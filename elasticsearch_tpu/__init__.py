@@ -1,0 +1,17 @@
+"""elasticsearch_tpu — a TPU-native distributed search engine.
+
+A from-scratch rebuild of the capabilities of Elasticsearch 8.0.0-alpha
+(reference surveyed in SURVEY.md) designed TPU-first:
+
+- The query phase (BM25 term scoring, boolean disjunction/conjunction, top-k)
+  executes as JAX/XLA programs over device-resident tiled posting tensors
+  (reference hot loop: server/src/main/java/org/elasticsearch/search/internal/
+  ContextIndexSearcher.java:170-206).
+- The coordinator-side shard reduce (reference: action/search/
+  SearchPhaseController.java:398-475) is replaced by all-gather/top-k
+  collectives over ICI on a `jax.sharding.Mesh`.
+- The host layer (REST API, JSON query DSL, indexing, WAL durability, routing,
+  fetch phase) is rebuilt idiomatically in Python with C++ for hot host paths.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,21 @@
+from .analyzers import (
+    Analyzer,
+    AnalysisRegistry,
+    KeywordAnalyzer,
+    SimpleAnalyzer,
+    StandardAnalyzer,
+    StopAnalyzer,
+    WhitespaceAnalyzer,
+    get_analyzer,
+)
+
+__all__ = [
+    "Analyzer",
+    "AnalysisRegistry",
+    "KeywordAnalyzer",
+    "SimpleAnalyzer",
+    "StandardAnalyzer",
+    "StopAnalyzer",
+    "WhitespaceAnalyzer",
+    "get_analyzer",
+]

@@ -1,0 +1,178 @@
+"""Text analysis: tokenizers, token filters, analyzers.
+
+Rebuilds the analysis chain of the reference (registry:
+server/src/main/java/org/elasticsearch/index/analysis/AnalysisRegistry.java,
+built-in chains: modules/analysis-common/) as composable Python callables.
+Analysis runs on the host at index/query time; its output (term ids, term
+frequencies, field lengths) is what gets packed into device tensors, so the
+only contract that matters for score parity is that index-time and query-time
+analysis agree.
+
+The standard analyzer approximates Lucene's UAX#29 word segmentation with a
+Unicode-aware word regex (alphanumeric runs, keeping digits), followed by
+lowercasing. Eastern-language segmentation packs (icu/kuromoji/nori/smartcn in
+the reference's plugins/) are out of scope.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Iterable
+
+Token = str
+TokenFilter = Callable[[list[Token]], list[Token]]
+
+# Unicode word pattern: letters/digits/underscore runs. Lucene's standard
+# tokenizer splits on punctuation and whitespace and keeps numerics.
+_WORD_RE = re.compile(r"[\w]+", re.UNICODE)
+_LETTER_RE = re.compile(r"[^\W\d_]+", re.UNICODE)
+
+# Lucene's default English stopword set (org.apache.lucene.analysis.en).
+ENGLISH_STOPWORDS = frozenset(
+    """a an and are as at be but by for if in into is it no not of on or such
+    that the their then there these they this to was will with""".split()
+)
+
+
+@dataclass
+class Analyzer:
+    """A tokenizer plus an ordered chain of token filters."""
+
+    name: str
+    tokenizer: Callable[[str], list[Token]]
+    filters: list[TokenFilter] = field(default_factory=list)
+
+    def analyze(self, text: str) -> list[Token]:
+        tokens = self.tokenizer(text)
+        for f in self.filters:
+            tokens = f(tokens)
+        return tokens
+
+    def __call__(self, text: str) -> list[Token]:
+        return self.analyze(text)
+
+
+def _standard_tokenize(text: str) -> list[Token]:
+    return _WORD_RE.findall(text)
+
+
+def _letter_tokenize(text: str) -> list[Token]:
+    return _LETTER_RE.findall(text)
+
+
+def _whitespace_tokenize(text: str) -> list[Token]:
+    return text.split()
+
+
+def _keyword_tokenize(text: str) -> list[Token]:
+    return [text] if text else []
+
+
+def lowercase_filter(tokens: list[Token]) -> list[Token]:
+    return [t.lower() for t in tokens]
+
+
+def make_stop_filter(stopwords: Iterable[str]) -> TokenFilter:
+    stopset = frozenset(stopwords)
+
+    def stop_filter(tokens: list[Token]) -> list[Token]:
+        return [t for t in tokens if t not in stopset]
+
+    return stop_filter
+
+
+def make_asciifolding_filter() -> TokenFilter:
+    import unicodedata
+
+    def fold(tokens: list[Token]) -> list[Token]:
+        out = []
+        for t in tokens:
+            norm = unicodedata.normalize("NFKD", t)
+            out.append("".join(c for c in norm if not unicodedata.combining(c)))
+        return out
+
+    return fold
+
+
+StandardAnalyzer = Analyzer("standard", _standard_tokenize, [lowercase_filter])
+SimpleAnalyzer = Analyzer("simple", _letter_tokenize, [lowercase_filter])
+WhitespaceAnalyzer = Analyzer("whitespace", _whitespace_tokenize, [])
+KeywordAnalyzer = Analyzer("keyword", _keyword_tokenize, [])
+StopAnalyzer = Analyzer(
+    "stop", _letter_tokenize, [lowercase_filter, make_stop_filter(ENGLISH_STOPWORDS)]
+)
+
+_BUILTIN = {
+    a.name: a
+    for a in (
+        StandardAnalyzer,
+        SimpleAnalyzer,
+        WhitespaceAnalyzer,
+        KeywordAnalyzer,
+        StopAnalyzer,
+    )
+}
+
+# "english" = standard tokenizer + lowercase + english stopwords. (The
+# reference additionally applies a possessive and porter stemmer; stemming is
+# intentionally omitted for round 1 to keep query/index analysis symmetric.)
+_BUILTIN["english"] = Analyzer(
+    "english",
+    _standard_tokenize,
+    [lowercase_filter, make_stop_filter(ENGLISH_STOPWORDS)],
+)
+
+
+def get_analyzer(name: str) -> Analyzer:
+    try:
+        return _BUILTIN[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown analyzer [{name}]; available: {sorted(_BUILTIN)}"
+        ) from None
+
+
+class AnalysisRegistry:
+    """Per-index analyzer registry supporting custom analyzer definitions.
+
+    Mirrors the role of the reference's AnalysisRegistry: resolve built-in
+    analyzers by name and build custom ones from a settings dict
+    ({"tokenizer": ..., "filter": [...]})
+    """
+
+    _TOKENIZERS = {
+        "standard": _standard_tokenize,
+        "letter": _letter_tokenize,
+        "whitespace": _whitespace_tokenize,
+        "keyword": _keyword_tokenize,
+    }
+
+    def __init__(self, custom: dict[str, dict] | None = None):
+        self._analyzers: dict[str, Analyzer] = dict(_BUILTIN)
+        for name, spec in (custom or {}).items():
+            self._analyzers[name] = self._build(name, spec)
+
+    def _build(self, name: str, spec: dict) -> Analyzer:
+        tokenizer_name = spec.get("tokenizer", "standard")
+        try:
+            tokenizer = self._TOKENIZERS[tokenizer_name]
+        except KeyError:
+            raise ValueError(f"unknown tokenizer [{tokenizer_name}]") from None
+        filters: list[TokenFilter] = []
+        for fname in spec.get("filter", []):
+            if fname == "lowercase":
+                filters.append(lowercase_filter)
+            elif fname == "stop":
+                filters.append(make_stop_filter(ENGLISH_STOPWORDS))
+            elif fname == "asciifolding":
+                filters.append(make_asciifolding_filter())
+            else:
+                raise ValueError(f"unknown token filter [{fname}]")
+        return Analyzer(name, tokenizer, filters)
+
+    def get(self, name: str) -> Analyzer:
+        try:
+            return self._analyzers[name]
+        except KeyError:
+            raise ValueError(f"unknown analyzer [{name}]") from None

@@ -1,0 +1,4 @@
+from .mapping import FieldMapping, Mappings
+from .segment import FieldIndex, Segment, SegmentBuilder
+
+__all__ = ["FieldMapping", "Mappings", "FieldIndex", "Segment", "SegmentBuilder"]

@@ -1,0 +1,160 @@
+"""Field mappings: the schema of an index.
+
+Rebuilds the role of the reference's mapper layer (server/src/main/java/org/
+elasticsearch/index/mapper/ — TextFieldMapper, KeywordFieldMapper,
+NumberFieldMapper, DenseVectorFieldMapper in x-pack/plugin/vectors/) as a thin
+declarative schema that drives:
+
+- which analyzer runs per field at index and query time,
+- which device-side structure a field materializes into (inverted postings for
+  text/keyword, dense doc-values columns for numerics, a dense matrix for
+  dense_vector),
+- dynamic mapping of unseen fields from JSON value types.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..analysis import AnalysisRegistry
+
+TEXT = "text"
+KEYWORD = "keyword"
+LONG = "long"
+INTEGER = "integer"
+SHORT = "short"
+BYTE = "byte"
+DOUBLE = "double"
+FLOAT = "float"
+BOOLEAN = "boolean"
+DATE = "date"
+DENSE_VECTOR = "dense_vector"
+
+NUMERIC_TYPES = {LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT, DATE, BOOLEAN}
+INVERTED_TYPES = {TEXT, KEYWORD}
+ALL_TYPES = NUMERIC_TYPES | INVERTED_TYPES | {DENSE_VECTOR}
+
+
+@dataclass
+class FieldMapping:
+    name: str
+    type: str
+    analyzer: str = "standard"
+    search_analyzer: str | None = None
+    dims: int = 0  # dense_vector dimension
+    index: bool = True  # whether the field is searchable
+    norms: bool | None = None  # None -> type default (text: True, keyword: False)
+
+    def __post_init__(self):
+        if self.type not in ALL_TYPES:
+            raise ValueError(f"No handler for type [{self.type}] on field [{self.name}]")
+        if self.type == KEYWORD:
+            self.analyzer = "keyword"
+        if self.search_analyzer is None:
+            self.search_analyzer = self.analyzer
+        if self.norms is None:
+            # Elasticsearch disables norms on keyword fields (KeywordFieldMapper
+            # omits norms); text fields index them by default.
+            self.norms = self.type == TEXT
+
+    @property
+    def is_inverted(self) -> bool:
+        return self.type in INVERTED_TYPES and self.index
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.type in NUMERIC_TYPES
+
+
+class Mappings:
+    """Parsed `mappings` for one index, with dynamic-mapping support.
+
+    Reference behavior being mirrored: unmapped fields get mapped on first
+    sight from their JSON type (string -> text, int -> long, float -> double,
+    bool -> boolean), as in the reference's DocumentParser dynamic mappings.
+    """
+
+    def __init__(
+        self,
+        properties: dict[str, dict[str, Any]] | None = None,
+        analysis: AnalysisRegistry | None = None,
+        dynamic: bool = True,
+    ):
+        self.fields: dict[str, FieldMapping] = {}
+        self.analysis = analysis or AnalysisRegistry()
+        self.dynamic = dynamic
+        for name, spec in (properties or {}).items():
+            self.fields[name] = self._parse_field(name, spec)
+
+    @staticmethod
+    def _parse_field(name: str, spec: dict[str, Any]) -> FieldMapping:
+        norms = spec.get("norms")
+        return FieldMapping(
+            name=name,
+            type=spec.get("type", TEXT),
+            analyzer=spec.get("analyzer", "standard"),
+            search_analyzer=spec.get("search_analyzer"),
+            dims=int(spec.get("dims", 0)),
+            index=bool(spec.get("index", True)),
+            norms=None if norms is None else bool(norms),
+        )
+
+    @classmethod
+    def from_json(cls, mappings_json: dict[str, Any] | None, **kw) -> "Mappings":
+        mappings_json = mappings_json or {}
+        return cls(properties=mappings_json.get("properties"), **kw)
+
+    def to_json(self) -> dict[str, Any]:
+        """Lossless schema serialization (round-trips through from_json)."""
+        props: dict[str, Any] = {}
+        for f in self.fields.values():
+            spec: dict[str, Any] = {"type": f.type}
+            if f.type == TEXT and f.analyzer != "standard":
+                spec["analyzer"] = f.analyzer
+            if f.search_analyzer != f.analyzer:
+                spec["search_analyzer"] = f.search_analyzer
+            if f.type == DENSE_VECTOR:
+                spec["dims"] = f.dims
+            if not f.index:
+                spec["index"] = False
+            if f.norms != (f.type == TEXT):
+                spec["norms"] = f.norms
+            props[f.name] = spec
+        return {"properties": props}
+
+    def get(self, name: str) -> FieldMapping | None:
+        return self.fields.get(name)
+
+    def resolve_dynamic(self, name: str, value: Any) -> FieldMapping | None:
+        """Map an unseen field from a concrete JSON value (or return None)."""
+        existing = self.fields.get(name)
+        if existing is not None:
+            return existing
+        if not self.dynamic:
+            return None
+        if isinstance(value, bool):
+            ftype = BOOLEAN
+        elif isinstance(value, int):
+            ftype = LONG
+        elif isinstance(value, float):
+            ftype = DOUBLE
+        elif isinstance(value, str):
+            ftype = TEXT
+        elif isinstance(value, list) and value and isinstance(value[0], (int, float)):
+            # Plain numeric arrays stay numeric multi-values; dense_vector must
+            # be mapped explicitly (as in the reference's x-pack vectors).
+            ftype = DOUBLE if any(isinstance(v, float) for v in value) else LONG
+        elif isinstance(value, list) and value and isinstance(value[0], str):
+            ftype = TEXT
+        else:
+            return None
+        fm = FieldMapping(name=name, type=ftype)
+        self.fields[name] = fm
+        return fm
+
+    def analyzer_for(self, name: str, search: bool = False):
+        fm = self.fields.get(name)
+        if fm is None:
+            return self.analysis.get("standard")
+        return self.analysis.get(fm.search_analyzer if search else fm.analyzer)

@@ -1,0 +1,223 @@
+"""Columnar inverted-index segments.
+
+The device-friendly replacement for the reference's Lucene segment layer
+(codec + FsDirectoryFactory mmap path, server/src/main/java/org/elasticsearch/
+index/store/FsDirectoryFactory.java:36). A Segment is an immutable columnar
+snapshot of a batch of documents:
+
+- per inverted field: a term dictionary plus CSR posting lists
+  (doc ids + term frequencies), norm bytes (Lucene SmallFloat-encoded field
+  lengths), and the collection stats BM25 needs (doc_count, sum_total_tf);
+- per numeric field: a dense doc-values column (float64, NaN = missing),
+  the analog of the reference's fielddata/doc-values access layer
+  (index/fielddata/FieldData.java);
+- per dense_vector field: a dense float32 matrix
+  (x-pack/plugin/vectors/.../mapper/DenseVectorFieldMapper.java);
+- stored `_source` documents (host-side; the fetch phase reads these).
+
+Everything is plain numpy so segments serialize trivially (npz) and pack
+directly into device tiles (see index/tiles.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from ..utils import smallfloat
+from .mapping import DENSE_VECTOR, Mappings
+
+
+@dataclass
+class FieldIndex:
+    """Immutable inverted index for one field within one segment."""
+
+    name: str
+    terms: dict[str, int]  # term -> term id (dense, 0..T-1)
+    df: np.ndarray  # int32[T] document frequency per term
+    offsets: np.ndarray  # int64[T+1] CSR offsets into doc_ids/tfs
+    doc_ids: np.ndarray  # int32[P] local doc ids, ascending within a term
+    tfs: np.ndarray  # float32[P] term frequency of (term, doc)
+    norm_bytes: np.ndarray  # uint8[N] SmallFloat-encoded field length
+    doc_count: int  # docs that have this field (BM25 docCount)
+    sum_total_tf: int  # total terms across docs (BM25 sumTotalTermFreq)
+    has_norms: bool = True  # keyword fields disable norms (ES KeywordFieldMapper)
+
+    @property
+    def num_terms(self) -> int:
+        return len(self.df)
+
+    @property
+    def avgdl(self) -> float:
+        if self.doc_count == 0:
+            return 1.0
+        return self.sum_total_tf / self.doc_count
+
+    def term_id(self, term: str) -> int | None:
+        return self.terms.get(term)
+
+    def postings(self, term: str) -> tuple[np.ndarray, np.ndarray]:
+        """(doc_ids, tfs) for a term; empty arrays if absent."""
+        tid = self.terms.get(term)
+        if tid is None:
+            return (
+                np.empty(0, dtype=np.int32),
+                np.empty(0, dtype=np.float32),
+            )
+        lo, hi = int(self.offsets[tid]), int(self.offsets[tid + 1])
+        return self.doc_ids[lo:hi], self.tfs[lo:hi]
+
+    def quantized_lengths(self) -> np.ndarray:
+        """float32[N] per-doc field length after norm-byte quantization."""
+        return smallfloat.LENGTH_TABLE[self.norm_bytes]
+
+
+@dataclass
+class Segment:
+    """An immutable batch of indexed documents."""
+
+    num_docs: int
+    fields: dict[str, FieldIndex]
+    doc_values: dict[str, np.ndarray]  # field -> float64[N] (NaN missing)
+    vectors: dict[str, np.ndarray]  # field -> float32[N, D]
+    sources: list[dict[str, Any]]  # stored _source per local doc
+    ids: list[str]  # external _id per local doc
+
+
+def _iter_field_values(value: Any) -> list[Any]:
+    if isinstance(value, list):
+        return value
+    return [value]
+
+
+class SegmentBuilder:
+    """Accumulates documents and freezes them into a Segment.
+
+    The analog of the reference's in-memory Lucene IndexWriter buffer on the
+    write path (index/engine/InternalEngine.java:851 indexIntoLucene).
+    """
+
+    def __init__(self, mappings: Mappings):
+        self.mappings = mappings
+        self._sources: list[dict[str, Any]] = []
+        self._ids: list[str] = []
+        # field -> {term -> list[(doc, tf)]} accumulated as dict doc->tf
+        self._inverted: dict[str, dict[str, dict[int, int]]] = {}
+        self._lengths: dict[str, dict[int, int]] = {}  # field -> doc -> len
+        self._numeric: dict[str, dict[int, float]] = {}
+        self._vectors: dict[str, dict[int, np.ndarray]] = {}
+
+    @property
+    def num_docs(self) -> int:
+        return len(self._sources)
+
+    def add(self, source: dict[str, Any], doc_id: str | None = None) -> int:
+        """Index one document; returns its local doc id."""
+        local = len(self._sources)
+        self._sources.append(source)
+        self._ids.append(doc_id if doc_id is not None else str(local))
+        for field_name, value in source.items():
+            if value is None:
+                continue
+            fm = self.mappings.resolve_dynamic(field_name, value)
+            if fm is None:
+                continue
+            # Note: index=false only disables inverted search (fm.is_inverted
+            # is False then); numeric doc_values and vectors are stored
+            # regardless, matching the reference where index:false keeps
+            # doc_values available for sort/agg/script access.
+            if fm.type == DENSE_VECTOR:
+                vec = np.asarray(value, dtype=np.float32)
+                if fm.dims and vec.shape[-1] != fm.dims:
+                    raise ValueError(
+                        f"dense_vector [{field_name}] dims mismatch: "
+                        f"{vec.shape[-1]} != {fm.dims}"
+                    )
+                self._vectors.setdefault(field_name, {})[local] = vec
+            elif fm.is_inverted:
+                analyzer = self.mappings.analyzer_for(field_name)
+                total_len = 0
+                postings = self._inverted.setdefault(field_name, {})
+                for v in _iter_field_values(value):
+                    tokens = analyzer.analyze(str(v))
+                    total_len += len(tokens)
+                    for tok in tokens:
+                        by_doc = postings.setdefault(tok, {})
+                        by_doc[local] = by_doc.get(local, 0) + 1
+                # Docs whose value analyzed to zero tokens (e.g. all
+                # stopwords) produce no postings and must not count toward
+                # docCount/sumTotalTermFreq — Lucene's Terms.getDocCount only
+                # counts docs with at least one posting for the field.
+                if total_len > 0:
+                    self._lengths.setdefault(field_name, {})[local] = total_len
+            elif fm.is_numeric:
+                vals = _iter_field_values(value)
+                v0 = vals[0]  # multi-valued numerics keep first value for now
+                if isinstance(v0, bool):
+                    v0 = 1.0 if v0 else 0.0
+                self._numeric.setdefault(field_name, {})[local] = float(v0)
+        return local
+
+    def build(self) -> Segment:
+        n = len(self._sources)
+        fields: dict[str, FieldIndex] = {}
+        for fname, postings in self._inverted.items():
+            terms = {t: i for i, t in enumerate(sorted(postings))}
+            t_count = len(terms)
+            df = np.zeros(t_count, dtype=np.int32)
+            offsets = np.zeros(t_count + 1, dtype=np.int64)
+            for term, tid in terms.items():
+                df[tid] = len(postings[term])
+            offsets[1:] = np.cumsum(df)
+            total = int(offsets[-1])
+            doc_ids = np.empty(total, dtype=np.int32)
+            tfs = np.empty(total, dtype=np.float32)
+            for term, tid in terms.items():
+                lo = int(offsets[tid])
+                by_doc = postings[term]
+                docs_sorted = sorted(by_doc)
+                doc_ids[lo : lo + len(docs_sorted)] = docs_sorted
+                tfs[lo : lo + len(docs_sorted)] = [by_doc[d] for d in docs_sorted]
+            lengths = self._lengths.get(fname, {})
+            norm_bytes = np.zeros(n, dtype=np.uint8)
+            if lengths:
+                docs_with_field = np.fromiter(lengths.keys(), dtype=np.int64)
+                lens = np.fromiter(lengths.values(), dtype=np.int64)
+                norm_bytes[docs_with_field] = smallfloat.encode_lengths(lens)
+            fm = self.mappings.get(fname)
+            fields[fname] = FieldIndex(
+                has_norms=fm.norms if fm is not None else True,
+                name=fname,
+                terms=terms,
+                df=df,
+                offsets=offsets,
+                doc_ids=doc_ids,
+                tfs=tfs,
+                norm_bytes=norm_bytes,
+                doc_count=len(lengths),
+                sum_total_tf=int(sum(lengths.values())),
+            )
+        doc_values: dict[str, np.ndarray] = {}
+        for fname, by_doc in self._numeric.items():
+            col = np.full(n, np.nan, dtype=np.float64)
+            for doc, v in by_doc.items():
+                col[doc] = v
+            doc_values[fname] = col
+        vectors: dict[str, np.ndarray] = {}
+        for fname, by_doc in self._vectors.items():
+            fm = self.mappings.get(fname)
+            dims = fm.dims if fm and fm.dims else len(next(iter(by_doc.values())))
+            mat = np.zeros((n, dims), dtype=np.float32)
+            for doc, vec in by_doc.items():
+                mat[doc] = vec
+            vectors[fname] = mat
+        return Segment(
+            num_docs=n,
+            fields=fields,
+            doc_values=doc_values,
+            vectors=vectors,
+            sources=list(self._sources),
+            ids=list(self._ids),
+        )

@@ -1,0 +1,23 @@
+"""Test environment: force an 8-device virtual CPU mesh before jax imports.
+
+Multi-chip sharding paths are tested on virtual CPU devices (the driver
+separately dry-runs __graft_entry__.dryrun_multichip); real-TPU benchmarking
+happens via bench.py only.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

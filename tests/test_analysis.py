@@ -1,0 +1,55 @@
+import pytest
+
+from elasticsearch_tpu.analysis import (
+    AnalysisRegistry,
+    KeywordAnalyzer,
+    StandardAnalyzer,
+    WhitespaceAnalyzer,
+    get_analyzer,
+)
+
+
+def test_standard_lowercases_and_splits_punctuation():
+    assert StandardAnalyzer("The QUICK-brown fox, 42 jumps!") == [
+        "the",
+        "quick",
+        "brown",
+        "fox",
+        "42",
+        "jumps",
+    ]
+
+
+def test_standard_unicode():
+    assert StandardAnalyzer("Küche straße") == ["küche", "straße"]
+
+
+def test_whitespace_preserves_case():
+    assert WhitespaceAnalyzer("Foo BAR") == ["Foo", "BAR"]
+
+
+def test_keyword_single_token():
+    assert KeywordAnalyzer("New York") == ["New York"]
+    assert KeywordAnalyzer("") == []
+
+
+def test_stop_analyzer():
+    stop = get_analyzer("stop")
+    assert stop("the quick and the dead") == ["quick", "dead"]
+
+
+def test_english_keeps_digits_out_of_letters():
+    en = get_analyzer("english")
+    assert en("The 3 foxes") == ["3", "foxes"]
+
+
+def test_custom_analyzer_registry():
+    reg = AnalysisRegistry(
+        custom={"my": {"tokenizer": "whitespace", "filter": ["lowercase", "asciifolding"]}}
+    )
+    assert reg.get("my")("Crème BRÛLÉE") == ["creme", "brulee"]
+
+
+def test_unknown_analyzer_raises():
+    with pytest.raises(ValueError):
+        get_analyzer("nope")

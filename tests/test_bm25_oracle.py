@@ -1,0 +1,125 @@
+import math
+
+import numpy as np
+
+from elasticsearch_tpu.index import Mappings, SegmentBuilder
+from elasticsearch_tpu.ops import bm25
+from elasticsearch_tpu.utils import smallfloat
+
+
+def build(docs):
+    b = SegmentBuilder(Mappings())
+    for d in docs:
+        b.add({"body": d})
+    return b.build()
+
+
+def manual_bm25(tf, dl, avgdl, df, doc_count, k1=1.2, b=0.75):
+    idf = math.log(1 + (doc_count - df + 0.5) / (df + 0.5))
+    return (k1 + 1) * idf * tf / (tf + k1 * (1 - b + b * dl / avgdl))
+
+
+def test_single_term_matches_hand_formula():
+    seg = build(["fox fox jumps", "lazy dog", "fox den"])
+    f = seg.fields["body"]
+    scores = bm25.score_terms_dense(f, ["fox"], seg.num_docs)
+    avgdl = (3 + 2 + 2) / 3
+    # doc 0: tf=2, dl=3 (exact, < 24 so no quantization loss)
+    assert np.isclose(scores[0], manual_bm25(2, 3, avgdl, df=2, doc_count=3), rtol=1e-6)
+    assert scores[1] == 0.0
+    assert np.isclose(scores[2], manual_bm25(1, 2, avgdl, df=2, doc_count=3), rtol=1e-6)
+
+
+def test_idf_values():
+    assert np.isclose(bm25.idf(1, 1), math.log(1 + 0.5 / 1.5))
+    assert np.isclose(bm25.idf(2, 10), math.log(1 + 8.5 / 2.5))
+
+
+def test_quantized_length_used_for_long_docs():
+    long_doc = " ".join(f"w{i}" for i in range(100)) + " target"
+    seg = build([long_doc, "target short"])
+    f = seg.fields["body"]
+    scores = bm25.score_terms_dense(f, ["target"], seg.num_docs)
+    dl0 = smallfloat.byte4_to_int(smallfloat.int_to_byte4(101))
+    assert dl0 != 101  # quantization is lossy here
+    avgdl = (101 + 2) / 2
+    expect = manual_bm25(1, dl0, avgdl, df=2, doc_count=2)
+    assert np.isclose(scores[0], expect, rtol=1e-6)
+
+
+def test_disjunction_sums_terms():
+    seg = build(["red fox", "red dog", "blue fox"])
+    f = seg.fields["body"]
+    s_red = bm25.score_terms_dense(f, ["red"], 3)
+    s_fox = bm25.score_terms_dense(f, ["fox"], 3)
+    s_both = bm25.score_terms_dense(f, ["red", "fox"], 3)
+    np.testing.assert_allclose(s_both, s_red + s_fox, rtol=1e-6)
+
+
+def test_duplicate_query_terms_double_count():
+    seg = build(["red fox", "red dog"])
+    f = seg.fields["body"]
+    s1 = bm25.score_terms_dense(f, ["red"], 2)
+    s2 = bm25.score_terms_dense(f, ["red", "red"], 2)
+    np.testing.assert_allclose(s2, 2 * s1, rtol=1e-6)
+
+
+def test_top_k_tie_breaks_by_doc_id():
+    scores = np.array([1.0, 3.0, 3.0, 2.0, 3.0], dtype=np.float32)
+    top, ids = bm25.top_k(scores, 4)
+    np.testing.assert_array_equal(ids, [1, 2, 4, 3])
+    np.testing.assert_array_equal(top, [3.0, 3.0, 3.0, 2.0])
+
+
+def test_top_k_truncation_and_empty():
+    scores = np.array([0.5, 0.1], dtype=np.float32)
+    top, ids = bm25.top_k(scores, 10)
+    assert len(top) == 2
+    top, ids = bm25.top_k(np.empty(0, dtype=np.float32), 10)
+    assert len(top) == 0
+
+
+def test_boost_scales_linearly():
+    seg = build(["fox", "dog"])
+    f = seg.fields["body"]
+    s1 = bm25.score_terms_dense(f, ["fox"], 2, boost=1.0)
+    s2 = bm25.score_terms_dense(f, ["fox"], 2, boost=2.5)
+    np.testing.assert_allclose(s2, 2.5 * s1, rtol=1e-6)
+
+
+def test_missing_term_returns_zero_hits():
+    seg = build(["fox den", "lazy dog"])
+    f = seg.fields["body"]
+    scores, ids = bm25.search_field(f, ["zzz"], seg.num_docs, k=10)
+    assert len(ids) == 0
+
+
+def test_fewer_matches_than_k():
+    seg = build(["fox", "dog", "cat", "bird"])
+    f = seg.fields["body"]
+    scores, ids = bm25.search_field(f, ["fox"], seg.num_docs, k=10)
+    assert len(ids) == 1 and ids[0] == 0
+
+
+def test_norms_disabled_uses_norm_byte_one():
+    from elasticsearch_tpu.index import Mappings, SegmentBuilder
+
+    m = Mappings.from_json({"properties": {"tag": {"type": "keyword"}}})
+    b = SegmentBuilder(m)
+    b.add({"tag": ["a", "b", "c"]})
+    b.add({"tag": "a"})
+    seg = b.build()
+    f = seg.fields["tag"]
+    # Lucene 8.9: missing norms -> norm value 1 -> cache[1], avgdl = 4/2 = 2
+    avgdl = f.avgdl
+    expect_inv = np.float32(1.0) / (np.float32(1.2) * (np.float32(0.25) + np.float32(0.75) * np.float32(1.0) / np.float32(avgdl)))
+    got = bm25.field_norm_inverse(f)
+    assert np.allclose(got, expect_inv, rtol=1e-7)
+
+
+def test_term_weight_fp32_rounding_order():
+    # weight must equal fp32(fp32(boost*(k1+1)) * fp32(idf))
+    w = bm25.term_weight(7, 1000, boost=1.3)
+    idf32 = np.float32(bm25.idf(7, 1000))
+    boost32 = np.float32(np.float32(1.3) * np.float32(2.2))
+    assert np.float32(w) == boost32 * idf32

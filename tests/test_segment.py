@@ -1,0 +1,164 @@
+import numpy as np
+
+from elasticsearch_tpu.index import Mappings, SegmentBuilder
+from elasticsearch_tpu.utils import smallfloat
+
+
+def build_books():
+    mappings = Mappings.from_json(
+        {
+            "properties": {
+                "title": {"type": "text"},
+                "tag": {"type": "keyword"},
+                "year": {"type": "long"},
+            }
+        }
+    )
+    b = SegmentBuilder(mappings)
+    b.add({"title": "the quick brown fox", "tag": "animals", "year": 2001}, "a")
+    b.add({"title": "the lazy dog", "tag": "animals", "year": 2002}, "b")
+    b.add({"title": "quick quick fox", "year": 2003}, "c")
+    b.add({"tag": "other"}, "d")
+    return b.build()
+
+
+def test_postings_and_stats():
+    seg = build_books()
+    title = seg.fields["title"]
+    assert seg.num_docs == 4
+    assert title.doc_count == 3  # doc d has no title
+    assert title.sum_total_tf == 4 + 3 + 3
+    docs, tfs = title.postings("quick")
+    np.testing.assert_array_equal(docs, [0, 2])
+    np.testing.assert_array_equal(tfs, [1.0, 2.0])
+    docs, _ = title.postings("missing")
+    assert len(docs) == 0
+    assert int(title.df[title.terms["the"]]) == 2
+
+
+def test_norms_quantized():
+    seg = build_books()
+    title = seg.fields["title"]
+    expect = [4, 3, 3, 0]
+    for doc, ln in enumerate(expect):
+        assert title.norm_bytes[doc] == smallfloat.int_to_byte4(ln)
+    np.testing.assert_array_equal(title.quantized_lengths(), np.float32(expect))
+
+
+def test_keyword_field_untokenized():
+    seg = build_books()
+    tag = seg.fields["tag"]
+    docs, _ = tag.postings("animals")
+    np.testing.assert_array_equal(docs, [0, 1])
+    assert tag.doc_count == 3
+
+
+def test_doc_values_with_missing():
+    seg = build_books()
+    year = seg.doc_values["year"]
+    np.testing.assert_array_equal(year[:3], [2001.0, 2002.0, 2003.0])
+    assert np.isnan(year[3])
+
+
+def test_dynamic_mapping():
+    m = Mappings()
+    b = SegmentBuilder(m)
+    b.add({"msg": "hello world", "n": 7, "x": 1.5, "flag": True})
+    seg = b.build()
+    assert m.fields["msg"].type == "text"
+    assert m.fields["n"].type == "long"
+    assert m.fields["x"].type == "double"
+    assert m.fields["flag"].type == "boolean"
+    assert seg.doc_values["flag"][0] == 1.0
+
+
+def test_dense_vector():
+    m = Mappings.from_json(
+        {"properties": {"emb": {"type": "dense_vector", "dims": 4}}}
+    )
+    b = SegmentBuilder(m)
+    b.add({"emb": [1.0, 2.0, 3.0, 4.0]})
+    b.add({})
+    seg = b.build()
+    assert seg.vectors["emb"].shape == (2, 4)
+    np.testing.assert_array_equal(seg.vectors["emb"][1], 0.0)
+
+
+def test_multivalue_text():
+    m = Mappings()
+    b = SegmentBuilder(m)
+    b.add({"t": ["red fox", "red dog"]})
+    seg = b.build()
+    t = seg.fields["t"]
+    docs, tfs = t.postings("red")
+    np.testing.assert_array_equal(docs, [0])
+    np.testing.assert_array_equal(tfs, [2.0])
+    assert t.sum_total_tf == 4
+
+
+def test_keyword_norms_disabled():
+    seg = build_books()
+    assert seg.fields["tag"].has_norms is False
+    assert seg.fields["title"].has_norms is True
+
+
+def test_keyword_scoring_ignores_length():
+    from elasticsearch_tpu.ops import bm25
+
+    m = Mappings.from_json({"properties": {"tag": {"type": "keyword"}}})
+    b = SegmentBuilder(m)
+    b.add({"tag": ["a", "b", "c"]})  # dl=3
+    b.add({"tag": ["a"]})  # dl=1
+    seg = b.build()
+    s = bm25.score_terms_dense(seg.fields["tag"], ["a"], 2)
+    assert s[0] == s[1] != 0.0
+
+
+def test_index_false_numeric_keeps_doc_values():
+    m = Mappings.from_json(
+        {"properties": {"year": {"type": "long", "index": False}}}
+    )
+    b = SegmentBuilder(m)
+    b.add({"year": 1999})
+    seg = b.build()
+    assert seg.doc_values["year"][0] == 1999.0
+
+
+def test_mappings_roundtrip_lossless():
+    m = Mappings.from_json(
+        {
+            "properties": {
+                "year": {"type": "long", "index": False},
+                "t": {"type": "text", "analyzer": "english", "search_analyzer": "standard"},
+                "k": {"type": "keyword"},
+                "nt": {"type": "text", "norms": False},
+            }
+        }
+    )
+    m2 = Mappings.from_json(m.to_json())
+    for name in m.fields:
+        a, b2 = m.fields[name], m2.fields[name]
+        assert (a.type, a.index, a.norms, a.analyzer, a.search_analyzer, a.dims) == (
+            b2.type, b2.index, b2.norms, b2.analyzer, b2.search_analyzer, b2.dims
+        )
+
+
+def test_zero_token_doc_not_in_doc_count():
+    m = Mappings.from_json(
+        {"properties": {"t": {"type": "text", "analyzer": "english"}}}
+    )
+    b = SegmentBuilder(m)
+    b.add({"t": "the of and"})  # all stopwords -> 0 tokens
+    b.add({"t": "fox"})
+    seg = b.build()
+    t = seg.fields["t"]
+    assert t.doc_count == 1
+    assert t.sum_total_tf == 1
+
+
+def test_builder_reuse_does_not_mutate_built_segment():
+    b = SegmentBuilder(Mappings())
+    b.add({"t": "one"}, "a")
+    seg = b.build()
+    b.add({"t": "two"}, "b")
+    assert len(seg.sources) == 1 and len(seg.ids) == 1

@@ -1,0 +1,68 @@
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.utils import smallfloat as sf
+
+
+def test_small_values_exact():
+    # Values below NUM_FREE_VALUES (24) round-trip exactly.
+    for i in range(sf.NUM_FREE_VALUES):
+        assert sf.byte4_to_int(sf.int_to_byte4(i)) == i
+
+
+def test_num_free_values_matches_lucene():
+    # Lucene: MAX_INT4 = longToInt4(Integer.MAX_VALUE) = 231, free = 24.
+    assert sf.NUM_FREE_VALUES == 24
+
+
+def test_order_preserving():
+    prev = -1
+    for i in [0, 1, 5, 23, 24, 30, 40, 64, 100, 1000, 10_000, 1_000_000, 2**31 - 1]:
+        enc = sf.int_to_byte4(i)
+        assert enc > prev or sf.byte4_to_int(enc) == sf.byte4_to_int(prev if prev >= 0 else 0)
+        prev = enc
+
+
+def test_monotone_and_lossy_quantization():
+    vals = np.arange(0, 5000)
+    enc = sf.encode_lengths(vals)
+    dec = sf.LENGTH_TABLE[enc]
+    # Decoded value never exceeds the input and is monotone non-decreasing.
+    assert np.all(dec <= vals)
+    assert np.all(np.diff(dec) >= 0)
+    # 4 significant bits: relative error bounded by 1/8.
+    nz = vals > 0
+    assert np.all((vals[nz] - dec[nz]) / vals[nz] <= 0.125)
+
+
+def test_known_lucene_values():
+    # Spot values checked against Lucene SmallFloat semantics:
+    # intToByte4(24) begins the encoded range (24 -> longToInt4(0) = 0 -> byte 24).
+    assert sf.int_to_byte4(24) == 24
+    assert sf.byte4_to_int(24) == 24
+    # 39 -> 24 + longToInt4(15): 15 = 0b1111 (4 bits) -> shift 0, enc = 0b1111 = 15
+    assert sf.int_to_byte4(39) == 24 + 15
+    assert sf.byte4_to_int(24 + 15) == 39
+    # 40 -> 24 + longToInt4(16): 16 -> numBits 5, shift 1, enc = 16 -> 40 decodes to 40
+    assert sf.byte4_to_int(sf.int_to_byte4(40)) == 40
+    # 41 -> 24+longToInt4(17): 17>>1=8 & 7 = 0 | (2<<3) = 16 ... decodes to 16 -> 40
+    assert sf.byte4_to_int(sf.int_to_byte4(41)) == 40
+
+
+def test_negative_rejected():
+    with pytest.raises(ValueError):
+        sf.int_to_byte4(-1)
+
+
+def test_length_table_shape():
+    assert sf.LENGTH_TABLE.shape == (256,)
+    assert sf.LENGTH_TABLE.dtype == np.float32
+    assert sf.LENGTH_TABLE[0] == 0.0
+    assert sf.LENGTH_TABLE[255] == float(sf.byte4_to_int(255))
+
+
+def test_encode_lengths_matches_scalar_loop():
+    vals = np.concatenate([np.arange(0, 3000), np.array([2**20, 2**30, 2**31 - 1])])
+    enc = sf.encode_lengths(vals)
+    for v, e in zip(vals.tolist(), enc.tolist()):
+        assert e == sf.int_to_byte4(v), f"mismatch at {v}"
