@@ -36,6 +36,29 @@ INVERTED_TYPES = {TEXT, KEYWORD}
 ALL_TYPES = NUMERIC_TYPES | INVERTED_TYPES | {DENSE_VECTOR}
 
 
+def coerce_numeric(field_type: str, value: Any) -> float:
+    """Coerce a query/document value to the numeric column representation.
+
+    Mirrors the reference's per-type value parsing (NumberFieldMapper value
+    coercion, BooleanFieldMapper accepting true/false/"true"/"false"):
+    booleans map to 1.0/0.0, numeric strings are parsed, anything else raises
+    ValueError (the reference throws a mapper parsing exception).
+    """
+    if field_type == BOOLEAN:
+        if value is True or value == "true":
+            return 1.0
+        if value is False or value == "false":
+            return 0.0
+        if isinstance(value, (int, float)):  # already-coerced column value
+            return float(value)
+        raise ValueError(
+            f"Can't parse boolean value [{value!r}], expected [true] or [false]"
+        )
+    if isinstance(value, bool):
+        return 1.0 if value else 0.0
+    return float(value)
+
+
 @dataclass
 class FieldMapping:
     name: str

@@ -41,9 +41,13 @@ class FieldIndex:
     doc_ids: np.ndarray  # int32[P] local doc ids, ascending within a term
     tfs: np.ndarray  # float32[P] term frequency of (term, doc)
     norm_bytes: np.ndarray  # uint8[N] SmallFloat-encoded field length
-    doc_count: int  # docs that have this field (BM25 docCount)
+    doc_count: int  # docs with >=1 posting (BM25 docCount, Lucene Terms.getDocCount)
     sum_total_tf: int  # total terms across docs (BM25 sumTotalTermFreq)
     has_norms: bool = True  # keyword fields disable norms (ES KeywordFieldMapper)
+    # bool[N]: doc supplied a value for this field, even if it analyzed to
+    # zero tokens (all stopwords / empty string). Backs `exists` semantics —
+    # Lucene's NormsFieldExistsQuery matches any doc with the field indexed.
+    present: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=bool))
 
     @property
     def num_terms(self) -> int:
@@ -106,6 +110,7 @@ class SegmentBuilder:
         # field -> {term -> list[(doc, tf)]} accumulated as dict doc->tf
         self._inverted: dict[str, dict[str, dict[int, int]]] = {}
         self._lengths: dict[str, dict[int, int]] = {}  # field -> doc -> len
+        self._present: dict[str, set[int]] = {}  # field -> docs with a value
         self._numeric: dict[str, dict[int, float]] = {}
         self._vectors: dict[str, dict[int, np.ndarray]] = {}
 
@@ -139,6 +144,7 @@ class SegmentBuilder:
             elif fm.is_inverted:
                 analyzer = self.mappings.analyzer_for(field_name)
                 total_len = 0
+                self._present.setdefault(field_name, set()).add(local)
                 postings = self._inverted.setdefault(field_name, {})
                 for v in _iter_field_values(value):
                     tokens = analyzer.analyze(str(v))
@@ -187,7 +193,12 @@ class SegmentBuilder:
                 lens = np.fromiter(lengths.values(), dtype=np.int64)
                 norm_bytes[docs_with_field] = smallfloat.encode_lengths(lens)
             fm = self.mappings.get(fname)
+            present = np.zeros(n, dtype=bool)
+            present_docs = self._present.get(fname)
+            if present_docs:
+                present[np.fromiter(present_docs, dtype=np.int64)] = True
             fields[fname] = FieldIndex(
+                present=present,
                 has_norms=fm.norms if fm is not None else True,
                 name=fname,
                 terms=terms,

@@ -1,0 +1,218 @@
+"""Device-resident tiled index layout.
+
+This is the HBM-resident replacement for Lucene's mmap'd segment files
+(reference: server/src/main/java/org/elasticsearch/index/store/
+FsDirectoryFactory.java:36 — immutable scoring files memory-mapped with
+optional preload). Instead of pointer-chased posting blocks, a field's
+postings live on device as flat CSR arrays padded to a tile multiple:
+
+    doc_ids : int32[P_pad]   local doc ids (sentinel = num_docs for padding)
+    tfs     : float32[P_pad] term frequencies (0 for padding)
+
+A query term is a contiguous [start, end) slice of these arrays. Because XLA
+needs static shapes, the per-query access pattern is expressed as *tile
+gathers*: the flat arrays are viewed as [P_pad // TILE, TILE] and a term's
+postings are covered by the tile ids it spans (host-computed at plan time,
+padded to a per-query bucket). The kernel in ops/bm25_device.py gathers those
+tiles, masks positions outside [start, end), and scatter-adds BM25
+contributions into a dense score vector.
+
+norm bytes (uint8, Lucene SmallFloat field lengths) ride along with one extra
+sentinel slot so padded doc ids gather norm 0 harmlessly; numeric doc-values
+columns and dense vectors are uploaded densely.
+
+Design notes (TPU-first):
+- Tile gathers keep HBM reads contiguous and aligned to the 128-lane layout.
+- The padding sentinel doc id == num_docs scatters into an extra slot that is
+  sliced off, so no masking is needed on the scatter itself.
+- All arrays are device-put once at refresh; per-query host→device traffic is
+  only the plan's small integer/float arrays (tile ids, weights, norm cache).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .segment import FieldIndex, Segment
+
+TILE = 256  # postings per tile; multiple of the 128-lane TPU layout
+
+
+def _pad_to_tile(arr: np.ndarray, pad_value, tile: int = TILE) -> np.ndarray:
+    """Pad to a tile multiple PLUS one extra all-padding sentinel tile.
+
+    The sentinel tile (always the last) is the target of padding slots in
+    per-query tile-id arrays: its global positions are >= every real posting
+    position, so the kernel's [start, end) mask can never select it.
+    """
+    p = len(arr)
+    p_pad = ((p + tile - 1) // tile) * tile + tile
+    out = np.full(p_pad, pad_value, dtype=arr.dtype)
+    out[:p] = arr
+    return out
+
+
+@dataclass
+class DeviceField:
+    """One field's postings resident on device (plus host-side term dict)."""
+
+    name: str
+    # Host-side planning data (term dictionary stays on host, like the
+    # reference's terms dict staying on-heap while postings are mmap'd):
+    terms: dict[str, int]
+    df: np.ndarray  # int32[T] host copy, for IDF at plan time
+    offsets: np.ndarray  # int64[T+1] host copy, for tile id computation
+    doc_count: int
+    sum_total_tf: int
+    has_norms: bool
+    # Device arrays:
+    doc_ids: jax.Array  # int32[NT, TILE]  (sentinel num_docs in padding)
+    tfs: jax.Array  # float32[NT, TILE]
+    norm_bytes: jax.Array  # uint8[N + 1]   (sentinel slot at N)
+    present: jax.Array  # bool[N] doc has a value for this field (exists query)
+
+    @property
+    def num_tiles(self) -> int:
+        return self.doc_ids.shape[0]
+
+    @property
+    def pad_tile(self) -> int:
+        """Tile id of the all-sentinel padding tile (always the last)."""
+        return self.doc_ids.shape[0] - 1
+
+    @property
+    def avgdl(self) -> float:
+        if self.doc_count == 0:
+            return 1.0
+        return self.sum_total_tf / self.doc_count
+
+    def term_span(self, term: str) -> tuple[int, int]:
+        """[start, end) posting positions for a term; (0, 0) if absent."""
+        tid = self.terms.get(term)
+        if tid is None:
+            return (0, 0)
+        return int(self.offsets[tid]), int(self.offsets[tid + 1])
+
+    def term_df(self, term: str) -> int:
+        tid = self.terms.get(term)
+        if tid is None:
+            return 0
+        return int(self.df[tid])
+
+
+@dataclass
+class DeviceSegment:
+    """A Segment uploaded to device memory (the 'refreshed' searchable form).
+
+    The analog of the reference's opened DirectoryReader over a committed
+    Lucene segment (index/engine/InternalEngine.java refresh →
+    ContextIndexSearcher over segment leaves). `live` is the liveDocs deletion
+    mask (ContextIndexSearcher.java:181-195): True = visible.
+    """
+
+    num_docs: int
+    fields: dict[str, DeviceField]
+    doc_values: dict[str, jax.Array]  # float64 is TPU-hostile: stored f32
+    vectors: dict[str, jax.Array]  # float32[N, D]
+    live: jax.Array  # bool[N]
+    # Host-side fetch-phase data:
+    sources: list[dict[str, Any]]
+    ids: list[str]
+
+    def field(self, name: str) -> DeviceField:
+        try:
+            return self.fields[name]
+        except KeyError:
+            raise KeyError(
+                f"no inverted field [{name}] in segment; have {sorted(self.fields)}"
+            ) from None
+
+
+def pack_field(field: FieldIndex, num_docs: int, device=None) -> DeviceField:
+    """Pack one FieldIndex into tiled device arrays."""
+    doc_ids = _pad_to_tile(field.doc_ids.astype(np.int32), np.int32(num_docs))
+    tfs = _pad_to_tile(field.tfs.astype(np.float32), np.float32(0.0))
+    norm_ext = np.zeros(num_docs + 1, dtype=np.uint8)
+    norm_ext[:num_docs] = field.norm_bytes
+    put = lambda x: jax.device_put(x, device)
+    return DeviceField(
+        name=field.name,
+        terms=field.terms,
+        df=field.df,
+        offsets=field.offsets,
+        doc_count=field.doc_count,
+        sum_total_tf=field.sum_total_tf,
+        has_norms=field.has_norms,
+        doc_ids=put(doc_ids.reshape(-1, TILE)),
+        tfs=put(tfs.reshape(-1, TILE)),
+        norm_bytes=put(norm_ext),
+        # FieldIndex instances predating the presence bitmap (direct
+        # construction, old serialized forms) fall back to norm-byte presence
+        # — the same fallback the oracle uses, so the two sides never diverge
+        # silently.
+        present=put(
+            field.present
+            if len(field.present) == num_docs
+            else np.asarray(field.norm_bytes[:num_docs] > 0)
+        ),
+    )
+
+
+def pack_segment(
+    segment: Segment, device=None, deleted: np.ndarray | None = None
+) -> DeviceSegment:
+    """Upload a whole Segment to the device (the 'refresh' step)."""
+    n = segment.num_docs
+    put = lambda x: jax.device_put(x, device)
+    fields = {
+        name: pack_field(f, n, device) for name, f in segment.fields.items()
+    }
+    doc_values = {
+        name: put(col.astype(np.float32)) for name, col in segment.doc_values.items()
+    }
+    vectors = {name: put(mat) for name, mat in segment.vectors.items()}
+    live = np.ones(n, dtype=bool)
+    if deleted is not None and len(deleted):
+        live[deleted] = False
+    return DeviceSegment(
+        num_docs=n,
+        fields=fields,
+        doc_values=doc_values,
+        vectors=vectors,
+        live=put(live),
+        sources=segment.sources,
+        ids=segment.ids,
+    )
+
+
+def term_tile_ids(start: int, end: int, max_tiles: int, pad_tile: int) -> np.ndarray:
+    """int32[max_tiles] tile ids covering postings [start, end).
+
+    Padding slots point at `pad_tile`, the segment's all-sentinel tile whose
+    positions lie past every real posting — the kernel's [start, end) mask
+    therefore never selects them (a padding slot aimed at a REAL tile would
+    double-count any term whose span covers that tile).
+    """
+    out = np.full(max_tiles, pad_tile, dtype=np.int32)
+    if end > start:
+        first = start // TILE
+        last = (end - 1) // TILE
+        count = last - first + 1
+        if count > max_tiles:
+            raise ValueError(
+                f"term spans {count} tiles > bucket {max_tiles}; "
+                "plan bucketing must grow the bucket"
+            )
+        out[:count] = np.arange(first, first + count, dtype=np.int32)
+    return out
+
+
+def tiles_needed(start: int, end: int) -> int:
+    if end <= start:
+        return 0
+    return (end - 1) // TILE - start // TILE + 1

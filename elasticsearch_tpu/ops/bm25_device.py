@@ -1,0 +1,247 @@
+"""Jitted BM25 query execution over tiled device postings.
+
+This replaces the reference's shard-local scoring hot loop —
+`ContextIndexSearcher.searchLeaf` → `weight.bulkScorer(ctx)` →
+`bulkScorer.score(leafCollector, liveDocs)` (server/src/main/java/org/
+elasticsearch/search/internal/ContextIndexSearcher.java:170-206) plus the
+top-k heap of `TopDocsCollectorContext` (search/query/
+TopDocsCollectorContext.java:68) — with one XLA program:
+
+    gather posting tiles → BM25 contributions → scatter-add dense scores
+    → combine boolean clause masks → masked `lax.top_k`
+
+Where Lucene iterates doc-at-a-time per segment per term with a heap, the
+TPU scores *all* postings of *all* query terms at once: the [T, MT, TILE]
+gather feeds the VPU elementwise BM25 expression and a dense scatter; top-k
+is a single `lax.top_k` whose tie-break (lower index wins) matches Lucene's
+TopScoreDocCollector doc-id tie-break exactly.
+
+A query is compiled (host side, see query/compile.py) into:
+- a hashable static `spec` (nested tuples describing the operator tree);
+- a pytree of per-node `arrays` (tile ids, spans, fp32 term weights, the
+  256-entry norm-inverse cache — exactly Lucene's per-query cache).
+`execute` is jitted with the spec static, so queries with the same shape
+bucket share one compilation.
+
+Scoring math is bit-identical to ops/bm25.py (the Lucene-parity oracle):
+fp32 `w - w / (1 + tf * cache[normByte])` with host-precomputed fp32 `w`.
+
+Boolean semantics follow the reference's BooleanQuery:
+- must/should contribute scores; filter/must_not never do;
+- a bool with no must/filter requires ≥1 should (minimum_should_match
+  default), otherwise shoulds are optional;
+- constant-score leaves (range, exists, match_all) score `boost` per hit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..index.tiles import TILE
+
+NEG_INF = float("-inf")
+
+# ---------------------------------------------------------------------------
+# Plan representation
+#
+# spec (static, hashable):
+#   ("terms", field_name, T, MT)          — weighted term disjunction
+#   ("range", field_name)                 — numeric range (bounds in arrays)
+#   ("match_all",)                        — every live doc, constant score
+#   ("match_none",)                       — no doc
+#   ("bool", (must...), (should...), (filter...), (must_not...), msm)
+#       msm: minimum_should_match (int; -1 = default rule)
+#
+# arrays (pytree), by node type:
+#   terms:     {"tile_ids": i32[T, MT], "starts": i32[T], "ends": i32[T],
+#               "weights": f32[T], "cache": f32[256]}
+#   range:     {"lo": f32[], "hi": f32[], "boost": f32[]}  (NaN-safe)
+#   match_all: {"boost": f32[]}
+#   match_none: {}
+#   bool:      {"boost": f32[], "children": (child arrays in
+#               must+should+filter+must_not order)}
+# ---------------------------------------------------------------------------
+
+
+def _eval_node(spec, arrays, seg: dict[str, Any], num_docs: int):
+    """Returns (scores f32[num_docs], matched bool[num_docs])."""
+    kind = spec[0]
+    if kind == "terms":
+        return _eval_terms(spec, arrays, seg, num_docs)
+    if kind == "terms_const":
+        matched = _terms_matched(spec, arrays, seg, num_docs)
+        scores = jnp.where(matched, arrays["boost"], jnp.float32(0.0))
+        return scores, matched
+    if kind == "const":
+        _, child_spec = spec
+        _, matched = _eval_node(child_spec, arrays["child"], seg, num_docs)
+        scores = jnp.where(matched, arrays["boost"], jnp.float32(0.0))
+        return scores, matched
+    if kind == "exists":
+        _, field_name, field_kind = spec
+        if field_kind == "inverted":
+            matched = seg["fields"][field_name][3]  # presence bitmap
+        else:
+            matched = ~jnp.isnan(seg["doc_values"][field_name])
+        scores = jnp.where(matched, arrays["boost"], jnp.float32(0.0))
+        return scores, matched
+    if kind == "range":
+        return _eval_range(spec, arrays, seg, num_docs)
+    if kind == "match_all":
+        matched = jnp.ones(num_docs, dtype=bool)
+        scores = jnp.full(num_docs, arrays["boost"], dtype=jnp.float32)
+        return scores, matched
+    if kind == "match_none":
+        return (
+            jnp.zeros(num_docs, dtype=jnp.float32),
+            jnp.zeros(num_docs, dtype=bool),
+        )
+    if kind == "bool":
+        return _eval_bool(spec, arrays, seg, num_docs)
+    raise ValueError(f"unknown plan node kind [{kind}]")
+
+
+def _gather_tiles(spec, arrays, seg):
+    """Shared tile gather: (docs, tfs, valid, idx) each [T, MT, S]."""
+    field_name = spec[1]
+    doc_tiles, tf_tiles, norm_bytes, _present = seg["fields"][field_name]
+    tile_ids = arrays["tile_ids"]  # i32[T, MT]
+    starts = arrays["starts"]  # i32[T]
+    ends = arrays["ends"]  # i32[T]
+    docs = doc_tiles[tile_ids]  # i32[T, MT, S]
+    tfs = tf_tiles[tile_ids]  # f32[T, MT, S]
+    pos = tile_ids[..., None] * TILE + jnp.arange(TILE, dtype=jnp.int32)
+    valid = (pos >= starts[:, None, None]) & (pos < ends[:, None, None])
+    return docs, tfs, valid, norm_bytes
+
+
+def _eval_terms(spec, arrays, seg, num_docs):
+    docs, tfs, valid, norm_bytes = _gather_tiles(spec, arrays, seg)
+    weights = arrays["weights"]  # f32[T]
+    cache = arrays["cache"]  # f32[256]
+
+    ninv = cache[norm_bytes[docs]]  # f32[T, MT, S]
+    w = weights[:, None, None]
+    one = jnp.float32(1.0)
+    contrib = w - w / (one + tfs * ninv)
+
+    idx = jnp.where(valid, docs, num_docs)  # sentinel slot = num_docs
+    scores = (
+        jnp.zeros(num_docs + 1, dtype=jnp.float32)
+        .at[idx]
+        .add(jnp.where(valid, contrib, jnp.float32(0.0)))[:num_docs]
+    )
+    matched = (
+        jnp.zeros(num_docs + 1, dtype=bool).at[idx].max(valid)[:num_docs]
+    )
+    return scores, matched
+
+
+def _terms_matched(spec, arrays, seg, num_docs):
+    docs, _tfs, valid, _norm = _gather_tiles(spec, arrays, seg)
+    idx = jnp.where(valid, docs, num_docs)
+    return jnp.zeros(num_docs + 1, dtype=bool).at[idx].max(valid)[:num_docs]
+
+
+def _eval_range(spec, arrays, seg, num_docs):
+    _, field_name = spec
+    col = seg["doc_values"][field_name]  # f32[N], NaN = missing
+    matched = (col >= arrays["lo"]) & (col <= arrays["hi"])  # NaN compares False
+    scores = jnp.where(matched, arrays["boost"], jnp.float32(0.0))
+    return scores, matched
+
+
+def _eval_bool(spec, arrays, seg, num_docs):
+    _, must_s, should_s, filter_s, must_not_s, msm = spec
+    children = arrays["children"]
+    i = 0
+    must, should, filt, must_not = [], [], [], []
+    for group, out in (
+        (must_s, must),
+        (should_s, should),
+        (filter_s, filt),
+        (must_not_s, must_not),
+    ):
+        for child_spec in group:
+            out.append(_eval_node(child_spec, children[i], seg, num_docs))
+            i += 1
+
+    matched = jnp.ones(num_docs, dtype=bool)
+    for _, m in must:
+        matched &= m
+    for _, m in filt:
+        matched &= m
+    for _, m in must_not:
+        matched &= ~m
+
+    effective_msm = msm
+    if effective_msm < 0:  # default: 1 iff no must and no filter clauses
+        effective_msm = 1 if (not must_s and not filter_s) else 0
+    if should:
+        if effective_msm == 1:
+            any_should = jnp.zeros(num_docs, dtype=bool)
+            for _, m in should:
+                any_should |= m
+            matched &= any_should
+        elif effective_msm > 1:
+            n_should = jnp.zeros(num_docs, dtype=jnp.int32)
+            for _, m in should:
+                n_should += m.astype(jnp.int32)
+            matched &= n_should >= effective_msm
+
+    score = jnp.zeros(num_docs, dtype=jnp.float32)
+    for s, _ in must:
+        score = score + s
+    for s, _ in should:
+        score = score + s
+    score = jnp.where(matched, score * arrays["boost"], jnp.float32(0.0))
+    return score, matched
+
+
+@partial(jax.jit, static_argnames=("spec", "k"))
+def execute(seg, spec, arrays, k: int):
+    """Run a compiled query plan over one device segment.
+
+    seg: {"fields": {name: (doc_ids i32[NT,S], tfs f32[NT,S],
+                            norm_bytes u8[N+1])},
+          "doc_values": {name: f32[N]}, "live": bool[N]}
+
+    Returns (top_scores f32[k], top_ids i32[k], total_hits i32[]).
+    Slots past total hits carry score -inf (host trims them).
+    """
+    live = seg["live"]
+    num_docs = live.shape[0]
+    scores, matched = _eval_node(spec, arrays, seg, num_docs)
+    eligible = matched & live
+    masked = jnp.where(eligible, scores, jnp.float32(NEG_INF))
+    kk = min(k, num_docs)
+    top_scores, top_ids = jax.lax.top_k(masked, kk)
+    total = jnp.sum(eligible, dtype=jnp.int32)
+    return top_scores, top_ids.astype(jnp.int32), total
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def execute_dense(seg, spec, arrays):
+    """Dense (scores, matched) over all docs — for rescoring/aggregations."""
+    live = seg["live"]
+    num_docs = live.shape[0]
+    scores, matched = _eval_node(spec, arrays, seg, num_docs)
+    eligible = matched & live
+    return jnp.where(eligible, scores, jnp.float32(0.0)), eligible
+
+
+def segment_tree(device_segment) -> dict[str, Any]:
+    """Build the jit-input pytree view of a DeviceSegment."""
+    return {
+        "fields": {
+            name: (f.doc_ids, f.tfs, f.norm_bytes, f.present)
+            for name, f in device_segment.fields.items()
+        },
+        "doc_values": dict(device_segment.doc_values),
+        "live": device_segment.live,
+    }

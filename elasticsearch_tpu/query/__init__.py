@@ -1,0 +1,13 @@
+from .dsl import (  # noqa: F401
+    BoolQuery,
+    ConstantScoreQuery,
+    ExistsQuery,
+    MatchAllQuery,
+    MatchNoneQuery,
+    MatchQuery,
+    Query,
+    RangeQuery,
+    TermQuery,
+    TermsQuery,
+    parse_query,
+)

@@ -1,0 +1,312 @@
+"""Query compiler: DSL tree → static-shaped device plan.
+
+The analog of the reference's query rewrite + Weight creation
+(`IndexSearcher.createWeight` via ContextIndexSearcher, and query rewriting in
+TransportSearchAction / QueryBuilder.rewrite). Everything data-dependent and
+irregular happens HERE, on the host, at plan time:
+
+- analysis of match-query text (field's search analyzer);
+- term-dictionary lookups → contiguous posting spans → covering tile ids;
+- BM25 per-term weights in fp32 (exact Lucene rounding, via ops/bm25);
+- the per-(field, k1, b) 256-entry norm-inverse cache;
+- shape bucketing (term count and tile count padded to powers of two) so the
+  jitted kernel recompiles only per shape bucket, not per query.
+
+The output is (spec, arrays): `spec` is a hashable nested tuple (static arg
+to the jitted executor in ops/bm25_device.py), `arrays` a pytree of small
+numpy arrays — the only per-query host→device traffic.
+
+Global-IDF (DFS) support: pass `stats` overriding per-field/term statistics
+(the analog of the reference's DfsPhase → AggregatedDfs consumed at
+search/internal/ContextIndexSearcher.java:116); by default statistics are the
+segment-local ones, matching query_then_fetch semantics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any
+
+import numpy as np
+
+from ..index.mapping import Mappings, coerce_numeric
+from ..index.tiles import DeviceField, term_tile_ids, tiles_needed
+from ..ops.bm25 import BM25Params, norm_inverse_cache, term_weight
+from .dsl import (
+    BoolQuery,
+    ConstantScoreQuery,
+    ExistsQuery,
+    MatchAllQuery,
+    MatchNoneQuery,
+    MatchQuery,
+    Query,
+    RangeQuery,
+    TermQuery,
+    TermsQuery,
+)
+
+
+@dataclass
+class FieldStats:
+    """BM25 statistics for one field, possibly globally aggregated (DFS)."""
+
+    doc_count: int
+    avgdl: float
+    df: dict[str, int] = dc_field(default_factory=dict)  # per-term overrides
+
+
+@dataclass
+class CompiledQuery:
+    spec: tuple
+    arrays: Any  # pytree of numpy arrays, shape-matched to spec
+
+
+def _pow2(n: int, minimum: int = 1) -> int:
+    n = max(n, minimum)
+    return 1 << (n - 1).bit_length()
+
+
+def _f32_range_bounds(gte, gt, lte, lt) -> tuple[np.float32, np.float32]:
+    """Inclusive f32 [lo, hi] for a range over an f32-quantized column.
+
+    Stored-value semantics: doc values live on device as round-to-nearest
+    float32, so inclusive bounds quantize the same way (a doc whose value
+    equals the bound quantizes to the same f32 and matches). Open bounds
+    exclude the quantized endpoint via one-ulp nextafter. Monotonicity of
+    the quantizer keeps order semantics; only within-ulp collisions are
+    ambiguous, which is inherent to f32 storage.
+    """
+    lo = np.float32(-np.inf)
+    hi = np.float32(np.inf)
+    if gte is not None:
+        lo = np.float32(gte)
+    if gt is not None:
+        lo = max(lo, np.nextafter(np.float32(gt), np.float32(np.inf)))
+    if lte is not None:
+        hi = np.float32(lte)
+    if lt is not None:
+        hi = min(hi, np.nextafter(np.float32(lt), np.float32(-np.inf)))
+    return np.float32(lo), np.float32(hi)
+
+
+def _terms_arrays(
+    dfield: DeviceField,
+    terms: list[str],
+    boost: float,
+    params: BM25Params,
+    stats: FieldStats | None,
+    scored: bool,
+) -> tuple[tuple, dict]:
+    doc_count = stats.doc_count if stats else dfield.doc_count
+    avgdl = stats.avgdl if stats else dfield.avgdl
+    t_pad = _pow2(len(terms))
+    spans = [dfield.term_span(t) for t in terms]
+    mt = _pow2(max((tiles_needed(s, e) for s, e in spans), default=1))
+
+    tile_ids = np.full((t_pad, mt), dfield.pad_tile, dtype=np.int32)
+    starts = np.zeros(t_pad, dtype=np.int32)
+    ends = np.zeros(t_pad, dtype=np.int32)
+    weights = np.zeros(t_pad, dtype=np.float32)
+    for i, (term, (s, e)) in enumerate(zip(terms, spans)):
+        tile_ids[i] = term_tile_ids(s, e, mt, dfield.pad_tile)
+        starts[i] = s
+        ends[i] = e
+        if scored:
+            df = (
+                stats.df.get(term, dfield.term_df(term))
+                if stats
+                else dfield.term_df(term)
+            )
+            if df > 0 and doc_count > 0:
+                weights[i] = term_weight(df, doc_count, boost, params)
+
+    spec = ("terms" if scored else "terms_const", dfield.name, t_pad, mt)
+    arrays = {"tile_ids": tile_ids, "starts": starts, "ends": ends}
+    if scored:
+        cache = norm_inverse_cache(avgdl if doc_count else 1.0, params)
+        if not dfield.has_norms:
+            # Norms-disabled fields (keyword) score every doc with norm byte 1
+            # (LeafSimScorer substitutes norm 1 when norms are absent).
+            cache = np.full(256, cache[1], dtype=np.float32)
+        arrays["weights"] = weights
+        arrays["cache"] = cache
+    else:
+        arrays["boost"] = np.float32(boost)
+    return spec, arrays
+
+
+class Compiler:
+    """Compiles Query trees against one segment's fields and statistics."""
+
+    def __init__(
+        self,
+        fields: dict[str, DeviceField],
+        doc_values: dict[str, Any],
+        mappings: Mappings,
+        params: BM25Params = BM25Params(),
+        stats: dict[str, FieldStats] | None = None,
+    ):
+        self.fields = fields
+        self.doc_values = doc_values
+        self.mappings = mappings
+        self.params = params
+        self.stats = stats or {}
+
+    def compile(self, query: Query) -> CompiledQuery:
+        spec, arrays = self._node(query, scoring=True)
+        return CompiledQuery(spec=spec, arrays=arrays)
+
+    # -- node lowering ------------------------------------------------------
+    # `scoring=False` is filter context (Lucene needsScores=false): term
+    # nodes skip BM25 weights/norm-cache work and compile to matched-only
+    # gathers, exactly like the reference's filter/must_not clauses.
+
+    def _node(self, q: Query, scoring: bool) -> tuple[tuple, Any]:
+        if isinstance(q, MatchQuery):
+            return self._match(q, scoring)
+        if isinstance(q, TermQuery):
+            return self._term(q, scoring)
+        if isinstance(q, TermsQuery):
+            return self._terms(q)
+        if isinstance(q, RangeQuery):
+            return self._range(q)
+        if isinstance(q, ExistsQuery):
+            return self._exists(q)
+        if isinstance(q, MatchAllQuery):
+            return ("match_all",), {"boost": np.float32(q.boost)}
+        if isinstance(q, MatchNoneQuery):
+            return ("match_none",), {}
+        if isinstance(q, ConstantScoreQuery):
+            child_spec, child_arrays = self._node(q.filter, scoring=False)
+            return ("const", child_spec), {
+                "boost": np.float32(q.boost),
+                "child": child_arrays,
+            }
+        if isinstance(q, BoolQuery):
+            return self._bool(q, scoring)
+        raise ValueError(f"cannot compile query type {type(q).__name__}")
+
+    def _field_or_none(self, name: str) -> DeviceField | None:
+        return self.fields.get(name)
+
+    def _match(self, q: MatchQuery, scoring: bool) -> tuple[tuple, Any]:
+        dfield = self._field_or_none(q.field_name)
+        if dfield is None:
+            return ("match_none",), {}
+        if q.analyzer:
+            analyzer = self.mappings.analysis.get(q.analyzer)
+        else:
+            analyzer = self.mappings.analyzer_for(q.field_name, search=True)
+        terms = analyzer.analyze(q.query)
+        if not terms:
+            return ("match_none",), {}
+        stats = self.stats.get(q.field_name)
+        if q.operator == "and" and len(terms) > 1:
+            children = [
+                self._terms_spec(dfield, [t], q.boost, stats, scoring)
+                for t in terms
+            ]
+            return self._bool_from_parts(must=children, boost=1.0)
+        if q.minimum_should_match > 1 and len(terms) > 1:
+            children = [
+                self._terms_spec(dfield, [t], q.boost, stats, scoring)
+                for t in terms
+            ]
+            return self._bool_from_parts(
+                should=children, msm=q.minimum_should_match, boost=1.0
+            )
+        return self._terms_spec(dfield, terms, q.boost, stats, scoring)
+
+    def _terms_spec(self, dfield, terms, boost, stats, scored=True):
+        return _terms_arrays(dfield, terms, boost, self.params, stats, scored)
+
+    def _term(self, q: TermQuery, scoring: bool = True) -> tuple[tuple, Any]:
+        fm = self.mappings.get(q.field_name)
+        if fm is not None and fm.is_numeric:
+            # Numeric term query = point range [v, v], constant score.
+            v = coerce_numeric(fm.type, q.value)
+            return self._range(RangeQuery(q.field_name, gte=v, lte=v, boost=q.boost))
+        dfield = self._field_or_none(q.field_name)
+        if dfield is None:
+            return ("match_none",), {}
+        stats = self.stats.get(q.field_name)
+        return self._terms_spec(dfield, [str(q.value)], q.boost, stats, scoring)
+
+    def _terms(self, q: TermsQuery) -> tuple[tuple, Any]:
+        # ES `terms` is constant-score (Lucene TermInSetQuery): boost per hit.
+        if not q.values:
+            return ("match_none",), {}
+        fm = self.mappings.get(q.field_name)
+        if fm is not None and fm.is_numeric:
+            # Disjunction of point ranges; one constant boost per doc.
+            children = [
+                self._range(
+                    RangeQuery(
+                        q.field_name,
+                        gte=coerce_numeric(fm.type, v),
+                        lte=coerce_numeric(fm.type, v),
+                    )
+                )
+                for v in q.values
+            ]
+            inner_spec, inner_arrays = self._assemble_bool(
+                [[], children, [], []], msm=-1, boost=1.0
+            )
+            return ("const", inner_spec), {
+                "boost": np.float32(q.boost),
+                "child": inner_arrays,
+            }
+        dfield = self._field_or_none(q.field_name)
+        if dfield is None:
+            return ("match_none",), {}
+        stats = self.stats.get(q.field_name)
+        terms = [str(v) for v in q.values]
+        return self._terms_spec(dfield, terms, q.boost, stats, scored=False)
+
+    def _range(self, q: RangeQuery) -> tuple[tuple, Any]:
+        if q.field_name not in self.doc_values:
+            return ("match_none",), {}
+        fm = self.mappings.get(q.field_name)
+        ftype = fm.type if fm is not None else "double"
+        bounds = [
+            None if b is None else coerce_numeric(ftype, b)
+            for b in (q.gte, q.gt, q.lte, q.lt)
+        ]
+        lo, hi = _f32_range_bounds(*bounds)
+        return ("range", q.field_name), {
+            "lo": lo,
+            "hi": hi,
+            "boost": np.float32(q.boost),
+        }
+
+    def _exists(self, q: ExistsQuery) -> tuple[tuple, Any]:
+        if q.field_name in self.fields:
+            return ("exists", q.field_name, "inverted"), {
+                "boost": np.float32(q.boost)
+            }
+        if q.field_name in self.doc_values:
+            return ("exists", q.field_name, "numeric"), {
+                "boost": np.float32(q.boost)
+            }
+        return ("match_none",), {}
+
+    def _bool(self, q: BoolQuery, scoring: bool) -> tuple[tuple, Any]:
+        groups = [
+            [self._node(c, scoring) for c in q.must],
+            [self._node(c, scoring) for c in q.should],
+            [self._node(c, scoring=False) for c in q.filter],
+            [self._node(c, scoring=False) for c in q.must_not],
+        ]
+        return self._assemble_bool(groups, q.minimum_should_match, q.boost)
+
+    def _bool_from_parts(self, must=(), should=(), msm=-1, boost=1.0):
+        groups = [list(must), list(should), [], []]
+        return self._assemble_bool(groups, msm, boost)
+
+    @staticmethod
+    def _assemble_bool(groups, msm, boost):
+        specs = tuple(tuple(s for s, _ in g) for g in groups)
+        children = tuple(a for g in groups for _, a in g)
+        spec = ("bool", *specs, int(msm))
+        arrays = {"boost": np.float32(boost), "children": children}
+        return spec, arrays

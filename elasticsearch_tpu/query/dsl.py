@@ -1,0 +1,204 @@
+"""Query DSL: typed query builders + JSON parsing.
+
+The analog of the reference's query builder layer (server/src/main/java/org/
+elasticsearch/index/query/ — 74 files: BoolQueryBuilder, MatchQueryBuilder,
+TermQueryBuilder, RangeQueryBuilder…) and its x-content parsing. Each class
+mirrors the JSON shape of the corresponding Elasticsearch query; `parse_query`
+accepts the standard `{"match": {...}}` / `{"bool": {...}}` request bodies.
+
+Queries are pure host-side descriptions; query/compile.py lowers them against
+a segment's statistics into the static-shaped device plan executed by
+ops/bm25_device.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class Query:
+    """Base class for all query builders."""
+
+    boost: float = 1.0
+
+
+@dataclass
+class MatchQuery(Query):
+    """Full-text match: analyzed terms, OR'd (or AND'd) together.
+
+    Mirrors MatchQueryBuilder (index/query/MatchQueryBuilder.java): text is
+    run through the field's search analyzer; `operator` controls whether all
+    terms must match; `minimum_should_match` applies in OR mode.
+    """
+
+    field_name: str
+    query: str
+    operator: str = "or"  # "or" | "and"
+    minimum_should_match: int = 0  # 0 = default for the operator
+    analyzer: str | None = None
+    boost: float = 1.0
+
+
+@dataclass
+class TermQuery(Query):
+    """Exact (un-analyzed) term match; BM25-scored like Lucene TermQuery."""
+
+    field_name: str
+    value: Any
+    boost: float = 1.0
+
+
+@dataclass
+class TermsQuery(Query):
+    """Disjunction of exact terms (constant-score in ES; here BM25 parity:
+    ES TermsQuery scores constant 1.0 per matching doc)."""
+
+    field_name: str
+    values: list[Any]
+    boost: float = 1.0
+
+
+@dataclass
+class RangeQuery(Query):
+    """Numeric/date range over doc values. Constant score (boost) per hit,
+    matching Lucene's IndexOrDocValuesQuery behavior under ES scoring."""
+
+    field_name: str
+    gte: float | None = None
+    gt: float | None = None
+    lte: float | None = None
+    lt: float | None = None
+    boost: float = 1.0
+
+
+@dataclass
+class ExistsQuery(Query):
+    """Docs that have any value for the field (constant score)."""
+
+    field_name: str
+    boost: float = 1.0
+
+
+@dataclass
+class MatchAllQuery(Query):
+    boost: float = 1.0
+
+
+@dataclass
+class MatchNoneQuery(Query):
+    boost: float = 1.0
+
+
+@dataclass
+class ConstantScoreQuery(Query):
+    """Wraps a filter; every matching doc scores exactly `boost`."""
+
+    filter: Query = None  # type: ignore[assignment]
+    boost: float = 1.0
+
+
+@dataclass
+class BoolQuery(Query):
+    """Boolean combination, mirroring BoolQueryBuilder semantics:
+
+    - must: contribute to score, all required;
+    - filter: required, never scored;
+    - should: optional unless no must/filter (then >=1 required by default),
+      controlled by minimum_should_match;
+    - must_not: excluded, never scored.
+    """
+
+    must: list[Query] = field(default_factory=list)
+    should: list[Query] = field(default_factory=list)
+    filter: list[Query] = field(default_factory=list)
+    must_not: list[Query] = field(default_factory=list)
+    minimum_should_match: int = -1  # -1 = ES default rule
+    boost: float = 1.0
+
+
+def _pop_boost(body: dict) -> float:
+    return float(body.get("boost", 1.0))
+
+
+def parse_query(body: dict[str, Any]) -> Query:
+    """Parse an Elasticsearch-style query JSON body into a Query tree.
+
+    Accepts the same shapes the reference's SearchSourceBuilder does for the
+    supported query types; raises ValueError on unknown queries (matching
+    the reference's parsing_exception behavior).
+    """
+    if not isinstance(body, dict) or len(body) != 1:
+        raise ValueError(
+            "query body must be an object with exactly one query clause, "
+            f"got: {body!r}"
+        )
+    kind, spec = next(iter(body.items()))
+
+    if kind == "match_all":
+        return MatchAllQuery(boost=_pop_boost(spec or {}))
+    if kind == "match_none":
+        return MatchNoneQuery()
+    if kind == "match":
+        fname, val = _single_field(kind, spec)
+        if isinstance(val, dict):
+            return MatchQuery(
+                field_name=fname,
+                query=str(val["query"]),
+                operator=str(val.get("operator", "or")).lower(),
+                minimum_should_match=int(val.get("minimum_should_match", 0)),
+                analyzer=val.get("analyzer"),
+                boost=_pop_boost(val),
+            )
+        return MatchQuery(field_name=fname, query=str(val))
+    if kind == "term":
+        fname, val = _single_field(kind, spec)
+        if isinstance(val, dict):
+            return TermQuery(fname, val["value"], boost=_pop_boost(val))
+        return TermQuery(fname, val)
+    if kind == "terms":
+        spec = dict(spec)
+        boost = _pop_boost(spec)
+        spec.pop("boost", None)
+        if len(spec) != 1:
+            raise ValueError(f"[terms] expects exactly one field, got {spec}")
+        fname, values = next(iter(spec.items()))
+        return TermsQuery(fname, list(values), boost=boost)
+    if kind == "range":
+        fname, val = _single_field(kind, spec)
+        return RangeQuery(
+            field_name=fname,
+            gte=val.get("gte"),
+            gt=val.get("gt"),
+            lte=val.get("lte"),
+            lt=val.get("lt"),
+            boost=_pop_boost(val),
+        )
+    if kind == "exists":
+        return ExistsQuery(spec["field"], boost=_pop_boost(spec))
+    if kind == "constant_score":
+        return ConstantScoreQuery(
+            filter=parse_query(spec["filter"]), boost=_pop_boost(spec)
+        )
+    if kind == "bool":
+        def _clauses(key: str) -> list[Query]:
+            raw = spec.get(key, [])
+            if isinstance(raw, dict):
+                raw = [raw]
+            return [parse_query(c) for c in raw]
+
+        return BoolQuery(
+            must=_clauses("must"),
+            should=_clauses("should"),
+            filter=_clauses("filter"),
+            must_not=_clauses("must_not"),
+            minimum_should_match=int(spec.get("minimum_should_match", -1)),
+            boost=_pop_boost(spec),
+        )
+    raise ValueError(f"unknown query type [{kind}]")
+
+
+def _single_field(kind: str, spec: dict) -> tuple[str, Any]:
+    if not isinstance(spec, dict) or len(spec) != 1:
+        raise ValueError(f"[{kind}] expects exactly one field, got: {spec!r}")
+    return next(iter(spec.items()))

@@ -1,0 +1,223 @@
+"""CPU oracle: execute the query DSL directly over a host Segment.
+
+This is the correctness reference for the device path — an independent
+numpy interpreter of the same Elasticsearch query semantics (BooleanQuery
+combination rules, constant-score filters, BM25 term scoring via
+ops/bm25.py's Lucene-parity math). It deliberately shares NO code with the
+query compiler or the device executor: parity tests run both and require
+identical top-k (score + doc id + tie order).
+
+Mirrors the CPU path being benchmarked against in BASELINE.md: Lucene's
+`ContextIndexSearcher.searchLeaf` scoring plus `TopScoreDocCollector`
+(reference server/src/main/java/org/elasticsearch/search/internal/
+ContextIndexSearcher.java:170-206).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..index.mapping import Mappings
+from ..index.segment import Segment
+from ..index.mapping import coerce_numeric
+from ..ops.bm25 import (
+    BM25Params,
+    score_terms_dense,
+    top_k as bm25_top_k,
+)
+from ..query.dsl import (
+    BoolQuery,
+    ConstantScoreQuery,
+    ExistsQuery,
+    MatchAllQuery,
+    MatchNoneQuery,
+    MatchQuery,
+    Query,
+    RangeQuery,
+    TermQuery,
+    TermsQuery,
+)
+
+
+class OracleSearcher:
+    def __init__(
+        self,
+        segment: Segment,
+        mappings: Mappings,
+        params: BM25Params = BM25Params(),
+    ):
+        self.segment = segment
+        self.mappings = mappings
+        self.params = params
+
+    # Each _eval returns (scores f32[N], matched bool[N]).
+
+    def search(self, query: Query, k: int = 10):
+        """(top_scores, top_doc_ids, total_hits) with Lucene tie-breaking."""
+        scores, matched = self._eval(query)
+        top_scores, top_ids = bm25_top_k(scores, k, matched)
+        return top_scores, top_ids, int(np.count_nonzero(matched))
+
+    def _eval(self, q: Query) -> tuple[np.ndarray, np.ndarray]:
+        n = self.segment.num_docs
+        if isinstance(q, MatchAllQuery):
+            return (
+                np.full(n, np.float32(q.boost), dtype=np.float32),
+                np.ones(n, dtype=bool),
+            )
+        if isinstance(q, MatchNoneQuery):
+            return np.zeros(n, np.float32), np.zeros(n, bool)
+        if isinstance(q, MatchQuery):
+            return self._match(q)
+        if isinstance(q, TermQuery):
+            fm = self.mappings.get(q.field_name)
+            if fm is not None and fm.is_numeric:
+                v = coerce_numeric(fm.type, q.value)
+                return self._eval(RangeQuery(q.field_name, gte=v, lte=v, boost=q.boost))
+            return self._score_terms(q.field_name, [str(q.value)], q.boost)
+        if isinstance(q, TermsQuery):
+            fm = self.mappings.get(q.field_name)
+            if fm is not None and fm.is_numeric:
+                matched = np.zeros(n, dtype=bool)
+                for v in q.values:
+                    fv = coerce_numeric(fm.type, v)
+                    _, m = self._eval(RangeQuery(q.field_name, gte=fv, lte=fv))
+                    matched |= m
+            else:
+                _, matched = self._score_terms(
+                    q.field_name, [str(v) for v in q.values], 1.0
+                )
+            return (
+                np.where(matched, np.float32(q.boost), np.float32(0.0)),
+                matched,
+            )
+        if isinstance(q, RangeQuery):
+            return self._range(q)
+        if isinstance(q, ExistsQuery):
+            return self._exists(q)
+        if isinstance(q, ConstantScoreQuery):
+            _, matched = self._eval(q.filter)
+            return (
+                np.where(matched, np.float32(q.boost), np.float32(0.0)),
+                matched,
+            )
+        if isinstance(q, BoolQuery):
+            return self._bool(q)
+        raise ValueError(f"oracle cannot evaluate {type(q).__name__}")
+
+    def _match(self, q: MatchQuery):
+        if q.analyzer:
+            analyzer = self.mappings.analysis.get(q.analyzer)
+        else:
+            analyzer = self.mappings.analyzer_for(q.field_name, search=True)
+        terms = analyzer.analyze(q.query)
+        n = self.segment.num_docs
+        if not terms or q.field_name not in self.segment.fields:
+            return np.zeros(n, np.float32), np.zeros(n, bool)
+        if q.operator == "and" and len(terms) > 1:
+            return self._bool(
+                BoolQuery(must=[TermQuery(q.field_name, t, boost=q.boost) for t in terms])
+            )
+        if q.minimum_should_match > 1 and len(terms) > 1:
+            return self._bool(
+                BoolQuery(
+                    should=[TermQuery(q.field_name, t, boost=q.boost) for t in terms],
+                    minimum_should_match=q.minimum_should_match,
+                )
+            )
+        return self._score_terms(q.field_name, terms, q.boost)
+
+    def _score_terms(self, field_name: str, terms: list[str], boost: float):
+        n = self.segment.num_docs
+        matched = np.zeros(n, dtype=bool)
+        fld = self.segment.fields.get(field_name)
+        if fld is None or fld.doc_count == 0:
+            return np.zeros(n, dtype=np.float32), matched
+        scores = score_terms_dense(fld, terms, n, boost, self.params, matched)
+        return scores, matched
+
+    def _range(self, q: RangeQuery):
+        """Framework contract (round 1): numeric doc values are stored as
+        round-to-nearest float32 on device, so the oracle compares the
+        f32-quantized column under stored-value semantics — inclusive bounds
+        quantize round-to-nearest too, open bounds step one f32 ulp past the
+        quantized endpoint. (Independent implementation; the compiler has its
+        own copy of this logic so shared bugs can't hide from parity tests.)
+        Exact int64/date columns are a planned upgrade (paired-int32)."""
+        n = self.segment.num_docs
+        col = self.segment.doc_values.get(q.field_name)
+        if col is None:
+            return np.zeros(n, np.float32), np.zeros(n, bool)
+        fm = self.mappings.get(q.field_name)
+        ftype = fm.type if fm is not None else "double"
+        f32 = np.float32
+        lo, hi = f32(-np.inf), f32(np.inf)
+        if q.gte is not None:
+            lo = f32(coerce_numeric(ftype, q.gte))
+        if q.gt is not None:
+            stepped_up = np.nextafter(f32(coerce_numeric(ftype, q.gt)), f32(np.inf))
+            lo = lo if lo > stepped_up else stepped_up
+        if q.lte is not None:
+            hi = f32(coerce_numeric(ftype, q.lte))
+        if q.lt is not None:
+            stepped_down = np.nextafter(f32(coerce_numeric(ftype, q.lt)), f32(-np.inf))
+            hi = hi if hi < stepped_down else stepped_down
+        col32 = col.astype(np.float32)
+        with np.errstate(invalid="ignore"):
+            matched = (col32 >= lo) & (col32 <= hi)
+        return np.where(matched, np.float32(q.boost), np.float32(0.0)), matched
+
+    def _exists(self, q: ExistsQuery):
+        n = self.segment.num_docs
+        fld = self.segment.fields.get(q.field_name)
+        if fld is not None:
+            # Field presence, not token presence: a value that analyzed to
+            # zero tokens (all stopwords, empty keyword) still exists.
+            matched = (
+                fld.present
+                if len(fld.present) == n
+                else fld.norm_bytes > 0
+            )
+            return np.where(matched, np.float32(q.boost), np.float32(0.0)), matched
+        col = self.segment.doc_values.get(q.field_name)
+        if col is not None:
+            matched = ~np.isnan(col)
+            return np.where(matched, np.float32(q.boost), np.float32(0.0)), matched
+        return np.zeros(n, np.float32), np.zeros(n, bool)
+
+    def _bool(self, q: BoolQuery):
+        n = self.segment.num_docs
+        must = [self._eval(c) for c in q.must]
+        should = [self._eval(c) for c in q.should]
+        filt = [self._eval(c) for c in q.filter]
+        must_not = [self._eval(c) for c in q.must_not]
+
+        matched = np.ones(n, dtype=bool)
+        for _, m in must:
+            matched &= m
+        for _, m in filt:
+            matched &= m
+        for _, m in must_not:
+            matched &= ~m
+
+        msm = q.minimum_should_match
+        if msm < 0:
+            msm = 1 if (not q.must and not q.filter) else 0
+        if should and msm == 1:
+            any_should = np.zeros(n, dtype=bool)
+            for _, m in should:
+                any_should |= m
+            matched &= any_should
+        elif should and msm > 1:
+            count = np.zeros(n, dtype=np.int32)
+            for _, m in should:
+                count += m.astype(np.int32)
+            matched &= count >= msm
+
+        score = np.zeros(n, dtype=np.float32)
+        for s, _ in must:
+            score = score + s
+        for s, _ in should:
+            score = score + s
+        score = np.where(matched, score * np.float32(q.boost), np.float32(0.0))
+        return score.astype(np.float32), matched

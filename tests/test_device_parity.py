@@ -1,0 +1,353 @@
+"""Device kernel ↔ CPU oracle parity.
+
+The acceptance gate from BASELINE.md: the jitted TPU query path must return
+*identical* top-k (doc ids, tie order) and fp32-equal scores versus the
+independent numpy oracle that replicates Lucene BM25 scoring.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.index.tiles import pack_segment
+from elasticsearch_tpu.ops import bm25_device
+from elasticsearch_tpu.query.compile import Compiler
+from elasticsearch_tpu.query.dsl import parse_query
+from elasticsearch_tpu.search.oracle import OracleSearcher
+
+VOCAB = [
+    "alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf",
+    "hotel", "india", "juliet", "kilo", "lima", "mike", "november",
+    "oscar", "papa", "quebec", "romeo", "sierra", "tango",
+]
+
+
+def build_corpus(rng, n_docs=500, seed_fields=True):
+    mappings = Mappings(
+        properties={
+            "title": {"type": "text"},
+            "body": {"type": "text"},
+            "tag": {"type": "keyword"},
+            "rank": {"type": "long"},
+        }
+    )
+    builder = SegmentBuilder(mappings)
+    for i in range(n_docs):
+        n_title = rng.integers(1, 8)
+        n_body = rng.integers(5, 60)
+        doc = {
+            "title": " ".join(rng.choice(VOCAB, n_title)),
+            "body": " ".join(rng.choice(VOCAB, n_body)),
+            "tag": str(rng.choice(["red", "green", "blue", "cyan"])),
+            "rank": int(rng.integers(0, 1000)),
+        }
+        if not seed_fields and rng.random() < 0.1:
+            del doc["rank"]  # exercise missing doc values
+        builder.add(doc)
+    segment = builder.build()
+    return mappings, segment
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(7)
+    mappings, segment = build_corpus(rng, 500, seed_fields=False)
+    dev = pack_segment(segment)
+    seg_tree = bm25_device.segment_tree(dev)
+    compiler = Compiler(dev.fields, dev.doc_values, mappings)
+    oracle = OracleSearcher(segment, mappings)
+    return mappings, segment, dev, seg_tree, compiler, oracle
+
+
+def run_both(corpus, query_json, k=10):
+    _, _, _, seg_tree, compiler, oracle = corpus
+    query = parse_query(query_json)
+    compiled = compiler.compile(query)
+    d_scores, d_ids, d_total = bm25_device.execute(
+        seg_tree, compiled.spec, compiled.arrays, k
+    )
+    d_scores = np.asarray(d_scores)
+    d_ids = np.asarray(d_ids)
+    d_total = int(d_total)
+    # Trim device padding: slots beyond total hits carry -inf.
+    n_valid = min(k, d_total)
+    d_scores, d_ids = d_scores[:n_valid], d_ids[:n_valid]
+    assert not np.isinf(d_scores).any()
+
+    o_scores, o_ids, o_total = oracle.search(query, k)
+    return (d_scores, d_ids, d_total), (o_scores, o_ids, o_total)
+
+
+def assert_parity(corpus, query_json, k=10):
+    (d_scores, d_ids, d_total), (o_scores, o_ids, o_total) = run_both(
+        corpus, query_json, k
+    )
+    assert d_total == o_total, f"total hits: device {d_total} != oracle {o_total}"
+    np.testing.assert_array_equal(d_ids, o_ids)
+    np.testing.assert_allclose(d_scores, o_scores, rtol=1e-6, atol=1e-6)
+
+
+def test_single_term_match(corpus):
+    assert_parity(corpus, {"match": {"title": "alpha"}})
+
+
+def test_multi_term_disjunction(corpus):
+    assert_parity(corpus, {"match": {"body": "alpha bravo charlie delta"}})
+
+
+def test_match_operator_and(corpus):
+    assert_parity(
+        corpus, {"match": {"body": {"query": "alpha bravo", "operator": "and"}}}
+    )
+
+
+def test_match_minimum_should_match(corpus):
+    assert_parity(
+        corpus,
+        {"match": {"body": {"query": "alpha bravo charlie", "minimum_should_match": 2}}},
+    )
+
+
+def test_term_on_keyword_no_norms(corpus):
+    assert_parity(corpus, {"term": {"tag": "red"}})
+
+
+def test_terms_constant_score(corpus):
+    assert_parity(corpus, {"terms": {"tag": ["red", "blue"], "boost": 2.5}})
+
+
+def test_term_numeric_becomes_range(corpus):
+    _, segment, *_ = corpus
+    v = int([s for s in segment.sources if "rank" in s][0]["rank"])
+    assert_parity(corpus, {"term": {"rank": v}})
+
+
+def test_range_query(corpus):
+    assert_parity(corpus, {"range": {"rank": {"gte": 100, "lt": 600}}})
+
+
+def _mini_numeric_corpus():
+    mappings = Mappings(
+        properties={
+            "price": {"type": "double"},
+            "flag": {"type": "boolean"},
+            "n": {"type": "long"},
+        }
+    )
+    builder = SegmentBuilder(mappings)
+    builder.add({"price": 0.1, "flag": True, "n": 16777217})
+    builder.add({"price": 0.2, "flag": False, "n": 5})
+    builder.add({"price": 0.3, "flag": True, "n": 7})
+    segment = builder.build()
+    dev = pack_segment(segment)
+    seg_tree = bm25_device.segment_tree(dev)
+    compiler = Compiler(dev.fields, dev.doc_values, mappings)
+    oracle = OracleSearcher(segment, mappings)
+    return seg_tree, compiler, oracle
+
+
+def _run_mini(seg_tree, compiler, oracle, query_json, k=10):
+    query = parse_query(query_json)
+    c = compiler.compile(query)
+    _, d_ids, d_total = bm25_device.execute(seg_tree, c.spec, c.arrays, k)
+    _, o_ids, o_total = oracle.search(query, k)
+    n = min(k, int(d_total))
+    assert int(d_total) == o_total, (query_json, int(d_total), o_total)
+    assert sorted(np.asarray(d_ids)[:n].tolist()) == sorted(o_ids.tolist())
+    return int(d_total), sorted(o_ids.tolist())
+
+
+def test_term_on_f32_unrepresentable_double():
+    """term on 0.1 (not f32-exact) must match under stored-value semantics."""
+    total, ids = _run_mini(*_mini_numeric_corpus(), {"term": {"price": 0.1}})
+    assert total == 1 and ids == [0]
+
+
+def test_range_lte_f32_unrepresentable_bound():
+    total, ids = _run_mini(
+        *_mini_numeric_corpus(), {"range": {"price": {"lte": 0.2}}}
+    )
+    assert total == 2 and ids == [0, 1]
+
+
+def test_term_long_beyond_f32_mantissa():
+    total, ids = _run_mini(*_mini_numeric_corpus(), {"term": {"n": 16777217}})
+    assert total == 1 and ids == [0]
+
+
+def test_terms_on_numeric_field():
+    total, ids = _run_mini(*_mini_numeric_corpus(), {"terms": {"n": [5, 7]}})
+    assert total == 2 and ids == [1, 2]
+
+
+def test_term_boolean_string_value():
+    total, ids = _run_mini(*_mini_numeric_corpus(), {"term": {"flag": "true"}})
+    assert total == 2 and ids == [0, 2]
+
+
+def test_exists_numeric(corpus):
+    assert_parity(corpus, {"exists": {"field": "rank"}})
+
+
+def test_exists_zero_token_value():
+    """A value analyzing to zero tokens (all stopwords) still exists."""
+    mappings = Mappings(
+        properties={"t": {"type": "text", "analyzer": "english"}}
+    )
+    builder = SegmentBuilder(mappings)
+    builder.add({"t": "the of and"})  # all stopwords -> 0 tokens
+    builder.add({"t": "fox jumps"})
+    builder.add({})  # no field at all
+    segment = builder.build()
+    dev = pack_segment(segment)
+    seg_tree = bm25_device.segment_tree(dev)
+    compiler = Compiler(dev.fields, dev.doc_values, mappings)
+    oracle = OracleSearcher(segment, mappings)
+    q = parse_query({"exists": {"field": "t"}})
+    c = compiler.compile(q)
+    _, d_ids, d_total = bm25_device.execute(seg_tree, c.spec, c.arrays, 10)
+    _, o_ids, o_total = oracle.search(q, 10)
+    assert int(d_total) == o_total == 2
+    assert sorted(np.asarray(d_ids)[:2].tolist()) == sorted(o_ids.tolist()) == [0, 1]
+
+
+def test_exists_text(corpus):
+    assert_parity(corpus, {"exists": {"field": "title"}})
+
+
+def test_match_all(corpus):
+    assert_parity(corpus, {"match_all": {}})
+
+
+def test_match_none_missing_term(corpus):
+    (d_scores, d_ids, d_total), (o_scores, o_ids, o_total) = run_both(
+        corpus, {"match": {"title": "zzzmissing"}}
+    )
+    assert d_total == o_total == 0
+    assert len(d_ids) == len(o_ids) == 0
+
+
+def test_bool_must_filter(corpus):
+    assert_parity(
+        corpus,
+        {
+            "bool": {
+                "must": [{"match": {"body": "alpha bravo"}}],
+                "filter": [{"term": {"tag": "red"}}],
+            }
+        },
+    )
+
+
+def test_bool_must_not(corpus):
+    assert_parity(
+        corpus,
+        {
+            "bool": {
+                "must": [{"match": {"title": "echo"}}],
+                "must_not": [{"range": {"rank": {"lt": 500}}}],
+            }
+        },
+    )
+
+
+def test_bool_should_scoring_on_top_of_must(corpus):
+    assert_parity(
+        corpus,
+        {
+            "bool": {
+                "must": [{"match": {"body": "alpha"}}],
+                "should": [{"match": {"title": "bravo"}}, {"term": {"tag": "green"}}],
+            }
+        },
+    )
+
+
+def test_bool_pure_should_requires_one(corpus):
+    assert_parity(
+        corpus,
+        {"bool": {"should": [{"match": {"title": "kilo"}}, {"match": {"title": "lima"}}]}},
+    )
+
+
+def test_bool_minimum_should_match_2(corpus):
+    assert_parity(
+        corpus,
+        {
+            "bool": {
+                "should": [
+                    {"match": {"body": "alpha"}},
+                    {"match": {"body": "bravo"}},
+                    {"match": {"body": "charlie"}},
+                ],
+                "minimum_should_match": 2,
+            }
+        },
+    )
+
+
+def test_nested_bool(corpus):
+    assert_parity(
+        corpus,
+        {
+            "bool": {
+                "must": [
+                    {
+                        "bool": {
+                            "should": [
+                                {"match": {"title": "alpha"}},
+                                {"match": {"title": "bravo"}},
+                            ]
+                        }
+                    },
+                    {"match": {"body": "charlie"}},
+                ],
+                "filter": [{"range": {"rank": {"gte": 0}}}],
+            }
+        },
+    )
+
+
+def test_constant_score(corpus):
+    assert_parity(
+        corpus,
+        {"constant_score": {"filter": {"match": {"body": "delta"}}, "boost": 3.0}},
+    )
+
+
+def test_boost_propagation(corpus):
+    assert_parity(corpus, {"match": {"title": {"query": "alpha", "boost": 2.0}}})
+
+
+def test_large_k_exceeds_hits(corpus):
+    assert_parity(corpus, {"match": {"title": "alpha"}}, k=400)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_randomized_queries(corpus, seed):
+    """Fuzz: random bool queries must match the oracle exactly."""
+    rng = np.random.default_rng(seed)
+
+    def rand_leaf():
+        r = rng.random()
+        if r < 0.45:
+            n = int(rng.integers(1, 5))
+            return {"match": {str(rng.choice(["title", "body"])): " ".join(rng.choice(VOCAB, n))}}
+        if r < 0.65:
+            return {"term": {"tag": str(rng.choice(["red", "green", "blue", "black"]))}}
+        if r < 0.85:
+            lo = int(rng.integers(0, 900))
+            return {"range": {"rank": {"gte": lo, "lte": lo + int(rng.integers(10, 400))}}}
+        return {"exists": {"field": str(rng.choice(["rank", "title", "tag"]))}}
+
+    for _ in range(8):
+        q = {
+            "bool": {
+                "must": [rand_leaf() for _ in range(int(rng.integers(0, 3)))],
+                "should": [rand_leaf() for _ in range(int(rng.integers(0, 3)))],
+                "filter": [rand_leaf() for _ in range(int(rng.integers(0, 2)))],
+                "must_not": [rand_leaf() for _ in range(int(rng.integers(0, 2)))],
+            }
+        }
+        assert_parity(corpus, q, k=20)
