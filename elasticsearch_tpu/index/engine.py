@@ -1,0 +1,255 @@
+"""Shard engine: the write path and searchable-snapshot lifecycle.
+
+The analog of the reference's InternalEngine (server/src/main/java/org/
+elasticsearch/index/engine/InternalEngine.java:851): documents land in an
+in-memory indexing buffer (SegmentBuilder ≈ the IndexWriter RAM buffer),
+`refresh()` freezes the buffer into an immutable Segment and uploads it to
+the device (≈ opening a new DirectoryReader over a flushed Lucene segment,
+FsDirectoryFactory mmap path), and deletes/updates flip live-doc masks on
+already-refreshed segments (≈ Lucene liveDocs,
+ContextIndexSearcher.java:181-195).
+
+Key semantic carried over from Lucene: BM25 term statistics (df, docCount,
+sumTotalTermFreq) are *shard-level* — aggregated across every searchable
+segment at search time (Lucene computes them from the top-level IndexReader,
+not per leaf). `field_stats()` provides that aggregate; the query compiler
+consumes it per segment so multi-segment scoring matches a single-segment
+index bit-for-bit.
+
+Sequence numbers: every index/delete op gets a monotonically increasing
+seqno (InternalEngine.java:829 generateSeqNoForOperation); the translog
+(index/translog.py) persists ops by seqno for restart recovery.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from ..ops.bm25 import BM25Params
+from ..query.compile import Compiler, FieldStats, aggregate_field_stats
+from .mapping import Mappings
+from .segment import Segment, SegmentBuilder
+from .tiles import DeviceSegment, pack_segment, repack_tn
+
+
+@dataclass
+class SegmentHandle:
+    """One searchable segment plus its mutable deletion state."""
+
+    segment: Segment
+    device: DeviceSegment
+    base: int  # global doc id base for this segment
+    live_host: np.ndarray  # bool[N] host copy of the live mask
+    live_dirty: bool = False
+
+    def soft_delete(self, local_doc: int) -> None:
+        if self.live_host[local_doc]:
+            self.live_host[local_doc] = False
+            self.live_dirty = True
+
+    def sync_live(self) -> None:
+        """Re-upload the live mask if deletions happened since last sync."""
+        if self.live_dirty:
+            import jax
+
+            self.device.live = jax.device_put(self.live_host.copy())
+            self.live_dirty = False
+
+    @property
+    def live_count(self) -> int:
+        return int(np.count_nonzero(self.live_host))
+
+
+class Engine:
+    """Indexing buffer + refreshed device segments for one shard."""
+
+    def __init__(
+        self,
+        mappings: Mappings | None = None,
+        params: BM25Params = BM25Params(),
+        device=None,
+    ):
+        self.mappings = mappings or Mappings()
+        self.params = params
+        self.device = device
+        self.segments: list[SegmentHandle] = []
+        self._buffer = SegmentBuilder(self.mappings)
+        self._buffer_ids: dict[str, int] = {}  # _id -> local doc in buffer
+        self._buffer_deleted: set[int] = set()  # buffer locals dropped pre-refresh
+        self._live_ids: dict[str, tuple[int, int]] = {}  # _id -> (seg idx, local)
+        self._seqno = -1
+        self._auto_id = 0
+        self._stats_cache: dict[str, FieldStats] | None = None
+
+    # ------------------------------------------------------------- write path
+
+    def next_seqno(self) -> int:
+        self._seqno += 1
+        return self._seqno
+
+    @property
+    def max_seqno(self) -> int:
+        return self._seqno
+
+    def index(self, source: dict[str, Any], doc_id: str | None = None) -> dict:
+        """Index (create or overwrite) one document. Returns op metadata."""
+        if doc_id is None:
+            doc_id = f"_auto_{self._auto_id}"
+            self._auto_id += 1
+        created = self._delete_existing(doc_id) == 0
+        local = self._buffer.add(source, doc_id)
+        self._buffer_ids[doc_id] = local
+        return {
+            "_id": doc_id,
+            "result": "created" if created else "updated",
+            "_seq_no": self.next_seqno(),
+        }
+
+    def delete(self, doc_id: str) -> dict:
+        found = self._delete_existing(doc_id) > 0
+        return {
+            "_id": doc_id,
+            "result": "deleted" if found else "not_found",
+            "_seq_no": self.next_seqno() if found else self._seqno,
+        }
+
+    def _delete_existing(self, doc_id: str) -> int:
+        """Tombstone any live copy of doc_id; returns number removed (0/1)."""
+        removed = 0
+        buf_local = self._buffer_ids.pop(doc_id, None)
+        if buf_local is not None:
+            # Buffered doc not yet refreshed: mark for drop at refresh time.
+            self._buffer_deleted.add(buf_local)
+            removed = 1
+        loc = self._live_ids.pop(doc_id, None)
+        if loc is not None:
+            seg_idx, local = loc
+            self.segments[seg_idx].soft_delete(local)
+            removed = 1
+        return removed
+
+    def get(self, doc_id: str) -> dict[str, Any] | None:
+        """Realtime GET: buffer first (like the reference's getFromTranslog,
+        InternalEngine.java:639), then refreshed segments."""
+        local = self._buffer_ids.get(doc_id)
+        if local is not None:
+            return self._buffer._sources[local]
+        loc = self._live_ids.get(doc_id)
+        if loc is not None:
+            seg_idx, local = loc
+            return self.segments[seg_idx].segment.sources[local]
+        return None
+
+    # ----------------------------------------------------------- refresh/read
+
+    def refresh(self) -> bool:
+        """Make buffered docs searchable; returns True if anything changed.
+
+        Buffered docs that were deleted/overwritten before the refresh are
+        dropped rather than indexed-then-masked (the reference achieves the
+        same via the version map + Lucene delete-by-term on flush).
+        """
+        changed = False
+        for handle in self.segments:
+            if handle.live_dirty:
+                handle.sync_live()
+                changed = True
+        if self._buffer.num_docs == 0:
+            return changed
+        deleted = self._buffer_deleted
+        if deleted:
+            # Rebuild the buffer without dropped docs.
+            keep = [
+                i for i in range(self._buffer.num_docs) if i not in deleted
+            ]
+            rebuilt = SegmentBuilder(self.mappings)
+            id_map = {}
+            for i in keep:
+                new_local = rebuilt.add(
+                    self._buffer._sources[i], self._buffer._ids[i]
+                )
+                id_map[i] = new_local
+            self._buffer = rebuilt
+            self._buffer_ids = {
+                d: id_map[l] for d, l in self._buffer_ids.items() if l in id_map
+            }
+            deleted.clear()
+            if self._buffer.num_docs == 0:
+                return changed
+        segment = self._buffer.build()
+        base = sum(h.segment.num_docs for h in self.segments)
+        device = pack_segment(
+            segment, self.device, k1=self.params.k1, b=self.params.b
+        )
+        handle = SegmentHandle(
+            segment=segment,
+            device=device,
+            base=base,
+            live_host=np.ones(segment.num_docs, dtype=bool),
+        )
+        seg_idx = len(self.segments)
+        self.segments.append(handle)
+        for doc_id, local in self._buffer_ids.items():
+            self._live_ids[doc_id] = (seg_idx, local)
+        self._buffer = SegmentBuilder(self.mappings)
+        self._buffer_ids = {}
+        self._stats_cache = None
+        self._sync_impacts()
+        return True
+
+    def _sync_impacts(self) -> None:
+        """Align every segment's precomputed impacts with shard-level stats.
+
+        Shard-level avgdl moves as segments accumulate; impacts baked with a
+        stale avgdl would silently push queries onto the slow gather path
+        (or produce non-reader-level scores). Mirrors Lucene's reader-level
+        CollectionStatistics being recomputed per searcher.
+        """
+        stats = self.field_stats()
+        for handle in self.segments:
+            for name, fld in handle.segment.fields.items():
+                dfield = handle.device.fields[name]
+                target = stats[name].avgdl if name in stats else fld.avgdl
+                if (
+                    dfield.tn_avgdl != float(target)
+                    or dfield.tn_k1 != self.params.k1
+                    or dfield.tn_b != self.params.b
+                ):
+                    repack_tn(dfield, fld, target, self.params.k1, self.params.b)
+
+    @property
+    def num_docs(self) -> int:
+        """Live (searchable) docs, excluding the unrefreshed buffer."""
+        return sum(h.live_count for h in self.segments)
+
+    @property
+    def buffered_docs(self) -> int:
+        return self._buffer.num_docs
+
+    def field_stats(self) -> dict[str, FieldStats]:
+        """Shard-level BM25 statistics aggregated across segments.
+
+        Matches Lucene's IndexReader-level TermStatistics/CollectionStatistics
+        (what the reference's ContextIndexSearcher.termStatistics returns when
+        no AggregatedDfs override is installed). Statistics only change on
+        refresh (new segments), so the aggregate is cached per refresh.
+        """
+        if self._stats_cache is None:
+            self._stats_cache = aggregate_field_stats(
+                [h.segment for h in self.segments]
+            )
+        return self._stats_cache
+
+    def compiler_for(
+        self, handle: SegmentHandle, stats: dict[str, FieldStats] | None = None
+    ) -> Compiler:
+        return Compiler(
+            fields=handle.device.fields,
+            doc_values=handle.device.doc_values,
+            mappings=self.mappings,
+            params=self.params,
+            stats=stats if stats is not None else self.field_stats(),
+        )

@@ -75,6 +75,18 @@ class DeviceField:
     tfs: jax.Array  # float32[NT, TILE]
     norm_bytes: jax.Array  # uint8[N + 1]   (sentinel slot at N)
     present: jax.Array  # bool[N] doc has a value for this field (exists query)
+    # Precomputed per-posting BM25 impact factor tn = tf * normInverse, f32,
+    # same [NT, TILE] layout as tfs. Scoring is then the pure elementwise
+    # `w - w / (1 + tn)` — Lucene's exact fp32 expression order — with NO
+    # random gather in the hot loop (gathers, not FLOPs, dominate on TPU).
+    # Valid for (tn_avgdl, tn_k1, tn_b); other statistics/params fall back
+    # to the gather kernel. The reference computes the same quantity lazily
+    # per (field, query) via its norm cache (BM25Similarity scorer).
+    tn: jax.Array  # float32[NT, TILE]
+    tn_avgdl: float
+    tn_k1: float
+    tn_b: float
+    device: Any = None  # placement used at pack time (repacks must match)
 
     @property
     def num_tiles(self) -> int:
@@ -133,12 +145,56 @@ class DeviceSegment:
             ) from None
 
 
-def pack_field(field: FieldIndex, num_docs: int, device=None) -> DeviceField:
-    """Pack one FieldIndex into tiled device arrays."""
+def compute_tn(
+    field: FieldIndex, avgdl: float, k1: float, b: float
+) -> np.ndarray:
+    """Per-posting impact tn = tf * normInverse(normByte) in fp32.
+
+    Matches the oracle's (and Lucene's) op order exactly: the fp32 product
+    `freq * normInv` that BM25Similarity's scorer feeds into
+    `weight - weight / (1 + freq * normInv)`.
+    """
+    from ..ops.bm25 import BM25Params, norm_inverse_cache
+
+    cache = norm_inverse_cache(avgdl, BM25Params(k1=k1, b=b))
+    if not field.has_norms:
+        cache = np.full(256, cache[1], dtype=np.float32)
+    ninv = cache[field.norm_bytes[field.doc_ids]]
+    return (field.tfs.astype(np.float32) * ninv).astype(np.float32)
+
+
+def pack_field(
+    field: FieldIndex,
+    num_docs: int,
+    device=None,
+    min_tiles: int = 0,
+    avgdl: float | None = None,
+    k1: float = 1.2,
+    b: float = 0.75,
+) -> DeviceField:
+    """Pack one FieldIndex into tiled device arrays.
+
+    `num_docs` may exceed the segment's own doc count (sharded stacking pads
+    every shard to a common size); the scatter sentinel is always `num_docs`.
+    `min_tiles` pads the tile axis so shards stack to equal shapes.
+    `avgdl` is the statistics scope used for the precomputed impacts —
+    shard-level (cross-segment) or global (cross-shard); defaults to this
+    segment's own.
+    """
+    if avgdl is None:
+        avgdl = field.avgdl
     doc_ids = _pad_to_tile(field.doc_ids.astype(np.int32), np.int32(num_docs))
     tfs = _pad_to_tile(field.tfs.astype(np.float32), np.float32(0.0))
+    tn = _pad_to_tile(compute_tn(field, avgdl, k1, b), np.float32(0.0))
+    if min_tiles and len(doc_ids) < min_tiles * TILE:
+        extra = min_tiles * TILE - len(doc_ids)
+        doc_ids = np.concatenate(
+            [doc_ids, np.full(extra, num_docs, dtype=np.int32)]
+        )
+        tfs = np.concatenate([tfs, np.zeros(extra, dtype=np.float32)])
+        tn = np.concatenate([tn, np.zeros(extra, dtype=np.float32)])
     norm_ext = np.zeros(num_docs + 1, dtype=np.uint8)
-    norm_ext[:num_docs] = field.norm_bytes
+    norm_ext[: len(field.norm_bytes)] = field.norm_bytes
     put = lambda x: jax.device_put(x, device)
     return DeviceField(
         name=field.name,
@@ -151,32 +207,85 @@ def pack_field(field: FieldIndex, num_docs: int, device=None) -> DeviceField:
         doc_ids=put(doc_ids.reshape(-1, TILE)),
         tfs=put(tfs.reshape(-1, TILE)),
         norm_bytes=put(norm_ext),
-        # FieldIndex instances predating the presence bitmap (direct
-        # construction, old serialized forms) fall back to norm-byte presence
-        # — the same fallback the oracle uses, so the two sides never diverge
-        # silently.
-        present=put(
-            field.present
-            if len(field.present) == num_docs
-            else np.asarray(field.norm_bytes[:num_docs] > 0)
-        ),
+        present=put(_fit_bool(field.present, field.norm_bytes, num_docs)),
+        tn=put(tn.reshape(-1, TILE)),
+        tn_avgdl=float(avgdl),
+        tn_k1=k1,
+        tn_b=b,
+        device=device,
     )
 
 
+def repack_tn(
+    dfield: DeviceField, field: FieldIndex, avgdl: float, k1: float, b: float
+) -> None:
+    """Recompute a DeviceField's per-posting impacts for new statistics.
+
+    Used when shard-level avgdl drifts as segments accumulate (the engine
+    keeps impacts aligned with reader-level statistics, like Lucene
+    recomputing its norm cache per searcher). Preserves the existing device
+    shape (including sharded min-tile padding).
+    """
+    total = dfield.doc_ids.shape[0] * TILE
+    tn = np.zeros(total, dtype=np.float32)
+    raw = compute_tn(field, avgdl, k1, b)
+    tn[: len(raw)] = raw
+    dfield.tn = jax.device_put(tn.reshape(-1, TILE), dfield.device)
+    dfield.tn_avgdl = float(avgdl)
+    dfield.tn_k1 = k1
+    dfield.tn_b = b
+
+
+def _fit_bool(present: np.ndarray, norm_bytes: np.ndarray, num_docs: int) -> np.ndarray:
+    # FieldIndex instances predating the presence bitmap (direct
+    # construction, old serialized forms) fall back to norm-byte presence —
+    # the same fallback the oracle uses, so the two sides never diverge
+    # silently. Padding docs (sharded stacking) are never present.
+    src = present if len(present) else norm_bytes > 0
+    out = np.zeros(num_docs, dtype=bool)
+    out[: len(src)] = src[:num_docs]
+    return out
+
+
 def pack_segment(
-    segment: Segment, device=None, deleted: np.ndarray | None = None
+    segment: Segment,
+    device=None,
+    deleted: np.ndarray | None = None,
+    pad_docs_to: int = 0,
+    field_min_tiles: dict[str, int] | None = None,
+    field_avgdl: dict[str, float] | None = None,
+    k1: float = 1.2,
+    b: float = 0.75,
 ) -> DeviceSegment:
-    """Upload a whole Segment to the device (the 'refresh' step)."""
-    n = segment.num_docs
+    """Upload a whole Segment to the device (the 'refresh' step).
+
+    `pad_docs_to` / `field_min_tiles` pad doc and tile axes so that several
+    shards' segments stack into one leading-axis array for mesh sharding
+    (padding docs are dead: live=False, doc values NaN, never present).
+    `field_avgdl` supplies the statistics scope for precomputed impacts.
+    """
+    n = max(segment.num_docs, pad_docs_to)
     put = lambda x: jax.device_put(x, device)
+    min_tiles = field_min_tiles or {}
+    avgdls = field_avgdl or {}
     fields = {
-        name: pack_field(f, n, device) for name, f in segment.fields.items()
+        name: pack_field(
+            f, n, device, min_tiles.get(name, 0), avgdls.get(name), k1, b
+        )
+        for name, f in segment.fields.items()
     }
-    doc_values = {
-        name: put(col.astype(np.float32)) for name, col in segment.doc_values.items()
-    }
-    vectors = {name: put(mat) for name, mat in segment.vectors.items()}
-    live = np.ones(n, dtype=bool)
+    doc_values = {}
+    for name, col in segment.doc_values.items():
+        padded = np.full(n, np.nan, dtype=np.float32)
+        padded[: len(col)] = col.astype(np.float32)
+        doc_values[name] = put(padded)
+    vectors = {}
+    for name, mat in segment.vectors.items():
+        padded = np.zeros((n, mat.shape[1]), dtype=np.float32)
+        padded[: len(mat)] = mat
+        vectors[name] = put(padded)
+    live = np.zeros(n, dtype=bool)
+    live[: segment.num_docs] = True
     if deleted is not None and len(deleted):
         live[deleted] = False
     return DeviceSegment(
@@ -190,29 +299,3 @@ def pack_segment(
     )
 
 
-def term_tile_ids(start: int, end: int, max_tiles: int, pad_tile: int) -> np.ndarray:
-    """int32[max_tiles] tile ids covering postings [start, end).
-
-    Padding slots point at `pad_tile`, the segment's all-sentinel tile whose
-    positions lie past every real posting — the kernel's [start, end) mask
-    therefore never selects them (a padding slot aimed at a REAL tile would
-    double-count any term whose span covers that tile).
-    """
-    out = np.full(max_tiles, pad_tile, dtype=np.int32)
-    if end > start:
-        first = start // TILE
-        last = (end - 1) // TILE
-        count = last - first + 1
-        if count > max_tiles:
-            raise ValueError(
-                f"term spans {count} tiles > bucket {max_tiles}; "
-                "plan bucketing must grow the bucket"
-            )
-        out[:count] = np.arange(first, first + count, dtype=np.int32)
-    return out
-
-
-def tiles_needed(start: int, end: int) -> int:
-    if end <= start:
-        return 0
-    return (end - 1) // TILE - start // TILE + 1

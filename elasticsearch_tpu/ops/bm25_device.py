@@ -50,21 +50,36 @@ NEG_INF = float("-inf")
 # Plan representation
 #
 # spec (static, hashable):
-#   ("terms", field_name, T, MT)          — weighted term disjunction
+#   ("terms", field_name, NT)             — weighted term disjunction
+#   ("terms_const", field_name, NT)       — same, constant-score (filters)
 #   ("range", field_name)                 — numeric range (bounds in arrays)
+#   ("exists", field_name, field_kind)    — docs with a value for the field
+#   ("const", child_spec)                 — constant_score wrapper
 #   ("match_all",)                        — every live doc, constant score
 #   ("match_none",)                       — no doc
 #   ("bool", (must...), (should...), (filter...), (must_not...), msm)
 #       msm: minimum_should_match (int; -1 = default rule)
 #
+# A terms node is a FLAT TILE WORKLIST: one entry per posting tile touched
+# by any query term, padded to the pow-2 bucket NT. Each entry carries its
+# term's posting span and precomputed fp32 weight, so the kernel's shape
+# depends only on the total number of tiles — not on term count or on the
+# per-term maximum — which keeps the set of compiled shapes tiny (one per
+# pow-2 worklist size) and the gather dense. Work scales with postings
+# actually touched, like Lucene's per-term postings iteration, but batched.
+#
 # arrays (pytree), by node type:
-#   terms:     {"tile_ids": i32[T, MT], "starts": i32[T], "ends": i32[T],
-#               "weights": f32[T], "cache": f32[256]}
-#   range:     {"lo": f32[], "hi": f32[], "boost": f32[]}  (NaN-safe)
-#   match_all: {"boost": f32[]}
-#   match_none: {}
-#   bool:      {"boost": f32[], "children": (child arrays in
-#               must+should+filter+must_not order)}
+#   terms:       {"tile_ids": i32[NT], "starts": i32[NT], "ends": i32[NT],
+#                 "weights": f32[NT], "cache": f32[256]}
+#   terms_const: {"tile_ids": i32[NT], "starts": i32[NT], "ends": i32[NT],
+#                 "boost": f32[]}
+#   range:       {"lo": f32[], "hi": f32[], "boost": f32[]}  (NaN-safe)
+#   exists:      {"boost": f32[]}
+#   const:       {"boost": f32[], "child": <child arrays>}
+#   match_all:   {"boost": f32[]}
+#   match_none:  {}
+#   bool:        {"boost": f32[], "children": (child arrays in
+#                 must+should+filter+must_not order)}
 # ---------------------------------------------------------------------------
 
 
@@ -73,6 +88,8 @@ def _eval_node(spec, arrays, seg: dict[str, Any], num_docs: int):
     kind = spec[0]
     if kind == "terms":
         return _eval_terms(spec, arrays, seg, num_docs)
+    if kind == "terms_gather":
+        return _eval_terms_gather(spec, arrays, seg, num_docs)
     if kind == "terms_const":
         matched = _terms_matched(spec, arrays, seg, num_docs)
         scores = jnp.where(matched, arrays["boost"], jnp.float32(0.0))
@@ -85,7 +102,7 @@ def _eval_node(spec, arrays, seg: dict[str, Any], num_docs: int):
     if kind == "exists":
         _, field_name, field_kind = spec
         if field_kind == "inverted":
-            matched = seg["fields"][field_name][3]  # presence bitmap
+            matched = seg["fields"][field_name][4]  # presence bitmap
         else:
             matched = ~jnp.isnan(seg["doc_values"][field_name])
         scores = jnp.where(matched, arrays["boost"], jnp.float32(0.0))
@@ -106,30 +123,25 @@ def _eval_node(spec, arrays, seg: dict[str, Any], num_docs: int):
     raise ValueError(f"unknown plan node kind [{kind}]")
 
 
-def _gather_tiles(spec, arrays, seg):
-    """Shared tile gather: (docs, tfs, valid, idx) each [T, MT, S]."""
+def _gather_tiles(spec, arrays, seg, want: str = "tn"):
+    """Shared worklist gather: (docs, vals, valid), each [NT, S].
+
+    `want` picks the value plane: "tn" (precomputed impact, the fast path)
+    or "tf" (raw frequency, for the custom-params gather kernel).
+    """
     field_name = spec[1]
-    doc_tiles, tf_tiles, norm_bytes, _present = seg["fields"][field_name]
-    tile_ids = arrays["tile_ids"]  # i32[T, MT]
-    starts = arrays["starts"]  # i32[T]
-    ends = arrays["ends"]  # i32[T]
-    docs = doc_tiles[tile_ids]  # i32[T, MT, S]
-    tfs = tf_tiles[tile_ids]  # f32[T, MT, S]
-    pos = tile_ids[..., None] * TILE + jnp.arange(TILE, dtype=jnp.int32)
-    valid = (pos >= starts[:, None, None]) & (pos < ends[:, None, None])
-    return docs, tfs, valid, norm_bytes
+    doc_tiles, tn_tiles, tf_tiles, norm_bytes, _present = seg["fields"][field_name]
+    tile_ids = arrays["tile_ids"]  # i32[NT]
+    starts = arrays["starts"]  # i32[NT] (term's span, same for its tiles)
+    ends = arrays["ends"]  # i32[NT]
+    docs = doc_tiles[tile_ids]  # i32[NT, S]
+    vals = (tn_tiles if want == "tn" else tf_tiles)[tile_ids]  # f32[NT, S]
+    pos = tile_ids[:, None] * TILE + jnp.arange(TILE, dtype=jnp.int32)
+    valid = (pos >= starts[:, None]) & (pos < ends[:, None])
+    return docs, vals, valid, norm_bytes
 
 
-def _eval_terms(spec, arrays, seg, num_docs):
-    docs, tfs, valid, norm_bytes = _gather_tiles(spec, arrays, seg)
-    weights = arrays["weights"]  # f32[T]
-    cache = arrays["cache"]  # f32[256]
-
-    ninv = cache[norm_bytes[docs]]  # f32[T, MT, S]
-    w = weights[:, None, None]
-    one = jnp.float32(1.0)
-    contrib = w - w / (one + tfs * ninv)
-
+def _scatter_scored(docs, contrib, valid, num_docs):
     idx = jnp.where(valid, docs, num_docs)  # sentinel slot = num_docs
     scores = (
         jnp.zeros(num_docs + 1, dtype=jnp.float32)
@@ -142,8 +154,29 @@ def _eval_terms(spec, arrays, seg, num_docs):
     return scores, matched
 
 
+def _eval_terms(spec, arrays, seg, num_docs):
+    """Fast path: per-posting impacts precomputed, zero gathers in-loop."""
+    docs, tn, valid, _norm = _gather_tiles(spec, arrays, seg, want="tn")
+    w = arrays["weights"][:, None]  # f32[NT, 1] per-tile term weight
+    one = jnp.float32(1.0)
+    contrib = w - w / (one + tn)
+    return _scatter_scored(docs, contrib, valid, num_docs)
+
+
+def _eval_terms_gather(spec, arrays, seg, num_docs):
+    """Fallback for non-default k1/b or statistics scope: per-doc norm via
+    the 256-entry cache (Lucene's per-query cache), costing a gather."""
+    docs, tfs, valid, norm_bytes = _gather_tiles(spec, arrays, seg, want="tf")
+    cache = arrays["cache"]  # f32[256]
+    ninv = cache[norm_bytes[docs]]  # f32[NT, S]
+    w = arrays["weights"][:, None]
+    one = jnp.float32(1.0)
+    contrib = w - w / (one + tfs * ninv)
+    return _scatter_scored(docs, contrib, valid, num_docs)
+
+
 def _terms_matched(spec, arrays, seg, num_docs):
-    docs, _tfs, valid, _norm = _gather_tiles(spec, arrays, seg)
+    docs, _vals, valid, _norm = _gather_tiles(spec, arrays, seg)
     idx = jnp.where(valid, docs, num_docs)
     return jnp.zeros(num_docs + 1, dtype=bool).at[idx].max(valid)[:num_docs]
 
@@ -203,17 +236,7 @@ def _eval_bool(spec, arrays, seg, num_docs):
     return score, matched
 
 
-@partial(jax.jit, static_argnames=("spec", "k"))
-def execute(seg, spec, arrays, k: int):
-    """Run a compiled query plan over one device segment.
-
-    seg: {"fields": {name: (doc_ids i32[NT,S], tfs f32[NT,S],
-                            norm_bytes u8[N+1])},
-          "doc_values": {name: f32[N]}, "live": bool[N]}
-
-    Returns (top_scores f32[k], top_ids i32[k], total_hits i32[]).
-    Slots past total hits carry score -inf (host trims them).
-    """
+def _execute_inner(seg, spec, arrays, k: int):
     live = seg["live"]
     num_docs = live.shape[0]
     scores, matched = _eval_node(spec, arrays, seg, num_docs)
@@ -223,6 +246,105 @@ def execute(seg, spec, arrays, k: int):
     top_scores, top_ids = jax.lax.top_k(masked, kk)
     total = jnp.sum(eligible, dtype=jnp.int32)
     return top_scores, top_ids.astype(jnp.int32), total
+
+
+@partial(jax.jit, static_argnames=("spec", "k"))
+def execute(seg, spec, arrays, k: int):
+    """Run a compiled query plan over one device segment.
+
+    seg: {"fields": {name: (doc_ids i32[NT,S], tfs f32[NT,S],
+                            norm_bytes u8[N+1], present bool[N])},
+          "doc_values": {name: f32[N]}, "live": bool[N]}
+
+    Returns (top_scores f32[k], top_ids i32[k], total_hits i32[]).
+    Slots past total hits carry score -inf (host trims them).
+    """
+    return _execute_inner(seg, spec, arrays, k)
+
+
+@partial(jax.jit, static_argnames=("spec", "k"))
+def execute_batch(seg, spec, arrays_batched, k: int):
+    """Run a batch of same-spec compiled queries in one program.
+
+    The msearch-style serving mode: arrays_batched leaves carry a leading
+    query axis [Q, ...]; one dispatch + one device→host transfer serves the
+    whole batch (amortizing host/device round-trip latency, the dominant
+    cost for small per-query work). Returns ([Q, k] scores, [Q, k] ids,
+    [Q] totals).
+    """
+    return jax.vmap(lambda arrays: _execute_inner(seg, spec, arrays, k))(
+        arrays_batched
+    )
+
+
+@partial(jax.jit, static_argnames=("spec", "k"))
+def execute_score_asc(seg, spec, arrays, k: int):
+    """Bottom-k by score (explicit {"_score": "asc"} sorts).
+
+    Ineligible docs mask to +inf so they can never enter the bottom-k; ties
+    break by ascending doc id like the descending path.
+    """
+    live = seg["live"]
+    num_docs = live.shape[0]
+    scores, matched = _eval_node(spec, arrays, seg, num_docs)
+    eligible = matched & live
+    masked = jnp.where(eligible, scores, jnp.float32(jnp.inf))
+    kk = min(k, num_docs)
+    neg_top, top_ids = jax.lax.top_k(-masked, kk)
+    total = jnp.sum(eligible, dtype=jnp.int32)
+    return -neg_top, top_ids.astype(jnp.int32), total
+
+
+def execute_many(seg, compiled_queries, k: int):
+    """Grouped msearch: batch same-spec queries, one launch per shape group.
+
+    Queries keep their natural pow-2 worklist buckets (no padding to the
+    global max), so total device work tracks actual postings touched; the
+    per-launch round-trip is amortized within each group. Returns results
+    in input order: a list of (scores f32[k], ids i32[k], total int).
+    """
+    from collections import defaultdict
+
+    groups = defaultdict(list)
+    for pos, c in enumerate(compiled_queries):
+        groups[c.spec].append(pos)
+    results: list = [None] * len(compiled_queries)
+    for spec, positions in groups.items():
+        arrays_b = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[compiled_queries[p].arrays for p in positions],
+        )
+        scores_b, ids_b, totals_b = jax.device_get(
+            execute_batch(seg, spec, arrays_b, k)
+        )
+        for row, p in enumerate(positions):
+            results[p] = (scores_b[row], ids_b[row], int(totals_b[row]))
+    return results
+
+
+@partial(jax.jit, static_argnames=("spec", "field_name", "desc", "k"))
+def execute_sorted(seg, spec, arrays, field_name: str, desc: bool, k: int):
+    """Query + field sort: top-k by a doc-values column, missing last.
+
+    Mirrors the reference's TopFieldCollector path with ES missing-last
+    semantics (search/sort/FieldSortBuilder). Ties break by ascending doc
+    id. Returns (values f32[k] raw field values (NaN = missing),
+    ids i32[k], total_hits i32[]).
+    """
+    live = seg["live"]
+    num_docs = live.shape[0]
+    _, matched = _eval_node(spec, arrays, seg, num_docs)
+    eligible = matched & live
+    col = seg["doc_values"][field_name]
+    key = -col if desc else col
+    fmax = jnp.float32(jnp.finfo(jnp.float32).max)
+    key = jnp.where(jnp.isnan(key), fmax, key)  # missing sorts last...
+    key = jnp.where(eligible, key, jnp.float32(jnp.inf))  # ...but before ineligible
+    kk = min(k, num_docs)
+    _neg_top, ids = jax.lax.top_k(-key, kk)
+    values = col[ids]
+    total = jnp.sum(eligible, dtype=jnp.int32)
+    return values, ids.astype(jnp.int32), total
 
 
 @partial(jax.jit, static_argnames=("spec",))
@@ -239,7 +361,7 @@ def segment_tree(device_segment) -> dict[str, Any]:
     """Build the jit-input pytree view of a DeviceSegment."""
     return {
         "fields": {
-            name: (f.doc_ids, f.tfs, f.norm_bytes, f.present)
+            name: (f.doc_ids, f.tn, f.tfs, f.norm_bytes, f.present)
             for name, f in device_segment.fields.items()
         },
         "doc_values": dict(device_segment.doc_values),

@@ -1,0 +1,2 @@
+from .routing import murmur3_hash, shard_for_id  # noqa: F401
+from .sharded import ShardedIndex, sharded_execute  # noqa: F401

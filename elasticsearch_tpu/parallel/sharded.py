@@ -1,0 +1,453 @@
+"""Sharded search over a device mesh: the cluster, in one XLA program.
+
+The reference scales search by scattering per-shard QUERY requests over TCP
+and reducing on a coordinator (AbstractSearchAsyncAction.java:280 fan-out;
+SearchPhaseController.java:398 reduce; QueryPhaseResultConsumer incremental
+merge). Here the entire scatter-gather collapses into a single SPMD program:
+
+- every shard's tiled postings live on its own device (leading `shard` mesh
+  axis, `jax.sharding.NamedSharding`);
+- one `shard_map` program scores all shards simultaneously, takes each
+  shard's local top-k, and merges via `jax.lax.all_gather` over the ICI —
+  the coordinator reduce becomes a collective;
+- total-hit counts reduce with `psum`.
+
+Global term statistics: per-shard IDF would make scores depend on routing
+(the reference has the same artifact and fixes it with the DFS phase,
+search/dfs/DfsPhase.java:31). `ShardedIndex.field_stats` aggregates
+statistics across shards at plan time — the DFS phase equivalent, free on
+the host because the coordinator owns all term dictionaries here.
+
+Tie-breaking: the merged flat top-k favors lower (shard, local-rank) on
+equal scores, which is exactly (shard index, doc id) order — the same
+contract as the reference's mergeTopDocs shard-order tie-break.
+
+Doc addressing: global doc = shard * padded_size + local, reversible on the
+host for the fetch phase (`locate`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..index.mapping import Mappings
+from ..index.segment import FieldIndex, Segment, SegmentBuilder
+from ..index.tiles import TILE, pack_segment
+from ..ops.bm25 import BM25Params
+from ..ops.bm25_device import NEG_INF, _eval_node, segment_tree
+from ..query.compile import (
+    CompiledQuery,
+    Compiler,
+    FieldStats,
+    aggregate_field_stats,
+)
+from ..query.dsl import Query
+from .routing import shard_for_id
+
+
+def _empty_field(name: str, num_docs: int, has_norms: bool) -> FieldIndex:
+    return FieldIndex(
+        name=name,
+        terms={},
+        df=np.zeros(0, dtype=np.int32),
+        offsets=np.zeros(1, dtype=np.int64),
+        doc_ids=np.zeros(0, dtype=np.int32),
+        tfs=np.zeros(0, dtype=np.float32),
+        norm_bytes=np.zeros(num_docs, dtype=np.uint8),
+        doc_count=0,
+        sum_total_tf=0,
+        has_norms=has_norms,
+        present=np.zeros(num_docs, dtype=bool),
+    )
+
+
+@dataclass
+class ShardedIndex:
+    """N shards stacked on a leading mesh axis, searchable as one program."""
+
+    mesh: Mesh
+    axis: str
+    mappings: Mappings
+    segments: list[Segment]  # host-side, for stats + fetch phase
+    seg_stacked: Any  # pytree: every leaf [n_shards, ...], device-sharded
+    docs_per_shard: int  # padded per-shard doc capacity (global id stride)
+    params: BM25Params
+    _stats_cache: dict[str, FieldStats] | None = None
+
+    @classmethod
+    def from_docs(
+        cls,
+        docs: list[tuple[str, dict]],
+        mappings: Mappings,
+        mesh: Mesh,
+        axis: str = "shard",
+        params: BM25Params = BM25Params(),
+    ) -> "ShardedIndex":
+        """Route (id, source) docs to shards and build the stacked index."""
+        n_shards = mesh.shape[axis]
+        builders = [SegmentBuilder(mappings) for _ in range(n_shards)]
+        for doc_id, source in docs:
+            builders[shard_for_id(doc_id, n_shards)].add(source, doc_id)
+        return cls.from_segments(
+            [b.build() for b in builders], mappings, mesh, axis, params
+        )
+
+    @classmethod
+    def from_segments(
+        cls,
+        segments: list[Segment],
+        mappings: Mappings,
+        mesh: Mesh,
+        axis: str = "shard",
+        params: BM25Params = BM25Params(),
+    ) -> "ShardedIndex":
+        n_shards = mesh.shape[axis]
+        if len(segments) != n_shards:
+            raise ValueError(
+                f"{len(segments)} segments for a {n_shards}-shard mesh axis"
+            )
+        # Uniform schema: every shard carries the union of fields/columns.
+        all_fields: dict[str, bool] = {}
+        all_dv: set[str] = set()
+        for seg in segments:
+            for name, fld in seg.fields.items():
+                all_fields[name] = fld.has_norms
+            all_dv.update(seg.doc_values)
+        n_pad = max((s.num_docs for s in segments), default=0)
+        n_pad = max(n_pad, 1)
+        min_tiles: dict[str, int] = {}
+        for seg in segments:
+            for name in all_fields:
+                fld = seg.fields.get(name)
+                postings = len(fld.doc_ids) if fld is not None else 0
+                tiles = postings // TILE + 2  # data tiles + sentinel tile
+                min_tiles[name] = max(min_tiles.get(name, 0), tiles)
+        # Global (cross-shard) avgdl so precomputed impacts match the DFS
+        # statistics scope the compiler will score with.
+        global_stats = aggregate_field_stats(segments)
+        global_avgdl = {name: s.avgdl for name, s in global_stats.items()}
+        trees = []
+        for seg in segments:
+            for name, has_norms in all_fields.items():
+                if name not in seg.fields:
+                    seg.fields[name] = _empty_field(name, seg.num_docs, has_norms)
+            for name in all_dv:
+                if name not in seg.doc_values:
+                    seg.doc_values[name] = np.full(seg.num_docs, np.nan)
+            dev = pack_segment(
+                seg,
+                pad_docs_to=n_pad,
+                field_min_tiles=min_tiles,
+                field_avgdl=global_avgdl,
+                k1=params.k1,
+                b=params.b,
+            )
+            trees.append(segment_tree(dev))
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+        sharding = NamedSharding(mesh, P(axis))
+        stacked = jax.tree.map(
+            lambda x: jax.device_put(x, sharding), stacked
+        )
+        return cls(
+            mesh=mesh,
+            axis=axis,
+            mappings=mappings,
+            segments=segments,
+            seg_stacked=stacked,
+            docs_per_shard=n_pad,
+            params=params,
+        )
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    def field_stats(self) -> dict[str, FieldStats]:
+        """Cross-shard statistics: the DFS phase, computed at plan time.
+
+        Cached — shards are immutable once the index is built."""
+        if self._stats_cache is None:
+            self._stats_cache = aggregate_field_stats(self.segments)
+        return self._stats_cache
+
+    def compile(self, query: Query, nt_floor: int = 1) -> CompiledQuery:
+        """Compile per shard with uniform buckets; stack arrays on axis 0."""
+        stats = self.field_stats()
+
+        def shard_compiler(seg: Segment, floor: int) -> Compiler:
+            # Host-side planning view over the same offsets the device sees.
+            fields = {}
+            for name, fld in seg.fields.items():
+                postings = len(fld.doc_ids)
+                nt = postings // TILE + 2
+                fstats = stats.get(name)
+                fields[name] = _PlanField(
+                    name=name,
+                    terms=fld.terms,
+                    df=fld.df,
+                    offsets=fld.offsets,
+                    doc_count=fld.doc_count,
+                    sum_total_tf=fld.sum_total_tf,
+                    has_norms=fld.has_norms,
+                    num_tiles_=max(nt, 0),
+                    # Impacts were packed with global stats + index params,
+                    # so the fast (precomputed-impact) kernel applies.
+                    tn_avgdl=float(fstats.avgdl) if fstats else 1.0,
+                    tn_k1=self.params.k1,
+                    tn_b=self.params.b,
+                )
+            return Compiler(
+                fields=fields,
+                doc_values={name: None for name in seg.doc_values},
+                mappings=self.mappings,
+                params=self.params,
+                stats=stats,
+                nt_floor=floor,
+            )
+
+        first = [
+            shard_compiler(seg, nt_floor).compile(query)
+            for seg in self.segments
+        ]
+        specs_match = len({c.spec for c in first}) == 1
+        if not specs_match:
+            nt_max = max(_max_nt(c.spec) for c in first)
+            first = [
+                shard_compiler(seg, nt_max).compile(query)
+                for seg in self.segments
+            ]
+            if len({c.spec for c in first}) != 1:
+                raise AssertionError(
+                    "sharded compile produced divergent specs even with a "
+                    "common worklist floor"
+                )
+        spec = first[0].spec
+        arrays = jax.tree.map(lambda *xs: np.stack(xs), *[c.arrays for c in first])
+        return CompiledQuery(spec=spec, arrays=arrays)
+
+    def compile_batch(self, queries: list[Query]) -> CompiledQuery:
+        """Compile a batch of same-shape queries; arrays get a leading Q axis.
+
+        All queries must lower to the same operator-tree structure; shape
+        buckets (term count, tile count) are equalized automatically by
+        recompiling with the batch-max floors — the batched executor then
+        runs one program for the whole batch.
+        """
+        compiled = [self.compile(q) for q in queries]
+        specs = {c.spec for c in compiled}
+        if len(specs) != 1:
+            nt_max = max(_max_nt(c.spec) for c in compiled)
+            compiled = [self.compile(q, nt_floor=nt_max) for q in queries]
+            specs = {c.spec for c in compiled}
+        if len(specs) != 1:
+            raise ValueError(
+                "batched queries must share one compiled operator tree; got "
+                f"{len(specs)} distinct specs after bucket equalization"
+            )
+        arrays = jax.tree.map(
+            lambda *xs: np.stack(xs), *[c.arrays for c in compiled]
+        )
+        return CompiledQuery(spec=compiled[0].spec, arrays=arrays)
+
+    def search_batch(self, queries: list[Query], k: int, batch_axis: str):
+        """Batched sharded search over a 2D (batch × shard) mesh."""
+        compiled = self.compile_batch(queries)
+        return sharded_execute_batch(
+            self.mesh,
+            self.axis,
+            batch_axis,
+            self.seg_stacked,
+            compiled.arrays,
+            compiled.spec,
+            k,
+            self.docs_per_shard,
+        )
+
+    def locate(self, global_doc: int) -> tuple[int, int]:
+        """global doc id -> (shard, local doc id) for the fetch phase."""
+        return divmod(int(global_doc), self.docs_per_shard)
+
+    def search(self, query: Query, k: int = 10):
+        """One-call sharded search: (scores f32[k'], global_ids, total)."""
+        compiled = self.compile(query)
+        scores, ids, total = sharded_execute(
+            self.mesh,
+            self.axis,
+            self.seg_stacked,
+            compiled.arrays,
+            compiled.spec,
+            k,
+            self.docs_per_shard,
+        )
+        scores, ids = np.asarray(scores), np.asarray(ids)
+        n = min(k, int(total))
+        return scores[:n], ids[:n], int(total)
+
+
+@dataclass
+class _PlanField:
+    """Host-only planning stand-in for DeviceField (term dict + spans)."""
+
+    name: str
+    terms: dict
+    df: Any
+    offsets: Any
+    doc_count: int
+    sum_total_tf: int
+    has_norms: bool
+    num_tiles_: int
+    tn_avgdl: float = -1.0
+    tn_k1: float = 1.2
+    tn_b: float = 0.75
+
+    @property
+    def avgdl(self) -> float:
+        if self.doc_count == 0:
+            return 1.0
+        return self.sum_total_tf / self.doc_count
+
+    @property
+    def pad_tile(self) -> int:
+        return self.num_tiles_ - 1
+
+    def term_span(self, term: str) -> tuple[int, int]:
+        tid = self.terms.get(term)
+        if tid is None:
+            return (0, 0)
+        return int(self.offsets[tid]), int(self.offsets[tid + 1])
+
+    def term_df(self, term: str) -> int:
+        tid = self.terms.get(term)
+        if tid is None:
+            return 0
+        return int(self.df[tid])
+
+
+def _max_nt(spec: tuple) -> int:
+    """Largest terms-node worklist bucket anywhere in a compiled spec."""
+    kind = spec[0]
+    if kind in ("terms", "terms_const"):
+        return spec[2]
+    if kind == "const":
+        return _max_nt(spec[1])
+    if kind == "bool":
+        out = 1
+        for group in spec[1:5]:
+            for child in group:
+                out = max(out, _max_nt(child))
+        return out
+    return 1
+
+
+@partial(
+    jax.jit, static_argnames=("mesh", "axis", "spec", "k", "docs_per_shard")
+)
+def sharded_execute(
+    mesh: Mesh, axis: str, seg_stacked, arrays_stacked, spec, k: int, docs_per_shard: int
+):
+    """SPMD query: per-shard score + top-k, all-gather merge, psum totals.
+
+    Replaces the reference's transport-level scatter/gather + coordinator
+    reduce with in-program collectives over ICI (SURVEY §2.3 row 3).
+    Returns replicated (scores f32[k], global ids i32[k], total i32[]).
+    """
+
+    def body(seg, arrays):
+        seg = jax.tree.map(lambda x: x[0], seg)
+        arrays = jax.tree.map(lambda x: x[0], arrays)
+        live = seg["live"]
+        n = live.shape[0]
+        scores, matched = _eval_node(spec, arrays, seg, n)
+        eligible = matched & live
+        masked = jnp.where(eligible, scores, jnp.float32(NEG_INF))
+        kk = min(k, n)
+        local_s, local_i = jax.lax.top_k(masked, kk)
+        shard_id = jax.lax.axis_index(axis)
+        global_i = shard_id.astype(jnp.int32) * docs_per_shard + local_i.astype(
+            jnp.int32
+        )
+        all_s = jax.lax.all_gather(local_s, axis)  # [S, kk]
+        all_i = jax.lax.all_gather(global_i, axis)
+        flat_s = all_s.reshape(-1)
+        flat_i = all_i.reshape(-1)
+        top_s, idx = jax.lax.top_k(flat_s, kk)
+        top_i = flat_i[idx]
+        total = jax.lax.psum(jnp.sum(eligible, dtype=jnp.int32), axis)
+        return top_s, top_i, total
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )(seg_stacked, arrays_stacked)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "shard_axis", "batch_axis", "spec", "k", "docs_per_shard"),
+)
+def sharded_execute_batch(
+    mesh: Mesh,
+    shard_axis: str,
+    batch_axis: str,
+    seg_stacked,
+    arrays_batched,  # leaves [Q, S, ...]
+    spec,
+    k: int,
+    docs_per_shard: int,
+):
+    """Query-batch × shard SPMD search over a 2D mesh.
+
+    The replica/data-parallel analog (SURVEY §2.3 row 2): the index is
+    replicated over `batch_axis` and sharded over `shard_axis`; a batch of
+    same-shape compiled queries is sharded over `batch_axis`. Each device
+    scores its query sub-batch against its shard; the shard reduce is an
+    `all_gather` over ICI exactly as in `sharded_execute`.
+
+    Returns (scores f32[Q, k], global ids i32[Q, k], totals i32[Q]), sharded
+    over `batch_axis`.
+    """
+
+    def body(seg, arrays):
+        seg = jax.tree.map(lambda x: x[0], seg)  # strip shard axis
+        arrays = jax.tree.map(lambda x: x[:, 0], arrays)  # [Qb, ...]
+        live = seg["live"]
+        n = live.shape[0]
+        kk = min(k, n)
+
+        def one(one_arrays):
+            scores, matched = _eval_node(spec, one_arrays, seg, n)
+            eligible = matched & live
+            masked = jnp.where(eligible, scores, jnp.float32(NEG_INF))
+            local_s, local_i = jax.lax.top_k(masked, kk)
+            return local_s, local_i, jnp.sum(eligible, dtype=jnp.int32)
+
+        local_s, local_i, counts = jax.vmap(one)(arrays)  # [Qb, kk]
+        shard_id = jax.lax.axis_index(shard_axis).astype(jnp.int32)
+        global_i = shard_id * docs_per_shard + local_i.astype(jnp.int32)
+        all_s = jax.lax.all_gather(local_s, shard_axis)  # [S, Qb, kk]
+        all_i = jax.lax.all_gather(global_i, shard_axis)
+        qb = all_s.shape[1]
+        flat_s = all_s.transpose(1, 0, 2).reshape(qb, -1)  # [Qb, S*kk]
+        flat_i = all_i.transpose(1, 0, 2).reshape(qb, -1)
+        top_s, idx = jax.lax.top_k(flat_s, kk)
+        top_i = jnp.take_along_axis(flat_i, idx, axis=1)
+        totals = jax.lax.psum(counts, shard_axis)
+        return top_s, top_i, totals
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(shard_axis), P(batch_axis, shard_axis)),
+        out_specs=(P(batch_axis), P(batch_axis), P(batch_axis)),
+        check_vma=False,
+    )(seg_stacked, arrays_batched)

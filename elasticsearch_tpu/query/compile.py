@@ -30,7 +30,7 @@ from typing import Any
 import numpy as np
 
 from ..index.mapping import Mappings, coerce_numeric
-from ..index.tiles import DeviceField, term_tile_ids, tiles_needed
+from ..index.tiles import TILE, DeviceField
 from ..ops.bm25 import BM25Params, norm_inverse_cache, term_weight
 from .dsl import (
     BoolQuery,
@@ -53,6 +53,34 @@ class FieldStats:
     doc_count: int
     avgdl: float
     df: dict[str, int] = dc_field(default_factory=dict)  # per-term overrides
+
+
+def aggregate_field_stats(segments) -> dict[str, FieldStats]:
+    """Reader-level statistics across segments (or shards).
+
+    The single source of the statistics contract shared by Engine (segments
+    of one shard) and ShardedIndex (shards of one index): deleted docs still
+    count — Lucene statistics ignore liveDocs until segments merge — and
+    avgdl = sumTotalTermFreq / docCount.
+    """
+    stats: dict[str, FieldStats] = {}
+    totals: dict[str, list[int]] = {}
+    dfs: dict[str, dict[str, int]] = {}
+    for seg in segments:
+        for name, fld in seg.fields.items():
+            tot = totals.setdefault(name, [0, 0])
+            tot[0] += fld.doc_count
+            tot[1] += fld.sum_total_tf
+            fdfs = dfs.setdefault(name, {})
+            for term, tid in fld.terms.items():
+                fdfs[term] = fdfs.get(term, 0) + int(fld.df[tid])
+    for name, (doc_count, sum_tf) in totals.items():
+        stats[name] = FieldStats(
+            doc_count=doc_count,
+            avgdl=(sum_tf / doc_count) if doc_count else 1.0,
+            df=dfs[name],
+        )
+    return stats
 
 
 @dataclass
@@ -96,21 +124,31 @@ def _terms_arrays(
     params: BM25Params,
     stats: FieldStats | None,
     scored: bool,
+    nt_floor: int = 1,
 ) -> tuple[tuple, dict]:
+    """Lower a term disjunction to a flat tile worklist.
+
+    One worklist entry per posting tile any term touches, each carrying its
+    term's [start, end) span and fp32 weight. The bucket (pow-2 total tile
+    count, floored by `nt_floor` for sharded/batched uniformity) is the only
+    shape dimension, so compiled-kernel reuse across queries is maximal.
+    """
     doc_count = stats.doc_count if stats else dfield.doc_count
     avgdl = stats.avgdl if stats else dfield.avgdl
-    t_pad = _pow2(len(terms))
-    spans = [dfield.term_span(t) for t in terms]
-    mt = _pow2(max((tiles_needed(s, e) for s, e in spans), default=1))
+    # Fast path: the segment's precomputed per-posting impacts are valid iff
+    # they were built with the same statistics scope and k1/b.
+    use_tn = scored and (
+        float(avgdl) == dfield.tn_avgdl
+        and params.k1 == dfield.tn_k1
+        and params.b == dfield.tn_b
+    )
 
-    tile_ids = np.full((t_pad, mt), dfield.pad_tile, dtype=np.int32)
-    starts = np.zeros(t_pad, dtype=np.int32)
-    ends = np.zeros(t_pad, dtype=np.int32)
-    weights = np.zeros(t_pad, dtype=np.float32)
-    for i, (term, (s, e)) in enumerate(zip(terms, spans)):
-        tile_ids[i] = term_tile_ids(s, e, mt, dfield.pad_tile)
-        starts[i] = s
-        ends[i] = e
+    entries: list[tuple[int, int, int, float]] = []  # (tile, start, end, w)
+    for term in terms:
+        s, e = dfield.term_span(term)
+        if e <= s:
+            continue
+        w = 0.0
         if scored:
             df = (
                 stats.df.get(term, dfield.term_df(term))
@@ -118,18 +156,34 @@ def _terms_arrays(
                 else dfield.term_df(term)
             )
             if df > 0 and doc_count > 0:
-                weights[i] = term_weight(df, doc_count, boost, params)
+                w = term_weight(df, doc_count, boost, params)
+        first, last = s // TILE, (e - 1) // TILE
+        for tile in range(first, last + 1):
+            entries.append((tile, s, e, w))
 
-    spec = ("terms" if scored else "terms_const", dfield.name, t_pad, mt)
+    nt = _pow2(len(entries), nt_floor)
+    tile_ids = np.full(nt, dfield.pad_tile, dtype=np.int32)
+    starts = np.zeros(nt, dtype=np.int32)
+    ends = np.zeros(nt, dtype=np.int32)
+    weights = np.zeros(nt, dtype=np.float32)
+    for i, (tile, s, e, w) in enumerate(entries):
+        tile_ids[i] = tile
+        starts[i] = s
+        ends[i] = e
+        weights[i] = w
+
+    kind = ("terms" if use_tn else "terms_gather") if scored else "terms_const"
+    spec = (kind, dfield.name, nt)
     arrays = {"tile_ids": tile_ids, "starts": starts, "ends": ends}
     if scored:
-        cache = norm_inverse_cache(avgdl if doc_count else 1.0, params)
-        if not dfield.has_norms:
-            # Norms-disabled fields (keyword) score every doc with norm byte 1
-            # (LeafSimScorer substitutes norm 1 when norms are absent).
-            cache = np.full(256, cache[1], dtype=np.float32)
         arrays["weights"] = weights
-        arrays["cache"] = cache
+        if not use_tn:
+            cache = norm_inverse_cache(avgdl if doc_count else 1.0, params)
+            if not dfield.has_norms:
+                # Norms-disabled fields (keyword) score every doc with norm
+                # byte 1 (LeafSimScorer substitutes norm 1 when absent).
+                cache = np.full(256, cache[1], dtype=np.float32)
+            arrays["cache"] = cache
     else:
         arrays["boost"] = np.float32(boost)
     return spec, arrays
@@ -145,12 +199,17 @@ class Compiler:
         mappings: Mappings,
         params: BM25Params = BM25Params(),
         stats: dict[str, FieldStats] | None = None,
+        nt_floor: int = 1,
     ):
         self.fields = fields
         self.doc_values = doc_values
         self.mappings = mappings
         self.params = params
         self.stats = stats or {}
+        # Minimum worklist bucket: sharded/batched compilation raises this to
+        # the max across shards (and across a query batch) so every shard
+        # and query compiles to one identical static spec.
+        self.nt_floor = nt_floor
 
     def compile(self, query: Query) -> CompiledQuery:
         spec, arrays = self._node(query, scoring=True)
@@ -218,7 +277,9 @@ class Compiler:
         return self._terms_spec(dfield, terms, q.boost, stats, scoring)
 
     def _terms_spec(self, dfield, terms, boost, stats, scored=True):
-        return _terms_arrays(dfield, terms, boost, self.params, stats, scored)
+        return _terms_arrays(
+            dfield, terms, boost, self.params, stats, scored, self.nt_floor
+        )
 
     def _term(self, q: TermQuery, scoring: bool = True) -> tuple[tuple, Any]:
         fm = self.mappings.get(q.field_name)

@@ -1,0 +1,261 @@
+"""Shard-local search service: query phase + fetch phase.
+
+The analog of the reference's SearchService.executeQueryPhase →
+QueryPhase.execute → FetchPhase.execute pipeline (server/src/main/java/org/
+elasticsearch/search/SearchService.java:403, search/query/QueryPhase.java:122,
+search/fetch/FetchPhase.java:70), restructured for the TPU:
+
+- QUERY phase: each refreshed segment executes the compiled plan on device
+  (ops/bm25_device.execute); per-segment top-k + total hits come back as
+  small arrays. Segments share shard-level statistics (engine.field_stats)
+  so scoring is independent of segmentation, like Lucene's reader-level
+  term statistics.
+- REDUCE: per-segment top-k merge by (score desc, global doc id asc) —
+  the same ordering contract as the reference's coordinator mergeTopDocs
+  (action/search/SearchPhaseController.java:186).
+- FETCH phase: _source loading happens on host from the segment's stored
+  documents, exactly mirroring the query-then-fetch split (scores on
+  device, documents on host).
+
+Sorting by a field lowers to a device top-k over the doc-values column with
+missing-last semantics (search/sort/FieldSortBuilder in the reference).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from ..index.engine import Engine, SegmentHandle
+from ..ops import bm25_device
+from ..query.compile import FieldStats
+from ..query.dsl import MatchAllQuery, Query, parse_query
+
+
+@dataclass
+class SearchHit:
+    doc_id: str
+    score: float | None
+    source: dict[str, Any] | None
+    sort: list[Any] | None = None
+    global_doc: int = -1
+
+    def to_json(self, index_name: str = "index") -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "_index": index_name,
+            "_id": self.doc_id,
+            "_score": self.score,
+        }
+        if self.source is not None:
+            out["_source"] = self.source
+        if self.sort is not None:
+            out["sort"] = self.sort
+        return out
+
+
+@dataclass
+class SearchResponse:
+    took_ms: int
+    total: int
+    total_relation: str
+    max_score: float | None
+    hits: list[SearchHit]
+
+    def to_json(self, index_name: str = "index") -> dict[str, Any]:
+        return {
+            "took": self.took_ms,
+            "timed_out": False,
+            "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
+            "hits": {
+                "total": {"value": self.total, "relation": self.total_relation},
+                "max_score": self.max_score,
+                "hits": [h.to_json(index_name) for h in self.hits],
+            },
+        }
+
+
+@dataclass
+class SearchRequest:
+    query: Query = field(default_factory=MatchAllQuery)
+    size: int = 10
+    from_: int = 0
+    source_includes: bool | list[str] = True
+    sort: list[dict[str, str]] | None = None  # [{"field": "asc"|"desc"}]
+
+    @classmethod
+    def from_json(cls, body: dict[str, Any] | None) -> "SearchRequest":
+        body = body or {}
+        query = (
+            parse_query(body["query"]) if "query" in body else MatchAllQuery()
+        )
+        sort = None
+        if "sort" in body:
+            sort = []
+            raw = body["sort"]
+            if not isinstance(raw, list):
+                raw = [raw]
+            for entry in raw:
+                if isinstance(entry, str):
+                    sort.append({entry: "asc" if entry != "_score" else "desc"})
+                else:
+                    ((fname, spec),) = entry.items()
+                    order = (
+                        spec.get("order", "asc")
+                        if isinstance(spec, dict)
+                        else str(spec)
+                    )
+                    sort.append({fname: order})
+        source = body.get("_source", True)
+        if isinstance(source, str):  # ES accepts a single field name/pattern
+            source = [source]
+        return cls(
+            query=query,
+            size=int(body.get("size", 10)),
+            from_=int(body.get("from", 0)),
+            source_includes=source,
+            sort=sort,
+        )
+
+
+_NO_SORT = object()  # sentinel: hit carries no sort values (default score sort)
+
+
+class SearchService:
+    """Executes SearchRequests against one Engine (one shard)."""
+
+    def __init__(self, engine: Engine, index_name: str = "index"):
+        self.engine = engine
+        self.index_name = index_name
+
+    def search(self, request: SearchRequest) -> SearchResponse:
+        start = time.monotonic()
+        k = max(0, request.from_) + max(0, request.size)
+        stats = self.engine.field_stats()
+
+        # Candidate tuples: (merge_key, global_doc, handle, local, score,
+        # sort_value). merge_key ascending + global doc id ascending gives
+        # Lucene's ordering for both score sort (key = -score) and field sort.
+        candidates: list[tuple] = []
+        total = 0
+        for handle in self.engine.segments:
+            if handle.segment.num_docs == 0:
+                continue
+            total += self._query_segment(handle, request, k, stats, candidates)
+
+        candidates.sort(key=lambda c: (c[0], c[1]))
+        page = candidates[request.from_ : request.from_ + request.size]
+
+        hits = []
+        max_score = None
+        if request.sort is None and candidates:
+            max_score = -candidates[0][0]
+        for merge_key, global_doc, handle, local, score, sort_value in page:
+            hits.append(
+                SearchHit(
+                    doc_id=handle.segment.ids[local],
+                    score=score,
+                    source=self._fetch_source(handle, local, request),
+                    sort=None if sort_value is _NO_SORT else [sort_value],
+                    global_doc=global_doc,
+                )
+            )
+        took = int((time.monotonic() - start) * 1000)
+        return SearchResponse(
+            took_ms=took,
+            total=total,
+            total_relation="eq",
+            max_score=max_score,
+            hits=hits,
+        )
+
+    # ------------------------------------------------------------------ query
+
+    def _query_segment(
+        self,
+        handle: SegmentHandle,
+        request: SearchRequest,
+        k: int,
+        stats: dict[str, FieldStats],
+        candidates: list,
+    ) -> int:
+        compiler = self.engine.compiler_for(handle, stats)
+        compiled = compiler.compile(request.query)
+        seg_tree = bm25_device.segment_tree(handle.device)
+
+        sort_field = None
+        descending = False
+        if request.sort is not None:
+            if len(request.sort) > 1:
+                raise ValueError(
+                    "multi-key sort is not supported yet; got "
+                    f"{len(request.sort)} sort keys"
+                )
+            ((sort_field, order),) = request.sort[0].items()
+            descending = order == "desc"
+
+        if sort_field is None or sort_field == "_score":
+            ascending_score = sort_field == "_score" and not descending
+            if ascending_score:
+                # Bottom-k needs its own device reduction — the default
+                # top-k collector would never see the lowest-scoring hits.
+                scores, ids, tot = bm25_device.execute_score_asc(
+                    seg_tree, compiled.spec, compiled.arrays, k
+                )
+            else:
+                scores, ids, tot = bm25_device.execute(
+                    seg_tree, compiled.spec, compiled.arrays, k
+                )
+            scores, ids = np.asarray(scores), np.asarray(ids)
+            n = min(k, int(tot))
+            for rank in range(n):
+                score = float(scores[rank])
+                local = int(ids[rank])
+                if sort_field is None:
+                    key, sort_value = -score, _NO_SORT
+                else:
+                    key, sort_value = (score if ascending_score else -score), score
+                candidates.append(
+                    (key, handle.base + local, handle, local, score, sort_value)
+                )
+            return int(tot)
+
+        if sort_field not in handle.device.doc_values:
+            raise ValueError(
+                f"No mapping found for [{sort_field}] in order to sort on"
+            )
+        values, ids, tot = bm25_device.execute_sorted(
+            seg_tree, compiled.spec, compiled.arrays, sort_field, descending, k
+        )
+        values, ids = np.asarray(values), np.asarray(ids)
+        n = min(k, int(tot))
+        for rank in range(n):
+            local = int(ids[rank])
+            raw = float(values[rank])
+            missing = np.isnan(values[rank])
+            key = np.inf if missing else (-raw if descending else raw)
+            candidates.append(
+                (
+                    key,
+                    handle.base + local,
+                    handle,
+                    local,
+                    None,  # ES omits _score for field sorts by default
+                    None if missing else raw,
+                )
+            )
+        return int(tot)
+
+    # ------------------------------------------------------------------ fetch
+
+    def _fetch_source(
+        self, handle: SegmentHandle, local: int, request: SearchRequest
+    ) -> dict[str, Any] | None:
+        if request.source_includes is False:
+            return None
+        src = handle.segment.sources[local]
+        if request.source_includes is True:
+            return src
+        return {k: v for k, v in src.items() if k in set(request.source_includes)}

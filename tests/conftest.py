@@ -1,18 +1,37 @@
-"""Test environment: force an 8-device virtual CPU mesh before jax imports.
+"""Test environment: force an 8-device virtual CPU mesh.
 
 Multi-chip sharding paths are tested on virtual CPU devices (the driver
 separately dry-runs __graft_entry__.dryrun_multichip); real-TPU benchmarking
 happens via bench.py only.
+
+NOTE: setting the JAX_PLATFORMS env var is NOT enough in this image — the
+axon TPU plugin registers itself from sitecustomize at interpreter startup
+and calls jax.config.update("jax_platforms", "axon,cpu"), overriding the
+environment. We must update the config (and clear any initialized backends)
+after importing jax.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge as _xb
+
+if _xb.backends_are_initialized():  # pragma: no cover - defensive
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+
+assert len(jax.devices()) == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}"
+)
 
 import numpy as np
 import pytest

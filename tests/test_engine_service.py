@@ -1,0 +1,200 @@
+"""Engine (write path, refresh, deletes) + SearchService (query-then-fetch)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.search.service import SearchRequest, SearchService
+
+DOCS = [
+    {"title": "the quick brown fox", "tag": "animal", "rank": 10},
+    {"title": "quick quick fox jumps", "tag": "animal", "rank": 20},
+    {"title": "lazy dog sleeps all day", "tag": "animal", "rank": 5},
+    {"title": "brown bread recipe", "tag": "food", "rank": 30},
+    {"title": "quick bread with brown butter", "tag": "food", "rank": 15},
+    {"title": "fox hunting ban", "tag": "politics", "rank": 25},
+]
+
+
+def make_mappings():
+    return Mappings(
+        properties={
+            "title": {"type": "text"},
+            "tag": {"type": "keyword"},
+            "rank": {"type": "long"},
+        }
+    )
+
+
+def make_engine(docs=DOCS, refresh_every=None):
+    """Build an engine; refresh_every=n splits docs into multiple segments."""
+    engine = Engine(make_mappings())
+    for i, doc in enumerate(docs):
+        engine.index(doc, f"doc{i}")
+        if refresh_every and (i + 1) % refresh_every == 0:
+            engine.refresh()
+    engine.refresh()
+    return engine
+
+
+def search(engine, body, index_name="test"):
+    service = SearchService(engine, index_name)
+    return service.search(SearchRequest.from_json(body))
+
+
+def test_basic_match_search():
+    engine = make_engine()
+    resp = search(engine, {"query": {"match": {"title": "quick fox"}}})
+    assert resp.total == 4
+    assert resp.hits[0].doc_id in {"doc0", "doc1"}
+    assert resp.max_score == pytest.approx(resp.hits[0].score)
+    assert all(h.source is not None for h in resp.hits)
+
+
+def test_multi_segment_scores_match_single_segment():
+    """Segmentation must not change scores: shard-level stats like Lucene."""
+    one = search(make_engine(), {"query": {"match": {"title": "quick brown fox"}}})
+    many = search(
+        make_engine(refresh_every=2),
+        {"query": {"match": {"title": "quick brown fox"}}},
+    )
+    assert one.total == many.total
+    assert [h.doc_id for h in one.hits] == [h.doc_id for h in many.hits]
+    for a, b in zip(one.hits, many.hits):
+        assert a.score == pytest.approx(b.score, rel=1e-6)
+
+
+def test_delete_and_update():
+    engine = make_engine()
+    engine.delete("doc0")
+    engine.index({"title": "completely different now", "tag": "other", "rank": 99}, "doc1")
+    engine.refresh()
+    resp = search(engine, {"query": {"match": {"title": "quick fox"}}})
+    ids = {h.doc_id for h in resp.hits}
+    assert "doc0" not in ids and "doc1" not in ids
+    resp2 = search(engine, {"query": {"match": {"title": "completely different"}}})
+    assert [h.doc_id for h in resp2.hits] == ["doc1"]
+
+
+def test_delete_before_refresh_drops_buffered_doc():
+    engine = Engine(make_mappings())
+    engine.index({"title": "ephemeral doc"}, "tmp")
+    engine.delete("tmp")
+    engine.refresh()
+    assert engine.num_docs == 0
+    resp = search(engine, {"query": {"match_all": {}}})
+    assert resp.total == 0
+
+
+def test_realtime_get():
+    engine = Engine(make_mappings())
+    engine.index({"title": "unrefreshed"}, "a")
+    assert engine.get("a") == {"title": "unrefreshed"}  # from buffer
+    engine.refresh()
+    assert engine.get("a") == {"title": "unrefreshed"}  # from segment
+    assert engine.get("missing") is None
+
+
+def test_pagination():
+    engine = make_engine()
+    all_hits = search(engine, {"query": {"match_all": {}}, "size": 6})
+    page2 = search(engine, {"query": {"match_all": {}}, "size": 2, "from": 2})
+    assert [h.doc_id for h in page2.hits] == [
+        h.doc_id for h in all_hits.hits[2:4]
+    ]
+    assert page2.total == 6
+
+
+def test_sort_by_field_asc_desc():
+    engine = make_engine(refresh_every=2)
+    asc = search(engine, {"query": {"match_all": {}}, "sort": [{"rank": "asc"}]})
+    ranks = [h.sort[0] for h in asc.hits]
+    assert ranks == sorted(ranks)
+    assert asc.hits[0].doc_id == "doc2"  # rank 5
+    desc = search(engine, {"query": {"match_all": {}}, "sort": [{"rank": {"order": "desc"}}]})
+    assert desc.hits[0].doc_id == "doc3"  # rank 30
+    assert [h.doc_id for h in desc.hits] == [h.doc_id for h in asc.hits][::-1]
+
+
+def test_sort_missing_last():
+    engine = Engine(make_mappings())
+    engine.index({"title": "has rank", "rank": 1}, "a")
+    engine.index({"title": "no rank"}, "b")
+    engine.refresh()
+    resp = search(engine, {"query": {"match_all": {}}, "sort": [{"rank": "asc"}]})
+    assert [h.doc_id for h in resp.hits] == ["a", "b"]
+    assert resp.hits[1].sort == [None]
+
+
+def test_sort_filters_to_query_matches():
+    engine = make_engine()
+    resp = search(
+        engine,
+        {"query": {"term": {"tag": "food"}}, "sort": [{"rank": "asc"}]},
+    )
+    assert [h.doc_id for h in resp.hits] == ["doc4", "doc3"]
+
+
+def test_sort_score_asc_returns_lowest_scoring():
+    engine = make_engine()
+    desc = search(engine, {"query": {"match": {"title": "quick"}}, "size": 100})
+    asc = search(
+        engine,
+        {"query": {"match": {"title": "quick"}}, "sort": [{"_score": "asc"}], "size": 2},
+    )
+    # The two LOWEST-scoring hits, ascending.
+    want = [h.doc_id for h in sorted(desc.hits, key=lambda h: (h.score, h.global_doc))][:2]
+    assert [h.doc_id for h in asc.hits] == want
+
+
+def test_multi_key_sort_rejected():
+    engine = make_engine()
+    with pytest.raises(ValueError, match="multi-key sort"):
+        search(
+            engine,
+            {"query": {"match_all": {}}, "sort": [{"rank": "asc"}, {"tag": "desc"}]},
+        )
+
+
+def test_source_string_form():
+    engine = make_engine()
+    resp = search(engine, {"query": {"match_all": {}}, "_source": "title", "size": 1})
+    assert set(resp.hits[0].source.keys()) == {"title"}
+
+
+def test_source_filtering():
+    engine = make_engine()
+    resp = search(
+        engine, {"query": {"match_all": {}}, "_source": ["title"], "size": 1}
+    )
+    assert set(resp.hits[0].source.keys()) == {"title"}
+    resp2 = search(engine, {"query": {"match_all": {}}, "_source": False, "size": 1})
+    assert resp2.hits[0].source is None
+
+
+def test_response_json_shape():
+    engine = make_engine()
+    body = search(engine, {"query": {"match": {"title": "fox"}}}).to_json("test")
+    assert body["hits"]["total"] == {"value": 3, "relation": "eq"}
+    assert body["_shards"]["successful"] == 1
+    hit = body["hits"]["hits"][0]
+    assert {"_index", "_id", "_score", "_source"} <= set(hit)
+
+
+def test_empty_index_search():
+    engine = Engine(make_mappings())
+    engine.refresh()
+    resp = search(engine, {"query": {"match": {"title": "anything"}}})
+    assert resp.total == 0 and resp.hits == []
+
+
+def test_seqno_monotonic():
+    engine = Engine(make_mappings())
+    r1 = engine.index({"title": "a"}, "x")
+    r2 = engine.index({"title": "b"}, "y")
+    r3 = engine.delete("x")
+    assert r1["_seq_no"] < r2["_seq_no"] < r3["_seq_no"]
+    assert r3["result"] == "deleted"
+    r4 = engine.delete("never-existed")
+    assert r4["result"] == "not_found"
