@@ -1,0 +1,379 @@
+"""Node: the in-process root that owns every index and service.
+
+The analog of the reference's Node (server/src/main/java/org/elasticsearch/
+node/Node.java:202, wiring IndicesService → IndexService → IndexShard) plus
+the coordinator-side behavior of the core document/search/bulk transport
+actions, collapsed to a single-process form: each index is one Engine (one
+shard) fronted by a SearchService. The REST layer (rest/) calls into this
+object the way the reference's REST handlers call NodeClient.
+
+Versioned concurrency, replication, and multi-node membership live in later
+layers (parallel/ has the device-mesh story; host-level clustering is a
+control-plane concern).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from .index.engine import Engine
+from .index.mapping import Mappings
+from .ops.bm25 import BM25Params
+from .search.service import SearchRequest, SearchService
+
+
+class ApiError(Exception):
+    """An error with an HTTP status, rendered ES-style by the REST layer."""
+
+    def __init__(self, status: int, err_type: str, reason: str):
+        super().__init__(reason)
+        self.status = status
+        self.err_type = err_type
+        self.reason = reason
+
+
+def index_not_found(name: str) -> ApiError:
+    return ApiError(404, "index_not_found_exception", f"no such index [{name}]")
+
+
+_INDEX_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9_\-.]*$")
+
+
+@dataclass
+class IndexService:
+    """One index: mappings + engine + search service + settings."""
+
+    name: str
+    mappings: Mappings
+    engine: Engine
+    search: SearchService
+    settings: dict[str, Any] = field(default_factory=dict)
+    created_at: float = field(default_factory=time.time)
+
+    @property
+    def num_docs(self) -> int:
+        return self.engine.num_docs
+
+
+class Node:
+    def __init__(self, node_name: str = "node-0", cluster_name: str = "es-tpu"):
+        self.node_name = node_name
+        self.cluster_name = cluster_name
+        self.indices: dict[str, IndexService] = {}
+
+    # -------------------------------------------------------------- indices
+
+    def create_index(self, name: str, body: dict[str, Any] | None = None) -> dict:
+        if name in self.indices:
+            raise ApiError(
+                400,
+                "resource_already_exists_exception",
+                f"index [{name}] already exists",
+            )
+        if not _INDEX_NAME_RE.match(name):
+            raise ApiError(
+                400, "invalid_index_name_exception", f"invalid index name [{name}]"
+            )
+        body = body or {}
+        settings = body.get("settings", {})
+        params = BM25Params()
+        sim = (
+            settings.get("index", {})
+            .get("similarity", {})
+            .get("default", {})
+        )
+        if sim.get("type") in (None, "BM25"):
+            params = BM25Params(
+                k1=float(sim.get("k1", 1.2)), b=float(sim.get("b", 0.75))
+            )
+        try:
+            mappings = Mappings.from_json(body.get("mappings"))
+        except ValueError as e:
+            raise ApiError(400, "mapper_parsing_exception", str(e)) from None
+        engine = Engine(mappings, params=params)
+        self.indices[name] = IndexService(
+            name=name,
+            mappings=mappings,
+            engine=engine,
+            search=SearchService(engine, name),
+            settings=settings,
+        )
+        return {"acknowledged": True, "shards_acknowledged": True, "index": name}
+
+    def delete_index(self, name: str) -> dict:
+        if name not in self.indices:
+            raise index_not_found(name)
+        del self.indices[name]
+        return {"acknowledged": True}
+
+    def get_index(self, name: str, auto_create: bool = False) -> IndexService:
+        svc = self.indices.get(name)
+        if svc is None:
+            if not auto_create:
+                raise index_not_found(name)
+            # Dynamic index auto-creation on first document, like the
+            # reference's TransportBulkAction auto-create step.
+            self.create_index(name)
+            svc = self.indices[name]
+        return svc
+
+    def get_mapping(self, name: str) -> dict:
+        svc = self.get_index(name)
+        return {name: {"mappings": svc.mappings.to_json()}}
+
+    def put_mapping(self, name: str, body: dict[str, Any]) -> dict:
+        svc = self.get_index(name)
+        for fname, spec in (body.get("properties") or {}).items():
+            existing = svc.mappings.get(fname)
+            new = Mappings._parse_field(fname, spec)
+            if existing is not None and existing.type != new.type:
+                raise ApiError(
+                    400,
+                    "illegal_argument_exception",
+                    f"mapper [{fname}] cannot be changed from type "
+                    f"[{existing.type}] to [{new.type}]",
+                )
+            svc.mappings.fields[fname] = new
+        return {"acknowledged": True}
+
+    # ------------------------------------------------------------ documents
+
+    def index_doc(
+        self,
+        index: str,
+        source: dict[str, Any],
+        doc_id: str | None = None,
+        refresh: bool = False,
+    ) -> dict:
+        svc = self.get_index(index, auto_create=True)
+        try:
+            result = svc.engine.index(source, doc_id)
+        except ValueError as e:
+            raise ApiError(400, "mapper_parsing_exception", str(e)) from None
+        if refresh:
+            svc.engine.refresh()
+        return {
+            "_index": index,
+            "_id": result["_id"],
+            "_version": 1,
+            "result": result["result"],
+            "_seq_no": result["_seq_no"],
+            "_primary_term": 1,
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+        }
+
+    def get_doc(self, index: str, doc_id: str) -> dict:
+        svc = self.get_index(index)
+        source = svc.engine.get(doc_id)
+        if source is None:
+            return {"_index": index, "_id": doc_id, "found": False}
+        return {
+            "_index": index,
+            "_id": doc_id,
+            "_version": 1,
+            "found": True,
+            "_source": source,
+        }
+
+    def delete_doc(self, index: str, doc_id: str, refresh: bool = False) -> dict:
+        svc = self.get_index(index)
+        result = svc.engine.delete(doc_id)
+        if refresh:
+            svc.engine.refresh()
+        status = "deleted" if result["result"] == "deleted" else "not_found"
+        return {
+            "_index": index,
+            "_id": doc_id,
+            "result": status,
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+        }
+
+    def update_doc(
+        self, index: str, doc_id: str, body: dict[str, Any], refresh: bool = False
+    ) -> dict:
+        """Partial update: realtime get + merge + reindex (the reference's
+        TransportUpdateAction/UpdateHelper flow, action/update/)."""
+        svc = self.get_index(index)
+        existing = svc.engine.get(doc_id)
+        if existing is None:
+            if "upsert" in body:
+                merged = dict(body["upsert"])
+                merged.update(body.get("doc", {}))
+            elif body.get("doc_as_upsert") and "doc" in body:
+                merged = dict(body["doc"])
+            else:
+                raise ApiError(
+                    404,
+                    "document_missing_exception",
+                    f"[{doc_id}]: document missing",
+                )
+        else:
+            merged = dict(existing)
+            merged.update(body.get("doc", {}))
+        result = svc.engine.index(merged, doc_id)
+        if refresh:
+            svc.engine.refresh()
+        return {
+            "_index": index,
+            "_id": doc_id,
+            "result": "updated" if existing is not None else "created",
+            "_seq_no": result["_seq_no"],
+        }
+
+    # ----------------------------------------------------------------- bulk
+
+    def bulk(self, body: str, default_index: str | None = None, refresh=False) -> dict:
+        """NDJSON bulk: index/create/delete/update action lines.
+
+        Mirrors TransportBulkAction's per-item independent outcomes
+        (action/bulk/TransportBulkAction.java): one bad item doesn't fail
+        the request."""
+        t0 = time.monotonic()
+        lines = [ln for ln in body.split("\n") if ln.strip()]
+        items = []
+        errors = False
+        touched: set[str] = set()
+        i = 0
+        while i < len(lines):
+            try:
+                action_line = json.loads(lines[i])
+            except json.JSONDecodeError as e:
+                raise ApiError(
+                    400, "illegal_argument_exception", f"malformed action line: {e}"
+                ) from None
+            ((op, meta),) = action_line.items()
+            index = meta.get("_index", default_index)
+            doc_id = meta.get("_id")
+            i += 1
+            try:
+                if op in ("index", "create"):
+                    source = json.loads(lines[i])
+                    i += 1
+                    if (
+                        op == "create"
+                        and doc_id is not None
+                        and index in self.indices
+                        and self.indices[index].engine.get(doc_id) is not None
+                    ):
+                        raise ApiError(
+                            409,
+                            "version_conflict_engine_exception",
+                            f"[{doc_id}]: version conflict, document already exists",
+                        )
+                    resp = self.index_doc(index, source, doc_id)
+                    touched.add(index)
+                    status = 201 if resp["result"] == "created" else 200
+                    items.append({op: {**resp, "status": status}})
+                elif op == "delete":
+                    resp = self.delete_doc(index, doc_id)
+                    touched.add(index)
+                    status = 200 if resp["result"] == "deleted" else 404
+                    items.append({op: {**resp, "status": status}})
+                elif op == "update":
+                    body_line = json.loads(lines[i])
+                    i += 1
+                    resp = self.update_doc(index, doc_id, body_line)
+                    touched.add(index)
+                    items.append({op: {**resp, "status": 200}})
+                else:
+                    raise ApiError(
+                        400,
+                        "illegal_argument_exception",
+                        f"Malformed action/metadata line, expected one of "
+                        f"[create, delete, index, update] but found [{op}]",
+                    )
+            except ApiError as e:
+                errors = True
+                items.append(
+                    {
+                        op: {
+                            "_index": index,
+                            "_id": doc_id,
+                            "status": e.status,
+                            "error": {"type": e.err_type, "reason": e.reason},
+                        }
+                    }
+                )
+        if refresh:
+            for index in touched:
+                if index in self.indices:
+                    self.indices[index].engine.refresh()
+        return {
+            "took": int((time.monotonic() - t0) * 1000),
+            "errors": errors,
+            "items": items,
+        }
+
+    # --------------------------------------------------------------- search
+
+    def search(self, index: str, body: dict[str, Any] | None) -> dict:
+        svc = self.get_index(index)
+        try:
+            request = SearchRequest.from_json(body)
+            response = svc.search.search(request)
+        except ValueError as e:
+            raise ApiError(400, "search_phase_execution_exception", str(e)) from None
+        return response.to_json(index)
+
+    def count(self, index: str, body: dict[str, Any] | None) -> dict:
+        body = dict(body or {})
+        body["size"] = 0
+        result = self.search(index, body)
+        return {
+            "count": result["hits"]["total"]["value"],
+            "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
+        }
+
+    def refresh(self, index: str) -> dict:
+        svc = self.get_index(index)
+        svc.engine.refresh()
+        return {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    # ---------------------------------------------------------------- admin
+
+    def cluster_health(self) -> dict:
+        return {
+            "cluster_name": self.cluster_name,
+            "status": "green",
+            "timed_out": False,
+            "number_of_nodes": 1,
+            "number_of_data_nodes": 1,
+            "active_primary_shards": len(self.indices),
+            "active_shards": len(self.indices),
+            "relocating_shards": 0,
+            "initializing_shards": 0,
+            "unassigned_shards": 0,
+            "active_shards_percent_as_number": 100.0,
+        }
+
+    def cat_indices(self) -> list[dict]:
+        return [
+            {
+                "health": "green",
+                "status": "open",
+                "index": name,
+                "pri": "1",
+                "rep": "0",
+                "docs.count": str(svc.num_docs),
+            }
+            for name, svc in sorted(self.indices.items())
+        ]
+
+    def stats(self) -> dict:
+        return {
+            "_all": {
+                "primaries": {
+                    "docs": {
+                        "count": sum(s.num_docs for s in self.indices.values())
+                    }
+                }
+            },
+            "indices": {
+                name: {"primaries": {"docs": {"count": svc.num_docs}}}
+                for name, svc in self.indices.items()
+            },
+        }

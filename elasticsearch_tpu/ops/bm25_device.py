@@ -120,7 +120,38 @@ def _eval_node(spec, arrays, seg: dict[str, Any], num_docs: int):
         )
     if kind == "bool":
         return _eval_bool(spec, arrays, seg, num_docs)
+    if kind == "script":
+        return _eval_script(spec, arrays, seg, num_docs)
     raise ValueError(f"unknown plan node kind [{kind}]")
+
+
+def _eval_script(spec, arrays, seg, num_docs):
+    """script_score: replace the child's score with a traced expression.
+
+    The painless-lite script evaluates as jnp array ops over ALL docs at
+    once (compilation happens at trace time, so the expression fuses into
+    the surrounding XLA program; x-pack vector functions become matmuls on
+    the MXU)."""
+    from ..script import compile_script
+
+    _, child_spec, source, _param_names, has_min_score = spec
+    child_scores, matched = _eval_node(child_spec, arrays["child"], seg, num_docs)
+    script = compile_script(source)
+    result = script.evaluate(
+        jnp,
+        child_scores,
+        seg["doc_values"],
+        seg.get("vectors", {}),
+        arrays["params"],
+    )
+    result = jnp.broadcast_to(
+        jnp.asarray(result, dtype=jnp.float32), (num_docs,)
+    )
+    scores = jnp.where(matched, result * arrays["boost"], jnp.float32(0.0))
+    if has_min_score:
+        matched = matched & (scores >= arrays["min_score"])
+        scores = jnp.where(matched, scores, jnp.float32(0.0))
+    return scores, matched
 
 
 def _gather_tiles(spec, arrays, seg, want: str = "tn"):
@@ -357,6 +388,22 @@ def execute_dense(seg, spec, arrays):
     return jnp.where(eligible, scores, jnp.float32(0.0)), eligible
 
 
+@partial(jax.jit, static_argnames=("spec",))
+def scores_at(seg, spec, arrays, ids):
+    """Evaluate a query and gather (scores, matched) at specific doc ids.
+
+    The rescore-phase primitive (the reference's QueryRescorer re-scores
+    only the top-window docs, action/search + search/rescore/RescorePhase):
+    dense evaluation stays on device; only the window is gathered out.
+    """
+    live = seg["live"]
+    num_docs = live.shape[0]
+    scores, matched = _eval_node(spec, arrays, seg, num_docs)
+    eligible = matched & live
+    scores = jnp.where(eligible, scores, jnp.float32(0.0))
+    return scores[ids], eligible[ids]
+
+
 def segment_tree(device_segment) -> dict[str, Any]:
     """Build the jit-input pytree view of a DeviceSegment."""
     return {
@@ -365,5 +412,6 @@ def segment_tree(device_segment) -> dict[str, Any]:
             for name, f in device_segment.fields.items()
         },
         "doc_values": dict(device_segment.doc_values),
+        "vectors": dict(device_segment.vectors),
         "live": device_segment.live,
     }

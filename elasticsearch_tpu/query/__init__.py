@@ -7,6 +7,7 @@ from .dsl import (  # noqa: F401
     MatchQuery,
     Query,
     RangeQuery,
+    ScriptScoreQuery,
     TermQuery,
     TermsQuery,
     parse_query,

@@ -41,6 +41,7 @@ from .dsl import (
     MatchQuery,
     Query,
     RangeQuery,
+    ScriptScoreQuery,
     TermQuery,
     TermsQuery,
 )
@@ -243,7 +244,34 @@ class Compiler:
             }
         if isinstance(q, BoolQuery):
             return self._bool(q, scoring)
+        if isinstance(q, ScriptScoreQuery):
+            return self._script_score(q, scoring)
         raise ValueError(f"cannot compile query type {type(q).__name__}")
+
+    def _script_score(self, q: ScriptScoreQuery, scoring: bool) -> tuple[tuple, Any]:
+        from ..script import compile_script
+
+        compile_script(q.source)  # validate at plan time (parse errors 400)
+        child_spec, child_arrays = self._node(q.query, scoring)
+        param_names = tuple(sorted(q.params))
+        spec = (
+            "script",
+            child_spec,
+            q.source,
+            param_names,
+            q.min_score is not None,
+        )
+        arrays = {
+            "child": child_arrays,
+            "params": {
+                name: np.asarray(q.params[name], dtype=np.float32)
+                for name in param_names
+            },
+            "boost": np.float32(q.boost),
+        }
+        if q.min_score is not None:
+            arrays["min_score"] = np.float32(q.min_score)
+        return spec, arrays
 
     def _field_or_none(self, name: str) -> DeviceField | None:
         return self.fields.get(name)

@@ -99,6 +99,23 @@ class ConstantScoreQuery(Query):
 
 
 @dataclass
+class ScriptScoreQuery(Query):
+    """Replace the child query's score with a script-computed one.
+
+    Mirrors the reference's script_score query (search/SearchModule.java
+    registry; script contexts in server/.../script/ScoreScript.java) with
+    the painless-lite expression subset, including the x-pack vector
+    functions used for brute-force kNN (BASELINE config 5).
+    """
+
+    query: Query = None  # type: ignore[assignment]
+    source: str = ""
+    params: dict = field(default_factory=dict)
+    boost: float = 1.0
+    min_score: float | None = None
+
+
+@dataclass
 class BoolQuery(Query):
     """Boolean combination, mirroring BoolQueryBuilder semantics:
 
@@ -179,6 +196,15 @@ def parse_query(body: dict[str, Any]) -> Query:
     if kind == "constant_score":
         return ConstantScoreQuery(
             filter=parse_query(spec["filter"]), boost=_pop_boost(spec)
+        )
+    if kind == "script_score":
+        script = spec.get("script", {})
+        return ScriptScoreQuery(
+            query=parse_query(spec["query"]),
+            source=str(script.get("source", "")),
+            params=dict(script.get("params", {})),
+            boost=_pop_boost(spec),
+            min_score=spec.get("min_score"),
         )
     if kind == "bool":
         def _clauses(key: str) -> list[Query]:

@@ -1,0 +1,1 @@
+from .server import RestServer, create_server  # noqa: F401

@@ -1,0 +1,258 @@
+"""HTTP/REST layer: Elasticsearch-compatible endpoints over a Node.
+
+The analog of the reference's RestController dispatch (server/src/main/java/
+org/elasticsearch/rest/RestController.java:57) + the per-API Rest*Action
+handlers, on the stdlib threading HTTP server (the reference uses Netty4;
+the serving hot path here is the device, not the socket layer).
+
+Routes (subset mirroring rest-api-spec/):
+    GET  /                                   — node banner
+    GET  /_cluster/health                    — health
+    GET  /_cat/indices[?format=json]         — cat API
+    GET  /_stats                             — docs stats
+    PUT  /{index}                            — create index
+    DELETE /{index}                          — delete index
+    GET  /{index}/_mapping | PUT             — mappings
+    PUT|POST /{index}/_doc/{id} | POST /{index}/_doc — index document
+    GET  /{index}/_doc/{id}                  — realtime get
+    DELETE /{index}/_doc/{id}                — delete document
+    POST /{index}/_update/{id}               — partial update
+    POST /[{index}/]_bulk                    — NDJSON bulk
+    GET|POST /{index}/_search                — search
+    GET|POST /{index}/_count                 — count
+    POST /{index}/_refresh                   — refresh
+    GET|POST /{index}/_rank_eval             — relevance evaluation
+    POST /{index}/_analyze                   — analysis debugging
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+from urllib.parse import parse_qs, urlparse
+
+from ..node import ApiError, Node
+from ..search import rank_eval
+
+Handler = Callable[["RestServer", dict, dict, Any], Any]
+
+
+def _json(body: str) -> dict:
+    if not body or not body.strip():
+        return {}
+    return json.loads(body)
+
+
+class RestServer:
+    def __init__(self, node: Node | None = None):
+        self.node = node or Node()
+        self.routes: list[tuple[str, re.Pattern, Handler]] = []
+        self._register_routes()
+
+    def route(self, method: str, pattern: str, handler: Handler) -> None:
+        # {name} → named group; index names can't start with _ so the
+        # literal _-prefixed routes must be registered first.
+        regex = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern)
+        self.routes.append((method, re.compile(f"^{regex}$"), handler))
+
+    def _register_routes(self) -> None:
+        n = self.node
+        r = self.route
+        r("GET", "/", lambda s, p, q, b: {
+            "name": n.node_name,
+            "cluster_name": n.cluster_name,
+            "version": {"number": "8.0.0-tpu", "distribution": "elasticsearch-tpu"},
+            "tagline": "You Know, for (TPU) Search",
+        })
+        r("GET", "/_cluster/health", lambda s, p, q, b: n.cluster_health())
+        r("GET", "/_cat/indices", lambda s, p, q, b: n.cat_indices())
+        r("GET", "/_stats", lambda s, p, q, b: n.stats())
+        r("POST", "/_bulk", lambda s, p, q, b: n.bulk(
+            b, refresh=q.get("refresh") in ("true", "")
+        ))
+        r("POST", "/{index}/_bulk", lambda s, p, q, b: n.bulk(
+            b, default_index=p["index"], refresh=q.get("refresh") in ("true", "")
+        ))
+        r("GET", "/{index}/_mapping", lambda s, p, q, b: n.get_mapping(p["index"]))
+        r("PUT", "/{index}/_mapping", lambda s, p, q, b: n.put_mapping(
+            p["index"], _json(b)
+        ))
+        for method in ("GET", "POST"):
+            r(method, "/{index}/_search", lambda s, p, q, b: n.search(
+                p["index"], _json(b)
+            ))
+            r(method, "/{index}/_count", lambda s, p, q, b: n.count(
+                p["index"], _json(b)
+            ))
+            r(method, "/{index}/_rank_eval", lambda s, p, q, b: rank_eval.evaluate(
+                n, p["index"], _json(b)
+            ))
+        r("POST", "/{index}/_refresh", lambda s, p, q, b: n.refresh(p["index"]))
+        r("GET", "/{index}/_refresh", lambda s, p, q, b: n.refresh(p["index"]))
+        r("POST", "/{index}/_analyze", self._analyze)
+        r("POST", "/{index}/_doc", lambda s, p, q, b: n.index_doc(
+            p["index"], _json(b), None, refresh=q.get("refresh") in ("true", "")
+        ))
+        for method in ("PUT", "POST"):
+            r(method, "/{index}/_doc/{id}", lambda s, p, q, b: n.index_doc(
+                p["index"], _json(b), p["id"],
+                refresh=q.get("refresh") in ("true", ""),
+            ))
+            r(method, "/{index}/_create/{id}", self._create_doc)
+        r("GET", "/{index}/_doc/{id}", lambda s, p, q, b: n.get_doc(
+            p["index"], p["id"]
+        ))
+        r("DELETE", "/{index}/_doc/{id}", lambda s, p, q, b: n.delete_doc(
+            p["index"], p["id"], refresh=q.get("refresh") in ("true", "")
+        ))
+        r("POST", "/{index}/_update/{id}", lambda s, p, q, b: n.update_doc(
+            p["index"], p["id"], _json(b),
+            refresh=q.get("refresh") in ("true", ""),
+        ))
+        r("PUT", "/{index}", lambda s, p, q, b: n.create_index(
+            p["index"], _json(b)
+        ))
+        r("DELETE", "/{index}", lambda s, p, q, b: n.delete_index(p["index"]))
+
+    def _create_doc(self, s, p, q, b):
+        svc = self.node.indices.get(p["index"])
+        if svc is not None and svc.engine.get(p["id"]) is not None:
+            raise ApiError(
+                409,
+                "version_conflict_engine_exception",
+                f"[{p['id']}]: version conflict, document already exists",
+            )
+        return self.node.index_doc(
+            p["index"], _json(b), p["id"],
+            refresh=q.get("refresh") in ("true", ""),
+        )
+
+    def _analyze(self, s, p, q, b):
+        body = _json(b) or {}
+        svc = self.node.get_index(p["index"])
+        analyzer_name = body.get("analyzer")
+        if analyzer_name:
+            analyzer = svc.mappings.analysis.get(analyzer_name)
+        elif "field" in body:
+            analyzer = svc.mappings.analyzer_for(body["field"])
+        else:
+            analyzer = svc.mappings.analysis.get("standard")
+        text = body.get("text", "")
+        if isinstance(text, list):
+            text = " ".join(text)
+        tokens = analyzer.analyze(text)
+        return {
+            "tokens": [
+                {"token": t, "position": i} for i, t in enumerate(tokens)
+            ]
+        }
+
+    # ------------------------------------------------------------- dispatch
+
+    def dispatch(self, method: str, path: str, query: dict, body: str):
+        """Returns (status, payload). ES-style error payloads on failure."""
+        try:
+            for m, regex, handler in self.routes:
+                if m != method:
+                    continue
+                match = regex.match(path)
+                if match:
+                    result = handler(self, match.groupdict(), query, body)
+                    return 200, result
+            raise ApiError(
+                405,
+                "invalid_request",
+                f"Incorrect HTTP method or unknown route [{method} {path}]",
+            )
+        except ApiError as e:
+            return e.status, {
+                "error": {
+                    "type": e.err_type,
+                    "reason": e.reason,
+                    "root_cause": [{"type": e.err_type, "reason": e.reason}],
+                },
+                "status": e.status,
+            }
+        except json.JSONDecodeError as e:
+            return 400, {
+                "error": {"type": "parsing_exception", "reason": str(e)},
+                "status": 400,
+            }
+        except ValueError as e:
+            return 400, {
+                "error": {"type": "illegal_argument_exception", "reason": str(e)},
+                "status": 400,
+            }
+
+    def serve(self, host: str = "127.0.0.1", port: int = 9200):
+        """Run a threading HTTP server (blocking). Returns the server."""
+        rest = self
+
+        class RequestHandler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _handle(self):
+                parsed = urlparse(self.path)
+                query = {
+                    key: vals[0] for key, vals in parse_qs(
+                        parsed.query, keep_blank_values=True
+                    ).items()
+                }
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length).decode("utf-8") if length else ""
+                status, payload = rest.dispatch(
+                    self.command, parsed.path.rstrip("/") or "/", query, body
+                )
+                data = json.dumps(payload).encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("X-elastic-product", "Elasticsearch")
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _handle
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        server = ThreadingHTTPServer((host, port), RequestHandler)
+        return server
+
+
+def create_server(host: str = "127.0.0.1", port: int = 9200):
+    """(http_server, rest) pair; call http_server.serve_forever() to run."""
+    rest = RestServer()
+    return rest.serve(host, port), rest
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser(description="elasticsearch-tpu node")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=9200)
+    args = parser.parse_args()
+    server, rest = create_server(args.host, args.port)
+    print(
+        json.dumps(
+            {
+                "message": "started",
+                "host": args.host,
+                "port": args.port,
+                "node": rest.node.node_name,
+            }
+        ),
+        flush=True,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from .painless_lite import CompiledScript, compile_script  # noqa: F401

@@ -1,0 +1,247 @@
+"""painless-lite: a vectorizable subset of the reference's script language.
+
+The reference compiles Painless (modules/lang-painless/, ANTLR grammar →
+JVM bytecode) and evaluates scripts doc-at-a-time through ScoreScript
+(server/.../script/ScoreScript.java). A TPU can't branch per document, so
+this engine supports the *expression* subset that covers the score-script
+idioms in BASELINE.md configs 4-5 — arithmetic over `_score`, doc values,
+params, Math functions, and the x-pack vector functions
+(x-pack/plugin/vectors/.../query/ScoreScriptUtils.java:
+cosineSimilarity / dotProduct / l2norm) — and evaluates it over ALL
+documents at once as array ops.
+
+Compilation path: the (painless-compatible) source is parsed with Python's
+`ast` after trivial syntax normalization, validated against a node
+whitelist, then evaluated with numpy or jax.numpy arrays bound to `_score`
+and `doc[...]` — the same compiled object runs on host (oracle) and under
+jit (device), so scripts are traced, not interpreted per doc.
+
+Supported grammar:
+    literals, + - * / % unary-, parentheses, ternary `a ? b : c` (via
+    Python `b if a else c` after normalization), comparisons,
+    _score, params.NAME (or params['NAME']), doc['field'].value,
+    Math.log/log10/sqrt/abs/exp/pow/min/max/floor/ceil,
+    cosineSimilarity(params.qv, 'field'), dotProduct(...), l2norm(...),
+    sigmoid(x), saturation(x, k) (rank-feature helpers).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Any, Callable
+
+_ALLOWED_NODES = (
+    ast.Expression,
+    ast.BinOp,
+    ast.UnaryOp,
+    ast.Add,
+    ast.Sub,
+    ast.Mult,
+    ast.Div,
+    ast.Mod,
+    ast.Pow,
+    ast.USub,
+    ast.UAdd,
+    ast.Call,
+    ast.Attribute,
+    ast.Subscript,
+    ast.Name,
+    ast.Constant,
+    ast.IfExp,
+    ast.Compare,
+    ast.Gt,
+    ast.GtE,
+    ast.Lt,
+    ast.LtE,
+    ast.Eq,
+    ast.NotEq,
+    ast.BoolOp,
+    ast.And,
+    ast.Or,
+    ast.Load,
+)
+
+_ALLOWED_NAMES = frozenset(
+    {
+        "_score",
+        "params",
+        "doc",
+        "Math",
+        "cosineSimilarity",
+        "dotProduct",
+        "l2norm",
+        "sigmoid",
+        "saturation",
+        "where",
+        "True",
+        "False",
+    }
+)
+
+# `a ? b : c` → `(b) if (a) else (c)`; applied repeatedly for nesting.
+_TERNARY_RE = re.compile(r"([^?]+)\?([^:]+):(.+)")
+
+
+def _normalize(source: str) -> str:
+    src = source.strip().rstrip(";")
+    # Painless allows `return expr;` for score scripts.
+    if src.startswith("return "):
+        src = src[len("return ") :].rstrip(";")
+    while "?" in src:
+        m = _TERNARY_RE.fullmatch(src)
+        if not m:
+            break
+        cond, then, other = m.groups()
+        src = f"(({then.strip()}) if ({cond.strip()}) else ({other.strip()}))"
+    # Java booleans / null.
+    src = re.sub(r"\btrue\b", "True", src)
+    src = re.sub(r"\bfalse\b", "False", src)
+    return src
+
+
+class _Params:
+    def __init__(self, values: dict[str, Any]):
+        self._values = values
+
+    def __getattr__(self, name: str):
+        try:
+            return self._values[name]
+        except KeyError:
+            raise ValueError(f"script params has no entry [{name}]") from None
+
+    def __getitem__(self, name: str):
+        return getattr(self, name)
+
+
+class _DocValue:
+    def __init__(self, col):
+        self.value = col
+
+    @property
+    def empty(self):  # doc['f'].empty — NaN means missing
+        import numpy as np
+
+        return np.isnan(self.value)
+
+
+class _Doc:
+    def __init__(self, columns: dict[str, Any]):
+        self._columns = columns
+
+    def __getitem__(self, field: str) -> _DocValue:
+        if field not in self._columns:
+            raise ValueError(
+                f"No field found for [{field}] in mapping (script doc access)"
+            )
+        return _DocValue(self._columns[field])
+
+
+@dataclass(frozen=True)
+class CompiledScript:
+    """A validated, reusable score expression."""
+
+    source: str
+    _tree: ast.Expression
+
+    def evaluate(
+        self,
+        xp,  # numpy or jax.numpy module
+        score,  # [N] array bound to _score
+        doc_columns: dict[str, Any],  # field -> [N] numeric column
+        vectors: dict[str, Any],  # field -> [N, D] matrix
+        params: dict[str, Any],
+    ):
+        """Evaluate over all docs at once; returns an [N] array."""
+
+        def _vec(field: str):
+            if field not in vectors:
+                raise ValueError(f"no dense_vector field [{field}]")
+            return vectors[field]
+
+        def cosine_similarity(qv, field):
+            v = _vec(field)
+            q = xp.asarray(qv, dtype=xp.float32)
+            vnorm = xp.sqrt(xp.sum(v * v, axis=-1))
+            qnorm = xp.sqrt(xp.sum(q * q))
+            denom = vnorm * qnorm
+            return xp.where(denom > 0, (v @ q) / denom, xp.float32(0.0))
+
+        def dot_product(qv, field):
+            q = xp.asarray(qv, dtype=xp.float32)
+            return _vec(field) @ q
+
+        def l2norm(qv, field):
+            q = xp.asarray(qv, dtype=xp.float32)
+            d = _vec(field) - q
+            return xp.sqrt(xp.sum(d * d, axis=-1))
+
+        class MathNS:
+            log = staticmethod(xp.log)
+            log10 = staticmethod(xp.log10)
+            sqrt = staticmethod(xp.sqrt)
+            abs = staticmethod(xp.abs)
+            exp = staticmethod(xp.exp)
+            floor = staticmethod(xp.floor)
+            ceil = staticmethod(xp.ceil)
+            pow = staticmethod(xp.power)
+            min = staticmethod(xp.minimum)
+            max = staticmethod(xp.maximum)
+            E = 2.718281828459045
+            PI = 3.141592653589793
+
+        env = {
+            "_score": score,
+            "params": _Params(params),
+            "doc": _Doc(doc_columns),
+            "Math": MathNS,
+            "cosineSimilarity": cosine_similarity,
+            "dotProduct": dot_product,
+            "l2norm": l2norm,
+            "sigmoid": lambda x: 1.0 / (1.0 + xp.exp(-x)),
+            "saturation": lambda x, k: x / (x + k),
+            "where": xp.where,
+            "True": True,
+            "False": False,
+        }
+        code = compile(self._tree, "<painless-lite>", "eval")
+        return eval(code, {"__builtins__": {}}, env)  # noqa: S307
+
+
+def compile_script(source: str) -> CompiledScript:
+    """Parse + validate a painless-lite expression (raises ValueError)."""
+    normalized = _normalize(source)
+    try:
+        tree = ast.parse(normalized, mode="eval")
+    except SyntaxError as e:
+        raise ValueError(
+            f"cannot compile script [{source}]: painless-lite supports "
+            f"expressions only ({e.msg})"
+        ) from None
+    for node in ast.walk(tree):
+        if not isinstance(node, _ALLOWED_NODES):
+            raise ValueError(
+                f"cannot compile script [{source}]: disallowed construct "
+                f"[{type(node).__name__}]"
+            )
+        if isinstance(node, ast.Name) and node.id not in _ALLOWED_NAMES:
+            raise ValueError(
+                f"cannot compile script [{source}]: unknown identifier "
+                f"[{node.id}]"
+            )
+    # Ternaries become vectorized selects (`where`) so per-doc conditions
+    # work both in numpy and under jit (a Python `if` on a traced array
+    # would fail).
+    tree = ast.fix_missing_locations(_TernaryToWhere().visit(tree))
+    return CompiledScript(source=source, _tree=tree)
+
+
+class _TernaryToWhere(ast.NodeTransformer):
+    def visit_IfExp(self, node: ast.IfExp) -> ast.AST:
+        self.generic_visit(node)
+        return ast.Call(
+            func=ast.Name(id="where", ctx=ast.Load()),
+            args=[node.test, node.body, node.orelse],
+            keywords=[],
+        )

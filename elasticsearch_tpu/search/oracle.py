@@ -34,6 +34,7 @@ from ..query.dsl import (
     MatchQuery,
     Query,
     RangeQuery,
+    ScriptScoreQuery,
     TermQuery,
     TermsQuery,
 )
@@ -103,7 +104,31 @@ class OracleSearcher:
             )
         if isinstance(q, BoolQuery):
             return self._bool(q)
+        if isinstance(q, ScriptScoreQuery):
+            return self._script_score(q)
         raise ValueError(f"oracle cannot evaluate {type(q).__name__}")
+
+    def _script_score(self, q: ScriptScoreQuery):
+        from ..script import compile_script
+
+        child_scores, matched = self._eval(q.query)
+        script = compile_script(q.source)
+        # f32 columns to match the device's doc-value storage contract.
+        columns = {
+            name: col.astype(np.float32)
+            for name, col in self.segment.doc_values.items()
+        }
+        result = script.evaluate(
+            np, child_scores, columns, self.segment.vectors, q.params
+        )
+        result = np.broadcast_to(
+            np.asarray(result, dtype=np.float32), matched.shape
+        )
+        scores = np.where(matched, result * np.float32(q.boost), np.float32(0.0))
+        if q.min_score is not None:
+            matched = matched & (scores >= np.float32(q.min_score))
+            scores = np.where(matched, scores, np.float32(0.0))
+        return scores.astype(np.float32), matched
 
     def _match(self, q: MatchQuery):
         if q.analyzer:

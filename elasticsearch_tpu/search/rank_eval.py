@@ -1,0 +1,158 @@
+"""Relevance evaluation metrics: the `_rank_eval` API.
+
+Rebuilds the reference's rank-eval module (modules/rank-eval/src/main/java/
+org/elasticsearch/index/rankeval/: PrecisionAtK.java, RecallAtK.java,
+MeanReciprocalRank.java, DiscountedCumulativeGain.java,
+ExpectedReciprocalRank.java) — the in-repo tooling BASELINE.md names for
+the recall@10-vs-Lucene acceptance check.
+
+Each metric consumes the ranked hit ids for a request plus its rated
+documents and returns a per-request score; the API response averages over
+requests like the reference's RankEvalResponse.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass
+class RatedDoc:
+    doc_id: str
+    rating: int
+
+
+def precision_at_k(
+    hits: list[str], rated: dict[str, int], k: int, relevant_rating_threshold: int = 1
+) -> float:
+    top = hits[:k]
+    if not top:
+        return 0.0
+    relevant = sum(
+        1 for h in top if rated.get(h, 0) >= relevant_rating_threshold
+    )
+    return relevant / len(top)
+
+
+def recall_at_k(
+    hits: list[str], rated: dict[str, int], k: int, relevant_rating_threshold: int = 1
+) -> float:
+    total_relevant = sum(
+        1 for r in rated.values() if r >= relevant_rating_threshold
+    )
+    if total_relevant == 0:
+        return 0.0
+    found = sum(
+        1 for h in hits[:k] if rated.get(h, 0) >= relevant_rating_threshold
+    )
+    return found / total_relevant
+
+
+def mean_reciprocal_rank(
+    hits: list[str], rated: dict[str, int], k: int, relevant_rating_threshold: int = 1
+) -> float:
+    for rank, h in enumerate(hits[:k], start=1):
+        if rated.get(h, 0) >= relevant_rating_threshold:
+            return 1.0 / rank
+    return 0.0
+
+
+def dcg_at_k(
+    hits: list[str], rated: dict[str, int], k: int, normalize: bool = False
+) -> float:
+    """DCG with the reference's gain formula (2^rating - 1) / log2(rank+1)."""
+
+    def dcg(ratings: list[int]) -> float:
+        return sum(
+            (2**r - 1) / math.log2(i + 2) for i, r in enumerate(ratings)
+        )
+
+    actual = dcg([rated.get(h, 0) for h in hits[:k]])
+    if not normalize:
+        return actual
+    ideal = dcg(sorted(rated.values(), reverse=True)[:k])
+    return (actual / ideal) if ideal > 0 else 0.0
+
+
+def expected_reciprocal_rank(
+    hits: list[str], rated: dict[str, int], k: int, max_rating: int | None = None
+) -> float:
+    """ERR (Chapelle et al.), as in ExpectedReciprocalRank.java."""
+    if max_rating is None:
+        max_rating = max(rated.values(), default=0)
+    if max_rating == 0:
+        return 0.0
+    p_stop = 1.0
+    err = 0.0
+    for rank, h in enumerate(hits[:k], start=1):
+        r = rated.get(h, 0)
+        usefulness = (2**r - 1) / (2**max_rating)
+        err += p_stop * usefulness / rank
+        p_stop *= 1 - usefulness
+    return err
+
+
+_METRICS: dict[str, Callable] = {
+    "precision": lambda hits, rated, opts: precision_at_k(
+        hits,
+        rated,
+        int(opts.get("k", 10)),
+        int(opts.get("relevant_rating_threshold", 1)),
+    ),
+    "recall": lambda hits, rated, opts: recall_at_k(
+        hits,
+        rated,
+        int(opts.get("k", 10)),
+        int(opts.get("relevant_rating_threshold", 1)),
+    ),
+    "mean_reciprocal_rank": lambda hits, rated, opts: mean_reciprocal_rank(
+        hits,
+        rated,
+        int(opts.get("k", 10)),
+        int(opts.get("relevant_rating_threshold", 1)),
+    ),
+    "dcg": lambda hits, rated, opts: dcg_at_k(
+        hits, rated, int(opts.get("k", 10)), bool(opts.get("normalize", False))
+    ),
+    "expected_reciprocal_rank": lambda hits, rated, opts: expected_reciprocal_rank(
+        hits, rated, int(opts.get("k", 10)), opts.get("maximum_relevance")
+    ),
+}
+
+
+def evaluate(node, index: str, body: dict[str, Any]) -> dict[str, Any]:
+    """Run the `_rank_eval` request shape against a Node.
+
+    body: {"requests": [{"id", "request": {search body}, "ratings":
+    [{"_id", "rating"}]}], "metric": {"<name>": {...opts}}}
+    """
+    metric_spec = body.get("metric", {"precision": {}})
+    ((metric_name, opts),) = metric_spec.items()
+    if metric_name not in _METRICS:
+        raise ValueError(f"unknown rank-eval metric [{metric_name}]")
+    metric = _METRICS[metric_name]
+    k = int(opts.get("k", 10))
+
+    details = {}
+    scores = []
+    for req in body.get("requests", []):
+        req_id = req.get("id", f"request_{len(scores)}")
+        search_body = dict(req.get("request", {}))
+        search_body.setdefault("size", k)
+        result = node.search(index, search_body)
+        hits = [h["_id"] for h in result["hits"]["hits"]]
+        rated = {r["_id"]: int(r["rating"]) for r in req.get("ratings", [])}
+        score = metric(hits, rated, opts)
+        scores.append(score)
+        details[req_id] = {
+            "metric_score": score,
+            "unrated_docs": [
+                {"_index": index, "_id": h} for h in hits if h not in rated
+            ],
+        }
+    return {
+        "metric_score": (sum(scores) / len(scores)) if scores else 0.0,
+        "details": details,
+    }

@@ -78,12 +78,48 @@ class SearchResponse:
 
 
 @dataclass
+class Rescore:
+    """One rescore stage: re-rank the top-`window_size` docs per shard.
+
+    Mirrors the reference's QueryRescorer (search/rescore/): combined score
+    per `score_mode`, with query_weight/rescore_query_weight factors; docs
+    in the window that don't match the rescore query keep
+    query_weight * original.
+    """
+
+    query: Query
+    window_size: int = 10
+    query_weight: float = 1.0
+    rescore_query_weight: float = 1.0
+    score_mode: str = "total"  # total | multiply | avg | max | min
+
+    def combine(self, orig: np.ndarray, resc: np.ndarray, matched: np.ndarray):
+        qw = np.float32(self.query_weight)
+        rw = np.float32(self.rescore_query_weight)
+        a, b = qw * orig, rw * resc
+        if self.score_mode == "total":
+            combined = a + b
+        elif self.score_mode == "multiply":
+            combined = a * b
+        elif self.score_mode == "avg":
+            combined = (a + b) / np.float32(2.0)
+        elif self.score_mode == "max":
+            combined = np.maximum(a, b)
+        elif self.score_mode == "min":
+            combined = np.minimum(a, b)
+        else:
+            raise ValueError(f"unknown rescore score_mode [{self.score_mode}]")
+        return np.where(matched, combined, a).astype(np.float32)
+
+
+@dataclass
 class SearchRequest:
     query: Query = field(default_factory=MatchAllQuery)
     size: int = 10
     from_: int = 0
     source_includes: bool | list[str] = True
     sort: list[dict[str, str]] | None = None  # [{"field": "asc"|"desc"}]
+    rescore: list[Rescore] = field(default_factory=list)
 
     @classmethod
     def from_json(cls, body: dict[str, Any] | None) -> "SearchRequest":
@@ -91,6 +127,23 @@ class SearchRequest:
         query = (
             parse_query(body["query"]) if "query" in body else MatchAllQuery()
         )
+        rescore = []
+        raw_rescore = body.get("rescore", [])
+        if isinstance(raw_rescore, dict):
+            raw_rescore = [raw_rescore]
+        for entry in raw_rescore:
+            rq = entry.get("query", {})
+            rescore.append(
+                Rescore(
+                    query=parse_query(rq["rescore_query"]),
+                    window_size=int(entry.get("window_size", 10)),
+                    query_weight=float(rq.get("query_weight", 1.0)),
+                    rescore_query_weight=float(
+                        rq.get("rescore_query_weight", 1.0)
+                    ),
+                    score_mode=str(rq.get("score_mode", "total")),
+                )
+            )
         sort = None
         if "sort" in body:
             sort = []
@@ -117,6 +170,7 @@ class SearchRequest:
             from_=int(body.get("from", 0)),
             source_includes=source,
             sort=sort,
+            rescore=rescore,
         )
 
 
@@ -198,6 +252,9 @@ class SearchService:
 
         if sort_field is None or sort_field == "_score":
             ascending_score = sort_field == "_score" and not descending
+            fetch_k = k
+            if request.rescore and not ascending_score:
+                fetch_k = max(k, max(r.window_size for r in request.rescore))
             if ascending_score:
                 # Bottom-k needs its own device reduction — the default
                 # top-k collector would never see the lowest-scoring hits.
@@ -206,10 +263,14 @@ class SearchService:
                 )
             else:
                 scores, ids, tot = bm25_device.execute(
-                    seg_tree, compiled.spec, compiled.arrays, k
+                    seg_tree, compiled.spec, compiled.arrays, fetch_k
                 )
             scores, ids = np.asarray(scores), np.asarray(ids)
-            n = min(k, int(tot))
+            if request.rescore and not ascending_score:
+                scores, ids = self._apply_rescore(
+                    handle, seg_tree, request, scores, ids, int(tot), stats
+                )
+            n = min(k, int(tot), len(ids))
             for rank in range(n):
                 score = float(scores[rank])
                 local = int(ids[rank])
@@ -247,6 +308,44 @@ class SearchService:
                 )
             )
         return int(tot)
+
+    def _apply_rescore(
+        self,
+        handle: SegmentHandle,
+        seg_tree,
+        request: SearchRequest,
+        scores: np.ndarray,
+        ids: np.ndarray,
+        total: int,
+        stats: dict[str, FieldStats],
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Run rescore stages over the shard-local top window.
+
+        Window docs are re-sorted by combined score; hits past the window
+        keep their original order BELOW the window, exactly like Lucene's
+        QueryRescorer contract."""
+        n = min(len(ids), total)
+        scores, ids = scores[:n].copy(), ids[:n].copy()
+        compiler = self.engine.compiler_for(handle, stats)
+        for stage in request.rescore:
+            w = min(stage.window_size, len(ids))
+            if w == 0:
+                continue
+            compiled = compiler.compile(stage.query)
+            # Pad the window to a pow-2 bucket to bound jit recompiles.
+            w_pad = 1 << (w - 1).bit_length()
+            padded = np.zeros(w_pad, dtype=np.int32)
+            padded[:w] = ids[:w]
+            r_scores, r_matched = bm25_device.scores_at(
+                seg_tree, compiled.spec, compiled.arrays, padded
+            )
+            r_scores = np.asarray(r_scores)[:w]
+            r_matched = np.asarray(r_matched)[:w]
+            combined = stage.combine(scores[:w], r_scores, r_matched)
+            order = np.lexsort((ids[:w], -combined.astype(np.float64)))
+            scores[:w] = combined[order]
+            ids[:w] = ids[:w][order]
+        return scores, ids
 
     # ------------------------------------------------------------------ fetch
 

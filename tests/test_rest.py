@@ -1,0 +1,205 @@
+"""REST layer: ES-compatible endpoints over the in-process dispatcher plus
+one live-socket round trip."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.rest.server import RestServer
+
+
+@pytest.fixture()
+def rest():
+    return RestServer()
+
+
+def call(rest, method, path, body=None, query=None):
+    payload = (
+        body
+        if isinstance(body, str)
+        else (json.dumps(body) if body is not None else "")
+    )
+    return rest.dispatch(method, path, query or {}, payload)
+
+
+def test_root_banner(rest):
+    status, body = call(rest, "GET", "/")
+    assert status == 200
+    assert body["version"]["number"].startswith("8.")
+
+
+def test_index_lifecycle(rest):
+    status, body = call(
+        rest,
+        "PUT",
+        "/books",
+        {"mappings": {"properties": {"title": {"type": "text"}}}},
+    )
+    assert status == 200 and body["acknowledged"]
+    status, body = call(rest, "PUT", "/books")
+    assert status == 400 and body["error"]["type"] == "resource_already_exists_exception"
+    status, body = call(rest, "GET", "/books/_mapping")
+    assert body["books"]["mappings"]["properties"]["title"]["type"] == "text"
+    status, body = call(rest, "DELETE", "/books")
+    assert body["acknowledged"]
+    status, body = call(rest, "GET", "/books/_mapping")
+    assert status == 404 and body["error"]["type"] == "index_not_found_exception"
+
+
+def test_document_crud_and_search(rest):
+    call(rest, "PUT", "/lib", {"mappings": {"properties": {"t": {"type": "text"}}}})
+    status, body = call(
+        rest, "PUT", "/lib/_doc/1", {"t": "quick brown fox"}, {"refresh": "true"}
+    )
+    assert status == 200 and body["result"] == "created"
+    call(rest, "PUT", "/lib/_doc/2", {"t": "lazy dog"}, {"refresh": "true"})
+
+    status, body = call(rest, "GET", "/lib/_doc/1")
+    assert body["found"] and body["_source"]["t"] == "quick brown fox"
+
+    status, body = call(
+        rest, "POST", "/lib/_search", {"query": {"match": {"t": "fox"}}}
+    )
+    assert body["hits"]["total"]["value"] == 1
+    assert body["hits"]["hits"][0]["_id"] == "1"
+
+    status, body = call(rest, "DELETE", "/lib/_doc/1", None, {"refresh": "true"})
+    assert body["result"] == "deleted"
+    status, body = call(
+        rest, "POST", "/lib/_search", {"query": {"match": {"t": "fox"}}}
+    )
+    assert body["hits"]["total"]["value"] == 0
+
+
+def test_update_and_upsert(rest):
+    call(rest, "PUT", "/u")
+    call(rest, "PUT", "/u/_doc/1", {"a": 1, "b": "x"}, {"refresh": "true"})
+    status, body = call(rest, "POST", "/u/_update/1", {"doc": {"a": 2}})
+    assert body["result"] == "updated"
+    status, body = call(rest, "GET", "/u/_doc/1")
+    assert body["_source"] == {"a": 2, "b": "x"}
+    status, body = call(rest, "POST", "/u/_update/9", {"doc": {"a": 1}})
+    assert status == 404
+    status, body = call(
+        rest, "POST", "/u/_update/9", {"doc": {"a": 5}, "doc_as_upsert": True}
+    )
+    assert body["result"] == "created"
+
+
+def test_bulk_ndjson(rest):
+    lines = [
+        {"index": {"_index": "bk", "_id": "1"}},
+        {"t": "alpha bravo"},
+        {"index": {"_index": "bk", "_id": "2"}},
+        {"t": "alpha charlie"},
+        {"delete": {"_index": "bk", "_id": "2"}},
+        {"index": {"_index": "missing-CAPS", "_id": "3"}},  # invalid name
+        {"t": "x"},
+    ]
+    body = "\n".join(json.dumps(l) for l in lines) + "\n"
+    status, resp = call(rest, "POST", "/_bulk", body, {"refresh": "true"})
+    assert status == 200
+    assert resp["errors"] is True
+    assert resp["items"][0]["index"]["status"] == 201
+    assert resp["items"][2]["delete"]["status"] == 200
+    assert resp["items"][3]["index"]["status"] == 400
+    status, resp = call(rest, "POST", "/bk/_search", {"query": {"match": {"t": "alpha"}}})
+    assert resp["hits"]["total"]["value"] == 1
+
+
+def test_create_conflict(rest):
+    call(rest, "PUT", "/c")
+    status, _ = call(rest, "PUT", "/c/_create/1", {"x": 1}, {"refresh": "true"})
+    assert status == 200
+    status, body = call(rest, "PUT", "/c/_create/1", {"x": 2})
+    assert status == 409
+    assert body["error"]["type"] == "version_conflict_engine_exception"
+
+
+def test_count_and_cat_and_health(rest):
+    call(rest, "PUT", "/k")
+    call(rest, "PUT", "/k/_doc/1", {"n": 5}, {"refresh": "true"})
+    call(rest, "PUT", "/k/_doc/2", {"n": 15}, {"refresh": "true"})
+    status, body = call(
+        rest, "POST", "/k/_count", {"query": {"range": {"n": {"gte": 10}}}}
+    )
+    assert body["count"] == 1
+    status, body = call(rest, "GET", "/_cluster/health")
+    assert body["status"] == "green"
+    status, body = call(rest, "GET", "/_cat/indices")
+    assert body[0]["index"] == "k" and body[0]["docs.count"] == "2"
+
+
+def test_analyze(rest):
+    call(rest, "PUT", "/a")
+    status, body = call(
+        rest, "POST", "/a/_analyze", {"analyzer": "standard", "text": "The QUICK fox"}
+    )
+    assert [t["token"] for t in body["tokens"]] == ["the", "quick", "fox"]
+
+
+def test_rank_eval(rest):
+    call(rest, "PUT", "/r")
+    for i, text in enumerate(["apple pie", "apple juice", "banana split"]):
+        call(rest, "PUT", f"/r/_doc/{i}", {"t": text}, {"refresh": "true"})
+    body = {
+        "requests": [
+            {
+                "id": "apple_query",
+                "request": {"query": {"match": {"t": "apple"}}},
+                "ratings": [
+                    {"_id": "0", "rating": 1},
+                    {"_id": "1", "rating": 1},
+                    {"_id": "2", "rating": 0},
+                ],
+            }
+        ],
+        "metric": {"recall": {"k": 10}},
+    }
+    status, resp = call(rest, "POST", "/r/_rank_eval", body)
+    assert status == 200
+    assert resp["metric_score"] == 1.0
+
+
+def test_error_shapes(rest):
+    status, body = call(rest, "GET", "/nope/_search")
+    assert status == 404 and body["status"] == 404
+    call(rest, "PUT", "/x")
+    status, body = call(rest, "POST", "/x/_search", "{bad json")
+    assert status == 400 and body["error"]["type"] == "parsing_exception"
+    status, body = call(
+        rest, "POST", "/x/_search", {"query": {"wibble": {}}}
+    )
+    assert status == 400
+
+
+def test_live_http_socket():
+    """Full socket round trip on an ephemeral port."""
+    rest = RestServer()
+    server = rest.serve("127.0.0.1", 0)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        def http(method, path, body=None):
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=data,
+                method=method,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, json.loads(resp.read())
+
+        status, body = http("GET", "/")
+        assert status == 200 and "tagline" in body
+        http("PUT", "/live")
+        http("PUT", "/live/_doc/1?refresh=true" if False else "/live/_doc/1", {"t": "hello world"})
+        http("POST", "/live/_refresh")
+        status, body = http("POST", "/live/_search", {"query": {"match": {"t": "hello"}}})
+        assert body["hits"]["total"]["value"] == 1
+    finally:
+        server.shutdown()

@@ -1,0 +1,264 @@
+"""script_score (painless-lite), brute-force kNN, and rescore."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.index.tiles import pack_segment
+from elasticsearch_tpu.ops import bm25_device
+from elasticsearch_tpu.query.compile import Compiler
+from elasticsearch_tpu.query.dsl import parse_query
+from elasticsearch_tpu.script import compile_script
+from elasticsearch_tpu.search.oracle import OracleSearcher
+from elasticsearch_tpu.search.service import SearchRequest, SearchService
+
+
+def test_painless_lite_basics():
+    s = compile_script("params.w1 * _score + params.w2")
+    out = s.evaluate(
+        np, np.array([1.0, 2.0], np.float32), {}, {}, {"w1": 2.0, "w2": 0.5}
+    )
+    np.testing.assert_allclose(out, [2.5, 4.5])
+
+
+def test_painless_lite_doc_access_and_math():
+    s = compile_script("Math.log(doc['pop'].value + 1) * _score")
+    out = s.evaluate(
+        np,
+        np.array([1.0, 1.0], np.float32),
+        {"pop": np.array([0.0, np.e - 1], np.float32)},
+        {},
+        {},
+    )
+    np.testing.assert_allclose(out, [0.0, 1.0], atol=1e-6)
+
+
+def test_painless_lite_ternary_vectorized():
+    s = compile_script("doc['x'].value > 1 ? _score * 2 : _score")
+    out = s.evaluate(
+        np,
+        np.array([1.0, 1.0], np.float32),
+        {"x": np.array([0.0, 5.0], np.float32)},
+        {},
+        {},
+    )
+    np.testing.assert_allclose(out, [1.0, 2.0])
+
+
+def test_painless_lite_rejects_malicious():
+    with pytest.raises(ValueError):
+        compile_script("__import__('os').system('x')")
+    with pytest.raises(ValueError):
+        compile_script("[x for x in range(10)]")
+    with pytest.raises(ValueError):
+        compile_script("lambda: 1")
+
+
+def test_painless_lite_return_form():
+    s = compile_script("return _score + 1;")
+    np.testing.assert_allclose(
+        s.evaluate(np, np.array([1.0], np.float32), {}, {}, {}), [2.0]
+    )
+
+
+@pytest.fixture(scope="module")
+def vector_corpus():
+    rng = np.random.default_rng(3)
+    mappings = Mappings(
+        properties={
+            "title": {"type": "text"},
+            "vec": {"type": "dense_vector", "dims": 16},
+            "pop": {"type": "double"},
+        }
+    )
+    builder = SegmentBuilder(mappings)
+    words = ["apple", "banana", "cherry", "date", "elder"]
+    for i in range(200):
+        builder.add(
+            {
+                "title": " ".join(rng.choice(words, 4)),
+                "vec": rng.normal(size=16).astype(np.float32).tolist(),
+                "pop": float(rng.random()),
+            },
+            f"d{i}",
+        )
+    segment = builder.build()
+    dev = pack_segment(segment)
+    return (
+        mappings,
+        segment,
+        bm25_device.segment_tree(dev),
+        Compiler(dev.fields, dev.doc_values, mappings),
+        OracleSearcher(segment, mappings),
+    )
+
+
+def run_parity(vector_corpus, query_json, k=10, rtol=1e-5):
+    _, _, seg_tree, compiler, oracle = vector_corpus
+    q = parse_query(query_json)
+    c = compiler.compile(q)
+    ds, di, dt = bm25_device.execute(seg_tree, c.spec, c.arrays, k)
+    os_, oi, ot = oracle.search(q, k)
+    n = min(k, int(dt))
+    assert int(dt) == ot
+    np.testing.assert_array_equal(np.asarray(di)[:n], oi)
+    np.testing.assert_allclose(np.asarray(ds)[:n], os_, rtol=rtol, atol=1e-5)
+
+
+def test_knn_cosine_script_score(vector_corpus):
+    _, segment, *_ = vector_corpus
+    qv = segment.vectors["vec"][7].tolist()  # query with a known doc's vector
+    run_parity(
+        vector_corpus,
+        {
+            "script_score": {
+                "query": {"match_all": {}},
+                "script": {
+                    "source": "cosineSimilarity(params.qv, 'vec') + 1.0",
+                    "params": {"qv": qv},
+                },
+            }
+        },
+    )
+
+
+def test_knn_exact_self_match(vector_corpus):
+    """The doc whose vector equals the query must rank first (cos = 1)."""
+    _, segment, seg_tree, compiler, _ = vector_corpus
+    qv = segment.vectors["vec"][7].tolist()
+    q = parse_query(
+        {
+            "script_score": {
+                "query": {"match_all": {}},
+                "script": {
+                    "source": "cosineSimilarity(params.qv, 'vec') + 1.0",
+                    "params": {"qv": qv},
+                },
+            }
+        }
+    )
+    c = compiler.compile(q)
+    ds, di, dt = bm25_device.execute(seg_tree, c.spec, c.arrays, 3)
+    assert int(np.asarray(di)[0]) == 7
+    assert np.asarray(ds)[0] == pytest.approx(2.0, rel=1e-5)
+
+
+def test_knn_dot_and_l2(vector_corpus):
+    _, segment, *_ = vector_corpus
+    qv = segment.vectors["vec"][0].tolist()
+    run_parity(
+        vector_corpus,
+        {
+            "script_score": {
+                "query": {"match_all": {}},
+                "script": {
+                    "source": "dotProduct(params.qv, 'vec')",
+                    "params": {"qv": qv},
+                },
+                "min_score": 0.0,
+            }
+        },
+    )
+    run_parity(
+        vector_corpus,
+        {
+            "script_score": {
+                "query": {"match_all": {}},
+                "script": {
+                    "source": "1 / (1 + l2norm(params.qv, 'vec'))",
+                    "params": {"qv": qv},
+                },
+            }
+        },
+    )
+
+
+def test_script_score_over_bm25_subquery(vector_corpus):
+    """BASELINE config 4 shape: linear re-rank of BM25 scores."""
+    run_parity(
+        vector_corpus,
+        {
+            "script_score": {
+                "query": {"match": {"title": "apple banana"}},
+                "script": {
+                    "source": "params.w1 * _score + params.w2 * doc['pop'].value",
+                    "params": {"w1": 0.8, "w2": 2.0},
+                },
+            }
+        },
+    )
+
+
+def make_service():
+    mappings = Mappings(
+        properties={"title": {"type": "text"}, "pop": {"type": "double"}}
+    )
+    engine = Engine(mappings)
+    docs = [
+        ("a", "red fox", 0.9),
+        ("b", "red red fox", 0.1),
+        ("c", "red dog", 0.5),
+        ("d", "blue fish", 0.99),
+    ]
+    for doc_id, title, pop in docs:
+        engine.index({"title": title, "pop": pop}, doc_id)
+    engine.refresh()
+    return SearchService(engine)
+
+
+def test_rescore_total_mode():
+    svc = make_service()
+    base = svc.search(SearchRequest.from_json({"query": {"match": {"title": "red"}}}))
+    resp = svc.search(
+        SearchRequest.from_json(
+            {
+                "query": {"match": {"title": "red"}},
+                "rescore": {
+                    "window_size": 10,
+                    "query": {
+                        "rescore_query": {
+                            "script_score": {
+                                "query": {"match_all": {}},
+                                "script": {"source": "doc['pop'].value * 10"},
+                            }
+                        },
+                        "query_weight": 0.0,
+                        "rescore_query_weight": 1.0,
+                    },
+                },
+            }
+        )
+    )
+    assert {h.doc_id for h in resp.hits} == {h.doc_id for h in base.hits}
+    # With query_weight 0 the order is purely by pop desc.
+    assert [h.doc_id for h in resp.hits] == ["a", "c", "b"]
+    assert resp.hits[0].score == pytest.approx(9.0)
+
+
+def test_rescore_window_limits_reordering():
+    svc = make_service()
+    resp = svc.search(
+        SearchRequest.from_json(
+            {
+                "query": {"match": {"title": "red"}},
+                "rescore": {
+                    "window_size": 2,
+                    "query": {
+                        "rescore_query": {
+                            "script_score": {
+                                "query": {"match_all": {}},
+                                "script": {"source": "doc['pop'].value * 10"},
+                            }
+                        },
+                        "query_weight": 0.0,
+                    },
+                },
+            }
+        )
+    )
+    base = svc.search(SearchRequest.from_json({"query": {"match": {"title": "red"}}}))
+    # Only the top-2 of the original ranking were eligible to reorder; the
+    # third hit stays third.
+    assert resp.hits[2].doc_id == base.hits[2].doc_id
