@@ -201,8 +201,10 @@ class Node:
         existing = svc.engine.get(doc_id)
         if existing is None:
             if "upsert" in body:
+                # The upsert document is indexed as-is when the doc is
+                # missing; `doc` only applies to an existing document
+                # (reference UpdateHelper.prepareUpsert semantics).
                 merged = dict(body["upsert"])
-                merged.update(body.get("doc", {}))
             elif body.get("doc_as_upsert") and "doc" in body:
                 merged = dict(body["doc"])
             else:

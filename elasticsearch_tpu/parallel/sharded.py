@@ -333,9 +333,9 @@ class _PlanField:
 def _max_nt(spec: tuple) -> int:
     """Largest terms-node worklist bucket anywhere in a compiled spec."""
     kind = spec[0]
-    if kind in ("terms", "terms_const"):
+    if kind in ("terms", "terms_const", "terms_gather"):
         return spec[2]
-    if kind == "const":
+    if kind in ("const", "script"):
         return _max_nt(spec[1])
     if kind == "bool":
         out = 1
@@ -377,7 +377,10 @@ def sharded_execute(
         all_i = jax.lax.all_gather(global_i, axis)
         flat_s = all_s.reshape(-1)
         flat_i = all_i.reshape(-1)
-        top_s, idx = jax.lax.top_k(flat_s, kk)
+        # Merge to min(k, S*kk), not kk: when k exceeds docs_per_shard the
+        # union across shards can still fill k hits (ES returns
+        # min(size, total) hits; the host trims by the psum'd total).
+        top_s, idx = jax.lax.top_k(flat_s, min(k, flat_s.shape[0]))
         top_i = flat_i[idx]
         total = jax.lax.psum(jnp.sum(eligible, dtype=jnp.int32), axis)
         return top_s, top_i, total
@@ -439,7 +442,7 @@ def sharded_execute_batch(
         qb = all_s.shape[1]
         flat_s = all_s.transpose(1, 0, 2).reshape(qb, -1)  # [Qb, S*kk]
         flat_i = all_i.transpose(1, 0, 2).reshape(qb, -1)
-        top_s, idx = jax.lax.top_k(flat_s, kk)
+        top_s, idx = jax.lax.top_k(flat_s, min(k, flat_s.shape[-1]))
         top_i = jnp.take_along_axis(flat_i, idx, axis=1)
         totals = jax.lax.psum(counts, shard_axis)
         return top_s, top_i, totals

@@ -155,17 +155,29 @@ class RestServer:
     def dispatch(self, method: str, path: str, query: dict, body: str):
         """Returns (status, payload). ES-style error payloads on failure."""
         try:
+            # HEAD is served by the matching GET handler (the HTTP layer
+            # suppresses the body), like the reference's RestController
+            # HEAD-from-GET dispatch.
+            lookup = "GET" if method == "HEAD" else method
+            path_matched = False
             for m, regex, handler in self.routes:
-                if m != method:
-                    continue
                 match = regex.match(path)
-                if match:
-                    result = handler(self, match.groupdict(), query, body)
-                    return 200, result
+                if not match:
+                    continue
+                if m != lookup:
+                    path_matched = True
+                    continue
+                result = handler(self, match.groupdict(), query, body)
+                return 200, result
+            if path_matched:
+                raise ApiError(
+                    405,
+                    "method_not_allowed_exception",
+                    f"Incorrect HTTP method for uri [{path}] and method "
+                    f"[{method}]",
+                )
             raise ApiError(
-                405,
-                "invalid_request",
-                f"Incorrect HTTP method or unknown route [{method} {path}]",
+                400, "invalid_request", f"no handler found for uri [{path}]"
             )
         except ApiError as e:
             return e.status, {
@@ -212,7 +224,8 @@ class RestServer:
                 self.send_header("Content-Length", str(len(data)))
                 self.send_header("X-elastic-product", "Elasticsearch")
                 self.end_headers()
-                self.wfile.write(data)
+                if self.command != "HEAD":  # HEAD: headers only, no body
+                    self.wfile.write(data)
 
             do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _handle
 

@@ -112,7 +112,12 @@ class _Params:
             raise ValueError(f"script params has no entry [{name}]") from None
 
     def __getitem__(self, name: str):
-        return getattr(self, name)
+        # Dict lookup only — never getattr, which would resolve real object
+        # attributes (dunders) before __getattr__ is consulted.
+        try:
+            return self._values[name]
+        except KeyError:
+            raise ValueError(f"script params has no entry [{name}]") from None
 
 
 class _DocValue:
@@ -209,6 +214,66 @@ class CompiledScript:
         return eval(code, {"__builtins__": {}}, env)  # noqa: S307
 
 
+_MATH_MEMBERS = frozenset(
+    {
+        "log", "log10", "sqrt", "abs", "exp", "floor", "ceil",
+        "pow", "min", "max", "E", "PI",
+    }
+)
+_DOC_VALUE_MEMBERS = frozenset({"value", "empty"})
+
+
+def _validate_access(tree: ast.Expression, source: str) -> None:
+    """Whitelist attribute/subscript access shapes.
+
+    The reference's Painless enforces a strict method/field whitelist
+    (modules/lang-painless/ PainlessLookup); the analogous rule here is
+    structural: the only legal attribute accesses are Math.<member>,
+    params.<name>, and doc['field'].value/.empty, and the only legal
+    subscripts are doc['field'] / params['name'] with string-constant keys.
+    Anything else — in particular any dunder walk like
+    `(1.0).__class__.__base__` — is rejected at compile time.
+    """
+
+    def fail(why: str) -> None:
+        raise ValueError(f"cannot compile script [{source}]: {why}")
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            attr, base = node.attr, node.value
+            if attr.startswith("_"):
+                fail(f"illegal attribute access [{attr}]")
+            if isinstance(base, ast.Name):
+                if base.id == "Math":
+                    if attr not in _MATH_MEMBERS:
+                        fail(f"unknown Math member [{attr}]")
+                elif base.id == "params":
+                    pass  # params.NAME: any non-underscore name
+                else:
+                    fail(f"illegal attribute access [{base.id}.{attr}]")
+            elif isinstance(base, ast.Subscript):
+                sub_base = base.value
+                if not (
+                    isinstance(sub_base, ast.Name) and sub_base.id == "doc"
+                ):
+                    fail(f"illegal attribute access [.{attr}]")
+                if attr not in _DOC_VALUE_MEMBERS:
+                    fail(f"unknown doc-values member [{attr}]")
+            else:
+                fail(f"illegal attribute access [.{attr}]")
+        elif isinstance(node, ast.Subscript):
+            base = node.value
+            if not (
+                isinstance(base, ast.Name) and base.id in ("doc", "params")
+            ):
+                fail("subscript access is only legal on doc[...] / params[...]")
+            key = node.slice
+            if not (isinstance(key, ast.Constant) and isinstance(key.value, str)):
+                fail("doc/params subscript keys must be string literals")
+            if key.value.startswith("_"):
+                fail(f"illegal subscript key [{key.value}]")
+
+
 def compile_script(source: str) -> CompiledScript:
     """Parse + validate a painless-lite expression (raises ValueError)."""
     normalized = _normalize(source)
@@ -230,6 +295,7 @@ def compile_script(source: str) -> CompiledScript:
                 f"cannot compile script [{source}]: unknown identifier "
                 f"[{node.id}]"
             )
+    _validate_access(tree, source)
     # Ternaries become vectorized selects (`where`) so per-doc conditions
     # work both in numpy and under jit (a Python `if` on a traced array
     # would fail).

@@ -1,0 +1,131 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.node import ApiError, Node
+from elasticsearch_tpu.rest.server import RestServer
+from elasticsearch_tpu.script import compile_script
+
+
+class TestSandboxEscape:
+    """painless-lite must reject every attribute-walk escape route."""
+
+    @pytest.mark.parametrize(
+        "src",
+        [
+            "sigmoid.__globals__['__builtins__']['__import__']('os')",
+            "(1.0).__class__.__base__.__subclasses__()",
+            "_score.__class__",
+            "params.__dict__",
+            "doc['f'].__class__",
+            "Math.__subclasshook__",
+            "doc['f'].value.__class__",
+            "params['x'].__class__.__mro__",
+            "params['__class__']",
+            "params['__getattribute__']('_values')",
+            "params['__setattr__']('_values', 0)",
+            "doc['__class__']",
+        ],
+    )
+    def test_dunder_walks_rejected(self, src):
+        with pytest.raises(ValueError):
+            compile_script(src)
+
+    @pytest.mark.parametrize(
+        "src",
+        [
+            "doc[_score]",  # non-constant subscript key
+            "doc[doc]",
+            "params[1]",  # non-string key
+            "Math.hypot(1, 2)",  # unknown Math member
+            "_score.real",  # attribute on a bare value
+        ],
+    )
+    def test_nonwhitelisted_access_rejected(self, src):
+        with pytest.raises(ValueError):
+            compile_script(src)
+
+    def test_legit_scripts_still_compile(self):
+        for src in [
+            "_score * 2.0",
+            "Math.log(1 + _score)",
+            "params.w * doc['price'].value",
+            "params['w'] * doc['price'].value + Math.PI",
+            "doc['f'].empty ? 0.0 : doc['f'].value",
+            "cosineSimilarity(params.qv, 'vec') + 1.0",
+            "saturation(doc['pagerank'].value, 10)",
+        ]:
+            compile_script(src)
+
+    def test_legit_script_evaluates(self):
+        s = compile_script("params.w * doc['price'].value + _score")
+        out = s.evaluate(
+            np,
+            np.array([1.0, 2.0], dtype=np.float32),
+            {"price": np.array([10.0, 20.0], dtype=np.float32)},
+            {},
+            {"w": 2.0},
+        )
+        np.testing.assert_allclose(out, [21.0, 42.0])
+
+
+class TestShardedMergeFill:
+    """Merged top-k must fill min(size, total), not min(size, docs/shard)."""
+
+    def test_k_exceeding_per_shard_docs(self):
+        import jax
+        from jax.sharding import Mesh
+
+        from elasticsearch_tpu.parallel.sharded import ShardedIndex
+        from elasticsearch_tpu.query.dsl import MatchAllQuery
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("shard",))
+        mappings = Mappings.from_json(
+            {"properties": {"body": {"type": "text"}}}
+        )
+        docs = [(str(i), {"body": f"doc number {i}"}) for i in range(40)]
+        idx = ShardedIndex.from_docs(docs, mappings, mesh)
+        scores, ids, total = idx.search(MatchAllQuery(), k=30)
+        assert total == 40
+        assert len(ids) == 30  # was 18 before the fix
+        assert len(set(int(i) for i in ids)) == 30
+
+
+class TestUpdateUpsert:
+    def test_upsert_indexes_as_is_when_missing(self):
+        node = Node()
+        node.create_index("i")
+        node.update_doc(
+            "i", "1", {"doc": {"a": 2}, "upsert": {"a": 1, "b": 9}}
+        )
+        got = node.get_doc("i", "1")
+        # ES indexes the upsert doc as-is; `doc` is NOT applied.
+        assert got["_source"] == {"a": 1, "b": 9}
+
+    def test_doc_applied_when_existing(self):
+        node = Node()
+        node.create_index("i")
+        node.index_doc("i", {"a": 1, "b": 9}, "1")
+        node.update_doc("i", "1", {"doc": {"a": 2}, "upsert": {"a": 0}})
+        assert node.get_doc("i", "1")["_source"] == {"a": 2, "b": 9}
+
+
+class TestRestDispatch:
+    def test_unknown_route_is_400(self):
+        rest = RestServer()
+        status, payload = rest.dispatch("GET", "/_nope/zzz/yyy", {}, "")
+        assert status == 400
+        assert payload["error"]["type"] == "invalid_request"
+
+    def test_wrong_method_is_405(self):
+        rest = RestServer()
+        status, _ = rest.dispatch("DELETE", "/_cluster/health", {}, "")
+        assert status == 405
+
+    def test_head_routes_like_get(self):
+        rest = RestServer()
+        status, payload = rest.dispatch("HEAD", "/", {}, "")
+        assert status == 200
+        assert "tagline" in payload
