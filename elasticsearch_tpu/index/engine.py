@@ -19,10 +19,18 @@ index bit-for-bit.
 Sequence numbers: every index/delete op gets a monotonically increasing
 seqno (InternalEngine.java:829 generateSeqNoForOperation); the translog
 (index/translog.py) persists ops by seqno for restart recovery.
+
+Durability (when constructed with a data_path): ops append to the translog
+(fsynced per request via `sync_translog`), `flush()` persists segments +
+live masks and writes a commit point, recovery at construction loads the
+last commit and replays translog ops above its seqno — the
+Translog/commitIndexWriter/recoverFromTranslog cycle of the reference
+(InternalEngine.java:851, translog/Translog.java:71-107).
 """
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any
 
@@ -30,9 +38,11 @@ import numpy as np
 
 from ..ops.bm25 import BM25Params
 from ..query.compile import Compiler, FieldStats, aggregate_field_stats
+from . import store
 from .mapping import Mappings
 from .segment import Segment, SegmentBuilder
 from .tiles import DeviceSegment, pack_segment, repack_tn
+from .translog import Translog
 
 
 @dataclass
@@ -44,6 +54,7 @@ class SegmentHandle:
     base: int  # global doc id base for this segment
     live_host: np.ndarray  # bool[N] host copy of the live mask
     live_dirty: bool = False
+    seg_id: int | None = None  # on-disk id once persisted by flush()
 
     def soft_delete(self, local_doc: int) -> None:
         if self.live_host[local_doc]:
@@ -71,6 +82,8 @@ class Engine:
         mappings: Mappings | None = None,
         params: BM25Params = BM25Params(),
         device=None,
+        data_path: str | None = None,
+        durability: str = "request",
     ):
         self.mappings = mappings or Mappings()
         self.params = params
@@ -83,6 +96,16 @@ class Engine:
         self._seqno = -1
         self._auto_id = 0
         self._stats_cache: dict[str, FieldStats] | None = None
+        self.data_path = data_path
+        self.translog: Translog | None = None
+        self._next_seg_id = 1
+        if data_path is not None:
+            os.makedirs(data_path, exist_ok=True)
+            self._recover()
+            self.translog = Translog(
+                os.path.join(data_path, "translog"), durability
+            )
+            self._replay_translog()
 
     # ------------------------------------------------------------- write path
 
@@ -102,19 +125,35 @@ class Engine:
         created = self._delete_existing(doc_id) == 0
         local = self._buffer.add(source, doc_id)
         self._buffer_ids[doc_id] = local
+        seqno = self.next_seqno()
+        if self.translog is not None:
+            self.translog.add(
+                {"seqno": seqno, "op": "index", "id": doc_id, "source": source}
+            )
         return {
             "_id": doc_id,
             "result": "created" if created else "updated",
-            "_seq_no": self.next_seqno(),
+            "_seq_no": seqno,
         }
 
     def delete(self, doc_id: str) -> dict:
         found = self._delete_existing(doc_id) > 0
+        seqno = self.next_seqno() if found else self._seqno
+        if found and self.translog is not None:
+            self.translog.add({"seqno": seqno, "op": "delete", "id": doc_id})
         return {
             "_id": doc_id,
             "result": "deleted" if found else "not_found",
-            "_seq_no": self.next_seqno() if found else self._seqno,
+            "_seq_no": seqno,
         }
+
+    def sync_translog(self) -> None:
+        """fsync the translog — the per-request durability point the write
+        path acks through (TransportWriteAction's waitForSync analog).
+        Under index.translog.durability=async the request-time fsync is
+        skipped; flush() still syncs via Translog.roll."""
+        if self.translog is not None and self.translog.durability == "request":
+            self.translog.sync()
 
     def _delete_existing(self, doc_id: str) -> int:
         """Tombstone any live copy of doc_id; returns number removed (0/1)."""
@@ -199,6 +238,104 @@ class Engine:
         self._stats_cache = None
         self._sync_impacts()
         return True
+
+    def flush(self) -> dict:
+        """Refresh, persist segments + live masks, commit, trim the translog.
+
+        The reference's InternalEngine.flush: Lucene commit embedding the
+        translog generation, then trimUnreferencedReaders. After a flush,
+        everything up to max_seqno survives a crash without replay.
+        """
+        self.refresh()
+        if self.data_path is None:
+            return {"committed": False}
+        for handle in self.segments:
+            if handle.seg_id is None:
+                handle.seg_id = self._next_seg_id
+                self._next_seg_id += 1
+                store.persist_segment(
+                    self.data_path, handle.seg_id, handle.segment
+                )
+            store.persist_live(self.data_path, handle.seg_id, handle.live_host)
+        store.write_commit(
+            self.data_path,
+            {
+                "segments": [h.seg_id for h in self.segments],
+                "max_seqno": self._seqno,
+                "next_seg_id": self._next_seg_id,
+            },
+        )
+        if self.translog is not None:
+            self.translog.roll(self._seqno)
+        store.gc_segments(
+            self.data_path, {h.seg_id for h in self.segments}
+        )
+        return {"committed": True, "max_seqno": self._seqno}
+
+    def close(self) -> None:
+        if self.translog is not None:
+            self.translog.close()
+
+    def _recover(self) -> None:
+        """Load the last commit's segments (recovery-from-disk at boot,
+        the engine-local slice of GatewayMetaState + store recovery)."""
+        commit = store.read_commit(self.data_path)
+        if commit is None:
+            return
+        self._seqno = commit["max_seqno"]
+        self._next_seg_id = commit.get("next_seg_id", 1)
+        base = 0
+        for seg_idx, seg_id in enumerate(commit["segments"]):
+            segment, live = store.load_segment(self.data_path, seg_id)
+            deleted = np.flatnonzero(~live)
+            device = pack_segment(
+                segment,
+                self.device,
+                deleted=deleted,
+                k1=self.params.k1,
+                b=self.params.b,
+            )
+            handle = SegmentHandle(
+                segment=segment,
+                device=device,
+                base=base,
+                live_host=live.copy(),
+                seg_id=seg_id,
+            )
+            self.segments.append(handle)
+            for local, doc_id in enumerate(segment.ids):
+                if live[local]:
+                    self._live_ids[doc_id] = (seg_idx, local)
+                self._bump_auto_id(doc_id)
+            base += segment.num_docs
+        self._stats_cache = None
+        self._sync_impacts()
+
+    def _replay_translog(self) -> None:
+        """Re-apply ops above the commit's seqno (recoverFromTranslog)."""
+        assert self.translog is not None
+        replayed = False
+        for op in self.translog.replay(above_seqno=self._seqno):
+            replayed = True
+            if op["op"] == "index":
+                doc_id = op["id"]
+                self._delete_existing(doc_id)
+                local = self._buffer.add(op["source"], doc_id)
+                self._buffer_ids[doc_id] = local
+                self._bump_auto_id(doc_id)
+            elif op["op"] == "delete":
+                self._delete_existing(op["id"])
+            self._seqno = max(self._seqno, int(op.get("seqno", -1)))
+        if replayed:
+            self.refresh()
+
+    def _bump_auto_id(self, doc_id: str) -> None:
+        """Keep the auto-id counter ahead of every recovered auto id."""
+        if doc_id.startswith("_auto_"):
+            try:
+                self._auto_id = max(self._auto_id, int(doc_id[6:]) + 1)
+            except ValueError:
+                pass
 
     def _sync_impacts(self) -> None:
         """Align every segment's precomputed impacts with shard-level stats.

@@ -1,0 +1,169 @@
+"""On-disk segment store + commit points.
+
+The durability half of the engine: immutable segments persist as
+npz + JSON metadata + JSONL sources (the analog of Lucene segment files
+written under FsDirectoryFactory, reference server/src/main/java/org/
+elasticsearch/index/store/FsDirectoryFactory.java:36), and a commit point
+records the live segment set plus the highest persisted seqno (the analog
+of InternalEngine.commitIndexWriter embedding translog metadata in the
+Lucene commit user-data). Commits replace atomically via tmp+rename, so a
+crash mid-flush falls back to the previous consistent commit.
+
+Layout under the shard data path:
+    seg-<id>.npz        posting/doc-value arrays (immutable)
+    seg-<id>.meta.json  term dicts, stats, doc ids (immutable)
+    seg-<id>.src.jsonl  stored _source per local doc (immutable)
+    seg-<id>.live.npz   live-docs mask (rewritten per flush: deletions)
+    commit.json         {"segments": [...], "max_seqno": N}
+    translog/           WAL (see translog.py)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+from .segment import FieldIndex, Segment
+
+_COMMIT = "commit.json"
+
+
+def persist_segment(path: str, seg_id: int, segment: Segment) -> None:
+    """Write one immutable segment (postings + doc values + sources)."""
+    arrays: dict[str, np.ndarray] = {}
+    meta: dict[str, Any] = {
+        "num_docs": segment.num_docs,
+        "ids": segment.ids,
+        "fields": {},
+        "doc_values": list(segment.doc_values),
+        "vectors": list(segment.vectors),
+    }
+    for i, (name, fld) in enumerate(sorted(segment.fields.items())):
+        pre = f"f{i}"
+        meta["fields"][name] = {
+            "key": pre,
+            "terms": fld.terms,
+            "doc_count": fld.doc_count,
+            "sum_total_tf": fld.sum_total_tf,
+            "has_norms": fld.has_norms,
+        }
+        arrays[f"{pre}_df"] = fld.df
+        arrays[f"{pre}_offsets"] = fld.offsets
+        arrays[f"{pre}_doc_ids"] = fld.doc_ids
+        arrays[f"{pre}_tfs"] = fld.tfs
+        arrays[f"{pre}_norm_bytes"] = fld.norm_bytes
+        arrays[f"{pre}_present"] = fld.present
+    for j, (name, col) in enumerate(sorted(segment.doc_values.items())):
+        arrays[f"dv{j}"] = col
+    for j, (name, mat) in enumerate(sorted(segment.vectors.items())):
+        arrays[f"vec{j}"] = mat
+    base = os.path.join(path, f"seg-{seg_id}")
+    with open(base + ".npz", "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    with open(base + ".src.jsonl", "w") as f:
+        for src in segment.sources:
+            f.write(json.dumps(src, separators=(",", ":")) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    with open(base + ".meta.json", "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def persist_live(path: str, seg_id: int, live: np.ndarray) -> None:
+    """Rewrite a segment's live-docs mask (deletions since last flush)."""
+    target = os.path.join(path, f"seg-{seg_id}.live.npz")
+    tmp = target + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, live=live)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, target)
+
+
+def load_segment(path: str, seg_id: int) -> tuple[Segment, np.ndarray]:
+    """Load (segment, live_mask) written by persist_segment/persist_live."""
+    base = os.path.join(path, f"seg-{seg_id}")
+    with open(base + ".meta.json") as f:
+        meta = json.load(f)
+    data = np.load(base + ".npz")
+    fields: dict[str, FieldIndex] = {}
+    for name, fm in meta["fields"].items():
+        pre = fm["key"]
+        fields[name] = FieldIndex(
+            name=name,
+            terms=fm["terms"],
+            df=data[f"{pre}_df"],
+            offsets=data[f"{pre}_offsets"],
+            doc_ids=data[f"{pre}_doc_ids"],
+            tfs=data[f"{pre}_tfs"],
+            norm_bytes=data[f"{pre}_norm_bytes"],
+            doc_count=fm["doc_count"],
+            sum_total_tf=fm["sum_total_tf"],
+            has_norms=fm["has_norms"],
+            present=data[f"{pre}_present"],
+        )
+    doc_values = {
+        name: data[f"dv{j}"]
+        for j, name in enumerate(sorted(meta["doc_values"]))
+    }
+    vectors = {
+        name: data[f"vec{j}"] for j, name in enumerate(sorted(meta["vectors"]))
+    }
+    sources = []
+    with open(base + ".src.jsonl") as f:
+        for line in f:
+            sources.append(json.loads(line))
+    segment = Segment(
+        num_docs=meta["num_docs"],
+        fields=fields,
+        doc_values=doc_values,
+        vectors=vectors,
+        sources=sources,
+        ids=list(meta["ids"]),
+    )
+    live_path = base + ".live.npz"
+    if os.path.exists(live_path):
+        live = np.load(live_path)["live"]
+    else:
+        live = np.ones(segment.num_docs, dtype=bool)
+    return segment, live
+
+
+def write_commit(path: str, commit: dict[str, Any]) -> None:
+    tmp = os.path.join(path, _COMMIT + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(commit, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(path, _COMMIT))
+
+
+def read_commit(path: str) -> dict[str, Any] | None:
+    target = os.path.join(path, _COMMIT)
+    if not os.path.exists(target):
+        return None
+    with open(target) as f:
+        return json.load(f)
+
+
+def gc_segments(path: str, referenced: set[int]) -> None:
+    """Delete segment files not referenced by the current commit."""
+    for name in os.listdir(path):
+        if not name.startswith("seg-"):
+            continue
+        try:
+            seg_id = int(name.split("-")[1].split(".")[0])
+        except (IndexError, ValueError):
+            continue
+        if seg_id not in referenced:
+            try:
+                os.remove(os.path.join(path, name))
+            except FileNotFoundError:
+                pass

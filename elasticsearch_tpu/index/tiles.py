@@ -86,6 +86,11 @@ class DeviceField:
     tn_avgdl: float
     tn_k1: float
     tn_b: float
+    # Host-side per-tile max impact (block-max analog): tile_max[j] =
+    # max(tn[j, :]) — the plan-time upper-bound source for tile pruning
+    # (reference behavior: Lucene block-max WAND skipping enabled by
+    # search/query/TopDocsCollectorContext.java:68).
+    tile_max: np.ndarray | None = None
     device: Any = None  # placement used at pack time (repacks must match)
 
     @property
@@ -195,6 +200,7 @@ def pack_field(
         tn = np.concatenate([tn, np.zeros(extra, dtype=np.float32)])
     norm_ext = np.zeros(num_docs + 1, dtype=np.uint8)
     norm_ext[: len(field.norm_bytes)] = field.norm_bytes
+    tile_max = tn.reshape(-1, TILE).max(axis=1)
     put = lambda x: jax.device_put(x, device)
     return DeviceField(
         name=field.name,
@@ -212,6 +218,7 @@ def pack_field(
         tn_avgdl=float(avgdl),
         tn_k1=k1,
         tn_b=b,
+        tile_max=tile_max,
         device=device,
     )
 
@@ -230,7 +237,9 @@ def repack_tn(
     tn = np.zeros(total, dtype=np.float32)
     raw = compute_tn(field, avgdl, k1, b)
     tn[: len(raw)] = raw
-    dfield.tn = jax.device_put(tn.reshape(-1, TILE), dfield.device)
+    tiled = tn.reshape(-1, TILE)
+    dfield.tn = jax.device_put(tiled, dfield.device)
+    dfield.tile_max = tiled.max(axis=1)
     dfield.tn_avgdl = float(avgdl)
     dfield.tn_k1 = k1
     dfield.tn_b = b

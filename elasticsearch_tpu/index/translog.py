@@ -1,0 +1,164 @@
+"""Translog: per-shard write-ahead log with checkpointed recovery.
+
+The analog of the reference's Translog (server/src/main/java/org/
+elasticsearch/index/translog/Translog.java:71-107): every index/delete
+operation is appended by sequence number to a generation file; a checkpoint
+file records the fsynced offset and seqno range and is replaced atomically;
+on restart, operations above the last commit's persisted seqno are replayed
+into the engine. `rollGeneration`/`trimUnreferencedReaders` become
+`roll()` — flush commits segment data, then retires fully-persisted
+generations.
+
+Format: one JSON object per line (op framing is line-delimited instead of
+the reference's length-prefixed binary records — the recovery semantics,
+not the byte layout, are the contract). Durability modes mirror
+index.translog.durability: "request" fsyncs on sync() (called per REST
+request, like TransportWriteAction waiting on Translog.Location sync);
+"async" leaves fsync to flush time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterator
+
+
+class Translog:
+    """Append-ops WAL over generation files + an atomic checkpoint."""
+
+    def __init__(self, path: str, durability: str = "request"):
+        self.path = path
+        self.durability = durability
+        os.makedirs(path, exist_ok=True)
+        self._ckp_path = os.path.join(path, "translog.ckp")
+        ckp = self._read_checkpoint()
+        self.generation = ckp["generation"]
+        # A crash can leave a torn partial line at the tail of the current
+        # generation. Appending after it would corrupt the frame stream and
+        # lose every LATER (fsynced, acked) op at the next replay, so the
+        # tail is truncated to the last complete line before reopening —
+        # the reference truncates to the checkpointed offset the same way.
+        self._truncate_torn_tail(self._gen_path(self.generation))
+        self._file = open(self._gen_path(self.generation), "ab")
+        self._dirty = False
+
+    @staticmethod
+    def _truncate_torn_tail(gen_path: str) -> None:
+        if not os.path.exists(gen_path):
+            return
+        with open(gen_path, "rb") as f:
+            data = f.read()
+        if not data or data.endswith(b"\n"):
+            # Even newline-terminated tails can be torn mid-record; validate
+            # the last line parses.
+            if data:
+                last = data[:-1].rsplit(b"\n", 1)[-1]
+                try:
+                    json.loads(last.decode("utf-8"))
+                    return
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    data = data[: len(data) - len(last) - 1]
+            else:
+                return
+        else:
+            keep = data.rfind(b"\n") + 1
+            data = data[:keep]
+        with open(gen_path, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+
+    # ------------------------------------------------------------- plumbing
+
+    def _gen_path(self, gen: int) -> str:
+        return os.path.join(self.path, f"translog-{gen}.log")
+
+    def _read_checkpoint(self) -> dict:
+        if os.path.exists(self._ckp_path):
+            with open(self._ckp_path) as f:
+                return json.load(f)
+        return {"generation": 1, "min_gen": 1, "persisted_seqno": -1}
+
+    def _write_checkpoint(self, **fields) -> None:
+        ckp = self._read_checkpoint()
+        ckp.update(fields)
+        tmp = self._ckp_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(ckp, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._ckp_path)  # atomic, like Checkpoint.write
+
+    # ------------------------------------------------------------ write path
+
+    def add(self, op: dict[str, Any]) -> None:
+        """Append one operation record (must carry 'seqno')."""
+        line = json.dumps(op, separators=(",", ":")) + "\n"
+        self._file.write(line.encode("utf-8"))
+        self._dirty = True
+        if self.durability == "request":
+            # Buffered until sync(); "request" durability is enforced by the
+            # caller invoking sync() before acking the client.
+            pass
+
+    def sync(self) -> None:
+        """fsync outstanding appends (the Translog.Location sync point)."""
+        if self._dirty:
+            self._file.flush()
+            os.fsync(self._file.fileno())
+            self._dirty = False
+
+    def roll(self, persisted_seqno: int) -> None:
+        """Commit point reached: start a new generation, retire old ones.
+
+        `persisted_seqno` is the highest seqno now durable in segment files
+        (the commit's local checkpoint); earlier generations hold only ops
+        at or below it and are deleted, like trimUnreferencedReaders.
+        """
+        self.sync()
+        self._file.close()
+        old_min = self._read_checkpoint().get("min_gen", 1)
+        self.generation += 1
+        self._file = open(self._gen_path(self.generation), "ab")
+        self._write_checkpoint(
+            generation=self.generation,
+            min_gen=self.generation,
+            persisted_seqno=persisted_seqno,
+        )
+        for gen in range(old_min, self.generation):
+            try:
+                os.remove(self._gen_path(gen))
+            except FileNotFoundError:
+                pass
+
+    # ---------------------------------------------------------- recovery path
+
+    @property
+    def persisted_seqno(self) -> int:
+        return self._read_checkpoint().get("persisted_seqno", -1)
+
+    def replay(self, above_seqno: int = -1) -> Iterator[dict]:
+        """Yield ops with seqno > above_seqno across live generations.
+
+        A torn final line (crash mid-append before fsync) is skipped — the
+        op was never acked durable, matching the reference's behavior of
+        truncating at the checkpointed offset.
+        """
+        ckp = self._read_checkpoint()
+        for gen in range(ckp.get("min_gen", 1), ckp["generation"] + 1):
+            gen_path = self._gen_path(gen)
+            if not os.path.exists(gen_path):
+                continue
+            with open(gen_path, "rb") as f:
+                for raw in f:
+                    try:
+                        op = json.loads(raw.decode("utf-8"))
+                    except (json.JSONDecodeError, UnicodeDecodeError):
+                        break  # torn tail write; nothing durable follows
+                    if op.get("seqno", -1) > above_seqno:
+                        yield op
+
+    def close(self) -> None:
+        self.sync()
+        self._file.close()

@@ -15,7 +15,9 @@ control-plane concern).
 from __future__ import annotations
 
 import json
+import os
 import re
+import shutil
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -60,10 +62,90 @@ class IndexService:
 
 
 class Node:
-    def __init__(self, node_name: str = "node-0", cluster_name: str = "es-tpu"):
+    def __init__(
+        self,
+        node_name: str = "node-0",
+        cluster_name: str = "es-tpu",
+        data_path: str | None = None,
+    ):
         self.node_name = node_name
         self.cluster_name = cluster_name
+        self.data_path = data_path
         self.indices: dict[str, IndexService] = {}
+        if data_path is not None:
+            os.makedirs(data_path, exist_ok=True)
+            self._recover_indices()
+
+    def _recover_indices(self) -> None:
+        """Boot recovery: re-open every index with persisted metadata
+        (the GatewayService/GatewayMetaState analog — cluster state here is
+        the set of index_meta.json files under the data path)."""
+        for name in sorted(os.listdir(self.data_path)):
+            meta_path = os.path.join(self.data_path, name, "index_meta.json")
+            if not os.path.exists(meta_path):
+                continue
+            with open(meta_path) as f:
+                meta = json.load(f)
+            self._open_index(
+                name, meta.get("mappings"), meta.get("settings", {})
+            )
+
+    def _index_dir(self, name: str) -> str | None:
+        if self.data_path is None:
+            return None
+        return os.path.join(self.data_path, name)
+
+    def _save_index_meta(self, svc: IndexService) -> None:
+        idx_dir = self._index_dir(svc.name)
+        if idx_dir is None:
+            return
+        os.makedirs(idx_dir, exist_ok=True)
+        tmp = os.path.join(idx_dir, "index_meta.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "mappings": svc.mappings.to_json(),
+                    "settings": svc.settings,
+                },
+                f,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(idx_dir, "index_meta.json"))
+
+    def _open_index(
+        self, name: str, mappings_json, settings: dict[str, Any]
+    ) -> IndexService:
+        params = BM25Params()
+        sim = settings.get("index", {}).get("similarity", {}).get("default", {})
+        if sim.get("type") in (None, "BM25"):
+            params = BM25Params(
+                k1=float(sim.get("k1", 1.2)), b=float(sim.get("b", 0.75))
+            )
+        try:
+            mappings = Mappings.from_json(mappings_json)
+        except ValueError as e:
+            raise ApiError(400, "mapper_parsing_exception", str(e)) from None
+        durability = (
+            settings.get("index", {}).get("translog", {}).get(
+                "durability", "request"
+            )
+        )
+        engine = Engine(
+            mappings,
+            params=params,
+            data_path=self._index_dir(name),
+            durability=durability,
+        )
+        svc = IndexService(
+            name=name,
+            mappings=mappings,
+            engine=engine,
+            search=SearchService(engine, name),
+            settings=settings,
+        )
+        self.indices[name] = svc
+        return svc
 
     # -------------------------------------------------------------- indices
 
@@ -79,35 +161,20 @@ class Node:
                 400, "invalid_index_name_exception", f"invalid index name [{name}]"
             )
         body = body or {}
-        settings = body.get("settings", {})
-        params = BM25Params()
-        sim = (
-            settings.get("index", {})
-            .get("similarity", {})
-            .get("default", {})
+        svc = self._open_index(
+            name, body.get("mappings"), body.get("settings", {})
         )
-        if sim.get("type") in (None, "BM25"):
-            params = BM25Params(
-                k1=float(sim.get("k1", 1.2)), b=float(sim.get("b", 0.75))
-            )
-        try:
-            mappings = Mappings.from_json(body.get("mappings"))
-        except ValueError as e:
-            raise ApiError(400, "mapper_parsing_exception", str(e)) from None
-        engine = Engine(mappings, params=params)
-        self.indices[name] = IndexService(
-            name=name,
-            mappings=mappings,
-            engine=engine,
-            search=SearchService(engine, name),
-            settings=settings,
-        )
+        self._save_index_meta(svc)
         return {"acknowledged": True, "shards_acknowledged": True, "index": name}
 
     def delete_index(self, name: str) -> dict:
         if name not in self.indices:
             raise index_not_found(name)
+        self.indices[name].engine.close()
         del self.indices[name]
+        idx_dir = self._index_dir(name)
+        if idx_dir is not None and os.path.isdir(idx_dir):
+            shutil.rmtree(idx_dir, ignore_errors=True)
         return {"acknowledged": True}
 
     def get_index(self, name: str, auto_create: bool = False) -> IndexService:
@@ -138,6 +205,7 @@ class Node:
                     f"[{existing.type}] to [{new.type}]",
                 )
             svc.mappings.fields[fname] = new
+        self._save_index_meta(svc)
         return {"acknowledged": True}
 
     # ------------------------------------------------------------ documents
@@ -148,12 +216,15 @@ class Node:
         source: dict[str, Any],
         doc_id: str | None = None,
         refresh: bool = False,
+        sync: bool = True,
     ) -> dict:
         svc = self.get_index(index, auto_create=True)
         try:
             result = svc.engine.index(source, doc_id)
         except ValueError as e:
             raise ApiError(400, "mapper_parsing_exception", str(e)) from None
+        if sync:  # request durability before the ack (bulk syncs once)
+            svc.engine.sync_translog()
         if refresh:
             svc.engine.refresh()
         return {
@@ -179,9 +250,13 @@ class Node:
             "_source": source,
         }
 
-    def delete_doc(self, index: str, doc_id: str, refresh: bool = False) -> dict:
+    def delete_doc(
+        self, index: str, doc_id: str, refresh: bool = False, sync: bool = True
+    ) -> dict:
         svc = self.get_index(index)
         result = svc.engine.delete(doc_id)
+        if sync:
+            svc.engine.sync_translog()
         if refresh:
             svc.engine.refresh()
         status = "deleted" if result["result"] == "deleted" else "not_found"
@@ -193,7 +268,12 @@ class Node:
         }
 
     def update_doc(
-        self, index: str, doc_id: str, body: dict[str, Any], refresh: bool = False
+        self,
+        index: str,
+        doc_id: str,
+        body: dict[str, Any],
+        refresh: bool = False,
+        sync: bool = True,
     ) -> dict:
         """Partial update: realtime get + merge + reindex (the reference's
         TransportUpdateAction/UpdateHelper flow, action/update/)."""
@@ -217,6 +297,8 @@ class Node:
             merged = dict(existing)
             merged.update(body.get("doc", {}))
         result = svc.engine.index(merged, doc_id)
+        if sync:
+            svc.engine.sync_translog()
         if refresh:
             svc.engine.refresh()
         return {
@@ -266,19 +348,19 @@ class Node:
                             "version_conflict_engine_exception",
                             f"[{doc_id}]: version conflict, document already exists",
                         )
-                    resp = self.index_doc(index, source, doc_id)
+                    resp = self.index_doc(index, source, doc_id, sync=False)
                     touched.add(index)
                     status = 201 if resp["result"] == "created" else 200
                     items.append({op: {**resp, "status": status}})
                 elif op == "delete":
-                    resp = self.delete_doc(index, doc_id)
+                    resp = self.delete_doc(index, doc_id, sync=False)
                     touched.add(index)
                     status = 200 if resp["result"] == "deleted" else 404
                     items.append({op: {**resp, "status": status}})
                 elif op == "update":
                     body_line = json.loads(lines[i])
                     i += 1
-                    resp = self.update_doc(index, doc_id, body_line)
+                    resp = self.update_doc(index, doc_id, body_line, sync=False)
                     touched.add(index)
                     items.append({op: {**resp, "status": 200}})
                 else:
@@ -300,6 +382,9 @@ class Node:
                         }
                     }
                 )
+        for index in touched:  # one fsync per bulk request, not per item
+            if index in self.indices:
+                self.indices[index].engine.sync_translog()
         if refresh:
             for index in touched:
                 if index in self.indices:
@@ -334,6 +419,15 @@ class Node:
         svc = self.get_index(index)
         svc.engine.refresh()
         return {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def flush(self, index: str) -> dict:
+        svc = self.get_index(index)
+        svc.engine.flush()
+        return {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def close(self) -> None:
+        for svc in self.indices.values():
+            svc.engine.close()
 
     # ---------------------------------------------------------------- admin
 

@@ -279,6 +279,99 @@ def _execute_inner(seg, spec, arrays, k: int):
     return top_scores, top_ids.astype(jnp.int32), total
 
 
+# ---------------------------------------------------------------------------
+# Sparse (candidate-centric) execution for pure term-disjunction specs.
+#
+# The dense path scatter-adds into an [N] score vector (TPU scatter is slow —
+# ~66M updates/s measured — and top_k over [Q, N] scales with corpus size).
+# For the hot query shape — a `terms` disjunction, i.e. every match query —
+# the candidate-centric kernel instead:
+#
+#   1. gathers the worklist tiles -> (doc, contrib) pairs [P], P = NT*TILE;
+#   2. STABLY sorts pairs by doc id (stability keeps same-doc entries in
+#      worklist order = query-term order);
+#   3. sums each doc-run with T_pad static shifted adds — a LEFT FOLD in
+#      term order, reproducing the oracle's (and the reference's per-term
+#      BulkScorer accumulation, ContextIndexSearcher.java:170-206) fp32
+#      rounding exactly;
+#   4. takes top-k over the run heads: positions ascend by doc id, so
+#      lax.top_k's lowest-index tie-break IS Lucene's doc-id tie-break.
+#
+# Work scales with postings touched (like Lucene's term iteration), not with
+# corpus size — the property that lets one chip hold its ground at 10M docs.
+# ---------------------------------------------------------------------------
+
+
+def supports_sparse(spec) -> bool:
+    """Sparse execution covers precomputed-impact term disjunctions."""
+    return spec[0] == "terms"
+
+
+def _sparse_inner(seg, spec, arrays, k: int):
+    """Candidate-centric top-k for a ("terms", field, NT, TP) spec."""
+    live = seg["live"]
+    num_docs = live.shape[0]
+    t_pad = spec[3]
+    docs, tn, valid, _norm = _gather_tiles(spec, arrays, seg, want="tn")
+    w = arrays["weights"][:, None]
+    contrib = w - w / (jnp.float32(1.0) + tn)
+    sentinel = jnp.int32(num_docs)
+    docs = jnp.where(valid, docs, sentinel).reshape(-1)  # [P]
+    contrib = jnp.where(valid, contrib, jnp.float32(0.0)).reshape(-1)
+    p = docs.shape[0]
+    docs_s, contrib_s = jax.lax.sort(
+        (docs, contrib), num_keys=1, is_stable=True
+    )
+    # Left-fold run sums via static shifts: run length <= total query-term
+    # occurrences (a doc appears in exactly one tile per term occurrence),
+    # bounded by the spec's T_pad bucket.
+    docs_ext = jnp.concatenate(
+        [docs_s, jnp.full(t_pad, num_docs + 1, dtype=docs_s.dtype)]
+    )
+    contrib_ext = jnp.concatenate(
+        [contrib_s, jnp.zeros(t_pad, dtype=contrib_s.dtype)]
+    )
+    run_sum = contrib_s
+    for j in range(1, t_pad):
+        same = docs_ext[j : j + p] == docs_s
+        run_sum = run_sum + jnp.where(
+            same, contrib_ext[j : j + p], jnp.float32(0.0)
+        )
+    is_start = jnp.concatenate(
+        [jnp.ones(1, dtype=bool), docs_s[1:] != docs_s[:-1]]
+    )
+    in_range = docs_s != sentinel
+    # Clamped gather: sentinel rows are masked by in_range regardless.
+    live_at = live[jnp.minimum(docs_s, sentinel - 1)]
+    eligible = is_start & in_range & live_at
+    key = jnp.where(eligible, run_sum, jnp.float32(NEG_INF))
+    kk = min(k, num_docs)
+    kp = min(kk, p)
+    top_scores, top_pos = jax.lax.top_k(key, kp)
+    top_ids = docs_s[top_pos]
+    if kp < kk:  # more hits requested than candidate slots: pad
+        top_scores = jnp.pad(
+            top_scores, (0, kk - kp), constant_values=NEG_INF
+        )
+        top_ids = jnp.pad(top_ids, (0, kk - kp), constant_values=0)
+    total = jnp.sum(eligible, dtype=jnp.int32)
+    return top_scores, top_ids.astype(jnp.int32), total
+
+
+@partial(jax.jit, static_argnames=("spec", "k"))
+def execute_sparse(seg, spec, arrays, k: int):
+    """Candidate-centric execution of a pure terms spec (see block comment)."""
+    return _sparse_inner(seg, spec, arrays, k)
+
+
+@partial(jax.jit, static_argnames=("spec", "k"))
+def execute_batch_sparse(seg, spec, arrays_batched, k: int):
+    """Batched candidate-centric execution ([Q, ...] plan arrays)."""
+    return jax.vmap(lambda arrays: _sparse_inner(seg, spec, arrays, k))(
+        arrays_batched
+    )
+
+
 @partial(jax.jit, static_argnames=("spec", "k"))
 def execute(seg, spec, arrays, k: int):
     """Run a compiled query plan over one device segment.
@@ -326,13 +419,21 @@ def execute_score_asc(seg, spec, arrays, k: int):
     return -neg_top, top_ids.astype(jnp.int32), total
 
 
+def execute_auto(seg, spec, arrays, k: int):
+    """Single-query execution via the best kernel for the spec."""
+    if supports_sparse(spec):
+        return execute_sparse(seg, spec, arrays, k)
+    return execute(seg, spec, arrays, k)
+
+
 def execute_many(seg, compiled_queries, k: int):
     """Grouped msearch: batch same-spec queries, one launch per shape group.
 
     Queries keep their natural pow-2 worklist buckets (no padding to the
     global max), so total device work tracks actual postings touched; the
-    per-launch round-trip is amortized within each group. Returns results
-    in input order: a list of (scores f32[k], ids i32[k], total int).
+    per-launch round-trip is amortized within each group. Term-disjunction
+    groups run on the candidate-centric kernel. Returns results in input
+    order: a list of (scores f32[k], ids i32[k], total int).
     """
     from collections import defaultdict
 
@@ -345,12 +446,104 @@ def execute_many(seg, compiled_queries, k: int):
             lambda *xs: jnp.stack(xs),
             *[compiled_queries[p].arrays for p in positions],
         )
+        kernel = execute_batch_sparse if supports_sparse(spec) else execute_batch
         scores_b, ids_b, totals_b = jax.device_get(
-            execute_batch(seg, spec, arrays_b, k)
+            kernel(seg, spec, arrays_b, k)
         )
         for row, p in enumerate(positions):
             results[p] = (scores_b[row], ids_b[row], int(totals_b[row]))
     return results
+
+
+def execute_batch_blockmax(seg, spec, arrays_list, k: int):
+    """Two-launch thresholded batch execution — the block-max WAND analog.
+
+    Lucene skips non-competitive posting blocks against the collector's
+    running k-th score (block-max WAND, enabled by search/query/
+    TopDocsCollectorContext.java:68). Data-dependent pointer skipping is
+    XLA-hostile, so the TPU form is *tile filtering* (SURVEY §7):
+
+      launch 1: sparse-score each query's A highest-upper-bound worklist
+                entries; θ[q] = k-th best partial run sum — partial sums
+                are lower bounds on full scores, so θ lower-bounds the
+                final k-th score;
+      host:     drop every entry whose tile upper bound plus the other
+                terms' global upper bounds can't reach θ (with an fp32
+                safety margin), then re-bucket the survivors — typically a
+                much smaller pow-2 worklist;
+      launch 2: sparse-score the surviving entries exactly.
+
+    Soundness: a pruned tile only contains docs whose full score is < θ ≤
+    final k-th score, so no top-k doc loses a contribution — top-k ids and
+    scores are exact. Total hits become lower bounds (docs matched only by
+    pruned tiles go uncounted) — precisely Lucene's `"relation": "gte"`
+    totals under WAND skipping.
+
+    Returns (scores [Q,k'], ids [Q,k'], totals [Q], relation) with
+    relation "gte" when any pruning occurred, else "eq".
+    """
+    nt = spec[2]
+    kind, field_name, _, t_pad = spec
+    a_bucket = max(8, nt // 4)
+    if a_bucket >= nt:  # tiny worklists: single launch, exact totals
+        arrays_b = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *arrays_list
+        )
+        s, i, t = jax.device_get(execute_batch_sparse(seg, spec, arrays_b, k))
+        return s, i, t, "eq"
+
+    # Launch 1: top-UB subset. (Reordering is safe here — phase-A scores
+    # are only used as lower bounds; exact accumulation order matters only
+    # in the final launch.)
+    spec_a = (kind, field_name, a_bucket, t_pad)
+    phase_a = []
+    for arrays in arrays_list:
+        order = np.argsort(-arrays["ub"], kind="stable")[:a_bucket]
+        phase_a.append(
+            {
+                "tile_ids": arrays["tile_ids"][order],
+                "starts": arrays["starts"][order],
+                "ends": arrays["ends"][order],
+                "weights": arrays["weights"][order],
+                "ub": arrays["ub"][order],
+                "ub_other": arrays["ub_other"][order],
+            }
+        )
+    arrays_a = jax.tree.map(lambda *xs: jnp.stack(xs), *phase_a)
+    scores_a, _, _ = jax.device_get(
+        execute_batch_sparse(seg, spec_a, arrays_a, k)
+    )
+    thetas = scores_a[:, k - 1] if scores_a.shape[1] >= k else np.full(
+        len(arrays_list), -np.inf, dtype=np.float32
+    )
+
+    # Host prune + re-bucket (order-preserving: the exact left-fold in
+    # launch 2 needs original worklist order).
+    keeps = []
+    pruned_any = False
+    for arrays, theta in zip(arrays_list, thetas):
+        if not np.isfinite(theta):
+            keep = np.ones(nt, dtype=bool)
+        else:
+            margin = np.float32(theta) * np.float32(1 - 1e-6) - np.float32(1e-6)
+            keep = (arrays["ub"] + arrays["ub_other"]) >= margin
+        keeps.append(keep)
+        pruned_any = pruned_any or (not keep.all())
+    max_survivors = max(1, max(int(kp.sum()) for kp in keeps))
+    nt_b = 1 << (max_survivors - 1).bit_length()
+    spec_b = (kind, field_name, nt_b, t_pad)
+    phase_b = []
+    for arrays, keep in zip(arrays_list, keeps):
+        out = {}
+        n_keep = int(keep.sum())
+        for name in ("tile_ids", "starts", "ends", "weights", "ub", "ub_other"):
+            col = np.zeros(nt_b, dtype=arrays[name].dtype)
+            col[:n_keep] = arrays[name][keep]
+            out[name] = col  # padding rows: starts == ends -> never valid
+        phase_b.append(out)
+    arrays_b = jax.tree.map(lambda *xs: jnp.stack(xs), *phase_b)
+    s, i, t = jax.device_get(execute_batch_sparse(seg, spec_b, arrays_b, k))
+    return s, i, t, ("gte" if pruned_any else "eq")
 
 
 @partial(jax.jit, static_argnames=("spec", "field_name", "desc", "k"))

@@ -144,7 +144,11 @@ def _terms_arrays(
         and params.b == dfield.tn_b
     )
 
-    entries: list[tuple[int, int, int, float]] = []  # (tile, start, end, w)
+    tile_max = getattr(dfield, "tile_max", None)  # f32[num_tiles] max impact
+    f32max = float(np.finfo(np.float32).max)
+    entries: list[tuple[int, int, int, float, float]] = []
+    term_ubs: list[float] = []  # per term-occurrence global upper bound
+    entry_term: list[int] = []  # entry -> term occurrence index
     for term in terms:
         s, e = dfield.term_span(term)
         if e <= s:
@@ -159,25 +163,58 @@ def _terms_arrays(
             if df > 0 and doc_count > 0:
                 w = term_weight(df, doc_count, boost, params)
         first, last = s // TILE, (e - 1) // TILE
+        term_tm = 0.0
         for tile in range(first, last + 1):
-            entries.append((tile, s, e, w))
+            # Block-max analog (reference: Lucene block-max WAND configured
+            # at search/query/TopDocsCollectorContext.java:68): upper-bound
+            # this term's contribution to any doc in this tile from the
+            # pack-time per-tile max impact. The whole-tile max >= the
+            # span-restricted max, so the bound stays valid at
+            # term-boundary tiles.
+            if tile_max is not None and use_tn:
+                tm = float(tile_max[tile])
+                ub = w - w / (1.0 + tm) if w > 0 else 0.0
+                term_tm = max(term_tm, tm)
+            else:
+                ub = f32max
+            entries.append((tile, s, e, w, ub))
+            entry_term.append(len(term_ubs))
+        if tile_max is not None and use_tn:
+            term_ubs.append(w - w / (1.0 + term_tm) if w > 0 else 0.0)
+        else:
+            term_ubs.append(f32max)
 
     nt = _pow2(len(entries), nt_floor)
     tile_ids = np.full(nt, dfield.pad_tile, dtype=np.int32)
     starts = np.zeros(nt, dtype=np.int32)
     ends = np.zeros(nt, dtype=np.int32)
     weights = np.zeros(nt, dtype=np.float32)
-    for i, (tile, s, e, w) in enumerate(entries):
+    ubs = np.zeros(nt, dtype=np.float32)
+    ub_other = np.zeros(nt, dtype=np.float32)
+    total_ub = min(float(sum(term_ubs)), f32max)
+    for i, (tile, s, e, w, ub) in enumerate(entries):
         tile_ids[i] = tile
         starts[i] = s
         ends[i] = e
         weights[i] = w
+        ubs[i] = np.float32(min(ub, f32max))
+        ub_other[i] = np.float32(
+            min(max(total_ub - term_ubs[entry_term[i]], 0.0), f32max)
+        )
 
     kind = ("terms" if use_tn else "terms_gather") if scored else "terms_const"
-    spec = (kind, dfield.name, nt)
+    if scored:
+        # T_pad bounds candidates per doc (= total term occurrences; each
+        # occurrence yields at most one posting per doc), pow-2 bucketed —
+        # the sparse kernel's run-fold length (ops/bm25_device.py).
+        spec = (kind, dfield.name, nt, _pow2(len(terms)))
+    else:
+        spec = (kind, dfield.name, nt)
     arrays = {"tile_ids": tile_ids, "starts": starts, "ends": ends}
     if scored:
         arrays["weights"] = weights
+        arrays["ub"] = ubs
+        arrays["ub_other"] = ub_other
         if not use_tn:
             cache = norm_inverse_cache(avgdl if doc_count else 1.0, params)
             if not dfield.has_norms:

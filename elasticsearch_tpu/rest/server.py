@@ -47,8 +47,8 @@ def _json(body: str) -> dict:
 
 
 class RestServer:
-    def __init__(self, node: Node | None = None):
-        self.node = node or Node()
+    def __init__(self, node: Node | None = None, data_path: str | None = None):
+        self.node = node or Node(data_path=data_path)
         self.routes: list[tuple[str, re.Pattern, Handler]] = []
         self._register_routes()
 
@@ -92,6 +92,7 @@ class RestServer:
             ))
         r("POST", "/{index}/_refresh", lambda s, p, q, b: n.refresh(p["index"]))
         r("GET", "/{index}/_refresh", lambda s, p, q, b: n.refresh(p["index"]))
+        r("POST", "/{index}/_flush", lambda s, p, q, b: n.flush(p["index"]))
         r("POST", "/{index}/_analyze", self._analyze)
         r("POST", "/{index}/_doc", lambda s, p, q, b: n.index_doc(
             p["index"], _json(b), None, refresh=q.get("refresh") in ("true", "")
@@ -236,9 +237,11 @@ class RestServer:
         return server
 
 
-def create_server(host: str = "127.0.0.1", port: int = 9200):
+def create_server(
+    host: str = "127.0.0.1", port: int = 9200, data_path: str | None = None
+):
     """(http_server, rest) pair; call http_server.serve_forever() to run."""
-    rest = RestServer()
+    rest = RestServer(data_path=data_path)
     return rest.serve(host, port), rest
 
 
@@ -248,8 +251,13 @@ def main():
     parser = argparse.ArgumentParser(description="elasticsearch-tpu node")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=9200)
+    parser.add_argument(
+        "--data-path",
+        default=None,
+        help="enable durability: per-index translog + segment persistence",
+    )
     args = parser.parse_args()
-    server, rest = create_server(args.host, args.port)
+    server, rest = create_server(args.host, args.port, args.data_path)
     print(
         json.dumps(
             {

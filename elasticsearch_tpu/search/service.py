@@ -262,7 +262,7 @@ class SearchService:
                     seg_tree, compiled.spec, compiled.arrays, k
                 )
             else:
-                scores, ids, tot = bm25_device.execute(
+                scores, ids, tot = bm25_device.execute_auto(
                     seg_tree, compiled.spec, compiled.arrays, fetch_k
                 )
             scores, ids = np.asarray(scores), np.asarray(ids)

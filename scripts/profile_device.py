@@ -1,0 +1,126 @@
+"""Microprofile of the device query path on the real TPU.
+
+Isolates: (a) pure per-batch compute with pre-staged inputs, (b) plan-array
+upload cost, (c) scatter vs top_k split, at two corpus scales.
+Run: python scripts/profile_device.py
+"""
+
+import time
+
+import numpy as np
+
+
+def timeit(fn, reps=10):
+    fn()  # warmup / compile
+    import jax
+
+    t0 = time.monotonic()
+    out = None
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.monotonic() - t0) / reps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    print("platform:", jax.devices()[0].platform, flush=True)
+
+    for n_docs in (100_000, 1_000_000):
+        print(f"\n===== n_docs={n_docs} =====", flush=True)
+        Q = 256  # query batch
+        NT = 64  # tiles per query worklist
+        TILE = 256
+        total_tiles = 4096 if n_docs <= 100_000 else 32768
+        rng = np.random.default_rng(0)
+
+        doc_tiles = jnp.asarray(
+            rng.integers(0, n_docs, size=(total_tiles, TILE), dtype=np.int32)
+        )
+        tn_tiles = jnp.asarray(
+            rng.random((total_tiles, TILE), dtype=np.float32)
+        )
+        tile_ids = jnp.asarray(
+            rng.integers(0, total_tiles, size=(Q, NT), dtype=np.int32)
+        )
+        weights = jnp.asarray(rng.random((Q, NT), dtype=np.float32))
+        live = jnp.ones(n_docs, dtype=bool)
+        jax.block_until_ready((doc_tiles, tn_tiles, tile_ids, weights))
+
+        k = 10
+
+        @jax.jit
+        def score_only(tile_ids, weights):
+            def one(tids, w):
+                docs = doc_tiles[tids]  # [NT, TILE]
+                tn = tn_tiles[tids]
+                contrib = w[:, None] - w[:, None] / (1.0 + tn)
+                scores = (
+                    jnp.zeros(n_docs + 1, dtype=jnp.float32)
+                    .at[docs]
+                    .add(contrib)[:n_docs]
+                )
+                return scores
+
+            return jax.vmap(one)(tile_ids, weights)
+
+        @jax.jit
+        def full(tile_ids, weights):
+            scores = score_only(tile_ids, weights)
+            s, i = jax.lax.top_k(scores, k)
+            return s, i
+
+        @jax.jit
+        def topk_only(scores):
+            return jax.lax.top_k(scores, k)
+
+        @jax.jit
+        def topk_twolevel(scores):
+            G = 250
+            s2 = scores.reshape(Q, G, -1)
+            ls, li = jax.lax.top_k(s2, k)  # [Q, G, k]
+            base = (jnp.arange(G, dtype=jnp.int32) * s2.shape[-1])[None, :, None]
+            gi = li.astype(jnp.int32) + base
+            fs, fi = jax.lax.top_k(ls.reshape(Q, -1), k)
+            gi_flat = gi.reshape(Q, -1)
+            return fs, jnp.take_along_axis(gi_flat, fi, axis=1)
+
+        scores = score_only(tile_ids, weights)
+        jax.block_until_ready(scores)
+
+        t_score = timeit(lambda: score_only(tile_ids, weights))
+        print(f"score-only (scatter) per batch of {Q}: {t_score*1e3:.2f} ms", flush=True)
+        t_full = timeit(lambda: full(tile_ids, weights))
+        print(f"full (score+topk):                    {t_full*1e3:.2f} ms", flush=True)
+        t_topk = timeit(lambda: topk_only(scores))
+        print(f"topk alone [Q={Q}, N={n_docs}]:        {t_topk*1e3:.2f} ms", flush=True)
+        t_topk2 = timeit(lambda: topk_twolevel(scores))
+        print(f"topk two-level:                        {t_topk2*1e3:.2f} ms", flush=True)
+
+        # parity of two-level topk
+        s1, i1 = topk_only(scores)
+        s2_, i2 = topk_twolevel(scores)
+        ok = bool(jnp.all(s1 == s2_))
+        print("two-level topk score parity:", ok, flush=True)
+
+        # upload cost: fresh numpy -> device of the per-query plan arrays
+        def upload():
+            a = jax.device_put(
+                np.ascontiguousarray(
+                    rng.integers(0, total_tiles, size=(Q, NT), dtype=np.int32)
+                )
+            )
+            b = jax.device_put(rng.random((Q, NT), dtype=np.float32))
+            return a, b
+
+        t_up = timeit(upload, reps=5)
+        print(f"fresh plan upload per batch:           {t_up*1e3:.2f} ms", flush=True)
+
+        t_e2e = timeit(lambda: full(*upload()), reps=5)
+        print(f"upload+full e2e:                       {t_e2e*1e3:.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,192 @@
+"""Durability: translog WAL, flush/commit, restart recovery.
+
+The verdict's acceptance test: index, kill the process, restart, get
+identical search results. Simulated both in-process (fresh Engine/Node over
+the same data dir) and across real processes (subprocess kill -9).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.index.translog import Translog
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture
+def mappings():
+    return Mappings.from_json(
+        {
+            "properties": {
+                "body": {"type": "text"},
+                "n": {"type": "long"},
+            }
+        }
+    )
+
+
+class TestTranslog:
+    def test_append_sync_replay(self, tmp_path):
+        tl = Translog(str(tmp_path / "tl"))
+        for i in range(5):
+            tl.add({"seqno": i, "op": "index", "id": str(i), "source": {"a": i}})
+        tl.sync()
+        tl.close()
+        tl2 = Translog(str(tmp_path / "tl"))
+        ops = list(tl2.replay(above_seqno=1))
+        assert [op["seqno"] for op in ops] == [2, 3, 4]
+
+    def test_roll_trims_generations(self, tmp_path):
+        tl = Translog(str(tmp_path / "tl"))
+        for i in range(3):
+            tl.add({"seqno": i, "op": "index", "id": str(i), "source": {}})
+        tl.roll(persisted_seqno=2)
+        tl.add({"seqno": 3, "op": "index", "id": "3", "source": {}})
+        tl.sync()
+        assert [op["seqno"] for op in tl.replay(above_seqno=2)] == [3]
+        # old generation file deleted
+        assert not os.path.exists(str(tmp_path / "tl" / "translog-1.log"))
+        tl.close()
+
+    def test_torn_tail_skipped(self, tmp_path):
+        tl = Translog(str(tmp_path / "tl"))
+        tl.add({"seqno": 0, "op": "index", "id": "0", "source": {}})
+        tl.sync()
+        tl.close()
+        # simulate a torn write: partial JSON at the tail
+        gen = str(tmp_path / "tl" / "translog-1.log")
+        with open(gen, "ab") as f:
+            f.write(b'{"seqno": 1, "op": "in')
+        tl2 = Translog(str(tmp_path / "tl"))
+        assert [op["seqno"] for op in tl2.replay()] == [0]
+        tl2.close()
+
+
+class TestEngineRecovery:
+    def test_unflushed_ops_replay_from_translog(self, tmp_path, mappings):
+        path = str(tmp_path / "idx")
+        e1 = Engine(mappings, data_path=path)
+        e1.index({"body": "hello world", "n": 1}, "a")
+        e1.index({"body": "hello there", "n": 2}, "b")
+        e1.sync_translog()
+        # no flush, no refresh — crash now
+        e2 = Engine(mappings, data_path=path)
+        assert e2.get("a") == {"body": "hello world", "n": 1}
+        assert e2.get("b") == {"body": "hello there", "n": 2}
+        assert e2.num_docs == 2  # replay ends with a refresh
+        assert e2.max_seqno == e1.max_seqno
+
+    def test_flush_then_restart(self, tmp_path, mappings):
+        path = str(tmp_path / "idx")
+        e1 = Engine(mappings, data_path=path)
+        for i in range(20):
+            e1.index({"body": f"doc number {i} common", "n": i}, f"d{i}")
+        e1.flush()
+        e1.delete("d3")
+        e1.index({"body": "updated doc common", "n": 99}, "d4")
+        e1.sync_translog()
+
+        e2 = Engine(mappings, data_path=path)
+        assert e2.get("d3") is None
+        assert e2.get("d4") == {"body": "updated doc common", "n": 99}
+        assert e2.num_docs == 19
+        # search parity across restart
+        from elasticsearch_tpu.search.service import SearchRequest, SearchService
+
+        req = SearchRequest.from_json({"query": {"match": {"body": "common"}}, "size": 25})
+        e1.refresh()
+        h1 = SearchService(e1).search(req)
+        h2 = SearchService(e2).search(req)
+        assert [h.doc_id for h in h1.hits] == [h.doc_id for h in h2.hits]
+        assert [h.score for h in h1.hits] == pytest.approx(
+            [h.score for h in h2.hits]
+        )
+
+    def test_flush_is_idempotent_and_gc_safe(self, tmp_path, mappings):
+        path = str(tmp_path / "idx")
+        e1 = Engine(mappings, data_path=path)
+        e1.index({"body": "one"}, "1")
+        e1.flush()
+        e1.flush()
+        e1.index({"body": "two"}, "2")
+        e1.flush()
+        e2 = Engine(mappings, data_path=path)
+        assert e2.num_docs == 2
+        assert len(e2.segments) == 2
+
+    def test_auto_id_counter_recovers(self, tmp_path, mappings):
+        path = str(tmp_path / "idx")
+        e1 = Engine(mappings, data_path=path)
+        r1 = e1.index({"body": "x"})
+        e1.sync_translog()
+        e2 = Engine(mappings, data_path=path)
+        r2 = e2.index({"body": "y"})
+        assert r2["_id"] != r1["_id"]
+
+
+class TestNodeRecovery:
+    def test_node_restart_in_process(self, tmp_path):
+        data = str(tmp_path / "data")
+        n1 = Node(data_path=data)
+        n1.create_index(
+            "logs",
+            {"mappings": {"properties": {"msg": {"type": "text"}}}},
+        )
+        for i in range(10):
+            n1.index_doc("logs", {"msg": f"event {i} alpha"}, f"e{i}")
+        n1.flush("logs")
+        n1.index_doc("logs", {"msg": "late event alpha"}, "late")
+        n1.close()
+
+        n2 = Node(data_path=data)
+        assert "logs" in n2.indices
+        r = n2.search("logs", {"query": {"match": {"msg": "alpha"}}, "size": 20})
+        ids = {h["_id"] for h in r["hits"]["hits"]}
+        assert ids == {f"e{i}" for i in range(10)} | {"late"}
+
+    def test_node_restart_subprocess_kill9(self, tmp_path):
+        """The real thing: a REST node killed with SIGKILL mid-life."""
+        data = str(tmp_path / "data")
+        script = f"""
+import sys
+sys.path.insert(0, {json.dumps(os.getcwd())})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from elasticsearch_tpu.node import Node
+node = Node(data_path={json.dumps(data)})
+node.create_index("k", {{"mappings": {{"properties": {{"t": {{"type": "text"}}}}}}}})
+for i in range(8):
+    node.index_doc("k", {{"t": f"word {{i}}"}}, f"w{{i}}")
+node.flush("k")
+node.index_doc("k", {{"t": "word unflushed"}}, "w8")
+print("READY", flush=True)
+import time
+time.sleep(30)
+"""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.PIPE,
+            env=env,
+        )
+        try:
+            line = proc.stdout.readline().decode()
+            assert "READY" in line, line
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+        n2 = Node(data_path=data)
+        r = n2.search("k", {"query": {"match": {"t": "word"}}, "size": 20})
+        ids = {h["_id"] for h in r["hits"]["hits"]}
+        assert ids == {f"w{i}" for i in range(9)}
