@@ -31,6 +31,8 @@ Translog/commitIndexWriter/recoverFromTranslog cycle of the reference
 from __future__ import annotations
 
 import os
+import threading
+import time
 from dataclasses import dataclass
 from typing import Any
 
@@ -43,6 +45,19 @@ from .mapping import Mappings
 from .segment import Segment, SegmentBuilder
 from .tiles import DeviceSegment, pack_segment, repack_tn
 from .translog import Translog
+
+
+class VersionConflictError(Exception):
+    """Seqno/term CAS failure — maps to HTTP 409 version_conflict_engine_exception.
+
+    The engine-level contract of the reference's if_seq_no/if_primary_term
+    compare-and-set (action/index/IndexRequest.java:109, enforced in
+    InternalEngine.planIndexingAsPrimary's version-map check).
+    """
+
+    def __init__(self, doc_id: str, reason: str):
+        super().__init__(f"[{doc_id}]: version conflict, {reason}")
+        self.doc_id = doc_id
 
 
 @dataclass
@@ -89,12 +104,31 @@ class Engine:
         self.params = params
         self.device = device
         self.segments: list[SegmentHandle] = []
+        # Serializes the whole write path (index/delete/refresh/flush and
+        # the version map) — the REST layer dispatches concurrent requests
+        # from ThreadingHTTPServer, and seqno assignment, buffer mutation,
+        # and the flush/roll window must be atomic with respect to each
+        # other (the reference guards the same invariants with
+        # InternalEngine's versionMap + readLock/writeLock).
+        self.lock = threading.RLock()
         self._buffer = SegmentBuilder(self.mappings)
         self._buffer_ids: dict[str, int] = {}  # _id -> local doc in buffer
         self._buffer_deleted: set[int] = set()  # buffer locals dropped pre-refresh
         self._live_ids: dict[str, tuple[int, int]] = {}  # _id -> (seg idx, local)
         self._seqno = -1
         self._auto_id = 0
+        self.primary_term = 1
+        # Version map: _id -> latest op version, kept across deletes
+        # (tombstones) so re-creating a deleted doc continues its version
+        # line, like the reference's LiveVersionMap delete tombstones.
+        # Tombstones persist in the commit point and are pruned after
+        # gc_deletes (ES index.gc_deletes, default 60s) — after that a
+        # re-create legitimately restarts at version 1, exactly like the
+        # reference after tombstone GC.
+        self._versions: dict[str, int] = {}
+        self._doc_seqnos: dict[str, int] = {}  # _id -> seqno of last op
+        self._tombstone_ts: dict[str, float] = {}  # _id -> delete wall time
+        self.gc_deletes_s = 60.0
         self._stats_cache: dict[str, FieldStats] | None = None
         self.data_path = data_path
         self.translog: Translog | None = None
@@ -117,35 +151,130 @@ class Engine:
     def max_seqno(self) -> int:
         return self._seqno
 
-    def index(self, source: dict[str, Any], doc_id: str | None = None) -> dict:
-        """Index (create or overwrite) one document. Returns op metadata."""
-        if doc_id is None:
-            doc_id = f"_auto_{self._auto_id}"
-            self._auto_id += 1
-        created = self._delete_existing(doc_id) == 0
-        local = self._buffer.add(source, doc_id)
-        self._buffer_ids[doc_id] = local
-        seqno = self.next_seqno()
-        if self.translog is not None:
-            self.translog.add(
-                {"seqno": seqno, "op": "index", "id": doc_id, "source": source}
+    def _check_cas(
+        self, doc_id: str, if_seq_no: int | None, if_primary_term: int | None
+    ) -> None:
+        """Enforce the if_seq_no/if_primary_term compare-and-set contract."""
+        if if_seq_no is None and if_primary_term is None:
+            return
+        if if_seq_no is None or if_primary_term is None:
+            # The reference rejects one-sided CAS up front with 400
+            # (IndexRequest.validate: "ifSeqNo is unassigned, but primary
+            # term is [x]").
+            raise ValueError(
+                "if_seq_no and if_primary_term must be provided together"
             )
-        return {
-            "_id": doc_id,
-            "result": "created" if created else "updated",
-            "_seq_no": seqno,
-        }
+        exists = doc_id in self._buffer_ids or doc_id in self._live_ids
+        if not exists:
+            raise VersionConflictError(
+                doc_id,
+                f"required seqNo [{if_seq_no}], but no document was found",
+            )
+        cur_seq = self._doc_seqnos.get(doc_id, -1)
+        if cur_seq != if_seq_no:
+            raise VersionConflictError(
+                doc_id,
+                f"required seqNo [{if_seq_no}], current document has "
+                f"seqNo [{cur_seq}]",
+            )
+        if if_primary_term != self.primary_term:
+            raise VersionConflictError(
+                doc_id,
+                f"required primaryTerm [{if_primary_term}], current "
+                f"primaryTerm [{self.primary_term}]",
+            )
 
-    def delete(self, doc_id: str) -> dict:
-        found = self._delete_existing(doc_id) > 0
-        seqno = self.next_seqno() if found else self._seqno
-        if found and self.translog is not None:
-            self.translog.add({"seqno": seqno, "op": "delete", "id": doc_id})
-        return {
-            "_id": doc_id,
-            "result": "deleted" if found else "not_found",
-            "_seq_no": seqno,
-        }
+    def index(
+        self,
+        source: dict[str, Any],
+        doc_id: str | None = None,
+        if_seq_no: int | None = None,
+        if_primary_term: int | None = None,
+        op_type: str = "index",
+    ) -> dict:
+        """Index (create or overwrite) one document. Returns op metadata.
+
+        op_type="create" enforces put-if-absent inside the engine lock (the
+        reference's IndexRequest.opType CREATE → version conflict when the
+        doc exists), closing the get-then-index race window.
+        """
+        with self.lock:
+            if doc_id is None:
+                doc_id = f"_auto_{self._auto_id}"
+                self._auto_id += 1
+            self._check_cas(doc_id, if_seq_no, if_primary_term)
+            exists = doc_id in self._buffer_ids or doc_id in self._live_ids
+            if op_type == "create" and exists:
+                raise VersionConflictError(
+                    doc_id, "document already exists"
+                )
+            version = self._versions.get(doc_id, 0) + 1
+            seqno = self.next_seqno()
+            try:
+                # SegmentBuilder.add is atomic (stage-then-commit), so a
+                # mapper failure here leaves no partial doc; the seqno is
+                # handed back and no prior copy has been tombstoned yet.
+                local = self._buffer.add(
+                    source, doc_id, version=version, seqno=seqno
+                )
+            except ValueError:
+                self._seqno -= 1
+                raise
+            created = not exists
+            self._delete_existing(doc_id)
+            self._buffer_ids[doc_id] = local
+            self._versions[doc_id] = version
+            self._doc_seqnos[doc_id] = seqno
+            self._tombstone_ts.pop(doc_id, None)
+            if self.translog is not None:
+                self.translog.add(
+                    {
+                        "seqno": seqno,
+                        "op": "index",
+                        "id": doc_id,
+                        "version": version,
+                        "source": source,
+                    }
+                )
+            return {
+                "_id": doc_id,
+                "result": "created" if created else "updated",
+                "_seq_no": seqno,
+                "_version": version,
+                "_primary_term": self.primary_term,
+            }
+
+    def delete(
+        self,
+        doc_id: str,
+        if_seq_no: int | None = None,
+        if_primary_term: int | None = None,
+    ) -> dict:
+        with self.lock:
+            self._check_cas(doc_id, if_seq_no, if_primary_term)
+            found = self._delete_existing(doc_id) > 0
+            version = self._versions.get(doc_id, 0) + (1 if found else 0)
+            seqno = self.next_seqno() if found else self._seqno
+            if found:
+                self._versions[doc_id] = version
+                self._doc_seqnos[doc_id] = seqno
+                self._tombstone_ts[doc_id] = time.time()
+                if self.translog is not None:
+                    self.translog.add(
+                        {
+                            "seqno": seqno,
+                            "op": "delete",
+                            "id": doc_id,
+                            "version": version,
+                        }
+                    )
+            return {
+                "_id": doc_id,
+                "result": "deleted" if found else "not_found",
+                "_seq_no": seqno,
+                "_version": version if found else 1,
+                "_primary_term": self.primary_term,
+            }
 
     def sync_translog(self) -> None:
         """fsync the translog — the per-request durability point the write
@@ -173,14 +302,28 @@ class Engine:
     def get(self, doc_id: str) -> dict[str, Any] | None:
         """Realtime GET: buffer first (like the reference's getFromTranslog,
         InternalEngine.java:639), then refreshed segments."""
-        local = self._buffer_ids.get(doc_id)
-        if local is not None:
-            return self._buffer._sources[local]
-        loc = self._live_ids.get(doc_id)
-        if loc is not None:
-            seg_idx, local = loc
-            return self.segments[seg_idx].segment.sources[local]
-        return None
+        with self.lock:
+            local = self._buffer_ids.get(doc_id)
+            if local is not None:
+                return self._buffer._sources[local]
+            loc = self._live_ids.get(doc_id)
+            if loc is not None:
+                seg_idx, local = loc
+                return self.segments[seg_idx].segment.sources[local]
+            return None
+
+    def get_with_meta(self, doc_id: str) -> dict[str, Any] | None:
+        """Realtime GET returning {_source, _version, _seq_no, _primary_term}."""
+        with self.lock:
+            source = self.get(doc_id)
+            if source is None:
+                return None
+            return {
+                "_source": source,
+                "_version": self._versions.get(doc_id, 1),
+                "_seq_no": self._doc_seqnos.get(doc_id, -1),
+                "_primary_term": self.primary_term,
+            }
 
     # ----------------------------------------------------------- refresh/read
 
@@ -191,53 +334,59 @@ class Engine:
         dropped rather than indexed-then-masked (the reference achieves the
         same via the version map + Lucene delete-by-term on flush).
         """
-        changed = False
-        for handle in self.segments:
-            if handle.live_dirty:
-                handle.sync_live()
-                changed = True
-        if self._buffer.num_docs == 0:
-            return changed
-        deleted = self._buffer_deleted
-        if deleted:
-            # Rebuild the buffer without dropped docs.
-            keep = [
-                i for i in range(self._buffer.num_docs) if i not in deleted
-            ]
-            rebuilt = SegmentBuilder(self.mappings)
-            id_map = {}
-            for i in keep:
-                new_local = rebuilt.add(
-                    self._buffer._sources[i], self._buffer._ids[i]
-                )
-                id_map[i] = new_local
-            self._buffer = rebuilt
-            self._buffer_ids = {
-                d: id_map[l] for d, l in self._buffer_ids.items() if l in id_map
-            }
-            deleted.clear()
+        with self.lock:
+            changed = False
+            for handle in self.segments:
+                if handle.live_dirty:
+                    handle.sync_live()
+                    changed = True
             if self._buffer.num_docs == 0:
                 return changed
-        segment = self._buffer.build()
-        base = sum(h.segment.num_docs for h in self.segments)
-        device = pack_segment(
-            segment, self.device, k1=self.params.k1, b=self.params.b
-        )
-        handle = SegmentHandle(
-            segment=segment,
-            device=device,
-            base=base,
-            live_host=np.ones(segment.num_docs, dtype=bool),
-        )
-        seg_idx = len(self.segments)
-        self.segments.append(handle)
-        for doc_id, local in self._buffer_ids.items():
-            self._live_ids[doc_id] = (seg_idx, local)
-        self._buffer = SegmentBuilder(self.mappings)
-        self._buffer_ids = {}
-        self._stats_cache = None
-        self._sync_impacts()
-        return True
+            deleted = self._buffer_deleted
+            if deleted:
+                # Rebuild the buffer without dropped docs.
+                keep = [
+                    i for i in range(self._buffer.num_docs) if i not in deleted
+                ]
+                rebuilt = SegmentBuilder(self.mappings)
+                id_map = {}
+                for i in keep:
+                    new_local = rebuilt.add(
+                        self._buffer._sources[i],
+                        self._buffer._ids[i],
+                        version=self._buffer._versions[i],
+                        seqno=self._buffer._seqnos[i],
+                    )
+                    id_map[i] = new_local
+                self._buffer = rebuilt
+                self._buffer_ids = {
+                    d: id_map[l]
+                    for d, l in self._buffer_ids.items()
+                    if l in id_map
+                }
+                deleted.clear()
+                if self._buffer.num_docs == 0:
+                    return changed
+            segment = self._buffer.build()
+            base = sum(h.segment.num_docs for h in self.segments)
+            device = pack_segment(
+                segment, self.device, k1=self.params.k1, b=self.params.b
+            )
+            handle = SegmentHandle(
+                segment=segment,
+                device=device,
+                base=base,
+                live_host=np.ones(segment.num_docs, dtype=bool),
+            )
+            seg_idx = len(self.segments)
+            self.segments.append(handle)
+            for doc_id, local in self._buffer_ids.items():
+                self._live_ids[doc_id] = (seg_idx, local)
+            self._buffer = SegmentBuilder(self.mappings)
+            self._buffer_ids = {}
+            self._stats_cache = None
+            self._sync_impacts()
+            return True
 
     def flush(self) -> dict:
         """Refresh, persist segments + live masks, commit, trim the translog.
@@ -246,35 +395,63 @@ class Engine:
         translog generation, then trimUnreferencedReaders. After a flush,
         everything up to max_seqno survives a crash without replay.
         """
-        self.refresh()
-        if self.data_path is None:
-            return {"committed": False}
-        for handle in self.segments:
-            if handle.seg_id is None:
-                handle.seg_id = self._next_seg_id
-                self._next_seg_id += 1
-                store.persist_segment(
-                    self.data_path, handle.seg_id, handle.segment
+        with self.lock:
+            self.refresh()
+            self._gc_tombstones()
+            if self.data_path is None:
+                return {"committed": False}
+            for handle in self.segments:
+                if handle.seg_id is None:
+                    handle.seg_id = self._next_seg_id
+                    self._next_seg_id += 1
+                    store.persist_segment(
+                        self.data_path, handle.seg_id, handle.segment
+                    )
+                store.persist_live(
+                    self.data_path, handle.seg_id, handle.live_host
                 )
-            store.persist_live(self.data_path, handle.seg_id, handle.live_host)
-        store.write_commit(
-            self.data_path,
-            {
-                "segments": [h.seg_id for h in self.segments],
-                "max_seqno": self._seqno,
-                "next_seg_id": self._next_seg_id,
-            },
-        )
-        if self.translog is not None:
-            self.translog.roll(self._seqno)
-        store.gc_segments(
-            self.data_path, {h.seg_id for h in self.segments}
-        )
-        return {"committed": True, "max_seqno": self._seqno}
+            store.write_commit(
+                self.data_path,
+                {
+                    "segments": [h.seg_id for h in self.segments],
+                    "max_seqno": self._seqno,
+                    "next_seg_id": self._next_seg_id,
+                    # Delete tombstones ride in the commit so the version
+                    # line survives restart (until gc_deletes prunes them).
+                    "tombstones": {
+                        doc_id: [
+                            self._versions.get(doc_id, 1),
+                            self._doc_seqnos.get(doc_id, -1),
+                            ts,
+                        ]
+                        for doc_id, ts in self._tombstone_ts.items()
+                    },
+                },
+            )
+            if self.translog is not None:
+                # Holding the engine lock across refresh→commit→roll keeps
+                # the persisted_seqno honest: no op can take a seqno between
+                # the refresh snapshot and the generation retirement.
+                self.translog.roll(self._seqno)
+            store.gc_segments(
+                self.data_path, {h.seg_id for h in self.segments}
+            )
+            return {"committed": True, "max_seqno": self._seqno}
 
     def close(self) -> None:
         if self.translog is not None:
             self.translog.close()
+
+    def _gc_tombstones(self) -> None:
+        """Prune delete tombstones older than gc_deletes (ES gc_deletes)."""
+        cutoff = time.time() - self.gc_deletes_s
+        expired = [
+            doc_id for doc_id, ts in self._tombstone_ts.items() if ts < cutoff
+        ]
+        for doc_id in expired:
+            del self._tombstone_ts[doc_id]
+            self._versions.pop(doc_id, None)
+            self._doc_seqnos.pop(doc_id, None)
 
     def _recover(self) -> None:
         """Load the last commit's segments (recovery-from-disk at boot,
@@ -284,6 +461,12 @@ class Engine:
             return
         self._seqno = commit["max_seqno"]
         self._next_seg_id = commit.get("next_seg_id", 1)
+        for doc_id, (version, seqno, ts) in commit.get(
+            "tombstones", {}
+        ).items():
+            self._versions[doc_id] = int(version)
+            self._doc_seqnos[doc_id] = int(seqno)
+            self._tombstone_ts[doc_id] = float(ts)
         base = 0
         for seg_idx, seg_id in enumerate(commit["segments"]):
             segment, live = store.load_segment(self.data_path, seg_id)
@@ -306,6 +489,8 @@ class Engine:
             for local, doc_id in enumerate(segment.ids):
                 if live[local]:
                     self._live_ids[doc_id] = (seg_idx, local)
+                    self._versions[doc_id] = segment.doc_version(local)
+                    self._doc_seqnos[doc_id] = segment.doc_seqno(local)
                 self._bump_auto_id(doc_id)
             base += segment.num_docs
         self._stats_cache = None
@@ -317,15 +502,25 @@ class Engine:
         replayed = False
         for op in self.translog.replay(above_seqno=self._seqno):
             replayed = True
+            doc_id = op["id"]
+            seqno = int(op.get("seqno", -1))
+            version = int(op.get("version", self._versions.get(doc_id, 0) + 1))
             if op["op"] == "index":
-                doc_id = op["id"]
                 self._delete_existing(doc_id)
-                local = self._buffer.add(op["source"], doc_id)
+                local = self._buffer.add(
+                    op["source"], doc_id, version=version, seqno=seqno
+                )
                 self._buffer_ids[doc_id] = local
+                self._versions[doc_id] = version
+                self._doc_seqnos[doc_id] = seqno
+                self._tombstone_ts.pop(doc_id, None)
                 self._bump_auto_id(doc_id)
             elif op["op"] == "delete":
-                self._delete_existing(op["id"])
-            self._seqno = max(self._seqno, int(op.get("seqno", -1)))
+                self._delete_existing(doc_id)
+                self._versions[doc_id] = version
+                self._doc_seqnos[doc_id] = seqno
+                self._tombstone_ts[doc_id] = time.time()
+            self._seqno = max(self._seqno, seqno)
         if replayed:
             self.refresh()
 

@@ -88,6 +88,17 @@ class Segment:
     vectors: dict[str, np.ndarray]  # field -> float32[N, D]
     sources: list[dict[str, Any]]  # stored _source per local doc
     ids: list[str]  # external _id per local doc
+    # Per-doc op metadata (the engine's version-map slice that survives a
+    # restart; the reference persists _version/_seq_no as doc values on
+    # every Lucene doc — index/mapper/VersionFieldMapper, SeqNoFieldMapper):
+    versions: np.ndarray | None = None  # int64[N]; None = all 1 (legacy)
+    seqnos: np.ndarray | None = None  # int64[N]; None = all -1 (legacy)
+
+    def doc_version(self, local: int) -> int:
+        return int(self.versions[local]) if self.versions is not None else 1
+
+    def doc_seqno(self, local: int) -> int:
+        return int(self.seqnos[local]) if self.seqnos is not None else -1
 
 
 def _iter_field_values(value: Any) -> list[Any]:
@@ -107,6 +118,8 @@ class SegmentBuilder:
         self.mappings = mappings
         self._sources: list[dict[str, Any]] = []
         self._ids: list[str] = []
+        self._versions: list[int] = []
+        self._seqnos: list[int] = []
         # field -> {term -> list[(doc, tf)]} accumulated as dict doc->tf
         self._inverted: dict[str, dict[str, dict[int, int]]] = {}
         self._lengths: dict[str, dict[int, int]] = {}  # field -> doc -> len
@@ -118,11 +131,26 @@ class SegmentBuilder:
     def num_docs(self) -> int:
         return len(self._sources)
 
-    def add(self, source: dict[str, Any], doc_id: str | None = None) -> int:
-        """Index one document; returns its local doc id."""
+    def add(
+        self,
+        source: dict[str, Any],
+        doc_id: str | None = None,
+        version: int = 1,
+        seqno: int = -1,
+    ) -> int:
+        """Index one document; returns its local doc id.
+
+        Atomic: everything that can fail (mapping validation, analysis,
+        coercion) runs in a staging pass that touches no builder state, so a
+        mapper_parsing failure leaves the buffer exactly as it was — the
+        engine relies on this to avoid ghost/partial documents on rejected
+        writes (the reference gets the same guarantee from Lucene's
+        per-document addDocument atomicity).
+        """
         local = len(self._sources)
-        self._sources.append(source)
-        self._ids.append(doc_id if doc_id is not None else str(local))
+        staged_vectors: list[tuple[str, np.ndarray]] = []
+        staged_postings: list[tuple[str, dict[str, int], int]] = []
+        staged_numeric: list[tuple[str, float]] = []
         for field_name, value in source.items():
             if value is None:
                 continue
@@ -140,30 +168,43 @@ class SegmentBuilder:
                         f"dense_vector [{field_name}] dims mismatch: "
                         f"{vec.shape[-1]} != {fm.dims}"
                     )
-                self._vectors.setdefault(field_name, {})[local] = vec
+                staged_vectors.append((field_name, vec))
             elif fm.is_inverted:
                 analyzer = self.mappings.analyzer_for(field_name)
                 total_len = 0
-                self._present.setdefault(field_name, set()).add(local)
-                postings = self._inverted.setdefault(field_name, {})
+                tf: dict[str, int] = {}
                 for v in _iter_field_values(value):
                     tokens = analyzer.analyze(str(v))
                     total_len += len(tokens)
                     for tok in tokens:
-                        by_doc = postings.setdefault(tok, {})
-                        by_doc[local] = by_doc.get(local, 0) + 1
-                # Docs whose value analyzed to zero tokens (e.g. all
-                # stopwords) produce no postings and must not count toward
-                # docCount/sumTotalTermFreq — Lucene's Terms.getDocCount only
-                # counts docs with at least one posting for the field.
-                if total_len > 0:
-                    self._lengths.setdefault(field_name, {})[local] = total_len
+                        tf[tok] = tf.get(tok, 0) + 1
+                staged_postings.append((field_name, tf, total_len))
             elif fm.is_numeric:
                 vals = _iter_field_values(value)
                 v0 = vals[0]  # multi-valued numerics keep first value for now
                 if isinstance(v0, bool):
                     v0 = 1.0 if v0 else 0.0
-                self._numeric.setdefault(field_name, {})[local] = float(v0)
+                staged_numeric.append((field_name, float(v0)))
+        # ---- commit phase: nothing below raises -------------------------
+        self._sources.append(source)
+        self._ids.append(doc_id if doc_id is not None else str(local))
+        self._versions.append(int(version))
+        self._seqnos.append(int(seqno))
+        for field_name, vec in staged_vectors:
+            self._vectors.setdefault(field_name, {})[local] = vec
+        for field_name, tf, total_len in staged_postings:
+            self._present.setdefault(field_name, set()).add(local)
+            postings = self._inverted.setdefault(field_name, {})
+            for tok, count in tf.items():
+                postings.setdefault(tok, {})[local] = count
+            # Docs whose value analyzed to zero tokens (e.g. all stopwords)
+            # produce no postings and must not count toward
+            # docCount/sumTotalTermFreq — Lucene's Terms.getDocCount only
+            # counts docs with at least one posting for the field.
+            if total_len > 0:
+                self._lengths.setdefault(field_name, {})[local] = total_len
+        for field_name, v in staged_numeric:
+            self._numeric.setdefault(field_name, {})[local] = v
         return local
 
     def build(self) -> Segment:
@@ -231,4 +272,6 @@ class SegmentBuilder:
             vectors=vectors,
             sources=list(self._sources),
             ids=list(self._ids),
+            versions=np.asarray(self._versions, dtype=np.int64),
+            seqnos=np.asarray(self._seqnos, dtype=np.int64),
         )

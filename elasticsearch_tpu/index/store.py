@@ -60,6 +60,10 @@ def persist_segment(path: str, seg_id: int, segment: Segment) -> None:
         arrays[f"dv{j}"] = col
     for j, (name, mat) in enumerate(sorted(segment.vectors.items())):
         arrays[f"vec{j}"] = mat
+    if segment.versions is not None:
+        arrays["doc_versions"] = segment.versions
+    if segment.seqnos is not None:
+        arrays["doc_seqnos"] = segment.seqnos
     base = os.path.join(path, f"seg-{seg_id}")
     with open(base + ".npz", "wb") as f:
         np.savez(f, **arrays)
@@ -127,6 +131,8 @@ def load_segment(path: str, seg_id: int) -> tuple[Segment, np.ndarray]:
         vectors=vectors,
         sources=sources,
         ids=list(meta["ids"]),
+        versions=data["doc_versions"] if "doc_versions" in data else None,
+        seqnos=data["doc_seqnos"] if "doc_seqnos" in data else None,
     )
     live_path = base + ".live.npz"
     if os.path.exists(live_path):

@@ -21,51 +21,111 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Any, Iterator
 
 
+class TranslogCorruptedError(Exception):
+    """Unreadable record in a position that cannot be a torn tail.
+
+    The analog of the reference's TranslogCorruptedException: a parse
+    failure anywhere other than the final line of the newest generation
+    means durable, acked operations are unreadable — recovery must fail
+    loudly rather than silently dropping them.
+    """
+
+
 class Translog:
-    """Append-ops WAL over generation files + an atomic checkpoint."""
+    """Append-ops WAL over generation files + an atomic checkpoint.
+
+    Thread safety: `add`/`sync`/`roll`/`close` serialize on an internal
+    lock — the REST layer serves concurrent requests (ThreadingHTTPServer)
+    and interleaved buffered writes would tear records mid-line.
+    """
 
     def __init__(self, path: str, durability: str = "request"):
         self.path = path
         self.durability = durability
+        self._lock = threading.Lock()
         os.makedirs(path, exist_ok=True)
         self._ckp_path = os.path.join(path, "translog.ckp")
         ckp = self._read_checkpoint()
         self.generation = ckp["generation"]
-        # A crash can leave a torn partial line at the tail of the current
-        # generation. Appending after it would corrupt the frame stream and
-        # lose every LATER (fsynced, acked) op at the next replay, so the
-        # tail is truncated to the last complete line before reopening —
-        # the reference truncates to the checkpointed offset the same way.
+        # Crash hygiene before reopening, mirroring the reference's recovery:
+        # (a) generations below the checkpoint's min_gen are orphans from a
+        # crash between checkpoint write and file removal in roll() — sweep
+        # them, or they leak disk forever (no later roll looks below the
+        # new min_gen);
+        self._sweep_orphans(ckp.get("min_gen", 1))
+        # (b) a crash can leave a torn partial line at the tail of the
+        # current generation. Appending after it would corrupt the frame
+        # stream and lose every LATER (fsynced, acked) op at the next
+        # replay, so the torn suffix is truncated IN PLACE — never by
+        # rewriting the file, which would zero it first and turn a crash
+        # mid-rewrite into loss of every acked op in the generation (the
+        # reference truncates to the checkpointed offset the same way).
         self._truncate_torn_tail(self._gen_path(self.generation))
         self._file = open(self._gen_path(self.generation), "ab")
         self._dirty = False
 
+    def _sweep_orphans(self, min_gen: int) -> None:
+        for fname in os.listdir(self.path):
+            if not fname.startswith("translog-") or not fname.endswith(".log"):
+                continue
+            try:
+                gen = int(fname[len("translog-") : -len(".log")])
+            except ValueError:
+                continue
+            if gen < min_gen:
+                try:
+                    os.remove(os.path.join(self.path, fname))
+                except FileNotFoundError:
+                    pass
+
     @staticmethod
-    def _truncate_torn_tail(gen_path: str) -> None:
+    def _last_newline_before(f, pos: int) -> int:
+        """Offset just past the last b'\\n' strictly before `pos`, scanning
+        backwards in bounded chunks (generations can be huge; never load
+        the whole file)."""
+        chunk = 1 << 16
+        end = pos
+        while end > 0:
+            start = max(0, end - chunk)
+            f.seek(start)
+            data = f.read(end - start)
+            idx = data.rfind(b"\n")
+            if idx >= 0:
+                return start + idx + 1
+            end = start
+        return 0
+
+    @classmethod
+    def _truncate_torn_tail(cls, gen_path: str) -> None:
         if not os.path.exists(gen_path):
             return
+        size = os.path.getsize(gen_path)
+        if size == 0:
+            return
         with open(gen_path, "rb") as f:
-            data = f.read()
-        if not data or data.endswith(b"\n"):
-            # Even newline-terminated tails can be torn mid-record; validate
-            # the last line parses.
-            if data:
-                last = data[:-1].rsplit(b"\n", 1)[-1]
+            f.seek(size - 1)
+            ends_nl = f.read(1) == b"\n"
+            if ends_nl:
+                # Even newline-terminated tails can be torn mid-record;
+                # validate the final line parses.
+                line_start = cls._last_newline_before(f, size - 1)
+                f.seek(line_start)
+                last = f.read(size - 1 - line_start)
                 try:
                     json.loads(last.decode("utf-8"))
                     return
                 except (json.JSONDecodeError, UnicodeDecodeError):
-                    data = data[: len(data) - len(last) - 1]
+                    keep = line_start
             else:
-                return
-        else:
-            keep = data.rfind(b"\n") + 1
-            data = data[:keep]
-        with open(gen_path, "wb") as f:
-            f.write(data)
+                keep = cls._last_newline_before(f, size)
+        # In-place truncation: only the torn suffix is ever removed; every
+        # fsynced byte before it stays on disk at all times.
+        with open(gen_path, "r+b") as f:
+            f.truncate(keep)
             f.flush()
             os.fsync(f.fileno())
 
@@ -95,15 +155,16 @@ class Translog:
     def add(self, op: dict[str, Any]) -> None:
         """Append one operation record (must carry 'seqno')."""
         line = json.dumps(op, separators=(",", ":")) + "\n"
-        self._file.write(line.encode("utf-8"))
-        self._dirty = True
-        if self.durability == "request":
-            # Buffered until sync(); "request" durability is enforced by the
-            # caller invoking sync() before acking the client.
-            pass
+        with self._lock:
+            self._file.write(line.encode("utf-8"))
+            self._dirty = True
 
     def sync(self) -> None:
         """fsync outstanding appends (the Translog.Location sync point)."""
+        with self._lock:
+            self._sync_locked()
+
+    def _sync_locked(self) -> None:
         if self._dirty:
             self._file.flush()
             os.fsync(self._file.fileno())
@@ -116,21 +177,22 @@ class Translog:
         (the commit's local checkpoint); earlier generations hold only ops
         at or below it and are deleted, like trimUnreferencedReaders.
         """
-        self.sync()
-        self._file.close()
-        old_min = self._read_checkpoint().get("min_gen", 1)
-        self.generation += 1
-        self._file = open(self._gen_path(self.generation), "ab")
-        self._write_checkpoint(
-            generation=self.generation,
-            min_gen=self.generation,
-            persisted_seqno=persisted_seqno,
-        )
-        for gen in range(old_min, self.generation):
-            try:
-                os.remove(self._gen_path(gen))
-            except FileNotFoundError:
-                pass
+        with self._lock:
+            self._sync_locked()
+            self._file.close()
+            old_min = self._read_checkpoint().get("min_gen", 1)
+            self.generation += 1
+            self._file = open(self._gen_path(self.generation), "ab")
+            self._write_checkpoint(
+                generation=self.generation,
+                min_gen=self.generation,
+                persisted_seqno=persisted_seqno,
+            )
+            for gen in range(old_min, self.generation):
+                try:
+                    os.remove(self._gen_path(gen))
+                except FileNotFoundError:
+                    pass
 
     # ---------------------------------------------------------- recovery path
 
@@ -141,24 +203,68 @@ class Translog:
     def replay(self, above_seqno: int = -1) -> Iterator[dict]:
         """Yield ops with seqno > above_seqno across live generations.
 
-        A torn final line (crash mid-append before fsync) is skipped — the
-        op was never acked durable, matching the reference's behavior of
-        truncating at the checkpointed offset.
+        A torn FINAL line of the NEWEST generation (crash mid-append before
+        fsync) is skipped — that op was never acked durable, matching the
+        reference's truncation at the checkpointed offset. An unreadable
+        record anywhere else is real corruption of durable history and
+        raises TranslogCorruptedError instead of silently dropping acked
+        ops (the reference's per-record checksum framing fails the same
+        way).
         """
         ckp = self._read_checkpoint()
-        for gen in range(ckp.get("min_gen", 1), ckp["generation"] + 1):
+        last_gen = ckp["generation"]
+        for gen in range(ckp.get("min_gen", 1), last_gen + 1):
             gen_path = self._gen_path(gen)
             if not os.path.exists(gen_path):
                 continue
+            # Streamed with a one-record lookahead (generations can be large
+            # — every op carries its _source — so no full-file reads here):
+            # a parse failure is a tolerable torn tail only when the failing
+            # record is the final line of the newest generation.
             with open(gen_path, "rb") as f:
+                prev: bytes | None = None
+                lineno = 0
                 for raw in f:
-                    try:
-                        op = json.loads(raw.decode("utf-8"))
-                    except (json.JSONDecodeError, UnicodeDecodeError):
-                        break  # torn tail write; nothing durable follows
-                    if op.get("seqno", -1) > above_seqno:
-                        yield op
+                    if prev is not None:
+                        yield from self._parse_record(
+                            prev, gen, lineno, torn_ok=False,
+                            above_seqno=above_seqno,
+                        )
+                    prev = raw
+                    lineno += 1
+                if prev is not None:
+                    yield from self._parse_record(
+                        prev, gen, lineno, torn_ok=(gen == last_gen),
+                        above_seqno=above_seqno,
+                    )
+
+    @staticmethod
+    def _parse_record(
+        raw: bytes, gen: int, lineno: int, torn_ok: bool, above_seqno: int
+    ) -> Iterator[dict]:
+        try:
+            op = json.loads(raw.decode("utf-8"))
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            if torn_ok:
+                return
+            raise TranslogCorruptedError(
+                f"unreadable translog record at generation {gen} "
+                f"line {lineno} (not a torn tail)"
+            ) from None
+        if not isinstance(op, dict):
+            # Records are always JSON objects; a scalar/array that parses is
+            # still corruption of a durable record unless it is the torn
+            # tail position.
+            if torn_ok:
+                return
+            raise TranslogCorruptedError(
+                f"non-object translog record at generation {gen} "
+                f"line {lineno}"
+            )
+        if op.get("seqno", -1) > above_seqno:
+            yield op
 
     def close(self) -> None:
-        self.sync()
-        self._file.close()
+        with self._lock:
+            self._sync_locked()
+            self._file.close()

@@ -22,7 +22,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
-from .index.engine import Engine
+from .index.engine import Engine, VersionConflictError
 from .index.mapping import Mappings
 from .ops.bm25 import BM25Params
 from .search.service import SearchRequest, SearchService
@@ -217,10 +217,20 @@ class Node:
         doc_id: str | None = None,
         refresh: bool = False,
         sync: bool = True,
+        if_seq_no: int | None = None,
+        if_primary_term: int | None = None,
+        op_type: str = "index",
     ) -> dict:
         svc = self.get_index(index, auto_create=True)
         try:
-            result = svc.engine.index(source, doc_id)
+            result = svc.engine.index(
+                source, doc_id, if_seq_no=if_seq_no,
+                if_primary_term=if_primary_term, op_type=op_type,
+            )
+        except VersionConflictError as e:
+            raise ApiError(
+                409, "version_conflict_engine_exception", str(e)
+            ) from None
         except ValueError as e:
             raise ApiError(400, "mapper_parsing_exception", str(e)) from None
         if sync:  # request durability before the ack (bulk syncs once)
@@ -230,31 +240,46 @@ class Node:
         return {
             "_index": index,
             "_id": result["_id"],
-            "_version": 1,
+            "_version": result["_version"],
             "result": result["result"],
             "_seq_no": result["_seq_no"],
-            "_primary_term": 1,
+            "_primary_term": result["_primary_term"],
             "_shards": {"total": 1, "successful": 1, "failed": 0},
         }
 
     def get_doc(self, index: str, doc_id: str) -> dict:
         svc = self.get_index(index)
-        source = svc.engine.get(doc_id)
-        if source is None:
+        meta = svc.engine.get_with_meta(doc_id)
+        if meta is None:
             return {"_index": index, "_id": doc_id, "found": False}
         return {
             "_index": index,
             "_id": doc_id,
-            "_version": 1,
+            "_version": meta["_version"],
+            "_seq_no": meta["_seq_no"],
+            "_primary_term": meta["_primary_term"],
             "found": True,
-            "_source": source,
+            "_source": meta["_source"],
         }
 
     def delete_doc(
-        self, index: str, doc_id: str, refresh: bool = False, sync: bool = True
+        self,
+        index: str,
+        doc_id: str,
+        refresh: bool = False,
+        sync: bool = True,
+        if_seq_no: int | None = None,
+        if_primary_term: int | None = None,
     ) -> dict:
         svc = self.get_index(index)
-        result = svc.engine.delete(doc_id)
+        try:
+            result = svc.engine.delete(
+                doc_id, if_seq_no=if_seq_no, if_primary_term=if_primary_term
+            )
+        except VersionConflictError as e:
+            raise ApiError(
+                409, "version_conflict_engine_exception", str(e)
+            ) from None
         if sync:
             svc.engine.sync_translog()
         if refresh:
@@ -264,6 +289,9 @@ class Node:
             "_index": index,
             "_id": doc_id,
             "result": status,
+            "_version": result["_version"],
+            "_seq_no": result["_seq_no"],
+            "_primary_term": result["_primary_term"],
             "_shards": {"total": 1, "successful": 1, "failed": 0},
         }
 
@@ -274,29 +302,44 @@ class Node:
         body: dict[str, Any],
         refresh: bool = False,
         sync: bool = True,
+        if_seq_no: int | None = None,
+        if_primary_term: int | None = None,
     ) -> dict:
         """Partial update: realtime get + merge + reindex (the reference's
         TransportUpdateAction/UpdateHelper flow, action/update/)."""
         svc = self.get_index(index)
-        existing = svc.engine.get(doc_id)
-        if existing is None:
-            if "upsert" in body:
-                # The upsert document is indexed as-is when the doc is
-                # missing; `doc` only applies to an existing document
-                # (reference UpdateHelper.prepareUpsert semantics).
-                merged = dict(body["upsert"])
-            elif body.get("doc_as_upsert") and "doc" in body:
-                merged = dict(body["doc"])
+        # The read-modify-write must be atomic against concurrent writers
+        # (the reference achieves this with a seqno CAS + retry loop in
+        # TransportUpdateAction; holding the engine write lock is the
+        # single-process equivalent).
+        with svc.engine.lock:
+            existing = svc.engine.get(doc_id)
+            if existing is None:
+                if "upsert" in body:
+                    # The upsert document is indexed as-is when the doc is
+                    # missing; `doc` only applies to an existing document
+                    # (reference UpdateHelper.prepareUpsert semantics).
+                    merged = dict(body["upsert"])
+                elif body.get("doc_as_upsert") and "doc" in body:
+                    merged = dict(body["doc"])
+                else:
+                    raise ApiError(
+                        404,
+                        "document_missing_exception",
+                        f"[{doc_id}]: document missing",
+                    )
             else:
-                raise ApiError(
-                    404,
-                    "document_missing_exception",
-                    f"[{doc_id}]: document missing",
+                merged = dict(existing)
+                merged.update(body.get("doc", {}))
+            try:
+                result = svc.engine.index(
+                    merged, doc_id, if_seq_no=if_seq_no,
+                    if_primary_term=if_primary_term,
                 )
-        else:
-            merged = dict(existing)
-            merged.update(body.get("doc", {}))
-        result = svc.engine.index(merged, doc_id)
+            except VersionConflictError as e:
+                raise ApiError(
+                    409, "version_conflict_engine_exception", str(e)
+                ) from None
         if sync:
             svc.engine.sync_translog()
         if refresh:
@@ -306,6 +349,8 @@ class Node:
             "_id": doc_id,
             "result": "updated" if existing is not None else "created",
             "_seq_no": result["_seq_no"],
+            "_version": result["_version"],
+            "_primary_term": result["_primary_term"],
         }
 
     # ----------------------------------------------------------------- bulk
@@ -337,18 +382,11 @@ class Node:
                 if op in ("index", "create"):
                     source = json.loads(lines[i])
                     i += 1
-                    if (
-                        op == "create"
-                        and doc_id is not None
-                        and index in self.indices
-                        and self.indices[index].engine.get(doc_id) is not None
-                    ):
-                        raise ApiError(
-                            409,
-                            "version_conflict_engine_exception",
-                            f"[{doc_id}]: version conflict, document already exists",
-                        )
-                    resp = self.index_doc(index, source, doc_id, sync=False)
+                    # "create" enforces put-if-absent atomically inside the
+                    # engine lock (no get-then-index race window).
+                    resp = self.index_doc(
+                        index, source, doc_id, sync=False, op_type=op
+                    )
                     touched.add(index)
                     status = 201 if resp["result"] == "created" else 200
                     items.append({op: {**resp, "status": status}})

@@ -302,9 +302,18 @@ def _execute_inner(seg, spec, arrays, k: int):
 # ---------------------------------------------------------------------------
 
 
+# Widest disjunction the run-fold unrolls: the fold is t_pad-1 static
+# shifted adds, so an ES-max 1024-clause disjunction would compile a
+# ~1000-step XLA program. Past this bucket the dense kernel wins on both
+# compile time and program size; the sparse path keeps the hot few-term
+# match-query shapes.
+SPARSE_TPAD_MAX = 32
+
+
 def supports_sparse(spec) -> bool:
-    """Sparse execution covers precomputed-impact term disjunctions."""
-    return spec[0] == "terms"
+    """Sparse execution covers precomputed-impact term disjunctions with a
+    bounded run-fold length (wider disjunctions route to the dense kernel)."""
+    return spec[0] == "terms" and spec[3] <= SPARSE_TPAD_MAX
 
 
 def _sparse_inner(seg, spec, arrays, k: int):

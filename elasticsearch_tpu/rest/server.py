@@ -46,6 +46,22 @@ def _json(body: str) -> dict:
     return json.loads(body)
 
 
+def _cas_params(q: dict) -> dict:
+    """Extract if_seq_no/if_primary_term CAS query params (ES doc APIs)."""
+    out: dict = {}
+    for name in ("if_seq_no", "if_primary_term"):
+        if name in q:
+            try:
+                out[name] = int(q[name])
+            except ValueError:
+                raise ApiError(
+                    400,
+                    "illegal_argument_exception",
+                    f"[{name}] must be an integer, got [{q[name]}]",
+                ) from None
+    return out
+
+
 class RestServer:
     def __init__(self, node: Node | None = None, data_path: str | None = None):
         self.node = node or Node(data_path=data_path)
@@ -101,17 +117,20 @@ class RestServer:
             r(method, "/{index}/_doc/{id}", lambda s, p, q, b: n.index_doc(
                 p["index"], _json(b), p["id"],
                 refresh=q.get("refresh") in ("true", ""),
+                **_cas_params(q),
             ))
             r(method, "/{index}/_create/{id}", self._create_doc)
         r("GET", "/{index}/_doc/{id}", lambda s, p, q, b: n.get_doc(
             p["index"], p["id"]
         ))
         r("DELETE", "/{index}/_doc/{id}", lambda s, p, q, b: n.delete_doc(
-            p["index"], p["id"], refresh=q.get("refresh") in ("true", "")
+            p["index"], p["id"], refresh=q.get("refresh") in ("true", ""),
+            **_cas_params(q),
         ))
         r("POST", "/{index}/_update/{id}", lambda s, p, q, b: n.update_doc(
             p["index"], p["id"], _json(b),
             refresh=q.get("refresh") in ("true", ""),
+            **_cas_params(q),
         ))
         r("PUT", "/{index}", lambda s, p, q, b: n.create_index(
             p["index"], _json(b)
@@ -119,16 +138,12 @@ class RestServer:
         r("DELETE", "/{index}", lambda s, p, q, b: n.delete_index(p["index"]))
 
     def _create_doc(self, s, p, q, b):
-        svc = self.node.indices.get(p["index"])
-        if svc is not None and svc.engine.get(p["id"]) is not None:
-            raise ApiError(
-                409,
-                "version_conflict_engine_exception",
-                f"[{p['id']}]: version conflict, document already exists",
-            )
+        # put-if-absent enforced atomically inside the engine lock
+        # (IndexRequest.opType CREATE semantics).
         return self.node.index_doc(
             p["index"], _json(b), p["id"],
             refresh=q.get("refresh") in ("true", ""),
+            op_type="create",
         )
 
     def _analyze(self, s, p, q, b):
