@@ -47,6 +47,10 @@ from .tiles import DeviceSegment, pack_segment, repack_tn
 from .translog import Translog
 
 
+class InvalidCasError(ValueError):
+    """Malformed CAS request (one-sided if_seq_no/if_primary_term) — 400."""
+
+
 class VersionConflictError(Exception):
     """Seqno/term CAS failure — maps to HTTP 409 version_conflict_engine_exception.
 
@@ -151,6 +155,10 @@ class Engine:
     def max_seqno(self) -> int:
         return self._seqno
 
+    def _exists(self, doc_id: str) -> bool:
+        """Doc currently live (buffered or refreshed)."""
+        return doc_id in self._buffer_ids or doc_id in self._live_ids
+
     def _check_cas(
         self, doc_id: str, if_seq_no: int | None, if_primary_term: int | None
     ) -> None:
@@ -161,11 +169,10 @@ class Engine:
             # The reference rejects one-sided CAS up front with 400
             # (IndexRequest.validate: "ifSeqNo is unassigned, but primary
             # term is [x]").
-            raise ValueError(
+            raise InvalidCasError(
                 "if_seq_no and if_primary_term must be provided together"
             )
-        exists = doc_id in self._buffer_ids or doc_id in self._live_ids
-        if not exists:
+        if not self._exists(doc_id):
             raise VersionConflictError(
                 doc_id,
                 f"required seqNo [{if_seq_no}], but no document was found",
@@ -203,7 +210,7 @@ class Engine:
                 doc_id = f"_auto_{self._auto_id}"
                 self._auto_id += 1
             self._check_cas(doc_id, if_seq_no, if_primary_term)
-            exists = doc_id in self._buffer_ids or doc_id in self._live_ids
+            exists = self._exists(doc_id)
             if op_type == "create" and exists:
                 raise VersionConflictError(
                     doc_id, "document already exists"

@@ -22,7 +22,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
-from .index.engine import Engine, VersionConflictError
+from .index.engine import Engine, InvalidCasError, VersionConflictError
 from .index.mapping import Mappings
 from .ops.bm25 import BM25Params
 from .search.service import SearchRequest, SearchService
@@ -231,6 +231,8 @@ class Node:
             raise ApiError(
                 409, "version_conflict_engine_exception", str(e)
             ) from None
+        except InvalidCasError as e:
+            raise ApiError(400, "illegal_argument_exception", str(e)) from None
         except ValueError as e:
             raise ApiError(400, "mapper_parsing_exception", str(e)) from None
         if sync:  # request durability before the ack (bulk syncs once)
@@ -280,6 +282,8 @@ class Node:
             raise ApiError(
                 409, "version_conflict_engine_exception", str(e)
             ) from None
+        except InvalidCasError as e:
+            raise ApiError(400, "illegal_argument_exception", str(e)) from None
         if sync:
             svc.engine.sync_translog()
         if refresh:
@@ -339,6 +343,10 @@ class Node:
             except VersionConflictError as e:
                 raise ApiError(
                     409, "version_conflict_engine_exception", str(e)
+                ) from None
+            except InvalidCasError as e:
+                raise ApiError(
+                    400, "illegal_argument_exception", str(e)
                 ) from None
         if sync:
             svc.engine.sync_translog()

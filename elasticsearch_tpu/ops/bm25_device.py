@@ -451,8 +451,11 @@ def execute_many(seg, compiled_queries, k: int):
         groups[c.spec].append(pos)
     results: list = [None] * len(compiled_queries)
     for spec, positions in groups.items():
+        # Stack on the HOST: one device transfer per leaf per group. A
+        # jnp.stack here would upload every query's small arrays one by one
+        # — measured 3000x slower through the host<->TPU link.
         arrays_b = jax.tree.map(
-            lambda *xs: jnp.stack(xs),
+            lambda *xs: np.stack(xs),
             *[compiled_queries[p].arrays for p in positions],
         )
         kernel = execute_batch_sparse if supports_sparse(spec) else execute_batch
@@ -496,7 +499,7 @@ def execute_batch_blockmax(seg, spec, arrays_list, k: int):
     a_bucket = max(8, nt // 4)
     if a_bucket >= nt:  # tiny worklists: single launch, exact totals
         arrays_b = jax.tree.map(
-            lambda *xs: jnp.stack(xs), *arrays_list
+            lambda *xs: np.stack(xs), *arrays_list
         )
         s, i, t = jax.device_get(execute_batch_sparse(seg, spec, arrays_b, k))
         return s, i, t, "eq"
@@ -518,7 +521,7 @@ def execute_batch_blockmax(seg, spec, arrays_list, k: int):
                 "ub_other": arrays["ub_other"][order],
             }
         )
-    arrays_a = jax.tree.map(lambda *xs: jnp.stack(xs), *phase_a)
+    arrays_a = jax.tree.map(lambda *xs: np.stack(xs), *phase_a)
     scores_a, _, _ = jax.device_get(
         execute_batch_sparse(seg, spec_a, arrays_a, k)
     )
@@ -550,7 +553,7 @@ def execute_batch_blockmax(seg, spec, arrays_list, k: int):
             col[:n_keep] = arrays[name][keep]
             out[name] = col  # padding rows: starts == ends -> never valid
         phase_b.append(out)
-    arrays_b = jax.tree.map(lambda *xs: jnp.stack(xs), *phase_b)
+    arrays_b = jax.tree.map(lambda *xs: np.stack(xs), *phase_b)
     s, i, t = jax.device_get(execute_batch_sparse(seg, spec_b, arrays_b, k))
     return s, i, t, ("gte" if pruned_any else "eq")
 

@@ -1,0 +1,53 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, numpy as np
+from collections import defaultdict
+import jax, jax.numpy as jnp
+from elasticsearch_tpu.index.tiles import pack_segment
+from elasticsearch_tpu.ops import bm25_device
+from elasticsearch_tpu.query.compile import Compiler
+from elasticsearch_tpu.query.dsl import parse_query
+from elasticsearch_tpu.utils.corpus import build_zipf_segment, pick_query_terms
+
+N_DOCS, N_QUERIES, K, REPS = 1_000_000, 256, 10, 5
+rng = np.random.default_rng(99)
+mappings, segment = build_zipf_segment(N_DOCS, vocab_size=30_000, seed=13)
+dev = pack_segment(segment)
+seg_tree = bm25_device.segment_tree(dev)
+jax.block_until_ready(seg_tree["live"])
+compiler = Compiler(dev.fields, dev.doc_values, mappings)
+query_terms = pick_query_terms(segment, rng, N_QUERIES)
+compiled = [compiler.compile(parse_query({"match": {"body": " ".join(t)}})) for t in query_terms]
+groups = defaultdict(list)
+for pos, c in enumerate(compiled):
+    groups[c.spec].append(pos)
+print("groups:", {s[2]: len(p) for s, p in groups.items()})
+
+outs = []
+for spec_g, positions in groups.items():
+    arrays_b = jax.tree.map(lambda *xs: np.stack(xs), *[compiled[p].arrays for p in positions])
+    outs.append(bm25_device.execute_batch_sparse(seg_tree, spec_g, arrays_b, K))
+jax.block_until_ready(outs)
+
+t0 = time.monotonic()
+for _ in range(REPS):
+    for spec_g, positions in groups.items():
+        arrays_b = jax.tree.map(lambda *xs: np.stack(xs), *[compiled[p].arrays for p in positions])
+print("np.stack staging ms/query:", (time.monotonic() - t0) / (REPS * N_QUERIES) * 1e3)
+
+outs = []
+t0 = time.monotonic()
+for _ in range(REPS):
+    for spec_g, positions in groups.items():
+        arrays_b = jax.tree.map(lambda *xs: np.stack(xs), *[compiled[p].arrays for p in positions])
+        outs.append(bm25_device.execute_batch_sparse(seg_tree, spec_g, arrays_b, K))
+jax.block_until_ready(outs)
+print("np.stack full ms/query:", (time.monotonic() - t0) / (REPS * N_QUERIES) * 1e3)
+
+outs = []
+t0 = time.monotonic()
+for _ in range(REPS):
+    for spec_g, positions in groups.items():
+        arrays_b = jax.tree.map(lambda *xs: jnp.stack(xs), *[compiled[p].arrays for p in positions])
+        outs.append(bm25_device.execute_batch_sparse(seg_tree, spec_g, arrays_b, K))
+jax.block_until_ready(outs)
+print("jnp.stack full ms/query:", (time.monotonic() - t0) / (REPS * N_QUERIES) * 1e3)
