@@ -36,13 +36,46 @@ INVERTED_TYPES = {TEXT, KEYWORD}
 ALL_TYPES = NUMERIC_TYPES | INVERTED_TYPES | {DENSE_VECTOR}
 
 
+def parse_date_millis(value: Any) -> float:
+    """Parse a date value to epoch milliseconds (the doc-values unit).
+
+    Accepts epoch millis (number) or ISO8601 date / datetime strings — the
+    default `strict_date_optional_time||epoch_millis` format of the
+    reference's DateFieldMapper.
+    """
+    if isinstance(value, bool):
+        raise ValueError(f"failed to parse date field [{value!r}]")
+    if isinstance(value, (int, float)):
+        return float(value)
+    if isinstance(value, str):
+        s = value.strip()
+        try:
+            return float(int(s))  # epoch_millis as string
+        except ValueError:
+            pass
+        from datetime import datetime, timezone
+
+        try:
+            dt = datetime.fromisoformat(s.replace("Z", "+00:00"))
+        except ValueError:
+            raise ValueError(
+                f"failed to parse date field [{value}] with format "
+                f"[strict_date_optional_time||epoch_millis]"
+            ) from None
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=timezone.utc)
+        return dt.timestamp() * 1000.0
+    raise ValueError(f"failed to parse date field [{value!r}]")
+
+
 def coerce_numeric(field_type: str, value: Any) -> float:
     """Coerce a query/document value to the numeric column representation.
 
     Mirrors the reference's per-type value parsing (NumberFieldMapper value
-    coercion, BooleanFieldMapper accepting true/false/"true"/"false"):
-    booleans map to 1.0/0.0, numeric strings are parsed, anything else raises
-    ValueError (the reference throws a mapper parsing exception).
+    coercion, BooleanFieldMapper accepting true/false/"true"/"false",
+    DateFieldMapper parsing ISO8601 or epoch millis): booleans map to
+    1.0/0.0, numeric strings are parsed, anything else raises ValueError
+    (the reference throws a mapper parsing exception).
     """
     if field_type == BOOLEAN:
         if value is True or value == "true":
@@ -54,6 +87,8 @@ def coerce_numeric(field_type: str, value: Any) -> float:
         raise ValueError(
             f"Can't parse boolean value [{value!r}], expected [true] or [false]"
         )
+    if field_type == DATE:
+        return parse_date_millis(value)
     if isinstance(value, bool):
         return 1.0 if value else 0.0
     return float(value)

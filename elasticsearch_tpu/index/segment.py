@@ -27,7 +27,7 @@ from typing import Any
 import numpy as np
 
 from ..utils import smallfloat
-from .mapping import DENSE_VECTOR, Mappings
+from .mapping import DENSE_VECTOR, Mappings, coerce_numeric
 
 
 @dataclass
@@ -182,9 +182,9 @@ class SegmentBuilder:
             elif fm.is_numeric:
                 vals = _iter_field_values(value)
                 v0 = vals[0]  # multi-valued numerics keep first value for now
-                if isinstance(v0, bool):
-                    v0 = 1.0 if v0 else 0.0
-                staged_numeric.append((field_name, float(v0)))
+                staged_numeric.append(
+                    (field_name, coerce_numeric(fm.type, v0))
+                )
         # ---- commit phase: nothing below raises -------------------------
         self._sources.append(source)
         self._ids.append(doc_id if doc_id is not None else str(local))

@@ -92,6 +92,16 @@ class DeviceField:
     # search/query/TopDocsCollectorContext.java:68).
     tile_max: np.ndarray | None = None
     device: Any = None  # placement used at pack time (repacks must match)
+    # Global ordinals plane for keyword fields (terms aggregations): term id
+    # owning each posting position, same [NT, TILE] layout, sentinel = T for
+    # padding. The analog of the reference's fielddata global ordinals
+    # (index/fielddata/; terms agg collects ordinals then resolves strings
+    # at reduce time). Only packed for norms-disabled (keyword) fields.
+    ord_terms: jax.Array | None = None  # int32[NT, TILE]
+
+    @property
+    def num_terms(self) -> int:
+        return len(self.df)
 
     @property
     def num_tiles(self) -> int:
@@ -202,6 +212,18 @@ def pack_field(
     norm_ext[: len(field.norm_bytes)] = field.norm_bytes
     tile_max = tn.reshape(-1, TILE).max(axis=1)
     put = lambda x: jax.device_put(x, device)
+    ord_terms = None
+    if not field.has_norms and len(field.df):
+        # keyword field: per-posting owning term id (CSR expansion),
+        # padded with sentinel T so padding scatters into a discard slot.
+        t_count = len(field.df)
+        ords = np.repeat(
+            np.arange(t_count, dtype=np.int32),
+            np.diff(field.offsets).astype(np.int64),
+        )
+        ords_pad = np.full(len(doc_ids), t_count, dtype=np.int32)
+        ords_pad[: len(ords)] = ords
+        ord_terms = put(ords_pad.reshape(-1, TILE))
     return DeviceField(
         name=field.name,
         terms=field.terms,
@@ -220,6 +242,7 @@ def pack_field(
         tn_b=b,
         tile_max=tile_max,
         device=device,
+        ord_terms=ord_terms,
     )
 
 

@@ -1,0 +1,306 @@
+"""Jitted aggregation execution over device segments.
+
+The TPU replacement for the reference's per-shard aggregation phase
+(server/src/main/java/org/elasticsearch/search/aggregations/
+AggregationPhase.java:23 — an aggs collector wired into the query-phase
+collector chain at search/query/QueryPhase.java:224, executed doc-at-a-time)
+and the 44-type registry of search/SearchModule.java:333.
+
+Where Lucene collects one doc at a time into per-agg buckets, the TPU form
+computes every aggregation from the dense (scores, matched) mask of the
+already-evaluated query in ONE XLA program per segment:
+
+- metric aggs are masked reductions over doc-values columns;
+- terms aggs scatter-add over the keyword field's per-posting ordinal plane
+  (the global-ordinals trick of the reference's fielddata layer): one
+  scatter per segment counts every bucket of every term at once;
+- histogram/range aggs compute a per-doc bucket index then scatter-add;
+- bucket sub-metrics reuse the same scatter with value planes;
+- filter/filters/global bucket aggs recompute the matched mask and recurse.
+
+Cross-segment (and cross-shard) reduce happens on the host in
+search/aggs.py — the coordinator-side InternalAggregations.topLevelReduce
+(action/search/SearchPhaseController.java:480) analog — because bucket
+keys (term strings) only unify across segments at reduce time, exactly as
+in the reference.
+
+Spec/arrays convention matches ops/bm25_device.py: `spec` is a hashable
+static tuple tree (one jit cache entry per shape), `arrays` a pytree of
+small arrays.
+
+Numeric semantics: doc values live on device as float32 (stored-value
+semantics, see query/compile.py range queries); sums accumulate in f32 via
+XLA tree reduction. min/max report the f32 stored value.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .bm25_device import _eval_node
+
+# ---------------------------------------------------------------------------
+# Agg spec (static, hashable):
+#   ("metric", field)                        — count/sum/min/max/sumsq in one
+#   ("cardinality_terms", field, TP)         — distinct keyword values
+#   ("terms", field, TP, (sub_metric_fields...))
+#   ("histogram", field, NB, (sub_metric_fields...))
+#   ("range", field, R, (sub_metric_fields...))
+#   ("filter", query_spec, (sub_specs...))   — mask & recurse
+#   ("filters", (query_specs...), (sub_specs...))
+#   ("global", (sub_specs...))               — ignore query mask
+#   ("missing", field, kind, (sub_specs...))
+#   ("top_metric_score",)                    — max score (max_score helper)
+#
+# arrays, by node:
+#   metric/cardinality_terms: {}
+#   terms: {}            (ordinals live in the segment tree)
+#   histogram: {"interval": f32, "offset": f32, "base": f32}
+#   range: {"los": f32[R], "his": f32[R]}
+#   filter: {"query": <query arrays>, "subs": (sub arrays...)}
+#   filters: {"queries": (...), "subs": (sub arrays...)}
+#   global/missing: {"subs": (sub arrays...)}
+#
+# Results are pytrees of small arrays; the host merges + renders.
+# ---------------------------------------------------------------------------
+
+F32_MAX = np.float32(np.finfo(np.float32).max)
+
+
+def agg_segment_tree(device_segment) -> dict[str, Any]:
+    """Segment pytree for aggregation kernels: query planes + ordinals."""
+    from .bm25_device import segment_tree
+
+    tree = segment_tree(device_segment)
+    tree["ordinals"] = {
+        name: f.ord_terms
+        for name, f in device_segment.fields.items()
+        if f.ord_terms is not None
+    }
+    return tree
+
+
+def _metric_planes(col, matched):
+    """Masked (count, sum, min, max, sumsq) over one doc-values column.
+
+    Docs without a value (NaN) never count — ES metric aggregators skip
+    docs missing the field (ValuesSource.Numeric semantics).
+    """
+    has = matched & ~jnp.isnan(col)
+    v = jnp.where(has, col, jnp.float32(0.0))
+    count = jnp.sum(has, dtype=jnp.int32)
+    total = jnp.sum(v, dtype=jnp.float32)
+    vmin = jnp.min(jnp.where(has, col, F32_MAX))
+    vmax = jnp.max(jnp.where(has, col, -F32_MAX))
+    sumsq = jnp.sum(v * v, dtype=jnp.float32)
+    return {
+        "count": count,
+        "sum": total,
+        "min": vmin,
+        "max": vmax,
+        "sumsq": sumsq,
+    }
+
+
+def _bucket_metric_planes(col, contrib_mask, bucket_idx, nb):
+    """Per-bucket (count, sum, min, max) via scatter over docs/postings.
+
+    `bucket_idx` assigns each row a bucket in [0, nb) or nb (discard);
+    `contrib_mask` gates rows; `col` carries the row's value (NaN = none).
+    """
+    has = contrib_mask & ~jnp.isnan(col)
+    idx = jnp.where(has, bucket_idx, nb)
+    v = jnp.where(has, col, jnp.float32(0.0))
+    count = (
+        jnp.zeros(nb + 1, dtype=jnp.int32).at[idx].add(has.astype(jnp.int32))
+    )[:nb]
+    total = jnp.zeros(nb + 1, dtype=jnp.float32).at[idx].add(v)[:nb]
+    vmin = (
+        jnp.full(nb + 1, F32_MAX, dtype=jnp.float32)
+        .at[idx]
+        .min(jnp.where(has, col, F32_MAX))
+    )[:nb]
+    vmax = (
+        jnp.full(nb + 1, -F32_MAX, dtype=jnp.float32)
+        .at[idx]
+        .max(jnp.where(has, col, -F32_MAX))
+    )[:nb]
+    return {"count": count, "sum": total, "min": vmin, "max": vmax}
+
+
+def _terms_postings(seg, field_name):
+    """Flat (docs [P], ords [P]) planes of a keyword field's postings."""
+    doc_tiles = seg["fields"][field_name][0]
+    ords = seg["ordinals"][field_name]
+    return doc_tiles.reshape(-1), ords.reshape(-1)
+
+
+def _eval_agg(spec, arrays, seg, matched, scores, num_docs):
+    kind = spec[0]
+    if kind == "metric":
+        col = seg["doc_values"][spec[1]]
+        return _metric_planes(col, matched)
+    if kind == "top_metric_score":
+        any_match = jnp.any(matched)
+        mx = jnp.max(jnp.where(matched, scores, -F32_MAX))
+        return {"max_score": mx, "any": any_match}
+    if kind == "cardinality_terms":
+        _, field_name, tp = spec
+        docs, ords = _terms_postings(seg, field_name)
+        m_ext = jnp.concatenate([matched, jnp.zeros(1, dtype=bool)])
+        m = m_ext[jnp.minimum(docs, num_docs)]
+        idx = jnp.where(m, ords, tp)
+        seen = jnp.zeros(tp + 1, dtype=bool).at[idx].max(m)[:tp]
+        return {"distinct": jnp.sum(seen, dtype=jnp.int32)}
+    if kind == "terms":
+        _, field_name, tp, sub_fields = spec
+        docs, ords = _terms_postings(seg, field_name)
+        m_ext = jnp.concatenate([matched, jnp.zeros(1, dtype=bool)])
+        m = m_ext[jnp.minimum(docs, num_docs)]
+        idx = jnp.where(m, ords, tp)
+        counts = (
+            jnp.zeros(tp + 1, dtype=jnp.int32).at[idx].add(m.astype(jnp.int32))
+        )[:tp]
+        out = {"counts": counts}
+        if sub_fields:
+            safe_docs = jnp.minimum(docs, num_docs - 1)
+            subs = {}
+            for f in sub_fields:
+                col = seg["doc_values"][f][safe_docs]
+                subs[f] = _bucket_metric_planes(col, m, ords, tp)
+            out["subs"] = subs
+        return out
+    if kind == "histogram":
+        _, field_name, nb, sub_fields = spec
+        col = seg["doc_values"][field_name]
+        has = matched & ~jnp.isnan(col)
+        rel = jnp.floor(
+            (col - arrays["offset"]) / arrays["interval"]
+        ) - arrays["base"]
+        rel = jnp.clip(rel, -1, nb).astype(jnp.int32)
+        bidx = jnp.where(has & (rel >= 0) & (rel < nb), rel, nb)
+        counts = (
+            jnp.zeros(nb + 1, dtype=jnp.int32)
+            .at[bidx]
+            .add((bidx < nb).astype(jnp.int32))
+        )[:nb]
+        out = {"counts": counts}
+        if sub_fields:
+            subs = {}
+            for f in sub_fields:
+                subs[f] = _bucket_metric_planes(
+                    seg["doc_values"][f], bidx < nb, bidx, nb
+                )
+            out["subs"] = subs
+        return out
+    if kind == "range":
+        _, field_name, r, sub_fields = spec
+        col = seg["doc_values"][field_name]
+        has = matched & ~jnp.isnan(col)
+        # [R, N] membership: ES range buckets are from-inclusive,
+        # to-exclusive and may overlap, so each range reduces independently.
+        in_r = (
+            has[None, :]
+            & (col[None, :] >= arrays["los"][:, None])
+            & (col[None, :] < arrays["his"][:, None])
+        )
+        counts = jnp.sum(in_r, axis=1, dtype=jnp.int32)
+        out = {"counts": counts}
+        if sub_fields:
+            subs = {}
+            for f in sub_fields:
+                sub_col = seg["doc_values"][f]
+                sub_has = in_r & ~jnp.isnan(sub_col)[None, :]
+                v = jnp.where(sub_has, sub_col[None, :], jnp.float32(0.0))
+                subs[f] = {
+                    "count": jnp.sum(sub_has, axis=1, dtype=jnp.int32),
+                    "sum": jnp.sum(v, axis=1, dtype=jnp.float32),
+                    "min": jnp.min(
+                        jnp.where(sub_has, sub_col[None, :], F32_MAX), axis=1
+                    ),
+                    "max": jnp.max(
+                        jnp.where(sub_has, sub_col[None, :], -F32_MAX), axis=1
+                    ),
+                }
+            out["subs"] = subs
+        return out
+    if kind == "filter":
+        _, query_spec, sub_specs = spec
+        _, f_matched = _eval_node(query_spec, arrays["query"], seg, num_docs)
+        m = matched & f_matched
+        return {
+            "doc_count": jnp.sum(m, dtype=jnp.int32),
+            "subs": tuple(
+                _eval_agg(s, a, seg, m, scores, num_docs)
+                for s, a in zip(sub_specs, arrays["subs"])
+            ),
+        }
+    if kind == "filters":
+        _, query_specs, sub_specs = spec
+        out = []
+        for qi, q_spec in enumerate(query_specs):
+            _, f_matched = _eval_node(
+                q_spec, arrays["queries"][qi], seg, num_docs
+            )
+            m = matched & f_matched
+            out.append(
+                {
+                    "doc_count": jnp.sum(m, dtype=jnp.int32),
+                    "subs": tuple(
+                        _eval_agg(s, a, seg, m, scores, num_docs)
+                        for s, a in zip(sub_specs, arrays["subs"])
+                    ),
+                }
+            )
+        return tuple(out)
+    if kind == "global":
+        _, sub_specs = spec
+        m = seg["live"]
+        return {
+            "doc_count": jnp.sum(m, dtype=jnp.int32),
+            "subs": tuple(
+                _eval_agg(s, a, seg, m, scores, num_docs)
+                for s, a in zip(sub_specs, arrays["subs"])
+            ),
+        }
+    if kind == "missing":
+        _, field_name, field_kind, sub_specs = spec
+        if field_kind == "inverted":
+            present = seg["fields"][field_name][4]
+        else:
+            present = ~jnp.isnan(seg["doc_values"][field_name])
+        m = matched & ~present
+        return {
+            "doc_count": jnp.sum(m, dtype=jnp.int32),
+            "subs": tuple(
+                _eval_agg(s, a, seg, m, scores, num_docs)
+                for s, a in zip(sub_specs, arrays["subs"])
+            ),
+        }
+    raise ValueError(f"unknown aggregation plan node [{kind}]")
+
+
+@partial(jax.jit, static_argnames=("query_spec", "aggs_spec"))
+def execute_aggs(seg, query_spec, query_arrays, aggs_spec, aggs_arrays):
+    """Evaluate the query then every aggregation in one XLA program.
+
+    Returns (total_hits i32[], agg result pytree). The query evaluates once;
+    all aggregations share the dense matched mask, exactly like the
+    reference's MultiBucketCollector wrapping every agg into one collection
+    pass (AggregationPhase.java:29 preProcess).
+    """
+    live = seg["live"]
+    num_docs = live.shape[0]
+    scores, matched = _eval_node(query_spec, query_arrays, seg, num_docs)
+    eligible = matched & live
+    total = jnp.sum(eligible, dtype=jnp.int32)
+    results = tuple(
+        _eval_agg(s, a, seg, eligible, scores, num_docs)
+        for s, a in zip(aggs_spec, aggs_arrays)
+    )
+    return total, results

@@ -1,0 +1,754 @@
+"""Aggregations: request parsing, per-segment planning, reduce, rendering.
+
+The host half of the aggregation subsystem (device kernels live in
+ops/aggs_device.py). Division of labor mirrors the reference:
+
+- parse_aggs: the x-content parsing of `"aggs"` request bodies into a typed
+  tree (reference: AggregatorFactories.parseAggregators via
+  search/SearchModule.java:333's 44-type registry — this module implements
+  the core analytics subset: terms, min, max, sum, avg, value_count, stats,
+  cardinality, histogram, date_histogram, range, filter, filters, global,
+  missing).
+- Aggregator.compile: lowers the tree against an engine's segments into the
+  static spec + arrays pytree executed on device (the AggregatorFactory →
+  Aggregator build step, search/aggregations/AggregationPhase.java:23).
+- Aggregator.reduce/render: cross-segment (and cross-shard) merge by bucket
+  key on the host, then ES-shaped JSON — the coordinator reduce of
+  InternalAggregations.topLevelReduce
+  (action/search/SearchPhaseController.java:480).
+
+Bucket sub-aggregations: `filter`/`filters`/`global`/`missing` nest any
+aggregation (they only mask); `terms`/`histogram`/`date_histogram`/`range`
+nest metric aggregations (per-bucket metrics compute as one scatter on
+device). Deeper bucket-in-bucket nesting raises 400.
+
+Numeric semantics: stored-value float32 on device (see ops/aggs_device.py);
+keys and metric values render from the f32 planes, with exact int keys for
+long-typed fields.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any
+
+import numpy as np
+
+METRIC_KINDS = {"min", "max", "sum", "avg", "value_count", "stats"}
+BUCKET_METRIC_HOSTS = {"terms", "histogram", "date_histogram", "range"}
+NESTING_KINDS = {"filter", "filters", "global", "missing"}
+MAX_BUCKETS = 65536  # ES search.max_buckets default
+
+# Calendar/fixed interval units in milliseconds (fixed-width ones; month+
+# use host-computed edges). ES treats day as fixed 86400000 ms in UTC.
+_FIXED_UNIT_MS = {
+    "ms": 1.0,
+    "s": 1000.0,
+    "second": 1000.0,
+    "1s": 1000.0,
+    "m": 60_000.0,
+    "minute": 60_000.0,
+    "1m": 60_000.0,
+    "h": 3_600_000.0,
+    "hour": 3_600_000.0,
+    "1h": 3_600_000.0,
+    "d": 86_400_000.0,
+    "day": 86_400_000.0,
+    "1d": 86_400_000.0,
+    "w": 604_800_000.0,
+    "week": 604_800_000.0,
+    "1w": 604_800_000.0,
+}
+
+
+class AggParsingError(ValueError):
+    """400 aggregation_execution_exception / parsing error."""
+
+
+class TooManyBucketsError(ValueError):
+    """ES too_many_buckets_exception (search.max_buckets breaker)."""
+
+
+@dataclass
+class AggNode:
+    name: str
+    kind: str
+    params: dict[str, Any]
+    subs: list["AggNode"] = dc_field(default_factory=list)
+
+
+def parse_aggs(body: dict[str, Any]) -> list[AggNode]:
+    """Parse an ES `"aggs"`/`"aggregations"` object into AggNode trees."""
+    nodes = []
+    for name, spec in body.items():
+        if not isinstance(spec, dict):
+            raise AggParsingError(f"aggregation [{name}] must be an object")
+        sub_body = None
+        kind = None
+        params: dict[str, Any] = {}
+        for key, val in spec.items():
+            if key in ("aggs", "aggregations"):
+                sub_body = val
+            elif kind is None:
+                kind, params = key, val if isinstance(val, dict) else {}
+            else:
+                raise AggParsingError(
+                    f"aggregation [{name}] declares multiple types "
+                    f"[{kind}] and [{key}]"
+                )
+        if kind is None:
+            raise AggParsingError(f"aggregation [{name}] has no type")
+        node = AggNode(name=name, kind=kind, params=dict(params))
+        if sub_body:
+            node.subs = parse_aggs(sub_body)
+        _validate(node)
+        nodes.append(node)
+    return nodes
+
+
+def _validate(node: AggNode) -> None:
+    k = node.kind
+    known = (
+        METRIC_KINDS
+        | BUCKET_METRIC_HOSTS
+        | NESTING_KINDS
+        | {"cardinality"}
+    )
+    if k not in known:
+        raise AggParsingError(f"unknown aggregation type [{k}]")
+    if k in METRIC_KINDS | {"cardinality"} and node.subs:
+        raise AggParsingError(
+            f"metric aggregation [{node.name}] cannot hold sub-aggregations"
+        )
+    if k in BUCKET_METRIC_HOSTS:
+        for sub in node.subs:
+            if sub.kind not in METRIC_KINDS:
+                raise AggParsingError(
+                    f"[{node.name}] supports metric sub-aggregations only; "
+                    f"[{sub.name}] is [{sub.kind}] (wrap it in a filter "
+                    f"aggregation for bucket-in-bucket nesting)"
+                )
+    if k != "global" and k != "filters" and k != "filter":
+        if k in METRIC_KINDS | {"cardinality", "missing"} | BUCKET_METRIC_HOSTS:
+            if "field" not in node.params:
+                raise AggParsingError(
+                    f"aggregation [{node.name}] of type [{k}] requires [field]"
+                )
+
+
+def _pow2(n: int, minimum: int = 1) -> int:
+    n = max(n, minimum)
+    return 1 << (n - 1).bit_length()
+
+
+class Aggregator:
+    """Plans, executes (per segment), reduces, and renders one request's aggs.
+
+    Construction plans against the engine's current segments: histogram
+    bases/bucket counts are computed from global column ranges so every
+    segment's result arrays align for the reduce.
+    """
+
+    def __init__(self, engine, nodes: list[AggNode]):
+        self.engine = engine
+        self.nodes = nodes
+        self.handles = [
+            h for h in engine.segments if h.segment.num_docs > 0
+        ]
+        self._host_needed = False
+        # Global per-field [min, max] over all segments (host columns are
+        # float64; quantize to f32 = stored-value semantics).
+        self._ranges: dict[str, tuple[float, float]] = {}
+        for h in self.handles:
+            for fname, col in h.segment.doc_values.items():
+                if not np.all(np.isnan(col)):
+                    lo = float(np.float32(np.nanmin(col)))
+                    hi = float(np.float32(np.nanmax(col)))
+                    old = self._ranges.get(fname, (np.inf, -np.inf))
+                    self._ranges[fname] = (min(old[0], lo), max(old[1], hi))
+        self._plan: dict[str, Any] = {}  # shared per-request plan state
+
+    # ----------------------------------------------------------- compile
+
+    def compile_for(self, handle, compiler) -> tuple[tuple, tuple]:
+        """(aggs_spec, aggs_arrays) for one segment."""
+        specs, arrays = [], []
+        for node in self.nodes:
+            s, a = self._compile_node(node, handle, compiler)
+            specs.append(s)
+            arrays.append(a)
+        return tuple(specs), tuple(arrays)
+
+    def _field_kind(self, handle, fname: str) -> str:
+        if fname in handle.device.fields:
+            return "inverted"
+        if fname in handle.device.doc_values:
+            return "numeric"
+        return "none"
+
+    def _keyword_ok(self, handle, fname: str) -> bool:
+        f = handle.device.fields.get(fname)
+        return f is not None and f.ord_terms is not None
+
+    def _compile_node(self, node: AggNode, handle, compiler):
+        k = node.kind
+        p = node.params
+        if k in METRIC_KINDS:
+            return ("metric", p["field"]), {}
+        if k == "cardinality":
+            fname = p["field"]
+            if self._keyword_ok(handle, fname):
+                tp = _pow2(handle.device.fields[fname].num_terms)
+                return ("terms", fname, tp, ()), {}
+            # numeric (or text) cardinality falls back to exact host compute
+            self._host_needed = True
+            return ("metric", fname), {}  # planes unused; mask fetched
+        if k == "terms":
+            fname = p["field"]
+            if self._keyword_ok(handle, fname):
+                tp = _pow2(handle.device.fields[fname].num_terms)
+                sub_fields = tuple(
+                    sorted({s.params["field"] for s in node.subs})
+                )
+                for f in sub_fields:
+                    if f not in handle.device.doc_values:
+                        raise AggParsingError(
+                            f"sub-aggregation field [{f}] has no doc values"
+                        )
+                return ("terms", fname, tp, sub_fields), {}
+            if self._field_kind(handle, fname) == "numeric":
+                self._host_needed = True
+                if node.subs:
+                    raise AggParsingError(
+                        "sub-aggregations under a numeric terms "
+                        "aggregation are not supported yet"
+                    )
+                return ("metric", fname), {}
+            raise AggParsingError(
+                f"cannot run terms aggregation on field [{fname}]: text "
+                f"fields need keyword doc values (use a keyword field)"
+            )
+        if k in ("histogram", "date_histogram"):
+            return self._compile_histogram(node, handle)
+        if k == "range":
+            fname = p["field"]
+            raw = p.get("ranges")
+            if not raw:
+                raise AggParsingError(
+                    f"range aggregation [{node.name}] requires [ranges]"
+                )
+            los = np.asarray(
+                [np.float32(r.get("from", -np.inf)) for r in raw],
+                dtype=np.float32,
+            )
+            his = np.asarray(
+                [np.float32(r.get("to", np.inf)) for r in raw],
+                dtype=np.float32,
+            )
+            sub_fields = tuple(sorted({s.params["field"] for s in node.subs}))
+            spec = ("range", fname, len(raw), sub_fields)
+            return spec, {"los": los, "his": his}
+        if k == "filter":
+            compiled = compiler.compile(_parse_query(p))
+            sub_s, sub_a = self._compile_subs(node, handle, compiler)
+            return ("filter", compiled.spec, sub_s), {
+                "query": compiled.arrays,
+                "subs": sub_a,
+            }
+        if k == "filters":
+            raw = p.get("filters")
+            if isinstance(raw, dict):
+                keys = sorted(raw)
+                queries = [raw[key] for key in keys]
+                self._plan.setdefault("filters_keys", {})[node.name] = keys
+            elif isinstance(raw, list):
+                queries = raw
+                self._plan.setdefault("filters_keys", {})[node.name] = None
+            else:
+                raise AggParsingError(
+                    f"filters aggregation [{node.name}] requires [filters]"
+                )
+            compiled = [compiler.compile(_parse_query({"filter": q})) for q in queries]
+            sub_s, sub_a = self._compile_subs(node, handle, compiler)
+            return (
+                "filters",
+                tuple(c.spec for c in compiled),
+                sub_s,
+            ), {"queries": tuple(c.arrays for c in compiled), "subs": sub_a}
+        if k == "global":
+            sub_s, sub_a = self._compile_subs(node, handle, compiler)
+            return ("global", sub_s), {"subs": sub_a}
+        if k == "missing":
+            fname = p["field"]
+            fkind = self._field_kind(handle, fname)
+            if fkind == "none":
+                fkind = "numeric"  # unmapped: every doc is missing
+                # compile against a ghost column of NaNs? use inverted absent
+                raise AggParsingError(
+                    f"missing aggregation on unmapped field [{fname}]"
+                )
+            sub_s, sub_a = self._compile_subs(node, handle, compiler)
+            return ("missing", fname, fkind, sub_s), {"subs": sub_a}
+        raise AggParsingError(f"unknown aggregation type [{k}]")
+
+    def _compile_subs(self, node: AggNode, handle, compiler):
+        specs, arrays = [], []
+        for sub in node.subs:
+            s, a = self._compile_node(sub, handle, compiler)
+            specs.append(s)
+            arrays.append(a)
+        return tuple(specs), tuple(arrays)
+
+    def _compile_histogram(self, node: AggNode, handle):
+        p = node.params
+        fname = p["field"]
+        interval, edges = self._histogram_interval(node)
+        if edges is not None:
+            # Calendar intervals (month+): host-computed bucket edges run as
+            # a range aggregation; keys render from the edges.
+            sub_fields = tuple(sorted({s.params["field"] for s in node.subs}))
+            los = np.asarray(edges[:-1], dtype=np.float32)
+            his = np.asarray(edges[1:], dtype=np.float32)
+            self._plan.setdefault("hist_edges", {})[node.name] = edges
+            return ("range", fname, len(los), sub_fields), {
+                "los": los,
+                "his": his,
+            }
+        offset = float(p.get("offset", 0.0))
+        lo, hi = self._ranges.get(fname, (0.0, 0.0))
+        base = float(np.floor((lo - offset) / interval))
+        last = float(np.floor((hi - offset) / interval))
+        nb = int(last - base) + 1 if hi >= lo else 1
+        if nb > MAX_BUCKETS:
+            raise TooManyBucketsError(
+                f"Trying to create too many buckets. Must be less than or "
+                f"equal to: [{MAX_BUCKETS}] but was [{nb}]"
+            )
+        nb_pad = _pow2(nb)
+        self._plan.setdefault("hist_params", {})[node.name] = (
+            interval,
+            offset,
+            base,
+        )
+        sub_fields = tuple(sorted({s.params["field"] for s in node.subs}))
+        spec = ("histogram", fname, nb_pad, sub_fields)
+        arrays = {
+            "interval": np.float32(interval),
+            "offset": np.float32(offset),
+            "base": np.float32(base),
+        }
+        return spec, arrays
+
+    def _histogram_interval(self, node: AggNode):
+        """(fixed_interval_ms_or_value, calendar_edges_or_None)."""
+        p = node.params
+        if node.kind == "histogram":
+            interval = p.get("interval")
+            if interval is None or float(interval) <= 0:
+                raise AggParsingError(
+                    f"[interval] must be a positive decimal in [{node.name}]"
+                )
+            return float(interval), None
+        unit = p.get("calendar_interval") or p.get("fixed_interval") or p.get(
+            "interval"
+        )
+        if unit is None:
+            raise AggParsingError(
+                f"date_histogram [{node.name}] requires [calendar_interval] "
+                f"or [fixed_interval]"
+            )
+        unit = str(unit)
+        if unit in _FIXED_UNIT_MS:
+            return _FIXED_UNIT_MS[unit], None
+        # fixed_interval like "30s", "12h", "90m", "7d"
+        import re as _re
+
+        m = _re.fullmatch(r"(\d+)(ms|s|m|h|d)", unit)
+        if m:
+            return float(m.group(1)) * _FIXED_UNIT_MS[m.group(2)], None
+        if unit in ("month", "1M", "M", "quarter", "1q", "q", "year", "1y", "y"):
+            return 0.0, self._calendar_edges(node, unit)
+        raise AggParsingError(
+            f"unknown date_histogram interval [{unit}] in [{node.name}]"
+        )
+
+    def _calendar_edges(self, node: AggNode, unit: str) -> list[float]:
+        """UTC month/quarter/year bucket edges covering the field's range."""
+        from datetime import datetime, timezone
+
+        fname = node.params["field"]
+        lo, hi = self._ranges.get(fname, (0.0, 0.0))
+        months = {"month": 1, "1M": 1, "M": 1, "quarter": 3, "1q": 3, "q": 3}.get(
+            unit, 12
+        )
+        start = datetime.fromtimestamp(lo / 1000.0, tz=timezone.utc)
+        y, mo = start.year, ((start.month - 1) // months) * months + 1
+        edges = []
+        while True:
+            edge = datetime(y, mo, 1, tzinfo=timezone.utc).timestamp() * 1000.0
+            edges.append(edge)
+            if edge > hi:
+                break
+            if len(edges) > MAX_BUCKETS:
+                raise TooManyBucketsError(
+                    f"Trying to create too many buckets. Must be less than "
+                    f"or equal to: [{MAX_BUCKETS}]"
+                )
+            mo += months
+            while mo > 12:
+                mo -= 12
+                y += 1
+        return edges
+
+    # ----------------------------------------------------------- execute
+
+    def run(self) -> tuple[int, dict[str, Any]]:
+        """Execute over every segment; returns (total_hits, rendered aggs)."""
+        raise NotImplementedError  # bound by SearchService (needs the query)
+
+
+def _parse_query(params: dict) -> Any:
+    """Parse the query body of a filter agg ({"filter": {...}} wrapper or
+    the bare query object of the `filter` agg itself)."""
+    from ..query.dsl import parse_query
+
+    body = params.get("filter", params)
+    return parse_query(body)
+
+
+# ---------------------------------------------------------------- reduce
+
+
+def new_merge_state(node: AggNode) -> dict[str, Any]:
+    k = node.kind
+    if k in METRIC_KINDS:
+        return {"count": 0, "sum": 0.0, "min": np.inf, "max": -np.inf, "sumsq": 0.0}
+    if k == "cardinality":
+        return {"values": set()}
+    if k == "terms":
+        return {"counts": {}, "subs": {}, "host": False}
+    if k in ("histogram", "date_histogram"):
+        return {"counts": None, "subs": {}}
+    if k == "range":
+        return {"counts": None, "subs": {}}
+    if k in ("filter", "global", "missing"):
+        return {
+            "doc_count": 0,
+            "subs": [new_merge_state(s) for s in node.subs],
+        }
+    if k == "filters":
+        return {"buckets": None}
+    raise AggParsingError(f"unknown aggregation type [{k}]")
+
+
+def _merge_metric(state, planes):
+    state["count"] += int(planes["count"])
+    state["sum"] += float(planes["sum"])
+    state["min"] = min(state["min"], float(planes["min"]))
+    state["max"] = max(state["max"], float(planes["max"]))
+    state["sumsq"] += float(planes["sumsq"])
+
+
+def _merge_bucket_planes(tgt: dict, planes, keys):
+    """Merge per-bucket metric planes into key->plane dicts."""
+    counts = np.asarray(planes["count"])
+    sums = np.asarray(planes["sum"])
+    mins = np.asarray(planes["min"])
+    maxs = np.asarray(planes["max"])
+    for i, key in enumerate(keys):
+        if key is None:
+            continue
+        cur = tgt.setdefault(
+            key, {"count": 0, "sum": 0.0, "min": np.inf, "max": -np.inf}
+        )
+        cur["count"] += int(counts[i])
+        cur["sum"] += float(sums[i])
+        cur["min"] = min(cur["min"], float(mins[i]))
+        cur["max"] = max(cur["max"], float(maxs[i]))
+
+
+def merge_segment_result(node: AggNode, state, result, handle) -> None:
+    """Fold one segment's device result into the cross-segment state."""
+    k = node.kind
+    if k in METRIC_KINDS:
+        _merge_metric(state, result)
+        return
+    if k == "cardinality":
+        fname = node.params["field"]
+        dfield = handle.device.fields.get(fname)
+        if dfield is not None and dfield.ord_terms is not None:
+            counts = np.asarray(result["counts"])
+            vocab = list(dfield.terms.keys())
+            nz = np.flatnonzero(counts[: len(vocab)])
+            state["values"].update(vocab[i] for i in nz)
+        return
+    if k == "terms":
+        fname = node.params["field"]
+        dfield = handle.device.fields[fname]
+        vocab = list(dfield.terms.keys())
+        counts = np.asarray(result["counts"])
+        nz = np.flatnonzero(counts[: len(vocab)])
+        for i in nz:
+            key = vocab[i]
+            state["counts"][key] = state["counts"].get(key, 0) + int(counts[i])
+        if node.subs:
+            keys = [
+                vocab[i] if counts[i] > 0 else None
+                for i in range(len(vocab))
+            ]
+            for f, planes in result["subs"].items():
+                trimmed = {
+                    name: np.asarray(arr)[: len(vocab)]
+                    for name, arr in planes.items()
+                }
+                _merge_bucket_planes(
+                    state["subs"].setdefault(f, {}), trimmed, keys
+                )
+        return
+    if k in ("histogram", "date_histogram", "range"):
+        counts = np.asarray(result["counts"]).astype(np.int64)
+        if state["counts"] is None:
+            state["counts"] = counts.copy()
+        else:
+            state["counts"] += counts
+        if node.subs and "subs" in result:
+            for f, planes in result["subs"].items():
+                cur = state["subs"].get(f)
+                planes = {k2: np.asarray(v) for k2, v in planes.items()}
+                if cur is None:
+                    state["subs"][f] = {
+                        "count": planes["count"].astype(np.int64),
+                        "sum": planes["sum"].astype(np.float64),
+                        "min": planes["min"].copy(),
+                        "max": planes["max"].copy(),
+                    }
+                else:
+                    cur["count"] += planes["count"]
+                    cur["sum"] += planes["sum"]
+                    cur["min"] = np.minimum(cur["min"], planes["min"])
+                    cur["max"] = np.maximum(cur["max"], planes["max"])
+        return
+    if k in ("filter", "global", "missing"):
+        state["doc_count"] += int(result["doc_count"])
+        for sub_node, sub_state, sub_result in zip(
+            node.subs, state["subs"], result["subs"]
+        ):
+            merge_segment_result(sub_node, sub_state, sub_result, handle)
+        return
+    if k == "filters":
+        if state["buckets"] is None:
+            state["buckets"] = [
+                {
+                    "doc_count": 0,
+                    "subs": [new_merge_state(s) for s in node.subs],
+                }
+                for _ in result
+            ]
+        for bstate, bresult in zip(state["buckets"], result):
+            bstate["doc_count"] += int(bresult["doc_count"])
+            for sub_node, sub_state, sub_result in zip(
+                node.subs, bstate["subs"], bresult["subs"]
+            ):
+                merge_segment_result(sub_node, sub_state, sub_result, handle)
+        return
+    raise AggParsingError(f"unknown aggregation type [{k}]")
+
+
+# ---------------------------------------------------------------- render
+
+
+def _render_metric(kind: str, state) -> dict[str, Any]:
+    count = state["count"]
+    if kind == "value_count":
+        return {"value": count}
+    if kind == "sum":
+        return {"value": float(state["sum"])}
+    if kind == "min":
+        return {"value": float(state["min"]) if count else None}
+    if kind == "max":
+        return {"value": float(state["max"]) if count else None}
+    if kind == "avg":
+        return {"value": float(state["sum"]) / count if count else None}
+    if kind == "stats":
+        return {
+            "count": count,
+            "min": float(state["min"]) if count else None,
+            "max": float(state["max"]) if count else None,
+            "avg": float(state["sum"]) / count if count else None,
+            "sum": float(state["sum"]),
+        }
+    raise AggParsingError(f"unknown metric [{kind}]")
+
+
+def _sub_bucket_rendering(node: AggNode, key, sub_planes_by_field):
+    out = {}
+    for sub in node.subs:
+        f = sub.params["field"]
+        planes = sub_planes_by_field.get(f, {}).get(
+            key, {"count": 0, "sum": 0.0, "min": np.inf, "max": -np.inf}
+        )
+        planes = dict(planes)
+        planes.setdefault("sumsq", 0.0)
+        out[sub.name] = _render_metric(sub.kind, planes)
+    return out
+
+
+def _render_array_sub(node: AggNode, idx: int, state) -> dict[str, Any]:
+    out = {}
+    for sub in node.subs:
+        f = sub.params["field"]
+        planes = state["subs"].get(f)
+        if planes is None:
+            p = {"count": 0, "sum": 0.0, "min": np.inf, "max": -np.inf, "sumsq": 0.0}
+        else:
+            p = {
+                "count": int(planes["count"][idx]),
+                "sum": float(planes["sum"][idx]),
+                "min": float(planes["min"][idx]),
+                "max": float(planes["max"][idx]),
+                "sumsq": 0.0,
+            }
+        out[sub.name] = _render_metric(sub.kind, p)
+    return out
+
+
+def _key_for_field(engine, fname: str, value: float):
+    """Render a numeric bucket key with the field's type (int for longs)."""
+    fm = engine.mappings.get(fname)
+    if fm is not None and fm.type in ("long", "integer", "short", "byte", "date"):
+        return int(value)
+    return float(value)
+
+
+def _iso_utc(ms: float) -> str:
+    from datetime import datetime, timezone
+
+    dt = datetime.fromtimestamp(ms / 1000.0, tz=timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+
+
+def render(node: AggNode, state, engine, plan: dict) -> dict[str, Any]:
+    k = node.kind
+    if k in METRIC_KINDS:
+        return _render_metric(k, state)
+    if k == "cardinality":
+        return {"value": len(state["values"])}
+    if k == "terms":
+        size = int(node.params.get("size", 10))
+        order = node.params.get("order", {"_count": "desc"})
+        items = list(state["counts"].items())
+        min_doc_count = int(node.params.get("min_doc_count", 1))
+        items = [it for it in items if it[1] >= min_doc_count]
+        ((order_key, order_dir),) = (
+            order.items() if isinstance(order, dict) else [("_count", "desc")]
+        )
+        reverse = str(order_dir) == "desc"
+        if order_key == "_key":
+            items.sort(key=lambda kv: kv[0], reverse=reverse)
+        else:  # _count order; key asc tiebreak like the reference
+            items.sort(key=lambda kv: (-kv[1], kv[0]) if reverse else (kv[1], kv[0]))
+        total = sum(state["counts"].values())
+        top = items[:size]
+        buckets = []
+        for key, count in top:
+            b = {"key": key, "doc_count": count}
+            if node.subs:
+                b.update(_sub_bucket_rendering(node, key, state["subs"]))
+            buckets.append(b)
+        return {
+            "doc_count_error_upper_bound": 0,  # exact: full per-segment counts
+            "sum_other_doc_count": total - sum(c for _, c in top),
+            "buckets": buckets,
+        }
+    if k in ("histogram", "date_histogram"):
+        return _render_histogram(node, state, engine, plan)
+    if k == "range":
+        raw = node.params.get("ranges", [])
+        counts = state["counts"]
+        buckets = []
+        for i, r in enumerate(raw):
+            frm, to = r.get("from"), r.get("to")
+            if "key" in r:
+                key = r["key"]
+            else:
+                key = f"{_fmt_edge(frm)}-{_fmt_edge(to)}"
+            b: dict[str, Any] = {"key": key}
+            if frm is not None:
+                b["from"] = float(frm)
+            if to is not None:
+                b["to"] = float(to)
+            b["doc_count"] = int(counts[i]) if counts is not None else 0
+            if node.subs:
+                b.update(_render_array_sub(node, i, state))
+            buckets.append(b)
+        return {"buckets": buckets}
+    if k == "filter" or k == "missing":
+        out = {"doc_count": state["doc_count"]}
+        for sub_node, sub_state in zip(node.subs, state["subs"]):
+            out[sub_node.name] = render(sub_node, sub_state, engine, plan)
+        return out
+    if k == "global":
+        out = {"doc_count": state["doc_count"]}
+        for sub_node, sub_state in zip(node.subs, state["subs"]):
+            out[sub_node.name] = render(sub_node, sub_state, engine, plan)
+        return out
+    if k == "filters":
+        keys = plan.get("filters_keys", {}).get(node.name)
+        rendered = []
+        for bstate in state["buckets"] or []:
+            out = {"doc_count": bstate["doc_count"]}
+            for sub_node, sub_state in zip(node.subs, bstate["subs"]):
+                out[sub_node.name] = render(sub_node, sub_state, engine, plan)
+            rendered.append(out)
+        if keys is not None:
+            return {"buckets": dict(zip(keys, rendered))}
+        return {"buckets": rendered}
+    raise AggParsingError(f"unknown aggregation type [{k}]")
+
+
+def _fmt_edge(v) -> str:
+    return "*" if v is None else str(float(v))
+
+
+def _render_histogram(node: AggNode, state, engine, plan) -> dict[str, Any]:
+    fname = node.params["field"]
+    min_doc_count = int(node.params.get("min_doc_count", 0))
+    is_date = node.kind == "date_histogram"
+    edges = plan.get("hist_edges", {}).get(node.name)
+    buckets = []
+    if edges is not None:  # calendar buckets executed as ranges
+        counts = state["counts"]
+        for i in range(len(edges) - 1):
+            count = int(counts[i]) if counts is not None else 0
+            buckets.append((edges[i], count, i))
+    else:
+        interval, offset, base = plan["hist_params"][node.name]
+        counts = state["counts"]
+        if counts is None:
+            counts = np.zeros(0, dtype=np.int64)
+        for i in range(len(counts)):
+            key = (base + i) * interval + offset
+            buckets.append((key, int(counts[i]), i))
+    # ES trims to [first, last] bucket with >= max(1, min_doc_count) docs,
+    # keeping interior empties when min_doc_count == 0.
+    occupied = [i for i, (_, c, _) in enumerate(buckets) if c > 0]
+    if not occupied:
+        return {"buckets": []}
+    lo_i, hi_i = occupied[0], occupied[-1]
+    out = []
+    for key, count, idx in buckets[lo_i : hi_i + 1]:
+        if count < min_doc_count:
+            continue
+        b: dict[str, Any] = {}
+        if is_date:
+            b["key_as_string"] = _iso_utc(key)
+            b["key"] = int(key)
+        else:
+            b["key"] = _key_for_field(engine, fname, key) if float(
+                key
+            ).is_integer() else float(key)
+        b["doc_count"] = count
+        if node.subs:
+            b.update(_render_array_sub(node, idx, state))
+        out.append(b)
+    return {"buckets": out}
