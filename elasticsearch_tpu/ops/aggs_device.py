@@ -145,6 +145,26 @@ def _eval_agg(spec, arrays, seg, matched, scores, num_docs):
     if kind == "metric":
         col = seg["doc_values"][spec[1]]
         return _metric_planes(col, matched)
+    if kind == "empty_metric":
+        # Field has no column in this segment: zero contribution, same
+        # plane shape as a real metric so the host merge is uniform.
+        return {
+            "count": jnp.int32(0),
+            "sum": jnp.float32(0.0),
+            "min": F32_MAX,
+            "max": -F32_MAX,
+            "sumsq": jnp.float32(0.0),
+        }
+    if kind == "empty_buckets":
+        # Histogram/range over a column absent from this segment: zero
+        # counts shaped like the segments that do carry the column.
+        return {"counts": jnp.zeros(spec[1], dtype=jnp.int32)}
+    if kind == "matched":
+        # Host-fallback aggregations (exact numeric cardinality, numeric
+        # terms) fetch the dense eligible mask and finish on the host from
+        # the segment's float64 columns — the TPU analog of the reference
+        # falling back from global ordinals to per-value collection.
+        return {"mask": matched}
     if kind == "top_metric_score":
         any_match = jnp.any(matched)
         mx = jnp.max(jnp.where(matched, scores, -F32_MAX))
@@ -272,8 +292,10 @@ def _eval_agg(spec, arrays, seg, matched, scores, num_docs):
         _, field_name, field_kind, sub_specs = spec
         if field_kind == "inverted":
             present = seg["fields"][field_name][4]
-        else:
+        elif field_kind == "numeric":
             present = ~jnp.isnan(seg["doc_values"][field_name])
+        else:  # unmapped / absent from this segment: everything is missing
+            present = jnp.zeros_like(matched)
         m = matched & ~present
         return {
             "doc_count": jnp.sum(m, dtype=jnp.int32),

@@ -149,24 +149,39 @@ class Aggregator:
     segment's result arrays align for the reduce.
     """
 
-    def __init__(self, engine, nodes: list[AggNode]):
+    def __init__(self, engine, nodes: list[AggNode], handles=None):
         self.engine = engine
         self.nodes = nodes
-        self.handles = [
-            h for h in engine.segments if h.segment.num_docs > 0
-        ]
-        self._host_needed = False
-        # Global per-field [min, max] over all segments (host columns are
-        # float64; quantize to f32 = stored-value semantics).
-        self._ranges: dict[str, tuple[float, float]] = {}
+        # `handles` lets the caller share one segment snapshot between the
+        # agg pass and the hits pass (concurrent refresh would otherwise
+        # desynchronize totals from hits).
+        segments = engine.segments if handles is None else handles
+        self.handles = [h for h in segments if h.segment.num_docs > 0]
+        # Per-request plan state, keyed by id(node) — names are not unique
+        # across nesting levels (a filter-nested histogram may shadow a
+        # top-level one of the same name).
+        self._plan: dict[str, Any] = {}
+        self._range_cache: dict[str, tuple[float, float]] = {}
+
+    def _field_range(self, fname: str) -> tuple[float, float]:
+        """Global [min, max] of a numeric column over the snapshot's
+        segments, lazily computed only for fields histogram aggs plan over
+        (host columns are float64; quantize to f32 = stored-value
+        semantics)."""
+        cached = self._range_cache.get(fname)
+        if cached is not None:
+            return cached
+        lo, hi = np.inf, -np.inf
         for h in self.handles:
-            for fname, col in h.segment.doc_values.items():
-                if not np.all(np.isnan(col)):
-                    lo = float(np.float32(np.nanmin(col)))
-                    hi = float(np.float32(np.nanmax(col)))
-                    old = self._ranges.get(fname, (np.inf, -np.inf))
-                    self._ranges[fname] = (min(old[0], lo), max(old[1], hi))
-        self._plan: dict[str, Any] = {}  # shared per-request plan state
+            col = h.segment.doc_values.get(fname)
+            if col is None or np.all(np.isnan(col)):
+                continue
+            lo = min(lo, float(np.float32(np.nanmin(col))))
+            hi = max(hi, float(np.float32(np.nanmax(col))))
+        if not np.isfinite(lo):
+            lo, hi = 0.0, 0.0
+        self._range_cache[fname] = (lo, hi)
+        return lo, hi
 
     # ----------------------------------------------------------- compile
 
@@ -190,44 +205,77 @@ class Aggregator:
         f = handle.device.fields.get(fname)
         return f is not None and f.ord_terms is not None
 
+    def _is_text(self, handle, fname: str) -> bool:
+        """Field indexed with norms (text) in this segment — aggs reject it
+        the way the reference rejects text fields without fielddata."""
+        f = handle.device.fields.get(fname)
+        return f is not None and f.has_norms
+
+    def _require_numeric(self, fname: str) -> None:
+        """Numeric-valued agg positions (metrics, histogram, range,
+        sub-metrics) must not silently return empties for mapped
+        non-numeric fields — the reference 400s 'field of type [keyword]
+        is not supported'. Unmapped fields stay permissive (empty result),
+        matching ES unmapped-field semantics."""
+        fm = self.engine.mappings.get(fname)
+        if fm is not None and not fm.is_numeric:
+            raise AggParsingError(
+                f"field [{fname}] of type [{fm.type}] is not supported "
+                f"for numeric aggregations"
+            )
+
+    def _sub_fields(self, node: AggNode, handle) -> tuple:
+        """Sub-metric fields present in this segment's doc values. A field
+        some docs lack simply contributes nothing from segments without it
+        (the reference's ValuesSource skips docs missing the field)."""
+        out = []
+        for f in sorted({s.params["field"] for s in node.subs}):
+            self._require_numeric(f)
+            if f in handle.device.doc_values:
+                out.append(f)
+        return tuple(out)
+
     def _compile_node(self, node: AggNode, handle, compiler):
         k = node.kind
         p = node.params
         if k in METRIC_KINDS:
-            return ("metric", p["field"]), {}
+            fname = p["field"]
+            self._require_numeric(fname)
+            if fname in handle.device.doc_values:
+                return ("metric", fname), {}
+            # Field absent from this segment (or unmapped): contributes
+            # nothing; other segments may still carry values.
+            return ("empty_metric",), {}
         if k == "cardinality":
             fname = p["field"]
             if self._keyword_ok(handle, fname):
                 tp = _pow2(handle.device.fields[fname].num_terms)
                 return ("terms", fname, tp, ()), {}
-            # numeric (or text) cardinality falls back to exact host compute
-            self._host_needed = True
-            return ("metric", fname), {}  # planes unused; mask fetched
+            if self._is_text(handle, fname):
+                raise AggParsingError(
+                    f"cardinality aggregation on text field [{fname}] "
+                    f"requires keyword doc values"
+                )
+            # numeric cardinality (exact host compute off the matched mask),
+            # or field absent from this segment (host fallback yields none)
+            return ("matched",), {}
         if k == "terms":
             fname = p["field"]
             if self._keyword_ok(handle, fname):
                 tp = _pow2(handle.device.fields[fname].num_terms)
-                sub_fields = tuple(
-                    sorted({s.params["field"] for s in node.subs})
+                return ("terms", fname, tp, self._sub_fields(node, handle)), {}
+            if self._is_text(handle, fname):
+                raise AggParsingError(
+                    f"cannot run terms aggregation on field [{fname}]: text "
+                    f"fields need keyword doc values (use a keyword field)"
                 )
-                for f in sub_fields:
-                    if f not in handle.device.doc_values:
-                        raise AggParsingError(
-                            f"sub-aggregation field [{f}] has no doc values"
-                        )
-                return ("terms", fname, tp, sub_fields), {}
-            if self._field_kind(handle, fname) == "numeric":
-                self._host_needed = True
-                if node.subs:
-                    raise AggParsingError(
-                        "sub-aggregations under a numeric terms "
-                        "aggregation are not supported yet"
-                    )
-                return ("metric", fname), {}
-            raise AggParsingError(
-                f"cannot run terms aggregation on field [{fname}]: text "
-                f"fields need keyword doc values (use a keyword field)"
-            )
+            if node.subs and self._field_kind(handle, fname) == "numeric":
+                raise AggParsingError(
+                    "sub-aggregations under a numeric terms "
+                    "aggregation are not supported yet"
+                )
+            # numeric terms host fallback; absent fields contribute nothing
+            return ("matched",), {}
         if k in ("histogram", "date_histogram"):
             return self._compile_histogram(node, handle)
         if k == "range":
@@ -237,6 +285,9 @@ class Aggregator:
                 raise AggParsingError(
                     f"range aggregation [{node.name}] requires [ranges]"
                 )
+            self._require_numeric(fname)
+            if fname not in handle.device.doc_values:
+                return ("empty_buckets", len(raw)), {}
             los = np.asarray(
                 [np.float32(r.get("from", -np.inf)) for r in raw],
                 dtype=np.float32,
@@ -245,8 +296,7 @@ class Aggregator:
                 [np.float32(r.get("to", np.inf)) for r in raw],
                 dtype=np.float32,
             )
-            sub_fields = tuple(sorted({s.params["field"] for s in node.subs}))
-            spec = ("range", fname, len(raw), sub_fields)
+            spec = ("range", fname, len(raw), self._sub_fields(node, handle))
             return spec, {"los": los, "his": his}
         if k == "filter":
             compiled = compiler.compile(_parse_query(p))
@@ -256,18 +306,7 @@ class Aggregator:
                 "subs": sub_a,
             }
         if k == "filters":
-            raw = p.get("filters")
-            if isinstance(raw, dict):
-                keys = sorted(raw)
-                queries = [raw[key] for key in keys]
-                self._plan.setdefault("filters_keys", {})[node.name] = keys
-            elif isinstance(raw, list):
-                queries = raw
-                self._plan.setdefault("filters_keys", {})[node.name] = None
-            else:
-                raise AggParsingError(
-                    f"filters aggregation [{node.name}] requires [filters]"
-                )
+            keys, queries = _filters_defs(node)
             compiled = [compiler.compile(_parse_query({"filter": q})) for q in queries]
             sub_s, sub_a = self._compile_subs(node, handle, compiler)
             return (
@@ -281,12 +320,9 @@ class Aggregator:
         if k == "missing":
             fname = p["field"]
             fkind = self._field_kind(handle, fname)
-            if fkind == "none":
-                fkind = "numeric"  # unmapped: every doc is missing
-                # compile against a ghost column of NaNs? use inverted absent
-                raise AggParsingError(
-                    f"missing aggregation on unmapped field [{fname}]"
-                )
+            # fkind "none" (unmapped or absent from this segment): every
+            # matched doc counts as missing, like the reference's missing
+            # agg over an unmapped field.
             sub_s, sub_a = self._compile_subs(node, handle, compiler)
             return ("missing", fname, fkind, sub_s), {"subs": sub_a}
         raise AggParsingError(f"unknown aggregation type [{k}]")
@@ -302,20 +338,46 @@ class Aggregator:
     def _compile_histogram(self, node: AggNode, handle):
         p = node.params
         fname = p["field"]
+        self._require_numeric(fname)
         interval, edges = self._histogram_interval(node)
+        if fname not in handle.device.doc_values:
+            # Keep the bucket-array shape consistent with the segments that
+            # do carry the column so the cross-segment merge aligns.
+            if edges is not None:
+                nb = len(edges) - 1
+                self._plan.setdefault("hist_edges", {})[id(node)] = edges
+            else:
+                _, _, _, nb = self._fixed_hist_plan(node, interval)  # padded
+            return ("empty_buckets", max(nb, 1)), {}
         if edges is not None:
             # Calendar intervals (month+): host-computed bucket edges run as
             # a range aggregation; keys render from the edges.
             sub_fields = tuple(sorted({s.params["field"] for s in node.subs}))
             los = np.asarray(edges[:-1], dtype=np.float32)
             his = np.asarray(edges[1:], dtype=np.float32)
-            self._plan.setdefault("hist_edges", {})[node.name] = edges
+            self._plan.setdefault("hist_edges", {})[id(node)] = edges
             return ("range", fname, len(los), sub_fields), {
                 "los": los,
                 "his": his,
             }
-        offset = float(p.get("offset", 0.0))
-        lo, hi = self._ranges.get(fname, (0.0, 0.0))
+        offset, base, nb, nb_pad = self._fixed_hist_plan(node, interval)
+        spec = ("histogram", fname, nb_pad, self._sub_fields(node, handle))
+        arrays = {
+            "interval": np.float32(interval),
+            "offset": np.float32(offset),
+            "base": np.float32(base),
+        }
+        return spec, arrays
+
+    def _fixed_hist_plan(
+        self, node: AggNode, interval: float
+    ) -> tuple[float, float, int, int]:
+        """(offset, base, nb, nb_pad) for a fixed-interval histogram; the
+        bucket window derives from the GLOBAL column range so every
+        segment's result arrays align for the reduce. Also records the
+        render-time plan entry."""
+        offset = float(node.params.get("offset", 0.0))
+        lo, hi = self._field_range(node.params["field"])
         base = float(np.floor((lo - offset) / interval))
         last = float(np.floor((hi - offset) / interval))
         nb = int(last - base) + 1 if hi >= lo else 1
@@ -324,20 +386,12 @@ class Aggregator:
                 f"Trying to create too many buckets. Must be less than or "
                 f"equal to: [{MAX_BUCKETS}] but was [{nb}]"
             )
-        nb_pad = _pow2(nb)
-        self._plan.setdefault("hist_params", {})[node.name] = (
+        self._plan.setdefault("hist_params", {})[id(node)] = (
             interval,
             offset,
             base,
         )
-        sub_fields = tuple(sorted({s.params["field"] for s in node.subs}))
-        spec = ("histogram", fname, nb_pad, sub_fields)
-        arrays = {
-            "interval": np.float32(interval),
-            "offset": np.float32(offset),
-            "base": np.float32(base),
-        }
-        return spec, arrays
+        return offset, base, nb, _pow2(nb)
 
     def _histogram_interval(self, node: AggNode):
         """(fixed_interval_ms_or_value, calendar_edges_or_None)."""
@@ -377,7 +431,7 @@ class Aggregator:
         from datetime import datetime, timezone
 
         fname = node.params["field"]
-        lo, hi = self._ranges.get(fname, (0.0, 0.0))
+        lo, hi = self._field_range(fname)
         months = {"month": 1, "1M": 1, "M": 1, "quarter": 3, "1q": 3, "q": 3}.get(
             unit, 12
         )
@@ -402,9 +456,55 @@ class Aggregator:
 
     # ----------------------------------------------------------- execute
 
-    def run(self) -> tuple[int, dict[str, Any]]:
-        """Execute over every segment; returns (total_hits, rendered aggs)."""
-        raise NotImplementedError  # bound by SearchService (needs the query)
+    def run(self, query, stats=None) -> tuple[int, dict[str, Any]]:
+        """Execute over every segment; returns (total_hits, rendered aggs).
+
+        One XLA program per segment evaluates the query once and every
+        aggregation off the shared matched mask (the reference's
+        MultiBucketCollector single collection pass,
+        search/aggregations/AggregationPhase.java:29); cross-segment merge
+        happens here on the host, the coordinator-reduce analog. When hits
+        are also requested the top-k pass runs separately (its kernel is the
+        benched fast path); `stats` lets the caller share the shard-level
+        statistics between the two passes."""
+        import jax
+
+        from ..ops import aggs_device
+
+        if stats is None:
+            stats = self.engine.field_stats()
+        states = [new_merge_state(n) for n in self.nodes]
+        total = 0
+        for handle in self.handles:
+            compiler = self.engine.compiler_for(handle, stats)
+            compiled = compiler.compile(query)
+            specs, arrays = self.compile_for(handle, compiler)
+            seg_tree = aggs_device.agg_segment_tree(handle.device)
+            tot, results = aggs_device.execute_aggs(
+                seg_tree, compiled.spec, compiled.arrays, specs, arrays
+            )
+            total += int(tot)
+            results = jax.device_get(results)
+            for node, state, result in zip(self.nodes, states, results):
+                merge_segment_result(node, state, result, handle)
+        rendered = {
+            node.name: render(node, state, self.engine, self._plan)
+            for node, state in zip(self.nodes, states)
+        }
+        return total, rendered
+
+
+def _filters_defs(node: AggNode) -> tuple[list[str] | None, list[dict]]:
+    """(keys, query bodies) of a filters agg; keys None for the list form."""
+    raw = node.params.get("filters")
+    if isinstance(raw, dict):
+        keys = sorted(raw)
+        return keys, [raw[key] for key in keys]
+    if isinstance(raw, list):
+        return None, raw
+    raise AggParsingError(
+        f"filters aggregation [{node.name}] requires [filters]"
+    )
 
 
 def _parse_query(params: dict) -> Any:
@@ -467,6 +567,16 @@ def _merge_bucket_planes(tgt: dict, planes, keys):
         cur["max"] = max(cur["max"], float(maxs[i]))
 
 
+def _host_values(result, handle, fname: str) -> np.ndarray:
+    """Matched docs' non-NaN values from the host float64 column."""
+    col = handle.segment.doc_values.get(fname)
+    if col is None:
+        return np.zeros(0, dtype=np.float64)
+    mask = np.asarray(result["mask"])[: len(col)]
+    vals = col[mask]
+    return vals[~np.isnan(vals)]
+
+
 def merge_segment_result(node: AggNode, state, result, handle) -> None:
     """Fold one segment's device result into the cross-segment state."""
     k = node.kind
@@ -481,10 +591,27 @@ def merge_segment_result(node: AggNode, state, result, handle) -> None:
             vocab = list(dfield.terms.keys())
             nz = np.flatnonzero(counts[: len(vocab)])
             state["values"].update(vocab[i] for i in nz)
+        else:  # numeric host fallback: exact distinct from the f64 column
+            for v in _host_values(result, handle, fname):
+                state["values"].add(float(v))
         return
     if k == "terms":
         fname = node.params["field"]
-        dfield = handle.device.fields[fname]
+        dfield = handle.device.fields.get(fname)
+        if dfield is None or dfield.ord_terms is None:
+            # numeric terms: exact host counts off the matched mask. A
+            # keyword field absent from this segment also lands here but
+            # contributes no values (and must not flip the numeric-key
+            # rendering flag).
+            vals, counts = np.unique(
+                _host_values(result, handle, fname), return_counts=True
+            )
+            if len(vals):
+                state["host"] = True
+            for v, c in zip(vals, counts):
+                key = float(v)
+                state["counts"][key] = state["counts"].get(key, 0) + int(c)
+            return
         vocab = list(dfield.terms.keys())
         counts = np.asarray(result["counts"])
         nz = np.flatnonzero(counts[: len(vocab)])
@@ -651,7 +778,12 @@ def render(node: AggNode, state, engine, plan: dict) -> dict[str, Any]:
         top = items[:size]
         buckets = []
         for key, count in top:
-            b = {"key": key, "doc_count": count}
+            out_key = (
+                _key_for_field(engine, node.params["field"], key)
+                if state.get("host")
+                else key
+            )
+            b = {"key": out_key, "doc_count": count}
             if node.subs:
                 b.update(_sub_bucket_rendering(node, key, state["subs"]))
             buckets.append(b)
@@ -693,9 +825,15 @@ def render(node: AggNode, state, engine, plan: dict) -> dict[str, Any]:
             out[sub_node.name] = render(sub_node, sub_state, engine, plan)
         return out
     if k == "filters":
-        keys = plan.get("filters_keys", {}).get(node.name)
+        keys, queries = _filters_defs(node)
+        bucket_states = state["buckets"]
+        if bucket_states is None:  # no non-empty segments: zero buckets
+            bucket_states = [
+                {"doc_count": 0, "subs": [new_merge_state(s) for s in node.subs]}
+                for _ in queries
+            ]
         rendered = []
-        for bstate in state["buckets"] or []:
+        for bstate in bucket_states:
             out = {"doc_count": bstate["doc_count"]}
             for sub_node, sub_state in zip(node.subs, bstate["subs"]):
                 out[sub_node.name] = render(sub_node, sub_state, engine, plan)
@@ -714,7 +852,7 @@ def _render_histogram(node: AggNode, state, engine, plan) -> dict[str, Any]:
     fname = node.params["field"]
     min_doc_count = int(node.params.get("min_doc_count", 0))
     is_date = node.kind == "date_histogram"
-    edges = plan.get("hist_edges", {}).get(node.name)
+    edges = plan.get("hist_edges", {}).get(id(node))
     buckets = []
     if edges is not None:  # calendar buckets executed as ranges
         counts = state["counts"]
@@ -722,7 +860,10 @@ def _render_histogram(node: AggNode, state, engine, plan) -> dict[str, Any]:
             count = int(counts[i]) if counts is not None else 0
             buckets.append((edges[i], count, i))
     else:
-        interval, offset, base = plan["hist_params"][node.name]
+        params = plan.get("hist_params", {}).get(id(node))
+        if params is None:  # no non-empty segments: nothing was planned
+            return {"buckets": []}
+        interval, offset, base = params
         counts = state["counts"]
         if counts is None:
             counts = np.zeros(0, dtype=np.int64)

@@ -63,9 +63,10 @@ class SearchResponse:
     total_relation: str
     max_score: float | None
     hits: list[SearchHit]
+    aggregations: dict[str, Any] | None = None
 
     def to_json(self, index_name: str = "index") -> dict[str, Any]:
-        return {
+        out = {
             "took": self.took_ms,
             "timed_out": False,
             "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
@@ -75,6 +76,9 @@ class SearchResponse:
                 "hits": [h.to_json(index_name) for h in self.hits],
             },
         }
+        if self.aggregations is not None:
+            out["aggregations"] = self.aggregations
+        return out
 
 
 @dataclass
@@ -120,6 +124,7 @@ class SearchRequest:
     source_includes: bool | list[str] = True
     sort: list[dict[str, str]] | None = None  # [{"field": "asc"|"desc"}]
     rescore: list[Rescore] = field(default_factory=list)
+    aggs: list[Any] | None = None  # list[aggs.AggNode]
 
     @classmethod
     def from_json(cls, body: dict[str, Any] | None) -> "SearchRequest":
@@ -127,6 +132,12 @@ class SearchRequest:
         query = (
             parse_query(body["query"]) if "query" in body else MatchAllQuery()
         )
+        aggs = None
+        raw_aggs = body.get("aggs") or body.get("aggregations")
+        if raw_aggs:
+            from .aggs import parse_aggs
+
+            aggs = parse_aggs(raw_aggs)
         rescore = []
         raw_rescore = body.get("rescore", [])
         if isinstance(raw_rescore, dict):
@@ -171,6 +182,7 @@ class SearchRequest:
             source_includes=source,
             sort=sort,
             rescore=rescore,
+            aggs=aggs,
         )
 
 
@@ -188,16 +200,38 @@ class SearchService:
         start = time.monotonic()
         k = max(0, request.from_) + max(0, request.size)
         stats = self.engine.field_stats()
+        self._validate_sort(request)
+
+        # One segment snapshot shared by the agg pass and the hits pass —
+        # a concurrent refresh must not desynchronize totals from hits
+        # (the reference pins one IndexReader per request the same way).
+        segments = list(self.engine.segments)
+
+        aggregations = None
+        agg_total = None
+        if request.aggs is not None:
+            from .aggs import Aggregator
+
+            agg_total, aggregations = Aggregator(
+                self.engine, request.aggs, handles=segments
+            ).run(request.query, stats=stats)
 
         # Candidate tuples: (merge_key, global_doc, handle, local, score,
         # sort_value). merge_key ascending + global doc id ascending gives
         # Lucene's ordering for both score sort (key = -score) and field sort.
         candidates: list[tuple] = []
         total = 0
-        for handle in self.engine.segments:
-            if handle.segment.num_docs == 0:
-                continue
-            total += self._query_segment(handle, request, k, stats, candidates)
+        if k > 0 or agg_total is None:
+            for handle in segments:
+                if handle.segment.num_docs == 0:
+                    continue
+                total += self._query_segment(
+                    handle, request, k, stats, candidates
+                )
+        if agg_total is not None:
+            # The agg program already counted matched ∧ live docs; trust one
+            # source for totals (they are the same mask by construction).
+            total = agg_total
 
         candidates.sort(key=lambda c: (c[0], c[1]))
         page = candidates[request.from_ : request.from_ + request.size]
@@ -223,7 +257,28 @@ class SearchService:
             total_relation="eq",
             max_score=max_score,
             hits=hits,
+            aggregations=aggregations,
         )
+
+    def _validate_sort(self, request: SearchRequest) -> None:
+        """Validate the sort spec against the mappings up front, so request
+        validity doesn't depend on whether the hits pass runs (an agg-only
+        size=0 request must still 400 on a bad sort)."""
+        if request.sort is None:
+            return
+        if len(request.sort) > 1:
+            raise ValueError(
+                "multi-key sort is not supported yet; got "
+                f"{len(request.sort)} sort keys"
+            )
+        ((sort_field, _),) = request.sort[0].items()
+        if sort_field == "_score":
+            return
+        fm = self.engine.mappings.get(sort_field)
+        if fm is None or not fm.is_numeric:
+            raise ValueError(
+                f"No mapping found for [{sort_field}] in order to sort on"
+            )
 
     # ------------------------------------------------------------------ query
 

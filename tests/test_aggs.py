@@ -1,0 +1,644 @@
+"""Aggregations: device execution vs numpy oracle.
+
+Coverage model mirrors the reference's aggregation test strategy
+(server/src/test/.../search/aggregations/metrics + bucket): randomized
+corpora, every agg type checked against an independently computed expected
+result, including under deletes, multiple segments, and query filtering.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.search.service import SearchRequest, SearchService
+
+MAPPINGS = {
+    "properties": {
+        "title": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "price": {"type": "double"},
+        "qty": {"type": "long"},
+        "ts": {"type": "date"},
+    }
+}
+
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]
+TAGS = ["red", "green", "blue", "yellow"]
+
+
+def build_engine(rng, n=240, segments=3, with_deletes=True):
+    engine = Engine(Mappings.from_json(MAPPINGS))
+    docs = []
+    per_seg = n // segments
+    for i in range(n):
+        doc = {
+            "title": " ".join(rng.choice(WORDS, size=rng.integers(1, 6))),
+            "tag": str(rng.choice(TAGS)),
+            "price": round(float(rng.uniform(0, 100)), 2),
+            "qty": int(rng.integers(0, 50)),
+            "ts": int(rng.integers(1_600_000_000_000, 1_700_000_000_000)),
+        }
+        # some docs miss some fields
+        if rng.random() < 0.15:
+            del doc["price"]
+        if rng.random() < 0.1:
+            del doc["tag"]
+        docs.append(doc)
+        engine.index(doc, f"d{i}")
+        if (i + 1) % per_seg == 0:
+            engine.refresh()
+    engine.refresh()
+    deleted = set()
+    if with_deletes:
+        for i in rng.choice(n, size=n // 10, replace=False):
+            engine.delete(f"d{int(i)}")
+            deleted.add(int(i))
+        engine.refresh()
+    live_docs = [d for i, d in enumerate(docs) if i not in deleted]
+    return engine, live_docs
+
+
+def run_aggs(engine, body):
+    svc = SearchService(engine)
+    resp = svc.search(SearchRequest.from_json(body))
+    return resp
+
+
+def matches(doc, word):
+    return word in doc.get("title", "").split()
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(7)
+    return build_engine(rng)
+
+
+def test_metric_aggs_match_all(corpus):
+    engine, docs = corpus
+    resp = run_aggs(
+        engine,
+        {
+            "size": 0,
+            "aggs": {
+                "p_min": {"min": {"field": "price"}},
+                "p_max": {"max": {"field": "price"}},
+                "p_sum": {"sum": {"field": "price"}},
+                "p_avg": {"avg": {"field": "price"}},
+                "p_cnt": {"value_count": {"field": "price"}},
+                "p_stats": {"stats": {"field": "price"}},
+            },
+        },
+    )
+    prices = [d["price"] for d in docs if "price" in d]
+    a = resp.aggregations
+    assert resp.total == len(docs)
+    assert a["p_cnt"]["value"] == len(prices)
+    assert a["p_min"]["value"] == pytest.approx(min(prices), rel=1e-6)
+    assert a["p_max"]["value"] == pytest.approx(max(prices), rel=1e-6)
+    assert a["p_sum"]["value"] == pytest.approx(sum(prices), rel=1e-4)
+    assert a["p_avg"]["value"] == pytest.approx(
+        sum(prices) / len(prices), rel=1e-4
+    )
+    st = a["p_stats"]
+    assert st["count"] == len(prices)
+    assert st["avg"] == pytest.approx(sum(prices) / len(prices), rel=1e-4)
+
+
+def test_metric_aggs_filtered_by_query(corpus):
+    engine, docs = corpus
+    resp = run_aggs(
+        engine,
+        {
+            "size": 0,
+            "query": {"match": {"title": "alpha"}},
+            "aggs": {"q_sum": {"sum": {"field": "qty"}}},
+        },
+    )
+    expected_docs = [d for d in docs if matches(d, "alpha")]
+    assert resp.total == len(expected_docs)
+    assert resp.aggregations["q_sum"]["value"] == pytest.approx(
+        sum(d["qty"] for d in expected_docs), rel=1e-6
+    )
+
+
+def test_terms_keyword(corpus):
+    engine, docs = corpus
+    resp = run_aggs(
+        engine,
+        {"size": 0, "aggs": {"tags": {"terms": {"field": "tag"}}}},
+    )
+    expected = {}
+    for d in docs:
+        if "tag" in d:
+            expected[d["tag"]] = expected.get(d["tag"], 0) + 1
+    buckets = resp.aggregations["tags"]["buckets"]
+    got = {b["key"]: b["doc_count"] for b in buckets}
+    assert got == expected
+    # count-desc, key-asc tiebreak ordering
+    counts = [b["doc_count"] for b in buckets]
+    assert counts == sorted(counts, reverse=True)
+    assert resp.aggregations["tags"]["sum_other_doc_count"] == 0
+
+
+def test_terms_keyword_with_sub_metrics(corpus):
+    engine, docs = corpus
+    resp = run_aggs(
+        engine,
+        {
+            "size": 0,
+            "aggs": {
+                "tags": {
+                    "terms": {"field": "tag"},
+                    "aggs": {
+                        "avg_p": {"avg": {"field": "price"}},
+                        "max_q": {"max": {"field": "qty"}},
+                    },
+                }
+            },
+        },
+    )
+    for b in resp.aggregations["tags"]["buckets"]:
+        sel = [d for d in docs if d.get("tag") == b["key"]]
+        prices = [d["price"] for d in sel if "price" in d]
+        assert b["doc_count"] == len(sel)
+        if prices:
+            assert b["avg_p"]["value"] == pytest.approx(
+                sum(prices) / len(prices), rel=1e-4
+            )
+        assert b["max_q"]["value"] == pytest.approx(
+            max(d["qty"] for d in sel), rel=1e-6
+        )
+
+
+def test_terms_size_and_other_count(corpus):
+    engine, docs = corpus
+    resp = run_aggs(
+        engine,
+        {"size": 0, "aggs": {"tags": {"terms": {"field": "tag", "size": 2}}}},
+    )
+    expected = {}
+    for d in docs:
+        if "tag" in d:
+            expected[d["tag"]] = expected.get(d["tag"], 0) + 1
+    ranked = sorted(expected.items(), key=lambda kv: (-kv[1], kv[0]))
+    buckets = resp.aggregations["tags"]["buckets"]
+    assert [(b["key"], b["doc_count"]) for b in buckets] == ranked[:2]
+    assert resp.aggregations["tags"]["sum_other_doc_count"] == sum(
+        c for _, c in ranked[2:]
+    )
+
+
+def test_terms_numeric_host_fallback(corpus):
+    engine, docs = corpus
+    resp = run_aggs(
+        engine,
+        {"size": 0, "aggs": {"qtys": {"terms": {"field": "qty", "size": 100}}}},
+    )
+    expected = {}
+    for d in docs:
+        expected[d["qty"]] = expected.get(d["qty"], 0) + 1
+    got = {b["key"]: b["doc_count"] for b in resp.aggregations["qtys"]["buckets"]}
+    assert got == expected
+    assert all(isinstance(b["key"], int) for b in resp.aggregations["qtys"]["buckets"])
+
+
+def test_cardinality_keyword_and_numeric(corpus):
+    engine, docs = corpus
+    resp = run_aggs(
+        engine,
+        {
+            "size": 0,
+            "aggs": {
+                "t_card": {"cardinality": {"field": "tag"}},
+                "q_card": {"cardinality": {"field": "qty"}},
+            },
+        },
+    )
+    assert resp.aggregations["t_card"]["value"] == len(
+        {d["tag"] for d in docs if "tag" in d}
+    )
+    assert resp.aggregations["q_card"]["value"] == len(
+        {d["qty"] for d in docs}
+    )
+
+
+def test_histogram(corpus):
+    engine, docs = corpus
+    resp = run_aggs(
+        engine,
+        {
+            "size": 0,
+            "aggs": {
+                "h": {
+                    "histogram": {"field": "price", "interval": 10},
+                    "aggs": {"s": {"sum": {"field": "qty"}}},
+                }
+            },
+        },
+    )
+    expected = {}
+    for d in docs:
+        if "price" in d:
+            key = math.floor(d["price"] / 10) * 10
+            cur = expected.setdefault(key, [0, 0])
+            cur[0] += 1
+            cur[1] += d["qty"]
+    buckets = resp.aggregations["h"]["buckets"]
+    got = {b["key"]: (b["doc_count"], b["s"]["value"]) for b in buckets}
+    for key, (cnt, qsum) in expected.items():
+        assert got[key][0] == cnt
+        assert got[key][1] == pytest.approx(qsum, rel=1e-5)
+    # interior empty buckets kept (min_doc_count default 0)
+    keys = sorted(got)
+    assert keys == [keys[0] + 10 * i for i in range(len(keys))]
+
+
+def test_date_histogram_fixed_interval(corpus):
+    engine, docs = corpus
+    day = 86_400_000
+    resp = run_aggs(
+        engine,
+        {
+            "size": 0,
+            "aggs": {
+                "d": {
+                    "date_histogram": {
+                        "field": "ts",
+                        "fixed_interval": "30d",
+                        "min_doc_count": 1,
+                    }
+                }
+            },
+        },
+    )
+    expected = {}
+    for d in docs:
+        key = math.floor(d["ts"] / (30 * day)) * 30 * day
+        expected[key] = expected.get(key, 0) + 1
+    got = {b["key"]: b["doc_count"] for b in resp.aggregations["d"]["buckets"]}
+    assert got == expected
+    for b in resp.aggregations["d"]["buckets"]:
+        assert b["key_as_string"].endswith("Z")
+
+
+def test_range_agg(corpus):
+    engine, docs = corpus
+    resp = run_aggs(
+        engine,
+        {
+            "size": 0,
+            "aggs": {
+                "r": {
+                    "range": {
+                        "field": "price",
+                        "ranges": [
+                            {"to": 25},
+                            {"from": 25, "to": 75},
+                            {"from": 75},
+                        ],
+                    },
+                    "aggs": {"aq": {"avg": {"field": "qty"}}},
+                }
+            },
+        },
+    )
+    buckets = resp.aggregations["r"]["buckets"]
+    prices = [(d.get("price"), d["qty"]) for d in docs if "price" in d]
+    exp = [
+        [pq for pq in prices if pq[0] < 25],
+        [pq for pq in prices if 25 <= pq[0] < 75],
+        [pq for pq in prices if pq[0] >= 75],
+    ]
+    for b, sel in zip(buckets, exp):
+        assert b["doc_count"] == len(sel)
+        if sel:
+            assert b["aq"]["value"] == pytest.approx(
+                sum(q for _, q in sel) / len(sel), rel=1e-4
+            )
+
+
+def test_filter_and_global_and_missing(corpus):
+    engine, docs = corpus
+    resp = run_aggs(
+        engine,
+        {
+            "size": 0,
+            "query": {"match": {"title": "beta"}},
+            "aggs": {
+                "cheap": {
+                    "filter": {"range": {"price": {"lt": 50}}},
+                    "aggs": {"n": {"value_count": {"field": "price"}}},
+                },
+                "everything": {
+                    "global": {},
+                    "aggs": {"all_sum": {"sum": {"field": "qty"}}},
+                },
+                "no_tag": {"missing": {"field": "tag"}},
+            },
+        },
+    )
+    matched = [d for d in docs if matches(d, "beta")]
+    cheap = [d for d in matched if d.get("price", 1e9) < 50]
+    a = resp.aggregations
+    assert a["cheap"]["doc_count"] == len(cheap)
+    assert a["cheap"]["n"]["value"] == len(cheap)
+    # global ignores the query
+    assert a["everything"]["doc_count"] == len(docs)
+    assert a["everything"]["all_sum"]["value"] == pytest.approx(
+        sum(d["qty"] for d in docs), rel=1e-5
+    )
+    assert a["no_tag"]["doc_count"] == len(
+        [d for d in matched if "tag" not in d]
+    )
+
+
+def test_filters_agg_keyed(corpus):
+    engine, docs = corpus
+    resp = run_aggs(
+        engine,
+        {
+            "size": 0,
+            "aggs": {
+                "f": {
+                    "filters": {
+                        "filters": {
+                            "has_alpha": {"match": {"title": "alpha"}},
+                            "cheap": {"range": {"price": {"lt": 30}}},
+                        }
+                    }
+                }
+            },
+        },
+    )
+    b = resp.aggregations["f"]["buckets"]
+    assert b["has_alpha"]["doc_count"] == len(
+        [d for d in docs if matches(d, "alpha")]
+    )
+    assert b["cheap"]["doc_count"] == len(
+        [d for d in docs if d.get("price", 1e9) < 30]
+    )
+
+
+def test_aggs_with_hits(corpus):
+    engine, docs = corpus
+    resp = run_aggs(
+        engine,
+        {
+            "size": 5,
+            "query": {"match": {"title": "gamma"}},
+            "aggs": {"s": {"sum": {"field": "qty"}}},
+        },
+    )
+    matched = [d for d in docs if matches(d, "gamma")]
+    assert resp.total == len(matched)
+    assert len(resp.hits) == min(5, len(matched))
+    assert resp.aggregations["s"]["value"] == pytest.approx(
+        sum(d["qty"] for d in matched), rel=1e-5
+    )
+
+
+def test_aggs_empty_index():
+    engine = Engine(Mappings.from_json(MAPPINGS))
+    resp = run_aggs(
+        engine,
+        {
+            "size": 0,
+            "aggs": {
+                "m": {"max": {"field": "price"}},
+                "t": {"terms": {"field": "tag"}},
+                "h": {"histogram": {"field": "price", "interval": 5}},
+                "r": {"range": {"field": "price", "ranges": [{"to": 10}]}},
+                "c": {"cardinality": {"field": "tag"}},
+            },
+        },
+    )
+    a = resp.aggregations
+    assert resp.total == 0
+    assert a["m"]["value"] is None
+    assert a["t"]["buckets"] == []
+    assert a["h"]["buckets"] == []
+    assert a["r"]["buckets"][0]["doc_count"] == 0
+    assert a["c"]["value"] == 0
+
+
+def test_duplicate_agg_name_across_nesting_levels(corpus):
+    """A filter-nested histogram sharing its name with a top-level one must
+    not clobber the top-level plan (plan state is per-node, not per-name)."""
+    engine, docs = corpus
+    resp = run_aggs(
+        engine,
+        {
+            "size": 0,
+            "aggs": {
+                "h": {"histogram": {"field": "price", "interval": 10}},
+                "f": {
+                    "filter": {"range": {"price": {"lt": 50}}},
+                    "aggs": {
+                        "h": {"histogram": {"field": "price", "interval": 5}}
+                    },
+                },
+            },
+        },
+    )
+    outer = {
+        b["key"]: b["doc_count"] for b in resp.aggregations["h"]["buckets"]
+    }
+    inner = {
+        b["key"]: b["doc_count"]
+        for b in resp.aggregations["f"]["h"]["buckets"]
+    }
+    exp_outer, exp_inner = {}, {}
+    for d in docs:
+        if "price" not in d:
+            continue
+        k10 = math.floor(d["price"] / 10) * 10
+        exp_outer[k10] = exp_outer.get(k10, 0) + 1
+        if d["price"] < 50:
+            k5 = math.floor(d["price"] / 5) * 5
+            exp_inner[k5] = exp_inner.get(k5, 0) + 1
+    assert {k: v for k, v in outer.items() if v} == exp_outer
+    assert {k: v for k, v in inner.items() if v} == exp_inner
+
+
+def test_filters_empty_index_keeps_bucket_shape():
+    engine = Engine(Mappings.from_json(MAPPINGS))
+    resp = run_aggs(
+        engine,
+        {
+            "size": 0,
+            "aggs": {
+                "f": {
+                    "filters": {
+                        "filters": {
+                            "a": {"match": {"title": "alpha"}},
+                            "b": {"match": {"title": "beta"}},
+                        }
+                    }
+                },
+                "fl": {
+                    "filters": {
+                        "filters": [{"match": {"title": "alpha"}}]
+                    }
+                },
+            },
+        },
+    )
+    assert resp.aggregations["f"]["buckets"] == {
+        "a": {"doc_count": 0},
+        "b": {"doc_count": 0},
+    }
+    assert resp.aggregations["fl"]["buckets"] == [{"doc_count": 0}]
+
+
+def test_field_absent_from_one_segment():
+    """Every agg type must work when a mapped field has no values in one
+    refreshed segment (reference: ValuesSource skips docs missing the
+    field; unmapped-in-segment never errors)."""
+    engine = Engine(Mappings.from_json(MAPPINGS))
+    for i in range(8):  # segment 1: no price/tag/ts at all
+        engine.index({"title": "alpha words here", "qty": i}, f"a{i}")
+    engine.refresh()
+    for i in range(8):  # segment 2: full docs
+        engine.index(
+            {
+                "title": "alpha more words",
+                "tag": "red" if i % 2 else "blue",
+                "price": 10.0 * i,
+                "qty": 100 + i,
+                "ts": 1_650_000_000_000 + i * 86_400_000,
+            },
+            f"b{i}",
+        )
+    engine.refresh()
+    resp = run_aggs(
+        engine,
+        {
+            "size": 0,
+            "aggs": {
+                "avg_p": {"avg": {"field": "price"}},
+                "tags": {"terms": {"field": "tag"}},
+                "qtys": {"terms": {"field": "qty", "size": 50}},
+                "card_t": {"cardinality": {"field": "tag"}},
+                "card_p": {"cardinality": {"field": "price"}},
+                "hist": {"histogram": {"field": "price", "interval": 25}},
+                "rng": {
+                    "range": {"field": "price", "ranges": [{"to": 35}, {"from": 35}]}
+                },
+                "no_tag": {"missing": {"field": "tag"}},
+                "no_such": {"missing": {"field": "unmapped_field"}},
+                "m_unmapped": {"max": {"field": "unmapped_field"}},
+            },
+        },
+    )
+    a = resp.aggregations
+    prices = [10.0 * i for i in range(8)]
+    assert a["avg_p"]["value"] == pytest.approx(sum(prices) / 8, rel=1e-6)
+    assert {b["key"]: b["doc_count"] for b in a["tags"]["buckets"]} == {
+        "red": 4,
+        "blue": 4,
+    }
+    got_q = {b["key"]: b["doc_count"] for b in a["qtys"]["buckets"]}
+    assert got_q == {**{i: 1 for i in range(8)}, **{100 + i: 1 for i in range(8)}}
+    assert a["card_t"]["value"] == 2
+    assert a["card_p"]["value"] == 8
+    assert sum(b["doc_count"] for b in a["hist"]["buckets"]) == 8
+    assert a["rng"]["buckets"][0]["doc_count"] == 4  # 0,10,20,30
+    assert a["rng"]["buckets"][1]["doc_count"] == 4
+    assert a["no_tag"]["doc_count"] == 8
+    assert a["no_such"]["doc_count"] == 16
+    assert a["m_unmapped"]["value"] is None
+
+
+def test_agg_parse_errors(corpus):
+    engine, _ = corpus
+    svc = SearchService(engine)
+    with pytest.raises(ValueError):
+        svc.search(
+            SearchRequest.from_json(
+                {"aggs": {"bad": {"nope_type": {"field": "price"}}}}
+            )
+        )
+    with pytest.raises(ValueError):
+        svc.search(
+            SearchRequest.from_json(
+                {"aggs": {"t": {"terms": {"field": "title"}}}}
+            )
+        )  # text field has no keyword ordinals
+    with pytest.raises(ValueError):
+        svc.search(
+            SearchRequest.from_json(
+                {"aggs": {"h": {"histogram": {"field": "price"}}}}
+            )
+        )  # missing interval
+
+
+def test_keyword_field_rejected_in_numeric_agg_positions(corpus):
+    engine, _ = corpus
+    svc = SearchService(engine)
+    for body in [
+        {"aggs": {"s": {"sum": {"field": "tag"}}}},
+        {"aggs": {"h": {"histogram": {"field": "tag", "interval": 1}}}},
+        {"aggs": {"r": {"range": {"field": "tag", "ranges": [{"to": 1}]}}}},
+        {
+            "aggs": {
+                "t": {
+                    "terms": {"field": "tag"},
+                    "aggs": {"s": {"sum": {"field": "title"}}},
+                }
+            }
+        },
+    ]:
+        with pytest.raises(ValueError):
+            svc.search(SearchRequest.from_json(body))
+
+
+def test_bad_sort_rejected_even_when_agg_only(corpus):
+    engine, _ = corpus
+    svc = SearchService(engine)
+    with pytest.raises(ValueError):
+        svc.search(
+            SearchRequest.from_json(
+                {
+                    "size": 0,
+                    "sort": [{"no_such_field": "asc"}],
+                    "aggs": {"s": {"sum": {"field": "qty"}}},
+                }
+            )
+        )
+
+
+def test_rest_aggregations_route(corpus, tmp_path):
+    from elasticsearch_tpu.rest.server import RestServer
+
+    rest = RestServer()
+    rest.node.create_index("idx", {"mappings": MAPPINGS})
+    engine, docs = corpus
+    # reuse corpus docs through the REST bulk path
+    lines = []
+    for i, d in enumerate(docs[:50]):
+        lines.append('{"index": {"_id": "r%d"}}' % i)
+        import json as _json
+
+        lines.append(_json.dumps(d))
+    status, _ = rest.dispatch("POST", "/idx/_bulk", {"refresh": "true"}, "\n".join(lines))
+    assert status == 200
+    status, resp = rest.dispatch(
+        "POST",
+        "/idx/_search",
+        {},
+        '{"size": 0, "aggs": {"tags": {"terms": {"field": "tag"}}}}',
+    )
+    assert status == 200
+    expected = {}
+    for d in docs[:50]:
+        if "tag" in d:
+            expected[d["tag"]] = expected.get(d["tag"], 0) + 1
+    got = {
+        b["key"]: b["doc_count"]
+        for b in resp["aggregations"]["tags"]["buckets"]
+    }
+    assert got == expected
