@@ -49,6 +49,29 @@ class Analyzer:
             tokens = f(tokens)
         return tokens
 
+    def analyze_positions(self, text: str) -> tuple[list[tuple[Token, int]], int]:
+        """((token, position) pairs, total position span).
+
+        Positions carry through filters with Lucene position-increment
+        semantics: a removed token (stop filter) leaves a GAP rather than
+        shifting later tokens down — `match_phrase` relies on these gaps
+        exactly like Lucene's StopFilter keeps increments. The span is the
+        tokenizer's position count (for multi-value position offsets).
+        """
+        tokens = self.tokenizer(text)
+        span = len(tokens)
+        pairs = [(t, i) for i, t in enumerate(tokens)]
+        for f in self.filters:
+            # Filters are per-token maps or drops; apply them elementwise so
+            # surviving tokens keep their original positions.
+            new_pairs = []
+            for t, p in pairs:
+                out = f([t])
+                if out:
+                    new_pairs.append((out[0], p))
+            pairs = new_pairs
+        return pairs, span
+
     def __call__(self, text: str) -> list[Token]:
         return self.analyze(text)
 

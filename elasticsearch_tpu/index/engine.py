@@ -74,6 +74,13 @@ class SegmentHandle:
     live_host: np.ndarray  # bool[N] host copy of the live mask
     live_dirty: bool = False
     seg_id: int | None = None  # on-disk id once persisted by flush()
+    _id_index: dict[str, int] | None = None  # lazy _id -> local (ids query)
+
+    @property
+    def id_index(self) -> dict[str, int]:
+        if self._id_index is None:
+            self._id_index = {d: i for i, d in enumerate(self.segment.ids)}
+        return self._id_index
 
     def soft_delete(self, local_doc: int) -> None:
         if self.live_host[local_doc]:
@@ -591,4 +598,5 @@ class Engine:
             mappings=self.mappings,
             params=self.params,
             stats=stats if stats is not None else self.field_stats(),
+            id_index=lambda: handle.id_index,  # built only if an ids query compiles
         )

@@ -48,6 +48,32 @@ class FieldIndex:
     # zero tokens (all stopwords / empty string). Backs `exists` semantics —
     # Lucene's NormsFieldExistsQuery matches any doc with the field indexed.
     present: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=bool))
+    # Token positions (proximity data, the analog of Lucene's .pos files —
+    # index_options=positions, the text-field default in the reference's
+    # TextFieldMapper). CSR aligned with the postings arrays: posting p's
+    # occurrence positions are positions[pos_offsets[p]:pos_offsets[p+1]],
+    # ascending. None for fields indexed without positions (keyword).
+    pos_offsets: np.ndarray | None = None  # int64[P+1]
+    positions: np.ndarray | None = None  # int32[sum tf]
+
+    @property
+    def has_positions(self) -> bool:
+        return self.positions is not None
+
+    def term_positions(self, term: str, local_doc: int) -> np.ndarray:
+        """Positions of `term` in `local_doc`; empty if absent/no positions."""
+        if self.positions is None:
+            return np.empty(0, dtype=np.int32)
+        tid = self.terms.get(term)
+        if tid is None:
+            return np.empty(0, dtype=np.int32)
+        lo, hi = int(self.offsets[tid]), int(self.offsets[tid + 1])
+        docs = self.doc_ids[lo:hi]
+        hit = np.searchsorted(docs, local_doc)
+        if hit >= len(docs) or docs[hit] != local_doc:
+            return np.empty(0, dtype=np.int32)
+        p = lo + int(hit)
+        return self.positions[self.pos_offsets[p] : self.pos_offsets[p + 1]]
 
     @property
     def num_terms(self) -> int:
@@ -107,6 +133,12 @@ def _iter_field_values(value: Any) -> list[Any]:
     return [value]
 
 
+# Positions of consecutive values of a multi-valued text field are separated
+# by this gap so phrases can't match across values (the reference's
+# TextFieldMapper position_increment_gap default, POSITION_INCREMENT_GAP_USE_ANALYZER).
+POSITION_INCREMENT_GAP = 100
+
+
 class SegmentBuilder:
     """Accumulates documents and freezes them into a Segment.
 
@@ -122,6 +154,8 @@ class SegmentBuilder:
         self._seqnos: list[int] = []
         # field -> {term -> list[(doc, tf)]} accumulated as dict doc->tf
         self._inverted: dict[str, dict[str, dict[int, int]]] = {}
+        # field -> term -> doc -> ascending token positions (text fields)
+        self._positions: dict[str, dict[str, dict[int, list[int]]]] = {}
         self._lengths: dict[str, dict[int, int]] = {}  # field -> doc -> len
         self._present: dict[str, set[int]] = {}  # field -> docs with a value
         self._numeric: dict[str, dict[int, float]] = {}
@@ -171,14 +205,23 @@ class SegmentBuilder:
                 staged_vectors.append((field_name, vec))
             elif fm.is_inverted:
                 analyzer = self.mappings.analyzer_for(field_name)
+                # Keyword fields index without positions (index_options=docs,
+                # the reference's KeywordFieldMapper default); text fields
+                # record per-occurrence positions for phrase queries.
+                with_positions = fm.norms
                 total_len = 0
                 tf: dict[str, int] = {}
+                poss: dict[str, list[int]] = {}
+                base = 0
                 for v in _iter_field_values(value):
-                    tokens = analyzer.analyze(str(v))
-                    total_len += len(tokens)
-                    for tok in tokens:
+                    pairs, span = analyzer.analyze_positions(str(v))
+                    total_len += len(pairs)
+                    for tok, pos in pairs:
                         tf[tok] = tf.get(tok, 0) + 1
-                staged_postings.append((field_name, tf, total_len))
+                        if with_positions:
+                            poss.setdefault(tok, []).append(base + pos)
+                    base += span + POSITION_INCREMENT_GAP
+                staged_postings.append((field_name, tf, total_len, poss))
             elif fm.is_numeric:
                 vals = _iter_field_values(value)
                 v0 = vals[0]  # multi-valued numerics keep first value for now
@@ -192,11 +235,15 @@ class SegmentBuilder:
         self._seqnos.append(int(seqno))
         for field_name, vec in staged_vectors:
             self._vectors.setdefault(field_name, {})[local] = vec
-        for field_name, tf, total_len in staged_postings:
+        for field_name, tf, total_len, poss in staged_postings:
             self._present.setdefault(field_name, set()).add(local)
             postings = self._inverted.setdefault(field_name, {})
             for tok, count in tf.items():
                 postings.setdefault(tok, {})[local] = count
+            if poss:
+                fpos = self._positions.setdefault(field_name, {})
+                for tok, plist in poss.items():
+                    fpos.setdefault(tok, {})[local] = plist
             # Docs whose value analyzed to zero tokens (e.g. all stopwords)
             # produce no postings and must not count toward
             # docCount/sumTotalTermFreq — Lucene's Terms.getDocCount only
@@ -238,6 +285,35 @@ class SegmentBuilder:
             present_docs = self._present.get(fname)
             if present_docs:
                 present[np.fromiter(present_docs, dtype=np.int64)] = True
+            pos_offsets = positions_flat = None
+            fm_pre = self.mappings.get(fname)
+            wants_positions = fm_pre.norms if fm_pre is not None else True
+            # Text fields ALWAYS carry (possibly empty) position arrays —
+            # a segment whose values all analyzed to zero tokens must not
+            # flip the field to positionless (phrase compile would reject
+            # the whole request; the sharded stack needs uniform pytrees).
+            fpos = self._positions.get(fname) if wants_positions else None
+            if wants_positions and fpos is None:
+                fpos = {}
+            if fpos is not None:
+                # CSR positions aligned with the postings order just built:
+                # posting p = (term, doc) → its occurrence positions.
+                pos_counts = np.zeros(total, dtype=np.int64)
+                chunks: list[list[int]] = [[]] * total
+                for term, tid in terms.items():
+                    lo = int(offsets[tid])
+                    by_doc = fpos.get(term, {})
+                    for off, d in enumerate(sorted(by_doc)):
+                        plist = by_doc[d]
+                        pos_counts[lo + off] = len(plist)
+                        chunks[lo + off] = plist
+                pos_offsets = np.zeros(total + 1, dtype=np.int64)
+                pos_offsets[1:] = np.cumsum(pos_counts)
+                positions_flat = np.fromiter(
+                    (p for chunk in chunks for p in chunk),
+                    dtype=np.int32,
+                    count=int(pos_offsets[-1]),
+                )
             fields[fname] = FieldIndex(
                 present=present,
                 has_norms=fm.norms if fm is not None else True,
@@ -250,6 +326,8 @@ class SegmentBuilder:
                 norm_bytes=norm_bytes,
                 doc_count=len(lengths),
                 sum_total_tf=int(sum(lengths.values())),
+                pos_offsets=pos_offsets,
+                positions=positions_flat,
             )
         doc_values: dict[str, np.ndarray] = {}
         for fname, by_doc in self._numeric.items():

@@ -56,6 +56,9 @@ def persist_segment(path: str, seg_id: int, segment: Segment) -> None:
         arrays[f"{pre}_tfs"] = fld.tfs
         arrays[f"{pre}_norm_bytes"] = fld.norm_bytes
         arrays[f"{pre}_present"] = fld.present
+        if fld.positions is not None:
+            arrays[f"{pre}_pos_offsets"] = fld.pos_offsets
+            arrays[f"{pre}_positions"] = fld.positions
     for j, (name, col) in enumerate(sorted(segment.doc_values.items())):
         arrays[f"dv{j}"] = col
     for j, (name, mat) in enumerate(sorted(segment.vectors.items())):
@@ -112,6 +115,16 @@ def load_segment(path: str, seg_id: int) -> tuple[Segment, np.ndarray]:
             sum_total_tf=fm["sum_total_tf"],
             has_norms=fm["has_norms"],
             present=data[f"{pre}_present"],
+            pos_offsets=(
+                data[f"{pre}_pos_offsets"]
+                if f"{pre}_pos_offsets" in data
+                else None
+            ),
+            positions=(
+                data[f"{pre}_positions"]
+                if f"{pre}_positions" in data
+                else None
+            ),
         )
     doc_values = {
         name: data[f"dv{j}"]

@@ -98,6 +98,29 @@ class DeviceField:
     # (index/fielddata/; terms agg collects ordinals then resolves strings
     # at reduce time). Only packed for norms-disabled (keyword) fields.
     ord_terms: jax.Array | None = None  # int32[NT, TILE]
+    # Proximity planes (text fields; Lucene .pos analog): flat position
+    # entries in CSR term→doc→occurrence order, tiled like postings. A
+    # phrase term's entries are the contiguous slice
+    # [pos_offsets[offsets[tid]], pos_offsets[offsets[tid+1]]) — the host
+    # plans tile worklists over this space exactly like postings tiles.
+    pos_doc: jax.Array | None = None  # int32[PT, TILE] owning doc (sentinel N)
+    pos_val: jax.Array | None = None  # int32[PT, TILE] position (sentinel -1)
+    pos_offsets: np.ndarray | None = None  # int64[P+1] host copy (planning)
+
+    def term_pos_span(self, term: str) -> tuple[int, int]:
+        """[start, end) position-entry span for a term; (0, 0) if absent."""
+        tid = self.terms.get(term)
+        if tid is None or self.pos_offsets is None:
+            return (0, 0)
+        return (
+            int(self.pos_offsets[self.offsets[tid]]),
+            int(self.pos_offsets[self.offsets[tid + 1]]),
+        )
+
+    @property
+    def pos_pad_tile(self) -> int:
+        """Tile id of the all-sentinel padding tile of the position planes."""
+        return self.pos_doc.shape[0] - 1
 
     @property
     def num_terms(self) -> int:
@@ -186,6 +209,7 @@ def pack_field(
     avgdl: float | None = None,
     k1: float = 1.2,
     b: float = 0.75,
+    min_pos_tiles: int = 0,
 ) -> DeviceField:
     """Pack one FieldIndex into tiled device arrays.
 
@@ -212,6 +236,22 @@ def pack_field(
     norm_ext[: len(field.norm_bytes)] = field.norm_bytes
     tile_max = tn.reshape(-1, TILE).max(axis=1)
     put = lambda x: jax.device_put(x, device)
+    pos_doc = pos_val = None
+    pos_offsets_host = None
+    if field.positions is not None:
+        # Expand the owning doc per position entry (CSR expansion over
+        # per-posting counts), then tile both planes like postings.
+        counts = np.diff(field.pos_offsets).astype(np.int64)
+        owners = np.repeat(field.doc_ids.astype(np.int32), counts)
+        pd = _pad_to_tile(owners, np.int32(num_docs))
+        pv = _pad_to_tile(field.positions.astype(np.int32), np.int32(-1))
+        if min_pos_tiles and len(pd) < min_pos_tiles * TILE:
+            extra = min_pos_tiles * TILE - len(pd)
+            pd = np.concatenate([pd, np.full(extra, num_docs, dtype=np.int32)])
+            pv = np.concatenate([pv, np.full(extra, -1, dtype=np.int32)])
+        pos_doc = jax.device_put(pd.reshape(-1, TILE), device)
+        pos_val = jax.device_put(pv.reshape(-1, TILE), device)
+        pos_offsets_host = field.pos_offsets
     ord_terms = None
     if not field.has_norms and len(field.df):
         # keyword field: per-posting owning term id (CSR expansion),
@@ -243,6 +283,9 @@ def pack_field(
         tile_max=tile_max,
         device=device,
         ord_terms=ord_terms,
+        pos_doc=pos_doc,
+        pos_val=pos_val,
+        pos_offsets=pos_offsets_host,
     )
 
 
@@ -288,6 +331,7 @@ def pack_segment(
     field_avgdl: dict[str, float] | None = None,
     k1: float = 1.2,
     b: float = 0.75,
+    field_pos_min_tiles: dict[str, int] | None = None,
 ) -> DeviceSegment:
     """Upload a whole Segment to the device (the 'refresh' step).
 
@@ -300,9 +344,17 @@ def pack_segment(
     put = lambda x: jax.device_put(x, device)
     min_tiles = field_min_tiles or {}
     avgdls = field_avgdl or {}
+    pos_min_tiles = field_pos_min_tiles or {}
     fields = {
         name: pack_field(
-            f, n, device, min_tiles.get(name, 0), avgdls.get(name), k1, b
+            f,
+            n,
+            device,
+            min_tiles.get(name, 0),
+            avgdls.get(name),
+            k1,
+            b,
+            pos_min_tiles.get(name, 0),
         )
         for name, f in segment.fields.items()
     }

@@ -122,6 +122,37 @@ def _eval_node(spec, arrays, seg: dict[str, Any], num_docs: int):
         return _eval_bool(spec, arrays, seg, num_docs)
     if kind == "script":
         return _eval_script(spec, arrays, seg, num_docs)
+    if kind == "phrase":
+        return _eval_phrase(spec, arrays, seg, num_docs)
+    if kind == "doc_set":
+        docs = arrays["docs"]  # i32[ND], -1 padding
+        idx = jnp.where(docs >= 0, docs, num_docs)
+        matched = (
+            jnp.zeros(num_docs + 1, dtype=bool).at[idx].max(docs >= 0)[:num_docs]
+        )
+        scores = jnp.where(matched, arrays["boost"], jnp.float32(0.0))
+        return scores, matched
+    if kind == "dismax":
+        _, child_specs = spec
+        best = jnp.full(num_docs, jnp.float32(0.0))
+        total = jnp.zeros(num_docs, dtype=jnp.float32)
+        matched = jnp.zeros(num_docs, dtype=bool)
+        for child_spec, child_arrays in zip(child_specs, arrays["children"]):
+            s, m = _eval_node(child_spec, child_arrays, seg, num_docs)
+            s = jnp.where(m, s, jnp.float32(0.0))
+            best = jnp.maximum(best, s)
+            total = total + s
+            matched = matched | m
+        tie = arrays["tie"]
+        # NOTE: XLA may contract this mul+add into an FMA (it even clones
+        # the multiply past an optimization_barrier), so dis_max scores can
+        # differ from the oracle's two-rounding result by 1 ulp. Ranking
+        # parity (ids + order) is unaffected in practice; the parity
+        # contract for fused expressions is ids/order exact, scores within
+        # ulps (BENCH gate).
+        scores = best + tie * (total - best)
+        scores = jnp.where(matched, scores * arrays["boost"], jnp.float32(0.0))
+        return scores, matched
     raise ValueError(f"unknown plan node kind [{kind}]")
 
 
@@ -204,6 +235,65 @@ def _eval_terms_gather(spec, arrays, seg, num_docs):
     one = jnp.float32(1.0)
     contrib = w - w / (one + tfs * ninv)
     return _scatter_scored(docs, contrib, valid, num_docs)
+
+
+def _eval_phrase(spec, arrays, seg, num_docs):
+    """Exact-phrase evaluation over the segment's position planes.
+
+    The TPU replacement for Lucene's ExactPhraseMatcher doc-at-a-time
+    postings zipper (reference: MatchPhraseQueryBuilder.java:28 lowering to
+    PhraseQuery): instead of advancing m positional iterators in lockstep,
+    every position entry of every phrase slot is gathered at once, each
+    shifted to its phrase-aligned position (apos = pos - slot offset), and
+    sorted by (doc, apos). A full phrase occurrence at (doc, apos) produces
+    exactly n_slots equal keys — one per slot, since one position holds one
+    token — so occurrences are runs of length n_slots, counted with a
+    static shifted-compare fold exactly like the sparse BM25 kernel's
+    run-sum. Phrase frequency then scores through the standard BM25
+    expression with the summed-idf weight (Lucene PhraseWeight +
+    BM25Similarity over combined termStatistics).
+    """
+    _, field_name, nt, n_slots = spec
+    pos_doc_tiles, pos_val_tiles = seg["positions"][field_name]
+    norm_bytes = seg["fields"][field_name][3]
+    tile_ids = arrays["tile_ids"]  # i32[NT]
+    docs = pos_doc_tiles[tile_ids]  # i32[NT, S]
+    poss = pos_val_tiles[tile_ids]  # i32[NT, S]
+    pos_idx = tile_ids[:, None] * TILE + jnp.arange(TILE, dtype=jnp.int32)
+    valid = (pos_idx >= arrays["starts"][:, None]) & (
+        pos_idx < arrays["ends"][:, None]
+    )
+    apos = poss - arrays["shifts"][:, None]
+    valid &= apos >= 0
+    sentinel = jnp.int32(num_docs)
+    doc_key = jnp.where(valid, docs, sentinel).reshape(-1)  # [P]
+    apos_key = jnp.where(valid, apos, jnp.int32(-1)).reshape(-1)
+    p = doc_key.shape[0]
+    d_s, a_s = jax.lax.sort((doc_key, apos_key), num_keys=2, is_stable=False)
+    # Run detection: occurrence ⇔ n_slots consecutive equal (doc, apos).
+    d_ext = jnp.concatenate(
+        [d_s, jnp.full(n_slots, num_docs + 1, dtype=d_s.dtype)]
+    )
+    a_ext = jnp.concatenate([a_s, jnp.full(n_slots, -2, dtype=a_s.dtype)])
+    full = jnp.ones(p, dtype=bool)
+    for j in range(1, n_slots):
+        full &= (d_ext[j : j + p] == d_s) & (a_ext[j : j + p] == a_s)
+    is_start = jnp.concatenate(
+        [jnp.ones(1, dtype=bool), (d_s[1:] != d_s[:-1]) | (a_s[1:] != a_s[:-1])]
+    )
+    occurrence = full & is_start & (d_s != sentinel)
+    freq_idx = jnp.where(occurrence, d_s, sentinel)
+    freq = (
+        jnp.zeros(num_docs + 1, dtype=jnp.float32)
+        .at[freq_idx]
+        .add(occurrence.astype(jnp.float32))[:num_docs]
+    )
+    matched = freq > 0
+    ninv = arrays["cache"][norm_bytes[:num_docs]]
+    w = arrays["weight"]
+    scores = w - w / (jnp.float32(1.0) + freq * ninv)
+    scores = jnp.where(matched, scores, jnp.float32(0.0))
+    return scores, matched
 
 
 def _terms_matched(spec, arrays, seg, num_docs):
@@ -615,6 +705,11 @@ def segment_tree(device_segment) -> dict[str, Any]:
         "fields": {
             name: (f.doc_ids, f.tn, f.tfs, f.norm_bytes, f.present)
             for name, f in device_segment.fields.items()
+        },
+        "positions": {
+            name: (f.pos_doc, f.pos_val)
+            for name, f in device_segment.fields.items()
+            if f.pos_doc is not None
         },
         "doc_values": dict(device_segment.doc_values),
         "vectors": dict(device_segment.vectors),

@@ -65,6 +65,10 @@ def _empty_field(name: str, num_docs: int, has_norms: bool) -> FieldIndex:
         sum_total_tf=0,
         has_norms=has_norms,
         present=np.zeros(num_docs, dtype=bool),
+        # Text fields carry (empty) position planes so every shard's pytree
+        # has the same structure for the mesh stack.
+        pos_offsets=np.zeros(1, dtype=np.int64) if has_norms else None,
+        positions=np.zeros(0, dtype=np.int32) if has_norms else None,
     )
 
 
@@ -123,12 +127,22 @@ class ShardedIndex:
         n_pad = max((s.num_docs for s in segments), default=0)
         n_pad = max(n_pad, 1)
         min_tiles: dict[str, int] = {}
+        pos_min_tiles: dict[str, int] = {}
         for seg in segments:
             for name in all_fields:
                 fld = seg.fields.get(name)
                 postings = len(fld.doc_ids) if fld is not None else 0
                 tiles = postings // TILE + 2  # data tiles + sentinel tile
                 min_tiles[name] = max(min_tiles.get(name, 0), tiles)
+                npos = (
+                    len(fld.positions)
+                    if fld is not None and fld.positions is not None
+                    else 0
+                )
+                if all_fields[name]:  # text field: position planes stack too
+                    pos_min_tiles[name] = max(
+                        pos_min_tiles.get(name, 0), npos // TILE + 2
+                    )
         # Global (cross-shard) avgdl so precomputed impacts match the DFS
         # statistics scope the compiler will score with.
         global_stats = aggregate_field_stats(segments)
@@ -148,6 +162,7 @@ class ShardedIndex:
                 field_avgdl=global_avgdl,
                 k1=params.k1,
                 b=params.b,
+                field_pos_min_tiles=pos_min_tiles,
             )
             trees.append(segment_tree(dev))
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
@@ -202,6 +217,12 @@ class ShardedIndex:
                     tn_avgdl=float(fstats.avgdl) if fstats else 1.0,
                     tn_k1=self.params.k1,
                     tn_b=self.params.b,
+                    pos_offsets=fld.pos_offsets,
+                    pos_num_tiles_=(
+                        len(fld.positions) // TILE + 2
+                        if fld.positions is not None
+                        else 0
+                    ),
                 )
             return Compiler(
                 fields=fields,
@@ -210,6 +231,9 @@ class ShardedIndex:
                 params=self.params,
                 stats=stats,
                 nt_floor=floor,
+                id_index=lambda s=seg: {
+                    d: i for i, d in enumerate(s.ids)
+                },
             )
 
         first = [
@@ -306,6 +330,8 @@ class _PlanField:
     tn_avgdl: float = -1.0
     tn_k1: float = 1.2
     tn_b: float = 0.75
+    pos_offsets: Any = None  # int64[P+1] host copy (phrase planning)
+    pos_num_tiles_: int = 0
 
     @property
     def avgdl(self) -> float:
@@ -317,11 +343,24 @@ class _PlanField:
     def pad_tile(self) -> int:
         return self.num_tiles_ - 1
 
+    @property
+    def pos_pad_tile(self) -> int:
+        return self.pos_num_tiles_ - 1
+
     def term_span(self, term: str) -> tuple[int, int]:
         tid = self.terms.get(term)
         if tid is None:
             return (0, 0)
         return int(self.offsets[tid]), int(self.offsets[tid + 1])
+
+    def term_pos_span(self, term: str) -> tuple[int, int]:
+        tid = self.terms.get(term)
+        if tid is None or self.pos_offsets is None:
+            return (0, 0)
+        return (
+            int(self.pos_offsets[self.offsets[tid]]),
+            int(self.pos_offsets[self.offsets[tid + 1]]),
+        )
 
     def term_df(self, term: str) -> int:
         tid = self.terms.get(term)
@@ -331,12 +370,16 @@ class _PlanField:
 
 
 def _max_nt(spec: tuple) -> int:
-    """Largest terms-node worklist bucket anywhere in a compiled spec."""
+    """Largest worklist bucket anywhere in a compiled spec."""
     kind = spec[0]
-    if kind in ("terms", "terms_const", "terms_gather"):
+    if kind in ("terms", "terms_const", "terms_gather", "phrase"):
         return spec[2]
+    if kind == "doc_set":
+        return spec[1]
     if kind in ("const", "script"):
         return _max_nt(spec[1])
+    if kind == "dismax":
+        return max((_max_nt(c) for c in spec[1]), default=1)
     if kind == "bool":
         out = 1
         for group in spec[1:5]:

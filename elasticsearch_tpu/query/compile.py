@@ -35,15 +35,22 @@ from ..ops.bm25 import BM25Params, norm_inverse_cache, term_weight
 from .dsl import (
     BoolQuery,
     ConstantScoreQuery,
+    DisMaxQuery,
     ExistsQuery,
+    FuzzyQuery,
+    IdsQuery,
     MatchAllQuery,
     MatchNoneQuery,
+    MatchPhrasePrefixQuery,
+    MatchPhraseQuery,
     MatchQuery,
+    PrefixQuery,
     Query,
     RangeQuery,
     ScriptScoreQuery,
     TermQuery,
     TermsQuery,
+    WildcardQuery,
 )
 
 
@@ -227,6 +234,73 @@ def _terms_arrays(
     return spec, arrays
 
 
+def _wildcard_regex(pattern: str, case_insensitive: bool):
+    """ES wildcard semantics: `*` = any run, `?` = any single char; every
+    other character is literal (no character classes)."""
+    import re
+
+    parts = []
+    for ch in pattern:
+        if ch == "*":
+            parts.append(".*")
+        elif ch == "?":
+            parts.append(".")
+        else:
+            parts.append(re.escape(ch))
+    return re.compile("".join(parts), re.IGNORECASE if case_insensitive else 0)
+
+
+def _auto_fuzziness(fuzziness, value: str) -> int:
+    """The reference's Fuzziness.AUTO ladder: below `low` chars → 0 edits,
+    below `high` → 1, else 2; defaults low=3, high=6, overridable as
+    "AUTO:low,high" (common/unit Fuzziness)."""
+    if isinstance(fuzziness, str) and fuzziness.upper().startswith("AUTO"):
+        low, high = 3, 6
+        _, _, rest = fuzziness.partition(":")
+        if rest:
+            try:
+                low, high = (int(x) for x in rest.split(","))
+            except ValueError:
+                raise ValueError(
+                    f"invalid fuzziness [{fuzziness}]; expected AUTO:low,high"
+                ) from None
+        n = len(value)
+        return 0 if n < low else (1 if n < high else 2)
+    return int(fuzziness)
+
+
+def _damerau_bounded(a: str, b: str, max_edits: int) -> int | None:
+    """Optimal-string-alignment distance (Lucene fuzzy's transpositions=true
+    semantics), banded; None if distance exceeds max_edits."""
+    if a == b:
+        return 0
+    if max_edits == 0:
+        return None
+    la, lb = len(a), len(b)
+    prev2: list[int] | None = None
+    prev = list(range(lb + 1))
+    for i in range(1, la + 1):
+        cur = [i] + [0] * lb
+        row_min = i
+        for j in range(1, lb + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            d = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+            if (
+                prev2 is not None
+                and i > 1
+                and j > 1
+                and a[i - 1] == b[j - 2]
+                and a[i - 2] == b[j - 1]
+            ):
+                d = min(d, prev2[j - 2] + 1)
+            cur[j] = d
+            row_min = min(row_min, d)
+        if row_min > max_edits:
+            return None
+        prev2, prev = prev, cur
+    return prev[lb] if prev[lb] <= max_edits else None
+
+
 class Compiler:
     """Compiles Query trees against one segment's fields and statistics."""
 
@@ -238,12 +312,17 @@ class Compiler:
         params: BM25Params = BM25Params(),
         stats: dict[str, FieldStats] | None = None,
         nt_floor: int = 1,
+        id_index: Any = None,  # dict[str, int] | zero-arg callable | None
     ):
         self.fields = fields
         self.doc_values = doc_values
         self.mappings = mappings
         self.params = params
         self.stats = stats or {}
+        # _id -> local doc for ids queries: a dict, or a zero-arg callable
+        # returning one (so the engine can defer building it until an ids
+        # query actually compiles)
+        self.id_index = id_index
         # Minimum worklist bucket: sharded/batched compilation raises this to
         # the max across shards (and across a query batch) so every shard
         # and query compiles to one identical static spec.
@@ -283,6 +362,33 @@ class Compiler:
             return self._bool(q, scoring)
         if isinstance(q, ScriptScoreQuery):
             return self._script_score(q, scoring)
+        if isinstance(q, MatchPhraseQuery):
+            return self._phrase(q, scoring)
+        if isinstance(q, MatchPhrasePrefixQuery):
+            return self._phrase_prefix(q, scoring)
+        if isinstance(q, PrefixQuery):
+            return self._multi_term(
+                q.field_name, self._prefix_terms(q), q.boost
+            )
+        if isinstance(q, WildcardQuery):
+            return self._multi_term(
+                q.field_name, self._wildcard_terms(q), q.boost
+            )
+        if isinstance(q, FuzzyQuery):
+            return self._multi_term(
+                q.field_name, self._fuzzy_terms(q), q.boost
+            )
+        if isinstance(q, IdsQuery):
+            return self._ids(q)
+        if isinstance(q, DisMaxQuery):
+            children = [self._node(c, scoring) for c in q.queries]
+            if not children:
+                return ("match_none",), {}
+            return ("dismax", tuple(s for s, _ in children)), {
+                "tie": np.float32(q.tie_breaker),
+                "boost": np.float32(q.boost),
+                "children": tuple(a for _, a in children),
+            }
         raise ValueError(f"cannot compile query type {type(q).__name__}")
 
     def _script_score(self, q: ScriptScoreQuery, scoring: bool) -> tuple[tuple, Any]:
@@ -312,6 +418,205 @@ class Compiler:
 
     def _field_or_none(self, name: str) -> DeviceField | None:
         return self.fields.get(name)
+
+    # -- positional queries -------------------------------------------------
+
+    def _phrase_slots(self, q, field_name: str):
+        """Analyzed (term, relative position) slots of a phrase query."""
+        if getattr(q, "analyzer", None):
+            analyzer = self.mappings.analysis.get(q.analyzer)
+        else:
+            analyzer = self.mappings.analyzer_for(field_name, search=True)
+        pairs, _span = analyzer.analyze_positions(q.query)
+        if not pairs:
+            return []
+        base = pairs[0][1]
+        return [(t, p - base) for t, p in pairs]
+
+    def _phrase(self, q: MatchPhraseQuery, scoring: bool):
+        if q.slop:
+            raise ValueError(
+                "match_phrase slop is not supported yet (exact phrases only)"
+            )
+        slots = self._phrase_slots(q, q.field_name)
+        return self._phrase_from_slots(q.field_name, slots, q.boost, scoring)
+
+    def _phrase_prefix(self, q: MatchPhrasePrefixQuery, scoring: bool):
+        slots = self._phrase_slots(q, q.field_name)
+        if not slots:
+            return ("match_none",), {}
+        dfield = self._field_or_none(q.field_name)
+        if dfield is None:
+            return ("match_none",), {}
+        last_term, last_pos = slots[-1]
+        expansions = [t for t in dfield.terms if t.startswith(last_term)]
+        expansions = expansions[: max(1, q.max_expansions)]
+        if len(slots) == 1:
+            # Bare prefix: multi-term disjunction (constant-score rewrite).
+            return self._multi_term(q.field_name, expansions, q.boost)
+        # MultiPhraseQuery form: the union of expansions occupies the last
+        # slot. All expansions share the phrase-position structure, so the
+        # plan merges their position spans into one entry list.
+        return self._phrase_from_slots(
+            q.field_name,
+            slots[:-1],
+            q.boost,
+            scoring,
+            union_slot=(last_pos, expansions),
+        )
+
+    def _phrase_from_slots(
+        self, field_name, slots, boost, scoring, union_slot=None
+    ):
+        dfield = self._field_or_none(field_name)
+        if dfield is None or not slots and union_slot is None:
+            return ("match_none",), {}
+        if len(slots) == 1 and union_slot is None:
+            # Single-term phrase scores exactly like a term query
+            # (Lucene rewrites PhraseQuery of one term to TermQuery).
+            stats = self.stats.get(field_name)
+            return self._terms_spec(
+                dfield, [slots[0][0]], boost, stats, scoring
+            )
+        if dfield.pos_offsets is None:
+            raise ValueError(
+                f"field [{field_name}] was indexed without positions "
+                f"(keyword fields don't support phrase queries)"
+            )
+        stats = self.stats.get(field_name)
+        doc_count = stats.doc_count if stats else dfield.doc_count
+        avgdl = stats.avgdl if stats else dfield.avgdl
+
+        all_slots: list[tuple[str, int]] = list(slots)
+        if union_slot is not None:
+            last_pos, expansions = union_slot
+            all_slots += [(t, last_pos) for t in expansions]
+        # Every non-union slot term must exist in this segment for any
+        # phrase occurrence; union slots need >= 1 surviving expansion.
+        # An impossible phrase compiles to an EMPTY worklist (not
+        # match_none) so the spec shape stays uniform across shards — the
+        # sharded executor stacks per-shard arrays under one static spec.
+        entries: list[tuple[int, int, int, int]] = []  # (tile, ps, pe, shift)
+        w = np.float32(0.0)
+        union_alive = False
+        impossible = False
+        for t, off in all_slots:
+            ps, pe = dfield.term_pos_span(t)
+            is_union = union_slot is not None and off == union_slot[0]
+            if pe <= ps:
+                if is_union:
+                    continue
+                impossible = True
+                break
+            if is_union:
+                union_alive = True
+            df = stats.df.get(t, dfield.term_df(t)) if stats else dfield.term_df(t)
+            if scoring and df > 0 and doc_count > 0:
+                # Lucene PhraseWeight sums idf over every term occurrence
+                # (BM25Similarity.idfExplain over the termStatistics array).
+                w = np.float32(
+                    w + term_weight(df, doc_count, boost, self.params)
+                )
+            first, last = ps // TILE, (pe - 1) // TILE
+            for tile in range(first, last + 1):
+                entries.append((tile, ps, pe, off))
+        if impossible or (union_slot is not None and not union_alive):
+            entries = []
+            w = np.float32(0.0)
+
+        nt = _pow2(len(entries), self.nt_floor)
+        tile_ids = np.full(nt, dfield.pos_pad_tile, dtype=np.int32)
+        starts = np.zeros(nt, dtype=np.int32)
+        ends = np.zeros(nt, dtype=np.int32)
+        shifts = np.zeros(nt, dtype=np.int32)
+        for i, (tile, ps, pe, off) in enumerate(entries):
+            tile_ids[i] = tile
+            starts[i] = ps
+            ends[i] = pe
+            shifts[i] = off
+        # Distinct phrase slots (not entries): a full occurrence produces
+        # exactly this many (doc, aligned-pos) key repeats.
+        n_slots = len(slots) + (1 if union_slot is not None else 0)
+        cache = norm_inverse_cache(avgdl if doc_count else 1.0, self.params)
+        if not dfield.has_norms:
+            cache = np.full(256, cache[1], dtype=np.float32)
+        spec = ("phrase", field_name, nt, n_slots)
+        arrays = {
+            "tile_ids": tile_ids,
+            "starts": starts,
+            "ends": ends,
+            "shifts": shifts,
+            "weight": np.float32(w),
+            "cache": cache,
+        }
+        return spec, arrays
+
+    # -- multi-term expansion queries ---------------------------------------
+
+    def _multi_term(self, field_name: str, terms: list[str], boost: float):
+        """Constant-score disjunction over expanded terms (the reference's
+        MultiTermQuery constant-score rewrite: every match scores boost).
+
+        Zero expansions still compile to an (empty) terms_const worklist so
+        the spec shape is uniform across shards."""
+        dfield = self._field_or_none(field_name)
+        if dfield is None:
+            return ("match_none",), {}
+        return self._terms_spec(
+            dfield, terms, boost, self.stats.get(field_name), scored=False
+        )
+
+    def _prefix_terms(self, q: PrefixQuery) -> list[str]:
+        dfield = self._field_or_none(q.field_name)
+        if dfield is None:
+            return []
+        if q.case_insensitive:
+            v = q.value.lower()
+            return [t for t in dfield.terms if t.lower().startswith(v)]
+        return [t for t in dfield.terms if t.startswith(q.value)]
+
+    def _wildcard_terms(self, q: WildcardQuery) -> list[str]:
+        dfield = self._field_or_none(q.field_name)
+        if dfield is None:
+            return []
+        regex = _wildcard_regex(q.value, q.case_insensitive)
+        return [t for t in dfield.terms if regex.fullmatch(t)]
+
+    def _fuzzy_terms(self, q: FuzzyQuery) -> list[str]:
+        dfield = self._field_or_none(q.field_name)
+        if dfield is None:
+            return []
+        max_edits = _auto_fuzziness(q.fuzziness, q.value)
+        prefix = q.value[: q.prefix_length]
+        scored: list[tuple[int, str]] = []
+        for t in dfield.terms:
+            if q.prefix_length and not t.startswith(prefix):
+                continue
+            if abs(len(t) - len(q.value)) > max_edits:
+                continue
+            d = _damerau_bounded(q.value, t, max_edits)
+            if d is not None:
+                scored.append((d, t))
+        scored.sort()
+        return [t for _, t in scored[: max(1, q.max_expansions)]]
+
+    def _ids(self, q: IdsQuery):
+        if self.id_index is None or not q.values:
+            return ("match_none",), {}
+        index = self.id_index() if callable(self.id_index) else self.id_index
+        locals_ = sorted(
+            index[v] for v in set(q.values) if v in index
+        )
+        # A shard with zero matching ids still compiles to an (all-padding)
+        # doc_set so the spec stays uniform across shards; nt_floor keeps
+        # the bucket uniform when counts differ.
+        nd = _pow2(len(locals_), self.nt_floor)
+        docs = np.full(nd, -1, dtype=np.int32)
+        docs[: len(locals_)] = locals_
+        return ("doc_set", nd), {
+            "docs": docs,
+            "boost": np.float32(q.boost),
+        }
 
     def _match(self, q: MatchQuery, scoring: bool) -> tuple[tuple, Any]:
         dfield = self._field_or_none(q.field_name)

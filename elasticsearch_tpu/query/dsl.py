@@ -116,6 +116,97 @@ class ScriptScoreQuery(Query):
 
 
 @dataclass
+class MatchPhraseQuery(Query):
+    """Exact phrase over an analyzed text field's positions.
+
+    Mirrors MatchPhraseQueryBuilder (index/query/MatchPhraseQueryBuilder.
+    java:28): query text analyzes to (term, position) pairs (stopword gaps
+    preserved), a doc matches when every term occurs at its relative
+    position, and the phrase frequency feeds BM25 with the summed term idf
+    (Lucene PhraseQuery → BM25Similarity over the combined termStatistics).
+    slop > 0 (sloppy matching) is not supported yet.
+    """
+
+    field_name: str
+    query: str
+    slop: int = 0
+    analyzer: str | None = None
+    boost: float = 1.0
+
+
+@dataclass
+class MatchPhrasePrefixQuery(Query):
+    """Phrase whose last term matches as a prefix (MatchPhrasePrefixQueryBuilder;
+    Lucene MultiPhraseQuery over the prefix's expansions, capped at
+    max_expansions)."""
+
+    field_name: str
+    query: str
+    max_expansions: int = 50
+    analyzer: str | None = None
+    boost: float = 1.0
+
+
+@dataclass
+class PrefixQuery(Query):
+    """Terms starting with a prefix; constant-score rewrite like the
+    reference's PrefixQueryBuilder under its default rewrite."""
+
+    field_name: str
+    value: str
+    case_insensitive: bool = False
+    boost: float = 1.0
+
+
+@dataclass
+class WildcardQuery(Query):
+    """`*`/`?` pattern over the term dictionary; constant-score rewrite
+    (WildcardQueryBuilder)."""
+
+    field_name: str
+    value: str
+    case_insensitive: bool = False
+    boost: float = 1.0
+
+
+@dataclass
+class FuzzyQuery(Query):
+    """Terms within Damerau-Levenshtein distance of `value` (FuzzyQueryBuilder).
+
+    fuzziness "AUTO" follows the reference's ladder: 0 edits below length 3,
+    1 below 6, else 2. Expansion is capped at `max_expansions`, closest
+    distance first. Matching is exact; scoring is the constant-score rewrite
+    (the reference's blended-frequency rewrite is a scoring refinement over
+    the same matched set).
+    """
+
+    field_name: str
+    value: str
+    fuzziness: str | int = "AUTO"
+    prefix_length: int = 0
+    max_expansions: int = 50
+    boost: float = 1.0
+
+
+@dataclass
+class IdsQuery(Query):
+    """Docs whose _id is in the given set (IdsQueryBuilder); constant score."""
+
+    values: list[str] = field(default_factory=list)
+    boost: float = 1.0
+
+
+@dataclass
+class DisMaxQuery(Query):
+    """Disjunction-max: score = max(children) + tie_breaker * (sum - max)
+    over matching children (DisMaxQueryBuilder / Lucene DisjunctionMaxQuery)."""
+
+    queries: list[Query] = field(default_factory=list)
+    tie_breaker: float = 0.0
+    boost: float = 1.0
+
+
+@dataclass
 class BoolQuery(Query):
     """Boolean combination, mirroring BoolQueryBuilder semantics:
 
@@ -206,6 +297,73 @@ def parse_query(body: dict[str, Any]) -> Query:
             boost=_pop_boost(spec),
             min_score=spec.get("min_score"),
         )
+    if kind == "match_phrase":
+        fname, val = _single_field(kind, spec)
+        if isinstance(val, dict):
+            return MatchPhraseQuery(
+                field_name=fname,
+                query=str(val["query"]),
+                slop=int(val.get("slop", 0)),
+                analyzer=val.get("analyzer"),
+                boost=_pop_boost(val),
+            )
+        return MatchPhraseQuery(field_name=fname, query=str(val))
+    if kind == "match_phrase_prefix":
+        fname, val = _single_field(kind, spec)
+        if isinstance(val, dict):
+            return MatchPhrasePrefixQuery(
+                field_name=fname,
+                query=str(val["query"]),
+                max_expansions=int(val.get("max_expansions", 50)),
+                analyzer=val.get("analyzer"),
+                boost=_pop_boost(val),
+            )
+        return MatchPhrasePrefixQuery(field_name=fname, query=str(val))
+    if kind == "multi_match":
+        return _parse_multi_match(spec)
+    if kind == "prefix":
+        fname, val = _single_field(kind, spec)
+        if isinstance(val, dict):
+            return PrefixQuery(
+                fname,
+                str(val["value"]),
+                case_insensitive=bool(val.get("case_insensitive", False)),
+                boost=_pop_boost(val),
+            )
+        return PrefixQuery(fname, str(val))
+    if kind == "wildcard":
+        fname, val = _single_field(kind, spec)
+        if isinstance(val, dict):
+            return WildcardQuery(
+                fname,
+                str(val.get("value", val.get("wildcard", ""))),
+                case_insensitive=bool(val.get("case_insensitive", False)),
+                boost=_pop_boost(val),
+            )
+        return WildcardQuery(fname, str(val))
+    if kind == "fuzzy":
+        fname, val = _single_field(kind, spec)
+        if isinstance(val, dict):
+            return FuzzyQuery(
+                fname,
+                str(val["value"]),
+                fuzziness=val.get("fuzziness", "AUTO"),
+                prefix_length=int(val.get("prefix_length", 0)),
+                max_expansions=int(val.get("max_expansions", 50)),
+                boost=_pop_boost(val),
+            )
+        return FuzzyQuery(fname, str(val))
+    if kind == "ids":
+        return IdsQuery(
+            values=[str(v) for v in spec.get("values", [])],
+            boost=_pop_boost(spec),
+        )
+    if kind == "dis_max":
+        return DisMaxQuery(
+            queries=[parse_query(q) for q in spec.get("queries", [])],
+            tie_breaker=float(spec.get("tie_breaker", 0.0)),
+            boost=_pop_boost(spec),
+        )
     if kind == "bool":
         def _clauses(key: str) -> list[Query]:
             raw = spec.get(key, [])
@@ -228,3 +386,55 @@ def _single_field(kind: str, spec: dict) -> tuple[str, Any]:
     if not isinstance(spec, dict) or len(spec) != 1:
         raise ValueError(f"[{kind}] expects exactly one field, got: {spec!r}")
     return next(iter(spec.items()))
+
+
+def _parse_multi_match(spec: dict) -> Query:
+    """multi_match → composition of per-field queries, mirroring
+    MultiMatchQueryBuilder's type dispatch: best_fields = dis_max with
+    tie_breaker, most_fields = bool should (scores sum), phrase /
+    phrase_prefix = dis_max over per-field phrase queries."""
+    text = str(spec.get("query", ""))
+    raw_fields = spec.get("fields")
+    if not raw_fields:
+        raise ValueError("[multi_match] requires [fields]")
+    if isinstance(raw_fields, str):
+        raw_fields = [raw_fields]
+    mm_type = str(spec.get("type", "best_fields"))
+    if mm_type not in ("best_fields", "most_fields", "phrase", "phrase_prefix"):
+        # cross_fields/bool_prefix blend term statistics across fields — a
+        # materially different scoring model; reject rather than silently
+        # mis-score (matching this codebase's not-supported-yet convention).
+        raise ValueError(f"multi_match type [{mm_type}] is not supported yet")
+    boost = _pop_boost(spec)
+    tie = float(
+        spec.get("tie_breaker", 0.0 if mm_type != "most_fields" else 1.0)
+    )
+    operator = str(spec.get("operator", "or")).lower()
+    fields: list[tuple[str, float]] = []
+    for f in raw_fields:
+        if "^" in f:
+            name, _, b = f.partition("^")
+            fields.append((name, float(b)))
+        else:
+            fields.append((f, 1.0))
+    per_field: list[Query] = []
+    for name, fboost in fields:
+        if mm_type == "phrase":
+            per_field.append(
+                MatchPhraseQuery(name, text, boost=fboost)
+            )
+        elif mm_type == "phrase_prefix":
+            per_field.append(
+                MatchPhrasePrefixQuery(name, text, boost=fboost)
+            )
+        else:
+            per_field.append(
+                MatchQuery(name, text, operator=operator, boost=fboost)
+            )
+    if len(per_field) == 1:
+        q = per_field[0]
+        q.boost *= boost
+        return q
+    if mm_type == "most_fields":
+        return BoolQuery(should=per_field, boost=boost)
+    return DisMaxQuery(queries=per_field, tie_breaker=tie, boost=boost)

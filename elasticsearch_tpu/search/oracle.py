@@ -28,16 +28,49 @@ from ..ops.bm25 import (
 from ..query.dsl import (
     BoolQuery,
     ConstantScoreQuery,
+    DisMaxQuery,
     ExistsQuery,
+    FuzzyQuery,
+    IdsQuery,
     MatchAllQuery,
     MatchNoneQuery,
+    MatchPhrasePrefixQuery,
+    MatchPhraseQuery,
     MatchQuery,
+    PrefixQuery,
     Query,
     RangeQuery,
     ScriptScoreQuery,
     TermQuery,
     TermsQuery,
+    WildcardQuery,
 )
+
+
+def _osa_distance(a: str, b: str) -> int:
+    """Optimal-string-alignment (Damerau with non-overlapping transposition)
+    — Lucene fuzzy's transpositions=true distance, re-derived independently
+    of the compiler's banded version."""
+    la, lb = len(a), len(b)
+    d = [[0] * (lb + 1) for _ in range(la + 1)]
+    for i in range(la + 1):
+        d[i][0] = i
+    for j in range(lb + 1):
+        d[0][j] = j
+    for i in range(1, la + 1):
+        for j in range(1, lb + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            d[i][j] = min(
+                d[i - 1][j] + 1, d[i][j - 1] + 1, d[i - 1][j - 1] + cost
+            )
+            if (
+                i > 1
+                and j > 1
+                and a[i - 1] == b[j - 2]
+                and a[i - 2] == b[j - 1]
+            ):
+                d[i][j] = min(d[i][j], d[i - 2][j - 2] + 1)
+    return d[la][lb]
 
 
 class OracleSearcher:
@@ -106,7 +139,209 @@ class OracleSearcher:
             return self._bool(q)
         if isinstance(q, ScriptScoreQuery):
             return self._script_score(q)
+        if isinstance(q, MatchPhraseQuery):
+            return self._phrase(q)
+        if isinstance(q, MatchPhrasePrefixQuery):
+            return self._phrase_prefix(q)
+        if isinstance(q, PrefixQuery):
+            fld = self.segment.fields.get(q.field_name)
+            if fld is None:
+                return np.zeros(n, np.float32), np.zeros(n, bool)
+            if q.case_insensitive:
+                v = q.value.lower()
+                terms = [t for t in fld.terms if t.lower().startswith(v)]
+            else:
+                terms = [t for t in fld.terms if t.startswith(q.value)]
+            return self._const_terms(q.field_name, terms, q.boost)
+        if isinstance(q, WildcardQuery):
+            import re
+
+            fld = self.segment.fields.get(q.field_name)
+            if fld is None:
+                return np.zeros(n, np.float32), np.zeros(n, bool)
+            pat = "".join(
+                ".*" if c == "*" else ("." if c == "?" else re.escape(c))
+                for c in q.value
+            )
+            rx = re.compile(pat, re.IGNORECASE if q.case_insensitive else 0)
+            terms = [t for t in fld.terms if rx.fullmatch(t)]
+            return self._const_terms(q.field_name, terms, q.boost)
+        if isinstance(q, FuzzyQuery):
+            return self._fuzzy(q)
+        if isinstance(q, IdsQuery):
+            wanted = set(q.values)
+            matched = np.fromiter(
+                (d in wanted for d in self.segment.ids), dtype=bool, count=n
+            )
+            return (
+                np.where(matched, np.float32(q.boost), np.float32(0.0)),
+                matched,
+            )
+        if isinstance(q, DisMaxQuery):
+            best = np.zeros(n, dtype=np.float32)
+            total = np.zeros(n, dtype=np.float32)
+            matched = np.zeros(n, dtype=bool)
+            for child in q.queries:
+                s, m = self._eval(child)
+                s = np.where(m, s, np.float32(0.0)).astype(np.float32)
+                best = np.maximum(best, s)
+                total = total + s
+                matched |= m
+            tie = np.float32(q.tie_breaker)
+            scores = best + tie * (total - best)
+            scores = np.where(
+                matched, scores * np.float32(q.boost), np.float32(0.0)
+            )
+            return scores.astype(np.float32), matched
         raise ValueError(f"oracle cannot evaluate {type(q).__name__}")
+
+    def _const_terms(self, field_name: str, terms: list[str], boost: float):
+        n = self.segment.num_docs
+        if not terms:
+            return np.zeros(n, np.float32), np.zeros(n, bool)
+        _, matched = self._score_terms(field_name, terms, 1.0)
+        return np.where(matched, np.float32(boost), np.float32(0.0)), matched
+
+    def _fuzzy(self, q: FuzzyQuery):
+        n = self.segment.num_docs
+        fld = self.segment.fields.get(q.field_name)
+        if fld is None:
+            return np.zeros(n, np.float32), np.zeros(n, bool)
+        # Independent re-derivation of the AUTO ladder + OSA distance.
+        if isinstance(q.fuzziness, str) and q.fuzziness.upper().startswith(
+            "AUTO"
+        ):
+            low, high = 3, 6
+            _, _, rest = str(q.fuzziness).partition(":")
+            if rest:
+                low, high = (int(x) for x in rest.split(","))
+            max_edits = (
+                0 if len(q.value) < low else (1 if len(q.value) < high else 2)
+            )
+        else:
+            max_edits = int(q.fuzziness)
+        prefix = q.value[: q.prefix_length]
+        ranked = []
+        for t in fld.terms:
+            if q.prefix_length and not t.startswith(prefix):
+                continue
+            d = _osa_distance(q.value, t)
+            if d <= max_edits:
+                ranked.append((d, t))
+        ranked.sort()
+        terms = [t for _, t in ranked[: max(1, q.max_expansions)]]
+        return self._const_terms(q.field_name, terms, q.boost)
+
+    def _phrase_pairs(self, q, field_name: str):
+        if getattr(q, "analyzer", None):
+            analyzer = self.mappings.analysis.get(q.analyzer)
+        else:
+            analyzer = self.mappings.analyzer_for(field_name, search=True)
+        pairs, _ = analyzer.analyze_positions(q.query)
+        if not pairs:
+            return []
+        base = pairs[0][1]
+        return [(t, p - base) for t, p in pairs]
+
+    def _phrase(self, q: MatchPhraseQuery):
+        if q.slop:
+            raise ValueError(
+                "match_phrase slop is not supported yet (exact phrases only)"
+            )
+        slots = self._phrase_pairs(q, q.field_name)
+        return self._phrase_freq_scores(q.field_name, slots, None, q.boost)
+
+    def _phrase_prefix(self, q: MatchPhrasePrefixQuery):
+        n = self.segment.num_docs
+        slots = self._phrase_pairs(q, q.field_name)
+        fld = self.segment.fields.get(q.field_name)
+        if not slots or fld is None:
+            return np.zeros(n, np.float32), np.zeros(n, bool)
+        last_term, last_pos = slots[-1]
+        expansions = [t for t in fld.terms if t.startswith(last_term)]
+        expansions = expansions[: max(1, q.max_expansions)]
+        if not expansions:
+            return np.zeros(n, np.float32), np.zeros(n, bool)
+        if len(slots) == 1:
+            return self._const_terms(q.field_name, expansions, q.boost)
+        return self._phrase_freq_scores(
+            q.field_name, slots[:-1], (last_pos, expansions), q.boost
+        )
+
+    def _phrase_freq_scores(self, field_name, slots, union_slot, boost):
+        """Exact phrase frequency per doc from host positions, scored with
+        the summed-idf BM25 weight — the independent reference for the
+        device phrase kernel."""
+        from ..ops.bm25 import norm_inverse_cache, term_weight
+
+        n = self.segment.num_docs
+        fld = self.segment.fields.get(field_name)
+        zeros = np.zeros(n, np.float32), np.zeros(n, bool)
+        if fld is None or not slots:
+            return zeros
+        if not fld.has_positions:
+            raise ValueError(
+                f"field [{field_name}] was indexed without positions "
+                f"(keyword fields don't support phrase queries)"
+            )
+        all_slots = list(slots)
+        if union_slot is not None:
+            last_pos, expansions = union_slot
+            all_slots += [(t, last_pos) for t in expansions]
+        # Candidate docs: conjunction over non-union slots, union over the
+        # union slot's expansions.
+        doc_sets = []
+        by_slot_pos: dict[int, set[str]] = {}
+        for t, off in all_slots:
+            by_slot_pos.setdefault(off, set()).add(t)
+        for off, terms in by_slot_pos.items():
+            docs: set[int] = set()
+            for t in terms:
+                d, _ = fld.postings(t)
+                docs.update(int(x) for x in d)
+            doc_sets.append(docs)
+        if not doc_sets or any(not s for s in doc_sets):
+            return zeros
+        candidates = sorted(set.intersection(*doc_sets))
+        w = np.float32(0.0)
+        for t, _off in all_slots:
+            tid = fld.terms.get(t)
+            if tid is None:
+                if union_slot is not None and _off == union_slot[0]:
+                    continue
+                return zeros
+            df = int(fld.df[tid])
+            w = np.float32(
+                w + term_weight(df, fld.doc_count, boost, self.params)
+            )
+        cache = norm_inverse_cache(fld.avgdl, self.params)
+        if not fld.has_norms:
+            cache = np.full(256, cache[1], dtype=np.float32)
+        scores = np.zeros(n, dtype=np.float32)
+        matched = np.zeros(n, dtype=bool)
+        for doc in candidates:
+            sets = []
+            ok = True
+            for off, terms in by_slot_pos.items():
+                aligned: set[int] = set()
+                for t in terms:
+                    for p in fld.term_positions(t, doc):
+                        if int(p) - off >= 0:
+                            aligned.add(int(p) - off)
+                if not aligned:
+                    ok = False
+                    break
+                sets.append(aligned)
+            if not ok:
+                continue
+            freq = len(set.intersection(*sets))
+            if freq == 0:
+                continue
+            matched[doc] = True
+            ninv = cache[fld.norm_bytes[doc]]
+            tn = np.float32(np.float32(freq) * ninv)
+            scores[doc] = np.float32(w - w / (np.float32(1.0) + tn))
+        return scores, matched
 
     def _script_score(self, q: ScriptScoreQuery):
         from ..script import compile_script

@@ -294,14 +294,10 @@ class SearchService:
         compiled = compiler.compile(request.query)
         seg_tree = bm25_device.segment_tree(handle.device)
 
+        # Sort spec validity is enforced up front by _validate_sort.
         sort_field = None
         descending = False
         if request.sort is not None:
-            if len(request.sort) > 1:
-                raise ValueError(
-                    "multi-key sort is not supported yet; got "
-                    f"{len(request.sort)} sort keys"
-                )
             ((sort_field, order),) = request.sort[0].items()
             descending = order == "desc"
 
@@ -339,9 +335,18 @@ class SearchService:
             return int(tot)
 
         if sort_field not in handle.device.doc_values:
-            raise ValueError(
-                f"No mapping found for [{sort_field}] in order to sort on"
+            # Mapped numeric field with no values in this segment: every
+            # matched doc is "missing" — sorts last, ordered by doc id
+            # (the same contract as NaN values in execute_sorted).
+            _, eligible = bm25_device.execute_dense(
+                seg_tree, compiled.spec, compiled.arrays
             )
+            mask = np.asarray(eligible)
+            for local in np.flatnonzero(mask)[:k]:
+                candidates.append(
+                    (np.inf, handle.base + int(local), handle, int(local), None, None)
+                )
+            return int(mask.sum())
         values, ids, tot = bm25_device.execute_sorted(
             seg_tree, compiled.spec, compiled.arrays, sort_field, descending, k
         )
