@@ -62,8 +62,15 @@ class Analyzer:
         span = len(tokens)
         pairs = [(t, i) for i, t in enumerate(tokens)]
         for f in self.filters:
-            # Filters are per-token maps or drops; apply them elementwise so
-            # surviving tokens keep their original positions.
+            stopset = getattr(f, "stopset", None)
+            if stopset is not None:  # drop filter: keep position gaps
+                pairs = [(t, p) for t, p in pairs if t not in stopset]
+                continue
+            mapped = f([t for t, _ in pairs])
+            if len(mapped) == len(pairs):  # 1:1 order-preserving map
+                pairs = [(m, p) for m, (_, p) in zip(mapped, pairs)]
+                continue
+            # Unknown drop/split filter: per-token fallback keeps positions.
             new_pairs = []
             for t, p in pairs:
                 out = f([t])
@@ -102,6 +109,9 @@ def make_stop_filter(stopwords: Iterable[str]) -> TokenFilter:
     def stop_filter(tokens: list[Token]) -> list[Token]:
         return [t for t in tokens if t not in stopset]
 
+    # Marks this as a pure drop filter so position-aware analysis can keep
+    # gaps without per-token fallback calls.
+    stop_filter.stopset = stopset
     return stop_filter
 
 

@@ -141,6 +141,11 @@ class Engine:
         self._tombstone_ts: dict[str, float] = {}  # _id -> delete wall time
         self.gc_deletes_s = 60.0
         self._stats_cache: dict[str, FieldStats] | None = None
+        # Monotonic refresh generation: bumps whenever the searchable view
+        # changes (new segment, live-mask sync, recovery). Cache keys built
+        # from this are safe where id()-of-handle keys are not (CPython
+        # reuses addresses after GC).
+        self.generation = 0
         self.data_path = data_path
         self.translog: Translog | None = None
         self._next_seg_id = 1
@@ -354,6 +359,8 @@ class Engine:
                 if handle.live_dirty:
                     handle.sync_live()
                     changed = True
+            if changed:
+                self.generation += 1
             if self._buffer.num_docs == 0:
                 return changed
             deleted = self._buffer_deleted
@@ -399,6 +406,7 @@ class Engine:
             self._buffer = SegmentBuilder(self.mappings)
             self._buffer_ids = {}
             self._stats_cache = None
+            self.generation += 1
             self._sync_impacts()
             return True
 
@@ -508,6 +516,7 @@ class Engine:
                 self._bump_auto_id(doc_id)
             base += segment.num_docs
         self._stats_cache = None
+        self.generation += 1
         self._sync_impacts()
 
     def _replay_translog(self) -> None:

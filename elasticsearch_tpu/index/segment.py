@@ -214,13 +214,18 @@ class SegmentBuilder:
                 poss: dict[str, list[int]] = {}
                 base = 0
                 for v in _iter_field_values(value):
-                    pairs, span = analyzer.analyze_positions(str(v))
-                    total_len += len(pairs)
-                    for tok, pos in pairs:
-                        tf[tok] = tf.get(tok, 0) + 1
-                        if with_positions:
+                    if with_positions:
+                        pairs, span = analyzer.analyze_positions(str(v))
+                        total_len += len(pairs)
+                        for tok, pos in pairs:
+                            tf[tok] = tf.get(tok, 0) + 1
                             poss.setdefault(tok, []).append(base + pos)
-                    base += span + POSITION_INCREMENT_GAP
+                        base += span + POSITION_INCREMENT_GAP
+                    else:  # keyword-style fields skip position tracking
+                        tokens = analyzer.analyze(str(v))
+                        total_len += len(tokens)
+                        for tok in tokens:
+                            tf[tok] = tf.get(tok, 0) + 1
                 staged_postings.append((field_name, tf, total_len, poss))
             elif fm.is_numeric:
                 vals = _iter_field_values(value)

@@ -18,13 +18,18 @@ import json
 import os
 import re
 import shutil
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any
 
+import numpy as np
+
 from .index.engine import Engine, InvalidCasError, VersionConflictError
 from .index.mapping import Mappings
 from .ops.bm25 import BM25Params
+from .parallel.routing import shard_for_id
+from .search.coordinator import ShardedSearchCoordinator
 from .search.service import SearchRequest, SearchService
 
 
@@ -47,18 +52,90 @@ _INDEX_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9_\-.]*$")
 
 @dataclass
 class IndexService:
-    """One index: mappings + engine + search service + settings."""
+    """One index: mappings + N shard engines + search entry + settings.
+
+    Shard count follows `settings.index.number_of_shards` (default 1);
+    documents route to shards by ES-compatible murmur3 over _id
+    (cluster/routing/OperationRouting.java:245 via parallel/routing.py),
+    and multi-shard search goes through the ShardedSearchCoordinator.
+    """
 
     name: str
     mappings: Mappings
-    engine: Engine
-    search: SearchService
+    engines: list[Engine]
+    search: SearchService | ShardedSearchCoordinator
     settings: dict[str, Any] = field(default_factory=dict)
     created_at: float = field(default_factory=time.time)
+    _auto_counter: int = -1  # lazy-initialized from recovered engines
+    _auto_lock: threading.Lock = field(default_factory=threading.Lock)
+
+    @property
+    def engine(self) -> Engine:
+        """The sole engine of a 1-shard index (back-compat accessor)."""
+        if len(self.engines) != 1:
+            raise ValueError(
+                f"index [{self.name}] has {len(self.engines)} shards; "
+                f"use route()/engines"
+            )
+        return self.engines[0]
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.engines)
+
+    def route(self, doc_id: str) -> Engine:
+        """Shard engine owning doc_id (murmur3 routing, ES-compatible)."""
+        if len(self.engines) == 1:
+            return self.engines[0]
+        return self.engines[shard_for_id(doc_id, len(self.engines))]
+
+    def next_auto_id(self) -> str:
+        """Node-generated _id for id-less writes, collision-free across
+        restarts (seeded from every shard's recovered auto-id counter) and
+        across concurrent REST threads (ThreadingHTTPServer dispatches
+        writes concurrently; the engine lock sits below this counter)."""
+        with self._auto_lock:
+            if self._auto_counter < 0:
+                self._auto_counter = max(e._auto_id for e in self.engines)
+            doc_id = f"_auto_{self._auto_counter}"
+            self._auto_counter += 1
+            return doc_id
+
+    def mesh_snapshot(self, mesh, axis: str = "shard"):
+        """Stack this index's live docs onto a device mesh for SPMD serving
+        (parallel/sharded.py): one segment per shard on the mesh axis, the
+        scatter-gather collapsed into collectives. A point-in-time snapshot
+        — writes after it don't appear until re-snapshot."""
+        from .index.segment import SegmentBuilder
+        from .parallel.sharded import ShardedIndex
+
+        if mesh.shape[axis] != len(self.engines):
+            raise ValueError(
+                f"mesh axis [{axis}] has {mesh.shape[axis]} devices; index "
+                f"[{self.name}] has {len(self.engines)} shards"
+            )
+        segments = []
+        for engine in self.engines:
+            # Snapshot the refreshed state: pending buffers and soft deletes
+            # become visible first, so the mesh view equals what the
+            # coordinator path serves.
+            engine.refresh()
+            builder = SegmentBuilder(self.mappings)
+            for handle in engine.segments:
+                for local in np.flatnonzero(handle.live_host):
+                    local = int(local)
+                    builder.add(
+                        handle.segment.sources[local],
+                        handle.segment.ids[local],
+                    )
+            segments.append(builder.build())
+        return ShardedIndex.from_segments(
+            segments, self.mappings, mesh, axis, self.engines[0].params
+        )
 
     @property
     def num_docs(self) -> int:
-        return self.engine.num_docs
+        return sum(e.num_docs for e in self.engines)
 
 
 class Node:
@@ -131,17 +208,46 @@ class Node:
                 "durability", "request"
             )
         )
-        engine = Engine(
-            mappings,
-            params=params,
-            data_path=self._index_dir(name),
-            durability=durability,
-        )
+        try:
+            n_shards = int(
+                settings.get("index", {}).get("number_of_shards", 1)
+            )
+        except (TypeError, ValueError):
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                "index.number_of_shards must be an integer",
+            ) from None
+        if n_shards < 1 or n_shards > 1024:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                f"index.number_of_shards must be in [1, 1024], got {n_shards}",
+            )
+        idx_dir = self._index_dir(name)
+        engines = []
+        for shard in range(n_shards):
+            shard_path = idx_dir
+            if idx_dir is not None and n_shards > 1:
+                shard_path = os.path.join(idx_dir, f"shard_{shard}")
+            engines.append(
+                Engine(
+                    mappings,
+                    params=params,
+                    data_path=shard_path,
+                    durability=durability,
+                )
+            )
+        search: SearchService | ShardedSearchCoordinator
+        if n_shards == 1:
+            search = SearchService(engines[0], name)
+        else:
+            search = ShardedSearchCoordinator(engines, name)
         svc = IndexService(
             name=name,
             mappings=mappings,
-            engine=engine,
-            search=SearchService(engine, name),
+            engines=engines,
+            search=search,
             settings=settings,
         )
         self.indices[name] = svc
@@ -170,7 +276,8 @@ class Node:
     def delete_index(self, name: str) -> dict:
         if name not in self.indices:
             raise index_not_found(name)
-        self.indices[name].engine.close()
+        for engine in self.indices[name].engines:
+            engine.close()
         del self.indices[name]
         idx_dir = self._index_dir(name)
         if idx_dir is not None and os.path.isdir(idx_dir):
@@ -222,8 +329,13 @@ class Node:
         op_type: str = "index",
     ) -> dict:
         svc = self.get_index(index, auto_create=True)
+        if doc_id is None and svc.n_shards > 1:
+            # Multi-shard: the id must exist before routing (the reference
+            # generates the UUID in TransportBulkAction before routing too).
+            doc_id = svc.next_auto_id()
+        engine = svc.engines[0] if doc_id is None else svc.route(doc_id)
         try:
-            result = svc.engine.index(
+            result = engine.index(
                 source, doc_id, if_seq_no=if_seq_no,
                 if_primary_term=if_primary_term, op_type=op_type,
             )
@@ -236,9 +348,9 @@ class Node:
         except ValueError as e:
             raise ApiError(400, "mapper_parsing_exception", str(e)) from None
         if sync:  # request durability before the ack (bulk syncs once)
-            svc.engine.sync_translog()
+            engine.sync_translog()
         if refresh:
-            svc.engine.refresh()
+            engine.refresh()
         return {
             "_index": index,
             "_id": result["_id"],
@@ -251,7 +363,7 @@ class Node:
 
     def get_doc(self, index: str, doc_id: str) -> dict:
         svc = self.get_index(index)
-        meta = svc.engine.get_with_meta(doc_id)
+        meta = svc.route(doc_id).get_with_meta(doc_id)
         if meta is None:
             return {"_index": index, "_id": doc_id, "found": False}
         return {
@@ -274,8 +386,9 @@ class Node:
         if_primary_term: int | None = None,
     ) -> dict:
         svc = self.get_index(index)
+        engine = svc.route(doc_id)
         try:
-            result = svc.engine.delete(
+            result = engine.delete(
                 doc_id, if_seq_no=if_seq_no, if_primary_term=if_primary_term
             )
         except VersionConflictError as e:
@@ -285,9 +398,9 @@ class Node:
         except InvalidCasError as e:
             raise ApiError(400, "illegal_argument_exception", str(e)) from None
         if sync:
-            svc.engine.sync_translog()
+            engine.sync_translog()
         if refresh:
-            svc.engine.refresh()
+            engine.refresh()
         status = "deleted" if result["result"] == "deleted" else "not_found"
         return {
             "_index": index,
@@ -316,8 +429,9 @@ class Node:
         # (the reference achieves this with a seqno CAS + retry loop in
         # TransportUpdateAction; holding the engine write lock is the
         # single-process equivalent).
-        with svc.engine.lock:
-            existing = svc.engine.get(doc_id)
+        engine = svc.route(doc_id)
+        with engine.lock:
+            existing = engine.get(doc_id)
             if existing is None:
                 if "upsert" in body:
                     # The upsert document is indexed as-is when the doc is
@@ -336,7 +450,7 @@ class Node:
                 merged = dict(existing)
                 merged.update(body.get("doc", {}))
             try:
-                result = svc.engine.index(
+                result = engine.index(
                     merged, doc_id, if_seq_no=if_seq_no,
                     if_primary_term=if_primary_term,
                 )
@@ -349,9 +463,9 @@ class Node:
                     400, "illegal_argument_exception", str(e)
                 ) from None
         if sync:
-            svc.engine.sync_translog()
+            engine.sync_translog()
         if refresh:
-            svc.engine.refresh()
+            engine.refresh()
         return {
             "_index": index,
             "_id": doc_id,
@@ -430,11 +544,13 @@ class Node:
                 )
         for index in touched:  # one fsync per bulk request, not per item
             if index in self.indices:
-                self.indices[index].engine.sync_translog()
+                for engine in self.indices[index].engines:
+                    engine.sync_translog()
         if refresh:
             for index in touched:
                 if index in self.indices:
-                    self.indices[index].engine.refresh()
+                    for engine in self.indices[index].engines:
+                        engine.refresh()
         return {
             "took": int((time.monotonic() - t0) * 1000),
             "errors": errors,
@@ -463,17 +579,22 @@ class Node:
 
     def refresh(self, index: str) -> dict:
         svc = self.get_index(index)
-        svc.engine.refresh()
-        return {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+        for engine in svc.engines:
+            engine.refresh()
+        n = svc.n_shards
+        return {"_shards": {"total": n, "successful": n, "failed": 0}}
 
     def flush(self, index: str) -> dict:
         svc = self.get_index(index)
-        svc.engine.flush()
-        return {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+        for engine in svc.engines:
+            engine.flush()
+        n = svc.n_shards
+        return {"_shards": {"total": n, "successful": n, "failed": 0}}
 
     def close(self) -> None:
         for svc in self.indices.values():
-            svc.engine.close()
+            for engine in svc.engines:
+                engine.close()
 
     # ---------------------------------------------------------------- admin
 
@@ -484,8 +605,10 @@ class Node:
             "timed_out": False,
             "number_of_nodes": 1,
             "number_of_data_nodes": 1,
-            "active_primary_shards": len(self.indices),
-            "active_shards": len(self.indices),
+            "active_primary_shards": sum(
+                s.n_shards for s in self.indices.values()
+            ),
+            "active_shards": sum(s.n_shards for s in self.indices.values()),
             "relocating_shards": 0,
             "initializing_shards": 0,
             "unassigned_shards": 0,
@@ -498,7 +621,7 @@ class Node:
                 "health": "green",
                 "status": "open",
                 "index": name,
-                "pri": "1",
+                "pri": str(svc.n_shards),
                 "rep": "0",
                 "docs.count": str(svc.num_docs),
             }

@@ -84,6 +84,18 @@ class ShardedIndex:
     docs_per_shard: int  # padded per-shard doc capacity (global id stride)
     params: BM25Params
     _stats_cache: dict[str, FieldStats] | None = None
+    _id_indexes: list[dict[str, int] | None] | None = None
+
+    def _id_index(self, shard: int) -> dict[str, int]:
+        """Memoized _id -> local map per shard (the index is an immutable
+        snapshot, so building it once per shard suffices)."""
+        if self._id_indexes is None:
+            self._id_indexes = [None] * len(self.segments)
+        if self._id_indexes[shard] is None:
+            self._id_indexes[shard] = {
+                d: i for i, d in enumerate(self.segments[shard].ids)
+            }
+        return self._id_indexes[shard]
 
     @classmethod
     def from_docs(
@@ -196,7 +208,7 @@ class ShardedIndex:
         """Compile per shard with uniform buckets; stack arrays on axis 0."""
         stats = self.field_stats()
 
-        def shard_compiler(seg: Segment, floor: int) -> Compiler:
+        def shard_compiler(seg: Segment, floor: int, shard: int) -> Compiler:
             # Host-side planning view over the same offsets the device sees.
             fields = {}
             for name, fld in seg.fields.items():
@@ -231,21 +243,19 @@ class ShardedIndex:
                 params=self.params,
                 stats=stats,
                 nt_floor=floor,
-                id_index=lambda s=seg: {
-                    d: i for i, d in enumerate(s.ids)
-                },
+                id_index=lambda s=shard: self._id_index(s),
             )
 
         first = [
-            shard_compiler(seg, nt_floor).compile(query)
-            for seg in self.segments
+            shard_compiler(seg, nt_floor, i).compile(query)
+            for i, seg in enumerate(self.segments)
         ]
         specs_match = len({c.spec for c in first}) == 1
         if not specs_match:
             nt_max = max(_max_nt(c.spec) for c in first)
             first = [
-                shard_compiler(seg, nt_max).compile(query)
-                for seg in self.segments
+                shard_compiler(seg, nt_max, i).compile(query)
+                for i, seg in enumerate(self.segments)
             ]
             if len({c.spec for c in first}) != 1:
                 raise AssertionError(

@@ -1,0 +1,135 @@
+"""Cross-shard search coordination: scatter, merge, reduce.
+
+The single-process analog of the reference's coordinator node path —
+AbstractSearchAsyncAction fans per-shard query-phase requests out over the
+transport and SearchPhaseController.merge reduces per-shard top docs
+(action/search/AbstractSearchAsyncAction.java:280,
+action/search/SearchPhaseController.java:398). Here the "transport" is a
+direct call into each shard's SearchService; the merge keeps the same
+contract: per-shard top-(from+size), merged by (sort key, shard index,
+per-shard rank), then paged.
+
+Statistics: the coordinator aggregates term statistics across every
+shard's segments and pushes them down (the DFS phase, DfsPhase.java:31,
+always on) so scores are independent of routing — stricter than the
+reference's query_then_fetch default, identical to its
+dfs_query_then_fetch.
+
+Aggregations run as ONE Aggregator whose handle snapshot spans every
+shard (per-segment device execution, one cross-shard host reduce) —
+matching the coordinator-side InternalAggregations.topLevelReduce.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from ..query.compile import aggregate_field_stats
+from .service import SearchRequest, SearchResponse, SearchService
+
+if TYPE_CHECKING:
+    from ..index.engine import Engine
+
+
+class ShardedSearchCoordinator:
+    """Serves search requests over N shard engines of one index."""
+
+    def __init__(self, engines: list["Engine"], index_name: str = "index"):
+        self.engines = engines
+        self.index_name = index_name
+        self.services = [
+            SearchService(e, index_name) for e in engines
+        ]
+        self._stats_cache = None
+        self._stats_gen: tuple = ()
+
+    def global_stats(self, snapshots: list[list] | None = None):
+        """Index-wide statistics across all shards' segments, cached per
+        engine refresh generation (monotonic — id()-based keys are unsafe
+        after GC address reuse)."""
+        gen = tuple(e.generation for e in self.engines)
+        if self._stats_cache is None or gen != self._stats_gen:
+            if snapshots is None:
+                snapshots = [list(e.segments) for e in self.engines]
+            self._stats_cache = aggregate_field_stats(
+                [h.segment for snap in snapshots for h in snap]
+            )
+            self._stats_gen = gen
+        return self._stats_cache
+
+    def search(self, request: SearchRequest) -> SearchResponse:
+        import time
+
+        start = time.monotonic()
+        # One segment snapshot per shard, pinned for the whole request —
+        # the agg pass and every shard's hits pass must see the same view
+        # (the per-shard SearchService pins the same way for one shard).
+        snapshots = [list(e.segments) for e in self.engines]
+        stats = self.global_stats(snapshots)
+        self.services[0]._validate_sort(request)
+        k = max(0, request.from_) + max(0, request.size)
+
+        aggregations = None
+        agg_total = None
+        if request.aggs is not None:
+            from .aggs import Aggregator
+
+            handles = [h for snap in snapshots for h in snap]
+            agg_total, aggregations = Aggregator(
+                self.engines[0], request.aggs, handles=handles
+            ).run(request.query, stats=stats)
+
+        shard_request = replace(
+            request, from_=0, size=k, aggs=None
+        )
+        merged: list[tuple] = []
+        total = 0
+        max_score = None
+        for shard_idx, svc in enumerate(self.services):
+            if k > 0 or agg_total is None:
+                resp = svc.search(
+                    shard_request, stats=stats, segments=snapshots[shard_idx]
+                )
+                total += resp.total
+                if resp.max_score is not None:
+                    max_score = (
+                        resp.max_score
+                        if max_score is None
+                        else max(max_score, resp.max_score)
+                    )
+                for rank, hit in enumerate(resp.hits):
+                    merged.append(
+                        (self._merge_key(request, hit), shard_idx, rank, hit)
+                    )
+        if agg_total is not None:
+            total = agg_total
+
+        merged.sort(key=lambda t: (t[0], t[1], t[2]))
+        page = merged[request.from_ : request.from_ + request.size]
+        took = int((time.monotonic() - start) * 1000)
+        return SearchResponse(
+            took_ms=took,
+            total=total,
+            total_relation="eq",
+            max_score=max_score,
+            hits=[hit for _, _, _, hit in page],
+            aggregations=aggregations,
+            shards=len(self.engines),
+        )
+
+    @staticmethod
+    def _merge_key(request: SearchRequest, hit) -> float:
+        """Scalar merge key matching the shard-local ordering contract."""
+        if request.sort is None:
+            return -hit.score if hit.score is not None else np.inf
+        ((sort_field, order),) = request.sort[0].items()
+        if sort_field == "_score":
+            s = hit.score if hit.score is not None else 0.0
+            return s if order == "asc" else -s
+        value = hit.sort[0] if hit.sort else None
+        if value is None:
+            return np.inf  # missing sorts last
+        return -value if order == "desc" else value

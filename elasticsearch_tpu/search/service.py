@@ -64,12 +64,18 @@ class SearchResponse:
     max_score: float | None
     hits: list[SearchHit]
     aggregations: dict[str, Any] | None = None
+    shards: int = 1
 
     def to_json(self, index_name: str = "index") -> dict[str, Any]:
         out = {
             "took": self.took_ms,
             "timed_out": False,
-            "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
+            "_shards": {
+                "total": self.shards,
+                "successful": self.shards,
+                "skipped": 0,
+                "failed": 0,
+            },
             "hits": {
                 "total": {"value": self.total, "relation": self.total_relation},
                 "max_score": self.max_score,
@@ -196,16 +202,33 @@ class SearchService:
         self.engine = engine
         self.index_name = index_name
 
-    def search(self, request: SearchRequest) -> SearchResponse:
+    def search(
+        self,
+        request: SearchRequest,
+        stats: dict[str, FieldStats] | None = None,
+        segments: list | None = None,
+    ) -> SearchResponse:
+        """Execute one request against this shard.
+
+        `stats` overrides the statistics scope: the sharded coordinator
+        passes index-global statistics (the reference's DFS phase /
+        AggregatedDfs, search/dfs/DfsPhase.java:31) so scores are routing-
+        independent; default is shard-local, ES query_then_fetch parity.
+        `segments` pins an explicit segment snapshot (the coordinator
+        shares one snapshot between its agg pass and every shard's hits
+        pass).
+        """
         start = time.monotonic()
         k = max(0, request.from_) + max(0, request.size)
-        stats = self.engine.field_stats()
+        if stats is None:
+            stats = self.engine.field_stats()
         self._validate_sort(request)
 
         # One segment snapshot shared by the agg pass and the hits pass —
         # a concurrent refresh must not desynchronize totals from hits
         # (the reference pins one IndexReader per request the same way).
-        segments = list(self.engine.segments)
+        if segments is None:
+            segments = list(self.engine.segments)
 
         aggregations = None
         agg_total = None

@@ -1,0 +1,251 @@
+"""number_of_shards wired through Node/REST: routing, coordinator merge,
+parity vs a single-shard index, persistence, and the mesh snapshot.
+
+Matches VERDICT item 4: an 8-shard index created over HTTP serves searches
+with parity vs 1-shard (reference: OperationRouting.java:245 routing,
+SearchPhaseController.java:398 coordinator merge).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.server import RestServer
+
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "rank": {"type": "long"},
+    }
+}
+
+WORDS = ["ant", "bee", "cat", "dog", "elk", "fox", "gnu", "hen"]
+
+
+def make_docs(n=120, seed=3):
+    rng = np.random.default_rng(seed)
+    docs = []
+    for i in range(n):
+        docs.append(
+            (
+                f"doc{i}",
+                {
+                    "body": " ".join(rng.choice(WORDS, rng.integers(2, 9))),
+                    "tag": str(rng.choice(["x", "y", "z"])),
+                    "rank": int(rng.integers(0, 500)),
+                },
+            )
+        )
+    return docs
+
+
+def load(node, index, docs, n_shards):
+    node.create_index(
+        index,
+        {
+            "settings": {"index": {"number_of_shards": n_shards}},
+            "mappings": MAPPINGS,
+        },
+    )
+    for doc_id, src in docs:
+        node.index_doc(index, src, doc_id)
+    node.refresh(index)
+
+
+@pytest.fixture(scope="module")
+def nodes():
+    docs = make_docs()
+    node = Node()
+    load(node, "one", docs, 1)
+    load(node, "eight", docs, 8)
+    return node, docs
+
+
+def test_shards_receive_disjoint_docs(nodes):
+    node, docs = nodes
+    svc = node.get_index("eight")
+    assert svc.n_shards == 8
+    per_shard = [e.num_docs for e in svc.engines]
+    assert sum(per_shard) == len(docs)
+    assert sum(1 for c in per_shard if c > 0) > 4  # murmur3 spreads
+
+
+def test_search_parity_one_vs_eight_shards(nodes):
+    node, docs = nodes
+    for body in [
+        {"query": {"match": {"body": "ant bee"}}, "size": 15},
+        {"query": {"bool": {"must": [{"match": {"body": "cat"}}],
+                            "filter": [{"term": {"tag": "x"}}]}}, "size": 10},
+        {"query": {"match_phrase": {"body": "fox gnu"}}, "size": 10},
+        {"query": {"range": {"rank": {"gte": 100, "lt": 300}}}, "size": 10},
+        {"query": {"match": {"body": "dog"}}, "size": 7,
+         "sort": [{"rank": "desc"}]},
+        {"query": {"match_all": {}}, "size": 5, "from": 10,
+         "sort": [{"rank": "asc"}]},
+    ]:
+        r1 = node.search("one", body)
+        r8 = node.search("eight", body)
+        assert r8["hits"]["total"]["value"] == r1["hits"]["total"]["value"]
+        s1 = [h["_score"] for h in r1["hits"]["hits"]]
+        s8 = [h["_score"] for h in r8["hits"]["hits"]]
+        assert s8 == s1  # global (DFS) stats: scores routing-independent
+        if "sort" in body:
+            assert [h["sort"] for h in r8["hits"]["hits"]] == [
+                h["sort"] for h in r1["hits"]["hits"]
+            ]
+        # id parity modulo tie order: equal-key groups can legitimately
+        # truncate to different members at the k boundary (the tie-break is
+        # (key, shard, doc) and shard structure differs), so compare ids of
+        # every NON-boundary key group.
+        def keyed(hits):
+            out = {}
+            for h in hits:
+                key = tuple(h.get("sort") or []) or h["_score"]
+                out.setdefault(key, set()).add(h["_id"])
+            return out
+
+        h1, h8 = r1["hits"]["hits"], r8["hits"]["hits"]
+        k1, k8 = keyed(h1), keyed(h8)
+        if h1:
+            last1 = tuple(h1[-1].get("sort") or []) or h1[-1]["_score"]
+            last8 = tuple(h8[-1].get("sort") or []) or h8[-1]["_score"]
+            for key in set(k1) & set(k8) - {last1, last8}:
+                assert k1[key] == k8[key]
+
+
+def test_aggregations_across_shards(nodes):
+    node, docs = nodes
+    body = {
+        "size": 0,
+        "aggs": {
+            "tags": {"terms": {"field": "tag"}},
+            "ranks": {"histogram": {"field": "rank", "interval": 100}},
+            "avg_rank": {"avg": {"field": "rank"}},
+        },
+    }
+    r1 = node.search("one", body)
+    r8 = node.search("eight", body)
+    assert r8["aggregations"]["tags"] == r1["aggregations"]["tags"]
+    assert r8["aggregations"]["ranks"] == r1["aggregations"]["ranks"]
+    assert r8["aggregations"]["avg_rank"]["value"] == pytest.approx(
+        r1["aggregations"]["avg_rank"]["value"], rel=1e-6
+    )
+
+
+def test_document_apis_route_correctly(nodes):
+    node, docs = nodes
+    # realtime get before and after refresh
+    resp = node.get_doc("eight", "doc5")
+    assert resp["found"] and resp["_source"] == dict(docs[5][1])
+    upd = node.update_doc("eight", "doc5", {"doc": {"rank": 9999}})
+    assert upd["result"] == "updated"
+    assert node.get_doc("eight", "doc5")["_source"]["rank"] == 9999
+    # restore for other tests
+    node.update_doc("eight", "doc5", {"doc": docs[5][1]})
+    resp = node.index_doc("eight", {"body": "zzz"},
+                          None)  # auto-id routes
+    assert resp["result"] == "created"
+    got = node.get_doc("eight", resp["_id"])
+    assert got["found"]
+    node.delete_doc("eight", resp["_id"])
+
+
+def test_rest_multi_shard_end_to_end():
+    rest = RestServer()
+    docs = make_docs(40, seed=9)
+    status, _ = rest.dispatch(
+        "PUT",
+        "/r8",
+        {},
+        json.dumps(
+            {
+                "settings": {"index": {"number_of_shards": 8}},
+                "mappings": MAPPINGS,
+            }
+        ),
+    )
+    assert status == 200
+    lines = []
+    for doc_id, src in docs:
+        lines.append(json.dumps({"index": {"_id": doc_id}}))
+        lines.append(json.dumps(src))
+    status, resp = rest.dispatch(
+        "POST", "/r8/_bulk", {"refresh": "true"}, "\n".join(lines)
+    )
+    assert status == 200 and not resp["errors"]
+    status, resp = rest.dispatch(
+        "POST", "/r8/_search", {}, json.dumps({"query": {"match": {"body": "ant"}}})
+    )
+    assert status == 200
+    assert resp["_shards"]["total"] == 8
+    expected = len(
+        [1 for _, s in docs if "ant" in s["body"].split()]
+    )
+    assert resp["hits"]["total"]["value"] == expected
+    status, cat = rest.dispatch("GET", "/_cat/indices", {}, "")
+    assert any(row["index"] == "r8" and row["pri"] == "8" for row in cat)
+
+
+def test_sharded_persistence_and_recovery(tmp_path):
+    docs = make_docs(30, seed=21)
+    node = Node(data_path=str(tmp_path))
+    load(node, "p4", docs, 4)
+    node.flush("p4")
+    node.close()
+
+    node2 = Node(data_path=str(tmp_path))
+    svc = node2.get_index("p4")
+    assert svc.n_shards == 4
+    assert svc.num_docs == len(docs)
+    r = node2.search("p4", {"query": {"match_all": {}}, "size": 0})
+    assert r["hits"]["total"]["value"] == len(docs)
+    got = node2.get_doc("p4", "doc3")
+    assert got["found"]
+    node2.close()
+
+
+def test_invalid_shard_count_rejected():
+    node = Node()
+    from elasticsearch_tpu.node import ApiError
+
+    with pytest.raises(ApiError):
+        node.create_index(
+            "bad", {"settings": {"index": {"number_of_shards": 0}}}
+        )
+    with pytest.raises(ApiError):
+        node.create_index(
+            "bad2", {"settings": {"index": {"number_of_shards": "nope"}}}
+        )
+
+
+def test_mesh_snapshot_matches_coordinator():
+    import jax
+    from jax.sharding import Mesh
+
+    # Fresh index: the snapshot rebuilds segments from live docs, so its
+    # term statistics exclude tombstones while the engine path keeps them
+    # until merge (both are legitimate Lucene states — parity needs a
+    # tombstone-free index).
+    node = Node()
+    docs = make_docs(80, seed=31)
+    load(node, "mesh8", docs, 8)
+    svc = node.get_index("mesh8")
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shard",))
+    snap = svc.mesh_snapshot(mesh)
+    from elasticsearch_tpu.query.dsl import parse_query
+
+    body = {"match": {"body": "bee cat"}}
+    scores, gids, total = snap.search(parse_query(body), k=12)
+    host = node.search("mesh8", {"query": body, "size": 12})
+    assert total == host["hits"]["total"]["value"]
+    mesh_ids = {
+        snap.segments[s].ids[l] for s, l in (snap.locate(g) for g in gids)
+    }
+    assert mesh_ids == {h["_id"] for h in host["hits"]["hits"]}
+    np.testing.assert_array_equal(
+        scores, np.array([h["_score"] for h in host["hits"]["hits"]],
+                         dtype=np.float32),
+    )
