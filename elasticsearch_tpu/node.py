@@ -47,6 +47,22 @@ def index_not_found(name: str) -> ApiError:
     return ApiError(404, "index_not_found_exception", f"no such index [{name}]")
 
 
+_KEEPALIVE_RE = re.compile(r"^(\d+)(ms|s|m|h|d)$")
+_KEEPALIVE_UNIT_S = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+
+
+def _parse_keepalive(value: str) -> float:
+    """ES time value ('30s', '1m', ...) → seconds."""
+    m = _KEEPALIVE_RE.match(str(value))
+    if not m:
+        raise ApiError(
+            400,
+            "illegal_argument_exception",
+            f"failed to parse time value [{value}]",
+        )
+    return int(m.group(1)) * _KEEPALIVE_UNIT_S[m.group(2)]
+
+
 _INDEX_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9_\-.]*$")
 
 
@@ -68,6 +84,7 @@ class IndexService:
     created_at: float = field(default_factory=time.time)
     _auto_counter: int = -1  # lazy-initialized from recovered engines
     _auto_lock: threading.Lock = field(default_factory=threading.Lock)
+    scroll_coordinator: Any = None  # cached 1-shard scroll coordinator
 
     @property
     def engine(self) -> Engine:
@@ -149,6 +166,11 @@ class Node:
         self.cluster_name = cluster_name
         self.data_path = data_path
         self.indices: dict[str, IndexService] = {}
+        # Live scroll contexts (search/SearchService.java:167 analog);
+        # bounded like the reference's search.max_open_scroll_context.
+        self._scrolls: dict[str, Any] = {}
+        self._scroll_lock = threading.Lock()
+        self.max_open_scrolls = 500
         if data_path is not None:
             os.makedirs(data_path, exist_ok=True)
             self._recover_indices()
@@ -559,10 +581,17 @@ class Node:
 
     # --------------------------------------------------------------- search
 
-    def search(self, index: str, body: dict[str, Any] | None) -> dict:
+    def search(
+        self,
+        index: str,
+        body: dict[str, Any] | None,
+        scroll: str | None = None,
+    ) -> dict:
         svc = self.get_index(index)
         try:
             request = SearchRequest.from_json(body)
+            if scroll is not None:
+                return self._start_scroll(svc, index, request, scroll)
             response = svc.search.search(request)
         except ValueError as e:
             raise ApiError(400, "search_phase_execution_exception", str(e)) from None
@@ -571,11 +600,216 @@ class Node:
     def count(self, index: str, body: dict[str, Any] | None) -> dict:
         body = dict(body or {})
         body["size"] = 0
+        body["track_total_hits"] = True  # _count is always exact
         result = self.search(index, body)
+        svc = self.get_index(index)
+        n = svc.n_shards
         return {
             "count": result["hits"]["total"]["value"],
-            "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
+            "_shards": {"total": n, "successful": n, "skipped": 0, "failed": 0},
         }
+
+    # --------------------------------------------------------------- scroll
+
+    def _coordinator_for(self, svc: IndexService):
+        if isinstance(svc.search, ShardedSearchCoordinator):
+            return svc.search
+        if svc.scroll_coordinator is None:
+            # Cached: a fresh coordinator per scroll would recompute the
+            # cross-segment statistics aggregate every open.
+            svc.scroll_coordinator = ShardedSearchCoordinator(
+                svc.engines, svc.name
+            )
+        return svc.scroll_coordinator
+
+    def _purge_scrolls(self) -> None:
+        now = time.monotonic()
+        with self._scroll_lock:
+            expired = [
+                sid for sid, ctx in self._scrolls.items() if ctx.deadline < now
+            ]
+            for sid in expired:
+                del self._scrolls[sid]
+
+    def _start_scroll(
+        self, svc: IndexService, index: str, request, scroll: str
+    ) -> dict:
+        import uuid
+
+        if request.from_:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                "[from] is not supported in a scroll context",
+            )
+        if request.rescore:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                "[rescore] is not supported in a scroll context",
+            )
+        if request.size <= 0:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                "[size] cannot be [0] in a scroll context",
+            )
+        self._purge_scrolls()
+        coord = self._coordinator_for(svc)
+        ctx = coord.open_scroll(index, request, _parse_keepalive(scroll))
+        scroll_id = uuid.uuid4().hex
+        # Atomic check-and-insert enforces the cap exactly; the context is
+        # registered before the first page so a failure cleans it up.
+        with self._scroll_lock:
+            if len(self._scrolls) >= self.max_open_scrolls:
+                raise ApiError(
+                    429,
+                    "too_many_scroll_contexts_exception",
+                    f"exceeded {self.max_open_scrolls} open scroll contexts",
+                )
+            self._scrolls[scroll_id] = ctx
+        try:
+            # Aggregations compute once, on the initial page (ES contract).
+            aggregations = None
+            if request.aggs is not None:
+                from .search.aggs import Aggregator
+
+                handles = [h for snap in ctx.snapshots for h in snap]
+                _, aggregations = Aggregator(
+                    svc.engines[0], request.aggs, handles=handles
+                ).run(request.query, stats=ctx.stats)
+            with ctx.lock:
+                page = coord.scroll_page(ctx)
+        except Exception:
+            with self._scroll_lock:
+                self._scrolls.pop(scroll_id, None)
+            raise
+        page.scroll_id = scroll_id
+        page.aggregations = aggregations
+        return page.to_json(index)
+
+    def scroll(self, body: dict[str, Any]) -> dict:
+        scroll_id = body.get("scroll_id")
+        if not scroll_id:
+            raise ApiError(
+                400, "illegal_argument_exception", "scroll_id is required"
+            )
+        self._purge_scrolls()
+        with self._scroll_lock:
+            ctx = self._scrolls.get(scroll_id)
+        if ctx is None:
+            raise ApiError(
+                404,
+                "search_context_missing_exception",
+                f"No search context found for id [{scroll_id}]",
+            )
+        if body.get("scroll"):
+            ctx.deadline = time.monotonic() + _parse_keepalive(body["scroll"])
+        with ctx.lock:  # concurrent use of one scroll id serializes
+            page = ctx.coordinator.scroll_page(ctx)
+        page.scroll_id = scroll_id
+        return page.to_json(ctx.index)
+
+    def clear_scroll(self, body: dict[str, Any]) -> dict:
+        ids = body.get("scroll_id", [])
+        if isinstance(ids, str):
+            ids = [ids]
+        freed = 0
+        with self._scroll_lock:
+            if ids == ["_all"]:
+                freed = len(self._scrolls)
+                self._scrolls.clear()
+            else:
+                for sid in ids:
+                    if self._scrolls.pop(sid, None) is not None:
+                        freed += 1
+        return {"succeeded": True, "num_freed": freed}
+
+    # ------------------------------------------------------- msearch / mget
+
+    def msearch(self, body: str, default_index: str | None = None) -> dict:
+        """NDJSON multi-search: header/body line pairs, per-item outcomes
+        (action/search/MultiSearchRequest.java:52)."""
+        t0 = time.monotonic()
+        lines = [ln for ln in body.split("\n") if ln.strip()]
+        if len(lines) % 2:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                "multi-search body must be header/body line pairs",
+            )
+        responses = []
+        for i in range(0, len(lines), 2):
+            try:
+                header = json.loads(lines[i])
+                search_body = json.loads(lines[i + 1])
+            except json.JSONDecodeError as e:
+                raise ApiError(
+                    400, "parsing_exception", f"malformed msearch line: {e}"
+                ) from None
+            index = header.get("index", default_index)
+            if isinstance(index, list):
+                # ES accepts index arrays; this node serves one index per
+                # item (multi-index search is a coordinator feature).
+                index = index[0] if len(index) == 1 else index
+            try:
+                if not isinstance(index, str):
+                    raise ApiError(
+                        400,
+                        "illegal_argument_exception",
+                        "msearch item requires exactly one index",
+                    )
+                item = self.search(index, search_body)
+                item["status"] = 200
+            except ApiError as e:
+                item = {
+                    "error": {"type": e.err_type, "reason": e.reason},
+                    "status": e.status,
+                }
+            responses.append(item)
+        return {
+            "took": int((time.monotonic() - t0) * 1000),
+            "responses": responses,
+        }
+
+    def mget(self, body: dict[str, Any], default_index: str | None = None) -> dict:
+        """Multi-get by id (action/get/MultiGetRequest semantics)."""
+        specs = body.get("docs")
+        if specs is None and "ids" in body:
+            specs = [{"_id": i} for i in body["ids"]]
+        if specs is None:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                "mget requires [docs] or [ids]",
+            )
+        docs = []
+        for spec in specs:
+            index = spec.get("_index", default_index)
+            doc_id = spec.get("_id")
+            if index is None or doc_id is None:
+                docs.append(
+                    {
+                        "_index": index,
+                        "_id": doc_id,
+                        "error": {
+                            "type": "illegal_argument_exception",
+                            "reason": "mget doc needs _index and _id",
+                        },
+                    }
+                )
+                continue
+            try:
+                docs.append(self.get_doc(index, doc_id))
+            except ApiError as e:
+                docs.append(
+                    {
+                        "_index": index,
+                        "_id": doc_id,
+                        "error": {"type": e.err_type, "reason": e.reason},
+                    }
+                )
+        return {"docs": docs}
 
     def refresh(self, index: str) -> dict:
         svc = self.get_index(index)

@@ -525,6 +525,72 @@ def execute_auto(seg, spec, arrays, k: int):
     return execute(seg, spec, arrays, k)
 
 
+# ---------------------------------------------------------------------------
+# After-cursor execution (search_after / scroll continuation).
+#
+# The cursor is (after_key, after_doc): a doc qualifies when its key is
+# strictly past the cursor, or ties the cursor key with a LARGER local doc
+# id — the (key, doc id) total order the merge contract uses. A key-only
+# cursor (REST search_after with no _doc tiebreak) passes after_doc =
+# num_docs so the equality clause never fires. Totals stay the FULL match
+# count: ES reports hits.total independent of the cursor.
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("spec", "k", "ascending"))
+def execute_score_after(seg, spec, arrays, k: int, after_score, after_doc,
+                        ascending: bool = False):
+    """Score-ordered top-k strictly after the (score, doc) cursor."""
+    live = seg["live"]
+    num_docs = live.shape[0]
+    scores, matched = _eval_node(spec, arrays, seg, num_docs)
+    eligible = matched & live
+    iota = jnp.arange(num_docs, dtype=jnp.int32)
+    if ascending:
+        past = scores > after_score
+    else:
+        past = scores < after_score
+    keep = eligible & (past | ((scores == after_score) & (iota > after_doc)))
+    if ascending:
+        masked = jnp.where(keep, scores, jnp.float32(jnp.inf))
+        neg_top, top_ids = jax.lax.top_k(-masked, min(k, num_docs))
+        top_scores = -neg_top
+    else:
+        masked = jnp.where(keep, scores, jnp.float32(NEG_INF))
+        top_scores, top_ids = jax.lax.top_k(masked, min(k, num_docs))
+    total = jnp.sum(eligible, dtype=jnp.int32)
+    n_after = jnp.sum(keep, dtype=jnp.int32)
+    return top_scores, top_ids.astype(jnp.int32), total, n_after
+
+
+@partial(jax.jit, static_argnames=("spec", "field_name", "desc", "k"))
+def execute_sorted_after(seg, spec, arrays, field_name: str, desc: bool,
+                         k: int, after_key, after_doc):
+    """Field-sorted top-k strictly after the (key, doc) cursor.
+
+    `after_key` lives in the transformed ascending key space (negated for
+    desc, missing = f32 max) so one comparison covers both directions and
+    the missing-last region."""
+    live = seg["live"]
+    num_docs = live.shape[0]
+    _, matched = _eval_node(spec, arrays, seg, num_docs)
+    eligible = matched & live
+    col = seg["doc_values"][field_name]
+    key = -col if desc else col
+    fmax = jnp.float32(jnp.finfo(jnp.float32).max)
+    key = jnp.where(jnp.isnan(key), fmax, key)
+    iota = jnp.arange(num_docs, dtype=jnp.int32)
+    keep = eligible & (
+        (key > after_key) | ((key == after_key) & (iota > after_doc))
+    )
+    masked = jnp.where(keep, key, jnp.float32(jnp.inf))
+    _neg, ids = jax.lax.top_k(-masked, min(k, num_docs))
+    values = col[ids]
+    total = jnp.sum(eligible, dtype=jnp.int32)
+    n_after = jnp.sum(keep, dtype=jnp.int32)
+    return values, ids.astype(jnp.int32), total, n_after
+
+
 def execute_many(seg, compiled_queries, k: int):
     """Grouped msearch: batch same-spec queries, one launch per shape group.
 

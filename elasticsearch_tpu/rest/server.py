@@ -97,8 +97,10 @@ class RestServer:
             p["index"], _json(b)
         ))
         for method in ("GET", "POST"):
+            r(method, "/_search/scroll", lambda s, p, q, b: n.scroll(_json(b)))
+            r(method, "/_mget", lambda s, p, q, b: n.mget(_json(b)))
             r(method, "/{index}/_search", lambda s, p, q, b: n.search(
-                p["index"], _json(b)
+                p["index"], _json(b), scroll=q.get("scroll")
             ))
             r(method, "/{index}/_count", lambda s, p, q, b: n.count(
                 p["index"], _json(b)
@@ -106,6 +108,16 @@ class RestServer:
             r(method, "/{index}/_rank_eval", lambda s, p, q, b: rank_eval.evaluate(
                 n, p["index"], _json(b)
             ))
+            r(method, "/{index}/_mget", lambda s, p, q, b: n.mget(
+                _json(b), default_index=p["index"]
+            ))
+        r("DELETE", "/_search/scroll", lambda s, p, q, b: n.clear_scroll(
+            _json(b)
+        ))
+        r("POST", "/_msearch", lambda s, p, q, b: n.msearch(b))
+        r("POST", "/{index}/_msearch", lambda s, p, q, b: n.msearch(
+            b, default_index=p["index"]
+        ))
         r("POST", "/{index}/_refresh", lambda s, p, q, b: n.refresh(p["index"]))
         r("GET", "/{index}/_refresh", lambda s, p, q, b: n.refresh(p["index"]))
         r("POST", "/{index}/_flush", lambda s, p, q, b: n.flush(p["index"]))

@@ -22,16 +22,59 @@ matching the coordinator-side InternalAggregations.topLevelReduce.
 
 from __future__ import annotations
 
-from dataclasses import replace
-from typing import TYPE_CHECKING
+import threading
+from dataclasses import dataclass, field, replace
+from typing import TYPE_CHECKING, Any
 
 import numpy as np
 
 from ..query.compile import aggregate_field_stats
-from .service import SearchRequest, SearchResponse, SearchService
+from .service import SearchRequest, SearchResponse, SearchService, clamp_total
 
 if TYPE_CHECKING:
     from ..index.engine import Engine
+
+
+@dataclass
+class ScrollContext:
+    """A scroll cursor: pinned per-shard segment snapshots + statistics +
+    per-shard (sort key, doc) continuation state.
+
+    The analog of the reference's per-shard ReaderContext kept alive by a
+    scroll (search/SearchService.java:167 createAndPutReaderContext). The
+    snapshot handles are FROZEN clones: jax arrays are immutable and
+    deletes replace `device.live` rather than mutating it, so cloning the
+    DeviceSegment with the open-time live array gives point-in-time
+    membership — concurrent deletes/updates/refreshes don't change what
+    the scroll serves. (Scores can still drift if shard-level avgdl moves
+    enough that the engine repacks impacts in place — membership and the
+    cursor order stay stable.) Continuation is cursor-based per shard, so
+    page N costs the same device work as page 1 (no from-offset re-scan).
+    """
+
+    index: str
+    request: SearchRequest  # page-size request, aggs stripped, exact totals
+    snapshots: list[list]
+    stats: dict[str, Any]
+    per_shard_after: list[tuple[Any, int] | None]
+    deadline: float
+    track_total_hits: bool | int = 10_000
+    coordinator: Any = None  # the owning ShardedSearchCoordinator
+    # Serializes concurrent scroll requests on one context (the reference
+    # errors on concurrent use of a scroll id; serializing is stricter).
+    lock: Any = field(default_factory=threading.Lock)
+
+
+def _freeze_handle(handle):
+    """Clone a SegmentHandle pinning its current live mask (device + host)
+    so in-place deletes after the snapshot don't leak into it."""
+    from dataclasses import replace as dc_replace
+
+    return dc_replace(
+        handle,
+        device=dc_replace(handle.device, live=handle.device.live),
+        live_host=handle.live_host.copy(),
+    )
 
 
 class ShardedSearchCoordinator:
@@ -83,40 +126,118 @@ class ShardedSearchCoordinator:
             ).run(request.query, stats=stats)
 
         shard_request = replace(
-            request, from_=0, size=k, aggs=None
+            request, from_=0, size=k, aggs=None, track_total_hits=True
         )
-        merged: list[tuple] = []
-        total = 0
-        max_score = None
-        for shard_idx, svc in enumerate(self.services):
-            if k > 0 or agg_total is None:
-                resp = svc.search(
-                    shard_request, stats=stats, segments=snapshots[shard_idx]
-                )
-                total += resp.total
-                if resp.max_score is not None:
-                    max_score = (
-                        resp.max_score
-                        if max_score is None
-                        else max(max_score, resp.max_score)
-                    )
-                for rank, hit in enumerate(resp.hits):
-                    merged.append(
-                        (self._merge_key(request, hit), shard_idx, rank, hit)
-                    )
+        if k > 0 or agg_total is None:
+            merged, total, max_score = self._scatter_merge(
+                shard_request, stats, snapshots
+            )
+        else:
+            merged, total, max_score = [], 0, None
         if agg_total is not None:
             total = agg_total
 
         merged.sort(key=lambda t: (t[0], t[1], t[2]))
         page = merged[request.from_ : request.from_ + request.size]
         took = int((time.monotonic() - start) * 1000)
+        total_out, relation = clamp_total(total, request.track_total_hits)
         return SearchResponse(
             took_ms=took,
-            total=total,
-            total_relation="eq",
+            total=total_out,
+            total_relation=relation,
             max_score=max_score,
             hits=[hit for _, _, _, hit in page],
             aggregations=aggregations,
+            shards=len(self.engines),
+        )
+
+    def open_scroll(
+        self, index: str, request: SearchRequest, keep_alive_s: float
+    ) -> ScrollContext:
+        """Pin snapshots + stats for a new scroll over this index."""
+        import time
+
+        snapshots = [
+            [_freeze_handle(h) for h in e.segments] for e in self.engines
+        ]
+        return ScrollContext(
+            index=index,
+            request=replace(
+                request, from_=0, aggs=None, track_total_hits=True
+            ),
+            snapshots=snapshots,
+            stats=self.global_stats(snapshots),
+            per_shard_after=[None] * len(self.engines),
+            deadline=time.monotonic() + keep_alive_s,
+            track_total_hits=request.track_total_hits,
+            coordinator=self,
+        )
+
+    def _scatter_merge(
+        self,
+        request: SearchRequest,
+        stats,
+        snapshots: list[list],
+        per_shard_after: list | None = None,
+    ) -> tuple[list[tuple], int, float | None]:
+        """Fan one request out to every shard and merge by
+        (merge key, shard, per-shard rank) — the single implementation of
+        the coordinator reduce contract used by both first-page search and
+        scroll continuation. Returns (sorted merged tuples, total,
+        max_score)."""
+        merged: list[tuple] = []
+        total = 0
+        max_score = None
+        for shard_idx, svc in enumerate(self.services):
+            sub = request
+            after = (
+                per_shard_after[shard_idx] if per_shard_after is not None
+                else None
+            )
+            if after is not None:
+                sub = replace(
+                    request, search_after=[after[0]], after_doc=after[1]
+                )
+            resp = svc.search(sub, stats=stats, segments=snapshots[shard_idx])
+            total += resp.total or 0
+            if resp.max_score is not None:
+                max_score = (
+                    resp.max_score
+                    if max_score is None
+                    else max(max_score, resp.max_score)
+                )
+            for rank, hit in enumerate(resp.hits):
+                merged.append(
+                    (self._merge_key(request, hit), shard_idx, rank, hit)
+                )
+        merged.sort(key=lambda t: (t[0], t[1], t[2]))
+        return merged, total, max_score
+
+    def scroll_page(self, ctx: ScrollContext) -> SearchResponse:
+        """Serve the next page of a scroll and advance its cursors."""
+        import time
+
+        start = time.monotonic()
+        request = ctx.request
+        size = max(0, request.size)
+        merged, total, max_score = self._scatter_merge(
+            request, ctx.stats, ctx.snapshots, ctx.per_shard_after
+        )
+        page = merged[:size]
+        for _, shard_idx, _, hit in page:
+            cursor_value = (
+                hit.sort[0]
+                if request.sort is not None and hit.sort
+                else hit.score
+            )
+            ctx.per_shard_after[shard_idx] = (cursor_value, hit.global_doc)
+        total_out, relation = clamp_total(total, ctx.track_total_hits)
+        return SearchResponse(
+            took_ms=int((time.monotonic() - start) * 1000),
+            total=total_out,
+            total_relation=relation,
+            max_score=max_score,
+            hits=[hit for _, _, _, hit in page],
             shards=len(self.engines),
         )
 

@@ -59,14 +59,24 @@ class SearchHit:
 @dataclass
 class SearchResponse:
     took_ms: int
-    total: int
+    total: int | None  # None = untracked (track_total_hits: false)
     total_relation: str
     max_score: float | None
     hits: list[SearchHit]
     aggregations: dict[str, Any] | None = None
     shards: int = 1
+    scroll_id: str | None = None
 
     def to_json(self, index_name: str = "index") -> dict[str, Any]:
+        hits_obj: dict[str, Any] = {
+            "max_score": self.max_score,
+            "hits": [h.to_json(index_name) for h in self.hits],
+        }
+        if self.total is not None:
+            hits_obj = {
+                "total": {"value": self.total, "relation": self.total_relation},
+                **hits_obj,
+            }
         out = {
             "took": self.took_ms,
             "timed_out": False,
@@ -76,15 +86,25 @@ class SearchResponse:
                 "skipped": 0,
                 "failed": 0,
             },
-            "hits": {
-                "total": {"value": self.total, "relation": self.total_relation},
-                "max_score": self.max_score,
-                "hits": [h.to_json(index_name) for h in self.hits],
-            },
+            "hits": hits_obj,
         }
+        if self.scroll_id is not None:
+            out["_scroll_id"] = self.scroll_id
         if self.aggregations is not None:
             out["aggregations"] = self.aggregations
         return out
+
+
+def clamp_total(total: int, track_total_hits) -> tuple[int | None, str]:
+    """(reported total, relation) under the track_total_hits contract."""
+    if track_total_hits is False:
+        return None, "eq"
+    if track_total_hits is True:
+        return total, "eq"
+    threshold = int(track_total_hits)
+    if total > threshold:
+        return threshold, "gte"
+    return total, "eq"
 
 
 @dataclass
@@ -131,6 +151,15 @@ class SearchRequest:
     sort: list[dict[str, str]] | None = None  # [{"field": "asc"|"desc"}]
     rescore: list[Rescore] = field(default_factory=list)
     aggs: list[Any] | None = None  # list[aggs.AggNode]
+    # Pagination cursor (search_after / scroll): the sort-key value of the
+    # last consumed hit, plus an optional doc-id tiebreak (engine-global
+    # doc id; -1 = key-only cursor, the public search_after form).
+    search_after: list[Any] | None = None
+    after_doc: int = -1
+    # hits.total accounting: True = exact, False = untracked (omitted),
+    # int = exact up to the threshold then ("gte", threshold). ES default
+    # is 10_000 (search/internal/SearchContext TRACK_TOTAL_HITS_UP_TO).
+    track_total_hits: bool | int = 10_000
 
     @classmethod
     def from_json(cls, body: dict[str, Any] | None) -> "SearchRequest":
@@ -181,6 +210,27 @@ class SearchRequest:
         source = body.get("_source", True)
         if isinstance(source, str):  # ES accepts a single field name/pattern
             source = [source]
+        search_after = body.get("search_after")
+        if search_after is not None:
+            if not isinstance(search_after, list) or len(search_after) != 1:
+                raise ValueError(
+                    "search_after must be a one-element array matching the "
+                    "sort (multi-key sort is not supported yet)"
+                )
+            if sort is None:
+                raise ValueError(
+                    "search_after requires a sort to be specified"
+                )
+            if rescore:
+                raise ValueError("cannot use [rescore] with [search_after]")
+            if int(body.get("from", 0)) > 0:
+                raise ValueError(
+                    "[from] parameter must be set to 0 when [search_after] "
+                    "is used"
+                )
+        tth = body.get("track_total_hits", 10_000)
+        if not isinstance(tth, bool):
+            tth = int(tth)
         return cls(
             query=query,
             size=int(body.get("size", 10)),
@@ -189,6 +239,8 @@ class SearchRequest:
             sort=sort,
             rescore=rescore,
             aggs=aggs,
+            search_after=search_after,
+            track_total_hits=tth,
         )
 
 
@@ -274,10 +326,11 @@ class SearchService:
                 )
             )
         took = int((time.monotonic() - start) * 1000)
+        total_out, relation = clamp_total(total, request.track_total_hits)
         return SearchResponse(
             took_ms=took,
-            total=total,
-            total_relation="eq",
+            total=total_out,
+            total_relation=relation,
             max_score=max_score,
             hits=hits,
             aggregations=aggregations,
@@ -324,27 +377,51 @@ class SearchService:
             ((sort_field, order),) = request.sort[0].items()
             descending = order == "desc"
 
+        cursor = request.search_after
         if sort_field is None or sort_field == "_score":
             ascending_score = sort_field == "_score" and not descending
             fetch_k = k
             if request.rescore and not ascending_score:
                 fetch_k = max(k, max(r.window_size for r in request.rescore))
-            if ascending_score:
+            if cursor is not None:
+                # Cursor pagination: mask docs at or before the (score, doc)
+                # cursor BEFORE the device top-k — the next page may lie
+                # beyond this segment's uncursored top-k.
+                a_doc = (
+                    request.after_doc - handle.base
+                    if request.after_doc >= 0
+                    else handle.device.num_docs  # key-only: no tie clause
+                )
+                scores, ids, tot, n_after = bm25_device.execute_score_after(
+                    seg_tree,
+                    compiled.spec,
+                    compiled.arrays,
+                    k,
+                    np.float32(cursor[0]),
+                    np.int32(a_doc),
+                    ascending=ascending_score,
+                )
+                scores, ids = np.asarray(scores), np.asarray(ids)
+                n = min(k, int(n_after), len(ids))
+                tot = int(tot)
+            elif ascending_score:
                 # Bottom-k needs its own device reduction — the default
                 # top-k collector would never see the lowest-scoring hits.
                 scores, ids, tot = bm25_device.execute_score_asc(
                     seg_tree, compiled.spec, compiled.arrays, k
                 )
+                scores, ids = np.asarray(scores), np.asarray(ids)
+                n = min(k, int(tot), len(ids))
             else:
                 scores, ids, tot = bm25_device.execute_auto(
                     seg_tree, compiled.spec, compiled.arrays, fetch_k
                 )
-            scores, ids = np.asarray(scores), np.asarray(ids)
-            if request.rescore and not ascending_score:
-                scores, ids = self._apply_rescore(
-                    handle, seg_tree, request, scores, ids, int(tot), stats
-                )
-            n = min(k, int(tot), len(ids))
+                scores, ids = np.asarray(scores), np.asarray(ids)
+                if request.rescore:
+                    scores, ids = self._apply_rescore(
+                        handle, seg_tree, request, scores, ids, int(tot), stats
+                    )
+                n = min(k, int(tot), len(ids))
             for rank in range(n):
                 score = float(scores[rank])
                 local = int(ids[rank])
@@ -365,16 +442,54 @@ class SearchService:
                 seg_tree, compiled.spec, compiled.arrays
             )
             mask = np.asarray(eligible)
-            for local in np.flatnonzero(mask)[:k]:
+            locs = np.flatnonzero(mask)
+            if cursor is not None:
+                if cursor[0] is None:
+                    # Cursor inside the missing region: resume by doc id
+                    # (key-only null cursor skips the whole region).
+                    if request.after_doc >= 0:
+                        locs = locs[locs > request.after_doc - handle.base]
+                    else:
+                        locs = locs[:0]
+                # A real-valued cursor precedes every missing doc: keep all.
+            for local in locs[:k]:
                 candidates.append(
                     (np.inf, handle.base + int(local), handle, int(local), None, None)
                 )
             return int(mask.sum())
-        values, ids, tot = bm25_device.execute_sorted(
-            seg_tree, compiled.spec, compiled.arrays, sort_field, descending, k
-        )
-        values, ids = np.asarray(values), np.asarray(ids)
-        n = min(k, int(tot))
+        if cursor is not None:
+            raw_after = cursor[0]
+            fmax = np.float32(np.finfo(np.float32).max)
+            if raw_after is None:
+                a_key = fmax  # missing region (with doc tiebreak if given)
+            else:
+                a_key = np.float32(raw_after)
+                if descending:
+                    a_key = np.float32(-a_key)
+            a_doc = (
+                request.after_doc - handle.base
+                if request.after_doc >= 0
+                else handle.device.num_docs
+            )
+            values, ids, tot, n_after = bm25_device.execute_sorted_after(
+                seg_tree,
+                compiled.spec,
+                compiled.arrays,
+                sort_field,
+                descending,
+                k,
+                a_key,
+                np.int32(a_doc),
+            )
+            values, ids = np.asarray(values), np.asarray(ids)
+            n = min(k, int(n_after))
+        else:
+            values, ids, tot = bm25_device.execute_sorted(
+                seg_tree, compiled.spec, compiled.arrays, sort_field,
+                descending, k
+            )
+            values, ids = np.asarray(values), np.asarray(ids)
+            n = min(k, int(tot))
         for rank in range(n):
             local = int(ids[rank])
             raw = float(values[rank])

@@ -1,0 +1,360 @@
+"""search_after, track_total_hits, scroll, _msearch, _mget.
+
+Reference behaviors: search/searchafter/, scroll contexts
+(search/SearchService.java:167), MultiSearchRequest.java:52,
+TRACK_TOTAL_HITS_UP_TO semantics.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import ApiError, Node
+from elasticsearch_tpu.rest.server import RestServer
+
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "rank": {"type": "long"},
+    }
+}
+
+
+def build_node(n=90, n_shards=1, segments=3, seed=5, index="idx"):
+    rng = np.random.default_rng(seed)
+    node = Node()
+    node.create_index(
+        index,
+        {
+            "settings": {"index": {"number_of_shards": n_shards}},
+            "mappings": MAPPINGS,
+        },
+    )
+    words = ["red", "green", "blue", "gold"]
+    per_seg = max(1, n // segments)
+    for i in range(n):
+        node.index_doc(
+            index,
+            {
+                "body": " ".join(rng.choice(words, rng.integers(1, 5))),
+                "rank": int(rng.integers(0, 10_000)),
+            },
+            f"d{i}",
+        )
+        if (i + 1) % per_seg == 0:
+            node.refresh(index)
+    node.refresh(index)
+    return node
+
+
+@pytest.mark.parametrize("n_shards", [1, 4])
+def test_search_after_walks_identically_to_from_size(n_shards):
+    node = build_node(n_shards=n_shards)
+    # Ranks are (almost surely) unique under the seed; field-sorted walk.
+    base = {"query": {"match_all": {}}, "sort": [{"rank": "asc"}], "size": 10}
+    via_from = []
+    for page in range(9):
+        r = node.search("idx", {**base, "from": page * 10})
+        via_from.extend(h["_id"] for h in r["hits"]["hits"])
+    via_after = []
+    after = None
+    while True:
+        body = dict(base)
+        if after is not None:
+            body["search_after"] = after
+        r = node.search("idx", body)
+        hits = r["hits"]["hits"]
+        if not hits:
+            break
+        via_after.extend(h["_id"] for h in hits)
+        after = hits[-1]["sort"]
+    assert via_after == via_from
+    assert len(set(via_after)) == 90
+
+
+def test_search_after_desc_and_score():
+    node = build_node()
+    body = {
+        "query": {"match": {"body": "red"}},
+        "sort": [{"rank": "desc"}],
+        "size": 7,
+    }
+    seen = []
+    after = None
+    while True:
+        b = dict(body)
+        if after is not None:
+            b["search_after"] = after
+        r = node.search("idx", b)
+        hits = r["hits"]["hits"]
+        if not hits:
+            break
+        ranks = [h["_source"]["rank"] for h in hits]
+        assert ranks == sorted(ranks, reverse=True)
+        if seen:
+            assert ranks[0] < seen[-1]  # strictly after the cursor
+        seen.extend(ranks)
+        after = hits[-1]["sort"]
+    full = node.search("idx", {**body, "size": 10_000})
+    assert seen == [h["_source"]["rank"] for h in full["hits"]["hits"]]
+
+    # _score-sorted search_after
+    body = {
+        "query": {"match": {"body": "red"}},
+        "sort": [{"_score": "desc"}],
+        "size": 5,
+    }
+    r1 = node.search("idx", body)
+    cut = r1["hits"]["hits"][-1]["_score"]
+    r2 = node.search("idx", {**body, "search_after": [cut]})
+    assert all(h["_score"] < cut for h in r2["hits"]["hits"])
+
+
+def test_search_after_requires_sort_and_rejects_rescore():
+    node = build_node(n=10, segments=1)
+    with pytest.raises(ApiError):
+        node.search("idx", {"search_after": [5]})
+    with pytest.raises(ApiError):
+        node.search(
+            "idx",
+            {
+                "sort": [{"rank": "asc"}],
+                "search_after": [5],
+                "rescore": {"query": {"rescore_query": {"match_all": {}}}},
+            },
+        )
+
+
+@pytest.mark.parametrize("n_shards", [1, 4])
+def test_track_total_hits(n_shards):
+    node = build_node(n=60, n_shards=n_shards)
+    exact = node.search("idx", {"query": {"match_all": {}}, "size": 0,
+                                "track_total_hits": True})
+    assert exact["hits"]["total"] == {"value": 60, "relation": "eq"}
+    clamped = node.search("idx", {"query": {"match_all": {}}, "size": 0,
+                                  "track_total_hits": 25})
+    assert clamped["hits"]["total"] == {"value": 25, "relation": "gte"}
+    under = node.search("idx", {"query": {"match_all": {}}, "size": 0,
+                                "track_total_hits": 100})
+    assert under["hits"]["total"] == {"value": 60, "relation": "eq"}
+    untracked = node.search("idx", {"query": {"match_all": {}}, "size": 3,
+                                    "track_total_hits": False})
+    assert "total" not in untracked["hits"]
+    assert len(untracked["hits"]["hits"]) == 3
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_scroll_walks_everything(n_shards):
+    node = build_node(n=70, n_shards=n_shards)
+    r = node.search(
+        "idx",
+        {"query": {"match_all": {}}, "size": 12, "sort": [{"rank": "asc"}]},
+        scroll="1m",
+    )
+    sid = r["_scroll_id"]
+    collected = [h["_id"] for h in r["hits"]["hits"]]
+    ranks = [h["_source"]["rank"] for h in r["hits"]["hits"]]
+    while True:
+        r = node.scroll({"scroll_id": sid, "scroll": "1m"})
+        hits = r["hits"]["hits"]
+        if not hits:
+            break
+        collected.extend(h["_id"] for h in hits)
+        ranks.extend(h["_source"]["rank"] for h in hits)
+    assert len(collected) == 70 and len(set(collected)) == 70
+    assert ranks == sorted(ranks)
+    out = node.clear_scroll({"scroll_id": sid})
+    assert out["num_freed"] == 1
+    with pytest.raises(ApiError):
+        node.scroll({"scroll_id": sid})
+
+
+def test_scroll_score_order_and_write_isolation():
+    node = build_node(n=40, segments=2)
+    r = node.search(
+        "idx", {"query": {"match": {"body": "blue"}}, "size": 6}, scroll="1m"
+    )
+    sid = r["_scroll_id"]
+    total = r["hits"]["total"]["value"]
+    collected = [(h["_score"], h["_id"]) for h in r["hits"]["hits"]]
+    # concurrent writes must not leak into the pinned snapshot
+    for i in range(10):
+        node.index_doc("idx", {"body": "blue blue blue", "rank": 1}, f"new{i}")
+    node.refresh("idx")
+    while True:
+        r = node.scroll({"scroll_id": sid})
+        hits = r["hits"]["hits"]
+        if not hits:
+            break
+        collected.extend((h["_score"], h["_id"]) for h in hits)
+    assert len(collected) == total
+    assert all(not i.startswith("new") for _, i in collected)
+    scores = [s for s, _ in collected]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_scroll_is_point_in_time_under_deletes():
+    """Docs deleted mid-scroll must still be served from the pinned
+    snapshot (the frozen live mask — ES ReaderContext semantics)."""
+    node = build_node(n=30, segments=2)
+    r = node.search(
+        "idx",
+        {"query": {"match_all": {}}, "size": 5, "sort": [{"rank": "asc"}]},
+        scroll="1m",
+    )
+    sid = r["_scroll_id"]
+    collected = [h["_id"] for h in r["hits"]["hits"]]
+    # delete everything not yet served
+    for i in range(30):
+        if f"d{i}" not in collected:
+            node.delete_doc("idx", f"d{i}")
+    node.refresh("idx")
+    while True:
+        r = node.scroll({"scroll_id": sid})
+        if not r["hits"]["hits"]:
+            break
+        collected.extend(h["_id"] for h in r["hits"]["hits"])
+    assert sorted(collected) == sorted(f"d{i}" for i in range(30))
+    # live search sees the deletes
+    live = node.search("idx", {"query": {"match_all": {}}, "size": 0})
+    assert live["hits"]["total"]["value"] == 5
+
+
+def test_search_after_with_from_rejected():
+    node = build_node(n=10, segments=1)
+    with pytest.raises(ApiError):
+        node.search(
+            "idx",
+            {"sort": [{"rank": "asc"}], "search_after": [5], "from": 3},
+        )
+
+
+def test_scroll_size_zero_rejected():
+    node = build_node(n=5, segments=1)
+    with pytest.raises(ApiError):
+        node.search("idx", {"query": {"match_all": {}}, "size": 0},
+                    scroll="1m")
+
+
+def test_msearch_list_index_header():
+    rest = RestServer()
+    rest.node.create_index("a", {"mappings": MAPPINGS})
+    rest.node.index_doc("a", {"body": "x", "rank": 1}, "1", refresh=True)
+    body = "\n".join(
+        [
+            json.dumps({"index": ["a"]}),
+            json.dumps({"query": {"match_all": {}}}),
+            json.dumps({"index": ["a", "b"]}),
+            json.dumps({"query": {"match_all": {}}}),
+        ]
+    )
+    status, resp = rest.dispatch("POST", "/_msearch", {}, body)
+    assert status == 200
+    assert resp["responses"][0]["status"] == 200
+    assert resp["responses"][1]["status"] == 400
+
+
+def test_scroll_rejects_from_and_expiry():
+    node = build_node(n=10, segments=1)
+    with pytest.raises(ApiError):
+        node.search(
+            "idx", {"query": {"match_all": {}}, "from": 5}, scroll="1m"
+        )
+    r = node.search("idx", {"query": {"match_all": {}}, "size": 3},
+                    scroll="1ms")
+    sid = r["_scroll_id"]
+    import time
+
+    time.sleep(0.01)
+    with pytest.raises(ApiError):
+        node.scroll({"scroll_id": sid})
+
+
+def test_msearch_rest():
+    rest = RestServer()
+    node = rest.node
+    node.create_index("a", {"mappings": MAPPINGS})
+    node.index_doc("a", {"body": "red fish", "rank": 1}, "1", refresh=True)
+    node.index_doc("a", {"body": "blue fish", "rank": 2}, "2", refresh=True)
+    body = "\n".join(
+        [
+            json.dumps({"index": "a"}),
+            json.dumps({"query": {"match": {"body": "red"}}}),
+            json.dumps({}),
+            json.dumps({"query": {"match": {"body": "fish"}}, "size": 1}),
+            json.dumps({"index": "missing"}),
+            json.dumps({"query": {"match_all": {}}}),
+        ]
+    )
+    status, resp = rest.dispatch("POST", "/a/_msearch", {}, body)
+    assert status == 200
+    r0, r1, r2 = resp["responses"]
+    assert r0["status"] == 200
+    assert [h["_id"] for h in r0["hits"]["hits"]] == ["1"]
+    assert r1["status"] == 200 and len(r1["hits"]["hits"]) == 1
+    assert r1["hits"]["total"]["value"] == 2
+    assert r2["status"] == 404 and "error" in r2
+
+
+def test_mget_rest():
+    rest = RestServer()
+    node = rest.node
+    node.create_index("a", {"mappings": MAPPINGS})
+    node.create_index("b", {"mappings": MAPPINGS})
+    node.index_doc("a", {"body": "x", "rank": 1}, "1")
+    node.index_doc("b", {"body": "y", "rank": 2}, "2")
+    status, resp = rest.dispatch(
+        "POST", "/a/_mget", {}, json.dumps({"ids": ["1", "nope"]})
+    )
+    assert status == 200
+    d0, d1 = resp["docs"]
+    assert d0["found"] and d0["_source"]["body"] == "x"
+    assert d1["found"] is False
+    status, resp = rest.dispatch(
+        "POST",
+        "/_mget",
+        {},
+        json.dumps(
+            {
+                "docs": [
+                    {"_index": "a", "_id": "1"},
+                    {"_index": "b", "_id": "2"},
+                    {"_index": "zz", "_id": "3"},
+                ]
+            }
+        ),
+    )
+    docs = resp["docs"]
+    assert docs[0]["found"] and docs[1]["found"]
+    assert "error" in docs[2]
+
+
+def test_scroll_via_rest_roundtrip():
+    rest = RestServer()
+    node = rest.node
+    node.create_index("s", {"mappings": MAPPINGS})
+    for i in range(25):
+        node.index_doc("s", {"body": "w", "rank": i}, f"d{i}")
+    node.refresh("s")
+    status, r = rest.dispatch(
+        "POST",
+        "/s/_search",
+        {"scroll": "1m"},
+        json.dumps({"query": {"match_all": {}}, "size": 10,
+                    "sort": [{"rank": "asc"}]}),
+    )
+    assert status == 200
+    got = [h["_source"]["rank"] for h in r["hits"]["hits"]]
+    sid = r["_scroll_id"]
+    status, r = rest.dispatch(
+        "POST", "/_search/scroll", {},
+        json.dumps({"scroll_id": sid, "scroll": "1m"}),
+    )
+    assert status == 200
+    got += [h["_source"]["rank"] for h in r["hits"]["hits"]]
+    assert got == list(range(20))
+    status, r = rest.dispatch(
+        "DELETE", "/_search/scroll", {}, json.dumps({"scroll_id": sid})
+    )
+    assert status == 200 and r["num_freed"] == 1
