@@ -110,10 +110,18 @@ class Engine:
         device=None,
         data_path: str | None = None,
         durability: str = "request",
+        max_segments: int = 10,
+        merge_factor: int = 8,
     ):
         self.mappings = mappings or Mappings()
         self.params = params
         self.device = device
+        # Merge policy (the reference's EsTieredMergePolicy, simplified to
+        # a segment-count budget): when a refresh pushes the searchable
+        # segment count past `max_segments`, the smallest `merge_factor`
+        # segments compact into one — bounding kernel launches per query.
+        self.max_segments = max(1, int(max_segments))
+        self.merge_factor = max(2, int(merge_factor))
         self.segments: list[SegmentHandle] = []
         # Serializes the whole write path (index/delete/refresh/flush and
         # the version map) — the REST layer dispatches concurrent requests
@@ -407,8 +415,104 @@ class Engine:
             self._buffer_ids = {}
             self._stats_cache = None
             self.generation += 1
+            self._maybe_merge()
             self._sync_impacts()
             return True
+
+    # ------------------------------------------------------------- merging
+
+    def _maybe_merge(self) -> None:
+        """Compact the smallest segments when the count exceeds the budget
+        (called under the engine lock from refresh)."""
+        if len(self.segments) <= self.max_segments:
+            return
+        over = len(self.segments) - self.max_segments
+        n_merge = min(len(self.segments), max(2, over + 1, self.merge_factor))
+        by_size = sorted(
+            range(len(self.segments)),
+            key=lambda i: self.segments[i].segment.num_docs,
+        )
+        self._merge_segments(sorted(by_size[:n_merge]))
+
+    def force_merge(self, max_num_segments: int = 1) -> dict:
+        """Merge down to at most `max_num_segments` searchable segments
+        (the reference's POST /_forcemerge → ForceMergeRequest)."""
+        with self.lock:
+            self.refresh()
+            target = max(1, int(max_num_segments))
+            if len(self.segments) > target:
+                # One merge of the (count - target + 1) smallest segments
+                # reaches the target exactly.
+                n_merge = len(self.segments) - target + 1
+                by_size = sorted(
+                    range(len(self.segments)),
+                    key=lambda i: self.segments[i].segment.num_docs,
+                )
+                self._merge_segments(sorted(by_size[:n_merge]))
+                self._sync_impacts()
+            return {"num_segments": len(self.segments)}
+
+    def _merge_segments(self, indices: list[int]) -> None:
+        """Rewrite the given segments (by position) into one live-docs-only
+        segment, placed at the first merged position.
+
+        Like a Lucene merge, deleted docs are purged — their postings leave
+        the term statistics — and doc ids are renumbered. Callers hold the
+        engine lock. Scroll snapshots are unaffected: they hold frozen
+        handle clones and this replaces the engine's segment LIST."""
+        if len(indices) < 2:
+            return
+        merge_set = set(indices)
+        builder = SegmentBuilder(self.mappings)
+        for idx in indices:
+            handle = self.segments[idx]
+            for local in np.flatnonzero(handle.live_host):
+                local = int(local)
+                seg = handle.segment
+                builder.add(
+                    seg.sources[local],
+                    seg.ids[local],
+                    version=seg.doc_version(local),
+                    seqno=seg.doc_seqno(local),
+                )
+        merged_segment = builder.build()
+        merged_device = pack_segment(
+            merged_segment, self.device, k1=self.params.k1, b=self.params.b
+        )
+        merged_handle = SegmentHandle(
+            segment=merged_segment,
+            device=merged_device,
+            base=0,  # bases renumber below
+            live_host=np.ones(merged_segment.num_docs, dtype=bool),
+        )
+        new_segments: list[SegmentHandle] = []
+        for idx, handle in enumerate(self.segments):
+            if idx == indices[0]:
+                new_segments.append(merged_handle)
+            elif idx not in merge_set:
+                new_segments.append(handle)
+        # Renumber bases copy-on-write: in-flight searches pin
+        # `list(engine.segments)` without the lock, so mutating a shared
+        # handle's base would corrupt their (base + local) doc ordering
+        # mid-request. A re-based survivor is a fresh handle object; the
+        # pinned snapshot keeps the old one with its old base.
+        from dataclasses import replace as dc_replace
+
+        base = 0
+        rebased: list[SegmentHandle] = []
+        self._live_ids = {}
+        for seg_idx, handle in enumerate(new_segments):
+            if handle.base != base:
+                handle = dc_replace(handle, base=base)
+            rebased.append(handle)
+            base += handle.segment.num_docs
+            live = handle.live_host
+            for local, doc_id in enumerate(handle.segment.ids):
+                if live[local]:
+                    self._live_ids[doc_id] = (seg_idx, local)
+        self.segments = rebased
+        self._stats_cache = None
+        self.generation += 1
 
     def flush(self) -> dict:
         """Refresh, persist segments + live masks, commit, trim the translog.

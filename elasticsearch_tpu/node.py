@@ -246,6 +246,7 @@ class Node:
                 "illegal_argument_exception",
                 f"index.number_of_shards must be in [1, 1024], got {n_shards}",
             )
+        merge_cfg = settings.get("index", {}).get("merge", {})
         idx_dir = self._index_dir(name)
         engines = []
         for shard in range(n_shards):
@@ -258,6 +259,8 @@ class Node:
                     params=params,
                     data_path=shard_path,
                     durability=durability,
+                    max_segments=int(merge_cfg.get("max_segment_count", 10)),
+                    merge_factor=int(merge_cfg.get("merge_factor", 8)),
                 )
             )
         search: SearchService | ShardedSearchCoordinator
@@ -588,6 +591,11 @@ class Node:
         scroll: str | None = None,
     ) -> dict:
         svc = self.get_index(index)
+        if self._scrolls:
+            # Reap expired scroll contexts opportunistically: they pin
+            # frozen device segments, and a quiet scroll API must not keep
+            # them alive forever (the reference runs a periodic reaper).
+            self._purge_scrolls()
         try:
             request = SearchRequest.from_json(body)
             if scroll is not None:
@@ -813,6 +821,8 @@ class Node:
 
     def refresh(self, index: str) -> dict:
         svc = self.get_index(index)
+        if self._scrolls:
+            self._purge_scrolls()
         for engine in svc.engines:
             engine.refresh()
         n = svc.n_shards
@@ -824,6 +834,18 @@ class Node:
             engine.flush()
         n = svc.n_shards
         return {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+    def force_merge(self, index: str, max_num_segments: int = 1) -> dict:
+        svc = self.get_index(index)
+        total_segments = 0
+        for engine in svc.engines:
+            out = engine.force_merge(max_num_segments)
+            total_segments += out["num_segments"]
+        n = svc.n_shards
+        return {
+            "_shards": {"total": n, "successful": n, "failed": 0},
+            "num_segments": total_segments,
+        }
 
     def close(self) -> None:
         for svc in self.indices.values():

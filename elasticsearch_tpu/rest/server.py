@@ -121,6 +121,9 @@ class RestServer:
         r("POST", "/{index}/_refresh", lambda s, p, q, b: n.refresh(p["index"]))
         r("GET", "/{index}/_refresh", lambda s, p, q, b: n.refresh(p["index"]))
         r("POST", "/{index}/_flush", lambda s, p, q, b: n.flush(p["index"]))
+        r("POST", "/{index}/_forcemerge", lambda s, p, q, b: n.force_merge(
+            p["index"], int(q.get("max_num_segments", 1))
+        ))
         r("POST", "/{index}/_analyze", self._analyze)
         r("POST", "/{index}/_doc", lambda s, p, q, b: n.index_doc(
             p["index"], _json(b), None, refresh=q.get("refresh") in ("true", "")

@@ -228,6 +228,13 @@ class SearchRequest:
                     "[from] parameter must be set to 0 when [search_after] "
                     "is used"
                 )
+            ((sa_field, _),) = sort[0].items()
+            if sa_field == "_score" and not isinstance(
+                search_after[0], (int, float)
+            ):
+                raise ValueError(
+                    "search_after value for a [_score] sort must be a number"
+                )
         tth = body.get("track_total_hits", 10_000)
         if not isinstance(tth, bool):
             tth = int(tth)

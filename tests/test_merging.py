@@ -1,0 +1,193 @@
+"""Segment merging: tiered compaction bounds segment count, force-merge,
+results unchanged, deletes purged, persistence across merge.
+
+Reference: index/EsTieredMergePolicy.java (policy), ForceMergeRequest.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search.service import SearchRequest, SearchService
+
+MAPPINGS = Mappings.from_json(
+    {
+        "properties": {
+            "body": {"type": "text"},
+            "tag": {"type": "keyword"},
+            "n": {"type": "long"},
+        }
+    }
+)
+
+WORDS = ["one", "two", "three", "four", "five"]
+
+
+def fill(engine, n, refresh_every, rng, prefix="d"):
+    for i in range(n):
+        engine.index(
+            {
+                "body": " ".join(rng.choice(WORDS, rng.integers(1, 5))),
+                "tag": str(rng.choice(["a", "b"])),
+                "n": i,
+            },
+            f"{prefix}{i}",
+        )
+        if (i + 1) % refresh_every == 0:
+            engine.refresh()
+    engine.refresh()
+
+
+def search_ids(engine, body):
+    resp = SearchService(engine).search(SearchRequest.from_json(body))
+    return [(h.doc_id, h.score) for h in resp.hits], resp.total
+
+
+def test_refresh_keeps_segment_count_bounded():
+    engine = Engine(MAPPINGS, max_segments=5, merge_factor=4)
+    rng = np.random.default_rng(1)
+    fill(engine, 200, 10, rng)  # 20 refreshes
+    assert len(engine.segments) <= 5
+    assert engine.num_docs == 200
+    hits, total = search_ids(engine, {"query": {"match": {"body": "one"}}})
+    assert total > 0
+
+
+def test_merge_preserves_results_exactly():
+    rng = np.random.default_rng(2)
+    merged = Engine(MAPPINGS, max_segments=3, merge_factor=3)
+    flat = Engine(MAPPINGS)
+    for i in range(120):
+        doc = {
+            "body": " ".join(rng.choice(WORDS, rng.integers(1, 5))),
+            "tag": str(rng.choice(["a", "b"])),
+            "n": i,
+        }
+        merged.index(doc, f"d{i}")
+        flat.index(doc, f"d{i}")
+        if (i + 1) % 8 == 0:
+            merged.refresh()
+    merged.refresh()
+    flat.refresh()  # single segment, never merged
+    assert len(merged.segments) <= 3
+    # Merging renumbers doc ids (Lucene merges do too), so equal-score tie
+    # ORDER may differ; scores and per-score membership must not. size
+    # covers the whole corpus so no group truncates.
+    for body in [
+        {"query": {"match": {"body": "two three"}}, "size": 200},
+        {"query": {"bool": {"must": [{"match": {"body": "one"}}],
+                            "filter": [{"term": {"tag": "a"}}]}}, "size": 200},
+        {"query": {"match_all": {}}, "sort": [{"n": "desc"}], "size": 10},
+    ]:
+        got, got_total = search_ids(merged, body)
+        want, want_total = search_ids(flat, body)
+        assert got_total == want_total
+        assert [s for _, s in got] == [s for _, s in want]
+        by_score_got: dict = {}
+        by_score_want: dict = {}
+        for h, s in got:
+            by_score_got.setdefault(s, set()).add(h)
+        for h, s in want:
+            by_score_want.setdefault(s, set()).add(h)
+        assert by_score_got == by_score_want
+
+
+def test_force_merge_purges_deletes_and_updates_stats():
+    engine = Engine(MAPPINGS, max_segments=100)
+    rng = np.random.default_rng(3)
+    fill(engine, 60, 15, rng)
+    for i in range(0, 60, 2):
+        engine.delete(f"d{i}")
+    engine.refresh()
+    stats_before = engine.field_stats()["body"]
+    out = engine.force_merge(1)
+    assert out["num_segments"] == 1
+    assert engine.num_docs == 30
+    # Purged deletes leave the statistics (Lucene merge semantics)
+    stats_after = engine.field_stats()["body"]
+    assert stats_after.doc_count == 30
+    assert stats_before.doc_count > stats_after.doc_count
+    # realtime get still routes correctly after renumbering
+    assert engine.get("d1") is not None
+    assert engine.get("d0") is None
+    hits, total = search_ids(engine, {"query": {"match_all": {}}, "size": 40})
+    assert total == 30
+    assert {h for h, _ in hits} == {f"d{i}" for i in range(1, 60, 2)}
+
+
+def test_merge_then_write_then_merge():
+    engine = Engine(MAPPINGS, max_segments=2, merge_factor=2)
+    rng = np.random.default_rng(4)
+    fill(engine, 30, 5, rng)
+    assert len(engine.segments) <= 2
+    fill(engine, 30, 5, rng, prefix="e")
+    assert len(engine.segments) <= 2
+    assert engine.num_docs == 60
+    engine.index({"body": "one", "n": 999}, "d3")  # overwrite post-merge
+    engine.refresh()
+    assert engine.get("d3")["n"] == 999
+    assert engine.num_docs == 60
+
+
+def test_merge_persistence(tmp_path):
+    engine = Engine(MAPPINGS, data_path=str(tmp_path / "x"), max_segments=100)
+    rng = np.random.default_rng(5)
+    fill(engine, 40, 10, rng)
+    engine.delete("d0")
+    engine.force_merge(1)
+    engine.flush()
+    engine.close()
+    engine2 = Engine(MAPPINGS, data_path=str(tmp_path / "x"))
+    assert len(engine2.segments) == 1
+    assert engine2.num_docs == 39
+    assert engine2.get("d0") is None
+    assert engine2.get("d5") is not None
+    # versions/seqnos survived the merge + restart
+    meta = engine2.get_with_meta("d5")
+    assert meta["_seq_no"] >= 0 and meta["_version"] >= 1
+    engine2.close()
+
+
+def test_forcemerge_rest_route():
+    node = Node()
+    node.create_index("m", {"settings": {"index": {"number_of_shards": 2}}})
+    for i in range(20):
+        node.index_doc("m", {"body": f"w{i}"}, f"d{i}")
+        if i % 4 == 0:
+            node.refresh("m")
+    node.refresh("m")
+    from elasticsearch_tpu.rest.server import RestServer
+
+    rest = RestServer(node=node)
+    status, resp = rest.dispatch(
+        "POST", "/m/_forcemerge", {"max_num_segments": "1"}, ""
+    )
+    assert status == 200
+    assert resp["num_segments"] == 2  # one per shard
+    r = node.search("m", {"query": {"match_all": {}}, "size": 0})
+    assert r["hits"]["total"]["value"] == 20
+
+
+def test_scroll_survives_merge():
+    node = Node()
+    node.create_index("s", {"mappings": {"properties": {"n": {"type": "long"}}}})
+    for i in range(30):
+        node.index_doc("s", {"n": i}, f"d{i}")
+        if i % 6 == 0:
+            node.refresh("s")
+    node.refresh("s")
+    r = node.search(
+        "s", {"query": {"match_all": {}}, "size": 7, "sort": [{"n": "asc"}]},
+        scroll="1m",
+    )
+    sid = r["_scroll_id"]
+    got = [h["_source"]["n"] for h in r["hits"]["hits"]]
+    node.force_merge("s", 1)  # compact while the scroll is open
+    while True:
+        r = node.scroll({"scroll_id": sid})
+        if not r["hits"]["hits"]:
+            break
+        got += [h["_source"]["n"] for h in r["hits"]["hits"]]
+    assert got == list(range(30))
