@@ -1,0 +1,4 @@
+from .breaker import BreakerError, CircuitBreaker
+from .request_cache import RequestCache
+
+__all__ = ["BreakerError", "CircuitBreaker", "RequestCache"]

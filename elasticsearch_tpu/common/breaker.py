@@ -1,0 +1,66 @@
+"""HBM circuit breaker: device-memory accounting for segment uploads.
+
+The TPU analog of the reference's hierarchical circuit breakers
+(indices/breaker/HierarchyCircuitBreakerService.java:51): where the JVM
+breakers bound heap for fielddata/request/in-flight, the scarce resource
+here is device HBM, consumed by packed segments (postings/position planes,
+doc values, vectors). Every engine reserves against one node-level breaker
+before a pack and settles to the actual byte count after; a reservation
+that would exceed the limit raises BreakerError — surfaced as HTTP 429
+circuit_breaking_exception, like the reference's
+CircuitBreakingException#durability=PERMANENT.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class BreakerError(Exception):
+    """Device-memory budget exceeded (HTTP 429 circuit_breaking_exception)."""
+
+    def __init__(self, wanted: int, used: int, limit: int, label: str):
+        super().__init__(
+            f"[hbm] Data too large: [{label}] would use {wanted} bytes on "
+            f"top of {used} used, larger than the limit of {limit}"
+        )
+        self.wanted = wanted
+        self.used = used
+        self.limit = limit
+
+
+class CircuitBreaker:
+    """Byte-budget accounting with reserve/settle/release semantics."""
+
+    def __init__(self, limit_bytes: int, name: str = "hbm"):
+        self.limit = int(limit_bytes)
+        self.name = name
+        self.used = 0
+        self.trips = 0
+        self._lock = threading.Lock()
+
+    def add(self, n: int, label: str = "segment") -> None:
+        """Reserve n bytes; raises BreakerError over the limit."""
+        with self._lock:
+            if self.used + n > self.limit:
+                self.trips += 1
+                raise BreakerError(n, self.used, self.limit, label)
+            self.used += n
+
+    def add_unchecked(self, n: int) -> None:
+        """Account bytes that must land regardless (recovery, settle-up):
+        the breaker protects against new allocations, not existing data."""
+        with self._lock:
+            self.used += n
+
+    def release(self, n: int) -> None:
+        with self._lock:
+            self.used = max(0, self.used - n)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "limit_size_in_bytes": self.limit,
+                "estimated_size_in_bytes": self.used,
+                "tripped": self.trips,
+            }

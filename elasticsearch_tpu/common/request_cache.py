@@ -1,0 +1,61 @@
+"""Shard request cache: serialized search responses keyed by request bytes.
+
+The analog of the reference's IndicesRequestCache
+(indices/IndicesRequestCache.java:57): size=0 requests (aggregations,
+counts) cache their full response, keyed by the canonical request body
+plus every shard's refresh generation — so a refresh implicitly
+invalidates without any explicit eviction hook, exactly like the
+reference keying on the reader's cache helper. Entries store the
+serialized JSON string; a hit deserializes a fresh object so callers
+can't mutate the cached copy.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import OrderedDict
+from typing import Any
+
+
+class RequestCache:
+    def __init__(self, max_entries: int = 256):
+        self.max_entries = max_entries
+        self._entries: OrderedDict[tuple, str] = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key(index: str, body: dict | None, generations: tuple) -> tuple:
+        return (
+            index,
+            json.dumps(body or {}, sort_keys=True, separators=(",", ":")),
+            generations,
+        )
+
+    def get(self, key: tuple) -> Any | None:
+        with self._lock:
+            raw = self._entries.get(key)
+            if raw is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+        return json.loads(raw)
+
+    def put(self, key: tuple, response: dict) -> None:
+        raw = json.dumps(response, separators=(",", ":"))
+        with self._lock:
+            self._entries[key] = raw
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "hit_count": self.hits,
+                "miss_count": self.misses,
+            }

@@ -38,12 +38,19 @@ from typing import Any
 
 import numpy as np
 
+from ..common.breaker import BreakerError
 from ..ops.bm25 import BM25Params
 from ..query.compile import Compiler, FieldStats, aggregate_field_stats
 from . import store
 from .mapping import Mappings
 from .segment import Segment, SegmentBuilder
-from .tiles import DeviceSegment, pack_segment, repack_tn
+from .tiles import (
+    DeviceSegment,
+    device_nbytes,
+    estimate_segment_device_bytes,
+    pack_segment,
+    repack_tn,
+)
 from .translog import Translog
 
 
@@ -74,6 +81,7 @@ class SegmentHandle:
     live_host: np.ndarray  # bool[N] host copy of the live mask
     live_dirty: bool = False
     seg_id: int | None = None  # on-disk id once persisted by flush()
+    nbytes: int = 0  # device bytes held (HBM breaker accounting)
     _id_index: dict[str, int] | None = None  # lazy _id -> local (ids query)
 
     @property
@@ -112,6 +120,7 @@ class Engine:
         durability: str = "request",
         max_segments: int = 10,
         merge_factor: int = 8,
+        breaker=None,  # common.breaker.CircuitBreaker (HBM accounting)
     ):
         self.mappings = mappings or Mappings()
         self.params = params
@@ -122,6 +131,7 @@ class Engine:
         # segments compact into one — bounding kernel launches per query.
         self.max_segments = max(1, int(max_segments))
         self.merge_factor = max(2, int(merge_factor))
+        self.breaker = breaker
         self.segments: list[SegmentHandle] = []
         # Serializes the whole write path (index/delete/refresh/flush and
         # the version map) — the REST layer dispatches concurrent requests
@@ -157,13 +167,21 @@ class Engine:
         self.data_path = data_path
         self.translog: Translog | None = None
         self._next_seg_id = 1
+        self._recovering = False
         if data_path is not None:
             os.makedirs(data_path, exist_ok=True)
-            self._recover()
-            self.translog = Translog(
-                os.path.join(data_path, "translog"), durability
-            )
-            self._replay_translog()
+            # Recovery must load durably-acked data regardless of the HBM
+            # budget (the breaker rejects NEW allocations, not committed
+            # state): _pack_accounted accounts without enforcing while set.
+            self._recovering = True
+            try:
+                self._recover()
+                self.translog = Translog(
+                    os.path.join(data_path, "translog"), durability
+                )
+                self._replay_translog()
+            finally:
+                self._recovering = False
 
     # ------------------------------------------------------------- write path
 
@@ -398,14 +416,13 @@ class Engine:
                     return changed
             segment = self._buffer.build()
             base = sum(h.segment.num_docs for h in self.segments)
-            device = pack_segment(
-                segment, self.device, k1=self.params.k1, b=self.params.b
-            )
+            device, nbytes = self._pack_accounted(segment)
             handle = SegmentHandle(
                 segment=segment,
                 device=device,
                 base=base,
                 live_host=np.ones(segment.num_docs, dtype=bool),
+                nbytes=nbytes,
             )
             seg_idx = len(self.segments)
             self.segments.append(handle)
@@ -418,6 +435,46 @@ class Engine:
             self._maybe_merge()
             self._sync_impacts()
             return True
+
+    def _pack_accounted(
+        self, segment, deleted=None, enforce: bool = True
+    ) -> tuple[DeviceSegment, int]:
+        """Pack a segment with HBM breaker accounting: reserve the estimate
+        first (reject BEFORE touching the device when over budget), settle
+        to actual bytes after. enforce=False accounts without rejecting —
+        recovery must load committed data regardless."""
+        est = estimate_segment_device_bytes(segment)
+        if self.breaker is not None:
+            if enforce and not self._recovering:
+                self.breaker.add(
+                    est, label=f"segment[{segment.num_docs} docs]"
+                )
+            else:
+                self.breaker.add_unchecked(est)
+        try:
+            device = pack_segment(
+                segment,
+                self.device,
+                deleted=deleted,
+                k1=self.params.k1,
+                b=self.params.b,
+            )
+        except Exception:
+            if self.breaker is not None:
+                self.breaker.release(est)
+            raise
+        actual = device_nbytes(device)
+        if self.breaker is not None:
+            if actual > est:
+                self.breaker.add_unchecked(actual - est)
+            else:
+                self.breaker.release(est - actual)
+        return device, actual
+
+    @property
+    def device_bytes(self) -> int:
+        """HBM held by this engine's packed segments."""
+        return sum(h.nbytes for h in self.segments)
 
     # ------------------------------------------------------------- merging
 
@@ -432,7 +489,13 @@ class Engine:
             range(len(self.segments)),
             key=lambda i: self.segments[i].segment.num_docs,
         )
-        self._merge_segments(sorted(by_size[:n_merge]))
+        try:
+            self._merge_segments(sorted(by_size[:n_merge]))
+        except BreakerError:
+            # A merge transiently doubles the merged bytes; under memory
+            # pressure skip the compaction rather than failing the refresh
+            # (the reference's merges back off the same way under throttle).
+            pass
 
     def force_merge(self, max_num_segments: int = 1) -> dict:
         """Merge down to at most `max_num_segments` searchable segments
@@ -476,14 +539,19 @@ class Engine:
                     seqno=seg.doc_seqno(local),
                 )
         merged_segment = builder.build()
-        merged_device = pack_segment(
-            merged_segment, self.device, k1=self.params.k1, b=self.params.b
-        )
+        merged_device, merged_nbytes = self._pack_accounted(merged_segment)
+        if self.breaker is not None:
+            # The merged-away segments' device arrays become garbage once
+            # the handle list swaps (snapshots may pin them briefly).
+            self.breaker.release(
+                sum(self.segments[i].nbytes for i in indices)
+            )
         merged_handle = SegmentHandle(
             segment=merged_segment,
             device=merged_device,
             base=0,  # bases renumber below
             live_host=np.ones(merged_segment.num_docs, dtype=bool),
+            nbytes=merged_nbytes,
         )
         new_segments: list[SegmentHandle] = []
         for idx, handle in enumerate(self.segments):
@@ -565,6 +633,8 @@ class Engine:
             return {"committed": True, "max_seqno": self._seqno}
 
     def close(self) -> None:
+        if self.breaker is not None:
+            self.breaker.release(self.device_bytes)
         if self.translog is not None:
             self.translog.close()
 
@@ -597,12 +667,10 @@ class Engine:
         for seg_idx, seg_id in enumerate(commit["segments"]):
             segment, live = store.load_segment(self.data_path, seg_id)
             deleted = np.flatnonzero(~live)
-            device = pack_segment(
-                segment,
-                self.device,
-                deleted=deleted,
-                k1=self.params.k1,
-                b=self.params.b,
+            # enforce=False: committed data must load; the breaker tracks
+            # it but can't reject recovery.
+            device, nbytes = self._pack_accounted(
+                segment, deleted=deleted, enforce=False
             )
             handle = SegmentHandle(
                 segment=segment,
@@ -610,6 +678,7 @@ class Engine:
                 base=base,
                 live_host=live.copy(),
                 seg_id=seg_id,
+                nbytes=nbytes,
             )
             self.segments.append(handle)
             for local, doc_id in enumerate(segment.ids):

@@ -322,6 +322,44 @@ def _fit_bool(present: np.ndarray, norm_bytes: np.ndarray, num_docs: int) -> np.
     return out
 
 
+def device_nbytes(seg: DeviceSegment) -> int:
+    """Actual device bytes held by a packed segment (HBM accounting)."""
+    total = seg.live.nbytes
+    for f in seg.fields.values():
+        total += f.doc_ids.nbytes + f.tfs.nbytes + f.tn.nbytes
+        total += f.norm_bytes.nbytes + f.present.nbytes
+        if f.ord_terms is not None:
+            total += f.ord_terms.nbytes
+        if f.pos_doc is not None:
+            total += f.pos_doc.nbytes + f.pos_val.nbytes
+    for col in seg.doc_values.values():
+        total += col.nbytes
+    for mat in seg.vectors.values():
+        total += mat.nbytes
+    return int(total)
+
+
+def estimate_segment_device_bytes(segment: Segment) -> int:
+    """Upper-ish estimate of a host Segment's packed device footprint,
+    computed BEFORE the pack so the HBM breaker can reject the upload
+    instead of OOMing the device."""
+    n = segment.num_docs
+    total = n  # live mask
+    for f in segment.fields.values():
+        p_pad = (len(f.doc_ids) // TILE + 2) * TILE
+        total += p_pad * 12  # doc_ids + tfs + tn (i32/f32/f32)
+        total += (n + 1) + n  # norm bytes + present
+        if not f.has_norms and len(f.df):
+            total += p_pad * 4  # keyword ordinals plane
+        if f.positions is not None:
+            pp_pad = (len(f.positions) // TILE + 2) * TILE
+            total += pp_pad * 8  # pos_doc + pos_val
+    total += 4 * n * len(segment.doc_values)
+    for mat in segment.vectors.values():
+        total += 4 * n * mat.shape[1]
+    return int(total)
+
+
 def pack_segment(
     segment: Segment,
     device=None,

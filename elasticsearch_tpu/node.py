@@ -20,11 +20,14 @@ import re
 import shutil
 import threading
 import time
+import uuid as uuid_mod
 from dataclasses import dataclass, field
 from typing import Any
 
 import numpy as np
 
+from .common.breaker import BreakerError, CircuitBreaker
+from .common.request_cache import RequestCache
 from .index.engine import Engine, InvalidCasError, VersionConflictError
 from .index.mapping import Mappings
 from .ops.bm25 import BM25Params
@@ -66,6 +69,20 @@ def _parse_keepalive(value: str) -> float:
 _INDEX_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9_\-.]*$")
 
 
+def _refresh_after_write(engine) -> bool:
+    """Refresh after an already-acked (durably applied) write.
+
+    Under HBM pressure the refresh is SKIPPED rather than failing the
+    request: a 429 after the translog fsync would invite client retries
+    that duplicate the document. Returns the forced_refresh flag; explicit
+    /_refresh still surfaces the breaker as 429."""
+    try:
+        engine.refresh()
+        return True
+    except BreakerError:
+        return False
+
+
 @dataclass
 class IndexService:
     """One index: mappings + N shard engines + search entry + settings.
@@ -82,6 +99,9 @@ class IndexService:
     search: SearchService | ShardedSearchCoordinator
     settings: dict[str, Any] = field(default_factory=dict)
     created_at: float = field(default_factory=time.time)
+    # Unique per index INCARNATION: delete-and-recreate must not collide in
+    # the request cache (generations restart from scratch).
+    uuid: str = field(default_factory=lambda: uuid_mod.uuid4().hex)
     _auto_counter: int = -1  # lazy-initialized from recovered engines
     _auto_lock: threading.Lock = field(default_factory=threading.Lock)
     scroll_coordinator: Any = None  # cached 1-shard scroll coordinator
@@ -161,6 +181,7 @@ class Node:
         node_name: str = "node-0",
         cluster_name: str = "es-tpu",
         data_path: str | None = None,
+        breaker_limit_bytes: int | None = None,
     ):
         self.node_name = node_name
         self.cluster_name = cluster_name
@@ -171,6 +192,15 @@ class Node:
         self._scrolls: dict[str, Any] = {}
         self._scroll_lock = threading.Lock()
         self.max_open_scrolls = 500
+        # Node-level HBM breaker shared by every shard engine (the parent
+        # breaker of HierarchyCircuitBreakerService) + the shard request
+        # cache (IndicesRequestCache).
+        if breaker_limit_bytes is None:
+            breaker_limit_bytes = int(
+                os.environ.get("ESTPU_HBM_LIMIT_BYTES", 8 << 30)
+            )
+        self.breaker = CircuitBreaker(breaker_limit_bytes)
+        self.request_cache = RequestCache()
         if data_path is not None:
             os.makedirs(data_path, exist_ok=True)
             self._recover_indices()
@@ -261,6 +291,7 @@ class Node:
                     durability=durability,
                     max_segments=int(merge_cfg.get("max_segment_count", 10)),
                     merge_factor=int(merge_cfg.get("merge_factor", 8)),
+                    breaker=self.breaker,
                 )
             )
         search: SearchService | ShardedSearchCoordinator
@@ -374,9 +405,7 @@ class Node:
             raise ApiError(400, "mapper_parsing_exception", str(e)) from None
         if sync:  # request durability before the ack (bulk syncs once)
             engine.sync_translog()
-        if refresh:
-            engine.refresh()
-        return {
+        out = {
             "_index": index,
             "_id": result["_id"],
             "_version": result["_version"],
@@ -385,6 +414,9 @@ class Node:
             "_primary_term": result["_primary_term"],
             "_shards": {"total": 1, "successful": 1, "failed": 0},
         }
+        if refresh:
+            out["forced_refresh"] = _refresh_after_write(engine)
+        return out
 
     def get_doc(self, index: str, doc_id: str) -> dict:
         svc = self.get_index(index)
@@ -424,10 +456,8 @@ class Node:
             raise ApiError(400, "illegal_argument_exception", str(e)) from None
         if sync:
             engine.sync_translog()
-        if refresh:
-            engine.refresh()
         status = "deleted" if result["result"] == "deleted" else "not_found"
-        return {
+        out = {
             "_index": index,
             "_id": doc_id,
             "result": status,
@@ -436,6 +466,9 @@ class Node:
             "_primary_term": result["_primary_term"],
             "_shards": {"total": 1, "successful": 1, "failed": 0},
         }
+        if refresh:
+            out["forced_refresh"] = _refresh_after_write(engine)
+        return out
 
     def update_doc(
         self,
@@ -489,9 +522,7 @@ class Node:
                 ) from None
         if sync:
             engine.sync_translog()
-        if refresh:
-            engine.refresh()
-        return {
+        out = {
             "_index": index,
             "_id": doc_id,
             "result": "updated" if existing is not None else "created",
@@ -499,6 +530,9 @@ class Node:
             "_version": result["_version"],
             "_primary_term": result["_primary_term"],
         }
+        if refresh:
+            out["forced_refresh"] = _refresh_after_write(engine)
+        return out
 
     # ----------------------------------------------------------------- bulk
 
@@ -575,7 +609,7 @@ class Node:
             for index in touched:
                 if index in self.indices:
                     for engine in self.indices[index].engines:
-                        engine.refresh()
+                        _refresh_after_write(engine)
         return {
             "took": int((time.monotonic() - t0) * 1000),
             "errors": errors,
@@ -589,6 +623,7 @@ class Node:
         index: str,
         body: dict[str, Any] | None,
         scroll: str | None = None,
+        request_cache: bool | None = None,
     ) -> dict:
         svc = self.get_index(index)
         if self._scrolls:
@@ -596,6 +631,24 @@ class Node:
             # frozen device segments, and a quiet scroll API must not keep
             # them alive forever (the reference runs a periodic reaper).
             self._purge_scrolls()
+        # Shard request cache: size=0 requests (aggs/counts) cache their
+        # serialized response, keyed on the body + every shard's refresh
+        # generation (a refresh implicitly invalidates). Mirrors
+        # IndicesRequestCache.canCache: non-scroll, size==0, opt-out via
+        # ?request_cache=false.
+        cacheable = (
+            scroll is None
+            and request_cache is not False
+            and int((body or {}).get("size", 10)) == 0
+        )
+        cache_key = None
+        if cacheable:
+            cache_key = RequestCache.key(
+                svc.uuid, body, tuple(e.generation for e in svc.engines)
+            )
+            cached = self.request_cache.get(cache_key)
+            if cached is not None:
+                return cached
         try:
             request = SearchRequest.from_json(body)
             if scroll is not None:
@@ -603,7 +656,10 @@ class Node:
             response = svc.search.search(request)
         except ValueError as e:
             raise ApiError(400, "search_phase_execution_exception", str(e)) from None
-        return response.to_json(index)
+        out = response.to_json(index)
+        if cache_key is not None:
+            self.request_cache.put(cache_key, out)
+        return out
 
     def count(self, index: str, body: dict[str, Any] | None) -> dict:
         body = dict(body or {})
@@ -642,8 +698,6 @@ class Node:
     def _start_scroll(
         self, svc: IndexService, index: str, request, scroll: str
     ) -> dict:
-        import uuid
-
         if request.from_:
             raise ApiError(
                 400,
@@ -665,7 +719,7 @@ class Node:
         self._purge_scrolls()
         coord = self._coordinator_for(svc)
         ctx = coord.open_scroll(index, request, _parse_keepalive(scroll))
-        scroll_id = uuid.uuid4().hex
+        scroll_id = uuid_mod.uuid4().hex
         # Atomic check-and-insert enforces the cap exactly; the context is
         # registered before the first page so a failure cleans it up.
         with self._scroll_lock:
@@ -890,11 +944,37 @@ class Node:
                 "primaries": {
                     "docs": {
                         "count": sum(s.num_docs for s in self.indices.values())
-                    }
+                    },
+                    "request_cache": self.request_cache.stats(),
+                    "segments": {
+                        "count": sum(
+                            len(e.segments)
+                            for s in self.indices.values()
+                            for e in s.engines
+                        ),
+                        "device_memory_in_bytes": sum(
+                            e.device_bytes
+                            for s in self.indices.values()
+                            for e in s.engines
+                        ),
+                    },
                 }
             },
+            "breakers": {"hbm": self.breaker.stats()},
             "indices": {
-                name: {"primaries": {"docs": {"count": svc.num_docs}}}
+                name: {
+                    "primaries": {
+                        "docs": {"count": svc.num_docs},
+                        "segments": {
+                            "count": sum(
+                                len(e.segments) for e in svc.engines
+                            ),
+                            "device_memory_in_bytes": sum(
+                                e.device_bytes for e in svc.engines
+                            ),
+                        },
+                    }
+                }
                 for name, svc in self.indices.items()
             },
         }

@@ -34,6 +34,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
 
+from ..common.breaker import BreakerError
 from ..node import ApiError, Node
 from ..search import rank_eval
 
@@ -100,7 +101,11 @@ class RestServer:
             r(method, "/_search/scroll", lambda s, p, q, b: n.scroll(_json(b)))
             r(method, "/_mget", lambda s, p, q, b: n.mget(_json(b)))
             r(method, "/{index}/_search", lambda s, p, q, b: n.search(
-                p["index"], _json(b), scroll=q.get("scroll")
+                p["index"], _json(b), scroll=q.get("scroll"),
+                request_cache=(
+                    None if "request_cache" not in q
+                    else q["request_cache"] in ("true", "")
+                ),
             ))
             r(method, "/{index}/_count", lambda s, p, q, b: n.count(
                 p["index"], _json(b)
@@ -218,6 +223,14 @@ class RestServer:
                     "root_cause": [{"type": e.err_type, "reason": e.reason}],
                 },
                 "status": e.status,
+            }
+        except BreakerError as e:
+            return 429, {
+                "error": {
+                    "type": "circuit_breaking_exception",
+                    "reason": str(e),
+                },
+                "status": 429,
             }
         except json.JSONDecodeError as e:
             return 400, {
