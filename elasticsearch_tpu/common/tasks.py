@@ -1,0 +1,140 @@
+"""Task registry: running-action tracking, cancellation, timeouts.
+
+The analog of the reference's TaskManager (tasks/TaskManager.java) +
+CancellableTask: every search registers a task; cancellation and the
+request timeout are polled at kernel-launch boundaries (between segments
+and shards) — the TPU analog of the reference polling inside the scoring
+loop (search/internal/ContextIndexSearcher.java:91 checkCancelled /
+search/query/QueryPhase.java timeout collector): an XLA program itself is
+not interruptible, so the check granularity is one segment's launch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class TaskCancelledError(Exception):
+    """Raised inside a cancelled task (HTTP 400 task_cancelled_exception)."""
+
+
+@dataclass
+class Task:
+    id: str
+    action: str
+    description: str
+    cancellable: bool = True
+    start_ms: float = field(default_factory=lambda: time.time() * 1000)
+    deadline: float | None = None  # monotonic seconds; None = no timeout
+    _cancelled: bool = False
+    _timed_out: bool = False
+    cancel_reason: str | None = None
+
+    def cancel(self, reason: str = "by user request") -> None:
+        self._cancelled = True
+        self.cancel_reason = reason
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def raise_if_cancelled(self) -> None:
+        if self._cancelled:
+            raise TaskCancelledError(
+                f"task cancelled [{self.cancel_reason}]"
+            )
+
+    def check_deadline(self) -> bool:
+        """True (and latches timed_out) once the wall-clock budget is
+        exhausted — callers stop launching work and return partials."""
+        if self.deadline is not None and time.monotonic() > self.deadline:
+            self._timed_out = True
+        return self._timed_out
+
+    @property
+    def timed_out(self) -> bool:
+        return self._timed_out
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "node": self.id.split(":")[0],
+            "id": int(self.id.split(":")[1]),
+            "type": "transport",
+            "action": self.action,
+            "description": self.description,
+            "start_time_in_millis": int(self.start_ms),
+            "running_time_in_nanos": int(
+                (time.time() * 1000 - self.start_ms) * 1e6
+            ),
+            "cancellable": self.cancellable,
+            "cancelled": self._cancelled,
+        }
+
+
+class TaskManager:
+    """Thread-safe registry of running tasks (tasks/TaskManager.java)."""
+
+    def __init__(self, node_name: str = "node-0"):
+        self.node_name = node_name
+        self._tasks: dict[str, Task] = {}
+        self._lock = threading.Lock()
+        self._counter = 0
+
+    def register(
+        self,
+        action: str,
+        description: str = "",
+        timeout_s: float | None = None,
+        cancellable: bool = True,
+    ) -> Task:
+        with self._lock:
+            self._counter += 1
+            task_id = f"{self.node_name}:{self._counter}"
+            task = Task(
+                id=task_id,
+                action=action,
+                description=description,
+                cancellable=cancellable,
+                deadline=(
+                    time.monotonic() + timeout_s
+                    if timeout_s is not None
+                    else None
+                ),
+            )
+            self._tasks[task_id] = task
+            return task
+
+    def unregister(self, task: Task) -> None:
+        with self._lock:
+            self._tasks.pop(task.id, None)
+
+    def get(self, task_id: str) -> Task | None:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def cancel(self, task_id: str, reason: str = "by user request") -> Task | None:
+        with self._lock:
+            task = self._tasks.get(task_id)
+        if task is not None and task.cancellable:
+            task.cancel(reason)
+        return task
+
+    def list(self, actions: str | None = None) -> list[Task]:
+        with self._lock:
+            tasks = list(self._tasks.values())
+        if actions:
+            # ES-style action filter with trailing-* wildcard support.
+            pats = [a.strip() for a in actions.split(",")]
+            tasks = [
+                t
+                for t in tasks
+                if any(
+                    t.action == p
+                    or (p.endswith("*") and t.action.startswith(p[:-1]))
+                    for p in pats
+                )
+            ]
+        return tasks

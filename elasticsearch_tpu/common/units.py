@@ -1,0 +1,20 @@
+"""Time-value parsing shared by every duration-bearing API parameter
+(the reference's common/unit/TimeValue)."""
+
+from __future__ import annotations
+
+import re
+
+_DURATION_RE = re.compile(r"^(\d+)(ms|s|m|h|d)$")
+_UNIT_S = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+
+
+def parse_duration_s(value: str) -> float:
+    """ES time value ('100ms', '30s', '1m', '2h', '1d') → seconds.
+
+    Raises ValueError on anything else (callers map to their error type).
+    """
+    m = _DURATION_RE.match(str(value))
+    if not m:
+        raise ValueError(f"failed to parse time value [{value}]")
+    return int(m.group(1)) * _UNIT_S[m.group(2)]

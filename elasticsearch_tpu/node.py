@@ -28,6 +28,7 @@ import numpy as np
 
 from .common.breaker import BreakerError, CircuitBreaker
 from .common.request_cache import RequestCache
+from .common.tasks import TaskCancelledError, TaskManager
 from .index.engine import Engine, InvalidCasError, VersionConflictError
 from .index.mapping import Mappings
 from .ops.bm25 import BM25Params
@@ -50,20 +51,14 @@ def index_not_found(name: str) -> ApiError:
     return ApiError(404, "index_not_found_exception", f"no such index [{name}]")
 
 
-_KEEPALIVE_RE = re.compile(r"^(\d+)(ms|s|m|h|d)$")
-_KEEPALIVE_UNIT_S = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
-
-
 def _parse_keepalive(value: str) -> float:
-    """ES time value ('30s', '1m', ...) → seconds."""
-    m = _KEEPALIVE_RE.match(str(value))
-    if not m:
-        raise ApiError(
-            400,
-            "illegal_argument_exception",
-            f"failed to parse time value [{value}]",
-        )
-    return int(m.group(1)) * _KEEPALIVE_UNIT_S[m.group(2)]
+    """ES time value ('30s', '1m', ...) → seconds, as a 400 on bad input."""
+    from .common.units import parse_duration_s
+
+    try:
+        return parse_duration_s(value)
+    except ValueError as e:
+        raise ApiError(400, "illegal_argument_exception", str(e)) from None
 
 
 _INDEX_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9_\-.]*$")
@@ -201,6 +196,7 @@ class Node:
             )
         self.breaker = CircuitBreaker(breaker_limit_bytes)
         self.request_cache = RequestCache()
+        self.tasks = TaskManager(node_name)
         if data_path is not None:
             os.makedirs(data_path, exist_ok=True)
             self._recover_indices()
@@ -651,13 +647,25 @@ class Node:
                 return cached
         try:
             request = SearchRequest.from_json(body)
-            if scroll is not None:
-                return self._start_scroll(svc, index, request, scroll)
-            response = svc.search.search(request)
+            task = self.tasks.register(
+                "indices:data/read/search",
+                description=f"indices[{index}]",
+                timeout_s=request.timeout_s,
+            )
+            try:
+                if scroll is not None:
+                    return self._start_scroll(
+                        svc, index, request, scroll, task=task
+                    )
+                response = svc.search.search(request, task=task)
+            finally:
+                self.tasks.unregister(task)
+        except TaskCancelledError as e:
+            raise ApiError(400, "task_cancelled_exception", str(e)) from None
         except ValueError as e:
             raise ApiError(400, "search_phase_execution_exception", str(e)) from None
         out = response.to_json(index)
-        if cache_key is not None:
+        if cache_key is not None and not response.timed_out:
             self.request_cache.put(cache_key, out)
         return out
 
@@ -696,7 +704,7 @@ class Node:
                 del self._scrolls[sid]
 
     def _start_scroll(
-        self, svc: IndexService, index: str, request, scroll: str
+        self, svc: IndexService, index: str, request, scroll: str, task=None
     ) -> dict:
         if request.from_:
             raise ApiError(
@@ -739,9 +747,9 @@ class Node:
                 handles = [h for snap in ctx.snapshots for h in snap]
                 _, aggregations = Aggregator(
                     svc.engines[0], request.aggs, handles=handles
-                ).run(request.query, stats=ctx.stats)
+                ).run(request.query, stats=ctx.stats, task=task)
             with ctx.lock:
-                page = coord.scroll_page(ctx)
+                page = coord.scroll_page(ctx, task=task)
         except Exception:
             with self._scroll_lock:
                 self._scrolls.pop(scroll_id, None)
@@ -767,8 +775,16 @@ class Node:
             )
         if body.get("scroll"):
             ctx.deadline = time.monotonic() + _parse_keepalive(body["scroll"])
-        with ctx.lock:  # concurrent use of one scroll id serializes
-            page = ctx.coordinator.scroll_page(ctx)
+        task = self.tasks.register(
+            "indices:data/read/scroll", description=f"scroll[{scroll_id}]"
+        )
+        try:
+            with ctx.lock:  # concurrent use of one scroll id serializes
+                page = ctx.coordinator.scroll_page(ctx, task=task)
+        except TaskCancelledError as e:
+            raise ApiError(400, "task_cancelled_exception", str(e)) from None
+        finally:
+            self.tasks.unregister(task)
         page.scroll_id = scroll_id
         return page.to_json(ctx.index)
 
@@ -905,6 +921,47 @@ class Node:
         for svc in self.indices.values():
             for engine in svc.engines:
                 engine.close()
+
+    # ---------------------------------------------------------------- tasks
+
+    def list_tasks(self, actions: str | None = None) -> dict:
+        return {
+            "nodes": {
+                self.node_name: {
+                    "name": self.node_name,
+                    "tasks": {
+                        t.id: t.to_json() for t in self.tasks.list(actions)
+                    },
+                }
+            }
+        }
+
+    def get_task(self, task_id: str) -> dict:
+        task = self.tasks.get(task_id)
+        if task is None:
+            raise ApiError(
+                404,
+                "resource_not_found_exception",
+                f"task [{task_id}] isn't running and hasn't stored its results",
+            )
+        return {"completed": False, "task": task.to_json()}
+
+    def cancel_task(self, task_id: str) -> dict:
+        task = self.tasks.cancel(task_id)
+        if task is None:
+            raise ApiError(
+                404,
+                "resource_not_found_exception",
+                f"task [{task_id}] is not found",
+            )
+        return {
+            "nodes": {
+                self.node_name: {
+                    "name": self.node_name,
+                    "tasks": {task.id: task.to_json()},
+                }
+            }
+        }
 
     # ---------------------------------------------------------------- admin
 

@@ -85,6 +85,15 @@ class RestServer:
             "tagline": "You Know, for (TPU) Search",
         })
         r("GET", "/_cluster/health", lambda s, p, q, b: n.cluster_health())
+        r("GET", "/_tasks", lambda s, p, q, b: n.list_tasks(
+            q.get("actions")
+        ))
+        r("GET", "/_tasks/{task_id}", lambda s, p, q, b: n.get_task(
+            p["task_id"]
+        ))
+        r("POST", "/_tasks/{task_id}/_cancel", lambda s, p, q, b: n.cancel_task(
+            p["task_id"]
+        ))
         r("GET", "/_cat/indices", lambda s, p, q, b: n.cat_indices())
         r("GET", "/_stats", lambda s, p, q, b: n.stats())
         r("POST", "/_bulk", lambda s, p, q, b: n.bulk(

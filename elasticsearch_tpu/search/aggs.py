@@ -456,7 +456,7 @@ class Aggregator:
 
     # ----------------------------------------------------------- execute
 
-    def run(self, query, stats=None) -> tuple[int, dict[str, Any]]:
+    def run(self, query, stats=None, task=None) -> tuple[int, dict[str, Any]]:
         """Execute over every segment; returns (total_hits, rendered aggs).
 
         One XLA program per segment evaluates the query once and every
@@ -476,6 +476,13 @@ class Aggregator:
         states = [new_merge_state(n) for n in self.nodes]
         total = 0
         for handle in self.handles:
+            if task is not None:
+                # Per-segment polling (kernel-launch boundary): a tripped
+                # deadline stops launching and renders the segments done
+                # so far — the reference's partial aggs on timeout.
+                task.raise_if_cancelled()
+                if task.check_deadline():
+                    break
             compiler = self.engine.compiler_for(handle, stats)
             compiled = compiler.compile(query)
             specs, arrays = self.compile_for(handle, compiler)

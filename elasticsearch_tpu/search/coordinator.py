@@ -103,7 +103,7 @@ class ShardedSearchCoordinator:
             self._stats_gen = gen
         return self._stats_cache
 
-    def search(self, request: SearchRequest) -> SearchResponse:
+    def search(self, request: SearchRequest, task=None) -> SearchResponse:
         import time
 
         start = time.monotonic()
@@ -123,21 +123,22 @@ class ShardedSearchCoordinator:
             handles = [h for snap in snapshots for h in snap]
             agg_total, aggregations = Aggregator(
                 self.engines[0], request.aggs, handles=handles
-            ).run(request.query, stats=stats)
+            ).run(request.query, stats=stats, task=task)
 
         shard_request = replace(
             request, from_=0, size=k, aggs=None, track_total_hits=True
         )
         if k > 0 or agg_total is None:
-            merged, total, max_score = self._scatter_merge(
-                shard_request, stats, snapshots
+            merged, total, max_score, timed_out = self._scatter_merge(
+                shard_request, stats, snapshots, task=task
             )
         else:
-            merged, total, max_score = [], 0, None
+            merged, total, max_score, timed_out = [], 0, None, False
+        if task is not None and task.timed_out:
+            timed_out = True
         if agg_total is not None:
             total = agg_total
 
-        merged.sort(key=lambda t: (t[0], t[1], t[2]))
         page = merged[request.from_ : request.from_ + request.size]
         took = int((time.monotonic() - start) * 1000)
         total_out, relation = clamp_total(total, request.track_total_hits)
@@ -149,6 +150,7 @@ class ShardedSearchCoordinator:
             hits=[hit for _, _, _, hit in page],
             aggregations=aggregations,
             shards=len(self.engines),
+            timed_out=timed_out,
         )
 
     def open_scroll(
@@ -179,16 +181,23 @@ class ShardedSearchCoordinator:
         stats,
         snapshots: list[list],
         per_shard_after: list | None = None,
-    ) -> tuple[list[tuple], int, float | None]:
+        task=None,
+    ) -> tuple[list[tuple], int, float | None, bool]:
         """Fan one request out to every shard and merge by
         (merge key, shard, per-shard rank) — the single implementation of
         the coordinator reduce contract used by both first-page search and
         scroll continuation. Returns (sorted merged tuples, total,
-        max_score)."""
+        max_score, timed_out)."""
         merged: list[tuple] = []
         total = 0
         max_score = None
+        timed_out = False
         for shard_idx, svc in enumerate(self.services):
+            if task is not None:
+                task.raise_if_cancelled()
+                if task.check_deadline():
+                    timed_out = True
+                    break
             sub = request
             after = (
                 per_shard_after[shard_idx] if per_shard_after is not None
@@ -198,7 +207,10 @@ class ShardedSearchCoordinator:
                 sub = replace(
                     request, search_after=[after[0]], after_doc=after[1]
                 )
-            resp = svc.search(sub, stats=stats, segments=snapshots[shard_idx])
+            resp = svc.search(
+                sub, stats=stats, segments=snapshots[shard_idx], task=task
+            )
+            timed_out = timed_out or resp.timed_out
             total += resp.total or 0
             if resp.max_score is not None:
                 max_score = (
@@ -211,17 +223,17 @@ class ShardedSearchCoordinator:
                     (self._merge_key(request, hit), shard_idx, rank, hit)
                 )
         merged.sort(key=lambda t: (t[0], t[1], t[2]))
-        return merged, total, max_score
+        return merged, total, max_score, timed_out
 
-    def scroll_page(self, ctx: ScrollContext) -> SearchResponse:
+    def scroll_page(self, ctx: ScrollContext, task=None) -> SearchResponse:
         """Serve the next page of a scroll and advance its cursors."""
         import time
 
         start = time.monotonic()
         request = ctx.request
         size = max(0, request.size)
-        merged, total, max_score = self._scatter_merge(
-            request, ctx.stats, ctx.snapshots, ctx.per_shard_after
+        merged, total, max_score, timed_out = self._scatter_merge(
+            request, ctx.stats, ctx.snapshots, ctx.per_shard_after, task=task
         )
         page = merged[:size]
         for _, shard_idx, _, hit in page:
@@ -239,6 +251,7 @@ class ShardedSearchCoordinator:
             max_score=max_score,
             hits=[hit for _, _, _, hit in page],
             shards=len(self.engines),
+            timed_out=timed_out,
         )
 
     @staticmethod

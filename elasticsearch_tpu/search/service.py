@@ -66,6 +66,7 @@ class SearchResponse:
     aggregations: dict[str, Any] | None = None
     shards: int = 1
     scroll_id: str | None = None
+    timed_out: bool = False
 
     def to_json(self, index_name: str = "index") -> dict[str, Any]:
         hits_obj: dict[str, Any] = {
@@ -79,7 +80,7 @@ class SearchResponse:
             }
         out = {
             "took": self.took_ms,
-            "timed_out": False,
+            "timed_out": self.timed_out,
             "_shards": {
                 "total": self.shards,
                 "successful": self.shards,
@@ -160,6 +161,9 @@ class SearchRequest:
     # int = exact up to the threshold then ("gte", threshold). ES default
     # is 10_000 (search/internal/SearchContext TRACK_TOTAL_HITS_UP_TO).
     track_total_hits: bool | int = 10_000
+    # Wall-clock budget in seconds (body "timeout"); polled at segment
+    # boundaries — partial results with timed_out: true past it.
+    timeout_s: float | None = None
 
     @classmethod
     def from_json(cls, body: dict[str, Any] | None) -> "SearchRequest":
@@ -238,6 +242,9 @@ class SearchRequest:
         tth = body.get("track_total_hits", 10_000)
         if not isinstance(tth, bool):
             tth = int(tth)
+        timeout_s = None
+        if "timeout" in body:
+            timeout_s = _parse_timeout(body["timeout"])
         return cls(
             query=query,
             size=int(body.get("size", 10)),
@@ -248,10 +255,22 @@ class SearchRequest:
             aggs=aggs,
             search_after=search_after,
             track_total_hits=tth,
+            timeout_s=timeout_s,
         )
 
 
 _NO_SORT = object()  # sentinel: hit carries no sort values (default score sort)
+
+def _parse_timeout(value) -> float | None:
+    """ES search timeout → seconds; None disables (the -1 sentinel)."""
+    from ..common.units import parse_duration_s
+
+    if isinstance(value, bool):
+        raise ValueError(f"failed to parse timeout value [{value}]")
+    if isinstance(value, (int, float)):
+        # Bare numbers are milliseconds; negative = no timeout (ES -1).
+        return None if value < 0 else float(value) / 1000.0
+    return parse_duration_s(value)
 
 
 class SearchService:
@@ -266,6 +285,7 @@ class SearchService:
         request: SearchRequest,
         stats: dict[str, FieldStats] | None = None,
         segments: list | None = None,
+        task=None,  # common.tasks.Task: cancellation + timeout polling
     ) -> SearchResponse:
         """Execute one request against this shard.
 
@@ -296,17 +316,27 @@ class SearchService:
 
             agg_total, aggregations = Aggregator(
                 self.engine, request.aggs, handles=segments
-            ).run(request.query, stats=stats)
+            ).run(request.query, stats=stats, task=task)
 
         # Candidate tuples: (merge_key, global_doc, handle, local, score,
         # sort_value). merge_key ascending + global doc id ascending gives
         # Lucene's ordering for both score sort (key = -score) and field sort.
         candidates: list[tuple] = []
         total = 0
+        timed_out = task is not None and task.timed_out  # agg pass may trip
         if k > 0 or agg_total is None:
             for handle in segments:
                 if handle.segment.num_docs == 0:
                     continue
+                if task is not None:
+                    # Kernel-launch-boundary polling: the analog of the
+                    # reference's per-segment cancellation check
+                    # (ContextIndexSearcher.java:91) — an XLA program is
+                    # not interruptible, so granularity is one segment.
+                    task.raise_if_cancelled()
+                    if task.check_deadline():
+                        timed_out = True
+                        break
                 total += self._query_segment(
                     handle, request, k, stats, candidates
                 )
@@ -341,6 +371,7 @@ class SearchService:
             max_score=max_score,
             hits=hits,
             aggregations=aggregations,
+            timed_out=timed_out,
         )
 
     def _validate_sort(self, request: SearchRequest) -> None:

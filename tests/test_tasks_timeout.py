@@ -1,0 +1,164 @@
+"""Tasks registry, query timeout (partial results), cancellation.
+
+Reference: tasks/TaskManager.java, cancellation polled in the scoring
+loop (search/internal/ContextIndexSearcher.java:91), QueryPhase timeout.
+"""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.tasks import Task, TaskCancelledError, TaskManager
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.node import ApiError, Node
+from elasticsearch_tpu.rest.server import RestServer
+from elasticsearch_tpu.search.service import SearchRequest, SearchService
+
+MAPPINGS = {"properties": {"t": {"type": "text"}, "n": {"type": "long"}}}
+
+
+def seed(node, index="tk", n=40, segments=4):
+    node.create_index(index, {"mappings": MAPPINGS})
+    per = n // segments
+    for i in range(n):
+        node.index_doc(index, {"t": f"w{i % 3} common", "n": i}, f"d{i}")
+        if (i + 1) % per == 0:
+            node.refresh(index)
+    node.refresh(index)
+
+
+def test_task_manager_basics():
+    tm = TaskManager("nodeX")
+    t1 = tm.register("indices:data/read/search", "idx[a]")
+    t2 = tm.register("indices:data/write/bulk", "bulk")
+    assert t1.id == "nodeX:1" and t2.id == "nodeX:2"
+    assert {t.id for t in tm.list()} == {t1.id, t2.id}
+    assert [t.id for t in tm.list("indices:data/read/*")] == [t1.id]
+    tm.cancel(t1.id)
+    assert tm.get(t1.id).cancelled
+    with pytest.raises(TaskCancelledError):
+        t1.raise_if_cancelled()
+    tm.unregister(t1)
+    tm.unregister(t2)
+    assert tm.list() == []
+
+
+def test_expired_deadline_returns_partial_timed_out():
+    engine = Engine(Mappings.from_json(MAPPINGS))
+    for i in range(20):
+        engine.index({"t": "x y z", "n": i}, f"d{i}")
+    engine.refresh()
+    task = Task(
+        id="n:1", action="s", description="",
+        deadline=time.monotonic() - 1.0,
+    )
+    resp = SearchService(engine).search(
+        SearchRequest.from_json({"query": {"match_all": {}}}), task=task
+    )
+    assert resp.timed_out is True
+    assert resp.hits == [] and resp.total == 0
+
+
+def test_timeout_zero_over_node_and_not_cached():
+    node = Node()
+    seed(node)
+    r = node.search("tk", {"query": {"match_all": {}}, "timeout": "0ms",
+                          "size": 0})
+    assert r["timed_out"] is True
+    # a timed-out (partial) response must not poison the request cache
+    r2 = node.search("tk", {"query": {"match_all": {}}, "size": 0})
+    assert r2["timed_out"] is False
+    assert r2["hits"]["total"]["value"] == 40
+
+
+def test_timeout_minus_one_disables():
+    node = Node()
+    seed(node)
+    r = node.search("tk", {"query": {"match_all": {}}, "timeout": -1,
+                          "size": 0})
+    assert r["timed_out"] is False
+    assert r["hits"]["total"]["value"] == 40
+
+
+def test_agg_only_request_honors_timeout():
+    node = Node()
+    seed(node)
+    r = node.search(
+        "tk",
+        {
+            "size": 0,
+            "timeout": "0ms",
+            "aggs": {"mx": {"max": {"field": "n"}}},
+        },
+    )
+    assert r["timed_out"] is True
+    assert r["aggregations"]["mx"]["value"] is None  # no segment ran
+
+
+def test_generous_timeout_not_timed_out():
+    node = Node()
+    seed(node)
+    r = node.search("tk", {"query": {"match": {"t": "common"}},
+                          "timeout": "1m"})
+    assert r["timed_out"] is False
+    assert r["hits"]["total"]["value"] == 40
+
+
+def test_cancel_mid_search(monkeypatch):
+    node = Node()
+    seed(node, segments=8)
+    from elasticsearch_tpu.search import service as service_mod
+
+    started = threading.Event()
+    release = threading.Event()
+    orig = service_mod.bm25_device.execute_auto
+
+    def slow(*args, **kwargs):
+        started.set()
+        release.wait(timeout=5)
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(service_mod.bm25_device, "execute_auto", slow)
+    result: dict = {}
+
+    def run():
+        try:
+            node.search("tk", {"query": {"match": {"t": "common"}}})
+            result["outcome"] = "completed"
+        except ApiError as e:
+            result["outcome"] = e.err_type
+
+    worker = threading.Thread(target=run)
+    worker.start()
+    assert started.wait(timeout=5)
+    tasks = node.list_tasks("indices:data/read/search")
+    running = tasks["nodes"][node.node_name]["tasks"]
+    assert len(running) == 1
+    task_id = next(iter(running))
+    node.cancel_task(task_id)
+    release.set()
+    worker.join(timeout=10)
+    assert result["outcome"] == "task_cancelled_exception"
+    # the task is gone from the registry after the request unwinds
+    assert node.list_tasks()["nodes"][node.node_name]["tasks"] == {}
+
+
+def test_tasks_rest_routes():
+    rest = RestServer()
+    status, resp = rest.dispatch("GET", "/_tasks", {}, "")
+    assert status == 200
+    assert rest.node.node_name in resp["nodes"]
+    status, resp = rest.dispatch("GET", "/_tasks/none:99", {}, "")
+    assert status == 404
+    status, resp = rest.dispatch("POST", "/_tasks/none:99/_cancel", {}, "")
+    assert status == 404
+    # a live task is visible and cancellable over REST
+    task = rest.node.tasks.register("indices:data/read/search", "probe")
+    status, resp = rest.dispatch("GET", f"/_tasks/{task.id}", {}, "")
+    assert status == 200 and resp["task"]["action"] == "indices:data/read/search"
+    status, resp = rest.dispatch("POST", f"/_tasks/{task.id}/_cancel", {}, "")
+    assert status == 200
+    assert rest.node.tasks.get(task.id).cancelled
+    rest.node.tasks.unregister(task)
