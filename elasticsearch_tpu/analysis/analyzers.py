@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass, field
-from typing import Callable, Iterable
+from typing import Any, Callable, Iterable
 
 Token = str
 TokenFilter = Callable[[list[Token]], list[Token]]
@@ -49,6 +49,35 @@ class Analyzer:
             tokens = f(tokens)
         return tokens
 
+    def _carry_filters(
+        self, items: list[tuple[Token, Any]]
+    ) -> list[tuple[Token, Any]]:
+        """Thread (token, payload) pairs through the filter chain, keeping
+        each surviving token's payload (a position, an offset span, ...).
+
+        Three filter shapes: marked drop filters (stopset attribute) keep
+        gaps; length-preserving outputs are 1:1 order-preserving maps;
+        anything else falls back to per-token application. The single
+        implementation behind both positions (phrase matching) and offsets
+        (highlighting) so the two can never desynchronize.
+        """
+        for f in self.filters:
+            stopset = getattr(f, "stopset", None)
+            if stopset is not None:
+                items = [it for it in items if it[0] not in stopset]
+                continue
+            mapped = f([tok for tok, _ in items])
+            if len(mapped) == len(items):
+                items = [(m, p) for m, (_, p) in zip(mapped, items)]
+                continue
+            out = []
+            for tok, p in items:
+                r = f([tok])
+                if r:
+                    out.append((r[0], p))
+            items = out
+        return items
+
     def analyze_positions(self, text: str) -> tuple[list[tuple[Token, int]], int]:
         """((token, position) pairs, total position span).
 
@@ -59,25 +88,20 @@ class Analyzer:
         tokenizer's position count (for multi-value position offsets).
         """
         tokens = self.tokenizer(text)
-        span = len(tokens)
-        pairs = [(t, i) for i, t in enumerate(tokens)]
-        for f in self.filters:
-            stopset = getattr(f, "stopset", None)
-            if stopset is not None:  # drop filter: keep position gaps
-                pairs = [(t, p) for t, p in pairs if t not in stopset]
-                continue
-            mapped = f([t for t, _ in pairs])
-            if len(mapped) == len(pairs):  # 1:1 order-preserving map
-                pairs = [(m, p) for m, (_, p) in zip(mapped, pairs)]
-                continue
-            # Unknown drop/split filter: per-token fallback keeps positions.
-            new_pairs = []
-            for t, p in pairs:
-                out = f([t])
-                if out:
-                    new_pairs.append((out[0], p))
-            pairs = new_pairs
-        return pairs, span
+        pairs = self._carry_filters([(t, i) for i, t in enumerate(tokens)])
+        return pairs, len(tokens)
+
+    def analyze_offsets(self, text: str) -> list[tuple[Token, int, int]]:
+        """(token, char_start, char_end) triples — the highlighter's view
+        (Lucene's OffsetAttribute). Offsets always reference the ORIGINAL
+        text even through token-mapping filters."""
+        spans = _TOKENIZER_SPANS.get(self.tokenizer)
+        if spans is None:  # unknown tokenizer: no offset support
+            return []
+        carried = self._carry_filters(
+            [(tok, (s, e)) for tok, s, e in spans(text)]
+        )
+        return [(tok, s, e) for tok, (s, e) in carried]
 
     def __call__(self, text: str) -> list[Token]:
         return self.analyze(text)
@@ -97,6 +121,25 @@ def _whitespace_tokenize(text: str) -> list[Token]:
 
 def _keyword_tokenize(text: str) -> list[Token]:
     return [text] if text else []
+
+
+_WS_RE = re.compile(r"\S+")
+
+
+def _spans_from_re(regex):
+    def spans(text: str) -> list[tuple[Token, int, int]]:
+        return [(m.group(), m.start(), m.end()) for m in regex.finditer(text)]
+
+    return spans
+
+
+def _keyword_spans(text: str) -> list[tuple[Token, int, int]]:
+    return [(text, 0, len(text))] if text else []
+
+
+# Offset-producing twins of each tokenizer (highlighting needs character
+# offsets; the plain tokenizers stay allocation-light for indexing).
+_TOKENIZER_SPANS = {}
 
 
 def lowercase_filter(tokens: list[Token]) -> list[Token]:
@@ -127,6 +170,15 @@ def make_asciifolding_filter() -> TokenFilter:
 
     return fold
 
+
+_TOKENIZER_SPANS.update(
+    {
+        _standard_tokenize: _spans_from_re(_WORD_RE),
+        _letter_tokenize: _spans_from_re(_LETTER_RE),
+        _whitespace_tokenize: _spans_from_re(_WS_RE),
+        _keyword_tokenize: _keyword_spans,
+    }
+)
 
 StandardAnalyzer = Analyzer("standard", _standard_tokenize, [lowercase_filter])
 SimpleAnalyzer = Analyzer("simple", _letter_tokenize, [lowercase_filter])

@@ -125,8 +125,19 @@ class ShardedSearchCoordinator:
                 self.engines[0], request.aggs, handles=handles
             ).run(request.query, stats=stats, task=task)
 
+        # Fetch subphases (highlight/docvalue_fields/fields) are stripped
+        # from the per-shard pass and applied only to the merged page —
+        # each shard returns from+size candidates, most of which the merge
+        # discards; re-analyzing text for them would be pure waste.
         shard_request = replace(
-            request, from_=0, size=k, aggs=None, track_total_hits=True
+            request,
+            from_=0,
+            size=k,
+            aggs=None,
+            track_total_hits=True,
+            highlight=None,
+            docvalue_fields=None,
+            fields=None,
         )
         if k > 0 or agg_total is None:
             merged, total, max_score, timed_out = self._scatter_merge(
@@ -140,6 +151,8 @@ class ShardedSearchCoordinator:
             total = agg_total
 
         page = merged[request.from_ : request.from_ + request.size]
+        page_hits = [hit for _, _, _, hit in page]
+        self._apply_fetch_subphases(request, page_hits)
         took = int((time.monotonic() - start) * 1000)
         total_out, relation = clamp_total(total, request.track_total_hits)
         return SearchResponse(
@@ -147,11 +160,27 @@ class ShardedSearchCoordinator:
             total=total_out,
             total_relation=relation,
             max_score=max_score,
-            hits=[hit for _, _, _, hit in page],
+            hits=page_hits,
             aggregations=aggregations,
             shards=len(self.engines),
             timed_out=timed_out,
         )
+
+    def _apply_fetch_subphases(self, request: SearchRequest, hits) -> None:
+        """Run highlight/docvalue_fields/fields over the final page only."""
+        if (
+            request.highlight is None
+            and not request.docvalue_fields
+            and not request.fields
+        ):
+            return
+        svc = self.services[0]  # mappings are index-wide
+        hl_ctx = svc._highlight_context(request)
+        for hit in hits:
+            if hit.handle is None:
+                continue
+            hit.highlight = svc._fetch_highlight(hit.handle, hit.local, hl_ctx)
+            hit.fields = svc._fetch_fields(hit.handle, hit.local, request)
 
     def open_scroll(
         self, index: str, request: SearchRequest, keep_alive_s: float
@@ -232,8 +261,11 @@ class ShardedSearchCoordinator:
         start = time.monotonic()
         request = ctx.request
         size = max(0, request.size)
+        stripped = replace(
+            request, highlight=None, docvalue_fields=None, fields=None
+        )
         merged, total, max_score, timed_out = self._scatter_merge(
-            request, ctx.stats, ctx.snapshots, ctx.per_shard_after, task=task
+            stripped, ctx.stats, ctx.snapshots, ctx.per_shard_after, task=task
         )
         page = merged[:size]
         for _, shard_idx, _, hit in page:
@@ -243,13 +275,15 @@ class ShardedSearchCoordinator:
                 else hit.score
             )
             ctx.per_shard_after[shard_idx] = (cursor_value, hit.global_doc)
+        page_hits = [hit for _, _, _, hit in page]
+        self._apply_fetch_subphases(request, page_hits)
         total_out, relation = clamp_total(total, ctx.track_total_hits)
         return SearchResponse(
             took_ms=int((time.monotonic() - start) * 1000),
             total=total_out,
             total_relation=relation,
             max_score=max_score,
-            hits=[hit for _, _, _, hit in page],
+            hits=page_hits,
             shards=len(self.engines),
             timed_out=timed_out,
         )

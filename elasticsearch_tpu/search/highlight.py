@@ -1,0 +1,216 @@
+"""Plain highlighter: re-analyze stored text, tag query-matched tokens.
+
+The analog of the reference's unified/plain highlighters
+(search/fetch/subphase/highlight/ — PlainHighlighter re-analyzes the
+stored field with the index analyzer and tags tokens the query matches).
+Runs on the host during the fetch phase, only over the returned page.
+
+Supported options per field (HighlightBuilder subset): pre_tags /
+post_tags, fragment_size (default 100), number_of_fragments (default 5;
+0 = whole value untruncated), require_field_match (default true).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Callable
+
+from ..query.dsl import (
+    BoolQuery,
+    ConstantScoreQuery,
+    DisMaxQuery,
+    FuzzyQuery,
+    MatchPhrasePrefixQuery,
+    MatchPhraseQuery,
+    MatchQuery,
+    PrefixQuery,
+    Query,
+    ScriptScoreQuery,
+    TermQuery,
+    TermsQuery,
+    WildcardQuery,
+)
+
+
+@dataclass
+class HighlightField:
+    name: str
+    pre_tag: str = "<em>"
+    post_tag: str = "</em>"
+    fragment_size: int = 100
+    number_of_fragments: int = 5
+    require_field_match: bool = True
+
+
+@dataclass
+class HighlightSpec:
+    fields: list[HighlightField] = dc_field(default_factory=list)
+
+
+def parse_highlight(body: dict[str, Any]) -> HighlightSpec:
+    """Parse the request's "highlight" object (HighlightBuilder shapes)."""
+    g_pre = (body.get("pre_tags") or ["<em>"])[0]
+    g_post = (body.get("post_tags") or ["</em>"])[0]
+    fields = []
+    raw = body.get("fields", {})
+    items = raw.items() if isinstance(raw, dict) else (
+        (name, opts) for d in raw for name, opts in d.items()
+    )
+    for name, opts in items:
+        opts = opts or {}
+        fields.append(
+            HighlightField(
+                name=name,
+                pre_tag=(opts.get("pre_tags") or [g_pre])[0],
+                post_tag=(opts.get("post_tags") or [g_post])[0],
+                fragment_size=int(
+                    opts.get("fragment_size", body.get("fragment_size", 100))
+                ),
+                number_of_fragments=int(
+                    opts.get(
+                        "number_of_fragments",
+                        body.get("number_of_fragments", 5),
+                    )
+                ),
+                require_field_match=bool(
+                    opts.get(
+                        "require_field_match",
+                        body.get("require_field_match", True),
+                    )
+                ),
+            )
+        )
+    return HighlightSpec(fields=fields)
+
+
+def collect_query_terms(
+    query: Query, field_name: str, mappings, match_any_field: bool = False
+) -> tuple[set[str], list[Callable[[str], bool]]]:
+    """(exact token set, token predicates) the query can match on a field.
+
+    `match_any_field` implements require_field_match=false: terms from
+    every field are collected. Mirrors the reference extracting terms from
+    the rewritten query (QueryBuilder.extractTerms equivalent)."""
+    terms: set[str] = set()
+    preds: list[Callable[[str], bool]] = []
+
+    def field_ok(f: str) -> bool:
+        return match_any_field or f == field_name
+
+    def query_analyzer(q) -> Any:
+        # Honor the per-query analyzer override exactly like the compiler
+        # (query/compile.py) so highlighting sees the same tokens.
+        if getattr(q, "analyzer", None):
+            return mappings.analysis.get(q.analyzer)
+        return mappings.analyzer_for(q.field_name, search=True)
+
+    def walk(q: Query) -> None:
+        if isinstance(q, MatchQuery) and field_ok(q.field_name):
+            terms.update(query_analyzer(q).analyze(q.query))
+        elif isinstance(q, (MatchPhraseQuery, MatchPhrasePrefixQuery)) and field_ok(
+            q.field_name
+        ):
+            toks = query_analyzer(q).analyze(q.query)
+            if isinstance(q, MatchPhrasePrefixQuery) and toks:
+                *head, last = toks
+                terms.update(head)
+                preds.append(lambda t, p=last: t.startswith(p))
+            else:
+                terms.update(toks)
+        elif isinstance(q, TermQuery) and field_ok(q.field_name):
+            terms.add(str(q.value))
+        elif isinstance(q, TermsQuery) and field_ok(q.field_name):
+            terms.update(str(v) for v in q.values)
+        elif isinstance(q, PrefixQuery) and field_ok(q.field_name):
+            v = q.value.lower() if q.case_insensitive else q.value
+            preds.append(
+                lambda t, p=v, ci=q.case_insensitive: (
+                    t.lower() if ci else t
+                ).startswith(p)
+            )
+        elif isinstance(q, WildcardQuery) and field_ok(q.field_name):
+            from ..query.compile import _wildcard_regex
+
+            rx = _wildcard_regex(q.value, q.case_insensitive)
+            preds.append(lambda t, r=rx: bool(r.fullmatch(t)))
+        elif isinstance(q, FuzzyQuery) and field_ok(q.field_name):
+            from ..query.compile import _auto_fuzziness, _damerau_bounded
+
+            max_edits = _auto_fuzziness(q.fuzziness, q.value)
+            preds.append(
+                lambda t, v=q.value, m=max_edits: _damerau_bounded(v, t, m)
+                is not None
+            )
+        elif isinstance(q, BoolQuery):
+            for clause in (*q.must, *q.should, *q.filter):
+                walk(clause)  # must_not never highlights
+        elif isinstance(q, DisMaxQuery):
+            for clause in q.queries:
+                walk(clause)
+        elif isinstance(q, ConstantScoreQuery) and q.filter is not None:
+            walk(q.filter)
+        elif isinstance(q, ScriptScoreQuery) and q.query is not None:
+            walk(q.query)
+
+    walk(query)
+    return terms, preds
+
+
+def highlight_value(
+    text: str,
+    analyzer,
+    terms: set[str],
+    preds: list[Callable[[str], bool]],
+    opts: HighlightField,
+) -> list[str]:
+    """Tagged fragments of one stored value; [] when nothing matches."""
+    triples = analyzer.analyze_offsets(text)
+    matches = [
+        (s, e)
+        for tok, s, e in triples
+        if tok in terms or any(p(tok) for p in preds)
+    ]
+    if not matches:
+        return []
+    if opts.number_of_fragments == 0:
+        return [_tag(text, matches, opts)]
+    # Simple fragmenter: greedy ~fragment_size character windows aligned
+    # to token boundaries; emit windows containing matches, source order.
+    fragments: list[tuple[int, int, list[tuple[int, int]]]] = []
+    frag_start = 0
+    frag_matches: list[tuple[int, int]] = []
+    mi = 0
+    last_end = len(text)
+    for tok, s, e in triples:
+        if e - frag_start > opts.fragment_size and s > frag_start:
+            while mi < len(matches) and matches[mi][0] < s:
+                frag_matches.append(matches[mi])
+                mi += 1
+            if frag_matches:
+                fragments.append((frag_start, s, frag_matches))
+            frag_start = s
+            frag_matches = []
+    while mi < len(matches):
+        frag_matches.append(matches[mi])
+        mi += 1
+    if frag_matches:
+        fragments.append((frag_start, last_end, frag_matches))
+    out = []
+    for start, end, ms in fragments[: opts.number_of_fragments]:
+        out.append(
+            _tag(text[start:end], [(s - start, e - start) for s, e in ms], opts)
+        )
+    return out
+
+
+def _tag(text: str, spans: list[tuple[int, int]], opts: HighlightField) -> str:
+    parts = []
+    pos = 0
+    for s, e in spans:
+        parts.append(text[pos:s])
+        parts.append(opts.pre_tag)
+        parts.append(text[s:e])
+        parts.append(opts.post_tag)
+        pos = e
+    parts.append(text[pos:])
+    return "".join(parts).rstrip()

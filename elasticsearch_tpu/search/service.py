@@ -42,6 +42,12 @@ class SearchHit:
     source: dict[str, Any] | None
     sort: list[Any] | None = None
     global_doc: int = -1
+    highlight: dict[str, list[str]] | None = None
+    fields: dict[str, list[Any]] | None = None
+    # Internal addressing for coordinator-side fetch subphases (not
+    # serialized): the owning segment handle + local doc id.
+    handle: Any = None
+    local: int = -1
 
     def to_json(self, index_name: str = "index") -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -51,6 +57,10 @@ class SearchHit:
         }
         if self.source is not None:
             out["_source"] = self.source
+        if self.fields is not None:
+            out["fields"] = self.fields
+        if self.highlight is not None:
+            out["highlight"] = self.highlight
         if self.sort is not None:
             out["sort"] = self.sort
         return out
@@ -164,6 +174,9 @@ class SearchRequest:
     # Wall-clock budget in seconds (body "timeout"); polled at segment
     # boundaries — partial results with timed_out: true past it.
     timeout_s: float | None = None
+    highlight: Any = None  # highlight.HighlightSpec
+    docvalue_fields: list[str] | None = None
+    fields: list[str] | None = None  # retrieved from _source
 
     @classmethod
     def from_json(cls, body: dict[str, Any] | None) -> "SearchRequest":
@@ -245,6 +258,23 @@ class SearchRequest:
         timeout_s = None
         if "timeout" in body:
             timeout_s = _parse_timeout(body["timeout"])
+        highlight = None
+        if "highlight" in body:
+            from .highlight import parse_highlight
+
+            highlight = parse_highlight(body["highlight"])
+        docvalue_fields = None
+        if "docvalue_fields" in body:
+            docvalue_fields = [
+                f if isinstance(f, str) else f["field"]
+                for f in body["docvalue_fields"]
+            ]
+        fields = None
+        if "fields" in body:
+            fields = [
+                f if isinstance(f, str) else f["field"]
+                for f in body["fields"]
+            ]
         return cls(
             query=query,
             size=int(body.get("size", 10)),
@@ -256,10 +286,21 @@ class SearchRequest:
             search_after=search_after,
             track_total_hits=tth,
             timeout_s=timeout_s,
+            highlight=highlight,
+            docvalue_fields=docvalue_fields,
+            fields=fields,
         )
 
 
 _NO_SORT = object()  # sentinel: hit carries no sort values (default score sort)
+
+def _iso_millis(ms: float) -> str:
+    """Epoch millis → the reference's strict_date_optional_time rendering."""
+    from datetime import datetime, timezone
+
+    dt = datetime.fromtimestamp(ms / 1000.0, tz=timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+
 
 def _parse_timeout(value) -> float | None:
     """ES search timeout → seconds; None disables (the -1 sentinel)."""
@@ -352,6 +393,7 @@ class SearchService:
         max_score = None
         if request.sort is None and candidates:
             max_score = -candidates[0][0]
+        hl_ctx = self._highlight_context(request)
         for merge_key, global_doc, handle, local, score, sort_value in page:
             hits.append(
                 SearchHit(
@@ -360,6 +402,10 @@ class SearchService:
                     source=self._fetch_source(handle, local, request),
                     sort=None if sort_value is _NO_SORT else [sort_value],
                     global_doc=global_doc,
+                    highlight=self._fetch_highlight(handle, local, hl_ctx),
+                    fields=self._fetch_fields(handle, local, request),
+                    handle=handle,
+                    local=local,
                 )
             )
         took = int((time.monotonic() - start) * 1000)
@@ -584,6 +630,93 @@ class SearchService:
         return scores, ids
 
     # ------------------------------------------------------------------ fetch
+
+    def _highlight_context(self, request: SearchRequest):
+        """Per-request highlight state: query terms/predicates + analyzer
+        per highlighted field (computed once, applied per page hit)."""
+        if request.highlight is None or not request.highlight.fields:
+            return None
+        from .highlight import collect_query_terms
+
+        ctx = []
+        for hf in request.highlight.fields:
+            terms, preds = collect_query_terms(
+                request.query,
+                hf.name,
+                self.engine.mappings,
+                match_any_field=not hf.require_field_match,
+            )
+            analyzer = self.engine.mappings.analyzer_for(hf.name)
+            ctx.append((hf, terms, preds, analyzer))
+        return ctx
+
+    def _fetch_highlight(
+        self, handle: SegmentHandle, local: int, hl_ctx
+    ) -> dict[str, list[str]] | None:
+        if hl_ctx is None:
+            return None
+        from .highlight import highlight_value
+
+        src = handle.segment.sources[local]
+        out: dict[str, list[str]] = {}
+        for hf, terms, preds, analyzer in hl_ctx:
+            value = src.get(hf.name)
+            if value is None:
+                continue
+            frags: list[str] = []
+            for v in value if isinstance(value, list) else [value]:
+                frags.extend(
+                    highlight_value(str(v), analyzer, terms, preds, hf)
+                )
+            if hf.number_of_fragments:
+                frags = frags[: hf.number_of_fragments]
+            if frags:
+                out[hf.name] = frags
+        return out or None
+
+    def _fetch_fields(
+        self, handle: SegmentHandle, local: int, request: SearchRequest
+    ) -> dict[str, list[Any]] | None:
+        """docvalue_fields (from the columnar store) + fields (from
+        _source), both rendered as ES value arrays."""
+        if not request.docvalue_fields and not request.fields:
+            return None
+        out: dict[str, list[Any]] = {}
+        for f in request.docvalue_fields or []:
+            fm = self.engine.mappings.get(f)
+            if fm is not None and fm.type in ("keyword", "text"):
+                # Keyword "doc values" render from the stored source (the
+                # columnar store is numeric-only); text has no doc values.
+                if fm.type == "keyword":
+                    src = handle.segment.sources[local]
+                    if f in src and src[f] is not None:
+                        v = src[f]
+                        out[f] = (
+                            [str(x) for x in v]
+                            if isinstance(v, list)
+                            else [str(v)]
+                        )
+                continue
+            col = handle.segment.doc_values.get(f)
+            if col is None or np.isnan(col[local]):
+                continue
+            v = col[local]
+            if fm is None:
+                out[f] = [float(v)]
+            elif fm.type == "boolean":
+                out[f] = [bool(v)]
+            elif fm.type == "date":
+                out[f] = [_iso_millis(float(v))]
+            elif fm.type in ("long", "integer", "short", "byte"):
+                out[f] = [int(v)]
+            else:
+                out[f] = [float(v)]
+        for f in request.fields or []:
+            src = handle.segment.sources[local]
+            if f in src and src[f] is not None:
+                v = src[f]
+                out[f] = v if isinstance(v, list) else [v]
+        return out or None
 
     def _fetch_source(
         self, handle: SegmentHandle, local: int, request: SearchRequest

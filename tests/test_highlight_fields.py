@@ -1,0 +1,272 @@
+"""Highlighting + docvalue_fields/fields fetch subphases.
+
+Reference: search/fetch/subphase/highlight/ (plain highlighter),
+FetchDocValuesPhase, FetchFieldsPhase.
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+MAPPINGS = {
+    "properties": {
+        "title": {"type": "text"},
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "price": {"type": "double"},
+        "qty": {"type": "long"},
+    }
+}
+
+
+@pytest.fixture()
+def node():
+    node = Node()
+    node.create_index("h", {"mappings": MAPPINGS})
+    node.index_doc(
+        "h",
+        {
+            "title": "The quick brown fox",
+            "body": "A quick brown fox jumps over the lazy dog. "
+                    "The dog was not amused by the quick fox at all.",
+            "tag": "animal",
+            "price": 9.5,
+            "qty": 3,
+        },
+        "1",
+    )
+    node.index_doc(
+        "h",
+        {
+            "title": "Slow green turtle",
+            "body": "Turtles are slow and green and calm.",
+            "tag": "animal",
+            "price": 5.0,
+            "qty": 7,
+        },
+        "2",
+    )
+    node.refresh("h")
+    return node
+
+
+def test_basic_highlight(node):
+    r = node.search(
+        "h",
+        {
+            "query": {"match": {"body": "quick fox"}},
+            "highlight": {"fields": {"body": {}}},
+        },
+    )
+    hit = r["hits"]["hits"][0]
+    assert hit["_id"] == "1"
+    frags = hit["highlight"]["body"]
+    assert frags and all("<em>" in f for f in frags)
+    joined = " ".join(frags)
+    assert "<em>quick</em>" in joined and "<em>fox</em>" in joined
+    # non-matching hit has no highlight key
+    for h in r["hits"]["hits"]:
+        if h["_id"] == "2":
+            assert "highlight" not in h
+
+
+def test_highlight_custom_tags_and_whole_field(node):
+    r = node.search(
+        "h",
+        {
+            "query": {"match": {"title": "fox"}},
+            "highlight": {
+                "pre_tags": ["<b>"],
+                "post_tags": ["</b>"],
+                "fields": {"title": {"number_of_fragments": 0}},
+            },
+        },
+    )
+    frags = r["hits"]["hits"][0]["highlight"]["title"]
+    assert frags == ["The quick brown <b>fox</b>"]
+
+
+def test_highlight_fragmentation(node):
+    long_body = " ".join(
+        ["filler word soup"] * 12 + ["needle"] + ["more padding here"] * 12
+    )
+    node.index_doc("h", {"body": long_body}, "3", refresh=True)
+    r = node.search(
+        "h",
+        {
+            "query": {"match": {"body": "needle"}},
+            "highlight": {
+                "fields": {"body": {"fragment_size": 60,
+                                    "number_of_fragments": 2}}
+            },
+        },
+    )
+    frags = r["hits"]["hits"][0]["highlight"]["body"]
+    assert len(frags) >= 1
+    assert all(len(f) < 200 for f in frags)
+    assert any("<em>needle</em>" in f for f in frags)
+    assert len(long_body) > 300  # fragmentation actually trimmed
+
+
+def test_highlight_field_match_requirements(node):
+    # query matches title; asking to highlight body yields nothing by
+    # default, but require_field_match: false highlights cross-field
+    r = node.search(
+        "h",
+        {
+            "query": {"match": {"title": "quick"}},
+            "highlight": {"fields": {"body": {}}},
+        },
+    )
+    hit = r["hits"]["hits"][0]
+    assert "highlight" not in hit or "body" not in hit.get("highlight", {})
+    r = node.search(
+        "h",
+        {
+            "query": {"match": {"title": "quick"}},
+            "highlight": {
+                "fields": {"body": {"require_field_match": False}}
+            },
+        },
+    )
+    assert "<em>quick</em>" in " ".join(
+        r["hits"]["hits"][0]["highlight"]["body"]
+    )
+
+
+def test_highlight_phrase_and_prefix_queries(node):
+    r = node.search(
+        "h",
+        {
+            "query": {"match_phrase": {"body": "lazy dog"}},
+            "highlight": {"fields": {"body": {}}},
+        },
+    )
+    joined = " ".join(r["hits"]["hits"][0]["highlight"]["body"])
+    assert "<em>lazy</em>" in joined and "<em>dog</em>" in joined
+    r = node.search(
+        "h",
+        {
+            "query": {"prefix": {"body": "turt"}},
+            "highlight": {"fields": {"body": {}}},
+        },
+    )
+    assert "<em>Turtles</em>" in " ".join(
+        r["hits"]["hits"][0]["highlight"]["body"]
+    )
+
+
+def test_docvalue_fields_and_fields(node):
+    r = node.search(
+        "h",
+        {
+            "query": {"ids": {"values": ["1"]}},
+            "docvalue_fields": ["price", "qty"],
+            "fields": ["tag", "title"],
+            "_source": False,
+        },
+    )
+    hit = r["hits"]["hits"][0]
+    assert "_source" not in hit
+    assert hit["fields"]["price"] == [9.5]
+    assert hit["fields"]["qty"] == [3]
+    assert hit["fields"]["tag"] == ["animal"]
+    assert hit["fields"]["title"] == ["The quick brown fox"]
+
+
+def test_docvalue_fields_keyword_boolean_date():
+    n = Node()
+    n.create_index(
+        "types",
+        {
+            "mappings": {
+                "properties": {
+                    "k": {"type": "keyword"},
+                    "b": {"type": "boolean"},
+                    "d": {"type": "date"},
+                }
+            }
+        },
+    )
+    n.index_doc(
+        "types",
+        {"k": "red", "b": True, "d": 1700000000000},
+        "1",
+        refresh=True,
+    )
+    r = n.search(
+        "types",
+        {
+            "query": {"match_all": {}},
+            "docvalue_fields": ["k", "b", "d"],
+        },
+    )
+    fields = r["hits"]["hits"][0]["fields"]
+    assert fields["k"] == ["red"]
+    assert fields["b"] == [True]
+    assert fields["d"] == ["2023-11-14T22:13:20.000Z"]
+
+
+def test_highlight_honors_query_analyzer_override():
+    n = Node()
+    n.create_index(
+        "ov",
+        {
+            "mappings": {
+                "properties": {
+                    "t": {"type": "text", "analyzer": "standard"}
+                }
+            }
+        },
+    )
+    n.index_doc("ov", {"t": "quick brown fox"}, "1", refresh=True)
+    r = n.search(
+        "ov",
+        {
+            "query": {"match": {"t": {"query": "QUICK",
+                                      "analyzer": "standard"}}},
+            "highlight": {"fields": {"t": {}}},
+        },
+    )
+    assert "<em>quick</em>" in " ".join(
+        r["hits"]["hits"][0]["highlight"]["t"]
+    )
+
+
+def test_highlight_on_sharded_index():
+    n = Node()
+    n.create_index(
+        "sh",
+        {
+            "settings": {"index": {"number_of_shards": 4}},
+            "mappings": MAPPINGS,
+        },
+    )
+    for i in range(20):
+        n.index_doc("sh", {"body": f"document {i} mentions zebra today"},
+                    f"d{i}")
+    n.refresh("sh")
+    r = n.search(
+        "sh",
+        {
+            "query": {"match": {"body": "zebra"}},
+            "size": 3,
+            "highlight": {"fields": {"body": {}}},
+            "docvalue_fields": [],
+        },
+    )
+    assert len(r["hits"]["hits"]) == 3
+    for h in r["hits"]["hits"]:
+        assert "<em>zebra</em>" in " ".join(h["highlight"]["body"])
+
+
+def test_docvalue_fields_object_form_and_missing(node):
+    r = node.search(
+        "h",
+        {
+            "query": {"ids": {"values": ["2"]}},
+            "docvalue_fields": [{"field": "price"}, {"field": "nope"}],
+        },
+    )
+    hit = r["hits"]["hits"][0]
+    assert hit["fields"] == {"price": [5.0]}
