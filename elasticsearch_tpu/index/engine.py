@@ -663,34 +663,68 @@ class Engine:
             self._versions[doc_id] = int(version)
             self._doc_seqnos[doc_id] = int(seqno)
             self._tombstone_ts[doc_id] = float(ts)
-        base = 0
-        for seg_idx, seg_id in enumerate(commit["segments"]):
+        for seg_id in commit["segments"]:
             segment, live = store.load_segment(self.data_path, seg_id)
-            deleted = np.flatnonzero(~live)
-            # enforce=False: committed data must load; the breaker tracks
-            # it but can't reject recovery.
-            device, nbytes = self._pack_accounted(
-                segment, deleted=deleted, enforce=False
-            )
-            handle = SegmentHandle(
-                segment=segment,
-                device=device,
-                base=base,
-                live_host=live.copy(),
-                seg_id=seg_id,
-                nbytes=nbytes,
-            )
-            self.segments.append(handle)
-            for local, doc_id in enumerate(segment.ids):
-                if live[local]:
-                    self._live_ids[doc_id] = (seg_idx, local)
-                    self._versions[doc_id] = segment.doc_version(local)
-                    self._doc_seqnos[doc_id] = segment.doc_seqno(local)
-                self._bump_auto_id(doc_id)
-            base += segment.num_docs
-        self._stats_cache = None
+            # _recovering makes the breaker account without rejecting:
+            # committed data must load.
+            self._install_segment(segment, live, seg_id=seg_id)
+        self._seqno = max(self._seqno, commit["max_seqno"])
         self.generation += 1
         self._sync_impacts()
+
+    def _install_segment(
+        self, segment, live: np.ndarray, seg_id: int | None = None
+    ) -> None:
+        """Install one already-built segment: pack + handle + id/version/
+        seqno map rebuild. The single implementation behind boot recovery
+        and snapshot restore (they must never diverge). Caller holds the
+        lock and bumps generation/impacts once after the batch."""
+        deleted = np.flatnonzero(~live)
+        device, nbytes = self._pack_accounted(segment, deleted=deleted)
+        base = sum(h.segment.num_docs for h in self.segments)
+        handle = SegmentHandle(
+            segment=segment,
+            device=device,
+            base=base,
+            live_host=live.copy(),
+            seg_id=seg_id,
+            nbytes=nbytes,
+        )
+        seg_idx = len(self.segments)
+        self.segments.append(handle)
+        for local, doc_id in enumerate(segment.ids):
+            if live[local]:
+                self._live_ids[doc_id] = (seg_idx, local)
+                self._versions[doc_id] = segment.doc_version(local)
+                self._doc_seqnos[doc_id] = segment.doc_seqno(local)
+            self._bump_auto_id(doc_id)
+        if segment.seqnos is not None and len(segment.seqnos):
+            self._seqno = max(self._seqno, int(segment.seqnos.max()))
+        self._stats_cache = None
+
+    def restore_segment(self, segment, live: np.ndarray) -> None:
+        """Append one snapshot segment (restore path). The HBM breaker
+        enforces here — a restore is a NEW allocation, unlike recovery."""
+        with self.lock:
+            self._install_segment(segment, live)
+            self.generation += 1
+            self._sync_impacts()
+
+    def restore_shard_state(
+        self, max_seqno: int, tombstones: dict[str, Any]
+    ) -> None:
+        """Restore shard-level op state a snapshot carries beyond segment
+        rows: the seqno high-water mark (delete ops' seqnos live only in
+        the translog, not in any surviving doc row) and delete tombstones
+        so restored version lines continue, exactly like flush/recover."""
+        with self.lock:
+            self._seqno = max(self._seqno, int(max_seqno))
+            for doc_id, (version, seqno, ts) in tombstones.items():
+                if doc_id in self._live_ids or doc_id in self._buffer_ids:
+                    continue
+                self._versions[doc_id] = int(version)
+                self._doc_seqnos[doc_id] = int(seqno)
+                self._tombstone_ts[doc_id] = float(ts)
 
     def _replay_translog(self) -> None:
         """Re-apply ops above the commit's seqno (recoverFromTranslog)."""

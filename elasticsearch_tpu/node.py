@@ -197,9 +197,11 @@ class Node:
         self.breaker = CircuitBreaker(breaker_limit_bytes)
         self.request_cache = RequestCache()
         self.tasks = TaskManager(node_name)
+        self.repositories: dict[str, Any] = {}
         if data_path is not None:
             os.makedirs(data_path, exist_ok=True)
             self._recover_indices()
+            self._load_repositories()
 
     def _recover_indices(self) -> None:
         """Boot recovery: re-open every index with persisted metadata
@@ -212,7 +214,10 @@ class Node:
             with open(meta_path) as f:
                 meta = json.load(f)
             self._open_index(
-                name, meta.get("mappings"), meta.get("settings", {})
+                name,
+                meta.get("mappings"),
+                meta.get("settings", {}),
+                uuid=meta.get("uuid"),
             )
 
     def _index_dir(self, name: str) -> str | None:
@@ -231,6 +236,10 @@ class Node:
                 {
                     "mappings": svc.mappings.to_json(),
                     "settings": svc.settings,
+                    # The incarnation uuid must survive restarts: snapshot
+                    # blob digests key on it (incremental dedup breaks if
+                    # it regenerates every boot).
+                    "uuid": svc.uuid,
                 },
                 f,
             )
@@ -239,7 +248,11 @@ class Node:
         os.replace(tmp, os.path.join(idx_dir, "index_meta.json"))
 
     def _open_index(
-        self, name: str, mappings_json, settings: dict[str, Any]
+        self,
+        name: str,
+        mappings_json,
+        settings: dict[str, Any],
+        uuid: str | None = None,
     ) -> IndexService:
         params = BM25Params()
         sim = settings.get("index", {}).get("similarity", {}).get("default", {})
@@ -302,6 +315,8 @@ class Node:
             search=search,
             settings=settings,
         )
+        if uuid is not None:
+            svc.uuid = uuid
         self.indices[name] = svc
         return svc
 
@@ -921,6 +936,159 @@ class Node:
         for svc in self.indices.values():
             for engine in svc.engines:
                 engine.close()
+
+    # ------------------------------------------------------------ snapshots
+
+    def _repositories_file(self) -> str | None:
+        if self.data_path is None:
+            return None
+        return os.path.join(self.data_path, "repositories.json")
+
+    def _load_repositories(self) -> None:
+        """Re-register persisted repositories; a broken registration (bad
+        json, unreachable location) is an unusable repository, never a
+        node-fatal boot error (the reference degrades the same way)."""
+        from .snapshots import FsRepository
+
+        path = self._repositories_file()
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                entries = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            return
+        for name, spec in entries.items():
+            try:
+                self.repositories[name] = FsRepository(
+                    name, spec["settings"]["location"]
+                )
+            except (KeyError, TypeError, OSError):
+                continue
+
+    def _save_repositories(self) -> None:
+        path = self._repositories_file()
+        if path is None:
+            return
+        data = {
+            name: {"type": "fs", "settings": {"location": repo.location}}
+            for name, repo in self.repositories.items()
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+
+    def put_repository(self, name: str, body: dict[str, Any]) -> dict:
+        from .snapshots import FsRepository
+
+        if body.get("type") != "fs":
+            raise ApiError(
+                400,
+                "repository_exception",
+                f"repository type [{body.get('type')}] does not exist "
+                f"(only [fs] is supported)",
+            )
+        location = (body.get("settings") or {}).get("location")
+        if not location:
+            raise ApiError(
+                400,
+                "repository_exception",
+                "[fs] repositories require [settings.location]",
+            )
+        self.repositories[name] = FsRepository(name, location)
+        self._save_repositories()
+        return {"acknowledged": True}
+
+    def get_repository(self, name: str | None = None) -> dict:
+        if name in (None, "_all"):
+            items = self.repositories.items()
+        else:
+            repo = self.repositories.get(name)
+            if repo is None:
+                raise ApiError(
+                    404,
+                    "repository_missing_exception",
+                    f"[{name}] missing",
+                )
+            items = [(name, repo)]
+        return {
+            n: {"type": "fs", "settings": {"location": r.location}}
+            for n, r in items
+        }
+
+    def _repo(self, name: str):
+        repo = self.repositories.get(name)
+        if repo is None:
+            raise ApiError(
+                404, "repository_missing_exception", f"[{name}] missing"
+            )
+        return repo
+
+    def create_snapshot(
+        self, repo: str, snapshot: str, body: dict[str, Any] | None
+    ) -> dict:
+        from .snapshots import RepositoryError
+
+        body = body or {}
+        indices = body.get("indices")
+        if isinstance(indices, str):
+            indices = [i for i in indices.split(",") if i]
+        try:
+            manifest = self._repo(repo).create(snapshot, self, indices)
+        except RepositoryError as e:
+            raise ApiError(e.status, e.err_type, e.reason) from None
+        return {"snapshot": self._render_snapshot(manifest)}
+
+    @staticmethod
+    def _render_snapshot(manifest: dict) -> dict:
+        return {
+            "snapshot": manifest["snapshot"],
+            "state": manifest["state"],
+            "indices": sorted(manifest["indices"]),
+            "start_time_in_millis": manifest["start_time_in_millis"],
+            "end_time_in_millis": manifest.get("end_time_in_millis"),
+        }
+
+    def get_snapshot(self, repo: str, snapshot: str | None = None) -> dict:
+        from .snapshots import RepositoryError
+
+        try:
+            manifests = self._repo(repo).get(snapshot)
+        except RepositoryError as e:
+            raise ApiError(e.status, e.err_type, e.reason) from None
+        return {
+            "snapshots": [self._render_snapshot(m) for m in manifests]
+        }
+
+    def delete_snapshot(self, repo: str, snapshot: str) -> dict:
+        from .snapshots import RepositoryError
+
+        try:
+            self._repo(repo).delete(snapshot)
+        except RepositoryError as e:
+            raise ApiError(e.status, e.err_type, e.reason) from None
+        return {"acknowledged": True}
+
+    def restore_snapshot(
+        self, repo: str, snapshot: str, body: dict[str, Any] | None
+    ) -> dict:
+        from .snapshots import RepositoryError
+
+        body = body or {}
+        indices = body.get("indices")
+        if isinstance(indices, str):
+            indices = [i for i in indices.split(",") if i]
+        try:
+            return self._repo(repo).restore(
+                snapshot,
+                self,
+                indices=indices,
+                rename_pattern=body.get("rename_pattern"),
+                rename_replacement=body.get("rename_replacement"),
+            )
+        except RepositoryError as e:
+            raise ApiError(e.status, e.err_type, e.reason) from None
 
     # ---------------------------------------------------------------- tasks
 

@@ -94,6 +94,23 @@ class RestServer:
         r("POST", "/_tasks/{task_id}/_cancel", lambda s, p, q, b: n.cancel_task(
             p["task_id"]
         ))
+        r("PUT", "/_snapshot/{repo}", lambda s, p, q, b: n.put_repository(
+            p["repo"], _json(b)
+        ))
+        r("GET", "/_snapshot/{repo}", lambda s, p, q, b: n.get_repository(
+            p["repo"]
+        ))
+        r("PUT", "/_snapshot/{repo}/{snap}", lambda s, p, q, b: n.create_snapshot(
+            p["repo"], p["snap"], _json(b)
+        ))
+        r("GET", "/_snapshot/{repo}/{snap}", lambda s, p, q, b: n.get_snapshot(
+            p["repo"], p["snap"]
+        ))
+        r("DELETE", "/_snapshot/{repo}/{snap}", lambda s, p, q, b: n.delete_snapshot(
+            p["repo"], p["snap"]
+        ))
+        r("POST", "/_snapshot/{repo}/{snap}/_restore",
+          lambda s, p, q, b: n.restore_snapshot(p["repo"], p["snap"], _json(b)))
         r("GET", "/_cat/indices", lambda s, p, q, b: n.cat_indices())
         r("GET", "/_stats", lambda s, p, q, b: n.stats())
         r("POST", "/_bulk", lambda s, p, q, b: n.bulk(

@@ -1,0 +1,3 @@
+from .repository import FsRepository, RepositoryError
+
+__all__ = ["FsRepository", "RepositoryError"]
