@@ -668,7 +668,6 @@ class Engine:
             # _recovering makes the breaker account without rejecting:
             # committed data must load.
             self._install_segment(segment, live, seg_id=seg_id)
-        self._seqno = max(self._seqno, commit["max_seqno"])
         self.generation += 1
         self._sync_impacts()
 
@@ -702,11 +701,17 @@ class Engine:
             self._seqno = max(self._seqno, int(segment.seqnos.max()))
         self._stats_cache = None
 
-    def restore_segment(self, segment, live: np.ndarray) -> None:
-        """Append one snapshot segment (restore path). The HBM breaker
-        enforces here — a restore is a NEW allocation, unlike recovery."""
+    def restore_segments(
+        self, segments_with_live: list[tuple[Any, np.ndarray]]
+    ) -> None:
+        """Append snapshot segments (restore path): install the whole
+        batch, then sync impacts/generation ONCE — per-segment syncing
+        would recompute device impacts O(k²) as avgdl moves. The HBM
+        breaker enforces here — a restore is a NEW allocation, unlike
+        recovery."""
         with self.lock:
-            self._install_segment(segment, live)
+            for segment, live in segments_with_live:
+                self._install_segment(segment, live)
             self.generation += 1
             self._sync_impacts()
 

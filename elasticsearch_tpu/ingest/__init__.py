@@ -1,0 +1,3 @@
+from .pipeline import Pipeline, PipelineError
+
+__all__ = ["Pipeline", "PipelineError"]

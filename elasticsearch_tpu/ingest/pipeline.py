@@ -1,0 +1,347 @@
+"""Ingest pipelines: pre-index document transformation chains.
+
+The analog of the reference's ingest service + ingest-common processors
+(server/src/main/java/org/elasticsearch/ingest/IngestService.java,
+modules/ingest-common/): a pipeline is an ordered processor list applied
+to every document before it reaches the engine, selected per request
+(?pipeline=) or per index (settings index.default_pipeline).
+
+Processors (ingest-common subset): set, remove, rename, lowercase,
+uppercase, trim, convert, split, join, append, gsub, fail, drop.
+Per-processor options: ignore_missing (skip absent fields),
+ignore_failure (swallow errors). Field paths use dot notation into
+nested objects; `set` values support one-level {{field}} templates
+(the reference's mustache value templates).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+
+class PipelineError(Exception):
+    """Processor failure (HTTP 400 / per-item bulk error)."""
+
+
+class DropDocument(Exception):
+    """Raised by the drop processor: the document is silently discarded."""
+
+
+_TEMPLATE_RE = re.compile(r"\{\{\s*([\w.]+)\s*\}\}")
+_MISSING = object()  # absent-field sentinel
+
+
+def _path_get(doc: dict, path: str, default=None):
+    if default is None:
+        default = _MISSING
+    cur: Any = doc
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return default
+        cur = cur[part]
+    return cur
+
+
+def _path_set(doc: dict, path: str, value: Any) -> None:
+    parts = path.split(".")
+    cur = doc
+    for part in parts[:-1]:
+        nxt = cur.get(part)
+        if not isinstance(nxt, dict):
+            nxt = {}
+            cur[part] = nxt
+        cur = nxt
+    cur[parts[-1]] = value
+
+
+def _path_del(doc: dict, path: str) -> bool:
+    parts = path.split(".")
+    cur: Any = doc
+    for part in parts[:-1]:
+        if not isinstance(cur, dict) or part not in cur:
+            return False
+        cur = cur[part]
+    if isinstance(cur, dict) and parts[-1] in cur:
+        del cur[parts[-1]]
+        return True
+    return False
+
+
+def _render(value: Any, doc: dict) -> Any:
+    """{{field}} template substitution in string values."""
+    if not isinstance(value, str) or "{{" not in value:
+        return value
+
+    def sub(m):
+        v = _path_get(doc, m.group(1))
+        return "" if v is _MISSING else str(v)
+
+    return _TEMPLATE_RE.sub(sub, value)
+
+
+def _missing(proc_kind: str, field: str) -> PipelineError:
+    return PipelineError(
+        f"[{proc_kind}] field [{field}] not present as part of path [{field}]"
+    )
+
+
+class Pipeline:
+    def __init__(self, pipeline_id: str, body: dict[str, Any]):
+        self.id = pipeline_id
+        self.description = body.get("description", "")
+        self.body = body
+        raw = body.get("processors")
+        if not isinstance(raw, list) or not raw:
+            raise PipelineError(
+                f"pipeline [{pipeline_id}] requires a [processors] array"
+            )
+        self._steps: list[tuple[str, dict, Callable[[dict, dict], None]]] = []
+        for spec in raw:
+            if not isinstance(spec, dict) or len(spec) != 1:
+                raise PipelineError(
+                    "each processor must be an object with exactly one type"
+                )
+            ((kind, opts),) = spec.items()
+            handler = _PROCESSORS.get(kind)
+            if handler is None:
+                raise PipelineError(
+                    f"No processor type exists with name [{kind}]"
+                )
+            _validate(kind, opts or {})
+            self._steps.append((kind, opts or {}, handler))
+
+    def run(self, source: dict[str, Any]) -> dict[str, Any] | None:
+        """Transformed copy of the source; None = dropped.
+
+        Deep copy: processors reach into nested objects and extend lists,
+        and the caller's original must stay untouched (bulk retries would
+        otherwise see pipeline-mangled data)."""
+        import copy
+
+        doc = copy.deepcopy(source)
+        for kind, opts, handler in self._steps:
+            try:
+                handler(doc, opts)
+            except DropDocument:
+                return None
+            except re.error as e:
+                if not opts.get("ignore_failure"):
+                    raise PipelineError(
+                        f"[{kind}] invalid pattern: {e}"
+                    ) from None
+            except PipelineError:
+                if not opts.get("ignore_failure"):
+                    raise
+        return doc
+
+
+_REQUIRED = {
+    "set": ("field", "value"),
+    "remove": ("field",),
+    "rename": ("field", "target_field"),
+    "lowercase": ("field",),
+    "uppercase": ("field",),
+    "trim": ("field",),
+    "convert": ("field", "type"),
+    "split": ("field", "separator"),
+    "join": ("field", "separator"),
+    "append": ("field", "value"),
+    "gsub": ("field", "pattern", "replacement"),
+    "fail": ("message",),
+    "drop": (),
+}
+
+
+def _validate(kind: str, opts: dict) -> None:
+    for key in _REQUIRED.get(kind, ()):
+        if key not in opts:
+            raise PipelineError(
+                f"[{kind}] processor requires [{key}]"
+            )
+    # Regex-bearing processors compile at PUT time, so a broken pattern is
+    # a 400 on registration, not a crash on the first indexed document.
+    for pattern_key in ("pattern", "separator") if kind in ("gsub", "split") else ():
+        if pattern_key in opts:
+            try:
+                re.compile(opts[pattern_key])
+            except re.error as e:
+                raise PipelineError(
+                    f"[{kind}] invalid [{pattern_key}] pattern: {e}"
+                ) from None
+
+
+def _string_op(kind: str, fn: Callable[[str], str]):
+    def handler(doc: dict, opts: dict) -> None:
+        field = opts["field"]
+        v = _path_get(doc, field)
+        if v is _MISSING:
+            if opts.get("ignore_missing"):
+                return
+            raise _missing(kind, field)
+        if isinstance(v, list):
+            _path_set(doc, field, [fn(str(x)) for x in v])
+        else:
+            _path_set(doc, field, fn(str(v)))
+
+    return handler
+
+
+def _p_set(doc: dict, opts: dict) -> None:
+    if not opts.get("override", True) and _path_get(
+        doc, opts["field"]
+    ) is not _MISSING:
+        return
+    _path_set(doc, opts["field"], _render(opts["value"], doc))
+
+
+def _p_remove(doc: dict, opts: dict) -> None:
+    fields = opts["field"]
+    for f in fields if isinstance(fields, list) else [fields]:
+        if not _path_del(doc, f) and not opts.get("ignore_missing"):
+            raise _missing("remove", f)
+
+
+def _p_rename(doc: dict, opts: dict) -> None:
+    v = _path_get(doc, opts["field"])
+    if v is _MISSING:
+        if opts.get("ignore_missing"):
+            return
+        raise _missing("rename", opts["field"])
+    if _path_get(doc, opts["target_field"]) is not _MISSING:
+        raise PipelineError(
+            f"[rename] field [{opts['target_field']}] already exists"
+        )
+    _path_del(doc, opts["field"])
+    _path_set(doc, opts["target_field"], v)
+
+
+def _p_convert(doc: dict, opts: dict) -> None:
+    field = opts["field"]
+    v = _path_get(doc, field)
+    if v is _MISSING:
+        if opts.get("ignore_missing"):
+            return
+        raise _missing("convert", field)
+    target = opts.get("target_field", field)
+    ctype = opts["type"]
+
+    def one(x):
+        try:
+            if ctype == "integer" or ctype == "long":
+                return int(x)  # base 10, leading zeros fine (ES parseInt)
+            if ctype == "float" or ctype == "double":
+                return float(x)
+            if ctype == "string":
+                return str(x)
+            if ctype == "boolean":
+                if isinstance(x, bool):
+                    return x
+                s = str(x).lower()
+                if s in ("true", "false"):
+                    return s == "true"
+                raise ValueError(x)
+            if ctype == "auto":
+                s = str(x)
+                for conv in (int, float):
+                    try:
+                        return conv(s)
+                    except ValueError:
+                        pass
+                if s.lower() in ("true", "false"):
+                    return s.lower() == "true"
+                return s
+        except (TypeError, ValueError):
+            raise PipelineError(
+                f"[convert] unable to convert [{x!r}] to {ctype}"
+            ) from None
+        raise PipelineError(f"[convert] invalid type [{ctype}]")
+
+    _path_set(
+        doc, target, [one(x) for x in v] if isinstance(v, list) else one(v)
+    )
+
+
+def _p_split(doc: dict, opts: dict) -> None:
+    field = opts["field"]
+    v = _path_get(doc, field)
+    if v is _MISSING:
+        if opts.get("ignore_missing"):
+            return
+        raise _missing("split", field)
+    if not isinstance(v, str):
+        raise PipelineError(f"[split] field [{field}] is not a string")
+    _path_set(
+        doc,
+        opts.get("target_field", field),
+        re.split(opts["separator"], v),
+    )
+
+
+def _p_join(doc: dict, opts: dict) -> None:
+    field = opts["field"]
+    v = _path_get(doc, field)
+    if v is _MISSING:
+        if opts.get("ignore_missing"):
+            return
+        raise _missing("join", field)
+    if not isinstance(v, list):
+        raise PipelineError(f"[join] field [{field}] is not a list")
+    _path_set(
+        doc, opts.get("target_field", field),
+        str(opts["separator"]).join(str(x) for x in v),
+    )
+
+
+def _p_append(doc: dict, opts: dict) -> None:
+    field = opts["field"]
+    value = _render(opts["value"], doc)
+    values = value if isinstance(value, list) else [value]
+    cur = _path_get(doc, field)
+    if cur is _MISSING:
+        _path_set(doc, field, list(values))
+    elif isinstance(cur, list):
+        cur.extend(values)
+    else:
+        _path_set(doc, field, [cur, *values])
+
+
+def _p_gsub(doc: dict, opts: dict) -> None:
+    field = opts["field"]
+    v = _path_get(doc, field)
+    if v is _MISSING:
+        if opts.get("ignore_missing"):
+            return
+        raise _missing("gsub", field)
+    if not isinstance(v, str):
+        raise PipelineError(f"[gsub] field [{field}] is not a string")
+    _path_set(
+        doc,
+        opts.get("target_field", field),
+        re.sub(opts["pattern"], opts["replacement"], v),
+    )
+
+
+def _p_fail(doc: dict, opts: dict) -> None:
+    raise PipelineError(_render(opts["message"], doc))
+
+
+def _p_drop(doc: dict, opts: dict) -> None:
+    raise DropDocument()
+
+
+_PROCESSORS: dict[str, Callable[[dict, dict], None]] = {
+    "set": _p_set,
+    "remove": _p_remove,
+    "rename": _p_rename,
+    "lowercase": _string_op("lowercase", str.lower),
+    "uppercase": _string_op("uppercase", str.upper),
+    "trim": _string_op("trim", str.strip),
+    "convert": _p_convert,
+    "split": _p_split,
+    "join": _p_join,
+    "append": _p_append,
+    "gsub": _p_gsub,
+    "fail": _p_fail,
+    "drop": _p_drop,
+}

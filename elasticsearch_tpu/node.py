@@ -198,10 +198,12 @@ class Node:
         self.request_cache = RequestCache()
         self.tasks = TaskManager(node_name)
         self.repositories: dict[str, Any] = {}
+        self.pipelines: dict[str, Any] = {}  # ingest.Pipeline by id
         if data_path is not None:
             os.makedirs(data_path, exist_ok=True)
             self._recover_indices()
             self._load_repositories()
+            self._load_pipelines()
 
     def _recover_indices(self) -> None:
         """Boot recovery: re-open every index with persisted metadata
@@ -394,8 +396,17 @@ class Node:
         if_seq_no: int | None = None,
         if_primary_term: int | None = None,
         op_type: str = "index",
+        pipeline: str | None = None,
     ) -> dict:
         svc = self.get_index(index, auto_create=True)
+        source = self._apply_pipeline(svc, source, pipeline)
+        if source is None:  # dropped by an ingest drop processor
+            return {
+                "_index": index,
+                "_id": doc_id,
+                "result": "noop",
+                "_shards": {"total": 1, "successful": 0, "failed": 0},
+            }
         if doc_id is None and svc.n_shards > 1:
             # Multi-shard: the id must exist before routing (the reference
             # generates the UUID in TransportBulkAction before routing too).
@@ -547,7 +558,13 @@ class Node:
 
     # ----------------------------------------------------------------- bulk
 
-    def bulk(self, body: str, default_index: str | None = None, refresh=False) -> dict:
+    def bulk(
+        self,
+        body: str,
+        default_index: str | None = None,
+        refresh=False,
+        pipeline: str | None = None,
+    ) -> dict:
         """NDJSON bulk: index/create/delete/update action lines.
 
         Mirrors TransportBulkAction's per-item independent outcomes
@@ -577,7 +594,8 @@ class Node:
                     # "create" enforces put-if-absent atomically inside the
                     # engine lock (no get-then-index race window).
                     resp = self.index_doc(
-                        index, source, doc_id, sync=False, op_type=op
+                        index, source, doc_id, sync=False, op_type=op,
+                        pipeline=meta.get("pipeline", pipeline),
                     )
                     touched.add(index)
                     status = 201 if resp["result"] == "created" else 200
@@ -936,6 +954,139 @@ class Node:
         for svc in self.indices.values():
             for engine in svc.engines:
                 engine.close()
+
+    # --------------------------------------------------------------- ingest
+
+    def _pipelines_file(self) -> str | None:
+        if self.data_path is None:
+            return None
+        return os.path.join(self.data_path, "pipelines.json")
+
+    def _load_pipelines(self) -> None:
+        from .ingest import Pipeline, PipelineError
+
+        path = self._pipelines_file()
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                entries = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            return
+        for pid, body in entries.items():
+            try:
+                self.pipelines[pid] = Pipeline(pid, body)
+            except PipelineError:
+                continue
+
+    def _save_pipelines(self) -> None:
+        path = self._pipelines_file()
+        if path is None:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({p.id: p.body for p in self.pipelines.values()}, f)
+        os.replace(tmp, path)
+
+    def put_pipeline(self, pipeline_id: str, body: dict[str, Any]) -> dict:
+        from .ingest import Pipeline, PipelineError
+
+        try:
+            self.pipelines[pipeline_id] = Pipeline(pipeline_id, body or {})
+        except PipelineError as e:
+            raise ApiError(400, "parse_exception", str(e)) from None
+        self._save_pipelines()
+        return {"acknowledged": True}
+
+    def get_pipeline(self, pipeline_id: str | None = None) -> dict:
+        if pipeline_id in (None, "*", "_all"):
+            items = self.pipelines.values()
+        else:
+            p = self.pipelines.get(pipeline_id)
+            if p is None:
+                raise ApiError(
+                    404,
+                    "resource_not_found_exception",
+                    f"pipeline [{pipeline_id}] is missing",
+                )
+            items = [p]
+        return {p.id: p.body for p in items}
+
+    def delete_pipeline(self, pipeline_id: str) -> dict:
+        if self.pipelines.pop(pipeline_id, None) is None:
+            raise ApiError(
+                404,
+                "resource_not_found_exception",
+                f"pipeline [{pipeline_id}] is missing",
+            )
+        self._save_pipelines()
+        return {"acknowledged": True}
+
+    def simulate_pipeline(
+        self, pipeline_id: str | None, body: dict[str, Any]
+    ) -> dict:
+        """POST /_ingest/pipeline/[{id}/]_simulate — run docs through the
+        pipeline without indexing (SimulatePipelineRequest)."""
+        from .ingest import Pipeline, PipelineError
+
+        if pipeline_id is not None:
+            pipeline = self.pipelines.get(pipeline_id)
+            if pipeline is None:
+                raise ApiError(
+                    404,
+                    "resource_not_found_exception",
+                    f"pipeline [{pipeline_id}] is missing",
+                )
+        else:
+            try:
+                pipeline = Pipeline("_simulate", body.get("pipeline") or {})
+            except PipelineError as e:
+                raise ApiError(400, "parse_exception", str(e)) from None
+        docs = []
+        for entry in body.get("docs", []):
+            source = entry.get("_source", {})
+            try:
+                out = pipeline.run(source)
+            except PipelineError as e:
+                docs.append(
+                    {"error": {"type": "pipeline_error", "reason": str(e)}}
+                )
+                continue
+            if out is None:
+                docs.append({"doc": None})  # dropped
+            else:
+                docs.append({"doc": {"_source": out}})
+        return {"docs": docs}
+
+    def _resolve_pipeline(self, svc: IndexService, pipeline: str | None):
+        """Request pipeline > index default_pipeline > none."""
+        pid = pipeline
+        if pid is None:
+            pid = svc.settings.get("index", {}).get("default_pipeline")
+        if pid in (None, "_none"):
+            return None
+        p = self.pipelines.get(pid)
+        if p is None:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                f"pipeline with id [{pid}] does not exist",
+            )
+        return p
+
+    def _apply_pipeline(self, svc, source, pipeline: str | None):
+        """(transformed source | None-if-dropped)."""
+        from .ingest import PipelineError
+
+        p = self._resolve_pipeline(svc, pipeline)
+        if p is None:
+            return source
+        try:
+            return p.run(source)
+        except PipelineError as e:
+            raise ApiError(
+                400, "illegal_argument_exception", str(e)
+            ) from None
 
     # ------------------------------------------------------------ snapshots
 

@@ -114,11 +114,27 @@ class RestServer:
         r("GET", "/_cat/indices", lambda s, p, q, b: n.cat_indices())
         r("GET", "/_stats", lambda s, p, q, b: n.stats())
         r("POST", "/_bulk", lambda s, p, q, b: n.bulk(
-            b, refresh=q.get("refresh") in ("true", "")
+            b, refresh=q.get("refresh") in ("true", ""),
+            pipeline=q.get("pipeline"),
         ))
         r("POST", "/{index}/_bulk", lambda s, p, q, b: n.bulk(
-            b, default_index=p["index"], refresh=q.get("refresh") in ("true", "")
+            b, default_index=p["index"],
+            refresh=q.get("refresh") in ("true", ""),
+            pipeline=q.get("pipeline"),
         ))
+        r("PUT", "/_ingest/pipeline/{id}", lambda s, p, q, b: n.put_pipeline(
+            p["id"], _json(b)
+        ))
+        r("GET", "/_ingest/pipeline", lambda s, p, q, b: n.get_pipeline())
+        r("GET", "/_ingest/pipeline/{id}", lambda s, p, q, b: n.get_pipeline(
+            p["id"]
+        ))
+        r("DELETE", "/_ingest/pipeline/{id}",
+          lambda s, p, q, b: n.delete_pipeline(p["id"]))
+        r("POST", "/_ingest/pipeline/{id}/_simulate",
+          lambda s, p, q, b: n.simulate_pipeline(p["id"], _json(b)))
+        r("POST", "/_ingest/pipeline/_simulate",
+          lambda s, p, q, b: n.simulate_pipeline(None, _json(b)))
         r("GET", "/{index}/_mapping", lambda s, p, q, b: n.get_mapping(p["index"]))
         r("PUT", "/{index}/_mapping", lambda s, p, q, b: n.put_mapping(
             p["index"], _json(b)
@@ -157,12 +173,15 @@ class RestServer:
         ))
         r("POST", "/{index}/_analyze", self._analyze)
         r("POST", "/{index}/_doc", lambda s, p, q, b: n.index_doc(
-            p["index"], _json(b), None, refresh=q.get("refresh") in ("true", "")
+            p["index"], _json(b), None,
+            refresh=q.get("refresh") in ("true", ""),
+            pipeline=q.get("pipeline"),
         ))
         for method in ("PUT", "POST"):
             r(method, "/{index}/_doc/{id}", lambda s, p, q, b: n.index_doc(
                 p["index"], _json(b), p["id"],
                 refresh=q.get("refresh") in ("true", ""),
+                pipeline=q.get("pipeline"),
                 **_cas_params(q),
             ))
             r(method, "/{index}/_create/{id}", self._create_doc)

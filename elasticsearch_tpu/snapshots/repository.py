@@ -249,6 +249,7 @@ class FsRepository:
             selected = sorted(indices or manifest["indices"].keys())
             snap_dir = os.path.join(self.location, "snapshots", snapshot)
             plan: list[tuple[str, str, dict]] = []
+            seen_targets: set[str] = set()
             for name in selected:
                 meta = manifest["indices"].get(name)
                 if meta is None:
@@ -259,14 +260,30 @@ class FsRepository:
                     )
                 target = name
                 if rename_pattern and rename_replacement is not None:
-                    target = re.sub(rename_pattern, rename_replacement, name)
-                if target in node.indices:
+                    try:
+                        target = re.sub(
+                            rename_pattern, rename_replacement, name
+                        )
+                    except re.error as e:
+                        raise RepositoryError(
+                            400,
+                            "snapshot_restore_exception",
+                            f"invalid rename_pattern: {e}",
+                        ) from None
+                if not _NAME_RE.match(target):
+                    raise RepositoryError(
+                        400,
+                        "snapshot_restore_exception",
+                        f"invalid renamed index name [{target}]",
+                    )
+                if target in node.indices or target in seen_targets:
                     raise RepositoryError(
                         400,
                         "snapshot_restore_exception",
                         f"cannot restore index [{target}] because an open "
                         f"index with same name already exists in the cluster",
                     )
+                seen_targets.add(target)
                 plan.append((name, target, meta))
             restored = []
             for name, target, meta in plan:
@@ -280,6 +297,7 @@ class FsRepository:
                 svc = node.indices[target]
                 for shard_idx, shard_meta in enumerate(meta["shards"]):
                     engine = svc.engines[shard_idx]
+                    batch = []
                     for seg_meta in shard_meta["segments"]:
                         blob_dir = os.path.join(
                             self.location, "blobs", seg_meta["blob"]
@@ -289,7 +307,8 @@ class FsRepository:
                             os.path.join(snap_dir, seg_meta["live"]),
                             allow_pickle=False,
                         )
-                        engine.restore_segment(segment, live)
+                        batch.append((segment, live))
+                    engine.restore_segments(batch)
                     engine.restore_shard_state(
                         shard_meta.get("max_seqno", -1),
                         shard_meta.get("tombstones", {}),

@@ -1,0 +1,233 @@
+"""Ingest pipelines: processors, CRUD, write-path wiring, simulate.
+
+Reference: ingest/IngestService.java, modules/ingest-common processors.
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.ingest import Pipeline, PipelineError
+from elasticsearch_tpu.node import ApiError, Node
+from elasticsearch_tpu.rest.server import RestServer
+
+
+def run(processors, doc):
+    return Pipeline("t", {"processors": processors}).run(doc)
+
+
+def test_basic_processors():
+    assert run([{"set": {"field": "a", "value": 5}}], {}) == {"a": 5}
+    assert run([{"remove": {"field": "a"}}], {"a": 1, "b": 2}) == {"b": 2}
+    assert run(
+        [{"rename": {"field": "a", "target_field": "b"}}], {"a": 1}
+    ) == {"b": 1}
+    assert run([{"lowercase": {"field": "s"}}], {"s": "ABC"}) == {"s": "abc"}
+    assert run([{"uppercase": {"field": "s"}}], {"s": "abc"}) == {"s": "ABC"}
+    assert run([{"trim": {"field": "s"}}], {"s": " x "}) == {"s": "x"}
+    assert run(
+        [{"convert": {"field": "n", "type": "integer"}}], {"n": "42"}
+    ) == {"n": 42}
+    assert run(
+        [{"split": {"field": "s", "separator": ","}}], {"s": "a,b,c"}
+    ) == {"s": ["a", "b", "c"]}
+    assert run(
+        [{"join": {"field": "s", "separator": "-"}}], {"s": ["a", "b"]}
+    ) == {"s": "a-b"}
+    assert run(
+        [{"append": {"field": "tags", "value": "new"}}], {"tags": ["old"]}
+    ) == {"tags": ["old", "new"]}
+    assert run(
+        [{"gsub": {"field": "s", "pattern": r"\d+", "replacement": "#"}}],
+        {"s": "a1b22c"},
+    ) == {"s": "a#b#c"}
+
+
+def test_templates_dots_missing_and_failures():
+    # {{field}} templates and dotted paths
+    assert run(
+        [{"set": {"field": "greeting", "value": "hi {{user.name}}"}}],
+        {"user": {"name": "ada"}},
+    ) == {"user": {"name": "ada"}, "greeting": "hi ada"}
+    assert run(
+        [{"lowercase": {"field": "user.name"}}], {"user": {"name": "ADA"}}
+    ) == {"user": {"name": "ada"}}
+    with pytest.raises(PipelineError):
+        run([{"lowercase": {"field": "nope"}}], {})
+    assert run(
+        [{"lowercase": {"field": "nope", "ignore_missing": True}}], {"a": 1}
+    ) == {"a": 1}
+    assert run(
+        [{"fail": {"message": "boom", "ignore_failure": True}}], {"a": 1}
+    ) == {"a": 1}
+    with pytest.raises(PipelineError):
+        run([{"fail": {"message": "bad doc {{a}}"}}], {"a": 7})
+    with pytest.raises(PipelineError):
+        run([{"convert": {"field": "n", "type": "integer"}}], {"n": "xx"})
+    with pytest.raises(PipelineError):
+        Pipeline("p", {"processors": [{"nope_proc": {}}]})
+    with pytest.raises(PipelineError):
+        Pipeline("p", {"processors": []})
+
+
+def test_run_never_mutates_nested_source():
+    src = {"user": {"name": "ADA"}, "tags": ["old"]}
+    out = run(
+        [
+            {"lowercase": {"field": "user.name"}},
+            {"append": {"field": "tags", "value": "new"}},
+        ],
+        src,
+    )
+    assert out == {"user": {"name": "ada"}, "tags": ["old", "new"]}
+    assert src == {"user": {"name": "ADA"}, "tags": ["old"]}
+
+
+def test_bad_regex_rejected_at_put_time():
+    with pytest.raises(PipelineError):
+        Pipeline("p", {"processors": [{"split": {"field": "s", "separator": "("}}]})
+    with pytest.raises(PipelineError):
+        Pipeline(
+            "p",
+            {"processors": [{"gsub": {"field": "s", "pattern": "[",
+                                      "replacement": "x"}}]},
+        )
+
+
+def test_convert_leading_zeros_and_hex():
+    assert run(
+        [{"convert": {"field": "n", "type": "integer"}}], {"n": "042"}
+    ) == {"n": 42}
+    with pytest.raises(PipelineError):
+        run([{"convert": {"field": "n", "type": "integer"}}], {"n": "0x10"})
+
+
+def test_drop_and_set_override():
+    assert run([{"drop": {}}], {"a": 1}) is None
+    assert run(
+        [{"set": {"field": "a", "value": 9, "override": False}}], {"a": 1}
+    ) == {"a": 1}
+    # original dict untouched (run works on a copy)
+    src = {"a": 1}
+    run([{"set": {"field": "b", "value": 2}}], src)
+    assert src == {"a": 1}
+
+
+def test_pipeline_on_write_paths():
+    node = Node()
+    node.create_index(
+        "p", {"mappings": {"properties": {"msg": {"type": "text"}}}}
+    )
+    node.put_pipeline(
+        "clean",
+        {
+            "processors": [
+                {"lowercase": {"field": "msg"}},
+                {"set": {"field": "via", "value": "clean"}},
+            ]
+        },
+    )
+    node.index_doc("p", {"msg": "HELLO World"}, "1", pipeline="clean")
+    assert node.get_doc("p", "1")["_source"] == {
+        "msg": "hello world",
+        "via": "clean",
+    }
+    with pytest.raises(ApiError):
+        node.index_doc("p", {"msg": "x"}, "2", pipeline="missing_pipe")
+
+
+def test_default_pipeline_and_drop():
+    node = Node()
+    node.put_pipeline(
+        "gate",
+        {
+            "processors": [
+                {"drop": {}},
+            ]
+        },
+    )
+    node.create_index(
+        "d",
+        {
+            "settings": {"index": {"default_pipeline": "gate"}},
+            "mappings": {"properties": {"x": {"type": "long"}}},
+        },
+    )
+    resp = node.index_doc("d", {"x": 1}, "1")
+    assert resp["result"] == "noop"
+    node.refresh("d")
+    assert node.get_index("d").num_docs == 0
+    # _none bypasses the default pipeline
+    resp = node.index_doc("d", {"x": 2}, "2", pipeline="_none")
+    assert resp["result"] == "created"
+
+
+def test_bulk_with_pipeline_param_and_meta_override():
+    node = Node()
+    node.create_index("b", {})
+    node.put_pipeline(
+        "tag", {"processors": [{"set": {"field": "tagged", "value": True}}]}
+    )
+    node.put_pipeline(
+        "other", {"processors": [{"set": {"field": "other", "value": 1}}]}
+    )
+    lines = [
+        json.dumps({"index": {"_id": "1"}}),
+        json.dumps({"v": 1}),
+        json.dumps({"index": {"_id": "2", "pipeline": "other"}}),
+        json.dumps({"v": 2}),
+    ]
+    resp = node.bulk("\n".join(lines), default_index="b", pipeline="tag")
+    assert not resp["errors"]
+    assert node.get_doc("b", "1")["_source"] == {"v": 1, "tagged": True}
+    assert node.get_doc("b", "2")["_source"] == {"v": 2, "other": 1}
+
+
+def test_ingest_rest_crud_and_simulate(tmp_path):
+    node = Node(data_path=str(tmp_path))
+    rest = RestServer(node=node)
+    status, r = rest.dispatch(
+        "PUT",
+        "/_ingest/pipeline/norm",
+        {},
+        json.dumps(
+            {
+                "description": "normalize",
+                "processors": [{"trim": {"field": "name"}}],
+            }
+        ),
+    )
+    assert status == 200
+    status, r = rest.dispatch("GET", "/_ingest/pipeline/norm", {}, "")
+    assert status == 200 and r["norm"]["description"] == "normalize"
+    status, r = rest.dispatch(
+        "POST",
+        "/_ingest/pipeline/norm/_simulate",
+        {},
+        json.dumps({"docs": [{"_source": {"name": "  ada  "}}]}),
+    )
+    assert status == 200
+    assert r["docs"][0]["doc"]["_source"] == {"name": "ada"}
+    # ad-hoc simulate without a stored pipeline
+    status, r = rest.dispatch(
+        "POST",
+        "/_ingest/pipeline/_simulate",
+        {},
+        json.dumps(
+            {
+                "pipeline": {"processors": [{"drop": {}}]},
+                "docs": [{"_source": {"a": 1}}],
+            }
+        ),
+    )
+    assert r["docs"][0]["doc"] is None
+    node.close()
+
+    # pipelines survive restart
+    node2 = Node(data_path=str(tmp_path))
+    assert "norm" in node2.pipelines
+    node2.close()
+    status, r = rest.dispatch("DELETE", "/_ingest/pipeline/norm", {}, "")
+    assert status == 200
+    status, r = rest.dispatch("GET", "/_ingest/pipeline/norm", {}, "")
+    assert status == 404

@@ -211,6 +211,27 @@ def test_blob_dedup_survives_restart(tmp_path):
     node2.close()
 
 
+def test_restore_rejects_duplicate_and_bad_rename_targets(tmp_path):
+    node = Node()
+    seed(node, "aa", n=3)
+    seed(node, "bb", n=3)
+    node.put_repository(
+        "repo", {"type": "fs", "settings": {"location": str(tmp_path / "r")}}
+    )
+    node.create_snapshot("repo", "s1", {})
+    with pytest.raises(ApiError):  # both rename to "same" — duplicate
+        node.restore_snapshot(
+            "repo", "s1",
+            {"rename_pattern": "..", "rename_replacement": "same"},
+        )
+    assert "same" not in node.indices  # nothing partially restored
+    with pytest.raises(ApiError):  # malformed regex -> 400, not 500
+        node.restore_snapshot(
+            "repo", "s1",
+            {"rename_pattern": "[", "rename_replacement": "x"},
+        )
+
+
 def test_unsupported_repo_type_rejected():
     node = Node()
     with pytest.raises(ApiError):
