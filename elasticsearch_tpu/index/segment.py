@@ -26,6 +26,8 @@ from typing import Any
 
 import numpy as np
 
+from ..native import NativeAccumulator, tokenize_ascii
+from ..native import available as native_available
 from ..utils import smallfloat
 from .mapping import DENSE_VECTOR, Mappings, coerce_numeric
 
@@ -160,6 +162,25 @@ class SegmentBuilder:
         self._present: dict[str, set[int]] = {}  # field -> docs with a value
         self._numeric: dict[str, dict[int, float]] = {}
         self._vectors: dict[str, dict[int, np.ndarray]] = {}
+        # Native indexing core (native/text_indexer.cpp): postings for
+        # standard-analyzed text fields accumulate in C++; fields fall back
+        # to the Python dicts when the library or analyzer doesn't qualify.
+        self._native_accs: dict[str, Any] = {}
+        self._native_ok: dict[str, bool] = {}
+
+    def _field_uses_native(self, field_name: str, analyzer) -> bool:
+        cached = self._native_ok.get(field_name)
+        if cached is not None:
+            return cached
+        from ..analysis.analyzers import _standard_tokenize, lowercase_filter
+
+        ok = (
+            native_available()
+            and analyzer.tokenizer is _standard_tokenize
+            and list(analyzer.filters) == [lowercase_filter]
+        )
+        self._native_ok[field_name] = ok
+        return ok
 
     @property
     def num_docs(self) -> int:
@@ -209,12 +230,36 @@ class SegmentBuilder:
                 # the reference's KeywordFieldMapper default); text fields
                 # record per-occurrence positions for phrase queries.
                 with_positions = fm.norms
+                use_native = with_positions and self._field_uses_native(
+                    field_name, analyzer
+                )
                 total_len = 0
                 tf: dict[str, int] = {}
                 poss: dict[str, list[int]] = {}
+                native_vals: list[tuple] | None = [] if use_native else None
                 base = 0
                 for v in _iter_field_values(value):
-                    if with_positions:
+                    if use_native:
+                        r = tokenize_ascii(str(v))
+                        if r is not None:  # ASCII fast path, C++ tokenizer
+                            buf, offs = r
+                            n = len(offs) - 1
+                            total_len += n
+                            native_vals.append(("buf", buf, offs, base))
+                            base += n + POSITION_INCREMENT_GAP
+                        else:  # Unicode: Python analyzer, native postings
+                            pairs, span = analyzer.analyze_positions(str(v))
+                            total_len += len(pairs)
+                            native_vals.append(
+                                (
+                                    "toks",
+                                    [t for t, _ in pairs],
+                                    [p for _, p in pairs],
+                                    base,
+                                )
+                            )
+                            base += span + POSITION_INCREMENT_GAP
+                    elif with_positions:
                         pairs, span = analyzer.analyze_positions(str(v))
                         total_len += len(pairs)
                         for tok, pos in pairs:
@@ -226,7 +271,9 @@ class SegmentBuilder:
                         total_len += len(tokens)
                         for tok in tokens:
                             tf[tok] = tf.get(tok, 0) + 1
-                staged_postings.append((field_name, tf, total_len, poss))
+                staged_postings.append(
+                    (field_name, tf, total_len, poss, native_vals)
+                )
             elif fm.is_numeric:
                 vals = _iter_field_values(value)
                 v0 = vals[0]  # multi-valued numerics keep first value for now
@@ -240,15 +287,36 @@ class SegmentBuilder:
         self._seqnos.append(int(seqno))
         for field_name, vec in staged_vectors:
             self._vectors.setdefault(field_name, {})[local] = vec
-        for field_name, tf, total_len, poss in staged_postings:
+        for field_name, tf, total_len, poss, native_vals in staged_postings:
             self._present.setdefault(field_name, set()).add(local)
-            postings = self._inverted.setdefault(field_name, {})
-            for tok, count in tf.items():
-                postings.setdefault(tok, {})[local] = count
-            if poss:
-                fpos = self._positions.setdefault(field_name, {})
-                for tok, plist in poss.items():
-                    fpos.setdefault(tok, {})[local] = plist
+            if native_vals is not None:
+                acc = self._native_accs.get(field_name)
+                if acc is None:
+                    acc = NativeAccumulator(with_positions=True)
+                    self._native_accs[field_name] = acc
+                for kind, a, b, vbase in native_vals:
+                    if kind == "buf":
+                        acc.add(
+                            local,
+                            a,
+                            b,
+                            vbase
+                            + np.arange(len(b) - 1, dtype=np.int32),
+                        )
+                    else:
+                        acc.add_tokens(
+                            local,
+                            a,
+                            np.asarray(b, dtype=np.int32) + vbase,
+                        )
+            else:
+                postings = self._inverted.setdefault(field_name, {})
+                for tok, count in tf.items():
+                    postings.setdefault(tok, {})[local] = count
+                if poss:
+                    fpos = self._positions.setdefault(field_name, {})
+                    for tok, plist in poss.items():
+                        fpos.setdefault(tok, {})[local] = plist
             # Docs whose value analyzed to zero tokens (e.g. all stopwords)
             # produce no postings and must not count toward
             # docCount/sumTotalTermFreq — Lucene's Terms.getDocCount only
@@ -262,7 +330,11 @@ class SegmentBuilder:
     def build(self) -> Segment:
         n = len(self._sources)
         fields: dict[str, FieldIndex] = {}
-        for fname, postings in self._inverted.items():
+        for fname in sorted(set(self._inverted) | set(self._native_accs)):
+            if fname in self._native_accs:
+                fields[fname] = self._build_native_field(fname, n)
+                continue
+            postings = self._inverted[fname]
             terms = {t: i for i, t in enumerate(sorted(postings))}
             t_count = len(terms)
             df = np.zeros(t_count, dtype=np.int32)
@@ -279,17 +351,8 @@ class SegmentBuilder:
                 docs_sorted = sorted(by_doc)
                 doc_ids[lo : lo + len(docs_sorted)] = docs_sorted
                 tfs[lo : lo + len(docs_sorted)] = [by_doc[d] for d in docs_sorted]
-            lengths = self._lengths.get(fname, {})
-            norm_bytes = np.zeros(n, dtype=np.uint8)
-            if lengths:
-                docs_with_field = np.fromiter(lengths.keys(), dtype=np.int64)
-                lens = np.fromiter(lengths.values(), dtype=np.int64)
-                norm_bytes[docs_with_field] = smallfloat.encode_lengths(lens)
+            norm_bytes, present, lengths = self._norms_present(fname, n)
             fm = self.mappings.get(fname)
-            present = np.zeros(n, dtype=bool)
-            present_docs = self._present.get(fname)
-            if present_docs:
-                present[np.fromiter(present_docs, dtype=np.int64)] = True
             pos_offsets = positions_flat = None
             fm_pre = self.mappings.get(fname)
             wants_positions = fm_pre.norms if fm_pre is not None else True
@@ -357,4 +420,45 @@ class SegmentBuilder:
             ids=list(self._ids),
             versions=np.asarray(self._versions, dtype=np.int64),
             seqnos=np.asarray(self._seqnos, dtype=np.int64),
+        )
+
+    def _norms_present(self, fname: str, n: int):
+        """(norm_bytes, present, lengths) for one field — shared between
+        the Python and native build paths."""
+        lengths = self._lengths.get(fname, {})
+        norm_bytes = np.zeros(n, dtype=np.uint8)
+        if lengths:
+            docs_with_field = np.fromiter(lengths.keys(), dtype=np.int64)
+            lens = np.fromiter(lengths.values(), dtype=np.int64)
+            norm_bytes[docs_with_field] = smallfloat.encode_lengths(lens)
+        present = np.zeros(n, dtype=bool)
+        present_docs = self._present.get(fname)
+        if present_docs:
+            present[np.fromiter(present_docs, dtype=np.int64)] = True
+        return norm_bytes, present, lengths
+
+    def _build_native_field(self, fname: str, n: int) -> FieldIndex:
+        """Materialize a FieldIndex from the C++ accumulator's CSR output
+        (native/text_indexer.cpp estpu_acc_build)."""
+        # build() is a read-only emit: the accumulator stays usable, so a
+        # builder can keep accepting docs after a build (the built Segment
+        # owns copies of every array).
+        acc = self._native_accs[fname]
+        out = acc.build()
+        norm_bytes, present, lengths = self._norms_present(fname, n)
+        fm = self.mappings.get(fname)
+        return FieldIndex(
+            name=fname,
+            terms=out["terms"],
+            df=out["df"],
+            offsets=out["offsets"],
+            doc_ids=out["doc_ids"],
+            tfs=out["tfs"],
+            norm_bytes=norm_bytes,
+            doc_count=len(lengths),
+            sum_total_tf=int(sum(lengths.values())),
+            has_norms=fm.norms if fm is not None else True,
+            present=present,
+            pos_offsets=out["pos_offsets"],
+            positions=out["positions"],
         )

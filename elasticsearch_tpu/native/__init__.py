@@ -1,0 +1,4 @@
+from .loader import available, get_lib
+from .text_indexer import NativeAccumulator, tokenize_ascii
+
+__all__ = ["available", "get_lib", "NativeAccumulator", "tokenize_ascii"]

@@ -1,0 +1,87 @@
+"""Build-on-demand loader for the native runtime library.
+
+The C++ sources live in native/ at the repo root; the shared library is
+compiled once with g++ (cached under native/build/) and loaded with
+ctypes. Everything using it falls back to pure Python when the toolchain
+or library is unavailable — the native layer is an accelerator, never a
+requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libestpu_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "text_indexer.cpp")
+    if not os.path.exists(src):
+        return False
+    if os.path.exists(_LIB_PATH) and os.path.getmtime(
+        _LIB_PATH
+    ) >= os.path.getmtime(src):
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return False
+    return os.path.exists(_LIB_PATH)
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The loaded native library, building it on first use; None if the
+    toolchain/library is unavailable (callers use their Python path)."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("ESTPU_DISABLE_NATIVE"):
+            return None
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        i64, i32, u8 = (
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+        )
+        lib.estpu_tokenize_ascii.restype = ctypes.c_int64
+        lib.estpu_tokenize_ascii.argtypes = [u8, ctypes.c_int64, u8, i64]
+        lib.estpu_acc_create.restype = ctypes.c_void_p
+        lib.estpu_acc_create.argtypes = [ctypes.c_int]
+        lib.estpu_acc_destroy.argtypes = [ctypes.c_void_p]
+        lib.estpu_acc_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, u8, i64, i32, ctypes.c_int64,
+        ]
+        lib.estpu_acc_sizes.argtypes = [ctypes.c_void_p, i64]
+        lib.estpu_acc_build.argtypes = [
+            ctypes.c_void_p, u8, i64, i32, i64, i32,
+            ctypes.POINTER(ctypes.c_float), i64, i32,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

@@ -1,0 +1,155 @@
+"""Python face of the native indexing core (native/text_indexer.cpp).
+
+Parity contract: the ASCII tokenizer is byte-for-byte equivalent to the
+standard analyzer's `\\w+` + lowercase on pure-ASCII text (it REFUSES
+non-ASCII, returning None, so Unicode segmentation always runs through
+the Python analyzer — the index/query analysis symmetry the scoring
+depends on is never at risk). The accumulator is analyzer-agnostic: it
+ingests token buffers from either side, so mixed ASCII/Unicode corpora
+keep one consistent postings state.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Any
+
+import numpy as np
+
+from .loader import get_lib
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _f32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def tokenize_ascii(text: str) -> tuple[np.ndarray, np.ndarray] | None:
+    """(token_bytes, offsets) for pure-ASCII text via the native standard
+    tokenizer; None when the library is unavailable or the text is
+    non-ASCII (caller uses the Python analyzer)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    raw = text.encode("utf-8", errors="surrogatepass")
+    if len(raw) != len(text):  # non-ASCII shortcut without scanning twice
+        return None
+    buf = np.frombuffer(raw, dtype=np.uint8)
+    out_buf = np.empty(max(1, len(raw)), dtype=np.uint8)
+    out_offsets = np.zeros(len(raw) + 2, dtype=np.int64)
+    n = lib.estpu_tokenize_ascii(
+        _u8(buf), len(raw), _u8(out_buf), _i64(out_offsets)
+    )
+    if n < 0:
+        return None
+    return out_buf[: out_offsets[n]].copy(), out_offsets[: n + 1].copy()
+
+
+class NativeAccumulator:
+    """Per-field postings accumulator living in C++.
+
+    Documents must arrive with non-decreasing doc ids (multi-value calls
+    for one doc are consecutive) — the same order SegmentBuilder produces.
+    """
+
+    def __init__(self, with_positions: bool):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._handle = lib.estpu_acc_create(1 if with_positions else 0)
+        self.with_positions = with_positions
+
+    def add(
+        self,
+        doc: int,
+        token_buf: np.ndarray,
+        offsets: np.ndarray,
+        positions: np.ndarray,
+    ) -> None:
+        n = len(offsets) - 1
+        if n <= 0:
+            return
+        if self._handle is None:
+            raise RuntimeError("accumulator is closed")
+        # Bind conversions to locals: a pointer from .ctypes does NOT keep
+        # its array alive, so temporaries must outlive the foreign call.
+        tb = np.ascontiguousarray(token_buf, dtype=np.uint8)
+        off = np.ascontiguousarray(offsets, dtype=np.int64)
+        pos = np.ascontiguousarray(positions, dtype=np.int32)
+        self._lib.estpu_acc_add(
+            self._handle, int(doc), _u8(tb), _i64(off), _i32(pos), n
+        )
+
+    def add_tokens(self, doc: int, tokens: list[str], positions) -> None:
+        """Fallback ingestion for Python-analyzed (non-ASCII) values."""
+        if not tokens:
+            return
+        blobs = [t.encode("utf-8") for t in tokens]
+        offsets = np.zeros(len(blobs) + 1, dtype=np.int64)
+        np.cumsum([len(b) for b in blobs], out=offsets[1:])
+        buf = np.frombuffer(b"".join(blobs), dtype=np.uint8)
+        self.add(doc, buf, offsets, np.asarray(positions, dtype=np.int32))
+
+    def build(self) -> dict[str, Any]:
+        """CSR arrays: terms dict + postings + positions (FieldIndex shape)."""
+        sizes = np.zeros(4, dtype=np.int64)
+        self._lib.estpu_acc_sizes(self._handle, _i64(sizes))
+        n_terms, n_postings, n_positions, term_bytes = (int(x) for x in sizes)
+        term_buf = np.empty(max(1, term_bytes), dtype=np.uint8)
+        term_offsets = np.zeros(n_terms + 1, dtype=np.int64)
+        df = np.zeros(n_terms, dtype=np.int32)
+        offsets = np.zeros(n_terms + 1, dtype=np.int64)
+        doc_ids = np.zeros(n_postings, dtype=np.int32)
+        tfs = np.zeros(n_postings, dtype=np.float32)
+        pos_offsets = np.zeros(n_postings + 1, dtype=np.int64)
+        positions = np.zeros(max(1, n_positions), dtype=np.int32)
+        self._lib.estpu_acc_build(
+            self._handle,
+            _u8(term_buf),
+            _i64(term_offsets),
+            _i32(df),
+            _i64(offsets),
+            _i32(doc_ids),
+            _f32(tfs),
+            _i64(pos_offsets),
+            _i32(positions),
+        )
+        blob = term_buf[:term_bytes].tobytes()
+        terms = {
+            blob[term_offsets[i] : term_offsets[i + 1]].decode("utf-8"): i
+            for i in range(n_terms)
+        }
+        out: dict[str, Any] = {
+            "terms": terms,
+            "df": df,
+            "offsets": offsets,
+            "doc_ids": doc_ids,
+            "tfs": tfs,
+        }
+        if self.with_positions:
+            out["pos_offsets"] = pos_offsets
+            out["positions"] = positions[:n_positions]
+        return out
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.estpu_acc_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # accumulator lifetime == builder lifetime
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -199,6 +199,13 @@ class Node:
         self.tasks = TaskManager(node_name)
         self.repositories: dict[str, Any] = {}
         self.pipelines: dict[str, Any] = {}  # ingest.Pipeline by id
+        self._broken_pipelines: dict[str, Any] = {}  # unloadable, preserved
+        # Warm the native indexing core off the request path: the first
+        # use would otherwise run a synchronous g++ build under the engine
+        # write lock.
+        from .native import available as _native_available
+
+        _native_available()
         if data_path is not None:
             os.makedirs(data_path, exist_ok=True)
             self._recover_indices()
@@ -977,15 +984,20 @@ class Node:
             try:
                 self.pipelines[pid] = Pipeline(pid, body)
             except PipelineError:
-                continue
+                # Unusable, but its definition must survive the next save
+                # (a newer build may load it; silently erasing durable
+                # config is never acceptable).
+                self._broken_pipelines[pid] = body
 
     def _save_pipelines(self) -> None:
         path = self._pipelines_file()
         if path is None:
             return
+        data = dict(self._broken_pipelines)
+        data.update({p.id: p.body for p in self.pipelines.values()})
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({p.id: p.body for p in self.pipelines.values()}, f)
+            json.dump(data, f)
         os.replace(tmp, path)
 
     def put_pipeline(self, pipeline_id: str, body: dict[str, Any]) -> dict:

@@ -653,63 +653,59 @@ def execute_batch_blockmax(seg, spec, arrays_list, k: int):
     nt = spec[2]
     kind, field_name, _, t_pad = spec
     a_bucket = max(8, nt // 4)
+    stacked = {
+        name: np.stack([a[name] for a in arrays_list])
+        for name in ("tile_ids", "starts", "ends", "weights", "ub", "ub_other")
+    }
     if a_bucket >= nt:  # tiny worklists: single launch, exact totals
-        arrays_b = jax.tree.map(
-            lambda *xs: np.stack(xs), *arrays_list
+        s, i, t = jax.device_get(
+            execute_batch_sparse(seg, spec, stacked, k)
         )
-        s, i, t = jax.device_get(execute_batch_sparse(seg, spec, arrays_b, k))
         return s, i, t, "eq"
 
-    # Launch 1: top-UB subset. (Reordering is safe here — phase-A scores
-    # are only used as lower bounds; exact accumulation order matters only
-    # in the final launch.)
+    # Launch 1: each query's top-UB subset, selected with ONE batched
+    # argsort + take_along_axis — no per-query python loops. (Reordering
+    # is safe here — phase-A scores are only lower bounds; exact
+    # accumulation order matters only in the final launch.)
     spec_a = (kind, field_name, a_bucket, t_pad)
-    phase_a = []
-    for arrays in arrays_list:
-        order = np.argsort(-arrays["ub"], kind="stable")[:a_bucket]
-        phase_a.append(
-            {
-                "tile_ids": arrays["tile_ids"][order],
-                "starts": arrays["starts"][order],
-                "ends": arrays["ends"][order],
-                "weights": arrays["weights"][order],
-                "ub": arrays["ub"][order],
-                "ub_other": arrays["ub_other"][order],
-            }
-        )
-    arrays_a = jax.tree.map(lambda *xs: np.stack(xs), *phase_a)
+    order = np.argsort(-stacked["ub"], axis=1, kind="stable")[:, :a_bucket]
+    arrays_a = {
+        name: np.take_along_axis(stacked[name], order, axis=1)
+        for name in stacked
+    }
     scores_a, _, _ = jax.device_get(
         execute_batch_sparse(seg, spec_a, arrays_a, k)
     )
-    thetas = scores_a[:, k - 1] if scores_a.shape[1] >= k else np.full(
-        len(arrays_list), -np.inf, dtype=np.float32
+    q = len(arrays_list)
+    thetas = (
+        scores_a[:, k - 1]
+        if scores_a.shape[1] >= k
+        else np.full(q, -np.inf, dtype=np.float32)
     )
 
-    # Host prune + re-bucket (order-preserving: the exact left-fold in
-    # launch 2 needs original worklist order).
-    keeps = []
-    pruned_any = False
-    for arrays, theta in zip(arrays_list, thetas):
-        if not np.isfinite(theta):
-            keep = np.ones(nt, dtype=bool)
-        else:
-            margin = np.float32(theta) * np.float32(1 - 1e-6) - np.float32(1e-6)
-            keep = (arrays["ub"] + arrays["ub_other"]) >= margin
-        keeps.append(keep)
-        pruned_any = pruned_any or (not keep.all())
-    max_survivors = max(1, max(int(kp.sum()) for kp in keeps))
-    nt_b = 1 << (max_survivors - 1).bit_length()
+    # Host prune + re-bucket, fully vectorized. keep preserves original
+    # worklist order (the exact left-fold in launch 2 needs it): a stable
+    # argsort on ~keep moves survivors to the front without reordering
+    # them.
+    margin = thetas.astype(np.float32) * np.float32(1 - 1e-6) - np.float32(
+        1e-6
+    )
+    keep = (stacked["ub"] + stacked["ub_other"]) >= margin[:, None]
+    keep |= ~np.isfinite(thetas)[:, None]  # underfull top-k: keep all
+    counts = keep.sum(axis=1)
+    pruned_any = bool((counts < nt).any())
+    nt_b = 1 << (max(1, int(counts.max())) - 1).bit_length()
+    front = np.argsort(~keep, axis=1, kind="stable")[:, :nt_b]
+    arrays_b = {
+        name: np.take_along_axis(stacked[name], front, axis=1)
+        for name in stacked
+    }
+    # Rows past each query's survivor count are padding: an empty span
+    # never validates, and the pad tile keeps gathers in-range.
+    pad = np.arange(nt_b)[None, :] >= counts[:, None]
+    arrays_b["starts"] = np.where(pad, 0, arrays_b["starts"])
+    arrays_b["ends"] = np.where(pad, 0, arrays_b["ends"])
     spec_b = (kind, field_name, nt_b, t_pad)
-    phase_b = []
-    for arrays, keep in zip(arrays_list, keeps):
-        out = {}
-        n_keep = int(keep.sum())
-        for name in ("tile_ids", "starts", "ends", "weights", "ub", "ub_other"):
-            col = np.zeros(nt_b, dtype=arrays[name].dtype)
-            col[:n_keep] = arrays[name][keep]
-            out[name] = col  # padding rows: starts == ends -> never valid
-        phase_b.append(out)
-    arrays_b = jax.tree.map(lambda *xs: np.stack(xs), *phase_b)
     s, i, t = jax.device_get(execute_batch_sparse(seg, spec_b, arrays_b, k))
     return s, i, t, ("gte" if pruned_any else "eq")
 

@@ -209,6 +209,7 @@ class RestServer:
             p["index"], _json(b), p["id"],
             refresh=q.get("refresh") in ("true", ""),
             op_type="create",
+            pipeline=q.get("pipeline"),
         )
 
     def _analyze(self, s, p, q, b):

@@ -1,0 +1,167 @@
+// Native indexing core: tokenization + postings accumulation in C++.
+//
+// The host-side hot loop of the write path (the reference's native
+// data-loading analog; its Lucene indexing chain plays this role on the
+// JVM). Two halves, both driven from Python over a C ABI (ctypes):
+//
+//  1. tokenize: ASCII fast path of the standard analyzer (word-character
+//     runs [A-Za-z0-9_]+, ASCII lowercase). Non-ASCII text falls back to
+//     the Python analyzer — Unicode word segmentation must match Python's
+//     regex exactly, so it is never re-implemented here. Tokens return as
+//     one contiguous byte buffer + offsets: no per-token Python objects.
+//
+//  2. accumulate/build: a per-field postings accumulator (term dict +
+//     per-term (doc, tf) postings + occurrence positions) replacing the
+//     dict-of-dict hot path in SegmentBuilder. build() emits the final
+//     CSR arrays (terms sorted bytewise — identical to Python's sorted()
+//     for UTF-8, which preserves code-point order) ready for FieldIndex.
+//
+// Memory: C++ owns accumulator state; build results are copied into
+// caller-provided numpy buffers sized by a query call. No allocation is
+// shared across the ABI.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- tokenize
+
+// Tokenize ASCII text: word-char runs, lowercased. Returns the token
+// count, or -1 if the text contains any non-ASCII byte (caller falls back
+// to the Python analyzer). Outputs (caller-allocated, sized >= len):
+//   out_buf:     concatenated lowercased token bytes
+//   out_offsets: token i occupies out_buf[out_offsets[i]:out_offsets[i+1]]
+// Positions are implicit: token i sits at position i (the standard
+// analyzer emits no gaps).
+int64_t estpu_tokenize_ascii(const uint8_t* text, int64_t len,
+                             uint8_t* out_buf, int64_t* out_offsets) {
+    int64_t n_tokens = 0;
+    int64_t out_pos = 0;
+    out_offsets[0] = 0;
+    int64_t i = 0;
+    while (i < len) {
+        uint8_t c = text[i];
+        if (c >= 0x80) return -1;  // non-ASCII: Python analyzer owns it
+        bool word = (c == '_') || (c >= '0' && c <= '9') ||
+                    (c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z');
+        if (!word) { i++; continue; }
+        while (i < len) {
+            c = text[i];
+            if (c >= 0x80) return -1;
+            bool w = (c == '_') || (c >= '0' && c <= '9') ||
+                     (c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z');
+            if (!w) break;
+            out_buf[out_pos++] = (c >= 'A' && c <= 'Z') ? (c + 32) : c;
+            i++;
+        }
+        out_offsets[++n_tokens] = out_pos;
+    }
+    return n_tokens;
+}
+
+// -------------------------------------------------------------- accumulate
+
+struct Posting {
+    std::vector<int32_t> docs;
+    std::vector<int32_t> tfs;
+    std::vector<std::vector<int32_t>> positions;  // per posting
+};
+
+struct Accumulator {
+    // std::map keeps terms bytewise-sorted, matching Python's sorted()
+    // over the same UTF-8 strings (UTF-8 byte order == code point order).
+    std::map<std::string, Posting> terms;
+    bool with_positions = true;
+};
+
+void* estpu_acc_create(int with_positions) {
+    auto* acc = new Accumulator();
+    acc->with_positions = with_positions != 0;
+    return acc;
+}
+
+void estpu_acc_destroy(void* handle) {
+    delete static_cast<Accumulator*>(handle);
+}
+
+// Add one document-value's tokens: `buf`/`offsets` as produced by
+// estpu_tokenize_ascii (or by the Python analyzer for non-ASCII text),
+// `positions` the per-token positions (base offset applied by caller).
+void estpu_acc_add(void* handle, int32_t doc, const uint8_t* buf,
+                   const int64_t* offsets, const int32_t* positions,
+                   int64_t n_tokens) {
+    auto* acc = static_cast<Accumulator*>(handle);
+    for (int64_t t = 0; t < n_tokens; t++) {
+        std::string term(reinterpret_cast<const char*>(buf + offsets[t]),
+                         static_cast<size_t>(offsets[t + 1] - offsets[t]));
+        Posting& p = acc->terms[term];
+        if (p.docs.empty() || p.docs.back() != doc) {
+            p.docs.push_back(doc);
+            p.tfs.push_back(1);
+            if (acc->with_positions) p.positions.emplace_back();
+        } else {
+            p.tfs.back() += 1;
+        }
+        if (acc->with_positions) p.positions.back().push_back(positions[t]);
+    }
+}
+
+// Result sizes: n_terms, total_postings, total_positions, term_bytes.
+void estpu_acc_sizes(void* handle, int64_t* out) {
+    auto* acc = static_cast<Accumulator*>(handle);
+    int64_t postings = 0, pos = 0, term_bytes = 0;
+    for (auto& kv : acc->terms) {
+        term_bytes += static_cast<int64_t>(kv.first.size());
+        postings += static_cast<int64_t>(kv.second.docs.size());
+        for (auto& v : kv.second.positions)
+            pos += static_cast<int64_t>(v.size());
+    }
+    out[0] = static_cast<int64_t>(acc->terms.size());
+    out[1] = postings;
+    out[2] = pos;
+    out[3] = term_bytes;
+}
+
+// Emit CSR arrays into caller buffers (sized via estpu_acc_sizes):
+//   term_buf[term_bytes], term_offsets[T+1]   sorted term dictionary
+//   df[T], offsets[T+1]                       postings CSR
+//   doc_ids[P], tfs[P]                        postings (docs ascending)
+//   pos_offsets[P+1], positions[total_pos]    occurrence positions
+void estpu_acc_build(void* handle, uint8_t* term_buf, int64_t* term_offsets,
+                     int32_t* df, int64_t* offsets, int32_t* doc_ids,
+                     float* tfs, int64_t* pos_offsets, int32_t* positions) {
+    auto* acc = static_cast<Accumulator*>(handle);
+    int64_t tb = 0, p = 0, pp = 0;
+    int64_t tid = 0;
+    term_offsets[0] = 0;
+    offsets[0] = 0;
+    pos_offsets[0] = 0;
+    for (auto& kv : acc->terms) {
+        std::memcpy(term_buf + tb, kv.first.data(), kv.first.size());
+        tb += static_cast<int64_t>(kv.first.size());
+        term_offsets[tid + 1] = tb;
+        Posting& post = kv.second;
+        df[tid] = static_cast<int32_t>(post.docs.size());
+        for (size_t j = 0; j < post.docs.size(); j++) {
+            doc_ids[p] = post.docs[j];
+            tfs[p] = static_cast<float>(post.tfs[j]);
+            if (acc->with_positions) {
+                auto& v = post.positions[j];
+                std::memcpy(positions + pp, v.data(),
+                            v.size() * sizeof(int32_t));
+                pp += static_cast<int64_t>(v.size());
+            }
+            pos_offsets[p + 1] = pp;
+            p++;
+        }
+        offsets[tid + 1] = p;
+        tid++;
+    }
+}
+
+}  // extern "C"
