@@ -200,6 +200,7 @@ class Node:
         self.repositories: dict[str, Any] = {}
         self.pipelines: dict[str, Any] = {}  # ingest.Pipeline by id
         self._broken_pipelines: dict[str, Any] = {}  # unloadable, preserved
+        self.aliases: dict[str, set[str]] = {}  # alias -> concrete indices
         # Warm the native indexing core off the request path: the first
         # use would otherwise run a synchronous g++ build under the engine
         # write lock.
@@ -211,6 +212,7 @@ class Node:
             self._recover_indices()
             self._load_repositories()
             self._load_pipelines()
+            self._load_aliases()
 
     def _recover_indices(self) -> None:
         """Boot recovery: re-open every index with persisted metadata
@@ -342,19 +344,56 @@ class Node:
             raise ApiError(
                 400, "invalid_index_name_exception", f"invalid index name [{name}]"
             )
+        if name in self.aliases:
+            raise ApiError(
+                400,
+                "invalid_index_name_exception",
+                f"an alias with the name [{name}] already exists",
+            )
         body = body or {}
+        # Validate the WHOLE request (aliases included) before creating
+        # anything — a mid-request failure must not leave a half-created
+        # index or unpersisted alias state.
+        for alias in body.get("aliases") or {}:
+            if alias in self.indices:
+                raise ApiError(
+                    400,
+                    "invalid_alias_name_exception",
+                    f"an index exists with the same name as the alias "
+                    f"[{alias}]",
+                )
         svc = self._open_index(
             name, body.get("mappings"), body.get("settings", {})
         )
         self._save_index_meta(svc)
+        for alias in body.get("aliases") or {}:
+            self.aliases.setdefault(alias, set()).add(name)
+        if body.get("aliases"):
+            self._save_aliases()
         return {"acknowledged": True, "shards_acknowledged": True, "index": name}
 
     def delete_index(self, name: str) -> dict:
         if name not in self.indices:
+            if name in self.aliases:
+                # The reference rejects alias expressions on index deletion
+                # — implicitly dropping the backing index would be silent
+                # data loss for a request clients consider safe-to-fail.
+                raise ApiError(
+                    400,
+                    "illegal_argument_exception",
+                    f"The provided expression [{name}] matches an alias, "
+                    f"specify the corresponding concrete indices instead.",
+                )
             raise index_not_found(name)
         for engine in self.indices[name].engines:
             engine.close()
         del self.indices[name]
+        # Aliases pointing only at the deleted index disappear with it.
+        for alias in list(self.aliases):
+            self.aliases[alias].discard(name)
+            if not self.aliases[alias]:
+                del self.aliases[alias]
+        self._save_aliases()
         idx_dir = self._index_dir(name)
         if idx_dir is not None and os.path.isdir(idx_dir):
             shutil.rmtree(idx_dir, ignore_errors=True)
@@ -362,6 +401,9 @@ class Node:
 
     def get_index(self, name: str, auto_create: bool = False) -> IndexService:
         svc = self.indices.get(name)
+        if svc is None:
+            resolved = self.resolve_index(name)  # alias -> concrete index
+            svc = self.indices.get(resolved)
         if svc is None:
             if not auto_create:
                 raise index_not_found(name)
@@ -687,6 +729,18 @@ class Node:
                 return cached
         try:
             request = SearchRequest.from_json(body)
+            window = int(
+                svc.settings.get("index", {}).get("max_result_window", 10_000)
+            )
+            if request.from_ + request.size > window:
+                raise ApiError(
+                    400,
+                    "illegal_argument_exception",
+                    f"Result window is too large, from + size must be less "
+                    f"than or equal to: [{window}] but was "
+                    f"[{request.from_ + request.size}]. See the scroll api "
+                    f"for a more efficient way to request large data sets.",
+                )
             task = self.tasks.register(
                 "indices:data/read/search",
                 description=f"indices[{index}]",
@@ -843,6 +897,168 @@ class Node:
                         freed += 1
         return {"succeeded": True, "num_freed": freed}
 
+    # ------------------------------------------------- by-query operations
+
+    def _scan_hits(self, index: str, query_body, batch: int = 1000):
+        """Iterate every matching hit over an internal scroll snapshot
+        (stable under the mutations the caller is about to make)."""
+        svc = self.get_index(index)
+        coord = self._coordinator_for(svc)
+        request = SearchRequest.from_json(
+            {
+                "query": query_body or {"match_all": {}},
+                "size": batch,
+                "track_total_hits": True,
+            }
+        )
+        ctx = coord.open_scroll(svc.name, request, keep_alive_s=600.0)
+        while True:
+            page = coord.scroll_page(ctx)
+            if not page.hits:
+                break
+            yield from page.hits
+
+    def delete_by_query(
+        self, index: str, body: dict[str, Any] | None, refresh: bool = False
+    ) -> dict:
+        """POST /{index}/_delete_by_query (reindex module's
+        TransportDeleteByQueryAction: scroll + per-doc delete)."""
+        t0 = time.monotonic()
+        body = body or {}
+        deleted = 0
+        total = 0
+        svc = self.get_index(index)
+        for hit in self._scan_hits(index, body.get("query")):
+            total += 1
+            result = svc.route(hit.doc_id).delete(hit.doc_id)
+            if result["result"] == "deleted":
+                deleted += 1
+        for engine in svc.engines:
+            engine.sync_translog()
+            if refresh:
+                _refresh_after_write(engine)
+        return {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": False,
+            "total": total,
+            "deleted": deleted,
+            "version_conflicts": 0,
+            "failures": [],
+        }
+
+    def update_by_query(
+        self,
+        index: str,
+        body: dict[str, Any] | None,
+        refresh: bool = False,
+        pipeline: str | None = None,
+    ) -> dict:
+        """POST /{index}/_update_by_query: reindex every matching doc in
+        place — picking up mapping changes and the (request or default)
+        ingest pipeline. Scripted updates are not supported yet
+        (painless-lite is a scoring-expression subset)."""
+        t0 = time.monotonic()
+        body = body or {}
+        if "script" in body:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                "scripted update_by_query is not supported yet",
+            )
+        svc = self.get_index(index)
+        updated = 0
+        total = 0
+        noops = 0
+        failures: list[dict] = []
+        try:
+            for hit in self._scan_hits(index, body.get("query")):
+                total += 1
+                engine = svc.route(hit.doc_id)
+                source = engine.get(hit.doc_id)
+                if source is None:
+                    continue  # deleted since the snapshot
+                try:
+                    out = self._apply_pipeline(svc, source, pipeline)
+                    if out is None:
+                        noops += 1
+                        continue
+                    engine.index(out, hit.doc_id)
+                    updated += 1
+                except (ApiError, ValueError, VersionConflictError) as e:
+                    # Per-doc outcome, never a request-level 500: the
+                    # by-query contract reports failures and keeps going.
+                    failures.append({"id": hit.doc_id, "cause": str(e)})
+        finally:
+            for engine in svc.engines:
+                engine.sync_translog()
+                if refresh:
+                    _refresh_after_write(engine)
+        return {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": False,
+            "total": total,
+            "updated": updated,
+            "noops": noops,
+            "version_conflicts": 0,
+            "failures": failures,
+        }
+
+    def reindex(self, body: dict[str, Any], refresh: bool = False) -> dict:
+        """POST /_reindex {"source": {"index", "query"?},
+        "dest": {"index", "pipeline"?}} — scroll the source snapshot and
+        index into dest (the reindex module's core flow)."""
+        t0 = time.monotonic()
+        source = body.get("source") or {}
+        dest = body.get("dest") or {}
+        src_index = source.get("index")
+        dest_index = dest.get("index")
+        if not src_index or not dest_index:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                "_reindex requires [source.index] and [dest.index]",
+            )
+        src_svc = self.get_index(src_index)  # 404 early
+        dest_svc = self.get_index(dest_index, auto_create=True)
+        if dest_svc is src_svc:
+            raise ApiError(
+                400,
+                "action_request_validation_exception",
+                "reindex cannot write into an index its reading from "
+                f"[{dest_index}]",
+            )
+        created = 0
+        updated = 0
+        total = 0
+        for hit in self._scan_hits(src_index, source.get("query")):
+            if hit.source is None:
+                continue
+            total += 1
+            resp = self.index_doc(
+                dest_index,
+                hit.source,
+                hit.doc_id,
+                sync=False,
+                pipeline=dest.get("pipeline"),
+            )
+            if resp["result"] == "created":
+                created += 1
+            elif resp["result"] == "updated":
+                updated += 1
+        for engine in dest_svc.engines:
+            engine.sync_translog()
+            if refresh:
+                _refresh_after_write(engine)
+        return {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": False,
+            "total": total,
+            "created": created,
+            "updated": updated,
+            "version_conflicts": 0,
+            "failures": [],
+        }
+
     # ------------------------------------------------------- msearch / mget
 
     def msearch(self, body: str, default_index: str | None = None) -> dict:
@@ -961,6 +1177,206 @@ class Node:
         for svc in self.indices.values():
             for engine in svc.engines:
                 engine.close()
+
+    # -------------------------------------------------------------- aliases
+
+    def _aliases_file(self) -> str | None:
+        if self.data_path is None:
+            return None
+        return os.path.join(self.data_path, "aliases.json")
+
+    def _load_aliases(self) -> None:
+        path = self._aliases_file()
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                self.aliases = {
+                    a: set(idx) for a, idx in json.load(f).items()
+                }
+        except (json.JSONDecodeError, OSError):
+            return
+
+    def _save_aliases(self) -> None:
+        path = self._aliases_file()
+        if path is None:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({a: sorted(i) for a, i in self.aliases.items()}, f)
+        os.replace(tmp, path)
+
+    def resolve_index(self, name: str) -> str:
+        """Concrete index for a name that may be an alias.
+
+        Aliases must resolve to exactly ONE index here (multi-index
+        fan-out is a coordinator feature; the reference 400s writes the
+        same way when no write index is set)."""
+        if name in self.indices:
+            return name
+        targets = self.aliases.get(name)
+        if targets:
+            live = [t for t in sorted(targets) if t in self.indices]
+            if len(live) == 1:
+                return live[0]
+            if len(live) > 1:
+                raise ApiError(
+                    400,
+                    "illegal_argument_exception",
+                    f"alias [{name}] has more than one index associated "
+                    f"with it [{live}]",
+                )
+        return name  # fall through to index_not_found in get_index
+
+    def update_aliases(self, body: dict[str, Any]) -> dict:
+        """POST /_aliases {"actions": [{"add"|"remove": {...}}]}.
+
+        Atomic like the reference's TransportIndicesAliasesAction: every
+        action validates and applies against a staged copy; the live map
+        swaps (and persists) only if the whole request succeeds."""
+        actions = body.get("actions")
+        if not isinstance(actions, list):
+            raise ApiError(
+                400, "illegal_argument_exception", "[_aliases] requires [actions]"
+            )
+        staged = {a: set(t) for a, t in self.aliases.items()}
+        for entry in actions:
+            if not isinstance(entry, dict) or len(entry) != 1:
+                raise ApiError(
+                    400,
+                    "illegal_argument_exception",
+                    "each aliases action is one add/remove object",
+                )
+            ((op, spec),) = entry.items()
+            index = spec.get("index")
+            alias = spec.get("alias")
+            if op not in ("add", "remove") or not index or not alias:
+                raise ApiError(
+                    400,
+                    "illegal_argument_exception",
+                    f"invalid aliases action [{op}]",
+                )
+            if op == "add":
+                if index not in self.indices:
+                    raise index_not_found(index)
+                if alias in self.indices:
+                    raise ApiError(
+                        400,
+                        "invalid_alias_name_exception",
+                        f"an index exists with the same name as the alias "
+                        f"[{alias}]",
+                    )
+                staged.setdefault(alias, set()).add(index)
+            else:
+                targets = staged.get(alias, set())
+                if index not in targets:
+                    raise ApiError(
+                        404,
+                        "aliases_not_found_exception",
+                        f"aliases [{alias}] missing",
+                    )
+                targets.discard(index)
+                if not targets:
+                    staged.pop(alias, None)
+        self.aliases = staged
+        self._save_aliases()
+        return {"acknowledged": True}
+
+    def get_aliases(self, index: str | None = None) -> dict:
+        if index is None:
+            selected = set(self.indices)
+        elif index in self.indices:
+            selected = {index}
+        elif index in self.aliases:
+            # An alias filter lists EVERY member index (multi-target
+            # aliases are valid for reads/listing).
+            selected = {t for t in self.aliases[index] if t in self.indices}
+        else:
+            raise index_not_found(index)
+        return {
+            name: {
+                "aliases": {
+                    a: {} for a, t in self.aliases.items() if name in t
+                }
+            }
+            for name in sorted(selected)
+        }
+
+    def delete_alias(self, index: str, alias: str) -> dict:
+        return self.update_aliases(
+            {"actions": [{"remove": {"index": index, "alias": alias}}]}
+        )
+
+    # ------------------------------------------------------------- settings
+
+    def get_settings(self, index: str) -> dict:
+        svc = self.get_index(index)
+        merged = dict(svc.settings)
+        idx = dict(merged.get("index", {}))
+        idx.setdefault("number_of_shards", svc.n_shards)
+        idx["uuid"] = svc.uuid
+        merged["index"] = idx
+        return {svc.name: {"settings": merged}}
+
+    # Every entry here is READ somewhere: acknowledging a setting nothing
+    # consumes would be a silent no-op.
+    _DYNAMIC_SETTINGS = {
+        "default_pipeline",  # _resolve_pipeline
+        "merge",  # engine merge policy, applied below
+        "translog",  # durability, applied below
+        "max_result_window",  # from+size bound in search()
+    }
+
+    def put_settings(self, index: str, body: dict[str, Any]) -> dict:
+        """Dynamic settings subset (the reference's update-settings action;
+        static settings like number_of_shards reject with 400)."""
+        svc = self.get_index(index)
+        flat = body.get("index", body) or {}
+        # accept dotted keys ("index.default_pipeline") and nested forms
+        updates: dict[str, Any] = {}
+        for key, value in flat.items():
+            key = key.removeprefix("index.")
+            top = key.split(".")[0]
+            if top not in self._DYNAMIC_SETTINGS:
+                raise ApiError(
+                    400,
+                    "illegal_argument_exception",
+                    f"setting [index.{key}] is not dynamically updateable",
+                )
+            updates[key] = value
+        idx_settings = svc.settings.setdefault("index", {})
+        for key, value in updates.items():
+            parts = key.split(".")
+            cur = idx_settings
+            for part in parts[:-1]:
+                cur = cur.setdefault(part, {})
+            cur[parts[-1]] = value
+        merge_cfg = idx_settings.get("merge", {})
+        translog_cfg = idx_settings.get("translog", {})
+        for engine in svc.engines:
+            if "merge" in idx_settings:
+                engine.max_segments = max(
+                    1, int(merge_cfg.get("max_segment_count", engine.max_segments))
+                )
+                engine.merge_factor = max(
+                    2, int(merge_cfg.get("merge_factor", engine.merge_factor))
+                )
+            if engine.translog is not None and "durability" in translog_cfg:
+                engine.translog.durability = translog_cfg["durability"]
+        self._save_index_meta(svc)
+        return {"acknowledged": True}
+
+    def get_index_info(self, index: str) -> dict:
+        svc = self.get_index(index)
+        return {
+            svc.name: {
+                "aliases": {
+                    a: {} for a, t in self.aliases.items() if svc.name in t
+                },
+                "mappings": svc.mappings.to_json(),
+                "settings": self.get_settings(index)[svc.name]["settings"],
+            }
+        }
 
     # --------------------------------------------------------------- ingest
 
@@ -1325,6 +1741,95 @@ class Node:
             }
             for name, svc in sorted(self.indices.items())
         ]
+
+    def cat_health(self) -> list[dict]:
+        h = self.cluster_health()
+        return [
+            {
+                "cluster": h["cluster_name"],
+                "status": h["status"],
+                "node.total": str(h["number_of_nodes"]),
+                "shards": str(h["active_shards"]),
+                "pri": str(h["active_primary_shards"]),
+                "unassign": "0",
+            }
+        ]
+
+    def cat_count(self, index: str | None = None) -> list[dict]:
+        if index is not None:
+            count = self.get_index(index).num_docs
+        else:
+            count = sum(s.num_docs for s in self.indices.values())
+        return [{"count": str(count)}]
+
+    def cat_shards(self) -> list[dict]:
+        rows = []
+        for name, svc in sorted(self.indices.items()):
+            for shard_idx, engine in enumerate(svc.engines):
+                rows.append(
+                    {
+                        "index": name,
+                        "shard": str(shard_idx),
+                        "prirep": "p",
+                        "state": "STARTED",
+                        "docs": str(engine.num_docs),
+                        "node": self.node_name,
+                    }
+                )
+        return rows
+
+    def cat_segments(self) -> list[dict]:
+        rows = []
+        for name, svc in sorted(self.indices.items()):
+            for shard_idx, engine in enumerate(svc.engines):
+                for handle in engine.segments:
+                    rows.append(
+                        {
+                            "index": name,
+                            "shard": str(shard_idx),
+                            "segment": f"_{handle.seg_id or 0}",
+                            "docs.count": str(handle.live_count),
+                            "docs.deleted": str(
+                                handle.segment.num_docs - handle.live_count
+                            ),
+                            "size.memory": str(handle.nbytes),
+                        }
+                    )
+        return rows
+
+    def cluster_stats(self) -> dict:
+        return {
+            "cluster_name": self.cluster_name,
+            "status": "green",
+            "indices": {
+                "count": len(self.indices),
+                "shards": {
+                    "total": sum(s.n_shards for s in self.indices.values())
+                },
+                "docs": {
+                    "count": sum(s.num_docs for s in self.indices.values())
+                },
+            },
+            "nodes": {"count": {"total": 1, "data": 1}},
+        }
+
+    def nodes_info(self) -> dict:
+        import jax
+
+        return {
+            "cluster_name": self.cluster_name,
+            "nodes": {
+                self.node_name: {
+                    "name": self.node_name,
+                    "version": "8.0.0-tpu",
+                    "roles": ["data", "ingest", "master"],
+                    "accelerator": {
+                        "platform": jax.devices()[0].platform,
+                        "device_count": jax.device_count(),
+                    },
+                }
+            },
+        }
 
     def stats(self) -> dict:
         return {

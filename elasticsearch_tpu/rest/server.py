@@ -85,6 +85,31 @@ class RestServer:
             "tagline": "You Know, for (TPU) Search",
         })
         r("GET", "/_cluster/health", lambda s, p, q, b: n.cluster_health())
+        r("GET", "/_cluster/stats", lambda s, p, q, b: n.cluster_stats())
+        r("GET", "/_nodes", lambda s, p, q, b: n.nodes_info())
+        r("GET", "/_cat/health", lambda s, p, q, b: n.cat_health())
+        r("GET", "/_cat/count", lambda s, p, q, b: n.cat_count())
+        r("GET", "/_cat/count/{index}", lambda s, p, q, b: n.cat_count(
+            p["index"]
+        ))
+        r("GET", "/_cat/shards", lambda s, p, q, b: n.cat_shards())
+        r("GET", "/_cat/segments", lambda s, p, q, b: n.cat_segments())
+        r("POST", "/_aliases", lambda s, p, q, b: n.update_aliases(_json(b)))
+        r("GET", "/_alias", lambda s, p, q, b: n.get_aliases())
+        r("GET", "/{index}/_alias", lambda s, p, q, b: n.get_aliases(
+            p["index"]
+        ))
+        r("PUT", "/{index}/_alias/{name}", lambda s, p, q, b: n.update_aliases(
+            {"actions": [{"add": {"index": p["index"], "alias": p["name"]}}]}
+        ))
+        r("DELETE", "/{index}/_alias/{name}",
+          lambda s, p, q, b: n.delete_alias(p["index"], p["name"]))
+        r("GET", "/{index}/_settings", lambda s, p, q, b: n.get_settings(
+            p["index"]
+        ))
+        r("PUT", "/{index}/_settings", lambda s, p, q, b: n.put_settings(
+            p["index"], _json(b)
+        ))
         r("GET", "/_tasks", lambda s, p, q, b: n.list_tasks(
             q.get("actions")
         ))
@@ -171,6 +196,18 @@ class RestServer:
         r("POST", "/{index}/_forcemerge", lambda s, p, q, b: n.force_merge(
             p["index"], int(q.get("max_num_segments", 1))
         ))
+        r("POST", "/{index}/_delete_by_query",
+          lambda s, p, q, b: n.delete_by_query(
+              p["index"], _json(b), refresh=q.get("refresh") in ("true", "")
+          ))
+        r("POST", "/{index}/_update_by_query",
+          lambda s, p, q, b: n.update_by_query(
+              p["index"], _json(b), refresh=q.get("refresh") in ("true", ""),
+              pipeline=q.get("pipeline"),
+          ))
+        r("POST", "/_reindex", lambda s, p, q, b: n.reindex(
+            _json(b), refresh=q.get("refresh") in ("true", "")
+        ))
         r("POST", "/{index}/_analyze", self._analyze)
         r("POST", "/{index}/_doc", lambda s, p, q, b: n.index_doc(
             p["index"], _json(b), None,
@@ -200,6 +237,7 @@ class RestServer:
         r("PUT", "/{index}", lambda s, p, q, b: n.create_index(
             p["index"], _json(b)
         ))
+        r("GET", "/{index}", lambda s, p, q, b: n.get_index_info(p["index"]))
         r("DELETE", "/{index}", lambda s, p, q, b: n.delete_index(p["index"]))
 
     def _create_doc(self, s, p, q, b):
