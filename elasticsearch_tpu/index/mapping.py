@@ -103,6 +103,13 @@ class FieldMapping:
     dims: int = 0  # dense_vector dimension
     index: bool = True  # whether the field is searchable
     norms: bool | None = None  # None -> type default (text: True, keyword: False)
+    # Multi-fields (the reference's FieldMapper multiFields, e.g. the
+    # ubiquitous text + .keyword pattern): each sub-field indexes the SAME
+    # source value under "<name>.<sub>" with its own mapping.
+    fields: dict[str, "FieldMapping"] = field(default_factory=dict)
+    # keyword option: values longer than this many characters are not
+    # indexed (KeywordFieldMapper ignore_above; 0 = no limit).
+    ignore_above: int = 0
 
     def __post_init__(self):
         if self.type not in ALL_TYPES:
@@ -145,9 +152,17 @@ class Mappings:
         for name, spec in (properties or {}).items():
             self.fields[name] = self._parse_field(name, spec)
 
-    @staticmethod
-    def _parse_field(name: str, spec: dict[str, Any]) -> FieldMapping:
+    @classmethod
+    def _parse_field(cls, name: str, spec: dict[str, Any]) -> FieldMapping:
         norms = spec.get("norms")
+        subs = {}
+        for sub_name, sub_spec in (spec.get("fields") or {}).items():
+            if sub_spec.get("fields"):
+                raise ValueError(
+                    f"cannot nest multi-fields inside multi-field "
+                    f"[{name}.{sub_name}]"
+                )
+            subs[sub_name] = cls._parse_field(f"{name}.{sub_name}", sub_spec)
         return FieldMapping(
             name=name,
             type=spec.get("type", TEXT),
@@ -156,6 +171,8 @@ class Mappings:
             dims=int(spec.get("dims", 0)),
             index=bool(spec.get("index", True)),
             norms=None if norms is None else bool(norms),
+            fields=subs,
+            ignore_above=int(spec.get("ignore_above", 0)),
         )
 
     @classmethod
@@ -163,31 +180,52 @@ class Mappings:
         mappings_json = mappings_json or {}
         return cls(properties=mappings_json.get("properties"), **kw)
 
+    @staticmethod
+    def _field_spec(f: FieldMapping) -> dict[str, Any]:
+        spec: dict[str, Any] = {"type": f.type}
+        if f.type == TEXT and f.analyzer != "standard":
+            spec["analyzer"] = f.analyzer
+        if f.search_analyzer != f.analyzer:
+            spec["search_analyzer"] = f.search_analyzer
+        if f.type == DENSE_VECTOR:
+            spec["dims"] = f.dims
+        if not f.index:
+            spec["index"] = False
+        if f.norms != (f.type == TEXT):
+            spec["norms"] = f.norms
+        if f.ignore_above:
+            spec["ignore_above"] = f.ignore_above
+        if f.fields:
+            spec["fields"] = {
+                sub_name: Mappings._field_spec(sub)
+                for sub_name, sub in f.fields.items()
+            }
+        return spec
+
     def to_json(self) -> dict[str, Any]:
         """Lossless schema serialization (round-trips through from_json)."""
-        props: dict[str, Any] = {}
-        for f in self.fields.values():
-            spec: dict[str, Any] = {"type": f.type}
-            if f.type == TEXT and f.analyzer != "standard":
-                spec["analyzer"] = f.analyzer
-            if f.search_analyzer != f.analyzer:
-                spec["search_analyzer"] = f.search_analyzer
-            if f.type == DENSE_VECTOR:
-                spec["dims"] = f.dims
-            if not f.index:
-                spec["index"] = False
-            if f.norms != (f.type == TEXT):
-                spec["norms"] = f.norms
-            props[f.name] = spec
-        return {"properties": props}
+        return {
+            "properties": {
+                f.name: self._field_spec(f) for f in self.fields.values()
+            }
+        }
 
     def get(self, name: str) -> FieldMapping | None:
-        return self.fields.get(name)
+        fm = self.fields.get(name)
+        if fm is not None:
+            return fm
+        # "<field>.<sub>" resolves through the parent's multi-fields.
+        if "." in name:
+            parent, _, sub = name.rpartition(".")
+            pfm = self.fields.get(parent)
+            if pfm is not None:
+                return pfm.fields.get(sub)
+        return None
 
     def resolve_dynamic(self, name: str, value: Any) -> FieldMapping | None:
         """Map an unseen field from a concrete JSON value (or return None)."""
-        existing = self.fields.get(name)
-        if existing is not None:
+        existing = self.get(name)  # incl. multi-field sub-paths: a literal
+        if existing is not None:  # dotted key must not shadow "<f>.<sub>"
             return existing
         if not self.dynamic:
             return None
@@ -207,12 +245,28 @@ class Mappings:
             ftype = TEXT
         else:
             return None
-        fm = FieldMapping(name=name, type=ftype)
+        if ftype == TEXT:
+            # Dynamic strings map like the reference's default template:
+            # text with a .keyword sub-field (ignore_above 256) so exact
+            # matching / terms aggs / sorting work out of the box.
+            fm = FieldMapping(
+                name=name,
+                type=TEXT,
+                fields={
+                    "keyword": FieldMapping(
+                        name=f"{name}.keyword",
+                        type=KEYWORD,
+                        ignore_above=256,
+                    )
+                },
+            )
+        else:
+            fm = FieldMapping(name=name, type=ftype)
         self.fields[name] = fm
         return fm
 
     def analyzer_for(self, name: str, search: bool = False):
-        fm = self.fields.get(name)
+        fm = self.get(name)  # resolves multi-field sub-paths too
         if fm is None:
             return self.analysis.get("standard")
         return self.analysis.get(fm.search_analyzer if search else fm.analyzer)

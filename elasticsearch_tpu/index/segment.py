@@ -186,6 +186,87 @@ class SegmentBuilder:
     def num_docs(self) -> int:
         return len(self._sources)
 
+    def _stage_field(
+        self,
+        field_name: str,
+        fm,
+        value: Any,
+        staged_vectors: list,
+        staged_postings: list,
+        staged_numeric: list,
+    ) -> None:
+        """Stage one (field, value) pair — raises on mapper errors, touches
+        no builder state (add()'s atomicity contract).
+
+        Note: index=false only disables inverted search (fm.is_inverted is
+        False then); numeric doc_values and vectors are stored regardless,
+        matching the reference where index:false keeps doc_values available
+        for sort/agg/script access."""
+        if fm.type == DENSE_VECTOR:
+            vec = np.asarray(value, dtype=np.float32)
+            if fm.dims and vec.shape[-1] != fm.dims:
+                raise ValueError(
+                    f"dense_vector [{field_name}] dims mismatch: "
+                    f"{vec.shape[-1]} != {fm.dims}"
+                )
+            staged_vectors.append((field_name, vec))
+        elif fm.is_inverted:
+            analyzer = self.mappings.analyzer_for(field_name)
+            # Keyword fields index without positions (index_options=docs,
+            # the reference's KeywordFieldMapper default); text fields
+            # record per-occurrence positions for phrase queries.
+            with_positions = fm.norms
+            use_native = with_positions and self._field_uses_native(
+                field_name, analyzer
+            )
+            total_len = 0
+            tf: dict[str, int] = {}
+            poss: dict[str, list[int]] = {}
+            native_vals: list[tuple] | None = [] if use_native else None
+            base = 0
+            for v in _iter_field_values(value):
+                if fm.ignore_above and len(str(v)) > fm.ignore_above:
+                    continue  # KeywordFieldMapper ignore_above: not indexed
+                if use_native:
+                    r = tokenize_ascii(str(v))
+                    if r is not None:  # ASCII fast path, C++ tokenizer
+                        buf, offs = r
+                        n = len(offs) - 1
+                        total_len += n
+                        native_vals.append(("buf", buf, offs, base))
+                        base += n + POSITION_INCREMENT_GAP
+                    else:  # Unicode: Python analyzer, native postings
+                        pairs, span = analyzer.analyze_positions(str(v))
+                        total_len += len(pairs)
+                        native_vals.append(
+                            (
+                                "toks",
+                                [t for t, _ in pairs],
+                                [p for _, p in pairs],
+                                base,
+                            )
+                        )
+                        base += span + POSITION_INCREMENT_GAP
+                elif with_positions:
+                    pairs, span = analyzer.analyze_positions(str(v))
+                    total_len += len(pairs)
+                    for tok, pos in pairs:
+                        tf[tok] = tf.get(tok, 0) + 1
+                        poss.setdefault(tok, []).append(base + pos)
+                    base += span + POSITION_INCREMENT_GAP
+                else:  # keyword-style fields skip position tracking
+                    tokens = analyzer.analyze(str(v))
+                    total_len += len(tokens)
+                    for tok in tokens:
+                        tf[tok] = tf.get(tok, 0) + 1
+            staged_postings.append(
+                (field_name, tf, total_len, poss, native_vals)
+            )
+        elif fm.is_numeric:
+            vals = _iter_field_values(value)
+            v0 = vals[0]  # multi-valued numerics keep first value for now
+            staged_numeric.append((field_name, coerce_numeric(fm.type, v0)))
+
     def add(
         self,
         source: dict[str, Any],
@@ -206,79 +287,27 @@ class SegmentBuilder:
         staged_vectors: list[tuple[str, np.ndarray]] = []
         staged_postings: list[tuple[str, dict[str, int], int]] = []
         staged_numeric: list[tuple[str, float]] = []
-        for field_name, value in source.items():
+        for source_name, value in source.items():
             if value is None:
                 continue
-            fm = self.mappings.resolve_dynamic(field_name, value)
-            if fm is None:
+            root_fm = self.mappings.resolve_dynamic(source_name, value)
+            if root_fm is None:
                 continue
-            # Note: index=false only disables inverted search (fm.is_inverted
-            # is False then); numeric doc_values and vectors are stored
-            # regardless, matching the reference where index:false keeps
-            # doc_values available for sort/agg/script access.
-            if fm.type == DENSE_VECTOR:
-                vec = np.asarray(value, dtype=np.float32)
-                if fm.dims and vec.shape[-1] != fm.dims:
-                    raise ValueError(
-                        f"dense_vector [{field_name}] dims mismatch: "
-                        f"{vec.shape[-1]} != {fm.dims}"
-                    )
-                staged_vectors.append((field_name, vec))
-            elif fm.is_inverted:
-                analyzer = self.mappings.analyzer_for(field_name)
-                # Keyword fields index without positions (index_options=docs,
-                # the reference's KeywordFieldMapper default); text fields
-                # record per-occurrence positions for phrase queries.
-                with_positions = fm.norms
-                use_native = with_positions and self._field_uses_native(
-                    field_name, analyzer
-                )
-                total_len = 0
-                tf: dict[str, int] = {}
-                poss: dict[str, list[int]] = {}
-                native_vals: list[tuple] | None = [] if use_native else None
-                base = 0
-                for v in _iter_field_values(value):
-                    if use_native:
-                        r = tokenize_ascii(str(v))
-                        if r is not None:  # ASCII fast path, C++ tokenizer
-                            buf, offs = r
-                            n = len(offs) - 1
-                            total_len += n
-                            native_vals.append(("buf", buf, offs, base))
-                            base += n + POSITION_INCREMENT_GAP
-                        else:  # Unicode: Python analyzer, native postings
-                            pairs, span = analyzer.analyze_positions(str(v))
-                            total_len += len(pairs)
-                            native_vals.append(
-                                (
-                                    "toks",
-                                    [t for t, _ in pairs],
-                                    [p for _, p in pairs],
-                                    base,
-                                )
-                            )
-                            base += span + POSITION_INCREMENT_GAP
-                    elif with_positions:
-                        pairs, span = analyzer.analyze_positions(str(v))
-                        total_len += len(pairs)
-                        for tok, pos in pairs:
-                            tf[tok] = tf.get(tok, 0) + 1
-                            poss.setdefault(tok, []).append(base + pos)
-                        base += span + POSITION_INCREMENT_GAP
-                    else:  # keyword-style fields skip position tracking
-                        tokens = analyzer.analyze(str(v))
-                        total_len += len(tokens)
-                        for tok in tokens:
-                            tf[tok] = tf.get(tok, 0) + 1
-                staged_postings.append(
-                    (field_name, tf, total_len, poss, native_vals)
-                )
-            elif fm.is_numeric:
-                vals = _iter_field_values(value)
-                v0 = vals[0]  # multi-valued numerics keep first value for now
-                staged_numeric.append(
-                    (field_name, coerce_numeric(fm.type, v0))
+            # Multi-fields: the same source value indexes under the parent
+            # AND every "<name>.<sub>" sub-field with its own mapping
+            # (FieldMapper multiFields).
+            targets = [(source_name, root_fm)] + [
+                (f"{source_name}.{sub}", sub_fm)
+                for sub, sub_fm in root_fm.fields.items()
+            ]
+            for field_name, fm in targets:
+                self._stage_field(
+                    field_name,
+                    fm,
+                    value,
+                    staged_vectors,
+                    staged_postings,
+                    staged_numeric,
                 )
         # ---- commit phase: nothing below raises -------------------------
         self._sources.append(source)

@@ -422,13 +422,29 @@ class Node:
         for fname, spec in (body.get("properties") or {}).items():
             existing = svc.mappings.get(fname)
             new = Mappings._parse_field(fname, spec)
-            if existing is not None and existing.type != new.type:
-                raise ApiError(
-                    400,
-                    "illegal_argument_exception",
-                    f"mapper [{fname}] cannot be changed from type "
-                    f"[{existing.type}] to [{new.type}]",
-                )
+            if existing is not None:
+                if existing.type != new.type:
+                    raise ApiError(
+                        400,
+                        "illegal_argument_exception",
+                        f"mapper [{fname}] cannot be changed from type "
+                        f"[{existing.type}] to [{new.type}]",
+                    )
+                # Multi-fields MERGE (the reference merges mappers): subs
+                # absent from the update survive; type changes of an
+                # existing sub are as illegal as for a root field.
+                for sub_name, sub_new in new.fields.items():
+                    sub_old = existing.fields.get(sub_name)
+                    if sub_old is not None and sub_old.type != sub_new.type:
+                        raise ApiError(
+                            400,
+                            "illegal_argument_exception",
+                            f"mapper [{fname}.{sub_name}] cannot be changed "
+                            f"from type [{sub_old.type}] to [{sub_new.type}]",
+                        )
+                merged_subs = dict(existing.fields)
+                merged_subs.update(new.fields)
+                new.fields = merged_subs
             svc.mappings.fields[fname] = new
         self._save_index_meta(svc)
         return {"acknowledged": True}
@@ -759,6 +775,22 @@ class Node:
         except ValueError as e:
             raise ApiError(400, "search_phase_execution_exception", str(e)) from None
         out = response.to_json(index)
+        if body and body.get("suggest"):
+            from .search.suggest import run_suggest
+
+            stats = (
+                svc.search.global_stats()
+                if isinstance(svc.search, ShardedSearchCoordinator)
+                else svc.engines[0].field_stats()
+            )
+            try:
+                out["suggest"] = run_suggest(
+                    body["suggest"], svc.mappings, stats
+                )
+            except ValueError as e:
+                raise ApiError(
+                    400, "search_phase_execution_exception", str(e)
+                ) from None
         if cache_key is not None and not response.timed_out:
             self.request_cache.put(cache_key, out)
         return out
@@ -774,6 +806,77 @@ class Node:
             "count": result["hits"]["total"]["value"],
             "_shards": {"total": n, "successful": n, "skipped": 0, "failed": 0},
         }
+
+    def explain(self, index: str, doc_id: str, body: dict[str, Any] | None) -> dict:
+        """GET/POST /{index}/_explain/{id}: why (and how strongly) one doc
+        matches a query (TransportExplainAction). The score comes from the
+        same device kernel evaluated at that document via scores_at.
+
+        Reads the CURRENT searchable view — never refreshes (a read API
+        must not publish buffered docs or invalidate caches); a doc that
+        is only in the unrefreshed buffer is not searchable yet and
+        reports 404 like the reference's uid-term lookup."""
+        from .ops import bm25_device
+
+        svc = self.get_index(index)
+        engine = svc.route(doc_id)
+        # The (seg_idx, local) -> handle resolution must be atomic with the
+        # lookup: a concurrent merge rebuilds the segment list and remaps
+        # _live_ids in place.
+        with engine.lock:
+            loc = engine._live_ids.get(doc_id)
+            handle = engine.segments[loc[0]] if loc is not None else None
+        if loc is None:
+            raise ApiError(
+                404,
+                "resource_not_found_exception",
+                f"document [{doc_id}] does not exist",
+            )
+        try:
+            request = SearchRequest.from_json(body)
+        except ValueError as e:
+            raise ApiError(
+                400, "search_phase_execution_exception", str(e)
+            ) from None
+        _seg_idx, local = loc
+        stats = (
+            svc.search.global_stats()
+            if isinstance(svc.search, ShardedSearchCoordinator)
+            else engine.field_stats()
+        )
+        try:
+            compiled = engine.compiler_for(handle, stats).compile(request.query)
+        except ValueError as e:
+            raise ApiError(
+                400, "search_phase_execution_exception", str(e)
+            ) from None
+        seg_tree = bm25_device.segment_tree(handle.device)
+        scores, matched = bm25_device.scores_at(
+            seg_tree, compiled.spec, compiled.arrays, np.asarray([local])
+        )
+        is_match = bool(np.asarray(matched)[0])
+        score = float(np.asarray(scores)[0])
+        out = {
+            "_index": svc.name,
+            "_id": doc_id,
+            "matched": is_match,
+        }
+        if is_match:
+            out["explanation"] = {
+                "value": score,
+                "description": (
+                    "score computed by the TPU query kernel "
+                    "(Lucene-parity fp32 BM25 over the compiled plan)"
+                ),
+                "details": [],
+            }
+        else:
+            out["explanation"] = {
+                "value": 0.0,
+                "description": "no matching clause for this document",
+                "details": [],
+            }
+        return out
 
     # --------------------------------------------------------------- scroll
 

@@ -183,6 +183,9 @@ class RestServer:
             r(method, "/{index}/_mget", lambda s, p, q, b: n.mget(
                 _json(b), default_index=p["index"]
             ))
+            r(method, "/{index}/_explain/{id}", lambda s, p, q, b: n.explain(
+                p["index"], p["id"], _json(b)
+            ))
         r("DELETE", "/_search/scroll", lambda s, p, q, b: n.clear_scroll(
             _json(b)
         ))

@@ -1,0 +1,79 @@
+"""Term suggester: did-you-mean corrections from the term dictionary.
+
+The analog of the reference's TermSuggester (search/suggest/term/ —
+DirectSpellChecker over the terms dict): each analyzed token of the
+suggest text gathers dictionary terms within max_edits (OSA distance,
+shared prefix required), scored by string similarity then frequency.
+Runs on the host against the shard-aggregated term statistics — the term
+dictionary lives host-side by design (tiles.py keeps it off-device).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..query.compile import _damerau_bounded
+
+
+def run_suggest(
+    body: dict[str, Any], mappings, stats: dict
+) -> dict[str, Any]:
+    """Evaluate the `suggest` section of a search request.
+
+    `stats` is the aggregated per-field FieldStats map (df per term)."""
+    out: dict[str, Any] = {}
+    for name, spec in body.items():
+        if not isinstance(spec, dict):
+            raise ValueError(f"suggestion [{name}] must be an object")
+        text = spec.get("text", "")
+        term_spec = spec.get("term")
+        if term_spec is None:
+            raise ValueError(
+                f"suggestion [{name}] requires a [term] suggester "
+                f"(other suggesters are not supported yet)"
+            )
+        field = term_spec.get("field")
+        if not field:
+            raise ValueError(f"suggestion [{name}] requires [term.field]")
+        size = int(term_spec.get("size", 5))
+        max_edits = int(term_spec.get("max_edits", 2))
+        prefix_len = int(term_spec.get("prefix_length", 1))
+        suggest_mode = str(term_spec.get("suggest_mode", "missing"))
+        fstats = stats.get(field)
+        df = fstats.df if fstats is not None else {}
+        analyzer = mappings.analyzer_for(field, search=True)
+        entries = []
+        for token, start, end in analyzer.analyze_offsets(str(text)):
+            entry = {
+                "text": token,
+                "offset": start,
+                "length": end - start,
+                "options": [],
+            }
+            token_freq = df.get(token, 0)
+            if suggest_mode == "missing" and token_freq > 0:
+                entries.append(entry)
+                continue
+            prefix = token[:prefix_len]
+            options = []
+            for term, freq in df.items():
+                if term == token:
+                    continue
+                if prefix_len and not term.startswith(prefix):
+                    continue
+                if abs(len(term) - len(token)) > max_edits:
+                    continue
+                d = _damerau_bounded(token, term, max_edits)
+                if d is None:
+                    continue
+                if suggest_mode == "popular" and freq <= token_freq:
+                    continue
+                score = 1.0 - d / max(len(token), len(term))
+                options.append(
+                    {"text": term, "score": round(score, 4), "freq": freq}
+                )
+            options.sort(key=lambda o: (-o["score"], -o["freq"], o["text"]))
+            entry["options"] = options[:size]
+            entries.append(entry)
+        out[name] = entries
+    return out

@@ -1,0 +1,224 @@
+"""Multi-fields (.keyword), the term suggester, and _explain.
+
+Reference: FieldMapper multiFields + dynamic templates default,
+search/suggest/term (DirectSpellChecker), TransportExplainAction.
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import ApiError, Node
+from elasticsearch_tpu.rest.server import RestServer
+
+
+def test_explicit_multifields():
+    node = Node()
+    node.create_index(
+        "m",
+        {
+            "mappings": {
+                "properties": {
+                    "title": {
+                        "type": "text",
+                        "fields": {"keyword": {"type": "keyword"}},
+                    }
+                }
+            }
+        },
+    )
+    node.index_doc("m", {"title": "Quick Brown Fox"}, "1", refresh=True)
+    node.index_doc("m", {"title": "quick brown fox"}, "2", refresh=True)
+    # text parent: analyzed match
+    r = node.search("m", {"query": {"match": {"title": "quick"}}})
+    assert r["hits"]["total"]["value"] == 2
+    # .keyword: exact, case-sensitive term
+    r = node.search(
+        "m", {"query": {"term": {"title.keyword": "Quick Brown Fox"}}}
+    )
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+    # terms agg over .keyword
+    r = node.search(
+        "m",
+        {"size": 0, "aggs": {"t": {"terms": {"field": "title.keyword"}}}},
+    )
+    keys = {b["key"] for b in r["aggregations"]["t"]["buckets"]}
+    assert keys == {"Quick Brown Fox", "quick brown fox"}
+    # mappings round-trip the sub-fields
+    out = node.get_mapping("m")["m"]["mappings"]["properties"]["title"]
+    assert out["fields"]["keyword"]["type"] == "keyword"
+
+
+def test_dynamic_strings_get_keyword_subfield():
+    node = Node()
+    node.create_index("dyn", {})
+    node.index_doc("dyn", {"city": "San Francisco"}, "1", refresh=True)
+    node.index_doc("dyn", {"city": "Berlin"}, "2", refresh=True)
+    r = node.search(
+        "dyn", {"query": {"term": {"city.keyword": "San Francisco"}}}
+    )
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+    r = node.search(
+        "dyn",
+        {"size": 0, "aggs": {"c": {"terms": {"field": "city.keyword"}}}},
+    )
+    assert {b["key"] for b in r["aggregations"]["c"]["buckets"]} == {
+        "San Francisco",
+        "Berlin",
+    }
+    # sort by keyword? keyword sort unsupported (numeric only) — but the
+    # sub-field must round-trip persistence via mappings JSON
+    props = node.get_mapping("dyn")["dyn"]["mappings"]["properties"]
+    assert props["city"]["fields"]["keyword"]["ignore_above"] == 256
+
+
+def test_ignore_above():
+    node = Node()
+    node.create_index(
+        "ia",
+        {
+            "mappings": {
+                "properties": {
+                    "tag": {"type": "keyword", "ignore_above": 5}
+                }
+            }
+        },
+    )
+    node.index_doc("ia", {"tag": "short"}, "1")
+    node.index_doc("ia", {"tag": "waytoolongvalue"}, "2")
+    node.refresh("ia")
+    r = node.search("ia", {"query": {"term": {"tag": "short"}}})
+    assert r["hits"]["total"]["value"] == 1
+    r = node.search("ia", {"query": {"term": {"tag": "waytoolongvalue"}}})
+    assert r["hits"]["total"]["value"] == 0  # not indexed
+    # still stored in _source
+    assert node.get_doc("ia", "2")["_source"]["tag"] == "waytoolongvalue"
+
+
+def test_term_suggester():
+    node = Node()
+    node.create_index("s", {"mappings": {"properties": {"t": {"type": "text"}}}})
+    for i, words in enumerate(
+        ["amsterdam rotterdam", "amsterdam utrecht", "rotterdam harbor"]
+    ):
+        node.index_doc("s", {"t": words}, f"d{i}")
+    node.refresh("s")
+    r = node.search(
+        "s",
+        {
+            "size": 0,
+            "suggest": {
+                "fix": {"text": "amsterdom", "term": {"field": "t"}}
+            },
+        },
+    )
+    entry = r["suggest"]["fix"][0]
+    assert entry["text"] == "amsterdom"
+    assert entry["offset"] == 0 and entry["length"] == 9
+    assert entry["options"][0]["text"] == "amsterdam"
+    assert entry["options"][0]["freq"] == 2
+    # an existing term suggests nothing under suggest_mode=missing
+    r = node.search(
+        "s",
+        {
+            "size": 0,
+            "suggest": {
+                "fix": {"text": "utrecht", "term": {"field": "t"}}
+            },
+        },
+    )
+    assert r["suggest"]["fix"][0]["options"] == []
+    # multi-token text yields one entry per token
+    r = node.search(
+        "s",
+        {
+            "size": 0,
+            "suggest": {
+                "fix": {
+                    "text": "amsterdem harbar",
+                    "term": {"field": "t", "suggest_mode": "always"},
+                }
+            },
+        },
+    )
+    entries = r["suggest"]["fix"]
+    assert len(entries) == 2
+    assert entries[0]["options"][0]["text"] == "amsterdam"
+    assert entries[1]["options"][0]["text"] == "harbor"
+
+
+def test_put_mapping_merges_subfields():
+    node = Node()
+    node.create_index("pm", {})
+    node.index_doc("pm", {"title": "San Francisco"}, "1", refresh=True)
+    # update the root field: the dynamic .keyword sub-field must survive
+    node.put_mapping(
+        "pm", {"properties": {"title": {"type": "text"}}}
+    )
+    r = node.search(
+        "pm", {"query": {"term": {"title.keyword": "San Francisco"}}}
+    )
+    assert r["hits"]["total"]["value"] == 1
+    with pytest.raises(ApiError):  # sub-field type change rejected
+        node.put_mapping(
+            "pm",
+            {
+                "properties": {
+                    "title": {
+                        "type": "text",
+                        "fields": {"keyword": {"type": "long"}},
+                    }
+                }
+            },
+        )
+
+
+def test_dotted_source_key_does_not_shadow_subfield():
+    node = Node()
+    node.create_index("dot", {})
+    node.index_doc("dot", {"title": "Foo Bar"}, "1", refresh=True)
+    # a literal dotted key reuses the existing sub-field mapping (keyword)
+    node.index_doc("dot", {"title.keyword": "Baz"}, "2", refresh=True)
+    r = node.search("dot", {"query": {"term": {"title.keyword": "Foo Bar"}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+    r = node.search("dot", {"query": {"term": {"title.keyword": "Baz"}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["2"]
+
+
+def test_explain_does_not_refresh():
+    node = Node()
+    node.create_index("nr", {"mappings": {"properties": {"t": {"type": "text"}}}})
+    node.index_doc("nr", {"t": "visible"}, "1", refresh=True)
+    node.index_doc("nr", {"t": "buffered"}, "2")  # no refresh
+    with pytest.raises(ApiError):  # unrefreshed doc is not searchable
+        node.explain("nr", "2", {"query": {"match_all": {}}})
+    # ...and the explain must NOT have published it
+    r = node.search("nr", {"query": {"match_all": {}}, "size": 0})
+    assert r["hits"]["total"]["value"] == 1
+
+
+def test_explain_rest():
+    rest = RestServer()
+    node = rest.node
+    node.create_index("e", {"mappings": {"properties": {"t": {"type": "text"}}}})
+    node.index_doc("e", {"t": "alpha beta"}, "1", refresh=True)
+    node.index_doc("e", {"t": "gamma delta"}, "2", refresh=True)
+    status, r = rest.dispatch(
+        "POST", "/e/_explain/1", {},
+        json.dumps({"query": {"match": {"t": "alpha"}}}),
+    )
+    assert status == 200 and r["matched"] is True
+    assert r["explanation"]["value"] > 0
+    # matches the _search score for the same doc
+    sr = node.search("e", {"query": {"match": {"t": "alpha"}}})
+    assert r["explanation"]["value"] == sr["hits"]["hits"][0]["_score"]
+    status, r = rest.dispatch(
+        "POST", "/e/_explain/2", {},
+        json.dumps({"query": {"match": {"t": "alpha"}}}),
+    )
+    assert status == 200 and r["matched"] is False
+    status, r = rest.dispatch(
+        "POST", "/e/_explain/nope", {},
+        json.dumps({"query": {"match_all": {}}}),
+    )
+    assert status == 404
