@@ -15,6 +15,7 @@ control-plane concern).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
 import shutil
@@ -62,6 +63,10 @@ def _parse_keepalive(value: str) -> float:
 
 
 _INDEX_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9_\-.]*$")
+
+# Search slow log (the reference's index.search.slowlog.*): queries over a
+# configured threshold log here with their source.
+slowlog = logging.getLogger("elasticsearch_tpu.slowlog.search")
 
 
 def _refresh_after_write(engine) -> bool:
@@ -775,6 +780,7 @@ class Node:
         except ValueError as e:
             raise ApiError(400, "search_phase_execution_exception", str(e)) from None
         out = response.to_json(index)
+        self._log_slow_search(svc, body, out.get("took", 0))
         if body and body.get("suggest"):
             from .search.suggest import run_suggest
 
@@ -877,6 +883,39 @@ class Node:
                 "details": [],
             }
         return out
+
+    def _log_slow_search(self, svc: IndexService, body, took_ms: int) -> None:
+        """index.search.slowlog.threshold.query.{warn,info,debug} — log the
+        slowest level the took time crosses (SearchSlowLog analog)."""
+        cfg = (
+            svc.settings.get("index", {})
+            .get("search", {})
+            .get("slowlog", {})
+            .get("threshold", {})
+            .get("query", {})
+        )
+        if not cfg:
+            return
+        for level, log in (
+            ("warn", slowlog.warning),
+            ("info", slowlog.info),
+            ("debug", slowlog.debug),
+        ):
+            raw = cfg.get(level)
+            if raw is None:
+                continue
+            try:
+                threshold_ms = _parse_keepalive(raw) * 1000.0
+            except ApiError:
+                continue
+            if took_ms >= threshold_ms:
+                log(
+                    "[%s] took[%dms], source[%s]",
+                    svc.name,
+                    took_ms,
+                    json.dumps(body or {}, separators=(",", ":"))[:1000],
+                )
+                return
 
     # --------------------------------------------------------------- scroll
 
@@ -1428,6 +1467,7 @@ class Node:
         "merge",  # engine merge policy, applied below
         "translog",  # durability, applied below
         "max_result_window",  # from+size bound in search()
+        "search",  # search.slowlog thresholds (_log_slow_search)
     }
 
     def put_settings(self, index: str, body: dict[str, Any]) -> dict:

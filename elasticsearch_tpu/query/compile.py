@@ -380,6 +380,13 @@ class Compiler:
             )
         if isinstance(q, IdsQuery):
             return self._ids(q)
+        from .querystring import QueryStringError, QueryStringQuery
+
+        if isinstance(q, QueryStringQuery):
+            try:
+                return self._node(q.to_query(self.mappings), scoring)
+            except QueryStringError as e:
+                raise ValueError(str(e)) from None
         if isinstance(q, DisMaxQuery):
             children = [self._node(c, scoring) for c in q.queries]
             if not children:

@@ -358,6 +358,21 @@ def parse_query(body: dict[str, Any]) -> Query:
             values=[str(v) for v in spec.get("values", [])],
             boost=_pop_boost(spec),
         )
+    if kind in ("query_string", "simple_query_string"):
+        from .querystring import QueryStringQuery
+
+        simple = kind == "simple_query_string"
+        q_text = spec.get("query")
+        if q_text is None:
+            raise ValueError(f"[{kind}] requires [query]")
+        return QueryStringQuery(
+            query=str(q_text),
+            fields=list(spec["fields"]) if "fields" in spec else None,
+            default_field=spec.get("default_field"),
+            default_operator=str(spec.get("default_operator", "or")).lower(),
+            simple=simple,
+            boost=_pop_boost(spec),
+        )
     if kind == "dis_max":
         return DisMaxQuery(
             queries=[parse_query(q) for q in spec.get("queries", [])],

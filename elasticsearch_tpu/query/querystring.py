@@ -1,0 +1,335 @@
+"""query_string / simple_query_string: Lucene-syntax mini-parser.
+
+The analog of the reference's QueryStringQueryBuilder /
+SimpleQueryStringBuilder (index/query/), covering the commonly used
+subset of the Lucene syntax:
+
+    term term2              default_operator combination (OR default)
+    +term -term             required / prohibited
+    term AND|OR|NOT term    boolean operators (&& || ! accepted too)
+    "a phrase"              match_phrase
+    field:term              field override (query_string dialect only)
+    pre*  te?m              prefix / wildcard terms
+    (grouping)              precedence
+    term^2                  per-clause boost (query_string dialect only)
+
+Operator semantics follow Lucene's classic flat parser: AND marks both
+neighbors required, OR marks both optional, bare adjacency follows
+default_operator, NOT/- prohibits, + requires. Unsupported grammar
+(ranges, regex, proximity ~N) raises a parsing error rather than
+mis-parsing. Parsing produces an unresolved tree; lowering to concrete
+per-field queries happens against the index mappings (default fields =
+every searchable text field, the reference's `*` expansion), with
+multi-field clauses combined dis_max like multi_match best_fields.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field as dc_field
+from typing import Any
+
+from .dsl import (
+    BoolQuery,
+    DisMaxQuery,
+    MatchAllQuery,
+    MatchPhraseQuery,
+    MatchQuery,
+    PrefixQuery,
+    Query,
+    WildcardQuery,
+)
+
+
+class QueryStringError(ValueError):
+    pass
+
+
+@dataclass
+class QueryStringQuery(Query):
+    """Deferred query_string: lowers against mappings at compile time."""
+
+    query: str = ""
+    fields: list[str] | None = None
+    default_field: str | None = None
+    default_operator: str = "or"
+    simple: bool = False  # simple_query_string dialect
+    boost: float = 1.0
+
+    def to_query(self, mappings) -> Query:
+        from .dsl import MatchNoneQuery
+
+        fields = self._resolve_fields(mappings)
+        if not fields:
+            # An explicit empty fields list targets nothing — collapsing
+            # to match_all would return the whole index for any text.
+            return MatchNoneQuery()
+        try:
+            group = _Parser(self.query, simple=self.simple).parse()
+        except QueryStringError:
+            if not self.simple:
+                raise
+            # The simple dialect NEVER throws on user input (the point of
+            # SimpleQueryStringQuery): degrade special syntax to plain text.
+            sanitized = re.sub(r'[+\-|!(){}\[\]^"~*?:\\/]', " ", self.query)
+            try:
+                group = _Parser(sanitized, simple=True).parse()
+            except QueryStringError:
+                # Even word operators (a bare "AND") degrade: every
+                # whitespace token becomes a literal term clause.
+                tokens = sanitized.split()
+                group = _Group(
+                    items=[
+                        ("", _Clause(kind="term", text=w)) for w in tokens
+                    ],
+                    joiners=[None] * max(0, len(tokens) - 1),
+                )
+        q = _lower_group(group, fields, self.default_operator)
+        if q is None:
+            return MatchAllQuery(boost=self.boost)
+        q.boost = q.boost * self.boost
+        return q
+
+    def _resolve_fields(self, mappings) -> list[tuple[str, float]]:
+        raw = self.fields
+        if raw is None and self.default_field not in (None, "*"):
+            raw = [self.default_field]
+        if raw is None:
+            # The reference's `*` default: every searchable text field.
+            defaults = [
+                (f.name, 1.0)
+                for f in mappings.fields.values()
+                if f.is_inverted and f.type == "text"
+            ]
+            return defaults or [("_all_absent", 1.0)]
+        out = []
+        for f in raw:
+            if "^" in f:
+                name, _, b = f.partition("^")
+                out.append((name, float(b)))
+            else:
+                out.append((f, 1.0))
+        return out
+
+
+# ---------------------------------------------------------------- parsing
+
+# Operators +/-/! only act as PREFIX operators (the tokenizer matches them
+# at token start, after whitespace/parens); inside a term they are literal
+# — "wi-fi" is one term, "-fi" after a space is a prohibit clause. This is
+# the reference parser's whitespace-sensitive modifier rule.
+_TOKEN_RE = re.compile(
+    r"""(?:
+        (?P<lparen>\() | (?P<rparen>\)) |
+        (?P<and>AND\b|&&) | (?P<or>OR\b|\|\|) | (?P<not>NOT\b|!) |
+        (?P<plus>\+) | (?P<minus>-) |
+        "(?P<phrase>[^"]*)" |
+        (?P<term>[^\s()"|]+)
+    )""",
+    re.VERBOSE,
+)
+
+_UNSUPPORTED_RE = re.compile(r"^\[|^\{|~\d*$|^/.*/$")
+
+
+@dataclass
+class _Clause:
+    kind: str  # "term" | "phrase" | "group"
+    text: str = ""
+    field: str | None = None
+    boost: float = 1.0
+    group: Any = None  # _Group for kind == "group"
+
+
+@dataclass
+class _Group:
+    items: list[tuple[str, _Clause]] = dc_field(default_factory=list)
+    joiners: list[str | None] = dc_field(default_factory=list)
+    # items[i] = (modifier "" | "must" | "must_not", clause);
+    # joiners[i] connects items[i] and items[i+1]: "and" | "or" | None.
+
+
+class _Parser:
+    def __init__(self, text: str, simple: bool):
+        self.simple = simple
+        self.tokens = self._tokenize(text)
+        self.pos = 0
+
+    @staticmethod
+    def _tokenize(text: str):
+        tokens = []
+        pos = 0
+        while pos < len(text):
+            if text[pos].isspace():
+                pos += 1
+                continue
+            m = _TOKEN_RE.match(text, pos)
+            if m is None or m.end() == pos:
+                raise QueryStringError(
+                    f"Cannot parse [{text}]: unexpected character at "
+                    f"offset {pos}"
+                )
+            pos = m.end()
+            for kind in (
+                "lparen", "rparen", "and", "or", "not", "plus", "minus",
+                "phrase", "term",
+            ):
+                if m.group(kind) is not None:
+                    tokens.append((kind, m.group(kind)))
+                    break
+        return tokens
+
+    def _peek(self):
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def _next(self):
+        tok = self._peek()
+        self.pos += 1
+        return tok
+
+    def parse(self) -> _Group:
+        group = self._group()
+        if self._peek() is not None:
+            raise QueryStringError(
+                f"Cannot parse query: unexpected [{self._peek()[1]}]"
+            )
+        return group
+
+    def _group(self) -> _Group:
+        group = _Group()
+        pending_joiner: str | None = None
+        while True:
+            tok = self._peek()
+            if tok is None or tok[0] == "rparen":
+                break
+            kind, _value = tok
+            if kind in ("and", "or"):
+                self._next()
+                pending_joiner = kind
+                continue
+            modifier = ""
+            if kind == "not":
+                self._next()
+                modifier = "must_not"
+            elif kind == "plus":
+                self._next()
+                modifier = "must"
+            elif kind == "minus":
+                self._next()
+                modifier = "must_not"
+            clause = self._clause()
+            if group.items:
+                group.joiners.append(pending_joiner)
+            group.items.append((modifier, clause))
+            pending_joiner = None
+        if pending_joiner is not None:
+            raise QueryStringError("Cannot parse query: dangling operator")
+        return group
+
+    def _clause(self) -> _Clause:
+        tok = self._next()
+        if tok is None:
+            raise QueryStringError("Cannot parse query: unexpected end")
+        kind, value = tok
+        if kind == "lparen":
+            inner = self._group()
+            closing = self._next()
+            if closing is None or closing[0] != "rparen":
+                raise QueryStringError("Cannot parse query: missing )")
+            return _Clause(kind="group", group=inner)
+        if kind == "phrase":
+            return _Clause(kind="phrase", text=value)
+        if kind == "term":
+            if _UNSUPPORTED_RE.search(value):
+                raise QueryStringError(
+                    f"Cannot parse [{value}]: ranges/proximity/regex are "
+                    f"not supported yet"
+                )
+            clause = _Clause(kind="term", text=value)
+            if not self.simple:
+                if ":" in clause.text:
+                    fname, _, rest = clause.text.partition(":")
+                    if not rest:
+                        raise QueryStringError(
+                            f"Cannot parse [{value}]: missing value after ':'"
+                        )
+                    clause.field = fname
+                    clause.text = rest
+                if "^" in clause.text:
+                    text, _, boost = clause.text.rpartition("^")
+                    try:
+                        clause.boost = float(boost)
+                        clause.text = text
+                    except ValueError:
+                        raise QueryStringError(
+                            f"Cannot parse boost [{boost}]"
+                        ) from None
+            return clause
+        raise QueryStringError(f"Cannot parse query: unexpected [{value}]")
+
+
+# --------------------------------------------------------------- lowering
+
+def _lower_group(group: _Group, fields, default_operator: str) -> Query | None:
+    if not group.items:
+        return None
+    n = len(group.items)
+    # Lucene classic flat semantics: AND requires both neighbors, OR makes
+    # both optional, adjacency follows default_operator; explicit +/-/NOT
+    # modifiers win.
+    required = [default_operator == "and"] * n
+    for i, joiner in enumerate(group.joiners):
+        if joiner == "and":
+            required[i] = required[i + 1] = True
+        elif joiner == "or":
+            required[i] = required[i + 1] = False
+    must: list[Query] = []
+    should: list[Query] = []
+    must_not: list[Query] = []
+    for i, (modifier, clause) in enumerate(group.items):
+        q = _lower_clause(clause, fields, default_operator)
+        if q is None:
+            continue
+        if modifier == "must_not":
+            must_not.append(q)
+        elif modifier == "must" or required[i]:
+            must.append(q)
+        else:
+            should.append(q)
+    if not must and not should and not must_not:
+        return None
+    if len(must) == 1 and not should and not must_not:
+        return must[0]
+    if len(should) == 1 and not must and not must_not:
+        return should[0]
+    return BoolQuery(must=must, should=should, must_not=must_not)
+
+
+def _lower_clause(clause: _Clause, fields, default_operator: str) -> Query | None:
+    if clause.kind == "group":
+        return _lower_group(clause.group, fields, default_operator)
+    targets = (
+        [(clause.field, 1.0)] if clause.field is not None else list(fields)
+    )
+    per_field: list[Query] = []
+    for fname, fboost in targets:
+        boost = fboost * clause.boost
+        text = clause.text
+        if clause.kind == "phrase":
+            per_field.append(MatchPhraseQuery(fname, text, boost=boost))
+        elif (
+            text.endswith("*")
+            and "*" not in text[:-1]
+            and "?" not in text
+            and len(text) > 1
+        ):
+            per_field.append(PrefixQuery(fname, text[:-1].lower(), boost=boost))
+        elif "*" in text or "?" in text:
+            per_field.append(WildcardQuery(fname, text.lower(), boost=boost))
+        else:
+            per_field.append(MatchQuery(fname, text, boost=boost))
+    if not per_field:
+        return None
+    if len(per_field) == 1:
+        return per_field[0]
+    return DisMaxQuery(queries=per_field, tie_breaker=0.0)

@@ -140,11 +140,13 @@ class ShardedSearchCoordinator:
             fields=None,
         )
         if k > 0 or agg_total is None:
-            merged, total, max_score, timed_out = self._scatter_merge(
-                shard_request, stats, snapshots, task=task
+            merged, total, max_score, timed_out, profiles = (
+                self._scatter_merge(shard_request, stats, snapshots, task=task)
             )
         else:
-            merged, total, max_score, timed_out = [], 0, None, False
+            merged, total, max_score, timed_out, profiles = (
+                [], 0, None, False, [],
+            )
         if task is not None and task.timed_out:
             timed_out = True
         if agg_total is not None:
@@ -164,6 +166,9 @@ class ShardedSearchCoordinator:
             aggregations=aggregations,
             shards=len(self.engines),
             timed_out=timed_out,
+            profile=(
+                {"shards": profiles} if request.profile and profiles else None
+            ),
         )
 
     def _apply_fetch_subphases(self, request: SearchRequest, hits) -> None:
@@ -211,16 +216,17 @@ class ShardedSearchCoordinator:
         snapshots: list[list],
         per_shard_after: list | None = None,
         task=None,
-    ) -> tuple[list[tuple], int, float | None, bool]:
+    ) -> tuple[list[tuple], int, float | None, bool, list[dict]]:
         """Fan one request out to every shard and merge by
         (merge key, shard, per-shard rank) — the single implementation of
         the coordinator reduce contract used by both first-page search and
         scroll continuation. Returns (sorted merged tuples, total,
-        max_score, timed_out)."""
+        max_score, timed_out, per-shard profiles)."""
         merged: list[tuple] = []
         total = 0
         max_score = None
         timed_out = False
+        profiles: list[dict] = []
         for shard_idx, svc in enumerate(self.services):
             if task is not None:
                 task.raise_if_cancelled()
@@ -239,6 +245,10 @@ class ShardedSearchCoordinator:
             resp = svc.search(
                 sub, stats=stats, segments=snapshots[shard_idx], task=task
             )
+            if resp.profile:
+                for shard_profile in resp.profile["shards"]:
+                    shard_profile["id"] = f"[{self.index_name}][{shard_idx}]"
+                    profiles.append(shard_profile)
             timed_out = timed_out or resp.timed_out
             total += resp.total or 0
             if resp.max_score is not None:
@@ -252,7 +262,7 @@ class ShardedSearchCoordinator:
                     (self._merge_key(request, hit), shard_idx, rank, hit)
                 )
         merged.sort(key=lambda t: (t[0], t[1], t[2]))
-        return merged, total, max_score, timed_out
+        return merged, total, max_score, timed_out, profiles
 
     def scroll_page(self, ctx: ScrollContext, task=None) -> SearchResponse:
         """Serve the next page of a scroll and advance its cursors."""
@@ -264,7 +274,7 @@ class ShardedSearchCoordinator:
         stripped = replace(
             request, highlight=None, docvalue_fields=None, fields=None
         )
-        merged, total, max_score, timed_out = self._scatter_merge(
+        merged, total, max_score, timed_out, _profiles = self._scatter_merge(
             stripped, ctx.stats, ctx.snapshots, ctx.per_shard_after, task=task
         )
         page = merged[:size]

@@ -151,6 +151,14 @@ def collect_query_terms(
             walk(q.filter)
         elif isinstance(q, ScriptScoreQuery) and q.query is not None:
             walk(q.query)
+        else:
+            from ..query.querystring import QueryStringError, QueryStringQuery
+
+            if isinstance(q, QueryStringQuery):
+                try:
+                    walk(q.to_query(mappings))
+                except QueryStringError:
+                    pass
 
     walk(query)
     return terms, preds

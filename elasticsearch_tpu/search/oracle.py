@@ -177,6 +177,10 @@ class OracleSearcher:
                 np.where(matched, np.float32(q.boost), np.float32(0.0)),
                 matched,
             )
+        from ..query.querystring import QueryStringQuery
+
+        if isinstance(q, QueryStringQuery):
+            return self._eval(q.to_query(self.mappings))
         if isinstance(q, DisMaxQuery):
             best = np.zeros(n, dtype=np.float32)
             total = np.zeros(n, dtype=np.float32)

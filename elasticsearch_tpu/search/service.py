@@ -77,6 +77,7 @@ class SearchResponse:
     shards: int = 1
     scroll_id: str | None = None
     timed_out: bool = False
+    profile: dict[str, Any] | None = None
 
     def to_json(self, index_name: str = "index") -> dict[str, Any]:
         hits_obj: dict[str, Any] = {
@@ -103,6 +104,8 @@ class SearchResponse:
             out["_scroll_id"] = self.scroll_id
         if self.aggregations is not None:
             out["aggregations"] = self.aggregations
+        if self.profile is not None:
+            out["profile"] = self.profile
         return out
 
 
@@ -177,6 +180,7 @@ class SearchRequest:
     highlight: Any = None  # highlight.HighlightSpec
     docvalue_fields: list[str] | None = None
     fields: list[str] | None = None  # retrieved from _source
+    profile: bool = False  # per-segment timing in the response
 
     @classmethod
     def from_json(cls, body: dict[str, Any] | None) -> "SearchRequest":
@@ -289,6 +293,7 @@ class SearchRequest:
             highlight=highlight,
             docvalue_fields=docvalue_fields,
             fields=fields,
+            profile=bool(body.get("profile", False)),
         )
 
 
@@ -365,8 +370,9 @@ class SearchService:
         candidates: list[tuple] = []
         total = 0
         timed_out = task is not None and task.timed_out  # agg pass may trip
+        profile_segments: list[dict] = []
         if k > 0 or agg_total is None:
-            for handle in segments:
+            for seg_i, handle in enumerate(segments):
                 if handle.segment.num_docs == 0:
                     continue
                 if task is not None:
@@ -378,9 +384,18 @@ class SearchService:
                     if task.check_deadline():
                         timed_out = True
                         break
+                seg_t0 = time.monotonic_ns() if request.profile else 0
                 total += self._query_segment(
                     handle, request, k, stats, candidates
                 )
+                if request.profile:
+                    profile_segments.append(
+                        {
+                            "segment": seg_i,
+                            "docs": handle.segment.num_docs,
+                            "time_in_nanos": time.monotonic_ns() - seg_t0,
+                        }
+                    )
         if agg_total is not None:
             # The agg program already counted matched ∧ live docs; trust one
             # source for totals (they are the same mask by construction).
@@ -410,6 +425,35 @@ class SearchService:
             )
         took = int((time.monotonic() - start) * 1000)
         total_out, relation = clamp_total(total, request.track_total_hits)
+        profile = None
+        if request.profile:
+            # Per-segment kernel-launch timing — the honest TPU shape of
+            # the reference's profile API (search/profile/): inside one
+            # XLA program there are no per-operator boundaries to time.
+            profile = {
+                "shards": [
+                    {
+                        "id": f"[{self.index_name}][0]",
+                        "searches": [
+                            {
+                                "query": [
+                                    {
+                                        "type": type(request.query).__name__,
+                                        "description": repr(request.query),
+                                        "time_in_nanos": sum(
+                                            s["time_in_nanos"]
+                                            for s in profile_segments
+                                        ),
+                                        "breakdown": {
+                                            "segments": profile_segments
+                                        },
+                                    }
+                                ]
+                            }
+                        ],
+                    }
+                ]
+            }
         return SearchResponse(
             took_ms=took,
             total=total_out,
@@ -418,6 +462,7 @@ class SearchService:
             hits=hits,
             aggregations=aggregations,
             timed_out=timed_out,
+            profile=profile,
         )
 
     def _validate_sort(self, request: SearchRequest) -> None:

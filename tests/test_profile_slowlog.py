@@ -1,0 +1,93 @@
+"""Profile API + search slow log (SURVEY §5.1 tracing/profiling).
+
+Reference: search/profile/ (the "profile": true response section),
+index/SearchSlowLog.java.
+"""
+
+import logging
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+MAPPINGS = {"properties": {"t": {"type": "text"}, "n": {"type": "long"}}}
+
+
+def seed(node, index="p", n=20, segments=2, **extra):
+    node.create_index(index, {"mappings": MAPPINGS, **extra})
+    per = n // segments
+    for i in range(n):
+        node.index_doc(index, {"t": f"w{i % 3}", "n": i}, f"d{i}")
+        if (i + 1) % per == 0:
+            node.refresh(index)
+    node.refresh(index)
+
+
+@pytest.mark.parametrize("n_shards", [1, 2])
+def test_profile_reports_per_segment_timing(n_shards):
+    node = Node()
+    seed(node, settings={"index": {"number_of_shards": n_shards}})
+    r = node.search(
+        "p", {"query": {"match": {"t": "w1"}}, "profile": True}
+    )
+    shards = r["profile"]["shards"]
+    assert len(shards) >= 1
+    q = shards[0]["searches"][0]["query"][0]
+    assert q["time_in_nanos"] > 0
+    assert q["breakdown"]["segments"]
+    assert all(s["time_in_nanos"] >= 0 for s in q["breakdown"]["segments"])
+    # no profile key without the flag
+    r = node.search("p", {"query": {"match": {"t": "w1"}}})
+    assert "profile" not in r
+
+
+def test_slowlog_fires_on_threshold(caplog):
+    node = Node()
+    seed(
+        node,
+        settings={
+            "index": {
+                "search": {
+                    "slowlog": {"threshold": {"query": {"warn": "0ms"}}}
+                }
+            }
+        },
+    )
+    with caplog.at_level(
+        logging.WARNING, logger="elasticsearch_tpu.slowlog.search"
+    ):
+        node.search("p", {"query": {"match": {"t": "w0"}}})
+    assert any("took[" in rec.message for rec in caplog.records)
+
+
+def test_slowlog_silent_below_threshold(caplog):
+    node = Node()
+    seed(
+        node,
+        settings={
+            "index": {
+                "search": {
+                    "slowlog": {"threshold": {"query": {"warn": "1h"}}}
+                }
+            }
+        },
+    )
+    with caplog.at_level(
+        logging.DEBUG, logger="elasticsearch_tpu.slowlog.search"
+    ):
+        node.search("p", {"query": {"match": {"t": "w0"}}})
+    assert not caplog.records
+
+
+def test_slowlog_threshold_settable_dynamically(caplog):
+    node = Node()
+    seed(node)
+    node.put_settings(
+        "p",
+        {"index": {"search": {"slowlog": {"threshold": {"query": {"warn": "0ms"}}}}}},
+    )
+    with caplog.at_level(
+        logging.WARNING, logger="elasticsearch_tpu.slowlog.search"
+    ):
+        node.search("p", {"query": {"match_all": {}}})
+    assert caplog.records

@@ -1,0 +1,256 @@
+"""query_string / simple_query_string: parser semantics + device/oracle
+parity.
+
+Reference: index/query/QueryStringQueryBuilder, SimpleQueryStringBuilder.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.index.tiles import pack_segment
+from elasticsearch_tpu.node import ApiError, Node
+from elasticsearch_tpu.ops import bm25_device
+from elasticsearch_tpu.query.compile import Compiler, aggregate_field_stats
+from elasticsearch_tpu.query.dsl import parse_query
+from elasticsearch_tpu.search.oracle import OracleSearcher
+
+MAPPINGS = Mappings.from_json(
+    {
+        "properties": {
+            "title": {"type": "text"},
+            "body": {"type": "text"},
+        }
+    }
+)
+
+
+@pytest.fixture(scope="module")
+def node():
+    node = Node()
+    node.create_index(
+        "q",
+        {
+            "mappings": {
+                "properties": {
+                    "title": {"type": "text"},
+                    "body": {"type": "text"},
+                }
+            }
+        },
+    )
+    docs = [
+        {"title": "quick brown fox", "body": "jumps over the lazy dog"},
+        {"title": "lazy dog", "body": "sleeps all day long"},
+        {"title": "brown bear", "body": "quick to anger"},
+        {"title": "red fox", "body": "clever and quick"},
+    ]
+    for i, d in enumerate(docs):
+        node.index_doc("q", d, f"d{i}")
+    node.refresh("q")
+    return node
+
+
+def ids(r):
+    return sorted(h["_id"] for h in r["hits"]["hits"])
+
+
+def test_default_or_and_operators(node):
+    r = node.search("q", {"query": {"query_string": {"query": "fox bear"}}})
+    assert ids(r) == ["d0", "d2", "d3"]
+    r = node.search(
+        "q",
+        {"query": {"query_string": {"query": "quick AND fox"}}},
+    )
+    assert ids(r) == ["d0", "d3"]
+    r = node.search(
+        "q",
+        {
+            "query": {
+                "query_string": {
+                    "query": "quick fox",
+                    "default_operator": "AND",
+                }
+            }
+        },
+    )
+    assert ids(r) == ["d0", "d3"]
+    r = node.search(
+        "q", {"query": {"query_string": {"query": "quick NOT fox"}}}
+    )
+    assert ids(r) == ["d2"]
+
+
+def test_field_syntax_phrase_prefix_group(node):
+    r = node.search(
+        "q", {"query": {"query_string": {"query": "title:lazy"}}}
+    )
+    assert ids(r) == ["d1"]
+    r = node.search(
+        "q", {"query": {"query_string": {"query": '"lazy dog"'}}}
+    )
+    assert ids(r) == ["d0", "d1"]
+    r = node.search(
+        "q", {"query": {"query_string": {"query": "bro*"}}}
+    )
+    assert ids(r) == ["d0", "d2"]
+    r = node.search(
+        "q",
+        {"query": {"query_string": {"query": "(bear OR sleeps) AND NOT red"}}},
+    )
+    assert ids(r) == ["d1", "d2"]
+
+
+def test_fields_param_and_boost(node):
+    r = node.search(
+        "q",
+        {
+            "query": {
+                "query_string": {"query": "quick", "fields": ["title"]}
+            }
+        },
+    )
+    assert ids(r) == ["d0"]
+    r = node.search(
+        "q",
+        {
+            "query": {
+                "query_string": {
+                    "query": "quick",
+                    "fields": ["title^3", "body"],
+                }
+            }
+        },
+    )
+    assert ids(r) == ["d0", "d2", "d3"]
+    assert r["hits"]["hits"][0]["_id"] == "d0"  # title boost wins
+
+
+def test_simple_query_string(node):
+    r = node.search(
+        "q",
+        {
+            "query": {
+                "simple_query_string": {
+                    "query": "quick -fox",
+                    "fields": ["title", "body"],
+                }
+            }
+        },
+    )
+    assert ids(r) == ["d2"]
+    # ':' is literal text in the simple dialect (no field syntax): the
+    # analyzer splits "title:lazy" into [title, lazy] and "lazy" matches
+    r = node.search(
+        "q",
+        {"query": {"simple_query_string": {"query": "title:lazy"}}},
+    )
+    assert ids(r) == ["d0", "d1"]
+
+
+def test_parse_errors(node):
+    for bad in ["(unclosed", "[1 TO 5]", "AND"]:
+        with pytest.raises(ApiError):
+            node.search("q", {"query": {"query_string": {"query": bad}}})
+
+
+def test_hyphenated_terms_are_not_exclusions(node):
+    node.index_doc("q", {"title": "wi fi router"}, "hy", refresh=True)
+    r = node.search(
+        "q", {"query": {"query_string": {"query": "wi-fi",
+                                         "fields": ["title"]}}}
+    )
+    assert "hy" in ids(r)  # analyzed to [wi, fi], OR-matched — not -fi
+    # a -prefix AFTER whitespace still prohibits
+    r = node.search(
+        "q",
+        {"query": {"query_string": {"query": "router -quick",
+                                    "fields": ["title"]}}},
+    )
+    assert ids(r) == ["hy"]
+    node.delete_doc("q", "hy", refresh=True)
+
+
+def test_simple_dialect_never_raises(node):
+    for garbage in ["foo(", 'un"closed', "AND", "a^", "(((", "[1 TO 2]"]:
+        r = node.search(
+            "q",
+            {"query": {"simple_query_string": {"query": garbage,
+                                               "fields": ["title"]}}},
+        )
+        assert "hits" in r  # degraded to plain text, no 400
+
+
+def test_empty_fields_list_matches_nothing(node):
+    r = node.search(
+        "q", {"query": {"query_string": {"query": "fox", "fields": []}}}
+    )
+    assert r["hits"]["total"]["value"] == 0
+
+
+def test_profile_agg_only_on_sharded_index():
+    n2 = Node()
+    n2.create_index(
+        "pr", {"settings": {"index": {"number_of_shards": 2}},
+               "mappings": {"properties": {"n": {"type": "long"}}}}
+    )
+    for i in range(8):
+        n2.index_doc("pr", {"n": i}, f"d{i}")
+    n2.refresh("pr")
+    r1 = n2.search(
+        "pr", {"size": 0, "profile": True,
+               "aggs": {"m": {"max": {"field": "n"}}}}
+    )
+    assert r1["aggregations"]["m"]["value"] == 7.0  # no 500, no stale data
+    assert "profile" not in r1 or r1["profile"]["shards"] is not None
+    r2 = n2.search("pr", {"query": {"match_all": {}}, "profile": True})
+    r3 = n2.search(
+        "pr", {"size": 0, "profile": True,
+               "aggs": {"m": {"max": {"field": "n"}}}}
+    )
+    assert r3.get("profile") != r2["profile"]  # never replays stale profiles
+
+
+def test_device_oracle_parity():
+    rng = np.random.default_rng(5)
+    builder = SegmentBuilder(MAPPINGS)
+    words = ["ant", "bee", "cow", "dog", "elk"]
+    for i in range(100):
+        builder.add(
+            {
+                "title": " ".join(rng.choice(words, rng.integers(1, 5))),
+                "body": " ".join(rng.choice(words, rng.integers(1, 8))),
+            },
+            f"d{i}",
+        )
+    segment = builder.build()
+    device = pack_segment(segment)
+    stats = aggregate_field_stats([segment])
+    compiler = Compiler(
+        fields=device.fields,
+        doc_values=device.doc_values,
+        mappings=MAPPINGS,
+        stats=stats,
+    )
+    oracle = OracleSearcher(segment, MAPPINGS)
+    tree = bm25_device.segment_tree(device)
+    for q_json in [
+        {"query_string": {"query": "ant bee"}},
+        {"query_string": {"query": "ant AND bee"}},
+        {"query_string": {"query": "title:cow OR body:dog"}},
+        {"query_string": {"query": '"ant bee" OR elk'}},
+        {"simple_query_string": {"query": "ant +bee -cow",
+                                 "fields": ["title", "body"]}},
+    ]:
+        query = parse_query(q_json)
+        o_scores, o_ids, o_total = oracle.search(query, 20)
+        compiled = compiler.compile(query)
+        d_scores, d_ids, d_total = (
+            np.asarray(x)
+            for x in bm25_device.execute(tree, compiled.spec, compiled.arrays, 20)
+        )
+        n = min(20, o_total)
+        assert int(d_total) == o_total, q_json
+        np.testing.assert_array_equal(d_ids[:n], o_ids[:n], err_msg=str(q_json))
+        np.testing.assert_array_equal(d_scores[:n], o_scores[:n])
