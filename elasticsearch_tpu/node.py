@@ -182,6 +182,7 @@ class Node:
         cluster_name: str = "es-tpu",
         data_path: str | None = None,
         breaker_limit_bytes: int | None = None,
+        plugins: list[str] | None = None,
     ):
         self.node_name = node_name
         self.cluster_name = cluster_name
@@ -206,6 +207,11 @@ class Node:
         self.pipelines: dict[str, Any] = {}  # ingest.Pipeline by id
         self._broken_pipelines: dict[str, Any] = {}  # unloadable, preserved
         self.aliases: dict[str, set[str]] = {}  # alias -> concrete indices
+        # Extension system (plugins.py): analyzers / ingest processors /
+        # query types contributed by ESTPU_PLUGINS or the plugins param.
+        from .plugins import load_plugins
+
+        self.plugin_names = load_plugins(plugins)
         # Warm the native indexing core off the request path: the first
         # use would otherwise run a synchronous g++ build under the engine
         # write lock.

@@ -1,0 +1,131 @@
+"""Plugin SPI: extension points for analyzers, ingest processors, queries.
+
+The analog of the reference's plugin system (server/src/main/java/org/
+elasticsearch/plugins/ — AnalysisPlugin, IngestPlugin, SearchPlugin),
+reduced to its registration surface: a plugin is a Python module exposing
+
+    def register(registry: PluginRegistry) -> None
+
+which contributes named components. Plugins load at node startup from the
+ESTPU_PLUGINS env var (comma-separated importable module names) or an
+explicit list passed to Node(plugins=[...]). Registered components are
+process-global (the reference's are classpath-global the same way):
+
+- analyzers:   registry.add_analyzer(name, Analyzer) — usable in mappings
+  ("analyzer": name) like any built-in.
+- processors:  registry.add_ingest_processor(name, fn, required=())
+  — fn(doc: dict, opts: dict) -> None mutates the doc in place; usable in
+  ingest pipelines.
+- queries:     registry.add_query(name, parser) — parser(spec: dict) ->
+  Query composes existing DSL nodes, so plugin queries lower through the
+  standard compiler/oracle with zero extra integration.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+from typing import Any, Callable
+
+
+class PluginError(Exception):
+    pass
+
+
+class PluginRegistry:
+    """Registrations STAGE during register() and apply only if the whole
+    plugin registers successfully — a partially failing plugin leaves no
+    residue in the global component tables."""
+
+    def __init__(self) -> None:
+        self.plugins: list[str] = []
+        self._staged: list[Callable[[], None]] = []
+
+    # -- extension points ---------------------------------------------------
+
+    def add_analyzer(self, name: str, analyzer) -> None:
+        def apply() -> None:
+            from .analysis.analyzers import _BUILTIN
+
+            _BUILTIN[name] = analyzer
+
+        self._staged.append(apply)
+
+    def add_ingest_processor(
+        self,
+        name: str,
+        fn: Callable[[dict, dict], None],
+        required: tuple[str, ...] = (),
+    ) -> None:
+        def apply() -> None:
+            from .ingest.pipeline import _PROCESSORS, _REQUIRED
+
+            _PROCESSORS[name] = fn
+            _REQUIRED[name] = tuple(required)
+
+        self._staged.append(apply)
+
+    def add_query(self, name: str, parser: Callable[[dict], Any]) -> None:
+        def apply() -> None:
+            from .query import dsl
+
+            dsl.EXTENSION_QUERIES[name] = parser
+
+        self._staged.append(apply)
+
+    # -- loading ------------------------------------------------------------
+
+    def load(self, module_name: str) -> None:
+        """Import + register one plugin (re-registering overwrites: a
+        reloaded module's latest components win)."""
+        try:
+            module = importlib.import_module(module_name)
+        except ImportError as e:
+            raise PluginError(
+                f"cannot load plugin [{module_name}]: {e}"
+            ) from None
+        register = getattr(module, "register", None)
+        if not callable(register):
+            raise PluginError(
+                f"plugin [{module_name}] does not expose register(registry)"
+            )
+        self._staged = []
+        try:
+            register(self)
+        except PluginError:
+            self._staged = []
+            raise
+        except Exception as e:
+            self._staged = []
+            raise PluginError(
+                f"plugin [{module_name}] failed to register: {e}"
+            ) from None
+        for apply in self._staged:
+            apply()
+        self._staged = []
+        if module_name not in self.plugins:
+            self.plugins.append(module_name)
+
+
+_registry = PluginRegistry()
+
+
+def registry() -> PluginRegistry:
+    return _registry
+
+
+def load_plugins(names: list[str] | None = None) -> list[str]:
+    """Load the given plugin modules plus any named in ESTPU_PLUGINS;
+    returns the names THIS call requested (a node reports only its own
+    plugins, even though registrations are process-global)."""
+    wanted: list[str] = []
+    for name in list(names or []) + [
+        n.strip()
+        for n in os.environ.get("ESTPU_PLUGINS", "").split(",")
+        if n.strip()
+    ]:
+        if name not in wanted:
+            wanted.append(name)
+    for name in wanted:
+        _registry.load(name)
+    return wanted

@@ -229,6 +229,12 @@ def _pop_boost(body: dict) -> float:
     return float(body.get("boost", 1.0))
 
 
+# Plugin-registered query kinds (plugins.PluginRegistry.add_query): parser
+# callables returning compositions of the built-in Query nodes, so they
+# compile/score through the standard pipeline.
+EXTENSION_QUERIES: dict[str, Any] = {}
+
+
 def parse_query(body: dict[str, Any]) -> Query:
     """Parse an Elasticsearch-style query JSON body into a Query tree.
 
@@ -394,6 +400,23 @@ def parse_query(body: dict[str, Any]) -> Query:
             minimum_should_match=int(spec.get("minimum_should_match", -1)),
             boost=_pop_boost(spec),
         )
+    ext = EXTENSION_QUERIES.get(kind)
+    if ext is not None:
+        try:
+            q = ext(spec or {})
+        except ValueError:
+            raise
+        except Exception as e:
+            # A plugin parser crashing on user input is a malformed-query
+            # 400, never an unhandled 500.
+            raise ValueError(
+                f"failed to parse [{kind}] query: {e}"
+            ) from None
+        if not isinstance(q, Query):
+            raise ValueError(
+                f"plugin query [{kind}] must return a Query composition"
+            )
+        return q
     raise ValueError(f"unknown query type [{kind}]")
 
 

@@ -87,6 +87,10 @@ class RestServer:
         r("GET", "/_cluster/health", lambda s, p, q, b: n.cluster_health())
         r("GET", "/_cluster/stats", lambda s, p, q, b: n.cluster_stats())
         r("GET", "/_nodes", lambda s, p, q, b: n.nodes_info())
+        r("GET", "/_cat/plugins", lambda s, p, q, b: [
+            {"name": n.node_name, "component": name}
+            for name in n.plugin_names
+        ])
         r("GET", "/_cat/health", lambda s, p, q, b: n.cat_health())
         r("GET", "/_cat/count", lambda s, p, q, b: n.cat_count())
         r("GET", "/_cat/count/{index}", lambda s, p, q, b: n.cat_count(

@@ -1,0 +1,151 @@
+"""Plugin SPI: analyzers, ingest processors, query types.
+
+Reference: plugins/ (AnalysisPlugin, IngestPlugin, SearchPlugin).
+"""
+
+import sys
+import types
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.plugins import PluginError, registry
+
+
+@pytest.fixture()
+def demo_plugin():
+    """A plugin module registered under a synthetic import name."""
+    mod = types.ModuleType("estpu_demo_plugin")
+
+    def register(reg):
+        from elasticsearch_tpu.analysis.analyzers import (
+            Analyzer,
+            _whitespace_tokenize,
+        )
+
+        def shout_filter(tokens):
+            return [t.upper() for t in tokens]
+
+        reg.add_analyzer(
+            "shout", Analyzer("shout", _whitespace_tokenize, [shout_filter])
+        )
+
+        def reverse_processor(doc, opts):
+            f = opts["field"]
+            if f in doc:
+                doc[f] = str(doc[f])[::-1]
+
+        reg.add_ingest_processor(
+            "reverse", reverse_processor, required=("field",)
+        )
+
+        def everything_but(spec):
+            from elasticsearch_tpu.query.dsl import (
+                BoolQuery,
+                MatchQuery,
+            )
+
+            return BoolQuery(
+                must_not=[MatchQuery(spec["field"], spec["text"])]
+            )
+
+        reg.add_query("everything_but", everything_but)
+
+    mod.register = register
+    sys.modules["estpu_demo_plugin"] = mod
+    yield "estpu_demo_plugin"
+    sys.modules.pop("estpu_demo_plugin", None)
+
+
+def test_plugin_extension_points(demo_plugin):
+    node = Node(plugins=[demo_plugin])
+    assert demo_plugin in node.plugin_names
+
+    # plugin analyzer usable from mappings
+    node.create_index(
+        "p",
+        {
+            "mappings": {
+                "properties": {
+                    "t": {"type": "text", "analyzer": "shout"}
+                }
+            }
+        },
+    )
+    node.index_doc("p", {"t": "hello world"}, "1", refresh=True)
+    r = node.search("p", {"query": {"term": {"t": "HELLO"}}})
+    assert r["hits"]["total"]["value"] == 1
+
+    # plugin ingest processor
+    node.put_pipeline(
+        "rev", {"processors": [{"reverse": {"field": "t"}}]}
+    )
+    node.index_doc("p", {"t": "abc"}, "2", refresh=True, pipeline="rev")
+    assert node.get_doc("p", "2")["_source"]["t"] == "cba"
+
+    # plugin query type composes built-in nodes
+    r = node.search(
+        "p", {"query": {"everything_but": {"field": "t", "text": "HELLO"}}}
+    )
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["2"]
+
+
+def test_plugin_names_are_per_node(demo_plugin):
+    node_with = Node(plugins=[demo_plugin])
+    node_without = Node()
+    assert demo_plugin in node_with.plugin_names
+    assert node_without.plugin_names == []
+
+
+def test_plugin_query_parser_errors_are_400(demo_plugin):
+    from elasticsearch_tpu.node import ApiError
+
+    node = Node(plugins=[demo_plugin])
+    node.create_index("e", {})
+    node.index_doc("e", {"t": "x"}, "1", refresh=True)
+    with pytest.raises(ApiError) as exc:  # KeyError in parser -> 400
+        node.search("e", {"query": {"everything_but": {}}})
+    assert exc.value.status == 400
+
+
+def test_partial_registration_leaves_no_residue():
+    mod = types.ModuleType("estpu_broken_plugin")
+
+    def register(reg):
+        def proc(doc, opts):
+            doc["x"] = 1
+
+        reg.add_ingest_processor("half_registered", proc)
+        raise RuntimeError("boom")
+
+    mod.register = register
+    sys.modules["estpu_broken_plugin"] = mod
+    try:
+        with pytest.raises(PluginError):
+            registry().load("estpu_broken_plugin")
+        from elasticsearch_tpu.ingest.pipeline import _PROCESSORS
+
+        assert "half_registered" not in _PROCESSORS
+    finally:
+        sys.modules.pop("estpu_broken_plugin", None)
+
+
+def test_bad_plugins_fail_loudly():
+    with pytest.raises(PluginError):
+        registry().load("no_such_module_zzz")
+    mod = types.ModuleType("estpu_noreg_plugin")
+    sys.modules["estpu_noreg_plugin"] = mod
+    try:
+        with pytest.raises(PluginError):
+            registry().load("estpu_noreg_plugin")
+    finally:
+        sys.modules.pop("estpu_noreg_plugin", None)
+
+
+def test_cat_plugins_route(demo_plugin):
+    from elasticsearch_tpu.rest.server import RestServer
+
+    rest = RestServer(node=Node(plugins=[demo_plugin]))
+    status, rows = rest.dispatch("GET", "/_cat/plugins", {}, "")
+    assert status == 200
+    assert any(r["component"] == demo_plugin for r in rows)
