@@ -199,13 +199,26 @@ _BUILTIN = {
     )
 }
 
-# "english" = standard tokenizer + lowercase + english stopwords. (The
-# reference additionally applies a possessive and porter stemmer; stemming is
-# intentionally omitted for round 1 to keep query/index analysis symmetric.)
+def _porter_filter(tokens: list[Token]) -> list[Token]:
+    from .porter import stem
+
+    return [stem(t) for t in tokens]
+
+
+# "english" = standard tokenizer + lowercase + stopwords + porter stem —
+# the reference's EnglishAnalyzer chain (analysis-common
+# EnglishAnalyzerProvider). Its possessive filter is unnecessary here:
+# our word-run tokenizer already splits "runner's" at the apostrophe.
+# Index- and query-time chains share the stemmer, so analysis stays
+# symmetric.
 _BUILTIN["english"] = Analyzer(
     "english",
     _standard_tokenize,
-    [lowercase_filter, make_stop_filter(ENGLISH_STOPWORDS)],
+    [
+        lowercase_filter,
+        make_stop_filter(ENGLISH_STOPWORDS),
+        _porter_filter,
+    ],
 )
 
 
@@ -252,6 +265,8 @@ class AnalysisRegistry:
                 filters.append(make_stop_filter(ENGLISH_STOPWORDS))
             elif fname == "asciifolding":
                 filters.append(make_asciifolding_filter())
+            elif fname in ("porter_stem", "stemmer"):
+                filters.append(_porter_filter)
             else:
                 raise ValueError(f"unknown token filter [{fname}]")
         return Analyzer(name, tokenizer, filters)

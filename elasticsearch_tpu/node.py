@@ -282,8 +282,18 @@ class Node:
             params = BM25Params(
                 k1=float(sim.get("k1", 1.2)), b=float(sim.get("b", 0.75))
             )
+        # Custom analyzers from settings.analysis.analyzer (the reference
+        # nests them under settings.index.analysis too).
+        analysis_cfg = (
+            settings.get("analysis")
+            or settings.get("index", {}).get("analysis")
+            or {}
+        )
         try:
-            mappings = Mappings.from_json(mappings_json)
+            from .analysis import AnalysisRegistry
+
+            registry = AnalysisRegistry(analysis_cfg.get("analyzer"))
+            mappings = Mappings.from_json(mappings_json, analysis=registry)
         except ValueError as e:
             raise ApiError(400, "mapper_parsing_exception", str(e)) from None
         durability = (

@@ -1,0 +1,89 @@
+"""Porter stemmer + the full english analyzer chain.
+
+Reference: Lucene PorterStemFilter / EnglishAnalyzer via analysis-common.
+"""
+
+from elasticsearch_tpu.analysis.analyzers import get_analyzer
+from elasticsearch_tpu.analysis.porter import stem
+from elasticsearch_tpu.node import Node
+
+# Canonical (Porter 1980) full-pipeline outputs.
+VECTORS = {
+    "caresses": "caress", "ponies": "poni", "ties": "ti", "cats": "cat",
+    "feed": "feed", "agreed": "agre", "plastered": "plaster", "bled": "bled",
+    "motoring": "motor", "sing": "sing", "conflated": "conflat",
+    "sized": "size", "hopping": "hop", "tanned": "tan", "falling": "fall",
+    "hissing": "hiss", "failing": "fail", "filing": "file", "happy": "happi",
+    "sky": "sky", "relational": "relat", "conditional": "condit",
+    "rational": "ration", "digitizer": "digit", "operator": "oper",
+    "feudalism": "feudal", "decisiveness": "decis", "hopefulness": "hope",
+    "formaliti": "formal", "formative": "form", "formalize": "formal",
+    "electriciti": "electr", "electrical": "electr", "hopeful": "hope",
+    "goodness": "good", "revival": "reviv", "allowance": "allow",
+    "inference": "infer", "airliner": "airlin", "adjustable": "adjust",
+    "defensible": "defens", "irritant": "irrit", "replacement": "replac",
+    "adjustment": "adjust", "dependent": "depend", "adoption": "adopt",
+    "communism": "commun", "activate": "activ", "effective": "effect",
+    "rate": "rate", "cease": "ceas", "roll": "roll",
+    "generalization": "gener", "oscillators": "oscil",
+    "differentli": "differ",
+}
+
+
+def test_canonical_vectors():
+    for word, expected in VECTORS.items():
+        assert stem(word) == expected, (word, stem(word), expected)
+
+
+def test_english_analyzer_chain():
+    a = get_analyzer("english")
+    # stopwords drop, stems apply; the word-run tokenizer splits "runner's"
+    assert a.analyze("The runner's shoes are running quickly") == [
+        "runner", "s", "shoe", "run", "quickli",
+    ]
+
+
+def test_stemmed_search_recall():
+    node = Node()
+    node.create_index(
+        "en",
+        {
+            "mappings": {
+                "properties": {"t": {"type": "text", "analyzer": "english"}}
+            }
+        },
+    )
+    node.index_doc("en", {"t": "the connected engines"}, "1")
+    node.index_doc("en", {"t": "a connection of engineering"}, "2")
+    node.refresh("en")
+    # "connect"/"connection"/"connected" all stem to connect
+    r = node.search("en", {"query": {"match": {"t": "connections"}}})
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1", "2"}
+    # phrase matching works through stems + stopword gaps
+    r = node.search("en", {"query": {"match_phrase": {"t": "connected engine"}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+
+
+def test_custom_analyzer_with_stemmer():
+    node = Node()
+    node.create_index(
+        "cu",
+        {
+            "settings": {
+                "analysis": {
+                    "analyzer": {
+                        "my_stem": {
+                            "tokenizer": "standard",
+                            "filter": ["lowercase", "porter_stem"],
+                        }
+                    }
+                }
+            },
+            "mappings": {
+                "properties": {"t": {"type": "text", "analyzer": "my_stem"}}
+            },
+        },
+    )
+    node.index_doc("cu", {"t": "Jumping Wildly"}, "1", refresh=True)
+    r = node.search("cu", {"query": {"match": {"t": "jumps"}}})
+    assert r["hits"]["total"]["value"] == 1
