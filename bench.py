@@ -22,6 +22,24 @@ with identical ranking is not a ranking error). Any id/order/total
 mismatch, or score beyond 2 ulp, zeroes the headline.
 
 Also reported:
+Headline metric (round 5 on): SINGLE-QUERY p50 — the per-query latency of
+STRICTLY SEQUENTIAL, UNBATCHED execution (ops/bm25_device.
+execute_sequential_sparse: a lax.scan whose iterations are dependency-
+chained so XLA can neither batch nor overlap them), versus the oracle's
+p50. This is the BASELINE north star ("p50 _search latency >=5x"), NOT the
+batch-256-amortized number (still reported as extras). Measured per-query
+sequential latency is what a PCIe-attached serving host observes.
+
+The dev harness reaches the TPU through a network tunnel whose result-
+fetch latency floor is ~70-110 ms regardless of payload size (reported as
+tunnel_roundtrip_floor_ms, measured with a trivial kernel each run).
+single_query_roundtrip_ms — the all-in host-observed latency of one
+unbatched query INCLUDING the tunnel — is therefore floor-bound in this
+environment: roundtrip minus floor is the actual host plan + dispatch +
+compute cost. On production TPU hosts (PCIe/local runtime, fetch latency
+~10 us) the roundtrip converges to single_query_p50_ms plus plan
+construction (~0.2 ms, see plan_build_ms).
+
 - blockmax_per_query_ms: two-launch tile-pruned mode (exact top-10,
   "gte" totals — Lucene block-max WAND semantics). MEASURED CONCLUSION
   (round 4): even with the fully vectorized host prune/re-bucket, the
@@ -204,7 +222,65 @@ def main():
     jax.block_until_ready(outs)
     compute_per_query = (time.monotonic() - t0) / (REPS * N_QUERIES)
 
-    # ---- Single-query round-trip latency ---------------------------------
+    # ---- SINGLE-QUERY p50: strictly sequential, unbatched ----------------
+    # One scan per spec group over pre-staged plan arrays; iterations are
+    # dependency-chained (see execute_sequential_sparse) so per-query time
+    # is true unbatched latency, not batch amortization. Parity: outputs
+    # must be bit-identical to the per-query kernel results above.
+    seq_outs = [
+        bm25_device.execute_sequential_sparse(seg_tree, spec_g, arrays_b, K)
+        for spec_g, arrays_b in staged
+    ]
+    jax.block_until_ready(seq_outs)
+    seq_mismatches = 0
+    for (spec_g, _), out, positions in zip(
+        staged, seq_outs, [groups[s] for s, _ in staged]
+    ):
+        s_h, i_h, t_h = jax.device_get(out)
+        for row, p in enumerate(positions):
+            if (
+                list(i_h[row]) != list(d_ids[p])
+                or not np.array_equal(s_h[row], d_scores[p])
+                or int(t_h[row]) != int(d_totals[p])
+            ):
+                seq_mismatches += 1
+    # Per-query latency: each query is assigned its shape GROUP's measured
+    # sequential per-query time (queries in a group share worklist shape =
+    # device work), then the p50 is the median over all 256 queries — an
+    # honest per-query distribution rather than a run-total mean.
+    per_query_s = np.empty(N_QUERIES)
+    for spec_g, arrays_b in staged:
+        positions = groups[spec_g]
+        rep_times = []
+        for _ in range(REPS):
+            t0 = time.monotonic()
+            jax.block_until_ready(
+                bm25_device.execute_sequential_sparse(
+                    seg_tree, spec_g, arrays_b, K
+                )
+            )
+            rep_times.append(time.monotonic() - t0)
+        per_query_s[positions] = float(np.median(rep_times)) / len(positions)
+    single_p50 = float(np.median(per_query_s))
+
+    # ---- Tunnel result-fetch latency floor (trivial kernel) --------------
+    ping = jax.jit(lambda a, s: (a + s)[:2])
+    px = jax.device_put(np.zeros(128, np.int32))
+    jax.block_until_ready(ping(px, 0))
+    floor = []
+    for i in range(5):
+        t0 = time.monotonic()
+        np.asarray(ping(px, i + 1))
+        floor.append(time.monotonic() - t0)
+    tunnel_floor_ms = float(np.median(floor)) * 1e3
+
+    # ---- Host plan-construction cost (parse + compile, per query) --------
+    t0 = time.monotonic()
+    for q in parsed[:64]:
+        compiler.compile(q)
+    plan_build_ms = (time.monotonic() - t0) / 64 * 1e3
+
+    # ---- Single-query all-in round trip through the tunnel ---------------
     c0 = compiled[0]
     sq = []
     for _ in range(3):
@@ -216,17 +292,26 @@ def main():
     single_query_ms = float(np.median(sq)) * 1e3
 
     o_p50 = float(np.median(oracle_times))
-    speedup = (o_p50 / device_per_query) if device_per_query > 0 else 0.0
-    if mismatches:
-        speedup = 0.0
+    speedup_batched = (
+        (o_p50 / device_per_query) if device_per_query > 0 else 0.0
+    )
+    speedup_single = (o_p50 / single_p50) if single_p50 > 0 else 0.0
+    if mismatches or seq_mismatches:
+        speedup_batched = 0.0
+        speedup_single = 0.0
 
     print(
         json.dumps(
             {
-                "metric": "bm25_disjunction_per_query_speedup_vs_cpu_oracle",
-                "value": round(speedup, 2),
+                "metric": "bm25_single_query_p50_speedup_vs_cpu_oracle",
+                "value": round(speedup_single, 2),
                 "unit": "x",
-                "vs_baseline": round(speedup, 2),
+                "vs_baseline": round(speedup_single, 2),
+                "single_query_p50_ms": round(single_p50 * 1e3, 4),
+                "sequential_mismatches": seq_mismatches,
+                "batched_speedup_vs_oracle": round(speedup_batched, 2),
+                "tunnel_roundtrip_floor_ms": round(tunnel_floor_ms, 1),
+                "plan_build_ms": round(plan_build_ms, 3),
                 "n_docs": N_DOCS,
                 "batch_size": N_QUERIES,
                 "device_per_query_ms": round(device_per_query * 1e3, 4),

@@ -472,6 +472,36 @@ def execute_batch_sparse(seg, spec, arrays_batched, k: int):
 
 
 @partial(jax.jit, static_argnames=("spec", "k"))
+def execute_sequential_sparse(seg, spec, arrays_batched, k: int):
+    """Run Q same-spec queries STRICTLY one after another (latency bench).
+
+    `execute_batch_sparse` vmaps Q queries into one fused program — the
+    right serving mode, but its per-query time is batch-amortized and so
+    cannot honestly answer "what is the p50 latency of a single _search?"
+    (the BASELINE north-star metric). This kernel scans over the Q queries
+    instead: `lax.scan` lowers to a sequential XLA while-loop, and each
+    iteration's plan additionally depends on the previous iteration's
+    result (the carried total-hits count feeds a `* 0.0` perturbation of
+    the weights behind an `optimization_barrier`, which XLA cannot fold —
+    `x * 0 → 0` is not a valid fp rewrite for a possibly-non-finite x).
+    Iterations therefore cannot overlap or batch; wall time / Q is the
+    true unbatched per-query device latency a PCIe-attached host observes.
+    The carry is the (always finite) hit count, so the perturbation is
+    exactly +0.0 and results stay bit-identical to the per-query kernel.
+    """
+
+    def step(carry, arrays):
+        eps = jax.lax.optimization_barrier(carry) * jnp.float32(0.0)
+        arrays = dict(arrays)
+        arrays["weights"] = arrays["weights"] + eps
+        s, i, t = _sparse_inner(seg, spec, arrays, k)
+        return t.astype(jnp.float32), (s, i, t)
+
+    _, out = jax.lax.scan(step, jnp.float32(0.0), arrays_batched)
+    return out
+
+
+@partial(jax.jit, static_argnames=("spec", "k"))
 def execute(seg, spec, arrays, k: int):
     """Run a compiled query plan over one device segment.
 

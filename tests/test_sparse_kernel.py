@@ -183,3 +183,36 @@ class TestBlockmax:
         o_s, o_i = _oracle(seg, terms, 10)
         assert list(i[0][: len(o_i)]) == list(o_i)
         assert np.array_equal(s[0][: len(o_i)], o_s)
+
+
+class TestSequentialKernel:
+    """execute_sequential_sparse must be bit-identical to the per-query
+    kernel — the latency bench's parity contract (bench.py)."""
+
+    def test_sequential_matches_per_query(self, corpus):
+        mappings, seg, dev, compiler, seg_tree = corpus
+        import jax
+
+        rng = np.random.default_rng(7)
+        queries = [
+            compiler.compile(MatchQuery("body", " ".join(t)))
+            for t in pick_query_terms(seg, rng, 6, terms_per_query=3)
+        ]
+        spec = queries[0].spec
+        same_spec = [c for c in queries if c.spec == spec]
+        assert len(same_spec) >= 2
+        stacked = jax.tree.map(
+            lambda *xs: np.stack(xs), *[c.arrays for c in same_spec]
+        )
+        s_b, i_b, t_b = map(
+            np.asarray,
+            bm25_device.execute_sequential_sparse(seg_tree, spec, stacked, 10),
+        )
+        for row, c in enumerate(same_spec):
+            s1, i1, t1 = map(
+                np.asarray,
+                bm25_device.execute_sparse(seg_tree, c.spec, c.arrays, 10),
+            )
+            assert np.array_equal(s_b[row], s1)
+            assert np.array_equal(i_b[row], i1)
+            assert int(t_b[row]) == int(t1)
