@@ -52,6 +52,11 @@ class RequestCache:
             while len(self._entries) > self.max_entries:
                 self._entries.popitem(last=False)
 
+    def clear(self) -> None:
+        """Drop every cached entry (the `_cache/clear` API analog)."""
+        with self._lock:
+            self._entries.clear()
+
     def stats(self) -> dict:
         with self._lock:
             return {

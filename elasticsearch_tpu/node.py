@@ -340,6 +340,9 @@ class Node:
             search = SearchService(engines[0], name)
         else:
             search = ShardedSearchCoordinator(engines, name)
+            from .parallel.mesh_serving import maybe_mesh_view
+
+            search.mesh_view = maybe_mesh_view(engines, mappings, params)
         svc = IndexService(
             name=name,
             mappings=mappings,

@@ -72,6 +72,57 @@ def _empty_field(name: str, num_docs: int, has_norms: bool) -> FieldIndex:
     )
 
 
+def union_schema(
+    segments: list[Segment],
+) -> tuple[dict[str, bool], set[str], dict[str, int]]:
+    """Cross-shard union of (field -> has_norms, doc-value names,
+    vector field -> dim) — the single definition of the uniform-schema
+    invariant every stacked mesh pytree relies on."""
+    fields: dict[str, bool] = {}
+    dv: set[str] = set()
+    vec: dict[str, int] = {}
+    for seg in segments:
+        for name, fld in seg.fields.items():
+            fields[name] = fld.has_norms
+        dv.update(seg.doc_values)
+        for name, mat in seg.vectors.items():
+            vec[name] = mat.shape[1]
+    return fields, dv, vec
+
+
+def fill_union_schema(
+    seg: Segment,
+    fields: dict[str, bool],
+    dv: set[str],
+    vec: dict[str, int],
+) -> Segment:
+    """Shallow-copied segment carrying the cross-shard union schema
+    (missing fields empty, doc-value columns NaN, vector columns zero) so
+    every shard's packed pytree has identical structure.
+
+    Returns a COPY with fresh dicts — never mutates `seg`, which callers
+    (the mesh serving view, mesh_snapshot) may share with still-serving
+    snapshots on other threads.
+    """
+    from dataclasses import replace as dc_replace
+
+    new_fields = dict(seg.fields)
+    for name, has_norms in fields.items():
+        if name not in new_fields:
+            new_fields[name] = _empty_field(name, seg.num_docs, has_norms)
+    new_dv = dict(seg.doc_values)
+    for name in dv:
+        if name not in new_dv:
+            new_dv[name] = np.full(seg.num_docs, np.nan)
+    new_vec = dict(seg.vectors)
+    for name, dim in vec.items():
+        if name not in new_vec:
+            new_vec[name] = np.zeros((seg.num_docs, dim), dtype=np.float32)
+    return dc_replace(
+        seg, fields=new_fields, doc_values=new_dv, vectors=new_vec
+    )
+
+
 @dataclass
 class ShardedIndex:
     """N shards stacked on a leading mesh axis, searchable as one program."""
@@ -130,12 +181,7 @@ class ShardedIndex:
                 f"{len(segments)} segments for a {n_shards}-shard mesh axis"
             )
         # Uniform schema: every shard carries the union of fields/columns.
-        all_fields: dict[str, bool] = {}
-        all_dv: set[str] = set()
-        for seg in segments:
-            for name, fld in seg.fields.items():
-                all_fields[name] = fld.has_norms
-            all_dv.update(seg.doc_values)
+        all_fields, all_dv, all_vec = union_schema(segments)
         n_pad = max((s.num_docs for s in segments), default=0)
         n_pad = max(n_pad, 1)
         min_tiles: dict[str, int] = {}
@@ -160,13 +206,11 @@ class ShardedIndex:
         global_stats = aggregate_field_stats(segments)
         global_avgdl = {name: s.avgdl for name, s in global_stats.items()}
         trees = []
+        segments = [
+            fill_union_schema(seg, all_fields, all_dv, all_vec)
+            for seg in segments
+        ]
         for seg in segments:
-            for name, has_norms in all_fields.items():
-                if name not in seg.fields:
-                    seg.fields[name] = _empty_field(name, seg.num_docs, has_norms)
-            for name in all_dv:
-                if name not in seg.doc_values:
-                    seg.doc_values[name] = np.full(seg.num_docs, np.nan)
             dev = pack_segment(
                 seg,
                 pad_docs_to=n_pad,
@@ -204,6 +248,18 @@ class ShardedIndex:
             self._stats_cache = aggregate_field_stats(self.segments)
         return self._stats_cache
 
+    def _tn_avgdl(self, shard: int, field: str, fstats) -> float:
+        """Statistics scope the packed tn (impact) planes are valid for.
+
+        The base class packs at build time with the same aggregated stats
+        `compile` scores with, so the fast precomputed-impact kernel always
+        applies. `MeshIndex` (parallel/mesh_serving.py) overrides this with
+        the per-shard PACK-TIME avgdl so the compiler falls back to the
+        norm-cache gather kernel whenever statistics have drifted since the
+        shard was last uploaded — stale tn planes are then simply unused.
+        """
+        return float(fstats.avgdl) if fstats else 1.0
+
     def compile(self, query: Query, nt_floor: int = 1) -> CompiledQuery:
         """Compile per shard with uniform buckets; stack arrays on axis 0."""
         stats = self.field_stats()
@@ -224,9 +280,11 @@ class ShardedIndex:
                     sum_total_tf=fld.sum_total_tf,
                     has_norms=fld.has_norms,
                     num_tiles_=max(nt, 0),
-                    # Impacts were packed with global stats + index params,
-                    # so the fast (precomputed-impact) kernel applies.
-                    tn_avgdl=float(fstats.avgdl) if fstats else 1.0,
+                    # Impacts validity scope: see _tn_avgdl. When it matches
+                    # the stats avgdl the fast (precomputed-impact) kernel
+                    # applies; otherwise the gather kernel recomputes
+                    # impacts from tf + norm bytes with the current stats.
+                    tn_avgdl=self._tn_avgdl(shard, name, fstats),
                     tn_k1=self.params.k1,
                     tn_b=self.params.b,
                     pos_offsets=fld.pos_offsets,

@@ -88,6 +88,11 @@ class ShardedSearchCoordinator:
         ]
         self._stats_cache = None
         self._stats_gen: tuple = ()
+        # SPMD serving path (parallel/mesh_serving.MeshView), set by the
+        # node when the local device mesh can hold one shard per device.
+        # When present, eligible requests execute as ONE shard_map program
+        # (collective reduce over ICI) instead of the host-side shard loop.
+        self.mesh_view = None
 
     def global_stats(self, snapshots: list[list] | None = None):
         """Index-wide statistics across all shards' segments, cached per
@@ -106,6 +111,10 @@ class ShardedSearchCoordinator:
     def search(self, request: SearchRequest, task=None) -> SearchResponse:
         import time
 
+        if self.mesh_view is not None:
+            resp = self.mesh_view.serve(self, request, task)
+            if resp is not None:
+                return resp
         start = time.monotonic()
         # One segment snapshot per shard, pinned for the whole request —
         # the agg pass and every shard's hits pass must see the same view

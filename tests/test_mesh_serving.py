@@ -1,0 +1,283 @@
+"""SPMD mesh serving as THE production `_search` path (VERDICT r4 item 1).
+
+A multi-shard index on a sufficient device mesh must serve eligible REST
+searches through ONE shard_map program (`parallel/mesh_serving.MeshView` →
+`sharded_execute`), asserted via the `served` hook, with results IDENTICAL
+to the host-loop coordinator across the query-DSL matrix; refresh must be
+incremental (only changed shards re-uploaded).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.rest.server import RestServer
+
+WORDS = ["ant", "bee", "cat", "dog", "elk", "fox", "gnu", "hen"]
+
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "rank": {"type": "long"},
+    }
+}
+
+
+@pytest.fixture(scope="module")
+def rest():
+    rest = RestServer()
+    status, _ = rest.dispatch(
+        "PUT",
+        "/mesh",
+        {},
+        json.dumps(
+            {
+                "settings": {"index": {"number_of_shards": 8}},
+                "mappings": MAPPINGS,
+            }
+        ),
+    )
+    assert status == 200
+    rng = np.random.default_rng(17)
+    lines = []
+    for i in range(160):
+        lines.append(json.dumps({"index": {"_id": f"d{i}"}}))
+        lines.append(
+            json.dumps(
+                {
+                    "body": " ".join(rng.choice(WORDS, rng.integers(2, 9))),
+                    "tag": str(rng.choice(["x", "y", "z"])),
+                    "rank": int(rng.integers(0, 500)),
+                }
+            )
+        )
+    status, resp = rest.dispatch(
+        "POST", "/mesh/_bulk", {"refresh": "true"}, "\n".join(lines)
+    )
+    assert status == 200 and not resp["errors"]
+    return rest
+
+
+def mesh_view(rest):
+    mv = rest.node.get_index("mesh").search.mesh_view
+    assert mv is not None, "8-device CPU mesh should enable SPMD serving"
+    return mv
+
+
+def both_paths(rest, body: dict) -> tuple[dict, dict, bool]:
+    """(mesh response, host-loop response, mesh_used) for one request."""
+    svc = rest.node.get_index("mesh")
+    mv = mesh_view(rest)
+    before = mv.served
+    status, via_mesh = rest.dispatch(
+        "POST", "/mesh/_search", {}, json.dumps(body)
+    )
+    assert status == 200, via_mesh
+    used = mv.served > before
+    svc.search.mesh_view = None
+    # The node's request cache would otherwise replay the mesh answer.
+    rest.node.request_cache.clear()
+    try:
+        status, via_host = rest.dispatch(
+            "POST", "/mesh/_search", {}, json.dumps(body)
+        )
+    finally:
+        svc.search.mesh_view = mv
+        rest.node.request_cache.clear()
+    assert status == 200, via_host
+    return via_mesh, via_host, used
+
+
+DSL_MATRIX = [
+    {"query": {"match": {"body": "bee cat"}}, "size": 12},
+    {"query": {"match": {"body": "ant bee cat dog"}}, "size": 30},
+    {"query": {"term": {"tag": "x"}}, "size": 10},
+    {
+        "query": {
+            "bool": {
+                "must": [{"match": {"body": "ant"}}],
+                "filter": [{"term": {"tag": "x"}}],
+            }
+        }
+    },
+    {
+        "query": {
+            "bool": {
+                "should": [
+                    {"match": {"body": "fox"}},
+                    {"match": {"body": "hen"}},
+                ],
+                "must_not": [{"term": {"tag": "z"}}],
+            }
+        }
+    },
+    {"query": {"range": {"rank": {"gte": 100, "lte": 400}}}, "size": 10},
+    {"query": {"exists": {"field": "rank"}}, "size": 5},
+    {"query": {"match_phrase": {"body": "bee cat"}}, "size": 5},
+    {
+        "query": {
+            "dis_max": {
+                "queries": [
+                    {"match": {"body": "fox"}},
+                    {"match": {"body": "hen"}},
+                ],
+                "tie_breaker": 0.3,
+            }
+        }
+    },
+    {
+        "query": {
+            "constant_score": {
+                "filter": {"term": {"tag": "y"}},
+                "boost": 2.5,
+            }
+        }
+    },
+    {"query": {"ids": {"values": ["d3", "d7", "d11"]}}},
+    {"query": {"match_all": {}}, "from": 5, "size": 7},
+    {"query": {"match": {"body": "bee"}}, "track_total_hits": 3},
+    {"query": {"match": {"body": "bee"}}, "track_total_hits": False},
+    {
+        "query": {"match": {"body": "bee cat"}},
+        "highlight": {"fields": {"body": {}}},
+        "fields": ["tag"],
+        "docvalue_fields": ["rank"],
+    },
+    {"query": {"match": {"body": "nosuchterm"}}},
+]
+
+
+@pytest.mark.parametrize("body", DSL_MATRIX)
+def test_dsl_matrix_identical_and_mesh_used(rest, body):
+    via_mesh, via_host, used = both_paths(rest, body)
+    assert used, f"mesh path not used for {body}"
+    for key in ("hits",):
+        m, h = via_mesh[key], via_host[key]
+        assert m.get("total") == h.get("total")
+        assert m["max_score"] == h["max_score"]
+        assert [x["_id"] for x in m["hits"]] == [x["_id"] for x in h["hits"]]
+        assert [x["_score"] for x in m["hits"]] == [
+            x["_score"] for x in h["hits"]
+        ]
+        for mh, hh in zip(m["hits"], h["hits"]):
+            assert mh.get("_source") == hh.get("_source")
+            assert mh.get("highlight") == hh.get("highlight")
+            assert mh.get("fields") == hh.get("fields")
+    assert via_mesh["_shards"]["total"] == 8
+
+
+def test_ineligible_shapes_fall_back(rest):
+    mv = mesh_view(rest)
+    for body in [
+        {"query": {"match_all": {}}, "sort": [{"rank": "desc"}]},
+        {
+            "query": {"match": {"body": "bee"}},
+            "aggs": {"tags": {"terms": {"field": "tag"}}},
+        },
+        {"query": {"match_all": {}}, "size": 0},
+        {
+            "query": {"match": {"body": "bee"}},
+            "rescore": {
+                "window_size": 5,
+                "query": {"rescore_query": {"match": {"body": "cat"}}},
+            },
+        },
+    ]:
+        before = mv.served
+        status, resp = rest.dispatch(
+            "POST", "/mesh/_search", {}, json.dumps(body)
+        )
+        assert status == 200, resp
+        assert mv.served == before, f"mesh should not serve {body}"
+
+
+def test_incremental_refresh_single_shard(rest):
+    mv = mesh_view(rest)
+    rest.dispatch(
+        "POST", "/mesh/_search", {}, json.dumps({"query": {"match_all": {}}})
+    )
+    packs0, rebuilds0 = mv.packs, mv.rebuilds
+    # One doc update touches exactly one shard.
+    status, _ = rest.dispatch(
+        "PUT",
+        "/mesh/_doc/d9",
+        {"refresh": "true"},
+        json.dumps({"body": "zebra ant", "tag": "x", "rank": 1}),
+    )
+    assert status in (200, 201)
+    via_mesh, via_host, used = both_paths(
+        rest, {"query": {"match": {"body": "zebra"}}}
+    )
+    assert used
+    assert [h["_id"] for h in via_mesh["hits"]["hits"]] == ["d9"]
+    assert via_mesh["hits"]["hits"] == via_host["hits"]["hits"]
+    assert mv.rebuilds == rebuilds0
+    assert mv.packs - packs0 == 1, "only the changed shard re-uploads"
+
+
+def test_delete_visibility_and_totals(rest):
+    mv = mesh_view(rest)
+    status, resp = rest.dispatch(
+        "DELETE", "/mesh/_doc/d9", {"refresh": "true"}, None
+    )
+    assert status == 200
+    packs0 = mv.packs
+    via_mesh, via_host, used = both_paths(
+        rest, {"query": {"match": {"body": "zebra"}}}
+    )
+    assert used
+    assert via_mesh["hits"]["total"]["value"] == 0
+    assert via_host["hits"]["total"]["value"] == 0
+    assert mv.packs - packs0 == 1
+
+
+def test_growth_triggers_full_rebuild_then_parity(rest):
+    mv = mesh_view(rest)
+    rest.dispatch(
+        "POST", "/mesh/_search", {}, json.dumps({"query": {"match_all": {}}})
+    )
+    docs_pad0 = mv._shapes["docs"]
+    # Enough docs to overflow the per-shard doc padding on some shard.
+    lines = []
+    for i in range(1000, 1000 + docs_pad0 * 8 + 50):
+        lines.append(json.dumps({"index": {"_id": f"g{i}"}}))
+        lines.append(
+            json.dumps({"body": "grow bee", "tag": "x", "rank": i})
+        )
+    status, resp = rest.dispatch(
+        "POST", "/mesh/_bulk", {"refresh": "true"}, "\n".join(lines)
+    )
+    assert status == 200 and not resp["errors"]
+    rebuilds0 = mv.rebuilds
+    via_mesh, via_host, used = both_paths(
+        rest, {"query": {"match": {"body": "grow"}}, "size": 25}
+    )
+    assert used
+    assert mv.rebuilds == rebuilds0 + 1
+    assert mv._shapes["docs"] > docs_pad0
+    assert [h["_id"] for h in via_mesh["hits"]["hits"]] == [
+        h["_id"] for h in via_host["hits"]["hits"]
+    ]
+    assert [h["_score"] for h in via_mesh["hits"]["hits"]] == [
+        h["_score"] for h in via_host["hits"]["hits"]
+    ]
+
+
+def test_msearch_through_mesh(rest):
+    mv = mesh_view(rest)
+    before = mv.served
+    payload = "\n".join(
+        [
+            json.dumps({}),
+            json.dumps({"query": {"match": {"body": "bee"}}}),
+            json.dumps({}),
+            json.dumps({"query": {"term": {"tag": "y"}}}),
+        ]
+    )
+    status, resp = rest.dispatch("POST", "/mesh/_msearch", {}, payload)
+    assert status == 200
+    assert len(resp["responses"]) == 2
+    assert all(r["_shards"]["total"] == 8 for r in resp["responses"])
+    assert mv.served >= before + 2
