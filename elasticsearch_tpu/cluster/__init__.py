@@ -1,0 +1,29 @@
+from .cluster import (
+    ClusterNode,
+    LocalCluster,
+    NoShardAvailableError,
+    NotMasterError,
+    ReplicationFailedError,
+    StalePrimaryTermError,
+)
+from .state import ClusterState, IndexMeta, ShardRouting
+from .transport import (
+    ConnectTransportError,
+    RemoteActionError,
+    TransportHub,
+)
+
+__all__ = [
+    "ClusterNode",
+    "ClusterState",
+    "ConnectTransportError",
+    "IndexMeta",
+    "LocalCluster",
+    "NoShardAvailableError",
+    "NotMasterError",
+    "RemoteActionError",
+    "ReplicationFailedError",
+    "ShardRouting",
+    "StalePrimaryTermError",
+    "TransportHub",
+]

@@ -1,0 +1,1040 @@
+"""Host cluster layer: membership, primaries/replicas, failover, recovery.
+
+The control plane the reference spreads over cluster/coordination
+(Coordinator.java:87 — elections, quorum publication), action/support/
+replication (ReplicationOperation.java:111 — primary→replica write
+fan-out), index/seqno (ReplicationTracker.java:68 — in-sync sets and
+checkpoints), and indices/recovery (RecoverySourceHandler.java:94 —
+ops-based peer recovery). On TPU pods the *data* plane (search) stays
+in-program over ICI (parallel/sharded.py, mesh_serving.py); this module is
+the *host* plane: which host owns which shard copy, how writes reach every
+in-sync copy before acking, and how copies fail over and catch up.
+
+Simplifications vs the reference, chosen to keep the safety story intact:
+
+- Election: the candidate is the lowest node id among reachable seeds; it
+  must win votes from a QUORUM of the seed configuration for a bumped
+  term. (The reference adds randomized pre-voting to reduce churn; the
+  quorum + term rules — the safety part — are the same.)
+- Publication is synchronous best-effort; the master steps down when it
+  cannot reach a quorum, and every state-mutating master action requires
+  a quorum-acked publication before the caller proceeds.
+- Acknowledged-write safety is the reference's exact invariant chain:
+  a write acks only after every in-sync copy applied it; only in-sync
+  copies are promotable; a replica rejects ops from a stale primary term;
+  failing a copy out of the in-sync set requires a quorum-published
+  state change. Therefore a promoted primary has every acknowledged op.
+- Health checking is a master-driven ping round (`LocalCluster.step`),
+  deterministic for tests; a background stepper thread makes it live.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from ..index.engine import Engine, VersionConflictError
+from ..index.mapping import Mappings
+from ..index.seqno import ReplicationTracker
+from ..parallel.routing import shard_for_id
+from .state import ClusterState, IndexMeta, ShardRouting
+from .transport import ConnectTransportError, RemoteActionError, TransportHub
+
+
+class NoShardAvailableError(Exception):
+    pass
+
+
+class NotMasterError(Exception):
+    pass
+
+
+class StalePrimaryTermError(Exception):
+    pass
+
+
+class ReplicationFailedError(Exception):
+    pass
+
+
+class ClusterNode:
+    """One host: engines for its assigned shard copies + cluster duties."""
+
+    def __init__(self, node_id: str, hub: TransportHub, seeds: tuple[str, ...]):
+        self.node_id = node_id
+        self.hub = hub
+        self.state = ClusterState(seed_nodes=seeds)
+        self.current_term = 0  # highest term voted for / seen
+        self.engines: dict[tuple[str, int], Engine] = {}
+        self.trackers: dict[tuple[str, int], ReplicationTracker] = {}
+        self.lock = threading.RLock()
+        # Serializes every master-side copy→mutate→publish sequence: the
+        # stepper's health_round racing a request thread's fail_shard would
+        # otherwise publish colliding versions, demoting a healthy master.
+        self.master_lock = threading.RLock()
+        # Shards this node was just promoted for: their replicas must be
+        # reset to the new primary's ops line (the reference's primary-
+        # replica resync, TransportResyncReplicationAction) before the old
+        # term's never-acknowledged divergent ops could surface.
+        self._pending_term_resync: set[tuple[str, int]] = set()
+        self.closed = False
+        # Incarnation id: a restarted process answers pings with a new
+        # session, which the master compares against the PUBLISHED session
+        # map (state.node_sessions) to detect "same node id, fresh (empty)
+        # copies" and strip their stale in-sync memberships — the
+        # in-memory stand-in for the reference's per-copy allocation ids.
+        # Because the map rides in the committed state, a new master
+        # inherits it and recognizes even its OWN restart.
+        import uuid
+
+        self.session = uuid.uuid4().hex
+        hub.register(node_id, self._handle)
+
+    # ------------------------------------------------------------ identity
+
+    def is_master(self) -> bool:
+        return self.state.master == self.node_id
+
+    def close(self) -> None:
+        self.closed = True
+        self.hub.unregister(self.node_id)
+
+    # ------------------------------------------------------------- handler
+
+    def _handle(self, from_id: str, action: str, payload: dict):
+        if self.closed:
+            raise ConnectTransportError(f"[{self.node_id}] closed")
+        fn = getattr(self, f"_on_{action}", None)
+        if fn is None:
+            raise ValueError(f"unknown transport action [{action}]")
+        return fn(from_id, payload)
+
+    def _on_ping(self, from_id: str, payload: dict):
+        return {
+            "node": self.node_id,
+            "term": self.current_term,
+            "session": self.session,
+        }
+
+    def _on_request_vote(self, from_id: str, payload: dict):
+        """Grant iff the term is new AND the candidate's accepted state is
+        at least as fresh as ours — a stale (e.g. freshly restarted)
+        candidate must never win and publish backlevel state over the
+        cluster (CoordinationState.isElectionQuorum's safety rule)."""
+        with self.lock:
+            term = int(payload["term"])
+            cand = (
+                int(payload.get("state_term", -1)),
+                int(payload.get("state_version", -1)),
+            )
+            if term > self.current_term and cand >= (
+                self.state.term,
+                self.state.version,
+            ):
+                self.current_term = term
+                return {"granted": True}
+            return {"granted": False}
+
+    def _on_get_state(self, from_id: str, payload: dict):
+        return {"state": self.state.to_json()}
+
+    def _on_publish_state(self, from_id: str, payload: dict):
+        new = ClusterState.from_json(payload["state"])
+        with self.lock:
+            if not new.newer_than(self.state):
+                return {"accepted": False}
+            self.current_term = max(self.current_term, new.term)
+            self.state = new
+            self._apply_assignments()
+            return {"accepted": True}
+
+    # ------------------------------------------------- assignment handling
+
+    def _apply_assignments(self) -> None:
+        """Create engines for newly assigned copies; adopt primary terms.
+        Caller holds self.lock."""
+        for index, meta in self.state.indices.items():
+            mappings = Mappings.from_json(meta.mappings)
+            for shard_id, routing in meta.shards.items():
+                key = (index, shard_id)
+                involved = (
+                    self.node_id in routing.assigned()
+                    or self.node_id in routing.recovering
+                )
+                if involved and key not in self.engines:
+                    self.engines[key] = Engine(mappings)
+                if routing.primary == self.node_id:
+                    engine = self.engines[key]
+                    if engine.primary_term != routing.primary_term:
+                        # Promotion: the translog/ops line this copy holds
+                        # is authoritative from here on (it is in-sync, so
+                        # it has every acknowledged op). Surviving replicas
+                        # may hold the OLD primary's never-acked ops — they
+                        # get reset to this line (term resync) next step.
+                        engine.primary_term = routing.primary_term
+                        engine.refresh()
+                        if routing.primary_term > 1:
+                            self._pending_term_resync.add(key)
+                    self.trackers.setdefault(key, ReplicationTracker())
+                    tracker = self.trackers[key]
+                    for node in routing.in_sync:
+                        tracker.mark_in_sync(node)
+                    # Reconcile: copies failed out of the published set must
+                    # leave the tracker or they pin the global checkpoint.
+                    tracker.retain(set(routing.in_sync))
+
+    def check_term_resyncs(self) -> None:
+        """New-primary duty: reset every replica to this copy's ops line.
+
+        A replica that followed the OLD primary may hold ops that were
+        never acknowledged (fan-out died with the primary); seqno-wins
+        application alone cannot purge them. Until this completes, such a
+        phantom op is only visible via that replica — the same window the
+        reference closes with its post-promotion primary-replica resync.
+        """
+        for key in list(self._pending_term_resync):
+            index, shard_id = key
+            try:
+                routing = self._routing(index, shard_id)
+            except (NoShardAvailableError, KeyError):
+                self._pending_term_resync.discard(key)
+                continue
+            if routing.primary != self.node_id:
+                self._pending_term_resync.discard(key)
+                continue
+            engine = self.engines[key]
+            with engine.lock:  # freeze the ops line during the handoff
+                payload = engine.resync_payload()
+                ok = True
+                for node in routing.replicas:
+                    if node == self.node_id:
+                        continue
+                    try:
+                        self.hub.send(
+                            self.node_id,
+                            node,
+                            "recovery_resync",
+                            {
+                                "index": index,
+                                "shard": shard_id,
+                                "payload": payload,
+                                "term": routing.primary_term,
+                            },
+                        )
+                    except (ConnectTransportError, RemoteActionError):
+                        ok = False  # retried next step
+                if ok:
+                    self._pending_term_resync.discard(key)
+
+    def check_recoveries(self) -> None:
+        """Start peer recovery for copies this node should be acquiring."""
+        self.check_term_resyncs()
+        with self.lock:
+            todo = []
+            for index, meta in self.state.indices.items():
+                for shard_id, routing in meta.shards.items():
+                    if (
+                        self.node_id in routing.recovering
+                        and routing.primary is not None
+                    ):
+                        todo.append((index, shard_id, routing.primary))
+        for index, shard_id, primary in todo:
+            try:
+                self._recover_from(index, shard_id, primary)
+            except (ConnectTransportError, RemoteActionError):
+                pass  # retried on the next step
+
+    def _recover_from(self, index: str, shard_id: int, primary: str) -> None:
+        """Replica-side peer recovery: ops-based catch-up, else full copy.
+        The primary finalizes under its engine lock and reports us in-sync
+        to the master (RecoverySourceHandler.finalizeRecovery analog)."""
+        engine = self.engines.get((index, shard_id))
+        if engine is None:
+            with self.lock:
+                meta = self.state.indices[index]
+                engine = Engine(Mappings.from_json(meta.mappings))
+                self.engines[(index, shard_id)] = engine
+        self.hub.send(
+            self.node_id,
+            primary,
+            "start_recovery",
+            {
+                "index": index,
+                "shard": shard_id,
+                "node": self.node_id,
+                "local_checkpoint": engine.local_checkpoint,
+                "max_op_term": engine.max_op_term,
+            },
+        )
+
+    # --------------------------------------------------- primary-side ops
+
+    def _routing(self, index: str, shard_id: int) -> ShardRouting:
+        meta = self.state.indices.get(index)
+        if meta is None:
+            raise NoShardAvailableError(f"no such index [{index}]")
+        return meta.shards[shard_id]
+
+    def _on_primary_op(self, from_id: str, payload: dict):
+        return self.execute_write(
+            payload["index"],
+            payload["id"],
+            payload.get("source"),
+            op=payload["op"],
+            op_type=payload.get("op_type", "index"),
+        )
+
+    def execute_write(
+        self,
+        index: str,
+        doc_id: str,
+        source: dict | None,
+        op: str = "index",
+        op_type: str = "index",
+    ) -> dict:
+        """Client write entry on ANY node: route to the primary, execute,
+        fan out to in-sync copies, ack only when all of them applied
+        (ReplicationOperation.java:111 semantics)."""
+        meta = self.state.indices.get(index)
+        if meta is None:
+            raise NoShardAvailableError(f"no such index [{index}]")
+        shard_id = shard_for_id(doc_id, meta.n_shards)
+        routing = self._routing(index, shard_id)
+        if routing.primary is None:
+            raise NoShardAvailableError(
+                f"[{index}][{shard_id}] has no promotable copy"
+            )
+        if routing.primary != self.node_id:
+            return self.hub.send(
+                self.node_id,
+                routing.primary,
+                "primary_op",
+                {
+                    "index": index,
+                    "id": doc_id,
+                    "source": source,
+                    "op": op,
+                    "op_type": op_type,
+                },
+            )
+        return self._replicate(index, shard_id, doc_id, source, op, op_type)
+
+    def _replicate(
+        self,
+        index: str,
+        shard_id: int,
+        doc_id: str,
+        source: dict | None,
+        op: str,
+        op_type: str,
+    ) -> dict:
+        key = (index, shard_id)
+        routing = self._routing(index, shard_id)
+        engine = self.engines[key]
+        tracker = self.trackers.setdefault(key, ReplicationTracker())
+        term = routing.primary_term
+        if op == "index":
+            result = engine.index(source, doc_id, op_type=op_type)
+            rep_op = {
+                "seqno": result["_seq_no"],
+                "op": "index",
+                "id": doc_id,
+                "version": result["_version"],
+                "source": source,
+                "term": term,
+            }
+        else:
+            result = engine.delete(doc_id)
+            if result["result"] == "not_found":
+                return result
+            rep_op = {
+                "seqno": result["_seq_no"],
+                "op": "delete",
+                "id": doc_id,
+                "version": result["_version"],
+                "term": term,
+            }
+        tracker.update_checkpoint(self.node_id, engine.local_checkpoint)
+        # Re-read the routing AFTER the op took its seqno: a recovery
+        # finalize holds the engine lock while flipping its target in-sync,
+        # so any copy it promoted while we waited for the lock is visible
+        # here and becomes REQUIRED for this op's ack.
+        routing = self._routing(index, shard_id)
+        # Fan out to every tracked copy; in-sync copies must apply (or be
+        # failed out of the set via a quorum-published state change) before
+        # the client sees an ack; recovering copies are best-effort.
+        targets = [
+            n
+            for n in routing.replicas + routing.recovering
+            if n != self.node_id
+        ]
+        for node in targets:
+            required = node in routing.in_sync
+            try:
+                resp = self.hub.send(
+                    self.node_id,
+                    node,
+                    "replica_op",
+                    {
+                        "index": index,
+                        "shard": shard_id,
+                        "term": term,
+                        "op": rep_op,
+                    },
+                )
+                tracker.update_checkpoint(node, resp["local_checkpoint"])
+            except (ConnectTransportError, RemoteActionError) as e:
+                if (
+                    isinstance(e, RemoteActionError)
+                    and e.remote_type == "StalePrimaryTermError"
+                ):
+                    # We were deposed: never ack through a stale term.
+                    raise StalePrimaryTermError(str(e)) from e
+                if not required:
+                    continue
+                self._fail_copy(index, shard_id, node, term, str(e))
+        result["_primary_term"] = term
+        result["_global_checkpoint"] = tracker.global_checkpoint
+        return result
+
+    def _fail_copy(
+        self, index: str, shard_id: int, node: str, term: int, reason: str
+    ) -> None:
+        """Ask the master to remove a copy from the in-sync set. The write
+        can only proceed once the removal is quorum-published; otherwise
+        acking would race a possible promotion of the unreached copy."""
+        master = self.state.master
+        if master is None:
+            raise ReplicationFailedError(
+                f"cannot fail [{node}] for [{index}][{shard_id}]: no master"
+            )
+        try:
+            resp = self.hub.send(
+                self.node_id,
+                master,
+                "fail_shard",
+                {
+                    "index": index,
+                    "shard": shard_id,
+                    "node": node,
+                    "term": term,
+                    "reason": reason,
+                },
+            )
+        except (ConnectTransportError, RemoteActionError) as e:
+            raise ReplicationFailedError(
+                f"master unreachable failing [{node}]: {e}"
+            ) from e
+        if not resp.get("acked"):
+            raise ReplicationFailedError(
+                f"master refused to fail [{node}]: {resp}"
+            )
+
+    # --------------------------------------------------- replica-side ops
+
+    def _on_replica_op(self, from_id: str, payload: dict):
+        index, shard_id = payload["index"], payload["shard"]
+        term = int(payload["term"])
+        routing = self._routing(index, shard_id)
+        if term < routing.primary_term:
+            raise StalePrimaryTermError(
+                f"stale primary term [{term}] < [{routing.primary_term}] "
+                f"for [{index}][{shard_id}]"
+            )
+        engine = self.engines.get((index, shard_id))
+        if engine is None:
+            with self.lock:
+                meta = self.state.indices[index]
+                engine = Engine(Mappings.from_json(meta.mappings))
+                self.engines[(index, shard_id)] = engine
+        return engine.apply_replica(payload["op"])
+
+    # ----------------------------------------------- recovery (source side)
+
+    def _on_start_recovery(self, from_id: str, payload: dict):
+        """Primary-side peer recovery (RecoverySourceHandler.java:94):
+        stream retained ops above the target's checkpoint (or a full copy
+        when history is gone), then finalize under the engine write lock so
+        no concurrent op can slip between catch-up and in-sync handoff."""
+        index, shard_id = payload["index"], payload["shard"]
+        target = payload["node"]
+        key = (index, shard_id)
+        routing = self._routing(index, shard_id)
+        if routing.primary != self.node_id:
+            raise ValueError(f"not primary for [{index}][{shard_id}]")
+        engine = self.engines[key]
+        term = routing.primary_term
+        ckpt = int(payload["local_checkpoint"])
+        # Ops catch-up is only sound when the target's ops line cannot have
+        # diverged: it is empty, or it already follows the CURRENT term and
+        # is a seqno-prefix of this primary. A line ending in an older term
+        # may hold the old primary's never-acked ops — full reset copy.
+        target_term = int(payload.get("max_op_term", 0))
+        prefix_ok = ckpt <= engine.local_checkpoint and (
+            ckpt == -1 or target_term == term
+        )
+        ops = engine.ops_since(ckpt) if prefix_ok else None
+        if ops is None:
+            resync = engine.resync_payload()
+            self.hub.send(
+                self.node_id, target, "recovery_resync",
+                {
+                    "index": index,
+                    "shard": shard_id,
+                    "payload": resync,
+                    "term": term,
+                },
+            )
+            ckpt = int(resync["max_seqno"])
+        else:
+            for op_batch in _batches(ops, 256):
+                self.hub.send(
+                    self.node_id, target, "recovery_ops",
+                    {"index": index, "shard": shard_id, "ops": op_batch},
+                )
+                if op_batch:
+                    ckpt = max(ckpt, int(op_batch[-1]["seqno"]))
+        # Finalize: block the write path briefly so the remaining tail is
+        # final, ship it, then flip the copy in-sync via the master.
+        with engine.lock:
+            tail = engine.ops_since(ckpt)
+            if tail is None:
+                # Concurrent writes trimmed the history past our cursor:
+                # the batched phase is unusable, fall back to a full copy
+                # (under the lock, so it IS final).
+                resync = engine.resync_payload()
+                self.hub.send(
+                    self.node_id, target, "recovery_resync",
+                    {
+                        "index": index,
+                        "shard": shard_id,
+                        "payload": resync,
+                        "term": term,
+                    },
+                )
+            elif tail:
+                self.hub.send(
+                    self.node_id, target, "recovery_ops",
+                    {"index": index, "shard": shard_id, "ops": tail},
+                )
+            master = self.state.master
+            if master is None:
+                raise ReplicationFailedError("no master to finalize recovery")
+            resp = self.hub.send(
+                self.node_id,
+                master,
+                "shard_recovered",
+                {
+                    "index": index,
+                    "shard": shard_id,
+                    "node": target,
+                    "term": term,
+                },
+            )
+            if not resp.get("acked"):
+                raise ReplicationFailedError(f"finalize refused: {resp}")
+            self.trackers.setdefault(key, ReplicationTracker()).mark_in_sync(
+                target
+            )
+        return {"done": True}
+
+    def _on_recovery_ops(self, from_id: str, payload: dict):
+        engine = self.engines[(payload["index"], payload["shard"])]
+        for op in payload["ops"]:
+            engine.apply_replica(op)
+        return {"local_checkpoint": engine.local_checkpoint}
+
+    def _on_recovery_resync(self, from_id: str, payload: dict):
+        key = (payload["index"], payload["shard"])
+        # A stale copy restarts from scratch: fresh engine, full install.
+        with self.lock:
+            meta = self.state.indices[payload["index"]]
+            engine = Engine(Mappings.from_json(meta.mappings))
+            self.engines[key] = engine
+        engine.apply_resync(payload["payload"])
+        # The installed line belongs to the sender's term: future
+        # recoveries may ops-catch-up from here.
+        engine.max_op_term = max(
+            engine.max_op_term, int(payload.get("term", 0))
+        )
+        return {"local_checkpoint": engine.local_checkpoint}
+
+    # ------------------------------------------------------- search path
+
+    def _on_shard_search(self, from_id: str, payload: dict):
+        from ..search.service import SearchRequest, SearchService
+
+        engine = self.engines[(payload["index"], payload["shard"])]
+        engine.refresh()
+        request = SearchRequest.from_json(payload["body"])
+        resp = SearchService(engine, payload["index"]).search(request)
+        return {
+            "total": resp.total,
+            "max_score": resp.max_score,
+            "hits": [
+                {
+                    "_id": h.doc_id,
+                    "_score": h.score,
+                    "_source": h.source,
+                }
+                for h in resp.hits
+            ],
+        }
+
+    def search(self, index: str, body: dict) -> dict:
+        """Scatter to one alive copy per shard, merge like the coordinator
+        (score desc, then shard index, then per-shard rank)."""
+        meta = self.state.indices.get(index)
+        if meta is None:
+            raise NoShardAvailableError(f"no such index [{index}]")
+        size = int(body.get("size", 10))
+        shard_body = dict(body)
+        shard_body["from"] = 0
+        shard_body["size"] = int(body.get("from", 0)) + size
+        merged: list[tuple] = []
+        total = 0
+        max_score = None
+        for shard_id, routing in sorted(meta.shards.items()):
+            copies = [
+                n
+                for n in ([routing.primary] if routing.primary else [])
+                + routing.replicas
+                if n is not None
+            ]
+            resp = None
+            last_err: Exception | None = None
+            for node in copies:
+                try:
+                    resp = self.hub.send(
+                        self.node_id,
+                        node,
+                        "shard_search",
+                        {"index": index, "shard": shard_id, "body": shard_body},
+                    )
+                    break
+                except (ConnectTransportError, RemoteActionError) as e:
+                    last_err = e
+            if resp is None:
+                raise NoShardAvailableError(
+                    f"all copies of [{index}][{shard_id}] failed: {last_err}"
+                )
+            total += resp["total"] or 0
+            if resp["max_score"] is not None:
+                max_score = (
+                    resp["max_score"]
+                    if max_score is None
+                    else max(max_score, resp["max_score"])
+                )
+            for rank, hit in enumerate(resp["hits"]):
+                score = hit["_score"]
+                sort_key = -score if score is not None else np.inf
+                merged.append((sort_key, shard_id, rank, hit))
+        merged.sort(key=lambda t: (t[0], t[1], t[2]))
+        frm = int(body.get("from", 0))
+        page = [h for _, _, _, h in merged[frm : frm + size]]
+        return {
+            "hits": {
+                "total": {"value": total, "relation": "eq"},
+                "max_score": max_score,
+                "hits": page,
+            }
+        }
+
+    def get_doc(self, index: str, doc_id: str) -> dict | None:
+        meta = self.state.indices[index]
+        shard_id = shard_for_id(doc_id, meta.n_shards)
+        routing = meta.shards[shard_id]
+        if routing.primary is None:
+            raise NoShardAvailableError(f"[{index}][{shard_id}] unassigned")
+        if routing.primary == self.node_id:
+            return self.engines[(index, shard_id)].get(doc_id)
+        return self.hub.send(
+            self.node_id,
+            routing.primary,
+            "get_doc",
+            {"index": index, "id": doc_id},
+        )
+
+    def _on_get_doc(self, from_id: str, payload: dict):
+        meta = self.state.indices[payload["index"]]
+        shard_id = shard_for_id(payload["id"], meta.n_shards)
+        return self.engines[(payload["index"], shard_id)].get(payload["id"])
+
+    # ------------------------------------------------------- master duties
+
+    def _require_master(self) -> None:
+        if not self.is_master():
+            raise NotMasterError(f"[{self.node_id}] is not the master")
+
+    def _publish(self, new_state: ClusterState) -> bool:
+        """Publish a state; True when a quorum of seeds accepted (committed).
+        The master steps down on losing quorum (Coordinator publication)."""
+        new_state.version += 1
+        acks = 0
+        for node in new_state.seed_nodes:
+            if node == self.node_id:
+                continue
+            try:
+                resp = self.hub.send(
+                    self.node_id,
+                    node,
+                    "publish_state",
+                    {"state": new_state.to_json()},
+                )
+                if resp.get("accepted"):
+                    acks += 1
+            except (ConnectTransportError, RemoteActionError):
+                continue
+        committed = new_state.quorum(acks + 1)  # self counts
+        if committed:
+            with self.lock:
+                self.state = new_state
+                self._apply_assignments()
+        else:
+            with self.lock:  # lost the cluster: stop acting as master
+                if self.state.master == self.node_id:
+                    demoted = self.state.copy()
+                    demoted.master = None
+                    self.state = demoted
+        return committed
+
+    def _on_fail_shard(self, from_id: str, payload: dict):
+        with self.master_lock:
+            self._require_master()
+            index, shard_id = payload["index"], payload["shard"]
+            node, term = payload["node"], int(payload["term"])
+            new = self.state.copy()
+            routing = new.indices[index].shards[shard_id]
+            if term != routing.primary_term:
+                return {"acked": False, "reason": "stale primary term"}
+            if node in routing.replicas:
+                routing.replicas.remove(node)
+            if node in routing.recovering:
+                routing.recovering.remove(node)
+            routing.in_sync.discard(node)
+            return {"acked": self._publish(new)}
+
+    def _on_shard_recovered(self, from_id: str, payload: dict):
+        with self.master_lock:
+            self._require_master()
+            index, shard_id = payload["index"], payload["shard"]
+            node = payload["node"]
+            new = self.state.copy()
+            routing = new.indices[index].shards[shard_id]
+            # A deposed primary must not vouch copies into the in-sync
+            # set: its recovery ran without the current term's acked
+            # writes.
+            if int(payload.get("term", -1)) != routing.primary_term:
+                return {"acked": False, "reason": "stale primary term"}
+            if from_id != routing.primary:
+                return {"acked": False, "reason": "not the primary"}
+            if node in routing.recovering:
+                routing.recovering.remove(node)
+            if node not in routing.replicas and node != routing.primary:
+                routing.replicas.append(node)
+            routing.in_sync.add(node)
+            return {"acked": self._publish(new)}
+
+    def _on_create_index(self, from_id: str, payload: dict):
+        with self.master_lock:
+            return self._create_index_locked(payload)
+
+    def _create_index_locked(self, payload: dict):
+        self._require_master()
+        name = payload["name"]
+        n_shards = int(payload.get("n_shards", 1))
+        n_replicas = int(payload.get("n_replicas", 1))
+        new = self.state.copy()
+        if name in new.indices:
+            raise ValueError(f"index [{name}] already exists")
+        nodes = sorted(new.nodes)
+        meta = IndexMeta(
+            name=name,
+            mappings=payload.get("mappings") or {},
+            n_shards=n_shards,
+            n_replicas=n_replicas,
+        )
+        for shard_id in range(n_shards):
+            ordered = nodes[shard_id % len(nodes):] + nodes[: shard_id % len(nodes)]
+            primary = ordered[0]
+            replicas = ordered[1 : 1 + n_replicas]
+            meta.shards[shard_id] = ShardRouting(
+                primary=primary,
+                replicas=replicas,
+                in_sync={primary, *replicas},  # empty copies: trivially in sync
+                primary_term=1,
+            )
+        new.indices[name] = meta
+        if not self._publish(new):
+            raise ReplicationFailedError("create_index lost quorum")
+        return {"acknowledged": True}
+
+    def health_round(self) -> None:
+        """Master ping round: drop dead members, promote/heal shards."""
+        with self.master_lock:
+            if not self.is_master():
+                return
+            self._health_round_locked()
+
+    def _health_round_locked(self) -> None:
+        alive = {self.node_id}
+        restarted: set[str] = set()
+        sessions = {self.node_id: self.session}
+        for node in self.state.seed_nodes:
+            if node == self.node_id:
+                continue
+            try:
+                pong = self.hub.send(self.node_id, node, "ping", {})
+                alive.add(node)
+                sessions[node] = pong.get("session", "")
+            except (ConnectTransportError, RemoteActionError):
+                continue
+        for node, session in sessions.items():
+            last = self.state.node_sessions.get(node)
+            if last is not None and session and session != last:
+                # Same node id, new process: its in-memory copies are gone
+                # — every membership it held is stale and must be stripped
+                # BEFORE any promotion decision below. Applies to the
+                # master itself after its own restart.
+                restarted.add(node)
+        new = self.state.copy()
+        changed = alive != new.nodes or sessions != {
+            n: new.node_sessions.get(n) for n in sessions
+        }
+        new.nodes = alive
+        new.node_sessions.update(sessions)
+        if restarted:
+            changed = True
+            for meta in new.indices.values():
+                for routing in meta.shards.values():
+                    for node in restarted:
+                        if routing.primary == node:
+                            routing.primary = None  # promotion path below
+                        if node in routing.replicas:
+                            routing.replicas.remove(node)
+                        if node in routing.recovering:
+                            routing.recovering.remove(node)
+                        routing.in_sync.discard(node)
+        for meta in new.indices.values():
+            for routing in meta.shards.values():
+                if routing.primary is None or routing.primary not in alive:
+                    # Promote: any in-sync replica has every acked op.
+                    dead = routing.primary
+                    candidates = sorted(
+                        n for n in routing.replicas
+                        if n in alive and n in routing.in_sync
+                    )
+                    if dead is not None:
+                        routing.in_sync.discard(dead)
+                        changed = True
+                    if candidates:
+                        routing.primary = candidates[0]
+                        routing.replicas.remove(candidates[0])
+                        routing.primary_term += 1
+                        changed = True
+                    elif dead is not None:
+                        routing.primary = None  # red: refuse writes
+                for node in list(routing.replicas):
+                    if node not in alive:
+                        routing.replicas.remove(node)
+                        routing.in_sync.discard(node)
+                        changed = True
+                for node in list(routing.recovering):
+                    if node not in alive:
+                        routing.recovering.remove(node)
+                        changed = True
+                # Heal: allocate missing copies to nodes without one.
+                want = meta.n_replicas
+                have = len(routing.replicas) + len(routing.recovering)
+                if routing.primary is not None and have < want:
+                    holders = set(routing.assigned()) | set(routing.recovering)
+                    for node in sorted(alive):
+                        if have >= want:
+                            break
+                        if node not in holders:
+                            routing.recovering.append(node)
+                            have += 1
+                            changed = True
+        if changed:
+            self._publish(new)
+
+    def try_elect(self) -> bool:
+        """Non-master path: if the master looks dead and we are the lowest
+        reachable seed, run a quorum election and take over."""
+        master = self.state.master
+        if master == self.node_id:
+            return True
+        if master is not None:
+            try:
+                self.hub.send(self.node_id, master, "ping", {})
+                return False  # master healthy
+            except (ConnectTransportError, RemoteActionError):
+                pass
+        reachable = {self.node_id}
+        for node in self.state.seed_nodes:
+            if node == self.node_id:
+                continue
+            try:
+                self.hub.send(self.node_id, node, "ping", {})
+                reachable.add(node)
+            except (ConnectTransportError, RemoteActionError):
+                continue
+        if min(reachable) != self.node_id:
+            return False  # defer to the lower-id candidate
+        # Adopt the newest accepted state among reachable peers before
+        # standing: a restarted candidate with empty state would otherwise
+        # be vetoed by every voter (and must never publish empty state
+        # over live cluster metadata).
+        for node in sorted(reachable - {self.node_id}):
+            try:
+                resp = self.hub.send(self.node_id, node, "get_state", {})
+                peer_state = ClusterState.from_json(resp["state"])
+            except (ConnectTransportError, RemoteActionError, KeyError):
+                continue
+            with self.lock:
+                if peer_state.newer_than(self.state):
+                    self.state = peer_state
+                    self.current_term = max(
+                        self.current_term, peer_state.term
+                    )
+                    self._apply_assignments()
+        term = self.current_term + 1
+        votes = 1
+        for node in sorted(reachable - {self.node_id}):
+            try:
+                resp = self.hub.send(
+                    self.node_id,
+                    node,
+                    "request_vote",
+                    {
+                        "term": term,
+                        "state_term": self.state.term,
+                        "state_version": self.state.version,
+                    },
+                )
+                if resp.get("granted"):
+                    votes += 1
+            except (ConnectTransportError, RemoteActionError):
+                continue
+        if not self.state.quorum(votes):
+            return False
+        with self.lock:
+            self.current_term = term
+            new = self.state.copy()
+            new.term = term
+            new.master = self.node_id
+            new.nodes = reachable
+        if not self._publish(new):  # commit the mastership itself
+            return False
+        self.health_round()  # reroute around dead nodes under the new term
+        return self.is_master()
+
+
+def _batches(items: list, n: int):
+    for i in range(0, len(items), n):
+        yield items[i : i + n]
+
+
+class LocalCluster:
+    """N in-process nodes over one interceptable hub — the test-cluster
+    form of the reference's InternalTestCluster (+ MockTransportService)."""
+
+    def __init__(self, n_nodes: int = 3):
+        self.hub = TransportHub()
+        seeds = tuple(f"node-{i}" for i in range(n_nodes))
+        self.seeds = seeds
+        self.nodes: dict[str, ClusterNode] = {
+            node_id: ClusterNode(node_id, self.hub, seeds)
+            for node_id in seeds
+        }
+        self._stepper: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.step()  # bootstrap election
+
+    # ------------------------------------------------------------ control
+
+    def step(self) -> None:
+        """One deterministic control-plane round: election checks, master
+        health round, recovery kicks."""
+        for node in list(self.nodes.values()):
+            if node.closed:
+                continue
+            node.try_elect()
+        master = self.master()
+        if master is not None:
+            master.health_round()
+        for node in list(self.nodes.values()):
+            if not node.closed:
+                node.check_recoveries()
+
+    def start_stepper(self, interval_s: float = 0.05) -> None:
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.step()
+                except Exception:
+                    pass
+                time.sleep(interval_s)
+
+        self._stop.clear()
+        self._stepper = threading.Thread(target=loop, daemon=True)
+        self._stepper.start()
+
+    def stop_stepper(self) -> None:
+        self._stop.set()
+        if self._stepper is not None:
+            self._stepper.join(timeout=2)
+
+    def master(self) -> ClusterNode | None:
+        for node in self.nodes.values():
+            if not node.closed and node.is_master():
+                return node
+        return None
+
+    def any_node(self) -> ClusterNode:
+        for node in self.nodes.values():
+            if not node.closed:
+                return node
+        raise RuntimeError("no live nodes")
+
+    def kill(self, node_id: str) -> None:
+        """Hard-stop a node (process death: no goodbye, state lost)."""
+        self.nodes[node_id].close()
+
+    def restart(self, node_id: str) -> ClusterNode:
+        """Bring a node back empty (in-memory copies are lost; it rejoins
+        and re-acquires shard copies via peer recovery)."""
+        node = ClusterNode(node_id, self.hub, self.seeds)
+        self.nodes[node_id] = node
+        return node
+
+    def close(self) -> None:
+        self.stop_stepper()
+        for node in self.nodes.values():
+            node.close()
+
+    # ------------------------------------------------------------- client
+
+    def create_index(
+        self,
+        name: str,
+        n_shards: int = 1,
+        n_replicas: int = 1,
+        mappings: dict | None = None,
+    ) -> dict:
+        master = self.master()
+        if master is None:
+            raise NotMasterError("cluster has no master")
+        resp = master._on_create_index(
+            "client",
+            {
+                "name": name,
+                "n_shards": n_shards,
+                "n_replicas": n_replicas,
+                "mappings": mappings or {},
+            },
+        )
+        return resp

@@ -1,0 +1,132 @@
+"""Cluster state: nodes, index metadata, shard routing, in-sync sets.
+
+The reference's ClusterState (cluster/ClusterState.java) carries discovery
+nodes, metadata, and a routing table; the master mutates it and publishes
+versioned copies to every node, and the in-sync allocation set per shard
+(cluster/metadata/IndexMetadata#inSyncAllocationIds) is the safety core:
+only a copy that has every acknowledged write may ever be promoted to
+primary. This module keeps the same shape, JSON-serializable so it can
+cross the transport verbatim.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class ShardRouting:
+    """Assignment of one shard's copies to nodes."""
+
+    primary: str | None  # node id (None = unassigned: no promotable copy)
+    replicas: list[str] = field(default_factory=list)
+    in_sync: set[str] = field(default_factory=set)  # node ids, incl. primary
+    primary_term: int = 1
+    recovering: list[str] = field(default_factory=list)  # tracked, not in-sync
+
+    def assigned(self) -> list[str]:
+        out = [] if self.primary is None else [self.primary]
+        out.extend(self.replicas)
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "primary": self.primary,
+            "replicas": list(self.replicas),
+            "in_sync": sorted(self.in_sync),
+            "primary_term": self.primary_term,
+            "recovering": list(self.recovering),
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ShardRouting":
+        return cls(
+            primary=d["primary"],
+            replicas=list(d["replicas"]),
+            in_sync=set(d["in_sync"]),
+            primary_term=int(d["primary_term"]),
+            recovering=list(d.get("recovering", [])),
+        )
+
+
+@dataclass
+class IndexMeta:
+    name: str
+    mappings: dict[str, Any]
+    n_shards: int
+    n_replicas: int
+    shards: dict[int, ShardRouting] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "mappings": self.mappings,
+            "n_shards": self.n_shards,
+            "n_replicas": self.n_replicas,
+            "shards": {str(k): v.to_json() for k, v in self.shards.items()},
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "IndexMeta":
+        return cls(
+            name=d["name"],
+            mappings=d["mappings"],
+            n_shards=int(d["n_shards"]),
+            n_replicas=int(d["n_replicas"]),
+            shards={
+                int(k): ShardRouting.from_json(v)
+                for k, v in d["shards"].items()
+            },
+        )
+
+
+@dataclass
+class ClusterState:
+    """Versioned, master-published view of the cluster."""
+
+    term: int = 0  # master term (bumps at each election)
+    version: int = 0  # bumps at each publication
+    master: str | None = None
+    nodes: set[str] = field(default_factory=set)  # current members
+    seed_nodes: tuple[str, ...] = ()  # full configuration (quorum base)
+    indices: dict[str, IndexMeta] = field(default_factory=dict)
+    # Last observed process incarnation per node id (allocation-id lite):
+    # lives IN the published state so a new master inherits it and can
+    # still recognize restarted-empty copies — including itself.
+    node_sessions: dict[str, str] = field(default_factory=dict)
+
+    def newer_than(self, other: "ClusterState") -> bool:
+        return (self.term, self.version) > (other.term, other.version)
+
+    def quorum(self, votes: int) -> bool:
+        return votes >= len(self.seed_nodes) // 2 + 1
+
+    def copy(self) -> "ClusterState":
+        return copy.deepcopy(self)
+
+    def to_json(self) -> dict:
+        return {
+            "term": self.term,
+            "version": self.version,
+            "master": self.master,
+            "nodes": sorted(self.nodes),
+            "seed_nodes": list(self.seed_nodes),
+            "indices": {k: v.to_json() for k, v in self.indices.items()},
+            "node_sessions": dict(self.node_sessions),
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ClusterState":
+        return cls(
+            term=int(d["term"]),
+            version=int(d["version"]),
+            master=d["master"],
+            nodes=set(d["nodes"]),
+            seed_nodes=tuple(d["seed_nodes"]),
+            indices={
+                k: IndexMeta.from_json(v) for k, v in d["indices"].items()
+            },
+            node_sessions=dict(d.get("node_sessions", {})),
+        )

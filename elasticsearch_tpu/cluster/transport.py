@@ -1,0 +1,132 @@
+"""In-memory node-to-node transport with fault injection.
+
+The host-level RPC layer of the cluster (SURVEY §2.4's control plane): the
+reference moves cluster state, replicated writes, and peer recovery over
+transport-netty4 TCP channels; on a TPU pod the data plane is ICI
+collectives (parallel/sharded.py) and only this control plane crosses
+hosts. The in-memory hub is the test-cluster form — the reference's
+MockTransportService pattern (test/framework .../MockTransportService) —
+with the same interception points (disconnect, partition, drop-by-action,
+delay) a TCP implementation would fault on, so replication/failover logic
+is exercised against real message loss without real sockets.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time
+from typing import Any, Callable
+
+
+class ConnectTransportError(Exception):
+    """Peer unreachable (dead node, partition, injected disconnect)."""
+
+
+class RemoteActionError(Exception):
+    """The remote handler raised; carries the remote error text plus the
+    remote exception's type name in `remote_type` so callers can react to
+    specific failures (e.g. stale-primary-term rejections) without
+    fragile message matching."""
+
+    def __init__(self, message: str, remote_type: str = ""):
+        super().__init__(message)
+        self.remote_type = remote_type
+
+
+class TransportHub:
+    """Shared in-process switchboard for a LocalCluster's nodes."""
+
+    def __init__(self):
+        self._handlers: dict[str, Callable[[str, str, dict], Any]] = {}
+        self._lock = threading.Lock()
+        self._partitions: list[set[str]] = []  # disjoint reachability groups
+        self._disconnected: set[frozenset] = set()  # unordered pairs
+        self._dropped_actions: list[tuple[str, str, str]] = []  # from,to,pat
+        self._delay_s = 0.0
+
+    # ------------------------------------------------------------ wiring
+
+    def register(
+        self, node_id: str, handler: Callable[[str, str, dict], Any]
+    ) -> None:
+        with self._lock:
+            self._handlers[node_id] = handler
+
+    def unregister(self, node_id: str) -> None:
+        with self._lock:
+            self._handlers.pop(node_id, None)
+
+    # ---------------------------------------------------- fault injection
+
+    def disconnect(self, a: str, b: str) -> None:
+        with self._lock:
+            self._disconnected.add(frozenset((a, b)))
+
+    def reconnect(self, a: str, b: str) -> None:
+        with self._lock:
+            self._disconnected.discard(frozenset((a, b)))
+
+    def partition(self, *groups: set[str]) -> None:
+        """Only nodes within the same group can talk."""
+        with self._lock:
+            self._partitions = [set(g) for g in groups]
+
+    def heal_partition(self) -> None:
+        with self._lock:
+            self._partitions = []
+
+    def drop_action(self, from_id: str, to_id: str, pattern: str) -> None:
+        """Drop matching requests (fnmatch on action; '*' wildcards ids)."""
+        with self._lock:
+            self._dropped_actions.append((from_id, to_id, pattern))
+
+    def clear_drops(self) -> None:
+        with self._lock:
+            self._dropped_actions = []
+
+    def set_delay(self, seconds: float) -> None:
+        self._delay_s = seconds
+
+    # ------------------------------------------------------------- sending
+
+    def _reachable(self, a: str, b: str) -> bool:
+        if frozenset((a, b)) in self._disconnected:
+            return False
+        for group in self._partitions:
+            if (a in group) != (b in group):
+                return False
+        return True
+
+    def send(self, from_id: str, to_id: str, action: str, payload: dict):
+        """Synchronous request/response; raises ConnectTransportError on
+        unreachable peers and RemoteActionError for remote failures."""
+        with self._lock:
+            handler = self._handlers.get(to_id)
+            reachable = self._reachable(from_id, to_id)
+            drops = list(self._dropped_actions)
+        if handler is None or not reachable:
+            raise ConnectTransportError(f"[{to_id}] unreachable from [{from_id}]")
+        for f, t, pat in drops:
+            if (
+                fnmatch.fnmatch(from_id, f)
+                and fnmatch.fnmatch(to_id, t)
+                and fnmatch.fnmatch(action, pat)
+            ):
+                raise ConnectTransportError(
+                    f"[{action}] {from_id}->{to_id} dropped by interceptor"
+                )
+        if self._delay_s:
+            time.sleep(self._delay_s)
+        try:
+            return handler(from_id, action, payload)
+        except (ConnectTransportError, RemoteActionError):
+            raise
+        except Exception as e:  # remote handler failure crosses the wire
+            raise RemoteActionError(
+                f"[{action}] on [{to_id}]: {e}", remote_type=type(e).__name__
+            ) from e
+
+    def alive(self, node_id: str) -> bool:
+        with self._lock:
+            return node_id in self._handlers

@@ -159,6 +159,21 @@ class Engine:
         self._tombstone_ts: dict[str, float] = {}  # _id -> delete wall time
         self.gc_deletes_s = 60.0
         self._stats_cache: dict[str, FieldStats] | None = None
+        # Replication state (index/seqno.py): the local checkpoint is the
+        # highest contiguous processed seqno (replicas apply out of order);
+        # the ops history retains recent ops for peer-recovery catch-up —
+        # the analog of the reference's translog retention / soft-delete
+        # ops history (index/seqno/RetentionLeases, RecoverySourceHandler).
+        from .seqno import LocalCheckpointTracker
+
+        self.checkpoint = LocalCheckpointTracker()
+        self._ops_history: list[dict] = []
+        self._ops_floor = -1  # seqnos <= floor no longer individually held
+        self.history_retention = 10_000
+        # Highest primary term any applied op carried: a copy whose ops
+        # line predates the current term may hold diverged (never-acked)
+        # ops and must full-resync rather than ops-catch-up.
+        self.max_op_term = 0
         # Monotonic refresh generation: bumps whenever the searchable view
         # changes (new segment, live-mask sync, recovery). Cache keys built
         # from this are safe where id()-of-handle keys are not (CPython
@@ -182,6 +197,10 @@ class Engine:
                 self._replay_translog()
             finally:
                 self._recovering = False
+        # Everything recovered is contiguous by construction; ops below the
+        # recovered point are not individually available for catch-up.
+        self.checkpoint.advance_to(self._seqno)
+        self._ops_floor = self._seqno
 
     # ------------------------------------------------------------- write path
 
@@ -271,16 +290,17 @@ class Engine:
             self._versions[doc_id] = version
             self._doc_seqnos[doc_id] = seqno
             self._tombstone_ts.pop(doc_id, None)
+            op = {
+                "seqno": seqno,
+                "op": "index",
+                "id": doc_id,
+                "version": version,
+                "source": source,
+                "term": self.primary_term,
+            }
             if self.translog is not None:
-                self.translog.add(
-                    {
-                        "seqno": seqno,
-                        "op": "index",
-                        "id": doc_id,
-                        "version": version,
-                        "source": source,
-                    }
-                )
+                self.translog.add(op)
+            self._record_op(op)
             return {
                 "_id": doc_id,
                 "result": "created" if created else "updated",
@@ -304,15 +324,16 @@ class Engine:
                 self._versions[doc_id] = version
                 self._doc_seqnos[doc_id] = seqno
                 self._tombstone_ts[doc_id] = time.time()
+                op = {
+                    "seqno": seqno,
+                    "op": "delete",
+                    "id": doc_id,
+                    "version": version,
+                    "term": self.primary_term,
+                }
                 if self.translog is not None:
-                    self.translog.add(
-                        {
-                            "seqno": seqno,
-                            "op": "delete",
-                            "id": doc_id,
-                            "version": version,
-                        }
-                    )
+                    self.translog.add(op)
+                self._record_op(op)
             return {
                 "_id": doc_id,
                 "result": "deleted" if found else "not_found",
@@ -320,6 +341,151 @@ class Engine:
                 "_version": version if found else 1,
                 "_primary_term": self.primary_term,
             }
+
+    # ------------------------------------------------------- replication
+
+    def _record_op(self, op: dict) -> None:
+        """Retain the op for peer-recovery catch-up and advance the local
+        checkpoint. Caller holds the engine lock."""
+        self.checkpoint.mark(int(op["seqno"]))
+        self.max_op_term = max(self.max_op_term, int(op.get("term", 0)))
+        self._ops_history.append(op)
+        if len(self._ops_history) > self.history_retention:
+            drop = len(self._ops_history) - self.history_retention
+            self._ops_floor = max(
+                self._ops_floor,
+                max(int(o["seqno"]) for o in self._ops_history[:drop]),
+            )
+            del self._ops_history[:drop]
+
+    @property
+    def local_checkpoint(self) -> int:
+        return self.checkpoint.checkpoint
+
+    def _apply_external_op(self, op: dict, write_translog: bool) -> None:
+        """Apply an op that already carries its seqno/version (replica
+        fan-out or translog replay). Per-doc conflicts resolve newest-
+        seqno-wins; stale ops are no-ops but still count as processed.
+        Caller holds the engine lock."""
+        doc_id = op["id"]
+        seqno = int(op["seqno"])
+        version = int(op.get("version", self._versions.get(doc_id, 0) + 1))
+        if seqno > self._doc_seqnos.get(doc_id, -1):
+            if op["op"] == "index":
+                self._delete_existing(doc_id)
+                local = self._buffer.add(
+                    op["source"], doc_id, version=version, seqno=seqno
+                )
+                self._buffer_ids[doc_id] = local
+                self._versions[doc_id] = version
+                self._doc_seqnos[doc_id] = seqno
+                self._tombstone_ts.pop(doc_id, None)
+                self._bump_auto_id(doc_id)
+            else:
+                self._delete_existing(doc_id)
+                self._versions[doc_id] = version
+                self._doc_seqnos[doc_id] = seqno
+                self._tombstone_ts[doc_id] = time.time()
+        self._seqno = max(self._seqno, seqno)
+        if write_translog and self.translog is not None:
+            self.translog.add(op)
+        self._record_op(op)
+
+    def apply_replica(self, op: dict) -> dict:
+        """Apply a primary-replicated op with its assigned seqno/version.
+
+        Replica-side semantics of the reference's TransportShardBulkAction
+        replica phase: ops may arrive out of order, so per-doc conflicts
+        resolve newest-seqno-wins (index/engine/InternalEngine
+        planIndexingAsNonPrimary), stale ops are no-ops (still marked
+        processed), and the local checkpoint advances through the tracker.
+        """
+        with self.lock:
+            self._apply_external_op(op, write_translog=True)
+            return {"local_checkpoint": self.local_checkpoint}
+
+    def ops_since(self, seqno: int) -> list[dict] | None:
+        """Retained ops with seqno > `seqno` in seqno order, or None when
+        the history no longer reaches back that far (caller must fall back
+        to a full resync — the reference's file-based recovery path)."""
+        with self.lock:
+            if seqno < self._ops_floor:
+                return None
+            return sorted(
+                (o for o in self._ops_history if int(o["seqno"]) > seqno),
+                key=lambda o: int(o["seqno"]),
+            )
+
+    def resync_payload(self) -> dict:
+        """Full-copy payload: every live doc (with version/seqno) plus the
+        tombstone version lines — the ops-history-exhausted recovery path.
+        """
+        with self.lock:
+            docs = []
+            for doc_id, local in self._buffer_ids.items():
+                if local not in self._buffer_deleted:
+                    docs.append(
+                        {
+                            "id": doc_id,
+                            "source": self._buffer._sources[local],
+                            "version": self._versions.get(doc_id, 1),
+                            "seqno": self._doc_seqnos.get(doc_id, -1),
+                        }
+                    )
+            for handle in self.segments:
+                seg = handle.segment
+                for local in np.flatnonzero(handle.live_host):
+                    local = int(local)
+                    doc_id = seg.ids[local]
+                    if doc_id in self._buffer_ids:
+                        continue
+                    docs.append(
+                        {
+                            "id": doc_id,
+                            "source": seg.sources[local],
+                            "version": seg.doc_version(local),
+                            "seqno": seg.doc_seqno(local),
+                        }
+                    )
+            return {
+                "docs": docs,
+                "tombstones": {
+                    doc_id: [
+                        self._versions.get(doc_id, 1),
+                        self._doc_seqnos.get(doc_id, -1),
+                    ]
+                    for doc_id in self._tombstone_ts
+                },
+                "max_seqno": self._seqno,
+            }
+
+    def apply_resync(self, payload: dict) -> None:
+        """Install a full-copy payload on an empty/stale replica."""
+        with self.lock:
+            for doc in payload["docs"]:
+                self.apply_replica(
+                    {
+                        "op": "index",
+                        "id": doc["id"],
+                        "source": doc["source"],
+                        "version": doc["version"],
+                        "seqno": doc["seqno"],
+                    }
+                )
+            for doc_id, (version, seqno) in payload["tombstones"].items():
+                self.apply_replica(
+                    {
+                        "op": "delete",
+                        "id": doc_id,
+                        "version": version,
+                        "seqno": seqno,
+                    }
+                )
+            # Seqnos in a full copy are sparse (merged-away ops are gone):
+            # everything at or below the primary's max is processed here.
+            self._seqno = max(self._seqno, int(payload["max_seqno"]))
+            self.checkpoint.advance_to(self._seqno)
+            self._ops_floor = max(self._ops_floor, self._seqno)
 
     def sync_translog(self) -> None:
         """fsync the translog — the per-request durability point the write
@@ -732,30 +898,15 @@ class Engine:
                 self._tombstone_ts[doc_id] = float(ts)
 
     def _replay_translog(self) -> None:
-        """Re-apply ops above the commit's seqno (recoverFromTranslog)."""
+        """Re-apply ops above the commit's seqno (recoverFromTranslog).
+
+        Shares the replica apply path (the ops already carry seqnos);
+        write_translog=False — these ops are already IN the translog."""
         assert self.translog is not None
         replayed = False
         for op in self.translog.replay(above_seqno=self._seqno):
             replayed = True
-            doc_id = op["id"]
-            seqno = int(op.get("seqno", -1))
-            version = int(op.get("version", self._versions.get(doc_id, 0) + 1))
-            if op["op"] == "index":
-                self._delete_existing(doc_id)
-                local = self._buffer.add(
-                    op["source"], doc_id, version=version, seqno=seqno
-                )
-                self._buffer_ids[doc_id] = local
-                self._versions[doc_id] = version
-                self._doc_seqnos[doc_id] = seqno
-                self._tombstone_ts.pop(doc_id, None)
-                self._bump_auto_id(doc_id)
-            elif op["op"] == "delete":
-                self._delete_existing(doc_id)
-                self._versions[doc_id] = version
-                self._doc_seqnos[doc_id] = seqno
-                self._tombstone_ts[doc_id] = time.time()
-            self._seqno = max(self._seqno, seqno)
+            self._apply_external_op(op, write_translog=False)
         if replayed:
             self.refresh()
 

@@ -1,0 +1,90 @@
+"""Sequence-number machinery for replication.
+
+The reference tracks per-shard write progress with a LocalCheckpointTracker
+(index/seqno/LocalCheckpointTracker.java:37): ops are assigned contiguous
+sequence numbers on the primary but may complete out of order on replicas,
+so the *local checkpoint* is the highest seqno below which every op has
+been processed. The primary's ReplicationTracker
+(index/seqno/ReplicationTracker.java:68) aggregates replica checkpoints
+into the *global checkpoint* — the highest seqno acknowledged by every
+in-sync copy, the durable truncation/recovery floor.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class LocalCheckpointTracker:
+    """Highest contiguous processed seqno (out-of-order tolerant)."""
+
+    def __init__(self, checkpoint: int = -1):
+        self.checkpoint = checkpoint
+        self._pending: set[int] = set()
+        self._lock = threading.Lock()
+
+    def mark(self, seqno: int) -> None:
+        with self._lock:
+            if seqno <= self.checkpoint:
+                return
+            self._pending.add(seqno)
+            while self.checkpoint + 1 in self._pending:
+                self.checkpoint += 1
+                self._pending.discard(self.checkpoint)
+
+    def advance_to(self, seqno: int) -> None:
+        """Jump the checkpoint forward (recovery: everything below a
+        restored commit/translog point is known-processed)."""
+        with self._lock:
+            if seqno > self.checkpoint:
+                self.checkpoint = seqno
+                self._pending = {s for s in self._pending if s > seqno}
+
+
+class ReplicationTracker:
+    """Primary-side view of every tracked copy's local checkpoint."""
+
+    def __init__(self):
+        self._checkpoints: dict[str, int] = {}
+        self._in_sync: set[str] = set()
+        self._lock = threading.Lock()
+
+    def track(self, allocation: str, checkpoint: int = -1) -> None:
+        with self._lock:
+            self._checkpoints.setdefault(allocation, checkpoint)
+
+    def untrack(self, allocation: str) -> None:
+        with self._lock:
+            self._checkpoints.pop(allocation, None)
+            self._in_sync.discard(allocation)
+
+    def mark_in_sync(self, allocation: str) -> None:
+        with self._lock:
+            self._in_sync.add(allocation)
+            self._checkpoints.setdefault(allocation, -1)
+
+    def retain(self, allocations: set[str]) -> None:
+        """Reconcile with the published in-sync set: drop copies that were
+        failed out so the global checkpoint can't stay pinned to them."""
+        with self._lock:
+            for gone in self._in_sync - allocations:
+                self._in_sync.discard(gone)
+                self._checkpoints.pop(gone, None)
+
+    def update_checkpoint(self, allocation: str, checkpoint: int) -> None:
+        with self._lock:
+            cur = self._checkpoints.get(allocation, -1)
+            if checkpoint > cur:
+                self._checkpoints[allocation] = checkpoint
+
+    @property
+    def global_checkpoint(self) -> int:
+        """min over in-sync copies' local checkpoints (-1 when none)."""
+        with self._lock:
+            if not self._in_sync:
+                return -1
+            return min(self._checkpoints.get(a, -1) for a in self._in_sync)
+
+    def in_sync(self) -> set[str]:
+        with self._lock:
+            return set(self._in_sync)
