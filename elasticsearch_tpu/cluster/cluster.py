@@ -266,6 +266,7 @@ class ClusterNode:
                 "shard": shard_id,
                 "node": self.node_id,
                 "local_checkpoint": engine.local_checkpoint,
+                "max_seqno": engine.max_seqno,
                 "max_op_term": engine.max_op_term,
             },
         )
@@ -473,8 +474,12 @@ class ClusterNode:
         # is a seqno-prefix of this primary. A line ending in an older term
         # may hold the old primary's never-acked ops — full reset copy.
         target_term = int(payload.get("max_op_term", 0))
+        target_max_seqno = int(payload.get("max_seqno", 0 if ckpt >= 0 else -1))
+        # "Empty" must mean NO ops at all: a copy can hold out-of-order
+        # old-term ops while its contiguous checkpoint is still -1.
+        empty = target_max_seqno == -1 and target_term == 0
         prefix_ok = ckpt <= engine.local_checkpoint and (
-            ckpt == -1 or target_term == term
+            empty or target_term == term
         )
         ops = engine.ops_since(ckpt) if prefix_ok else None
         if ops is None:
@@ -493,7 +498,12 @@ class ClusterNode:
             for op_batch in _batches(ops, 256):
                 self.hub.send(
                     self.node_id, target, "recovery_ops",
-                    {"index": index, "shard": shard_id, "ops": op_batch},
+                    {
+                        "index": index,
+                        "shard": shard_id,
+                        "ops": op_batch,
+                        "term": term,
+                    },
                 )
                 if op_batch:
                     ckpt = max(ckpt, int(op_batch[-1]["seqno"]))
@@ -518,7 +528,12 @@ class ClusterNode:
             elif tail:
                 self.hub.send(
                     self.node_id, target, "recovery_ops",
-                    {"index": index, "shard": shard_id, "ops": tail},
+                    {
+                        "index": index,
+                        "shard": shard_id,
+                        "ops": tail,
+                        "term": term,
+                    },
                 )
             master = self.state.master
             if master is None:
@@ -541,7 +556,21 @@ class ClusterNode:
             )
         return {"done": True}
 
+    def _check_recovery_term(self, index: str, shard_id: int, term: int):
+        """A deposed primary must not rewrite copies through the recovery
+        channel — the stale-term fence replica_op has, for the channel
+        that can do strictly more damage."""
+        routing = self._routing(index, shard_id)
+        if term < routing.primary_term:
+            raise StalePrimaryTermError(
+                f"stale recovery term [{term}] < [{routing.primary_term}] "
+                f"for [{index}][{shard_id}]"
+            )
+
     def _on_recovery_ops(self, from_id: str, payload: dict):
+        self._check_recovery_term(
+            payload["index"], payload["shard"], int(payload.get("term", -1))
+        )
         engine = self.engines[(payload["index"], payload["shard"])]
         for op in payload["ops"]:
             engine.apply_replica(op)
@@ -549,17 +578,20 @@ class ClusterNode:
 
     def _on_recovery_resync(self, from_id: str, payload: dict):
         key = (payload["index"], payload["shard"])
-        # A stale copy restarts from scratch: fresh engine, full install.
-        with self.lock:
-            meta = self.state.indices[payload["index"]]
-            engine = Engine(Mappings.from_json(meta.mappings))
-            self.engines[key] = engine
+        self._check_recovery_term(key[0], key[1], int(payload.get("term", -1)))
+        # Build the replacement line DETACHED, then swap: a search routed
+        # here mid-install must never see a half-empty engine.
+        meta = self.state.indices[payload["index"]]
+        engine = Engine(Mappings.from_json(meta.mappings))
         engine.apply_resync(payload["payload"])
         # The installed line belongs to the sender's term: future
         # recoveries may ops-catch-up from here.
         engine.max_op_term = max(
             engine.max_op_term, int(payload.get("term", 0))
         )
+        engine.refresh()
+        with self.lock:
+            self.engines[key] = engine
         return {"local_checkpoint": engine.local_checkpoint}
 
     # ------------------------------------------------------- search path
