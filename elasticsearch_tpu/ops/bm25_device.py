@@ -122,6 +122,8 @@ def _eval_node(spec, arrays, seg: dict[str, Any], num_docs: int):
         return _eval_bool(spec, arrays, seg, num_docs)
     if kind == "script":
         return _eval_script(spec, arrays, seg, num_docs)
+    if kind == "function_score":
+        return _eval_function_score(spec, arrays, seg, num_docs)
     if kind == "phrase":
         return _eval_phrase(spec, arrays, seg, num_docs)
     if kind == "doc_set":
@@ -183,6 +185,57 @@ def _eval_script(spec, arrays, seg, num_docs):
         matched = matched & (scores >= arrays["min_score"])
         scores = jnp.where(matched, scores, jnp.float32(0.0))
     return scores, matched
+
+
+def _eval_function_score(spec, arrays, seg, num_docs):
+    """function_score: modify the child's scores with filtered functions.
+
+    All math lives in query/functions.py (shared with the numpy oracle so
+    fp32 rounding matches); this evaluator supplies the traced context —
+    doc-value columns, the child pass, per-function filter masks. The
+    whole thing fuses into the surrounding XLA program: fvf/decay are
+    VPU elementwise chains over doc-values planes, script functions may
+    lower to MXU matmuls (vector ops). Ref: FunctionScoreQueryBuilder.
+    """
+    from ..query.functions import combine_function_score, eval_function
+
+    (_, child_spec, fspecs, filter_specs, score_mode, boost_mode, has_min) = spec
+    child_scores, matched = _eval_node(child_spec, arrays["child"], seg, num_docs)
+    values, applies, weights = [], [], []
+    for fspec, farrays, fil_spec, fil_arrays in zip(
+        fspecs, arrays["functions"], filter_specs, arrays["filters"]
+    ):
+        values.append(
+            eval_function(
+                jnp,
+                fspec,
+                farrays,
+                num_docs=num_docs,
+                column=lambda name: seg["doc_values"].get(name),
+                child_scores=child_scores,
+                doc_values=seg["doc_values"],
+                vectors=seg.get("vectors", {}),
+            )
+        )
+        if fil_spec is None:
+            applies.append(matched)
+        else:
+            _, fil_matched = _eval_node(fil_spec, fil_arrays, seg, num_docs)
+            applies.append(matched & fil_matched)
+        weights.append(farrays["weight"])
+    return combine_function_score(
+        jnp,
+        child_scores=child_scores,
+        matched=matched,
+        values=values,
+        applies=applies,
+        weights=weights,
+        score_mode=score_mode,
+        boost_mode=boost_mode,
+        max_boost=arrays["max_boost"],
+        boost=arrays["boost"],
+        min_score=arrays["min_score"] if has_min else None,
+    )
 
 
 def _gather_tiles(spec, arrays, seg, want: str = "tn"):

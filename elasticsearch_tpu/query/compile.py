@@ -362,6 +362,10 @@ class Compiler:
             return self._bool(q, scoring)
         if isinstance(q, ScriptScoreQuery):
             return self._script_score(q, scoring)
+        from .dsl import FunctionScoreQuery
+
+        if isinstance(q, FunctionScoreQuery):
+            return self._function_score(q, scoring)
         if isinstance(q, MatchPhraseQuery):
             return self._phrase(q, scoring)
         if isinstance(q, MatchPhrasePrefixQuery):
@@ -417,6 +421,53 @@ class Compiler:
                 name: np.asarray(q.params[name], dtype=np.float32)
                 for name in param_names
             },
+            "boost": np.float32(q.boost),
+        }
+        if q.min_score is not None:
+            arrays["min_score"] = np.float32(q.min_score)
+        return spec, arrays
+
+    def _function_score(self, q, scoring: bool) -> tuple[tuple, Any]:
+        """Lower function_score: child plan + per-function (static spec,
+        f32 constants) + per-function filter plans, all shard-uniform
+        (function filters lower through the ordinary node path, so
+        impossible clauses become empty worklists, never divergent specs).
+        Ref: index/query/functionscore/FunctionScoreQueryBuilder.java:45.
+        """
+        from .functions import lower_function
+
+        child_spec, child_arrays = self._node(q.query, scoring)
+        fspecs = []
+        filter_specs = []
+        fn_arrays = []
+        filter_arrays = []
+        for fs in q.functions:
+            fspec, farrays = lower_function(
+                fs, lambda name: name in self.doc_values
+            )
+            fspecs.append(fspec)
+            fn_arrays.append(farrays)
+            if fs.filter is not None:
+                fspec_filter, fa = self._node(fs.filter, scoring=False)
+                filter_specs.append(fspec_filter)
+                filter_arrays.append(fa)
+            else:
+                filter_specs.append(None)
+                filter_arrays.append({})
+        spec = (
+            "function_score",
+            child_spec,
+            tuple(fspecs),
+            tuple(filter_specs),
+            q.score_mode,
+            q.boost_mode,
+            q.min_score is not None,
+        )
+        arrays: dict[str, Any] = {
+            "child": child_arrays,
+            "functions": tuple(fn_arrays),
+            "filters": tuple(filter_arrays),
+            "max_boost": np.float32(q.max_boost),
             "boost": np.float32(q.boost),
         }
         if q.min_score is not None:

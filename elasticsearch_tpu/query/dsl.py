@@ -116,6 +116,59 @@ class ScriptScoreQuery(Query):
 
 
 @dataclass
+class ScoreFunction:
+    """One function of a function_score query (reference: index/query/
+    functionscore/* builders — WeightBuilder, FieldValueFactorFunction
+    Builder, ScriptScoreFunctionBuilder, RandomScoreFunctionBuilder, the
+    decay family). `weight` multiplies the function's value; a bare
+    weight-only entry has kind "weight"."""
+
+    kind: str  # weight | field_value_factor | script_score | random_score
+    #           | gauss | exp | linear
+    filter: "Query | None" = None
+    weight: float | None = None
+    # script_score (params declared before the `field` attribute below —
+    # that annotation shadows dataclasses.field for the rest of the body)
+    source: str = ""
+    params: dict = field(default_factory=dict)
+    # random_score
+    seed: int = 0
+    # field_value_factor / decay target
+    field: str | None = None
+    factor: float = 1.0
+    modifier: str = "none"
+    missing: float | None = None
+    # decay
+    origin: float = 0.0
+    scale: float = 1.0
+    offset: float = 0.0
+    decay: float = 0.5
+
+
+@dataclass
+class FunctionScoreQuery(Query):
+    """Modify the child query's score with a set of (optionally filtered)
+    functions (index/query/functionscore/FunctionScoreQueryBuilder.java:45).
+
+    Matching semantics follow the reference: the doc set is the child
+    query's; each function applies only where its filter matches (no
+    filter = everywhere); when NO function applies to a doc its combined
+    function value is the neutral 1. score_mode combines function values
+    (multiply/sum/avg/first/max/min — avg is weight-weighted), the result
+    is capped at max_boost, boost_mode merges it with the query score
+    (multiply/replace/sum/avg/max/min), and min_score finally filters.
+    """
+
+    query: Query = None  # type: ignore[assignment]
+    functions: list[ScoreFunction] = field(default_factory=list)
+    score_mode: str = "multiply"
+    boost_mode: str = "multiply"
+    max_boost: float = 3.4028235e38  # FLT_MAX, the reference default
+    min_score: float | None = None
+    boost: float = 1.0
+
+
+@dataclass
 class MatchPhraseQuery(Query):
     """Exact phrase over an analyzed text field's positions.
 
@@ -303,6 +356,8 @@ def parse_query(body: dict[str, Any]) -> Query:
             boost=_pop_boost(spec),
             min_score=spec.get("min_score"),
         )
+    if kind == "function_score":
+        return _parse_function_score(spec)
     if kind == "match_phrase":
         fname, val = _single_field(kind, spec)
         if isinstance(val, dict):
@@ -418,6 +473,130 @@ def parse_query(body: dict[str, Any]) -> Query:
             )
         return q
     raise ValueError(f"unknown query type [{kind}]")
+
+
+_DECAY_KINDS = ("gauss", "exp", "linear")
+_FN_KINDS = (
+    "weight",
+    "field_value_factor",
+    "script_score",
+    "random_score",
+) + _DECAY_KINDS
+_FVF_MODIFIERS = (
+    "none", "log", "log1p", "log2p", "ln", "ln1p", "ln2p",
+    "square", "sqrt", "reciprocal",
+)
+
+
+def _parse_one_function(entry: dict) -> ScoreFunction:
+    entry = dict(entry)
+    filt = parse_query(entry.pop("filter")) if "filter" in entry else None
+    weight = entry.pop("weight", None)
+    weight = float(weight) if weight is not None else None
+    kinds = [k for k in entry if k in _FN_KINDS]
+    if len(kinds) > 1:
+        raise ValueError(
+            "failed to parse [function_score]: an entry may define at most "
+            f"one score function, got {kinds}"
+        )
+    if not kinds:
+        if weight is None:
+            raise ValueError(
+                "failed to parse [function_score]: an entry must have a "
+                "function or a weight"
+            )
+        return ScoreFunction(kind="weight", filter=filt, weight=weight)
+    kind = kinds[0]
+    body = entry[kind] or {}
+    if kind == "field_value_factor":
+        if "field" not in body:
+            raise ValueError("[field_value_factor] requires a [field]")
+        modifier = str(body.get("modifier", "none")).lower()
+        if modifier not in _FVF_MODIFIERS:
+            raise ValueError(
+                f"Illegal value for field_value_factor modifier [{modifier}]"
+            )
+        missing = body.get("missing")
+        return ScoreFunction(
+            kind=kind,
+            filter=filt,
+            weight=weight,
+            field=str(body["field"]),
+            factor=float(body.get("factor", 1.0)),
+            modifier=modifier,
+            missing=float(missing) if missing is not None else None,
+        )
+    if kind == "script_score":
+        script = body.get("script", {})
+        if isinstance(script, str):
+            script = {"source": script}
+        return ScoreFunction(
+            kind=kind,
+            filter=filt,
+            weight=weight,
+            source=str(script.get("source", "")),
+            params=dict(script.get("params", {})),
+        )
+    if kind == "random_score":
+        return ScoreFunction(
+            kind=kind, filter=filt, weight=weight,
+            seed=int(body.get("seed", 0)),
+        )
+    # decay family: {"gauss": {"<field>": {origin, scale, offset, decay}}}
+    decay_body = dict(body)
+    if len(decay_body) != 1:
+        raise ValueError(
+            f"[{kind}] expects exactly one field, got {sorted(decay_body)}"
+        )
+    fname, dspec = next(iter(decay_body.items()))
+    if "scale" not in dspec:
+        raise ValueError(f"[{kind}] on [{fname}] requires [scale]")
+    return ScoreFunction(
+        kind=kind,
+        filter=filt,
+        weight=weight,
+        field=str(fname),
+        origin=float(dspec.get("origin", 0.0)),
+        scale=float(dspec["scale"]),
+        offset=float(dspec.get("offset", 0.0)),
+        decay=float(dspec.get("decay", 0.5)),
+    )
+
+
+def _parse_function_score(spec: dict) -> FunctionScoreQuery:
+    spec = dict(spec)
+    boost = _pop_boost(spec)
+    child = (
+        parse_query(spec["query"]) if "query" in spec else MatchAllQuery()
+    )
+    functions = [_parse_one_function(e) for e in spec.get("functions", [])]
+    # Single-function shorthand at the top level.
+    shorthand = {k: v for k, v in spec.items() if k in _FN_KINDS}
+    if shorthand and functions:
+        raise ValueError(
+            "failed to parse [function_score]: use [functions] or a single "
+            "inline function, not both"
+        )
+    if shorthand:
+        # A bare top-level weight is itself in _FN_KINDS, so this branch
+        # also covers the weight-only shorthand.
+        functions = [_parse_one_function(dict(shorthand))]
+    score_mode = str(spec.get("score_mode", "multiply")).lower()
+    boost_mode = str(spec.get("boost_mode", "multiply")).lower()
+    if score_mode not in ("multiply", "sum", "avg", "first", "max", "min"):
+        raise ValueError(f"illegal score_mode [{score_mode}]")
+    if boost_mode not in ("multiply", "replace", "sum", "avg", "max", "min"):
+        raise ValueError(f"illegal boost_mode [{boost_mode}]")
+    min_score = spec.get("min_score")
+    return FunctionScoreQuery(
+        query=child,
+        functions=functions,
+        score_mode=score_mode,
+        boost_mode=boost_mode,
+        max_boost=float(spec.get("max_boost", 3.4028235e38)),
+        min_score=float(min_score) if min_score is not None else None,
+        boost=boost,
+    )
 
 
 def _single_field(kind: str, spec: dict) -> tuple[str, Any]:

@@ -139,6 +139,10 @@ class OracleSearcher:
             return self._bool(q)
         if isinstance(q, ScriptScoreQuery):
             return self._script_score(q)
+        from ..query.dsl import FunctionScoreQuery
+
+        if isinstance(q, FunctionScoreQuery):
+            return self._function_score(q)
         if isinstance(q, MatchPhraseQuery):
             return self._phrase(q)
         if isinstance(q, MatchPhrasePrefixQuery):
@@ -368,6 +372,59 @@ class OracleSearcher:
             matched = matched & (scores >= np.float32(q.min_score))
             scores = np.where(matched, scores, np.float32(0.0))
         return scores.astype(np.float32), matched
+
+    def _function_score(self, q):
+        """function_score via the SAME shared math as the device kernel
+        (query/functions.py), fed numpy arrays — fp32 parity by
+        construction."""
+        from ..query.functions import (
+            combine_function_score,
+            eval_function,
+            lower_function,
+        )
+
+        n = self.segment.num_docs
+        child_scores, matched = self._eval(q.query)
+        columns = {
+            name: col.astype(np.float32)
+            for name, col in self.segment.doc_values.items()
+        }
+        values, applies, weights = [], [], []
+        for fs in q.functions:
+            fspec, farrays = lower_function(fs, lambda name: name in columns)
+            values.append(
+                eval_function(
+                    np,
+                    fspec,
+                    farrays,
+                    num_docs=n,
+                    column=lambda name: columns.get(name),
+                    child_scores=child_scores,
+                    doc_values=columns,
+                    vectors=self.segment.vectors,
+                )
+            )
+            if fs.filter is None:
+                applies.append(matched)
+            else:
+                _, fil_matched = self._eval(fs.filter)
+                applies.append(matched & fil_matched)
+            weights.append(farrays["weight"])
+        return combine_function_score(
+            np,
+            child_scores=child_scores,
+            matched=matched,
+            values=values,
+            applies=applies,
+            weights=weights,
+            score_mode=q.score_mode,
+            boost_mode=q.boost_mode,
+            max_boost=np.float32(q.max_boost),
+            boost=np.float32(q.boost),
+            min_score=(
+                np.float32(q.min_score) if q.min_score is not None else None
+            ),
+        )
 
     def _match(self, q: MatchQuery):
         if q.analyzer:

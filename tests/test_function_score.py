@@ -1,0 +1,349 @@
+"""function_score: device ↔ oracle parity, ES semantics, REST shapes.
+
+Reference: index/query/functionscore/FunctionScoreQueryBuilder.java:45 and
+the function implementations in common/lucene/search/function/.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.query.dsl import parse_query
+from elasticsearch_tpu.rest.server import RestServer
+
+from test_device_parity import assert_parity, build_corpus, run_both
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    from elasticsearch_tpu.index.tiles import pack_segment
+    from elasticsearch_tpu.ops import bm25_device
+    from elasticsearch_tpu.query.compile import Compiler
+    from elasticsearch_tpu.search.oracle import OracleSearcher
+
+    rng = np.random.default_rng(23)
+    mappings, segment = build_corpus(rng, 400, seed_fields=False)
+    dev = pack_segment(segment)
+    seg_tree = bm25_device.segment_tree(dev)
+    compiler = Compiler(dev.fields, dev.doc_values, mappings)
+    oracle = OracleSearcher(segment, mappings)
+    return mappings, segment, dev, seg_tree, compiler, oracle
+
+
+def fs(body):
+    return {"function_score": body}
+
+
+class TestParity:
+    def test_weight_only(self, corpus):
+        assert_parity(
+            corpus,
+            fs({"query": {"match": {"body": "alpha bravo"}}, "weight": 2.5}),
+        )
+
+    def test_field_value_factor(self, corpus):
+        assert_parity(
+            corpus,
+            fs(
+                {
+                    "query": {"match": {"body": "alpha"}},
+                    "field_value_factor": {
+                        "field": "rank",
+                        "factor": 1.2,
+                        "modifier": "log1p",
+                        "missing": 1,
+                    },
+                }
+            ),
+        )
+
+    @pytest.mark.parametrize(
+        "modifier",
+        ["none", "log1p", "log2p", "ln1p", "ln2p", "square", "sqrt"],
+    )
+    def test_fvf_modifiers(self, corpus, modifier):
+        assert_parity(
+            corpus,
+            fs(
+                {
+                    "query": {"match": {"title": "charlie"}},
+                    "field_value_factor": {
+                        "field": "rank",
+                        "modifier": modifier,
+                        "missing": 2,
+                    },
+                    "boost_mode": "sum",
+                }
+            ),
+        )
+
+    @pytest.mark.parametrize(
+        "score_mode", ["multiply", "sum", "avg", "first", "max", "min"]
+    )
+    def test_score_modes_with_filters(self, corpus, score_mode):
+        assert_parity(
+            corpus,
+            fs(
+                {
+                    "query": {"match": {"body": "alpha bravo charlie"}},
+                    "functions": [
+                        {
+                            "filter": {"term": {"tag": "red"}},
+                            "weight": 3.0,
+                        },
+                        {
+                            "filter": {"range": {"rank": {"gte": 500}}},
+                            "field_value_factor": {
+                                "field": "rank",
+                                "modifier": "sqrt",
+                                "missing": 1,
+                            },
+                            "weight": 0.5,
+                        },
+                        {"weight": 1.7},
+                    ],
+                    "score_mode": score_mode,
+                }
+            ),
+        )
+
+    @pytest.mark.parametrize(
+        "boost_mode", ["multiply", "replace", "sum", "avg", "max", "min"]
+    )
+    def test_boost_modes(self, corpus, boost_mode):
+        assert_parity(
+            corpus,
+            fs(
+                {
+                    "query": {"match": {"body": "delta echo"}},
+                    "field_value_factor": {
+                        "field": "rank",
+                        "modifier": "ln2p",
+                        "missing": 1,
+                    },
+                    "boost_mode": boost_mode,
+                }
+            ),
+        )
+
+    @pytest.mark.parametrize("kind", ["gauss", "exp", "linear"])
+    def test_decay_functions(self, corpus, kind):
+        assert_parity(
+            corpus,
+            fs(
+                {
+                    "query": {"match": {"body": "alpha"}},
+                    kind: {
+                        "rank": {
+                            "origin": 500,
+                            "scale": 200,
+                            "offset": 50,
+                            "decay": 0.33,
+                        }
+                    },
+                    "boost_mode": "multiply",
+                }
+            ),
+        )
+
+    def test_random_score_deterministic_and_uniform(self, corpus):
+        body = fs(
+            {
+                "query": {"match_all": {}},
+                "random_score": {"seed": 42},
+                "boost_mode": "replace",
+            }
+        )
+        (d_scores, d_ids, _), (o_scores, o_ids, _) = run_both(corpus, body)
+        np.testing.assert_array_equal(d_ids, o_ids)
+        np.testing.assert_allclose(d_scores, o_scores, rtol=1e-6)
+        assert 0.0 <= float(d_scores.max()) < 1.0
+        # Different seed -> different ordering.
+        body2 = fs(
+            {
+                "query": {"match_all": {}},
+                "random_score": {"seed": 7},
+                "boost_mode": "replace",
+            }
+        )
+        (_, d_ids2, _), _ = run_both(corpus, body2)
+        assert list(d_ids2) != list(d_ids)
+
+    def test_max_boost_and_min_score(self, corpus):
+        assert_parity(
+            corpus,
+            fs(
+                {
+                    "query": {"match": {"body": "alpha bravo"}},
+                    "field_value_factor": {
+                        "field": "rank",
+                        "missing": 1,
+                    },
+                    "max_boost": 10.0,
+                    "min_score": 5.0,
+                    "boost_mode": "multiply",
+                }
+            ),
+        )
+
+    def test_script_score_function(self, corpus):
+        assert_parity(
+            corpus,
+            fs(
+                {
+                    "query": {"match": {"body": "alpha"}},
+                    "functions": [
+                        {
+                            "script_score": {
+                                "script": {
+                                    "source": "_score * 2.0 + params.bump",
+                                    "params": {"bump": 3.0},
+                                }
+                            }
+                        }
+                    ],
+                    "boost_mode": "replace",
+                }
+            ),
+        )
+
+    def test_no_functions_neutral(self, corpus):
+        # No functions: factor 1, score unchanged (modulo boost).
+        assert_parity(
+            corpus, fs({"query": {"match": {"body": "alpha"}}, "boost": 2.0})
+        )
+
+    def test_nested_inside_bool(self, corpus):
+        assert_parity(
+            corpus,
+            {
+                "bool": {
+                    "must": [
+                        fs(
+                            {
+                                "query": {"match": {"body": "alpha"}},
+                                "weight": 2.0,
+                            }
+                        )
+                    ],
+                    "filter": [{"exists": {"field": "rank"}}],
+                }
+            },
+        )
+
+
+class TestParseErrors:
+    def test_two_functions_in_one_entry(self):
+        with pytest.raises(ValueError, match="at most one score function"):
+            parse_query(
+                fs(
+                    {
+                        "functions": [
+                            {
+                                "weight": 1,
+                                "field_value_factor": {"field": "r"},
+                                "random_score": {},
+                            }
+                        ]
+                    }
+                )
+            )
+
+    def test_bad_modifier(self):
+        with pytest.raises(ValueError, match="modifier"):
+            parse_query(
+                fs(
+                    {
+                        "field_value_factor": {
+                            "field": "rank",
+                            "modifier": "cube",
+                        }
+                    }
+                )
+            )
+
+    def test_bad_score_mode(self):
+        with pytest.raises(ValueError, match="score_mode"):
+            parse_query(fs({"weight": 2, "score_mode": "median"}))
+
+    def test_decay_requires_scale(self):
+        with pytest.raises(ValueError, match="scale"):
+            parse_query(fs({"gauss": {"rank": {"origin": 0}}}))
+
+    def test_empty_function_entry(self):
+        with pytest.raises(ValueError, match="function or a weight"):
+            parse_query(fs({"functions": [{}]}))
+
+
+class TestRest:
+    def test_end_to_end_and_error_shape(self):
+        rest = RestServer()
+        status, _ = rest.dispatch(
+            "PUT",
+            "/fsx",
+            {},
+            json.dumps(
+                {
+                    "mappings": {
+                        "properties": {
+                            "body": {"type": "text"},
+                            "rank": {"type": "long"},
+                        }
+                    }
+                }
+            ),
+        )
+        assert status == 200
+        lines = []
+        for i in range(30):
+            lines.append(json.dumps({"index": {"_id": f"f{i}"}}))
+            lines.append(
+                json.dumps({"body": "quick brown fox", "rank": i * 10})
+            )
+        status, resp = rest.dispatch(
+            "POST", "/fsx/_bulk", {"refresh": "true"}, "\n".join(lines)
+        )
+        assert status == 200 and not resp["errors"]
+        status, resp = rest.dispatch(
+            "POST",
+            "/fsx/_search",
+            {},
+            json.dumps(
+                {
+                    "query": fs(
+                        {
+                            "query": {"match": {"body": "fox"}},
+                            "field_value_factor": {
+                                "field": "rank",
+                                "missing": 0,
+                            },
+                            "boost_mode": "replace",
+                        }
+                    ),
+                    "size": 3,
+                }
+            ),
+        )
+        assert status == 200
+        ids = [h["_id"] for h in resp["hits"]["hits"]]
+        assert ids == ["f29", "f28", "f27"]  # highest rank wins
+        # ES-shaped 400 on a bad body.
+        status, resp = rest.dispatch(
+            "POST",
+            "/fsx/_search",
+            {},
+            json.dumps(
+                {"query": fs({"weight": 1, "boost_mode": "sideways"})}
+            ),
+        )
+        assert status == 400
+        # The node wraps search-body errors the way ES does: a 400 whose
+        # top-level type is the search wrapper exception.
+        assert resp["error"]["type"] == "search_phase_execution_exception"
+        assert "boost_mode" in resp["error"]["reason"]
+
+
+def test_fvf_requires_field():
+    with pytest.raises(ValueError, match="field"):
+        parse_query(fs({"field_value_factor": {"factor": 2.0}}))
