@@ -1001,7 +1001,10 @@ class Node:
 
                 handles = [h for snap in ctx.snapshots for h in snap]
                 _, aggregations = Aggregator(
-                    svc.engines[0], request.aggs, handles=handles
+                    svc.engines[0],
+                    request.aggs,
+                    handles=handles,
+                    index_name=svc.name,
                 ).run(request.query, stats=ctx.stats, task=task)
             with ctx.lock:
                 page = coord.scroll_page(ctx, task=task)

@@ -46,11 +46,14 @@ from .bm25_device import _eval_node
 
 # ---------------------------------------------------------------------------
 # Agg spec (static, hashable):
-#   ("metric", field)                        — count/sum/min/max/sumsq in one
+#   ("matched",)                             — context mask; host finishes
+#       (f64-exact metrics/percentiles/composite/numeric fallbacks)
+#   ("hits_planes",)                         — context mask + query scores
 #   ("cardinality_terms", field, TP)         — distinct keyword values
-#   ("terms", field, TP, (sub_metric_fields...))
-#   ("histogram", field, NB, (sub_metric_fields...))
-#   ("range", field, R, (sub_metric_fields...))
+#   ("terms", field, TP, (sub_metric_fields...)[, "mask"])
+#   ("histogram", field, NB, (sub_metric_fields...)[, "mask"])
+#   ("range", field, R, (sub_metric_fields...)[, "mask"])
+#       trailing "mask" flag: also return the context mask (top_hits subs)
 #   ("filter", query_spec, (sub_specs...))   — mask & recurse
 #   ("filters", (query_specs...), (sub_specs...))
 #   ("global", (sub_specs...))               — ignore query mask
@@ -83,28 +86,6 @@ def agg_segment_tree(device_segment) -> dict[str, Any]:
         if f.ord_terms is not None
     }
     return tree
-
-
-def _metric_planes(col, matched):
-    """Masked (count, sum, min, max, sumsq) over one doc-values column.
-
-    Docs without a value (NaN) never count — ES metric aggregators skip
-    docs missing the field (ValuesSource.Numeric semantics).
-    """
-    has = matched & ~jnp.isnan(col)
-    v = jnp.where(has, col, jnp.float32(0.0))
-    count = jnp.sum(has, dtype=jnp.int32)
-    total = jnp.sum(v, dtype=jnp.float32)
-    vmin = jnp.min(jnp.where(has, col, F32_MAX))
-    vmax = jnp.max(jnp.where(has, col, -F32_MAX))
-    sumsq = jnp.sum(v * v, dtype=jnp.float32)
-    return {
-        "count": count,
-        "sum": total,
-        "min": vmin,
-        "max": vmax,
-        "sumsq": sumsq,
-    }
 
 
 def _bucket_metric_planes(col, contrib_mask, bucket_idx, nb):
@@ -142,29 +123,29 @@ def _terms_postings(seg, field_name):
 
 def _eval_agg(spec, arrays, seg, matched, scores, num_docs):
     kind = spec[0]
-    if kind == "metric":
-        col = seg["doc_values"][spec[1]]
-        return _metric_planes(col, matched)
-    if kind == "empty_metric":
-        # Field has no column in this segment: zero contribution, same
-        # plane shape as a real metric so the host merge is uniform.
-        return {
-            "count": jnp.int32(0),
-            "sum": jnp.float32(0.0),
-            "min": F32_MAX,
-            "max": -F32_MAX,
-            "sumsq": jnp.float32(0.0),
-        }
     if kind == "empty_buckets":
         # Histogram/range over a column absent from this segment: zero
-        # counts shaped like the segments that do carry the column.
-        return {"counts": jnp.zeros(spec[1], dtype=jnp.int32)}
+        # counts shaped like the segments that do carry the column. The
+        # optional trailing "mask" flag (top_hits subs) still reports the
+        # context mask so bucket hit selection sees this segment.
+        out = {"counts": jnp.zeros(spec[1], dtype=jnp.int32)}
+        if len(spec) > 2:
+            out["ctx_mask"] = matched
+        return out
     if kind == "matched":
         # Host-fallback aggregations (exact numeric cardinality, numeric
-        # terms) fetch the dense eligible mask and finish on the host from
-        # the segment's float64 columns — the TPU analog of the reference
-        # falling back from global ordinals to per-value collection.
+        # terms, f64-exact metrics/percentiles, composite) fetch the dense
+        # eligible mask and finish on the host from the segment's float64
+        # columns — the TPU analog of the reference falling back from
+        # global ordinals to per-value collection, and the f64 reduce the
+        # f32 device planes can't provide (InternalSum.java:22 reduces in
+        # double).
         return {"mask": matched}
+    if kind == "hits_planes":
+        # top_hits support: the context's matched mask plus the main
+        # query's per-doc scores; the host selects each rendered bucket's
+        # top docs from these planes (TopHitsAggregationBuilder.java:51).
+        return {"mask": matched, "scores": scores}
     if kind == "top_metric_score":
         any_match = jnp.any(matched)
         mx = jnp.max(jnp.where(matched, scores, -F32_MAX))
@@ -178,7 +159,8 @@ def _eval_agg(spec, arrays, seg, matched, scores, num_docs):
         seen = jnp.zeros(tp + 1, dtype=bool).at[idx].max(m)[:tp]
         return {"distinct": jnp.sum(seen, dtype=jnp.int32)}
     if kind == "terms":
-        _, field_name, tp, sub_fields = spec
+        field_name, tp, sub_fields = spec[1], spec[2], spec[3]
+        want_mask = len(spec) > 4  # top_hits subs need the context mask
         docs, ords = _terms_postings(seg, field_name)
         m_ext = jnp.concatenate([matched, jnp.zeros(1, dtype=bool)])
         m = m_ext[jnp.minimum(docs, num_docs)]
@@ -187,6 +169,8 @@ def _eval_agg(spec, arrays, seg, matched, scores, num_docs):
             jnp.zeros(tp + 1, dtype=jnp.int32).at[idx].add(m.astype(jnp.int32))
         )[:tp]
         out = {"counts": counts}
+        if want_mask:
+            out["ctx_mask"] = matched
         if sub_fields:
             safe_docs = jnp.minimum(docs, num_docs - 1)
             subs = {}
@@ -196,7 +180,8 @@ def _eval_agg(spec, arrays, seg, matched, scores, num_docs):
             out["subs"] = subs
         return out
     if kind == "histogram":
-        _, field_name, nb, sub_fields = spec
+        field_name, nb, sub_fields = spec[1], spec[2], spec[3]
+        want_mask = len(spec) > 4
         col = seg["doc_values"][field_name]
         has = matched & ~jnp.isnan(col)
         rel = jnp.floor(
@@ -210,6 +195,8 @@ def _eval_agg(spec, arrays, seg, matched, scores, num_docs):
             .add((bidx < nb).astype(jnp.int32))
         )[:nb]
         out = {"counts": counts}
+        if want_mask:
+            out["ctx_mask"] = matched
         if sub_fields:
             subs = {}
             for f in sub_fields:
@@ -219,7 +206,8 @@ def _eval_agg(spec, arrays, seg, matched, scores, num_docs):
             out["subs"] = subs
         return out
     if kind == "range":
-        _, field_name, r, sub_fields = spec
+        field_name, r, sub_fields = spec[1], spec[2], spec[3]
+        want_mask = len(spec) > 4
         col = seg["doc_values"][field_name]
         has = matched & ~jnp.isnan(col)
         # [R, N] membership: ES range buckets are from-inclusive,
@@ -231,6 +219,8 @@ def _eval_agg(spec, arrays, seg, matched, scores, num_docs):
         )
         counts = jnp.sum(in_r, axis=1, dtype=jnp.int32)
         out = {"counts": counts}
+        if want_mask:
+            out["ctx_mask"] = matched
         if sub_fields:
             subs = {}
             for f in sub_fields:

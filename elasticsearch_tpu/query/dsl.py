@@ -508,6 +508,11 @@ def _parse_one_function(entry: dict) -> ScoreFunction:
         return ScoreFunction(kind="weight", filter=filt, weight=weight)
     kind = kinds[0]
     body = entry[kind] or {}
+    if not isinstance(body, dict):
+        raise ValueError(
+            f"failed to parse [function_score]: [{kind}] body must be an "
+            f"object, got {type(body).__name__}"
+        )
     if kind == "field_value_factor":
         if "field" not in body:
             raise ValueError("[field_value_factor] requires a [field]")
@@ -549,6 +554,10 @@ def _parse_one_function(entry: dict) -> ScoreFunction:
             f"[{kind}] expects exactly one field, got {sorted(decay_body)}"
         )
     fname, dspec = next(iter(decay_body.items()))
+    if not isinstance(dspec, dict):
+        raise ValueError(
+            f"[{kind}] on [{fname}] must be an object with origin/scale"
+        )
     if "scale" not in dspec:
         raise ValueError(f"[{kind}] on [{fname}] requires [scale]")
     return ScoreFunction(

@@ -35,9 +35,15 @@ from typing import Any
 import numpy as np
 
 METRIC_KINDS = {"min", "max", "sum", "avg", "value_count", "stats"}
+# Metric-like kinds computed on the host from the device matched mask and
+# the float64 columns (f64-exact reduce; InternalSum.java:22 reduces in
+# double) — they nest under filter-type parents like any metric.
+HOST_METRIC_KINDS = {"percentiles", "percentile_ranks", "extended_stats"}
 BUCKET_METRIC_HOSTS = {"terms", "histogram", "date_histogram", "range"}
 NESTING_KINDS = {"filter", "filters", "global", "missing"}
 MAX_BUCKETS = 65536  # ES search.max_buckets default
+# ES default percents for the percentiles aggregation.
+DEFAULT_PERCENTS = (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)
 
 # Calendar/fixed interval units in milliseconds (fixed-width ones; month+
 # use host-computed edges). ES treats day as fixed 86400000 ms in UTC.
@@ -110,30 +116,129 @@ def _validate(node: AggNode) -> None:
     k = node.kind
     known = (
         METRIC_KINDS
+        | HOST_METRIC_KINDS
         | BUCKET_METRIC_HOSTS
         | NESTING_KINDS
-        | {"cardinality"}
+        | {"cardinality", "top_hits", "composite"}
     )
     if k not in known:
         raise AggParsingError(f"unknown aggregation type [{k}]")
-    if k in METRIC_KINDS | {"cardinality"} and node.subs:
+    if (
+        k in METRIC_KINDS | HOST_METRIC_KINDS | {"cardinality", "top_hits"}
+        and node.subs
+    ):
         raise AggParsingError(
             f"metric aggregation [{node.name}] cannot hold sub-aggregations"
         )
     if k in BUCKET_METRIC_HOSTS:
         for sub in node.subs:
-            if sub.kind not in METRIC_KINDS:
+            if sub.kind not in METRIC_KINDS | {"top_hits"}:
                 raise AggParsingError(
-                    f"[{node.name}] supports metric sub-aggregations only; "
-                    f"[{sub.name}] is [{sub.kind}] (wrap it in a filter "
-                    f"aggregation for bucket-in-bucket nesting)"
+                    f"[{node.name}] supports metric and top_hits "
+                    f"sub-aggregations only; [{sub.name}] is [{sub.kind}] "
+                    f"(wrap it in a filter aggregation for bucket-in-bucket "
+                    f"nesting)"
                 )
+    if k == "composite":
+        _validate_composite(node)
+    for sub in node.subs:
+        if sub.kind == "composite":
+            raise AggParsingError(
+                "[composite] aggregation cannot be used with a parent "
+                "aggregation"
+            )
     if k != "global" and k != "filters" and k != "filter":
-        if k in METRIC_KINDS | {"cardinality", "missing"} | BUCKET_METRIC_HOSTS:
+        if (
+            k
+            in METRIC_KINDS
+            | HOST_METRIC_KINDS
+            | {"cardinality", "missing"}
+            | BUCKET_METRIC_HOSTS
+        ):
             if "field" not in node.params:
                 raise AggParsingError(
                     f"aggregation [{node.name}] of type [{k}] requires [field]"
                 )
+    if k == "percentile_ranks" and not node.params.get("values"):
+        raise AggParsingError(
+            f"percentile_ranks [{node.name}] requires [values]"
+        )
+
+
+def _validate_composite(node: AggNode) -> None:
+    """Normalize composite sources into node.params['_sources']:
+    (name, kind, field, order, interval, offset) tuples."""
+    raw = node.params.get("sources")
+    if not isinstance(raw, list) or not raw:
+        raise AggParsingError(
+            f"composite [{node.name}] requires a non-empty [sources] array"
+        )
+    parsed = []
+    for entry in raw:
+        if not isinstance(entry, dict) or len(entry) != 1:
+            raise AggParsingError(
+                "each composite source must be an object with exactly one "
+                "named source"
+            )
+        ((name, body),) = entry.items()
+        if not isinstance(body, dict) or len(body) != 1:
+            raise AggParsingError(
+                f"composite source [{name}] must define exactly one type"
+            )
+        ((skind, sparams),) = body.items()
+        if skind not in ("terms", "histogram", "date_histogram"):
+            raise AggParsingError(
+                f"unknown composite source type [{skind}] in [{name}]"
+            )
+        field = sparams.get("field")
+        if field is None:
+            raise AggParsingError(
+                f"composite source [{name}] requires [field]"
+            )
+        order = str(sparams.get("order", "asc")).lower()
+        if order not in ("asc", "desc"):
+            raise AggParsingError(
+                f"composite source [{name}] order must be asc or desc"
+            )
+        interval = None
+        offset = float(sparams.get("offset", 0.0))
+        if skind == "histogram":
+            interval = float(sparams.get("interval", 0.0))
+            if interval <= 0:
+                raise AggParsingError(
+                    f"composite histogram source [{name}] requires a "
+                    f"positive [interval]"
+                )
+        elif skind == "date_histogram":
+            unit = sparams.get("calendar_interval") or sparams.get(
+                "fixed_interval"
+            )
+            if unit is None:
+                raise AggParsingError(
+                    f"composite date_histogram source [{name}] requires "
+                    f"[fixed_interval] or [calendar_interval]"
+                )
+            unit = str(unit)
+            if unit in _FIXED_UNIT_MS:
+                interval = _FIXED_UNIT_MS[unit]
+            else:
+                import re as _re
+
+                m = _re.fullmatch(r"(\d+)(ms|s|m|h|d)", unit)
+                if m is None:
+                    raise AggParsingError(
+                        f"composite date_histogram source [{name}]: only "
+                        f"fixed-width intervals are supported, got [{unit}]"
+                    )
+                interval = float(m.group(1)) * _FIXED_UNIT_MS[m.group(2)]
+        parsed.append((name, skind, str(field), order, interval, offset))
+    node.params["_sources"] = parsed
+    for sub in node.subs:
+        if sub.kind not in METRIC_KINDS:
+            raise AggParsingError(
+                f"composite [{node.name}] supports metric sub-aggregations "
+                f"only; [{sub.name}] is [{sub.kind}]"
+            )
 
 
 def _pow2(n: int, minimum: int = 1) -> int:
@@ -149,9 +254,11 @@ class Aggregator:
     segment's result arrays align for the reduce.
     """
 
-    def __init__(self, engine, nodes: list[AggNode], handles=None):
+    def __init__(self, engine, nodes: list[AggNode], handles=None,
+                 index_name: str = "index"):
         self.engine = engine
         self.nodes = nodes
+        self.index_name = index_name
         # `handles` lets the caller share one segment snapshot between the
         # agg pass and the hits pass (concurrent refresh would otherwise
         # desynchronize totals from hits).
@@ -186,12 +293,17 @@ class Aggregator:
     # ----------------------------------------------------------- compile
 
     def compile_for(self, handle, compiler) -> tuple[tuple, tuple]:
-        """(aggs_spec, aggs_arrays) for one segment."""
+        """(aggs_spec, aggs_arrays) for one segment. When any top_hits
+        rides an array-bucket host (or the root), one extra trailing
+        ("hits_planes",) spec fetches the root mask + scores."""
         specs, arrays = [], []
         for node in self.nodes:
             s, a = self._compile_node(node, handle, compiler)
             specs.append(s)
             arrays.append(a)
+        if self._has_top_hits():
+            specs.append(("hits_planes",))
+            arrays.append({})
         return tuple(specs), tuple(arrays)
 
     def _field_kind(self, handle, fname: str) -> str:
@@ -227,25 +339,65 @@ class Aggregator:
     def _sub_fields(self, node: AggNode, handle) -> tuple:
         """Sub-metric fields present in this segment's doc values. A field
         some docs lack simply contributes nothing from segments without it
-        (the reference's ValuesSource skips docs missing the field)."""
+        (the reference's ValuesSource skips docs missing the field).
+        top_hits subs carry no field — they ride the root hits planes."""
         out = []
-        for f in sorted({s.params["field"] for s in node.subs}):
+        for f in sorted(
+            {s.params["field"] for s in node.subs if s.kind in METRIC_KINDS}
+        ):
             self._require_numeric(f)
             if f in handle.device.doc_values:
                 out.append(f)
         return tuple(out)
 
+    def _has_top_hits(self) -> bool:
+        """True when any node needs the root (mask, scores) planes: a
+        top-level top_hits, or one nested under an array-bucket host
+        (whose per-bucket membership is recomputed host-side at render)."""
+
+        def walk(nodes):
+            for n in nodes:
+                if n.kind == "top_hits":
+                    return True
+                if n.kind in BUCKET_METRIC_HOSTS and any(
+                    s.kind == "top_hits" for s in n.subs
+                ):
+                    return True
+                if walk(n.subs):
+                    return True
+            return False
+
+        return walk(self.nodes)
+
+
+    def _want_mask(self, node: AggNode) -> tuple:
+        """("mask",) spec suffix when a top_hits sub needs the CONTEXT
+        mask back from this bucket agg (the root planes would leak docs
+        from outside a filter/missing/global parent's context)."""
+        return ("mask",) if any(
+            s.kind == "top_hits" for s in node.subs
+        ) else ()
+
     def _compile_node(self, node: AggNode, handle, compiler):
         k = node.kind
         p = node.params
-        if k in METRIC_KINDS:
-            fname = p["field"]
-            self._require_numeric(fname)
-            if fname in handle.device.doc_values:
-                return ("metric", fname), {}
-            # Field absent from this segment (or unmapped): contributes
-            # nothing; other segments may still carry values.
-            return ("empty_metric",), {}
+        if k in METRIC_KINDS | HOST_METRIC_KINDS:
+            # Metrics reduce on the HOST in float64 from the device-
+            # returned matched mask and the segment's f64 columns: the
+            # reference accumulates sums/stats in double
+            # (InternalSum.java:22), which the f32 device planes cannot
+            # honor at 1M+ docs. The device still evaluates the query and
+            # every bucket scatter; per-bucket sub-metric planes stay f32
+            # on device (bucket populations are smaller) with f64 merge.
+            self._require_numeric(p["field"])
+            return ("matched",), {}
+        if k == "top_hits":
+            return ("hits_planes",), {}
+        if k == "composite":
+            for _, skind, fname, _, _, _ in p["_sources"]:
+                if skind in ("histogram", "date_histogram"):
+                    self._require_numeric(fname)
+            return ("matched",), {}
         if k == "cardinality":
             fname = p["field"]
             if self._keyword_ok(handle, fname):
@@ -263,7 +415,8 @@ class Aggregator:
             fname = p["field"]
             if self._keyword_ok(handle, fname):
                 tp = _pow2(handle.device.fields[fname].num_terms)
-                return ("terms", fname, tp, self._sub_fields(node, handle)), {}
+                spec = ("terms", fname, tp, self._sub_fields(node, handle))
+                return spec + self._want_mask(node), {}
             if self._is_text(handle, fname):
                 raise AggParsingError(
                     f"cannot run terms aggregation on field [{fname}]: text "
@@ -287,7 +440,7 @@ class Aggregator:
                 )
             self._require_numeric(fname)
             if fname not in handle.device.doc_values:
-                return ("empty_buckets", len(raw)), {}
+                return ("empty_buckets", len(raw)) + self._want_mask(node), {}
             los = np.asarray(
                 [np.float32(r.get("from", -np.inf)) for r in raw],
                 dtype=np.float32,
@@ -297,7 +450,7 @@ class Aggregator:
                 dtype=np.float32,
             )
             spec = ("range", fname, len(raw), self._sub_fields(node, handle))
-            return spec, {"los": los, "his": his}
+            return spec + self._want_mask(node), {"los": los, "his": his}
         if k == "filter":
             compiled = compiler.compile(_parse_query(p))
             sub_s, sub_a = self._compile_subs(node, handle, compiler)
@@ -348,20 +501,23 @@ class Aggregator:
                 self._plan.setdefault("hist_edges", {})[id(node)] = edges
             else:
                 _, _, _, nb = self._fixed_hist_plan(node, interval)  # padded
-            return ("empty_buckets", max(nb, 1)), {}
+            return ("empty_buckets", max(nb, 1)) + self._want_mask(node), {}
         if edges is not None:
             # Calendar intervals (month+): host-computed bucket edges run as
             # a range aggregation; keys render from the edges.
-            sub_fields = tuple(sorted({s.params["field"] for s in node.subs}))
+            sub_fields = self._sub_fields(node, handle)
             los = np.asarray(edges[:-1], dtype=np.float32)
             his = np.asarray(edges[1:], dtype=np.float32)
             self._plan.setdefault("hist_edges", {})[id(node)] = edges
-            return ("range", fname, len(los), sub_fields), {
+            return ("range", fname, len(los), sub_fields) + self._want_mask(
+                node
+            ), {
                 "los": los,
                 "his": his,
             }
         offset, base, nb, nb_pad = self._fixed_hist_plan(node, interval)
         spec = ("histogram", fname, nb_pad, self._sub_fields(node, handle))
+        spec = spec + self._want_mask(node)
         arrays = {
             "interval": np.float32(interval),
             "offset": np.float32(offset),
@@ -492,10 +648,18 @@ class Aggregator:
             )
             total += int(tot)
             results = jax.device_get(results)
+            root_planes = None
+            if self._has_top_hits():
+                root_planes = results[-1]
+                results = results[: len(self.nodes)]
             for node, state, result in zip(self.nodes, states, results):
-                merge_segment_result(node, state, result, handle)
+                merge_segment_result(
+                    node, state, result, handle, root_planes=root_planes
+                )
         rendered = {
-            node.name: render(node, state, self.engine, self._plan)
+            node.name: render(
+                node, state, self.engine, self._plan, self.index_name
+            )
             for node, state in zip(self.nodes, states)
         }
         return total, rendered
@@ -528,16 +692,22 @@ def _parse_query(params: dict) -> Any:
 
 def new_merge_state(node: AggNode) -> dict[str, Any]:
     k = node.kind
-    if k in METRIC_KINDS:
+    if k in METRIC_KINDS | {"extended_stats"}:
         return {"count": 0, "sum": 0.0, "min": np.inf, "max": -np.inf, "sumsq": 0.0}
+    if k in ("percentiles", "percentile_ranks"):
+        return {"chunks": []}  # per-segment matched f64 value arrays
+    if k == "top_hits":
+        return {"segments": []}  # (handle, mask, scores) per segment
+    if k == "composite":
+        return {"counts": {}, "subs": {}}
     if k == "cardinality":
         return {"values": set()}
     if k == "terms":
-        return {"counts": {}, "subs": {}, "host": False}
+        return {"counts": {}, "subs": {}, "host": False, "hits_segments": []}
     if k in ("histogram", "date_histogram"):
-        return {"counts": None, "subs": {}}
+        return {"counts": None, "subs": {}, "hits_segments": []}
     if k == "range":
-        return {"counts": None, "subs": {}}
+        return {"counts": None, "subs": {}, "hits_segments": []}
     if k in ("filter", "global", "missing"):
         return {
             "doc_count": 0,
@@ -546,14 +716,6 @@ def new_merge_state(node: AggNode) -> dict[str, Any]:
     if k == "filters":
         return {"buckets": None}
     raise AggParsingError(f"unknown aggregation type [{k}]")
-
-
-def _merge_metric(state, planes):
-    state["count"] += int(planes["count"])
-    state["sum"] += float(planes["sum"])
-    state["min"] = min(state["min"], float(planes["min"]))
-    state["max"] = max(state["max"], float(planes["max"]))
-    state["sumsq"] += float(planes["sumsq"])
 
 
 def _merge_bucket_planes(tgt: dict, planes, keys):
@@ -584,11 +746,39 @@ def _host_values(result, handle, fname: str) -> np.ndarray:
     return vals[~np.isnan(vals)]
 
 
-def merge_segment_result(node: AggNode, state, result, handle) -> None:
+def merge_segment_result(
+    node: AggNode, state, result, handle, root_planes=None
+) -> None:
     """Fold one segment's device result into the cross-segment state."""
     k = node.kind
-    if k in METRIC_KINDS:
-        _merge_metric(state, result)
+    if k in METRIC_KINDS | {"extended_stats"}:
+        # f64-exact host reduce over the matched mask (the device f32 sum
+        # plane drifts user-visibly at 1M+ docs; InternalSum.java:22).
+        vals = _host_values(result, handle, node.params["field"])
+        state["count"] += len(vals)
+        if len(vals):
+            state["sum"] += float(np.sum(vals))
+            state["min"] = min(state["min"], float(np.min(vals)))
+            state["max"] = max(state["max"], float(np.max(vals)))
+            state["sumsq"] += float(np.sum(vals * vals))
+        return
+    if k in ("percentiles", "percentile_ranks"):
+        vals = _host_values(result, handle, node.params["field"])
+        if len(vals):
+            state["chunks"].append(vals)
+        return
+    if k == "top_hits":
+        n = handle.segment.num_docs
+        state["segments"].append(
+            (
+                handle,
+                np.asarray(result["mask"])[:n],
+                np.asarray(result["scores"])[:n],
+            )
+        )
+        return
+    if k == "composite":
+        _merge_composite(node, state, result, handle)
         return
     if k == "cardinality":
         fname = node.params["field"]
@@ -603,6 +793,7 @@ def merge_segment_result(node: AggNode, state, result, handle) -> None:
                 state["values"].add(float(v))
         return
     if k == "terms":
+        _capture_hits_planes(node, state, handle, result, root_planes)
         fname = node.params["field"]
         dfield = handle.device.fields.get(fname)
         if dfield is None or dfield.ord_terms is None:
@@ -625,7 +816,7 @@ def merge_segment_result(node: AggNode, state, result, handle) -> None:
         for i in nz:
             key = vocab[i]
             state["counts"][key] = state["counts"].get(key, 0) + int(counts[i])
-        if node.subs:
+        if node.subs and "subs" in result:
             keys = [
                 vocab[i] if counts[i] > 0 else None
                 for i in range(len(vocab))
@@ -640,6 +831,7 @@ def merge_segment_result(node: AggNode, state, result, handle) -> None:
                 )
         return
     if k in ("histogram", "date_histogram", "range"):
+        _capture_hits_planes(node, state, handle, result, root_planes)
         counts = np.asarray(result["counts"]).astype(np.int64)
         if state["counts"] is None:
             state["counts"] = counts.copy()
@@ -667,7 +859,10 @@ def merge_segment_result(node: AggNode, state, result, handle) -> None:
         for sub_node, sub_state, sub_result in zip(
             node.subs, state["subs"], result["subs"]
         ):
-            merge_segment_result(sub_node, sub_state, sub_result, handle)
+            merge_segment_result(
+                sub_node, sub_state, sub_result, handle,
+                root_planes=root_planes,
+            )
         return
     if k == "filters":
         if state["buckets"] is None:
@@ -683,9 +878,163 @@ def merge_segment_result(node: AggNode, state, result, handle) -> None:
             for sub_node, sub_state, sub_result in zip(
                 node.subs, bstate["subs"], bresult["subs"]
             ):
-                merge_segment_result(sub_node, sub_state, sub_result, handle)
+                merge_segment_result(
+                    sub_node, sub_state, sub_result, handle,
+                    root_planes=root_planes,
+                )
         return
     raise AggParsingError(f"unknown aggregation type [{k}]")
+
+
+def _capture_hits_planes(node, state, handle, result, root_planes) -> None:
+    """Array-bucket hosts with top_hits subs keep per-segment (context
+    mask, scores) planes; bucket membership is recomputed at render time.
+    The mask comes from THIS node's result (its spec carries the "mask"
+    flag) so a terms/histogram/range nested under a filter-type parent
+    only ever selects docs inside that parent's context; only the scores
+    plane (context-independent) rides the root hits planes."""
+    if root_planes is None or not any(
+        s.kind == "top_hits" for s in node.subs
+    ):
+        return
+    mask = result.get("ctx_mask", result.get("mask"))
+    if mask is None:
+        return
+    n = handle.segment.num_docs
+    state["hits_segments"].append(
+        (
+            handle,
+            np.asarray(mask)[:n],
+            np.asarray(root_planes["scores"])[:n],
+        )
+    )
+
+
+def _keyword_ords(handle, fname: str):
+    """(per-doc term ordinal i32[N] (-1 = none; multi-valued docs keep the
+    LAST term in term-sort order — composite sources assume single-valued
+    keywords), vocab list) — cached on the handle."""
+    cache = handle.__dict__.setdefault("_keyword_ords_cache", {})
+    got = cache.get(fname)
+    if got is not None:
+        return got
+    fld = handle.segment.fields.get(fname)
+    n = handle.segment.num_docs
+    if fld is None or fld.has_norms:
+        out = (None, [])
+    else:
+        ords = np.full(n, -1, dtype=np.int64)
+        counts = np.diff(fld.offsets).astype(np.int64)
+        per_posting = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
+        ords[fld.doc_ids] = per_posting
+        out = (ords, list(fld.terms.keys()))
+    cache[fname] = out
+    return out
+
+
+def _merge_composite(node: AggNode, state, result, handle) -> None:
+    """Fold one segment's matched docs into the composite key space.
+
+    Vectorized: each source factorizes to integer codes; np.unique over
+    the stacked code rows buckets every matched doc at once; sub-metric
+    planes group with np.add.at / minimum.at over the inverse index."""
+    mask = np.asarray(result["mask"])[: handle.segment.num_docs]
+    n = handle.segment.num_docs
+    valid = mask.copy()
+    codes = []
+    decoders = []
+    for name, skind, fname, order, interval, offset in node.params["_sources"]:
+        if skind == "terms":
+            ords, vocab = _keyword_ords(handle, fname)
+            if ords is not None:
+                valid &= ords >= 0
+                codes.append(ords)
+                decoders.append(("vocab", vocab))
+                continue
+            col = handle.segment.doc_values.get(fname)
+            if col is None:
+                valid &= False
+                codes.append(np.zeros(n, dtype=np.int64))
+                decoders.append(("values", np.zeros(0)))
+                continue
+            valid &= ~np.isnan(col)
+            uniq, inv = np.unique(
+                np.where(np.isnan(col), 0.0, col), return_inverse=True
+            )
+            codes.append(inv.astype(np.int64))
+            decoders.append(("values", uniq))
+        else:  # histogram / date_histogram (fixed intervals)
+            col = handle.segment.doc_values.get(fname)
+            if col is None:
+                valid &= False
+                codes.append(np.zeros(n, dtype=np.int64))
+                decoders.append(("values", np.zeros(0)))
+                continue
+            valid &= ~np.isnan(col)
+            keys = (
+                np.floor((np.where(np.isnan(col), 0.0, col) - offset) / interval)
+                * interval
+                + offset
+            )
+            uniq, inv = np.unique(keys, return_inverse=True)
+            codes.append(inv.astype(np.int64))
+            decoders.append(("values", uniq))
+    locs = np.flatnonzero(valid)
+    if len(locs) == 0:
+        return
+    rows = np.stack([c[locs] for c in codes], axis=1)  # [M, S]
+    uniq_rows, inv, counts = np.unique(
+        rows, axis=0, return_inverse=True, return_counts=True
+    )
+
+    def decode(row) -> tuple:
+        out = []
+        for (dkind, data), code in zip(decoders, row):
+            out.append(
+                data[int(code)] if dkind == "vocab" else float(data[int(code)])
+            )
+        return tuple(out)
+
+    keys = [decode(row) for row in uniq_rows]
+    for key, count in zip(keys, counts):
+        state["counts"][key] = state["counts"].get(key, 0) + int(count)
+    if node.subs:
+        nb = len(uniq_rows)
+        for f in sorted({s.params["field"] for s in node.subs}):
+            col = handle.segment.doc_values.get(f)
+            if col is None:
+                continue
+            v = col[locs]
+            has = ~np.isnan(v)
+            vi = inv[has]
+            vv = v[has]
+            cnt = np.zeros(nb, dtype=np.int64)
+            np.add.at(cnt, vi, 1)
+            s = np.zeros(nb, dtype=np.float64)
+            np.add.at(s, vi, vv)
+            mn = np.full(nb, np.inf)
+            np.minimum.at(mn, vi, vv)
+            mx = np.full(nb, -np.inf)
+            np.maximum.at(mx, vi, vv)
+            sq = np.zeros(nb, dtype=np.float64)
+            np.add.at(sq, vi, vv * vv)
+            tgt = state["subs"].setdefault(f, {})
+            for i, key in enumerate(keys):
+                cur = tgt.setdefault(
+                    key,
+                    {
+                        "count": 0,
+                        "sum": 0.0,
+                        "min": np.inf,
+                        "max": -np.inf,
+                        "sumsq": 0.0,
+                    },
+                )
+                cur["count"] += int(cnt[i])
+                cur["sum"] += float(s[i])
+                cur["min"] = min(cur["min"], float(mn[i]))
+                cur["max"] = max(cur["max"], float(mx[i]))
+                cur["sumsq"] += float(sq[i])
 
 
 # ---------------------------------------------------------------- render
@@ -717,6 +1066,8 @@ def _render_metric(kind: str, state) -> dict[str, Any]:
 def _sub_bucket_rendering(node: AggNode, key, sub_planes_by_field):
     out = {}
     for sub in node.subs:
+        if sub.kind == "top_hits":
+            continue  # rendered by the parent with a membership predicate
         f = sub.params["field"]
         planes = sub_planes_by_field.get(f, {}).get(
             key, {"count": 0, "sum": 0.0, "min": np.inf, "max": -np.inf}
@@ -730,6 +1081,8 @@ def _sub_bucket_rendering(node: AggNode, key, sub_planes_by_field):
 def _render_array_sub(node: AggNode, idx: int, state) -> dict[str, Any]:
     out = {}
     for sub in node.subs:
+        if sub.kind == "top_hits":
+            continue  # rendered by the parent with a membership predicate
         f = sub.params["field"]
         planes = state["subs"].get(f)
         if planes is None:
@@ -761,10 +1114,235 @@ def _iso_utc(ms: float) -> str:
     return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
 
 
-def render(node: AggNode, state, engine, plan: dict) -> dict[str, Any]:
+def _percentile_values(state) -> np.ndarray:
+    if not state["chunks"]:
+        return np.zeros(0, dtype=np.float64)
+    return np.sort(np.concatenate(state["chunks"]))
+
+
+def _render_percentiles(node: AggNode, state) -> dict[str, Any]:
+    """Exact quantiles with linear interpolation — where the reference's
+    t-digest approximates (PercentilesAggregationBuilder.java:62), the
+    host reduce over f64 columns is exact at every size (t-digest itself
+    is exact until compression kicks in, so small-data values agree)."""
+    percents = [
+        float(p) for p in node.params.get("percents", DEFAULT_PERCENTS)
+    ]
+    vals = _percentile_values(state)
+    keyed = bool(node.params.get("keyed", True))
+    out_vals: list[tuple[str, float | None]] = []
+    for p in percents:
+        if len(vals) == 0:
+            v = None
+        else:
+            v = float(np.percentile(vals, p, method="linear"))
+        out_vals.append((f"{p:g}.0" if float(p).is_integer() else f"{p:g}", v))
+    if keyed:
+        return {"values": {key: v for key, v in out_vals}}
+    return {
+        "values": [
+            {"key": float(key), "value": v} for key, v in out_vals
+        ]
+    }
+
+
+def _render_percentile_ranks(node: AggNode, state) -> dict[str, Any]:
+    values = [float(v) for v in node.params["values"]]
+    vals = _percentile_values(state)
+    keyed = bool(node.params.get("keyed", True))
+    out = {}
+    for v in values:
+        if len(vals) == 0:
+            rank = None
+        else:
+            rank = float(np.searchsorted(vals, v, side="right")) / len(vals) * 100.0
+        out[f"{v:g}.0" if float(v).is_integer() else f"{v:g}"] = rank
+    if keyed:
+        return {"values": out}
+    return {
+        "values": [{"key": float(k), "value": v} for k, v in out.items()]
+    }
+
+
+def _render_extended_stats(state) -> dict[str, Any]:
+    count = state["count"]
+    if not count:
+        return {
+            "count": 0, "min": None, "max": None, "avg": None, "sum": 0.0,
+            "sum_of_squares": None, "variance": None, "std_deviation": None,
+            "std_deviation_bounds": {"upper": None, "lower": None},
+        }
+    mean = state["sum"] / count
+    variance = max(0.0, state["sumsq"] / count - mean * mean)
+    std = float(np.sqrt(variance))
+    sigma = 2.0
+    return {
+        "count": count,
+        "min": float(state["min"]),
+        "max": float(state["max"]),
+        "avg": mean,
+        "sum": float(state["sum"]),
+        "sum_of_squares": float(state["sumsq"]),
+        "variance": variance,
+        "std_deviation": std,
+        "std_deviation_bounds": {
+            "upper": mean + sigma * std,
+            "lower": mean - sigma * std,
+        },
+    }
+
+
+def _source_filter(src, source_param):
+    if source_param is False:
+        return None
+    if source_param is True or source_param is None:
+        return src
+    wanted = (
+        [source_param] if isinstance(source_param, str) else list(source_param)
+    )
+    return {k: v for k, v in src.items() if k in set(wanted)}
+
+
+def _render_top_hits(
+    node: AggNode, segments, index_name: str, predicate=None
+) -> dict[str, Any]:
+    """Select the context's top docs by (score desc, global doc asc).
+
+    `segments` holds per-segment (handle, mask, scores) planes;
+    `predicate(handle) -> bool[N]` restricts to one bucket's members
+    (array-bucket parents recompute membership here — only rendered
+    buckets pay, the TopHitsAggregator analog without a per-bucket
+    device pass)."""
+    size = int(node.params.get("size", 3))
+    frm = int(node.params.get("from", 0))
+    want = frm + size
+    source_param = node.params.get("_source", True)
+    cands: list[tuple[float, int, Any, int]] = []
+    total = 0
+    for handle, mask, scores in segments:
+        member = mask
+        if predicate is not None:
+            member = member & predicate(handle)
+        locs = np.flatnonzero(member)
+        total += len(locs)
+        if len(locs) == 0 or want <= 0:
+            continue
+        sc = scores[locs].astype(np.float64)
+        order = np.lexsort((locs, -sc))[:want]
+        for i in order:
+            cands.append(
+                (-float(sc[i]), handle.base + int(locs[i]), handle, int(locs[i]))
+            )
+    cands.sort(key=lambda t: (t[0], t[1]))
+    page = cands[frm : frm + size]
+    max_score = -cands[0][0] if cands else None
+    hits = []
+    for neg, _gdoc, handle, local in page:
+        hit: dict[str, Any] = {
+            "_index": index_name,
+            "_id": handle.segment.ids[local],
+            "_score": -neg,
+        }
+        src = _source_filter(handle.segment.sources[local], source_param)
+        if src is not None:
+            hit["_source"] = src
+        hits.append(hit)
+    return {
+        "hits": {
+            "total": {"value": total, "relation": "eq"},
+            "max_score": max_score,
+            "hits": hits,
+        }
+    }
+
+
+def _cmp_composite(orders):
+    """Comparator over decoded composite key tuples honoring per-source
+    asc/desc (strings sort lexicographically, numbers numerically)."""
+
+    def cmp(a, b):
+        for order, va, vb in zip(orders, a, b):
+            if va == vb:
+                continue
+            lt = va < vb
+            if order == "asc":
+                return -1 if lt else 1
+            return 1 if lt else -1
+        return 0
+
+    return cmp
+
+
+def _render_composite(node: AggNode, state, engine, plan, index_name):
+    import functools
+
+    sources = node.params["_sources"]
+    orders = [s[3] for s in sources]
+    names = [s[0] for s in sources]
+    size = int(node.params.get("size", 10))
+    cmp = _cmp_composite(orders)
+    items = sorted(
+        state["counts"].items(),
+        key=functools.cmp_to_key(lambda a, b: cmp(a[0], b[0])),
+    )
+    after = node.params.get("after")
+    if after:
+        try:
+            after_key = tuple(after[name] for name in names)
+        except KeyError as e:
+            raise AggParsingError(
+                f"composite [after] is missing source {e}"
+            ) from None
+        items = [it for it in items if cmp(it[0], after_key) > 0]
+    page = items[:size]
+
+    def render_value(key_val, source):
+        _, skind, fname, _, _, _ = source
+        if isinstance(key_val, str):
+            return key_val
+        if skind in ("histogram", "date_histogram"):
+            return _key_for_field(engine, fname, key_val) if float(
+                key_val
+            ).is_integer() else float(key_val)
+        return _key_for_field(engine, fname, key_val)
+
+    buckets = []
+    for key, count in page:
+        rendered_key = {
+            name: render_value(v, src)
+            for name, v, src in zip(names, key, sources)
+        }
+        b: dict[str, Any] = {"key": rendered_key, "doc_count": count}
+        for sub in node.subs:
+            planes = state["subs"].get(sub.params["field"], {}).get(
+                key,
+                {"count": 0, "sum": 0.0, "min": np.inf, "max": -np.inf,
+                 "sumsq": 0.0},
+            )
+            b[sub.name] = _render_metric(sub.kind, planes)
+        buckets.append(b)
+    out: dict[str, Any] = {"buckets": buckets}
+    if page and len(items) > size:
+        out["after_key"] = buckets[-1]["key"]
+    return out
+
+
+def render(
+    node: AggNode, state, engine, plan: dict, index_name: str = "index"
+) -> dict[str, Any]:
     k = node.kind
     if k in METRIC_KINDS:
         return _render_metric(k, state)
+    if k == "extended_stats":
+        return _render_extended_stats(state)
+    if k == "percentiles":
+        return _render_percentiles(node, state)
+    if k == "percentile_ranks":
+        return _render_percentile_ranks(node, state)
+    if k == "top_hits":
+        return _render_top_hits(node, state["segments"], index_name)
+    if k == "composite":
+        return _render_composite(node, state, engine, plan, index_name)
     if k == "cardinality":
         return {"value": len(state["values"])}
     if k == "terms":
@@ -784,15 +1362,26 @@ def render(node: AggNode, state, engine, plan: dict) -> dict[str, Any]:
         total = sum(state["counts"].values())
         top = items[:size]
         buckets = []
+        fname = node.params["field"]
         for key, count in top:
             out_key = (
-                _key_for_field(engine, node.params["field"], key)
+                _key_for_field(engine, fname, key)
                 if state.get("host")
                 else key
             )
             b = {"key": out_key, "doc_count": count}
             if node.subs:
                 b.update(_sub_bucket_rendering(node, key, state["subs"]))
+                for sub in node.subs:
+                    if sub.kind == "top_hits":
+                        b[sub.name] = _render_top_hits(
+                            sub,
+                            state["hits_segments"],
+                            index_name,
+                            predicate=_terms_bucket_predicate(
+                                fname, key, bool(state.get("host"))
+                            ),
+                        )
             buckets.append(b)
         return {
             "doc_count_error_upper_bound": 0,  # exact: full per-segment counts
@@ -800,9 +1389,10 @@ def render(node: AggNode, state, engine, plan: dict) -> dict[str, Any]:
             "buckets": buckets,
         }
     if k in ("histogram", "date_histogram"):
-        return _render_histogram(node, state, engine, plan)
+        return _render_histogram(node, state, engine, plan, index_name)
     if k == "range":
         raw = node.params.get("ranges", [])
+        fname = node.params["field"]
         counts = state["counts"]
         buckets = []
         for i, r in enumerate(raw):
@@ -819,17 +1409,26 @@ def render(node: AggNode, state, engine, plan: dict) -> dict[str, Any]:
             b["doc_count"] = int(counts[i]) if counts is not None else 0
             if node.subs:
                 b.update(_render_array_sub(node, i, state))
+                for sub in node.subs:
+                    if sub.kind == "top_hits":
+                        b[sub.name] = _render_top_hits(
+                            sub,
+                            state["hits_segments"],
+                            index_name,
+                            predicate=_value_range_predicate(
+                                fname,
+                                float(frm) if frm is not None else -np.inf,
+                                float(to) if to is not None else np.inf,
+                            ),
+                        )
             buckets.append(b)
         return {"buckets": buckets}
-    if k == "filter" or k == "missing":
+    if k == "filter" or k == "missing" or k == "global":
         out = {"doc_count": state["doc_count"]}
         for sub_node, sub_state in zip(node.subs, state["subs"]):
-            out[sub_node.name] = render(sub_node, sub_state, engine, plan)
-        return out
-    if k == "global":
-        out = {"doc_count": state["doc_count"]}
-        for sub_node, sub_state in zip(node.subs, state["subs"]):
-            out[sub_node.name] = render(sub_node, sub_state, engine, plan)
+            out[sub_node.name] = render(
+                sub_node, sub_state, engine, plan, index_name
+            )
         return out
     if k == "filters":
         keys, queries = _filters_defs(node)
@@ -843,7 +1442,9 @@ def render(node: AggNode, state, engine, plan: dict) -> dict[str, Any]:
         for bstate in bucket_states:
             out = {"doc_count": bstate["doc_count"]}
             for sub_node, sub_state in zip(node.subs, bstate["subs"]):
-                out[sub_node.name] = render(sub_node, sub_state, engine, plan)
+                out[sub_node.name] = render(
+                    sub_node, sub_state, engine, plan, index_name
+                )
             rendered.append(out)
         if keys is not None:
             return {"buckets": dict(zip(keys, rendered))}
@@ -855,7 +1456,47 @@ def _fmt_edge(v) -> str:
     return "*" if v is None else str(float(v))
 
 
-def _render_histogram(node: AggNode, state, engine, plan) -> dict[str, Any]:
+def _terms_bucket_predicate(fname: str, key, host_numeric: bool):
+    """Membership mask for one terms bucket (top_hits rendering)."""
+    if host_numeric:
+
+        def pred(handle):
+            col = handle.segment.doc_values.get(fname)
+            if col is None:
+                return np.zeros(handle.segment.num_docs, dtype=bool)
+            with np.errstate(invalid="ignore"):
+                return col == key
+
+        return pred
+
+    def pred(handle):
+        member = np.zeros(handle.segment.num_docs, dtype=bool)
+        fld = handle.segment.fields.get(fname)
+        if fld is not None:
+            docs, _ = fld.postings(key)
+            member[docs] = True
+        return member
+
+    return pred
+
+
+def _value_range_predicate(fname: str, lo: float, hi: float):
+    """Membership mask for a [lo, hi) value window (histogram/range
+    top_hits rendering); NaN (missing) never matches."""
+
+    def pred(handle):
+        col = handle.segment.doc_values.get(fname)
+        if col is None:
+            return np.zeros(handle.segment.num_docs, dtype=bool)
+        with np.errstate(invalid="ignore"):
+            return (col >= lo) & (col < hi)
+
+    return pred
+
+
+def _render_histogram(
+    node: AggNode, state, engine, plan, index_name: str = "index"
+) -> dict[str, Any]:
     fname = node.params["field"]
     min_doc_count = int(node.params.get("min_doc_count", 0))
     is_date = node.kind == "date_histogram"
@@ -898,5 +1539,19 @@ def _render_histogram(node: AggNode, state, engine, plan) -> dict[str, Any]:
         b["doc_count"] = count
         if node.subs:
             b.update(_render_array_sub(node, idx, state))
+            for sub in node.subs:
+                if sub.kind == "top_hits":
+                    if edges is not None:
+                        lo, hi = edges[idx], edges[idx + 1]
+                    else:
+                        lo, hi = key, key + interval
+                    b[sub.name] = _render_top_hits(
+                        sub,
+                        state["hits_segments"],
+                        index_name,
+                        predicate=_value_range_predicate(
+                            fname, float(lo), float(hi)
+                        ),
+                    )
         out.append(b)
     return {"buckets": out}

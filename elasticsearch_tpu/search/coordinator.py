@@ -131,7 +131,10 @@ class ShardedSearchCoordinator:
 
             handles = [h for snap in snapshots for h in snap]
             agg_total, aggregations = Aggregator(
-                self.engines[0], request.aggs, handles=handles
+                self.engines[0],
+                request.aggs,
+                handles=handles,
+                index_name=self.index_name,
             ).run(request.query, stats=stats, task=task)
 
         # Fetch subphases (highlight/docvalue_fields/fields) are stripped

@@ -361,7 +361,10 @@ class SearchService:
             from .aggs import Aggregator
 
             agg_total, aggregations = Aggregator(
-                self.engine, request.aggs, handles=segments
+                self.engine,
+                request.aggs,
+                handles=segments,
+                index_name=self.index_name,
             ).run(request.query, stats=stats, task=task)
 
         # Candidate tuples: (merge_key, global_doc, handle, local, score,

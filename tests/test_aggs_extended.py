@@ -1,0 +1,551 @@
+"""percentiles / percentile_ranks / extended_stats / top_hits / composite
+aggregations + f64-exact metric accumulation (VERDICT r4 items 6 and 8).
+
+References: search/aggregations/metrics/PercentilesAggregationBuilder.java:62,
+TopHitsAggregationBuilder.java:51, bucket/composite/
+CompositeAggregationBuilder.java:35, metrics/InternalSum.java:22 (double
+accumulation).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.rest.server import RestServer
+
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "rank": {"type": "long"},
+        "price": {"type": "double"},
+    }
+}
+
+
+@pytest.fixture(scope="module")
+def rest():
+    rest = RestServer()
+    status, _ = rest.dispatch(
+        "PUT", "/agx", {}, json.dumps({"mappings": MAPPINGS})
+    )
+    assert status == 200
+    rng = np.random.default_rng(5)
+    lines = []
+    rows = []
+    for i in range(500):
+        r = int(rng.integers(0, 1000))
+        p = round(float(rng.uniform(0, 100)), 2)
+        t = ["x", "y", "z"][i % 3]
+        rows.append((r, p, t))
+        lines.append(json.dumps({"index": {"_id": f"d{i}"}}))
+        lines.append(
+            json.dumps({"body": "alpha beta", "tag": t, "rank": r, "price": p})
+        )
+    status, resp = rest.dispatch(
+        "POST", "/agx/_bulk", {"refresh": "true"}, "\n".join(lines)
+    )
+    assert status == 200 and not resp["errors"]
+    rest.rows = rows
+    return rest
+
+
+def search(rest, body, index="agx"):
+    status, resp = rest.dispatch(
+        "POST", f"/{index}/_search", {}, json.dumps(body)
+    )
+    assert status == 200, resp
+    return resp
+
+
+class TestF64Metrics:
+    def test_sum_matches_numpy_f64_exactly(self, rest):
+        prices = np.array([p for _, p, _ in rest.rows], dtype=np.float64)
+        resp = search(rest, {"size": 0, "aggs": {"s": {"sum": {"field": "price"}}}})
+        got = resp["aggregations"]["s"]["value"]
+        expect = float(np.sum(prices))
+        assert got == pytest.approx(expect, abs=np.spacing(expect))
+
+    def test_f32_would_drift_f64_does_not(self):
+        """Accumulating many small values: the old f32 device sum drifts
+        user-visibly; the f64 host reduce matches numpy exactly."""
+        rest = RestServer()
+        rest.dispatch(
+            "PUT", "/drift", {},
+            json.dumps({"mappings": {"properties": {"v": {"type": "double"}}}}),
+        )
+        lines = []
+        for i in range(20000):
+            lines.append(json.dumps({"index": {"_id": f"v{i}"}}))
+            lines.append(json.dumps({"v": 0.1}))
+        status, resp = rest.dispatch(
+            "POST", "/drift/_bulk", {"refresh": "true"}, "\n".join(lines)
+        )
+        assert status == 200 and not resp["errors"]
+        resp = search(rest, {"size": 0, "aggs": {"s": {"sum": {"field": "v"}}}}, "drift")
+        expect = float(np.sum(np.full(20000, 0.1, dtype=np.float64)))
+        got = resp["aggregations"]["s"]["value"]
+        assert got == pytest.approx(expect, abs=2 * np.spacing(expect))
+        # And the f32 running total would NOT be this close:
+        f32 = float(np.sum(np.full(20000, np.float32(0.1), dtype=np.float32)))
+        assert abs(f32 - expect) > 1e-4
+
+    def test_extended_stats(self, rest):
+        prices = np.array([p for _, p, _ in rest.rows], dtype=np.float64)
+        resp = search(
+            rest,
+            {"size": 0, "aggs": {"es": {"extended_stats": {"field": "price"}}}},
+        )
+        es = resp["aggregations"]["es"]
+        assert es["count"] == len(prices)
+        assert es["avg"] == pytest.approx(float(np.mean(prices)))
+        assert es["variance"] == pytest.approx(float(np.var(prices)), rel=1e-9)
+        assert es["std_deviation"] == pytest.approx(float(np.std(prices)), rel=1e-9)
+        assert es["std_deviation_bounds"]["upper"] == pytest.approx(
+            float(np.mean(prices) + 2 * np.std(prices)), rel=1e-9
+        )
+
+
+class TestPercentiles:
+    def test_default_percents_match_numpy(self, rest):
+        ranks = np.array([r for r, _, _ in rest.rows], dtype=np.float64)
+        resp = search(
+            rest, {"size": 0, "aggs": {"p": {"percentiles": {"field": "rank"}}}}
+        )
+        got = resp["aggregations"]["p"]["values"]
+        for q in (1, 5, 25, 50, 75, 95, 99):
+            assert got[f"{q}.0"] == pytest.approx(
+                float(np.percentile(ranks, q)), rel=1e-12
+            )
+
+    def test_custom_percents_and_unkeyed(self, rest):
+        resp = search(
+            rest,
+            {
+                "size": 0,
+                "aggs": {
+                    "p": {
+                        "percentiles": {
+                            "field": "rank",
+                            "percents": [50, 99.9],
+                            "keyed": False,
+                        }
+                    }
+                },
+            },
+        )
+        vals = resp["aggregations"]["p"]["values"]
+        assert [v["key"] for v in vals] == [50.0, 99.9]
+
+    def test_under_filter_agg(self, rest):
+        ranks = np.array(
+            [r for r, _, t in rest.rows if t == "x"], dtype=np.float64
+        )
+        resp = search(
+            rest,
+            {
+                "size": 0,
+                "aggs": {
+                    "only_x": {
+                        "filter": {"term": {"tag": "x"}},
+                        "aggs": {"p": {"percentiles": {"field": "rank"}}},
+                    }
+                },
+            },
+        )
+        got = resp["aggregations"]["only_x"]["p"]["values"]
+        assert got["50.0"] == pytest.approx(float(np.percentile(ranks, 50)))
+
+    def test_percentile_ranks(self, rest):
+        ranks = np.sort([r for r, _, _ in rest.rows])
+        resp = search(
+            rest,
+            {
+                "size": 0,
+                "aggs": {
+                    "pr": {
+                        "percentile_ranks": {
+                            "field": "rank",
+                            "values": [250, 750],
+                        }
+                    }
+                },
+            },
+        )
+        got = resp["aggregations"]["pr"]["values"]
+        expect = np.searchsorted(ranks, 250, side="right") / len(ranks) * 100
+        assert got["250.0"] == pytest.approx(float(expect))
+
+    def test_requires_values(self, rest):
+        status, resp = rest.dispatch(
+            "POST",
+            "/agx/_search",
+            {},
+            json.dumps(
+                {"size": 0, "aggs": {"pr": {"percentile_ranks": {"field": "rank"}}}}
+            ),
+        )
+        assert status == 400
+
+
+class TestTopHits:
+    def test_top_level(self, rest):
+        resp = search(
+            rest,
+            {
+                "size": 0,
+                "query": {"match": {"body": "alpha"}},
+                "aggs": {"th": {"top_hits": {"size": 3}}},
+            },
+        )
+        th = resp["aggregations"]["th"]["hits"]
+        assert th["total"]["value"] == 500
+        assert len(th["hits"]) == 3
+        assert th["hits"][0]["_score"] == pytest.approx(th["max_score"])
+        assert th["hits"][0]["_index"] == "agx"
+
+    def test_under_terms_with_source_filter(self, rest):
+        resp = search(
+            rest,
+            {
+                "size": 0,
+                "aggs": {
+                    "tags": {
+                        "terms": {"field": "tag"},
+                        "aggs": {
+                            "best": {
+                                "top_hits": {"size": 2, "_source": ["rank"]}
+                            }
+                        },
+                    }
+                },
+            },
+        )
+        for b in resp["aggregations"]["tags"]["buckets"]:
+            th = b["best"]["hits"]
+            assert th["total"]["value"] == b["doc_count"]
+            assert len(th["hits"]) == 2
+            for h in th["hits"]:
+                assert set(h["_source"]) <= {"rank"}
+                # Member docs really carry this bucket's tag.
+                tag = next(
+                    t for i, (_, _, t) in enumerate(rest.rows)
+                    if f"d{i}" == h["_id"]
+                )
+                assert tag == b["key"]
+
+    def test_under_range(self, rest):
+        resp = search(
+            rest,
+            {
+                "size": 0,
+                "aggs": {
+                    "bands": {
+                        "range": {
+                            "field": "rank",
+                            "ranges": [{"to": 500}, {"from": 500}],
+                        },
+                        "aggs": {"top": {"top_hits": {"size": 1}}},
+                    }
+                },
+            },
+        )
+        lo, hi = resp["aggregations"]["bands"]["buckets"]
+        for b, pred in ((lo, lambda r: r < 500), (hi, lambda r: r >= 500)):
+            assert b["top"]["hits"]["total"]["value"] == b["doc_count"]
+            hit = b["top"]["hits"]["hits"][0]
+            rank = next(
+                r for i, (r, _, _) in enumerate(rest.rows)
+                if f"d{i}" == hit["_id"]
+            )
+            assert pred(rank)
+
+    def test_under_histogram(self, rest):
+        resp = search(
+            rest,
+            {
+                "size": 0,
+                "aggs": {
+                    "h": {
+                        "histogram": {"field": "rank", "interval": 250},
+                        "aggs": {"top": {"top_hits": {"size": 1}}},
+                    }
+                },
+            },
+        )
+        for b in resp["aggregations"]["h"]["buckets"]:
+            assert b["top"]["hits"]["total"]["value"] == b["doc_count"]
+
+
+class TestComposite:
+    def test_pagination_covers_everything_exactly_once(self, rest):
+        import collections
+
+        expect = collections.Counter()
+        for r, _, t in rest.rows:
+            expect[(t, (r // 250) * 250)] += 1
+        seen = {}
+        after = None
+        pages = 0
+        while True:
+            comp = {
+                "size": 3,
+                "sources": [
+                    {"t": {"terms": {"field": "tag"}}},
+                    {"h": {"histogram": {"field": "rank", "interval": 250}}},
+                ],
+            }
+            if after:
+                comp["after"] = after
+            resp = search(
+                rest,
+                {
+                    "size": 0,
+                    "aggs": {
+                        "c": {
+                            "composite": comp,
+                            "aggs": {"ap": {"avg": {"field": "price"}}},
+                        }
+                    },
+                },
+            )
+            agg = resp["aggregations"]["c"]
+            if not agg["buckets"]:
+                break
+            pages += 1
+            for b in agg["buckets"]:
+                key = (b["key"]["t"], b["key"]["h"])
+                assert key not in seen, "bucket repeated across pages"
+                seen[key] = b["doc_count"]
+                assert b["ap"]["value"] is not None
+            after = agg.get("after_key")
+            if after is None:
+                break
+        assert pages > 1
+        assert seen == {(t, h): c for (t, h), c in expect.items()}
+
+    def test_desc_order(self, rest):
+        resp = search(
+            rest,
+            {
+                "size": 0,
+                "aggs": {
+                    "c": {
+                        "composite": {
+                            "size": 100,
+                            "sources": [
+                                {"t": {"terms": {"field": "tag", "order": "desc"}}}
+                            ],
+                        }
+                    }
+                },
+            },
+        )
+        keys = [b["key"]["t"] for b in resp["aggregations"]["c"]["buckets"]]
+        assert keys == sorted(keys, reverse=True)
+
+    def test_rejected_under_parent(self, rest):
+        status, resp = rest.dispatch(
+            "POST",
+            "/agx/_search",
+            {},
+            json.dumps(
+                {
+                    "size": 0,
+                    "aggs": {
+                        "f": {
+                            "filter": {"term": {"tag": "x"}},
+                            "aggs": {
+                                "c": {
+                                    "composite": {
+                                        "sources": [
+                                            {"t": {"terms": {"field": "tag"}}}
+                                        ]
+                                    }
+                                }
+                            },
+                        }
+                    },
+                }
+            ),
+        )
+        assert status == 400
+
+    def test_date_histogram_source(self, rest):
+        rest2 = RestServer()
+        rest2.dispatch(
+            "PUT", "/dh", {},
+            json.dumps(
+                {"mappings": {"properties": {"ts": {"type": "date"}}}}
+            ),
+        )
+        lines = []
+        day = 86400000
+        for i in range(6):
+            lines.append(json.dumps({"index": {"_id": f"t{i}"}}))
+            lines.append(json.dumps({"ts": (i % 3) * day}))
+        status, resp = rest2.dispatch(
+            "POST", "/dh/_bulk", {"refresh": "true"}, "\n".join(lines)
+        )
+        assert status == 200 and not resp["errors"]
+        resp = search(
+            rest2,
+            {
+                "size": 0,
+                "aggs": {
+                    "c": {
+                        "composite": {
+                            "sources": [
+                                {
+                                    "d": {
+                                        "date_histogram": {
+                                            "field": "ts",
+                                            "fixed_interval": "1d",
+                                        }
+                                    }
+                                }
+                            ]
+                        }
+                    }
+                },
+            },
+            "dh",
+        )
+        buckets = resp["aggregations"]["c"]["buckets"]
+        assert [b["doc_count"] for b in buckets] == [2, 2, 2]
+        assert [b["key"]["d"] for b in buckets] == [0, day, 2 * day]
+
+
+class TestMultiShard:
+    def test_new_aggs_across_shards(self):
+        rest = RestServer()
+        rest.dispatch(
+            "PUT", "/m", {},
+            json.dumps(
+                {
+                    "settings": {"index": {"number_of_shards": 4}},
+                    "mappings": MAPPINGS,
+                }
+            ),
+        )
+        rng = np.random.default_rng(9)
+        lines = []
+        ranks = []
+        for i in range(200):
+            r = int(rng.integers(0, 100))
+            ranks.append(r)
+            lines.append(json.dumps({"index": {"_id": f"s{i}"}}))
+            lines.append(
+                json.dumps(
+                    {"body": "w", "tag": ["a", "b"][i % 2], "rank": r,
+                     "price": 1.5}
+                )
+            )
+        status, resp = rest.dispatch(
+            "POST", "/m/_bulk", {"refresh": "true"}, "\n".join(lines)
+        )
+        assert status == 200 and not resp["errors"]
+        resp = search(
+            rest,
+            {
+                "size": 0,
+                "aggs": {
+                    "p": {"percentiles": {"field": "rank", "percents": [50]}},
+                    "s": {"sum": {"field": "price"}},
+                    "th": {"top_hits": {"size": 2}},
+                    "c": {
+                        "composite": {
+                            "size": 100,
+                            "sources": [{"t": {"terms": {"field": "tag"}}}],
+                        }
+                    },
+                },
+            },
+            "m",
+        )
+        aggs = resp["aggregations"]
+        assert aggs["s"]["value"] == pytest.approx(300.0)
+        assert aggs["p"]["values"]["50.0"] == pytest.approx(
+            float(np.percentile(np.asarray(ranks, dtype=np.float64), 50))
+        )
+        assert aggs["th"]["hits"]["total"]["value"] == 200
+        assert [b["doc_count"] for b in aggs["c"]["buckets"]] == [100, 100]
+
+
+class TestContextMasks:
+    def test_top_hits_under_terms_inside_filter_respects_context(self, rest):
+        """Regression: the bucket top_hits of a terms agg nested in a
+        filter parent must only see docs matching the filter."""
+        resp = search(
+            rest,
+            {
+                "size": 0,
+                "aggs": {
+                    "only_x": {
+                        "filter": {"term": {"tag": "x"}},
+                        "aggs": {
+                            "bands": {
+                                "range": {
+                                    "field": "rank",
+                                    "ranges": [{"to": 500}, {"from": 500}],
+                                },
+                                "aggs": {"th": {"top_hits": {"size": 3}}},
+                            }
+                        },
+                    }
+                },
+            },
+        )
+        bands = resp["aggregations"]["only_x"]["bands"]["buckets"]
+        for b in bands:
+            th = b["th"]["hits"]
+            assert th["total"]["value"] == b["doc_count"]
+            for h in th["hits"]:
+                i = int(h["_id"][1:])
+                assert rest.rows[i][2] == "x", "doc outside filter context"
+
+    def test_top_hits_under_calendar_date_histogram(self):
+        rest = RestServer()
+        rest.dispatch(
+            "PUT", "/cal", {},
+            json.dumps({"mappings": {"properties": {"ts": {"type": "date"}}}}),
+        )
+        lines = []
+        month = 32 * 86400000
+        for i in range(6):
+            lines.append(json.dumps({"index": {"_id": f"c{i}"}}))
+            lines.append(json.dumps({"ts": (i % 3) * month}))
+        status, resp = rest.dispatch(
+            "POST", "/cal/_bulk", {"refresh": "true"}, "\n".join(lines)
+        )
+        assert status == 200 and not resp["errors"]
+        resp = search(
+            rest,
+            {
+                "size": 0,
+                "aggs": {
+                    "m": {
+                        "date_histogram": {
+                            "field": "ts",
+                            "calendar_interval": "month",
+                        },
+                        "aggs": {"th": {"top_hits": {"size": 1}}},
+                    }
+                },
+            },
+            "cal",
+        )
+        for b in resp["aggregations"]["m"]["buckets"]:
+            assert b["th"]["hits"]["total"]["value"] == b["doc_count"]
+
+    def test_malformed_decay_body_400(self, rest):
+        status, resp = rest.dispatch(
+            "POST",
+            "/agx/_search",
+            {},
+            json.dumps(
+                {"query": {"function_score": {"gauss": {"rank": 5}}}}
+            ),
+        )
+        assert status == 400
