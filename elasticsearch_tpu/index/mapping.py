@@ -145,10 +145,15 @@ class Mappings:
         properties: dict[str, dict[str, Any]] | None = None,
         analysis: AnalysisRegistry | None = None,
         dynamic: bool = True,
+        dynamic_templates: list[dict[str, Any]] | None = None,
     ):
         self.fields: dict[str, FieldMapping] = {}
         self.analysis = analysis or AnalysisRegistry()
         self.dynamic = dynamic
+        # Reference's dynamic_templates (index/mapper/DynamicTemplate.java):
+        # ordered [{name: {match/unmatch/match_mapping_type, mapping}}]
+        # rules consulted before default JSON-type inference.
+        self.dynamic_templates = list(dynamic_templates or [])
         for name, spec in (properties or {}).items():
             self.fields[name] = self._parse_field(name, spec)
 
@@ -178,6 +183,14 @@ class Mappings:
     @classmethod
     def from_json(cls, mappings_json: dict[str, Any] | None, **kw) -> "Mappings":
         mappings_json = mappings_json or {}
+        if "dynamic" not in kw:
+            # ES accepts true/false/"strict"; "strict" is treated as
+            # disabled here (unknown fields are dropped, not 400'd).
+            raw = mappings_json.get("dynamic", True)
+            kw["dynamic"] = raw is True or str(raw).lower() == "true"
+        kw.setdefault(
+            "dynamic_templates", mappings_json.get("dynamic_templates")
+        )
         return cls(properties=mappings_json.get("properties"), **kw)
 
     @staticmethod
@@ -204,11 +217,16 @@ class Mappings:
 
     def to_json(self) -> dict[str, Any]:
         """Lossless schema serialization (round-trips through from_json)."""
-        return {
+        out: dict[str, Any] = {
             "properties": {
                 f.name: self._field_spec(f) for f in self.fields.values()
             }
         }
+        if not self.dynamic:
+            out["dynamic"] = False
+        if self.dynamic_templates:
+            out["dynamic_templates"] = list(self.dynamic_templates)
+        return out
 
     def get(self, name: str) -> FieldMapping | None:
         fm = self.fields.get(name)
@@ -222,6 +240,45 @@ class Mappings:
                 return pfm.fields.get(sub)
         return None
 
+    def _json_kind(self, value: Any) -> str | None:
+        """The match_mapping_type bucket of a JSON value."""
+        if isinstance(value, bool):
+            return "boolean"
+        if isinstance(value, int):
+            return "long"
+        if isinstance(value, float):
+            return "double"
+        if isinstance(value, str):
+            return "string"
+        if isinstance(value, list) and value:
+            return self._json_kind(value[0])
+        return None
+
+    def _match_dynamic_template(
+        self, name: str, value: Any
+    ) -> dict[str, Any] | None:
+        """First dynamic_templates rule matching (field name, JSON type)."""
+        import fnmatch
+
+        kind = self._json_kind(value)
+        for entry in self.dynamic_templates:
+            if not isinstance(entry, dict) or len(entry) != 1:
+                continue
+            ((_, rule),) = entry.items()
+            want_type = rule.get("match_mapping_type")
+            if want_type not in (None, "*") and want_type != kind:
+                continue
+            pattern = rule.get("match")
+            if pattern is not None and not fnmatch.fnmatchcase(name, pattern):
+                continue
+            unmatch = rule.get("unmatch")
+            if unmatch is not None and fnmatch.fnmatchcase(name, unmatch):
+                continue
+            mapping = rule.get("mapping")
+            if isinstance(mapping, dict):
+                return mapping
+        return None
+
     def resolve_dynamic(self, name: str, value: Any) -> FieldMapping | None:
         """Map an unseen field from a concrete JSON value (or return None)."""
         existing = self.get(name)  # incl. multi-field sub-paths: a literal
@@ -229,6 +286,11 @@ class Mappings:
             return existing
         if not self.dynamic:
             return None
+        rule_mapping = self._match_dynamic_template(name, value)
+        if rule_mapping is not None:
+            fm = self._parse_field(name, rule_mapping)
+            self.fields[name] = fm
+            return fm
         if isinstance(value, bool):
             ftype = BOOLEAN
         elif isinstance(value, int):

@@ -207,6 +207,11 @@ class Node:
         self.pipelines: dict[str, Any] = {}  # ingest.Pipeline by id
         self._broken_pipelines: dict[str, Any] = {}  # unloadable, preserved
         self.aliases: dict[str, set[str]] = {}  # alias -> concrete indices
+        # Composable index templates (cluster/metadata/
+        # MetadataIndexTemplateService.java:83): name -> {index_patterns,
+        # priority, template:{settings,mappings,aliases}} — applied at
+        # (auto-)creation, request body winning over the template.
+        self.index_templates: dict[str, dict[str, Any]] = {}
         # Extension system (plugins.py): analyzers / ingest processors /
         # query types contributed by ESTPU_PLUGINS or the plugins param.
         from .plugins import load_plugins
@@ -220,6 +225,7 @@ class Node:
         _native_available()
         if data_path is not None:
             os.makedirs(data_path, exist_ok=True)
+            self._load_templates()
             self._recover_indices()
             self._load_repositories()
             self._load_pipelines()
@@ -357,6 +363,141 @@ class Node:
 
     # -------------------------------------------------------------- indices
 
+    # ------------------------------------------------------ index templates
+
+    def put_index_template(self, name: str, body: dict[str, Any]) -> dict:
+        """PUT /_index_template/{name} (composable templates,
+        MetadataIndexTemplateService.java:83)."""
+        body = body or {}
+        patterns = body.get("index_patterns")
+        if isinstance(patterns, str):
+            patterns = [patterns]
+        if not patterns or not isinstance(patterns, list):
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                f"index template [{name}] must have [index_patterns]",
+            )
+        template = body.get("template") or {}
+        # Validate the mappings/analysis up front so a broken template
+        # can't poison future auto-creates.
+        try:
+            Mappings.from_json(template.get("mappings"))
+            # dynamic_templates mapping bodies must parse too, or a broken
+            # rule would reject documents at index time instead of here.
+            for rule_entry in (template.get("mappings") or {}).get(
+                "dynamic_templates", []
+            ):
+                if isinstance(rule_entry, dict) and len(rule_entry) == 1:
+                    ((_, rule),) = rule_entry.items()
+                    mapping = (rule or {}).get("mapping")
+                    if isinstance(mapping, dict):
+                        Mappings._parse_field("_probe", mapping)
+        except ValueError as e:
+            raise ApiError(
+                400, "mapper_parsing_exception", str(e)
+            ) from None
+        self.index_templates[name] = {
+            "index_patterns": [str(p) for p in patterns],
+            "priority": int(body.get("priority", 0)),
+            "template": template,
+        }
+        self._save_templates()
+        return {"acknowledged": True}
+
+    def get_index_template(self, name: str | None = None) -> dict:
+        if name is not None:
+            entry = self.index_templates.get(name)
+            if entry is None:
+                raise ApiError(
+                    404,
+                    "resource_not_found_exception",
+                    f"index template matching [{name}] not found",
+                )
+            entries = {name: entry}
+        else:
+            entries = self.index_templates
+        return {
+            "index_templates": [
+                {"name": n, "index_template": dict(t)}
+                for n, t in sorted(entries.items())
+            ]
+        }
+
+    def delete_index_template(self, name: str) -> dict:
+        if name not in self.index_templates:
+            raise ApiError(
+                404,
+                "resource_not_found_exception",
+                f"index template matching [{name}] not found",
+            )
+        del self.index_templates[name]
+        self._save_templates()
+        return {"acknowledged": True}
+
+    def _matching_template(self, index_name: str) -> dict[str, Any] | None:
+        """Highest-priority template whose pattern matches the name (ties
+        break by name for determinism, like the reference's comparator)."""
+        import fnmatch
+
+        best = None
+        best_key = None
+        for name, entry in self.index_templates.items():
+            if any(
+                fnmatch.fnmatchcase(index_name, p)
+                for p in entry["index_patterns"]
+            ):
+                key = (entry["priority"], name)
+                if best_key is None or key > best_key:
+                    best, best_key = entry, key
+        return best
+
+    @staticmethod
+    def _deep_merge(base: dict, override: dict) -> dict:
+        out = dict(base)
+        for k, v in override.items():
+            if isinstance(v, dict) and isinstance(out.get(k), dict):
+                out[k] = Node._deep_merge(out[k], v)
+            else:
+                out[k] = v
+        return out
+
+    def _apply_template(
+        self, name: str, body: dict[str, Any]
+    ) -> dict[str, Any]:
+        """Compose the matching template under the create-request body
+        (request wins key-by-key; mappings properties merge per field)."""
+        entry = self._matching_template(name)
+        if entry is None:
+            return body
+        return self._deep_merge(entry["template"], body)
+
+    def _templates_file(self) -> str | None:
+        if self.data_path is None:
+            return None
+        return os.path.join(self.data_path, "_index_templates.json")
+
+    def _save_templates(self) -> None:
+        path = self._templates_file()
+        if path is None:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.index_templates, f)
+        os.replace(tmp, path)
+
+    def _load_templates(self) -> None:
+        path = self._templates_file()
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                self.index_templates = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            # Broken persisted state is never a node-fatal boot error
+            # (same convention as aliases/pipelines/repositories).
+            self.index_templates = {}
+
     def create_index(self, name: str, body: dict[str, Any] | None = None) -> dict:
         if name in self.indices:
             raise ApiError(
@@ -374,7 +515,7 @@ class Node:
                 "invalid_index_name_exception",
                 f"an alias with the name [{name}] already exists",
             )
-        body = body or {}
+        body = self._apply_template(name, body or {})
         # Validate the WHOLE request (aliases included) before creating
         # anything — a mid-request failure must not leave a half-created
         # index or unpersisted alias state.
@@ -810,7 +951,8 @@ class Node:
             )
             try:
                 out["suggest"] = run_suggest(
-                    body["suggest"], svc.mappings, stats
+                    body["suggest"], svc.mappings, stats,
+                    engines=svc.engines,
                 )
             except ValueError as e:
                 raise ApiError(

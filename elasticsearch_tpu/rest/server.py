@@ -99,6 +99,16 @@ class RestServer:
         r("GET", "/_cat/shards", lambda s, p, q, b: n.cat_shards())
         r("GET", "/_cat/segments", lambda s, p, q, b: n.cat_segments())
         r("POST", "/_aliases", lambda s, p, q, b: n.update_aliases(_json(b)))
+        r("PUT", "/_index_template/{name}", lambda s, p, q, b:
+          n.put_index_template(p["name"], _json(b)))
+        r("POST", "/_index_template/{name}", lambda s, p, q, b:
+          n.put_index_template(p["name"], _json(b)))
+        r("GET", "/_index_template", lambda s, p, q, b:
+          n.get_index_template())
+        r("GET", "/_index_template/{name}", lambda s, p, q, b:
+          n.get_index_template(p["name"]))
+        r("DELETE", "/_index_template/{name}", lambda s, p, q, b:
+          n.delete_index_template(p["name"]))
         r("GET", "/_alias", lambda s, p, q, b: n.get_aliases())
         r("GET", "/{index}/_alias", lambda s, p, q, b: n.get_aliases(
             p["index"]
