@@ -1,36 +1,58 @@
-"""Term suggester: did-you-mean corrections from the term dictionary.
+"""Term + phrase suggesters: did-you-mean corrections.
 
-The analog of the reference's TermSuggester (search/suggest/term/ —
-DirectSpellChecker over the terms dict): each analyzed token of the
-suggest text gathers dictionary terms within max_edits (OSA distance,
-shared prefix required), scored by string similarity then frequency.
-Runs on the host against the shard-aggregated term statistics — the term
-dictionary lives host-side by design (tiles.py keeps it off-device).
+Term suggester (reference: search/suggest/term/ — DirectSpellChecker over
+the terms dict): each analyzed token of the suggest text gathers
+dictionary terms within max_edits (OSA distance, shared prefix required),
+scored by string similarity then frequency.
+
+Phrase suggester (reference: search/suggest/phrase/PhraseSuggester.java:44):
+whole-phrase corrections ranked by a BIGRAM language model with stupid-
+backoff smoothing (the reference's default LaplaceScorer sibling,
+phrase/StupidBackoffScorer.java) times a channel model (candidates from
+the term suggester's OSA machinery; keeping an in-dictionary token costs
+`real_word_error_likelihood`). The bigram table extracts VECTORIZED from
+the index's position planes — occurrences sorted by (doc, position),
+adjacent pairs counted with one np.unique — and caches per (field,
+refresh generation) on the engine.
+
+Both run on the host: term dictionaries and position planes live host-side
+by design (tiles.py keeps strings off-device).
 """
 
 from __future__ import annotations
 
+import math
 from typing import Any
+
+import numpy as np
 
 from ..query.compile import _damerau_bounded
 
 
 def run_suggest(
-    body: dict[str, Any], mappings, stats: dict
+    body: dict[str, Any], mappings, stats: dict, engines=None
 ) -> dict[str, Any]:
     """Evaluate the `suggest` section of a search request.
 
-    `stats` is the aggregated per-field FieldStats map (df per term)."""
+    `stats` is the aggregated per-field FieldStats map (df per term);
+    `engines` (shard engines) supply position planes for the phrase
+    suggester's bigram model."""
     out: dict[str, Any] = {}
     for name, spec in body.items():
         if not isinstance(spec, dict):
             raise ValueError(f"suggestion [{name}] must be an object")
         text = spec.get("text", "")
+        phrase_spec = spec.get("phrase")
+        if phrase_spec is not None:
+            out[name] = _phrase_suggest(
+                name, str(text), phrase_spec, mappings, stats, engines or []
+            )
+            continue
         term_spec = spec.get("term")
         if term_spec is None:
             raise ValueError(
-                f"suggestion [{name}] requires a [term] suggester "
-                f"(other suggesters are not supported yet)"
+                f"suggestion [{name}] requires a [term] or [phrase] "
+                f"suggester (other suggesters are not supported yet)"
             )
         field = term_spec.get("field")
         if not field:
@@ -77,3 +99,222 @@ def run_suggest(
             entries.append(entry)
         out[name] = entries
     return out
+
+
+# ------------------------------------------------------------------ phrase
+
+
+def _bigram_model(engines, field: str):
+    """(unigram counts, bigram counts, total tokens) for a field, merged
+    over every shard's segments and cached per refresh generation.
+
+    Vectorized extraction from the CSR position planes: expand each
+    posting to its occurrences, sort by (doc, position), and count
+    adjacent same-doc consecutive-position pairs with one np.unique."""
+    if not engines:
+        return {}, {}, 0
+    gens = tuple(e.generation for e in engines)
+    cache = engines[0].__dict__.setdefault("_phrase_lm_cache", {})
+    got = cache.get((field, gens))
+    if got is not None:
+        return got
+    uni: dict[str, int] = {}
+    bi: dict[tuple[str, str], int] = {}
+    total = 0
+    for engine in engines:
+        for handle in list(engine.segments):
+            fld = handle.segment.fields.get(field)
+            if fld is None or fld.positions is None or not len(fld.doc_ids):
+                continue
+            names = list(fld.terms.keys())
+            n_terms = len(names)
+            term_of_posting = np.repeat(
+                np.arange(n_terms, dtype=np.int64), np.diff(fld.offsets)
+            )
+            pos_counts = np.diff(fld.pos_offsets).astype(np.int64)
+            occ_term = np.repeat(term_of_posting, pos_counts)
+            occ_doc = np.repeat(fld.doc_ids.astype(np.int64), pos_counts)
+            occ_pos = fld.positions.astype(np.int64)
+            total += len(occ_term)
+            ut, uc = np.unique(occ_term, return_counts=True)
+            for t, c in zip(ut, uc):
+                name = names[int(t)]
+                uni[name] = uni.get(name, 0) + int(c)
+            if len(occ_term) < 2:
+                continue
+            order = np.lexsort((occ_pos, occ_doc))
+            st, sd, sp = occ_term[order], occ_doc[order], occ_pos[order]
+            adj = (sd[1:] == sd[:-1]) & (sp[1:] == sp[:-1] + 1)
+            if not adj.any():
+                continue
+            pair_key = st[:-1][adj] * n_terms + st[1:][adj]
+            pk, pc = np.unique(pair_key, return_counts=True)
+            for key, c in zip(pk, pc):
+                pair = (names[int(key // n_terms)], names[int(key % n_terms)])
+                bi[pair] = bi.get(pair, 0) + int(c)
+    out = (uni, bi, total)
+    # Bounded memory: evict stale generations only — models for OTHER
+    # fields at the current generation stay cached (alternating-field
+    # suggest requests must not thrash the O(positions) rebuild).
+    for key in [k for k in cache if k[1] != gens]:
+        del cache[key]
+    cache[(field, gens)] = out
+    return out
+
+
+def _token_candidates(
+    token: str, df: dict, max_edits: int, prefix_len: int, limit: int
+):
+    """(candidate, OSA distance) corrections for one token (the term
+    suggester's generator), best-first by similarity then frequency."""
+    prefix = token[:prefix_len]
+    out = []
+    for term, freq in df.items():
+        if term == token:
+            continue
+        if prefix_len and not term.startswith(prefix):
+            continue
+        if abs(len(term) - len(token)) > max_edits:
+            continue
+        d = _damerau_bounded(token, term, max_edits)
+        if d is None:
+            continue
+        sim = 1.0 - d / max(len(token), len(term))
+        out.append((-sim, -freq, term, d))
+    out.sort()
+    return [(term, d) for _, _, term, d in out[:limit]]
+
+
+def _phrase_suggest(
+    name: str, text: str, pspec: dict, mappings, stats, engines
+) -> list[dict[str, Any]]:
+    field = pspec.get("field")
+    if not field:
+        raise ValueError(f"suggestion [{name}] requires [phrase.field]")
+    size = int(pspec.get("size", 5))
+    max_errors = float(pspec.get("max_errors", 1.0))
+    confidence = float(pspec.get("confidence", 1.0))
+    rwel = float(pspec.get("real_word_error_likelihood", 0.95))
+    if not (0.0 < rwel < 1.0):
+        raise ValueError(
+            "[phrase] real_word_error_likelihood must be in (0, 1), got "
+            f"[{rwel}]"
+        )
+    discount = 0.4  # stupid-backoff default (StupidBackoffScorer)
+    generators = pspec.get("direct_generator") or [{}]
+    gen0 = generators[0] if isinstance(generators, list) else {}
+    max_edits = int(gen0.get("max_edits", 2))
+    prefix_len = int(gen0.get("prefix_length", 1))
+    cand_limit = int(gen0.get("candidate_size", 5))
+    highlight = pspec.get("highlight")
+
+    fstats = stats.get(field)
+    df = fstats.df if fstats is not None else {}
+    uni, bi, total = _bigram_model(engines, field)
+    analyzer = mappings.analyzer_for(field, search=True)
+    tokens = [t for t, _, _ in analyzer.analyze_offsets(str(text))]
+    entry = {
+        "text": text,
+        "offset": 0,
+        "length": len(text),
+        "options": [],
+    }
+    if not tokens or total == 0:
+        return [entry]
+
+    allowed_errors = (
+        max(1, int(round(max_errors)))
+        if max_errors >= 1
+        else max(1, int(max_errors * len(tokens)))
+    )
+
+    def log_lm(prev: str | None, word: str) -> float:
+        """Stupid-backoff bigram log-probability."""
+        wc = uni.get(word, 0)
+        if prev is not None:
+            pc = uni.get(prev, 0)
+            bc = bi.get((prev, word), 0)
+            if pc > 0 and bc > 0:
+                return math.log(bc / pc)
+        return math.log(discount * max(wc, 0.5) / total)
+
+    def log_channel(orig: str, cand: str, dist: int) -> float:
+        """Keeping an in-dictionary token costs rwel; keeping an out-of-
+        vocabulary token is itself unlikely ((1-rwel)/2, the strongest
+        signal to correct); corrections cost their string similarity —
+        the reference's DirectCandidateGenerator scoring shape."""
+        if cand == orig:
+            if uni.get(orig, 0) > 0 or df.get(orig, 0) > 0:
+                return math.log(rwel)
+            return math.log((1.0 - rwel) / 2.0)
+        sim = 1.0 - dist / max(len(orig), len(cand), 1)
+        return math.log(max(sim, 1e-3))
+
+    per_token = []
+    for tok in tokens:
+        cands = [(tok, 0)]
+        cands += _token_candidates(tok, df, max_edits, prefix_len, cand_limit)
+        per_token.append(cands)
+
+    # Beam search over per-token candidates: state = (log score, phrase
+    # tokens, changed flags, error count, previous word).
+    beam = [(0.0, [], [], 0)]
+    width = max(8, size * 4)
+    for ti, cands in enumerate(per_token):
+        nxt = []
+        for score, words, changed, errs in beam:
+            prev = words[-1] if words else None
+            for cand, dist in cands:
+                is_err = cand != tokens[ti]
+                if is_err and errs + 1 > allowed_errors:
+                    continue
+                nxt.append(
+                    (
+                        score
+                        + log_lm(prev, cand)
+                        + log_channel(tokens[ti], cand, dist),
+                        words + [cand],
+                        changed + [is_err],
+                        errs + (1 if is_err else 0),
+                    )
+                )
+        nxt.sort(key=lambda s: -s[0])
+        beam = nxt[:width]
+
+    # Input phrase score: the confidence threshold baseline.
+    base = 0.0
+    prev = None
+    for tok in tokens:
+        base += log_lm(prev, tok) + math.log(rwel)
+        prev = tok
+
+    n = len(tokens)
+    options = []
+    seen = set()
+    for score, words, changed, errs in beam:
+        phrase = " ".join(words)
+        if phrase in seen:
+            continue
+        seen.add(phrase)
+        if words == tokens:
+            continue  # the input itself is not a suggestion
+        # ES confidence: only corrections scoring above
+        # confidence * score(input) are returned.
+        if confidence > 0 and score <= base + math.log(confidence):
+            continue
+        opt: dict[str, Any] = {
+            "text": phrase,
+            "score": round(math.exp(score / n), 6),
+        }
+        if highlight:
+            pre = highlight.get("pre_tag", "<em>")
+            post = highlight.get("post_tag", "</em>")
+            opt["highlighted"] = " ".join(
+                f"{pre}{w}{post}" if c else w
+                for w, c in zip(words, changed)
+            )
+        options.append(opt)
+        if len(options) >= size:
+            break
+    entry["options"] = options
+    return [entry]

@@ -1,0 +1,135 @@
+"""Phrase suggester (VERDICT r4 item 9): bigram-LM did-you-mean.
+
+Reference: search/suggest/phrase/PhraseSuggester.java:44 with
+StupidBackoffScorer smoothing and DirectCandidateGenerator candidates.
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.rest.server import RestServer
+
+TITLES = [
+    "nobel prize winner",
+    "nobel prize ceremony",
+    "nobel peace prize",
+    "noble gas chemistry",
+    "prize money rules",
+    "peace treaty signed",
+    "nobel prize physics",
+    "nobel prize literature",
+]
+
+
+@pytest.fixture(scope="module")
+def rest():
+    rest = RestServer()
+    rest.dispatch(
+        "PUT", "/bks", {},
+        json.dumps({"mappings": {"properties": {"title": {"type": "text"}}}}),
+    )
+    lines = []
+    for i, t in enumerate(TITLES):
+        lines.append(json.dumps({"index": {"_id": f"b{i}"}}))
+        lines.append(json.dumps({"title": t}))
+    status, resp = rest.dispatch(
+        "POST", "/bks/_bulk", {"refresh": "true"}, "\n".join(lines)
+    )
+    assert status == 200 and not resp["errors"]
+    return rest
+
+
+def suggest(rest, text, **phrase_params):
+    body = {
+        "suggest": {
+            "sp": {"text": text, "phrase": {"field": "title", **phrase_params}}
+        }
+    }
+    status, resp = rest.dispatch("POST", "/bks/_search", {}, json.dumps(body))
+    assert status == 200, resp
+    return resp["suggest"]["sp"][0]
+
+
+def test_single_edit_correction(rest):
+    entry = suggest(rest, "noble prize")
+    assert entry["text"] == "noble prize"
+    assert entry["options"][0]["text"] == "nobel prize"
+    assert entry["options"][0]["score"] > 0
+
+
+def test_two_errors_ranked_by_language_model(rest):
+    entry = suggest(rest, "noble prise", max_errors=2, size=3)
+    texts = [o["text"] for o in entry["options"]]
+    assert texts[0] == "nobel prize"  # full correction wins on bigram LM
+    assert "noble prize" in texts  # partial correction also offered
+
+
+def test_correct_phrase_yields_nothing(rest):
+    entry = suggest(rest, "nobel prize")
+    assert entry["options"] == []
+
+
+def test_max_errors_limits_changes(rest):
+    entry = suggest(rest, "noble prise", max_errors=1, size=5)
+    for o in entry["options"]:
+        changed = sum(
+            1 for a, b in zip(o["text"].split(), ["noble", "prise"])
+            if a != b
+        )
+        assert changed <= 1
+
+
+def test_highlight_wraps_changed_tokens(rest):
+    entry = suggest(
+        rest,
+        "noble prize",
+        highlight={"pre_tag": "<em>", "post_tag": "</em>"},
+    )
+    assert entry["options"][0]["highlighted"] == "<em>nobel</em> prize"
+
+
+def test_confidence_zero_keeps_weak_options(rest):
+    strict = suggest(rest, "nobel prize", confidence=1.0)
+    loose = suggest(rest, "nobel prize", confidence=0.0, max_errors=2)
+    assert strict["options"] == []
+    assert len(loose["options"]) >= 1  # threshold disabled
+
+
+def test_requires_field(rest):
+    status, resp = rest.dispatch(
+        "POST",
+        "/bks/_search",
+        {},
+        json.dumps({"suggest": {"sp": {"text": "x", "phrase": {}}}}),
+    )
+    assert status == 400
+
+
+def test_multi_shard_phrase_suggest():
+    rest = RestServer()
+    rest.dispatch(
+        "PUT", "/ms", {},
+        json.dumps(
+            {
+                "settings": {"index": {"number_of_shards": 4}},
+                "mappings": {"properties": {"title": {"type": "text"}}},
+            }
+        ),
+    )
+    lines = []
+    for i, t in enumerate(TITLES * 3):
+        lines.append(json.dumps({"index": {"_id": f"m{i}"}}))
+        lines.append(json.dumps({"title": t}))
+    status, resp = rest.dispatch(
+        "POST", "/ms/_bulk", {"refresh": "true"}, "\n".join(lines)
+    )
+    assert status == 200 and not resp["errors"]
+    body = {
+        "suggest": {
+            "sp": {"text": "noble prize", "phrase": {"field": "title"}}
+        }
+    }
+    status, resp = rest.dispatch("POST", "/ms/_search", {}, json.dumps(body))
+    assert status == 200
+    assert resp["suggest"]["sp"][0]["options"][0]["text"] == "nobel prize"
