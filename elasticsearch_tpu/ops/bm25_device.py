@@ -545,12 +545,171 @@ def execute_sequential_sparse(seg, spec, arrays_batched, k: int):
 
     def step(carry, arrays):
         eps = jax.lax.optimization_barrier(carry) * jnp.float32(0.0)
-        arrays = dict(arrays)
-        arrays["weights"] = arrays["weights"] + eps
-        s, i, t = _sparse_inner(seg, spec, arrays, k)
+        s, i, t = _sparse_inner(seg, spec, _chain_perturb(arrays, eps), k)
         return t.astype(jnp.float32), (s, i, t)
 
     _, out = jax.lax.scan(step, jnp.float32(0.0), arrays_batched)
+    return out
+
+
+def _chain_perturb(arrays, eps):
+    """Dependency-chain a query plan on a prior result (see
+    execute_sequential_sparse): adds an exactly-+0.0 perturbation derived
+    from the carried value to the plan's top-level f32 leaf, so XLA cannot
+    overlap or batch consecutive scan iterations. Plans with no f32 leaf
+    (match_none compiles to empty arrays) pass through unperturbed — there
+    is no device work to overlap for them anyway."""
+    for key in ("boost", "weights"):
+        if key in arrays:
+            arrays = dict(arrays)
+            arrays[key] = arrays[key] + eps
+            break
+    return arrays
+
+
+def _inner_for(spec):
+    return _sparse_inner if supports_sparse(spec) else _execute_inner
+
+
+@partial(jax.jit, static_argnames=("spec", "k", "length"))
+def execute_sequential(seg, spec, arrays_batched, k: int, length=None):
+    """Strictly-sequential unbatched execution for ANY compiled spec.
+
+    The dense-path counterpart of execute_sequential_sparse — the honest
+    per-query latency kernel for bool/script/function_score plans (the
+    BASELINE config-3/4/5 shapes). Results are bit-identical to the
+    per-query kernel. `length` is only needed for specs whose plans carry
+    no per-query arrays at all (match_none compiles to an empty pytree,
+    leaving the scan length uninferrable)."""
+
+    def step(carry, arrays):
+        eps = jax.lax.optimization_barrier(carry) * jnp.float32(0.0)
+        s, i, t = _inner_for(spec)(seg, spec, _chain_perturb(arrays, eps), k)
+        return t.astype(jnp.float32), (s, i, t)
+
+    _, out = jax.lax.scan(
+        step, jnp.float32(0.0), arrays_batched, length=length
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Multi-shard execution on ONE device: the scatter/gather phase when shard
+# count exceeds device count (every shard's tree is stacked on a leading
+# axis and vmapped — one program scores all shards, then an in-program
+# merge takes the global top-k). The single-chip complement of
+# parallel/sharded.py's shard_map path (same stacked layout, same merge
+# contract: score desc, shard asc, doc asc — SearchPhaseController.java:398
+# as one top_k over concatenated per-shard rank lists).
+# ---------------------------------------------------------------------------
+
+
+def _shards_inner(seg_stacked, spec, arrays_stacked, k: int, docs_per_shard: int):
+    inner = _inner_for(spec)
+    s, i, t = jax.vmap(lambda seg, arr: inner(seg, spec, arr, k))(
+        seg_stacked, arrays_stacked
+    )
+    n_shards = s.shape[0]
+    gids = i.astype(jnp.int32) + (
+        jnp.arange(n_shards, dtype=jnp.int32) * jnp.int32(docs_per_shard)
+    )[:, None]
+    flat_s = s.reshape(-1)
+    # Flattened index order is (shard, rank); per-shard ranks tie-break by
+    # doc id ascending, so lax.top_k's lowest-index tie-break reproduces the
+    # coordinator merge order exactly.
+    top_s, pos = jax.lax.top_k(flat_s, min(k, flat_s.shape[0]))
+    return top_s, gids.reshape(-1)[pos], jnp.sum(t, dtype=jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("spec", "k", "docs_per_shard"))
+def execute_shards(seg_stacked, spec, arrays_stacked, k: int, docs_per_shard: int):
+    """One query over S stacked shards on one device -> global top-k."""
+    return _shards_inner(seg_stacked, spec, arrays_stacked, k, docs_per_shard)
+
+
+@partial(jax.jit, static_argnames=("spec", "k", "docs_per_shard"))
+def execute_shards_batch(
+    seg_stacked, spec, arrays_batched, k: int, docs_per_shard: int
+):
+    """Q same-spec queries over S stacked shards ([Q, S, ...] plans)."""
+    return jax.vmap(
+        lambda arr: _shards_inner(seg_stacked, spec, arr, k, docs_per_shard)
+    )(arrays_batched)
+
+
+@partial(jax.jit, static_argnames=("spec", "k", "docs_per_shard"))
+def execute_shards_sequential(
+    seg_stacked, spec, arrays_batched, k: int, docs_per_shard: int
+):
+    """Strictly-sequential multi-shard execution (per-query p50 bench)."""
+
+    def step(carry, arrays):
+        eps = jax.lax.optimization_barrier(carry) * jnp.float32(0.0)
+        s, i, t = _shards_inner(
+            seg_stacked, spec, _chain_perturb(arrays, eps), k, docs_per_shard
+        )
+        return t.astype(jnp.float32), (s, i, t)
+
+    _, out = jax.lax.scan(step, jnp.float32(0.0), arrays_batched)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fused two-phase rescore: query top-window, re-score the window with a
+# second compiled plan, combine, global top-k — one launch, nothing leaves
+# the device but the final k hits. The reference runs this as two separate
+# phases (QueryPhase then RescorePhase, search/rescore/QueryRescorer.java);
+# on TPU both phases fuse into one XLA program so the window never round-
+# trips through the host.
+# ---------------------------------------------------------------------------
+
+
+def _rescore_inner(seg, spec, arrays, rspec, rarrays, k: int, window: int,
+                   query_weight, rescore_weight):
+    s, ids, total = _inner_for(spec)(seg, spec, arrays, window)
+    live = seg["live"]
+    num_docs = live.shape[0]
+    rscores, rmatched = _eval_node(rspec, rarrays, seg, num_docs)
+    relig = rmatched & live
+    rs = jnp.where(relig, rscores, jnp.float32(0.0))[ids]
+    rm = relig[ids]
+    valid = s > jnp.float32(NEG_INF)
+    qw = jnp.float32(query_weight)
+    rw = jnp.float32(rescore_weight)
+    comb = jnp.where(rm, qw * s + rw * rs, qw * s)
+    comb = jnp.where(valid, comb, jnp.float32(NEG_INF))
+    top_s, pos = jax.lax.top_k(comb, min(k, comb.shape[0]))
+    return top_s, ids[pos], total
+
+
+@partial(jax.jit, static_argnames=("spec", "rspec", "k", "window"))
+def execute_rescore(seg, spec, arrays, rspec, rarrays, k: int, window: int,
+                    query_weight, rescore_weight):
+    """score_mode=total rescore: qw*orig + rw*rescore for window docs the
+    rescore query matches, qw*orig otherwise; ties keep original rank."""
+    return _rescore_inner(seg, spec, arrays, rspec, rarrays, k, window,
+                          query_weight, rescore_weight)
+
+
+@partial(jax.jit, static_argnames=("spec", "rspec", "k", "window"))
+def execute_rescore_sequential(seg, spec, arrays_batched, rspec,
+                               rarrays_batched, k: int, window: int,
+                               query_weight, rescore_weight):
+    """Strictly-sequential fused rescore (per-query p50 bench)."""
+
+    def step(carry, pair):
+        arrays, rarrays = pair
+        eps = jax.lax.optimization_barrier(carry) * jnp.float32(0.0)
+        s, i, t = _rescore_inner(
+            seg, spec, _chain_perturb(arrays, eps), rarrays=rarrays,
+            rspec=rspec, k=k, window=window, query_weight=query_weight,
+            rescore_weight=rescore_weight,
+        )
+        return t.astype(jnp.float32), (s, i, t)
+
+    _, out = jax.lax.scan(
+        step, jnp.float32(0.0), (arrays_batched, rarrays_batched)
+    )
     return out
 
 

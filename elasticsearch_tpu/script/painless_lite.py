@@ -165,17 +165,27 @@ class CompiledScript:
                 raise ValueError(f"no dense_vector field [{field}]")
             return vectors[field]
 
+        def _matvec(mat, q):
+            # On TPU the default f32 matmul precision is bf16 passes; the
+            # reference scores vectors in true float32 (x-pack
+            # ScoreScriptUtils), so request full-precision MXU passes when
+            # the backend supports the kwarg (numpy does not).
+            try:
+                return xp.matmul(mat, q, precision="highest")
+            except TypeError:
+                return mat @ q
+
         def cosine_similarity(qv, field):
             v = _vec(field)
             q = xp.asarray(qv, dtype=xp.float32)
             vnorm = xp.sqrt(xp.sum(v * v, axis=-1))
             qnorm = xp.sqrt(xp.sum(q * q))
             denom = vnorm * qnorm
-            return xp.where(denom > 0, (v @ q) / denom, xp.float32(0.0))
+            return xp.where(denom > 0, _matvec(v, q) / denom, xp.float32(0.0))
 
         def dot_product(qv, field):
             q = xp.asarray(qv, dtype=xp.float32)
-            return _vec(field) @ q
+            return _matvec(_vec(field), q)
 
         def l2norm(qv, field):
             q = xp.asarray(qv, dtype=xp.float32)
