@@ -971,4 +971,5 @@ class Engine:
             params=self.params,
             stats=stats if stats is not None else self.field_stats(),
             id_index=lambda: handle.id_index,  # built only if an ids query compiles
+            nested=handle.device.nested,
         )

@@ -30,10 +30,12 @@ FLOAT = "float"
 BOOLEAN = "boolean"
 DATE = "date"
 DENSE_VECTOR = "dense_vector"
+OBJECT = "object"
+NESTED = "nested"
 
 NUMERIC_TYPES = {LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT, DATE, BOOLEAN}
 INVERTED_TYPES = {TEXT, KEYWORD}
-ALL_TYPES = NUMERIC_TYPES | INVERTED_TYPES | {DENSE_VECTOR}
+ALL_TYPES = NUMERIC_TYPES | INVERTED_TYPES | {DENSE_VECTOR, OBJECT, NESTED}
 
 
 def parse_date_millis(value: Any) -> float:
@@ -110,6 +112,10 @@ class FieldMapping:
     # keyword option: values longer than this many characters are not
     # indexed (KeywordFieldMapper ignore_above; 0 = no limit).
     ignore_above: int = 0
+    # object / nested: the raw `properties` sub-schema as written (leaf
+    # sub-fields are ALSO registered flat under their dotted full paths —
+    # this copy exists for lossless to_json round-trips).
+    properties: dict[str, Any] | None = None
 
     def __post_init__(self):
         if self.type not in ALL_TYPES:
@@ -154,7 +160,35 @@ class Mappings:
         # ordered [{name: {match/unmatch/match_mapping_type, mapping}}]
         # rules consulted before default JSON-type inference.
         self.dynamic_templates = list(dynamic_templates or [])
+        # Nested scopes: path -> a Mappings whose field names are FULL
+        # dotted paths ("comments.author"). Nested objects index into a
+        # separate per-path document space (the reference's hidden Lucene
+        # block-join sub-documents, index/mapper/NestedObjectMapper.java);
+        # the scope carries their schema.
+        self.nested: dict[str, "Mappings"] = {}
         for name, spec in (properties or {}).items():
+            self._register(name, spec)
+
+    def _register(self, name: str, spec: dict[str, Any]) -> None:
+        """Register one property, flattening object trees to dotted leaf
+        names (the reference's ObjectMapper path-prefixed leaves) and
+        splitting nested sub-schemas into their own scopes."""
+        ftype = spec.get("type", OBJECT if "properties" in spec else TEXT)
+        if ftype == NESTED:
+            self.fields[name] = FieldMapping(
+                name=name, type=NESTED, properties=spec.get("properties") or {}
+            )
+            scope = Mappings(analysis=self.analysis, dynamic=self.dynamic)
+            for sub, subspec in (spec.get("properties") or {}).items():
+                scope._register(f"{name}.{sub}", subspec)
+            self.nested[name] = scope
+        elif ftype == OBJECT:
+            self.fields[name] = FieldMapping(
+                name=name, type=OBJECT, properties=spec.get("properties") or {}
+            )
+            for sub, subspec in (spec.get("properties") or {}).items():
+                self._register(f"{name}.{sub}", subspec)
+        else:
             self.fields[name] = self._parse_field(name, spec)
 
     @classmethod
@@ -193,6 +227,31 @@ class Mappings:
         )
         return cls(properties=mappings_json.get("properties"), **kw)
 
+    def _props_under(self, prefix: str) -> dict[str, Any]:
+        """Relative `properties` of an object/nested parent, reconstructed
+        LIVE from the registered flat fields (so dynamically added leaves
+        at any depth serialize — the raw parse-time copy in
+        FieldMapping.properties would miss them)."""
+        dot = prefix + "."
+        props: dict[str, Any] = {}
+        for name, f in self.fields.items():
+            if name.startswith(dot) and "." not in name[len(dot):]:
+                props[name[len(dot):]] = self._spec_of(f)
+        return props
+
+    def _spec_of(self, f: FieldMapping) -> dict[str, Any]:
+        if f.type == OBJECT:
+            return {"type": OBJECT, "properties": self._props_under(f.name)}
+        if f.type == NESTED:
+            scope = self.nested.get(f.name)
+            props = (
+                scope._props_under(f.name)
+                if scope is not None
+                else dict(f.properties or {})
+            )
+            return {"type": NESTED, "properties": props}
+        return self._field_spec(f)
+
     @staticmethod
     def _field_spec(f: FieldMapping) -> dict[str, Any]:
         spec: dict[str, Any] = {"type": f.type}
@@ -215,11 +274,23 @@ class Mappings:
             }
         return spec
 
+    def _under_object(self, name: str) -> bool:
+        """True when `name` is a flattened leaf of a registered object
+        parent (those serialize inside the parent's `properties`)."""
+        parts = name.split(".")
+        for i in range(1, len(parts)):
+            fm = self.fields.get(".".join(parts[:i]))
+            if fm is not None and fm.type == OBJECT:
+                return True
+        return False
+
     def to_json(self) -> dict[str, Any]:
         """Lossless schema serialization (round-trips through from_json)."""
         out: dict[str, Any] = {
             "properties": {
-                f.name: self._field_spec(f) for f in self.fields.values()
+                f.name: self._spec_of(f)
+                for f in self.fields.values()
+                if not self._under_object(f.name)
             }
         }
         if not self.dynamic:
@@ -289,6 +360,19 @@ class Mappings:
         rule_mapping = self._match_dynamic_template(name, value)
         if rule_mapping is not None:
             fm = self._parse_field(name, rule_mapping)
+            self.fields[name] = fm
+            return fm
+        if isinstance(value, dict):
+            # Dynamic objects map like the reference's ObjectMapper: the
+            # parent registers as `object`, leaves flatten to dotted paths
+            # (the builder recurses and resolves each leaf separately).
+            fm = FieldMapping(name=name, type=OBJECT, properties={})
+            self.fields[name] = fm
+            return fm
+        if isinstance(value, list) and value and isinstance(value[0], dict):
+            # Arrays of objects without a nested mapping FLATTEN (the
+            # documented reference behavior): same object treatment.
+            fm = FieldMapping(name=name, type=OBJECT, properties={})
             self.fields[name] = fm
             return fm
         if isinstance(value, bool):

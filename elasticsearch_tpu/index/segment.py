@@ -29,7 +29,7 @@ import numpy as np
 from ..native import NativeAccumulator, tokenize_ascii
 from ..native import available as native_available
 from ..utils import smallfloat
-from .mapping import DENSE_VECTOR, Mappings, coerce_numeric
+from .mapping import DENSE_VECTOR, NESTED, Mappings, coerce_numeric
 
 
 @dataclass
@@ -121,12 +121,27 @@ class Segment:
     # every Lucene doc — index/mapper/VersionFieldMapper, SeqNoFieldMapper):
     versions: np.ndarray | None = None  # int64[N]; None = all 1 (legacy)
     seqnos: np.ndarray | None = None  # int64[N]; None = all -1 (legacy)
+    # Nested object blocks, one per nested path. The reference interleaves
+    # hidden sub-documents into the SAME Lucene doc space and joins with a
+    # parent bitset (NestedObjectMapper + ToParentBlockJoinQuery); the
+    # TPU-first layout keeps each nested path in its OWN document space
+    # (a sub-segment with full-path field names) plus an explicit
+    # nested-doc -> parent-doc map, so the join is one scatter.
+    nested: dict[str, "NestedBlock"] = field(default_factory=dict)
 
     def doc_version(self, local: int) -> int:
         return int(self.versions[local]) if self.versions is not None else 1
 
     def doc_seqno(self, local: int) -> int:
         return int(self.seqnos[local]) if self.seqnos is not None else -1
+
+
+@dataclass
+class NestedBlock:
+    """All nested objects of one path within a segment."""
+
+    seg: Segment  # inner document space (fields named with full paths)
+    parent_of: np.ndarray  # int32[seg.num_docs] -> parent local doc id
 
 
 def _iter_field_values(value: Any) -> list[Any]:
@@ -167,6 +182,22 @@ class SegmentBuilder:
         # to the Python dicts when the library or analyzer doesn't qualify.
         self._native_accs: dict[str, Any] = {}
         self._native_ok: dict[str, bool] = {}
+        # Nested paths: each accumulates its objects in a sub-builder over
+        # the path's scope mappings, plus the parent doc of every object.
+        self._nested: dict[str, tuple["SegmentBuilder", list[int]]] = {}
+
+    def _nested_candidate(self, path: str) -> tuple["SegmentBuilder", list[int]]:
+        """The accumulator a nested object WOULD commit into — existing or
+        freshly built, but never registered here: staging must not touch
+        builder state (a rejected write would otherwise leave a ghost
+        empty nested block), so registration happens in _commit_doc."""
+        acc = self._nested.get(path)
+        if acc is None:
+            scope = self.mappings.nested.get(path)
+            if scope is None:  # defensive; NESTED mappings always have one
+                scope = Mappings(analysis=self.mappings.analysis)
+            acc = (SegmentBuilder(scope), [])
+        return acc
 
     def _field_uses_native(self, field_name: str, analyzer) -> bool:
         cached = self._native_ok.get(field_name)
@@ -267,6 +298,115 @@ class SegmentBuilder:
             v0 = vals[0]  # multi-valued numerics keep first value for now
             staged_numeric.append((field_name, coerce_numeric(fm.type, v0)))
 
+    def _collect_values(
+        self,
+        prefix: str,
+        value: Any,
+        flat: dict[str, tuple[Any, list[Any]]],
+        nested_ops: list[tuple[str, dict[str, Any]]],
+    ) -> None:
+        """Flatten one source entry into leaf (field -> values) pairs.
+
+        Objects flatten to dotted paths and arrays of objects merge their
+        leaves as multi-values (the reference's ObjectMapper/DocumentParser
+        behavior); values under a `nested`-mapped path route to nested_ops
+        instead, one hidden sub-document per object."""
+        fm = self.mappings.resolve_dynamic(prefix, value)
+        if fm is not None and fm.type == NESTED:
+            for obj in value if isinstance(value, list) else [value]:
+                if not isinstance(obj, dict):
+                    raise ValueError(
+                        f"object mapping for [{prefix}] tried to parse "
+                        f"field as object, but found a concrete value"
+                    )
+                nested_ops.append((prefix, obj))
+            return
+        if isinstance(value, dict):
+            if fm is not None and fm.type not in ("object", "nested"):
+                raise ValueError(
+                    f"failed to parse field [{prefix}] of type [{fm.type}]: "
+                    f"found an object value"
+                )
+            for k, v in value.items():
+                if v is None:
+                    continue
+                self._collect_values(f"{prefix}.{k}", v, flat, nested_ops)
+            return
+        if isinstance(value, list) and any(
+            isinstance(v, dict) for v in value
+        ):
+            for obj in value:
+                if obj is None:
+                    continue
+                if not isinstance(obj, dict):
+                    raise ValueError(
+                        f"mapper [{prefix}] cannot mix objects and "
+                        f"concrete values in one array"
+                    )
+                self._collect_values(prefix, obj, flat, nested_ops)
+            return
+        if fm is None:
+            return
+        if fm.type == "object":
+            # A concrete value where an object is mapped: the reference
+            # rejects this with mapper_parsing_exception rather than
+            # silently dropping the data.
+            raise ValueError(
+                f"object mapping for [{prefix}] tried to parse field "
+                f"[{prefix}] as object, but found a concrete value"
+            )
+        values = _iter_field_values(value)
+        if not values:  # empty arrays index nothing (routine ES docs)
+            return
+        entry = flat.get(prefix)
+        if entry is None:
+            flat[prefix] = (fm, values)
+        else:
+            entry[1].extend(values)
+
+    def _stage_doc(self, source: dict[str, Any]):
+        """Validation pass: analyze/coerce everything, touch no state."""
+        staged_vectors: list[tuple[str, np.ndarray]] = []
+        staged_postings: list[tuple[str, dict[str, int], int]] = []
+        staged_numeric: list[tuple[str, float]] = []
+        flat: dict[str, tuple[Any, list[Any]]] = {}
+        nested_ops: list[tuple[str, dict[str, Any]]] = []
+        for source_name, value in source.items():
+            if value is None:
+                continue
+            self._collect_values(source_name, value, flat, nested_ops)
+        for field_name, (root_fm, values) in flat.items():
+            value = values if len(values) > 1 else values[0]
+            # Multi-fields: the same source value indexes under the parent
+            # AND every "<name>.<sub>" sub-field with its own mapping
+            # (FieldMapper multiFields).
+            targets = [(field_name, root_fm)] + [
+                (f"{field_name}.{sub}", sub_fm)
+                for sub, sub_fm in root_fm.fields.items()
+            ]
+            for target_name, fm in targets:
+                self._stage_field(
+                    target_name,
+                    fm,
+                    value,
+                    staged_vectors,
+                    staged_postings,
+                    staged_numeric,
+                )
+        staged_nested = []
+        candidates: dict[str, tuple] = {}
+        for path, obj in nested_ops:
+            acc = candidates.get(path)
+            if acc is None:
+                acc = self._nested_candidate(path)
+                candidates[path] = acc
+            sub_builder, _parents = acc
+            prefixed = {f"{path}.{k}": v for k, v in obj.items()}
+            staged_nested.append(
+                (path, acc, prefixed, sub_builder._stage_doc(prefixed))
+            )
+        return staged_vectors, staged_postings, staged_numeric, staged_nested
+
     def add(
         self,
         source: dict[str, Any],
@@ -277,38 +417,19 @@ class SegmentBuilder:
         """Index one document; returns its local doc id.
 
         Atomic: everything that can fail (mapping validation, analysis,
-        coercion) runs in a staging pass that touches no builder state, so a
-        mapper_parsing failure leaves the buffer exactly as it was — the
-        engine relies on this to avoid ghost/partial documents on rejected
-        writes (the reference gets the same guarantee from Lucene's
-        per-document addDocument atomicity).
+        coercion) runs in a staging pass that touches no builder state —
+        including recursively for every nested object — so a mapper_parsing
+        failure leaves the buffer exactly as it was — the engine relies on
+        this to avoid ghost/partial documents on rejected writes (the
+        reference gets the same guarantee from Lucene's per-document-block
+        addDocuments atomicity).
         """
+        staged = self._stage_doc(source)
+        return self._commit_doc(source, doc_id, version, seqno, staged)
+
+    def _commit_doc(self, source, doc_id, version, seqno, staged) -> int:
         local = len(self._sources)
-        staged_vectors: list[tuple[str, np.ndarray]] = []
-        staged_postings: list[tuple[str, dict[str, int], int]] = []
-        staged_numeric: list[tuple[str, float]] = []
-        for source_name, value in source.items():
-            if value is None:
-                continue
-            root_fm = self.mappings.resolve_dynamic(source_name, value)
-            if root_fm is None:
-                continue
-            # Multi-fields: the same source value indexes under the parent
-            # AND every "<name>.<sub>" sub-field with its own mapping
-            # (FieldMapper multiFields).
-            targets = [(source_name, root_fm)] + [
-                (f"{source_name}.{sub}", sub_fm)
-                for sub, sub_fm in root_fm.fields.items()
-            ]
-            for field_name, fm in targets:
-                self._stage_field(
-                    field_name,
-                    fm,
-                    value,
-                    staged_vectors,
-                    staged_postings,
-                    staged_numeric,
-                )
+        staged_vectors, staged_postings, staged_numeric, staged_nested = staged
         # ---- commit phase: nothing below raises -------------------------
         self._sources.append(source)
         self._ids.append(doc_id if doc_id is not None else str(local))
@@ -354,6 +475,11 @@ class SegmentBuilder:
                 self._lengths.setdefault(field_name, {})[local] = total_len
         for field_name, v in staged_numeric:
             self._numeric.setdefault(field_name, {})[local] = v
+        for path, acc, prefixed, sub_staged in staged_nested:
+            self._nested.setdefault(path, acc)
+            sub_builder, parents = acc
+            sub_builder._commit_doc(prefixed, None, 1, -1, sub_staged)
+            parents.append(local)
         return local
 
     def build(self) -> Segment:
@@ -440,6 +566,13 @@ class SegmentBuilder:
             for doc, vec in by_doc.items():
                 mat[doc] = vec
             vectors[fname] = mat
+        nested = {
+            path: NestedBlock(
+                seg=sub_builder.build(),
+                parent_of=np.asarray(parents, dtype=np.int32),
+            )
+            for path, (sub_builder, parents) in sorted(self._nested.items())
+        }
         return Segment(
             num_docs=n,
             fields=fields,
@@ -449,6 +582,7 @@ class SegmentBuilder:
             ids=list(self._ids),
             versions=np.asarray(self._versions, dtype=np.int64),
             seqnos=np.asarray(self._seqnos, dtype=np.int64),
+            nested=nested,
         )
 
     def _norms_present(self, fname: str, n: int):

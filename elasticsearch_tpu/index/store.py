@@ -26,13 +26,17 @@ from typing import Any
 
 import numpy as np
 
-from .segment import FieldIndex, Segment
+from .segment import FieldIndex, NestedBlock, Segment
 
 _COMMIT = "commit.json"
 
 
-def persist_segment(path: str, seg_id: int, segment: Segment) -> None:
-    """Write one immutable segment (postings + doc values + sources)."""
+def _segment_arrays(
+    segment: Segment, key_prefix: str = ""
+) -> tuple[dict[str, np.ndarray], dict[str, Any]]:
+    """Flatten one segment into (npz arrays, JSON meta); nested blocks
+    recurse with a path-indexed key prefix so everything lives in the same
+    npz/meta pair."""
     arrays: dict[str, np.ndarray] = {}
     meta: dict[str, Any] = {
         "num_docs": segment.num_docs,
@@ -42,7 +46,7 @@ def persist_segment(path: str, seg_id: int, segment: Segment) -> None:
         "vectors": list(segment.vectors),
     }
     for i, (name, fld) in enumerate(sorted(segment.fields.items())):
-        pre = f"f{i}"
+        pre = f"{key_prefix}f{i}"
         meta["fields"][name] = {
             "key": pre,
             "terms": fld.terms,
@@ -60,13 +64,99 @@ def persist_segment(path: str, seg_id: int, segment: Segment) -> None:
             arrays[f"{pre}_pos_offsets"] = fld.pos_offsets
             arrays[f"{pre}_positions"] = fld.positions
     for j, (name, col) in enumerate(sorted(segment.doc_values.items())):
-        arrays[f"dv{j}"] = col
+        arrays[f"{key_prefix}dv{j}"] = col
     for j, (name, mat) in enumerate(sorted(segment.vectors.items())):
-        arrays[f"vec{j}"] = mat
+        arrays[f"{key_prefix}vec{j}"] = mat
     if segment.versions is not None:
-        arrays["doc_versions"] = segment.versions
+        arrays[f"{key_prefix}doc_versions"] = segment.versions
     if segment.seqnos is not None:
-        arrays["doc_seqnos"] = segment.seqnos
+        arrays[f"{key_prefix}doc_seqnos"] = segment.seqnos
+    if segment.nested:
+        meta["nested"] = {}
+        for ni, (npath, block) in enumerate(sorted(segment.nested.items())):
+            npre = f"{key_prefix}n{ni}_"
+            sub_arrays, sub_meta = _segment_arrays(block.seg, npre)
+            # Nested object sources are NOT persisted: every object already
+            # exists verbatim inside its parent's _source in the jsonl
+            # sidecar, and the inner segment's sources are not consulted at
+            # search time (fetch reads parent sources).
+            arrays.update(sub_arrays)
+            arrays[f"{npre}parent_of"] = block.parent_of
+            meta["nested"][npath] = {"key": npre, "meta": sub_meta}
+    return arrays, meta
+
+
+def _segment_from(
+    data, meta: dict[str, Any], key_prefix: str = "", sources=None
+) -> Segment:
+    """Inverse of _segment_arrays (sources supplied out-of-band for the
+    top level, inline in meta for nested blocks)."""
+    fields: dict[str, FieldIndex] = {}
+    for name, fm in meta["fields"].items():
+        pre = fm["key"]
+        fields[name] = FieldIndex(
+            name=name,
+            terms=fm["terms"],
+            df=data[f"{pre}_df"],
+            offsets=data[f"{pre}_offsets"],
+            doc_ids=data[f"{pre}_doc_ids"],
+            tfs=data[f"{pre}_tfs"],
+            norm_bytes=data[f"{pre}_norm_bytes"],
+            doc_count=fm["doc_count"],
+            sum_total_tf=fm["sum_total_tf"],
+            has_norms=fm["has_norms"],
+            present=data[f"{pre}_present"],
+            pos_offsets=(
+                data[f"{pre}_pos_offsets"]
+                if f"{pre}_pos_offsets" in data
+                else None
+            ),
+            positions=(
+                data[f"{pre}_positions"]
+                if f"{pre}_positions" in data
+                else None
+            ),
+        )
+    doc_values = {
+        name: data[f"{key_prefix}dv{j}"]
+        for j, name in enumerate(sorted(meta["doc_values"]))
+    }
+    vectors = {
+        name: data[f"{key_prefix}vec{j}"]
+        for j, name in enumerate(sorted(meta["vectors"]))
+    }
+    nested = {}
+    for npath, entry in (meta.get("nested") or {}).items():
+        npre = entry["key"]
+        sub_meta = entry["meta"]
+        nested[npath] = NestedBlock(
+            seg=_segment_from(data, sub_meta, npre, sources=[]),
+            parent_of=data[f"{npre}parent_of"],
+        )
+    return Segment(
+        num_docs=meta["num_docs"],
+        fields=fields,
+        doc_values=doc_values,
+        vectors=vectors,
+        sources=sources if sources is not None else [],
+        ids=list(meta["ids"]),
+        versions=(
+            data[f"{key_prefix}doc_versions"]
+            if f"{key_prefix}doc_versions" in data
+            else None
+        ),
+        seqnos=(
+            data[f"{key_prefix}doc_seqnos"]
+            if f"{key_prefix}doc_seqnos" in data
+            else None
+        ),
+        nested=nested,
+    )
+
+
+def persist_segment(path: str, seg_id: int, segment: Segment) -> None:
+    """Write one immutable segment (postings + doc values + sources)."""
+    arrays, meta = _segment_arrays(segment)
     base = os.path.join(path, f"seg-{seg_id}")
     with open(base + ".npz", "wb") as f:
         np.savez(f, **arrays)
@@ -100,53 +190,11 @@ def load_segment(path: str, seg_id: int) -> tuple[Segment, np.ndarray]:
     with open(base + ".meta.json") as f:
         meta = json.load(f)
     data = np.load(base + ".npz")
-    fields: dict[str, FieldIndex] = {}
-    for name, fm in meta["fields"].items():
-        pre = fm["key"]
-        fields[name] = FieldIndex(
-            name=name,
-            terms=fm["terms"],
-            df=data[f"{pre}_df"],
-            offsets=data[f"{pre}_offsets"],
-            doc_ids=data[f"{pre}_doc_ids"],
-            tfs=data[f"{pre}_tfs"],
-            norm_bytes=data[f"{pre}_norm_bytes"],
-            doc_count=fm["doc_count"],
-            sum_total_tf=fm["sum_total_tf"],
-            has_norms=fm["has_norms"],
-            present=data[f"{pre}_present"],
-            pos_offsets=(
-                data[f"{pre}_pos_offsets"]
-                if f"{pre}_pos_offsets" in data
-                else None
-            ),
-            positions=(
-                data[f"{pre}_positions"]
-                if f"{pre}_positions" in data
-                else None
-            ),
-        )
-    doc_values = {
-        name: data[f"dv{j}"]
-        for j, name in enumerate(sorted(meta["doc_values"]))
-    }
-    vectors = {
-        name: data[f"vec{j}"] for j, name in enumerate(sorted(meta["vectors"]))
-    }
     sources = []
     with open(base + ".src.jsonl") as f:
         for line in f:
             sources.append(json.loads(line))
-    segment = Segment(
-        num_docs=meta["num_docs"],
-        fields=fields,
-        doc_values=doc_values,
-        vectors=vectors,
-        sources=sources,
-        ids=list(meta["ids"]),
-        versions=data["doc_versions"] if "doc_versions" in data else None,
-        seqnos=data["doc_seqnos"] if "doc_seqnos" in data else None,
-    )
+    segment = _segment_from(data, meta, sources=sources)
     live_path = base + ".live.npz"
     if os.path.exists(live_path):
         live = np.load(live_path)["live"]

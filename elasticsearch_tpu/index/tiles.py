@@ -31,7 +31,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dc_field
 from typing import Any
 
 import jax
@@ -173,6 +173,11 @@ class DeviceSegment:
     # Host-side fetch-phase data:
     sources: list[dict[str, Any]]
     ids: list[str]
+    # Nested blocks: path -> (inner DeviceSegment over the nested-doc
+    # space, parent_of i32[NN] device map). The block-join planes.
+    nested: dict[str, tuple["DeviceSegment", jax.Array]] = dc_field(
+        default_factory=dict
+    )
 
     def field(self, name: str) -> DeviceField:
         try:
@@ -336,6 +341,8 @@ def device_nbytes(seg: DeviceSegment) -> int:
         total += col.nbytes
     for mat in seg.vectors.values():
         total += mat.nbytes
+    for inner, parent_of in seg.nested.values():
+        total += device_nbytes(inner) + parent_of.nbytes
     return int(total)
 
 
@@ -357,6 +364,9 @@ def estimate_segment_device_bytes(segment: Segment) -> int:
     total += 4 * n * len(segment.doc_values)
     for mat in segment.vectors.values():
         total += 4 * n * mat.shape[1]
+    for block in segment.nested.values():
+        total += estimate_segment_device_bytes(block.seg)
+        total += 4 * block.seg.num_docs  # parent_of plane
     return int(total)
 
 
@@ -410,6 +420,13 @@ def pack_segment(
     live[: segment.num_docs] = True
     if deleted is not None and len(deleted):
         live[deleted] = False
+    nested = {
+        path: (
+            pack_segment(block.seg, device=device, k1=k1, b=b),
+            put(block.parent_of.astype(np.int32)),
+        )
+        for path, block in segment.nested.items()
+    }
     return DeviceSegment(
         num_docs=n,
         fields=fields,
@@ -418,6 +435,7 @@ def pack_segment(
         live=put(live),
         sources=segment.sources,
         ids=segment.ids,
+        nested=nested,
     )
 
 

@@ -120,6 +120,8 @@ def _eval_node(spec, arrays, seg: dict[str, Any], num_docs: int):
         )
     if kind == "bool":
         return _eval_bool(spec, arrays, seg, num_docs)
+    if kind == "nested":
+        return _eval_nested(spec, arrays, seg, num_docs)
     if kind == "script":
         return _eval_script(spec, arrays, seg, num_docs)
     if kind == "function_score":
@@ -156,6 +158,57 @@ def _eval_node(spec, arrays, seg: dict[str, Any], num_docs: int):
         scores = jnp.where(matched, scores * arrays["boost"], jnp.float32(0.0))
         return scores, matched
     raise ValueError(f"unknown plan node kind [{kind}]")
+
+
+def _eval_nested(spec, arrays, seg, num_docs):
+    """Nested query: child runs in the path's own document space, then the
+    child-doc results JOIN to parents with one scatter per reduction.
+
+    The TPU form of the reference's block join (NestedQueryBuilder.java:54
+    lowering to ToParentBlockJoinQuery): where Lucene walks each parent's
+    contiguous child range against a parent bitset, here every nested doc
+    of the whole segment scores at once and `parent_of` scatters matches
+    and score reductions (sum/avg/max/min per score_mode) into parent
+    space. Unmatched parents score 0; `none` joins matches only.
+    """
+    _, path, child_spec, score_mode = spec
+    nblk = seg["nested"][path]
+    ntree = nblk["tree"]
+    nn = ntree["live"].shape[0]
+    cs, cm = _eval_node(child_spec, arrays["child"], ntree, nn)
+    cm = cm & ntree["live"]
+    cs = jnp.where(cm, cs, jnp.float32(0.0))
+    parent_of = nblk["parent_of"]  # i32[nn]
+    idx = jnp.where(cm, parent_of, jnp.int32(num_docs))  # sentinel slot
+    matched = jnp.zeros(num_docs + 1, dtype=bool).at[idx].max(cm)[:num_docs]
+    if score_mode == "none":
+        # Lucene ToParentBlockJoinQuery ScoreMode.None: parents match with
+        # score 0 (boost * 0 stays 0, as in the reference).
+        return jnp.zeros(num_docs, dtype=jnp.float32), matched
+    if score_mode in ("sum", "avg"):
+        sums = (
+            jnp.zeros(num_docs + 1, dtype=jnp.float32).at[idx].add(cs)[:num_docs]
+        )
+        if score_mode == "avg":
+            counts = (
+                jnp.zeros(num_docs + 1, dtype=jnp.float32)
+                .at[idx]
+                .add(cm.astype(jnp.float32))[:num_docs]
+            )
+            sums = sums / jnp.maximum(counts, jnp.float32(1.0))
+        reduced = sums
+    elif score_mode in ("max", "min"):
+        sign = jnp.float32(1.0 if score_mode == "max" else -1.0)
+        best = (
+            jnp.full(num_docs + 1, NEG_INF, dtype=jnp.float32)
+            .at[idx]
+            .max(jnp.where(cm, sign * cs, jnp.float32(NEG_INF)))[:num_docs]
+        )
+        reduced = jnp.where(matched, sign * best, jnp.float32(0.0))
+    else:
+        raise ValueError(f"unknown nested score_mode [{score_mode}]")
+    scores = jnp.where(matched, reduced * arrays["boost"], jnp.float32(0.0))
+    return scores, matched
 
 
 def _eval_script(spec, arrays, seg, num_docs):
@@ -1018,4 +1071,8 @@ def segment_tree(device_segment) -> dict[str, Any]:
         "doc_values": dict(device_segment.doc_values),
         "vectors": dict(device_segment.vectors),
         "live": device_segment.live,
+        "nested": {
+            path: {"tree": segment_tree(inner), "parent_of": parent_of}
+            for path, (inner, parent_of) in device_segment.nested.items()
+        },
     }

@@ -399,6 +399,13 @@ class MeshView:
 
         if self.disabled or not self.eligible(request):
             return None
+        if any(
+            h.segment.nested for e in self.engines for h in e.segments
+        ):
+            # Nested blocks are not mesh-stackable yet; without this guard
+            # the mesh compiler (which has no nested context) would lower
+            # nested queries to match_none and serve wrong results.
+            return None
         start = time.monotonic()
         snap = self._ensure()
         idx = snap.index

@@ -180,6 +180,11 @@ class ShardedIndex:
             raise ValueError(
                 f"{len(segments)} segments for a {n_shards}-shard mesh axis"
             )
+        if any(s.nested for s in segments):
+            raise ValueError(
+                "nested blocks are not mesh-stackable yet; serve nested "
+                "indices through the host-loop coordinator"
+            )
         # Uniform schema: every shard carries the union of fields/columns.
         all_fields, all_dv, all_vec = union_schema(segments)
         n_pad = max((s.num_docs for s in segments), default=0)

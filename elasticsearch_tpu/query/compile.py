@@ -74,7 +74,8 @@ def aggregate_field_stats(segments) -> dict[str, FieldStats]:
     stats: dict[str, FieldStats] = {}
     totals: dict[str, list[int]] = {}
     dfs: dict[str, dict[str, int]] = {}
-    for seg in segments:
+
+    def walk(seg):
         for name, fld in seg.fields.items():
             tot = totals.setdefault(name, [0, 0])
             tot[0] += fld.doc_count
@@ -82,6 +83,16 @@ def aggregate_field_stats(segments) -> dict[str, FieldStats]:
             fdfs = dfs.setdefault(name, {})
             for term, tid in fld.terms.items():
                 fdfs[term] = fdfs.get(term, 0) + int(fld.df[tid])
+        # Nested inner fields aggregate at reader level too — the
+        # reference keeps nested sub-documents in the same Lucene index,
+        # so their term statistics cross segment boundaries like any
+        # other field's (full-path names cannot collide with flat fields:
+        # a nested path never doubles as an object path).
+        for block in getattr(seg, "nested", {}).values():
+            walk(block.seg)
+
+    for seg in segments:
+        walk(seg)
     for name, (doc_count, sum_tf) in totals.items():
         stats[name] = FieldStats(
             doc_count=doc_count,
@@ -313,12 +324,17 @@ class Compiler:
         stats: dict[str, FieldStats] | None = None,
         nt_floor: int = 1,
         id_index: Any = None,  # dict[str, int] | zero-arg callable | None
+        nested: dict[str, Any] | None = None,  # path -> (DeviceSegment, map)
     ):
         self.fields = fields
         self.doc_values = doc_values
         self.mappings = mappings
         self.params = params
         self.stats = stats or {}
+        # Nested blocks of the segment being compiled against: path ->
+        # (inner DeviceSegment, parent_of). Child queries of a nested
+        # clause compile against the inner segment's fields/statistics.
+        self.nested = nested or {}
         # _id -> local doc for ids queries: a dict, or a zero-arg callable
         # returning one (so the engine can defer building it until an ids
         # query actually compiles)
@@ -360,6 +376,10 @@ class Compiler:
             }
         if isinstance(q, BoolQuery):
             return self._bool(q, scoring)
+        from .dsl import NestedQuery
+
+        if isinstance(q, NestedQuery):
+            return self._nested_q(q, scoring)
         if isinstance(q, ScriptScoreQuery):
             return self._script_score(q, scoring)
         from .dsl import FunctionScoreQuery
@@ -401,6 +421,49 @@ class Compiler:
                 "children": tuple(a for _, a in children),
             }
         raise ValueError(f"cannot compile query type {type(q).__name__}")
+
+    def _nested_q(self, q, scoring: bool) -> tuple[tuple, Any]:
+        """Lower a nested query: compile the child against the path's inner
+        document space (its own fields, statistics, and nested blocks — so
+        nested-in-nested recurses), emit the block-join spec. A segment
+        with no objects under the path compiles to match_none, like the
+        reference's non-matching BitSetProducer."""
+        scope = self.mappings.nested.get(q.path)
+        if scope is None:
+            if q.ignore_unmapped:
+                return ("match_none",), {}
+            raise ValueError(
+                f"[nested] failed to find nested object under path [{q.path}]"
+            )
+        blk = self.nested.get(q.path)
+        if blk is None:
+            return ("match_none",), {}
+        inner_dev, _parent_of = blk
+        if inner_dev.num_docs == 0 or not (
+            inner_dev.fields or inner_dev.doc_values
+        ):
+            return ("match_none",), {}
+        sub = Compiler(
+            fields=inner_dev.fields,
+            doc_values=inner_dev.doc_values,
+            mappings=scope,
+            params=self.params,
+            # Reader-level statistics flow through: aggregate_field_stats
+            # includes nested inner fields, so the same nested content
+            # scores identically regardless of which segment its parent
+            # landed in. Pack-time tn planes use the inner segment's local
+            # avgdl; the compiler's stats/tn_avgdl comparison falls back
+            # to the norm-cache gather kernel when they have drifted.
+            stats=self.stats,
+            nt_floor=self.nt_floor,
+            nested=inner_dev.nested,
+        )
+        child_spec, child_arrays = sub._node(
+            q.query, scoring=scoring and q.score_mode != "none"
+        )
+        spec = ("nested", q.path, child_spec, q.score_mode)
+        arrays = {"child": child_arrays, "boost": np.float32(q.boost)}
+        return spec, arrays
 
     def _script_score(self, q: ScriptScoreQuery, scoring: bool) -> tuple[tuple, Any]:
         from ..script import compile_script

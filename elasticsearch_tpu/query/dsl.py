@@ -278,6 +278,19 @@ class BoolQuery(Query):
     boost: float = 1.0
 
 
+@dataclass
+class NestedQuery(Query):
+    """Query over one nested path's hidden sub-documents, joined to parents
+    with a per-parent score reduction (NestedQueryBuilder.java:54 lowering
+    to ToParentBlockJoinQuery + ScoreMode)."""
+
+    path: str = ""
+    query: Query = None  # type: ignore[assignment]
+    score_mode: str = "avg"  # avg | sum | max | min | none
+    ignore_unmapped: bool = False
+    boost: float = 1.0
+
+
 def _pop_boost(body: dict) -> float:
     return float(body.get("boost", 1.0))
 
@@ -346,6 +359,21 @@ def parse_query(body: dict[str, Any]) -> Query:
     if kind == "constant_score":
         return ConstantScoreQuery(
             filter=parse_query(spec["filter"]), boost=_pop_boost(spec)
+        )
+    if kind == "nested":
+        if "path" not in spec or "query" not in spec:
+            raise ValueError("[nested] requires [path] and [query]")
+        score_mode = str(spec.get("score_mode", "avg")).lower()
+        if score_mode not in ("avg", "sum", "max", "min", "none"):
+            raise ValueError(
+                f"[nested] unknown score_mode [{score_mode}]"
+            )
+        return NestedQuery(
+            path=str(spec["path"]),
+            query=parse_query(spec["query"]),
+            score_mode=score_mode,
+            ignore_unmapped=bool(spec.get("ignore_unmapped", False)),
+            boost=_pop_boost(spec),
         )
     if kind == "script_score":
         script = spec.get("script", {})

@@ -101,6 +101,10 @@ class OracleSearcher:
             )
         if isinstance(q, MatchNoneQuery):
             return np.zeros(n, np.float32), np.zeros(n, bool)
+        from ..query.dsl import NestedQuery
+
+        if isinstance(q, NestedQuery):
+            return self._nested(q)
         if isinstance(q, MatchQuery):
             return self._match(q)
         if isinstance(q, TermQuery):
@@ -505,6 +509,54 @@ class OracleSearcher:
             matched = ~np.isnan(col)
             return np.where(matched, np.float32(q.boost), np.float32(0.0)), matched
         return np.zeros(n, np.float32), np.zeros(n, bool)
+
+    def _nested(self, q):
+        """Nested block join in numpy — the parity reference for
+        ops/bm25_device._eval_nested (same fp32 reduction order: nested
+        docs accumulate ascending)."""
+        n = self.segment.num_docs
+        zeros = (np.zeros(n, np.float32), np.zeros(n, bool))
+        if self.mappings.nested.get(q.path) is None:
+            if q.ignore_unmapped:
+                return zeros
+            raise ValueError(
+                f"[nested] failed to find nested object under path [{q.path}]"
+            )
+        blk = self.segment.nested.get(q.path)
+        if blk is None or blk.seg.num_docs == 0:
+            return zeros
+        sub = OracleSearcher(
+            blk.seg, self.mappings.nested[q.path], self.params
+        )
+        cs, cm = sub._eval(q.query)
+        parent = blk.parent_of[cm]
+        child = cs[cm].astype(np.float32)
+        matched = np.zeros(n, dtype=bool)
+        matched[parent] = True
+        if q.score_mode == "none":
+            return np.zeros(n, np.float32), matched
+        if q.score_mode in ("sum", "avg"):
+            sums = np.zeros(n, dtype=np.float32)
+            np.add.at(sums, parent, child)
+            if q.score_mode == "avg":
+                counts = np.zeros(n, dtype=np.float32)
+                np.add.at(counts, parent, np.float32(1.0))
+                sums = sums / np.maximum(counts, np.float32(1.0))
+            reduced = sums
+        elif q.score_mode == "max":
+            best = np.full(n, -np.inf, dtype=np.float32)
+            np.maximum.at(best, parent, child)
+            reduced = np.where(matched, best, np.float32(0.0))
+        elif q.score_mode == "min":
+            worst = np.full(n, np.inf, dtype=np.float32)
+            np.minimum.at(worst, parent, child)
+            reduced = np.where(matched, worst, np.float32(0.0))
+        else:
+            raise ValueError(f"unknown nested score_mode [{q.score_mode}]")
+        scores = np.where(
+            matched, reduced * np.float32(q.boost), np.float32(0.0)
+        ).astype(np.float32)
+        return scores, matched
 
     def _bool(self, q: BoolQuery):
         n = self.segment.num_docs

@@ -1,0 +1,358 @@
+"""Nested field type, nested query (block join), and object flattening.
+
+Reference semantics: index/mapper/NestedObjectMapper.java (hidden
+sub-documents), index/query/NestedQueryBuilder.java:54 (score_mode join via
+ToParentBlockJoinQuery), ObjectMapper/DocumentParser (object flattening,
+arrays of objects flattening without a nested mapping).
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.index.tiles import pack_segment
+from elasticsearch_tpu.ops import bm25_device
+from elasticsearch_tpu.query.compile import Compiler
+from elasticsearch_tpu.query.dsl import parse_query
+from elasticsearch_tpu.search.oracle import OracleSearcher
+from elasticsearch_tpu.search.service import SearchRequest, SearchService
+
+MAPPINGS = {
+    "properties": {
+        "title": {"type": "text"},
+        "user": {
+            "type": "object",
+            "properties": {"name": {"type": "keyword"}},
+        },
+        "comments": {
+            "type": "nested",
+            "properties": {
+                "author": {"type": "keyword"},
+                "body": {"type": "text"},
+                "stars": {"type": "long"},
+            },
+        },
+    }
+}
+
+DOCS = [
+    {
+        "title": "alpha post",
+        "user": {"name": "ann"},
+        "comments": [
+            {"author": "bob", "body": "great post indeed", "stars": 5},
+            {"author": "cat", "body": "terrible take", "stars": 1},
+        ],
+    },
+    {
+        "title": "beta post",
+        "user": {"name": "bob"},
+        "comments": [
+            {"author": "bob", "body": "meh post", "stars": 3},
+        ],
+    },
+    {
+        "title": "gamma post",
+        "user": {"name": "cat"},
+        "comments": [
+            {"author": "dan", "body": "great great great", "stars": 4},
+            {"author": "bob", "body": "nope", "stars": 2},
+        ],
+    },
+    {"title": "delta no comments", "user": {"name": "dan"}},
+]
+
+
+def test_mappings_nested_and_object_registration():
+    m = Mappings.from_json(MAPPINGS)
+    assert m.get("user.name").type == "keyword"
+    assert m.get("comments").type == "nested"
+    assert "comments" in m.nested
+    scope = m.nested["comments"]
+    assert scope.get("comments.author").type == "keyword"
+    assert scope.get("comments.body").type == "text"
+    # Round trip keeps the structure.
+    again = Mappings.from_json(m.to_json())
+    assert again.get("user.name").type == "keyword"
+    assert "comments" in again.nested
+    assert again.nested["comments"].get("comments.stars").type == "long"
+
+
+def test_builder_produces_nested_blocks_and_flattens_objects():
+    m = Mappings.from_json(MAPPINGS)
+    b = SegmentBuilder(m)
+    for i, d in enumerate(DOCS):
+        b.add(d, f"d{i}")
+    seg = b.build()
+    assert seg.num_docs == 4
+    # Object flattened: user.name searchable as keyword postings.
+    assert "user.name" in seg.fields
+    blk = seg.nested["comments"]
+    assert blk.seg.num_docs == 5
+    assert list(blk.parent_of) == [0, 0, 1, 2, 2]
+    assert "comments.body" in blk.seg.fields
+    assert "comments.stars" in blk.seg.doc_values
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    m = Mappings.from_json(MAPPINGS)
+    b = SegmentBuilder(m)
+    for i, d in enumerate(DOCS):
+        b.add(d, f"d{i}")
+    seg = b.build()
+    dev = pack_segment(seg)
+    return m, seg, dev
+
+
+@pytest.mark.parametrize("mode", ["avg", "sum", "max", "min", "none"])
+def test_nested_device_oracle_parity(corpus, mode):
+    import jax
+
+    m, seg, dev = corpus
+    tree = bm25_device.segment_tree(dev)
+    compiler = Compiler(dev.fields, dev.doc_values, m, nested=dev.nested)
+    oracle = OracleSearcher(seg, m)
+    query = parse_query(
+        {
+            "nested": {
+                "path": "comments",
+                "query": {"match": {"comments.body": "great post"}},
+                "score_mode": mode,
+            }
+        }
+    )
+    c = compiler.compile(query)
+    d_s, d_i, d_t = jax.device_get(
+        bm25_device.execute(tree, c.spec, c.arrays, 4)
+    )
+    o_s, o_i, o_t = oracle.search(query, 4)
+    n = len(o_i)
+    assert list(d_i[:n]) == list(o_i), mode
+    np.testing.assert_allclose(d_s[:n], o_s, rtol=2e-6)
+    assert int(d_t) == o_t
+
+
+def test_nested_with_filter_and_bool(corpus):
+    import jax
+
+    m, seg, dev = corpus
+    tree = bm25_device.segment_tree(dev)
+    compiler = Compiler(dev.fields, dev.doc_values, m, nested=dev.nested)
+    oracle = OracleSearcher(seg, m)
+    # Both conditions must hold on the SAME nested object: doc0 has a
+    # 5-star comment by bob; doc2 has bob (2 stars) and 4 stars (dan) —
+    # flattened semantics would wrongly match doc2.
+    query = parse_query(
+        {
+            "nested": {
+                "path": "comments",
+                "query": {
+                    "bool": {
+                        "must": [{"term": {"comments.author": "bob"}}],
+                        "filter": [{"range": {"comments.stars": {"gte": 4}}}],
+                    }
+                },
+            }
+        }
+    )
+    c = compiler.compile(query)
+    d_s, d_i, d_t = jax.device_get(
+        bm25_device.execute(tree, c.spec, c.arrays, 4)
+    )
+    assert int(d_t) == 1 and int(d_i[0]) == 0
+    o_s, o_i, o_t = oracle.search(query, 4)
+    assert o_t == 1 and list(o_i) == [0]
+
+
+def test_nested_unmapped_path(corpus):
+    m, seg, dev = corpus
+    compiler = Compiler(dev.fields, dev.doc_values, m, nested=dev.nested)
+    bad = parse_query(
+        {"nested": {"path": "nope", "query": {"match_all": {}}}}
+    )
+    with pytest.raises(ValueError, match="nested"):
+        compiler.compile(bad)
+    ok = parse_query(
+        {
+            "nested": {
+                "path": "nope",
+                "query": {"match_all": {}},
+                "ignore_unmapped": True,
+            }
+        }
+    )
+    assert compiler.compile(ok).spec == ("match_none",)
+
+
+def test_nested_through_engine_and_rest_service(tmp_path):
+    eng = Engine(Mappings.from_json(MAPPINGS), data_path=str(tmp_path))
+    for i, d in enumerate(DOCS):
+        eng.index(d, doc_id=f"d{i}")
+    eng.refresh()
+    svc = SearchService(eng)
+    resp = svc.search(
+        SearchRequest.from_json(
+            {
+                "query": {
+                    "nested": {
+                        "path": "comments",
+                        "query": {"match": {"comments.body": "great"}},
+                        "score_mode": "max",
+                    }
+                }
+            }
+        )
+    )
+    body = resp.to_json()
+    ids = [h["_id"] for h in body["hits"]["hits"]]
+    assert set(ids) == {"d0", "d2"}
+    # Sources come back whole, nested objects intact.
+    src = body["hits"]["hits"][0]["_source"]
+    assert isinstance(src["comments"], list)
+    # Object-flattened field is searchable.
+    resp2 = svc.search(
+        SearchRequest.from_json(
+            {"query": {"term": {"user.name": "ann"}}}
+        )
+    )
+    assert [h["_id"] for h in resp2.to_json()["hits"]["hits"]] == ["d0"]
+
+
+def test_nested_durability_roundtrip(tmp_path):
+    from elasticsearch_tpu.index.store import load_segment, persist_segment
+
+    m = Mappings.from_json(MAPPINGS)
+    b = SegmentBuilder(m)
+    for i, d in enumerate(DOCS):
+        b.add(d, f"d{i}")
+    seg = b.build()
+    persist_segment(str(tmp_path), 0, seg)
+    loaded, live = load_segment(str(tmp_path), 0)
+    assert live.all()
+    blk = loaded.nested["comments"]
+    assert blk.seg.num_docs == 5
+    assert list(blk.parent_of) == [0, 0, 1, 2, 2]
+    assert "comments.body" in blk.seg.fields
+    # Loaded segment answers nested queries identically.
+    o1 = OracleSearcher(seg, m)
+    o2 = OracleSearcher(loaded, m)
+    q = parse_query(
+        {
+            "nested": {
+                "path": "comments",
+                "query": {"match": {"comments.body": "great post"}},
+            }
+        }
+    )
+    s1, i1, t1 = o1.search(q, 4)
+    s2, i2, t2 = o2.search(q, 4)
+    assert list(i1) == list(i2) and t1 == t2
+    np.testing.assert_array_equal(s1, s2)
+
+
+def test_empty_array_is_a_noop():
+    m = Mappings(properties={"title": {"type": "text"}})
+    b = SegmentBuilder(m)
+    b.add({"title": [], "tags": []}, "a")
+    b.add({"title": "real doc"}, "b")
+    seg = b.build()
+    assert seg.num_docs == 2
+    fld = seg.fields["title"]
+    assert fld.doc_count == 1  # the empty-array doc indexed nothing
+
+
+def test_rejected_write_leaves_no_ghost_nested_block():
+    m = Mappings.from_json(MAPPINGS)
+    b = SegmentBuilder(m)
+    with pytest.raises(ValueError):
+        b.add({"comments": [{"stars": "not-a-number"}]}, "bad")
+    seg = b.build()
+    assert seg.nested == {}  # no ghost empty block
+    # And the engine stays mesh-eligible / nested-free.
+    b2 = SegmentBuilder(m)
+    with pytest.raises(ValueError):
+        b2.add(
+            {"comments": [{"stars": 4}, {"stars": "nope"}]}, "bad2"
+        )
+    assert b2.build().nested == {}
+
+
+def test_concrete_value_for_object_field_rejected():
+    m = Mappings.from_json(MAPPINGS)
+    b = SegmentBuilder(m)
+    with pytest.raises(ValueError, match="object"):
+        b.add({"user": "bob"}, "x")
+    with pytest.raises(ValueError, match="found an object"):
+        b.add({"title": {"oops": 1}}, "y")
+
+
+def test_to_json_lossless_for_deep_dynamic_and_nested_leaves():
+    m = Mappings.from_json(MAPPINGS)
+    b = SegmentBuilder(m)
+    # Deep dynamic object + dynamic leaf under a nested path.
+    b.add(
+        {
+            "a": {"b": {"c": 1}},
+            "comments": [{"author": "x", "newfield": "hello"}],
+        },
+        "d0",
+    )
+    again = Mappings.from_json(m.to_json())
+    assert again.get("a.b.c") is not None and again.get("a.b.c").type == "long"
+    assert again.nested["comments"].get("comments.newfield") is not None
+
+
+def test_nested_stats_aggregate_across_segments():
+    """Same nested content in two segments scores identically (reader-level
+    statistics — InternalSum-style drift guard for nested BM25)."""
+    eng = Engine(Mappings.from_json(MAPPINGS))
+    eng.index(
+        {"title": "one", "comments": [{"body": "excellent analysis"}]},
+        doc_id="a",
+    )
+    eng.refresh()  # segment 1
+    eng.index(
+        {"title": "two", "comments": [{"body": "excellent analysis"}]},
+        doc_id="b",
+    )
+    eng.refresh()  # segment 2
+    svc = SearchService(eng)
+    resp = svc.search(
+        SearchRequest.from_json(
+            {
+                "query": {
+                    "nested": {
+                        "path": "comments",
+                        "query": {"match": {"comments.body": "excellent"}},
+                    }
+                }
+            }
+        )
+    ).to_json()
+    hits = resp["hits"]["hits"]
+    assert len(hits) == 2
+    assert hits[0]["_score"] == hits[1]["_score"], hits
+
+
+def test_dynamic_object_flattening():
+    m = Mappings()  # fully dynamic
+    b = SegmentBuilder(m)
+    b.add({"a": {"b": "hello world", "c": 7}}, "x")
+    b.add({"a": {"b": "goodbye"}}, "y")
+    # Array of objects without nested mapping FLATTENS (multi-values).
+    b.add({"tags": [{"k": "red"}, {"k": "blue"}]}, "z")
+    seg = b.build()
+    assert "a.b" in seg.fields
+    assert "a.c" in seg.doc_values
+    oracle = OracleSearcher(seg, m)
+    _, ids, total = oracle.search(
+        parse_query({"match": {"a.b": "hello"}}), 3
+    )
+    assert total == 1 and list(ids) == [0]
+    _, ids, total = oracle.search(
+        parse_query({"match": {"tags.k": "blue"}}), 3
+    )
+    assert total == 1 and list(ids) == [2]
