@@ -120,6 +120,16 @@ def _eval_node(spec, arrays, seg: dict[str, Any], num_docs: int):
         )
     if kind == "bool":
         return _eval_bool(spec, arrays, seg, num_docs)
+    if kind == "boosting":
+        _, pos_spec, neg_spec = spec
+        ps, pm = _eval_node(pos_spec, arrays["positive"], seg, num_docs)
+        _, nm = _eval_node(neg_spec, arrays["negative"], seg, num_docs)
+        # BoostingQueryBuilder: negative matches are demoted, not excluded.
+        factor = jnp.where(nm, arrays["negative_boost"], jnp.float32(1.0))
+        scores = jnp.where(pm, ps * factor * arrays["boost"], jnp.float32(0.0))
+        return scores, pm
+    if kind == "terms_set":
+        return _eval_terms_set(spec, arrays, seg, num_docs)
     if kind == "nested":
         return _eval_nested(spec, arrays, seg, num_docs)
     if kind == "script":
@@ -128,6 +138,10 @@ def _eval_node(spec, arrays, seg: dict[str, Any], num_docs: int):
         return _eval_function_score(spec, arrays, seg, num_docs)
     if kind == "phrase":
         return _eval_phrase(spec, arrays, seg, num_docs)
+    if kind == "span_near":
+        return _eval_span_near(spec, arrays, seg, num_docs)
+    if kind == "span_not":
+        return _eval_span_not(spec, arrays, seg, num_docs)
     if kind == "doc_set":
         docs = arrays["docs"]  # i32[ND], -1 padding
         idx = jnp.where(docs >= 0, docs, num_docs)
@@ -158,6 +172,45 @@ def _eval_node(spec, arrays, seg: dict[str, Any], num_docs: int):
         scores = jnp.where(matched, scores * arrays["boost"], jnp.float32(0.0))
         return scores, matched
     raise ValueError(f"unknown plan node kind [{kind}]")
+
+
+def _eval_terms_set(spec, arrays, seg, num_docs):
+    """terms_set: BM25-sum scoring gated on per-doc term coverage.
+
+    The scored child is the plain terms disjunction; coverage counts come
+    from one matched-only worklist per term (CoveringQuery's per-clause
+    DISI count, all clauses at once); the per-doc requirement reads a
+    doc-values column or evaluates a painless-lite expression inline.
+    Requirements clamp to >= 1 and NaN (missing value) never matches.
+    Ref: TermsSetQueryBuilder -> lucene CoveringQuery.
+    """
+    _, scored_spec, count_specs, msm_kind, msm_ref = spec
+    s, _m = _eval_node(scored_spec, arrays["scored"], seg, num_docs)
+    count = jnp.zeros(num_docs, dtype=jnp.float32)
+    for cspec, carr in zip(count_specs, arrays["counts"]):
+        _, m = _eval_node(cspec, carr, seg, num_docs)
+        count = count + m.astype(jnp.float32)
+    if msm_kind == "field":
+        required = seg["doc_values"][msm_ref]
+    else:
+        from ..script import compile_script
+
+        source, _names = msm_ref
+        required = jnp.asarray(
+            compile_script(source).evaluate(
+                jnp,
+                jnp.zeros(num_docs, dtype=jnp.float32),
+                seg["doc_values"],
+                seg.get("vectors", {}),
+                arrays["params"],
+            ),
+            dtype=jnp.float32,
+        )
+        required = jnp.broadcast_to(required, (num_docs,))
+    required = jnp.maximum(required, jnp.float32(1.0))  # NaN propagates
+    matched = count >= required  # NaN requirement compares False
+    scores = jnp.where(matched, s * arrays["boost"], jnp.float32(0.0))
+    return scores, matched
 
 
 def _eval_nested(spec, arrays, seg, num_docs):
@@ -400,6 +453,145 @@ def _eval_phrase(spec, arrays, seg, num_docs):
     scores = w - w / (jnp.float32(1.0) + freq * ninv)
     scores = jnp.where(matched, scores, jnp.float32(0.0))
     return scores, matched
+
+
+def _segmented_cummax(seg_ids, vals):
+    """Inclusive per-segment running max (segments = equal seg_ids runs).
+
+    The classic segmented-scan combine is associative, so it lowers to
+    XLA's log-depth associative_scan rather than a sequential loop.
+    """
+
+    def combine(a, b):
+        ia, va = a
+        ib, vb = b
+        return ib, jnp.where(ia == ib, jnp.maximum(va, vb), vb)
+
+    _, out = jax.lax.associative_scan(combine, (seg_ids, vals))
+    return out
+
+
+def _gather_span_events(arrays, seg, field_name, num_docs):
+    """Flatten + sort a positions worklist to (doc, pos, clause) events.
+
+    Shared by the span kernels: the unit-span form of the phrase kernel's
+    gather — every position occurrence of every clause term, sorted by
+    (doc, pos, clause); invalid slots carry doc = num_docs (sentinel)."""
+    pos_doc_tiles, pos_val_tiles = seg["positions"][field_name]
+    tile_ids = arrays["tile_ids"]  # i32[NT]
+    docs = pos_doc_tiles[tile_ids]  # i32[NT, S]
+    poss = pos_val_tiles[tile_ids]  # i32[NT, S]
+    pos_idx = tile_ids[:, None] * TILE + jnp.arange(TILE, dtype=jnp.int32)
+    valid = (pos_idx >= arrays["starts"][:, None]) & (
+        pos_idx < arrays["ends"][:, None]
+    )
+    clause = jnp.broadcast_to(arrays["clause_of"][:, None], docs.shape)
+    sentinel = jnp.int32(num_docs)
+    doc_key = jnp.where(valid, docs, sentinel).reshape(-1)
+    pos_key = jnp.where(valid, poss, jnp.int32(2**30)).reshape(-1)
+    clause_key = jnp.where(valid, clause, jnp.int32(0)).reshape(-1)
+    return jax.lax.sort((doc_key, pos_key, clause_key), num_keys=3)
+
+
+def _span_chain_ends(d_s, p_s, c_s, n_clauses: int, slop: int):
+    """Events that END an ordered chain c0 < c1 < ... < c{n-1} with total
+    stretch <= slop. dp[l] at an event of clause l = the LARGEST reachable
+    chain start p0 (greedy max-start is optimal: the slop constraint only
+    involves p0 and the end position)."""
+    neg = jnp.float32(-(2.0**31))
+    pf = p_s.astype(jnp.float32)
+    dp = jnp.where(c_s == 0, pf, neg)
+    idx = jnp.arange(d_s.shape[0], dtype=jnp.int32)
+    # First index of each (doc, pos) group, for STRICT pos ordering.
+    is_new = jnp.concatenate(
+        [
+            jnp.ones(1, dtype=bool),
+            (d_s[1:] != d_s[:-1]) | (p_s[1:] != p_s[:-1]),
+        ]
+    )
+    group_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_new, idx, jnp.int32(-1))
+    )
+    prev_idx = jnp.maximum(group_start - 1, 0)
+    has_prev = (group_start > 0) & (d_s[prev_idx] == d_s)
+    for level in range(1, n_clauses):
+        vals = jnp.where(c_s == level - 1, dp, neg)
+        run = _segmented_cummax(d_s, vals)
+        carry = jnp.where(has_prev, run[prev_idx], neg)
+        dp = jnp.where(c_s == level, carry, neg)
+    ok = (c_s == n_clauses - 1) & (dp > neg)
+    stretch = pf - dp - jnp.float32(n_clauses - 1)
+    return ok & (stretch <= jnp.float32(slop))
+
+
+def _span_freq_scores(seg, field_name, d_s, ok, weight, cache, num_docs):
+    """Occurrence count -> BM25, exactly the phrase kernel's scoring."""
+    sentinel = jnp.int32(num_docs)
+    freq_idx = jnp.where(ok & (d_s != sentinel), d_s, sentinel)
+    freq = (
+        jnp.zeros(num_docs + 1, dtype=jnp.float32)
+        .at[freq_idx]
+        .add((ok & (d_s != sentinel)).astype(jnp.float32))[:num_docs]
+    )
+    matched = freq > 0
+    norm_bytes = seg["fields"][field_name][3]
+    ninv = cache[norm_bytes[:num_docs]]
+    w = weight
+    scores = w - w / (jnp.float32(1.0) + freq * ninv)
+    scores = jnp.where(matched, scores, jnp.float32(0.0))
+    return scores, matched
+
+
+def _eval_span_near(spec, arrays, seg, num_docs):
+    """span_near / span_or / span_first over unit spans.
+
+    The TPU form of Lucene's NearSpansOrdered/Unordered zipper
+    (SpanNearQueryBuilder): all clause positions gather at once, a
+    log-depth segmented-scan DP finds chain ends, and occurrences scatter
+    to per-doc frequencies. Matching sets are exact for unit-span clauses;
+    scoring uses freq = chain-end count with the summed-idf weight (the
+    reference's SloppySimScorer weights each span 1/(1+stretch) — a
+    scoring refinement over the same matched set, noted divergence).
+    """
+    _, field_name, _nt, n_clauses, slop, ordered, end_limit = spec
+    d_s, p_s, c_s = _gather_span_events(arrays, seg, field_name, num_docs)
+    ok = _span_chain_ends(d_s, p_s, c_s, n_clauses, slop)
+    if not ordered and n_clauses == 2:
+        ok = ok | _span_chain_ends(
+            d_s, p_s, jnp.int32(1) - c_s, n_clauses, slop
+        )
+    if end_limit >= 0:
+        ok = ok & (p_s + 1 <= jnp.int32(end_limit))
+    return _span_freq_scores(
+        seg, field_name, d_s, ok, arrays["weight"], arrays["cache"], num_docs
+    )
+
+
+def _eval_span_not(spec, arrays, seg, num_docs):
+    """span_not over unit spans: include positions with no exclude
+    position in [p-pre, p+post] (SpanNotQueryBuilder). Clause 0 =
+    include, clause 1 = exclude; violation checks are two segmented scans
+    (nearest exclude at-or-before from the left, at-or-after from the
+    right)."""
+    _, field_name, _nt, pre, post = spec
+    d_s, p_s, c_s = _gather_span_events(arrays, seg, field_name, num_docs)
+    pf = p_s.astype(jnp.float32)
+    neg = jnp.float32(-(2.0**31))
+    posv = jnp.float32(2.0**31)
+    # Nearest exclude position <= p (inclusive scan; same-(doc,pos)
+    # excludes sort after includes but are caught by the backward scan).
+    before = _segmented_cummax(d_s, jnp.where(c_s == 1, pf, neg))
+    # Nearest exclude position >= p: reverse, negate, scan, undo.
+    after = -_segmented_cummax(
+        d_s[::-1], jnp.where(c_s[::-1] == 1, -pf[::-1], neg)
+    )[::-1]
+    violated = (before >= pf - jnp.float32(pre)) | (
+        after <= pf + jnp.float32(post)
+    )
+    ok = (c_s == 0) & ~violated
+    return _span_freq_scores(
+        seg, field_name, d_s, ok, arrays["weight"], arrays["cache"], num_docs
+    )
 
 
 def _terms_matched(spec, arrays, seg, num_docs):

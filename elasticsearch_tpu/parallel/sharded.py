@@ -445,12 +445,28 @@ class _PlanField:
 def _max_nt(spec: tuple) -> int:
     """Largest worklist bucket anywhere in a compiled spec."""
     kind = spec[0]
-    if kind in ("terms", "terms_const", "terms_gather", "phrase"):
+    if kind in ("terms", "terms_const", "terms_gather", "phrase",
+                "span_near", "span_not"):
         return spec[2]
     if kind == "doc_set":
         return spec[1]
     if kind in ("const", "script"):
         return _max_nt(spec[1])
+    if kind == "nested":
+        return _max_nt(spec[2])
+    if kind == "boosting":
+        return max(_max_nt(spec[1]), _max_nt(spec[2]))
+    if kind == "terms_set":
+        return max(
+            _max_nt(spec[1]),
+            max((_max_nt(c) for c in spec[2]), default=1),
+        )
+    if kind == "function_score":
+        out = _max_nt(spec[1])
+        for fil in spec[3]:
+            if fil is not None:
+                out = max(out, _max_nt(fil))
+        return out
     if kind == "dismax":
         return max((_max_nt(c) for c in spec[1]), default=1)
     if kind == "bool":

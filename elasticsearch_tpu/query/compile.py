@@ -261,6 +261,137 @@ def _wildcard_regex(pattern: str, case_insensitive: bool):
     return re.compile("".join(parts), re.IGNORECASE if case_insensitive else 0)
 
 
+def regexp_pattern(value: str, case_insensitive: bool):
+    """Lucene RegExp core syntax -> a compiled Python regex (fullmatch).
+
+    The core operators — `.` `?` `+` `*` `|` `(` `)` `[` `]` `{` `}` and
+    backslash escapes — have identical meaning in Python's engine. The
+    OPTIONAL Lucene operators (`~` complement, `&` intersection, `<>`
+    numeric interval, `@` any-string, `#` empty) have no Python
+    equivalent; an unescaped use outside a character class is rejected
+    with the reference's error shape rather than silently mis-matched.
+    Ref: RegexpQueryBuilder + lucene RegExp.
+    """
+    import re
+
+    out: list[str] = []
+    in_class = False
+    escaped = False
+    for ch in value:
+        if escaped:
+            # Lucene: backslash escapes the NEXT CHARACTER LITERALLY — there
+            # are no \d/\w/\s classes. Re-escape for Python so e.g. "\\d"
+            # matches the letter d, not digits.
+            out.append(re.escape(ch))
+            escaped = False
+            continue
+        if ch == "\\":
+            escaped = True
+            continue
+        if in_class:
+            out.append(ch)
+            if ch == "]":
+                in_class = False
+            continue
+        if ch == "[":
+            out.append(ch)
+            in_class = True
+            continue
+        if ch in "~&<>@#":
+            raise ValueError(
+                f"unsupported regexp operator [{ch}] in [{value}]; the "
+                f"optional Lucene operators (~ & <> @ #) are not supported"
+            )
+        if ch in "^$":
+            # Lucene RegExp has no anchors: ^ and $ are literal characters
+            # (matching is implicitly whole-term).
+            out.append("\\" + ch)
+            continue
+        out.append(ch)
+    if escaped:
+        raise ValueError(f"invalid regexp [{value}]: trailing backslash")
+    try:
+        return re.compile(
+            "".join(out),
+            re.DOTALL | (re.IGNORECASE if case_insensitive else 0),
+        )
+    except re.error as e:
+        raise ValueError(f"invalid regexp [{value}]: {e}") from None
+
+
+def select_mlt_terms(
+    texts,
+    analyzer,
+    df_of,
+    doc_count: int,
+    min_term_freq: int,
+    min_doc_freq: int,
+    max_query_terms: int,
+) -> list[str]:
+    """The MoreLikeThis term-selection pass (lucene MoreLikeThis
+    retrieveInterestingTerms): analyze the like-texts, keep terms above
+    the tf/df floors, rank by tf*idf, take the top max_query_terms."""
+    import math
+
+    tf: dict[str, int] = {}
+    for text in texts:
+        for tok in analyzer.analyze(str(text)):
+            tf[tok] = tf.get(tok, 0) + 1
+    ranked: list[tuple[float, str]] = []
+    for term, f in tf.items():
+        if f < min_term_freq:
+            continue
+        df = int(df_of(term))
+        if df < min_doc_freq or df <= 0:
+            continue
+        idf = math.log(1.0 + (doc_count - df + 0.5) / (df + 0.5))
+        ranked.append((-f * idf, term))
+    ranked.sort()
+    return [t for _, t in ranked[: max(1, max_query_terms)]]
+
+
+def mlt_to_bool(q, field_ctx):
+    """more_like_this -> bool(should=[term...], msm): the single rewrite
+    shared by the compiler and the oracle. `field_ctx(fname)` returns
+    (analyzer, df_of, doc_count) for a searchable field, or None."""
+    from .dsl import BoolQuery, MatchNoneQuery, TermQuery
+
+    shoulds = []
+    for fname in q.fields:
+        ctx = field_ctx(fname)
+        if ctx is None:
+            continue
+        analyzer, df_of, doc_count = ctx
+        terms = select_mlt_terms(
+            q.like,
+            analyzer,
+            df_of,
+            doc_count,
+            q.min_term_freq,
+            q.min_doc_freq,
+            q.max_query_terms,
+        )
+        shoulds.extend(TermQuery(fname, t) for t in terms)
+    if not shoulds:
+        return MatchNoneQuery()
+    msm = parse_msm_percent(q.minimum_should_match, len(shoulds))
+    return BoolQuery(
+        should=shoulds, minimum_should_match=max(msm, 1), boost=q.boost
+    )
+
+
+def parse_msm_percent(raw: str, n_clauses: int) -> int:
+    """minimum_should_match as "N" or "P%" -> clause count (the common
+    subset of the reference's Queries.calculateMinShouldMatch)."""
+    raw = str(raw).strip()
+    if raw.endswith("%"):
+        pct = float(raw[:-1])
+        if pct < 0:
+            return n_clauses + int(n_clauses * pct / 100.0)
+        return int(n_clauses * pct / 100.0)
+    return int(raw)
+
+
 def _auto_fuzziness(fuzziness, value: str) -> int:
     """The reference's Fuzziness.AUTO ladder: below `low` chars → 0 edits,
     below `high` → 1, else 2; defaults low=3, high=6, overridable as
@@ -402,6 +533,75 @@ class Compiler:
             return self._multi_term(
                 q.field_name, self._fuzzy_terms(q), q.boost
             )
+        from .dsl import (
+            BoostingQuery,
+            MoreLikeThisQuery,
+            RegexpQuery,
+            TermsSetQuery,
+        )
+
+        if isinstance(q, RegexpQuery):
+            return self._multi_term(
+                q.field_name, self._regexp_terms(q), q.boost
+            )
+        from .dsl import (
+            SpanFirstQuery,
+            SpanNearQuery,
+            SpanNotQuery,
+            SpanOrQuery,
+            SpanTermQuery,
+        )
+
+        if isinstance(q, SpanTermQuery):
+            # Lucene rewrites a lone SpanTermQuery's scoring to exactly the
+            # term query's (freq = tf), so compile it as one.
+            dfield = self._field_or_none(q.field_name)
+            if dfield is None:
+                return ("match_none",), {}
+            return self._terms_spec(
+                dfield, [q.value], q.boost, self.stats.get(q.field_name),
+                scored=scoring,
+            )
+        if isinstance(q, SpanOrQuery):
+            field_name, terms = self._span_terms(q)
+            return self._span_near_spec(
+                field_name, [terms], 0, True, -1, q.boost, scoring
+            )
+        if isinstance(q, SpanNearQuery):
+            clause_terms = []
+            fields = set()
+            for c in q.clauses:
+                f, ts = self._span_terms(c)
+                fields.add(f)
+                clause_terms.append(ts)
+            if len(fields) != 1:
+                raise ValueError(
+                    "[span_near] clauses must all target the same field"
+                )
+            return self._span_near_spec(
+                fields.pop(), clause_terms, q.slop, q.in_order, -1,
+                q.boost, scoring,
+            )
+        if isinstance(q, SpanFirstQuery):
+            field_name, terms = self._span_terms(q.match)
+            return self._span_near_spec(
+                field_name, [terms], 0, True, q.end, q.boost, scoring
+            )
+        if isinstance(q, SpanNotQuery):
+            return self._span_not_spec(q, scoring)
+        if isinstance(q, BoostingQuery):
+            pos_spec, pos_arrays = self._node(q.positive, scoring)
+            neg_spec, neg_arrays = self._node(q.negative, scoring=False)
+            return ("boosting", pos_spec, neg_spec), {
+                "positive": pos_arrays,
+                "negative": neg_arrays,
+                "negative_boost": np.float32(q.negative_boost),
+                "boost": np.float32(q.boost),
+            }
+        if isinstance(q, TermsSetQuery):
+            return self._terms_set(q, scoring)
+        if isinstance(q, MoreLikeThisQuery):
+            return self._node(self._rewrite_mlt(q), scoring)
         if isinstance(q, IdsQuery):
             return self._ids(q)
         from .querystring import QueryStringError, QueryStringQuery
@@ -686,6 +886,209 @@ class Compiler:
         return self._terms_spec(
             dfield, terms, boost, self.stats.get(field_name), scored=False
         )
+
+    def _span_terms(self, q) -> tuple[str, list[str]]:
+        from .dsl import span_unit_terms
+
+        return span_unit_terms(q)
+
+    def _span_worklist(self, dfield, clause_terms, boost, scoring,
+                       optional_clauses=()):
+        """Shared positions-worklist lowering for the span kernels: one
+        entry per position tile each clause term touches, carrying the
+        clause id; weight = summed idf over all clause terms (the
+        reference's SpanWeight builds its scorer over every term's
+        statistics)."""
+        field_name = dfield.name
+        if dfield.pos_offsets is None:
+            raise ValueError(
+                f"field [{field_name}] was indexed without positions "
+                f"(keyword fields don't support span queries)"
+            )
+        stats = self.stats.get(field_name)
+        doc_count = stats.doc_count if stats else dfield.doc_count
+        avgdl = stats.avgdl if stats else dfield.avgdl
+        entries: list[tuple[int, int, int, int]] = []  # (tile, ps, pe, cl)
+        w = np.float32(0.0)
+        possible = True
+        for cl, terms in enumerate(clause_terms):
+            clause_alive = False
+            for t in terms:
+                # Weight accumulates under the STATISTICS scope, independent
+                # of whether this shard holds the term's positions — the
+                # cross-segment score-consistency rule: identical docs must
+                # score identically regardless of shard placement.
+                df = (
+                    stats.df.get(t, dfield.term_df(t))
+                    if stats
+                    else dfield.term_df(t)
+                )
+                if scoring and df > 0 and doc_count > 0:
+                    w = np.float32(
+                        w + term_weight(df, doc_count, boost, self.params)
+                    )
+                ps, pe = dfield.term_pos_span(t)
+                if pe <= ps:
+                    continue
+                clause_alive = True
+                first, last = ps // TILE, (pe - 1) // TILE
+                for tile in range(first, last + 1):
+                    entries.append((tile, ps, pe, cl))
+            if not clause_alive and cl not in optional_clauses:
+                possible = False
+        if not possible:
+            entries = []
+            w = np.float32(0.0)
+        nt = _pow2(len(entries), self.nt_floor)
+        tile_ids = np.full(nt, dfield.pos_pad_tile, dtype=np.int32)
+        starts = np.zeros(nt, dtype=np.int32)
+        ends = np.zeros(nt, dtype=np.int32)
+        clause_of = np.zeros(nt, dtype=np.int32)
+        for i, (tile, ps, pe, cl) in enumerate(entries):
+            tile_ids[i] = tile
+            starts[i] = ps
+            ends[i] = pe
+            clause_of[i] = cl
+        cache = norm_inverse_cache(avgdl if doc_count else 1.0, self.params)
+        if not dfield.has_norms:
+            cache = np.full(256, cache[1], dtype=np.float32)
+        arrays = {
+            "tile_ids": tile_ids,
+            "starts": starts,
+            "ends": ends,
+            "clause_of": clause_of,
+            "weight": np.float32(w),
+            "cache": cache,
+        }
+        return nt, arrays
+
+    def _span_near_spec(
+        self, field_name, clause_terms, slop, in_order, end_limit, boost,
+        scoring,
+    ):
+        dfield = self._field_or_none(field_name)
+        if dfield is None:
+            return ("match_none",), {}
+        nt, arrays = self._span_worklist(dfield, clause_terms, boost, scoring)
+        spec = (
+            "span_near",
+            field_name,
+            nt,
+            len(clause_terms),
+            int(slop),
+            bool(in_order),
+            int(end_limit),
+        )
+        return spec, arrays
+
+    def _span_not_spec(self, q, scoring: bool):
+        inc_field, inc_terms = self._span_terms(q.include)
+        exc_field, exc_terms = self._span_terms(q.exclude)
+        if inc_field != exc_field:
+            raise ValueError(
+                "[span_not] include and exclude must target the same field"
+            )
+        dfield = self._field_or_none(inc_field)
+        if dfield is None:
+            return ("match_none",), {}
+        _, inc_only = self._span_worklist(
+            dfield, [inc_terms], q.boost, scoring
+        )
+        # Lower include+exclude (exclude OPTIONAL: a shard without the
+        # exclude terms must still match includes, under the same spec),
+        # but keep the weight from the include terms only (SpanNotQuery
+        # scores the included spans).
+        nt, arrays = self._span_worklist(
+            dfield, [inc_terms, exc_terms], q.boost, scoring,
+            optional_clauses=(1,),
+        )
+        arrays["weight"] = inc_only["weight"]
+        arrays["cache"] = inc_only["cache"]
+        spec = ("span_not", inc_field, nt, int(q.pre), int(q.post))
+        return spec, arrays
+
+    def _regexp_terms(self, q) -> list[str]:
+        dfield = self._field_or_none(q.field_name)
+        if dfield is None:
+            return []
+        regex = regexp_pattern(q.value, q.case_insensitive)
+        return [t for t in dfield.terms if regex.fullmatch(t)]
+
+    def _field_df(self, dfield, stats, term: str) -> int:
+        if stats is not None and term in stats.df:
+            return int(stats.df[term])
+        tid = dfield.terms.get(term)
+        return 0 if tid is None else int(dfield.df[tid])
+
+    def _terms_set(self, q, scoring: bool):
+        """Lower terms_set: one scored disjunction for the BM25 sum plus
+        one per-term matched worklist for the coverage count; the per-doc
+        requirement reads a doc-values column or a painless-lite
+        expression at trace time. Ref: TermsSetQueryBuilder -> lucene
+        CoveringQuery. Missing requirement values never match; the
+        requirement is clamped to >= 1 (an empty requirement cannot make
+        every doc match)."""
+        dfield = self._field_or_none(q.field_name)
+        if dfield is None:
+            return ("match_none",), {}
+        stats = self.stats.get(q.field_name)
+        scored_spec, scored_arrays = self._terms_spec(
+            dfield, q.terms, 1.0, stats, scored=scoring
+        )
+        counts = [
+            self._terms_spec(dfield, [t], 1.0, stats, scored=False)
+            for t in q.terms
+        ]
+        arrays: dict[str, Any] = {
+            "scored": scored_arrays,
+            "counts": tuple(ca for _, ca in counts),
+            "boost": np.float32(q.boost),
+        }
+        if q.minimum_should_match_field is not None:
+            if q.minimum_should_match_field not in self.doc_values:
+                return ("match_none",), {}
+            msm_kind, msm_ref = "field", q.minimum_should_match_field
+        else:
+            from ..script import compile_script
+
+            compile_script(q.minimum_should_match_script)  # 400 on parse
+            params = dict(q.script_params)
+            params["num_terms"] = float(len(q.terms))
+            names = tuple(sorted(params))
+            msm_kind, msm_ref = "script", (
+                q.minimum_should_match_script,
+                names,
+            )
+            arrays["params"] = {
+                name: np.asarray(params[name], dtype=np.float32)
+                for name in names
+            }
+        spec = (
+            "terms_set",
+            scored_spec,
+            tuple(cs for cs, _ in counts),
+            msm_kind,
+            msm_ref,
+        )
+        return spec, arrays
+
+    def _rewrite_mlt(self, q):
+        """more_like_this rewrite at plan time against THIS compiler's
+        statistics scope (the reference's MoreLikeThis rewrite)."""
+
+        def field_ctx(fname):
+            dfield = self._field_or_none(fname)
+            if dfield is None:
+                return None
+            stats = self.stats.get(fname)
+            doc_count = stats.doc_count if stats else dfield.doc_count
+            return (
+                self.mappings.analyzer_for(fname, search=True),
+                lambda t: self._field_df(dfield, stats, t),
+                doc_count,
+            )
+
+        return mlt_to_bool(q, field_ctx)
 
     def _prefix_terms(self, q: PrefixQuery) -> list[str]:
         dfield = self._field_or_none(q.field_name)

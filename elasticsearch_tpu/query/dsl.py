@@ -279,6 +279,151 @@ class BoolQuery(Query):
 
 
 @dataclass
+class RegexpQuery(Query):
+    """Regular-expression term match over the term dictionary, Lucene
+    RegExp core syntax (RegexpQueryBuilder); constant-score rewrite like
+    the other multi-term queries."""
+
+    field_name: str = ""
+    value: str = ""
+    case_insensitive: bool = False
+    boost: float = 1.0
+
+
+@dataclass
+class BoostingQuery(Query):
+    """Demote (not exclude) docs matching `negative`: positive matches
+    keep their score, those also matching negative multiply by
+    negative_boost (BoostingQueryBuilder / Lucene FunctionScoreQuery
+    demotion form)."""
+
+    positive: Query = None  # type: ignore[assignment]
+    negative: Query = None  # type: ignore[assignment]
+    negative_boost: float = 0.0
+    boost: float = 1.0
+
+
+@dataclass
+class TermsSetQuery(Query):
+    """Match docs containing at least N of the given terms, N per-doc from
+    a numeric field or a script (TermsSetQueryBuilder / Lucene
+    CoveringQuery). Scores like a should-of-terms bool: BM25 sum over the
+    matching terms."""
+
+    field_name: str = ""
+    terms: list[str] = field(default_factory=list)
+    minimum_should_match_field: str | None = None
+    minimum_should_match_script: str | None = None
+    script_params: dict[str, Any] = field(default_factory=dict)
+    boost: float = 1.0
+
+
+@dataclass
+class MoreLikeThisQuery(Query):
+    """Find documents resembling free text: select the `like` texts' most
+    significant terms by TF-IDF and search them as a should-bool
+    (MoreLikeThisQueryBuilder / Lucene MoreLikeThis). `like` document
+    references ({"_id": ...}) are not supported yet — text only."""
+
+    fields: list[str] = field(default_factory=list)
+    like: list[str] = field(default_factory=list)
+    min_term_freq: int = 2
+    min_doc_freq: int = 5
+    max_query_terms: int = 25
+    minimum_should_match: str = "30%"
+    boost: float = 1.0
+
+
+@dataclass
+class SpanTermQuery(Query):
+    """One term's positions as unit spans (SpanTermQueryBuilder)."""
+
+    field_name: str = ""
+    value: str = ""
+    boost: float = 1.0
+
+
+@dataclass
+class SpanOrQuery(Query):
+    """Union of span clauses (SpanOrQueryBuilder). As a span_near clause
+    or top-level query, the position set is the union of its terms'."""
+
+    clauses: list[Query] = field(default_factory=list)
+    boost: float = 1.0
+
+
+@dataclass
+class SpanNearQuery(Query):
+    """Clauses within `slop` of each other (SpanNearQueryBuilder).
+
+    Clauses must be unit-span producers (span_term / span_or of terms) on
+    ONE field. Ordered: positions p1<p2<...<pn with pn-p1-(n-1) <= slop.
+    Unordered is supported for two clauses (|p1-p2|-1 <= slop, p1 != p2);
+    wider unordered nears raise at parse time.
+    """
+
+    clauses: list[Query] = field(default_factory=list)
+    slop: int = 0
+    in_order: bool = True
+    boost: float = 1.0
+
+
+@dataclass
+class SpanFirstQuery(Query):
+    """Spans ending within the first `end` positions (SpanFirstQueryBuilder).
+    `match` must be a unit-span producer."""
+
+    match: Query = None  # type: ignore[assignment]
+    end: int = 0
+    boost: float = 1.0
+
+
+@dataclass
+class SpanNotQuery(Query):
+    """Include spans with no exclude span within [pos-pre, pos+post]
+    (SpanNotQueryBuilder). Both sides must be unit-span producers."""
+
+    include: Query = None  # type: ignore[assignment]
+    exclude: Query = None  # type: ignore[assignment]
+    pre: int = 0
+    post: int = 0
+    boost: float = 1.0
+
+
+def span_unit_terms(q) -> tuple[str, list[str]]:
+    """(field, term list) of a unit-span producer (span_term / span_or of
+    span_terms) — the single flattening rule shared by the compiler and
+    the oracle. Compound spans inside compounds are rejected: the kernels
+    operate on unit spans."""
+    if isinstance(q, SpanTermQuery):
+        return q.field_name, [q.value]
+    if isinstance(q, SpanOrQuery):
+        fields, terms = set(), []
+        for c in q.clauses:
+            f, ts = span_unit_terms(c)
+            fields.add(f)
+            terms.extend(ts)
+        if len(fields) != 1:
+            raise ValueError("[span_or] clauses must all target the same field")
+        return fields.pop(), terms
+    raise ValueError(
+        "only span_term / span_or clauses are supported inside "
+        f"span compounds, got [{type(q).__name__}]"
+    )
+
+
+def _parse_span(body: dict[str, Any]) -> Query:
+    q = parse_query(body)
+    if not isinstance(
+        q, (SpanTermQuery, SpanOrQuery, SpanNearQuery, SpanFirstQuery, SpanNotQuery)
+    ):
+        raise ValueError(
+            f"span clauses must be span queries, got [{next(iter(body))}]"
+        )
+    return q
+
+
+@dataclass
 class NestedQuery(Query):
     """Query over one nested path's hidden sub-documents, joined to parents
     with a per-parent score reduction (NestedQueryBuilder.java:54 lowering
@@ -359,6 +504,122 @@ def parse_query(body: dict[str, Any]) -> Query:
     if kind == "constant_score":
         return ConstantScoreQuery(
             filter=parse_query(spec["filter"]), boost=_pop_boost(spec)
+        )
+    if kind == "span_term":
+        fname, val = _single_field(kind, spec)
+        if isinstance(val, dict):
+            return SpanTermQuery(fname, str(val["value"]), boost=_pop_boost(val))
+        return SpanTermQuery(fname, str(val))
+    if kind == "span_or":
+        clauses = [_parse_span(c) for c in spec.get("clauses", [])]
+        if not clauses:
+            raise ValueError("[span_or] requires [clauses]")
+        return SpanOrQuery(clauses=clauses, boost=_pop_boost(spec))
+    if kind == "span_near":
+        clauses = [_parse_span(c) for c in spec.get("clauses", [])]
+        if not clauses:
+            raise ValueError("[span_near] requires [clauses]")
+        in_order = bool(spec.get("in_order", True))
+        if not in_order and len(clauses) > 2:
+            raise ValueError(
+                "[span_near] with in_order=false supports at most 2 clauses"
+            )
+        return SpanNearQuery(
+            clauses=clauses,
+            slop=int(spec.get("slop", 0)),
+            in_order=in_order,
+            boost=_pop_boost(spec),
+        )
+    if kind == "span_first":
+        if "match" not in spec or "end" not in spec:
+            raise ValueError("[span_first] requires [match] and [end]")
+        end = int(spec["end"])
+        if end < 0:
+            raise ValueError("[span_first] requires [end] to be non-negative")
+        return SpanFirstQuery(
+            match=_parse_span(spec["match"]),
+            end=end,
+            boost=_pop_boost(spec),
+        )
+    if kind == "span_not":
+        if "include" not in spec or "exclude" not in spec:
+            raise ValueError("[span_not] requires [include] and [exclude]")
+        dist = int(spec.get("dist", 0))
+        return SpanNotQuery(
+            include=_parse_span(spec["include"]),
+            exclude=_parse_span(spec["exclude"]),
+            pre=int(spec.get("pre", dist)),
+            post=int(spec.get("post", dist)),
+            boost=_pop_boost(spec),
+        )
+    if kind == "regexp":
+        fname, val = _single_field(kind, spec)
+        if isinstance(val, dict):
+            return RegexpQuery(
+                field_name=fname,
+                value=str(val["value"]),
+                case_insensitive=bool(val.get("case_insensitive", False)),
+                boost=_pop_boost(val),
+            )
+        return RegexpQuery(field_name=fname, value=str(val))
+    if kind == "boosting":
+        for req in ("positive", "negative", "negative_boost"):
+            if req not in spec:
+                raise ValueError(f"[boosting] requires [{req}]")
+        return BoostingQuery(
+            positive=parse_query(spec["positive"]),
+            negative=parse_query(spec["negative"]),
+            negative_boost=float(spec["negative_boost"]),
+            boost=_pop_boost(spec),
+        )
+    if kind == "terms_set":
+        fname, val = _single_field(kind, spec)
+        if not isinstance(val, dict) or "terms" not in val:
+            raise ValueError("[terms_set] requires [terms]")
+        msm_field = val.get("minimum_should_match_field")
+        script = val.get("minimum_should_match_script")
+        src = params = None
+        if script is not None:
+            src = script.get("source") if isinstance(script, dict) else str(script)
+            params = dict(script.get("params", {})) if isinstance(script, dict) else {}
+        if (msm_field is None) == (src is None):
+            raise ValueError(
+                "[terms_set] requires exactly one of "
+                "[minimum_should_match_field] or [minimum_should_match_script]"
+            )
+        return TermsSetQuery(
+            field_name=fname,
+            terms=[str(t) for t in val["terms"]],
+            minimum_should_match_field=msm_field,
+            minimum_should_match_script=src,
+            script_params=params or {},
+            boost=_pop_boost(val),
+        )
+    if kind == "more_like_this":
+        like = spec.get("like", [])
+        if isinstance(like, (str, dict)):
+            like = [like]
+        texts = []
+        for entry in like:
+            if isinstance(entry, dict):
+                raise ValueError(
+                    "[more_like_this] document references in [like] are "
+                    "not supported; pass text"
+                )
+            texts.append(str(entry))
+        if not texts:
+            raise ValueError("[more_like_this] requires [like] text")
+        fields = [str(f) for f in spec.get("fields", [])]
+        if not fields:
+            raise ValueError("[more_like_this] requires [fields]")
+        return MoreLikeThisQuery(
+            fields=fields,
+            like=texts,
+            min_term_freq=int(spec.get("min_term_freq", 2)),
+            min_doc_freq=int(spec.get("min_doc_freq", 5)),
+            max_query_terms=int(spec.get("max_query_terms", 25)),
+            minimum_should_match=str(spec.get("minimum_should_match", "30%")),
+            boost=_pop_boost(spec),
         )
     if kind == "nested":
         if "path" not in spec or "query" not in spec:

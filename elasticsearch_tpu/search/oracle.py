@@ -101,10 +101,78 @@ class OracleSearcher:
             )
         if isinstance(q, MatchNoneQuery):
             return np.zeros(n, np.float32), np.zeros(n, bool)
-        from ..query.dsl import NestedQuery
+        from ..query.dsl import (
+            BoostingQuery,
+            MoreLikeThisQuery,
+            NestedQuery,
+            RegexpQuery,
+            TermsSetQuery,
+        )
 
         if isinstance(q, NestedQuery):
             return self._nested(q)
+        if isinstance(q, RegexpQuery):
+            from ..query.compile import regexp_pattern
+
+            fld = self.segment.fields.get(q.field_name)
+            if fld is None:
+                return np.zeros(n, np.float32), np.zeros(n, bool)
+            regex = regexp_pattern(q.value, q.case_insensitive)
+            terms = [t for t in fld.terms if regex.fullmatch(t)]
+            return self._const_terms(q.field_name, terms, q.boost)
+        if isinstance(q, BoostingQuery):
+            ps, pm = self._eval(q.positive)
+            _, nm = self._eval(q.negative)
+            factor = np.where(nm, np.float32(q.negative_boost), np.float32(1.0))
+            scores = np.where(
+                pm, ps * factor * np.float32(q.boost), np.float32(0.0)
+            ).astype(np.float32)
+            return scores, pm
+        if isinstance(q, TermsSetQuery):
+            return self._terms_set(q)
+        if isinstance(q, MoreLikeThisQuery):
+            return self._eval(self._rewrite_mlt(q))
+        from ..query.dsl import (
+            SpanFirstQuery,
+            SpanNearQuery,
+            SpanNotQuery,
+            SpanOrQuery,
+            SpanTermQuery,
+        )
+
+        if isinstance(q, SpanTermQuery):
+            # Lone span_term scores exactly like the term query.
+            return self._score_terms(q.field_name, [q.value], q.boost)
+        if isinstance(q, SpanOrQuery):
+            f, terms = self._span_unit_terms(q)
+            return self._span_eval(f, [terms], 0, True, -1, q.boost)
+        if isinstance(q, SpanNearQuery):
+            fields, clause_terms = set(), []
+            for c in q.clauses:
+                f, ts = self._span_unit_terms(c)
+                fields.add(f)
+                clause_terms.append(ts)
+            if len(fields) != 1:
+                raise ValueError(
+                    "[span_near] clauses must all target the same field"
+                )
+            return self._span_eval(
+                fields.pop(), clause_terms, q.slop, q.in_order, -1, q.boost
+            )
+        if isinstance(q, SpanFirstQuery):
+            f, terms = self._span_unit_terms(q.match)
+            return self._span_eval(f, [terms], 0, True, q.end, q.boost)
+        if isinstance(q, SpanNotQuery):
+            fi, inc = self._span_unit_terms(q.include)
+            fe, exc = self._span_unit_terms(q.exclude)
+            if fi != fe:
+                raise ValueError(
+                    "[span_not] include and exclude must target the same field"
+                )
+            return self._span_eval(
+                fi, [inc], 0, True, -1, q.boost,
+                exclude_terms=exc, pre=q.pre, post=q.post,
+            )
         if isinstance(q, MatchQuery):
             return self._match(q)
         if isinstance(q, TermQuery):
@@ -355,6 +423,124 @@ class OracleSearcher:
             scores[doc] = np.float32(w - w / (np.float32(1.0) + tn))
         return scores, matched
 
+    def _span_unit_terms(self, q) -> tuple[str, list[str]]:
+        from ..query.dsl import span_unit_terms
+
+        return span_unit_terms(q)
+
+    def _span_eval(
+        self,
+        field_name: str,
+        clause_terms: list[list[str]],
+        slop: int,
+        in_order: bool,
+        end_limit: int,
+        boost: float,
+        exclude_terms: list[str] | None = None,
+        pre: int = 0,
+        post: int = 0,
+    ):
+        """Unit-span evaluation twin of ops/bm25_device's span kernels:
+        freq(doc) = number of chain-end positions (span_near ordered DP /
+        both directions for unordered-2 / pre-post window subtraction for
+        span_not), scored as freq-BM25 with the summed-idf weight."""
+        from ..ops.bm25 import norm_inverse_cache, term_weight
+
+        n = self.segment.num_docs
+        zeros = np.zeros(n, np.float32), np.zeros(n, bool)
+        fld = self.segment.fields.get(field_name)
+        if fld is None:
+            return zeros
+        if not fld.has_positions:
+            raise ValueError(
+                f"field [{field_name}] was indexed without positions "
+                f"(keyword fields don't support span queries)"
+            )
+
+        def positions_by_doc(terms):
+            per: dict[int, list[int]] = {}
+            for t in terms:
+                docs, _ = fld.postings(t)
+                for d in docs:
+                    per.setdefault(int(d), []).extend(
+                        int(p) for p in fld.term_positions(t, int(d))
+                    )
+            return {d: sorted(ps) for d, ps in per.items()}
+
+        w = np.float32(0.0)
+        possible = True
+        for terms in clause_terms:
+            alive = False
+            for t in terms:
+                tid = fld.terms.get(t)
+                if tid is None:
+                    continue
+                alive = True
+                df = int(fld.df[tid])
+                if df > 0 and fld.doc_count > 0:
+                    w = np.float32(
+                        w + term_weight(df, fld.doc_count, boost, self.params)
+                    )
+            if not alive:
+                possible = False
+        if not possible:
+            return zeros
+
+        clause_pos = [positions_by_doc(terms) for terms in clause_terms]
+        exc_pos = (
+            positions_by_doc(exclude_terms)
+            if exclude_terms is not None
+            else None
+        )
+        n_clauses = len(clause_terms)
+        candidates = set(clause_pos[0])
+        for cp in clause_pos[1:]:
+            candidates &= set(cp)
+
+        def ordered_ends(pos_lists):
+            dp = [(p, p) for p in pos_lists[0]]
+            for level in range(1, len(pos_lists)):
+                nxt = []
+                for p in pos_lists[level]:
+                    best = None
+                    for pp, v in dp:
+                        if pp < p and v is not None:
+                            best = v if best is None else max(best, v)
+                    nxt.append((p, best))
+                dp = nxt
+            return [
+                p
+                for p, v in dp
+                if v is not None and p - v - (len(pos_lists) - 1) <= slop
+            ]
+
+        freq = np.zeros(n, dtype=np.float32)
+        for doc in sorted(candidates):
+            pos_lists = [cp[doc] for cp in clause_pos]
+            ends = set(ordered_ends(pos_lists))
+            if not in_order and n_clauses == 2:
+                ends |= set(ordered_ends(pos_lists[::-1]))
+            if end_limit >= 0:
+                ends = {p for p in ends if p + 1 <= end_limit}
+            if exc_pos is not None:
+                excl = exc_pos.get(doc, [])
+                ends = {
+                    p
+                    for p in ends
+                    if not any(p - pre <= q <= p + post for q in excl)
+                }
+            freq[doc] = float(len(ends))
+        matched = freq > 0
+        cache = norm_inverse_cache(fld.avgdl, self.params)
+        if not fld.has_norms:
+            cache = np.full(256, cache[1], dtype=np.float32)
+        scores = np.zeros(n, dtype=np.float32)
+        for doc in np.flatnonzero(matched):
+            ninv = cache[fld.norm_bytes[doc]]
+            tn = np.float32(np.float32(freq[doc]) * ninv)
+            scores[doc] = np.float32(w - w / (np.float32(1.0) + tn))
+        return scores, matched
+
     def _script_score(self, q: ScriptScoreQuery):
         from ..script import compile_script
 
@@ -509,6 +695,70 @@ class OracleSearcher:
             matched = ~np.isnan(col)
             return np.where(matched, np.float32(q.boost), np.float32(0.0)), matched
         return np.zeros(n, np.float32), np.zeros(n, bool)
+
+    def _terms_set(self, q):
+        """terms_set parity twin of ops/bm25_device._eval_terms_set."""
+        n = self.segment.num_docs
+        scores, _ = self._score_terms(q.field_name, q.terms, 1.0)
+        count = np.zeros(n, dtype=np.float32)
+        fld = self.segment.fields.get(q.field_name)
+        if fld is not None:
+            for t in q.terms:
+                docs, _tfs = fld.postings(t)
+                marks = np.zeros(n, dtype=np.float32)
+                marks[docs] = 1.0
+                count += marks
+        if q.minimum_should_match_field is not None:
+            col = self.segment.doc_values.get(q.minimum_should_match_field)
+            if col is None:
+                return np.zeros(n, np.float32), np.zeros(n, bool)
+            required = col.astype(np.float32)
+        else:
+            from ..script import compile_script
+
+            params = dict(q.script_params)
+            params["num_terms"] = float(len(q.terms))
+            required = np.broadcast_to(
+                np.asarray(
+                    compile_script(q.minimum_should_match_script).evaluate(
+                        np,
+                        np.zeros(n, dtype=np.float32),
+                        self.segment.doc_values,
+                        self.segment.vectors,
+                        params,
+                    ),
+                    dtype=np.float32,
+                ),
+                (n,),
+            )
+        required = np.maximum(required, np.float32(1.0))
+        matched = count >= required
+        out = np.where(
+            matched, scores * np.float32(q.boost), np.float32(0.0)
+        ).astype(np.float32)
+        return out, matched
+
+    def _rewrite_mlt(self, q):
+        """more_like_this rewrite against this segment's local statistics
+        (the shared mlt_to_bool pass, segment-adapted)."""
+        from ..query.compile import mlt_to_bool
+
+        def field_ctx(fname):
+            fld = self.segment.fields.get(fname)
+            if fld is None:
+                return None
+
+            def df_of(t, fld=fld):
+                tid = fld.terms.get(t)
+                return 0 if tid is None else int(fld.df[tid])
+
+            return (
+                self.mappings.analyzer_for(fname, search=True),
+                df_of,
+                fld.doc_count,
+            )
+
+        return mlt_to_bool(q, field_ctx)
 
     def _nested(self, q):
         """Nested block join in numpy — the parity reference for

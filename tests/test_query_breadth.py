@@ -1,0 +1,194 @@
+"""regexp, boosting, terms_set, and more_like_this queries.
+
+Reference: RegexpQueryBuilder, BoostingQueryBuilder, TermsSetQueryBuilder
+(lucene CoveringQuery), MoreLikeThisQueryBuilder (lucene MoreLikeThis).
+Each device plan gates against the numpy oracle.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.index.tiles import pack_segment
+from elasticsearch_tpu.ops import bm25_device
+from elasticsearch_tpu.query.compile import Compiler
+from elasticsearch_tpu.query.dsl import parse_query
+from elasticsearch_tpu.search.oracle import OracleSearcher
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    m = Mappings(
+        properties={
+            "body": {"type": "text"},
+            "tag": {"type": "keyword"},
+            "required_matches": {"type": "long"},
+        }
+    )
+    b = SegmentBuilder(m)
+    docs = [
+        {"body": "red apple pie recipe", "tag": "food-101", "required_matches": 2},
+        {"body": "green apple tart", "tag": "food-202", "required_matches": 1},
+        {"body": "red wine pairing", "tag": "drink-1", "required_matches": 3},
+        {"body": "apple wine cider press", "tag": "drink-22", "required_matches": 2},
+        {"body": "blueberry pie and apple pie", "tag": "food-303", "required_matches": 1},
+        {"body": "unrelated document entirely", "tag": "misc", "required_matches": 1},
+    ]
+    for i, d in enumerate(docs):
+        b.add(d, f"d{i}")
+    seg = b.build()
+    dev = pack_segment(seg)
+    return m, seg, dev
+
+
+def _both(corpus, query_json, k=6):
+    import jax
+
+    m, seg, dev = corpus
+    c = Compiler(dev.fields, dev.doc_values, m).compile(parse_query(query_json))
+    tree = bm25_device.segment_tree(dev)
+    d_s, d_i, d_t = jax.device_get(bm25_device.execute(tree, c.spec, c.arrays, k))
+    o_s, o_i, o_t = OracleSearcher(seg, m).search(parse_query(query_json), k)
+    n = len(o_i)
+    assert list(d_i[:n]) == list(o_i), (query_json, list(d_i[:n]), list(o_i))
+    np.testing.assert_allclose(d_s[:n], o_s, rtol=2e-6)
+    assert int(d_t) == o_t
+    return list(o_i), o_s, o_t
+
+
+def test_regexp_matches_and_parity(corpus):
+    ids, _, total = _both(corpus, {"regexp": {"tag": "food-[0-9]+"}})
+    assert total == 3 and set(ids) == {0, 1, 4}
+    ids, _, total = _both(
+        corpus, {"regexp": {"tag": {"value": "FOOD-.*", "case_insensitive": True}}}
+    )
+    assert total == 3
+    ids, _, total = _both(corpus, {"regexp": {"body": "appl(e|es)"}})
+    assert total == 4
+
+
+def test_regexp_rejects_unsupported_operators(corpus):
+    m, seg, dev = corpus
+    compiler = Compiler(dev.fields, dev.doc_values, m)
+    with pytest.raises(ValueError, match="regexp"):
+        compiler.compile(parse_query({"regexp": {"tag": "foo~bar"}}))
+    with pytest.raises(ValueError, match="regexp"):
+        compiler.compile(parse_query({"regexp": {"tag": "<1-10>"}}))
+    # Escaped operators are literal and fine.
+    compiler.compile(parse_query({"regexp": {"tag": "a\\~b"}}))
+
+
+def test_regexp_lucene_semantics():
+    """Lucene RegExp: backslash escapes the next char LITERALLY (no \\d
+    classes) and ^/$ are literal characters, not anchors."""
+    from elasticsearch_tpu.query.compile import regexp_pattern
+
+    assert regexp_pattern("\\d+", False).fullmatch("ddd")
+    assert not regexp_pattern("\\d+", False).fullmatch("123")
+    assert regexp_pattern("a^b", False).fullmatch("a^b")
+    assert not regexp_pattern("a^b", False).fullmatch("ab")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="trailing"):
+        regexp_pattern("abc\\", False)
+
+
+def test_boosting_demotes_not_excludes(corpus):
+    ids, scores, total = _both(
+        corpus,
+        {
+            "boosting": {
+                "positive": {"match": {"body": "apple"}},
+                "negative": {"match": {"body": "wine"}},
+                "negative_boost": 0.2,
+            }
+        },
+    )
+    assert total == 4  # wine docs still match...
+    assert 3 in ids  # ...but the apple+wine doc sinks to the bottom
+    assert ids[-1] == 3
+
+
+def test_terms_set_field_coverage(corpus):
+    # required_matches per doc: d0 needs 2 of {red, apple, pie} (has 3 -> hit),
+    # d1 needs 1 (has apple -> hit), d2 needs 3 (has red only -> miss),
+    # d3 needs 2 (has apple only -> miss), d4 needs 1 (apple+pie -> hit).
+    ids, _, total = _both(
+        corpus,
+        {
+            "terms_set": {
+                "body": {
+                    "terms": ["red", "apple", "pie"],
+                    "minimum_should_match_field": "required_matches",
+                }
+            }
+        },
+    )
+    assert set(ids) == {0, 1, 4} and total == 3
+
+
+def test_terms_set_script(corpus):
+    ids, _, total = _both(
+        corpus,
+        {
+            "terms_set": {
+                "body": {
+                    "terms": ["red", "apple", "pie"],
+                    "minimum_should_match_script": {
+                        "source": "Math.min(params.num_terms, doc['required_matches'].value)"
+                    },
+                }
+            }
+        },
+    )
+    assert set(ids) == {0, 1, 4} and total == 3
+
+
+def test_terms_set_requires_exactly_one_msm():
+    with pytest.raises(ValueError, match="terms_set"):
+        parse_query({"terms_set": {"body": {"terms": ["a"]}}})
+
+
+def test_more_like_this(corpus):
+    ids, _, total = _both(
+        corpus,
+        {
+            "more_like_this": {
+                "fields": ["body"],
+                "like": ["apple pie apple pie baking"],
+                "min_term_freq": 2,
+                "min_doc_freq": 1,
+                "minimum_should_match": "30%",
+            }
+        },
+    )
+    # Selected terms: apple, pie (tf 2, present in corpus); docs with either.
+    assert 0 in ids and 4 in ids and total >= 3
+
+
+def test_more_like_this_requires_text():
+    with pytest.raises(ValueError, match="more_like_this"):
+        parse_query({"more_like_this": {"fields": ["body"], "like": [{"_id": "1"}]}})
+    with pytest.raises(ValueError, match="more_like_this"):
+        parse_query({"more_like_this": {"like": ["x"]}})
+
+
+def test_new_queries_through_bool_composition(corpus):
+    _both(
+        corpus,
+        {
+            "bool": {
+                "must": [
+                    {
+                        "boosting": {
+                            "positive": {"match": {"body": "apple"}},
+                            "negative": {"regexp": {"tag": "drink-.*"}},
+                            "negative_boost": 0.5,
+                        }
+                    }
+                ],
+                "filter": [{"regexp": {"tag": "[a-z]+-[0-9]+"}}],
+            }
+        },
+    )

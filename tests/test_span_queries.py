@@ -1,0 +1,278 @@
+"""Span query family: span_term, span_or, span_near, span_first, span_not.
+
+Reference: SpanTermQueryBuilder, SpanOrQueryBuilder, SpanNearQueryBuilder
+(lucene NearSpansOrdered/Unordered), SpanFirstQueryBuilder,
+SpanNotQueryBuilder. Matching sets over unit spans are exact; scoring
+uses freq = chain-end count with the summed-idf weight (the sloppy-freq
+1/(1+stretch) weighting is a noted divergence — see _eval_span_near).
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.index.tiles import pack_segment
+from elasticsearch_tpu.ops import bm25_device
+from elasticsearch_tpu.query.compile import Compiler
+from elasticsearch_tpu.query.dsl import parse_query
+from elasticsearch_tpu.search.oracle import OracleSearcher
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    m = Mappings(properties={"body": {"type": "text"}})
+    docs = [
+        "the quick brown fox jumps over the lazy dog",      # 0
+        "quick fox",                                        # 1
+        "the fox was quick and brown",                      # 2
+        "lazy quick brown dog fox",                         # 3
+        "a dog and a fox walked home",                      # 4
+        "quick brown quick fox",                            # 5
+        "brown dog",                                        # 6
+    ]
+    b = SegmentBuilder(m)
+    for i, text in enumerate(docs):
+        b.add({"body": text}, f"d{i}")
+    seg = b.build()
+    dev = pack_segment(seg)
+    return m, seg, dev
+
+
+def _both(corpus, query_json, k=7):
+    import jax
+
+    m, seg, dev = corpus
+    q = parse_query(query_json)
+    c = Compiler(dev.fields, dev.doc_values, m).compile(q)
+    tree = bm25_device.segment_tree(dev)
+    d_s, d_i, d_t = jax.device_get(bm25_device.execute(tree, c.spec, c.arrays, k))
+    o_s, o_i, o_t = OracleSearcher(seg, m).search(q, k)
+    n = len(o_i)
+    assert list(d_i[:n]) == list(o_i), (query_json, list(d_i[:n]), list(o_i))
+    np.testing.assert_allclose(d_s[:n], o_s, rtol=2e-6)
+    assert int(d_t) == o_t, query_json
+    return list(o_i), o_s, o_t
+
+
+def test_span_term_scores_like_term(corpus):
+    ids, scores, total = _both(corpus, {"span_term": {"body": "fox"}})
+    assert total == 6
+    ids2, scores2, total2 = _both(corpus, {"term": {"body": "fox"}})
+    assert ids == ids2 and total == total2
+    np.testing.assert_array_equal(scores, scores2)
+
+
+def test_span_near_ordered(corpus):
+    # "quick ... fox" within slop 0 (adjacent, ordered): docs 1 and 5.
+    ids, _, total = _both(
+        corpus,
+        {
+            "span_near": {
+                "clauses": [
+                    {"span_term": {"body": "quick"}},
+                    {"span_term": {"body": "fox"}},
+                ],
+                "slop": 0,
+                "in_order": True,
+            }
+        },
+    )
+    assert set(ids) == {1, 5} and total == 2
+    # slop 2 adds docs 0 (quick brown fox) and 3 (quick brown dog fox).
+    ids, _, total = _both(
+        corpus,
+        {
+            "span_near": {
+                "clauses": [
+                    {"span_term": {"body": "quick"}},
+                    {"span_term": {"body": "fox"}},
+                ],
+                "slop": 2,
+                "in_order": True,
+            }
+        },
+    )
+    assert set(ids) == {0, 1, 3, 5} and total == 4
+
+
+def test_span_near_unordered(corpus):
+    # unordered: "fox ... quick" in doc 2 now matches at slop 1.
+    ids, _, total = _both(
+        corpus,
+        {
+            "span_near": {
+                "clauses": [
+                    {"span_term": {"body": "quick"}},
+                    {"span_term": {"body": "fox"}},
+                ],
+                "slop": 1,
+                "in_order": False,
+            }
+        },
+    )
+    assert 2 in ids and 1 in ids
+
+
+def test_span_near_three_clauses(corpus):
+    ids, _, total = _both(
+        corpus,
+        {
+            "span_near": {
+                "clauses": [
+                    {"span_term": {"body": "quick"}},
+                    {"span_term": {"body": "brown"}},
+                    {"span_term": {"body": "fox"}},
+                ],
+                "slop": 0,
+                "in_order": True,
+            }
+        },
+    )
+    assert set(ids) == {0}  # only "quick brown fox" adjacent in order
+    ids, _, total = _both(
+        corpus,
+        {
+            "span_near": {
+                "clauses": [
+                    {"span_term": {"body": "quick"}},
+                    {"span_term": {"body": "brown"}},
+                    {"span_term": {"body": "fox"}},
+                ],
+                "slop": 1,
+                "in_order": True,
+            }
+        },
+    )
+    assert set(ids) == {0, 3, 5}
+
+
+def test_span_or_and_nested_in_near(corpus):
+    ids, _, total = _both(
+        corpus,
+        {
+            "span_or": {
+                "clauses": [
+                    {"span_term": {"body": "lazy"}},
+                    {"span_term": {"body": "walked"}},
+                ]
+            }
+        },
+    )
+    assert set(ids) == {0, 3, 4}
+    ids, _, total = _both(
+        corpus,
+        {
+            "span_near": {
+                "clauses": [
+                    {
+                        "span_or": {
+                            "clauses": [
+                                {"span_term": {"body": "quick"}},
+                                {"span_term": {"body": "lazy"}},
+                            ]
+                        }
+                    },
+                    {"span_term": {"body": "dog"}},
+                ],
+                "slop": 0,
+                "in_order": True,
+            }
+        },
+    )
+    assert set(ids) == {0}  # only "lazy dog" is adjacent
+    ids, _, total = _both(
+        corpus,
+        {
+            "span_near": {
+                "clauses": [
+                    {
+                        "span_or": {
+                            "clauses": [
+                                {"span_term": {"body": "quick"}},
+                                {"span_term": {"body": "lazy"}},
+                            ]
+                        }
+                    },
+                    {"span_term": {"body": "dog"}},
+                ],
+                "slop": 1,
+                "in_order": True,
+            }
+        },
+    )
+    assert set(ids) == {0, 3}  # doc 3: quick(1) .. dog(3), stretch 1
+
+
+def test_span_first(corpus):
+    ids, _, total = _both(
+        corpus,
+        {"span_first": {"match": {"span_term": {"body": "quick"}}, "end": 1}},
+    )
+    assert set(ids) == {1, 5}  # "quick" as the first token
+    ids, _, total = _both(
+        corpus,
+        {"span_first": {"match": {"span_term": {"body": "quick"}}, "end": 2}},
+    )
+    assert set(ids) == {0, 1, 3, 5}  # "quick" within the first two tokens
+
+
+def test_span_not(corpus):
+    # fox not immediately preceded by quick (pre=1): docs 0,2,3,4 keep
+    # foxes; doc 1 and 5's foxes follow quick directly.
+    ids, _, total = _both(
+        corpus,
+        {
+            "span_not": {
+                "include": {"span_term": {"body": "fox"}},
+                "exclude": {"span_term": {"body": "quick"}},
+                "pre": 1,
+            }
+        },
+    )
+    assert 1 not in ids and 5 not in ids
+    assert {0, 2, 3, 4} <= set(ids)
+
+
+def test_span_parse_errors():
+    with pytest.raises(ValueError, match="span"):
+        parse_query({"span_near": {"clauses": [{"term": {"body": "x"}}]}})
+    with pytest.raises(ValueError, match="in_order"):
+        parse_query(
+            {
+                "span_near": {
+                    "clauses": [
+                        {"span_term": {"body": "a"}},
+                        {"span_term": {"body": "b"}},
+                        {"span_term": {"body": "c"}},
+                    ],
+                    "in_order": False,
+                }
+            }
+        )
+    with pytest.raises(ValueError, match="span_first"):
+        parse_query({"span_first": {"match": {"span_term": {"body": "a"}}}})
+
+
+def test_span_in_bool_filter(corpus):
+    ids, _, total = _both(
+        corpus,
+        {
+            "bool": {
+                "must": [{"match": {"body": "dog"}}],
+                "filter": [
+                    {
+                        "span_near": {
+                            "clauses": [
+                                {"span_term": {"body": "quick"}},
+                                {"span_term": {"body": "fox"}},
+                            ],
+                            "slop": 2,
+                            "in_order": True,
+                        }
+                    }
+                ],
+            }
+        },
+    )
+    assert set(ids) == {0, 3}
