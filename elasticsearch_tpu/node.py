@@ -212,6 +212,10 @@ class Node:
         # priority, template:{settings,mappings,aliases}} — applied at
         # (auto-)creation, request body winning over the template.
         self.index_templates: dict[str, dict[str, Any]] = {}
+        # Stored scripts (script/ScriptService.java cluster-state scripts):
+        # id -> {"lang": "painless"|"mustache", "source": str}. Referenced
+        # by {"script": {"id": ...}} in queries and by _search/template.
+        self.stored_scripts: dict[str, dict[str, Any]] = {}
         # Extension system (plugins.py): analyzers / ingest processors /
         # query types contributed by ESTPU_PLUGINS or the plugins param.
         from .plugins import load_plugins
@@ -226,6 +230,7 @@ class Node:
         if data_path is not None:
             os.makedirs(data_path, exist_ok=True)
             self._load_templates()
+            self._load_scripts()
             self._recover_indices()
             self._load_repositories()
             self._load_pipelines()
@@ -497,6 +502,181 @@ class Node:
             # Broken persisted state is never a node-fatal boot error
             # (same convention as aliases/pipelines/repositories).
             self.index_templates = {}
+
+    # ---------------------------------------------------------------------
+    # Stored scripts + search templates (script/ScriptService.java,
+    # modules/lang-mustache TransportSearchTemplateAction)
+
+    def _scripts_file(self) -> str | None:
+        if self.data_path is None:
+            return None
+        return os.path.join(self.data_path, "_stored_scripts.json")
+
+    def _save_scripts(self) -> None:
+        path = self._scripts_file()
+        if path is None:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.stored_scripts, f)
+        os.replace(tmp, path)
+
+    def _load_scripts(self) -> None:
+        path = self._scripts_file()
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                self.stored_scripts = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            self.stored_scripts = {}
+
+    def put_script(self, script_id: str, body: dict[str, Any]) -> dict:
+        script = (body or {}).get("script")
+        if not isinstance(script, dict) or "source" not in script:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                "must specify [script] with a [source]",
+            )
+        lang = str(script.get("lang", "painless"))
+        source = script["source"]
+        if lang == "mustache":
+            if isinstance(source, dict):
+                source = json.dumps(source)
+            from .script.mustache import TemplateError, render
+
+            try:  # compile-validate now, not at first use
+                render(str(source), {})
+            except TemplateError as e:
+                raise ApiError(400, "script_exception", str(e)) from None
+        elif lang == "painless":
+            from .script import compile_script
+
+            try:
+                compile_script(str(source))
+            except ValueError as e:
+                raise ApiError(400, "script_exception", str(e)) from None
+        else:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                f"unable to parse language [{lang}]",
+            )
+        self.stored_scripts[script_id] = {"lang": lang, "source": str(source)}
+        self._save_scripts()
+        return {"acknowledged": True}
+
+    def get_script(self, script_id: str) -> dict:
+        entry = self.stored_scripts.get(script_id)
+        if entry is None:
+            raise ApiError(
+                404,
+                "resource_not_found_exception",
+                f"unable to find script [{script_id}]",
+            )
+        return {"_id": script_id, "found": True, "script": dict(entry)}
+
+    def delete_script(self, script_id: str) -> dict:
+        if script_id not in self.stored_scripts:
+            raise ApiError(
+                404,
+                "resource_not_found_exception",
+                f"unable to find script [{script_id}]",
+            )
+        del self.stored_scripts[script_id]
+        self._save_scripts()
+        return {"acknowledged": True}
+
+    def _resolve_stored_script(self, ref: dict[str, Any]) -> dict[str, Any]:
+        entry = self.stored_scripts.get(str(ref["id"]))
+        if entry is None:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                f"unable to find script [{ref['id']}]",
+            )
+        out = {"source": entry["source"]}
+        if "params" in ref:
+            out["params"] = ref["params"]
+        return out
+
+    def resolve_script_refs(self, body):
+        """Replace {"script"/"...script": {"id": X}} references with their
+        stored sources anywhere in a request body (the reference resolves
+        stored scripts in ScriptService.compile)."""
+        if isinstance(body, list):
+            return [self.resolve_script_refs(v) for v in body]
+        if not isinstance(body, dict):
+            return body
+        out = {}
+        for k, v in body.items():
+            if (
+                (k == "script" or k.endswith("_script"))
+                and isinstance(v, dict)
+                and "id" in v
+                and "source" not in v
+            ):
+                out[k] = self._resolve_stored_script(v)
+            else:
+                out[k] = self.resolve_script_refs(v)
+        return out
+
+    def render_template(self, body: dict[str, Any]) -> dict:
+        """POST /_render/template — rendered search body without running
+        it (RestRenderSearchTemplateAction)."""
+        return {"template_output": self._render_search_template(body or {})}
+
+    def _render_search_template(self, body: dict[str, Any]) -> dict:
+        from .script.mustache import TemplateError, render
+
+        source = body.get("source")
+        if source is None and "id" in body:
+            entry = self.stored_scripts.get(str(body["id"]))
+            if entry is None or entry.get("lang") != "mustache":
+                raise ApiError(
+                    404,
+                    "resource_not_found_exception",
+                    f"unable to find search template [{body.get('id')}]",
+                )
+            source = entry["source"]
+        if source is None:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                "template is missing: specify [source] or [id]",
+            )
+        if isinstance(source, dict):
+            source = json.dumps(source)
+        try:
+            rendered = render(str(source), body.get("params") or {})
+        except TemplateError as e:
+            raise ApiError(400, "script_exception", str(e)) from None
+        try:
+            parsed = json.loads(rendered)
+        except json.JSONDecodeError as e:
+            raise ApiError(
+                400,
+                "json_parse_exception",
+                f"rendered template is not valid JSON: {e}",
+            ) from None
+        if not isinstance(parsed, dict):
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                "rendered template must be a JSON object",
+            )
+        return parsed
+
+    def search_template(self, index: str, body: dict[str, Any]) -> dict:
+        """GET/POST /{index}/_search/template (TransportSearchTemplateAction:
+        render, then the ordinary search path)."""
+        rendered = self._render_search_template(body or {})
+        if (body or {}).get("explain"):
+            rendered["explain"] = True
+        if (body or {}).get("profile"):
+            rendered["profile"] = True
+        return self.search(index, rendered)
 
     def create_index(self, name: str, body: dict[str, Any] | None = None) -> dict:
         if name in self.indices:
@@ -885,6 +1065,8 @@ class Node:
         request_cache: bool | None = None,
     ) -> dict:
         svc = self.get_index(index)
+        if body and self.stored_scripts:
+            body = self.resolve_script_refs(body)
         if self._scrolls:
             # Reap expired scroll contexts opportunistically: they pin
             # frozen device segments, and a quiet scroll API must not keep
@@ -983,6 +1165,8 @@ class Node:
         must not publish buffered docs or invalidate caches); a doc that
         is only in the unrefreshed buffer is not searchable yet and
         reports 404 like the reference's uid-term lookup."""
+        if body and self.stored_scripts:
+            body = self.resolve_script_refs(body)
         from .ops import bm25_device
 
         svc = self.get_index(index)

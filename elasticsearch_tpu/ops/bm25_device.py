@@ -577,7 +577,6 @@ def _eval_span_not(spec, arrays, seg, num_docs):
     d_s, p_s, c_s = _gather_span_events(arrays, seg, field_name, num_docs)
     pf = p_s.astype(jnp.float32)
     neg = jnp.float32(-(2.0**31))
-    posv = jnp.float32(2.0**31)
     # Nearest exclude position <= p (inclusive scan; same-(doc,pos)
     # excludes sort after includes but are caught by the backward scan).
     before = _segmented_cummax(d_s, jnp.where(c_s == 1, pf, neg))

@@ -568,18 +568,11 @@ class Compiler:
                 field_name, [terms], 0, True, -1, q.boost, scoring
             )
         if isinstance(q, SpanNearQuery):
-            clause_terms = []
-            fields = set()
-            for c in q.clauses:
-                f, ts = self._span_terms(c)
-                fields.add(f)
-                clause_terms.append(ts)
-            if len(fields) != 1:
-                raise ValueError(
-                    "[span_near] clauses must all target the same field"
-                )
+            from .dsl import span_clause_lists
+
+            field_name, clause_terms = span_clause_lists(q.clauses)
             return self._span_near_spec(
-                fields.pop(), clause_terms, q.slop, q.in_order, -1,
+                field_name, clause_terms, q.slop, q.in_order, -1,
                 q.boost, scoring,
             )
         if isinstance(q, SpanFirstQuery):
@@ -893,7 +886,7 @@ class Compiler:
         return span_unit_terms(q)
 
     def _span_worklist(self, dfield, clause_terms, boost, scoring,
-                       optional_clauses=()):
+                       optional_clauses=(), weight_clauses=None):
         """Shared positions-worklist lowering for the span kernels: one
         entry per position tile each clause term touches, carrying the
         clause id; weight = summed idf over all clause terms (the
@@ -923,7 +916,12 @@ class Compiler:
                     if stats
                     else dfield.term_df(t)
                 )
-                if scoring and df > 0 and doc_count > 0:
+                if (
+                    scoring
+                    and df > 0
+                    and doc_count > 0
+                    and (weight_clauses is None or cl in weight_clauses)
+                ):
                     w = np.float32(
                         w + term_weight(df, doc_count, boost, self.params)
                     )
@@ -982,28 +980,19 @@ class Compiler:
         return spec, arrays
 
     def _span_not_spec(self, q, scoring: bool):
-        inc_field, inc_terms = self._span_terms(q.include)
-        exc_field, exc_terms = self._span_terms(q.exclude)
-        if inc_field != exc_field:
-            raise ValueError(
-                "[span_not] include and exclude must target the same field"
-            )
+        from .dsl import span_not_lists
+
+        inc_field, inc_terms, exc_terms = span_not_lists(q.include, q.exclude)
         dfield = self._field_or_none(inc_field)
         if dfield is None:
             return ("match_none",), {}
-        _, inc_only = self._span_worklist(
-            dfield, [inc_terms], q.boost, scoring
-        )
-        # Lower include+exclude (exclude OPTIONAL: a shard without the
-        # exclude terms must still match includes, under the same spec),
-        # but keep the weight from the include terms only (SpanNotQuery
-        # scores the included spans).
+        # Exclude clause OPTIONAL (a shard without the exclude terms must
+        # still match includes, under the same spec) and weightless
+        # (SpanNotQuery scores the included spans only).
         nt, arrays = self._span_worklist(
             dfield, [inc_terms, exc_terms], q.boost, scoring,
-            optional_clauses=(1,),
+            optional_clauses=(1,), weight_clauses=(0,),
         )
-        arrays["weight"] = inc_only["weight"]
-        arrays["cache"] = inc_only["cache"]
         spec = ("span_not", inc_field, nt, int(q.pre), int(q.post))
         return spec, arrays
 

@@ -412,6 +412,30 @@ def span_unit_terms(q) -> tuple[str, list[str]]:
     )
 
 
+def span_clause_lists(clauses) -> tuple[str, list[list[str]]]:
+    """Flatten span_near clauses to per-clause term lists, enforcing the
+    one-field rule — shared by the compiler and the oracle."""
+    fields, out = set(), []
+    for c in clauses:
+        f, ts = span_unit_terms(c)
+        fields.add(f)
+        out.append(ts)
+    if len(fields) != 1:
+        raise ValueError("[span_near] clauses must all target the same field")
+    return fields.pop(), out
+
+
+def span_not_lists(include, exclude) -> tuple[str, list[str], list[str]]:
+    """Flatten span_not sides, enforcing the one-field rule."""
+    fi, inc = span_unit_terms(include)
+    fe, exc = span_unit_terms(exclude)
+    if fi != fe:
+        raise ValueError(
+            "[span_not] include and exclude must target the same field"
+        )
+    return fi, inc, exc
+
+
 def _parse_span(body: dict[str, Any]) -> Query:
     q = parse_query(body)
     if not isinstance(

@@ -109,6 +109,21 @@ class RestServer:
           n.get_index_template(p["name"]))
         r("DELETE", "/_index_template/{name}", lambda s, p, q, b:
           n.delete_index_template(p["name"]))
+        for method in ("PUT", "POST"):
+            r(method, "/_scripts/{id}", lambda s, p, q, b: n.put_script(
+                p["id"], _json(b)
+            ))
+        r("GET", "/_scripts/{id}", lambda s, p, q, b: n.get_script(p["id"]))
+        r("DELETE", "/_scripts/{id}", lambda s, p, q, b: n.delete_script(
+            p["id"]
+        ))
+        for method in ("GET", "POST"):
+            r(method, "/_render/template", lambda s, p, q, b:
+              n.render_template(_json(b)))
+            r(method, "/_render/template/{id}", lambda s, p, q, b:
+              n.render_template(dict(_json(b), id=p["id"])))
+            r(method, "/{index}/_search/template", lambda s, p, q, b:
+              n.search_template(p["index"], _json(b)))
         r("GET", "/_alias", lambda s, p, q, b: n.get_aliases())
         r("GET", "/{index}/_alias", lambda s, p, q, b: n.get_aliases(
             p["index"]

@@ -1,0 +1,173 @@
+"""Mustache-lite: the search-template rendering engine.
+
+The analog of the reference's lang-mustache module
+(modules/lang-mustache/src/main/java/org/elasticsearch/script/mustache/
+MustacheScriptEngine.java): templates render against `params` before the
+result parses as a search body. Supported syntax — the subset the
+reference's own docs exercise:
+
+- `{{var}}`            variable (dotted paths), JSON-string-escaped
+- `{{{var}}}`          raw (unescaped) variable
+- `{{#toJson}}var{{/toJson}}`   value serialized as JSON
+- `{{#join}}var{{/join}}`       array joined with ","
+- `{{#name}}...{{/name}}`       section: list iteration / truthy guard
+- `{{^name}}...{{/name}}`       inverted section (renders when falsy/empty)
+- `{{! comment}}`
+Inside a list section, `{{.}}` is the current element.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any
+
+_TAG = re.compile(r"\{\{\{(.+?)\}\}\}|\{\{(.+?)\}\}", re.DOTALL)
+
+
+class TemplateError(ValueError):
+    pass
+
+
+def _lookup(stack: list[Any], path: str) -> Any:
+    path = path.strip()
+    if path == ".":
+        return stack[-1]
+    for frame in reversed(stack):
+        obj: Any = frame
+        found = True
+        for part in path.split("."):
+            if isinstance(obj, dict) and part in obj:
+                obj = obj[part]
+            else:
+                found = False
+                break
+        if found:
+            return obj
+    return None
+
+
+def _json_escape(value: Any) -> str:
+    if value is None:
+        return ""
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, (int, float)):
+        return json.dumps(value)
+    # json.dumps then strip the surrounding quotes: escapes ", \, control
+    # chars — the reference's JsonEscapingMustacheFactory behavior.
+    return json.dumps(str(value))[1:-1]
+
+
+def _tokens(template: str):
+    """(literal, tag) alternation; tag is (sigil, name) or None."""
+    pos = 0
+    for m in _TAG.finditer(template):
+        if m.start() > pos:
+            yield template[pos : m.start()], None
+        raw = m.group(1)
+        if raw is not None:
+            yield "", ("raw", raw.strip())
+        else:
+            body = m.group(2).strip()
+            if body.startswith(("#", "^", "/", "!")):
+                yield "", (body[0], body[1:].strip())
+            else:
+                yield "", ("var", body)
+        pos = m.end()
+    if pos < len(template):
+        yield template[pos:], None
+
+
+def _parse(tokens: list, i: int, until: str | None, out: list) -> int:
+    """Build a node list: str | ("var"/"raw", name) | (kind, name, children)."""
+    while i < len(tokens):
+        lit, tag = tokens[i]
+        i += 1
+        if lit:
+            out.append(lit)
+        if tag is None:
+            continue
+        sigil, name = tag
+        if sigil == "!":
+            continue
+        if sigil == "/":
+            if name != until:
+                raise TemplateError(
+                    f"unexpected closing tag [{{{{/{name}}}}}]"
+                )
+            return i
+        if sigil in ("#", "^"):
+            children: list = []
+            i = _parse(tokens, i, name, children)
+            out.append((sigil, name, children))
+            continue
+        out.append((sigil, name))
+    if until is not None:
+        raise TemplateError(f"unclosed section [{{{{#{until}}}}}]")
+    return i
+
+
+def _section_text(children: list) -> str | None:
+    """The literal content of a {{#fn}}var{{/fn}} function section."""
+    if len(children) == 1 and isinstance(children[0], str):
+        return children[0].strip()
+    return None
+
+
+def _render_nodes(nodes: list, stack: list[Any], out: list[str]) -> None:
+    for node in nodes:
+        if isinstance(node, str):
+            out.append(node)
+            continue
+        kind = node[0]
+        if kind == "var":
+            out.append(_json_escape(_lookup(stack, node[1])))
+        elif kind == "raw":
+            value = _lookup(stack, node[1])
+            out.append("" if value is None else str(value))
+        elif kind == "#":
+            name, children = node[1], node[2]
+            if name == "toJson":
+                path = _section_text(children)
+                if path is None:
+                    raise TemplateError("[toJson] takes a single variable")
+                out.append(json.dumps(_lookup(stack, path)))
+                continue
+            if name == "join":
+                path = _section_text(children)
+                if path is None:
+                    raise TemplateError("[join] takes a single variable")
+                value = _lookup(stack, path) or []
+                out.append(",".join(str(v) for v in value))
+                continue
+            value = _lookup(stack, name)
+            if isinstance(value, list):
+                for item in value:
+                    stack.append(item)
+                    _render_nodes(children, stack, out)
+                    stack.pop()
+            elif isinstance(value, dict):
+                stack.append(value)
+                _render_nodes(children, stack, out)
+                stack.pop()
+            elif value:
+                # Standard mustache: a truthy scalar becomes the current
+                # context, so {{.}} renders the value itself.
+                stack.append(value)
+                _render_nodes(children, stack, out)
+                stack.pop()
+        elif kind == "^":
+            value = _lookup(stack, node[1])
+            if not value:
+                _render_nodes(node[2], stack, out)
+
+
+def render(template: str, params: dict[str, Any] | None) -> str:
+    """Render a mustache template against params; raises TemplateError on
+    malformed syntax (the reference 400s these as script compile errors)."""
+    nodes: list = []
+    _parse(list(_tokens(template)), 0, None, nodes)
+    out: list[str] = []
+    _render_nodes(nodes, [params or {}], out)
+    return "".join(out)

@@ -147,28 +147,19 @@ class OracleSearcher:
             f, terms = self._span_unit_terms(q)
             return self._span_eval(f, [terms], 0, True, -1, q.boost)
         if isinstance(q, SpanNearQuery):
-            fields, clause_terms = set(), []
-            for c in q.clauses:
-                f, ts = self._span_unit_terms(c)
-                fields.add(f)
-                clause_terms.append(ts)
-            if len(fields) != 1:
-                raise ValueError(
-                    "[span_near] clauses must all target the same field"
-                )
+            from ..query.dsl import span_clause_lists
+
+            f, clause_terms = span_clause_lists(q.clauses)
             return self._span_eval(
-                fields.pop(), clause_terms, q.slop, q.in_order, -1, q.boost
+                f, clause_terms, q.slop, q.in_order, -1, q.boost
             )
         if isinstance(q, SpanFirstQuery):
             f, terms = self._span_unit_terms(q.match)
             return self._span_eval(f, [terms], 0, True, q.end, q.boost)
         if isinstance(q, SpanNotQuery):
-            fi, inc = self._span_unit_terms(q.include)
-            fe, exc = self._span_unit_terms(q.exclude)
-            if fi != fe:
-                raise ValueError(
-                    "[span_not] include and exclude must target the same field"
-                )
+            from ..query.dsl import span_not_lists
+
+            fi, inc, exc = span_not_lists(q.include, q.exclude)
             return self._span_eval(
                 fi, [inc], 0, True, -1, q.boost,
                 exclude_terms=exc, pre=q.pre, post=q.post,

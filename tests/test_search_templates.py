@@ -1,0 +1,147 @@
+"""Search templates (mustache-lite) and stored scripts.
+
+Reference: modules/lang-mustache (MustacheScriptEngine,
+TransportSearchTemplateAction, RestRenderSearchTemplateAction) and
+script/ScriptService.java (cluster-state stored scripts).
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import ApiError, Node
+from elasticsearch_tpu.script.mustache import TemplateError, render
+
+
+def test_mustache_variables_and_escaping():
+    assert render("hello {{name}}", {"name": "world"}) == "hello world"
+    assert render('{"q": "{{text}}"}', {"text": 'say "hi"'}) == (
+        '{"q": "say \\"hi\\""}'
+    )
+    assert render("{{a.b}}", {"a": {"b": 7}}) == "7"
+    assert render("{{missing}}", {}) == ""
+    assert render("{{{raw}}}", {"raw": 'x"y'}) == 'x"y'
+    assert render("{{flag}}", {"flag": True}) == "true"
+
+
+def test_mustache_tojson_join_sections():
+    assert render("{{#toJson}}v{{/toJson}}", {"v": [1, 2, {"a": "b"}]}) == (
+        json.dumps([1, 2, {"a": "b"}])
+    )
+    assert render("{{#join}}v{{/join}}", {"v": ["a", "b", "c"]}) == "a,b,c"
+    out = render(
+        "{{#items}}[{{.}}]{{/items}}", {"items": ["x", "y"]}
+    )
+    assert out == "[x][y]"
+    assert render("{{#on}}yes{{/on}}{{^on}}no{{/on}}", {"on": False}) == "no"
+    assert render("{{#on}}yes{{/on}}{{^on}}no{{/on}}", {"on": 1}) == "yes"
+    assert render("a{{! comment }}b", {}) == "ab"
+
+
+def test_mustache_errors():
+    with pytest.raises(TemplateError):
+        render("{{#a}}unclosed", {})
+    with pytest.raises(TemplateError):
+        render("{{/a}}", {})
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path))
+    n.create_index("products", {"mappings": {"properties": {
+        "name": {"type": "text"}, "price": {"type": "double"}}}})
+    for i, (name, price) in enumerate(
+        [("red shirt", 10.0), ("blue shirt", 25.0), ("red hat", 40.0)]
+    ):
+        n.index_doc("products", {"name": name, "price": price}, str(i))
+    n.refresh("products")
+    return n
+
+
+def test_search_template_inline(node):
+    out = node.search_template(
+        "products",
+        {
+            "source": {
+                "query": {"match": {"name": "{{q}}"}},
+                "size": "{{size}}",
+            },
+            "params": {"q": "red", "size": 10},
+        },
+    )
+    ids = [h["_id"] for h in out["hits"]["hits"]]
+    assert sorted(ids) == ["0", "2"]
+
+
+def test_stored_search_template_and_render(node):
+    node.put_script(
+        "find-by-name",
+        {
+            "script": {
+                "lang": "mustache",
+                "source": '{"query": {"match": {"name": "{{q}}"}}}',
+            }
+        },
+    )
+    got = node.get_script("find-by-name")
+    assert got["found"] and got["script"]["lang"] == "mustache"
+    rendered = node.render_template(
+        {"id": "find-by-name", "params": {"q": "hat"}}
+    )
+    assert rendered["template_output"] == {
+        "query": {"match": {"name": "hat"}}
+    }
+    out = node.search_template(
+        "products", {"id": "find-by-name", "params": {"q": "hat"}}
+    )
+    assert [h["_id"] for h in out["hits"]["hits"]] == ["2"]
+    node.delete_script("find-by-name")
+    with pytest.raises(ApiError):
+        node.get_script("find-by-name")
+
+
+def test_stored_painless_script_in_query(node):
+    node.put_script(
+        "price-boost",
+        {"script": {"lang": "painless", "source": "_score * doc['price'].value"}},
+    )
+    out = node.search(
+        "products",
+        {
+            "query": {
+                "script_score": {
+                    "query": {"match": {"name": "shirt"}},
+                    "script": {"id": "price-boost"},
+                }
+            }
+        },
+    )
+    hits = out["hits"]["hits"]
+    assert [h["_id"] for h in hits] == ["1", "0"]  # price re-ranks blue first
+
+
+def test_stored_scripts_persist_across_restart(node, tmp_path):
+    node.put_script(
+        "t1", {"script": {"lang": "mustache", "source": '{"size": {{n}}}'}}
+    )
+    n2 = Node(data_path=str(tmp_path))
+    assert n2.get_script("t1")["found"]
+    out = n2.render_template({"id": "t1", "params": {"n": 3}})
+    assert out["template_output"] == {"size": 3}
+
+
+def test_put_script_validation(node):
+    with pytest.raises(ApiError):
+        node.put_script("bad", {"script": {"lang": "mustache", "source": "{{#x}}"}})
+    with pytest.raises(ApiError):
+        node.put_script("bad", {"script": {"lang": "painless", "source": "import os"}})
+    with pytest.raises(ApiError):
+        node.put_script("bad", {"script": {"lang": "groovy", "source": "x"}})
+    with pytest.raises(ApiError):
+        node.put_script("bad", {"nope": 1})
+    with pytest.raises(ApiError):
+        node.search_template("products", {"params": {}})
+    with pytest.raises(ApiError):
+        node.search_template(
+            "products", {"source": "{{q}}", "params": {"q": "notjson"}}
+        )
