@@ -32,10 +32,13 @@ DATE = "date"
 DENSE_VECTOR = "dense_vector"
 OBJECT = "object"
 NESTED = "nested"
+COMPLETION = "completion"
 
 NUMERIC_TYPES = {LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT, DATE, BOOLEAN}
 INVERTED_TYPES = {TEXT, KEYWORD}
-ALL_TYPES = NUMERIC_TYPES | INVERTED_TYPES | {DENSE_VECTOR, OBJECT, NESTED}
+ALL_TYPES = NUMERIC_TYPES | INVERTED_TYPES | {
+    DENSE_VECTOR, OBJECT, NESTED, COMPLETION,
+}
 
 
 def parse_date_millis(value: Any) -> float:

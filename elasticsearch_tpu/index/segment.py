@@ -29,7 +29,7 @@ import numpy as np
 from ..native import NativeAccumulator, tokenize_ascii
 from ..native import available as native_available
 from ..utils import smallfloat
-from .mapping import DENSE_VECTOR, NESTED, Mappings, coerce_numeric
+from .mapping import COMPLETION, DENSE_VECTOR, NESTED, Mappings, coerce_numeric
 
 
 @dataclass
@@ -128,6 +128,12 @@ class Segment:
     # (a sub-segment with full-path field names) plus an explicit
     # nested-doc -> parent-doc map, so the join is one scatter.
     nested: dict[str, "NestedBlock"] = field(default_factory=dict)
+    # Completion-field entries, per field, SORTED by normalized input:
+    # (normalized, surface, weight, local doc). The host-side analog of the
+    # reference's in-memory suggest FSTs (search/suggest/completion/
+    # CompletionSuggester.java:30 over NRTSuggester) — prefix lookup is a
+    # bisect over the sorted array.
+    completion: dict[str, list[tuple]] = field(default_factory=dict)
 
     def doc_version(self, local: int) -> int:
         return int(self.versions[local]) if self.versions is not None else 1
@@ -185,6 +191,8 @@ class SegmentBuilder:
         # Nested paths: each accumulates its objects in a sub-builder over
         # the path's scope mappings, plus the parent doc of every object.
         self._nested: dict[str, tuple["SegmentBuilder", list[int]]] = {}
+        # Completion fields: field -> [(normalized, surface, weight, doc)].
+        self._completion: dict[str, list[tuple]] = {}
 
     def _nested_candidate(self, path: str) -> tuple["SegmentBuilder", list[int]]:
         """The accumulator a nested object WOULD commit into — existing or
@@ -225,6 +233,7 @@ class SegmentBuilder:
         staged_vectors: list,
         staged_postings: list,
         staged_numeric: list,
+        staged_completion: list,
     ) -> None:
         """Stage one (field, value) pair — raises on mapper errors, touches
         no builder state (add()'s atomicity contract).
@@ -233,7 +242,28 @@ class SegmentBuilder:
         False then); numeric doc_values and vectors are stored regardless,
         matching the reference where index:false keeps doc_values available
         for sort/agg/script access."""
-        if fm.type == DENSE_VECTOR:
+        if fm.type == COMPLETION:
+            entries = []
+            for v in _iter_field_values(value):
+                if isinstance(v, dict):
+                    inputs = v.get("input", [])
+                    if isinstance(inputs, str):
+                        inputs = [inputs]
+                    try:
+                        weight = int(v.get("weight", 1))
+                    except (TypeError, ValueError):
+                        raise ValueError(
+                            f"weight must be an integer for completion "
+                            f"field [{field_name}]"
+                        ) from None
+                    for inp in inputs:
+                        surface = str(inp)
+                        entries.append((surface.lower(), surface, weight))
+                else:
+                    surface = str(v)
+                    entries.append((surface.lower(), surface, 1))
+            staged_completion.append((field_name, entries))
+        elif fm.type == DENSE_VECTOR:
             vec = np.asarray(value, dtype=np.float32)
             if fm.dims and vec.shape[-1] != fm.dims:
                 raise ValueError(
@@ -321,6 +351,9 @@ class SegmentBuilder:
                     )
                 nested_ops.append((prefix, obj))
             return
+        if fm is not None and fm.type == COMPLETION:
+            flat.setdefault(prefix, (fm, []))[1].append(value)
+            return
         if isinstance(value, dict):
             if fm is not None and fm.type not in ("object", "nested"):
                 raise ValueError(
@@ -369,6 +402,7 @@ class SegmentBuilder:
         staged_vectors: list[tuple[str, np.ndarray]] = []
         staged_postings: list[tuple[str, dict[str, int], int]] = []
         staged_numeric: list[tuple[str, float]] = []
+        staged_completion: list[tuple[str, list[tuple]]] = []
         flat: dict[str, tuple[Any, list[Any]]] = {}
         nested_ops: list[tuple[str, dict[str, Any]]] = []
         for source_name, value in source.items():
@@ -392,6 +426,7 @@ class SegmentBuilder:
                     staged_vectors,
                     staged_postings,
                     staged_numeric,
+                    staged_completion,
                 )
         staged_nested = []
         candidates: dict[str, tuple] = {}
@@ -405,7 +440,13 @@ class SegmentBuilder:
             staged_nested.append(
                 (path, acc, prefixed, sub_builder._stage_doc(prefixed))
             )
-        return staged_vectors, staged_postings, staged_numeric, staged_nested
+        return (
+            staged_vectors,
+            staged_postings,
+            staged_numeric,
+            staged_completion,
+            staged_nested,
+        )
 
     def add(
         self,
@@ -429,7 +470,13 @@ class SegmentBuilder:
 
     def _commit_doc(self, source, doc_id, version, seqno, staged) -> int:
         local = len(self._sources)
-        staged_vectors, staged_postings, staged_numeric, staged_nested = staged
+        (
+            staged_vectors,
+            staged_postings,
+            staged_numeric,
+            staged_completion,
+            staged_nested,
+        ) = staged
         # ---- commit phase: nothing below raises -------------------------
         self._sources.append(source)
         self._ids.append(doc_id if doc_id is not None else str(local))
@@ -475,6 +522,10 @@ class SegmentBuilder:
                 self._lengths.setdefault(field_name, {})[local] = total_len
         for field_name, v in staged_numeric:
             self._numeric.setdefault(field_name, {})[local] = v
+        for field_name, entries in staged_completion:
+            bucket = self._completion.setdefault(field_name, [])
+            for norm, surface, weight in entries:
+                bucket.append((norm, surface, weight, local))
         for path, acc, prefixed, sub_staged in staged_nested:
             self._nested.setdefault(path, acc)
             sub_builder, parents = acc
@@ -566,6 +617,10 @@ class SegmentBuilder:
             for doc, vec in by_doc.items():
                 mat[doc] = vec
             vectors[fname] = mat
+        completion = {
+            fname: sorted(entries)
+            for fname, entries in self._completion.items()
+        }
         nested = {
             path: NestedBlock(
                 seg=sub_builder.build(),
@@ -583,6 +638,7 @@ class SegmentBuilder:
             versions=np.asarray(self._versions, dtype=np.int64),
             seqnos=np.asarray(self._seqnos, dtype=np.int64),
             nested=nested,
+            completion=completion,
         )
 
     def _norms_present(self, fname: str, n: int):

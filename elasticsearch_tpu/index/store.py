@@ -71,6 +71,11 @@ def _segment_arrays(
         arrays[f"{key_prefix}doc_versions"] = segment.versions
     if segment.seqnos is not None:
         arrays[f"{key_prefix}doc_seqnos"] = segment.seqnos
+    if segment.completion:
+        meta["completion"] = {
+            f: [list(e) for e in entries]
+            for f, entries in segment.completion.items()
+        }
     if segment.nested:
         meta["nested"] = {}
         for ni, (npath, block) in enumerate(sorted(segment.nested.items())):
@@ -125,6 +130,10 @@ def _segment_from(
         name: data[f"{key_prefix}vec{j}"]
         for j, name in enumerate(sorted(meta["vectors"]))
     }
+    completion = {
+        f: [tuple(e) for e in entries]
+        for f, entries in (meta.get("completion") or {}).items()
+    }
     nested = {}
     for npath, entry in (meta.get("nested") or {}).items():
         npre = entry["key"]
@@ -151,6 +160,7 @@ def _segment_from(
             else None
         ),
         nested=nested,
+        completion=completion,
     )
 
 

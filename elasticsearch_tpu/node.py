@@ -1065,7 +1065,7 @@ class Node:
         request_cache: bool | None = None,
     ) -> dict:
         svc = self.get_index(index)
-        if body and self.stored_scripts:
+        if body:
             body = self.resolve_script_refs(body)
         if self._scrolls:
             # Reap expired scroll contexts opportunistically: they pin
@@ -1165,7 +1165,7 @@ class Node:
         must not publish buffered docs or invalidate caches); a doc that
         is only in the unrefreshed buffer is not searchable yet and
         reports 404 like the reference's uid-term lookup."""
-        if body and self.stored_scripts:
+        if body:
             body = self.resolve_script_refs(body)
         from .ops import bm25_device
 

@@ -48,11 +48,23 @@ def run_suggest(
                 name, str(text), phrase_spec, mappings, stats, engines or []
             )
             continue
+        comp_spec = spec.get("completion")
+        if comp_spec is not None:
+            regex = spec.get("regex")
+            prefix = spec.get("prefix", spec.get("text", text or None))
+            if regex is None and prefix is None:
+                raise ValueError(
+                    f"suggestion [{name}] requires [prefix] or [regex]"
+                )
+            out[name] = _completion_suggest(
+                str(prefix or ""), regex, comp_spec, mappings, engines or []
+            )
+            continue
         term_spec = spec.get("term")
         if term_spec is None:
             raise ValueError(
-                f"suggestion [{name}] requires a [term] or [phrase] "
-                f"suggester (other suggesters are not supported yet)"
+                f"suggestion [{name}] requires a [term], [phrase], or "
+                f"[completion] suggester"
             )
         field = term_spec.get("field")
         if not field:
@@ -99,6 +111,116 @@ def run_suggest(
             entries.append(entry)
         out[name] = entries
     return out
+
+
+# -------------------------------------------------------------- completion
+
+
+def _completion_suggest(
+    prefix: str, regex, spec: dict, mappings, engines
+) -> list[dict]:
+    """Completion suggester over the per-segment sorted input arrays.
+
+    The reference builds per-segment suggest FSTs and walks them by prefix
+    with weighted top-N (search/suggest/completion/CompletionSuggester.
+    java:30 over NRTSuggester); the analog here is a bisect over each
+    segment's sorted (normalized, surface, weight, doc) entries, merged
+    across segments, ranked weight-desc then surface-asc. `fuzzy` matches
+    prefixes within `fuzziness` edits (OSA, like the reference's
+    FuzzyCompletionQuery over a Levenshtein automaton).
+    """
+    import bisect
+
+    field = spec.get("field")
+    if not field:
+        raise ValueError("[completion] requires [field]")
+    fm = mappings.get(field)
+    if fm is None or fm.type != "completion":
+        raise ValueError(
+            f"Field [{field}] is not a completion suggest field"
+        )
+    size = int(spec.get("size", 5))
+    skip_duplicates = bool(spec.get("skip_duplicates", False))
+    fuzzy = spec.get("fuzzy")
+    max_edits = 0
+    if fuzzy is not None:
+        if fuzzy is True or fuzzy == {}:
+            fuzzy = {}
+        raw = (fuzzy or {}).get("fuzziness", "AUTO")
+        from ..query.compile import _auto_fuzziness
+
+        max_edits = _auto_fuzziness(raw, prefix)
+    norm_prefix = prefix.lower()
+    pattern = None
+    if regex is not None:
+        from ..query.compile import regexp_pattern
+
+        pattern = regexp_pattern(str(regex), case_insensitive=False)
+    rows: list[tuple] = []
+    for engine in engines:
+        for handle in engine.segments:
+            entries = handle.segment.completion.get(field)
+            if not entries:
+                continue
+            live = handle.live_host
+            if pattern is not None:
+                # Completion regex is anchored at the input's start
+                # (RegexCompletionQuery).
+                span = [e for e in entries if pattern.match(e[0])]
+            elif max_edits == 0:
+                # Entries sharing the prefix are one contiguous sorted
+                # run; scanning to the first non-match avoids an upper-
+                # bound sentinel (which would drop inputs whose next code
+                # point is astral, > U+FFFF).
+                lo = bisect.bisect_left(entries, (norm_prefix,))
+                span = []
+                for e in entries[lo:]:
+                    if not e[0].startswith(norm_prefix):
+                        break
+                    span.append(e)
+            else:
+                span = [
+                    e
+                    for e in entries
+                    if _prefix_within_edits(norm_prefix, e[0], max_edits)
+                ]
+            for norm, surface, weight, doc in span:
+                if doc < len(live) and not live[doc]:
+                    continue
+                rows.append(
+                    (-int(weight), surface, handle.segment.ids[doc])
+                )
+    rows.sort()
+    options = []
+    seen: set[str] = set()
+    for neg_weight, surface, doc_id in rows:
+        if skip_duplicates:
+            if surface in seen:
+                continue
+            seen.add(surface)
+        options.append(
+            {"text": surface, "_id": doc_id, "_score": float(-neg_weight)}
+        )
+        if len(options) >= size:
+            break
+    return [
+        {
+            "text": prefix,
+            "offset": 0,
+            "length": len(prefix),
+            "options": options,
+        }
+    ]
+
+
+def _prefix_within_edits(prefix: str, norm: str, max_edits: int) -> bool:
+    """Does some prefix of `norm` sit within `max_edits` of `prefix`?"""
+    lp = len(prefix)
+    for length in range(max(0, lp - max_edits), lp + max_edits + 1):
+        d = _damerau_bounded(prefix, norm[:length], max_edits)
+        if d is not None:
+            return True
+    return False
 
 
 # ------------------------------------------------------------------ phrase

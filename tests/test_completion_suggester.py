@@ -1,0 +1,166 @@
+"""Completion suggester + completion field type.
+
+Reference: search/suggest/completion/CompletionSuggester.java:30
+(NRTSuggester FSTs), CompletionFieldMapper (input/weight docs),
+FuzzyCompletionQuery (fuzzy prefix).
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import ApiError, Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path))
+    n.create_index(
+        "music",
+        {
+            "mappings": {
+                "properties": {
+                    "title": {"type": "text"},
+                    "suggest": {"type": "completion"},
+                }
+            }
+        },
+    )
+    docs = [
+        ("1", {"title": "a", "suggest": {"input": ["Hotel California", "California Dreamin"], "weight": 10}}),
+        ("2", {"title": "b", "suggest": {"input": "Hotel Costa Rica", "weight": 5}}),
+        ("3", {"title": "c", "suggest": ["Hot Chocolate", "Chocolate Rain"]}),
+        ("4", {"title": "d", "suggest": {"input": "Hotline Bling", "weight": 20}}),
+    ]
+    for doc_id, src in docs:
+        n.index_doc("music", src, doc_id)
+    n.refresh("music")
+    return n
+
+
+def _options(node, body):
+    out = node.search("music", {"suggest": {"s": body}, "size": 0})
+    return out["suggest"]["s"][0]["options"]
+
+
+def test_prefix_weight_ranking(node):
+    opts = _options(node, {"prefix": "hot", "completion": {"field": "suggest"}})
+    texts = [o["text"] for o in opts]
+    # Weight-desc: Hotline Bling (20) > Hotel California (10) > Hotel
+    # Costa Rica (5) > Hot Chocolate (1).
+    assert texts == [
+        "Hotline Bling",
+        "Hotel California",
+        "Hotel Costa Rica",
+        "Hot Chocolate",
+    ]
+    assert opts[0]["_id"] == "4" and opts[0]["_score"] == 20.0
+
+
+def test_prefix_case_insensitive_and_size(node):
+    opts = _options(
+        node, {"prefix": "HOTEL", "completion": {"field": "suggest", "size": 1}}
+    )
+    assert [o["text"] for o in opts] == ["Hotel California"]
+
+
+def test_fuzzy_prefix(node):
+    opts = _options(
+        node,
+        {"prefix": "hotl", "completion": {"field": "suggest", "fuzzy": {}}},
+    )
+    texts = [o["text"] for o in opts]
+    assert "Hotline Bling" in texts and "Hotel California" in texts
+
+
+def test_skip_duplicates(node):
+    node.index_doc(
+        "music", {"title": "e", "suggest": {"input": "Hotel California", "weight": 3}}, "5"
+    )
+    node.refresh("music")
+    with_dups = _options(
+        node, {"prefix": "hotel cal", "completion": {"field": "suggest"}}
+    )
+    assert len(with_dups) == 2
+    deduped = _options(
+        node,
+        {
+            "prefix": "hotel cal",
+            "completion": {"field": "suggest", "skip_duplicates": True},
+        },
+    )
+    assert [o["text"] for o in deduped] == ["Hotel California"]
+
+
+def test_deleted_docs_stop_suggesting(node):
+    node.delete_doc("music", "4", refresh=True)
+    opts = _options(node, {"prefix": "hotline", "completion": {"field": "suggest"}})
+    assert opts == []
+
+
+def test_completion_survives_restart(node, tmp_path):
+    node.flush("music")
+    n2 = Node(data_path=str(tmp_path))
+    out = n2.search(
+        "music",
+        {
+            "suggest": {
+                "s": {"prefix": "hot choc", "completion": {"field": "suggest"}}
+            },
+            "size": 0,
+        },
+    )
+    assert [o["text"] for o in out["suggest"]["s"][0]["options"]] == [
+        "Hot Chocolate"
+    ]
+
+
+def test_completion_requires_field(node):
+    with pytest.raises(ApiError):
+        node.search(
+            "music",
+            {"suggest": {"s": {"prefix": "x", "completion": {}}}, "size": 0},
+        )
+
+
+def test_completion_regex(node):
+    opts = _options(
+        node, {"regex": "hot.l", "completion": {"field": "suggest"}}
+    )
+    texts = [o["text"] for o in opts]
+    assert "Hotel California" in texts and "Hot Chocolate" not in texts
+
+
+def test_completion_requires_prefix_or_regex(node):
+    with pytest.raises(ApiError):
+        node.search(
+            "music",
+            {"suggest": {"s": {"completion": {"field": "suggest"}}}, "size": 0},
+        )
+
+
+def test_completion_wrong_field_type(node):
+    with pytest.raises(ApiError):
+        node.search(
+            "music",
+            {
+                "suggest": {
+                    "s": {"prefix": "x", "completion": {"field": "title"}}
+                },
+                "size": 0,
+            },
+        )
+
+
+def test_stored_script_ref_404_without_any_scripts(node):
+    with pytest.raises(ApiError) as e:
+        node.search(
+            "music",
+            {
+                "query": {
+                    "script_score": {
+                        "query": {"match_all": {}},
+                        "script": {"id": "does-not-exist"},
+                    }
+                }
+            },
+        )
+    assert "unable to find script" in str(e.value)
