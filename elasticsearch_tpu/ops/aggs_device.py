@@ -158,7 +158,11 @@ def _eval_agg(spec, arrays, seg, matched, scores, num_docs):
         idx = jnp.where(m, ords, tp)
         seen = jnp.zeros(tp + 1, dtype=bool).at[idx].max(m)[:tp]
         return {"distinct": jnp.sum(seen, dtype=jnp.int32)}
-    if kind == "terms":
+    if kind == "sig_matched":
+        # significant_terms over a segment without the field: only the
+        # context (subset) size contributes.
+        return {"doc_count": jnp.sum(matched, dtype=jnp.int32)}
+    if kind in ("terms", "sig_terms"):
         field_name, tp, sub_fields = spec[1], spec[2], spec[3]
         want_mask = len(spec) > 4  # top_hits subs need the context mask
         docs, ords = _terms_postings(seg, field_name)
@@ -169,6 +173,11 @@ def _eval_agg(spec, arrays, seg, matched, scores, num_docs):
             jnp.zeros(tp + 1, dtype=jnp.int32).at[idx].add(m.astype(jnp.int32))
         )[:tp]
         out = {"counts": counts}
+        if kind == "sig_terms":
+            # Subset (foreground) size: the significance heuristics need
+            # the context doc count, not just per-term counts
+            # (SignificantTermsAggregatorFactory subsetSize).
+            out["doc_count"] = jnp.sum(matched, dtype=jnp.int32)
         if want_mask:
             out["ctx_mask"] = matched
         if sub_fields:

@@ -39,7 +39,9 @@ METRIC_KINDS = {"min", "max", "sum", "avg", "value_count", "stats"}
 # the float64 columns (f64-exact reduce; InternalSum.java:22 reduces in
 # double) — they nest under filter-type parents like any metric.
 HOST_METRIC_KINDS = {"percentiles", "percentile_ranks", "extended_stats"}
-BUCKET_METRIC_HOSTS = {"terms", "histogram", "date_histogram", "range"}
+BUCKET_METRIC_HOSTS = {
+    "terms", "significant_terms", "histogram", "date_histogram", "range",
+}
 NESTING_KINDS = {"filter", "filters", "global", "missing"}
 MAX_BUCKETS = 65536  # ES search.max_buckets default
 # ES default percents for the percentiles aggregation.
@@ -119,7 +121,7 @@ def _validate(node: AggNode) -> None:
         | HOST_METRIC_KINDS
         | BUCKET_METRIC_HOSTS
         | NESTING_KINDS
-        | {"cardinality", "top_hits", "composite"}
+        | {"cardinality", "top_hits", "composite", "matrix_stats"}
     )
     if k not in known:
         raise AggParsingError(f"unknown aggregation type [{k}]")
@@ -159,6 +161,15 @@ def _validate(node: AggNode) -> None:
                 raise AggParsingError(
                     f"aggregation [{node.name}] of type [{k}] requires [field]"
                 )
+    if k == "matrix_stats":
+        if node.subs:
+            raise AggParsingError(
+                f"metric aggregation [{node.name}] cannot hold sub-aggregations"
+            )
+        if not node.params.get("fields"):
+            raise AggParsingError(
+                f"matrix_stats [{node.name}] requires [fields]"
+            )
     if k == "percentile_ranks" and not node.params.get("values"):
         raise AggParsingError(
             f"percentile_ranks [{node.name}] requires [values]"
@@ -411,6 +422,23 @@ class Aggregator:
             # numeric cardinality (exact host compute off the matched mask),
             # or field absent from this segment (host fallback yields none)
             return ("matched",), {}
+        if k == "matrix_stats":
+            for fname in p["fields"]:
+                self._require_numeric(fname)
+            return ("matched",), {}
+        if k == "significant_terms":
+            fname = p["field"]
+            if self._keyword_ok(handle, fname):
+                tp = _pow2(handle.device.fields[fname].num_terms)
+                spec = ("sig_terms", fname, tp, self._sub_fields(node, handle))
+                return spec + self._want_mask(node), {}
+            if self._is_text(handle, fname):
+                raise AggParsingError(
+                    f"significant_terms aggregation on text field [{fname}] "
+                    f"requires keyword doc values"
+                )
+            # absent from this segment: count the context size only
+            return ("sig_matched",), {}
         if k == "terms":
             fname = p["field"]
             if self._keyword_ok(handle, fname):
@@ -704,6 +732,17 @@ def new_merge_state(node: AggNode) -> dict[str, Any]:
         return {"values": set()}
     if k == "terms":
         return {"counts": {}, "subs": {}, "host": False, "hits_segments": []}
+    if k == "significant_terms":
+        return {
+            "counts": {},
+            "subs": {},
+            "hits_segments": [],
+            "doc_count": 0,       # subset (context) size
+            "bg_total": 0,        # superset size: index live docs
+            "bg_df": {},          # superset per-term doc counts
+        }
+    if k == "matrix_stats":
+        return {"moments": None}
     if k in ("histogram", "date_histogram"):
         return {"counts": None, "subs": {}, "hits_segments": []}
     if k == "range":
@@ -791,6 +830,48 @@ def merge_segment_result(
         else:  # numeric host fallback: exact distinct from the f64 column
             for v in _host_values(result, handle, fname):
                 state["values"].add(float(v))
+        return
+    if k == "matrix_stats":
+        _merge_matrix_stats(node, state, result, handle)
+        return
+    if k == "significant_terms":
+        _capture_hits_planes(node, state, handle, result, root_planes)
+        fname = node.params["field"]
+        state["doc_count"] += int(np.asarray(result["doc_count"]))
+        live = getattr(handle, "live_host", None)
+        state["bg_total"] += (
+            int(np.count_nonzero(live))
+            if live is not None
+            else handle.segment.num_docs
+        )
+        fld = handle.segment.fields.get(fname)
+        if fld is not None:
+            for term, tid in fld.terms.items():
+                state["bg_df"][term] = state["bg_df"].get(term, 0) + int(
+                    fld.df[tid]
+                )
+        dfield = handle.device.fields.get(fname)
+        if dfield is None or dfield.ord_terms is None or "counts" not in result:
+            return
+        vocab = list(dfield.terms.keys())
+        counts = np.asarray(result["counts"])
+        nz = np.flatnonzero(counts[: len(vocab)])
+        for i in nz:
+            key = vocab[i]
+            state["counts"][key] = state["counts"].get(key, 0) + int(counts[i])
+        if node.subs and "subs" in result:
+            keys = [
+                vocab[i] if counts[i] > 0 else None
+                for i in range(len(vocab))
+            ]
+            for f, planes in result["subs"].items():
+                trimmed = {
+                    name: np.asarray(arr)[: len(vocab)]
+                    for name, arr in planes.items()
+                }
+                _merge_bucket_planes(
+                    state["subs"].setdefault(f, {}), trimmed, keys
+                )
         return
     if k == "terms":
         _capture_hits_planes(node, state, handle, result, root_planes)
@@ -1327,6 +1408,178 @@ def _render_composite(node: AggNode, state, engine, plan, index_name):
     return out
 
 
+def _merge_matrix_stats(node, state, result, handle) -> None:
+    """Accumulate f64 raw power sums + cross-products over docs carrying
+    ALL requested fields (rows with any missing value are excluded, the
+    reference module's default; aggs-matrix-stats RunningStats)."""
+    fields = [str(f) for f in node.params["fields"]]
+    n = handle.segment.num_docs
+    mask = np.asarray(result["mask"])[:n]
+    cols = []
+    for f in fields:
+        col = handle.segment.doc_values.get(f)
+        if col is None:
+            return  # a wholly-absent field contributes no complete rows
+        cols.append(col[:n].astype(np.float64))
+    rows = mask.copy()
+    for col in cols:
+        rows &= ~np.isnan(col)
+    if not rows.any():
+        return
+    x = np.stack([col[rows] for col in cols])  # [K, R]
+    mom = state["moments"]
+    if mom is None:
+        kdim = len(fields)
+        mom = state["moments"] = {
+            "fields": fields,
+            "n": 0,
+            # Per-field pivot (the first observed value): power sums
+            # accumulate over x - pivot so large-offset data (epoch
+            # millis) doesn't catastrophically cancel when central
+            # moments are derived — the same problem the reference's
+            # Welford-style RunningStats updates avoid.
+            "pivot": x[:, 0].copy(),
+            "s1": np.zeros(kdim),
+            "s2": np.zeros(kdim),
+            "s3": np.zeros(kdim),
+            "s4": np.zeros(kdim),
+            "cross": np.zeros((kdim, kdim)),
+        }
+    x = x - mom["pivot"][:, None]
+    mom["n"] += int(x.shape[1])
+    mom["s1"] += x.sum(axis=1)
+    mom["s2"] += (x**2).sum(axis=1)
+    mom["s3"] += (x**3).sum(axis=1)
+    mom["s4"] += (x**4).sum(axis=1)
+    mom["cross"] += x @ x.T
+
+
+def _render_matrix_stats(node: AggNode, state) -> dict[str, Any]:
+    mom = state["moments"]
+    if mom is None or mom["n"] == 0:
+        return {"doc_count": 0, "fields": []}
+    n = mom["n"]
+    names = mom["fields"]
+    sh_mean = mom["s1"] / n  # mean of the PIVOT-SHIFTED values
+    mean = mom["pivot"] + sh_mean
+    # Central moments from pivot-shifted power sums (shift-invariant).
+    m2 = np.maximum(mom["s2"] / n - sh_mean**2, 0.0)
+    m3 = mom["s3"] / n - 3 * sh_mean * mom["s2"] / n + 2 * sh_mean**3
+    m4 = (
+        mom["s4"] / n
+        - 4 * sh_mean * mom["s3"] / n
+        + 6 * sh_mean**2 * mom["s2"] / n
+        - 3 * sh_mean**4
+    )
+    variance = m2 * n / max(n - 1, 1)  # unbiased, like RunningStats
+    std = np.sqrt(m2)
+    cov_pop = mom["cross"] / n - np.outer(sh_mean, sh_mean)
+    cov = cov_pop * n / max(n - 1, 1)
+    out_fields = []
+    for i, name in enumerate(names):
+        skew = float(m3[i] / std[i] ** 3) if std[i] > 0 else 0.0
+        kurt = float(m4[i] / m2[i] ** 2) if m2[i] > 0 else 0.0
+        covariance = {}
+        correlation = {}
+        for j, other in enumerate(names):
+            covariance[other] = float(cov[i, j])
+            denom = std[i] * std[j]
+            correlation[other] = (
+                float(cov_pop[i, j] / denom) if denom > 0 else 0.0
+            )
+        out_fields.append(
+            {
+                "name": name,
+                "count": n,
+                "mean": float(mean[i]),
+                "variance": float(variance[i]),
+                "skewness": skew,
+                "kurtosis": kurt,
+                "covariance": covariance,
+                "correlation": correlation,
+            }
+        )
+    return {"doc_count": n, "fields": out_fields}
+
+
+_SIG_HEURISTICS = ("jlh", "chi_square", "percentage")
+
+
+def _sig_score(heuristic: str, fg: int, subset: int, bg: int, superset: int,
+               params: dict) -> float:
+    """Significance heuristics (search/aggregations/bucket/terms/heuristic/):
+    JLH (the default), chi_square, percentage."""
+    subset = max(subset, 1)
+    superset = max(superset, 1)
+    fg_pct = fg / subset
+    bg_pct = bg / superset
+    if heuristic == "percentage":
+        return fg / bg if bg > 0 else 0.0
+    if heuristic == "chi_square":
+        include_negatives = bool(params.get("include_negatives", False))
+        if not include_negatives and fg_pct < bg_pct:
+            return 0.0
+        # 2x2 contingency chi-square, the reference's ChiSquare.java.
+        a, b = fg, bg - fg
+        c, d = subset - fg, superset - bg - (subset - fg)
+        num = (a * d - b * c) ** 2 * (a + b + c + d)
+        den = (a + b) * (c + d) * (a + c) * (b + d)
+        return num / den if den > 0 else 0.0
+    # JLH (JLHScore.java): absolute * relative change, 0 unless fg% > bg%.
+    if fg_pct <= bg_pct or bg_pct == 0:
+        return 0.0
+    return (fg_pct - bg_pct) * (fg_pct / bg_pct)
+
+
+def _render_significant_terms(node: AggNode, state, index_name: str) -> dict:
+    p = node.params
+    size = int(p.get("size", 10))
+    min_doc_count = int(p.get("min_doc_count", 3))
+    heuristic, hparams = "jlh", {}
+    for h in _SIG_HEURISTICS:
+        if h in p:
+            heuristic = h
+            hparams = p[h] if isinstance(p[h], dict) else {}
+    subset = state["doc_count"]
+    superset = state["bg_total"]
+    scored = []
+    for term, fg in state["counts"].items():
+        if fg < min_doc_count:
+            continue
+        bg = state["bg_df"].get(term, fg)
+        score = _sig_score(heuristic, fg, subset, bg, superset, hparams)
+        if score <= 0:
+            continue
+        scored.append((-score, term, fg, bg))
+    scored.sort()
+    buckets = []
+    for neg_score, term, fg, bg in scored[:size]:
+        b = {
+            "key": term,
+            "doc_count": fg,
+            "score": -neg_score,
+            "bg_count": bg,
+        }
+        if node.subs:
+            b.update(_sub_bucket_rendering(node, term, state["subs"]))
+            for sub in node.subs:
+                if sub.kind == "top_hits":
+                    b[sub.name] = _render_top_hits(
+                        sub,
+                        state["hits_segments"],
+                        index_name,
+                        predicate=_terms_bucket_predicate(
+                            node.params["field"], term, False
+                        ),
+                    )
+        buckets.append(b)
+    return {
+        "doc_count": subset,
+        "bg_count": superset,
+        "buckets": buckets,
+    }
+
+
 def render(
     node: AggNode, state, engine, plan: dict, index_name: str = "index"
 ) -> dict[str, Any]:
@@ -1345,6 +1598,10 @@ def render(
         return _render_composite(node, state, engine, plan, index_name)
     if k == "cardinality":
         return {"value": len(state["values"])}
+    if k == "matrix_stats":
+        return _render_matrix_stats(node, state)
+    if k == "significant_terms":
+        return _render_significant_terms(node, state, index_name)
     if k == "terms":
         size = int(node.params.get("size", 10))
         order = node.params.get("order", {"_count": "desc"})

@@ -94,6 +94,20 @@ class ShardedSearchCoordinator:
         # (collective reduce over ICI) instead of the host-side shard loop.
         self.mesh_view = None
 
+    def _shard_can_match(self, request, shard_idx: int, snapshots) -> bool:
+        from .can_match import can_match, shard_bounds
+
+        if request.query is None:
+            return True
+        # Bounds are cached per immutable segment handle inside
+        # shard_bounds, so they always describe exactly the snapshot this
+        # request pinned (scroll-frozen or fresh).
+        return can_match(
+            request.query,
+            shard_bounds(snapshots[shard_idx]),
+            self.engines[shard_idx].mappings,
+        )
+
     def global_stats(self, snapshots: list[list] | None = None):
         """Index-wide statistics across all shards' segments, cached per
         engine refresh generation (monotonic — id()-based keys are unsafe
@@ -152,12 +166,12 @@ class ShardedSearchCoordinator:
             fields=None,
         )
         if k > 0 or agg_total is None:
-            merged, total, max_score, timed_out, profiles = (
+            merged, total, max_score, timed_out, profiles, skipped = (
                 self._scatter_merge(shard_request, stats, snapshots, task=task)
             )
         else:
-            merged, total, max_score, timed_out, profiles = (
-                [], 0, None, False, [],
+            merged, total, max_score, timed_out, profiles, skipped = (
+                [], 0, None, False, [], 0,
             )
         if task is not None and task.timed_out:
             timed_out = True
@@ -178,6 +192,7 @@ class ShardedSearchCoordinator:
             aggregations=aggregations,
             shards=len(self.engines),
             timed_out=timed_out,
+            skipped=skipped,
             profile=(
                 {"shards": profiles} if request.profile and profiles else None
             ),
@@ -238,6 +253,7 @@ class ShardedSearchCoordinator:
         total = 0
         max_score = None
         timed_out = False
+        skipped = 0
         profiles: list[dict] = []
         for shard_idx, svc in enumerate(self.services):
             if task is not None:
@@ -245,6 +261,13 @@ class ShardedSearchCoordinator:
                 if task.check_deadline():
                     timed_out = True
                     break
+            # can_match pre-filter (CanMatchPreFilterSearchPhase): skip
+            # shards whose numeric bounds provably exclude the query.
+            # Skipped shards contribute nothing — including to totals,
+            # which stays exact because "cannot match" means zero hits.
+            if not self._shard_can_match(request, shard_idx, snapshots):
+                skipped += 1
+                continue
             sub = request
             after = (
                 per_shard_after[shard_idx] if per_shard_after is not None
@@ -274,7 +297,7 @@ class ShardedSearchCoordinator:
                     (self._merge_key(request, hit), shard_idx, rank, hit)
                 )
         merged.sort(key=lambda t: (t[0], t[1], t[2]))
-        return merged, total, max_score, timed_out, profiles
+        return merged, total, max_score, timed_out, profiles, skipped
 
     def scroll_page(self, ctx: ScrollContext, task=None) -> SearchResponse:
         """Serve the next page of a scroll and advance its cursors."""
@@ -286,7 +309,7 @@ class ShardedSearchCoordinator:
         stripped = replace(
             request, highlight=None, docvalue_fields=None, fields=None
         )
-        merged, total, max_score, timed_out, _profiles = self._scatter_merge(
+        merged, total, max_score, timed_out, _profiles, skipped = self._scatter_merge(
             stripped, ctx.stats, ctx.snapshots, ctx.per_shard_after, task=task
         )
         page = merged[:size]
@@ -308,6 +331,7 @@ class ShardedSearchCoordinator:
             hits=page_hits,
             shards=len(self.engines),
             timed_out=timed_out,
+            skipped=skipped,
         )
 
     @staticmethod

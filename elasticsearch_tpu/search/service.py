@@ -78,6 +78,7 @@ class SearchResponse:
     scroll_id: str | None = None
     timed_out: bool = False
     profile: dict[str, Any] | None = None
+    skipped: int = 0  # can_match pre-filtered shards
 
     def to_json(self, index_name: str = "index") -> dict[str, Any]:
         hits_obj: dict[str, Any] = {
@@ -95,7 +96,7 @@ class SearchResponse:
             "_shards": {
                 "total": self.shards,
                 "successful": self.shards,
-                "skipped": 0,
+                "skipped": self.skipped,
                 "failed": 0,
             },
             "hits": hits_obj,

@@ -1,0 +1,239 @@
+"""significant_terms, matrix_stats aggregations, and can_match pruning.
+
+Reference: SignificantTermsAggregationBuilder + JLHScore/ChiSquare
+heuristics, modules/aggs-matrix-stats (RunningStats/MatrixStatsResults),
+action/search/CanMatchPreFilterSearchPhase.java.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path))
+    n.create_index(
+        "crimes",
+        {
+            "mappings": {
+                "properties": {
+                    "desc": {"type": "text"},
+                    "type": {"type": "keyword"},
+                    "x": {"type": "double"},
+                    "y": {"type": "double"},
+                }
+            }
+        },
+    )
+    rng = np.random.default_rng(5)
+    # "bicycle theft" reports are dominated by type=theft; background has
+    # many other types.
+    types = ["burglary", "assault", "fraud", "theft", "vandalism"]
+    for i in range(200):
+        t = types[i % 5]
+        desc = "bicycle stolen" if (t == "theft" and i % 10 < 8) else "incident report"
+        x = float(rng.normal(0, 1))
+        n.index_doc(
+            "crimes",
+            {"desc": desc, "type": t, "x": x, "y": 2.0 * x + float(rng.normal(0, 0.1))},
+            str(i),
+        )
+    n.refresh("crimes")
+    return n
+
+
+def test_significant_terms_jlh(node):
+    out = node.search(
+        "crimes",
+        {
+            "size": 0,
+            "query": {"match": {"desc": "bicycle"}},
+            "aggs": {
+                "sig": {
+                    "significant_terms": {"field": "type", "min_doc_count": 3}
+                }
+            },
+        },
+    )
+    agg = out["aggregations"]["sig"]
+    assert agg["doc_count"] == out["hits"]["total"]["value"]
+    assert agg["bg_count"] == 200
+    buckets = agg["buckets"]
+    assert buckets and buckets[0]["key"] == "theft"
+    b = buckets[0]
+    assert b["doc_count"] > 0 and b["bg_count"] == 40 and b["score"] > 0
+    # "theft" is overrepresented in the foreground; others score 0 (jlh).
+    assert all(x["key"] == "theft" for x in buckets)
+
+
+def test_significant_terms_chi_square(node):
+    out = node.search(
+        "crimes",
+        {
+            "size": 0,
+            "query": {"match": {"desc": "bicycle"}},
+            "aggs": {
+                "sig": {
+                    "significant_terms": {
+                        "field": "type",
+                        "chi_square": {},
+                        "min_doc_count": 3,
+                    }
+                }
+            },
+        },
+    )
+    buckets = out["aggregations"]["sig"]["buckets"]
+    assert buckets and buckets[0]["key"] == "theft"
+
+
+def test_matrix_stats(node):
+    out = node.search(
+        "crimes",
+        {
+            "size": 0,
+            "aggs": {"m": {"matrix_stats": {"fields": ["x", "y"]}}},
+        },
+    )
+    agg = out["aggregations"]["m"]
+    assert agg["doc_count"] == 200
+    by_name = {f["name"]: f for f in agg["fields"]}
+    fx, fy = by_name["x"], by_name["y"]
+    # y = 2x + noise: correlation ~1, covariance(y,x) ~ 2*var(x).
+    assert fx["correlation"]["y"] > 0.99
+    assert abs(fy["covariance"]["x"] - 2.0 * fx["variance"]) < 0.1
+    # Cross-check moments against numpy.
+    xs = np.array(
+        [
+            node.get_doc("crimes", str(i))["_source"]["x"]
+            for i in range(200)
+        ]
+    )
+    assert abs(fx["mean"] - xs.mean()) < 1e-9
+    assert abs(fx["variance"] - xs.var(ddof=1)) < 1e-9
+
+
+def test_matrix_stats_requires_fields(node):
+    from elasticsearch_tpu.node import ApiError
+
+    with pytest.raises(ApiError):
+        node.search(
+            "crimes", {"size": 0, "aggs": {"m": {"matrix_stats": {}}}}
+        )
+
+
+@pytest.fixture()
+def sharded(tmp_path, monkeypatch):
+    # can_match belongs to the host-loop scatter/gather; the SPMD mesh
+    # path is one fused program with no per-shard skip decision.
+    monkeypatch.setenv("ESTPU_MESH_SERVING", "0")
+    n = Node(data_path=str(tmp_path))
+    n.create_index(
+        "logs",
+        {
+            "settings": {"index": {"number_of_shards": 4}},
+            "mappings": {
+                "properties": {
+                    "ts": {"type": "long"},
+                    "msg": {"type": "text"},
+                }
+            },
+        },
+    )
+    for i in range(80):
+        n.index_doc("logs", {"ts": i, "msg": f"event {i}"}, str(i))
+    n.refresh("logs")
+    return n
+
+
+def test_can_match_skips_shards(sharded):
+    # A range beyond every shard's bounds: all shards skip, zero hits.
+    out = sharded.search(
+        "logs", {"query": {"range": {"ts": {"gte": 1000}}}}
+    )
+    assert out["hits"]["total"]["value"] == 0
+    assert out["_shards"]["skipped"] == 4
+    # A matching range: results correct, and a bool filter carries the
+    # pruning decision too.
+    out = sharded.search(
+        "logs",
+        {
+            "query": {
+                "bool": {
+                    "must": [{"match": {"msg": "event"}}],
+                    "filter": [{"range": {"ts": {"gte": 0, "lte": 79}}}],
+                }
+            },
+            "size": 100,
+        },
+    )
+    assert out["hits"]["total"]["value"] == 80
+    assert out["_shards"]["skipped"] == 0
+
+
+def test_can_match_never_skips_matching_shards(sharded):
+    # Point lookup: only shards whose bounds contain ts=5 run, but the
+    # answer stays exact.
+    out = sharded.search(
+        "logs", {"query": {"term": {"ts": 5}}, "size": 10}
+    )
+    assert out["hits"]["total"]["value"] == 1
+    assert [h["_id"] for h in out["hits"]["hits"]] == ["5"]
+
+
+def test_can_match_msm_zero_does_not_skip(sharded):
+    out = sharded.search(
+        "logs",
+        {
+            "query": {
+                "bool": {
+                    "should": [{"range": {"ts": {"gte": 1000}}}],
+                    "minimum_should_match": 0,
+                }
+            },
+            "size": 0,
+        },
+    )
+    assert out["hits"]["total"]["value"] == 80
+    assert out["_shards"]["skipped"] == 0
+
+
+def test_can_match_scroll_snapshot_isolation(sharded):
+    # Bounds must follow the pinned snapshot, not the live engine: after
+    # new out-of-range docs arrive, a fresh search must still see them.
+    for i in range(4):
+        sharded.index_doc("logs", {"ts": 5000 + i, "msg": "late"}, f"n{i}")
+    sharded.refresh("logs")
+    out = sharded.search(
+        "logs", {"query": {"range": {"ts": {"gte": 4000}}}, "size": 10}
+    )
+    assert out["hits"]["total"]["value"] == 4
+
+
+def test_matrix_stats_large_offset_stability(node):
+    # Epoch-millis-scale values: raw power sums would cancel
+    # catastrophically; pivoted moments must stay accurate.
+    base = 1.7e12
+    for i in range(50):
+        node.index_doc(
+            "crimes",
+            {"x": base + float(i), "y": 3.0 * i + 0.001 * (i % 7)},
+            f"big{i}",
+        )
+    node.refresh("crimes")
+    out = node.search(
+        "crimes",
+        {
+            "size": 0,
+            "query": {"ids": {"values": [f"big{i}" for i in range(50)]}},
+            "aggs": {"m": {"matrix_stats": {"fields": ["x", "y"]}}},
+        },
+    )
+    by_name = {f["name"]: f for f in out["aggregations"]["m"]["fields"]}
+    fx = by_name["x"]
+    xs = base + np.arange(50, dtype=np.float64)
+    assert fx["variance"] >= 0
+    assert abs(fx["variance"] - xs.var(ddof=1)) / xs.var(ddof=1) < 1e-6
+    assert fx["correlation"]["y"] > 0.999
