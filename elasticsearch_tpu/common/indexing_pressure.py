@@ -1,0 +1,78 @@
+"""Indexing backpressure: a node-wide in-flight byte budget for writes.
+
+The analog of the reference's coordinating-side memory accounting
+(index/IndexingPressure.java): every bulk/index request reserves its
+payload bytes before any work happens and releases them when the
+operation completes (success OR failure). When the outstanding total
+would exceed the limit, the request is rejected up front with the
+reference's 429 `es_rejected_execution_exception` — protecting the host
+heap long before the HBM breaker (which guards device memory, not the
+Python buffers a runaway `_bulk` burst allocates) could engage.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+
+class IndexingPressureRejected(Exception):
+    def __init__(self, message: str):
+        super().__init__(message)
+
+
+class IndexingPressure:
+    # 10% of a nominal 1 GiB heap, the reference's default ratio
+    # (indexing_pressure.memory.limit: 10%).
+    DEFAULT_LIMIT = 100 * 1024 * 1024
+
+    def __init__(self, limit_bytes: int | None = None):
+        self.limit = (
+            int(limit_bytes) if limit_bytes is not None else self.DEFAULT_LIMIT
+        )
+        self._lock = threading.Lock()
+        self.current_bytes = 0
+        # Lifetime counters (the reference's *_rejections / total stats).
+        self.total_bytes = 0
+        self.rejections = 0
+
+    @contextmanager
+    def acquire(self, nbytes: int):
+        """Reserve nbytes for the duration of one write operation."""
+        nbytes = max(0, int(nbytes))
+        with self._lock:
+            if self.current_bytes + nbytes > self.limit:
+                self.rejections += 1
+                would = self.current_bytes + nbytes
+                raise IndexingPressureRejected(
+                    f"rejected execution of coordinating operation "
+                    f"[coordinating_and_primary_bytes={self.current_bytes}, "
+                    f"operation_bytes={nbytes}, max_coordinating_and_primary_"
+                    f"bytes={self.limit}] (would be [{would}])"
+                )
+            self.current_bytes += nbytes
+            self.total_bytes += nbytes
+        try:
+            yield
+        finally:
+            with self._lock:
+                self.current_bytes -= nbytes
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "memory": {
+                    "current": {
+                        "combined_coordinating_and_primary_in_bytes": (
+                            self.current_bytes
+                        )
+                    },
+                    "total": {
+                        "combined_coordinating_and_primary_in_bytes": (
+                            self.total_bytes
+                        ),
+                        "coordinating_rejections": self.rejections,
+                    },
+                    "limit_in_bytes": self.limit,
+                }
+            }

@@ -216,6 +216,14 @@ class Node:
         # id -> {"lang": "painless"|"mustache", "source": str}. Referenced
         # by {"script": {"id": ...}} in queries and by _search/template.
         self.stored_scripts: dict[str, dict[str, Any]] = {}
+        # Indexing backpressure: node-wide in-flight write-byte budget
+        # (index/IndexingPressure.java); ESTPU_INDEXING_PRESSURE_BYTES
+        # overrides the default limit.
+        from .common.indexing_pressure import IndexingPressure
+
+        self.indexing_pressure = IndexingPressure(
+            int(os.environ.get("ESTPU_INDEXING_PRESSURE_BYTES", 0)) or None
+        )
         # Extension system (plugins.py): analyzers / ingest processors /
         # query types contributed by ESTPU_PLUGINS or the plugins param.
         from .plugins import load_plugins
@@ -981,6 +989,28 @@ class Node:
         (action/bulk/TransportBulkAction.java): one bad item doesn't fail
         the request."""
         t0 = time.monotonic()
+        from .common.indexing_pressure import IndexingPressureRejected
+
+        try:
+            # UTF-8 byte size: the budget guards heap bytes, and len() of
+            # a str undercounts multi-byte text 3-4x.
+            with self.indexing_pressure.acquire(len(body.encode("utf-8"))):
+                return self._bulk_inner(
+                    body, default_index, refresh, pipeline, t0
+                )
+        except IndexingPressureRejected as e:
+            raise ApiError(
+                429, "es_rejected_execution_exception", str(e)
+            ) from None
+
+    def _bulk_inner(
+        self,
+        body: str,
+        default_index: str | None,
+        refresh,
+        pipeline: str | None,
+        t0: float,
+    ) -> dict:
         lines = [ln for ln in body.split("\n") if ln.strip()]
         items = []
         errors = False
@@ -2318,6 +2348,7 @@ class Node:
                         "platform": jax.devices()[0].platform,
                         "device_count": jax.device_count(),
                     },
+                    "indexing_pressure": self.indexing_pressure.stats(),
                 }
             },
         }

@@ -64,6 +64,9 @@ def _cas_params(q: dict) -> dict:
 
 
 class RestServer:
+    # http.max_content_length (the reference's 100mb default).
+    max_content_length = 100 * 1024 * 1024
+
     def __init__(self, node: Node | None = None, data_path: str | None = None):
         self.node = node or Node(data_path=data_path)
         self.routes: list[tuple[str, re.Pattern, Handler]] = []
@@ -374,6 +377,29 @@ class RestServer:
                     ).items()
                 }
                 length = int(self.headers.get("Content-Length") or 0)
+                if length > rest.max_content_length:
+                    # http.max_content_length: reject BEFORE buffering the
+                    # payload (the reference closes oversized requests with
+                    # 413 in the netty pipeline).
+                    data = json.dumps({
+                        "error": {
+                            "type": "content_too_long_exception",
+                            "reason": (
+                                f"entity content is too long [{length}] "
+                                f"for the configured buffer limit "
+                                f"[{rest.max_content_length}]"
+                            ),
+                        },
+                        "status": 413,
+                    }).encode("utf-8")
+                    self.send_response(413)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.send_header("X-elastic-product", "Elasticsearch")
+                    self.end_headers()
+                    self.wfile.write(data)
+                    self.close_connection = True
+                    return
                 body = self.rfile.read(length).decode("utf-8") if length else ""
                 status, payload = rest.dispatch(
                     self.command, parsed.path.rstrip("/") or "/", query, body

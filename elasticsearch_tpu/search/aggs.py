@@ -437,6 +437,11 @@ class Aggregator:
                     f"significant_terms aggregation on text field [{fname}] "
                     f"requires keyword doc values"
                 )
+            if self._field_kind(handle, fname) == "numeric":
+                raise AggParsingError(
+                    f"significant_terms on numeric field [{fname}] is not "
+                    f"supported yet (use a keyword field)"
+                )
             # absent from this segment: count the context size only
             return ("sig_matched",), {}
         if k == "terms":
@@ -838,12 +843,11 @@ def merge_segment_result(
         _capture_hits_planes(node, state, handle, result, root_planes)
         fname = node.params["field"]
         state["doc_count"] += int(np.asarray(result["doc_count"]))
-        live = getattr(handle, "live_host", None)
-        state["bg_total"] += (
-            int(np.count_nonzero(live))
-            if live is not None
-            else handle.segment.num_docs
-        )
+        # Superset size counts ALL docs (deleted included), matching the
+        # per-term bg df which is frozen at segment build — Lucene
+        # statistics ignore liveDocs until merge, and mixing scopes would
+        # let bg_pct exceed 1 and suppress real signals after deletes.
+        state["bg_total"] += handle.segment.num_docs
         fld = handle.segment.fields.get(fname)
         if fld is not None:
             for term, tid in fld.terms.items():

@@ -57,6 +57,7 @@ def shard_bounds(handles) -> dict[str, tuple[float, float]]:
 
 def _range_overlaps(q: RangeQuery, bounds, mappings) -> bool:
     from ..index.mapping import coerce_numeric
+    from ..query.compile import _f32_range_bounds
 
     fm = mappings.get(q.field_name) if mappings is not None else None
     entry = bounds.get(q.field_name)
@@ -68,21 +69,20 @@ def _range_overlaps(q: RangeQuery, bounds, mappings) -> bool:
     mn, mx = entry
     ftype = fm.type if fm is not None else "double"
     try:
-        lo = coerce_numeric(ftype, q.gte) if q.gte is not None else None
-        lo2 = coerce_numeric(ftype, q.gt) if q.gt is not None else None
-        hi = coerce_numeric(ftype, q.lte) if q.lte is not None else None
-        hi2 = coerce_numeric(ftype, q.lt) if q.lt is not None else None
+        lo, hi = _f32_range_bounds(
+            coerce_numeric(ftype, q.gte) if q.gte is not None else None,
+            coerce_numeric(ftype, q.gt) if q.gt is not None else None,
+            coerce_numeric(ftype, q.lte) if q.lte is not None else None,
+            coerce_numeric(ftype, q.lt) if q.lt is not None else None,
+        )
     except ValueError:
         return True  # unparsable bound: let the real search 400
-    if lo is not None and lo > mx:
-        return False
-    if lo2 is not None and lo2 >= mx:  # strictly-greater bound at/past max
-        return False
-    if hi is not None and hi < mn:
-        return False
-    if hi2 is not None and hi2 <= mn:  # strictly-less bound at/under min
-        return False
-    return True
+    # Matching happens against f32-QUANTIZED stored values (the compiler's
+    # stored-value semantics), so widen the f64 host bounds by one f32 ulp
+    # each way before deciding — pruning must never beat quantization.
+    mn32 = np.nextafter(np.float32(mn), np.float32(-np.inf))
+    mx32 = np.nextafter(np.float32(mx), np.float32(np.inf))
+    return not (lo > mx32 or hi < mn32)
 
 
 def can_match(query, bounds, mappings=None) -> bool:

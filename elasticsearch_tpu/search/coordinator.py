@@ -46,10 +46,11 @@ class ScrollContext:
     deletes replace `device.live` rather than mutating it, so cloning the
     DeviceSegment with the open-time live array gives point-in-time
     membership — concurrent deletes/updates/refreshes don't change what
-    the scroll serves. (Scores can still drift if shard-level avgdl moves
-    enough that the engine repacks impacts in place — membership and the
-    cursor order stay stable.) Continuation is cursor-based per shard, so
-    page N costs the same device work as page 1 (no from-offset re-scan).
+    the scroll serves. Statistics are pinned too: the frozen handles clone
+    each DeviceField, so the engine's in-place impact repacks (avgdl
+    drift) cannot move a pinned scroll's scores. Continuation is
+    cursor-based per shard, so page N costs the same device work as
+    page 1 (no from-offset re-scan).
     """
 
     index: str
@@ -67,12 +68,24 @@ class ScrollContext:
 
 def _freeze_handle(handle):
     """Clone a SegmentHandle pinning its current live mask (device + host)
-    so in-place deletes after the snapshot don't leak into it."""
+    AND its per-field impact planes: the engine repacks tn/tile_max IN
+    PLACE when shard-level avgdl drifts (tiles.repack_tn), so sharing the
+    DeviceField objects would let post-snapshot statistics movement change
+    a pinned scroll's scores. Cloning the field dataclasses pins the
+    pack-time planes; together with the pinned `stats`, pages re-execute
+    against exactly the open-time statistics."""
     from dataclasses import replace as dc_replace
 
     return dc_replace(
         handle,
-        device=dc_replace(handle.device, live=handle.device.live),
+        device=dc_replace(
+            handle.device,
+            live=handle.device.live,
+            fields={
+                name: dc_replace(f)
+                for name, f in handle.device.fields.items()
+            },
+        ),
         live_host=handle.live_host.copy(),
     )
 

@@ -1,0 +1,77 @@
+"""Indexing backpressure + HTTP content limits + scroll stat pinning.
+
+Reference: index/IndexingPressure.java (coordinating byte budget, 429),
+http.max_content_length (413), search/SearchService reader contexts
+(point-in-time statistics).
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.indexing_pressure import (
+    IndexingPressure,
+    IndexingPressureRejected,
+)
+from elasticsearch_tpu.node import ApiError, Node
+
+
+def test_indexing_pressure_acquire_release():
+    p = IndexingPressure(limit_bytes=100)
+    with p.acquire(60):
+        assert p.current_bytes == 60
+        with pytest.raises(IndexingPressureRejected):
+            with p.acquire(50):
+                pass
+        with p.acquire(40):
+            assert p.current_bytes == 100
+    assert p.current_bytes == 0
+    assert p.rejections == 1
+    assert p.total_bytes == 100
+
+
+def test_bulk_rejects_over_budget(tmp_path, monkeypatch):
+    monkeypatch.setenv("ESTPU_INDEXING_PRESSURE_BYTES", "200")
+    n = Node(data_path=str(tmp_path))
+    small = '{"index": {"_index": "i", "_id": "1"}}\n{"a": "b"}\n'
+    n.bulk(small)  # fits
+    big = small * 50  # > 200 bytes
+    with pytest.raises(ApiError) as e:
+        n.bulk(big)
+    assert e.value.status == 429
+    assert "rejected execution" in e.value.reason
+    # Budget released after the rejection and after success: small works.
+    n.bulk(small)
+    stats = n.nodes_info()["nodes"][n.node_name]["indexing_pressure"]
+    assert stats["memory"]["total"]["coordinating_rejections"] == 1
+    assert (
+        stats["memory"]["current"][
+            "combined_coordinating_and_primary_in_bytes"
+        ]
+        == 0
+    )
+
+
+def test_scroll_pins_statistics(tmp_path):
+    """A pinned scroll's scores must not move when later writes shift
+    shard-level avgdl enough to repack impacts in place."""
+    n = Node(data_path=str(tmp_path))
+    n.create_index("s", {"mappings": {"properties": {"t": {"type": "text"}}}})
+    for i in range(20):
+        n.index_doc("s", {"t": f"alpha beta word{i}"}, str(i))
+    n.refresh("s")
+    first = n.search(
+        "s", {"query": {"match": {"t": "alpha"}}, "size": 5}, scroll="1m"
+    )
+    page1_scores = [h["_score"] for h in first["hits"]["hits"]]
+    sid = first["_scroll_id"]
+    # Massive avgdl shift: long documents, then refresh (repacks impacts).
+    long_text = " ".join(f"filler{j}" for j in range(300))
+    for i in range(30):
+        n.index_doc("s", {"t": "alpha " + long_text}, f"big{i}")
+    n.refresh("s")
+    page2 = n.scroll({"scroll_id": sid, "scroll": "1m"})
+    page2_scores = [h["_score"] for h in page2["hits"]["hits"]]
+    # Same statistics scope as page 1: identical docs -> identical scores
+    # (all 20 original docs share one shape, so every page's scores match
+    # page 1's).
+    assert page2_scores == page1_scores
