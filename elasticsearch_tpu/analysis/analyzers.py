@@ -222,6 +222,51 @@ _BUILTIN["english"] = Analyzer(
 )
 
 
+def make_shingle_filter(n: int) -> TokenFilter:
+    """Word shingles of size n, space-joined — the reference's
+    ShingleTokenFilter as used by search_as_you_type's _2gram/_3gram
+    subfields (SearchAsYouTypeFieldMapper). Changes token count, so it
+    only runs on norms-free fields (full-chain analyze())."""
+
+    def shingles(tokens: list[Token]) -> list[Token]:
+        return [
+            " ".join(tokens[i : i + n])
+            for i in range(len(tokens) - n + 1)
+        ]
+
+    return shingles
+
+
+def make_edge_ngram_filter(min_gram: int = 1, max_gram: int = 20) -> TokenFilter:
+    """Per-token edge n-grams — search_as_you_type's _index_prefix
+    subfield (the reference's index_prefixes machinery), letting the
+    final partial token of a type-ahead query match as a plain term."""
+
+    def edges(tokens: list[Token]) -> list[Token]:
+        out = []
+        for t in tokens:
+            for ln in range(min_gram, min(len(t), max_gram) + 1):
+                out.append(t[:ln])
+        return out
+
+    return edges
+
+
+# search_as_you_type subfield chains (index side; queries against the
+# base field analyze with plain standard).
+_BUILTIN["_sayt_2gram"] = Analyzer(
+    "_sayt_2gram", _standard_tokenize, [lowercase_filter, make_shingle_filter(2)]
+)
+_BUILTIN["_sayt_3gram"] = Analyzer(
+    "_sayt_3gram", _standard_tokenize, [lowercase_filter, make_shingle_filter(3)]
+)
+_BUILTIN["_sayt_prefix"] = Analyzer(
+    "_sayt_prefix",
+    _standard_tokenize,
+    [lowercase_filter, make_edge_ngram_filter(1, 20)],
+)
+
+
 def get_analyzer(name: str) -> Analyzer:
     try:
         return _BUILTIN[name]

@@ -972,4 +972,5 @@ class Engine:
             stats=stats if stats is not None else self.field_stats(),
             id_index=lambda: handle.id_index,  # built only if an ids query compiles
             nested=handle.device.nested,
+            percolator=handle.segment.percolator,
         )

@@ -33,11 +33,20 @@ DENSE_VECTOR = "dense_vector"
 OBJECT = "object"
 NESTED = "nested"
 COMPLETION = "completion"
+RANK_FEATURE = "rank_feature"
+RANK_FEATURES = "rank_features"
+TOKEN_COUNT = "token_count"
+SEARCH_AS_YOU_TYPE = "search_as_you_type"
+PERCOLATOR = "percolator"
 
 NUMERIC_TYPES = {LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT, DATE, BOOLEAN}
 INVERTED_TYPES = {TEXT, KEYWORD}
+# rank_feature and token_count materialize as numeric doc-values columns.
+DOC_VALUE_TYPES = NUMERIC_TYPES | {RANK_FEATURE, TOKEN_COUNT}
 ALL_TYPES = NUMERIC_TYPES | INVERTED_TYPES | {
     DENSE_VECTOR, OBJECT, NESTED, COMPLETION,
+    RANK_FEATURE, RANK_FEATURES, TOKEN_COUNT, SEARCH_AS_YOU_TYPE,
+    PERCOLATOR,
 }
 
 
@@ -134,11 +143,13 @@ class FieldMapping:
 
     @property
     def is_inverted(self) -> bool:
-        return self.type in INVERTED_TYPES and self.index
+        return (
+            self.type in INVERTED_TYPES or self.type == SEARCH_AS_YOU_TYPE
+        ) and self.index
 
     @property
     def is_numeric(self) -> bool:
-        return self.type in NUMERIC_TYPES
+        return self.type in DOC_VALUE_TYPES
 
 
 class Mappings:
@@ -198,6 +209,27 @@ class Mappings:
     def _parse_field(cls, name: str, spec: dict[str, Any]) -> FieldMapping:
         norms = spec.get("norms")
         subs = {}
+        if spec.get("type") == SEARCH_AS_YOU_TYPE:
+            # Auto-materialize the reference's SAYT subfields
+            # (SearchAsYouTypeFieldMapper): word shingles for proximity
+            # boosting and edge n-grams so the trailing partial token
+            # matches as a plain term. The prefix subfield searches with
+            # plain standard analysis (queries must not re-gram).
+            subs = {
+                "_2gram": FieldMapping(
+                    name=f"{name}._2gram", type=TEXT,
+                    analyzer="_sayt_2gram", norms=False,
+                ),
+                "_3gram": FieldMapping(
+                    name=f"{name}._3gram", type=TEXT,
+                    analyzer="_sayt_3gram", norms=False,
+                ),
+                "_index_prefix": FieldMapping(
+                    name=f"{name}._index_prefix", type=TEXT,
+                    analyzer="_sayt_prefix", search_analyzer="standard",
+                    norms=False,
+                ),
+            }
         for sub_name, sub_spec in (spec.get("fields") or {}).items():
             if sub_spec.get("fields"):
                 raise ValueError(

@@ -29,7 +29,17 @@ import numpy as np
 from ..native import NativeAccumulator, tokenize_ascii
 from ..native import available as native_available
 from ..utils import smallfloat
-from .mapping import COMPLETION, DENSE_VECTOR, NESTED, Mappings, coerce_numeric
+from .mapping import (
+    COMPLETION,
+    DENSE_VECTOR,
+    NESTED,
+    PERCOLATOR,
+    RANK_FEATURES,
+    TOKEN_COUNT,
+    FieldMapping,
+    Mappings,
+    coerce_numeric,
+)
 
 
 @dataclass
@@ -134,6 +144,12 @@ class Segment:
     # CompletionSuggester.java:30 over NRTSuggester) — prefix lookup is a
     # bisect over the sorted array.
     completion: dict[str, list[tuple]] = field(default_factory=dict)
+    # Percolator fields: per field, (local doc, stored query json). The
+    # reference indexes extracted query terms for candidate pruning
+    # (PercolatorFieldMapper); here percolation evaluates stored queries
+    # against a one-doc in-memory segment at plan time (the MemoryIndex
+    # analog), so only the raw queries are kept.
+    percolator: dict[str, list[tuple]] = field(default_factory=dict)
 
     def doc_version(self, local: int) -> int:
         return int(self.versions[local]) if self.versions is not None else 1
@@ -193,6 +209,8 @@ class SegmentBuilder:
         self._nested: dict[str, tuple["SegmentBuilder", list[int]]] = {}
         # Completion fields: field -> [(normalized, surface, weight, doc)].
         self._completion: dict[str, list[tuple]] = {}
+        # Percolator fields: field -> [(doc, query_json)].
+        self._percolator: dict[str, list[tuple]] = {}
 
     def _nested_candidate(self, path: str) -> tuple["SegmentBuilder", list[int]]:
         """The accumulator a nested object WOULD commit into — existing or
@@ -234,6 +252,7 @@ class SegmentBuilder:
         staged_postings: list,
         staged_numeric: list,
         staged_completion: list,
+        staged_percolator: list,
     ) -> None:
         """Stage one (field, value) pair — raises on mapper errors, touches
         no builder state (add()'s atomicity contract).
@@ -242,7 +261,22 @@ class SegmentBuilder:
         False then); numeric doc_values and vectors are stored regardless,
         matching the reference where index:false keeps doc_values available
         for sort/agg/script access."""
-        if fm.type == COMPLETION:
+        if fm.type == TOKEN_COUNT:
+            # Analyzed token count as a numeric doc value
+            # (TokenCountFieldMapper, mapper-extras).
+            analyzer = self.mappings.analysis.get(fm.analyzer)
+            count = sum(
+                len(analyzer.analyze(str(v)))
+                for v in _iter_field_values(value)
+            )
+            staged_numeric.append((field_name, float(count)))
+        elif fm.type == PERCOLATOR:
+            for v in _iter_field_values(value):
+                from ..query.dsl import parse_query
+
+                parse_query(v)  # validate at index time (mapper parsing)
+                staged_percolator.append((field_name, v))
+        elif fm.type == COMPLETION:
             entries = []
             for v in _iter_field_values(value):
                 if isinstance(v, dict):
@@ -354,6 +388,36 @@ class SegmentBuilder:
         if fm is not None and fm.type == COMPLETION:
             flat.setdefault(prefix, (fm, []))[1].append(value)
             return
+        if fm is not None and fm.type == PERCOLATOR:
+            if not isinstance(value, dict):
+                raise ValueError(
+                    f"percolator field [{prefix}] must hold a query object"
+                )
+            flat.setdefault(prefix, (fm, []))[1].append(value)
+            return
+        if fm is not None and fm.type == RANK_FEATURES:
+            # rank_features flatten to one rank_feature column per key
+            # (RankFeaturesFieldMapper: sparse features queried per name).
+            if not isinstance(value, dict):
+                raise ValueError(
+                    f"rank_features field [{prefix}] must hold an object "
+                    f"mapping feature names to positive numbers"
+                )
+            for k, v in value.items():
+                leaf = f"{prefix}.{k}"
+                leaf_fm = self.mappings.get(leaf)
+                if leaf_fm is None:
+                    leaf_fm = FieldMapping(name=leaf, type="rank_feature")
+                    self.mappings.fields[leaf] = leaf_fm
+                try:
+                    fv = float(v)
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        f"rank_features field [{prefix}] feature [{k}] "
+                        f"must be a number, got [{v!r}]"
+                    ) from None
+                self._collect_values(leaf, fv, flat, nested_ops)
+            return
         if isinstance(value, dict):
             if fm is not None and fm.type not in ("object", "nested"):
                 raise ValueError(
@@ -403,6 +467,7 @@ class SegmentBuilder:
         staged_postings: list[tuple[str, dict[str, int], int]] = []
         staged_numeric: list[tuple[str, float]] = []
         staged_completion: list[tuple[str, list[tuple]]] = []
+        staged_percolator: list[tuple[str, dict]] = []
         flat: dict[str, tuple[Any, list[Any]]] = {}
         nested_ops: list[tuple[str, dict[str, Any]]] = []
         for source_name, value in source.items():
@@ -427,6 +492,7 @@ class SegmentBuilder:
                     staged_postings,
                     staged_numeric,
                     staged_completion,
+                    staged_percolator,
                 )
         staged_nested = []
         candidates: dict[str, tuple] = {}
@@ -445,6 +511,7 @@ class SegmentBuilder:
             staged_postings,
             staged_numeric,
             staged_completion,
+            staged_percolator,
             staged_nested,
         )
 
@@ -475,6 +542,7 @@ class SegmentBuilder:
             staged_postings,
             staged_numeric,
             staged_completion,
+            staged_percolator,
             staged_nested,
         ) = staged
         # ---- commit phase: nothing below raises -------------------------
@@ -526,6 +594,10 @@ class SegmentBuilder:
             bucket = self._completion.setdefault(field_name, [])
             for norm, surface, weight in entries:
                 bucket.append((norm, surface, weight, local))
+        for field_name, query_json in staged_percolator:
+            self._percolator.setdefault(field_name, []).append(
+                (local, query_json)
+            )
         for path, acc, prefixed, sub_staged in staged_nested:
             self._nested.setdefault(path, acc)
             sub_builder, parents = acc
@@ -621,6 +693,10 @@ class SegmentBuilder:
             fname: sorted(entries)
             for fname, entries in self._completion.items()
         }
+        percolator = {
+            fname: list(entries)
+            for fname, entries in self._percolator.items()
+        }
         nested = {
             path: NestedBlock(
                 seg=sub_builder.build(),
@@ -639,6 +715,7 @@ class SegmentBuilder:
             seqnos=np.asarray(self._seqnos, dtype=np.int64),
             nested=nested,
             completion=completion,
+            percolator=percolator,
         )
 
     def _norms_present(self, fname: str, n: int):

@@ -76,6 +76,11 @@ def _segment_arrays(
             f: [list(e) for e in entries]
             for f, entries in segment.completion.items()
         }
+    if segment.percolator:
+        meta["percolator"] = {
+            f: [[int(doc), q] for doc, q in entries]
+            for f, entries in segment.percolator.items()
+        }
     if segment.nested:
         meta["nested"] = {}
         for ni, (npath, block) in enumerate(sorted(segment.nested.items())):
@@ -134,6 +139,10 @@ def _segment_from(
         f: [tuple(e) for e in entries]
         for f, entries in (meta.get("completion") or {}).items()
     }
+    percolator = {
+        f: [(int(doc), q) for doc, q in entries]
+        for f, entries in (meta.get("percolator") or {}).items()
+    }
     nested = {}
     for npath, entry in (meta.get("nested") or {}).items():
         npre = entry["key"]
@@ -161,6 +170,7 @@ def _segment_from(
         ),
         nested=nested,
         completion=completion,
+        percolator=percolator,
     )
 
 

@@ -982,6 +982,7 @@ class Node:
         default_index: str | None = None,
         refresh=False,
         pipeline: str | None = None,
+        nbytes: int | None = None,
     ) -> dict:
         """NDJSON bulk: index/create/delete/update action lines.
 
@@ -991,10 +992,13 @@ class Node:
         t0 = time.monotonic()
         from .common.indexing_pressure import IndexingPressureRejected
 
-        try:
+        if nbytes is None:
             # UTF-8 byte size: the budget guards heap bytes, and len() of
-            # a str undercounts multi-byte text 3-4x.
-            with self.indexing_pressure.acquire(len(body.encode("utf-8"))):
+            # a str undercounts multi-byte text 3-4x. The REST layer
+            # passes the wire Content-Length to avoid this re-encode.
+            nbytes = len(body.encode("utf-8"))
+        try:
+            with self.indexing_pressure.acquire(nbytes):
                 return self._bulk_inner(
                     body, default_index, refresh, pipeline, t0
                 )

@@ -109,6 +109,20 @@ def _eval_node(spec, arrays, seg: dict[str, Any], num_docs: int):
         return scores, matched
     if kind == "range":
         return _eval_range(spec, arrays, seg, num_docs)
+    if kind == "rank_feature":
+        _, field_name, fn = spec
+        col = seg["doc_values"][field_name]
+        matched = ~jnp.isnan(col)
+        v = jnp.where(matched, col, jnp.float32(0.0))
+        if fn == "saturation":
+            s = v / (v + arrays["pivot"])
+        elif fn == "log":
+            s = jnp.log(arrays["scaling"] + v)
+        else:  # sigmoid
+            ve = v ** arrays["exponent"]
+            s = ve / (ve + arrays["pivot"] ** arrays["exponent"])
+        scores = jnp.where(matched, arrays["boost"] * s, jnp.float32(0.0))
+        return scores, matched
     if kind == "match_all":
         matched = jnp.ones(num_docs, dtype=bool)
         scores = jnp.full(num_docs, arrays["boost"], dtype=jnp.float32)

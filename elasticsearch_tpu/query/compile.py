@@ -456,6 +456,7 @@ class Compiler:
         nt_floor: int = 1,
         id_index: Any = None,  # dict[str, int] | zero-arg callable | None
         nested: dict[str, Any] | None = None,  # path -> (DeviceSegment, map)
+        percolator: dict[str, list] | None = None,  # field -> [(doc, query)]
     ):
         self.fields = fields
         self.doc_values = doc_values
@@ -466,6 +467,8 @@ class Compiler:
         # (inner DeviceSegment, parent_of). Child queries of a nested
         # clause compile against the inner segment's fields/statistics.
         self.nested = nested or {}
+        # Stored percolator queries of the segment being compiled against.
+        self.percolator = percolator or {}
         # _id -> local doc for ids queries: a dict, or a zero-arg callable
         # returning one (so the engine can defer building it until an ids
         # query actually compiles)
@@ -507,10 +510,28 @@ class Compiler:
             }
         if isinstance(q, BoolQuery):
             return self._bool(q, scoring)
-        from .dsl import NestedQuery
+        from .dsl import (
+            MatchBoolPrefixQuery,
+            NestedQuery,
+            PercolateQuery,
+            RankFeatureQuery,
+        )
 
         if isinstance(q, NestedQuery):
             return self._nested_q(q, scoring)
+        if isinstance(q, MatchBoolPrefixQuery):
+            from .dsl import bool_prefix_rewrite
+
+            analyzer = (
+                self.mappings.analysis.get(q.analyzer)
+                if q.analyzer
+                else self.mappings.analyzer_for(q.field_name, search=True)
+            )
+            return self._node(bool_prefix_rewrite(q, analyzer), scoring)
+        if isinstance(q, RankFeatureQuery):
+            return self._rank_feature(q)
+        if isinstance(q, PercolateQuery):
+            return self._percolate(q)
         if isinstance(q, ScriptScoreQuery):
             return self._script_score(q, scoring)
         from .dsl import FunctionScoreQuery
@@ -995,6 +1016,78 @@ class Compiler:
         )
         spec = ("span_not", inc_field, nt, int(q.pre), int(q.post))
         return spec, arrays
+
+    def _rank_feature(self, q):
+        """rank_feature over the feature's doc-values column; the scoring
+        function fuses into the device program (RankFeatureQueryBuilder).
+        The reference derives a default saturation pivot from index
+        statistics; here it must be explicit (clear 400 otherwise)."""
+        if q.field_name not in self.doc_values:
+            return ("match_none",), {}
+        fm = self.mappings.get(q.field_name)
+        if fm is not None and fm.type not in ("rank_feature", "token_count"):
+            if not fm.is_numeric:
+                raise ValueError(
+                    f"[rank_feature] field [{q.field_name}] must be a "
+                    f"rank_feature or numeric field"
+                )
+        if q.function == "saturation" and q.pivot is None:
+            raise ValueError(
+                "[rank_feature] [saturation] requires an explicit [pivot] "
+                "(automatic pivots from index statistics are not supported "
+                "yet)"
+            )
+        arrays = {
+            "pivot": np.float32(q.pivot if q.pivot is not None else 1.0),
+            "scaling": np.float32(q.scaling_factor),
+            "exponent": np.float32(q.exponent),
+            "boost": np.float32(q.boost),
+        }
+        return ("rank_feature", q.field_name, q.function), arrays
+
+    def _percolate(self, q):
+        """percolate: evaluate every stored query against an in-memory
+        segment built from the provided document(s) AT PLAN TIME — the
+        analog of the reference's MemoryIndex percolation
+        (PercolateQueryBuilder) — then select the matching stored-query
+        docs with a doc_set plan. Matching queries score `boost` (the
+        reference scores percolation matches; constant scoring is a noted
+        simplification)."""
+        from ..index.mapping import Mappings as _Mappings
+        from ..index.segment import SegmentBuilder
+        from ..search.oracle import OracleSearcher
+        from .dsl import parse_query as _parse
+
+        fm = self.mappings.get(q.field_name)
+        if fm is None or fm.type != "percolator":
+            raise ValueError(
+                f"field [{q.field_name}] is not a percolator field"
+            )
+        entries = self.percolator.get(q.field_name, [])
+        matched_locals: list[int] = []
+        if entries:
+            mini_mappings = _Mappings.from_json(
+                self.mappings.to_json(), analysis=self.mappings.analysis
+            )
+            builder = SegmentBuilder(mini_mappings)
+            for doc in q.documents:
+                builder.add(dict(doc))
+            mini = builder.build()
+            oracle = OracleSearcher(mini, mini_mappings)
+            for local_doc, query_json in entries:
+                try:
+                    _s, m = oracle._eval(_parse(query_json))
+                except ValueError:
+                    continue  # stored query this segment can't evaluate
+                if m.any():
+                    matched_locals.append(local_doc)
+        nd = _pow2(len(matched_locals), self.nt_floor)
+        docs = np.full(nd, -1, dtype=np.int32)
+        docs[: len(matched_locals)] = sorted(matched_locals)
+        return ("doc_set", nd), {
+            "docs": docs,
+            "boost": np.float32(q.boost),
+        }
 
     def _regexp_terms(self, q) -> list[str]:
         dfield = self._field_or_none(q.field_name)

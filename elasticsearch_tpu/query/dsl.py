@@ -448,6 +448,112 @@ def _parse_span(body: dict[str, Any]) -> Query:
 
 
 @dataclass
+class RankFeatureQuery(Query):
+    """Score docs by a rank_feature column through saturation / log /
+    sigmoid (RankFeatureQueryBuilder, mapper-extras)."""
+
+    field_name: str = ""
+    function: str = "saturation"  # saturation | log | sigmoid
+    pivot: float | None = None
+    scaling_factor: float = 1.0
+    exponent: float = 1.0
+    boost: float = 1.0
+
+
+@dataclass
+class MatchBoolPrefixQuery(Query):
+    """Analyzed terms as a bool, the LAST term matching as a prefix
+    (MatchBoolPrefixQueryBuilder) — the type-ahead query shape."""
+
+    field_name: str = ""
+    query: str = ""
+    operator: str = "or"
+    analyzer: str | None = None
+    boost: float = 1.0
+
+
+@dataclass
+class PercolateQuery(Query):
+    """Match stored percolator queries against provided document(s)
+    (percolator module, PercolateQueryBuilder)."""
+
+    field_name: str = ""
+    documents: list[dict] = field(default_factory=list)
+    boost: float = 1.0
+
+
+def bool_prefix_rewrite(q: "MatchBoolPrefixQuery", analyzer) -> Query:
+    """match_bool_prefix -> bool of term queries + trailing prefix, the
+    single rewrite shared by the compiler and the oracle."""
+    terms = analyzer.analyze(str(q.query))
+    if not terms:
+        return MatchNoneQuery()
+    children: list[Query] = [
+        TermQuery(q.field_name, t) for t in terms[:-1]
+    ]
+    children.append(PrefixQuery(q.field_name, terms[-1]))
+    if q.operator == "and":
+        return BoolQuery(must=children, boost=q.boost)
+    return BoolQuery(should=children, minimum_should_match=1, boost=q.boost)
+
+
+def multi_match_to_query(spec: dict[str, Any]) -> Query:
+    """multi_match -> dis_max/bool composition over per-field matches
+    (MultiMatchQueryBuilder; best_fields is a DisjunctionMaxQuery, with
+    `field^boost` caret syntax)."""
+    text = spec.get("query")
+    raw_fields = spec.get("fields")
+    if text is None or not raw_fields:
+        raise ValueError("[multi_match] requires [query] and [fields]")
+    mtype = str(spec.get("type", "best_fields"))
+    operator = str(spec.get("operator", "or")).lower()
+    boost = _pop_boost(spec)
+    fields: list[tuple[str, float]] = []
+    for f in raw_fields:
+        name, _, fboost = str(f).partition("^")
+        fields.append((name, float(fboost) if fboost else 1.0))
+    per_field: list[Query] = []
+    for name, fboost in fields:
+        if mtype in ("best_fields", "most_fields"):
+            per_field.append(
+                MatchQuery(
+                    field_name=name, query=str(text), operator=operator,
+                    boost=fboost,
+                )
+            )
+        elif mtype == "phrase":
+            per_field.append(
+                MatchPhraseQuery(field_name=name, query=str(text), boost=fboost)
+            )
+        elif mtype == "phrase_prefix":
+            per_field.append(
+                MatchPhrasePrefixQuery(
+                    field_name=name, query=str(text), boost=fboost
+                )
+            )
+        elif mtype == "bool_prefix":
+            per_field.append(
+                MatchBoolPrefixQuery(
+                    field_name=name, query=str(text), operator=operator,
+                    boost=fboost,
+                )
+            )
+        else:
+            raise ValueError(f"[multi_match] unknown type [{mtype}]")
+    if len(per_field) == 1:
+        q = per_field[0]
+        q.boost = q.boost * boost
+        return q
+    if mtype in ("most_fields", "bool_prefix"):
+        return BoolQuery(should=per_field, boost=boost)
+    return DisMaxQuery(
+        queries=per_field,
+        tie_breaker=float(spec.get("tie_breaker", 0.0)),
+        boost=boost,
+    )
+
+
+@dataclass
 class NestedQuery(Query):
     """Query over one nested path's hidden sub-documents, joined to parents
     with a per-parent score reduction (NestedQueryBuilder.java:54 lowering
@@ -528,6 +634,63 @@ def parse_query(body: dict[str, Any]) -> Query:
     if kind == "constant_score":
         return ConstantScoreQuery(
             filter=parse_query(spec["filter"]), boost=_pop_boost(spec)
+        )
+    if kind == "multi_match":
+        return multi_match_to_query(spec)
+    if kind == "match_bool_prefix":
+        fname, val = _single_field(kind, spec)
+        if isinstance(val, dict):
+            return MatchBoolPrefixQuery(
+                field_name=fname,
+                query=str(val["query"]),
+                operator=str(val.get("operator", "or")).lower(),
+                analyzer=val.get("analyzer"),
+                boost=_pop_boost(val),
+            )
+        return MatchBoolPrefixQuery(field_name=fname, query=str(val))
+    if kind == "rank_feature":
+        if "field" not in spec:
+            raise ValueError("[rank_feature] requires [field]")
+        fns = [f for f in ("saturation", "log", "sigmoid") if f in spec]
+        if len(fns) > 1:
+            raise ValueError(
+                "[rank_feature] accepts at most one scoring function"
+            )
+        fn = fns[0] if fns else "saturation"
+        params = spec.get(fn) or {}
+        if fn == "log" and "scaling_factor" not in params:
+            raise ValueError("[rank_feature] [log] requires [scaling_factor]")
+        if fn == "sigmoid" and (
+            "pivot" not in params or "exponent" not in params
+        ):
+            raise ValueError(
+                "[rank_feature] [sigmoid] requires [pivot] and [exponent]"
+            )
+        return RankFeatureQuery(
+            field_name=str(spec["field"]),
+            function=fn,
+            pivot=(
+                float(params["pivot"]) if "pivot" in params else None
+            ),
+            scaling_factor=float(params.get("scaling_factor", 1.0)),
+            exponent=float(params.get("exponent", 1.0)),
+            boost=_pop_boost(spec),
+        )
+    if kind == "percolate":
+        if "field" not in spec:
+            raise ValueError("[percolate] requires [field]")
+        docs = spec.get("documents")
+        if docs is None:
+            doc = spec.get("document")
+            docs = [doc] if doc is not None else []
+        if not docs or not all(isinstance(d, dict) for d in docs):
+            raise ValueError(
+                "[percolate] requires [document] or [documents]"
+            )
+        return PercolateQuery(
+            field_name=str(spec["field"]),
+            documents=list(docs),
+            boost=_pop_boost(spec),
         )
     if kind == "span_term":
         fname, val = _single_field(kind, spec)

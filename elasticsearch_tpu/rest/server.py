@@ -69,6 +69,9 @@ class RestServer:
 
     def __init__(self, node: Node | None = None, data_path: str | None = None):
         self.node = node or Node(data_path=data_path)
+        # Wire byte length of the current request's body, per handler
+        # thread (the Content-Length the socket actually carried).
+        self._tl = threading.local()
         self.routes: list[tuple[str, re.Pattern, Handler]] = []
         self._register_routes()
 
@@ -173,11 +176,13 @@ class RestServer:
         r("POST", "/_bulk", lambda s, p, q, b: n.bulk(
             b, refresh=q.get("refresh") in ("true", ""),
             pipeline=q.get("pipeline"),
+            nbytes=getattr(s._tl, "body_nbytes", None),
         ))
         r("POST", "/{index}/_bulk", lambda s, p, q, b: n.bulk(
             b, default_index=p["index"],
             refresh=q.get("refresh") in ("true", ""),
             pipeline=q.get("pipeline"),
+            nbytes=getattr(s._tl, "body_nbytes", None),
         ))
         r("PUT", "/_ingest/pipeline/{id}", lambda s, p, q, b: n.put_pipeline(
             p["id"], _json(b)
@@ -400,6 +405,7 @@ class RestServer:
                     self.wfile.write(data)
                     self.close_connection = True
                     return
+                rest._tl.body_nbytes = length
                 body = self.rfile.read(length).decode("utf-8") if length else ""
                 status, payload = rest.dispatch(
                     self.command, parsed.path.rstrip("/") or "/", query, body

@@ -111,6 +111,25 @@ class OracleSearcher:
 
         if isinstance(q, NestedQuery):
             return self._nested(q)
+        from ..query.dsl import (
+            MatchBoolPrefixQuery,
+            PercolateQuery,
+            RankFeatureQuery,
+        )
+
+        if isinstance(q, MatchBoolPrefixQuery):
+            from ..query.dsl import bool_prefix_rewrite
+
+            analyzer = (
+                self.mappings.analysis.get(q.analyzer)
+                if q.analyzer
+                else self.mappings.analyzer_for(q.field_name, search=True)
+            )
+            return self._eval(bool_prefix_rewrite(q, analyzer))
+        if isinstance(q, RankFeatureQuery):
+            return self._rank_feature(q)
+        if isinstance(q, PercolateQuery):
+            return self._percolate(q)
         if isinstance(q, RegexpQuery):
             from ..query.compile import regexp_pattern
 
@@ -686,6 +705,63 @@ class OracleSearcher:
             matched = ~np.isnan(col)
             return np.where(matched, np.float32(q.boost), np.float32(0.0)), matched
         return np.zeros(n, np.float32), np.zeros(n, bool)
+
+    def _rank_feature(self, q):
+        """rank_feature parity twin of ops/bm25_device (f32 math)."""
+        n = self.segment.num_docs
+        col = self.segment.doc_values.get(q.field_name)
+        if col is None:
+            return np.zeros(n, np.float32), np.zeros(n, bool)
+        if q.function == "saturation" and q.pivot is None:
+            raise ValueError(
+                "[rank_feature] [saturation] requires an explicit [pivot] "
+                "(automatic pivots from index statistics are not supported "
+                "yet)"
+            )
+        col32 = col.astype(np.float32)
+        matched = ~np.isnan(col32)
+        v = np.where(matched, col32, np.float32(0.0))
+        if q.function == "saturation":
+            s = v / (v + np.float32(q.pivot))
+        elif q.function == "log":
+            s = np.log(np.float32(q.scaling_factor) + v)
+        else:
+            ve = v ** np.float32(q.exponent)
+            s = ve / (ve + np.float32(q.pivot) ** np.float32(q.exponent))
+        scores = np.where(
+            matched, np.float32(q.boost) * s, np.float32(0.0)
+        ).astype(np.float32)
+        return scores, matched
+
+    def _percolate(self, q):
+        """Percolation twin: evaluate stored queries against an in-memory
+        segment built from the provided document(s)."""
+        from ..index.mapping import Mappings as _Mappings
+        from ..index.segment import SegmentBuilder
+        from ..query.dsl import parse_query as _parse
+
+        n = self.segment.num_docs
+        scores = np.zeros(n, np.float32)
+        matched = np.zeros(n, bool)
+        entries = self.segment.percolator.get(q.field_name, [])
+        if not entries:
+            return scores, matched
+        mini_mappings = _Mappings.from_json(
+            self.mappings.to_json(), analysis=self.mappings.analysis
+        )
+        builder = SegmentBuilder(mini_mappings)
+        for doc in q.documents:
+            builder.add(dict(doc))
+        oracle = OracleSearcher(builder.build(), mini_mappings)
+        for local_doc, query_json in entries:
+            try:
+                _s, m = oracle._eval(_parse(query_json))
+            except ValueError:
+                continue
+            if m.any():
+                matched[local_doc] = True
+                scores[local_doc] = np.float32(q.boost)
+        return scores, matched
 
     def _terms_set(self, q):
         """terms_set parity twin of ops/bm25_device._eval_terms_set."""

@@ -1,0 +1,328 @@
+"""mapper-extras field types, multi_match, and the percolator.
+
+Reference: RankFeatureFieldMapper/RankFeatureQueryBuilder,
+RankFeaturesFieldMapper, TokenCountFieldMapper,
+SearchAsYouTypeFieldMapper, MultiMatchQueryBuilder,
+percolator module (PercolatorFieldMapper, PercolateQueryBuilder).
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.index.tiles import pack_segment
+from elasticsearch_tpu.node import ApiError, Node
+from elasticsearch_tpu.ops import bm25_device
+from elasticsearch_tpu.query.compile import Compiler
+from elasticsearch_tpu.query.dsl import parse_query
+from elasticsearch_tpu.search.oracle import OracleSearcher
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path))
+    n.create_index(
+        "docs",
+        {
+            "mappings": {
+                "properties": {
+                    "title": {
+                        "type": "text",
+                        "fields": {"length": {"type": "token_count"}},
+                    },
+                    "pagerank": {"type": "rank_feature"},
+                    "features": {"type": "rank_features"},
+                    "sayt": {"type": "search_as_you_type"},
+                }
+            }
+        },
+    )
+    docs = [
+        {"title": "quick brown fox", "pagerank": 8.0,
+         "features": {"politics": 3.0}, "sayt": "quick brown fox"},
+        {"title": "lazy dog", "pagerank": 2.0,
+         "features": {"politics": 1.0, "sports": 9.0}, "sayt": "lazy dog"},
+        {"title": "quick start guide for foxes and dogs", "pagerank": 5.0,
+         "sayt": "quick start guide"},
+    ]
+    for i, d in enumerate(docs):
+        n.index_doc("docs", d, str(i))
+    n.refresh("docs")
+    return n
+
+
+def test_token_count_field(node):
+    out = node.search(
+        "docs", {"query": {"range": {"title.length": {"gte": 3}}}, "size": 10}
+    )
+    assert sorted(h["_id"] for h in out["hits"]["hits"]) == ["0", "2"]
+    out = node.search(
+        "docs",
+        {"size": 0, "aggs": {"len": {"stats": {"field": "title.length"}}}},
+    )
+    assert out["aggregations"]["len"]["max"] == 7.0
+
+
+def test_rank_feature_query(node):
+    out = node.search(
+        "docs",
+        {
+            "query": {
+                "rank_feature": {
+                    "field": "pagerank",
+                    "saturation": {"pivot": 4.0},
+                }
+            },
+            "size": 10,
+        },
+    )
+    hits = out["hits"]["hits"]
+    assert [h["_id"] for h in hits] == ["0", "2", "1"]
+    # saturation: v/(v+pivot)
+    assert abs(hits[0]["_score"] - 8.0 / 12.0) < 1e-6
+    # log and sigmoid variants run too
+    out = node.search(
+        "docs",
+        {
+            "query": {
+                "rank_feature": {
+                    "field": "pagerank",
+                    "log": {"scaling_factor": 1.0},
+                }
+            }
+        },
+    )
+    assert out["hits"]["hits"][0]["_id"] == "0"
+    with pytest.raises(ApiError):
+        node.search(
+            "docs",
+            {"query": {"rank_feature": {"field": "pagerank"}}},
+        )  # saturation without explicit pivot
+
+
+def test_rank_features_flatten(node):
+    out = node.search(
+        "docs",
+        {
+            "query": {
+                "rank_feature": {
+                    "field": "features.sports",
+                    "saturation": {"pivot": 1.0},
+                }
+            }
+        },
+    )
+    assert [h["_id"] for h in out["hits"]["hits"]] == ["1"]
+
+
+def test_search_as_you_type(node):
+    # Trailing partial token matches via the _index_prefix subfield.
+    out = node.search(
+        "docs",
+        {
+            "query": {
+                "multi_match": {
+                    "query": "quick bro",
+                    "type": "bool_prefix",
+                    "fields": ["sayt", "sayt._index_prefix"],
+                }
+            }
+        },
+    )
+    ids = [h["_id"] for h in out["hits"]["hits"]]
+    assert ids[0] == "0"
+    # 2-gram shingle field matches adjacent word pairs.
+    out = node.search(
+        "docs", {"query": {"match": {"sayt._2gram": "quick brown"}}}
+    )
+    assert [h["_id"] for h in out["hits"]["hits"]] == ["0"]
+
+
+def test_multi_match_best_and_most_fields(node):
+    out = node.search(
+        "docs",
+        {
+            "query": {
+                "multi_match": {
+                    "query": "quick fox",
+                    "fields": ["title^2", "sayt"],
+                }
+            }
+        },
+    )
+    assert out["hits"]["hits"][0]["_id"] == "0"
+    out = node.search(
+        "docs",
+        {
+            "query": {
+                "multi_match": {
+                    "query": "quick",
+                    "type": "most_fields",
+                    "fields": ["title", "sayt"],
+                }
+            }
+        },
+    )
+    assert {h["_id"] for h in out["hits"]["hits"]} == {"0", "2"}
+    with pytest.raises(ApiError):
+        node.search(
+            "docs",
+            {"query": {"multi_match": {"query": "x", "fields": [],}}},
+        )
+
+
+def test_match_bool_prefix_direct(node):
+    out = node.search(
+        "docs",
+        {"query": {"match_bool_prefix": {"sayt._index_prefix": "qui"}}},
+    )
+    assert {h["_id"] for h in out["hits"]["hits"]} == {"0", "2"}
+
+
+def test_rank_feature_device_oracle_parity():
+    m = Mappings(properties={"f": {"type": "rank_feature"},
+                             "t": {"type": "text"}})
+    b = SegmentBuilder(m)
+    rng = np.random.default_rng(3)
+    for i in range(300):
+        b.add({"t": "x", "f": float(rng.random() * 10)}, str(i))
+    seg = b.build()
+    dev = pack_segment(seg)
+    tree = bm25_device.segment_tree(dev)
+    for body in (
+        {"rank_feature": {"field": "f", "saturation": {"pivot": 2.5}}},
+        {"rank_feature": {"field": "f", "log": {"scaling_factor": 2.0}}},
+        {"rank_feature": {"field": "f",
+                          "sigmoid": {"pivot": 3.0, "exponent": 2.0}}},
+    ):
+        import jax
+
+        q = parse_query(body)
+        c = Compiler(dev.fields, dev.doc_values, m).compile(q)
+        d_s, d_i, d_t = jax.device_get(
+            bm25_device.execute(tree, c.spec, c.arrays, 10)
+        )
+        o_s, o_i, o_t = OracleSearcher(seg, m).search(q, 10)
+        n = len(o_i)
+        assert list(d_i[:n]) == list(o_i), body
+        np.testing.assert_allclose(d_s[:n], o_s, rtol=2e-6)
+        assert int(d_t) == o_t
+
+
+@pytest.fixture()
+def percolator_node(tmp_path):
+    n = Node(data_path=str(tmp_path))
+    n.create_index(
+        "alerts",
+        {
+            "mappings": {
+                "properties": {
+                    "query": {"type": "percolator"},
+                    "message": {"type": "text"},
+                    "severity": {"type": "long"},
+                }
+            }
+        },
+    )
+    n.index_doc("alerts", {"query": {"match": {"message": "fire"}}}, "q-fire")
+    n.index_doc(
+        "alerts",
+        {"query": {"bool": {"must": [{"match": {"message": "flood"}}],
+                            "filter": [{"range": {"severity": {"gte": 3}}}]}}},
+        "q-flood",
+    )
+    n.index_doc("alerts", {"query": {"match_all": {}}}, "q-all")
+    n.refresh("alerts")
+    return n
+
+
+def test_percolate(percolator_node):
+    n = percolator_node
+    out = n.search(
+        "alerts",
+        {
+            "query": {
+                "percolate": {
+                    "field": "query",
+                    "document": {"message": "fire in the server room"},
+                }
+            }
+        },
+    )
+    assert sorted(h["_id"] for h in out["hits"]["hits"]) == ["q-all", "q-fire"]
+    out = n.search(
+        "alerts",
+        {
+            "query": {
+                "percolate": {
+                    "field": "query",
+                    "document": {"message": "flood warning", "severity": 5},
+                }
+            }
+        },
+    )
+    assert sorted(h["_id"] for h in out["hits"]["hits"]) == [
+        "q-all", "q-flood",
+    ]
+    # severity below the stored filter: q-flood must not fire.
+    out = n.search(
+        "alerts",
+        {
+            "query": {
+                "percolate": {
+                    "field": "query",
+                    "document": {"message": "flood warning", "severity": 1},
+                }
+            }
+        },
+    )
+    assert sorted(h["_id"] for h in out["hits"]["hits"]) == ["q-all"]
+
+
+def test_percolate_multiple_documents(percolator_node):
+    out = percolator_node.search(
+        "alerts",
+        {
+            "query": {
+                "percolate": {
+                    "field": "query",
+                    "documents": [
+                        {"message": "all quiet"},
+                        {"message": "fire alarm"},
+                    ],
+                }
+            }
+        },
+    )
+    assert sorted(h["_id"] for h in out["hits"]["hits"]) == ["q-all", "q-fire"]
+
+
+def test_percolator_validates_stored_queries(percolator_node):
+    with pytest.raises(ApiError):
+        percolator_node.index_doc(
+            "alerts", {"query": {"not_a_query": {}}}, "bad"
+        )
+    with pytest.raises(ApiError):
+        percolator_node.search(
+            "alerts",
+            {"query": {"percolate": {"field": "message",
+                                     "document": {"x": 1}}}},
+        )
+
+
+def test_percolator_survives_restart(percolator_node, tmp_path):
+    percolator_node.flush("alerts")
+    n2 = Node(data_path=str(tmp_path))
+    out = n2.search(
+        "alerts",
+        {
+            "query": {
+                "percolate": {
+                    "field": "query",
+                    "document": {"message": "fire drill"},
+                }
+            }
+        },
+    )
+    assert sorted(h["_id"] for h in out["hits"]["hits"]) == ["q-all", "q-fire"]
