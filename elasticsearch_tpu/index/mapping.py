@@ -34,19 +34,29 @@ OBJECT = "object"
 NESTED = "nested"
 COMPLETION = "completion"
 RANK_FEATURE = "rank_feature"
+IP = "ip"
+BINARY = "binary"
+GEO_POINT = "geo_point"
+DATE_NANOS = "date_nanos"
 RANK_FEATURES = "rank_features"
 TOKEN_COUNT = "token_count"
 SEARCH_AS_YOU_TYPE = "search_as_you_type"
 PERCOLATOR = "percolator"
 
-NUMERIC_TYPES = {LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT, DATE, BOOLEAN}
-INVERTED_TYPES = {TEXT, KEYWORD}
+NUMERIC_TYPES = {
+    LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT, DATE, BOOLEAN, DATE_NANOS,
+    "half_float", "scaled_float", "unsigned_long",
+}
+# ip fields index exactly like keywords (terms, no norms); binary is
+# stored-only (_source round-trip, no index structures) — both from the
+# reference's mapper roster (IpFieldMapper, BinaryFieldMapper).
+INVERTED_TYPES = {TEXT, KEYWORD, IP}
 # rank_feature and token_count materialize as numeric doc-values columns.
 DOC_VALUE_TYPES = NUMERIC_TYPES | {RANK_FEATURE, TOKEN_COUNT}
 ALL_TYPES = NUMERIC_TYPES | INVERTED_TYPES | {
     DENSE_VECTOR, OBJECT, NESTED, COMPLETION,
     RANK_FEATURE, RANK_FEATURES, TOKEN_COUNT, SEARCH_AS_YOU_TYPE,
-    PERCOLATOR,
+    PERCOLATOR, BINARY, GEO_POINT,
 }
 
 
@@ -69,6 +79,7 @@ def parse_date_millis(value: Any) -> float:
             pass
         from datetime import datetime, timezone
 
+        s = _trim_subsecond(s)
         try:
             dt = datetime.fromisoformat(s.replace("Z", "+00:00"))
         except ValueError:
@@ -80,6 +91,16 @@ def parse_date_millis(value: Any) -> float:
             dt = dt.replace(tzinfo=timezone.utc)
         return dt.timestamp() * 1000.0
     raise ValueError(f"failed to parse date field [{value!r}]")
+
+
+def _trim_subsecond(s: str) -> str:
+    """Truncate fractional seconds past microseconds (date_nanos inputs;
+    fromisoformat accepts at most 6 fractional digits)."""
+    import re as _re
+
+    return _re.sub(
+        r"(\.\d{6})\d+", r"\1", s
+    )
 
 
 def coerce_numeric(field_type: str, value: Any) -> float:
@@ -101,7 +122,7 @@ def coerce_numeric(field_type: str, value: Any) -> float:
         raise ValueError(
             f"Can't parse boolean value [{value!r}], expected [true] or [false]"
         )
-    if field_type == DATE:
+    if field_type in (DATE, DATE_NANOS):
         return parse_date_millis(value)
     if isinstance(value, bool):
         return 1.0 if value else 0.0
@@ -132,14 +153,14 @@ class FieldMapping:
     def __post_init__(self):
         if self.type not in ALL_TYPES:
             raise ValueError(f"No handler for type [{self.type}] on field [{self.name}]")
-        if self.type == KEYWORD:
+        if self.type in (KEYWORD, IP):
             self.analyzer = "keyword"
         if self.search_analyzer is None:
             self.search_analyzer = self.analyzer
         if self.norms is None:
             # Elasticsearch disables norms on keyword fields (KeywordFieldMapper
             # omits norms); text fields index them by default.
-            self.norms = self.type == TEXT
+            self.norms = self.type in (TEXT, SEARCH_AS_YOU_TYPE)
 
     @property
     def is_inverted(self) -> bool:

@@ -32,6 +32,7 @@ from ..utils import smallfloat
 from .mapping import (
     COMPLETION,
     DENSE_VECTOR,
+    GEO_POINT,
     NESTED,
     PERCOLATOR,
     RANK_FEATURES,
@@ -40,6 +41,26 @@ from .mapping import (
     Mappings,
     coerce_numeric,
 )
+
+
+def parse_geo_point(value) -> tuple[float, float]:
+    """(lat, lon) from the reference's accepted forms: [lon, lat] arrays,
+    {lat, lon} objects, "lat,lon" strings (GeoUtils.parseGeoPoint;
+    geohash form unsupported)."""
+    if isinstance(value, (list, tuple)) and len(value) == 2:
+        try:
+            lon, lat = float(value[0]), float(value[1])
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"failed to parse geo_point [{value!r}]"
+            ) from None
+        return lat, lon
+    if isinstance(value, dict) and "lat" in value and "lon" in value:
+        return float(value["lat"]), float(value["lon"])
+    if isinstance(value, str) and "," in value:
+        lat_s, lon_s = value.split(",", 1)
+        return float(lat_s), float(lon_s)
+    raise ValueError(f"failed to parse geo_point [{value!r}]")
 
 
 @dataclass
@@ -261,7 +282,22 @@ class SegmentBuilder:
         False then); numeric doc_values and vectors are stored regardless,
         matching the reference where index:false keeps doc_values available
         for sort/agg/script access."""
-        if fm.type == TOKEN_COUNT:
+        if fm.type == GEO_POINT:
+            # A bare [lon, lat] number pair IS one point (GeoUtils); a
+            # list of point forms is multi-valued — first point wins
+            # (consistent with the numeric columns' first-value policy).
+            try:
+                lat, lon = parse_geo_point(value)
+            except ValueError:
+                lat, lon = parse_geo_point(_iter_field_values(value)[0])
+            if not (-90.0 <= lat <= 90.0) or not (-180.0 <= lon <= 180.0):
+                raise ValueError(
+                    f"failed to parse geo_point: [{lat}, {lon}] out of "
+                    f"bounds for field [{field_name}]"
+                )
+            staged_numeric.append((f"{field_name}.lat", lat))
+            staged_numeric.append((f"{field_name}.lon", lon))
+        elif fm.type == TOKEN_COUNT:
             # Analyzed token count as a numeric doc value
             # (TokenCountFieldMapper, mapper-extras).
             analyzer = self.mappings.analysis.get(fm.analyzer)
@@ -386,6 +422,9 @@ class SegmentBuilder:
                 nested_ops.append((prefix, obj))
             return
         if fm is not None and fm.type == COMPLETION:
+            flat.setdefault(prefix, (fm, []))[1].append(value)
+            return
+        if fm is not None and fm.type == GEO_POINT:
             flat.setdefault(prefix, (fm, []))[1].append(value)
             return
         if fm is not None and fm.type == PERCOLATOR:

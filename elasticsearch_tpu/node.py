@@ -752,7 +752,67 @@ class Node:
             shutil.rmtree(idx_dir, ignore_errors=True)
         return {"acknowledged": True}
 
+    def default_index(self) -> str:
+        """The target of index-less APIs (/_search, /_count): the single
+        concrete index. ES fans out to every index; this node serves one
+        index per request, so multi-index targets 400 (documented gap)."""
+        if len(self.indices) == 1:
+            return next(iter(self.indices))
+        if not self.indices:
+            raise index_not_found("_all")
+        raise ApiError(
+            400,
+            "illegal_argument_exception",
+            "searching multiple indices in one request is not supported "
+            "yet; target a single index",
+        )
+
+    def refresh_all(self) -> dict:
+        for name in list(self.indices):
+            self.refresh(name)
+        return {"_shards": {"failed": 0}}
+
+    def expand_index_patterns(self, name: str) -> list[str]:
+        """_all / comma-lists / wildcards -> concrete index names
+        (IndexNameExpressionResolver for the admin APIs)."""
+        import fnmatch
+
+        if name in ("_all", "*"):
+            return sorted(self.indices)
+        out: list[str] = []
+        for part in name.split(","):
+            part = part.strip()
+            if "*" in part or "?" in part:
+                out.extend(
+                    i for i in sorted(self.indices)
+                    if fnmatch.fnmatchcase(i, part)
+                )
+            elif part:
+                out.append(self.resolve_index(part))
+        return out
+
+    def flush_all(self) -> dict:
+        for name in list(self.indices):
+            self.flush(name)
+        return {"_shards": {"failed": 0}}
+
+    def get_mapping_all(self) -> dict:
+        return {
+            name: {"mappings": svc.mappings.to_json()}
+            for name, svc in sorted(self.indices.items())
+        }
+
+    def resolve_search_targets(self, name: str) -> list[str]:
+        """Concrete indices a search-style request targets."""
+        if name in ("_all", "*"):
+            return sorted(self.indices)
+        if "," in name or "*" in name or "?" in name:
+            return self.expand_index_patterns(name)
+        return [name]
+
     def get_index(self, name: str, auto_create: bool = False) -> IndexService:
+        if name in ("_all", "*"):
+            name = self.default_index()
         svc = self.indices.get(name)
         if svc is None:
             resolved = self.resolve_index(name)  # alias -> concrete index
@@ -1027,6 +1087,13 @@ class Node:
                 raise ApiError(
                     400, "illegal_argument_exception", f"malformed action line: {e}"
                 ) from None
+            if not isinstance(action_line, dict) or len(action_line) != 1:
+                raise ApiError(
+                    400,
+                    "illegal_argument_exception",
+                    f"Malformed action/metadata line [{i}], expected a "
+                    f"single action object",
+                )
             ((op, meta),) = action_line.items()
             index = meta.get("_index", default_index)
             doc_id = meta.get("_id")
@@ -1098,6 +1165,11 @@ class Node:
         scroll: str | None = None,
         request_cache: bool | None = None,
     ) -> dict:
+        targets = self.resolve_search_targets(index)
+        if len(targets) > 1:
+            return self._multi_index_search(targets, body, scroll)
+        if len(targets) == 1:
+            index = targets[0]
         svc = self.get_index(index)
         if body:
             body = self.resolve_script_refs(body)
@@ -1176,6 +1248,74 @@ class Node:
                 ) from None
         if cache_key is not None and not response.timed_out:
             self.request_cache.put(cache_key, out)
+        return out
+
+    def _multi_index_search(
+        self, targets: list[str], body: dict[str, Any] | None, scroll
+    ) -> dict:
+        """Search several indices and merge pages by score (the
+        coordinator's cross-index reduce, TransportSearchAction over
+        multiple target indices). Aggs/scroll/suggest across indices are
+        not supported yet."""
+        body = dict(body or {})
+        if scroll is not None or body.get("aggs") or body.get(
+            "aggregations"
+        ) or body.get("suggest") or body.get("sort"):
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                "aggregations/scroll/suggest/sort across multiple indices "
+                "are not supported yet; target a single index",
+            )
+        from_ = max(0, int(body.get("from", 0)))
+        size = max(0, int(body.get("size", 10)))
+        sub_body = dict(body)
+        sub_body["from"] = 0
+        sub_body["size"] = from_ + size
+        merged = []
+        total = 0
+        relation = "eq"
+        max_score = None
+        took = 0
+        shards = 0
+        skipped = 0
+        for rank_base, name in enumerate(targets):
+            out = self.search(name, dict(sub_body))
+            took += out.get("took", 0)
+            sh = out.get("_shards", {})
+            shards += sh.get("total", 1)
+            skipped += sh.get("skipped", 0)
+            tot = out["hits"].get("total")
+            if tot is not None:
+                total += tot["value"]
+                if tot["relation"] == "gte":
+                    relation = "gte"
+            ms = out["hits"].get("max_score")
+            if ms is not None:
+                max_score = ms if max_score is None else max(max_score, ms)
+            for rank, hit in enumerate(out["hits"]["hits"]):
+                key = (
+                    -hit["_score"] if hit.get("_score") is not None
+                    else float("inf")
+                )
+                merged.append((key, hit["_index"], rank, hit))
+        merged.sort(key=lambda t: (t[0], t[1], t[2]))
+        page = [hit for *_, hit in merged[from_ : from_ + size]]
+        out = {
+            "took": took,
+            "timed_out": False,
+            "_shards": {
+                "total": shards,
+                "successful": shards,
+                "skipped": skipped,
+                "failed": 0,
+            },
+            "hits": {
+                "total": {"value": total, "relation": relation},
+                "max_score": max_score,
+                "hits": page,
+            },
+        }
         return out
 
     def count(self, index: str, body: dict[str, Any] | None) -> dict:
@@ -1645,6 +1785,8 @@ class Node:
         for spec in specs:
             index = spec.get("_index", default_index)
             doc_id = spec.get("_id")
+            if doc_id is not None:
+                doc_id = str(doc_id)  # ES coerces numeric _ids to strings
             if index is None or doc_id is None:
                 docs.append(
                     {

@@ -109,6 +109,27 @@ def _eval_node(spec, arrays, seg: dict[str, Any], num_docs: int):
         return scores, matched
     if kind == "range":
         return _eval_range(spec, arrays, seg, num_docs)
+    if kind == "geo_distance":
+        _, field_name = spec
+        lat = seg["doc_values"][field_name + ".lat"]
+        lon = seg["doc_values"][field_name + ".lon"]
+        d = _haversine_m(jnp, lat, lon, arrays["lat"], arrays["lon"])
+        matched = ~jnp.isnan(lat) & (d <= arrays["radius_m"])
+        scores = jnp.where(matched, arrays["boost"], jnp.float32(0.0))
+        return scores, matched
+    if kind == "geo_box":
+        _, field_name = spec
+        lat = seg["doc_values"][field_name + ".lat"]
+        lon = seg["doc_values"][field_name + ".lon"]
+        in_lat = (lat <= arrays["top"]) & (lat >= arrays["bottom"])
+        # Antimeridian-crossing boxes: left > right wraps.
+        wraps = arrays["left"] > arrays["right"]
+        in_lon_plain = (lon >= arrays["left"]) & (lon <= arrays["right"])
+        in_lon_wrap = (lon >= arrays["left"]) | (lon <= arrays["right"])
+        in_lon = jnp.where(wraps, in_lon_wrap, in_lon_plain)
+        matched = ~jnp.isnan(lat) & in_lat & in_lon
+        scores = jnp.where(matched, arrays["boost"], jnp.float32(0.0))
+        return scores, matched
     if kind == "rank_feature":
         _, field_name, fn = spec
         col = seg["doc_values"][field_name]
@@ -276,6 +297,22 @@ def _eval_nested(spec, arrays, seg, num_docs):
         raise ValueError(f"unknown nested score_mode [{score_mode}]")
     scores = jnp.where(matched, reduced * arrays["boost"], jnp.float32(0.0))
     return scores, matched
+
+
+def _haversine_m(xp, lat, lon, qlat, qlon):
+    """Great-circle distance in meters (GeoUtils.arcDistance; f32 on the
+    VPU — sub-meter accuracy is not the contract, matching ES's own
+    Haversin approximation)."""
+    rad = 0.017453292519943295
+    phi1 = lat * rad
+    phi2 = qlat * rad
+    dphi = (qlat - lat) * rad
+    dlmb = (qlon - lon) * rad
+    a = (
+        xp.sin(dphi / 2) ** 2
+        + xp.cos(phi1) * xp.cos(phi2) * xp.sin(dlmb / 2) ** 2
+    )
+    return 6371008.7714 * 2 * xp.arctan2(xp.sqrt(a), xp.sqrt(1 - a))
 
 
 def _eval_script(spec, arrays, seg, num_docs):

@@ -530,6 +530,27 @@ class Compiler:
             return self._node(bool_prefix_rewrite(q, analyzer), scoring)
         if isinstance(q, RankFeatureQuery):
             return self._rank_feature(q)
+        from .dsl import GeoBoundingBoxQuery, GeoDistanceQuery
+
+        if isinstance(q, GeoDistanceQuery):
+            if f"{q.field_name}.lat" not in self.doc_values:
+                return ("match_none",), {}
+            return ("geo_distance", q.field_name), {
+                "lat": np.float32(q.lat),
+                "lon": np.float32(q.lon),
+                "radius_m": np.float32(q.distance_m),
+                "boost": np.float32(q.boost),
+            }
+        if isinstance(q, GeoBoundingBoxQuery):
+            if f"{q.field_name}.lat" not in self.doc_values:
+                return ("match_none",), {}
+            return ("geo_box", q.field_name), {
+                "top": np.float32(q.top),
+                "left": np.float32(q.left),
+                "bottom": np.float32(q.bottom),
+                "right": np.float32(q.right),
+                "boost": np.float32(q.boost),
+            }
         if isinstance(q, PercolateQuery):
             return self._percolate(q)
         if isinstance(q, ScriptScoreQuery):
@@ -603,6 +624,25 @@ class Compiler:
             )
         if isinstance(q, SpanNotQuery):
             return self._span_not_spec(q, scoring)
+        from .dsl import IntervalsQuery, intervals_to_spans
+
+        if isinstance(q, IntervalsQuery):
+            analyzer = self.mappings.analyzer_for(q.field_name, search=True)
+            dfield = self._field_or_none(q.field_name)
+
+            def expand_prefix(prefix: str) -> list[str]:
+                if dfield is None:
+                    return []
+                return [t for t in dfield.terms if t.startswith(prefix)]
+
+            clauses, slop, ordered = intervals_to_spans(
+                q.field_name, q.rule, analyzer, expand_prefix
+            )
+            if not clauses:
+                return ("match_none",), {}
+            return self._span_near_spec(
+                q.field_name, clauses, slop, ordered, -1, q.boost, scoring
+            )
         if isinstance(q, BoostingQuery):
             pos_spec, pos_arrays = self._node(q.positive, scoring)
             neg_spec, neg_arrays = self._node(q.negative, scoring=False)
@@ -1052,35 +1092,19 @@ class Compiler:
         (PercolateQueryBuilder) — then select the matching stored-query
         docs with a doc_set plan. Matching queries score `boost` (the
         reference scores percolation matches; constant scoring is a noted
-        simplification)."""
-        from ..index.mapping import Mappings as _Mappings
-        from ..index.segment import SegmentBuilder
-        from ..search.oracle import OracleSearcher
-        from .dsl import parse_query as _parse
+        simplification). The evaluator and its cached one-doc segment are
+        shared with the oracle (search/oracle.percolate_matching_docs).
+        """
+        from ..search.oracle import percolate_matching_docs
 
         fm = self.mappings.get(q.field_name)
         if fm is None or fm.type != "percolator":
             raise ValueError(
                 f"field [{q.field_name}] is not a percolator field"
             )
-        entries = self.percolator.get(q.field_name, [])
-        matched_locals: list[int] = []
-        if entries:
-            mini_mappings = _Mappings.from_json(
-                self.mappings.to_json(), analysis=self.mappings.analysis
-            )
-            builder = SegmentBuilder(mini_mappings)
-            for doc in q.documents:
-                builder.add(dict(doc))
-            mini = builder.build()
-            oracle = OracleSearcher(mini, mini_mappings)
-            for local_doc, query_json in entries:
-                try:
-                    _s, m = oracle._eval(_parse(query_json))
-                except ValueError:
-                    continue  # stored query this segment can't evaluate
-                if m.any():
-                    matched_locals.append(local_doc)
+        matched_locals = percolate_matching_docs(
+            q, self.mappings, self.percolator.get(q.field_name, [])
+        )
         nd = _pow2(len(matched_locals), self.nt_floor)
         docs = np.full(nd, -1, dtype=np.int32)
         docs[: len(matched_locals)] = sorted(matched_locals)

@@ -497,60 +497,115 @@ def bool_prefix_rewrite(q: "MatchBoolPrefixQuery", analyzer) -> Query:
     return BoolQuery(should=children, minimum_should_match=1, boost=q.boost)
 
 
-def multi_match_to_query(spec: dict[str, Any]) -> Query:
-    """multi_match -> dis_max/bool composition over per-field matches
-    (MultiMatchQueryBuilder; best_fields is a DisjunctionMaxQuery, with
-    `field^boost` caret syntax)."""
-    text = spec.get("query")
-    raw_fields = spec.get("fields")
-    if text is None or not raw_fields:
-        raise ValueError("[multi_match] requires [query] and [fields]")
-    mtype = str(spec.get("type", "best_fields"))
-    operator = str(spec.get("operator", "or")).lower()
-    boost = _pop_boost(spec)
-    fields: list[tuple[str, float]] = []
-    for f in raw_fields:
-        name, _, fboost = str(f).partition("^")
-        fields.append((name, float(fboost) if fboost else 1.0))
-    per_field: list[Query] = []
-    for name, fboost in fields:
-        if mtype in ("best_fields", "most_fields"):
-            per_field.append(
-                MatchQuery(
-                    field_name=name, query=str(text), operator=operator,
-                    boost=fboost,
+@dataclass
+class IntervalsQuery(Query):
+    """Interval matching over analyzed positions (IntervalQueryBuilder).
+    Supported sources: match (ordered/max_gaps), all_of, any_of, prefix —
+    lowered onto the unit-span kernels."""
+
+    field_name: str = ""
+    rule: dict = field(default_factory=dict)
+    boost: float = 1.0
+
+
+def intervals_to_spans(
+    field_name: str, rule: dict, analyzer, expand_prefix
+) -> tuple[list[list[str]], int, bool]:
+    """(clause term-lists, slop, ordered) for an intervals rule — shared
+    by the compiler and the oracle. `expand_prefix(prefix)` supplies the
+    dictionary expansion. max_gaps maps directly onto span slop (total
+    stretch between unit spans); -1 means unlimited."""
+    if not isinstance(rule, dict) or len(rule) != 1:
+        raise ValueError("[intervals] requires exactly one source")
+    ((kind, params),) = rule.items()
+    params = params or {}
+
+    def unit_terms(sub_rule) -> list[str]:
+        ((skind, sparams),) = sub_rule.items()
+        sparams = sparams or {}
+        if skind == "match":
+            terms = analyzer.analyze(str(sparams.get("query", "")))
+            if len(terms) != 1:
+                raise ValueError(
+                    "[intervals] sub-sources must analyze to one term"
                 )
-            )
-        elif mtype == "phrase":
-            per_field.append(
-                MatchPhraseQuery(field_name=name, query=str(text), boost=fboost)
-            )
-        elif mtype == "phrase_prefix":
-            per_field.append(
-                MatchPhrasePrefixQuery(
-                    field_name=name, query=str(text), boost=fboost
-                )
-            )
-        elif mtype == "bool_prefix":
-            per_field.append(
-                MatchBoolPrefixQuery(
-                    field_name=name, query=str(text), operator=operator,
-                    boost=fboost,
-                )
-            )
-        else:
-            raise ValueError(f"[multi_match] unknown type [{mtype}]")
-    if len(per_field) == 1:
-        q = per_field[0]
-        q.boost = q.boost * boost
-        return q
-    if mtype in ("most_fields", "bool_prefix"):
-        return BoolQuery(should=per_field, boost=boost)
-    return DisMaxQuery(
-        queries=per_field,
-        tie_breaker=float(spec.get("tie_breaker", 0.0)),
-        boost=boost,
-    )
+            return terms
+        if skind == "prefix":
+            return expand_prefix(str(sparams.get("prefix", "")))
+        if skind == "any_of":
+            out: list[str] = []
+            for sub in sparams.get("intervals", []):
+                out.extend(unit_terms(sub))
+            return out
+        raise ValueError(
+            f"[intervals] unsupported sub-source [{skind}]"
+        )
+
+    unlimited = 1 << 28
+    if kind == "match":
+        terms = analyzer.analyze(str(params.get("query", "")))
+        clauses = [[t] for t in terms]
+        max_gaps = int(params.get("max_gaps", -1))
+        ordered = bool(params.get("ordered", False))
+    elif kind == "all_of":
+        clauses = [unit_terms(sub) for sub in params.get("intervals", [])]
+        max_gaps = int(params.get("max_gaps", -1))
+        ordered = bool(params.get("ordered", False))
+    elif kind in ("any_of", "prefix"):
+        clauses = [unit_terms({kind: params})]
+        max_gaps, ordered = -1, True
+    else:
+        raise ValueError(f"[intervals] unsupported source [{kind}]")
+    if not clauses:
+        return [], 0, True
+    if not ordered and len(clauses) > 2:
+        raise ValueError(
+            "[intervals] unordered matching beyond 2 clauses is not "
+            "supported"
+        )
+    slop = unlimited if max_gaps < 0 else max_gaps
+    return clauses, slop, ordered
+
+
+def parse_distance_meters(value) -> float:
+    """"200km" / "5mi" / "1000m" / bare meters -> meters
+    (common/unit/DistanceUnit)."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = str(value).strip().lower()
+    units = [
+        ("km", 1000.0), ("mi", 1609.344), ("nmi", 1852.0), ("yd", 0.9144),
+        ("ft", 0.3048), ("cm", 0.01), ("mm", 0.001), ("m", 1.0),
+    ]
+    for suffix, factor in units:
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * factor
+    return float(s)
+
+
+@dataclass
+class GeoDistanceQuery(Query):
+    """Docs within `distance` meters of a center point
+    (GeoDistanceQueryBuilder; haversine arc distance)."""
+
+    field_name: str = ""
+    lat: float = 0.0
+    lon: float = 0.0
+    distance_m: float = 0.0
+    boost: float = 1.0
+
+
+@dataclass
+class GeoBoundingBoxQuery(Query):
+    """Docs inside a lat/lon box (GeoBoundingBoxQueryBuilder); handles
+    boxes crossing the antimeridian."""
+
+    field_name: str = ""
+    top: float = 0.0
+    left: float = 0.0
+    bottom: float = 0.0
+    right: float = 0.0
+    boost: float = 1.0
 
 
 @dataclass
@@ -635,8 +690,57 @@ def parse_query(body: dict[str, Any]) -> Query:
         return ConstantScoreQuery(
             filter=parse_query(spec["filter"]), boost=_pop_boost(spec)
         )
+    if kind == "intervals":
+        fname, rule = _single_field(kind, spec)
+        if not isinstance(rule, dict):
+            raise ValueError("[intervals] requires a source object")
+        rule = dict(rule)
+        boost = _pop_boost(rule)
+        rule.pop("boost", None)
+        return IntervalsQuery(field_name=fname, rule=rule, boost=boost)
+    if kind == "geo_distance":
+        spec = dict(spec)
+        boost = _pop_boost(spec)
+        spec.pop("boost", None)
+        distance = spec.pop("distance", None)
+        spec.pop("distance_type", None)
+        spec.pop("validation_method", None)
+        if distance is None or len(spec) != 1:
+            raise ValueError(
+                "[geo_distance] requires [distance] and exactly one field"
+            )
+        ((fname, point),) = spec.items()
+        from ..index.segment import parse_geo_point
+
+        lat, lon = parse_geo_point(point)
+        return GeoDistanceQuery(
+            field_name=fname, lat=lat, lon=lon,
+            distance_m=parse_distance_meters(distance), boost=boost,
+        )
+    if kind == "geo_bounding_box":
+        spec = dict(spec)
+        boost = _pop_boost(spec)
+        spec.pop("boost", None)
+        spec.pop("validation_method", None)
+        if len(spec) != 1:
+            raise ValueError("[geo_bounding_box] requires exactly one field")
+        ((fname, box),) = spec.items()
+        from ..index.segment import parse_geo_point
+
+        if "top_left" in box and "bottom_right" in box:
+            top, left = parse_geo_point(box["top_left"])
+            bottom, right = parse_geo_point(box["bottom_right"])
+        else:
+            top = float(box["top"])
+            left = float(box["left"])
+            bottom = float(box["bottom"])
+            right = float(box["right"])
+        return GeoBoundingBoxQuery(
+            field_name=fname, top=top, left=left, bottom=bottom,
+            right=right, boost=boost,
+        )
     if kind == "multi_match":
-        return multi_match_to_query(spec)
+        return _parse_multi_match(spec)
     if kind == "match_bool_prefix":
         fname, val = _single_field(kind, spec)
         if isinstance(val, dict):
@@ -1102,8 +1206,11 @@ def _parse_multi_match(spec: dict) -> Query:
     if isinstance(raw_fields, str):
         raw_fields = [raw_fields]
     mm_type = str(spec.get("type", "best_fields"))
-    if mm_type not in ("best_fields", "most_fields", "phrase", "phrase_prefix"):
-        # cross_fields/bool_prefix blend term statistics across fields — a
+    if mm_type not in (
+        "best_fields", "most_fields", "phrase", "phrase_prefix",
+        "bool_prefix",
+    ):
+        # cross_fields blends term statistics across fields — a
         # materially different scoring model; reject rather than silently
         # mis-score (matching this codebase's not-supported-yet convention).
         raise ValueError(f"multi_match type [{mm_type}] is not supported yet")
@@ -1129,6 +1236,13 @@ def _parse_multi_match(spec: dict) -> Query:
             per_field.append(
                 MatchPhrasePrefixQuery(name, text, boost=fboost)
             )
+        elif mm_type == "bool_prefix":
+            per_field.append(
+                MatchBoolPrefixQuery(
+                    field_name=name, query=text, operator=operator,
+                    boost=fboost,
+                )
+            )
         else:
             per_field.append(
                 MatchQuery(name, text, operator=operator, boost=fboost)
@@ -1137,6 +1251,6 @@ def _parse_multi_match(spec: dict) -> Query:
         q = per_field[0]
         q.boost *= boost
         return q
-    if mm_type == "most_fields":
+    if mm_type in ("most_fields", "bool_prefix"):
         return BoolQuery(should=per_field, boost=boost)
     return DisMaxQuery(queries=per_field, tie_breaker=tie, boost=boost)

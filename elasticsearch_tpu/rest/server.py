@@ -203,6 +203,20 @@ class RestServer:
         ))
         for method in ("GET", "POST"):
             r(method, "/_search/scroll", lambda s, p, q, b: n.scroll(_json(b)))
+            r(method, "/_search", lambda s, p, q, b: n.search(
+                "_all", _json(b), scroll=q.get("scroll"),
+            ))
+            r(method, "/_count", lambda s, p, q, b: n.count(
+                n.default_index(), _json(b)
+            ))
+            r(method, "/_refresh", lambda s, p, q, b: n.refresh_all())
+            r(method, "/_flush", lambda s, p, q, b: n.flush_all())
+        r("POST", "/_forcemerge", lambda s, p, q, b: [
+            n.force_merge(name, int(q.get("max_num_segments", 1)))
+            for name in list(n.indices)
+        ] and {"_shards": {"failed": 0}} or {"_shards": {"failed": 0}})
+        r("GET", "/_mapping", lambda s, p, q, b: n.get_mapping_all())
+        for method in ("GET", "POST"):
             r(method, "/_mget", lambda s, p, q, b: n.mget(_json(b)))
             r(method, "/{index}/_search", lambda s, p, q, b: n.search(
                 p["index"], _json(b), scroll=q.get("scroll"),
@@ -230,8 +244,17 @@ class RestServer:
         r("POST", "/{index}/_msearch", lambda s, p, q, b: n.msearch(
             b, default_index=p["index"]
         ))
-        r("POST", "/{index}/_refresh", lambda s, p, q, b: n.refresh(p["index"]))
-        r("GET", "/{index}/_refresh", lambda s, p, q, b: n.refresh(p["index"]))
+        def _refresh_multi(s, p, q, b):
+            names = n.expand_index_patterns(p["index"])
+            if not names:
+                return n.refresh(p["index"])  # 404 with ES shape
+            out = None
+            for name in names:
+                out = n.refresh(name)
+            return out
+
+        r("POST", "/{index}/_refresh", _refresh_multi)
+        r("GET", "/{index}/_refresh", _refresh_multi)
         r("POST", "/{index}/_flush", lambda s, p, q, b: n.flush(p["index"]))
         r("POST", "/{index}/_forcemerge", lambda s, p, q, b: n.force_merge(
             p["index"], int(q.get("max_num_segments", 1))

@@ -38,9 +38,13 @@ METRIC_KINDS = {"min", "max", "sum", "avg", "value_count", "stats"}
 # Metric-like kinds computed on the host from the device matched mask and
 # the float64 columns (f64-exact reduce; InternalSum.java:22 reduces in
 # double) — they nest under filter-type parents like any metric.
-HOST_METRIC_KINDS = {"percentiles", "percentile_ranks", "extended_stats"}
+HOST_METRIC_KINDS = {
+    "percentiles", "percentile_ranks", "extended_stats",
+    "median_absolute_deviation",
+}
 BUCKET_METRIC_HOSTS = {
-    "terms", "significant_terms", "histogram", "date_histogram", "range",
+    "terms", "significant_terms", "rare_terms", "histogram",
+    "date_histogram", "range",
 }
 NESTING_KINDS = {"filter", "filters", "global", "missing"}
 MAX_BUCKETS = 65536  # ES search.max_buckets default
@@ -426,6 +430,21 @@ class Aggregator:
             for fname in p["fields"]:
                 self._require_numeric(fname)
             return ("matched",), {}
+        if k == "rare_terms":
+            fname = p["field"]
+            if node.subs:
+                raise AggParsingError(
+                    "[rare_terms] sub-aggregations are not supported yet"
+                )
+            if self._keyword_ok(handle, fname):
+                tp = _pow2(handle.device.fields[fname].num_terms)
+                return ("terms", fname, tp, ()), {}
+            if self._is_text(handle, fname):
+                raise AggParsingError(
+                    f"rare_terms aggregation on text field [{fname}] "
+                    f"requires keyword doc values"
+                )
+            return ("matched",), {}
         if k == "significant_terms":
             fname = p["field"]
             if self._keyword_ok(handle, fname):
@@ -727,7 +746,7 @@ def new_merge_state(node: AggNode) -> dict[str, Any]:
     k = node.kind
     if k in METRIC_KINDS | {"extended_stats"}:
         return {"count": 0, "sum": 0.0, "min": np.inf, "max": -np.inf, "sumsq": 0.0}
-    if k in ("percentiles", "percentile_ranks"):
+    if k in ("percentiles", "percentile_ranks", "median_absolute_deviation"):
         return {"chunks": []}  # per-segment matched f64 value arrays
     if k == "top_hits":
         return {"segments": []}  # (handle, mask, scores) per segment
@@ -735,7 +754,7 @@ def new_merge_state(node: AggNode) -> dict[str, Any]:
         return {"counts": {}, "subs": {}}
     if k == "cardinality":
         return {"values": set()}
-    if k == "terms":
+    if k in ("terms", "rare_terms"):
         return {"counts": {}, "subs": {}, "host": False, "hits_segments": []}
     if k == "significant_terms":
         return {
@@ -806,7 +825,7 @@ def merge_segment_result(
             state["max"] = max(state["max"], float(np.max(vals)))
             state["sumsq"] += float(np.sum(vals * vals))
         return
-    if k in ("percentiles", "percentile_ranks"):
+    if k in ("percentiles", "percentile_ranks", "median_absolute_deviation"):
         vals = _host_values(result, handle, node.params["field"])
         if len(vals):
             state["chunks"].append(vals)
@@ -876,6 +895,26 @@ def merge_segment_result(
                 _merge_bucket_planes(
                     state["subs"].setdefault(f, {}), trimmed, keys
                 )
+        return
+    if k == "rare_terms":
+        fname = node.params["field"]
+        dfield = handle.device.fields.get(fname)
+        if dfield is None or dfield.ord_terms is None:
+            vals, counts = np.unique(
+                _host_values(result, handle, fname), return_counts=True
+            )
+            if len(vals):
+                state["host"] = True
+            for v, c in zip(vals, counts):
+                key = float(v)
+                state["counts"][key] = state["counts"].get(key, 0) + int(c)
+            return
+        vocab = list(dfield.terms.keys())
+        counts = np.asarray(result["counts"])
+        nz = np.flatnonzero(counts[: len(vocab)])
+        for i in nz:
+            key = vocab[i]
+            state["counts"][key] = state["counts"].get(key, 0) + int(counts[i])
         return
     if k == "terms":
         _capture_hits_planes(node, state, handle, result, root_planes)
@@ -1604,6 +1643,33 @@ def render(
         return {"value": len(state["values"])}
     if k == "matrix_stats":
         return _render_matrix_stats(node, state)
+    if k == "median_absolute_deviation":
+        vals = (
+            np.concatenate(state["chunks"])
+            if state["chunks"]
+            else np.zeros(0)
+        )
+        if not len(vals):
+            return {"value": None}
+        med = float(np.median(vals))
+        return {"value": float(np.median(np.abs(vals - med)))}
+    if k == "rare_terms":
+        max_doc_count = int(node.params.get("max_doc_count", 1))
+        fname = node.params["field"]
+        items = [
+            (k2, c) for k2, c in state["counts"].items()
+            if c <= max_doc_count
+        ]
+        items.sort(key=lambda kv: (kv[1], kv[0]))
+        buckets = []
+        for key, count in items[:10_000]:
+            out_key = (
+                _key_for_field(engine, fname, key)
+                if state.get("host")
+                else key
+            )
+            buckets.append({"key": out_key, "doc_count": count})
+        return {"buckets": buckets}
     if k == "significant_terms":
         return _render_significant_terms(node, state, index_name)
     if k == "terms":

@@ -73,6 +73,42 @@ def _osa_distance(a: str, b: str) -> int:
     return d[la][lb]
 
 
+def percolate_matching_docs(q, mappings, entries) -> list[int]:
+    """Local doc ids of stored percolator queries matching q.documents.
+
+    The single percolation evaluator shared by the compiler and the
+    oracle. The one-doc in-memory segment (the MemoryIndex analog) is
+    built once per PercolateQuery and cached on the query object — every
+    index segment percolates against the same documents.
+    """
+    if not entries:
+        return []
+    cached = getattr(q, "_percolation_oracle", None)
+    if cached is None:
+        from ..index.mapping import Mappings as _Mappings
+        from ..index.segment import SegmentBuilder
+
+        mini_mappings = _Mappings.from_json(
+            mappings.to_json(), analysis=mappings.analysis
+        )
+        builder = SegmentBuilder(mini_mappings)
+        for doc in q.documents:
+            builder.add(dict(doc))
+        cached = OracleSearcher(builder.build(), mini_mappings)
+        q._percolation_oracle = cached
+    from ..query.dsl import parse_query as _parse
+
+    out: list[int] = []
+    for local_doc, query_json in entries:
+        try:
+            _s, m = cached._eval(_parse(query_json))
+        except ValueError:
+            continue  # a stored query this node cannot evaluate
+        if m.any():
+            out.append(local_doc)
+    return out
+
+
 class OracleSearcher:
     def __init__(
         self,
@@ -128,6 +164,48 @@ class OracleSearcher:
             return self._eval(bool_prefix_rewrite(q, analyzer))
         if isinstance(q, RankFeatureQuery):
             return self._rank_feature(q)
+        from ..query.dsl import GeoBoundingBoxQuery, GeoDistanceQuery
+
+        if isinstance(q, GeoDistanceQuery):
+            from ..ops.bm25_device import _haversine_m
+
+            lat = self.segment.doc_values.get(f"{q.field_name}.lat")
+            lon = self.segment.doc_values.get(f"{q.field_name}.lon")
+            if lat is None:
+                return np.zeros(n, np.float32), np.zeros(n, bool)
+            lat32 = lat.astype(np.float32)
+            lon32 = lon.astype(np.float32)
+            d = _haversine_m(
+                np, lat32, lon32, np.float32(q.lat), np.float32(q.lon)
+            )
+            matched = ~np.isnan(lat32) & (d <= np.float32(q.distance_m))
+            return (
+                np.where(matched, np.float32(q.boost), np.float32(0.0)),
+                matched,
+            )
+        if isinstance(q, GeoBoundingBoxQuery):
+            lat = self.segment.doc_values.get(f"{q.field_name}.lat")
+            lon = self.segment.doc_values.get(f"{q.field_name}.lon")
+            if lat is None:
+                return np.zeros(n, np.float32), np.zeros(n, bool)
+            lat32 = lat.astype(np.float32)
+            lon32 = lon.astype(np.float32)
+            in_lat = (lat32 <= np.float32(q.top)) & (
+                lat32 >= np.float32(q.bottom)
+            )
+            if q.left > q.right:
+                in_lon = (lon32 >= np.float32(q.left)) | (
+                    lon32 <= np.float32(q.right)
+                )
+            else:
+                in_lon = (lon32 >= np.float32(q.left)) & (
+                    lon32 <= np.float32(q.right)
+                )
+            matched = ~np.isnan(lat32) & in_lat & in_lon
+            return (
+                np.where(matched, np.float32(q.boost), np.float32(0.0)),
+                matched,
+            )
         if isinstance(q, PercolateQuery):
             return self._percolate(q)
         if isinstance(q, RegexpQuery):
@@ -175,6 +253,25 @@ class OracleSearcher:
         if isinstance(q, SpanFirstQuery):
             f, terms = self._span_unit_terms(q.match)
             return self._span_eval(f, [terms], 0, True, q.end, q.boost)
+        from ..query.dsl import IntervalsQuery, intervals_to_spans
+
+        if isinstance(q, IntervalsQuery):
+            analyzer = self.mappings.analyzer_for(q.field_name, search=True)
+            fld = self.segment.fields.get(q.field_name)
+
+            def expand_prefix(prefix: str) -> list[str]:
+                if fld is None:
+                    return []
+                return [t for t in fld.terms if t.startswith(prefix)]
+
+            clauses, slop, ordered = intervals_to_spans(
+                q.field_name, q.rule, analyzer, expand_prefix
+            )
+            if not clauses:
+                return np.zeros(n, np.float32), np.zeros(n, bool)
+            return self._span_eval(
+                q.field_name, clauses, slop, ordered, -1, q.boost
+            )
         if isinstance(q, SpanNotQuery):
             from ..query.dsl import span_not_lists
 
@@ -736,31 +833,15 @@ class OracleSearcher:
     def _percolate(self, q):
         """Percolation twin: evaluate stored queries against an in-memory
         segment built from the provided document(s)."""
-        from ..index.mapping import Mappings as _Mappings
-        from ..index.segment import SegmentBuilder
-        from ..query.dsl import parse_query as _parse
-
         n = self.segment.num_docs
         scores = np.zeros(n, np.float32)
         matched = np.zeros(n, bool)
         entries = self.segment.percolator.get(q.field_name, [])
-        if not entries:
-            return scores, matched
-        mini_mappings = _Mappings.from_json(
-            self.mappings.to_json(), analysis=self.mappings.analysis
-        )
-        builder = SegmentBuilder(mini_mappings)
-        for doc in q.documents:
-            builder.add(dict(doc))
-        oracle = OracleSearcher(builder.build(), mini_mappings)
-        for local_doc, query_json in entries:
-            try:
-                _s, m = oracle._eval(_parse(query_json))
-            except ValueError:
-                continue
-            if m.any():
-                matched[local_doc] = True
-                scores[local_doc] = np.float32(q.boost)
+        for local_doc in percolate_matching_docs(
+            q, self.mappings, entries
+        ):
+            matched[local_doc] = True
+            scores[local_doc] = np.float32(q.boost)
         return scores, matched
 
     def _terms_set(self, q):

@@ -183,9 +183,29 @@ class SearchRequest:
     fields: list[str] | None = None  # retrieved from _source
     profile: bool = False  # per-segment timing in the response
 
+    # The search-body keys this node understands; anything else is a
+    # parsing error, like the reference's strict SearchSourceBuilder
+    # x-content parsing (unknown keys 400, never silently ignore).
+    KNOWN_KEYS = frozenset(
+        {
+            "query", "aggs", "aggregations", "rescore", "sort", "from",
+            "size", "search_after", "track_total_hits", "highlight",
+            "docvalue_fields", "fields", "_source", "stored_fields",
+            "timeout", "profile", "suggest", "min_score", "version",
+            "seq_no_primary_term", "explain", "pit", "track_scores",
+            "terminate_after", "indices_boost", "script_fields",
+            "rest_total_hits_as_int", "scroll_id", "scroll",
+        }
+    )
+
     @classmethod
     def from_json(cls, body: dict[str, Any] | None) -> "SearchRequest":
         body = body or {}
+        unknown = set(body) - cls.KNOWN_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown key [{sorted(unknown)[0]}] in the search request"
+            )
         query = (
             parse_query(body["query"]) if "query" in body else MatchAllQuery()
         )

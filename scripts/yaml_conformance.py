@@ -1,0 +1,85 @@
+"""Sweep the reference's YAML REST conformance suites and report.
+
+Usage: python scripts/yaml_conformance.py [test-dir-filter ...]
+
+Runs every section of every .yml under the reference's rest-api-spec test
+tree against a fresh in-process node per section, then prints a summary
+and writes the per-section outcomes to /tmp/yaml_conformance.json.
+Outcomes: pass / fail (assertion or error) / skip (unsupported feature or
+API outside the runner's table).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from yaml_runner import (  # noqa: E402
+    REFERENCE_TESTS,
+    SkipTest,
+    YamlRunner,
+    load_suites,
+)
+
+
+def main() -> None:
+    from elasticsearch_tpu.rest.server import RestServer
+
+    filters = sys.argv[1:]
+    results: dict[str, str] = {}
+    counts = {"pass": 0, "fail": 0, "skip": 0}
+    by_dir: dict[str, dict[str, int]] = {}
+    for path in sorted(REFERENCE_TESTS.rglob("*.yml")):
+        rel = str(path.relative_to(REFERENCE_TESTS))
+        if filters and not any(rel.startswith(f) for f in filters):
+            continue
+        try:
+            suites = load_suites(path)
+        except Exception as e:  # malformed-to-us yaml: count as skip
+            results[rel] = f"skip (yaml: {e})"
+            counts["skip"] += 1
+            continue
+        for section, steps in suites.items():
+            if section in ("setup", "teardown"):
+                continue
+            key = f"{rel}::{section}"
+            try:
+                rest = RestServer(data_path=tempfile.mkdtemp())
+                runner = YamlRunner(rest)
+                if "setup" in suites:
+                    runner.run_steps(suites["setup"])
+                runner.run_steps(steps)
+            except SkipTest as e:
+                results[key] = f"skip ({e})"
+                outcome = "skip"
+            except Exception as e:
+                results[key] = f"fail ({type(e).__name__}: {str(e)[:160]})"
+                outcome = "fail"
+            else:
+                results[key] = "pass"
+                outcome = "pass"
+            counts[outcome] += 1
+            top = rel.split("/")[0]
+            by_dir.setdefault(top, {"pass": 0, "fail": 0, "skip": 0})
+            by_dir[top][outcome] += 1
+
+    with open("/tmp/yaml_conformance.json", "w") as f:
+        json.dump({"counts": counts, "results": results}, f, indent=1)
+    print(json.dumps(counts))
+    for d in sorted(by_dir):
+        c = by_dir[d]
+        print(f"  {d}: {c['pass']}P/{c['fail']}F/{c['skip']}S")
+
+
+if __name__ == "__main__":
+    main()

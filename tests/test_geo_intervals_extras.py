@@ -1,0 +1,205 @@
+"""geo_point fields + geo queries, intervals, rare_terms, MAD, and the
+new mapper types (ip/binary/date_nanos).
+
+Reference: GeoDistanceQueryBuilder, GeoBoundingBoxQueryBuilder,
+IntervalQueryBuilder, RareTermsAggregationBuilder,
+MedianAbsoluteDeviationAggregationBuilder, IpFieldMapper,
+BinaryFieldMapper.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import ApiError, Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path))
+    n.create_index(
+        "places",
+        {
+            "mappings": {
+                "properties": {
+                    "name": {"type": "text"},
+                    "loc": {"type": "geo_point"},
+                    "tag": {"type": "keyword"},
+                    "v": {"type": "double"},
+                    "addr": {"type": "ip"},
+                    "blob": {"type": "binary"},
+                    "ts": {"type": "date_nanos"},
+                }
+            }
+        },
+    )
+    cities = [
+        ("berlin", 52.52, 13.40),
+        ("paris", 48.85, 2.35),
+        ("london", 51.50, -0.12),
+        ("nyc", 40.71, -74.00),
+    ]
+    for i, (name, lat, lon) in enumerate(cities):
+        n.index_doc(
+            "places",
+            {
+                "name": f"{name} quick brown fox jumps", "loc": [lon, lat],
+                "tag": name, "v": float(i),
+                "addr": f"10.0.0.{i}", "blob": "aGVsbG8=",
+                "ts": "2020-01-01T00:00:00.123456789Z",
+            },
+            name,
+        )
+    n.refresh("places")
+    return n
+
+
+def test_geo_distance(node):
+    out = node.search(
+        "places",
+        {
+            "query": {
+                "geo_distance": {
+                    "distance": "200km",
+                    "loc": {"lat": 49.0, "lon": 4.0},
+                }
+            },
+            "size": 10,
+        },
+    )
+    # Reims-ish center: only Paris (~130km) is in range.
+    ids = {h["_id"] for h in out["hits"]["hits"]}
+    assert ids == {"paris"}
+    out = node.search(
+        "places",
+        {
+            "query": {
+                "geo_distance": {"distance": "7000km", "loc": [8.0, 50.0]}
+            },
+            "size": 10,
+        },
+    )
+    assert {h["_id"] for h in out["hits"]["hits"]} == {
+        "berlin", "paris", "london", "nyc",
+    }
+
+
+def test_geo_bounding_box(node):
+    out = node.search(
+        "places",
+        {
+            "query": {
+                "geo_bounding_box": {
+                    "loc": {
+                        "top_left": {"lat": 55.0, "lon": -1.0},
+                        "bottom_right": {"lat": 45.0, "lon": 15.0},
+                    }
+                }
+            },
+            "size": 10,
+        },
+    )
+    assert {h["_id"] for h in out["hits"]["hits"]} == {
+        "berlin", "paris", "london",
+    }
+
+
+def test_intervals_ordered_and_gaps(node):
+    out = node.search(
+        "places",
+        {
+            "query": {
+                "intervals": {
+                    "name": {
+                        "match": {
+                            "query": "quick fox",
+                            "max_gaps": 1,
+                            "ordered": True,
+                        }
+                    }
+                }
+            },
+            "size": 10,
+        },
+    )
+    assert len(out["hits"]["hits"]) == 4  # quick [brown] fox everywhere
+    out = node.search(
+        "places",
+        {
+            "query": {
+                "intervals": {
+                    "name": {
+                        "match": {
+                            "query": "quick fox",
+                            "max_gaps": 0,
+                            "ordered": True,
+                        }
+                    }
+                }
+            },
+        },
+    )
+    assert out["hits"]["hits"] == []
+    out = node.search(
+        "places",
+        {
+            "query": {
+                "intervals": {
+                    "name": {
+                        "all_of": {
+                            "ordered": True,
+                            "intervals": [
+                                {"match": {"query": "berlin"}},
+                                {"prefix": {"prefix": "qui"}},
+                            ],
+                        }
+                    }
+                }
+            },
+        },
+    )
+    assert [h["_id"] for h in out["hits"]["hits"]] == ["berlin"]
+
+
+def test_ip_and_binary_and_date_nanos(node):
+    out = node.search(
+        "places", {"query": {"term": {"addr": "10.0.0.2"}}}
+    )
+    assert [h["_id"] for h in out["hits"]["hits"]] == ["london"]
+    doc = node.get_doc("places", "berlin")
+    assert doc["_source"]["blob"] == "aGVsbG8="
+    out = node.search(
+        "places",
+        {"query": {"range": {"ts": {"gte": "2020-01-01"}}}, "size": 10},
+    )
+    assert len(out["hits"]["hits"]) == 4
+
+
+def test_rare_terms_and_mad(node):
+    node.index_doc("places", {"tag": "berlin", "v": 100.0}, "extra")
+    node.refresh("places")
+    out = node.search(
+        "places",
+        {
+            "size": 0,
+            "aggs": {
+                "rare": {"rare_terms": {"field": "tag"}},
+                "mad": {"median_absolute_deviation": {"field": "v"}},
+            },
+        },
+    )
+    rare = out["aggregations"]["rare"]["buckets"]
+    # berlin now occurs twice -> not rare; the others are singletons.
+    assert [b["key"] for b in rare] == ["london", "nyc", "paris"]
+    vals = np.array([0.0, 1.0, 2.0, 3.0, 100.0])
+    med = np.median(vals)
+    assert out["aggregations"]["mad"]["value"] == pytest.approx(
+        float(np.median(np.abs(vals - med)))
+    )
+
+
+def test_index_less_apis(node):
+    out = node.search("_all", {"query": {"match_all": {}}, "size": 0})
+    assert out["hits"]["total"]["value"] == 4
+    assert node.refresh_all()["_shards"]["failed"] == 0
+    assert set(node.get_mapping_all()) == {"places"}
+    assert node.expand_index_patterns("pla*") == ["places"]

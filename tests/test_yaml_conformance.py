@@ -1,0 +1,39 @@
+"""Pinned-green subset of the reference's YAML REST conformance suites.
+
+tests/yaml_green.json lists every (file::section) of the reference's
+rest-api-spec executable tests that this node currently passes verbatim
+through tests/yaml_runner.py (sweep the full tree with
+scripts/yaml_conformance.py). This test keeps the green set green —
+a regression here means an API-compatibility break the reference's own
+conformance suite would catch.
+"""
+
+import json
+import tempfile
+from pathlib import Path
+
+import pytest
+
+from yaml_runner import REFERENCE_TESTS, SkipTest, YamlRunner, load_suites
+
+GREEN = json.loads(
+    (Path(__file__).parent / "yaml_green.json").read_text()
+)
+
+
+@pytest.mark.parametrize("case", GREEN)
+def test_yaml_green(case):
+    if not REFERENCE_TESTS.exists():
+        pytest.skip("reference YAML suites not mounted")
+    rel, section = case.split("::", 1)
+    from elasticsearch_tpu.rest.server import RestServer
+
+    suites = load_suites(REFERENCE_TESTS / rel)
+    rest = RestServer(data_path=tempfile.mkdtemp())
+    runner = YamlRunner(rest)
+    try:
+        if "setup" in suites:
+            runner.run_steps(suites["setup"])
+        runner.run_steps(suites[section])
+    except SkipTest as e:
+        pytest.skip(str(e))

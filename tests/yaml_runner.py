@@ -1,0 +1,361 @@
+"""Runner for the reference's YAML REST conformance suites.
+
+Executes the executable API specs shipped in the reference repo
+(rest-api-spec/src/yamlRestTest/resources/rest-api-spec/test/ — the same
+files ESClientYamlSuiteTestCase runs against a live cluster) directly
+against an in-process RestServer. Each test is `setup` steps plus named
+sections of steps:
+
+    do:      invoke an API (name -> method/path from the API table below)
+    match / length / is_true / is_false / gt / gte / lt / lte: assertions
+    set:     stash a response value for later $var substitution
+    catch:   the do must fail with the given error class/regex
+
+This is the round-4 verdict's "cheapest way to find the next hundred
+compatibility gaps": tests/test_yaml_conformance.py pins a curated green
+set, and scripts/yaml_conformance.py sweeps everything for a coverage
+report.
+"""
+
+from __future__ import annotations
+
+import json
+import numbers
+import re
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+REFERENCE_TESTS = Path(
+    "/root/reference/rest-api-spec/src/yamlRestTest/resources/rest-api-spec/test"
+)
+
+
+class SkipTest(Exception):
+    pass
+
+
+class StepFailure(AssertionError):
+    pass
+
+
+# API name -> (method, path template with {param} placeholders).
+# Params not in the template become query-string params; "body" is JSON
+# (or NDJSON lines for the bulk/msearch families).
+API_TABLE: dict[str, tuple[str, str]] = {
+    "indices.create": ("PUT", "/{index}"),
+    "indices.delete": ("DELETE", "/{index}"),
+    "indices.get": ("GET", "/{index}"),
+    "indices.exists": ("HEAD", "/{index}"),
+    "indices.refresh": ("POST", "/{index}/_refresh"),
+    "indices.flush": ("POST", "/{index}/_flush"),
+    "indices.forcemerge": ("POST", "/{index}/_forcemerge"),
+    "indices.get_mapping": ("GET", "/{index}/_mapping"),
+    "indices.put_mapping": ("PUT", "/{index}/_mapping"),
+    "indices.get_settings": ("GET", "/{index}/_settings"),
+    "indices.put_settings": ("PUT", "/{index}/_settings"),
+    "indices.get_alias": ("GET", "/{index}/_alias"),
+    "indices.put_alias": ("PUT", "/{index}/_alias/{name}"),
+    "indices.delete_alias": ("DELETE", "/{index}/_alias/{name}"),
+    "indices.update_aliases": ("POST", "/_aliases"),
+    "indices.put_index_template": ("PUT", "/_index_template/{name}"),
+    "indices.get_index_template": ("GET", "/_index_template/{name}"),
+    "indices.delete_index_template": ("DELETE", "/_index_template/{name}"),
+    "indices.analyze": ("POST", "/{index}/_analyze"),
+    "index": ("PUT", "/{index}/_doc/{id}"),
+    "create": ("PUT", "/{index}/_create/{id}"),
+    "get": ("GET", "/{index}/_doc/{id}"),
+    "delete": ("DELETE", "/{index}/_doc/{id}"),
+    "update": ("POST", "/{index}/_update/{id}"),
+    "bulk": ("POST", "/{index}/_bulk"),
+    "mget": ("POST", "/{index}/_mget"),
+    "search": ("POST", "/{index}/_search"),
+    "count": ("POST", "/{index}/_count"),
+    "msearch": ("POST", "/{index}/_msearch"),
+    "explain": ("POST", "/{index}/_explain/{id}"),
+    "scroll": ("POST", "/_search/scroll"),
+    "clear_scroll": ("DELETE", "/_search/scroll"),
+    "delete_by_query": ("POST", "/{index}/_delete_by_query"),
+    "update_by_query": ("POST", "/{index}/_update_by_query"),
+    "reindex": ("POST", "/_reindex"),
+    "put_script": ("PUT", "/_scripts/{id}"),
+    "get_script": ("GET", "/_scripts/{id}"),
+    "delete_script": ("DELETE", "/_scripts/{id}"),
+    "render_search_template": ("POST", "/_render/template"),
+    "search_template": ("POST", "/{index}/_search/template"),
+    "cluster.health": ("GET", "/_cluster/health"),
+    "cluster.stats": ("GET", "/_cluster/stats"),
+    "nodes.info": ("GET", "/_nodes"),
+    "cat.count": ("GET", "/_cat/count/{index}"),
+    "cat.indices": ("GET", "/_cat/indices"),
+    "ingest.put_pipeline": ("PUT", "/_ingest/pipeline/{id}"),
+    "ingest.get_pipeline": ("GET", "/_ingest/pipeline/{id}"),
+    "ingest.delete_pipeline": ("DELETE", "/_ingest/pipeline/{id}"),
+    "ingest.simulate": ("POST", "/_ingest/pipeline/_simulate"),
+    "rank_eval": ("POST", "/{index}/_rank_eval"),
+    "tasks.list": ("GET", "/_tasks"),
+    "snapshot.create_repository": ("PUT", "/_snapshot/{repository}"),
+    "snapshot.create": ("PUT", "/_snapshot/{repository}/{snapshot}"),
+    "snapshot.get": ("GET", "/_snapshot/{repository}/{snapshot}"),
+    "snapshot.restore": (
+        "POST", "/_snapshot/{repository}/{snapshot}/_restore",
+    ),
+}
+
+_CATCH_STATUS = {
+    "bad_request": 400,
+    "missing": 404,
+    "conflict": 409,
+    "forbidden": 403,
+    "unauthorized": 401,
+    "request_timeout": 408,
+}
+
+
+def load_suites(path: Path) -> dict[str, list[dict]]:
+    """{section name: steps}, with 'setup'/'teardown' kept separate."""
+    docs = list(yaml.safe_load_all(path.read_text()))
+    suites: dict[str, list[dict]] = {}
+    for doc in docs:
+        if not isinstance(doc, dict):
+            continue
+        for name, steps in doc.items():
+            suites[name] = steps or []
+    return suites
+
+
+class YamlRunner:
+    """Executes one test section (plus its file's setup) via dispatch()."""
+
+    def __init__(self, rest):
+        self.rest = rest
+        self.stash: dict[str, Any] = {}
+        self.last: Any = None
+        self.last_status: int = 0
+
+    # ---------------------------------------------------------- resolution
+
+    def _sub(self, value):
+        if isinstance(value, str):
+            if value.startswith("$"):
+                key = value[1:]
+                if key == "body":
+                    return self.last
+                if key in self.stash:
+                    return self.stash[key]
+            return value
+        if isinstance(value, dict):
+            return {k: self._sub(v) for k, v in value.items()}
+        if isinstance(value, list):
+            return [self._sub(v) for v in value]
+        return value
+
+    def _navigate(self, path: str):
+        """Resolve a dotted response path ('hits.total.value', escaped
+        dots with backslash, integer list indexes)."""
+        if path == "$body":
+            return self.last
+        cur = self.last
+        parts = re.split(r"(?<!\\)\.", path)
+        for raw in parts:
+            part = raw.replace("\\.", ".")
+            if part.startswith("$"):
+                part = str(self._sub(part))
+            if isinstance(cur, list):
+                cur = cur[int(part)]
+            elif isinstance(cur, dict):
+                if part not in cur:
+                    raise StepFailure(
+                        f"response has no [{path}] (missing [{part}]); "
+                        f"got keys {sorted(cur)[:20]}"
+                    )
+                cur = cur[part]
+            else:
+                raise StepFailure(
+                    f"cannot navigate [{part}] of non-container {cur!r}"
+                )
+        return cur
+
+    # ------------------------------------------------------------ steps
+
+    def run_steps(self, steps: list[dict]) -> None:
+        for step in steps or []:
+            ((kind, payload),) = step.items()
+            handler = getattr(self, f"_step_{kind}", None)
+            if handler is None:
+                raise SkipTest(f"unsupported step kind [{kind}]")
+            handler(payload)
+
+    def _step_skip(self, payload) -> None:
+        # Version ranges target real ES releases; feature flags describe
+        # client capabilities. Headers/warnings features are harmless to
+        # run without; anything else skips.
+        features = payload.get("features") or []
+        if isinstance(features, str):
+            features = [features]
+        harmless = {"headers", "allowed_warnings", "warnings",
+                    "contains", "close_to", "arbitrary_key"}
+        rest = [f for f in features if f not in harmless]
+        if rest:
+            raise SkipTest(f"requires features {rest}")
+
+    def _step_do(self, payload) -> None:
+        payload = dict(payload)
+        payload.pop("headers", None)
+        payload.pop("allowed_warnings", None)
+        payload.pop("warnings", None)
+        catch = payload.pop("catch", None)
+        ((api, params),) = payload.items()
+        if api not in API_TABLE:
+            raise SkipTest(f"API [{api}] not in the runner table")
+        params = dict(self._sub(params or {}))
+        body = params.pop("body", None)
+        method, template = API_TABLE[api]
+        if api == "index" and "id" not in params:
+            method, template = "POST", "/{index}/_doc"
+        path = template
+        for name in re.findall(r"\{(\w+)\}", template):
+            if name not in params:
+                # Optional path params: trim the trailing segment.
+                path = path.replace("/{" + name + "}", "")
+                continue
+            value = params.pop(name)
+            if isinstance(value, list):  # multi-index targets join as csv
+                value = ",".join(str(v) for v in value)
+            path = path.replace("{" + name + "}", str(value))
+        query = {
+            k: (json.dumps(v) if isinstance(v, bool) else str(v))
+            for k, v in params.items()
+        }
+        # bool query params arrive lowercase like on the wire
+        query = {k: v.lower() if v in ("True", "False") else v
+                 for k, v in query.items()}
+        if isinstance(body, list):  # bulk/msearch NDJSON
+            raw = "\n".join(
+                line if isinstance(line, str) else json.dumps(line)
+                for line in body
+            ) + "\n"
+        elif body is None:
+            raw = ""
+        elif isinstance(body, str):
+            raw = body
+        else:
+            raw = json.dumps(body)
+        status, response = self.rest.dispatch(method, path, query, raw)
+        self.last, self.last_status = response, status
+        if catch is not None:
+            want = _CATCH_STATUS.get(catch)
+            if catch.startswith("/") and catch.endswith("/"):
+                if status < 400:
+                    raise StepFailure(
+                        f"expected an error matching {catch}, got {status}"
+                    )
+                if not re.search(catch[1:-1], json.dumps(response)):
+                    raise StepFailure(
+                        f"error {response} does not match {catch}"
+                    )
+            elif catch in ("request", "param"):
+                if status < 400:
+                    raise StepFailure(
+                        f"expected a request error, got {status}"
+                    )
+            elif want is not None and status != want:
+                raise StepFailure(
+                    f"expected catch [{catch}] ({want}), got {status}: "
+                    f"{response}"
+                )
+            return
+        if status >= 400:
+            raise StepFailure(f"[{api}] failed with {status}: {response}")
+
+    def _step_match(self, payload) -> None:
+        for path, expected in payload.items():
+            actual = self._navigate(path)
+            expected = self._sub(expected)
+            if (
+                isinstance(expected, str)
+                and len(expected) > 1
+                and expected.startswith("/")
+                and expected.rstrip().endswith("/")
+            ):
+                pattern = expected.strip().strip("/")
+                if not re.search(
+                    pattern, str(actual), re.VERBOSE | re.DOTALL
+                ):
+                    raise StepFailure(
+                        f"[{path}]: {actual!r} !~ /{pattern}/"
+                    )
+                continue
+            if (
+                isinstance(expected, numbers.Number)
+                and isinstance(actual, dict)
+                and set(actual) == {"value", "relation"}
+            ):
+                # Pre-7 suites say `hits.total: N`; modern responses are
+                # {value, relation} (the rest_total_hits_as_int shim).
+                actual = actual["value"]
+            if isinstance(expected, numbers.Number) and isinstance(
+                actual, numbers.Number
+            ):
+                if float(actual) != float(expected):
+                    raise StepFailure(
+                        f"[{path}]: {actual!r} != {expected!r}"
+                    )
+                continue
+            if actual != expected:
+                raise StepFailure(f"[{path}]: {actual!r} != {expected!r}")
+
+    def _step_set(self, payload) -> None:
+        for path, var in payload.items():
+            self.stash[var] = self._navigate(path)
+
+    def _step_length(self, payload) -> None:
+        for path, expected in payload.items():
+            actual = self._navigate(path)
+            if len(actual) != int(self._sub(expected)):
+                raise StepFailure(
+                    f"[{path}]: len {len(actual)} != {expected}"
+                )
+
+    def _step_is_true(self, payload) -> None:
+        value = self._navigate(payload)
+        if value in (None, False, "", 0, [], {}):
+            raise StepFailure(f"[{payload}] is not true: {value!r}")
+
+    def _step_is_false(self, payload) -> None:
+        try:
+            value = self._navigate(payload)
+        except StepFailure:
+            return  # absent counts as false
+        if value not in (None, False, "", 0, [], {}):
+            raise StepFailure(f"[{payload}] is not false: {value!r}")
+
+    def _cmp(self, payload, op, name) -> None:
+        for path, expected in payload.items():
+            actual = self._navigate(path)
+            if not op(float(actual), float(self._sub(expected))):
+                raise StepFailure(f"[{path}]: !({actual} {name} {expected})")
+
+    def _step_gt(self, p) -> None:
+        self._cmp(p, lambda a, b: a > b, ">")
+
+    def _step_gte(self, p) -> None:
+        self._cmp(p, lambda a, b: a >= b, ">=")
+
+    def _step_lt(self, p) -> None:
+        self._cmp(p, lambda a, b: a < b, "<")
+
+    def _step_lte(self, p) -> None:
+        self._cmp(p, lambda a, b: a <= b, "<=")
+
+
+def run_section(rest, path: Path, section: str) -> None:
+    """Run one named section (with the file's setup first)."""
+    suites = load_suites(path)
+    if section not in suites:
+        raise KeyError(f"{path} has no section [{section}]")
+    runner = YamlRunner(rest)
+    if "setup" in suites:
+        runner.run_steps(suites["setup"])
+    runner.run_steps(suites[section])
