@@ -315,6 +315,7 @@ class Node:
             mappings = Mappings.from_json(mappings_json, analysis=registry)
         except ValueError as e:
             raise ApiError(400, "mapper_parsing_exception", str(e)) from None
+        settings = self._normalize_index_settings(settings)
         durability = (
             settings.get("index", {}).get("translog", {}).get(
                 "durability", "request"
@@ -1097,6 +1098,8 @@ class Node:
             ((op, meta),) = action_line.items()
             index = meta.get("_index", default_index)
             doc_id = meta.get("_id")
+            if doc_id is not None:
+                doc_id = str(doc_id)  # ES coerces numeric _ids to strings
             i += 1
             try:
                 if op in ("index", "create"):
@@ -1975,14 +1978,58 @@ class Node:
 
     # ------------------------------------------------------------- settings
 
+    @staticmethod
+    def _normalize_index_settings(raw: dict) -> dict:
+        """Accept every settings spelling the reference does — nested
+        ({"index": {"number_of_shards": 5}}), flat ({"number_of_shards":
+        5}), and dotted ({"index.number_of_shards": 5}) — normalized to
+        the nested-under-"index" form the node reads."""
+        flat: dict[str, Any] = {}
+
+        def walk(prefix: str, val) -> None:
+            if isinstance(val, dict) and val:
+                for k, v in val.items():
+                    walk(f"{prefix}.{k}" if prefix else str(k), v)
+            else:
+                flat[prefix] = val
+
+        walk("", raw or {})
+        out: dict[str, Any] = {}
+        for key, val in flat.items():
+            parts = key.split(".")
+            if parts[0] != "index":
+                parts = ["index"] + parts
+            cur = out
+            for p in parts[:-1]:
+                cur = cur.setdefault(p, {})
+            cur[parts[-1]] = val
+        # analysis is consumed from the top level too; mirror it there.
+        if "analysis" in out.get("index", {}):
+            out.setdefault("analysis", out["index"]["analysis"])
+        return out
+
+    @staticmethod
+    def _stringify_settings(obj):
+        """GET-settings values serialize as strings (the reference's
+        Settings x-content form: every leaf is a string)."""
+        if isinstance(obj, dict):
+            return {k: Node._stringify_settings(v) for k, v in obj.items()}
+        if isinstance(obj, bool):
+            return "true" if obj else "false"
+        if isinstance(obj, (int, float)):
+            return str(obj)
+        return obj
+
     def get_settings(self, index: str) -> dict:
         svc = self.get_index(index)
         merged = dict(svc.settings)
         idx = dict(merged.get("index", {}))
         idx.setdefault("number_of_shards", svc.n_shards)
+        idx.setdefault("number_of_replicas", 0)
         idx["uuid"] = svc.uuid
+        idx["provided_name"] = svc.name
         merged["index"] = idx
-        return {svc.name: {"settings": merged}}
+        return {svc.name: {"settings": self._stringify_settings(merged)}}
 
     # Every entry here is READ somewhere: acknowledging a setting nothing
     # consumes would be a silent no-op.
@@ -2393,6 +2440,10 @@ class Node:
             "relocating_shards": 0,
             "initializing_shards": 0,
             "unassigned_shards": 0,
+            "delayed_unassigned_shards": 0,
+            "number_of_pending_tasks": 0,
+            "number_of_in_flight_fetch": 0,
+            "task_max_waiting_in_queue_millis": 0,
             "active_shards_percent_as_number": 100.0,
         }
 

@@ -750,12 +750,106 @@ SPARSE_TPAD_MAX = 32
 
 def supports_sparse(spec) -> bool:
     """Sparse execution covers precomputed-impact term disjunctions with a
-    bounded run-fold length (wider disjunctions route to the dense kernel)."""
-    return spec[0] == "terms" and spec[3] <= SPARSE_TPAD_MAX
+    bounded run-fold length (wider disjunctions route to the dense kernel),
+    and bool conjunctions of one such disjunction with constant-score term
+    filters/exclusions — the BASELINE config-3 shape. Candidate-centric
+    execution beats the dense path because top-k runs over the candidate
+    worklist, never over a [num_docs] plane."""
+    if spec[0] == "terms":
+        return spec[3] <= SPARSE_TPAD_MAX
+    if spec[0] == "bool":
+        _, must_s, should_s, filter_s, must_not_s, _msm = spec
+        return (
+            len(must_s) == 1
+            and must_s[0][0] == "terms"
+            and must_s[0][3] <= SPARSE_TPAD_MAX
+            and not should_s
+            and all(c[0] == "terms_const" for c in filter_s)
+            and all(c[0] == "terms_const" for c in must_not_s)
+        )
+    return False
 
 
 def _sparse_inner(seg, spec, arrays, k: int):
-    """Candidate-centric top-k for a ("terms", field, NT, TP) spec."""
+    """Candidate-centric top-k for a supports_sparse spec."""
+    if spec[0] == "bool":
+        return _sparse_bool_inner(seg, spec, arrays, k)
+    return _sparse_terms_inner(seg, spec, arrays, k)
+
+
+def _sparse_bool_inner(seg, spec, arrays, k: int):
+    """bool(must=[terms], filter/must_not=[terms_const...]) without any
+    [num_docs]-sized score plane or dense top-k: candidates come from the
+    must disjunction's worklist fold, and each filter/exclusion becomes a
+    presence bitmap (one bool scatter over its own postings) gathered at
+    the candidate docs. The dense path's lax.top_k over [N] — the
+    dominant cost at shard scale — disappears; this is the config-3
+    conjunction shape (BooleanQuery with required + filter clauses,
+    ContextIndexSearcher.java:170-206)."""
+    _, must_s, _should_s, filter_s, must_not_s, _msm = spec
+    children = arrays["children"]
+    live = seg["live"]
+    num_docs = live.shape[0]
+    (
+        docs_s,
+        run_sum,
+        eligible,
+        p,
+        kk,
+    ) = _sparse_candidates(seg, must_s[0], children[0], k)
+    sentinel = jnp.int32(num_docs)
+    safe_docs = jnp.minimum(docs_s, sentinel - 1)
+
+    def membership(child_spec, carr):
+        if len(child_spec) == 4 and child_spec[3] == 1:
+            # Single contiguous posting span: binary-search the candidates
+            # against the field's sorted postings plane — O(P log df), no
+            # [N]-sized scatter.
+            return _span_member(
+                seg, child_spec[1], carr["span_start"], carr["span_end"],
+                safe_docs,
+            )
+        return _terms_matched(child_spec, carr, seg, num_docs)[safe_docs]
+
+    for idx_child, child_spec in enumerate(filter_s):
+        eligible &= membership(child_spec, children[1 + idx_child])
+    base = 1 + len(filter_s)
+    for idx_child, child_spec in enumerate(must_not_s):
+        eligible &= ~membership(child_spec, children[base + idx_child])
+    scores = run_sum * arrays["boost"]
+    key = jnp.where(eligible, scores, jnp.float32(NEG_INF))
+    kp = min(kk, p)
+    top_scores, top_pos = jax.lax.top_k(key, kp)
+    top_ids = docs_s[top_pos]
+    if kp < kk:
+        top_scores = jnp.pad(top_scores, (0, kk - kp), constant_values=NEG_INF)
+        top_ids = jnp.pad(top_ids, (0, kk - kp), constant_values=0)
+    total = jnp.sum(eligible, dtype=jnp.int32)
+    return top_scores, top_ids.astype(jnp.int32), total
+
+
+def _span_member(seg, field_name, start, end, cands):
+    """bool[P]: is each candidate doc inside the sorted posting span
+    [start, end) of the field's flat postings plane? 21 static
+    binary-search steps (spans cannot exceed one term's df <= num_docs),
+    all vectorized gathers — the scatter-free filter membership test."""
+    flat = seg["fields"][field_name][0].reshape(-1)
+    p = cands.shape[0]
+    lo = jnp.full(p, start, dtype=jnp.int32)
+    hi = jnp.full(p, end, dtype=jnp.int32)
+    limit = jnp.int32(flat.shape[0] - 1)
+    for _ in range(21):
+        mid = (lo + hi) >> 1
+        v = flat[jnp.clip(mid, 0, limit)]
+        go = v < cands
+        lo = jnp.where(go, mid + 1, lo)
+        hi = jnp.where(go, hi, mid)
+    return (lo < end) & (flat[jnp.clip(lo, 0, limit)] == cands)
+
+
+def _sparse_candidates(seg, spec, arrays, k: int):
+    """Shared candidate fold: (sorted candidate docs, left-fold run sums,
+    run-head eligibility, P, clamped k) for a terms spec."""
     live = seg["live"]
     num_docs = live.shape[0]
     t_pad = spec[3]
@@ -763,15 +857,12 @@ def _sparse_inner(seg, spec, arrays, k: int):
     w = arrays["weights"][:, None]
     contrib = w - w / (jnp.float32(1.0) + tn)
     sentinel = jnp.int32(num_docs)
-    docs = jnp.where(valid, docs, sentinel).reshape(-1)  # [P]
+    docs = jnp.where(valid, docs, sentinel).reshape(-1)
     contrib = jnp.where(valid, contrib, jnp.float32(0.0)).reshape(-1)
     p = docs.shape[0]
     docs_s, contrib_s = jax.lax.sort(
         (docs, contrib), num_keys=1, is_stable=True
     )
-    # Left-fold run sums via static shifts: run length <= total query-term
-    # occurrences (a doc appears in exactly one tile per term occurrence),
-    # bounded by the spec's T_pad bucket.
     docs_ext = jnp.concatenate(
         [docs_s, jnp.full(t_pad, num_docs + 1, dtype=docs_s.dtype)]
     )
@@ -788,11 +879,22 @@ def _sparse_inner(seg, spec, arrays, k: int):
         [jnp.ones(1, dtype=bool), docs_s[1:] != docs_s[:-1]]
     )
     in_range = docs_s != sentinel
-    # Clamped gather: sentinel rows are masked by in_range regardless.
     live_at = live[jnp.minimum(docs_s, sentinel - 1)]
     eligible = is_start & in_range & live_at
+    return docs_s, run_sum, eligible, p, min(k, num_docs)
+
+
+def _sparse_terms_inner(seg, spec, arrays, k: int):
+    """Candidate-centric top-k for a ("terms", field, NT, TP) spec.
+
+    Left-fold run sums via static shifts (see _sparse_candidates): run
+    length <= total query-term occurrences, bounded by the spec's T_pad
+    bucket; top-k positions ascend by doc id, so lax.top_k's lowest-index
+    tie-break IS Lucene's doc-id tie-break."""
+    docs_s, run_sum, eligible, p, kk = _sparse_candidates(
+        seg, spec, arrays, k
+    )
     key = jnp.where(eligible, run_sum, jnp.float32(NEG_INF))
-    kk = min(k, num_docs)
     kp = min(kk, p)
     top_scores, top_pos = jax.lax.top_k(key, kp)
     top_ids = docs_s[top_pos]

@@ -41,7 +41,13 @@ from ..index.mapping import Mappings
 from ..index.segment import FieldIndex, Segment, SegmentBuilder
 from ..index.tiles import TILE, pack_segment
 from ..ops.bm25 import BM25Params
-from ..ops.bm25_device import NEG_INF, _eval_node, segment_tree
+from ..ops.bm25_device import (
+    NEG_INF,
+    _eval_node,
+    _sparse_inner,
+    segment_tree,
+    supports_sparse,
+)
 from ..query.compile import (
     CompiledQuery,
     Compiler,
@@ -496,11 +502,17 @@ def sharded_execute(
         arrays = jax.tree.map(lambda x: x[0], arrays)
         live = seg["live"]
         n = live.shape[0]
-        scores, matched = _eval_node(spec, arrays, seg, n)
-        eligible = matched & live
-        masked = jnp.where(eligible, scores, jnp.float32(NEG_INF))
         kk = min(k, n)
-        local_s, local_i = jax.lax.top_k(masked, kk)
+        if supports_sparse(spec):
+            # Candidate-centric kernel: no [N] score plane, no dense
+            # top-k — the same fast path single-chip serving uses.
+            local_s, local_i, count = _sparse_inner(seg, spec, arrays, kk)
+        else:
+            scores, matched = _eval_node(spec, arrays, seg, n)
+            eligible = matched & live
+            masked = jnp.where(eligible, scores, jnp.float32(NEG_INF))
+            local_s, local_i = jax.lax.top_k(masked, kk)
+            count = jnp.sum(eligible, dtype=jnp.int32)
         shard_id = jax.lax.axis_index(axis)
         global_i = shard_id.astype(jnp.int32) * docs_per_shard + local_i.astype(
             jnp.int32
@@ -514,7 +526,7 @@ def sharded_execute(
         # min(size, total) hits; the host trims by the psum'd total).
         top_s, idx = jax.lax.top_k(flat_s, min(k, flat_s.shape[0]))
         top_i = flat_i[idx]
-        total = jax.lax.psum(jnp.sum(eligible, dtype=jnp.int32), axis)
+        total = jax.lax.psum(count, axis)
         return top_s, top_i, total
 
     return jax.shard_map(
@@ -560,6 +572,8 @@ def sharded_execute_batch(
         kk = min(k, n)
 
         def one(one_arrays):
+            if supports_sparse(spec):
+                return _sparse_inner(seg, spec, one_arrays, kk)
             scores, matched = _eval_node(spec, one_arrays, seg, n)
             eligible = matched & live
             masked = jnp.where(eligible, scores, jnp.float32(NEG_INF))

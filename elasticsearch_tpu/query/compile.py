@@ -226,9 +226,21 @@ def _terms_arrays(
         # occurrence yields at most one posting per doc), pow-2 bucketed —
         # the sparse kernel's run-fold length (ops/bm25_device.py).
         spec = (kind, dfield.name, nt, _pow2(len(terms)))
+    elif len(terms) == 1:
+        # Single-term constant filter: the spec's trailing 1 marks that
+        # the whole worklist is ONE contiguous posting span, so the
+        # sparse-bool kernel can test candidate membership with a binary
+        # search over the span instead of a dense bitmap scatter (the
+        # scatter costs ~NT*TILE updates — the dominant term for high-df
+        # filters like BASELINE config 3's).
+        spec = (kind, dfield.name, nt, 1)
     else:
         spec = (kind, dfield.name, nt)
     arrays = {"tile_ids": tile_ids, "starts": starts, "ends": ends}
+    if not scored and len(terms) == 1:
+        span = entries[0][1:3] if entries else (0, 0)
+        arrays["span_start"] = np.int32(span[0])
+        arrays["span_end"] = np.int32(span[1])
     if scored:
         arrays["weights"] = weights
         arrays["ub"] = ubs

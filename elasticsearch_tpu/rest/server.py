@@ -272,6 +272,12 @@ class RestServer:
             _json(b), refresh=q.get("refresh") in ("true", "")
         ))
         r("POST", "/{index}/_analyze", self._analyze)
+        r("POST", "/_analyze", lambda s, p, q, b: s._analyze(
+            s, {"index": None}, q, b
+        ))
+        r("GET", "/_analyze", lambda s, p, q, b: s._analyze(
+            s, {"index": None}, q, b
+        ))
         r("POST", "/{index}/_doc", lambda s, p, q, b: n.index_doc(
             p["index"], _json(b), None,
             refresh=q.get("refresh") in ("true", ""),
@@ -315,14 +321,19 @@ class RestServer:
 
     def _analyze(self, s, p, q, b):
         body = _json(b) or {}
-        svc = self.node.get_index(p["index"])
+        if p.get("index"):
+            registry = self.node.get_index(p["index"]).mappings
+        else:  # index-less /_analyze: builtin analyzers only
+            from ..index.mapping import Mappings as _Mappings
+
+            registry = _Mappings()
         analyzer_name = body.get("analyzer")
         if analyzer_name:
-            analyzer = svc.mappings.analysis.get(analyzer_name)
-        elif "field" in body:
-            analyzer = svc.mappings.analyzer_for(body["field"])
+            analyzer = registry.analysis.get(analyzer_name)
+        elif "field" in body and p.get("index"):
+            analyzer = registry.analyzer_for(body["field"])
         else:
-            analyzer = svc.mappings.analysis.get("standard")
+            analyzer = registry.analysis.get("standard")
         text = body.get("text", "")
         if isinstance(text, list):
             text = " ".join(text)

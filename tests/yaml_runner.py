@@ -162,6 +162,11 @@ class YamlRunner:
             part = raw.replace("\\.", ".")
             if part.startswith("$"):
                 part = str(self._sub(part))
+            if part == "_arbitrary_key_" and isinstance(cur, dict) and cur:
+                key = sorted(cur)[0]
+                self.stash["_arbitrary_key_"] = key
+                cur = cur[key]
+                continue
             if isinstance(cur, list):
                 cur = cur[int(part)]
             elif isinstance(cur, dict):
