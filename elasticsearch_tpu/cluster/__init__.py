@@ -6,6 +6,7 @@ from .cluster import (
     ReplicationFailedError,
     StalePrimaryTermError,
 )
+from .gateway import ReplicationGateway, ReplicationUnavailableError
 from .state import ClusterState, IndexMeta, ShardRouting
 from .transport import (
     ConnectTransportError,
@@ -23,6 +24,8 @@ __all__ = [
     "NotMasterError",
     "RemoteActionError",
     "ReplicationFailedError",
+    "ReplicationGateway",
+    "ReplicationUnavailableError",
     "ShardRouting",
     "StalePrimaryTermError",
     "TransportHub",

@@ -30,6 +30,8 @@ Simplifications vs the reference, chosen to keep the safety story intact:
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from typing import Any
@@ -63,13 +65,31 @@ class ReplicationFailedError(Exception):
 class ClusterNode:
     """One host: engines for its assigned shard copies + cluster duties."""
 
-    def __init__(self, node_id: str, hub: TransportHub, seeds: tuple[str, ...]):
+    def __init__(
+        self,
+        node_id: str,
+        hub: TransportHub,
+        seeds: tuple[str, ...],
+        state_path: str | None = None,
+    ):
         self.node_id = node_id
         self.hub = hub
         self.state = ClusterState(seed_nodes=seeds)
         self.current_term = 0  # highest term voted for / seen
+        # Durable cluster-state directory (the reference's gateway/
+        # PersistedClusterStateService): every accepted publication and
+        # vote persists {current_term, state} so a full-cluster restart
+        # recovers membership/in-sync sets/primary terms instead of
+        # re-bootstrapping empty metadata — without it, the first election
+        # after a full restart could promote a stale (empty) copy under a
+        # fresh term 1 and silently lose every index.
+        self._state_path = state_path
         self.engines: dict[tuple[str, int], Engine] = {}
         self.trackers: dict[tuple[str, int], ReplicationTracker] = {}
+        # Last-applied mappings blob per index: existing engines adopt
+        # published mapping updates (put_mapping propagation) only when
+        # the blob actually changed.
+        self._applied_mappings: dict[str, str] = {}
         self.lock = threading.RLock()
         # Serializes every master-side copy→mutate→publish sequence: the
         # stepper's health_round racing a request thread's fail_shard would
@@ -91,7 +111,66 @@ class ClusterNode:
         import uuid
 
         self.session = uuid.uuid4().hex
+        self._recover_persisted_state()
         hub.register(node_id, self._handle)
+
+    # -------------------------------------------------- state persistence
+
+    def _state_file(self) -> str | None:
+        if self._state_path is None:
+            return None
+        return os.path.join(self._state_path, f"{self.node_id}.cluster.json")
+
+    def _save_state(self) -> None:
+        """Atomically persist {current_term, state}. Caller holds either
+        self.lock or is single-threaded at boot."""
+        path = self._state_file()
+        if path is None:
+            return
+        os.makedirs(self._state_path, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "current_term": self.current_term,
+                    "state": self.state.to_json(),
+                },
+                f,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _recover_persisted_state(self) -> None:
+        """Boot recovery: adopt the persisted state and voting term, then
+        strip THIS node from every copy set — in-memory shard copies never
+        survive a restart, so any membership the old incarnation held is
+        stale by definition (the allocation-id invalidation the master's
+        session round performs for peers, done locally and immediately so
+        the window between boot and the first health round cannot ack
+        writes against an empty resurrected 'primary')."""
+        path = self._state_file()
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            recovered = ClusterState.from_json(data["state"])
+        except (json.JSONDecodeError, OSError, KeyError, TypeError, ValueError):
+            return  # broken persisted state is never boot-fatal
+        self.state = recovered
+        self.current_term = max(
+            int(data.get("current_term", 0)), recovered.term
+        )
+        for meta in self.state.indices.values():
+            for routing in meta.shards.values():
+                if routing.primary == self.node_id:
+                    routing.primary = None
+                if self.node_id in routing.replicas:
+                    routing.replicas.remove(self.node_id)
+                if self.node_id in routing.recovering:
+                    routing.recovering.remove(self.node_id)
+                routing.in_sync.discard(self.node_id)
 
     # ------------------------------------------------------------ identity
 
@@ -135,6 +214,7 @@ class ClusterNode:
                 self.state.version,
             ):
                 self.current_term = term
+                self._save_state()  # a vote must survive restarts
                 return {"granted": True}
             return {"granted": False}
 
@@ -149,6 +229,7 @@ class ClusterNode:
             self.current_term = max(self.current_term, new.term)
             self.state = new
             self._apply_assignments()
+            self._save_state()
             return {"accepted": True}
 
     # ------------------------------------------------- assignment handling
@@ -156,8 +237,18 @@ class ClusterNode:
     def _apply_assignments(self) -> None:
         """Create engines for newly assigned copies; adopt primary terms.
         Caller holds self.lock."""
+        for key in list(self.engines):
+            if key[0] not in self.state.indices:
+                # Index deleted cluster-wide: release the copy.
+                del self.engines[key]
+                self.trackers.pop(key, None)
+                self._pending_term_resync.discard(key)
+                self._applied_mappings.pop(key[0], None)
         for index, meta in self.state.indices.items():
             mappings = Mappings.from_json(meta.mappings)
+            blob = json.dumps(meta.mappings, sort_keys=True)
+            mappings_changed = self._applied_mappings.get(index) != blob
+            self._applied_mappings[index] = blob
             for shard_id, routing in meta.shards.items():
                 key = (index, shard_id)
                 involved = (
@@ -166,6 +257,14 @@ class ClusterNode:
                 )
                 if involved and key not in self.engines:
                     self.engines[key] = Engine(mappings)
+                elif mappings_changed and key in self.engines:
+                    # put_mapping propagation: existing copies adopt the
+                    # published field set in place (the Mappings object is
+                    # shared with the engine's buffers); locally-derived
+                    # dynamic fields absent from the update survive.
+                    live = self.engines[key].mappings
+                    live.fields.update(mappings.fields)
+                    live.nested.update(mappings.nested)
                 if routing.primary == self.node_id:
                     engine = self.engines[key]
                     if engine.primary_term != routing.primary_term:
@@ -286,6 +385,8 @@ class ClusterNode:
             payload.get("source"),
             op=payload["op"],
             op_type=payload.get("op_type", "index"),
+            if_seq_no=payload.get("if_seq_no"),
+            if_primary_term=payload.get("if_primary_term"),
         )
 
     def execute_write(
@@ -295,6 +396,8 @@ class ClusterNode:
         source: dict | None,
         op: str = "index",
         op_type: str = "index",
+        if_seq_no: int | None = None,
+        if_primary_term: int | None = None,
     ) -> dict:
         """Client write entry on ANY node: route to the primary, execute,
         fan out to in-sync copies, ack only when all of them applied
@@ -319,9 +422,14 @@ class ClusterNode:
                     "source": source,
                     "op": op,
                     "op_type": op_type,
+                    "if_seq_no": if_seq_no,
+                    "if_primary_term": if_primary_term,
                 },
             )
-        return self._replicate(index, shard_id, doc_id, source, op, op_type)
+        return self._replicate(
+            index, shard_id, doc_id, source, op, op_type,
+            if_seq_no=if_seq_no, if_primary_term=if_primary_term,
+        )
 
     def _replicate(
         self,
@@ -331,6 +439,8 @@ class ClusterNode:
         source: dict | None,
         op: str,
         op_type: str,
+        if_seq_no: int | None = None,
+        if_primary_term: int | None = None,
     ) -> dict:
         key = (index, shard_id)
         routing = self._routing(index, shard_id)
@@ -338,7 +448,10 @@ class ClusterNode:
         tracker = self.trackers.setdefault(key, ReplicationTracker())
         term = routing.primary_term
         if op == "index":
-            result = engine.index(source, doc_id, op_type=op_type)
+            result = engine.index(
+                source, doc_id, op_type=op_type,
+                if_seq_no=if_seq_no, if_primary_term=if_primary_term,
+            )
             rep_op = {
                 "seqno": result["_seq_no"],
                 "op": "index",
@@ -348,7 +461,9 @@ class ClusterNode:
                 "term": term,
             }
         else:
-            result = engine.delete(doc_id)
+            result = engine.delete(
+                doc_id, if_seq_no=if_seq_no, if_primary_term=if_primary_term
+            )
             if result["result"] == "not_found":
                 return result
             rep_op = {
@@ -618,7 +733,14 @@ class ClusterNode:
 
     def search(self, index: str, body: dict) -> dict:
         """Scatter to one alive copy per shard, merge like the coordinator
-        (score desc, then shard index, then per-shard rank)."""
+        (score desc, then shard index, then per-shard rank).
+
+        Shards with no reachable copy degrade to a PARTIAL result — the
+        response's `_shards.failed` reports them honestly (the reference's
+        allow_partial_search_results default) — and only an index with
+        zero reachable shards raises NoShardAvailableError. Per-shard
+        user errors (a malformed query raising remotely) re-raise: a bad
+        request must be a 400, never "0 of N shards"."""
         meta = self.state.indices.get(index)
         if meta is None:
             raise NoShardAvailableError(f"no such index [{index}]")
@@ -629,6 +751,9 @@ class ClusterNode:
         merged: list[tuple] = []
         total = 0
         max_score = None
+        successful = 0
+        failed = 0
+        last_err: Exception | None = None
         for shard_id, routing in sorted(meta.shards.items()):
             copies = [
                 n
@@ -637,7 +762,6 @@ class ClusterNode:
                 if n is not None
             ]
             resp = None
-            last_err: Exception | None = None
             for node in copies:
                 try:
                     resp = self.hub.send(
@@ -647,12 +771,16 @@ class ClusterNode:
                         {"index": index, "shard": shard_id, "body": shard_body},
                     )
                     break
-                except (ConnectTransportError, RemoteActionError) as e:
+                except RemoteActionError as e:
+                    if e.remote_type in ("ValueError", "TypeError"):
+                        raise  # request-shaped error, not a copy failure
+                    last_err = e
+                except ConnectTransportError as e:
                     last_err = e
             if resp is None:
-                raise NoShardAvailableError(
-                    f"all copies of [{index}][{shard_id}] failed: {last_err}"
-                )
+                failed += 1
+                continue
+            successful += 1
             total += resp["total"] or 0
             if resp["max_score"] is not None:
                 max_score = (
@@ -664,15 +792,25 @@ class ClusterNode:
                 score = hit["_score"]
                 sort_key = -score if score is not None else np.inf
                 merged.append((sort_key, shard_id, rank, hit))
+        if successful == 0 and failed > 0:
+            raise NoShardAvailableError(
+                f"all shards of [{index}] failed: {last_err}"
+            )
         merged.sort(key=lambda t: (t[0], t[1], t[2]))
         frm = int(body.get("from", 0))
         page = [h for _, _, _, h in merged[frm : frm + size]]
         return {
+            "_shards": {
+                "total": len(meta.shards),
+                "successful": successful,
+                "skipped": 0,
+                "failed": failed,
+            },
             "hits": {
                 "total": {"value": total, "relation": "eq"},
                 "max_score": max_score,
                 "hits": page,
-            }
+            },
         }
 
     def get_doc(self, index: str, doc_id: str) -> dict | None:
@@ -694,6 +832,52 @@ class ClusterNode:
         meta = self.state.indices[payload["index"]]
         shard_id = shard_for_id(payload["id"], meta.n_shards)
         return self.engines[(payload["index"], shard_id)].get(payload["id"])
+
+    def read_doc(self, index: str, doc_id: str) -> dict | None:
+        """Failover realtime get: the primary first, then any in-sync
+        replica (the REST router's read path — a dead or unassigned
+        primary degrades to a possibly-slightly-stale replica read instead
+        of an error, like the reference's `preference` replica reads).
+        Returns {_source, _version, _seq_no, _primary_term} or None."""
+        meta = self.state.indices.get(index)
+        if meta is None:
+            raise NoShardAvailableError(f"no such index [{index}]")
+        shard_id = shard_for_id(doc_id, meta.n_shards)
+        routing = meta.shards[shard_id]
+        candidates = [] if routing.primary is None else [routing.primary]
+        candidates += [
+            n
+            for n in routing.replicas
+            if n in routing.in_sync and n not in candidates
+        ]
+        last_err: Exception | None = None
+        for node in candidates:
+            if node == self.node_id:
+                engine = self.engines.get((index, shard_id))
+                if engine is None:
+                    continue
+                return engine.get_with_meta(doc_id)
+            try:
+                return self.hub.send(
+                    self.node_id,
+                    node,
+                    "read_doc",
+                    {"index": index, "shard": shard_id, "id": doc_id},
+                )
+            except (ConnectTransportError, RemoteActionError) as e:
+                last_err = e
+        raise NoShardAvailableError(
+            f"no readable copy of [{index}][{shard_id}]: {last_err}"
+        )
+
+    def _on_read_doc(self, from_id: str, payload: dict):
+        engine = self.engines.get((payload["index"], payload["shard"]))
+        if engine is None:
+            raise NoShardAvailableError(
+                f"[{payload['index']}][{payload['shard']}] not allocated "
+                f"on [{self.node_id}]"
+            )
+        return engine.get_with_meta(payload["id"])
 
     # ------------------------------------------------------- master duties
 
@@ -725,12 +909,14 @@ class ClusterNode:
             with self.lock:
                 self.state = new_state
                 self._apply_assignments()
+                self._save_state()
         else:
             with self.lock:  # lost the cluster: stop acting as master
                 if self.state.master == self.node_id:
                     demoted = self.state.copy()
                     demoted.master = None
                     self.state = demoted
+                    self._save_state()
         return committed
 
     def _on_fail_shard(self, from_id: str, payload: dict):
@@ -803,6 +989,34 @@ class ClusterNode:
         if not self._publish(new):
             raise ReplicationFailedError("create_index lost quorum")
         return {"acknowledged": True}
+
+    def _on_put_mappings(self, from_id: str, payload: dict):
+        """Master action: replace an index's mappings and publish, so every
+        copy's engine adopts the update (the reference's put-mapping
+        cluster-state task). Validation happened at the REST layer."""
+        with self.master_lock:
+            self._require_master()
+            name = payload["name"]
+            new = self.state.copy()
+            meta = new.indices.get(name)
+            if meta is None:
+                raise NoShardAvailableError(f"no such index [{name}]")
+            meta.mappings = payload["mappings"] or {}
+            if not self._publish(new):
+                raise ReplicationFailedError("put_mappings lost quorum")
+            return {"acknowledged": True}
+
+    def _on_delete_index(self, from_id: str, payload: dict):
+        with self.master_lock:
+            self._require_master()
+            name = payload["name"]
+            new = self.state.copy()
+            if name not in new.indices:
+                return {"acknowledged": True}
+            del new.indices[name]
+            if not self._publish(new):
+                raise ReplicationFailedError("delete_index lost quorum")
+            return {"acknowledged": True}
 
     def health_round(self) -> None:
         """Master ping round: drop dead members, promote/heal shards."""
@@ -933,6 +1147,7 @@ class ClusterNode:
                         self.current_term, peer_state.term
                     )
                     self._apply_assignments()
+                    self._save_state()
         term = self.current_term + 1
         votes = 1
         for node in sorted(reachable - {self.node_id}):
@@ -955,6 +1170,7 @@ class ClusterNode:
             return False
         with self.lock:
             self.current_term = term
+            self._save_state()  # our own vote for this term is durable too
             new = self.state.copy()
             new.term = term
             new.master = self.node_id
@@ -974,12 +1190,17 @@ class LocalCluster:
     """N in-process nodes over one interceptable hub — the test-cluster
     form of the reference's InternalTestCluster (+ MockTransportService)."""
 
-    def __init__(self, n_nodes: int = 3):
+    def __init__(self, n_nodes: int = 3, data_path: str | None = None):
         self.hub = TransportHub()
         seeds = tuple(f"node-{i}" for i in range(n_nodes))
         self.seeds = seeds
+        # Durable cluster-state root: with a data_path, every node persists
+        # accepted publications, so a new LocalCluster over the same path
+        # is a full-cluster restart that RECOVERS metadata (and refuses to
+        # promote stale copies) instead of bootstrapping empty.
+        self.data_path = data_path
         self.nodes: dict[str, ClusterNode] = {
-            node_id: ClusterNode(node_id, self.hub, seeds)
+            node_id: ClusterNode(node_id, self.hub, seeds, state_path=data_path)
             for node_id in seeds
         }
         self._stepper: threading.Thread | None = None
@@ -1038,8 +1259,12 @@ class LocalCluster:
 
     def restart(self, node_id: str) -> ClusterNode:
         """Bring a node back empty (in-memory copies are lost; it rejoins
-        and re-acquires shard copies via peer recovery)."""
-        node = ClusterNode(node_id, self.hub, self.seeds)
+        and re-acquires shard copies via peer recovery). With a data_path
+        the node boots from its persisted cluster state — metadata intact,
+        its own stale copy memberships already stripped."""
+        node = ClusterNode(
+            node_id, self.hub, self.seeds, state_path=self.data_path
+        )
         self.nodes[node_id] = node
         return node
 

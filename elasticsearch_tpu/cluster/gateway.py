@@ -1,0 +1,319 @@
+"""Replication gateway: the REST serving path's entry into the cluster.
+
+The bridge between the coordinating REST node (node.py / rest/server.py)
+and the host replication layer (cluster.py) — the role the reference's
+TransportReplicationAction plays between a RestHandler and
+ReplicationOperation: pick a coordinating node, route the operation to the
+shard's primary, and RETRY with bounded backoff when the topology is in
+flux (primary died mid-operation, master election in progress, replica
+being failed out) instead of surfacing a transient error to the client.
+
+Retry policy:
+
+- Only topology-shaped failures retry: unreachable peers, unassigned
+  shards, no/stale master, a primary deposed mid-operation. User-shaped
+  failures (mapping errors, version conflicts) surface immediately.
+- Every retry first drives one control-plane round (`LocalCluster.step`)
+  so failure detection → promotion → healing makes progress even when no
+  background stepper is running, then backs off exponentially (base 20ms,
+  capped) up to `max_retries` attempts within `timeout_s` per request.
+- When every retry is exhausted the caller gets
+  ReplicationUnavailableError — the REST layer maps it to 503, the shape
+  the reference uses for unavailable shards.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .cluster import (
+    ClusterNode,
+    LocalCluster,
+    NoShardAvailableError,
+    NotMasterError,
+    ReplicationFailedError,
+    StalePrimaryTermError,
+)
+from .transport import ConnectTransportError, RemoteActionError
+
+# Remote exception type names that mean "the topology moved under the
+# operation" — safe to retry after a control-plane round. KeyError covers
+# the assignment race where a freshly-published routing reached the
+# primary before its engine map caught up.
+_RETRYABLE_REMOTE_TYPES = {
+    "ConnectTransportError",
+    "NoShardAvailableError",
+    "NotMasterError",
+    "StalePrimaryTermError",
+    "ReplicationFailedError",
+    "KeyError",
+}
+
+_RETRYABLE_LOCAL_TYPES = (
+    ConnectTransportError,
+    NoShardAvailableError,
+    NotMasterError,
+    StalePrimaryTermError,
+    ReplicationFailedError,
+    KeyError,
+)
+
+
+class ReplicationUnavailableError(Exception):
+    """Retries exhausted: no healthy primary/copy within the timeout."""
+
+
+class ReplicationGateway:
+    """Failover-aware client over a LocalCluster for the REST node."""
+
+    def __init__(
+        self,
+        cluster: LocalCluster,
+        preferred_node: str | None = None,
+        timeout_s: float = 10.0,
+        max_retries: int = 8,
+        backoff_base_s: float = 0.02,
+        backoff_max_s: float = 0.5,
+    ):
+        self.cluster = cluster
+        self.preferred_node = preferred_node
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self._stats_lock = threading.Lock()
+        self._counters = {
+            "writes": 0,
+            "reads": 0,
+            "searches": 0,
+            "retries": 0,
+            "coordinator_failovers": 0,
+            "unavailable": 0,
+        }
+
+    # ------------------------------------------------------------ plumbing
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def coordinator(self) -> ClusterNode:
+        """The preferred coordinating node when alive, else ANY live node
+        (the REST router's node-level failover)."""
+        if self.preferred_node is not None:
+            node = self.cluster.nodes.get(self.preferred_node)
+            if node is not None and not node.closed:
+                return node
+            self._count("coordinator_failovers")
+        return self.cluster.any_node()
+
+    def _retryable(self, e: Exception) -> bool:
+        if isinstance(e, _RETRYABLE_LOCAL_TYPES):
+            return True
+        return (
+            isinstance(e, RemoteActionError)
+            and e.remote_type in _RETRYABLE_REMOTE_TYPES
+        )
+
+    def _run(self, op_name: str, fn, timeout_s: float | None = None):
+        """Run fn(coordinator) with bounded retry-with-backoff, driving a
+        control-plane round between attempts so promotion can happen."""
+        timeout_s = self.timeout_s if timeout_s is None else timeout_s
+        deadline = time.monotonic() + timeout_s
+        attempt = 0
+        while True:
+            try:
+                try:
+                    node = self.coordinator()
+                except RuntimeError as e:  # every node dead: nothing to retry
+                    self._count("unavailable")
+                    raise ReplicationUnavailableError(str(e)) from e
+                return fn(node)
+            except Exception as e:
+                if not self._retryable(e):
+                    raise
+                attempt += 1
+                self._count("retries")
+                if attempt > self.max_retries or time.monotonic() >= deadline:
+                    self._count("unavailable")
+                    raise ReplicationUnavailableError(
+                        f"[{op_name}] failed after {attempt} attempts "
+                        f"within {timeout_s}s: {e}"
+                    ) from e
+                try:
+                    # Failure detection + election + promotion + healing:
+                    # the reason the NEXT attempt can succeed.
+                    self.cluster.step()
+                except Exception:
+                    pass
+                delay = min(
+                    self.backoff_base_s * (2 ** (attempt - 1)),
+                    self.backoff_max_s,
+                    max(0.0, deadline - time.monotonic()),
+                )
+                if delay > 0:
+                    time.sleep(delay)
+
+    # ------------------------------------------------------------- client
+
+    def write(
+        self,
+        index: str,
+        doc_id: str,
+        source: dict | None,
+        op: str = "index",
+        op_type: str = "index",
+        if_seq_no: int | None = None,
+        if_primary_term: int | None = None,
+        timeout_s: float | None = None,
+    ) -> dict:
+        """Replicated write: acked only after every in-sync copy applied.
+        Retries across primary promotion — an op the dead primary never
+        acked re-executes against the promoted one.
+
+        Delivery is at-least-once: a retried attempt can observe its OWN
+        earlier partial apply (the failure hit after the primary indexed
+        but before the ack chain completed). Plain index ops re-apply
+        idempotently; op_type=create and CAS writes may then report 409
+        for an operation that did take effect — the same ambiguity the
+        reference documents for client retries after failover."""
+        self._count("writes")
+        return self._run(
+            f"{op}:{index}/{doc_id}",
+            lambda node: node.execute_write(
+                index,
+                doc_id,
+                source,
+                op=op,
+                op_type=op_type,
+                if_seq_no=if_seq_no,
+                if_primary_term=if_primary_term,
+            ),
+            timeout_s=timeout_s,
+        )
+
+    def read(
+        self, index: str, doc_id: str, timeout_s: float | None = None
+    ) -> dict | None:
+        """Failover realtime get (primary, then in-sync replicas)."""
+        self._count("reads")
+        return self._run(
+            f"get:{index}/{doc_id}",
+            lambda node: node.read_doc(index, doc_id),
+            timeout_s=timeout_s,
+        )
+
+    def search(
+        self, index: str, body: dict, timeout_s: float | None = None
+    ) -> dict:
+        """Scatter/merge search over one live copy per shard; partial
+        results carry honest `_shards.failed` counts."""
+        self._count("searches")
+        return self._run(
+            f"search:{index}",
+            lambda node: node.search(index, body),
+            timeout_s=timeout_s,
+        )
+
+    def create_index(
+        self,
+        name: str,
+        n_shards: int = 1,
+        n_replicas: int = 1,
+        mappings: dict | None = None,
+        timeout_s: float | None = None,
+    ) -> dict:
+        def fn(node: ClusterNode) -> dict:
+            master = self.cluster.master()
+            if master is None:
+                raise NotMasterError("no elected master")
+            return master._on_create_index(
+                "rest-gateway",
+                {
+                    "name": name,
+                    "n_shards": n_shards,
+                    "n_replicas": n_replicas,
+                    "mappings": mappings or {},
+                },
+            )
+
+        return self._run(f"create_index:{name}", fn, timeout_s=timeout_s)
+
+    def put_mappings(
+        self,
+        name: str,
+        mappings: dict,
+        timeout_s: float | None = None,
+    ) -> dict:
+        """Publish a mapping update so every copy's engine adopts it —
+        without this, explicit put_mapping would only change the REST
+        node's view while the serving engines kept the creation-time
+        mappings."""
+
+        def fn(node: ClusterNode) -> dict:
+            master = self.cluster.master()
+            if master is None:
+                raise NotMasterError("no elected master")
+            return master._on_put_mappings(
+                "rest-gateway", {"name": name, "mappings": mappings}
+            )
+
+        return self._run(f"put_mappings:{name}", fn, timeout_s=timeout_s)
+
+    def delete_index(self, name: str, timeout_s: float | None = None) -> dict:
+        def fn(node: ClusterNode) -> dict:
+            master = self.cluster.master()
+            if master is None:
+                raise NotMasterError("no elected master")
+            return master._on_delete_index("rest-gateway", {"name": name})
+
+        return self._run(f"delete_index:{name}", fn, timeout_s=timeout_s)
+
+    def refresh(self, index: str) -> None:
+        """Refresh every live copy's engine (in-process reach — the admin
+        analog of the reference's broadcast refresh)."""
+        for node in self.cluster.nodes.values():
+            if node.closed:
+                continue
+            for (idx, _shard), engine in list(node.engines.items()):
+                if idx == index:
+                    engine.refresh()
+
+    def num_docs(self, index: str) -> int:
+        """Primary-side doc count across shards (cat/stats APIs)."""
+        try:
+            node = self.coordinator()
+        except RuntimeError:
+            return 0
+        meta = node.state.indices.get(index)
+        if meta is None:
+            return 0
+        total = 0
+        for shard_id, routing in meta.shards.items():
+            if routing.primary is None:
+                continue
+            holder = self.cluster.nodes.get(routing.primary)
+            if holder is None or holder.closed:
+                continue
+            engine = holder.engines.get((index, shard_id))
+            if engine is not None:
+                total += engine.num_docs
+        return total
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            counters = dict(self._counters)
+        alive = [
+            n.node_id for n in self.cluster.nodes.values() if not n.closed
+        ]
+        master = self.cluster.master()
+        return {
+            **counters,
+            "nodes": sorted(self.cluster.nodes),
+            "alive_nodes": sorted(alive),
+            "master": None if master is None else master.node_id,
+        }
+
+    def close(self) -> None:
+        self.cluster.close()

@@ -406,30 +406,45 @@ class Mappings:
                 return mapping
         return None
 
-    def resolve_dynamic(self, name: str, value: Any) -> FieldMapping | None:
-        """Map an unseen field from a concrete JSON value (or return None)."""
+    def resolve_dynamic(
+        self,
+        name: str,
+        value: Any,
+        stage: dict[str, "FieldMapping"] | None = None,
+    ) -> FieldMapping | None:
+        """Map an unseen field from a concrete JSON value (or return None).
+
+        With `stage`, freshly-derived mappings are written THERE instead of
+        into self.fields: the document-staging pass resolves against
+        (committed mappings + stage) and the caller commits the stage only
+        together with the document — a rejected doc leaves no ghost
+        mappings behind (the reference applies dynamic-mapping updates via
+        the master only after the doc parsed successfully)."""
         existing = self.get(name)  # incl. multi-field sub-paths: a literal
         if existing is not None:  # dotted key must not shadow "<f>.<sub>"
             return existing
+        if stage is not None and name in stage:
+            return stage[name]
+        target = self.fields if stage is None else stage
         if not self.dynamic:
             return None
         rule_mapping = self._match_dynamic_template(name, value)
         if rule_mapping is not None:
             fm = self._parse_field(name, rule_mapping)
-            self.fields[name] = fm
+            target[name] = fm
             return fm
         if isinstance(value, dict):
             # Dynamic objects map like the reference's ObjectMapper: the
             # parent registers as `object`, leaves flatten to dotted paths
             # (the builder recurses and resolves each leaf separately).
             fm = FieldMapping(name=name, type=OBJECT, properties={})
-            self.fields[name] = fm
+            target[name] = fm
             return fm
         if isinstance(value, list) and value and isinstance(value[0], dict):
             # Arrays of objects without a nested mapping FLATTEN (the
             # documented reference behavior): same object treatment.
             fm = FieldMapping(name=name, type=OBJECT, properties={})
-            self.fields[name] = fm
+            target[name] = fm
             return fm
         if isinstance(value, bool):
             ftype = BOOLEAN
@@ -464,7 +479,7 @@ class Mappings:
             )
         else:
             fm = FieldMapping(name=name, type=ftype)
-        self.fields[name] = fm
+        target[name] = fm
         return fm
 
     def analyzer_for(self, name: str, search: bool = False):

@@ -342,7 +342,10 @@ class SegmentBuilder:
                 )
             staged_vectors.append((field_name, vec))
         elif fm.is_inverted:
-            analyzer = self.mappings.analyzer_for(field_name)
+            # The fm in hand may still be STAGED (dynamic mapping not yet
+            # committed), so resolve its analyzer directly rather than by
+            # name through the committed mappings.
+            analyzer = self.mappings.analysis.get(fm.analyzer)
             # Keyword fields index without positions (index_options=docs,
             # the reference's KeywordFieldMapper default); text fields
             # record per-occurrence positions for phrase queries.
@@ -404,14 +407,16 @@ class SegmentBuilder:
         value: Any,
         flat: dict[str, tuple[Any, list[Any]]],
         nested_ops: list[tuple[str, dict[str, Any]]],
+        staged_mappings: dict[str, Any],
     ) -> None:
         """Flatten one source entry into leaf (field -> values) pairs.
 
         Objects flatten to dotted paths and arrays of objects merge their
         leaves as multi-values (the reference's ObjectMapper/DocumentParser
         behavior); values under a `nested`-mapped path route to nested_ops
-        instead, one hidden sub-document per object."""
-        fm = self.mappings.resolve_dynamic(prefix, value)
+        instead, one hidden sub-document per object. New dynamic mappings
+        land in `staged_mappings`, committed only with the doc."""
+        fm = self.mappings.resolve_dynamic(prefix, value, staged_mappings)
         if fm is not None and fm.type == NESTED:
             for obj in value if isinstance(value, list) else [value]:
                 if not isinstance(obj, dict):
@@ -444,10 +449,10 @@ class SegmentBuilder:
                 )
             for k, v in value.items():
                 leaf = f"{prefix}.{k}"
-                leaf_fm = self.mappings.get(leaf)
+                leaf_fm = self.mappings.get(leaf) or staged_mappings.get(leaf)
                 if leaf_fm is None:
                     leaf_fm = FieldMapping(name=leaf, type="rank_feature")
-                    self.mappings.fields[leaf] = leaf_fm
+                    staged_mappings[leaf] = leaf_fm
                 try:
                     fv = float(v)
                 except (TypeError, ValueError):
@@ -455,7 +460,7 @@ class SegmentBuilder:
                         f"rank_features field [{prefix}] feature [{k}] "
                         f"must be a number, got [{v!r}]"
                     ) from None
-                self._collect_values(leaf, fv, flat, nested_ops)
+                self._collect_values(leaf, fv, flat, nested_ops, staged_mappings)
             return
         if isinstance(value, dict):
             if fm is not None and fm.type not in ("object", "nested"):
@@ -466,7 +471,9 @@ class SegmentBuilder:
             for k, v in value.items():
                 if v is None:
                     continue
-                self._collect_values(f"{prefix}.{k}", v, flat, nested_ops)
+                self._collect_values(
+                    f"{prefix}.{k}", v, flat, nested_ops, staged_mappings
+                )
             return
         if isinstance(value, list) and any(
             isinstance(v, dict) for v in value
@@ -479,7 +486,7 @@ class SegmentBuilder:
                         f"mapper [{prefix}] cannot mix objects and "
                         f"concrete values in one array"
                     )
-                self._collect_values(prefix, obj, flat, nested_ops)
+                self._collect_values(prefix, obj, flat, nested_ops, staged_mappings)
             return
         if fm is None:
             return
@@ -501,18 +508,24 @@ class SegmentBuilder:
             entry[1].extend(values)
 
     def _stage_doc(self, source: dict[str, Any]):
-        """Validation pass: analyze/coerce everything, touch no state."""
+        """Validation pass: analyze/coerce everything, touch no state —
+        including the shared Mappings: dynamic mappings derived from this
+        doc stage in a side dict and commit only with the doc, so a
+        rejected write leaves no ghost mappings."""
         staged_vectors: list[tuple[str, np.ndarray]] = []
         staged_postings: list[tuple[str, dict[str, int], int]] = []
         staged_numeric: list[tuple[str, float]] = []
         staged_completion: list[tuple[str, list[tuple]]] = []
         staged_percolator: list[tuple[str, dict]] = []
+        staged_mappings: dict[str, Any] = {}
         flat: dict[str, tuple[Any, list[Any]]] = {}
         nested_ops: list[tuple[str, dict[str, Any]]] = []
         for source_name, value in source.items():
             if value is None:
                 continue
-            self._collect_values(source_name, value, flat, nested_ops)
+            self._collect_values(
+                source_name, value, flat, nested_ops, staged_mappings
+            )
         for field_name, (root_fm, values) in flat.items():
             value = values if len(values) > 1 else values[0]
             # Multi-fields: the same source value indexes under the parent
@@ -552,6 +565,7 @@ class SegmentBuilder:
             staged_completion,
             staged_percolator,
             staged_nested,
+            staged_mappings,
         )
 
     def add(
@@ -583,8 +597,11 @@ class SegmentBuilder:
             staged_completion,
             staged_percolator,
             staged_nested,
+            staged_mappings,
         ) = staged
         # ---- commit phase: nothing below raises -------------------------
+        for fname, fm in staged_mappings.items():
+            self.mappings.fields.setdefault(fname, fm)
         self._sources.append(source)
         self._ids.append(doc_id if doc_id is not None else str(local))
         self._versions.append(int(version))

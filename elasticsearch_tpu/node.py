@@ -183,10 +183,24 @@ class Node:
         data_path: str | None = None,
         breaker_limit_bytes: int | None = None,
         plugins: list[str] | None = None,
+        replication=None,
     ):
         self.node_name = node_name
         self.cluster_name = cluster_name
         self.data_path = data_path
+        # Replicated serving topology: with a cluster attached, document
+        # writes/reads/searches route through the host replication layer
+        # (cluster/gateway.py) — acknowledged writes are seqno-replicated
+        # to every in-sync copy before the 200 returns, and reads/searches
+        # fail over across copies. Without it (the default), this Node
+        # serves its local engines single-process, exactly as before.
+        self.replication = None
+        if replication is not None:
+            from .cluster import LocalCluster, ReplicationGateway
+
+            if isinstance(replication, LocalCluster):
+                replication = ReplicationGateway(replication)
+            self.replication = replication
         self.indices: dict[str, IndexService] = {}
         # Live scroll contexts (search/SearchService.java:167 analog);
         # bounded like the reference's search.max_open_scroll_context.
@@ -719,6 +733,32 @@ class Node:
         svc = self._open_index(
             name, body.get("mappings"), body.get("settings", {})
         )
+        if self.replication is not None:
+            from .cluster import ReplicationUnavailableError
+
+            idx_settings = svc.settings.get("index", {})
+            try:
+                n_replicas = int(idx_settings.get("number_of_replicas", 1))
+            except (TypeError, ValueError):
+                n_replicas = 1
+            try:
+                self.replication.create_index(
+                    name,
+                    n_shards=svc.n_shards,
+                    n_replicas=n_replicas,
+                    mappings=svc.mappings.to_json(),
+                )
+            except ReplicationUnavailableError as e:
+                # The index does not exist anywhere authoritative: undo
+                # the local registration before failing the request.
+                for engine in svc.engines:
+                    engine.close()
+                self.indices.pop(name, None)
+                raise ApiError(
+                    503, "master_not_discovered_exception", str(e)
+                ) from None
+            except ValueError:
+                pass  # already registered cluster-side (re-create race)
         self._save_index_meta(svc)
         for alias in body.get("aliases") or {}:
             self.aliases.setdefault(alias, set()).add(name)
@@ -739,6 +779,15 @@ class Node:
                     f"specify the corresponding concrete indices instead.",
                 )
             raise index_not_found(name)
+        if self.replication is not None:
+            from .cluster import ReplicationUnavailableError
+
+            try:
+                self.replication.delete_index(name)
+            except ReplicationUnavailableError as e:
+                raise ApiError(
+                    503, "master_not_discovered_exception", str(e)
+                ) from None
         for engine in self.indices[name].engines:
             engine.close()
         del self.indices[name]
@@ -860,8 +909,227 @@ class Node:
                 merged_subs.update(new.fields)
                 new.fields = merged_subs
             svc.mappings.fields[fname] = new
+        if self.replication is not None:
+            from .cluster import ReplicationUnavailableError
+
+            try:
+                # Serving engines live in the cluster: the update must be
+                # published there or it would only exist on this node.
+                self.replication.put_mappings(
+                    svc.name, svc.mappings.to_json()
+                )
+            except ReplicationUnavailableError as e:
+                raise ApiError(
+                    503, "master_not_discovered_exception", str(e)
+                ) from None
         self._save_index_meta(svc)
         return {"acknowledged": True}
+
+    # ------------------------------------------------- replicated serving
+
+    def _remote_api_error(self, e) -> ApiError:
+        """Map a replication-layer remote failure onto the ApiError the
+        single-process path would have raised for the same condition."""
+        remote_type = getattr(e, "remote_type", "")
+        if remote_type == "VersionConflictError":
+            return ApiError(409, "version_conflict_engine_exception", str(e))
+        if remote_type == "InvalidCasError":
+            return ApiError(400, "illegal_argument_exception", str(e))
+        if remote_type == "ValueError":
+            return ApiError(400, "mapper_parsing_exception", str(e))
+        return ApiError(500, "replication_exception", str(e))
+
+    def _replicated_copies(self, index: str, doc_id: str) -> tuple[int, int]:
+        """(wanted copies, in-sync copies) for the shard owning doc_id —
+        the honest `_shards` numbers for a replicated write response."""
+        try:
+            state = self.replication.coordinator().state
+        except RuntimeError:
+            return 1, 1
+        meta = state.indices.get(index)
+        if meta is None:
+            return 1, 1
+        routing = meta.shards.get(shard_for_id(doc_id, meta.n_shards))
+        total = 1 + meta.n_replicas
+        successful = len(routing.in_sync) if routing is not None else 1
+        return total, max(1, min(successful, total))
+
+    def _replicated_write(
+        self,
+        svc: IndexService,
+        doc_id: str,
+        source: dict[str, Any] | None,
+        op: str,
+        op_type: str = "index",
+        refresh: bool = False,
+        if_seq_no: int | None = None,
+        if_primary_term: int | None = None,
+        timeout_s: float | None = None,
+    ) -> dict:
+        """One write through the replication layer, with the gateway's
+        bounded retry-after-promotion behind it; errors map onto the same
+        statuses the local path produces, plus 503 when no healthy
+        primary emerged within the retry budget."""
+        from .cluster import ReplicationUnavailableError
+        from .cluster.transport import RemoteActionError
+
+        index = svc.name
+        try:
+            result = self.replication.write(
+                index, doc_id, source, op=op, op_type=op_type,
+                if_seq_no=if_seq_no, if_primary_term=if_primary_term,
+                timeout_s=timeout_s,
+            )
+        except ReplicationUnavailableError as e:
+            raise ApiError(503, "unavailable_shards_exception", str(e)) from None
+        except RemoteActionError as e:
+            raise self._remote_api_error(e) from None
+        except VersionConflictError as e:
+            raise ApiError(
+                409, "version_conflict_engine_exception", str(e)
+            ) from None
+        except InvalidCasError as e:
+            raise ApiError(400, "illegal_argument_exception", str(e)) from None
+        except ValueError as e:
+            raise ApiError(400, "mapper_parsing_exception", str(e)) from None
+        total, successful = self._replicated_copies(index, doc_id)
+        out = {
+            "_index": index,
+            "_id": result.get("_id", doc_id),
+            "_version": result.get("_version"),
+            "result": result.get("result"),
+            "_seq_no": result.get("_seq_no"),
+            "_primary_term": result.get("_primary_term"),
+            "_shards": {
+                "total": total,
+                "successful": successful,
+                "failed": 0,
+            },
+        }
+        if refresh:
+            self.replication.refresh(index)
+            out["forced_refresh"] = True
+        return out
+
+    def _replicated_read(self, svc: IndexService, doc_id: str) -> dict:
+        from .cluster import ReplicationUnavailableError
+        from .cluster.transport import RemoteActionError
+
+        try:
+            meta = self.replication.read(svc.name, doc_id)
+        except ReplicationUnavailableError as e:
+            raise ApiError(503, "unavailable_shards_exception", str(e)) from None
+        except RemoteActionError as e:
+            raise self._remote_api_error(e) from None
+        if meta is None:
+            return {"_index": svc.name, "_id": doc_id, "found": False}
+        return {
+            "_index": svc.name,
+            "_id": doc_id,
+            "_version": meta["_version"],
+            "_seq_no": meta["_seq_no"],
+            "_primary_term": meta["_primary_term"],
+            "found": True,
+            "_source": meta["_source"],
+        }
+
+    def _replicated_search(
+        self, svc: IndexService, body: dict[str, Any] | None, scroll
+    ) -> dict:
+        from .cluster import ReplicationUnavailableError
+        from .cluster.transport import RemoteActionError
+
+        body = dict(body or {})
+        if (
+            scroll is not None
+            or body.get("aggs")
+            or body.get("aggregations")
+            or body.get("suggest")
+        ):
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                "aggregations/scroll/suggest are not supported on "
+                "replicated indices yet; disable replication for this "
+                "workload",
+            )
+        t0 = time.monotonic()
+        try:
+            out = self.replication.search(svc.name, body)
+        except ReplicationUnavailableError as e:
+            raise ApiError(
+                503, "search_phase_execution_exception", str(e)
+            ) from None
+        except RemoteActionError as e:
+            if e.remote_type == "ValueError":
+                raise ApiError(
+                    400, "search_phase_execution_exception", str(e)
+                ) from None
+            raise self._remote_api_error(e) from None
+        except ValueError as e:
+            raise ApiError(
+                400, "search_phase_execution_exception", str(e)
+            ) from None
+        for hit in out["hits"]["hits"]:
+            hit.setdefault("_index", svc.name)
+        return {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": False,
+            **out,
+        }
+
+    def _replicated_update(
+        self,
+        svc: IndexService,
+        doc_id: str,
+        body: dict[str, Any],
+        refresh: bool = False,
+        if_seq_no: int | None = None,
+        if_primary_term: int | None = None,
+    ) -> dict:
+        """Partial update over the replication layer: failover read +
+        merge + CAS'd replicated reindex. When the caller supplies no CAS
+        of its own, the read's seqno/term become one, so a concurrent
+        writer surfaces as 409 instead of silently losing this merge (the
+        reference closes the same race with its internal CAS retry loop;
+        here the retry is the client's)."""
+        existing_meta = self._replicated_read(svc, doc_id)
+        existing = (
+            existing_meta["_source"] if existing_meta.get("found") else None
+        )
+        op_type = "index"
+        if existing is None:
+            if "upsert" in body:
+                merged = dict(body["upsert"])
+            elif body.get("doc_as_upsert") and "doc" in body:
+                merged = dict(body["doc"])
+            else:
+                raise ApiError(
+                    404,
+                    "document_missing_exception",
+                    f"[{doc_id}]: document missing",
+                )
+            # put-if-absent: a concurrent creator must 409, not be
+            # overwritten by this upsert's stale merge.
+            op_type = "create"
+        else:
+            merged = dict(existing)
+            merged.update(body.get("doc", {}))
+            if if_seq_no is None and if_primary_term is None:
+                if_seq_no = existing_meta["_seq_no"]
+                if_primary_term = existing_meta["_primary_term"]
+        out = self._replicated_write(
+            svc, doc_id, merged, op="index", op_type=op_type,
+            refresh=refresh, if_seq_no=if_seq_no,
+            if_primary_term=if_primary_term,
+        )
+        out["result"] = "updated" if existing is not None else "created"
+        return out
+
+    def _docs_count(self, svc: IndexService) -> int:
+        if self.replication is not None:
+            return self.replication.num_docs(svc.name)
+        return svc.num_docs
 
     # ------------------------------------------------------------ documents
 
@@ -876,6 +1144,7 @@ class Node:
         if_primary_term: int | None = None,
         op_type: str = "index",
         pipeline: str | None = None,
+        timeout_s: float | None = None,
     ) -> dict:
         svc = self.get_index(index, auto_create=True)
         source = self._apply_pipeline(svc, source, pipeline)
@@ -886,6 +1155,14 @@ class Node:
                 "result": "noop",
                 "_shards": {"total": 1, "successful": 0, "failed": 0},
             }
+        if self.replication is not None:
+            if doc_id is None:
+                doc_id = svc.next_auto_id()
+            return self._replicated_write(
+                svc, doc_id, source, op="index", op_type=op_type,
+                refresh=refresh, if_seq_no=if_seq_no,
+                if_primary_term=if_primary_term, timeout_s=timeout_s,
+            )
         if doc_id is None and svc.n_shards > 1:
             # Multi-shard: the id must exist before routing (the reference
             # generates the UUID in TransportBulkAction before routing too).
@@ -921,6 +1198,8 @@ class Node:
 
     def get_doc(self, index: str, doc_id: str) -> dict:
         svc = self.get_index(index)
+        if self.replication is not None:
+            return self._replicated_read(svc, doc_id)
         meta = svc.route(doc_id).get_with_meta(doc_id)
         if meta is None:
             return {"_index": index, "_id": doc_id, "found": False}
@@ -942,8 +1221,18 @@ class Node:
         sync: bool = True,
         if_seq_no: int | None = None,
         if_primary_term: int | None = None,
+        timeout_s: float | None = None,
     ) -> dict:
         svc = self.get_index(index)
+        if self.replication is not None:
+            out = self._replicated_write(
+                svc, doc_id, None, op="delete", refresh=refresh,
+                if_seq_no=if_seq_no, if_primary_term=if_primary_term,
+                timeout_s=timeout_s,
+            )
+            if out["result"] != "deleted":
+                out["result"] = "not_found"
+            return out
         engine = svc.route(doc_id)
         try:
             result = engine.delete(
@@ -984,6 +1273,11 @@ class Node:
         """Partial update: realtime get + merge + reindex (the reference's
         TransportUpdateAction/UpdateHelper flow, action/update/)."""
         svc = self.get_index(index)
+        if self.replication is not None:
+            return self._replicated_update(
+                svc, doc_id, body, refresh=refresh,
+                if_seq_no=if_seq_no, if_primary_term=if_primary_term,
+            )
         # The read-modify-write must be atomic against concurrent writers
         # (the reference achieves this with a seqno CAS + retry loop in
         # TransportUpdateAction; holding the engine write lock is the
@@ -1169,13 +1463,20 @@ class Node:
         request_cache: bool | None = None,
     ) -> dict:
         targets = self.resolve_search_targets(index)
+        if not targets:
+            # Only wildcard/_all expressions can resolve to nothing; the
+            # reference's allow_no_indices default makes that an empty
+            # SUCCESSFUL response, not a 404 (concrete missing names still
+            # 404 below).
+            return self._empty_search_response()
         if len(targets) > 1:
             return self._multi_index_search(targets, body, scroll)
-        if len(targets) == 1:
-            index = targets[0]
+        index = targets[0]
         svc = self.get_index(index)
         if body:
             body = self.resolve_script_refs(body)
+        if self.replication is not None:
+            return self._replicated_search(svc, body, scroll)
         if self._scrolls:
             # Reap expired scroll contexts opportunistically: they pin
             # frozen device segments, and a quiet scroll API must not keep
@@ -1253,6 +1554,25 @@ class Node:
             self.request_cache.put(cache_key, out)
         return out
 
+    @staticmethod
+    def _empty_search_response() -> dict:
+        """The allow_no_indices success shape: zero shards, zero hits."""
+        return {
+            "took": 0,
+            "timed_out": False,
+            "_shards": {
+                "total": 0,
+                "successful": 0,
+                "skipped": 0,
+                "failed": 0,
+            },
+            "hits": {
+                "total": {"value": 0, "relation": "eq"},
+                "max_score": None,
+                "hits": [],
+            },
+        }
+
     def _multi_index_search(
         self, targets: list[str], body: dict[str, Any] | None, scroll
     ) -> dict:
@@ -1326,11 +1646,17 @@ class Node:
         body["size"] = 0
         body["track_total_hits"] = True  # _count is always exact
         result = self.search(index, body)
-        svc = self.get_index(index)
-        n = svc.n_shards
+        # The search already reports its shard accounting (including the
+        # allow_no_indices zero-shard case and replicated partial results).
+        shards = result.get("_shards") or {"total": 1, "successful": 1}
         return {
             "count": result["hits"]["total"]["value"],
-            "_shards": {"total": n, "successful": n, "skipped": 0, "failed": 0},
+            "_shards": {
+                "total": shards.get("total", 1),
+                "successful": shards.get("successful", 1),
+                "skipped": shards.get("skipped", 0),
+                "failed": shards.get("failed", 0),
+            },
         }
 
     def explain(self, index: str, doc_id: str, body: dict[str, Any] | None) -> dict:
@@ -1566,6 +1892,41 @@ class Node:
 
     # ------------------------------------------------- by-query operations
 
+    def _replicated_scan(
+        self, svc: IndexService, query_body, require_complete: bool = False
+    ):
+        """One refreshed scatter of matching hits for a by-query operation
+        on a replicated index (page size = max_result_window). With
+        `require_complete`, a match set larger than one page is a 400 —
+        silently processing a truncated prefix would report success while
+        skipping documents. delete_by_query instead re-scans until the
+        match set drains, so it needs no completeness guarantee per page.
+        Returns (hits, total_matched)."""
+        self.replication.refresh(svc.name)
+        window = int(
+            svc.settings.get("index", {}).get("max_result_window", 10_000)
+        )
+        out = self._replicated_search(
+            svc,
+            {
+                "query": query_body or {"match_all": {}},
+                "size": window,
+                "track_total_hits": True,
+            },
+            None,
+        )
+        hits = out["hits"]["hits"]
+        total = out["hits"]["total"]["value"]
+        if require_complete and total > len(hits):
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                f"[{total}] documents match but only [{len(hits)}] fit one "
+                f"scan page on a replicated index; narrow the query or "
+                f"raise index.max_result_window",
+            )
+        return hits, total
+
     def _scan_hits(self, index: str, query_body, batch: int = 1000):
         """Iterate every matching hit over an internal scroll snapshot
         (stable under the mutations the caller is about to make)."""
@@ -1595,6 +1956,34 @@ class Node:
         deleted = 0
         total = 0
         svc = self.get_index(index)
+        if self.replication is not None:
+            # Deleting shrinks the match set, so re-scan until it drains —
+            # match sets past one page are handled, never truncated.
+            while True:
+                hits, _ = self._replicated_scan(svc, body.get("query"))
+                if not hits:
+                    break
+                round_deleted = 0
+                for hit in hits:
+                    total += 1
+                    out = self._replicated_write(
+                        svc, hit["_id"], None, op="delete"
+                    )
+                    if out["result"] == "deleted":
+                        deleted += 1
+                        round_deleted += 1
+                if round_deleted == 0:
+                    break  # no progress: never spin on an undeletable set
+            if refresh:
+                self.replication.refresh(svc.name)
+            return {
+                "took": int((time.monotonic() - t0) * 1000),
+                "timed_out": False,
+                "total": total,
+                "deleted": deleted,
+                "version_conflicts": 0,
+                "failures": [],
+            }
         for hit in self._scan_hits(index, body.get("query")):
             total += 1
             result = svc.route(hit.doc_id).delete(hit.doc_id)
@@ -1637,6 +2026,34 @@ class Node:
         total = 0
         noops = 0
         failures: list[dict] = []
+        if self.replication is not None:
+            hits, _ = self._replicated_scan(
+                svc, body.get("query"), require_complete=True
+            )
+            for hit in hits:
+                total += 1
+                try:
+                    out = self._apply_pipeline(
+                        svc, hit.get("_source") or {}, pipeline
+                    )
+                    if out is None:
+                        noops += 1
+                        continue
+                    self._replicated_write(svc, hit["_id"], out, op="index")
+                    updated += 1
+                except ApiError as e:
+                    failures.append({"id": hit["_id"], "cause": str(e)})
+            if refresh:
+                self.replication.refresh(svc.name)
+            return {
+                "took": int((time.monotonic() - t0) * 1000),
+                "timed_out": False,
+                "total": total,
+                "updated": updated,
+                "noops": noops,
+                "version_conflicts": 0,
+                "failures": failures,
+            }
         try:
             for hit in self._scan_hits(index, body.get("query")):
                 total += 1
@@ -1818,6 +2235,8 @@ class Node:
         svc = self.get_index(index)
         if self._scrolls:
             self._purge_scrolls()
+        if self.replication is not None:
+            self.replication.refresh(svc.name)
         for engine in svc.engines:
             engine.refresh()
         n = svc.n_shards
@@ -2427,6 +2846,8 @@ class Node:
     # ---------------------------------------------------------------- admin
 
     def cluster_health(self) -> dict:
+        if self.replication is not None:
+            return self._replicated_cluster_health()
         return {
             "cluster_name": self.cluster_name,
             "status": "green",
@@ -2447,6 +2868,57 @@ class Node:
             "active_shards_percent_as_number": 100.0,
         }
 
+    def _replicated_cluster_health(self) -> dict:
+        """Health derived from the published ClusterState: red = a shard
+        with no promotable copy, yellow = in-sync copies below the
+        configured replica count, green otherwise."""
+        try:
+            state = self.replication.coordinator().state
+        except RuntimeError:
+            state = None
+        active_primaries = 0
+        active_shards = 0
+        unassigned = 0
+        desired = 0
+        initializing = 0
+        n_nodes = 0
+        if state is not None:
+            n_nodes = len(state.nodes)
+            for meta in state.indices.values():
+                for routing in meta.shards.values():
+                    desired += 1 + meta.n_replicas
+                    initializing += len(routing.recovering)
+                    if routing.primary is None:
+                        unassigned += 1 + meta.n_replicas
+                        continue
+                    active_primaries += 1
+                    active_shards += len(routing.assigned())
+        if state is None or unassigned:
+            status = "red"  # an unassigned PRIMARY is red, not yellow
+        elif active_shards < desired:
+            status = "yellow"
+        else:
+            status = "green"
+        return {
+            "cluster_name": self.cluster_name,
+            "status": status,
+            "timed_out": False,
+            "number_of_nodes": n_nodes,
+            "number_of_data_nodes": n_nodes,
+            "active_primary_shards": active_primaries,
+            "active_shards": active_shards,
+            "relocating_shards": 0,
+            "initializing_shards": initializing,
+            "unassigned_shards": unassigned,
+            "delayed_unassigned_shards": 0,
+            "number_of_pending_tasks": 0,
+            "number_of_in_flight_fetch": 0,
+            "task_max_waiting_in_queue_millis": 0,
+            "active_shards_percent_as_number": (
+                100.0 if not desired else 100.0 * active_shards / desired
+            ),
+        }
+
     def cat_indices(self) -> list[dict]:
         return [
             {
@@ -2455,7 +2927,7 @@ class Node:
                 "index": name,
                 "pri": str(svc.n_shards),
                 "rep": "0",
-                "docs.count": str(svc.num_docs),
+                "docs.count": str(self._docs_count(svc)),
             }
             for name, svc in sorted(self.indices.items())
         ]
@@ -2475,9 +2947,9 @@ class Node:
 
     def cat_count(self, index: str | None = None) -> list[dict]:
         if index is not None:
-            count = self.get_index(index).num_docs
+            count = self._docs_count(self.get_index(index))
         else:
-            count = sum(s.num_docs for s in self.indices.values())
+            count = sum(self._docs_count(s) for s in self.indices.values())
         return [{"count": str(count)}]
 
     def cat_shards(self) -> list[dict]:
@@ -2548,6 +3020,52 @@ class Node:
                     "indexing_pressure": self.indexing_pressure.stats(),
                 }
             },
+        }
+
+    def nodes_stats(self) -> dict:
+        """GET /_nodes/stats — serving-resilience counters: SPMD mesh
+        circuit-breaker state and disable/re-enable events per index, plus
+        replication gateway retry/failover counts when clustered."""
+        mesh_views: dict[str, Any] = {}
+        disable_events = 0
+        reenable_events = 0
+        for name, svc in sorted(self.indices.items()):
+            mv = getattr(svc.search, "mesh_view", None)
+            if mv is None:
+                continue
+            breaker = mv.breaker.stats()
+            disable_events += breaker["disable_events"]
+            reenable_events += breaker["reenable_events"]
+            mesh_views[name] = {
+                **breaker,
+                "served": mv.served,
+                "packs": mv.packs,
+                "rebuilds": mv.rebuilds,
+                "exec_failures": mv.exec_failures,
+            }
+        node_stats: dict[str, Any] = {
+            "name": self.node_name,
+            "indices": {
+                "docs": {
+                    "count": sum(
+                        self._docs_count(svc)
+                        for svc in self.indices.values()
+                    )
+                }
+            },
+            "breakers": {"hbm": self.breaker.stats()},
+            "indexing_pressure": self.indexing_pressure.stats(),
+            "mesh_serving": {
+                "disable_events": disable_events,
+                "reenable_events": reenable_events,
+                "views": mesh_views,
+            },
+        }
+        if self.replication is not None:
+            node_stats["replication"] = self.replication.stats()
+        return {
+            "cluster_name": self.cluster_name,
+            "nodes": {self.node_name: node_stats},
         }
 
     def stats(self) -> dict:

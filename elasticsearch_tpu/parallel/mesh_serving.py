@@ -73,6 +73,135 @@ def _pow2(n: int, floor: int = 1) -> int:
     return 1 << max(0, max(n, floor) - 1).bit_length()
 
 
+# Error classification for the serving breaker. Sticky failures are wrong-
+# answer or will-never-work conditions (plan/compile bugs, parity breaks):
+# retrying them risks serving bad results or paying a doomed compile per
+# request forever. Transient failures are capacity/runtime conditions
+# (device OOM holding the mesh copy, executor hiccups) that clear when
+# pressure does.
+_STICKY_ERROR_TYPES = (TypeError, ValueError, NotImplementedError, AssertionError)
+_STICKY_ERROR_TOKENS = ("INVALID_ARGUMENT", "parity", "mismatch")
+_TRANSIENT_ERROR_TOKENS = (
+    "RESOURCE_EXHAUSTED",
+    "out of memory",
+    "OOM",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+)
+
+
+def classify_mesh_error(e: BaseException) -> str:
+    """'sticky' | 'transient' for an execute-stage mesh failure."""
+    text = str(e)
+    if isinstance(e, MemoryError) or any(
+        tok in text for tok in _TRANSIENT_ERROR_TOKENS
+    ):
+        return "transient"
+    if isinstance(e, _STICKY_ERROR_TYPES) or any(
+        tok.lower() in text.lower() for tok in _STICKY_ERROR_TOKENS
+    ):
+        return "sticky"
+    # Unknown runtime failures are treated as transient: a cooldown'd
+    # retry is recoverable, a permanent disable is not.
+    return "transient"
+
+
+class MeshServingBreaker:
+    """Circuit breaker for the SPMD serving path.
+
+    closed → (threshold transient failures) → open → [cooldown] →
+    half-open → closed on the first success / back to open on failure.
+    Sticky failures (see classify_mesh_error) latch the breaker open for
+    the life of the process — those need a code fix, not a retry. Disable
+    and re-enable transitions are counted for `_nodes/stats`.
+    """
+
+    def __init__(
+        self,
+        failure_threshold: int | None = None,
+        cooldown_s: float | None = None,
+    ):
+        if failure_threshold is None:
+            failure_threshold = int(
+                os.environ.get("ESTPU_MESH_BREAKER_FAILURES", 3)
+            )
+        if cooldown_s is None:
+            cooldown_s = float(
+                os.environ.get("ESTPU_MESH_BREAKER_COOLDOWN_S", 30.0)
+            )
+        self.failure_threshold = max(1, failure_threshold)
+        self.cooldown_s = cooldown_s
+        self.state = "closed"  # closed | open | half_open
+        self.sticky = False
+        self.failures = 0  # consecutive transient failures while closed
+        self.opened_at = 0.0
+        self.disable_events = 0
+        self.reenable_events = 0
+        self.last_error = ""
+        self._lock = threading.Lock()
+
+    def allow(self) -> bool:
+        """May the next request try the mesh? Flips open → half-open once
+        the cooldown has elapsed (that request is the trial)."""
+        with self._lock:
+            if self.sticky:
+                return False
+            if self.state == "open":
+                if time.monotonic() - self.opened_at >= self.cooldown_s:
+                    self.state = "half_open"
+                    return True
+                return False
+            return True
+
+    def is_open(self) -> bool:
+        """Side-effect-free probe: is the mesh path currently not served?
+        (Unlike allow(), never performs the open → half-open transition.)"""
+        with self._lock:
+            if self.sticky:
+                return True
+            return (
+                self.state == "open"
+                and time.monotonic() - self.opened_at < self.cooldown_s
+            )
+
+    def record_failure(self, e: BaseException) -> None:
+        with self._lock:
+            self.last_error = f"{type(e).__name__}: {e}"
+            if classify_mesh_error(e) == "sticky":
+                self.sticky = True
+                if self.state != "open":
+                    self.disable_events += 1
+                self.state = "open"
+                self.opened_at = time.monotonic()
+                return
+            self.failures += 1
+            if self.state == "half_open" or self.failures >= self.failure_threshold:
+                if self.state != "open":
+                    self.disable_events += 1
+                self.state = "open"
+                self.opened_at = time.monotonic()
+                self.failures = 0
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.failures = 0
+            if self.state == "half_open":
+                self.state = "closed"
+                self.reenable_events += 1
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "state": "disabled" if self.sticky else self.state,
+                "sticky": self.sticky,
+                "failure_threshold": self.failure_threshold,
+                "cooldown_seconds": self.cooldown_s,
+                "disable_events": self.disable_events,
+                "reenable_events": self.reenable_events,
+                "last_error": self.last_error,
+            }
+
+
 @dataclass
 class _MeshHandle:
     """Host-side fetch handle for a snapshot's merged shard segment (duck-
@@ -143,11 +272,19 @@ class MeshView:
         self.served = 0  # searches answered by the SPMD program
         self.packs = 0  # shard pack+upload operations performed
         self.rebuilds = 0  # full (all-shard) rebuilds
-        # Resilience latch: repeated execute-stage failures (e.g. device
-        # memory exhausted by the mesh copy) permanently route this index
-        # back to the host-loop path instead of failing every request.
-        self.exec_failures = 0
-        self.disabled = False
+        # Resilience: execute-stage failures route requests back to the
+        # host-loop path through a circuit breaker — transient failures
+        # (device OOM under the mesh copy) half-open after a cooldown and
+        # re-enable on the first success; sticky failures (compile/parity
+        # bugs) stay off for the life of the process.
+        self.exec_failures = 0  # lifetime count, for _nodes/stats
+        self.breaker = MeshServingBreaker()
+
+    @property
+    def disabled(self) -> bool:
+        """Back-compat view of the breaker: True while the SPMD path is
+        not being tried (sticky-latched or cooling down)."""
+        return self.breaker.is_open()
 
     # ------------------------------------------------------------- refresh
 
@@ -397,7 +534,7 @@ class MeshView:
         shape, or a plan the mesh compiler cannot make shard-uniform)."""
         from ..search.service import SearchHit, SearchResponse, clamp_total
 
-        if self.disabled or not self.eligible(request):
+        if not self.eligible(request) or not self.breaker.allow():
             return None
         if any(
             h.segment.nested for e in self.engines for h in e.segments
@@ -429,16 +566,15 @@ class MeshView:
                 idx.docs_per_shard,
             )
             scores, gids = np.asarray(scores), np.asarray(gids)
-        except Exception:
+        except Exception as e:
             # Execute-stage failure (XLA lowering, device OOM holding the
-            # mesh copy): fall back to the host loop, and stop trying after
-            # repeated failures so every request doesn't pay a doomed
-            # compile attempt.
+            # mesh copy): fall back to the host loop and feed the breaker —
+            # transient failures re-enable after a cooldown'd success,
+            # sticky (compile/parity) failures latch off for good.
             self.exec_failures += 1
-            if self.exec_failures >= 3:
-                self.disabled = True
+            self.breaker.record_failure(e)
             return None
-        self.exec_failures = 0
+        self.breaker.record_success()
         total = int(total)
         self.served += 1
         timed_out = bool(task is not None and task.check_deadline())

@@ -58,6 +58,30 @@ from ..query.dsl import Query
 from .routing import shard_for_id
 
 
+def _shard_map(body, mesh: Mesh, in_specs, out_specs):
+    """`jax.shard_map` (public since 0.6, kw `check_vma`) or the older
+    `jax.experimental.shard_map.shard_map` (kw `check_rep`) — the mesh
+    serving path must work on both; replication checking is off either way
+    (the reduce mixes per-shard and replicated values)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    return _legacy_shard_map(
+        body,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 def _empty_field(name: str, num_docs: int, has_norms: bool) -> FieldIndex:
     return FieldIndex(
         name=name,
@@ -529,12 +553,11 @@ def sharded_execute(
         total = jax.lax.psum(count, axis)
         return top_s, top_i, total
 
-    return jax.shard_map(
+    return _shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis), P(axis)),
         out_specs=(P(), P(), P()),
-        check_vma=False,
     )(seg_stacked, arrays_stacked)
 
 
@@ -593,10 +616,9 @@ def sharded_execute_batch(
         totals = jax.lax.psum(counts, shard_axis)
         return top_s, top_i, totals
 
-    return jax.shard_map(
+    return _shard_map(
         body,
         mesh=mesh,
         in_specs=(P(shard_axis), P(batch_axis, shard_axis)),
         out_specs=(P(batch_axis), P(batch_axis), P(batch_axis)),
-        check_vma=False,
     )(seg_stacked, arrays_batched)

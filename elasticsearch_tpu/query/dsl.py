@@ -573,8 +573,11 @@ def parse_distance_meters(value) -> float:
     if isinstance(value, (int, float)):
         return float(value)
     s = str(value).strip().lower()
+    # Longest suffix first: "nmi" must match before "mi"/"m", and
+    # "cm"/"mm"/"km" before "m" — a shorter suffix that is a suffix OF a
+    # longer one would otherwise shadow it.
     units = [
-        ("km", 1000.0), ("mi", 1609.344), ("nmi", 1852.0), ("yd", 0.9144),
+        ("nmi", 1852.0), ("km", 1000.0), ("mi", 1609.344), ("yd", 0.9144),
         ("ft", 0.3048), ("cm", 0.01), ("mm", 0.001), ("m", 1.0),
     ]
     for suffix, factor in units:

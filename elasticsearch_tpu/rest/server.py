@@ -28,23 +28,61 @@ Routes (subset mirroring rest-api-spec/):
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
 
+from ..cluster import (
+    ConnectTransportError,
+    NoShardAvailableError,
+    NotMasterError,
+    ReplicationFailedError,
+    ReplicationUnavailableError,
+    StalePrimaryTermError,
+)
 from ..common.breaker import BreakerError
 from ..node import ApiError, Node
 from ..search import rank_eval
 
 Handler = Callable[["RestServer", dict, dict, Any], Any]
 
+# Cluster-topology failures that may escape the Node's own retry mapping
+# (e.g. raised from a code path that predates replication): the router
+# retries them once after a control-plane round, then answers 503 — the
+# reference's unavailable-shards status — never a raw 500.
+_TOPOLOGY_ERRORS = (
+    ConnectTransportError,
+    NoShardAvailableError,
+    NotMasterError,
+    ReplicationFailedError,
+    StalePrimaryTermError,
+    ReplicationUnavailableError,
+)
+
 
 def _json(body: str) -> dict:
     if not body or not body.strip():
         return {}
     return json.loads(body)
+
+
+def _timeout_param(q: dict) -> float | None:
+    """?timeout=30s on write APIs: per-request replication retry budget."""
+    if "timeout" not in q:
+        return None
+    from ..common.units import parse_duration_s
+
+    try:
+        return parse_duration_s(q["timeout"])
+    except ValueError:
+        raise ApiError(
+            400,
+            "illegal_argument_exception",
+            f"failed to parse [timeout]: [{q['timeout']}]",
+        ) from None
 
 
 def _cas_params(q: dict) -> dict:
@@ -67,13 +105,60 @@ class RestServer:
     # http.max_content_length (the reference's 100mb default).
     max_content_length = 100 * 1024 * 1024
 
-    def __init__(self, node: Node | None = None, data_path: str | None = None):
+    def __init__(
+        self,
+        node: Node | None = None,
+        data_path: str | None = None,
+        replication_nodes: int = 0,
+        cluster_data_path: str | None = None,
+    ):
+        """A REST front. With `replication_nodes >= 2` (or the
+        ESTPU_REPLICATION_NODES env var) the server boots an in-process
+        replication cluster and serves the document APIs through it:
+        acknowledged writes reach every in-sync copy before the 200, and
+        reads/searches fail over across copies when nodes die. The
+        background stepper keeps failure detection and promotion live
+        under traffic."""
+        if node is None and replication_nodes == 0:
+            replication_nodes = int(
+                os.environ.get("ESTPU_REPLICATION_NODES", "0") or 0
+            )
+        if node is not None and replication_nodes:
+            raise ValueError(
+                "replication_nodes cannot be combined with an existing "
+                "node; construct the Node with replication= instead"
+            )
+        if replication_nodes == 1:
+            raise ValueError(
+                "replication requires at least 2 nodes (replication_nodes"
+                f"={replication_nodes} would serve unreplicated)"
+            )
+        self.cluster = None
+        if node is None and replication_nodes >= 2:
+            from ..cluster import LocalCluster, ReplicationGateway
+
+            self.cluster = LocalCluster(
+                replication_nodes, data_path=cluster_data_path
+            )
+            self.cluster.start_stepper()
+            node = Node(
+                data_path=data_path,
+                replication=ReplicationGateway(self.cluster),
+            )
         self.node = node or Node(data_path=data_path)
+        if self.cluster is None and self.node.replication is not None:
+            self.cluster = self.node.replication.cluster
         # Wire byte length of the current request's body, per handler
         # thread (the Content-Length the socket actually carried).
         self._tl = threading.local()
         self.routes: list[tuple[str, re.Pattern, Handler]] = []
         self._register_routes()
+
+    def close(self) -> None:
+        """Stop the replication cluster (if any) and local engines."""
+        if self.cluster is not None:
+            self.cluster.close()
+        self.node.close()
 
     def route(self, method: str, pattern: str, handler: Handler) -> None:
         # {name} → named group; index names can't start with _ so the
@@ -93,6 +178,7 @@ class RestServer:
         r("GET", "/_cluster/health", lambda s, p, q, b: n.cluster_health())
         r("GET", "/_cluster/stats", lambda s, p, q, b: n.cluster_stats())
         r("GET", "/_nodes", lambda s, p, q, b: n.nodes_info())
+        r("GET", "/_nodes/stats", lambda s, p, q, b: n.nodes_stats())
         r("GET", "/_cat/plugins", lambda s, p, q, b: [
             {"name": n.node_name, "component": name}
             for name in n.plugin_names
@@ -282,12 +368,14 @@ class RestServer:
             p["index"], _json(b), None,
             refresh=q.get("refresh") in ("true", ""),
             pipeline=q.get("pipeline"),
+            timeout_s=_timeout_param(q),
         ))
         for method in ("PUT", "POST"):
             r(method, "/{index}/_doc/{id}", lambda s, p, q, b: n.index_doc(
                 p["index"], _json(b), p["id"],
                 refresh=q.get("refresh") in ("true", ""),
                 pipeline=q.get("pipeline"),
+                timeout_s=_timeout_param(q),
                 **_cas_params(q),
             ))
             r(method, "/{index}/_create/{id}", self._create_doc)
@@ -296,6 +384,7 @@ class RestServer:
         ))
         r("DELETE", "/{index}/_doc/{id}", lambda s, p, q, b: n.delete_doc(
             p["index"], p["id"], refresh=q.get("refresh") in ("true", ""),
+            timeout_s=_timeout_param(q),
             **_cas_params(q),
         ))
         r("POST", "/{index}/_update/{id}", lambda s, p, q, b: n.update_doc(
@@ -346,6 +435,23 @@ class RestServer:
 
     # ------------------------------------------------------------- dispatch
 
+    def _invoke(self, handler: Handler, params: dict, query: dict, body: str):
+        """Run one route handler with topology-failover: a cluster error
+        that escapes the gateway's own retries gets ONE more attempt after
+        a control-plane round (failure detection → promotion), so a
+        request that raced a node death is served by the promoted primary
+        (or a surviving replica) instead of erroring."""
+        try:
+            return handler(self, params, query, body)
+        except _TOPOLOGY_ERRORS:
+            if self.cluster is None:
+                raise
+            try:
+                self.cluster.step()
+            except Exception:
+                pass
+            return handler(self, params, query, body)
+
     def dispatch(self, method: str, path: str, query: dict, body: str):
         """Returns (status, payload). ES-style error payloads on failure."""
         try:
@@ -361,7 +467,7 @@ class RestServer:
                 if m != lookup:
                     path_matched = True
                     continue
-                result = handler(self, match.groupdict(), query, body)
+                result = self._invoke(handler, match.groupdict(), query, body)
                 return 200, result
             if path_matched:
                 raise ApiError(
@@ -389,6 +495,16 @@ class RestServer:
                     "reason": str(e),
                 },
                 "status": 429,
+            }
+        except _TOPOLOGY_ERRORS as e:
+            # Retries exhausted: the honest status is 503 (retryable),
+            # mirroring the reference's unavailable-shards responses.
+            return 503, {
+                "error": {
+                    "type": "unavailable_shards_exception",
+                    "reason": str(e),
+                },
+                "status": 503,
             }
         except json.JSONDecodeError as e:
             return 400, {
@@ -463,10 +579,15 @@ class RestServer:
 
 
 def create_server(
-    host: str = "127.0.0.1", port: int = 9200, data_path: str | None = None
+    host: str = "127.0.0.1",
+    port: int = 9200,
+    data_path: str | None = None,
+    replication_nodes: int = 0,
 ):
     """(http_server, rest) pair; call http_server.serve_forever() to run."""
-    rest = RestServer(data_path=data_path)
+    rest = RestServer(
+        data_path=data_path, replication_nodes=replication_nodes
+    )
     return rest.serve(host, port), rest
 
 
@@ -481,8 +602,18 @@ def main():
         default=None,
         help="enable durability: per-index translog + segment persistence",
     )
+    parser.add_argument(
+        "--replication-nodes",
+        type=int,
+        default=0,
+        help="serve through an in-process replication cluster of N nodes "
+        "(acknowledged writes reach every in-sync copy; reads fail over)",
+    )
     args = parser.parse_args()
-    server, rest = create_server(args.host, args.port, args.data_path)
+    server, rest = create_server(
+        args.host, args.port, args.data_path,
+        replication_nodes=args.replication_nodes,
+    )
     print(
         json.dumps(
             {

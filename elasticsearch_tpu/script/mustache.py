@@ -29,7 +29,10 @@ class TemplateError(ValueError):
     pass
 
 
-def _lookup(stack: list[Any], path: str) -> Any:
+_MISSING = object()  # distinguishes an absent variable from explicit null
+
+
+def _lookup(stack: list[Any], path: str, default: Any = None) -> Any:
     path = path.strip()
     if path == ".":
         return stack[-1]
@@ -44,7 +47,7 @@ def _lookup(stack: list[Any], path: str) -> Any:
                 break
         if found:
             return obj
-    return None
+    return default
 
 
 def _json_escape(value: Any) -> str:
@@ -124,8 +127,19 @@ def _render_nodes(nodes: list, stack: list[Any], out: list[str]) -> None:
         if kind == "var":
             out.append(_json_escape(_lookup(stack, node[1])))
         elif kind == "raw":
-            value = _lookup(stack, node[1])
-            out.append("" if value is None else str(value))
+            value = _lookup(stack, node[1], _MISSING)
+            if value is _MISSING:
+                out.append("")  # absent variable: standard mustache empty
+            elif isinstance(value, str):
+                out.append(value)  # raw = unescaped, verbatim
+            else:
+                # Non-string values must substitute as VALID JSON —
+                # Python's repr ("True", "None", "{'a': 1}") would break
+                # the rendered search body at parse time.
+                try:
+                    out.append(json.dumps(value))
+                except (TypeError, ValueError):
+                    out.append(str(value))
         elif kind == "#":
             name, children = node[1], node[2]
             if name == "toJson":

@@ -1,0 +1,224 @@
+"""SPMD mesh serving circuit breaker (ISSUE 1 tentpole c).
+
+The old latch ("3 exec failures → disabled for the life of the process")
+is replaced by an error-classifying breaker: transient failures (device
+OOM, executor hiccups) open the circuit, half-open after a cooldown, and
+re-enable on the first success; sticky failures (compile/parity bugs)
+latch off permanently. Disable/re-enable events surface in /_nodes/stats.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.parallel import mesh_serving
+from elasticsearch_tpu.parallel.mesh_serving import (
+    MeshServingBreaker,
+    classify_mesh_error,
+)
+from elasticsearch_tpu.rest.server import RestServer
+
+
+class TestErrorClassifier:
+    def test_oom_and_runtime_errors_are_transient(self):
+        assert classify_mesh_error(RuntimeError("RESOURCE_EXHAUSTED")) == (
+            "transient"
+        )
+        assert classify_mesh_error(MemoryError()) == "transient"
+        assert classify_mesh_error(RuntimeError("device out of memory")) == (
+            "transient"
+        )
+        # Unknown runtime failures default to transient: a cooldown'd
+        # retry is recoverable, a permanent disable is not.
+        assert classify_mesh_error(RuntimeError("weird")) == "transient"
+
+    def test_compile_and_parity_errors_are_sticky(self):
+        assert classify_mesh_error(TypeError("bad lowering")) == "sticky"
+        assert classify_mesh_error(ValueError("shape off")) == "sticky"
+        assert classify_mesh_error(
+            RuntimeError("INVALID_ARGUMENT: mismatched operand")
+        ) == "sticky"
+
+
+class TestBreakerStateMachine:
+    def test_transient_trips_then_half_opens_then_closes(self):
+        b = MeshServingBreaker(failure_threshold=2, cooldown_s=0.05)
+        assert b.allow()
+        b.record_failure(RuntimeError("RESOURCE_EXHAUSTED"))
+        assert b.allow()  # below threshold
+        b.record_failure(RuntimeError("RESOURCE_EXHAUSTED"))
+        assert not b.allow()  # open
+        assert b.disable_events == 1
+        time.sleep(0.06)
+        assert b.allow()  # half-open trial
+        b.record_success()
+        assert b.state == "closed"
+        assert b.reenable_events == 1
+        assert b.allow()
+
+    def test_half_open_failure_reopens(self):
+        b = MeshServingBreaker(failure_threshold=1, cooldown_s=0.05)
+        b.record_failure(RuntimeError("oom OOM"))
+        assert not b.allow()
+        time.sleep(0.06)
+        assert b.allow()  # half-open
+        b.record_failure(RuntimeError("OOM again"))
+        assert not b.allow()  # straight back open
+        assert b.disable_events == 2
+
+    def test_sticky_never_reenables(self):
+        b = MeshServingBreaker(failure_threshold=3, cooldown_s=0.0)
+        b.record_failure(TypeError("compile bug"))
+        assert b.sticky
+        assert not b.allow()
+        time.sleep(0.01)
+        assert not b.allow()  # cooldown elapsed; still latched
+        assert b.stats()["state"] == "disabled"
+
+    def test_success_resets_transient_count(self):
+        b = MeshServingBreaker(failure_threshold=2, cooldown_s=10.0)
+        b.record_failure(RuntimeError("OOM"))
+        b.record_success()
+        b.record_failure(RuntimeError("OOM"))
+        assert b.allow()  # counter was reset; one more failure needed
+
+
+MAPPINGS = {
+    "properties": {"body": {"type": "text"}, "tag": {"type": "keyword"}}
+}
+
+
+@pytest.fixture
+def rest():
+    rest = RestServer()
+    status, _ = rest.dispatch(
+        "PUT",
+        "/mb",
+        {},
+        json.dumps(
+            {
+                "settings": {"index": {"number_of_shards": 2}},
+                "mappings": MAPPINGS,
+            }
+        ),
+    )
+    assert status == 200
+    rng = np.random.default_rng(7)
+    lines = []
+    for i in range(40):
+        lines.append(json.dumps({"index": {"_id": f"d{i}"}}))
+        lines.append(
+            json.dumps(
+                {
+                    "body": " ".join(
+                        rng.choice(["ant", "bee", "cat"], rng.integers(2, 6))
+                    ),
+                    "tag": "x",
+                }
+            )
+        )
+    status, resp = rest.dispatch(
+        "POST", "/mb/_bulk", {"refresh": "true"}, "\n".join(lines)
+    )
+    assert status == 200 and not resp["errors"]
+    yield rest
+    rest.close()
+
+
+def search(rest):
+    status, resp = rest.dispatch(
+        "POST",
+        "/mb/_search",
+        {"request_cache": "false"},
+        json.dumps({"query": {"match": {"body": "bee"}}}),
+    )
+    assert status == 200, resp
+    rest.node.request_cache.clear()
+    return resp
+
+
+def test_transient_exec_failure_reenables_after_cooldown(rest, monkeypatch):
+    """Acceptance: an injected transient mesh exec failure no longer
+    disables the SPMD path for the life of the process — it re-enables
+    after the cooldown and the path serves again."""
+    mv = rest.node.get_index("mb").search.mesh_view
+    assert mv is not None
+    mv.breaker = MeshServingBreaker(failure_threshold=2, cooldown_s=0.2)
+    search(rest)
+    assert mv.served >= 1  # the mesh path actually works here
+    served_before = mv.served
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("RESOURCE_EXHAUSTED: injected device OOM")
+
+    real = mesh_serving.sharded_execute
+    monkeypatch.setattr(mesh_serving, "sharded_execute", boom)
+    # Requests during the failure window still answer 200 via the host
+    # loop; the breaker opens at the threshold.
+    for _ in range(2):
+        out = search(rest)
+        assert out["hits"]["total"]["value"] > 0
+    assert mv.served == served_before
+    assert mv.breaker.state == "open"
+    assert mv.breaker.disable_events == 1
+    assert mv.exec_failures == 2
+
+    # The fault clears, but the circuit is still open: within the
+    # cooldown the mesh is not retried.
+    monkeypatch.setattr(mesh_serving, "sharded_execute", real)
+    search(rest)
+    assert mv.served == served_before
+
+    # After the cooldown the half-open trial succeeds and the SPMD path
+    # serves again — no process restart required.
+    time.sleep(0.25)
+    search(rest)
+    assert mv.served == served_before + 1
+    assert mv.breaker.state == "closed"
+    assert mv.breaker.reenable_events == 1
+    # And it keeps serving.
+    search(rest)
+    assert mv.served == served_before + 2
+
+
+def test_disable_reenable_events_visible_in_nodes_stats(rest, monkeypatch):
+    mv = rest.node.get_index("mb").search.mesh_view
+    mv.breaker = MeshServingBreaker(failure_threshold=1, cooldown_s=0.05)
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+
+    real = mesh_serving.sharded_execute
+    monkeypatch.setattr(mesh_serving, "sharded_execute", boom)
+    search(rest)
+    monkeypatch.setattr(mesh_serving, "sharded_execute", real)
+    time.sleep(0.06)
+    search(rest)  # half-open success
+    status, resp = rest.dispatch("GET", "/_nodes/stats", {}, "")
+    assert status == 200
+    mesh_stats = resp["nodes"][rest.node.node_name]["mesh_serving"]
+    assert mesh_stats["disable_events"] == 1
+    assert mesh_stats["reenable_events"] == 1
+    view = mesh_stats["views"]["mb"]
+    assert view["state"] == "closed"
+    assert view["served"] >= 1
+
+
+def test_sticky_failure_stays_disabled(rest, monkeypatch):
+    mv = rest.node.get_index("mb").search.mesh_view
+    mv.breaker = MeshServingBreaker(failure_threshold=3, cooldown_s=0.0)
+    served_before = mv.served
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("INVALID_ARGUMENT: mismatched shard shapes")
+
+    real = mesh_serving.sharded_execute
+    monkeypatch.setattr(mesh_serving, "sharded_execute", boom)
+    search(rest)  # one sticky failure latches immediately
+    monkeypatch.setattr(mesh_serving, "sharded_execute", real)
+    time.sleep(0.01)
+    search(rest)
+    assert mv.served == served_before  # never retried
+    assert mv.breaker.stats()["state"] == "disabled"

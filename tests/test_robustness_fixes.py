@@ -1,0 +1,172 @@
+"""Satellite robustness fixes riding with ISSUE 1.
+
+- parse_distance_meters: longest-suffix-first so nmi/cm/mm are reachable
+- wildcard/_all search matching zero indices → empty success, not 404
+- triple-mustache raw rendering of non-strings emits valid JSON
+- rejected docs leave no ghost dynamic mappings behind
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import ApiError, Node
+from elasticsearch_tpu.query.dsl import parse_distance_meters
+from elasticsearch_tpu.rest.server import RestServer
+from elasticsearch_tpu.script.mustache import render
+
+
+class TestDistanceUnits:
+    @pytest.mark.parametrize(
+        "text,meters",
+        [
+            ("1m", 1.0),
+            ("1km", 1000.0),
+            ("1mi", 1609.344),
+            ("1nmi", 1852.0),  # previously shadowed by "mi"
+            ("1yd", 0.9144),
+            ("1ft", 0.3048),
+            ("1cm", 0.01),
+            ("1mm", 0.001),
+        ],
+    )
+    def test_every_suffix_reachable(self, text, meters):
+        assert parse_distance_meters(text) == pytest.approx(meters)
+
+    def test_bare_numbers(self):
+        assert parse_distance_meters(250) == 250.0
+        assert parse_distance_meters("250") == 250.0
+        assert parse_distance_meters("2.5km") == 2500.0
+
+    def test_nmi_is_not_miles(self):
+        # The regression this guards: "10nmi" parsed as 10 miles.
+        assert parse_distance_meters("10nmi") == pytest.approx(18520.0)
+        assert parse_distance_meters("10nmi") != pytest.approx(16093.44)
+
+
+class TestAllowNoIndices:
+    def test_all_with_no_indices_is_empty_success(self):
+        node = Node()
+        out = node.search("_all", {"query": {"match_all": {}}})
+        assert out["hits"]["total"]["value"] == 0
+        assert out["hits"]["hits"] == []
+        assert out["_shards"]["total"] == 0
+
+    def test_wildcard_matching_nothing_is_empty_success(self):
+        rest = RestServer()
+        rest.dispatch(
+            "PUT", "/existing", {},
+            json.dumps({"mappings": {"properties": {"a": {"type": "text"}}}}),
+        )
+        status, resp = rest.dispatch(
+            "POST", "/nomatch-*/_search", {},
+            json.dumps({"query": {"match_all": {}}}),
+        )
+        assert status == 200, resp
+        assert resp["hits"]["total"]["value"] == 0
+        status, resp = rest.dispatch("GET", "/_search", {}, "")
+        assert status == 200  # _all over one index still works
+        # _count over a zero-match wildcard follows the same contract.
+        status, resp = rest.dispatch("POST", "/nomatch-*/_count", {}, "")
+        assert status == 200 and resp["count"] == 0
+
+    def test_concrete_missing_name_still_404s(self):
+        rest = RestServer()
+        status, resp = rest.dispatch(
+            "POST", "/missing/_search", {},
+            json.dumps({"query": {"match_all": {}}}),
+        )
+        assert status == 404
+        assert resp["error"]["type"] == "index_not_found_exception"
+
+    def test_empty_node_all_search_via_rest(self):
+        rest = RestServer()
+        status, resp = rest.dispatch("GET", "/_search", {}, "")
+        assert status == 200, resp
+        assert resp["hits"]["total"]["value"] == 0
+
+
+class TestMustacheRawRendering:
+    def test_bool_renders_as_json(self):
+        assert render("{{{v}}}", {"v": True}) == "true"
+        assert render("{{{v}}}", {"v": False}) == "false"
+
+    def test_none_renders_as_json_null(self):
+        assert render("{{{v}}}", {"v": None}) == "null"
+
+    def test_missing_variable_renders_empty(self):
+        assert render("{{{gone}}}", {}) == ""
+
+    def test_dict_and_list_render_as_json(self):
+        out = render("{{{v}}}", {"v": {"match": {"f": "x"}}})
+        assert json.loads(out) == {"match": {"f": "x"}}
+        out = render("{{{v}}}", {"v": [1, "two", True, None]})
+        assert json.loads(out) == [1, "two", True, None]
+
+    def test_string_stays_raw_unescaped(self):
+        assert render('{{{v}}}', {"v": 'say "hi" \\'}) == 'say "hi" \\'
+
+    def test_rendered_template_parses_as_search_body(self):
+        template = '{"query": {"bool": {"filter": {{{filters}}}}}}'
+        out = render(
+            template, {"filters": [{"term": {"tag": "x"}}]}
+        )
+        body = json.loads(out)
+        assert body["query"]["bool"]["filter"] == [{"term": {"tag": "x"}}]
+
+
+class TestNoGhostMappings:
+    def test_rejected_doc_leaves_no_dynamic_mapping(self):
+        rest = RestServer()
+        rest.dispatch(
+            "PUT", "/gm", {},
+            json.dumps({"mappings": {"properties": {"n": {"type": "long"}}}}),
+        )
+        # "ghost" (a NEW dynamic field) stages before "n" rejects.
+        status, resp = rest.dispatch(
+            "PUT", "/gm/_doc/1", {},
+            json.dumps({"ghost": "hello", "n": "not-a-number"}),
+        )
+        assert status == 400, resp
+        status, resp = rest.dispatch("GET", "/gm/_mapping", {}, "")
+        props = resp["gm"]["mappings"]["properties"]
+        assert "ghost" not in props, "rejected doc left a ghost mapping"
+        # A subsequent VALID doc maps the field normally.
+        status, _ = rest.dispatch(
+            "PUT", "/gm/_doc/2", {}, json.dumps({"ghost": "hello", "n": 4})
+        )
+        assert status == 200
+        _, resp = rest.dispatch("GET", "/gm/_mapping", {}, "")
+        assert "ghost" in resp["gm"]["mappings"]["properties"]
+
+    def test_rejected_rank_features_leave_no_leaf_mappings(self):
+        rest = RestServer()
+        rest.dispatch(
+            "PUT", "/rf", {},
+            json.dumps(
+                {"mappings": {"properties": {
+                    "feats": {"type": "rank_features"},
+                    "n": {"type": "long"},
+                }}}
+            ),
+        )
+        status, _ = rest.dispatch(
+            "PUT", "/rf/_doc/1", {},
+            json.dumps({"feats": {"a": 1.5, "b": 2.0}, "n": "bad"}),
+        )
+        assert status == 400
+        _, resp = rest.dispatch("GET", "/rf/_mapping", {}, "")
+        props = resp["rf"]["mappings"]["properties"]
+        assert "feats.a" not in props and "feats.b" not in props
+
+    def test_dynamic_mapping_still_works_for_accepted_docs(self):
+        node = Node()
+        node.create_index("dyn")
+        node.index_doc("dyn", {"fresh": "text value", "num": 3}, "1")
+        svc = node.get_index("dyn")
+        assert svc.mappings.get("fresh") is not None
+        assert svc.mappings.get("fresh.keyword") is not None
+        assert svc.mappings.get("num").type in ("long", "double")
+        node.refresh("dyn")
+        out = node.search("dyn", {"query": {"match": {"fresh": "text"}}})
+        assert out["hits"]["total"]["value"] == 1
