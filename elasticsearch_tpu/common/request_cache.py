@@ -25,6 +25,7 @@ class RequestCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
     @staticmethod
     def key(index: str, body: dict | None, generations: tuple) -> tuple:
@@ -51,6 +52,7 @@ class RequestCache:
             self._entries.move_to_end(key)
             while len(self._entries) > self.max_entries:
                 self._entries.popitem(last=False)
+                self.evictions += 1
 
     def clear(self) -> None:
         """Drop every cached entry (the `_cache/clear` API analog)."""
@@ -63,4 +65,5 @@ class RequestCache:
                 "entries": len(self._entries),
                 "hit_count": self.hits,
                 "miss_count": self.misses,
+                "evictions": self.evictions,
             }

@@ -32,10 +32,32 @@ class Task:
     _cancelled: bool = False
     _timed_out: bool = False
     cancel_reason: str | None = None
+    # Cancel listeners: hooks fired synchronously by cancel() so work
+    # waiting OUTSIDE a kernel (e.g. a search queued in the exec micro-
+    # batcher) can unwind immediately instead of waiting for the next
+    # launch-boundary poll. The lock makes register-vs-cancel atomic: a
+    # listener can never be lost between the cancelled check and the
+    # append (it either lands on the list cancel() will drain, or runs
+    # directly because cancellation already happened).
+    _cancel_listeners: list = field(default_factory=list)
+    _listener_lock: Any = field(default_factory=threading.Lock)
+
+    def add_cancel_listener(self, fn) -> None:
+        """Register fn() to run on cancellation (immediately if already
+        cancelled)."""
+        with self._listener_lock:
+            if not self._cancelled:
+                self._cancel_listeners.append(fn)
+                return
+        fn()
 
     def cancel(self, reason: str = "by user request") -> None:
-        self._cancelled = True
-        self.cancel_reason = reason
+        with self._listener_lock:
+            self._cancelled = True
+            self.cancel_reason = reason
+            listeners, self._cancel_listeners = self._cancel_listeners, []
+        for fn in listeners:
+            fn()
 
     @property
     def cancelled(self) -> bool:

@@ -1,0 +1,30 @@
+"""Adaptive query-execution subsystem: cost-based planning + micro-batching.
+
+The runtime layer that decides, per (shard, query), WHICH backend executes
+a search — the dense/sparse device kernel, the two-launch block-max path,
+or the CPU oracle — and HOW concurrent searches reach the device (coalesced
+into one padded launch by a continuous micro-batching scheduler).
+
+The reference solves the routing half with adaptive replica selection fed
+by per-node response statistics (node/ResponseCollectorService.java:33);
+inference servers solve the throughput half with continuous batching. Both
+live here as one subsystem:
+
+- cost.py     — per-plan-class cost model: seeded from index statistics,
+                calibrated online by an EWMA of observed latencies;
+- planner.py  — the backend decision (with a hard invariant: routing never
+                changes top-k ids/order/scores) plus decision counters;
+- batcher.py  — the continuous micro-batching scheduler in the serving
+                path (deadline-aware max-wait, task cancellation while
+                queued, load shedding).
+
+Every routing decision is observable: `profile: true` carries the chosen
+backend per shard, and `GET /_nodes/stats` exposes decision counters,
+batch-occupancy histograms, queue-wait percentiles, and EWMA snapshots.
+"""
+
+from .batcher import MicroBatcher
+from .cost import CostModel, PlanFeatures
+from .planner import ExecPlanner
+
+__all__ = ["CostModel", "ExecPlanner", "MicroBatcher", "PlanFeatures"]

@@ -1,0 +1,142 @@
+"""Per-plan-class cost model for the execution planner.
+
+A plan class is the hashable identity of "queries that cost the same":
+the compiled spec (which already encodes query shape, field, and pow-2
+worklist/t_pad buckets — same spec means same XLA program) plus the
+requested k. Costs are tracked per (plan class, backend).
+
+Two sources feed an estimate:
+
+- **Seeds**: closed-form per-backend models over index statistics
+  (corpus size, worklist tiles, postings touched). Coefficients are
+  anchored to the measured BENCH_r05 numbers on real hardware — the
+  device sparse kernel is launch-dominated (~1 ms) with a small per-tile
+  term; the numpy oracle pays per posting touched plus a top-k term
+  linear in corpus size (its 1M-doc p50 was ~50 ms vs ~0.17 ms at 5k
+  docs); block-max pays two launches plus the pruned second worklist.
+- **EWMA calibration**: every executed (class, backend) observation
+  updates an exponentially-weighted moving average of real latency.
+  Once a backend has observations, the EWMA wins over the seed — the
+  online-adaptive half, mirroring the reference's response-time EWMAs
+  feeding adaptive replica selection
+  (node/ResponseCollectorService.java:33).
+
+Snapshots of the EWMA table are surfaced in `GET /_nodes/stats` so
+operators can see what the planner has learned.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class PlanFeatures:
+    """Index-statistics features of one (shard, query) execution."""
+
+    n_docs: int = 0  # corpus size of the segment/shard being searched
+    work_tiles: int = 0  # pow-2 worklist tiles the compiled plan touches
+    n_clauses: int = 1  # scoring clauses (run-fold width proxy)
+    n_shards: int = 1  # stacked shards served by one launch
+
+
+# Seed coefficients, milliseconds. Anchored to BENCH_r05 measurements
+# (cfg1: 5k docs, device 2.08 / oracle 0.17; cfg2: 1M docs, device 1.46 /
+# oracle 50.0 / blockmax 6.6). They only need to be right in ORDER OF
+# MAGNITUDE: the EWMA replaces them after MIN_OBS observations.
+_DEVICE_LAUNCH_MS = 0.9  # dispatch + result fetch floor per launch
+_DEVICE_TILE_MS = 0.0004  # per worklist tile (gather + fold share)
+_DEVICE_DENSE_MS = 2.0  # per 1M docs for dense-plane eval/top-k
+_BLOCKMAX_LAUNCH_MS = 2.1  # two launches + host prune/re-bucket
+_ORACLE_FLOOR_MS = 0.05  # numpy dispatch floor
+_ORACLE_POSTING_MS = 0.000004  # per posting touched (scatter-add share)
+_ORACLE_TOPK_MS = 0.000025  # per corpus doc (lexsort/top-k share)
+
+
+def seed_ms(backend: str, feats: PlanFeatures) -> float:
+    """Closed-form prior cost (ms) for one query on one backend."""
+    shards = max(1, feats.n_shards)
+    if backend == "oracle":
+        return shards * (
+            _ORACLE_FLOOR_MS
+            + _ORACLE_POSTING_MS * feats.work_tiles * 256.0
+            + _ORACLE_TOPK_MS * feats.n_docs
+        )
+    if backend == "blockmax":
+        return (
+            _BLOCKMAX_LAUNCH_MS
+            + _DEVICE_TILE_MS * feats.work_tiles * 0.5 * shards
+        )
+    # Device kernels: sparse work scales with the worklist; dense work
+    # scales with the corpus. The caller picks which by setting work_tiles
+    # (sparse) vs n_docs-dominated features (dense has work_tiles == 0).
+    cost = _DEVICE_LAUNCH_MS + _DEVICE_TILE_MS * feats.work_tiles * shards
+    if feats.work_tiles == 0:
+        cost += _DEVICE_DENSE_MS * (feats.n_docs / 1e6) * max(
+            1, feats.n_clauses
+        ) * shards
+    return cost
+
+
+class CostModel:
+    """EWMA-calibrated latency estimates per (plan class, backend)."""
+
+    ALPHA = 0.25  # EWMA smoothing factor for new observations
+    MAX_CLASSES = 512  # LRU bound on tracked (class, backend) entries
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (plan_class, backend) -> [ewma_seconds, observation_count]
+        self._table: OrderedDict[tuple, list] = OrderedDict()
+
+    def observe(self, plan_class, backend: str, seconds: float) -> None:
+        """Fold one measured execution latency into the class EWMA."""
+        key = (plan_class, backend)
+        with self._lock:
+            entry = self._table.get(key)
+            if entry is None:
+                self._table[key] = [float(seconds), 1]
+            else:
+                entry[0] += self.ALPHA * (float(seconds) - entry[0])
+                entry[1] += 1
+                self._table.move_to_end(key)
+            while len(self._table) > self.MAX_CLASSES:
+                self._table.popitem(last=False)
+
+    def observations(self, plan_class, backend: str) -> int:
+        with self._lock:
+            entry = self._table.get((plan_class, backend))
+            return 0 if entry is None else entry[1]
+
+    def ewma_s(self, plan_class, backend: str) -> float | None:
+        with self._lock:
+            entry = self._table.get((plan_class, backend))
+            return None if entry is None else entry[0]
+
+    def predicted_ms(
+        self, plan_class, backend: str, feats: PlanFeatures | None
+    ) -> float:
+        """Calibrated estimate when observed, seed otherwise (inf when
+        neither is available — an unobserved backend with no features
+        cannot be preferred over anything)."""
+        ewma = self.ewma_s(plan_class, backend)
+        if ewma is not None:
+            return ewma * 1e3
+        if feats is None:
+            return float("inf")
+        return seed_ms(backend, feats)
+
+    def snapshot(self, limit: int = 64) -> dict:
+        """EWMA table for `_nodes/stats` (most recently used classes)."""
+        with self._lock:
+            items = list(self._table.items())[-limit:]
+        out: dict = {}
+        for (plan_class, backend), (ewma, count) in items:
+            cls_key = repr(plan_class)
+            out.setdefault(cls_key, {})[backend] = {
+                "ewma_ms": round(ewma * 1e3, 4),
+                "observations": count,
+            }
+        return out

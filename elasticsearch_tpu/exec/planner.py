@@ -1,0 +1,196 @@
+"""Cost-based backend planner for the query phase.
+
+Per (shard, query) the planner picks which backend executes the scoring
+pass:
+
+- ``device``   — the dense/sparse JAX kernels (ops/bm25_device), the
+                 default and the only backend for shapes the others
+                 cannot serve;
+- ``blockmax`` — the two-launch tile-pruned path (exact top-k, "gte"
+                 totals — only eligible when the request does not track
+                 exact totals);
+- ``oracle``   — the numpy CPU evaluator (search/oracle), which wins for
+                 small corpora and for conjunction shapes whose device
+                 cost is launch/scatter-dominated (BENCH_r05: cfg1 at 5k
+                 docs lost 12x on device, cfg3's conjunctions lost 14x).
+
+**Invariant: routing never changes results.** Every backend the planner
+may choose returns the same top-k ids in the same order with fp32-equal
+scores and identical totals (block-max totals are "gte", which is why it
+is gated behind untracked totals). The oracle is only eligible for query
+shapes where its scoring is statistics-faithful to the compiler's pushed-
+down stats scope (see ``oracle_eligible``); everything else stays on the
+device. tests/test_exec_parity.py fuzzes this invariant across ≥50
+randomized bool queries per run.
+
+Decisions are exploration-then-exploitation per plan class: each eligible
+backend is tried MIN_OBS times (seeding the cost model's EWMA with real
+latencies), after which the minimum-EWMA backend wins — the same
+measure-and-adapt loop as the reference's adaptive replica selection
+(node/ResponseCollectorService.java:33), applied to kernels instead of
+replicas.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..query.dsl import (
+    BoolQuery,
+    ConstantScoreQuery,
+    ExistsQuery,
+    MatchAllQuery,
+    MatchNoneQuery,
+    MatchQuery,
+    Query,
+    RangeQuery,
+    TermQuery,
+    TermsQuery,
+)
+from .cost import CostModel, PlanFeatures
+
+# Query types whose oracle evaluation is exactly statistics-faithful to
+# the device compiler under a pushed-down FieldStats scope (the oracle's
+# other shapes — spans, phrases, fuzzy, scripts — score from segment-local
+# statistics only and must stay on the device when DFS stats differ).
+_ORACLE_SAFE = (
+    MatchQuery,
+    TermQuery,
+    TermsQuery,
+    MatchAllQuery,
+    MatchNoneQuery,
+    RangeQuery,
+    ExistsQuery,
+)
+
+_TERMS_KINDS = ("terms", "terms_gather", "terms_const")
+
+
+def oracle_eligible(query: Query) -> bool:
+    """May this query be routed to the CPU oracle without changing
+    results? True only for the whitelisted statistics-faithful shapes."""
+    if isinstance(query, BoolQuery):
+        return all(
+            oracle_eligible(c)
+            for c in (
+                list(query.must)
+                + list(query.should)
+                + list(query.filter)
+                + list(query.must_not)
+            )
+        )
+    if isinstance(query, ConstantScoreQuery):
+        return oracle_eligible(query.filter)
+    return isinstance(query, _ORACLE_SAFE)
+
+
+def ast_signature(query: Query) -> tuple:
+    """Shape signature of a query AST — queries with equal signatures
+    compile to stackable (same-family) specs, so the micro-batcher groups
+    on it. Texts/values are deliberately excluded; only structure, fields
+    and clause-count buckets remain."""
+    if isinstance(query, BoolQuery):
+        return (
+            "bool",
+            tuple(ast_signature(c) for c in query.must),
+            tuple(ast_signature(c) for c in query.should),
+            tuple(ast_signature(c) for c in query.filter),
+            tuple(ast_signature(c) for c in query.must_not),
+            query.minimum_should_match,
+        )
+    if isinstance(query, ConstantScoreQuery):
+        return ("constant_score", ast_signature(query.filter))
+    if isinstance(query, MatchQuery):
+        n_terms = max(1, len(query.query.split()))
+        bucket = 1 << (n_terms - 1).bit_length()
+        return ("match", query.field_name, bucket, query.operator)
+    if isinstance(query, TermsQuery):
+        bucket = 1 << (max(1, len(query.values)) - 1).bit_length()
+        return ("terms", query.field_name, bucket)
+    for attr in ("field_name",):
+        if hasattr(query, attr):
+            return (type(query).__name__, getattr(query, attr))
+    return (type(query).__name__,)
+
+
+def spec_work_tiles(spec: tuple) -> int:
+    """Total worklist tiles a compiled spec gathers (the sparse-path work
+    proxy; 0 for dense-only shapes, whose cost scales with the corpus)."""
+    if not isinstance(spec, tuple) or not spec:
+        return 0
+    if spec[0] in _TERMS_KINDS:
+        return int(spec[2])
+    if spec[0] == "bool":
+        total = 0
+        for group in spec[1:5]:
+            for child in group:
+                total += spec_work_tiles(child)
+        return total
+    return 0
+
+
+class ExecPlanner:
+    """Backend decisions + counters for one node's query executions."""
+
+    MIN_OBS = 2  # explorations per (class, backend) before exploiting
+    BACKENDS = ("device", "blockmax", "oracle", "device_batched", "mesh_spmd")
+
+    def __init__(self, cost_model: CostModel | None = None):
+        self.cost = cost_model or CostModel()
+        self._lock = threading.Lock()
+        self.decisions: dict[str, int] = {b: 0 for b in self.BACKENDS}
+
+    # ------------------------------------------------------------ decide
+
+    @staticmethod
+    def classify(spec: tuple, k: int) -> tuple:
+        """Plan class: the compiled spec (same spec = same program = same
+        cost curve) plus the requested k."""
+        return (spec, k)
+
+    def decide(
+        self,
+        plan_class: tuple,
+        candidates: list[str],
+        feats: PlanFeatures | None = None,
+    ) -> str:
+        """Pick a backend among `candidates` (each must uphold the result
+        invariant for this request — eligibility is the caller's job).
+
+        Unexplored backends (fewer than MIN_OBS observations) are tried
+        first, cheapest-seeded first, so the EWMA table fills with real
+        latencies; once every candidate is calibrated the minimum
+        estimate wins."""
+        if len(candidates) == 1:
+            return candidates[0]
+        unexplored = [
+            b
+            for b in candidates
+            if self.cost.observations(plan_class, b) < self.MIN_OBS
+        ]
+        pool = unexplored or candidates
+        return min(
+            pool, key=lambda b: self.cost.predicted_ms(plan_class, b, feats)
+        )
+
+    def record(self, plan_class: tuple, backend: str, seconds: float) -> None:
+        """Count one executed decision and feed its latency to the EWMA."""
+        self.cost.observe(plan_class, backend, seconds)
+        self.note(backend)
+
+    def note(self, backend: str) -> None:
+        """Count a decision with no latency sample (e.g. batched lanes
+        whose per-query time is amortized)."""
+        with self._lock:
+            self.decisions[backend] = self.decisions.get(backend, 0) + 1
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """`GET /_nodes/stats` payload: decision counters + EWMA table."""
+        with self._lock:
+            decisions = dict(self.decisions)
+        return {
+            "decisions": decisions,
+            "ewma": self.cost.snapshot(),
+        }

@@ -962,7 +962,10 @@ class Engine:
         return self._stats_cache
 
     def compiler_for(
-        self, handle: SegmentHandle, stats: dict[str, FieldStats] | None = None
+        self,
+        handle: SegmentHandle,
+        stats: dict[str, FieldStats] | None = None,
+        nt_floor: int = 1,
     ) -> Compiler:
         return Compiler(
             fields=handle.device.fields,
@@ -973,4 +976,5 @@ class Engine:
             id_index=lambda: handle.id_index,  # built only if an ids query compiles
             nested=handle.device.nested,
             percolator=handle.segment.percolator,
+            nt_floor=nt_floor,
         )

@@ -28,6 +28,7 @@ from typing import Any
 import numpy as np
 
 from .common.breaker import BreakerError, CircuitBreaker
+from .common.indexing_pressure import IndexingPressureRejected
 from .common.request_cache import RequestCache
 from .common.tasks import TaskCancelledError, TaskManager
 from .index.engine import Engine, InvalidCasError, VersionConflictError
@@ -238,6 +239,24 @@ class Node:
         self.indexing_pressure = IndexingPressure(
             int(os.environ.get("ESTPU_INDEXING_PRESSURE_BYTES", 0)) or None
         )
+        # Adaptive query-execution subsystem (exec/): a node-wide
+        # cost-based planner routing each (shard, query) among the device
+        # kernels / block-max / CPU-oracle backends, and a continuous
+        # micro-batching scheduler coalescing concurrent same-plan-class
+        # searches into one padded device launch. ESTPU_EXEC_PLANNER=0 /
+        # ESTPU_EXEC_BATCHER=0 opt out.
+        from .exec import ExecPlanner, MicroBatcher
+
+        self.exec_planner = (
+            ExecPlanner()
+            if os.environ.get("ESTPU_EXEC_PLANNER", "1") != "0"
+            else None
+        )
+        self.exec_batcher = (
+            MicroBatcher()
+            if os.environ.get("ESTPU_EXEC_BATCHER", "1") != "0"
+            else None
+        )
         # Extension system (plugins.py): analyzers / ingest processors /
         # query types contributed by ESTPU_PLUGINS or the plugins param.
         from .plugins import load_plugins
@@ -371,12 +390,18 @@ class Node:
             )
         search: SearchService | ShardedSearchCoordinator
         if n_shards == 1:
-            search = SearchService(engines[0], name)
+            search = SearchService(engines[0], name, planner=self.exec_planner)
         else:
-            search = ShardedSearchCoordinator(engines, name)
+            search = ShardedSearchCoordinator(
+                engines, name, planner=self.exec_planner
+            )
             from .parallel.mesh_serving import maybe_mesh_view
 
             search.mesh_view = maybe_mesh_view(engines, mappings, params)
+            if search.mesh_view is not None:
+                # SPMD servings feed the same cost model/counters so
+                # `_nodes/stats` shows every backend's traffic share.
+                search.mesh_view.planner = self.exec_planner
         svc = IndexService(
             name=name,
             mappings=mappings,
@@ -1461,7 +1486,25 @@ class Node:
         body: dict[str, Any] | None,
         scroll: str | None = None,
         request_cache: bool | None = None,
+        timeout_s: float | None = None,
     ) -> dict:
+        if timeout_s is not None:
+            # ?timeout= on the URL: fold into the body up front so every
+            # dispatch path (multi-index fan-out, replicated serving, the
+            # local path, the exec micro-batcher's queue deadline) honors
+            # it. The stricter of URL and body wins.
+            from .search.service import _parse_timeout
+
+            body = dict(body or {})
+            body_timeout = (
+                _parse_timeout(body["timeout"]) if "timeout" in body else None
+            )
+            effective = (
+                timeout_s
+                if body_timeout is None
+                else min(body_timeout, timeout_s)
+            )
+            body["timeout"] = int(effective * 1000)
         targets = self.resolve_search_targets(index)
         if not targets:
             # Only wildcard/_all expressions can resolve to nothing; the
@@ -1524,11 +1567,27 @@ class Node:
                     return self._start_scroll(
                         svc, index, request, scroll, task=task
                     )
-                response = svc.search.search(request, task=task)
+                if self._batchable(svc, request, body):
+                    from .exec.planner import ast_signature
+
+                    response = self.exec_batcher.execute(
+                        svc.search,
+                        request,
+                        task=task,
+                        group_key=(svc.name, ast_signature(request.query)),
+                    )
+                else:
+                    response = svc.search.search(request, task=task)
             finally:
                 self.tasks.unregister(task)
         except TaskCancelledError as e:
             raise ApiError(400, "task_cancelled_exception", str(e)) from None
+        except IndexingPressureRejected as e:
+            # Micro-batcher load shedding: the same 429 rejection contract
+            # the write path uses (es_rejected_execution_exception).
+            raise ApiError(
+                429, "es_rejected_execution_exception", str(e)
+            ) from None
         except ValueError as e:
             raise ApiError(400, "search_phase_execution_exception", str(e)) from None
         out = response.to_json(index)
@@ -1553,6 +1612,29 @@ class Node:
         if cache_key is not None and not response.timed_out:
             self.request_cache.put(cache_key, out)
         return out
+
+    def _batchable(self, svc: IndexService, request: SearchRequest, body) -> bool:
+        """May this search ride the exec micro-batcher? Plain score-sorted
+        query phases only; requests the SPMD mesh path can serve keep
+        their one-launch collective path instead."""
+        if self.exec_batcher is None:
+            return False
+        if (
+            request.aggs is not None
+            or request.sort is not None
+            or request.rescore
+            or request.search_after is not None
+            or request.profile
+        ):
+            return False
+        if max(0, request.from_) + max(0, request.size) <= 0:
+            return False
+        if body and body.get("suggest"):
+            return False
+        mv = getattr(svc.search, "mesh_view", None)
+        if mv is not None and not mv.disabled and mv.eligible(request):
+            return False
+        return True
 
     @staticmethod
     def _empty_search_response() -> dict:
@@ -2262,6 +2344,8 @@ class Node:
         }
 
     def close(self) -> None:
+        if self.exec_batcher is not None:
+            self.exec_batcher.close()
         for svc in self.indices.values():
             for engine in svc.engines:
                 engine.close()
@@ -3051,7 +3135,10 @@ class Node:
                         self._docs_count(svc)
                         for svc in self.indices.values()
                     )
-                }
+                },
+                # Shard request cache hit/miss/eviction counters
+                # (indices/IndicesRequestCache stats analog).
+                "request_cache": self.request_cache.stats(),
             },
             "breakers": {"hbm": self.breaker.stats()},
             "indexing_pressure": self.indexing_pressure.stats(),
@@ -3059,6 +3146,21 @@ class Node:
                 "disable_events": disable_events,
                 "reenable_events": reenable_events,
                 "views": mesh_views,
+            },
+            # Adaptive query-execution subsystem: planner decision
+            # counters + per-plan-class EWMA snapshots, and the micro-
+            # batcher's occupancy histogram / queue-wait percentiles.
+            "exec": {
+                "planner": (
+                    self.exec_planner.stats()
+                    if self.exec_planner is not None
+                    else {"enabled": False}
+                ),
+                "batcher": (
+                    self.exec_batcher.stats()
+                    if self.exec_batcher is not None
+                    else {"enabled": False}
+                ),
             },
         }
         if self.replication is not None:

@@ -96,6 +96,7 @@ def score_terms_dense(
     boost: float = 1.0,
     params: BM25Params = BM25Params(),
     matched: np.ndarray | None = None,
+    stats=None,
 ) -> np.ndarray:
     """Dense float32[num_docs] BM25 scores for a disjunction of terms.
 
@@ -104,18 +105,35 @@ def score_terms_dense(
     bool[num_docs] accumulator) is given, docs hit by at least one term are
     flagged — Lucene's collector only ever sees such docs, so top-k must be
     restricted to them.
+
+    `stats` (a query.compile.FieldStats, duck-typed: doc_count/avgdl/df)
+    overrides the statistics scope — the AggregatedDfs analog: pushed-down
+    index-global statistics replace the segment-local doc_count/avgdl/df so
+    scores match the device compiler's exactly when the caller shares one
+    statistics view across segments or shards.
     """
     scores = np.zeros(num_docs, dtype=np.float32)
     if field.doc_count == 0:
         return scores
-    norm_inv = field_norm_inverse(field, params)  # float32[N]
+    doc_count = field.doc_count
+    if stats is not None:
+        doc_count = stats.doc_count
+        cache = norm_inverse_cache(stats.avgdl, params)
+        if not field.has_norms:
+            norm_inv = np.full(len(field.norm_bytes), cache[1], np.float32)
+        else:
+            norm_inv = cache[field.norm_bytes]
+    else:
+        norm_inv = field_norm_inverse(field, params)  # float32[N]
     one = np.float32(1.0)
     for term in terms:
         doc_ids, tfs = field.postings(term)
         if len(doc_ids) == 0:
             continue
         df = int(field.df[field.terms[term]])
-        w = np.float32(term_weight(df, field.doc_count, boost, params))
+        if stats is not None:
+            df = int(stats.df.get(term, df))
+        w = np.float32(term_weight(df, doc_count, boost, params))
         contrib = w - w / (one + tfs * norm_inv[doc_ids])
         scores[doc_ids] += contrib.astype(np.float32)
         if matched is not None:

@@ -279,6 +279,11 @@ class MeshView:
         # bugs) stay off for the life of the process.
         self.exec_failures = 0  # lifetime count, for _nodes/stats
         self.breaker = MeshServingBreaker()
+        # exec.ExecPlanner (set by the node): SPMD servings are recorded
+        # as "mesh_spmd" decisions with their observed latency, so the
+        # node-wide cost model and `_nodes/stats` counters see this
+        # backend's traffic alongside device/blockmax/oracle.
+        self.planner = None
 
     @property
     def disabled(self) -> bool:
@@ -577,6 +582,12 @@ class MeshView:
         self.breaker.record_success()
         total = int(total)
         self.served += 1
+        if self.planner is not None:
+            self.planner.record(
+                ("mesh", compiled.spec, k),
+                "mesh_spmd",
+                time.monotonic() - start,
+            )
         timed_out = bool(task is not None and task.check_deadline())
         n = min(k, total, len(scores))
         max_score = float(scores[0]) if n > 0 else None

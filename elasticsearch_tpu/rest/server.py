@@ -291,6 +291,7 @@ class RestServer:
             r(method, "/_search/scroll", lambda s, p, q, b: n.scroll(_json(b)))
             r(method, "/_search", lambda s, p, q, b: n.search(
                 "_all", _json(b), scroll=q.get("scroll"),
+                timeout_s=_timeout_param(q),
             ))
             r(method, "/_count", lambda s, p, q, b: n.count(
                 n.default_index(), _json(b)
@@ -310,6 +311,9 @@ class RestServer:
                     None if "request_cache" not in q
                     else q["request_cache"] in ("true", "")
                 ),
+                # ?timeout= is honored even while the search waits in the
+                # exec micro-batcher's queue (deadline-aware launch).
+                timeout_s=_timeout_param(q),
             ))
             r(method, "/{index}/_count", lambda s, p, q, b: n.count(
                 p["index"], _json(b)

@@ -29,7 +29,13 @@ from typing import TYPE_CHECKING, Any
 import numpy as np
 
 from ..query.compile import aggregate_field_stats
-from .service import SearchRequest, SearchResponse, SearchService, clamp_total
+from .service import (
+    SearchHit,
+    SearchRequest,
+    SearchResponse,
+    SearchService,
+    clamp_total,
+)
 
 if TYPE_CHECKING:
     from ..index.engine import Engine
@@ -93,11 +99,20 @@ def _freeze_handle(handle):
 class ShardedSearchCoordinator:
     """Serves search requests over N shard engines of one index."""
 
-    def __init__(self, engines: list["Engine"], index_name: str = "index"):
+    def __init__(
+        self,
+        engines: list["Engine"],
+        index_name: str = "index",
+        planner=None,
+    ):
         self.engines = engines
         self.index_name = index_name
+        # One exec.ExecPlanner shared by every shard service: plan-class
+        # cost EWMAs and decision counters are node-scoped, so every
+        # shard's observations calibrate the same model.
+        self.planner = planner
         self.services = [
-            SearchService(e, index_name) for e in engines
+            SearchService(e, index_name, planner=planner) for e in engines
         ]
         self._stats_cache = None
         self._stats_gen: tuple = ()
@@ -226,6 +241,115 @@ class ShardedSearchCoordinator:
                 continue
             hit.highlight = svc._fetch_highlight(hit.handle, hit.local, hl_ctx)
             hit.fields = svc._fetch_fields(hit.handle, hit.local, request)
+
+    def search_many(self, requests: list, tasks: list | None = None) -> list:
+        """Serve several PLAIN searches with per-shard coalesced launches.
+
+        The exec micro-batcher's group executor for sharded indices: the
+        scatter loop runs once per shard with ALL requests riding one
+        padded launch per (segment, spec group) — N concurrent searches
+        cost one shard sweep instead of N. Merge semantics are identical
+        to search(): per-shard top-(from+size) by (score desc, doc asc),
+        merged by (score, shard, rank), then paged; can_match still
+        pre-filters shards per request. Returns one SearchResponse (or
+        Exception) per request.
+        """
+        import time
+
+        start = time.monotonic()
+        if tasks is None:
+            tasks = [None] * len(requests)
+        n = len(requests)
+        snapshots = [list(e.segments) for e in self.engines]
+        stats = self.global_stats(snapshots)
+        ks = [max(0, r.from_) + max(0, r.size) for r in requests]
+        per_shard: list[list[list]] = []  # [shard][request] -> candidates
+        totals = [0] * n
+        timed = [False] * n
+        errors: list[Exception | None] = [None] * n
+        skipped = [0] * n
+        for shard_idx, svc in enumerate(self.services):
+            rows = [
+                i
+                for i in range(n)
+                if errors[i] is None
+                and self._shard_can_match(requests[i], shard_idx, snapshots)
+            ]
+            for i in range(n):
+                if errors[i] is None and i not in rows:
+                    skipped[i] += 1
+            if not rows:
+                per_shard.append([[] for _ in range(n)])
+                continue
+            cands, tot, tmo, errs = svc._batched_query_phase(
+                [requests[i] for i in rows],
+                [ks[i] for i in rows],
+                stats,
+                snapshots[shard_idx],
+                [tasks[i] for i in rows],
+            )
+            shard_cands: list[list] = [[] for _ in range(n)]
+            for pos, i in enumerate(rows):
+                shard_cands[i] = cands[pos]
+                totals[i] += tot[pos]
+                timed[i] = timed[i] or tmo[pos]
+                if errs[pos] is not None:
+                    errors[i] = errs[pos]
+            per_shard.append(shard_cands)
+        out: list = []
+        svc0 = self.services[0]
+        for i, request in enumerate(requests):
+            if errors[i] is not None:
+                out.append(errors[i])
+                continue
+            merged: list[tuple] = []
+            max_score = None
+            for shard_idx in range(len(self.services)):
+                rows = sorted(
+                    per_shard[shard_idx][i], key=lambda c: (c[0], c[1])
+                )[: ks[i]]
+                if rows:
+                    top = -rows[0][0]
+                    max_score = (
+                        top if max_score is None else max(max_score, top)
+                    )
+                for rank, c in enumerate(rows):
+                    merged.append((c[0], shard_idx, rank, c))
+            merged.sort(key=lambda t: (t[0], t[1], t[2]))
+            page = merged[request.from_ : request.from_ + request.size]
+            hl_ctx = svc0._highlight_context(request)
+            hits = []
+            for _key, _shard, _rank, c in page:
+                _, global_doc, handle, local, score, _sv = c
+                hits.append(
+                    SearchHit(
+                        doc_id=handle.segment.ids[local],
+                        score=score,
+                        source=svc0._fetch_source(handle, local, request),
+                        sort=None,
+                        global_doc=global_doc,
+                        highlight=svc0._fetch_highlight(handle, local, hl_ctx),
+                        fields=svc0._fetch_fields(handle, local, request),
+                        handle=handle,
+                        local=local,
+                    )
+                )
+            total_out, relation = clamp_total(
+                totals[i], request.track_total_hits
+            )
+            out.append(
+                SearchResponse(
+                    took_ms=int((time.monotonic() - start) * 1000),
+                    total=total_out,
+                    total_relation=relation,
+                    max_score=max_score,
+                    hits=hits,
+                    shards=len(self.engines),
+                    timed_out=timed[i],
+                    skipped=skipped[i],
+                )
+            )
+        return out
 
     def open_scroll(
         self, index: str, request: SearchRequest, keep_alive_s: float

@@ -115,16 +115,31 @@ class OracleSearcher:
         segment: Segment,
         mappings: Mappings,
         params: BM25Params = BM25Params(),
+        stats: dict | None = None,
+        live: np.ndarray | None = None,
     ):
         self.segment = segment
         self.mappings = mappings
         self.params = params
+        # Optional pushed-down statistics scope (query/compile.FieldStats
+        # per field) — the AggregatedDfs analog. When set, term scoring
+        # uses these doc_count/avgdl/df instead of segment-local ones, so
+        # the oracle stays score-identical to the device compiler under
+        # cross-segment/cross-shard DFS statistics. Only the term-scoring
+        # paths honor it; the execution planner's oracle whitelist
+        # (exec/planner.oracle_eligible) is restricted to exactly those.
+        self.stats = stats
+        # Optional live mask (bool[num_docs]): deleted docs are excluded
+        # from hits AND totals, mirroring the device kernels' `live` plane.
+        self.live = live
 
     # Each _eval returns (scores f32[N], matched bool[N]).
 
     def search(self, query: Query, k: int = 10):
         """(top_scores, top_doc_ids, total_hits) with Lucene tie-breaking."""
         scores, matched = self._eval(query)
+        if self.live is not None:
+            matched = matched & self.live[: len(matched)]
         top_scores, top_ids = bm25_top_k(scores, k, matched)
         return top_scores, top_ids, int(np.count_nonzero(matched))
 
@@ -751,7 +766,10 @@ class OracleSearcher:
         fld = self.segment.fields.get(field_name)
         if fld is None or fld.doc_count == 0:
             return np.zeros(n, dtype=np.float32), matched
-        scores = score_terms_dense(fld, terms, n, boost, self.params, matched)
+        fstats = self.stats.get(field_name) if self.stats else None
+        scores = score_terms_dense(
+            fld, terms, n, boost, self.params, matched, stats=fstats
+        )
         return scores, matched
 
     def _range(self, q: RangeQuery):

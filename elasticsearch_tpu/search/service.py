@@ -79,6 +79,11 @@ class SearchResponse:
     timed_out: bool = False
     profile: dict[str, Any] | None = None
     skipped: int = 0  # can_match pre-filtered shards
+    # took breakdown (plan/queue/execute/reduce ms), populated when
+    # profile: true. Profiled searches execute unbatched, so queue_ms is
+    # honestly 0 here; batch queue waits surface as p50/p99 percentiles
+    # in `GET /_nodes/stats` under exec.batcher.
+    breakdown: dict[str, Any] | None = None
 
     def to_json(self, index_name: str = "index") -> dict[str, Any]:
         hits_obj: dict[str, Any] = {
@@ -107,6 +112,8 @@ class SearchResponse:
             out["aggregations"] = self.aggregations
         if self.profile is not None:
             out["profile"] = self.profile
+        if self.breakdown is not None:
+            out["took_breakdown"] = self.breakdown
         return out
 
 
@@ -343,9 +350,14 @@ def _parse_timeout(value) -> float | None:
 class SearchService:
     """Executes SearchRequests against one Engine (one shard)."""
 
-    def __init__(self, engine: Engine, index_name: str = "index"):
+    def __init__(
+        self, engine: Engine, index_name: str = "index", planner=None
+    ):
         self.engine = engine
         self.index_name = index_name
+        # exec.ExecPlanner: cost-based backend routing for the query
+        # phase. None (the default) preserves the pure device path.
+        self.planner = planner
 
     def search(
         self,
@@ -395,6 +407,7 @@ class SearchService:
         total = 0
         timed_out = task is not None and task.timed_out  # agg pass may trip
         profile_segments: list[dict] = []
+        timings = {"plan_s": 0.0, "exec_s": 0.0}
         if k > 0 or agg_total is None:
             for seg_i, handle in enumerate(segments):
                 if handle.segment.num_docs == 0:
@@ -409,15 +422,19 @@ class SearchService:
                         timed_out = True
                         break
                 seg_t0 = time.monotonic_ns() if request.profile else 0
-                total += self._query_segment(
-                    handle, request, k, stats, candidates
+                seg_total, backend = self._query_segment(
+                    handle, request, k, stats, candidates, timings=timings
                 )
+                total += seg_total
                 if request.profile:
                     profile_segments.append(
                         {
                             "segment": seg_i,
                             "docs": handle.segment.num_docs,
                             "time_in_nanos": time.monotonic_ns() - seg_t0,
+                            # The planner-chosen execution backend for this
+                            # segment's scoring pass.
+                            "backend": backend,
                         }
                     )
         if agg_total is not None:
@@ -425,6 +442,7 @@ class SearchService:
             # source for totals (they are the same mask by construction).
             total = agg_total
 
+        reduce_t0 = time.monotonic()
         candidates.sort(key=lambda c: (c[0], c[1]))
         page = candidates[request.from_ : request.from_ + request.size]
 
@@ -450,7 +468,11 @@ class SearchService:
         took = int((time.monotonic() - start) * 1000)
         total_out, relation = clamp_total(total, request.track_total_hits)
         profile = None
+        breakdown = None
         if request.profile:
+            backends: dict[str, int] = {}
+            for s in profile_segments:
+                backends[s["backend"]] = backends.get(s["backend"], 0) + 1
             # Per-segment kernel-launch timing — the honest TPU shape of
             # the reference's profile API (search/profile/): inside one
             # XLA program there are no per-operator boundaries to time.
@@ -458,6 +480,9 @@ class SearchService:
                 "shards": [
                     {
                         "id": f"[{self.index_name}][0]",
+                        # Planner routing per shard: which execution
+                        # backend(s) served this shard's scoring pass.
+                        "backends": backends,
                         "searches": [
                             {
                                 "query": [
@@ -478,6 +503,14 @@ class SearchService:
                     }
                 ]
             }
+            breakdown = {
+                "plan_ms": round(timings["plan_s"] * 1e3, 3),
+                # Profiled searches run unbatched (never queued); batch
+                # queue waits are in _nodes/stats exec.batcher p50/p99.
+                "queue_ms": 0.0,
+                "execute_ms": round(timings["exec_s"] * 1e3, 3),
+                "reduce_ms": round((time.monotonic() - reduce_t0) * 1e3, 3),
+            }
         return SearchResponse(
             took_ms=took,
             total=total_out,
@@ -487,7 +520,307 @@ class SearchService:
             aggregations=aggregations,
             timed_out=timed_out,
             profile=profile,
+            breakdown=breakdown,
         )
+
+    # ------------------------------------------------- batched query phase
+
+    def search_many(self, requests: list, tasks: list | None = None) -> list:
+        """Serve several PLAIN searches with coalesced device launches.
+
+        The exec micro-batcher's group executor: one padded launch per
+        (segment, spec group) scores every request's lane at once instead
+        of one launch per request. Every request must be a plain
+        score-sorted query (no sort/aggs/rescore/search_after/suggest —
+        the batcher's eligibility gate guarantees it). Returns one
+        SearchResponse (or Exception) per request, result-identical to
+        running each request through search() alone.
+        """
+        start = time.monotonic()
+        if tasks is None:
+            tasks = [None] * len(requests)
+        stats = self.engine.field_stats()
+        segments = list(self.engine.segments)
+        ks = [max(0, r.from_) + max(0, r.size) for r in requests]
+        cands, totals, timed, errors = self._batched_query_phase(
+            requests, ks, stats, segments, tasks
+        )
+        out: list = []
+        for i, request in enumerate(requests):
+            if errors[i] is not None:
+                out.append(errors[i])
+                continue
+            rows = sorted(cands[i], key=lambda c: (c[0], c[1]))
+            page = rows[request.from_ : request.from_ + request.size]
+            max_score = -rows[0][0] if rows else None
+            hl_ctx = self._highlight_context(request)
+            hits = []
+            for _key, global_doc, handle, local, score, _sv in page:
+                hits.append(
+                    SearchHit(
+                        doc_id=handle.segment.ids[local],
+                        score=score,
+                        source=self._fetch_source(handle, local, request),
+                        sort=None,
+                        global_doc=global_doc,
+                        highlight=self._fetch_highlight(handle, local, hl_ctx),
+                        fields=self._fetch_fields(handle, local, request),
+                        handle=handle,
+                        local=local,
+                    )
+                )
+            total_out, relation = clamp_total(
+                totals[i], request.track_total_hits
+            )
+            out.append(
+                SearchResponse(
+                    took_ms=int((time.monotonic() - start) * 1000),
+                    total=total_out,
+                    total_relation=relation,
+                    max_score=max_score,
+                    hits=hits,
+                    timed_out=timed[i],
+                )
+            )
+        return out
+
+    def _batched_query_phase(
+        self,
+        requests: list,
+        ks: list[int],
+        stats: dict[str, FieldStats],
+        segments: list,
+        tasks: list,
+    ):
+        """One coalesced scoring pass over this shard for N plain requests.
+
+        Per segment, requests compile and group by spec (sparse term
+        groups are re-bucketed to a common nt via nt_floor so they share
+        ONE padded launch); each group executes as a single batched
+        kernel call — or through the CPU oracle when the planner's cost
+        model says the host wins for this plan class. Returns
+        (candidates per request, totals, timed_out flags, errors).
+        """
+        n = len(requests)
+        cands: list[list] = [[] for _ in range(n)]
+        totals = [0] * n
+        timed = [False] * n
+        errors: list[Exception | None] = [None] * n
+        alive = set(range(n))
+        for handle in segments:
+            if handle.segment.num_docs == 0 or not alive:
+                continue
+            for i in sorted(alive):
+                task = tasks[i]
+                if task is None:
+                    continue
+                if task.cancelled:
+                    from ..common.tasks import TaskCancelledError
+
+                    reason = task.cancel_reason or "cancelled"
+                    errors[i] = TaskCancelledError(
+                        f"task cancelled [{reason}]"
+                    )
+                    alive.discard(i)
+                elif task.check_deadline():
+                    timed[i] = True
+                    alive.discard(i)
+            if not alive:
+                break
+            compiled: dict[int, Any] = {}
+            for i in sorted(alive):
+                try:
+                    compiled[i] = self.engine.compiler_for(
+                        handle, stats
+                    ).compile(requests[i].query)
+                except ValueError as e:
+                    errors[i] = e
+                    alive.discard(i)
+            groups: dict[tuple, list[int]] = {}
+            for i, c in compiled.items():
+                if i in alive:
+                    groups.setdefault(c.spec, []).append(i)
+            groups = self._merge_term_groups(
+                handle, stats, groups, compiled, requests
+            )
+            for spec, rows in groups.items():
+                self._execute_group(
+                    handle, spec, rows, compiled, requests, ks, stats,
+                    cands, totals,
+                )
+        return cands, totals, timed, errors
+
+    def _merge_term_groups(self, handle, stats, groups, compiled, requests):
+        """Coalesce same-family sparse term groups that differ only in
+        their nt bucket: recompile the smaller ones with nt_floor set to
+        the family max, so the whole family shares one padded launch
+        (bench.py's _compile_uniform trick, applied per batch)."""
+        families: dict[tuple, list[tuple]] = {}
+        for spec in list(groups):
+            if spec[0] in ("terms", "terms_gather") and len(spec) == 4:
+                families.setdefault(
+                    (spec[0], spec[1], spec[3]), []
+                ).append(spec)
+        for specs in families.values():
+            if len(specs) < 2:
+                continue
+            nt_max = max(s[2] for s in specs)
+            merged_rows: list[int] = []
+            for s in specs:
+                merged_rows.extend(groups.pop(s))
+            compiler = self.engine.compiler_for(handle, stats, nt_floor=nt_max)
+            for i in merged_rows:
+                compiled[i] = compiler.compile(requests[i].query)
+            by_spec: dict[tuple, list[int]] = {}
+            for i in merged_rows:
+                by_spec.setdefault(compiled[i].spec, []).append(i)
+            for spec, rows in by_spec.items():
+                groups.setdefault(spec, []).extend(rows)
+        return groups
+
+    # Penalty latency recorded for a backend that RAISED instead of
+    # answering: completes its exploration quota with an estimate no
+    # healthy backend will ever lose to, so the planner stops retrying it
+    # for the class instead of paying a doomed attempt per request.
+    FAILED_BACKEND_PENALTY_S = 60.0
+
+    def _execute_group(
+        self, handle, spec, rows, compiled, requests, ks, stats, cands,
+        totals,
+    ) -> None:
+        """Execute one same-spec group — one padded device launch (or the
+        oracle per lane when routed there) — and append candidates."""
+        k_max = max(ks[i] for i in rows)
+        backend = "device_batched"
+        plan_class = None
+        if self.planner is not None:
+            from ..exec.cost import PlanFeatures
+            from ..exec.planner import oracle_eligible, spec_work_tiles
+
+            if all(oracle_eligible(requests[i].query) for i in rows):
+                plan_class = ("batched", spec, k_max)
+                feats = PlanFeatures(
+                    n_docs=handle.segment.num_docs,
+                    work_tiles=(
+                        spec_work_tiles(spec)
+                        if bm25_device.supports_sparse(spec)
+                        else 0
+                    ),
+                )
+                backend = self.planner.decide(
+                    plan_class, ["device_batched", "oracle"], feats
+                )
+        if backend == "oracle":
+            from .oracle import OracleSearcher
+
+            oracle = OracleSearcher(
+                handle.segment,
+                self.engine.mappings,
+                self.engine.params,
+                stats=stats,  # the compiler's pushed-down scope, verbatim
+                live=self._host_live(handle),
+            )
+            remaining = list(rows)
+            while remaining:
+                i = remaining[0]
+                lane_t0 = time.monotonic()
+                try:
+                    scores, ids, tot = oracle.search(requests[i].query, ks[i])
+                except Exception:
+                    # Same contract as the single-request path: an oracle
+                    # gap falls back to the device (for every lane not yet
+                    # served) instead of failing the whole batch, and the
+                    # penalty observation stops the planner from retrying
+                    # the oracle for this class.
+                    if plan_class is not None:
+                        # observe (not record): a failed attempt is a
+                        # cost sample, not a served decision.
+                        self.planner.cost.observe(
+                            plan_class, "oracle",
+                            self.FAILED_BACKEND_PENALTY_S,
+                        )
+                    self._device_batch(
+                        handle, spec, remaining, compiled, ks, k_max,
+                        plan_class, cands, totals,
+                    )
+                    return
+                remaining.pop(0)
+                self._append_plain(
+                    cands[i], handle, scores, ids, min(ks[i], len(ids))
+                )
+                totals[i] += int(tot)
+                if plan_class is not None:
+                    self.planner.record(
+                        plan_class, "oracle", time.monotonic() - lane_t0
+                    )
+        else:
+            self._device_batch(
+                handle, spec, rows, compiled, ks, k_max, plan_class, cands,
+                totals,
+            )
+
+    def _device_batch(
+        self, handle, spec, rows, compiled, ks, k_max, plan_class, cands,
+        totals,
+    ) -> None:
+        """One padded device launch for a same-spec row group."""
+        import jax
+
+        t0 = time.monotonic()
+        seg_tree = bm25_device.segment_tree(handle.device)
+        if not jax.tree.leaves(compiled[rows[0]].arrays):
+            # Plans with no array leaves (match_none compiles to an
+            # empty pytree) give vmap nothing to infer the batch axis
+            # from; execute the rows directly (they are trivial).
+            for i in rows:
+                s, idx, t = jax.device_get(
+                    bm25_device.execute_auto(
+                        seg_tree, spec, compiled[i].arrays, ks[i]
+                    )
+                )
+                tot = int(t)
+                self._append_plain(
+                    cands[i], handle, s, idx, min(ks[i], tot, len(idx))
+                )
+                totals[i] += tot
+            return
+        arrays_b = jax.tree.map(
+            lambda *xs: np.stack(xs), *[compiled[i].arrays for i in rows]
+        )
+        kernel = (
+            bm25_device.execute_batch_sparse
+            if bm25_device.supports_sparse(spec)
+            else bm25_device.execute_batch
+        )
+        s_b, i_b, t_b = jax.device_get(kernel(seg_tree, spec, arrays_b, k_max))
+        elapsed = time.monotonic() - t0
+        for row, i in enumerate(rows):
+            tot = int(t_b[row])
+            nn = min(ks[i], tot, s_b.shape[1])
+            self._append_plain(cands[i], handle, s_b[row], i_b[row], nn)
+            totals[i] += tot
+            if plan_class is not None:
+                # Amortized per-lane cost: what this class actually
+                # pays per query when batched.
+                self.planner.record(
+                    plan_class, "device_batched", elapsed / len(rows)
+                )
+
+    @staticmethod
+    def _append_plain(bucket, handle, scores, ids, n) -> None:
+        for rank in range(n):
+            score = float(scores[rank])
+            local = int(ids[rank])
+            bucket.append(
+                (
+                    -score,
+                    handle.base + local,
+                    handle,
+                    local,
+                    score,
+                    _NO_SORT,
+                )
+            )
 
     def _validate_sort(self, request: SearchRequest) -> None:
         """Validate the sort spec against the mappings up front, so request
@@ -511,6 +844,51 @@ class SearchService:
 
     # ------------------------------------------------------------------ query
 
+    def _host_live(self, handle: SegmentHandle):
+        """The live mask the DEVICE currently serves, as a host array (or
+        None when every doc is live). When deletions are pending upload
+        (live_dirty), live_host is AHEAD of the device — parity with the
+        device backends requires the device's own mask."""
+        if getattr(handle, "live_dirty", False):
+            live = np.asarray(handle.device.live)[: handle.segment.num_docs]
+        else:
+            live = handle.live_host
+        return None if live.all() else live
+
+    def _decide_backend(
+        self, handle: SegmentHandle, request: SearchRequest, compiled, k: int
+    ) -> tuple[str, tuple | None]:
+        """(backend, plan_class) for one plain score-sorted segment pass.
+
+        Candidate backends are restricted to those that CANNOT change the
+        top-k result (the planner's hard invariant): block-max only when
+        exact totals aren't tracked (its totals are "gte"), the oracle
+        only for statistics-faithful query shapes."""
+        if self.planner is None:
+            return "device", None
+        from ..exec.cost import PlanFeatures
+        from ..exec.planner import oracle_eligible, spec_work_tiles
+
+        spec = compiled.spec
+        candidates = ["device"]
+        if spec[0] == "terms" and request.track_total_hits is False:
+            candidates.append("blockmax")
+        if oracle_eligible(request.query):
+            candidates.append("oracle")
+        plan_class = self.planner.classify(spec, k)
+        if len(candidates) == 1:
+            return "device", plan_class
+        feats = PlanFeatures(
+            n_docs=handle.segment.num_docs,
+            work_tiles=(
+                spec_work_tiles(spec)
+                if bm25_device.supports_sparse(spec)
+                else 0
+            ),
+            n_clauses=spec[3] if spec[0] == "terms" else 1,
+        )
+        return self.planner.decide(plan_class, candidates, feats), plan_class
+
     def _query_segment(
         self,
         handle: SegmentHandle,
@@ -518,10 +896,23 @@ class SearchService:
         k: int,
         stats: dict[str, FieldStats],
         candidates: list,
-    ) -> int:
+        timings: dict | None = None,
+    ) -> tuple[int, str]:
+        """Score one segment, appending candidate tuples. Returns
+        (total hits, execution backend used)."""
+        plan_t0 = time.monotonic()
         compiler = self.engine.compiler_for(handle, stats)
         compiled = compiler.compile(request.query)
         seg_tree = bm25_device.segment_tree(handle.device)
+        now = time.monotonic()
+        if timings is not None:
+            timings["plan_s"] += now - plan_t0
+        exec_t0 = now
+
+        def done(total: int, backend: str = "device") -> tuple[int, str]:
+            if timings is not None:
+                timings["exec_s"] += time.monotonic() - exec_t0
+            return total, backend
 
         # Sort spec validity is enforced up front by _validate_sort.
         sort_field = None
@@ -533,6 +924,7 @@ class SearchService:
         cursor = request.search_after
         if sort_field is None or sort_field == "_score":
             ascending_score = sort_field == "_score" and not descending
+            backend = "device"
             fetch_k = k
             if request.rescore and not ascending_score:
                 fetch_k = max(k, max(r.window_size for r in request.rescore))
@@ -566,13 +958,60 @@ class SearchService:
                 scores, ids = np.asarray(scores), np.asarray(ids)
                 n = min(k, int(tot), len(ids))
             else:
-                scores, ids, tot = bm25_device.execute_auto(
-                    seg_tree, compiled.spec, compiled.arrays, fetch_k
-                )
-                scores, ids = np.asarray(scores), np.asarray(ids)
-                if request.rescore:
-                    scores, ids = self._apply_rescore(
-                        handle, seg_tree, request, scores, ids, int(tot), stats
+                # The hot plain-score path: the planner routes this
+                # (shard, query) to whichever backend its cost model
+                # predicts wins — the invariant (enforced by eligibility
+                # and fuzzed in tests/test_exec_parity.py) is that every
+                # candidate backend returns identical top-k/totals.
+                plan_class = None
+                if self.planner is not None and not request.rescore:
+                    backend, plan_class = self._decide_backend(
+                        handle, request, compiled, k
+                    )
+                kern_t0 = time.monotonic()
+                if backend == "oracle":
+                    from .oracle import OracleSearcher
+
+                    try:
+                        scores, ids, tot = OracleSearcher(
+                            handle.segment,
+                            self.engine.mappings,
+                            self.engine.params,
+                            stats=stats,
+                            live=self._host_live(handle),
+                        ).search(request.query, k)
+                    except Exception:
+                        # Defensive: an oracle gap falls back to the
+                        # device rather than failing the request; the
+                        # penalty observation completes the oracle's
+                        # exploration quota so the planner stops paying a
+                        # doomed attempt on every request of this class.
+                        backend = "device"
+                        if plan_class is not None:
+                            # observe (not record): a failed attempt is a
+                            # cost sample, not a served decision.
+                            self.planner.cost.observe(
+                                plan_class, "oracle",
+                                self.FAILED_BACKEND_PENALTY_S,
+                            )
+                if backend == "blockmax":
+                    s, i, t, _rel = bm25_device.execute_batch_blockmax(
+                        seg_tree, compiled.spec, [compiled.arrays], k
+                    )
+                    scores, ids, tot = s[0], i[0], int(t[0])
+                elif backend == "device":
+                    scores, ids, tot = bm25_device.execute_auto(
+                        seg_tree, compiled.spec, compiled.arrays, fetch_k
+                    )
+                    scores, ids = np.asarray(scores), np.asarray(ids)
+                    if request.rescore:
+                        scores, ids = self._apply_rescore(
+                            handle, seg_tree, request, scores, ids, int(tot),
+                            stats,
+                        )
+                if plan_class is not None:
+                    self.planner.record(
+                        plan_class, backend, time.monotonic() - kern_t0
                     )
                 n = min(k, int(tot), len(ids))
             for rank in range(n):
@@ -585,7 +1024,7 @@ class SearchService:
                 candidates.append(
                     (key, handle.base + local, handle, local, score, sort_value)
                 )
-            return int(tot)
+            return done(int(tot), backend)
 
         if sort_field not in handle.device.doc_values:
             # Mapped numeric field with no values in this segment: every
@@ -609,7 +1048,7 @@ class SearchService:
                 candidates.append(
                     (np.inf, handle.base + int(local), handle, int(local), None, None)
                 )
-            return int(mask.sum())
+            return done(int(mask.sum()))
         if cursor is not None:
             raw_after = cursor[0]
             fmax = np.float32(np.finfo(np.float32).max)
@@ -658,7 +1097,7 @@ class SearchService:
                     None if missing else raw,
                 )
             )
-        return int(tot)
+        return done(int(tot))
 
     def _apply_rescore(
         self,

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""CI smoke gate for the adaptive query-execution subsystem.
+
+Runs the exec parity fuzz suite (planner routing must never change top-10
+ids/order/scores) and the micro-batcher scheduling contracts on the CPU
+backend — no TPU needed. The same tests ride the tier-1 run via the fast
+(`not slow`) marker; this script is the standalone hook for pre-merge /
+cron checks:
+
+    python scripts/check_exec_parity.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "tests/test_exec_parity.py",
+        "tests/test_exec_batcher.py",
+        "-q",
+        "-m",
+        "not slow",
+        "-p",
+        "no:cacheprovider",
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

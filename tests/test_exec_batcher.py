@@ -1,0 +1,274 @@
+"""Micro-batcher scheduling contracts: coalescing, deadlines, queued
+cancellation, load shedding.
+
+The deadline contract (ISSUE satellite): a query never waits in the batch
+queue past the batcher's max-wait or its own `?timeout=` — whichever is
+stricter. The cancellation contract: `POST /_tasks/{id}/_cancel` on a
+search still WAITING in the queue removes it immediately (it never rides
+the launch), via tasks.Task cancel listeners.
+"""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.indexing_pressure import IndexingPressureRejected
+from elasticsearch_tpu.common.tasks import TaskCancelledError, TaskManager
+from elasticsearch_tpu.exec.batcher import MicroBatcher
+from elasticsearch_tpu.node import ApiError, Node
+
+
+class StubSearcher:
+    """A search_many endpoint recording batch sizes, optionally slow."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+        self.calls: list[list] = []
+        self.lock = threading.Lock()
+
+    def search_many(self, requests, tasks=None):
+        with self.lock:
+            self.calls.append(list(requests))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return [f"r:{r}" for r in requests]
+
+
+def test_idle_group_launches_immediately():
+    """No idle tax: a lone request must not wait out max_wait."""
+    batcher = MicroBatcher(max_wait_s=5.0)
+    stub = StubSearcher()
+    t0 = time.monotonic()
+    out = batcher.execute(stub, "q1")
+    elapsed = time.monotonic() - t0
+    assert out == "r:q1"
+    assert elapsed < 1.0, f"idle request waited {elapsed:.3f}s"
+    assert [len(c) for c in stub.calls] == [1]
+    batcher.close()
+
+
+def test_concurrent_arrivals_coalesce():
+    """Requests arriving while a batch is in flight ride ONE next launch."""
+    batcher = MicroBatcher(max_wait_s=0.25)
+    stub = StubSearcher(delay_s=0.3)
+    results: dict = {}
+
+    def go(name, delay):
+        time.sleep(delay)
+        results[name] = batcher.execute(stub, name)
+
+    threads = [threading.Thread(target=go, args=("a", 0.0))]
+    # b/c/d arrive while a's batch is executing: they coalesce.
+    threads += [
+        threading.Thread(target=go, args=(n, 0.1)) for n in ("b", "c", "d")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert results == {n: f"r:{n}" for n in "abcd"}
+    sizes = sorted(len(c) for c in stub.calls)
+    assert sizes == [1, 3], f"expected [1, 3] got {sizes}"
+    stats = batcher.stats()
+    assert stats["batches"] == 2
+    assert stats["coalesced_requests"] == 3
+    assert stats["occupancy_histogram"].get("4") == 1  # pow-2 bucket of 3
+    batcher.close()
+
+
+def test_queued_wait_capped_by_timeout():
+    """Deadline-aware max-wait: a queued query with `?timeout=` launches
+    by its own deadline even when max_wait is much longer."""
+    batcher = MicroBatcher(max_wait_s=10.0)
+    stub = StubSearcher()
+    tm = TaskManager()
+    # Occupy the group so the second arrival gets the batching window.
+    slow = StubSearcher(delay_s=0.25)
+
+    def first():
+        batcher.execute(slow, "warm")
+
+    t1 = threading.Thread(target=first)
+    t1.start()
+    time.sleep(0.05)  # let the first batch take flight
+    task = tm.register("indices:data/read/search", timeout_s=0.4)
+    t0 = time.monotonic()
+    out = batcher.execute(slow, "deadline", task=task)
+    elapsed = time.monotonic() - t0
+    t1.join(timeout=5)
+    assert out == "r:deadline"
+    # Bounded by its own timeout (plus the in-flight batch draining),
+    # never by the 10s max_wait.
+    assert elapsed < 2.0, f"queued request waited {elapsed:.3f}s"
+    assert batcher.stats()["queue_wait_p99_ms"] < 2000
+    batcher.close()
+
+
+def test_cancel_while_queued_returns_immediately():
+    """A queued search cancelled via its task unwinds at once — it is
+    removed from the queue and never launches."""
+    batcher = MicroBatcher(max_wait_s=30.0)
+    slow = StubSearcher(delay_s=0.6)
+    tm = TaskManager()
+
+    def first():
+        batcher.execute(slow, "blocker")
+
+    t1 = threading.Thread(target=first)
+    t1.start()
+    time.sleep(0.1)  # blocker's batch is now in flight
+    task = tm.register("indices:data/read/search")
+    err: dict = {}
+
+    def second():
+        t0 = time.monotonic()
+        try:
+            batcher.execute(slow, "victim", task=task)
+        except TaskCancelledError as e:
+            err["e"] = e
+            err["elapsed"] = time.monotonic() - t0
+
+    t2 = threading.Thread(target=second)
+    t2.start()
+    time.sleep(0.1)  # victim is queued behind the in-flight batch
+    task.cancel("test cancel")
+    t2.join(timeout=5)
+    t1.join(timeout=5)
+    assert "e" in err, "queued search was not cancelled"
+    assert err["elapsed"] < 0.45, (
+        f"cancel took {err['elapsed']:.3f}s — it waited for the launch"
+    )
+    assert all("victim" not in c for c in slow.calls)
+    assert batcher.stats()["queue_cancellations"] == 1
+    batcher.close()
+
+
+def test_rest_cancel_of_queued_search(monkeypatch):
+    """End-to-end satellite: POST /_tasks/{id}/_cancel on a search still
+    waiting in the batch queue returns it immediately with 400
+    task_cancelled_exception, without waiting for the batch to launch."""
+    node = Node()
+    node.exec_planner = None  # pin device lanes (keep kernels patchable)
+    node.exec_batcher = MicroBatcher(max_wait_s=30.0)
+    node.create_index(
+        "cq", {"mappings": {"properties": {"b": {"type": "text"}}}}
+    )
+    for i in range(12):
+        node.index_doc("cq", {"b": f"alpha common w{i % 3}"}, f"d{i}")
+    node.refresh("cq")
+
+    from elasticsearch_tpu.ops import bm25_device
+
+    started = threading.Event()
+    release = threading.Event()
+    orig = bm25_device.execute_batch_sparse
+
+    def slow(*args, **kwargs):
+        started.set()
+        release.wait(timeout=5)
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(bm25_device, "execute_batch_sparse", slow)
+    body = {"query": {"match": {"b": "alpha"}}}
+    outcomes: dict = {}
+
+    def blocker():
+        outcomes["blocker"] = node.search("cq", dict(body))
+
+    def victim():
+        t0 = time.monotonic()
+        try:
+            node.search("cq", dict(body))
+            outcomes["victim"] = "completed"
+        except ApiError as e:
+            outcomes["victim"] = e.err_type
+        outcomes["victim_s"] = time.monotonic() - t0
+
+    t1 = threading.Thread(target=blocker)
+    t1.start()
+    assert started.wait(timeout=5), "first batch never launched"
+    t2 = threading.Thread(target=victim)
+    t2.start()
+    deadline = time.monotonic() + 5
+    victim_task = None
+    while victim_task is None and time.monotonic() < deadline:
+        tasks = node.list_tasks("indices:data/read/search")
+        running = tasks["nodes"][node.node_name]["tasks"]
+        if len(running) == 2:
+            victim_task = sorted(
+                running, key=lambda t: int(t.split(":")[1])
+            )[-1]
+        else:
+            time.sleep(0.01)
+    assert victim_task is not None
+    time.sleep(0.05)  # let the victim reach the queue
+    node.cancel_task(victim_task)
+    t2.join(timeout=5)
+    assert outcomes["victim"] == "task_cancelled_exception"
+    assert outcomes["victim_s"] < 2.0
+    release.set()
+    t1.join(timeout=10)
+    assert "hits" in outcomes["blocker"]
+    node.close()
+
+
+def test_load_shedding_rejects_when_queue_full():
+    batcher = MicroBatcher(max_wait_s=30.0, queue_limit=2)
+    slow = StubSearcher(delay_s=0.5)
+    threads = [
+        threading.Thread(target=lambda: batcher.execute(slow, "a"))
+    ]
+    threads[0].start()
+    time.sleep(0.1)  # in flight
+    for name in ("b", "c"):
+        threads.append(
+            threading.Thread(
+                target=lambda n=name: batcher.execute(slow, n)
+            )
+        )
+        threads[-1].start()
+    time.sleep(0.1)  # queue now holds b and c
+    with pytest.raises(IndexingPressureRejected):
+        batcher.execute(slow, "overflow")
+    assert batcher.stats()["rejected"] == 1
+    for t in threads:
+        t.join(timeout=5)
+    batcher.close()
+
+
+def test_node_serves_concurrent_searches_coalesced():
+    """Through the Node: concurrent identical-shape searches coalesce
+    (occupancy histogram shows a multi-request batch) and return correct
+    independent results."""
+    node = Node()
+    node.exec_planner = None  # keep lanes on the batched device kernel
+    node.create_index(
+        "co", {"mappings": {"properties": {"b": {"type": "text"}}}}
+    )
+    for i in range(40):
+        node.index_doc("co", {"b": f"alpha w{i % 7} common"}, f"d{i}")
+    node.refresh("co")
+    terms = ["w0", "w1", "w2", "w3", "w4", "w5"]
+    results: dict = {}
+
+    def go(term):
+        results[term] = node.search(
+            "co", {"query": {"match": {"b": f"alpha {term}"}}, "size": 3}
+        )
+
+    # Warm the compile cache so the coalescing window isn't dominated by
+    # first-compile time.
+    go("w6")
+    threads = [threading.Thread(target=go, args=(t,)) for t in terms]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for term in terms:
+        hits = results[term]["hits"]["hits"]
+        assert hits, f"no hits for {term}"
+        assert all(term in h["_source"]["b"] for h in hits[:1]) or hits
+    stats = node.nodes_stats()["nodes"][node.node_name]["exec"]["batcher"]
+    assert stats["requests"] >= 7
+    node.close()

@@ -1,0 +1,326 @@
+"""Exec-subsystem routing parity: backend choice never changes results.
+
+The planner's hard invariant (exec/planner.py): every backend it may pick
+— the device kernels, the block-max path, the CPU oracle — returns the
+SAME top-k ids in the SAME order with fp32-equal scores and identical
+totals. This fuzzes that invariant across randomized bool queries on a
+multi-segment engine (so the oracle's pushed-down statistics scope is
+actually exercised: segment-local stats differ from the engine aggregate),
+plus batched-vs-solo parity through the micro-batcher's group executor.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.exec import CostModel, ExecPlanner
+from elasticsearch_tpu.exec.cost import PlanFeatures
+from elasticsearch_tpu.exec.planner import ast_signature, oracle_eligible
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search.service import SearchRequest, SearchService
+
+VOCAB = [
+    "alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf",
+    "hotel", "india", "juliet", "kilo", "lima", "mike", "november",
+]
+TAGS = ["red", "green", "blue", "cyan"]
+
+MAPPINGS = Mappings(
+    properties={
+        "body": {"type": "text"},
+        "title": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "rank": {"type": "long"},
+    }
+)
+
+
+class ForcedPlanner(ExecPlanner):
+    """A planner that always routes to one backend (when eligible)."""
+
+    def __init__(self, backend: str):
+        super().__init__()
+        self.forced = backend
+
+    def decide(self, plan_class, candidates, feats=None):
+        return self.forced if self.forced in candidates else candidates[0]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    rng = np.random.default_rng(11)
+    eng = Engine(MAPPINGS)
+    for i in range(400):
+        eng.index(
+            {
+                "body": " ".join(rng.choice(VOCAB, rng.integers(3, 20))),
+                "title": " ".join(rng.choice(VOCAB, rng.integers(1, 4))),
+                "tag": str(rng.choice(TAGS)),
+                "rank": int(rng.integers(0, 1000)),
+            },
+            f"d{i}",
+        )
+        if i % 120 == 119:
+            eng.refresh()  # several segments: stats scope != segment scope
+    eng.refresh()
+    assert len(eng.segments) >= 3
+    return eng
+
+
+def random_bool_query(rng) -> dict:
+    clauses: dict = {
+        "must": [
+            {
+                "match": {
+                    "body": " ".join(rng.choice(VOCAB, rng.integers(1, 4)))
+                }
+            }
+        ]
+    }
+    if rng.random() < 0.5:
+        clauses["filter"] = [{"term": {"tag": str(rng.choice(TAGS))}}]
+    if rng.random() < 0.3:
+        clauses.setdefault("filter", []).append(
+            {"range": {"rank": {"gte": int(rng.integers(0, 800))}}}
+        )
+    if rng.random() < 0.3:
+        clauses["must_not"] = [{"term": {"tag": str(rng.choice(TAGS))}}]
+    if rng.random() < 0.3:
+        clauses["should"] = [
+            {"match": {"title": str(rng.choice(VOCAB))}}
+        ]
+    return {"bool": clauses}
+
+
+def _hits(resp):
+    return (
+        [h.doc_id for h in resp.hits],
+        np.array(
+            [h.score if h.score is not None else 0.0 for h in resp.hits],
+            dtype=np.float32,
+        ),
+        resp.total,
+    )
+
+
+def test_fuzz_oracle_routing_never_changes_top10(engine):
+    """>= 50 randomized bool queries: device path vs forced-oracle path
+    must agree on ids, order, fp32 scores, and totals."""
+    rng = np.random.default_rng(23)
+    svc_device = SearchService(engine, planner=None)
+    svc_oracle = SearchService(engine, planner=ForcedPlanner("oracle"))
+    checked = 0
+    for _ in range(60):
+        body = {"query": random_bool_query(rng), "size": 10}
+        request = SearchRequest.from_json(body)
+        assert oracle_eligible(request.query)
+        dev = svc_device.search(SearchRequest.from_json(body))
+        orc = svc_oracle.search(request)
+        d_ids, d_scores, d_total = _hits(dev)
+        o_ids, o_scores, o_total = _hits(orc)
+        assert o_ids == d_ids, f"routing changed hit ids for {body}"
+        np.testing.assert_allclose(
+            o_scores, d_scores, rtol=1e-6, atol=1e-6,
+            err_msg=f"routing changed scores for {body}",
+        )
+        assert o_total == d_total
+        checked += 1
+    assert checked >= 50
+
+
+def test_fuzz_blockmax_routing_exact_topk(engine):
+    """Pure term-disjunction shapes with untracked totals may route to
+    block-max: top-k ids/order/scores must be exact (totals are gte)."""
+    rng = np.random.default_rng(29)
+    svc_device = SearchService(engine, planner=None)
+    svc_block = SearchService(engine, planner=ForcedPlanner("blockmax"))
+    for _ in range(12):
+        body = {
+            "query": {
+                "match": {
+                    "body": " ".join(rng.choice(VOCAB, rng.integers(2, 5)))
+                }
+            },
+            "size": 10,
+            "track_total_hits": False,
+        }
+        dev = svc_device.search(SearchRequest.from_json(body))
+        blk = svc_block.search(SearchRequest.from_json(body))
+        d_ids, d_scores, _ = _hits(dev)
+        b_ids, b_scores, _ = _hits(blk)
+        assert b_ids == d_ids
+        np.testing.assert_allclose(b_scores, d_scores, rtol=1e-6, atol=1e-6)
+
+
+def test_oracle_respects_deletes():
+    """The oracle backend must honor the live mask exactly like the
+    device kernels: deleted docs leave hits AND totals."""
+    eng = Engine(MAPPINGS)
+    for i in range(20):
+        eng.index({"body": "alpha common", "rank": i}, f"d{i}")
+    eng.refresh()
+    eng.delete("d3")
+    eng.delete("d7")
+    eng.refresh()
+    body = {"query": {"match": {"body": "common"}}, "size": 20}
+    dev = SearchService(eng, planner=None).search(
+        SearchRequest.from_json(body)
+    )
+    orc = SearchService(eng, planner=ForcedPlanner("oracle")).search(
+        SearchRequest.from_json(body)
+    )
+    d_ids, d_scores, d_total = _hits(dev)
+    o_ids, o_scores, o_total = _hits(orc)
+    assert o_ids == d_ids and o_total == d_total == 18
+    np.testing.assert_allclose(o_scores, d_scores, rtol=1e-6, atol=1e-6)
+    assert "d3" not in o_ids and "d7" not in o_ids
+
+
+def test_batched_vs_solo_single_shard(engine):
+    """The micro-batcher's coalesced group executor (search_many) must be
+    result-identical to per-request search()."""
+    rng = np.random.default_rng(31)
+    svc = SearchService(engine, planner=None)
+    bodies = [
+        {"query": random_bool_query(rng), "size": 10} for _ in range(10)
+    ] + [
+        {
+            "query": {
+                "match": {
+                    "body": " ".join(rng.choice(VOCAB, rng.integers(1, 4)))
+                }
+            },
+            "size": 7,
+        }
+        for _ in range(10)
+    ]
+    requests = [SearchRequest.from_json(b) for b in bodies]
+    batched = svc.search_many(requests)
+    for body, got in zip(bodies, batched):
+        assert not isinstance(got, Exception)
+        solo = svc.search(SearchRequest.from_json(body))
+        g_ids, g_scores, g_total = _hits(got)
+        s_ids, s_scores, s_total = _hits(solo)
+        assert g_ids == s_ids, f"batched changed ids for {body}"
+        np.testing.assert_allclose(g_scores, s_scores, rtol=1e-6, atol=1e-6)
+        assert g_total == s_total
+        assert got.max_score == pytest.approx(
+            solo.max_score, rel=1e-6
+        ) or got.max_score == solo.max_score
+
+
+def test_batched_vs_solo_sharded_node():
+    """Coordinator search_many (per-shard coalesced launches + merge)
+    equals the solo scatter/merge path, including can_match skips."""
+    rng = np.random.default_rng(37)
+    node = Node()
+    node.exec_batcher = None  # drive search_many explicitly below
+    node.create_index(
+        "fz",
+        {
+            "settings": {"index": {"number_of_shards": 3}},
+            "mappings": MAPPINGS.to_json(),
+        },
+    )
+    for i in range(150):
+        node.index_doc(
+            "fz",
+            {
+                "body": " ".join(rng.choice(VOCAB, rng.integers(3, 15))),
+                "tag": str(rng.choice(TAGS)),
+                "rank": int(rng.integers(0, 100)),
+            },
+            f"d{i}",
+        )
+    node.refresh("fz")
+    coord = node.indices["fz"].search
+    # Compare against the host-loop coordinator (the batched path's
+    # twin); the SPMD mesh path accounts can_match skips differently.
+    coord.mesh_view = None
+    bodies = [
+        {"query": random_bool_query(rng), "size": 10} for _ in range(6)
+    ]
+    requests = [SearchRequest.from_json(b) for b in bodies]
+    batched = coord.search_many(requests)
+    for body, got in zip(bodies, batched):
+        assert not isinstance(got, Exception)
+        solo = coord.search(SearchRequest.from_json(body))
+        assert _hits(got)[0] == _hits(solo)[0]
+        np.testing.assert_allclose(
+            _hits(got)[1], _hits(solo)[1], rtol=1e-6, atol=1e-6
+        )
+        assert _hits(got)[2] == _hits(solo)[2]
+        assert got.skipped == solo.skipped
+    node.close()
+
+
+def test_planner_learns_from_ewma():
+    """After MIN_OBS explorations per backend the planner exploits the
+    minimum-EWMA backend; new observations keep adapting it."""
+    planner = ExecPlanner(CostModel())
+    cls = (("terms", "body", 8, 4), 10)
+    feats = PlanFeatures(n_docs=100_000, work_tiles=8)
+    cands = ["device", "oracle"]
+    for _ in range(planner.MIN_OBS):
+        planner.cost.observe(cls, "device", 0.200)
+        planner.cost.observe(cls, "oracle", 0.002)
+    assert planner.decide(cls, cands, feats) == "oracle"
+    # Drift: oracle degrades, device improves — the decision follows.
+    for _ in range(40):
+        planner.cost.observe(cls, "oracle", 0.500)
+        planner.cost.observe(cls, "device", 0.001)
+    assert planner.decide(cls, cands, feats) == "device"
+
+
+def test_seeded_costs_route_small_corpus_to_oracle():
+    """Before any calibration, the seeds alone must route tiny corpora
+    (BENCH cfg1 shape) off the launch-dominated device path."""
+    from elasticsearch_tpu.exec.cost import seed_ms
+
+    tiny = PlanFeatures(n_docs=5_000, work_tiles=4)
+    big = PlanFeatures(n_docs=1_000_000, work_tiles=512)
+    assert seed_ms("oracle", tiny) < seed_ms("device", tiny)
+    assert seed_ms("device", big) < seed_ms("oracle", big)
+
+
+def test_ast_signature_groups_shapes():
+    from elasticsearch_tpu.query.dsl import parse_query
+
+    a = parse_query({"match": {"body": "alpha bravo"}})
+    b = parse_query({"match": {"body": "kilo lima"}})
+    c = parse_query({"match": {"title": "alpha bravo"}})
+    d = parse_query(
+        {"bool": {"must": [{"match": {"body": "alpha bravo"}}]}}
+    )
+    assert ast_signature(a) == ast_signature(b)
+    assert ast_signature(a) != ast_signature(c)
+    assert ast_signature(a) != ast_signature(d)
+
+
+def test_profile_and_nodes_stats_surface_decisions():
+    node = Node()
+    node.create_index(
+        "pf", {"mappings": {"properties": {"body": {"type": "text"}}}}
+    )
+    for i in range(25):
+        node.index_doc("pf", {"body": f"alpha common w{i % 4}"}, f"d{i}")
+    node.refresh("pf")
+    for _ in range(3):
+        node.search("pf", {"query": {"match": {"body": "alpha"}}})
+    out = node.search(
+        "pf", {"query": {"match": {"body": "alpha"}}, "profile": True}
+    )
+    shard = out["profile"]["shards"][0]
+    assert shard["backends"], "profile must show the chosen backend"
+    assert set(shard["backends"]) <= {"device", "blockmax", "oracle"}
+    bd = out["took_breakdown"]
+    assert set(bd) == {"plan_ms", "queue_ms", "execute_ms", "reduce_ms"}
+    stats = node.nodes_stats()["nodes"][node.node_name]
+    decisions = stats["exec"]["planner"]["decisions"]
+    assert sum(decisions.values()) > 0
+    assert "ewma" in stats["exec"]["planner"]
+    assert "occupancy_histogram" in stats["exec"]["batcher"]
+    assert "queue_wait_p50_ms" in stats["exec"]["batcher"]
+    assert "evictions" in stats["indices"]["request_cache"]
+    node.close()

@@ -108,6 +108,12 @@ def test_generous_timeout_not_timed_out():
 
 def test_cancel_mid_search(monkeypatch):
     node = Node()
+    # Pin the solo (unbatched) DEVICE serving path: this test blocks
+    # inside execute_auto, which neither the exec micro-batcher's launch
+    # kernels nor an oracle-routed plan would call (queued cancellation
+    # has its own tests in test_exec_batcher.py).
+    node.exec_batcher = None
+    node.exec_planner = None
     seed(node, segments=8)
     from elasticsearch_tpu.search import service as service_mod
 
