@@ -4,9 +4,11 @@ from .cluster import (
     NoShardAvailableError,
     NotMasterError,
     ReplicationFailedError,
+    ShardSearchFailedError,
     StalePrimaryTermError,
 )
 from .gateway import ReplicationGateway, ReplicationUnavailableError
+from .response_collector import ResponseCollectorService
 from .state import ClusterState, IndexMeta, ShardRouting
 from .transport import (
     ConnectTransportError,
@@ -26,7 +28,9 @@ __all__ = [
     "ReplicationFailedError",
     "ReplicationGateway",
     "ReplicationUnavailableError",
+    "ResponseCollectorService",
     "ShardRouting",
+    "ShardSearchFailedError",
     "StalePrimaryTermError",
     "TransportHub",
 ]

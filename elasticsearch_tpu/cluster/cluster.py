@@ -42,12 +42,23 @@ from ..index.engine import Engine, VersionConflictError
 from ..index.mapping import Mappings
 from ..index.seqno import ReplicationTracker
 from ..parallel.routing import shard_for_id
+from .response_collector import ResponseCollectorService
 from .state import ClusterState, IndexMeta, ShardRouting
 from .transport import ConnectTransportError, RemoteActionError, TransportHub
 
 
 class NoShardAvailableError(Exception):
     pass
+
+
+class ShardSearchFailedError(Exception):
+    """A shard failed every copy while allow_partial_search_results=false:
+    the request must surface as 503, never a silently-partial 200. Carries
+    the per-shard failure entries for the error body."""
+
+    def __init__(self, message: str, failures: list | None = None):
+        super().__init__(message)
+        self.failures = failures or []
 
 
 class NotMasterError(Exception):
@@ -111,6 +122,18 @@ class ClusterNode:
         import uuid
 
         self.session = uuid.uuid4().hex
+        # Adaptive replica selection: EWMA rank per target copy observed
+        # by THIS coordinating node (node/ResponseCollectorService.java:33)
+        # + degraded-search counters for `GET /_nodes/stats`.
+        self.response_collector = ResponseCollectorService()
+        self._search_stats = {
+            "searches": 0,
+            "partial_results": 0,
+            "shard_failures": 0,
+            "copy_retries": 0,
+            "rerouted": 0,
+        }
+        self._inflight_searches = 0
         self._recover_persisted_state()
         hub.register(node_id, self._handle)
 
@@ -715,12 +738,22 @@ class ClusterNode:
         from ..search.service import SearchRequest, SearchService
 
         engine = self.engines[(payload["index"], payload["shard"])]
-        engine.refresh()
-        request = SearchRequest.from_json(payload["body"])
-        resp = SearchService(engine, payload["index"]).search(request)
+        with self.lock:
+            self._inflight_searches += 1
+            queue = self._inflight_searches - 1
+        try:
+            engine.refresh()
+            request = SearchRequest.from_json(payload["body"])
+            resp = SearchService(engine, payload["index"]).search(request)
+        finally:
+            with self.lock:
+                self._inflight_searches -= 1
         return {
             "total": resp.total,
             "max_score": resp.max_score,
+            # Copy-side load signal for the coordinator's adaptive replica
+            # selection (the reference piggybacks queue size the same way).
+            "queue": queue,
             "hits": [
                 {
                     "_id": h.doc_id,
@@ -731,19 +764,44 @@ class ClusterNode:
             ],
         }
 
-    def search(self, index: str, body: dict) -> dict:
+    # How many ordered passes over a shard's copies the query phase makes
+    # before declaring the shard failed, and the backoff between passes.
+    COPY_RETRY_ROUNDS = 2
+    COPY_RETRY_BACKOFF_S = 0.01
+
+    def _count_search(self, key: str, n: int = 1) -> None:
+        with self.lock:
+            self._search_stats[key] = self._search_stats.get(key, 0) + n
+
+    def search_resilience_stats(self) -> dict:
+        with self.lock:
+            counters = dict(self._search_stats)
+        return {
+            **counters,
+            "response_collector": self.response_collector.snapshot(),
+        }
+
+    def search(
+        self, index: str, body: dict, allow_partial: bool = True
+    ) -> dict:
         """Scatter to one alive copy per shard, merge like the coordinator
         (score desc, then shard index, then per-shard rank).
 
-        Shards with no reachable copy degrade to a PARTIAL result — the
-        response's `_shards.failed` reports them honestly (the reference's
-        allow_partial_search_results default) — and only an index with
-        zero reachable shards raises NoShardAvailableError. Per-shard
-        user errors (a malformed query raising remotely) re-raise: a bad
+        Degraded-mode query phase: copies are tried in the response
+        collector's EWMA rank order (adaptive replica selection) instead
+        of the fixed primary-then-replicas order, each shard gets
+        COPY_RETRY_ROUNDS bounded-backoff passes over its copies, and a
+        shard whose every copy failed degrades to a PARTIAL result with an
+        honest `_shards.failed` + `failures[]` entry — unless
+        `allow_partial=False`, which turns any shard failure into
+        ShardSearchFailedError (HTTP 503). Only an index with zero
+        successful shards raises NoShardAvailableError. Per-shard user
+        errors (a malformed query raising remotely) re-raise: a bad
         request must be a 400, never "0 of N shards"."""
         meta = self.state.indices.get(index)
         if meta is None:
             raise NoShardAvailableError(f"no such index [{index}]")
+        self._count_search("searches")
         size = int(body.get("size", 10))
         shard_body = dict(body)
         shard_body["from"] = 0
@@ -752,8 +810,7 @@ class ClusterNode:
         total = 0
         max_score = None
         successful = 0
-        failed = 0
-        last_err: Exception | None = None
+        failures: list[dict] = []
         for shard_id, routing in sorted(meta.shards.items()):
             copies = [
                 n
@@ -761,24 +818,11 @@ class ClusterNode:
                 + routing.replicas
                 if n is not None
             ]
-            resp = None
-            for node in copies:
-                try:
-                    resp = self.hub.send(
-                        self.node_id,
-                        node,
-                        "shard_search",
-                        {"index": index, "shard": shard_id, "body": shard_body},
-                    )
-                    break
-                except RemoteActionError as e:
-                    if e.remote_type in ("ValueError", "TypeError"):
-                        raise  # request-shaped error, not a copy failure
-                    last_err = e
-                except ConnectTransportError as e:
-                    last_err = e
+            resp, failure = self._search_one_shard(
+                index, shard_id, copies, shard_body
+            )
             if resp is None:
-                failed += 1
+                failures.append(failure)
                 continue
             successful += 1
             total += resp["total"] or 0
@@ -792,24 +836,96 @@ class ClusterNode:
                 score = hit["_score"]
                 sort_key = -score if score is not None else np.inf
                 merged.append((sort_key, shard_id, rank, hit))
+        failed = len(failures)
+        if failed:
+            self._count_search("shard_failures", failed)
         if successful == 0 and failed > 0:
             raise NoShardAvailableError(
-                f"all shards of [{index}] failed: {last_err}"
+                f"all shards of [{index}] failed: "
+                f"{failures[-1]['reason']['reason']}"
             )
+        if failed and not allow_partial:
+            raise ShardSearchFailedError(
+                f"[{index}] {failed} of {len(meta.shards)} shards failed "
+                f"and allow_partial_search_results is false",
+                failures=failures,
+            )
+        if failed:
+            self._count_search("partial_results")
         merged.sort(key=lambda t: (t[0], t[1], t[2]))
         frm = int(body.get("from", 0))
         page = [h for _, _, _, h in merged[frm : frm + size]]
+        shards_obj: dict[str, Any] = {
+            "total": len(meta.shards),
+            "successful": successful,
+            "skipped": 0,
+            "failed": failed,
+        }
+        if failures:
+            shards_obj["failures"] = failures
         return {
-            "_shards": {
-                "total": len(meta.shards),
-                "successful": successful,
-                "skipped": 0,
-                "failed": failed,
-            },
+            "_shards": shards_obj,
             "hits": {
                 "total": {"value": total, "relation": "eq"},
                 "max_score": max_score,
                 "hits": page,
+            },
+        }
+
+    def _search_one_shard(
+        self, index: str, shard_id: int, copies: list[str], shard_body: dict
+    ) -> tuple[dict | None, dict | None]:
+        """Query one shard across its copies: EWMA-ranked order, bounded
+        backoff between rounds. Returns (response, None) on success or
+        (None, failure entry) once every copy of every round failed."""
+        ordered = self.response_collector.ordered(copies)
+        if ordered and copies and ordered[0] != copies[0]:
+            # Adaptive selection steered away from the default
+            # primary-first order.
+            self._count_search("rerouted")
+        last_err: Exception | None = None
+        last_node: str | None = None
+        attempts = 0
+        for round_i in range(self.COPY_RETRY_ROUNDS):
+            if round_i and ordered:
+                time.sleep(self.COPY_RETRY_BACKOFF_S * round_i)
+            for node in ordered:
+                attempts += 1
+                if attempts > 1:
+                    self._count_search("copy_retries")
+                t0 = time.monotonic()
+                try:
+                    resp = self.hub.send(
+                        self.node_id,
+                        node,
+                        "shard_search",
+                        {"index": index, "shard": shard_id, "body": shard_body},
+                    )
+                except RemoteActionError as e:
+                    if e.remote_type in ("ValueError", "TypeError"):
+                        raise  # request-shaped error, not a copy failure
+                    last_err, last_node = e, node
+                    self.response_collector.record_failure(node)
+                except ConnectTransportError as e:
+                    last_err, last_node = e, node
+                    self.response_collector.record_failure(node)
+                else:
+                    self.response_collector.record_response(
+                        node,
+                        time.monotonic() - t0,
+                        queue_size=int(resp.get("queue", 0)),
+                    )
+                    return resp, None
+        reason = (
+            str(last_err) if last_err is not None else "no copy assigned"
+        )
+        return None, {
+            "shard": shard_id,
+            "index": index,
+            "node": last_node,
+            "reason": {
+                "type": type(last_err).__name__ if last_err else "unassigned",
+                "reason": reason,
             },
         }
 

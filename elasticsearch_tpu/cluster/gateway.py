@@ -205,14 +205,21 @@ class ReplicationGateway:
         )
 
     def search(
-        self, index: str, body: dict, timeout_s: float | None = None
+        self,
+        index: str,
+        body: dict,
+        timeout_s: float | None = None,
+        allow_partial: bool = True,
     ) -> dict:
         """Scatter/merge search over one live copy per shard; partial
-        results carry honest `_shards.failed` counts."""
+        results carry honest `_shards.failed` + `failures[]` entries.
+        `allow_partial=False` surfaces ShardSearchFailedError (503)
+        immediately — a partial-disallowed failure is an honest answer,
+        not a retryable topology blip."""
         self._count("searches")
         return self._run(
             f"search:{index}",
-            lambda node: node.search(index, body),
+            lambda node: node.search(index, body, allow_partial=allow_partial),
             timeout_s=timeout_s,
         )
 
@@ -308,11 +315,33 @@ class ReplicationGateway:
             n.node_id for n in self.cluster.nodes.values() if not n.closed
         ]
         master = self.cluster.master()
+        # Degraded-search accounting: per-node coordinator counters summed
+        # cluster-wide, plus each live node's per-copy EWMA snapshot
+        # (adaptive replica selection state).
+        resilience: dict = {
+            "searches": 0,
+            "partial_results": 0,
+            "shard_failures": 0,
+            "copy_retries": 0,
+            "rerouted": 0,
+        }
+        collectors: dict = {}
+        for node in self.cluster.nodes.values():
+            if node.closed:
+                continue
+            node_stats = node.search_resilience_stats()
+            snapshot = node_stats.pop("response_collector")
+            for key, value in node_stats.items():
+                resilience[key] = resilience.get(key, 0) + value
+            if snapshot:
+                collectors[node.node_id] = snapshot
         return {
             **counters,
             "nodes": sorted(self.cluster.nodes),
             "alive_nodes": sorted(alive),
             "master": None if master is None else master.node_id,
+            "search_resilience": resilience,
+            "adaptive_replica_selection": collectors,
         }
 
     def close(self) -> None:

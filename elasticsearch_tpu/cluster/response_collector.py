@@ -1,0 +1,132 @@
+"""Adaptive replica selection: per-copy EWMA ranking of shard copies.
+
+The analog of the reference's ResponseCollectorService
+(node/ResponseCollectorService.java:33) feeding its adaptive replica
+selection (OperationRouting rank-based copy ordering): the coordinating
+node keeps, per target node, an EWMA of observed service time, an EWMA of
+the remote's reported search queue depth, and a decaying failure penalty.
+`ordered()` sorts a shard's copies by that rank so traffic steers toward
+the fastest healthy copy instead of hammering the fixed
+primary-then-replicas order — a slow or fault-injected copy drifts to the
+back of the order and recovers as successes decay its penalty.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class ResponseCollectorService:
+    """Per-node EWMA statistics observed by ONE coordinating node."""
+
+    # EWMA smoothing for service time / queue size (the reference's 0.3).
+    ALPHA = 0.3
+    # Each success multiplies the outstanding failure penalty by this;
+    # each failure adds 1.0 — a failing copy ranks behind healthy ones
+    # until a few successes rehabilitate it.
+    FAILURE_DECAY = 0.5
+    # Rank seconds charged per unit of failure penalty: large enough that
+    # one recent failure outranks any realistic service-time difference.
+    FAILURE_PENALTY_S = 5.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: dict[str, dict] = {}
+
+    def _entry(self, node: str) -> dict:
+        entry = self._stats.get(node)
+        if entry is None:
+            entry = {
+                "service_ewma_s": None,
+                "queue_ewma": 0.0,
+                "failure_penalty": 0.0,
+                "responses": 0,
+                "failures": 0,
+            }
+            self._stats[node] = entry
+        return entry
+
+    def record_response(
+        self, node: str, service_time_s: float, queue_size: int = 0
+    ) -> None:
+        with self._lock:
+            entry = self._entry(node)
+            entry["responses"] += 1
+            prev = entry["service_ewma_s"]
+            entry["service_ewma_s"] = (
+                service_time_s
+                if prev is None
+                else self.ALPHA * service_time_s + (1 - self.ALPHA) * prev
+            )
+            entry["queue_ewma"] = (
+                self.ALPHA * float(queue_size)
+                + (1 - self.ALPHA) * entry["queue_ewma"]
+            )
+            entry["failure_penalty"] *= self.FAILURE_DECAY
+
+    def record_failure(self, node: str) -> None:
+        with self._lock:
+            entry = self._entry(node)
+            entry["failures"] += 1
+            entry["failure_penalty"] += 1.0
+
+    def _rank_locked(self, node: str, default_service_s: float) -> float:
+        entry = self._stats.get(node)
+        if entry is None:
+            # Unseen copies rank at the optimistic default so fresh
+            # copies get sampled (the reference adjusts unknown nodes
+            # toward the average for the same reason).
+            return default_service_s
+        service = (
+            entry["service_ewma_s"]
+            if entry["service_ewma_s"] is not None
+            else default_service_s
+        )
+        return (
+            service * (1.0 + entry["queue_ewma"])
+            + entry["failure_penalty"] * self.FAILURE_PENALTY_S
+        )
+
+    def ordered(self, nodes: list[str]) -> list[str]:
+        """Copies sorted by rank ascending; ties keep the caller's order
+        (so with no observations the primary-first default survives)."""
+        if len(nodes) < 2:
+            return list(nodes)
+        with self._lock:
+            known = [
+                e["service_ewma_s"]
+                for e in self._stats.values()
+                if e["service_ewma_s"] is not None
+            ]
+            default = min(known) if known else 0.0
+            ranked = [
+                (self._rank_locked(node, default), pos, node)
+                for pos, node in enumerate(nodes)
+            ]
+        ranked.sort(key=lambda t: (t[0], t[1]))
+        return [node for _, _, node in ranked]
+
+    def snapshot(self) -> dict:
+        """Per-copy EWMA snapshot for `GET /_nodes/stats`."""
+        with self._lock:
+            known = [
+                e["service_ewma_s"]
+                for e in self._stats.values()
+                if e["service_ewma_s"] is not None
+            ]
+            default = min(known) if known else 0.0
+            return {
+                node: {
+                    "rank": round(self._rank_locked(node, default), 6),
+                    "service_time_ewma_ms": (
+                        None
+                        if e["service_ewma_s"] is None
+                        else round(e["service_ewma_s"] * 1e3, 3)
+                    ),
+                    "queue_ewma": round(e["queue_ewma"], 3),
+                    "failure_penalty": round(e["failure_penalty"], 3),
+                    "responses": e["responses"],
+                    "failures": e["failures"],
+                }
+                for node, e in sorted(self._stats.items())
+            }

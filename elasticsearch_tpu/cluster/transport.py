@@ -18,6 +18,8 @@ import threading
 import time
 from typing import Any, Callable
 
+from ..faults import fault_point
+
 
 class ConnectTransportError(Exception):
     """Peer unreachable (dead node, partition, injected disconnect)."""
@@ -118,6 +120,12 @@ class TransportHub:
                 )
         if self._delay_s:
             time.sleep(self._delay_s)
+        # Named fault site (faults/registry.py): injectable per-action
+        # drops/delays without pre-wiring hub interceptors, e.g.
+        # `transport.send.shard_search`.
+        fault_point(
+            f"transport.send.{action}", from_node=from_id, to_node=to_id
+        )
         try:
             return handler(from_id, action, payload)
         except (ConnectTransportError, RemoteActionError):

@@ -41,6 +41,11 @@ class CircuitBreaker:
 
     def add(self, n: int, label: str = "segment") -> None:
         """Reserve n bytes; raises BreakerError over the limit."""
+        from ..faults import fault_point
+
+        # Injectable breaker trip (faults/registry.py `breaker.reserve`):
+        # provokes the 429/degraded paths without filling real HBM.
+        fault_point("breaker.reserve", breaker=self.name, label=label)
         with self._lock:
             if self.used + n > self.limit:
                 self.trips += 1

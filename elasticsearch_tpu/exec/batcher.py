@@ -19,17 +19,27 @@ touching single-request latency:
   the launch;
 - when the queue backs up past ``queue_limit`` the batcher sheds load
   through the indexing-pressure rejection machinery (HTTP 429
-  ``es_rejected_execution_exception``), the same contract writes use.
+  ``es_rejected_execution_exception``), carrying a ``Retry-After`` hint
+  derived from the observed queue-wait p50 so shed clients back off
+  sanely instead of hot-looping;
+- failure isolation: a sub-request that fails inside a coalesced launch
+  (injected ``batcher.launch`` fault, device-launch error, shard blowup)
+  is RETRIED INDIVIDUALLY through the plain per-request path instead of
+  poisoning its batchmates, and a group key that keeps failing while
+  coalesced is QUARANTINED to the per-request path for a cooldown.
 
 Counters for `GET /_nodes/stats`: batches launched, batch-occupancy
-histogram, queue-wait p50/p99, queue-cancellations and sheds.
+histogram, queue-wait p50/p99, queue-cancellations, sheds, individual
+retries and quarantine activity.
 """
 
 from __future__ import annotations
 
+import math
 import os
 import threading
 import time
+import weakref
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -37,6 +47,12 @@ import numpy as np
 
 from ..common.indexing_pressure import IndexingPressureRejected
 from ..common.tasks import TaskCancelledError
+from ..faults import fault_point
+
+# Errors that must surface verbatim, never trigger an individual retry:
+# cancellations honor the cancel contract; ValueError/TypeError are
+# request-shaped (the same request would fail solo too).
+_NO_RETRY_ERRORS = (TaskCancelledError, ValueError, TypeError)
 
 
 @dataclass
@@ -52,10 +68,20 @@ class _Pending:
     result: object = None
     error: Exception | None = None
     queue_wait_s: float = 0.0
+    # Failed while riding a coalesced launch: the CALLER thread runs one
+    # individual retry on the per-request path (keeping the scheduler
+    # thread free for other groups).
+    retry_solo: bool = False
 
 
 class MicroBatcher:
     """One node's continuous micro-batching scheduler."""
+
+    # A group key whose coalesced launches failed this many times in a
+    # row is quarantined to the per-request path for QUARANTINE_TTL_S
+    # (then paroled and allowed to coalesce again).
+    QUARANTINE_FAILURES = 3
+    QUARANTINE_TTL_S = 30.0
 
     def __init__(
         self,
@@ -83,6 +109,15 @@ class MicroBatcher:
         self.queue_cancellations = 0
         self.shed = 0
         self._wait_samples: deque[float] = deque(maxlen=512)
+        # Failure isolation / quarantine state (under _cv).
+        self.retried_individually = 0
+        self.quarantine_hits = 0
+        self.groups_quarantined = 0
+        self._group_failures: dict[tuple, int] = {}
+        # group -> (parole time, weakref to the offending searcher). The
+        # weakref pins identity: id() reuse by a NEW searcher at the same
+        # address must not inherit a dead group's quarantine.
+        self._quarantine: dict[tuple, tuple[float, object]] = {}
 
     # ------------------------------------------------------------- public
 
@@ -96,13 +131,33 @@ class MicroBatcher:
         group = (id(searcher), group_key)
         now = time.monotonic()
         with self._cv:
+            # Opportunistic pruning: expired quarantines (and ones whose
+            # searcher died — dropped/recreated indices) must not
+            # accumulate or leak onto unrelated work.
+            for g, (t, ref) in list(self._quarantine.items()):
+                if now >= t or ref() is None:
+                    self._quarantine.pop(g, None)
+                    self._group_failures.pop(g, None)
+            entry = self._quarantine.get(group)
+            quarantined = entry is not None and entry[1]() is searcher
+            if quarantined:
+                # Repeat offender: this spec keeps failing coalesced
+                # launches — serve it on the plain per-request path so
+                # it cannot take batchmates down with it.
+                self.quarantine_hits += 1
+        if quarantined:
+            return searcher.search(request, task=task)
+        with self._cv:
             depth = sum(len(q) for q in self._queues.values())
             if depth >= self.queue_limit:
                 self.shed += 1
-                raise IndexingPressureRejected(
+                err = IndexingPressureRejected(
                     f"rejected execution of search: exec batch queue is "
                     f"full [queued={depth}, limit={self.queue_limit}]"
                 )
+                # Back-off hint for the REST layer's Retry-After header.
+                err.retry_after_s = self._retry_after_locked(depth)
+                raise err
             queue = self._queues.setdefault(group, deque())
             # Idle groups launch immediately; a group with work in flight
             # (or already queued) opens the continuous-batching window so
@@ -126,6 +181,12 @@ class MicroBatcher:
         if task is not None:
             task.add_cancel_listener(lambda: self._cancel_queued(item))
         self._await(item)
+        if item.retry_solo:
+            # Failure isolation: this rider failed inside the coalesced
+            # launch — one individual retry on the plain per-request
+            # path, run HERE so a batch of failures never serializes on
+            # the scheduler thread.
+            return searcher.search(request, task=task)
         if item.error is not None:
             raise item.error
         return item.result
@@ -136,6 +197,21 @@ class MicroBatcher:
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=1.0)
+
+    def _retry_after_locked(self, depth: int) -> int:
+        """Retry-After seconds for a shed request: the observed queue-wait
+        p50 scaled by how many batches deep the queue is — an honest
+        drain-time estimate, clamped to [1, 30]s. Caller holds _cv."""
+        if self._wait_samples:
+            p50_s = float(
+                np.percentile(
+                    np.asarray(self._wait_samples, dtype=np.float64), 50
+                )
+            )
+        else:
+            p50_s = self.max_wait_s
+        estimate = p50_s * (1.0 + depth / self.max_batch)
+        return int(min(30, max(1, math.ceil(estimate))))
 
     def stats(self) -> dict:
         with self._cv:
@@ -152,6 +228,12 @@ class MicroBatcher:
                 "queue_cancellations": self.queue_cancellations,
                 "rejected": self.shed,
                 "queued": sum(len(q) for q in self._queues.values()),
+                # Failure-isolation telemetry: sub-requests retried solo
+                # after failing a coalesced launch, and quarantine state.
+                "retried_individually": self.retried_individually,
+                "groups_quarantined": self.groups_quarantined,
+                "quarantine_hits": self.quarantine_hits,
+                "quarantined_now": len(self._quarantine),
             }
         if samples.size:
             out["queue_wait_p50_ms"] = round(
@@ -269,6 +351,7 @@ class MicroBatcher:
     def _run_batch(self, batch: list[_Pending]) -> None:
         now = time.monotonic()
         live: list[_Pending] = []
+        faulted: list[tuple[_Pending, Exception]] = []
         for item in batch:
             item.queue_wait_s = now - item.enqueued_at
             task = item.task
@@ -277,24 +360,71 @@ class MicroBatcher:
                 item.error = TaskCancelledError(f"task cancelled [{reason}]")
                 item.event.set()
                 continue
+            try:
+                # Injectable per-sub-request launch fault
+                # (faults/registry.py `batcher.launch`): evaluated per
+                # rider so one injected failure cannot touch batchmates.
+                fault_point("batcher.launch")
+            except Exception as e:
+                faulted.append((item, e))
+                continue
             live.append(item)
+        retry: list[tuple[_Pending, Exception]] = list(faulted)
         if live:
             try:
                 results = live[0].searcher.search_many(
                     [it.request for it in live],
                     tasks=[it.task for it in live],
                 )
-            except Exception as e:  # systemic failure: fail the batch
+            except Exception as e:  # whole-launch failure
                 results = [e] * len(live)
             for item, result in zip(live, results):
                 if isinstance(result, Exception):
-                    item.error = result
+                    if isinstance(result, _NO_RETRY_ERRORS):
+                        item.error = result  # would fail solo too
+                        item.event.set()
+                    else:
+                        retry.append((item, result))
                 else:
                     item.result = result
-                item.event.set()
+                    item.event.set()
+        # Failure isolation: anything that failed while riding the
+        # coalesced launch gets ONE individual retry on the plain
+        # per-request path — executed by ITS caller's thread (execute()),
+        # so a batch of failures never stalls other groups behind the
+        # scheduler thread.
+        for item, _first_error in retry:
+            item.retry_solo = True
+            item.event.set()
+        group = batch[0].group if batch else None
         with self._cv:
             self.batches += 1
             self.requests += len(batch)
+            self.retried_individually += len(retry)
+            if group is not None:
+                if retry:
+                    # Repeat-offender tracking: consecutive coalesced
+                    # failures quarantine the group to the per-request
+                    # path for a cooldown.
+                    while len(self._group_failures) > 4096:
+                        # Bound residue from groups that never return
+                        # (dropped indices): evict oldest-first.
+                        self._group_failures.pop(
+                            next(iter(self._group_failures))
+                        )
+                    fails = self._group_failures.get(group, 0) + 1
+                    self._group_failures[group] = fails
+                    if (
+                        fails >= self.QUARANTINE_FAILURES
+                        and group not in self._quarantine
+                    ):
+                        self._quarantine[group] = (
+                            time.monotonic() + self.QUARANTINE_TTL_S,
+                            weakref.ref(batch[0].searcher),
+                        )
+                        self.groups_quarantined += 1
+                elif live:
+                    self._group_failures.pop(group, None)
             if len(live) >= 2:
                 self.coalesced_requests += len(live)
             bucket = 1 << max(0, len(live) - 1).bit_length() if live else 0

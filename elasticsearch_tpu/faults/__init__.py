@@ -1,0 +1,15 @@
+from .registry import (
+    REGISTRY,
+    FaultRegistry,
+    FaultSpec,
+    InjectedFaultError,
+    fault_point,
+)
+
+__all__ = [
+    "REGISTRY",
+    "FaultRegistry",
+    "FaultSpec",
+    "InjectedFaultError",
+    "fault_point",
+]

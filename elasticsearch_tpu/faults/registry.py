@@ -1,0 +1,256 @@
+"""Deterministic, seedable fault injection for the serving stack.
+
+The in-process form of the failure scenarios the reference exercises with
+MockTransportService interception and its disruption test framework
+(test/framework org.elasticsearch.test.disruption): named *fault sites*
+threaded through the serving stack evaluate a registry of armed specs on
+every pass, so tests (and operators, via `POST /_fault`) can provoke
+device-launch failures, per-shard scoring errors, transport drops,
+breaker trips, and slow shards on demand — deterministically, from a
+seed — and assert the degraded paths (partial results, copy retry,
+batch isolation) actually engage.
+
+Sites currently threaded (fnmatch patterns match against these names):
+
+    search.kernel               per-segment device launch
+                                (search/service.py, single + batched)
+    coordinator.shard           per-shard scoring pass in the sharded
+                                coordinator (search/coordinator.py)
+    batcher.launch              one sub-request riding a coalesced
+                                micro-batch launch (exec/batcher.py)
+    transport.send.<action>     host transport send (cluster/transport.py),
+                                e.g. transport.send.shard_search
+    breaker.reserve             HBM breaker reservation (common/breaker.py)
+
+Configuration is per-site: error rate, error class (internal | transport |
+breaker), injected latency, a count budget, and a seed. Specs arm via the
+`ESTPU_FAULTS` env var (read at import) or the `POST /_fault` admin API:
+
+    ESTPU_FAULTS="coordinator.shard:rate=0.3:error=transport:seed=7,
+                  transport.send.shard_search:delay_ms=20:rate=1.0"
+
+Determinism: each armed spec draws from its own `random.Random(seed)`, so
+an identical sequence of site evaluations yields an identical fault
+schedule — the property the chaos suite (tests/test_faults_chaos.py)
+relies on to replay failures.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+
+class InjectedFaultError(RuntimeError):
+    """An injected failure of error class "internal" (a generic serving
+    bug: surfaces as an all-shards-failed 503 unless a degraded path
+    absorbs it)."""
+
+
+_ERROR_KINDS = ("internal", "transport", "breaker")
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault: WHERE (site pattern), HOW OFTEN (error_rate per
+    evaluation), WHAT (error class and/or delay), HOW MANY (count budget;
+    None = unlimited), and the seed of its private RNG."""
+
+    site: str
+    error_rate: float = 1.0
+    error: str | None = "internal"  # None = delay-only (slow shard)
+    delay_ms: float = 0.0
+    count: int | None = None
+    seed: int = 0
+
+    def validate(self) -> None:
+        if not self.site:
+            raise ValueError("fault spec requires a [site]")
+        if not 0.0 <= self.error_rate <= 1.0:
+            raise ValueError(
+                f"[error_rate] must be in [0, 1], got {self.error_rate}"
+            )
+        if self.error is not None and self.error not in _ERROR_KINDS:
+            raise ValueError(
+                f"unknown [error] class [{self.error}]; expected one of "
+                f"{list(_ERROR_KINDS)} or null"
+            )
+        if self.delay_ms < 0:
+            raise ValueError(f"[delay_ms] must be >= 0, got {self.delay_ms}")
+        if self.count is not None and self.count < 0:
+            raise ValueError(f"[count] must be >= 0, got {self.count}")
+
+
+class _Armed:
+    """A FaultSpec plus its live state: private RNG and counters."""
+
+    def __init__(self, spec: FaultSpec):
+        self.spec = spec
+        self.rng = random.Random(spec.seed)
+        self.evaluations = 0
+        self.fired = 0  # draws that hit (error raised and/or delay slept)
+        self.injected_errors = 0
+        self.injected_delays = 0
+
+    @property
+    def exhausted(self) -> bool:
+        return self.spec.count is not None and self.fired >= self.spec.count
+
+    def stats(self) -> dict:
+        s = self.spec
+        return {
+            "site": s.site,
+            "error_rate": s.error_rate,
+            "error": s.error,
+            "delay_ms": s.delay_ms,
+            "count": s.count,
+            "seed": s.seed,
+            "evaluations": self.evaluations,
+            "fired": self.fired,
+            "injected_errors": self.injected_errors,
+            "injected_delays": self.injected_delays,
+            "exhausted": self.exhausted,
+        }
+
+
+def _make_error(kind: str, site: str, ctx: dict):
+    detail = (
+        " " + " ".join(f"{k}={v}" for k, v in sorted(ctx.items()))
+        if ctx
+        else ""
+    )
+    msg = f"injected fault at [{site}]{detail}"
+    if kind == "transport":
+        # Late import: cluster.transport itself calls fault_point.
+        from ..cluster.transport import ConnectTransportError
+
+        return ConnectTransportError(msg)
+    if kind == "breaker":
+        from ..common.breaker import BreakerError
+
+        return BreakerError(0, 0, 0, f"injected:{site}")
+    return InjectedFaultError(msg)
+
+
+class FaultRegistry:
+    """Thread-safe registry of armed fault specs, evaluated at sites."""
+
+    def __init__(self, env: str | None = None):
+        self._lock = threading.Lock()
+        self._armed: dict[str, _Armed] = {}  # keyed by site pattern
+        if env:
+            for spec in self.parse_env(env):
+                self.put(spec)
+
+    # ---------------------------------------------------------- management
+
+    @staticmethod
+    def parse_env(value: str) -> list[FaultSpec]:
+        """Parse ESTPU_FAULTS: comma-separated specs, each
+        `site[:key=value]*` with keys rate|error|delay_ms|count|seed."""
+        specs = []
+        for chunk in value.split(","):
+            chunk = chunk.strip()
+            if not chunk:
+                continue
+            parts = chunk.split(":")
+            spec = FaultSpec(site=parts[0].strip())
+            error_given = False
+            for kv in parts[1:]:
+                key, _, raw = kv.partition("=")
+                key = key.strip()
+                raw = raw.strip()
+                if key in ("rate", "error_rate"):
+                    spec.error_rate = float(raw)
+                elif key == "error":
+                    spec.error = None if raw in ("none", "null", "") else raw
+                    error_given = True
+                elif key == "delay_ms":
+                    spec.delay_ms = float(raw)
+                elif key == "count":
+                    spec.count = int(raw)
+                elif key == "seed":
+                    spec.seed = int(raw)
+                else:
+                    raise ValueError(
+                        f"unknown ESTPU_FAULTS key [{key}] in [{chunk}]"
+                    )
+            if spec.delay_ms > 0 and not error_given:
+                # A spec that asks for latency and says nothing about an
+                # error class means "slow", not "slow AND broken" — an
+                # unstated internal-error default would turn a latency
+                # experiment into an outage.
+                spec.error = None
+            spec.validate()
+            specs.append(spec)
+        return specs
+
+    def put(self, spec: FaultSpec) -> None:
+        """Arm (or re-arm, resetting RNG/counters) a spec for its site."""
+        spec.validate()
+        with self._lock:
+            self._armed[spec.site] = _Armed(spec)
+
+    def clear(self, site: str | None = None) -> int:
+        """Disarm one site pattern (exact key) or everything."""
+        with self._lock:
+            if site is None:
+                n = len(self._armed)
+                self._armed.clear()
+                return n
+            return 0 if self._armed.pop(site, None) is None else 1
+
+    @property
+    def active(self) -> bool:
+        return bool(self._armed)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "active": bool(self._armed),
+                "specs": [a.stats() for a in self._armed.values()],
+            }
+
+    # ---------------------------------------------------------- evaluation
+
+    def check(self, site: str, **ctx) -> None:
+        """Evaluate every armed spec matching `site`; sleeps injected
+        delays and raises the first injected error."""
+        delay_s = 0.0
+        error = None
+        with self._lock:
+            for armed in self._armed.values():
+                if not fnmatch.fnmatchcase(site, armed.spec.site):
+                    continue
+                armed.evaluations += 1
+                if armed.exhausted:
+                    continue
+                if armed.rng.random() >= armed.spec.error_rate:
+                    continue
+                armed.fired += 1
+                if armed.spec.delay_ms > 0:
+                    armed.injected_delays += 1
+                    delay_s += armed.spec.delay_ms / 1e3
+                if armed.spec.error is not None and error is None:
+                    armed.injected_errors += 1
+                    error = _make_error(armed.spec.error, site, ctx)
+        if delay_s > 0:  # slow-shard injection: sleep OUTSIDE the lock
+            time.sleep(delay_s)
+        if error is not None:
+            raise error
+
+
+# The process-wide registry every threaded site evaluates. ESTPU_FAULTS is
+# read once at import; tests and the REST admin API mutate it live.
+REGISTRY = FaultRegistry(os.environ.get("ESTPU_FAULTS"))
+
+
+def fault_point(site: str, **ctx) -> None:
+    """Evaluate the global registry at a named site. The no-faults fast
+    path is one attribute read — safe on hot paths."""
+    if REGISTRY._armed:
+        REGISTRY.check(site, **ctx)

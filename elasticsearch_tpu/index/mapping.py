@@ -428,6 +428,19 @@ class Mappings:
         target = self.fields if stage is None else stage
         if not self.dynamic:
             return None
+        if "." in name:
+            # A dotted name whose prefix is a NESTED mapping must never
+            # dynamic-map as a flat field: the flat/nested name collision
+            # would merge two document spaces' term statistics into one
+            # FieldStats (compile.py aggregate_field_stats invariant).
+            # The document parser routes such keys into the nested scope
+            # (segment.py dot-expansion); anything else reaching here is
+            # refused rather than mapped.
+            parts = name.split(".")
+            for i in range(1, len(parts)):
+                pfm = self.fields.get(".".join(parts[:i]))
+                if pfm is not None and pfm.type == NESTED:
+                    return None
         rule_mapping = self._match_dynamic_template(name, value)
         if rule_mapping is not None:
             fm = self._parse_field(name, rule_mapping)

@@ -416,6 +416,25 @@ class SegmentBuilder:
         behavior); values under a `nested`-mapped path route to nested_ops
         instead, one hidden sub-document per object. New dynamic mappings
         land in `staged_mappings`, committed only with the doc."""
+        if "." in prefix and self.mappings.get(prefix) is None:
+            # Dot-expansion through a nested parent (the reference's
+            # DocumentParser expands literal dotted keys before routing):
+            # {"comments.author": "x"} with `comments` mapped nested must
+            # become one nested sub-document, NEVER a dynamically-mapped
+            # flat field colliding with the nested scope's name — the
+            # collision aggregate_field_stats assumes impossible.
+            parts = prefix.split(".")
+            for i in range(1, len(parts)):
+                parent = ".".join(parts[:i])
+                pfm = self.mappings.fields.get(parent)
+                if pfm is not None and pfm.type == NESTED:
+                    obj: Any = value
+                    for part in reversed(parts[i:]):
+                        obj = {part: obj}
+                    self._collect_values(
+                        parent, obj, flat, nested_ops, staged_mappings
+                    )
+                    return
         fm = self.mappings.resolve_dynamic(prefix, value, staged_mappings)
         if fm is not None and fm.type == NESTED:
             for obj in value if isinstance(value, list) else [value]:

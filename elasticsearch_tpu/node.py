@@ -31,22 +31,36 @@ from .common.breaker import BreakerError, CircuitBreaker
 from .common.indexing_pressure import IndexingPressureRejected
 from .common.request_cache import RequestCache
 from .common.tasks import TaskCancelledError, TaskManager
+from .faults import REGISTRY as FAULTS
+from .faults import FaultSpec, InjectedFaultError
 from .index.engine import Engine, InvalidCasError, VersionConflictError
 from .index.mapping import Mappings
 from .ops.bm25 import BM25Params
 from .parallel.routing import shard_for_id
 from .search.coordinator import ShardedSearchCoordinator
-from .search.service import SearchRequest, SearchService
+from .search.service import (
+    SearchPhaseFailedError,
+    SearchRequest,
+    SearchService,
+)
 
 
 class ApiError(Exception):
-    """An error with an HTTP status, rendered ES-style by the REST layer."""
+    """An error with an HTTP status, rendered ES-style by the REST layer.
+    `headers` (e.g. Retry-After on 429s) ride to the HTTP response."""
 
-    def __init__(self, status: int, err_type: str, reason: str):
+    def __init__(
+        self,
+        status: int,
+        err_type: str,
+        reason: str,
+        headers: dict[str, str] | None = None,
+    ):
         super().__init__(reason)
         self.status = status
         self.err_type = err_type
         self.reason = reason
+        self.headers = headers or {}
 
 
 def index_not_found(name: str) -> ApiError:
@@ -218,6 +232,15 @@ class Node:
         self.breaker = CircuitBreaker(breaker_limit_bytes)
         self.request_cache = RequestCache()
         self.tasks = TaskManager(node_name)
+        # Degraded-mode serving counters (GET /_nodes/stats
+        # search_resilience): partial responses served, shard failures
+        # absorbed, partial-disallowed 503s.
+        self._resilience_lock = threading.Lock()
+        self.search_resilience = {
+            "partial_responses": 0,
+            "shard_failures": 0,
+            "search_phase_failures": 0,
+        }
         self.repositories: dict[str, Any] = {}
         self.pipelines: dict[str, Any] = {}  # ingest.Pipeline by id
         self._broken_pipelines: dict[str, Any] = {}  # unloadable, preserved
@@ -1061,10 +1084,23 @@ class Node:
     def _replicated_search(
         self, svc: IndexService, body: dict[str, Any] | None, scroll
     ) -> dict:
-        from .cluster import ReplicationUnavailableError
+        from .cluster import ReplicationUnavailableError, ShardSearchFailedError
         from .cluster.transport import RemoteActionError
 
         body = dict(body or {})
+        # allow_partial_search_results rides to the cluster coordinator as
+        # a call argument, not a shard-level body key.
+        from .search.service import parse_lenient_bool
+
+        try:
+            allow_partial = parse_lenient_bool(
+                body.pop("allow_partial_search_results", True),
+                "allow_partial_search_results",
+            )
+        except ValueError as e:
+            raise ApiError(
+                400, "illegal_argument_exception", str(e)
+            ) from None
         if (
             scroll is not None
             or body.get("aggs")
@@ -1080,7 +1116,16 @@ class Node:
             )
         t0 = time.monotonic()
         try:
-            out = self.replication.search(svc.name, body)
+            out = self.replication.search(
+                svc.name, body, allow_partial=bool(allow_partial)
+            )
+        except ShardSearchFailedError as e:
+            # A shard failed every copy with partial results disallowed:
+            # honest 503, never a silently-partial 200.
+            self._count_resilience("search_phase_failures")
+            raise ApiError(
+                503, "search_phase_execution_exception", str(e)
+            ) from None
         except ReplicationUnavailableError as e:
             raise ApiError(
                 503, "search_phase_execution_exception", str(e)
@@ -1097,6 +1142,10 @@ class Node:
             ) from None
         for hit in out["hits"]["hits"]:
             hit.setdefault("_index", svc.name)
+        failed = out.get("_shards", {}).get("failed", 0)
+        if failed:
+            self._count_resilience("shard_failures", failed)
+            self._count_resilience("partial_responses")
         return {
             "took": int((time.monotonic() - t0) * 1000),
             "timed_out": False,
@@ -1480,6 +1529,12 @@ class Node:
 
     # --------------------------------------------------------------- search
 
+    def _count_resilience(self, key: str, n: int = 1) -> None:
+        with self._resilience_lock:
+            self.search_resilience[key] = (
+                self.search_resilience.get(key, 0) + n
+            )
+
     def search(
         self,
         index: str,
@@ -1487,7 +1542,14 @@ class Node:
         scroll: str | None = None,
         request_cache: bool | None = None,
         timeout_s: float | None = None,
+        allow_partial: bool | None = None,
     ) -> dict:
+        if allow_partial is not None:
+            # ?allow_partial_search_results= on the URL wins over the body
+            # key; folded in up front so every dispatch path (multi-index,
+            # replicated, local, batched) honors it.
+            body = dict(body or {})
+            body["allow_partial_search_results"] = bool(allow_partial)
         if timeout_s is not None:
             # ?timeout= on the URL: fold into the body up front so every
             # dispatch path (multi-index fan-out, replicated serving, the
@@ -1582,15 +1644,40 @@ class Node:
                 self.tasks.unregister(task)
         except TaskCancelledError as e:
             raise ApiError(400, "task_cancelled_exception", str(e)) from None
+        except SearchPhaseFailedError as e:
+            # Every shard failed, or a shard failed with partial results
+            # disallowed: the honest status is 503, never a silently-
+            # partial 200 (the reference's SearchPhaseExecutionException).
+            self._count_resilience("search_phase_failures")
+            raise ApiError(
+                503, "search_phase_execution_exception", str(e)
+            ) from None
+        except InjectedFaultError as e:
+            # A fault that no degraded path could absorb (e.g. the only
+            # shard of an unreplicated index): all shards failed.
+            self._count_resilience("search_phase_failures")
+            raise ApiError(
+                503, "search_phase_execution_exception", str(e)
+            ) from None
         except IndexingPressureRejected as e:
             # Micro-batcher load shedding: the same 429 rejection contract
-            # the write path uses (es_rejected_execution_exception).
+            # the write path uses (es_rejected_execution_exception), plus
+            # a Retry-After back-off hint derived from queue-wait p50.
+            headers = {}
+            retry_after = getattr(e, "retry_after_s", None)
+            if retry_after is not None:
+                headers["Retry-After"] = str(int(retry_after))
             raise ApiError(
-                429, "es_rejected_execution_exception", str(e)
+                429, "es_rejected_execution_exception", str(e),
+                headers=headers,
             ) from None
         except ValueError as e:
             raise ApiError(400, "search_phase_execution_exception", str(e)) from None
         out = response.to_json(index)
+        if response.failed:
+            # Degraded-mode accounting: a 200 that omitted failed shards.
+            self._count_resilience("shard_failures", response.failed)
+            self._count_resilience("partial_responses")
         self._log_slow_search(svc, body, out.get("took", 0))
         if body and body.get("suggest"):
             from .search.suggest import run_suggest
@@ -1609,7 +1696,9 @@ class Node:
                 raise ApiError(
                     400, "search_phase_execution_exception", str(e)
                 ) from None
-        if cache_key is not None and not response.timed_out:
+        if cache_key is not None and not response.timed_out and not response.failed:
+            # Partial responses must never be cached: a later healthy
+            # request would be served the degraded result.
             self.request_cache.put(cache_key, out)
         return out
 
@@ -1684,12 +1773,16 @@ class Node:
         took = 0
         shards = 0
         skipped = 0
+        failed = 0
+        failures: list[dict] = []
         for rank_base, name in enumerate(targets):
             out = self.search(name, dict(sub_body))
             took += out.get("took", 0)
             sh = out.get("_shards", {})
             shards += sh.get("total", 1)
             skipped += sh.get("skipped", 0)
+            failed += sh.get("failed", 0)
+            failures.extend(sh.get("failures", []))
             tot = out["hits"].get("total")
             if tot is not None:
                 total += tot["value"]
@@ -1706,15 +1799,18 @@ class Node:
                 merged.append((key, hit["_index"], rank, hit))
         merged.sort(key=lambda t: (t[0], t[1], t[2]))
         page = [hit for *_, hit in merged[from_ : from_ + size]]
+        shards_obj: dict[str, Any] = {
+            "total": shards,
+            "successful": max(0, shards - skipped - failed),
+            "skipped": skipped,
+            "failed": failed,
+        }
+        if failures:
+            shards_obj["failures"] = failures
         out = {
             "took": took,
             "timed_out": False,
-            "_shards": {
-                "total": shards,
-                "successful": shards,
-                "skipped": skipped,
-                "failed": 0,
-            },
+            "_shards": shards_obj,
             "hits": {
                 "total": {"value": total, "relation": relation},
                 "max_score": max_score,
@@ -1952,6 +2048,13 @@ class Node:
                 page = ctx.coordinator.scroll_page(ctx, task=task)
         except TaskCancelledError as e:
             raise ApiError(400, "task_cancelled_exception", str(e)) from None
+        except (SearchPhaseFailedError, InjectedFaultError) as e:
+            # Scroll continuation hit failed shards (all failed, or
+            # partials disallowed): the same 503 contract as page one.
+            self._count_resilience("search_phase_failures")
+            raise ApiError(
+                503, "search_phase_execution_exception", str(e)
+            ) from None
         finally:
             self.tasks.unregister(task)
         page.scroll_id = scroll_id
@@ -1994,6 +2097,10 @@ class Node:
                 "query": query_body or {"match_all": {}},
                 "size": window,
                 "track_total_hits": True,
+                # A by-query scan over a silently-partial match set would
+                # report success while skipping a failed shard's docs:
+                # any shard failure must fail the whole operation (503).
+                "allow_partial_search_results": False,
             },
             None,
         )
@@ -2019,6 +2126,10 @@ class Node:
                 "query": query_body or {"match_all": {}},
                 "size": batch,
                 "track_total_hits": True,
+                # Internal scans must never silently skip a failed
+                # shard's docs — a by-query op reporting success over a
+                # partial match set is data loss; fail loudly instead.
+                "allow_partial_search_results": False,
             }
         )
         ctx = coord.open_scroll(svc.name, request, keep_alive_s=600.0)
@@ -2227,9 +2338,16 @@ class Node:
 
     # ------------------------------------------------------- msearch / mget
 
-    def msearch(self, body: str, default_index: str | None = None) -> dict:
+    def msearch(
+        self,
+        body: str,
+        default_index: str | None = None,
+        allow_partial: bool | None = None,
+    ) -> dict:
         """NDJSON multi-search: header/body line pairs, per-item outcomes
-        (action/search/MultiSearchRequest.java:52)."""
+        (action/search/MultiSearchRequest.java:52). Each item carries the
+        full degraded-mode contract — honest `_shards.failed`/`failures[]`
+        and per-item 503s under allow_partial_search_results=false."""
         t0 = time.monotonic()
         lines = [ln for ln in body.split("\n") if ln.strip()]
         if len(lines) % 2:
@@ -2259,7 +2377,9 @@ class Node:
                         "illegal_argument_exception",
                         "msearch item requires exactly one index",
                     )
-                item = self.search(index, search_body)
+                item = self.search(
+                    index, search_body, allow_partial=allow_partial
+                )
                 item["status"] = 200
             except ApiError as e:
                 item = {
@@ -2927,6 +3047,58 @@ class Node:
             }
         }
 
+    # ---------------------------------------------------------------- faults
+
+    def put_fault(self, body: dict[str, Any]) -> dict:
+        """POST /_fault — arm one fault spec (or {"faults": [specs]}),
+        deterministic per spec via its seed. See faults/registry.py for
+        the site roster."""
+        body = body or {}
+        specs = body.get("faults", [body])
+        if not isinstance(specs, list):
+            raise ApiError(
+                400, "illegal_argument_exception", "[faults] must be a list"
+            )
+        for raw in specs:
+            if not isinstance(raw, dict) or not raw.get("site"):
+                raise ApiError(
+                    400,
+                    "illegal_argument_exception",
+                    "each fault spec requires a [site]",
+                )
+            try:
+                # A delay-only spec (delay_ms set, no [error] key) means
+                # "slow", not "slow AND broken".
+                default_error = (
+                    None if float(raw.get("delay_ms", 0.0)) > 0
+                    else "internal"
+                )
+                spec = FaultSpec(
+                    site=str(raw["site"]),
+                    error_rate=float(raw.get("error_rate", 1.0)),
+                    error=raw.get("error", default_error),
+                    delay_ms=float(raw.get("delay_ms", 0.0)),
+                    count=(
+                        None if raw.get("count") is None
+                        else int(raw["count"])
+                    ),
+                    seed=int(raw.get("seed", 0)),
+                )
+                FAULTS.put(spec)
+            except (TypeError, ValueError) as e:
+                raise ApiError(
+                    400, "illegal_argument_exception", str(e)
+                ) from None
+        return {"acknowledged": True, "faults": FAULTS.stats()}
+
+    def get_faults(self) -> dict:
+        """GET /_fault — armed specs with their live counters."""
+        return FAULTS.stats()
+
+    def clear_faults(self, site: str | None = None) -> dict:
+        """DELETE /_fault[/{site}] — disarm one site pattern or all."""
+        return {"acknowledged": True, "cleared": FAULTS.clear(site)}
+
     # ---------------------------------------------------------------- admin
 
     def cluster_health(self) -> dict:
@@ -3106,6 +3278,20 @@ class Node:
             },
         }
 
+    def _batcher_resilience_stats(self) -> dict:
+        if self.exec_batcher is None:
+            return {"enabled": False}
+        stats = self.exec_batcher.stats()  # one consistent snapshot
+        return {
+            k: stats[k]
+            for k in (
+                "retried_individually",
+                "groups_quarantined",
+                "quarantine_hits",
+                "quarantined_now",
+            )
+        }
+
     def nodes_stats(self) -> dict:
         """GET /_nodes/stats — serving-resilience counters: SPMD mesh
         circuit-breaker state and disable/re-enable events per index, plus
@@ -3161,6 +3347,17 @@ class Node:
                     if self.exec_batcher is not None
                     else {"enabled": False}
                 ),
+            },
+            # Fault-injection registry (POST /_fault) and degraded-mode
+            # serving counters: partial responses, absorbed shard
+            # failures, batcher failure-isolation activity.
+            "faults": FAULTS.stats(),
+            "search_resilience": {
+                **{
+                    k: v
+                    for k, v in sorted(self.search_resilience.items())
+                },
+                "batcher": self._batcher_resilience_stats(),
             },
         }
         if self.replication is not None:

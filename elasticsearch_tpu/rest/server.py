@@ -44,8 +44,10 @@ from ..cluster import (
     StalePrimaryTermError,
 )
 from ..common.breaker import BreakerError
+from ..faults import InjectedFaultError
 from ..node import ApiError, Node
 from ..search import rank_eval
+from ..search.service import SearchPhaseFailedError
 
 Handler = Callable[["RestServer", dict, dict, Any], Any]
 
@@ -83,6 +85,26 @@ def _timeout_param(q: dict) -> float | None:
             "illegal_argument_exception",
             f"failed to parse [timeout]: [{q['timeout']}]",
         ) from None
+
+
+def _partial_param(q: dict) -> bool | None:
+    """?allow_partial_search_results= (the reference's URL param): None
+    when absent (body/default wins), else the boolean. Anything but
+    true/false is a 400 — a misspelled "False" must never silently
+    invert the caller's no-partials demand."""
+    if "allow_partial_search_results" not in q:
+        return None
+    raw = q["allow_partial_search_results"].strip().lower()
+    if raw in ("true", ""):
+        return True
+    if raw == "false":
+        return False
+    raise ApiError(
+        400,
+        "illegal_argument_exception",
+        f"Failed to parse value [{q['allow_partial_search_results']}] as "
+        f"only [true] or [false] are allowed.",
+    )
 
 
 def _cas_params(q: dict) -> dict:
@@ -231,6 +253,14 @@ class RestServer:
         r("PUT", "/{index}/_settings", lambda s, p, q, b: n.put_settings(
             p["index"], _json(b)
         ))
+        # Fault-injection admin API (faults/registry.py): arm/inspect/
+        # disarm deterministic fault specs at named serving sites.
+        r("GET", "/_fault", lambda s, p, q, b: n.get_faults())
+        r("POST", "/_fault", lambda s, p, q, b: n.put_fault(_json(b)))
+        r("DELETE", "/_fault", lambda s, p, q, b: n.clear_faults())
+        r("DELETE", "/_fault/{site}", lambda s, p, q, b: n.clear_faults(
+            p["site"]
+        ))
         r("GET", "/_tasks", lambda s, p, q, b: n.list_tasks(
             q.get("actions")
         ))
@@ -292,6 +322,7 @@ class RestServer:
             r(method, "/_search", lambda s, p, q, b: n.search(
                 "_all", _json(b), scroll=q.get("scroll"),
                 timeout_s=_timeout_param(q),
+                allow_partial=_partial_param(q),
             ))
             r(method, "/_count", lambda s, p, q, b: n.count(
                 n.default_index(), _json(b)
@@ -314,6 +345,7 @@ class RestServer:
                 # ?timeout= is honored even while the search waits in the
                 # exec micro-batcher's queue (deadline-aware launch).
                 timeout_s=_timeout_param(q),
+                allow_partial=_partial_param(q),
             ))
             r(method, "/{index}/_count", lambda s, p, q, b: n.count(
                 p["index"], _json(b)
@@ -330,9 +362,11 @@ class RestServer:
         r("DELETE", "/_search/scroll", lambda s, p, q, b: n.clear_scroll(
             _json(b)
         ))
-        r("POST", "/_msearch", lambda s, p, q, b: n.msearch(b))
+        r("POST", "/_msearch", lambda s, p, q, b: n.msearch(
+            b, allow_partial=_partial_param(q)
+        ))
         r("POST", "/{index}/_msearch", lambda s, p, q, b: n.msearch(
-            b, default_index=p["index"]
+            b, default_index=p["index"], allow_partial=_partial_param(q)
         ))
         def _refresh_multi(s, p, q, b):
             names = n.expand_index_patterns(p["index"])
@@ -457,7 +491,10 @@ class RestServer:
             return handler(self, params, query, body)
 
     def dispatch(self, method: str, path: str, query: dict, body: str):
-        """Returns (status, payload). ES-style error payloads on failure."""
+        """Returns (status, payload). ES-style error payloads on failure.
+        Extra response headers (e.g. Retry-After on shed 429s) land in
+        `self._tl.response_headers` for the HTTP layer to emit."""
+        self._tl.response_headers = {}
         try:
             # HEAD is served by the matching GET handler (the HTTP layer
             # suppresses the body), like the reference's RestController
@@ -484,6 +521,8 @@ class RestServer:
                 400, "invalid_request", f"no handler found for uri [{path}]"
             )
         except ApiError as e:
+            if e.headers:
+                self._tl.response_headers = dict(e.headers)
             return e.status, {
                 "error": {
                     "type": e.err_type,
@@ -506,6 +545,17 @@ class RestServer:
             return 503, {
                 "error": {
                     "type": "unavailable_shards_exception",
+                    "reason": str(e),
+                },
+                "status": 503,
+            }
+        except (SearchPhaseFailedError, InjectedFaultError) as e:
+            # Shard failures that escaped a handler further down (e.g. an
+            # internal by-query scan refusing a partial match set): 503,
+            # never a stack trace out of the socket.
+            return 503, {
+                "error": {
+                    "type": "search_phase_execution_exception",
                     "reason": str(e),
                 },
                 "status": 503,
@@ -569,6 +619,10 @@ class RestServer:
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.send_header("X-elastic-product", "Elasticsearch")
+                for name, value in getattr(
+                    rest._tl, "response_headers", {}
+                ).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 if self.command != "HEAD":  # HEAD: headers only, no body
                     self.wfile.write(data)

@@ -28,9 +28,12 @@ from typing import TYPE_CHECKING, Any
 
 import numpy as np
 
+from ..common.tasks import TaskCancelledError
+from ..faults import fault_point
 from ..query.compile import aggregate_field_stats
 from .service import (
     SearchHit,
+    SearchPhaseFailedError,
     SearchRequest,
     SearchResponse,
     SearchService,
@@ -194,13 +197,14 @@ class ShardedSearchCoordinator:
             fields=None,
         )
         if k > 0 or agg_total is None:
-            merged, total, max_score, timed_out, profiles, skipped = (
+            merged, total, max_score, timed_out, profiles, skipped, failures = (
                 self._scatter_merge(shard_request, stats, snapshots, task=task)
             )
         else:
-            merged, total, max_score, timed_out, profiles, skipped = (
-                [], 0, None, False, [], 0,
+            merged, total, max_score, timed_out, profiles, skipped, failures = (
+                [], 0, None, False, [], 0, [],
             )
+        self._check_partial_allowed(request, failures, skipped)
         if task is not None and task.timed_out:
             timed_out = True
         if agg_total is not None:
@@ -221,10 +225,34 @@ class ShardedSearchCoordinator:
             shards=len(self.engines),
             timed_out=timed_out,
             skipped=skipped,
+            failed=len(failures),
+            failures=failures,
             profile=(
                 {"shards": profiles} if request.profile and profiles else None
             ),
         )
+
+    def _check_partial_allowed(
+        self, request: SearchRequest, failures: list, skipped: int
+    ) -> None:
+        """Enforce the allow_partial_search_results contract: every
+        non-skipped shard failing — or any shard failing with partials
+        disallowed — fails the whole request (HTTP 503)."""
+        if not failures:
+            return
+        executed = len(self.engines) - skipped
+        if len(failures) >= executed:
+            raise SearchPhaseFailedError(
+                f"all shards failed for [{self.index_name}]",
+                failures=failures,
+            )
+        if not request.allow_partial_search_results:
+            raise SearchPhaseFailedError(
+                f"[{self.index_name}] {len(failures)} of "
+                f"{len(self.engines)} shards failed and "
+                f"allow_partial_search_results is false",
+                failures=failures,
+            )
 
     def _apply_fetch_subphases(self, request: SearchRequest, hits) -> None:
         """Run highlight/docvalue_fields/fields over the final page only."""
@@ -268,6 +296,7 @@ class ShardedSearchCoordinator:
         timed = [False] * n
         errors: list[Exception | None] = [None] * n
         skipped = [0] * n
+        shard_failures: list[list[dict]] = [[] for _ in range(n)]
         for shard_idx, svc in enumerate(self.services):
             rows = [
                 i
@@ -281,13 +310,30 @@ class ShardedSearchCoordinator:
             if not rows:
                 per_shard.append([[] for _ in range(n)])
                 continue
-            cands, tot, tmo, errs = svc._batched_query_phase(
-                [requests[i] for i in rows],
-                [ks[i] for i in rows],
-                stats,
-                snapshots[shard_idx],
-                [tasks[i] for i in rows],
-            )
+            try:
+                fault_point(
+                    "coordinator.shard",
+                    index=self.index_name,
+                    shard=shard_idx,
+                )
+                cands, tot, tmo, errs = svc._batched_query_phase(
+                    [requests[i] for i in rows],
+                    [ks[i] for i in rows],
+                    stats,
+                    snapshots[shard_idx],
+                    [tasks[i] for i in rows],
+                )
+            except (ValueError, TypeError, TaskCancelledError):
+                raise
+            except Exception as e:
+                # Shard-level failure on the coalesced path: every rider
+                # records a per-shard failure (partial-results machinery),
+                # never a whole-batch poison.
+                entry = self._shard_failure_entry(shard_idx, e)
+                for i in rows:
+                    shard_failures[i].append(entry)
+                per_shard.append([[] for _ in range(n)])
+                continue
             shard_cands: list[list] = [[] for _ in range(n)]
             for pos, i in enumerate(rows):
                 shard_cands[i] = cands[pos]
@@ -302,6 +348,14 @@ class ShardedSearchCoordinator:
             if errors[i] is not None:
                 out.append(errors[i])
                 continue
+            if shard_failures[i]:
+                try:
+                    self._check_partial_allowed(
+                        request, shard_failures[i], skipped[i]
+                    )
+                except SearchPhaseFailedError as e:
+                    out.append(e)
+                    continue
             merged: list[tuple] = []
             max_score = None
             for shard_idx in range(len(self.services)):
@@ -347,6 +401,8 @@ class ShardedSearchCoordinator:
                     shards=len(self.engines),
                     timed_out=timed[i],
                     skipped=skipped[i],
+                    failed=len(shard_failures[i]),
+                    failures=shard_failures[i],
                 )
             )
         return out
@@ -385,13 +441,21 @@ class ShardedSearchCoordinator:
         (merge key, shard, per-shard rank) — the single implementation of
         the coordinator reduce contract used by both first-page search and
         scroll continuation. Returns (sorted merged tuples, total,
-        max_score, timed_out, per-shard profiles)."""
+        max_score, timed_out, per-shard profiles, skipped, failures).
+
+        Degraded mode: a shard whose scoring pass raises a non-request-
+        shaped error (injected fault, breaker trip, launch failure) is
+        recorded in `failures` and the scatter continues — merged hits
+        stay a correct subset because scores ride the pushed-down global
+        statistics, independent of which shards answered. The caller
+        enforces the allow_partial_search_results contract."""
         merged: list[tuple] = []
         total = 0
         max_score = None
         timed_out = False
         skipped = 0
         profiles: list[dict] = []
+        failures: list[dict] = []
         for shard_idx, svc in enumerate(self.services):
             if task is not None:
                 task.raise_if_cancelled()
@@ -414,9 +478,24 @@ class ShardedSearchCoordinator:
                 sub = replace(
                     request, search_after=[after[0]], after_doc=after[1]
                 )
-            resp = svc.search(
-                sub, stats=stats, segments=snapshots[shard_idx], task=task
-            )
+            try:
+                # Injectable per-shard failure / slow shard
+                # (faults/registry.py `coordinator.shard`).
+                fault_point(
+                    "coordinator.shard",
+                    index=self.index_name,
+                    shard=shard_idx,
+                )
+                resp = svc.search(
+                    sub, stats=stats, segments=snapshots[shard_idx], task=task
+                )
+            except (ValueError, TypeError, TaskCancelledError):
+                raise  # request-shaped / cancellation: never "a shard died"
+            except Exception as e:
+                failures.append(
+                    self._shard_failure_entry(shard_idx, e)
+                )
+                continue
             if resp.profile:
                 for shard_profile in resp.profile["shards"]:
                     shard_profile["id"] = f"[{self.index_name}][{shard_idx}]"
@@ -434,7 +513,15 @@ class ShardedSearchCoordinator:
                     (self._merge_key(request, hit), shard_idx, rank, hit)
                 )
         merged.sort(key=lambda t: (t[0], t[1], t[2]))
-        return merged, total, max_score, timed_out, profiles, skipped
+        return merged, total, max_score, timed_out, profiles, skipped, failures
+
+    def _shard_failure_entry(self, shard_idx: int, e: Exception) -> dict:
+        return {
+            "shard": shard_idx,
+            "index": self.index_name,
+            "node": "local",
+            "reason": {"type": type(e).__name__, "reason": str(e)},
+        }
 
     def scroll_page(self, ctx: ScrollContext, task=None) -> SearchResponse:
         """Serve the next page of a scroll and advance its cursors."""
@@ -446,9 +533,13 @@ class ShardedSearchCoordinator:
         stripped = replace(
             request, highlight=None, docvalue_fields=None, fields=None
         )
-        merged, total, max_score, timed_out, _profiles, skipped = self._scatter_merge(
-            stripped, ctx.stats, ctx.snapshots, ctx.per_shard_after, task=task
+        merged, total, max_score, timed_out, _profiles, skipped, failures = (
+            self._scatter_merge(
+                stripped, ctx.stats, ctx.snapshots, ctx.per_shard_after,
+                task=task,
+            )
         )
+        self._check_partial_allowed(request, failures, skipped)
         page = merged[:size]
         for _, shard_idx, _, hit in page:
             cursor_value = (
@@ -469,6 +560,8 @@ class ShardedSearchCoordinator:
             shards=len(self.engines),
             timed_out=timed_out,
             skipped=skipped,
+            failed=len(failures),
+            failures=failures,
         )
 
     @staticmethod

@@ -29,10 +29,21 @@ from typing import Any
 
 import numpy as np
 
+from ..faults import fault_point
 from ..index.engine import Engine, SegmentHandle
 from ..ops import bm25_device
 from ..query.compile import FieldStats
 from ..query.dsl import MatchAllQuery, Query, parse_query
+
+
+class SearchPhaseFailedError(Exception):
+    """Shard failures that must fail the whole request (HTTP 503): every
+    shard failed, or allow_partial_search_results=false and any did.
+    Carries the per-shard `failures[]` entries."""
+
+    def __init__(self, message: str, failures: list | None = None):
+        super().__init__(message)
+        self.failures = failures or []
 
 
 @dataclass
@@ -79,6 +90,11 @@ class SearchResponse:
     timed_out: bool = False
     profile: dict[str, Any] | None = None
     skipped: int = 0  # can_match pre-filtered shards
+    # Degraded-mode accounting: shards whose every attempt failed, served
+    # partial under allow_partial_search_results, with one failures[]
+    # entry per failed shard ({shard, index, node, reason}).
+    failed: int = 0
+    failures: list = field(default_factory=list)
     # took breakdown (plan/queue/execute/reduce ms), populated when
     # profile: true. Profiled searches execute unbatched, so queue_ms is
     # honestly 0 here; batch queue waits surface as p50/p99 percentiles
@@ -95,15 +111,20 @@ class SearchResponse:
                 "total": {"value": self.total, "relation": self.total_relation},
                 **hits_obj,
             }
+        shards_obj: dict[str, Any] = {
+            "total": self.shards,
+            # Honest accounting: successful + skipped + failed == total on
+            # every response shape (the chaos suite's core invariant).
+            "successful": max(0, self.shards - self.skipped - self.failed),
+            "skipped": self.skipped,
+            "failed": self.failed,
+        }
+        if self.failures:
+            shards_obj["failures"] = list(self.failures)
         out = {
             "took": self.took_ms,
             "timed_out": self.timed_out,
-            "_shards": {
-                "total": self.shards,
-                "successful": self.shards,
-                "skipped": self.skipped,
-                "failed": 0,
-            },
+            "_shards": shards_obj,
             "hits": hits_obj,
         }
         if self.scroll_id is not None:
@@ -115,6 +136,23 @@ class SearchResponse:
         if self.breakdown is not None:
             out["took_breakdown"] = self.breakdown
         return out
+
+
+def parse_lenient_bool(value, name: str) -> bool:
+    """true/false (bool or string, any case) — anything else raises: a
+    misspelled boolean must never silently pick a default."""
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, str):
+        low = value.strip().lower()
+        if low in ("true", ""):
+            return True
+        if low == "false":
+            return False
+    raise ValueError(
+        f"Failed to parse value [{value!r}] for [{name}]: only [true] "
+        f"or [false] are allowed"
+    )
 
 
 def clamp_total(total: int, track_total_hits) -> tuple[int | None, str]:
@@ -189,6 +227,11 @@ class SearchRequest:
     docvalue_fields: list[str] | None = None
     fields: list[str] | None = None  # retrieved from _source
     profile: bool = False  # per-segment timing in the response
+    # Degraded-mode contract (the reference's allow_partial_search_results,
+    # default true): failed shards degrade to a partial 200 with honest
+    # `_shards.failed`/`failures[]`; false turns ANY shard failure into a
+    # 503. Overridable per request via body key or URL param.
+    allow_partial_search_results: bool = True
 
     # The search-body keys this node understands; anything else is a
     # parsing error, like the reference's strict SearchSourceBuilder
@@ -202,6 +245,7 @@ class SearchRequest:
             "seq_no_primary_term", "explain", "pit", "track_scores",
             "terminate_after", "indices_boost", "script_fields",
             "rest_total_hits_as_int", "scroll_id", "scroll",
+            "allow_partial_search_results",
         }
     )
 
@@ -307,6 +351,10 @@ class SearchRequest:
                 f if isinstance(f, str) else f["field"]
                 for f in body["fields"]
             ]
+        allow_partial = parse_lenient_bool(
+            body.get("allow_partial_search_results", True),
+            "allow_partial_search_results",
+        )
         return cls(
             query=query,
             size=int(body.get("size", 10)),
@@ -322,6 +370,7 @@ class SearchRequest:
             docvalue_fields=docvalue_fields,
             fields=fields,
             profile=bool(body.get("profile", False)),
+            allow_partial_search_results=bool(allow_partial),
         )
 
 
@@ -644,10 +693,22 @@ class SearchService:
                 handle, stats, groups, compiled, requests
             )
             for spec, rows in groups.items():
-                self._execute_group(
-                    handle, spec, rows, compiled, requests, ks, stats,
-                    cands, totals,
-                )
+                try:
+                    fault_point("search.kernel", index=self.index_name)
+                    self._execute_group(
+                        handle, spec, rows, compiled, requests, ks, stats,
+                        cands, totals,
+                    )
+                except (ValueError, TypeError):
+                    raise  # request-shaped: the compile path 400s
+                except Exception as e:
+                    # Launch failure isolation: only the riders of THIS
+                    # group fail (and get retried individually by the
+                    # micro-batcher); batchmates in other groups and
+                    # segments are untouched.
+                    for i in rows:
+                        errors[i] = e
+                        alive.discard(i)
         return cands, totals, timed, errors
 
     def _merge_term_groups(self, handle, stats, groups, compiled, requests):
@@ -900,6 +961,9 @@ class SearchService:
     ) -> tuple[int, str]:
         """Score one segment, appending candidate tuples. Returns
         (total hits, execution backend used)."""
+        # Injectable device-launch failure / slow-segment delay
+        # (faults/registry.py `search.kernel`).
+        fault_point("search.kernel", index=self.index_name)
         plan_t0 = time.monotonic()
         compiler = self.engine.compiler_for(handle, stats)
         compiled = compiler.compile(request.query)

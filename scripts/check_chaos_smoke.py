@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""CI smoke gate for the fault-injection / degraded-mode subsystem.
+
+Runs the deterministic-seed chaos suite (seeded fault schedules over a
+replicated multi-shard corpus: correct-subset partials, honest shard
+accounting, allow_partial_search_results=false → 503, batcher failure
+isolation) plus the targeted fault-injection contracts, on the CPU
+backend — no TPU needed, < 60 s. The same tests ride the tier-1 run via
+the fast (`not slow`) marker; this script is the standalone hook for
+pre-merge / cron checks, mirroring scripts/check_exec_parity.py:
+
+    python scripts/check_chaos_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "tests/test_faults_chaos.py",
+        "tests/test_fault_injection.py",
+        "-q",
+        "-m",
+        "not slow",
+        "-p",
+        "no:cacheprovider",
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,586 @@
+"""Fault-injection subsystem + degraded-mode search contracts.
+
+Covers: registry determinism and the `POST /_fault` admin API; coordinator
+partial results with honest `_shards.failed`/`failures[]` (including
+`_msearch`); `allow_partial_search_results=false` → 503; batcher failure
+isolation (individual retry, quarantine); the shed-429 Retry-After hint;
+adaptive replica selection (EWMA reroute away from failing copies); and
+the nested dotted-key dynamic-mapping fix.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.response_collector import (
+    ResponseCollectorService,
+)
+from elasticsearch_tpu.common.indexing_pressure import (
+    IndexingPressureRejected,
+)
+from elasticsearch_tpu.exec.batcher import MicroBatcher
+from elasticsearch_tpu.faults import (
+    REGISTRY,
+    FaultRegistry,
+    FaultSpec,
+    InjectedFaultError,
+)
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.rest.server import RestServer
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    REGISTRY.clear()
+    yield
+    REGISTRY.clear()
+
+
+# ---------------------------------------------------------------- registry
+
+
+class TestRegistry:
+    def test_seeded_schedule_is_deterministic(self):
+        def schedule(seed):
+            reg = FaultRegistry()
+            reg.put(FaultSpec(site="x", error_rate=0.5, seed=seed))
+            out = []
+            for _ in range(50):
+                try:
+                    reg.check("x")
+                    out.append(0)
+                except InjectedFaultError:
+                    out.append(1)
+            return out
+
+        assert schedule(7) == schedule(7)
+        assert schedule(7) != schedule(8)
+        assert 0 < sum(schedule(7)) < 50
+
+    def test_count_budget_exhausts(self):
+        reg = FaultRegistry()
+        reg.put(FaultSpec(site="x", error_rate=1.0, count=2))
+        fired = 0
+        for _ in range(5):
+            try:
+                reg.check("x")
+            except InjectedFaultError:
+                fired += 1
+        assert fired == 2
+        assert reg.stats()["specs"][0]["exhausted"] is True
+
+    def test_pattern_matching_and_error_classes(self):
+        from elasticsearch_tpu.cluster.transport import ConnectTransportError
+        from elasticsearch_tpu.common.breaker import BreakerError
+
+        reg = FaultRegistry()
+        reg.put(FaultSpec(site="transport.send.*", error="transport"))
+        with pytest.raises(ConnectTransportError):
+            reg.check("transport.send.shard_search")
+        reg.check("other.site")  # no match, no fault
+        reg.clear()
+        reg.put(FaultSpec(site="breaker.*", error="breaker"))
+        with pytest.raises(BreakerError):
+            reg.check("breaker.reserve")
+
+    def test_delay_only_spec_sleeps(self):
+        reg = FaultRegistry()
+        reg.put(FaultSpec(site="slow", error=None, delay_ms=30))
+        t0 = time.monotonic()
+        reg.check("slow")  # no error raised
+        assert time.monotonic() - t0 >= 0.025
+
+    def test_env_parsing(self):
+        specs = FaultRegistry.parse_env(
+            "coordinator.shard:rate=0.3:error=transport:seed=7,"
+            "batcher.launch:delay_ms=5:count=10:error=none"
+        )
+        assert specs[0].site == "coordinator.shard"
+        assert specs[0].error_rate == 0.3
+        assert specs[0].error == "transport"
+        assert specs[0].seed == 7
+        assert specs[1].error is None
+        assert specs[1].delay_ms == 5.0
+        assert specs[1].count == 10
+        with pytest.raises(ValueError):
+            FaultRegistry.parse_env("x:bogus=1")
+        with pytest.raises(ValueError):
+            FaultRegistry.parse_env("x:rate=1.5")
+
+
+# ------------------------------------------------------------- REST admin
+
+
+INDEX_3SHARD = json.dumps(
+    {
+        "settings": {"index": {"number_of_shards": 3}},
+        "mappings": {"properties": {"body": {"type": "text"}}},
+    }
+)
+
+
+def _seed_docs(rest, index, n=30):
+    lines = []
+    for i in range(n):
+        lines.append(json.dumps({"index": {"_index": index, "_id": f"d{i}"}}))
+        lines.append(json.dumps({"body": f"findme token{i % 5}"}))
+    status, resp = rest.dispatch("POST", "/_bulk", {}, "\n".join(lines))
+    assert status == 200 and not resp["errors"]
+    rest.dispatch("POST", f"/{index}/_refresh", {}, "")
+
+
+@pytest.fixture
+def rest(monkeypatch):
+    # The host-loop coordinator path is what this suite faults; keep the
+    # SPMD mesh out of the way.
+    monkeypatch.setenv("ESTPU_MESH_SERVING", "0")
+    server = RestServer()
+    yield server
+    server.close()
+
+
+class TestFaultAdminApi:
+    def test_arm_inspect_disarm(self, rest):
+        status, resp = rest.dispatch(
+            "POST",
+            "/_fault",
+            {},
+            json.dumps(
+                {"site": "coordinator.shard", "error_rate": 0.5, "seed": 3}
+            ),
+        )
+        assert status == 200 and resp["acknowledged"]
+        status, resp = rest.dispatch("GET", "/_fault", {}, "")
+        assert status == 200
+        assert resp["active"] is True
+        (spec,) = resp["specs"]
+        assert spec["site"] == "coordinator.shard"
+        assert spec["error_rate"] == 0.5
+        status, resp = rest.dispatch("DELETE", "/_fault", {}, "")
+        assert status == 200 and resp["cleared"] == 1
+        status, resp = rest.dispatch("GET", "/_fault", {}, "")
+        assert resp["active"] is False
+
+    def test_bad_spec_is_400(self, rest):
+        status, resp = rest.dispatch(
+            "POST", "/_fault", {}, json.dumps({"error_rate": 1.0})
+        )
+        assert status == 400
+        status, _ = rest.dispatch(
+            "POST", "/_fault", {},
+            json.dumps({"site": "x", "error": "nonsense"}),
+        )
+        assert status == 400
+
+
+class TestDegradedCoordinator:
+    def _arm_one_shard_fault(self, rest, **kw):
+        body = {"site": "coordinator.shard", "error_rate": 1.0, "count": 1}
+        body.update(kw)
+        status, _ = rest.dispatch("POST", "/_fault", {}, json.dumps(body))
+        assert status == 200
+
+    def test_partial_results_with_honest_failures(self, rest):
+        status, _ = rest.dispatch("PUT", "/fi", {}, INDEX_3SHARD)
+        assert status == 200
+        _seed_docs(rest, "fi")
+        # Baseline: full result.
+        q = json.dumps({"query": {"match": {"body": "findme"}}, "size": 30})
+        status, full = rest.dispatch("POST", "/fi/_search", {}, q)
+        assert status == 200
+        assert full["_shards"] == {
+            "total": 3, "successful": 3, "skipped": 0, "failed": 0,
+        }
+        baseline = {
+            h["_id"]: h["_score"] for h in full["hits"]["hits"]
+        }
+        # One shard fails exactly once: partial 200 with failures[].
+        self._arm_one_shard_fault(rest)
+        status, part = rest.dispatch("POST", "/fi/_search", {}, q)
+        assert status == 200
+        sh = part["_shards"]
+        assert sh["failed"] == 1
+        assert sh["successful"] + sh["failed"] + sh["skipped"] == sh["total"]
+        (failure,) = sh["failures"]
+        assert failure["index"] == "fi"
+        assert failure["reason"]["type"] == "InjectedFaultError"
+        # Correct subset: identical scores, fewer docs, order preserved.
+        hits = part["hits"]["hits"]
+        assert 0 < len(hits) < len(baseline)
+        for hit in hits:
+            assert baseline[hit["_id"]] == hit["_score"]
+
+    def test_allow_partial_false_body_and_url_503(self, rest):
+        status, _ = rest.dispatch("PUT", "/fi", {}, INDEX_3SHARD)
+        assert status == 200
+        _seed_docs(rest, "fi")
+        # sort:_score keeps the request off the micro-batcher (whose
+        # individual-retry machinery would absorb a one-shot fault —
+        # tested separately): one shard fails, partials are disallowed,
+        # the whole request must 503.
+        q = {
+            "query": {"match": {"body": "findme"}},
+            "sort": [{"_score": "desc"}],
+        }
+        self._arm_one_shard_fault(rest)
+        status, resp = rest.dispatch(
+            "POST", "/fi/_search", {},
+            json.dumps({**q, "allow_partial_search_results": False}),
+        )
+        assert status == 503
+        assert resp["error"]["type"] == "search_phase_execution_exception"
+        self._arm_one_shard_fault(rest)
+        status, resp = rest.dispatch(
+            "POST", "/fi/_search",
+            {"allow_partial_search_results": "false"}, json.dumps(q),
+        )
+        assert status == 503
+        # Faults cleared (count budget spent): the same request succeeds.
+        status, resp = rest.dispatch(
+            "POST", "/fi/_search",
+            {"allow_partial_search_results": "false"}, json.dumps(q),
+        )
+        assert status == 200 and resp["_shards"]["failed"] == 0
+
+    def test_bogus_allow_partial_values_are_400(self, rest):
+        """A misspelled boolean must never silently invert the caller's
+        no-partials demand — URL and body forms both reject it."""
+        status, _ = rest.dispatch("PUT", "/fi", {}, INDEX_3SHARD)
+        assert status == 200
+        q = {"query": {"match_all": {}}}
+        status, resp = rest.dispatch(
+            "POST", "/fi/_search",
+            {"allow_partial_search_results": "maybe"}, json.dumps(q),
+        )
+        assert status == 400, resp
+        status, resp = rest.dispatch(
+            "POST", "/fi/_search", {},
+            json.dumps({**q, "allow_partial_search_results": "nope"}),
+        )
+        assert status == 400, resp
+        # Case-insensitive accepted spellings still work.
+        status, resp = rest.dispatch(
+            "POST", "/fi/_search",
+            {"allow_partial_search_results": "False"}, json.dumps(q),
+        )
+        assert status == 200, resp
+
+    def test_one_shot_fault_on_batched_path_degrades_honestly(self, rest):
+        """A count-budgeted shard fault on the coalesced (batched) path
+        serves an honest partial 200 — the failure never poisons the
+        batch or escalates to an error."""
+        status, _ = rest.dispatch("PUT", "/fi", {}, INDEX_3SHARD)
+        assert status == 200
+        _seed_docs(rest, "fi")
+        self._arm_one_shard_fault(rest)  # count=1
+        status, resp = rest.dispatch(
+            "POST", "/fi/_search", {},
+            json.dumps({"query": {"match": {"body": "findme"}}}),
+        )
+        assert status == 200
+        sh = resp["_shards"]
+        assert sh["failed"] == 1
+        assert sh["successful"] + sh["failed"] + sh["skipped"] == sh["total"]
+        assert sh["failures"][0]["reason"]["type"] == "InjectedFaultError"
+
+    def test_single_shard_index_fault_is_503_not_500(self, rest):
+        status, _ = rest.dispatch(
+            "PUT", "/one", {},
+            json.dumps({"mappings": {"properties": {"body": {"type": "text"}}}}),
+        )
+        assert status == 200
+        _seed_docs(rest, "one", n=5)
+        # Persistent fault: the retry fails too, and a 1-shard index has
+        # no partial to degrade to.
+        status, _ = rest.dispatch(
+            "POST", "/_fault", {}, json.dumps({"site": "search.kernel"})
+        )
+        assert status == 200
+        status, resp = rest.dispatch(
+            "POST", "/one/_search", {},
+            json.dumps({"query": {"match": {"body": "findme"}}}),
+        )
+        # All (one) shards failed: 503, never a raw 500 or partial 200.
+        assert status == 503
+        assert resp["error"]["type"] == "search_phase_execution_exception"
+
+    def test_msearch_items_carry_failures(self, rest):
+        status, _ = rest.dispatch("PUT", "/fi", {}, INDEX_3SHARD)
+        assert status == 200
+        _seed_docs(rest, "fi")
+        self._arm_one_shard_fault(rest)
+        payload = "\n".join(
+            [
+                json.dumps({"index": "fi"}),
+                json.dumps({"query": {"match": {"body": "findme"}}}),
+                json.dumps({"index": "fi"}),
+                json.dumps({"query": {"match": {"body": "findme"}}}),
+            ]
+        )
+        status, resp = rest.dispatch("POST", "/_msearch", {}, payload)
+        assert status == 200
+        shard_sum = [
+            r["_shards"]["successful"] + r["_shards"]["failed"]
+            + r["_shards"]["skipped"]
+            for r in resp["responses"]
+        ]
+        assert shard_sum == [3, 3]
+        assert sum(
+            r["_shards"]["failed"] for r in resp["responses"]
+        ) == 1
+
+    def test_counters_surface_in_nodes_stats(self, rest):
+        status, _ = rest.dispatch("PUT", "/fi", {}, INDEX_3SHARD)
+        assert status == 200
+        _seed_docs(rest, "fi")
+        self._arm_one_shard_fault(rest)
+        q = json.dumps({"query": {"match": {"body": "findme"}}})
+        status, _ = rest.dispatch("POST", "/fi/_search", {}, q)
+        assert status == 200
+        status, stats = rest.dispatch("GET", "/_nodes/stats", {}, "")
+        node = next(iter(stats["nodes"].values()))
+        res = node["search_resilience"]
+        assert res["partial_responses"] >= 1
+        assert res["shard_failures"] >= 1
+        assert node["faults"]["specs"][0]["injected_errors"] == 1
+
+
+# ---------------------------------------------------- batcher isolation
+
+
+class FlakySearcher:
+    """search_many fails marked requests; the solo path always works."""
+
+    def __init__(self, poison=()):
+        self.poison = set(poison)
+        self.batch_calls = []
+        self.solo_calls = []
+        self.lock = threading.Lock()
+
+    def search_many(self, requests, tasks=None):
+        with self.lock:
+            self.batch_calls.append(list(requests))
+        return [
+            InjectedFaultError(f"boom:{r}") if r in self.poison
+            else f"batched:{r}"
+            for r in requests
+        ]
+
+    def search(self, request, task=None):
+        with self.lock:
+            self.solo_calls.append(request)
+        return f"solo:{request}"
+
+
+class TestBatcherIsolation:
+    def test_failed_subrequest_retried_individually(self):
+        batcher = MicroBatcher(max_wait_s=0.2)
+        stub = FlakySearcher(poison={"bad"})
+        results = {}
+
+        def go(name, delay):
+            time.sleep(delay)
+            results[name] = batcher.execute(stub, name)
+
+        threads = [threading.Thread(target=go, args=("a", 0.0))]
+        threads += [
+            threading.Thread(target=go, args=(n, 0.05))
+            for n in ("bad", "c", "d")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        # Batchmates unharmed, the poisoned one served via the solo path.
+        assert results["c"] == "batched:c"
+        assert results["d"] == "batched:d"
+        assert results["bad"] == "solo:bad"
+        assert batcher.stats()["retried_individually"] == 1
+        batcher.close()
+
+    def test_injected_batcher_fault_spares_batchmates(self):
+        REGISTRY.put(
+            FaultSpec(site="batcher.launch", error_rate=1.0, count=1)
+        )
+        batcher = MicroBatcher(max_wait_s=0.2)
+        stub = FlakySearcher()
+        results = {}
+
+        def go(name, delay):
+            time.sleep(delay)
+            results[name] = batcher.execute(stub, name)
+
+        threads = [
+            threading.Thread(target=go, args=(n, d))
+            for n, d in (("a", 0.0), ("b", 0.05), ("c", 0.05))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        # Exactly one request took the fault and was served solo; no
+        # request failed.
+        solo = [v for v in results.values() if v.startswith("solo:")]
+        assert len(solo) == 1
+        assert len(results) == 3
+        batcher.close()
+
+    def test_repeat_offender_group_quarantined(self):
+        batcher = MicroBatcher(max_wait_s=0.0)
+        stub = FlakySearcher(poison={"bad"})
+        for _ in range(batcher.QUARANTINE_FAILURES):
+            assert batcher.execute(stub, "bad") == "solo:bad"
+        stats = batcher.stats()
+        assert stats["groups_quarantined"] == 1
+        n_batches = len(stub.batch_calls)
+        # Quarantined: served on the per-request path, no coalesced launch.
+        assert batcher.execute(stub, "bad") == "solo:bad"
+        assert len(stub.batch_calls) == n_batches
+        assert batcher.stats()["quarantine_hits"] == 1
+        batcher.close()
+
+    def test_shed_carries_retry_after_hint(self):
+        batcher = MicroBatcher(max_wait_s=0.05, queue_limit=1)
+        slow = FlakySearcher()
+        orig = slow.search_many
+
+        def slow_many(requests, tasks=None):
+            time.sleep(0.4)
+            return orig(requests, tasks)
+
+        slow.search_many = slow_many
+        t = threading.Thread(target=lambda: batcher.execute(slow, "a"))
+        t.start()
+        time.sleep(0.1)  # a executing; fill the queue
+        t2 = threading.Thread(target=lambda: batcher.execute(slow, "b"))
+        t2.start()
+        time.sleep(0.05)
+        with pytest.raises(IndexingPressureRejected) as ei:
+            batcher.execute(slow, "c")
+        assert 1 <= ei.value.retry_after_s <= 30
+        t.join(timeout=5)
+        t2.join(timeout=5)
+        batcher.close()
+
+    def test_rest_429_sets_retry_after_header(self, rest):
+        status, _ = rest.dispatch("PUT", "/fi", {}, INDEX_3SHARD)
+        assert status == 200
+        _seed_docs(rest, "fi", n=5)
+
+        def shed(*a, **kw):
+            err = IndexingPressureRejected("queue full")
+            err.retry_after_s = 7
+            raise err
+
+        rest.node.exec_batcher.execute = shed
+        status, resp = rest.dispatch(
+            "POST", "/fi/_search", {},
+            json.dumps({"query": {"match": {"body": "findme"}}}),
+        )
+        assert status == 429
+        assert resp["error"]["type"] == "es_rejected_execution_exception"
+        assert rest._tl.response_headers["Retry-After"] == "7"
+
+
+# ------------------------------------------- adaptive replica selection
+
+
+class TestResponseCollector:
+    def test_failing_copy_drops_behind_healthy_ones(self):
+        rc = ResponseCollectorService()
+        copies = ["n0", "n1", "n2"]
+        for node in copies:
+            rc.record_response(node, 0.01)
+        assert rc.ordered(copies) == copies  # ties keep caller order
+        rc.record_failure("n0")
+        assert rc.ordered(copies)[0] != "n0"
+        assert rc.ordered(copies)[-1] == "n0"
+        # Successes rehabilitate the copy (penalty decays toward zero).
+        for _ in range(20):
+            rc.record_response("n0", 0.001)
+        assert rc.ordered(copies)[0] == "n0"
+
+    def test_slow_copy_ranks_behind_fast_ones(self):
+        rc = ResponseCollectorService()
+        rc.record_response("slow", 0.5)
+        rc.record_response("fast", 0.001)
+        assert rc.ordered(["slow", "fast"]) == ["fast", "slow"]
+        snap = rc.snapshot()
+        assert snap["slow"]["rank"] > snap["fast"]["rank"]
+        assert snap["fast"]["responses"] == 1
+
+    def test_queue_pressure_raises_rank(self):
+        rc = ResponseCollectorService()
+        for _ in range(5):
+            rc.record_response("busy", 0.01, queue_size=20)
+            rc.record_response("idle", 0.01, queue_size=0)
+        assert rc.ordered(["busy", "idle"]) == ["idle", "busy"]
+
+
+# --------------------------------------------------- nested dotted keys
+
+
+class TestNestedDottedKeys:
+    MAPPINGS = {
+        "properties": {
+            "title": {"type": "text"},
+            "comments": {
+                "type": "nested",
+                "properties": {
+                    "author": {"type": "keyword"},
+                    "body": {"type": "text"},
+                },
+            },
+        }
+    }
+
+    def test_literal_dotted_key_routes_into_nested_scope(self):
+        engine = Engine(Mappings.from_json(self.MAPPINGS))
+        engine.index({"title": "t", "comments.author": "alice"}, "d1")
+        engine.refresh()
+        # No flat field collides with the nested path name.
+        assert "comments.author" not in engine.mappings.fields
+        (handle,) = engine.segments
+        block = handle.segment.nested["comments"]
+        assert block.seg.num_docs == 1
+        fld = block.seg.fields["comments.author"]
+        assert "alice" in fld.terms
+        # And the nested query finds it like a properly-shaped doc.
+        from elasticsearch_tpu.search.service import (
+            SearchRequest,
+            SearchService,
+        )
+
+        resp = SearchService(engine).search(
+            SearchRequest.from_json(
+                {
+                    "query": {
+                        "nested": {
+                            "path": "comments",
+                            "query": {
+                                "term": {"comments.author": "alice"}
+                            },
+                        }
+                    }
+                }
+            )
+        )
+        assert [h.doc_id for h in resp.hits] == ["d1"]
+
+    def test_deep_dotted_key_expands_through_nested_parent(self):
+        engine = Engine(Mappings.from_json(self.MAPPINGS))
+        engine.index({"comments.author": ["a", "b"]}, "d1")
+        engine.refresh()
+        (handle,) = engine.segments
+        block = handle.segment.nested["comments"]
+        # One nested sub-doc with a multi-valued author, not two.
+        assert block.seg.num_docs == 1
+
+    def test_dynamic_flat_mapping_refused_under_nested_prefix(self):
+        m = Mappings.from_json(self.MAPPINGS)
+        assert m.resolve_dynamic("comments.newfield", "x") is None
+        # Ordinary dynamic mapping still works.
+        assert m.resolve_dynamic("brand.new", "x") is not None

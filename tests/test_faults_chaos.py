@@ -1,0 +1,377 @@
+"""Chaos suite: seeded randomized fault schedules over a replicated
+multi-shard corpus, asserting the degraded-mode invariants.
+
+Invariants (the ISSUE's acceptance contract):
+
+- returned hits are always a CORRECT SUBSET of the fault-free result —
+  identical per-doc scores, non-increasing order, never wrong docs; a
+  response with zero failed shards is bit-identical to the baseline;
+- `successful + failed + skipped == total` on every `_shards` object;
+- `allow_partial_search_results=false` never yields a silently-partial
+  200: every response is either a complete 200 or a 503;
+- a batcher-site fault on one sub-request never fails a coalesced
+  batchmate;
+- with faults disabled the identical workload returns bit-identical
+  top-10 hits.
+
+Everything runs on the CPU backend with deterministic seeds; the same
+schedule replays identically (FaultRegistry is seeded per spec).
+"""
+
+import json
+import threading
+
+import pytest
+
+from elasticsearch_tpu.faults import REGISTRY
+from elasticsearch_tpu.rest.server import RestServer
+
+QUERIES = [
+    {"query": {"match": {"body": "findme"}}, "size": 20},
+    {"query": {"match": {"body": "alpha beta"}}, "size": 10},
+    {"query": {"term": {"tag": "red"}}, "size": 20},
+    {
+        "query": {
+            "bool": {
+                "must": [{"match": {"body": "findme"}}],
+                "should": [{"match": {"body": "gamma"}}],
+            }
+        },
+        "size": 15,
+    },
+]
+
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon"]
+TAGS = ["red", "blue"]
+
+
+def _seed_corpus(rest, index, n=48):
+    lines = []
+    for i in range(n):
+        lines.append(json.dumps({"index": {"_index": index, "_id": f"d{i}"}}))
+        lines.append(
+            json.dumps(
+                {
+                    "body": f"findme {WORDS[i % 5]} {WORDS[(i * 3) % 5]} "
+                    f"filler{i}",
+                    "tag": TAGS[i % 2],
+                }
+            )
+        )
+    status, resp = rest.dispatch("POST", "/_bulk", {}, "\n".join(lines))
+    assert status == 200 and not resp["errors"], resp
+    status, _ = rest.dispatch("POST", f"/{index}/_refresh", {}, "")
+    assert status == 200
+
+
+def _search(rest, index, body, query=None):
+    return rest.dispatch(
+        "POST", f"/{index}/_search", query or {}, json.dumps(body)
+    )
+
+
+def _assert_shard_math(resp):
+    sh = resp["_shards"]
+    assert (
+        sh["successful"] + sh["failed"] + sh["skipped"] == sh["total"]
+    ), sh
+    return sh
+
+
+def _assert_correct_subset(resp, full_baseline):
+    """Hits carry fault-free scores, in non-increasing score order.
+    `full_baseline` must page over the ENTIRE match set: a partial
+    merge over fewer shards can legitimately surface equal-scored docs
+    the full top-k page truncated away."""
+    scores = {h["_id"]: h["_score"] for h in full_baseline["hits"]["hits"]}
+    prev = None
+    for hit in resp["hits"]["hits"]:
+        assert hit["_id"] in scores, f"unknown hit {hit['_id']}"
+        assert scores[hit["_id"]] == hit["_score"], hit["_id"]
+        if prev is not None:
+            assert hit["_score"] <= prev
+        prev = hit["_score"]
+
+
+def _assert_bit_identical(resp, baseline):
+    got = [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
+    want = [(h["_id"], h["_score"]) for h in baseline["hits"]["hits"]]
+    assert got == want
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    REGISTRY.clear()
+    yield
+    REGISTRY.clear()
+
+
+@pytest.fixture
+def replicated(monkeypatch):
+    monkeypatch.setenv("ESTPU_MESH_SERVING", "0")
+    server = RestServer(replication_nodes=3)
+    status, _ = server.dispatch(
+        "PUT",
+        "/chaos",
+        {},
+        json.dumps(
+            {
+                "settings": {
+                    "index": {
+                        "number_of_shards": 2,
+                        "number_of_replicas": 2,
+                    }
+                },
+                "mappings": {
+                    "properties": {
+                        "body": {"type": "text"},
+                        "tag": {"type": "keyword"},
+                    }
+                },
+            }
+        ),
+    )
+    assert status == 200
+    _seed_corpus(server, "chaos")
+    yield server
+    server.close()
+
+
+class TestReplicatedChaos:
+    def _baselines(self, rest):
+        """(page baseline, full-match-set baseline) per query."""
+        out = []
+        for body in QUERIES:
+            status, page = _search(rest, "chaos", body)
+            assert status == 200
+            assert _assert_shard_math(page)["failed"] == 0
+            status, full = _search(rest, "chaos", dict(body, size=60))
+            assert status == 200
+            out.append((page, full))
+        return out
+
+    def test_seeded_schedule_partial_results_are_correct_subsets(
+        self, replicated
+    ):
+        """30% per-send transport failure on the query phase: every
+        response is a 200 whose hits are a correct subset; the shard
+        accounting always adds up; partials report honest failures[]."""
+        rest = replicated
+        baselines = self._baselines(rest)
+        status, _ = rest.dispatch(
+            "POST",
+            "/_fault",
+            {},
+            json.dumps(
+                {
+                    "site": "transport.send.shard_search",
+                    "error_rate": 0.9,
+                    "error": "transport",
+                    "seed": 1234,
+                }
+            ),
+        )
+        assert status == 200
+        partials = 0
+        for round_i in range(10):
+            for body, (page, full) in zip(QUERIES, baselines):
+                status, resp = _search(rest, "chaos", body)
+                # Copy retry (2 rounds x 3 copies) absorbs most injected
+                # failures; an all-copies-dead shard degrades to partial,
+                # an all-shards-dead search is an honest 503.
+                if status == 503:
+                    continue
+                assert status == 200, resp
+                sh = _assert_shard_math(resp)
+                if sh["failed"]:
+                    partials += 1
+                    assert sh["failures"], sh
+                    for entry in sh["failures"]:
+                        assert entry["index"] == "chaos"
+                        assert entry["reason"]["reason"]
+                _assert_correct_subset(resp, full)
+                if sh["failed"] == 0:
+                    _assert_bit_identical(resp, page)
+        assert partials > 0, "chaos schedule never produced a partial"
+        # Degradation is visible in the stats surface.
+        status, stats = rest.dispatch("GET", "/_nodes/stats", {}, "")
+        assert status == 200
+        node = next(iter(stats["nodes"].values()))
+        resilience = node["replication"]["search_resilience"]
+        assert resilience["shard_failures"] > 0
+        assert resilience["partial_results"] > 0
+        assert resilience["copy_retries"] > 0
+        assert node["replication"]["adaptive_replica_selection"]
+
+    def test_partial_disallowed_never_silently_partial(self, replicated):
+        """allow_partial_search_results=false under the same schedule:
+        every response is a complete 200 or a 503 — never a 200 with
+        failed shards."""
+        rest = replicated
+        status, _ = rest.dispatch(
+            "POST",
+            "/_fault",
+            {},
+            json.dumps(
+                {
+                    "site": "transport.send.shard_search",
+                    "error_rate": 0.9,
+                    "error": "transport",
+                    "seed": 1234,
+                }
+            ),
+        )
+        assert status == 200
+        saw_503 = False
+        for round_i in range(10):
+            for body in QUERIES:
+                status, resp = _search(
+                    rest,
+                    "chaos",
+                    body,
+                    query={"allow_partial_search_results": "false"},
+                )
+                if status == 503:
+                    saw_503 = True
+                    assert (
+                        resp["error"]["type"]
+                        == "search_phase_execution_exception"
+                    )
+                    continue
+                assert status == 200, resp
+                assert _assert_shard_math(resp)["failed"] == 0
+        assert saw_503, "schedule never exhausted a shard's copies"
+
+    def test_faults_disabled_restores_bit_identical_top10(self, replicated):
+        rest = replicated
+        baselines = self._baselines(rest)
+        status, _ = rest.dispatch(
+            "POST",
+            "/_fault",
+            {},
+            json.dumps(
+                {
+                    "site": "transport.send.shard_search",
+                    "error_rate": 0.9,
+                    "error": "transport",
+                    "seed": 77,
+                }
+            ),
+        )
+        assert status == 200
+        for body in QUERIES:
+            _search(rest, "chaos", body)  # chaos traffic
+        status, resp = rest.dispatch("DELETE", "/_fault", {}, "")
+        assert status == 200 and resp["cleared"] == 1
+        for body, (page, _full) in zip(QUERIES, baselines):
+            status, resp = _search(rest, "chaos", dict(body, size=10))
+            assert status == 200
+            assert _assert_shard_math(resp)["failed"] == 0
+            want = [
+                (h["_id"], h["_score"])
+                for h in page["hits"]["hits"][:10]
+            ]
+            got = [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
+            assert got == want
+
+
+@pytest.fixture
+def local(monkeypatch):
+    monkeypatch.setenv("ESTPU_MESH_SERVING", "0")
+    server = RestServer()
+    status, _ = server.dispatch(
+        "PUT",
+        "/chaos",
+        {},
+        json.dumps(
+            {
+                "settings": {"index": {"number_of_shards": 3}},
+                "mappings": {
+                    "properties": {
+                        "body": {"type": "text"},
+                        "tag": {"type": "keyword"},
+                    }
+                },
+            }
+        ),
+    )
+    assert status == 200
+    _seed_corpus(server, "chaos")
+    yield server
+    server.close()
+
+
+class TestLocalCoordinatorChaos:
+    def test_concurrent_chaos_with_batcher_isolation(self, local):
+        """Randomized faults at every local site under concurrent batched
+        traffic: every request ends in a correct-subset 200 or an honest
+        503; no injected batcher fault ever fails a batchmate with a
+        non-search error."""
+        rest = local
+        baselines = {}
+        for i, body in enumerate(QUERIES):
+            status, resp = _search(rest, "chaos", dict(body, size=60))
+            assert status == 200
+            baselines[i] = resp
+        status, _ = rest.dispatch(
+            "POST",
+            "/_fault",
+            {},
+            json.dumps(
+                {
+                    "faults": [
+                        {
+                            "site": "coordinator.shard",
+                            "error_rate": 0.15,
+                            "seed": 42,
+                        },
+                        {
+                            "site": "batcher.launch",
+                            "error_rate": 0.2,
+                            "seed": 43,
+                        },
+                        {
+                            "site": "search.kernel",
+                            "error_rate": 0.05,
+                            "seed": 44,
+                            "delay_ms": 1,
+                        },
+                    ]
+                }
+            ),
+        )
+        assert status == 200
+        outcomes = []
+        lock = threading.Lock()
+
+        def worker(worker_id):
+            for round_i in range(6):
+                qi = (worker_id + round_i) % len(QUERIES)
+                status, resp = _search(rest, "chaos", QUERIES[qi])
+                with lock:
+                    outcomes.append((qi, status, resp))
+
+        threads = [
+            threading.Thread(target=worker, args=(w,)) for w in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(outcomes) == 24
+        ok = 0
+        for qi, status, resp in outcomes:
+            if status == 503:
+                assert (
+                    resp["error"]["type"]
+                    == "search_phase_execution_exception"
+                )
+                continue
+            assert status == 200, resp
+            ok += 1
+            _assert_shard_math(resp)
+            _assert_correct_subset(resp, baselines[qi])
+        assert ok > 0
+        stats = rest.node.exec_batcher.stats()
+        # Injected batcher faults were isolated and retried individually.
+        assert stats["retried_individually"] > 0
