@@ -609,6 +609,50 @@ def main():
     for pos, c in enumerate(compiled):
         groups[c.spec].append(pos)
 
+    # ---- Device-metrics instrumentation (obs/metrics.py registry) --------
+    # One timed first-launch per batch shape group BEFORE any other use:
+    # first launch of a new (spec, k) static shape IS the XLA compile, so
+    # the registry's compile_count/compile_ms_total are the real JIT cost
+    # this run paid. Padding waste mirrors what the serving path's
+    # coalescer (SearchService._merge_term_groups) would pad re-bucketing
+    # same-family groups to a uniform nt.
+    from elasticsearch_tpu.obs.metrics import (
+        DeviceInstruments,
+        MetricsRegistry,
+    )
+
+    obs_registry = MetricsRegistry()
+    device_instr = DeviceInstruments(obs_registry)
+    for spec_g, positions in groups.items():
+        arrays_b = jax.tree.map(
+            lambda *xs: np.stack(xs),
+            *[compiled[p].arrays for p in positions],
+        )
+        device_instr.h2d(arrays_b)
+        t0 = time.monotonic()
+        jax.block_until_ready(
+            bm25_device.execute_batch_sparse(seg_tree, spec_g, arrays_b, K)
+        )
+        device_instr.launch(
+            f"{spec_g[0]}_batched", (spec_g, K), time.monotonic() - t0
+        )
+    from elasticsearch_tpu.search.service import (
+        family_padding_tiles,
+        sparse_family_key,
+    )
+
+    fam_groups = defaultdict(list)
+    for spec_g in groups:
+        fam = sparse_family_key(spec_g)
+        if fam is not None:
+            fam_groups[fam].append(spec_g)
+    for specs in fam_groups.values():
+        if len(specs) < 2:
+            continue
+        device_instr.padding(
+            *family_padding_tiles([(s, len(groups[s])) for s in specs])
+        )
+
     # ---- Warmup (compiles every group's shape) + parity results ----------
     results = bm25_device.execute_many(seg_tree, compiled, K)
     d_scores = [r[0] for r in results]
@@ -878,6 +922,14 @@ def main():
                 "single_query_roundtrip_ms": round(single_query_ms, 2),
                 "top10_mismatches": mismatches,
                 "blockmax_mismatches": bm_mismatches,
+                # Device-level instruments pulled from the obs metrics
+                # registry (first-launch JIT cost + coalescing pad waste).
+                "compile_count": device_instr.compile_count(),
+                "compile_ms_total": device_instr.compile_ms_total(),
+                "padding_waste_pct": device_instr.padding_waste_pct(),
+                "h2d_bytes_total": int(
+                    obs_registry.value("estpu_device_h2d_bytes_total")
+                ),
                 "configs": configs,
                 "configs_parity_ok": configs_parity_ok,
                 "parity": "ids+order+fp32_scores+totals",

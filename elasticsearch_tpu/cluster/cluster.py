@@ -126,12 +126,26 @@ class ClusterNode:
         # by THIS coordinating node (node/ResponseCollectorService.java:33)
         # + degraded-search counters for `GET /_nodes/stats`.
         self.response_collector = ResponseCollectorService()
-        self._search_stats = {
-            "searches": 0,
-            "partial_results": 0,
-            "shard_failures": 0,
-            "copy_retries": 0,
-            "rerouted": 0,
+        # Degraded-search counters write through a per-node metrics
+        # registry (obs/metrics.py) — search_resilience_stats() and the
+        # gateway's cluster-wide rollup are views over it.
+        from ..obs.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
+        self._search_counters = {
+            key: self.metrics.counter(
+                "estpu_cluster_search_resilience_total",
+                "Coordinator degraded-search events",
+                kind=key,
+                node=node_id,
+            )
+            for key in (
+                "searches",
+                "partial_results",
+                "shard_failures",
+                "copy_retries",
+                "rerouted",
+            )
         }
         self._inflight_searches = 0
         self._recover_persisted_state()
@@ -212,7 +226,22 @@ class ClusterNode:
         fn = getattr(self, f"_on_{action}", None)
         if fn is None:
             raise ValueError(f"unknown transport action [{action}]")
-        return fn(from_id, payload)
+        wire_trace = payload.pop("_trace", None)
+        if wire_trace is None:
+            return fn(from_id, payload)
+        # Re-activate the sender's wire context EXPLICITLY (never via
+        # thread locals — this is what a cross-host receive would do), so
+        # the remote execution's spans (per-segment launches inside
+        # _on_shard_search) parent into the caller's trace tree.
+        from ..obs.tracing import TRACER
+
+        with TRACER.span_from(
+            (wire_trace["trace_id"], wire_trace["parent"]),
+            f"cluster.{action}",
+            node=self.node_id,
+            from_node=from_id,
+        ):
+            return fn(from_id, payload)
 
     def _on_ping(self, from_id: str, payload: dict):
         return {
@@ -770,14 +799,23 @@ class ClusterNode:
     COPY_RETRY_BACKOFF_S = 0.01
 
     def _count_search(self, key: str, n: int = 1) -> None:
-        with self.lock:
-            self._search_stats[key] = self._search_stats.get(key, 0) + n
+        counter = self._search_counters.get(key)
+        if counter is None:
+            # Cache novel keys so search_resilience_stats reports them.
+            counter = self._search_counters[key] = self.metrics.counter(
+                "estpu_cluster_search_resilience_total",
+                "Coordinator degraded-search events",
+                kind=key,
+                node=self.node_id,
+            )
+        counter.inc(n)
 
     def search_resilience_stats(self) -> dict:
-        with self.lock:
-            counters = dict(self._search_stats)
         return {
-            **counters,
+            **{
+                key: int(c.value)
+                for key, c in list(self._search_counters.items())
+            },
             "response_collector": self.response_collector.snapshot(),
         }
 
@@ -811,6 +849,8 @@ class ClusterNode:
         max_score = None
         successful = 0
         failures: list[dict] = []
+        from ..obs.tracing import TRACER
+
         for shard_id, routing in sorted(meta.shards.items()):
             copies = [
                 n
@@ -818,9 +858,18 @@ class ClusterNode:
                 + routing.replicas
                 if n is not None
             ]
-            resp, failure = self._search_one_shard(
-                index, shard_id, copies, shard_body
-            )
+            with TRACER.span(
+                "cluster.shard", shard=shard_id, index=index
+            ) as shard_span:
+                resp, failure = self._search_one_shard(
+                    index, shard_id, copies, shard_body
+                )
+                if shard_span is not None and failure is not None:
+                    shard_span.status = "error"
+                    shard_span.tags["failed"] = True
+                    shard_span.tags["error_reason"] = failure["reason"][
+                        "reason"
+                    ][:200]
             if resp is None:
                 failures.append(failure)
                 continue
@@ -878,11 +927,20 @@ class ClusterNode:
         """Query one shard across its copies: EWMA-ranked order, bounded
         backoff between rounds. Returns (response, None) on success or
         (None, failure entry) once every copy of every round failed."""
+        from ..obs.tracing import TRACER
+
         ordered = self.response_collector.ordered(copies)
         if ordered and copies and ordered[0] != copies[0]:
             # Adaptive selection steered away from the default
             # primary-first order.
             self._count_search("rerouted")
+            TRACER.event(
+                "search.rerouted",
+                shard=shard_id,
+                index=index,
+                chosen=ordered[0],
+                default=copies[0],
+            )
         last_err: Exception | None = None
         last_node: str | None = None
         attempts = 0
@@ -893,6 +951,13 @@ class ClusterNode:
                 attempts += 1
                 if attempts > 1:
                     self._count_search("copy_retries")
+                    TRACER.event(
+                        "search.copy_retry",
+                        shard=shard_id,
+                        index=index,
+                        copy=node,
+                        attempt=attempts,
+                    )
                 t0 = time.monotonic()
                 try:
                     resp = self.hub.send(

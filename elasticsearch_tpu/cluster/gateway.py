@@ -24,7 +24,6 @@ Retry policy:
 
 from __future__ import annotations
 
-import threading
 import time
 
 from .cluster import (
@@ -82,21 +81,52 @@ class ReplicationGateway:
         self.max_retries = max_retries
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
-        self._stats_lock = threading.Lock()
-        self._counters = {
-            "writes": 0,
-            "reads": 0,
-            "searches": 0,
-            "retries": 0,
-            "coordinator_failovers": 0,
-            "unavailable": 0,
-        }
+        # Gateway counters write through a metrics registry (obs/
+        # metrics.py); stats() and the node's `GET /_metrics` exposition
+        # are views over it. The owning Node swaps in its registry via
+        # bind_metrics() at construction time (before any traffic).
+        from ..obs.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
+        self._counters: dict = {}
+        self._make_counters()
 
     # ------------------------------------------------------------ plumbing
 
+    def _make_counters(self) -> None:
+        self._counters = {
+            key: self.metrics.counter(
+                "estpu_replication_gateway_total",
+                "Replication gateway operations and retry outcomes",
+                op=key,
+            )
+            for key in (
+                "writes",
+                "reads",
+                "searches",
+                "retries",
+                "coordinator_failovers",
+                "unavailable",
+            )
+        }
+
+    def bind_metrics(self, metrics) -> None:
+        """Re-home the gateway's instruments onto the node's registry so
+        `GET /_metrics` exposes them. Called by Node.__init__ before any
+        request flows (counter values are still zero)."""
+        self.metrics = metrics
+        self._make_counters()
+
     def _count(self, key: str, n: int = 1) -> None:
-        with self._stats_lock:
-            self._counters[key] = self._counters.get(key, 0) + n
+        counter = self._counters.get(key)
+        if counter is None:
+            # Cache novel keys so stats() reports them.
+            counter = self._counters[key] = self.metrics.counter(
+                "estpu_replication_gateway_total",
+                "Replication gateway operations and retry outcomes",
+                op=key,
+            )
+        counter.inc(n)
 
     def coordinator(self) -> ClusterNode:
         """The preferred coordinating node when alive, else ANY live node
@@ -118,42 +148,58 @@ class ReplicationGateway:
 
     def _run(self, op_name: str, fn, timeout_s: float | None = None):
         """Run fn(coordinator) with bounded retry-with-backoff, driving a
-        control-plane round between attempts so promotion can happen."""
+        control-plane round between attempts so promotion can happen.
+
+        The whole retry loop is ONE gateway span in the request's trace
+        (attempt count tagged on exit); each attempt's transport sends
+        nest under it, so a failover reads as one gateway hop with N
+        transport children."""
+        from ..obs.tracing import TRACER
+
         timeout_s = self.timeout_s if timeout_s is None else timeout_s
         deadline = time.monotonic() + timeout_s
         attempt = 0
-        while True:
-            try:
+        with TRACER.span(
+            f"gateway.{op_name.split(':', 1)[0]}", op=op_name
+        ) as span:
+            while True:
                 try:
-                    node = self.coordinator()
-                except RuntimeError as e:  # every node dead: nothing to retry
-                    self._count("unavailable")
-                    raise ReplicationUnavailableError(str(e)) from e
-                return fn(node)
-            except Exception as e:
-                if not self._retryable(e):
-                    raise
-                attempt += 1
-                self._count("retries")
-                if attempt > self.max_retries or time.monotonic() >= deadline:
-                    self._count("unavailable")
-                    raise ReplicationUnavailableError(
-                        f"[{op_name}] failed after {attempt} attempts "
-                        f"within {timeout_s}s: {e}"
-                    ) from e
-                try:
-                    # Failure detection + election + promotion + healing:
-                    # the reason the NEXT attempt can succeed.
-                    self.cluster.step()
-                except Exception:
-                    pass
-                delay = min(
-                    self.backoff_base_s * (2 ** (attempt - 1)),
-                    self.backoff_max_s,
-                    max(0.0, deadline - time.monotonic()),
-                )
-                if delay > 0:
-                    time.sleep(delay)
+                    try:
+                        node = self.coordinator()
+                    except RuntimeError as e:  # every node dead: no retry
+                        self._count("unavailable")
+                        raise ReplicationUnavailableError(str(e)) from e
+                    result = fn(node)
+                    if span is not None and attempt:
+                        span.tags["retries"] = attempt
+                    return result
+                except Exception as e:
+                    if not self._retryable(e):
+                        raise
+                    attempt += 1
+                    self._count("retries")
+                    if (
+                        attempt > self.max_retries
+                        or time.monotonic() >= deadline
+                    ):
+                        self._count("unavailable")
+                        raise ReplicationUnavailableError(
+                            f"[{op_name}] failed after {attempt} attempts "
+                            f"within {timeout_s}s: {e}"
+                        ) from e
+                    try:
+                        # Failure detection + election + promotion +
+                        # healing: why the NEXT attempt can succeed.
+                        self.cluster.step()
+                    except Exception:
+                        pass
+                    delay = min(
+                        self.backoff_base_s * (2 ** (attempt - 1)),
+                        self.backoff_max_s,
+                        max(0.0, deadline - time.monotonic()),
+                    )
+                    if delay > 0:
+                        time.sleep(delay)
 
     # ------------------------------------------------------------- client
 
@@ -309,8 +355,9 @@ class ReplicationGateway:
         return total
 
     def stats(self) -> dict:
-        with self._stats_lock:
-            counters = dict(self._counters)
+        counters = {
+            key: int(c.value) for key, c in list(self._counters.items())
+        }
         alive = [
             n.node_id for n in self.cluster.nodes.values() if not n.closed
         ]

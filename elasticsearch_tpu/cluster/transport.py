@@ -19,6 +19,7 @@ import time
 from typing import Any, Callable
 
 from ..faults import fault_point
+from ..obs.tracing import TRACER
 
 
 class ConnectTransportError(Exception):
@@ -102,38 +103,55 @@ class TransportHub:
 
     def send(self, from_id: str, to_id: str, action: str, payload: dict):
         """Synchronous request/response; raises ConnectTransportError on
-        unreachable peers and RemoteActionError for remote failures."""
+        unreachable peers and RemoteActionError for remote failures.
+
+        Trace context rides the wire: when the sender has an active span,
+        the payload carries `_trace` (trace_id + parent span id) so the
+        receiving node's execution parents into the caller's tree exactly
+        as it would across real sockets — the receive side re-activates
+        the explicit context rather than trusting thread locals."""
         with self._lock:
             handler = self._handlers.get(to_id)
             reachable = self._reachable(from_id, to_id)
             drops = list(self._dropped_actions)
-        if handler is None or not reachable:
-            raise ConnectTransportError(f"[{to_id}] unreachable from [{from_id}]")
-        for f, t, pat in drops:
-            if (
-                fnmatch.fnmatch(from_id, f)
-                and fnmatch.fnmatch(to_id, t)
-                and fnmatch.fnmatch(action, pat)
-            ):
+        with TRACER.span(
+            f"transport.{action}", from_node=from_id, to_node=to_id
+        ):
+            if handler is None or not reachable:
                 raise ConnectTransportError(
-                    f"[{action}] {from_id}->{to_id} dropped by interceptor"
+                    f"[{to_id}] unreachable from [{from_id}]"
                 )
-        if self._delay_s:
-            time.sleep(self._delay_s)
-        # Named fault site (faults/registry.py): injectable per-action
-        # drops/delays without pre-wiring hub interceptors, e.g.
-        # `transport.send.shard_search`.
-        fault_point(
-            f"transport.send.{action}", from_node=from_id, to_node=to_id
-        )
-        try:
-            return handler(from_id, action, payload)
-        except (ConnectTransportError, RemoteActionError):
-            raise
-        except Exception as e:  # remote handler failure crosses the wire
-            raise RemoteActionError(
-                f"[{action}] on [{to_id}]: {e}", remote_type=type(e).__name__
-            ) from e
+            for f, t, pat in drops:
+                if (
+                    fnmatch.fnmatch(from_id, f)
+                    and fnmatch.fnmatch(to_id, t)
+                    and fnmatch.fnmatch(action, pat)
+                ):
+                    raise ConnectTransportError(
+                        f"[{action}] {from_id}->{to_id} dropped by interceptor"
+                    )
+            if self._delay_s:
+                time.sleep(self._delay_s)
+            # Named fault site (faults/registry.py): injectable per-action
+            # drops/delays without pre-wiring hub interceptors, e.g.
+            # `transport.send.shard_search`.
+            fault_point(
+                f"transport.send.{action}", from_node=from_id, to_node=to_id
+            )
+            ctx = TRACER.context()
+            if ctx is not None:
+                payload = dict(
+                    payload, _trace={"trace_id": ctx[0], "parent": ctx[1]}
+                )
+            try:
+                return handler(from_id, action, payload)
+            except (ConnectTransportError, RemoteActionError):
+                raise
+            except Exception as e:  # remote handler failure crosses the wire
+                raise RemoteActionError(
+                    f"[{action}] on [{to_id}]: {e}",
+                    remote_type=type(e).__name__,
+                ) from e
 
     def alive(self, node_id: str) -> bool:
         with self._lock:

@@ -8,6 +8,10 @@ invalidates without any explicit eviction hook, exactly like the
 reference keying on the reader's cache helper. Entries store the
 serialized JSON string; a hit deserializes a fresh object so callers
 can't mutate the cached copy.
+
+Hit/miss/eviction accounting writes through the node's metrics registry
+(obs/metrics.py) — `_nodes/stats` and `GET /_metrics` render the same
+counters.
 """
 
 from __future__ import annotations
@@ -19,13 +23,29 @@ from typing import Any
 
 
 class RequestCache:
-    def __init__(self, max_entries: int = 256):
+    def __init__(self, max_entries: int = 256, metrics=None):
         self.max_entries = max_entries
         self._entries: OrderedDict[tuple, str] = OrderedDict()
         self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
+        if metrics is None:
+            from ..obs.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self._hits = metrics.counter(
+            "estpu_request_cache_hits_total", "Shard request cache hits"
+        )
+        self._misses = metrics.counter(
+            "estpu_request_cache_misses_total", "Shard request cache misses"
+        )
+        self._evictions = metrics.counter(
+            "estpu_request_cache_evictions_total",
+            "Shard request cache LRU evictions",
+        )
+        metrics.gauge(
+            "estpu_request_cache_entries",
+            "Shard request cache live entries",
+            fn=lambda: len(self._entries),
+        )
 
     @staticmethod
     def key(index: str, body: dict | None, generations: tuple) -> tuple:
@@ -39,10 +59,10 @@ class RequestCache:
         with self._lock:
             raw = self._entries.get(key)
             if raw is None:
-                self.misses += 1
+                self._misses.inc()
                 return None
             self._entries.move_to_end(key)
-            self.hits += 1
+            self._hits.inc()
         return json.loads(raw)
 
     def put(self, key: tuple, response: dict) -> None:
@@ -52,7 +72,21 @@ class RequestCache:
             self._entries.move_to_end(key)
             while len(self._entries) > self.max_entries:
                 self._entries.popitem(last=False)
-                self.evictions += 1
+                self._evictions.inc()
+
+    # Back-compat accessors (pre-migration attribute names): the values
+    # now live on the registry counters.
+    @property
+    def hits(self) -> int:
+        return int(self._hits.value)
+
+    @property
+    def misses(self) -> int:
+        return int(self._misses.value)
+
+    @property
+    def evictions(self) -> int:
+        return int(self._evictions.value)
 
     def clear(self) -> None:
         """Drop every cached entry (the `_cache/clear` API analog)."""
@@ -63,7 +97,7 @@ class RequestCache:
         with self._lock:
             return {
                 "entries": len(self._entries),
-                "hit_count": self.hits,
-                "miss_count": self.misses,
-                "evictions": self.evictions,
+                "hit_count": int(self._hits.value),
+                "miss_count": int(self._misses.value),
+                "evictions": int(self._evictions.value),
             }

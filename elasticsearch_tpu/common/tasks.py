@@ -28,6 +28,13 @@ class Task:
     description: str
     cancellable: bool = True
     start_ms: float = field(default_factory=lambda: time.time() * 1000)
+    # Monotonic start: running_time_in_nanos must survive wall-clock
+    # steps (NTP slew during a long search would otherwise report a
+    # negative or wildly wrong runtime).
+    start_mono: float = field(default_factory=time.monotonic)
+    # The task's current span name (obs/tracing.py mirrors the active
+    # span here), surfaced by `GET /_tasks` / `GET /_cat/tasks`.
+    span_name: str | None = None
     deadline: float | None = None  # monotonic seconds; None = no timeout
     _cancelled: bool = False
     _timed_out: bool = False
@@ -80,20 +87,27 @@ class Task:
     def timed_out(self) -> bool:
         return self._timed_out
 
-    def to_json(self) -> dict[str, Any]:
-        return {
+    def to_json(self, detailed: bool = True) -> dict[str, Any]:
+        out = {
             "node": self.id.split(":")[0],
             "id": int(self.id.split(":")[1]),
             "type": "transport",
             "action": self.action,
-            "description": self.description,
             "start_time_in_millis": int(self.start_ms),
             "running_time_in_nanos": int(
-                (time.time() * 1000 - self.start_ms) * 1e6
+                (time.monotonic() - self.start_mono) * 1e9
             ),
             "cancellable": self.cancellable,
             "cancelled": self._cancelled,
         }
+        if self.span_name is not None:
+            # Where the task is RIGHT NOW (obs/tracing.py mirrors the
+            # active span here): which segment/queue/phase a long search
+            # is currently in.
+            out["span"] = self.span_name
+        if detailed:
+            out["description"] = self.description
+        return out
 
 
 class TaskManager:

@@ -48,6 +48,8 @@ import numpy as np
 from ..common.indexing_pressure import IndexingPressureRejected
 from ..common.tasks import TaskCancelledError
 from ..faults import fault_point
+from ..obs.metrics import OCCUPANCY_BUCKETS, QUEUE_WAIT_MS_BUCKETS
+from ..obs.tracing import TRACER
 
 # Errors that must surface verbatim, never trigger an individual retry:
 # cancellations honor the cancel contract; ValueError/TypeError are
@@ -72,6 +74,10 @@ class _Pending:
     # individual retry on the per-request path (keeping the scheduler
     # thread free for other groups).
     retry_solo: bool = False
+    # Caller's (trace_id, span_id) captured at enqueue: the scheduler
+    # thread has no contextvar continuity, so queue-wait and coalesced-
+    # launch spans are recorded retrospectively under this context.
+    trace_ctx: tuple | None = None
 
 
 class MicroBatcher:
@@ -88,6 +94,7 @@ class MicroBatcher:
         max_wait_s: float | None = None,
         max_batch: int = 64,
         queue_limit: int = 256,
+        metrics=None,
     ):
         if max_wait_s is None:
             max_wait_s = (
@@ -101,18 +108,63 @@ class MicroBatcher:
         self._in_flight: set[tuple] = set()
         self._thread: threading.Thread | None = None
         self._closed = False
-        # Telemetry (read under _cv).
-        self.batches = 0
-        self.requests = 0
-        self.coalesced_requests = 0  # requests served in a batch of >= 2
-        self.occupancy_histogram: dict[int, int] = {}
-        self.queue_cancellations = 0
-        self.shed = 0
+        # Telemetry: one write path, the node's metrics registry
+        # (obs/metrics.py) — `_nodes/stats` and `GET /_metrics` are both
+        # views over these instruments. A standalone batcher gets a
+        # private registry.
+        if metrics is None:
+            from ..obs.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
+
+        def _c(name: str, help_text: str):
+            return metrics.counter(f"estpu_exec_batcher_{name}", help_text)
+
+        self._batches = _c("batches_total", "Coalesced launches executed")
+        self._requests = _c("requests_total", "Requests through the queue")
+        self._coalesced = _c(
+            "coalesced_requests_total", "Requests served in a batch of >= 2"
+        )
+        self._cancelled = _c(
+            "queue_cancellations_total", "Searches cancelled while queued"
+        )
+        self._shed = _c("shed_total", "Requests shed with 429 (queue full)")
+        self._retried = _c(
+            "retried_individually_total",
+            "Riders retried solo after a coalesced-launch failure",
+        )
+        self._quarantined_total = _c(
+            "groups_quarantined_total",
+            "Group keys quarantined to the per-request path",
+        )
+        self._quarantine_hits_c = _c(
+            "quarantine_hits_total", "Requests served while group quarantined"
+        )
+        self._occupancy = metrics.histogram(
+            "estpu_exec_batcher_occupancy",
+            (0.0,) + OCCUPANCY_BUCKETS,
+            "Batch occupancy (pow-2 bucketed riders per launch)",
+        )
+        self._queue_wait_hist = metrics.histogram(
+            "estpu_exec_batcher_queue_wait_ms",
+            QUEUE_WAIT_MS_BUCKETS,
+            "Queue wait before launch, milliseconds",
+        )
+        def _queued_depth() -> int:
+            # Scrapes race queue mutation: snapshot under the condition
+            # lock (a lock-free sum can die mid-iteration and silently
+            # report 0 exactly when depth is the signal that matters).
+            with self._cv:
+                return sum(len(q) for q in self._queues.values())
+
+        metrics.gauge(
+            "estpu_exec_batcher_queued",
+            "Searches currently waiting in the batch queue",
+            fn=_queued_depth,
+        )
         self._wait_samples: deque[float] = deque(maxlen=512)
         # Failure isolation / quarantine state (under _cv).
-        self.retried_individually = 0
-        self.quarantine_hits = 0
-        self.groups_quarantined = 0
         self._group_failures: dict[tuple, int] = {}
         # group -> (parole time, weakref to the offending searcher). The
         # weakref pins identity: id() reuse by a NEW searcher at the same
@@ -144,13 +196,13 @@ class MicroBatcher:
                 # Repeat offender: this spec keeps failing coalesced
                 # launches — serve it on the plain per-request path so
                 # it cannot take batchmates down with it.
-                self.quarantine_hits += 1
+                self._quarantine_hits_c.inc()
         if quarantined:
             return searcher.search(request, task=task)
         with self._cv:
             depth = sum(len(q) for q in self._queues.values())
             if depth >= self.queue_limit:
-                self.shed += 1
+                self._shed.inc()
                 err = IndexingPressureRejected(
                     f"rejected execution of search: exec batch queue is "
                     f"full [queued={depth}, limit={self.queue_limit}]"
@@ -175,7 +227,10 @@ class MicroBatcher:
                 group=group,
                 enqueued_at=now,
                 launch_at=launch_at,
+                trace_ctx=TRACER.context(),
             )
+            if task is not None:
+                task.span_name = "batcher.queue"
             queue.append(item)
             self._cv.notify_all()
         if task is not None:
@@ -216,23 +271,25 @@ class MicroBatcher:
     def stats(self) -> dict:
         with self._cv:
             samples = np.asarray(self._wait_samples, dtype=np.float64)
+            occupancy = self._occupancy.snapshot()
             out = {
                 "max_wait_ms": round(self.max_wait_s * 1e3, 3),
-                "batches": self.batches,
-                "requests": self.requests,
-                "coalesced_requests": self.coalesced_requests,
+                "batches": int(self._batches.value),
+                "requests": int(self._requests.value),
+                "coalesced_requests": int(self._coalesced.value),
                 "occupancy_histogram": {
-                    str(k): v
-                    for k, v in sorted(self.occupancy_histogram.items())
+                    k: int(v)
+                    for k, v in occupancy["buckets"].items()
+                    if v  # seed shape: only observed buckets appear
                 },
-                "queue_cancellations": self.queue_cancellations,
-                "rejected": self.shed,
+                "queue_cancellations": int(self._cancelled.value),
+                "rejected": int(self._shed.value),
                 "queued": sum(len(q) for q in self._queues.values()),
                 # Failure-isolation telemetry: sub-requests retried solo
                 # after failing a coalesced launch, and quarantine state.
-                "retried_individually": self.retried_individually,
-                "groups_quarantined": self.groups_quarantined,
-                "quarantine_hits": self.quarantine_hits,
+                "retried_individually": int(self._retried.value),
+                "groups_quarantined": int(self._quarantined_total.value),
+                "quarantine_hits": int(self._quarantine_hits_c.value),
                 "quarantined_now": len(self._quarantine),
             }
         if samples.size:
@@ -277,7 +334,15 @@ class MicroBatcher:
                     self._queues.pop(item.group, None)
             reason = getattr(item.task, "cancel_reason", None) or "cancelled"
             item.error = TaskCancelledError(f"task cancelled [{reason}]")
-            self.queue_cancellations += 1
+            self._cancelled.inc()
+        TRACER.record(
+            item.trace_ctx,
+            "batcher.queue",
+            item.enqueued_at,
+            time.monotonic(),
+            status="error",
+            cancelled=True,
+        )
         item.event.set()
 
     def _await(self, item: _Pending) -> None:
@@ -352,6 +417,10 @@ class MicroBatcher:
         now = time.monotonic()
         live: list[_Pending] = []
         faulted: list[tuple[_Pending, Exception]] = []
+        # Retrospective spans (queue-wait + coalesced launch) accumulate
+        # here and flush AFTER every rider's event fires: span recording
+        # must never sit between the result and the caller's wake-up.
+        deferred_spans: list[tuple] = []
         for item in batch:
             item.queue_wait_s = now - item.enqueued_at
             task = item.task
@@ -360,6 +429,18 @@ class MicroBatcher:
                 item.error = TaskCancelledError(f"task cancelled [{reason}]")
                 item.event.set()
                 continue
+            # Queue-wait span: recorded retrospectively under the caller's
+            # captured context (the scheduler thread has none of its own).
+            deferred_spans.append(
+                (
+                    item.trace_ctx,
+                    "batcher.queue",
+                    item.enqueued_at,
+                    now,
+                    "ok",
+                    {"group": repr(item.group[1])},
+                )
+            )
             try:
                 # Injectable per-sub-request launch fault
                 # (faults/registry.py `batcher.launch`): evaluated per
@@ -370,7 +451,33 @@ class MicroBatcher:
                 continue
             live.append(item)
         retry: list[tuple[_Pending, Exception]] = list(faulted)
+        launch_id = f"launch-{id(batch):x}-{int(now * 1e6) & 0xFFFFFF:x}"
+        for item, e in faulted:
+            # The injected fault kept this rider off the launch entirely:
+            # give its trace a zero-length launch span carrying the error.
+            deferred_spans.append(
+                (
+                    item.trace_ctx,
+                    "batcher.launch",
+                    now,
+                    now,
+                    "error",
+                    {
+                        "launch_id": launch_id,
+                        "error_type": type(e).__name__,
+                        **(
+                            {"injected_fault": True}
+                            if getattr(e, "injected", False)
+                            else {}
+                        ),
+                    },
+                )
+            )
         if live:
+            for it in live:
+                if it.task is not None:
+                    it.task.span_name = "batcher.launch"
+            launch_t0 = time.monotonic()
             try:
                 results = live[0].searcher.search_many(
                     [it.request for it in live],
@@ -378,8 +485,26 @@ class MicroBatcher:
                 )
             except Exception as e:  # whole-launch failure
                 results = [e] * len(live)
+            launch_t1 = time.monotonic()
             for item, result in zip(live, results):
-                if isinstance(result, Exception):
+                failed = isinstance(result, Exception)
+                # The coalesced-launch span, shared across batchmates: the
+                # same launch_id and timing land in every rider's trace.
+                deferred_spans.append(
+                    (
+                        item.trace_ctx,
+                        "batcher.launch",
+                        launch_t0,
+                        launch_t1,
+                        "error" if failed else "ok",
+                        {
+                            "launch_id": launch_id,
+                            "batch_size": len(live),
+                            "coalesced": len(live) >= 2,
+                        },
+                    )
+                )
+                if failed:
                     if isinstance(result, _NO_RETRY_ERRORS):
                         item.error = result  # would fail solo too
                         item.event.set()
@@ -396,11 +521,16 @@ class MicroBatcher:
         for item, _first_error in retry:
             item.retry_solo = True
             item.event.set()
+        # Every rider is unblocked; NOW pay for span recording (a sealed
+        # rider trace still accepts these — span_from appends to the
+        # sealed span list the ring already holds).
+        for ctx, name, t0, t1, status, tags in deferred_spans:
+            TRACER.record(ctx, name, t0, t1, status=status, **tags)
         group = batch[0].group if batch else None
+        self._batches.inc()
+        self._requests.inc(len(batch))
+        self._retried.inc(len(retry))
         with self._cv:
-            self.batches += 1
-            self.requests += len(batch)
-            self.retried_individually += len(retry)
             if group is not None:
                 if retry:
                     # Repeat-offender tracking: consecutive coalesced
@@ -422,14 +552,17 @@ class MicroBatcher:
                             time.monotonic() + self.QUARANTINE_TTL_S,
                             weakref.ref(batch[0].searcher),
                         )
-                        self.groups_quarantined += 1
+                        self._quarantined_total.inc()
                 elif live:
                     self._group_failures.pop(group, None)
             if len(live) >= 2:
-                self.coalesced_requests += len(live)
+                self._coalesced.inc(len(live))
             bucket = 1 << max(0, len(live) - 1).bit_length() if live else 0
-            self.occupancy_histogram[bucket] = (
-                self.occupancy_histogram.get(bucket, 0) + 1
-            )
+            self._occupancy.observe(float(bucket))
+            # Two renderings of the same observations: the bounded deque
+            # keeps exact recent-window p50/p99 for stats()/Retry-After;
+            # the registry histogram is the cumulative Prometheus series
+            # (scrapers compute quantiles from buckets).
             for item in batch:
                 self._wait_samples.append(item.queue_wait_s)
+                self._queue_wait_hist.observe(item.queue_wait_s * 1e3)

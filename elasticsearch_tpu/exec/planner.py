@@ -135,10 +135,25 @@ class ExecPlanner:
     MIN_OBS = 2  # explorations per (class, backend) before exploiting
     BACKENDS = ("device", "blockmax", "oracle", "device_batched", "mesh_spmd")
 
-    def __init__(self, cost_model: CostModel | None = None):
+    def __init__(self, cost_model: CostModel | None = None, metrics=None):
         self.cost = cost_model or CostModel()
         self._lock = threading.Lock()
-        self.decisions: dict[str, int] = {b: 0 for b in self.BACKENDS}
+        # Decision counters live on the node's metrics registry (the one
+        # write path behind `_nodes/stats` AND `GET /_metrics`); a
+        # standalone planner gets a private registry.
+        if metrics is None:
+            from ..obs.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
+        self._decision_counters = {
+            b: metrics.counter(
+                "estpu_exec_planner_decisions_total",
+                "Query-phase backend decisions",
+                backend=b,
+            )
+            for b in self.BACKENDS
+        }
 
     # ------------------------------------------------------------ decide
 
@@ -181,16 +196,33 @@ class ExecPlanner:
     def note(self, backend: str) -> None:
         """Count a decision with no latency sample (e.g. batched lanes
         whose per-query time is amortized)."""
-        with self._lock:
-            self.decisions[backend] = self.decisions.get(backend, 0) + 1
+        counter = self._decision_counters.get(backend)
+        if counter is None:
+            # Plugin backends outside BACKENDS: register-on-first-use
+            # (counter() is idempotent; the dict is just a fast path).
+            counter = self.metrics.counter(
+                "estpu_exec_planner_decisions_total",
+                "Query-phase backend decisions",
+                backend=backend,
+            )
+            with self._lock:
+                self._decision_counters.setdefault(backend, counter)
+        counter.inc()
 
     # ------------------------------------------------------------- stats
 
+    @property
+    def decisions(self) -> dict[str, int]:
+        """Decision counts by backend — a view over the metrics registry
+        (kept as the attribute callers always read). Snapshot under the
+        lock note() inserts plugin-backend counters with."""
+        with self._lock:
+            items = list(self._decision_counters.items())
+        return {b: int(c.value) for b, c in items}
+
     def stats(self) -> dict:
         """`GET /_nodes/stats` payload: decision counters + EWMA table."""
-        with self._lock:
-            decisions = dict(self.decisions)
         return {
-            "decisions": decisions,
+            "decisions": self.decisions,
             "ewma": self.cost.snapshot(),
         }

@@ -128,12 +128,17 @@ def _make_error(kind: str, site: str, ctx: dict):
         # Late import: cluster.transport itself calls fault_point.
         from ..cluster.transport import ConnectTransportError
 
-        return ConnectTransportError(msg)
-    if kind == "breaker":
+        err: Exception = ConnectTransportError(msg)
+    elif kind == "breaker":
         from ..common.breaker import BreakerError
 
-        return BreakerError(0, 0, 0, f"injected:{site}")
-    return InjectedFaultError(msg)
+        err = BreakerError(0, 0, 0, f"injected:{site}")
+    else:
+        err = InjectedFaultError(msg)
+    # Marker the tracing layer reads: an enclosing span tags
+    # injected_fault=true so chaos runs produce readable traces.
+    err.injected = True
+    return err
 
 
 class FaultRegistry:

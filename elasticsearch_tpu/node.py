@@ -35,6 +35,8 @@ from .faults import REGISTRY as FAULTS
 from .faults import FaultSpec, InjectedFaultError
 from .index.engine import Engine, InvalidCasError, VersionConflictError
 from .index.mapping import Mappings
+from .obs.metrics import DeviceInstruments, MetricsRegistry
+from .obs.tracing import TRACER
 from .ops.bm25 import BM25Params
 from .parallel.routing import shard_for_id
 from .search.coordinator import ShardedSearchCoordinator
@@ -82,6 +84,10 @@ _INDEX_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9_\-.]*$")
 # Search slow log (the reference's index.search.slowlog.*): queries over a
 # configured threshold log here with their source.
 slowlog = logging.getLogger("elasticsearch_tpu.slowlog.search")
+
+# Indexing slow log (index.indexing.slowlog.threshold.index.*): document
+# writes over a configured threshold log here with their id + source.
+indexing_slowlog = logging.getLogger("elasticsearch_tpu.slowlog.index")
 
 
 def _refresh_after_write(engine) -> bool:
@@ -230,16 +236,40 @@ class Node:
                 os.environ.get("ESTPU_HBM_LIMIT_BYTES", 8 << 30)
             )
         self.breaker = CircuitBreaker(breaker_limit_bytes)
-        self.request_cache = RequestCache()
+        # Unified metrics registry (obs/metrics.py): THE write path for
+        # this node's operational counters — `GET /_nodes/stats` and the
+        # Prometheus exposition at `GET /_metrics` are both views over
+        # it. Device-level launch instruments (XLA compile count/ms,
+        # padding waste, H2D bytes) hang off the same registry.
+        self.metrics = MetricsRegistry()
+        self.device = DeviceInstruments(self.metrics)
+        self.metrics.gauge(
+            "estpu_faults_armed",
+            "Armed fault-injection specs (faults/registry.py)",
+            fn=lambda: len(FAULTS._armed),
+        )
+        self.metrics.gauge(
+            "estpu_traces_buffered",
+            "Finished traces held in the /_traces ring buffer",
+            fn=lambda: TRACER.stats()["buffered_traces"],
+        )
+        self.request_cache = RequestCache(metrics=self.metrics)
         self.tasks = TaskManager(node_name)
         # Degraded-mode serving counters (GET /_nodes/stats
         # search_resilience): partial responses served, shard failures
-        # absorbed, partial-disallowed 503s.
-        self._resilience_lock = threading.Lock()
-        self.search_resilience = {
-            "partial_responses": 0,
-            "shard_failures": 0,
-            "search_phase_failures": 0,
+        # absorbed, partial-disallowed 503s. Registry-backed; the
+        # `search_resilience` property renders the stats view.
+        self._resilience_counters = {
+            key: self.metrics.counter(
+                "estpu_search_resilience_total",
+                "Degraded-mode serving events",
+                kind=key,
+            )
+            for key in (
+                "partial_responses",
+                "shard_failures",
+                "search_phase_failures",
+            )
         }
         self.repositories: dict[str, Any] = {}
         self.pipelines: dict[str, Any] = {}  # ingest.Pipeline by id
@@ -271,15 +301,19 @@ class Node:
         from .exec import ExecPlanner, MicroBatcher
 
         self.exec_planner = (
-            ExecPlanner()
+            ExecPlanner(metrics=self.metrics)
             if os.environ.get("ESTPU_EXEC_PLANNER", "1") != "0"
             else None
         )
         self.exec_batcher = (
-            MicroBatcher()
+            MicroBatcher(metrics=self.metrics)
             if os.environ.get("ESTPU_EXEC_BATCHER", "1") != "0"
             else None
         )
+        if self.replication is not None:
+            # Re-home the gateway's counters onto this node's registry
+            # (still zero at this point) so `GET /_metrics` exposes them.
+            self.replication.bind_metrics(self.metrics)
         # Extension system (plugins.py): analyzers / ingest processors /
         # query types contributed by ESTPU_PLUGINS or the plugins param.
         from .plugins import load_plugins
@@ -413,10 +447,14 @@ class Node:
             )
         search: SearchService | ShardedSearchCoordinator
         if n_shards == 1:
-            search = SearchService(engines[0], name, planner=self.exec_planner)
+            search = SearchService(
+                engines[0], name, planner=self.exec_planner,
+                device=self.device,
+            )
         else:
             search = ShardedSearchCoordinator(
-                engines, name, planner=self.exec_planner
+                engines, name, planner=self.exec_planner,
+                device=self.device,
             )
             from .parallel.mesh_serving import maybe_mesh_view
 
@@ -1220,6 +1258,7 @@ class Node:
         pipeline: str | None = None,
         timeout_s: float | None = None,
     ) -> dict:
+        write_t0 = time.monotonic()
         svc = self.get_index(index, auto_create=True)
         source = self._apply_pipeline(svc, source, pipeline)
         if source is None:  # dropped by an ingest drop processor
@@ -1232,11 +1271,15 @@ class Node:
         if self.replication is not None:
             if doc_id is None:
                 doc_id = svc.next_auto_id()
-            return self._replicated_write(
+            out = self._replicated_write(
                 svc, doc_id, source, op="index", op_type=op_type,
                 refresh=refresh, if_seq_no=if_seq_no,
                 if_primary_term=if_primary_term, timeout_s=timeout_s,
             )
+            self._log_slow_indexing(
+                svc, doc_id, (time.monotonic() - write_t0) * 1e3, source
+            )
+            return out
         if doc_id is None and svc.n_shards > 1:
             # Multi-shard: the id must exist before routing (the reference
             # generates the UUID in TransportBulkAction before routing too).
@@ -1268,6 +1311,9 @@ class Node:
         }
         if refresh:
             out["forced_refresh"] = _refresh_after_write(engine)
+        self._log_slow_indexing(
+            svc, result["_id"], (time.monotonic() - write_t0) * 1e3, source
+        )
         return out
 
     def get_doc(self, index: str, doc_id: str) -> dict:
@@ -1530,10 +1576,26 @@ class Node:
     # --------------------------------------------------------------- search
 
     def _count_resilience(self, key: str, n: int = 1) -> None:
-        with self._resilience_lock:
-            self.search_resilience[key] = (
-                self.search_resilience.get(key, 0) + n
+        counter = self._resilience_counters.get(key)
+        if counter is None:
+            # counter() is idempotent get-or-create; caching the novel
+            # key here keeps the search_resilience view complete.
+            counter = self._resilience_counters[key] = self.metrics.counter(
+                "estpu_search_resilience_total",
+                "Degraded-mode serving events",
+                kind=key,
             )
+        counter.inc(n)
+
+    @property
+    def search_resilience(self) -> dict[str, int]:
+        """Degraded-mode counters — a view over the metrics registry.
+        list() snapshots the dict C-atomically against concurrent
+        novel-key inserts."""
+        return {
+            key: int(c.value)
+            for key, c in list(self._resilience_counters.items())
+        }
 
     def search(
         self,
@@ -1544,6 +1606,29 @@ class Node:
         timeout_s: float | None = None,
         allow_partial: bool | None = None,
     ) -> dict:
+        # Every search runs inside a span: a child of the REST root when
+        # dispatched over HTTP, a fresh root trace when called directly —
+        # either way the planner/batcher/segment spans below parent here.
+        with TRACER.span("search", root=True, index=index):
+            return self._search_inner(
+                index,
+                body,
+                scroll=scroll,
+                request_cache=request_cache,
+                timeout_s=timeout_s,
+                allow_partial=allow_partial,
+            )
+
+    def _search_inner(
+        self,
+        index: str,
+        body: dict[str, Any] | None,
+        scroll: str | None = None,
+        request_cache: bool | None = None,
+        timeout_s: float | None = None,
+        allow_partial: bool | None = None,
+    ) -> dict:
+        search_t0 = time.monotonic()
         if allow_partial is not None:
             # ?allow_partial_search_results= on the URL wins over the body
             # key; folded in up front so every dispatch path (multi-index,
@@ -1581,7 +1666,16 @@ class Node:
         if body:
             body = self.resolve_script_refs(body)
         if self.replication is not None:
-            return self._replicated_search(svc, body, scroll)
+            out = self._replicated_search(svc, body, scroll)
+            # Replicated searches slowlog too (no per-phase breakdown:
+            # the cluster path reports one end-to-end took).
+            self._log_slow_search(
+                svc,
+                body,
+                out.get("took", 0),
+                trace_id=TRACER.current_trace_id(),
+            )
+            return out
         if self._scrolls:
             # Reap expired scroll contexts opportunistically: they pin
             # frozen device segments, and a quiet scroll API must not keep
@@ -1604,6 +1698,14 @@ class Node:
             )
             cached = self.request_cache.get(cache_key)
             if cached is not None:
+                # Honest accounting on a hit: report the time THIS
+                # request actually took (the cache lookup), never replay
+                # the cached execution's `took`; the trace says why it
+                # was fast instead of pretending the kernels ran.
+                TRACER.tag(cache_hit=True)
+                cached["took"] = max(
+                    1, int((time.monotonic() - search_t0) * 1000)
+                )
                 return cached
         try:
             request = SearchRequest.from_json(body)
@@ -1678,7 +1780,22 @@ class Node:
             # Degraded-mode accounting: a 200 that omitted failed shards.
             self._count_resilience("shard_failures", response.failed)
             self._count_resilience("partial_responses")
-        self._log_slow_search(svc, body, out.get("took", 0))
+        self._log_slow_search(
+            svc,
+            body,
+            out.get("took", 0),
+            trace_id=TRACER.current_trace_id(),
+            breakdown=getattr(response, "phases", None),
+        )
+        if request.profile and "profile" in out:
+            # The ES profile-API analog of a trace dump: `profile: true`
+            # responses inline the request's own span tree so far.
+            trace_id = TRACER.current_trace_id()
+            tree = (
+                TRACER.export(trace_id) if trace_id is not None else None
+            )
+            if tree is not None:
+                out["profile"]["trace"] = tree
         if body and body.get("suggest"):
             from .search.suggest import run_suggest
 
@@ -1910,9 +2027,18 @@ class Node:
             }
         return out
 
-    def _log_slow_search(self, svc: IndexService, body, took_ms: int) -> None:
+    def _log_slow_search(
+        self,
+        svc: IndexService,
+        body,
+        took_ms: int,
+        trace_id: str | None = None,
+        breakdown: dict[str, Any] | None = None,
+    ) -> None:
         """index.search.slowlog.threshold.query.{warn,info,debug} — log the
-        slowest level the took time crosses (SearchSlowLog analog)."""
+        slowest level the took time crosses (SearchSlowLog analog). Lines
+        carry the request's trace_id (join against `GET /_traces/{id}`)
+        and the per-phase took breakdown."""
         cfg = (
             svc.settings.get("index", {})
             .get("search", {})
@@ -1936,10 +2062,56 @@ class Node:
                 continue
             if took_ms >= threshold_ms:
                 log(
-                    "[%s] took[%dms], source[%s]",
+                    "[%s] took[%dms], trace_id[%s], took_breakdown[%s], "
+                    "source[%s]",
                     svc.name,
                     took_ms,
+                    trace_id or "-",
+                    (
+                        json.dumps(breakdown, separators=(",", ":"))
+                        if breakdown
+                        else "-"
+                    ),
                     json.dumps(body or {}, separators=(",", ":"))[:1000],
+                )
+                return
+
+    def _log_slow_indexing(
+        self, svc: IndexService, doc_id: str, took_ms: float, source
+    ) -> None:
+        """index.indexing.slowlog.threshold.index.{warn,info,debug} — the
+        write-side sibling of the search slowlog (IndexingSlowLog
+        analog): document writes over the threshold log with their id,
+        trace_id and (truncated) source."""
+        cfg = (
+            svc.settings.get("index", {})
+            .get("indexing", {})
+            .get("slowlog", {})
+            .get("threshold", {})
+            .get("index", {})
+        )
+        if not cfg:
+            return
+        for level, log in (
+            ("warn", indexing_slowlog.warning),
+            ("info", indexing_slowlog.info),
+            ("debug", indexing_slowlog.debug),
+        ):
+            raw = cfg.get(level)
+            if raw is None:
+                continue
+            try:
+                threshold_ms = _parse_keepalive(raw) * 1000.0
+            except ApiError:
+                continue
+            if took_ms >= threshold_ms:
+                log(
+                    "[%s] took[%dms], trace_id[%s], id[%s], source[%s]",
+                    svc.name,
+                    int(took_ms),
+                    TRACER.current_trace_id() or "-",
+                    doc_id,
+                    json.dumps(source or {}, separators=(",", ":"))[:1000],
                 )
                 return
 
@@ -2662,6 +2834,7 @@ class Node:
         "translog",  # durability, applied below
         "max_result_window",  # from+size bound in search()
         "search",  # search.slowlog thresholds (_log_slow_search)
+        "indexing",  # indexing.slowlog thresholds (_log_slow_indexing)
     }
 
     def put_settings(self, index: str, body: dict[str, Any]) -> dict:
@@ -3008,17 +3181,41 @@ class Node:
 
     # ---------------------------------------------------------------- tasks
 
-    def list_tasks(self, actions: str | None = None) -> dict:
+    def list_tasks(
+        self, actions: str | None = None, detailed: bool = False
+    ) -> dict:
+        """GET /_tasks[?detailed=true]: running tasks with monotonic
+        running_time_in_nanos + current span name; detailed adds the
+        description."""
         return {
             "nodes": {
                 self.node_name: {
                     "name": self.node_name,
                     "tasks": {
-                        t.id: t.to_json() for t in self.tasks.list(actions)
+                        t.id: t.to_json(detailed=detailed)
+                        for t in self.tasks.list(actions)
                     },
                 }
             }
         }
+
+    def cat_tasks(self) -> list[dict]:
+        """GET /_cat/tasks — the cat rendering of the task list."""
+        rows = []
+        for t in self.tasks.list():
+            j = t.to_json(detailed=True)
+            rows.append(
+                {
+                    "action": j["action"],
+                    "task_id": t.id,
+                    "type": j["type"],
+                    "start_time": str(j["start_time_in_millis"]),
+                    "running_time": f"{j['running_time_in_nanos'] / 1e6:.1f}ms",
+                    "node": j["node"],
+                    "span": j.get("span", "-"),
+                }
+            )
+        return rows
 
     def get_task(self, task_id: str) -> dict:
         task = self.tasks.get(task_id)
@@ -3098,6 +3295,46 @@ class Node:
     def clear_faults(self, site: str | None = None) -> dict:
         """DELETE /_fault[/{site}] — disarm one site pattern or all."""
         return {"acknowledged": True, "cleared": FAULTS.clear(site)}
+
+    # -------------------------------------------------------- observability
+
+    def get_traces(self, limit: int = 50) -> dict:
+        """GET /_traces — newest-first summaries of the trace ring."""
+        return {
+            **TRACER.stats(),
+            "traces": TRACER.traces(limit=limit),
+        }
+
+    def get_trace(self, trace_id: str, fmt: str | None = None) -> dict:
+        """GET /_traces/{trace_id}[?format=chrome] — one span tree, as
+        span JSON or Chrome trace-event JSON (Perfetto-loadable)."""
+        out = (
+            TRACER.to_chrome(trace_id)
+            if fmt == "chrome"
+            else TRACER.export(trace_id)
+        )
+        if out is None:
+            raise ApiError(
+                404,
+                "resource_not_found_exception",
+                f"trace [{trace_id}] is not buffered (ring keeps the last "
+                f"{TRACER.max_traces} traces)",
+            )
+        return out
+
+    def metrics_text(self) -> str:
+        """GET /_metrics — Prometheus text exposition: this node's
+        registry merged with the replication gateway's and every live
+        cluster node's (their series carry distinguishing labels)."""
+        others = []
+        if self.replication is not None:
+            gw_metrics = getattr(self.replication, "metrics", None)
+            if gw_metrics is not None and gw_metrics is not self.metrics:
+                others.append(gw_metrics)
+            for cnode in self.replication.cluster.nodes.values():
+                if not cnode.closed:
+                    others.append(cnode.metrics)
+        return self.metrics.exposition(*others)
 
     # ---------------------------------------------------------------- admin
 
@@ -3359,6 +3596,11 @@ class Node:
                 },
                 "batcher": self._batcher_resilience_stats(),
             },
+            # Device-level launch instruments (obs/metrics.py): XLA
+            # compile count/ms per plan class, H2D bytes, padding waste.
+            "device": self.device.snapshot(),
+            # Tracing ring state (obs/tracing.py).
+            "obs": {"tracing": TRACER.stats()},
         }
         if self.replication is not None:
             node_stats["replication"] = self.replication.stats()

@@ -1,0 +1,24 @@
+"""Observability substrate: distributed tracing + unified metrics.
+
+Two pillars, both process-wide services the serving stack writes through:
+
+- ``tracing``: a thread-safe span tree per request (trace_id/span_id/
+  parent), propagated via `traceparent`/`X-Opaque-Id` headers at the REST
+  edge and via transport payloads across cluster nodes, buffered in a
+  bounded ring exposed at `GET /_traces` (`?format=chrome` emits Chrome
+  trace-event JSON loadable in Perfetto).
+- ``metrics``: a central registry of counters, gauges and fixed-bucket
+  histograms — the single write path behind `_nodes/stats` and the
+  Prometheus text exposition at `GET /_metrics`.
+"""
+
+from .metrics import DeviceInstruments, MetricsRegistry
+from .tracing import TRACER, Span, Tracer
+
+__all__ = [
+    "TRACER",
+    "Span",
+    "Tracer",
+    "MetricsRegistry",
+    "DeviceInstruments",
+]

@@ -1,0 +1,466 @@
+"""Unified metrics registry: counters, gauges, fixed-bucket histograms.
+
+The single write path behind the node's operational counters: the
+scattered per-subsystem dicts (exec planner decisions, micro-batcher
+telemetry, search-resilience counters, request-cache hit/miss/eviction,
+replication gateway retries) write through registry instruments, and
+`GET /_nodes/stats` is rebuilt as a VIEW over the registry — one source
+of truth, two renderings (the ES-shaped stats JSON and the Prometheus
+text exposition at `GET /_metrics`).
+
+Device-level instruments (DeviceInstruments) hook the kernel-launch
+sites: XLA compile count and compile-ms per plan class (first launch of a
+new (kernel, spec, k) shape is the compile), padding-waste ratio of
+coalesced launches (padded nt vs. actual), host→device transfer bytes,
+and launch counts — the signals BENCH_r05-style regressions (cfg3_conj at
+0.07×, tunnel_roundtrip_floor_ms 106.2) need span-level attribution for.
+
+Prometheus exposition follows the text format 0.0.4: `# TYPE` per family,
+`name{label="value"} <float>` samples, histogram `_bucket`/`_sum`/`_count`
+series with cumulative `le` buckets.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Any, Callable
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _escape_label(value: Any) -> str:
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace("\n", r"\n")
+        .replace('"', r'\"')
+    )
+
+
+def _format_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+class Counter:
+    """Monotonically increasing value (one labeled sample)."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counters only go up, got {n}")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Settable value, or a callback evaluated at scrape time."""
+
+    __slots__ = ("_value", "_fn", "_lock")
+
+    def __init__(self, fn: Callable[[], float] | None = None):
+        self._value = 0.0
+        self._fn = fn
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:
+                return 0.0
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram: per-bucket (non-cumulative) counts, sum,
+    count. Buckets are upper bounds; values above the last bound land in
+    the implicit +Inf bucket. The exposition renders the cumulative
+    `le`-labeled series Prometheus expects."""
+
+    __slots__ = ("buckets", "_counts", "_inf", "_sum", "_count", "_lock")
+
+    def __init__(self, buckets: tuple[float, ...]):
+        if not buckets:
+            raise ValueError("histogram requires at least one bucket bound")
+        ordered = tuple(sorted(float(b) for b in buckets))
+        if len(set(ordered)) != len(ordered):
+            raise ValueError(f"duplicate histogram bucket in {buckets}")
+        self.buckets = ordered
+        self._counts = [0] * len(ordered)
+        self._inf = 0
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            for i, bound in enumerate(self.buckets):
+                if v <= bound:
+                    self._counts[i] += 1
+                    return
+            self._inf += 1
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "buckets": {
+                    _format_value(b): c
+                    for b, c in zip(self.buckets, self._counts)
+                },
+                "inf": self._inf,
+                "sum": self._sum,
+                "count": self._count,
+            }
+
+class MetricsRegistry:
+    """Thread-safe instrument registry with Prometheus text exposition.
+
+    Instruments are keyed by (name, sorted label items): repeated
+    ``counter(name, **labels)`` calls return the same instrument, so call
+    sites don't pre-register anything."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> (kind, help, {label_tuple: instrument})
+        self._families: dict[str, tuple[str, str, dict]] = {}
+
+    # ------------------------------------------------------------ creation
+
+    def _family(self, name: str, kind: str, help_text: str) -> dict:
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name [{name}]")
+        with self._lock:
+            entry = self._families.get(name)
+            if entry is None:
+                entry = (kind, help_text, {})
+                self._families[name] = entry
+            elif entry[0] != kind:
+                raise ValueError(
+                    f"metric [{name}] already registered as {entry[0]}, "
+                    f"not {kind}"
+                )
+            return entry[2]
+
+    @staticmethod
+    def _label_key(labels: dict[str, Any]) -> tuple:
+        for k in labels:
+            if not _LABEL_RE.match(k):
+                raise ValueError(f"invalid label name [{k}]")
+        return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+    def counter(self, name: str, help_text: str = "", **labels) -> Counter:
+        series = self._family(name, "counter", help_text)
+        key = self._label_key(labels)
+        with self._lock:
+            inst = series.get(key)
+            if inst is None:
+                inst = series[key] = Counter()
+            return inst
+
+    def gauge(
+        self,
+        name: str,
+        help_text: str = "",
+        fn: Callable[[], float] | None = None,
+        **labels,
+    ) -> Gauge:
+        series = self._family(name, "gauge", help_text)
+        key = self._label_key(labels)
+        with self._lock:
+            inst = series.get(key)
+            if inst is None:
+                inst = series[key] = Gauge(fn)
+            elif fn is not None:
+                inst._fn = fn
+            return inst
+
+    def histogram(
+        self,
+        name: str,
+        buckets: tuple[float, ...],
+        help_text: str = "",
+        **labels,
+    ) -> Histogram:
+        series = self._family(name, "histogram", help_text)
+        key = self._label_key(labels)
+        with self._lock:
+            inst = series.get(key)
+            if inst is None:
+                inst = series[key] = Histogram(buckets)
+            return inst
+
+    # -------------------------------------------------------------- views
+
+    def value(self, name: str, **labels) -> float:
+        """Current value of one counter/gauge sample (0 when absent) —
+        the `_nodes/stats` view accessor."""
+        with self._lock:
+            entry = self._families.get(name)
+            if entry is None:
+                return 0.0
+            inst = entry[2].get(self._label_key(labels))
+        return 0.0 if inst is None else inst.value
+
+    def values(self, name: str) -> dict[tuple, float]:
+        """Every labeled sample of a family: {label_items: value}."""
+        with self._lock:
+            entry = self._families.get(name)
+            if entry is None:
+                return {}
+            items = list(entry[2].items())
+        return {key: inst.value for key, inst in items}
+
+    def label_values(self, name: str, label: str) -> dict[str, float]:
+        """Family samples keyed by ONE label's value (counters with a
+        single distinguishing label, e.g. decisions by backend)."""
+        out: dict[str, float] = {}
+        for key, value in self.values(name).items():
+            for k, v in key:
+                if k == label:
+                    out[v] = out.get(v, 0.0) + value
+        return out
+
+    # ---------------------------------------------------------- exposition
+
+    def _collect(self) -> dict[str, tuple[str, str, dict]]:
+        """{name: (kind, help, {label_key: float | histogram snapshot})}"""
+        with self._lock:
+            families = {
+                name: (kind, help_text, dict(series))
+                for name, (kind, help_text, series) in self._families.items()
+            }
+        out: dict[str, tuple[str, str, dict]] = {}
+        for name, (kind, help_text, series) in families.items():
+            samples = {}
+            for key, inst in series.items():
+                samples[key] = (
+                    inst.snapshot() if kind == "histogram" else inst.value
+                )
+            out[name] = (kind, help_text, samples)
+        return out
+
+    def exposition(self, *others: "MetricsRegistry") -> str:
+        """The Prometheus text format 0.0.4 rendering of every family —
+        optionally merged with other registries (the node merges its own
+        with the replication gateway's and each cluster node's; samples
+        that collide on (name, labels) sum, so per-node series should
+        carry a distinguishing label)."""
+        merged: dict[str, tuple[str, str, dict]] = {}
+        for registry in (self, *others):
+            for name, (kind, help_text, samples) in registry._collect().items():
+                entry = merged.get(name)
+                if entry is None:
+                    merged[name] = (kind, help_text, dict(samples))
+                    continue
+                if entry[0] != kind:  # conflicting kinds: keep the first
+                    continue
+                for key, sample in samples.items():
+                    prior = entry[2].get(key)
+                    if prior is None:
+                        entry[2][key] = sample
+                    elif kind == "histogram":
+                        entry[2][key] = {
+                            "buckets": {
+                                b: prior["buckets"].get(b, 0) + c
+                                for b, c in sample["buckets"].items()
+                            },
+                            "inf": prior["inf"] + sample["inf"],
+                            "sum": prior["sum"] + sample["sum"],
+                            "count": prior["count"] + sample["count"],
+                        }
+                    else:
+                        entry[2][key] = prior + sample
+        lines: list[str] = []
+        for name, (kind, help_text, samples) in sorted(merged.items()):
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {kind}")
+            for key, sample in sorted(samples.items()):
+                labels = ",".join(
+                    f'{k}="{_escape_label(v)}"' for k, v in key
+                )
+                if kind == "histogram":
+                    cumulative = 0
+                    for bound_str, count in sample["buckets"].items():
+                        cumulative += count
+                        le = (labels + "," if labels else "") + (
+                            f'le="{bound_str}"'
+                        )
+                        lines.append(f"{name}_bucket{{{le}}} {cumulative}")
+                    cumulative += sample["inf"]
+                    le = (labels + "," if labels else "") + 'le="+Inf"'
+                    lines.append(f"{name}_bucket{{{le}}} {cumulative}")
+                    suffix = f"{{{labels}}}" if labels else ""
+                    lines.append(
+                        f"{name}_sum{suffix} {_format_value(sample['sum'])}"
+                    )
+                    lines.append(f"{name}_count{suffix} {sample['count']}")
+                else:
+                    suffix = f"{{{labels}}}" if labels else ""
+                    lines.append(
+                        f"{name}{suffix} {_format_value(sample)}"
+                    )
+        return "\n".join(lines) + "\n"
+
+
+# Pow-2-ish bounds for the padding-waste ratio and occupancy/wait shapes.
+PADDING_RATIO_BUCKETS = (0.0, 0.05, 0.1, 0.2, 0.3, 0.5, 0.7, 0.9)
+OCCUPANCY_BUCKETS = tuple(float(1 << i) for i in range(9))  # 1..256
+QUEUE_WAIT_MS_BUCKETS = (
+    0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 1024.0,
+)
+
+
+class DeviceInstruments:
+    """Launch-site instruments over one registry.
+
+    ``launch(kind, plan_key, elapsed_s)`` counts every kernel launch; the
+    FIRST launch of a given plan_key is recorded as the XLA compile for
+    its plan class (jit compiles on first call of a new static shape, so
+    first-launch wall time is compile-dominated — the honest in-band
+    measure without reaching into XLA internals). Plan classes are
+    labeled by the spec kind (bounded cardinality), never the full spec.
+    """
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._seen: set = set()
+
+    def launch(self, kind: str, plan_key: Any, elapsed_s: float) -> None:
+        self.registry.counter(
+            "estpu_device_launches_total",
+            "Kernel launches by plan class",
+            plan_class=kind,
+        ).inc()
+        with self._lock:
+            first = plan_key not in self._seen
+            if first:
+                self._seen.add(plan_key)
+        if first:
+            self.registry.counter(
+                "estpu_device_compile_total",
+                "XLA compiles (first launch of a new plan shape)",
+                plan_class=kind,
+            ).inc()
+            self.registry.counter(
+                "estpu_device_compile_ms_total",
+                "Wall-clock ms spent in first (compiling) launches",
+                plan_class=kind,
+            ).inc(elapsed_s * 1e3)
+
+    def h2d(self, arrays: Any) -> None:
+        """Host→device transfer bytes: the numpy leaves staged for upload
+        by this launch."""
+        try:
+            import jax
+
+            nbytes = sum(
+                getattr(leaf, "nbytes", 0)
+                for leaf in jax.tree.leaves(arrays)
+            )
+        except Exception:
+            nbytes = getattr(arrays, "nbytes", 0)
+        if nbytes:
+            self.registry.counter(
+                "estpu_device_h2d_bytes_total",
+                "Host-to-device plan-array bytes staged at launch sites",
+            ).inc(float(nbytes))
+
+    def padding(self, actual_tiles: int, padded_tiles: int) -> None:
+        """Padding waste of one coalesced launch: padded worklist tiles
+        vs. the tiles the lanes actually needed."""
+        padded_tiles = max(1, int(padded_tiles))
+        waste = max(0.0, 1.0 - float(actual_tiles) / padded_tiles)
+        self.registry.counter(
+            "estpu_device_padded_tiles_total",
+            "Worklist tiles launched (after pad/coalesce)",
+        ).inc(float(padded_tiles))
+        self.registry.counter(
+            "estpu_device_actual_tiles_total",
+            "Worklist tiles the lanes actually required",
+        ).inc(float(actual_tiles))
+        self.registry.histogram(
+            "estpu_device_padding_waste_ratio",
+            PADDING_RATIO_BUCKETS,
+            "Per-coalesced-launch padding waste ratio",
+        ).observe(waste)
+
+    # ------------------------------------------------------------- views
+
+    def compile_count(self) -> int:
+        return int(
+            sum(
+                self.registry.label_values(
+                    "estpu_device_compile_total", "plan_class"
+                ).values()
+            )
+        )
+
+    def compile_ms_total(self) -> float:
+        return round(
+            sum(
+                self.registry.label_values(
+                    "estpu_device_compile_ms_total", "plan_class"
+                ).values()
+            ),
+            3,
+        )
+
+    def padding_waste_pct(self) -> float:
+        padded = self.registry.value("estpu_device_padded_tiles_total")
+        actual = self.registry.value("estpu_device_actual_tiles_total")
+        if padded <= 0:
+            return 0.0
+        return round(100.0 * (1.0 - actual / padded), 2)
+
+    def snapshot(self) -> dict[str, Any]:
+        """The `_nodes/stats` device section."""
+        return {
+            "compile_count": self.compile_count(),
+            "compile_ms_total": self.compile_ms_total(),
+            "compiles_by_plan_class": {
+                k: int(v)
+                for k, v in sorted(
+                    self.registry.label_values(
+                        "estpu_device_compile_total", "plan_class"
+                    ).items()
+                )
+            },
+            "launches_by_plan_class": {
+                k: int(v)
+                for k, v in sorted(
+                    self.registry.label_values(
+                        "estpu_device_launches_total", "plan_class"
+                    ).items()
+                )
+            },
+            "h2d_bytes_total": int(
+                self.registry.value("estpu_device_h2d_bytes_total")
+            ),
+            "padding_waste_pct": self.padding_waste_pct(),
+        }

@@ -46,10 +46,32 @@ from ..cluster import (
 from ..common.breaker import BreakerError
 from ..faults import InjectedFaultError
 from ..node import ApiError, Node
+from ..obs.tracing import TRACER, format_traceparent
 from ..search import rank_eval
 from ..search.service import SearchPhaseFailedError
 
 Handler = Callable[["RestServer", dict, dict, Any], Any]
+
+
+class PlainText:
+    """A non-JSON response body (the Prometheus exposition): the HTTP
+    layer writes `text` verbatim with `content_type` instead of
+    json.dumps-ing it."""
+
+    __slots__ = ("text", "content_type")
+
+    def __init__(
+        self,
+        text: str,
+        content_type: str = "text/plain; version=0.0.4; charset=utf-8",
+    ):
+        self.text = text
+        self.content_type = content_type
+
+
+# Endpoints that observe the observer: tracing them would fill the ring
+# buffer with scrapes instead of searches.
+_UNTRACED_PATHS = ("/_traces", "/_metrics")
 
 # Cluster-topology failures that may escape the Node's own retry mapping
 # (e.g. raised from a code path that predates replication): the router
@@ -261,8 +283,18 @@ class RestServer:
         r("DELETE", "/_fault/{site}", lambda s, p, q, b: n.clear_faults(
             p["site"]
         ))
+        # Observability: trace ring + Prometheus exposition.
+        r("GET", "/_traces", lambda s, p, q, b: n.get_traces(
+            limit=int(q.get("limit", 50))
+        ))
+        r("GET", "/_traces/{trace_id}", lambda s, p, q, b: n.get_trace(
+            p["trace_id"], fmt=q.get("format")
+        ))
+        r("GET", "/_metrics", lambda s, p, q, b: PlainText(n.metrics_text()))
+        r("GET", "/_cat/tasks", lambda s, p, q, b: n.cat_tasks())
         r("GET", "/_tasks", lambda s, p, q, b: n.list_tasks(
-            q.get("actions")
+            q.get("actions"),
+            detailed=q.get("detailed") in ("true", ""),
         ))
         r("GET", "/_tasks/{task_id}", lambda s, p, q, b: n.get_task(
             p["task_id"]
@@ -490,10 +522,53 @@ class RestServer:
                 pass
             return handler(self, params, query, body)
 
-    def dispatch(self, method: str, path: str, query: dict, body: str):
+    def dispatch(
+        self,
+        method: str,
+        path: str,
+        query: dict,
+        body: str,
+        headers: dict | None = None,
+    ):
         """Returns (status, payload). ES-style error payloads on failure.
         Extra response headers (e.g. Retry-After on shed 429s) land in
-        `self._tl.response_headers` for the HTTP layer to emit."""
+        `self._tl.response_headers` for the HTTP layer to emit.
+
+        Every dispatched request runs inside a ROOT trace span: an inbound
+        `traceparent` header continues the caller's W3C trace, and
+        `X-Opaque-Id` tags the root (the reference threads it to tasks and
+        slowlogs the same way). The trace id returns as `X-Trace-Id` +
+        `traceparent` response headers."""
+        headers = headers or {}
+        if any(path == p or path.startswith(p + "/") for p in _UNTRACED_PATHS):
+            return self._dispatch_inner(method, path, query, body)
+        tags = {"method": method, "path": path}
+        opaque = headers.get("X-Opaque-Id") or headers.get("x-opaque-id")
+        if opaque:
+            tags["opaque_id"] = opaque
+        with TRACER.start_trace(
+            "rest.request",
+            traceparent=(
+                headers.get("traceparent") or headers.get("Traceparent")
+            ),
+            **tags,
+        ) as root:
+            status, payload = self._dispatch_inner(method, path, query, body)
+            root.tags["status"] = status
+            if status >= 500:
+                root.status = "error"
+            self._tl.response_headers = {
+                **getattr(self._tl, "response_headers", {}),
+                "X-Trace-Id": root.trace_id,
+                "traceparent": format_traceparent(
+                    root.trace_id, root.span_id
+                ),
+            }
+            return status, payload
+
+    def _dispatch_inner(
+        self, method: str, path: str, query: dict, body: str
+    ):
         self._tl.response_headers = {}
         try:
             # HEAD is served by the matching GET handler (the HTTP layer
@@ -612,11 +687,17 @@ class RestServer:
                 rest._tl.body_nbytes = length
                 body = self.rfile.read(length).decode("utf-8") if length else ""
                 status, payload = rest.dispatch(
-                    self.command, parsed.path.rstrip("/") or "/", query, body
+                    self.command, parsed.path.rstrip("/") or "/", query, body,
+                    headers=dict(self.headers.items()),
                 )
-                data = json.dumps(payload).encode("utf-8")
+                if isinstance(payload, PlainText):
+                    data = payload.text.encode("utf-8")
+                    content_type = payload.content_type
+                else:
+                    data = json.dumps(payload).encode("utf-8")
+                    content_type = "application/json"
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.send_header("X-elastic-product", "Elasticsearch")
                 for name, value in getattr(

@@ -30,6 +30,7 @@ import numpy as np
 
 from ..common.tasks import TaskCancelledError
 from ..faults import fault_point
+from ..obs.tracing import TRACER
 from ..query.compile import aggregate_field_stats
 from .service import (
     SearchHit,
@@ -107,15 +108,19 @@ class ShardedSearchCoordinator:
         engines: list["Engine"],
         index_name: str = "index",
         planner=None,
+        device=None,
     ):
         self.engines = engines
         self.index_name = index_name
         # One exec.ExecPlanner shared by every shard service: plan-class
         # cost EWMAs and decision counters are node-scoped, so every
-        # shard's observations calibrate the same model.
+        # shard's observations calibrate the same model. The same goes
+        # for the obs.DeviceInstruments launch-site metrics.
         self.planner = planner
+        self.device = device
         self.services = [
-            SearchService(e, index_name, planner=planner) for e in engines
+            SearchService(e, index_name, planner=planner, device=device)
+            for e in engines
         ]
         self._stats_cache = None
         self._stats_gen: tuple = ()
@@ -157,7 +162,15 @@ class ShardedSearchCoordinator:
         import time
 
         if self.mesh_view is not None:
-            resp = self.mesh_view.serve(self, request, task)
+            # The SPMD serving path: ONE shard_map program over the mesh —
+            # one span, since there are no per-shard launches to trace.
+            with TRACER.span(
+                "mesh.serve", task=task, index=self.index_name,
+                shards=len(self.engines),
+            ) as mesh_span:
+                resp = self.mesh_view.serve(self, request, task)
+                if mesh_span is not None:
+                    mesh_span.tags["served"] = resp is not None
             if resp is not None:
                 return resp
         start = time.monotonic()
@@ -311,18 +324,24 @@ class ShardedSearchCoordinator:
                 per_shard.append([[] for _ in range(n)])
                 continue
             try:
-                fault_point(
+                with TRACER.span(
                     "coordinator.shard",
-                    index=self.index_name,
                     shard=shard_idx,
-                )
-                cands, tot, tmo, errs = svc._batched_query_phase(
-                    [requests[i] for i in rows],
-                    [ks[i] for i in rows],
-                    stats,
-                    snapshots[shard_idx],
-                    [tasks[i] for i in rows],
-                )
+                    index=self.index_name,
+                    riders=len(rows),
+                ):
+                    fault_point(
+                        "coordinator.shard",
+                        index=self.index_name,
+                        shard=shard_idx,
+                    )
+                    cands, tot, tmo, errs = svc._batched_query_phase(
+                        [requests[i] for i in rows],
+                        [ks[i] for i in rows],
+                        stats,
+                        snapshots[shard_idx],
+                        [tasks[i] for i in rows],
+                    )
             except (ValueError, TypeError, TaskCancelledError):
                 raise
             except Exception as e:
@@ -479,16 +498,26 @@ class ShardedSearchCoordinator:
                     request, search_after=[after[0]], after_doc=after[1]
                 )
             try:
-                # Injectable per-shard failure / slow shard
-                # (faults/registry.py `coordinator.shard`).
-                fault_point(
+                # One span per shard scoring pass; an injected fault or
+                # launch failure marks it error (with injected_fault)
+                # while the scatter continues degraded.
+                with TRACER.span(
                     "coordinator.shard",
-                    index=self.index_name,
+                    task=task,
                     shard=shard_idx,
-                )
-                resp = svc.search(
-                    sub, stats=stats, segments=snapshots[shard_idx], task=task
-                )
+                    index=self.index_name,
+                ):
+                    # Injectable per-shard failure / slow shard
+                    # (faults/registry.py `coordinator.shard`).
+                    fault_point(
+                        "coordinator.shard",
+                        index=self.index_name,
+                        shard=shard_idx,
+                    )
+                    resp = svc.search(
+                        sub, stats=stats, segments=snapshots[shard_idx],
+                        task=task,
+                    )
             except (ValueError, TypeError, TaskCancelledError):
                 raise  # request-shaped / cancellation: never "a shard died"
             except Exception as e:

@@ -31,6 +31,7 @@ import numpy as np
 
 from ..faults import fault_point
 from ..index.engine import Engine, SegmentHandle
+from ..obs.tracing import TRACER
 from ..ops import bm25_device
 from ..query.compile import FieldStats
 from ..query.dsl import MatchAllQuery, Query, parse_query
@@ -100,6 +101,10 @@ class SearchResponse:
     # honestly 0 here; batch queue waits surface as p50/p99 percentiles
     # in `GET /_nodes/stats` under exec.batcher.
     breakdown: dict[str, Any] | None = None
+    # The same per-phase timings, collected on EVERY search (never
+    # serialized into the response): the slowlog reads them so slow-query
+    # lines carry a breakdown without the profile flag.
+    phases: dict[str, Any] | None = None
 
     def to_json(self, index_name: str = "index") -> dict[str, Any]:
         hits_obj: dict[str, Any] = {
@@ -376,6 +381,32 @@ class SearchRequest:
 
 _NO_SORT = object()  # sentinel: hit carries no sort values (default score sort)
 
+
+def sparse_family_key(spec) -> tuple | None:
+    """Coalescing family of a compiled sparse spec: same kind/field/
+    trailing shape, differing only in the nt bucket (spec[2]). Groups in
+    one family re-bucket to a common nt and share ONE padded launch
+    (_merge_term_groups); None for non-coalescible specs. bench.py uses
+    the same key so its padding_waste_pct mirrors what serving would pad.
+    """
+    if (
+        isinstance(spec, tuple)
+        and spec
+        and spec[0] in ("terms", "terms_gather")
+        and len(spec) == 4
+    ):
+        return (spec[0], spec[1], spec[3])
+    return None
+
+
+def family_padding_tiles(spec_rows) -> tuple[int, int]:
+    """(actual, padded) worklist tiles if the same-family groups in
+    `spec_rows` ([(spec, n_rows), ...]) coalesce to one nt_max launch."""
+    nt_max = max(s[2] for s, _ in spec_rows)
+    n_rows = sum(r for _, r in spec_rows)
+    actual = sum(s[2] * r for s, r in spec_rows)
+    return actual, nt_max * n_rows
+
 def _iso_millis(ms: float) -> str:
     """Epoch millis → the reference's strict_date_optional_time rendering."""
     from datetime import datetime, timezone
@@ -400,13 +431,20 @@ class SearchService:
     """Executes SearchRequests against one Engine (one shard)."""
 
     def __init__(
-        self, engine: Engine, index_name: str = "index", planner=None
+        self,
+        engine: Engine,
+        index_name: str = "index",
+        planner=None,
+        device=None,
     ):
         self.engine = engine
         self.index_name = index_name
         # exec.ExecPlanner: cost-based backend routing for the query
         # phase. None (the default) preserves the pure device path.
         self.planner = planner
+        # obs.DeviceInstruments: launch-site metrics (compile count/ms,
+        # H2D bytes, padding waste). None = uninstrumented.
+        self.device = device
 
     def search(
         self,
@@ -471,9 +509,21 @@ class SearchService:
                         timed_out = True
                         break
                 seg_t0 = time.monotonic_ns() if request.profile else 0
-                seg_total, backend = self._query_segment(
-                    handle, request, k, stats, candidates, timings=timings
-                )
+                # One leaf span per segment launch — the kernel-launch
+                # granularity the whole trace tree bottoms out at.
+                with TRACER.span(
+                    "search.segment",
+                    task=task,
+                    segment=seg_i,
+                    index=self.index_name,
+                    docs=handle.segment.num_docs,
+                ) as seg_span:
+                    seg_total, backend = self._query_segment(
+                        handle, request, k, stats, candidates,
+                        timings=timings,
+                    )
+                    if seg_span is not None:
+                        seg_span.tags["backend"] = backend
                 total += seg_total
                 if request.profile:
                     profile_segments.append(
@@ -492,32 +542,41 @@ class SearchService:
             total = agg_total
 
         reduce_t0 = time.monotonic()
-        candidates.sort(key=lambda c: (c[0], c[1]))
-        page = candidates[request.from_ : request.from_ + request.size]
+        with TRACER.span("search.reduce", task=task, candidates=len(candidates)):
+            candidates.sort(key=lambda c: (c[0], c[1]))
+            page = candidates[request.from_ : request.from_ + request.size]
 
-        hits = []
-        max_score = None
-        if request.sort is None and candidates:
-            max_score = -candidates[0][0]
-        hl_ctx = self._highlight_context(request)
-        for merge_key, global_doc, handle, local, score, sort_value in page:
-            hits.append(
-                SearchHit(
-                    doc_id=handle.segment.ids[local],
-                    score=score,
-                    source=self._fetch_source(handle, local, request),
-                    sort=None if sort_value is _NO_SORT else [sort_value],
-                    global_doc=global_doc,
-                    highlight=self._fetch_highlight(handle, local, hl_ctx),
-                    fields=self._fetch_fields(handle, local, request),
-                    handle=handle,
-                    local=local,
+            hits = []
+            max_score = None
+            if request.sort is None and candidates:
+                max_score = -candidates[0][0]
+            hl_ctx = self._highlight_context(request)
+            for merge_key, global_doc, handle, local, score, sort_value in page:
+                hits.append(
+                    SearchHit(
+                        doc_id=handle.segment.ids[local],
+                        score=score,
+                        source=self._fetch_source(handle, local, request),
+                        sort=None if sort_value is _NO_SORT else [sort_value],
+                        global_doc=global_doc,
+                        highlight=self._fetch_highlight(handle, local, hl_ctx),
+                        fields=self._fetch_fields(handle, local, request),
+                        handle=handle,
+                        local=local,
+                    )
                 )
-            )
         took = int((time.monotonic() - start) * 1000)
         total_out, relation = clamp_total(total, request.track_total_hits)
         profile = None
         breakdown = None
+        # Per-phase timings on EVERY search (the slowlog's breakdown);
+        # only profile: true serializes them into the response.
+        phases = {
+            "plan_ms": round(timings["plan_s"] * 1e3, 3),
+            "queue_ms": 0.0,
+            "execute_ms": round(timings["exec_s"] * 1e3, 3),
+            "reduce_ms": round((time.monotonic() - reduce_t0) * 1e3, 3),
+        }
         if request.profile:
             backends: dict[str, int] = {}
             for s in profile_segments:
@@ -552,14 +611,10 @@ class SearchService:
                     }
                 ]
             }
-            breakdown = {
-                "plan_ms": round(timings["plan_s"] * 1e3, 3),
-                # Profiled searches run unbatched (never queued); batch
-                # queue waits are in _nodes/stats exec.batcher p50/p99.
-                "queue_ms": 0.0,
-                "execute_ms": round(timings["exec_s"] * 1e3, 3),
-                "reduce_ms": round((time.monotonic() - reduce_t0) * 1e3, 3),
-            }
+            # Profiled searches run unbatched (never queued), so queue_ms
+            # is honestly 0; batch queue waits are in _nodes/stats
+            # exec.batcher p50/p99.
+            breakdown = dict(phases)
         return SearchResponse(
             took_ms=took,
             total=total_out,
@@ -570,6 +625,7 @@ class SearchService:
             timed_out=timed_out,
             profile=profile,
             breakdown=breakdown,
+            phases=phases,
         )
 
     # ------------------------------------------------- batched query phase
@@ -718,14 +774,21 @@ class SearchService:
         (bench.py's _compile_uniform trick, applied per batch)."""
         families: dict[tuple, list[tuple]] = {}
         for spec in list(groups):
-            if spec[0] in ("terms", "terms_gather") and len(spec) == 4:
-                families.setdefault(
-                    (spec[0], spec[1], spec[3]), []
-                ).append(spec)
+            fam = sparse_family_key(spec)
+            if fam is not None:
+                families.setdefault(fam, []).append(spec)
         for specs in families.values():
             if len(specs) < 2:
                 continue
             nt_max = max(s[2] for s in specs)
+            if self.device is not None:
+                # Padding waste of this coalesced family: every lane now
+                # launches at nt_max tiles regardless of what it needed.
+                self.device.padding(
+                    *family_padding_tiles(
+                        [(s, len(groups[s])) for s in specs]
+                    )
+                )
             merged_rows: list[int] = []
             for s in specs:
                 merged_rows.extend(groups.pop(s))
@@ -848,6 +911,8 @@ class SearchService:
         arrays_b = jax.tree.map(
             lambda *xs: np.stack(xs), *[compiled[i].arrays for i in rows]
         )
+        if self.device is not None:
+            self.device.h2d(arrays_b)
         kernel = (
             bm25_device.execute_batch_sparse
             if bm25_device.supports_sparse(spec)
@@ -855,6 +920,11 @@ class SearchService:
         )
         s_b, i_b, t_b = jax.device_get(kernel(seg_tree, spec, arrays_b, k_max))
         elapsed = time.monotonic() - t0
+        if self.device is not None:
+            kind = str(spec[0]) if isinstance(spec, tuple) and spec else "dense"
+            self.device.launch(
+                f"{kind}_batched", (spec, k_max, "device_batched"), elapsed
+            )
         for row, i in enumerate(rows):
             tot = int(t_b[row])
             nn = min(ks[i], tot, s_b.shape[1])
@@ -972,10 +1042,25 @@ class SearchService:
         if timings is not None:
             timings["plan_s"] += now - plan_t0
         exec_t0 = now
+        spec_kind = (
+            str(compiled.spec[0])
+            if isinstance(compiled.spec, tuple) and compiled.spec
+            else type(request.query).__name__
+        )
+        if self.device is not None:
+            # Host→device plan-array bytes this launch stages.
+            self.device.h2d(compiled.arrays)
 
         def done(total: int, backend: str = "device") -> tuple[int, str]:
+            elapsed = time.monotonic() - exec_t0
             if timings is not None:
-                timings["exec_s"] += time.monotonic() - exec_t0
+                timings["exec_s"] += elapsed
+            if self.device is not None and backend != "oracle":
+                # First launch of a new (spec, k, backend) shape is the
+                # XLA compile for its plan class.
+                self.device.launch(
+                    spec_kind, (compiled.spec, k, backend), elapsed
+                )
             return total, backend
 
         # Sort spec validity is enforced up front by _validate_sort.
@@ -1031,6 +1116,14 @@ class SearchService:
                 if self.planner is not None and not request.rescore:
                     backend, plan_class = self._decide_backend(
                         handle, request, compiled, k
+                    )
+                    # The routing decision, as a tagged event on the
+                    # enclosing segment span.
+                    TRACER.event(
+                        "planner.decision",
+                        backend=backend,
+                        plan_class=spec_kind,
+                        k=k,
                     )
                 kern_t0 = time.monotonic()
                 if backend == "oracle":

@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""CI smoke gate for the observability subsystem.
+
+Runs the distributed-tracing suite (one connected trace per search over a
+replicated multi-shard cluster, fault-tagged spans, Chrome/Perfetto
+export, cache-hit honesty, slowlog trace ids) plus the unified-metrics
+suite (registry migration parity for `_nodes/stats`, Prometheus
+exposition validity, histogram bucket invariants, device launch
+instruments), on the CPU backend — no TPU needed, < 30 s. The same tests
+ride the tier-1 run via the fast (`not slow`) marker; this script is the
+standalone hook for pre-merge / cron checks, mirroring
+scripts/check_chaos_smoke.py:
+
+    python scripts/check_obs_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "tests/test_obs_tracing.py",
+        "tests/test_obs_metrics.py",
+        "-q",
+        "-m",
+        "not slow",
+        "-p",
+        "no:cacheprovider",
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

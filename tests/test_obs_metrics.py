@@ -1,0 +1,433 @@
+"""Unified metrics registry (obs/metrics.py).
+
+Contracts: the registry migration keeps `_nodes/stats` backward
+compatible (same key sets/value semantics as the pre-migration counter
+dicts); `GET /_metrics` parses as valid Prometheus text exposition
+(cumulative histogram buckets, declared families); histogram bucket
+invariants hold; device-level instruments (compile count/ms, H2D bytes,
+padding waste) record at the launch sites.
+"""
+
+import json
+import re
+import threading
+
+import pytest
+
+from elasticsearch_tpu.faults import REGISTRY
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.obs.metrics import (
+    DeviceInstruments,
+    Histogram,
+    MetricsRegistry,
+)
+from elasticsearch_tpu.obs.tracing import TRACER
+from elasticsearch_tpu.rest.server import PlainText, RestServer
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    REGISTRY.clear()
+    TRACER.clear()
+    yield
+    REGISTRY.clear()
+    TRACER.clear()
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN))$"
+)
+_LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
+
+
+def parse_prometheus(text: str) -> dict:
+    """Strict-enough parser for the exposition format: returns
+    {family: {"type": kind, "samples": [(name, labels, value)]}} and
+    raises AssertionError on any malformed line."""
+    families: dict = {}
+    current = None
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, kind = rest.rsplit(" ", 1)
+            assert kind in ("counter", "gauge", "histogram"), line
+            assert name not in families, f"family declared twice: {name}"
+            families[name] = {"type": kind, "samples": []}
+            current = name
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name = m.group("name")
+        labels = {}
+        if m.group("labels"):
+            for pair in re.split(r",(?=[a-zA-Z_])", m.group("labels")):
+                assert _LABEL_RE.match(pair), f"bad label pair {pair!r}"
+                k, _, v = pair.partition("=")
+                labels[k] = v.strip('"')
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in families:
+                base = name[: -len(suffix)]
+        assert base in families, f"sample before TYPE: {line!r}"
+        assert current is not None
+        value = float(m.group("value").replace("Inf", "inf"))
+        families[base]["samples"].append((name, labels, value))
+    return families
+
+
+def assert_histogram_series_valid(families: dict, family: str) -> None:
+    """Cumulative non-decreasing buckets; +Inf bucket == count."""
+    entry = families[family]
+    assert entry["type"] == "histogram"
+    by_labels: dict = {}
+    for name, labels, value in entry["samples"]:
+        key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+        slot = by_labels.setdefault(
+            key, {"buckets": [], "sum": None, "count": None}
+        )
+        if name.endswith("_bucket"):
+            slot["buckets"].append((labels["le"], value))
+        elif name.endswith("_sum"):
+            slot["sum"] = value
+        elif name.endswith("_count"):
+            slot["count"] = value
+    assert by_labels
+    for slot in by_labels.values():
+        assert slot["buckets"], slot
+        values = [v for _, v in slot["buckets"]]
+        assert values == sorted(values), "buckets must be cumulative"
+        assert slot["buckets"][-1][0] == "+Inf"
+        assert slot["buckets"][-1][1] == slot["count"]
+
+
+class TestRegistryPrimitives:
+    def test_counter_monotonic(self):
+        reg = MetricsRegistry()
+        c = reg.counter("estpu_test_total", "t", kind="a")
+        c.inc()
+        c.inc(2.5)
+        assert reg.value("estpu_test_total", kind="a") == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        # Same (name, labels) returns the same instrument.
+        assert reg.counter("estpu_test_total", kind="a") is c
+
+    def test_kind_conflict_rejected(self):
+        reg = MetricsRegistry()
+        reg.counter("estpu_x_total")
+        with pytest.raises(ValueError):
+            reg.gauge("estpu_x_total")
+
+    def test_invalid_names_rejected(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("bad-name")
+        with pytest.raises(ValueError):
+            reg.counter("ok_name", **{"bad-label": 1})
+
+    def test_histogram_bucket_invariants(self):
+        h = Histogram((1.0, 2.0, 4.0))
+        for v in (0.5, 1.0, 1.5, 3.0, 100.0):
+            h.observe(v)
+        snap = h.snapshot()
+        # Per-bucket counts + inf == count; sum is the observation sum.
+        assert sum(snap["buckets"].values()) + snap["inf"] == snap["count"]
+        assert snap["count"] == 5
+        assert snap["buckets"] == {"1": 2, "2": 1, "4": 1}
+        assert snap["inf"] == 1
+        assert snap["sum"] == pytest.approx(106.0)
+        with pytest.raises(ValueError):
+            Histogram(())
+        with pytest.raises(ValueError):
+            Histogram((1.0, 1.0))
+
+    def test_histogram_exposition_cumulative(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("estpu_test_hist", (1.0, 2.0), "t")
+        for v in (0.5, 1.5, 9.0):
+            h.observe(v)
+        families = parse_prometheus(reg.exposition())
+        assert_histogram_series_valid(families, "estpu_test_hist")
+
+    def test_gauge_callback(self):
+        reg = MetricsRegistry()
+        state = {"v": 1}
+        reg.gauge("estpu_test_gauge", fn=lambda: state["v"])
+        assert reg.value("estpu_test_gauge") == 1
+        state["v"] = 7
+        assert reg.value("estpu_test_gauge") == 7
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("estpu_esc_total", label='a"b\\c\nd').inc()
+        text = reg.exposition()
+        assert '\\"' in text and "\\\\" in text and "\\n" in text
+        parse_prometheus(text)
+
+    def test_merged_exposition_sums_collisions(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("estpu_m_total", kind="x").inc(2)
+        b.counter("estpu_m_total", kind="x").inc(3)
+        b.counter("estpu_m_total", kind="y").inc(1)
+        families = parse_prometheus(a.exposition(b))
+        samples = {
+            tuple(sorted(lbl.items())): v
+            for _n, lbl, v in families["estpu_m_total"]["samples"]
+        }
+        assert samples[(("kind", "x"),)] == 5
+        assert samples[(("kind", "y"),)] == 1
+
+    def test_thread_safety_under_contention(self):
+        reg = MetricsRegistry()
+        c = reg.counter("estpu_tsafe_total")
+        h = reg.histogram("estpu_tsafe_hist", (1.0, 10.0))
+
+        def spin():
+            for _ in range(500):
+                c.inc()
+                h.observe(0.5)
+
+        threads = [threading.Thread(target=spin) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 4000
+        assert h.snapshot()["count"] == 4000
+
+
+class TestDeviceInstruments:
+    def test_first_launch_counts_as_compile(self):
+        reg = MetricsRegistry()
+        dev = DeviceInstruments(reg)
+        dev.launch("terms", ("spec-a", 10), 0.25)
+        dev.launch("terms", ("spec-a", 10), 0.001)  # warm: no new compile
+        dev.launch("terms", ("spec-b", 10), 0.10)
+        assert dev.compile_count() == 2
+        assert dev.compile_ms_total() == pytest.approx(350.0)
+        snap = dev.snapshot()
+        assert snap["launches_by_plan_class"] == {"terms": 3}
+        assert snap["compiles_by_plan_class"] == {"terms": 2}
+
+    def test_padding_waste_pct(self):
+        reg = MetricsRegistry()
+        dev = DeviceInstruments(reg)
+        dev.padding(actual_tiles=6, padded_tiles=8)
+        dev.padding(actual_tiles=8, padded_tiles=8)
+        assert dev.padding_waste_pct() == pytest.approx(12.5)
+        families = parse_prometheus(reg.exposition())
+        assert_histogram_series_valid(
+            families, "estpu_device_padding_waste_ratio"
+        )
+
+    def test_h2d_bytes(self):
+        import numpy as np
+
+        reg = MetricsRegistry()
+        dev = DeviceInstruments(reg)
+        dev.h2d({"a": np.zeros(8, np.float32), "b": np.zeros(4, np.int32)})
+        assert reg.value("estpu_device_h2d_bytes_total") == 48
+
+
+class TestNodeStatsMigration:
+    """`_nodes/stats` stays backward compatible after the counter dicts
+    moved onto the registry: same key sets as the seed shapes, counters
+    behave identically."""
+
+    @pytest.fixture
+    def node(self, monkeypatch):
+        monkeypatch.setenv("ESTPU_MESH_SERVING", "0")
+        node = Node()
+        node.create_index(
+            "m", {"mappings": {"properties": {"b": {"type": "text"}}}}
+        )
+        for i in range(8):
+            node.index_doc("m", {"b": f"alpha w{i % 2}"}, f"d{i}")
+        node.refresh("m")
+        return node
+
+    def test_request_cache_stats_shape_and_behavior(self, node):
+        body = {"query": {"match": {"b": "alpha"}}, "size": 0}
+        node.search("m", dict(body))
+        node.search("m", dict(body))
+        stats = node.nodes_stats()["nodes"][node.node_name]
+        rc = stats["indices"]["request_cache"]
+        assert set(rc) == {"entries", "hit_count", "miss_count", "evictions"}
+        assert rc["hit_count"] == 1
+        assert rc["miss_count"] == 1
+        assert rc["entries"] == 1
+
+    def test_exec_sections_keep_seed_shape(self, node):
+        node.search("m", {"query": {"match": {"b": "alpha"}}})
+        stats = node.nodes_stats()["nodes"][node.node_name]
+        batcher = stats["exec"]["batcher"]
+        assert {
+            "max_wait_ms", "batches", "requests", "coalesced_requests",
+            "occupancy_histogram", "queue_cancellations", "rejected",
+            "queued", "retried_individually", "groups_quarantined",
+            "quarantine_hits", "quarantined_now", "queue_wait_p50_ms",
+            "queue_wait_p99_ms",
+        } <= set(batcher)
+        assert batcher["requests"] >= 1
+        assert batcher["batches"] >= 1
+        # Occupancy view: pow-2 string buckets, counts sum to batches.
+        occ = batcher["occupancy_histogram"]
+        assert all(k.isdigit() for k in occ)
+        assert sum(occ.values()) == batcher["batches"]
+        planner = stats["exec"]["planner"]
+        assert set(planner) == {"decisions", "ewma"}
+        from elasticsearch_tpu.exec import ExecPlanner
+
+        assert set(planner["decisions"]) >= set(ExecPlanner.BACKENDS)
+
+    def test_search_resilience_keys_and_faults(self, node):
+        stats = node.nodes_stats()["nodes"][node.node_name]
+        assert set(stats["search_resilience"]) == {
+            "partial_responses",
+            "shard_failures",
+            "search_phase_failures",
+            "batcher",
+        }
+        assert stats["faults"] == REGISTRY.stats()
+        # New sections are additive, never replacing seed keys.
+        assert "device" in stats and "obs" in stats
+
+    def test_resilience_counters_still_count(self, node, monkeypatch):
+        from elasticsearch_tpu.faults import FaultSpec
+
+        REGISTRY.put(FaultSpec(site="search.kernel", error_rate=1.0))
+        with pytest.raises(Exception):
+            node.search(
+                "m",
+                {"query": {"match": {"b": "alpha"}}, "profile": True},
+            )
+        REGISTRY.clear()
+        assert node.search_resilience["search_phase_failures"] >= 1
+
+
+class TestMetricsEndpoint:
+    def test_metrics_endpoint_parses_as_prometheus(self, monkeypatch):
+        monkeypatch.setenv("ESTPU_MESH_SERVING", "0")
+        rest = RestServer()
+        rest.dispatch(
+            "PUT",
+            "/p",
+            {},
+            json.dumps(
+                {"mappings": {"properties": {"b": {"type": "text"}}}}
+            ),
+        )
+        rest.dispatch(
+            "PUT", "/p/_doc/1", {}, json.dumps({"b": "alpha beta"})
+        )
+        rest.dispatch("POST", "/p/_refresh", {}, "")
+        rest.dispatch(
+            "POST",
+            "/p/_search",
+            {},
+            json.dumps({"query": {"match": {"b": "alpha"}}}),
+        )
+        status, payload = rest.dispatch("GET", "/_metrics", {}, "")
+        assert status == 200
+        assert isinstance(payload, PlainText)
+        assert payload.content_type.startswith("text/plain")
+        families = parse_prometheus(payload.text)
+        assert "estpu_exec_batcher_requests_total" in families
+        assert "estpu_request_cache_misses_total" in families
+        assert "estpu_exec_planner_decisions_total" in families
+        assert "estpu_search_resilience_total" in families
+        assert "estpu_faults_armed" in families
+        assert_histogram_series_valid(
+            families, "estpu_exec_batcher_occupancy"
+        )
+
+    def test_replicated_metrics_merge_gateway_and_cluster(self, monkeypatch):
+        monkeypatch.setenv("ESTPU_MESH_SERVING", "0")
+        rest = RestServer(replication_nodes=3)
+        try:
+            rest.dispatch(
+                "PUT",
+                "/r",
+                {},
+                json.dumps(
+                    {
+                        "settings": {
+                            "index": {
+                                "number_of_shards": 2,
+                                "number_of_replicas": 1,
+                            }
+                        },
+                        "mappings": {
+                            "properties": {"b": {"type": "text"}}
+                        },
+                    }
+                ),
+            )
+            rest.dispatch(
+                "PUT", "/r/_doc/1", {}, json.dumps({"b": "alpha"})
+            )
+            rest.dispatch("POST", "/r/_refresh", {}, "")
+            status, _ = rest.dispatch(
+                "POST",
+                "/r/_search",
+                {},
+                json.dumps({"query": {"match": {"b": "alpha"}}}),
+            )
+            assert status == 200
+            status, payload = rest.dispatch("GET", "/_metrics", {}, "")
+            assert status == 200
+            families = parse_prometheus(payload.text)
+            gw = {
+                lbl["op"]: v
+                for _n, lbl, v in families[
+                    "estpu_replication_gateway_total"
+                ]["samples"]
+            }
+            assert gw["searches"] >= 1
+            cluster = families["estpu_cluster_search_resilience_total"]
+            nodes = {lbl["node"] for _n, lbl, v in cluster["samples"]}
+            assert len(nodes) == 3
+            # The exposition view and the _nodes/stats view read the SAME
+            # counters.
+            status, stats = rest.dispatch("GET", "/_nodes/stats", {}, "")
+            node_stats = next(iter(stats["nodes"].values()))
+            assert node_stats["replication"]["searches"] == int(
+                gw["searches"]
+            )
+        finally:
+            rest.close()
+
+    def test_device_metrics_flow_to_bench_fields(self, monkeypatch):
+        """The same registry fields bench.py emits: compile_count,
+        compile_ms_total, padding_waste_pct."""
+        monkeypatch.setenv("ESTPU_MESH_SERVING", "0")
+        monkeypatch.setenv("ESTPU_EXEC_PLANNER", "0")
+        node = Node()
+        node.create_index(
+            "d", {"mappings": {"properties": {"b": {"type": "text"}}}}
+        )
+        for i in range(6):
+            node.index_doc("d", {"b": f"alpha w{i % 2}"}, f"d{i}")
+        node.refresh("d")
+        node.search(
+            "d", {"query": {"match": {"b": "alpha"}}, "profile": True}
+        )
+        node.search(
+            "d", {"query": {"match": {"b": "alpha"}}, "profile": True}
+        )
+        dev = node.nodes_stats()["nodes"][node.node_name]["device"]
+        assert dev["compile_count"] >= 1
+        assert dev["compile_ms_total"] > 0
+        assert (
+            sum(dev["launches_by_plan_class"].values())
+            > dev["compile_count"] - 1
+        )
+        assert dev["h2d_bytes_total"] > 0
+        assert 0.0 <= dev["padding_waste_pct"] <= 100.0
