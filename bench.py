@@ -163,22 +163,19 @@ def _seq_p50(run, n_queries: int, reps: int = 3) -> float:
     return float(np.median(times)) / n_queries
 
 
-def _compile_uniform(devs, mappings, query, nt_floor: int = 1):
-    """Compile one query against every shard with ONE common spec."""
-    from elasticsearch_tpu.parallel.sharded import _max_nt
-    from elasticsearch_tpu.query.compile import Compiler
+def _compile_uniform(devs, mappings, query):
+    """Compile one query against every shard with ONE common spec —
+    per-node-position equalization (each clause's bucket rises only to
+    ITS cross-shard max; the old single global floor let cfg3's high-df
+    filter term inflate the must worklist 4-16x, the BENCH_r05 0.07x)."""
+    from elasticsearch_tpu.query.compile import Compiler, equalize_compiled
 
-    def compile_all(floor):
-        return [
-            Compiler(d.fields, d.doc_values, mappings, nt_floor=floor).compile(
-                query
-            )
+    compiled = equalize_compiled(
+        [
+            Compiler(d.fields, d.doc_values, mappings).compile(query)
             for d in devs
         ]
-
-    compiled = compile_all(nt_floor)
-    if len({c.spec for c in compiled}) != 1:
-        compiled = compile_all(max(_max_nt(c.spec) for c in compiled))
+    )
     assert len({c.spec for c in compiled}) == 1
     return compiled
 
@@ -305,32 +302,103 @@ def bench_cfg3_conjunction(n_shards=8, shard_docs=125_000, n_q=32):
             )
         )
 
-    per_query = [_compile_uniform(devs, mappings, q) for q in queries]
-    specs = {c[0].spec for c in per_query}
-    if len(specs) != 1:
-        from elasticsearch_tpu.parallel.sharded import _max_nt
-
-        floor = max(_max_nt(c[0].spec) for c in per_query)
-        per_query = [
-            _compile_uniform(devs, mappings, q, nt_floor=floor) for q in queries
-        ]
-    spec = per_query[0][0].spec
-    assert len({c[0].spec for c in per_query}) == 1
-    shard_stacked = [
-        jax.tree.map(lambda *xs: np.stack(xs), *[c.arrays for c in cs])
-        for cs in per_query
-    ]
-    batched = jax.tree.map(lambda *xs: np.stack(xs), *shard_stacked)
-    batched = jax.tree.map(jax.device_put, batched)
-
-    s_b, g_b, t_b = jax.device_get(
-        bm25_device.execute_shards_sequential(
-            stacked, spec, batched, K, shard_docs
-        )
+    from elasticsearch_tpu.exec.batcher import plan_spec_buckets
+    from elasticsearch_tpu.exec.planner import spec_work_tiles
+    from elasticsearch_tpu.obs.metrics import (
+        DeviceInstruments,
+        MetricsRegistry,
     )
+    from elasticsearch_tpu.parallel.sharded import _max_nt
+    from elasticsearch_tpu.query.compile import (
+        Compiler,
+        CompiledQuery,
+        equalize_compiled,
+        pad_arrays_to_spec,
+        unify_specs,
+    )
+
+    # Per-query compile: per-node-position equalization across shards only
+    # (no cross-query floor). Natural per-(query, shard) specs feed the
+    # padding accounting below.
+    naturals: list[list[tuple]] = []
+    per_query: list = []
+    for query in queries:
+        cs = [
+            Compiler(d.fields, d.doc_values, mappings).compile(query)
+            for d in devs
+        ]
+        naturals.append([c.spec for c in cs])
+        cs = equalize_compiled(cs)
+        arrays = jax.tree.map(
+            lambda *xs: np.stack(xs), *[c.arrays for c in cs]
+        )
+        per_query.append(CompiledQuery(spec=cs[0].spec, arrays=arrays))
+
+    # Adaptive worklist sub-buckets: queries pad only to their own bucket,
+    # one launch per bucket (exec/batcher.plan_spec_buckets cost rule) —
+    # the single-nt_floor replacement that kills the batched-worse-than-
+    # sequential inversion.
+    by_spec: dict[tuple, list[int]] = {}
+    for pos, c in enumerate(per_query):
+        by_spec.setdefault(c.spec, []).append(pos)
+    buckets = []  # (spec, positions, device arrays [Qb, S, ...], host arrays)
+    for bucket_specs in plan_spec_buckets(
+        list(by_spec.items()), n_shards=n_shards
+    ):
+        positions = [p for s in bucket_specs for p in by_spec[s]]
+        target = unify_specs(list(bucket_specs))
+        host_rows = [
+            pad_arrays_to_spec(per_query[p].spec, target, per_query[p].arrays)
+            for p in positions
+        ]
+        arrs = jax.tree.map(lambda *xs: np.stack(xs), *host_rows)
+        buckets.append(
+            (target, positions, jax.tree.map(jax.device_put, arrs), host_rows)
+        )
+
+    # Padding accounting via the obs registry instrument: the adaptive
+    # sub-bucket scheme vs the old single group-wide nt_floor baseline.
+    actual_tiles = sum(
+        spec_work_tiles(s) for specs in naturals for s in specs
+    )
+    adaptive_padded = sum(
+        spec_work_tiles(spec) * n_shards * len(positions)
+        for spec, positions, _a, _h in buckets
+    )
+    floor = max(_max_nt(s) for specs in naturals for s in specs)
+    floor_padded = sum(
+        spec_work_tiles(s, floor) for specs in naturals for s in specs
+    )
+    registry = MetricsRegistry()
+    instr = DeviceInstruments(registry)
+    instr.padding(actual_tiles, adaptive_padded)
+    floor_instr = DeviceInstruments(MetricsRegistry())
+    floor_instr.padding(actual_tiles, floor_padded)
+
+    def run_sequential():
+        outs = []
+        for spec, _pos, arrs, _h in buckets:
+            outs.append(
+                bm25_device.execute_shards_sequential(
+                    stacked, spec, arrs, K, shard_docs
+                )
+            )
+            jax.block_until_ready(outs[-1])  # scans must not overlap
+        return outs
+
+    seq_outs = run_sequential()
+    s_b = np.empty((n_q, K), np.float32)
+    g_b = np.empty((n_q, K), np.int64)
+    t_b = np.empty(n_q, np.int64)
+    for (spec, positions, _a, _h), out in zip(buckets, seq_outs):
+        s_o, g_o, t_o = jax.device_get(out)
+        for row, p in enumerate(positions):
+            s_b[p], g_b[p], t_b[p] = s_o[row], g_o[row], t_o[row]
+
     # Parity + oracle timing: per-shard CPU search, host merge.
     mismatches = 0
     oracle_times = []
+    oracle_top = []
     oracles = [OracleSearcher(s, mappings) for s in shards]
     for qi, query in enumerate(queries):
         t0 = time.monotonic()
@@ -345,38 +413,81 @@ def bench_cfg3_conjunction(n_shards=8, shard_docs=125_000, n_q=32):
         oracle_times.append(time.monotonic() - t0)
         top = rows[:K]
         gids = [sh * shard_docs + d for _, sh, d, _ in top]
-        n = len(top)
-        ok = ranked_match(
-            g_b[qi], s_b[qi], gids, np.array([r[3] for r in top], np.float32)
-        ) and int(t_b[qi]) == o_total
+        o_scores = np.array([r[3] for r in top], np.float32)
+        oracle_top.append((gids, o_scores, o_total))
+        ok = ranked_match(g_b[qi], s_b[qi], gids, o_scores) and int(
+            t_b[qi]
+        ) == o_total
         if not ok:
             mismatches += 1
-    p50 = _seq_p50(
-        lambda: bm25_device.execute_shards_sequential(
-            stacked, spec, batched, K, shard_docs
-        ),
-        n_q,
-    )
-    # Batched (msearch) amortized throughput for the same workload.
-    jax.block_until_ready(
-        bm25_device.execute_shards_batch(stacked, spec, batched, K, shard_docs)
-    )
+    p50 = _seq_p50(run_sequential, n_q)
+
+    # Batched (msearch) amortized throughput: one launch per sub-bucket.
+    def run_batched():
+        outs = [
+            bm25_device.execute_shards_batch(
+                stacked, spec, arrs, K, shard_docs
+            )
+            for spec, _pos, arrs, _h in buckets
+        ]
+        jax.block_until_ready(outs)
+        return outs
+
+    run_batched()  # compile
     t0 = time.monotonic()
     for _ in range(3):
-        jax.block_until_ready(
-            bm25_device.execute_shards_batch(
-                stacked, spec, batched, K, shard_docs
-            )
-        )
+        run_batched()
     batched_per_query = (time.monotonic() - t0) / (3 * n_q)
+
+    # Two-phase block-max conjunction (tile pruning against the running
+    # top-k floor; exact top-10, "gte" totals). Buckets whose spec is
+    # filter-led (lead >= 0) have no sort to prune and run the plain
+    # batch kernel — that IS their fast path.
+    def run_blockmax(collect=None):
+        for spec, positions, arrs, host_rows in buckets:
+            if bm25_device.supports_blockmax_conj(spec):
+                s, g, t, _rel = bm25_device.execute_shards_blockmax_conj(
+                    stacked, spec, host_rows, K, shard_docs,
+                    instruments=instr if collect is not None else None,
+                )
+            else:
+                s, g, t = jax.device_get(
+                    bm25_device.execute_shards_batch(
+                        stacked, spec, arrs, K, shard_docs
+                    )
+                )
+            if collect is not None:
+                for row, p in enumerate(positions):
+                    collect[p] = (s[row], g[row], int(t[row]))
+
+    bm_results: dict[int, tuple] = {}
+    run_blockmax(collect=bm_results)
+    bm_mismatches = 0
+    for qi in range(n_q):
+        gids, o_scores, o_total = oracle_top[qi]
+        s, g, t = bm_results[qi]
+        if not ranked_match(g, s, gids, o_scores) or t > o_total:
+            bm_mismatches += 1
+    t0 = time.monotonic()
+    for _ in range(3):
+        run_blockmax()
+    blockmax_per_query = (time.monotonic() - t0) / (3 * n_q)
+
     o_p50 = float(np.median(oracle_times))
     speedup = (o_p50 / p50) if p50 > 0 and not mismatches else 0.0
+    prune = instr.snapshot()["blockmax_pruned_tile_fraction"]
     return {
         "speedup": round(speedup, 2),
         "device_p50_ms": round(p50 * 1e3, 4),
         "device_batched_per_query_ms": round(batched_per_query * 1e3, 4),
+        "blockmax_conj_per_query_ms": round(blockmax_per_query * 1e3, 4),
+        "blockmax_conj_mismatches": bm_mismatches,
+        "blockmax_pruned_tile_fraction_mean": prune["mean"],
         "oracle_p50_ms": round(o_p50 * 1e3, 4),
         "mismatches": mismatches,
+        "n_launch_buckets": len(buckets),
+        "padding_waste_pct": instr.padding_waste_pct(),
+        "padding_waste_single_floor_pct": floor_instr.padding_waste_pct(),
         "n_shards": n_shards,
         "n_docs": n_shards * shard_docs,
         "n_queries": n_q,
@@ -856,8 +967,10 @@ def main():
     configs["cfg2_disjunction"] = {
         "speedup": round(speedup_single, 2),
         "device_p50_ms": round(single_p50 * 1e3, 4),
+        "device_batched_per_query_ms": round(device_per_query * 1e3, 4),
         "oracle_p50_ms": round(o_p50 * 1e3, 3),
         "mismatches": mismatches + seq_mismatches,
+        "padding_waste_pct": device_instr.padding_waste_pct(),
         "n_docs": N_DOCS,
         "n_queries": N_QUERIES,
     }
@@ -881,6 +994,13 @@ def main():
             # lower bound on its solo latency, so if it loses here it
             # loses solo too (it does: two launches beat nothing at 1M).
             measured["blockmax"] = round(blockmax_per_query * 1e3, 4)
+        if (
+            name == "cfg3_conj"
+            and cfg.get("blockmax_conj_per_query_ms")
+            and cfg.get("blockmax_conj_mismatches") == 0
+        ):
+            # Same caveat: batch-amortized lower bound on solo latency.
+            measured["blockmax_conj"] = cfg["blockmax_conj_per_query_ms"]
         plan_class = ("bench", name)
         for backend, ms in measured.items():
             for _ in range(planner.MIN_OBS):
@@ -897,6 +1017,27 @@ def main():
         ("error" not in c) and c.get("mismatches") == 0
         for c in configs.values()
     )
+
+    # Batched-vs-sequential inversion flag: a config whose coalesced batch
+    # costs MORE per query than strictly-sequential execution means launch
+    # padding is eating the amortization — BENCH_r05 shipped a silent 7x
+    # inversion on cfg3; make it impossible to miss in future rounds.
+    import sys
+
+    batched_inversions = []
+    for name, cfg in configs.items():
+        b = cfg.get("device_batched_per_query_ms")
+        s = cfg.get("device_p50_ms")
+        if b and s and b > s:
+            batched_inversions.append(name)
+            print(
+                f"WARNING: {name}: batched per-query {b} ms exceeds "
+                f"sequential {s} ms — coalesced-launch padding is hurting "
+                f"(padding_waste_pct="
+                f"{cfg.get('padding_waste_pct', 'n/a')})",
+                file=sys.stderr,
+                flush=True,
+            )
 
     print(
         json.dumps(
@@ -932,6 +1073,7 @@ def main():
                 ),
                 "configs": configs,
                 "configs_parity_ok": configs_parity_ok,
+                "batched_inversions": batched_inversions,
                 "parity": "ids+order+fp32_scores+totals",
                 "n_spec_groups": len(groups),
                 "corpus_build_s": round(build_s, 1),

@@ -57,6 +57,59 @@ from ..obs.tracing import TRACER
 _NO_RETRY_ERRORS = (TaskCancelledError, ValueError, TypeError)
 
 
+def plan_spec_buckets(spec_rows, n_shards: int = 1) -> list[tuple]:
+    """Adaptive worklist sub-bucketing for coalesced launches.
+
+    `spec_rows`: [(compiled spec, row count or row list)] — the same-spec
+    groups of a batch. Returns a list of buckets (tuples of specs); each
+    bucket shares ONE padded launch at its per-position-max bucket, the
+    rest launch separately. Greedy largest-first: a smaller group joins a
+    bucket only when (a) its spec unifies with the bucket's (structural
+    compatibility) and (b) the padding tiles it would pay cost less than
+    the launch it saves (exec/cost.coalesce_wins seeds). This replaces
+    the unconditional pad-everything-to-the-group-max policy whose
+    padding made batched execution slower than sequential for skewed
+    worklists (BENCH_r05 cfg3's 7x inversion).
+    """
+    from ..query.compile import SpecUnifyError, unify_specs
+    from .cost import coalesce_wins
+    from .planner import spec_work_tiles
+
+    items = []
+    for spec, rows in spec_rows:
+        n = rows if isinstance(rows, int) else len(rows)
+        items.append((spec_work_tiles(spec), spec, max(1, n)))
+    items.sort(key=lambda it: -it[0])
+    # Each bucket: [target_spec, target_tiles, total_rows, [member specs]]
+    buckets: list[list] = []
+    for tiles, spec, n in items:
+        placed = False
+        for b in buckets:
+            try:
+                target = unify_specs([b[0], spec])
+            except SpecUnifyError:
+                continue
+            # Price the merge against the UNIFIED target: per-position
+            # maxima can exceed both inputs' totals, and existing bucket
+            # members pay any growth too — all of that padding must beat
+            # the one launch the merge saves.
+            t_tiles = spec_work_tiles(target)
+            extra = ((t_tiles - b[1]) * b[2] + (t_tiles - tiles) * n) * max(
+                1, n_shards
+            )
+            if not coalesce_wins(extra):
+                continue
+            b[0] = target
+            b[1] = t_tiles
+            b[2] += n
+            b[3].append(spec)
+            placed = True
+            break
+        if not placed:
+            buckets.append([spec, tiles, n, [spec]])
+    return [tuple(b[3]) for b in buckets]
+
+
 @dataclass
 class _Pending:
     searcher: object

@@ -55,6 +55,16 @@ _ORACLE_POSTING_MS = 0.000004  # per posting touched (scatter-add share)
 _ORACLE_TOPK_MS = 0.000025  # per corpus doc (lexsort/top-k share)
 
 
+def coalesce_wins(extra_pad_tiles: int) -> bool:
+    """Should a smaller worklist group share a larger bucket's coalesced
+    launch? True when the padding work it would add (seed per-tile cost)
+    costs less than the launch dispatch it saves — the single decision
+    rule behind adaptive sub-bucket splitting (exec/batcher.
+    plan_spec_buckets), replacing the unconditional pad-to-group-max that
+    made BENCH_r05's cfg3 batched execution slower than sequential."""
+    return _DEVICE_TILE_MS * max(0, extra_pad_tiles) <= _DEVICE_LAUNCH_MS
+
+
 def seed_ms(backend: str, feats: PlanFeatures) -> float:
     """Closed-form prior cost (ms) for one query on one backend."""
     shards = max(1, feats.n_shards)
@@ -64,7 +74,9 @@ def seed_ms(backend: str, feats: PlanFeatures) -> float:
             + _ORACLE_POSTING_MS * feats.work_tiles * 256.0
             + _ORACLE_TOPK_MS * feats.n_docs
         )
-    if backend == "blockmax":
+    if backend in ("blockmax", "blockmax_conj"):
+        # Both two-phase tile-pruned paths: two launches + a host prune,
+        # with roughly half the worklist surviving to the exact launch.
         return (
             _BLOCKMAX_LAUNCH_MS
             + _DEVICE_TILE_MS * feats.work_tiles * 0.5 * shards

@@ -113,18 +113,21 @@ def ast_signature(query: Query) -> tuple:
     return (type(query).__name__,)
 
 
-def spec_work_tiles(spec: tuple) -> int:
+def spec_work_tiles(spec: tuple, floor: int = 0) -> int:
     """Total worklist tiles a compiled spec gathers (the sparse-path work
-    proxy; 0 for dense-only shapes, whose cost scales with the corpus)."""
+    proxy; 0 for dense-only shapes, whose cost scales with the corpus).
+    `floor` raises every node's bucket to at least that value — the
+    accounting measure for the old single group-wide nt_floor policy
+    (bench.py's padding-waste baseline)."""
     if not isinstance(spec, tuple) or not spec:
         return 0
     if spec[0] in _TERMS_KINDS:
-        return int(spec[2])
+        return max(int(spec[2]), floor)
     if spec[0] == "bool":
         total = 0
         for group in spec[1:5]:
             for child in group:
-                total += spec_work_tiles(child)
+                total += spec_work_tiles(child, floor)
         return total
     return 0
 
@@ -133,7 +136,14 @@ class ExecPlanner:
     """Backend decisions + counters for one node's query executions."""
 
     MIN_OBS = 2  # explorations per (class, backend) before exploiting
-    BACKENDS = ("device", "blockmax", "oracle", "device_batched", "mesh_spmd")
+    BACKENDS = (
+        "device",
+        "blockmax",
+        "blockmax_conj",
+        "oracle",
+        "device_batched",
+        "mesh_spmd",
+    )
 
     def __init__(self, cost_model: CostModel | None = None, metrics=None):
         self.cost = cost_model or CostModel()

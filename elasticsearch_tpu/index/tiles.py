@@ -91,6 +91,14 @@ class DeviceField:
     # (reference behavior: Lucene block-max WAND skipping enabled by
     # search/query/TopDocsCollectorContext.java:68).
     tile_max: np.ndarray | None = None
+    # Host-side per-tile doc-id extrema (padding sentinels == num_docs
+    # only widen the max, keeping bounds conservative): the plan-time
+    # bounds for conjunction doc-range pruning — a must tile whose
+    # [lo, hi] cannot intersect the doc range a single-span filter bounds
+    # is dropped at compile time, exactly (query/compile._terms_arrays).
+    # The analog of Lucene's per-block min/max docID skip data.
+    tile_doc_lo: np.ndarray | None = None
+    tile_doc_hi: np.ndarray | None = None
     device: Any = None  # placement used at pack time (repacks must match)
     # Global ordinals plane for keyword fields (terms aggregations): term id
     # owning each posting position, same [NT, TILE] layout, sentinel = T for
@@ -240,6 +248,9 @@ def pack_field(
     norm_ext = np.zeros(num_docs + 1, dtype=np.uint8)
     norm_ext[: len(field.norm_bytes)] = field.norm_bytes
     tile_max = tn.reshape(-1, TILE).max(axis=1)
+    doc_tiles_host = doc_ids.reshape(-1, TILE)
+    tile_doc_lo = doc_tiles_host.min(axis=1)
+    tile_doc_hi = doc_tiles_host.max(axis=1)
     put = lambda x: jax.device_put(x, device)
     pos_doc = pos_val = None
     pos_offsets_host = None
@@ -286,6 +297,8 @@ def pack_field(
         tn_k1=k1,
         tn_b=b,
         tile_max=tile_max,
+        tile_doc_lo=tile_doc_lo,
+        tile_doc_hi=tile_doc_hi,
         device=device,
         ord_terms=ord_terms,
         pos_doc=pos_doc,
@@ -314,6 +327,18 @@ def repack_tn(
     dfield.tn_avgdl = float(avgdl)
     dfield.tn_k1 = k1
     dfield.tn_b = b
+
+
+def tile_doc_bounds(
+    doc_ids: np.ndarray, num_docs: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-tile (min, max) doc id over a host postings array, padded the
+    way pack_field pads (sentinel num_docs; bounds stay conservative).
+    The host-side planning twin of DeviceField.tile_doc_lo/hi for paths
+    that never pack a DeviceField (the sharded compiler's _PlanField)."""
+    padded = _pad_to_tile(doc_ids.astype(np.int32), np.int32(num_docs))
+    tiles = padded.reshape(-1, TILE)
+    return tiles.min(axis=1), tiles.max(axis=1)
 
 
 def _fit_bool(present: np.ndarray, norm_bytes: np.ndarray, num_docs: int) -> np.ndarray:

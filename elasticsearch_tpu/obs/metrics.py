@@ -329,6 +329,8 @@ class MetricsRegistry:
 
 # Pow-2-ish bounds for the padding-waste ratio and occupancy/wait shapes.
 PADDING_RATIO_BUCKETS = (0.0, 0.05, 0.1, 0.2, 0.3, 0.5, 0.7, 0.9)
+# Fraction of a worklist the two-phase block-max prune dropped.
+BLOCKMAX_PRUNE_BUCKETS = (0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99)
 OCCUPANCY_BUCKETS = tuple(float(1 << i) for i in range(9))  # 1..256
 QUEUE_WAIT_MS_BUCKETS = (
     0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 1024.0,
@@ -410,6 +412,21 @@ class DeviceInstruments:
             "Per-coalesced-launch padding waste ratio",
         ).observe(waste)
 
+    def blockmax_pruned(self, fraction: float) -> None:
+        """Per-query fraction of worklist tiles a two-phase block-max
+        execution pruned before the exact launch (0 = kept everything) —
+        prune effectiveness, observable in production at every two-phase
+        launch site (ops/bm25_device.execute_batch_blockmax[_conj])."""
+        self._prune_hist().observe(min(1.0, max(0.0, float(fraction))))
+
+    def _prune_hist(self) -> Histogram:
+        return self.registry.histogram(
+            "estpu_device_blockmax_pruned_tile_fraction",
+            BLOCKMAX_PRUNE_BUCKETS,
+            "Per-query fraction of worklist tiles pruned by two-phase "
+            "block-max execution",
+        )
+
     # ------------------------------------------------------------- views
 
     def compile_count(self) -> int:
@@ -463,4 +480,13 @@ class DeviceInstruments:
                 self.registry.value("estpu_device_h2d_bytes_total")
             ),
             "padding_waste_pct": self.padding_waste_pct(),
+            "blockmax_pruned_tile_fraction": self._prune_summary(),
+        }
+
+    def _prune_summary(self) -> dict[str, Any]:
+        snap = self._prune_hist().snapshot()
+        count = snap["count"]
+        return {
+            "count": int(count),
+            "mean": round(snap["sum"] / count, 4) if count else 0.0,
         }

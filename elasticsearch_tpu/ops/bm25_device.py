@@ -57,8 +57,12 @@ NEG_INF = float("-inf")
 #   ("const", child_spec)                 — constant_score wrapper
 #   ("match_all",)                        — every live doc, constant score
 #   ("match_none",)                       — no doc
-#   ("bool", (must...), (should...), (filter...), (must_not...), msm)
+#   ("bool", (must...), (should...), (filter...), (must_not...), msm, lead)
 #       msm: minimum_should_match (int; -1 = default rule)
+#       lead: index of the single-span constant FILTER clause that drives
+#       sparse candidate generation (compile-time selectivity choice, the
+#       ConjunctionDISI lead-iterator analog), or -1 for the default
+#       must-driven fold
 #
 # A terms node is a FLAT TILE WORKLIST: one entry per posting tile touched
 # by any query term, padded to the pow-2 bucket NT. Each entry carries its
@@ -659,7 +663,8 @@ def _eval_range(spec, arrays, seg, num_docs):
 
 
 def _eval_bool(spec, arrays, seg, num_docs):
-    _, must_s, should_s, filter_s, must_not_s, msm = spec
+    # spec[6] (the sparse lead-clause choice) is irrelevant dense-side.
+    must_s, should_s, filter_s, must_not_s, msm = spec[1:6]
     children = arrays["children"]
     i = 0
     must, should, filt, must_not = [], [], [], []
@@ -740,11 +745,9 @@ def _execute_inner(seg, spec, arrays, k: int):
 # ---------------------------------------------------------------------------
 
 
-# Widest disjunction the run-fold unrolls: the fold is t_pad-1 static
-# shifted adds, so an ES-max 1024-clause disjunction would compile a
-# ~1000-step XLA program. Past this bucket the dense kernel wins on both
-# compile time and program size; the sparse path keeps the hot few-term
-# match-query shapes.
+# Widest disjunction the run-fold unrolls (the fold is t_pad-1 static
+# shifted adds; wider disjunctions route to the dense kernel). Rationale:
+# README "Conjunction execution".
 SPARSE_TPAD_MAX = 32
 
 
@@ -758,7 +761,7 @@ def supports_sparse(spec) -> bool:
     if spec[0] == "terms":
         return spec[3] <= SPARSE_TPAD_MAX
     if spec[0] == "bool":
-        _, must_s, should_s, filter_s, must_not_s, _msm = spec
+        must_s, should_s, filter_s, must_not_s = spec[1:5]
         return (
             len(must_s) == 1
             and must_s[0][0] == "terms"
@@ -770,11 +773,31 @@ def supports_sparse(spec) -> bool:
     return False
 
 
+def _bool_lead(spec) -> int:
+    """The compile-time lead-clause choice of a bool spec (-1 = the
+    default must-driven fold)."""
+    return spec[6] if len(spec) > 6 else -1
+
+
 def _sparse_inner(seg, spec, arrays, k: int):
     """Candidate-centric top-k for a supports_sparse spec."""
     if spec[0] == "bool":
+        if _bool_lead(spec) >= 0:
+            return _sparse_lead_inner(seg, spec, arrays, k)
         return _sparse_bool_inner(seg, spec, arrays, k)
     return _sparse_terms_inner(seg, spec, arrays, k)
+
+
+def _const_membership(seg, child_spec, carr, safe_docs, num_docs):
+    """Constant-clause membership test at candidate docs: binary search
+    for single contiguous spans (O(P log df), no [N]-sized scatter), the
+    dense presence bitmap gathered at candidates otherwise."""
+    if len(child_spec) == 4 and child_spec[3] == 1:
+        return _span_member(
+            seg, child_spec[1], carr["span_start"], carr["span_end"],
+            safe_docs,
+        )
+    return _terms_matched(child_spec, carr, seg, num_docs)[safe_docs]
 
 
 def _sparse_bool_inner(seg, spec, arrays, k: int):
@@ -786,7 +809,7 @@ def _sparse_bool_inner(seg, spec, arrays, k: int):
     dominant cost at shard scale — disappears; this is the config-3
     conjunction shape (BooleanQuery with required + filter clauses,
     ContextIndexSearcher.java:170-206)."""
-    _, must_s, _should_s, filter_s, must_not_s, _msm = spec
+    must_s, filter_s, must_not_s = spec[1], spec[3], spec[4]
     children = arrays["children"]
     live = seg["live"]
     num_docs = live.shape[0]
@@ -800,22 +823,15 @@ def _sparse_bool_inner(seg, spec, arrays, k: int):
     sentinel = jnp.int32(num_docs)
     safe_docs = jnp.minimum(docs_s, sentinel - 1)
 
-    def membership(child_spec, carr):
-        if len(child_spec) == 4 and child_spec[3] == 1:
-            # Single contiguous posting span: binary-search the candidates
-            # against the field's sorted postings plane — O(P log df), no
-            # [N]-sized scatter.
-            return _span_member(
-                seg, child_spec[1], carr["span_start"], carr["span_end"],
-                safe_docs,
-            )
-        return _terms_matched(child_spec, carr, seg, num_docs)[safe_docs]
-
     for idx_child, child_spec in enumerate(filter_s):
-        eligible &= membership(child_spec, children[1 + idx_child])
+        eligible &= _const_membership(
+            seg, child_spec, children[1 + idx_child], safe_docs, num_docs
+        )
     base = 1 + len(filter_s)
     for idx_child, child_spec in enumerate(must_not_s):
-        eligible &= ~membership(child_spec, children[base + idx_child])
+        eligible &= ~_const_membership(
+            seg, child_spec, children[base + idx_child], safe_docs, num_docs
+        )
     scores = run_sum * arrays["boost"]
     key = jnp.where(eligible, scores, jnp.float32(NEG_INF))
     kp = min(kk, p)
@@ -828,23 +844,104 @@ def _sparse_bool_inner(seg, spec, arrays, k: int):
     return top_scores, top_ids.astype(jnp.int32), total
 
 
-def _span_member(seg, field_name, start, end, cands):
-    """bool[P]: is each candidate doc inside the sorted posting span
-    [start, end) of the field's flat postings plane? 21 static
-    binary-search steps (spans cannot exceed one term's df <= num_docs),
-    all vectorized gathers — the scatter-free filter membership test."""
-    flat = seg["fields"][field_name][0].reshape(-1)
+def _sparse_lead_inner(seg, spec, arrays, k: int):
+    """Lead-driven conjunction: candidates come from the MOST SELECTIVE
+    clause — a single-span constant filter whose df undercuts the must
+    disjunction's (spec[6], chosen statically at compile time from clause
+    selectivities, the ConjunctionDISI lead-iterator cost ordering).
+
+    The filter's posting span IS the candidate list, already sorted by
+    doc id (CSR term→doc order) — no union worklist, NO SORT. Each must
+    term then verifies + scores at the candidates with one binary search
+    over its posting span plus one impact gather; contributions fold in
+    term order, reproducing the oracle's per-term accumulation rounding
+    exactly. Remaining filters/exclusions verify via _const_membership.
+    Totals stay exact (every candidate is checked, none dropped)."""
+    must_s, filter_s, must_not_s = spec[1], spec[3], spec[4]
+    lead = _bool_lead(spec)
+    children = arrays["children"]
+    live = seg["live"]
+    num_docs = live.shape[0]
+    sentinel = jnp.int32(num_docs)
+    lead_spec = filter_s[lead]
+    docs, _vals, valid, _norm = _gather_tiles(
+        lead_spec, children[1 + lead], seg
+    )
+    cand = jnp.where(valid, docs, sentinel).reshape(-1)  # [P], doc-ascending
+    p = cand.shape[0]
+    safe = jnp.minimum(cand, sentinel - 1)
+    in_range = cand != sentinel
+    must_spec = must_s[0]
+    marr = children[0]
+    t_pad = must_spec[3]
+    field_planes = seg["fields"][must_spec[1]]
+    flat_docs = field_planes[0].reshape(-1)
+    flat_tn = field_planes[1].reshape(-1)
+    one = jnp.float32(1.0)
+    score = jnp.zeros(p, dtype=jnp.float32)
+    matched_any = jnp.zeros(p, dtype=bool)
+    for j in range(t_pad):
+        pos, found = _span_locate(
+            flat_docs, marr["term_starts"][j], marr["term_ends"][j], safe
+        )
+        found &= in_range
+        w = marr["term_weights"][j]
+        contrib = w - w / (one + flat_tn[pos])
+        score = score + jnp.where(found, contrib, jnp.float32(0.0))
+        matched_any |= found
+    eligible = matched_any & in_range & live[safe]
+    for idx_child, child_spec in enumerate(filter_s):
+        if idx_child == lead:
+            continue
+        eligible &= _const_membership(
+            seg, child_spec, children[1 + idx_child], safe, num_docs
+        )
+    base = 1 + len(filter_s)
+    for idx_child, child_spec in enumerate(must_not_s):
+        eligible &= ~_const_membership(
+            seg, child_spec, children[base + idx_child], safe, num_docs
+        )
+    scores = score * arrays["boost"]
+    key = jnp.where(eligible, scores, jnp.float32(NEG_INF))
+    kk = min(k, num_docs)
+    kp = min(kk, p)
+    # Candidate order ascends by doc id (one span, CSR order), so
+    # lax.top_k's lowest-index tie-break IS Lucene's doc-id tie-break.
+    top_scores, top_pos = jax.lax.top_k(key, kp)
+    top_ids = cand[top_pos]
+    if kp < kk:
+        top_scores = jnp.pad(top_scores, (0, kk - kp), constant_values=NEG_INF)
+        top_ids = jnp.pad(top_ids, (0, kk - kp), constant_values=0)
+    total = jnp.sum(eligible, dtype=jnp.int32)
+    return top_scores, top_ids.astype(jnp.int32), total
+
+
+def _span_locate(flat, start, end, cands):
+    """(pos, found) for each candidate doc against the sorted slice
+    [start, end) of a flat postings plane: pos = first in-span slot whose
+    doc >= the candidate (clipped in-plane), found = that slot holds
+    exactly the candidate. log2(plane) static binary-search steps, all
+    vectorized gathers — the scatter-free conjunction primitive."""
     p = cands.shape[0]
-    lo = jnp.full(p, start, dtype=jnp.int32)
-    hi = jnp.full(p, end, dtype=jnp.int32)
+    lo = jnp.broadcast_to(jnp.asarray(start, dtype=jnp.int32), (p,))
+    hi = jnp.broadcast_to(jnp.asarray(end, dtype=jnp.int32), (p,))
     limit = jnp.int32(flat.shape[0] - 1)
-    for _ in range(21):
+    for _ in range(max(1, int(flat.shape[0]).bit_length())):
         mid = (lo + hi) >> 1
         v = flat[jnp.clip(mid, 0, limit)]
         go = v < cands
         lo = jnp.where(go, mid + 1, lo)
         hi = jnp.where(go, hi, mid)
-    return (lo < end) & (flat[jnp.clip(lo, 0, limit)] == cands)
+    pos = jnp.clip(lo, 0, limit)
+    return pos, (lo < end) & (flat[pos] == cands)
+
+
+def _span_member(seg, field_name, start, end, cands):
+    """bool[P]: is each candidate doc inside the sorted posting span
+    [start, end) of the field's flat postings plane?"""
+    flat = seg["fields"][field_name][0].reshape(-1)
+    _pos, found = _span_locate(flat, start, end, cands)
+    return found
 
 
 def _sparse_candidates(seg, spec, arrays, k: int):
@@ -1262,7 +1359,7 @@ def execute_many(seg, compiled_queries, k: int):
     return results
 
 
-def execute_batch_blockmax(seg, spec, arrays_list, k: int):
+def execute_batch_blockmax(seg, spec, arrays_list, k: int, instruments=None):
     """Two-launch thresholded batch execution — the block-max WAND analog.
 
     Lucene skips non-competitive posting blocks against the collector's
@@ -1333,6 +1430,11 @@ def execute_batch_blockmax(seg, spec, arrays_list, k: int):
     keep |= ~np.isfinite(thetas)[:, None]  # underfull top-k: keep all
     counts = keep.sum(axis=1)
     pruned_any = bool((counts < nt).any())
+    if instruments is not None:
+        # Prune effectiveness, per query (obs/metrics.py
+        # blockmax_pruned_tile_fraction histogram).
+        for c in counts:
+            instruments.blockmax_pruned(1.0 - float(c) / nt)
     nt_b = 1 << (max(1, int(counts.max())) - 1).bit_length()
     front = np.argsort(~keep, axis=1, kind="stable")[:, :nt_b]
     arrays_b = {
@@ -1346,6 +1448,200 @@ def execute_batch_blockmax(seg, spec, arrays_list, k: int):
     arrays_b["ends"] = np.where(pad, 0, arrays_b["ends"])
     spec_b = (kind, field_name, nt_b, t_pad)
     s, i, t = jax.device_get(execute_batch_sparse(seg, spec_b, arrays_b, k))
+    return s, i, t, ("gte" if pruned_any else "eq")
+
+
+# ---------------------------------------------------------------------------
+# Two-phase block-max CONJUNCTION execution — the BMW analog for the
+# sparse bool shape (required terms + constant filters). Same structure as
+# execute_batch_blockmax, but phase A runs the full conjunction over each
+# query's A highest-upper-bound MUST tiles (filters verified at
+# candidates), so θ = the k-th best filter-passing partial score — a
+# lower bound on the final k-th score. The host then drops must tiles
+# whose upper bound plus the other terms' bounds cannot reach θ and
+# re-buckets the survivors for the exact second launch. Top-k ids/scores
+# are exact; totals become "gte" when any tile was pruned (docs matched
+# only by pruned tiles go uncounted), so serving gates this backend
+# behind untracked totals exactly like the disjunction block-max.
+# ---------------------------------------------------------------------------
+
+# The must child's worklist-entry planes that phase subsets reorder.
+_CONJ_ENTRY_KEYS = ("tile_ids", "starts", "ends", "weights", "ub", "ub_other")
+
+
+def supports_blockmax_conj(spec) -> bool:
+    """Two-phase pruned execution applies to the must-driven sparse
+    conjunction shape: a scored terms must (whose worklist carries
+    block-max upper bounds) with constant filters/exclusions and the
+    default lead (-1; a filter-led fold has no sort worth pruning)."""
+    return (
+        isinstance(spec, tuple)
+        and bool(spec)
+        and spec[0] == "bool"
+        and supports_sparse(spec)
+        and _bool_lead(spec) == -1
+        and bool(spec[1])
+        and spec[1][0][0] == "terms"
+    )
+
+
+def _with_must_nt(spec, nt: int):
+    """The bool spec with its (single) must child re-bucketed to nt."""
+    must_spec = spec[1][0]
+    new_must = (must_spec[0], must_spec[1], nt, must_spec[3])
+    return ("bool", (new_must,), *spec[2:])
+
+
+def _subset_must_child(child: dict, order: np.ndarray) -> dict:
+    """Reorder/subset the must child's worklist planes along the tile
+    axis (the trailing axis of `order`); per-term planes pass through."""
+    out = dict(child)
+    for name in _CONJ_ENTRY_KEYS:
+        if name in out:
+            out[name] = np.take_along_axis(out[name], order, axis=-1)
+    return out
+
+
+def execute_batch_blockmax_conj(seg, spec, arrays_list, k: int,
+                                instruments=None):
+    """Two-launch thresholded conjunction batch over one segment.
+
+    Returns (scores [Q,k'], ids [Q,k'], totals [Q], relation) with
+    relation "gte" when any pruning occurred, else "eq".
+    """
+    must_spec = spec[1][0]
+    nt = must_spec[2]
+    stacked = jax.tree.map(lambda *xs: np.stack(xs), *arrays_list)
+    a_bucket = max(8, nt // 4)
+    if a_bucket >= nt:  # tiny worklists: single launch, exact totals
+        s, i, t = jax.device_get(execute_batch_sparse(seg, spec, stacked, k))
+        return s, i, t, "eq"
+    child0 = stacked["children"][0]
+    ub, ub_other = child0["ub"], child0["ub_other"]  # [Q, nt]
+    q = ub.shape[0]
+
+    # Launch 1: the conjunction over each query's top-UB must subset.
+    # (Reordering is safe — phase-A scores are only lower bounds; exact
+    # accumulation order matters only in the final launch.)
+    order = np.argsort(-ub, axis=-1, kind="stable")[..., :a_bucket]
+    arrays_a = {
+        **stacked,
+        "children": (
+            _subset_must_child(child0, order),
+            *stacked["children"][1:],
+        ),
+    }
+    scores_a, _, _ = jax.device_get(
+        execute_batch_sparse(seg, _with_must_nt(spec, a_bucket), arrays_a, k)
+    )
+    thetas = (
+        scores_a[..., k - 1]
+        if scores_a.shape[-1] >= k
+        else np.full(q, -np.inf, dtype=np.float32)
+    )
+
+    # Host prune + re-bucket (same fp32 safety margin as the disjunction
+    # path); keep preserves worklist order via the stable ~keep argsort.
+    # θ lives in the bool's boosted score space while ub/ub_other carry
+    # only term weights, so the bounds scale by the per-query boost
+    # before comparing (a non-positive boost disables pruning — every
+    # bound degenerates).
+    boost = np.asarray(stacked["boost"], dtype=np.float32).reshape(q)
+    margin = thetas.astype(np.float32) * np.float32(1 - 1e-6) - np.float32(
+        1e-6
+    )
+    keep = (ub + ub_other) * boost[:, None] >= margin[:, None]
+    keep |= ~np.isfinite(thetas)[:, None]  # underfull top-k: keep all
+    keep |= (boost <= 0)[:, None]
+    counts = keep.sum(axis=-1)
+    pruned_any = bool((counts < nt).any())
+    if instruments is not None:
+        for c in counts:
+            instruments.blockmax_pruned(1.0 - float(c) / nt)
+    nt_b = 1 << (max(1, int(counts.max())) - 1).bit_length()
+    front = np.argsort(~keep, axis=-1, kind="stable")[..., :nt_b]
+    child_b = _subset_must_child(child0, front)
+    pad = np.arange(nt_b)[None, :] >= counts[..., None]
+    child_b["starts"] = np.where(pad, 0, child_b["starts"])
+    child_b["ends"] = np.where(pad, 0, child_b["ends"])
+    arrays_b = {**stacked, "children": (child_b, *stacked["children"][1:])}
+    s, i, t = jax.device_get(
+        execute_batch_sparse(seg, _with_must_nt(spec, nt_b), arrays_b, k)
+    )
+    return s, i, t, ("gte" if pruned_any else "eq")
+
+
+def execute_shards_blockmax_conj(seg_stacked, spec, arrays_list, k: int,
+                                 docs_per_shard: int, instruments=None):
+    """Two-launch thresholded conjunction batch over S stacked shards.
+
+    arrays_list: per-query plan pytrees with [S, ...] leaves (the stacked
+    compile). θ comes from each query's MERGED phase-A top-k, so one
+    shard's strong candidates prune other shards' hopeless tiles too.
+    Returns (scores [Q,k'], global ids [Q,k'], totals [Q], relation).
+    """
+    must_spec = spec[1][0]
+    nt = must_spec[2]
+    stacked = jax.tree.map(lambda *xs: np.stack(xs), *arrays_list)
+    a_bucket = max(8, nt // 4)
+    if a_bucket >= nt:
+        s, i, t = jax.device_get(
+            execute_shards_batch(seg_stacked, spec, stacked, k,
+                                 docs_per_shard)
+        )
+        return s, i, t, "eq"
+    child0 = stacked["children"][0]
+    ub, ub_other = child0["ub"], child0["ub_other"]  # [Q, S, nt]
+    q = ub.shape[0]
+    order = np.argsort(-ub, axis=-1, kind="stable")[..., :a_bucket]
+    arrays_a = {
+        **stacked,
+        "children": (
+            _subset_must_child(child0, order),
+            *stacked["children"][1:],
+        ),
+    }
+    scores_a, _, _ = jax.device_get(
+        execute_shards_batch(
+            seg_stacked, _with_must_nt(spec, a_bucket), arrays_a, k,
+            docs_per_shard,
+        )
+    )
+    thetas = (
+        scores_a[..., k - 1]
+        if scores_a.shape[-1] >= k
+        else np.full(q, -np.inf, dtype=np.float32)
+    )
+    # Bound/threshold spaces as in execute_batch_blockmax_conj: scale the
+    # term-weight bounds by the bool boost (uniform across shards — the
+    # same query compiles every shard) before comparing against θ.
+    boost = np.asarray(stacked["boost"], dtype=np.float32).reshape(
+        q, -1
+    )[:, 0]
+    margin = thetas.astype(np.float32) * np.float32(1 - 1e-6) - np.float32(
+        1e-6
+    )
+    keep = (ub + ub_other) * boost[:, None, None] >= margin[:, None, None]
+    keep |= ~np.isfinite(thetas)[:, None, None]
+    keep |= (boost <= 0)[:, None, None]
+    counts = keep.sum(axis=-1)  # [Q, S]
+    pruned_any = bool((counts < nt).any())
+    if instruments is not None:
+        for row in counts:
+            instruments.blockmax_pruned(1.0 - float(row.mean()) / nt)
+    nt_b = 1 << (max(1, int(counts.max())) - 1).bit_length()
+    front = np.argsort(~keep, axis=-1, kind="stable")[..., :nt_b]
+    child_b = _subset_must_child(child0, front)
+    pad = np.arange(nt_b)[None, None, :] >= counts[..., None]
+    child_b["starts"] = np.where(pad, 0, child_b["starts"])
+    child_b["ends"] = np.where(pad, 0, child_b["ends"])
+    arrays_b = {**stacked, "children": (child_b, *stacked["children"][1:])}
+    s, i, t = jax.device_get(
+        execute_shards_batch(
+            seg_stacked, _with_must_nt(spec, nt_b), arrays_b, k,
+            docs_per_shard,
+        )
+    )
     return s, i, t, ("gte" if pruned_any else "eq")
 
 

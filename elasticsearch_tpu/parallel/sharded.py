@@ -39,7 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..index.mapping import Mappings
 from ..index.segment import FieldIndex, Segment, SegmentBuilder
-from ..index.tiles import TILE, pack_segment
+from ..index.tiles import TILE, pack_segment, tile_doc_bounds
 from ..ops.bm25 import BM25Params
 from ..ops.bm25_device import (
     NEG_INF,
@@ -52,7 +52,11 @@ from ..query.compile import (
     CompiledQuery,
     Compiler,
     FieldStats,
+    SpecUnifyError,
     aggregate_field_stats,
+    equalize_compiled,
+    pad_arrays_to_spec,
+    unify_specs,
 )
 from ..query.dsl import Query
 from .routing import shard_for_id
@@ -166,6 +170,23 @@ class ShardedIndex:
     params: BM25Params
     _stats_cache: dict[str, FieldStats] | None = None
     _id_indexes: list[dict[str, int] | None] | None = None
+    # Memoized per-(shard, field) tile doc-id bounds for plan-time
+    # conjunction range pruning (computed once; shards are immutable).
+    _tile_bounds: dict | None = None
+
+    def _field_tile_bounds(self, shard: int, name: str):
+        if self._tile_bounds is None:
+            self._tile_bounds = {}
+        key = (shard, name)
+        if key not in self._tile_bounds:
+            fld = self.segments[shard].fields.get(name)
+            if fld is None or not len(fld.doc_ids):
+                self._tile_bounds[key] = (None, None)
+            else:
+                self._tile_bounds[key] = tile_doc_bounds(
+                    fld.doc_ids, self.segments[shard].num_docs
+                )
+        return self._tile_bounds[key]
 
     def _id_index(self, shard: int) -> dict[str, int]:
         """Memoized _id -> local map per shard (the index is an immutable
@@ -306,7 +327,10 @@ class ShardedIndex:
                 postings = len(fld.doc_ids)
                 nt = postings // TILE + 2
                 fstats = stats.get(name)
+                b_lo, b_hi = self._field_tile_bounds(shard, name)
                 fields[name] = _PlanField(
+                    tile_doc_lo=b_lo,
+                    tile_doc_hi=b_hi,
                     name=name,
                     terms=fld.terms,
                     df=fld.df,
@@ -345,11 +369,19 @@ class ShardedIndex:
         ]
         specs_match = len({c.spec for c in first}) == 1
         if not specs_match:
-            nt_max = max(_max_nt(c.spec) for c in first)
-            first = [
-                shard_compiler(seg, nt_max, i).compile(query)
-                for i, seg in enumerate(self.segments)
-            ]
+            # Per-node-position equalization: each clause's bucket rises
+            # only to ITS max across shards (array padding, no recompile).
+            # The old single group-wide nt_floor let one fat clause (a
+            # high-df filter term) inflate every other clause's worklist
+            # — the BENCH_r05 cfg3 sort blow-up.
+            try:
+                first = equalize_compiled(first)
+            except SpecUnifyError:
+                nt_max = max(_max_nt(c.spec) for c in first)
+                first = [
+                    shard_compiler(seg, nt_max, i).compile(query)
+                    for i, seg in enumerate(self.segments)
+                ]
             if len({c.spec for c in first}) != 1:
                 raise AssertionError(
                     "sharded compile produced divergent specs even with a "
@@ -370,8 +402,10 @@ class ShardedIndex:
         compiled = [self.compile(q) for q in queries]
         specs = {c.spec for c in compiled}
         if len(specs) != 1:
-            nt_max = max(_max_nt(c.spec) for c in compiled)
-            compiled = [self.compile(q, nt_floor=nt_max) for q in queries]
+            try:
+                compiled = equalize_compiled(compiled)
+            except SpecUnifyError:
+                pass
             specs = {c.spec for c in compiled}
         if len(specs) != 1:
             raise ValueError(
@@ -382,6 +416,43 @@ class ShardedIndex:
             lambda *xs: np.stack(xs), *[c.arrays for c in compiled]
         )
         return CompiledQuery(spec=compiled[0].spec, arrays=arrays)
+
+    def compile_batch_buckets(
+        self, queries: list[Query]
+    ) -> list[tuple[CompiledQuery, list[int]]]:
+        """Adaptive worklist bucketing for a query batch: instead of ONE
+        launch padded to the batch-wide maximum (whose padding made cfg3's
+        batched execution slower than sequential, BENCH_r05), queries
+        group into pow-2 sub-buckets — each query padded only to its own
+        bucket, one launch per bucket. A smaller group is merged into a
+        larger bucket only when the padding it would pay costs less than
+        the launch it saves (exec/cost.coalesce_wins). Returns
+        [(batched CompiledQuery, query positions)] covering all queries.
+        """
+        from ..exec.batcher import plan_spec_buckets
+
+        compiled = [self.compile(q) for q in queries]
+        by_spec: dict[tuple, list[int]] = {}
+        for pos, c in enumerate(compiled):
+            by_spec.setdefault(c.spec, []).append(pos)
+        buckets = plan_spec_buckets(
+            list(by_spec.items()), n_shards=self.n_shards
+        )
+        out: list[tuple[CompiledQuery, list[int]]] = []
+        for bucket_specs in buckets:
+            positions = [p for s in bucket_specs for p in by_spec[s]]
+            target = unify_specs(list(bucket_specs))
+            arrays = jax.tree.map(
+                lambda *xs: np.stack(xs),
+                *[
+                    pad_arrays_to_spec(
+                        compiled[p].spec, target, compiled[p].arrays
+                    )
+                    for p in positions
+                ],
+            )
+            out.append((CompiledQuery(spec=target, arrays=arrays), positions))
+        return out
 
     def search_batch(self, queries: list[Query], k: int, batch_axis: str):
         """Batched sharded search over a 2D (batch × shard) mesh."""
@@ -435,6 +506,10 @@ class _PlanField:
     tn_b: float = 0.75
     pos_offsets: Any = None  # int64[P+1] host copy (phrase planning)
     pos_num_tiles_: int = 0
+    # Per-tile doc-id extrema (tiles.tile_doc_bounds), for plan-time
+    # conjunction range pruning; None disables it.
+    tile_doc_lo: Any = None
+    tile_doc_hi: Any = None
 
     @property
     def avgdl(self) -> float:

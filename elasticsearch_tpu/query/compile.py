@@ -144,6 +144,7 @@ def _terms_arrays(
     stats: FieldStats | None,
     scored: bool,
     nt_floor: int = 1,
+    doc_range: tuple[int, int] | None = None,
 ) -> tuple[tuple, dict]:
     """Lower a term disjunction to a flat tile worklist.
 
@@ -151,6 +152,14 @@ def _terms_arrays(
     term's [start, end) span and fp32 weight. The bucket (pow-2 total tile
     count, floored by `nt_floor` for sharded/batched uniformity) is the only
     shape dimension, so compiled-kernel reuse across queries is maximal.
+
+    `doc_range` is the conjunction pushdown (set while lowering the must
+    clauses of a bool whose single-span constant filters bound the doc-id
+    range any match can come from): tiles whose per-tile doc-id bounds
+    (index/tiles.py `tile_doc_lo/hi`) cannot intersect the range are
+    dropped at plan time. Exact — a dropped tile only holds docs the
+    filter conjunction rejects anyway, so top-k, scores AND totals are
+    unchanged; only dead gather/sort work disappears.
     """
     doc_count = stats.doc_count if stats else dfield.doc_count
     avgdl = stats.avgdl if stats else dfield.avgdl
@@ -163,26 +172,44 @@ def _terms_arrays(
     )
 
     tile_max = getattr(dfield, "tile_max", None)  # f32[num_tiles] max impact
+    tile_doc_lo = getattr(dfield, "tile_doc_lo", None)
+    tile_doc_hi = getattr(dfield, "tile_doc_hi", None)
+    prune_range = (
+        doc_range is not None
+        and tile_doc_lo is not None
+        and tile_doc_hi is not None
+    )
     f32max = float(np.finfo(np.float32).max)
     entries: list[tuple[int, int, int, float, float]] = []
     term_ubs: list[float] = []  # per term-occurrence global upper bound
     entry_term: list[int] = []  # entry -> term occurrence index
+    # Per-term planning rows (full spans, independent of tile pruning):
+    # the lead-driven conjunction kernel binary-searches candidates against
+    # each term's whole span, and the selectivity sum drives lead choice.
+    term_rows: list[tuple[int, int, float]] = []  # (start, end, weight)
+    sel_df = 0
     for term in terms:
         s, e = dfield.term_span(term)
+        df = (
+            stats.df.get(term, dfield.term_df(term))
+            if stats
+            else dfield.term_df(term)
+        )
+        sel_df += max(0, int(df))
+        w = 0.0
+        if scored and df > 0 and doc_count > 0:
+            w = term_weight(df, doc_count, boost, params)
+        term_rows.append((s, e, w))
         if e <= s:
             continue
-        w = 0.0
-        if scored:
-            df = (
-                stats.df.get(term, dfield.term_df(term))
-                if stats
-                else dfield.term_df(term)
-            )
-            if df > 0 and doc_count > 0:
-                w = term_weight(df, doc_count, boost, params)
         first, last = s // TILE, (e - 1) // TILE
         term_tm = 0.0
         for tile in range(first, last + 1):
+            if prune_range and (
+                int(tile_doc_lo[tile]) > doc_range[1]
+                or int(tile_doc_hi[tile]) < doc_range[0]
+            ):
+                continue
             # Block-max analog (reference: Lucene block-max WAND configured
             # at search/query/TopDocsCollectorContext.java:68): upper-bound
             # this term's contribution to any doc in this tile from the
@@ -237,14 +264,29 @@ def _terms_arrays(
     else:
         spec = (kind, dfield.name, nt)
     arrays = {"tile_ids": tile_ids, "starts": starts, "ends": ends}
+    # Statistics-scope selectivity (summed df): drives the bool lead-clause
+    # choice at plan time (Lucene ConjunctionDISI cost ordering); inert as
+    # a kernel input.
+    arrays["sel_df"] = np.float32(min(float(sel_df), f32max))
     if not scored and len(terms) == 1:
-        span = entries[0][1:3] if entries else (0, 0)
+        span = dfield.term_span(terms[0])
         arrays["span_start"] = np.int32(span[0])
         arrays["span_end"] = np.int32(span[1])
     if scored:
         arrays["weights"] = weights
         arrays["ub"] = ubs
         arrays["ub_other"] = ub_other
+        t_pad = _pow2(len(terms))
+        term_starts = np.zeros(t_pad, dtype=np.int32)
+        term_ends = np.zeros(t_pad, dtype=np.int32)
+        term_weights = np.zeros(t_pad, dtype=np.float32)
+        for i, (ts, te, tw) in enumerate(term_rows):
+            term_starts[i] = ts
+            term_ends[i] = te
+            term_weights[i] = tw
+        arrays["term_starts"] = term_starts
+        arrays["term_ends"] = term_ends
+        arrays["term_weights"] = term_weights
         if not use_tn:
             cache = norm_inverse_cache(avgdl if doc_count else 1.0, params)
             if not dfield.has_norms:
@@ -255,6 +297,40 @@ def _terms_arrays(
     else:
         arrays["boost"] = np.float32(boost)
     return spec, arrays
+
+
+def select_lead_clause(groups) -> int:
+    """Static lead-clause choice for a lowered bool's sparse execution.
+
+    The analog of Lucene's ConjunctionDISI lead-iterator cost ordering:
+    when a bool is the sparse conjunction shape (one scored terms must,
+    constant-term filters/exclusions, no shoulds), candidate generation
+    should be driven by the MOST SELECTIVE clause. Returns the index of a
+    single-span constant filter whose df undercuts the must disjunction's
+    summed df (the kernel then folds candidates from that filter's
+    postings and verifies/scores the must terms by binary search), or -1
+    for the default must-driven fold. Selectivity comes from the
+    statistics scope the compiler scores with, so sharded compiles agree.
+    """
+    must_g, should_g, filter_g, must_not_g = groups
+    if len(must_g) != 1 or should_g or not filter_g:
+        return -1
+    mspec, marr = must_g[0]
+    from ..ops.bm25_device import SPARSE_TPAD_MAX
+
+    if mspec[0] != "terms" or mspec[3] > SPARSE_TPAD_MAX:
+        return -1
+    for cspec, _ in list(filter_g) + list(must_not_g):
+        if cspec[0] != "terms_const":
+            return -1
+    best, best_df = -1, float(marr.get("sel_df", np.float32(np.inf)))
+    for i, (fspec, farr) in enumerate(filter_g):
+        if not (len(fspec) == 4 and fspec[3] == 1):
+            continue  # only single-span filters support lead-driven folds
+        df = float(farr.get("sel_df", np.float32(np.inf)))
+        if df < best_df:
+            best, best_df = i, df
+    return best
 
 
 def _wildcard_regex(pattern: str, case_insensitive: bool):
@@ -487,8 +563,14 @@ class Compiler:
         self.id_index = id_index
         # Minimum worklist bucket: sharded/batched compilation raises this to
         # the max across shards (and across a query batch) so every shard
-        # and query compiles to one identical static spec.
+        # and query compiles to one identical static spec. (The sharded
+        # path now prefers per-node-position equalization — unify_specs /
+        # pad_arrays_to_spec — over a single global floor; the floor
+        # remains for callers that need one uniform bucket.)
         self.nt_floor = nt_floor
+        # Conjunction pushdown state: the doc-id range single-span filters
+        # bound while a bool's must clauses lower (see _bool).
+        self._doc_range: tuple[int, int] | None = None
 
     def compile(self, query: Query) -> CompiledQuery:
         spec, arrays = self._node(query, scoring=True)
@@ -1290,7 +1372,8 @@ class Compiler:
 
     def _terms_spec(self, dfield, terms, boost, stats, scored=True):
         return _terms_arrays(
-            dfield, terms, boost, self.params, stats, scored, self.nt_floor
+            dfield, terms, boost, self.params, stats, scored, self.nt_floor,
+            doc_range=self._doc_range,
         )
 
     def _term(self, q: TermQuery, scoring: bool = True) -> tuple[tuple, Any]:
@@ -1364,13 +1447,53 @@ class Compiler:
         return ("match_none",), {}
 
     def _bool(self, q: BoolQuery, scoring: bool) -> tuple[tuple, Any]:
-        groups = [
-            [self._node(c, scoring) for c in q.must],
-            [self._node(c, scoring) for c in q.should],
-            [self._node(c, scoring=False) for c in q.filter],
-            [self._node(c, scoring=False) for c in q.must_not],
-        ]
+        # Filters lower FIRST: single-span constant filters bound the
+        # doc-id range any conjunction match can come from, and that range
+        # pushes down into the must worklists (plan-time tile intersection
+        # pruning — exact, see _terms_arrays).
+        filter_g = [self._node(c, scoring=False) for c in q.filter]
+        must_not_g = [self._node(c, scoring=False) for c in q.must_not]
+        outer = self._doc_range
+        rng = self._filters_doc_range(filter_g)
+        if rng is not None and outer is not None:
+            rng = (max(rng[0], outer[0]), min(rng[1], outer[1]))
+        elif rng is None:
+            rng = outer
+        self._doc_range = rng
+        try:
+            must_g = [self._node(c, scoring) for c in q.must]
+        finally:
+            self._doc_range = outer
+        should_g = [self._node(c, scoring) for c in q.should]
+        groups = [must_g, should_g, filter_g, must_not_g]
         return self._assemble_bool(groups, q.minimum_should_match, q.boost)
+
+    def _filters_doc_range(self, filter_g) -> tuple[int, int] | None:
+        """Conservative [lo, hi] doc-id range covering every doc the
+        single-span constant filters can accept (None = unbounded). Bounds
+        come from the covering tiles' pack-time doc-id extrema, so they
+        are wide but always sound; an absent filter term yields the empty
+        range (the conjunction cannot match)."""
+        rng: tuple[int, int] | None = None
+        for fspec, farr in filter_g:
+            if not (
+                fspec
+                and fspec[0] == "terms_const"
+                and len(fspec) == 4
+                and fspec[3] == 1
+            ):
+                continue
+            dfield = self.fields.get(fspec[1])
+            lo_b = getattr(dfield, "tile_doc_lo", None)
+            hi_b = getattr(dfield, "tile_doc_hi", None)
+            s, e = int(farr["span_start"]), int(farr["span_end"])
+            if e <= s:
+                return (0, -1)  # empty filter: empty conjunction
+            if lo_b is None or hi_b is None:
+                continue
+            lo, hi = int(lo_b[s // TILE]), int(hi_b[(e - 1) // TILE])
+            rng = (lo, hi) if rng is None else (max(rng[0], lo), min(rng[1], hi))
+        return rng
 
     def _bool_from_parts(self, must=(), should=(), msm=-1, boost=1.0):
         groups = [list(must), list(should), [], []]
@@ -1380,6 +1503,263 @@ class Compiler:
     def _assemble_bool(groups, msm, boost):
         specs = tuple(tuple(s for s, _ in g) for g in groups)
         children = tuple(a for g in groups for _, a in g)
-        spec = ("bool", *specs, int(msm))
+        spec = ("bool", *specs, int(msm), select_lead_clause(groups))
         arrays = {"boost": np.float32(boost), "children": children}
         return spec, arrays
+
+
+# ---------------------------------------------------------------------------
+# Per-node-position spec equalization.
+#
+# Sharded and batched execution need ONE static spec across shards (and
+# across the queries of a coalesced launch). The old mechanism — a single
+# group-wide nt_floor raising EVERY worklist bucket to the global maximum —
+# let one fat clause (a high-df filter term) inflate every other clause's
+# worklist: BENCH_r05's cfg3 paid a full sort over a must worklist padded
+# 4-16x past its need. `unify_specs` instead takes the per-POSITION maximum
+# bucket over structurally identical specs, and `pad_arrays_to_spec` pads
+# each plan's arrays up to it with inert entries (empty [0, 0) spans never
+# validate, tile id 0 keeps gathers in range, sentinel doc_set slots stay
+# -1), so results are bit-identical to the natural-bucket compile.
+# ---------------------------------------------------------------------------
+
+
+class SpecUnifyError(ValueError):
+    """Specs differ structurally (not just in bucket sizes)."""
+
+
+# Worklist-entry fill values for padding slots, by array key. Keys absent
+# from a node's arrays (or not [nt]-shaped) are left untouched.
+_PAD_FILLS = {
+    "tile_ids": 0,
+    "starts": 0,
+    "ends": 0,
+    "weights": 0.0,
+    "ub": 0.0,
+    "ub_other": 0.0,
+    "shifts": 0,
+    "clause_of": 0,
+}
+
+# Node kinds whose spec[2] is a pow-2 worklist bucket.
+_NT_KINDS = (
+    "terms",
+    "terms_gather",
+    "terms_const",
+    "phrase",
+    "span_near",
+    "span_not",
+)
+
+
+def _unify_same(specs: list[tuple], idx: int):
+    vals = {s[idx] for s in specs}
+    if len(vals) != 1:
+        raise SpecUnifyError(
+            f"spec position {idx} differs across {specs[0][0]} nodes: {vals}"
+        )
+    return specs[0][idx]
+
+
+def unify_specs(specs: list[tuple]) -> tuple:
+    """The least common spec covering every spec in `specs`: identical
+    structure with each worklist bucket raised to the per-position max.
+    Raises SpecUnifyError when structures genuinely differ."""
+    first = specs[0]
+    if all(s == first for s in specs[1:]):
+        return first
+    kinds = {s[0] for s in specs}
+    if len(kinds) != 1 or any(len(s) != len(first) for s in specs):
+        raise SpecUnifyError(f"divergent node kinds/arity: {sorted(kinds)}")
+    kind = first[0]
+    if kind in _NT_KINDS:
+        for idx in range(1, len(first)):
+            if idx != 2:
+                _unify_same(specs, idx)
+        nt = max(s[2] for s in specs)
+        return (*first[:2], nt, *first[3:])
+    if kind == "doc_set":
+        return (kind, max(s[1] for s in specs))
+    if kind == "const":
+        return (kind, unify_specs([s[1] for s in specs]))
+    if kind == "script":
+        for idx in range(2, len(first)):
+            _unify_same(specs, idx)
+        return (kind, unify_specs([s[1] for s in specs]), *first[2:])
+    if kind == "nested":
+        _unify_same(specs, 1)
+        _unify_same(specs, 3)
+        return (kind, first[1], unify_specs([s[2] for s in specs]), first[3])
+    if kind == "boosting":
+        return (
+            kind,
+            unify_specs([s[1] for s in specs]),
+            unify_specs([s[2] for s in specs]),
+        )
+    if kind == "terms_set":
+        _unify_same(specs, 3)
+        _unify_same(specs, 4)
+        if len({len(s[2]) for s in specs}) != 1:
+            raise SpecUnifyError("terms_set count-clause arity differs")
+        counts = tuple(
+            unify_specs([s[2][i] for s in specs])
+            for i in range(len(first[2]))
+        )
+        return (kind, unify_specs([s[1] for s in specs]), counts, *first[3:])
+    if kind == "function_score":
+        for idx in range(2, len(first)):
+            if idx != 3:
+                _unify_same(specs, idx)
+        if len({len(s[3]) for s in specs}) != 1:
+            raise SpecUnifyError("function_score filter arity differs")
+        filters = []
+        for i in range(len(first[3])):
+            col = [s[3][i] for s in specs]
+            if any(c is None for c in col):
+                if not all(c is None for c in col):
+                    raise SpecUnifyError("function filter None-ness differs")
+                filters.append(None)
+            else:
+                filters.append(unify_specs(col))
+        return (
+            kind,
+            unify_specs([s[1] for s in specs]),
+            first[2],
+            tuple(filters),
+            *first[4:],
+        )
+    if kind == "dismax":
+        if len({len(s[1]) for s in specs}) != 1:
+            raise SpecUnifyError("dismax clause-count differs")
+        return (
+            kind,
+            tuple(
+                unify_specs([s[1][i] for s in specs])
+                for i in range(len(first[1]))
+            ),
+        )
+    if kind == "bool":
+        _unify_same(specs, 5)  # minimum_should_match
+        out_groups = []
+        for g in range(1, 5):
+            if len({len(s[g]) for s in specs}) != 1:
+                raise SpecUnifyError("bool clause-count differs")
+            out_groups.append(
+                tuple(
+                    unify_specs([s[g][i] for s in specs])
+                    for i in range(len(first[g]))
+                )
+            )
+        # Lead choice is a plan heuristic, not a result contract: shards
+        # compiled without a shared statistics scope may disagree, and the
+        # default must-driven fold (-1) is valid everywhere.
+        leads = {s[6] for s in specs}
+        lead = first[6] if len(leads) == 1 else -1
+        return ("bool", *out_groups, first[5], lead)
+    # Leaf kinds (range, exists, match_all, ...) carry no buckets: reaching
+    # here means inequality at a position with no padding story.
+    raise SpecUnifyError(f"cannot unify [{kind}] specs: {specs}")
+
+
+def _pad_entries(arrays: dict, nt_src: int, nt_tgt: int) -> dict:
+    out = dict(arrays)
+    for key, fill in _PAD_FILLS.items():
+        arr = out.get(key)
+        # Pad the trailing (worklist) axis so stacked plans ([S, nt] or
+        # [Q, S, nt] leaves) equalize too, not just single-plan arrays.
+        if arr is None or getattr(arr, "ndim", 0) < 1:
+            continue
+        if arr.shape[-1] != nt_src:
+            continue  # per-term planning rows ([t_pad]) etc.
+        pad = np.full(
+            (*arr.shape[:-1], nt_tgt - nt_src), fill, dtype=arr.dtype
+        )
+        out[key] = np.concatenate([arr, pad], axis=-1)
+    return out
+
+
+def pad_arrays_to_spec(spec: tuple, target: tuple, arrays):
+    """Pad a compiled plan's arrays so they execute under `target` (a
+    unify_specs output covering `spec`) with bit-identical results."""
+    if spec == target:
+        return arrays
+    kind = spec[0]
+    if kind in _NT_KINDS:
+        return _pad_entries(arrays, spec[2], target[2])
+    if kind == "doc_set":
+        docs = arrays["docs"]
+        pad = np.full(
+            (*docs.shape[:-1], target[1] - spec[1]), -1, dtype=docs.dtype
+        )
+        return {**arrays, "docs": np.concatenate([docs, pad], axis=-1)}
+    if kind in ("const", "script", "nested"):
+        child_idx = 1 if kind != "nested" else 2
+        return {
+            **arrays,
+            "child": pad_arrays_to_spec(
+                spec[child_idx], target[child_idx], arrays["child"]
+            ),
+        }
+    if kind == "boosting":
+        return {
+            **arrays,
+            "positive": pad_arrays_to_spec(
+                spec[1], target[1], arrays["positive"]
+            ),
+            "negative": pad_arrays_to_spec(
+                spec[2], target[2], arrays["negative"]
+            ),
+        }
+    if kind == "terms_set":
+        return {
+            **arrays,
+            "scored": pad_arrays_to_spec(spec[1], target[1], arrays["scored"]),
+            "counts": tuple(
+                pad_arrays_to_spec(cs, ct, ca)
+                for cs, ct, ca in zip(spec[2], target[2], arrays["counts"])
+            ),
+        }
+    if kind == "function_score":
+        return {
+            **arrays,
+            "child": pad_arrays_to_spec(spec[1], target[1], arrays["child"]),
+            "filters": tuple(
+                fa if fs is None else pad_arrays_to_spec(fs, ft, fa)
+                for fs, ft, fa in zip(spec[3], target[3], arrays["filters"])
+            ),
+        }
+    if kind == "dismax":
+        return {
+            **arrays,
+            "children": tuple(
+                pad_arrays_to_spec(cs, ct, ca)
+                for cs, ct, ca in zip(spec[1], target[1], arrays["children"])
+            ),
+        }
+    if kind == "bool":
+        out_children = []
+        i = 0
+        for g in range(1, 5):
+            for cs, ct in zip(spec[g], target[g]):
+                out_children.append(
+                    pad_arrays_to_spec(cs, ct, arrays["children"][i])
+                )
+                i += 1
+        return {**arrays, "children": tuple(out_children)}
+    return arrays
+
+
+def equalize_compiled(compiled: list["CompiledQuery"]) -> list["CompiledQuery"]:
+    """Equalize a list of structurally-identical compiled plans to one
+    shared spec (per-position bucket maxima), padding arrays in place of
+    the old whole-tree nt_floor recompile."""
+    specs = [c.spec for c in compiled]
+    if all(s == specs[0] for s in specs[1:]):
+        return compiled
+    target = unify_specs(specs)
+    return [
+        CompiledQuery(
+            spec=target, arrays=pad_arrays_to_spec(c.spec, target, c.arrays)
+        )
+        for c in compiled
+    ]

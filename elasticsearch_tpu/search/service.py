@@ -769,9 +769,19 @@ class SearchService:
 
     def _merge_term_groups(self, handle, stats, groups, compiled, requests):
         """Coalesce same-family sparse term groups that differ only in
-        their nt bucket: recompile the smaller ones with nt_floor set to
-        the family max, so the whole family shares one padded launch
-        (bench.py's _compile_uniform trick, applied per batch)."""
+        their nt bucket — ADAPTIVELY. The old policy padded the whole
+        family to its max bucket unconditionally, so one fat-worklist
+        query taxed every batchmate (the BENCH_r05 cfg3 batched-worse-
+        than-sequential inversion). Now exec/batcher.plan_spec_buckets
+        splits the family into pow-2 sub-buckets: a smaller group joins a
+        larger bucket only when the padding it would pay costs less than
+        the launch it saves; everything else keeps its own bucket and
+        launch. Joined groups PAD their compiled arrays to the bucket
+        spec (bit-identical results, no recompile); the device padding
+        instrument records exactly the waste each accepted merge pays."""
+        from ..exec.batcher import plan_spec_buckets
+        from ..query.compile import CompiledQuery, pad_arrays_to_spec, unify_specs
+
         families: dict[tuple, list[tuple]] = {}
         for spec in list(groups):
             fam = sparse_family_key(spec)
@@ -780,26 +790,32 @@ class SearchService:
         for specs in families.values():
             if len(specs) < 2:
                 continue
-            nt_max = max(s[2] for s in specs)
-            if self.device is not None:
-                # Padding waste of this coalesced family: every lane now
-                # launches at nt_max tiles regardless of what it needed.
-                self.device.padding(
-                    *family_padding_tiles(
-                        [(s, len(groups[s])) for s in specs]
+            for bucket in plan_spec_buckets(
+                [(s, len(groups[s])) for s in specs]
+            ):
+                if len(bucket) < 2:
+                    continue
+                target = unify_specs(list(bucket))
+                if self.device is not None:
+                    # Padding waste of this coalesced bucket: every lane
+                    # launches at the bucket's nt regardless of need.
+                    self.device.padding(
+                        *family_padding_tiles(
+                            [(s, len(groups[s])) for s in bucket]
+                        )
                     )
-                )
-            merged_rows: list[int] = []
-            for s in specs:
-                merged_rows.extend(groups.pop(s))
-            compiler = self.engine.compiler_for(handle, stats, nt_floor=nt_max)
-            for i in merged_rows:
-                compiled[i] = compiler.compile(requests[i].query)
-            by_spec: dict[tuple, list[int]] = {}
-            for i in merged_rows:
-                by_spec.setdefault(compiled[i].spec, []).append(i)
-            for spec, rows in by_spec.items():
-                groups.setdefault(spec, []).extend(rows)
+                merged_rows: list[int] = []
+                for s in bucket:
+                    rows = groups.pop(s)
+                    for i in rows:
+                        compiled[i] = CompiledQuery(
+                            spec=target,
+                            arrays=pad_arrays_to_spec(
+                                compiled[i].spec, target, compiled[i].arrays
+                            ),
+                        )
+                    merged_rows.extend(rows)
+                groups.setdefault(target, []).extend(merged_rows)
         return groups
 
     # Penalty latency recorded for a backend that RAISED instead of
@@ -1002,8 +1018,13 @@ class SearchService:
 
         spec = compiled.spec
         candidates = ["device"]
-        if spec[0] == "terms" and request.track_total_hits is False:
-            candidates.append("blockmax")
+        if request.track_total_hits is False:
+            # Two-phase tile-pruned paths report "gte" totals, so they are
+            # only eligible when exact totals aren't tracked.
+            if spec[0] == "terms":
+                candidates.append("blockmax")
+            elif bm25_device.supports_blockmax_conj(spec):
+                candidates.append("blockmax_conj")
         if oracle_eligible(request.query):
             candidates.append("oracle")
         plan_class = self.planner.classify(spec, k)
@@ -1153,7 +1174,14 @@ class SearchService:
                             )
                 if backend == "blockmax":
                     s, i, t, _rel = bm25_device.execute_batch_blockmax(
-                        seg_tree, compiled.spec, [compiled.arrays], k
+                        seg_tree, compiled.spec, [compiled.arrays], k,
+                        instruments=self.device,
+                    )
+                    scores, ids, tot = s[0], i[0], int(t[0])
+                elif backend == "blockmax_conj":
+                    s, i, t, _rel = bm25_device.execute_batch_blockmax_conj(
+                        seg_tree, compiled.spec, [compiled.arrays], k,
+                        instruments=self.device,
                     )
                     scores, ids, tot = s[0], i[0], int(t[0])
                 elif backend == "device":

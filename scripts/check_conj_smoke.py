@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""CI smoke gate for the conjunction execution stack (ISSUE 5).
+
+Runs the conjunction-kernel parity suite on the CPU backend — no TPU
+needed: lead-clause selection follows clause selectivity, the two-phase
+block-max prune is exact at tiny k, empty-intersection conjunctions
+return zero hits everywhere, and bucketed batched execution is
+bit-identical to sequential. The same tests ride the tier-1 run via the
+fast (`not slow`) marker; this script is the standalone hook for
+pre-merge / cron checks:
+
+    python scripts/check_conj_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "tests/test_conj_kernel.py",
+        "-q",
+        "-m",
+        "not slow",
+        "-p",
+        "no:cacheprovider",
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

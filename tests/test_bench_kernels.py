@@ -51,12 +51,18 @@ def _queries(segment, rng, nq=6):
 
 
 def test_execute_sequential_matches_per_query():
+    from elasticsearch_tpu.query.compile import equalize_compiled
+
     mappings, segment, dev = _corpus()
     seg = bm25_device.segment_tree(dev)
     compiler = Compiler(dev.fields, dev.doc_values, mappings, nt_floor=NT_FLOOR)
     rng = np.random.default_rng(7)
     compiled = [compiler.compile(q) for q in _queries(segment, rng)]
-    assert len({c.spec for c in compiled}) == 1, "bucket floor must unify specs"
+    # Per-query lead-clause choices may split the batch into spec groups;
+    # equalization (which also resolves mixed leads to the must-driven
+    # fold) restores the single shared spec this batched scan needs.
+    compiled = equalize_compiled(compiled)
+    assert len({c.spec for c in compiled}) == 1, "equalize must unify specs"
     spec = compiled[0].spec
     import jax
 
@@ -124,13 +130,15 @@ def test_execute_shards_matches_oracle_merge(sharded_corpus):
     queries = _queries(segments[0], rng, nq=4)
     import jax
 
+    from elasticsearch_tpu.query.compile import equalize_compiled
+
     for query in queries:
-        per_shard = [
+        per_shard = equalize_compiled([
             Compiler(d.fields, d.doc_values, mappings, nt_floor=NT_FLOOR).compile(
                 query
             )
             for d in devs
-        ]
+        ])
         assert len({c.spec for c in per_shard}) == 1
         spec = per_shard[0].spec
         arrays = jax.tree.map(
@@ -155,20 +163,24 @@ def test_execute_shards_batch_and_sequential(sharded_corpus):
     queries = _queries(segments[0], rng, nq=4)
     import jax
 
+    from elasticsearch_tpu.query.compile import equalize_compiled
+
+    # Equalize every (query, shard) plan to ONE shared spec (per-position
+    # bucket maxima; mixed lead choices resolve to the must-driven fold).
+    flat = equalize_compiled([
+        Compiler(d.fields, d.doc_values, mappings, nt_floor=NT_FLOOR).compile(
+            query
+        )
+        for query in queries
+        for d in devs
+    ])
+    spec = flat[0].spec
     all_compiled = []
-    for query in queries:
-        per_shard = [
-            Compiler(d.fields, d.doc_values, mappings, nt_floor=NT_FLOOR).compile(
-                query
-            )
-            for d in devs
-        ]
+    for qi in range(len(queries)):
+        per_shard = flat[qi * len(devs) : (qi + 1) * len(devs)]
         all_compiled.append(
             jax.tree.map(lambda *xs: np.stack(xs), *[c.arrays for c in per_shard])
         )
-    spec = Compiler(
-        devs[0].fields, devs[0].doc_values, mappings, nt_floor=NT_FLOOR
-    ).compile(queries[0]).spec
     batched = jax.tree.map(lambda *xs: np.stack(xs), *all_compiled)
     s_b, g_b, t_b = jax.device_get(
         bm25_device.execute_shards_batch(stacked, spec, batched, 10, n_pad)

@@ -1,0 +1,473 @@
+"""Conjunction-kernel parity: selective-clause lead folds, block-max
+tile pruning, and adaptive worklist sub-bucketing never change results.
+
+The config-3 contract (ISSUE 5): the sparse conjunction kernels — the
+must-driven candidate fold, the lead-driven (filter-led) fold chosen by
+compile-time selectivity, and the two-phase block-max prune — must return
+the SAME top-10 ids in the SAME order with fp32-equal scores and
+identical totals as both the dense device path and the CPU oracle; and
+bucketed batched execution (queries padded only to their own pow-2
+sub-bucket) must be bit-identical to sequential per-request execution.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.ops import bm25_device
+from elasticsearch_tpu.query.compile import (
+    SpecUnifyError,
+    equalize_compiled,
+    pad_arrays_to_spec,
+    unify_specs,
+)
+from elasticsearch_tpu.search.oracle import OracleSearcher
+from elasticsearch_tpu.search.service import SearchRequest, SearchService
+
+# Zipf-skewed vocabulary: head terms appear in most docs, tail terms in a
+# handful — queries mixing ranks exercise both lead-clause directions.
+VOCAB = [f"w{i:02d}" for i in range(28)]
+TAGS = ["red", "green", "blue", "rare"]
+
+MAPPINGS = Mappings(
+    properties={
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "rank": {"type": "long"},
+    }
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    rng = np.random.default_rng(17)
+    weights = 1.0 / (np.arange(1, len(VOCAB) + 1) ** 1.1)
+    probs = weights / weights.sum()
+    eng = Engine(MAPPINGS)
+    for i in range(500):
+        n_tokens = int(rng.integers(3, 16))
+        eng.index(
+            {
+                "body": " ".join(rng.choice(VOCAB, n_tokens, p=probs)),
+                "tag": "rare" if i % 41 == 0 else str(rng.choice(TAGS[:3])),
+                "rank": int(rng.integers(0, 1000)),
+            },
+            f"d{i}",
+        )
+    eng.refresh()
+    assert len(eng.segments) == 1
+    return eng
+
+
+def _random_conj(rng) -> dict:
+    """Random bool body: multi-term must, msm variants, term/range
+    filters, must_nots, df skew across the whole vocabulary."""
+    n_must = int(rng.integers(1, 5))
+    clauses: dict = {
+        "must": [
+            {"match": {"body": " ".join(rng.choice(VOCAB, n_must))}}
+        ]
+    }
+    roll = rng.random()
+    if roll < 0.45:
+        clauses["filter"] = [{"term": {"tag": str(rng.choice(TAGS))}}]
+    elif roll < 0.75:
+        clauses["filter"] = [{"term": {"body": str(rng.choice(VOCAB))}}]
+    if rng.random() < 0.3:
+        clauses.setdefault("filter", []).append(
+            {"range": {"rank": {"gte": int(rng.integers(0, 800))}}}
+        )
+    if rng.random() < 0.3:
+        clauses["must_not"] = [{"term": {"tag": str(rng.choice(TAGS))}}]
+    if rng.random() < 0.25:
+        clauses["should"] = [{"match": {"body": str(rng.choice(VOCAB))}}]
+        if rng.random() < 0.5:
+            clauses["minimum_should_match"] = 1
+    return {"bool": clauses}
+
+
+def _run_kernel(eng, handle, seg, query, k=10, kernel="auto"):
+    compiled = eng.compiler_for(handle).compile(query)
+    if kernel == "dense":
+        s, i, t = bm25_device.execute(seg, compiled.spec, compiled.arrays, k)
+    else:
+        s, i, t = bm25_device.execute_auto(
+            seg, compiled.spec, compiled.arrays, k
+        )
+    s, i = np.asarray(s), np.asarray(i)
+    n = min(k, int(t))
+    return s[:n], [int(x) for x in i[:n]], int(t), compiled.spec
+
+
+def test_fuzz_conj_kernels_match_dense_and_oracle(engine):
+    """>= 60 randomized bool queries: the sparse conjunction kernels
+    (must-driven AND lead-driven) == dense device path == CPU oracle on
+    top-10 ids + order + fp32 scores + totals."""
+    from elasticsearch_tpu.query.dsl import parse_query
+
+    rng = np.random.default_rng(23)
+    handle = engine.segments[0]
+    seg = bm25_device.segment_tree(handle.device)
+    oracle = OracleSearcher(
+        handle.segment, MAPPINGS, stats=engine.field_stats()
+    )
+    checked = lead_runs = must_runs = 0
+    for _ in range(64):
+        body = _random_conj(rng)
+        query = parse_query(body)
+        a_s, a_i, a_t, spec = _run_kernel(engine, handle, seg, query)
+        d_s, d_i, d_t, _ = _run_kernel(
+            engine, handle, seg, query, kernel="dense"
+        )
+        o_scores, o_ids, o_t = oracle.search(query, 10)
+        assert a_t == d_t == o_t, f"totals diverge for {body}"
+        assert a_i == d_i == [int(x) for x in o_ids], (
+            f"ids/order diverge for {body}"
+        )
+        np.testing.assert_allclose(
+            a_s, np.asarray(o_scores, np.float32), rtol=1e-6, atol=1e-6,
+            err_msg=f"scores diverge for {body}",
+        )
+        np.testing.assert_allclose(a_s, d_s, rtol=1e-6, atol=1e-6)
+        if spec[0] == "bool" and bm25_device.supports_sparse(spec):
+            if spec[6] >= 0:
+                lead_runs += 1
+                # Lead-driven fold matches the oracle bit-exactly (same
+                # per-term fp32 accumulation order).
+                np.testing.assert_array_equal(
+                    a_s, np.asarray(o_scores, np.float32)
+                )
+            else:
+                must_runs += 1
+        checked += 1
+    assert checked >= 60
+    # df skew must exercise BOTH candidate-generation directions.
+    assert lead_runs >= 5, "no filter-led conjunctions were generated"
+    assert must_runs >= 5, "no must-led conjunctions were generated"
+
+
+def test_lead_selection_follows_selectivity(engine):
+    """The compiler picks the lowest-df clause as the candidate driver:
+    a rare filter leads; a frequent filter with rarer must terms does
+    not (the must disjunction stays the driver)."""
+    from elasticsearch_tpu.query.dsl import parse_query
+
+    handle = engine.segments[0]
+
+    def spec_for(must_text, filter_clause):
+        q = parse_query(
+            {
+                "bool": {
+                    "must": [{"match": {"body": must_text}}],
+                    "filter": [filter_clause],
+                }
+            }
+        )
+        return engine.compiler_for(handle).compile(q).spec
+
+    # Rare tag (few docs) vs two head terms: the filter leads.
+    rare = spec_for(f"{VOCAB[0]} {VOCAB[1]}", {"term": {"tag": "rare"}})
+    assert rare[0] == "bool" and rare[6] == 0
+    # Head term filter vs two tail must terms: the must disjunction leads.
+    frequent = spec_for(
+        f"{VOCAB[-1]} {VOCAB[-2]}", {"term": {"body": VOCAB[0]}}
+    )
+    assert frequent[0] == "bool" and frequent[6] == -1
+
+
+def test_empty_intersection(engine):
+    """A conjunction whose clauses cannot co-occur returns zero hits on
+    every kernel — including an absent filter term (empty span) and an
+    absent must term."""
+    from elasticsearch_tpu.query.dsl import parse_query
+
+    handle = engine.segments[0]
+    seg = bm25_device.segment_tree(handle.device)
+    oracle = OracleSearcher(
+        handle.segment, MAPPINGS, stats=engine.field_stats()
+    )
+    for body in (
+        {
+            "bool": {
+                "must": [{"match": {"body": VOCAB[0]}}],
+                "filter": [{"term": {"tag": "nonexistent-tag"}}],
+            }
+        },
+        {
+            "bool": {
+                "must": [{"match": {"body": "zz-absent-term"}}],
+                "filter": [{"term": {"tag": "rare"}}],
+            }
+        },
+    ):
+        query = parse_query(body)
+        a_s, a_i, a_t, _spec = _run_kernel(engine, handle, seg, query)
+        assert a_t == 0 and a_i == []
+        _o_s, o_i, o_t = oracle.search(query, 10)
+        assert o_t == 0 and len(o_i) == 0
+
+
+@pytest.fixture(scope="module")
+def big_corpus():
+    """A corpus large enough that conjunction worklists span >= 16 tiles
+    (the two-phase prune path needs a_bucket < nt)."""
+    from elasticsearch_tpu.index.tiles import pack_segment
+    from elasticsearch_tpu.utils.corpus import build_zipf_segment
+
+    mappings, segment = build_zipf_segment(
+        30_000, vocab_size=2_000, seed=11
+    )
+    dev = pack_segment(segment)
+    return mappings, segment, dev
+
+
+def _conj_query(segment, must_ranks, filter_rank, boost=None):
+    from elasticsearch_tpu.query.dsl import parse_query
+
+    fld = segment.fields["body"]
+    by_df = sorted(fld.terms, key=lambda t: -fld.df[fld.terms[t]])
+    must = " ".join(by_df[r] for r in must_ranks)
+    clauses = {
+        "must": [{"match": {"body": must}}],
+        "filter": [{"term": {"body": by_df[filter_rank]}}],
+    }
+    if boost is not None:
+        clauses["boost"] = boost
+    return parse_query({"bool": clauses})
+
+
+def test_blockmax_conj_prune_exact_topk_tiny_k(big_corpus):
+    """Two-phase block-max conjunction at tiny k (strongest pruning):
+    top-k ids/order/scores exactly match the single-launch kernel;
+    totals only ever undercount ("gte"); the prune instrument observes."""
+    from elasticsearch_tpu.obs.metrics import (
+        DeviceInstruments,
+        MetricsRegistry,
+    )
+    from elasticsearch_tpu.query.compile import Compiler
+
+    mappings, segment, dev = big_corpus
+    seg = bm25_device.segment_tree(dev)
+    compiler = Compiler(dev.fields, dev.doc_values, mappings)
+    registry = MetricsRegistry()
+    instr = DeviceInstruments(registry)
+    observed = 0
+    for must_ranks, filter_rank, boost in (
+        ((40, 70), 3, None),
+        ((25, 90, 140), 5, None),
+        ((60, 61), 1, None),
+        # Boosted bool: θ lives in the boosted score space — the prune
+        # must scale the term-weight bounds by the boost or it drops
+        # competitive tiles (regression: the boost-space mismatch).
+        ((40, 70), 3, 2.5),
+        ((25, 90, 140), 5, 0.25),
+    ):
+        query = _conj_query(segment, must_ranks, filter_rank, boost=boost)
+        c = compiler.compile(query)
+        assert bm25_device.supports_blockmax_conj(c.spec), c.spec
+        for k in (1, 3):
+            s_e, i_e, t_e = (
+                np.asarray(x)
+                for x in bm25_device.execute_sparse(seg, c.spec, c.arrays, k)
+            )
+            s_b, i_b, t_b, rel = bm25_device.execute_batch_blockmax_conj(
+                seg, c.spec, [c.arrays], k, instruments=instr
+            )
+            n = min(k, int(t_b[0]), int(t_e))
+            np.testing.assert_array_equal(s_b[0][:n], s_e[:n])
+            np.testing.assert_array_equal(i_b[0][:n], i_e[:n])
+            assert int(t_b[0]) <= int(t_e)
+            assert rel in ("eq", "gte")
+            observed += 1
+    snap = instr.snapshot()["blockmax_pruned_tile_fraction"]
+    assert snap["count"] == observed
+
+
+def test_bucketed_batch_bit_identical_to_sequential(engine):
+    """Sub-bucket batching equivalence: search_many (adaptive coalescing
+    with padded sub-buckets) returns BIT-IDENTICAL scores/ids/totals to
+    per-request sequential search()."""
+    from elasticsearch_tpu.query.dsl import parse_query
+
+    rng = np.random.default_rng(31)
+    svc = SearchService(engine, planner=None)
+    bodies = []
+    # Same family, different natural nt buckets: head terms (fat
+    # worklists) and tail terms (thin ones) force real padding merges.
+    for _ in range(12):
+        n = int(rng.integers(1, 4))
+        bodies.append(
+            {"query": {"match": {"body": " ".join(rng.choice(VOCAB, n))}},
+             "size": 10}
+        )
+    for _ in range(6):
+        bodies.append({"query": _random_conj(rng), "size": 10})
+    requests = [SearchRequest.from_json(b) for b in bodies]
+    batched = svc.search_many(requests)
+    for body, got in zip(bodies, batched):
+        assert not isinstance(got, Exception), got
+        solo = svc.search(SearchRequest.from_json(body))
+        assert [h.doc_id for h in got.hits] == [h.doc_id for h in solo.hits]
+        got_scores = [h.score for h in got.hits]
+        solo_scores = [h.score for h in solo.hits]
+        assert got_scores == solo_scores, f"scores not bit-identical: {body}"
+        assert got.total == solo.total
+    # parse_query referenced for flake8 friendliness of the shared import
+    assert parse_query({"match_all": {}}) is not None
+
+
+def test_pad_arrays_equalization_bit_identical(engine):
+    """pad_arrays_to_spec: executing a plan padded to a larger unified
+    spec is bit-identical to its natural-bucket execution."""
+    from elasticsearch_tpu.query.dsl import parse_query
+
+    handle = engine.segments[0]
+    seg = bm25_device.segment_tree(handle.device)
+    compiler = engine.compiler_for(handle)
+    thin = compiler.compile(
+        parse_query(
+            {
+                "bool": {
+                    "must": [{"match": {"body": f"{VOCAB[-1]} {VOCAB[-2]}"}}],
+                    "filter": [{"term": {"tag": "red"}}],
+                }
+            }
+        )
+    )
+    fat = compiler.compile(
+        parse_query(
+            {
+                "bool": {
+                    "must": [{"match": {"body": f"{VOCAB[0]} {VOCAB[1]}"}}],
+                    "filter": [{"term": {"tag": "red"}}],
+                }
+            }
+        )
+    )
+    target = unify_specs([thin.spec, fat.spec])
+    padded = pad_arrays_to_spec(thin.spec, target, thin.arrays)
+    for k in (3, 10):
+        s_n, i_n, t_n = (
+            np.asarray(x)
+            for x in bm25_device.execute_auto(seg, thin.spec, thin.arrays, k)
+        )
+        s_p, i_p, t_p = (
+            np.asarray(x)
+            for x in bm25_device.execute_auto(seg, target, padded, k)
+        )
+        assert int(t_n) == int(t_p)
+        n = min(k, int(t_n))
+        np.testing.assert_array_equal(s_n[:n], s_p[:n])
+        np.testing.assert_array_equal(i_n[:n], i_p[:n])
+    # Dense path too (the padding contract is kernel-independent).
+    s_n, i_n, t_n = (
+        np.asarray(x)
+        for x in bm25_device.execute(seg, thin.spec, thin.arrays, 10)
+    )
+    s_p, i_p, t_p = (
+        np.asarray(x) for x in bm25_device.execute(seg, target, padded, 10)
+    )
+    assert int(t_n) == int(t_p)
+    n = min(10, int(t_n))
+    np.testing.assert_array_equal(s_n[:n], s_p[:n])
+    np.testing.assert_array_equal(i_n[:n], i_p[:n])
+
+
+def test_unify_specs_contract():
+    """unify_specs: per-position bucket maxima; lead disagreement resolves
+    to the must-driven fold; structural divergence raises."""
+    t_a = ("terms", "body", 8, 2)
+    t_b = ("terms", "body", 32, 2)
+    assert unify_specs([t_a, t_b]) == ("terms", "body", 32, 2)
+    bool_a = ("bool", (t_a,), (), (("terms_const", "body", 4, 1),), (), -1, 0)
+    bool_b = ("bool", (t_b,), (), (("terms_const", "body", 16, 1),), (), -1, -1)
+    merged = unify_specs([bool_a, bool_b])
+    assert merged == (
+        "bool",
+        (("terms", "body", 32, 2),),
+        (),
+        (("terms_const", "body", 16, 1),),
+        (),
+        -1,
+        -1,  # mixed leads fall back to the must-driven fold
+    )
+    with pytest.raises(SpecUnifyError):
+        unify_specs([t_a, ("terms", "title", 8, 2)])  # field differs
+    with pytest.raises(SpecUnifyError):
+        unify_specs([t_a, ("terms", "body", 8, 4)])  # t_pad differs
+
+
+def test_equalize_compiled_roundtrip(engine):
+    """equalize_compiled unifies mixed-bucket compilations of the same
+    query family into one spec without touching results."""
+    from elasticsearch_tpu.query.dsl import parse_query
+
+    handle = engine.segments[0]
+    compiler = engine.compiler_for(handle)
+    compiled = [
+        compiler.compile(parse_query({"match": {"body": w}}))
+        for w in (VOCAB[0], VOCAB[-1], VOCAB[10])
+    ]
+    out = equalize_compiled(compiled)
+    assert len({c.spec for c in out}) == 1
+    assert out[0].spec[2] == max(c.spec[2] for c in compiled)
+
+
+def test_plan_spec_buckets_cost_rule():
+    """The adaptive coalescer merges only when padding costs less than a
+    launch: tiny groups join a fat bucket; a large row-count group with a
+    big bucket gap keeps its own launch."""
+    from elasticsearch_tpu.exec.batcher import plan_spec_buckets
+
+    fat = ("terms", "body", 1024, 2)
+    thin = ("terms", "body", 8, 2)
+    # One thin row: padding 1016 tiles ~0.4 ms < one launch ~0.9 ms.
+    merged = plan_spec_buckets([(fat, 1), (thin, 1)])
+    assert len(merged) == 1 and set(merged[0]) == {fat, thin}
+    # 64 thin rows across 8 shards: padding >> launch — keep two buckets.
+    split = plan_spec_buckets([(fat, 4), (thin, 64)], n_shards=8)
+    assert len(split) == 2
+    # Structurally incompatible specs never merge.
+    other = ("terms", "title", 8, 2)
+    assert len(plan_spec_buckets([(fat, 1), (other, 1)])) == 2
+
+
+def test_blockmax_conj_routing_parity(engine):
+    """Serving-path routing: a forced blockmax_conj backend (untracked
+    totals) returns the same top-10 ids/order/scores as the device path,
+    and the prune instrument surfaces in the device stats section."""
+    from elasticsearch_tpu.exec import ExecPlanner
+    from elasticsearch_tpu.obs.metrics import (
+        DeviceInstruments,
+        MetricsRegistry,
+    )
+
+    class Forced(ExecPlanner):
+        def decide(self, plan_class, candidates, feats=None):
+            return (
+                "blockmax_conj"
+                if "blockmax_conj" in candidates
+                else candidates[0]
+            )
+
+    registry = MetricsRegistry()
+    instruments = DeviceInstruments(registry)
+    svc_dev = SearchService(engine, planner=None)
+    svc_bmx = SearchService(engine, planner=Forced(), device=instruments)
+    body = {
+        "query": {
+            "bool": {
+                "must": [{"match": {"body": f"{VOCAB[3]} {VOCAB[5]}"}}],
+                "filter": [{"term": {"body": VOCAB[0]}}],
+            }
+        },
+        "size": 10,
+        "track_total_hits": False,
+    }
+    dev = svc_dev.search(SearchRequest.from_json(body))
+    bmx = svc_bmx.search(SearchRequest.from_json(body))
+    assert [h.doc_id for h in bmx.hits] == [h.doc_id for h in dev.hits]
+    assert [h.score for h in bmx.hits] == [h.score for h in dev.hits]
+    snap = instruments.snapshot()
+    assert "blockmax_pruned_tile_fraction" in snap

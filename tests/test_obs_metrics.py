@@ -235,6 +235,24 @@ class TestDeviceInstruments:
         dev.h2d({"a": np.zeros(8, np.float32), "b": np.zeros(4, np.int32)})
         assert reg.value("estpu_device_h2d_bytes_total") == 48
 
+    def test_blockmax_pruned_tile_fraction(self):
+        """The two-phase prune-effectiveness instrument: histogram series
+        are Prometheus-valid and the stats view reports count + mean."""
+        reg = MetricsRegistry()
+        dev = DeviceInstruments(reg)
+        snap = dev.snapshot()["blockmax_pruned_tile_fraction"]
+        assert snap == {"count": 0, "mean": 0.0}  # present before any obs
+        dev.blockmax_pruned(0.75)
+        dev.blockmax_pruned(0.25)
+        dev.blockmax_pruned(1.5)  # clamped into [0, 1]
+        snap = dev.snapshot()["blockmax_pruned_tile_fraction"]
+        assert snap["count"] == 3
+        assert snap["mean"] == pytest.approx(2.0 / 3.0, abs=1e-3)
+        families = parse_prometheus(reg.exposition())
+        assert_histogram_series_valid(
+            families, "estpu_device_blockmax_pruned_tile_fraction"
+        )
+
 
 class TestNodeStatsMigration:
     """`_nodes/stats` stays backward compatible after the counter dicts
