@@ -962,7 +962,7 @@ def main():
     ):
         try:
             configs[name] = fn()
-        except Exception as e:  # report, don't zero the headline
+        except Exception as e:  # staticcheck: ignore[broad-except] per-config isolation: one failing bench config reports its error instead of zeroing the headline; no tasks or fault sites flow here
             configs[name] = {"error": f"{type(e).__name__}: {e}"}
     configs["cfg2_disjunction"] = {
         "speedup": round(speedup_single, 2),

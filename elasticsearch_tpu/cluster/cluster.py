@@ -1409,6 +1409,7 @@ class LocalCluster:
             while not self._stop.is_set():
                 try:
                     self.step()
+                # staticcheck: ignore[broad-except] daemon control-plane stepper: must survive any transient step error and retry next tick; owns no task
                 except Exception:
                     pass
                 time.sleep(interval_s)

@@ -173,6 +173,7 @@ class ReplicationGateway:
                     if span is not None and attempt:
                         span.tags["retries"] = attempt
                     return result
+                # staticcheck: ignore[broad-except] classification handler: the _retryable() whitelist re-raises everything else (incl. TaskCancelledError) on the next line
                 except Exception as e:
                     if not self._retryable(e):
                         raise
@@ -191,6 +192,7 @@ class ReplicationGateway:
                         # Failure detection + election + promotion +
                         # healing: why the NEXT attempt can succeed.
                         self.cluster.step()
+                    # staticcheck: ignore[broad-except] best-effort control-plane nudge between retries; a failure here only delays the next attempt
                     except Exception:
                         pass
                     delay = min(

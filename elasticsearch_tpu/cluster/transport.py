@@ -147,6 +147,7 @@ class TransportHub:
                 return handler(from_id, action, payload)
             except (ConnectTransportError, RemoteActionError):
                 raise
+            # staticcheck: ignore[broad-except] wire boundary: a remote handler failure must cross as RemoteActionError exactly like a real RPC (chaos parity includes injected faults)
             except Exception as e:  # remote handler failure crosses the wire
                 raise RemoteActionError(
                     f"[{action}] on [{to_id}]: {e}",

@@ -27,6 +27,7 @@ class Task:
     action: str
     description: str
     cancellable: bool = True
+    # staticcheck: ignore[wallclock-duration] user-facing start_time_in_millis is an epoch timestamp; runtime uses start_mono below
     start_ms: float = field(default_factory=lambda: time.time() * 1000)
     # Monotonic start: running_time_in_nanos must survive wall-clock
     # steps (NTP slew during a long search would otherwise report a

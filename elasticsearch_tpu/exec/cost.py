@@ -65,6 +65,13 @@ def coalesce_wins(extra_pad_tiles: int) -> bool:
     return _DEVICE_TILE_MS * max(0, extra_pad_tiles) <= _DEVICE_LAUNCH_MS
 
 
+# Backends priced by the device launch+tiles formula below. Every
+# ExecPlanner.BACKENDS entry must be named either here or in a seed_ms
+# branch (staticcheck registry-backend rule): an unlisted backend would
+# silently inherit a formula nobody chose for it.
+_DEVICE_LIKE = ("device", "device_batched", "mesh_spmd")
+
+
 def seed_ms(backend: str, feats: PlanFeatures) -> float:
     """Closed-form prior cost (ms) for one query on one backend."""
     shards = max(1, feats.n_shards)
@@ -85,7 +92,10 @@ def seed_ms(backend: str, feats: PlanFeatures) -> float:
     # scales with the corpus. The caller picks which by setting work_tiles
     # (sparse) vs n_docs-dominated features (dense has work_tiles == 0).
     cost = _DEVICE_LAUNCH_MS + _DEVICE_TILE_MS * feats.work_tiles * shards
-    if feats.work_tiles == 0:
+    if backend in _DEVICE_LIKE and feats.work_tiles == 0:
+        # An unknown (plugin) backend gets only the conservative launch
+        # floor: MIN_OBS exploration tries it regardless, and its EWMA
+        # takes over from there — no reason to presume the dense tax.
         cost += _DEVICE_DENSE_MS * (feats.n_docs / 1e6) * max(
             1, feats.n_clauses
         ) * shards

@@ -90,6 +90,7 @@ def ast_signature(query: Query) -> tuple:
     on it. Texts/values are deliberately excluded; only structure, fields
     and clause-count buckets remain."""
     if isinstance(query, BoolQuery):
+        # staticcheck: ignore[bool-spec] this is a batching SIGNATURE over the query AST, not the arity-7 compiled bool spec
         return (
             "bool",
             tuple(ast_signature(c) for c in query.must),

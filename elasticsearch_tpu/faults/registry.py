@@ -51,6 +51,20 @@ class InjectedFaultError(RuntimeError):
     absorbs it)."""
 
 
+# Canonical site registry: every `fault_point(site)` call in the serving
+# stack must match one of these patterns, and every pattern must have a
+# live call site — enforced by `python -m staticcheck` (the
+# registry-fault-site rule), so a renamed or misspelled site can never
+# silently become a chaos hook that no spec can arm.
+SITES = (
+    "search.kernel",
+    "coordinator.shard",
+    "batcher.launch",
+    "transport.send.*",
+    "breaker.reserve",
+)
+
+
 _ERROR_KINDS = ("internal", "transport", "breaker")
 
 

@@ -54,6 +54,22 @@ from .tiles import (
 from .translog import Translog
 
 
+def _mono_to_wall_ts(mono_ts: float) -> float:
+    """Monotonic instant -> wall-clock epoch seconds, at a persistence
+    boundary. In-memory tombstone ages use time.monotonic() (NTP-step
+    immune); only the persisted form may (and must) be wall clock, since
+    monotonic readings are meaningless across processes."""
+    # staticcheck: ignore[wallclock-duration] persistence boundary: monotonic readings do not survive a restart, epoch does
+    return mono_ts - time.monotonic() + time.time()
+
+
+def _wall_to_mono_ts(wall_ts: float) -> float:
+    """Wall-clock epoch seconds (from a commit/snapshot) -> this
+    process's monotonic clock, preserving the recorded age."""
+    # staticcheck: ignore[wallclock-duration] persistence boundary: converting a persisted epoch age back onto the monotonic clock
+    return wall_ts - time.time() + time.monotonic()
+
+
 class InvalidCasError(ValueError):
     """Malformed CAS request (one-sided if_seq_no/if_primary_term) — 400."""
 
@@ -156,7 +172,12 @@ class Engine:
         # reference after tombstone GC.
         self._versions: dict[str, int] = {}
         self._doc_seqnos: dict[str, int] = {}  # _id -> seqno of last op
-        self._tombstone_ts: dict[str, float] = {}  # _id -> delete wall time
+        # _id -> MONOTONIC delete time: gc_deletes measures an age, and a
+        # wall clock stepped by NTP would prune tombstones early (version
+        # lines break) or never. Persistence boundaries (commit/snapshot)
+        # convert to wall time so values stay comparable across restarts
+        # — see _mono_to_wall_ts/_wall_to_mono_ts.
+        self._tombstone_ts: dict[str, float] = {}
         self.gc_deletes_s = 60.0
         self._stats_cache: dict[str, FieldStats] | None = None
         # Replication state (index/seqno.py): the local checkpoint is the
@@ -323,7 +344,7 @@ class Engine:
             if found:
                 self._versions[doc_id] = version
                 self._doc_seqnos[doc_id] = seqno
-                self._tombstone_ts[doc_id] = time.time()
+                self._tombstone_ts[doc_id] = time.monotonic()
                 op = {
                     "seqno": seqno,
                     "op": "delete",
@@ -385,7 +406,7 @@ class Engine:
                 self._delete_existing(doc_id)
                 self._versions[doc_id] = version
                 self._doc_seqnos[doc_id] = seqno
-                self._tombstone_ts[doc_id] = time.time()
+                self._tombstone_ts[doc_id] = time.monotonic()
         self._seqno = max(self._seqno, seqno)
         if write_translog and self.translog is not None:
             self.translog.add(op)
@@ -778,14 +799,7 @@ class Engine:
                     "next_seg_id": self._next_seg_id,
                     # Delete tombstones ride in the commit so the version
                     # line survives restart (until gc_deletes prunes them).
-                    "tombstones": {
-                        doc_id: [
-                            self._versions.get(doc_id, 1),
-                            self._doc_seqnos.get(doc_id, -1),
-                            ts,
-                        ]
-                        for doc_id, ts in self._tombstone_ts.items()
-                    },
+                    "tombstones": self.export_tombstones(),
                 },
             )
             if self.translog is not None:
@@ -804,9 +818,23 @@ class Engine:
         if self.translog is not None:
             self.translog.close()
 
+    def export_tombstones(self) -> dict[str, list]:
+        """{_id: [version, seqno, wall_ts]} for persistence (commit point
+        and snapshot manifests): in-memory tombstone times are monotonic
+        (see __init__), so the persisted form converts to wall clock —
+        the only representation comparable across process restarts."""
+        return {
+            doc_id: [
+                self._versions.get(doc_id, 1),
+                self._doc_seqnos.get(doc_id, -1),
+                _mono_to_wall_ts(ts),
+            ]
+            for doc_id, ts in self._tombstone_ts.items()
+        }
+
     def _gc_tombstones(self) -> None:
         """Prune delete tombstones older than gc_deletes (ES gc_deletes)."""
-        cutoff = time.time() - self.gc_deletes_s
+        cutoff = time.monotonic() - self.gc_deletes_s
         expired = [
             doc_id for doc_id, ts in self._tombstone_ts.items() if ts < cutoff
         ]
@@ -828,7 +856,7 @@ class Engine:
         ).items():
             self._versions[doc_id] = int(version)
             self._doc_seqnos[doc_id] = int(seqno)
-            self._tombstone_ts[doc_id] = float(ts)
+            self._tombstone_ts[doc_id] = _wall_to_mono_ts(float(ts))
         for seg_id in commit["segments"]:
             segment, live = store.load_segment(self.data_path, seg_id)
             # _recovering makes the breaker account without rejecting:
@@ -895,7 +923,7 @@ class Engine:
                     continue
                 self._versions[doc_id] = int(version)
                 self._doc_seqnos[doc_id] = int(seqno)
-                self._tombstone_ts[doc_id] = float(ts)
+                self._tombstone_ts[doc_id] = _wall_to_mono_ts(float(ts))
 
     def _replay_translog(self) -> None:
         """Re-apply ops above the commit's seqno (recoverFromTranslog).

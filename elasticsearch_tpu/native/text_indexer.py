@@ -151,5 +151,6 @@ class NativeAccumulator:
     def __del__(self):  # accumulator lifetime == builder lifetime
         try:
             self.close()
+        # staticcheck: ignore[broad-except] __del__ must never raise; the native handle is gone either way
         except Exception:
             pass

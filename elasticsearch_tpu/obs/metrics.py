@@ -87,6 +87,7 @@ class Gauge:
         if self._fn is not None:
             try:
                 return float(self._fn())
+            # staticcheck: ignore[broad-except] a failing gauge callback must not 500 the scrape; the sample reads 0
             except Exception:
                 return 0.0
         with self._lock:
@@ -327,6 +328,66 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+# Instrument catalog: every estpu_* instrument in the codebase, its
+# kind, and the `_nodes/stats` section that renders it. This is the
+# machine-checked contract (staticcheck registry-metric rule) that keeps
+# `GET /_metrics` (automatic: every registered family is exposed) and
+# `GET /_nodes/stats` (hand-built views) over the SAME instruments: a
+# new instrument must be cataloged with its stats section, a renamed one
+# must update its catalog entry, and a dead entry fails the gate.
+CATALOG = {
+    "estpu_exec_planner_decisions_total": ("counter", "exec.planner"),
+    "estpu_exec_batcher_batches_total": ("counter", "exec.batcher"),
+    "estpu_exec_batcher_requests_total": ("counter", "exec.batcher"),
+    "estpu_exec_batcher_coalesced_requests_total": (
+        "counter",
+        "exec.batcher",
+    ),
+    "estpu_exec_batcher_queue_cancellations_total": (
+        "counter",
+        "exec.batcher",
+    ),
+    "estpu_exec_batcher_shed_total": ("counter", "exec.batcher"),
+    "estpu_exec_batcher_retried_individually_total": (
+        "counter",
+        "exec.batcher",
+    ),
+    "estpu_exec_batcher_groups_quarantined_total": (
+        "counter",
+        "exec.batcher",
+    ),
+    "estpu_exec_batcher_quarantine_hits_total": ("counter", "exec.batcher"),
+    "estpu_exec_batcher_occupancy": ("histogram", "exec.batcher"),
+    "estpu_exec_batcher_queue_wait_ms": ("histogram", "exec.batcher"),
+    "estpu_exec_batcher_queued": ("gauge", "exec.batcher"),
+    "estpu_device_launches_total": ("counter", "device"),
+    "estpu_device_compile_total": ("counter", "device"),
+    "estpu_device_compile_ms_total": ("counter", "device"),
+    "estpu_device_h2d_bytes_total": ("counter", "device"),
+    "estpu_device_padded_tiles_total": ("counter", "device"),
+    "estpu_device_actual_tiles_total": ("counter", "device"),
+    "estpu_device_padding_waste_ratio": ("histogram", "device"),
+    "estpu_device_blockmax_pruned_tile_fraction": ("histogram", "device"),
+    "estpu_request_cache_hits_total": ("counter", "indices.request_cache"),
+    "estpu_request_cache_misses_total": (
+        "counter",
+        "indices.request_cache",
+    ),
+    "estpu_request_cache_evictions_total": (
+        "counter",
+        "indices.request_cache",
+    ),
+    "estpu_request_cache_entries": ("gauge", "indices.request_cache"),
+    "estpu_faults_armed": ("gauge", "faults"),
+    "estpu_traces_buffered": ("gauge", "obs"),
+    "estpu_search_resilience_total": ("counter", "search_resilience"),
+    "estpu_cluster_search_resilience_total": (
+        "counter",
+        "replication.search_resilience",
+    ),
+    "estpu_replication_gateway_total": ("counter", "replication.gateway"),
+}
+
 # Pow-2-ish bounds for the padding-waste ratio and occupancy/wait shapes.
 PADDING_RATIO_BUCKETS = (0.0, 0.05, 0.1, 0.2, 0.3, 0.5, 0.7, 0.9)
 # Fraction of a worklist the two-phase block-max prune dropped.
@@ -385,6 +446,7 @@ class DeviceInstruments:
                 getattr(leaf, "nbytes", 0)
                 for leaf in jax.tree.leaves(arrays)
             )
+        # staticcheck: ignore[broad-except] H2D byte accounting is best-effort observability; fall back to a plain .nbytes read
         except Exception:
             nbytes = getattr(arrays, "nbytes", 0)
         if nbytes:

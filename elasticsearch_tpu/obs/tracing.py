@@ -110,6 +110,7 @@ class Span:
         self.events.append(
             {
                 "name": name,
+                # staticcheck: ignore[wallclock-duration] user-facing event epoch timestamp in the trace export, not a duration
                 "timestamp_ms": time.time() * 1e3,
                 **attrs,
             }
@@ -235,6 +236,7 @@ class Tracer:
             span_id=_new_span_id(),
             parent_id=parent[1] if parent else None,
             name=name,
+            # staticcheck: ignore[wallclock-duration] user-facing span start epoch timestamp; durations come from start_mono
             start_ms=time.time() * 1e3,
             start_mono=time.monotonic(),
             tags=dict(tags),
@@ -272,6 +274,7 @@ class Tracer:
             span_id=_new_span_id(),
             parent_id=parent_id,
             name=name,
+            # staticcheck: ignore[wallclock-duration] user-facing span start epoch timestamp; durations come from start_mono
             start_ms=time.time() * 1e3,
             start_mono=time.monotonic(),
             tags=dict(tags),
@@ -310,6 +313,7 @@ class Tracer:
         if handle.span is None:
             return
         handle.span.start_mono = start_mono
+        # staticcheck: ignore[wallclock-duration] reconstructs the span's epoch start for the trace export; elapsed part stays monotonic
         handle.span.start_ms = time.time() * 1e3 - max(
             0.0, (time.monotonic() - start_mono) * 1e3
         )

@@ -837,6 +837,7 @@ def _sparse_bool_inner(seg, spec, arrays, k: int):
     kp = min(kk, p)
     top_scores, top_pos = jax.lax.top_k(key, kp)
     top_ids = docs_s[top_pos]
+    # staticcheck: ignore[traced-branch] kp and kk are Python ints derived from the static spec's worklist shape, not traced values; the branch is resolved at trace time
     if kp < kk:
         top_scores = jnp.pad(top_scores, (0, kk - kp), constant_values=NEG_INF)
         top_ids = jnp.pad(top_ids, (0, kk - kp), constant_values=0)
@@ -995,6 +996,7 @@ def _sparse_terms_inner(seg, spec, arrays, k: int):
     kp = min(kk, p)
     top_scores, top_pos = jax.lax.top_k(key, kp)
     top_ids = docs_s[top_pos]
+    # staticcheck: ignore[traced-branch] kp and kk are Python ints derived from the static spec's worklist shape, not traced values; the branch is resolved at trace time
     if kp < kk:  # more hits requested than candidate slots: pad
         top_scores = jnp.pad(
             top_scores, (0, kk - kp), constant_values=NEG_INF
@@ -1489,6 +1491,7 @@ def _with_must_nt(spec, nt: int):
     """The bool spec with its (single) must child re-bucketed to nt."""
     must_spec = spec[1][0]
     new_must = (must_spec[0], must_spec[1], nt, must_spec[3])
+    # staticcheck: ignore[bool-spec] star-tail rebuild copies every other field verbatim, so arity is preserved by construction (ops/ stays import-free of query/compile)
     return ("bool", (new_must,), *spec[2:])
 
 

@@ -553,6 +553,7 @@ class MeshView:
         idx = snap.index
         try:
             compiled = idx.compile(request.query)
+        # staticcheck: ignore[broad-except] compile fallback: non-shard-uniform plans route to the host loop, which re-raises user-facing validation errors identically
         except Exception:
             # Plans the mesh can't make shard-uniform fall back; user-facing
             # validation errors re-raise identically from the host path.
@@ -571,6 +572,7 @@ class MeshView:
                 idx.docs_per_shard,
             )
             scores, gids = np.asarray(scores), np.asarray(gids)
+        # staticcheck: ignore[broad-except] execute failures (incl. injected ones) must feed the mesh circuit breaker and fall back — the breaker's error classification is the tested behavior
         except Exception as e:
             # Execute-stage failure (XLA lowering, device OOM holding the
             # mesh copy): fall back to the host loop and feed the breaker —
@@ -632,6 +634,7 @@ def maybe_mesh_view(engines, mappings, params) -> MeshView | None:
         from jax.sharding import Mesh
 
         devices = jax.devices()
+    # staticcheck: ignore[broad-except] device-probe guard: no usable mesh means host-loop serving, not an error
     except Exception:
         return None
     if len(devices) < len(engines):

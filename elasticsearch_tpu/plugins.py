@@ -95,6 +95,7 @@ class PluginRegistry:
         except PluginError:
             self._staged = []
             raise
+        # staticcheck: ignore[broad-except] plugin registration crash is translated to PluginError with staged registrations rolled back; nothing to cancel at load time
         except Exception as e:
             self._staged = []
             raise PluginError(

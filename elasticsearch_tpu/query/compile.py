@@ -299,6 +299,37 @@ def _terms_arrays(
     return spec, arrays
 
 
+# The canonical bool-spec layout. Three modules build or destructure
+# this tuple (here, ops/bm25_device.py, exec/); `python -m staticcheck`
+# (the bool-spec rule) enforces that construction goes through
+# `make_bool_spec` and that no consumer indexes past the declared arity,
+# so adding a field is a one-place change that the gate walks to every
+# consumer.
+BOOL_SPEC_FIELDS = (
+    "kind",  # the literal "bool"
+    "must",  # tuple of child specs, scored, all required
+    "should",  # tuple of child specs, scored, optional (msm applies)
+    "filter",  # tuple of child specs, required, never scored
+    "must_not",  # tuple of child specs, excluded, never scored
+    "msm",  # minimum_should_match (int; -1 = default rule)
+    "lead",  # lead filter-clause index for sparse folds (-1 = must-led)
+)
+BOOL_SPEC_ARITY = len(BOOL_SPEC_FIELDS)
+
+
+def make_bool_spec(must, should, filter_, must_not, msm, lead) -> tuple:
+    """The one construction site of the arity-7 bool spec tuple."""
+    return (
+        "bool",
+        tuple(must),
+        tuple(should),
+        tuple(filter_),
+        tuple(must_not),
+        int(msm),
+        int(lead),
+    )
+
+
 def select_lead_clause(groups) -> int:
     """Static lead-clause choice for a lowered bool's sparse execution.
 
@@ -1503,7 +1534,9 @@ class Compiler:
     def _assemble_bool(groups, msm, boost):
         specs = tuple(tuple(s for s, _ in g) for g in groups)
         children = tuple(a for g in groups for _, a in g)
-        spec = ("bool", *specs, int(msm), select_lead_clause(groups))
+        spec = make_bool_spec(
+            *specs, msm=msm, lead=select_lead_clause(groups)
+        )
         arrays = {"boost": np.float32(boost), "children": children}
         return spec, arrays
 
@@ -1655,7 +1688,7 @@ def unify_specs(specs: list[tuple]) -> tuple:
         # default must-driven fold (-1) is valid everywhere.
         leads = {s[6] for s in specs}
         lead = first[6] if len(leads) == 1 else -1
-        return ("bool", *out_groups, first[5], lead)
+        return make_bool_spec(*out_groups, msm=first[5], lead=lead)
     # Leaf kinds (range, exists, match_all, ...) carry no buckets: reaching
     # here means inequality at a position with no padding story.
     raise SpecUnifyError(f"cannot unify [{kind}] specs: {specs}")

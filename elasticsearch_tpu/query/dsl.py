@@ -1044,6 +1044,7 @@ def parse_query(body: dict[str, Any]) -> Query:
             q = ext(spec or {})
         except ValueError:
             raise
+        # staticcheck: ignore[broad-except] a plugin parser crashing on user input is a malformed-query 400, never a 500; no tasks flow at parse time
         except Exception as e:
             # A plugin parser crashing on user input is a malformed-query
             # 400, never an unhandled 500.

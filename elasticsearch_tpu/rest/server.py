@@ -518,6 +518,7 @@ class RestServer:
                 raise
             try:
                 self.cluster.step()
+            # staticcheck: ignore[broad-except] best-effort control-plane round before the single failover retry; a step failure only forfeits the retry's improved odds
             except Exception:
                 pass
             return handler(self, params, query, body)

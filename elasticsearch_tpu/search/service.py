@@ -757,6 +757,7 @@ class SearchService:
                     )
                 except (ValueError, TypeError):
                     raise  # request-shaped: the compile path 400s
+                # staticcheck: ignore[broad-except] launch-failure isolation: only this group's riders fail (then retry individually); a re-raise here would fail batchmates on one rider's error
                 except Exception as e:
                     # Launch failure isolation: only the riders of THIS
                     # group fail (and get retried individually by the
@@ -866,6 +867,7 @@ class SearchService:
                 lane_t0 = time.monotonic()
                 try:
                     scores, ids, tot = oracle.search(requests[i].query, ks[i])
+                # staticcheck: ignore[broad-except] oracle gap falls back to the device; the numpy oracle polls no tasks and hosts no fault sites
                 except Exception:
                     # Same contract as the single-request path: an oracle
                     # gap falls back to the device (for every lane not yet
@@ -1158,6 +1160,7 @@ class SearchService:
                             stats=stats,
                             live=self._host_live(handle),
                         ).search(request.query, k)
+                    # staticcheck: ignore[broad-except] oracle gap falls back to the device; the numpy oracle polls no tasks and hosts no fault sites
                     except Exception:
                         # Defensive: an oracle gap falls back to the
                         # device rather than failing the request; the

@@ -113,6 +113,7 @@ class FsRepository:
         manifest: dict[str, Any] = {
             "snapshot": snapshot,
             "state": "SUCCESS",
+            # staticcheck: ignore[wallclock-duration] user-facing ES API epoch timestamp (snapshot start time), not a duration
             "start_time_in_millis": int(time.time() * 1000),
             "indices": {},
         }
@@ -132,14 +133,8 @@ class FsRepository:
                     # in the op maps, not in any surviving doc row — the
                     # restored shard needs them for seqno uniqueness and
                     # version-line continuity (same data flush() commits).
-                    tombstones = {
-                        doc_id: [
-                            engine._versions.get(doc_id, 1),
-                            engine._doc_seqnos.get(doc_id, -1),
-                            ts,
-                        ]
-                        for doc_id, ts in engine._tombstone_ts.items()
-                    }
+                    # export converts monotonic ages to wall clock.
+                    tombstones = engine.export_tombstones()
                 segs = []
                 for j, (handle, live) in enumerate(handles):
                     digest = _segment_digest(svc.uuid, handle.segment)
@@ -170,6 +165,7 @@ class FsRepository:
                 "mappings": svc.mappings.to_json(),
                 "shards": shards,
             }
+        # staticcheck: ignore[wallclock-duration] user-facing ES API epoch timestamp (snapshot end time), not a duration
         manifest["end_time_in_millis"] = int(time.time() * 1000)
         tmp = self._manifest_path(snapshot) + ".tmp"
         with open(tmp, "w") as f:

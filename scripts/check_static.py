@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""CI gate for the repo-specific static analyzer (ISSUE 6).
+
+Runs `python -m staticcheck` over the checkout — the four pass families
+(trace-hazard, lock-discipline, registry-consistency, hygiene) — and
+fails on any non-baselined, non-suppressed finding. Pure stdlib `ast`,
+CPU-only, seconds: the same contract the self-run test
+(tests/test_staticcheck.py) enforces in tier-1; this script is the
+standalone hook for pre-merge / cron checks:
+
+    python scripts/check_static.py
+
+The analyzer prints a per-rule finding-count summary either way, so a
+regression is diagnosable from the log alone.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    cmd = [sys.executable, "-m", "staticcheck", "--root", REPO_ROOT]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,29 @@
+"""Repo-specific static analysis: machine-checked serving invariants.
+
+The codebase's correctness rests on conventions no unit test can see
+until they break at runtime: kernels reachable from `jax.jit` /
+`shard_map` must stay trace-pure or they silently recompile (or host-
+sync) per request; ~30 locks guard the batcher/transport/metrics hot
+paths and must never invert or block while held; and each subsystem PR
+added a registry (planner BACKENDS, fault sites, metrics catalog, the
+arity-7 bool spec) whose producers and consumers are linked only by
+convention. `staticcheck` turns those conventions into contracts the
+tier-1 gate enforces — the same move as the reference build's
+forbidden-APIs / StringFormatting checks (gradle/internal precommit).
+
+Usage:
+
+    python -m staticcheck                  # analyze the repo, exit 1 on
+                                           # any non-baselined finding
+    python -m staticcheck --rules          # rule glossary
+    python -m staticcheck --write-baseline # grandfather current findings
+
+Suppress a single finding at its line (a reason is mandatory):
+
+    something_flagged()  # staticcheck: ignore[rule-name] why it is fine
+
+Passes register themselves in `staticcheck.core.PASSES` on import of
+`staticcheck.passes`; everything runs on the stdlib `ast` only.
+"""
+
+from .core import Finding, Project, Report, run_project  # noqa: F401

@@ -1,0 +1,115 @@
+"""CLI: `python -m staticcheck` — analyze the repo, gate on findings.
+
+Exit status 0 = clean (every finding suppressed or baselined);
+1 = at least one new gating finding; 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .core import (
+    Project,
+    all_rules,
+    load_baseline,
+    run_project,
+    write_baseline,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="staticcheck",
+        description="repo-specific AST invariant checks (tier-1 gate)",
+    )
+    parser.add_argument(
+        "--root",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="repo root to analyze (default: this checkout)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline JSON (default: <root>/staticcheck/baseline.json)",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="grandfather every current finding into the baseline file",
+    )
+    parser.add_argument(
+        "--only",
+        default=None,
+        help="comma-separated pass families to run (default: all)",
+    )
+    parser.add_argument(
+        "--rules", action="store_true", help="print the rule glossary"
+    )
+    args = parser.parse_args(argv)
+
+    # Pass modules self-register on import.
+    from . import passes  # noqa: F401
+
+    if args.rules:
+        for rule, why in sorted(all_rules().items()):
+            print(f"{rule:28s} {why}")
+        return 0
+
+    baseline_path = args.baseline or os.path.join(
+        args.root, "staticcheck", "baseline.json"
+    )
+    only = args.only.split(",") if args.only else None
+    if only:
+        from .core import PASSES
+
+        unknown = [name for name in only if name not in PASSES]
+        if unknown:
+            # A typo'd family silently running zero passes would be a
+            # false-green gate.
+            print(
+                f"unknown pass famil{'ies' if len(unknown) > 1 else 'y'} "
+                f"{unknown}; available: {sorted(PASSES)}",
+                file=sys.stderr,
+            )
+            return 2
+    project = Project(args.root)
+    report = run_project(
+        project, baseline=load_baseline(baseline_path), only=only
+    )
+
+    if args.write_baseline:
+        if only:
+            # A partial run only holds the executed families' findings;
+            # rewriting the baseline from it would silently drop every
+            # other family's grandfathered entries.
+            print(
+                "--write-baseline requires a full run (drop --only)",
+                file=sys.stderr,
+            )
+            return 2
+        from .core import ADVISORY_RULES
+
+        # Advisory findings (stale suppressions) must never be
+        # grandfathered: baselining one would hide the stale comment —
+        # and anything it later starts suppressing — forever.
+        entries = [
+            f
+            for f in report.findings + report.baselined
+            if f.rule not in ADVISORY_RULES
+        ]
+        write_baseline(baseline_path, entries)
+        print(f"wrote {len(entries)} entries to {baseline_path}")
+        return 0
+
+    for f in report.findings:
+        print(f.render())
+    print("-- staticcheck summary --")
+    for line in report.summary_lines():
+        print(line)
+    return 1 if report.failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

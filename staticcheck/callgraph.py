@@ -1,0 +1,378 @@
+"""Name resolution and a conservative call graph over the project.
+
+Resolution is deliberately simple — this is a repo-specific linter, not
+a type checker. A call resolves to project functions via, in order:
+
+1. a bare name defined in the same module (or imported from a project
+   module with `from x import f`);
+2. `mod.f(...)` where `mod` is an imported project module;
+3. `self.f(...)` to a method of the enclosing class (then same-module
+   base classes);
+4. a unique-name fallback: `obj.f(...)` resolves iff exactly one
+   function named `f` exists in the whole project.
+
+Over-approximation is acceptable (passes suppress/baseline the noise);
+silent under-approximation of the jit-reachable set is what we must
+avoid, because that is where the recompile hazards hide.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import Project, SourceFile
+
+# Attribute accesses that yield static (host, hashable) values even when
+# the receiver is a traced array — the barrier that keeps `x.shape[0]`
+# out of the traced set.
+SHAPE_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "nbytes"})
+# Builtins whose result is static regardless of argument tracedness.
+STATIC_CALLS = frozenset({"len", "isinstance", "type", "hasattr", "getattr"})
+
+
+@dataclass
+class FunctionInfo:
+    sf: SourceFile
+    qualname: str  # e.g. "ClassName.method" or "fn" or "fn.<locals>.inner"
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    cls: str | None  # enclosing class name, if a method
+    parent: str | None  # enclosing function qualname, if nested
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.sf.rel, self.qualname)
+
+    @property
+    def params(self) -> list[str]:
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+
+# Stream/file verbs excluded from unique-name resolution: `f.flush()`
+# on an untyped receiver must not resolve to, say, Engine.flush.
+_FILEISH_METHODS = frozenset(
+    {
+        "write",
+        "read",
+        "readline",
+        "flush",
+        "close",
+        "open",
+        "seek",
+        "tell",
+        "fileno",
+        "encode",
+        "decode",
+    }
+)
+
+
+def get_index(project: Project) -> "ProjectIndex":
+    """The memoized ProjectIndex for a Project — passes share one index
+    instead of re-walking every AST per pass family."""
+    cached = getattr(project, "_staticcheck_index", None)
+    if cached is None:
+        cached = ProjectIndex(project)
+        project._staticcheck_index = cached
+    return cached
+
+
+class ProjectIndex:
+    """Functions, classes, and import tables for every project file."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.functions: dict[tuple[str, str], FunctionInfo] = {}
+        self.by_name: dict[str, list[FunctionInfo]] = {}
+        # module rel -> {local name -> dotted target}
+        self.imports: dict[str, dict[str, str]] = {}
+        # dotted module -> rel path
+        self.module_rel: dict[str, str] = {}
+        # (rel, class name) -> [base class names]
+        self.class_bases: dict[tuple[str, str], list[str]] = {}
+        # (rel, class, attr) -> (rel2, class2) | "external" | "unknown":
+        # cheap type inference from `self.X = ClassName(...)` assignments.
+        self.attr_types: dict[tuple[str, str, str], object] = {}
+        for sf in project.files.values():
+            self.module_rel[sf.module] = sf.rel
+            self.imports[sf.rel] = self._imports(sf)
+            self._index_defs(sf)
+        for sf in project.files.values():
+            self._index_attr_types(sf)
+
+    # ------------------------------------------------------------ indexing
+
+    def _imports(self, sf: SourceFile) -> dict[str, str]:
+        table: dict[str, str] = {}
+        pkg_parts = sf.module.split(".")
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    table[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    # Relative import: strip `level` trailing components
+                    # (the module's own name counts as one).
+                    base = pkg_parts[: len(pkg_parts) - node.level]
+                    prefix = ".".join(base + ([node.module] if node.module else []))
+                else:
+                    prefix = node.module or ""
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    table[alias.asname or alias.name] = (
+                        f"{prefix}.{alias.name}" if prefix else alias.name
+                    )
+        return table
+
+    def _index_defs(self, sf: SourceFile) -> None:
+        def visit(node, prefix, cls, parent_fn):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    qual = f"{prefix}{child.name}"
+                    self.class_bases[(sf.rel, child.name)] = [
+                        b.id
+                        for b in child.bases
+                        if isinstance(b, ast.Name)
+                    ]
+                    visit(child, qual + ".", child.name, parent_fn)
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    qual = f"{prefix}{child.name}"
+                    info = FunctionInfo(
+                        sf=sf,
+                        qualname=qual,
+                        node=child,
+                        cls=cls,
+                        parent=parent_fn,
+                    )
+                    self.functions[info.key] = info
+                    self.by_name.setdefault(child.name, []).append(info)
+                    visit(child, qual + ".<locals>.", None, qual)
+
+        visit(sf.tree, "", None, None)
+
+    def _index_attr_types(self, sf: SourceFile) -> None:
+        for info in self.functions.values():
+            if info.sf is not sf or not info.cls:
+                continue
+            for node in ast.walk(info.node):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id == "self"
+                ):
+                    continue
+                key = (sf.rel, info.cls, node.targets[0].attr)
+                t = self._infer_type(sf, node.value)
+                prior = self.attr_types.get(key)
+                if prior is None or prior == t:
+                    self.attr_types[key] = t
+                else:
+                    self.attr_types[key] = "unknown"
+
+    def _infer_type(self, sf: SourceFile, value: ast.AST) -> object:
+        """(rel, Class) for `ProjectClass(...)`, "external" for library
+        constructors/literals, "unknown" otherwise."""
+        if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.Constant)):
+            return "external"
+        if not isinstance(value, ast.Call):
+            return "unknown"
+        name = dotted_name(value.func)
+        if name is None:
+            return "unknown"
+        head, _, rest = name.partition(".")
+        dotted = self.imports.get(sf.rel, {}).get(head)
+        if dotted is None:
+            # Same-module class, or a builtin like open()/dict().
+            if (sf.rel, head) in self.class_bases and not rest:
+                return (sf.rel, head)
+            if head in ("open", "dict", "list", "set", "deque", "tuple"):
+                return "external"
+            return "unknown"
+        full = f"{dotted}.{rest}" if rest else dotted
+        if "." in full:
+            mod, cls = full.rsplit(".", 1)
+            rel2 = self.module_rel.get(mod)
+            if rel2 is not None and (rel2, cls) in self.class_bases:
+                return (rel2, cls)
+        if not any(
+            m == full or full.startswith(m + ".") or m.startswith(full + ".")
+            for m in self.module_rel
+        ):
+            return "external"
+        return "unknown"
+
+    # ---------------------------------------------------------- resolution
+
+    def _module_function(
+        self, dotted: str
+    ) -> FunctionInfo | None:
+        """`pkg.mod.fn` -> FunctionInfo if pkg.mod is a project file."""
+        if "." not in dotted:
+            return None
+        mod, name = dotted.rsplit(".", 1)
+        rel = self.module_rel.get(mod)
+        if rel is None:
+            return None
+        return self.functions.get((rel, name))
+
+    def resolve_call(
+        self, caller: FunctionInfo, call: ast.Call
+    ) -> list[FunctionInfo]:
+        func = call.func
+        sf = caller.sf
+        if isinstance(func, ast.Name):
+            # Nested sibling / enclosing-scope function first.
+            scope = caller.qualname
+            while scope:
+                info = self.functions.get(
+                    (sf.rel, f"{scope}.<locals>.{func.id}")
+                )
+                if info is not None:
+                    return [info]
+                scope = self.functions.get((sf.rel, scope)) and (
+                    self.functions[(sf.rel, scope)].parent or ""
+                )
+            info = self.functions.get((sf.rel, func.id))
+            if info is not None:
+                return [info]
+            dotted = self.imports[sf.rel].get(func.id)
+            if dotted:
+                info = self._module_function(dotted)
+                if info is not None:
+                    return [info]
+            return []
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            recv = func.value
+            if isinstance(recv, ast.Name):
+                if recv.id in ("self", "cls") and caller.cls:
+                    hit = self._method(sf.rel, caller.cls, attr)
+                    if hit is not None:
+                        return [hit]
+                dotted = self.imports[sf.rel].get(recv.id)
+                if dotted:
+                    rel = self.module_rel.get(dotted)
+                    if rel is not None:
+                        info = self.functions.get((rel, attr))
+                        if info is not None:
+                            return [info]
+            elif (
+                isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id in ("self", "cls")
+                and caller.cls
+            ):
+                # `self.translog.roll()`: use the inferred type of the
+                # attribute; an untyped self-chain stays unresolved (a
+                # unique-name guess here caused false lock edges through
+                # file handles).
+                t = self.attr_types.get((sf.rel, caller.cls, recv.attr))
+                if isinstance(t, tuple):
+                    hit = self._method(t[0], t[1], attr)
+                    return [hit] if hit is not None else []
+                return []
+            if self._external_receiver(sf, recv):
+                # `jax.lax.top_k`, `np.argsort`, ...: a library call must
+                # never unique-name-resolve onto a same-named project
+                # function.
+                return []
+            if attr in _FILEISH_METHODS:
+                # Generic stream/file verbs on an untyped receiver are
+                # overwhelmingly stdlib objects, not project methods.
+                return []
+            # Unique-name fallback (receiver type unknown).
+            candidates = self.by_name.get(attr, [])
+            if len(candidates) == 1:
+                return [candidates[0]]
+        return []
+
+    def _external_receiver(self, sf: SourceFile, recv: ast.AST) -> bool:
+        """True when the receiver chain is rooted at an imported name
+        that does not lead back into the project."""
+        name = dotted_name(recv)
+        if name is None:
+            return False
+        dotted = self.imports.get(sf.rel, {}).get(name.split(".")[0])
+        if dotted is None:
+            return False
+        for mod in self.module_rel:
+            if (
+                mod == dotted
+                or mod.startswith(dotted + ".")
+                or dotted.startswith(mod + ".")
+            ):
+                return False
+        return True
+
+    def _method(
+        self, rel: str, cls: str, attr: str
+    ) -> FunctionInfo | None:
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            info = self.functions.get((rel, f"{c}.{attr}"))
+            if info is not None:
+                return info
+            stack.extend(self.class_bases.get((rel, c), []))
+        return None
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """`a.b.c` expression -> "a.b.c" (None for anything fancier)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolves_to(
+    index: ProjectIndex, sf: SourceFile, node: ast.AST, target: str
+) -> bool:
+    """Does this Name/Attribute expression denote dotted path `target`
+    (e.g. "jax.jit", "time.sleep", "numpy.asarray") under the module's
+    import table?"""
+    name = dotted_name(node)
+    if name is None:
+        return False
+    head, _, rest = name.partition(".")
+    dotted = index.imports.get(sf.rel, {}).get(head, head)
+    full = f"{dotted}.{rest}" if rest else dotted
+    return full == target
+
+
+def mentions_traced(node: ast.AST, traced: set[str]) -> bool:
+    """Does the expression read any traced name — ignoring reads that
+    pass through a static barrier (`.shape`, `len(...)`, etc.)?"""
+    if isinstance(node, ast.Attribute) and node.attr in SHAPE_ATTRS:
+        return False
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in STATIC_CALLS:
+            return False
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    return any(
+        mentions_traced(child, traced)
+        for child in ast.iter_child_nodes(node)
+    )

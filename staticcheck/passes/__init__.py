@@ -1,0 +1,5 @@
+"""Pass families. Importing this package registers every pass in
+`staticcheck.core.PASSES` (each module calls `register_pass` at import).
+"""
+
+from . import hygiene, locks, registries, trace_hazard  # noqa: F401

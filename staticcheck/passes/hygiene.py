@@ -1,0 +1,127 @@
+"""Pass family 4: exception and clock hygiene.
+
+- **broad-except**: `except Exception:` (or a bare `except:`) can
+  swallow `TaskCancelledError` — turning an instant cancel into a
+  completed search — and can mask injected faults the chaos suite
+  expects to observe. Handlers that deliberately absorb everything
+  (scrape callbacks, best-effort cleanup) carry a suppression naming
+  why; degraded-path handlers re-raise cancellation first.
+- **wallclock-duration**: `time.time()` measures the wall clock, which
+  NTP can step backwards mid-measurement; durations and deadlines use
+  `time.monotonic()`. Wall-clock reads that produce user-facing epoch
+  timestamps carry a suppression naming why.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import dotted_name
+from ..core import Finding, Project, register_pass
+
+RULES = {
+    "broad-except": (
+        "except Exception can swallow task cancellation and injected "
+        "faults — re-raise control-flow errors or narrow the handler"
+    ),
+    "wallclock-duration": (
+        "time.time() is NTP-steppable; durations/deadlines need "
+        "time.monotonic() (user-facing epoch timestamps: suppress with "
+        "the reason)"
+    ),
+}
+
+# A broad handler is fine when its body starts by re-raising the
+# control-flow exceptions: `except TaskCancelledError: raise` above it,
+# or an `if isinstance(e, TaskCancelledError): raise` guard inside.
+_CONTROL_FLOW = ("TaskCancelledError",)
+
+
+def _reraises_control_flow(try_node: ast.Try, handler: ast.ExceptHandler) -> bool:
+    idx = try_node.handlers.index(handler)
+    # An earlier dedicated handler for the control-flow class that
+    # re-raises (or is `raise`-only) protects the broad one below it.
+    for prior in try_node.handlers[:idx]:
+        names = _handler_names(prior)
+        if any(n in _CONTROL_FLOW for n in names) and any(
+            isinstance(s, ast.Raise) for s in prior.body
+        ):
+            return True
+    # Or the broad handler itself opens with an isinstance re-raise.
+    for stmt in handler.body[:2]:
+        if isinstance(stmt, ast.If):
+            test_src = ast.dump(stmt.test)
+            if any(n in test_src for n in _CONTROL_FLOW) and any(
+                isinstance(s, ast.Raise) for s in stmt.body
+            ):
+                return True
+    # Cleanup-and-reraise: a handler whose top level ends in a bare
+    # `raise` (release resources, then propagate) cannot swallow
+    # anything.
+    last = handler.body[-1]
+    if isinstance(last, ast.Raise) and last.exc is None:
+        return True
+    return False
+
+
+def _handler_names(handler: ast.ExceptHandler) -> list[str]:
+    t = handler.type
+    if t is None:
+        return []
+    nodes = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = []
+    for n in nodes:
+        name = dotted_name(n)
+        if name:
+            out.append(name.split(".")[-1])
+    return out
+
+
+@register_pass("hygiene", RULES)
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files.values():
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Try):
+                for handler in node.handlers:
+                    names = _handler_names(handler)
+                    broad = handler.type is None or any(
+                        n in ("Exception", "BaseException") for n in names
+                    )
+                    if not broad:
+                        continue
+                    if _reraises_control_flow(node, handler):
+                        continue
+                    what = "bare except" if handler.type is None else (
+                        "except " + "/".join(names)
+                    )
+                    findings.append(
+                        Finding(
+                            rule="broad-except",
+                            path=sf.rel,
+                            line=handler.lineno,
+                            message=(
+                                f"{what} can swallow TaskCancelledError/"
+                                "injected faults — re-raise control flow "
+                                "first, narrow, or suppress with the "
+                                "reason"
+                            ),
+                        )
+                    )
+            elif isinstance(node, ast.Call):
+                # The repo always spells it `import time; time.time()` —
+                # no import-table resolution needed.
+                if dotted_name(node.func) == "time.time":
+                    findings.append(
+                        Finding(
+                            rule="wallclock-duration",
+                            path=sf.rel,
+                            line=node.lineno,
+                            message=(
+                                "time.time() — use time.monotonic() for "
+                                "durations/deadlines (epoch timestamps "
+                                "reported to users: suppress, naming why)"
+                            ),
+                        )
+                    )
+    return findings

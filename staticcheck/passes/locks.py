@@ -1,0 +1,371 @@
+"""Pass family 2: lock discipline across the serving hot paths.
+
+~30 `threading.Lock/RLock/Condition` instances guard the batcher,
+transport, metrics, engine and cluster layers. Two classes of latent
+deadlock/latency bug are machine-checkable:
+
+- **lock-order**: if thread 1 takes A then B while thread 2 takes B
+  then A, the process deadlocks under load. The pass names every lock
+  `module:Class.attr`, builds an acquisition graph (lexical `with`
+  nesting plus acquisitions reachable through calls made while a lock
+  is held), and reports every edge participating in a cycle.
+- **lock-blocking-call**: sleeping, sending on the transport, launching
+  device work, or doing file I/O while holding a lock serializes every
+  other thread needing that lock behind an unbounded wait (the
+  batcher-holds-lock-across-launch class of bug). `Condition.wait`
+  is exempt (it releases the lock); deliberate holds (e.g. translog
+  durability ordering) carry inline suppressions naming why.
+
+Locks on different *instances* of the same class share a name, so the
+graph over-approximates; cross-instance edges that cannot deadlock are
+suppressed or baselined with a written justification.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import (
+    FunctionInfo,
+    ProjectIndex,
+    dotted_name,
+    get_index,
+)
+from ..core import Finding, Project, register_pass
+
+RULES = {
+    "lock-order": (
+        "two locks are acquired in opposite orders on different paths — "
+        "a deadlock waiting for concurrent load"
+    ),
+    "lock-blocking-call": (
+        "blocking call (sleep / transport send / device launch / file "
+        "I/O) while holding a lock stalls every waiter"
+    ),
+    "lock-self-deadlock": (
+        "non-reentrant Lock acquired while already held in the same "
+        "function — guaranteed deadlock"
+    ),
+}
+
+_LOCK_FACTORIES = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+}
+
+# Callee attribute names that block the calling thread. Curated for this
+# repo: transport sends, device launches/syncs, queue waits.
+_BLOCKING_ATTRS = frozenset(
+    {
+        "send",
+        "send_request",
+        "block_until_ready",
+        "device_put",
+        "search",
+        "search_many",
+        "execute_batch",
+        "execute_shards",
+    }
+)
+_BLOCKING_DOTTED = frozenset({"time.sleep", "subprocess.run", "os.fsync"})
+# Condition methods that RELEASE the lock while waiting.
+_WAIT_ATTRS = frozenset({"wait", "wait_for"})
+
+
+def _factory_kind(index: ProjectIndex, sf, node: ast.AST) -> str | None:
+    """threading.Lock / RLock / Condition constructor -> kind."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    dotted = index.imports.get(sf.rel, {}).get(head, head)
+    full = f"{dotted}.{rest}" if rest else dotted
+    return _LOCK_FACTORIES.get(full)
+
+
+class _LockIndex:
+    """lock id = "Class.attr" or "<module rel>:name" for globals."""
+
+    def __init__(self, project: Project, index: ProjectIndex):
+        self.kinds: dict[str, str] = {}  # lock id -> Lock/RLock/Condition
+        # (rel, class, attr) and (rel, global name) -> lock id
+        self.attr_ids: dict[tuple[str, str], str] = {}
+        self.global_ids: dict[tuple[str, str], str] = {}
+        for sf in project.files.values():
+            for fn_key, info in index.functions.items():
+                if fn_key[0] != sf.rel:
+                    continue
+                for node in ast.walk(info.node):
+                    kind = None
+                    target = None
+                    if isinstance(node, ast.Assign):
+                        kind = _factory_kind(index, sf, node.value)
+                        target = node.targets[0] if node.targets else None
+                    if kind is None or target is None:
+                        continue
+                    if (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                        and info.cls
+                    ):
+                        lock_id = f"{info.cls}.{target.attr}"
+                        self.attr_ids[(info.cls, target.attr)] = lock_id
+                        self.kinds[lock_id] = kind
+            for node in sf.tree.body:
+                # Module-level locks plus dataclass field defaults.
+                if isinstance(node, ast.Assign):
+                    kind = _factory_kind(index, sf, node.value)
+                    if kind and isinstance(node.targets[0], ast.Name):
+                        lock_id = f"{sf.module}:{node.targets[0].id}"
+                        self.global_ids[(sf.rel, node.targets[0].id)] = (
+                            lock_id
+                        )
+                        self.kinds[lock_id] = kind
+                elif isinstance(node, ast.ClassDef):
+                    for stmt in node.body:
+                        kind = self._field_default(index, sf, stmt)
+                        if kind is None:
+                            continue
+                        attr = self._ann_target(stmt)
+                        if attr:
+                            lock_id = f"{node.name}.{attr}"
+                            self.attr_ids[(node.name, attr)] = lock_id
+                            self.kinds[lock_id] = kind
+
+    @staticmethod
+    def _ann_target(stmt) -> str | None:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(
+            stmt.target, ast.Name
+        ):
+            return stmt.target.id
+        if isinstance(stmt, ast.Assign) and isinstance(
+            stmt.targets[0], ast.Name
+        ):
+            return stmt.targets[0].id
+        return None
+
+    def _field_default(self, index: ProjectIndex, sf, stmt) -> str | None:
+        value = getattr(stmt, "value", None)
+        if not isinstance(value, ast.Call):
+            return None
+        if dotted_name(value.func) not in ("field", "dataclasses.field"):
+            return None
+        for kw in value.keywords:
+            if kw.arg == "default_factory":
+                name = dotted_name(kw.value)
+                if name is None:
+                    return None
+                head, _, rest = name.partition(".")
+                dotted = index.imports.get(sf.rel, {}).get(head, head)
+                full = f"{dotted}.{rest}" if rest else dotted
+                return _LOCK_FACTORIES.get(full)
+        return None
+
+    def resolve(
+        self, info: FunctionInfo, expr: ast.AST
+    ) -> str | None:
+        """`self._lock` / module-global `_lock` / unique attr name."""
+        if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ):
+            if expr.value.id == "self" and info.cls:
+                hit = self.attr_ids.get((info.cls, expr.attr))
+                if hit:
+                    return hit
+            # Unique-attr fallback: exactly one class defines this attr.
+            owners = [
+                lock_id
+                for (cls, attr), lock_id in self.attr_ids.items()
+                if attr == expr.attr
+            ]
+            if len(owners) == 1:
+                return owners[0]
+            return None
+        if isinstance(expr, ast.Name):
+            hit = self.global_ids.get((info.sf.rel, expr.id))
+            if hit:
+                return hit
+        return None
+
+
+def _with_lock_items(locks: _LockIndex, info: FunctionInfo, node: ast.With):
+    out = []
+    for item in node.items:
+        lock_id = locks.resolve(info, item.context_expr)
+        if lock_id is not None:
+            out.append(lock_id)
+    return out
+
+
+def _is_blocking(index: ProjectIndex, sf, call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _WAIT_ATTRS:
+        # Condition.wait/wait_for RELEASE the held lock while blocked —
+        # exempt even if a wait-like attr is ever added to the blocking
+        # set for another receiver type.
+        return None
+    name = dotted_name(f)
+    if name is not None:
+        head, _, rest = name.partition(".")
+        dotted = index.imports.get(sf.rel, {}).get(head, head)
+        full = f"{dotted}.{rest}" if rest else dotted
+        if full in _BLOCKING_DOTTED:
+            return full
+    if isinstance(f, ast.Name) and f.id == "open":
+        return "open"
+    if isinstance(f, ast.Attribute) and f.attr in _BLOCKING_ATTRS:
+        # `re.search(...)`-style module functions are not blocking.
+        recv = dotted_name(f.value)
+        if recv is not None:
+            head = recv.partition(".")[0]
+            if index.imports.get(sf.rel, {}).get(head, "") in (
+                "re",
+                "fnmatch",
+            ):
+                return None
+        return f".{f.attr}"
+    return None
+
+
+@register_pass("lock-discipline", RULES)
+def run(project: Project) -> list[Finding]:
+    index = get_index(project)
+    locks = _LockIndex(project, index)
+    findings: list[Finding] = []
+
+    # ---- per-function summaries: locks acquired anywhere inside
+    acquires: dict[tuple, set[str]] = {}
+    infos = list(index.functions.values())
+    for info in infos:
+        direct: set[str] = set()
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.With):
+                direct.update(_with_lock_items(locks, info, node))
+        acquires[info.key] = direct
+    # Transitive closure (bounded fixpoint over the call graph).
+    for _ in range(6):
+        changed = False
+        for info in infos:
+            summary = acquires[info.key]
+            before = len(summary)
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Call):
+                    for callee in index.resolve_call(info, node):
+                        summary |= acquires.get(callee.key, set())
+            if len(summary) != before:
+                changed = True
+        if not changed:
+            break
+
+    # ---- edges + blocking calls under each lexical with-block
+    edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+
+    def scan(info: FunctionInfo, node, held: tuple[str, ...]) -> None:
+        sf = info.sf
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda),
+        ):
+            return  # runs later, not under this lock
+        if isinstance(node, ast.With):
+            got = _with_lock_items(locks, info, node)
+            for lock_id in got:
+                for h in held:
+                    if h == lock_id:
+                        if locks.kinds.get(lock_id) == "Lock":
+                            findings.append(
+                                Finding(
+                                    rule="lock-self-deadlock",
+                                    path=sf.rel,
+                                    line=node.lineno,
+                                    message=(
+                                        f"[{lock_id}] is a plain Lock "
+                                        "already held here"
+                                    ),
+                                    context=info.qualname,
+                                )
+                            )
+                    else:
+                        edges.setdefault(
+                            (h, lock_id),
+                            (sf.rel, node.lineno, info.qualname),
+                        )
+            inner = held + tuple(g for g in got if g not in held)
+            for child in node.body:
+                scan(info, child, inner)
+            return
+        if isinstance(node, ast.Call) and held:
+            blocked = _is_blocking(index, sf, node)
+            if blocked is not None:
+                findings.append(
+                    Finding(
+                        rule="lock-blocking-call",
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            f"blocking call [{blocked}] while "
+                            f"holding [{held[-1]}]"
+                        ),
+                        context=info.qualname,
+                    )
+                )
+            # Calls that *transitively* acquire other locks create
+            # ordering edges.
+            for callee in index.resolve_call(info, node):
+                for lock_id in acquires.get(callee.key, set()):
+                    for h in held:
+                        if h != lock_id:
+                            edges.setdefault(
+                                (h, lock_id),
+                                (sf.rel, node.lineno, info.qualname),
+                            )
+        for child in ast.iter_child_nodes(node):
+            scan(info, child, held)
+
+    for info in infos:
+        for stmt in info.node.body:
+            scan(info, stmt, ())
+
+    # ---- cycle detection over the ordering edges
+    graph: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+
+    def reachable(src: str, dst: str) -> bool:
+        seen, stack = set(), [src]
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(graph.get(n, ()))
+        return False
+
+    reported: set[frozenset] = set()
+    for (a, b), (rel, line, ctx) in sorted(edges.items()):
+        if a == b:
+            continue
+        if reachable(b, a):
+            pair = frozenset((a, b))
+            if pair in reported:
+                continue
+            reported.add(pair)
+            findings.append(
+                Finding(
+                    rule="lock-order",
+                    path=rel,
+                    line=line,
+                    message=(
+                        f"lock-order inversion: [{a}] -> [{b}] here but "
+                        f"[{b}] -> [{a}] elsewhere"
+                    ),
+                    context=ctx,
+                )
+            )
+    return findings

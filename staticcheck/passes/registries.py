@@ -1,0 +1,498 @@
+"""Pass family 3: cross-module registry consistency.
+
+Each subsystem PR added a registry whose producers and consumers are
+linked only by convention; this pass turns the conventions into checked
+contracts:
+
+- **planner backends** (`exec/planner.py` `ExecPlanner.BACKENDS`): every
+  backend must have a cost seed mention in `exec/cost.py` (the planner
+  calls `seed_ms` for every candidate — an unseeded backend silently
+  costs like the device) and at least one execution/surfacing site
+  outside the planner itself.
+- **fault sites** (`faults/registry.py` `SITES`): every `fault_point()`
+  call site in the serving stack must name a registered site pattern
+  (an unregistered string is a chaos hook that silently never fires),
+  and every registered pattern must have a live call site.
+- **metrics catalog** (`obs/metrics.py` `CATALOG`): every `estpu_*`
+  instrument created on a registry must be cataloged with a matching
+  kind and a `_nodes/stats` section, and every cataloged name must be
+  referenced by code — the machine check that `GET /_metrics` and
+  `GET /_nodes/stats` stay two views over the same instruments.
+- **bool spec** (`query/compile.py` `BOOL_SPEC_FIELDS`): the arity-7
+  `("bool", must, should, filter, must_not, msm, lead)` plan tuple is
+  constructed only via `make_bool_spec` and destructured with indices
+  inside the declared arity, across compile.py / ops/bm25_device.py /
+  exec/.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+
+from ..core import Finding, Project, register_pass
+
+RULES = {
+    "registry-backend": (
+        "planner BACKENDS entry without a cost seed in exec/cost.py or "
+        "without any execution/surfacing site"
+    ),
+    "registry-fault-site": (
+        "fault_point() site not declared in faults/registry.py SITES "
+        "(or a declared site with no call site)"
+    ),
+    "registry-metric": (
+        "estpu_* instrument not in the obs/metrics.py CATALOG (or "
+        "cataloged with the wrong kind / never referenced)"
+    ),
+    "bool-spec": (
+        "arity-7 bool spec constructed outside make_bool_spec or "
+        "indexed/destructured beyond the declared field order"
+    ),
+}
+
+_PLANNER = "elasticsearch_tpu/exec/planner.py"
+_COST = "elasticsearch_tpu/exec/cost.py"
+_FAULTS = "elasticsearch_tpu/faults/registry.py"
+_METRICS = "elasticsearch_tpu/obs/metrics.py"
+_COMPILE = "elasticsearch_tpu/query/compile.py"
+
+# Files handling raw bool-spec tuples (construction restricted to
+# make_bool_spec in compile.py; index bounds checked everywhere below).
+_BOOL_SPEC_FILES = (
+    _COMPILE,
+    "elasticsearch_tpu/ops/bm25_device.py",
+    "elasticsearch_tpu/exec/planner.py",
+    "elasticsearch_tpu/exec/batcher.py",
+)
+_BOOL_SPEC_ARITY = 7
+
+
+def _const_tuple(node: ast.AST) -> list[str]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ]
+    return []
+
+
+def _assigned_tuple(tree: ast.AST, name: str) -> tuple[list[str], int]:
+    """Find `NAME = ("a", "b", ...)` anywhere (module or class body)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    return _const_tuple(node.value), node.lineno
+    return [], 0
+
+
+def _string_literals(tree: ast.AST) -> set[str]:
+    return {
+        n.value
+        for n in ast.walk(tree)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    }
+
+
+@register_pass("registry-consistency", RULES)
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    findings += _check_backends(project)
+    findings += _check_fault_sites(project)
+    findings += _check_metrics(project)
+    findings += _check_bool_spec(project)
+    return findings
+
+
+# ----------------------------------------------------------- backends
+
+def _check_backends(project: Project) -> list[Finding]:
+    planner = project.get(_PLANNER)
+    cost = project.get(_COST)
+    if planner is None or cost is None:
+        return []
+    backends, line = _assigned_tuple(planner.tree, "BACKENDS")
+    if not backends:
+        return [
+            Finding(
+                rule="registry-backend",
+                path=_PLANNER,
+                line=1,
+                message="ExecPlanner.BACKENDS tuple not found",
+            )
+        ]
+    cost_literals = _string_literals(cost.tree)
+    # Surfacing sites exclude the planner AND the cost model: a backend
+    # named only in its cost seed has a price but nothing that ever
+    # executes or reports it.
+    other_literals: set[str] = set()
+    for sf in project.files.values():
+        if sf.rel not in (_PLANNER, _COST):
+            other_literals |= _string_literals(sf.tree)
+    out = []
+    for b in backends:
+        if b not in cost_literals:
+            out.append(
+                Finding(
+                    rule="registry-backend",
+                    path=_PLANNER,
+                    line=line,
+                    message=(
+                        f"backend [{b}] has no cost seed mention in "
+                        "exec/cost.py — seed_ms silently misprices it"
+                    ),
+                )
+            )
+        if b not in other_literals:
+            out.append(
+                Finding(
+                    rule="registry-backend",
+                    path=_PLANNER,
+                    line=line,
+                    message=(
+                        f"backend [{b}] is never referenced outside the "
+                        "planner — no execution or surfacing site"
+                    ),
+                )
+            )
+    return out
+
+
+# -------------------------------------------------------- fault sites
+
+def _fault_point_calls(project: Project):
+    for sf in project.files.values():
+        if sf.rel == _FAULTS:
+            continue
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and (
+                    (
+                        isinstance(node.func, ast.Name)
+                        and node.func.id == "fault_point"
+                    )
+                    or (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "fault_point"
+                    )
+                )
+                and node.args
+            ):
+                yield sf, node
+
+
+def _site_literal(arg: ast.AST) -> tuple[str, bool]:
+    """(site-or-prefix, is_exact). f-strings yield their static prefix."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, True
+    if isinstance(arg, ast.JoinedStr):
+        prefix = ""
+        for part in arg.values:
+            if isinstance(part, ast.Constant):
+                prefix += str(part.value)
+            else:
+                break
+        return prefix, False
+    return "", False
+
+
+def _check_fault_sites(project: Project) -> list[Finding]:
+    reg = project.get(_FAULTS)
+    if reg is None:
+        return []
+    sites, line = _assigned_tuple(reg.tree, "SITES")
+    if not sites:
+        return [
+            Finding(
+                rule="registry-fault-site",
+                path=_FAULTS,
+                line=1,
+                message="canonical SITES tuple not found",
+            )
+        ]
+    out = []
+    matched: set[str] = set()
+    for sf, call in _fault_point_calls(project):
+        site, exact = _site_literal(call.args[0])
+        if not site:
+            continue
+        hits = []
+        for pat in sites:
+            if exact:
+                ok = fnmatch.fnmatchcase(site, pat)
+            else:
+                pat_prefix = pat.split("*")[0]
+                ok = site.startswith(pat_prefix) or pat_prefix.startswith(
+                    site
+                )
+            if ok:
+                hits.append(pat)
+        if hits:
+            matched.update(hits)
+        else:
+            out.append(
+                Finding(
+                    rule="registry-fault-site",
+                    path=sf.rel,
+                    line=call.lineno,
+                    message=(
+                        f"fault site [{site}] is not declared in "
+                        "faults/registry.py SITES — this chaos hook can "
+                        "never be armed by name"
+                    ),
+                )
+            )
+    for pat in sites:
+        if pat not in matched:
+            out.append(
+                Finding(
+                    rule="registry-fault-site",
+                    path=_FAULTS,
+                    line=line,
+                    message=(
+                        f"declared fault site [{pat}] has no fault_point "
+                        "call site — dead registry entry"
+                    ),
+                )
+            )
+    return out
+
+
+# ------------------------------------------------------------ metrics
+
+_INSTRUMENT_METHODS = {"counter", "gauge", "histogram"}
+
+
+def _catalog(project: Project) -> tuple[dict[str, str], tuple[int, int]]:
+    """CATALOG = {"name": ("kind", "stats section"), ...} -> {name: kind}
+    plus the dict's line span (to exclude it from reference counting)."""
+    metrics = project.get(_METRICS)
+    if metrics is None:
+        return {}, (0, 0)
+    for node in ast.walk(metrics.tree):
+        if isinstance(node, ast.Assign):
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name) and tgt.id == "CATALOG":
+                out = {}
+                if isinstance(node.value, ast.Dict):
+                    for k, v in zip(node.value.keys, node.value.values):
+                        if not (
+                            isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)
+                        ):
+                            continue
+                        kinds = _const_tuple(v)
+                        out[k.value] = kinds[0] if kinds else ""
+                span = (
+                    node.lineno,
+                    getattr(node, "end_lineno", node.lineno),
+                )
+                return out, span
+    return {}, (0, 0)
+
+
+def _check_metrics(project: Project) -> list[Finding]:
+    metrics = project.get(_METRICS)
+    if metrics is None:
+        return []
+    catalog, span = _catalog(project)
+    if not catalog:
+        return [
+            Finding(
+                rule="registry-metric",
+                path=_METRICS,
+                line=1,
+                message="instrument CATALOG dict not found",
+            )
+        ]
+    out = []
+    referenced: set[str] = set()
+    for sf in project.files.values():
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value.startswith("estpu_")
+            ):
+                if sf.rel == _METRICS and span[0] <= node.lineno <= span[1]:
+                    continue  # the catalog itself is not a reference
+                referenced.add(node.value)
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _INSTRUMENT_METHODS
+                and node.args
+            ):
+                continue
+            name_arg = node.args[0]
+            if not (
+                isinstance(name_arg, ast.Constant)
+                and isinstance(name_arg.value, str)
+                and name_arg.value.startswith("estpu_")
+            ):
+                continue
+            name = name_arg.value
+            want = catalog.get(name)
+            if want is None:
+                out.append(
+                    Finding(
+                        rule="registry-metric",
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            f"instrument [{name}] is not in the "
+                            "obs/metrics.py CATALOG — add it with its "
+                            "kind and _nodes/stats section"
+                        ),
+                    )
+                )
+            elif want != node.func.attr:
+                out.append(
+                    Finding(
+                        rule="registry-metric",
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            f"instrument [{name}] created as "
+                            f"{node.func.attr} but cataloged as {want}"
+                        ),
+                    )
+                )
+    for name in sorted(catalog):
+        if name not in referenced:
+            out.append(
+                Finding(
+                    rule="registry-metric",
+                    path=_METRICS,
+                    line=span[0],
+                    message=(
+                        f"cataloged instrument [{name}] is never "
+                        "referenced by code — dead catalog entry"
+                    ),
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------- bool spec
+
+def _check_bool_spec(project: Project) -> list[Finding]:
+    compile_sf = project.get(_COMPILE)
+    if compile_sf is None:
+        return []
+    fields, _ = _assigned_tuple(compile_sf.tree, "BOOL_SPEC_FIELDS")
+    if len(fields) != _BOOL_SPEC_ARITY:
+        return [
+            Finding(
+                rule="bool-spec",
+                path=_COMPILE,
+                line=1,
+                message=(
+                    "BOOL_SPEC_FIELDS must declare exactly "
+                    f"{_BOOL_SPEC_ARITY} fields (found {len(fields)})"
+                ),
+            )
+        ]
+    out = []
+    for rel in _BOOL_SPEC_FILES:
+        sf = project.get(rel)
+        if sf is None:
+            continue
+        in_ctor = rel == _COMPILE
+        for node in ast.walk(sf.tree):
+            # Raw construction: a tuple literal ("bool", ...) outside
+            # make_bool_spec, in ANY bool-spec-handling file. Star-splat
+            # rebuilds count too — their arity is unverifiable here,
+            # which is the point of the constructor. (Deliberate
+            # non-spec tuples, like the planner's AST signatures, carry
+            # inline suppressions.)
+            if isinstance(node, ast.Tuple) and node.elts:
+                first = node.elts[0]
+                if (
+                    isinstance(first, ast.Constant)
+                    and first.value == "bool"
+                    and len(node.elts) > 1
+                ):
+                    ctor = in_ctor and "make_bool_spec" in sf.context_at(
+                        node.lineno
+                    )
+                    if not ctor:
+                        out.append(
+                            Finding(
+                                rule="bool-spec",
+                                path=rel,
+                                line=node.lineno,
+                                message=(
+                                    "raw ('bool', ...) spec tuple — "
+                                    "construct via query.compile."
+                                    "make_bool_spec so arity stays "
+                                    f"{_BOOL_SPEC_ARITY}"
+                                ),
+                            )
+                        )
+            # Out-of-range constant index on a bool-spec variable.
+            if isinstance(node, ast.Subscript):
+                idx = node.slice
+                if (
+                    isinstance(idx, ast.Constant)
+                    and isinstance(idx.value, int)
+                    and idx.value >= _BOOL_SPEC_ARITY
+                    and isinstance(node.value, ast.Name)
+                    and _is_bool_spec_var(sf, node.value.id, node.lineno)
+                ):
+                    out.append(
+                        Finding(
+                            rule="bool-spec",
+                            path=rel,
+                            line=node.lineno,
+                            message=(
+                                f"index [{idx.value}] beyond bool-spec "
+                                f"arity {_BOOL_SPEC_ARITY} on "
+                                f"[{node.value.id}]"
+                            ),
+                        )
+                    )
+                if (
+                    isinstance(idx, ast.Slice)
+                    and isinstance(idx.upper, ast.Constant)
+                    and isinstance(idx.upper.value, int)
+                    and idx.upper.value > _BOOL_SPEC_ARITY
+                    and isinstance(node.value, ast.Name)
+                    and _is_bool_spec_var(sf, node.value.id, node.lineno)
+                ):
+                    out.append(
+                        Finding(
+                            rule="bool-spec",
+                            path=rel,
+                            line=node.lineno,
+                            message=(
+                                f"slice bound [{idx.upper.value}] beyond "
+                                f"bool-spec arity {_BOOL_SPEC_ARITY} on "
+                                f"[{node.value.id}]"
+                            ),
+                        )
+                    )
+    return out
+
+
+def _is_bool_spec_var(sf, name: str, line: int) -> bool:
+    """Is `name` treated as a bool spec in the enclosing function? True
+    when the function also compares `name[0] == "bool"` (or assigns
+    `kind = name[0]` and compares kind)."""
+    ctx = sf.context_at(line)
+    if not ctx:
+        return False
+    # Cheap textual scope check: find the enclosing function's span via
+    # the context index built by SourceFile.
+    for lo, hi, qual in sf._context_spans or ():
+        if qual == ctx:
+            body = "\n".join(sf.lines[lo - 1 : hi])
+            return (
+                f'{name}[0] == "bool"' in body
+                or f"{name}[0] == 'bool'" in body
+                or (f"kind = {name}[0]" in body and '"bool"' in body)
+            )
+    return False

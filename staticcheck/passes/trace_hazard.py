@@ -1,0 +1,477 @@
+"""Pass family 1: trace purity of jit/shard_map-reachable code.
+
+Functions reachable from a `jax.jit` / `shard_map` entry point execute
+under tracing: a host sync (`.item()`, `np.asarray`, `float()` on a
+traced value) stalls the launch pipeline or fails under jit, and a
+Python branch on a traced value either fails at trace time or — worse —
+silently burns a recompile per distinct value, the exact dispatch
+overhead that made BENCH cfg1 lose 12x. The pass:
+
+1. finds jit roots (`@partial(jax.jit, static_argnames=...)` decorators,
+   `jax.jit(f)` calls, `shard_map(body, ...)` bodies);
+2. walks the project call graph from the roots, propagating which
+   parameters are traced (static_argnames and shape-like derivations
+   are static; everything else array-ish flows as traced);
+3. inside the reachable set, flags host syncs and data-dependent Python
+   control flow on traced values;
+4. everywhere, flags ephemeral `jax.jit(...)` wrappers (a fresh jit
+   cache per call recompiles per request) and unhashable literals
+   passed in a jit static parameter position.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import (
+    FunctionInfo,
+    ProjectIndex,
+    dotted_name,
+    get_index,
+    mentions_traced,
+    resolves_to,
+)
+from ..core import Finding, Project, register_pass
+
+RULES = {
+    "host-sync": (
+        "host sync (.item()/np.asarray/float()/block_until_ready on a "
+        "traced value) inside jit/shard_map-reachable code stalls or "
+        "breaks the launch"
+    ),
+    "traced-branch": (
+        "Python if/while/for on a traced value fails at trace time or "
+        "recompiles per value"
+    ),
+    "jit-ephemeral": (
+        "jax.jit(...) built and invoked inline creates a fresh compile "
+        "cache per call — every request recompiles"
+    ),
+    "jit-unhashable-static": (
+        "list/dict/set literal passed in a jit static parameter position "
+        "is unhashable and fails (or defeats) the compile cache"
+    ),
+}
+
+_HOST_SYNC_ATTRS = frozenset({"item", "tolist", "block_until_ready"})
+_HOST_SYNC_BUILTINS = frozenset({"float", "int", "bool", "complex"})
+_NUMPY_SYNC_FUNCS = frozenset({"asarray", "array"})
+_UNHASHABLE = (
+    ast.List,
+    ast.Dict,
+    ast.Set,
+    ast.ListComp,
+    ast.DictComp,
+    ast.SetComp,
+    ast.GeneratorExp,
+)
+
+
+def _is_jax_jit(index: ProjectIndex, sf, node: ast.AST) -> bool:
+    return resolves_to(index, sf, node, "jax.jit") or resolves_to(
+        index, sf, node, "jax.Jit"
+    )
+
+
+def _is_shard_map(index: ProjectIndex, sf, node: ast.AST) -> bool:
+    name = dotted_name(node)
+    if name is None:
+        return False
+    # Any local or jax-qualified shard_map spelling (the repo wraps the
+    # 0.4/0.6 API split in parallel/sharded._shard_map).
+    return name.split(".")[-1] in ("shard_map", "_shard_map")
+
+
+def _static_argnames(call: ast.Call) -> set[str]:
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant):
+                        out.add(str(elt.value))
+    return out
+
+
+class _Roots:
+    """jit/shard_map entry points: FunctionInfo -> static param names."""
+
+    def __init__(self, project: Project, index: ProjectIndex):
+        self.static: dict[tuple, set[str]] = {}
+        self.index = index
+        for sf in project.files.values():
+            for node in ast.walk(sf.tree):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    self._from_decorators(sf, node)
+                elif isinstance(node, ast.Call):
+                    self._from_call(sf, node)
+
+    def _add(self, info: FunctionInfo | None, static: set[str]) -> None:
+        if info is None:
+            return
+        self.static.setdefault(info.key, set()).update(static)
+
+    def _lookup(self, sf, name: str) -> FunctionInfo | None:
+        for key, info in self.index.functions.items():
+            if key[0] == sf.rel and (
+                info.qualname == name or info.qualname.endswith(f".{name}")
+            ):
+                return info
+        return None
+
+    def _from_decorators(self, sf, fn: ast.AST) -> None:
+        for dec in fn.decorator_list:
+            static: set[str] | None = None
+            if _is_jax_jit(self.index, sf, dec):
+                static = set()
+            elif isinstance(dec, ast.Call):
+                if _is_jax_jit(self.index, sf, dec.func):
+                    static = _static_argnames(dec)
+                elif (
+                    resolves_to(self.index, sf, dec.func, "functools.partial")
+                    and dec.args
+                    and _is_jax_jit(self.index, sf, dec.args[0])
+                ):
+                    static = _static_argnames(dec)
+            if static is not None:
+                self._add(self._lookup(sf, fn.name), static)
+
+    def _from_call(self, sf, call: ast.Call) -> None:
+        fn_arg: ast.AST | None = None
+        static: set[str] = set()
+        if _is_jax_jit(self.index, sf, call.func) and call.args:
+            fn_arg = call.args[0]
+            static = _static_argnames(call)
+        elif _is_shard_map(self.index, sf, call.func) and call.args:
+            fn_arg = call.args[0]
+        if isinstance(fn_arg, ast.Name):
+            self._add(self._lookup(sf, fn_arg.id), static)
+
+
+def _local_traced(
+    info: FunctionInfo, seed: set[str]
+) -> set[str]:
+    """Names traced inside one function: seeded params/closures plus
+    anything assigned from an expression mentioning a traced name (two
+    propagation sweeps cover backward references in loops)."""
+    traced = set(seed)
+    body = info.node.body
+    for _ in range(2):
+        before = len(traced)
+        for node in ast.walk(ast.Module(body=body, type_ignores=[])):
+            if isinstance(node, ast.Assign) and mentions_traced(
+                node.value, traced
+            ):
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            traced.add(n.id)
+            elif isinstance(node, ast.AugAssign) and mentions_traced(
+                node.value, traced
+            ):
+                if isinstance(node.target, ast.Name):
+                    traced.add(node.target.id)
+            elif isinstance(node, ast.For) and mentions_traced(
+                node.iter, traced
+            ):
+                _propagate_loop_targets(node, traced)
+        if len(traced) == before:
+            break
+    return traced
+
+
+def _is_identity_test(test: ast.AST) -> bool:
+    """`x is None` / `x is not None` (possibly and/or-joined)."""
+    if isinstance(test, ast.BoolOp):
+        return all(_is_identity_test(v) for v in test.values)
+    return (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and isinstance(test.ops[0], (ast.Is, ast.IsNot))
+        and isinstance(test.comparators[0], ast.Constant)
+        and test.comparators[0].value is None
+    )
+
+
+def _propagate_loop_targets(node: ast.For, traced: set[str]) -> None:
+    """Mark loop targets traced — per position for `zip`/`enumerate`
+    (iterating a Python container that MIXES static specs with traced
+    pytrees must not poison the static side)."""
+    it, tgt = node.iter, node.target
+    if (
+        isinstance(it, ast.Call)
+        and isinstance(it.func, ast.Name)
+        and isinstance(tgt, ast.Tuple)
+    ):
+        if it.func.id == "zip" and len(it.args) == len(tgt.elts):
+            for arg, elt in zip(it.args, tgt.elts):
+                if mentions_traced(arg, traced):
+                    for n in ast.walk(elt):
+                        if isinstance(n, ast.Name):
+                            traced.add(n.id)
+            return
+        if it.func.id == "enumerate" and len(tgt.elts) == 2 and it.args:
+            if mentions_traced(it.args[0], traced):
+                for n in ast.walk(tgt.elts[1]):
+                    if isinstance(n, ast.Name):
+                        traced.add(n.id)
+            return
+    for n in ast.walk(tgt):
+        if isinstance(n, ast.Name):
+            traced.add(n.id)
+
+
+def _walk_own(info: FunctionInfo):
+    """Statements of a function EXCLUDING nested function bodies (those
+    are analyzed as their own reachable nodes)."""
+    skip: set[int] = set()
+    for node in ast.walk(info.node):
+        if id(node) in skip:
+            continue
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node is not info.node
+        ):
+            for sub in ast.walk(node):
+                skip.add(id(sub))
+            continue
+        yield node
+
+
+@register_pass("trace-hazard", RULES)
+def run(project: Project) -> list[Finding]:
+    index = get_index(project)
+    roots = _Roots(project, index)
+    findings: list[Finding] = []
+
+    # ---- reachability + traced-parameter propagation (fixpoint)
+    traced_params: dict[tuple, set[str]] = {}
+    order: list[tuple] = []
+    for key, static in roots.static.items():
+        info = index.functions[key]
+        traced_params[key] = {
+            p for p in info.params if p not in static and p != "self"
+        }
+        order.append(key)
+
+    closure_env: dict[tuple, set[str]] = {k: set() for k in order}
+    work = list(order)
+    local_cache: dict[tuple, set[str]] = {}
+    hops = 0
+    while work and hops < 10000:
+        hops += 1
+        key = work.pop()
+        info = index.functions.get(key)
+        if info is None:
+            continue
+        seed = traced_params.get(key, set()) | closure_env.get(key, set())
+        traced = _local_traced(info, seed)
+        local_cache[key] = traced
+        for node in _walk_own(info):
+            if not isinstance(node, ast.Call):
+                continue
+            for callee in index.resolve_call(info, node):
+                ck = callee.key
+                params = callee.params
+                new = traced_params.setdefault(ck, set())
+                before = len(new) + len(closure_env.get(ck, set()))
+                pos = [p for p in params if p != "self"]
+                for i, arg in enumerate(node.args):
+                    if i < len(pos) and mentions_traced(arg, traced):
+                        new.add(pos[i])
+                for kw in node.keywords:
+                    if kw.arg in params and mentions_traced(
+                        kw.value, traced
+                    ):
+                        new.add(kw.arg)
+                if callee.parent and callee.sf.rel == info.sf.rel:
+                    # Nested callee closes over this scope's names.
+                    env = closure_env.setdefault(ck, set())
+                    env.update(n for n in traced if n not in params)
+                after = len(new) + len(closure_env.get(ck, set()))
+                if ck not in local_cache or after > before:
+                    work.append(ck)
+                    if ck not in order:
+                        order.append(ck)
+
+    # ---- rules inside the reachable set
+    for key in order:
+        info = index.functions.get(key)
+        if info is None:
+            continue
+        sf = info.sf
+        traced = local_cache.get(key, set())
+
+        def finding(rule: str, node: ast.AST, msg: str) -> None:
+            findings.append(
+                Finding(
+                    rule=rule,
+                    path=sf.rel,
+                    line=node.lineno,
+                    message=msg,
+                    context=info.qualname,
+                )
+            )
+
+        for node in _walk_own(info):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _HOST_SYNC_ATTRS
+                    and mentions_traced(f.value, traced)
+                ):
+                    finding(
+                        "host-sync",
+                        node,
+                        f".{f.attr}() on traced value in jit-reachable "
+                        f"[{info.qualname}]",
+                    )
+                elif (
+                    isinstance(f, ast.Name)
+                    and f.id in _HOST_SYNC_BUILTINS
+                    and any(mentions_traced(a, traced) for a in node.args)
+                ):
+                    finding(
+                        "host-sync",
+                        node,
+                        f"{f.id}() forces a traced value to host in "
+                        f"jit-reachable [{info.qualname}]",
+                    )
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _NUMPY_SYNC_FUNCS
+                    and isinstance(f.value, ast.Name)
+                    and index.imports.get(sf.rel, {}).get(f.value.id)
+                    == "numpy"
+                    and any(mentions_traced(a, traced) for a in node.args)
+                ):
+                    finding(
+                        "host-sync",
+                        node,
+                        f"np.{f.attr}() on traced value in jit-reachable "
+                        f"[{info.qualname}]",
+                    )
+            elif isinstance(node, (ast.If, ast.While)):
+                if _is_identity_test(node.test):
+                    # `x is None` / `x is not None` never reads traced
+                    # data — pytree structure is static at trace time.
+                    continue
+                if mentions_traced(node.test, traced):
+                    finding(
+                        "traced-branch",
+                        node,
+                        "Python branch on traced value in "
+                        f"[{info.qualname}] (trace error or per-value "
+                        "recompile)",
+                    )
+            elif isinstance(node, ast.For):
+                it = node.iter
+                hazard = (
+                    isinstance(it, ast.Name) and it.id in traced
+                ) or (
+                    isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Name)
+                    and it.func.id in ("range", "enumerate", "reversed")
+                    and any(
+                        mentions_traced(a, traced) for a in it.args
+                    )
+                )
+                if hazard:
+                    finding(
+                        "traced-branch",
+                        node,
+                        "Python loop over traced value in "
+                        f"[{info.qualname}] (length must be static)",
+                    )
+
+    # ---- whole-project structural rules
+    for sf in project.files.values():
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # jax.jit(...)(args): ephemeral wrapper, recompiles per call.
+            if isinstance(node.func, ast.Call) and _is_jax_jit(
+                index, sf, node.func.func
+            ):
+                findings.append(
+                    Finding(
+                        rule="jit-ephemeral",
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            "jax.jit(...) invoked inline — cache the "
+                            "jitted callable at module scope"
+                        ),
+                    )
+                )
+
+    # Static positions of known roots must receive hashable literals.
+    static_by_key = {
+        k: v for k, v in roots.static.items() if v
+    }
+    for sf in project.files.values():
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for callee in _resolve_any(index, sf, node):
+                static = static_by_key.get(callee.key)
+                if not static:
+                    continue
+                pos = [p for p in callee.params if p != "self"]
+                bad: list[tuple[str, ast.AST]] = []
+                for i, arg in enumerate(node.args):
+                    if i < len(pos) and pos[i] in static and isinstance(
+                        arg, _UNHASHABLE
+                    ):
+                        bad.append((pos[i], arg))
+                for kw in node.keywords:
+                    if kw.arg in static and isinstance(
+                        kw.value, _UNHASHABLE
+                    ):
+                        bad.append((kw.arg, kw.value))
+                for pname, arg in bad:
+                    findings.append(
+                        Finding(
+                            rule="jit-unhashable-static",
+                            path=sf.rel,
+                            line=arg.lineno,
+                            message=(
+                                f"unhashable literal for static jit "
+                                f"arg [{pname}] of "
+                                f"[{callee.qualname}]"
+                            ),
+                        )
+                    )
+    return findings
+
+
+def _resolve_any(index: ProjectIndex, sf, call: ast.Call):
+    """Resolve a call from arbitrary (possibly module-level) context."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        info = index.functions.get((sf.rel, f.id))
+        if info is not None:
+            return [info]
+        dotted = index.imports.get(sf.rel, {}).get(f.id)
+        if dotted and "." in dotted:
+            mod, name = dotted.rsplit(".", 1)
+            rel = index.module_rel.get(mod)
+            if rel:
+                info = index.functions.get((rel, name))
+                if info is not None:
+                    return [info]
+    elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        dotted = index.imports.get(sf.rel, {}).get(f.value.id)
+        if dotted:
+            rel = index.module_rel.get(dotted)
+            if rel:
+                info = index.functions.get((rel, f.attr))
+                if info is not None:
+                    return [info]
+    return []

@@ -1,0 +1,51 @@
+"""Fixture: exception/clock hygiene positives, twins, and exemptions."""
+
+import time
+
+
+def swallows():
+    try:
+        step()
+    except Exception:  # broad-except: can swallow cancellation
+        pass
+
+
+def wall_duration():
+    t0 = time.time()  # wallclock-duration
+    step()
+    return time.monotonic() - t0  # monotonic: fine
+
+
+def suppressed():
+    try:
+        step()
+    # staticcheck: ignore[broad-except] fixture: suppressed twin
+    except Exception:
+        pass
+    # staticcheck: ignore[wallclock-duration] fixture: suppressed twin
+    return time.time()
+
+
+def guarded():
+    try:
+        step()
+    except TaskCancelledError:
+        raise
+    except Exception:  # exempt: cancellation re-raised above
+        pass
+
+
+def cleanup_reraise(res):
+    try:
+        step()
+    except Exception:  # exempt: bare re-raise cannot swallow
+        res.close()
+        raise
+
+
+class TaskCancelledError(Exception):
+    pass
+
+
+def step():
+    pass

@@ -1,0 +1,34 @@
+"""Fixture: lock-discipline positives + suppressed twins."""
+
+import threading
+import time
+
+
+class Pair:
+    def __init__(self):
+        self.alpha = threading.Lock()
+        self.beta = threading.Lock()
+
+    def ab(self):
+        with self.alpha:
+            with self.beta:  # alpha -> beta
+                pass
+
+    def ba(self):
+        with self.beta:
+            with self.alpha:  # beta -> alpha: lock-order inversion
+                pass
+
+    def sleepy(self):
+        with self.alpha:
+            time.sleep(0.1)  # lock-blocking-call
+
+    def sleepy_ok(self):
+        with self.alpha:
+            # staticcheck: ignore[lock-blocking-call] fixture: suppressed twin
+            time.sleep(0.1)
+
+    def nested_same(self):
+        with self.alpha:
+            with self.alpha:  # plain Lock re-entered: self-deadlock
+                pass

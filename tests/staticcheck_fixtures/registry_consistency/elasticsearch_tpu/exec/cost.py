@@ -1,0 +1,3 @@
+"""Fixture cost model: only [device] is seeded."""
+
+SEEDED = ("device",)

@@ -1,0 +1,5 @@
+"""Fixture planner: [ghost] has no cost seed and no surfacing site."""
+
+
+class ExecPlanner:
+    BACKENDS = ("device", "ghost")

@@ -1,0 +1,7 @@
+"""Fixture fault registry: [dead.site] has no call site."""
+
+SITES = ("search.kernel", "dead.site")
+
+
+def fault_point(site: str, **ctx) -> None:
+    pass

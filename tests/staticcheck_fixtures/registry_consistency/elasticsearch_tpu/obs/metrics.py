@@ -1,0 +1,7 @@
+"""Fixture catalog: [estpu_dead_total] is never referenced by code."""
+
+CATALOG = {
+    "estpu_good_total": ("counter", "fixture"),
+    "estpu_kind_total": ("counter", "fixture"),
+    "estpu_dead_total": ("counter", "fixture"),
+}

@@ -1,0 +1,38 @@
+"""Fixture bool-spec module: raw construction + out-of-range index."""
+
+BOOL_SPEC_FIELDS = (
+    "kind",
+    "must",
+    "should",
+    "filter",
+    "must_not",
+    "msm",
+    "lead",
+)
+
+
+def make_bool_spec(must, should, filter_, must_not, msm, lead):
+    return (
+        "bool",
+        tuple(must),
+        tuple(should),
+        tuple(filter_),
+        tuple(must_not),
+        int(msm),
+        int(lead),
+    )
+
+
+def rogue_build(groups, msm):
+    return ("bool", tuple(groups), int(msm))  # raw construction
+
+
+def rogue_read(spec):
+    if spec[0] == "bool":
+        return spec[7]  # index beyond the declared arity
+    return None
+
+
+def suppressed_build(groups, msm):
+    # staticcheck: ignore[bool-spec] fixture: suppressed twin
+    return ("bool", tuple(groups), int(msm))

@@ -1,0 +1,20 @@
+"""Fixture consumers: the call sites the registry contracts check."""
+
+from .faults.registry import fault_point
+
+
+def arm_faults():
+    fault_point("search.kernel")  # registered: fine
+    fault_point("unregistered.site")  # not in SITES
+    # staticcheck: ignore[registry-fault-site] fixture: suppressed twin
+    fault_point("other.bad")
+
+
+def make_instruments(m):
+    m.counter("estpu_good_total", "cataloged: fine")
+    m.counter("estpu_rogue_total", "not in CATALOG")
+    m.gauge("estpu_kind_total", "cataloged as counter: kind mismatch")
+
+
+def route(backend="device"):
+    return backend

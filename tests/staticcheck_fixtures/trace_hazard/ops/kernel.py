@@ -1,0 +1,37 @@
+"""Fixture: trace-hazard positives + suppressed twins (not collected by
+pytest; analyzed as a mini-project by tests/test_staticcheck.py)."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("spec", "k"))
+def execute(spec, arrays, k):
+    total = jnp.sum(arrays)
+    bad = float(total)  # host-sync: traced value forced to host
+    ok = float(k)  # static arg: fine
+    if total > 0:  # traced-branch: data-dependent Python if
+        bad += 1.0
+    # staticcheck: ignore[host-sync] fixture: suppressed twin
+    bad2 = np.asarray(total)
+    # staticcheck: ignore[traced-branch] fixture: suppressed twin
+    if total > 1:
+        ok += 1.0
+    return helper(arrays), bad, bad2, ok
+
+
+def helper(xs):
+    # Reachable from the jit root above: flagged transitively.
+    return xs.item()  # host-sync via reachability
+
+
+def ephemeral(xs):
+    return jax.jit(helper)(xs)  # jit-ephemeral: fresh cache per call
+
+
+def caller(arrays):
+    # list literal in the static [spec] position: unhashable.
+    return execute([1, 2], arrays, 10)

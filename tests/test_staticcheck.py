@@ -1,0 +1,307 @@
+"""The analyzer analyzed: fixture snippets trigger each rule exactly as
+designed (positive + suppressed twin per rule), seeded defects fail the
+gate, and the live repo itself runs clean — the tier-1 contract of
+ISSUE 6 (`python -m staticcheck` as a merge gate).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from staticcheck.core import Project, load_baseline, run_project
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(__file__), "staticcheck_fixtures")
+
+
+def run_fixture(name: str):
+    return run_project(Project(os.path.join(FIXTURES, name)))
+
+
+def rules_of(findings):
+    out = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return out
+
+
+# ------------------------------------------------------------ trace-hazard
+
+
+class TestTraceHazard:
+    @pytest.fixture(scope="class")
+    def report(self):
+        return run_fixture("trace_hazard")
+
+    def test_host_sync_fires(self, report):
+        hits = [f for f in report.findings if f.rule == "host-sync"]
+        # float(total) in the root + .item() in the reachable helper;
+        # float(k) on the static arg stays clean.
+        assert len(hits) == 2
+        assert {f.context for f in hits} == {"execute", "helper"}
+
+    def test_traced_branch_fires_once(self, report):
+        hits = [f for f in report.findings if f.rule == "traced-branch"]
+        assert len(hits) == 1
+
+    def test_jit_ephemeral_fires(self, report):
+        assert rules_of(report.findings).get("jit-ephemeral") == 1
+
+    def test_unhashable_static_fires(self, report):
+        hits = [
+            f for f in report.findings if f.rule == "jit-unhashable-static"
+        ]
+        assert len(hits) == 1
+        assert "[spec]" in hits[0].message
+
+    def test_suppressed_twins(self, report):
+        sup = rules_of(report.suppressed)
+        assert sup.get("host-sync") == 1
+        assert sup.get("traced-branch") == 1
+
+    def test_gate_fails(self, report):
+        assert report.failed
+
+
+# --------------------------------------------------------- lock-discipline
+
+
+class TestLockDiscipline:
+    @pytest.fixture(scope="class")
+    def report(self):
+        return run_fixture("lock_discipline")
+
+    def test_lock_order_inversion(self, report):
+        hits = [f for f in report.findings if f.rule == "lock-order"]
+        # One cycle, reported once.
+        assert len(hits) == 1
+        assert "Pair.alpha" in hits[0].message
+        assert "Pair.beta" in hits[0].message
+
+    def test_blocking_call(self, report):
+        hits = [
+            f for f in report.findings if f.rule == "lock-blocking-call"
+        ]
+        assert len(hits) == 1
+        assert "time.sleep" in hits[0].message
+
+    def test_self_deadlock(self, report):
+        assert rules_of(report.findings).get("lock-self-deadlock") == 1
+
+    def test_suppressed_twin(self, report):
+        assert rules_of(report.suppressed).get("lock-blocking-call") == 1
+
+    def test_gate_fails(self, report):
+        assert report.failed
+
+
+# ----------------------------------------------------- registry-consistency
+
+
+class TestRegistryConsistency:
+    @pytest.fixture(scope="class")
+    def report(self):
+        return run_fixture("registry_consistency")
+
+    def test_unseeded_unsurfaced_backend(self, report):
+        msgs = [
+            f.message
+            for f in report.findings
+            if f.rule == "registry-backend"
+        ]
+        # [ghost] lacks both a cost seed and any surfacing site;
+        # [device] is covered by both and stays clean.
+        assert len(msgs) == 2
+        assert all("[ghost]" in m for m in msgs)
+
+    def test_fault_sites(self, report):
+        msgs = [
+            f.message
+            for f in report.findings
+            if f.rule == "registry-fault-site"
+        ]
+        assert any("[unregistered.site]" in m for m in msgs)
+        assert any("[dead.site]" in m for m in msgs)
+        assert len(msgs) == 2
+
+    def test_fault_site_suppressed_twin(self, report):
+        assert rules_of(report.suppressed).get("registry-fault-site") == 1
+
+    def test_metrics_catalog(self, report):
+        msgs = [
+            f.message for f in report.findings if f.rule == "registry-metric"
+        ]
+        assert any("[estpu_rogue_total]" in m for m in msgs)  # uncataloged
+        assert any("[estpu_kind_total]" in m for m in msgs)  # kind clash
+        assert any("[estpu_dead_total]" in m for m in msgs)  # dead entry
+        assert len(msgs) == 3
+
+    def test_bool_spec(self, report):
+        msgs = [f.message for f in report.findings if f.rule == "bool-spec"]
+        assert any("raw ('bool'" in m for m in msgs)
+        assert any("index [7]" in m for m in msgs)
+        assert len(msgs) == 2
+        assert rules_of(report.suppressed).get("bool-spec") == 1
+
+    def test_gate_fails(self, report):
+        assert report.failed
+
+
+# ------------------------------------------------------------------ hygiene
+
+
+class TestHygiene:
+    @pytest.fixture(scope="class")
+    def report(self):
+        return run_fixture("hygiene")
+
+    def test_broad_except_fires_once(self, report):
+        hits = [f for f in report.findings if f.rule == "broad-except"]
+        # `guarded` (cancellation re-raised first) and `cleanup_reraise`
+        # (bare re-raise) are exempt by construction.
+        assert len(hits) == 1
+        assert hits[0].context == "swallows"
+
+    def test_wallclock_fires_once(self, report):
+        hits = [
+            f for f in report.findings if f.rule == "wallclock-duration"
+        ]
+        assert len(hits) == 1
+        assert hits[0].context == "wall_duration"
+
+    def test_suppressed_twins(self, report):
+        sup = rules_of(report.suppressed)
+        assert sup.get("broad-except") == 1
+        assert sup.get("wallclock-duration") == 1
+
+    def test_gate_fails(self, report):
+        assert report.failed
+
+
+# ------------------------------------------------------- framework contract
+
+
+class TestFramework:
+    def test_reasonless_suppression_does_not_suppress(self, tmp_path):
+        (tmp_path / "mod.py").write_text(
+            "import time\n"
+            "def f():\n"
+            "    # staticcheck: ignore[wallclock-duration]\n"
+            "    return time.time()\n"
+        )
+        report = run_project(Project(str(tmp_path)))
+        assert rules_of(report.findings).get("wallclock-duration") == 1
+
+    def test_unused_suppression_is_advisory(self, tmp_path):
+        (tmp_path / "mod.py").write_text(
+            "x = 1  # staticcheck: ignore[broad-except] nothing here\n"
+        )
+        report = run_project(Project(str(tmp_path)))
+        assert rules_of(report.findings) == {"unused-suppression": 1}
+        assert not report.failed  # advisory: never gates
+
+    def test_docstring_mention_is_not_a_suppression(self, tmp_path):
+        (tmp_path / "mod.py").write_text(
+            '"""Docs: # staticcheck: ignore[broad-except] example."""\n'
+        )
+        report = run_project(Project(str(tmp_path)))
+        assert report.findings == []
+
+    def test_inline_suppression_covers_only_its_own_line(self, tmp_path):
+        (tmp_path / "mod.py").write_text(
+            "import time\n"
+            "def f():\n"
+            "    a = time.time()\n"
+            "    b = time.time()  "
+            "# staticcheck: ignore[wallclock-duration] only this line\n"
+            "    return a, b\n"
+        )
+        report = run_project(Project(str(tmp_path)))
+        hits = [
+            f for f in report.findings if f.rule == "wallclock-duration"
+        ]
+        # The unannotated call one line ABOVE the comment still gates.
+        assert [f.line for f in hits] == [3]
+        assert [f.line for f in report.suppressed] == [4]
+
+    def test_only_typo_exits_nonzero(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "staticcheck", "--only", "hygeine"],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 2
+        assert "unknown pass famil" in proc.stderr
+
+    def test_write_baseline_excludes_advisory_findings(self, tmp_path):
+        import json
+
+        (tmp_path / "mod.py").write_text(
+            "import time\n"
+            "x = 1  # staticcheck: ignore[broad-except] stale\n"
+            "def f():\n    return time.time()\n"
+        )
+        baseline_path = tmp_path / "baseline.json"
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "staticcheck",
+                "--root",
+                str(tmp_path),
+                "--baseline",
+                str(baseline_path),
+                "--write-baseline",
+            ],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        entries = json.loads(baseline_path.read_text())
+        rules = {e["rule"] for e in entries}
+        # The real finding is grandfathered; the stale suppression is not.
+        assert rules == {"wallclock-duration"}
+
+    def test_baseline_grandfathers_findings(self, tmp_path):
+        (tmp_path / "mod.py").write_text(
+            "import time\n\ndef f():\n    return time.time()\n"
+        )
+        project = Project(str(tmp_path))
+        first = run_project(project)
+        assert first.failed
+        baseline = {f.fingerprint for f in first.findings}
+        second = run_project(Project(str(tmp_path)), baseline=baseline)
+        assert not second.failed
+        assert len(second.baselined) == len(first.findings)
+
+
+# ------------------------------------------------------------ the live repo
+
+
+class TestLiveRepo:
+    def test_repo_has_zero_non_baselined_findings(self):
+        baseline = load_baseline(
+            os.path.join(REPO_ROOT, "staticcheck", "baseline.json")
+        )
+        report = run_project(Project(REPO_ROOT), baseline=baseline)
+        rendered = "\n".join(f.render() for f in report.findings)
+        assert not report.failed, f"new staticcheck findings:\n{rendered}"
+
+    def test_check_static_script_passes_and_summarizes(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join("scripts", "check_static.py")],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "staticcheck summary" in proc.stdout
