@@ -16,15 +16,27 @@ live here as one subsystem:
                 changes top-k ids/order/scores) plus decision counters;
 - batcher.py  — the continuous micro-batching scheduler in the serving
                 path (deadline-aware max-wait, task cancellation while
-                queued, load shedding).
+                queued, load shedding);
+- packed.py   — the packed multi-tenant executor: many SMALL indices
+                share one device plane and one coalesced launch (the
+                batcher's cross-index group), with per-tenant result
+                parity and planner-routed packed-vs-oracle execution.
 
 Every routing decision is observable: `profile: true` carries the chosen
 backend per shard, and `GET /_nodes/stats` exposes decision counters,
-batch-occupancy histograms, queue-wait percentiles, and EWMA snapshots.
+batch-occupancy histograms, queue-wait percentiles, packed-launch
+occupancy, and EWMA snapshots.
 """
 
 from .batcher import MicroBatcher
 from .cost import CostModel, PlanFeatures
+from .packed import PackedExecutor
 from .planner import ExecPlanner
 
-__all__ = ["CostModel", "ExecPlanner", "MicroBatcher", "PlanFeatures"]
+__all__ = [
+    "CostModel",
+    "ExecPlanner",
+    "MicroBatcher",
+    "PackedExecutor",
+    "PlanFeatures",
+]

@@ -40,7 +40,7 @@ import os
 import threading
 import time
 import weakref
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -226,6 +226,14 @@ class MicroBatcher:
             fn=_queued_depth,
         )
         self._wait_samples: deque[float] = deque(maxlen=512)
+        # Per-group coalescing effectiveness (under _cv): group label ->
+        # {launches, riders, tenants_last, tenants_max}. Labeled by the
+        # group key's leading element (index name, or "_packed" for the
+        # cross-index packed group) so cardinality stays bounded; riders
+        # carrying a `tenant_key` attribute (exec/packed.TenantSearch)
+        # count distinct tenants per launch — the observable that says
+        # whether multi-tenant packing is actually coalescing.
+        self._group_stats: "OrderedDict[str, dict]" = OrderedDict()
         # Failure isolation / quarantine state (under _cv).
         self._group_failures: dict[tuple, int] = {}
         # group -> (parole time, weakref to the offending searcher). The
@@ -315,6 +323,38 @@ class MicroBatcher:
         if self._thread is not None:
             self._thread.join(timeout=1.0)
 
+    _GROUP_STATS_MAX = 64  # LRU bound on tracked group labels
+
+    def _note_group_locked(self, group: tuple, live: list) -> None:
+        """Record one launch's coalescing stats for its group label.
+        Caller holds _cv."""
+        gkey = group[1]
+        label = str(
+            gkey[0] if isinstance(gkey, tuple) and gkey else gkey
+        )
+        tenants = set()
+        for it in live:
+            t = getattr(it.request, "tenant_key", None)
+            tenants.add(label if t is None else t)
+        entry = self._group_stats.get(label)
+        if entry is None:
+            entry = {
+                "launches": 0,
+                "riders": 0,
+                "coalesced_tenants_last": 0,
+                "coalesced_tenants_max": 0,
+            }
+            self._group_stats[label] = entry
+        entry["launches"] += 1
+        entry["riders"] += len(live)
+        entry["coalesced_tenants_last"] = len(tenants)
+        entry["coalesced_tenants_max"] = max(
+            entry["coalesced_tenants_max"], len(tenants)
+        )
+        self._group_stats.move_to_end(label)
+        while len(self._group_stats) > self._GROUP_STATS_MAX:
+            self._group_stats.popitem(last=False)
+
     def _retry_after_locked(self, depth: int) -> int:
         """Retry-After seconds for a shed request: the observed queue-wait
         p50 scaled by how many batches deep the queue is — an honest
@@ -353,6 +393,13 @@ class MicroBatcher:
                 "groups_quarantined": int(self._quarantined_total.value),
                 "quarantine_hits": int(self._quarantine_hits_c.value),
                 "quarantined_now": len(self._quarantine),
+                # Per-group coalescing effectiveness: launches/riders and
+                # distinct coalesced tenants per launch (packing shows up
+                # here as coalesced_tenants_* > 1 under "_packed").
+                "groups": {
+                    label: dict(entry)
+                    for label, entry in self._group_stats.items()
+                },
             }
         if samples.size:
             out["queue_wait_p50_ms"] = round(
@@ -621,6 +668,8 @@ class MicroBatcher:
                     self._group_failures.pop(group, None)
             if len(live) >= 2:
                 self._coalesced.inc(len(live))
+            if group is not None and live:
+                self._note_group_locked(group, live)
             bucket = 1 << max(0, len(live) - 1).bit_length() if live else 0
             self._occupancy.observe(float(bucket))
             # Two renderings of the same observations: the bounded deque

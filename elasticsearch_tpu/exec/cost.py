@@ -40,6 +40,7 @@ class PlanFeatures:
     work_tiles: int = 0  # pow-2 worklist tiles the compiled plan touches
     n_clauses: int = 1  # scoring clauses (run-fold width proxy)
     n_shards: int = 1  # stacked shards served by one launch
+    n_lanes: int = 1  # coalesced (query, tenant) lanes sharing one launch
 
 
 # Seed coefficients, milliseconds. Anchored to BENCH_r05 measurements
@@ -58,10 +59,16 @@ _ORACLE_TOPK_MS = 0.000025  # per corpus doc (lexsort/top-k share)
 def coalesce_wins(extra_pad_tiles: int) -> bool:
     """Should a smaller worklist group share a larger bucket's coalesced
     launch? True when the padding work it would add (seed per-tile cost)
-    costs less than the launch dispatch it saves — the single decision
-    rule behind adaptive sub-bucket splitting (exec/batcher.
+    costs less than the ONE launch dispatch the merge saves — the single
+    decision rule behind adaptive sub-bucket splitting (exec/batcher.
     plan_spec_buckets), replacing the unconditional pad-to-group-max that
-    made BENCH_r05's cfg3 batched execution slower than sequential."""
+    made BENCH_r05's cfg3 batched execution slower than sequential.
+
+    The same rule prices CROSS-TENANT merges on the packed plane
+    (exec/packed.py): there `extra_pad_tiles` is summed over every
+    tenant lane the bucket carries — the merged groups' tenants pay the
+    padding collectively — so a merge happens only when the total
+    cross-tenant padding stays under the launch it saves."""
     return _DEVICE_TILE_MS * max(0, extra_pad_tiles) <= _DEVICE_LAUNCH_MS
 
 
@@ -88,6 +95,21 @@ def seed_ms(backend: str, feats: PlanFeatures) -> float:
             _BLOCKMAX_LAUNCH_MS
             + _DEVICE_TILE_MS * feats.work_tiles * 0.5 * shards
         )
+    if backend == "packed":
+        # Packed multi-tenant launch (exec/packed.py): ONE dispatch is
+        # shared by every coalesced lane, so the per-lane launch floor
+        # divides by the lane count — the amortization that flips tiny
+        # indices from oracle-bound to device-bound. Per-lane tile work
+        # is unchanged (each lane gathers only its own tenant's tiles);
+        # dense-shape lanes pay the plane-sized top-k like the device.
+        cost = _DEVICE_LAUNCH_MS / max(1, feats.n_lanes) + (
+            _DEVICE_TILE_MS * feats.work_tiles
+        )
+        if feats.work_tiles == 0:
+            cost += _DEVICE_DENSE_MS * (feats.n_docs / 1e6) * max(
+                1, feats.n_clauses
+            )
+        return cost
     # Device kernels: sparse work scales with the worklist; dense work
     # scales with the corpus. The caller picks which by setting work_tiles
     # (sparse) vs n_docs-dominated features (dense has work_tiles == 0).

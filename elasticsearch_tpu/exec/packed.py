@@ -1,0 +1,618 @@
+"""Packed multi-tenant execution: one launch scores many small indices.
+
+The serving-path owner of index/tiles.py's PackedPlane. The north-star
+workload is millions of SMALL tenants (BENCH_r05 cfg1: a 5k-doc index ran
+0.08x the CPU oracle because ~2 ms of per-launch dispatch dwarfed ~0.2 ms
+of scoring), and the micro-batcher could never help: its group key was
+`(id(searcher), ...)`, so concurrent searches against DIFFERENT small
+indices each paid their own launch. This module gives all packable
+tenants ONE shared searcher facade — the batcher's group key then
+coalesces cross-index traffic naturally — and executes a coalesced batch
+as a single `execute_batch_packed` launch over one packed plane, the way
+the reference amortizes per-segment work inside a single Lucene
+`IndexSearcher` pass instead of paying a JVM entry per segment.
+
+Flow per coalesced batch (`search_many`):
+
+1. ensure the plane: every known packable tenant's refreshed segments
+   concatenate into one PackedPlane (cached; rebuilt when any member's
+   engine generation moves — a refresh/delete invalidates exactly like
+   the per-tenant device path);
+2. compile each rider against its tenant's per-member views — plans land
+   directly in packed coordinates with the tenant's OWN statistics, so
+   per-tenant scores are bit-identical to solo execution;
+3. group lanes by spec and let `exec.batcher.plan_spec_buckets` merge
+   same-family groups across tenants (a smaller tenant's worklist joins a
+   larger bucket only when the cross-tenant padding it pays costs less
+   than the launch it saves — `exec.cost.coalesce_wins`);
+4. per bucket, the planner picks `packed` vs the per-tenant CPU oracle
+   (plan class `("packed", spec, k)`, candidates restricted to backends
+   that cannot change results); `packed` runs one vmapped launch with
+   per-lane tenant doc bounds, the oracle runs per lane on the tenant's
+   own segment;
+5. responses assemble through each tenant's SearchService (same fetch /
+   pagination code as solo searches).
+
+**Invariant: packing never changes results.** Per tenant, packed top-k
+ids, order, fp32 scores and totals equal solo execution (fuzzed in
+tests/test_packed_multitenant.py, gated by scripts/check_packed_smoke.py
+and bench.py's cfg6 parity gate). Cross-tenant isolation is structural
+(a lane's worklist tiles lie in its own tenant's tile range) and enforced
+(the kernel masks eligibility to the lane's doc bounds).
+
+Anything the plane cannot serve — multi-shard indices, non-inverted
+query shapes, oversized tenants, zero-segment edge cases — falls back to
+the per-tenant path (counted in `estpu_packed_fallback_solo_total`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+
+import numpy as np
+
+from ..common.tasks import TaskCancelledError
+from ..faults import fault_point
+from ..obs.metrics import OCCUPANCY_BUCKETS
+from ..query.dsl import (
+    BoolQuery,
+    ConstantScoreQuery,
+    MatchNoneQuery,
+    MatchQuery,
+    Query,
+    TermQuery,
+    TermsQuery,
+)
+
+# Query leaves that lower to pure inverted-postings plans (the packed
+# plane holds only postings planes). Field types are checked separately:
+# a term query on a NUMERIC field compiles to a doc-values range, which
+# the plane cannot serve.
+_PACKED_LEAVES = (MatchQuery, TermQuery, TermsQuery)
+_PACKED_FIELD_TYPES = ("text", "keyword")
+
+
+def packed_query_eligible(query: Query, mappings) -> bool:
+    """May this query compile against a packed plane's views? True only
+    for trees of inverted-field term shapes (match / term / terms / bool
+    combinations) — everything a small tenant's hot path sends."""
+    if isinstance(query, BoolQuery):
+        return all(
+            packed_query_eligible(c, mappings)
+            for c in (
+                list(query.must)
+                + list(query.should)
+                + list(query.filter)
+                + list(query.must_not)
+            )
+        )
+    if isinstance(query, ConstantScoreQuery):
+        return packed_query_eligible(query.filter, mappings)
+    if isinstance(query, MatchNoneQuery):
+        return True
+    if isinstance(query, _PACKED_LEAVES):
+        fm = mappings.get(query.field_name)
+        return fm is not None and fm.type in _PACKED_FIELD_TYPES
+    return False
+
+
+class TenantSearch:
+    """One rider of the shared packed group: (index service, request).
+
+    The micro-batcher treats requests opaquely; `tenant_key` is the one
+    attribute it reads (per-group coalesced-tenant telemetry)."""
+
+    __slots__ = ("svc", "request", "tenant_key")
+
+    def __init__(self, svc, request):
+        self.svc = svc
+        self.request = request
+        self.tenant_key = svc.name
+
+
+class _Unpackable(Exception):
+    """A lane's compiled spec cannot ride the plane (solo fallback)."""
+
+
+class PackedExecutor:
+    """Node-level packed multi-tenant searcher facade.
+
+    Passed to MicroBatcher.execute as the `searcher` for every packable
+    search, so the batcher's `(id(searcher), group_key)` group coalesces
+    across indices; implements the searcher contract the batcher relies
+    on (`search`, `search_many`).
+    """
+
+    # Per-tenant doc ceiling: beyond this the per-launch dispatch no
+    # longer dominates and the regular device path wins anyway.
+    MAX_TENANT_DOCS = 65_536
+    # Plane doc budget: beyond it, packing stops accepting new tenants
+    # (HBM duplication bound; riders past the budget fall back solo).
+    MAX_PLANE_DOCS = 4_000_000
+
+    def __init__(self, metrics=None, planner=None, device=None):
+        if metrics is None:
+            from ..obs.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
+        self.planner = planner
+        self.device = device  # obs.DeviceInstruments (launch/h2d/padding)
+        self._lock = threading.Lock()
+        # Known packable tenants (weak: a deleted index must not be kept
+        # alive, nor resurrect into the next plane).
+        self._tenants: "weakref.WeakValueDictionary[str, object]" = (
+            weakref.WeakValueDictionary()
+        )
+        self._plane = None
+        self._plane_tree = None
+        self._plane_key = None
+        # uuid -> [(member index, SegmentHandle)] for the current plane.
+        self._member_rows: dict[str, list] = {}
+        self._launches = metrics.counter(
+            "estpu_packed_launches_total",
+            "Packed multi-tenant kernel launches",
+        )
+        self._lanes_total = metrics.counter(
+            "estpu_packed_lanes_total",
+            "(query, tenant-segment) lanes scored by packed launches",
+        )
+        self._rebuilds = metrics.counter(
+            "estpu_packed_plane_rebuilds_total",
+            "Packed plane (re)builds",
+        )
+        self._fallbacks = metrics.counter(
+            "estpu_packed_fallback_solo_total",
+            "Riders that fell back to the per-tenant path",
+        )
+        self._tenants_hist = metrics.histogram(
+            "estpu_packed_tenants_per_launch",
+            (0.0,) + OCCUPANCY_BUCKETS,
+            "Distinct tenants coalesced into one packed launch",
+        )
+        self._lanes_hist = metrics.histogram(
+            "estpu_packed_lanes_per_launch",
+            (0.0,) + OCCUPANCY_BUCKETS,
+            "Lanes (pow-2 bucketed) per packed launch",
+        )
+        metrics.gauge(
+            "estpu_packed_plane_docs",
+            "Docs resident in the current packed plane",
+            fn=lambda: self._plane.num_docs if self._plane else 0,
+        )
+        metrics.gauge(
+            "estpu_packed_plane_tenants",
+            "Tenants resident in the current packed plane",
+            fn=lambda: len(self._member_rows),
+        )
+
+    # -------------------------------------------------------- eligibility
+
+    def eligible(self, svc, request) -> bool:
+        """May this (index, request) ride the packed group? Single-shard
+        small indices with inverted-only query shapes; everything else
+        keeps the per-index batching group. The batcher's own gate
+        (Node._batchable) has already excluded aggs/sort/rescore/cursor/
+        suggest shapes."""
+        if len(svc.engines) != 1:
+            return False
+        # The per-tenant assembly (fetch/pagination) runs through the
+        # tenant's own SearchService; anything else (sharded coordinator)
+        # keeps its per-index group.
+        if not hasattr(svc.search, "assemble_plain"):
+            return False
+        if svc.num_docs > self.MAX_TENANT_DOCS:
+            return False
+        if getattr(request, "search_after", None) is not None:
+            return False
+        return packed_query_eligible(request.query, svc.mappings)
+
+    def wrap(self, svc, request) -> TenantSearch:
+        return TenantSearch(svc, request)
+
+    # ---------------------------------------------- searcher facade (batcher)
+
+    def search(self, wrapped: TenantSearch, task=None):
+        """Solo / quarantine / retry path: the tenant's own service."""
+        return wrapped.svc.search.search(wrapped.request, task=task)
+
+    def _solo(self, wrapped: TenantSearch, task, fallback: bool = True):
+        """Per-tenant execution inside a coalesced batch: result or the
+        error the solo path would raise (the batcher re-raises it on the
+        rider's own thread). `fallback` distinguishes riders the plane
+        REFUSED (counted) from a companion-less batch of one (the normal
+        idle path — nothing to amortize, nothing fell back)."""
+        if fallback:
+            self._fallbacks.inc()
+        try:
+            return self.search(wrapped, task=task)
+        # staticcheck: ignore[broad-except] the batcher contract returns one result-or-exception per rider; the rider's own error must not fail batchmates
+        except Exception as e:
+            return e
+
+    def search_many(self, wrapped: list, tasks: list | None = None) -> list:
+        """Serve a coalesced cross-tenant batch. Returns one
+        SearchResponse (or Exception) per rider, result-identical to each
+        rider running solo on its own index."""
+        start = time.monotonic()
+        n = len(wrapped)
+        if tasks is None:
+            tasks = [None] * n
+        if n == 1:
+            # No companions: nothing to amortize — the per-tenant path
+            # (with its own planner routing) is the honest executor.
+            return [self._solo(wrapped[0], tasks[0], fallback=False)]
+        plane_info = self._ensure_plane([w.svc for w in wrapped])
+        if plane_info is None:
+            return [self._solo(w, t) for w, t in zip(wrapped, tasks)]
+        plane, tree, member_rows = plane_info
+
+        out: list = [None] * n
+        cands: list[list] = [[] for _ in range(n)]
+        totals = [0] * n
+        timed = [False] * n
+        errors: list[Exception | None] = [None] * n
+        solo: set[int] = set()
+        ks: list[int] = [0] * n
+        # lanes: rider -> one lane per tenant segment member.
+        lanes: list[tuple] = []  # (rider, member, handle, CompiledQuery)
+        for i, w in enumerate(wrapped):
+            task = tasks[i]
+            if task is not None and task.cancelled:
+                reason = getattr(task, "cancel_reason", None) or "cancelled"
+                errors[i] = TaskCancelledError(f"task cancelled [{reason}]")
+                continue
+            if task is not None and task.check_deadline():
+                timed[i] = True
+                continue
+            rows = member_rows.get(w.svc.uuid)
+            if rows is None:
+                solo.add(i)
+                continue
+            ks[i] = max(0, w.request.from_) + max(0, w.request.size)
+            engine = w.svc.engines[0]
+            stats = engine.field_stats()
+            mine: list[tuple] = []
+            try:
+                for member, handle in rows:
+                    compiled = self._compile_lane(
+                        plane, member, handle, w, engine, stats
+                    )
+                    mine.append((i, member, handle, compiled))
+            except ValueError as e:
+                errors[i] = e  # request-shaped: the solo path 400s too
+                continue
+            except _Unpackable:
+                solo.add(i)
+                continue
+            lanes.extend(mine)
+
+        self._execute_lanes(
+            plane, tree, wrapped, tasks, lanes, ks, cands, totals, errors
+        )
+
+        for i, w in enumerate(wrapped):
+            if errors[i] is not None:
+                out[i] = errors[i]
+            elif i in solo:
+                out[i] = self._solo(w, tasks[i])
+            else:
+                out[i] = w.svc.search.assemble_plain(
+                    w.request, cands[i], totals[i], timed[i], start
+                )
+        return out
+
+    # ----------------------------------------------------------- internals
+
+    def _compile_lane(self, plane, member, handle, wrapped, engine, stats):
+        """Compile one rider's query against one member's packed views.
+
+        The views carry the tenant's own term dictionary, statistics and
+        precomputed impacts with posting offsets shifted into plane
+        coordinates, so the standard Compiler emits the exact solo plan,
+        relocated — fp32 parity by construction."""
+        from ..ops import bm25_device
+        from ..query.compile import Compiler
+
+        compiler = Compiler(
+            fields=plane.member_fields(member),
+            doc_values={},
+            mappings=wrapped.svc.mappings,
+            params=engine.params,
+            stats=stats,
+        )
+        compiled = compiler.compile(wrapped.request.query)
+        if not bm25_device.supports_packed(compiled.spec):
+            raise _Unpackable()
+        return compiled
+
+    def _execute_lanes(
+        self, plane, tree, wrapped, tasks, lanes, ks, cands, totals, errors
+    ) -> None:
+        """Bucket lanes by spec (cross-tenant coalescing under the cost
+        rule) and execute each bucket via the planner-chosen backend."""
+        from ..query.compile import CompiledQuery, pad_arrays_to_spec, unify_specs
+        from .batcher import plan_spec_buckets
+
+        groups: dict[tuple, list[int]] = {}
+        for idx, (_i, _m, _h, compiled) in enumerate(lanes):
+            groups.setdefault(compiled.spec, []).append(idx)
+        # Cross-tenant sub-bucketing: same-family specs from DIFFERENT
+        # tenants merge into one launch only when the padding each lane
+        # pays undercuts the launch it saves (exec/cost.coalesce_wins) —
+        # the PR-5 sub-bucket rule applied across index boundaries.
+        buckets: list[tuple[tuple, list[int]]] = []
+        for bucket_specs in plan_spec_buckets(
+            [(spec, len(idxs)) for spec, idxs in groups.items()]
+        ):
+            target = unify_specs(list(bucket_specs))
+            members: list[int] = []
+            for spec in bucket_specs:
+                for idx in groups[spec]:
+                    if spec != target:
+                        _i, _m, _h, c = lanes[idx]
+                        lanes[idx] = (
+                            _i,
+                            _m,
+                            _h,
+                            CompiledQuery(
+                                spec=target,
+                                arrays=pad_arrays_to_spec(
+                                    c.spec, target, c.arrays
+                                ),
+                            ),
+                        )
+                    members.append(idx)
+            if self.device is not None and len(bucket_specs) > 1:
+                from .planner import spec_work_tiles
+
+                actual = sum(
+                    spec_work_tiles(s) * len(groups[s]) for s in bucket_specs
+                )
+                self.device.padding(
+                    actual, spec_work_tiles(target) * len(members)
+                )
+            buckets.append((target, members))
+
+        for spec, idxs in buckets:
+            rows = [lanes[idx] for idx in idxs]
+            live_rows = []
+            for r in rows:
+                task = tasks[r[0]]
+                if task is not None and task.cancelled:
+                    # Cancelled while the batch was being planned: honor
+                    # the cancel contract instead of serving a result.
+                    reason = (
+                        getattr(task, "cancel_reason", None) or "cancelled"
+                    )
+                    errors[r[0]] = TaskCancelledError(
+                        f"task cancelled [{reason}]"
+                    )
+                if errors[r[0]] is None:
+                    live_rows.append(r)
+            if not live_rows:
+                continue
+            k_max = max(ks[r[0]] for r in live_rows)
+            backend = self._decide(spec, k_max, live_rows, wrapped, plane)
+            try:
+                fault_point("search.kernel", index="_packed")
+                if backend == "oracle":
+                    self._oracle_rows(
+                        live_rows, wrapped, ks, cands, totals, spec, k_max
+                    )
+                else:
+                    self._packed_launch(
+                        plane, tree, spec, live_rows, wrapped, ks, k_max,
+                        cands, totals,
+                    )
+            except (ValueError, TypeError):
+                raise  # request-shaped: the compile/launch path 400s
+            # staticcheck: ignore[broad-except] launch-failure isolation: only this bucket's riders fail (the batcher retries them individually); a re-raise would take batchmates down
+            except Exception as e:
+                for r in live_rows:
+                    errors[r[0]] = e
+
+    def _decide(self, spec, k: int, rows, wrapped, plane) -> str:
+        """Planner-routed backend for one bucket; candidates restricted to
+        backends that cannot change per-tenant results."""
+        if self.planner is None:
+            return "packed"
+        from ..ops import bm25_device
+        from .cost import PlanFeatures
+        from .planner import oracle_eligible, spec_work_tiles
+
+        if not all(oracle_eligible(wrapped[r[0]].request.query) for r in rows):
+            return "packed"
+        plan_class = ("packed", spec, k)
+        feats = PlanFeatures(
+            n_docs=plane.num_docs,
+            work_tiles=(
+                spec_work_tiles(spec)
+                if bm25_device.supports_sparse(spec)
+                else 0
+            ),
+            n_lanes=len(rows),
+        )
+        return self.planner.decide(plan_class, ["packed", "oracle"], feats)
+
+    def _oracle_rows(
+        self, rows, wrapped, ks, cands, totals, spec, k_max
+    ) -> None:
+        """Per-lane CPU oracle on the tenant's own segment — the backend
+        that wins when even an amortized launch loses to numpy."""
+        from ..search.oracle import OracleSearcher
+        from ..search.service import SearchService
+
+        plan_class = ("packed", spec, k_max)
+        for i, _member, handle, _compiled in rows:
+            w = wrapped[i]
+            engine = w.svc.engines[0]
+            t0 = time.monotonic()
+            oracle = OracleSearcher(
+                handle.segment,
+                w.svc.mappings,
+                engine.params,
+                stats=engine.field_stats(),
+                live=w.svc.search._host_live(handle),
+            )
+            scores, ids, tot = oracle.search(w.request.query, ks[i])
+            SearchService._append_plain(
+                cands[i], handle, scores, ids, min(ks[i], len(ids))
+            )
+            totals[i] += int(tot)
+            if self.planner is not None:
+                self.planner.record(
+                    plan_class, "oracle", time.monotonic() - t0
+                )
+
+    def _packed_launch(
+        self, plane, tree, spec, rows, wrapped, ks, k_max, cands, totals
+    ) -> None:
+        """One vmapped launch scoring every lane of one spec bucket."""
+        import jax
+
+        from ..ops import bm25_device
+        from ..search.service import SearchService
+
+        t0 = time.monotonic()
+        arrays_b = jax.tree.map(
+            lambda *xs: np.stack(xs), *[r[3].arrays for r in rows]
+        )
+        lo = np.empty(len(rows), dtype=np.int32)
+        hi = np.empty(len(rows), dtype=np.int32)
+        for pos, (_i, member, _h, _c) in enumerate(rows):
+            lo[pos], hi[pos] = plane.member_bounds(member)
+        if self.device is not None:
+            self.device.h2d(arrays_b)
+        s_b, i_b, t_b = jax.device_get(
+            bm25_device.execute_batch_packed(
+                tree, spec, arrays_b, lo, hi, k_max
+            )
+        )
+        elapsed = time.monotonic() - t0
+        if self.device is not None:
+            self.device.launch(
+                "packed_batched", (spec, k_max, "packed"), elapsed
+            )
+        self._launches.inc()
+        self._lanes_total.inc(len(rows))
+        n_tenants = len({wrapped[r[0]].svc.uuid for r in rows})
+        self._tenants_hist.observe(float(n_tenants))
+        self._lanes_hist.observe(
+            float(1 << max(0, len(rows) - 1).bit_length())
+        )
+        plan_class = ("packed", spec, k_max)
+        for row, (i, _member, handle, _compiled) in enumerate(rows):
+            tot = int(t_b[row])
+            nn = min(ks[i], tot, s_b.shape[1])
+            SearchService._append_plain(
+                cands[i], handle, s_b[row], i_b[row], nn
+            )
+            totals[i] += tot
+            if self.planner is not None:
+                # Amortized per-lane cost — what a lane actually pays
+                # when the launch is shared.
+                self.planner.record(plan_class, "packed", elapsed / len(rows))
+
+    # ------------------------------------------------------------- plane
+
+    def _ensure_plane(self, svcs):
+        """Return (plane, jit tree, member rows) covering every known
+        packable tenant, rebuilding only when a member's engine
+        generation moved (refresh/delete/rebuild) or a new tenant
+        appeared. None = packing unavailable for this batch (budget)."""
+        from ..index.tiles import pack_segments_packed
+        from ..ops import bm25_device
+
+        current = {svc.uuid for svc in svcs}
+        with self._lock:
+            for svc in svcs:
+                self._tenants[svc.uuid] = svc
+            # Budget admission, ACTIVE riders first: this batch's tenants
+            # claim the plane before idle registered ones, so a long tail
+            # of idle tenants can never crowd an active rider out of
+            # packing (idle overflow just sits out this plane). Member
+            # ORDER stays uuid-sorted over the admitted set, so the cache
+            # key is stable across batches with the same admitted set.
+            admitted: dict[str, tuple] = {}
+            total_docs = 0
+            ordered = sorted(
+                self._tenants.keys(), key=lambda u: (u not in current, u)
+            )
+            for uuid in ordered:
+                svc = self._tenants.get(uuid)
+                if svc is None or len(svc.engines) != 1:
+                    continue
+                engine = svc.engines[0]
+                handles = [
+                    h for h in engine.segments if h.segment.num_docs > 0
+                ]
+                docs = sum(h.device.num_docs for h in handles)
+                if total_docs + docs > self.MAX_PLANE_DOCS:
+                    if uuid in current:
+                        # Even with priority admission an active rider
+                        # doesn't fit: packing is unavailable this batch.
+                        return None
+                    continue  # idle tenant sits this plane out
+                total_docs += docs
+                admitted[uuid] = (svc, engine.generation, handles)
+            snapshot = [
+                (uuid,) + admitted[uuid] for uuid in sorted(admitted)
+            ]
+            key = tuple((u, g) for u, _s, g, _h in snapshot)
+            if key == self._plane_key and self._plane is not None:
+                return self._plane, self._plane_tree, self._member_rows
+        # Build OUTSIDE the lock: concatenating up to MAX_PLANE_DOCS of
+        # postings is real device work, and stats()/other batches must
+        # not stall behind it. The snapshot's handles pin the segments,
+        # so the plane is a consistent point-in-time view regardless of
+        # concurrent installs (last install wins; this batch serves from
+        # the exact plane it built).
+        segs = []
+        member_rows: dict[str, list] = {}
+        for uuid, _svc, _gen, handles in snapshot:
+            member_rows[uuid] = []
+            for h in handles:
+                member_rows[uuid].append((len(segs), h))
+                segs.append(h.device)
+        if not segs:
+            return None
+        plane = pack_segments_packed(segs)
+        tree = bm25_device.packed_segment_tree(plane)
+        self._rebuilds.inc()
+        with self._lock:
+            self._plane = plane
+            self._plane_tree = tree
+            self._plane_key = key
+            self._member_rows = member_rows
+        return plane, tree, member_rows
+
+    # -------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """`GET /_nodes/stats` exec.packed payload."""
+        with self._lock:
+            plane = self._plane
+            tenants = len(self._member_rows)
+            members = sum(len(v) for v in self._member_rows.values())
+        return {
+            "launches": int(self._launches.value),
+            "lanes": int(self._lanes_total.value),
+            "plane_rebuilds": int(self._rebuilds.value),
+            "fallback_solo": int(self._fallbacks.value),
+            "plane_docs": plane.num_docs if plane is not None else 0,
+            "plane_tenants": tenants,
+            "plane_members": members,
+            "tenants_per_launch": {
+                k: int(v)
+                for k, v in self._tenants_hist.snapshot()["buckets"].items()
+                if v
+            },
+            "lanes_per_launch": {
+                k: int(v)
+                for k, v in self._lanes_hist.snapshot()["buckets"].items()
+                if v
+            },
+        }

@@ -144,6 +144,10 @@ class ExecPlanner:
         "oracle",
         "device_batched",
         "mesh_spmd",
+        # One launch scoring many small tenants' lanes against a shared
+        # packed plane (exec/packed.py); its seed amortizes the launch
+        # floor across the coalesced lanes.
+        "packed",
     )
 
     def __init__(self, cost_model: CostModel | None = None, metrics=None):

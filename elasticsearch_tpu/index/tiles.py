@@ -329,6 +329,254 @@ def repack_tn(
     dfield.tn_b = b
 
 
+# ---------------------------------------------------------------------------
+# Multi-tenant packed planes.
+#
+# The north-star workload is millions of SMALL tenants: per-launch dispatch
+# (~1-2 ms) dwarfs the scoring work of a 5k-doc index, so one launch per
+# (tenant, query) loses to a CPU oracle by an order of magnitude (BENCH_r05
+# cfg1: 0.08x). The packed layout concatenates many small DeviceSegments
+# into ONE shared set of tile planes — a tenant/index-id dimension expressed
+# as contiguous doc-id and tile ranges — so a single batched XLA launch
+# scores many tenants' queries at once, amortizing dispatch the same way
+# the reference amortizes per-segment work inside one Lucene IndexSearcher
+# pass rather than paying a JVM entry per segment.
+#
+# Layout invariants:
+# - tenant t owns GLOBAL doc ids [doc_base[t], doc_base[t] + num_docs[t]);
+#   every member doc id is rewritten local + doc_base at pack time, and the
+#   member's padding sentinels (== its local num_docs) are rewritten to the
+#   GLOBAL sentinel (plane num_docs) so padding can never alias the next
+#   tenant's first doc;
+# - tenant t's postings for a field occupy GLOBAL tiles
+#   [tile_base[t], tile_base[t] + member tiles) — each member plane already
+#   ends in its own all-sentinel padding tile, which becomes the member's
+#   in-plane pad target;
+# - per-member compile `views` are ordinary DeviceFields sharing the packed
+#   device arrays with the member's own host metadata (terms dict, df,
+#   statistics) and posting offsets shifted by tile_base * TILE, so the
+#   standard Compiler emits plans directly in packed coordinates — per-
+#   tenant IDF/avgdl (and therefore fp32 scores) are untouched by packing.
+#
+# Cross-tenant isolation is structural (a query's worklist tiles all lie in
+# its own tenant's tile range) AND enforced: the packed kernel masks
+# eligibility to the tenant's [doc lo, doc hi) bounds
+# (ops/bm25_device.execute_batch_packed), so a host-side plan bug cannot
+# leak another tenant's docs into a top-k.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PackedField:
+    """One field's postings for ALL members, concatenated on device."""
+
+    name: str
+    doc_ids: jax.Array  # i32[NT_total, TILE], GLOBAL ids, sentinel = N_total
+    tfs: jax.Array  # f32[NT_total, TILE]
+    tn: jax.Array  # f32[NT_total, TILE] per-posting impacts (per-member stats)
+    norm_bytes: jax.Array  # u8[N_total + 1]
+    present: jax.Array  # bool[N_total]
+    tile_base: dict[int, int]  # member index -> first global tile
+    views: dict[int, DeviceField]  # member index -> compile view
+
+
+@dataclass
+class PackedPlane:
+    """Several small DeviceSegments concatenated into shared tile planes."""
+
+    num_docs: int  # total packed doc space (sum of member doc spaces)
+    doc_base: list[int]  # member index -> global doc-id base
+    doc_count: list[int]  # member index -> member doc-space size
+    fields: dict[str, PackedField]
+    live: jax.Array  # bool[N_total], concat of member live masks
+
+    @property
+    def n_members(self) -> int:
+        return len(self.doc_base)
+
+    def member_bounds(self, member: int) -> tuple[int, int]:
+        """GLOBAL [lo, hi) doc-id bounds of one member — the per-tenant
+        mask the packed kernel applies so no cross-tenant doc can appear
+        in this member's results."""
+        lo = self.doc_base[member]
+        return lo, lo + self.doc_count[member]
+
+    def member_fields(self, member: int) -> dict[str, DeviceField]:
+        """Compile views for one member: a dict shaped exactly like
+        DeviceSegment.fields, sharing the packed device arrays."""
+        return {
+            name: pf.views[member]
+            for name, pf in self.fields.items()
+            if member in pf.views
+        }
+
+
+def pack_field_packed(
+    name: str,
+    members: list[tuple[DeviceField | None, int, int]],
+    n_total: int,
+) -> PackedField | None:
+    """Concatenate one field's member planes into a packed field.
+
+    `members`: (DeviceField or None when the member lacks the field,
+    member doc base, member doc-space size) per member, in member order.
+    Returns None when no member has the field.
+
+    Doc ids are rewritten to global ids with the member's padding sentinel
+    (its local num_docs) mapped to the GLOBAL sentinel n_total; norm bytes
+    and presence land at the member's doc range (absent members contribute
+    zeros so a stray gather reads norm 0 / not-present, never another
+    tenant's bytes).
+    """
+    if not any(df is not None for df, _b, _n in members):
+        return None
+    id_parts, tf_parts, tn_parts = [], [], []
+    norm_parts, present_parts = [], []
+    tile_base: dict[int, int] = {}
+    shifted: list[tuple[int, DeviceField, int, int, int]] = []
+    tiles = 0
+    for m, (dfield, base, n_member) in enumerate(members):
+        if dfield is None:
+            norm_parts.append(np.zeros(n_member, dtype=np.uint8))
+            present_parts.append(np.zeros(n_member, dtype=bool))
+            continue
+        ids = dfield.doc_ids
+        # Sentinel rewrite BEFORE the base shift: a member pad slot must
+        # scatter into the plane's own discard slot, not into the doc
+        # range of whichever tenant happens to follow.
+        id_parts.append(
+            jnp.where(
+                ids == jnp.int32(n_member),
+                jnp.int32(n_total),
+                ids + jnp.int32(base),
+            )
+        )
+        tf_parts.append(dfield.tfs)
+        tn_parts.append(dfield.tn)
+        norm_parts.append(np.asarray(dfield.norm_bytes)[:n_member])
+        present_parts.append(np.asarray(dfield.present)[:n_member])
+        tile_base[m] = tiles
+        shifted.append((m, dfield, base, n_member, tiles))
+        tiles += dfield.num_tiles
+    doc_ids = jnp.concatenate(id_parts, axis=0)
+    tfs = jnp.concatenate(tf_parts, axis=0)
+    tn = jnp.concatenate(tn_parts, axis=0)
+    norm_bytes = jax.device_put(
+        np.concatenate(norm_parts + [np.zeros(1, dtype=np.uint8)])
+    )
+    present = jax.device_put(np.concatenate(present_parts))
+    views: dict[int, DeviceField] = {}
+    for m, dfield, base, n_member, tbase in shifted:
+        lo, hi = dfield.tile_doc_lo, dfield.tile_doc_hi
+        if lo is not None:
+            # Global per-tile doc bounds (the tile_doc_bounds machinery's
+            # packed form): real ids shift by the member base; a bound that
+            # IS the member sentinel stays the (global) sentinel so range
+            # pruning remains conservative at partially-padded tiles.
+            lo = np.where(lo == n_member, n_total, lo + base).astype(np.int64)
+            hi = np.where(hi == n_member, n_total, hi + base).astype(np.int64)
+        views[m] = DeviceField(
+            name=name,
+            terms=dfield.terms,
+            df=dfield.df,
+            # Posting positions shift with the member's tile range, so the
+            # unmodified Compiler plans straight into packed coordinates.
+            offsets=dfield.offsets + np.int64(tbase * TILE),
+            doc_count=dfield.doc_count,
+            sum_total_tf=dfield.sum_total_tf,
+            has_norms=dfield.has_norms,
+            doc_ids=doc_ids,
+            tfs=tfs,
+            norm_bytes=norm_bytes,
+            present=present,
+            tn=tn,
+            tn_avgdl=dfield.tn_avgdl,
+            tn_k1=dfield.tn_k1,
+            tn_b=dfield.tn_b,
+            tile_max=(
+                None
+                if dfield.tile_max is None
+                else _shifted_tile_plane(dfield.tile_max, tbase, tiles)
+            ),
+            tile_doc_lo=_shifted_tile_plane(lo, tbase, tiles, fill=n_total),
+            tile_doc_hi=_shifted_tile_plane(hi, tbase, tiles, fill=n_total),
+            device=dfield.device,
+        )
+    return PackedField(
+        name=name,
+        doc_ids=doc_ids,
+        tfs=tfs,
+        tn=tn,
+        norm_bytes=norm_bytes,
+        present=present,
+        tile_base=tile_base,
+        views=views,
+    )
+
+
+def _shifted_tile_plane(
+    local: np.ndarray | None, tile_base: int, total_tiles: int, fill=0.0
+):
+    """Host per-tile metadata (tile_max / doc bounds) placed at the
+    member's global tile range; other members' tiles carry `fill` (their
+    entries are only ever indexed through THIS member's tile ids, which
+    stay in range by construction — fill is belt-and-braces)."""
+    if local is None:
+        return None
+    out = np.full(total_tiles, fill, dtype=np.asarray(local).dtype)
+    out[tile_base : tile_base + len(local)] = local
+    return out
+
+
+def pack_segments_packed(
+    segments: list[DeviceSegment],
+) -> PackedPlane:
+    """Concatenate several small DeviceSegments into one PackedPlane.
+
+    Member order fixes the tenant-id dimension: member m owns doc range
+    [doc_base[m], doc_base[m] + num_docs). Only inverted fields pack
+    (doc-values / vectors / positions / nested stay per-tenant — the
+    packed backend's eligibility gate routes queries needing them to the
+    per-tenant path). Device arrays are concatenated on device; no host
+    round-trip of postings.
+    """
+    doc_base: list[int] = []
+    doc_count: list[int] = []
+    n_total = 0
+    for seg in segments:
+        doc_base.append(n_total)
+        doc_count.append(seg.num_docs)
+        n_total += seg.num_docs
+    field_names = sorted({n for seg in segments for n in seg.fields})
+    fields: dict[str, PackedField] = {}
+    for name in field_names:
+        members = [
+            (seg.fields.get(name), doc_base[m], seg.num_docs)
+            for m, seg in enumerate(segments)
+        ]
+        pf = pack_field_packed(name, members, n_total)
+        if pf is not None:
+            fields[name] = pf
+    live = jnp.concatenate([seg.live for seg in segments])
+    return PackedPlane(
+        num_docs=n_total,
+        doc_base=doc_base,
+        doc_count=doc_count,
+        fields=fields,
+        live=live,
+    )
+
+
+def packed_device_nbytes(plane: PackedPlane) -> int:
+    """Device bytes the packed plane itself holds (it duplicates member
+    postings — the price of one-launch multi-tenant scoring)."""
+    total = plane.live.nbytes
+    for pf in plane.fields.values():
+        total += pf.doc_ids.nbytes + pf.tfs.nbytes + pf.tn.nbytes
+        total += pf.norm_bytes.nbytes + pf.present.nbytes
+    return int(total)
+
+
 def tile_doc_bounds(
     doc_ids: np.ndarray, num_docs: int
 ) -> tuple[np.ndarray, np.ndarray]:

@@ -298,7 +298,7 @@ class Node:
         # micro-batching scheduler coalescing concurrent same-plan-class
         # searches into one padded device launch. ESTPU_EXEC_PLANNER=0 /
         # ESTPU_EXEC_BATCHER=0 opt out.
-        from .exec import ExecPlanner, MicroBatcher
+        from .exec import ExecPlanner, MicroBatcher, PackedExecutor
 
         self.exec_planner = (
             ExecPlanner(metrics=self.metrics)
@@ -308,6 +308,21 @@ class Node:
         self.exec_batcher = (
             MicroBatcher(metrics=self.metrics)
             if os.environ.get("ESTPU_EXEC_BATCHER", "1") != "0"
+            else None
+        )
+        # Packed multi-tenant execution (exec/packed.py): small single-
+        # shard indices share ONE device plane and one coalesced launch —
+        # the batcher group key that finally spans DIFFERENT indices.
+        # Rides the micro-batcher, so it inherits its opt-out;
+        # ESTPU_EXEC_PACKED=0 opts out independently.
+        self.packed_exec = (
+            PackedExecutor(
+                metrics=self.metrics,
+                planner=self.exec_planner,
+                device=self.device,
+            )
+            if self.exec_batcher is not None
+            and os.environ.get("ESTPU_EXEC_PACKED", "1") != "0"
             else None
         )
         if self.replication is not None:
@@ -1734,12 +1749,33 @@ class Node:
                 if self._batchable(svc, request, body):
                     from .exec.planner import ast_signature
 
-                    response = self.exec_batcher.execute(
-                        svc.search,
-                        request,
-                        task=task,
-                        group_key=(svc.name, ast_signature(request.query)),
-                    )
+                    if self.packed_exec is not None and self.packed_exec.eligible(
+                        svc, request
+                    ):
+                        # Small-tenant searches share ONE batcher group
+                        # across indices: the packed executor is the
+                        # group's searcher, so concurrent searches on
+                        # DIFFERENT small indices coalesce into one
+                        # packed launch (per-tenant results unchanged).
+                        response = self.exec_batcher.execute(
+                            self.packed_exec,
+                            self.packed_exec.wrap(svc, request),
+                            task=task,
+                            group_key=(
+                                "_packed",
+                                ast_signature(request.query),
+                            ),
+                        )
+                    else:
+                        response = self.exec_batcher.execute(
+                            svc.search,
+                            request,
+                            task=task,
+                            group_key=(
+                                svc.name,
+                                ast_signature(request.query),
+                            ),
+                        )
                 else:
                     response = svc.search.search(request, task=task)
             finally:
@@ -3582,6 +3618,13 @@ class Node:
                 "batcher": (
                     self.exec_batcher.stats()
                     if self.exec_batcher is not None
+                    else {"enabled": False}
+                ),
+                # Packed multi-tenant execution: launch/lane counters,
+                # plane residency, tenants-per-launch occupancy.
+                "packed": (
+                    self.packed_exec.stats()
+                    if self.packed_exec is not None
                     else {"enabled": False}
                 ),
             },

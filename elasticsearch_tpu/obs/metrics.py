@@ -368,6 +368,16 @@ CATALOG = {
     "estpu_device_actual_tiles_total": ("counter", "device"),
     "estpu_device_padding_waste_ratio": ("histogram", "device"),
     "estpu_device_blockmax_pruned_tile_fraction": ("histogram", "device"),
+    # Packed multi-tenant execution (exec/packed.py): one launch scores
+    # many small indices' lanes against a shared plane.
+    "estpu_packed_launches_total": ("counter", "exec.packed"),
+    "estpu_packed_lanes_total": ("counter", "exec.packed"),
+    "estpu_packed_plane_rebuilds_total": ("counter", "exec.packed"),
+    "estpu_packed_fallback_solo_total": ("counter", "exec.packed"),
+    "estpu_packed_tenants_per_launch": ("histogram", "exec.packed"),
+    "estpu_packed_lanes_per_launch": ("histogram", "exec.packed"),
+    "estpu_packed_plane_docs": ("gauge", "exec.packed"),
+    "estpu_packed_plane_tenants": ("gauge", "exec.packed"),
     "estpu_request_cache_hits_total": ("counter", "indices.request_cache"),
     "estpu_request_cache_misses_total": (
         "counter",

@@ -655,39 +655,54 @@ class SearchService:
             if errors[i] is not None:
                 out.append(errors[i])
                 continue
-            rows = sorted(cands[i], key=lambda c: (c[0], c[1]))
-            page = rows[request.from_ : request.from_ + request.size]
-            max_score = -rows[0][0] if rows else None
-            hl_ctx = self._highlight_context(request)
-            hits = []
-            for _key, global_doc, handle, local, score, _sv in page:
-                hits.append(
-                    SearchHit(
-                        doc_id=handle.segment.ids[local],
-                        score=score,
-                        source=self._fetch_source(handle, local, request),
-                        sort=None,
-                        global_doc=global_doc,
-                        highlight=self._fetch_highlight(handle, local, hl_ctx),
-                        fields=self._fetch_fields(handle, local, request),
-                        handle=handle,
-                        local=local,
-                    )
-                )
-            total_out, relation = clamp_total(
-                totals[i], request.track_total_hits
-            )
             out.append(
-                SearchResponse(
-                    took_ms=int((time.monotonic() - start) * 1000),
-                    total=total_out,
-                    total_relation=relation,
-                    max_score=max_score,
-                    hits=hits,
-                    timed_out=timed[i],
+                self.assemble_plain(
+                    request, cands[i], totals[i], timed[i], start
                 )
             )
         return out
+
+    def assemble_plain(
+        self,
+        request: SearchRequest,
+        rows: list,
+        total: int,
+        timed_out: bool,
+        start: float,
+    ) -> SearchResponse:
+        """Assemble one plain score-sorted SearchResponse from candidate
+        tuples (the shared fetch/pagination step behind the coalesced
+        batch path AND the packed multi-tenant executor, exec/packed.py —
+        both score elsewhere and fetch here, so hits/highlights/fields
+        render identically to a solo search)."""
+        rows = sorted(rows, key=lambda c: (c[0], c[1]))
+        page = rows[request.from_ : request.from_ + request.size]
+        max_score = -rows[0][0] if rows else None
+        hl_ctx = self._highlight_context(request)
+        hits = []
+        for _key, global_doc, handle, local, score, _sv in page:
+            hits.append(
+                SearchHit(
+                    doc_id=handle.segment.ids[local],
+                    score=score,
+                    source=self._fetch_source(handle, local, request),
+                    sort=None,
+                    global_doc=global_doc,
+                    highlight=self._fetch_highlight(handle, local, hl_ctx),
+                    fields=self._fetch_fields(handle, local, request),
+                    handle=handle,
+                    local=local,
+                )
+            )
+        total_out, relation = clamp_total(total, request.track_total_hits)
+        return SearchResponse(
+            took_ms=int((time.monotonic() - start) * 1000),
+            total=total_out,
+            total_relation=relation,
+            max_score=max_score,
+            hits=hits,
+            timed_out=timed_out,
+        )
 
     def _batched_query_phase(
         self,

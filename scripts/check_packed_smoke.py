@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""CI smoke gate for packed multi-tenant execution (ISSUE 7).
+
+Runs the packed-plane parity suite on the CPU backend — no TPU needed:
+per-tenant ids/order/fp32-scores/totals equal the per-index oracle, zero
+cross-tenant leakage under adversarial shared-term vocabularies, and the
+planner-routed packed/oracle backends return identical responses to solo
+execution. The same tests ride the tier-1 run via the fast (`not slow`)
+marker; this script is the standalone hook for pre-merge / cron checks:
+
+    python scripts/check_packed_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "tests/test_packed_multitenant.py",
+        "-q",
+        "-m",
+        "not slow",
+        "-p",
+        "no:cacheprovider",
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,6 +64,7 @@ _BOOL_SPEC_FILES = (
     "elasticsearch_tpu/ops/bm25_device.py",
     "elasticsearch_tpu/exec/planner.py",
     "elasticsearch_tpu/exec/batcher.py",
+    "elasticsearch_tpu/exec/packed.py",
 )
 _BOOL_SPEC_ARITY = 7
 

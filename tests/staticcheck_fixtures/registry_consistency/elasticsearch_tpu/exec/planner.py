@@ -1,5 +1,7 @@
-"""Fixture planner: [ghost] has no cost seed and no surfacing site."""
+"""Fixture planner: [ghost] has no cost seed and no surfacing site;
+[packed] is surfaced (user.py) but UNSEEDED — the multi-tenant backend
+registered without a cost seed must fail the gate."""
 
 
 class ExecPlanner:
-    BACKENDS = ("device", "ghost")
+    BACKENDS = ("device", "ghost", "packed")

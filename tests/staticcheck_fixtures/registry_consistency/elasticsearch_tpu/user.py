@@ -14,7 +14,14 @@ def make_instruments(m):
     m.counter("estpu_good_total", "cataloged: fine")
     m.counter("estpu_rogue_total", "not in CATALOG")
     m.gauge("estpu_kind_total", "cataloged as counter: kind mismatch")
+    m.histogram("estpu_packed_rogue_total", "packed instrument not in CATALOG")
 
 
 def route(backend="device"):
     return backend
+
+
+def route_packed():
+    # Surfacing site for the packed backend (so only its MISSING cost
+    # seed fires, isolating that half of the contract).
+    return "packed"

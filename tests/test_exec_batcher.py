@@ -150,6 +150,7 @@ def test_rest_cancel_of_queued_search(monkeypatch):
     task_cancelled_exception, without waiting for the batch to launch."""
     node = Node()
     node.exec_planner = None  # pin device lanes (keep kernels patchable)
+    node.packed_exec = None  # pin the per-index group (patched kernel below)
     node.exec_batcher = MicroBatcher(max_wait_s=30.0)
     node.create_index(
         "cq", {"mappings": {"properties": {"b": {"type": "text"}}}}

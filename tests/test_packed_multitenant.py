@@ -1,0 +1,554 @@
+"""Packed multi-tenant execution: parity, isolation, routing, coalescing.
+
+The packed plane (index/tiles.py) concatenates many SMALL tenants'
+segments into one shared device plane; one vmapped launch
+(ops/bm25_device.execute_batch_packed) scores many tenants' queries at
+once. The hard contracts under test:
+
+- **Per-tenant parity**: packed top-k ids + order + fp32 scores + totals
+  are IDENTICAL to the per-index oracle (and to per-tenant device
+  execution) for every tenant — packing relocates plans, it never
+  changes a single bit of scoring.
+- **Zero cross-tenant leakage**: adversarial shared-term vocabularies
+  (a term that is a head term in tenant A and rare in tenant B) must
+  never surface one tenant's docs in another's results, and totals
+  count only the searched tenant's docs.
+- **Routing never changes results**: whether the planner picks `packed`
+  or the per-tenant oracle for a coalesced batch, responses equal solo
+  execution through the tenant's own SearchService.
+- **Coalescing telemetry**: the micro-batcher's per-group stats report
+  distinct coalesced tenants, and the packed executor's occupancy
+  instruments record tenants/lanes per launch.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.exec import ExecPlanner
+from elasticsearch_tpu.exec.batcher import MicroBatcher
+from elasticsearch_tpu.exec.cost import PlanFeatures, coalesce_wins, seed_ms
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.index.tiles import (
+    pack_segment,
+    pack_segments_packed,
+)
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.ops import bm25_device
+from elasticsearch_tpu.query.compile import Compiler
+from elasticsearch_tpu.query.dsl import parse_query
+from elasticsearch_tpu.search.oracle import OracleSearcher
+from elasticsearch_tpu.search.service import SearchRequest
+
+K = 10
+
+# Shared adversarial vocabulary: every tenant draws from the SAME terms,
+# so any doc-id or tile mix-up across tenants surfaces immediately as a
+# leaked hit or a wrong total.
+VOCAB = [
+    "alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf",
+    "hotel", "shared", "common", "leak",
+]
+
+MAPPINGS = Mappings(properties={"body": {"type": "text"}})
+
+
+def _build_tenant(rng, n_docs: int, heavy_term: str | None = None):
+    """One tenant segment of space-joined VOCAB tokens; `heavy_term`
+    floods every doc with a term that is rare elsewhere."""
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+
+    builder = SegmentBuilder(MAPPINGS)
+    for i in range(n_docs):
+        toks = list(rng.choice(VOCAB[:8], rng.integers(2, 7)))
+        if heavy_term is not None:
+            toks += [heavy_term] * int(rng.integers(3, 8))
+        elif rng.random() < 0.05:
+            toks.append("leak")
+        builder.add({"body": " ".join(toks)}, f"d{i}")
+    return builder.build()
+
+
+@pytest.fixture(scope="module")
+def tenants():
+    rng = np.random.default_rng(7)
+    out = []
+    for t in range(8):
+        seg = _build_tenant(
+            rng,
+            int(rng.integers(40, 400)),
+            heavy_term="leak" if t == 3 else None,
+        )
+        out.append((seg, pack_segment(seg)))
+    return out
+
+
+@pytest.fixture(scope="module")
+def plane(tenants):
+    return pack_segments_packed([dev for _seg, dev in tenants])
+
+
+def random_query(rng) -> dict:
+    roll = rng.random()
+    if roll < 0.5:
+        return {
+            "match": {"body": " ".join(rng.choice(VOCAB, rng.integers(1, 4)))}
+        }
+    if roll < 0.8:
+        return {
+            "bool": {
+                "must": [
+                    {
+                        "match": {
+                            "body": " ".join(
+                                rng.choice(VOCAB, rng.integers(1, 3))
+                            )
+                        }
+                    }
+                ],
+                "filter": [{"term": {"body": str(rng.choice(VOCAB))}}],
+            }
+        }
+    return {
+        "bool": {
+            "should": [
+                {"term": {"body": str(rng.choice(VOCAB))}},
+                {"term": {"body": str(rng.choice(VOCAB))}},
+            ],
+            "minimum_should_match": 1,
+        }
+    }
+
+
+def _packed_results(plane, tenants, lane_specs):
+    """Execute (tenant, parsed query) lanes through the packed kernel,
+    grouped by spec like the executor. Returns per-lane (scores, ids,
+    total)."""
+    import jax
+
+    tree = bm25_device.packed_segment_tree(plane)
+    compiled = []
+    for ti, query in lane_specs:
+        compiler = Compiler(
+            fields=plane.member_fields(ti),
+            doc_values={},
+            mappings=MAPPINGS,
+        )
+        c = compiler.compile(query)
+        assert bm25_device.supports_packed(c.spec), c.spec
+        compiled.append(c)
+    groups: dict[tuple, list[int]] = {}
+    for i, c in enumerate(compiled):
+        groups.setdefault(c.spec, []).append(i)
+    out: list = [None] * len(lane_specs)
+    for spec, idxs in groups.items():
+        arrays_b = jax.tree.map(
+            lambda *xs: np.stack(xs), *[compiled[i].arrays for i in idxs]
+        )
+        lo = np.array(
+            [plane.member_bounds(lane_specs[i][0])[0] for i in idxs],
+            np.int32,
+        )
+        hi = np.array(
+            [plane.member_bounds(lane_specs[i][0])[1] for i in idxs],
+            np.int32,
+        )
+        s_b, i_b, t_b = jax.device_get(
+            bm25_device.execute_batch_packed(tree, spec, arrays_b, lo, hi, K)
+        )
+        for row, i in enumerate(idxs):
+            out[i] = (s_b[row], i_b[row], int(t_b[row]))
+    return out
+
+
+class TestKernelParity:
+    def test_fuzz_parity_vs_oracle_and_solo_device(self, tenants, plane):
+        """Fuzz: every (tenant, random bool query) lane through the packed
+        kernel equals the per-index oracle AND per-tenant device execution
+        — ids, order, fp32 scores (bit-exact on CPU), totals."""
+        import jax
+
+        rng = np.random.default_rng(23)
+        lanes = []
+        for _ in range(60):
+            ti = int(rng.integers(0, len(tenants)))
+            lanes.append((ti, parse_query(random_query(rng))))
+        packed = _packed_results(plane, tenants, lanes)
+        for (ti, query), (p_s, p_ids, p_tot) in zip(lanes, packed):
+            seg, dev = tenants[ti]
+            o_s, o_ids, o_tot = OracleSearcher(seg, MAPPINGS).search(query, K)
+            n = min(K, o_tot, len(o_ids))
+            assert p_tot == o_tot, (query, p_tot, o_tot)
+            assert [int(x) for x in p_ids[:n]] == [int(x) for x in o_ids[:n]]
+            assert np.array_equal(
+                p_s[:n].astype(np.float32), o_s[:n].astype(np.float32)
+            ), (query, p_s[:n], o_s[:n])
+            # Solo device run on the tenant's OWN plane: bit-identical.
+            solo_tree = bm25_device.segment_tree(dev)
+            c = Compiler(
+                fields=dev.fields, doc_values={}, mappings=MAPPINGS
+            ).compile(query)
+            d_s, d_ids, d_tot = jax.device_get(
+                bm25_device.execute_auto(solo_tree, c.spec, c.arrays, K)
+            )
+            assert int(d_tot) == p_tot
+            assert [int(x) for x in d_ids[:n]] == [int(x) for x in p_ids[:n]]
+            assert np.array_equal(d_s[:n], p_s[:n])
+
+    def test_zero_cross_tenant_leakage(self, tenants, plane):
+        """Tenant 3 floods "leak"; other tenants hold only a few. A
+        search for "leak" on tenant t must return ONLY t's docs and count
+        only t's matches — the flooded tenant can never shadow them."""
+        query = parse_query({"match": {"body": "leak"}})
+        lanes = [(ti, query) for ti in range(len(tenants))]
+        packed = _packed_results(plane, tenants, lanes)
+        for ti, (p_s, p_ids, p_tot) in enumerate(packed):
+            seg, _dev = tenants[ti]
+            o_s, o_ids, o_tot = OracleSearcher(seg, MAPPINGS).search(query, K)
+            assert p_tot == o_tot
+            n = min(K, o_tot)
+            ids = [int(x) for x in p_ids[:n]]
+            assert all(0 <= d < seg.num_docs for d in ids)
+            assert ids == [int(x) for x in o_ids[:n]]
+            assert np.array_equal(
+                p_s[:n].astype(np.float32), o_s[:n].astype(np.float32)
+            )
+
+    def test_tenant_missing_term_returns_empty(self, tenants, plane):
+        """A term present ONLY in other tenants yields zero hits and zero
+        totals — absence is per-tenant, not plane-wide."""
+        # Build a fresh tenant with NO "leak" occurrences at all.
+        rng = np.random.default_rng(5)
+        from elasticsearch_tpu.index.segment import SegmentBuilder
+
+        builder = SegmentBuilder(MAPPINGS)
+        for i in range(50):
+            builder.add(
+                {"body": " ".join(rng.choice(VOCAB[:5], 4))}, f"x{i}"
+            )
+        seg = builder.build()
+        devs = [d for _s, d in tenants] + [pack_segment(seg)]
+        plane2 = pack_segments_packed(devs)
+        ti = len(devs) - 1
+        query = parse_query({"match": {"body": "leak"}})
+        compiler = Compiler(
+            fields=plane2.member_fields(ti), doc_values={}, mappings=MAPPINGS
+        )
+        c = compiler.compile(query)
+        import jax
+
+        tree = bm25_device.packed_segment_tree(plane2)
+        arrays_b = jax.tree.map(lambda x: np.stack([x]), c.arrays)
+        lo, hi = plane2.member_bounds(ti)
+        s, ids, tot = jax.device_get(
+            bm25_device.execute_batch_packed(
+                tree,
+                c.spec,
+                arrays_b,
+                np.array([lo], np.int32),
+                np.array([hi], np.int32),
+                K,
+            )
+        )
+        assert int(tot[0]) == 0
+
+
+class _ForcedPlanner(ExecPlanner):
+    def __init__(self, backend: str):
+        super().__init__()
+        self.forced = backend
+
+    def decide(self, plan_class, candidates, feats=None):
+        return self.forced if self.forced in candidates else candidates[0]
+
+
+def _make_node(n_idx=5, docs=40, planner=None):
+    node = Node()
+    if planner is not None:
+        node.exec_planner = planner
+        node.packed_exec.planner = planner
+    rng = np.random.default_rng(11)
+    for t in range(n_idx):
+        name = f"tenant{t}"
+        node.create_index(
+            name, {"mappings": {"properties": {"body": {"type": "text"}}}}
+        )
+        for i in range(docs + 13 * t):
+            node.index_doc(
+                name,
+                {"body": " ".join(rng.choice(VOCAB, rng.integers(2, 6)))},
+                f"d{i}",
+            )
+        node.refresh(name)
+    return node
+
+
+class TestExecutorRouting:
+    @pytest.mark.parametrize("backend", ["packed", "oracle"])
+    def test_routing_never_changes_topk(self, backend):
+        """A coalesced cross-tenant batch through the packed executor —
+        with the planner FORCED to either backend — returns per-rider
+        responses identical to each rider's solo SearchService path."""
+        node = _make_node(planner=_ForcedPlanner(backend))
+        try:
+            queries = [
+                {"query": {"match": {"body": "alpha shared"}}},
+                {"query": {"match": {"body": "bravo"}}},
+                {
+                    "query": {
+                        "bool": {
+                            "must": [{"match": {"body": "charlie delta"}}],
+                            "filter": [{"term": {"body": "alpha"}}],
+                        }
+                    }
+                },
+            ]
+            wrapped = []
+            solo = []
+            for t in range(5):
+                svc = node.get_index(f"tenant{t}")
+                body = queries[t % len(queries)]
+                request = SearchRequest.from_json(dict(body))
+                assert node.packed_exec.eligible(svc, request)
+                wrapped.append(node.packed_exec.wrap(svc, request))
+                solo.append(
+                    svc.search.search(SearchRequest.from_json(dict(body)))
+                )
+            out = node.packed_exec.search_many(wrapped)
+            for got, exp in zip(out, solo):
+                assert not isinstance(got, Exception), got
+                assert got.total == exp.total
+                assert got.total_relation == exp.total_relation
+                assert [h.doc_id for h in got.hits] == [
+                    h.doc_id for h in exp.hits
+                ]
+                assert [h.score for h in got.hits] == [
+                    h.score for h in exp.hits
+                ]
+            if backend == "packed":
+                assert node.packed_exec.stats()["launches"] >= 1
+                decisions = node.packed_exec.planner.decisions
+                assert decisions.get("packed", 0) >= 1
+        finally:
+            node.close()
+
+    def test_plane_tracks_refresh(self):
+        """New docs become searchable through the packed path after a
+        refresh: the plane rebuilds when a member's generation moves."""
+        node = _make_node(n_idx=2)
+        try:
+            svc0 = node.get_index("tenant0")
+            svc1 = node.get_index("tenant1")
+            req = SearchRequest.from_json(
+                {"query": {"match": {"body": "zzzunique"}}}
+            )
+            wrapped = [
+                node.packed_exec.wrap(svc0, req),
+                node.packed_exec.wrap(svc1, req),
+            ]
+            out = node.packed_exec.search_many(wrapped)
+            assert out[0].total == 0 and out[1].total == 0
+            rebuilds0 = node.packed_exec.stats()["plane_rebuilds"]
+            node.index_doc("tenant0", {"body": "zzzunique token"}, "fresh")
+            node.refresh("tenant0")
+            out = node.packed_exec.search_many(wrapped)
+            assert out[0].total == 1
+            assert out[0].hits[0].doc_id == "fresh"
+            assert out[1].total == 0
+            assert node.packed_exec.stats()["plane_rebuilds"] > rebuilds0
+        finally:
+            node.close()
+
+    def test_ineligible_shapes_fall_back(self):
+        """Numeric-field and unsupported query shapes never enter the
+        packed group; oversized tenants are refused too."""
+        node = Node()
+        try:
+            node.create_index(
+                "t",
+                {
+                    "mappings": {
+                        "properties": {
+                            "body": {"type": "text"},
+                            "rank": {"type": "long"},
+                        }
+                    }
+                },
+            )
+            node.index_doc("t", {"body": "alpha", "rank": 3}, "d0")
+            node.refresh("t")
+            svc = node.get_index("t")
+            ok = SearchRequest.from_json(
+                {"query": {"match": {"body": "alpha"}}}
+            )
+            assert node.packed_exec.eligible(svc, ok)
+            num = SearchRequest.from_json(
+                {"query": {"range": {"rank": {"gte": 1}}}}
+            )
+            assert not node.packed_exec.eligible(svc, num)
+            term_numeric = SearchRequest.from_json(
+                {"query": {"term": {"rank": 3}}}
+            )
+            assert not node.packed_exec.eligible(svc, term_numeric)
+            node.packed_exec.MAX_TENANT_DOCS = 0
+            assert not node.packed_exec.eligible(svc, ok)
+        finally:
+            node.close()
+
+    def test_active_riders_outrank_idle_tenants_for_plane_budget(self):
+        """Plane admission under a doc budget prefers THIS batch's
+        tenants: idle registered tenants sit the plane out rather than
+        crowding an active rider into the solo path."""
+        node = _make_node(n_idx=4)
+        try:
+            ex = node.packed_exec
+            body = {"query": {"match": {"body": "alpha"}}}
+            all_wrapped = [
+                ex.wrap(
+                    node.get_index(f"tenant{t}"),
+                    SearchRequest.from_json(dict(body)),
+                )
+                for t in range(4)
+            ]
+            out = ex.search_many(all_wrapped)  # registers all 4 tenants
+            assert all(not isinstance(r, Exception) for r in out)
+            assert len(ex._member_rows) == 4
+            # Shrink the budget so only the two ACTIVE riders fit.
+            active = [all_wrapped[2], all_wrapped[3]]
+            ex.MAX_PLANE_DOCS = sum(
+                w.svc.num_docs for w in active
+            )
+            out = ex.search_many(active)
+            assert all(not isinstance(r, Exception) for r in out)
+            admitted = set(ex._member_rows)
+            assert admitted == {w.svc.uuid for w in active}
+            for got, w in zip(out, active):
+                exp = w.svc.search.search(
+                    SearchRequest.from_json(dict(body))
+                )
+                assert got.total == exp.total
+                assert [h.doc_id for h in got.hits] == [
+                    h.doc_id for h in exp.hits
+                ]
+        finally:
+            node.close()
+
+    def test_rest_path_parity_under_concurrency(self):
+        """Full REST-shaped serving path: concurrent searches against
+        DIFFERENT small indices coalesce in the shared packed group and
+        return exactly the solo results."""
+        node = _make_node(n_idx=6)
+        node.exec_batcher = MicroBatcher(max_wait_s=0.05, metrics=node.metrics)
+        try:
+            body = {"query": {"match": {"body": "alpha shared"}}}
+            expected = {}
+            for t in range(6):
+                svc = node.get_index(f"tenant{t}")
+                resp = svc.search.search(SearchRequest.from_json(dict(body)))
+                expected[t] = (
+                    resp.total,
+                    [(h.doc_id, h.score) for h in resp.hits],
+                )
+            results: dict = {}
+
+            def go(t):
+                results[t] = node.search(f"tenant{t}", dict(body))
+
+            threads = [
+                threading.Thread(target=go, args=(t,)) for t in range(6)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            for t in range(6):
+                got = results[t]
+                assert got["hits"]["total"]["value"] == expected[t][0]
+                assert [
+                    (h["_id"], h["_score"]) for h in got["hits"]["hits"]
+                ] == expected[t][1]
+        finally:
+            node.close()
+
+
+class TestBatcherTenantStats:
+    def test_per_group_coalesced_tenant_counts(self):
+        """MicroBatcher.stats() reports distinct coalesced tenants per
+        group — the packing-effectiveness observable."""
+
+        class Wrapped:
+            def __init__(self, name, tenant):
+                self.name = name
+                self.tenant_key = tenant
+
+            def __repr__(self):
+                return self.name
+
+        class Stub:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.calls = []
+
+            def search(self, request, task=None):
+                return f"solo:{request}"
+
+            def search_many(self, requests, tasks=None):
+                with self.lock:
+                    self.calls.append(list(requests))
+                time.sleep(0.2)
+                return [f"r:{r}" for r in requests]
+
+        batcher = MicroBatcher(max_wait_s=0.25)
+        stub = Stub()
+        results: dict = {}
+
+        def go(name, tenant, delay):
+            time.sleep(delay)
+            results[name] = batcher.execute(
+                stub, Wrapped(name, tenant), group_key=("_packed", "sig")
+            )
+
+        threads = [
+            threading.Thread(target=go, args=("a", "t0", 0.0)),
+            threading.Thread(target=go, args=("b", "t1", 0.05)),
+            threading.Thread(target=go, args=("c", "t2", 0.06)),
+            threading.Thread(target=go, args=("d", "t1", 0.07)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats = batcher.stats()
+        groups = stats["groups"]
+        assert "_packed" in groups
+        entry = groups["_packed"]
+        assert entry["launches"] >= 2
+        assert entry["riders"] == 4
+        # b/c/d queued behind a's in-flight launch and coalesced: 3
+        # riders from 2 distinct tenants in one launch.
+        assert entry["coalesced_tenants_max"] >= 2
+        batcher.close()
+
+
+class TestCostModel:
+    def test_packed_seed_amortizes_launch(self):
+        solo = seed_ms("packed", PlanFeatures(work_tiles=8, n_lanes=1))
+        many = seed_ms("packed", PlanFeatures(work_tiles=8, n_lanes=64))
+        device = seed_ms("device", PlanFeatures(work_tiles=8))
+        assert many < solo <= device + 1e-9
+        # At high lane counts the packed seed undercuts the oracle's
+        # small-corpus floor — the cfg1 regime flips.
+        oracle = seed_ms(
+            "oracle", PlanFeatures(n_docs=5_000, work_tiles=8)
+        )
+        assert many < oracle
+
+    def test_coalesce_wins_prices_total_cross_tenant_padding(self):
+        # The merge rule sees the SUMMED padding of every tenant lane in
+        # the bucket: small per-lane waste across many tenants still
+        # merges, but a collectively fat bill refuses.
+        per_lane = 20
+        assert coalesce_wins(per_lane * 40)
+        assert not coalesce_wins(per_lane * 40_000)

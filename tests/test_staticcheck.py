@@ -114,9 +114,13 @@ class TestRegistryConsistency:
             if f.rule == "registry-backend"
         ]
         # [ghost] lacks both a cost seed and any surfacing site;
-        # [device] is covered by both and stays clean.
-        assert len(msgs) == 2
-        assert all("[ghost]" in m for m in msgs)
+        # [packed] is surfaced but unseeded (exactly one finding) —
+        # registering the multi-tenant backend without an exec/cost.py
+        # seed must fail the gate; [device] is covered and stays clean.
+        assert len(msgs) == 3
+        assert sum("[ghost]" in m for m in msgs) == 2
+        packed = [m for m in msgs if "[packed]" in m]
+        assert len(packed) == 1 and "cost seed" in packed[0]
 
     def test_fault_sites(self, report):
         msgs = [
@@ -138,7 +142,9 @@ class TestRegistryConsistency:
         assert any("[estpu_rogue_total]" in m for m in msgs)  # uncataloged
         assert any("[estpu_kind_total]" in m for m in msgs)  # kind clash
         assert any("[estpu_dead_total]" in m for m in msgs)  # dead entry
-        assert len(msgs) == 3
+        # an uncataloged packed-occupancy instrument fails like any other
+        assert any("[estpu_packed_rogue_total]" in m for m in msgs)
+        assert len(msgs) == 4
 
     def test_bool_spec(self, report):
         msgs = [f.message for f in report.findings if f.rule == "bool-spec"]
