@@ -343,6 +343,372 @@ def bench_cfg1_scifact(n_docs=5_000, vocab=8_000, n_q=64):
     }
 
 
+def bench_cfg7_sorted_aggs(n_docs=N_DOCS, n_shards=8):
+    """Round-8 config: one-launch SPMD serving of sorted + aggregating
+    searches (ISSUE 8 / ROADMAP item 1). Two honest measurements:
+
+    KERNEL (at n_docs across n_shards mesh devices): a sorted (price asc)
+    match query WITH metric + fixed-interval histogram agg planes served
+    by ONE `sharded_execute_request` launch (in-program all-gather sort
+    merge + psum'd counts), versus the host-loop baseline (one launch per
+    shard: execute_sorted + execute_aggs, host merge) — the path this
+    config existed to retire. Parity: identical hit ids/sort keys, exact
+    totals, bit-equal histogram counts and metric mask counts; any
+    mismatch zeroes the speedup.
+
+    END-TO-END (REST, smaller corpus): a production request mix — sorted,
+    sorted+aggs, size:0 agg-only, search_after — through the real serving
+    stack, mesh vs host-loop p50 with a FULL-JSON zero-mismatch parity
+    gate, plus a replicated 2-node cluster serving the same agg shapes
+    with exact values (previously a 400).
+    """
+    import jax
+
+    from elasticsearch_tpu.index.mapping import Mappings
+    from elasticsearch_tpu.index.tiles import pack_segment as pack_solo
+    from elasticsearch_tpu.ops import bm25_device
+    from elasticsearch_tpu.ops.aggs_device import (
+        agg_segment_tree,
+        execute_aggs,
+    )
+    from elasticsearch_tpu.parallel.sharded import (
+        ShardedIndex,
+        sharded_execute_request,
+    )
+    from elasticsearch_tpu.query.dsl import parse_query
+    from elasticsearch_tpu.utils.corpus import build_zipf_segment, pick_query_terms
+
+    devices = jax.devices()
+    n_shards = min(n_shards, len(devices))
+    if n_shards < 2:
+        return {"error": "needs >= 2 devices for a shard mesh"}
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(devices[:n_shards]), ("shard",))
+    rng = np.random.default_rng(88)
+    per_shard = max(1, n_docs // n_shards)
+    segments = []
+    for s in range(n_shards):
+        _m, seg = build_zipf_segment(
+            per_shard, vocab_size=20_000, seed=800 + s
+        )
+        price = rng.integers(0, 10_000, per_shard).astype(np.float64)
+        price[rng.random(per_shard) < 0.1] = np.nan  # ~10% missing
+        seg.doc_values["price"] = price
+        segments.append(seg)
+    mappings = Mappings(
+        properties={"body": {"type": "text"}, "price": {"type": "long"}}
+    )
+    idx = ShardedIndex.from_segments(segments, mappings, mesh)
+
+    queries = [
+        parse_query({"match": {"body": " ".join(t)}})
+        for t in pick_query_terms(segments[0], rng, 16, terms_per_query=3)
+    ]
+    # Fixed-interval histogram plane shared by both paths: the bucket
+    # window covers the full price range (metric family rides the
+    # ("matched",) mask planes, finished f64 on the host in both paths).
+    interval, offset = 500.0, 0.0
+    base = 0.0
+    nb = int(10_000 // interval) + 1
+    nb_pad = 1 << (nb - 1).bit_length()
+    aggs_spec = (("matched",), ("histogram", "price", nb_pad, ()))
+    hist_arrays = {
+        "interval": np.float32(interval),
+        "offset": np.float32(offset),
+        "base": np.float32(base),
+    }
+    aggs_arrays = (
+        {},
+        jax.tree.map(
+            lambda x: np.stack([x] * n_shards), hist_arrays
+        ),
+    )
+    solo_devs = [pack_solo(seg) for seg in segments]
+    solo_trees = [agg_segment_tree(dev) for dev in solo_devs]
+    from elasticsearch_tpu.query.compile import Compiler
+
+    # Host-loop plans compile against each shard's SOLO tile layout (its
+    # own pack), exactly like per-shard serving; the mesh plan compiles
+    # against the stacked layout. Sorting/aggs read the matched mask
+    # only, so the two layouts agree on results by construction.
+    solo_compilers = [
+        Compiler(dev.fields, dev.doc_values, mappings)
+        for dev in solo_devs
+    ]
+    solo_compiled = [
+        [comp.compile(q) for q in queries] for comp in solo_compilers
+    ]
+
+    K_SORT = 10
+    compiled = [idx.compile(q) for q in queries]
+
+    def mesh_once(c):
+        return jax.device_get(
+            sharded_execute_request(
+                mesh, "shard", idx.seg_stacked, c.arrays, c.spec, K_SORT,
+                idx.docs_per_shard, sort_field="price", sort_desc=False,
+                missing_first=False, aggs_spec=aggs_spec,
+                aggs_arrays_stacked=aggs_arrays,
+            )
+        )
+
+    def host_loop_once(qi):
+        """One launch per shard (execute_sorted + execute_aggs) + host
+        merge — the path the mesh launch replaces."""
+        merged = []
+        total = 0
+        counts = np.zeros(nb_pad, dtype=np.int64)
+        mask_count = 0
+        for s in range(n_shards):
+            cs = solo_compiled[s][qi]
+            vals, ids, tot = bm25_device.execute_sorted(
+                solo_trees[s], cs.spec, cs.arrays, "price", False, K_SORT
+            )
+            tot2, results = execute_aggs(
+                solo_trees[s], cs.spec, cs.arrays, aggs_spec, (
+                    {}, hist_arrays,
+                )
+            )
+            vals, ids = np.asarray(vals), np.asarray(ids)
+            n = min(K_SORT, int(tot))
+            for rank in range(n):
+                v = float(vals[rank])
+                key = np.inf if np.isnan(vals[rank]) else v
+                merged.append((key, s, rank, int(ids[rank]), v))
+            total += int(tot)
+            counts += np.asarray(
+                jax.device_get(results[1]["counts"])
+            ).astype(np.int64)
+            mask_count += int(
+                np.asarray(jax.device_get(results[0]["mask"])).sum()
+            )
+        merged.sort(key=lambda t: (t[0], t[1], t[2]))
+        return merged[:K_SORT], total, counts, mask_count
+
+    # Warmup (compiles both programs) + parity gate.
+    mismatches = 0
+    for qi, c in enumerate(compiled):
+        keys, vals, gids, total, _n_after, agg_out = mesh_once(c)
+        h_merged, h_total, h_counts, h_mask = host_loop_once(qi)
+        n = min(K_SORT, int(total))
+        ok = int(total) == h_total
+        mesh_counts = np.asarray(agg_out[1]["counts"])[0].astype(np.int64)
+        ok = ok and np.array_equal(mesh_counts, h_counts)
+        mesh_mask = int(
+            np.asarray(agg_out[0]["mask"]).sum()
+        )
+        ok = ok and mesh_mask == h_mask
+        for rank in range(n):
+            shard, local = divmod(int(gids[rank]), idx.docs_per_shard)
+            _hk, h_shard, _hr, h_local, h_val = h_merged[rank]
+            v = float(vals[rank])
+            same_val = (
+                (np.isnan(vals[rank]) and np.isnan(h_val))
+                if np.isnan(h_val) or np.isnan(vals[rank])
+                else v == h_val
+            )
+            if not (shard == h_shard and local == h_local and same_val):
+                ok = False
+                break
+        if not ok:
+            mismatches += 1
+
+    t0 = time.monotonic()
+    for _ in range(REPS):
+        for c in compiled:
+            mesh_once(c)
+    mesh_p50 = (time.monotonic() - t0) / (REPS * len(compiled))
+    t0 = time.monotonic()
+    for _ in range(REPS):
+        for qi in range(len(compiled)):
+            host_loop_once(qi)
+    host_p50 = (time.monotonic() - t0) / (REPS * len(compiled))
+
+    e2e = _cfg7_end_to_end()
+    total_mismatches = (
+        mismatches + e2e.get("e2e_mismatches", 0)
+        + e2e.get("replicated_mismatches", 0)
+    )
+    speedup = (
+        round(host_p50 / mesh_p50, 2)
+        if mesh_p50 > 0 and total_mismatches == 0
+        else 0.0
+    )
+    return {
+        # Unlike other configs there is no raw-document CPU oracle here:
+        # the baseline this config retires is the HOST LOOP (one device
+        # launch per shard + host merge), so speedup = host_loop/mesh and
+        # no oracle_p50_ms field is reported.
+        "speedup": speedup,  # host-loop p50 / one-launch p50
+        "mesh_p50_ms": round(mesh_p50 * 1e3, 4),
+        "host_loop_p50_ms": round(host_p50 * 1e3, 4),
+        "mismatches": total_mismatches,
+        "kernel_mismatches": mismatches,
+        **e2e,
+        "n_docs": per_shard * n_shards,
+        "n_shards": n_shards,
+        "workload": "sorted(price asc, missing last) + stats mask + "
+        "histogram psum, one shard_map launch",
+    }
+
+
+def _cfg7_end_to_end(n_docs=16_000, repl_docs=1_200):
+    """REST-level half of cfg7: the real serving stack end to end."""
+    import json as _json
+
+    from elasticsearch_tpu.rest.server import RestServer
+
+    rng = np.random.default_rng(99)
+    words = ["ant", "bee", "cat", "dog", "elk", "fox", "gnu", "hen"]
+    mappings = {
+        "properties": {
+            "body": {"type": "text"},
+            "tag": {"type": "keyword"},
+            "price": {"type": "long"},
+        }
+    }
+
+    def doc():
+        d = {
+            "body": " ".join(rng.choice(words, 4)),
+            "tag": str(rng.choice(["x", "y", "z"])),
+        }
+        if rng.random() > 0.1:
+            d["price"] = int(rng.integers(0, 5_000))
+        return d
+
+    rest = RestServer()
+    rest.dispatch(
+        "PUT", "/c7", {},
+        _json.dumps({
+            "settings": {"index": {"number_of_shards": 8}},
+            "mappings": mappings,
+        }),
+    )
+    lines = []
+    for i in range(n_docs):
+        lines.append(_json.dumps({"index": {"_id": f"b{i}"}}))
+        lines.append(_json.dumps(doc()))
+        if len(lines) >= 4_000 or i == n_docs - 1:
+            status, resp = rest.dispatch(
+                "POST", "/c7/_bulk", {}, "\n".join(lines)
+            )
+            assert status == 200 and not resp["errors"]
+            lines = []
+    rest.dispatch("POST", "/c7/_refresh", {}, None)
+    svc = rest.node.get_index("c7")
+    mv = svc.search.mesh_view
+    bodies = [
+        {"query": {"match": {"body": "bee cat"}},
+         "sort": [{"price": "desc"}], "size": 10},
+        {"query": {"match": {"body": "ant dog"}},
+         "sort": [{"price": {"order": "asc", "missing": "_first"}}],
+         "size": 10,
+         "aggs": {"st": {"stats": {"field": "price"}},
+                  "h": {"histogram": {"field": "price", "interval": 250}}}},
+        {"query": {"match_all": {}}, "size": 0,
+         "aggs": {"tags": {"terms": {"field": "tag"}},
+                  "st": {"stats": {"field": "price"}}}},
+        {"query": {"match": {"body": "fox"}}, "sort": [{"price": "asc"}],
+         "size": 10, "search_after": [2500]},
+    ]
+
+    def run_all(use_mesh):
+        svc.search.mesh_view = mv if use_mesh else None
+        out = []
+        for b in bodies:
+            rest.node.request_cache.clear()
+            status, resp = rest.dispatch(
+                "POST", "/c7/_search", {}, _json.dumps(b)
+            )
+            assert status == 200, resp
+            out.append({k: v for k, v in resp.items() if k != "took"})
+        svc.search.mesh_view = mv
+        return out
+
+    served0 = mv.served if mv is not None else 0
+    via_mesh = run_all(True)
+    mesh_served = (mv.served - served0) if mv is not None else 0
+    via_host = run_all(False)
+    e2e_mismatches = sum(
+        1 for m, h in zip(via_mesh, via_host) if m != h
+    )
+    if mv is not None and mesh_served < len(bodies):
+        e2e_mismatches += len(bodies) - mesh_served  # silent fallback = fail
+    t0 = time.monotonic()
+    for _ in range(REPS):
+        run_all(True)
+    e2e_mesh_p50 = (time.monotonic() - t0) / (REPS * len(bodies))
+    t0 = time.monotonic()
+    for _ in range(REPS):
+        run_all(False)
+    e2e_host_p50 = (time.monotonic() - t0) / (REPS * len(bodies))
+
+    # Replicated: sorted + agg parity vs raw-doc arithmetic.
+    repl = RestServer(replication_nodes=2)
+    repl.dispatch(
+        "PUT", "/r7", {},
+        _json.dumps({
+            "settings": {
+                "index": {"number_of_shards": 2, "number_of_replicas": 1}
+            },
+            "mappings": mappings,
+        }),
+    )
+    rdocs = {}
+    for i in range(repl_docs):
+        rdocs[f"r{i}"] = doc()
+        status, _ = repl.dispatch(
+            "PUT", f"/r7/_doc/r{i}", {}, _json.dumps(rdocs[f"r{i}"])
+        )
+        assert status in (200, 201)
+    repl.dispatch("POST", "/r7/_refresh", {}, None)
+    replicated_mismatches = 0
+    status, out = repl.dispatch(
+        "POST", "/r7/_search", {},
+        _json.dumps({"size": 0, "aggs": {
+            "st": {"stats": {"field": "price"}},
+            "tags": {"terms": {"field": "tag"}},
+        }}),
+    )
+    if status != 200:
+        replicated_mismatches += 1
+    else:
+        prices = [d["price"] for d in rdocs.values() if "price" in d]
+        st = out["aggregations"]["st"]
+        if st["sum"] != float(sum(prices)) or st["count"] != len(prices):
+            replicated_mismatches += 1
+        from collections import Counter
+
+        tags = Counter(d["tag"] for d in rdocs.values())
+        got = {
+            b["key"]: b["doc_count"]
+            for b in out["aggregations"]["tags"]["buckets"]
+        }
+        if got != dict(tags):
+            replicated_mismatches += 1
+    status, out = repl.dispatch(
+        "POST", "/r7/_search", {},
+        _json.dumps({"query": {"match_all": {}},
+                     "sort": [{"price": "asc"}], "size": 20}),
+    )
+    if status != 200:
+        replicated_mismatches += 1
+    else:
+        got = [h["sort"][0] for h in out["hits"]["hits"]]
+        if got != sorted(got, key=lambda v: np.inf if v is None else v):
+            replicated_mismatches += 1
+    return {
+        "e2e_mesh_p50_ms": round(e2e_mesh_p50 * 1e3, 3),
+        "e2e_host_loop_p50_ms": round(e2e_host_p50 * 1e3, 3),
+        "e2e_mismatches": e2e_mismatches,
+        "e2e_mesh_served": mesh_served,
+        "replicated_mismatches": replicated_mismatches,
+        "e2e_n_docs": n_docs,
+    }
+
+
 def bench_cfg6_multitenant(n_tenants=150, q_per_tenant=2, vocab=4_000):
     """Round-7 config: packed multi-tenant execution at tenant scale —
     >= 100 small indices (1-10k docs each, ROADMAP item 4's "millions of
@@ -1274,6 +1640,7 @@ def main():
         ),
         ("cfg5_knn", bench_cfg5_knn),
         ("cfg6_multitenant", bench_cfg6_multitenant),
+        ("cfg7_sorted_aggs", bench_cfg7_sorted_aggs),
     ):
         try:
             configs[name] = fn()

@@ -764,6 +764,13 @@ class ClusterNode:
     # ------------------------------------------------------- search path
 
     def _on_shard_search(self, from_id: str, payload: dict):
+        from dataclasses import replace as dc_replace
+
+        from ..search.aggs import (
+            Aggregator,
+            state_to_wire,
+            wire_agg_ineligible_reason,
+        )
         from ..search.service import SearchRequest, SearchService
 
         engine = self.engines[(payload["index"], payload["shard"])]
@@ -773,23 +780,58 @@ class ClusterNode:
         try:
             engine.refresh()
             request = SearchRequest.from_json(payload["body"])
-            resp = SearchService(engine, payload["index"]).search(request)
+            # One segment snapshot shared by the agg pass and the hits
+            # pass, like the single-process shard service.
+            segments = list(engine.segments)
+            agg_wire = None
+            agg_total = None
+            if request.aggs is not None:
+                reason = wire_agg_ineligible_reason(request.aggs)
+                if reason:
+                    raise ValueError(
+                        f"{reason} are not supported on replicated "
+                        f"indices yet"
+                    )
+                agg = Aggregator(
+                    engine, request.aggs, handles=segments,
+                    index_name=payload["index"],
+                )
+                agg_total, states = agg.run_states(request.query)
+                agg_wire = [
+                    state_to_wire(node, state, agg._plan)
+                    for node, state in zip(request.aggs, states)
+                ]
+                request = dc_replace(request, aggs=None)
+            k = max(0, request.from_) + max(0, request.size)
+            if k > 0 or agg_total is None:
+                resp = SearchService(engine, payload["index"]).search(
+                    request, segments=segments
+                )
+                total = agg_total if agg_total is not None else resp.total
+                max_score, hits = resp.max_score, resp.hits
+            else:  # agg-only: the agg program already counted totals
+                total, max_score, hits = agg_total, None, []
         finally:
             with self.lock:
                 self._inflight_searches -= 1
         return {
-            "total": resp.total,
-            "max_score": resp.max_score,
+            "total": total,
+            "max_score": max_score,
             # Copy-side load signal for the coordinator's adaptive replica
             # selection (the reference piggybacks queue size the same way).
             "queue": queue,
+            # Pre-render aggregation merge states: the coordinator reduce
+            # folds these across shards and renders once (the wire analog
+            # of InternalAggregations.topLevelReduce).
+            "aggs": agg_wire,
             "hits": [
                 {
                     "_id": h.doc_id,
                     "_score": h.score,
                     "_source": h.source,
+                    "sort": h.sort,
                 }
-                for h in resp.hits
+                for h in hits
             ],
         }
 
@@ -839,6 +881,23 @@ class ClusterNode:
         meta = self.state.indices.get(index)
         if meta is None:
             raise NoShardAvailableError(f"no such index [{index}]")
+        from ..search.aggs import (
+            merge_wire_states,
+            render_wire_states,
+            wire_agg_ineligible_reason,
+        )
+        from ..search.service import SearchRequest, sort_merge_key
+
+        # The coordinator's view of the request: merge keys (sort spec,
+        # missing directives) and the agg node tree for the wire reduce.
+        # Parsing errors are request-shaped (ValueError -> 400).
+        request = SearchRequest.from_json(body)
+        if request.aggs is not None:
+            reason = wire_agg_ineligible_reason(request.aggs)
+            if reason:
+                raise ValueError(
+                    f"{reason} are not supported on replicated indices yet"
+                )
         self._count_search("searches")
         size = int(body.get("size", 10))
         shard_body = dict(body)
@@ -849,6 +908,7 @@ class ClusterNode:
         max_score = None
         successful = 0
         failures: list[dict] = []
+        agg_acc: list | None = None
         from ..obs.tracing import TRACER
 
         for shard_id, routing in sorted(meta.shards.items()):
@@ -881,9 +941,23 @@ class ClusterNode:
                     if max_score is None
                     else max(max_score, resp["max_score"])
                 )
+            shard_aggs = resp.get("aggs")
+            if request.aggs is not None and shard_aggs is not None:
+                if agg_acc is None:
+                    agg_acc = [None] * len(request.aggs)
+                agg_acc = [
+                    merge_wire_states(node, acc, wire)
+                    for node, acc, wire in zip(
+                        request.aggs, agg_acc, shard_aggs
+                    )
+                ]
             for rank, hit in enumerate(resp["hits"]):
-                score = hit["_score"]
-                sort_key = -score if score is not None else np.inf
+                # Merge contract identical to the single-process
+                # coordinator: (sort key per the request's sort spec with
+                # missing-value placement, shard index, per-shard rank).
+                sort_key = sort_merge_key(
+                    request, hit.get("_score"), hit.get("sort")
+                )
                 merged.append((sort_key, shard_id, rank, hit))
         failed = len(failures)
         if failed:
@@ -903,7 +977,11 @@ class ClusterNode:
             self._count_search("partial_results")
         merged.sort(key=lambda t: (t[0], t[1], t[2]))
         frm = int(body.get("from", 0))
-        page = [h for _, _, _, h in merged[frm : frm + size]]
+        page = []
+        for _, _, _, h in merged[frm : frm + size]:
+            if h.get("sort") is None:
+                h = {k2: v for k2, v in h.items() if k2 != "sort"}
+            page.append(h)
         shards_obj: dict[str, Any] = {
             "total": len(meta.shards),
             "successful": successful,
@@ -912,7 +990,7 @@ class ClusterNode:
         }
         if failures:
             shards_obj["failures"] = failures
-        return {
+        out: dict[str, Any] = {
             "_shards": shards_obj,
             "hits": {
                 "total": {"value": total, "relation": "eq"},
@@ -920,6 +998,28 @@ class ClusterNode:
                 "hits": page,
             },
         }
+        if request.aggs is not None:
+            from ..index.mapping import Mappings
+
+            wires = agg_acc or [None] * len(request.aggs)
+            if any(w is None for w in wires):
+                # No successful shard contributed (all-failed raises
+                # earlier): render empty states.
+                from ..search.aggs import new_merge_state, state_to_wire
+
+                wires = [
+                    w
+                    if w is not None
+                    else state_to_wire(n, new_merge_state(n), {})
+                    for n, w in zip(request.aggs, wires)
+                ]
+            out["aggregations"] = render_wire_states(
+                request.aggs,
+                wires,
+                Mappings.from_json(meta.mappings),
+                index,
+            )
+        return out
 
     def _search_one_shard(
         self, index: str, shard_id: int, copies: list[str], shard_body: dict

@@ -54,6 +54,11 @@ _BLOCKMAX_LAUNCH_MS = 2.1  # two launches + host prune/re-bucket
 _ORACLE_FLOOR_MS = 0.05  # numpy dispatch floor
 _ORACLE_POSTING_MS = 0.000004  # per posting touched (scatter-add share)
 _ORACLE_TOPK_MS = 0.000025  # per corpus doc (lexsort/top-k share)
+# Per-shard share of the in-program mesh reduce (all-gather of k-sized
+# key planes + psum over ICI): tiny next to the launch floor — the whole
+# point of the SPMD path is that adding shards adds collective hops, not
+# per-shard launches.
+_MESH_COLLECTIVE_MS = 0.02
 
 
 def coalesce_wins(extra_pad_tiles: int) -> bool:
@@ -76,7 +81,7 @@ def coalesce_wins(extra_pad_tiles: int) -> bool:
 # ExecPlanner.BACKENDS entry must be named either here or in a seed_ms
 # branch (staticcheck registry-backend rule): an unlisted backend would
 # silently inherit a formula nobody chose for it.
-_DEVICE_LIKE = ("device", "device_batched", "mesh_spmd")
+_DEVICE_LIKE = ("device", "device_batched")
 
 
 def seed_ms(backend: str, feats: PlanFeatures) -> float:
@@ -95,6 +100,22 @@ def seed_ms(backend: str, feats: PlanFeatures) -> float:
             _BLOCKMAX_LAUNCH_MS
             + _DEVICE_TILE_MS * feats.work_tiles * 0.5 * shards
         )
+    if backend == "mesh_spmd":
+        # One shard_map launch serves EVERY shard: one dispatch floor,
+        # per-shard work in parallel across the mesh (so the per-shard
+        # tile/dense terms do NOT multiply by shard count — only the
+        # collective reduce scales with it). n_docs here is the padded
+        # per-shard doc capacity, the shard-local plane the program scans.
+        cost = (
+            _DEVICE_LAUNCH_MS
+            + _MESH_COLLECTIVE_MS * shards
+            + _DEVICE_TILE_MS * feats.work_tiles
+        )
+        if feats.work_tiles == 0:
+            cost += _DEVICE_DENSE_MS * (feats.n_docs / 1e6) * max(
+                1, feats.n_clauses
+            )
+        return cost
     if backend == "packed":
         # Packed multi-tenant launch (exec/packed.py): ONE dispatch is
         # shared by every coalesced lane, so the per-lane launch floor

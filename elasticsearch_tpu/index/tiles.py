@@ -269,9 +269,13 @@ def pack_field(
         pos_val = jax.device_put(pv.reshape(-1, TILE), device)
         pos_offsets_host = field.pos_offsets
     ord_terms = None
-    if not field.has_norms and len(field.df):
+    if not field.has_norms:
         # keyword field: per-posting owning term id (CSR expansion),
         # padded with sentinel T so padding scatters into a discard slot.
+        # Built even for an EMPTY vocabulary: the SPMD mesh path stacks
+        # one agg program over every shard, so a shard where the field is
+        # union-schema-filled empty must still present the same ordinal
+        # plane structure (all padding, sentinel 0 → the discard slot).
         t_count = len(field.df)
         ords = np.repeat(
             np.arange(t_count, dtype=np.int32),

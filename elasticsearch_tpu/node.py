@@ -476,8 +476,11 @@ class Node:
             search.mesh_view = maybe_mesh_view(engines, mappings, params)
             if search.mesh_view is not None:
                 # SPMD servings feed the same cost model/counters so
-                # `_nodes/stats` shows every backend's traffic share.
+                # `_nodes/stats` shows every backend's traffic share, and
+                # mesh served/fallback counters land on the node registry
+                # (Prometheus `/_metrics` + `_nodes/stats` mesh_serving).
                 search.mesh_view.planner = self.exec_planner
+                search.mesh_view.metrics = self.metrics
         svc = IndexService(
             name=name,
             mappings=mappings,
@@ -1154,18 +1157,12 @@ class Node:
             raise ApiError(
                 400, "illegal_argument_exception", str(e)
             ) from None
-        if (
-            scroll is not None
-            or body.get("aggs")
-            or body.get("aggregations")
-            or body.get("suggest")
-        ):
+        if scroll is not None or body.get("suggest"):
             raise ApiError(
                 400,
                 "illegal_argument_exception",
-                "aggregations/scroll/suggest are not supported on "
-                "replicated indices yet; disable replication for this "
-                "workload",
+                "scroll/suggest are not supported on replicated indices "
+                "yet; disable replication for this workload",
             )
         t0 = time.monotonic()
         try:
@@ -3585,6 +3582,11 @@ class Node:
                 "packs": mv.packs,
                 "rebuilds": mv.rebuilds,
                 "exec_failures": mv.exec_failures,
+                # Host-loop fallbacks by reason (estpu_mesh_fallback_total
+                # view): a mesh decline is never silent.
+                "fallbacks": {
+                    k: v for k, v in sorted(mv.fallbacks.items())
+                },
             }
         node_stats: dict[str, Any] = {
             "name": self.node_name,
@@ -3604,6 +3606,16 @@ class Node:
             "mesh_serving": {
                 "disable_events": disable_events,
                 "reenable_events": reenable_events,
+                # Node-wide one-launch servings by request shape
+                # (estpu_mesh_served_total view).
+                "served_by_shape": {
+                    shape: int(v)
+                    for shape, v in sorted(
+                        self.metrics.label_values(
+                            "estpu_mesh_served_total", "shape"
+                        ).items()
+                    )
+                },
                 "views": mesh_views,
             },
             # Adaptive query-execution subsystem: planner decision

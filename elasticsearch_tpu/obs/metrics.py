@@ -378,6 +378,12 @@ CATALOG = {
     "estpu_packed_lanes_per_launch": ("histogram", "exec.packed"),
     "estpu_packed_plane_docs": ("gauge", "exec.packed"),
     "estpu_packed_plane_tenants": ("gauge", "exec.packed"),
+    # SPMD mesh serving (parallel/mesh_serving.py): one-launch servings by
+    # request shape, and fallbacks to the host-loop coordinator by reason
+    # (ineligible_shape, sort_shape, agg_shape, nested, breaker,
+    # non_uniform_plan, execute_error) — a silent mesh decline is a bug.
+    "estpu_mesh_served_total": ("counter", "mesh_serving"),
+    "estpu_mesh_fallback_total": ("counter", "mesh_serving"),
     "estpu_request_cache_hits_total": ("counter", "indices.request_cache"),
     "estpu_request_cache_misses_total": (
         "counter",

@@ -306,6 +306,56 @@ def _eval_agg(spec, arrays, seg, matched, scores, num_docs):
     raise ValueError(f"unknown aggregation plan node [{kind}]")
 
 
+def _mesh_combine_node(spec, result, axis):
+    """In-program cross-shard combine for one agg node's result planes.
+
+    Integer count planes psum EXACTLY (int addition is grouping-free, so
+    the in-program combine is bit-identical to the host loop's per-shard
+    fold): fixed-edge histogram/range bucket counts and the filter-family
+    doc_counts. Per-shard planes — eligibility masks for the f64-exact
+    metric finish, keyword ordinal counts whose vocabularies are
+    shard-local — pass through unreduced and come back stacked for the
+    host fold (the same division of labor as the reference's coordinator
+    reduce: exact combiners in the program, string-keyed merges on the
+    coordinator)."""
+    kind = spec[0]
+    if kind in ("histogram", "range", "empty_buckets"):
+        out = dict(result)
+        out["counts"] = jax.lax.psum(result["counts"], axis)
+        return out
+    if kind in ("filter", "global", "missing"):
+        sub_specs = spec[-1]
+        return {
+            "doc_count": jax.lax.psum(result["doc_count"], axis),
+            "subs": tuple(
+                _mesh_combine_node(s, r, axis)
+                for s, r in zip(sub_specs, result["subs"])
+            ),
+        }
+    if kind == "filters":
+        sub_specs = spec[2]
+        return tuple(
+            {
+                "doc_count": jax.lax.psum(b["doc_count"], axis),
+                "subs": tuple(
+                    _mesh_combine_node(s, r, axis)
+                    for s, r in zip(sub_specs, b["subs"])
+                ),
+            }
+            for b in result
+        )
+    # matched / terms / cardinality_terms / hits planes: per-shard.
+    return result
+
+
+def mesh_combine(aggs_spec, results, axis):
+    """Apply the in-program psum combine across a whole agg spec tuple
+    (called from inside the mesh shard_map body)."""
+    return tuple(
+        _mesh_combine_node(s, r, axis) for s, r in zip(aggs_spec, results)
+    )
+
+
 @partial(jax.jit, static_argnames=("query_spec", "aggs_spec"))
 def execute_aggs(seg, query_spec, query_arrays, aggs_spec, aggs_arrays):
     """Evaluate the query then every aggregation in one XLA program.

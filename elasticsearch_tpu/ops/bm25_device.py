@@ -1313,22 +1313,22 @@ def execute_score_after(seg, spec, arrays, k: int, after_score, after_doc,
     return top_scores, top_ids.astype(jnp.int32), total, n_after
 
 
-@partial(jax.jit, static_argnames=("spec", "field_name", "desc", "k"))
+@partial(
+    jax.jit, static_argnames=("spec", "field_name", "desc", "k", "missing_first")
+)
 def execute_sorted_after(seg, spec, arrays, field_name: str, desc: bool,
-                         k: int, after_key, after_doc):
+                         k: int, after_key, after_doc,
+                         missing_first: bool = False):
     """Field-sorted top-k strictly after the (key, doc) cursor.
 
     `after_key` lives in the transformed ascending key space (negated for
-    desc, missing = f32 max) so one comparison covers both directions and
-    the missing-last region."""
+    desc, missing = +/-f32 max per the missing directive) so one
+    comparison covers both directions and the missing region."""
     live = seg["live"]
     num_docs = live.shape[0]
     _, matched = _eval_node(spec, arrays, seg, num_docs)
     eligible = matched & live
-    col = seg["doc_values"][field_name]
-    key = -col if desc else col
-    fmax = jnp.float32(jnp.finfo(jnp.float32).max)
-    key = jnp.where(jnp.isnan(key), fmax, key)
+    col, key = sort_key_plane(seg, field_name, desc, missing_first)
     iota = jnp.arange(num_docs, dtype=jnp.int32)
     keep = eligible & (
         (key > after_key) | ((key == after_key) & (iota > after_doc))
@@ -1733,24 +1733,37 @@ def packed_segment_tree(plane) -> dict[str, Any]:
     }
 
 
-@partial(jax.jit, static_argnames=("spec", "field_name", "desc", "k"))
-def execute_sorted(seg, spec, arrays, field_name: str, desc: bool, k: int):
-    """Query + field sort: top-k by a doc-values column, missing last.
+def sort_key_plane(seg, field_name: str, desc: bool, missing_first: bool):
+    """Transformed ascending sort-key plane for a doc-values column:
+    negate for desc, missing (NaN) pinned to +/-f32max per the missing
+    directive (FieldSortBuilder missing-value semantics). Shared by the
+    single-segment sort kernels and the SPMD mesh program so both paths
+    rank by bit-identical keys."""
+    col = seg["doc_values"][field_name]
+    key = -col if desc else col
+    fmax = jnp.float32(jnp.finfo(jnp.float32).max)
+    miss = -fmax if missing_first else fmax
+    return col, jnp.where(jnp.isnan(key), miss, key)
 
-    Mirrors the reference's TopFieldCollector path with ES missing-last
-    semantics (search/sort/FieldSortBuilder). Ties break by ascending doc
-    id. Returns (values f32[k] raw field values (NaN = missing),
-    ids i32[k], total_hits i32[]).
+
+@partial(
+    jax.jit, static_argnames=("spec", "field_name", "desc", "k", "missing_first")
+)
+def execute_sorted(seg, spec, arrays, field_name: str, desc: bool, k: int,
+                   missing_first: bool = False):
+    """Query + field sort: top-k by a doc-values column, missing first or
+    last per the sort's missing directive (default last).
+
+    Mirrors the reference's TopFieldCollector path with ES FieldSortBuilder
+    semantics. Ties break by ascending doc id. Returns (values f32[k] raw
+    field values (NaN = missing), ids i32[k], total_hits i32[]).
     """
     live = seg["live"]
     num_docs = live.shape[0]
     _, matched = _eval_node(spec, arrays, seg, num_docs)
     eligible = matched & live
-    col = seg["doc_values"][field_name]
-    key = -col if desc else col
-    fmax = jnp.float32(jnp.finfo(jnp.float32).max)
-    key = jnp.where(jnp.isnan(key), fmax, key)  # missing sorts last...
-    key = jnp.where(eligible, key, jnp.float32(jnp.inf))  # ...but before ineligible
+    col, key = sort_key_plane(seg, field_name, desc, missing_first)
+    key = jnp.where(eligible, key, jnp.float32(jnp.inf))  # ineligible last
     kk = min(k, num_docs)
     _neg_top, ids = jax.lax.top_k(-key, kk)
     values = col[ids]

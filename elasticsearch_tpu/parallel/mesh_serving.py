@@ -41,10 +41,21 @@ Design:
   host against the snapshot's merged segments, mirroring the reference's
   query-then-fetch split.
 
-Requests outside the supported shape (explicit sort, rescore, aggs,
-search_after/scroll, profile, size+from = 0) fall back to the host-loop
-coordinator; result parity between the two paths is asserted by
-tests/test_mesh_serving.py across the query-DSL matrix.
+One launch serves the full production request shape: sorted searches
+(single numeric doc-values key, asc/desc, missing first/last, optional
+trailing `_doc` tiebreak — ranked by an encoded (sort key, shard, doc)
+composite and merged by in-program collectives), `search_after` cursors
+(a key-range mask applied before the local top-k), aggregations in the
+mesh-eligible family (metric/percentile family, fixed-edge histogram/
+range with psum'd integer count planes, keyword/numeric terms,
+cardinality, and the filter/global/missing nesting family), and `size:0`
+agg-only / count-only requests. Requests outside the supported shape
+(rescore, profile, multi-key field sorts, array-bucket aggs with metric
+subs, top_hits/composite/matrix_stats/significant_terms) fall back to
+the host-loop coordinator — counted by reason in
+`estpu_mesh_fallback_total`, never silently. Result parity between the
+two paths is asserted bit-exactly by tests/test_mesh_serving.py and the
+tests/test_mesh_sorted_aggs.py fuzz suite.
 """
 
 from __future__ import annotations
@@ -65,6 +76,7 @@ from .sharded import (
     ShardedIndex,
     fill_union_schema,
     sharded_execute,
+    sharded_execute_request,
     union_schema,
 )
 
@@ -204,11 +216,20 @@ class MeshServingBreaker:
 
 @dataclass
 class _MeshHandle:
-    """Host-side fetch handle for a snapshot's merged shard segment (duck-
-    typed for SearchService._fetch_source/_fetch_highlight/_fetch_fields,
-    which only read handle.segment)."""
+    """Host-side handle for a snapshot's merged shard segment (duck-typed
+    for SearchService._fetch_source/_fetch_highlight/_fetch_fields, which
+    only read handle.segment; the agg compile additionally reads
+    handle.device, and the mesh agg merge reads handle.spans)."""
 
     segment: Segment
+    # The packed DeviceSegment behind the stacked pytree row (same device
+    # buffers, host-side field/column views for the agg planner).
+    device: Any = None
+    # Engine-handle boundaries inside the merged doc space: [lo, hi) per
+    # original segment, in handle order. The f64-exact metric folds walk
+    # these spans so their partial sums group exactly like the host loop's
+    # per-segment folds (bit-identical results).
+    spans: list = dc_field(default_factory=list)
 
 
 @dataclass
@@ -246,6 +267,11 @@ class _Snapshot:
     gens: tuple
     index: MeshIndex
     handles: list[_MeshHandle]
+    # The pinned engine segment handles the serving statistics came from
+    # (flat, shard order): the agg planner's histogram-range scope, so
+    # plan-time behavior (bucket windows, TooManyBuckets) matches the
+    # host-loop coordinator exactly — tombstoned values included.
+    engine_handles: list = dc_field(default_factory=list)
 
 
 class MeshView:
@@ -266,12 +292,26 @@ class MeshView:
         # Union-schema-filled copies actually packed (what snapshots see).
         self._filled_segs: list[Segment | None] = [None] * n
         self._trees: list[Any] = [None] * n  # [1, ...]-leaved device pytrees
+        self._devs: list[Any] = [None] * n  # packed DeviceSegments (views)
+        self._spans: list[list] = [[] for _ in range(n)]  # handle spans
         self._pack_avgdl: list[dict[str, float]] = [{} for _ in range(n)]
         self._shapes: dict[str, Any] | None = None  # current padded shapes
         # Test/observability hooks.
         self.served = 0  # searches answered by the SPMD program
         self.packs = 0  # shard pack+upload operations performed
         self.rebuilds = 0  # full (all-shard) rebuilds
+        # Fallback accounting: every serve() decline is counted by reason
+        # (never silent) — mirrored on the metrics registry as
+        # estpu_mesh_fallback_total{reason} and surfaced in `_nodes/stats`
+        # under mesh_serving; the coordinator tags the request span with
+        # last_fallback_reason.
+        self.fallbacks: dict[str, int] = {}
+        self.last_fallback_reason: str | None = None
+        # obs.MetricsRegistry (the node's, when wired by create_index);
+        # standalone views get a private registry.
+        from ..obs.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
         # Resilience: execute-stage failures route requests back to the
         # host-loop path through a circuit breaker — transient failures
         # (device OOM under the mesh copy) half-open after a cooldown and
@@ -293,16 +333,21 @@ class MeshView:
 
     # ------------------------------------------------------------- refresh
 
-    def _merged_segment(self, handles: list) -> Segment:
+    def _merged_segment(self, handles: list) -> tuple[Segment, list]:
         """One segment of the shard's device-visible live docs, in host-path
         order (segment handles in order, local ids ascending) so equal-score
-        tie-breaks match the coordinator merge exactly."""
+        tie-breaks match the coordinator merge exactly. Also returns the
+        [lo, hi) span each engine handle occupies in the merged doc space
+        (the f64-exact agg folds group by these)."""
         builder = SegmentBuilder(self.mappings)
+        spans: list[tuple[int, int]] = []
+        base = 0
         for handle in handles:
             # The mask the device kernels currently serve — NOT live_host,
             # which may carry deletes that only become searchable at the
             # next refresh (generation bump) on the host path too.
             live = np.asarray(handle.device.live)[: handle.segment.num_docs]
+            added = 0
             for local in np.flatnonzero(live):
                 local = int(local)
                 builder.add(
@@ -311,7 +356,10 @@ class MeshView:
                     version=handle.segment.doc_version(local),
                     seqno=handle.segment.doc_seqno(local),
                 )
-        return builder.build()
+                added += 1
+            spans.append((base, base + added))
+            base += added
+        return builder.build(), spans
 
     def _schema(self, segs: list[Segment]) -> dict[str, Any]:
         """Union schema + pow-2 padded shapes covering every shard."""
@@ -401,8 +449,13 @@ class MeshView:
             b=self.params.b,
             field_pos_min_tiles=shapes["pos_tiles"],
         )
-        tree = jax.tree.map(lambda x: x[None], segment_tree(dev))
-        return tree, seg, avgdl
+        # agg_segment_tree = segment_tree + keyword ordinal planes: the
+        # one stacked pytree serves both the scoring kernels and the
+        # in-program aggregation planes.
+        from ..ops.aggs_device import agg_segment_tree
+
+        tree = jax.tree.map(lambda x: x[None], agg_segment_tree(dev))
+        return tree, seg, avgdl, dev
 
     def _assemble(self) -> Any:
         """Zero-copy global stacked pytree from the per-shard buffers."""
@@ -464,8 +517,9 @@ class MeshView:
             merged = {
                 i: s for i, s in enumerate(self._host_segs) if s is not None
             }
+            spans = {i: self._spans[i] for i in merged}
             for i in changed:
-                merged[i] = self._merged_segment(pinned[i])
+                merged[i], spans[i] = self._merged_segment(pinned[i])
             new_shapes = self._schema([merged[i] for i in sorted(merged)])
             # Serving statistics: the ENGINE view (tombstones included),
             # computed from the same pinned handle lists the merges came
@@ -493,10 +547,12 @@ class MeshView:
                 self.rebuilds += 1
             for i in changed:
                 self._host_segs[i] = merged[i]
-            for i, (tree, filled, avgdl) in packed.items():
+                self._spans[i] = spans[i]
+            for i, (tree, filled, avgdl, dev) in packed.items():
                 self._trees[i] = tree
                 self._filled_segs[i] = filled
                 self._pack_avgdl[i] = avgdl
+                self._devs[i] = dev
                 self.packs += 1
             self._shard_gen = list(gens)
             segments = [s for s in self._filled_segs]
@@ -514,40 +570,167 @@ class MeshView:
             self._snap = _Snapshot(
                 gens=gens,
                 index=index,
-                handles=[_MeshHandle(s) for s in segments],
+                handles=[
+                    _MeshHandle(s, device=self._devs[i], spans=self._spans[i])
+                    for i, s in enumerate(segments)
+                ],
+                engine_handles=[h for handles in pinned for h in handles],
             )
             return self._snap
 
     # -------------------------------------------------------------- serve
 
+    def _fallback(self, reason: str):
+        """Count (never silently drop) one serve() decline and return the
+        None the coordinator interprets as host-loop fallback. The reason
+        is attached to the ENCLOSING mesh.serve span as an event from this
+        thread's own trace context (race-free under concurrent searches);
+        last_fallback_reason is a single-threaded test/diagnostic hook."""
+        from ..obs.tracing import TRACER
+
+        self.last_fallback_reason = reason
+        with self._lock:
+            self.fallbacks[reason] = self.fallbacks.get(reason, 0) + 1
+        self.metrics.counter(
+            "estpu_mesh_fallback_total",
+            "SPMD mesh fallbacks to the host-loop coordinator by reason",
+            reason=reason,
+        ).inc()
+        TRACER.event("mesh.fallback", reason=reason)
+        return None
+
     @staticmethod
-    def eligible(request) -> bool:
+    def ineligible_reason(request) -> str | None:
+        """Shape-level reason this request cannot serve on the SPMD path
+        (None = eligible). Context-free — mapping/plan-level declines
+        (unsortable field, non-uniform compile) surface inside serve()."""
+        from ..search.aggs import mesh_agg_ineligible_reason
+        from ..search.service import normalized_sort
+
+        if request.rescore or request.profile:
+            return "ineligible_shape"
+        if request.after_doc >= 0:
+            # Engine-global doc cursors (scroll internals) address the
+            # host path's doc space, not the mesh's.
+            return "ineligible_shape"
+        if request.sort is not None:
+            keys = normalized_sort(request)
+            if len(keys) != 1:
+                # Multi-key field sorts lexsort on the host path.
+                return "sort_shape"
+            fname, desc, _mf = keys[0]
+            if fname == "_score" and not desc:
+                return "sort_shape"  # bottom-k: host execute_score_asc
+        if request.aggs is not None:
+            reason = mesh_agg_ineligible_reason(request.aggs)
+            if reason is not None:
+                return reason
+        return None
+
+    @classmethod
+    def eligible(cls, request) -> bool:
         """Request shapes the SPMD query phase covers; everything else
-        falls back to the host-loop coordinator."""
-        return (
-            request.sort is None
-            and not request.rescore
-            and request.aggs is None
-            and request.search_after is None
-            and not request.profile
-            and max(0, request.from_) + max(0, request.size) > 0
+        falls back to the host-loop coordinator. Sorted searches
+        (single numeric key, asc/desc, missing first/last, optional _doc
+        tiebreak), aggregations in the mesh-eligible family, search_after
+        cursors and size:0 agg-only/count requests are all served."""
+        return cls.ineligible_reason(request) is None
+
+    def _sort_plan(self, request):
+        """(sort_field, desc, missing_first, want_sort_values) for the
+        kernel, or an ineligibility reason string. sort_field None =
+        score-ordered."""
+        from ..search.service import normalized_sort
+
+        if request.sort is None:
+            return (None, False, False, False)
+        ((fname, desc, mfirst),) = normalized_sort(request)
+        if fname == "_score":
+            return (None, False, False, True)
+        fm = self.mappings.get(fname)
+        if fm is None or not fm.is_numeric:
+            return "sort_shape"  # host path raises the 400 verbatim
+        return (fname, desc, mfirst, True)
+
+    def _compile_aggs(self, coordinator, snap, request):
+        """(Aggregator, specs tuple, stacked arrays) for the request's agg
+        tree, compiled shard-uniform across the mesh. Raises ValueError
+        when per-shard lowering diverges (non-uniform filter plans)."""
+        from ..search.aggs import Aggregator, _pow2 as agg_pow2
+
+        idx = snap.index
+        term_fields: set[str] = set()
+
+        def collect(nodes):
+            for n in nodes:
+                if n.kind in ("terms", "rare_terms", "cardinality"):
+                    f = n.params.get("field")
+                    if f:
+                        term_fields.add(f)
+                collect(n.subs)
+
+        collect(request.aggs)
+        term_pads: dict[str, int] = {}
+        for f in term_fields:
+            widths = [
+                h.device.fields[f].num_terms
+                for h in snap.handles
+                if h.device is not None and f in h.device.fields
+            ]
+            if widths:
+                term_pads[f] = agg_pow2(max(widths))
+        agg = Aggregator(
+            self.engines[0],
+            request.aggs,
+            handles=snap.handles,
+            index_name=coordinator.index_name,
+            term_pads=term_pads,
+            range_handles=snap.engine_handles,
         )
+        # Keep EVERY shard row (the stacked program is mesh-wide); the
+        # default constructor filter drops empty merged shards.
+        agg.handles = list(snap.handles)
+        import jax
+
+        per_shard = [
+            agg.compile_for(snap.handles[s], idx.shard_compiler(s))
+            for s in range(len(snap.handles))
+        ]
+        specs = {s for s, _ in per_shard}
+        if len(specs) != 1:
+            raise ValueError(
+                "aggregation plans did not lower shard-uniform"
+            )
+        arrays = jax.tree.map(
+            lambda *xs: np.stack(xs), *[a for _, a in per_shard]
+        )
+        return agg, per_shard[0][0], arrays
 
     def serve(self, coordinator, request, task=None):
-        """Answer a SearchRequest via the SPMD program, or None to make the
-        coordinator fall back to the host-loop path (ineligible request
-        shape, or a plan the mesh compiler cannot make shard-uniform)."""
+        """Answer a SearchRequest via ONE SPMD program — scoring, sorted or
+        score-ordered top-k with search_after masking, psum'd totals, and
+        the aggregation planes all inside a single shard_map launch — or
+        None (with the fallback counted by reason) to make the coordinator
+        fall back to the host-loop path."""
+        from ..search.aggs import new_merge_state
         from ..search.service import SearchHit, SearchResponse, clamp_total
 
-        if not self.eligible(request) or not self.breaker.allow():
-            return None
+        reason = self.ineligible_reason(request)
+        if reason is not None:
+            return self._fallback(reason)
+        if not self.breaker.allow():
+            return self._fallback("breaker")
         if any(
             h.segment.nested for e in self.engines for h in e.segments
         ):
             # Nested blocks are not mesh-stackable yet; without this guard
             # the mesh compiler (which has no nested context) would lower
             # nested queries to match_none and serve wrong results.
-            return None
+            return self._fallback("nested")
+        sort_plan = self._sort_plan(request)
+        if isinstance(sort_plan, str):
+            return self._fallback(sort_plan)
+        sort_field, sort_desc, missing_first, want_sort_values = sort_plan
         start = time.monotonic()
         snap = self._ensure()
         idx = snap.index
@@ -557,21 +740,97 @@ class MeshView:
         except Exception:
             # Plans the mesh can't make shard-uniform fall back; user-facing
             # validation errors re-raise identically from the host path.
-            return None
+            return self._fallback("non_uniform_plan")
+        agg = None
+        aggs_spec = None
+        aggs_arrays = ()
+        if request.aggs is not None:
+            try:
+                agg, aggs_spec, aggs_arrays = self._compile_aggs(
+                    coordinator, snap, request
+                )
+            # staticcheck: ignore[broad-except] agg-compile fallback: the host loop re-raises user-facing agg validation errors (text-field terms, bad params) identically
+            except Exception:
+                return self._fallback("non_uniform_plan")
         k = max(0, request.from_) + max(0, request.size)
+        if sort_field is not None and k > 0 and sort_field not in (
+            idx.segments[0].doc_values if idx.segments else {}
+        ):
+            # Mapped numeric field no document carries: the host path's
+            # missing-column branch owns that shape.
+            return self._fallback("sort_shape")
+        # search_after cursor, in the kernel's transformed ascending key
+        # space; public cursors are key-only, so the global doc tiebreak
+        # is pushed past every shard (ties never qualify).
+        has_after = request.search_after is not None
+        after_key = np.float32(0.0)
+        after_doc = len(self.engines) * idx.docs_per_shard
+        if has_after:
+            raw = request.search_after[0]
+            fmax = np.float32(np.finfo(np.float32).max)
+            if sort_field is None:
+                if raw is None or not isinstance(raw, (int, float)):
+                    return self._fallback("ineligible_shape")
+                after_key = np.float32(raw)
+            elif raw is None:
+                after_key = -fmax if missing_first else fmax
+            else:
+                after_key = np.float32(raw)
+                if sort_desc:
+                    after_key = np.float32(-after_key)
         if task is not None:
             task.raise_if_cancelled()
+        plain = (
+            sort_field is None
+            and not has_after
+            and aggs_spec is None
+            and not want_sort_values
+            and k > 0
+        )
         try:
-            scores, gids, total = sharded_execute(
-                idx.mesh,
-                idx.axis,
-                idx.seg_stacked,
-                compiled.arrays,
-                compiled.spec,
-                k,
-                idx.docs_per_shard,
-            )
-            scores, gids = np.asarray(scores), np.asarray(gids)
+            if plain:
+                # The hot plain-score path keeps the candidate-centric
+                # sparse kernel (no dense planes, no agg planes).
+                scores, gids, total = sharded_execute(
+                    idx.mesh,
+                    idx.axis,
+                    idx.seg_stacked,
+                    compiled.arrays,
+                    compiled.spec,
+                    k,
+                    idx.docs_per_shard,
+                )
+                keys = vals = None
+                n_after = total
+                agg_out = ()
+            else:
+                keys, vals, gids, total, n_after, agg_out = (
+                    sharded_execute_request(
+                        idx.mesh,
+                        idx.axis,
+                        idx.seg_stacked,
+                        compiled.arrays,
+                        compiled.spec,
+                        k,
+                        idx.docs_per_shard,
+                        sort_field=sort_field,
+                        sort_desc=sort_desc,
+                        missing_first=missing_first,
+                        has_after=has_after,
+                        after_key=after_key,
+                        after_doc=after_doc,
+                        aggs_spec=aggs_spec,
+                        aggs_arrays_stacked=aggs_arrays,
+                    )
+                )
+                scores = vals
+            import jax
+
+            scores = np.asarray(scores) if scores is not None else None
+            gids = np.asarray(gids)
+            agg_np = jax.device_get(agg_out)
+            total = int(total)
+            n_after = int(n_after)
         # staticcheck: ignore[broad-except] execute failures (incl. injected ones) must feed the mesh circuit breaker and fall back — the breaker's error classification is the tested behavior
         except Exception as e:
             # Execute-stage failure (XLA lowering, device OOM holding the
@@ -580,29 +839,59 @@ class MeshView:
             # sticky (compile/parity) failures latch off for good.
             self.exec_failures += 1
             self.breaker.record_failure(e)
-            return None
+            return self._fallback("execute_error")
         self.breaker.record_success()
-        total = int(total)
         self.served += 1
+        shape = "plain" if sort_field is None else "sorted"
+        if aggs_spec is not None:
+            shape = shape + "_aggs" if k > 0 else "aggs_only"
+        elif k == 0:
+            shape = "count_only"
+        self.metrics.counter(
+            "estpu_mesh_served_total",
+            "Searches served by the one-launch SPMD program, by shape",
+            shape=shape,
+        ).inc()
         if self.planner is not None:
             self.planner.record(
-                ("mesh", compiled.spec, k),
+                ("mesh", compiled.spec, k, sort_field, aggs_spec is not None),
                 "mesh_spmd",
                 time.monotonic() - start,
             )
+        aggregations = None
+        if agg is not None:
+            from ..search.aggs import merge_mesh_result
+
+            states = [new_merge_state(n) for n in request.aggs]
+            for node, state, res in zip(request.aggs, states, agg_np):
+                merge_mesh_result(node, state, res, snap.handles)
+            aggregations = agg.render_states(states)
         timed_out = bool(task is not None and task.check_deadline())
-        n = min(k, total, len(scores))
-        max_score = float(scores[0]) if n > 0 else None
+        limit = n_after if has_after else total
+        n = min(k, limit, len(gids))
+        max_score = None
+        if request.sort is None and scores is not None and n > 0:
+            max_score = float(scores[0])
         hits = []
         svc = coordinator.services[0]
         for rank in range(max(0, request.from_), n):
             shard, local = idx.locate(int(gids[rank]))
             handle = snap.handles[shard]
+            score = None
+            sort_out = None
+            if sort_field is not None:
+                raw = float(scores[rank])
+                sort_out = [None if np.isnan(scores[rank]) else raw]
+            else:
+                score = float(scores[rank])
+                if want_sort_values:
+                    sort_out = [score]
             hits.append(
                 SearchHit(
                     doc_id=handle.segment.ids[local],
-                    score=float(scores[rank]),
+                    score=score,
                     source=svc._fetch_source(handle, local, request),
+                    sort=sort_out,
                     global_doc=-1,
                     handle=handle,
                     local=local,
@@ -616,6 +905,7 @@ class MeshView:
             total_relation=relation,
             max_score=max_score,
             hits=hits,
+            aggregations=aggregations,
             shards=len(self.engines),
             timed_out=timed_out,
         )

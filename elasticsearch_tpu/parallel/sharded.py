@@ -316,56 +316,59 @@ class ShardedIndex:
         """
         return float(fstats.avgdl) if fstats else 1.0
 
+    def shard_compiler(self, shard: int, nt_floor: int = 1) -> Compiler:
+        """Host-side planning view for one shard over the same offsets the
+        device sees — the per-shard Compiler behind `compile`, also used
+        by the mesh serving path to lower aggregation plans (filter-agg
+        sub-queries) into shard-uniform specs."""
+        stats = self.field_stats()
+        seg = self.segments[shard]
+        fields = {}
+        for name, fld in seg.fields.items():
+            postings = len(fld.doc_ids)
+            nt = postings // TILE + 2
+            fstats = stats.get(name)
+            b_lo, b_hi = self._field_tile_bounds(shard, name)
+            fields[name] = _PlanField(
+                tile_doc_lo=b_lo,
+                tile_doc_hi=b_hi,
+                name=name,
+                terms=fld.terms,
+                df=fld.df,
+                offsets=fld.offsets,
+                doc_count=fld.doc_count,
+                sum_total_tf=fld.sum_total_tf,
+                has_norms=fld.has_norms,
+                num_tiles_=max(nt, 0),
+                # Impacts validity scope: see _tn_avgdl. When it matches
+                # the stats avgdl the fast (precomputed-impact) kernel
+                # applies; otherwise the gather kernel recomputes
+                # impacts from tf + norm bytes with the current stats.
+                tn_avgdl=self._tn_avgdl(shard, name, fstats),
+                tn_k1=self.params.k1,
+                tn_b=self.params.b,
+                pos_offsets=fld.pos_offsets,
+                pos_num_tiles_=(
+                    len(fld.positions) // TILE + 2
+                    if fld.positions is not None
+                    else 0
+                ),
+            )
+        return Compiler(
+            fields=fields,
+            doc_values={name: None for name in seg.doc_values},
+            mappings=self.mappings,
+            params=self.params,
+            stats=stats,
+            nt_floor=nt_floor,
+            id_index=lambda s=shard: self._id_index(s),
+        )
+
     def compile(self, query: Query, nt_floor: int = 1) -> CompiledQuery:
         """Compile per shard with uniform buckets; stack arrays on axis 0."""
-        stats = self.field_stats()
-
-        def shard_compiler(seg: Segment, floor: int, shard: int) -> Compiler:
-            # Host-side planning view over the same offsets the device sees.
-            fields = {}
-            for name, fld in seg.fields.items():
-                postings = len(fld.doc_ids)
-                nt = postings // TILE + 2
-                fstats = stats.get(name)
-                b_lo, b_hi = self._field_tile_bounds(shard, name)
-                fields[name] = _PlanField(
-                    tile_doc_lo=b_lo,
-                    tile_doc_hi=b_hi,
-                    name=name,
-                    terms=fld.terms,
-                    df=fld.df,
-                    offsets=fld.offsets,
-                    doc_count=fld.doc_count,
-                    sum_total_tf=fld.sum_total_tf,
-                    has_norms=fld.has_norms,
-                    num_tiles_=max(nt, 0),
-                    # Impacts validity scope: see _tn_avgdl. When it matches
-                    # the stats avgdl the fast (precomputed-impact) kernel
-                    # applies; otherwise the gather kernel recomputes
-                    # impacts from tf + norm bytes with the current stats.
-                    tn_avgdl=self._tn_avgdl(shard, name, fstats),
-                    tn_k1=self.params.k1,
-                    tn_b=self.params.b,
-                    pos_offsets=fld.pos_offsets,
-                    pos_num_tiles_=(
-                        len(fld.positions) // TILE + 2
-                        if fld.positions is not None
-                        else 0
-                    ),
-                )
-            return Compiler(
-                fields=fields,
-                doc_values={name: None for name in seg.doc_values},
-                mappings=self.mappings,
-                params=self.params,
-                stats=stats,
-                nt_floor=floor,
-                id_index=lambda s=shard: self._id_index(s),
-            )
-
         first = [
-            shard_compiler(seg, nt_floor, i).compile(query)
-            for i, seg in enumerate(self.segments)
+            self.shard_compiler(i, nt_floor).compile(query)
+            for i in range(len(self.segments))
         ]
         specs_match = len({c.spec for c in first}) == 1
         if not specs_match:
@@ -379,8 +382,8 @@ class ShardedIndex:
             except SpecUnifyError:
                 nt_max = max(_max_nt(c.spec) for c in first)
                 first = [
-                    shard_compiler(seg, nt_max, i).compile(query)
-                    for i, seg in enumerate(self.segments)
+                    self.shard_compiler(i, nt_max).compile(query)
+                    for i in range(len(self.segments))
                 ]
             if len({c.spec for c in first}) != 1:
                 raise AssertionError(
@@ -634,6 +637,148 @@ def sharded_execute(
         in_specs=(P(axis), P(axis)),
         out_specs=(P(), P(), P()),
     )(seg_stacked, arrays_stacked)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "axis", "spec", "k", "docs_per_shard", "sort_field",
+        "sort_desc", "missing_first", "has_after", "aggs_spec",
+    ),
+)
+def sharded_execute_request(
+    mesh: Mesh,
+    axis: str,
+    seg_stacked,
+    arrays_stacked,
+    spec,
+    k: int,
+    docs_per_shard: int,
+    sort_field: str | None = None,
+    sort_desc: bool = False,
+    missing_first: bool = False,
+    has_after: bool = False,
+    after_key=0.0,
+    after_doc=0,
+    aggs_spec: tuple | None = None,
+    aggs_arrays_stacked=(),
+):
+    """One shard_map launch serving a full query phase: scoring, sorted or
+    score-ordered top-k with search_after cursor masking, psum'd totals,
+    AND the aggregation planes — the whole coordinator reduce as in-program
+    collectives (SearchPhaseController.java:477 / FieldSortBuilder merged
+    into the XLA program).
+
+    - Field sorts rank by the transformed ascending (sort key, shard, doc)
+      composite: keys via ops.bm25_device.sort_key_plane (desc negation,
+      missing pinned first/last), the (shard, doc) tiebreak implicit in
+      jax.lax.top_k's stable lower-flat-index-first ordering over the
+      all-gathered [shard, k] key planes — bit-identical hit order to the
+      host-loop FieldSortBuilder-style merge.
+    - search_after applies as a key-range mask BEFORE the local top-k (the
+      next page may lie beyond a shard's uncursored top-k). `after_doc` is
+      mesh-global (shard * docs_per_shard + local); key-only public
+      cursors pass n_shards * docs_per_shard so key ties never qualify.
+    - Aggregations evaluate off the shared eligibility mask exactly like
+      the single-segment program (ops/aggs_device.execute_aggs); integer
+      count planes (histogram/range buckets, filter-family doc_counts) are
+      psum-combined IN PROGRAM (exact — int addition is grouping-free),
+      while per-shard planes (masks for the f64-exact metric finish,
+      keyword ordinal counts) come back stacked [S, ...] from the same
+      launch for the host fold.
+
+    Returns (merge keys f32[k'] ascending, sort values f32[k'] (raw column
+    values / scores), global ids i32[k'], total i32[], n_after i32[],
+    agg results pytree with leading shard axis).
+    """
+    from ..ops.aggs_device import _eval_agg, mesh_combine
+
+    def body(seg, arrays, agg_arrays, a_key, a_doc):
+        seg = jax.tree.map(lambda x: x[0], seg)
+        arrays = jax.tree.map(lambda x: x[0], arrays)
+        agg_arrays = jax.tree.map(lambda x: x[0], agg_arrays)
+        live = seg["live"]
+        n = live.shape[0]
+        scores, matched = _eval_node(spec, arrays, seg, n)
+        eligible = matched & live
+        count = jnp.sum(eligible, dtype=jnp.int32)
+        total = jax.lax.psum(count, axis)
+        shard_id = jax.lax.axis_index(axis).astype(jnp.int32)
+        if k > 0:
+            from ..ops.bm25_device import sort_key_plane
+
+            kk = min(k, n)
+            iota = jnp.arange(n, dtype=jnp.int32)
+            local_after = a_doc - shard_id * docs_per_shard
+            if sort_field is not None:
+                col, key = sort_key_plane(
+                    seg, sort_field, sort_desc, missing_first
+                )
+                keep = eligible
+                if has_after:
+                    keep = keep & (
+                        (key > a_key)
+                        | ((key == a_key) & (iota > local_after))
+                    )
+                masked = jnp.where(keep, key, jnp.float32(jnp.inf))
+                neg, ids = jax.lax.top_k(-masked, kk)
+                local_key = -neg  # ascending merge-key space
+                local_val = col[ids]  # raw values (NaN = missing)
+            else:
+                keep = eligible
+                if has_after:
+                    keep = keep & (
+                        (scores < a_key)
+                        | ((scores == a_key) & (iota > local_after))
+                    )
+                masked = jnp.where(keep, scores, jnp.float32(NEG_INF))
+                top_s, ids = jax.lax.top_k(masked, kk)
+                local_key = -top_s  # score desc == key asc
+                local_val = top_s
+            n_after = jnp.sum(keep, dtype=jnp.int32)
+            gids = shard_id * docs_per_shard + ids.astype(jnp.int32)
+            all_key = jax.lax.all_gather(local_key, axis).reshape(-1)
+            all_val = jax.lax.all_gather(local_val, axis).reshape(-1)
+            all_gid = jax.lax.all_gather(gids, axis).reshape(-1)
+            m = min(k, all_key.shape[0])
+            # Stable top-k over -key: equal keys favor the lower flat
+            # index = (shard, per-shard rank) — the host merge tiebreak.
+            neg_top, idxm = jax.lax.top_k(-all_key, m)
+            out_key = -neg_top
+            out_val = all_val[idxm]
+            out_gid = all_gid[idxm]
+            n_after_total = jax.lax.psum(n_after, axis)
+        else:  # agg-only / count-only request: no hits merge at all
+            out_key = jnp.zeros(0, dtype=jnp.float32)
+            out_val = jnp.zeros(0, dtype=jnp.float32)
+            out_gid = jnp.zeros(0, dtype=jnp.int32)
+            n_after_total = jnp.zeros((), dtype=jnp.int32)
+        if aggs_spec is not None:
+            results = tuple(
+                _eval_agg(s, a, seg, eligible, scores, n)
+                for s, a in zip(aggs_spec, agg_arrays)
+            )
+            results = mesh_combine(aggs_spec, results, axis)
+            # Leading [1, ...] axis so P(axis) out-specs stack per-shard
+            # planes to [S, ...]; psum'd (replicated) leaves stack to
+            # identical rows — the host reads row 0 for those.
+            agg_out = jax.tree.map(lambda x: x[None], results)
+        else:
+            agg_out = ()
+        return out_key, out_val, out_gid, total, n_after_total, agg_out
+
+    return _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(), P()),
+        out_specs=(P(), P(), P(), P(), P(), P(axis)),
+    )(
+        seg_stacked,
+        arrays_stacked,
+        aggs_arrays_stacked,
+        jnp.float32(after_key),
+        jnp.int32(after_doc),
+    )
 
 
 @partial(

@@ -270,7 +270,8 @@ class Aggregator:
     """
 
     def __init__(self, engine, nodes: list[AggNode], handles=None,
-                 index_name: str = "index"):
+                 index_name: str = "index", term_pads=None,
+                 range_handles=None):
         self.engine = engine
         self.nodes = nodes
         self.index_name = index_name
@@ -279,6 +280,21 @@ class Aggregator:
         # desynchronize totals from hits).
         segments = engine.segments if handles is None else handles
         self.handles = [h for h in segments if h.segment.num_docs > 0]
+        # Uniform keyword ordinal-plane pads: {field: pow2 bucket}. The
+        # mesh serving path compiles ONE agg program over every shard, so
+        # the scatter width must cover the largest shard vocabulary; the
+        # per-handle pow2 default keeps solo-segment behavior.
+        self.term_pads = term_pads or {}
+        # Histogram planning scope: the handles whose column ranges size
+        # fixed-interval bucket windows. The mesh path plans over the
+        # PINNED ENGINE handles (tombstoned values included, like the
+        # host-loop coordinator) while executing over merged shard
+        # segments — the rendered buckets are identical either way (only
+        # occupied buckets render), but the plan-time TooManyBuckets
+        # behavior must match the host path exactly.
+        self.range_handles = range_handles if range_handles is not None else (
+            self.handles
+        )
         # Per-request plan state, keyed by id(node) — names are not unique
         # across nesting levels (a filter-nested histogram may shadow a
         # top-level one of the same name).
@@ -286,7 +302,7 @@ class Aggregator:
         self._range_cache: dict[str, tuple[float, float]] = {}
 
     def _field_range(self, fname: str) -> tuple[float, float]:
-        """Global [min, max] of a numeric column over the snapshot's
+        """Global [min, max] of a numeric column over the planning scope's
         segments, lazily computed only for fields histogram aggs plan over
         (host columns are float64; quantize to f32 = stored-value
         semantics)."""
@@ -294,9 +310,9 @@ class Aggregator:
         if cached is not None:
             return cached
         lo, hi = np.inf, -np.inf
-        for h in self.handles:
+        for h in self.range_handles:
             col = h.segment.doc_values.get(fname)
-            if col is None or np.all(np.isnan(col)):
+            if col is None or not len(col) or np.all(np.isnan(col)):
                 continue
             lo = min(lo, float(np.float32(np.nanmin(col))))
             hi = max(hi, float(np.float32(np.nanmax(col))))
@@ -304,6 +320,14 @@ class Aggregator:
             lo, hi = 0.0, 0.0
         self._range_cache[fname] = (lo, hi)
         return lo, hi
+
+    def _term_pad(self, handle, fname: str) -> int:
+        """Ordinal scatter width for a keyword field: the handle's own
+        pow2 vocabulary bucket, or the caller-injected uniform pad."""
+        override = self.term_pads.get(fname)
+        if override is not None:
+            return override
+        return _pow2(handle.device.fields[fname].num_terms)
 
     # ----------------------------------------------------------- compile
 
@@ -416,7 +440,7 @@ class Aggregator:
         if k == "cardinality":
             fname = p["field"]
             if self._keyword_ok(handle, fname):
-                tp = _pow2(handle.device.fields[fname].num_terms)
+                tp = self._term_pad(handle, fname)
                 return ("terms", fname, tp, ()), {}
             if self._is_text(handle, fname):
                 raise AggParsingError(
@@ -437,7 +461,7 @@ class Aggregator:
                     "[rare_terms] sub-aggregations are not supported yet"
                 )
             if self._keyword_ok(handle, fname):
-                tp = _pow2(handle.device.fields[fname].num_terms)
+                tp = self._term_pad(handle, fname)
                 return ("terms", fname, tp, ()), {}
             if self._is_text(handle, fname):
                 raise AggParsingError(
@@ -448,7 +472,7 @@ class Aggregator:
         if k == "significant_terms":
             fname = p["field"]
             if self._keyword_ok(handle, fname):
-                tp = _pow2(handle.device.fields[fname].num_terms)
+                tp = self._term_pad(handle, fname)
                 spec = ("sig_terms", fname, tp, self._sub_fields(node, handle))
                 return spec + self._want_mask(node), {}
             if self._is_text(handle, fname):
@@ -466,7 +490,7 @@ class Aggregator:
         if k == "terms":
             fname = p["field"]
             if self._keyword_ok(handle, fname):
-                tp = _pow2(handle.device.fields[fname].num_terms)
+                tp = self._term_pad(handle, fname)
                 spec = ("terms", fname, tp, self._sub_fields(node, handle))
                 return spec + self._want_mask(node), {}
             if self._is_text(handle, fname):
@@ -665,7 +689,21 @@ class Aggregator:
     # ----------------------------------------------------------- execute
 
     def run(self, query, stats=None, task=None) -> tuple[int, dict[str, Any]]:
-        """Execute over every segment; returns (total_hits, rendered aggs).
+        """Execute over every segment; returns (total_hits, rendered aggs)."""
+        total, states = self.run_states(query, stats=stats, task=task)
+        return total, self.render_states(states)
+
+    def render_states(self, states) -> dict[str, Any]:
+        """Render merged states to the ES response shape."""
+        return {
+            node.name: render(
+                node, state, self.engine, self._plan, self.index_name
+            )
+            for node, state in zip(self.nodes, states)
+        }
+
+    def run_states(self, query, stats=None, task=None) -> tuple[int, list]:
+        """Execute over every segment; returns (total_hits, merge states).
 
         One XLA program per segment evaluates the query once and every
         aggregation off the shared matched mask (the reference's
@@ -674,7 +712,9 @@ class Aggregator:
         happens here on the host, the coordinator-reduce analog. When hits
         are also requested the top-k pass runs separately (its kernel is the
         benched fast path); `stats` lets the caller share the shard-level
-        statistics between the two passes."""
+        statistics between the two passes. The pre-render states are the
+        mergeable form the replicated cluster coordinator reduces across
+        shard copies (state_to_wire / merge_wire_states)."""
         import jax
 
         from ..ops import aggs_device
@@ -708,13 +748,7 @@ class Aggregator:
                 merge_segment_result(
                     node, state, result, handle, root_planes=root_planes
                 )
-        rendered = {
-            node.name: render(
-                node, state, self.engine, self._plan, self.index_name
-            )
-            for node, state in zip(self.nodes, states)
-        }
-        return total, rendered
+        return total, states
 
 
 def _filters_defs(node: AggNode) -> tuple[list[str] | None, list[dict]]:
@@ -809,6 +843,26 @@ def _host_values(result, handle, fname: str) -> np.ndarray:
     return vals[~np.isnan(vals)]
 
 
+def _fold_metric_values(state, vals: np.ndarray) -> None:
+    """Fold one segment's (or one mesh handle-span's) matched f64 values
+    into a metric merge state — the single fold both the host loop and
+    the mesh path apply, in the same per-segment order, so their f64
+    partial sums are bit-identical."""
+    state["count"] += len(vals)
+    if len(vals):
+        state["sum"] += float(np.sum(vals))
+        state["min"] = min(state["min"], float(np.min(vals)))
+        state["max"] = max(state["max"], float(np.max(vals)))
+        state["sumsq"] += float(np.sum(vals * vals))
+
+
+def _fold_chunk_values(state, vals: np.ndarray) -> None:
+    """Percentile-family fold: keep the raw f64 chunk (render sorts the
+    concatenation, so chunk boundaries never affect the result)."""
+    if len(vals):
+        state["chunks"].append(vals)
+
+
 def merge_segment_result(
     node: AggNode, state, result, handle, root_planes=None
 ) -> None:
@@ -817,18 +871,14 @@ def merge_segment_result(
     if k in METRIC_KINDS | {"extended_stats"}:
         # f64-exact host reduce over the matched mask (the device f32 sum
         # plane drifts user-visibly at 1M+ docs; InternalSum.java:22).
-        vals = _host_values(result, handle, node.params["field"])
-        state["count"] += len(vals)
-        if len(vals):
-            state["sum"] += float(np.sum(vals))
-            state["min"] = min(state["min"], float(np.min(vals)))
-            state["max"] = max(state["max"], float(np.max(vals)))
-            state["sumsq"] += float(np.sum(vals * vals))
+        _fold_metric_values(
+            state, _host_values(result, handle, node.params["field"])
+        )
         return
     if k in ("percentiles", "percentile_ranks", "median_absolute_deviation"):
-        vals = _host_values(result, handle, node.params["field"])
-        if len(vals):
-            state["chunks"].append(vals)
+        _fold_chunk_values(
+            state, _host_values(result, handle, node.params["field"])
+        )
         return
     if k == "top_hits":
         n = handle.segment.num_docs
@@ -1008,6 +1058,116 @@ def merge_segment_result(
                 )
         return
     raise AggParsingError(f"unknown aggregation type [{k}]")
+
+
+# ------------------------------------------------------ mesh (SPMD) merge
+
+
+def mesh_agg_ineligible_reason(nodes: list[AggNode]) -> str | None:
+    """Why this agg tree cannot ride the one-launch SPMD mesh program
+    (None = eligible). Eligible kinds are exactly those whose combine is
+    bit-identical to the host loop's: the metric family and percentile
+    family (per-shard masks from the launch + the same f64 host fold in
+    handle-span order), integer-count planes (fixed-edge histogram /
+    date_histogram / range, psum'd in program — int addition is
+    grouping-free), keyword/numeric terms, rare_terms and cardinality
+    (integer counts / distinct sets merged by key on the host), and the
+    filter/filters/global/missing nesting family over eligible subs.
+
+    Ineligible: array-bucket hosts with metric sub-aggs (their f32 device
+    planes accumulate in per-segment order — a merged-shard scatter would
+    drift last bits vs the host loop), top_hits, composite, matrix_stats,
+    and significant_terms (its background statistics come from tombstoned
+    engine segments the mesh snapshot doesn't carry)."""
+    for node in nodes:
+        k = node.kind
+        if k in METRIC_KINDS | HOST_METRIC_KINDS or k == "cardinality":
+            continue
+        if k in ("terms", "rare_terms", "histogram", "date_histogram",
+                 "range"):
+            if node.subs:
+                return "agg_shape"
+            continue
+        if k in NESTING_KINDS:
+            reason = mesh_agg_ineligible_reason(node.subs)
+            if reason:
+                return reason
+            continue
+        return "agg_shape"
+    return None
+
+
+def merge_mesh_result(node: AggNode, state, stacked, handles) -> None:
+    """Fold one agg node's stacked mesh-launch result ([shard, ...]
+    planes; psum-combined count leaves replicated across the axis) into a
+    merge state BIT-IDENTICALLY to the host loop's per-segment fold.
+
+    `handles` are the mesh shard handles (one merged live-doc segment per
+    shard) carrying `spans`: the handle-boundary offsets of the original
+    engine segments inside the merged doc space. Metric folds walk spans
+    in shard-then-handle order, reproducing the exact f64 partial-sum
+    grouping of the host path."""
+    k = node.kind
+    if k in METRIC_KINDS | {"extended_stats"} or k in (
+        "percentiles", "percentile_ranks", "median_absolute_deviation"
+    ):
+        fold = (
+            _fold_chunk_values
+            if k in ("percentiles", "percentile_ranks",
+                     "median_absolute_deviation")
+            else _fold_metric_values
+        )
+        fname = node.params["field"]
+        masks = np.asarray(stacked["mask"])
+        for s, handle in enumerate(handles):
+            col = handle.segment.doc_values.get(fname)
+            if col is None or not len(col):
+                continue
+            mask = masks[s][: handle.segment.num_docs]
+            for lo, hi in handle.spans:
+                vals = col[lo:hi][mask[lo:hi]]
+                fold(state, vals[~np.isnan(vals)])
+        return
+    if k in ("cardinality", "terms", "rare_terms"):
+        # Integer counts / distinct values keyed by shard-local
+        # vocabularies: the existing per-segment merge applies verbatim,
+        # one merged segment per shard.
+        import jax
+
+        for s, handle in enumerate(handles):
+            row = jax.tree.map(lambda x: np.asarray(x)[s], stacked)
+            merge_segment_result(node, state, row, handle)
+        return
+    if k in ("histogram", "date_histogram", "range"):
+        # Counts were psum'd IN PROGRAM (replicated rows): read once.
+        state["counts"] = np.asarray(stacked["counts"])[0].astype(np.int64)
+        return
+    if k in ("filter", "global", "missing"):
+        state["doc_count"] += int(np.asarray(stacked["doc_count"])[0])
+        for sub_node, sub_state, sub_stacked in zip(
+            node.subs, state["subs"], stacked["subs"]
+        ):
+            merge_mesh_result(sub_node, sub_state, sub_stacked, handles)
+        return
+    if k == "filters":
+        if state["buckets"] is None:
+            state["buckets"] = [
+                {
+                    "doc_count": 0,
+                    "subs": [new_merge_state(s) for s in node.subs],
+                }
+                for _ in stacked
+            ]
+        for bstate, bstacked in zip(state["buckets"], stacked):
+            bstate["doc_count"] += int(np.asarray(bstacked["doc_count"])[0])
+            for sub_node, sub_state, sub_stacked in zip(
+                node.subs, bstate["subs"], bstacked["subs"]
+            ):
+                merge_mesh_result(sub_node, sub_state, sub_stacked, handles)
+        return
+    raise AggParsingError(
+        f"aggregation type [{k}] is not mesh-eligible"
+    )
 
 
 def _capture_hits_planes(node, state, handle, result, root_planes) -> None:
@@ -1882,3 +2042,463 @@ def _render_histogram(
                     )
         out.append(b)
     return {"buckets": out}
+
+
+# ----------------------------------------- replicated (cross-node) reduce
+#
+# The replicated cluster serves aggregations by reducing MERGE STATES at
+# the coordinator (the wire analog of InternalAggregations.topLevelReduce):
+# each shard copy runs its own device agg pass (shard-local statistics,
+# like the rest of the replicated query phase), serializes its pre-render
+# states to a JSON-shaped wire form, and the coordinator folds them by
+# key and renders once. Integer counts merge exactly; float metric sums
+# fold f64 per shard state in shard order.
+
+
+def _is_calendar(node: AggNode) -> bool:
+    unit = node.params.get("calendar_interval") or node.params.get(
+        "fixed_interval"
+    ) or node.params.get("interval")
+    return str(unit) in (
+        "month", "1M", "M", "quarter", "1q", "q", "year", "1y", "y"
+    )
+
+
+def wire_agg_ineligible_reason(nodes: list[AggNode]) -> str | None:
+    """Why this agg tree cannot serve on a replicated index (None =
+    eligible). Kinds whose merge states don't serialize (top_hits pins
+    segment handles), whose bucket planes don't key-align across
+    independently-planned shards (calendar date_histogram, composite), or
+    whose reduce needs whole-corpus moments (matrix_stats) still 400."""
+    for node in nodes:
+        k = node.kind
+        if k == "top_hits" or any(s.kind == "top_hits" for s in node.subs):
+            return "top_hits aggregations"
+        if k in ("composite", "matrix_stats"):
+            return f"[{k}] aggregations"
+        if k == "date_histogram" and _is_calendar(node):
+            return "calendar-interval date_histogram aggregations"
+        if k in NESTING_KINDS:
+            reason = wire_agg_ineligible_reason(node.subs)
+            if reason:
+                return reason
+    return None
+
+
+def _wire_num(v) -> float | None:
+    v = float(v)
+    return None if not np.isfinite(v) else v
+
+
+def _unwire_num(v, default: float) -> float:
+    return default if v is None else float(v)
+
+
+def _planes_to_wire(planes: dict) -> dict:
+    return {
+        "count": int(planes["count"]),
+        "sum": float(planes["sum"]),
+        "min": _wire_num(planes["min"]),
+        "max": _wire_num(planes["max"]),
+        "sumsq": float(planes.get("sumsq", 0.0)),
+    }
+
+
+def _planes_from_wire(w: dict) -> dict:
+    return {
+        "count": int(w["count"]),
+        "sum": float(w["sum"]),
+        "min": _unwire_num(w["min"], np.inf),
+        "max": _unwire_num(w["max"], -np.inf),
+        "sumsq": float(w.get("sumsq", 0.0)),
+    }
+
+
+def _merge_planes(dst: dict, src: dict) -> None:
+    dst["count"] += src["count"]
+    dst["sum"] += src["sum"]
+    dst["min"] = min(dst["min"], src["min"])
+    dst["max"] = max(dst["max"], src["max"])
+    dst["sumsq"] = dst.get("sumsq", 0.0) + src.get("sumsq", 0.0)
+
+
+def _subs_to_wire(subs: dict) -> dict:
+    return {
+        f: [[key, _planes_to_wire(p)] for key, p in by_key.items()]
+        for f, by_key in subs.items()
+    }
+
+
+def _subs_from_wire(w: dict) -> dict:
+    return {
+        f: {
+            (tuple(key) if isinstance(key, list) else key):
+                _planes_from_wire(p)
+            for key, p in pairs
+        }
+        for f, pairs in w.items()
+    }
+
+
+def state_to_wire(node: AggNode, state, plan: dict) -> Any:
+    """One shard's merge state as a JSON-shaped wire payload."""
+    k = node.kind
+    if k in METRIC_KINDS | {"extended_stats"}:
+        return {
+            "count": state["count"],
+            "sum": float(state["sum"]),
+            "min": _wire_num(state["min"]),
+            "max": _wire_num(state["max"]),
+            "sumsq": float(state["sumsq"]),
+        }
+    if k in ("percentiles", "percentile_ranks", "median_absolute_deviation"):
+        vals = (
+            np.concatenate(state["chunks"]) if state["chunks"] else
+            np.zeros(0)
+        )
+        return {"values": [float(v) for v in vals]}
+    if k == "cardinality":
+        return {"values": sorted(state["values"], key=repr)}
+    if k in ("terms", "rare_terms"):
+        return {
+            "counts": [[key, int(c)] for key, c in state["counts"].items()],
+            "host": bool(state.get("host")),
+            "subs": _subs_to_wire(state.get("subs", {})),
+        }
+    if k == "significant_terms":
+        return {
+            "counts": [[key, int(c)] for key, c in state["counts"].items()],
+            "bg_df": [[key, int(c)] for key, c in state["bg_df"].items()],
+            "doc_count": int(state["doc_count"]),
+            "bg_total": int(state["bg_total"]),
+            "subs": _subs_to_wire(state.get("subs", {})),
+        }
+    if k in ("histogram", "date_histogram"):
+        params = plan.get("hist_params", {}).get(id(node))
+        counts = state["counts"]
+        if params is None or counts is None:
+            return {"m_counts": [], "interval": None, "offset": 0.0,
+                    "subs": {}}
+        interval, offset, base = params
+        m_counts = [
+            [int(base) + i, int(c)]
+            for i, c in enumerate(np.asarray(counts)) if c
+        ]
+        subs = {}
+        for f, planes in state.get("subs", {}).items():
+            rows = []
+            for i in range(len(np.asarray(counts))):
+                p = {
+                    "count": int(planes["count"][i]),
+                    "sum": float(planes["sum"][i]),
+                    "min": float(planes["min"][i]),
+                    "max": float(planes["max"][i]),
+                }
+                if p["count"]:
+                    rows.append([int(base) + i, _planes_to_wire(p)])
+            subs[f] = rows
+        return {
+            "m_counts": m_counts,
+            "interval": float(interval),
+            "offset": float(offset),
+            "subs": subs,
+        }
+    if k == "range":
+        counts = state["counts"]
+        subs = {}
+        for f, planes in state.get("subs", {}).items():
+            subs[f] = {
+                "count": [int(v) for v in planes["count"]],
+                "sum": [float(v) for v in planes["sum"]],
+                "min": [_wire_num(v) for v in planes["min"]],
+                "max": [_wire_num(v) for v in planes["max"]],
+            }
+        return {
+            "counts": (
+                None if counts is None else [int(v) for v in counts]
+            ),
+            "subs": subs,
+        }
+    if k in ("filter", "global", "missing"):
+        return {
+            "doc_count": int(state["doc_count"]),
+            "subs": [
+                state_to_wire(s, st, plan)
+                for s, st in zip(node.subs, state["subs"])
+            ],
+        }
+    if k == "filters":
+        if state["buckets"] is None:
+            return {"buckets": None}
+        return {
+            "buckets": [
+                {
+                    "doc_count": int(b["doc_count"]),
+                    "subs": [
+                        state_to_wire(s, st, plan)
+                        for s, st in zip(node.subs, b["subs"])
+                    ],
+                }
+                for b in state["buckets"]
+            ]
+        }
+    raise AggParsingError(
+        f"aggregation type [{k}] has no wire state (replicated serving)"
+    )
+
+
+def merge_wire_states(node: AggNode, acc, new):
+    """Fold one shard's wire state into the coordinator accumulator (None
+    accumulator adopts the first shard's state)."""
+    k = node.kind
+    if acc is None:
+        # Adopt a structural copy so later folds never mutate the
+        # transport payload in place.
+        import copy
+
+        return copy.deepcopy(new)
+    if k in METRIC_KINDS | {"extended_stats"}:
+        acc["count"] += new["count"]
+        acc["sum"] += new["sum"]
+        a, b = acc.get("min"), new.get("min")
+        acc["min"] = b if a is None else a if b is None else min(a, b)
+        a, b = acc.get("max"), new.get("max")
+        acc["max"] = b if a is None else a if b is None else max(a, b)
+        acc["sumsq"] += new["sumsq"]
+        return acc
+    if k in ("percentiles", "percentile_ranks", "median_absolute_deviation"):
+        acc["values"].extend(new["values"])
+        return acc
+    if k == "cardinality":
+        acc["values"] = sorted(
+            set(map(_hashable, acc["values"]))
+            | set(map(_hashable, new["values"])),
+            key=repr,
+        )
+        return acc
+    if k in ("terms", "rare_terms", "significant_terms"):
+        for field in ("counts",) + (("bg_df",) if k == "significant_terms" else ()):
+            got = {_hashable(key): c for key, c in acc[field]}
+            for key, c in new[field]:
+                key = _hashable(key)
+                got[key] = got.get(key, 0) + c
+            acc[field] = [[key, c] for key, c in got.items()]
+        if k == "significant_terms":
+            acc["doc_count"] += new["doc_count"]
+            acc["bg_total"] += new["bg_total"]
+        else:
+            acc["host"] = bool(acc.get("host")) or bool(new.get("host"))
+        acc["subs"] = _merge_wire_subs(acc.get("subs", {}), new.get("subs", {}))
+        return acc
+    if k in ("histogram", "date_histogram"):
+        got = {m: c for m, c in acc["m_counts"]}
+        for m, c in new["m_counts"]:
+            got[m] = got.get(m, 0) + c
+        acc["m_counts"] = sorted([[m, c] for m, c in got.items()])
+        if acc.get("interval") is None:
+            acc["interval"] = new.get("interval")
+            acc["offset"] = new.get("offset", 0.0)
+        acc["subs"] = _merge_wire_subs(acc.get("subs", {}), new.get("subs", {}))
+        return acc
+    if k == "range":
+        if new["counts"] is not None:
+            if acc["counts"] is None:
+                acc["counts"] = list(new["counts"])
+            else:
+                acc["counts"] = [
+                    a + b for a, b in zip(acc["counts"], new["counts"])
+                ]
+        for f, planes in new.get("subs", {}).items():
+            cur = acc.setdefault("subs", {}).get(f)
+            if cur is None:
+                acc["subs"][f] = {
+                    key: list(v) for key, v in planes.items()
+                }
+                continue
+            cur["count"] = [a + b for a, b in zip(cur["count"], planes["count"])]
+            cur["sum"] = [a + b for a, b in zip(cur["sum"], planes["sum"])]
+            cur["min"] = [
+                _wire_num(min(_unwire_num(a, np.inf), _unwire_num(b, np.inf)))
+                for a, b in zip(cur["min"], planes["min"])
+            ]
+            cur["max"] = [
+                _wire_num(max(_unwire_num(a, -np.inf), _unwire_num(b, -np.inf)))
+                for a, b in zip(cur["max"], planes["max"])
+            ]
+        return acc
+    if k in ("filter", "global", "missing"):
+        acc["doc_count"] += new["doc_count"]
+        acc["subs"] = [
+            merge_wire_states(s, a, b)
+            for s, a, b in zip(node.subs, acc["subs"], new["subs"])
+        ]
+        return acc
+    if k == "filters":
+        if new["buckets"] is None:
+            return acc
+        if acc["buckets"] is None:
+            import copy
+
+            acc["buckets"] = copy.deepcopy(new["buckets"])
+            return acc
+        for ab, nb in zip(acc["buckets"], new["buckets"]):
+            ab["doc_count"] += nb["doc_count"]
+            ab["subs"] = [
+                merge_wire_states(s, a, b)
+                for s, a, b in zip(node.subs, ab["subs"], nb["subs"])
+            ]
+        return acc
+    raise AggParsingError(f"aggregation type [{k}] has no wire merge")
+
+
+def _hashable(key):
+    return tuple(key) if isinstance(key, list) else key
+
+
+def _merge_wire_subs(acc: dict, new: dict) -> dict:
+    for f, pairs in new.items():
+        got = {_hashable(key): p for key, p in acc.get(f, [])}
+        for key, p in pairs:
+            key = _hashable(key)
+            cur = got.get(key)
+            if cur is None:
+                got[key] = dict(p)
+            else:
+                cur2 = _planes_from_wire(cur)
+                _merge_planes(cur2, _planes_from_wire(p))
+                got[key] = _planes_to_wire(cur2)
+        acc[f] = [[key, p] for key, p in got.items()]
+    return acc
+
+
+class _MappingsShim:
+    """Engine stand-in for render(): only .mappings is read there."""
+
+    def __init__(self, mappings):
+        self.mappings = mappings
+
+
+def wire_to_state(node: AggNode, wire, plan: dict):
+    """Reconstruct a render()-able merge state from a merged wire state,
+    filling `plan` (hist_params keyed by id(node)) so the one render code
+    path serves both the single-process and the replicated coordinator."""
+    k = node.kind
+    if k in METRIC_KINDS | {"extended_stats"}:
+        return {
+            "count": wire["count"],
+            "sum": wire["sum"],
+            "min": _unwire_num(wire["min"], np.inf),
+            "max": _unwire_num(wire["max"], -np.inf),
+            "sumsq": wire["sumsq"],
+        }
+    if k in ("percentiles", "percentile_ranks", "median_absolute_deviation"):
+        state = {"chunks": []}
+        if wire["values"]:
+            state["chunks"].append(np.asarray(wire["values"], dtype=np.float64))
+        return state
+    if k == "cardinality":
+        return {"values": set(map(_hashable, wire["values"]))}
+    if k in ("terms", "rare_terms"):
+        return {
+            "counts": {_hashable(key): c for key, c in wire["counts"]},
+            "subs": _subs_from_wire(wire.get("subs", {})),
+            "host": bool(wire.get("host")),
+            "hits_segments": [],
+        }
+    if k == "significant_terms":
+        return {
+            "counts": {_hashable(key): c for key, c in wire["counts"]},
+            "subs": _subs_from_wire(wire.get("subs", {})),
+            "hits_segments": [],
+            "doc_count": wire["doc_count"],
+            "bg_total": wire["bg_total"],
+            "bg_df": {_hashable(key): c for key, c in wire["bg_df"]},
+        }
+    if k in ("histogram", "date_histogram"):
+        if not wire["m_counts"] or wire.get("interval") is None:
+            return {"counts": None, "subs": {}, "hits_segments": []}
+        ms = [m for m, _c in wire["m_counts"]]
+        m_lo, m_hi = min(ms), max(ms)
+        counts = np.zeros(m_hi - m_lo + 1, dtype=np.int64)
+        for m, c in wire["m_counts"]:
+            counts[m - m_lo] = c
+        subs: dict = {}
+        for f, pairs in wire.get("subs", {}).items():
+            nb = len(counts)
+            planes = {
+                "count": np.zeros(nb, dtype=np.int64),
+                "sum": np.zeros(nb, dtype=np.float64),
+                "min": np.full(nb, np.inf),
+                "max": np.full(nb, -np.inf),
+            }
+            for m, p in pairs:
+                i = m - m_lo
+                planes["count"][i] = p["count"]
+                planes["sum"][i] = p["sum"]
+                planes["min"][i] = _unwire_num(p["min"], np.inf)
+                planes["max"][i] = _unwire_num(p["max"], -np.inf)
+            subs[f] = planes
+        plan.setdefault("hist_params", {})[id(node)] = (
+            float(wire["interval"]), float(wire.get("offset", 0.0)),
+            float(m_lo),
+        )
+        return {"counts": counts, "subs": subs, "hits_segments": []}
+    if k == "range":
+        subs = {}
+        for f, planes in wire.get("subs", {}).items():
+            subs[f] = {
+                "count": np.asarray(planes["count"], dtype=np.int64),
+                "sum": np.asarray(planes["sum"], dtype=np.float64),
+                "min": np.asarray(
+                    [_unwire_num(v, np.inf) for v in planes["min"]]
+                ),
+                "max": np.asarray(
+                    [_unwire_num(v, -np.inf) for v in planes["max"]]
+                ),
+            }
+        return {
+            "counts": (
+                None
+                if wire["counts"] is None
+                else np.asarray(wire["counts"], dtype=np.int64)
+            ),
+            "subs": subs,
+            "hits_segments": [],
+        }
+    if k in ("filter", "global", "missing"):
+        return {
+            "doc_count": wire["doc_count"],
+            "subs": [
+                wire_to_state(s, w, plan)
+                for s, w in zip(node.subs, wire["subs"])
+            ],
+        }
+    if k == "filters":
+        if wire["buckets"] is None:
+            return {"buckets": None}
+        return {
+            "buckets": [
+                {
+                    "doc_count": b["doc_count"],
+                    "subs": [
+                        wire_to_state(s, w, plan)
+                        for s, w in zip(node.subs, b["subs"])
+                    ],
+                }
+                for b in wire["buckets"]
+            ]
+        }
+    raise AggParsingError(f"aggregation type [{k}] has no wire state")
+
+
+def render_wire_states(
+    nodes: list[AggNode], wires: list, mappings, index_name: str = "index"
+) -> dict[str, Any]:
+    """Render coordinator-merged wire states through the one render path."""
+    shim = _MappingsShim(mappings)
+    out = {}
+    for node, wire in zip(nodes, wires):
+        plan: dict = {}
+        state = wire_to_state(node, wire, plan)
+        out[node.name] = render(node, state, shim, plan, index_name)
+    return out

@@ -168,6 +168,10 @@ class ShardedSearchCoordinator:
                 "mesh.serve", task=task, index=self.index_name,
                 shards=len(self.engines),
             ) as mesh_span:
+                # A decline attaches a mesh.fallback event (with the same
+                # reason label estpu_mesh_fallback_total carries) to this
+                # span from inside serve() — thread-safe, unlike reading
+                # a shared last-reason attribute back here.
                 resp = self.mesh_view.serve(self, request, task)
                 if mesh_span is not None:
                     mesh_span.tags["served"] = resp is not None
@@ -432,6 +436,14 @@ class ShardedSearchCoordinator:
         """Pin snapshots + stats for a new scroll over this index."""
         import time
 
+        from .service import normalized_sort
+
+        if len(normalized_sort(request)) > 1:
+            # The per-shard scroll cursor is a single (key, doc) pair;
+            # a multi-key cursor cannot resume correctly.
+            raise ValueError(
+                "scroll with a multi-key sort is not supported yet"
+            )
         snapshots = [
             [_freeze_handle(h) for h in e.segments] for e in self.engines
         ]
@@ -594,15 +606,11 @@ class ShardedSearchCoordinator:
         )
 
     @staticmethod
-    def _merge_key(request: SearchRequest, hit) -> float:
-        """Scalar merge key matching the shard-local ordering contract."""
-        if request.sort is None:
-            return -hit.score if hit.score is not None else np.inf
-        ((sort_field, order),) = request.sort[0].items()
-        if sort_field == "_score":
-            s = hit.score if hit.score is not None else 0.0
-            return s if order == "asc" else -s
-        value = hit.sort[0] if hit.sort else None
-        if value is None:
-            return np.inf  # missing sorts last
-        return -value if order == "desc" else value
+    def _merge_key(request: SearchRequest, hit):
+        """Merge key matching the shard-local ordering contract: a scalar
+        for score/single-key sorts, a tuple for multi-key sorts, with
+        missing values placed per each key's missing directive (the
+        shared service.sort_merge_key definition)."""
+        from .service import sort_merge_key
+
+        return sort_merge_key(request, hit.score, hit.sort)

@@ -214,6 +214,9 @@ class SearchRequest:
     from_: int = 0
     source_includes: bool | list[str] = True
     sort: list[dict[str, str]] | None = None  # [{"field": "asc"|"desc"}]
+    # Per-sort-key missing-value placement ("_first" | "_last"), aligned
+    # with `sort` (FieldSortBuilder's missing parameter; default _last).
+    sort_missing: list[str] | None = None
     rescore: list[Rescore] = field(default_factory=list)
     aggs: list[Any] | None = None  # list[aggs.AggNode]
     # Pagination cursor (search_after / scroll): the sort-key value of the
@@ -289,22 +292,33 @@ class SearchRequest:
                 )
             )
         sort = None
+        sort_missing = None
         if "sort" in body:
             sort = []
+            sort_missing = []
             raw = body["sort"]
             if not isinstance(raw, list):
                 raw = [raw]
             for entry in raw:
+                missing = "_last"
                 if isinstance(entry, str):
-                    sort.append({entry: "asc" if entry != "_score" else "desc"})
+                    fname = entry
+                    order = "asc" if entry != "_score" else "desc"
                 else:
                     ((fname, spec),) = entry.items()
-                    order = (
-                        spec.get("order", "asc")
-                        if isinstance(spec, dict)
-                        else str(spec)
+                    if isinstance(spec, dict):
+                        order = spec.get("order", "asc")
+                        missing = str(spec.get("missing", "_last"))
+                    else:
+                        order = str(spec)
+                if missing not in ("_first", "_last"):
+                    raise ValueError(
+                        f"sort [missing] must be [_first] or [_last], got "
+                        f"[{missing}] (custom missing values are not "
+                        f"supported yet)"
                     )
-                    sort.append({fname: order})
+                sort.append({fname: order})
+                sort_missing.append(missing)
         source = body.get("_source", True)
         if isinstance(source, str):  # ES accepts a single field name/pattern
             source = [source]
@@ -313,7 +327,8 @@ class SearchRequest:
             if not isinstance(search_after, list) or len(search_after) != 1:
                 raise ValueError(
                     "search_after must be a one-element array matching the "
-                    "sort (multi-key sort is not supported yet)"
+                    "primary sort key (multi-key cursors are not supported "
+                    "yet)"
                 )
             if sort is None:
                 raise ValueError(
@@ -366,6 +381,7 @@ class SearchRequest:
             from_=int(body.get("from", 0)),
             source_includes=source,
             sort=sort,
+            sort_missing=sort_missing,
             rescore=rescore,
             aggs=aggs,
             search_after=search_after,
@@ -380,6 +396,52 @@ class SearchRequest:
 
 
 _NO_SORT = object()  # sentinel: hit carries no sort values (default score sort)
+
+F32_MAX = float(np.finfo(np.float32).max)
+
+
+def normalized_sort(request: "SearchRequest") -> list[tuple[str, bool, bool]]:
+    """The request's sort as [(field, descending, missing_first)], with a
+    trailing "_doc" key dropped: the merge contract is ALWAYS doc-id
+    tiebroken, so an explicit trailing _doc only makes the implicit
+    tiebreak visible (it contributes no sort value). "_score" keys pass
+    through as the pseudo-field "_score"."""
+    if request.sort is None:
+        return []
+    missing = request.sort_missing or ["_last"] * len(request.sort)
+    out: list[tuple[str, bool, bool]] = []
+    for i, entry in enumerate(request.sort):
+        ((fname, order),) = entry.items()
+        if fname == "_doc" and i == len(request.sort) - 1 and i > 0:
+            continue
+        out.append((fname, str(order) == "desc", missing[i] == "_first"))
+    return out
+
+
+def sort_merge_key(request: "SearchRequest", score, sort_values):
+    """Cross-shard merge key for one hit under the request's sort: a
+    scalar for single-key sorts (back-compat with scroll cursors), a
+    tuple for multi-key. Ascending key space; missing values map to
+    +/-inf per the key's missing directive — the single definition the
+    host-loop coordinator AND the replicated cluster coordinator merge
+    with (FieldSortBuilder missing-value semantics)."""
+    if request.sort is None:
+        return -score if score is not None else np.inf
+    keys = normalized_sort(request)
+    if keys and keys[0][0] == "_score":
+        s = score if score is not None else 0.0
+        return s if not keys[0][1] else -s
+    vals = sort_values or []
+    out = []
+    for i, (_f, desc, mfirst) in enumerate(keys):
+        v = vals[i] if i < len(vals) else None
+        if v is None:
+            out.append(-np.inf if mfirst else np.inf)
+        else:
+            out.append(-v if desc else v)
+    if not out:
+        return np.inf
+    return tuple(out) if len(out) > 1 else out[0]
 
 
 def sparse_family_key(spec) -> tuple | None:
@@ -557,7 +619,13 @@ class SearchService:
                         doc_id=handle.segment.ids[local],
                         score=score,
                         source=self._fetch_source(handle, local, request),
-                        sort=None if sort_value is _NO_SORT else [sort_value],
+                        sort=(
+                            None
+                            if sort_value is _NO_SORT
+                            else sort_value
+                            if isinstance(sort_value, list)
+                            else [sort_value]
+                        ),
                         global_doc=global_doc,
                         highlight=self._fetch_highlight(handle, local, hl_ctx),
                         fields=self._fetch_fields(handle, local, request),
@@ -989,21 +1057,38 @@ class SearchService:
     def _validate_sort(self, request: SearchRequest) -> None:
         """Validate the sort spec against the mappings up front, so request
         validity doesn't depend on whether the hits pass runs (an agg-only
-        size=0 request must still 400 on a bad sort)."""
+        size=0 request must still 400 on a bad sort).
+
+        Accepted shapes: one or more numeric doc-values fields (multi-key
+        sorts lexsort on the host path), an optional trailing "_doc"
+        tiebreak (which only makes the implicit doc tiebreak explicit),
+        or a lone "_score" key."""
         if request.sort is None:
             return
-        if len(request.sort) > 1:
+        fields = [next(iter(e)) for e in request.sort]
+        for i, f in enumerate(fields):
+            if f == "_doc":
+                if i != len(fields) - 1 or i == 0:
+                    raise ValueError(
+                        "[_doc] is only supported as a trailing tiebreak "
+                        "after a field sort key"
+                    )
+                continue
+            if f == "_score":
+                if len(fields) > 1:
+                    raise ValueError(
+                        "[_score] cannot be combined with other sort keys"
+                    )
+                continue
+            fm = self.engine.mappings.get(f)
+            if fm is None or not fm.is_numeric:
+                raise ValueError(
+                    f"No mapping found for [{f}] in order to sort on"
+                )
+        real = [f for f in fields if f not in ("_doc", "_score")]
+        if request.search_after is not None and len(real) > 1:
             raise ValueError(
-                "multi-key sort is not supported yet; got "
-                f"{len(request.sort)} sort keys"
-            )
-        ((sort_field, _),) = request.sort[0].items()
-        if sort_field == "_score":
-            return
-        fm = self.engine.mappings.get(sort_field)
-        if fm is None or not fm.is_numeric:
-            raise ValueError(
-                f"No mapping found for [{sort_field}] in order to sort on"
+                "search_after with a multi-key sort is not supported yet"
             )
 
     # ------------------------------------------------------------------ query
@@ -1104,9 +1189,22 @@ class SearchService:
         # Sort spec validity is enforced up front by _validate_sort.
         sort_field = None
         descending = False
+        missing_first = False
         if request.sort is not None:
-            ((sort_field, order),) = request.sort[0].items()
-            descending = order == "desc"
+            keys = normalized_sort(request)
+            if keys[0][0] == "_score":
+                sort_field = "_score"
+                descending = keys[0][1]
+            elif len(keys) == 1:
+                sort_field, descending, missing_first = keys[0]
+            else:
+                # Multi-key field sort: dense matched mask + host lexsort
+                # (a per-segment top-k by the primary key alone could drop
+                # docs that tie on it but win on a secondary key).
+                total, backend = self._query_segment_multisort(
+                    handle, request, k, keys, compiled, seg_tree, candidates
+                )
+                return done(total, backend)
 
         cursor = request.search_after
         if sort_field is None or sort_field == "_score":
@@ -1229,10 +1327,12 @@ class SearchService:
                 )
             return done(int(tot), backend)
 
+        missing_key = -np.inf if missing_first else np.inf
         if sort_field not in handle.device.doc_values:
             # Mapped numeric field with no values in this segment: every
-            # matched doc is "missing" — sorts last, ordered by doc id
-            # (the same contract as NaN values in execute_sorted).
+            # matched doc is "missing" — placed per the missing directive,
+            # ordered by doc id (the same contract as NaN values in
+            # execute_sorted).
             _, eligible = bm25_device.execute_dense(
                 seg_tree, compiled.spec, compiled.arrays
             )
@@ -1246,17 +1346,24 @@ class SearchService:
                         locs = locs[locs > request.after_doc - handle.base]
                     else:
                         locs = locs[:0]
-                # A real-valued cursor precedes every missing doc: keep all.
+                elif missing_first:
+                    # Missing-first: a real-valued cursor is PAST the
+                    # whole missing region.
+                    locs = locs[:0]
+                # Missing-last: a real cursor precedes every missing doc.
             for local in locs[:k]:
                 candidates.append(
-                    (np.inf, handle.base + int(local), handle, int(local), None, None)
+                    (missing_key, handle.base + int(local), handle,
+                     int(local), None, None)
                 )
             return done(int(mask.sum()))
         if cursor is not None:
             raw_after = cursor[0]
             fmax = np.float32(np.finfo(np.float32).max)
             if raw_after is None:
-                a_key = fmax  # missing region (with doc tiebreak if given)
+                # Missing-region cursor, in the transformed ascending key
+                # space (missing = +fmax last / -fmax first).
+                a_key = -fmax if missing_first else fmax
             else:
                 a_key = np.float32(raw_after)
                 if descending:
@@ -1275,13 +1382,14 @@ class SearchService:
                 k,
                 a_key,
                 np.int32(a_doc),
+                missing_first=missing_first,
             )
             values, ids = np.asarray(values), np.asarray(ids)
             n = min(k, int(n_after))
         else:
             values, ids, tot = bm25_device.execute_sorted(
                 seg_tree, compiled.spec, compiled.arrays, sort_field,
-                descending, k
+                descending, k, missing_first=missing_first,
             )
             values, ids = np.asarray(values), np.asarray(ids)
             n = min(k, int(tot))
@@ -1289,7 +1397,7 @@ class SearchService:
             local = int(ids[rank])
             raw = float(values[rank])
             missing = np.isnan(values[rank])
-            key = np.inf if missing else (-raw if descending else raw)
+            key = missing_key if missing else (-raw if descending else raw)
             candidates.append(
                 (
                     key,
@@ -1301,6 +1409,70 @@ class SearchService:
                 )
             )
         return done(int(tot))
+
+    def _query_segment_multisort(
+        self,
+        handle: SegmentHandle,
+        request: SearchRequest,
+        k: int,
+        keys: list[tuple[str, bool, bool]],
+        compiled,
+        seg_tree,
+        candidates: list,
+    ) -> tuple[int, str]:
+        """Multi-key field sort over one segment: ONE dense device launch
+        for the matched mask, then a host lexsort over the f32-quantized
+        doc-values columns (FieldSortBuilder semantics per key: asc/desc,
+        missing first/last, final doc-id tiebreak). A per-key device top-k
+        cannot serve this shape — docs tying on the primary key may win on
+        a secondary key from beyond the primary top-k."""
+        _, eligible = bm25_device.execute_dense(
+            seg_tree, compiled.spec, compiled.arrays
+        )
+        n_docs = handle.segment.num_docs
+        mask = np.asarray(eligible)[:n_docs]
+        locs = np.flatnonzero(mask)
+        total = int(len(locs))
+        if total == 0 or k <= 0:
+            return total, "device"
+        vals32 = []  # f32 stored-value semantics, like the device column
+        sortkeys = []  # transformed ascending f64 key per sort position
+        for f, desc, mfirst in keys:
+            col = handle.segment.doc_values.get(f)
+            if col is None:
+                v = np.full(len(locs), np.nan, dtype=np.float32)
+            else:
+                v = col[locs].astype(np.float32)
+            miss = np.float32(-F32_MAX if mfirst else F32_MAX)
+            key = np.where(
+                np.isnan(v), miss, (-v if desc else v)
+            ).astype(np.float64)
+            vals32.append(v)
+            sortkeys.append(key)
+        order = np.lexsort((locs,) + tuple(reversed(sortkeys)))[:k]
+        for pos in order:
+            local = int(locs[pos])
+            sort_vals = []
+            merge_key = []
+            for ki, (f, desc, mfirst) in enumerate(keys):
+                v = vals32[ki][pos]
+                if np.isnan(v):
+                    sort_vals.append(None)
+                    merge_key.append(-np.inf if mfirst else np.inf)
+                else:
+                    sort_vals.append(float(v))
+                    merge_key.append(-float(v) if desc else float(v))
+            candidates.append(
+                (
+                    tuple(merge_key),
+                    handle.base + local,
+                    handle,
+                    local,
+                    None,  # no _score for field sorts
+                    sort_vals,
+                )
+            )
+        return total, "device"
 
     def _apply_rescore(
         self,

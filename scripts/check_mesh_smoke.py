@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""CI smoke gate for one-launch SPMD serving (ISSUE 8).
+
+Runs the sorted/agg/search_after/replicated mesh parity suite on the CPU
+backend — no TPU needed: ≥64 fuzzed request shapes must return
+bit-identical responses from the SPMD mesh path, the host-loop
+coordinator, and the raw-document oracle; replicated indices serve
+sorted + aggregating searches with exact values; mesh fallbacks are
+counted, never silent. The same tests ride the tier-1 run via the fast
+(`not slow`) marker; this script is the standalone hook for pre-merge /
+cron checks:
+
+    python scripts/check_mesh_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "tests/test_mesh_sorted_aggs.py",
+        "tests/test_mesh_serving.py",
+        "-q",
+        "-m",
+        "not slow",
+        "-p",
+        "no:cacheprovider",
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

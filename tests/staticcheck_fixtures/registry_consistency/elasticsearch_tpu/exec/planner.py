@@ -1,7 +1,8 @@
 """Fixture planner: [ghost] has no cost seed and no surfacing site;
-[packed] is surfaced (user.py) but UNSEEDED — the multi-tenant backend
-registered without a cost seed must fail the gate."""
+[packed] and [mesh_spmd] are surfaced (user.py) but UNSEEDED — the
+multi-tenant backend and the SPMD mesh plan class registered without an
+exec/cost.py seed must each fail the gate."""
 
 
 class ExecPlanner:
-    BACKENDS = ("device", "ghost", "packed")
+    BACKENDS = ("device", "ghost", "packed", "mesh_spmd")

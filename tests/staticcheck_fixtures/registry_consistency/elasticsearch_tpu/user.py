@@ -15,6 +15,7 @@ def make_instruments(m):
     m.counter("estpu_rogue_total", "not in CATALOG")
     m.gauge("estpu_kind_total", "cataloged as counter: kind mismatch")
     m.histogram("estpu_packed_rogue_total", "packed instrument not in CATALOG")
+    m.counter("estpu_mesh_rogue_total", "mesh instrument not in CATALOG")
 
 
 def route(backend="device"):
@@ -25,3 +26,9 @@ def route_packed():
     # Surfacing site for the packed backend (so only its MISSING cost
     # seed fires, isolating that half of the contract).
     return "packed"
+
+
+def route_mesh():
+    # Surfacing site for the SPMD mesh backend: an unseeded mesh plan
+    # class must fail exactly like an unseeded packed one.
+    return "mesh_spmd"

@@ -148,12 +148,31 @@ def test_sort_score_asc_returns_lowest_scoring():
     assert [h.doc_id for h in asc.hits] == want
 
 
-def test_multi_key_sort_rejected():
+def test_multi_key_sort_supported_numeric_only():
     engine = make_engine()
-    with pytest.raises(ValueError, match="multi-key sort"):
+    # Multi-key numeric sorts lexsort on the host path (ISSUE 8); hits
+    # carry one sort value per key and order by (key1, key2, doc).
+    resp = search(
+        engine,
+        {"query": {"match_all": {}}, "sort": [{"rank": "asc"}, "_doc"]},
+    )
+    values = [h.sort[0] for h in resp.hits if h.sort[0] is not None]
+    assert values == sorted(values)
+    # Non-numeric keys still reject, on any key position.
+    with pytest.raises(ValueError, match="No mapping found for \\[tag\\]"):
         search(
             engine,
             {"query": {"match_all": {}}, "sort": [{"rank": "asc"}, {"tag": "desc"}]},
+        )
+    # search_after remains single-cursor: multi-key sorts refuse it.
+    with pytest.raises(ValueError, match="search_after with a multi-key"):
+        search(
+            engine,
+            {
+                "query": {"match_all": {}},
+                "sort": [{"rank": "asc"}, {"rank": "desc"}],
+                "search_after": [5],
+            },
         )
 
 

@@ -168,7 +168,11 @@ def test_dsl_matrix_identical_and_mesh_used(rest, body):
     assert via_mesh["_shards"]["total"] == 8
 
 
-def test_ineligible_shapes_fall_back(rest):
+def test_newly_eligible_shapes_serve_on_mesh(rest):
+    """Sorted, aggregating and size:0 requests — the production shapes
+    ISSUE 8 moved into the one-launch SPMD program — now serve via the
+    mesh (parity with the host loop is asserted by the fuzz suite in
+    test_mesh_sorted_aggs.py)."""
     mv = mesh_view(rest)
     for body in [
         {"query": {"match_all": {}}, "sort": [{"rank": "desc"}]},
@@ -177,20 +181,69 @@ def test_ineligible_shapes_fall_back(rest):
             "aggs": {"tags": {"terms": {"field": "tag"}}},
         },
         {"query": {"match_all": {}}, "size": 0},
-        {
-            "query": {"match": {"body": "bee"}},
-            "rescore": {
-                "window_size": 5,
-                "query": {"rescore_query": {"match": {"body": "cat"}}},
-            },
-        },
     ]:
         before = mv.served
         status, resp = rest.dispatch(
             "POST", "/mesh/_search", {}, json.dumps(body)
         )
         assert status == 200, resp
+        rest.node.request_cache.clear()
+        assert mv.served == before + 1, f"mesh should serve {body}"
+
+
+def test_ineligible_shapes_fall_back_counted(rest):
+    mv = mesh_view(rest)
+    for body, reason in [
+        (
+            {
+                "query": {"match": {"body": "bee"}},
+                "rescore": {
+                    "window_size": 5,
+                    "query": {"rescore_query": {"match": {"body": "cat"}}},
+                },
+            },
+            "ineligible_shape",
+        ),
+        (
+            {
+                "query": {"match_all": {}},
+                "sort": [{"rank": "asc"}, {"rank": "desc"}],
+            },
+            "sort_shape",
+        ),
+        (
+            {
+                "query": {"match_all": {}},
+                "size": 0,
+                "aggs": {
+                    "t": {
+                        "terms": {"field": "tag"},
+                        "aggs": {"s": {"sum": {"field": "rank"}}},
+                    }
+                },
+            },
+            "agg_shape",
+        ),
+    ]:
+        before = mv.served
+        before_falls = mv.fallbacks.get(reason, 0)
+        status, resp = rest.dispatch(
+            "POST", "/mesh/_search", {}, json.dumps(body)
+        )
+        assert status == 200, resp
+        rest.node.request_cache.clear()
         assert mv.served == before, f"mesh should not serve {body}"
+        assert mv.fallbacks.get(reason, 0) == before_falls + 1, (
+            f"fallback for {body} must be counted as [{reason}]: "
+            f"{mv.fallbacks}"
+        )
+    # The counter is cataloged + surfaced: _nodes/stats carries the
+    # per-view reasons and the node-wide served_by_shape breakdown.
+    stats = rest.node.nodes_stats()
+    node_stats = next(iter(stats["nodes"].values()))
+    mesh_stats = node_stats["mesh_serving"]
+    assert mesh_stats["views"]["mesh"]["fallbacks"].get("sort_shape")
+    assert sum(mesh_stats["served_by_shape"].values()) >= 1
 
 
 def test_incremental_refresh_single_shard(rest):

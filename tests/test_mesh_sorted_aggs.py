@@ -1,0 +1,617 @@
+"""ISSUE 8 parity fuzz: one-launch SPMD serving of sorted, aggregating,
+and replicated searches.
+
+Gate: ≥64 randomized request shapes (single-field sorts asc/desc with
+missing first/last and `_doc` tiebreaks, search_after cursors, the
+mesh-eligible agg family, size:0 agg-only, track_total_hits variants)
+must return BIT-IDENTICAL responses (ids + order + fp32 scores/sort keys
++ agg values + totals + shard math) from:
+
+- the SPMD mesh path (ONE shard_map launch, asserted via `served`),
+- the host-loop coordinator (mesh disabled), and
+- an independent numpy oracle computed from the raw documents.
+
+A replicated 2-node cluster additionally serves the same sorted/agg
+shapes with exact agg values and the documented (key, shard, insertion)
+hit order. Fallbacks for still-ineligible shapes are counted, never
+silent.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.parallel.routing import shard_for_id
+from elasticsearch_tpu.rest.server import RestServer
+
+WORDS = ["ant", "bee", "cat", "dog", "elk", "fox"]
+TAGS = ["x", "y", "z"]
+N_DOCS = 260
+N_SHARDS = 4
+DAY = 86_400_000
+
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "price": {"type": "long"},
+        "qty": {"type": "integer"},
+        "ts": {"type": "date"},
+    }
+}
+
+
+def build_docs():
+    rng = np.random.default_rng(1234)
+    docs = {}
+    for i in range(N_DOCS):
+        doc = {
+            "body": " ".join(rng.choice(WORDS, rng.integers(2, 7))),
+            "tag": str(rng.choice(TAGS)),
+            "qty": int(rng.integers(0, 4)),
+            "ts": int(1_700_000_000_000 + int(rng.integers(0, 20)) * DAY),
+        }
+        if rng.random() > 0.15:  # ~15% missing price
+            doc["price"] = int(rng.integers(0, 40))
+        docs[f"d{i}"] = doc
+    return docs
+
+
+DOCS = build_docs()
+
+
+@pytest.fixture(scope="module")
+def rest():
+    rest = RestServer()
+    status, _ = rest.dispatch(
+        "PUT",
+        "/fz",
+        {},
+        json.dumps(
+            {
+                "settings": {"index": {"number_of_shards": N_SHARDS}},
+                "mappings": MAPPINGS,
+            }
+        ),
+    )
+    assert status == 200
+    lines = []
+    for doc_id, doc in DOCS.items():
+        lines.append(json.dumps({"index": {"_id": doc_id}}))
+        lines.append(json.dumps(doc))
+    status, resp = rest.dispatch(
+        "POST", "/fz/_bulk", {"refresh": "true"}, "\n".join(lines)
+    )
+    assert status == 200 and not resp["errors"]
+    return rest
+
+
+def mesh_view(rest):
+    mv = rest.node.get_index("fz").search.mesh_view
+    assert mv is not None
+    return mv
+
+
+def both_paths(rest, body):
+    svc = rest.node.get_index("fz")
+    mv = mesh_view(rest)
+    before = mv.served
+    status, via_mesh = rest.dispatch(
+        "POST", "/fz/_search", {}, json.dumps(body)
+    )
+    assert status == 200, via_mesh
+    used = mv.served > before
+    svc.search.mesh_view = None
+    rest.node.request_cache.clear()
+    try:
+        status, via_host = rest.dispatch(
+            "POST", "/fz/_search", {}, json.dumps(body)
+        )
+    finally:
+        svc.search.mesh_view = mv
+        rest.node.request_cache.clear()
+    assert status == 200, via_host
+    return via_mesh, via_host, used
+
+
+def strip_took(resp):
+    return {k: v for k, v in resp.items() if k != "took"}
+
+
+# ------------------------------------------------------------ the oracle
+#
+# Independent reference computed from the raw documents: query matching
+# for the pooled query shapes, the (sort key, doc) total order, and the
+# agg families' exact integer arithmetic.
+
+
+def matches(doc, query):
+    kind, params = next(iter(query.items()))
+    if kind == "match_all":
+        return True
+    if kind == "term":
+        ((f, v),) = params.items()
+        return doc.get(f) == v
+    if kind == "match":
+        ((f, text),) = params.items()
+        terms = text.split()
+        return any(t in doc.get(f, "").split() for t in terms)
+    if kind == "bool":
+        must = params.get("must", [])
+        filt = params.get("filter", [])
+        return all(matches(doc, q) for q in must + filt)
+    raise AssertionError(f"oracle has no {kind}")
+
+
+def oracle_sorted_ids(query, field, desc, missing_first, k):
+    """Expected hit ids under the documented total order: (key asc after
+    transform, shard index, within-shard insertion order)."""
+    rows = []
+    for seq, (doc_id, doc) in enumerate(DOCS.items()):
+        if not matches(doc, query):
+            continue
+        v = doc.get(field)
+        if v is None:
+            key = -np.inf if missing_first else np.inf
+        else:
+            key = -float(v) if desc else float(v)
+        rows.append((key, shard_for_id(doc_id, N_SHARDS), seq, doc_id))
+    rows.sort(key=lambda r: (r[0], r[1], r[2]))
+    return [r[3] for r in rows[:k]], len(rows)
+
+
+def oracle_matched(query):
+    return [doc for doc in DOCS.values() if matches(doc, query)]
+
+
+# -------------------------------------------------------------- the fuzz
+
+QUERY_POOL = [
+    {"match_all": {}},
+    {"match": {"body": "bee cat"}},
+    {"term": {"tag": "x"}},
+    {
+        "bool": {
+            "must": [{"match": {"body": "ant"}}],
+            "filter": [{"term": {"tag": "y"}}],
+        }
+    },
+]
+
+SORT_POOL = [
+    None,
+    [{"price": "asc"}],
+    [{"price": "desc"}],
+    [{"price": {"order": "asc", "missing": "_first"}}],
+    [{"price": {"order": "desc", "missing": "_first"}}],
+    [{"price": "asc"}, "_doc"],
+    [{"qty": "asc"}],
+]
+
+AGG_POOL = [
+    None,
+    {
+        "p_stats": {"stats": {"field": "price"}},
+        "q_avg": {"avg": {"field": "qty"}},
+        "p_count": {"value_count": {"field": "price"}},
+    },
+    {
+        "tags": {"terms": {"field": "tag"}},
+        "tag_card": {"cardinality": {"field": "tag"}},
+        "p_card": {"cardinality": {"field": "price"}},
+    },
+    {
+        "hist": {"histogram": {"field": "price", "interval": 7}},
+        "days": {"date_histogram": {"field": "ts", "fixed_interval": "1d"}},
+    },
+    {
+        "r": {
+            "range": {
+                "field": "price",
+                "ranges": [{"to": 10}, {"from": 10, "to": 25}, {"from": 25}],
+            }
+        },
+        "pct": {"percentiles": {"field": "price"}},
+    },
+    {
+        "only_x": {
+            "filter": {"term": {"tag": "x"}},
+            "aggs": {"s": {"sum": {"field": "price"}}},
+        },
+        "no_price": {"missing": {"field": "price"}},
+        "g": {"global": {}, "aggs": {"mx": {"max": {"field": "qty"}}}},
+    },
+]
+
+TTH_POOL = [True, 10_000, False, 4]
+
+
+def fuzz_cases():
+    rng = np.random.default_rng(77)
+    cases = []
+    for _ in range(64):
+        body = {"query": dict(QUERY_POOL[rng.integers(len(QUERY_POOL))])}
+        sort = SORT_POOL[rng.integers(len(SORT_POOL))]
+        if sort is not None:
+            body["sort"] = sort
+        aggs = AGG_POOL[rng.integers(len(AGG_POOL))]
+        if aggs is not None:
+            body["aggs"] = aggs
+        if aggs is not None and rng.random() < 0.25:
+            body["size"] = 0
+        else:
+            body["size"] = int(rng.choice([8, 13]))
+        body["track_total_hits"] = TTH_POOL[rng.integers(len(TTH_POOL))]
+        cases.append(body)
+    return cases
+
+
+@pytest.mark.parametrize("body", fuzz_cases())
+def test_fuzz_mesh_equals_host_loop_bit_exact(rest, body):
+    via_mesh, via_host, used = both_paths(rest, body)
+    assert used, (
+        f"mesh did not serve eligible {body}: "
+        f"{mesh_view(rest).last_fallback_reason}"
+    )
+    assert strip_took(via_mesh) == strip_took(via_host), (
+        json.dumps(strip_took(via_mesh), indent=1),
+        json.dumps(strip_took(via_host), indent=1),
+    )
+
+
+def test_fuzz_oracle_sorted_order_and_totals(rest):
+    """Mesh-sorted hit order equals the raw-document oracle exactly."""
+    checked = 0
+    for query in QUERY_POOL:
+        for sort in SORT_POOL[1:]:
+            ((field, spec),) = sort[0].items()
+            desc = (
+                spec == "desc"
+                or (isinstance(spec, dict) and spec.get("order") == "desc")
+            )
+            mfirst = (
+                isinstance(spec, dict) and spec.get("missing") == "_first"
+            )
+            body = {"query": query, "sort": sort, "size": 11}
+            via_mesh, _via_host, used = both_paths(rest, body)
+            assert used
+            want_ids, want_total = oracle_sorted_ids(
+                query, field, desc, mfirst, 11
+            )
+            got = [h["_id"] for h in via_mesh["hits"]["hits"]]
+            assert got == want_ids, (body, got, want_ids)
+            assert via_mesh["hits"]["total"]["value"] == want_total
+            # Sort values are the raw f32 field values (missing = null).
+            for h in via_mesh["hits"]["hits"]:
+                v = DOCS[h["_id"]].get(field)
+                assert h["sort"] == [None if v is None else float(v)]
+            checked += 1
+    assert checked == len(QUERY_POOL) * (len(SORT_POOL) - 1)
+
+
+def test_fuzz_oracle_agg_values(rest):
+    """Mesh agg values equal exact integer arithmetic over raw docs."""
+    for query in QUERY_POOL:
+        body = {
+            "query": query,
+            "size": 0,
+            "aggs": {**AGG_POOL[1], **AGG_POOL[2], **AGG_POOL[3]},
+        }
+        via_mesh, via_host, used = both_paths(rest, body)
+        assert used
+        assert strip_took(via_mesh) == strip_took(via_host)
+        matched = oracle_matched(query)
+        prices = [d["price"] for d in matched if "price" in d]
+        aggs = via_mesh["aggregations"]
+        assert aggs["p_count"]["value"] == len(prices)
+        assert aggs["p_stats"]["count"] == len(prices)
+        assert aggs["p_stats"]["sum"] == float(sum(prices))
+        if prices:
+            assert aggs["p_stats"]["min"] == float(min(prices))
+            assert aggs["p_stats"]["max"] == float(max(prices))
+        qtys = [d["qty"] for d in matched]
+        if qtys:
+            assert aggs["q_avg"]["value"] == sum(qtys) / len(qtys)
+        from collections import Counter
+
+        tag_counts = Counter(d["tag"] for d in matched)
+        got = {b["key"]: b["doc_count"] for b in aggs["tags"]["buckets"]}
+        assert got == dict(tag_counts)
+        assert aggs["tag_card"]["value"] == len(tag_counts)
+        assert aggs["p_card"]["value"] == len(set(prices))
+        hist = Counter((p // 7) * 7 for p in prices)
+        got = {b["key"]: b["doc_count"] for b in aggs["hist"]["buckets"]}
+        assert {k: v for k, v in got.items() if v} == {
+            float(k): v for k, v in hist.items()
+        }
+        days = Counter((d["ts"] // DAY) * DAY for d in matched)
+        got = {b["key"]: b["doc_count"] for b in aggs["days"]["buckets"]}
+        assert {k: v for k, v in got.items() if v} == dict(days)
+
+
+def test_search_after_pagination_chain(rest):
+    """Walk a sorted result set page by page via search_after on the mesh
+    and via the host loop: identical pages, and their concatenation is
+    the oracle's full order."""
+    body = {
+        "query": {"match_all": {}},
+        "sort": [{"price": "asc"}],
+        "size": 50,
+    }
+    mv = mesh_view(rest)
+    seen = []
+    cursor = None
+    for _page in range(4):
+        b = dict(body)
+        if cursor is not None:
+            b["search_after"] = cursor
+        via_mesh, via_host, used = both_paths(rest, b)
+        assert used, mv.last_fallback_reason
+        assert strip_took(via_mesh) == strip_took(via_host)
+        hits = via_mesh["hits"]["hits"]
+        if not hits:
+            break
+        seen.extend(h["_id"] for h in hits)
+        cursor = hits[-1]["sort"]
+    want_ids, total = oracle_sorted_ids(
+        {"match_all": {}}, "price", False, False, N_DOCS
+    )
+    # A key-only cursor resumes STRICTLY past the cursor key, skipping
+    # any remaining ties at each page boundary (public search_after
+    # semantics without a tiebreak value) — so the walked ids are a
+    # subsequence of the oracle order, never a reordering or duplicate.
+    assert len(set(seen)) == len(seen)
+    seen_set = set(seen)
+    assert seen == [i for i in want_ids if i in seen_set]
+    assert seen[: 50] == want_ids[: 50]  # page 1 is the exact prefix
+    assert len(seen) >= total - 4 * 40  # only tie-groups may be skipped
+
+
+def test_size0_count_only_serves_on_mesh(rest):
+    mv = mesh_view(rest)
+    before = mv.served
+    via_mesh, via_host, used = both_paths(
+        rest, {"query": {"term": {"tag": "x"}}, "size": 0}
+    )
+    assert used and mv.served == before + 1
+    assert strip_took(via_mesh) == strip_took(via_host)
+    assert via_mesh["hits"]["hits"] == []
+    want = sum(1 for d in DOCS.values() if d["tag"] == "x")
+    assert via_mesh["hits"]["total"]["value"] == want
+
+
+def test_fallbacks_counted_never_silent(rest):
+    mv = mesh_view(rest)
+    svc = rest.node.get_index("fz")
+    total_before = mv.served + sum(mv.fallbacks.values())
+    bodies = [
+        {"query": {"match_all": {}}, "sort": [{"price": "asc"}, {"qty": "desc"}]},
+        {"size": 0, "aggs": {"c": {"composite": {"sources": [
+            {"t": {"terms": {"field": "tag"}}}]}}}},
+        {"query": {"match": {"body": "bee"}}, "rescore": {
+            "window_size": 4,
+            "query": {"rescore_query": {"match": {"body": "cat"}}}}},
+    ]
+    for body in bodies:
+        status, _ = rest.dispatch("POST", "/fz/_search", {}, json.dumps(body))
+        assert status == 200
+        rest.node.request_cache.clear()
+    total_after = mv.served + sum(mv.fallbacks.values())
+    assert total_after == total_before + len(bodies), (
+        "every mesh decline must be counted", mv.fallbacks,
+    )
+    # The Prometheus exposition carries the reason-labeled counter.
+    text = rest.node.metrics.exposition()
+    assert "estpu_mesh_fallback_total" in text
+    assert 'reason="sort_shape"' in text
+    assert svc.search.mesh_view is mv
+
+
+# ---------------------------------------------------------- replicated
+
+
+REPL_DOCS = {}
+
+
+def _build_repl_docs():
+    rng = np.random.default_rng(55)
+    for i in range(80):
+        doc = {
+            "body": " ".join(rng.choice(WORDS, rng.integers(2, 5))),
+            "tag": str(rng.choice(TAGS)),
+            "qty": int(rng.integers(0, 4)),
+        }
+        if rng.random() > 0.2:
+            doc["price"] = int(rng.integers(0, 30))
+        REPL_DOCS[f"r{i}"] = doc
+
+
+_build_repl_docs()
+
+
+@pytest.fixture(scope="module")
+def repl():
+    rest = RestServer(replication_nodes=2)
+    status, resp = rest.dispatch(
+        "PUT",
+        "/rp",
+        {},
+        json.dumps(
+            {
+                "settings": {
+                    "index": {
+                        "number_of_shards": 2,
+                        "number_of_replicas": 1,
+                    }
+                },
+                "mappings": MAPPINGS,
+            }
+        ),
+    )
+    assert status == 200, resp
+    for doc_id, doc in REPL_DOCS.items():
+        status, resp = rest.dispatch(
+            "PUT", f"/rp/_doc/{doc_id}", {}, json.dumps(doc)
+        )
+        assert status in (200, 201), resp
+    rest.dispatch("POST", "/rp/_refresh", {}, None)
+    return rest
+
+
+def test_replicated_sorted_search_order(repl):
+    """Replicated sorted searches merge by (sort key, shard, per-shard
+    rank) with missing-value placement — previously the cluster merge
+    keyed on _score (None for field sorts) and scrambled sorted hits."""
+    for sort, desc, mfirst in [
+        ([{"price": "asc"}], False, False),
+        ([{"price": "desc"}], True, False),
+        ([{"price": {"order": "asc", "missing": "_first"}}], False, True),
+    ]:
+        status, out = repl.dispatch(
+            "POST",
+            "/rp/_search",
+            {},
+            json.dumps(
+                {"query": {"match_all": {}}, "sort": sort, "size": 15}
+            ),
+        )
+        assert status == 200, out
+        rows = []
+        for seq, (doc_id, doc) in enumerate(REPL_DOCS.items()):
+            v = doc.get("price")
+            if v is None:
+                key = -np.inf if mfirst else np.inf
+            else:
+                key = -float(v) if desc else float(v)
+            rows.append((key, shard_for_id(doc_id, 2), seq, doc_id))
+        rows.sort(key=lambda r: (r[0], r[1], r[2]))
+        want = [r[3] for r in rows[:15]]
+        got = [h["_id"] for h in out["hits"]["hits"]]
+        assert got == want, (sort, got, want)
+        for h in out["hits"]["hits"]:
+            v = REPL_DOCS[h["_id"]].get("price")
+            assert h["sort"] == [None if v is None else float(v)]
+
+
+def test_replicated_aggs_exact(repl):
+    """Aggregations on replicated indices (previously a 400): the shard
+    copies return mergeable wire states, the coordinator reduces and
+    renders — values exact vs raw-document arithmetic."""
+    status, out = repl.dispatch(
+        "POST",
+        "/rp/_search",
+        {},
+        json.dumps(
+            {
+                "size": 0,
+                "aggs": {
+                    "st": {"stats": {"field": "price"}},
+                    "tags": {"terms": {"field": "tag"}},
+                    "hist": {"histogram": {"field": "price", "interval": 6}},
+                    "r": {"range": {"field": "price", "ranges": [
+                        {"to": 10}, {"from": 10}]}},
+                    "only_x": {
+                        "filter": {"term": {"tag": "x"}},
+                        "aggs": {"s": {"sum": {"field": "price"}}},
+                    },
+                    "t2": {
+                        "terms": {"field": "tag"},
+                        "aggs": {"mx": {"max": {"field": "price"}}},
+                    },
+                    "pct": {"percentiles": {"field": "price"}},
+                    "card": {"cardinality": {"field": "tag"}},
+                },
+            }
+        ),
+    )
+    assert status == 200, out
+    aggs = out["aggregations"]
+    from collections import Counter
+
+    prices = [d["price"] for d in REPL_DOCS.values() if "price" in d]
+    assert out["hits"]["total"]["value"] == len(REPL_DOCS)
+    assert aggs["st"]["count"] == len(prices)
+    assert aggs["st"]["sum"] == float(sum(prices))
+    assert aggs["st"]["min"] == float(min(prices))
+    assert aggs["st"]["max"] == float(max(prices))
+    tag_counts = Counter(d["tag"] for d in REPL_DOCS.values())
+    got = {b["key"]: b["doc_count"] for b in aggs["tags"]["buckets"]}
+    assert got == dict(tag_counts)
+    assert aggs["card"]["value"] == len(tag_counts)
+    hist = Counter((p // 6) * 6 for p in prices)
+    got = {b["key"]: b["doc_count"] for b in aggs["hist"]["buckets"]}
+    assert {k: v for k, v in got.items() if v} == {
+        float(k): v for k, v in hist.items()
+    }
+    assert aggs["r"]["buckets"][0]["doc_count"] == sum(
+        1 for p in prices if p < 10
+    )
+    assert aggs["r"]["buckets"][1]["doc_count"] == sum(
+        1 for p in prices if p >= 10
+    )
+    x_prices = [
+        d["price"]
+        for d in REPL_DOCS.values()
+        if d["tag"] == "x" and "price" in d
+    ]
+    assert aggs["only_x"]["s"]["value"] == float(sum(x_prices))
+    for b in aggs["t2"]["buckets"]:
+        t_prices = [
+            d["price"]
+            for d in REPL_DOCS.values()
+            if d["tag"] == b["key"] and "price" in d
+        ]
+        assert b["mx"]["value"] == float(max(t_prices))
+    vals = np.sort(np.asarray(prices, dtype=np.float64))
+    got_pct = aggs["pct"]["values"]
+    assert got_pct["50.0"] == float(np.percentile(vals, 50, method="linear"))
+
+
+def test_replicated_agg_only_size0_and_search_after(repl):
+    status, out = repl.dispatch(
+        "POST",
+        "/rp/_search",
+        {},
+        json.dumps(
+            {
+                "query": {"term": {"tag": "y"}},
+                "size": 0,
+                "aggs": {"n": {"value_count": {"field": "qty"}}},
+            }
+        ),
+    )
+    assert status == 200, out
+    want = sum(1 for d in REPL_DOCS.values() if d["tag"] == "y")
+    assert out["hits"]["total"]["value"] == want
+    assert out["aggregations"]["n"]["value"] == want
+    assert out["hits"]["hits"] == []
+    # search_after rides the same per-shard cursor semantics.
+    status, p1 = repl.dispatch(
+        "POST", "/rp/_search", {},
+        json.dumps({"query": {"match_all": {}},
+                    "sort": [{"qty": "asc"}], "size": 30}),
+    )
+    assert status == 200, p1
+    cursor = p1["hits"]["hits"][-1]["sort"]
+    status, p2 = repl.dispatch(
+        "POST", "/rp/_search", {},
+        json.dumps({"query": {"match_all": {}}, "sort": [{"qty": "asc"}],
+                    "size": 30, "search_after": cursor}),
+    )
+    assert status == 200, p2
+    # Strictly past the cursor key (key-only cursor excludes ties).
+    assert all(h["sort"][0] > cursor[0] for h in p2["hits"]["hits"])
+
+
+def test_replicated_still_unsupported_shapes_400(repl):
+    for body in [
+        {"size": 0, "aggs": {"th": {"terms": {"field": "tag"}, "aggs": {
+            "h": {"top_hits": {"size": 1}}}}}},
+        {"size": 0, "aggs": {"m": {"matrix_stats": {"fields": ["price", "qty"]}}}},
+    ]:
+        status, out = repl.dispatch(
+            "POST", "/rp/_search", {}, json.dumps(body)
+        )
+        assert status == 400, out
+        assert "not supported on replicated indices" in json.dumps(out)

@@ -114,13 +114,16 @@ class TestRegistryConsistency:
             if f.rule == "registry-backend"
         ]
         # [ghost] lacks both a cost seed and any surfacing site;
-        # [packed] is surfaced but unseeded (exactly one finding) —
-        # registering the multi-tenant backend without an exec/cost.py
-        # seed must fail the gate; [device] is covered and stays clean.
-        assert len(msgs) == 3
+        # [packed] and [mesh_spmd] are surfaced but unseeded (exactly one
+        # finding each) — registering the multi-tenant backend or the
+        # SPMD mesh plan class without an exec/cost.py seed must fail the
+        # gate; [device] is covered and stays clean.
+        assert len(msgs) == 4
         assert sum("[ghost]" in m for m in msgs) == 2
         packed = [m for m in msgs if "[packed]" in m]
         assert len(packed) == 1 and "cost seed" in packed[0]
+        mesh = [m for m in msgs if "[mesh_spmd]" in m]
+        assert len(mesh) == 1 and "cost seed" in mesh[0]
 
     def test_fault_sites(self, report):
         msgs = [
@@ -144,7 +147,9 @@ class TestRegistryConsistency:
         assert any("[estpu_dead_total]" in m for m in msgs)  # dead entry
         # an uncataloged packed-occupancy instrument fails like any other
         assert any("[estpu_packed_rogue_total]" in m for m in msgs)
-        assert len(msgs) == 4
+        # ... and so does an uncataloged mesh serving instrument
+        assert any("[estpu_mesh_rogue_total]" in m for m in msgs)
+        assert len(msgs) == 5
 
     def test_bool_spec(self, report):
         msgs = [f.message for f in report.findings if f.rule == "bool-spec"]
