@@ -1153,10 +1153,92 @@ def bench_cfg3_conjunction(n_shards=8, shard_docs=125_000, n_q=32):
         run_blockmax()
     blockmax_per_query = (time.monotonic() - t0) / (3 * n_q)
 
+    # Warm filter-mask re-measure (ISSUE 9): steady-state cfg3 traffic
+    # repeats its filter clauses, so each filter's [S, N] mask plane is
+    # already resident (admitted by earlier arrivals of the same filter)
+    # and the masked plan skips the filter's in-program work. Filters the
+    # lead fold already serves for free stay inline (apply_cached_masks
+    # skips the lead by design), so only queries whose masks actually
+    # engage are meaningful — cached_mask_engaged counts them. Latency is
+    # measured as INDIVIDUAL Q=1 launches (no chained-scan amortization),
+    # a conservative upper bound when routed against the scan-measured
+    # device_p50_ms.
+    from elasticsearch_tpu.index.filter_cache import (
+        FilterCache,
+        apply_cached_masks,
+    )
+    from elasticsearch_tpu.query.compile import collect_cacheable_filters
+
+    fcache = FilterCache(min_freq=1)
+    masked_plans = []
+    for qi, query in enumerate(queries):
+        fcache.record(
+            [key for _g, _i, key in collect_cacheable_filters(query)]
+        )
+
+        def build(child_spec, child_arrays):
+            plane = bm25_device.compute_filter_mask_stacked(
+                stacked, child_spec, child_arrays
+            )
+            jax.block_until_ready(plane)
+            return plane, int(plane.nbytes)
+
+        mc, masks, _reused = apply_cached_masks(
+            fcache, (("cfg3", 0), 0, 0), query, per_query[qi], build,
+            const_fill=lambda: {
+                "boost": np.zeros(n_shards, dtype=np.float32)
+            },
+        )
+        masked_plans.append(
+            (
+                mc.spec,
+                jax.tree.map(
+                    lambda x: jax.device_put(np.asarray(x)[None]), mc.arrays
+                ),
+                {**stacked, "masks": masks} if masks else stacked,
+                bool(masks),
+            )
+        )
+
+    cm_mismatches = 0
+    masked_engaged = 0
+    for qi, (spec, arrs, seg, engaged) in enumerate(masked_plans):
+        masked_engaged += int(engaged)
+        s, g, t = jax.device_get(
+            bm25_device.execute_shards_batch(seg, spec, arrs, K, shard_docs)
+        )
+        gids, o_scores, o_total = oracle_top[qi]
+        if not ranked_match(g[0], s[0], gids, o_scores) or int(
+            t[0]
+        ) != o_total:
+            cm_mismatches += 1
+    cm_times = []
+    for _ in range(3):
+        for spec, arrs, seg, _engaged in masked_plans:
+            t0 = time.monotonic()
+            jax.block_until_ready(
+                bm25_device.execute_shards_batch(
+                    seg, spec, arrs, K, shard_docs
+                )
+            )
+            cm_times.append(time.monotonic() - t0)
+    cached_mask_per_query = float(np.median(cm_times))
+
     o_p50 = float(np.median(oracle_times))
     speedup = (o_p50 / p50) if p50 > 0 and not mismatches else 0.0
     prune = instr.snapshot()["blockmax_pruned_tile_fraction"]
+    extras = {}
+    if masked_engaged:
+        extras = {
+            "cached_mask_per_query_ms": round(
+                cached_mask_per_query * 1e3, 4
+            ),
+            "cached_mask_mismatches": cm_mismatches,
+            "cached_mask_engaged": masked_engaged,
+            "cached_mask_planes_resident": fcache.stats()["entries"],
+        }
     return {
+        **extras,
         "speedup": round(speedup, 2),
         "device_p50_ms": round(p50 * 1e3, 4),
         "device_batched_per_query_ms": round(batched_per_query * 1e3, 4),
@@ -1350,6 +1432,171 @@ def bench_cfg5_knn(n=1_000_000, d=100, n_q=16):
         "dims": d,
         "n_queries": n_q,
         "upload_s": round(upload_s, 1),
+    }
+
+
+def bench_cfg8_filter_cache(segment, dev, seg_tree, mappings, n_q=48,
+                            n_hot=6, reps=3):
+    """ISSUE 9 config: repeated-filter traffic over the 1M-doc corpus.
+
+    Production filter traffic repeats: the same terms/range filter combos
+    arrive over and over while the scored must clauses vary. Cold
+    execution re-derives every filter in program each launch (dense
+    presence scatters for multi-term unions, doc-value compares for
+    ranges); warm execution substitutes the filter cache's resident mask
+    planes (index/filter_cache.py) — one gather per cached clause.
+    Reported: cold vs warm per-query p50 (INDIVIDUAL launches on both
+    sides — identical methodology, no scan amortization on either), the
+    warm sweep's cache hit rate, and the zero-mismatch gate: warm results
+    must be BIT-IDENTICAL (ids + order + fp32 scores + totals) to cold,
+    and cold must match the CPU oracle under ranked_match."""
+    import jax
+
+    from elasticsearch_tpu.index.filter_cache import (
+        FilterCache,
+        apply_cached_masks,
+    )
+    from elasticsearch_tpu.ops import bm25_device
+    from elasticsearch_tpu.query.compile import (
+        Compiler,
+        collect_cacheable_filters,
+    )
+    from elasticsearch_tpu.query.dsl import parse_query
+    from elasticsearch_tpu.search.oracle import OracleSearcher
+
+    rng = np.random.default_rng(23)
+    fld = segment.fields["body"]
+    by_df = sorted(fld.terms, key=lambda t: -fld.df[fld.terms[t]])
+    head = by_df[: max(64, len(by_df) // 100)]
+    mid = by_df[len(by_df) // 100 : len(by_df) // 4]
+
+    # The hot filter set: n_hot expensive combos (multi-term unions over
+    # head postings, half of them with a numeric doc-value range stacked
+    # on) that the traffic mix keeps repeating.
+    hot = []
+    for i in range(n_hot):
+        terms = [str(t) for t in rng.choice(head, 3, replace=False)]
+        filters = [{"terms": {"body": terms}}]
+        if i % 2:
+            lo = round(float(rng.uniform(0.0, 0.5)), 3)
+            filters.append({"range": {"f1": {"gte": lo, "lt": lo + 0.4}}})
+        hot.append(filters)
+    queries = [
+        parse_query(
+            {
+                "bool": {
+                    "must": [
+                        {
+                            "match": {
+                                "body": " ".join(
+                                    str(t)
+                                    for t in rng.choice(mid, 2, replace=False)
+                                )
+                            }
+                        }
+                    ],
+                    "filter": hot[qi % n_hot],
+                }
+            }
+        )
+        for qi in range(n_q)
+    ]
+    compiler = Compiler(dev.fields, dev.doc_values, mappings)
+    compiled = [compiler.compile(q) for q in queries]
+
+    def _p50(plans):
+        for spec, arrays, seg in plans:  # compile pass
+            jax.block_until_ready(
+                bm25_device.execute_auto(seg, spec, arrays, K)
+            )
+        times = []
+        results = []
+        for r in range(reps):
+            for spec, arrays, seg in plans:
+                t0 = time.monotonic()
+                out = bm25_device.execute_auto(seg, spec, arrays, K)
+                jax.block_until_ready(out)
+                times.append(time.monotonic() - t0)
+                if r == 0:
+                    results.append(jax.device_get(out))
+        return float(np.median(times)), results
+
+    cold_p50, cold_res = _p50(
+        [(c.spec, c.arrays, seg_tree) for c in compiled]
+    )
+
+    # Warm sweep: one usage sighting per request (the service's own
+    # admission signal — each hot combo recurs n_q/n_hot times, clearing
+    # the default min_freq), then substitution: the first arrival of each
+    # hot combo builds + admits its plane, every later one hits.
+    cache = FilterCache()
+    for q in queries:
+        cache.record([key for _g, _i, key in collect_cacheable_filters(q)])
+
+    def build(child_spec, child_arrays):
+        plane = bm25_device.compute_filter_mask(
+            seg_tree, child_spec, child_arrays
+        )
+        jax.block_until_ready(plane)
+        return plane, int(plane.nbytes)
+
+    t0 = time.monotonic()
+    warm_plans = []
+    for q, c in zip(queries, compiled):
+        mc, masks, _reused = apply_cached_masks(
+            cache, ("cfg8", 0, 0), q, c, build
+        )
+        seg = {**seg_tree, "masks": masks} if masks else seg_tree
+        warm_plans.append((mc.spec, mc.arrays, seg))
+    admit_ms = (time.monotonic() - t0) * 1e3
+    stats = cache.stats()
+    lookups = stats["hit_count"] + stats["miss_count"]
+    warm_p50, warm_res = _p50(warm_plans)
+
+    # Zero-mismatch parity gate, both halves.
+    cache_mismatches = 0
+    for (cs, ci, ct), (ws, wi, wt) in zip(cold_res, warm_res):
+        if not (
+            np.array_equal(ci, wi)
+            and np.array_equal(cs, ws)
+            and int(ct) == int(wt)
+        ):
+            cache_mismatches += 1
+    oracle = OracleSearcher(segment, mappings)
+    mismatches = cache_mismatches
+    oracle_times = []
+    for qi, q in enumerate(queries):
+        t0 = time.monotonic()
+        o_scores, o_ids, o_total = oracle.search(q, K)
+        oracle_times.append(time.monotonic() - t0)
+        s, i, t = cold_res[qi]
+        if not ranked_match(i, s, o_ids, o_scores) or int(t) != o_total:
+            mismatches += 1
+    o_p50 = float(np.median(oracle_times))
+    speedup = (o_p50 / cold_p50) if cold_p50 > 0 and not mismatches else 0.0
+    return {
+        "speedup": round(speedup, 2),
+        # Cold = today's behavior: every launch re-derives the filters.
+        "device_p50_ms": round(cold_p50 * 1e3, 4),
+        # Warm = resident planes; the routing candidate (main() feeds
+        # both numbers to the planner like every other backend pair).
+        "cached_mask_per_query_ms": round(warm_p50 * 1e3, 4),
+        "cached_mask_mismatches": cache_mismatches,
+        "warm_vs_cold_speedup": (
+            round(cold_p50 / warm_p50, 2) if warm_p50 > 0 else 0.0
+        ),
+        "oracle_p50_ms": round(o_p50 * 1e3, 4),
+        "mismatches": mismatches,
+        "hit_rate": (
+            round(stats["hit_count"] / lookups, 4) if lookups else 0.0
+        ),
+        "admissions": stats["admissions"],
+        "planes_resident": stats["entries"],
+        "plane_bytes_resident": stats["bytes_resident"],
+        "plane_admit_build_ms_total": round(admit_ms, 2),
+        "n_docs": int(seg_tree["live"].shape[0]),
+        "n_queries": n_q,
+        "n_hot_filters": n_hot,
     }
 
 
@@ -1641,6 +1888,10 @@ def main():
         ("cfg5_knn", bench_cfg5_knn),
         ("cfg6_multitenant", bench_cfg6_multitenant),
         ("cfg7_sorted_aggs", bench_cfg7_sorted_aggs),
+        (
+            "cfg8_filter_cache",
+            lambda: bench_cfg8_filter_cache(segment, dev, seg_tree, mappings),
+        ),
     ):
         try:
             configs[name] = fn()
@@ -1669,6 +1920,7 @@ def main():
         "cfg2_disjunction",
         "cfg3_conj",
         "cfg6_multitenant",
+        "cfg8_filter_cache",
     }
     for name, cfg in configs.items():
         if "error" in cfg or not cfg.get("device_p50_ms"):
@@ -1697,6 +1949,16 @@ def main():
         ):
             # Same caveat: batch-amortized lower bound on solo latency.
             measured["blockmax_conj"] = cfg["blockmax_conj_per_query_ms"]
+        if (
+            cfg.get("cached_mask_per_query_ms")
+            and cfg.get("cached_mask_mismatches") == 0
+        ):
+            # Warm filter-cache masked execution (index/filter_cache.py):
+            # planes already resident, as steady-state repeated-filter
+            # traffic sees them. Measured as individual launches — a
+            # CONSERVATIVE upper bound against scan-amortized device
+            # p50s, so routing to cached_mask is never flattered.
+            measured["cached_mask"] = cfg["cached_mask_per_query_ms"]
         plan_class = ("bench", name)
         for backend, ms in measured.items():
             for _ in range(planner.MIN_OBS):

@@ -88,10 +88,19 @@ class RequestCache:
     def evictions(self) -> int:
         return int(self._evictions.value)
 
-    def clear(self) -> None:
-        """Drop every cached entry (the `_cache/clear` API analog)."""
+    def clear(self, index_key=None) -> int:
+        """Drop cached entries (the `_cache/clear` API analog): all of
+        them, or only one index's (entries key on the index uuid as
+        their first component). Returns the number dropped."""
         with self._lock:
-            self._entries.clear()
+            if index_key is None:
+                n = len(self._entries)
+                self._entries.clear()
+                return n
+            keys = [k for k in self._entries if k[0] == index_key]
+            for k in keys:
+                del self._entries[k]
+            return len(keys)
 
     def stats(self) -> dict:
         with self._lock:

@@ -310,8 +310,14 @@ class MicroBatcher:
             # Failure isolation: this rider failed inside the coalesced
             # launch — one individual retry on the plain per-request
             # path, run HERE so a batch of failures never serializes on
-            # the scheduler thread.
-            return searcher.search(request, task=task)
+            # the scheduler thread. record_filter_usage=False: the
+            # coalesced attempt's search_many already counted this
+            # request's filter-cache sighting; counting the retry too
+            # would let a one-off filter self-admit past min_freq within
+            # a single user request.
+            return searcher.search(
+                request, task=task, record_filter_usage=False
+            )
         if item.error is not None:
             raise item.error
         return item.result

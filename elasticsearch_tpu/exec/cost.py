@@ -81,7 +81,14 @@ def coalesce_wins(extra_pad_tiles: int) -> bool:
 # ExecPlanner.BACKENDS entry must be named either here or in a seed_ms
 # branch (staticcheck registry-backend rule): an unlisted backend would
 # silently inherit a formula nobody chose for it.
-_DEVICE_LIKE = ("device", "device_batched")
+#
+# "cached_mask" is the device kernel executing a filter-cache-substituted
+# plan (index/filter_cache.py): same launch floor, but its PlanFeatures
+# work_tiles already EXCLUDE the cached clauses' worklists (a cached_mask
+# node gathers one resident plane instead of posting tiles), so the seed
+# prices mask reuse below the full-recompute device/oracle seeds exactly
+# in proportion to the filter work the plane removed.
+_DEVICE_LIKE = ("device", "device_batched", "cached_mask")
 
 
 def seed_ms(backend: str, feats: PlanFeatures) -> float:

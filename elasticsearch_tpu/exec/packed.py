@@ -214,20 +214,31 @@ class PackedExecutor:
 
     # ---------------------------------------------- searcher facade (batcher)
 
-    def search(self, wrapped: TenantSearch, task=None):
+    def search(
+        self, wrapped: TenantSearch, task=None,
+        record_filter_usage: bool = True,
+    ):
         """Solo / quarantine / retry path: the tenant's own service."""
-        return wrapped.svc.search.search(wrapped.request, task=task)
+        return wrapped.svc.search.search(
+            wrapped.request, task=task,
+            record_filter_usage=record_filter_usage,
+        )
 
-    def _solo(self, wrapped: TenantSearch, task, fallback: bool = True):
+    def _solo(
+        self, wrapped: TenantSearch, task, fallback: bool = True,
+        record: bool = True,
+    ):
         """Per-tenant execution inside a coalesced batch: result or the
         error the solo path would raise (the batcher re-raises it on the
         rider's own thread). `fallback` distinguishes riders the plane
         REFUSED (counted) from a companion-less batch of one (the normal
-        idle path — nothing to amortize, nothing fell back)."""
+        idle path — nothing to amortize, nothing fell back). `record`:
+        search_many counts every rider's filter-cache sighting at entry,
+        so its _solo fallbacks pass False — one sighting per request."""
         if fallback:
             self._fallbacks.inc()
         try:
-            return self.search(wrapped, task=task)
+            return self.search(wrapped, task=task, record_filter_usage=record)
         # staticcheck: ignore[broad-except] the batcher contract returns one result-or-exception per rider; the rider's own error must not fail batchmates
         except Exception as e:
             return e
@@ -240,13 +251,28 @@ class PackedExecutor:
         n = len(wrapped)
         if tasks is None:
             tasks = [None] * n
+        # One filter-cache admission sighting per rider, counted HERE so
+        # the tally is identical whether a rider ends up on the packed
+        # kernel (which recomputes filters — honest residue) or a _solo
+        # fallback; every downstream solo call passes record=False.
+        from ..index.filter_cache import record_filter_usage
+
+        for w in wrapped:
+            record_filter_usage(
+                getattr(w.svc.search, "filter_cache", None), w.request.query
+            )
         if n == 1:
             # No companions: nothing to amortize — the per-tenant path
             # (with its own planner routing) is the honest executor.
-            return [self._solo(wrapped[0], tasks[0], fallback=False)]
+            return [
+                self._solo(wrapped[0], tasks[0], fallback=False, record=False)
+            ]
         plane_info = self._ensure_plane([w.svc for w in wrapped])
         if plane_info is None:
-            return [self._solo(w, t) for w, t in zip(wrapped, tasks)]
+            return [
+                self._solo(w, t, record=False)
+                for w, t in zip(wrapped, tasks)
+            ]
         plane, tree, member_rows = plane_info
 
         out: list = [None] * n
@@ -297,7 +323,7 @@ class PackedExecutor:
             if errors[i] is not None:
                 out[i] = errors[i]
             elif i in solo:
-                out[i] = self._solo(w, tasks[i])
+                out[i] = self._solo(w, tasks[i], record=False)
             else:
                 out[i] = w.svc.search.assemble_plain(
                     w.request, cands[i], totals[i], timed[i], start

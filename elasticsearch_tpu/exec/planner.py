@@ -148,6 +148,12 @@ class ExecPlanner:
         # packed plane (exec/packed.py); its seed amortizes the launch
         # floor across the coalesced lanes.
         "packed",
+        # The device kernel over a filter-cache-substituted plan
+        # (index/filter_cache.py): cached filter clauses cost one plane
+        # gather instead of their worklists, so this backend's features
+        # carry the REDUCED work_tiles — mask reuse priced against the
+        # oracle's full recompute.
+        "cached_mask",
     )
 
     def __init__(self, cost_model: CostModel | None = None, metrics=None):

@@ -30,10 +30,11 @@ Translog/commitIndexWriter/recoverFromTranslog cycle of the reference
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dc_field
 from typing import Any
 
 import numpy as np
@@ -70,6 +71,15 @@ def _wall_to_mono_ts(wall_ts: float) -> float:
     return wall_ts - time.time() + time.monotonic()
 
 
+# Process-unique ids for engines and segment handles. The filter cache
+# (index/filter_cache.py) keys mask planes on these instead of id(obj):
+# CPython reuses addresses after GC, so an id()-keyed entry could silently
+# alias a NEW segment with an old segment's mask — a monotonic counter
+# cannot collide within a process.
+_ENGINE_UIDS = itertools.count(1)
+_HANDLE_UIDS = itertools.count(1)
+
+
 class InvalidCasError(ValueError):
     """Malformed CAS request (one-sided if_seq_no/if_primary_term) — 400."""
 
@@ -98,6 +108,11 @@ class SegmentHandle:
     live_dirty: bool = False
     seg_id: int | None = None  # on-disk id once persisted by flush()
     nbytes: int = 0  # device bytes held (HBM breaker accounting)
+    # Process-unique handle id: the filter cache's segment key component.
+    # dataclasses.replace (merge re-basing, scroll freezing) copies it —
+    # correct, since those clones share the SAME immutable postings and
+    # doc-values planes, so cached masks stay valid for them.
+    uid: int = dc_field(default_factory=lambda: next(_HANDLE_UIDS))
     _id_index: dict[str, int] | None = None  # lazy _id -> local (ids query)
 
     @property
@@ -148,6 +163,9 @@ class Engine:
         self.max_segments = max(1, int(max_segments))
         self.merge_factor = max(2, int(merge_factor))
         self.breaker = breaker
+        # Process-unique engine id: filter-cache key component + the
+        # per-index clear handle (`POST /{index}/_cache/clear`).
+        self.uid = next(_ENGINE_UIDS)
         self.segments: list[SegmentHandle] = []
         # Serializes the whole write path (index/delete/refresh/flush and
         # the version map) — the REST layer dispatches concurrent requests

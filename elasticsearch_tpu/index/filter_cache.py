@@ -1,0 +1,481 @@
+"""Device-resident filter/bitset cache: reusable mask planes in HBM.
+
+The TPU analog of the reference's filter-clause query cache — the shared
+`IndicesQueryCache` (indices/IndicesQueryCache.java:42) wrapping Lucene's
+LRUQueryCache under a `UsageTrackingQueryCachingPolicy`. Where Lucene
+caches a filter's DocIdSet per (query, leaf reader), here the cached
+object is the filter subtree's evaluated matched plane — a device-resident
+bool[num_docs] bitset — so a repeated filter costs ONE gather inside the
+kernel instead of re-deriving posting unions/intersections every launch.
+
+Three Lucene-shaped policies, adapted to HBM:
+
+- **Usage-tracking admission**: a bounded ring of recently-seen filter
+  keys (the policy's frequency history); a filter is admitted only on its
+  `min_freq`-th sighting, so one-off filters never occupy HBM.
+- **HBM-budgeted LRU eviction**: entries charge the node's HBM circuit
+  breaker (common/breaker.py, label "filter_cache") and an own byte
+  budget; least-recently-used planes evict first, releasing their bytes.
+- **Hard invalidation**: the solo cache key carries (engine uid, 0,
+  segment-handle uid, canonical filter key) — segment postings are
+  immutable and planes exclude the live mask, so the handle uid alone
+  scopes validity. New and merged segments mint fresh handle uids, so a
+  stale plane can never be served, while planes of UNCHANGED segments
+  keep hitting across refreshes (keying on the engine generation would
+  zero the hit rate under live write traffic); planes of merged-away
+  segments are pruned eagerly on the next store. The mesh path keys
+  (("sharded", engine-uid tuple), generation sum, 0, key) instead: its
+  stacked planes die wholesale on any refresh, so generation IS the
+  invalidator there (stale generations purged eagerly on store).
+  Soft-deletes need no invalidation at all: planes exclude the live mask,
+  which ANDs in at query time exactly as for recomputed filters.
+
+Bit-exactness is the contract (tests/test_filter_cache.py fuzz): a plane
+IS the filter subtree's own evaluation, and filter context discards
+scores, so substituting `("cached_mask", slot)` for the subtree cannot
+move top-k ids, order, fp32 scores, or totals on any execution path.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter, OrderedDict, deque
+from typing import Any, Callable
+
+import numpy as np
+
+from ..common.breaker import BreakerError
+
+# Defaults, overridable via Node env plumbing (ESTPU_FILTER_CACHE_BYTES /
+# ESTPU_FILTER_CACHE_MIN_FREQ). 256 MB holds ~256 planes of a 1M-doc
+# segment — the reference's indices.queries.cache.size (10% heap) analog.
+DEFAULT_MAX_BYTES = 256 << 20
+DEFAULT_MIN_FREQ = 2
+DEFAULT_HISTORY = 256
+
+
+class FilterCache:
+    """Mask-plane store with usage-tracking admission + LRU eviction."""
+
+    def __init__(
+        self,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        min_freq: int = DEFAULT_MIN_FREQ,
+        history: int = DEFAULT_HISTORY,
+        breaker=None,  # common.breaker.CircuitBreaker (node HBM budget)
+        metrics=None,  # obs.metrics.MetricsRegistry
+    ):
+        self.max_bytes = int(max_bytes)
+        self.min_freq = max(1, int(min_freq))
+        self.breaker = breaker
+        self._lock = threading.Lock()
+        # key -> (plane, nbytes); key = (engine uid, 0, segment-handle
+        # uid, canonical filter key) — segment postings are immutable and
+        # planes exclude the live mask, so the handle uid alone scopes
+        # validity and planes survive refreshes of OTHER segments. The
+        # mesh form is (("sharded", engine-uid tuple), generation, 0,
+        # key): stacked planes die wholesale on any refresh, so the
+        # summed generation is the invalidator there.
+        self._entries: OrderedDict[tuple, tuple[Any, int]] = OrderedDict()
+        self._bytes = 0
+        # Usage-tracking history ring: one sighting per USER request —
+        # SearchService solo requests and ShardedIndex direct searches
+        # record once, and ShardedSearchCoordinator records once per
+        # request (its per-shard scatter passes record_filter_usage=
+        # False), the policy's leaf-independent frequency count.
+        self._history: deque = deque(maxlen=max(1, int(history)))
+        self._freq: Counter = Counter()
+        if metrics is None:
+            from ..obs.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self._hits = metrics.counter(
+            "estpu_filter_cache_hits_total", "Filter-cache mask plane hits"
+        )
+        self._misses = metrics.counter(
+            "estpu_filter_cache_misses_total",
+            "Filter-cache lookups that found no plane",
+        )
+        self._admissions = metrics.counter(
+            "estpu_filter_cache_admissions_total",
+            "Filter subtrees admitted (usage threshold reached, plane "
+            "built and stored)",
+        )
+        self._evictions = metrics.counter(
+            "estpu_filter_cache_evictions_total",
+            "Mask planes evicted (LRU under the byte/HBM budget, stale "
+            "generations, or cache-clear)",
+        )
+        self._mask_reuse = metrics.counter(
+            "estpu_filter_cache_mask_reuse_total",
+            "Cache-HIT planes substituted into plans (one count per plane "
+            "per per-request segment apply; freshly built planes count on "
+            "their next apply, and N coalesced batchmates sharing a plane "
+            "count N)",
+        )
+        metrics.gauge(
+            "estpu_filter_cache_bytes_resident",
+            "HBM bytes held by cached mask planes",
+            fn=lambda: self._bytes,
+        )
+        metrics.gauge(
+            "estpu_filter_cache_entries",
+            "Live mask planes in the filter cache",
+            fn=lambda: len(self._entries),
+        )
+
+    # ------------------------------------------------------------ admission
+
+    def record(self, norm_keys) -> None:
+        """Count one sighting of each filter key (one call per shard
+        request). The ring bounds history: old sightings roll off, so a
+        filter must RECUR within the window to reach the threshold —
+        exactly UsageTrackingQueryCachingPolicy's bounded frequency ring.
+        """
+        with self._lock:
+            for key in norm_keys:
+                if len(self._history) == self._history.maxlen:
+                    oldest = self._history[0]
+                    self._freq[oldest] -= 1
+                    if self._freq[oldest] <= 0:
+                        del self._freq[oldest]
+                self._history.append(key)
+                self._freq[key] += 1
+
+    def should_admit(self, norm_key) -> bool:
+        """Has this filter recurred enough to deserve HBM residency?"""
+        with self._lock:
+            return self._freq.get(norm_key, 0) >= self.min_freq
+
+    # -------------------------------------------------------------- storage
+
+    def get(self, key: tuple):
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self._misses.inc()
+                return None
+            self._entries.move_to_end(key)
+            self._hits.inc()
+            return entry[0]
+
+    def put(
+        self, key: tuple, plane, nbytes: int, live_uids=None
+    ) -> bool:
+        """Store one plane under the byte + HBM budgets. Returns False
+        when the budgets cannot fit it even after evicting everything
+        else — the caller keeps using its freshly computed plane; only
+        residency is declined. `live_uids` (solo path) names the engine's
+        current segment-handle uids so planes of merged-away segments are
+        pruned eagerly; the mesh path invalidates by generation instead
+        (its stacked planes die wholesale on any refresh)."""
+        nbytes = int(nbytes)
+        if nbytes > self.max_bytes:
+            return False
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return True
+            while self._bytes + nbytes > self.max_bytes and self._entries:
+                self._evict_lru_locked()
+            reserved = False
+            if self.breaker is not None:
+                freed = 0
+                while True:
+                    try:
+                        self.breaker.add(nbytes, label="filter_cache")
+                        reserved = True
+                        break
+                    except BreakerError:
+                        if not self._entries or freed >= nbytes:
+                            # Once we've released at least the plane's own
+                            # bytes and the breaker STILL rejects, the
+                            # pressure is from other labels — wiping the
+                            # rest of the warm cache cannot relieve it,
+                            # so decline residency instead.
+                            return False
+                        freed += self._evict_lru_locked()
+            try:
+                self._entries[key] = (plane, nbytes)
+                self._bytes += nbytes
+            except BaseException:
+                if reserved:
+                    self.breaker.release(nbytes)
+                raise
+            self._admissions.inc()
+            # Eager stale purge: entries that can never be served again —
+            # same-scope older generations (mesh keys) and same-scope
+            # dead segment handles (solo keys) — free their HBM now
+            # instead of waiting for LRU to reach them.
+            self._purge_stale_locked(key)
+            if live_uids is not None:
+                self._prune_dead_handles_locked(key[0], live_uids, key)
+            return True
+
+    def _evict_lru_locked(self) -> int:
+        """Evict the LRU plane; returns its byte size."""
+        _key, (_plane, nbytes) = self._entries.popitem(last=False)
+        self._bytes -= nbytes
+        if self.breaker is not None:
+            self.breaker.release(nbytes)
+        self._evictions.inc()
+        return nbytes
+
+    def _purge_stale_locked(self, fresh_key: tuple) -> None:
+        """Drop same-engine/same-segment-scope entries whose generation
+        predates `fresh_key`'s (keys are (scope, generation, ...))."""
+        if len(fresh_key) < 2 or not isinstance(fresh_key[1], int):
+            return
+        scope, generation = fresh_key[0], fresh_key[1]
+        stale = [
+            k
+            for k in self._entries
+            if k[0] == scope
+            and isinstance(k[1], int)
+            and k[1] < generation
+        ]
+        for k in stale:
+            _plane, nbytes = self._entries.pop(k)
+            self._bytes -= nbytes
+            if self.breaker is not None:
+                self.breaker.release(nbytes)
+            self._evictions.inc()
+
+    def _prune_dead_handles_locked(
+        self, scope, live_uids, fresh_key: tuple
+    ) -> None:
+        """Drop same-scope entries whose segment-handle uid (key[2]) is no
+        longer among the engine's live handles — the segment was merged
+        away or dropped, so the plane can never be looked up again."""
+        dead = [
+            k
+            for k in self._entries
+            if k[0] == scope and k != fresh_key and k[2] not in live_uids
+        ]
+        for k in dead:
+            _plane, nbytes = self._entries.pop(k)
+            self._bytes -= nbytes
+            if self.breaker is not None:
+                self.breaker.release(nbytes)
+            self._evictions.inc()
+
+    def note_reuse(self, n: int) -> None:
+        """Count `n` cached planes substituted into one launch."""
+        if n > 0:
+            self._mask_reuse.inc(n)
+
+    def clear(self, scope=None) -> int:
+        """Drop entries (all, or one engine/index scope — the
+        `_cache/clear` API). Returns the number of planes dropped."""
+        with self._lock:
+            if scope is None:
+                keys = list(self._entries)
+            else:
+                keys = [k for k in self._entries if k[0] == scope]
+            for k in keys:
+                _plane, nbytes = self._entries.pop(k)
+                self._bytes -= nbytes
+                if self.breaker is not None:
+                    self.breaker.release(nbytes)
+                self._evictions.inc()
+            return len(keys)
+
+    def keys(self) -> list[tuple]:
+        """Snapshot of live entry keys, LRU-first (tests/debug)."""
+        with self._lock:
+            return list(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            entries = len(self._entries)
+            bytes_resident = self._bytes
+        return {
+            "enabled": True,
+            "entries": entries,
+            "bytes_resident": bytes_resident,
+            "hit_count": int(self._hits.value),
+            "miss_count": int(self._misses.value),
+            "admissions": int(self._admissions.value),
+            "evictions": int(self._evictions.value),
+            "mask_reuse": int(self._mask_reuse.value),
+        }
+
+    @staticmethod
+    def disabled_stats() -> dict:
+        """The `_nodes/stats` section shape under ESTPU_FILTER_CACHE=0 —
+        present (dashboards keep their panel) but honestly inert."""
+        return {
+            "enabled": False,
+            "entries": 0,
+            "bytes_resident": 0,
+            "hit_count": 0,
+            "miss_count": 0,
+            "admissions": 0,
+            "evictions": 0,
+            "mask_reuse": 0,
+        }
+
+
+def mesh_cache_scope(engines) -> tuple:
+    """The scope component of mesh-path plane keys: one index's engine-uid
+    tuple — the SINGLE definition shared by the store side
+    (parallel/mesh_serving.MeshView) and the clear side (node
+    _cache/clear + delete_index), so a future shape change cannot orphan
+    planes on the HBM breaker."""
+    return ("sharded", tuple(e.uid for e in engines))
+
+
+def clear_index_planes(cache: "FilterCache | None", engines) -> int:
+    """Drop every plane of one index — the per-engine solo scopes plus
+    the mesh scope. Returns the number of planes dropped."""
+    if cache is None:
+        return 0
+    cleared = 0
+    for engine in engines:
+        cleared += cache.clear(engine.uid)
+    cleared += cache.clear(mesh_cache_scope(engines))
+    return cleared
+
+
+def record_filter_usage(
+    cache: "FilterCache | None", query, record: bool = True
+) -> list:
+    """Count ONE admission sighting for each distinct cacheable filter
+    subtree of `query` — the single shared recording helper (SearchService
+    solo requests, ShardedSearchCoordinator once per user request,
+    ShardedIndex direct searches), so the one-sighting-per-request
+    invariant has one implementation. `record=False` collects without
+    counting: the caller's request was already counted upstream (per-shard
+    scatter, mesh consult, batcher solo retry). Returns the collected
+    [(group, idx, key)] entries for reuse by apply_cached_masks (no second
+    AST walk)."""
+    from ..query.compile import collect_cacheable_filters
+
+    if cache is None:
+        return []  # disabled: skip the AST walk too — nothing downstream
+    entries = collect_cacheable_filters(query)
+    if record and entries:
+        # Dedup within the request: bool.filter = [F, F] (or F in both
+        # filter and must_not) is still ONE sighting of F — otherwise a
+        # one-off query with a duplicated clause self-admits past
+        # min_freq on its very first request.
+        cache.record(list(dict.fromkeys(k for _g, _i, k in entries)))
+    return entries
+
+
+# ---------------------------------------------------------------------------
+# Plan substitution: compiled bool spec -> masked bool spec.
+# ---------------------------------------------------------------------------
+
+
+def apply_cached_masks(
+    cache: FilterCache | None,
+    key_prefix: tuple,
+    query,
+    compiled,
+    build_mask: Callable[[tuple, Any], tuple[Any, int]],
+    const_fill: Callable[[], dict] | None = None,
+    entries: list | None = None,
+    live_uids=None,
+):
+    """Substitute cached mask planes for this plan's cacheable top-level
+    filter-context clauses.
+
+    `key_prefix` scopes the cache key (single-segment: (engine uid, 0,
+    handle uid); mesh: (("sharded", engine-uid tuple), sum(gens), 0));
+    `build_mask(child_spec, child_arrays) -> (plane, nbytes)` evaluates a
+    missing plane (called OUTSIDE the cache lock — it launches a kernel);
+    `const_fill()` builds the substituted clause's replacement arrays
+    (default: a scalar zero boost — the sharded path supplies a
+    per-shard-stacked one so every plan leaf keeps its leading axis).
+
+    Returns (compiled', masks, reused): `masks` maps mask slot -> plane
+    for the kernel's seg["masks"] input (empty = nothing substituted),
+    `reused` counts planes served from cache rather than built. Clause
+    order, count, and the lead choice are preserved, so every downstream
+    consumer (sparse eligibility, lead folds, unify/pad) sees a
+    structurally intact bool spec.
+    """
+    from ..query.compile import (
+        CompiledQuery,
+        collect_cacheable_filters,
+        make_bool_spec,
+    )
+
+    if cache is None:
+        return compiled, {}, 0
+    spec = compiled.spec
+    if not (isinstance(spec, tuple) and spec and spec[0] == "bool"):
+        return compiled, {}, 0
+    if entries is None:  # callers that already collected pass the list
+        entries = collect_cacheable_filters(query)
+    if not entries:
+        return compiled, {}, 0
+    must_s, should_s, filter_s, must_not_s = spec[1:5]
+    lead = spec[6]
+    n_must, n_should, n_filter = len(must_s), len(should_s), len(filter_s)
+    children = list(compiled.arrays["children"])
+    new_filter = list(filter_s)
+    new_must_not = list(must_not_s)
+    masks: dict[int, Any] = {}
+    reused = 0
+    slot = 0
+    for group, idx, norm in entries:
+        if group == "filter":
+            if idx >= n_filter:
+                continue  # compile rewrote the clause list; stay out
+            if lead >= 0 and idx == lead:
+                # The lead-driven fold reads candidates straight off this
+                # filter's posting span (no union, no sort) — already the
+                # zero-extra-work path; masking it would only discard the
+                # candidate source.
+                continue
+            child_spec = new_filter[idx]
+            flat = n_must + n_should + idx
+        else:
+            if idx >= len(must_not_s):
+                continue
+            child_spec = new_must_not[idx]
+            flat = n_must + n_should + n_filter + idx
+        if child_spec == ("match_none",):
+            # Unmapped-field filters: free to evaluate, and skipping them
+            # keeps a later mapping addition from pinning a stale plane.
+            continue
+        key = (*key_prefix, norm)
+        plane = cache.get(key)
+        if plane is None:
+            if not cache.should_admit(norm):
+                continue
+            plane, nbytes = build_mask(child_spec, children[flat])
+            cache.put(key, plane, nbytes, live_uids=live_uids)
+        else:
+            reused += 1
+        masks[slot] = plane
+        sub = ("cached_mask", slot)
+        if group == "filter":
+            new_filter[idx] = sub
+        else:
+            new_must_not[idx] = sub
+        children[flat] = (
+            const_fill() if const_fill is not None
+            else {"boost": np.float32(0.0)}
+        )
+        slot += 1
+    if not masks:
+        return compiled, {}, 0
+    cache.note_reuse(reused)
+    new_spec = make_bool_spec(
+        must_s, should_s, new_filter, new_must_not, msm=spec[5], lead=lead
+    )
+    new_arrays = dict(compiled.arrays)
+    new_arrays["children"] = tuple(children)
+    return CompiledQuery(spec=new_spec, arrays=new_arrays), masks, reused
+
+
+def mask_group_token(masks: dict[int, Any]) -> tuple:
+    """Launch-grouping identity of a plan's mask planes: coalesced
+    batchmates may share ONE launch (and one seg["masks"] input) only
+    when every slot points at the same plane object. Planes are held
+    alive by the cache entries (or the local plan) for the token's whole
+    lifetime, so id() cannot alias here."""
+    return tuple((slot, id(plane)) for slot, plane in sorted(masks.items()))

@@ -34,6 +34,12 @@ from .common.tasks import TaskCancelledError, TaskManager
 from .faults import REGISTRY as FAULTS
 from .faults import FaultSpec, InjectedFaultError
 from .index.engine import Engine, InvalidCasError, VersionConflictError
+from .index.filter_cache import (
+    DEFAULT_MAX_BYTES as FILTER_CACHE_DEFAULT_BYTES,
+    DEFAULT_MIN_FREQ as FILTER_CACHE_DEFAULT_MIN_FREQ,
+    FilterCache,
+    clear_index_planes,
+)
 from .index.mapping import Mappings
 from .obs.metrics import DeviceInstruments, MetricsRegistry
 from .obs.tracing import TRACER
@@ -254,6 +260,28 @@ class Node:
             fn=lambda: TRACER.stats()["buffered_traces"],
         )
         self.request_cache = RequestCache(metrics=self.metrics)
+        # Filter/bitset cache (index/filter_cache.py): device-resident
+        # mask planes for repeated filter-context subtrees, charged
+        # against the node HBM breaker, usage-tracking admission + LRU
+        # eviction. ESTPU_FILTER_CACHE=0 opts out (every path recomputes).
+        self.filter_cache = None
+        if os.environ.get("ESTPU_FILTER_CACHE", "1") != "0":
+            self.filter_cache = FilterCache(
+                max_bytes=int(
+                    os.environ.get(
+                        "ESTPU_FILTER_CACHE_BYTES",
+                        FILTER_CACHE_DEFAULT_BYTES,
+                    )
+                ),
+                min_freq=int(
+                    os.environ.get(
+                        "ESTPU_FILTER_CACHE_MIN_FREQ",
+                        FILTER_CACHE_DEFAULT_MIN_FREQ,
+                    )
+                ),
+                breaker=self.breaker,
+                metrics=self.metrics,
+            )
         self.tasks = TaskManager(node_name)
         # Degraded-mode serving counters (GET /_nodes/stats
         # search_resilience): partial responses served, shard failures
@@ -464,16 +492,18 @@ class Node:
         if n_shards == 1:
             search = SearchService(
                 engines[0], name, planner=self.exec_planner,
-                device=self.device,
+                device=self.device, filter_cache=self.filter_cache,
             )
         else:
             search = ShardedSearchCoordinator(
                 engines, name, planner=self.exec_planner,
-                device=self.device,
+                device=self.device, filter_cache=self.filter_cache,
             )
             from .parallel.mesh_serving import maybe_mesh_view
 
-            search.mesh_view = maybe_mesh_view(engines, mappings, params)
+            search.mesh_view = maybe_mesh_view(
+                engines, mappings, params, filter_cache=self.filter_cache
+            )
             if search.mesh_view is not None:
                 # SPMD servings feed the same cost model/counters so
                 # `_nodes/stats` shows every backend's traffic share, and
@@ -892,6 +922,11 @@ class Node:
                 raise ApiError(
                     503, "master_not_discovered_exception", str(e)
                 ) from None
+        # Drop the index's filter-cache planes BEFORE closing: the engine
+        # uids can never be looked up again, and orphaned planes would
+        # stay charged to the shared HBM breaker until unrelated traffic
+        # happens to LRU-evict them.
+        clear_index_planes(self.filter_cache, self.indices[name].engines)
         for engine in self.indices[name].engines:
             engine.close()
         del self.indices[name]
@@ -925,6 +960,42 @@ class Node:
         for name in list(self.indices):
             self.refresh(name)
         return {"_shards": {"failed": 0}}
+
+    def clear_cache(self, index: str | None = None) -> dict:
+        """POST [/{index}]/_cache/clear — drop filter-cache mask planes
+        and request-cache entries (for one index/pattern, or node-wide),
+        reporting per-cache cleared counts like the reference's
+        ClearIndicesCacheResponse carries per-shard results."""
+        if index is None:
+            targets = sorted(self.indices)
+        else:
+            targets = self.expand_index_patterns(index)
+            if index != "_all":
+                # Concrete names 404 when missing — each element of a
+                # comma list individually, like the reference; wildcards
+                # matching nothing clear nothing successfully.
+                for part in index.split(","):
+                    if part and not any(ch in part for ch in "*?"):
+                        self.get_index(part)  # raises index_not_found
+        cleared_filter = 0
+        cleared_request = 0
+        shards = 0
+        for name in targets:
+            svc = self.indices.get(name)
+            if svc is None:
+                continue
+            shards += svc.n_shards
+            cleared_filter += clear_index_planes(
+                self.filter_cache, svc.engines
+            )
+            cleared_request += self.request_cache.clear(svc.uuid)
+        return {
+            "_shards": {"total": shards, "successful": shards, "failed": 0},
+            "cleared": {
+                "filter_cache": cleared_filter,
+                "request_cache": cleared_request,
+            },
+        }
 
     def expand_index_patterns(self, name: str) -> list[str]:
         """_all / comma-lists / wildcards -> concrete index names
@@ -3600,6 +3671,15 @@ class Node:
                 # Shard request cache hit/miss/eviction counters
                 # (indices/IndicesRequestCache stats analog).
                 "request_cache": self.request_cache.stats(),
+                # Filter/bitset cache (indices/IndicesQueryCache analog):
+                # mask-plane hits/misses/admissions/evictions + resident
+                # HBM bytes. Present (inert) under ESTPU_FILTER_CACHE=0
+                # so dashboards keep their panel.
+                "filter_cache": (
+                    self.filter_cache.stats()
+                    if self.filter_cache is not None
+                    else FilterCache.disabled_stats()
+                ),
             },
             "breakers": {"hbm": self.breaker.stats()},
             "indexing_pressure": self.indexing_pressure.stats(),

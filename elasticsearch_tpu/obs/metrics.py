@@ -384,6 +384,25 @@ CATALOG = {
     # non_uniform_plan, execute_error) — a silent mesh decline is a bug.
     "estpu_mesh_served_total": ("counter", "mesh_serving"),
     "estpu_mesh_fallback_total": ("counter", "mesh_serving"),
+    # Filter/bitset cache (index/filter_cache.py): device-resident mask
+    # planes for repeated filter-context subtrees — the IndicesQueryCache
+    # analog, surfaced under `_nodes/stats` indices.filter_cache.
+    "estpu_filter_cache_hits_total": ("counter", "indices.filter_cache"),
+    "estpu_filter_cache_misses_total": ("counter", "indices.filter_cache"),
+    "estpu_filter_cache_admissions_total": (
+        "counter",
+        "indices.filter_cache",
+    ),
+    "estpu_filter_cache_evictions_total": (
+        "counter",
+        "indices.filter_cache",
+    ),
+    "estpu_filter_cache_mask_reuse_total": (
+        "counter",
+        "indices.filter_cache",
+    ),
+    "estpu_filter_cache_bytes_resident": ("gauge", "indices.filter_cache"),
+    "estpu_filter_cache_entries": ("gauge", "indices.filter_cache"),
     "estpu_request_cache_hits_total": ("counter", "indices.request_cache"),
     "estpu_request_cache_misses_total": (
         "counter",

@@ -57,6 +57,10 @@ NEG_INF = float("-inf")
 #   ("const", child_spec)                 — constant_score wrapper
 #   ("match_all",)                        — every live doc, constant score
 #   ("match_none",)                       — no doc
+#   ("cached_mask", slot)                 — filter-cache plane: matched =
+#       seg["masks"][slot], a device-resident bool[N] evaluated once by
+#       compute_filter_mask and reused across requests (filter cache,
+#       index/filter_cache.py)
 #   ("bool", (must...), (should...), (filter...), (must_not...), msm, lead)
 #       msm: minimum_should_match (int; -1 = default rule)
 #       lead: index of the single-span constant FILTER clause that drives
@@ -147,6 +151,18 @@ def _eval_node(spec, arrays, seg: dict[str, Any], num_docs: int):
             ve = v ** arrays["exponent"]
             s = ve / (ve + arrays["pivot"] ** arrays["exponent"])
         scores = jnp.where(matched, arrays["boost"] * s, jnp.float32(0.0))
+        return scores, matched
+    if kind == "cached_mask":
+        # A filter-cache plane (index/filter_cache.py): the subtree's
+        # matched set was evaluated once and parked in HBM; the node is
+        # a plain read of seg["masks"][slot]. Bit-identical to
+        # re-evaluating the original filter subtree by construction (the
+        # plane IS that evaluation), so substitution never moves top-k,
+        # scores, or totals. Filter context discards scores, but the
+        # node still reports boost-where-matched like every constant
+        # leaf so a (never-produced) scoring placement would not differ.
+        matched = seg["masks"][spec[1]]
+        scores = jnp.where(matched, arrays["boost"], jnp.float32(0.0))
         return scores, matched
     if kind == "match_all":
         matched = jnp.ones(num_docs, dtype=bool)
@@ -767,13 +783,16 @@ def supports_sparse(spec) -> bool:
         return spec[3] <= SPARSE_TPAD_MAX
     if spec[0] == "bool":
         must_s, should_s, filter_s, must_not_s = spec[1:5]
+        # cached_mask clauses (filter-cache planes) verify at candidates
+        # with ONE gather — cheaper than either membership primitive.
+        const_kinds = ("terms_const", "cached_mask")
         return (
             len(must_s) == 1
             and must_s[0][0] == "terms"
             and must_s[0][3] <= SPARSE_TPAD_MAX
             and not should_s
-            and all(c[0] == "terms_const" for c in filter_s)
-            and all(c[0] == "terms_const" for c in must_not_s)
+            and all(c[0] in const_kinds for c in filter_s)
+            and all(c[0] in const_kinds for c in must_not_s)
         )
     return False
 
@@ -794,9 +813,12 @@ def _sparse_inner(seg, spec, arrays, k: int, bounds=None):
 
 
 def _const_membership(seg, child_spec, carr, safe_docs, num_docs):
-    """Constant-clause membership test at candidate docs: binary search
+    """Constant-clause membership test at candidate docs: a cached
+    filter-mask plane gathers directly (zero posting work), binary search
     for single contiguous spans (O(P log df), no [N]-sized scatter), the
     dense presence bitmap gathered at candidates otherwise."""
+    if child_spec[0] == "cached_mask":
+        return seg["masks"][child_spec[1]][safe_docs]
     if len(child_spec) == 4 and child_spec[3] == 1:
         return _span_member(
             seg, child_spec[1], carr["span_start"], carr["span_end"],
@@ -1769,6 +1791,34 @@ def execute_sorted(seg, spec, arrays, field_name: str, desc: bool, k: int,
     values = col[ids]
     total = jnp.sum(eligible, dtype=jnp.int32)
     return values, ids.astype(jnp.int32), total
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def compute_filter_mask(seg, spec, arrays):
+    """Evaluate one filter-context plan to its matched plane — the
+    device-resident bitset the filter cache stores (index/filter_cache).
+
+    The live mask is deliberately NOT applied: deletions AND in at query
+    time exactly as for recomputed filters, so cached planes survive
+    soft-deletes unchanged (postings/doc-values are immutable per packed
+    segment; only refresh/merge produce new segments — and new cache
+    keys)."""
+    num_docs = seg["live"].shape[0]
+    _, matched = _eval_node(spec, arrays, seg, num_docs)
+    return matched
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def compute_filter_mask_stacked(seg_stacked, spec, arrays_stacked):
+    """Per-shard filter-mask planes over S stacked shards ([S, N] bool)
+    — the mesh-path (parallel/sharded.py) form of compute_filter_mask."""
+
+    def one(seg, arrays):
+        num_docs = seg["live"].shape[0]
+        _, matched = _eval_node(spec, arrays, seg, num_docs)
+        return matched
+
+    return jax.vmap(one)(seg_stacked, arrays_stacked)
 
 
 @partial(jax.jit, static_argnames=("spec",))

@@ -68,6 +68,7 @@ from typing import Any
 
 import numpy as np
 
+from ..index.filter_cache import mesh_cache_scope
 from ..index.segment import Segment, SegmentBuilder
 from ..index.tiles import TILE, pack_segment
 from ..ops.bm25_device import segment_tree
@@ -277,12 +278,20 @@ class _Snapshot:
 class MeshView:
     """Generation-consistent device mesh view of one index's shards."""
 
-    def __init__(self, engines, mappings, params, mesh, axis: str = "shard"):
+    def __init__(self, engines, mappings, params, mesh, axis: str = "shard",
+                 filter_cache=None):
         self.engines = engines
         self.mappings = mappings
         self.params = params
         self.mesh = mesh
         self.axis = axis
+        # index.filter_cache.FilterCache (the node's, when wired by
+        # create_index): the plain-scoring serve path substitutes cached
+        # [S, N] mask planes for repeated filter clauses. Keys scope on
+        # the engines' uid tuple with the generation SUM as the
+        # monotonic invalidation component, so a refresh of any shard
+        # stales every plane of this view (purged eagerly on next store).
+        self.filter_cache = filter_cache
         self._lock = threading.Lock()
         self._snap: _Snapshot | None = None
         # Per-shard cache reused across refreshes.
@@ -566,6 +575,9 @@ class MeshView:
                 params=self.params,
                 serving_stats=stats,
                 pack_avgdls=list(self._pack_avgdl),
+                filter_cache=self.filter_cache,
+                cache_scope=mesh_cache_scope(self.engines),
+                cache_generation=sum(gens),
             )
             self._snap = _Snapshot(
                 gens=gens,
@@ -706,7 +718,7 @@ class MeshView:
         )
         return agg, per_shard[0][0], arrays
 
-    def serve(self, coordinator, request, task=None):
+    def serve(self, coordinator, request, task=None, fc_entries=None):
         """Answer a SearchRequest via ONE SPMD program — scoring, sorted or
         score-ordered top-k with search_after masking, psum'd totals, and
         the aggregation planes all inside a single shard_map launch — or
@@ -790,11 +802,29 @@ class MeshView:
         try:
             if plain:
                 # The hot plain-score path keeps the candidate-centric
-                # sparse kernel (no dense planes, no agg planes).
+                # sparse kernel (no dense planes, no agg planes). Filter
+                # cache: repeated filter clauses swap in their cached
+                # [S, N] mask planes (bit-identical by construction —
+                # gated by tests/test_filter_cache.py's mesh fuzz); the
+                # sorted/agg one-launch program still recomputes filters
+                # (honest residue, ROADMAP item 3).
+                seg = idx.seg_stacked
+                if idx.filter_cache is not None:
+                    # record=False: the coordinator already counted this
+                    # request's sighting; recording here too would
+                    # double-count whenever execution fails and the
+                    # request falls back to the host loop. Its collected
+                    # entries ride along so the AST isn't re-walked.
+                    compiled, fc_masks = idx._apply_filter_cache(
+                        request.query, compiled, record=False,
+                        entries=fc_entries,
+                    )
+                    if fc_masks:
+                        seg = {**idx.seg_stacked, "masks": fc_masks}
                 scores, gids, total = sharded_execute(
                     idx.mesh,
                     idx.axis,
-                    idx.seg_stacked,
+                    seg,
                     compiled.arrays,
                     compiled.spec,
                     k,
@@ -911,7 +941,9 @@ class MeshView:
         )
 
 
-def maybe_mesh_view(engines, mappings, params) -> MeshView | None:
+def maybe_mesh_view(
+    engines, mappings, params, filter_cache=None
+) -> MeshView | None:
     """A MeshView when SPMD serving can work here: >1 shard, enough local
     devices for one shard per device, and not disabled via
     ESTPU_MESH_SERVING=0."""
@@ -932,4 +964,4 @@ def maybe_mesh_view(engines, mappings, params) -> MeshView | None:
     mesh = Mesh(
         np.array(devices[: len(engines)]), ("shard",)
     )
-    return MeshView(engines, mappings, params, mesh)
+    return MeshView(engines, mappings, params, mesh, filter_cache=filter_cache)

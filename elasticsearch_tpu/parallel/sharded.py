@@ -28,7 +28,8 @@ host for the fetch phase (`locate`).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import itertools
+from dataclasses import dataclass, field as dc_field
 from functools import partial
 from typing import Any
 
@@ -157,6 +158,9 @@ def fill_union_schema(
     )
 
 
+_SHARDED_UIDS = itertools.count(1)
+
+
 @dataclass
 class ShardedIndex:
     """N shards stacked on a leading mesh axis, searchable as one program."""
@@ -168,11 +172,28 @@ class ShardedIndex:
     seg_stacked: Any  # pytree: every leaf [n_shards, ...], device-sharded
     docs_per_shard: int  # padded per-shard doc capacity (global id stride)
     params: BM25Params
+    # index.filter_cache.FilterCache: when set, `search` substitutes
+    # cacheable filter-context clauses with [S, N] stacked mask planes
+    # (computed once via compute_filter_mask_stacked, keyed on this
+    # index's process-unique uid — shards are immutable, so planes never
+    # go stale; the cache's LRU/HBM budget still bounds residency).
+    filter_cache: Any = None
+    # Cache-key scope + generation override (mesh_serving.MeshView): a
+    # refresh-tracking view sets scope to its engines' uid tuple and
+    # generation to their monotonic sum, so snapshot rebuilds invalidate
+    # planes via the ordinary stale-generation purge and the per-index
+    # `_cache/clear` can address them. None = the immutable default
+    # (this instance's process-unique uid, generation pinned 0).
+    cache_scope: Any = None
+    cache_generation: int = 0
     _stats_cache: dict[str, FieldStats] | None = None
     _id_indexes: list[dict[str, int] | None] | None = None
     # Memoized per-(shard, field) tile doc-id bounds for plan-time
     # conjunction range pruning (computed once; shards are immutable).
     _tile_bounds: dict | None = None
+    _cache_uid: int = dc_field(
+        default_factory=lambda: next(_SHARDED_UIDS)
+    )
 
     def _field_tile_bounds(self, shard: int, name: str):
         if self._tile_bounds is None:
@@ -475,13 +496,66 @@ class ShardedIndex:
         """global doc id -> (shard, local doc id) for the fetch phase."""
         return divmod(int(global_doc), self.docs_per_shard)
 
+    def _apply_filter_cache(
+        self, query: Query, compiled: CompiledQuery, record: bool = True,
+        entries: list | None = None,
+    ):
+        """Mesh-path filter cache: substitute [S, N] stacked mask planes
+        for cacheable top-level filter clauses. The planes ride the seg
+        pytree (P(axis)-sharded like every other plane), so the shard_map
+        body reads its own shard's row — bit-identical to recomputing the
+        clause in-program. `record=False` skips the admission sighting
+        (MeshView.serve passes it: the coordinator already recorded the
+        request, and an execute-failure fallback to the host loop must
+        not leave a second sighting behind)."""
+        from ..index.filter_cache import (
+            apply_cached_masks,
+            record_filter_usage,
+        )
+        from ..ops.bm25_device import compute_filter_mask_stacked
+
+        cache = self.filter_cache
+        if entries is None:
+            entries = record_filter_usage(cache, query, record=record)
+        if not entries:
+            return compiled, {}
+
+        def build(child_spec, child_arrays):
+            plane = compute_filter_mask_stacked(
+                self.seg_stacked, child_spec, child_arrays
+            )
+            plane = jax.device_put(
+                plane, NamedSharding(self.mesh, P(self.axis))
+            )
+            return plane, int(plane.nbytes)
+
+        scope = (
+            self.cache_scope
+            if self.cache_scope is not None
+            else ("sharded", self._cache_uid)
+        )
+        prefix = (scope, int(self.cache_generation), 0)
+        compiled, masks, _reused = apply_cached_masks(
+            cache, prefix, query, compiled, build,
+            const_fill=lambda: {
+                "boost": np.zeros(self.n_shards, dtype=np.float32)
+            },
+            entries=entries,
+        )
+        return compiled, masks
+
     def search(self, query: Query, k: int = 10):
         """One-call sharded search: (scores f32[k'], global_ids, total)."""
         compiled = self.compile(query)
+        seg = self.seg_stacked
+        if self.filter_cache is not None:
+            compiled, masks = self._apply_filter_cache(query, compiled)
+            if masks:
+                seg = {**self.seg_stacked, "masks": masks}
         scores, ids, total = sharded_execute(
             self.mesh,
             self.axis,
-            self.seg_stacked,
+            seg,
             compiled.arrays,
             compiled.spec,
             k,

@@ -364,6 +364,95 @@ def select_lead_clause(groups) -> int:
     return best
 
 
+# ---------------------------------------------------------------------------
+# Filter-cache normalization (index/filter_cache.py).
+#
+# A filter-context subtree is CACHEABLE when its matched set is a pure
+# function of the segment's postings/doc-values — constant-scoring and
+# statistics-free, so the evaluated bool[num_docs] plane can be reused
+# verbatim across requests (the reference caches exactly this family via
+# UsageTrackingQueryCachingPolicy + LRUQueryCache). `cacheable_filter_key`
+# canonicalizes such a subtree to a hashable key: equal keys MUST imply
+# bit-identical matched planes (boosts are dropped — filter context
+# discards scores; terms sort — disjunction order cannot move the mask).
+# ---------------------------------------------------------------------------
+
+
+def cacheable_filter_key(q) -> tuple | None:
+    """Canonical cache key of a filter-context query subtree, or None
+    when the shape is not cacheable (statistics-dependent, positional,
+    script-driven, or otherwise not a pure postings/doc-values set)."""
+    from .dsl import (
+        BoolQuery as _Bool,
+        ConstantScoreQuery as _Const,
+        ExistsQuery as _Exists,
+        RangeQuery as _Range,
+        TermQuery as _Term,
+        TermsQuery as _Terms,
+    )
+
+    if isinstance(q, _Term):
+        return ("term", q.field_name, str(q.value))
+    if isinstance(q, _Terms):
+        if not q.values:
+            return None
+        return ("terms", q.field_name, tuple(sorted(str(v) for v in q.values)))
+    if isinstance(q, _Range):
+        return (
+            "range",
+            q.field_name,
+            str(q.gte),
+            str(q.gt),
+            str(q.lte),
+            str(q.lt),
+        )
+    if isinstance(q, _Exists):
+        return ("exists", q.field_name)
+    if isinstance(q, _Const):
+        # constant_score in filter context matches exactly its filter.
+        return cacheable_filter_key(q.filter)
+    if isinstance(q, _Bool):
+        # Pure-filter composite: every child must itself be cacheable.
+        # minimum_should_match participates (it changes the matched set).
+        groups = []
+        for clause in (q.must, q.should, q.filter, q.must_not):
+            keys = []
+            for child in clause:
+                key = cacheable_filter_key(child)
+                if key is None:
+                    return None
+                keys.append(key)
+            groups.append(tuple(keys))
+        if not any(groups):
+            return None
+        # staticcheck: ignore[bool-spec] this is a filter-CACHE KEY over the query AST, not the arity-7 compiled bool spec
+        return ("bool", *groups, q.minimum_should_match)
+    return None
+
+
+def collect_cacheable_filters(query) -> list[tuple[str, int, tuple]]:
+    """The cacheable filter-context clauses of a top-level bool query:
+    [(group, clause index, canonical key)] with group in
+    ("filter", "must_not") — the positions index/filter_cache.py may
+    substitute with cached mask planes. Non-bool roots yield nothing
+    (must/should clauses score, so their subtrees are never mask-
+    substitutable)."""
+    from .dsl import BoolQuery as _Bool
+
+    if not isinstance(query, _Bool):
+        return []
+    out: list[tuple[str, int, tuple]] = []
+    for group, clauses in (
+        ("filter", query.filter),
+        ("must_not", query.must_not),
+    ):
+        for i, clause in enumerate(clauses):
+            key = cacheable_filter_key(clause)
+            if key is not None:
+                out.append((group, i, key))
+    return out
+
+
 def _wildcard_regex(pattern: str, case_insensitive: bool):
     """ES wildcard semantics: `*` = any run, `?` = any single char; every
     other character is literal (no character classes)."""

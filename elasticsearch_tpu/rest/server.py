@@ -361,6 +361,13 @@ class RestServer:
             ))
             r(method, "/_refresh", lambda s, p, q, b: n.refresh_all())
             r(method, "/_flush", lambda s, p, q, b: n.flush_all())
+        # Cache administration (the reference's clear-cache API,
+        # RestClearIndicesCacheAction): drops filter-cache mask planes
+        # and request-cache entries; per-cache cleared counts returned.
+        r("POST", "/_cache/clear", lambda s, p, q, b: n.clear_cache())
+        r("POST", "/{index}/_cache/clear", lambda s, p, q, b: n.clear_cache(
+            p["index"]
+        ))
         r("POST", "/_forcemerge", lambda s, p, q, b: [
             n.force_merge(name, int(q.get("max_num_segments", 1)))
             for name in list(n.indices)

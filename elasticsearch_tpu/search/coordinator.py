@@ -109,17 +109,24 @@ class ShardedSearchCoordinator:
         index_name: str = "index",
         planner=None,
         device=None,
+        filter_cache=None,
     ):
         self.engines = engines
         self.index_name = index_name
         # One exec.ExecPlanner shared by every shard service: plan-class
         # cost EWMAs and decision counters are node-scoped, so every
         # shard's observations calibrate the same model. The same goes
-        # for the obs.DeviceInstruments launch-site metrics.
+        # for the obs.DeviceInstruments launch-site metrics and the
+        # node-wide filter cache (index/filter_cache.py) — shard engines
+        # key their mask planes into one HBM-budgeted store.
         self.planner = planner
         self.device = device
+        self.filter_cache = filter_cache
         self.services = [
-            SearchService(e, index_name, planner=planner, device=device)
+            SearchService(
+                e, index_name, planner=planner, device=device,
+                filter_cache=filter_cache,
+            )
             for e in engines
         ]
         self._stats_cache = None
@@ -158,9 +165,29 @@ class ShardedSearchCoordinator:
             self._stats_gen = gen
         return self._stats_cache
 
-    def search(self, request: SearchRequest, task=None) -> SearchResponse:
+    def search(
+        self, request: SearchRequest, task=None,
+        record_filter_usage: bool = True,
+    ) -> SearchResponse:
         import time
 
+        # Filter-cache admission: ONE sighting per user request, recorded
+        # BEFORE the mesh attempt so neither outcome double-counts — the
+        # mesh consult applies masks without recording (record=False
+        # below), and per-shard SearchService calls on the host path get
+        # record_filter_usage=False. An n-shard scatter (or a mesh
+        # consult followed by an execute-failure fallback) must not count
+        # extra sightings, or one-off filters self-admit past min_freq
+        # within their very first request. The batcher's solo retry after
+        # a failed coalesced launch passes record_filter_usage=False for
+        # the same reason: search_many already counted that request.
+        from ..index.filter_cache import (
+            record_filter_usage as _record_filter_usage,
+        )
+
+        fc_entries = _record_filter_usage(
+            self.filter_cache, request.query, record=record_filter_usage
+        )
         if self.mesh_view is not None:
             # The SPMD serving path: ONE shard_map program over the mesh —
             # one span, since there are no per-shard launches to trace.
@@ -172,7 +199,9 @@ class ShardedSearchCoordinator:
                 # reason label estpu_mesh_fallback_total carries) to this
                 # span from inside serve() — thread-safe, unlike reading
                 # a shared last-reason attribute back here.
-                resp = self.mesh_view.serve(self, request, task)
+                resp = self.mesh_view.serve(
+                    self, request, task, fc_entries=fc_entries
+                )
                 if mesh_span is not None:
                     mesh_span.tags["served"] = resp is not None
             if resp is not None:
@@ -215,7 +244,10 @@ class ShardedSearchCoordinator:
         )
         if k > 0 or agg_total is None:
             merged, total, max_score, timed_out, profiles, skipped, failures = (
-                self._scatter_merge(shard_request, stats, snapshots, task=task)
+                self._scatter_merge(
+                    shard_request, stats, snapshots, task=task,
+                    fc_entries=fc_entries,
+                )
             )
         else:
             merged, total, max_score, timed_out, profiles, skipped, failures = (
@@ -305,6 +337,14 @@ class ShardedSearchCoordinator:
         if tasks is None:
             tasks = [None] * len(requests)
         n = len(requests)
+        # One filter-cache admission sighting per rider (not per shard);
+        # the collected entries thread through every shard's batched pass
+        # so the query ASTs are walked once, not once per shard.
+        from ..index.filter_cache import record_filter_usage
+
+        fc_entries = [
+            record_filter_usage(self.filter_cache, r.query) for r in requests
+        ]
         snapshots = [list(e.segments) for e in self.engines]
         stats = self.global_stats(snapshots)
         ks = [max(0, r.from_) + max(0, r.size) for r in requests]
@@ -345,6 +385,8 @@ class ShardedSearchCoordinator:
                         stats,
                         snapshots[shard_idx],
                         [tasks[i] for i in rows],
+                        record_filter_usage=False,
+                        fc_entries=[fc_entries[i] for i in rows],
                     )
             except (ValueError, TypeError, TaskCancelledError):
                 raise
@@ -467,6 +509,7 @@ class ShardedSearchCoordinator:
         snapshots: list[list],
         per_shard_after: list | None = None,
         task=None,
+        fc_entries: list | None = None,
     ) -> tuple[list[tuple], int, float | None, bool, list[dict]]:
         """Fan one request out to every shard and merge by
         (merge key, shard, per-shard rank) — the single implementation of
@@ -528,7 +571,8 @@ class ShardedSearchCoordinator:
                     )
                     resp = svc.search(
                         sub, stats=stats, segments=snapshots[shard_idx],
-                        task=task,
+                        task=task, record_filter_usage=False,
+                        fc_entries=fc_entries,
                     )
             except (ValueError, TypeError, TaskCancelledError):
                 raise  # request-shaped / cancellation: never "a shard died"

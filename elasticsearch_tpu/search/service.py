@@ -498,6 +498,7 @@ class SearchService:
         index_name: str = "index",
         planner=None,
         device=None,
+        filter_cache=None,
     ):
         self.engine = engine
         self.index_name = index_name
@@ -507,6 +508,56 @@ class SearchService:
         # obs.DeviceInstruments: launch-site metrics (compile count/ms,
         # H2D bytes, padding waste). None = uninstrumented.
         self.device = device
+        # index.filter_cache.FilterCache: device-resident mask planes for
+        # repeated filter-context subtrees. None (default, and the
+        # ESTPU_FILTER_CACHE=0 opt-out) recomputes every filter.
+        self.filter_cache = filter_cache
+
+    # --------------------------------------------------------- filter cache
+
+    def _collect_filter_entries(self, query, record: bool) -> list:
+        """The request's cacheable-filter entries, with one admission
+        sighting recorded when `record` (the frequency signal is counted
+        once per USER request — never per segment, and never per shard
+        when a coordinator drives this service with record=False).
+        Collected ONCE here and threaded through every per-segment apply
+        so the query AST is not re-walked on the hot path."""
+        from ..index.filter_cache import record_filter_usage
+
+        return record_filter_usage(self.filter_cache, query, record=record)
+
+    def _apply_filter_cache(
+        self, handle, query, compiled, seg_tree, entries=None
+    ):
+        """Substitute cached mask planes into one segment's compiled plan.
+        Returns (compiled', masks) — masks empty when nothing applied."""
+        if self.filter_cache is None:
+            return compiled, {}
+        from ..index.filter_cache import apply_cached_masks
+
+        def build(child_spec, child_arrays):
+            plane = bm25_device.compute_filter_mask(
+                seg_tree, child_spec, child_arrays
+            )
+            return plane, int(plane.nbytes)
+
+        # Keyed per segment handle, NOT per engine generation: postings
+        # are immutable and planes exclude the live mask, so a plane
+        # stays servable across refreshes that only add/merge OTHER
+        # segments — the whole point of a filter cache under live write
+        # traffic. live_uids lets the store prune planes of merged-away
+        # segments eagerly.
+        prefix = (self.engine.uid, 0, handle.uid)
+        compiled, masks, reused = apply_cached_masks(
+            self.filter_cache, prefix, query, compiled, build,
+            entries=entries,
+            live_uids=frozenset(h.uid for h in self.engine.segments),
+        )
+        if reused:
+            # The span-level signal the tracing satellite asks for: this
+            # segment pass served at least one filter from a cached plane.
+            TRACER.tag(filter_cache_hit=True)
+        return compiled, masks
 
     def search(
         self,
@@ -514,6 +565,8 @@ class SearchService:
         stats: dict[str, FieldStats] | None = None,
         segments: list | None = None,
         task=None,  # common.tasks.Task: cancellation + timeout polling
+        record_filter_usage: bool = True,
+        fc_entries: list | None = None,
     ) -> SearchResponse:
         """Execute one request against this shard.
 
@@ -523,13 +576,23 @@ class SearchService:
         independent; default is shard-local, ES query_then_fetch parity.
         `segments` pins an explicit segment snapshot (the coordinator
         shares one snapshot between its agg pass and every shard's hits
-        pass).
+        pass). `record_filter_usage=False` suppresses the filter-cache
+        admission sighting: the sharded coordinator records ONCE per user
+        request and passes False to its per-shard calls — otherwise an
+        n-shard scatter would count n sightings and one-off filters would
+        self-admit past min_freq on their very first request. `fc_entries`
+        passes the coordinator's already-collected cacheable-filter
+        entries so the scatter doesn't re-walk the query AST per shard.
         """
         start = time.monotonic()
         k = max(0, request.from_) + max(0, request.size)
         if stats is None:
             stats = self.engine.field_stats()
         self._validate_sort(request)
+        if fc_entries is None:
+            fc_entries = self._collect_filter_entries(
+                request.query, record_filter_usage
+            )
 
         # One segment snapshot shared by the agg pass and the hits pass —
         # a concurrent refresh must not desynchronize totals from hits
@@ -582,7 +645,7 @@ class SearchService:
                 ) as seg_span:
                     seg_total, backend = self._query_segment(
                         handle, request, k, stats, candidates,
-                        timings=timings,
+                        timings=timings, fc_entries=fc_entries,
                     )
                     if seg_span is not None:
                         seg_span.tags["backend"] = backend
@@ -779,6 +842,8 @@ class SearchService:
         stats: dict[str, FieldStats],
         segments: list,
         tasks: list,
+        record_filter_usage: bool = True,
+        fc_entries: list | None = None,
     ):
         """One coalesced scoring pass over this shard for N plain requests.
 
@@ -795,6 +860,16 @@ class SearchService:
         timed = [False] * n
         errors: list[Exception | None] = [None] * n
         alive = set(range(n))
+        # One admission sighting per rider, collected once for the whole
+        # batch (the sharded coordinator records per user request itself,
+        # passes record_filter_usage=False, and hands its precollected
+        # per-rider entries in); the entries thread into every per-segment
+        # apply so the ASTs aren't re-walked.
+        if fc_entries is None:
+            fc_entries = [
+                self._collect_filter_entries(r.query, record_filter_usage)
+                for r in requests
+            ]
         for handle in segments:
             if handle.segment.num_docs == 0 or not alive:
                 continue
@@ -815,7 +890,9 @@ class SearchService:
                     alive.discard(i)
             if not alive:
                 break
+            seg_tree = bm25_device.segment_tree(handle.device)
             compiled: dict[int, Any] = {}
+            req_masks: dict[int, dict] = {}
             for i in sorted(alive):
                 try:
                     compiled[i] = self.engine.compiler_for(
@@ -824,19 +901,33 @@ class SearchService:
                 except ValueError as e:
                     errors[i] = e
                     alive.discard(i)
+                    continue
+                # Coalesced batchmates sharing a filter share ONE plane:
+                # substitution happens before grouping, so identical
+                # (spec, plane set) lanes land in the same launch with
+                # the plane passed ONCE via seg["masks"] — never stacked
+                # per lane.
+                compiled[i], req_masks[i] = self._apply_filter_cache(
+                    handle, requests[i].query, compiled[i], seg_tree,
+                    entries=fc_entries[i],
+                )
+            from ..index.filter_cache import mask_group_token
+
             groups: dict[tuple, list[int]] = {}
             for i, c in compiled.items():
                 if i in alive:
-                    groups.setdefault(c.spec, []).append(i)
+                    token = mask_group_token(req_masks.get(i, {}))
+                    groups.setdefault((c.spec, token), []).append(i)
             groups = self._merge_term_groups(
                 handle, stats, groups, compiled, requests
             )
-            for spec, rows in groups.items():
+            for (spec, _token), rows in groups.items():
                 try:
                     fault_point("search.kernel", index=self.index_name)
                     self._execute_group(
                         handle, spec, rows, compiled, requests, ks, stats,
-                        cands, totals,
+                        cands, totals, seg_tree=seg_tree,
+                        masks=req_masks.get(rows[0], {}),
                     )
                 except (ValueError, TypeError):
                     raise  # request-shaped: the compile path 400s
@@ -866,16 +957,19 @@ class SearchService:
         from ..exec.batcher import plan_spec_buckets
         from ..query.compile import CompiledQuery, pad_arrays_to_spec, unify_specs
 
+        # Group keys are (spec, mask token); sparse term families never
+        # carry masks (mask substitution only rewrites bool filter
+        # clauses), so family merging operates on the empty-token keys.
         families: dict[tuple, list[tuple]] = {}
-        for spec in list(groups):
+        for spec, token in list(groups):
             fam = sparse_family_key(spec)
-            if fam is not None:
+            if fam is not None and token == ():
                 families.setdefault(fam, []).append(spec)
         for specs in families.values():
             if len(specs) < 2:
                 continue
             for bucket in plan_spec_buckets(
-                [(s, len(groups[s])) for s in specs]
+                [(s, len(groups[(s, ())])) for s in specs]
             ):
                 if len(bucket) < 2:
                     continue
@@ -885,12 +979,12 @@ class SearchService:
                     # launches at the bucket's nt regardless of need.
                     self.device.padding(
                         *family_padding_tiles(
-                            [(s, len(groups[s])) for s in bucket]
+                            [(s, len(groups[(s, ())])) for s in bucket]
                         )
                     )
                 merged_rows: list[int] = []
                 for s in bucket:
-                    rows = groups.pop(s)
+                    rows = groups.pop((s, ()))
                     for i in rows:
                         compiled[i] = CompiledQuery(
                             spec=target,
@@ -899,7 +993,7 @@ class SearchService:
                             ),
                         )
                     merged_rows.extend(rows)
-                groups.setdefault(target, []).extend(merged_rows)
+                groups.setdefault((target, ()), []).extend(merged_rows)
         return groups
 
     # Penalty latency recorded for a backend that RAISED instead of
@@ -910,10 +1004,13 @@ class SearchService:
 
     def _execute_group(
         self, handle, spec, rows, compiled, requests, ks, stats, cands,
-        totals,
+        totals, seg_tree=None, masks=None,
     ) -> None:
         """Execute one same-spec group — one padded device launch (or the
-        oracle per lane when routed there) — and append candidates."""
+        oracle per lane when routed there) — and append candidates.
+        `masks` holds the group's shared filter-cache planes (every rider
+        in the group references the same planes, by group-key
+        construction), injected once into the launch's seg tree."""
         k_max = max(ks[i] for i in rows)
         backend = "device_batched"
         plan_class = None
@@ -966,7 +1063,8 @@ class SearchService:
                         )
                     self._device_batch(
                         handle, spec, remaining, compiled, ks, k_max,
-                        plan_class, cands, totals,
+                        plan_class, cands, totals, seg_tree=seg_tree,
+                        masks=masks,
                     )
                     return
                 remaining.pop(0)
@@ -981,18 +1079,23 @@ class SearchService:
         else:
             self._device_batch(
                 handle, spec, rows, compiled, ks, k_max, plan_class, cands,
-                totals,
+                totals, seg_tree=seg_tree, masks=masks,
             )
 
     def _device_batch(
         self, handle, spec, rows, compiled, ks, k_max, plan_class, cands,
-        totals,
+        totals, seg_tree=None, masks=None,
     ) -> None:
-        """One padded device launch for a same-spec row group."""
+        """One padded device launch for a same-spec row group. Filter-
+        cache planes (`masks`) ride the seg tree — one shared plane per
+        launch, never stacked per lane."""
         import jax
 
         t0 = time.monotonic()
-        seg_tree = bm25_device.segment_tree(handle.device)
+        if seg_tree is None:
+            seg_tree = bm25_device.segment_tree(handle.device)
+        if masks:
+            seg_tree = {**seg_tree, "masks": masks}
         if not jax.tree.leaves(compiled[rows[0]].arrays):
             # Plans with no array leaves (match_none compiles to an
             # empty pytree) give vmap nothing to infer the batch axis
@@ -1105,21 +1208,31 @@ class SearchService:
         return None if live.all() else live
 
     def _decide_backend(
-        self, handle: SegmentHandle, request: SearchRequest, compiled, k: int
+        self,
+        handle: SegmentHandle,
+        request: SearchRequest,
+        compiled,
+        k: int,
+        masked: bool = False,
     ) -> tuple[str, tuple | None]:
         """(backend, plan_class) for one plain score-sorted segment pass.
 
         Candidate backends are restricted to those that CANNOT change the
         top-k result (the planner's hard invariant): block-max only when
         exact totals aren't tracked (its totals are "gte"), the oracle
-        only for statistics-faithful query shapes."""
+        only for statistics-faithful query shapes. A mask-substituted plan
+        runs the same device kernels but is priced (and counted) as the
+        `cached_mask` backend: its work_tiles exclude the cached clauses'
+        worklists, so the planner prices mask reuse against the oracle's
+        full recompute honestly."""
+        base = "cached_mask" if masked else "device"
         if self.planner is None:
-            return "device", None
+            return base, None
         from ..exec.cost import PlanFeatures
         from ..exec.planner import oracle_eligible, spec_work_tiles
 
         spec = compiled.spec
-        candidates = ["device"]
+        candidates = [base]
         if request.track_total_hits is False:
             # Two-phase tile-pruned paths report "gte" totals, so they are
             # only eligible when exact totals aren't tracked.
@@ -1131,7 +1244,7 @@ class SearchService:
             candidates.append("oracle")
         plan_class = self.planner.classify(spec, k)
         if len(candidates) == 1:
-            return "device", plan_class
+            return base, plan_class
         feats = PlanFeatures(
             n_docs=handle.segment.num_docs,
             work_tiles=(
@@ -1151,6 +1264,7 @@ class SearchService:
         stats: dict[str, FieldStats],
         candidates: list,
         timings: dict | None = None,
+        fc_entries: list | None = None,
     ) -> tuple[int, str]:
         """Score one segment, appending candidate tuples. Returns
         (total hits, execution backend used)."""
@@ -1161,6 +1275,14 @@ class SearchService:
         compiler = self.engine.compiler_for(handle, stats)
         compiled = compiler.compile(request.query)
         seg_tree = bm25_device.segment_tree(handle.device)
+        # Filter cache: swap cacheable filter-context clauses for their
+        # cached (or freshly admitted) mask planes — bit-identical by
+        # construction, the plane IS the clause's own evaluation.
+        compiled, fc_masks = self._apply_filter_cache(
+            handle, request.query, compiled, seg_tree, entries=fc_entries
+        )
+        if fc_masks:
+            seg_tree = {**seg_tree, "masks": fc_masks}
         now = time.monotonic()
         if timings is not None:
             timings["plan_s"] += now - plan_t0
@@ -1251,7 +1373,7 @@ class SearchService:
                 plan_class = None
                 if self.planner is not None and not request.rescore:
                     backend, plan_class = self._decide_backend(
-                        handle, request, compiled, k
+                        handle, request, compiled, k, masked=bool(fc_masks)
                     )
                     # The routing decision, as a tagged event on the
                     # enclosing segment span.
@@ -1300,7 +1422,7 @@ class SearchService:
                         instruments=self.device,
                     )
                     scores, ids, tot = s[0], i[0], int(t[0])
-                elif backend == "device":
+                elif backend in ("device", "cached_mask"):
                     scores, ids, tot = bm25_device.execute_auto(
                         seg_tree, compiled.spec, compiled.arrays, fetch_k
                     )

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""CI smoke gate for the device-resident filter/bitset cache (ISSUE 9).
+
+Runs the filter-cache suite on the CPU backend — no TPU needed: the
+64-query cached-vs-uncached parity fuzz (device, block-max conjunction,
+and SPMD mesh paths, bit-exact ids/order/fp32 scores/totals including
+immediately after refresh/update/delete invalidation), usage-tracking
+admission, HBM-budgeted LRU eviction, coalesced-batchmate plane sharing,
+and the `_cache/clear` / `_nodes/stats` / `/_metrics` surfaces. The same
+tests ride the tier-1 run via the fast (`not slow`) marker; this script
+is the standalone hook for pre-merge / cron checks:
+
+    python scripts/check_filter_cache_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "tests/test_filter_cache.py",
+        "-q",
+        "-m",
+        "not slow",
+        "-p",
+        "no:cacheprovider",
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,8 +1,9 @@
 """Fixture planner: [ghost] has no cost seed and no surfacing site;
-[packed] and [mesh_spmd] are surfaced (user.py) but UNSEEDED — the
-multi-tenant backend and the SPMD mesh plan class registered without an
+[packed], [mesh_spmd] and [cached_mask] are surfaced (user.py) but
+UNSEEDED — the multi-tenant backend, the SPMD mesh plan class, and the
+filter-cache masked-execution backend registered without an
 exec/cost.py seed must each fail the gate."""
 
 
 class ExecPlanner:
-    BACKENDS = ("device", "ghost", "packed", "mesh_spmd")
+    BACKENDS = ("device", "ghost", "packed", "mesh_spmd", "cached_mask")

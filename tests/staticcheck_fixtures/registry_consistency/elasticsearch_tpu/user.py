@@ -32,3 +32,16 @@ def route_mesh():
     # Surfacing site for the SPMD mesh backend: an unseeded mesh plan
     # class must fail exactly like an unseeded packed one.
     return "mesh_spmd"
+
+
+def route_cached_mask():
+    # Surfacing site for the filter-cache masked-execution backend: an
+    # unseeded cached_mask registration must fail exactly like packed.
+    return "cached_mask"
+
+
+def make_filter_cache_instruments(m):
+    m.counter(
+        "estpu_filter_cache_rogue_total",
+        "filter-cache instrument not in CATALOG",
+    )

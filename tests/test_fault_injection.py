@@ -369,7 +369,7 @@ class FlakySearcher:
             for r in requests
         ]
 
-    def search(self, request, task=None):
+    def search(self, request, task=None, record_filter_usage=True):
         with self.lock:
             self.solo_calls.append(request)
         return f"solo:{request}"

@@ -1,0 +1,827 @@
+"""Filter/bitset cache (ISSUE 9): cached-vs-uncached parity is the law.
+
+A cached mask plane IS the filter subtree's own evaluation, so
+substituting it can never move ids, order, fp32 scores, or totals — on
+the plain device path, the sparse conjunction kernels, the two-phase
+block-max path, the coalesced micro-batch path, or the SPMD mesh path.
+These tests fuzz that contract, plus the cache policies themselves:
+usage-tracking admission (one-off filters never admitted), HBM-budgeted
+LRU eviction (least-recently-used planes evict first, breaker bytes
+released), hard invalidation across refresh/update/delete, coalesced
+batchmates sharing one plane, and the REST/observability surfaces
+(`_cache/clear`, `_nodes/stats` indices.filter_cache, `/_metrics`).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.breaker import CircuitBreaker
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.filter_cache import FilterCache
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.query.compile import (
+    cacheable_filter_key,
+    collect_cacheable_filters,
+)
+from elasticsearch_tpu.query.dsl import parse_query
+from elasticsearch_tpu.search.service import SearchRequest, SearchService
+
+WORDS = [f"w{i}" for i in range(40)]
+TAGS = ["red", "green", "blue", "teal"]
+
+MAPPINGS = {
+    "properties": {
+        "title": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "price": {"type": "long"},
+    }
+}
+
+
+def _doc(rng):
+    return {
+        "title": " ".join(rng.choices(WORDS, k=6)),
+        "tag": rng.choice(TAGS),
+        "price": rng.randint(0, 100),
+    }
+
+
+def _build_engine(n_docs=600, seed=7, segments=2) -> Engine:
+    rng = random.Random(seed)
+    eng = Engine(Mappings.from_json(MAPPINGS))
+    per_seg = max(1, n_docs // segments)
+    for i in range(n_docs):
+        eng.index(_doc(rng), str(i))
+        if (i + 1) % per_seg == 0:
+            eng.refresh()
+    eng.refresh()
+    return eng
+
+
+def _rand_filtered_query(rng):
+    """A random filtered bool body: scored musts + cacheable filters."""
+    must = [
+        {"match": {"title": " ".join(rng.sample(WORDS, rng.randint(1, 3)))}}
+    ]
+    filters = []
+    for _ in range(rng.randint(1, 2)):
+        kind = rng.randint(0, 3)
+        if kind == 0:
+            filters.append({"term": {"tag": rng.choice(TAGS)}})
+        elif kind == 1:
+            filters.append(
+                {"terms": {"tag": rng.sample(TAGS, rng.randint(1, 2))}}
+            )
+        elif kind == 2:
+            lo = rng.randint(0, 80)
+            filters.append({"range": {"price": {"gte": lo, "lt": lo + 40}}})
+        else:
+            filters.append({"exists": {"field": "price"}})
+    body: dict = {"bool": {"must": must, "filter": filters}}
+    if rng.random() < 0.3:
+        body["bool"]["must_not"] = [{"term": {"tag": rng.choice(TAGS)}}]
+    return body
+
+
+def _hits_sig(resp):
+    return (
+        [(h.doc_id, h.score) for h in resp.hits],
+        resp.total,
+        resp.total_relation,
+    )
+
+
+class TestParityFuzz:
+    def test_cached_vs_uncached_64_queries_device(self):
+        """The headline gate: ≥64 random filtered bool queries, executed
+        cold (admission pass), warm (cache hits), and on a cache-free
+        twin service — all three bit-identical (ids + order + fp32
+        scores + totals), across a multi-segment shard."""
+        eng = _build_engine()
+        cache = FilterCache(min_freq=1)  # admit on first sight: hits fuzz
+        cached_svc = SearchService(eng, filter_cache=cache)
+        plain_svc = SearchService(eng)
+        rng = random.Random(11)
+        for i in range(64):
+            body = {"query": _rand_filtered_query(rng), "size": 10}
+            request = SearchRequest.from_json(body)
+            cold = cached_svc.search(SearchRequest.from_json(body))
+            warm = cached_svc.search(SearchRequest.from_json(body))
+            plain = plain_svc.search(request)
+            assert _hits_sig(cold) == _hits_sig(plain), body
+            assert _hits_sig(warm) == _hits_sig(plain), body
+        stats = cache.stats()
+        assert stats["admissions"] > 0
+        assert stats["hit_count"] > 0
+
+    def test_parity_on_blockmax_conj_path(self):
+        """Untracked totals open the two-phase block-max conjunction
+        backend; cached masks must survive it bit-exactly (the pruned
+        second launch verifies filters via the plane gather)."""
+        from elasticsearch_tpu.ops import bm25_device
+
+        eng = _build_engine(segments=1)
+        cache = FilterCache(min_freq=1)
+        svc = SearchService(eng, filter_cache=cache)
+        plain = SearchService(eng)
+        handle = eng.segments[0]
+        rng = random.Random(3)
+        checked_masked_sparse = False
+        for _ in range(16):
+            body = {
+                "query": _rand_filtered_query(rng),
+                "size": 10,
+                "track_total_hits": False,
+            }
+            warm_req = SearchRequest.from_json(body)
+            svc.search(SearchRequest.from_json(body))  # admit
+            # The masked plan must stay sparse-eligible (the conjunction
+            # kernels accept cached_mask clauses).
+            compiled = eng.compiler_for(handle).compile(warm_req.query)
+            seg_tree = bm25_device.segment_tree(handle.device)
+            masked, masks = svc._apply_filter_cache(
+                handle, warm_req.query, compiled, seg_tree
+            )
+            if masks and bm25_device.supports_sparse(compiled.spec):
+                assert bm25_device.supports_sparse(masked.spec)
+                checked_masked_sparse = True
+            warm = svc.search(warm_req)
+            ref = plain.search(SearchRequest.from_json(body))
+            assert _hits_sig(warm)[0] == _hits_sig(ref)[0]
+        assert checked_masked_sparse
+
+    def test_masked_blockmax_conj_kernel_bit_exact(self):
+        """A masked plan the planner routes to blockmax_conj must return
+        the same hits as masked execute_auto — the two-phase pruned
+        kernel's phase-A filter verification and exact second launch both
+        read the cached plane via seg["masks"]."""
+        from elasticsearch_tpu.ops import bm25_device
+
+        eng = _build_engine(n_docs=600, segments=1)
+        cache = FilterCache(min_freq=1)
+        svc = SearchService(eng, filter_cache=cache)
+        handle = eng.segments[0]
+        seg_tree = bm25_device.segment_tree(handle.device)
+        rng = random.Random(13)
+        checked = 0
+        for _ in range(16):
+            body = {
+                "query": {
+                    "bool": {
+                        "must": [
+                            {
+                                "match": {
+                                    "title": " ".join(rng.sample(WORDS, 2))
+                                }
+                            }
+                        ],
+                        "filter": [
+                            {"term": {"tag": rng.choice(TAGS)}},
+                            {"range": {"price": {"gte": rng.randint(0, 50)}}},
+                        ],
+                    }
+                },
+                "size": 10,
+                "track_total_hits": False,
+            }
+            req = SearchRequest.from_json(body)
+            svc.search(SearchRequest.from_json(body))  # admit planes
+            compiled = eng.compiler_for(handle).compile(req.query)
+            masked, masks = svc._apply_filter_cache(
+                handle, req.query, compiled, seg_tree
+            )
+            if not masks or not bm25_device.supports_blockmax_conj(
+                masked.spec
+            ):
+                continue
+            seg_m = {**seg_tree, "masks": masks}
+            s_a, i_a, t_a = bm25_device.execute_auto(
+                seg_m, masked.spec, masked.arrays, 10
+            )
+            s_b, i_b, t_b, _rel = bm25_device.execute_batch_blockmax_conj(
+                seg_m, masked.spec, [masked.arrays], 10
+            )
+            assert np.array_equal(np.asarray(i_a), np.asarray(i_b[0])), body
+            assert np.array_equal(np.asarray(s_a), np.asarray(s_b[0])), body
+            assert int(t_b[0]) <= int(t_a), body  # "gte" totals undercount
+            checked += 1
+        assert checked > 0
+
+    def test_parity_immediately_after_refresh_update_delete(self):
+        """Invalidation gate: writes + refresh mint new generations and
+        segment handles, so the very next search recomputes (or
+        re-admits) and stays bit-identical to a cache-free twin."""
+        eng = _build_engine(n_docs=300, segments=1)
+        cache = FilterCache(min_freq=1)
+        svc = SearchService(eng, filter_cache=cache)
+        plain = SearchService(eng)
+        body = {
+            "query": {
+                "bool": {
+                    "must": [{"match": {"title": "w1 w2 w3"}}],
+                    "filter": [{"term": {"tag": "red"}}],
+                }
+            },
+            "size": 10,
+        }
+        for _ in range(2):  # admit + hit
+            svc.search(SearchRequest.from_json(body))
+        # Update: make a doc enter the filter's matched set.
+        eng.index({"title": "w1 w2 w3", "tag": "red", "price": 1}, "0")
+        eng.refresh()
+        got = svc.search(SearchRequest.from_json(body))
+        ref = plain.search(SearchRequest.from_json(body))
+        assert _hits_sig(got) == _hits_sig(ref)
+        assert any(h.doc_id == "0" for h in got.hits)
+        # Delete (soft): planes exclude live, which ANDs at query time.
+        victim = got.hits[0].doc_id
+        eng.delete(victim)
+        eng.refresh()
+        got = svc.search(SearchRequest.from_json(body))
+        ref = plain.search(SearchRequest.from_json(body))
+        assert _hits_sig(got) == _hits_sig(ref)
+        assert all(h.doc_id != victim for h in got.hits)
+
+
+class TestMeshParity:
+    def test_sharded_mesh_masks_bit_identical(self):
+        """parallel/sharded.py consults the cache: stacked [S, N] planes
+        ride the seg pytree; results equal the cache-free mesh run."""
+        import jax
+        from jax.sharding import Mesh
+
+        from elasticsearch_tpu.parallel.sharded import ShardedIndex
+
+        devices = jax.devices()
+        n_shards = min(4, len(devices))
+        mesh = Mesh(np.array(devices[:n_shards]), ("shard",))
+        rng = random.Random(5)
+        docs = [(str(i), _doc(rng)) for i in range(600)]
+        mappings = Mappings.from_json(MAPPINGS)
+        plain = ShardedIndex.from_docs(docs, mappings, mesh)
+        cached = ShardedIndex.from_docs(docs, mappings, mesh)
+        cached.filter_cache = FilterCache(min_freq=1)
+        qrng = random.Random(6)
+        for _ in range(16):
+            q = parse_query(_rand_filtered_query(qrng))
+            ref = plain.search(q, k=10)
+            for _rep in range(2):  # cold (admission) then warm (hit)
+                got = cached.search(q, k=10)
+                assert np.array_equal(ref[0], got[0])
+                assert np.array_equal(ref[1], got[1])
+                assert ref[2] == got[2]
+        stats = cached.filter_cache.stats()
+        assert stats["admissions"] > 0 and stats["hit_count"] > 0
+
+
+class TestMeshServingPath:
+    def test_mesh_serve_consults_cache_and_stays_exact(self):
+        """The PRODUCTION mesh path (MeshView.serve's plain-score branch)
+        consults the node filter cache: planes key under the engines'
+        uid-tuple scope with the generation sum, results stay identical
+        to the host loop, refresh invalidates, and per-index
+        `_cache/clear` reaches the mesh-scope planes."""
+        import json
+
+        from elasticsearch_tpu.rest.server import RestServer
+
+        rest = RestServer()
+        status, _ = rest.dispatch(
+            "PUT",
+            "/m",
+            {},
+            json.dumps(
+                {
+                    "settings": {"index": {"number_of_shards": 4}},
+                    "mappings": MAPPINGS,
+                }
+            ),
+        )
+        assert status == 200
+        node = rest.node
+        rng = random.Random(3)
+        lines = []
+        for i in range(240):
+            lines.append(json.dumps({"index": {"_id": str(i)}}))
+            lines.append(json.dumps(_doc(rng)))
+        status, resp = rest.dispatch(
+            "POST", "/m/_bulk", {"refresh": "true"}, "\n".join(lines)
+        )
+        assert status == 200 and not resp["errors"]
+        svc = node.get_index("m")
+        mv = svc.search.mesh_view
+        if mv is None:
+            pytest.skip("no device mesh available")
+        assert mv.filter_cache is node.filter_cache
+        body = {
+            "query": {
+                "bool": {
+                    "must": [{"match": {"title": "w1 w2 w3"}}],
+                    "filter": [
+                        {"term": {"tag": "red"}},
+                        {"range": {"price": {"gte": 10}}},
+                    ],
+                }
+            },
+            "size": 10,
+        }
+
+        def sig(out):
+            return (
+                [
+                    (h["_id"], h["_score"])
+                    for h in out["hits"]["hits"]
+                ],
+                out["hits"]["total"],
+            )
+
+        svc.search.mesh_view = None  # host-loop reference run
+        try:
+            ref = node.search("m", json.loads(json.dumps(body)))
+        finally:
+            svc.search.mesh_view = mv
+        before = mv.served
+        out1 = node.search("m", dict(body))  # sighting 1: no plane yet
+        out2 = node.search("m", dict(body))  # sighting 2: built + admitted
+        assert mv.served == before + 2
+        scope = ("sharded", tuple(e.uid for e in svc.engines))
+        assert any(k[0] == scope for k in node.filter_cache.keys())
+        assert sig(out1) == sig(ref)
+        assert sig(out2) == sig(ref)
+        # Refresh invalidation: a new matching doc must appear at once
+        # (the generation component stales every plane of this view).
+        node.index_doc(
+            "m", {"title": "w1 w2 w3", "tag": "red", "price": 50}, "new"
+        )
+        node.refresh("m")
+        out3 = node.search("m", dict(body))
+        assert any(h["_id"] == "new" for h in out3["hits"]["hits"])
+        # Per-index clear reaches the mesh-scope planes.
+        node.search("m", dict(body))  # re-admit at the new generation
+        assert any(k[0] == scope for k in node.filter_cache.keys())
+        node.clear_cache("m")
+        assert not any(
+            k[0] == scope for k in node.filter_cache.keys()
+        )
+
+
+class TestAdmission:
+    def test_one_off_filters_never_admitted(self):
+        eng = _build_engine(n_docs=200, segments=1)
+        cache = FilterCache(min_freq=2)
+        svc = SearchService(eng, filter_cache=cache)
+        body = {
+            "query": {
+                "bool": {
+                    "must": [{"match": {"title": "w1"}}],
+                    "filter": [{"term": {"tag": "red"}}],
+                }
+            }
+        }
+        svc.search(SearchRequest.from_json(body))
+        assert cache.stats()["entries"] == 0  # one sighting: not admitted
+        svc.search(SearchRequest.from_json(body))
+        assert cache.stats()["admissions"] == 1  # second sighting: stored
+        hits_before = cache.stats()["hit_count"]
+        svc.search(SearchRequest.from_json(body))
+        assert cache.stats()["hit_count"] == hits_before + 1
+
+    def test_history_ring_bounds_frequency(self):
+        cache = FilterCache(min_freq=2, history=4)
+        cache.record([("term", "tag", "red")])
+        # Four other sightings roll the ring past the first.
+        for i in range(4):
+            cache.record([("term", "tag", f"other{i}")])
+        cache.record([("term", "tag", "red")])
+        # Only ONE "red" sighting survives in the window: not admitted.
+        assert not cache.should_admit(("term", "tag", "red"))
+
+    def test_min_freq_one_admits_immediately(self):
+        cache = FilterCache(min_freq=1)
+        cache.record([("exists", "price")])
+        assert cache.should_admit(("exists", "price"))
+
+    def test_duplicate_clauses_in_one_request_count_one_sighting(self):
+        """bool.filter = [F, F] is still ONE sighting of F: a one-off
+        query with a duplicated clause must not self-admit past
+        min_freq=2 on its very first request."""
+        eng = _build_engine(n_docs=200, segments=1)
+        cache = FilterCache(min_freq=2)
+        svc = SearchService(eng, filter_cache=cache)
+        body = {
+            "query": {
+                "bool": {
+                    "must": [{"match": {"title": "w1"}}],
+                    "filter": [
+                        {"term": {"tag": "red"}},
+                        {"term": {"tag": "red"}},
+                    ],
+                }
+            }
+        }
+        svc.search(SearchRequest.from_json(body))
+        assert not cache.should_admit(("term", "tag", "red"))
+        assert cache.stats()["entries"] == 0
+
+    def test_sharded_scatter_counts_one_sighting_per_request(self):
+        """An n-shard scatter is ONE user request: the coordinator
+        records once and suppresses per-shard recording, so a one-off
+        filter on a 3-shard index never self-admits past min_freq=2."""
+        from elasticsearch_tpu.search.coordinator import (
+            ShardedSearchCoordinator,
+        )
+
+        engines = [_build_engine(n_docs=60, seed=s, segments=1)
+                   for s in (1, 2, 3)]
+        cache = FilterCache(min_freq=2)
+        coord = ShardedSearchCoordinator(engines, filter_cache=cache)
+        body = {
+            "query": {
+                "bool": {
+                    "must": [{"match": {"title": "w1"}}],
+                    "filter": [{"term": {"tag": "red"}}],
+                }
+            }
+        }
+        coord.search(SearchRequest.from_json(body))
+        # One request = one sighting: below the threshold, nothing admitted.
+        assert cache.stats()["entries"] == 0
+        assert not cache.should_admit(("term", "tag", "red"))
+        coord.search(SearchRequest.from_json(body))
+        # Second request reaches min_freq; per-shard passes admit planes.
+        assert cache.stats()["admissions"] >= 1
+
+
+class TestEviction:
+    def _plane(self, n=64):
+        return np.zeros(n, dtype=bool)
+
+    def test_lru_eviction_order(self):
+        cache = FilterCache(max_bytes=200)
+        a, b, c = ("k", "a"), ("k", "b"), ("k", "c")
+        cache.put((1, 0, 0, a), self._plane(), 80)
+        cache.put((1, 0, 0, b), self._plane(), 80)
+        assert cache.get((1, 0, 0, a)) is not None  # touch a: b becomes LRU
+        cache.put((1, 0, 0, c), self._plane(), 80)
+        assert cache.get((1, 0, 0, b)) is None  # b evicted, not a
+        assert cache.get((1, 0, 0, a)) is not None
+        assert cache.get((1, 0, 0, c)) is not None
+        assert cache.stats()["evictions"] == 1
+
+    def test_breaker_budget_enforced_and_released(self):
+        breaker = CircuitBreaker(150)
+        cache = FilterCache(max_bytes=1 << 20, breaker=breaker)
+        cache.put((1, 0, 0, ("k", "a")), self._plane(), 100)
+        assert breaker.used == 100
+        # Second plane cannot fit alongside the first: the LRU evicts.
+        cache.put((1, 0, 0, ("k", "b")), self._plane(), 100)
+        assert breaker.used == 100
+        assert cache.get((1, 0, 0, ("k", "a"))) is None
+        # A plane larger than the whole budget is declined, not stored.
+        assert not cache.put((1, 0, 0, ("k", "c")), self._plane(), 500)
+        cache.clear()
+        assert breaker.used == 0
+        assert cache.stats()["entries"] == 0
+
+    def test_external_breaker_pressure_does_not_wipe_cache(self):
+        """When the HBM breaker rejects because OTHER labels hold the
+        memory, eviction stops once the declined plane's own size has
+        been freed — the rest of the warm cache survives instead of
+        being wiped for a reservation that can never succeed."""
+        breaker = CircuitBreaker(400)
+        cache = FilterCache(max_bytes=1 << 20, breaker=breaker)
+        cache.put((1, 0, 0, ("k", "a")), self._plane(), 60)
+        cache.put((1, 0, 0, ("k", "b")), self._plane(), 60)
+        # Recovery/settle-up pressure from another subsystem lands
+        # unchecked and pushes usage over the limit: freeing our planes
+        # cannot open headroom. Decline after freeing at most the
+        # plane's own size (one eviction), keeping the other plane warm.
+        breaker.add_unchecked(320)
+        assert not cache.put((1, 0, 0, ("k", "c")), self._plane(), 60)
+        assert cache.stats()["entries"] == 1
+        assert breaker.used == 320 + 60
+
+    def test_stale_generation_purged_on_store(self):
+        cache = FilterCache(max_bytes=1 << 20)
+        cache.put((1, 3, 10, ("k", "a")), self._plane(), 64)
+        cache.put((1, 4, 11, ("k", "a")), self._plane(), 64)  # newer gen
+        assert cache.get((1, 3, 10, ("k", "a"))) is None  # purged eagerly
+        assert cache.get((1, 4, 11, ("k", "a"))) is not None
+
+
+class TestCrossRefreshReuse:
+    BODY = {
+        "query": {
+            "bool": {
+                "must": [{"match": {"title": "w1 w2 w3"}}],
+                "filter": [{"range": {"price": {"gte": 10, "lt": 90}}}],
+            }
+        }
+    }
+
+    def test_planes_survive_refresh_of_other_segments(self):
+        """Solo keys scope on the segment-handle uid, NOT the engine
+        generation: a refresh that only ADDS a segment leaves existing
+        segments' planes resident and serving — the whole point of a
+        filter cache under live write traffic."""
+        eng = _build_engine(n_docs=200, seed=11, segments=1)
+        cache = FilterCache(min_freq=1)
+        svc = SearchService(eng, filter_cache=cache)
+        svc.search(SearchRequest.from_json(self.BODY))
+        assert cache.stats()["admissions"] >= 1
+        keys_before = set(cache.keys())
+        rng = random.Random(99)
+        for i in range(20):
+            eng.index(_doc(rng), f"new{i}")
+        eng.refresh()  # new segment appended; old handles unchanged
+        hits0 = cache.stats()["hit_count"]
+        svc.search(SearchRequest.from_json(self.BODY))
+        assert keys_before <= set(cache.keys())  # old planes still resident
+        assert cache.stats()["hit_count"] > hits0  # and actually served
+
+    def test_merged_away_segment_planes_pruned_on_store(self):
+        """A merge mints a fresh handle uid; the dead handles' planes are
+        pruned eagerly on the next store instead of lingering on the HBM
+        breaker until LRU happens to reach them."""
+        eng = _build_engine(n_docs=200, seed=12, segments=2)
+        cache = FilterCache(min_freq=1)
+        svc = SearchService(eng, filter_cache=cache)
+        svc.search(SearchRequest.from_json(self.BODY))
+        assert cache.stats()["entries"] == 2  # one plane per segment
+        dead_keys = set(cache.keys())
+        eng.force_merge(max_num_segments=1)
+        svc.search(SearchRequest.from_json(self.BODY))
+        live_keys = set(cache.keys())
+        assert not (dead_keys & live_keys)  # old handles' planes pruned
+        assert cache.stats()["entries"] == 1  # merged segment's plane only
+        assert cache.stats()["bytes_resident"] > 0
+
+
+class TestBatcherPlaneSharing:
+    def test_coalesced_batchmates_share_one_plane(self):
+        """Four same-filter batchmates in one search_many sweep use ONE
+        cached plane (one cache entry; per-lane reuse counted), and each
+        response equals its solo run bit-for-bit."""
+        eng = _build_engine(n_docs=300, segments=1)
+        cache = FilterCache(min_freq=1)
+        svc = SearchService(eng, filter_cache=cache)
+        plain = SearchService(eng)
+        bodies = [
+            {
+                "query": {
+                    "bool": {
+                        "must": [{"match": {"title": f"w{j} w9"}}],
+                        # term may win the lead fold (never substituted);
+                        # the range filter is the shared cacheable plane.
+                        "filter": [
+                            {"term": {"tag": "red"}},
+                            {"range": {"price": {"gte": 5}}},
+                        ],
+                    }
+                },
+                "size": 5,
+            }
+            for j in range(4)
+        ]
+        # Warm: admission happens on the first coalesced sweep already
+        # (each batchmate records one sighting of the shared filters).
+        svc.search_many([SearchRequest.from_json(b) for b in bodies])
+        # TWO planes (term + range), each shared by all four batchmates —
+        # never one entry per batchmate.
+        assert cache.stats()["entries"] == 2
+        reuse_before = cache.stats()["mask_reuse"]
+        many = svc.search_many([SearchRequest.from_json(b) for b in bodies])
+        # Every batchmate reuses both shared planes: 4 lanes × 2 planes.
+        assert cache.stats()["mask_reuse"] >= reuse_before + 8
+        assert cache.stats()["entries"] == 2
+        solo = [plain.search(SearchRequest.from_json(b)) for b in bodies]
+        for m, s in zip(many, solo):
+            assert _hits_sig(m) == _hits_sig(s)
+
+
+    def test_failed_launch_retry_records_no_second_sighting(self):
+        """The micro-batcher's solo retry after a failed coalesced launch
+        passes record_filter_usage=False — search_many already counted
+        this request, and a retry that counted again would self-admit a
+        one-off filter past min_freq=2 within a single user request."""
+        eng = _build_engine(n_docs=200, segments=1)
+        cache = FilterCache(min_freq=2)
+        svc = SearchService(eng, filter_cache=cache)
+        req = SearchRequest.from_json({
+            "query": {
+                "bool": {
+                    "must": [{"match": {"title": "w1"}}],
+                    "filter": [{"range": {"price": {"gte": 10, "lt": 90}}}],
+                }
+            }
+        })
+        key = collect_cacheable_filters(req.query)[0][2]
+        svc.search_many([req])  # the coalesced attempt: ONE sighting
+        svc.search(req, record_filter_usage=False)  # the batcher's retry
+        assert not cache.should_admit(key)
+        assert cache.stats()["entries"] == 0
+
+
+class TestNormalization:
+    def test_boost_and_order_insensitive(self):
+        q1 = parse_query({"terms": {"tag": ["red", "blue"]}})
+        q2 = parse_query({"terms": {"tag": ["blue", "red"], "boost": 3.0}})
+        assert cacheable_filter_key(q1) == cacheable_filter_key(q2)
+
+    def test_statistics_dependent_shapes_refused(self):
+        assert cacheable_filter_key(parse_query({"match": {"title": "x"}})) is None
+        assert (
+            cacheable_filter_key(
+                parse_query({"match_phrase": {"title": "a b"}})
+            )
+            is None
+        )
+
+    def test_pure_filter_bool_composite_cacheable(self):
+        q = parse_query(
+            {
+                "bool": {
+                    "filter": [{"term": {"tag": "red"}}],
+                    "must_not": [{"range": {"price": {"lt": 10}}}],
+                }
+            }
+        )
+        assert cacheable_filter_key(q) is not None
+
+    def test_collect_targets_top_level_filter_context_only(self):
+        q = parse_query(
+            {
+                "bool": {
+                    "must": [{"term": {"tag": "red"}}],
+                    "filter": [
+                        {"term": {"tag": "blue"}},
+                        {"match": {"title": "x"}},
+                    ],
+                    "must_not": [{"exists": {"field": "price"}}],
+                }
+            }
+        )
+        got = collect_cacheable_filters(q)
+        groups = {(g, i) for g, i, _k in got}
+        # must clauses score -> never collected; the match filter is not
+        # cacheable; the term filter and the exists exclusion are.
+        assert groups == {("filter", 0), ("must_not", 0)}
+
+
+class TestCostAndPlanner:
+    def test_cached_mask_backend_registered_and_seeded(self):
+        from elasticsearch_tpu.exec.cost import PlanFeatures, seed_ms
+        from elasticsearch_tpu.exec.planner import ExecPlanner
+
+        assert "cached_mask" in ExecPlanner.BACKENDS
+        # Mask reuse removes the cached clauses' tiles from work_tiles,
+        # so the masked seed undercuts the full-recompute device seed.
+        full = seed_ms("device", PlanFeatures(n_docs=1_000_000, work_tiles=4096))
+        masked = seed_ms(
+            "cached_mask", PlanFeatures(n_docs=1_000_000, work_tiles=256)
+        )
+        assert masked < full
+        assert np.isfinite(masked)
+
+    def test_planner_counts_cached_mask_decisions(self):
+        eng = _build_engine(n_docs=200, segments=1)
+        from elasticsearch_tpu.exec.planner import ExecPlanner
+
+        planner = ExecPlanner()
+        cache = FilterCache(min_freq=1)
+        svc = SearchService(eng, planner=planner, filter_cache=cache)
+        body = {
+            "query": {
+                "bool": {
+                    "must": [{"match": {"title": "w1 w2"}}],
+                    # Two filters so one clause survives past the lead
+                    # fold and masked execution actually engages.
+                    "filter": [
+                        {"term": {"tag": "red"}},
+                        {"range": {"price": {"gte": 5}}},
+                    ],
+                }
+            }
+        }
+        for _ in range(4):
+            svc.search(SearchRequest.from_json(body))
+        assert planner.decisions.get("cached_mask", 0) > 0
+
+
+class TestRestAndObs:
+    @pytest.fixture()
+    def node(self):
+        from elasticsearch_tpu.node import Node
+
+        n = Node()
+        n.create_index("idx", {"mappings": MAPPINGS})
+        rng = random.Random(9)
+        for i in range(200):
+            n.index_doc("idx", _doc(rng), str(i))
+        n.refresh("idx")
+        yield n
+        n.close()
+
+    BODY = {
+        "query": {
+            "bool": {
+                "must": [{"match": {"title": "w1 w2"}}],
+                # Two filters: one may win the lead fold (which stays
+                # inline by design); the other exercises the cache.
+                "filter": [
+                    {"term": {"tag": "red"}},
+                    {"range": {"price": {"gte": 5}}},
+                ],
+            }
+        }
+    }
+
+    def test_cache_clear_api_reports_counts(self, node):
+        from elasticsearch_tpu.rest.server import RestServer
+
+        rest = RestServer(node=node)
+        import json
+
+        for _ in range(3):
+            status, _ = rest.dispatch(
+                "POST", "/idx/_search", {}, json.dumps(self.BODY)
+            )
+            assert status == 200
+        assert node.filter_cache.stats()["entries"] > 0
+        status, out = rest.dispatch("POST", "/idx/_cache/clear", {}, "")
+        assert status == 200
+        assert out["cleared"]["filter_cache"] >= 1
+        assert node.filter_cache.stats()["entries"] == 0
+        # Bare /_cache/clear clears node-wide (idempotent here).
+        status, out = rest.dispatch("POST", "/_cache/clear", {}, "")
+        assert status == 200
+        assert out["cleared"]["filter_cache"] == 0
+        # Unknown concrete index 404s like the reference — alone AND as
+        # an element of a comma list (a missing concrete name must not
+        # silently succeed just because a real one rode along).
+        status, _ = rest.dispatch("POST", "/nope/_cache/clear", {}, "")
+        assert status == 404
+        status, _ = rest.dispatch("POST", "/idx,nope/_cache/clear", {}, "")
+        assert status == 404
+
+    def test_nodes_stats_and_metrics_expose_filter_cache(self, node):
+        for _ in range(3):
+            node.search("idx", dict(self.BODY))
+        section = node.nodes_stats()["nodes"][node.node_name]["indices"][
+            "filter_cache"
+        ]
+        assert section["enabled"] is True
+        assert section["admissions"] >= 1
+        assert section["hit_count"] >= 1
+        assert section["bytes_resident"] > 0
+        text = node.metrics_text()
+        assert "estpu_filter_cache_hits_total" in text
+        assert "estpu_filter_cache_bytes_resident" in text
+
+    def test_delete_index_drops_planes_and_breaker_bytes(self, node):
+        for _ in range(3):
+            node.search("idx", dict(self.BODY))
+        assert node.filter_cache.stats()["entries"] > 0
+        used_before = node.breaker.used
+        node.delete_index("idx")
+        # Orphaned planes would stay charged to the shared HBM breaker
+        # forever (their engine uids can never be looked up again).
+        assert node.filter_cache.stats()["entries"] == 0
+        assert node.breaker.used < used_before
+
+    def test_opt_out_env(self, monkeypatch):
+        from elasticsearch_tpu.node import Node
+
+        monkeypatch.setenv("ESTPU_FILTER_CACHE", "0")
+        n = Node()
+        try:
+            n.create_index("idx", {"mappings": MAPPINGS})
+            rng = random.Random(9)
+            for i in range(100):
+                n.index_doc("idx", _doc(rng), str(i))
+            n.refresh("idx")
+            out1 = n.search("idx", dict(self.BODY))
+            out2 = n.search("idx", dict(self.BODY))
+            assert out1["hits"]["total"] == out2["hits"]["total"]
+            section = n.nodes_stats()["nodes"][n.node_name]["indices"][
+                "filter_cache"
+            ]
+            assert section == {
+                "enabled": False,
+                "entries": 0,
+                "bytes_resident": 0,
+                "hit_count": 0,
+                "miss_count": 0,
+                "admissions": 0,
+                "evictions": 0,
+                "mask_reuse": 0,
+            }
+            # Clear-cache API still answers (zero filter planes).
+            out = n.clear_cache("idx")
+            assert out["cleared"]["filter_cache"] == 0
+        finally:
+            n.close()
