@@ -1423,6 +1423,17 @@ def bench_cfg5_knn(n=1_000_000, d=100, n_q=16):
     )
     o_p50 = float(np.median(oracle_times))
     speedup = (o_p50 / p50) if p50 > 0 and not mismatches else 0.0
+    # ISSUE 10 re-measure: the same corpus through the first-class `knn`
+    # SECTION, with ann_ivf as a routing candidate. The script_score
+    # numbers above are untouched — exact kNN stays brute-force and
+    # byte-identical; only the knn section may route approximate.
+    try:
+        knn_section, _parts = _knn_section_measure(
+            vecs, dev.vectors["vec"], "cosine", n_q=8,
+            rng=np.random.default_rng(53),
+        )
+    except Exception as e:  # staticcheck: ignore[broad-except] per-section isolation mirrors the per-config isolation: a knn-section failure reports itself without zeroing cfg5's exact measurements; no tasks or fault sites flow here
+        knn_section = {"error": f"{type(e).__name__}: {e}"}
     return {
         "speedup": round(speedup, 2),
         "device_p50_ms": round(p50 * 1e3, 4),
@@ -1432,7 +1443,196 @@ def bench_cfg5_knn(n=1_000_000, d=100, n_q=16):
         "dims": d,
         "n_queries": n_q,
         "upload_s": round(upload_s, 1),
+        "knn_section": knn_section,
     }
+
+
+def _knn_section_measure(vecs, dev_vectors, metric, n_q, rng, k=10):
+    """Measure the `knn` section's two backends over one vector plane:
+    ann_ivf (IVF probe + exact re-rank) vs the exact brute-force device
+    kernel, as INDIVIDUAL launches on both sides (identical methodology).
+
+    Gates: (1) zero re-rank mismatches — every ANN hit's score bit-equal
+    (fp32) to ops/ann_device.exact_scores for that doc (approximation may
+    only pick candidates, never change scoring); (2) recall@10 vs the
+    exact kernel's top-10 at the DEFAULT nprobe >= 0.95. Either failing
+    zeroes the section's speedup. Candidate fraction is reported honestly
+    (the probe examines this share of the corpus; 1.0 would be brute
+    force)."""
+    import jax
+
+    from elasticsearch_tpu.index.ann import build_partitions, default_nprobe
+    from elasticsearch_tpu.ops import ann_device
+
+    n, d = vecs.shape
+    t0 = time.monotonic()
+    parts = build_partitions(
+        "vec", vecs, dev_vectors, num_docs=n, metric=metric
+    )
+    build_s = time.monotonic() - t0
+    live = jax.numpy.ones(n, bool)
+    nprobe = default_nprobe(parts.n_partitions)
+    qs = rng.standard_normal((n_q, d)).astype(np.float32)
+    if metric == "dot_product":
+        qs /= np.linalg.norm(qs, axis=1, keepdims=True)
+    # Warm both programs (first launch is the XLA compile).
+    jax.block_until_ready(
+        ann_device.ann_ivf_search(parts.tree(), live, qs[0], k, nprobe,
+                                  metric)
+    )
+    jax.block_until_ready(
+        ann_device.knn_exact(dev_vectors, live, qs[0], k, metric)
+    )
+    ann_times, brute_times = [], []
+    rerank_mismatches = 0
+    recall_hits = 0
+    cand_fracs = []
+    for qi in range(n_q):
+        q = qs[qi]
+        t0 = time.monotonic()
+        s, ids, _tot, n_cand = jax.block_until_ready(
+            ann_device.ann_ivf_search(
+                parts.tree(), live, q, k, nprobe, metric
+            )
+        )
+        ann_times.append(time.monotonic() - t0)
+        t0 = time.monotonic()
+        es, ei, _et = jax.block_until_ready(
+            ann_device.knn_exact(dev_vectors, live, q, k, metric)
+        )
+        brute_times.append(time.monotonic() - t0)
+        s, ids = np.asarray(s), np.asarray(ids)
+        es, ei = np.asarray(es), np.asarray(ei)
+        cand_fracs.append(float(n_cand) / n)
+        # Parity law: bit-exact fp32 against the exact scorer of record.
+        exact = np.asarray(ann_device.exact_scores(dev_vectors, q, metric))
+        if not np.array_equal(s, exact[ids]):
+            rerank_mismatches += 1
+        recall_hits += len(set(ids.tolist()) & set(ei.tolist()))
+    recall = recall_hits / (n_q * k)
+    ann_p50 = float(np.median(ann_times))
+    brute_p50 = float(np.median(brute_times))
+    gates_ok = rerank_mismatches == 0 and recall >= 0.95
+    # Routed backend for the knn section: the approximate-by-contract
+    # exception — ann_ivf is admissible only with its gates green, and
+    # then the cheaper measured backend wins (the serving planner's
+    # decide() over the same two candidates).
+    backend = (
+        "ann_ivf" if gates_ok and ann_p50 <= brute_p50 else "device"
+    )
+    routed = ann_p50 if backend == "ann_ivf" else brute_p50
+    return {
+        "backend": backend,
+        "routed_p50_ms": round(routed * 1e3, 4),
+        "ann_p50_ms": round(ann_p50 * 1e3, 4),
+        "device_bruteforce_p50_ms": round(brute_p50 * 1e3, 4),
+        "ann_vs_bruteforce": (
+            round(brute_p50 / ann_p50, 2) if ann_p50 > 0 else 0.0
+        ),
+        "recall_at_10": round(recall, 4),
+        "rerank_mismatches": rerank_mismatches,
+        "nprobe": nprobe,
+        "partitions": parts.n_partitions,
+        "partition_size": parts.pmax,
+        "candidate_fraction": round(float(np.mean(cand_fracs)), 4),
+        "build_s": round(build_s, 1),
+        "index_bytes": parts.nbytes,
+        "n_queries": n_q,
+        "metric": metric,
+    }, parts
+
+
+def bench_cfg9_ann(n=None, d=16, n_q=8, n_centers=256):
+    """ISSUE 10 config: IVF ANN at >= 10M vectors vs the brute-force
+    device path and the CPU exact oracle.
+
+    The corpus is CLUSTERED synthetic data (a mixture of gaussians) —
+    the workload shape ANN indexes exist for; pure-noise vectors carry no
+    structure for ANY approximate index (the reference's HNSW included)
+    to exploit. Gates: recall@10 >= 0.95 at the default nprobe against
+    the exact device kernel, ZERO candidate re-rank score mismatches
+    (bit-exact fp32 vs ops/ann_device.exact_scores), and the brute-force
+    side ranked_match-checked against the CPU oracle. The ANN-beats-
+    brute-force latency claim is measured per query (individual launches
+    both sides); the CPU round reports it honestly and the real-TPU
+    round confirms it."""
+    import os
+
+    import jax
+
+    from elasticsearch_tpu.ops import ann_device
+
+    if n is None:
+        n = int(os.environ.get("ESTPU_BENCH_ANN_N", 10_000_000))
+    rng = np.random.default_rng(41)
+    centers = rng.standard_normal((n_centers, d)).astype(np.float32) * 3.0
+    t0 = time.monotonic()
+    vecs = np.empty((n, d), dtype=np.float32)
+    chunk = 1_000_000
+    for start in range(0, n, chunk):
+        m = min(chunk, n - start)
+        assign = rng.integers(0, n_centers, m)
+        vecs[start : start + m] = centers[assign] + rng.standard_normal(
+            (m, d)
+        ).astype(np.float32)
+    corpus_s = time.monotonic() - t0
+    dev_vectors = jax.device_put(vecs)
+    jax.block_until_ready(dev_vectors)
+    out, _parts = _knn_section_measure(vecs, dev_vectors, "cosine", n_q, rng)
+    # CPU exact oracle: numpy full-scan cosine + top-10, chunked; the
+    # brute-force device side must ranked_match it (f32 accumulation
+    # order differs host-vs-device: 64-ulp tolerance like cfg5).
+    oracle_times = []
+    oracle_mismatches = 0
+    qs = rng.standard_normal((n_q, d)).astype(np.float32)
+    for qi in range(n_q):
+        q = qs[qi]
+        t0 = time.monotonic()
+        best_s = np.empty(0, np.float32)
+        best_i = np.empty(0, np.int64)
+        for start in range(0, n, chunk):
+            sims = ann_device.similarity_scores(
+                np, vecs[start : start + chunk], q, "cosine"
+            )
+            part = np.argpartition(-sims, min(K, len(sims) - 1))[: K * 4]
+            order = part[np.lexsort((part, -sims[part]))][:K]
+            best_s = np.concatenate([best_s, sims[order]])
+            best_i = np.concatenate([best_i, order + start])
+        keep = np.lexsort((best_i, -best_s))[:K]
+        o_scores, o_ids = best_s[keep], best_i[keep]
+        oracle_times.append(time.monotonic() - t0)
+        es, ei, _ = jax.block_until_ready(
+            ann_device.knn_exact(dev_vectors, jax.numpy.ones(n, bool), q,
+                                 K, "cosine")
+        )
+        if not ranked_match(
+            np.asarray(ei), np.asarray(es), [int(x) for x in o_ids],
+            o_scores, ulps=64,
+        ):
+            oracle_mismatches += 1
+    o_p50 = float(np.median(oracle_times))
+    routed = out["routed_p50_ms"] / 1e3
+    gates_ok = (
+        out["rerank_mismatches"] == 0
+        and out["recall_at_10"] >= 0.95
+        and oracle_mismatches == 0
+    )
+    out.update(
+        {
+            "speedup": (
+                round(o_p50 / routed, 2) if gates_ok and routed > 0 else 0.0
+            ),
+            # The outer routing glue reads these two names.
+            "device_p50_ms": out["device_bruteforce_p50_ms"],
+            "oracle_p50_ms": round(o_p50 * 1e3, 4),
+            "mismatches": oracle_mismatches + out["rerank_mismatches"],
+            "recall_gate_passed": out["recall_at_10"] >= 0.95,
+            "n_vectors": n,
+            "dims": d,
+            "corpus_build_s": round(corpus_s, 1),
+        }
+    )
+    return out
 
 
 def bench_cfg8_filter_cache(segment, dev, seg_tree, mappings, n_q=48,
@@ -1892,6 +2092,7 @@ def main():
             "cfg8_filter_cache",
             lambda: bench_cfg8_filter_cache(segment, dev, seg_tree, mappings),
         ),
+        ("cfg9_ann", bench_cfg9_ann),
     ):
         try:
             configs[name] = fn()
@@ -1949,6 +2150,18 @@ def main():
         ):
             # Same caveat: batch-amortized lower bound on solo latency.
             measured["blockmax_conj"] = cfg["blockmax_conj_per_query_ms"]
+        if (
+            cfg.get("ann_p50_ms")
+            and cfg.get("rerank_mismatches") == 0
+            and cfg.get("recall_at_10", 0.0) >= 0.95
+        ):
+            # The approximate-by-contract exception: the `knn` section's
+            # ann_ivf backend is a routing candidate gated on the re-rank
+            # bit-exactness law and the recall@10 >= 0.95 floor instead
+            # of identical-results parity (which approximate kNN cannot
+            # and does not promise — candidate REACH is the
+            # approximation, scoring never is).
+            measured["ann_ivf"] = cfg["ann_p50_ms"]
         if (
             cfg.get("cached_mask_per_query_ms")
             and cfg.get("cached_mask_mismatches") == 0

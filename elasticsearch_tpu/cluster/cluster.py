@@ -132,6 +132,19 @@ class ClusterNode:
         from ..obs.metrics import MetricsRegistry
 
         self.metrics = MetricsRegistry()
+        # Per-node filter/bitset cache (index/filter_cache.py): replicated
+        # shard searches consult it exactly like the single-process
+        # coordinator's shard services do. Admission counts ONE sighting
+        # per user request per node — the coordinating node marks the
+        # FIRST shard request it sends to each target node as the
+        # recording one (payload flag), so an n-shard scatter landing
+        # several shards on one node cannot self-admit one-off filters
+        # past min_freq within a single request.
+        from ..index.filter_cache import FilterCache
+
+        self.filter_cache = None
+        if os.environ.get("ESTPU_FILTER_CACHE", "1") != "0":
+            self.filter_cache = FilterCache(metrics=self.metrics)
         self._search_counters = {
             key: self.metrics.counter(
                 "estpu_cluster_search_resilience_total",
@@ -780,6 +793,11 @@ class ClusterNode:
         try:
             engine.refresh()
             request = SearchRequest.from_json(payload["body"])
+            # One admission sighting per user request per node: only the
+            # scatter's FIRST shard request to this node records (the
+            # coordinator sets the flag; absent = a direct single-shard
+            # search, which is its own user request).
+            record_usage = bool(payload.get("record_filter_usage", True))
             # One segment snapshot shared by the agg pass and the hits
             # pass, like the single-process shard service.
             segments = list(engine.segments)
@@ -804,8 +822,12 @@ class ClusterNode:
                 request = dc_replace(request, aggs=None)
             k = max(0, request.from_) + max(0, request.size)
             if k > 0 or agg_total is None:
-                resp = SearchService(engine, payload["index"]).search(
-                    request, segments=segments
+                resp = SearchService(
+                    engine, payload["index"],
+                    filter_cache=self.filter_cache,
+                ).search(
+                    request, segments=segments,
+                    record_filter_usage=record_usage,
                 )
                 total = agg_total if agg_total is not None else resp.total
                 max_score, hits = resp.max_score, resp.hits
@@ -911,6 +933,11 @@ class ClusterNode:
         agg_acc: list | None = None
         from ..obs.tracing import TRACER
 
+        # Target nodes that already recorded this REQUEST's filter-cache
+        # sighting: the first shard request sent to a node records, later
+        # shards of the same scatter pass record_filter_usage=False — one
+        # sighting per user request per node cache.
+        recorded_nodes: set[str] = set()
         for shard_id, routing in sorted(meta.shards.items()):
             copies = [
                 n
@@ -922,7 +949,8 @@ class ClusterNode:
                 "cluster.shard", shard=shard_id, index=index
             ) as shard_span:
                 resp, failure = self._search_one_shard(
-                    index, shard_id, copies, shard_body
+                    index, shard_id, copies, shard_body,
+                    recorded_nodes=recorded_nodes,
                 )
                 if shard_span is not None and failure is not None:
                     shard_span.status = "error"
@@ -976,6 +1004,10 @@ class ClusterNode:
         if failed:
             self._count_search("partial_results")
         merged.sort(key=lambda t: (t[0], t[1], t[2]))
+        if request.knn is not None:
+            # Global top-k reduce (the kNN coordinator contract): shards
+            # contribute up to k candidates each; the merge keeps k.
+            merged = merged[: request.knn.k]
         frm = int(body.get("from", 0))
         page = []
         for _, _, _, h in merged[frm : frm + size]:
@@ -1022,11 +1054,15 @@ class ClusterNode:
         return out
 
     def _search_one_shard(
-        self, index: str, shard_id: int, copies: list[str], shard_body: dict
+        self, index: str, shard_id: int, copies: list[str],
+        shard_body: dict, recorded_nodes: set | None = None,
     ) -> tuple[dict | None, dict | None]:
         """Query one shard across its copies: EWMA-ranked order, bounded
         backoff between rounds. Returns (response, None) on success or
-        (None, failure entry) once every copy of every round failed."""
+        (None, failure entry) once every copy of every round failed.
+        `recorded_nodes` tracks which target nodes already counted this
+        request's filter-cache admission sighting (first send records,
+        every other shard/retry to that node passes False)."""
         from ..obs.tracing import TRACER
 
         ordered = self.response_collector.ordered(copies)
@@ -1059,12 +1095,25 @@ class ClusterNode:
                         attempt=attempts,
                     )
                 t0 = time.monotonic()
+                record = (
+                    recorded_nodes is not None and node not in recorded_nodes
+                )
+                if record:
+                    # Marked at SEND time: a search that fails mid-shard
+                    # may still have counted its sighting, exactly like a
+                    # failed solo request.
+                    recorded_nodes.add(node)
                 try:
                     resp = self.hub.send(
                         self.node_id,
                         node,
                         "shard_search",
-                        {"index": index, "shard": shard_id, "body": shard_body},
+                        {
+                            "index": index,
+                            "shard": shard_id,
+                            "body": shard_body,
+                            "record_filter_usage": record,
+                        },
                     )
                 except RemoteActionError as e:
                     if e.remote_type in ("ValueError", "TypeError"):

@@ -41,6 +41,10 @@ class PlanFeatures:
     n_clauses: int = 1  # scoring clauses (run-fold width proxy)
     n_shards: int = 1  # stacked shards served by one launch
     n_lanes: int = 1  # coalesced (query, tenant) lanes sharing one launch
+    # ANN probe work: centroids scanned + nprobe · partition_size
+    # candidates gathered/re-ranked (the ann_ivf seed's scale — the knn
+    # section's cost is independent of corpus size by design).
+    n_candidates: int = 0
 
 
 # Seed coefficients, milliseconds. Anchored to BENCH_r05 measurements
@@ -123,6 +127,20 @@ def seed_ms(backend: str, feats: PlanFeatures) -> float:
                 1, feats.n_clauses
             )
         return cost
+    if backend == "ann_ivf":
+        # IVF kNN: one launch, a coarse scan + gathered re-rank priced in
+        # candidates EXAMINED (feats.n_candidates = centroids + nprobe ·
+        # partition_size) instead of corpus size, plus the dense [N]
+        # scatter/top-k plane both knn kernels share. The exact knn
+        # brute-force alternative prices through the default device
+        # formula below (its dense term scales with n_docs), so the seed
+        # ordering flips to ann_ivf exactly when the probe examines a
+        # small fraction of the corpus.
+        return (
+            _DEVICE_LAUNCH_MS
+            + _DEVICE_DENSE_MS * (feats.n_candidates / 1e6)
+            + 0.25 * _DEVICE_DENSE_MS * (feats.n_docs / 1e6)
+        )
     if backend == "packed":
         # Packed multi-tenant launch (exec/packed.py): ONE dispatch is
         # shared by every coalesced lane, so the per-lane launch floor

@@ -198,6 +198,10 @@ class PackedExecutor:
         suggest shapes."""
         if len(svc.engines) != 1:
             return False
+        if getattr(request, "knn", None) is not None:
+            # kNN coalesces through its own ("_knn", ...) batcher group;
+            # packed planes carry postings only, never vector planes.
+            return False
         # The per-tenant assembly (fetch/pagination) runs through the
         # tenant's own SearchService; anything else (sharded coordinator)
         # keeps its per-index group.

@@ -154,6 +154,16 @@ class ExecPlanner:
         # carry the REDUCED work_tiles — mask reuse priced against the
         # oracle's full recompute.
         "cached_mask",
+        # IVF-partitioned approximate kNN (index/ann.py + ops/ann_device):
+        # coarse centroid scan → nprobe partition gather → exact re-rank.
+        # Its cost scales in the CANDIDATES examined (centroids + nprobe ·
+        # partition_size, PlanFeatures.n_candidates), not the corpus — the
+        # whole point of leaving the O(N) brute-force path. Only eligible
+        # under the `knn` section's approximate-by-contract semantics
+        # (routing it never changes how candidates are SCORED, only which
+        # candidates the probe reaches); exact `script_score` kNN keeps
+        # the routing-never-changes-top-k invariant and never routes here.
+        "ann_ivf",
     )
 
     def __init__(self, cost_model: CostModel | None = None, metrics=None):

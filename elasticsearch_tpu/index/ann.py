@@ -1,0 +1,505 @@
+"""IVF partition planes for dense_vector fields (approximate kNN index).
+
+The index half of the `knn` search section (the reference builds Lucene
+HNSW graphs per segment at flush time — `index/mapping/vectors/`,
+`org.apache.lucene.util.hnsw`; here the device-friendly structure is
+IVF): at pack time a segment's vectors are coarse-quantized with k-means
+and REGROUPED on device into partition-contiguous tiles, so a query's
+probe gathers `nprobe` contiguous [pmax, d] slabs instead of chasing
+graph pointers.
+
+Build pipeline (`build_partitions`, all seeded/deterministic):
+
+1. **Train** — Lloyd iterations on a bounded sample. The heavy half
+   (nearest-centroid assignment, an [M, C] distance matmul) runs on
+   device in chunks (`ops/ann_device.assign_chunk`); the mean update
+   folds on host with `np.add.at` (deterministic accumulation order).
+   Cosine-similarity fields train on L2-normalized copies (spherical
+   k-means); l2/dot train on raw vectors.
+2. **Assign** — one chunked device pass labels every vector.
+3. **Split** — clusters larger than the uniform partition size `pmax`
+   split into multiple partitions sharing one centroid row. This bounds
+   the padded layout at roughly 1.5–2.5× the raw vectors even under
+   cluster skew (pmax is ~1.5× the mean cluster size), where a
+   pad-to-max-cluster layout could blow up arbitrarily.
+4. **Regroup** — one device gather builds `part_vectors` f32[C, pmax, d]
+   (padding rows zero) and `part_docs` i32[C, pmax] (sentinel = num_docs)
+   — the per-partition doc-id remap tables the kernel scatters results
+   back through.
+
+Incremental handling mirrors the filter cache (index/filter_cache.py):
+partitions are cached per (engine uid, segment-handle uid, field).
+Segment postings/vectors are immutable, so a handle uid alone scopes
+validity: a refresh gives NEW segments fresh handles (their partitions
+build on first kNN query), unchanged segments keep hitting, and
+merged-away segments' planes are pruned eagerly via `live_uids` on the
+next store. Soft-deletes need no invalidation — partitions exclude the
+live mask, which ANDs in at query time.
+
+A segment below `min_docs` (ESTPU_ANN_MIN_DOCS, default 4096) is not
+partitioned: `get_or_build` returns None and the serving path stays on
+the exact brute-force kernel — probing most of a tiny corpus costs more
+than scanning it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.breaker import BreakerError
+from ..ops.ann_device import METRICS, assign_all
+
+DEFAULT_MIN_DOCS = 4096  # below this, brute force wins — don't partition
+DEFAULT_MAX_PARTITIONS = 1024
+DEFAULT_KMEANS_ITERS = 4
+DEFAULT_SAMPLE_PER_PARTITION = 64
+DEFAULT_MAX_BYTES = 2 << 30
+DEFAULT_SEED = 17
+
+
+def default_nprobe(n_partitions: int) -> int:
+    """Default probe width: an eighth of the partitions (min 4). With
+    C ≈ √N partitions this scans ~C·pmax/8 ≈ N/8 candidates — the
+    recall ≥ 0.95 operating point the fuzz suite and bench gate."""
+    return max(4, n_partitions // 8)
+
+
+@dataclass
+class AnnPartitions:
+    """One (segment, field)'s IVF planes, device-resident."""
+
+    field: str
+    metric: str
+    centroids: jax.Array  # f32[C, d] (split partitions repeat a centroid)
+    part_vectors: jax.Array  # f32[C, pmax, d]
+    part_docs: jax.Array  # i32[C, pmax], sentinel = num_docs
+    pmax: int
+    n_vectors: int
+    num_docs: int
+    n_clusters: int  # distinct k-means clusters (before splitting)
+    nbytes: int
+
+    @property
+    def n_partitions(self) -> int:
+        return int(self.part_docs.shape[0])
+
+    def tree(self) -> dict[str, Any]:
+        """The kernel input pytree (ops/ann_device.ann_ivf_search)."""
+        return {
+            "centroids": self.centroids,
+            "part_vectors": self.part_vectors,
+            "part_docs": self.part_docs,
+        }
+
+
+def _train_kmeans(
+    sample: np.ndarray, n_clusters: int, iters: int, rng
+) -> np.ndarray:
+    """Seeded Lloyd: device-side chunked assignment, host mean update
+    (np.add.at — deterministic accumulation). Empty clusters keep their
+    previous centroid. Returns f32[n_clusters, d]."""
+    n, d = sample.shape
+    init = rng.choice(n, size=min(n_clusters, n), replace=False)
+    centroids = sample[np.sort(init)].astype(np.float32)
+    if len(centroids) < n_clusters:
+        centroids = np.pad(centroids, ((0, n_clusters - len(centroids)), (0, 0)))
+    for _ in range(max(1, iters)):
+        assign = assign_all(jnp.asarray(centroids), sample)
+        sums = np.zeros((n_clusters, d), dtype=np.float64)
+        np.add.at(sums, assign, sample.astype(np.float64))
+        counts = np.bincount(assign, minlength=n_clusters)
+        nonempty = counts > 0
+        centroids = centroids.copy()
+        centroids[nonempty] = (
+            sums[nonempty] / counts[nonempty, None]
+        ).astype(np.float32)
+    return centroids
+
+
+def build_partitions(
+    field: str,
+    vectors: np.ndarray,
+    device_vectors,
+    num_docs: int,
+    metric: str = "cosine",
+    n_partitions: int | None = None,
+    seed: int = DEFAULT_SEED,
+    iters: int = DEFAULT_KMEANS_ITERS,
+) -> "AnnPartitions | None":
+    """Build one segment's IVF planes. `vectors` is the host f32[N, d]
+    matrix (k-means sampling/update side); `device_vectors` the already-
+    resident device copy (regroup gather side — no second upload).
+    Returns None when the segment holds no real (nonzero) vectors."""
+    if metric not in METRICS:
+        raise ValueError(f"unknown dense_vector similarity [{metric}]")
+    n, d = vectors.shape
+    # Docs without a stored vector zero-fill their matrix row
+    # (index/segment.py flush); they are excluded from the partition
+    # layout HERE, at build time, so the query kernel never has to
+    # re-check vector presence per candidate (an O(candidates·d) pass
+    # that measured ~2× on the probe path). The doc_map invariant the
+    # kernel relies on: every mapped slot names a doc with a real
+    # vector.
+    real = np.flatnonzero(np.any(vectors != 0, axis=1))
+    if len(real) == 0:
+        return None
+    n_real = len(real)
+    if n_partitions is None:
+        cap = int(os.environ.get("ESTPU_ANN_MAX_PARTITIONS",
+                                 DEFAULT_MAX_PARTITIONS))
+        n_partitions = int(np.clip(int(np.sqrt(n_real)), 8, max(8, cap)))
+    n_partitions = min(n_partitions, n_real)
+    rng = np.random.default_rng(seed)
+    train = vectors
+    if metric == "cosine":
+        # Spherical k-means: cluster directions, not magnitudes — the
+        # space the cosine coarse scan ranks in.
+        norms = np.linalg.norm(vectors, axis=1, keepdims=True)
+        train = (vectors / np.where(norms > 0, norms, 1.0)).astype(np.float32)
+    sample_idx = real[
+        np.sort(
+            rng.choice(
+                n_real,
+                size=min(
+                    n_real, DEFAULT_SAMPLE_PER_PARTITION * n_partitions
+                ),
+                replace=False,
+            )
+        )
+    ]
+    centroids = _train_kmeans(
+        train[sample_idx], n_partitions, iters, rng
+    )
+    assign = assign_all(jnp.asarray(centroids), train[real])
+    sizes = np.bincount(assign, minlength=n_partitions)
+    # Uniform partition size, bounded vs the MEAN (not the max): skewed
+    # clusters split into several partitions sharing a centroid row, so
+    # padding stays bounded under any skew.
+    pmax = int(np.ceil(1.5 * n_real / n_partitions))
+    pmax = max(32, ((pmax + 7) // 8) * 8)
+    # Stable argsort over the (doc-ascending) real ids: slots within a
+    # partition stay doc-ascending — the kernel's tie-break relies on it.
+    order = real[np.argsort(assign, kind="stable")]
+    starts = np.concatenate(([0], np.cumsum(sizes)))[:-1]
+    part_cluster: list[int] = []  # partition slot -> source cluster
+    slot_doc_rows: list[np.ndarray] = []
+    for c in range(n_partitions):
+        if sizes[c] == 0:
+            continue
+        docs = order[starts[c] : starts[c] + sizes[c]]
+        for off in range(0, len(docs), pmax):
+            part_cluster.append(c)
+            slot_doc_rows.append(docs[off : off + pmax])
+    n_parts = len(slot_doc_rows)
+    doc_map = np.full((n_parts, pmax), num_docs, dtype=np.int32)
+    for i, row in enumerate(slot_doc_rows):
+        doc_map[i, : len(row)] = row
+    cent_rows = centroids[np.asarray(part_cluster, dtype=np.int64)]
+    # Regroup ON DEVICE: one gather of the resident vector plane; padding
+    # slots read row 0 then zero out, so no stray doc's vector leaks into
+    # a padding slot a bug might unmask.
+    dm = jnp.asarray(doc_map)
+    valid = dm != jnp.int32(num_docs)
+    safe = jnp.where(valid, dm, 0)
+    part_vectors = jnp.where(
+        valid[:, :, None],
+        jnp.asarray(device_vectors)[safe.reshape(-1)].reshape(
+            n_parts, pmax, d
+        ),
+        jnp.float32(0.0),
+    )
+    centroids_dev = jax.device_put(cent_rows)
+    part_docs = jax.device_put(doc_map)
+    nbytes = int(
+        part_vectors.nbytes + part_docs.nbytes + centroids_dev.nbytes
+    )
+    return AnnPartitions(
+        field=field,
+        metric=metric,
+        centroids=centroids_dev,
+        part_vectors=part_vectors,
+        part_docs=part_docs,
+        pmax=pmax,
+        n_vectors=int(n_real),
+        num_docs=int(num_docs),
+        n_clusters=int(np.count_nonzero(sizes)),
+        nbytes=nbytes,
+    )
+
+
+class AnnCache:
+    """Node-wide store of per-(segment, field) IVF planes.
+
+    Keyed (engine uid, segment-handle uid, field) — the filter cache's
+    invalidation scheme: fresh handles on refresh/merge mint fresh keys,
+    dead handles prune eagerly via live_uids on store, LRU eviction under
+    a byte budget charged to the node HBM breaker (label "ann_cache").
+    Unlike the filter cache there is no admission frequency: building
+    partitions costs a k-means pass, so the first kNN query against a
+    big-enough segment pays the build and every later query reuses it.
+    """
+
+    def __init__(
+        self,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        min_docs: int = DEFAULT_MIN_DOCS,
+        breaker=None,
+        metrics=None,
+    ):
+        self.max_bytes = int(max_bytes)
+        self.min_docs = int(min_docs)
+        self.breaker = breaker
+        self._lock = threading.Lock()
+        # key -> AnnPartitions; OrderedDict-style LRU via move-to-end.
+        from collections import OrderedDict
+
+        self._entries: "OrderedDict[tuple, AnnPartitions]" = OrderedDict()
+        self._bytes = 0
+        # Resident-plane totals as plain ints so the gauges below never
+        # iterate the mutable entry dict outside the lock (a scrape racing
+        # an eviction burst would RuntimeError mid-iteration).
+        self._partitions_resident = 0
+        self._centroids_resident = 0
+        # Single-flight build latches: concurrent first queries against
+        # one (engine, handle, field) must not each pay the k-means +
+        # regroup pass (and transiently hold N copies of the planes).
+        self._building: dict[tuple, threading.Lock] = {}
+        if metrics is None:
+            from ..obs.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
+        self._builds = metrics.counter(
+            "estpu_ann_builds_total",
+            "IVF partition planes built (k-means + regroup passes)",
+        )
+        self._evictions = metrics.counter(
+            "estpu_ann_evictions_total",
+            "IVF planes dropped (LRU under the byte/HBM budget, dead "
+            "segment handles, index deletes)",
+        )
+        metrics.gauge(
+            "estpu_ann_bytes_resident",
+            "HBM bytes held by IVF partition planes",
+            fn=lambda: self._bytes,
+        )
+        metrics.gauge(
+            "estpu_ann_partitions_resident",
+            "IVF partitions resident across cached planes",
+            fn=lambda: self._partitions_resident,
+        )
+        metrics.gauge(
+            "estpu_ann_centroids_resident",
+            "Distinct k-means centroids resident across cached planes",
+            fn=lambda: self._centroids_resident,
+        )
+        self._searches: dict[str, Any] = {}
+        self._probes = metrics.counter(
+            "estpu_ann_probes_total",
+            "IVF partitions probed across knn segment passes",
+        )
+        self._cand_hist = metrics.histogram(
+            "estpu_ann_candidate_fraction",
+            (0.005, 0.01, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0),
+            "Fraction of a segment's docs examined as knn candidates "
+            "(1.0 = the exact brute-force pass)",
+        )
+        self._recall_gate: dict[str, Any] = {}
+
+    def note_search(
+        self, backend: str, nprobe: int = 0,
+        candidate_fraction: float = 1.0,
+    ) -> None:
+        """Count one knn segment pass (the `search.ann` stats feed)."""
+        counter = self._searches.get(backend)
+        if counter is None:
+            counter = self.metrics.counter(
+                "estpu_ann_searches_total",
+                "knn segment passes by execution backend",
+                backend=backend,
+            )
+            with self._lock:
+                self._searches.setdefault(backend, counter)
+        counter.inc()
+        if nprobe > 0:
+            self._probes.inc(nprobe)
+        self._cand_hist.observe(min(1.0, float(candidate_fraction)))
+
+    def note_recall_gate(self, passed: bool) -> None:
+        """Record one recall-gate outcome (the fuzz suite / smoke script /
+        bench recall measurements report through here so `_nodes/stats`
+        `search.ann` carries the latest gate results)."""
+        outcome = "pass" if passed else "fail"
+        counter = self._recall_gate.get(outcome)
+        if counter is None:
+            counter = self.metrics.counter(
+                "estpu_ann_recall_gate_total",
+                "ANN recall-gate checks (recall@10 vs exact top-k)",
+                outcome=outcome,
+            )
+            with self._lock:
+                self._recall_gate.setdefault(outcome, counter)
+        counter.inc()
+
+    # ------------------------------------------------------------- lookup
+
+    def get_or_build(self, engine, handle, field: str, metric: str):
+        """The (engine, segment, field) IVF planes — cached, or built on
+        first use. None when the segment is too small to partition (the
+        caller serves exact brute force). A declined-residency build is
+        still returned and serves its request; only caching is skipped.
+        Builds are single-flight per key: concurrent first queries wait
+        on one builder instead of each paying the k-means pass."""
+        vectors = handle.segment.vectors.get(field)
+        if vectors is None or len(vectors) < self.min_docs:
+            return None
+        key = (engine.uid, handle.uid, field)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and entry.metric == metric:
+                self._entries.move_to_end(key)
+                return entry
+            gate = self._building.setdefault(key, threading.Lock())
+        with gate:
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None and entry.metric == metric:
+                    self._entries.move_to_end(key)
+                    return entry
+            # Build OUTSIDE self._lock (only the per-key gate held): a
+            # k-means pass must not stall readers of other keys.
+            parts = build_partitions(
+                field,
+                vectors,
+                handle.device.vectors[field],
+                num_docs=handle.device.num_docs,
+                metric=metric,
+                seed=int(os.environ.get("ESTPU_ANN_SEED", DEFAULT_SEED)),
+            )
+            if parts is not None:  # None: no real vectors — exact path
+                self._builds.inc()
+                live_uids = frozenset(h.uid for h in engine.segments)
+                self._store(key, parts, live_uids)
+        with self._lock:
+            self._building.pop(key, None)
+        return parts
+
+    def _store(self, key, parts: AnnPartitions, live_uids) -> bool:
+        if parts.nbytes > self.max_bytes:
+            return False
+        with self._lock:
+            if key in self._entries:
+                # Same key, different plane (a metric change after a
+                # mapping update): the old plane can never serve again —
+                # replace it, never keep both charged to the breaker.
+                self._drop_locked(key)
+            # Prune planes of merged-away segments of this engine first —
+            # they can never be looked up again.
+            dead = [
+                k for k in self._entries
+                if k[0] == key[0] and k[1] not in live_uids
+            ]
+            for k in dead:
+                self._drop_locked(k)
+            while self._bytes + parts.nbytes > self.max_bytes and self._entries:
+                self._drop_locked(next(iter(self._entries)))
+            reserved = False
+            if self.breaker is not None:
+                freed = 0
+                while True:
+                    try:
+                        self.breaker.add(parts.nbytes, label="ann_cache")
+                        reserved = True
+                        break
+                    except BreakerError:
+                        if not self._entries or freed >= parts.nbytes:
+                            # Pressure from other labels: wiping more of
+                            # the warm cache can't relieve it — decline.
+                            return False
+                        freed += self._drop_locked(next(iter(self._entries)))
+            try:
+                self._entries[key] = parts
+                self._bytes += parts.nbytes
+                self._partitions_resident += parts.n_partitions
+                self._centroids_resident += parts.n_clusters
+            except BaseException:
+                if reserved:
+                    self.breaker.release(parts.nbytes)
+                raise
+            return True
+
+    def _drop_locked(self, key) -> int:
+        parts = self._entries.pop(key)
+        self._bytes -= parts.nbytes
+        self._partitions_resident -= parts.n_partitions
+        self._centroids_resident -= parts.n_clusters
+        if self.breaker is not None:
+            self.breaker.release(parts.nbytes)
+        self._evictions.inc()
+        return parts.nbytes
+
+    def clear(self, engine_uid=None) -> int:
+        """Drop planes (all, or one engine's — index delete / cache
+        clear). Returns the number dropped."""
+        with self._lock:
+            keys = [
+                k for k in self._entries
+                if engine_uid is None or k[0] == engine_uid
+            ]
+            for k in keys:
+                self._drop_locked(k)
+            return len(keys)
+
+    def stats(self) -> dict:
+        with self._lock:
+            entries = list(self._entries.values())
+            bytes_resident = self._bytes
+            searches = list(self._searches.items())
+            recall_gate = list(self._recall_gate.items())
+        return {
+            "enabled": True,
+            "planes": len(entries),
+            "partitions": sum(p.n_partitions for p in entries),
+            "centroids": sum(p.n_clusters for p in entries),
+            "vectors": sum(p.n_vectors for p in entries),
+            "bytes_resident": bytes_resident,
+            "builds": int(self._builds.value),
+            "evictions": int(self._evictions.value),
+            "searches": {b: int(c.value) for b, c in sorted(searches)},
+            "probes": int(self._probes.value),
+            "recall_gate": {
+                o: int(c.value) for o, c in sorted(recall_gate)
+            },
+        }
+
+    @staticmethod
+    def disabled_stats() -> dict:
+        """`_nodes/stats` shape under ESTPU_ANN=0 — present, inert."""
+        return {
+            "enabled": False,
+            "planes": 0,
+            "partitions": 0,
+            "centroids": 0,
+            "vectors": 0,
+            "bytes_resident": 0,
+            "builds": 0,
+            "evictions": 0,
+            "searches": {},
+            "probes": 0,
+            "recall_gate": {},
+        }
+
+
+def clear_index_ann(cache: "AnnCache | None", engines) -> int:
+    """Drop every IVF plane of one index's engines (delete_index /
+    `POST /_cache/clear` — the ann twin of filter_cache.clear_index_planes)."""
+    if cache is None:
+        return 0
+    return sum(cache.clear(engine.uid) for engine in engines)

@@ -363,6 +363,21 @@ def record_filter_usage(
     return entries
 
 
+def record_knn_filter_usage(cache, knn, record: bool = True) -> None:
+    """One admission sighting for a knn section's filter (the knn twin of
+    record_filter_usage, same once-per-user-request contract: the
+    coordinator records once and its per-shard scatter passes
+    record=False). The filter's mask plane is keyed and admitted exactly
+    like a bool filter clause's."""
+    if cache is None or knn is None or knn.filter is None or not record:
+        return
+    from ..query.compile import cacheable_filter_key
+
+    norm = cacheable_filter_key(knn.filter)
+    if norm is not None:
+        cache.record([norm])
+
+
 # ---------------------------------------------------------------------------
 # Plan substitution: compiled bool spec -> masked bool spec.
 # ---------------------------------------------------------------------------

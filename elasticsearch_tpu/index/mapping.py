@@ -136,6 +136,9 @@ class FieldMapping:
     analyzer: str = "standard"
     search_analyzer: str | None = None
     dims: int = 0  # dense_vector dimension
+    # dense_vector similarity (DenseVectorFieldMapper.VectorSimilarity):
+    # drives both the `knn` section's scoring and the IVF coarse scan.
+    similarity: str = "cosine"
     index: bool = True  # whether the field is searchable
     norms: bool | None = None  # None -> type default (text: True, keyword: False)
     # Multi-fields (the reference's FieldMapper multiFields, e.g. the
@@ -150,9 +153,29 @@ class FieldMapping:
     # this copy exists for lossless to_json round-trips).
     properties: dict[str, Any] | None = None
 
+    # Max dense_vector dims (reference: DenseVectorFieldMapper MAX_DIMS).
+    MAX_DIMS = 4096
+    SIMILARITIES = ("cosine", "dot_product", "l2_norm")
+
     def __post_init__(self):
         if self.type not in ALL_TYPES:
             raise ValueError(f"No handler for type [{self.type}] on field [{self.name}]")
+        if self.type == DENSE_VECTOR:
+            # The reference requires dims up front (DenseVectorFieldMapper
+            # Builder): a mapping without it would defer the shape error
+            # to ingest — or worse, to the kernel.
+            if self.dims < 1 or self.dims > self.MAX_DIMS:
+                raise ValueError(
+                    f"The number of dimensions for field [{self.name}] "
+                    f"should be in the range [1, {self.MAX_DIMS}] but was "
+                    f"[{self.dims}]"
+                )
+            if self.similarity not in self.SIMILARITIES:
+                raise ValueError(
+                    f"Unknown similarity [{self.similarity}] for field "
+                    f"[{self.name}]; expected one of "
+                    f"{list(self.SIMILARITIES)}"
+                )
         if self.type in (KEYWORD, IP):
             self.analyzer = "keyword"
         if self.search_analyzer is None:
@@ -264,6 +287,7 @@ class Mappings:
             analyzer=spec.get("analyzer", "standard"),
             search_analyzer=spec.get("search_analyzer"),
             dims=int(spec.get("dims", 0)),
+            similarity=str(spec.get("similarity", "cosine")),
             index=bool(spec.get("index", True)),
             norms=None if norms is None else bool(norms),
             fields=subs,
@@ -317,6 +341,8 @@ class Mappings:
             spec["search_analyzer"] = f.search_analyzer
         if f.type == DENSE_VECTOR:
             spec["dims"] = f.dims
+            if f.similarity != "cosine":
+                spec["similarity"] = f.similarity
         if not f.index:
             spec["index"] = False
         if f.norms != (f.type == TEXT):

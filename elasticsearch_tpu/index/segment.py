@@ -334,11 +334,43 @@ class SegmentBuilder:
                     entries.append((surface.lower(), surface, 1))
             staged_completion.append((field_name, entries))
         elif fm.type == DENSE_VECTOR:
-            vec = np.asarray(value, dtype=np.float32)
-            if fm.dims and vec.shape[-1] != fm.dims:
+            # Reference behavior (DenseVectorFieldMapper.parse): a vector
+            # whose shape disagrees with the mapping is a 400 AT INDEX
+            # TIME with a field-naming message — it must never surface
+            # later as a kernel shape error.
+            try:
+                vec = np.asarray(value, dtype=np.float32)
+            except (TypeError, ValueError):
                 raise ValueError(
-                    f"dense_vector [{field_name}] dims mismatch: "
-                    f"{vec.shape[-1]} != {fm.dims}"
+                    f"Failed to parse object: dense_vector field "
+                    f"[{field_name}] expects an array of numbers"
+                ) from None
+            if vec.ndim != 1:
+                raise ValueError(
+                    f"dense_vector field [{field_name}] expects a flat "
+                    f"array of numbers, got an array of rank {vec.ndim}"
+                )
+            if not np.all(np.isfinite(vec)):
+                raise ValueError(
+                    f"dense_vector field [{field_name}] must not contain "
+                    f"NaN or Infinity values"
+                )
+            if vec.shape[0] != fm.dims:
+                raise ValueError(
+                    f"The [{field_name}] field has a different number of "
+                    f"dimensions [{vec.shape[0]}] than defined in the "
+                    f"mapping [{fm.dims}]"
+                )
+            if fm.similarity in ("cosine", "dot_product") and not np.any(
+                vec
+            ):
+                # Reference behavior: cosine (and unit-norm dot_product)
+                # cannot score a zero-magnitude vector. Rejecting it here
+                # also makes the kNN kernels' all-zero-row ⇒ no-vector
+                # rule exact for these metrics.
+                raise ValueError(
+                    f"The [{fm.similarity}] similarity does not support "
+                    f"vectors with zero magnitude (field [{field_name}])"
                 )
             staged_vectors.append((field_name, vec))
         elif fm.is_inverted:
@@ -517,7 +549,15 @@ class SegmentBuilder:
                 f"object mapping for [{prefix}] tried to parse field "
                 f"[{prefix}] as object, but found a concrete value"
             )
-        values = _iter_field_values(value)
+        if fm.type == DENSE_VECTOR:
+            # A dense_vector value IS the array — the generic multi-value
+            # flattening would unwrap it (making [[1,2,3]] look like a
+            # valid vector and [5] look like a scalar) and defer the
+            # shape error to the kernel. Stage the raw value; the mapper
+            # validates rank/dims/finiteness itself.
+            values = [value]
+        else:
+            values = _iter_field_values(value)
         if not values:  # empty arrays index nothing (routine ES docs)
             return
         entry = flat.get(prefix)

@@ -34,6 +34,12 @@ from .common.tasks import TaskCancelledError, TaskManager
 from .faults import REGISTRY as FAULTS
 from .faults import FaultSpec, InjectedFaultError
 from .index.engine import Engine, InvalidCasError, VersionConflictError
+from .index.ann import (
+    DEFAULT_MAX_BYTES as ANN_DEFAULT_BYTES,
+    DEFAULT_MIN_DOCS as ANN_DEFAULT_MIN_DOCS,
+    AnnCache,
+    clear_index_ann,
+)
 from .index.filter_cache import (
     DEFAULT_MAX_BYTES as FILTER_CACHE_DEFAULT_BYTES,
     DEFAULT_MIN_FREQ as FILTER_CACHE_DEFAULT_MIN_FREQ,
@@ -282,6 +288,24 @@ class Node:
                 breaker=self.breaker,
                 metrics=self.metrics,
             )
+        # ANN partition cache (index/ann.py): IVF planes for the `knn`
+        # section, built per (segment, dense_vector field) on first use,
+        # HBM-charged, invalidated like filter-cache planes. ESTPU_ANN=0
+        # opts out (every knn serves the exact brute-force kernel).
+        self.ann_cache = None
+        if os.environ.get("ESTPU_ANN", "1") != "0":
+            self.ann_cache = AnnCache(
+                max_bytes=int(
+                    os.environ.get("ESTPU_ANN_BYTES", ANN_DEFAULT_BYTES)
+                ),
+                min_docs=int(
+                    os.environ.get(
+                        "ESTPU_ANN_MIN_DOCS", ANN_DEFAULT_MIN_DOCS
+                    )
+                ),
+                breaker=self.breaker,
+                metrics=self.metrics,
+            )
         self.tasks = TaskManager(node_name)
         # Degraded-mode serving counters (GET /_nodes/stats
         # search_resilience): partial responses served, shard failures
@@ -493,11 +517,13 @@ class Node:
             search = SearchService(
                 engines[0], name, planner=self.exec_planner,
                 device=self.device, filter_cache=self.filter_cache,
+                ann_cache=self.ann_cache,
             )
         else:
             search = ShardedSearchCoordinator(
                 engines, name, planner=self.exec_planner,
                 device=self.device, filter_cache=self.filter_cache,
+                ann_cache=self.ann_cache,
             )
             from .parallel.mesh_serving import maybe_mesh_view
 
@@ -927,6 +953,7 @@ class Node:
         # stay charged to the shared HBM breaker until unrelated traffic
         # happens to LRU-evict them.
         clear_index_planes(self.filter_cache, self.indices[name].engines)
+        clear_index_ann(self.ann_cache, self.indices[name].engines)
         for engine in self.indices[name].engines:
             engine.close()
         del self.indices[name]
@@ -979,6 +1006,7 @@ class Node:
                         self.get_index(part)  # raises index_not_found
         cleared_filter = 0
         cleared_request = 0
+        cleared_ann = 0
         shards = 0
         for name in targets:
             svc = self.indices.get(name)
@@ -988,12 +1016,14 @@ class Node:
             cleared_filter += clear_index_planes(
                 self.filter_cache, svc.engines
             )
+            cleared_ann += clear_index_ann(self.ann_cache, svc.engines)
             cleared_request += self.request_cache.clear(svc.uuid)
         return {
             "_shards": {"total": shards, "successful": shards, "failed": 0},
             "cleared": {
                 "filter_cache": cleared_filter,
                 "request_cache": cleared_request,
+                "ann": cleared_ann,
             },
         }
 
@@ -1068,6 +1098,23 @@ class Node:
                         f"mapper [{fname}] cannot be changed from type "
                         f"[{existing.type}] to [{new.type}]",
                     )
+                if existing.type == "dense_vector":
+                    # dims/similarity are the vector field's indexing
+                    # contract (reference: both are non-updatable mapper
+                    # parameters): resident vectors and IVF planes were
+                    # built under them, so a silent change would score
+                    # with the wrong metric or shape-fail in the kernel.
+                    for param in ("dims", "similarity"):
+                        if getattr(existing, param) != getattr(new, param):
+                            raise ApiError(
+                                400,
+                                "illegal_argument_exception",
+                                f"Mapper for [{fname}] conflicts with "
+                                f"existing mapper: Cannot update parameter "
+                                f"[{param}] from "
+                                f"[{getattr(existing, param)}] to "
+                                f"[{getattr(new, param)}]",
+                            )
                 # Multi-fields MERGE (the reference merges mappers): subs
                 # absent from the update survive; type changes of an
                 # existing sub are as illegal as for a root field.
@@ -1518,6 +1565,13 @@ class Node:
                 raise ApiError(
                     400, "illegal_argument_exception", str(e)
                 ) from None
+            except ValueError as e:
+                # Mapper rejection of the merged doc (e.g. a dense_vector
+                # dims mismatch in the partial update) is a 400 like the
+                # plain index path — it must not escape as a 500.
+                raise ApiError(
+                    400, "mapper_parsing_exception", str(e)
+                ) from None
         if sync:
             engine.sync_translog()
         out = {
@@ -1804,6 +1858,12 @@ class Node:
                     f"[{request.from_ + request.size}]. See the scroll api "
                     f"for a more efficient way to request large data sets.",
                 )
+            if scroll is not None and request.knn is not None:
+                raise ApiError(
+                    400,
+                    "illegal_argument_exception",
+                    "[knn] cannot be used with [scroll]",
+                )
             task = self.tasks.register(
                 "indices:data/read/search",
                 description=f"indices[{index}]",
@@ -1814,7 +1874,23 @@ class Node:
                     return self._start_scroll(
                         svc, index, request, scroll, task=task
                     )
-                if self._batchable(svc, request, body):
+                if request.knn is not None and self._batchable(
+                    svc, request, body
+                ):
+                    # Coalesced kNN: same-shape knn searches (field, k,
+                    # num_candidates, nprobe, unfiltered) group into ONE
+                    # batched ANN/exact launch per segment.
+                    knn = request.knn
+                    response = self.exec_batcher.execute(
+                        svc.search,
+                        request,
+                        task=task,
+                        group_key=(
+                            "_knn", svc.name, knn.field, knn.k,
+                            knn.num_candidates, knn.nprobe,
+                        ),
+                    )
+                elif self._batchable(svc, request, body):
                     from .exec.planner import ast_signature
 
                     if self.packed_exec is not None and self.packed_exec.eligible(
@@ -1937,6 +2013,16 @@ class Node:
             or request.profile
         ):
             return False
+        if request.knn is not None:
+            # kNN coalescing: unfiltered same-shape knn on a single-shard
+            # service (the coalesced kernel batches query vectors; a
+            # per-lane filter mask or a shard scatter keeps its solo
+            # path).
+            return (
+                request.knn.filter is None
+                and isinstance(svc.search, SearchService)
+                and max(0, request.size) > 0
+            )
         if max(0, request.from_) + max(0, request.size) <= 0:
             return False
         if body and body.get("suggest"):
@@ -3679,6 +3765,17 @@ class Node:
                     self.filter_cache.stats()
                     if self.filter_cache is not None
                     else FilterCache.disabled_stats()
+                ),
+            },
+            # ANN serving state (the `knn` section): resident IVF planes,
+            # build/eviction counters, per-backend search counts, probe
+            # totals, recall-gate outcomes. Present (inert) under
+            # ESTPU_ANN=0.
+            "search": {
+                "ann": (
+                    self.ann_cache.stats()
+                    if self.ann_cache is not None
+                    else AnnCache.disabled_stats()
                 ),
             },
             "breakers": {"hbm": self.breaker.stats()},

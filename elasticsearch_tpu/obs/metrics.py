@@ -387,6 +387,15 @@ CATALOG = {
     # Filter/bitset cache (index/filter_cache.py): device-resident mask
     # planes for repeated filter-context subtrees — the IndicesQueryCache
     # analog, surfaced under `_nodes/stats` indices.filter_cache.
+    "estpu_ann_builds_total": ("counter", "search.ann"),
+    "estpu_ann_evictions_total": ("counter", "search.ann"),
+    "estpu_ann_searches_total": ("counter", "search.ann"),
+    "estpu_ann_probes_total": ("counter", "search.ann"),
+    "estpu_ann_candidate_fraction": ("histogram", "search.ann"),
+    "estpu_ann_recall_gate_total": ("counter", "search.ann"),
+    "estpu_ann_bytes_resident": ("gauge", "search.ann"),
+    "estpu_ann_partitions_resident": ("gauge", "search.ann"),
+    "estpu_ann_centroids_resident": ("gauge", "search.ann"),
     "estpu_filter_cache_hits_total": ("counter", "indices.filter_cache"),
     "estpu_filter_cache_misses_total": ("counter", "indices.filter_cache"),
     "estpu_filter_cache_admissions_total": (

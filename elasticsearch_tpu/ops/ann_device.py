@@ -1,0 +1,302 @@
+"""Device kNN kernels: IVF probe + exact re-rank, and brute-force exact.
+
+The reference ships approximate kNN as a first-class search citizen (the
+ES 8.0 `knn` section / `_knn_search`, backed by Lucene HNSW —
+`server/.../index/mapping/vectors/`, `x-pack/plugin/vectors/`). A
+pointer-chasing graph is the wrong shape for a TPU; the right shape is
+IVF partitioning, which turns ANN into exactly the ops the MXU/VPU and
+the tile machinery are good at:
+
+    coarse scan   — q · centroids, one small dense pass over [C, d];
+    probe select  — lax.top_k over the C coarse scores → nprobe partitions;
+    gather        — the probed partitions' vector tiles, contiguous
+                    [nprobe, pmax, d] HBM reads (index/ann.py lays each
+                    partition out contiguously at build time);
+    exact re-rank — the full similarity expression over every gathered
+                    candidate, fp32;
+    top-k         — candidate scores scattered into a dense [N] plane,
+                    one masked lax.top_k (doc-id tie-break for free).
+
+**Parity law** (the contract tests/test_ann_ivf.py fuzzes): approximation
+lives ONLY in which candidates the probe reaches — never in how they are
+scored. The re-ranked score of every candidate is bit-exact fp32 equal to
+what the exact brute-force scorer assigns that same doc. Two choices make
+that hold by construction:
+
+- One scorer of record, `_scored_rows`: elementwise-multiply + per-row
+  `sum(axis=-1)` behind an `optimization_barrier` — NOT a matmul,
+  because a dot_general's accumulation grouping changes with the operand
+  shapes (measured: full-[N,d] vs gathered-[M,d] matmuls disagree in the
+  last bit on XLA:CPU), while a per-row reduction over d is independent
+  of how many rows ride the launch; the barrier keeps surrounding
+  gathers from fusing in and changing the codegen. This trades peak
+  matmul throughput for the parity law — the win over brute force comes
+  from scanning nprobe·pmax rows instead of N, not peak FLOPs.
+- The IVF top-k stays in candidate space with the exact kernel's
+  ordering: a per-partition `lax.top_k` whose lowest-index tie-break IS
+  ascending doc id (partitions are laid out doc-ascending), then a tiny
+  lexicographic (score desc, doc asc) merge of the survivors.
+
+Similarity functions mirror the reference's vector similarities
+(`DenseVectorFieldMapper.VectorSimilarity`): `cosine` scores
+(1 + cos) / 2, `dot_product` scores (1 + dot) / 2, and `l2_norm` scores
+1 / (1 + ||q − v||²) — all monotone in the underlying metric, so the
+coarse scan ranks centroids with the same expression.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = float("-inf")
+
+# The similarity names the dense_vector mapping accepts (reference:
+# DenseVectorFieldMapper.VectorSimilarity).
+METRICS = ("cosine", "dot_product", "l2_norm")
+
+
+def similarity_scores(xp, vectors, q, metric: str):
+    """ES vector-similarity scores of `q` against each row of `vectors` —
+    the REFERENCE formulation: the host oracle (xp=numpy; bench/test
+    recall checks) and the jitted coarse centroid scan use it. The
+    serving kernels score through `_scored_rows` instead, whose
+    fixed-tile layout carries the bit-exactness parity law; this plain
+    expression matches it to float rounding, not bit-for-bit.
+    """
+    if metric not in METRICS:
+        raise ValueError(f"unknown dense_vector similarity [{metric}]")
+    q = xp.asarray(q, dtype=xp.float32)
+    half = xp.float32(0.5)
+    one = xp.float32(1.0)
+    if metric == "l2_norm":
+        diff = vectors - q
+        d2 = xp.sum(diff * diff, axis=-1)
+        return (one / (one + d2)).astype(xp.float32)
+    dots = xp.sum(vectors * q, axis=-1)
+    if metric == "dot_product":
+        return ((one + dots) * half).astype(xp.float32)
+    vnorm = xp.sqrt(xp.sum(vectors * vectors, axis=-1))
+    qnorm = xp.sqrt(xp.sum(q * q))
+    denom = vnorm * qnorm
+    cos = xp.where(denom > 0, dots / denom, xp.float32(0.0))
+    return ((one + cos) * half).astype(xp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Exact brute force: the `knn` section's fallback for segments too small
+# to partition, and the scorer the parity law compares against.
+# ---------------------------------------------------------------------------
+
+
+def _scored_rows(vectors, q, metric: str):
+    """The exact scorer of record: barrier + the similarity expression.
+
+    The barrier pins a materialization boundary before the expression, so
+    XLA emits the same reduction codegen at EVERY call site — the
+    brute-force kernel, the IVF re-rank, and the standalone exact_scores
+    map (without it, fusing into surrounding gathers changes FMA
+    contraction and drifts the last bit — measured on XLA:CPU). The
+    parity law needs the kernels bit-identical per row, not merely close.
+
+    Deliberately elementwise-multiply + per-row sum, NOT a matmul: a
+    dot_general's accumulation grouping follows its operand shapes, so
+    full-[N,d] and gathered-[M,d] matmuls disagree in the last bit (also
+    measured; a fixed-tile-shape matmul restores bit-stability but costs
+    extra memory passes that measured SLOWER end-to-end on CPU at both
+    d=16 and d=100). Revisit on the real-TPU round where the MXU changes
+    the arithmetic-to-bandwidth ratio.
+    """
+    return similarity_scores(
+        jnp, jax.lax.optimization_barrier(vectors), q, metric
+    )
+
+
+@partial(jax.jit, static_argnames=("metric",))
+def exact_scores(vectors, q, metric: str):
+    """Per-doc exact similarity scores f32[N] — the reference values the
+    parity gates (tests, check_ann_smoke, bench cfg9) compare candidate
+    re-rank scores against, bit-for-bit."""
+    return _scored_rows(vectors, q, metric)
+
+
+def _exact_inner(vectors, live, q, k: int, metric: str, filter_mask):
+    scores = _scored_rows(vectors, q, metric)
+    eligible = live
+    if filter_mask is not None:
+        eligible = eligible & filter_mask
+    # Docs without a stored vector zero-fill their matrix row
+    # (index/segment.py flush); they must never enter a kNN hit set (the
+    # reference only considers docs with an indexed vector — a zero row
+    # would otherwise score 0.5 under cosine/dot). Ingest rejects
+    # zero-magnitude vectors for cosine/dot_product, so all-zero ⇒
+    # absent is exact there; an explicit l2_norm zero vector is also
+    # treated as absent (documented edge). Totals stay live ∧ filter —
+    # the request-shaped doc space — matching the IVF kernel, which
+    # cannot count vector presence without the O(N) pass it exists to
+    # avoid.
+    has_vec = jnp.any(vectors != 0, axis=-1)
+    masked = jnp.where(
+        eligible & has_vec, scores, jnp.float32(NEG_INF)
+    )
+    kk = min(k, masked.shape[0])
+    top_s, top_i = jax.lax.top_k(masked, kk)
+    total = jnp.sum(eligible, dtype=jnp.int32)
+    return top_s, top_i.astype(jnp.int32), total
+
+
+@partial(jax.jit, static_argnames=("metric", "k"))
+def knn_exact(vectors, live, q, k: int, metric: str, filter_mask=None):
+    """Exact top-k over the whole [N, d] plane (one masked dense pass).
+
+    Returns (scores f32[k], local ids i32[k], eligible-doc total i32[]).
+    Slots past the eligible count carry -inf scores (host trims).
+    """
+    return _exact_inner(vectors, live, q, k, metric, filter_mask)
+
+
+@partial(jax.jit, static_argnames=("metric", "k"))
+def knn_exact_batch(vectors, live, qs, k: int, metric: str):
+    """B query vectors against one plane, ONE launch ([B, k] results).
+
+    Lanes run via lax.map, not vmap: the parity barrier inside the inner
+    kernel has no batching rule, and an in-program map keeps each lane's
+    program — and therefore its bits — IDENTICAL to the solo kernel. The
+    batch win is amortized dispatch (one launch for B queries), which is
+    the coalescing gain the micro-batcher exists for.
+    """
+    return jax.lax.map(
+        lambda q: _exact_inner(vectors, live, q, k, metric, None), qs
+    )
+
+
+# ---------------------------------------------------------------------------
+# IVF probe + exact re-rank.
+#
+# ann tree (built by index/ann.py AnnPartitions.tree()):
+#   centroids    f32[C, d]   one row per partition (split clusters repeat
+#                            their centroid)
+#   part_vectors f32[C, pmax, d]  partition-contiguous vectors, zero rows
+#                            at padding slots
+#   part_docs    i32[C, pmax]     local doc id per slot, sentinel = N at
+#                            padding
+# ---------------------------------------------------------------------------
+
+
+def _ivf_inner(ann, live, q, k: int, nprobe: int, metric: str, filter_mask):
+    centroids = ann["centroids"]
+    part_vectors = ann["part_vectors"]
+    part_docs = ann["part_docs"]
+    num_docs = live.shape[0]
+    pmax = part_vectors.shape[1]
+    d = part_vectors.shape[-1]
+    coarse = similarity_scores(jnp, centroids, q, metric)  # [C]
+    kp = min(nprobe, coarse.shape[0])
+    _, probes = jax.lax.top_k(coarse, kp)  # [kp]
+    cand_v = part_vectors[probes].reshape(-1, d)  # [kp*pmax, d]
+    cand_d = part_docs[probes]  # [kp, pmax]
+    # The exact scorer of record — its barrier keeps this re-rank from
+    # fusing with the partition gather, so candidate scores stay
+    # bit-identical to the brute-force kernel's (the parity law).
+    scores = _scored_rows(cand_v, q, metric)
+    flat_d = cand_d.reshape(-1)
+    valid = flat_d < jnp.int32(num_docs)
+    safe = jnp.where(valid, flat_d, 0)
+    eligible = valid & live[safe]
+    if filter_mask is not None:
+        eligible = eligible & filter_mask[safe]
+    # Vector-less docs (zero matrix rows — see _exact_inner) need no
+    # check here: the build excludes them from doc_map entirely
+    # (index/ann.py), so no mapped slot can name one — a per-candidate
+    # presence pass measured ~2× on this path and buys nothing.
+    # Top-k stays in CANDIDATE space — a dense [N] scatter plane would
+    # hand the O(N) top-k cost right back to the query the probe just
+    # freed from O(N). Two exact stages:
+    #   1. per-partition top-k: slots within a partition are laid out in
+    #      ASCENDING doc order (index/ann.py regroups with a stable
+    #      argsort), so lax.top_k's lowest-index tie-break IS the
+    #      ascending-doc-id rule within each partition. A doc dropped
+    #      here ties >= k lower-doc partition-mates, so it can never
+    #      belong to the global top-k.
+    #   2. lexicographic merge of the kp*k survivors by (score desc,
+    #      doc asc) — tiny, and bit-identical to the exact kernel's
+    #      dense-plane ordering.
+    kk = min(k, num_docs)
+    kk_part = min(kk, pmax)
+    masked = jnp.where(eligible, scores, jnp.float32(NEG_INF)).reshape(
+        kp, pmax
+    )
+    part_s, part_pos = jax.lax.top_k(masked, kk_part)  # [kp, kk_part]
+    part_d = jnp.take_along_axis(cand_d, part_pos, axis=1)
+    flat_s = part_s.reshape(-1)
+    flat_docs = part_d.reshape(-1)
+    neg_sorted, doc_sorted, s_sorted = jax.lax.sort(
+        (-flat_s, flat_docs, flat_s), num_keys=2
+    )
+    kk = min(kk, flat_s.shape[0])
+    hit = neg_sorted[:kk] < jnp.float32(jnp.inf)
+    top_s = jnp.where(hit, s_sorted[:kk], jnp.float32(NEG_INF))
+    top_i = jnp.where(hit, doc_sorted[:kk], jnp.int32(0))
+    # Totals stay request-shaped (live ∧ filter over the WHOLE doc space),
+    # like every other query kind: the probe narrows candidates, never
+    # what "matched" means.
+    total_elig = live if filter_mask is None else live & filter_mask
+    total = jnp.sum(total_elig, dtype=jnp.int32)
+    n_candidates = jnp.sum(eligible, dtype=jnp.int32)
+    return top_s, top_i.astype(jnp.int32), total, n_candidates
+
+
+@partial(jax.jit, static_argnames=("metric", "k", "nprobe"))
+def ann_ivf_search(ann, live, q, k: int, nprobe: int, metric: str,
+                   filter_mask=None):
+    """One IVF query: coarse scan → nprobe partition gather → exact
+    re-rank → top-k. Returns (scores f32[k], local ids i32[k],
+    eligible-doc total i32[], candidates examined i32[])."""
+    return _ivf_inner(ann, live, q, k, nprobe, metric, filter_mask)
+
+
+@partial(jax.jit, static_argnames=("metric", "k", "nprobe"))
+def ann_ivf_search_batch(ann, live, qs, k: int, nprobe: int, metric: str):
+    """B query vectors through ONE IVF launch (the micro-batcher's
+    coalesced kNN path; every lane probes its own partitions). lax.map,
+    not vmap — see knn_exact_batch: lane programs stay bit-identical to
+    the solo kernel and the batch amortizes dispatch."""
+    return jax.lax.map(
+        lambda q: _ivf_inner(ann, live, q, k, nprobe, metric, None), qs
+    )
+
+
+# ---------------------------------------------------------------------------
+# Build-time assignment (Lloyd iterations run their heavy half on device;
+# index/ann.py drives the loop). Assignment has NO parity law — it only
+# decides candidate reachability — so it uses the fast matmul form.
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def assign_chunk(centroids, chunk):
+    """Nearest centroid (squared L2) per row of `chunk` → i32[M]."""
+    c2 = jnp.sum(centroids * centroids, axis=-1)
+    d2 = (
+        jnp.sum(chunk * chunk, axis=-1, keepdims=True)
+        - 2.0 * (chunk @ centroids.T)
+        + c2
+    )
+    return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+
+def assign_all(centroids, vectors, chunk_rows: int = 8192) -> np.ndarray:
+    """Nearest-centroid assignment for every vector, chunked so the
+    [M, C] distance plane stays small. `vectors` may be a device or host
+    array; returns host i32[N]."""
+    n = vectors.shape[0]
+    out = np.empty(n, dtype=np.int32)
+    for start in range(0, n, chunk_rows):
+        chunk = jnp.asarray(vectors[start : start + chunk_rows])
+        out[start : start + chunk_rows] = np.asarray(
+            assign_chunk(centroids, chunk)
+        )
+    return out

@@ -621,6 +621,10 @@ class MeshView:
 
         if request.rescore or request.profile:
             return "ineligible_shape"
+        if getattr(request, "knn", None) is not None:
+            # kNN serves through the host loop's ANN/exact kernels; the
+            # stacked-shard SPMD program has no vector planes yet.
+            return "knn"
         if request.after_doc >= 0:
             # Engine-global doc cursors (scroll internals) address the
             # host path's doc space, not the mesh's.

@@ -93,6 +93,29 @@ def _json(body: str) -> dict:
     return json.loads(body)
 
 
+def _knn_search_body(body: dict) -> dict:
+    """`_knn_search` request body → the equivalent `_search` body with a
+    top-level `knn` section. The endpoint's own keys are the knn object,
+    an optional top-level filter (folded into the section), and the
+    ordinary fetch/paging keys, which pass through."""
+    if "knn" not in body:
+        raise ApiError(
+            400, "parsing_exception", "[_knn_search] requires a [knn] body"
+        )
+    knn = dict(body["knn"]) if isinstance(body["knn"], dict) else body["knn"]
+    out: dict = {}
+    for key, value in body.items():
+        if key == "knn":
+            continue
+        if key == "filter":
+            if isinstance(knn, dict):
+                knn = {**knn, "filter": value}
+            continue
+        out[key] = value
+    out["knn"] = knn
+    return out
+
+
 def _timeout_param(q: dict) -> float | None:
     """?timeout=30s on write APIs: per-request replication retry budget."""
     if "timeout" not in q:
@@ -389,6 +412,13 @@ class RestServer:
             r(method, "/{index}/_count", lambda s, p, q, b: n.count(
                 p["index"], _json(b)
             ))
+            # The reference's 8.0 dedicated kNN endpoint (RestKnnSearch-
+            # Action, deprecated there in favor of the `knn` search
+            # section both endpoints share here): {"knn": {...}} plus the
+            # ordinary fetch keys; a top-level "filter" folds into the
+            # knn section (its 8.1+ home).
+            r(method, "/{index}/_knn_search", lambda s, p, q, b:
+                n.search(p["index"], _knn_search_body(_json(b))))
             r(method, "/{index}/_rank_eval", lambda s, p, q, b: rank_eval.evaluate(
                 n, p["index"], _json(b)
             ))

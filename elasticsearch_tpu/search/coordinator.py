@@ -110,22 +110,25 @@ class ShardedSearchCoordinator:
         planner=None,
         device=None,
         filter_cache=None,
+        ann_cache=None,
     ):
         self.engines = engines
         self.index_name = index_name
         # One exec.ExecPlanner shared by every shard service: plan-class
         # cost EWMAs and decision counters are node-scoped, so every
         # shard's observations calibrate the same model. The same goes
-        # for the obs.DeviceInstruments launch-site metrics and the
-        # node-wide filter cache (index/filter_cache.py) — shard engines
-        # key their mask planes into one HBM-budgeted store.
+        # for the obs.DeviceInstruments launch-site metrics, the
+        # node-wide filter cache (index/filter_cache.py), and the ANN
+        # partition cache (index/ann.py) — shard engines key their mask
+        # and IVF planes into one HBM-budgeted store each.
         self.planner = planner
         self.device = device
         self.filter_cache = filter_cache
+        self.ann_cache = ann_cache
         self.services = [
             SearchService(
                 e, index_name, planner=planner, device=device,
-                filter_cache=filter_cache,
+                filter_cache=filter_cache, ann_cache=ann_cache,
             )
             for e in engines
         ]
@@ -183,10 +186,14 @@ class ShardedSearchCoordinator:
         # the same reason: search_many already counted that request.
         from ..index.filter_cache import (
             record_filter_usage as _record_filter_usage,
+            record_knn_filter_usage as _record_knn_filter_usage,
         )
 
         fc_entries = _record_filter_usage(
             self.filter_cache, request.query, record=record_filter_usage
+        )
+        _record_knn_filter_usage(
+            self.filter_cache, request.knn, record=record_filter_usage
         )
         if self.mesh_view is not None:
             # The SPMD serving path: ONE shard_map program over the mesh —
@@ -213,6 +220,7 @@ class ShardedSearchCoordinator:
         snapshots = [list(e.segments) for e in self.engines]
         stats = self.global_stats(snapshots)
         self.services[0]._validate_sort(request)
+        self.services[0]._validate_knn(request)
         k = max(0, request.from_) + max(0, request.size)
 
         aggregations = None
@@ -259,6 +267,10 @@ class ShardedSearchCoordinator:
         if agg_total is not None:
             total = agg_total
 
+        if request.knn is not None:
+            # Global top-k reduce (the kNN coordinator contract): shards
+            # contribute up to k candidates each; the merge keeps k.
+            merged = merged[: request.knn.k]
         page = merged[request.from_ : request.from_ + request.size]
         page_hits = [hit for _, _, _, hit in page]
         self._apply_fetch_subphases(request, page_hits)
@@ -336,6 +348,19 @@ class ShardedSearchCoordinator:
         start = time.monotonic()
         if tasks is None:
             tasks = [None] * len(requests)
+        if any(r.knn is not None for r in requests):
+            # kNN groups coalesce only on single-shard services (the
+            # batcher's gate); a sharded rider serves through the full
+            # scatter/merge path, result-identical. Per-rider errors
+            # return as values (the batcher re-raises them per rider).
+            out: list = []
+            for r, t in zip(requests, tasks):
+                try:
+                    out.append(self.search(r, task=t))
+                # staticcheck: ignore[broad-except] batcher contract: per-rider results-or-exceptions, one rider's failure must not poison batchmates
+                except Exception as e:
+                    out.append(e)
+            return out
         n = len(requests)
         # One filter-cache admission sighting per rider (not per shard);
         # the collected entries thread through every shard's batched pass

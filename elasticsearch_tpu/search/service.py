@@ -208,6 +208,89 @@ class Rescore:
 
 
 @dataclass
+class KnnSpec:
+    """The top-level `knn` search section (the reference's ES 8.0 `knn`
+    option / `_knn_search` endpoint, SearchSourceBuilder.knnSearch).
+
+    Approximate BY CONTRACT: the engine may serve it from the IVF
+    partition planes (index/ann.py — only the `nprobe` probed partitions'
+    vectors are examined), so the hit SET may miss true neighbors the
+    probe never reached. Scoring is never approximate: every returned
+    candidate's score is bit-exact fp32 against the exact brute-force
+    scorer (the parity law ops/ann_device.py documents). Exact kNN stays
+    available through `script_score` — that path is byte-identical to its
+    pre-ANN behavior and keeps the routing-never-changes-top-k invariant.
+    """
+
+    field: str
+    query_vector: np.ndarray  # f32[d]
+    k: int = 10
+    num_candidates: int = 100
+    # IVF probe width (ours — the reference exposes num_candidates only).
+    # None = the index-side default (index/ann.default_nprobe), raised if
+    # needed so probed slots cover num_candidates.
+    nprobe: int | None = None
+    filter: Query | None = None
+
+    KNOWN_KEYS = frozenset(
+        {"field", "query_vector", "k", "num_candidates", "nprobe", "filter"}
+    )
+
+    @classmethod
+    def from_json(cls, body) -> "KnnSpec":
+        if not isinstance(body, dict):
+            raise ValueError("[knn] must be an object")
+        unknown = set(body) - cls.KNOWN_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown key [{sorted(unknown)[0]}] in the [knn] section"
+            )
+        if "field" not in body:
+            raise ValueError("[knn] requires a [field]")
+        if "query_vector" not in body:
+            raise ValueError("[knn] requires a [query_vector]")
+        raw = body["query_vector"]
+        if not isinstance(raw, list) or not raw or not all(
+            isinstance(v, (int, float)) and not isinstance(v, bool)
+            for v in raw
+        ):
+            raise ValueError(
+                "[knn] [query_vector] must be a non-empty array of numbers"
+            )
+        k = int(body.get("k", 10))
+        if k < 1:
+            raise ValueError(f"[knn] [k] must be greater than 0, got [{k}]")
+        num_candidates = int(body.get("num_candidates", max(100, k)))
+        if num_candidates < k:
+            raise ValueError(
+                f"[knn] [num_candidates] cannot be less than [k] "
+                f"([{num_candidates}] < [{k}])"
+            )
+        if num_candidates > 10_000:
+            raise ValueError(
+                "[knn] [num_candidates] cannot exceed [10000]"
+            )
+        nprobe = body.get("nprobe")
+        if nprobe is not None:
+            nprobe = int(nprobe)
+            if nprobe < 1:
+                raise ValueError(
+                    f"[knn] [nprobe] must be greater than 0, got [{nprobe}]"
+                )
+        filter_q = None
+        if body.get("filter") is not None:
+            filter_q = parse_query(body["filter"])
+        return cls(
+            field=str(body["field"]),
+            query_vector=np.asarray(raw, dtype=np.float32),
+            k=k,
+            num_candidates=num_candidates,
+            nprobe=nprobe,
+            filter=filter_q,
+        )
+
+
+@dataclass
 class SearchRequest:
     query: Query = field(default_factory=MatchAllQuery)
     size: int = 10
@@ -240,6 +323,8 @@ class SearchRequest:
     # `_shards.failed`/`failures[]`; false turns ANY shard failure into a
     # 503. Overridable per request via body key or URL param.
     allow_partial_search_results: bool = True
+    # Top-level `knn` section (approximate vector search; see KnnSpec).
+    knn: KnnSpec | None = None
 
     # The search-body keys this node understands; anything else is a
     # parsing error, like the reference's strict SearchSourceBuilder
@@ -253,8 +338,16 @@ class SearchRequest:
             "seq_no_primary_term", "explain", "pit", "track_scores",
             "terminate_after", "indices_boost", "script_fields",
             "rest_total_hits_as_int", "scroll_id", "scroll",
-            "allow_partial_search_results",
+            "allow_partial_search_results", "knn",
         }
+    )
+
+    # Search-body keys the `knn` section cannot ride with (each would
+    # need a score-combination or re-sort contract the ANN path doesn't
+    # define yet; the reference's 8.0 `_knn_search` was similarly pure).
+    KNN_EXCLUSIVE = (
+        "query", "aggs", "aggregations", "sort", "rescore",
+        "search_after", "suggest", "min_score",
     )
 
     @classmethod
@@ -265,6 +358,16 @@ class SearchRequest:
             raise ValueError(
                 f"unknown key [{sorted(unknown)[0]}] in the search request"
             )
+        knn = None
+        if body.get("knn") is not None:
+            for key in cls.KNN_EXCLUSIVE:
+                if body.get(key) is not None:
+                    raise ValueError(
+                        f"[knn] cannot be combined with [{key}] yet; the "
+                        f"knn section serves pure vector queries "
+                        f"(script_score remains the exact hybrid path)"
+                    )
+            knn = KnnSpec.from_json(body["knn"])
         query = (
             parse_query(body["query"]) if "query" in body else MatchAllQuery()
         )
@@ -319,6 +422,15 @@ class SearchRequest:
                     )
                 sort.append({fname: order})
                 sort_missing.append(missing)
+        if rescore and sort is not None:
+            # Reference behavior (SearchService.parseSource): rescore
+            # re-ranks the score-ordered top window; combined with an
+            # explicit sort — including [{"_score": "asc"}] — it has no
+            # defined semantics. This used to be silently IGNORED on the
+            # ascending-score host path; a clear 400 is the contract.
+            raise ValueError(
+                "Cannot use [sort] option in conjunction with [rescore]"
+            )
         source = body.get("_source", True)
         if isinstance(source, str):  # ES accepts a single field name/pattern
             source = [source]
@@ -392,6 +504,7 @@ class SearchRequest:
             fields=fields,
             profile=bool(body.get("profile", False)),
             allow_partial_search_results=bool(allow_partial),
+            knn=knn,
         )
 
 
@@ -499,6 +612,7 @@ class SearchService:
         planner=None,
         device=None,
         filter_cache=None,
+        ann_cache=None,
     ):
         self.engine = engine
         self.index_name = index_name
@@ -512,6 +626,10 @@ class SearchService:
         # repeated filter-context subtrees. None (default, and the
         # ESTPU_FILTER_CACHE=0 opt-out) recomputes every filter.
         self.filter_cache = filter_cache
+        # index.ann.AnnCache: IVF partition planes for the `knn` section.
+        # None (the ESTPU_ANN=0 opt-out) serves every knn exactly via the
+        # brute-force kernel.
+        self.ann_cache = ann_cache
 
     # --------------------------------------------------------- filter cache
 
@@ -589,9 +707,20 @@ class SearchService:
         if stats is None:
             stats = self.engine.field_stats()
         self._validate_sort(request)
+        self._validate_knn(request)
         if fc_entries is None:
             fc_entries = self._collect_filter_entries(
                 request.query, record_filter_usage
+            )
+        if request.knn is not None:
+            # One admission sighting for the knn filter per USER request —
+            # the same counting contract as bool filter clauses (the
+            # coordinator records once and passes record_filter_usage=
+            # False to its per-shard scatter).
+            from ..index.filter_cache import record_knn_filter_usage
+
+            record_knn_filter_usage(
+                self.filter_cache, request.knn, record=record_filter_usage
             )
 
         # One segment snapshot shared by the agg pass and the hits pass —
@@ -669,6 +798,12 @@ class SearchService:
         reduce_t0 = time.monotonic()
         with TRACER.span("search.reduce", task=task, candidates=len(candidates)):
             candidates.sort(key=lambda c: (c[0], c[1]))
+            if request.knn is not None:
+                # The knn contract returns the GLOBAL top k: segments
+                # each contribute up to k candidates, the merge keeps k
+                # (the reference's kNN coordinator reduce), and from/size
+                # page within those.
+                candidates = candidates[: request.knn.k]
             page = candidates[request.from_ : request.from_ + request.size]
 
             hits = []
@@ -775,6 +910,10 @@ class SearchService:
         start = time.monotonic()
         if tasks is None:
             tasks = [None] * len(requests)
+        if any(r.knn is not None for r in requests):
+            # Coalesced kNN group (the batcher's ("_knn", ...) group key):
+            # query vectors stack into one batched ANN/exact launch.
+            return self._knn_search_many(requests, tasks)
         stats = self.engine.field_stats()
         segments = list(self.engine.segments)
         ks = [max(0, r.from_) + max(0, r.size) for r in requests]
@@ -1194,6 +1333,30 @@ class SearchService:
                 "search_after with a multi-key sort is not supported yet"
             )
 
+    def _validate_knn(self, request: SearchRequest) -> None:
+        """Validate the knn section against the mappings up front (field
+        mapped as dense_vector, query_vector dims agree) so a malformed
+        request 400s before any segment pass runs."""
+        if request.knn is None:
+            return
+        knn = request.knn
+        fm = self.engine.mappings.get(knn.field)
+        if fm is None:
+            raise ValueError(
+                f"failed to find knn vector field [{knn.field}] in mapping"
+            )
+        if fm.type != "dense_vector":
+            raise ValueError(
+                f"[knn] field [{knn.field}] must be of type [dense_vector] "
+                f"but is [{fm.type}]"
+            )
+        if len(knn.query_vector) != fm.dims:
+            raise ValueError(
+                f"the query vector has a different number of dimensions "
+                f"[{len(knn.query_vector)}] than the document vectors "
+                f"[{fm.dims}]"
+            )
+
     # ------------------------------------------------------------------ query
 
     def _host_live(self, handle: SegmentHandle):
@@ -1256,6 +1419,305 @@ class SearchService:
         )
         return self.planner.decide(plan_class, candidates, feats), plan_class
 
+    # ------------------------------------------------------------------ knn
+
+    def _knn_filter_mask(self, handle, seg_tree, filter_query, stats):
+        """The knn filter as a device mask plane (bool[N]) — applied
+        PRE-rank inside the kernel, so filtered-out docs never consume a
+        candidate slot. Reuses the PR-9 filter cache when the filter is a
+        cacheable shape and has earned admission; otherwise computed
+        fresh (one dense filter pass, same as an uncached bool filter)."""
+        compiled = self.engine.compiler_for(handle, stats).compile(
+            filter_query
+        )
+
+        def build():
+            return bm25_device.compute_filter_mask(
+                seg_tree, compiled.spec, compiled.arrays
+            )
+
+        if self.filter_cache is None:
+            return build()
+        from ..query.compile import cacheable_filter_key
+
+        norm = cacheable_filter_key(filter_query)
+        if norm is None:
+            return build()
+        key = (self.engine.uid, 0, handle.uid, norm)
+        plane = self.filter_cache.get(key)
+        if plane is not None:
+            self.filter_cache.note_reuse(1)
+            TRACER.tag(filter_cache_hit=True)
+            return plane
+        plane = build()
+        if self.filter_cache.should_admit(norm):
+            self.filter_cache.put(
+                key, plane, int(plane.nbytes),
+                live_uids=frozenset(h.uid for h in self.engine.segments),
+            )
+        return plane
+
+    def _knn_plan(self, handle, knn):
+        """(partitions-or-None, nprobe, metric, plan_class, backend) for
+        one segment's knn pass. A segment with no partitions (too small,
+        cache disabled, or residency declined) serves the exact
+        brute-force kernel — the planner's `ann_ivf` backend exists only
+        where the IVF planes do. Routing between `ann_ivf` and the exact
+        `device` kernel is admissible here BECAUSE the knn section is
+        approximate by contract (exact answers satisfy it trivially);
+        `script_score` kNN never enters this path."""
+        metric = self.engine.mappings.get(knn.field).similarity
+        parts = None
+        if self.ann_cache is not None:
+            parts = self.ann_cache.get_or_build(
+                self.engine, handle, knn.field, metric
+            )
+        if parts is None:
+            return None, 0, metric, None, "device"
+        from ..index.ann import default_nprobe
+
+        nprobe = knn.nprobe or default_nprobe(parts.n_partitions)
+        # num_candidates is a floor on the candidates examined: widen the
+        # probe until the expected REAL vectors covered reach it
+        # (average partition fill = n_vectors / n_partitions; counting
+        # padded slots instead would under-probe small or skewed
+        # segments). num_candidates >= the corpus degenerates to a full
+        # probe.
+        nprobe = max(
+            nprobe,
+            -(-knn.num_candidates * parts.n_partitions // max(
+                1, parts.n_vectors
+            )),
+        )
+        nprobe = min(nprobe, parts.n_partitions)
+        backend = "ann_ivf"
+        plan_class = None
+        if self.planner is not None:
+            from ..exec.cost import PlanFeatures
+
+            spec = ("knn", knn.field, metric, parts.n_partitions, nprobe)
+            plan_class = self.planner.classify(spec, knn.k)
+            feats = PlanFeatures(
+                n_docs=handle.segment.num_docs,
+                n_candidates=parts.n_partitions + nprobe * parts.pmax,
+            )
+            backend = self.planner.decide(
+                plan_class, ["ann_ivf", "device"], feats
+            )
+        return parts, nprobe, metric, plan_class, backend
+
+    def _query_segment_knn(
+        self,
+        handle: SegmentHandle,
+        request: SearchRequest,
+        stats: dict[str, FieldStats],
+        candidates: list,
+        timings: dict | None = None,
+    ) -> tuple[int, str]:
+        """One segment's knn pass: IVF probe + exact re-rank when the
+        segment has partition planes, exact brute force otherwise.
+        Appends up to knn.k candidates (the per-segment candidate count
+        the reference's per-shard kNN contract uses); pagination happens
+        at the shared reduce."""
+        from ..ops import ann_device
+
+        fault_point("search.kernel", index=self.index_name)
+        knn = request.knn
+        plan_t0 = time.monotonic()
+        dev = handle.device
+        vectors = dev.vectors.get(knn.field)
+        if vectors is None:
+            return 0, "device"  # mapped field, no vectors in this segment
+        seg_tree = bm25_device.segment_tree(dev)
+        fmask = None
+        if knn.filter is not None:
+            fmask = self._knn_filter_mask(
+                handle, seg_tree, knn.filter, stats
+            )
+        parts, nprobe, metric, plan_class, backend = self._knn_plan(
+            handle, knn
+        )
+        now = time.monotonic()
+        if timings is not None:
+            timings["plan_s"] += now - plan_t0
+        exec_t0 = now
+        if self.device is not None:
+            self.device.h2d(knn.query_vector)
+        if backend == "ann_ivf":
+            scores, ids, tot, n_cand = ann_device.ann_ivf_search(
+                parts.tree(), dev.live, knn.query_vector, knn.k, nprobe,
+                metric, filter_mask=fmask,
+            )
+        else:
+            scores, ids, tot = ann_device.knn_exact(
+                vectors, dev.live, knn.query_vector, knn.k, metric,
+                filter_mask=fmask,
+            )
+            n_cand = tot
+        scores, ids = np.asarray(scores), np.asarray(ids)
+        tot, n_cand = int(tot), int(n_cand)
+        # Trim to REAL hits: totals count the eligible doc space, but
+        # vector-less docs can't be scored, so the hit count is the
+        # finite-score prefix (both kernels fill unserved slots -inf).
+        n_cand = min(n_cand, int(np.sum(scores > np.float32(bm25_device.NEG_INF))))
+        elapsed = time.monotonic() - exec_t0
+        if timings is not None:
+            timings["exec_s"] += elapsed
+        if self.planner is not None:
+            if plan_class is not None:
+                self.planner.record(plan_class, backend, elapsed)
+            else:
+                self.planner.note(backend)
+        if self.device is not None:
+            self.device.launch(
+                "knn", (knn.field, metric, knn.k, backend), elapsed
+            )
+        if self.ann_cache is not None:
+            self.ann_cache.note_search(
+                backend,
+                nprobe=nprobe if backend == "ann_ivf" else 0,
+                candidate_fraction=(
+                    n_cand / max(1, handle.segment.num_docs)
+                ),
+            )
+        n = min(knn.k, n_cand, len(ids))
+        self._append_plain(candidates, handle, scores, ids, n)
+        return tot, backend
+
+    def _knn_search_many(self, requests: list, tasks: list) -> list:
+        """Coalesced knn serving: the micro-batcher groups knn requests
+        by (field, k, num_candidates, nprobe, no filter), so every rider
+        here shares one kernel shape — their query vectors stack into ONE
+        batched launch per segment. Results are identical to solo
+        execution (the batched kernel vmaps the same program)."""
+        from ..common.tasks import TaskCancelledError
+        from ..ops import ann_device
+
+        start = time.monotonic()
+        n = len(requests)
+        stats = self.engine.field_stats()
+        segments = list(self.engine.segments)
+        cands: list[list] = [[] for _ in range(n)]
+        totals = [0] * n
+        timed = [False] * n
+        errors: list[Exception | None] = [None] * n
+        for i, r in enumerate(requests):
+            try:
+                self._validate_knn(r)
+            except ValueError as e:
+                errors[i] = e
+        knn0 = next(
+            (requests[i].knn for i in range(n) if errors[i] is None), None
+        )
+        uniform = all(
+            errors[i] is not None
+            or (
+                (kn := requests[i].knn) is not None
+                and kn.filter is None
+                and (kn.field, kn.k, kn.num_candidates, kn.nprobe)
+                == (knn0.field, knn0.k, knn0.num_candidates, knn0.nprobe)
+            )
+            for i in range(n)
+        )
+        if knn0 is None or not uniform:
+            # Defensive: a mixed group (the batcher's group key should
+            # prevent it) serves each rider solo, result-identical.
+            return [
+                errors[i]
+                if errors[i] is not None
+                else self.search(requests[i], task=tasks[i])
+                for i in range(n)
+            ]
+        for handle in segments:
+            alive = [i for i in range(n) if errors[i] is None]
+            for i in list(alive):
+                task = tasks[i]
+                if task is None:
+                    continue
+                if task.cancelled:
+                    reason = task.cancel_reason or "cancelled"
+                    errors[i] = TaskCancelledError(
+                        f"task cancelled [{reason}]"
+                    )
+                    alive.remove(i)
+                elif task.check_deadline():
+                    timed[i] = True
+                    alive.remove(i)
+            if not alive:
+                break
+            dev = handle.device
+            vectors = dev.vectors.get(knn0.field)
+            if vectors is None or handle.segment.num_docs == 0:
+                continue
+            fault_point("search.kernel", index=self.index_name)
+            parts, nprobe, metric, plan_class, backend = self._knn_plan(
+                handle, knn0
+            )
+            qs = np.stack(
+                [requests[i].knn.query_vector for i in alive]
+            )
+            t0 = time.monotonic()
+            if backend == "ann_ivf":
+                s_b, i_b, t_b, nc_b = ann_device.ann_ivf_search_batch(
+                    parts.tree(), dev.live, qs, knn0.k, nprobe, metric
+                )
+            else:
+                s_b, i_b, t_b = ann_device.knn_exact_batch(
+                    vectors, dev.live, qs, knn0.k, metric
+                )
+                nc_b = t_b
+            s_b, i_b = np.asarray(s_b), np.asarray(i_b)
+            t_b, nc_b = np.asarray(t_b), np.asarray(nc_b)
+            # Real hits per lane = the finite-score prefix (totals count
+            # the eligible doc space; vector-less docs can't be scored).
+            finite_b = np.sum(
+                s_b > np.float32(bm25_device.NEG_INF), axis=1
+            )
+            elapsed = time.monotonic() - t0
+            if self.device is not None:
+                self.device.launch(
+                    "knn_batched",
+                    (knn0.field, metric, knn0.k, backend, len(alive)),
+                    elapsed,
+                )
+            for row, i in enumerate(alive):
+                tot = int(t_b[row])
+                nn = min(
+                    knn0.k, int(nc_b[row]), int(finite_b[row]),
+                    i_b.shape[1],
+                )
+                self._append_plain(cands[i], handle, s_b[row], i_b[row], nn)
+                totals[i] += tot
+                if self.planner is not None and plan_class is not None:
+                    self.planner.record(
+                        plan_class, backend, elapsed / len(alive)
+                    )
+                if self.ann_cache is not None:
+                    self.ann_cache.note_search(
+                        backend,
+                        nprobe=nprobe if backend == "ann_ivf" else 0,
+                        candidate_fraction=(
+                            int(nc_b[row])
+                            / max(1, handle.segment.num_docs)
+                        ),
+                    )
+        out: list = []
+        for i, request in enumerate(requests):
+            if errors[i] is not None:
+                out.append(errors[i])
+                continue
+            rows = sorted(cands[i], key=lambda c: (c[0], c[1]))
+            out.append(
+                self.assemble_plain(
+                    request,
+                    rows[: request.knn.k],  # global top-k, then page
+                    totals[i],
+                    timed[i],
+                    start,
+                )
+            )
+        return out
+
     def _query_segment(
         self,
         handle: SegmentHandle,
@@ -1268,6 +1730,10 @@ class SearchService:
     ) -> tuple[int, str]:
         """Score one segment, appending candidate tuples. Returns
         (total hits, execution backend used)."""
+        if request.knn is not None:
+            return self._query_segment_knn(
+                handle, request, stats, candidates, timings=timings
+            )
         # Injectable device-launch failure / slow-segment delay
         # (faults/registry.py `search.kernel`).
         fault_point("search.kernel", index=self.index_name)

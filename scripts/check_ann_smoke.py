@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""CI smoke gate for IVF-partitioned ANN (ISSUE 10).
+
+Runs the ANN suite on the CPU backend — no TPU needed: the candidate-set
+re-rank bit-exactness law (every returned score fp32-equal to the exact
+brute-force scorer on the same doc), recall@10 >= 0.95 at the default
+nprobe on seeded clustered corpora, filtered-knn pre-rank semantics,
+refresh/merge invalidation, brute-force fallback for unpartitionable
+segments, the dense_vector ingest 400 contracts, and the script_score
+exact path's byte-identity. The same tests ride the tier-1 run via the
+fast (`not slow`) marker; this script is the standalone hook for
+pre-merge / cron checks:
+
+    python scripts/check_ann_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "tests/test_ann_ivf.py",
+        "-q",
+        "-m",
+        "not slow",
+        "-p",
+        "no:cacheprovider",
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

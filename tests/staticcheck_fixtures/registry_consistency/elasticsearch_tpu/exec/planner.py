@@ -1,9 +1,12 @@
 """Fixture planner: [ghost] has no cost seed and no surfacing site;
-[packed], [mesh_spmd] and [cached_mask] are surfaced (user.py) but
-UNSEEDED — the multi-tenant backend, the SPMD mesh plan class, and the
-filter-cache masked-execution backend registered without an
-exec/cost.py seed must each fail the gate."""
+[packed], [mesh_spmd], [cached_mask] and [ann_ivf] are surfaced
+(user.py) but UNSEEDED — the multi-tenant backend, the SPMD mesh plan
+class, the filter-cache masked-execution backend, and the IVF ANN
+backend registered without an exec/cost.py seed must each fail the
+gate."""
 
 
 class ExecPlanner:
-    BACKENDS = ("device", "ghost", "packed", "mesh_spmd", "cached_mask")
+    BACKENDS = (
+        "device", "ghost", "packed", "mesh_spmd", "cached_mask", "ann_ivf",
+    )

@@ -40,6 +40,16 @@ def route_cached_mask():
     return "cached_mask"
 
 
+def route_ann():
+    # Surfacing site for the IVF ANN backend: an unseeded ann_ivf
+    # registration must fail exactly like packed.
+    return "ann_ivf"
+
+
+def make_ann_instruments(m):
+    m.counter("estpu_ann_rogue_total", "ANN instrument not in CATALOG")
+
+
 def make_filter_cache_instruments(m):
     m.counter(
         "estpu_filter_cache_rogue_total",

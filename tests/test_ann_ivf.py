@@ -1,0 +1,935 @@
+"""IVF-partitioned ANN: build invariants, the bit-exact re-rank parity
+law, recall gates, filtered knn, invalidation, serving-path wiring, and
+the dense_vector ingest/validation satellites (ISSUE 10)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from elasticsearch_tpu.index.ann import (
+    AnnCache,
+    build_partitions,
+    default_nprobe,
+)
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.node import ApiError, Node
+from elasticsearch_tpu.ops import ann_device
+from elasticsearch_tpu.search.service import SearchRequest, SearchService
+
+METRICS = ("cosine", "dot_product", "l2_norm")
+
+
+def clustered(rng, n, d, n_centers=24, spread=3.0):
+    """A mixture-of-gaussians corpus — the natural ANN workload shape
+    (recall gates run on clustered data; pure-noise vectors have no
+    structure for ANY approximate index to exploit)."""
+    centers = rng.standard_normal((n_centers, d)).astype(np.float32) * spread
+    assign = rng.integers(0, n_centers, n)
+    return (
+        centers[assign] + rng.standard_normal((n, d)).astype(np.float32)
+    ).astype(np.float32), centers
+
+
+def exact_top(dev_vectors, live, q, k, metric, mask=None):
+    s, i, t = ann_device.knn_exact(dev_vectors, live, q, k, metric, mask)
+    s, i = np.asarray(s), np.asarray(i)
+    n = min(k, int(t))
+    return s[:n], i[:n]
+
+
+# --------------------------------------------------------------- kernels
+
+
+class TestKernelParity:
+    def test_rerank_bit_exact_fuzz(self):
+        """The parity law: every candidate the IVF path returns carries a
+        score BIT-EQUAL (fp32) to the exact brute-force kernel's score
+        for that same doc — approximation may only choose candidates,
+        never change scoring."""
+        for metric in METRICS:
+            for seed, n, d in ((1, 6000, 16), (2, 3000, 33), (3, 9000, 8)):
+                rng = np.random.default_rng(seed)
+                vecs, centers = clustered(rng, n, d)
+                if metric == "dot_product":
+                    vecs = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+                dev = jnp.asarray(vecs)
+                parts = build_partitions(
+                    "vec", vecs, dev, num_docs=n, metric=metric
+                )
+                live = jnp.ones(n, bool)
+                nprobe = default_nprobe(parts.n_partitions)
+                for qi in range(8):
+                    q = (
+                        centers[qi % len(centers)]
+                        + rng.standard_normal(d).astype(np.float32)
+                    ).astype(np.float32)
+                    if metric == "dot_product":
+                        q = q / np.linalg.norm(q)
+                    s, ids, _t, _nc = ann_device.ann_ivf_search(
+                        parts.tree(), live, q, 10, nprobe, metric
+                    )
+                    s, ids = np.asarray(s), np.asarray(ids)
+                    exact_all = np.asarray(
+                        ann_device.knn_exact(dev, live, q, n, metric)[0]
+                    )
+                    # knn_exact returns scores ranked; rebuild per-doc map
+                    exact_ids = np.asarray(
+                        ann_device.knn_exact(dev, live, q, n, metric)[1]
+                    )
+                    by_doc = dict(
+                        zip(exact_ids.tolist(), exact_all.tolist())
+                    )
+                    for doc, score in zip(ids, s):
+                        assert np.float32(score) == np.float32(
+                            by_doc[int(doc)]
+                        ), (metric, seed, int(doc))
+
+    def test_full_probe_equals_exact(self):
+        """nprobe == n_partitions reaches every candidate, so the IVF
+        result must be IDENTICAL to brute force — ids, order (incl. the
+        ascending-doc-id tie-break; the corpus repeats vectors to force
+        ties), scores, totals."""
+        rng = np.random.default_rng(5)
+        n, d = 4000, 12
+        base, _ = clustered(rng, n // 4, d)
+        vecs = np.tile(base, (4, 1))  # every vector 4x -> guaranteed ties
+        dev = jnp.asarray(vecs)
+        live = jnp.ones(n, bool)
+        for metric in METRICS:
+            parts = build_partitions(
+                "vec", vecs, dev, num_docs=n, metric=metric
+            )
+            for qi in range(6):
+                q = vecs[rng.integers(0, n)] + 0.01 * rng.standard_normal(
+                    d
+                ).astype(np.float32)
+                s, ids, tot, _nc = ann_device.ann_ivf_search(
+                    parts.tree(), live, q, 20, parts.n_partitions, metric
+                )
+                es, ei = exact_top(dev, live, q, 20, metric)
+                np.testing.assert_array_equal(np.asarray(ids)[: len(ei)], ei)
+                np.testing.assert_array_equal(np.asarray(s)[: len(es)], es)
+                assert int(tot) == n
+
+    def test_recall_gate_default_nprobe(self):
+        """recall@10 >= 0.95 at the DEFAULT nprobe on seeded clustered
+        corpora — the fuzz gate the bench's cfg9 mirrors at scale."""
+        hits = total = 0
+        for seed in (11, 12, 13):
+            rng = np.random.default_rng(seed)
+            n, d = 8000, 24
+            vecs, centers = clustered(rng, n, d)
+            dev = jnp.asarray(vecs)
+            parts = build_partitions(
+                "vec", vecs, dev, num_docs=n, metric="cosine"
+            )
+            live = jnp.ones(n, bool)
+            nprobe = default_nprobe(parts.n_partitions)
+            for qi in range(16):
+                q = (
+                    centers[qi % len(centers)]
+                    + rng.standard_normal(d).astype(np.float32)
+                ).astype(np.float32)
+                _s, ids, _t, _nc = ann_device.ann_ivf_search(
+                    parts.tree(), live, q, 10, nprobe, "cosine"
+                )
+                _es, ei = exact_top(dev, live, q, 10, "cosine")
+                hits += len(set(np.asarray(ids).tolist()) & set(ei.tolist()))
+                total += len(ei)
+        assert hits / total >= 0.95, f"recall@10 {hits / total:.3f}"
+
+    def test_partition_layout_covers_every_doc_once(self):
+        rng = np.random.default_rng(9)
+        n, d = 5000, 10
+        vecs, _ = clustered(rng, n, d)
+        parts = build_partitions(
+            "vec", vecs, jnp.asarray(vecs), num_docs=n, metric="l2_norm"
+        )
+        doc_map = np.asarray(parts.part_docs)
+        real = doc_map[doc_map < n]
+        assert sorted(real.tolist()) == list(range(n))
+        # split clusters: every partition fits the uniform pmax, and the
+        # padded layout stays bounded (the anti-skew guarantee).
+        assert doc_map.shape[1] == parts.pmax
+        assert doc_map.size <= 3 * n + parts.n_partitions * 0  # bounded
+        # padding slots gather zero vectors, never another doc's.
+        pv = np.asarray(parts.part_vectors)
+        pad_rows = pv.reshape(-1, d)[(doc_map == n).reshape(-1)]
+        assert not pad_rows.any()
+
+    def test_batched_kernel_matches_solo(self):
+        rng = np.random.default_rng(21)
+        n, d = 6000, 16
+        vecs, centers = clustered(rng, n, d)
+        dev = jnp.asarray(vecs)
+        live = jnp.ones(n, bool)
+        parts = build_partitions(
+            "vec", vecs, dev, num_docs=n, metric="cosine"
+        )
+        qs = np.stack(
+            [
+                (centers[i % len(centers)] + rng.standard_normal(d)).astype(
+                    np.float32
+                )
+                for i in range(5)
+            ]
+        )
+        s_b, i_b, t_b, nc_b = ann_device.ann_ivf_search_batch(
+            parts.tree(), live, qs, 10, 6, "cosine"
+        )
+        for row in range(len(qs)):
+            s, i, t, nc = ann_device.ann_ivf_search(
+                parts.tree(), live, qs[row], 10, 6, "cosine"
+            )
+            np.testing.assert_array_equal(np.asarray(s_b)[row], np.asarray(s))
+            np.testing.assert_array_equal(np.asarray(i_b)[row], np.asarray(i))
+            assert int(np.asarray(t_b)[row]) == int(t)
+            assert int(np.asarray(nc_b)[row]) == int(nc)
+
+
+# ------------------------------------------------------------ service path
+
+
+def vector_engine(n=1500, d=8, seed=4, extra_fields=False, n_centers=16):
+    rng = np.random.default_rng(seed)
+    props = {"vec": {"type": "dense_vector", "dims": d}}
+    if extra_fields:
+        props["tag"] = {"type": "keyword"}
+        props["rank"] = {"type": "long"}
+    engine = Engine(Mappings(properties=props))
+    vecs, centers = clustered(rng, n, d, n_centers=n_centers)
+    for i in range(n):
+        doc = {"vec": vecs[i].tolist()}
+        if extra_fields:
+            doc["tag"] = "odd" if i % 2 else "even"
+            doc["rank"] = i
+        engine.index(doc, f"d{i}")
+    engine.refresh()
+    return engine, vecs, centers, rng
+
+
+def knn_body(q, k=10, **kw):
+    return {"knn": {"field": "vec", "query_vector": list(map(float, q)), "k": k, **kw}}
+
+
+class TestServicePath:
+    def test_ivf_engaged_and_scores_exact(self):
+        engine, vecs, centers, rng = vector_engine()
+        cache = AnnCache(min_docs=256)
+        svc = SearchService(engine, "v", ann_cache=cache)
+        q = (centers[0] + rng.standard_normal(8)).astype(np.float32)
+        resp = svc.search(SearchRequest.from_json(knn_body(q, k=5)))
+        assert len(resp.hits) == 5
+        assert cache.stats()["builds"] == 1
+        assert cache.stats()["searches"].get("ann_ivf", 0) >= 1
+        dev = engine.segments[0].device.vectors["vec"]
+        live = engine.segments[0].device.live
+        es, ei = exact_top(dev, live, q, 1500, "cosine")
+        by_doc = {f"d{int(doc)}": s for doc, s in zip(ei, es)}
+        for h in resp.hits:
+            assert np.float32(h.score) == np.float32(by_doc[h.doc_id])
+        # recall vs exact top-5, recorded through the stats gate counter
+        top5 = {f"d{int(doc)}" for doc in ei[:5]}
+        recall = len({h.doc_id for h in resp.hits} & top5) / 5
+        cache.note_recall_gate(recall >= 0.95)
+        assert recall >= 0.95
+        assert cache.stats()["recall_gate"] == {"pass": 1}
+
+    def test_small_segment_falls_back_to_exact(self):
+        engine, vecs, _centers, rng = vector_engine(n=300)
+        cache = AnnCache(min_docs=4096)
+        svc = SearchService(engine, "v", ann_cache=cache)
+        q = rng.standard_normal(8).astype(np.float32)
+        resp = svc.search(SearchRequest.from_json(knn_body(q, k=3)))
+        assert cache.stats()["builds"] == 0
+        assert cache.stats()["searches"] == {"device": 1}
+        dev = engine.segments[0].device.vectors["vec"]
+        es, ei = exact_top(dev, engine.segments[0].device.live, q, 3, "cosine")
+        assert [h.doc_id for h in resp.hits] == [f"d{int(i)}" for i in ei]
+        np.testing.assert_array_equal(
+            np.asarray([h.score for h in resp.hits], np.float32), es
+        )
+
+    def test_filtered_knn_pre_rank_not_post_trim(self):
+        """The filter applies BEFORE candidate ranking: k hits return
+        even when the unfiltered top-k is entirely outside the filter (a
+        post-trim would come back short)."""
+        engine, vecs, centers, rng = vector_engine(extra_fields=True)
+        cache = AnnCache(min_docs=256)
+        svc = SearchService(engine, "v", ann_cache=cache)
+        q = (centers[1] + 0.1 * rng.standard_normal(8)).astype(np.float32)
+        resp = svc.search(
+            SearchRequest.from_json(
+                knn_body(q, k=8, filter={"term": {"tag": "odd"}})
+            )
+        )
+        assert len(resp.hits) == 8
+        assert all(int(h.doc_id[1:]) % 2 == 1 for h in resp.hits)
+        # parity: full probe == exact filtered top-k (ids AND scores)
+        resp_full = svc.search(
+            SearchRequest.from_json(
+                knn_body(
+                    q, k=8, nprobe=4096, filter={"term": {"tag": "odd"}}
+                )
+            )
+        )
+        dev = engine.segments[0].device.vectors["vec"]
+        live = engine.segments[0].device.live
+        mask = jnp.asarray(
+            np.array([i % 2 == 1 for i in range(len(vecs))])
+        )
+        es, ei = exact_top(dev, live, q, 8, "cosine", mask=mask)
+        assert [h.doc_id for h in resp_full.hits] == [
+            f"d{int(i)}" for i in ei
+        ]
+        np.testing.assert_array_equal(
+            np.asarray([h.score for h in resp_full.hits], np.float32), es
+        )
+        # totals count the FILTERED eligible set, not the probe
+        assert resp_full.total == len(vecs) // 2
+
+    def test_refresh_new_segment_builds_merge_invalidates(self):
+        engine, vecs, centers, rng = vector_engine(n=800)
+        cache = AnnCache(min_docs=256)
+        svc = SearchService(engine, "v", ann_cache=cache)
+        q = (centers[0] + rng.standard_normal(8)).astype(np.float32)
+        svc.search(SearchRequest.from_json(knn_body(q)))
+        assert cache.stats()["builds"] == 1
+        # A second segment arrives: its OWN partitions build; the first
+        # segment's plane keeps serving (no rebuild for it).
+        for i in range(800, 1400):
+            engine.index(
+                {"vec": (centers[i % 8] + rng.standard_normal(8)).tolist()},
+                f"d{i}",
+            )
+        engine.refresh()
+        svc.search(SearchRequest.from_json(knn_body(q)))
+        assert cache.stats()["builds"] == 2
+        assert cache.stats()["planes"] == 2
+        uids_before = {k[1] for k in cache._entries}
+        # Force a merge: merged-away handles mint fresh uids, their
+        # planes are pruned on the next store, results stay correct.
+        engine.force_merge(max_num_segments=1)
+        svc.search(SearchRequest.from_json(knn_body(q)))
+        uids_after = {k[1] for k in cache._entries}
+        assert not (uids_before & uids_after)
+        assert cache.stats()["planes"] == 1  # one merged segment
+        resp = svc.search(SearchRequest.from_json(knn_body(q, k=5)))
+        dev = engine.segments[0].device.vectors["vec"]
+        es, ei = exact_top(
+            dev, engine.segments[0].device.live, q, 1400, "cosine"
+        )
+        by_doc = {int(doc): s for doc, s in zip(ei, es)}
+        for h in resp.hits:
+            local = engine.segments[0].id_index[h.doc_id]
+            assert np.float32(h.score) == np.float32(by_doc[local])
+
+    def test_docs_without_vectors_never_surface(self):
+        """A doc that omits the dense_vector field zero-fills its matrix
+        row; it must never enter a kNN hit set (the reference only
+        considers docs with an indexed vector — a zero row would score
+        0.5 under cosine)."""
+        rng = np.random.default_rng(14)
+        engine = Engine(
+            Mappings(
+                properties={
+                    "vec": {"type": "dense_vector", "dims": 6},
+                    "title": {"type": "text"},
+                }
+            )
+        )
+        for i in range(40):
+            doc = {"title": f"doc {i}"}
+            if i % 3:  # a third of the docs carry NO vector
+                doc["vec"] = (
+                    rng.standard_normal(6) - 5.0  # negative cosine to q
+                ).tolist()
+            engine.index(doc, f"d{i}")
+        engine.refresh()
+        svc = SearchService(engine, "v", ann_cache=AnnCache(min_docs=8))
+        q = np.full(6, 5.0, dtype=np.float32)
+        body = knn_body(q, k=40)
+        body["size"] = 40  # page size defaults to 10; expose all k hits
+        resp = svc.search(SearchRequest.from_json(body))
+        returned = {h.doc_id for h in resp.hits}
+        vectorless = {f"d{i}" for i in range(40) if i % 3 == 0}
+        assert not (returned & vectorless)
+        assert len(resp.hits) == 40 - len(vectorless)
+        # The exact brute-force path must agree (forced via a min_docs
+        # the segment can't reach): same doc set, no -inf filler hits.
+        exact_svc = SearchService(
+            engine, "v", ann_cache=AnnCache(min_docs=1 << 20)
+        )
+        resp2 = exact_svc.search(SearchRequest.from_json(body))
+        assert {h.doc_id for h in resp2.hits} == returned
+        assert all(np.isfinite(h.score) for h in resp2.hits)
+
+    def test_zero_vector_rejected_for_cosine_and_dot(self):
+        for sim in ("cosine", "dot_product"):
+            engine = Engine(
+                Mappings(
+                    properties={
+                        "vec": {
+                            "type": "dense_vector",
+                            "dims": 3,
+                            "similarity": sim,
+                        }
+                    }
+                )
+            )
+            with pytest.raises(ValueError, match="zero magnitude"):
+                engine.index({"vec": [0.0, 0.0, 0.0]}, "a")
+        # l2_norm accepts it (distance from a zero point is well-defined)
+        engine = Engine(
+            Mappings(
+                properties={
+                    "vec": {
+                        "type": "dense_vector",
+                        "dims": 3,
+                        "similarity": "l2_norm",
+                    }
+                }
+            )
+        )
+        engine.index({"vec": [0.0, 0.0, 0.0]}, "a")
+
+    def test_dense_vector_mapping_params_immutable(self):
+        node = Node()
+        try:
+            node.create_index(
+                "v",
+                {
+                    "mappings": {
+                        "properties": {
+                            "vec": {"type": "dense_vector", "dims": 4}
+                        }
+                    }
+                },
+            )
+            for bad in (
+                {"type": "dense_vector", "dims": 8},
+                {"type": "dense_vector", "dims": 4, "similarity": "l2_norm"},
+            ):
+                with pytest.raises(ApiError) as err:
+                    node.put_mapping("v", {"properties": {"vec": bad}})
+                assert err.value.status == 400
+                assert "Cannot update parameter" in str(err.value)
+        finally:
+            node.close()
+
+    def test_deleted_docs_never_surface(self):
+        engine, vecs, centers, rng = vector_engine(n=900)
+        cache = AnnCache(min_docs=256)
+        svc = SearchService(engine, "v", ann_cache=cache)
+        q = (centers[2] + 0.05 * rng.standard_normal(8)).astype(np.float32)
+        first = svc.search(SearchRequest.from_json(knn_body(q, k=3)))
+        victim = first.hits[0].doc_id
+        engine.delete(victim)
+        engine.refresh()  # deletes become searchable-visible on refresh
+        resp = svc.search(SearchRequest.from_json(knn_body(q, k=3)))
+        assert victim not in {h.doc_id for h in resp.hits}
+
+    def test_search_many_matches_solo(self):
+        engine, vecs, centers, rng = vector_engine()
+        cache = AnnCache(min_docs=256)
+        svc = SearchService(engine, "v", ann_cache=cache)
+        reqs = [
+            SearchRequest.from_json(
+                knn_body(
+                    (centers[i] + rng.standard_normal(8)).astype(np.float32),
+                    k=6,
+                )
+            )
+            for i in range(4)
+        ]
+        batched = svc.search_many(list(reqs))
+        for req, got in zip(reqs, batched):
+            solo = svc.search(req)
+            assert [h.doc_id for h in got.hits] == [
+                h.doc_id for h in solo.hits
+            ]
+            np.testing.assert_array_equal(
+                np.asarray([h.score for h in got.hits], np.float32),
+                np.asarray([h.score for h in solo.hits], np.float32),
+            )
+            assert got.total == solo.total
+
+
+# --------------------------------------------------------------- node path
+
+
+class TestNodePath:
+    def bulk_vectors(self, n, node, index, rng, d=8, centers=None):
+        lines = []
+        for i in range(n):
+            base = centers[i % len(centers)] if centers is not None else 0.0
+            lines.append(json.dumps({"index": {"_id": str(i)}}))
+            lines.append(
+                json.dumps(
+                    {"vec": (base + rng.standard_normal(d)).tolist()}
+                )
+            )
+        node.bulk("\n".join(lines) + "\n", default_index=index)
+        node.refresh(index)
+
+    def test_knn_section_end_to_end_sharded_global_topk(self):
+        node = Node()
+        try:
+            node.ann_cache.min_docs = 512
+            node.create_index(
+                "v",
+                {
+                    "mappings": {
+                        "properties": {
+                            "vec": {"type": "dense_vector", "dims": 8}
+                        }
+                    },
+                    "settings": {"index": {"number_of_shards": 2}},
+                },
+            )
+            rng = np.random.default_rng(2)
+            centers = rng.standard_normal((8, 8)).astype(np.float32) * 3
+            self.bulk_vectors(3000, node, "v", rng, centers=centers)
+            q = (centers[0] + rng.standard_normal(8)).tolist()
+            out = node.search("v", knn_body(q, k=4, nprobe=4096))
+            # GLOBAL top-k: 2 shards x k candidates merge to k hits.
+            assert len(out["hits"]["hits"]) == 4
+            assert out["_shards"]["successful"] == 2
+            scores = [h["_score"] for h in out["hits"]["hits"]]
+            assert scores == sorted(scores, reverse=True)
+        finally:
+            node.close()
+
+    def test_rest_knn_search_endpoint_and_cache_clear(self):
+        from elasticsearch_tpu.rest.server import RestServer
+
+        node = Node()
+        rest = RestServer(node=node)
+        try:
+            node.ann_cache.min_docs = 256
+            node.create_index(
+                "v",
+                {
+                    "mappings": {
+                        "properties": {
+                            "vec": {"type": "dense_vector", "dims": 8},
+                            "tag": {"type": "keyword"},
+                        }
+                    }
+                },
+            )
+            rng = np.random.default_rng(3)
+            lines = []
+            for i in range(800):
+                lines.append(json.dumps({"index": {"_id": str(i)}}))
+                lines.append(
+                    json.dumps(
+                        {
+                            "vec": rng.standard_normal(8).tolist(),
+                            "tag": "a" if i % 2 else "b",
+                        }
+                    )
+                )
+            node.bulk("\n".join(lines) + "\n", default_index="v")
+            node.refresh("v")
+            q = rng.standard_normal(8).tolist()
+            status, body = rest.dispatch(
+                "POST",
+                "/v/_knn_search",
+                {},
+                json.dumps(
+                    {
+                        "knn": {
+                            "field": "vec",
+                            "query_vector": q,
+                            "k": 3,
+                            "num_candidates": 50,
+                        },
+                        "filter": {"term": {"tag": "a"}},
+                        "_source": False,
+                    }
+                ),
+            )
+            assert status == 200, body
+            assert len(body["hits"]["hits"]) == 3
+            assert all(
+                int(h["_id"]) % 2 == 1 for h in body["hits"]["hits"]
+            )
+            status, body = rest.dispatch(
+                "POST", "/v/_knn_search", {}, json.dumps({})
+            )
+            assert status == 400
+            # knn planes drop with _cache/clear and with index deletion
+            assert node.ann_cache.stats()["planes"] == 1
+            status, body = rest.dispatch(
+                "POST", "/v/_cache/clear", {}, ""
+            )
+            assert status == 200 and body["cleared"]["ann"] == 1
+            assert node.ann_cache.stats()["planes"] == 0
+        finally:
+            rest.close()
+
+    def test_knn_rejected_with_scroll(self):
+        node = Node()
+        try:
+            node.create_index(
+                "v",
+                {
+                    "mappings": {
+                        "properties": {
+                            "vec": {"type": "dense_vector", "dims": 4}
+                        }
+                    }
+                },
+            )
+            node.index_doc("v", {"vec": [1, 2, 3, 4]}, doc_id="a")
+            node.refresh("v")
+            with pytest.raises(ApiError) as err:
+                node.search(
+                    "v", knn_body([1, 2, 3, 4], k=1), scroll="1m"
+                )
+            assert err.value.status == 400
+        finally:
+            node.close()
+
+    def test_ann_opt_out_still_serves_exact(self, monkeypatch):
+        monkeypatch.setenv("ESTPU_ANN", "0")
+        node = Node()
+        try:
+            assert node.ann_cache is None
+            node.create_index(
+                "v",
+                {
+                    "mappings": {
+                        "properties": {
+                            "vec": {"type": "dense_vector", "dims": 4}
+                        }
+                    }
+                },
+            )
+            for i in range(20):
+                node.index_doc(
+                    "v", {"vec": [float(i), 0.0, 0.0, 1.0]}, doc_id=str(i)
+                )
+            node.refresh("v")
+            out = node.search("v", knn_body([19.0, 0, 0, 1], k=2))
+            assert len(out["hits"]["hits"]) == 2
+            stats = node.nodes_stats()["nodes"][node.node_name]["search"][
+                "ann"
+            ]
+            assert stats["enabled"] is False
+        finally:
+            node.close()
+
+    def test_replicated_knn_serves_exact(self):
+        from elasticsearch_tpu.rest.server import RestServer
+
+        rest = RestServer(replication_nodes=3)
+        try:
+            rest.dispatch(
+                "PUT",
+                "/v",
+                {},
+                json.dumps(
+                    {
+                        "mappings": {
+                            "properties": {
+                                "vec": {"type": "dense_vector", "dims": 4}
+                            }
+                        },
+                        "settings": {
+                            "index": {
+                                "number_of_shards": 2,
+                                "number_of_replicas": 1,
+                            }
+                        },
+                    }
+                ),
+            )
+            rng = np.random.default_rng(6)
+            for i in range(30):
+                rest.dispatch(
+                    "PUT",
+                    f"/v/_doc/{i}",
+                    {},
+                    json.dumps({"vec": rng.standard_normal(4).tolist()}),
+                )
+            rest.dispatch("POST", "/v/_refresh", {}, "")
+            status, body = rest.dispatch(
+                "POST",
+                "/v/_search",
+                {},
+                json.dumps(knn_body(rng.standard_normal(4).tolist(), k=3)),
+            )
+            assert status == 200, body
+            assert len(body["hits"]["hits"]) == 3
+        finally:
+            rest.close()
+
+
+# ----------------------------------------------- ingest validation satellite
+
+
+class TestDenseVectorIngest:
+    def make_node(self):
+        node = Node()
+        node.create_index(
+            "v",
+            {
+                "mappings": {
+                    "properties": {
+                        "vec": {"type": "dense_vector", "dims": 3},
+                        "body": {"type": "text"},
+                    }
+                }
+            },
+        )
+        return node
+
+    def test_dims_mismatch_400_at_index_time(self):
+        node = self.make_node()
+        try:
+            with pytest.raises(ApiError) as err:
+                node.index_doc("v", {"vec": [1.0, 2.0]}, doc_id="a")
+            assert err.value.status == 400
+            assert "dimensions" in str(err.value)
+            # nothing half-indexed
+            node.refresh("v")
+            assert node.search("v", {"size": 0})["hits"]["total"]["value"] == 0
+        finally:
+            node.close()
+
+    def test_bad_shapes_400(self):
+        node = self.make_node()
+        try:
+            for bad in (
+                [[1.0, 2.0, 3.0]],  # rank-2
+                ["a", "b", "c"],  # strings
+                {"x": 1},  # object
+                [1.0, float("nan"), 2.0],  # NaN
+            ):
+                with pytest.raises(ApiError) as err:
+                    node.index_doc("v", {"vec": bad})
+                assert err.value.status == 400, bad
+        finally:
+            node.close()
+
+    def test_bulk_reports_per_item_and_keeps_good_docs(self):
+        node = self.make_node()
+        try:
+            lines = [
+                json.dumps({"index": {"_id": "good1"}}),
+                json.dumps({"vec": [1.0, 2.0, 3.0]}),
+                json.dumps({"index": {"_id": "bad"}}),
+                json.dumps({"vec": [1.0, 2.0]}),
+                json.dumps({"index": {"_id": "good2"}}),
+                json.dumps({"vec": [4.0, 5.0, 6.0]}),
+            ]
+            out = node.bulk("\n".join(lines) + "\n", default_index="v")
+            assert out["errors"] is True
+            statuses = [item["index"]["status"] for item in out["items"]]
+            assert statuses == [201, 400, 201]
+            err = out["items"][1]["index"]["error"]
+            assert "dimensions" in err["reason"]
+            node.refresh("v")
+            assert node.search("v", {"size": 0})["hits"]["total"]["value"] == 2
+        finally:
+            node.close()
+
+    def test_update_with_bad_vector_400_keeps_original(self):
+        node = self.make_node()
+        try:
+            node.index_doc("v", {"vec": [1.0, 2.0, 3.0]}, doc_id="a")
+            with pytest.raises(ApiError) as err:
+                node.update_doc("v", "a", {"doc": {"vec": [9.0]}})
+            assert err.value.status == 400
+            assert "dimensions" in str(err.value)
+            doc = node.get_doc("v", "a")
+            assert doc["_source"]["vec"] == [1.0, 2.0, 3.0]
+        finally:
+            node.close()
+
+    def test_mapping_requires_dims_and_valid_similarity(self):
+        node = Node()
+        try:
+            with pytest.raises(ApiError) as err:
+                node.create_index(
+                    "nodims",
+                    {
+                        "mappings": {
+                            "properties": {
+                                "vec": {"type": "dense_vector"}
+                            }
+                        }
+                    },
+                )
+            assert err.value.status == 400
+            with pytest.raises(ApiError) as err:
+                node.create_index(
+                    "badsim",
+                    {
+                        "mappings": {
+                            "properties": {
+                                "vec": {
+                                    "type": "dense_vector",
+                                    "dims": 4,
+                                    "similarity": "euclid",
+                                }
+                            }
+                        }
+                    },
+                )
+            assert err.value.status == 400
+            # similarity round-trips through the mapping API
+            node.create_index(
+                "l2",
+                {
+                    "mappings": {
+                        "properties": {
+                            "vec": {
+                                "type": "dense_vector",
+                                "dims": 4,
+                                "similarity": "l2_norm",
+                            }
+                        }
+                    }
+                },
+            )
+            got = node.get_mapping("l2")["l2"]["mappings"]["properties"]
+            assert got["vec"]["similarity"] == "l2_norm"
+        finally:
+            node.close()
+
+
+# ----------------------------------------- script_score stays byte-identical
+
+
+class TestExactPathUnchanged:
+    def test_script_score_never_routes_to_ann(self):
+        """Exact kNN via script_score must not touch the ANN machinery:
+        identical hits with the ann cache enabled, disabled, and after
+        ANN planes exist for the same field."""
+        engine, vecs, centers, rng = vector_engine()
+        q = (centers[0] + rng.standard_normal(8)).astype(np.float32)
+        body = {
+            "query": {
+                "script_score": {
+                    "query": {"match_all": {}},
+                    "script": {
+                        "source": "cosineSimilarity(params.qv, 'vec') + 1.0",
+                        "params": {"qv": q.tolist()},
+                    },
+                }
+            },
+            "size": 10,
+        }
+        plain = SearchService(engine, "v").search(
+            SearchRequest.from_json(body)
+        )
+        cache = AnnCache(min_docs=256)
+        svc = SearchService(engine, "v", ann_cache=cache)
+        svc.search(SearchRequest.from_json(knn_body(q)))  # planes exist now
+        with_ann = svc.search(SearchRequest.from_json(body))
+        assert [h.doc_id for h in plain.hits] == [
+            h.doc_id for h in with_ann.hits
+        ]
+        np.testing.assert_array_equal(
+            np.asarray([h.score for h in plain.hits], np.float32),
+            np.asarray([h.score for h in with_ann.hits], np.float32),
+        )
+        assert cache.stats()["searches"].get("ann_ivf", 0) == 1  # knn only
+
+
+# ---------------------------------------------- _score asc host contract
+
+
+class TestScoreAscContract:
+    def docs_engine(self, refresh_every=None):
+        mappings = Mappings(
+            properties={"title": {"type": "text"}, "rank": {"type": "long"}}
+        )
+        engine = Engine(mappings)
+        words = ["quick", "brown", "fox", "lazy", "dog", "bread"]
+        rng = np.random.default_rng(8)
+        for i in range(60):
+            engine.index(
+                {"title": " ".join(rng.choice(words, 5)), "rank": i},
+                f"d{i}",
+            )
+            if refresh_every and (i + 1) % refresh_every == 0:
+                engine.refresh()
+        engine.refresh()
+        return engine
+
+    def oracle_bottom_k(self, engine, body, k):
+        from elasticsearch_tpu.query.dsl import parse_query
+        from elasticsearch_tpu.search.oracle import OracleSearcher
+
+        rows = []
+        for handle in engine.segments:
+            oracle = OracleSearcher(
+                handle.segment, engine.mappings, engine.params,
+                stats=engine.field_stats(),
+            )
+            scores, matched = oracle._eval(parse_query(body["query"]))
+            for local in np.flatnonzero(matched):
+                rows.append(
+                    (np.float32(scores[local]), handle.base + int(local),
+                     handle.segment.ids[local])
+                )
+        rows.sort(key=lambda r: (r[0], r[1]))
+        return rows[:k]
+
+    def test_score_asc_solo_oracle_parity(self):
+        engine = self.docs_engine()
+        body = {
+            "query": {"match": {"title": "quick fox"}},
+            "sort": [{"_score": "asc"}],
+            "size": 5,
+        }
+        resp = SearchService(engine, "t").search(
+            SearchRequest.from_json(body)
+        )
+        want = self.oracle_bottom_k(engine, body, 5)
+        assert [h.doc_id for h in resp.hits] == [w[2] for w in want]
+        np.testing.assert_array_equal(
+            np.asarray([h.score for h in resp.hits], np.float32),
+            np.asarray([w[0] for w in want], np.float32),
+        )
+
+    def test_score_asc_multi_segment_oracle_parity(self):
+        engine = self.docs_engine(refresh_every=17)
+        assert len(engine.segments) > 1
+        body = {
+            "query": {"match": {"title": "lazy dog"}},
+            "sort": [{"_score": "asc"}],
+            "size": 7,
+        }
+        resp = SearchService(engine, "t").search(
+            SearchRequest.from_json(body)
+        )
+        want = self.oracle_bottom_k(engine, body, 7)
+        assert [h.doc_id for h in resp.hits] == [w[2] for w in want]
+
+    def test_rescore_with_sort_is_a_clear_400(self):
+        """PR-8 residue closed: rescore combined with ANY explicit sort —
+        including {"_score": "asc"}, which used to silently DROP the
+        rescore stage — is a parse-time error (reference behavior)."""
+        for sort in ([{"_score": "asc"}], [{"_score": "desc"}], [{"rank": "asc"}]):
+            with pytest.raises(ValueError, match="rescore"):
+                SearchRequest.from_json(
+                    {
+                        "query": {"match": {"title": "quick"}},
+                        "sort": sort,
+                        "rescore": {
+                            "window_size": 5,
+                            "query": {
+                                "rescore_query": {"match": {"title": "fox"}}
+                            },
+                        },
+                    }
+                )

@@ -825,3 +825,129 @@ class TestRestAndObs:
             assert out["cleared"]["filter_cache"] == 0
         finally:
             n.close()
+
+
+class TestReplicatedClusterCache:
+    """ISSUE 10 satellite: replicated ClusterNode per-shard searches
+    consult the node filter cache, with the one-sighting-per-user-request
+    admission contract held across the scatter."""
+
+    BODY = {
+        "query": {
+            "bool": {
+                "must": [{"match": {"title": "w1 w2"}}],
+                "filter": [{"term": {"tag": "red"}}],
+            }
+        },
+        "size": 20,
+    }
+
+    def _cluster_rest(self):
+        import json
+
+        from elasticsearch_tpu.rest.server import RestServer
+
+        rest = RestServer(replication_nodes=3)
+        rest.dispatch(
+            "PUT",
+            "/rc",
+            {},
+            json.dumps(
+                {
+                    "mappings": MAPPINGS,
+                    "settings": {
+                        # 4 shards over 3 nodes: pigeonhole guarantees
+                        # some node serves >= 2 shard requests of ONE
+                        # scatter — the shape where per-shard recording
+                        # used to double-count sightings.
+                        "index": {
+                            "number_of_shards": 4,
+                            "number_of_replicas": 2,
+                        }
+                    },
+                }
+            ),
+        )
+        rng = random.Random(5)
+        for i in range(80):
+            rest.dispatch(
+                "PUT", f"/rc/_doc/{i}", {}, json.dumps(_doc(rng))
+            )
+        rest.dispatch("POST", "/rc/_refresh", {}, "")
+        return rest
+
+    def _freq_by_node(self, rest, key):
+        return {
+            nid: node.filter_cache._freq.get(key, 0)
+            for nid, node in rest.cluster.nodes.items()
+            if node.filter_cache is not None
+        }
+
+    def _entries_total(self, rest):
+        return sum(
+            len(node.filter_cache.keys())
+            for node in rest.cluster.nodes.values()
+            if node.filter_cache is not None
+        )
+
+    def test_scatter_counts_one_sighting_and_consults_cache(self):
+        import json
+
+        rest = self._cluster_rest()
+        try:
+            key = cacheable_filter_key(
+                parse_query({"term": {"tag": "red"}})
+            )
+            status, first = rest.dispatch(
+                "POST", "/rc/_search", {}, json.dumps(self.BODY)
+            )
+            assert status == 200
+            # ONE user request = at most ONE sighting per node cache,
+            # even for the node that served several shards of the
+            # scatter (pre-fix, every shard request counted one and a
+            # one-off filter self-admitted past min_freq=2 immediately).
+            freqs = self._freq_by_node(rest, key)
+            assert max(freqs.values()) == 1, freqs
+            assert self._entries_total(rest) == 0
+            status, second = rest.dispatch(
+                "POST", "/rc/_search", {}, json.dumps(self.BODY)
+            )
+            assert status == 200
+            # Second request reaches min_freq on the nodes serving the
+            # scatter: planes admitted, results bit-identical.
+            assert self._entries_total(rest) >= 1
+            status, third = rest.dispatch(
+                "POST", "/rc/_search", {}, json.dumps(self.BODY)
+            )
+            assert status == 200
+            for a, b in ((first, second), (second, third)):
+                assert [
+                    (h["_id"], h["_score"]) for h in a["hits"]["hits"]
+                ] == [(h["_id"], h["_score"]) for h in b["hits"]["hits"]]
+                assert a["hits"]["total"] == b["hits"]["total"]
+            # ... and the warm pass actually SERVED from the planes.
+            hits = sum(
+                node.filter_cache.stats()["hit_count"]
+                for node in rest.cluster.nodes.values()
+                if node.filter_cache is not None
+            )
+            assert hits >= 1
+        finally:
+            rest.close()
+
+    def test_opt_out_env_disables_cluster_caches(self, monkeypatch):
+        monkeypatch.setenv("ESTPU_FILTER_CACHE", "0")
+        import json
+
+        rest = self._cluster_rest()
+        try:
+            assert all(
+                node.filter_cache is None
+                for node in rest.cluster.nodes.values()
+            )
+            status, out = rest.dispatch(
+                "POST", "/rc/_search", {}, json.dumps(self.BODY)
+            )
+            assert status == 200
+        finally:
+            rest.close()

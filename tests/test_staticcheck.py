@@ -114,12 +114,13 @@ class TestRegistryConsistency:
             if f.rule == "registry-backend"
         ]
         # [ghost] lacks both a cost seed and any surfacing site;
-        # [packed], [mesh_spmd] and [cached_mask] are surfaced but
-        # unseeded (exactly one finding each) — registering the
-        # multi-tenant backend, the SPMD mesh plan class, or the filter-
-        # cache masked-execution backend without an exec/cost.py seed
-        # must fail the gate; [device] is covered and stays clean.
-        assert len(msgs) == 5
+        # [packed], [mesh_spmd], [cached_mask] and [ann_ivf] are surfaced
+        # but unseeded (exactly one finding each) — registering the
+        # multi-tenant backend, the SPMD mesh plan class, the filter-
+        # cache masked-execution backend, or the IVF ANN backend without
+        # an exec/cost.py seed must fail the gate; [device] is covered
+        # and stays clean.
+        assert len(msgs) == 6
         assert sum("[ghost]" in m for m in msgs) == 2
         packed = [m for m in msgs if "[packed]" in m]
         assert len(packed) == 1 and "cost seed" in packed[0]
@@ -127,6 +128,8 @@ class TestRegistryConsistency:
         assert len(mesh) == 1 and "cost seed" in mesh[0]
         cached = [m for m in msgs if "[cached_mask]" in m]
         assert len(cached) == 1 and "cost seed" in cached[0]
+        ann = [m for m in msgs if "[ann_ivf]" in m]
+        assert len(ann) == 1 and "cost seed" in ann[0]
 
     def test_fault_sites(self, report):
         msgs = [
@@ -154,7 +157,9 @@ class TestRegistryConsistency:
         assert any("[estpu_mesh_rogue_total]" in m for m in msgs)
         # ... and an uncataloged filter-cache instrument
         assert any("[estpu_filter_cache_rogue_total]" in m for m in msgs)
-        assert len(msgs) == 6
+        # ... and an uncataloged ANN instrument
+        assert any("[estpu_ann_rogue_total]" in m for m in msgs)
+        assert len(msgs) == 7
 
     def test_bool_spec(self, report):
         msgs = [f.message for f in report.findings if f.rule == "bool-spec"]
