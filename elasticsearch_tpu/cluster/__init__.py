@@ -10,10 +10,12 @@ from .cluster import (
 from .gateway import ReplicationGateway, ReplicationUnavailableError
 from .response_collector import ResponseCollectorService
 from .state import ClusterState, IndexMeta, ShardRouting
+from .tcp_transport import TcpTransport, TcpTransportHub
 from .transport import (
     ConnectTransportError,
     RemoteActionError,
     TransportHub,
+    TransportIntercepts,
 )
 
 __all__ = [
@@ -32,5 +34,8 @@ __all__ = [
     "ShardRouting",
     "ShardSearchFailedError",
     "StalePrimaryTermError",
+    "TcpTransport",
+    "TcpTransportHub",
     "TransportHub",
+    "TransportIntercepts",
 ]

@@ -82,10 +82,14 @@ class ClusterNode:
         hub: TransportHub,
         seeds: tuple[str, ...],
         state_path: str | None = None,
+        voting_only: tuple[str, ...] = (),
     ):
         self.node_id = node_id
         self.hub = hub
-        self.state = ClusterState(seed_nodes=seeds)
+        self.state = ClusterState(
+            seed_nodes=seeds, voting_only=set(voting_only)
+        )
+        self._voting_only = tuple(voting_only)
         self.current_term = 0  # highest term voted for / seen
         # Durable cluster-state directory (the reference's gateway/
         # PersistedClusterStateService): every accepted publication and
@@ -161,6 +165,14 @@ class ClusterNode:
             )
         }
         self._inflight_searches = 0
+        # Control-plane steps that raised and were swallowed by a stepper
+        # loop (LocalCluster's thread or a procs.py worker loop): a wedged
+        # control plane must be countable, never silent.
+        self._step_errors = self.metrics.counter(
+            "estpu_cluster_step_errors_total",
+            "Control-plane step errors swallowed by the background stepper",
+            node=node_id,
+        )
         self._recover_persisted_state()
         hub.register(node_id, self._handle)
 
@@ -209,6 +221,8 @@ class ClusterNode:
         except (json.JSONDecodeError, OSError, KeyError, TypeError, ValueError):
             return  # broken persisted state is never boot-fatal
         self.state = recovered
+        # Static role config survives even a pre-roles persisted state.
+        self.state.voting_only |= set(self._voting_only)
         self.current_term = max(
             int(data.get("current_term", 0)), recovered.term
         )
@@ -1209,6 +1223,53 @@ class ClusterNode:
             )
         return engine.get_with_meta(payload["id"])
 
+    # -------------------------------------------------------- client entry
+    # Coordinating-node entry points addressable over the wire: a
+    # supervisor/REST process that is NOT a cluster member reaches the
+    # multi-process cluster through these (the role TransportService's
+    # client channels play in the reference). Each simply enters the same
+    # coordinating paths a local caller uses.
+
+    def _on_client_write(self, from_id: str, payload: dict):
+        return self.execute_write(
+            payload["index"],
+            payload["id"],
+            payload.get("source"),
+            op=payload.get("op", "index"),
+            op_type=payload.get("op_type", "index"),
+            if_seq_no=payload.get("if_seq_no"),
+            if_primary_term=payload.get("if_primary_term"),
+        )
+
+    def _on_client_search(self, from_id: str, payload: dict):
+        return self.search(
+            payload["index"],
+            payload["body"],
+            allow_partial=bool(payload.get("allow_partial", True)),
+        )
+
+    def _on_client_read(self, from_id: str, payload: dict):
+        return self.read_doc(payload["index"], payload["id"])
+
+    def _on_client_state(self, from_id: str, payload: dict):
+        return {
+            "node": self.node_id,
+            "master": self.state.master,
+            "term": self.state.term,
+            "version": self.state.version,
+            "state": self.state.to_json(),
+            "step_errors": int(self._step_errors.value),
+        }
+
+    def _on_client_create_index(self, from_id: str, payload: dict):
+        """Create-index from a non-member client: route to the master."""
+        master = self.state.master
+        if master is None:
+            raise NotMasterError("no elected master")
+        if master == self.node_id:
+            return self._on_create_index(from_id, payload)
+        return self.hub.send(self.node_id, master, "create_index", payload)
+
     # ------------------------------------------------------- master duties
 
     def _require_master(self) -> None:
@@ -1298,7 +1359,12 @@ class ClusterNode:
         new = self.state.copy()
         if name in new.indices:
             raise ValueError(f"index [{name}] already exists")
-        nodes = sorted(new.nodes)
+        # Voting-only members never hold shard copies.
+        nodes = sorted(n for n in new.nodes if n not in new.voting_only)
+        if not nodes:
+            raise NoShardAvailableError(
+                f"cannot allocate [{name}]: no data-eligible nodes"
+            )
         meta = IndexMeta(
             name=name,
             mappings=payload.get("mappings") or {},
@@ -1430,6 +1496,8 @@ class ClusterNode:
                     for node in sorted(alive):
                         if have >= want:
                             break
+                        if node in new.voting_only:
+                            continue  # tiebreakers never take copies
                         if node not in holders:
                             routing.recovering.append(node)
                             have += 1
@@ -1518,10 +1586,34 @@ def _batches(items: list, n: int):
 
 class LocalCluster:
     """N in-process nodes over one interceptable hub — the test-cluster
-    form of the reference's InternalTestCluster (+ MockTransportService)."""
+    form of the reference's InternalTestCluster (+ MockTransportService).
 
-    def __init__(self, n_nodes: int = 3, data_path: str | None = None):
-        self.hub = TransportHub()
+    `transport` picks the wire: "hub" (in-memory switchboard, default) or
+    "tcp" (every node gets a real loopback socket endpoint via
+    TcpTransportHub — same interception API, actual frames). Defaults
+    from ESTPU_CLUSTER_TRANSPORT so whole suites re-run over sockets
+    unchanged."""
+
+    def __init__(
+        self,
+        n_nodes: int = 3,
+        data_path: str | None = None,
+        transport: str | None = None,
+    ):
+        if transport is None:
+            transport = os.environ.get("ESTPU_CLUSTER_TRANSPORT", "hub")
+        self.transport_kind = transport
+        if transport == "tcp":
+            from .tcp_transport import TcpTransportHub
+
+            self.hub = TcpTransportHub()
+        elif transport == "hub":
+            self.hub = TransportHub()
+        else:
+            raise ValueError(
+                f"unknown cluster transport [{transport}]; "
+                f"expected 'hub' or 'tcp'"
+            )
         seeds = tuple(f"node-{i}" for i in range(n_nodes))
         self.seeds = seeds
         # Durable cluster-state root: with a data_path, every node persists
@@ -1533,6 +1625,17 @@ class LocalCluster:
             node_id: ClusterNode(node_id, self.hub, seeds, state_path=data_path)
             for node_id in seeds
         }
+        # Cluster-level stepper error counter (the per-node counters cover
+        # procs.py worker loops); surfaced through gateway.stats() into
+        # `_nodes/stats` so a wedged control plane is visible.
+        from ..obs.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
+        self._step_errors = self.metrics.counter(
+            "estpu_cluster_step_errors_total",
+            "Control-plane step errors swallowed by the background stepper",
+            node="_cluster",
+        )
         self._stepper: threading.Thread | None = None
         self._stop = threading.Event()
         self.step()  # bootstrap election
@@ -1558,9 +1661,9 @@ class LocalCluster:
             while not self._stop.is_set():
                 try:
                     self.step()
-                # staticcheck: ignore[broad-except] daemon control-plane stepper: must survive any transient step error and retry next tick; owns no task
+                # staticcheck: ignore[broad-except] daemon control-plane stepper: must survive any transient step error and retry next tick; owns no task — but every swallowed error is COUNTED (estpu_cluster_step_errors_total), never silent
                 except Exception:
-                    pass
+                    self._step_errors.inc()
                 time.sleep(interval_s)
 
         self._stop.clear()
@@ -1599,10 +1702,20 @@ class LocalCluster:
         self.nodes[node_id] = node
         return node
 
+    def step_errors(self) -> int:
+        """Swallowed stepper errors: cluster-level loop + per-node loops."""
+        total = int(self._step_errors.value)
+        for node in self.nodes.values():
+            total += int(node._step_errors.value)
+        return total
+
     def close(self) -> None:
         self.stop_stepper()
         for node in self.nodes.values():
             node.close()
+        close_hub = getattr(self.hub, "close", None)
+        if close_hub is not None:
+            close_hub()
 
     # ------------------------------------------------------------- client
 

@@ -81,6 +81,14 @@ class ReplicationGateway:
         self.max_retries = max_retries
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
+        # One timeout semantics across transports: a single transport send
+        # must never outlive the gateway's whole per-request retry budget,
+        # so the hub's per-send deadline (which both the in-memory and the
+        # TCP transport honor as ConnectTransportError on expiry) is
+        # clamped to it.
+        hub = getattr(cluster, "hub", None)
+        if hub is not None and getattr(hub, "default_timeout_s", 0) > 0:
+            hub.default_timeout_s = min(hub.default_timeout_s, timeout_s)
         # Gateway counters write through a metrics registry (obs/
         # metrics.py); stats() and the node's `GET /_metrics` exposition
         # are views over it. The owning Node swaps in its registry via
@@ -384,7 +392,7 @@ class ReplicationGateway:
                 resilience[key] = resilience.get(key, 0) + value
             if snapshot:
                 collectors[node.node_id] = snapshot
-        return {
+        out = {
             **counters,
             "nodes": sorted(self.cluster.nodes),
             "alive_nodes": sorted(alive),
@@ -392,6 +400,17 @@ class ReplicationGateway:
             "search_resilience": resilience,
             "adaptive_replica_selection": collectors,
         }
+        # Swallowed control-plane stepper errors: a wedged stepper is a
+        # visible number in `_nodes/stats`, never a silent pass.
+        step_errors = getattr(self.cluster, "step_errors", None)
+        if step_errors is not None:
+            out["step_errors"] = step_errors()
+        # Transport-layer view (connection/reconnect/frame/timeout
+        # instruments for TCP; registered nodes + timeouts for the hub).
+        hub_stats = getattr(self.cluster.hub, "stats", None)
+        if hub_stats is not None:
+            out["transport"] = hub_stats()
+        return out
 
     def close(self) -> None:
         self.cluster.close()

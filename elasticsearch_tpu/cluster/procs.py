@@ -1,0 +1,527 @@
+"""Multi-process cluster serving: N OS processes over the TCP transport.
+
+The production topology the in-process LocalCluster simulates: each
+worker is a SPAWNED OS process owning one node id, its own data_path, and
+its own engines/device context, talking to its peers over
+cluster/tcp_transport.py sockets. `kill -9` of a worker is therefore a
+real failure mode — half-written frames, connection-refused dials, a
+process that vanishes without unwinding a single lock — and the
+promotion / zero-acked-write-loss / partition-heal guarantees are proven
+against it, not against a simulated `close()`.
+
+Topology: `ProcCluster(n_workers)` boots the workers plus (by default) a
+voting-only TIEBREAKER node living in the supervisor process — the
+classic two-data-nodes-plus-tiebreaker shape, so a 2-process cluster
+survives kill -9 of either data process with an intact election quorum
+while the tiebreaker (ClusterState.voting_only) never holds shard
+copies. The tiebreaker doubles as the supervisor's coordinating node:
+client writes/searches/reads enter there and route over real sockets.
+With `tiebreaker=False` the supervisor instead drives a non-member
+client endpoint through the `client_*` transport actions.
+
+Supervisor API mirrors LocalCluster where it matters:
+
+- `kill_9(node_id)` — SIGKILL the worker process (no goodbye; its
+  address file stays behind, stale, exactly like a crashed host).
+- `restart(node_id)` — spawn a fresh process for that node id; it boots
+  from its persisted cluster state and re-acquires copies via peer
+  recovery. The supervisor re-broadcasts the current interception rules
+  to it.
+- `partition(*groups)` / `heal_partition()` / `drop_action(...)` /
+  `set_delay(...)` — broadcast over a dedicated, never-intercepted
+  control endpoint; each worker applies the rules to its OWN sender-side
+  TransportIntercepts, so a partition blocks at every node's real socket
+  layer symmetrically.
+
+Device ownership: workers force the JAX platform named in
+`jax_platforms` (default "cpu" — the CI shape). Passing
+`jax_distributed={"coordinator_address": ..., "num_processes": ...,
+"process_id": ...}` per worker initializes `jax.distributed` so each
+process owns a device subset on real hardware; this is plumbing only —
+CI never exercises it (no multi-host TPU in the loop) and it is honest
+residue until a real pod run.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import os
+import signal
+import threading
+import time
+from typing import Any, Callable
+
+from .gateway import _RETRYABLE_LOCAL_TYPES, _RETRYABLE_REMOTE_TYPES
+from .transport import ConnectTransportError, RemoteActionError
+
+TIEBREAKER_ID = "tiebreaker"
+
+
+class ProcClusterUnavailableError(Exception):
+    """Supervisor-side retries exhausted against the process cluster."""
+
+
+def _force_platform(platform: str) -> None:
+    """conftest.py's dance, in-worker: the axon TPU plugin registers from
+    sitecustomize at interpreter startup and overrides JAX_PLATFORMS, so
+    the config must be updated (and any initialized backends cleared)
+    after importing jax."""
+    os.environ.setdefault("JAX_PLATFORMS", platform)
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+    from jax._src import xla_bridge as _xb
+
+    if _xb.backends_are_initialized():  # pragma: no cover - defensive
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+
+
+def _worker_main(cfg: dict) -> None:
+    """One spawned worker: TCP endpoint + ClusterNode + stepper loop.
+
+    Runs until a `_shutdown` control frame arrives or the supervisor
+    process disappears (getppid flip). Every swallowed step error counts
+    into estpu_cluster_step_errors_total — visible via `client_state`."""
+    platform = cfg.get("jax_platforms") or "cpu"
+    _force_platform(platform)
+    dist = cfg.get("jax_distributed")
+    if dist:
+        import jax
+
+        jax.distributed.initialize(**dist)
+    from .cluster import ClusterNode
+    from .tcp_transport import FileAddressBook, TcpTransport
+
+    book = FileAddressBook(cfg["addr_dir"])
+    transport = TcpTransport(
+        cfg["node_id"],
+        book,
+        cluster_name=cfg["cluster_name"],
+        default_timeout_s=cfg.get("send_timeout_s"),
+    )
+    node = ClusterNode(
+        cfg["node_id"],
+        transport,
+        tuple(cfg["seeds"]),
+        state_path=cfg["data_path"],
+        voting_only=tuple(cfg.get("voting_only", ())),
+    )
+    stop = threading.Event()
+
+    def handler(from_id: str, action: str, payload: dict):
+        # Control plane of the control plane: supervisor-only frames the
+        # ClusterNode never sees.
+        if action == "_shutdown":
+            stop.set()
+            return {"ok": True}
+        if action == "_intercepts":
+            transport.intercepts.load(payload)
+            return {"ok": True}
+        return node._handle(from_id, action, payload)
+
+    transport.register(cfg["node_id"], handler)
+    parent = os.getppid()
+    interval = float(cfg.get("step_interval_s", 0.05))
+    while not stop.wait(interval):
+        if os.getppid() != parent:
+            break  # supervisor died: no one owns this process anymore
+        try:
+            node.try_elect()
+            if node.is_master():
+                node.health_round()
+            node.check_recoveries()
+        # staticcheck: ignore[broad-except] daemon control-plane stepper: must survive any transient step error and retry next tick — every swallowed error is COUNTED (estpu_cluster_step_errors_total), never silent
+        except Exception:
+            node._step_errors.inc()
+    node.close()
+    transport.close()
+
+
+class ProcCluster:
+    """Supervisor for a multi-process TCP cluster (LocalCluster's API
+    shape over real OS processes)."""
+
+    def __init__(
+        self,
+        n_workers: int = 2,
+        data_path: str | None = None,
+        tiebreaker: bool = True,
+        cluster_name: str = "estpu-procs",
+        jax_platforms: str = "cpu",
+        jax_distributed: dict[str, dict] | None = None,
+        step_interval_s: float = 0.05,
+        send_timeout_s: float | None = 5.0,
+        boot_timeout_s: float = 90.0,
+    ):
+        import tempfile
+
+        from .tcp_transport import FileAddressBook, TcpTransport
+
+        self.data_path = data_path or tempfile.mkdtemp(prefix="estpu-procs-")
+        self.addr_dir = os.path.join(self.data_path, "_addr")
+        self.cluster_name = cluster_name
+        self.jax_platforms = jax_platforms
+        self.jax_distributed = jax_distributed or {}
+        self.step_interval_s = step_interval_s
+        self.send_timeout_s = send_timeout_s
+        self.boot_timeout_s = boot_timeout_s
+        self.workers = tuple(f"node-{i}" for i in range(n_workers))
+        self.voting_only = (TIEBREAKER_ID,) if tiebreaker else ()
+        self.seeds = self.workers + self.voting_only
+        self._ctx = multiprocessing.get_context("spawn")
+        self._procs: dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._intercept_state: dict = {}
+        self._closed = False
+        self._book = FileAddressBook(self.addr_dir)
+        # Dedicated control endpoint: its intercepts stay EMPTY forever,
+        # so partition/heal broadcasts always reach every worker even
+        # when the cluster's own channels are partitioned.
+        self._ctl = TcpTransport(
+            "_ctl",
+            self._book,
+            cluster_name=cluster_name,
+            default_timeout_s=send_timeout_s,
+        )
+        self._ctl.start()
+        for node_id in self.workers:
+            self._spawn(node_id)
+        self._local_node = None
+        self._stepper: threading.Thread | None = None
+        self._stop = threading.Event()
+        if tiebreaker:
+            from .cluster import ClusterNode
+
+            self._tb_transport = TcpTransport(
+                TIEBREAKER_ID,
+                self._book,
+                cluster_name=cluster_name,
+                default_timeout_s=send_timeout_s,
+            )
+            self._local_node = ClusterNode(
+                TIEBREAKER_ID,
+                self._tb_transport,
+                self.seeds,
+                state_path=os.path.join(self.data_path, TIEBREAKER_ID),
+                voting_only=self.voting_only,
+            )
+            self._start_tiebreaker_stepper()
+        self.wait_ready()
+
+    # ------------------------------------------------------------ workers
+
+    def _spawn(self, node_id: str) -> None:
+        cfg = {
+            "node_id": node_id,
+            "seeds": list(self.seeds),
+            "voting_only": list(self.voting_only),
+            "addr_dir": self.addr_dir,
+            "data_path": os.path.join(self.data_path, node_id),
+            "cluster_name": self.cluster_name,
+            "jax_platforms": self.jax_platforms,
+            "jax_distributed": self.jax_distributed.get(node_id),
+            "step_interval_s": self.step_interval_s,
+            "send_timeout_s": self.send_timeout_s,
+        }
+        proc = self._ctx.Process(
+            target=_worker_main, args=(cfg,), name=f"estpu-{node_id}"
+        )
+        proc.daemon = True
+        proc.start()
+        with self._lock:
+            self._procs[node_id] = proc
+
+    def _start_tiebreaker_stepper(self) -> None:
+        node = self._local_node
+
+        def loop():
+            while not self._stop.wait(self.step_interval_s):
+                try:
+                    node.try_elect()
+                    if node.is_master():
+                        node.health_round()
+                    node.check_recoveries()
+                # staticcheck: ignore[broad-except] daemon control-plane stepper: must survive any transient step error and retry next tick — every swallowed error is COUNTED (estpu_cluster_step_errors_total), never silent
+                except Exception:
+                    node._step_errors.inc()
+
+        self._stepper = threading.Thread(
+            target=loop, daemon=True, name="estpu-tiebreaker-stepper"
+        )
+        self._stepper.start()
+
+    def pid(self, node_id: str) -> int | None:
+        with self._lock:
+            proc = self._procs.get(node_id)
+        return None if proc is None else proc.pid
+
+    def wait_ready(
+        self,
+        timeout_s: float | None = None,
+        node_ids: tuple[str, ...] | None = None,
+    ) -> None:
+        """Block until the given workers (default: all) answer a ping
+        over their sockets."""
+        deadline = time.monotonic() + (timeout_s or self.boot_timeout_s)
+        for node_id in node_ids if node_ids is not None else self.workers:
+            while True:
+                try:
+                    self._ctl.send(
+                        "_ctl", node_id, "ping", {}, timeout_s=2.0
+                    )
+                    break
+                except (ConnectTransportError, RemoteActionError) as e:
+                    if time.monotonic() >= deadline:
+                        raise ProcClusterUnavailableError(
+                            f"worker [{node_id}] never came up: {e}"
+                        ) from e
+                    time.sleep(0.1)
+
+    def kill_9(self, node_id: str) -> None:
+        """Real process death: SIGKILL, no goodbye, stale address file."""
+        with self._lock:
+            proc = self._procs.get(node_id)
+        if proc is None or proc.pid is None:
+            return
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.join(timeout=10)
+
+    def restart(self, node_id: str) -> None:
+        """Fresh process for the node id: boots from its persisted
+        cluster state, rejoins, re-acquires copies via peer recovery."""
+        with self._lock:
+            proc = self._procs.pop(node_id, None)
+        if proc is not None and proc.is_alive():
+            raise ValueError(f"[{node_id}] is still running; kill it first")
+        self._spawn(node_id)
+        # Wait for THIS worker only: other workers may be intentionally
+        # dead (multi-failure chaos) and must not block the restart.
+        self.wait_ready(node_ids=(node_id,))
+        if self._intercept_state:
+            # A restarted worker boots with empty interception rules;
+            # converge it onto the cluster's current ruleset.
+            self._send_intercepts(node_id, self._intercept_state)
+
+    # ------------------------------------------------- interception control
+
+    def _send_intercepts(self, node_id: str, state: dict) -> None:
+        try:
+            self._ctl.send(
+                "_ctl", node_id, "_intercepts", state, timeout_s=5.0
+            )
+        except (ConnectTransportError, RemoteActionError):
+            pass  # dead worker: it gets the ruleset again on restart
+
+    def _broadcast_intercepts(self, state: dict) -> None:
+        self._intercept_state = state
+        for node_id in self.workers:
+            self._send_intercepts(node_id, state)
+        if self._local_node is not None:
+            self._tb_transport.intercepts.load(state)
+
+    def partition(self, *groups) -> None:
+        """Socket-layer partition: every node refuses sends that cross
+        group lines, symmetrically."""
+        state = dict(self._intercept_state or {"drops": [], "delay_s": 0.0})
+        state["partitions"] = [sorted(g) for g in groups]
+        self._broadcast_intercepts(state)
+
+    def heal_partition(self) -> None:
+        state = dict(self._intercept_state or {})
+        state["partitions"] = []
+        self._broadcast_intercepts(state)
+
+    def drop_action(self, from_id: str, to_id: str, pattern: str) -> None:
+        state = dict(self._intercept_state or {})
+        state.setdefault("drops", []).append([from_id, to_id, pattern])
+        self._broadcast_intercepts(state)
+
+    def clear_drops(self) -> None:
+        state = dict(self._intercept_state or {})
+        state["drops"] = []
+        self._broadcast_intercepts(state)
+
+    def set_delay(self, seconds: float) -> None:
+        state = dict(self._intercept_state or {})
+        state["delay_s"] = float(seconds)
+        self._broadcast_intercepts(state)
+
+    # ------------------------------------------------------------- client
+
+    def _retry(
+        self,
+        fn: Callable[[], Any],
+        timeout_s: float = 30.0,
+        backoff_s: float = 0.05,
+    ):
+        """Bounded supervisor-side retry over topology-shaped failures —
+        the gateway's exact classification (shared sets) — while the
+        workers' own steppers drive detection/promotion between attempts
+        (there is no cluster.step() to call across processes)."""
+        deadline = time.monotonic() + timeout_s
+        last: Exception | None = None
+        while True:
+            try:
+                return fn()
+            except RemoteActionError as e:
+                if e.remote_type not in _RETRYABLE_REMOTE_TYPES:
+                    raise
+                last = e
+            except _RETRYABLE_LOCAL_TYPES as e:
+                last = e
+            if time.monotonic() >= deadline:
+                raise ProcClusterUnavailableError(
+                    f"cluster operation failed within {timeout_s}s: {last}"
+                ) from last
+            time.sleep(backoff_s)
+
+    def _send_any(self, action: str, payload: dict):
+        """client_* action against any answering worker."""
+        last: Exception | None = None
+        for node_id in self.workers:
+            try:
+                return self._ctl.send("_ctl", node_id, action, payload)
+            except (ConnectTransportError, RemoteActionError) as e:
+                if (
+                    isinstance(e, RemoteActionError)
+                    and e.remote_type not in _RETRYABLE_REMOTE_TYPES
+                ):
+                    raise
+                last = e
+        raise ConnectTransportError(f"no worker answered [{action}]: {last}")
+
+    def create_index(
+        self,
+        name: str,
+        n_shards: int = 1,
+        n_replicas: int = 1,
+        mappings: dict | None = None,
+        timeout_s: float = 30.0,
+    ) -> dict:
+        payload = {
+            "name": name,
+            "n_shards": n_shards,
+            "n_replicas": n_replicas,
+            "mappings": mappings or {},
+        }
+        if self._local_node is not None:
+            node = self._local_node
+
+            def do():
+                return node._on_client_create_index("supervisor", payload)
+
+        else:
+
+            def do():
+                return self._send_any("client_create_index", payload)
+
+        return self._retry(do, timeout_s=timeout_s)
+
+    def write(
+        self,
+        index: str,
+        doc_id: str,
+        source: dict | None,
+        op: str = "index",
+        timeout_s: float = 30.0,
+    ) -> dict:
+        if self._local_node is not None:
+            node = self._local_node
+
+            def do():
+                return node.execute_write(index, doc_id, source, op=op)
+
+        else:
+            payload = {"index": index, "id": doc_id, "source": source, "op": op}
+
+            def do():
+                return self._send_any("client_write", payload)
+
+        return self._retry(do, timeout_s=timeout_s)
+
+    def read(self, index: str, doc_id: str, timeout_s: float = 30.0):
+        if self._local_node is not None:
+            node = self._local_node
+
+            def do():
+                return node.read_doc(index, doc_id)
+
+        else:
+            payload = {"index": index, "id": doc_id}
+
+            def do():
+                return self._send_any("client_read", payload)
+
+        return self._retry(do, timeout_s=timeout_s)
+
+    def search(self, index: str, body: dict, timeout_s: float = 30.0) -> dict:
+        if self._local_node is not None:
+            node = self._local_node
+
+            def do():
+                return node.search(index, body)
+
+        else:
+            payload = {"index": index, "body": body}
+
+            def do():
+                return self._send_any("client_search", payload)
+
+        return self._retry(do, timeout_s=timeout_s)
+
+    def state_of(self, node_id: str, timeout_s: float = 5.0) -> dict:
+        """client_state of one worker (routing table, master, counters)."""
+        return self._ctl.send(
+            "_ctl", node_id, "client_state", {}, timeout_s=timeout_s
+        )
+
+    def wait_for(
+        self,
+        predicate: Callable[[], bool],
+        timeout_s: float = 30.0,
+        interval_s: float = 0.1,
+        what: str = "condition",
+    ) -> None:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                if predicate():
+                    return
+            except (ConnectTransportError, RemoteActionError):
+                pass  # mid-failover flakes: keep polling
+            if time.monotonic() >= deadline:
+                raise ProcClusterUnavailableError(
+                    f"timed out after {timeout_s}s waiting for {what}"
+                )
+            time.sleep(interval_s)
+
+    # ------------------------------------------------------------ teardown
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        for node_id in self.workers:
+            try:
+                self._ctl.send(
+                    "_ctl", node_id, "_shutdown", {}, timeout_s=2.0
+                )
+            except (ConnectTransportError, RemoteActionError):
+                pass  # already dead
+        with self._lock:
+            procs = dict(self._procs)
+        deadline = time.monotonic() + 10.0
+        for node_id, proc in procs.items():
+            proc.join(timeout=max(0.1, deadline - time.monotonic()))
+            if proc.is_alive():
+                os.kill(proc.pid, signal.SIGKILL)
+                proc.join(timeout=5)
+        if self._stepper is not None:
+            self._stepper.join(timeout=2)
+        if self._local_node is not None:
+            self._local_node.close()
+            self._tb_transport.close()
+        self._ctl.close()

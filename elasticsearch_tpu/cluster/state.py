@@ -96,6 +96,12 @@ class ClusterState:
     # lives IN the published state so a new master inherits it and can
     # still recognize restarted-empty copies — including itself.
     node_sessions: dict[str, str] = field(default_factory=dict)
+    # Voting-only members (the reference's voting_only role): they count
+    # toward election/publication quorums but never hold shard copies —
+    # the tiebreaker shape that lets a 2-data-process cluster survive
+    # kill -9 of either data process. Static configuration, like
+    # seed_nodes.
+    voting_only: set[str] = field(default_factory=set)
 
     def newer_than(self, other: "ClusterState") -> bool:
         return (self.term, self.version) > (other.term, other.version)
@@ -115,6 +121,7 @@ class ClusterState:
             "seed_nodes": list(self.seed_nodes),
             "indices": {k: v.to_json() for k, v in self.indices.items()},
             "node_sessions": dict(self.node_sessions),
+            "voting_only": sorted(self.voting_only),
         }
 
     @classmethod
@@ -129,4 +136,5 @@ class ClusterState:
                 k: IndexMeta.from_json(v) for k, v in d["indices"].items()
             },
             node_sessions=dict(d.get("node_sessions", {})),
+            voting_only=set(d.get("voting_only", [])),
         )

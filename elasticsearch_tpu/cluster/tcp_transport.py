@@ -1,0 +1,941 @@
+"""Real TCP transport: the cluster control plane over actual sockets.
+
+The production form of cluster/transport.py's in-memory hub — the role
+the reference splits between the abstract transport
+(transport/TcpTransport.java:86) and its netty implementation
+(modules/transport-netty4/.../Netty4Transport.java:66). Same surface
+(`register`/`unregister`/`send` + the MockTransportService interception
+points), so every distributed guarantee the chaos and replication suites
+prove over the hub is re-proven over real sockets, where kill -9 of an
+OS process is a real failure mode instead of a simulated `close()`.
+
+Wire protocol (deliberately boring):
+
+- Frames are length-prefixed JSON: a 4-byte big-endian size then a UTF-8
+  JSON body, capped at MAX_FRAME_BYTES. numpy scalars/arrays in payloads
+  serialize via `.item()`/`.tolist()` (shard-search responses carry
+  device-computed scores).
+- The first frame on every connection is a handshake
+  `{"_handshake": {cluster, version, node}}`; the server refuses a
+  mismatched cluster name or protocol version with an error frame and
+  closes — a node from the wrong cluster (or a wrong-build peer) can
+  never exchange cluster state.
+- Requests are `{id, from, action, payload}`; responses are
+  `{id, ok, result}` or `{id, ok: false, kind, remote_type, error}`.
+  `kind: "connect"` re-raises as ConnectTransportError (the remote node
+  is closed/unregistered); anything else crosses as RemoteActionError
+  with the remote exception's type name, exactly like the hub.
+
+Failure semantics:
+
+- Every send runs under a deadline (default transport.DEFAULT_TIMEOUT_S)
+  driving connect/send/recv socket timeouts; expiry raises
+  ConnectTransportError — never an indefinite hang.
+- Dials retry with bounded exponential backoff (connect_attempts) inside
+  the deadline; connection-refused against a kill -9'd process fails
+  fast.
+- Connections are pooled per peer. A POOLED connection that dies before
+  any response byte is retried ONCE on a fresh dial (the peer may have
+  restarted); a fresh-dial failure or a mid-frame death (partial frame =
+  abrupt process death) surfaces immediately as ConnectTransportError.
+- Interception parity: partition/disconnect/drop_action/delay evaluate
+  sender-side from a TransportIntercepts — the SAME object semantics the
+  hub uses, so armed chaos schedules replay unchanged. The generic
+  `transport.send.<action>` fault site fires here too, plus TCP-specific
+  sites: `transport.tcp.connect` (dial-time resets),
+  `transport.tcp.send.<action>` (sender-side frame drops), and
+  `transport.tcp.frame` (receiver-side: the connection is torn down
+  mid-exchange, which the sender observes as a reset).
+
+Observability: `estpu_transport_*` instruments (connections, reconnect
+attempts, handshake rejections, frames/bytes by direction, deadline
+expiries, open-connection gauge) registered on the owning registry and
+cataloged in obs/metrics.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from ..faults import fault_point
+from ..obs.tracing import TRACER
+from .transport import (
+    DEFAULT_TIMEOUT_S,
+    ConnectTransportError,
+    InterceptsDelegate,
+    RemoteActionError,
+    TransportIntercepts,
+)
+
+PROTOCOL_VERSION = 1
+MAX_FRAME_BYTES = 64 * 1024 * 1024
+# Dial-time TCP connect timeout (per attempt), clamped to the remaining
+# per-send budget.
+CONNECT_TIMEOUT_S = 5.0
+# Idle pooled connections kept per peer; extras close on check-in.
+POOL_SIZE = 4
+
+
+# ------------------------------------------------------------------ frames
+
+
+def _json_default(obj):
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (set, frozenset)):
+        return sorted(obj)
+    raise TypeError(
+        f"not JSON-serializable over the transport wire: {type(obj)!r}"
+    )
+
+
+def encode_frame(obj: Any) -> bytes:
+    data = json.dumps(obj, default=_json_default).encode("utf-8")
+    if len(data) > MAX_FRAME_BYTES:
+        raise ConnectTransportError(
+            f"frame of {len(data)} bytes exceeds the "
+            f"{MAX_FRAME_BYTES}-byte cap"
+        )
+    return struct.pack(">I", len(data)) + data
+
+
+class _PeerClosed(Exception):
+    """The peer closed the connection. `clean` is True at a frame
+    boundary (pool churn / graceful close); False mid-frame — the
+    signature of abrupt process death (kill -9 with a half-written
+    frame)."""
+
+    def __init__(self, clean: bool):
+        super().__init__("clean EOF" if clean else "connection died mid-frame")
+        self.clean = clean
+
+
+def _recv_exact(sock: socket.socket, n: int, at_boundary: bool) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise _PeerClosed(clean=at_boundary and not buf)
+        buf += chunk
+    return buf
+
+
+def read_frame(sock: socket.socket) -> tuple[dict, int]:
+    """One length-prefixed JSON frame -> (object, wire bytes). Raises
+    _PeerClosed on EOF (clean only at a frame boundary) and
+    ConnectTransportError on an oversized or undecodable frame."""
+    head = _recv_exact(sock, 4, at_boundary=True)
+    (n,) = struct.unpack(">I", head)
+    if n > MAX_FRAME_BYTES:
+        raise ConnectTransportError(
+            f"inbound frame of {n} bytes exceeds the "
+            f"{MAX_FRAME_BYTES}-byte cap"
+        )
+    body = _recv_exact(sock, n, at_boundary=False)
+    try:
+        return json.loads(body.decode("utf-8")), n + 4
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ConnectTransportError(f"undecodable transport frame: {e}") from e
+
+
+# ----------------------------------------------------------- address books
+
+
+class InMemoryAddressBook:
+    """node id -> (host, port) for endpoints living in one process."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._addrs: dict[str, tuple[str, int]] = {}
+
+    def publish(self, node_id: str, addr: tuple[str, int]) -> None:
+        with self._lock:
+            self._addrs[node_id] = addr
+
+    def lookup(self, node_id: str) -> tuple[str, int] | None:
+        with self._lock:
+            return self._addrs.get(node_id)
+
+    def forget(self, node_id: str) -> None:
+        with self._lock:
+            self._addrs.pop(node_id, None)
+
+
+class FileAddressBook:
+    """Disk-backed address book for multi-process clusters: each worker
+    atomically publishes `<dir>/<node>.addr` ("host:port") at bind time;
+    senders resolve at dial time, so a restarted worker's new port is
+    picked up without coordination. A kill -9'd worker leaves a stale
+    file behind — honest: its address resolves, the dial gets
+    connection-refused, and the bounded reconnect surfaces
+    ConnectTransportError."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, node_id: str) -> str:
+        return os.path.join(self.root, f"{node_id}.addr")
+
+    def publish(self, node_id: str, addr: tuple[str, int]) -> None:
+        tmp = self._path(node_id) + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{addr[0]}:{addr[1]}")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path(node_id))
+
+    def lookup(self, node_id: str) -> tuple[str, int] | None:
+        try:
+            with open(self._path(node_id)) as f:
+                host, _, port = f.read().strip().rpartition(":")
+            return (host, int(port))
+        except (OSError, ValueError):
+            return None
+
+    def forget(self, node_id: str) -> None:
+        try:
+            os.unlink(self._path(node_id))
+        except OSError:
+            pass
+
+
+# --------------------------------------------------------------- endpoint
+
+
+class _HandshakeRejected(Exception):
+    pass
+
+
+def _hard_close(sock: socket.socket) -> None:
+    """shutdown + close: unlike a bare close(), shutdown(SHUT_RDWR) wakes
+    any thread blocked in recv() on this socket."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class TcpTransport:
+    """One node's socket endpoint: a listening server plus per-peer
+    outbound connection pools. Implements the TransportHub calling
+    surface for a SINGLE node id, so a ClusterNode in its own OS process
+    takes a TcpTransport directly as its `hub`."""
+
+    def __init__(
+        self,
+        node_id: str,
+        book,
+        cluster_name: str = "estpu-cluster",
+        intercepts: TransportIntercepts | None = None,
+        metrics=None,
+        default_timeout_s: float | None = None,
+        connect_attempts: int = 3,
+        connect_backoff_s: float = 0.02,
+        host: str = "127.0.0.1",
+    ):
+        self.node_id = node_id
+        self.book = book
+        self.cluster_name = cluster_name
+        self.intercepts = (
+            TransportIntercepts() if intercepts is None else intercepts
+        )
+        from ..obs.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry() if metrics is None else metrics
+        self.default_timeout_s = (
+            DEFAULT_TIMEOUT_S if default_timeout_s is None else default_timeout_s
+        )
+        self.connect_attempts = max(1, int(connect_attempts))
+        self.connect_backoff_s = connect_backoff_s
+        self._host = host
+        self._handler: Callable[[str, str, dict], Any] | None = None
+        self._server: socket.socket | None = None
+        self.address: tuple[str, int] | None = None
+        self._lock = threading.Lock()
+        self._pool: dict[str, list[socket.socket]] = {}
+        self._server_conns: set[socket.socket] = set()
+        self._accept_thread: threading.Thread | None = None
+        self._closed = False
+        self._req_id = 0
+        self._c_connections = self.metrics.counter(
+            "estpu_transport_connections_total",
+            "Outbound TCP transport connections established (post-handshake)",
+            node=node_id,
+        )
+        self._c_reconnects = self.metrics.counter(
+            "estpu_transport_reconnects_total",
+            "Dial retries after a failed/refused transport connect",
+            node=node_id,
+        )
+        self._c_handshake_rejects = self.metrics.counter(
+            "estpu_transport_handshake_rejects_total",
+            "Transport handshakes refused (cluster-name/version mismatch)",
+            node=node_id,
+        )
+        self._c_timeouts = self.metrics.counter(
+            "estpu_transport_send_timeouts_total",
+            "Transport sends that exceeded their per-send deadline",
+            transport="tcp",
+            node=node_id,
+        )
+        self._c_frames = {
+            d: self.metrics.counter(
+                "estpu_transport_frames_total",
+                "Transport frames by direction",
+                node=node_id,
+                dir=d,
+            )
+            for d in ("sent", "received")
+        }
+        self._c_frame_bytes = {
+            d: self.metrics.counter(
+                "estpu_transport_frame_bytes_total",
+                "Transport frame wire bytes by direction",
+                node=node_id,
+                dir=d,
+            )
+            for d in ("sent", "received")
+        }
+        self.metrics.gauge(
+            "estpu_transport_open_connections",
+            "Live transport connections (inbound + pooled outbound)",
+            fn=self._open_connections,
+            node=node_id,
+        )
+
+    # ------------------------------------------------------------- wiring
+
+    def start(self) -> tuple[str, int]:
+        """Bind, start accepting, and publish the address LAST so a peer
+        that can resolve this node can also reach it."""
+        if self._server is not None:
+            return self.address
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self._host, 0))
+        srv.listen(128)
+        self._server = srv
+        self.address = srv.getsockname()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop,
+            daemon=True,
+            name=f"tcp-accept-{self.node_id}",
+        )
+        self._accept_thread.start()
+        self.book.publish(self.node_id, self.address)
+        return self.address
+
+    def register(
+        self, node_id: str, handler: Callable[[str, str, dict], Any]
+    ) -> None:
+        if node_id != self.node_id:
+            raise ValueError(
+                f"endpoint [{self.node_id}] cannot host handler for "
+                f"[{node_id}]"
+            )
+        self._handler = handler
+        if self._server is None:
+            self.start()
+
+    def unregister(self, node_id: str) -> None:
+        if node_id == self.node_id:
+            self._handler = None
+
+    def alive(self, node_id: str) -> bool:
+        if node_id == self.node_id:
+            return self._handler is not None and not self._closed
+        return self.book.lookup(node_id) is not None
+
+    def _open_connections(self) -> float:
+        with self._lock:
+            return float(
+                len(self._server_conns)
+                + sum(len(p) for p in self._pool.values())
+            )
+
+    def close(self, abrupt: bool = False) -> None:
+        """Tear the endpoint down. `abrupt=True` is process death: every
+        socket closes with no goodbye and the published address stays
+        behind (stale), so peers observe resets and connection-refused —
+        exactly what kill -9 leaves. A graceful close retracts the
+        address."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            conns = list(self._server_conns)
+            for pool in self._pool.values():
+                conns.extend(pool)
+            self._pool.clear()
+            self._server_conns.clear()
+        self._handler = None
+        if self._server is not None:
+            # Wake a blocked accept() (close alone may not interrupt the
+            # syscall): one throwaway dial, then close the listener.
+            if self.address is not None:
+                try:
+                    socket.create_connection(
+                        self.address, timeout=0.2
+                    ).close()
+                except OSError:
+                    pass
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        for conn in conns:
+            _hard_close(conn)  # shutdown() wakes any thread blocked in recv
+        if not abrupt:
+            self.book.forget(self.node_id)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2)
+
+    # ------------------------------------------------------- server side
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _peer = self._server.accept()
+            except OSError:
+                return  # server socket closed
+            try:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            with self._lock:
+                if self._closed:
+                    conn.close()
+                    return
+                self._server_conns.add(conn)
+            threading.Thread(
+                target=self._serve_conn,
+                args=(conn,),
+                daemon=True,
+                name=f"tcp-serve-{self.node_id}",
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        """One inbound connection: handshake, then request frames until
+        the peer drops it. Any failure — including an injected
+        `transport.tcp.frame` fault — tears the connection down without a
+        response, which the sender observes as a reset."""
+        peer = "?"
+        try:
+            conn.settimeout(30.0)  # handshake must arrive promptly
+            hello, _ = read_frame(conn)
+            hs = hello.get("_handshake")
+            if (
+                not isinstance(hs, dict)
+                or hs.get("cluster") != self.cluster_name
+                or hs.get("version") != PROTOCOL_VERSION
+            ):
+                self._c_handshake_rejects.inc()
+                self._write(
+                    conn,
+                    {
+                        "ok": False,
+                        "kind": "handshake",
+                        "error": (
+                            f"[{self.node_id}] refused handshake: got "
+                            f"cluster [{(hs or {}).get('cluster')}] "
+                            f"version [{(hs or {}).get('version')}], this "
+                            f"node is [{self.cluster_name}]/"
+                            f"[{PROTOCOL_VERSION}]"
+                        ),
+                    },
+                )
+                return
+            peer = str(hs.get("node", "?"))
+            self._write(
+                conn,
+                {
+                    "ok": True,
+                    "node": self.node_id,
+                    "cluster": self.cluster_name,
+                    "version": PROTOCOL_VERSION,
+                },
+            )
+            while not self._closed:
+                conn.settimeout(None)  # idle pooled conn: wait for traffic
+                req, nbytes = read_frame(conn)
+                self._c_frames["received"].inc()
+                self._c_frame_bytes["received"].inc(nbytes)
+                # Receiver-side chaos hook: an armed transport.tcp.frame
+                # fault aborts the connection mid-exchange (reset).
+                fault_point(
+                    "transport.tcp.frame",
+                    node=self.node_id,
+                    action=req.get("action", "?"),
+                )
+                self._write(conn, self._serve_one(peer, req))
+        except _PeerClosed:
+            pass  # pool churn or peer death; nothing to answer
+        except (OSError, ConnectTransportError, ValueError):
+            pass  # torn-down socket / injected reset / garbage frame
+        # staticcheck: ignore[broad-except] connection thread boundary: an injected InjectedFaultError (or any handler-side surprise) must kill THIS connection only, never the acceptor
+        except Exception:
+            pass
+        finally:
+            with self._lock:
+                self._server_conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_one(self, peer: str, req: dict) -> dict:
+        rid = req.get("id")
+        action = str(req.get("action", "?"))
+        handler = self._handler
+        if handler is None or self._closed:
+            return {
+                "id": rid,
+                "ok": False,
+                "kind": "connect",
+                "error": f"[{self.node_id}] is closed (no handler)",
+            }
+        try:
+            result = handler(peer, action, req.get("payload") or {})
+            return {"id": rid, "ok": True, "result": result}
+        except ConnectTransportError as e:
+            return {
+                "id": rid,
+                "ok": False,
+                "kind": "connect",
+                "error": str(e),
+            }
+        except RemoteActionError as e:
+            return {
+                "id": rid,
+                "ok": False,
+                "kind": "remote",
+                "remote_type": e.remote_type,
+                "error": str(e),
+            }
+        # staticcheck: ignore[broad-except] wire boundary: a remote handler failure must cross as RemoteActionError exactly like the in-memory hub's send
+        except Exception as e:
+            return {
+                "id": rid,
+                "ok": False,
+                "kind": "remote",
+                "remote_type": type(e).__name__,
+                "error": f"[{action}] on [{self.node_id}]: {e}",
+            }
+
+    def _write(self, conn: socket.socket, obj: dict) -> None:
+        try:
+            data = encode_frame(obj)
+        except TypeError as e:
+            # Unserializable handler result: still answer, as an error.
+            data = encode_frame(
+                {
+                    "id": obj.get("id"),
+                    "ok": False,
+                    "kind": "remote",
+                    "remote_type": "TypeError",
+                    "error": f"unserializable transport response: {e}",
+                }
+            )
+        conn.sendall(data)
+        self._c_frames["sent"].inc()
+        self._c_frame_bytes["sent"].inc(len(data))
+
+    # ------------------------------------------------------- client side
+
+    def send(
+        self,
+        from_id: str,
+        to_id: str,
+        action: str,
+        payload: dict,
+        timeout_s: float | None = None,
+    ):
+        """TransportHub.send over a pooled socket: same interception
+        points, same error surface, bounded by a per-send deadline."""
+        if from_id != self.node_id:
+            raise ValueError(
+                f"endpoint [{self.node_id}] cannot send as [{from_id}]"
+            )
+        if self._closed:
+            raise ConnectTransportError(f"[{from_id}] endpoint is closed")
+        timeout_s = self.default_timeout_s if timeout_s is None else timeout_s
+        deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
+        with TRACER.span(
+            f"transport.{action}",
+            from_node=from_id,
+            to_node=to_id,
+            transport="tcp",
+        ):
+            # ONE shared sender-side gate with the in-memory hub: the
+            # interception/deadline semantics cannot diverge per transport.
+            self.intercepts.preflight(
+                from_id, to_id, action, deadline, timeout_s,
+                on_timeout=self._c_timeouts.inc,
+            )
+            # Transport-agnostic site (chaos schedules written against the
+            # hub replay here unchanged), then the TCP-specific one.
+            fault_point(
+                f"transport.send.{action}", from_node=from_id, to_node=to_id
+            )
+            fault_point(
+                f"transport.tcp.send.{action}",
+                from_node=from_id,
+                to_node=to_id,
+            )
+            ctx = TRACER.context()
+            if ctx is not None:
+                payload = dict(
+                    payload, _trace={"trace_id": ctx[0], "parent": ctx[1]}
+                )
+            with self._lock:
+                self._req_id += 1
+                rid = self._req_id
+            req = {
+                "id": rid,
+                "from": from_id,
+                "action": action,
+                "payload": payload,
+            }
+            return self._roundtrip(to_id, action, req, deadline, timeout_s)
+
+    def _remaining(self, deadline, action: str, to_id: str) -> float | None:
+        if deadline is None:
+            return None
+        left = deadline - time.monotonic()
+        if left <= 0:
+            self._c_timeouts.inc()
+            raise ConnectTransportError(
+                f"[{action}] to [{to_id}] timed out (deadline exhausted)"
+            )
+        return left
+
+    def _roundtrip(self, to_id, action, req, deadline, timeout_s):
+        frame = encode_frame(req)
+        for attempt in (0, 1):
+            conn, pooled = self._checkout(to_id, deadline, action)
+            wrote = False
+            try:
+                conn.settimeout(self._remaining(deadline, action, to_id))
+                conn.sendall(frame)
+                wrote = True
+                self._c_frames["sent"].inc()
+                self._c_frame_bytes["sent"].inc(len(frame))
+                conn.settimeout(self._remaining(deadline, action, to_id))
+                resp, nbytes = read_frame(conn)
+            except socket.timeout:
+                self._discard(conn)
+                self._c_timeouts.inc()
+                raise ConnectTransportError(
+                    f"[{action}] to [{to_id}] timed out after {timeout_s}s "
+                    f"(no response)"
+                ) from None
+            except (_PeerClosed, OSError) as e:
+                self._discard(conn)
+                # Retry ONLY when the request cannot have executed: the
+                # pooled connection failed during the request WRITE (the
+                # peer never consumed the full frame), or the peer closed
+                # CLEANLY at a frame boundary without answering (the
+                # stale-keep-alive race — the server drops idle conns
+                # before dispatching). A mid-frame death or reset AFTER
+                # the request was delivered may have executed a
+                # non-idempotent op; that ambiguity belongs to the
+                # replication layer's at-least-once contract, never to a
+                # silent transport re-send.
+                safe_retry = not wrote or (
+                    isinstance(e, _PeerClosed) and e.clean
+                )
+                if pooled and attempt == 0 and safe_retry:
+                    continue  # stale pooled conn: one fresh-dial retry
+                mode = (
+                    "reset mid-frame (abrupt peer death)"
+                    if isinstance(e, _PeerClosed) and not e.clean
+                    else "connection lost"
+                )
+                raise ConnectTransportError(
+                    f"[{action}] to [{to_id}] {mode}: {e}"
+                ) from e
+            except ConnectTransportError:
+                # Deadline exhausted between checkout and IO (_remaining
+                # raised): the checked-out socket must not leak.
+                self._discard(conn)
+                raise
+            self._c_frames["received"].inc()
+            self._c_frame_bytes["received"].inc(nbytes)
+            self._checkin(to_id, conn)
+            return self._unwrap(resp, action, to_id)
+        raise ConnectTransportError(f"[{action}] to [{to_id}] failed")
+
+    def _unwrap(self, resp: dict, action: str, to_id: str):
+        if resp.get("ok"):
+            return resp.get("result")
+        if resp.get("kind") in ("connect", "handshake"):
+            raise ConnectTransportError(resp.get("error") or f"[{to_id}]")
+        raise RemoteActionError(
+            resp.get("error") or f"[{action}] failed on [{to_id}]",
+            remote_type=str(resp.get("remote_type", "")),
+        )
+
+    # ------------------------------------------------------------- pool
+
+    def _checkout(
+        self, to_id: str, deadline, action: str
+    ) -> tuple[socket.socket, bool]:
+        with self._lock:
+            pool = self._pool.get(to_id)
+            if pool:
+                return pool.pop(), True
+        return self._dial(to_id, deadline, action), False
+
+    def _checkin(self, to_id: str, conn: socket.socket) -> None:
+        with self._lock:
+            if not self._closed:
+                pool = self._pool.setdefault(to_id, [])
+                if len(pool) < POOL_SIZE:
+                    pool.append(conn)
+                    return
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _discard(self, conn: socket.socket) -> None:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _dial(self, to_id: str, deadline, action: str) -> socket.socket:
+        """Bounded reconnect-with-backoff within the send deadline."""
+        last: Exception | None = None
+        for attempt in range(self.connect_attempts):
+            if attempt:
+                self._c_reconnects.inc()
+                backoff = self.connect_backoff_s * (2 ** (attempt - 1))
+                left = self._remaining(deadline, action, to_id)
+                if left is not None and backoff >= left:
+                    break
+                time.sleep(backoff)
+            addr = self.book.lookup(to_id)
+            if addr is None:
+                raise ConnectTransportError(
+                    f"[{to_id}] has no published transport address"
+                )
+            try:
+                # Injectable dial-time reset (chaos: connection storms).
+                fault_point(
+                    "transport.tcp.connect",
+                    from_node=self.node_id,
+                    to_node=to_id,
+                )
+                left = self._remaining(deadline, action, to_id)
+                conn_timeout = (
+                    CONNECT_TIMEOUT_S
+                    if left is None
+                    else min(CONNECT_TIMEOUT_S, left)
+                )
+                sock = socket.create_connection(addr, timeout=conn_timeout)
+                try:
+                    sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                    sock.settimeout(
+                        self._remaining(deadline, action, to_id)
+                    )
+                    hello = encode_frame(
+                        {
+                            "_handshake": {
+                                "cluster": self.cluster_name,
+                                "version": PROTOCOL_VERSION,
+                                "node": self.node_id,
+                            }
+                        }
+                    )
+                    sock.sendall(hello)
+                    resp, _ = read_frame(sock)
+                    if not resp.get("ok"):
+                        raise _HandshakeRejected(
+                            resp.get("error")
+                            or f"handshake rejected by [{to_id}]"
+                        )
+                except BaseException:
+                    sock.close()
+                    raise
+                self._c_connections.inc()
+                return sock
+            except _HandshakeRejected as e:
+                self._c_handshake_rejects.inc()
+                raise ConnectTransportError(str(e)) from None
+            except (OSError, _PeerClosed, ConnectTransportError) as e:
+                if isinstance(e, ConnectTransportError) and "timed out" in str(
+                    e
+                ):
+                    raise  # deadline exhausted: stop retrying
+                last = e
+        raise ConnectTransportError(
+            f"cannot connect to [{to_id}] from [{self.node_id}] after "
+            f"{self.connect_attempts} attempts: {last}"
+        )
+
+
+# -------------------------------------------------------------------- hub
+
+
+class TcpTransportHub(InterceptsDelegate):
+    """Drop-in TransportHub over real loopback sockets: every registered
+    node gets its own TcpTransport endpoint (listening socket + pools) in
+    this process, and `send` routes through the SENDER's endpoint — so
+    the existing LocalCluster, chaos, and replication machinery runs
+    unchanged while every RPC crosses an actual TCP connection. One
+    shared TransportIntercepts keeps the interception API identical to
+    the in-memory hub."""
+
+    def __init__(
+        self,
+        cluster_name: str = "estpu-local",
+        default_timeout_s: float | None = None,
+    ):
+        from ..obs.metrics import MetricsRegistry
+
+        self.cluster_name = cluster_name
+        self.metrics = MetricsRegistry()
+        self.intercepts = TransportIntercepts()
+        self.book = InMemoryAddressBook()
+        self.default_timeout_s = (
+            DEFAULT_TIMEOUT_S if default_timeout_s is None else default_timeout_s
+        )
+        self._lock = threading.Lock()
+        self._endpoints: dict[str, TcpTransport] = {}
+
+    # ------------------------------------------------------------ wiring
+
+    def register(
+        self, node_id: str, handler: Callable[[str, str, dict], Any]
+    ) -> None:
+        endpoint = TcpTransport(
+            node_id,
+            self.book,
+            cluster_name=self.cluster_name,
+            intercepts=self.intercepts,
+            metrics=self.metrics,
+            default_timeout_s=self.default_timeout_s,
+        )
+        endpoint.register(node_id, handler)  # binds + publishes
+        with self._lock:
+            old = self._endpoints.pop(node_id, None)
+            self._endpoints[node_id] = endpoint
+        if old is not None:
+            old.close(abrupt=True)
+
+    def unregister(self, node_id: str) -> None:
+        """Node death: the endpoint's sockets close with no goodbye —
+        peers see resets/refused connections, the socket-layer truth of a
+        killed node."""
+        with self._lock:
+            endpoint = self._endpoints.pop(node_id, None)
+        if endpoint is not None:
+            endpoint.close(abrupt=True)
+            self.book.forget(node_id)
+
+    # ------------------------------------------------------------- sending
+
+    def send(
+        self,
+        from_id: str,
+        to_id: str,
+        action: str,
+        payload: dict,
+        timeout_s: float | None = None,
+    ):
+        with self._lock:
+            endpoint = self._endpoints.get(from_id)
+        if endpoint is None:
+            raise ConnectTransportError(
+                f"[{from_id}] has no live transport endpoint"
+            )
+        if timeout_s is None:
+            # Resolve against the hub's LIVE default, not the value each
+            # endpoint copied at registration: the replication gateway
+            # clamps hub.default_timeout_s to its per-request budget
+            # after the nodes already registered.
+            timeout_s = self.default_timeout_s
+        return endpoint.send(
+            from_id, to_id, action, payload, timeout_s=timeout_s
+        )
+
+    def alive(self, node_id: str) -> bool:
+        with self._lock:
+            return node_id in self._endpoints
+
+    def stats(self) -> dict:
+        with self._lock:
+            endpoints = dict(self._endpoints)
+        return {
+            "kind": "tcp",
+            "registered": sorted(endpoints),
+            "addresses": {
+                node_id: list(ep.address) if ep.address else None
+                for node_id, ep in endpoints.items()
+            },
+            "connections": int(
+                sum(
+                    self.metrics.values(
+                        "estpu_transport_connections_total"
+                    ).values()
+                )
+            ),
+            "reconnects": int(
+                sum(
+                    self.metrics.values(
+                        "estpu_transport_reconnects_total"
+                    ).values()
+                )
+            ),
+            "handshake_rejects": int(
+                sum(
+                    self.metrics.values(
+                        "estpu_transport_handshake_rejects_total"
+                    ).values()
+                )
+            ),
+            "send_timeouts": int(
+                sum(
+                    self.metrics.values(
+                        "estpu_transport_send_timeouts_total"
+                    ).values()
+                )
+            ),
+            "frames": {
+                d: int(
+                    sum(
+                        v
+                        for k, v in self.metrics.values(
+                            "estpu_transport_frames_total"
+                        ).items()
+                        if ("dir", d) in k
+                    )
+                )
+                for d in ("sent", "received")
+            },
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            endpoints = list(self._endpoints.values())
+            self._endpoints.clear()
+        for endpoint in endpoints:
+            endpoint.close(abrupt=True)

@@ -7,13 +7,24 @@ collectives (parallel/sharded.py) and only this control plane crosses
 hosts. The in-memory hub is the test-cluster form — the reference's
 MockTransportService pattern (test/framework .../MockTransportService) —
 with the same interception points (disconnect, partition, drop-by-action,
-delay) a TCP implementation would fault on, so replication/failover logic
-is exercised against real message loss without real sockets.
+delay) a TCP implementation faults on, so replication/failover logic
+is exercised against real message loss without real sockets. The real-
+socket implementation of the SAME surface lives in cluster/tcp_transport.py
+(TcpTransportHub / TcpTransport); both share `TransportIntercepts` so a
+chaos schedule written against one transport runs unchanged on the other.
+
+Every send is bounded: `send` carries a per-call deadline (default
+`ESTPU_TRANSPORT_TIMEOUT_S`, 10s) and raises ConnectTransportError on
+expiry — an injected `delay` or a wedged remote handler can never block a
+caller forever. This is the same contract the TCP transport honors with
+socket timeouts, so the gateway's retry loop sees one timeout semantics
+across both transports.
 """
 
 from __future__ import annotations
 
 import fnmatch
+import os
 import threading
 import time
 from typing import Any, Callable
@@ -21,9 +32,15 @@ from typing import Any, Callable
 from ..faults import fault_point
 from ..obs.tracing import TRACER
 
+# Per-send deadline shared by BOTH transports (the in-memory hub joins the
+# handler thread against it; the TCP transport drives socket timeouts from
+# it). <= 0 disables the bound (escape hatch for debugging).
+DEFAULT_TIMEOUT_S = float(os.environ.get("ESTPU_TRANSPORT_TIMEOUT_S", "10") or 10)
+
 
 class ConnectTransportError(Exception):
-    """Peer unreachable (dead node, partition, injected disconnect)."""
+    """Peer unreachable (dead node, partition, injected disconnect) or a
+    send that exceeded its deadline without a response."""
 
 
 class RemoteActionError(Exception):
@@ -37,30 +54,20 @@ class RemoteActionError(Exception):
         self.remote_type = remote_type
 
 
-class TransportHub:
-    """Shared in-process switchboard for a LocalCluster's nodes."""
+class TransportIntercepts:
+    """Sender-side interception state: the MockTransportService surface
+    (disconnect pairs, partition groups, drop-by-action, added latency)
+    shared by the in-memory hub and the TCP transport. In a multi-process
+    cluster every worker holds its own copy and the supervisor broadcasts
+    updates over a control action, so a partition applies symmetrically at
+    each node's real socket layer."""
 
     def __init__(self):
-        self._handlers: dict[str, Callable[[str, str, dict], Any]] = {}
         self._lock = threading.Lock()
         self._partitions: list[set[str]] = []  # disjoint reachability groups
         self._disconnected: set[frozenset] = set()  # unordered pairs
         self._dropped_actions: list[tuple[str, str, str]] = []  # from,to,pat
-        self._delay_s = 0.0
-
-    # ------------------------------------------------------------ wiring
-
-    def register(
-        self, node_id: str, handler: Callable[[str, str, dict], Any]
-    ) -> None:
-        with self._lock:
-            self._handlers[node_id] = handler
-
-    def unregister(self, node_id: str) -> None:
-        with self._lock:
-            self._handlers.pop(node_id, None)
-
-    # ---------------------------------------------------- fault injection
+        self.delay_s = 0.0
 
     def disconnect(self, a: str, b: str) -> None:
         with self._lock:
@@ -89,49 +96,186 @@ class TransportHub:
             self._dropped_actions = []
 
     def set_delay(self, seconds: float) -> None:
-        self._delay_s = seconds
+        self.delay_s = seconds
+
+    def reachable(self, a: str, b: str) -> bool:
+        with self._lock:
+            if frozenset((a, b)) in self._disconnected:
+                return False
+            for group in self._partitions:
+                if (a in group) != (b in group):
+                    return False
+            return True
+
+    def dropped(self, from_id: str, to_id: str, action: str) -> bool:
+        with self._lock:
+            drops = list(self._dropped_actions)
+        for f, t, pat in drops:
+            if (
+                fnmatch.fnmatch(from_id, f)
+                and fnmatch.fnmatch(to_id, t)
+                and fnmatch.fnmatch(action, pat)
+            ):
+                return True
+        return False
+
+    def preflight(
+        self,
+        from_id: str,
+        to_id: str,
+        action: str,
+        deadline: float | None,
+        timeout_s: float,
+        on_timeout: Callable[[], None],
+    ) -> None:
+        """The sender-side gate both transports run before touching the
+        wire — ONE implementation so the interception semantics (and the
+        delay-vs-deadline interplay) can never diverge between them.
+        Raises ConnectTransportError for partitions/disconnects, dropped
+        actions, and injected delays that blow the send deadline
+        (counting via on_timeout first); sleeps surviving delays."""
+        if not self.reachable(from_id, to_id):
+            raise ConnectTransportError(
+                f"[{to_id}] unreachable from [{from_id}]"
+            )
+        if self.dropped(from_id, to_id, action):
+            raise ConnectTransportError(
+                f"[{action}] {from_id}->{to_id} dropped by interceptor"
+            )
+        delay = self.delay_s
+        if delay:
+            if deadline is not None and time.monotonic() + delay > deadline:
+                # The injected latency alone blows the budget: honor the
+                # deadline, not the sleep.
+                time.sleep(max(0.0, deadline - time.monotonic()))
+                on_timeout()
+                raise ConnectTransportError(
+                    f"[{action}] {from_id}->{to_id} timed out after "
+                    f"{timeout_s}s (injected delay)"
+                )
+            time.sleep(delay)
+
+    # ------------------------------------------- control-channel transfer
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {
+                "partitions": [sorted(g) for g in self._partitions],
+                "disconnected": [sorted(p) for p in self._disconnected],
+                "drops": [list(d) for d in self._dropped_actions],
+                "delay_s": self.delay_s,
+            }
+
+    def load(self, data: dict) -> None:
+        """Replace the whole interception state (the supervisor's
+        broadcast form: every worker converges on one ruleset)."""
+        with self._lock:
+            self._partitions = [set(g) for g in data.get("partitions", [])]
+            self._disconnected = {
+                frozenset(p) for p in data.get("disconnected", [])
+            }
+            self._dropped_actions = [
+                (d[0], d[1], d[2]) for d in data.get("drops", [])
+            ]
+            self.delay_s = float(data.get("delay_s", 0.0))
+
+
+class InterceptsDelegate:
+    """The hub-level fault-injection surface, delegated to
+    `self.intercepts`: tests/operators interact with
+    `cluster.hub.partition(...)` no matter which transport backs it."""
+
+    intercepts: TransportIntercepts
+
+    def disconnect(self, a: str, b: str) -> None:
+        self.intercepts.disconnect(a, b)
+
+    def reconnect(self, a: str, b: str) -> None:
+        self.intercepts.reconnect(a, b)
+
+    def partition(self, *groups: set[str]) -> None:
+        self.intercepts.partition(*groups)
+
+    def heal_partition(self) -> None:
+        self.intercepts.heal_partition()
+
+    def drop_action(self, from_id: str, to_id: str, pattern: str) -> None:
+        self.intercepts.drop_action(from_id, to_id, pattern)
+
+    def clear_drops(self) -> None:
+        self.intercepts.clear_drops()
+
+    def set_delay(self, seconds: float) -> None:
+        self.intercepts.set_delay(seconds)
+
+
+class TransportHub(InterceptsDelegate):
+    """Shared in-process switchboard for a LocalCluster's nodes."""
+
+    def __init__(self, default_timeout_s: float | None = None):
+        self._handlers: dict[str, Callable[[str, str, dict], Any]] = {}
+        self._lock = threading.Lock()
+        self.intercepts = TransportIntercepts()
+        self.default_timeout_s = (
+            DEFAULT_TIMEOUT_S if default_timeout_s is None else default_timeout_s
+        )
+        from ..obs.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
+        self._timeouts = self.metrics.counter(
+            "estpu_transport_send_timeouts_total",
+            "Transport sends that exceeded their per-send deadline",
+            transport="hub",
+        )
+
+    # ------------------------------------------------------------ wiring
+
+    def register(
+        self, node_id: str, handler: Callable[[str, str, dict], Any]
+    ) -> None:
+        with self._lock:
+            self._handlers[node_id] = handler
+
+    def unregister(self, node_id: str) -> None:
+        with self._lock:
+            self._handlers.pop(node_id, None)
 
     # ------------------------------------------------------------- sending
 
-    def _reachable(self, a: str, b: str) -> bool:
-        if frozenset((a, b)) in self._disconnected:
-            return False
-        for group in self._partitions:
-            if (a in group) != (b in group):
-                return False
-        return True
-
-    def send(self, from_id: str, to_id: str, action: str, payload: dict):
+    def send(
+        self,
+        from_id: str,
+        to_id: str,
+        action: str,
+        payload: dict,
+        timeout_s: float | None = None,
+    ):
         """Synchronous request/response; raises ConnectTransportError on
-        unreachable peers and RemoteActionError for remote failures.
+        unreachable peers (and on deadline expiry) and RemoteActionError
+        for remote failures.
 
         Trace context rides the wire: when the sender has an active span,
         the payload carries `_trace` (trace_id + parent span id) so the
         receiving node's execution parents into the caller's tree exactly
         as it would across real sockets — the receive side re-activates
         the explicit context rather than trusting thread locals."""
+        timeout_s = self.default_timeout_s if timeout_s is None else timeout_s
+        deadline = (
+            time.monotonic() + timeout_s if timeout_s > 0 else None
+        )
         with self._lock:
             handler = self._handlers.get(to_id)
-            reachable = self._reachable(from_id, to_id)
-            drops = list(self._dropped_actions)
         with TRACER.span(
             f"transport.{action}", from_node=from_id, to_node=to_id
         ):
-            if handler is None or not reachable:
+            if handler is None:
                 raise ConnectTransportError(
                     f"[{to_id}] unreachable from [{from_id}]"
                 )
-            for f, t, pat in drops:
-                if (
-                    fnmatch.fnmatch(from_id, f)
-                    and fnmatch.fnmatch(to_id, t)
-                    and fnmatch.fnmatch(action, pat)
-                ):
-                    raise ConnectTransportError(
-                        f"[{action}] {from_id}->{to_id} dropped by interceptor"
-                    )
-            if self._delay_s:
-                time.sleep(self._delay_s)
+            self.intercepts.preflight(
+                from_id, to_id, action, deadline, timeout_s,
+                on_timeout=self._timeouts.inc,
+            )
             # Named fault site (faults/registry.py): injectable per-action
             # drops/delays without pre-wiring hub interceptors, e.g.
             # `transport.send.shard_search`.
@@ -143,17 +287,73 @@ class TransportHub:
                 payload = dict(
                     payload, _trace={"trace_id": ctx[0], "parent": ctx[1]}
                 )
+            if deadline is None:
+                return _invoke(handler, from_id, to_id, action, payload)
+            return self._bounded_invoke(
+                handler, from_id, to_id, action, payload, deadline, timeout_s
+            )
+
+    def _bounded_invoke(
+        self, handler, from_id, to_id, action, payload, deadline, timeout_s
+    ):
+        """Run the handler on a worker thread and join against the
+        deadline: a response that never comes surfaces as
+        ConnectTransportError, exactly like a socket recv timeout. The
+        abandoned handler may still complete its side effects — the same
+        at-least-once ambiguity a real network timeout leaves behind."""
+        box: dict[str, Any] = {}
+
+        def run():
             try:
-                return handler(from_id, action, payload)
-            except (ConnectTransportError, RemoteActionError):
-                raise
-            # staticcheck: ignore[broad-except] wire boundary: a remote handler failure must cross as RemoteActionError exactly like a real RPC (chaos parity includes injected faults)
-            except Exception as e:  # remote handler failure crosses the wire
-                raise RemoteActionError(
-                    f"[{action}] on [{to_id}]: {e}",
-                    remote_type=type(e).__name__,
-                ) from e
+                box["result"] = handler(from_id, action, payload)
+            # staticcheck: ignore[broad-except] wire boundary: the failure is carried back to the sending thread and classified there exactly like an on-thread call
+            except BaseException as e:
+                box["error"] = e
+
+        worker = threading.Thread(
+            target=run, daemon=True, name=f"hub-send-{action}"
+        )
+        worker.start()
+        worker.join(max(0.0, deadline - time.monotonic()))
+        if worker.is_alive():
+            self._timeouts.inc()
+            raise ConnectTransportError(
+                f"[{action}] on [{to_id}] timed out after {timeout_s}s "
+                f"(no response within the per-send deadline)"
+            )
+        if "error" in box:
+            _raise_as_remote(box["error"], action, to_id)
+        return box.get("result")
 
     def alive(self, node_id: str) -> bool:
         with self._lock:
             return node_id in self._handlers
+
+    def stats(self) -> dict:
+        with self._lock:
+            registered = sorted(self._handlers)
+        return {
+            "kind": "hub",
+            "registered": registered,
+            "send_timeouts": int(self._timeouts.value),
+        }
+
+
+def _invoke(handler, from_id, to_id, action, payload):
+    try:
+        return handler(from_id, action, payload)
+    except (ConnectTransportError, RemoteActionError):
+        raise
+    # staticcheck: ignore[broad-except] wire boundary: a remote handler failure must cross as RemoteActionError exactly like a real RPC (chaos parity includes injected faults)
+    except Exception as e:  # remote handler failure crosses the wire
+        _raise_as_remote(e, action, to_id)
+
+
+def _raise_as_remote(e: BaseException, action: str, to_id: str):
+    """Classify a handler failure the way the wire would: transport-shaped
+    errors pass through, everything else crosses as RemoteActionError."""
+    if isinstance(e, (ConnectTransportError, RemoteActionError)):
+        raise e
+    raise RemoteActionError(
+        f"[{action}] on [{to_id}]: {e}", remote_type=type(e).__name__
+    ) from e

@@ -18,8 +18,16 @@ Sites currently threaded (fnmatch patterns match against these names):
                                 coordinator (search/coordinator.py)
     batcher.launch              one sub-request riding a coalesced
                                 micro-batch launch (exec/batcher.py)
-    transport.send.<action>     host transport send (cluster/transport.py),
+    transport.send.<action>     host transport send (cluster/transport.py
+                                AND cluster/tcp_transport.py — a schedule
+                                armed here replays on either transport),
                                 e.g. transport.send.shard_search
+    transport.tcp.*             socket-layer faults (cluster/
+                                tcp_transport.py): `transport.tcp.connect`
+                                dial-time resets, `transport.tcp.send.<a>`
+                                sender-side frame drops,
+                                `transport.tcp.frame` receiver-side
+                                connection teardown mid-exchange
     breaker.reserve             HBM breaker reservation (common/breaker.py)
 
 Configuration is per-site: error rate, error class (internal | transport |
@@ -61,6 +69,7 @@ SITES = (
     "coordinator.shard",
     "batcher.launch",
     "transport.send.*",
+    "transport.tcp.*",
     "breaker.reserve",
 )
 

@@ -430,6 +430,26 @@ CATALOG = {
         "replication.search_resilience",
     ),
     "estpu_replication_gateway_total": ("counter", "replication.gateway"),
+    # Control-plane stepper errors (cluster/cluster.py, cluster/procs.py):
+    # a step that raised and was swallowed by a background loop — counted
+    # so a wedged control plane is visible in `_nodes/stats`.
+    "estpu_cluster_step_errors_total": ("counter", "replication.stepper"),
+    # TCP transport (cluster/tcp_transport.py) + the in-memory hub's
+    # shared deadline counter: connection/reconnect/handshake/frame/
+    # timeout instruments, surfaced under replication.transport.
+    "estpu_transport_connections_total": ("counter", "replication.transport"),
+    "estpu_transport_reconnects_total": ("counter", "replication.transport"),
+    "estpu_transport_handshake_rejects_total": (
+        "counter",
+        "replication.transport",
+    ),
+    "estpu_transport_send_timeouts_total": (
+        "counter",
+        "replication.transport",
+    ),
+    "estpu_transport_frames_total": ("counter", "replication.transport"),
+    "estpu_transport_frame_bytes_total": ("counter", "replication.transport"),
+    "estpu_transport_open_connections": ("gauge", "replication.transport"),
 }
 
 # Pow-2-ish bounds for the padding-waste ratio and occupancy/wait shapes.

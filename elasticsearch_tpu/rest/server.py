@@ -178,6 +178,7 @@ class RestServer:
         data_path: str | None = None,
         replication_nodes: int = 0,
         cluster_data_path: str | None = None,
+        cluster_transport: str | None = None,
     ):
         """A REST front. With `replication_nodes >= 2` (or the
         ESTPU_REPLICATION_NODES env var) the server boots an in-process
@@ -185,7 +186,9 @@ class RestServer:
         acknowledged writes reach every in-sync copy before the 200, and
         reads/searches fail over across copies when nodes die. The
         background stepper keeps failure detection and promotion live
-        under traffic."""
+        under traffic. `cluster_transport` picks the node-to-node wire:
+        "hub" (in-memory, default) or "tcp" (real loopback sockets);
+        defaults from ESTPU_CLUSTER_TRANSPORT."""
         if node is None and replication_nodes == 0:
             replication_nodes = int(
                 os.environ.get("ESTPU_REPLICATION_NODES", "0") or 0
@@ -205,7 +208,9 @@ class RestServer:
             from ..cluster import LocalCluster, ReplicationGateway
 
             self.cluster = LocalCluster(
-                replication_nodes, data_path=cluster_data_path
+                replication_nodes,
+                data_path=cluster_data_path,
+                transport=cluster_transport,
             )
             self.cluster.start_stepper()
             node = Node(

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""CI smoke gate for the real-socket transport / multi-process cluster.
+
+Runs, on the CPU backend with no TPU in the loop:
+
+- the TCP transport contracts (frame codec, handshake refusal, per-send
+  deadlines, abrupt-death/partial-frame handling, pooled reconnect,
+  interception parity with the in-memory hub), and
+- the 2-process loopback cluster scenario (cluster/procs.py): each
+  worker an OS process with its own node id + data_path, indexing and
+  the search mix served through real sockets, then kill -9 of the
+  primary-owning process -> promotion within deadline -> every acked
+  write read back, plus a socket-layer partition + heal converging.
+
+The same tests ride the tier-1 run via the fast (`not slow`) marker —
+the FULL chaos/replication matrices over TCP run in the `slow` lane of
+the transport-parameterized suites. This script is the standalone hook
+for pre-merge / cron checks, mirroring scripts/check_chaos_smoke.py:
+
+    python scripts/check_socket_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "tests/test_tcp_transport.py",
+        "tests/test_socket_procs.py",
+        "-q",
+        "-m",
+        "not slow",
+        "-p",
+        "no:cacheprovider",
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

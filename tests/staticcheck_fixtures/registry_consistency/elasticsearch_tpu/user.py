@@ -6,6 +6,9 @@ from .faults.registry import fault_point
 def arm_faults():
     fault_point("search.kernel")  # registered: fine
     fault_point("unregistered.site")  # not in SITES
+    # A socket-transport site that never made it into SITES must fail
+    # exactly like any other unregistered chaos hook.
+    fault_point("transport.tcp.frame")
     # staticcheck: ignore[registry-fault-site] fixture: suppressed twin
     fault_point("other.bad")
 
@@ -54,4 +57,11 @@ def make_filter_cache_instruments(m):
     m.counter(
         "estpu_filter_cache_rogue_total",
         "filter-cache instrument not in CATALOG",
+    )
+
+
+def make_transport_instruments(m):
+    m.counter(
+        "estpu_transport_rogue_total",
+        "socket-transport instrument not in CATALOG",
     )

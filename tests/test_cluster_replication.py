@@ -5,6 +5,11 @@ rejoin via ops-based catch-up, stale-primary rejection, quorum safety.
 The reference's acceptance shape: InternalTestCluster + MockTransportService
 (test/framework) driving ReplicationOperation.java:111 semantics with
 ReplicationTracker.java:68 in-sync sets; here LocalCluster + TransportHub.
+
+The whole suite is parameterized over BOTH transports: the in-memory hub
+(tier-1, every run) and real TCP loopback sockets (`slow` lane — the full
+matrix re-proven over the wire; the trimmed tier-1 socket slice lives in
+test_tcp_transport.py / test_socket_procs.py).
 """
 
 import pytest
@@ -20,11 +25,33 @@ from elasticsearch_tpu.parallel.routing import shard_for_id
 MAPPINGS = {"properties": {"body": {"type": "text"}}}
 
 
+@pytest.fixture(
+    params=["hub", pytest.param("tcp", marks=pytest.mark.slow)]
+)
+def transport(request):
+    return request.param
+
+
 @pytest.fixture
-def cluster():
-    c = LocalCluster(3)
-    yield c
-    c.close()
+def make_cluster(transport):
+    """LocalCluster factory bound to the parameterized transport; closes
+    everything it made on teardown (tests may also close explicitly —
+    close is idempotent)."""
+    made = []
+
+    def make(n_nodes: int = 3, **kwargs) -> LocalCluster:
+        c = LocalCluster(n_nodes, transport=transport, **kwargs)
+        made.append(c)
+        return c
+
+    yield make
+    for c in made:
+        c.close()
+
+
+@pytest.fixture
+def cluster(make_cluster):
+    return make_cluster(3)
 
 
 def doc_ids(n, prefix="d"):
@@ -144,11 +171,11 @@ class TestKillPrimary:
 
 
 class TestReplicaRejoin:
-    def test_ops_based_catchup(self):
+    def test_ops_based_catchup(self, make_cluster):
         # 5 nodes all holding a copy (no spares): a killed replica cannot
         # be replaced, so its restart must rejoin THAT copy via ops-based
         # catch-up; killing the primary afterwards still keeps a quorum.
-        cluster = LocalCluster(5)
+        cluster = make_cluster(5)
         try:
             cluster.create_index(
                 "rj", n_shards=1, n_replicas=4, mappings=MAPPINGS
@@ -287,12 +314,12 @@ class TestDeleteReplication:
 
 
 class TestConcurrentChaos:
-    def test_writes_race_promotion_no_acked_loss(self):
+    def test_writes_race_promotion_no_acked_loss(self, make_cluster):
         """Writer threads race a primary kill with the background stepper
         running; every write that was ACKED must survive promotion."""
         import threading
 
-        cluster = LocalCluster(3)
+        cluster = make_cluster(3)
         try:
             cluster.create_index(
                 "chaos", n_shards=1, n_replicas=2, mappings=MAPPINGS
@@ -356,11 +383,11 @@ class TestConcurrentChaos:
 
 
 class TestRestartSafety:
-    def test_restarted_empty_copy_not_promoted(self):
+    def test_restarted_empty_copy_not_promoted(self, make_cluster):
         """kill+restart a replica with NO control round between, then kill
         the primary: the restarted (empty) copy must never be promoted —
         the session map strips its stale in-sync membership first."""
-        cluster = LocalCluster(5)
+        cluster = make_cluster(5)
         try:
             cluster.create_index(
                 "rs", n_shards=1, n_replicas=1, mappings=MAPPINGS
@@ -393,10 +420,10 @@ class TestRestartSafety:
         finally:
             cluster.close()
 
-    def test_global_checkpoint_unpinned_after_fail_out(self):
+    def test_global_checkpoint_unpinned_after_fail_out(self, make_cluster):
         """Failing a copy out of the in-sync set must release its grip on
         the primary's global checkpoint."""
-        cluster = LocalCluster(3)
+        cluster = make_cluster(3)
         try:
             cluster.create_index(
                 "gc2", n_shards=1, n_replicas=1, mappings=MAPPINGS
@@ -420,10 +447,10 @@ class TestRestartSafety:
 
 
 class TestDivergenceSafety:
-    def test_term_resync_purges_phantom_on_surviving_replica(self):
+    def test_term_resync_purges_phantom_on_surviving_replica(self, make_cluster):
         """A replica holding the dead primary's never-acked op (phantom)
         must be reset to the new primary's ops line after promotion."""
-        cluster = LocalCluster(3)
+        cluster = make_cluster(3)
         try:
             cluster.create_index(
                 "dv", n_shards=1, n_replicas=2, mappings=MAPPINGS
@@ -461,11 +488,11 @@ class TestDivergenceSafety:
         finally:
             cluster.close()
 
-    def test_deposed_primary_with_phantom_resyncs_on_rejoin(self):
+    def test_deposed_primary_with_phantom_resyncs_on_rejoin(self, make_cluster):
         """An isolated primary that accepted (but could not replicate or
         ack) an op rejoins after healing via full resync — the phantom op
         never resurrects."""
-        cluster = LocalCluster(3)
+        cluster = make_cluster(3)
         try:
             cluster.create_index(
                 "dp", n_shards=1, n_replicas=2, mappings=MAPPINGS

@@ -106,9 +106,15 @@ def _clean_registry():
     REGISTRY.clear()
 
 
-@pytest.fixture
-def replicated(monkeypatch):
+@pytest.fixture(
+    params=["hub", pytest.param("tcp", marks=pytest.mark.slow)]
+)
+def replicated(request, monkeypatch):
+    """Replicated REST cluster, parameterized over both transports: the
+    in-memory hub every run, real TCP loopback sockets in the `slow`
+    lane — the identical seeded chaos schedules replay over the wire."""
     monkeypatch.setenv("ESTPU_MESH_SERVING", "0")
+    monkeypatch.setenv("ESTPU_CLUSTER_TRANSPORT", request.param)
     server = RestServer(replication_nodes=3)
     status, _ = server.dispatch(
         "PUT",

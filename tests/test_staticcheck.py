@@ -139,7 +139,9 @@ class TestRegistryConsistency:
         ]
         assert any("[unregistered.site]" in m for m in msgs)
         assert any("[dead.site]" in m for m in msgs)
-        assert len(msgs) == 2
+        # an unregistered socket-transport site fails like any other
+        assert any("[transport.tcp.frame]" in m for m in msgs)
+        assert len(msgs) == 3
 
     def test_fault_site_suppressed_twin(self, report):
         assert rules_of(report.suppressed).get("registry-fault-site") == 1
@@ -159,7 +161,9 @@ class TestRegistryConsistency:
         assert any("[estpu_filter_cache_rogue_total]" in m for m in msgs)
         # ... and an uncataloged ANN instrument
         assert any("[estpu_ann_rogue_total]" in m for m in msgs)
-        assert len(msgs) == 7
+        # ... and an uncataloged socket-transport instrument
+        assert any("[estpu_transport_rogue_total]" in m for m in msgs)
+        assert len(msgs) == 8
 
     def test_bool_spec(self, report):
         msgs = [f.message for f in report.findings if f.rule == "bool-spec"]

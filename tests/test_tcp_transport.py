@@ -1,0 +1,500 @@
+"""TCP transport (cluster/tcp_transport.py): frame codec, handshake
+refusal, per-send deadlines, abrupt-death/partial-frame handling, pooled
+reconnect, interception parity with the in-memory hub — plus the trimmed
+tier-1 socket smoke: a LocalCluster over real loopback sockets surviving
+primary kill and partition with zero acked-write loss. (The FULL chaos
+and replication matrices run over TCP in the `slow` lane via the
+transport-parameterized suites.)"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster import (
+    ConnectTransportError,
+    LocalCluster,
+    RemoteActionError,
+    TcpTransport,
+    TcpTransportHub,
+    TransportHub,
+)
+from elasticsearch_tpu.cluster.tcp_transport import (
+    InMemoryAddressBook,
+    encode_frame,
+    read_frame,
+)
+from elasticsearch_tpu.faults import REGISTRY, FaultSpec
+
+MAPPINGS = {"properties": {"body": {"type": "text"}}}
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    REGISTRY.clear()
+    yield
+    REGISTRY.clear()
+
+
+def _echo(from_id, action, payload):
+    return {"echo": action, "from": from_id, "payload": payload}
+
+
+@pytest.fixture
+def pair():
+    """Two live endpoints (a, b) sharing one in-memory address book."""
+    book = InMemoryAddressBook()
+    a = TcpTransport("a", book, cluster_name="t")
+    b = TcpTransport("b", book, cluster_name="t")
+    a.register("a", _echo)
+    b.register("b", _echo)
+    yield a, b
+    a.close()
+    b.close()
+
+
+class TestFrameCodec:
+    def test_roundtrip_via_socket_pair(self):
+        left, right = socket.socketpair()
+        try:
+            obj = {"x": 1, "nested": {"y": [1, 2, 3]}, "s": "héllo"}
+            left.sendall(encode_frame(obj))
+            got, nbytes = read_frame(right)
+            assert got == obj
+            assert nbytes == len(encode_frame(obj))
+        finally:
+            left.close()
+            right.close()
+
+    def test_numpy_payloads_serialize(self):
+        left, right = socket.socketpair()
+        try:
+            obj = {
+                "score": np.float32(1.5),
+                "count": np.int64(7),
+                "arr": np.array([1.0, 2.0]),
+                "ids": {"b", "a"},
+            }
+            left.sendall(encode_frame(obj))
+            got, _ = read_frame(right)
+            assert got == {
+                "score": 1.5,
+                "count": 7,
+                "arr": [1.0, 2.0],
+                "ids": ["a", "b"],
+            }
+        finally:
+            left.close()
+            right.close()
+
+    def test_oversized_inbound_frame_refused(self):
+        left, right = socket.socketpair()
+        try:
+            left.sendall(struct.pack(">I", 1 << 30))
+            with pytest.raises(ConnectTransportError, match="exceeds"):
+                read_frame(right)
+        finally:
+            left.close()
+            right.close()
+
+
+class TestEndpoint:
+    def test_request_response(self, pair):
+        a, b = pair
+        out = a.send("a", "b", "ping", {"n": 1})
+        assert out == {"echo": "ping", "from": "a", "payload": {"n": 1}}
+
+    def test_remote_error_carries_type(self, pair):
+        a, b = pair
+
+        def boom(from_id, action, payload):
+            raise KeyError("nope")
+
+        b.register("b", boom)
+        with pytest.raises(RemoteActionError) as err:
+            a.send("a", "b", "x", {})
+        assert err.value.remote_type == "KeyError"
+
+    def test_remote_connect_error_crosses_as_connect(self, pair):
+        a, b = pair
+
+        def closed(from_id, action, payload):
+            raise ConnectTransportError("[b] closed")
+
+        b.register("b", closed)
+        with pytest.raises(ConnectTransportError, match="closed"):
+            a.send("a", "b", "x", {})
+
+    def test_unknown_peer_unreachable(self, pair):
+        a, _ = pair
+        with pytest.raises(ConnectTransportError, match="no published"):
+            a.send("a", "ghost", "ping", {})
+
+    def test_dead_peer_connection_refused_fast(self, pair):
+        a, b = pair
+        a.send("a", "b", "ping", {})  # warm pool
+        b.close(abrupt=True)  # process death: address stays, port dead
+        t0 = time.monotonic()
+        with pytest.raises(ConnectTransportError):
+            a.send("a", "b", "ping", {})
+        assert time.monotonic() - t0 < 5.0  # bounded, not hung
+
+    def test_slow_handler_hits_send_deadline(self, pair):
+        a, b = pair
+
+        def slow(from_id, action, payload):
+            time.sleep(2.0)
+            return {}
+
+        b.register("b", slow)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectTransportError, match="timed out"):
+            a.send("a", "b", "x", {}, timeout_s=0.2)
+        assert time.monotonic() - t0 < 1.5
+        assert (
+            a.metrics.value(
+                "estpu_transport_send_timeouts_total",
+                transport="tcp",
+                node="a",
+            )
+            >= 1
+        )
+
+    def test_handshake_refuses_wrong_cluster(self, pair):
+        a, b = pair
+        book = a.book
+        rogue = TcpTransport("rogue", book, cluster_name="OTHER")
+        rogue.register("rogue", _echo)
+        try:
+            with pytest.raises(ConnectTransportError, match="refused"):
+                rogue.send("rogue", "b", "ping", {})
+            assert (
+                b.metrics.value(
+                    "estpu_transport_handshake_rejects_total", node="b"
+                )
+                >= 1
+            )
+        finally:
+            rogue.close()
+
+    def test_partial_frame_then_close_does_not_wedge_server(self, pair):
+        a, b = pair
+        # A client that dies mid-frame (half a length prefix + garbage).
+        raw = socket.create_connection(b.address)
+        raw.sendall(encode_frame({"_handshake": {
+            "cluster": "t", "version": 1, "node": "raw"}}))
+        read_frame(raw)  # handshake ok
+        raw.sendall(struct.pack(">I", 100) + b"half")
+        raw.close()
+        # The endpoint keeps serving everyone else.
+        assert a.send("a", "b", "ping", {})["echo"] == "ping"
+
+    def test_stale_pooled_connection_retries_fresh(self, pair):
+        a, b = pair
+        book = a.book
+        a.send("a", "b", "ping", {})  # pool a connection to b's OLD port
+        b.close(abrupt=True)
+        b2 = TcpTransport("b", book, cluster_name="t")  # restarted process
+        b2.register("b", _echo)
+        try:
+            # The pooled conn is dead; the send must fall back to a fresh
+            # dial against the re-published address and succeed.
+            assert a.send("a", "b", "ping", {})["echo"] == "ping"
+        finally:
+            b2.close()
+
+    def test_frames_counted_both_directions(self, pair):
+        a, b = pair
+        a.send("a", "b", "ping", {})
+        sent = a.metrics.value(
+            "estpu_transport_frames_total", node="a", dir="sent"
+        )
+        received = b.metrics.value(
+            "estpu_transport_frames_total", node="b", dir="received"
+        )
+        assert sent >= 1 and received >= 1
+
+
+class TestInterceptionParity:
+    """The MockTransportService surface behaves identically over sockets."""
+
+    def test_drop_action(self, pair):
+        a, b = pair
+        a.intercepts.drop_action("a", "b", "ping")
+        with pytest.raises(ConnectTransportError, match="dropped"):
+            a.send("a", "b", "ping", {})
+        assert a.send("a", "b", "other", {})["echo"] == "other"
+        a.intercepts.clear_drops()
+        assert a.send("a", "b", "ping", {})["echo"] == "ping"
+
+    def test_partition_and_heal(self, pair):
+        a, b = pair
+        a.intercepts.partition({"a"}, {"b"})
+        with pytest.raises(ConnectTransportError, match="unreachable"):
+            a.send("a", "b", "ping", {})
+        a.intercepts.heal_partition()
+        assert a.send("a", "b", "ping", {})["echo"] == "ping"
+
+    def test_injected_delay_respects_deadline(self, pair):
+        a, b = pair
+        a.intercepts.set_delay(5.0)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectTransportError, match="timed out"):
+            a.send("a", "b", "ping", {}, timeout_s=0.2)
+        assert time.monotonic() - t0 < 1.5
+        a.intercepts.set_delay(0.0)
+
+    def test_generic_transport_send_fault_site_fires_over_tcp(self, pair):
+        a, b = pair
+        REGISTRY.put(
+            FaultSpec(
+                site="transport.send.ping", error="transport", seed=1
+            )
+        )
+        with pytest.raises(ConnectTransportError, match="injected"):
+            a.send("a", "b", "ping", {})
+        REGISTRY.clear()
+        assert a.send("a", "b", "ping", {})["echo"] == "ping"
+
+    def test_tcp_frame_fault_resets_connection(self, pair):
+        a, b = pair
+        REGISTRY.put(
+            FaultSpec(site="transport.tcp.frame", error="transport", seed=2)
+        )
+        # The receiver tears the connection down mid-exchange; the sender
+        # observes it as a transport failure, never a hang.
+        with pytest.raises(ConnectTransportError):
+            a.send("a", "b", "ping", {}, timeout_s=2.0)
+        REGISTRY.clear()
+        assert a.send("a", "b", "ping", {})["echo"] == "ping"
+
+
+class TestHubDeadline:
+    """Satellite: the in-memory hub honors the same per-send deadline."""
+
+    def test_slow_handler_times_out(self):
+        hub = TransportHub(default_timeout_s=0.2)
+        hub.register("n", lambda f, a, p: time.sleep(5.0))
+        t0 = time.monotonic()
+        with pytest.raises(ConnectTransportError, match="timed out"):
+            hub.send("m", "n", "x", {})
+        assert time.monotonic() - t0 < 2.0
+        assert hub.stats()["send_timeouts"] == 1
+
+    def test_injected_delay_times_out(self):
+        hub = TransportHub(default_timeout_s=0.2)
+        hub.register("n", lambda f, a, p: {"ok": True})
+        hub.set_delay(5.0)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectTransportError, match="timed out"):
+            hub.send("m", "n", "x", {})
+        assert time.monotonic() - t0 < 2.0
+
+    def test_fast_handler_unaffected(self):
+        hub = TransportHub(default_timeout_s=5.0)
+        hub.register("n", lambda f, a, p: {"got": p})
+        assert hub.send("m", "n", "x", {"v": 1}) == {"got": {"v": 1}}
+
+    def test_gateway_clamp_applies_to_live_tcp_sends(self):
+        """The gateway clamps the HUB's default; TCP sends must resolve
+        against that live value, not the default each endpoint copied at
+        registration time — otherwise one wedged send outlives the
+        gateway's whole retry budget."""
+        from elasticsearch_tpu.cluster import ReplicationGateway
+
+        cluster = LocalCluster(2, transport="tcp")
+        try:
+            ReplicationGateway(cluster, timeout_s=0.3)
+            assert cluster.hub.default_timeout_s == 0.3
+            cluster.hub._endpoints["node-1"].register(
+                "node-1", lambda f, a, p: time.sleep(5.0)
+            )
+            t0 = time.monotonic()
+            with pytest.raises(ConnectTransportError, match="timed out"):
+                cluster.hub.send("node-0", "node-1", "ping", {})
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            cluster.close()
+
+
+class TestTraceOverTheWire:
+    def test_trace_context_survives_tcp(self):
+        from elasticsearch_tpu.obs.tracing import TRACER
+
+        cluster = LocalCluster(2, transport="tcp")
+        try:
+            cluster.create_index(
+                "tr", n_shards=1, n_replicas=1, mappings=MAPPINGS
+            )
+            cluster.any_node().execute_write("tr", "d1", {"body": "x"})
+            with TRACER.start_trace("test-root") as root:
+                trace_id = root.trace_id
+                cluster.nodes["node-1"].search(
+                    "tr", {"query": {"match_all": {}}}
+                )
+            spans = TRACER.get(trace_id) or []
+            names = {s.name for s in spans}
+            # The remote shard execution parented into the caller's trace
+            # via the `_trace` payload field riding the JSON frame.
+            assert any(n.startswith("transport.") for n in names), names
+            assert "cluster.shard_search" in names, names
+        finally:
+            cluster.close()
+
+
+class TestStatsSurface:
+    """Satellite contracts: swallowed stepper errors and the transport
+    layer are VISIBLE in `_nodes/stats`, never silent."""
+
+    def test_step_errors_and_transport_surface_in_nodes_stats(
+        self, monkeypatch
+    ):
+        import json as _json
+
+        from elasticsearch_tpu.rest.server import RestServer
+
+        monkeypatch.setenv("ESTPU_MESH_SERVING", "0")
+        monkeypatch.setenv("ESTPU_CLUSTER_TRANSPORT", "tcp")
+        server = RestServer(replication_nodes=2)
+        try:
+            # Wedge one node's control-plane step: the background stepper
+            # must keep running AND count every swallowed error.
+            def boom():
+                raise RuntimeError("wedged control plane")
+
+            monkeypatch.setattr(
+                server.cluster.nodes["node-1"], "check_recoveries", boom
+            )
+            deadline = time.monotonic() + 5.0
+            rep = None
+            while time.monotonic() < deadline:
+                status, stats = server.dispatch(
+                    "GET", "/_nodes/stats", {}, ""
+                )
+                assert status == 200
+                rep = next(iter(stats["nodes"].values()))["replication"]
+                if rep["step_errors"] >= 1:
+                    break
+                time.sleep(0.05)
+            assert rep is not None and rep["step_errors"] >= 1, rep
+            # Transport instruments ride the same stats surface.
+            transport = rep["transport"]
+            assert transport["kind"] == "tcp"
+            assert transport["connections"] >= 1
+            assert transport["frames"]["sent"] >= 1
+            # The cluster still serves through the wedged stepper.
+            status, _ = server.dispatch(
+                "PUT",
+                "/alive",
+                {},
+                _json.dumps({"mappings": MAPPINGS}),
+            )
+            assert status == 200
+        finally:
+            server.close()
+
+
+class TestTcpClusterSmoke:
+    """Trimmed tier-1 slice of the chaos contract over real sockets:
+    kill the primary-owning node, partition the master away — promotion
+    within the control rounds, zero acked-write loss."""
+
+    def test_kill_primary_promotion_no_acked_loss(self):
+        cluster = LocalCluster(3, transport="tcp")
+        try:
+            cluster.create_index(
+                "kp", n_shards=1, n_replicas=2, mappings=MAPPINGS
+            )
+            acked = []
+            for i in range(30):
+                resp = cluster.any_node().execute_write(
+                    "kp", f"k{i}", {"body": f"payload {i}"}
+                )
+                assert resp["result"] == "created"
+                acked.append(f"k{i}")
+            routing = cluster.any_node().state.indices["kp"].shards[0]
+            old_primary, old_term = routing.primary, routing.primary_term
+            cluster.kill(old_primary)
+            cluster.step()
+            survivor = cluster.any_node()
+            new_routing = survivor.state.indices["kp"].shards[0]
+            assert new_routing.primary not in (None, old_primary)
+            assert new_routing.primary_term == old_term + 1
+            for doc_id in acked:
+                assert survivor.get_doc("kp", doc_id) is not None, doc_id
+            out = survivor.search(
+                "kp", {"query": {"match_all": {}}, "size": 50}
+            )
+            assert out["hits"]["total"]["value"] == len(acked)
+            # Writes continue through the promoted primary.
+            resp = survivor.execute_write("kp", "after", {"body": "after"})
+            assert resp["result"] == "created"
+        finally:
+            cluster.close()
+
+    def test_partition_master_steps_down_and_heals(self):
+        cluster = LocalCluster(3, transport="tcp")
+        try:
+            cluster.create_index(
+                "pt", n_shards=1, n_replicas=2, mappings=MAPPINGS
+            )
+            acked = []
+            for i in range(10):
+                cluster.any_node().execute_write(
+                    "pt", f"p{i}", {"body": "x"}
+                )
+                acked.append(f"p{i}")
+            master = cluster.master()
+            others = {n for n in cluster.seeds if n != master.node_id}
+            cluster.hub.partition({master.node_id}, others)
+            master.health_round()  # loses quorum -> steps down
+            assert master.state.master is None
+            for n in others:
+                cluster.nodes[n].try_elect()
+            new_master = cluster.master()
+            assert new_master is not None
+            assert new_master.node_id in others
+            # Majority side serves every acked write through the split.
+            majority = cluster.nodes[sorted(others)[0]]
+            for doc_id in acked:
+                assert majority.get_doc("pt", doc_id) is not None
+            cluster.hub.heal_partition()
+            cluster.step()
+            cluster.step()
+            # Convergence: every node agrees on ONE elected master (the
+            # lowest-id candidate may legitimately retake mastership
+            # after healing).
+            masters = {
+                n.state.master
+                for n in cluster.nodes.values()
+                if not n.closed
+            }
+            assert len(masters) == 1 and None not in masters, masters
+        finally:
+            cluster.close()
+
+    def test_socket_unreachable_replica_failed_out_then_heals(self):
+        cluster = LocalCluster(3, transport="tcp")
+        try:
+            cluster.create_index(
+                "fo", n_shards=1, n_replicas=1, mappings=MAPPINGS
+            )
+            routing = cluster.any_node().state.indices["fo"].shards[0]
+            replica, primary = routing.replicas[0], routing.primary
+            cluster.hub.drop_action(primary, replica, "replica_op")
+            resp = cluster.any_node().execute_write(
+                "fo", "x1", {"body": "x"}
+            )
+            assert resp["result"] == "created"
+            routing = cluster.any_node().state.indices["fo"].shards[0]
+            assert replica not in routing.in_sync
+            cluster.hub.clear_drops()
+            cluster.step()
+            cluster.step()
+            routing = cluster.any_node().state.indices["fo"].shards[0]
+            assert replica in routing.in_sync
+        finally:
+            cluster.close()
