@@ -1176,7 +1176,7 @@ def bench_cfg3_conjunction(n_shards=8, shard_docs=125_000, n_q=32):
             [key for _g, _i, key in collect_cacheable_filters(query)]
         )
 
-        def build(child_spec, child_arrays):
+        def build(child_spec, child_arrays, _norm=None):
             plane = bm25_device.compute_filter_mask_stacked(
                 stacked, child_spec, child_arrays
             )
@@ -1733,7 +1733,7 @@ def bench_cfg8_filter_cache(segment, dev, seg_tree, mappings, n_q=48,
     for q in queries:
         cache.record([key for _g, _i, key in collect_cacheable_filters(q)])
 
-    def build(child_spec, child_arrays):
+    def build(child_spec, child_arrays, _norm=None):
         plane = bm25_device.compute_filter_mask(
             seg_tree, child_spec, child_arrays
         )
@@ -1797,6 +1797,194 @@ def bench_cfg8_filter_cache(segment, dev, seg_tree, mappings, n_q=48,
         "n_docs": int(seg_tree["live"].shape[0]),
         "n_queries": n_q,
         "n_hot_filters": n_hot,
+    }
+
+
+def bench_cfg10_ingest(n_docs=None, n_refreshes=40, n_q=16):
+    """ISSUE 12 config: sustained ingest-while-serving on a 100k-doc
+    shard — write cost must track the DELTA, not the shard.
+
+    A 100k-doc engine shard (vectorized corpus install) takes one-doc
+    writes + refreshes while a background thread serves a cfg3-style
+    query mix (bool: 2-term match must + range filter) with the filter
+    cache enabled. Measures refresh p50 (merges included — the tiered
+    policy fires as the 1-doc segments accumulate), per-refresh analysis
+    calls via the estpu_analysis_calls_total hook (MUST be 0: the
+    posting-concatenation merge never re-tokenizes; only the write
+    itself analyzes its own doc), and the warm filter-cache hit rate
+    across refreshes (uid-keyed planes of untouched segments keep
+    hitting). Parity gate: after quiescing, the multi-segment engine's
+    answers are bit-identical (ids + fp32 scores + totals) to a
+    single-segment oracle engine rebuilt from the concat merge of every
+    live doc."""
+    import os
+    import threading
+
+    from elasticsearch_tpu.analysis.analyzers import analysis_calls_total
+    from elasticsearch_tpu.index.engine import Engine
+    from elasticsearch_tpu.index.filter_cache import FilterCache
+    from elasticsearch_tpu.index.mapping import Mappings
+    from elasticsearch_tpu.index.merge import merged_live_segment
+    from elasticsearch_tpu.search.service import (
+        SearchRequest,
+        SearchService,
+    )
+    from elasticsearch_tpu.utils.corpus import (
+        build_zipf_segment,
+        pick_query_terms,
+    )
+
+    if n_docs is None:
+        n_docs = int(os.environ.get("ESTPU_BENCH_INGEST_N", 100_000))
+    rng = np.random.default_rng(53)
+    t0 = time.monotonic()
+    _, base_seg = build_zipf_segment(
+        n_docs, vocab_size=20_000, seed=29, with_sources=True
+    )
+    base_seg.doc_values["rank"] = rng.random(n_docs).astype(np.float64)
+    mappings = Mappings(
+        properties={"body": {"type": "text"}, "rank": {"type": "float"}}
+    )
+    engine = Engine(mappings, max_segments=10, merge_factor=8)
+    engine.restore_segments([(base_seg, np.ones(n_docs, dtype=bool))])
+    build_s = time.monotonic() - t0
+
+    cache = FilterCache(min_freq=1)
+    svc = SearchService(engine, filter_cache=cache)
+    term_sets = pick_query_terms(base_seg, rng, n_q)
+    requests = []
+    for terms in term_sets:
+        lo = float(rng.random() * 0.4)
+        requests.append(
+            {
+                "query": {
+                    "bool": {
+                        "must": [{"match": {"body": " ".join(terms[:2])}}],
+                        "filter": [
+                            {"range": {"rank": {"gte": lo, "lte": lo + 0.5}}},
+                            {"range": {"rank": {"gte": 0.0}}},
+                        ],
+                    }
+                },
+                "size": K,
+            }
+        )
+    # Warm the mix once (admission sightings + plane builds + compiles).
+    for body in requests:
+        svc.search(SearchRequest.from_json(body))
+
+    # ---- Ingest while serving -------------------------------------------
+    stop = threading.Event()
+    served = [0]
+    query_errors: list[str] = []
+
+    def query_loop():
+        qi = 0
+        while not stop.is_set():
+            try:
+                svc.search(SearchRequest.from_json(requests[qi % n_q]))
+                served[0] += 1
+            except Exception as e:  # staticcheck: ignore[broad-except] a dying query thread must be REPORTED (query_errors in the result), not silently end the concurrent load the config exists to measure
+                query_errors.append(f"{type(e).__name__}: {e}")
+                if len(query_errors) >= 5:
+                    return  # persistent failure: stop burning the loop
+            qi += 1
+
+    vocab = list(base_seg.fields["body"].terms)
+    refresh_times = []
+    hits0 = cache.stats()["hit_count"]
+    thread = threading.Thread(target=query_loop, daemon=True)
+    thread.start()
+    t_ingest = time.monotonic()
+    try:
+        for i in range(n_refreshes):
+            body_terms = [
+                str(t) for t in rng.choice(vocab, rng.integers(4, 12))
+            ]
+            engine.index(
+                {
+                    "body": " ".join(body_terms),
+                    "rank": float(rng.random()),
+                },
+                f"ingest{i}",
+            )
+            t0 = time.monotonic()
+            engine.refresh()
+            refresh_times.append(time.monotonic() - t0)
+    finally:
+        stop.set()
+        thread.join(timeout=30)
+    ingest_s = time.monotonic() - t_ingest
+    stats = cache.stats()
+    warm_hits = stats["hit_count"] - hits0
+    lookups = stats["hit_count"] + stats["miss_count"]
+
+    # ---- Quiesced probe: the acceptance-criterion shape -----------------
+    # One-doc write + refresh on the (now ~100k-doc) shard: the write
+    # analyzes its own fields; the refresh (buffer freeze + any merge)
+    # performs ZERO analysis calls.
+    a0 = analysis_calls_total()
+    engine.index({"body": "t1 t2 t3", "rank": 0.5}, "probe")
+    write_calls = analysis_calls_total() - a0
+    a1 = analysis_calls_total()
+    t0 = time.monotonic()
+    engine.refresh()
+    probe_refresh_ms = (time.monotonic() - t0) * 1e3
+    refresh_calls = analysis_calls_total() - a1
+
+    # ---- Zero-mismatch parity gate vs a quiesced oracle -----------------
+    # Oracle: a single-segment engine holding the concat merge of every
+    # live doc — multi-segment serving must be bit-identical to it.
+    merged = merged_live_segment(
+        [h.segment for h in engine.segments],
+        [h.live_host for h in engine.segments],
+    )
+    oracle_engine = Engine(mappings)
+    oracle_engine.restore_segments(
+        [(merged, np.ones(merged.num_docs, dtype=bool))]
+    )
+    oracle_svc = SearchService(oracle_engine)
+    mismatches = 0
+    for body in requests:
+        got = svc.search(SearchRequest.from_json(body))
+        want = oracle_svc.search(SearchRequest.from_json(body))
+        same = got.total == want.total and [
+            (h.doc_id, h.score) for h in got.hits
+        ] == [(h.doc_id, h.score) for h in want.hits]
+        if not same:
+            mismatches += 1
+    return {
+        "mismatches": mismatches,
+        "refresh_p50_ms": round(
+            float(np.median(refresh_times)) * 1e3, 3
+        ),
+        "refresh_p99_ms": round(
+            float(np.quantile(refresh_times, 0.99)) * 1e3, 3
+        ),
+        "quiesced_one_doc_refresh_ms": round(probe_refresh_ms, 3),
+        # The ISSUE 12 hook-counted acceptance: zero re-tokenization in
+        # refresh/merge; the write analyzes only its own doc.
+        "per_refresh_analysis_calls": refresh_calls,
+        "per_write_analysis_calls": write_calls,
+        "docs_per_s_indexed": round(n_refreshes / ingest_s, 2),
+        "queries_served_concurrently": served[0],
+        # Nonzero = the concurrent-load numbers above are suspect: the
+        # query thread hit errors (first few recorded verbatim).
+        "query_errors": len(query_errors),
+        "query_error_samples": query_errors[:3],
+        "filter_cache_hit_rate": (
+            round(stats["hit_count"] / lookups, 4) if lookups else 0.0
+        ),
+        "warm_hits_across_refreshes": warm_hits,
+        "merges": engine.merges_total,
+        "merge_docs_moved": engine.merge_docs_total,
+        "merge_ms_total": round(engine.merge_ms_total, 2),
+        "segments_after": len(engine.segments),
+        "n_docs": n_docs,
+        "n_refreshes": n_refreshes,
+        "n_queries": n_q,
+        "corpus_build_s": round(build_s, 1),
+        "path": "host",  # the mesh half is gated by tests/test_mesh_refresh.py
     }
 
 
@@ -2093,6 +2281,7 @@ def main():
             lambda: bench_cfg8_filter_cache(segment, dev, seg_tree, mappings),
         ),
         ("cfg9_ann", bench_cfg9_ann),
+        ("cfg10_ingest", bench_cfg10_ingest),
     ):
         try:
             configs[name] = fn()

@@ -23,6 +23,28 @@ from typing import Any, Callable, Iterable
 Token = str
 TokenFilter = Callable[[list[Token]], list[Token]]
 
+# Hook-counted analysis accounting: every tokenize/analyze invocation —
+# Python analyzer chains here, the native ASCII tokenizer at its
+# index/segment.py call site — increments this counter, so "the merge does
+# no re-tokenization" (index/merge.py, ROADMAP item 4) is a measured
+# invariant (tests/test_merge_concat.py, bench cfg10_ingest), not an
+# assertion by inspection. A module-global registry: analysis is
+# process-wide (analyzers are shared singletons), and the node merges this
+# registry into `GET /_metrics` / renders it under `_nodes/stats`
+# indices.analysis.
+from ..obs.metrics import MetricsRegistry as _MetricsRegistry
+
+ANALYSIS_METRICS = _MetricsRegistry()
+ANALYSIS_CALLS = ANALYSIS_METRICS.counter(
+    "estpu_analysis_calls_total",
+    "Tokenize/analyze entry-point invocations (index + query time)",
+)
+
+
+def analysis_calls_total() -> int:
+    """Current analysis-call count (test/bench hook)."""
+    return int(ANALYSIS_CALLS.value)
+
 # Unicode word pattern: letters/digits/underscore runs. Lucene's standard
 # tokenizer splits on punctuation and whitespace and keeps numerics.
 _WORD_RE = re.compile(r"[\w]+", re.UNICODE)
@@ -44,6 +66,7 @@ class Analyzer:
     filters: list[TokenFilter] = field(default_factory=list)
 
     def analyze(self, text: str) -> list[Token]:
+        ANALYSIS_CALLS.inc()
         tokens = self.tokenizer(text)
         for f in self.filters:
             tokens = f(tokens)
@@ -87,6 +110,7 @@ class Analyzer:
         exactly like Lucene's StopFilter keeps increments. The span is the
         tokenizer's position count (for multi-value position offsets).
         """
+        ANALYSIS_CALLS.inc()
         tokens = self.tokenizer(text)
         pairs = self._carry_filters([(t, i) for i, t in enumerate(tokens)])
         return pairs, len(tokens)
@@ -95,6 +119,7 @@ class Analyzer:
         """(token, char_start, char_end) triples — the highlighter's view
         (Lucene's OffsetAttribute). Offsets always reference the ORIGINAL
         text even through token-mapping filters."""
+        ANALYSIS_CALLS.inc()
         spans = _TOKENIZER_SPANS.get(self.tokenizer)
         if spans is None:  # unknown tokenizer: no offset support
             return []

@@ -32,8 +32,9 @@ partitions are cached per (engine uid, segment-handle uid, field).
 Segment postings/vectors are immutable, so a handle uid alone scopes
 validity: a refresh gives NEW segments fresh handles (their partitions
 build on first kNN query), unchanged segments keep hitting, and
-merged-away segments' planes are pruned eagerly via `live_uids` on the
-next store. Soft-deletes need no invalidation — partitions exclude the
+merged-away segments' planes are pruned eagerly — via `live_uids` on the
+next store, and by the node's refresh/force-merge paths via
+`prune_dead`. Soft-deletes need no invalidation — partitions exclude the
 live mask, which ANDs in at query time.
 
 A segment below `min_docs` (ESTPU_ANN_MIN_DOCS, default 4096) is not
@@ -444,6 +445,22 @@ class AnnCache:
             self.breaker.release(parts.nbytes)
         self._evictions.inc()
         return parts.nbytes
+
+    def prune_dead(self, engine_uid, live_uids) -> int:
+        """Eagerly drop planes of `engine_uid` whose segment handle is no
+        longer live (merged away) — the refresh/force-merge hook (the
+        filter cache's prune_dead twin), so dead IVF planes free their
+        HBM without waiting for the next store. Returns the number
+        dropped."""
+        with self._lock:
+            dead = [
+                k
+                for k in self._entries
+                if k[0] == engine_uid and k[1] not in live_uids
+            ]
+            for k in dead:
+                self._drop_locked(k)
+            return len(dead)
 
     def clear(self, engine_uid=None) -> int:
         """Drop planes (all, or one engine's — index delete / cache

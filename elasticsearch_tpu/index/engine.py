@@ -44,6 +44,7 @@ from ..ops.bm25 import BM25Params
 from ..query.compile import Compiler, FieldStats, aggregate_field_stats
 from . import store
 from .mapping import Mappings
+from .merge import merged_live_segment
 from .segment import Segment, SegmentBuilder
 from .tiles import (
     DeviceSegment,
@@ -113,6 +114,12 @@ class SegmentHandle:
     # correct, since those clones share the SAME immutable postings and
     # doc-values planes, so cached masks stay valid for them.
     uid: int = dc_field(default_factory=lambda: next(_HANDLE_UIDS))
+    # Monotonic epoch of the DEVICE-visible live mask: bumps on every
+    # sync_live upload. (uid, live_epoch) identifies the searchable
+    # content of this handle exactly — the mesh view keys its per-handle
+    # compaction pieces and per-shard filter-cache rows on it, so a
+    # refresh that only touches OTHER handles leaves them warm.
+    live_epoch: int = 0
     _id_index: dict[str, int] | None = None  # lazy _id -> local (ids query)
 
     @property
@@ -133,6 +140,7 @@ class SegmentHandle:
 
             self.device.live = jax.device_put(self.live_host.copy())
             self.live_dirty = False
+            self.live_epoch += 1
 
     @property
     def live_count(self) -> int:
@@ -152,6 +160,7 @@ class Engine:
         max_segments: int = 10,
         merge_factor: int = 8,
         breaker=None,  # common.breaker.CircuitBreaker (HBM accounting)
+        metrics=None,  # obs.metrics.MetricsRegistry (refresh/merge counters)
     ):
         self.mappings = mappings or Mappings()
         self.params = params
@@ -163,6 +172,16 @@ class Engine:
         self.max_segments = max(1, int(max_segments))
         self.merge_factor = max(2, int(merge_factor))
         self.breaker = breaker
+        self.metrics = metrics
+        # Refresh/merge accounting (the reference's RefreshStats /
+        # MergeStats): plain ints read by `_stats`/`_nodes/stats`, mirrored
+        # onto the node registry (estpu_refresh_* / estpu_merge_*) when one
+        # is wired.
+        self.refresh_total = 0
+        self.refresh_ms_total = 0.0
+        self.merges_total = 0
+        self.merge_docs_total = 0
+        self.merge_ms_total = 0.0
         # Process-unique engine id: filter-cache key component + the
         # per-index clear handle (`POST /{index}/_cache/clear`).
         self.uid = next(_ENGINE_UIDS)
@@ -584,6 +603,26 @@ class Engine:
         dropped rather than indexed-then-masked (the reference achieves the
         same via the version map + Lucene delete-by-term on flush).
         """
+        t0 = time.monotonic()
+        # Completed refreshes only (the reference RefreshStats contract):
+        # a refresh that raises (e.g. the HBM breaker rejecting the pack)
+        # must not inflate the totals the bench p50s are built on.
+        out = self._refresh_locked()
+        elapsed_ms = (time.monotonic() - t0) * 1e3
+        self.refresh_total += 1
+        self.refresh_ms_total += elapsed_ms
+        if self.metrics is not None:
+            self.metrics.counter(
+                "estpu_refresh_total",
+                "Engine refreshes (buffer freeze + live-mask syncs)",
+            ).inc()
+            self.metrics.counter(
+                "estpu_refresh_ms_total",
+                "Wall-clock ms spent in engine refreshes",
+            ).inc(elapsed_ms)
+        return out
+
+    def _refresh_locked(self) -> bool:
         with self.lock:
             changed = False
             for handle in self.segments:
@@ -725,25 +764,23 @@ class Engine:
         segment, placed at the first merged position.
 
         Like a Lucene merge, deleted docs are purged — their postings leave
-        the term statistics — and doc ids are renumbered. Callers hold the
-        engine lock. Scroll snapshots are unaffected: they hold frozen
-        handle clones and this replaces the engine's segment LIST."""
+        the term statistics — and doc ids are renumbered. The merge is pure
+        posting concatenation (index/merge.py): term dictionaries union,
+        doc ids renumber via cumulative live-doc offsets, stats fold
+        arithmetically — NO document is re-analyzed (hook-counted via
+        estpu_analysis_calls_total), so merge cost is array I/O like a
+        Lucene SegmentMerger pass, not a tokenizer pass over the shard.
+        Callers hold the engine lock. Scroll snapshots are unaffected:
+        they hold frozen handle clones and this replaces the engine's
+        segment LIST."""
         if len(indices) < 2:
             return
+        t0 = time.monotonic()
         merge_set = set(indices)
-        builder = SegmentBuilder(self.mappings)
-        for idx in indices:
-            handle = self.segments[idx]
-            for local in np.flatnonzero(handle.live_host):
-                local = int(local)
-                seg = handle.segment
-                builder.add(
-                    seg.sources[local],
-                    seg.ids[local],
-                    version=seg.doc_version(local),
-                    seqno=seg.doc_seqno(local),
-                )
-        merged_segment = builder.build()
+        merged_segment = merged_live_segment(
+            [self.segments[idx].segment for idx in indices],
+            [self.segments[idx].live_host for idx in indices],
+        )
         merged_device, merged_nbytes = self._pack_accounted(merged_segment)
         if self.breaker is not None:
             # The merged-away segments' device arrays become garbage once
@@ -786,6 +823,23 @@ class Engine:
         self.segments = rebased
         self._stats_cache = None
         self.generation += 1
+        elapsed_ms = (time.monotonic() - t0) * 1e3
+        self.merges_total += 1
+        self.merge_docs_total += merged_segment.num_docs
+        self.merge_ms_total += elapsed_ms
+        if self.metrics is not None:
+            self.metrics.counter(
+                "estpu_merge_total",
+                "Segment merges (posting-concatenation compactions)",
+            ).inc()
+            self.metrics.counter(
+                "estpu_merge_docs_moved_total",
+                "Live docs moved into merged segments",
+            ).inc(merged_segment.num_docs)
+            self.metrics.counter(
+                "estpu_merge_ms_total",
+                "Wall-clock ms spent in segment merges",
+            ).inc(elapsed_ms)
 
     def flush(self) -> dict:
         """Refresh, persist segments + live masks, commit, trim the translog.

@@ -23,12 +23,19 @@ Three Lucene-shaped policies, adapted to HBM:
   stale plane can never be served, while planes of UNCHANGED segments
   keep hitting across refreshes (keying on the engine generation would
   zero the hit rate under live write traffic); planes of merged-away
-  segments are pruned eagerly on the next store. The mesh path keys
-  (("sharded", engine-uid tuple), generation sum, 0, key) instead: its
-  stacked planes die wholesale on any refresh, so generation IS the
-  invalidator there (stale generations purged eagerly on store).
-  Soft-deletes need no invalidation at all: planes exclude the live mask,
-  which ANDs in at query time exactly as for recomputed filters.
+  segments are pruned eagerly on the next store (and on refresh via
+  `prune_dead`). The mesh path keys per SHARD ROW:
+  (("sharded", engine-uid tuple), ("row", shard, shard-signature,
+  docs-pad), 0, key) where the shard signature is the tuple of
+  (handle uid, live epoch) — so a refresh of one shard invalidates only
+  that shard's row and unchanged shards' rows keep hitting
+  (parallel/mesh_serving.MeshIndex._apply_filter_cache); the [S, N]
+  stacked view re-assembles zero-copy from the rows per request and is
+  never cached itself (it shares the rows' buffers — caching it would
+  pin HBM past the rows' eviction). Dead signatures purge eagerly on
+  snapshot change (`purge_scope`). Soft-deletes need no invalidation at all: planes
+  exclude the live mask, which ANDs in at query time exactly as for
+  recomputed filters.
 
 Bit-exactness is the contract (tests/test_filter_cache.py fuzz): a plane
 IS the filter subtree's own evaluation, and filter context discards
@@ -212,14 +219,22 @@ class FilterCache:
                 self._prune_dead_handles_locked(key[0], live_uids, key)
             return True
 
-    def _evict_lru_locked(self) -> int:
-        """Evict the LRU plane; returns its byte size."""
-        _key, (_plane, nbytes) = self._entries.popitem(last=False)
+    def _drop_locked(self, key: tuple) -> int:
+        """Unlink one entry: bytes, breaker reservation, eviction count.
+        The SINGLE accounting site every eviction path goes through
+        (LRU, stale-generation purge, dead-handle prunes, scope purges,
+        clears) — a missed copy here would silently corrupt byte/breaker
+        accounting. Returns the entry's byte size."""
+        _plane, nbytes = self._entries.pop(key)
         self._bytes -= nbytes
         if self.breaker is not None:
             self.breaker.release(nbytes)
         self._evictions.inc()
         return nbytes
+
+    def _evict_lru_locked(self) -> int:
+        """Evict the LRU plane; returns its byte size."""
+        return self._drop_locked(next(iter(self._entries)))
 
     def _purge_stale_locked(self, fresh_key: tuple) -> None:
         """Drop same-engine/same-segment-scope entries whose generation
@@ -235,11 +250,7 @@ class FilterCache:
             and k[1] < generation
         ]
         for k in stale:
-            _plane, nbytes = self._entries.pop(k)
-            self._bytes -= nbytes
-            if self.breaker is not None:
-                self.breaker.release(nbytes)
-            self._evictions.inc()
+            self._drop_locked(k)
 
     def _prune_dead_handles_locked(
         self, scope, live_uids, fresh_key: tuple
@@ -253,11 +264,37 @@ class FilterCache:
             if k[0] == scope and k != fresh_key and k[2] not in live_uids
         ]
         for k in dead:
-            _plane, nbytes = self._entries.pop(k)
-            self._bytes -= nbytes
-            if self.breaker is not None:
-                self.breaker.release(nbytes)
-            self._evictions.inc()
+            self._drop_locked(k)
+
+    def prune_dead(self, scope, live_uids) -> int:
+        """Eagerly drop every plane of `scope` whose segment-handle uid
+        (key[2]) is no longer live — the refresh/force-merge hook that
+        frees merged-away segments' HBM without waiting for the next
+        store. Returns the number of planes dropped."""
+        with self._lock:
+            dead = [
+                k
+                for k in self._entries
+                if k[0] == scope and k[2] != 0 and k[2] not in live_uids
+            ]
+            for k in dead:
+                self._drop_locked(k)
+            return len(dead)
+
+    def purge_scope(self, scope, keep) -> int:
+        """Drop every `scope` entry whose signature component (key[1]) is
+        not in `keep` — the mesh view's eager invalidation on snapshot
+        change: dead rows free their HBM now, live rows (unchanged
+        shards) survive and keep hitting. Returns the number dropped."""
+        with self._lock:
+            stale = [
+                k
+                for k in self._entries
+                if k[0] == scope and k[1] not in keep
+            ]
+            for k in stale:
+                self._drop_locked(k)
+            return len(stale)
 
     def note_reuse(self, n: int) -> None:
         """Count `n` cached planes substituted into one launch."""
@@ -273,11 +310,7 @@ class FilterCache:
             else:
                 keys = [k for k in self._entries if k[0] == scope]
             for k in keys:
-                _plane, nbytes = self._entries.pop(k)
-                self._bytes -= nbytes
-                if self.breaker is not None:
-                    self.breaker.release(nbytes)
-                self._evictions.inc()
+                self._drop_locked(k)
             return len(keys)
 
     def keys(self) -> list[tuple]:
@@ -392,14 +425,17 @@ def apply_cached_masks(
     const_fill: Callable[[], dict] | None = None,
     entries: list | None = None,
     live_uids=None,
+    store_planes: bool = True,
 ):
     """Substitute cached mask planes for this plan's cacheable top-level
     filter-context clauses.
 
     `key_prefix` scopes the cache key (single-segment: (engine uid, 0,
-    handle uid); mesh: (("sharded", engine-uid tuple), sum(gens), 0));
-    `build_mask(child_spec, child_arrays) -> (plane, nbytes)` evaluates a
-    missing plane (called OUTSIDE the cache lock — it launches a kernel);
+    handle uid); unused under `store_planes=False`, where the builder
+    keys its own sub-planes); `build_mask(child_spec, child_arrays,
+    norm_key) -> (plane, nbytes)` evaluates a missing plane (called
+    OUTSIDE the cache lock — it launches a kernel; `norm_key` is the
+    clause's canonical key so row-granular builders can key sub-planes);
     `const_fill()` builds the substituted clause's replacement arrays
     (default: a scalar zero boost — the sharded path supplies a
     per-shard-stacked one so every plan leaf keeps its leading axis).
@@ -456,13 +492,21 @@ def apply_cached_masks(
             # Unmapped-field filters: free to evaluate, and skipping them
             # keeps a later mapping addition from pinning a stale plane.
             continue
-        key = (*key_prefix, norm)
-        plane = cache.get(key)
+        # store_planes=False (mesh row mode): the built plane is a
+        # zero-copy ASSEMBLY over per-row cache entries the builder
+        # manages itself — caching the assembled view here would pin the
+        # rows' device buffers past their own eviction (HBM the breaker
+        # thinks was freed), so it is rebuilt per request instead (a
+        # metadata-only operation).
+        plane = cache.get((*key_prefix, norm)) if store_planes else None
         if plane is None:
             if not cache.should_admit(norm):
                 continue
-            plane, nbytes = build_mask(child_spec, children[flat])
-            cache.put(key, plane, nbytes, live_uids=live_uids)
+            plane, nbytes = build_mask(child_spec, children[flat], norm)
+            if store_planes:
+                cache.put(
+                    (*key_prefix, norm), plane, nbytes, live_uids=live_uids
+                )
         else:
             reused += 1
         masks[slot] = plane

@@ -26,6 +26,7 @@ from typing import Any
 
 import numpy as np
 
+from ..analysis.analyzers import ANALYSIS_CALLS
 from ..native import NativeAccumulator, tokenize_ascii
 from ..native import available as native_available
 from ..utils import smallfloat
@@ -396,6 +397,11 @@ class SegmentBuilder:
                 if use_native:
                     r = tokenize_ascii(str(v))
                     if r is not None:  # ASCII fast path, C++ tokenizer
+                        # The native tokenizer is an analysis entry point
+                        # too — hook-count it like Analyzer.analyze so the
+                        # "no re-tokenization in merge" invariant covers
+                        # both build paths.
+                        ANALYSIS_CALLS.inc()
                         buf, offs = r
                         n = len(offs) - 1
                         total_len += n

@@ -311,6 +311,201 @@ def pack_field(
     )
 
 
+def _padded_equal(a: np.ndarray, b: np.ndarray, fill) -> bool:
+    """Equal once both are padded with `fill` to a common length (the
+    pack always pads per-doc planes to the shared doc capacity, so two
+    host arrays produce identical DEVICE planes iff they agree where
+    they overlap and the longer one's tail is all `fill`). `fill` of
+    NaN compares tails with isnan; 2-D arrays compare per row."""
+    if len(a) == len(b):
+        if a.dtype.kind == "f" or b.dtype.kind == "f":
+            return np.array_equal(a, b, equal_nan=True)
+        return np.array_equal(a, b)
+    short, longer = (a, b) if len(a) < len(b) else (b, a)
+    head, tail = longer[: len(short)], longer[len(short) :]
+    if a.dtype.kind == "f" or b.dtype.kind == "f":
+        if not np.array_equal(head, short, equal_nan=True):
+            return False
+    elif not np.array_equal(head, short):
+        return False
+    if isinstance(fill, float) and np.isnan(fill):
+        return bool(np.all(np.isnan(tail)))
+    return bool(np.all(tail == fill))
+
+
+def _field_plane_reusable(
+    fld: FieldIndex,
+    prev_fld: FieldIndex | None,
+    prev_dev: DeviceField | None,
+    avgdl: float,
+    k1: float,
+    b: float,
+) -> bool:
+    """May `prev_dev`'s device planes serve `fld` unchanged?
+
+    True only when every host array that feeds the pack produces
+    byte-identical device planes AND the precomputed-impact scope
+    (avgdl, k1, b) matches. Postings/positions must match exactly;
+    per-doc planes (norms, presence) may differ by an all-empty tail —
+    the pack zero-pads them to the shared doc capacity anyway, so a
+    freshly appended doc that does NOT carry this field leaves the
+    packed planes bit-identical. Device arrays are immutable, so sharing
+    them with a previous snapshot is safe (the same contract as
+    dataclasses.replace handle clones)."""
+    if prev_fld is None or prev_dev is None:
+        return False
+    if (
+        prev_dev.tn_avgdl != float(avgdl)
+        or prev_dev.tn_k1 != k1
+        or prev_dev.tn_b != b
+        or fld.has_norms != prev_fld.has_norms
+    ):
+        return False
+    if fld.terms != prev_fld.terms:
+        return False
+    for attr in ("df", "offsets", "doc_ids", "tfs"):
+        if not np.array_equal(getattr(fld, attr), getattr(prev_fld, attr)):
+            return False
+    if not _padded_equal(fld.norm_bytes, prev_fld.norm_bytes, 0):
+        return False
+    from .merge import _field_present
+
+    if not _padded_equal(
+        _field_present(fld), _field_present(prev_fld), False
+    ):
+        return False
+    if (fld.positions is None) != (prev_fld.positions is None):
+        return False
+    if fld.positions is not None and not (
+        np.array_equal(fld.pos_offsets, prev_fld.pos_offsets)
+        and np.array_equal(fld.positions, prev_fld.positions)
+    ):
+        return False
+    return True
+
+
+def pack_segment_delta(
+    segment: Segment,
+    prev_segment: Segment | None,
+    prev_device: DeviceSegment | None,
+    device=None,
+    pad_docs_to: int = 0,
+    field_min_tiles: dict[str, int] | None = None,
+    field_avgdl: dict[str, float] | None = None,
+    k1: float = 1.2,
+    b: float = 0.75,
+    field_pos_min_tiles: dict[str, int] | None = None,
+) -> tuple[DeviceSegment, int, int]:
+    """pack_segment with per-plane upload skipping against a previous pack.
+
+    The delta-scaled refresh's device half (mesh_serving.MeshView): after
+    an append-only refresh, most fields' merged postings are byte-identical
+    to the previous snapshot's, so their device planes (doc_ids/tfs/tn/
+    norms/ordinals/positions) are REUSED rather than re-uploaded — only
+    fields the delta actually touched repack, plus the per-segment live
+    mask (always fresh: deletions move it). Callers must pass prev_*
+    packed under the SAME padded shapes (pad_docs_to / min-tile maps);
+    shape growth forces a full pack upstream. Returns
+    (device segment, planes reused, planes packed). Nested blocks never
+    take this path (the mesh excludes them)."""
+    if prev_segment is None or prev_device is None or segment.nested:
+        dev = pack_segment(
+            segment,
+            device,
+            pad_docs_to=pad_docs_to,
+            field_min_tiles=field_min_tiles,
+            field_avgdl=field_avgdl,
+            k1=k1,
+            b=b,
+            field_pos_min_tiles=field_pos_min_tiles,
+        )
+        return dev, 0, len(dev.fields) + len(dev.doc_values) + len(dev.vectors)
+    n = max(segment.num_docs, pad_docs_to)
+    if prev_device.num_docs != n:
+        dev = pack_segment(
+            segment,
+            device,
+            pad_docs_to=pad_docs_to,
+            field_min_tiles=field_min_tiles,
+            field_avgdl=field_avgdl,
+            k1=k1,
+            b=b,
+            field_pos_min_tiles=field_pos_min_tiles,
+        )
+        return dev, 0, len(dev.fields) + len(dev.doc_values) + len(dev.vectors)
+    put = lambda x: jax.device_put(x, device)
+    min_tiles = field_min_tiles or {}
+    avgdls = field_avgdl or {}
+    pos_min_tiles = field_pos_min_tiles or {}
+    reused = 0
+    packed = 0
+    fields: dict[str, DeviceField] = {}
+    for name, f in segment.fields.items():
+        avgdl = avgdls.get(name)
+        if avgdl is None:
+            avgdl = f.avgdl
+        prev_dev = prev_device.fields.get(name)
+        if _field_plane_reusable(
+            f, prev_segment.fields.get(name), prev_dev, avgdl, k1, b
+        ):
+            fields[name] = prev_dev
+            reused += 1
+        else:
+            fields[name] = pack_field(
+                f,
+                n,
+                device,
+                min_tiles.get(name, 0),
+                avgdl,
+                k1,
+                b,
+                pos_min_tiles.get(name, 0),
+            )
+            packed += 1
+    doc_values: dict[str, jax.Array] = {}
+    for name, col in segment.doc_values.items():
+        prev_col = prev_segment.doc_values.get(name)
+        if prev_col is not None and _padded_equal(col, prev_col, np.nan):
+            doc_values[name] = prev_device.doc_values[name]
+            reused += 1
+        else:
+            padded = np.full(n, np.nan, dtype=np.float32)
+            padded[: len(col)] = col.astype(np.float32)
+            doc_values[name] = put(padded)
+            packed += 1
+    vectors: dict[str, jax.Array] = {}
+    for name, mat in segment.vectors.items():
+        prev_mat = prev_segment.vectors.get(name)
+        if (
+            prev_mat is not None
+            and mat.shape[1] == prev_mat.shape[1]
+            and _padded_equal(mat, prev_mat, 0.0)
+        ):
+            vectors[name] = prev_device.vectors[name]
+            reused += 1
+        else:
+            padded = np.zeros((n, mat.shape[1]), dtype=np.float32)
+            padded[: len(mat)] = mat
+            vectors[name] = put(padded)
+            packed += 1
+    live = np.zeros(n, dtype=bool)
+    live[: segment.num_docs] = True
+    return (
+        DeviceSegment(
+            num_docs=n,
+            fields=fields,
+            doc_values=doc_values,
+            vectors=vectors,
+            live=put(live),
+            sources=segment.sources,
+            ids=segment.ids,
+            nested={},
+        ),
+        reused,
+        packed,
+    )
+
+
 def repack_tn(
     dfield: DeviceField, field: FieldIndex, avgdl: float, k1: float, b: float
 ) -> None:

@@ -510,6 +510,7 @@ class Node:
                     max_segments=int(merge_cfg.get("max_segment_count", 10)),
                     merge_factor=int(merge_cfg.get("merge_factor", 8)),
                     breaker=self.breaker,
+                    metrics=self.metrics,
                 )
             )
         search: SearchService | ShardedSearchCoordinator
@@ -2803,8 +2804,20 @@ class Node:
             self.replication.refresh(svc.name)
         for engine in svc.engines:
             engine.refresh()
+        self._prune_dead_cache_planes(svc)
         n = svc.n_shards
         return {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+    def _prune_dead_cache_planes(self, svc) -> None:
+        """Eagerly drop filter/ANN planes of segment handles a refresh or
+        merge just retired — merged-away uids can never be looked up
+        again, so their HBM frees now instead of on the next store."""
+        for engine in svc.engines:
+            live = frozenset(h.uid for h in engine.segments)
+            if self.filter_cache is not None:
+                self.filter_cache.prune_dead(engine.uid, live)
+            if self.ann_cache is not None:
+                self.ann_cache.prune_dead(engine.uid, live)
 
     def flush(self, index: str) -> dict:
         svc = self.get_index(index)
@@ -2819,6 +2832,7 @@ class Node:
         for engine in svc.engines:
             out = engine.force_merge(max_num_segments)
             total_segments += out["num_segments"]
+        self._prune_dead_cache_planes(svc)
         n = svc.n_shards
         return {
             "_shards": {"total": n, "successful": n, "failed": 0},
@@ -3515,8 +3529,12 @@ class Node:
     def metrics_text(self) -> str:
         """GET /_metrics — Prometheus text exposition: this node's
         registry merged with the replication gateway's and every live
-        cluster node's (their series carry distinguishing labels)."""
-        others = []
+        cluster node's (their series carry distinguishing labels), plus
+        the process-wide analysis registry
+        (estpu_analysis_calls_total)."""
+        from .analysis.analyzers import ANALYSIS_METRICS
+
+        others = [ANALYSIS_METRICS]
         if self.replication is not None:
             gw_metrics = getattr(self.replication, "metrics", None)
             if gw_metrics is not None and gw_metrics is not self.metrics:
@@ -3719,6 +3737,25 @@ class Node:
             )
         }
 
+    def _refresh_merge_stats(self, engines) -> tuple[dict, dict]:
+        """(refresh, merges) stats blocks over a set of engines — the
+        reference's RefreshStats/MergeStats shapes, fed by the engine's
+        posting-concatenation merge accounting."""
+        refresh = {
+            "total": sum(e.refresh_total for e in engines),
+            "total_time_in_millis": int(
+                sum(e.refresh_ms_total for e in engines)
+            ),
+        }
+        merges = {
+            "total": sum(e.merges_total for e in engines),
+            "total_docs": sum(e.merge_docs_total for e in engines),
+            "total_time_in_millis": int(
+                sum(e.merge_ms_total for e in engines)
+            ),
+        }
+        return refresh, merges
+
     def nodes_stats(self) -> dict:
         """GET /_nodes/stats — serving-resilience counters: SPMD mesh
         circuit-breaker state and disable/re-enable events per index, plus
@@ -3737,6 +3774,7 @@ class Node:
                 **breaker,
                 "served": mv.served,
                 "packs": mv.packs,
+                "segment_reuses": mv.seg_reuses,
                 "rebuilds": mv.rebuilds,
                 "exec_failures": mv.exec_failures,
                 # Host-loop fallbacks by reason (estpu_mesh_fallback_total
@@ -3745,6 +3783,18 @@ class Node:
                     k: v for k, v in sorted(mv.fallbacks.items())
                 },
             }
+        from .analysis.analyzers import analysis_calls_total
+
+        all_engines = [
+            e for svc in self.indices.values() for e in svc.engines
+        ]
+        refresh_stats, merge_stats = self._refresh_merge_stats(all_engines)
+        merge_stats["mesh_segments_packed"] = int(
+            self.metrics.value("estpu_mesh_segments_packed_total")
+        )
+        merge_stats["mesh_segments_reused"] = int(
+            self.metrics.value("estpu_mesh_segments_reused_total")
+        )
         node_stats: dict[str, Any] = {
             "name": self.node_name,
             "indices": {
@@ -3753,6 +3803,17 @@ class Node:
                         self._docs_count(svc)
                         for svc in self.indices.values()
                     )
+                },
+                # Refresh/merge accounting (RefreshStats/MergeStats
+                # analog): merges are posting-concatenation compactions —
+                # estpu_refresh_*/estpu_merge_* views.
+                "refresh": refresh_stats,
+                "merges": merge_stats,
+                # Analysis-call accounting: the hook behind the
+                # "merges never re-tokenize" invariant
+                # (estpu_analysis_calls_total view).
+                "analysis": {
+                    "analysis_calls_total": analysis_calls_total()
                 },
                 # Shard request cache hit/miss/eviction counters
                 # (indices/IndicesRequestCache stats analog).
@@ -3842,6 +3903,28 @@ class Node:
         }
 
     def stats(self) -> dict:
+        all_engines = [
+            e for s in self.indices.values() for e in s.engines
+        ]
+        all_refresh, all_merges = self._refresh_merge_stats(all_engines)
+
+        def _index_primaries(svc) -> dict:
+            refresh, merges = self._refresh_merge_stats(svc.engines)
+            return {
+                "docs": {"count": svc.num_docs},
+                "segments": {
+                    "count": sum(len(e.segments) for e in svc.engines),
+                    "device_memory_in_bytes": sum(
+                        e.device_bytes for e in svc.engines
+                    ),
+                },
+                # Reference-style refresh/merges blocks (_stats):
+                # merges move docs by posting concatenation, never
+                # through the analysis chain.
+                "refresh": refresh,
+                "merges": merges,
+            }
+
         return {
             "_all": {
                 "primaries": {
@@ -3861,23 +3944,13 @@ class Node:
                             for e in s.engines
                         ),
                     },
+                    "refresh": all_refresh,
+                    "merges": all_merges,
                 }
             },
             "breakers": {"hbm": self.breaker.stats()},
             "indices": {
-                name: {
-                    "primaries": {
-                        "docs": {"count": svc.num_docs},
-                        "segments": {
-                            "count": sum(
-                                len(e.segments) for e in svc.engines
-                            ),
-                            "device_memory_in_bytes": sum(
-                                e.device_bytes for e in svc.engines
-                            ),
-                        },
-                    }
-                }
+                name: {"primaries": _index_primaries(svc)}
                 for name, svc in self.indices.items()
             },
         }

@@ -384,6 +384,26 @@ CATALOG = {
     # non_uniform_plan, execute_error) — a silent mesh decline is a bug.
     "estpu_mesh_served_total": ("counter", "mesh_serving"),
     "estpu_mesh_fallback_total": ("counter", "mesh_serving"),
+    # Delta-scaled refresh (ROADMAP item 4): shard segments re-packed vs
+    # served from unchanged buffers per mesh refresh, and device planes
+    # re-uploaded vs shared with the previous snapshot (field-granular
+    # upload skipping in tiles.pack_segment_delta).
+    "estpu_mesh_segments_packed_total": ("counter", "mesh_serving"),
+    "estpu_mesh_segments_reused_total": ("counter", "mesh_serving"),
+    "estpu_mesh_field_planes_packed_total": ("counter", "mesh_serving"),
+    "estpu_mesh_field_planes_reused_total": ("counter", "mesh_serving"),
+    # Engine refresh/merge accounting (index/engine.py; the reference's
+    # RefreshStats/MergeStats): totals + wall-clock ms + docs moved by
+    # posting-concatenation merges.
+    "estpu_refresh_total": ("counter", "indices.refresh"),
+    "estpu_refresh_ms_total": ("counter", "indices.refresh"),
+    "estpu_merge_total": ("counter", "indices.merges"),
+    "estpu_merge_docs_moved_total": ("counter", "indices.merges"),
+    "estpu_merge_ms_total": ("counter", "indices.merges"),
+    # Analysis-call accounting (analysis/analyzers.py): every tokenize/
+    # analyze invocation — the hook that makes "merges never re-tokenize"
+    # a measured invariant (tests/test_merge_concat.py, cfg10_ingest).
+    "estpu_analysis_calls_total": ("counter", "indices.analysis"),
     # Filter/bitset cache (index/filter_cache.py): device-resident mask
     # planes for repeated filter-context subtrees — the IndicesQueryCache
     # analog, surfaced under `_nodes/stats` indices.filter_cache.

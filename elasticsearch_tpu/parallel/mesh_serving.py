@@ -15,18 +15,36 @@ Design:
 - `MeshView` maintains a device-resident "searchable snapshot" of the
   index: one merged segment per shard (its engine's live docs, in
   host-path global-doc order), packed onto that shard's mesh device.
-- **Incremental refresh**: per-shard buffers are keyed by the engine's
-  monotonic refresh `generation`; a search only re-packs/re-uploads shards
-  whose generation moved. The global stacked arrays are re-assembled
-  zero-copy from the per-shard device buffers with
-  `jax.make_array_from_single_device_arrays`. Padded doc/tile shapes grow
-  in pow-2 steps, so unchanged shards' buffers stay valid across growth-
-  free refreshes; any shape growth or schema change rebuilds every shard
-  (geometric, so amortized-incremental). KNOWN COST: a changed shard is
-  re-merged from its engine's live docs via SegmentBuilder (re-analysis),
-  so within-shard refresh cost scales with shard size, not update size —
-  the array-level segment merge (see index/merging plans) will replace
-  this with tokenization-free posting concatenation.
+- **Delta-scaled refresh** (ROADMAP item 4): per-shard buffers are keyed
+  by the engine's monotonic refresh `generation`; a search only re-merges
+  shards whose generation moved. Within a changed shard, the merge is
+  TOKENIZATION-FREE posting concatenation (index/merge.py): per-handle
+  live-compacted pieces are cached by (handle uid, live epoch) — the
+  PR-9 cache-key scheme — so only NEW or merged handles compact, and the
+  concatenation itself is pure array ops (zero analysis calls,
+  hook-counted via estpu_analysis_calls_total). On the device side,
+  `pack_segment_delta` compares the merged host arrays against the
+  previous snapshot's and re-uploads only the planes the delta actually
+  touched (an append-only one-doc refresh re-uploads the written fields'
+  postings + the live mask; untouched fields' tile planes are shared
+  with the previous snapshot) — counted as
+  estpu_mesh_field_planes_{packed,reused}_total. The global stacked
+  arrays are re-assembled zero-copy from the per-shard device buffers
+  with `jax.make_array_from_single_device_arrays`. Padded doc/tile
+  shapes grow in pow-2 steps, so unchanged shards' buffers stay valid
+  across growth-free refreshes; any shape growth or schema change
+  rebuilds every shard (geometric, so amortized-incremental). KNOWN
+  COST: a changed shard's merged postings still re-CONCATENATE in full
+  (array I/O, not analysis) because the stacked planes interleave
+  handles term-major; per-handle device subplanes would need multi-span
+  term worklists in the compiler.
+- **Filter-cache rows survive refresh**: mesh-path mask planes are
+  cached per SHARD ROW, keyed by the shard's (handle uid, live epoch)
+  signature instead of the old generation sum (which killed every
+  stacked plane on any refresh). A one-shard refresh rebuilds only that
+  shard's row (one single-shard mask launch); unchanged shards' rows
+  keep hitting, and the [S, N] stacked plane is re-assembled zero-copy
+  from the cached rows (see MeshIndex._apply_filter_cache).
 - **Statistics parity**: plans compile with statistics aggregated from the
   ENGINE segments (tombstones included — Lucene keeps deleted docs in
   term stats until merge), exactly what `ShardedSearchCoordinator.
@@ -69,8 +87,9 @@ from typing import Any
 import numpy as np
 
 from ..index.filter_cache import mesh_cache_scope
-from ..index.segment import Segment, SegmentBuilder
-from ..index.tiles import TILE, pack_segment
+from ..index.merge import compact_segment, concat_segments
+from ..index.segment import Segment
+from ..index.tiles import TILE, pack_segment_delta
 from ..ops.bm25_device import segment_tree
 from ..query.compile import FieldStats, aggregate_field_stats
 from .sharded import (
@@ -240,11 +259,102 @@ class MeshIndex(ShardedIndex):
 
     serving_stats: dict[str, FieldStats] | None = None
     pack_avgdls: list[dict[str, float]] | None = None
+    # Per-shard content signatures — tuple of (handle uid, live epoch)
+    # per shard — and per-shard (non-stacked) device seg trees: the
+    # row-granular filter-cache machinery below keys mask-plane rows on
+    # the former and rebuilds a single missing row on the latter.
+    shard_sigs: tuple = ()
+    shard_trees: list = dc_field(default_factory=list)
 
     def field_stats(self) -> dict[str, FieldStats]:
         if self.serving_stats is not None:
             return self.serving_stats
         return super().field_stats()
+
+    def _apply_filter_cache(
+        self, query, compiled, record: bool = True, entries: list | None = None
+    ):
+        """Row-granular mesh filter cache: mask planes are cached per
+        SHARD ROW, keyed on the shard's (handle uid, live epoch)
+        signature — the same uid scheme the solo filter/ANN caches use —
+        so a refresh of one shard invalidates ONLY that shard's row.
+        The [S, N] stacked plane the kernel consumes is re-assembled
+        zero-copy from the cached rows (each row already lives on its
+        shard's mesh device); a missing row is rebuilt with a
+        single-shard `compute_filter_mask` launch against that shard's
+        own seg tree. Bit-exactness holds because the stacked builder
+        was itself a vmap of the same per-shard mask program
+        (ops/bm25_device.compute_filter_mask_stacked), gated by the
+        tests/test_mesh_refresh.py fuzz. The assembled [S, N] view is
+        deliberately NOT cached: it shares the rows' device buffers
+        zero-copy, so a cached view would pin HBM past the rows' own
+        eviction — re-assembly is a metadata-only operation paid per
+        request (S row gets + one make_array call)."""
+        cache = self.filter_cache
+        if cache is None or not self.shard_sigs:
+            return super()._apply_filter_cache(query, compiled, record, entries)
+        from ..index.filter_cache import (
+            apply_cached_masks,
+            record_filter_usage,
+        )
+        from ..ops.bm25_device import compute_filter_mask
+
+        if entries is None:
+            entries = record_filter_usage(cache, query, record=record)
+        if not entries:
+            return compiled, {}
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        n_shards = self.n_shards
+        npad = self.docs_per_shard
+        scope = self.cache_scope
+
+        def build(child_spec, child_arrays, norm):
+            rows = []
+            hit_rows = 0
+            for s in range(n_shards):
+                rkey = (
+                    scope,
+                    ("row", s, self.shard_sigs[s], npad),
+                    0,
+                    norm,
+                )
+                row = cache.get(rkey)
+                if row is None:
+                    arrays_s = jax.tree.map(
+                        lambda x: x[s], child_arrays
+                    )
+                    row = compute_filter_mask(
+                        self.shard_trees[s], child_spec, arrays_s
+                    ).reshape(1, -1)
+                    cache.put(rkey, row, int(row.nbytes))
+                else:
+                    hit_rows += 1
+                rows.append(row)
+            if hit_rows:
+                cache.note_reuse(hit_rows)
+            shape = (n_shards, npad)
+            index_map = sharding.addressable_devices_indices_map(shape)
+            ordered = [
+                rows[idx[0].start if idx[0].start is not None else 0]
+                for _, idx in index_map.items()
+            ]
+            plane = jax.make_array_from_single_device_arrays(
+                shape, sharding, ordered
+            )
+            return plane, 0
+
+        compiled, masks, _reused = apply_cached_masks(
+            cache, (scope, 0, 0), query, compiled, build,
+            const_fill=lambda: {
+                "boost": np.zeros(self.n_shards, dtype=np.float32)
+            },
+            entries=entries,
+            store_planes=False,
+        )
+        return compiled, masks
 
     def _tn_avgdl(self, shard: int, field: str, fstats) -> float:
         # The compiled spec KIND must stay shard-uniform (one shard_map
@@ -287,17 +397,29 @@ class MeshView:
         self.axis = axis
         # index.filter_cache.FilterCache (the node's, when wired by
         # create_index): the plain-scoring serve path substitutes cached
-        # [S, N] mask planes for repeated filter clauses. Keys scope on
-        # the engines' uid tuple with the generation SUM as the
-        # monotonic invalidation component, so a refresh of any shard
-        # stales every plane of this view (purged eagerly on next store).
+        # [S, N] mask planes for repeated filter clauses. Planes are
+        # cached per SHARD ROW keyed on (handle uid, live epoch)
+        # signatures (MeshIndex._apply_filter_cache), so a refresh of one
+        # shard invalidates only that shard's row; rows of unchanged
+        # shards keep hitting. Stale rows/views are purged eagerly on
+        # snapshot change (purge_scope).
         self.filter_cache = filter_cache
         self._lock = threading.Lock()
         self._snap: _Snapshot | None = None
         # Per-shard cache reused across refreshes.
         n = len(engines)
-        self._shard_gen: list[int | None] = [None] * n
         self._host_segs: list[Segment | None] = [None] * n
+        # Per-handle live-compacted pieces, keyed (handle uid, live
+        # epoch): a refresh re-compacts only handles whose key is new
+        # (fresh segment, merge output, or a live-mask sync); unchanged
+        # handles reuse their piece — the host-side half of delta
+        # scaling. Pruned to the engines' live handle set every refresh.
+        self._pieces: dict[tuple[int, int], Segment] = {}
+        # Per-shard content signature: tuple of (uid, live_epoch) in
+        # handle order — the filter-cache row key component and the
+        # skip-repack check (a generation bump that leaves a shard's
+        # signature unchanged needs no re-merge).
+        self._shard_sig: list[tuple | None] = [None] * n
         # Union-schema-filled copies actually packed (what snapshots see).
         self._filled_segs: list[Segment | None] = [None] * n
         self._trees: list[Any] = [None] * n  # [1, ...]-leaved device pytrees
@@ -308,6 +430,7 @@ class MeshView:
         # Test/observability hooks.
         self.served = 0  # searches answered by the SPMD program
         self.packs = 0  # shard pack+upload operations performed
+        self.seg_reuses = 0  # shard buffers reused across refreshes
         self.rebuilds = 0  # full (all-shard) rebuilds
         # Fallback accounting: every serve() decline is counted by reason
         # (never silent) — mirrored on the metrics registry as
@@ -347,28 +470,34 @@ class MeshView:
         order (segment handles in order, local ids ascending) so equal-score
         tie-breaks match the coordinator merge exactly. Also returns the
         [lo, hi) span each engine handle occupies in the merged doc space
-        (the f64-exact agg folds group by these)."""
-        builder = SegmentBuilder(self.mappings)
+        (the f64-exact agg folds group by these).
+
+        Tokenization-free: each handle contributes a live-compacted PIECE
+        (index/merge.compact_segment — a flatnonzero gather, cached by
+        (uid, live epoch) so only new/changed handles compact) and the
+        pieces concatenate as pure array ops (concat_segments). No
+        document is re-analyzed — the SegmentBuilder re-add loop this
+        replaces re-tokenized the whole shard on every one-doc refresh."""
+        pieces: list[Segment] = []
         spans: list[tuple[int, int]] = []
         base = 0
         for handle in handles:
-            # The mask the device kernels currently serve — NOT live_host,
-            # which may carry deletes that only become searchable at the
-            # next refresh (generation bump) on the host path too.
-            live = np.asarray(handle.device.live)[: handle.segment.num_docs]
-            added = 0
-            for local in np.flatnonzero(live):
-                local = int(local)
-                builder.add(
-                    handle.segment.sources[local],
-                    handle.segment.ids[local],
-                    version=handle.segment.doc_version(local),
-                    seqno=handle.segment.doc_seqno(local),
-                )
-                added += 1
-            spans.append((base, base + added))
-            base += added
-        return builder.build(), spans
+            key = (handle.uid, handle.live_epoch)
+            piece = self._pieces.get(key)
+            if piece is None:
+                # The mask the device kernels currently serve — NOT
+                # live_host, which may carry deletes that only become
+                # searchable at the next refresh (generation bump) on the
+                # host path too.
+                live = np.asarray(handle.device.live)[
+                    : handle.segment.num_docs
+                ]
+                piece = compact_segment(handle.segment, live)
+                self._pieces[key] = piece
+            pieces.append(piece)
+            spans.append((base, base + piece.num_docs))
+            base += piece.num_docs
+        return concat_segments(pieces), spans
 
     def _schema(self, segs: list[Segment]) -> dict[str, Any]:
         """Union schema + pow-2 padded shapes covering every shard."""
@@ -427,12 +556,20 @@ class MeshView:
         return True
 
     def _pack_shard(self, shard: int, seg: Segment, shapes: dict[str, Any],
-                    stats: dict[str, FieldStats]):
+                    stats: dict[str, FieldStats],
+                    delta_ok: bool = False):
         """Pack one shard's merged segment onto its mesh device; leaves get
         a leading [1, ...] axis for the global-array assembly. Returns
         (tree, filled segment, pack avgdls) — the caller commits them into
         the per-shard caches only once EVERY shard packed, so a mid-rebuild
         failure can't leave mixed-shape buffers behind.
+
+        `delta_ok` (padded shapes unchanged) enables plane-level upload
+        skipping: pack_segment_delta compares the merged host arrays
+        against the previous snapshot's filled segment and reuses every
+        device plane the delta didn't touch — the device half of the
+        delta-scaled refresh, counted as
+        estpu_mesh_field_planes_{packed,reused}_total.
 
         The union-schema fill COPIES the segment (fill_union_schema):
         `seg` stays pristine in the per-shard cache, and segments held by a
@@ -448,8 +585,12 @@ class MeshView:
             name: (stats[name].avgdl if name in stats else 1.0)
             for name in shapes["fields"]
         }
-        dev = pack_segment(
+        prev_seg = self._filled_segs[shard] if delta_ok else None
+        prev_dev = self._devs[shard] if delta_ok else None
+        dev, reused, packed = pack_segment_delta(
             seg,
+            prev_seg,
+            prev_dev,
             device=device,
             pad_docs_to=shapes["docs"],
             field_min_tiles=shapes["tiles"],
@@ -458,6 +599,16 @@ class MeshView:
             b=self.params.b,
             field_pos_min_tiles=shapes["pos_tiles"],
         )
+        if reused or packed:
+            self.metrics.counter(
+                "estpu_mesh_field_planes_reused_total",
+                "Mesh refresh device planes shared with the previous "
+                "snapshot (upload skipped: host arrays byte-identical)",
+            ).inc(reused)
+            self.metrics.counter(
+                "estpu_mesh_field_planes_packed_total",
+                "Mesh refresh device planes re-packed and re-uploaded",
+            ).inc(packed)
         # agg_segment_tree = segment_tree + keyword ordinal planes: the
         # one stacked pytree serves both the scoring kernels and the
         # in-program aggregation planes.
@@ -519,9 +670,19 @@ class MeshView:
             snap = self._snap
             if snap is not None and snap.gens == gens:
                 return snap
+            import jax
+
+            n = len(self.engines)
+            # Content signatures: a generation bump whose shard signature
+            # is unchanged (e.g. another shard's write) needs no re-merge.
+            sigs = [
+                tuple((h.uid, h.live_epoch) for h in pinned[i])
+                for i in range(n)
+            ]
             changed = [
-                i for i in range(len(self.engines))
-                if self._shard_gen[i] != gens[i]
+                i for i in range(n)
+                if self._shard_sig[i] != sigs[i]
+                or self._host_segs[i] is None
             ]
             merged = {
                 i: s for i, s in enumerate(self._host_segs) if s is not None
@@ -529,6 +690,17 @@ class MeshView:
             spans = {i: self._spans[i] for i in merged}
             for i in changed:
                 merged[i], spans[i] = self._merged_segment(pinned[i])
+            # Prune compaction pieces of handles no longer serving
+            # (merged away, dropped): keyed (uid, live_epoch) like the
+            # filter/ANN cache entries they mirror.
+            live_keys = {
+                (h.uid, h.live_epoch)
+                for handles in pinned
+                for h in handles
+            }
+            self._pieces = {
+                k: v for k, v in self._pieces.items() if k in live_keys
+            }
             new_shapes = self._schema([merged[i] for i in sorted(merged)])
             # Serving statistics: the ENGINE view (tombstones included),
             # computed from the same pinned handle lists the merges came
@@ -541,14 +713,17 @@ class MeshView:
             if self._shapes_fit(self._shapes, new_shapes):
                 shapes = self._shapes
                 to_pack = changed
+                delta_ok = True
             else:
                 shapes = new_shapes
-                to_pack = list(range(len(self.engines)))
+                to_pack = list(range(n))
+                delta_ok = False
             # Stage every pack, then commit atomically: a failure here
             # leaves all per-shard caches untouched (old snapshot keeps
             # serving; the gen mismatch retries the refresh next search).
             packed = {
-                i: self._pack_shard(i, merged[i], shapes, stats)
+                i: self._pack_shard(i, merged[i], shapes, stats,
+                                    delta_ok=delta_ok)
                 for i in to_pack
             }
             if shapes is not self._shapes:
@@ -563,7 +738,28 @@ class MeshView:
                 self._pack_avgdl[i] = avgdl
                 self._devs[i] = dev
                 self.packs += 1
-            self._shard_gen = list(gens)
+            self.seg_reuses += n - len(to_pack)
+            self.metrics.counter(
+                "estpu_mesh_segments_packed_total",
+                "Mesh refresh shard segments re-merged and re-packed",
+            ).inc(len(to_pack))
+            self.metrics.counter(
+                "estpu_mesh_segments_reused_total",
+                "Mesh refresh shard segments served from unchanged "
+                "buffers (no re-merge, no re-upload)",
+            ).inc(n - len(to_pack))
+            self._shard_sig = list(sigs)
+            scope = mesh_cache_scope(self.engines)
+            docs_pad = self._shapes["docs"]
+            if self.filter_cache is not None:
+                # Eager purge of mask rows no refresh can serve again —
+                # dead signatures free their HBM now instead of waiting
+                # for LRU. Live rows (unchanged shards) survive: that is
+                # the delta-scaled cache-survival contract.
+                keep = {
+                    ("row", s, sigs[s], docs_pad) for s in range(n)
+                }
+                self.filter_cache.purge_scope(scope, keep)
             segments = [s for s in self._filled_segs]
             index = MeshIndex(
                 mesh=self.mesh,
@@ -571,13 +767,17 @@ class MeshView:
                 mappings=self.mappings,
                 segments=segments,
                 seg_stacked=self._assemble(),
-                docs_per_shard=self._shapes["docs"],
+                docs_per_shard=docs_pad,
                 params=self.params,
                 serving_stats=stats,
                 pack_avgdls=list(self._pack_avgdl),
                 filter_cache=self.filter_cache,
-                cache_scope=mesh_cache_scope(self.engines),
+                cache_scope=scope,
                 cache_generation=sum(gens),
+                shard_sigs=tuple(sigs),
+                shard_trees=[
+                    jax.tree.map(lambda x: x[0], t) for t in self._trees
+                ],
             )
             self._snap = _Snapshot(
                 gens=gens,

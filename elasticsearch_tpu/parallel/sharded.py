@@ -520,7 +520,7 @@ class ShardedIndex:
         if not entries:
             return compiled, {}
 
-        def build(child_spec, child_arrays):
+        def build(child_spec, child_arrays, _norm):
             plane = compute_filter_mask_stacked(
                 self.seg_stacked, child_spec, child_arrays
             )

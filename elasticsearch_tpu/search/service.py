@@ -653,7 +653,7 @@ class SearchService:
             return compiled, {}
         from ..index.filter_cache import apply_cached_masks
 
-        def build(child_spec, child_arrays):
+        def build(child_spec, child_arrays, _norm):
             plane = bm25_device.compute_filter_mask(
                 seg_tree, child_spec, child_arrays
             )

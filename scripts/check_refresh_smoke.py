@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""CI smoke gate for the delta-scaled refresh (ISSUE 12).
+
+Runs the posting-concatenation merge and segment-granular mesh refresh
+suites on the CPU backend — no TPU needed: structural bit-equality of
+the concat merge vs the re-analysis oracle (terms, CSR postings,
+positions, norms, doc values, vectors, nested/completion/percolator),
+search-parity fuzz with deletes purged, the zero-analysis-calls hook
+gate (a one-doc write + refresh tokenizes only the delta; merges and
+mesh repacks tokenize NOTHING), filter/ANN cache survival across
+refresh + merge on the host path, and the mesh half: one-shard repack
+per one-doc refresh, field-plane upload skipping, uid-keyed mask ROWS
+of unchanged shards hitting across refreshes, all bit-identical to the
+host-loop coordinator. The same tests ride the tier-1 run via the fast
+(`not slow`) marker; this script is the standalone hook for pre-merge /
+cron checks:
+
+    python scripts/check_refresh_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "tests/test_merge_concat.py",
+        "tests/test_mesh_refresh.py",
+        "-q",
+        "-m",
+        "not slow",
+        "-p",
+        "no:cacheprovider",
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,3 +65,12 @@ def make_transport_instruments(m):
         "estpu_transport_rogue_total",
         "socket-transport instrument not in CATALOG",
     )
+
+
+def make_merge_instruments(m):
+    # A refresh/merge instrument that never made it into the CATALOG must
+    # fail exactly like any other rogue estpu_* registration.
+    m.counter(
+        "estpu_merge_rogue_total",
+        "merge instrument not in CATALOG",
+    )

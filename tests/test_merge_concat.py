@@ -1,0 +1,428 @@
+"""Delta-scaled refresh (ISSUE 12): the posting-concatenation merge.
+
+Three contracts gate the tokenization-free merge (index/merge.py):
+
+1. **Structural parity** — the concat merge's output Segment is
+   bit-identical, array by array and dtype by dtype, to what the old
+   re-analysis path (SegmentBuilder re-adding every live doc) produces:
+   term dictionaries, CSR postings, tf/position/ordinal planes, norms,
+   presence, doc values, vectors, versions/seqnos, nested blocks,
+   completion and percolator entries. Structural equality implies search
+   bit-exactness on every path, which the search-parity fuzz re-asserts
+   end to end (deletes purged, doc-value sorts, highlights).
+2. **Zero re-tokenization** — hook-counted via
+   `estpu_analysis_calls_total` (analysis/analyzers.py): a one-doc write
+   + refresh on a populated shard performs analysis calls only for the
+   delta doc; the merge and the mesh repack add none.
+3. **Cache survival** — filter/ANN planes of untouched segments keep
+   hitting across refresh + merge (uid-keyed, PR-9 scheme), and
+   merged-away handle uids are pruned from both caches.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis.analyzers import analysis_calls_total
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.index.merge import (
+    compact_segment,
+    concat_segments,
+    merged_live_segment,
+)
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.service import SearchRequest, SearchService
+
+MAPPINGS = Mappings.from_json(
+    {
+        "properties": {
+            "body": {"type": "text"},
+            "title": {"type": "text", "analyzer": "english"},
+            "tag": {"type": "keyword"},
+            "n": {"type": "long"},
+            "vec": {"type": "dense_vector", "dims": 4},
+            "sug": {"type": "completion"},
+            "comments": {
+                "type": "nested",
+                "properties": {
+                    "who": {"type": "keyword"},
+                    "stars": {"type": "long"},
+                },
+            },
+        }
+    }
+)
+
+WORDS = ["one", "two", "three", "four", "five", "the", "and", "café", "naïve"]
+
+
+def _make_doc(rng, i):
+    doc = {"body": " ".join(rng.choice(WORDS, rng.integers(0, 7))), "n": int(i)}
+    if rng.random() < 0.7:
+        doc["title"] = " ".join(rng.choice(WORDS, rng.integers(1, 4)))
+    if rng.random() < 0.6:
+        doc["tag"] = str(rng.choice(["a", "b", "c"]))
+    if rng.random() < 0.4:
+        doc["vec"] = [float(x) for x in rng.normal(size=4)]
+    if rng.random() < 0.3:
+        doc["sug"] = {
+            "input": [f"sug {i}", "shared"],
+            "weight": int(rng.integers(1, 5)),
+        }
+    if rng.random() < 0.4:
+        doc["comments"] = [
+            {
+                "who": str(rng.choice(["x", "y"])),
+                "stars": int(rng.integers(0, 5)),
+            }
+            for _ in range(rng.integers(1, 3))
+        ]
+    return doc
+
+
+def _random_segments(rng, n_segments=3, lo=5, hi=25):
+    segs, lives = [], []
+    counter = 0
+    for _ in range(n_segments):
+        builder = SegmentBuilder(MAPPINGS)
+        for _ in range(rng.integers(lo, hi)):
+            builder.add(
+                _make_doc(rng, counter),
+                f"d{counter}",
+                version=int(rng.integers(1, 4)),
+                seqno=counter,
+            )
+            counter += 1
+        seg = builder.build()
+        live = rng.random(seg.num_docs) > 0.3
+        segs.append(seg)
+        lives.append(live)
+    return segs, lives
+
+
+def _builder_merge(segs, lives):
+    """The old re-analysis merge: re-add every live doc through the
+    tokenizer — the oracle the concat merge must match bit-for-bit."""
+    builder = SegmentBuilder(MAPPINGS)
+    for seg, live in zip(segs, lives):
+        for local in np.flatnonzero(live):
+            local = int(local)
+            builder.add(
+                seg.sources[local],
+                seg.ids[local],
+                version=seg.doc_version(local),
+                seqno=seg.doc_seqno(local),
+            )
+    return builder.build()
+
+
+def _assert_fields_equal(a, b, name):
+    assert a.terms == b.terms, name
+    for attr in ("df", "offsets", "doc_ids", "tfs", "norm_bytes", "present"):
+        x, y = getattr(a, attr), getattr(b, attr)
+        assert x.dtype == y.dtype, (name, attr, x.dtype, y.dtype)
+        assert np.array_equal(x, y), (name, attr)
+    assert (a.pos_offsets is None) == (b.pos_offsets is None), name
+    if a.pos_offsets is not None:
+        assert np.array_equal(a.pos_offsets, b.pos_offsets), name
+        assert np.array_equal(a.positions, b.positions), name
+    assert a.doc_count == b.doc_count, name
+    assert a.sum_total_tf == b.sum_total_tf, name
+    assert a.has_norms == b.has_norms, name
+
+
+def _assert_segments_equal(got, want, label=""):
+    assert got.num_docs == want.num_docs, label
+    assert sorted(got.fields) == sorted(want.fields), label
+    for name in want.fields:
+        _assert_fields_equal(got.fields[name], want.fields[name], label + name)
+    assert sorted(got.doc_values) == sorted(want.doc_values), label
+    for name in want.doc_values:
+        assert got.doc_values[name].dtype == want.doc_values[name].dtype
+        assert np.array_equal(
+            got.doc_values[name], want.doc_values[name], equal_nan=True
+        ), (label, name)
+    assert sorted(got.vectors) == sorted(want.vectors), label
+    for name in want.vectors:
+        assert np.array_equal(got.vectors[name], want.vectors[name]), (
+            label,
+            name,
+        )
+    assert got.ids == want.ids, label
+    assert got.sources == want.sources, label
+    assert np.array_equal(got.versions, want.versions), label
+    assert np.array_equal(got.seqnos, want.seqnos), label
+    assert got.completion == want.completion, label
+    assert got.percolator == want.percolator, label
+    assert sorted(got.nested) == sorted(want.nested), label
+    for path in want.nested:
+        assert np.array_equal(
+            got.nested[path].parent_of, want.nested[path].parent_of
+        ), (label, path)
+        _assert_segments_equal(
+            got.nested[path].seg, want.nested[path].seg, label + path + "."
+        )
+
+
+# ----------------------------------------------------------- structural
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_concat_merge_structurally_equals_reanalysis(seed):
+    rng = np.random.default_rng(seed)
+    segs, lives = _random_segments(rng)
+    merged = merged_live_segment(segs, lives)
+    oracle = _builder_merge(segs, lives)
+    _assert_segments_equal(merged, oracle)
+
+
+def test_concat_merge_all_deleted_segment():
+    rng = np.random.default_rng(11)
+    segs, lives = _random_segments(rng, n_segments=3)
+    lives[1][:] = False  # one segment entirely dead
+    merged = merged_live_segment(segs, lives)
+    oracle = _builder_merge(segs, lives)
+    _assert_segments_equal(merged, oracle)
+
+
+def test_concat_empty_input_builds_empty_segment():
+    merged = concat_segments([])
+    assert merged.num_docs == 0
+    assert merged.fields == {} and merged.ids == []
+
+
+def test_compact_all_live_is_identity():
+    rng = np.random.default_rng(12)
+    segs, _ = _random_segments(rng, n_segments=1)
+    seg = segs[0]
+    assert compact_segment(seg, np.ones(seg.num_docs, dtype=bool)) is seg
+
+
+# ------------------------------------------------------- search parity
+
+
+def _search_pairs(engine, body):
+    resp = SearchService(engine).search(SearchRequest.from_json(body))
+    return (
+        [(h.doc_id, h.score, h.sort) for h in resp.hits],
+        resp.total,
+        resp,
+    )
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_merged_engine_search_parity_fuzz(seed):
+    """Merged engine == never-merged engine across match/bool/sort/
+    highlight shapes, with deletes purged by the merge."""
+    rng = np.random.default_rng(seed)
+    merged = Engine(MAPPINGS, max_segments=3, merge_factor=3)
+    flat = Engine(MAPPINGS)
+    n = 90
+    for i in range(n):
+        doc = _make_doc(rng, i)
+        merged.index(doc, f"d{i}")
+        flat.index(doc, f"d{i}")
+        if (i + 1) % 7 == 0:
+            merged.refresh()
+    for i in range(0, n, 5):  # deletes, purged by later merges
+        merged.delete(f"d{i}")
+        flat.delete(f"d{i}")
+    merged.refresh()
+    merged.force_merge(1)
+    flat.refresh()
+    assert len(merged.segments) == 1
+    bodies = [
+        {"query": {"match": {"body": "one two"}}, "size": n},
+        {
+            "query": {
+                "bool": {
+                    "must": [{"match": {"body": "three"}}],
+                    "filter": [{"term": {"tag": "a"}}],
+                }
+            },
+            "size": n,
+        },
+        {"query": {"match_all": {}}, "sort": [{"n": "desc"}], "size": 10},
+        {"query": {"match_phrase": {"body": "one two"}}, "size": n},
+        {
+            "query": {"match": {"body": "four"}},
+            "highlight": {"fields": {"body": {}}},
+            "size": n,
+        },
+    ]
+    for body in bodies:
+        got, got_total, got_resp = _search_pairs(merged, body)
+        want, want_total, want_resp = _search_pairs(flat, body)
+        assert got_total == want_total, body
+        assert [s for _, s, _ in got] == [s for _, s, _ in want], body
+        # Same (score -> id set) membership; tie ORDER may differ because
+        # merges renumber docs (Lucene merges do too).
+        by_score_got: dict = {}
+        by_score_want: dict = {}
+        for h, s, srt in got:
+            by_score_got.setdefault((s, tuple(srt or ())), set()).add(h)
+        for h, s, srt in want:
+            by_score_want.setdefault((s, tuple(srt or ())), set()).add(h)
+        assert by_score_got == by_score_want, body
+        if "highlight" in body:
+            got_hl = {
+                h.doc_id: h.highlight for h in got_resp.hits if h.highlight
+            }
+            want_hl = {
+                h.doc_id: h.highlight for h in want_resp.hits if h.highlight
+            }
+            assert got_hl == want_hl
+
+
+# ------------------------------------------------- analysis accounting
+
+
+def test_merge_performs_zero_analysis_calls():
+    rng = np.random.default_rng(21)
+    engine = Engine(MAPPINGS, max_segments=100)
+    for i in range(60):
+        engine.index(_make_doc(rng, i), f"d{i}")
+        if (i + 1) % 10 == 0:
+            engine.refresh()
+    engine.delete("d3")
+    engine.refresh()
+    before = analysis_calls_total()
+    engine.force_merge(1)
+    assert analysis_calls_total() == before  # the merge never tokenizes
+    assert engine.merges_total >= 1
+    assert engine.merge_docs_total >= 59
+    assert len(engine.segments) == 1
+
+
+def test_one_doc_write_refresh_analyzes_only_the_delta():
+    """The ISSUE 12 acceptance shape on the host path: a one-doc write +
+    refresh on a populated shard (small here; bench cfg10 runs 100k)
+    performs analysis calls for the delta doc only, even when the
+    refresh triggers a merge."""
+    rng = np.random.default_rng(22)
+    engine = Engine(MAPPINGS, max_segments=2, merge_factor=2)
+    for i in range(50):
+        engine.index(_make_doc(rng, i), f"d{i}")
+        if (i + 1) % 10 == 0:
+            engine.refresh()  # keeps merging down to <= 2 segments
+    merges_before = engine.merges_total
+    before = analysis_calls_total()
+    engine.index({"body": "one two three", "n": 999}, "delta")
+    after_write = analysis_calls_total()
+    delta_calls = after_write - before
+    assert delta_calls >= 1  # the delta doc itself analyzed
+    engine.refresh()  # freezes the buffer AND merges (max_segments=2)
+    assert engine.merges_total > merges_before  # a merge really ran
+    assert analysis_calls_total() == after_write  # ...with zero analysis
+
+
+# --------------------------------------------------- cache survival
+
+
+def test_filter_planes_of_untouched_segments_survive_refresh_and_merge():
+    from elasticsearch_tpu.index.filter_cache import FilterCache
+
+    rng = np.random.default_rng(31)
+    cache = FilterCache(min_freq=1)
+    engine = Engine(MAPPINGS, max_segments=100)
+    for i in range(40):
+        engine.index(_make_doc(rng, i), f"d{i}")
+        if (i + 1) % 10 == 0:
+            engine.refresh()
+    svc = SearchService(engine, filter_cache=cache)
+    # Two filters: the compiler may drive candidates off one (the lead,
+    # never masked); the other substitutes a cached plane.
+    body = {
+        "query": {
+            "bool": {
+                "must": [{"match": {"body": "one"}}],
+                "filter": [
+                    {"term": {"tag": "a"}},
+                    {"range": {"n": {"lt": 1000000}}},
+                ],
+            }
+        }
+    }
+    req = SearchRequest.from_json(body)
+    svc.search(req)  # admission sighting
+    svc.search(req)  # builds + stores planes per segment handle
+    keys_before = set(cache.keys())
+    assert keys_before, "planes should be resident"
+    old_uids = {h.uid for h in engine.segments}
+    # A refresh that only ADDS a segment leaves every old plane valid.
+    engine.index({"body": "one", "tag": "a", "n": 1000}, "newdoc")
+    engine.refresh()
+    hits_before = cache.stats()["hit_count"]
+    svc.search(req)
+    assert keys_before <= set(cache.keys())  # untouched planes survived
+    assert cache.stats()["hit_count"] > hits_before
+    # A merge retires every merged handle: fresh uids, old planes pruned
+    # on the next store/prune pass.
+    engine.force_merge(1)
+    live = frozenset(h.uid for h in engine.segments)
+    assert not (live & old_uids)  # merge minted fresh handle uids
+    cache.prune_dead(engine.uid, live)
+    for key in cache.keys():
+        if key[0] == engine.uid:
+            assert key[2] in live  # no merged-away uid remains
+
+
+def test_ann_planes_survive_refresh_and_prune_on_merge():
+    from elasticsearch_tpu.index.ann import AnnCache
+
+    rng = np.random.default_rng(32)
+    cache = AnnCache(min_docs=8)
+    engine = Engine(MAPPINGS, max_segments=100)
+    for i in range(32):
+        engine.index(
+            {"vec": [float(x) for x in rng.normal(size=4)], "n": i}, f"v{i}"
+        )
+    engine.refresh()
+    handle = engine.segments[0]
+    parts = cache.get_or_build(engine, handle, "vec", "cosine")
+    assert parts is not None
+    key = (engine.uid, handle.uid, "vec")
+    assert key in cache._entries
+    # Refresh adding a new segment: the untouched handle's planes survive
+    # and the SAME object is served (cache hit, no rebuild).
+    engine.index(
+        {"vec": [float(x) for x in rng.normal(size=4)], "n": 99}, "vnew"
+    )
+    engine.refresh()
+    assert cache.get_or_build(engine, handle, "vec", "cosine") is parts
+    assert int(cache._builds.value) == 1
+    # Merge retires the handle; prune_dead drops its planes eagerly.
+    engine.force_merge(1)
+    dropped = cache.prune_dead(
+        engine.uid, frozenset(h.uid for h in engine.segments)
+    )
+    assert dropped >= 1
+    assert key not in cache._entries
+
+
+def test_refresh_merge_stats_blocks_in_node_apis():
+    from elasticsearch_tpu.node import Node
+
+    node = Node()
+    node.create_index(
+        "rm", {"settings": {"index": {"merge": {"max_segment_count": 2,
+                                                "merge_factor": 2}}}}
+    )
+    for i in range(30):
+        node.index_doc("rm", {"body": f"w{i % 5} common"}, f"d{i}")
+        if i % 5 == 4:
+            node.refresh("rm")
+    node.refresh("rm")
+    stats = node.stats()
+    blk = stats["indices"]["rm"]["primaries"]
+    assert blk["refresh"]["total"] >= 6
+    assert blk["merges"]["total"] >= 1
+    assert blk["merges"]["total_docs"] > 0
+    assert stats["_all"]["primaries"]["merges"]["total"] >= 1
+    nstats = node.nodes_stats()
+    nblk = nstats["nodes"][node.node_name]["indices"]
+    assert nblk["refresh"]["total"] >= 6
+    assert nblk["merges"]["total"] >= 1
+    assert nblk["analysis"]["analysis_calls_total"] > 0
+    # Prometheus exposition carries the analysis counter too.
+    assert "estpu_analysis_calls_total" in node.metrics_text()

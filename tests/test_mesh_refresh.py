@@ -1,0 +1,252 @@
+"""Segment-granular mesh refresh (ISSUE 12, the mesh half).
+
+A one-doc write + refresh on a multi-shard mesh index must cost the
+delta, not the index:
+
+- only the OWNING shard re-merges and re-packs (the other shards'
+  buffers are reused — `estpu_mesh_segments_reused_total`);
+- within the re-packed shard, device planes of untouched fields are
+  shared with the previous snapshot (`pack_segment_delta` — counted
+  as `estpu_mesh_field_planes_reused_total`);
+- the merge itself never tokenizes (posting concatenation with per-
+  handle piece caching, hook-counted via estpu_analysis_calls_total);
+- filter-cache mask ROWS of unchanged shards keep hitting across the
+  refresh (keyed by (handle uid, live epoch) signatures; the old
+  generation-sum key killed every stacked plane on any refresh);
+- and results stay bit-identical to the host-loop coordinator.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis.analyzers import analysis_calls_total
+from elasticsearch_tpu.rest.server import RestServer
+
+WORDS = ["ant", "bee", "cat", "dog", "elk", "fox", "gnu", "hen"]
+
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "rank": {"type": "long"},
+    }
+}
+
+
+@pytest.fixture()
+def rest(monkeypatch):
+    monkeypatch.setenv("ESTPU_FILTER_CACHE_MIN_FREQ", "1")
+    rest = RestServer()
+    status, _ = rest.dispatch(
+        "PUT",
+        "/mesh",
+        {},
+        json.dumps(
+            {
+                "settings": {"index": {"number_of_shards": 8}},
+                "mappings": MAPPINGS,
+            }
+        ),
+    )
+    assert status == 200
+    rng = np.random.default_rng(23)
+    lines = []
+    for i in range(160):
+        lines.append(json.dumps({"index": {"_id": f"d{i}"}}))
+        lines.append(
+            json.dumps(
+                {
+                    "body": " ".join(rng.choice(WORDS, rng.integers(2, 9))),
+                    "tag": str(rng.choice(["x", "y", "z"])),
+                    "rank": int(rng.integers(0, 500)),
+                }
+            )
+        )
+    status, resp = rest.dispatch(
+        "POST", "/mesh/_bulk", {"refresh": "true"}, "\n".join(lines)
+    )
+    assert status == 200 and not resp["errors"]
+    return rest
+
+
+def mesh_view(rest):
+    mv = rest.node.get_index("mesh").search.mesh_view
+    assert mv is not None, "8-device CPU mesh should enable SPMD serving"
+    return mv
+
+
+def serve(rest, body):
+    status, resp = rest.dispatch(
+        "POST", "/mesh/_search", {}, json.dumps(body)
+    )
+    assert status == 200, resp
+    rest.node.request_cache.clear()
+    return resp
+
+
+def host_answer(rest, body):
+    """The same request through the host-loop coordinator."""
+    svc = rest.node.get_index("mesh")
+    mv = svc.search.mesh_view
+    svc.search.mesh_view = None
+    try:
+        return serve(rest, body)
+    finally:
+        svc.search.mesh_view = mv
+
+
+def hits_sig(resp):
+    return (
+        resp["hits"]["total"]["value"],
+        [
+            (h["_id"], h.get("_score"), tuple(h.get("sort", ())))
+            for h in resp["hits"]["hits"]
+        ],
+    )
+
+
+MATCH = {"query": {"match": {"body": "bee cat"}}, "size": 20}
+FILTERED = {
+    "query": {
+        "bool": {
+            "must": [{"match": {"body": "ant"}}],
+            "filter": [
+                {"term": {"tag": "x"}},
+                {"range": {"rank": {"lt": 100000}}},
+            ],
+        }
+    },
+    "size": 20,
+}
+
+
+def test_one_doc_refresh_repacks_one_shard_and_reuses_planes(rest):
+    mv = mesh_view(rest)
+    serve(rest, MATCH)  # builds the snapshot (8 packs)
+    assert mv.served >= 1
+    packs0, reuses0 = mv.packs, mv.seg_reuses
+    # One-doc write + refresh: exactly one shard owns the doc. The doc
+    # carries ONLY `body`, so the owning shard's tag/rank planes are
+    # byte-identical after the merge and their uploads are skipped.
+    rest.dispatch(
+        "PUT",
+        "/mesh/_doc/delta1",
+        {"refresh": "true"},
+        json.dumps({"body": "bee delta"}),
+    )
+    resp = serve(rest, MATCH)
+    assert mv.packs == packs0 + 1, "only the owning shard re-packs"
+    assert mv.seg_reuses == reuses0 + 7, "the other 7 shards reuse buffers"
+    # Within the re-packed shard, untouched planes (other fields) were
+    # shared with the previous snapshot, not re-uploaded.
+    reused = mv.metrics.value("estpu_mesh_field_planes_reused_total")
+    assert reused > 0
+    # Bit-identical to the host loop after the delta refresh.
+    assert hits_sig(resp) == hits_sig(host_answer(rest, MATCH))
+
+
+def test_mesh_refresh_and_serve_do_zero_analysis(rest):
+    mv = mesh_view(rest)
+    serve(rest, MATCH)  # initial snapshot built
+    rest.dispatch(
+        "PUT",
+        "/mesh/_doc/delta2",
+        {"refresh": "true"},
+        json.dumps({"body": "cat delta", "tag": "y", "rank": 9}),
+    )
+    served0 = mv.served
+    before = analysis_calls_total()
+    # A term query analyzes nothing; the mesh re-merge + repack of the
+    # delta shard must add ZERO analysis calls (pure posting concat).
+    resp = serve(rest, {"query": {"term": {"tag": "y"}}, "size": 5})
+    assert mv.served == served0 + 1
+    assert analysis_calls_total() == before
+    assert resp["hits"]["total"]["value"] > 0
+
+
+def test_filter_rows_of_unchanged_shards_survive_refresh(rest):
+    mv = mesh_view(rest)
+    cache = rest.node.filter_cache
+    assert cache is not None
+    # Admission (sighting 1) + build/store (sighting 2 hits min_freq=1
+    # immediately; the second serve substitutes cached rows).
+    cold = serve(rest, FILTERED)
+    warm = serve(rest, FILTERED)
+    assert hits_sig(cold) == hits_sig(warm)
+    row_keys0 = {
+        k for k in cache.keys()
+        if isinstance(k[1], tuple) and k[1][0] == "row"
+    }
+    assert len(row_keys0) >= 8, "one mask row per shard should be cached"
+    # One-doc write + refresh: exactly one shard's signature moves.
+    rest.dispatch(
+        "PUT",
+        "/mesh/_doc/delta3",
+        {"refresh": "true"},
+        json.dumps({"body": "ant delta", "tag": "x", "rank": 3}),
+    )
+    hits0 = cache.stats()["hit_count"]
+    after = serve(rest, FILTERED)
+    row_keys1 = {
+        k for k in cache.keys()
+        if isinstance(k[1], tuple) and k[1][0] == "row"
+    }
+    # Per cached filter: 7 of the 8 rows survived the refresh (same
+    # (uid, epoch) sigs); the delta shard minted a fresh row; the dead
+    # row purged eagerly on the snapshot change.
+    n_filters = len(row_keys0) // 8
+    assert len(row_keys0 & row_keys1) == 7 * n_filters
+    assert len(row_keys1 - row_keys0) == n_filters
+    assert cache.stats()["hit_count"] > hits0
+    # Parity after the delta, cached rows substituted.
+    assert hits_sig(after) == hits_sig(host_answer(rest, FILTERED))
+
+
+def test_filtered_parity_fuzz_across_refreshes(rest):
+    """Ingest-while-serving in miniature: interleave writes/refreshes
+    with filtered searches; every mesh answer must equal the host loop
+    bit-exactly while warm rows keep serving."""
+    rng = np.random.default_rng(5)
+    mv = mesh_view(rest)
+    for round_ in range(6):
+        doc_id = f"ingest{round_}"
+        rest.dispatch(
+            "PUT",
+            f"/mesh/_doc/{doc_id}",
+            {"refresh": "true"},
+            json.dumps(
+                {
+                    "body": " ".join(rng.choice(WORDS, rng.integers(2, 9))),
+                    "tag": str(rng.choice(["x", "y", "z"])),
+                    "rank": int(rng.integers(0, 500)),
+                }
+            ),
+        )
+        for body in (MATCH, FILTERED):
+            got = serve(rest, body)
+            want = host_answer(rest, body)
+            assert hits_sig(got) == hits_sig(want), (round_, body)
+    assert mv.served >= 12
+    stats = rest.node.filter_cache.stats()
+    assert stats["hit_count"] > 0
+
+
+def test_deletes_flow_through_row_cache(rest):
+    """A delete + refresh bumps the owning handle's live epoch: its
+    shard re-packs, rows re-key, and results stay host-identical."""
+    mv = mesh_view(rest)
+    serve(rest, FILTERED)
+    serve(rest, FILTERED)
+    packs0 = mv.packs
+    rest.dispatch("DELETE", "/mesh/_doc/d3", {"refresh": "true"}, "")
+    got = serve(rest, FILTERED)
+    assert mv.packs > packs0
+    assert all(h["_id"] != "d3" for h in got["hits"]["hits"])
+    assert hits_sig(got) == hits_sig(host_answer(rest, FILTERED))
+    match_all = {"query": {"match_all": {}}, "size": 0}
+    assert (
+        serve(rest, match_all)["hits"]["total"]["value"]
+        == host_answer(rest, match_all)["hits"]["total"]["value"]
+    )

@@ -163,7 +163,9 @@ class TestRegistryConsistency:
         assert any("[estpu_ann_rogue_total]" in m for m in msgs)
         # ... and an uncataloged socket-transport instrument
         assert any("[estpu_transport_rogue_total]" in m for m in msgs)
-        assert len(msgs) == 8
+        # ... and an uncataloged refresh/merge instrument
+        assert any("[estpu_merge_rogue_total]" in m for m in msgs)
+        assert len(msgs) == 9
 
     def test_bool_spec(self, report):
         msgs = [f.message for f in report.findings if f.rule == "bool-spec"]
