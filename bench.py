@@ -1988,6 +1988,168 @@ def bench_cfg10_ingest(n_docs=None, n_refreshes=40, n_q=16):
     }
 
 
+def bench_cfg11_obs_scrape(
+    n_docs=None, n_q=24, phase_s=3.0, scrape_interval_s=0.05
+):
+    """ISSUE 13 config: observability scrapes stay off the serving hot
+    path. The cfg3-style filtered-query mix serves on a Node while two
+    background threads scrape the node's `_nodes/stats` assembly and the
+    Prometheus `/_metrics` exposition every 50ms each (~40 scrapes/s
+    combined — two orders of magnitude above any real agent's cadence; an
+    UNPACED loop is deliberately not the gate: on a GIL interpreter any
+    always-runnable thread dilates every latency, which measures CPU
+    contention, not scrape coupling). The per-query p50 under scrape load
+    must stay within noise of the quiet p50 (quiet is measured BEFORE and
+    AFTER the loaded phase; the better of the two is the baseline, so
+    one-directional machine drift cannot fake a regression). Parity
+    gate: the loaded phase's hits are bit-identical to the quiet
+    phase's."""
+    import os
+    import threading
+
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.utils.corpus import (
+        build_zipf_segment,
+        pick_query_terms,
+    )
+
+    if n_docs is None:
+        n_docs = int(os.environ.get("ESTPU_BENCH_OBS_N", 100_000))
+    rng = np.random.default_rng(67)
+    t0 = time.monotonic()
+    _, base_seg = build_zipf_segment(
+        n_docs, vocab_size=20_000, seed=31, with_sources=True
+    )
+    base_seg.doc_values["rank"] = rng.random(n_docs).astype(np.float64)
+    node = Node()
+    node.create_index(
+        "obs",
+        {
+            "mappings": {
+                "properties": {
+                    "body": {"type": "text"},
+                    "rank": {"type": "float"},
+                }
+            }
+        },
+    )
+    engine = node.indices["obs"].engines[0]
+    engine.restore_segments([(base_seg, np.ones(n_docs, dtype=bool))])
+    node.refresh("obs")
+    build_s = time.monotonic() - t0
+
+    term_sets = pick_query_terms(base_seg, rng, n_q)
+    bodies = []
+    for terms in term_sets:
+        lo = float(rng.random() * 0.4)
+        bodies.append(
+            {
+                "query": {
+                    "bool": {
+                        "must": [{"match": {"body": " ".join(terms[:2])}}],
+                        "filter": [
+                            {"range": {"rank": {"gte": lo, "lte": lo + 0.5}}}
+                        ],
+                    }
+                },
+                "size": K,
+            }
+        )
+    for body in bodies:  # warm: compiles + cache admissions
+        node.search("obs", body)
+        node.search("obs", body)
+
+    def measure(duration_s):
+        times = []
+        hits = []
+        deadline = time.monotonic() + duration_s
+        qi = 0
+        while time.monotonic() < deadline:
+            body = bodies[qi % n_q]
+            t1 = time.monotonic()
+            resp = node.search("obs", body)
+            times.append(time.monotonic() - t1)
+            if qi < n_q:
+                hits.append(
+                    [
+                        (h["_id"], h["_score"])
+                        for h in resp["hits"]["hits"]
+                    ]
+                )
+            qi += 1
+        return float(np.median(times)) * 1e3, len(times), hits
+
+    quiet_a_p50, quiet_a_n, quiet_hits = measure(phase_s)
+
+    stop = threading.Event()
+    scrapes = [0, 0]
+    scrape_errors: list[str] = []
+
+    def scrape_loop(slot, fn):
+        while not stop.wait(scrape_interval_s):
+            try:
+                fn()
+                scrapes[slot] += 1
+            except Exception as e:  # staticcheck: ignore[broad-except] a dying scrape thread must be REPORTED (scrape_errors in the result), not silently end the load this config measures
+                scrape_errors.append(f"{type(e).__name__}: {e}")
+                if len(scrape_errors) >= 5:
+                    return
+
+    threads = [
+        threading.Thread(
+            target=scrape_loop, args=(0, node.nodes_stats), daemon=True
+        ),
+        threading.Thread(
+            target=scrape_loop, args=(1, node.metrics_text), daemon=True
+        ),
+    ]
+    t_loaded = time.monotonic()
+    for thread in threads:
+        thread.start()
+    try:
+        loaded_p50, loaded_n, loaded_hits = measure(phase_s)
+    finally:
+        stop.set()
+        for thread in threads:
+            thread.join(timeout=10)
+    loaded_s = time.monotonic() - t_loaded
+    quiet_b_p50, quiet_b_n, _ = measure(phase_s)
+
+    mismatches = sum(
+        1 for got, want in zip(loaded_hits, quiet_hits) if got != want
+    )
+    quiet_p50 = min(quiet_a_p50, quiet_b_p50)
+    # Noise budget: 30% + a 2ms CPU-jitter floor. The scrape threads run
+    # continuously at full tilt — far above any real agent's cadence —
+    # so passing here means a 15s-interval Prometheus scrape is free.
+    impact_ok = loaded_p50 <= quiet_p50 * 1.3 + 2.0
+    return {
+        "mismatches": mismatches,
+        "quiet_p50_ms": round(quiet_p50, 3),
+        "quiet_p50_before_ms": round(quiet_a_p50, 3),
+        "quiet_p50_after_ms": round(quiet_b_p50, 3),
+        "loaded_p50_ms": round(loaded_p50, 3),
+        "p50_ratio_loaded_over_quiet": (
+            round(loaded_p50 / quiet_p50, 3) if quiet_p50 else 0.0
+        ),
+        "scrape_impact_ok": impact_ok,
+        "nodes_stats_scrapes": scrapes[0],
+        "metrics_scrapes": scrapes[1],
+        "scrapes_per_s": round(sum(scrapes) / loaded_s, 1),
+        "scrape_errors": len(scrape_errors),
+        "scrape_error_samples": scrape_errors[:3],
+        "queries_quiet": quiet_a_n + quiet_b_n,
+        "queries_loaded": loaded_n,
+        "n_docs": n_docs,
+        "n_queries": n_q,
+        "corpus_build_s": round(build_s, 1),
+        # Scope note: standalone node — the cluster FAN half (per-send
+        # deadlines, named failures) is gated in tests/test_cluster_obs.py;
+        # this config measures the scrape cost the serving path feels.
+        "path": "standalone",
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -2282,6 +2444,7 @@ def main():
         ),
         ("cfg9_ann", bench_cfg9_ann),
         ("cfg10_ingest", bench_cfg10_ingest),
+        ("cfg11_obs_scrape", bench_cfg11_obs_scrape),
     ):
         try:
             configs[name] = fn()

@@ -1270,6 +1270,133 @@ class ClusterNode:
             return self._on_create_index(from_id, payload)
         return self.hub.send(self.node_id, master, "create_index", payload)
 
+    # -------------------------------------------- cluster-scope observability
+
+    def roles(self) -> list[str]:
+        """Reference-style role names: every member is master-eligible;
+        voting-only tiebreakers vote but never hold shard copies."""
+        if self.node_id in self.state.voting_only:
+            return ["master", "voting_only"]
+        return ["data", "master"]
+
+    def node_stats_local(self) -> dict:
+        """This node's `_nodes/stats` section — the per-node payload the
+        `node_stats` wire action ships (the reference's NodeStats shape):
+        identity/roles/master marker, doc+shard+segment counts, the
+        per-node filter cache, degraded-search counters, process identity
+        (the pid is what distinguishes real worker processes), stepper
+        errors, and this node's transport counters."""
+        from ..index.filter_cache import FilterCache
+
+        with self.lock:
+            engines = dict(self.engines)
+            inflight = self._inflight_searches
+        docs = 0
+        segments = 0
+        for engine in engines.values():
+            docs += engine.num_docs
+            segments += len(engine.segments)
+        out: dict[str, Any] = {
+            "name": self.node_id,
+            "roles": self.roles(),
+            "master": self.is_master(),
+            "process": {
+                "pid": os.getpid(),
+                "inflight_searches": int(inflight),
+            },
+            "indices": {
+                "docs": {"count": int(docs)},
+                "shards": {"count": len(engines)},
+                "segments": {"count": int(segments)},
+                "filter_cache": (
+                    self.filter_cache.stats()
+                    if self.filter_cache is not None
+                    else FilterCache.disabled_stats()
+                ),
+            },
+            "search_resilience": self.search_resilience_stats(),
+            "cluster_state": {
+                "term": self.state.term,
+                "version": self.state.version,
+                "master_node": self.state.master,
+            },
+            "step_errors": int(self._step_errors.value),
+        }
+        # Per-node transport view: a node owning its own endpoint (a
+        # procs worker, or a TcpTransportHub member) reports endpoint-
+        # scoped counters; the in-memory hub reports its hub-wide view.
+        endpoint = None
+        get_endpoint = getattr(self.hub, "endpoint", None)
+        if get_endpoint is not None:
+            endpoint = get_endpoint(self.node_id)
+        elif getattr(self.hub, "node_id", None) == self.node_id:
+            endpoint = self.hub
+        if endpoint is not None:
+            out["transport"] = endpoint.stats()
+        else:
+            hub_stats = getattr(self.hub, "stats", None)
+            if hub_stats is not None:
+                out["transport"] = hub_stats()
+        return out
+
+    def _on_node_stats(self, from_id: str, payload: dict):
+        return self.node_stats_local()
+
+    def _on_metrics_wire(self, from_id: str, payload: dict):
+        """Federated `/_metrics` ship side: this node's registry as a
+        wire snapshot. Process-wide registries (the transport endpoint's,
+        the analysis counter's) ride along only when this node OWNS its
+        process (a procs worker) — in-process cluster members would
+        otherwise each re-ship the same process globals and the cluster
+        fold would multiply them."""
+        others = []
+        if getattr(self.hub, "node_id", None) == self.node_id:
+            from ..analysis.analyzers import ANALYSIS_METRICS
+
+            hub_metrics = getattr(self.hub, "metrics", None)
+            if hub_metrics is not None and hub_metrics is not self.metrics:
+                others.append(hub_metrics)
+            others.append(ANALYSIS_METRICS)
+        return {
+            "node": self.node_id,
+            "families": self.metrics.to_wire(*others),
+        }
+
+    def _on_trace_fragment(self, from_id: str, payload: dict):
+        """Distributed trace assembly ship side: the spans THIS process
+        buffered for one trace id (its fragment of the cluster-wide
+        tree). None when the trace never reached this process."""
+        from ..obs.tracing import TRACER
+
+        spans = TRACER.get(str(payload.get("trace_id", "")))
+        if spans is None:
+            return {"node": self.node_id, "spans": None}
+        self.metrics.counter(
+            "estpu_trace_fragments_shipped_total",
+            "Trace-fragment spans shipped to a collecting coordinator",
+            node=self.node_id,
+        ).inc(len(spans))
+        return {
+            "node": self.node_id,
+            "spans": [s.to_json() for s in spans],
+        }
+
+    def _on_hot_threads(self, from_id: str, payload: dict):
+        """Hot-threads ship side: sample THIS process' thread stacks over
+        the requested interval and return the rendered text block."""
+        from ..obs.hot_threads import hot_threads_text
+
+        return {
+            "node": self.node_id,
+            "text": hot_threads_text(
+                node_name=self.node_id,
+                threads=int(payload.get("threads", 3)),
+                interval_s=float(payload.get("interval_s", 0.5)),
+                snapshots=int(payload.get("snapshots", 10)),
+                metrics=self.metrics,
+            ),
+        }
+
     # ------------------------------------------------------- master duties
 
     def _require_master(self) -> None:

@@ -174,6 +174,7 @@ class ProcCluster:
         self._procs: dict[str, Any] = {}
         self._lock = threading.Lock()
         self._intercept_state: dict = {}
+        self._metrics_cache: tuple[float, str] | None = None
         self._closed = False
         self._book = FileAddressBook(self.addr_dir)
         # Dedicated control endpoint: its intercepts stay EMPTY forever,
@@ -476,6 +477,196 @@ class ProcCluster:
         return self._ctl.send(
             "_ctl", node_id, "client_state", {}, timeout_s=timeout_s
         )
+
+    # ------------------------------------------- cluster-scope observability
+
+    def _fan(
+        self,
+        action: str,
+        payload: dict | None = None,
+        timeout_s: float | None = None,
+    ):
+        """Scatter one wire action over every worker via the `_ctl`
+        endpoint (never intercepted, so observability keeps working under
+        armed partitions): partial-tolerant, deadline-bounded, named
+        failure entries for dead/wedged processes."""
+        from .transport import scatter_nodes
+
+        if timeout_s is None:
+            timeout_s = self.send_timeout_s or 5.0
+
+        def send(node_id: str):
+            return self._ctl.send(
+                "_ctl", node_id, action, dict(payload or {}),
+                timeout_s=timeout_s,
+            )
+
+        return scatter_nodes(
+            list(self.workers), send, action, timeout_s,
+            metrics=self._ctl.metrics,
+        )
+
+    def nodes_stats(self) -> dict:
+        """`GET /_nodes/stats` over the process cluster: the `node_stats`
+        wire action fanned to every worker plus the supervisor-resident
+        tiebreaker, with a `_nodes: {total, successful, failed}` header —
+        a kill -9'd worker shows up as a named failure entry within the
+        per-send deadline, never a hang."""
+        results, failures = self._fan("node_stats")
+        nodes: dict[str, dict] = {}
+        if self._local_node is not None:
+            nodes[TIEBREAKER_ID] = self._local_node.node_stats_local()
+        for node_id in self.workers:
+            if node_id in results:
+                nodes[node_id] = results[node_id]
+        tb = 1 if self._local_node is not None else 0
+        header: dict[str, Any] = {
+            "total": len(self.workers) + tb,
+            "successful": len(results) + tb,
+            "failed": len(failures),
+        }
+        if failures:
+            header["failures"] = failures
+        return {
+            "_nodes": header,
+            "cluster_name": self.cluster_name,
+            "nodes": nodes,
+        }
+
+    def metrics_text(self, max_age_s: float | None = None) -> str:
+        """Federated `GET /_metrics`: every live worker's registry ships
+        over the `metrics_wire` action and re-exposes here with a
+        `node=<id>` label per series; counters additionally fold into
+        `node="_cluster"` totals. Scrapes cache for ESTPU_METRICS_FED_TTL_S
+        (default 0.5s) so a scrape storm cannot multiply worker fan-outs;
+        the fan itself is deadline-bounded and runs only at scrape time —
+        never on the serving hot path."""
+        from ..analysis.analyzers import ANALYSIS_METRICS
+        from ..obs.metrics import WireRegistrySnapshot, fold_cluster_counters
+
+        if max_age_s is None:
+            max_age_s = float(
+                os.environ.get("ESTPU_METRICS_FED_TTL_S", "0.5") or 0.5
+            )
+        now = time.monotonic()
+        with self._lock:
+            cached = self._metrics_cache
+        if cached is not None and now - cached[0] <= max_age_s:
+            return cached[1]
+        results, _failures = self._fan("metrics_wire")
+        snapshots = [
+            WireRegistrySnapshot(
+                (results[node_id] or {}).get("families"), node=node_id
+            )
+            for node_id in sorted(results)
+        ]
+        if self._local_node is not None:
+            snapshots.append(
+                WireRegistrySnapshot(
+                    self._local_node.metrics.to_wire(
+                        self._tb_transport.metrics
+                    ),
+                    node=TIEBREAKER_ID,
+                )
+            )
+        text = self._ctl.metrics.exposition(
+            ANALYSIS_METRICS, *snapshots, fold_cluster_counters(snapshots)
+        )
+        with self._lock:
+            self._metrics_cache = (time.monotonic(), text)
+        return text
+
+    def hot_threads(
+        self,
+        threads: int = 3,
+        interval_s: float = 0.5,
+        snapshots: int = 10,
+    ) -> str:
+        """`GET /_nodes/hot_threads` over the process cluster: every
+        worker samples its OWN interpreter's thread stacks; the texts
+        concatenate under `::: {node}` headers, with a failure line for
+        any process that could not be sampled."""
+        from ..obs.hot_threads import fan_text_blocks, hot_threads_text
+
+        payload = {
+            "threads": threads,
+            "interval_s": interval_s,
+            "snapshots": snapshots,
+        }
+        local_box: dict[str, str] = {}
+        sampler = None
+        if self._local_node is not None:
+            # Supervisor sample runs CONCURRENTLY with the fan: one
+            # interval of wall clock for the whole cluster.
+            local_node = self._local_node
+
+            def sample_local() -> None:
+                local_box["text"] = hot_threads_text(
+                    node_name=TIEBREAKER_ID,
+                    threads=threads,
+                    interval_s=interval_s,
+                    snapshots=snapshots,
+                    metrics=local_node.metrics,
+                )
+
+            sampler = threading.Thread(target=sample_local, daemon=True)
+            sampler.start()
+        results, failures = self._fan(
+            "hot_threads",
+            payload,
+            timeout_s=(self.send_timeout_s or 5.0) + float(interval_s),
+        )
+        blocks = []
+        if sampler is not None:
+            sampler.join()
+            blocks.append(local_box.get("text", ""))
+        blocks.extend(
+            fan_text_blocks(results, failures, order=list(self.workers))
+        )
+        return "\n".join(blocks)
+
+    def search_traced(
+        self, index: str, body: dict, timeout_s: float = 30.0
+    ) -> tuple[dict, str]:
+        """Search under a ROOT trace span: (response, trace_id). The
+        remote shard executions' spans land in the worker processes'
+        rings; `trace(trace_id)` splices them back into one tree."""
+        from ..obs.tracing import TRACER
+
+        with TRACER.start_trace("procs.search", index=index) as root:
+            out = self.search(index, body, timeout_s=timeout_s)
+        return out, root.trace_id
+
+    def trace(self, trace_id: str, fmt: str | None = None):
+        """Distributed trace assembly: collect this trace's fragments
+        from the supervisor's own ring and every live worker, splice ONE
+        tree. None when no process buffered the trace; `fmt="chrome"`
+        renders Perfetto-loadable trace-event JSON covering the whole
+        cluster (one track per node)."""
+        from ..obs.tracing import TRACER, chrome_trace, collect_fragments
+
+        results, failures = self._fan(
+            "trace_fragment", {"trace_id": trace_id}
+        )
+        spans, collected = collect_fragments(TRACER.get(trace_id), results)
+        if collected:
+            self._ctl.metrics.counter(
+                "estpu_trace_fragments_collected_total",
+                "Trace-fragment spans collected from cluster nodes",
+            ).inc(collected)
+        if not spans:
+            return None
+        if fmt == "chrome":
+            return chrome_trace(spans)
+        tb = 1 if self._local_node is not None else 0
+        header: dict[str, Any] = {
+            "total": len(self.workers) + tb,
+            "successful": len(results) + tb,
+            "failed": len(failures),
+        }
+        if failures:
+            header["failures"] = failures
+        return {"trace_id": trace_id, "_nodes": header, "spans": spans}
 
     def wait_for(
         self,

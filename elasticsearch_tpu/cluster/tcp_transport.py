@@ -368,6 +368,62 @@ class TcpTransport:
                 + sum(len(p) for p in self._pool.values())
             )
 
+    def stats(self) -> dict:
+        """Endpoint-scoped transport counters — the per-node transport
+        section the `node_stats` wire action ships. Reads by exact label
+        so a shared registry (TcpTransportHub wiring) still yields THIS
+        endpoint's numbers."""
+        m = self.metrics
+        return {
+            "kind": "tcp",
+            "node": self.node_id,
+            "address": list(self.address) if self.address else None,
+            "connections": int(
+                m.value(
+                    "estpu_transport_connections_total", node=self.node_id
+                )
+            ),
+            "reconnects": int(
+                m.value(
+                    "estpu_transport_reconnects_total", node=self.node_id
+                )
+            ),
+            "handshake_rejects": int(
+                m.value(
+                    "estpu_transport_handshake_rejects_total",
+                    node=self.node_id,
+                )
+            ),
+            "send_timeouts": int(
+                m.value(
+                    "estpu_transport_send_timeouts_total",
+                    transport="tcp",
+                    node=self.node_id,
+                )
+            ),
+            "frames": {
+                d: int(
+                    m.value(
+                        "estpu_transport_frames_total",
+                        node=self.node_id,
+                        dir=d,
+                    )
+                )
+                for d in ("sent", "received")
+            },
+            "frame_bytes": {
+                d: int(
+                    m.value(
+                        "estpu_transport_frame_bytes_total",
+                        node=self.node_id,
+                        dir=d,
+                    )
+                )
+                for d in ("sent", "received")
+            },
+            "open_connections": int(self._open_connections()),
+        }
+
     def close(self, abrupt: bool = False) -> None:
         """Tear the endpoint down. `abrupt=True` is process death: every
         socket closes with no goodbye and the published address stays
@@ -880,6 +936,11 @@ class TcpTransportHub(InterceptsDelegate):
     def alive(self, node_id: str) -> bool:
         with self._lock:
             return node_id in self._endpoints
+
+    def endpoint(self, node_id: str) -> TcpTransport | None:
+        """One node's own endpoint (per-node transport stats source)."""
+        with self._lock:
+            return self._endpoints.get(node_id)
 
     def stats(self) -> dict:
         with self._lock:

@@ -339,6 +339,105 @@ class TransportHub(InterceptsDelegate):
         }
 
 
+def scatter_nodes(
+    node_ids,
+    send: Callable[[str], Any],
+    action: str,
+    timeout_s: float | None,
+    metrics=None,
+) -> tuple[dict[str, Any], list[dict[str, str]]]:
+    """Parallel scatter of one wire action over many nodes with per-node
+    failure capture — the TransportNodesAction fan-in shape shared by
+    `_nodes/stats`, the federated `/_metrics` scrape, trace-fragment
+    collection, and hot-threads sampling.
+
+    ``send(node_id)`` performs the transport call and is already bounded
+    by its per-send deadline; a node that is dead, partitioned, or wedged
+    past that deadline becomes a NAMED failure entry — never an exception
+    out of the fan and never a hang (the join carries a small grace over
+    the send deadline as a belt-and-braces bound). Returns
+    ``(results by node id, failures [{node, type, reason}])``."""
+    results: dict[str, Any] = {}
+    failures: list[dict[str, str]] = []
+    # Nodes whose worker outlived the join grace: their (late) outcome
+    # must NOT mutate the returned dicts after the caller starts reading
+    # them — the failure entry recorded at abandonment is final.
+    abandoned: set[str] = set()
+    out_lock = threading.Lock()
+    t0 = time.monotonic()
+
+    def fan_one(nid: str) -> None:
+        try:
+            result = send(nid)
+        # staticcheck: ignore[broad-except] fan-in boundary: ANY per-node failure (transport, remote, local bug) must become a named failure entry — partial tolerance is the contract
+        except Exception as e:
+            with out_lock:
+                if nid not in abandoned:
+                    failures.append(
+                        {
+                            "node": nid,
+                            "type": type(e).__name__,
+                            "reason": str(e),
+                        }
+                    )
+        else:
+            with out_lock:
+                if nid not in abandoned:
+                    results[nid] = result
+
+    workers = [
+        threading.Thread(
+            target=fan_one,
+            args=(nid,),
+            daemon=True,
+            name=f"nodes-fan-{action}-{nid}",
+        )
+        for nid in node_ids
+    ]
+    for worker in workers:
+        worker.start()
+    grace = (timeout_s if timeout_s and timeout_s > 0 else 30.0) + 2.0
+    deadline = time.monotonic() + grace
+    for nid, worker in zip(node_ids, workers):
+        worker.join(max(0.0, deadline - time.monotonic()))
+        if worker.is_alive():
+            with out_lock:
+                abandoned.add(nid)
+                if nid not in results and not any(
+                    f["node"] == nid for f in failures
+                ):
+                    failures.append(
+                        {
+                            "node": nid,
+                            "type": "ConnectTransportError",
+                            "reason": (
+                                f"[{action}] fan-in deadline exceeded "
+                                f"after {grace}s"
+                            ),
+                        }
+                    )
+    if metrics is not None:
+        from ..obs.metrics import NODES_FAN_LATENCY_MS_BUCKETS
+
+        metrics.counter(
+            "estpu_nodes_stats_fanouts_total",
+            "Cluster-wide stats/obs scatter rounds by wire action",
+            action=action,
+        ).inc()
+        if failures:
+            metrics.counter(
+                "estpu_nodes_stats_fan_failures_total",
+                "Named per-node failures during stats/obs fan-in",
+                action=action,
+            ).inc(len(failures))
+        metrics.histogram(
+            "estpu_nodes_stats_fan_latency_ms",
+            NODES_FAN_LATENCY_MS_BUCKETS,
+            "Wall-clock fan-in latency of stats/obs scatter rounds",
+        ).observe((time.monotonic() - t0) * 1e3)
+    return results, failures
+
+
 def _invoke(handler, from_id, to_id, action, payload):
     try:
         return handler(from_id, action, payload)

@@ -59,6 +59,14 @@ from .search.service import (
 )
 
 
+# Per-send deadline for cluster-wide observability scatters
+# (`_nodes/stats`, trace-fragment collection, hot-threads sampling): a
+# dead or wedged member yields a named failure entry within this bound.
+NODES_FAN_TIMEOUT_S = float(
+    os.environ.get("ESTPU_NODES_FAN_TIMEOUT_S", "5") or 5
+)
+
+
 class ApiError(Exception):
     """An error with an HTTP status, rendered ES-style by the REST layer.
     `headers` (e.g. Retry-After on 429s) ride to the HTTP response."""
@@ -3502,6 +3510,90 @@ class Node:
 
     # -------------------------------------------------------- observability
 
+    def _cluster_fan(
+        self,
+        action: str,
+        payload: dict | None = None,
+        timeout_s: float | None = None,
+    ) -> tuple[dict, list[dict]]:
+        """Scatter one wire action over every cluster member (the
+        TransportNodesAction fan shape): parallel, per-send deadline,
+        named failure entries — a dead or wedged node can never hang
+        an observability request."""
+        from .cluster.transport import scatter_nodes
+
+        cluster = self.replication.cluster
+        if timeout_s is None:
+            timeout_s = NODES_FAN_TIMEOUT_S
+        try:
+            from_id = self.replication.coordinator().node_id
+        except RuntimeError:
+            # Every member dead: the sends still run (and fail, named)
+            # so the caller gets a complete failure roster, not a 500.
+            from_id = self.node_name
+
+        def send(node_id: str):
+            return cluster.hub.send(
+                from_id, node_id, action, dict(payload or {}),
+                timeout_s=timeout_s,
+            )
+
+        return scatter_nodes(
+            sorted(cluster.nodes), send, action, timeout_s,
+            metrics=self.metrics,
+        )
+
+    def hot_threads(
+        self,
+        threads: int = 3,
+        interval_s: float = 0.5,
+        snapshots: int = 10,
+    ) -> str:
+        """GET /_nodes/hot_threads — reference-style per-node thread
+        stack sampling (monitor/jvm/HotThreads analog): this process
+        samples itself, and when clustered the `hot_threads` wire action
+        fans over every member so each process samples its OWN
+        interpreter; blocks concatenate under `::: {node}` headers with
+        a failure line for any node that could not be sampled."""
+        from .obs.hot_threads import fan_text_blocks, hot_threads_text
+
+        local_box: dict[str, str] = {}
+
+        def sample_local() -> None:
+            local_box["text"] = hot_threads_text(
+                node_name=self.node_name,
+                threads=threads,
+                interval_s=interval_s,
+                snapshots=snapshots,
+                metrics=self.metrics,
+            )
+
+        if self.replication is None:
+            sample_local()
+            return local_box["text"]
+        # The local sample runs CONCURRENTLY with the fan (each remote
+        # handler samples for the same interval) so the request costs
+        # one interval of wall clock, not two.
+        sampler = threading.Thread(target=sample_local, daemon=True)
+        sampler.start()
+        results, failures = self._cluster_fan(
+            "hot_threads",
+            {
+                "threads": threads,
+                "interval_s": interval_s,
+                "snapshots": snapshots,
+            },
+            timeout_s=NODES_FAN_TIMEOUT_S + float(interval_s),
+        )
+        sampler.join()
+        # The member sharing the coordinating front's name is the SAME
+        # interpreter the local block just sampled (the nodes_stats
+        # merge rule): one block per node name.
+        results.pop(self.node_name, None)
+        blocks = [local_box.get("text", "")]
+        blocks.extend(fan_text_blocks(results, failures))
+        return "\n".join(blocks)
+
     def get_traces(self, limit: int = 50) -> dict:
         """GET /_traces — newest-first summaries of the trace ring."""
         return {
@@ -3510,38 +3602,81 @@ class Node:
         }
 
     def get_trace(self, trace_id: str, fmt: str | None = None) -> dict:
-        """GET /_traces/{trace_id}[?format=chrome] — one span tree, as
-        span JSON or Chrome trace-event JSON (Perfetto-loadable)."""
-        out = (
-            TRACER.to_chrome(trace_id)
-            if fmt == "chrome"
-            else TRACER.export(trace_id)
-        )
-        if out is None:
+        """GET /_traces/{trace_id}[?format=chrome] — ONE spliced span
+        tree. Remote span bodies stay in each node's ring (only parent
+        ids cross with requests), so when clustered the coordinator fans
+        the `trace_fragment` wire action over every member and splices
+        the fragments with its own spans: one tree, and the chrome export
+        covers the whole cluster (one track per node)."""
+        from .obs.tracing import chrome_trace, collect_fragments
+
+        header = None
+        results: dict = {}
+        if self.replication is not None:
+            results, failures = self._cluster_fan(
+                "trace_fragment", {"trace_id": trace_id}
+            )
+            header = {
+                "total": len(self.replication.cluster.nodes),
+                "successful": len(results),
+                "failed": len(failures),
+            }
+            if failures:
+                header["failures"] = failures
+        spans, collected = collect_fragments(TRACER.get(trace_id), results)
+        if collected:
+            self.metrics.counter(
+                "estpu_trace_fragments_collected_total",
+                "Trace-fragment spans collected from cluster nodes",
+            ).inc(collected)
+        if not spans:
             raise ApiError(
                 404,
                 "resource_not_found_exception",
                 f"trace [{trace_id}] is not buffered (ring keeps the last "
                 f"{TRACER.max_traces} traces)",
             )
+        if fmt == "chrome":
+            return chrome_trace(spans)
+        out: dict[str, Any] = {"trace_id": trace_id, "spans": spans}
+        if header is not None:
+            out["_nodes"] = header
         return out
 
     def metrics_text(self) -> str:
-        """GET /_metrics — Prometheus text exposition: this node's
-        registry merged with the replication gateway's and every live
-        cluster node's (their series carry distinguishing labels), plus
-        the process-wide analysis registry
-        (estpu_analysis_calls_total)."""
+        """GET /_metrics — federated Prometheus text exposition: this
+        node's registry merged with the replication gateway's, the
+        cluster/hub-level registries, the process-wide analysis registry
+        (estpu_analysis_calls_total), and every live cluster member's
+        registry re-exposed with a `node=<id>` label per series —
+        counters additionally folded into `node="_cluster"` totals.
+        Federation happens only at scrape time (the same wire snapshot
+        shape the procs `metrics_wire` action ships), never on the
+        request hot path."""
         from .analysis.analyzers import ANALYSIS_METRICS
+        from .obs.metrics import WireRegistrySnapshot, fold_cluster_counters
 
-        others = [ANALYSIS_METRICS]
+        others: list = [ANALYSIS_METRICS]
         if self.replication is not None:
             gw_metrics = getattr(self.replication, "metrics", None)
             if gw_metrics is not None and gw_metrics is not self.metrics:
                 others.append(gw_metrics)
-            for cnode in self.replication.cluster.nodes.values():
-                if not cnode.closed:
-                    others.append(cnode.metrics)
+            cluster = self.replication.cluster
+            cluster_metrics = getattr(cluster, "metrics", None)
+            if cluster_metrics is not None:
+                others.append(cluster_metrics)
+            hub_metrics = getattr(cluster.hub, "metrics", None)
+            if hub_metrics is not None:
+                others.append(hub_metrics)
+            snapshots = [
+                WireRegistrySnapshot(
+                    cnode.metrics.to_wire(), node=cnode.node_id
+                )
+                for cnode in cluster.nodes.values()
+                if not cnode.closed
+            ]
+            others.extend(snapshots)
+            others.append(fold_cluster_counters(snapshots))
         return self.metrics.exposition(*others)
 
     # ---------------------------------------------------------------- admin
@@ -3669,6 +3804,47 @@ class Node:
                 )
         return rows
 
+    def cat_nodes(self) -> list[dict]:
+        """GET /_cat/nodes — id, role letters (d=data, i=ingest,
+        m=master-eligible, v=voting-only tiebreaker), the elected-master
+        marker, and load columns read from the fanned per-node stats
+        (nodes_stats); a member that failed the fan gets no row, exactly
+        like the reference's cat view over a partial nodes response."""
+        role_letters = {
+            "data": "d",
+            "ingest": "i",
+            "master": "m",
+            "voting_only": "v",
+        }
+        rows = []
+        for name, section in self.nodes_stats()["nodes"].items():
+            roles = section.get("roles")
+            if roles is None:
+                # The standalone / coordinating front (no cluster role
+                # payload): the single-process reference shape.
+                roles = ["data", "ingest", "master"]
+            master = section.get("master")
+            if master is None:
+                master = self.replication is None
+            process = section.get("process") or {}
+            indices = section.get("indices") or {}
+            rows.append(
+                {
+                    "id": name,
+                    "name": name,
+                    "node.role": "".join(
+                        sorted(role_letters.get(r, "-") for r in roles)
+                    ),
+                    "master": "*" if master else "-",
+                    "load": str(int(process.get("inflight_searches", 0))),
+                    "docs": str(
+                        int((indices.get("docs") or {}).get("count", 0))
+                    ),
+                    "step_errors": str(int(section.get("step_errors", 0))),
+                }
+            )
+        return rows
+
     def cat_segments(self) -> list[dict]:
         rows = []
         for name, svc in sorted(self.indices.items()):
@@ -3756,10 +3932,106 @@ class Node:
         }
         return refresh, merges
 
+    def _cluster_obs_stats(self) -> dict:
+        """The obs.cluster section: fan-in rounds/failures/latency plus
+        trace-fragment and hot-threads accounting (views over the
+        estpu_nodes_stats_* / estpu_trace_fragments_* /
+        estpu_hot_threads_* instruments)."""
+        from .obs.metrics import NODES_FAN_LATENCY_MS_BUCKETS
+
+        latency = self.metrics.histogram(
+            "estpu_nodes_stats_fan_latency_ms",
+            NODES_FAN_LATENCY_MS_BUCKETS,
+            "Wall-clock fan-in latency of stats/obs scatter rounds",
+        ).snapshot()
+        count = latency["count"]
+        return {
+            "fanouts": {
+                action: int(v)
+                for action, v in sorted(
+                    self.metrics.label_values(
+                        "estpu_nodes_stats_fanouts_total", "action"
+                    ).items()
+                )
+            },
+            "fan_failures": {
+                action: int(v)
+                for action, v in sorted(
+                    self.metrics.label_values(
+                        "estpu_nodes_stats_fan_failures_total", "action"
+                    ).items()
+                )
+            },
+            "fan_latency_ms": {
+                "count": int(count),
+                "mean": (
+                    round(latency["sum"] / count, 3) if count else 0.0
+                ),
+            },
+            "trace_fragments_collected": int(
+                self.metrics.value("estpu_trace_fragments_collected_total")
+            ),
+            "hot_threads_samples": int(
+                self.metrics.value("estpu_hot_threads_samples_total")
+            ),
+        }
+
     def nodes_stats(self) -> dict:
-        """GET /_nodes/stats — serving-resilience counters: SPMD mesh
-        circuit-breaker state and disable/re-enable events per index, plus
-        replication gateway retry/failover counts when clustered."""
+        """GET /_nodes/stats — cluster-scoped scatter/fan-in (the
+        reference's TransportNodesStatsAction shape): the coordinating
+        node's own sections plus, when clustered, one reference-shaped
+        section per member collected over the `node_stats` wire action,
+        under a `_nodes: {total, successful, failed}` header. A dead or
+        wedged member becomes a NAMED failure entry within the per-send
+        deadline — never a hang. The in-memory LocalCluster and the
+        multi-process ProcCluster paths ship the SAME per-node payload
+        (ClusterNode.node_stats_local), so the response shape is one
+        across transports."""
+        header: dict[str, Any] = {
+            "total": 1,
+            "successful": 1,
+            "failed": 0,
+        }
+        results: dict[str, Any] = {}
+        member_ids: list[str] = []
+        if self.replication is not None:
+            # Fan BEFORE snapshotting the local sections, so this very
+            # round's fan counters (a failure entry just recorded) are
+            # visible in the response's own obs.cluster view.
+            member_ids = sorted(self.replication.cluster.nodes)
+            results, failures = self._cluster_fan("node_stats", {})
+            header = {
+                "total": 1 + len(member_ids),
+                "successful": 1 + len(results),
+                "failed": len(failures),
+            }
+            if failures:
+                header["failures"] = failures
+        nodes: dict[str, Any] = {self.node_name: self._local_node_stats()}
+        for node_id in member_ids:
+            section = results.get(node_id)
+            if section is None:
+                continue
+            if node_id in nodes:
+                # The coordinating front shares this member's name (the
+                # default LocalCluster layout): keep the local keys and
+                # graft the member-only sections in.
+                merged = dict(section)
+                merged.update(nodes[node_id])
+                nodes[node_id] = merged
+            else:
+                nodes[node_id] = section
+        return {
+            "_nodes": header,
+            "cluster_name": self.cluster_name,
+            "nodes": nodes,
+        }
+
+    def _local_node_stats(self) -> dict:
+        """This coordinating node's own `_nodes/stats` sections:
+        serving-resilience counters, SPMD mesh circuit-breaker state and
+        disable/re-enable events per index, plus replication gateway
+        retry/failover counts when clustered."""
         mesh_views: dict[str, Any] = {}
         disable_events = 0
         reenable_events = 0
@@ -3892,15 +4164,17 @@ class Node:
             # Device-level launch instruments (obs/metrics.py): XLA
             # compile count/ms per plan class, H2D bytes, padding waste.
             "device": self.device.snapshot(),
-            # Tracing ring state (obs/tracing.py).
-            "obs": {"tracing": TRACER.stats()},
+            # Tracing ring state (obs/tracing.py) + cluster-scope fan-in
+            # accounting (estpu_nodes_stats_* / trace-fragment /
+            # hot-threads views).
+            "obs": {
+                "tracing": TRACER.stats(),
+                "cluster": self._cluster_obs_stats(),
+            },
         }
         if self.replication is not None:
             node_stats["replication"] = self.replication.stats()
-        return {
-            "cluster_name": self.cluster_name,
-            "nodes": {self.node_name: node_stats},
-        }
+        return node_stats
 
     def stats(self) -> dict:
         all_engines = [

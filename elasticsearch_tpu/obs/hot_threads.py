@@ -1,0 +1,177 @@
+"""Per-process hot-threads sampling (`GET /_nodes/hot_threads`).
+
+The reference's monitor/jvm/HotThreads.java samples every JVM thread's
+stack N times over an interval, buckets identical stacks, and renders the
+busiest threads as text. The Python form: ``sys._current_frames()`` gives
+every live thread's current frame; sampling it ``snapshots`` times over
+``interval_s`` yields, per thread, (a) how many snapshots caught it OFF a
+known-idle wait — the busyness rank; CPython exposes no portable
+per-thread CPU clock, so busy-snapshot fraction is the honest stand-in
+for the reference's per-thread cpu time — and (b) its most common stack,
+rendered reference-style ("M/N snapshots sharing following K elements").
+
+One call samples ONE process. The cluster view fans the ``hot_threads``
+wire action over every member and concatenates the per-node texts under
+``::: {node}`` headers, so a multi-process topology (cluster/procs.py)
+reports each worker's real interpreter state — the pid in the header is
+what distinguishes true worker processes from in-process cluster members
+sharing the coordinator's interpreter.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import Counter
+from typing import Any
+
+# A thread whose TOP frame is one of these functions is parked, not hot:
+# waiting on a lock/queue/socket/selector. The analog of the reference's
+# known-idle filter (epollWait, Unsafe.park, ...).
+_IDLE_TOP_FUNCS = frozenset(
+    {
+        "wait",
+        "_wait_for_tstate_lock",
+        "select",
+        "poll",
+        "epoll",
+        "accept",
+        "recv",
+        "recvfrom",
+        "recv_into",
+        "readinto",
+        "get",
+        "sleep",
+        "_recv_exact",
+        "park",
+    }
+)
+MAX_STACK_DEPTH = 40
+MAX_SNAPSHOTS = 100
+
+
+def _stack_of(frame: Any) -> tuple[str, ...]:
+    out: list[str] = []
+    while frame is not None and len(out) < MAX_STACK_DEPTH:
+        code = frame.f_code
+        out.append(
+            f"{code.co_name} "
+            f"({os.path.basename(code.co_filename)}:{frame.f_lineno})"
+        )
+        frame = frame.f_back
+    return tuple(out)
+
+
+def sample_hot_threads(
+    threads: int = 3,
+    interval_s: float = 0.5,
+    snapshots: int = 10,
+    metrics=None,
+) -> list[dict[str, Any]]:
+    """Sample this process' threads; busiest first.
+
+    Each entry: thread name, busy-snapshot count, total snapshots, the
+    most common stack (top frame first) and how many snapshots shared it.
+    The sampling thread itself is excluded — a hot-threads request must
+    never report its own collection loop as the hottest thread."""
+    snapshots = max(1, min(MAX_SNAPSHOTS, int(snapshots)))
+    threads = max(1, int(threads))
+    interval_s = max(0.0, min(30.0, float(interval_s)))
+    pause = interval_s / snapshots
+    me = threading.get_ident()
+    busy: Counter = Counter()
+    seen: Counter = Counter()
+    stacks: dict[int, Counter] = {}
+    for i in range(snapshots):
+        if i and pause:
+            time.sleep(pause)
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue
+            stack = _stack_of(frame)
+            if not stack:
+                continue
+            seen[ident] += 1
+            top_func = stack[0].split(" (", 1)[0]
+            if top_func not in _IDLE_TOP_FUNCS:
+                busy[ident] += 1
+            stacks.setdefault(ident, Counter())[stack] += 1
+    if metrics is not None:
+        metrics.counter(
+            "estpu_hot_threads_samples_total",
+            "Hot-threads stack snapshots taken by this process",
+        ).inc(snapshots)
+    names = {t.ident: t.name for t in threading.enumerate()}
+    ranked = sorted(
+        seen, key=lambda i: (-busy[i], -seen[i], names.get(i, ""))
+    )
+    out = []
+    for ident in ranked[:threads]:
+        stack, shared = stacks[ident].most_common(1)[0]
+        out.append(
+            {
+                "name": names.get(ident, f"thread-{ident}"),
+                "busy_snapshots": int(busy[ident]),
+                "snapshots": snapshots,
+                "stack": list(stack),
+                "stack_shared_by": int(shared),
+            }
+        )
+    return out
+
+
+def fan_text_blocks(
+    results: dict, failures: list[dict], order=None
+) -> list[str]:
+    """Per-node text blocks of a `hot_threads` fan, shared by the Node
+    and ProcCluster assemblers: sampled nodes in the given order, then
+    one failure line per node that could not be sampled."""
+    blocks = [
+        str((results[node_id] or {}).get("text", ""))
+        for node_id in (sorted(results) if order is None else order)
+        if node_id in results
+    ]
+    for failure in failures:
+        blocks.append(
+            f"::: {{{failure['node']}}}\n   hot_threads collection "
+            f"failed: {failure['reason']}\n"
+        )
+    return blocks
+
+
+def hot_threads_text(
+    node_name: str = "",
+    threads: int = 3,
+    interval_s: float = 0.5,
+    snapshots: int = 10,
+    metrics=None,
+) -> str:
+    """The reference-style text block for one process' sample."""
+    sampled = sample_hot_threads(
+        threads=threads,
+        interval_s=interval_s,
+        snapshots=snapshots,
+        metrics=metrics,
+    )
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime())
+    lines = [
+        f"::: {{{node_name or 'node'}}} pid[{os.getpid()}]",
+        f"   Hot threads at {stamp}Z, interval={int(interval_s * 1e3)}ms, "
+        f"busiestThreads={threads}, snapshots={snapshots}:",
+        "",
+    ]
+    for entry in sampled:
+        lines.append(
+            f"   {entry['busy_snapshots']}/{entry['snapshots']} snapshots "
+            f"busy in thread '{entry['name']}'"
+        )
+        lines.append(
+            f"     {entry['stack_shared_by']}/{entry['snapshots']} "
+            f"snapshots sharing following {len(entry['stack'])} elements"
+        )
+        for element in entry["stack"]:
+            lines.append(f"       {element}")
+        lines.append("")
+    return "\n".join(lines)

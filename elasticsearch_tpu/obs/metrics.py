@@ -264,37 +264,38 @@ class MetricsRegistry:
             out[name] = (kind, help_text, samples)
         return out
 
-    def exposition(self, *others: "MetricsRegistry") -> str:
+    def to_wire(self, *others: "MetricsRegistry") -> dict:
+        """JSON-serializable snapshot of every family (optionally merged
+        with other registries) — the federation payload the `metrics_wire`
+        cluster action ships so a worker process' instruments re-expose at
+        the coordinator's `GET /_metrics` (wrap the result in
+        WireRegistrySnapshot with a `node` label)."""
+        merged = _merge_collected(
+            [registry._collect() for registry in (self, *others)]
+        )
+        return {
+            name: {
+                "kind": kind,
+                "help": help_text,
+                "samples": [
+                    [[list(kv) for kv in key], sample]
+                    for key, sample in samples.items()
+                ],
+            }
+            for name, (kind, help_text, samples) in merged.items()
+        }
+
+    def exposition(self, *others) -> str:
         """The Prometheus text format 0.0.4 rendering of every family —
         optionally merged with other registries (the node merges its own
         with the replication gateway's and each cluster node's; samples
         that collide on (name, labels) sum, so per-node series should
-        carry a distinguishing label)."""
-        merged: dict[str, tuple[str, str, dict]] = {}
-        for registry in (self, *others):
-            for name, (kind, help_text, samples) in registry._collect().items():
-                entry = merged.get(name)
-                if entry is None:
-                    merged[name] = (kind, help_text, dict(samples))
-                    continue
-                if entry[0] != kind:  # conflicting kinds: keep the first
-                    continue
-                for key, sample in samples.items():
-                    prior = entry[2].get(key)
-                    if prior is None:
-                        entry[2][key] = sample
-                    elif kind == "histogram":
-                        entry[2][key] = {
-                            "buckets": {
-                                b: prior["buckets"].get(b, 0) + c
-                                for b, c in sample["buckets"].items()
-                            },
-                            "inf": prior["inf"] + sample["inf"],
-                            "sum": prior["sum"] + sample["sum"],
-                            "count": prior["count"] + sample["count"],
-                        }
-                    else:
-                        entry[2][key] = prior + sample
+        carry a distinguishing label). `others` accepts anything with a
+        `_collect()` view, including WireRegistrySnapshot (remote
+        registries shipped over the wire)."""
+        merged = _merge_collected(
+            [registry._collect() for registry in (self, *others)]
+        )
         lines: list[str] = []
         for name, (kind, help_text, samples) in sorted(merged.items()):
             if help_text:
@@ -326,6 +327,106 @@ class MetricsRegistry:
                         f"{name}{suffix} {_format_value(sample)}"
                     )
         return "\n".join(lines) + "\n"
+
+
+def _merge_collected(
+    collected: list[dict[str, tuple[str, str, dict]]],
+) -> dict[str, tuple[str, str, dict]]:
+    """Fold several `_collect()` views into one family map: samples that
+    collide on (name, labels) sum (histograms bucket-wise); families that
+    collide on name with a different kind keep the first registration."""
+    merged: dict[str, tuple[str, str, dict]] = {}
+    for families in collected:
+        for name, (kind, help_text, samples) in families.items():
+            entry = merged.get(name)
+            if entry is None:
+                merged[name] = (kind, help_text, dict(samples))
+                continue
+            if entry[0] != kind:  # conflicting kinds: keep the first
+                continue
+            for key, sample in samples.items():
+                prior = entry[2].get(key)
+                if prior is None:
+                    entry[2][key] = sample
+                elif kind == "histogram":
+                    entry[2][key] = {
+                        "buckets": {
+                            b: prior["buckets"].get(b, 0) + c
+                            for b, c in sample["buckets"].items()
+                        },
+                        "inf": prior["inf"] + sample["inf"],
+                        "sum": prior["sum"] + sample["sum"],
+                        "count": prior["count"] + sample["count"],
+                    }
+                else:
+                    entry[2][key] = prior + sample
+    return merged
+
+
+class WireRegistrySnapshot:
+    """Re-exposes a remote registry's wire families (`to_wire` output) in
+    `exposition()` merges, stamping extra labels onto every sample — the
+    federation `node` label that keeps one worker's series from colliding
+    with another's at the coordinator scrape."""
+
+    def __init__(self, families: dict | None, **labels):
+        self.families = families or {}
+        self.labels = {k: str(v) for k, v in labels.items()}
+
+    def _collect(self) -> dict[str, tuple[str, str, dict]]:
+        out: dict[str, tuple[str, str, dict]] = {}
+        for name, fam in self.families.items():
+            samples: dict = {}
+            for key, sample in fam.get("samples", ()):
+                labels = {str(k): str(v) for k, v in key}
+                labels.update(self.labels)
+                samples[tuple(sorted(labels.items()))] = sample
+            out[name] = (
+                str(fam.get("kind", "counter")),
+                str(fam.get("help", "")),
+                samples,
+            )
+        return out
+
+
+class _CollectedView:
+    """A pre-built `_collect()` view (exposition merge input)."""
+
+    def __init__(self, families: dict[str, tuple[str, str, dict]]):
+        self._families = families
+
+    def _collect(self) -> dict[str, tuple[str, str, dict]]:
+        return self._families
+
+
+def fold_cluster_counters(
+    snapshots: list[WireRegistrySnapshot],
+    label: str = "node",
+    value: str = "_cluster",
+) -> _CollectedView:
+    """Cluster-total series for a federated scrape: every COUNTER sample
+    of the per-node snapshots sums into one `node="_cluster"` sample per
+    (family, labels). Samples whose original key already carried the fold
+    label are skipped — they are per-node by construction and folding
+    them would double-count across the label dimension. Gauges and
+    histograms stay per-node only (a summed gauge is not a meaningful
+    cluster value)."""
+    totals: dict[str, tuple[str, str, dict]] = {}
+    for snap in snapshots:
+        for name, fam in snap.families.items():
+            if fam.get("kind") != "counter":
+                continue
+            for key, sample in fam.get("samples", ()):
+                labels = {str(k): str(v) for k, v in key}
+                if label in labels:
+                    continue
+                labels[label] = value
+                fkey = tuple(sorted(labels.items()))
+                entry = totals.setdefault(
+                    name, ("counter", str(fam.get("help", "")), {})
+                )
+                entry[2][fkey] = entry[2].get(fkey, 0.0) + float(sample)
+    return _CollectedView(totals)
 
 
 # Instrument catalog: every estpu_* instrument in the codebase, its
@@ -470,6 +571,17 @@ CATALOG = {
     "estpu_transport_frames_total": ("counter", "replication.transport"),
     "estpu_transport_frame_bytes_total": ("counter", "replication.transport"),
     "estpu_transport_open_connections": ("gauge", "replication.transport"),
+    # Cluster-scope observability fan-in (cluster/transport.scatter_nodes
+    # + the node_stats / metrics_wire / trace_fragment / hot_threads wire
+    # actions): scatter rounds by action, named per-node failures,
+    # wall-clock fan latency, trace-fragment spans shipped from / spliced
+    # at nodes, and hot-threads stack snapshots taken by this process.
+    "estpu_nodes_stats_fanouts_total": ("counter", "obs.cluster"),
+    "estpu_nodes_stats_fan_failures_total": ("counter", "obs.cluster"),
+    "estpu_nodes_stats_fan_latency_ms": ("histogram", "obs.cluster"),
+    "estpu_trace_fragments_shipped_total": ("counter", "obs.cluster"),
+    "estpu_trace_fragments_collected_total": ("counter", "obs.cluster"),
+    "estpu_hot_threads_samples_total": ("counter", "obs.cluster"),
 }
 
 # Pow-2-ish bounds for the padding-waste ratio and occupancy/wait shapes.
@@ -479,6 +591,11 @@ BLOCKMAX_PRUNE_BUCKETS = (0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99)
 OCCUPANCY_BUCKETS = tuple(float(1 << i) for i in range(9))  # 1..256
 QUEUE_WAIT_MS_BUCKETS = (
     0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 1024.0,
+)
+# Wall-clock latency of one cluster-wide stats/obs scatter round; the
+# top bounds cover a fan that rode its per-send deadline out.
+NODES_FAN_LATENCY_MS_BUCKETS = (
+    1.0, 4.0, 16.0, 64.0, 256.0, 1024.0, 4096.0, 16384.0,
 )
 
 

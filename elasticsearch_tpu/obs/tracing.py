@@ -132,6 +132,9 @@ class Span:
             "parent_id": self.parent_id,
             "name": self.name,
             "start_time_in_millis": int(self.start_ms),
+            # Sub-millisecond start for renderers (chrome_trace): spans
+            # fanned within one millisecond must keep their real order.
+            "start_ms": round(self.start_ms, 3),
             "duration_ms": (
                 round(self.duration_ms, 3)
                 if self.duration_ms is not None
@@ -425,34 +428,7 @@ class Tracer:
         spans = self.get(trace_id)
         if spans is None:
             return None
-        events = []
-        for s in spans:
-            dur_ms = (
-                s.duration_ms
-                if s.duration_ms is not None
-                else (time.monotonic() - s.start_mono) * 1e3
-            )
-            args: dict[str, Any] = {
-                "span_id": s.span_id,
-                "parent_id": s.parent_id,
-                "status": s.status,
-            }
-            args.update(s.tags)
-            if s.events:
-                args["events"] = s.events
-            events.append(
-                {
-                    "name": s.name,
-                    "ph": "X",
-                    "ts": s.start_ms * 1e3,  # Chrome wants microseconds
-                    "dur": max(1.0, dur_ms * 1e3),
-                    "pid": 1,
-                    "tid": 1,
-                    "cat": "estpu",
-                    "args": args,
-                }
-            )
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        return chrome_trace([s.to_json() for s in spans])
 
     def clear(self) -> None:
         """Drop buffered AND in-flight spans (test isolation)."""
@@ -469,6 +445,91 @@ class Tracer:
                 "in_flight_traces": len(self._active),
                 "buffer_capacity": self.max_traces,
             }
+
+
+def splice_spans(span_lists: list[list[dict]]) -> list[dict]:
+    """Splice span-JSON fragments collected from several processes into
+    ONE tree's span list — the assembly half of distributed tracing.
+    Remote spans already parent into the caller's tree via the `_trace`
+    wire context, so no id fixup is needed; splicing is dedup (by
+    span_id — the same span can arrive both locally and via a fragment
+    when cluster members share a process, and a finished version beats an
+    in-progress one) plus a stable start-time ordering."""
+    by_id: dict[str, dict] = {}
+    for spans in span_lists:
+        for span in spans or ():
+            sid = str(span.get("span_id"))
+            prev = by_id.get(sid)
+            if prev is None or (
+                prev.get("in_progress") and not span.get("in_progress")
+            ):
+                by_id[sid] = span
+    return sorted(
+        by_id.values(),
+        key=lambda s: (
+            s.get("start_ms", s.get("start_time_in_millis", 0)),
+            str(s.get("span_id")),
+        ),
+    )
+
+
+def collect_fragments(
+    local_spans: list[Span] | None, fragment_results: dict
+) -> tuple[list[dict], int]:
+    """The coordinator half of trace assembly, shared by Node.get_trace
+    and ProcCluster.trace: this process' own spans plus the
+    `trace_fragment` fan results → (ONE spliced span-JSON list, count of
+    remote spans collected)."""
+    fragments: list[list[dict]] = []
+    if local_spans is not None:
+        fragments.append([s.to_json() for s in local_spans])
+    collected = 0
+    for node_id in sorted(fragment_results):
+        spans = (fragment_results[node_id] or {}).get("spans")
+        if spans:
+            fragments.append(spans)
+            collected += len(spans)
+    return splice_spans(fragments), collected
+
+
+def chrome_trace(spans: list[dict]) -> dict[str, Any]:
+    """Chrome trace-event JSON from span JSON (`Span.to_json` shapes):
+    complete 'X' events in microseconds. Spans are laned by their `node`
+    tag — one tid per node — so a spliced cluster trace renders each
+    worker process as its own track in Perfetto."""
+    tids: dict[str, int] = {}
+    events = []
+    for span in spans:
+        node = str((span.get("tags") or {}).get("node", ""))
+        tid = tids.setdefault(node, len(tids) + 1)
+        args: dict[str, Any] = {
+            "span_id": span.get("span_id"),
+            "parent_id": span.get("parent_id"),
+            "status": span.get("status", "ok"),
+        }
+        args.update(span.get("tags") or {})
+        if span.get("events"):
+            args["events"] = span["events"]
+        events.append(
+            {
+                "name": span.get("name", "?"),
+                "ph": "X",
+                # Chrome wants microseconds; the float start_ms keeps
+                # sub-millisecond ordering of fanned spans.
+                "ts": float(
+                    span.get(
+                        "start_ms", span.get("start_time_in_millis", 0)
+                    )
+                )
+                * 1e3,
+                "dur": max(1.0, float(span.get("duration_ms") or 0.0) * 1e3),
+                "pid": 1,
+                "tid": tid,
+                "cat": "estpu",
+                "args": args,
+            }
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 # The process-wide tracer every instrumented site writes through, like

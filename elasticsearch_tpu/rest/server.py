@@ -132,6 +132,22 @@ def _timeout_param(q: dict) -> float | None:
         ) from None
 
 
+def _interval_param(q: dict) -> float:
+    """?interval=500ms on hot_threads (the reference's sample interval)."""
+    if "interval" not in q:
+        return 0.5
+    from ..common.units import parse_duration_s
+
+    try:
+        return parse_duration_s(q["interval"])
+    except ValueError:
+        raise ApiError(
+            400,
+            "illegal_argument_exception",
+            f"failed to parse [interval]: [{q['interval']}]",
+        ) from None
+
+
 def _partial_param(q: dict) -> bool | None:
     """?allow_partial_search_results= (the reference's URL param): None
     when absent (body/default wins), else the boolean. Anything but
@@ -251,6 +267,17 @@ class RestServer:
         r("GET", "/_cluster/stats", lambda s, p, q, b: n.cluster_stats())
         r("GET", "/_nodes", lambda s, p, q, b: n.nodes_info())
         r("GET", "/_nodes/stats", lambda s, p, q, b: n.nodes_stats())
+        # Per-node thread-stack sampling, fanned over cluster members
+        # (the reference's RestNodesHotThreadsAction; text response).
+        r("GET", "/_nodes/hot_threads", lambda s, p, q, b: PlainText(
+            n.hot_threads(
+                threads=int(q.get("threads", 3)),
+                interval_s=_interval_param(q),
+                snapshots=int(q.get("snapshots", 10)),
+            ),
+            content_type="text/plain; charset=utf-8",
+        ))
+        r("GET", "/_cat/nodes", lambda s, p, q, b: n.cat_nodes())
         r("GET", "/_cat/plugins", lambda s, p, q, b: [
             {"name": n.node_name, "component": name}
             for name in n.plugin_names

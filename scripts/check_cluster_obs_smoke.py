@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""CI smoke gate for cluster-scope observability (ISSUE 13).
+
+Runs, on the CPU backend with no TPU in the loop:
+
+- wire-fanned `_nodes/stats` (per-node sections + `_nodes` header, named
+  failure entries within the per-send deadline after killing a member,
+  hub/tcp response-shape parity),
+- the federated `/_metrics` scrape (node-labeled worker series +
+  `node="_cluster"` counter folds),
+- distributed trace assembly (ONE spliced tree containing remote
+  `cluster.shard_search` / `search.segment` spans, chrome export laned
+  per node), and
+- `GET /_nodes/hot_threads` sampling across real worker processes
+  (ProcCluster: each interpreter samples itself).
+
+The same tests ride the tier-1 run via the fast (`not slow`) marker;
+this script is the standalone hook for pre-merge / cron checks,
+mirroring scripts/check_socket_smoke.py:
+
+    python scripts/check_cluster_obs_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "tests/test_cluster_obs.py",
+        "-q",
+        "-m",
+        "not slow",
+        "-p",
+        "no:cacheprovider",
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

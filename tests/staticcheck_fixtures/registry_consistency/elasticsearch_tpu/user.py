@@ -67,6 +67,16 @@ def make_transport_instruments(m):
     )
 
 
+def make_nodes_fan_instruments(m):
+    # A cluster-observability fan-in instrument (`_nodes/stats` scatter,
+    # trace-fragment shipping, hot-threads sampling) that never made it
+    # into the CATALOG must fail like any other rogue registration.
+    m.counter(
+        "estpu_nodes_rogue_total",
+        "nodes fan-in instrument not in CATALOG",
+    )
+
+
 def make_merge_instruments(m):
     # A refresh/merge instrument that never made it into the CATALOG must
     # fail exactly like any other rogue estpu_* registration.

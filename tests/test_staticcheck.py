@@ -165,7 +165,9 @@ class TestRegistryConsistency:
         assert any("[estpu_transport_rogue_total]" in m for m in msgs)
         # ... and an uncataloged refresh/merge instrument
         assert any("[estpu_merge_rogue_total]" in m for m in msgs)
-        assert len(msgs) == 9
+        # ... and an uncataloged cluster-observability fan-in instrument
+        assert any("[estpu_nodes_rogue_total]" in m for m in msgs)
+        assert len(msgs) == 10
 
     def test_bool_spec(self, report):
         msgs = [f.message for f in report.findings if f.rule == "bool-spec"]
