@@ -1058,12 +1058,19 @@ def bench_cfg3_conjunction(n_shards=8, shard_docs=125_000, n_q=32):
     def run_sequential():
         outs = []
         for spec, _pos, arrs, _h in buckets:
-            outs.append(
-                bm25_device.execute_shards_sequential(
-                    stacked, spec, arrs, K, shard_docs
+            # Timed-launch window (obs/metrics.DeviceInstruments.timed):
+            # attributes any XLA compile to this plan key, so a
+            # recompile-per-query regression during the measured reps
+            # shows up as retraces — the cfg3 bench gate. dispatched()
+            # blocks, preserving the scans-must-not-overlap contract.
+            with instr.timed("bool_seq", (spec, K, "seq"), "device") as tl:
+                outs.append(
+                    tl.dispatched(
+                        bm25_device.execute_shards_sequential(
+                            stacked, spec, arrs, K, shard_docs
+                        )
+                    )
                 )
-            )
-            jax.block_until_ready(outs[-1])  # scans must not overlap
         return outs
 
     seq_outs = run_sequential()
@@ -1104,12 +1111,19 @@ def bench_cfg3_conjunction(n_shards=8, shard_docs=125_000, n_q=32):
 
     # Batched (msearch) amortized throughput: one launch per sub-bucket.
     def run_batched():
-        outs = [
-            bm25_device.execute_shards_batch(
-                stacked, spec, arrs, K, shard_docs
-            )
-            for spec, _pos, arrs, _h in buckets
-        ]
+        outs = []
+        for spec, _pos, arrs, _h in buckets:
+            # Window without an in-window block: launches stay async
+            # (amortization is the point here); compile attribution
+            # still lands because tracing happens inside dispatch.
+            with instr.timed(
+                "bool_batched", (spec, K, "batched"), "device_batched"
+            ):
+                outs.append(
+                    bm25_device.execute_shards_batch(
+                        stacked, spec, arrs, K, shard_docs
+                    )
+                )
         jax.block_until_ready(outs)
         return outs
 
@@ -2150,6 +2164,159 @@ def bench_cfg11_obs_scrape(
     }
 
 
+def bench_cfg12_device_obs(n_docs=None, n_q=24, reps=6):
+    """ISSUE 14 config: device observability is free at serving time.
+
+    The same cfg3-style filtered mix serves on two Nodes over one
+    corpus: one with the per-launch timing wrapper + HBM ledger enabled
+    (the default) and one with ESTPU_DEVICE_OBS=0 (instruments off — the
+    DeviceInstruments handle is None at every launch site, the ledger
+    no-ops). Gates: instrumented p50 within 1.05x of instruments-off
+    (plus a 0.2 ms CPU-jitter floor), hits bit-identical between the two
+    nodes, and a `/_profiler` round trip (start → serve traffic → stop)
+    produces a loadable Perfetto trace directory (a .trace.json.gz under
+    plugins/profile/). Phases interleave on/off/on/off and take each
+    side's best median so one-directional machine drift cannot fake a
+    regression (the cfg11 methodology)."""
+    import os
+
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.obs import device as device_obs
+    from elasticsearch_tpu.utils.corpus import (
+        build_zipf_segment,
+        pick_query_terms,
+    )
+
+    if n_docs is None:
+        n_docs = int(os.environ.get("ESTPU_BENCH_DEVOBS_N", 100_000))
+    rng = np.random.default_rng(77)
+    t0 = time.monotonic()
+    _, base_seg = build_zipf_segment(
+        n_docs, vocab_size=20_000, seed=41, with_sources=True
+    )
+    base_seg.doc_values["rank"] = rng.random(n_docs).astype(np.float64)
+    term_sets = pick_query_terms(base_seg, rng, n_q)
+    bodies = []
+    for terms in term_sets:
+        lo = float(rng.random() * 0.4)
+        bodies.append(
+            {
+                "query": {
+                    "bool": {
+                        "must": [{"match": {"body": " ".join(terms[:2])}}],
+                        "filter": [
+                            {"range": {"rank": {"gte": lo, "lte": lo + 0.5}}}
+                        ],
+                    }
+                },
+                "size": K,
+            }
+        )
+
+    def build_node(device_obs_on: bool) -> Node:
+        prev = os.environ.get("ESTPU_DEVICE_OBS")
+        os.environ["ESTPU_DEVICE_OBS"] = "1" if device_obs_on else "0"
+        try:
+            node = Node()
+        finally:
+            if prev is None:
+                os.environ.pop("ESTPU_DEVICE_OBS", None)
+            else:
+                os.environ["ESTPU_DEVICE_OBS"] = prev
+        node.create_index(
+            "devobs",
+            {
+                "mappings": {
+                    "properties": {
+                        "body": {"type": "text"},
+                        "rank": {"type": "float"},
+                    }
+                }
+            },
+        )
+        engine = node.indices["devobs"].engines[0]
+        engine.restore_segments([(base_seg, np.ones(n_docs, dtype=bool))])
+        node.refresh("devobs")
+        for body in bodies:  # warm: compiles + cache admissions
+            node.search("devobs", body)
+            node.search("devobs", body)
+        return node
+
+    node_on = build_node(True)
+    node_off = build_node(False)
+    assert node_on.device is not None and node_off.device is None
+    build_s = time.monotonic() - t0
+
+    def measure(node, record_hits: bool):
+        times = []
+        hits = []
+        for _ in range(reps):
+            for qi, body in enumerate(bodies):
+                t1 = time.monotonic()
+                resp = node.search("devobs", body)
+                times.append(time.monotonic() - t1)
+                if record_hits and len(hits) < n_q:
+                    hits.append(
+                        [
+                            (h["_id"], h["_score"])
+                            for h in resp["hits"]["hits"]
+                        ]
+                    )
+        return float(np.median(times)) * 1e3, hits
+
+    # Interleaved phases, best-of-two per side (drift damping).
+    on_a, on_hits = measure(node_on, record_hits=True)
+    off_a, off_hits = measure(node_off, record_hits=True)
+    on_b, _ = measure(node_on, record_hits=False)
+    off_b, _ = measure(node_off, record_hits=False)
+    on_p50 = min(on_a, on_b)
+    off_p50 = min(off_a, off_b)
+    mismatches = sum(
+        1 for got, want in zip(on_hits, off_hits) if got != want
+    )
+    ratio = (on_p50 / off_p50) if off_p50 else 0.0
+    overhead_ok = on_p50 <= off_p50 * 1.05 + 0.2
+
+    # /_profiler round trip on the instrumented node: capture a few
+    # launches, then verify the directory holds a Perfetto-loadable
+    # trace (jax writes plugins/profile/<ts>/*.trace.json.gz).
+    start = node_on.profiler_start({"duration_s": 60})
+    for body in bodies[:4]:
+        node_on.search("devobs", body)
+    stop = node_on.profiler_stop()
+    trace_files = [
+        os.path.join(root, f)
+        for root, _dirs, files in os.walk(stop["trace_dir"])
+        for f in files
+    ]
+    perfetto_ok = any(f.endswith(".trace.json.gz") for f in trace_files)
+
+    ledger = node_on.hbm_ledger.snapshot()
+    return {
+        "mismatches": mismatches,
+        "instrumented_p50_ms": round(on_p50, 3),
+        "instruments_off_p50_ms": round(off_p50, 3),
+        "p50_ratio_on_over_off": round(ratio, 3),
+        "overhead_ok": overhead_ok,
+        "profiler_trace_dir": start["trace_dir"],
+        "profiler_capture_ms": stop["duration_ms"],
+        "perfetto_trace_ok": perfetto_ok,
+        "perfetto_trace_files": len(trace_files),
+        "hbm_total_bytes": ledger["total_bytes"],
+        "hbm_high_watermark_bytes": ledger["high_watermark_bytes"],
+        "hbm_breaker_drift_bytes": ledger.get("breaker_drift_bytes", 0),
+        "retraces": (
+            node_on.device.retraces_total()
+            if node_on.device is not None
+            else 0
+        ),
+        "compile_count": device_obs.process_census()["compiles"],
+        "n_docs": n_docs,
+        "n_queries": n_q,
+        "corpus_build_s": round(build_s, 1),
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -2209,21 +2376,28 @@ def main():
         MetricsRegistry,
     )
 
+    from elasticsearch_tpu.obs import device as device_obs
+
     obs_registry = MetricsRegistry()
     device_instr = DeviceInstruments(obs_registry)
+    census_cfg2_start = device_obs.process_census()
     for spec_g, positions in groups.items():
         arrays_b = jax.tree.map(
             lambda *xs: np.stack(xs),
             *[compiled[p].arrays for p in positions],
         )
         device_instr.h2d(arrays_b)
-        t0 = time.monotonic()
-        jax.block_until_ready(
-            bm25_device.execute_batch_sparse(seg_tree, spec_g, arrays_b, K)
-        )
-        device_instr.launch(
-            f"{spec_g[0]}_batched", (spec_g, K), time.monotonic() - t0
-        )
+        # First timed launch per shape group: the compile census
+        # attributes the real XLA compile to this plan key (a first
+        # launch, so never a retrace), and later steady-state windows on
+        # the SAME key turn any further compile into a retrace — the
+        # shape-polymorphism gate cfg2 carries.
+        with device_instr.timed(
+            f"{spec_g[0]}_batched", (spec_g, K), "device_batched"
+        ) as tl:
+            tl.dispatched(
+                bm25_device.execute_batch_sparse(seg_tree, spec_g, arrays_b, K)
+            )
     from elasticsearch_tpu.search.service import (
         family_padding_tiles,
         sparse_family_key,
@@ -2287,9 +2461,19 @@ def main():
                 lambda *xs: np.stack(xs),
                 *[compiled[p].arrays for p in positions],
             )
-            launched.append(
-                bm25_device.execute_batch_sparse(seg_tree, spec_g, arrays_b, K)
-            )
+            # Retrace-attribution window WITHOUT an in-window block:
+            # dispatch stays async (the next group's staging overlaps
+            # device execution — the measured pipeline), while a compile
+            # fired during dispatch of this already-seen key counts as a
+            # retrace and fails the cfg2 gate.
+            with device_instr.timed(
+                f"{spec_g[0]}_batched", (spec_g, K), "device_batched"
+            ):
+                launched.append(
+                    bm25_device.execute_batch_sparse(
+                        seg_tree, spec_g, arrays_b, K
+                    )
+                )
         # One device->host fetch per pass (the _msearch response step).
         fetched.append(jax.device_get(launched))
 
@@ -2414,6 +2598,8 @@ def main():
         sq.append(time.monotonic() - t0)
     single_query_ms = float(np.median(sq)) * 1e3
 
+    census_cfg2_end = device_obs.process_census()
+
     o_p50 = float(np.median(oracle_times))
     speedup_batched = (
         (o_p50 / device_per_query) if device_per_query > 0 else 0.0
@@ -2445,11 +2631,30 @@ def main():
         ("cfg9_ann", bench_cfg9_ann),
         ("cfg10_ingest", bench_cfg10_ingest),
         ("cfg11_obs_scrape", bench_cfg11_obs_scrape),
+        ("cfg12_device_obs", bench_cfg12_device_obs),
     ):
+        # Device-obs accounting per config (ISSUE 14): bracket every
+        # config with a process census + HBM window so each emits its
+        # real XLA compile count, retraces, and incremental HBM peak —
+        # whatever Nodes/registries the config built internally.
+        census0 = device_obs.process_census()
+        device_obs.begin_hbm_window()
         try:
             configs[name] = fn()
         except Exception as e:  # staticcheck: ignore[broad-except] per-config isolation: one failing bench config reports its error instead of zeroing the headline; no tasks or fault sites flow here
             configs[name] = {"error": f"{type(e).__name__}: {e}"}
+        census1 = device_obs.process_census()
+        if "error" not in configs[name]:
+            configs[name].setdefault(
+                "hbm_high_watermark_bytes", device_obs.hbm_window_peak()
+            )
+            configs[name].setdefault(
+                "compile_count",
+                census1["compiles"] - census0["compiles"],
+            )
+            configs[name].setdefault(
+                "retraces", census1["retraces"] - census0["retraces"]
+            )
     configs["cfg2_disjunction"] = {
         "speedup": round(speedup_single, 2),
         "device_p50_ms": round(single_p50 * 1e3, 4),
@@ -2459,6 +2664,19 @@ def main():
         "padding_waste_pct": device_instr.padding_waste_pct(),
         "n_docs": N_DOCS,
         "n_queries": N_QUERIES,
+        # Device-obs accounting over the cfg2 kernel sections (warmup
+        # through single-query round trip): real XLA compiles paid, and
+        # retraces — a compile during a steady-state launch of an
+        # already-seen shape group. The gate below fails the bench on
+        # any cfg2/cfg3 retrace (a recompile-per-query regression would
+        # silently triple p50 otherwise).
+        "hbm_high_watermark_bytes": 0,
+        "compile_count": (
+            census_cfg2_end["compiles"] - census_cfg2_start["compiles"]
+        ),
+        "retraces": (
+            census_cfg2_end["retraces"] - census_cfg2_start["retraces"]
+        ),
     }
     # ---- Adaptive routing: calibrate the exec cost model with the
     # measured per-backend p50s (the serving path's own EWMA loop) and let
@@ -2547,6 +2765,29 @@ def main():
     # inversion on cfg3; make it impossible to miss in future rounds.
     import sys
 
+    # Retrace gate (ISSUE 14): cfg2/cfg3 run steady-state shapes through
+    # timed-launch windows, so ANY real XLA compile landing on an
+    # already-seen plan key during their measured sections is a
+    # shape-polymorphism regression — fail the bench (zero the config's
+    # speedup) instead of letting a recompile-per-query silently triple
+    # p50.
+    retrace_gate_failures = []
+    for name in ("cfg2_disjunction", "cfg3_conj"):
+        cfg = configs.get(name) or {}
+        retraces = cfg.get("retraces", 0)
+        cfg["retrace_gate_ok"] = retraces == 0
+        if retraces:
+            retrace_gate_failures.append(name)
+            cfg["speedup"] = 0.0
+            print(
+                f"WARNING: {name}: {retraces} retraces during the "
+                "measured section — a plan class recompiled after its "
+                "first launch (shape-polymorphism regression); speedup "
+                "zeroed",
+                file=sys.stderr,
+                flush=True,
+            )
+
     batched_inversions = []
     for name, cfg in configs.items():
         b = cfg.get("device_batched_per_query_ms")
@@ -2597,6 +2838,10 @@ def main():
                 "configs": configs,
                 "configs_parity_ok": configs_parity_ok,
                 "batched_inversions": batched_inversions,
+                "retrace_gate_failures": retrace_gate_failures,
+                # Process-wide device-obs totals (obs/device.py census):
+                # real XLA compiles + retraces across every config.
+                "process_census": device_obs.process_census(),
                 "parity": "ids+order+fp32_scores+totals",
                 "n_spec_groups": len(groups),
                 "corpus_build_s": round(build_s, 1),

@@ -1287,6 +1287,7 @@ class ClusterNode:
         (the pid is what distinguishes real worker processes), stepper
         errors, and this node's transport counters."""
         from ..index.filter_cache import FilterCache
+        from ..obs.device import HbmLedger
 
         with self.lock:
             engines = dict(self.engines)
@@ -1321,6 +1322,17 @@ class ClusterNode:
                 "master_node": self.state.master,
             },
             "step_errors": int(self._step_errors.value),
+            # Per-node device.hbm section (ISSUE 14): cluster data nodes
+            # carry no write-through ledger (their engines run without a
+            # breaker), so the section is COMPUTED from component stats —
+            # by the consistency law the totals are the ledger totals.
+            # The coordinating front's cat_hbm reads this fanned shape.
+            "device": {
+                "hbm": HbmLedger.computed_section(
+                    engines_by_index=_engines_by_index(engines),
+                    filter_cache=self.filter_cache,
+                )
+            },
         }
         # Per-node transport view: a node owning its own endpoint (a
         # procs worker, or a TcpTransportHub member) reports endpoint-
@@ -1709,6 +1721,15 @@ class ClusterNode:
 def _batches(items: list, n: int):
     for i in range(0, len(items), n):
         yield items[i : i + n]
+
+
+def _engines_by_index(engines: dict) -> dict[str, list]:
+    """Group a ClusterNode's (index, shard) -> Engine map by index name
+    (the per-index attribution of the computed device.hbm section)."""
+    out: dict[str, list] = {}
+    for (index, _shard), engine in engines.items():
+        out.setdefault(index, []).append(engine)
+    return out
 
 
 class LocalCluster:

@@ -30,16 +30,27 @@ class BreakerError(Exception):
 
 
 class CircuitBreaker:
-    """Byte-budget accounting with reserve/settle/release semantics."""
+    """Byte-budget accounting with reserve/settle/release semantics.
 
-    def __init__(self, limit_bytes: int, name: str = "hbm"):
+    With a `ledger` attached (obs/device.HbmLedger), every mutation
+    WRITES THROUGH to it under the same (label, scope) — the single
+    mechanism that keeps breaker accounting and HBM-ledger accounting
+    from drifting (the ISSUE-14 consistency law). Labels must come from
+    the ledger's label registry (obs/device.LEDGER_LABELS; enforced by
+    staticcheck's registry-breaker-label rule at every call site).
+    """
+
+    def __init__(self, limit_bytes: int, name: str = "hbm", ledger=None):
         self.limit = int(limit_bytes)
         self.name = name
         self.used = 0
         self.trips = 0
         self._lock = threading.Lock()
+        self.ledger = ledger
+        if ledger is not None:
+            ledger.breaker = self
 
-    def add(self, n: int, label: str = "segment") -> None:
+    def add(self, n: int, label: str = "segment", scope=None) -> None:
         """Reserve n bytes; raises BreakerError over the limit."""
         from ..faults import fault_point
 
@@ -51,16 +62,24 @@ class CircuitBreaker:
                 self.trips += 1
                 raise BreakerError(n, self.used, self.limit, label)
             self.used += n
+        if self.ledger is not None:
+            self.ledger.register(label, scope, n, breaker_backed=True)
 
-    def add_unchecked(self, n: int) -> None:
+    def add_unchecked(
+        self, n: int, label: str = "segment", scope=None
+    ) -> None:
         """Account bytes that must land regardless (recovery, settle-up):
         the breaker protects against new allocations, not existing data."""
         with self._lock:
             self.used += n
+        if self.ledger is not None:
+            self.ledger.register(label, scope, n, breaker_backed=True)
 
-    def release(self, n: int) -> None:
+    def release(self, n: int, label: str = "segment", scope=None) -> None:
         with self._lock:
             self.used = max(0, self.used - n)
+        if self.ledger is not None:
+            self.ledger.release(label, scope, n, breaker_backed=True)
 
     def stats(self) -> dict:
         with self._lock:

@@ -213,6 +213,29 @@ class MicroBatcher:
             QUEUE_WAIT_MS_BUCKETS,
             "Queue wait before launch, milliseconds",
         )
+        # The batcher's leg of the per-launch timing story (ISSUE 14):
+        # riders' batch-queue waits and the coalesced launch's wall time
+        # land in the SAME estpu_launch_ms family as the kernel sites'
+        # dispatch/block splits, so one histogram answers "where does a
+        # batched search's time go" per phase.
+        from ..obs.metrics import LAUNCH_MS_BUCKETS
+
+        self._launch_queue_ms = metrics.histogram(
+            "estpu_launch_ms",
+            LAUNCH_MS_BUCKETS,
+            "Per-launch wall ms by plan class/backend and phase",
+            plan_class="batcher_group",
+            backend="batcher",
+            phase="queue",
+        )
+        self._launch_exec_ms = metrics.histogram(
+            "estpu_launch_ms",
+            LAUNCH_MS_BUCKETS,
+            "Per-launch wall ms by plan class/backend and phase",
+            plan_class="batcher_group",
+            backend="batcher",
+            phase="execute",
+        )
         def _queued_depth() -> int:
             # Scrapes race queue mutation: snapshot under the condition
             # lock (a lock-free sum can die mid-iteration and silently
@@ -603,6 +626,7 @@ class MicroBatcher:
             except Exception as e:  # whole-launch failure
                 results = [e] * len(live)
             launch_t1 = time.monotonic()
+            self._launch_exec_ms.observe((launch_t1 - launch_t0) * 1e3)
             for item, result in zip(live, results):
                 failed = isinstance(result, Exception)
                 # The coalesced-launch span, shared across batchmates: the
@@ -685,3 +709,4 @@ class MicroBatcher:
             for item in batch:
                 self._wait_samples.append(item.queue_wait_s)
                 self._queue_wait_hist.observe(item.queue_wait_s * 1e3)
+                self._launch_queue_ms.observe(item.queue_wait_s * 1e3)

@@ -55,7 +55,7 @@ import numpy as np
 
 from ..common.tasks import TaskCancelledError
 from ..faults import fault_point
-from ..obs.metrics import OCCUPANCY_BUCKETS
+from ..obs.metrics import OCCUPANCY_BUCKETS, timed_launch
 from ..query.dsl import (
     BoolQuery,
     ConstantScoreQuery,
@@ -132,7 +132,7 @@ class PackedExecutor:
     # (HBM duplication bound; riders past the budget fall back solo).
     MAX_PLANE_DOCS = 4_000_000
 
-    def __init__(self, metrics=None, planner=None, device=None):
+    def __init__(self, metrics=None, planner=None, device=None, ledger=None):
         if metrics is None:
             from ..obs.metrics import MetricsRegistry
 
@@ -140,6 +140,11 @@ class PackedExecutor:
         self.metrics = metrics
         self.planner = planner
         self.device = device  # obs.DeviceInstruments (launch/h2d/padding)
+        # obs.device.HbmLedger: packed planes duplicate member postings
+        # on device, so their bytes register under label "packed_plane"
+        # (scope "_packed") — plane installs swap the registration.
+        self.ledger = ledger
+        self._plane_nbytes = 0
         self._lock = threading.Lock()
         # Known packable tenants (weak: a deleted index must not be kept
         # alive, nor resurrect into the next plane).
@@ -516,16 +521,18 @@ class PackedExecutor:
             lo[pos], hi[pos] = plane.member_bounds(member)
         if self.device is not None:
             self.device.h2d(arrays_b)
-        s_b, i_b, t_b = jax.device_get(
-            bm25_device.execute_batch_packed(
-                tree, spec, arrays_b, lo, hi, k_max
+        # Per-launch queue/execute split + retrace-census attribution
+        # (obs/metrics.DeviceInstruments.timed).
+        with timed_launch(
+            self.device, "packed_batched", (spec, k_max, "packed"), "packed"
+        ) as tl:
+            out = tl.dispatched(
+                bm25_device.execute_batch_packed(
+                    tree, spec, arrays_b, lo, hi, k_max
+                )
             )
-        )
+        s_b, i_b, t_b = jax.device_get(out)
         elapsed = time.monotonic() - t0
-        if self.device is not None:
-            self.device.launch(
-                "packed_batched", (spec, k_max, "packed"), elapsed
-            )
         self._launches.inc()
         self._lanes_total.inc(len(rows))
         n_tenants = len({wrapped[r[0]].svc.uuid for r in rows})
@@ -612,11 +619,22 @@ class PackedExecutor:
         plane = pack_segments_packed(segs)
         tree = bm25_device.packed_segment_tree(plane)
         self._rebuilds.inc()
+        from ..index.tiles import packed_device_nbytes
+
+        nbytes = packed_device_nbytes(plane)
         with self._lock:
             self._plane = plane
             self._plane_tree = tree
             self._plane_key = key
             self._member_rows = member_rows
+            prev_nbytes, self._plane_nbytes = self._plane_nbytes, nbytes
+        if self.ledger is not None:
+            # Swap the ledger registration to the new plane — REGISTER
+            # first: during the swap both planes are genuinely resident
+            # (the old one's arrays become garbage only after references
+            # drop), and the high watermark must observe that peak.
+            self.ledger.register("packed_plane", "_packed", nbytes)
+            self.ledger.release("packed_plane", "_packed", prev_nbytes)
         return plane, tree, member_rows
 
     # -------------------------------------------------------------- stats
@@ -625,6 +643,7 @@ class PackedExecutor:
         """`GET /_nodes/stats` exec.packed payload."""
         with self._lock:
             plane = self._plane
+            plane_nbytes = self._plane_nbytes
             tenants = len(self._member_rows)
             members = sum(len(v) for v in self._member_rows.values())
         return {
@@ -633,6 +652,9 @@ class PackedExecutor:
             "plane_rebuilds": int(self._rebuilds.value),
             "fallback_solo": int(self._fallbacks.value),
             "plane_docs": plane.num_docs if plane is not None else 0,
+            # Device bytes of the resident plane — the consistency-law
+            # twin of the ledger's "packed_plane" registration.
+            "plane_bytes": int(plane_nbytes),
             "plane_tenants": tenants,
             "plane_members": members,
             "tenants_per_launch": {

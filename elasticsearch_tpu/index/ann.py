@@ -416,7 +416,9 @@ class AnnCache:
                 freed = 0
                 while True:
                     try:
-                        self.breaker.add(parts.nbytes, label="ann_cache")
+                        self.breaker.add(
+                            parts.nbytes, label="ann_cache", scope=key[0]
+                        )
                         reserved = True
                         break
                     except BreakerError:
@@ -432,7 +434,9 @@ class AnnCache:
                 self._centroids_resident += parts.n_clusters
             except BaseException:
                 if reserved:
-                    self.breaker.release(parts.nbytes)
+                    self.breaker.release(
+                        parts.nbytes, label="ann_cache", scope=key[0]
+                    )
                 raise
             return True
 
@@ -442,7 +446,9 @@ class AnnCache:
         self._partitions_resident -= parts.n_partitions
         self._centroids_resident -= parts.n_clusters
         if self.breaker is not None:
-            self.breaker.release(parts.nbytes)
+            self.breaker.release(
+                parts.nbytes, label="ann_cache", scope=key[0]
+            )
         self._evictions.inc()
         return parts.nbytes
 

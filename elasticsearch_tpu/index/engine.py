@@ -690,11 +690,11 @@ class Engine:
         est = estimate_segment_device_bytes(segment)
         if self.breaker is not None:
             if enforce and not self._recovering:
-                self.breaker.add(
-                    est, label=f"segment[{segment.num_docs} docs]"
-                )
+                self.breaker.add(est, label="segment", scope=self.uid)
             else:
-                self.breaker.add_unchecked(est)
+                self.breaker.add_unchecked(
+                    est, label="segment", scope=self.uid
+                )
         try:
             device = pack_segment(
                 segment,
@@ -705,14 +705,22 @@ class Engine:
             )
         except Exception:
             if self.breaker is not None:
-                self.breaker.release(est)
+                self.breaker.release(est, label="segment", scope=self.uid)
             raise
         actual = device_nbytes(device)
         if self.breaker is not None:
+            # Settle the reservation to the packed truth; mirrored into
+            # the HBM ledger through the breaker, so ledger "segment"
+            # bytes track sum(handle.nbytes) exactly (the consistency
+            # law's segment leg).
             if actual > est:
-                self.breaker.add_unchecked(actual - est)
+                self.breaker.add_unchecked(
+                    actual - est, label="segment", scope=self.uid
+                )
             else:
-                self.breaker.release(est - actual)
+                self.breaker.release(
+                    est - actual, label="segment", scope=self.uid
+                )
         return device, actual
 
     @property
@@ -786,7 +794,9 @@ class Engine:
             # The merged-away segments' device arrays become garbage once
             # the handle list swaps (snapshots may pin them briefly).
             self.breaker.release(
-                sum(self.segments[i].nbytes for i in indices)
+                sum(self.segments[i].nbytes for i in indices),
+                label="segment",
+                scope=self.uid,
             )
         merged_handle = SegmentHandle(
             segment=merged_segment,
@@ -886,7 +896,9 @@ class Engine:
 
     def close(self) -> None:
         if self.breaker is not None:
-            self.breaker.release(self.device_bytes)
+            self.breaker.release(
+                self.device_bytes, label="segment", scope=self.uid
+            )
         if self.translog is not None:
             self.translog.close()
 

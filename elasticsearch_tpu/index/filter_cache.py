@@ -190,7 +190,9 @@ class FilterCache:
                 freed = 0
                 while True:
                     try:
-                        self.breaker.add(nbytes, label="filter_cache")
+                        self.breaker.add(
+                            nbytes, label="filter_cache", scope=key[0]
+                        )
                         reserved = True
                         break
                     except BreakerError:
@@ -207,7 +209,9 @@ class FilterCache:
                 self._bytes += nbytes
             except BaseException:
                 if reserved:
-                    self.breaker.release(nbytes)
+                    self.breaker.release(
+                        nbytes, label="filter_cache", scope=key[0]
+                    )
                 raise
             self._admissions.inc()
             # Eager stale purge: entries that can never be served again —
@@ -228,7 +232,7 @@ class FilterCache:
         _plane, nbytes = self._entries.pop(key)
         self._bytes -= nbytes
         if self.breaker is not None:
-            self.breaker.release(nbytes)
+            self.breaker.release(nbytes, label="filter_cache", scope=key[0])
         self._evictions.inc()
         return nbytes
 

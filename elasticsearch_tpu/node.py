@@ -45,8 +45,15 @@ from .index.filter_cache import (
     DEFAULT_MIN_FREQ as FILTER_CACHE_DEFAULT_MIN_FREQ,
     FilterCache,
     clear_index_planes,
+    mesh_cache_scope,
 )
 from .index.mapping import Mappings
+from .obs.device import (
+    HbmLedger,
+    ProfilerCapture,
+    ProfilerConflictError,
+    ProfilerInactiveError,
+)
 from .obs.metrics import DeviceInstruments, MetricsRegistry
 from .obs.tracing import TRACER
 from .ops.bm25 import BM25Params
@@ -248,6 +255,34 @@ class Node:
         self._scrolls: dict[str, Any] = {}
         self._scroll_lock = threading.Lock()
         self.max_open_scrolls = 500
+        # Unified metrics registry (obs/metrics.py): THE write path for
+        # this node's operational counters — `GET /_nodes/stats` and the
+        # Prometheus exposition at `GET /_metrics` are both views over
+        # it. Device-level launch instruments (XLA compile count/ms,
+        # padding waste, H2D bytes, launch-ms histograms) hang off the
+        # same registry. ESTPU_DEVICE_OBS=0 disables the per-launch
+        # timing wrapper AND the HBM ledger (the bench's instruments-off
+        # baseline); the breaker itself always enforces.
+        self.metrics = MetricsRegistry()
+        self.device_obs_enabled = (
+            os.environ.get("ESTPU_DEVICE_OBS", "1") != "0"
+        )
+        self.device = (
+            DeviceInstruments(self.metrics)
+            if self.device_obs_enabled
+            else None
+        )
+        # HBM ledger (obs/device.py): the single source of truth for
+        # device-resident bytes by (label, index). The node breaker
+        # writes through it, so breaker and ledger accounting cannot
+        # drift; packed planes and mesh snapshots register directly.
+        self.hbm_ledger = HbmLedger(
+            metrics=self.metrics, enabled=self.device_obs_enabled
+        )
+        # On-demand profiler capture (POST /_profiler/start|stop):
+        # single-flight jax.profiler trace windows, stamped into the obs
+        # trace ring.
+        self.profiler = ProfilerCapture()
         # Node-level HBM breaker shared by every shard engine (the parent
         # breaker of HierarchyCircuitBreakerService) + the shard request
         # cache (IndicesRequestCache).
@@ -255,14 +290,9 @@ class Node:
             breaker_limit_bytes = int(
                 os.environ.get("ESTPU_HBM_LIMIT_BYTES", 8 << 30)
             )
-        self.breaker = CircuitBreaker(breaker_limit_bytes)
-        # Unified metrics registry (obs/metrics.py): THE write path for
-        # this node's operational counters — `GET /_nodes/stats` and the
-        # Prometheus exposition at `GET /_metrics` are both views over
-        # it. Device-level launch instruments (XLA compile count/ms,
-        # padding waste, H2D bytes) hang off the same registry.
-        self.metrics = MetricsRegistry()
-        self.device = DeviceInstruments(self.metrics)
+        self.breaker = CircuitBreaker(
+            breaker_limit_bytes, ledger=self.hbm_ledger
+        )
         self.metrics.gauge(
             "estpu_faults_armed",
             "Armed fault-injection specs (faults/registry.py)",
@@ -380,6 +410,7 @@ class Node:
                 metrics=self.metrics,
                 planner=self.exec_planner,
                 device=self.device,
+                ledger=self.hbm_ledger,
             )
             if self.exec_batcher is not None
             and os.environ.get("ESTPU_EXEC_PACKED", "1") != "0"
@@ -521,6 +552,13 @@ class Node:
                     metrics=self.metrics,
                 )
             )
+        # HBM-ledger scope naming: every component keys its device bytes
+        # by engine uid (or the mesh scope tuple); naming them here makes
+        # `estpu_hbm_bytes{label,index}` and `/_cat/hbm` render the index
+        # name instead of `_node`.
+        for engine in engines:
+            self.hbm_ledger.name_scope(engine.uid, name)
+        self.hbm_ledger.name_scope(mesh_cache_scope(engines), name)
         search: SearchService | ShardedSearchCoordinator
         if n_shards == 1:
             search = SearchService(
@@ -546,6 +584,9 @@ class Node:
                 # (Prometheus `/_metrics` + `_nodes/stats` mesh_serving).
                 search.mesh_view.planner = self.exec_planner
                 search.mesh_view.metrics = self.metrics
+                # Per-launch timing + mesh-snapshot HBM registration.
+                search.mesh_view.device = self.device
+                search.mesh_view.ledger = self.hbm_ledger
         svc = IndexService(
             name=name,
             mappings=mappings,
@@ -961,10 +1002,19 @@ class Node:
         # uids can never be looked up again, and orphaned planes would
         # stay charged to the shared HBM breaker until unrelated traffic
         # happens to LRU-evict them.
-        clear_index_planes(self.filter_cache, self.indices[name].engines)
-        clear_index_ann(self.ann_cache, self.indices[name].engines)
-        for engine in self.indices[name].engines:
+        svc = self.indices[name]
+        clear_index_planes(self.filter_cache, svc.engines)
+        clear_index_ann(self.ann_cache, svc.engines)
+        # Mesh snapshot buffers die with the view: release their HBM
+        # ledger registration so `device.hbm` can't carry ghost bytes.
+        mesh_view = getattr(svc.search, "mesh_view", None)
+        if mesh_view is not None:
+            mesh_view.release_ledger()
+        for engine in svc.engines:
             engine.close()
+        for engine in svc.engines:
+            self.hbm_ledger.forget_scope(engine.uid)
+        self.hbm_ledger.forget_scope(mesh_cache_scope(svc.engines))
         del self.indices[name]
         # Aliases pointing only at the deleted index disappear with it.
         for alias in list(self.aliases):
@@ -3643,6 +3693,46 @@ class Node:
             out["_nodes"] = header
         return out
 
+    # ------------------------------------------------------ profiler capture
+
+    def profiler_start(self, body: dict[str, Any] | None = None) -> dict:
+        """POST /_profiler/start — open a single-flight jax.profiler
+        capture (409 while one is running; duration bounded)."""
+        body = body or {}
+        duration = body.get("duration_s")
+        if duration is not None and not isinstance(
+            duration, (int, float)
+        ):
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                f"duration_s must be a number, got [{duration!r}]",
+            )
+        try:
+            return self.profiler.start(
+                duration_s=duration, trace_dir=body.get("trace_dir")
+            )
+        except ProfilerConflictError as e:
+            raise ApiError(409, "status_exception", str(e)) from None
+        except ValueError as e:
+            raise ApiError(
+                400, "illegal_argument_exception", str(e)
+            ) from None
+
+    def profiler_stop(self) -> dict:
+        """POST /_profiler/stop — close the capture; returns the Perfetto
+        trace directory + the obs-ring trace id of the stamped window."""
+        try:
+            return self.profiler.stop()
+        except ProfilerInactiveError as e:
+            raise ApiError(
+                400, "illegal_argument_exception", str(e)
+            ) from None
+
+    def profiler_status(self) -> dict:
+        """GET /_profiler — capture state."""
+        return self.profiler.status()
+
     def metrics_text(self) -> str:
         """GET /_metrics — federated Prometheus text exposition: this
         node's registry merged with the replication gateway's, the
@@ -3860,8 +3950,47 @@ class Node:
                                 handle.segment.num_docs - handle.live_count
                             ),
                             "size.memory": str(handle.nbytes),
+                            # Device bytes this segment's packed planes
+                            # hold — per index these sum to the HBM
+                            # ledger's "segment" bytes (the /_cat/hbm
+                            # consistency surface).
+                            "device.bytes": str(handle.nbytes),
                         }
                     )
+        return rows
+
+    def cat_hbm(self) -> list[dict]:
+        """GET /_cat/hbm — the HBM ledger's per-(label, index) resident
+        device bytes, one row per sample, read from the FANNED per-node
+        `device.hbm` sections (nodes_stats), so a clustered front shows
+        every member's residency; `?format=json` behaves like every cat
+        handler (the response is the row list)."""
+        rows: list[dict] = []
+        for node_name, section in sorted(self.nodes_stats()["nodes"].items()):
+            hbm = (section.get("device") or {}).get("hbm") or {}
+            for entry in hbm.get("by_label_index", []):
+                rows.append(
+                    {
+                        "node": node_name,
+                        "label": str(entry.get("label", "")),
+                        "index": str(entry.get("index", "")),
+                        "bytes": str(int(entry.get("bytes", 0))),
+                    }
+                )
+            total_row = {
+                "node": node_name,
+                "label": "_total",
+                "index": "_all",
+                "bytes": str(int(hbm.get("total_bytes", 0))),
+            }
+            # Computed member sections carry no high watermark (the
+            # instantaneous total is not a peak); only ledger-backed
+            # sections render the column.
+            if "high_watermark_bytes" in hbm:
+                total_row["high_watermark"] = str(
+                    int(hbm["high_watermark_bytes"])
+                )
+            rows.append(total_row)
         return rows
 
     def cluster_stats(self) -> dict:
@@ -4162,8 +4291,17 @@ class Node:
                 "batcher": self._batcher_resilience_stats(),
             },
             # Device-level launch instruments (obs/metrics.py): XLA
-            # compile count/ms per plan class, H2D bytes, padding waste.
-            "device": self.device.snapshot(),
+            # compile count/ms per plan class, H2D bytes, padding waste,
+            # the retrace census (device.compile), and the HBM ledger
+            # (device.hbm). Present-but-inert under ESTPU_DEVICE_OBS=0.
+            "device": {
+                **(
+                    self.device.snapshot()
+                    if self.device is not None
+                    else {"enabled": False}
+                ),
+                "hbm": self.hbm_ledger.snapshot(),
+            },
             # Tracing ring state (obs/tracing.py) + cluster-scope fan-in
             # accounting (estpu_nodes_stats_* / trace-fragment /
             # hot-threads views).

@@ -12,6 +12,7 @@ Two pillars, both process-wide services the serving stack writes through:
   Prometheus text exposition at `GET /_metrics`.
 """
 
+from .device import HbmLedger, ProfilerCapture
 from .metrics import DeviceInstruments, MetricsRegistry
 from .tracing import TRACER, Span, Tracer
 
@@ -21,4 +22,6 @@ __all__ = [
     "Tracer",
     "MetricsRegistry",
     "DeviceInstruments",
+    "HbmLedger",
+    "ProfilerCapture",
 ]

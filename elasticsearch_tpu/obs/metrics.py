@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from typing import Any, Callable
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -234,6 +235,12 @@ class MetricsRegistry:
                 return {}
             items = list(entry[2].items())
         return {key: inst.value for key, inst in items}
+
+    def family(self, name: str) -> tuple[str, str, dict] | None:
+        """(kind, help, {label_key: value | histogram snapshot}) of one
+        family — the public read for consumers that need histogram
+        snapshots (scripts/profile_capture.py's launch-ms summaries)."""
+        return self._collect().get(name)
 
     def label_values(self, name: str, label: str) -> dict[str, float]:
         """Family samples keyed by ONE label's value (counters with a
@@ -469,6 +476,17 @@ CATALOG = {
     "estpu_device_actual_tiles_total": ("counter", "device"),
     "estpu_device_padding_waste_ratio": ("histogram", "device"),
     "estpu_device_blockmax_pruned_tile_fraction": ("histogram", "device"),
+    # Device observability (ISSUE 14, obs/device.py): per-launch wall
+    # times split queue (dispatch return) vs execute (block_until_ready)
+    # per backend/plan class — the split is honest only on real devices
+    # (XLA:CPU executes synchronously inside dispatch); real-XLA-compile
+    # retraces per plan class (a compile during a launch whose plan key
+    # was already seen — the shape-polymorphism alarm); and the HBM
+    # ledger's per-(label, index) resident bytes + lifetime peak.
+    "estpu_launch_ms": ("histogram", "device"),
+    "estpu_device_retraces_total": ("counter", "device.compile"),
+    "estpu_hbm_bytes": ("gauge", "device.hbm"),
+    "estpu_hbm_high_watermark_bytes": ("gauge", "device.hbm"),
     # Packed multi-tenant execution (exec/packed.py): one launch scores
     # many small indices' lanes against a shared plane.
     "estpu_packed_launches_total": ("counter", "exec.packed"),
@@ -597,6 +615,12 @@ QUEUE_WAIT_MS_BUCKETS = (
 NODES_FAN_LATENCY_MS_BUCKETS = (
     1.0, 4.0, 16.0, 64.0, 256.0, 1024.0, 4096.0, 16384.0,
 )
+# Per-launch queue/execute wall times: sub-ms dispatch up through
+# compile-dominated first launches.
+LAUNCH_MS_BUCKETS = (
+    0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 512.0,
+    2048.0,
+)
 
 
 class DeviceInstruments:
@@ -608,14 +632,39 @@ class DeviceInstruments:
     first-launch wall time is compile-dominated — the honest in-band
     measure without reaching into XLA internals). Plan classes are
     labeled by the spec kind (bounded cardinality), never the full spec.
+
+    ``timed(kind, plan_key, backend)`` is the per-launch timing wrapper
+    (ISSUE 14): it brackets the kernel dispatch so wall time splits into
+    queue (dispatch return) vs execute (block_until_ready), feeds the
+    ``estpu_launch_ms{plan_class,backend,phase}`` histograms, and arms
+    the obs/device.py compile-census attribution — a REAL XLA compile
+    observed during a launch whose plan key was already seen counts as a
+    retrace (``estpu_device_retraces_total{plan_class}``), the alarm for
+    accidental shape-polymorphism regressions. The queue/execute split
+    is honest only on real devices: XLA:CPU executes synchronously
+    inside dispatch, so there queue absorbs the work and execute ~0.
     """
 
     def __init__(self, registry: MetricsRegistry):
         self.registry = registry
         self._lock = threading.Lock()
         self._seen: set = set()
+        # Real-compile census per plan class (fed by obs/device.py's
+        # jax.monitoring listener through timed() windows):
+        # kind -> {"compiles": int, "retraces": int, "compile_s": float}
+        self._census: dict[str, dict[str, float]] = {}
 
-    def launch(self, kind: str, plan_key: Any, elapsed_s: float) -> None:
+    def launch(
+        self,
+        kind: str,
+        plan_key: Any,
+        elapsed_s: float,
+        backend: str = "device",
+        queue_s: float | None = None,
+    ) -> bool:
+        """Record one launch. Returns True when this was the plan key's
+        FIRST launch (the inferred-compile signal `profile: true` device
+        blocks report as a compile miss)."""
         self.registry.counter(
             "estpu_device_launches_total",
             "Kernel launches by plan class",
@@ -636,10 +685,74 @@ class DeviceInstruments:
                 "Wall-clock ms spent in first (compiling) launches",
                 plan_class=kind,
             ).inc(elapsed_s * 1e3)
+        if queue_s is not None:
+            execute_s = max(0.0, elapsed_s - queue_s)
+            self._launch_hist(kind, backend, "queue").observe(queue_s * 1e3)
+            self._launch_hist(kind, backend, "execute").observe(
+                execute_s * 1e3
+            )
+        else:
+            # Untimed site: the whole elapsed is one total-phase sample,
+            # so every backend's latency shape is in the histogram even
+            # where the dispatch/block split is not instrumented.
+            self._launch_hist(kind, backend, "total").observe(
+                elapsed_s * 1e3
+            )
+        return first
 
-    def h2d(self, arrays: Any) -> None:
+    def _launch_hist(self, kind: str, backend: str, phase: str) -> Histogram:
+        return self.registry.histogram(
+            "estpu_launch_ms",
+            LAUNCH_MS_BUCKETS,
+            "Per-launch wall ms by plan class/backend, split queue "
+            "(dispatch return) vs execute (block_until_ready); the split "
+            "is honest only on real devices — XLA:CPU runs inside "
+            "dispatch",
+            plan_class=kind,
+            backend=backend,
+            phase=phase,
+        )
+
+    def timed(
+        self, kind: str, plan_key: Any, backend: str = "device"
+    ) -> "_TimedLaunch":
+        """Context manager for one instrumented launch: call
+        ``out = t.dispatched(out)`` right after the kernel call — it
+        records the queue split, blocks until the device finishes, and
+        returns the ready outputs."""
+        return _TimedLaunch(self, kind, plan_key, backend)
+
+    def seen(self, plan_key: Any) -> bool:
+        with self._lock:
+            return plan_key in self._seen
+
+    def _note_retrace(
+        self, kind: str, compiles: int, compile_s: float, retrace: bool
+    ) -> None:
+        """Census write-back from a timed launch window."""
+        with self._lock:
+            entry = self._census.setdefault(
+                kind, {"compiles": 0, "retraces": 0, "compile_s": 0.0}
+            )
+            entry["compiles"] += compiles
+            entry["compile_s"] += compile_s
+            if retrace:
+                entry["retraces"] += compiles
+        if retrace:
+            self.registry.counter(
+                "estpu_device_retraces_total",
+                "XLA compiles observed on a plan key's NON-first launch "
+                "— the plan key failed to capture a varying shape "
+                "(shape-polymorphism regression alarm)",
+                plan_class=kind,
+            ).inc(compiles)
+            from . import device as _device
+
+            _device.note_retraces(compiles)
+
+    def h2d(self, arrays: Any) -> int:
         """Host→device transfer bytes: the numpy leaves staged for upload
-        by this launch."""
+        by this launch. Returns the byte count (profile device blocks)."""
         try:
             import jax
 
@@ -655,6 +768,7 @@ class DeviceInstruments:
                 "estpu_device_h2d_bytes_total",
                 "Host-to-device plan-array bytes staged at launch sites",
             ).inc(float(nbytes))
+        return int(nbytes)
 
     def padding(self, actual_tiles: int, padded_tiles: int) -> None:
         """Padding waste of one coalesced launch: padded worklist tiles
@@ -718,6 +832,57 @@ class DeviceInstruments:
             return 0.0
         return round(100.0 * (1.0 - actual / padded), 2)
 
+    def retraces_total(self) -> int:
+        return int(
+            sum(
+                self.registry.label_values(
+                    "estpu_device_retraces_total", "plan_class"
+                ).values()
+            )
+        )
+
+    def compile_census(self, top_n: int = 8) -> dict[str, Any]:
+        """The `device.compile` section of `_nodes/stats`: inferred
+        compiles per plan class (first-launch detection), REAL attributed
+        XLA compiles + retraces (jax.monitoring census through timed
+        windows), and the top-N recompiling classes — any class with a
+        nonzero retrace count is the shape-polymorphism alarm firing."""
+        with self._lock:
+            census = {
+                kind: dict(entry) for kind, entry in self._census.items()
+            }
+        retraced = {
+            kind: int(entry["retraces"])
+            for kind, entry in census.items()
+            if entry["retraces"]
+        }
+        top = sorted(
+            census.items(),
+            key=lambda kv: (-kv[1]["compiles"], kv[0]),
+        )[:top_n]
+        return {
+            "compiles_by_plan_class": {
+                k: int(v)
+                for k, v in sorted(
+                    self.registry.label_values(
+                        "estpu_device_compile_total", "plan_class"
+                    ).items()
+                )
+            },
+            "attributed_xla_compiles": {
+                kind: {
+                    "compiles": int(entry["compiles"]),
+                    "compile_ms": round(entry["compile_s"] * 1e3, 3),
+                    "retraces": int(entry["retraces"]),
+                }
+                for kind, entry in top
+            },
+            "retraces_total": self.retraces_total(),
+            "retraced_plan_classes": {
+                k: retraced[k] for k in sorted(retraced)
+            },
+        }
+
     def snapshot(self) -> dict[str, Any]:
         """The `_nodes/stats` device section."""
         return {
@@ -744,6 +909,9 @@ class DeviceInstruments:
             ),
             "padding_waste_pct": self.padding_waste_pct(),
             "blockmax_pruned_tile_fraction": self._prune_summary(),
+            # Retrace census (ISSUE 14): real attributed XLA compiles +
+            # the top-N recompiling classes — `device.compile`.
+            "compile": self.compile_census(),
         }
 
     def _prune_summary(self) -> dict[str, Any]:
@@ -753,3 +921,124 @@ class DeviceInstruments:
             "count": int(count),
             "mean": round(snap["sum"] / count, 4) if count else 0.0,
         }
+
+
+class _NullTimedLaunch:
+    """timed() stand-in for uninstrumented paths: same surface, records
+    nothing, and dispatched() is a passthrough (device_get blocks later
+    anyway)."""
+
+    queue_ms = 0.0
+    execute_ms = 0.0
+    first = False
+    compiles = 0
+
+    def __enter__(self) -> "_NullTimedLaunch":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+    @staticmethod
+    def dispatched(out: Any) -> Any:
+        return out
+
+
+NULL_TIMED = _NullTimedLaunch()
+
+
+def timed_launch(instruments, kind: str, plan_key: Any, backend: str):
+    """`instruments.timed(...)` or the null stand-in when uninstrumented —
+    the one-liner launch sites use so the wrapped/unwrapped code path is
+    identical."""
+    if instruments is None:
+        return NULL_TIMED
+    return instruments.timed(kind, plan_key, backend)
+
+
+class _TimedLaunch:
+    """One instrumented kernel launch (DeviceInstruments.timed).
+
+    Usage::
+
+        with instruments.timed(kind, plan_key, backend) as t:
+            out = t.dispatched(kernel(...))  # queue split + block
+
+    On exit it records the launch (counts, launch-ms histograms with the
+    queue/execute split, first-launch compile inference) and folds the
+    compile-census attribution: real XLA compiles that fired on this
+    thread during the window (obs/device.py's jax.monitoring listener)
+    attribute to this plan class, and count as retraces when the plan
+    key had already launched before. A window that raises records
+    nothing — a failed launch's timings would poison the histograms."""
+
+    __slots__ = (
+        "instruments", "kind", "plan_key", "backend",
+        "t0", "t_disp", "t_done", "compiles", "compile_s",
+        "_seen_before", "_prev_window", "queue_ms", "execute_ms", "first",
+    )
+
+    def __init__(self, instruments, kind, plan_key, backend):
+        self.instruments = instruments
+        self.kind = kind
+        self.plan_key = plan_key
+        self.backend = backend
+        self.t0 = self.t_disp = self.t_done = 0.0
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.queue_ms = 0.0
+        self.execute_ms = 0.0
+        self.first = False
+
+    def __enter__(self) -> "_TimedLaunch":
+        from . import device as _device
+
+        _device.ensure_compile_listener()
+        self._seen_before = self.instruments.seen(self.plan_key)
+        self._prev_window = getattr(_device._TLS, "launch_window", None)
+        _device._TLS.launch_window = self
+        self.t0 = time.monotonic()
+        return self
+
+    def note_compile(self, duration_s: float) -> None:
+        """Called by the process compile listener on this thread."""
+        self.compiles += 1
+        self.compile_s += duration_s
+
+    def dispatched(self, out: Any) -> Any:
+        """Mark the dispatch return (queue split), then block until the
+        device finishes (execute split) and return the ready outputs."""
+        import jax
+
+        self.t_disp = time.monotonic()
+        out = jax.block_until_ready(out)
+        self.t_done = time.monotonic()
+        return out
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        from . import device as _device
+
+        _device._TLS.launch_window = self._prev_window
+        if exc is not None:
+            return False
+        now = time.monotonic()
+        t_disp = self.t_disp or now
+        t_done = self.t_done or now
+        queue_s = t_disp - self.t0
+        self.queue_ms = round(queue_s * 1e3, 3)
+        self.execute_ms = round(max(0.0, t_done - t_disp) * 1e3, 3)
+        self.first = self.instruments.launch(
+            self.kind,
+            self.plan_key,
+            t_done - self.t0,
+            backend=self.backend,
+            queue_s=queue_s,
+        )
+        if self.compiles:
+            self.instruments._note_retrace(
+                self.kind,
+                self.compiles,
+                self.compile_s,
+                retrace=self._seen_before,
+            )
+        return False

@@ -89,7 +89,8 @@ import numpy as np
 from ..index.filter_cache import mesh_cache_scope
 from ..index.merge import compact_segment, concat_segments
 from ..index.segment import Segment
-from ..index.tiles import TILE, pack_segment_delta
+from ..index.tiles import TILE, device_nbytes, pack_segment_delta
+from ..obs.metrics import timed_launch
 from ..ops.bm25_device import segment_tree
 from ..query.compile import FieldStats, aggregate_field_stats
 from .sharded import (
@@ -456,6 +457,13 @@ class MeshView:
         # node-wide cost model and `_nodes/stats` counters see this
         # backend's traffic alongside device/blockmax/oracle.
         self.planner = None
+        # obs.DeviceInstruments + obs.device.HbmLedger (set by the node):
+        # per-launch timing for the one-launch SPMD program, and ledger
+        # registration of the mesh snapshot's device bytes under label
+        # "mesh_plane" scoped to this index's mesh cache scope.
+        self.device = None
+        self.ledger = None
+        self.plane_bytes = 0  # current snapshot's registered device bytes
 
     @property
     def disabled(self) -> bool:
@@ -760,6 +768,20 @@ class MeshView:
                     ("row", s, sigs[s], docs_pad) for s in range(n)
                 }
                 self.filter_cache.purge_scope(scope, keep)
+            # HBM ledger: this snapshot's resident device bytes (shared
+            # delta-reused planes count once — device_nbytes walks the
+            # CURRENT views). The registration swaps atomically with the
+            # snapshot commit; the consistency-law twin is plane_bytes.
+            nbytes = sum(
+                device_nbytes(d) for d in self._devs if d is not None
+            )
+            if self.ledger is not None:
+                # Register BEFORE releasing the previous snapshot's
+                # bytes: both snapshots coexist across the swap (delta
+                # reuse aside), and the high watermark must see it.
+                self.ledger.register("mesh_plane", scope, nbytes)
+                self.ledger.release("mesh_plane", scope, self.plane_bytes)
+            self.plane_bytes = nbytes
             segments = [s for s in self._filled_segs]
             index = MeshIndex(
                 mesh=self.mesh,
@@ -789,6 +811,16 @@ class MeshView:
                 engine_handles=[h for handles in pinned for h in handles],
             )
             return self._snap
+
+    def release_ledger(self) -> None:
+        """Release this view's mesh-plane ledger registration (index
+        deletion: the snapshot's device arrays die with the view)."""
+        if self.ledger is not None and self.plane_bytes:
+            self.ledger.release(
+                "mesh_plane", mesh_cache_scope(self.engines),
+                self.plane_bytes,
+            )
+        self.plane_bytes = 0
 
     # -------------------------------------------------------------- serve
 
@@ -1025,38 +1057,55 @@ class MeshView:
                     )
                     if fc_masks:
                         seg = {**idx.seg_stacked, "masks": fc_masks}
-                scores, gids, total = sharded_execute(
-                    idx.mesh,
-                    idx.axis,
-                    seg,
-                    compiled.arrays,
-                    compiled.spec,
-                    k,
-                    idx.docs_per_shard,
-                )
+                with timed_launch(
+                    self.device,
+                    "mesh_spmd",
+                    (compiled.spec, k, None, False, "plain"),
+                    "mesh_spmd",
+                ) as tl:
+                    scores, gids, total = tl.dispatched(
+                        sharded_execute(
+                            idx.mesh,
+                            idx.axis,
+                            seg,
+                            compiled.arrays,
+                            compiled.spec,
+                            k,
+                            idx.docs_per_shard,
+                        )
+                    )
                 keys = vals = None
                 n_after = total
                 agg_out = ()
             else:
-                keys, vals, gids, total, n_after, agg_out = (
-                    sharded_execute_request(
-                        idx.mesh,
-                        idx.axis,
-                        idx.seg_stacked,
-                        compiled.arrays,
-                        compiled.spec,
-                        k,
-                        idx.docs_per_shard,
-                        sort_field=sort_field,
-                        sort_desc=sort_desc,
-                        missing_first=missing_first,
-                        has_after=has_after,
-                        after_key=after_key,
-                        after_doc=after_doc,
-                        aggs_spec=aggs_spec,
-                        aggs_arrays_stacked=aggs_arrays,
+                with timed_launch(
+                    self.device,
+                    "mesh_spmd",
+                    (
+                        compiled.spec, k, sort_field, sort_desc,
+                        missing_first, has_after, aggs_spec,
+                    ),
+                    "mesh_spmd",
+                ) as tl:
+                    keys, vals, gids, total, n_after, agg_out = tl.dispatched(
+                        sharded_execute_request(
+                            idx.mesh,
+                            idx.axis,
+                            idx.seg_stacked,
+                            compiled.arrays,
+                            compiled.spec,
+                            k,
+                            idx.docs_per_shard,
+                            sort_field=sort_field,
+                            sort_desc=sort_desc,
+                            missing_first=missing_first,
+                            has_after=has_after,
+                            after_key=after_key,
+                            after_doc=after_doc,
+                            aggs_spec=aggs_spec,
+                            aggs_arrays_stacked=aggs_arrays,
+                        )
                     )
-                )
                 scores = vals
             import jax
 

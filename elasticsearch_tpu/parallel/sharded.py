@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..index.mapping import Mappings
 from ..index.segment import FieldIndex, Segment, SegmentBuilder
 from ..index.tiles import TILE, pack_segment, tile_doc_bounds
+from ..obs.metrics import timed_launch
 from ..ops.bm25 import BM25Params
 from ..ops.bm25_device import (
     NEG_INF,
@@ -186,6 +187,11 @@ class ShardedIndex:
     # (this instance's process-unique uid, generation pinned 0).
     cache_scope: Any = None
     cache_generation: int = 0
+    # obs.metrics.DeviceInstruments: per-launch timing (queue/execute
+    # split + retrace-census attribution) for direct mesh searches.
+    # None = uninstrumented (the MeshView serving path wraps its own
+    # launches in MeshView.serve instead).
+    instruments: Any = None
     _stats_cache: dict[str, FieldStats] | None = None
     _id_indexes: list[dict[str, int] | None] | None = None
     # Memoized per-(shard, field) tile doc-id bounds for plan-time
@@ -552,15 +558,23 @@ class ShardedIndex:
             compiled, masks = self._apply_filter_cache(query, compiled)
             if masks:
                 seg = {**self.seg_stacked, "masks": masks}
-        scores, ids, total = sharded_execute(
-            self.mesh,
-            self.axis,
-            seg,
-            compiled.arrays,
-            compiled.spec,
-            k,
-            self.docs_per_shard,
-        )
+        with timed_launch(
+            self.instruments,
+            "mesh_spmd",
+            (compiled.spec, k, "sharded_direct"),
+            "mesh_spmd",
+        ) as tl:
+            scores, ids, total = tl.dispatched(
+                sharded_execute(
+                    self.mesh,
+                    self.axis,
+                    seg,
+                    compiled.arrays,
+                    compiled.spec,
+                    k,
+                    self.docs_per_shard,
+                )
+            )
         scores, ids = np.asarray(scores), np.asarray(ids)
         n = min(k, int(total))
         return scores[:n], ids[:n], int(total)

@@ -346,6 +346,17 @@ class RestServer:
             p["trace_id"], fmt=q.get("format")
         ))
         r("GET", "/_metrics", lambda s, p, q, b: PlainText(n.metrics_text()))
+        # On-demand device profiler capture (obs/device.ProfilerCapture):
+        # jax.profiler trace windows — single-flight, bounded duration,
+        # 409 on double-start; stop returns the Perfetto trace directory.
+        r("GET", "/_profiler", lambda s, p, q, b: n.profiler_status())
+        r("POST", "/_profiler/start", lambda s, p, q, b: n.profiler_start(
+            _json(b)
+        ))
+        r("POST", "/_profiler/stop", lambda s, p, q, b: n.profiler_stop())
+        # HBM ledger cat view: per-(node, label, index) resident device
+        # bytes read from the fanned `device.hbm` stats sections.
+        r("GET", "/_cat/hbm", lambda s, p, q, b: n.cat_hbm())
         r("GET", "/_cat/tasks", lambda s, p, q, b: n.cat_tasks())
         r("GET", "/_tasks", lambda s, p, q, b: n.list_tasks(
             q.get("actions"),

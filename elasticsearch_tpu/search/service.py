@@ -31,6 +31,7 @@ import numpy as np
 
 from ..faults import fault_point
 from ..index.engine import Engine, SegmentHandle
+from ..obs.metrics import timed_launch
 from ..obs.tracing import TRACER
 from ..ops import bm25_device
 from ..query.compile import FieldStats
@@ -763,6 +764,12 @@ class SearchService:
                         timed_out = True
                         break
                 seg_t0 = time.monotonic_ns() if request.profile else 0
+                # Per-segment device block (profile: true): launch ms,
+                # compile hit/miss, H2D bytes this request staged.
+                seg_device: dict | None = (
+                    {} if request.profile and self.device is not None
+                    else None
+                )
                 # One leaf span per segment launch — the kernel-launch
                 # granularity the whole trace tree bottoms out at.
                 with TRACER.span(
@@ -775,21 +782,23 @@ class SearchService:
                     seg_total, backend = self._query_segment(
                         handle, request, k, stats, candidates,
                         timings=timings, fc_entries=fc_entries,
+                        device_info=seg_device,
                     )
                     if seg_span is not None:
                         seg_span.tags["backend"] = backend
                 total += seg_total
                 if request.profile:
-                    profile_segments.append(
-                        {
-                            "segment": seg_i,
-                            "docs": handle.segment.num_docs,
-                            "time_in_nanos": time.monotonic_ns() - seg_t0,
-                            # The planner-chosen execution backend for this
-                            # segment's scoring pass.
-                            "backend": backend,
-                        }
-                    )
+                    entry = {
+                        "segment": seg_i,
+                        "docs": handle.segment.num_docs,
+                        "time_in_nanos": time.monotonic_ns() - seg_t0,
+                        # The planner-chosen execution backend for this
+                        # segment's scoring pass.
+                        "backend": backend,
+                    }
+                    if seg_device:
+                        entry["device"] = seg_device
+                    profile_segments.append(entry)
         if agg_total is not None:
             # The agg program already counted matched ∧ live docs; trust one
             # source for totals (they are the same mask by construction).
@@ -1261,13 +1270,19 @@ class SearchService:
             if bm25_device.supports_sparse(spec)
             else bm25_device.execute_batch
         )
-        s_b, i_b, t_b = jax.device_get(kernel(seg_tree, spec, arrays_b, k_max))
+        kind = str(spec[0]) if isinstance(spec, tuple) and spec else "dense"
+        # Per-launch timing wrapper (obs/metrics.DeviceInstruments.timed):
+        # queue/execute split around block_until_ready + retrace-census
+        # attribution for any XLA compile this dispatch provokes.
+        with timed_launch(
+            self.device,
+            f"{kind}_batched",
+            (spec, k_max, "device_batched"),
+            "device_batched",
+        ) as tl:
+            out = tl.dispatched(kernel(seg_tree, spec, arrays_b, k_max))
+        s_b, i_b, t_b = jax.device_get(out)
         elapsed = time.monotonic() - t0
-        if self.device is not None:
-            kind = str(spec[0]) if isinstance(spec, tuple) and spec else "dense"
-            self.device.launch(
-                f"{kind}_batched", (spec, k_max, "device_batched"), elapsed
-            )
         for row, i in enumerate(rows):
             tot = int(t_b[row])
             nn = min(ks[i], tot, s_b.shape[1])
@@ -1513,6 +1528,7 @@ class SearchService:
         stats: dict[str, FieldStats],
         candidates: list,
         timings: dict | None = None,
+        device_info: dict | None = None,
     ) -> tuple[int, str]:
         """One segment's knn pass: IVF probe + exact re-rank when the
         segment has partition planes, exact brute force otherwise.
@@ -1541,19 +1557,29 @@ class SearchService:
         if timings is not None:
             timings["plan_s"] += now - plan_t0
         exec_t0 = now
+        h2d_bytes = 0
         if self.device is not None:
-            self.device.h2d(knn.query_vector)
-        if backend == "ann_ivf":
-            scores, ids, tot, n_cand = ann_device.ann_ivf_search(
-                parts.tree(), dev.live, knn.query_vector, knn.k, nprobe,
-                metric, filter_mask=fmask,
-            )
-        else:
-            scores, ids, tot = ann_device.knn_exact(
-                vectors, dev.live, knn.query_vector, knn.k, metric,
-                filter_mask=fmask,
-            )
-            n_cand = tot
+            h2d_bytes = self.device.h2d(knn.query_vector)
+        with timed_launch(
+            self.device, "knn", (knn.field, metric, knn.k, backend), backend
+        ) as tl:
+            if backend == "ann_ivf":
+                out = tl.dispatched(
+                    ann_device.ann_ivf_search(
+                        parts.tree(), dev.live, knn.query_vector, knn.k,
+                        nprobe, metric, filter_mask=fmask,
+                    )
+                )
+                scores, ids, tot, n_cand = out
+            else:
+                out = tl.dispatched(
+                    ann_device.knn_exact(
+                        vectors, dev.live, knn.query_vector, knn.k, metric,
+                        filter_mask=fmask,
+                    )
+                )
+                scores, ids, tot = out
+                n_cand = tot
         scores, ids = np.asarray(scores), np.asarray(ids)
         tot, n_cand = int(tot), int(n_cand)
         # Trim to REAL hits: totals count the eligible doc space, but
@@ -1563,15 +1589,19 @@ class SearchService:
         elapsed = time.monotonic() - exec_t0
         if timings is not None:
             timings["exec_s"] += elapsed
+        if device_info is not None:
+            device_info.update(
+                launch_ms=round(elapsed * 1e3, 3),
+                queue_ms=tl.queue_ms,
+                execute_ms=tl.execute_ms,
+                compile=bool(tl.first),
+                h2d_bytes=h2d_bytes,
+            )
         if self.planner is not None:
             if plan_class is not None:
                 self.planner.record(plan_class, backend, elapsed)
             else:
                 self.planner.note(backend)
-        if self.device is not None:
-            self.device.launch(
-                "knn", (knn.field, metric, knn.k, backend), elapsed
-            )
         if self.ann_cache is not None:
             self.ann_cache.note_search(
                 backend,
@@ -1657,15 +1687,26 @@ class SearchService:
                 [requests[i].knn.query_vector for i in alive]
             )
             t0 = time.monotonic()
-            if backend == "ann_ivf":
-                s_b, i_b, t_b, nc_b = ann_device.ann_ivf_search_batch(
-                    parts.tree(), dev.live, qs, knn0.k, nprobe, metric
-                )
-            else:
-                s_b, i_b, t_b = ann_device.knn_exact_batch(
-                    vectors, dev.live, qs, knn0.k, metric
-                )
-                nc_b = t_b
+            with timed_launch(
+                self.device,
+                "knn_batched",
+                (knn0.field, metric, knn0.k, backend, len(alive)),
+                backend,
+            ) as tl:
+                if backend == "ann_ivf":
+                    s_b, i_b, t_b, nc_b = tl.dispatched(
+                        ann_device.ann_ivf_search_batch(
+                            parts.tree(), dev.live, qs, knn0.k, nprobe,
+                            metric,
+                        )
+                    )
+                else:
+                    s_b, i_b, t_b = tl.dispatched(
+                        ann_device.knn_exact_batch(
+                            vectors, dev.live, qs, knn0.k, metric
+                        )
+                    )
+                    nc_b = t_b
             s_b, i_b = np.asarray(s_b), np.asarray(i_b)
             t_b, nc_b = np.asarray(t_b), np.asarray(nc_b)
             # Real hits per lane = the finite-score prefix (totals count
@@ -1674,12 +1715,6 @@ class SearchService:
                 s_b > np.float32(bm25_device.NEG_INF), axis=1
             )
             elapsed = time.monotonic() - t0
-            if self.device is not None:
-                self.device.launch(
-                    "knn_batched",
-                    (knn0.field, metric, knn0.k, backend, len(alive)),
-                    elapsed,
-                )
             for row, i in enumerate(alive):
                 tot = int(t_b[row])
                 nn = min(
@@ -1727,12 +1762,16 @@ class SearchService:
         candidates: list,
         timings: dict | None = None,
         fc_entries: list | None = None,
+        device_info: dict | None = None,
     ) -> tuple[int, str]:
         """Score one segment, appending candidate tuples. Returns
-        (total hits, execution backend used)."""
+        (total hits, execution backend used). `device_info` (profile:
+        true) is filled with this segment's device block: launch ms,
+        compile hit/miss, H2D bytes staged for this request."""
         if request.knn is not None:
             return self._query_segment_knn(
-                handle, request, stats, candidates, timings=timings
+                handle, request, stats, candidates, timings=timings,
+                device_info=device_info,
             )
         # Injectable device-launch failure / slow-segment delay
         # (faults/registry.py `search.kernel`).
@@ -1758,19 +1797,28 @@ class SearchService:
             if isinstance(compiled.spec, tuple) and compiled.spec
             else type(request.query).__name__
         )
+        h2d_bytes = 0
         if self.device is not None:
             # Host→device plan-array bytes this launch stages.
-            self.device.h2d(compiled.arrays)
+            h2d_bytes = self.device.h2d(compiled.arrays)
 
         def done(total: int, backend: str = "device") -> tuple[int, str]:
             elapsed = time.monotonic() - exec_t0
             if timings is not None:
                 timings["exec_s"] += elapsed
+            first = False
             if self.device is not None and backend != "oracle":
                 # First launch of a new (spec, k, backend) shape is the
                 # XLA compile for its plan class.
-                self.device.launch(
-                    spec_kind, (compiled.spec, k, backend), elapsed
+                first = self.device.launch(
+                    spec_kind, (compiled.spec, k, backend), elapsed,
+                    backend=backend,
+                )
+            if device_info is not None:
+                device_info.update(
+                    launch_ms=round(elapsed * 1e3, 3),
+                    compile=bool(first),
+                    h2d_bytes=h2d_bytes,
                 )
             return total, backend
 

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""CI smoke gate for device observability (ISSUE 14).
+
+Runs, on the CPU backend with no TPU in the loop:
+
+- the HBM ledger consistency law: `device.hbm` totals equal the sum of
+  each component's own byte stats (engine segments, filter-cache
+  planes, ANN tiles, packed planes, mesh snapshots) through refresh /
+  evict / `_cache/clear` / delete_index cycles — drift zero, including
+  under a threaded eviction burst,
+- breaker/ledger no-drift (the breaker writes through),
+- per-launch timing histograms (queue/execute split) + the retrace
+  census: a seeded shape-polymorphic plan key trips
+  `estpu_device_retraces_total`,
+- the profiler capture API: start/stop round trip producing a
+  Perfetto-loadable trace dir, 409 on double-start, bounded duration,
+  capture-window stamp in the obs trace ring, and
+- `GET /_cat/hbm` + the `/_cat/segments` device-bytes column.
+
+The same tests ride the tier-1 run via the fast (`not slow`) marker;
+this script is the standalone hook for pre-merge / cron checks:
+
+    python scripts/check_device_obs_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "tests/test_device_obs.py",
+        "-q",
+        "-m",
+        "not slow",
+        "-p",
+        "no:cacheprovider",
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

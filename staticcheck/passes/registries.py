@@ -49,6 +49,10 @@ RULES = {
         "arity-7 bool spec constructed outside make_bool_spec or "
         "indexed/destructured beyond the declared field order"
     ),
+    "registry-breaker-label": (
+        "CircuitBreaker add/add_unchecked/release with a label outside "
+        "the HBM ledger's label registry (obs/device.py LEDGER_LABELS)"
+    ),
 }
 
 _PLANNER = "elasticsearch_tpu/exec/planner.py"
@@ -56,6 +60,7 @@ _COST = "elasticsearch_tpu/exec/cost.py"
 _FAULTS = "elasticsearch_tpu/faults/registry.py"
 _METRICS = "elasticsearch_tpu/obs/metrics.py"
 _COMPILE = "elasticsearch_tpu/query/compile.py"
+_DEVICE_OBS = "elasticsearch_tpu/obs/device.py"
 
 # Files handling raw bool-spec tuples (construction restricted to
 # make_bool_spec in compile.py; index bounds checked everywhere below).
@@ -104,6 +109,7 @@ def run(project: Project) -> list[Finding]:
     findings += _check_fault_sites(project)
     findings += _check_metrics(project)
     findings += _check_bool_spec(project)
+    findings += _check_breaker_labels(project)
     return findings
 
 
@@ -375,6 +381,79 @@ def _check_metrics(project: Project) -> list[Finding]:
                     ),
                 )
             )
+    return out
+
+
+# ------------------------------------------------------ breaker labels
+
+_BREAKER_METHODS = {"add", "add_unchecked", "release"}
+
+
+def _check_breaker_labels(project: Project) -> list[Finding]:
+    """Every breaker byte must carry a label from the HBM ledger's
+    registry (obs/device.py LEDGER_LABELS): the breaker writes through to
+    the ledger, so a label allocated outside the registry would mint an
+    unbounded/unreconcilable ledger series — the drift the consistency
+    law forbids. Checks calls of add/add_unchecked/release carrying a
+    LITERAL `label=` keyword (f-strings match by their static prefix,
+    like fault-site patterns; non-literal labels pass through — they are
+    plumbing, not allocation sites)."""
+    device = project.get(_DEVICE_OBS)
+    if device is None:
+        return []
+    labels, line = _assigned_tuple(device.tree, "LEDGER_LABELS")
+    if not labels:
+        return [
+            Finding(
+                rule="registry-breaker-label",
+                path=_DEVICE_OBS,
+                line=1,
+                message="LEDGER_LABELS tuple not found",
+            )
+        ]
+    out = []
+    for sf in project.files.values():
+        if sf.rel == _DEVICE_OBS:
+            continue
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BREAKER_METHODS
+            ):
+                continue
+            label_kw = next(
+                (kw for kw in node.keywords if kw.arg == "label"), None
+            )
+            if label_kw is None:
+                continue
+            label, exact = _site_literal(label_kw.value)
+            if not label:
+                continue
+            if exact:
+                ok = any(
+                    label == known or label.startswith(known)
+                    for known in labels
+                )
+            else:  # f-string: conservative prefix overlap
+                ok = any(
+                    label.startswith(known) or known.startswith(label)
+                    for known in labels
+                )
+            if not ok:
+                out.append(
+                    Finding(
+                        rule="registry-breaker-label",
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            f"breaker label [{label}] is not in the HBM "
+                            "ledger's LEDGER_LABELS registry "
+                            "(obs/device.py) — bytes charged under it "
+                            "cannot reconcile with the ledger"
+                        ),
+                    )
+                )
     return out
 
 

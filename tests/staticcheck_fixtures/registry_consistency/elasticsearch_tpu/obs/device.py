@@ -1,0 +1,6 @@
+"""Fixture HBM-ledger label registry (registry-breaker-label)."""
+
+LEDGER_LABELS = (
+    "segment",
+    "filter_cache",
+)

@@ -84,3 +84,23 @@ def make_merge_instruments(m):
         "estpu_merge_rogue_total",
         "merge instrument not in CATALOG",
     )
+
+
+def make_hbm_instruments(m):
+    # An HBM-ledger instrument that never made it into the CATALOG must
+    # fail like any other rogue estpu_* registration.
+    m.counter(
+        "estpu_hbm_rogue_total",
+        "HBM ledger instrument not in CATALOG",
+    )
+
+
+def charge_breaker(breaker, n):
+    breaker.add(n, label="segment")  # registered ledger label: fine
+    # f-string labels match by static prefix, like fault-site patterns.
+    breaker.add(n, label=f"segment[{n} docs]")
+    # A breaker label allocated outside the ledger's registry splits the
+    # breaker and ledger accountings — the drift the law forbids.
+    breaker.add(n, label="rogue_label")
+    # staticcheck: ignore[registry-breaker-label] fixture: suppressed twin
+    breaker.release(n, label="other_rogue")

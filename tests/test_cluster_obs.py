@@ -48,6 +48,10 @@ MEMBER_SECTIONS = {
     "cluster_state",
     "step_errors",
     "transport",
+    # Per-node device.hbm section (ISSUE 14): computed from component
+    # stats on workers (no write-through ledger there), fanned so the
+    # coordinating front's /_cat/hbm shows every member's residency.
+    "device",
 }
 
 

@@ -167,7 +167,24 @@ class TestRegistryConsistency:
         assert any("[estpu_merge_rogue_total]" in m for m in msgs)
         # ... and an uncataloged cluster-observability fan-in instrument
         assert any("[estpu_nodes_rogue_total]" in m for m in msgs)
-        assert len(msgs) == 10
+        # ... and an uncataloged HBM-ledger instrument
+        assert any("[estpu_hbm_rogue_total]" in m for m in msgs)
+        assert len(msgs) == 11
+
+    def test_breaker_labels(self, report):
+        msgs = [
+            f.message
+            for f in report.findings
+            if f.rule == "registry-breaker-label"
+        ]
+        # A breaker label allocated outside obs/device.py LEDGER_LABELS
+        # fails the gate; registered labels (exact or f-string prefix)
+        # stay clean, and the suppressed twin suppresses.
+        assert len(msgs) == 1
+        assert "[rogue_label]" in msgs[0]
+        assert (
+            rules_of(report.suppressed).get("registry-breaker-label") == 1
+        )
 
     def test_bool_spec(self, report):
         msgs = [f.message for f in report.findings if f.rule == "bool-spec"]
