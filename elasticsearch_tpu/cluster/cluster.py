@@ -1354,6 +1354,64 @@ class ClusterNode:
     def _on_node_stats(self, from_id: str, payload: dict):
         return self.node_stats_local()
 
+    def health_inputs_local(self) -> dict:
+        """This node's `health_inputs` wire section (obs/health.py): the
+        small, cheap-to-collect slice of per-node state the health
+        indicators interpret — identity/roles/master, the published
+        state's term (re-election tracking), swallowed stepper errors,
+        transport counters with their trailing-window events, and recent
+        cache-eviction pressure. Deliberately much lighter than
+        node_stats_local: a 1/s health poll must not cost a stats
+        assembly per node."""
+        out: dict[str, Any] = {
+            "name": self.node_id,
+            "roles": self.roles(),
+            "master": self.is_master(),
+            "cluster_state": {
+                "term": self.state.term,
+                "version": self.state.version,
+                "master_node": self.state.master,
+            },
+            "step_errors": int(self._step_errors.value),
+            "process": {"pid": os.getpid()},
+        }
+        evictions: dict[str, int] = {}
+        window = self.metrics.window(
+            "estpu_filter_cache_evictions_recent"
+        )
+        if window is not None:
+            evictions["filter"] = int(window.count())
+        if evictions:
+            out["evictions_recent"] = evictions
+        endpoint = None
+        get_endpoint = getattr(self.hub, "endpoint", None)
+        if get_endpoint is not None:
+            endpoint = get_endpoint(self.node_id)
+        elif getattr(self.hub, "node_id", None) == self.node_id:
+            endpoint = self.hub
+        if endpoint is not None:
+            out["transport"] = endpoint.stats()
+            recent = getattr(endpoint, "recent_events", None)
+            if recent is not None:
+                out["transport_events_recent"] = recent()
+        else:
+            hub_stats = getattr(self.hub, "stats", None)
+            if hub_stats is not None:
+                out["transport"] = hub_stats()
+            hub_metrics = getattr(self.hub, "metrics", None)
+            if hub_metrics is not None:
+                recent = hub_metrics.window_counts(
+                    "estpu_transport_events_recent", "event"
+                )
+                if recent:
+                    out["transport_events_recent"] = {
+                        k: int(v) for k, v in recent.items()
+                    }
+        return out
+
+    def _on_health_inputs(self, from_id: str, payload: dict):
+        return self.health_inputs_local()
+
     def _on_metrics_wire(self, from_id: str, payload: dict):
         """Federated `/_metrics` ship side: this node's registry as a
         wire snapshot. Process-wide registries (the transport endpoint's,

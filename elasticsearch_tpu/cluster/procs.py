@@ -175,6 +175,9 @@ class ProcCluster:
         self._lock = threading.Lock()
         self._intercept_state: dict = {}
         self._metrics_cache: tuple[float, str] | None = None
+        # Lazily-built health report service (obs/health.py): holds the
+        # re-election/step-error history between report rounds.
+        self._health = None
         self._closed = False
         self._book = FileAddressBook(self.addr_dir)
         # Dedicated control endpoint: its intercepts stay EMPTY forever,
@@ -532,6 +535,80 @@ class ProcCluster:
             "cluster_name": self.cluster_name,
             "nodes": nodes,
         }
+
+    def health_report(
+        self,
+        verbose: bool = True,
+        indicator: str | None = None,
+    ) -> dict:
+        """`GET /_health_report` over the process cluster: the
+        `health_inputs` wire action fanned to every worker over the
+        never-intercepted `_ctl` socket path plus the supervisor-resident
+        tiebreaker's own inputs, interpreted by the SAME obs/health.py
+        indicator functions the in-process forms use. A kill -9'd worker
+        becomes a named per-indicator diagnosis within the per-send
+        deadline — never a hang. ``verbose=False`` skips the worker fan
+        (cheap liveness probe: statuses + symptoms from the supervisor's
+        view alone)."""
+        from ..obs.health import HealthContext, HealthService
+
+        if self._health is None:
+            self._health = HealthService(metrics=self._ctl.metrics)
+        node_inputs: dict[str, dict] = {}
+        failures: list[dict] = []
+        state = None
+        coordinator = "_ctl"
+        if self._local_node is not None:
+            coordinator = TIEBREAKER_ID
+            state = self._local_node.state
+            node_inputs[TIEBREAKER_ID] = (
+                self._local_node.health_inputs_local()
+            )
+        if verbose:
+            results, failures = self._fan("health_inputs")
+            for node_id in self.workers:
+                if node_id in results:
+                    node_inputs[node_id] = results[node_id]
+        if state is None:
+            # No tiebreaker: adopt an answering worker's published state
+            # for the shard/master rules — in BOTH modes (a terse probe
+            # with no state would report a healthy cluster red). Verbose
+            # prefers the freshest fanned section's node; terse asks the
+            # workers in order until one answers.
+            from .state import ClusterState
+
+            candidates = list(self.workers)
+            if verbose and results:
+                candidates = sorted(
+                    results,
+                    key=lambda n: (
+                        results[n].get("cluster_state", {}).get("term", 0),
+                        results[n]
+                        .get("cluster_state", {})
+                        .get("version", 0),
+                    ),
+                    reverse=True,
+                ) + [n for n in candidates if n not in results]
+            for node_id in candidates:
+                try:
+                    raw = self.state_of(node_id)
+                    state = ClusterState.from_json(raw["state"])
+                    break
+                except (ConnectTransportError, RemoteActionError):
+                    continue
+        ctx = HealthContext(
+            cluster_name=self.cluster_name,
+            coordinator=coordinator,
+            standalone=False,
+            state=state,
+            expected_nodes=tuple(self.workers),
+            node_inputs=node_inputs,
+            fan_failures=failures,
+            fanned=verbose,
+        )
+        return self._health.report(
+            ctx, verbose=verbose, indicator=indicator
+        )
 
     def metrics_text(self, max_age_s: float | None = None) -> str:
         """Federated `GET /_metrics`: every live worker's registry ships

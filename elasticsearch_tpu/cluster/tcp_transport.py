@@ -317,6 +317,34 @@ class TcpTransport:
             fn=self._open_connections,
             node=node_id,
         )
+        # Windowed event twins (health `transport` indicator input):
+        # reconnect churn, handshake rejects, and send timeouts over the
+        # trailing window — "is the wire flapping NOW", which the
+        # cumulative counters above cannot answer.
+        self._recent_events = {
+            event: self.metrics.windowed_counter(
+                "estpu_transport_events_recent",
+                "Transport events over the trailing window",
+                event=event,
+                node=node_id,
+            )
+            for event in ("reconnect", "handshake_reject", "send_timeout")
+        }
+
+    def _note_event(self, event: str) -> None:
+        self._recent_events[event].inc()
+
+    def _note_timeout(self) -> None:
+        self._c_timeouts.inc()
+        self._note_event("send_timeout")
+
+    def recent_events(self) -> dict[str, int]:
+        """{event: count} over the trailing window — the per-node
+        `transport_events_recent` health input."""
+        return {
+            event: int(window.count())
+            for event, window in self._recent_events.items()
+        }
 
     # ------------------------------------------------------------- wiring
 
@@ -422,6 +450,8 @@ class TcpTransport:
                 for d in ("sent", "received")
             },
             "open_connections": int(self._open_connections()),
+            # Trailing-window event counts (health `transport` input).
+            "recent_events": self.recent_events(),
         }
 
     def close(self, abrupt: bool = False) -> None:
@@ -501,6 +531,7 @@ class TcpTransport:
                 or hs.get("version") != PROTOCOL_VERSION
             ):
                 self._c_handshake_rejects.inc()
+                self._note_event("handshake_reject")
                 self._write(
                     conn,
                     {
@@ -641,7 +672,7 @@ class TcpTransport:
             # interception/deadline semantics cannot diverge per transport.
             self.intercepts.preflight(
                 from_id, to_id, action, deadline, timeout_s,
-                on_timeout=self._c_timeouts.inc,
+                on_timeout=self._note_timeout,
             )
             # Transport-agnostic site (chaos schedules written against the
             # hub replay here unchanged), then the TCP-specific one.
@@ -674,7 +705,7 @@ class TcpTransport:
             return None
         left = deadline - time.monotonic()
         if left <= 0:
-            self._c_timeouts.inc()
+            self._note_timeout()
             raise ConnectTransportError(
                 f"[{action}] to [{to_id}] timed out (deadline exhausted)"
             )
@@ -695,7 +726,7 @@ class TcpTransport:
                 resp, nbytes = read_frame(conn)
             except socket.timeout:
                 self._discard(conn)
-                self._c_timeouts.inc()
+                self._note_timeout()
                 raise ConnectTransportError(
                     f"[{action}] to [{to_id}] timed out after {timeout_s}s "
                     f"(no response)"
@@ -781,6 +812,7 @@ class TcpTransport:
         for attempt in range(self.connect_attempts):
             if attempt:
                 self._c_reconnects.inc()
+                self._note_event("reconnect")
                 backoff = self.connect_backoff_s * (2 ** (attempt - 1))
                 left = self._remaining(deadline, action, to_id)
                 if left is not None and backoff >= left:
@@ -835,6 +867,7 @@ class TcpTransport:
                 return sock
             except _HandshakeRejected as e:
                 self._c_handshake_rejects.inc()
+                self._note_event("handshake_reject")
                 raise ConnectTransportError(str(e)) from None
             except (OSError, _PeerClosed, ConnectTransportError) as e:
                 if isinstance(e, ConnectTransportError) and "timed out" in str(

@@ -227,6 +227,14 @@ class TransportHub(InterceptsDelegate):
             "Transport sends that exceeded their per-send deadline",
             transport="hub",
         )
+        # Windowed twin (health `transport` indicator input): timeouts
+        # over the trailing window, not since boot.
+        self._timeouts_recent = self.metrics.windowed_counter(
+            "estpu_transport_events_recent",
+            "Transport events over the trailing window",
+            event="send_timeout",
+            transport="hub",
+        )
 
     # ------------------------------------------------------------ wiring
 
@@ -274,7 +282,7 @@ class TransportHub(InterceptsDelegate):
                 )
             self.intercepts.preflight(
                 from_id, to_id, action, deadline, timeout_s,
-                on_timeout=self._timeouts.inc,
+                on_timeout=self._note_timeout,
             )
             # Named fault site (faults/registry.py): injectable per-action
             # drops/delays without pre-wiring hub interceptors, e.g.
@@ -316,7 +324,7 @@ class TransportHub(InterceptsDelegate):
         worker.start()
         worker.join(max(0.0, deadline - time.monotonic()))
         if worker.is_alive():
-            self._timeouts.inc()
+            self._note_timeout()
             raise ConnectTransportError(
                 f"[{action}] on [{to_id}] timed out after {timeout_s}s "
                 f"(no response within the per-send deadline)"
@@ -324,6 +332,10 @@ class TransportHub(InterceptsDelegate):
         if "error" in box:
             _raise_as_remote(box["error"], action, to_id)
         return box.get("result")
+
+    def _note_timeout(self) -> None:
+        self._timeouts.inc()
+        self._timeouts_recent.inc()
 
     def alive(self, node_id: str) -> bool:
         with self._lock:

@@ -14,6 +14,8 @@ CircuitBreakingException#durability=PERMANENT.
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 
 
 class BreakerError(Exception):
@@ -45,6 +47,10 @@ class CircuitBreaker:
         self.name = name
         self.used = 0
         self.trips = 0
+        # Monotonic stamps of recent trips: the health report's
+        # device_memory indicator asks "is the breaker refusing
+        # allocations NOW", which the cumulative trip count can't answer.
+        self._trip_times: deque[float] = deque(maxlen=128)
         self._lock = threading.Lock()
         self.ledger = ledger
         if ledger is not None:
@@ -60,6 +66,7 @@ class CircuitBreaker:
         with self._lock:
             if self.used + n > self.limit:
                 self.trips += 1
+                self._trip_times.append(time.monotonic())
                 raise BreakerError(n, self.used, self.limit, label)
             self.used += n
         if self.ledger is not None:
@@ -80,6 +87,12 @@ class CircuitBreaker:
             self.used = max(0, self.used - n)
         if self.ledger is not None:
             self.ledger.release(label, scope, n, breaker_backed=True)
+
+    def trips_recent(self, window_s: float = 60.0) -> int:
+        """Trips inside the trailing window (health-indicator input)."""
+        floor = time.monotonic() - window_s
+        with self._lock:
+            return sum(1 for t in self._trip_times if t >= floor)
 
     def stats(self) -> dict:
         with self._lock:

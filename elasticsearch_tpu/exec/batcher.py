@@ -191,6 +191,17 @@ class MicroBatcher:
             "estpu_exec_batcher_shed_total",
             "Requests shed with 429 (queue full)",
         )
+        # Rolling-window twins (ISSUE 15): the health report's
+        # exec_saturation indicator needs RECENT queue waits and shed
+        # rate, not the since-boot cumulatives.
+        self._shed_recent = metrics.windowed_counter(
+            "estpu_exec_batcher_shed_recent",
+            "Requests shed with 429 over the trailing window",
+        )
+        self._queue_wait_recent = metrics.windowed_histogram(
+            "estpu_exec_batcher_queue_wait_recent_ms",
+            "Queue wait before launch over the trailing window, ms",
+        )
         self._retried = metrics.counter(
             "estpu_exec_batcher_retried_individually_total",
             "Riders retried solo after a coalesced-launch failure",
@@ -296,6 +307,7 @@ class MicroBatcher:
             depth = sum(len(q) for q in self._queues.values())
             if depth >= self.queue_limit:
                 self._shed.inc()
+                self._shed_recent.inc()
                 err = IndexingPressureRejected(
                     f"rejected execution of search: exec batch queue is "
                     f"full [queued={depth}, limit={self.queue_limit}]"
@@ -709,4 +721,5 @@ class MicroBatcher:
             for item in batch:
                 self._wait_samples.append(item.queue_wait_s)
                 self._queue_wait_hist.observe(item.queue_wait_s * 1e3)
+                self._queue_wait_recent.record(item.queue_wait_s * 1e3)
                 self._launch_queue_ms.observe(item.queue_wait_s * 1e3)

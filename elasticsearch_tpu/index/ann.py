@@ -286,6 +286,12 @@ class AnnCache:
             "IVF planes dropped (LRU under the byte/HBM budget, dead "
             "segment handles, index deletes)",
         )
+        # Windowed twin: the health report's eviction-burst rule reads
+        # RECENT evictions, not the since-boot cumulative.
+        self._evictions_recent = metrics.windowed_counter(
+            "estpu_ann_evictions_recent",
+            "IVF planes dropped over the trailing window",
+        )
         metrics.gauge(
             "estpu_ann_bytes_resident",
             "HBM bytes held by IVF partition planes",
@@ -450,6 +456,7 @@ class AnnCache:
                 parts.nbytes, label="ann_cache", scope=key[0]
             )
         self._evictions.inc()
+        self._evictions_recent.inc()
         return parts.nbytes
 
     def prune_dead(self, engine_uid, live_uids) -> int:

@@ -113,6 +113,13 @@ class FilterCache:
             "Mask planes evicted (LRU under the byte/HBM budget, stale "
             "generations, or cache-clear)",
         )
+        # Windowed twin: the health report's eviction-burst rule reads
+        # RECENT evictions (a warm cache that churned last week is fine;
+        # one churning now is thrashing its HBM budget).
+        self._evictions_recent = metrics.windowed_counter(
+            "estpu_filter_cache_evictions_recent",
+            "Mask planes evicted over the trailing window",
+        )
         self._mask_reuse = metrics.counter(
             "estpu_filter_cache_mask_reuse_total",
             "Cache-HIT planes substituted into plans (one count per plane "
@@ -234,6 +241,7 @@ class FilterCache:
         if self.breaker is not None:
             self.breaker.release(nbytes, label="filter_cache", scope=key[0])
         self._evictions.inc()
+        self._evictions_recent.inc()
         return nbytes
 
     def _evict_lru_locked(self) -> int:

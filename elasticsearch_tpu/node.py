@@ -54,6 +54,14 @@ from .obs.device import (
     ProfilerConflictError,
     ProfilerInactiveError,
 )
+from .obs.health import (
+    INDICATORS,
+    HealthContext,
+    HealthService,
+    shard_summary,
+    status_at_least,
+)
+from .obs.insights import QueryInsights
 from .obs.metrics import DeviceInstruments, MetricsRegistry
 from .obs.tracing import TRACER
 from .ops.bm25 import BM25Params
@@ -302,6 +310,17 @@ class Node:
             "estpu_traces_buffered",
             "Finished traces held in the /_traces ring buffer",
             fn=lambda: TRACER.stats()["buffered_traces"],
+        )
+        # Health report (obs/health.py, GET /_health_report): rule-based
+        # indicators over the rolling windows + cluster state — the
+        # interpretation layer over every raw surface above.
+        self.health = HealthService(metrics=self.metrics)
+        # Query insights ring (obs/insights.py, GET /_insights/queries):
+        # bounded top-N slowest searches, fed from the slowlog's
+        # SearchResponse.phases hook.
+        self.insights = QueryInsights(
+            capacity=int(os.environ.get("ESTPU_INSIGHTS_CAPACITY", 100)),
+            metrics=self.metrics,
         )
         self.request_cache = RequestCache(metrics=self.metrics)
         # Filter/bitset cache (index/filter_cache.py): device-resident
@@ -1871,6 +1890,13 @@ class Node:
                 out.get("took", 0),
                 trace_id=TRACER.current_trace_id(),
             )
+            self.insights.record(
+                index=svc.name,
+                took_ms=out.get("took", 0),
+                shards=out.get("_shards"),
+                trace_id=TRACER.current_trace_id(),
+                source=body,
+            )
             return out
         if self._scrolls:
             # Reap expired scroll contexts opportunistically: they pin
@@ -2025,6 +2051,17 @@ class Node:
             out.get("took", 0),
             trace_id=TRACER.current_trace_id(),
             breakdown=getattr(response, "phases", None),
+        )
+        # Structured slowlog sibling: the insights ring samples the
+        # slowest searches with the SAME phases hook plus shard math and
+        # the trace id as an exemplar.
+        self.insights.record(
+            index=index,
+            took_ms=out.get("took", 0),
+            shards=out.get("_shards"),
+            trace_id=TRACER.current_trace_id(),
+            phases=getattr(response, "phases", None),
+            source=body,
         )
         if request.profile and "profile" in out:
             # The ES profile-API analog of a trace dump: `profile: true`
@@ -3644,6 +3681,14 @@ class Node:
         blocks.extend(fan_text_blocks(results, failures))
         return "\n".join(blocks)
 
+    def query_insights(self, size: int | None = None) -> dict:
+        """GET /_insights/queries — the bounded top-N slowest-searches
+        sample (obs/insights.py), slowest first."""
+        return {
+            **self.insights.stats(),
+            "queries": self.insights.queries(size=size),
+        }
+
     def get_traces(self, limit: int = 50) -> dict:
         """GET /_traces — newest-first summaries of the trace ring."""
         return {
@@ -3769,79 +3814,222 @@ class Node:
             others.append(fold_cluster_counters(snapshots))
         return self.metrics.exposition(*others)
 
+    # --------------------------------------------------------- health report
+
+    def _coordinator_state(self):
+        """The published ClusterState, or None when no member answers."""
+        if self.replication is None:
+            return None
+        try:
+            return self.replication.coordinator().state
+        except RuntimeError:
+            return None
+
+    def _recent_windows(self) -> dict[str, Any]:
+        """Rolling-window snapshots off this node's registry — the
+        recent-behavior half of the health inputs."""
+        out: dict[str, Any] = {}
+        queue_wait = self.metrics.window(
+            "estpu_exec_batcher_queue_wait_recent_ms"
+        )
+        if queue_wait is not None:
+            out["queue_wait_recent"] = queue_wait.snapshot()
+        shed = self.metrics.window("estpu_exec_batcher_shed_recent")
+        if shed is not None:
+            out["shed_recent"] = shed.count()
+        evictions: dict[str, int] = {}
+        for cache, name in (
+            ("filter", "estpu_filter_cache_evictions_recent"),
+            ("ann", "estpu_ann_evictions_recent"),
+        ):
+            window = self.metrics.window(name)
+            if window is not None:
+                evictions[cache] = int(window.count())
+        if evictions:
+            out["evictions_recent"] = evictions
+        outcomes: dict[str, dict[str, int]] = {}
+        for labels, window in self.metrics.windows(
+            "estpu_device_launch_recent"
+        ):
+            backend = labels.get("backend", "device")
+            outcome = labels.get("outcome", "ok")
+            entry = outcomes.setdefault(backend, {})
+            entry[outcome] = entry.get(outcome, 0) + int(window.count())
+        if outcomes:
+            out["launch_outcomes_recent"] = outcomes
+        return out
+
+    def _health_inputs_local(self) -> dict[str, Any]:
+        """This coordinating front's own health inputs: breaker/ledger
+        accounting, the compile census, batcher state, the rolling
+        windows, mesh circuit-breaker states, and (when clustered) the
+        gateway transport's recent events."""
+        out: dict[str, Any] = {
+            "name": self.node_name,
+            "breaker": self.breaker.stats(),
+            "breaker_trips_recent": self.breaker.trips_recent(),
+            "hbm": self.hbm_ledger.snapshot(),
+            "device_compile": (
+                self.device.compile_census()
+                if self.device is not None
+                else None
+            ),
+            "batcher": (
+                self.exec_batcher.stats()
+                if self.exec_batcher is not None
+                else {"enabled": False}
+            ),
+            "step_errors": 0,
+        }
+        out.update(self._recent_windows())
+        mesh: dict[str, str] = {}
+        for name, svc in sorted(self.indices.items()):
+            mv = getattr(svc.search, "mesh_view", None)
+            if mv is None:
+                continue
+            mesh[name] = mv.breaker.stats()["state"]
+        if mesh:
+            out["mesh_breakers"] = mesh
+        if self.replication is not None:
+            cluster = self.replication.cluster
+            out["step_errors"] = int(
+                getattr(cluster, "_step_errors", None).value
+                if getattr(cluster, "_step_errors", None) is not None
+                else 0
+            )
+            hub_metrics = getattr(cluster.hub, "metrics", None)
+            if hub_metrics is not None:
+                recent = hub_metrics.window_counts(
+                    "estpu_transport_events_recent", "event"
+                )
+                if recent:
+                    out["transport_events_recent"] = {
+                        k: int(v) for k, v in recent.items()
+                    }
+            hub_stats = getattr(cluster.hub, "stats", None)
+            if hub_stats is not None:
+                out["transport"] = hub_stats()
+        return out
+
+    def health_report(
+        self,
+        verbose: bool = True,
+        indicator: str | None = None,
+    ) -> dict:
+        """GET /_health_report — the rule-based indicator report
+        (obs/health.py). Verbose reports fan `health_inputs` over every
+        cluster member (per-send deadline, named failure entries — a
+        dead node degrades the report, never hangs it);
+        ``verbose=False`` is the cheap liveness probe: local inputs
+        only, statuses + symptoms without the detail blocks."""
+        if indicator is not None and indicator not in INDICATORS:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                f"unknown health indicator [{indicator}]; expected one "
+                f"of {list(INDICATORS)}",
+            )
+        node_inputs = {self.node_name: self._health_inputs_local()}
+        failures: list[dict] = []
+        expected: tuple[str, ...] = ()
+        fanned = False
+        if self.replication is not None and verbose:
+            fanned = True
+            expected = tuple(sorted(self.replication.cluster.nodes))
+            results, failures = self._cluster_fan("health_inputs", {})
+            for node_id, section in results.items():
+                if node_id == self.node_name:
+                    # The member sharing the coordinating front's name is
+                    # this same interpreter: keep the richer local entry,
+                    # graft the member-only keys (roles, cluster_state).
+                    merged = dict(section)
+                    merged.update(node_inputs[node_id])
+                    node_inputs[node_id] = merged
+                else:
+                    node_inputs[node_id] = section
+        ctx = HealthContext(
+            cluster_name=self.cluster_name,
+            coordinator=self.node_name,
+            standalone=self.replication is None,
+            state=self._coordinator_state(),
+            expected_nodes=expected,
+            node_inputs=node_inputs,
+            fan_failures=failures,
+            fanned=fanned,
+            local_indices=self.indices,
+        )
+        report = self.health.report(
+            ctx, verbose=verbose, indicator=indicator
+        )
+        return report
+
     # ---------------------------------------------------------------- admin
 
-    def cluster_health(self) -> dict:
-        if self.replication is not None:
-            return self._replicated_cluster_health()
-        return {
-            "cluster_name": self.cluster_name,
-            "status": "green",
-            "timed_out": False,
-            "number_of_nodes": 1,
-            "number_of_data_nodes": 1,
-            "active_primary_shards": sum(
-                s.n_shards for s in self.indices.values()
-            ),
-            "active_shards": sum(s.n_shards for s in self.indices.values()),
-            "relocating_shards": 0,
-            "initializing_shards": 0,
-            "unassigned_shards": 0,
-            "delayed_unassigned_shards": 0,
-            "number_of_pending_tasks": 0,
-            "number_of_in_flight_fetch": 0,
-            "task_max_waiting_in_queue_millis": 0,
-            "active_shards_percent_as_number": 100.0,
-        }
+    def cluster_health(
+        self,
+        wait_for_status: str | None = None,
+        timeout_s: float = 30.0,
+    ) -> dict:
+        """GET /_cluster/health — a VIEW over the health report's shard
+        math (obs/health.shard_summary: one computation behind this, the
+        `shards_availability` indicator, and `_cat/health`). With
+        ``wait_for_status`` it blocks until the cluster reaches at least
+        that status (green satisfies a yellow wait) or the timeout
+        expires — then answers with ``timed_out: true`` instead of an
+        error, like the reference."""
+        if wait_for_status is not None:
+            if wait_for_status not in ("green", "yellow", "red"):
+                raise ApiError(
+                    400,
+                    "illegal_argument_exception",
+                    f"unknown wait_for_status [{wait_for_status}]; "
+                    f"expected green, yellow or red",
+                )
+            deadline = time.monotonic() + max(0.0, timeout_s)
+            while True:
+                out = self._cluster_health_now()
+                if status_at_least(out["status"], wait_for_status):
+                    return out
+                if time.monotonic() >= deadline:
+                    out["timed_out"] = True
+                    return out
+                time.sleep(0.05)
+        return self._cluster_health_now()
 
-    def _replicated_cluster_health(self) -> dict:
-        """Health derived from the published ClusterState: red = a shard
-        with no promotable copy, yellow = in-sync copies below the
-        configured replica count, green otherwise."""
-        try:
-            state = self.replication.coordinator().state
-        except RuntimeError:
-            state = None
-        active_primaries = 0
-        active_shards = 0
-        unassigned = 0
-        desired = 0
-        initializing = 0
-        n_nodes = 0
-        if state is not None:
-            n_nodes = len(state.nodes)
-            for meta in state.indices.values():
-                for routing in meta.shards.values():
-                    desired += 1 + meta.n_replicas
-                    initializing += len(routing.recovering)
-                    if routing.primary is None:
-                        unassigned += 1 + meta.n_replicas
-                        continue
-                    active_primaries += 1
-                    active_shards += len(routing.assigned())
-        if state is None or unassigned:
-            status = "red"  # an unassigned PRIMARY is red, not yellow
-        elif active_shards < desired:
-            status = "yellow"
+    def _cluster_health_now(self) -> dict:
+        if self.replication is None:
+            shards = sum(s.n_shards for s in self.indices.values())
+            summary = {
+                "status": "green",
+                "nodes": 1,
+                "active_primaries": shards,
+                "active_shards": shards,
+                "unassigned_shards": 0,
+                "desired_shards": shards,
+                "initializing_shards": 0,
+            }
         else:
-            status = "green"
+            summary = shard_summary(self._coordinator_state())
+        desired = summary["desired_shards"]
         return {
             "cluster_name": self.cluster_name,
-            "status": status,
+            "status": summary["status"],
             "timed_out": False,
-            "number_of_nodes": n_nodes,
-            "number_of_data_nodes": n_nodes,
-            "active_primary_shards": active_primaries,
-            "active_shards": active_shards,
+            "number_of_nodes": summary["nodes"],
+            "number_of_data_nodes": summary["nodes"],
+            "active_primary_shards": summary["active_primaries"],
+            "active_shards": summary["active_shards"],
             "relocating_shards": 0,
-            "initializing_shards": initializing,
-            "unassigned_shards": unassigned,
+            "initializing_shards": summary["initializing_shards"],
+            "unassigned_shards": summary["unassigned_shards"],
             "delayed_unassigned_shards": 0,
             "number_of_pending_tasks": 0,
             "number_of_in_flight_fetch": 0,
             "task_max_waiting_in_queue_millis": 0,
             "active_shards_percent_as_number": (
-                100.0 if not desired else 100.0 * active_shards / desired
+                100.0
+                if not desired
+                else 100.0 * summary["active_shards"] / desired
             ),
         }
 
@@ -3859,6 +4047,8 @@ class Node:
         ]
 
     def cat_health(self) -> list[dict]:
+        # A view over the same shard math as /_cluster/health and the
+        # shards_availability indicator (obs/health.shard_summary).
         h = self.cluster_health()
         return [
             {
@@ -3867,7 +4057,7 @@ class Node:
                 "node.total": str(h["number_of_nodes"]),
                 "shards": str(h["active_shards"]),
                 "pri": str(h["active_primary_shards"]),
-                "unassign": "0",
+                "unassign": str(h["unassigned_shards"]),
             }
         ]
 
@@ -4304,11 +4494,15 @@ class Node:
             },
             # Tracing ring state (obs/tracing.py) + cluster-scope fan-in
             # accounting (estpu_nodes_stats_* / trace-fragment /
-            # hot-threads views).
+            # hot-threads views) + the query-insights ring.
             "obs": {
                 "tracing": TRACER.stats(),
                 "cluster": self._cluster_obs_stats(),
+                "insights": self.insights.stats(),
             },
+            # Health-report rounds + last-computed indicator statuses
+            # (obs/health.py; estpu_health_* views).
+            "health": self.health.stats(),
         }
         if self.replication is not None:
             node_stats["replication"] = self.replication.stats()

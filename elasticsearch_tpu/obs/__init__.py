@@ -13,6 +13,8 @@ Two pillars, both process-wide services the serving stack writes through:
 """
 
 from .device import HbmLedger, ProfilerCapture
+from .health import INDICATORS, HealthContext, HealthService
+from .insights import QueryInsights
 from .metrics import DeviceInstruments, MetricsRegistry
 from .tracing import TRACER, Span, Tracer
 
@@ -24,4 +26,8 @@ __all__ = [
     "DeviceInstruments",
     "HbmLedger",
     "ProfilerCapture",
+    "HealthService",
+    "HealthContext",
+    "INDICATORS",
+    "QueryInsights",
 ]

@@ -1,0 +1,1046 @@
+"""Cluster health report: rule-based indicators over rolling windows.
+
+The interpretation layer over PRs 4/13/14's raw telemetry (the
+reference's `HealthService` / `GET /_health_report`,
+server/src/main/java/org/elasticsearch/health/HealthService.java): every
+instrument so far answers "what happened since boot"; an operator needs
+"is the cluster healthy RIGHT NOW, and if not, why and what do I do".
+Each indicator computes green/yellow/red from cluster state, cumulative
+counters, and the obs/metrics.py rolling windows (`estpu_*_recent`), and
+renders reference-shaped `symptom` / `details` / `impacts[]` /
+`diagnosis[]{cause, action}` blocks.
+
+`INDICATORS` is the machine-checked registry (staticcheck's
+registry-indicator rule, like `LEDGER_LABELS` / `CATALOG`): every entry
+must have a module-level `indicator_<name>` implementation here, and
+every implementation must be registered — an indicator that exists but
+never renders (or renders but never computes) fails `check_static.py`.
+
+Indicator functions are PURE over a `HealthContext`: the coordinating
+front (node.py), the in-process LocalCluster fan, and the multi-process
+ProcCluster supervisor each assemble a context (local inputs + per-node
+`health_inputs` wire sections + named fan failures) and call ONE
+`HealthService.report`, so the report shape cannot drift between
+cluster forms. A dead or wedged node degrades `shards_availability` /
+`master_stability` with a NAMED diagnosis inside the per-send deadline —
+never a hang (the PR-13 scatter contract).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any
+
+# Machine-checked indicator registry (staticcheck registry-indicator):
+# each entry maps to a module-level `indicator_<name>` function below.
+INDICATORS = (
+    "shards_availability",
+    "master_stability",
+    "device_memory",
+    "device_compile",
+    "exec_saturation",
+    "transport",
+)
+
+_STATUS_RANK = {"green": 0, "yellow": 1, "red": 2, "unknown": 1}
+
+# Rule thresholds (env-tunable; defaults sized for the CI/laptop shape —
+# a production deployment tunes them like the reference's health node
+# settings).
+HBM_YELLOW_FRACTION = float(
+    os.environ.get("ESTPU_HEALTH_HBM_YELLOW_FRACTION", "0.9") or 0.9
+)
+EVICTION_BURST = int(os.environ.get("ESTPU_HEALTH_EVICTION_BURST", "64"))
+QUEUE_P99_YELLOW_MS = float(
+    os.environ.get("ESTPU_HEALTH_QUEUE_P99_MS", "250") or 250
+)
+SHED_RED = int(os.environ.get("ESTPU_HEALTH_SHED_RED", "100"))
+REELECTION_YELLOW = int(os.environ.get("ESTPU_HEALTH_REELECTIONS", "2"))
+# Reconnect churn threshold: sized so ONE node death's dial blip (the
+# survivors' steppers retry a refused peer a dozen-odd times before the
+# routing table updates) stays under it, while a crash-looping or
+# flapping peer (hundreds of dials per minute) crosses it — a single
+# death is shards_availability's finding, not a wire problem.
+TRANSPORT_CHURN_YELLOW = int(
+    os.environ.get("ESTPU_HEALTH_TRANSPORT_CHURN", "50")
+)
+
+
+def worst(statuses) -> str:
+    """The most severe of several statuses (green < yellow < red)."""
+    out = "green"
+    for status in statuses:
+        if _STATUS_RANK.get(status, 1) > _STATUS_RANK[out]:
+            out = "yellow" if status == "unknown" else status
+    return out
+
+
+def status_at_least(status: str, wanted: str) -> bool:
+    """Is `status` at least as healthy as `wanted`? (green satisfies a
+    yellow wait; yellow does not satisfy a green wait.)"""
+    return _STATUS_RANK.get(status, 2) <= _STATUS_RANK.get(wanted, 0)
+
+
+def shard_summary(state) -> dict[str, Any]:
+    """Shard math + status from a published ClusterState — the ONE
+    computation `GET /_cluster/health`, `_cat/health`, and the
+    `shards_availability` indicator are all views of. `state=None`
+    (no reachable coordinator) is red; an unassigned PRIMARY is red;
+    in-sync copies below the configured replica count are yellow."""
+    active_primaries = 0
+    active_shards = 0
+    unassigned = 0
+    desired = 0
+    initializing = 0
+    n_nodes = 0
+    red_indices: list[str] = []
+    if state is not None:
+        n_nodes = len(state.nodes)
+        for name, meta in state.indices.items():
+            for routing in meta.shards.values():
+                desired += 1 + meta.n_replicas
+                initializing += len(routing.recovering)
+                if routing.primary is None:
+                    unassigned += 1 + meta.n_replicas
+                    if name not in red_indices:
+                        red_indices.append(name)
+                    continue
+                active_primaries += 1
+                active_shards += len(routing.assigned())
+    if state is None or unassigned:
+        status = "red"  # an unassigned PRIMARY is red, not yellow
+    elif active_shards < desired:
+        status = "yellow"
+    else:
+        status = "green"
+    return {
+        "status": status,
+        "nodes": n_nodes,
+        "active_primaries": active_primaries,
+        "active_shards": active_shards,
+        "unassigned_shards": unassigned,
+        "desired_shards": desired,
+        "initializing_shards": initializing,
+        "red_indices": red_indices,
+    }
+
+
+@dataclass
+class HealthContext:
+    """Everything one report round computes from. `node_inputs` holds
+    one `health_inputs`-shaped section per node (the coordinating
+    front's own section included); `fan_failures` are the PR-13-style
+    named `{node, type, reason}` entries for members that did not answer
+    within the per-send deadline."""
+
+    cluster_name: str = "es-tpu"
+    coordinator: str = "node-0"
+    standalone: bool = True
+    state: Any = None  # published ClusterState (None when standalone)
+    expected_nodes: tuple[str, ...] = ()
+    node_inputs: dict[str, dict] = field(default_factory=dict)
+    fan_failures: list[dict] = field(default_factory=list)
+    fanned: bool = False
+    # Indices served locally by the coordinating front (the standalone
+    # shard surface the cluster state does not cover).
+    local_indices: dict[str, Any] = field(default_factory=dict)
+    # HealthService-observed control-plane history (recent re-elections,
+    # step-error deltas) — filled by HealthService.report.
+    recent_terms: int = 0
+    recent_step_errors: int = 0
+
+
+def _result(
+    status: str,
+    symptom: str,
+    details: dict | None = None,
+    impacts: list | None = None,
+    diagnosis: list | None = None,
+) -> dict[str, Any]:
+    return {
+        "status": status,
+        "symptom": symptom,
+        "details": details or {},
+        "impacts": impacts or [],
+        "diagnosis": diagnosis or [],
+    }
+
+
+def _fan_failure_diagnosis(ctx: HealthContext) -> list[dict]:
+    """One named diagnosis entry per node that failed the health fan —
+    the 'a worker died and here is its name' block the kill -9 arc
+    asserts on."""
+    return [
+        {
+            "cause": (
+                f"node [{f['node']}] did not answer the health fan "
+                f"within the per-send deadline ({f['type']}: "
+                f"{f['reason']})"
+            ),
+            "action": (
+                f"restart the process serving [{f['node']}] (or remove "
+                "it from the cluster); shard copies it held are being "
+                "promoted/re-replicated in the meantime"
+            ),
+        }
+        for f in ctx.fan_failures
+    ]
+
+
+# --------------------------------------------------------------- indicators
+
+
+def indicator_shards_availability(ctx: HealthContext) -> dict[str, Any]:
+    """Unassigned/under-replicated shards from the published cluster
+    state; a node that failed the health fan degrades the indicator
+    immediately (its copies are at risk before the control plane has
+    even noticed)."""
+    if ctx.standalone:
+        shards = sum(
+            getattr(svc, "n_shards", 1) for svc in ctx.local_indices.values()
+        )
+        return _result(
+            "green",
+            f"This node is serving all {shards} local shard(s).",
+            details={
+                "active_shards": shards,
+                "unassigned_shards": 0,
+                "topology": "standalone",
+            },
+        )
+    summary = shard_summary(ctx.state)
+    status = summary["status"]
+    details = dict(summary)
+    diagnosis: list[dict] = []
+    impacts: list[dict] = []
+    if ctx.fan_failures:
+        # A dead/wedged node is at least yellow even while the routing
+        # table still believes its copies: the next health round will
+        # fail them, and the operator should not wait for it to learn.
+        status = worst([status, "yellow"])
+        diagnosis.extend(_fan_failure_diagnosis(ctx))
+    if summary["red_indices"]:
+        diagnosis.append(
+            {
+                "cause": (
+                    "indices "
+                    f"{summary['red_indices']} have shards with no "
+                    "promotable copy (every in-sync holder is gone)"
+                ),
+                "action": (
+                    "restart the nodes that held the in-sync copies, or "
+                    "restore the indices from a snapshot"
+                ),
+            }
+        )
+        impacts.append(
+            {
+                "severity": 1,
+                "description": (
+                    "searches and writes against the red indices fail "
+                    "or return partial results"
+                ),
+                "impact_areas": ["search", "ingest"],
+            }
+        )
+    elif status != "green":
+        impacts.append(
+            {
+                "severity": 2,
+                "description": (
+                    "reads have fewer copies to fail over to; another "
+                    "node loss may lose acknowledged writes"
+                ),
+                "impact_areas": ["search", "deployment_management"],
+            }
+        )
+    if status == "green":
+        symptom = (
+            f"This cluster has all {summary['active_shards']} shard "
+            "copies available."
+        )
+    else:
+        symptom = (
+            f"{summary['unassigned_shards']} of "
+            f"{summary['desired_shards']} shard copies are unavailable"
+            + (
+                f" ({len(ctx.fan_failures)} node(s) not responding)."
+                if ctx.fan_failures
+                else "."
+            )
+        )
+    return _result(status, symptom, details, impacts, diagnosis)
+
+
+def indicator_master_stability(ctx: HealthContext) -> dict[str, Any]:
+    """Elected master + quorum of answering voters, recent re-elections
+    (term churn inside the window), and control-plane step errors that
+    are still accumulating."""
+    if ctx.standalone:
+        return _result(
+            "green",
+            "This single node is its own elected master.",
+            details={"master": ctx.coordinator, "topology": "standalone"},
+        )
+    master = None if ctx.state is None else ctx.state.master
+    term = 0 if ctx.state is None else ctx.state.term
+    seeds = () if ctx.state is None else tuple(ctx.state.seed_nodes)
+    answering = len(ctx.node_inputs)
+    quorum = len(seeds) // 2 + 1 if seeds else 1
+    details: dict[str, Any] = {
+        "master": master,
+        "term": term,
+        "seed_nodes": list(seeds),
+        "answering_nodes": answering,
+        "quorum": quorum,
+        "recent_reelections": ctx.recent_terms,
+        "recent_step_errors": ctx.recent_step_errors,
+    }
+    diagnosis: list[dict] = []
+    impacts: list[dict] = []
+    status = "green"
+    if master is None:
+        status = "red"
+        diagnosis.append(
+            {
+                "cause": "no elected master is published",
+                "action": (
+                    "restart enough master-eligible nodes to reach "
+                    f"quorum ({quorum} of {len(seeds)})"
+                ),
+            }
+        )
+    if ctx.fanned and answering < quorum:
+        status = "red"
+        diagnosis.extend(_fan_failure_diagnosis(ctx))
+        diagnosis.append(
+            {
+                "cause": (
+                    f"only {answering} of {len(seeds)} voters answered "
+                    f"the health fan — below the election quorum of "
+                    f"{quorum}"
+                ),
+                "action": "restart the unreachable voting nodes",
+            }
+        )
+    elif ctx.fan_failures and status == "green":
+        status = "yellow"
+        diagnosis.extend(_fan_failure_diagnosis(ctx))
+    if ctx.recent_terms >= REELECTION_YELLOW and status == "green":
+        status = "yellow"
+        diagnosis.append(
+            {
+                "cause": (
+                    f"the master term changed {ctx.recent_terms} times "
+                    "in the trailing window (election churn)"
+                ),
+                "action": (
+                    "check inter-node connectivity and GC/CPU "
+                    "starvation on the master-eligible nodes"
+                ),
+            }
+        )
+    if ctx.recent_step_errors > 0 and status == "green":
+        status = "yellow"
+        diagnosis.append(
+            {
+                "cause": (
+                    f"{ctx.recent_step_errors} control-plane step "
+                    "error(s) were swallowed by the background stepper "
+                    "since the last report"
+                ),
+                "action": (
+                    "inspect estpu_cluster_step_errors_total per node "
+                    "and the stepper logs"
+                ),
+            }
+        )
+    if status == "red":
+        impacts.append(
+            {
+                "severity": 1,
+                "description": (
+                    "the cluster cannot commit metadata changes, "
+                    "promote primaries, or heal failed copies"
+                ),
+                "impact_areas": ["cluster_coordination", "ingest"],
+            }
+        )
+    elif status == "yellow":
+        impacts.append(
+            {
+                "severity": 3,
+                "description": (
+                    "control-plane reactions (promotion, recovery) may "
+                    "lag behind failures"
+                ),
+                "impact_areas": ["cluster_coordination"],
+            }
+        )
+    symptom = (
+        f"The elected master is [{master}] (term {term})."
+        if status == "green"
+        else (
+            "No elected master."
+            if master is None
+            else f"Master [{master}] is elected but unstable."
+        )
+    )
+    return _result(status, symptom, details, impacts, diagnosis)
+
+
+def indicator_device_memory(ctx: HealthContext) -> dict[str, Any]:
+    """HBM ledger vs breaker budget: accounting drift is ALWAYS red
+    (the consistency law is broken — nothing downstream of it can be
+    trusted), near-budget usage / recent breaker trips / eviction
+    bursts are yellow."""
+    worst_status = "green"
+    symptoms: list[str] = []
+    details: dict[str, Any] = {"nodes": {}}
+    impacts: list[dict] = []
+    diagnosis: list[dict] = []
+    reporting = 0
+    for node_id, inputs in sorted(ctx.node_inputs.items()):
+        breaker = inputs.get("breaker")
+        hbm = inputs.get("hbm")
+        if breaker is None and hbm is None:
+            continue
+        reporting += 1
+        node_detail: dict[str, Any] = {}
+        status = "green"
+        drift = int((hbm or {}).get("breaker_drift_bytes", 0) or 0)
+        node_detail["breaker_drift_bytes"] = drift
+        if drift != 0:
+            status = "red"
+            symptoms.append(
+                f"HBM accounting drift of {drift} bytes on [{node_id}]"
+            )
+            diagnosis.append(
+                {
+                    "cause": (
+                        f"breaker and ledger accounting diverge by "
+                        f"{drift} bytes on [{node_id}] — a device "
+                        "allocation bypassed the write-through ledger"
+                    ),
+                    "action": (
+                        "this is a bug: capture `/_cat/hbm` and "
+                        "`_nodes/stats → device.hbm` and file it; "
+                        "restart the node to re-zero the accounting"
+                    ),
+                }
+            )
+        if breaker is not None:
+            limit = int(breaker.get("limit_size_in_bytes", 0) or 0)
+            used = int(breaker.get("estimated_size_in_bytes", 0) or 0)
+            node_detail["breaker_used_bytes"] = used
+            node_detail["breaker_limit_bytes"] = limit
+            fraction = (used / limit) if limit else 0.0
+            node_detail["used_fraction"] = round(fraction, 4)
+            if limit and fraction >= HBM_YELLOW_FRACTION:
+                status = worst([status, "yellow"])
+                symptoms.append(
+                    f"[{node_id}] is at {fraction:.0%} of its HBM "
+                    "breaker budget"
+                )
+                diagnosis.append(
+                    {
+                        "cause": (
+                            f"device memory on [{node_id}] is within "
+                            f"{1 - HBM_YELLOW_FRACTION:.0%} of the "
+                            "breaker limit — one eviction burst from "
+                            "breaker trips"
+                        ),
+                        "action": (
+                            "shrink the filter/ANN cache budgets, "
+                            "delete or shrink indices, or raise "
+                            "ESTPU_HBM_LIMIT_BYTES"
+                        ),
+                    }
+                )
+        trips_recent = int(inputs.get("breaker_trips_recent", 0) or 0)
+        node_detail["breaker_trips_recent"] = trips_recent
+        if trips_recent:
+            status = worst([status, "yellow"])
+            symptoms.append(
+                f"{trips_recent} breaker trip(s) on [{node_id}] in the "
+                "trailing window"
+            )
+            diagnosis.append(
+                {
+                    "cause": (
+                        f"the HBM breaker on [{node_id}] refused "
+                        f"{trips_recent} allocation(s) recently "
+                        "(callers saw 429 circuit_breaking_exception)"
+                    ),
+                    "action": (
+                        "free device memory (POST /_cache/clear, delete "
+                        "indices) or raise the breaker limit"
+                    ),
+                }
+            )
+        evictions = inputs.get("evictions_recent") or {}
+        total_evictions = int(sum(evictions.values()))
+        node_detail["evictions_recent"] = evictions
+        if total_evictions >= EVICTION_BURST:
+            status = worst([status, "yellow"])
+            symptoms.append(
+                f"eviction burst on [{node_id}]: {total_evictions} "
+                "cache planes dropped in the trailing window"
+            )
+            diagnosis.append(
+                {
+                    "cause": (
+                        f"{total_evictions} filter/ANN cache evictions "
+                        f"on [{node_id}] in the trailing window — the "
+                        "working set is thrashing its HBM budget"
+                    ),
+                    "action": (
+                        "raise ESTPU_FILTER_CACHE_BYTES / "
+                        "ESTPU_ANN_BYTES or reduce the distinct-filter "
+                        "working set"
+                    ),
+                }
+            )
+        details["nodes"][node_id] = node_detail
+        worst_status = worst([worst_status, status])
+    if not reporting:
+        return _result(
+            "green",
+            "No node reported device-memory inputs (device "
+            "observability disabled or worker-only sections).",
+            details={"enabled": False},
+        )
+    if worst_status != "green":
+        impacts.append(
+            {
+                "severity": 1 if worst_status == "red" else 2,
+                "description": (
+                    "device-memory accounting is broken"
+                    if worst_status == "red"
+                    else "new segment uploads and cache admissions may "
+                    "be refused with 429s"
+                ),
+                "impact_areas": ["search", "ingest"],
+            }
+        )
+    symptom = (
+        "Device memory is within budget on every reporting node."
+        if worst_status == "green"
+        else "; ".join(symptoms) + "."
+    )
+    return _result(worst_status, symptom, details, impacts, diagnosis)
+
+
+def indicator_device_compile(ctx: HealthContext) -> dict[str, Any]:
+    """The retrace census (PR 14): any steady-state retrace — a REAL XLA
+    compile on a plan key's non-first launch — is yellow, with the
+    offending plan classes NAMED. A recompile-per-launch silently
+    multiplies p50 long before anyone reads a profile."""
+    retraced: dict[str, int] = {}
+    compiles_total = 0
+    launch_errors = 0
+    reporting = 0
+    for node_id, inputs in sorted(ctx.node_inputs.items()):
+        census = inputs.get("device_compile")
+        if census is None:
+            continue
+        reporting += 1
+        compiles_total += int(
+            sum(census.get("compiles_by_plan_class", {}).values())
+        )
+        for cls, n in (census.get("retraced_plan_classes") or {}).items():
+            retraced[cls] = retraced.get(cls, 0) + int(n)
+        # {backend: {"ok": n, "error": n}} over the trailing window.
+        outcomes = inputs.get("launch_outcomes_recent") or {}
+        launch_errors += int(
+            sum(entry.get("error", 0) for entry in outcomes.values())
+        )
+    if not reporting:
+        return _result(
+            "green",
+            "No node reported compile-census inputs (device "
+            "observability disabled).",
+            details={"enabled": False},
+        )
+    details = {
+        "compiles_total": compiles_total,
+        "retraced_plan_classes": {
+            k: retraced[k] for k in sorted(retraced)
+        },
+        "launch_errors_recent": launch_errors,
+    }
+    symptoms: list[str] = []
+    impacts: list[dict] = []
+    diagnosis: list[dict] = []
+    status = "green"
+    if launch_errors:
+        # Recent launches RAISED (the outcome="error" window): the
+        # device path is failing right now, not just recompiling.
+        status = "yellow"
+        symptoms.append(
+            f"{launch_errors} kernel launch(es) failed in the trailing "
+            "window"
+        )
+        impacts.append(
+            {
+                "severity": 2,
+                "description": (
+                    "failing launches fall back to slower paths or "
+                    "surface as shard failures"
+                ),
+                "impact_areas": ["search"],
+            }
+        )
+        diagnosis.append(
+            {
+                "cause": (
+                    "device kernel launches are raising "
+                    "(estpu_device_launch_recent{outcome=\"error\"})"
+                ),
+                "action": (
+                    "check the mesh circuit-breaker last_error and the "
+                    "trace ring for the failing plan class"
+                ),
+            }
+        )
+    if retraced:
+        status = "yellow"
+        classes = ", ".join(sorted(retraced))
+        symptoms.append(
+            f"plan class(es) [{classes}] are recompiling in steady "
+            f"state ({sum(retraced.values())} retrace(s))"
+        )
+        impacts.append(
+            {
+                "severity": 2,
+                "description": (
+                    "every retracing launch pays XLA compile latency "
+                    "instead of serving — p50 inflates silently"
+                ),
+                "impact_areas": ["search"],
+            }
+        )
+        diagnosis.append(
+            {
+                "cause": (
+                    f"plan key(s) of [{classes}] fail to capture a "
+                    "varying input shape, so XLA re-traces on launches "
+                    "after the first"
+                ),
+                "action": (
+                    "add the varying dimension to the plan key (or pad "
+                    "it to a fixed bucket); confirm with POST "
+                    "/_profiler/start and estpu_device_retraces_total"
+                ),
+            }
+        )
+    if status == "green":
+        return _result(
+            "green",
+            "No steady-state retraces: every plan class compiled once "
+            "and stayed compiled.",
+            details,
+        )
+    symptom = "; ".join(symptoms) + "."
+    return _result(status, symptom, details, impacts, diagnosis)
+
+
+def indicator_exec_saturation(ctx: HealthContext) -> dict[str, Any]:
+    """Micro-batcher admission health over the trailing window: queue
+    waits, 429 shed rate, quarantined groups. Cumulative shed counts are
+    history; the windows say whether clients are being turned away
+    NOW."""
+    reporting = 0
+    status = "green"
+    symptoms: list[str] = []
+    diagnosis: list[dict] = []
+    details: dict[str, Any] = {"nodes": {}}
+    for node_id, inputs in sorted(ctx.node_inputs.items()):
+        batcher = inputs.get("batcher")
+        if batcher is None:
+            continue
+        reporting += 1
+        if batcher.get("enabled") is False:
+            details["nodes"][node_id] = {"enabled": False}
+            continue
+        recent = inputs.get("queue_wait_recent") or {}
+        shed_recent = int(inputs.get("shed_recent", 0) or 0)
+        quarantined = int(batcher.get("quarantined_now", 0) or 0)
+        node_detail = {
+            "queue_wait_recent_p99_ms": recent.get("p99", 0.0),
+            "queue_wait_recent_count": recent.get("count", 0),
+            "shed_recent": shed_recent,
+            "quarantined_now": quarantined,
+            "queued_now": batcher.get("queued", 0),
+        }
+        details["nodes"][node_id] = node_detail
+        if shed_recent >= SHED_RED:
+            status = "red"
+            symptoms.append(
+                f"[{node_id}] shed {shed_recent} searches with 429 in "
+                "the trailing window"
+            )
+            diagnosis.append(
+                {
+                    "cause": (
+                        f"the batch queue on [{node_id}] is full and "
+                        "shedding load at a sustained rate"
+                    ),
+                    "action": (
+                        "add serving capacity, raise the queue limit, "
+                        "or shed at the client with the Retry-After "
+                        "hints"
+                    ),
+                }
+            )
+        elif shed_recent:
+            status = worst([status, "yellow"])
+            symptoms.append(
+                f"[{node_id}] shed {shed_recent} search(es) recently"
+            )
+            diagnosis.append(
+                {
+                    "cause": (
+                        f"the batch queue on [{node_id}] filled and "
+                        f"shed {shed_recent} request(s) in the "
+                        "trailing window"
+                    ),
+                    "action": (
+                        "watch estpu_exec_batcher_shed_recent; if it "
+                        "sustains, add capacity or raise queue_limit"
+                    ),
+                }
+            )
+        p99 = float(recent.get("p99", 0.0) or 0.0)
+        if p99 >= QUEUE_P99_YELLOW_MS:
+            status = worst([status, "yellow"])
+            symptoms.append(
+                f"queue-wait p99 on [{node_id}] is {p99:.0f}ms"
+            )
+            diagnosis.append(
+                {
+                    "cause": (
+                        f"searches on [{node_id}] wait {p99:.0f}ms p99 "
+                        "in the batch queue (threshold "
+                        f"{QUEUE_P99_YELLOW_MS:.0f}ms)"
+                    ),
+                    "action": (
+                        "check for a slow plan class hogging launches "
+                        "(estpu_launch_ms) or lower "
+                        "ESTPU_EXEC_BATCH_WAIT_MS"
+                    ),
+                }
+            )
+        if quarantined:
+            status = worst([status, "yellow"])
+            symptoms.append(
+                f"{quarantined} group(s) quarantined on [{node_id}]"
+            )
+            diagnosis.append(
+                {
+                    "cause": (
+                        f"{quarantined} batch group(s) on [{node_id}] "
+                        "keep failing coalesced launches and are "
+                        "serving per-request"
+                    ),
+                    "action": (
+                        "inspect exec.batcher retried_individually and "
+                        "the failing group's plan class"
+                    ),
+                }
+            )
+    if not reporting:
+        return _result(
+            "green",
+            "No node reported batcher inputs.",
+            details={"enabled": False},
+        )
+    impacts = []
+    if status != "green":
+        impacts.append(
+            {
+                "severity": 1 if status == "red" else 2,
+                "description": (
+                    "search clients are being rejected with 429s"
+                    if status == "red"
+                    else "search tail latency is inflated by queue "
+                    "pressure"
+                ),
+                "impact_areas": ["search"],
+            }
+        )
+    symptom = (
+        "The execution queue is keeping up: no recent sheds, queue "
+        "waits within budget."
+        if status == "green"
+        else "; ".join(symptoms) + "."
+    )
+    return _result(status, symptom, details, impacts, diagnosis)
+
+
+def indicator_transport(ctx: HealthContext) -> dict[str, Any]:
+    """Node-to-node wire health over the trailing window: reconnect
+    churn, handshake rejects (misconfigured peer), send timeouts, plus
+    the SPMD mesh circuit-breaker state on the serving front."""
+    status = "green"
+    symptoms: list[str] = []
+    diagnosis: list[dict] = []
+    details: dict[str, Any] = {"nodes": {}}
+    for node_id, inputs in sorted(ctx.node_inputs.items()):
+        transport = inputs.get("transport") or {}
+        recent = inputs.get("transport_events_recent") or {}
+        node_detail = {
+            "kind": transport.get("kind"),
+            "send_timeouts_total": transport.get("send_timeouts", 0),
+            "reconnects_total": transport.get("reconnects", 0),
+            "handshake_rejects_total": transport.get(
+                "handshake_rejects", 0
+            ),
+            "recent_events": recent,
+        }
+        details["nodes"][node_id] = node_detail
+        timeouts = int(recent.get("send_timeout", 0) or 0)
+        rejects = int(recent.get("handshake_reject", 0) or 0)
+        reconnects = int(recent.get("reconnect", 0) or 0)
+        if timeouts:
+            status = worst([status, "yellow"])
+            symptoms.append(
+                f"{timeouts} send timeout(s) at [{node_id}] in the "
+                "trailing window"
+            )
+            diagnosis.append(
+                {
+                    "cause": (
+                        f"sends from [{node_id}] exceeded the per-send "
+                        "deadline recently — a peer is dead, wedged, or "
+                        "partitioned"
+                    ),
+                    "action": (
+                        "check the peer processes and network; `GET "
+                        "/_nodes/stats` names which fans failed"
+                    ),
+                }
+            )
+        if rejects:
+            status = worst([status, "yellow"])
+            symptoms.append(
+                f"{rejects} handshake reject(s) at [{node_id}]"
+            )
+            diagnosis.append(
+                {
+                    "cause": (
+                        f"[{node_id}] refused transport handshakes "
+                        "(cluster-name/protocol-version mismatch)"
+                    ),
+                    "action": (
+                        "a foreign or mis-versioned process is dialing "
+                        "this cluster; align cluster_name/versions"
+                    ),
+                }
+            )
+        if reconnects >= TRANSPORT_CHURN_YELLOW:
+            status = worst([status, "yellow"])
+            symptoms.append(
+                f"reconnect churn at [{node_id}]: {reconnects} dial "
+                "retries in the trailing window"
+            )
+            diagnosis.append(
+                {
+                    "cause": (
+                        f"[{node_id}] re-dialed peers {reconnects} "
+                        "times in the trailing window — flapping "
+                        "connectivity"
+                    ),
+                    "action": (
+                        "check for a crash-looping peer or packet loss "
+                        "between hosts"
+                    ),
+                }
+            )
+    mesh = {}
+    for node_id, inputs in sorted(ctx.node_inputs.items()):
+        for index, state in (inputs.get("mesh_breakers") or {}).items():
+            mesh[index] = state
+            if state not in ("closed",):
+                status = worst([status, "yellow"])
+                symptoms.append(
+                    f"mesh circuit breaker for [{index}] is [{state}]"
+                )
+                diagnosis.append(
+                    {
+                        "cause": (
+                            f"the SPMD mesh path for [{index}] is "
+                            f"[{state}]: recent execution failures "
+                            "tripped its circuit breaker"
+                        ),
+                        "action": (
+                            "serving continues on the host path; see "
+                            "mesh_serving.views[...].last_error and "
+                            "re-enable after fixing the cause"
+                        ),
+                    }
+                )
+    if mesh:
+        details["mesh_breakers"] = mesh
+    if (
+        ctx.fanned
+        and ctx.expected_nodes
+        and not ctx.node_inputs.keys() & set(ctx.expected_nodes)
+    ):
+        status = "red"
+        symptoms.append("no cluster member answered the health fan")
+        diagnosis.extend(_fan_failure_diagnosis(ctx))
+    impacts = []
+    if status != "green":
+        impacts.append(
+            {
+                "severity": 1 if status == "red" else 3,
+                "description": (
+                    "the cluster wire is down"
+                    if status == "red"
+                    else "cross-node requests may retry or fail over "
+                    "more than usual"
+                ),
+                "impact_areas": ["cluster_coordination", "search"],
+            }
+        )
+    symptom = (
+        "Transport is quiet: no recent timeouts, rejects, or reconnect "
+        "churn."
+        if status == "green"
+        else "; ".join(symptoms) + "."
+    )
+    return _result(status, symptom, details, impacts, diagnosis)
+
+
+# ------------------------------------------------------------ the service
+
+
+class HealthService:
+    """Stateful report builder: computes every `INDICATORS` entry over a
+    HealthContext, tracks cross-report control-plane history (term
+    changes for the re-election rule, step-error deltas), and surfaces
+    `estpu_health_reports_total` / `estpu_health_status{indicator}` plus
+    the `_nodes/stats → health` section."""
+
+    def __init__(self, metrics=None, window_s: float = 60.0):
+        self.metrics = metrics
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        # (monotonic, term) observations — re-election rule input.
+        self._terms: deque[tuple[float, int]] = deque(maxlen=32)
+        self._last_step_errors: dict[str, int] = {}
+        self._last: dict[str, str] = {}
+        self._reports = 0
+        if metrics is not None:
+            self._reports_c = metrics.counter(
+                "estpu_health_reports_total",
+                "Health reports computed (GET /_health_report rounds)",
+            )
+        else:
+            self._reports_c = None
+
+    # ------------------------------------------------ history observation
+
+    def _observe(self, ctx: HealthContext) -> None:
+        """Fold this round's control-plane observations into the rolling
+        history and stamp the ctx with the recent-window aggregates."""
+        now = time.monotonic()
+        term = 0 if ctx.state is None else int(ctx.state.term)
+        step_delta = 0
+        with self._lock:
+            if term and (
+                not self._terms or self._terms[-1][1] != term
+            ):
+                self._terms.append((now, term))
+            floor = now - self.window_s
+            recent_terms = max(
+                0,
+                len([1 for t, _ in self._terms if t >= floor]) - 1,
+            )
+            for node_id, inputs in ctx.node_inputs.items():
+                errors = int(inputs.get("step_errors", 0) or 0)
+                prev = self._last_step_errors.get(node_id)
+                if prev is not None and errors > prev:
+                    step_delta += errors - prev
+                self._last_step_errors[node_id] = errors
+        ctx.recent_terms = recent_terms
+        ctx.recent_step_errors = step_delta
+
+    # --------------------------------------------------------- reporting
+
+    def report(
+        self,
+        ctx: HealthContext,
+        verbose: bool = True,
+        indicator: str | None = None,
+    ) -> dict[str, Any]:
+        """Compute the full report. `verbose=False` is the cheap
+        liveness-probe shape: indicator statuses + symptoms only, no
+        details/impacts/diagnosis blocks (the caller also skips the
+        cluster fan for it). `indicator` filters to one entry."""
+        if indicator is not None and indicator not in INDICATORS:
+            raise KeyError(indicator)
+        self._observe(ctx)
+        names = (indicator,) if indicator else INDICATORS
+        indicators: dict[str, Any] = {}
+        for name in names:
+            result = globals()[f"indicator_{name}"](ctx)
+            if not verbose:
+                result = {
+                    "status": result["status"],
+                    "symptom": result["symptom"],
+                }
+            indicators[name] = result
+        status = worst(r["status"] for r in indicators.values())
+        with self._lock:
+            self._reports += 1
+            for name, result in indicators.items():
+                self._last[name] = result["status"]
+        if self._reports_c is not None:
+            self._reports_c.inc()
+        if self.metrics is not None:
+            for name, result in indicators.items():
+                self.metrics.gauge(
+                    "estpu_health_status",
+                    "Last-computed indicator status (0 green / 1 "
+                    "yellow / 2 red)",
+                    indicator=name,
+                ).set(_STATUS_RANK.get(result["status"], 1))
+        out: dict[str, Any] = {
+            "cluster_name": ctx.cluster_name,
+            "status": status,
+            "indicators": indicators,
+        }
+        if ctx.fanned:
+            header: dict[str, Any] = {
+                "total": 1 + len(ctx.expected_nodes),
+                "successful": 1
+                + len(
+                    [
+                        n
+                        for n in ctx.expected_nodes
+                        if n in ctx.node_inputs
+                    ]
+                ),
+                "failed": len(ctx.fan_failures),
+            }
+            if ctx.fan_failures:
+                header["failures"] = list(ctx.fan_failures)
+            out["_nodes"] = header
+        return out
+
+    def stats(self) -> dict[str, Any]:
+        """The `_nodes/stats → health` section: last statuses + rounds."""
+        with self._lock:
+            last = dict(self._last)
+            reports = self._reports
+        return {
+            "reports_total": reports,
+            "last_status": worst(last.values()) if last else "unknown",
+            "indicators": {k: last[k] for k in sorted(last)},
+        }

@@ -1,0 +1,131 @@
+"""Query insights: a bounded top-N slowest-searches sample.
+
+The structured analog of the search slowlog (ISSUE 15): where the
+slowlog emits text lines past a configured threshold, the insights ring
+keeps the N slowest searches seen so far as STRUCTURED entries — took,
+index, the per-phase breakdown and chosen backend(s) from the same
+`SearchResponse.phases` hook the slowlog reads, the response's shard
+math, and the request's trace_id as an exemplar (join against
+`GET /_traces/{id}` for the full span tree). Served at
+`GET /_insights/queries`.
+
+Admission is a min-heap on took: a search enters only while the ring has
+room or it is slower than the current fastest member, so the ring
+converges on the true top-N without unbounded memory — and a storm of
+fast queries can never wash the slow exemplars out.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import threading
+import time
+from typing import Any
+
+
+class QueryInsights:
+    """Thread-safe bounded top-N slowest-searches sample."""
+
+    def __init__(self, capacity: int = 100, metrics=None):
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        # Min-heap of (took_ms, seq, entry): the root is the FASTEST
+        # retained search — the admission bar.
+        self._heap: list[tuple[float, int, dict]] = []
+        self._seq = 0
+        self.metrics = metrics
+        if metrics is not None:
+            self._recorded = metrics.counter(
+                "estpu_insights_recorded_total",
+                "Searches offered to the insights ring (recorded + "
+                "rejected-by-bar)",
+            )
+            metrics.gauge(
+                "estpu_insights_entries",
+                "Entries resident in the insights top-N ring",
+                fn=lambda: len(self._heap),
+            )
+        else:
+            self._recorded = None
+
+    def record(
+        self,
+        index: str,
+        took_ms: int,
+        shards: dict | None = None,
+        trace_id: str | None = None,
+        phases: dict | None = None,
+        source: dict | None = None,
+    ) -> None:
+        if self._recorded is not None:
+            self._recorded.inc()
+        with self._lock:
+            if (
+                len(self._heap) >= self.capacity
+                and took_ms <= self._heap[0][0]
+            ):
+                return  # faster than every retained entry: not insight
+            entry: dict[str, Any] = {
+                "took_ms": int(took_ms),
+                "index": index,
+                # staticcheck: ignore[wallclock-duration] user-facing epoch stamp on the entry; nothing measures durations from it
+                "timestamp_ms": int(time.time() * 1e3),
+            }
+            if trace_id:
+                entry["trace_id"] = trace_id
+            if shards:
+                entry["shards"] = {
+                    k: shards[k]
+                    for k in ("total", "successful", "skipped", "failed")
+                    if k in shards
+                }
+            if phases:
+                entry["phases"] = {
+                    k: v for k, v in phases.items() if k != "backends"
+                }
+                if phases.get("backends"):
+                    # Planner-chosen execution backend(s) (per-segment
+                    # tally) — the plan-class attribution the slowlog
+                    # never carried.
+                    entry["backends"] = dict(phases["backends"])
+            if source is not None:
+                entry["source"] = json.dumps(
+                    source, separators=(",", ":")
+                )[:1000]
+            self._seq += 1
+            item = (float(took_ms), self._seq, entry)
+            if len(self._heap) >= self.capacity:
+                heapq.heapreplace(self._heap, item)
+            else:
+                heapq.heappush(self._heap, item)
+
+    def queries(self, size: int | None = None) -> list[dict]:
+        """Retained entries, slowest first."""
+        with self._lock:
+            items = sorted(self._heap, key=lambda t: (-t[0], -t[1]))
+        out = [dict(entry) for _took, _seq, entry in items]
+        if size is not None:
+            out = out[: max(0, int(size))]
+        return out
+
+    def clear(self) -> int:
+        with self._lock:
+            n = len(self._heap)
+            self._heap = []
+        return n
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            entries = len(self._heap)
+            bar = self._heap[0][0] if self._heap else 0.0
+        return {
+            "entries": entries,
+            "capacity": self.capacity,
+            "min_retained_took_ms": int(bar),
+            "recorded_total": (
+                int(self._recorded.value)
+                if self._recorded is not None
+                else 0
+            ),
+        }

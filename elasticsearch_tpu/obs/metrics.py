@@ -138,6 +138,155 @@ class Histogram:
                 "count": self._count,
             }
 
+class WindowedHistogram:
+    """Rolling-window latency sketch: a ring of fixed-interval buckets,
+    each holding a bounded sample list, covering the trailing
+    ``window_s`` seconds. Every existing instrument is cumulative since
+    boot; health indicators need RECENT behavior — "is the queue backing
+    up NOW", not "did it ever". ``record`` is lock-cheap (one lock, one
+    append); ``snapshot`` computes p50/p99/rate over only the buckets
+    still inside the window, so stale load ages out within one bucket
+    interval of leaving it."""
+
+    __slots__ = (
+        "window_s", "interval_s", "n_buckets", "cap_per_bucket",
+        "_lock", "_samples", "_counts", "_epochs",
+    )
+
+    def __init__(
+        self,
+        window_s: float = 60.0,
+        interval_s: float = 5.0,
+        cap_per_bucket: int = 512,
+    ):
+        self.window_s = float(window_s)
+        self.interval_s = max(0.05, float(interval_s))
+        # +1 ring slot: the current (partial) bucket plus a full window
+        # of sealed buckets.
+        self.n_buckets = max(1, int(round(window_s / self.interval_s))) + 1
+        self.cap_per_bucket = max(1, int(cap_per_bucket))
+        self._lock = threading.Lock()
+        self._samples: list[list[float]] = [
+            [] for _ in range(self.n_buckets)
+        ]
+        # Full count per bucket (the sample list caps; the count doesn't,
+        # so rates stay honest under bursts past the cap).
+        self._counts = [0] * self.n_buckets
+        self._epochs = [-1] * self.n_buckets
+
+    def _slot(self, now: float) -> int:
+        """Rotate to the bucket owning `now`; returns its ring index.
+        Caller holds the lock."""
+        epoch = int(now / self.interval_s)
+        idx = epoch % self.n_buckets
+        if self._epochs[idx] != epoch:
+            self._samples[idx] = []
+            self._counts[idx] = 0
+            self._epochs[idx] = epoch
+        return idx
+
+    def record(self, value: float) -> None:
+        now = time.monotonic()
+        with self._lock:
+            idx = self._slot(now)
+            self._counts[idx] += 1
+            bucket = self._samples[idx]
+            if len(bucket) < self.cap_per_bucket:
+                bucket.append(float(value))
+
+    def snapshot(self) -> dict[str, Any]:
+        """{count, rate_per_s, p50, p99, mean, max} over the trailing
+        window (zeros when the window is empty)."""
+        now = time.monotonic()
+        floor = int(now / self.interval_s) - (self.n_buckets - 1)
+        samples: list[float] = []
+        count = 0
+        with self._lock:
+            for i in range(self.n_buckets):
+                if self._epochs[i] >= floor:
+                    samples.extend(self._samples[i])
+                    count += self._counts[i]
+        if not samples:
+            return {
+                "count": 0, "rate_per_s": 0.0, "p50": 0.0, "p99": 0.0,
+                "mean": 0.0, "max": 0.0,
+            }
+        ordered = sorted(samples)
+        n = len(ordered)
+
+        def pct(q: float) -> float:
+            return ordered[min(n - 1, int(q * (n - 1) + 0.5))]
+
+        return {
+            "count": int(count),
+            "rate_per_s": round(count / self.window_s, 4),
+            "p50": round(pct(0.50), 4),
+            "p99": round(pct(0.99), 4),
+            "mean": round(sum(ordered) / n, 4),
+            "max": round(ordered[-1], 4),
+        }
+
+    def stat(self, name: str) -> float:
+        return float(self.snapshot().get(name, 0.0))
+
+    def count(self) -> float:
+        """Samples inside the trailing window (WindowedCounter parity)."""
+        return float(self.snapshot()["count"])
+
+
+class WindowedCounter:
+    """Rolling-window event counter: ring of per-interval counts; the
+    windowed sibling of a cumulative Counter for rate-style health rules
+    (shed rate, eviction bursts, transport churn)."""
+
+    __slots__ = ("window_s", "interval_s", "n_buckets", "_lock", "_counts",
+                 "_epochs")
+
+    def __init__(self, window_s: float = 60.0, interval_s: float = 5.0):
+        self.window_s = float(window_s)
+        self.interval_s = max(0.05, float(interval_s))
+        self.n_buckets = max(1, int(round(window_s / self.interval_s))) + 1
+        self._lock = threading.Lock()
+        self._counts = [0.0] * self.n_buckets
+        self._epochs = [-1] * self.n_buckets
+
+    def inc(self, n: float = 1.0) -> None:
+        now = time.monotonic()
+        epoch = int(now / self.interval_s)
+        idx = epoch % self.n_buckets
+        with self._lock:
+            if self._epochs[idx] != epoch:
+                self._counts[idx] = 0.0
+                self._epochs[idx] = epoch
+            self._counts[idx] += n
+
+    def count(self) -> float:
+        """Events inside the trailing window."""
+        now = time.monotonic()
+        floor = int(now / self.interval_s) - (self.n_buckets - 1)
+        with self._lock:
+            return float(
+                sum(
+                    c
+                    for c, e in zip(self._counts, self._epochs)
+                    if e >= floor
+                )
+            )
+
+    def rate_per_s(self) -> float:
+        return round(self.count() / self.window_s, 4)
+
+    def snapshot(self) -> dict[str, Any]:
+        count = self.count()
+        return {
+            "count": int(count),
+            "rate_per_s": round(count / self.window_s, 4),
+        }
+
+    def stat(self, name: str) -> float:
+        return float(self.snapshot().get(name, 0.0))
+
+
 class MetricsRegistry:
     """Thread-safe instrument registry with Prometheus text exposition.
 
@@ -149,6 +298,10 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         # name -> (kind, help, {label_tuple: instrument})
         self._families: dict[str, tuple[str, str, dict]] = {}
+        # Rolling-window instruments, keyed (name, label_key). They live
+        # OUTSIDE _families (their exposition is the `stat`-labeled gauge
+        # series windowed_* registers), so _collect/merge stay unchanged.
+        self._windows: dict[tuple, Any] = {}
 
     # ------------------------------------------------------------ creation
 
@@ -214,6 +367,109 @@ class MetricsRegistry:
             if inst is None:
                 inst = series[key] = Histogram(buckets)
             return inst
+
+    # ------------------------------------------------- rolling windows
+
+    def windowed_histogram(
+        self,
+        name: str,
+        help_text: str = "",
+        window_s: float = 60.0,
+        interval_s: float = 5.0,
+        **labels,
+    ) -> WindowedHistogram:
+        """A rolling-window histogram surfaced as `stat`-labeled gauge
+        samples of family `name` (p50 / p99 / rate) — the `estpu_*_recent`
+        exposition shape. Hot paths call ``.record(value)`` on the
+        returned object; scrapes and health indicators read the gauges /
+        ``snapshot()``. Names must end in `_recent` by convention (and
+        `_recent_ms` for millisecond-valued families) so recent-window
+        series are recognizable at a glance; the staticcheck catalog rule
+        covers them like any other estpu_* instrument."""
+        key = (name, self._label_key(labels))
+        with self._lock:
+            existing = self._windows.get(key)
+        if existing is not None:
+            return existing
+        wh = WindowedHistogram(window_s=window_s, interval_s=interval_s)
+        with self._lock:
+            raced = self._windows.get(key)
+            if raced is not None:
+                return raced
+            self._windows[key] = wh
+        for stat in ("p50", "p99", "rate_per_s"):
+            self.gauge(
+                name,
+                help_text,
+                fn=lambda s=stat, w=wh: w.stat(s),
+                stat=stat,
+                **labels,
+            )
+        return wh
+
+    def windowed_counter(
+        self,
+        name: str,
+        help_text: str = "",
+        window_s: float = 60.0,
+        interval_s: float = 5.0,
+        **labels,
+    ) -> WindowedCounter:
+        """A rolling-window counter surfaced as `stat`-labeled gauge
+        samples (count / rate_per_s over the trailing window)."""
+        key = (name, self._label_key(labels))
+        with self._lock:
+            existing = self._windows.get(key)
+        if existing is not None:
+            return existing
+        wc = WindowedCounter(window_s=window_s, interval_s=interval_s)
+        with self._lock:
+            raced = self._windows.get(key)
+            if raced is not None:
+                return raced
+            self._windows[key] = wc
+        for stat in ("count", "rate_per_s"):
+            self.gauge(
+                name,
+                help_text,
+                fn=lambda s=stat, w=wc: w.stat(s),
+                stat=stat,
+                **labels,
+            )
+        return wc
+
+    def window(self, name: str, **labels):
+        """The windowed instrument registered under (name, labels), or
+        None — the health indicators' read accessor."""
+        with self._lock:
+            return self._windows.get((name, self._label_key(labels)))
+
+    def windows(self, name: str) -> list[tuple[dict[str, str], Any]]:
+        """Every windowed instrument of one family as (labels, window)
+        pairs — the multi-label read (e.g. launch outcomes grouped by
+        backend AND outcome)."""
+        with self._lock:
+            return [
+                (dict(key), w)
+                for (n, key), w in self._windows.items()
+                if n == name
+            ]
+
+    def window_counts(self, name: str, label: str) -> dict[str, float]:
+        """Windowed-counter counts keyed by ONE label's value (e.g.
+        transport events by `event`) over the trailing window."""
+        with self._lock:
+            items = [
+                (key, w)
+                for (n, key), w in self._windows.items()
+                if n == name
+            ]
+        out: dict[str, float] = {}
+        for key, window in items:
+            for k, v in key:
+                if k == label:
+                    out[v] = out.get(v, 0.0) + float(window.count())
+        return out
 
     # -------------------------------------------------------------- views
 
@@ -600,6 +856,37 @@ CATALOG = {
     "estpu_trace_fragments_shipped_total": ("counter", "obs.cluster"),
     "estpu_trace_fragments_collected_total": ("counter", "obs.cluster"),
     "estpu_hot_threads_samples_total": ("counter", "obs.cluster"),
+    # Rolling-window (`estpu_*_recent`) instruments (ISSUE 15): every
+    # cumulative instrument above answers "since boot"; these answer
+    # "right now" — the health indicators' inputs, exposed as
+    # `stat`-labeled gauge series (p50/p99/rate_per_s for histograms,
+    # count/rate_per_s for counters) over a trailing 60s window.
+    "estpu_rest_latency_recent_ms": ("windowed_histogram", "obs.recent"),
+    "estpu_exec_batcher_queue_wait_recent_ms": (
+        "windowed_histogram",
+        "exec.batcher",
+    ),
+    "estpu_exec_batcher_shed_recent": ("windowed_counter", "exec.batcher"),
+    "estpu_device_launch_recent": ("windowed_counter", "device"),
+    "estpu_filter_cache_evictions_recent": (
+        "windowed_counter",
+        "indices.filter_cache",
+    ),
+    "estpu_ann_evictions_recent": ("windowed_counter", "search.ann"),
+    "estpu_transport_events_recent": (
+        "windowed_counter",
+        "replication.transport",
+    ),
+    # Health report (obs/health.py, GET /_health_report): report rounds
+    # and the last-computed status per indicator (0 green / 1 yellow /
+    # 2 red), surfaced under `_nodes/stats → health`.
+    "estpu_health_reports_total": ("counter", "health"),
+    "estpu_health_status": ("gauge", "health"),
+    # Query insights ring (obs/insights.py, GET /_insights/queries): the
+    # structured top-N slowest-searches sample fed from the slowlog's
+    # SearchResponse.phases hook.
+    "estpu_insights_recorded_total": ("counter", "obs.insights"),
+    "estpu_insights_entries": ("gauge", "obs.insights"),
 }
 
 # Pow-2-ish bounds for the padding-waste ratio and occupancy/wait shapes.
@@ -685,6 +972,7 @@ class DeviceInstruments:
                 "Wall-clock ms spent in first (compiling) launches",
                 plan_class=kind,
             ).inc(elapsed_s * 1e3)
+        self.launch_outcome(backend, "ok")
         if queue_s is not None:
             execute_s = max(0.0, elapsed_s - queue_s)
             self._launch_hist(kind, backend, "queue").observe(queue_s * 1e3)
@@ -699,6 +987,18 @@ class DeviceInstruments:
                 elapsed_s * 1e3
             )
         return first
+
+    def launch_outcome(self, backend: str, outcome: str) -> None:
+        """Per-backend launch outcomes over the trailing window (the
+        `device_compile`/`exec_saturation` indicators' error-rate input):
+        every completed launch records "ok"; a timed window that raises
+        records "error"."""
+        self.registry.windowed_counter(
+            "estpu_device_launch_recent",
+            "Kernel-launch outcomes per backend over the trailing window",
+            backend=backend,
+            outcome=outcome,
+        ).inc()
 
     def _launch_hist(self, kind: str, backend: str, phase: str) -> Histogram:
         return self.registry.histogram(
@@ -1020,6 +1320,10 @@ class _TimedLaunch:
 
         _device._TLS.launch_window = self._prev_window
         if exc is not None:
+            # A failed launch records no timings (they would poison the
+            # histograms) but DOES count as a windowed error outcome —
+            # the recent-failure-rate input health indicators watch.
+            self.instruments.launch_outcome(self.backend, "error")
             return False
         now = time.monotonic()
         t_disp = self.t_disp or now

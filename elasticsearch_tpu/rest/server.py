@@ -31,6 +31,7 @@ import json
 import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
@@ -70,8 +71,10 @@ class PlainText:
 
 
 # Endpoints that observe the observer: tracing them would fill the ring
-# buffer with scrapes instead of searches.
-_UNTRACED_PATHS = ("/_traces", "/_metrics")
+# buffer with scrapes instead of searches. `/_health_report` belongs
+# here so a paced health poll (a 1/s liveness probe is normal ops)
+# doesn't churn the trace ring.
+_UNTRACED_PATHS = ("/_traces", "/_metrics", "/_health_report")
 
 # Cluster-topology failures that may escape the Node's own retry mapping
 # (e.g. raised from a code path that predates replication): the router
@@ -114,6 +117,47 @@ def _knn_search_body(body: dict) -> dict:
         out[key] = value
     out["knn"] = knn
     return out
+
+
+def _verbose_param(q: dict) -> bool:
+    """?verbose= on /_health_report: default true; false is the cheap
+    liveness-probe mode (no cluster fan, no detail blocks)."""
+    raw = q.get("verbose", "true").strip().lower()
+    if raw in ("true", ""):
+        return True
+    if raw == "false":
+        return False
+    raise ApiError(
+        400,
+        "illegal_argument_exception",
+        f"Failed to parse value [{q['verbose']}] for [verbose]: only "
+        f"[true] or [false] are allowed.",
+    )
+
+
+# Bounded endpoint classes for the per-endpoint rolling latency window
+# (`estpu_rest_latency_recent_ms{endpoint=...}`): route families, never
+# raw paths (unbounded cardinality). Document-API paths split by method:
+# GET/HEAD /{index}/_doc/{id} is a realtime read, not a write.
+def _endpoint_class(path: str, method: str = "GET") -> str:
+    if path.endswith(
+        ("/_search", "/_msearch", "/_count", "/_knn_search")
+    ) or "/_search/" in path:
+        return "search"
+    if "/_mget" in path or path == "/_mget":
+        return "read"
+    if (
+        "/_doc" in path
+        or "/_update" in path
+        or "/_create" in path
+        or path.endswith("/_bulk")
+        or path == "/_bulk"
+        or path.endswith(("/_delete_by_query", "/_update_by_query"))
+    ):
+        return "read" if method in ("GET", "HEAD") else "write"
+    if path.startswith("/_") or "/_" in path:
+        return "admin"
+    return "other"
 
 
 def _timeout_param(q: dict) -> float | None:
@@ -263,8 +307,29 @@ class RestServer:
             "version": {"number": "8.0.0-tpu", "distribution": "elasticsearch-tpu"},
             "tagline": "You Know, for (TPU) Search",
         })
-        r("GET", "/_cluster/health", lambda s, p, q, b: n.cluster_health())
+        r("GET", "/_cluster/health", lambda s, p, q, b: n.cluster_health(
+            wait_for_status=q.get("wait_for_status"),
+            timeout_s=(
+                30.0 if "timeout" not in q else (_timeout_param(q) or 0.0)
+            ),
+        ))
         r("GET", "/_cluster/stats", lambda s, p, q, b: n.cluster_stats())
+        # Health report (obs/health.py): rule-based indicators over the
+        # rolling windows — the reference's GET /_health_report.
+        # ?verbose=false skips the cluster fan and detail blocks (cheap
+        # liveness probe); untraced (see _UNTRACED_PATHS).
+        r("GET", "/_health_report", lambda s, p, q, b: n.health_report(
+            verbose=_verbose_param(q)
+        ))
+        r("GET", "/_health_report/{indicator}", lambda s, p, q, b:
+          n.health_report(
+              verbose=_verbose_param(q), indicator=p["indicator"]
+          ))
+        # Query insights: the bounded top-N slowest-searches sample
+        # (structured slowlog sibling, obs/insights.py).
+        r("GET", "/_insights/queries", lambda s, p, q, b: n.query_insights(
+            size=int(q["size"]) if "size" in q else None
+        ))
         r("GET", "/_nodes", lambda s, p, q, b: n.nodes_info())
         r("GET", "/_nodes/stats", lambda s, p, q, b: n.nodes_stats())
         # Per-node thread-stack sampling, fanned over cluster members
@@ -585,6 +650,15 @@ class RestServer:
 
     # ------------------------------------------------------------- dispatch
 
+    def _record_latency(
+        self, method: str, path: str, elapsed_s: float
+    ) -> None:
+        self.node.metrics.windowed_histogram(
+            "estpu_rest_latency_recent_ms",
+            "Per-endpoint-class REST latency over the trailing window, ms",
+            endpoint=_endpoint_class(path, method),
+        ).record(elapsed_s * 1e3)
+
     def _invoke(self, handler: Handler, params: dict, query: dict, body: str):
         """Run one route handler with topology-failover: a cluster error
         that escapes the gateway's own retries gets ONE more attempt after
@@ -622,7 +696,13 @@ class RestServer:
         `traceparent` response headers."""
         headers = headers or {}
         if any(path == p or path.startswith(p + "/") for p in _UNTRACED_PATHS):
-            return self._dispatch_inner(method, path, query, body)
+            # Untraced, but still timed: the rolling per-endpoint window
+            # is a few counter words, not a trace-ring slot.
+            t0 = time.monotonic()
+            try:
+                return self._dispatch_inner(method, path, query, body)
+            finally:
+                self._record_latency(method, path, time.monotonic() - t0)
         tags = {"method": method, "path": path}
         opaque = headers.get("X-Opaque-Id") or headers.get("x-opaque-id")
         if opaque:
@@ -634,7 +714,16 @@ class RestServer:
             ),
             **tags,
         ) as root:
-            status, payload = self._dispatch_inner(method, path, query, body)
+            t0 = time.monotonic()
+            try:
+                status, payload = self._dispatch_inner(
+                    method, path, query, body
+                )
+            finally:
+                # Per-endpoint-class rolling latency window — the
+                # health report's serving-latency input
+                # (estpu_rest_latency_recent_ms{endpoint=...}).
+                self._record_latency(method, path, time.monotonic() - t0)
             root.tags["status"] = status
             if status >= 500:
                 root.status = "error"

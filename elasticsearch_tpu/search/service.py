@@ -749,6 +749,10 @@ class SearchService:
         total = 0
         timed_out = task is not None and task.timed_out  # agg pass may trip
         profile_segments: list[dict] = []
+        # Per-backend segment tally on EVERY search (bounded: backend
+        # names) — the insights ring's "which backend served this slow
+        # query" attribution, riding the phases hook like the slowlog.
+        backend_tally: dict[str, int] = {}
         timings = {"plan_s": 0.0, "exec_s": 0.0}
         if k > 0 or agg_total is None:
             for seg_i, handle in enumerate(segments):
@@ -786,6 +790,7 @@ class SearchService:
                     )
                     if seg_span is not None:
                         seg_span.tags["backend"] = backend
+                backend_tally[backend] = backend_tally.get(backend, 0) + 1
                 total += seg_total
                 if request.profile:
                     entry = {
@@ -852,6 +857,8 @@ class SearchService:
             "execute_ms": round(timings["exec_s"] * 1e3, 3),
             "reduce_ms": round((time.monotonic() - reduce_t0) * 1e3, 3),
         }
+        if backend_tally:
+            phases["backends"] = backend_tally
         if request.profile:
             backends: dict[str, int] = {}
             for s in profile_segments:
@@ -888,8 +895,11 @@ class SearchService:
             }
             # Profiled searches run unbatched (never queued), so queue_ms
             # is honestly 0; batch queue waits are in _nodes/stats
-            # exec.batcher p50/p99.
-            breakdown = dict(phases)
+            # exec.batcher p50/p99. The backends tally stays internal
+            # (the profile's own `backends` block already reports it).
+            breakdown = {
+                k: v for k, v in phases.items() if k != "backends"
+            }
         return SearchResponse(
             took_ms=took,
             total=total_out,

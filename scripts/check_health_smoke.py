@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""CI smoke gate for the cluster health report (ISSUE 15).
+
+Runs, on the CPU backend with no TPU in the loop:
+
+- the rule-based indicator registry (every INDICATORS entry computes a
+  reference-shaped status/symptom/details/impacts/diagnosis block; a
+  fresh node reports green on every indicator),
+- the rolling-window layer (`estpu_*_recent`: record, percentile
+  snapshot, aging out of the trailing window),
+- the acceptance arcs on BOTH cluster forms: LocalCluster REST front and
+  a 2-process ProcCluster — green report → kill a data node →
+  `/_health_report` turns non-green with a NAMED per-indicator diagnosis
+  within the per-send deadline → restart + heal → green again,
+- the seeded retrace defect flipping `device_compile` yellow naming the
+  plan class, breaker near-budget/drift rules, the
+  `?wait_for_status=green&timeout=` blocking poll (timed_out, never a
+  500), and the `GET /_insights/queries` top-N ring.
+
+The same tests ride the tier-1 run via the fast (`not slow`) marker;
+this script is the standalone hook for pre-merge / cron checks,
+mirroring scripts/check_cluster_obs_smoke.py:
+
+    python scripts/check_health_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "tests/test_health.py",
+        "-q",
+        "-m",
+        "not slow",
+        "-p",
+        "no:cacheprovider",
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    main_rc = main()
+    sys.exit(main_rc)

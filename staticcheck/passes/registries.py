@@ -53,6 +53,11 @@ RULES = {
         "CircuitBreaker add/add_unchecked/release with a label outside "
         "the HBM ledger's label registry (obs/device.py LEDGER_LABELS)"
     ),
+    "registry-indicator": (
+        "health INDICATORS entry without an indicator_<name> "
+        "implementation in obs/health.py (or an implementation absent "
+        "from INDICATORS)"
+    ),
 }
 
 _PLANNER = "elasticsearch_tpu/exec/planner.py"
@@ -61,6 +66,7 @@ _FAULTS = "elasticsearch_tpu/faults/registry.py"
 _METRICS = "elasticsearch_tpu/obs/metrics.py"
 _COMPILE = "elasticsearch_tpu/query/compile.py"
 _DEVICE_OBS = "elasticsearch_tpu/obs/device.py"
+_HEALTH = "elasticsearch_tpu/obs/health.py"
 
 # Files handling raw bool-spec tuples (construction restricted to
 # make_bool_spec in compile.py; index bounds checked everywhere below).
@@ -110,6 +116,7 @@ def run(project: Project) -> list[Finding]:
     findings += _check_metrics(project)
     findings += _check_bool_spec(project)
     findings += _check_breaker_labels(project)
+    findings += _check_indicators(project)
     return findings
 
 
@@ -270,7 +277,16 @@ def _check_fault_sites(project: Project) -> list[Finding]:
 
 # ------------------------------------------------------------ metrics
 
-_INSTRUMENT_METHODS = {"counter", "gauge", "histogram"}
+# `windowed_*` are the rolling-window instruments (ISSUE 15): cataloged
+# with kind "windowed_histogram"/"windowed_counter", so an uncataloged
+# estpu_*_recent / estpu_health_* creation fails the gate like any other.
+_INSTRUMENT_METHODS = {
+    "counter",
+    "gauge",
+    "histogram",
+    "windowed_histogram",
+    "windowed_counter",
+}
 
 
 def _catalog(project: Project) -> tuple[dict[str, str], tuple[int, int]]:
@@ -454,6 +470,65 @@ def _check_breaker_labels(project: Project) -> list[Finding]:
                         ),
                     )
                 )
+    return out
+
+
+# --------------------------------------------------------- indicators
+
+def _check_indicators(project: Project) -> list[Finding]:
+    """The health-indicator registry (obs/health.py INDICATORS): every
+    registered name must have a module-level `indicator_<name>`
+    implementation, and every implementation must be registered — an
+    indicator that computes but never renders (or renders an entry that
+    never computes) would silently hole the health report."""
+    health = project.get(_HEALTH)
+    if health is None:
+        return []
+    names, line = _assigned_tuple(health.tree, "INDICATORS")
+    if not names:
+        return [
+            Finding(
+                rule="registry-indicator",
+                path=_HEALTH,
+                line=1,
+                message="INDICATORS tuple not found",
+            )
+        ]
+    implemented: dict[str, int] = {}
+    for node in health.tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name.startswith(
+            "indicator_"
+        ):
+            implemented[node.name[len("indicator_"):]] = node.lineno
+    out = []
+    for name in names:
+        if name not in implemented:
+            out.append(
+                Finding(
+                    rule="registry-indicator",
+                    path=_HEALTH,
+                    line=line,
+                    message=(
+                        f"indicator [{name}] is registered in INDICATORS "
+                        "but has no indicator_<name> implementation — "
+                        "the health report would KeyError computing it"
+                    ),
+                )
+            )
+    for name, impl_line in sorted(implemented.items()):
+        if name not in names:
+            out.append(
+                Finding(
+                    rule="registry-indicator",
+                    path=_HEALTH,
+                    line=impl_line,
+                    message=(
+                        f"indicator_[{name}] is implemented but absent "
+                        "from INDICATORS — it never renders in the "
+                        "health report"
+                    ),
+                )
+            )
     return out
 
 

@@ -1,0 +1,19 @@
+"""Fixture health-indicator registry (registry-indicator).
+
+[good] is registered AND implemented (clean); [missing] is registered
+with no implementation; indicator_ghost is implemented but never
+registered — both directions must fail the gate.
+"""
+
+INDICATORS = (
+    "good",
+    "missing",
+)
+
+
+def indicator_good(ctx):
+    return {"status": "green", "symptom": "fixture"}
+
+
+def indicator_ghost(ctx):
+    return {"status": "green", "symptom": "never renders"}

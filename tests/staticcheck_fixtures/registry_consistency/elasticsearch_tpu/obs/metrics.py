@@ -4,4 +4,5 @@ CATALOG = {
     "estpu_good_total": ("counter", "fixture"),
     "estpu_kind_total": ("counter", "fixture"),
     "estpu_dead_total": ("counter", "fixture"),
+    "estpu_good_recent_ms": ("windowed_histogram", "fixture"),
 }

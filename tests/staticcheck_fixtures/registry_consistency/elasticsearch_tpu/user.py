@@ -95,6 +95,20 @@ def make_hbm_instruments(m):
     )
 
 
+def make_health_instruments(m):
+    # A health-report instrument that never made it into the CATALOG
+    # must fail like any other rogue estpu_* registration.
+    m.counter(
+        "estpu_health_rogue_total",
+        "health instrument not in CATALOG",
+    )
+    # Rolling-window instruments are instruments too: an uncataloged
+    # estpu_*_recent windowed counter/histogram fails the same gate
+    # (and a cataloged one stays clean).
+    m.windowed_counter("estpu_rogue_recent", "window not in CATALOG")
+    m.windowed_histogram("estpu_good_recent_ms", "cataloged: fine")
+
+
 def charge_breaker(breaker, n):
     breaker.add(n, label="segment")  # registered ledger label: fine
     # f-string labels match by static prefix, like fault-site patterns.

@@ -1,0 +1,811 @@
+"""Cluster health report (ISSUE 15): rule-based indicators over rolling
+windows, `GET /_health_report`, the `wait_for_status` blocking poll, and
+the query-insights ring.
+
+The acceptance arc runs on BOTH cluster forms: a LocalCluster REST front
+and a 2-process ProcCluster — green report → kill a data node →
+`/_health_report` turns non-green with a NAMED per-indicator diagnosis
+within the per-send deadline (never a hang) → restart + heal → green
+again. Indicator rules are additionally unit-tested over synthetic
+HealthContexts (the pure-function contract), and the PR-14 seeded
+retrace defect must flip `device_compile` to yellow NAMING the plan
+class.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.state import ClusterState, IndexMeta, ShardRouting
+from elasticsearch_tpu.node import NODES_FAN_TIMEOUT_S, Node
+from elasticsearch_tpu.obs.health import (
+    INDICATORS,
+    HealthContext,
+    HealthService,
+    indicator_device_memory,
+    indicator_exec_saturation,
+    indicator_master_stability,
+    indicator_shards_availability,
+    indicator_transport,
+    shard_summary,
+    worst,
+)
+from elasticsearch_tpu.obs.insights import QueryInsights
+from elasticsearch_tpu.obs.metrics import (
+    MetricsRegistry,
+    WindowedCounter,
+    WindowedHistogram,
+)
+from elasticsearch_tpu.rest.server import RestServer
+
+REPLICATED_INDEX = json.dumps(
+    {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 1},
+        "mappings": {"properties": {"b": {"type": "text"}}},
+    }
+)
+
+
+def _mappings():
+    return {"mappings": {"properties": {"body": {"type": "text"}}}}
+
+
+# ------------------------------------------------------- rolling windows
+
+
+class TestRollingWindows:
+    def test_windowed_histogram_percentiles_and_rate(self):
+        wh = WindowedHistogram(window_s=60.0, interval_s=5.0)
+        for v in range(1, 101):
+            wh.record(float(v))
+        snap = wh.snapshot()
+        assert snap["count"] == 100
+        assert 45 <= snap["p50"] <= 55
+        assert snap["p99"] >= 95
+        assert snap["max"] == 100.0
+        assert snap["rate_per_s"] == pytest.approx(100 / 60.0, rel=1e-3)
+
+    def test_windowed_counter_ages_out(self):
+        wc = WindowedCounter(window_s=0.2, interval_s=0.05)
+        wc.inc(3)
+        assert wc.count() == 3
+        time.sleep(0.45)
+        assert wc.count() == 0  # outside the trailing window
+
+    def test_windowed_histogram_ages_out(self):
+        wh = WindowedHistogram(window_s=0.2, interval_s=0.05)
+        wh.record(7.0)
+        assert wh.snapshot()["count"] == 1
+        time.sleep(0.45)
+        assert wh.snapshot()["count"] == 0
+
+    def test_registry_windows_expose_stat_gauges(self):
+        registry = MetricsRegistry()
+        wh = registry.windowed_histogram(
+            "estpu_rest_latency_recent_ms", "t", endpoint="search"
+        )
+        wh.record(10.0)
+        # Same (name, labels) returns the same window.
+        again = registry.windowed_histogram(
+            "estpu_rest_latency_recent_ms", "t", endpoint="search"
+        )
+        assert again is wh
+        text = registry.exposition()
+        assert 'estpu_rest_latency_recent_ms{endpoint="search",stat="p50"}' in text
+        assert registry.window(
+            "estpu_rest_latency_recent_ms", endpoint="search"
+        ) is wh
+        wc = registry.windowed_counter(
+            "estpu_transport_events_recent", "t", event="reconnect"
+        )
+        wc.inc(4)
+        assert registry.window_counts(
+            "estpu_transport_events_recent", "event"
+        ) == {"reconnect": 4.0}
+
+
+# --------------------------------------------------------- indicator rules
+
+
+def _state(term=3, master="node-0", unassigned=False, under_replicated=False):
+    routing = ShardRouting(
+        primary=None if unassigned else "node-0",
+        replicas=[] if (unassigned or under_replicated) else ["node-1"],
+        in_sync={"node-0", "node-1"},
+    )
+    meta = IndexMeta(
+        name="h", mappings={}, n_shards=1, n_replicas=1,
+        shards={0: routing},
+    )
+    return ClusterState(
+        term=term,
+        version=7,
+        master=master,
+        nodes={"node-0", "node-1"},
+        seed_nodes=("node-0", "node-1", "node-2"),
+        indices={"h": meta},
+    )
+
+
+def _ctx(state=None, **kw):
+    defaults = dict(
+        standalone=state is None,
+        state=state,
+        node_inputs={"node-0": {}},
+        fanned=state is not None,
+        expected_nodes=("node-0", "node-1", "node-2") if state else (),
+    )
+    defaults.update(kw)
+    return HealthContext(**defaults)
+
+
+class TestIndicatorRules:
+    def test_every_indicator_registered_and_callable(self):
+        from elasticsearch_tpu.obs import health
+
+        for name in INDICATORS:
+            assert callable(getattr(health, f"indicator_{name}"))
+
+    def test_worst_ordering(self):
+        assert worst(["green", "yellow"]) == "yellow"
+        assert worst(["yellow", "red", "green"]) == "red"
+        assert worst([]) == "green"
+
+    def test_shard_summary_matches_cluster_health_semantics(self):
+        assert shard_summary(None)["status"] == "red"
+        assert shard_summary(_state())["status"] == "green"
+        assert shard_summary(_state(unassigned=True))["status"] == "red"
+        yellow = shard_summary(_state(under_replicated=True))
+        assert yellow["status"] == "yellow"
+        assert yellow["active_shards"] < yellow["desired_shards"]
+
+    def test_shards_availability_names_dead_node(self):
+        ctx = _ctx(
+            _state(),
+            fan_failures=[
+                {"node": "node-1", "type": "ConnectTransportError",
+                 "reason": "refused"}
+            ],
+        )
+        out = indicator_shards_availability(ctx)
+        assert out["status"] == "yellow"
+        assert any("node-1" in d["cause"] for d in out["diagnosis"])
+        assert any("restart" in d["action"] for d in out["diagnosis"])
+
+    def test_shards_availability_red_names_indices(self):
+        out = indicator_shards_availability(_ctx(_state(unassigned=True)))
+        assert out["status"] == "red"
+        assert any("['h']" in d["cause"] for d in out["diagnosis"])
+
+    def test_master_stability_red_without_master(self):
+        out = indicator_master_stability(_ctx(_state(master=None)))
+        assert out["status"] == "red"
+        assert out["impacts"] and out["diagnosis"]
+
+    def test_master_stability_red_below_quorum(self):
+        # 1 answering node of 3 seeds: below the quorum of 2.
+        ctx = _ctx(
+            _state(),
+            node_inputs={"node-0": {}},
+            fan_failures=[
+                {"node": n, "type": "ConnectTransportError", "reason": "x"}
+                for n in ("node-1", "node-2")
+            ],
+        )
+        out = indicator_master_stability(ctx)
+        assert out["status"] == "red"
+        assert any("quorum" in d["cause"] for d in out["diagnosis"])
+
+    def test_master_stability_yellow_on_reelection_churn(self):
+        service = HealthService()
+        inputs = {n: {} for n in ("node-0", "node-1", "node-2")}
+        for term in (1, 2, 3):
+            report = service.report(
+                _ctx(_state(term=term), node_inputs=dict(inputs))
+            )
+        out = report["indicators"]["master_stability"]
+        assert out["status"] == "yellow"
+        assert any("term changed" in d["cause"] for d in out["diagnosis"])
+
+    def test_device_memory_rules(self):
+        # Near budget -> yellow.
+        ctx = _ctx(node_inputs={"n": {
+            "breaker": {
+                "limit_size_in_bytes": 1000,
+                "estimated_size_in_bytes": 950,
+            },
+            "hbm": {"breaker_drift_bytes": 0},
+        }})
+        assert indicator_device_memory(ctx)["status"] == "yellow"
+        # Drift is ALWAYS red.
+        ctx = _ctx(node_inputs={"n": {
+            "breaker": {
+                "limit_size_in_bytes": 1000,
+                "estimated_size_in_bytes": 10,
+            },
+            "hbm": {"breaker_drift_bytes": 64},
+        }})
+        out = indicator_device_memory(ctx)
+        assert out["status"] == "red"
+        assert any("drift" in s for s in [out["symptom"]])
+        # Recent trips -> yellow.
+        ctx = _ctx(node_inputs={"n": {
+            "breaker": {
+                "limit_size_in_bytes": 1000,
+                "estimated_size_in_bytes": 10,
+            },
+            "hbm": {"breaker_drift_bytes": 0},
+            "breaker_trips_recent": 2,
+        }})
+        assert indicator_device_memory(ctx)["status"] == "yellow"
+        # Eviction burst -> yellow.
+        ctx = _ctx(node_inputs={"n": {
+            "breaker": {
+                "limit_size_in_bytes": 1000,
+                "estimated_size_in_bytes": 10,
+            },
+            "hbm": {"breaker_drift_bytes": 0},
+            "evictions_recent": {"filter": 200},
+        }})
+        out = indicator_device_memory(ctx)
+        assert out["status"] == "yellow"
+        assert "eviction burst" in out["symptom"]
+
+    def test_device_memory_breaker_fuzz(self):
+        """Near-budget fuzz: random fills on a real breaker flip the
+        indicator exactly when usage crosses the yellow fraction."""
+        import numpy as np
+
+        from elasticsearch_tpu.common.breaker import (
+            BreakerError,
+            CircuitBreaker,
+        )
+        from elasticsearch_tpu.obs.health import HBM_YELLOW_FRACTION
+
+        rng = np.random.default_rng(5)
+        for _ in range(12):
+            breaker = CircuitBreaker(10_000)
+            target = int(rng.integers(1000, 10_000))
+            filled = 0
+            while filled < target:
+                n = min(int(rng.integers(1, 2000)), target - filled)
+                breaker.add(n, label="segment")
+                filled += n
+            ctx = _ctx(node_inputs={"n": {
+                "breaker": breaker.stats(),
+                "hbm": {"breaker_drift_bytes": 0},
+                "breaker_trips_recent": breaker.trips_recent(),
+            }})
+            out = indicator_device_memory(ctx)
+            expect = (
+                "yellow"
+                if filled >= 10_000 * HBM_YELLOW_FRACTION
+                else "green"
+            )
+            assert out["status"] == expect, (filled, out["symptom"])
+            # Overfill trips the breaker -> yellow regardless of level.
+            with pytest.raises(BreakerError):
+                breaker.add(20_000, label="segment")
+            ctx = _ctx(node_inputs={"n": {
+                "breaker": breaker.stats(),
+                "hbm": {"breaker_drift_bytes": 0},
+                "breaker_trips_recent": breaker.trips_recent(),
+            }})
+            assert indicator_device_memory(ctx)["status"] == "yellow"
+
+    def test_exec_saturation_rules(self):
+        base = {"batcher": {"quarantined_now": 0, "queued": 0}}
+        assert (
+            indicator_exec_saturation(_ctx(node_inputs={"n": dict(base)}))[
+                "status"
+            ]
+            == "green"
+        )
+        ctx = _ctx(node_inputs={"n": {**base, "shed_recent": 3}})
+        out = indicator_exec_saturation(ctx)
+        assert out["status"] == "yellow" and "shed" in out["symptom"]
+        ctx = _ctx(node_inputs={"n": {**base, "shed_recent": 500}})
+        assert indicator_exec_saturation(ctx)["status"] == "red"
+        ctx = _ctx(node_inputs={"n": {
+            **base,
+            "queue_wait_recent": {"p99": 400.0, "count": 9},
+        }})
+        out = indicator_exec_saturation(ctx)
+        assert out["status"] == "yellow" and "p99" in out["symptom"]
+        ctx = _ctx(node_inputs={"n": {
+            "batcher": {"quarantined_now": 2, "queued": 0},
+        }})
+        out = indicator_exec_saturation(ctx)
+        assert out["status"] == "yellow" and "quarantined" in out["symptom"]
+
+    def test_device_compile_yellow_on_recent_launch_errors(self):
+        from elasticsearch_tpu.obs.health import indicator_device_compile
+
+        ctx = _ctx(node_inputs={"n": {
+            "device_compile": {
+                "compiles_by_plan_class": {"solo": 2},
+                "retraced_plan_classes": {},
+            },
+            "launch_outcomes_recent": {"device": {"ok": 1, "error": 3}},
+        }})
+        out = indicator_device_compile(ctx)
+        assert out["status"] == "yellow"
+        assert "failed" in out["symptom"]
+        assert out["details"]["launch_errors_recent"] == 3
+        assert any("raising" in d["cause"] for d in out["diagnosis"])
+
+    def test_transport_rules(self):
+        ctx = _ctx(node_inputs={"n": {
+            "transport": {"kind": "tcp"},
+            "transport_events_recent": {"send_timeout": 2},
+        }})
+        out = indicator_transport(ctx)
+        assert out["status"] == "yellow" and "timeout" in out["symptom"]
+        ctx = _ctx(node_inputs={"n": {
+            "transport_events_recent": {"handshake_reject": 1},
+        }})
+        assert indicator_transport(ctx)["status"] == "yellow"
+        ctx = _ctx(node_inputs={"n": {
+            "transport_events_recent": {"reconnect": 500},
+        }})
+        out = indicator_transport(ctx)
+        assert out["status"] == "yellow" and "churn" in out["symptom"]
+        # A kill blip's dozen-odd dials stays green (shards_availability
+        # owns single-death findings, not the wire indicator).
+        ctx = _ctx(node_inputs={"n": {
+            "transport_events_recent": {"reconnect": 16},
+        }})
+        assert indicator_transport(ctx)["status"] == "green"
+        ctx = _ctx(node_inputs={"n": {
+            "mesh_breakers": {"idx": "open"},
+        }})
+        out = indicator_transport(ctx)
+        assert out["status"] == "yellow"
+        assert "mesh circuit breaker" in out["symptom"]
+
+
+# ------------------------------------------------------- standalone node
+
+
+class TestStandaloneReport:
+    @pytest.fixture(scope="class")
+    def rest(self):
+        server = RestServer()
+        server.node.create_index("hx", _mappings())
+        server.node.index_doc(
+            "hx", {"body": "alpha beta"}, "1", refresh=True
+        )
+        yield server
+        server.close()
+
+    def test_fresh_node_every_indicator_green_shape(self, rest):
+        status, rep = rest.dispatch("GET", "/_health_report", {}, "")
+        assert status == 200
+        assert rep["status"] == "green"
+        assert set(rep["indicators"]) == set(INDICATORS)
+        for name, ind in rep["indicators"].items():
+            assert ind["status"] == "green", name
+            assert ind["symptom"]
+            # Reference-shaped blocks present (empty when green).
+            assert set(ind) == {
+                "status", "symptom", "details", "impacts", "diagnosis",
+            }
+            assert ind["impacts"] == [] and ind["diagnosis"] == []
+        assert "_nodes" not in rep  # standalone: nothing fanned
+
+    def test_verbose_false_skips_detail_blocks(self, rest):
+        status, rep = rest.dispatch(
+            "GET", "/_health_report", {"verbose": "false"}, ""
+        )
+        assert status == 200
+        for ind in rep["indicators"].values():
+            assert set(ind) == {"status", "symptom"}
+
+    def test_single_indicator_route_and_unknown_400(self, rest):
+        status, rep = rest.dispatch(
+            "GET", "/_health_report/device_memory", {}, ""
+        )
+        assert status == 200
+        assert list(rep["indicators"]) == ["device_memory"]
+        status, err = rest.dispatch(
+            "GET", "/_health_report/bogus", {}, ""
+        )
+        assert status == 400
+        assert err["error"]["type"] == "illegal_argument_exception"
+        status, err = rest.dispatch(
+            "GET", "/_health_report", {"verbose": "maybe"}, ""
+        )
+        assert status == 400
+
+    def test_health_polling_does_not_churn_trace_ring(self, rest):
+        def newest_ids():
+            # Newest-first trace ids: the ring may already be at
+            # capacity (process-global), so compare identities, not
+            # counts.
+            return [
+                t["trace_id"]
+                for t in rest.node.get_traces(limit=5)["traces"]
+            ]
+
+        before = newest_ids()
+        for _ in range(5):
+            status, _rep = rest.dispatch("GET", "/_health_report", {}, "")
+            assert status == 200
+        assert newest_ids() == before  # polls buffered NO traces
+        # ... while an ordinary request DOES trace.
+        rest.dispatch(
+            "POST",
+            "/hx/_search",
+            {},
+            json.dumps({"query": {"match": {"body": "alpha"}}}),
+        )
+        after = newest_ids()
+        assert after != before
+        assert after[0] not in before
+
+    def test_endpoint_classes_split_reads_from_writes(self):
+        from elasticsearch_tpu.rest.server import _endpoint_class
+
+        assert _endpoint_class("/idx/_search", "POST") == "search"
+        assert _endpoint_class("/idx/_knn_search", "GET") == "search"
+        assert _endpoint_class("/idx/_doc/1", "GET") == "read"
+        assert _endpoint_class("/idx/_doc/1", "HEAD") == "read"
+        assert _endpoint_class("/idx/_mget", "POST") == "read"
+        assert _endpoint_class("/idx/_doc/1", "PUT") == "write"
+        assert _endpoint_class("/_bulk", "POST") == "write"
+        assert _endpoint_class("/idx/_update/1", "POST") == "write"
+        assert _endpoint_class("/_health_report", "GET") == "admin"
+        assert _endpoint_class("/idx", "PUT") == "other"
+
+    def test_rest_latency_window_records_by_endpoint_class(self, rest):
+        rest.dispatch(
+            "POST",
+            "/hx/_search",
+            {},
+            json.dumps({"query": {"match": {"body": "alpha"}}}),
+        )
+        window = rest.node.metrics.window(
+            "estpu_rest_latency_recent_ms", endpoint="search"
+        )
+        assert window is not None and window.snapshot()["count"] >= 1
+
+    def test_seeded_retrace_defect_flips_device_compile(self, rest):
+        """The PR-14 seeded shape-polymorphism defect: the SAME plan key
+        launches a NEW shape — device_compile goes yellow NAMING the
+        plan class."""
+        import jax
+        import jax.numpy as jnp
+
+        node = rest.node
+        f = jax.jit(lambda x: x * 3 + 1)
+        with node.device.timed("healthpoly", ("healthpoly", 1), "device") as t:
+            t.dispatched(f(jnp.ones(3)))
+        assert t.first
+        with node.device.timed("healthpoly", ("healthpoly", 1), "device") as t:
+            t.dispatched(f(jnp.ones(9)))  # same key, new shape: retrace
+        status, rep = rest.dispatch("GET", "/_health_report", {}, "")
+        assert status == 200
+        ind = rep["indicators"]["device_compile"]
+        assert ind["status"] == "yellow"
+        assert "healthpoly" in ind["symptom"]
+        assert "healthpoly" in ind["details"]["retraced_plan_classes"]
+        assert any("plan key" in d["cause"] for d in ind["diagnosis"])
+        assert rep["status"] == "yellow"
+
+    def test_health_section_and_metrics_exposed(self, rest):
+        rest.dispatch("GET", "/_health_report", {}, "")
+        stats = rest.node.nodes_stats()["nodes"][rest.node.node_name]
+        section = stats["health"]
+        assert section["reports_total"] >= 1
+        assert set(section["indicators"]) == set(INDICATORS)
+        status, payload = rest.dispatch("GET", "/_metrics", {}, "")
+        assert status == 200
+        assert "estpu_health_reports_total" in payload.text
+        assert 'estpu_health_status{indicator="device_memory"}' in payload.text
+
+    def test_cluster_health_is_view_of_shard_summary(self, rest):
+        status, health = rest.dispatch("GET", "/_cluster/health", {}, "")
+        assert status == 200
+        assert health["status"] == "green"
+        assert health["timed_out"] is False
+        status, rows = rest.dispatch("GET", "/_cat/health", {}, "")
+        assert rows[0]["status"] == health["status"]
+        assert rows[0]["unassign"] == str(health["unassigned_shards"])
+
+    def test_wait_for_status_satisfied_immediately(self, rest):
+        status, health = rest.dispatch(
+            "GET",
+            "/_cluster/health",
+            {"wait_for_status": "yellow", "timeout": "5s"},
+            "",
+        )
+        assert status == 200
+        assert health["timed_out"] is False  # green satisfies yellow
+
+    def test_wait_for_status_rejects_bogus_value(self, rest):
+        status, err = rest.dispatch(
+            "GET", "/_cluster/health", {"wait_for_status": "purple"}, ""
+        )
+        assert status == 400
+        assert err["error"]["type"] == "illegal_argument_exception"
+
+
+class TestExecSaturationEndToEnd:
+    def test_windowed_shed_flips_indicator(self):
+        node = Node()
+        try:
+            # The batcher registered its windows at construction; drive
+            # them the way a 429 storm would.
+            shed = node.metrics.window("estpu_exec_batcher_shed_recent")
+            assert shed is not None
+            shed.inc(3)
+            rep = node.health_report()
+            ind = rep["indicators"]["exec_saturation"]
+            assert ind["status"] == "yellow"
+            assert "shed" in ind["symptom"]
+        finally:
+            node.close()
+
+    def test_device_memory_drift_red_end_to_end(self):
+        node = Node(breaker_limit_bytes=1_000_000)
+        try:
+            node.breaker.used += 123  # forge accounting drift
+            rep = node.health_report()
+            ind = rep["indicators"]["device_memory"]
+            assert ind["status"] == "red"
+            assert rep["status"] == "red"
+            assert any(
+                "bypassed the write-through ledger" in d["cause"]
+                for d in ind["diagnosis"]
+            )
+        finally:
+            node.close()
+
+
+# ---------------------------------------------------------- query insights
+
+
+class TestQueryInsights:
+    def test_top_n_bound_and_ordering(self):
+        ring = QueryInsights(capacity=3)
+        for took in (5, 50, 10, 80, 1, 30):
+            ring.record(index="i", took_ms=took)
+        out = ring.queries()
+        assert [e["took_ms"] for e in out] == [80, 50, 30]
+        # A fast query cannot wash a slow exemplar out.
+        ring.record(index="i", took_ms=2)
+        assert [e["took_ms"] for e in ring.queries()] == [80, 50, 30]
+        stats = ring.stats()
+        assert stats["entries"] == 3 and stats["capacity"] == 3
+        assert stats["min_retained_took_ms"] == 30
+
+    def test_entry_shape_from_real_search(self):
+        node = Node()
+        try:
+            node.create_index("qi", _mappings())
+            node.index_doc("qi", {"body": "alpha beta"}, "1", refresh=True)
+            node.search("qi", {"query": {"match": {"body": "alpha"}}})
+            entries = node.query_insights()["queries"]
+            assert entries
+            entry = entries[0]
+            assert entry["index"] == "qi"
+            assert "took_ms" in entry and "timestamp_ms" in entry
+            assert entry["shards"]["total"] == 1
+            assert entry["trace_id"]  # the exemplar join key
+            # Chosen backend(s) ride the phases hook.
+            assert entry["backends"]
+            assert "phases" in entry and "execute_ms" in entry["phases"]
+            assert "alpha" in entry["source"]
+        finally:
+            node.close()
+
+    def test_rest_route_and_stats_section(self):
+        server = RestServer()
+        try:
+            server.node.create_index("qi2", _mappings())
+            server.dispatch(
+                "PUT", "/qi2/_doc/1", {},
+                json.dumps({"body": "alpha"}),
+            )
+            server.dispatch("POST", "/qi2/_refresh", {}, "")
+            server.dispatch(
+                "POST", "/qi2/_search", {},
+                json.dumps({"query": {"match": {"body": "alpha"}}}),
+            )
+            status, out = server.dispatch(
+                "GET", "/_insights/queries", {"size": "1"}, ""
+            )
+            assert status == 200
+            assert len(out["queries"]) == 1
+            assert out["queries"][0]["index"] == "qi2"
+            stats = server.node.nodes_stats()["nodes"][
+                server.node.node_name
+            ]
+            assert stats["obs"]["insights"]["entries"] >= 1
+        finally:
+            server.close()
+
+
+# ------------------------------------------------- LocalCluster REST front
+
+
+class TestLocalClusterHealthArc:
+    @pytest.fixture(scope="class")
+    def rest(self):
+        mesh = os.environ.get("ESTPU_MESH_SERVING")
+        os.environ["ESTPU_MESH_SERVING"] = "0"
+        server = RestServer(replication_nodes=3)
+        server.dispatch("PUT", "/hobs", {}, REPLICATED_INDEX)
+        server.dispatch(
+            "PUT", "/hobs/_doc/1", {}, json.dumps({"b": "alpha"})
+        )
+        yield server
+        server.close()
+        if mesh is None:
+            os.environ.pop("ESTPU_MESH_SERVING", None)
+        else:
+            os.environ["ESTPU_MESH_SERVING"] = mesh
+
+    def _wait_green(self, rest, timeout_s=30.0):
+        deadline = time.monotonic() + timeout_s
+        while True:
+            status, rep = rest.dispatch("GET", "/_health_report", {}, "")
+            assert status == 200
+            if rep["status"] == "green":
+                return rep
+            if time.monotonic() >= deadline:
+                raise AssertionError(f"never green: {rep}")
+            time.sleep(0.2)
+
+    def test_green_report_carries_nodes_header(self, rest):
+        rep = self._wait_green(rest)
+        assert rep["_nodes"]["failed"] == 0
+        assert set(rep["indicators"]) == set(INDICATORS)
+
+    def test_kill_heal_arc_named_diagnosis_within_deadline(self, rest):
+        self._wait_green(rest)
+        rest.cluster.kill("node-2")
+        try:
+            t0 = time.monotonic()
+            status, rep = rest.dispatch("GET", "/_health_report", {}, "")
+            elapsed = time.monotonic() - t0
+            assert status == 200
+            assert elapsed < NODES_FAN_TIMEOUT_S + 3.0
+            assert rep["status"] != "green"
+            assert rep["_nodes"]["failed"] == 1
+            assert rep["_nodes"]["failures"][0]["node"] == "node-2"
+            sa = rep["indicators"]["shards_availability"]
+            assert sa["status"] != "green"
+            assert any("node-2" in d["cause"] for d in sa["diagnosis"])
+            assert any("restart" in d["action"] for d in sa["diagnosis"])
+            # wait_for_status=green times out HONESTLY (200 +
+            # timed_out: true, never a 500) while the cluster is degraded
+            # ... unless the stepper heals it within the wait, in which
+            # case the poll returns green (both are correct; what the
+            # contract forbids is an error).
+            status, health = rest.dispatch(
+                "GET",
+                "/_cluster/health",
+                {"wait_for_status": "green", "timeout": "200ms"},
+                "",
+            )
+            assert status == 200
+            assert health["timed_out"] or health["status"] == "green"
+        finally:
+            rest.cluster.restart("node-2")
+        rep = self._wait_green(rest)
+        assert rep["_nodes"]["failed"] == 0
+        status, health = rest.dispatch(
+            "GET",
+            "/_cluster/health",
+            {"wait_for_status": "green", "timeout": "30s"},
+            "",
+        )
+        assert status == 200
+        assert health["status"] == "green" and not health["timed_out"]
+
+
+# ------------------------------------------------------ ProcCluster (2 OS
+# processes + tiebreaker): the acceptance arc over real sockets.
+
+
+@pytest.fixture(scope="module")
+def procs():
+    from elasticsearch_tpu.cluster.procs import ProcCluster
+
+    cluster = ProcCluster(
+        2, data_path=tempfile.mkdtemp(prefix="estpu-health-procs-")
+    )
+    yield cluster
+    cluster.close()
+
+
+class TestProcClusterHealthArc:
+    def test_full_arc_green_kill9_named_diagnosis_heal_green(self, procs):
+        procs.create_index(
+            "h",
+            n_shards=1,
+            n_replicas=1,
+            mappings={"properties": {"b": {"type": "text"}}},
+        )
+        procs.write("h", "d1", {"b": "alpha"})
+        procs.wait_for(
+            lambda: procs.health_report()["status"] == "green",
+            timeout_s=30,
+            what="green report",
+        )
+        rep = procs.health_report()
+        assert rep["_nodes"] == {"total": 3, "successful": 3, "failed": 0}
+
+        victim = procs.workers[1]
+        procs.kill_9(victim)
+        t0 = time.monotonic()
+        rep = procs.health_report()
+        elapsed = time.monotonic() - t0
+        # Within the per-send deadline: a SIGKILL'd process degrades the
+        # report with a named diagnosis, never a hang.
+        assert elapsed < (procs.send_timeout_s or 5.0) + 3.0
+        assert rep["status"] != "green"
+        assert rep["_nodes"]["failed"] == 1
+        assert rep["_nodes"]["failures"][0]["node"] == victim
+        sa = rep["indicators"]["shards_availability"]
+        assert sa["status"] != "green"
+        assert any(victim in d["cause"] for d in sa["diagnosis"])
+
+        # The cheap probe (verbose=false) skips the worker fan entirely:
+        # statuses + symptoms only, still instant.
+        terse = procs.health_report(verbose=False)
+        assert set(terse["indicators"]) == set(INDICATORS)
+        for ind in terse["indicators"].values():
+            assert set(ind) == {"status", "symptom"}
+
+        procs.restart(victim)
+        procs.wait_for(
+            lambda: procs.health_report()["status"] == "green",
+            timeout_s=60,
+            what="healed green report",
+        )
+        rep = procs.health_report()
+        assert rep["status"] == "green"
+        assert rep["_nodes"]["failed"] == 0
+
+
+class TestProcClusterNoTiebreaker:
+    def test_terse_probe_adopts_worker_state(self):
+        """Without a supervisor-resident tiebreaker the probe has no
+        local state; BOTH modes must adopt an answering worker's
+        published state — a healthy cluster must never read red just
+        because the cheap probe skipped the fan."""
+        from elasticsearch_tpu.cluster.procs import ProcCluster
+
+        procs = ProcCluster(
+            2,
+            data_path=tempfile.mkdtemp(prefix="estpu-health-notb-"),
+            tiebreaker=False,
+        )
+        try:
+            procs.create_index(
+                "nt",
+                n_shards=1,
+                n_replicas=1,
+                mappings={"properties": {"b": {"type": "text"}}},
+            )
+            procs.write("nt", "d1", {"b": "alpha"})
+            procs.wait_for(
+                lambda: procs.health_report()["status"] == "green",
+                timeout_s=30,
+                what="green report (no tiebreaker)",
+            )
+            terse = procs.health_report(verbose=False)
+            assert terse["status"] == "green"
+            assert (
+                terse["indicators"]["master_stability"]["status"]
+                == "green"
+            )
+        finally:
+            procs.close()

@@ -169,7 +169,26 @@ class TestRegistryConsistency:
         assert any("[estpu_nodes_rogue_total]" in m for m in msgs)
         # ... and an uncataloged HBM-ledger instrument
         assert any("[estpu_hbm_rogue_total]" in m for m in msgs)
-        assert len(msgs) == 11
+        # ... and an uncataloged health instrument
+        assert any("[estpu_health_rogue_total]" in m for m in msgs)
+        # ... and an uncataloged rolling-window instrument; the
+        # cataloged windowed twin (estpu_good_recent_ms) stays clean.
+        assert any("[estpu_rogue_recent]" in m for m in msgs)
+        assert not any("[estpu_good_recent_ms]" in m for m in msgs)
+        assert len(msgs) == 13
+
+    def test_indicator_registry(self, report):
+        msgs = [
+            f.message
+            for f in report.findings
+            if f.rule == "registry-indicator"
+        ]
+        # [missing] is registered with no implementation; [ghost] is
+        # implemented but unregistered; [good] is clean.
+        assert len(msgs) == 2
+        assert any("[missing]" in m for m in msgs)
+        assert any("[ghost]" in m for m in msgs)
+        assert not any("[good]" in m for m in msgs)
 
     def test_breaker_labels(self, report):
         msgs = [
