@@ -2480,6 +2480,238 @@ def bench_cfg13_health(
     }
 
 
+def bench_cfg14_socket(n_docs=None, n_q=24, duration_s=3.0):
+    """ISSUE 16 config: the socketed serving topology's wire tax.
+
+    The same cfg3-style filtered-query mix is served twice through the
+    SAME REST front code, same replication semantics (1 primary + 1
+    replica, acked writes reach every in-sync copy), same corpus and
+    ingest order — once over the in-process hub transport
+    (`replication_nodes=2`) and once over the socketed multi-process
+    topology (`proc_nodes=2`: data nodes are separate OS processes
+    reached through cluster/tcp_transport.py, the one-machine rehearsal
+    of the production layout). Gates: the hits are bit-identical between
+    topologies (the wire must not change results), and the socketed p50
+    stays within 3x of the in-process p50 plus a 3 ms scheduling floor —
+    the budget for two real socket hops (front → primary → replica) plus
+    two process schedulings per request. The per-hop
+    http → gateway → shard latency split comes from the windowed
+    instruments each hop already records (`estpu_rest_latency_recent_ms`,
+    `estpu_gateway_latency_recent_ms`, `estpu_shard_exec_latency_recent_ms`
+    — the last federated from the worker processes over `_ctl`)."""
+    import json
+    import os
+    import re as re_mod
+    import tempfile
+
+    from elasticsearch_tpu.rest.server import RestServer
+
+    if n_docs is None:
+        n_docs = int(os.environ.get("ESTPU_BENCH_SOCKET_N", 4_000))
+    rng = np.random.default_rng(71)
+    t0 = time.monotonic()
+    # The corpus must travel the WRITE path of each topology (no
+    # restore_segments shortcut: the data nodes are other processes), so
+    # build raw JSON docs — zipf-ish bodies + a doc-values float for the
+    # range filter — identically for both runs.
+    vocab = [f"w{i:04d}" for i in range(2_000)]
+    probs = 1.0 / np.arange(1, len(vocab) + 1) ** 1.1
+    probs /= probs.sum()
+    ranks = rng.random(n_docs)
+    docs = []
+    for i in range(n_docs):
+        terms = rng.choice(len(vocab), size=12, p=probs)
+        docs.append(
+            (
+                f"d{i}",
+                {
+                    "body": " ".join(vocab[t] for t in terms),
+                    "rank": float(ranks[i]),
+                },
+            )
+        )
+    bulk_chunks = []
+    for start in range(0, n_docs, 500):
+        lines = []
+        for doc_id, source in docs[start:start + 500]:
+            lines.append(json.dumps({"index": {"_id": doc_id}}))
+            lines.append(json.dumps(source))
+        bulk_chunks.append("\n".join(lines))
+    bodies = []
+    for _ in range(n_q):
+        picked = rng.choice(300, size=2, replace=False)
+        lo = float(rng.random() * 0.4)
+        bodies.append(
+            json.dumps(
+                {
+                    "query": {
+                        "bool": {
+                            "must": [
+                                {
+                                    "match": {
+                                        "body": " ".join(
+                                            vocab[t] for t in picked
+                                        )
+                                    }
+                                }
+                            ],
+                            "filter": [
+                                {
+                                    "range": {
+                                        "rank": {"gte": lo, "lte": lo + 0.5}
+                                    }
+                                }
+                            ],
+                        }
+                    },
+                    "size": K,
+                }
+            )
+        )
+    corpus_s = time.monotonic() - t0
+    index_body = json.dumps(
+        {
+            "settings": {
+                "index": {"number_of_shards": 1, "number_of_replicas": 1}
+            },
+            "mappings": {
+                "properties": {
+                    "body": {"type": "text"},
+                    "rank": {"type": "float"},
+                }
+            },
+        }
+    )
+
+    def run(server):
+        """Ingest + warm + measure one topology; returns
+        (p50_ms, n_queries, hits, ingest_s)."""
+        try:
+            status, resp = server.dispatch("PUT", "/sock", {}, index_body)
+            assert status == 200, resp
+            t1 = time.monotonic()
+            for chunk in bulk_chunks:
+                status, resp = server.dispatch(
+                    "POST", "/sock/_bulk", {}, chunk
+                )
+                assert status == 200 and not resp["errors"], resp
+            server.dispatch("POST", "/sock/_refresh", {}, "")
+            ingest_s = time.monotonic() - t1
+            for body in bodies:  # warm: compiles + cache admissions
+                for _ in range(2):
+                    status, resp = server.dispatch(
+                        "POST", "/sock/_search", {}, body
+                    )
+                    assert status == 200, resp
+            times = []
+            hits = []
+            deadline = time.monotonic() + duration_s
+            qi = 0
+            while time.monotonic() < deadline:
+                body = bodies[qi % n_q]
+                t1 = time.monotonic()
+                status, resp = server.dispatch(
+                    "POST", "/sock/_search", {}, body
+                )
+                times.append(time.monotonic() - t1)
+                assert status == 200, resp
+                assert resp["_shards"]["failed"] == 0, resp["_shards"]
+                if qi < n_q:
+                    hits.append(
+                        [
+                            (h["_id"], h["_score"])
+                            for h in resp["hits"]["hits"]
+                        ]
+                    )
+                qi += 1
+            # Per-hop split: every hop's windowed p50 as the traffic
+            # left it (shard-side series live on the data nodes — in
+            # proc mode node.metrics_text() federates them over _ctl).
+            def window_p50(name, **labels):
+                w = server.node.metrics.window(name, **labels)
+                return round(w.stat("p50"), 3) if w is not None else None
+
+            shard_p50 = {}
+            pat = re_mod.compile(
+                r'^estpu_shard_exec_latency_recent_ms\{([^}]*)\}\s+'
+                r"([0-9.eE+-]+)$"
+            )
+            for line in server.node.metrics_text().splitlines():
+                m = pat.match(line)
+                if m and 'stat="p50"' in m.group(1):
+                    nm = re_mod.search(r'node="([^"]*)"', m.group(1))
+                    shard_p50[nm.group(1) if nm else "?"] = round(
+                        float(m.group(2)), 3
+                    )
+            split = {
+                "http_p50_ms": window_p50(
+                    "estpu_rest_latency_recent_ms", endpoint="search"
+                ),
+                "gateway_p50_ms": window_p50(
+                    "estpu_gateway_latency_recent_ms", op="search"
+                ),
+                "shard_p50_ms_by_node": shard_p50,
+            }
+            return float(np.median(times)) * 1e3, len(times), hits, (
+                ingest_s, split
+            )
+        finally:
+            server.close()
+
+    t0 = time.monotonic()
+    inproc_p50, inproc_n, inproc_hits, (inproc_ingest_s, inproc_split) = (
+        run(
+            RestServer(
+                replication_nodes=2,
+                cluster_data_path=tempfile.mkdtemp(prefix="estpu-b14-hub-"),
+            )
+        )
+    )
+    inproc_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    socket_p50, socket_n, socket_hits, (socket_ingest_s, socket_split) = (
+        run(
+            RestServer(
+                proc_nodes=2,
+                cluster_data_path=tempfile.mkdtemp(prefix="estpu-b14-sock-"),
+            )
+        )
+    )
+    socket_s = time.monotonic() - t0
+
+    mismatches = sum(
+        1 for got, want in zip(socket_hits, inproc_hits) if got != want
+    )
+    # Gate: two real socket hops + two process schedulings per request —
+    # 3x the in-process p50 plus a 3 ms floor (sub-ms in-process p50s
+    # would otherwise gate on scheduler jitter, the cfg11 floor idiom).
+    wire_tax_ok = socket_p50 <= inproc_p50 * 3.0 + 3.0
+    return {
+        "mismatches": mismatches,
+        "inproc_p50_ms": round(inproc_p50, 3),
+        "socket_p50_ms": round(socket_p50, 3),
+        "p50_ratio_socket_over_inproc": (
+            round(socket_p50 / inproc_p50, 3) if inproc_p50 else 0.0
+        ),
+        "wire_tax_ok": wire_tax_ok,
+        "inproc_hop_split": inproc_split,
+        "socket_hop_split": socket_split,
+        "inproc_ingest_s": round(inproc_ingest_s, 2),
+        "socket_ingest_s": round(socket_ingest_s, 2),
+        "queries_inproc": inproc_n,
+        "queries_socket": socket_n,
+        "n_docs": n_docs,
+        "n_queries": n_q,
+        "corpus_build_s": round(corpus_s, 1),
+        "inproc_phase_s": round(inproc_s, 1),
+        "socket_phase_s": round(socket_s, 1),
+        # Scope note: one machine, loopback sockets — the wire tax here
+        # is serialization + kernel + scheduling, not network distance;
+        # multi-host DCN is the named residue on ROADMAP item 1.
+        "path": "loopback-sockets",
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -2796,6 +3028,7 @@ def main():
         ("cfg11_obs_scrape", bench_cfg11_obs_scrape),
         ("cfg12_device_obs", bench_cfg12_device_obs),
         ("cfg13_health", bench_cfg13_health),
+        ("cfg14_socket", bench_cfg14_socket),
     ):
         # Device-obs accounting per config (ISSUE 14): bracket every
         # config with a process census + HBM window so each emits its

@@ -7,10 +7,20 @@ from .cluster import (
     ShardSearchFailedError,
     StalePrimaryTermError,
 )
-from .gateway import ReplicationGateway, ReplicationUnavailableError
+from .gateway import (
+    ProcGateway,
+    ReplicationGateway,
+    ReplicationUnavailableError,
+)
+from .procs import ProcCluster
 from .response_collector import ResponseCollectorService
 from .state import ClusterState, IndexMeta, ShardRouting
-from .tcp_transport import TcpTransport, TcpTransportHub
+from .tcp_transport import (
+    StaticAddressBook,
+    TcpTransport,
+    TcpTransportHub,
+    handshake_token,
+)
 from .transport import (
     ConnectTransportError,
     RemoteActionError,
@@ -26,6 +36,8 @@ __all__ = [
     "LocalCluster",
     "NoShardAvailableError",
     "NotMasterError",
+    "ProcCluster",
+    "ProcGateway",
     "RemoteActionError",
     "ReplicationFailedError",
     "ReplicationGateway",
@@ -34,8 +46,10 @@ __all__ = [
     "ShardRouting",
     "ShardSearchFailedError",
     "StalePrimaryTermError",
+    "StaticAddressBook",
     "TcpTransport",
     "TcpTransportHub",
     "TransportHub",
     "TransportIntercepts",
+    "handshake_token",
 ]

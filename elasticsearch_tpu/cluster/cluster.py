@@ -46,6 +46,13 @@ from .response_collector import ResponseCollectorService
 from .state import ClusterState, IndexMeta, ShardRouting
 from .transport import ConnectTransportError, RemoteActionError, TransportHub
 
+# How long a node trusts its last contact with the master before a
+# non-member client request forces an active master ping (the minority-
+# side stale-serving guard: a node cut off from the master must refuse
+# to serve possibly-stale data to external clients instead of answering
+# from a state the majority may have moved past).
+MASTER_LEASE_S = float(os.environ.get("ESTPU_MASTER_LEASE_S", "1.0") or 1.0)
+
 
 class NoShardAvailableError(Exception):
     pass
@@ -91,6 +98,10 @@ class ClusterNode:
         )
         self._voting_only = tuple(voting_only)
         self.current_term = 0  # highest term voted for / seen
+        # Monotonic time of the last proof the master can reach us (its
+        # ping or an accepted publication) — the master lease the client-
+        # entry stale-serving guard checks.
+        self._master_contact = 0.0
         # Durable cluster-state directory (the reference's gateway/
         # PersistedClusterStateService): every accepted publication and
         # vote persists {current_term, state} so a full-cluster restart
@@ -271,6 +282,8 @@ class ClusterNode:
             return fn(from_id, payload)
 
     def _on_ping(self, from_id: str, payload: dict):
+        if from_id == self.state.master:
+            self._master_contact = time.monotonic()
         return {
             "node": self.node_id,
             "term": self.current_term,
@@ -309,6 +322,8 @@ class ClusterNode:
             self.state = new
             self._apply_assignments()
             self._save_state()
+            # An accepted publication is proof of a live master quorum.
+            self._master_contact = time.monotonic()
             return {"accepted": True}
 
     # ------------------------------------------------- assignment handling
@@ -801,6 +816,7 @@ class ClusterNode:
         from ..search.service import SearchRequest, SearchService
 
         engine = self.engines[(payload["index"], payload["shard"])]
+        shard_t0 = time.monotonic()
         with self.lock:
             self._inflight_searches += 1
             queue = self._inflight_searches - 1
@@ -850,6 +866,15 @@ class ClusterNode:
         finally:
             with self.lock:
                 self._inflight_searches -= 1
+            # Shard-hop term of the http -> gateway -> shard latency
+            # split (bench cfg14_socket): time spent executing on the
+            # shard owner, excluding every wire/queue cost above it.
+            self.metrics.windowed_histogram(
+                "estpu_shard_exec_latency_recent_ms",
+                "Per-shard search execution latency over the trailing "
+                "window, ms (the shard-side term of the per-hop split)",
+                node=self.node_id,
+            ).record((time.monotonic() - shard_t0) * 1e3)
         return {
             "total": total,
             "max_score": max_score,
@@ -1230,7 +1255,41 @@ class ClusterNode:
     # client channels play in the reference). Each simply enters the same
     # coordinating paths a local caller uses.
 
+    def _ensure_master_lease(self) -> None:
+        """Client-entry stale-serving guard: a node answering an EXTERNAL
+        client must hold a recent proof that the elected master can reach
+        it — otherwise it may be the minority side of a partition serving
+        a state the majority has moved past (promoted primaries, failed
+        copies). Recent contact (the master's ping round or an accepted
+        publication within MASTER_LEASE_S) serves immediately; a stale
+        lease forces one active master ping; an unreachable master
+        REFUSES with NotMasterError (retryable at the gateway, an honest
+        503 at REST — the reference's no-master block, not a stale 200).
+        Cluster-internal paths (replication fan-out, peer recovery) are
+        deliberately unguarded: their safety comes from primary terms and
+        in-sync quorums, not from this lease."""
+        master = self.state.master
+        if master is None:
+            raise NotMasterError(
+                f"[{self.node_id}] has no elected master; refusing a "
+                f"possibly-stale serve"
+            )
+        if master == self.node_id:
+            return
+        if time.monotonic() - self._master_contact < MASTER_LEASE_S:
+            return
+        try:
+            self.hub.send(self.node_id, master, "ping", {})
+        except (ConnectTransportError, RemoteActionError) as e:
+            raise NotMasterError(
+                f"[{self.node_id}] cannot reach master [{master}] "
+                f"({e}); refusing a possibly-stale serve (minority side "
+                f"of a partition)"
+            ) from e
+        self._master_contact = time.monotonic()
+
     def _on_client_write(self, from_id: str, payload: dict):
+        self._ensure_master_lease()
         return self.execute_write(
             payload["index"],
             payload["id"],
@@ -1242,6 +1301,7 @@ class ClusterNode:
         )
 
     def _on_client_search(self, from_id: str, payload: dict):
+        self._ensure_master_lease()
         return self.search(
             payload["index"],
             payload["body"],
@@ -1249,6 +1309,7 @@ class ClusterNode:
         )
 
     def _on_client_read(self, from_id: str, payload: dict):
+        self._ensure_master_lease()
         return self.read_doc(payload["index"], payload["id"])
 
     def _on_client_state(self, from_id: str, payload: dict):
@@ -1263,12 +1324,77 @@ class ClusterNode:
 
     def _on_client_create_index(self, from_id: str, payload: dict):
         """Create-index from a non-member client: route to the master."""
+        return self._route_to_master(from_id, "create_index", payload)
+
+    def _on_client_put_mappings(self, from_id: str, payload: dict):
+        return self._route_to_master(from_id, "put_mappings", payload)
+
+    def _on_client_delete_index(self, from_id: str, payload: dict):
+        return self._route_to_master(from_id, "delete_index", payload)
+
+    def _route_to_master(self, from_id: str, action: str, payload: dict):
+        """Master-scoped admin op from a non-member client: execute
+        locally when this node IS the master, else one wire hop to it."""
         master = self.state.master
         if master is None:
             raise NotMasterError("no elected master")
         if master == self.node_id:
-            return self._on_create_index(from_id, payload)
-        return self.hub.send(self.node_id, master, "create_index", payload)
+            return getattr(self, f"_on_{action}")(from_id, payload)
+        return self.hub.send(self.node_id, master, action, payload)
+
+    def _on_refresh_index(self, from_id: str, payload: dict):
+        """Refresh this node's local engines for one index (the per-node
+        leg of the broadcast refresh a non-member client fans out)."""
+        index = payload["index"]
+        refreshed = 0
+        with self.lock:
+            engines = dict(self.engines)
+        for (idx, _shard), engine in engines.items():
+            if idx == index:
+                engine.refresh()
+                refreshed += 1
+        return {"node": self.node_id, "refreshed": refreshed}
+
+    def _on_shard_docs(self, from_id: str, payload: dict):
+        """Primary-side doc count of one local shard copy."""
+        engine = self.engines.get((payload["index"], payload["shard"]))
+        if engine is None:
+            raise NoShardAvailableError(
+                f"[{payload['index']}][{payload['shard']}] not allocated "
+                f"on [{self.node_id}]"
+            )
+        return {"count": int(engine.num_docs)}
+
+    def num_docs(self, index: str) -> int:
+        """Coordinating primary-side doc count across shards: each
+        shard's primary answers over the wire (the over-socket form of
+        the gateway's in-process engine walk; cat/stats APIs)."""
+        meta = self.state.indices.get(index)
+        if meta is None:
+            return 0
+        total = 0
+        for shard_id, routing in meta.shards.items():
+            if routing.primary is None:
+                continue
+            if routing.primary == self.node_id:
+                engine = self.engines.get((index, shard_id))
+                if engine is not None:
+                    total += int(engine.num_docs)
+                continue
+            try:
+                resp = self.hub.send(
+                    self.node_id,
+                    routing.primary,
+                    "shard_docs",
+                    {"index": index, "shard": shard_id},
+                )
+                total += int(resp.get("count", 0))
+            except (ConnectTransportError, RemoteActionError):
+                continue  # dead primary: the count is honestly partial
+        return total
+
+    def _on_client_num_docs(self, from_id: str, payload: dict):
+        return self.num_docs(payload["index"])
 
     # -------------------------------------------- cluster-scope observability
 

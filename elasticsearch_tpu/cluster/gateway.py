@@ -167,49 +167,68 @@ class ReplicationGateway:
         timeout_s = self.timeout_s if timeout_s is None else timeout_s
         deadline = time.monotonic() + timeout_s
         attempt = 0
+        op_class = op_name.split(":", 1)[0]
+        started = time.monotonic()
+        latency = self.metrics.windowed_histogram(
+            "estpu_gateway_latency_recent_ms",
+            "Per-op gateway latency (retries + backoff included) over the "
+            "trailing window, ms — the middle term of the http -> gateway "
+            "-> shard per-hop split",
+            op=op_class,
+        )
         with TRACER.span(
             f"gateway.{op_name.split(':', 1)[0]}", op=op_name
         ) as span:
-            while True:
+            try:
+                return self._run_attempts(
+                    op_name, fn, span, deadline, timeout_s, attempt
+                )
+            finally:
+                latency.record((time.monotonic() - started) * 1e3)
+
+    def _run_attempts(
+        self, op_name: str, fn, span, deadline, timeout_s, attempt
+    ):
+        while True:
+            try:
                 try:
-                    try:
-                        node = self.coordinator()
-                    except RuntimeError as e:  # every node dead: no retry
-                        self._count("unavailable")
-                        raise ReplicationUnavailableError(str(e)) from e
-                    result = fn(node)
-                    if span is not None and attempt:
-                        span.tags["retries"] = attempt
-                    return result
-                # staticcheck: ignore[broad-except] classification handler: the _retryable() whitelist re-raises everything else (incl. TaskCancelledError) on the next line
-                except Exception as e:
-                    if not self._retryable(e):
-                        raise
-                    attempt += 1
-                    self._count("retries")
-                    if (
-                        attempt > self.max_retries
-                        or time.monotonic() >= deadline
-                    ):
-                        self._count("unavailable")
-                        raise ReplicationUnavailableError(
-                            f"[{op_name}] failed after {attempt} attempts "
-                            f"within {timeout_s}s: {e}"
-                        ) from e
-                    try:
-                        # Failure detection + election + promotion +
-                        # healing: why the NEXT attempt can succeed.
-                        self.cluster.step()
-                    # staticcheck: ignore[broad-except] best-effort control-plane nudge between retries; a failure here only delays the next attempt
-                    except Exception:
-                        pass
-                    delay = min(
-                        self.backoff_base_s * (2 ** (attempt - 1)),
-                        self.backoff_max_s,
-                        max(0.0, deadline - time.monotonic()),
-                    )
-                    if delay > 0:
-                        time.sleep(delay)
+                    node = self.coordinator()
+                except RuntimeError as e:  # every node dead: no retry
+                    self._count("unavailable")
+                    raise ReplicationUnavailableError(str(e)) from e
+                result = fn(node)
+                if span is not None and attempt:
+                    span.tags["retries"] = attempt
+                return result
+            # staticcheck: ignore[broad-except] classification handler: the _retryable() whitelist re-raises everything else (incl. TaskCancelledError) on the next line
+            except Exception as e:
+                if not self._retryable(e):
+                    raise
+                attempt += 1
+                self._count("retries")
+                if (
+                    attempt > self.max_retries
+                    or time.monotonic() >= deadline
+                ):
+                    self._count("unavailable")
+                    raise ReplicationUnavailableError(
+                        f"[{op_name}] failed after {attempt} attempts "
+                        f"within {timeout_s}s: {e}"
+                    ) from e
+                try:
+                    # Failure detection + election + promotion +
+                    # healing: why the NEXT attempt can succeed.
+                    self.cluster.step()
+                # staticcheck: ignore[broad-except] best-effort control-plane nudge between retries; a failure here only delays the next attempt
+                except Exception:
+                    pass
+                delay = min(
+                    self.backoff_base_s * (2 ** (attempt - 1)),
+                    self.backoff_max_s,
+                    max(0.0, deadline - time.monotonic()),
+                )
+                if delay > 0:
+                    time.sleep(delay)
 
     # ------------------------------------------------------------- client
 
@@ -414,3 +433,158 @@ class ReplicationGateway:
 
     def close(self) -> None:
         self.cluster.close()
+
+
+class ProcGateway(ReplicationGateway):
+    """The socketed gateway mode: ReplicationGateway's retry/backoff/
+    failover semantics with a multi-process ProcCluster behind it — the
+    topology where every shard-level hop crosses a real TCP connection.
+
+    The coordinating node is the supervisor-resident voting-only
+    tiebreaker: `write`/`read`/`search` (inherited) call its
+    `execute_write`/`read_doc`/`search`, which scatter to shard-owner
+    processes over cluster/tcp_transport.py sockets with per-send
+    deadlines — a dead peer is a timed retryable failure feeding the
+    retry loop (and, exhausted, a 503 at REST), never a hang. Between
+    attempts `_run` drives `ProcCluster.step()`: one synchronous
+    tiebreaker control round, so promotion happens even mid-request.
+    Master-scoped admin ops route to the elected master over the wire
+    (`client_*`-shaped entries); in-process reaches of the parent
+    (engine walks for refresh/num_docs, `cluster.nodes` attribute
+    access) are overridden with wire equivalents."""
+
+    def __init__(
+        self,
+        procs,
+        timeout_s: float = 10.0,
+        max_retries: int = 8,
+        backoff_base_s: float = 0.02,
+        backoff_max_s: float = 0.5,
+    ):
+        if getattr(procs, "_local_node", None) is None:
+            raise ValueError(
+                "ProcGateway needs a ProcCluster with the supervisor-"
+                "resident tiebreaker (tiebreaker=True) as its "
+                "coordinating node"
+            )
+        # The parent __init__ clamps hub.default_timeout_s (the
+        # tiebreaker transport here) and builds the counters; the
+        # `cluster` attribute IS the ProcCluster — every LocalCluster
+        # surface the inherited paths touch (hub / step() / nodes /
+        # step_errors()) exists on it.
+        super().__init__(
+            procs,
+            preferred_node=None,
+            timeout_s=timeout_s,
+            max_retries=max_retries,
+            backoff_base_s=backoff_base_s,
+            backoff_max_s=backoff_max_s,
+        )
+        self.procs = procs
+        # The control endpoint must honor the same per-request budget.
+        ctl = getattr(procs, "_ctl", None)
+        if ctl is not None and getattr(ctl, "default_timeout_s", 0) > 0:
+            ctl.default_timeout_s = min(ctl.default_timeout_s, timeout_s)
+
+    def coordinator(self) -> ClusterNode:
+        return self.procs._local_node
+
+    def _master_id(self) -> str:
+        master = self.coordinator().state.master
+        if master is None:
+            raise NotMasterError("no elected master")
+        return master
+
+    def _admin(self, op_name: str, action: str, payload: dict) -> dict:
+        """Master-scoped admin op over the wire: executed on the
+        tiebreaker when it holds mastership, else one hop to the elected
+        master — inside the inherited retry loop, so an election in
+        flight is a retry, not an error."""
+
+        def fn(node: ClusterNode) -> dict:
+            return getattr(node, f"_on_client_{action}")(
+                "proc-gateway", payload
+            )
+
+        return self._run(op_name, fn)
+
+    def create_index(
+        self,
+        name: str,
+        n_shards: int = 1,
+        n_replicas: int = 1,
+        mappings: dict | None = None,
+        timeout_s: float | None = None,
+    ) -> dict:
+        return self._admin(
+            f"create_index:{name}",
+            "create_index",
+            {
+                "name": name,
+                "n_shards": n_shards,
+                "n_replicas": n_replicas,
+                "mappings": mappings or {},
+            },
+        )
+
+    def put_mappings(
+        self, name: str, mappings: dict, timeout_s: float | None = None
+    ) -> dict:
+        return self._admin(
+            f"put_mappings:{name}",
+            "put_mappings",
+            {"name": name, "mappings": mappings},
+        )
+
+    def delete_index(self, name: str, timeout_s: float | None = None) -> dict:
+        return self._admin(
+            f"delete_index:{name}", "delete_index", {"name": name}
+        )
+
+    def refresh(self, index: str) -> None:
+        """Broadcast refresh over the wire: every worker refreshes its
+        local engines for the index (dead workers skipped — their copies
+        are failing out of the routing table anyway)."""
+        self.procs._fan("refresh_index", {"index": index})
+
+    def num_docs(self, index: str) -> int:
+        """Primary-side doc count across shards, each primary answering
+        over its socket."""
+        try:
+            return int(
+                self._run(
+                    f"num_docs:{index}",
+                    lambda node: node.num_docs(index),
+                )
+            )
+        except ReplicationUnavailableError:
+            return 0
+
+    def stats(self) -> dict:
+        counters = {
+            key: int(c.value) for key, c in list(self._counters.items())
+        }
+        tb = self.coordinator()
+        resilience = tb.search_resilience_stats()
+        collectors = {}
+        snapshot = resilience.pop("response_collector", None)
+        if snapshot:
+            collectors[tb.node_id] = snapshot
+        return {
+            **counters,
+            "nodes": sorted(self.procs.seeds),
+            "alive_nodes": sorted(
+                node_id
+                for node_id in self.procs.workers
+                if self.procs.pid(node_id) is not None
+            )
+            + [tb.node_id],
+            "master": tb.state.master,
+            "search_resilience": resilience,
+            "adaptive_replica_selection": collectors,
+            "step_errors": self.procs.step_errors(),
+            "transport": self.procs.hub.stats(),
+        }
+
+    def close(self) -> None:
+        self.procs.close()

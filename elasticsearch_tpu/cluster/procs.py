@@ -92,14 +92,32 @@ def _worker_main(cfg: dict) -> None:
 
         jax.distributed.initialize(**dist)
     from .cluster import ClusterNode
-    from .tcp_transport import FileAddressBook, TcpTransport
+    from .tcp_transport import (
+        FileAddressBook,
+        StaticAddressBook,
+        TcpTransport,
+    )
 
-    book = FileAddressBook(cfg["addr_dir"])
+    seed_addrs = cfg.get("seed_addrs")
+    host, port = "127.0.0.1", 0
+    if seed_addrs:
+        # Multi-host form: peers resolve from the pre-agreed static map
+        # (no shared addr directory), and this worker must bind exactly
+        # the address the map promised for it.
+        book = StaticAddressBook(seed_addrs)
+        own = book.lookup(cfg["node_id"])
+        if own is not None:
+            host, port = own
+    else:
+        book = FileAddressBook(cfg["addr_dir"])
     transport = TcpTransport(
         cfg["node_id"],
         book,
         cluster_name=cfg["cluster_name"],
         default_timeout_s=cfg.get("send_timeout_s"),
+        host=host,
+        port=port,
+        auth_key=cfg.get("auth_key"),
     )
     node = ClusterNode(
         cfg["node_id"],
@@ -122,6 +140,12 @@ def _worker_main(cfg: dict) -> None:
         return node._handle(from_id, action, payload)
 
     transport.register(cfg["node_id"], handler)
+
+    # Graceful stop: SIGTERM means "finish what you are doing, then
+    # leave" — the rolling-restart signal, distinct from kill -9's
+    # no-goodbye death. The handler only flips the stop event; the
+    # drain/flush/close sequence below runs on the main thread.
+    signal.signal(signal.SIGTERM, lambda _s, _f: stop.set())
     parent = os.getppid()
     interval = float(cfg.get("step_interval_s", 0.05))
     while not stop.wait(interval):
@@ -135,6 +159,20 @@ def _worker_main(cfg: dict) -> None:
         # staticcheck: ignore[broad-except] daemon control-plane stepper: must survive any transient step error and retry next tick — every swallowed error is COUNTED (estpu_cluster_step_errors_total), never silent
         except Exception:
             node._step_errors.inc()
+    # Drain before teardown: in-flight requests (a search mid-scatter, a
+    # replica op mid-apply) finish and answer instead of dying as resets,
+    # then every engine flushes segments + commit point so the restarted
+    # process replays only the translog tail. A failed drain/flush must
+    # never block exit — shutdown terminates, honestly degraded.
+    try:
+        transport.drain(timeout_s=float(cfg.get("drain_timeout_s", 5.0)))
+        with node.lock:
+            engines = list(node.engines.values())
+        for engine in engines:
+            engine.flush()
+    # staticcheck: ignore[broad-except] shutdown path: a wedged drain or a flush error (disk full, injected transport.drain fault) must not keep a SIGTERM'd process alive
+    except Exception:
+        pass
     node.close()
     transport.close()
 
@@ -154,10 +192,17 @@ class ProcCluster:
         step_interval_s: float = 0.05,
         send_timeout_s: float | None = 5.0,
         boot_timeout_s: float = 90.0,
+        seed_addrs: dict[str, str] | None = None,
+        auth_key: str | None = None,
+        drain_timeout_s: float = 5.0,
     ):
         import tempfile
 
-        from .tcp_transport import FileAddressBook, TcpTransport
+        from .tcp_transport import (
+            FileAddressBook,
+            StaticAddressBook,
+            TcpTransport,
+        )
 
         self.data_path = data_path or tempfile.mkdtemp(prefix="estpu-procs-")
         self.addr_dir = os.path.join(self.data_path, "_addr")
@@ -167,6 +212,15 @@ class ProcCluster:
         self.step_interval_s = step_interval_s
         self.send_timeout_s = send_timeout_s
         self.boot_timeout_s = boot_timeout_s
+        self.drain_timeout_s = drain_timeout_s
+        # Shared-key wire authn rides the worker cfg (NOT just the env:
+        # a spawned worker must authenticate even when the supervisor got
+        # the key programmatically). None falls back to ESTPU_TRANSPORT_KEY.
+        self.auth_key = auth_key
+        # Multi-host form: explicit node -> "host:port" seeds replace the
+        # shared-filesystem address directory (discovery is configuration,
+        # like the reference's discovery.seed_hosts).
+        self.seed_addrs = dict(seed_addrs) if seed_addrs else None
         self.workers = tuple(f"node-{i}" for i in range(n_workers))
         self.voting_only = (TIEBREAKER_ID,) if tiebreaker else ()
         self.seeds = self.workers + self.voting_only
@@ -174,12 +228,21 @@ class ProcCluster:
         self._procs: dict[str, Any] = {}
         self._lock = threading.Lock()
         self._intercept_state: dict = {}
-        self._metrics_cache: tuple[float, str] | None = None
+        self._metrics_cache: tuple[float, list] | None = None
         # Lazily-built health report service (obs/health.py): holds the
         # re-election/step-error history between report rounds.
         self._health = None
         self._closed = False
-        self._book = FileAddressBook(self.addr_dir)
+        if self.seed_addrs:
+            missing = [n for n in self.seeds if n not in self.seed_addrs]
+            if missing:
+                raise ValueError(
+                    f"seed_addrs must name every cluster member; "
+                    f"missing {missing}"
+                )
+            self._book = StaticAddressBook(self.seed_addrs)
+        else:
+            self._book = FileAddressBook(self.addr_dir)
         # Dedicated control endpoint: its intercepts stay EMPTY forever,
         # so partition/heal broadcasts always reach every worker even
         # when the cluster's own channels are partitioned.
@@ -188,21 +251,31 @@ class ProcCluster:
             self._book,
             cluster_name=cluster_name,
             default_timeout_s=send_timeout_s,
+            auth_key=auth_key,
         )
         self._ctl.start()
         for node_id in self.workers:
             self._spawn(node_id)
         self._local_node = None
+        self._tb_transport = None
         self._stepper: threading.Thread | None = None
         self._stop = threading.Event()
         if tiebreaker:
             from .cluster import ClusterNode
 
+            tb_host, tb_port = "127.0.0.1", 0
+            if self.seed_addrs:
+                tb_addr = self._book.lookup(TIEBREAKER_ID)
+                if tb_addr is not None:
+                    tb_host, tb_port = tb_addr
             self._tb_transport = TcpTransport(
                 TIEBREAKER_ID,
                 self._book,
                 cluster_name=cluster_name,
                 default_timeout_s=send_timeout_s,
+                host=tb_host,
+                port=tb_port,
+                auth_key=auth_key,
             )
             self._local_node = ClusterNode(
                 TIEBREAKER_ID,
@@ -228,6 +301,9 @@ class ProcCluster:
             "jax_distributed": self.jax_distributed.get(node_id),
             "step_interval_s": self.step_interval_s,
             "send_timeout_s": self.send_timeout_s,
+            "seed_addrs": self.seed_addrs,
+            "auth_key": self.auth_key,
+            "drain_timeout_s": self.drain_timeout_s,
         }
         proc = self._ctx.Process(
             target=_worker_main, args=(cfg,), name=f"estpu-{node_id}"
@@ -292,6 +368,24 @@ class ProcCluster:
         os.kill(proc.pid, signal.SIGKILL)
         proc.join(timeout=10)
 
+    def sigterm(self, node_id: str, timeout_s: float = 20.0) -> None:
+        """Graceful stop (the rolling-restart signal): SIGTERM, then wait
+        for the worker's drain → translog/segment flush → close sequence
+        to finish. Escalates to SIGKILL past the deadline — shutdown must
+        terminate even when the drain wedges."""
+        with self._lock:
+            proc = self._procs.get(node_id)
+        if proc is None or proc.pid is None:
+            return
+        try:
+            os.kill(proc.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            return
+        proc.join(timeout=timeout_s)
+        if proc.is_alive():
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.join(timeout=5)
+
     def restart(self, node_id: str) -> None:
         """Fresh process for the node id: boots from its persisted
         cluster state, rejoins, re-acquires copies via peer recovery."""
@@ -347,9 +441,28 @@ class ProcCluster:
         state["drops"] = []
         self._broadcast_intercepts(state)
 
-    def set_delay(self, seconds: float) -> None:
+    def set_delay(
+        self, seconds: float, from_id: str = "*", to_id: str = "*"
+    ) -> None:
+        """Injected latency, broadcast to every node's sender-side
+        intercepts. The all-pairs default keeps the historical global
+        knob; the targeted form (``set_delay(2.0, to_id="node-1")``)
+        models ONE browned-out peer: every send toward it crawls while
+        healthy paths stay fast. ``set_delay(0)`` clears everything."""
         state = dict(self._intercept_state or {})
-        state["delay_s"] = float(seconds)
+        if from_id == "*" and to_id == "*":
+            state["delay_s"] = float(seconds)
+            if not seconds:
+                state["delays"] = []
+        else:
+            delays = [
+                d
+                for d in state.get("delays", [])
+                if (d[0], d[1]) != (from_id, to_id)
+            ]
+            if seconds:
+                delays.append([from_id, to_id, float(seconds)])
+            state["delays"] = delays
         self._broadcast_intercepts(state)
 
     # ------------------------------------------------------------- client
@@ -481,6 +594,69 @@ class ProcCluster:
             "_ctl", node_id, "client_state", {}, timeout_s=timeout_s
         )
 
+    # --------------------------------------------- gateway-facing surface
+    # The LocalCluster shape a ProcGateway / front Node expects: `hub`
+    # (the coordinating transport), `nodes` (member ids), `step()` (one
+    # synchronous control-plane round), `step_errors()`.
+
+    @property
+    def hub(self):
+        """The coordinating endpoint cluster-facing code sends through:
+        the tiebreaker's transport — INTERCEPTED like any member's, so a
+        front Node's serving path honestly feels partitions/brownouts —
+        or the control endpoint when no tiebreaker exists."""
+        return self._tb_transport if self._tb_transport is not None else self._ctl
+
+    @property
+    def nodes(self) -> tuple[str, ...]:
+        """Cluster member ids (sorted/len/iteration surface; the actual
+        members live in other OS processes)."""
+        return self.seeds
+
+    def step(self) -> None:
+        """One synchronous control-plane round on the supervisor-resident
+        tiebreaker — the gateway's between-retries nudge (election /
+        health round / recovery check). Worker processes run their own
+        steppers; without a tiebreaker this is a no-op and detection is
+        entirely theirs."""
+        node = self._local_node
+        if node is None:
+            return
+        node.try_elect()
+        if node.is_master():
+            node.health_round()
+        node.check_recoveries()
+
+    def step_errors(self) -> int:
+        node = self._local_node
+        return 0 if node is None else int(node._step_errors.value)
+
+    def wait_for_status(
+        self, wanted: str = "green", timeout_s: float = 60.0
+    ) -> None:
+        """Block until the shard summary over the tiebreaker's published
+        state reaches `wanted` AND every worker is back in the
+        membership — the heal barrier the chaos arcs use (`GET
+        /_cluster/health?wait_for_status=green` over the REST front polls
+        the same summary)."""
+        from ..obs.health import shard_summary, status_at_least
+
+        node = self._local_node
+        if node is None:
+            raise ProcClusterUnavailableError(
+                "wait_for_status needs the supervisor-resident tiebreaker"
+            )
+
+        def ok() -> bool:
+            state = node.state
+            if not set(self.workers) <= set(state.nodes):
+                return False
+            return status_at_least(shard_summary(state)["status"], wanted)
+
+        self.wait_for(
+            ok, timeout_s=timeout_s, what=f"cluster status {wanted}"
+        )
+
     # ------------------------------------------- cluster-scope observability
 
     def _fan(
@@ -509,12 +685,13 @@ class ProcCluster:
             metrics=self._ctl.metrics,
         )
 
-    def nodes_stats(self) -> dict:
+    def nodes_stats(self, extra: dict[str, dict] | None = None) -> dict:
         """`GET /_nodes/stats` over the process cluster: the `node_stats`
         wire action fanned to every worker plus the supervisor-resident
         tiebreaker, with a `_nodes: {total, successful, failed}` header —
         a kill -9'd worker shows up as a named failure entry within the
-        per-send deadline, never a hang."""
+        per-send deadline, never a hang. `extra` grafts additional
+        sections (the REST front's own node) into the payload."""
         results, failures = self._fan("node_stats")
         nodes: dict[str, dict] = {}
         if self._local_node is not None:
@@ -522,10 +699,12 @@ class ProcCluster:
         for node_id in self.workers:
             if node_id in results:
                 nodes[node_id] = results[node_id]
-        tb = 1 if self._local_node is not None else 0
+        for name, section in (extra or {}).items():
+            nodes[name] = section
+        local = (1 if self._local_node is not None else 0) + len(extra or {})
         header: dict[str, Any] = {
-            "total": len(self.workers) + tb,
-            "successful": len(results) + tb,
+            "total": len(self.workers) + local,
+            "successful": len(results) + local,
             "failed": len(failures),
         }
         if failures:
@@ -540,6 +719,7 @@ class ProcCluster:
         self,
         verbose: bool = True,
         indicator: str | None = None,
+        extra_inputs: dict[str, dict] | None = None,
     ) -> dict:
         """`GET /_health_report` over the process cluster: the
         `health_inputs` wire action fanned to every worker over the
@@ -569,6 +749,8 @@ class ProcCluster:
             for node_id in self.workers:
                 if node_id in results:
                     node_inputs[node_id] = results[node_id]
+        for name, inputs in (extra_inputs or {}).items():
+            node_inputs.setdefault(name, inputs)
         if state is None:
             # No tiebreaker: adopt an answering worker's published state
             # for the shard/master rules — in BOTH modes (a terse probe
@@ -610,14 +792,20 @@ class ProcCluster:
             ctx, verbose=verbose, indicator=indicator
         )
 
-    def metrics_text(self, max_age_s: float | None = None) -> str:
+    def metrics_text(
+        self,
+        max_age_s: float | None = None,
+        extra_snapshots: tuple = (),
+    ) -> str:
         """Federated `GET /_metrics`: every live worker's registry ships
         over the `metrics_wire` action and re-exposes here with a
         `node=<id>` label per series; counters additionally fold into
-        `node="_cluster"` totals. Scrapes cache for ESTPU_METRICS_FED_TTL_S
-        (default 0.5s) so a scrape storm cannot multiply worker fan-outs;
-        the fan itself is deadline-bounded and runs only at scrape time —
-        never on the serving hot path."""
+        `node="_cluster"` totals. The worker fan caches for
+        ESTPU_METRICS_FED_TTL_S (default 0.5s) so a scrape storm cannot
+        multiply fan-outs; the fan itself is deadline-bounded and runs
+        only at scrape time — never on the serving hot path.
+        `extra_snapshots` (WireRegistrySnapshot, e.g. the REST front's
+        own registry) join the exposition and the cluster fold uncached."""
         from ..analysis.analyzers import ANALYSIS_METRICS
         from ..obs.metrics import WireRegistrySnapshot, fold_cluster_counters
 
@@ -629,29 +817,30 @@ class ProcCluster:
         with self._lock:
             cached = self._metrics_cache
         if cached is not None and now - cached[0] <= max_age_s:
-            return cached[1]
-        results, _failures = self._fan("metrics_wire")
-        snapshots = [
-            WireRegistrySnapshot(
-                (results[node_id] or {}).get("families"), node=node_id
-            )
-            for node_id in sorted(results)
-        ]
-        if self._local_node is not None:
-            snapshots.append(
+            snapshots = cached[1]
+        else:
+            results, _failures = self._fan("metrics_wire")
+            snapshots = [
                 WireRegistrySnapshot(
-                    self._local_node.metrics.to_wire(
-                        self._tb_transport.metrics
-                    ),
-                    node=TIEBREAKER_ID,
+                    (results[node_id] or {}).get("families"), node=node_id
                 )
-            )
-        text = self._ctl.metrics.exposition(
-            ANALYSIS_METRICS, *snapshots, fold_cluster_counters(snapshots)
+                for node_id in sorted(results)
+            ]
+            if self._local_node is not None:
+                snapshots.append(
+                    WireRegistrySnapshot(
+                        self._local_node.metrics.to_wire(
+                            self._tb_transport.metrics
+                        ),
+                        node=TIEBREAKER_ID,
+                    )
+                )
+            with self._lock:
+                self._metrics_cache = (time.monotonic(), snapshots)
+        merged = list(snapshots) + list(extra_snapshots)
+        return self._ctl.metrics.exposition(
+            ANALYSIS_METRICS, *merged, fold_cluster_counters(merged)
         )
-        with self._lock:
-            self._metrics_cache = (time.monotonic(), text)
-        return text
 
     def hot_threads(
         self,
@@ -772,24 +961,32 @@ class ProcCluster:
             return
         self._closed = True
         self._stop.set()
-        for node_id in self.workers:
+        try:
+            for node_id in self.workers:
+                try:
+                    self._ctl.send(
+                        "_ctl", node_id, "_shutdown", {}, timeout_s=2.0
+                    )
+                except (ConnectTransportError, RemoteActionError):
+                    pass  # already dead
+            with self._lock:
+                procs = dict(self._procs)
+            deadline = time.monotonic() + 10.0
+            for node_id, proc in procs.items():
+                proc.join(timeout=max(0.1, deadline - time.monotonic()))
+                if proc.is_alive():
+                    os.kill(proc.pid, signal.SIGKILL)
+                    proc.join(timeout=5)
+            if self._stepper is not None:
+                self._stepper.join(timeout=2)
+        finally:
+            # Child reaping must NEVER leak the supervisor's sockets: the
+            # tiebreaker endpoint and the `_ctl` listener close even when
+            # a join/kill above throws (a leaked `_ctl` listener holds
+            # its port and fd for the supervisor's lifetime).
             try:
-                self._ctl.send(
-                    "_ctl", node_id, "_shutdown", {}, timeout_s=2.0
-                )
-            except (ConnectTransportError, RemoteActionError):
-                pass  # already dead
-        with self._lock:
-            procs = dict(self._procs)
-        deadline = time.monotonic() + 10.0
-        for node_id, proc in procs.items():
-            proc.join(timeout=max(0.1, deadline - time.monotonic()))
-            if proc.is_alive():
-                os.kill(proc.pid, signal.SIGKILL)
-                proc.join(timeout=5)
-        if self._stepper is not None:
-            self._stepper.join(timeout=2)
-        if self._local_node is not None:
-            self._local_node.close()
-            self._tb_transport.close()
-        self._ctl.close()
+                if self._local_node is not None:
+                    self._local_node.close()
+                    self._tb_transport.close()
+            finally:
+                self._ctl.close()

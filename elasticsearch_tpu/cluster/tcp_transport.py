@@ -55,6 +55,8 @@ cataloged in obs/metrics.py.
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import json
 import os
 import socket
@@ -82,6 +84,21 @@ MAX_FRAME_BYTES = 64 * 1024 * 1024
 CONNECT_TIMEOUT_S = 5.0
 # Idle pooled connections kept per peer; extras close on check-in.
 POOL_SIZE = 4
+# Shared-key wire authn (the minimal security-transport analog): when the
+# key is set, every handshake carries an HMAC token over the claimed
+# identity and the server verifies it with a constant-time compare. A
+# missing or mismatched token is a handshake-reject — the same observable
+# (counter + windowed event + `transport` health indicator input) as a
+# wrong-cluster peer. TLS on the wire is a named residue (ROADMAP).
+TRANSPORT_KEY_ENV = "ESTPU_TRANSPORT_KEY"
+
+
+def handshake_token(key: str, cluster: str, version: int, node: str) -> str:
+    """HMAC-SHA256 over the handshake's claimed identity. Binding the
+    token to (cluster, version, node) means a captured token only ever
+    authenticates the same claim it was minted for."""
+    msg = f"{cluster}|{version}|{node}".encode("utf-8")
+    return hmac.new(key.encode("utf-8"), msg, hashlib.sha256).hexdigest()
 
 
 # ------------------------------------------------------------------ frames
@@ -210,6 +227,43 @@ class FileAddressBook:
             pass
 
 
+class StaticAddressBook:
+    """Pre-agreed node -> host:port seeds — the multi-host production
+    form (the reference's `discovery.seed_hosts`): no shared filesystem
+    and no inherited fds; every process resolves peers from the same
+    static map, so the topology can span hosts. Publication is
+    configuration: a node must bind the address the map promised for it
+    (enforced at publish time), and a dead node's address stays resolvable
+    — dials get connection-refused and the bounded reconnect surfaces
+    ConnectTransportError, exactly like a stale FileAddressBook entry."""
+
+    def __init__(self, addrs: dict[str, Any]):
+        self._addrs: dict[str, tuple[str, int]] = {}
+        for node_id, addr in addrs.items():
+            if isinstance(addr, str):
+                host, _, port = addr.rpartition(":")
+                addr = (host, int(port))
+            self._addrs[node_id] = (str(addr[0]), int(addr[1]))
+
+    def publish(self, node_id: str, addr: tuple[str, int]) -> None:
+        expected = self._addrs.get(node_id)
+        if expected is None:
+            # An endpoint outside the map (e.g. a send-only control
+            # endpoint) simply cannot be dialed by peers — not an error.
+            return
+        if (str(addr[0]), int(addr[1])) != expected:
+            raise ValueError(
+                f"[{node_id}] bound {addr[0]}:{addr[1]} but the static "
+                f"address book promised {expected[0]}:{expected[1]}"
+            )
+
+    def lookup(self, node_id: str) -> tuple[str, int] | None:
+        return self._addrs.get(node_id)
+
+    def forget(self, node_id: str) -> None:
+        pass  # static config: nothing to retract
+
+
 # --------------------------------------------------------------- endpoint
 
 
@@ -247,10 +301,17 @@ class TcpTransport:
         connect_attempts: int = 3,
         connect_backoff_s: float = 0.02,
         host: str = "127.0.0.1",
+        port: int = 0,
+        auth_key: str | None = None,
     ):
         self.node_id = node_id
         self.book = book
         self.cluster_name = cluster_name
+        # None means "resolve from the environment"; pass "" to force
+        # authn off regardless of ESTPU_TRANSPORT_KEY.
+        if auth_key is None:
+            auth_key = os.environ.get(TRANSPORT_KEY_ENV, "")
+        self.auth_key = auth_key or None
         self.intercepts = (
             TransportIntercepts() if intercepts is None else intercepts
         )
@@ -263,6 +324,7 @@ class TcpTransport:
         self.connect_attempts = max(1, int(connect_attempts))
         self.connect_backoff_s = connect_backoff_s
         self._host = host
+        self._port = int(port)
         self._handler: Callable[[str, str, dict], Any] | None = None
         self._server: socket.socket | None = None
         self.address: tuple[str, int] | None = None
@@ -272,6 +334,10 @@ class TcpTransport:
         self._accept_thread: threading.Thread | None = None
         self._closed = False
         self._req_id = 0
+        # In-flight inbound requests (handler currently executing) — the
+        # graceful-drain barrier SIGTERM waits on before closing sockets.
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
         self._c_connections = self.metrics.counter(
             "estpu_transport_connections_total",
             "Outbound TCP transport connections established (post-handshake)",
@@ -330,13 +396,47 @@ class TcpTransport:
             )
             for event in ("reconnect", "handshake_reject", "send_timeout")
         }
+        # Per-PEER windowed timeout twins, created lazily on first expiry
+        # against that peer: the health `transport` indicator uses these
+        # to NAME the slow/dead peer (brownout diagnosis), which the
+        # per-sender window above cannot do.
+        self._peer_timeout_windows: dict[str, Any] = {}
+        self._c_drains = self.metrics.counter(
+            "estpu_transport_drains_total",
+            "Graceful-drain barriers entered (SIGTERM shutdown path)",
+            node=node_id,
+        )
 
     def _note_event(self, event: str) -> None:
         self._recent_events[event].inc()
 
-    def _note_timeout(self) -> None:
+    def _note_timeout(self, peer: str | None = None) -> None:
         self._c_timeouts.inc()
         self._note_event("send_timeout")
+        if peer is not None:
+            with self._lock:
+                window = self._peer_timeout_windows.get(peer)
+                if window is None:
+                    window = self.metrics.windowed_counter(
+                        "estpu_transport_peer_events_recent",
+                        "Per-peer transport events over the trailing window",
+                        event="send_timeout",
+                        node=self.node_id,
+                        peer=peer,
+                    )
+                    self._peer_timeout_windows[peer] = window
+            window.inc()
+
+    def peer_timeouts_recent(self) -> dict[str, int]:
+        """{peer: send timeouts over the trailing window} — who, exactly,
+        is not answering this node within the per-send deadline."""
+        with self._lock:
+            windows = dict(self._peer_timeout_windows)
+        return {
+            peer: count
+            for peer, window in sorted(windows.items())
+            if (count := int(window.count()))
+        }
 
     def recent_events(self) -> dict[str, int]:
         """{event: count} over the trailing window — the per-node
@@ -355,7 +455,7 @@ class TcpTransport:
             return self.address
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        srv.bind((self._host, 0))
+        srv.bind((self._host, self._port))
         srv.listen(128)
         self._server = srv
         self.address = srv.getsockname()
@@ -450,9 +550,38 @@ class TcpTransport:
                 for d in ("sent", "received")
             },
             "open_connections": int(self._open_connections()),
+            "drains": int(
+                m.value(
+                    "estpu_transport_drains_total", node=self.node_id
+                )
+            ),
             # Trailing-window event counts (health `transport` input).
             "recent_events": self.recent_events(),
+            # Per-peer deadline expiries over the trailing window: the
+            # slow-peer attribution the brownout diagnosis names.
+            "peer_send_timeouts_recent": self.peer_timeouts_recent(),
         }
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Graceful-shutdown barrier: block until every in-flight inbound
+        request has finished executing (its response may still be on the
+        wire) or the timeout lapses. SIGTERM runs this BEFORE tearing
+        sockets down so an in-flight search or replicated write completes
+        instead of dying as a reset mid-handler. Returns False when
+        stragglers outlived the window — the caller proceeds to close
+        anyway (shutdown must terminate), but honestly."""
+        self._c_drains.inc()
+        # Named chaos hook: an injected fault here models a drain that
+        # wedges/aborts, which the shutdown path must survive.
+        fault_point("transport.drain", node=self.node_id)
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._inflight_cond:
+            while self._inflight:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._inflight_cond.wait(left)
+        return True
 
     def close(self, abrupt: bool = False) -> None:
         """Tear the endpoint down. `abrupt=True` is process death: every
@@ -525,26 +654,50 @@ class TcpTransport:
             conn.settimeout(30.0)  # handshake must arrive promptly
             hello, _ = read_frame(conn)
             hs = hello.get("_handshake")
+            # Named chaos hook: an armed fault here aborts the handshake
+            # exchange (connection storms / flaky accept paths), which
+            # the dialer observes as a reset before any request frame.
+            fault_point(
+                "transport.handshake",
+                node=self.node_id,
+                peer=str((hs or {}).get("node", "?")) if isinstance(hs, dict) else "?",
+            )
+            reject = None
             if (
                 not isinstance(hs, dict)
                 or hs.get("cluster") != self.cluster_name
                 or hs.get("version") != PROTOCOL_VERSION
             ):
+                reject = (
+                    f"[{self.node_id}] refused handshake: got "
+                    f"cluster [{(hs or {}).get('cluster')}] "
+                    f"version [{(hs or {}).get('version')}], this "
+                    f"node is [{self.cluster_name}]/"
+                    f"[{PROTOCOL_VERSION}]"
+                )
+            elif self.auth_key is not None and not hmac.compare_digest(
+                handshake_token(
+                    self.auth_key,
+                    str(hs.get("cluster")),
+                    int(hs.get("version")),
+                    str(hs.get("node", "?")),
+                ),
+                str(hs.get("auth", "")),
+            ):
+                # Deliberately the SAME observable as a wrong-cluster
+                # peer: reject counter + windowed event, which the
+                # `transport` health indicator already surfaces. The
+                # error text never echoes key material.
+                reject = (
+                    f"[{self.node_id}] refused handshake from "
+                    f"[{hs.get('node', '?')}]: bad or missing transport "
+                    f"auth token (shared-key HMAC mismatch)"
+                )
+            if reject is not None:
                 self._c_handshake_rejects.inc()
                 self._note_event("handshake_reject")
                 self._write(
-                    conn,
-                    {
-                        "ok": False,
-                        "kind": "handshake",
-                        "error": (
-                            f"[{self.node_id}] refused handshake: got "
-                            f"cluster [{(hs or {}).get('cluster')}] "
-                            f"version [{(hs or {}).get('version')}], this "
-                            f"node is [{self.cluster_name}]/"
-                            f"[{PROTOCOL_VERSION}]"
-                        ),
-                    },
+                    conn, {"ok": False, "kind": "handshake", "error": reject}
                 )
                 return
             peer = str(hs.get("node", "?"))
@@ -569,7 +722,15 @@ class TcpTransport:
                     node=self.node_id,
                     action=req.get("action", "?"),
                 )
-                self._write(conn, self._serve_one(peer, req))
+                with self._inflight_cond:
+                    self._inflight += 1
+                try:
+                    resp = self._serve_one(peer, req)
+                finally:
+                    with self._inflight_cond:
+                        self._inflight -= 1
+                        self._inflight_cond.notify_all()
+                self._write(conn, resp)
         except _PeerClosed:
             pass  # pool churn or peer death; nothing to answer
         except (OSError, ConnectTransportError, ValueError):
@@ -672,7 +833,7 @@ class TcpTransport:
             # interception/deadline semantics cannot diverge per transport.
             self.intercepts.preflight(
                 from_id, to_id, action, deadline, timeout_s,
-                on_timeout=self._note_timeout,
+                on_timeout=lambda: self._note_timeout(to_id),
             )
             # Transport-agnostic site (chaos schedules written against the
             # hub replay here unchanged), then the TCP-specific one.
@@ -705,7 +866,7 @@ class TcpTransport:
             return None
         left = deadline - time.monotonic()
         if left <= 0:
-            self._note_timeout()
+            self._note_timeout(to_id)
             raise ConnectTransportError(
                 f"[{action}] to [{to_id}] timed out (deadline exhausted)"
             )
@@ -726,7 +887,7 @@ class TcpTransport:
                 resp, nbytes = read_frame(conn)
             except socket.timeout:
                 self._discard(conn)
-                self._note_timeout()
+                self._note_timeout(to_id)
                 raise ConnectTransportError(
                     f"[{action}] to [{to_id}] timed out after {timeout_s}s "
                     f"(no response)"
@@ -844,15 +1005,19 @@ class TcpTransport:
                     sock.settimeout(
                         self._remaining(deadline, action, to_id)
                     )
-                    hello = encode_frame(
-                        {
-                            "_handshake": {
-                                "cluster": self.cluster_name,
-                                "version": PROTOCOL_VERSION,
-                                "node": self.node_id,
-                            }
-                        }
-                    )
+                    hs: dict[str, Any] = {
+                        "cluster": self.cluster_name,
+                        "version": PROTOCOL_VERSION,
+                        "node": self.node_id,
+                    }
+                    if self.auth_key is not None:
+                        hs["auth"] = handshake_token(
+                            self.auth_key,
+                            self.cluster_name,
+                            PROTOCOL_VERSION,
+                            self.node_id,
+                        )
+                    hello = encode_frame({"_handshake": hs})
                     sock.sendall(hello)
                     resp, _ = read_frame(sock)
                     if not resp.get("ok"):
@@ -897,10 +1062,12 @@ class TcpTransportHub(InterceptsDelegate):
         self,
         cluster_name: str = "estpu-local",
         default_timeout_s: float | None = None,
+        auth_key: str | None = None,
     ):
         from ..obs.metrics import MetricsRegistry
 
         self.cluster_name = cluster_name
+        self.auth_key = auth_key
         self.metrics = MetricsRegistry()
         self.intercepts = TransportIntercepts()
         self.book = InMemoryAddressBook()
@@ -922,6 +1089,7 @@ class TcpTransportHub(InterceptsDelegate):
             intercepts=self.intercepts,
             metrics=self.metrics,
             default_timeout_s=self.default_timeout_s,
+            auth_key=self.auth_key,
         )
         endpoint.register(node_id, handler)  # binds + publishes
         with self._lock:

@@ -67,6 +67,7 @@ class TransportIntercepts:
         self._partitions: list[set[str]] = []  # disjoint reachability groups
         self._disconnected: set[frozenset] = set()  # unordered pairs
         self._dropped_actions: list[tuple[str, str, str]] = []  # from,to,pat
+        self._delays: list[tuple[str, str, float]] = []  # from,to,seconds
         self.delay_s = 0.0
 
     def disconnect(self, a: str, b: str) -> None:
@@ -95,8 +96,38 @@ class TransportIntercepts:
         with self._lock:
             self._dropped_actions = []
 
-    def set_delay(self, seconds: float) -> None:
-        self.delay_s = seconds
+    def set_delay(
+        self, seconds: float, from_id: str = "*", to_id: str = "*"
+    ) -> None:
+        """Add latency to matching sends ('*' wildcards ids). The default
+        all-pairs form keeps the historical global knob; a targeted form
+        (e.g. ``set_delay(0.5, to_id="node-1")``) models ONE slow/wedged
+        peer — the brownout shape — without touching healthy paths.
+        ``set_delay(0)`` with wildcards clears everything; ``seconds=0``
+        on a targeted pair clears just that pair's rules."""
+        with self._lock:
+            if from_id == "*" and to_id == "*":
+                self.delay_s = seconds
+                if not seconds:
+                    self._delays = []
+                return
+            self._delays = [
+                (f, t, s)
+                for f, t, s in self._delays
+                if (f, t) != (from_id, to_id)
+            ]
+            if seconds:
+                self._delays.append((from_id, to_id, float(seconds)))
+
+    def delay_for(self, from_id: str, to_id: str) -> float:
+        """Effective injected latency for one send: the global delay or
+        the largest matching targeted rule, whichever is worse."""
+        with self._lock:
+            delay = self.delay_s
+            for f, t, s in self._delays:
+                if fnmatch.fnmatch(from_id, f) and fnmatch.fnmatch(to_id, t):
+                    delay = max(delay, s)
+            return delay
 
     def reachable(self, a: str, b: str) -> bool:
         with self._lock:
@@ -142,7 +173,7 @@ class TransportIntercepts:
             raise ConnectTransportError(
                 f"[{action}] {from_id}->{to_id} dropped by interceptor"
             )
-        delay = self.delay_s
+        delay = self.delay_for(from_id, to_id)
         if delay:
             if deadline is not None and time.monotonic() + delay > deadline:
                 # The injected latency alone blows the budget: honor the
@@ -163,6 +194,7 @@ class TransportIntercepts:
                 "partitions": [sorted(g) for g in self._partitions],
                 "disconnected": [sorted(p) for p in self._disconnected],
                 "drops": [list(d) for d in self._dropped_actions],
+                "delays": [list(d) for d in self._delays],
                 "delay_s": self.delay_s,
             }
 
@@ -176,6 +208,9 @@ class TransportIntercepts:
             }
             self._dropped_actions = [
                 (d[0], d[1], d[2]) for d in data.get("drops", [])
+            ]
+            self._delays = [
+                (d[0], d[1], float(d[2])) for d in data.get("delays", [])
             ]
             self.delay_s = float(data.get("delay_s", 0.0))
 
@@ -205,8 +240,10 @@ class InterceptsDelegate:
     def clear_drops(self) -> None:
         self.intercepts.clear_drops()
 
-    def set_delay(self, seconds: float) -> None:
-        self.intercepts.set_delay(seconds)
+    def set_delay(
+        self, seconds: float, from_id: str = "*", to_id: str = "*"
+    ) -> None:
+        self.intercepts.set_delay(seconds, from_id, to_id)
 
 
 class TransportHub(InterceptsDelegate):

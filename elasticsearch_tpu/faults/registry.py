@@ -28,6 +28,15 @@ Sites currently threaded (fnmatch patterns match against these names):
                                 sender-side frame drops,
                                 `transport.tcp.frame` receiver-side
                                 connection teardown mid-exchange
+    transport.handshake         server-side connection handshake
+                                (cluster/tcp_transport.py): evaluated
+                                after the hello frame is read, before
+                                the cluster/version/auth checks — arm it
+                                to chaos-test rejected joins
+    transport.drain             graceful-shutdown drain barrier
+                                (cluster/tcp_transport.py): evaluated as
+                                the drain begins — arm a delay to rehearse
+                                a slow drain racing the SIGTERM timeout
     breaker.reserve             HBM breaker reservation (common/breaker.py)
 
 Configuration is per-site: error rate, error class (internal | transport |
@@ -70,6 +79,8 @@ SITES = (
     "batcher.launch",
     "transport.send.*",
     "transport.tcp.*",
+    "transport.handshake",
+    "transport.drain",
     "breaker.reserve",
 )
 

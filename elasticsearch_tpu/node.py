@@ -3597,6 +3597,16 @@ class Node:
 
     # -------------------------------------------------------- observability
 
+    @property
+    def _procs(self):
+        """The ProcCluster behind a socketed gateway (ProcGateway), or
+        None for standalone / in-process-LocalCluster fronts. The procs
+        obs fans run over the never-intercepted `_ctl` socket path, so
+        the front delegates to them instead of `_cluster_fan`: a
+        partitioned data plane must still be OBSERVABLE (the report
+        names the unreachable members; the scrape doesn't go dark)."""
+        return getattr(self.replication, "procs", None)
+
     def _cluster_fan(
         self,
         action: str,
@@ -3658,6 +3668,20 @@ class Node:
         if self.replication is None:
             sample_local()
             return local_box["text"]
+        if self._procs is not None:
+            # Front block first, then the procs fan (tiebreaker +
+            # workers, each sampling its OWN interpreter).
+            sample_local()
+            return "\n".join(
+                [
+                    local_box["text"],
+                    self._procs.hot_threads(
+                        threads=threads,
+                        interval_s=interval_s,
+                        snapshots=snapshots,
+                    ),
+                ]
+            )
         # The local sample runs CONCURRENTLY with the fan (each remote
         # handler samples for the same interval) so the request costs
         # one interval of wall clock, not two.
@@ -3705,6 +3729,16 @@ class Node:
         covers the whole cluster (one track per node)."""
         from .obs.tracing import chrome_trace, collect_fragments
 
+        if self._procs is not None:
+            out = self._procs.trace(trace_id, fmt=fmt)
+            if out is None:
+                raise ApiError(
+                    404,
+                    "resource_not_found_exception",
+                    f"trace [{trace_id}] is not buffered (ring keeps the "
+                    f"last {TRACER.max_traces} traces)",
+                )
+            return out
         header = None
         results: dict = {}
         if self.replication is not None:
@@ -3791,6 +3825,18 @@ class Node:
         from .analysis.analyzers import ANALYSIS_METRICS
         from .obs.metrics import WireRegistrySnapshot, fold_cluster_counters
 
+        if self._procs is not None:
+            # The procs federation (worker fan over `_ctl`, TTL-cached)
+            # plus this front's own registry as one more labeled
+            # snapshot — the gateway's counters already live here via
+            # bind_metrics.
+            return self._procs.metrics_text(
+                extra_snapshots=(
+                    WireRegistrySnapshot(
+                        self.metrics.to_wire(), node=self.node_name
+                    ),
+                )
+            )
         others: list = [ANALYSIS_METRICS]
         if self.replication is not None:
             gw_metrics = getattr(self.replication, "metrics", None)
@@ -3928,6 +3974,14 @@ class Node:
                 "illegal_argument_exception",
                 f"unknown health indicator [{indicator}]; expected one "
                 f"of {list(INDICATORS)}",
+            )
+        if self._procs is not None:
+            return self._procs.health_report(
+                verbose=verbose,
+                indicator=indicator,
+                extra_inputs={
+                    self.node_name: self._health_inputs_local()
+                },
             )
         node_inputs = {self.node_name: self._health_inputs_local()}
         failures: list[dict] = []
@@ -4306,6 +4360,10 @@ class Node:
         multi-process ProcCluster paths ship the SAME per-node payload
         (ClusterNode.node_stats_local), so the response shape is one
         across transports."""
+        if self._procs is not None:
+            return self._procs.nodes_stats(
+                extra={self.node_name: self._local_node_stats()}
+            )
         header: dict[str, Any] = {
             "total": 1,
             "successful": 1,

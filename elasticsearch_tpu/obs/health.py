@@ -802,11 +802,45 @@ def indicator_transport(ctx: HealthContext) -> dict[str, Any]:
             ),
             "recent_events": recent,
         }
+        peer_timeouts = {
+            str(peer): int(count)
+            for peer, count in (
+                transport.get("peer_send_timeouts_recent") or {}
+            ).items()
+            if int(count)
+        }
+        if peer_timeouts:
+            node_detail["peer_send_timeouts_recent"] = peer_timeouts
         details["nodes"][node_id] = node_detail
         timeouts = int(recent.get("send_timeout", 0) or 0)
         rejects = int(recent.get("handshake_reject", 0) or 0)
         reconnects = int(recent.get("reconnect", 0) or 0)
-        if timeouts:
+        if peer_timeouts:
+            # Per-peer attribution (the brownout diagnosis): the windowed
+            # per-peer twins say WHO is not answering within the per-send
+            # deadline, not just that someone isn't.
+            status = worst([status, "yellow"])
+            for peer, count in sorted(peer_timeouts.items()):
+                symptoms.append(
+                    f"peer [{peer}] timed out {count} send(s) from "
+                    f"[{node_id}] in the trailing window"
+                )
+                diagnosis.append(
+                    {
+                        "cause": (
+                            f"sends from [{node_id}] to peer [{peer}] "
+                            f"exceeded the per-send deadline {count} "
+                            f"time(s) in the trailing window — [{peer}] "
+                            "is slow, wedged, or partitioned (brownout)"
+                        ),
+                        "action": (
+                            f"check the process serving [{peer}] and its "
+                            "network path; adaptive replica selection "
+                            "routes reads around it in the meantime"
+                        ),
+                    }
+                )
+        elif timeouts:
             status = worst([status, "yellow"])
             symptoms.append(
                 f"{timeouts} send timeout(s) at [{node_id}] in the "
@@ -886,6 +920,45 @@ def indicator_transport(ctx: HealthContext) -> dict[str, Any]:
                 )
     if mesh:
         details["mesh_breakers"] = mesh
+    # Membership view (the partition diagnosis): an expected member the
+    # elected master has dropped from the published state is unreachable
+    # from the majority — name it. Guarded on an elected master so a
+    # cluster still bootstrapping (empty membership, no master) reports
+    # through master_stability instead of a spurious wire diagnosis.
+    if ctx.state is not None and ctx.expected_nodes:
+        members = set(getattr(ctx.state, "nodes", ()) or ())
+        master = getattr(ctx.state, "master", None)
+        missing = [
+            n
+            for n in ctx.expected_nodes
+            if n not in members and n != master
+        ]
+        if missing and master is not None and members:
+            status = worst([status, "yellow"])
+            details["unreachable_members"] = missing
+            for node_id in missing:
+                symptoms.append(
+                    f"expected member [{node_id}] is not in the "
+                    "published cluster state"
+                )
+                diagnosis.append(
+                    {
+                        "cause": (
+                            f"expected member [{node_id}] is missing "
+                            f"from the state published by master "
+                            f"[{master}] (term "
+                            f"{getattr(ctx.state, 'term', '?')}): the "
+                            "master cannot reach it — it is dead or on "
+                            "the minority side of a partition"
+                        ),
+                        "action": (
+                            f"check the process serving [{node_id}] and "
+                            "the network between it and the master; "
+                            "heal the partition (or restart it) and "
+                            "wait for status green"
+                        ),
+                    }
+                )
     if (
         ctx.fanned
         and ctx.expected_nodes

@@ -845,6 +845,9 @@ CATALOG = {
     "estpu_transport_frames_total": ("counter", "replication.transport"),
     "estpu_transport_frame_bytes_total": ("counter", "replication.transport"),
     "estpu_transport_open_connections": ("gauge", "replication.transport"),
+    # Graceful-shutdown drain barriers entered (cluster/tcp_transport.py
+    # drain(): SIGTERM'd workers waiting out their in-flight requests).
+    "estpu_transport_drains_total": ("counter", "replication.transport"),
     # Cluster-scope observability fan-in (cluster/transport.scatter_nodes
     # + the node_stats / metrics_wire / trace_fragment / hot_threads wire
     # actions): scatter rounds by action, named per-node failures,
@@ -876,6 +879,27 @@ CATALOG = {
     "estpu_transport_events_recent": (
         "windowed_counter",
         "replication.transport",
+    ),
+    # Per-peer attribution of the trailing window's send timeouts
+    # (cluster/tcp_transport.py): the transport health indicator reads
+    # these to NAME the slow/wedged peer in a brownout diagnosis.
+    "estpu_transport_peer_events_recent": (
+        "windowed_counter",
+        "replication.transport",
+    ),
+    # Whole-gateway-op latency (retries + backoff included) by op class
+    # (cluster/gateway.py): the middle term of the bench's per-hop
+    # http -> gateway -> shard split over the socketed topology.
+    "estpu_gateway_latency_recent_ms": (
+        "windowed_histogram",
+        "replication.gateway",
+    ),
+    # Shard-side search execution latency (cluster/cluster.py,
+    # _on_shard_search): the innermost term of the per-hop split — what
+    # the shard owner spent executing, net of every wire/queue cost.
+    "estpu_shard_exec_latency_recent_ms": (
+        "windowed_histogram",
+        "replication.search",
     ),
     # Health report (obs/health.py, GET /_health_report): report rounds
     # and the last-computed status per indicator (0 green / 1 yellow /

@@ -239,6 +239,8 @@ class RestServer:
         replication_nodes: int = 0,
         cluster_data_path: str | None = None,
         cluster_transport: str | None = None,
+        proc_nodes: int = 0,
+        transport_key: str | None = None,
     ):
         """A REST front. With `replication_nodes >= 2` (or the
         ESTPU_REPLICATION_NODES env var) the server boots an in-process
@@ -248,23 +250,63 @@ class RestServer:
         background stepper keeps failure detection and promotion live
         under traffic. `cluster_transport` picks the node-to-node wire:
         "hub" (in-memory, default) or "tcp" (real loopback sockets);
-        defaults from ESTPU_CLUSTER_TRANSPORT."""
+        defaults from ESTPU_CLUSTER_TRANSPORT.
+
+        With `proc_nodes >= 2` (or ESTPU_PROC_NODES) the server instead
+        boots the SOCKETED topology: this process is the HTTP front +
+        voting-only tiebreaker, and every data node is a separate OS
+        process reached over cluster/tcp_transport.py — the one-machine
+        rehearsal of the production layout. Document APIs route through
+        ProcGateway (the replication gateway's retry/backoff/failover
+        semantics over real sockets, per-send deadlines: a dead peer is
+        a timed 503, never a hang); observability endpoints fan over the
+        never-intercepted `_ctl` control path. `transport_key` (or
+        ESTPU_TRANSPORT_KEY) arms shared-key HMAC handshake authn on
+        every node-to-node connection."""
         if node is None and replication_nodes == 0:
             replication_nodes = int(
                 os.environ.get("ESTPU_REPLICATION_NODES", "0") or 0
             )
-        if node is not None and replication_nodes:
-            raise ValueError(
-                "replication_nodes cannot be combined with an existing "
-                "node; construct the Node with replication= instead"
+        if node is None and proc_nodes == 0:
+            proc_nodes = int(
+                os.environ.get("ESTPU_PROC_NODES", "0") or 0
             )
-        if replication_nodes == 1:
+        if node is not None and (replication_nodes or proc_nodes):
             raise ValueError(
-                "replication requires at least 2 nodes (replication_nodes"
-                f"={replication_nodes} would serve unreplicated)"
+                "replication_nodes/proc_nodes cannot be combined with an "
+                "existing node; construct the Node with replication= "
+                "instead"
+            )
+        if replication_nodes and proc_nodes:
+            raise ValueError(
+                "replication_nodes (in-process) and proc_nodes (socketed"
+                " multi-process) are mutually exclusive topologies"
+            )
+        if replication_nodes == 1 or proc_nodes == 1:
+            raise ValueError(
+                "replication requires at least 2 nodes "
+                f"(replication_nodes={replication_nodes} proc_nodes="
+                f"{proc_nodes} would serve unreplicated)"
             )
         self.cluster = None
-        if node is None and replication_nodes >= 2:
+        if node is None and proc_nodes >= 2:
+            from ..cluster import ProcCluster, ProcGateway
+
+            self.cluster = ProcCluster(
+                proc_nodes,
+                data_path=cluster_data_path,
+                auth_key=transport_key,
+            )
+            # The front's name must NOT collide with a data node's
+            # ("node-0"): the nodes_stats/health merge rules would graft
+            # front-local sections onto a worker's entry.
+            node = Node(
+                node_name="front",
+                cluster_name=self.cluster.cluster_name,
+                data_path=data_path,
+                replication=ProcGateway(self.cluster),
+            )
+        elif node is None and replication_nodes >= 2:
             from ..cluster import LocalCluster, ReplicationGateway
 
             self.cluster = LocalCluster(
@@ -892,10 +934,15 @@ def create_server(
     port: int = 9200,
     data_path: str | None = None,
     replication_nodes: int = 0,
+    proc_nodes: int = 0,
+    transport_key: str | None = None,
 ):
     """(http_server, rest) pair; call http_server.serve_forever() to run."""
     rest = RestServer(
-        data_path=data_path, replication_nodes=replication_nodes
+        data_path=data_path,
+        replication_nodes=replication_nodes,
+        proc_nodes=proc_nodes,
+        transport_key=transport_key,
     )
     return rest.serve(host, port), rest
 
@@ -918,10 +965,26 @@ def main():
         help="serve through an in-process replication cluster of N nodes "
         "(acknowledged writes reach every in-sync copy; reads fail over)",
     )
+    parser.add_argument(
+        "--proc-nodes",
+        type=int,
+        default=0,
+        help="serve through a SOCKETED multi-process cluster of N data "
+        "node processes (this process is the HTTP front + voting-only "
+        "tiebreaker; every hop crosses a real TCP connection)",
+    )
+    parser.add_argument(
+        "--transport-key",
+        default=None,
+        help="shared-key HMAC handshake authn for node-to-node transport "
+        "connections (defaults to ESTPU_TRANSPORT_KEY)",
+    )
     args = parser.parse_args()
     server, rest = create_server(
         args.host, args.port, args.data_path,
         replication_nodes=args.replication_nodes,
+        proc_nodes=args.proc_nodes,
+        transport_key=args.transport_key,
     )
     print(
         json.dumps(

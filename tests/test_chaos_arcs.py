@@ -1,0 +1,489 @@
+"""Production chaos arcs over the SOCKETED serving topology: an HTTP
+front (REST + voting-only tiebreaker in this process) over a ProcCluster
+of spawned OS worker processes, every shard-level hop a real TCP
+connection (rest/server.py proc mode -> cluster/gateway.ProcGateway ->
+cluster/procs.py).
+
+Three arcs run against ONE booted topology (workers pay a full JAX
+import, so the boot is amortized), each under sustained mixed
+read/write traffic, each asserting recovery against the health report's
+NAMED diagnoses — never raw counter polls, never an unbounded wait:
+
+  1. Rolling restart: SIGTERM-drain + restart every data node in turn;
+     zero acked-write loss, no request ever answers 500.
+  2. Brownout: one slow peer (targeted transport delay > the per-send
+     deadline) flips the transport indicator yellow with a diagnosis
+     naming the peer, while the healthy path keeps serving within
+     budget; healed by clearing the delay and waiting for green.
+  3. Asymmetric partition: the minority side refuses possibly-stale
+     serving (NotMasterError, not silent stale reads), the report goes
+     non-green naming the unreachable member within the per-send
+     deadline, and ONLY heal_partition + wait-for-green closes the arc.
+
+A fourth scenario drives the never-intercepted `_ctl` observability
+path under compound chaos (partition + a kill -9'd worker): the obs
+fans still answer within deadline with named `failures[]` entries.
+"""
+
+import json
+import random
+import tempfile
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster import ProcCluster, ProcGateway
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.server import RestServer
+
+INDEX = "chaos"
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+    }
+}
+
+# Per-send deadline on every node-to-node socket (and the `_ctl` obs
+# fan): the bound the arcs assert against.
+SEND_TIMEOUT_S = 2.0
+# One whole gateway op (retries + backoff included).
+GATEWAY_TIMEOUT_S = 8.0
+# An obs fan is parallel, so one round costs ~one per-send deadline;
+# slack for scheduling under load.
+FAN_BUDGET_S = SEND_TIMEOUT_S + 2.0
+# Healthy-path search latency budget under brownout: BELOW the per-send
+# deadline, so meeting it proves no measured request waited on the
+# browned-out peer.
+HEALTHY_P99_BUDGET_S = 1.5
+
+
+@pytest.fixture(scope="module")
+def topo():
+    procs = ProcCluster(
+        2,
+        data_path=tempfile.mkdtemp(prefix="estpu-chaos-arcs-"),
+        send_timeout_s=SEND_TIMEOUT_S,
+    )
+    node = Node(
+        node_name="front",
+        cluster_name=procs.cluster_name,
+        replication=ProcGateway(procs, timeout_s=GATEWAY_TIMEOUT_S),
+    )
+    rest = RestServer(node=node)
+    status, _ = rest.dispatch(
+        "PUT",
+        f"/{INDEX}",
+        {},
+        json.dumps(
+            {
+                "settings": {
+                    "number_of_shards": 1,
+                    "number_of_replicas": 1,
+                },
+                "mappings": MAPPINGS,
+            }
+        ),
+    )
+    assert status == 200
+    procs.wait_for_status("green", timeout_s=60.0)
+    yield rest, procs
+    if not procs._closed:  # the teardown scenario closes it in-test
+        rest.close()
+
+
+class Traffic:
+    """Sustained mixed read/write traffic through the REST front.
+
+    Every response is classified: 2xx serves, 503 (gateway retries
+    exhausted mid-chaos) and 404/409 (read raced a not-yet-replayed doc
+    / write raced its own retry) are tolerated and counted; anything
+    else — a 500, a hang past the gateway budget — fails the arc."""
+
+    def __init__(self, rest: RestServer, tag: str):
+        self.rest = rest
+        self.tag = tag
+        self.acked: list[str] = []
+        self.statuses: dict[int, int] = {}
+        self.unexpected: list[tuple[int, object]] = []
+        self.latencies: list[float] = []
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        self._seq = 0
+
+    def _record(self, status: int, out, elapsed: float) -> None:
+        with self._lock:
+            self.statuses[status] = self.statuses.get(status, 0) + 1
+            self.latencies.append(elapsed)
+            if status not in (200, 201, 404, 409, 503):
+                self.unexpected.append((status, out))
+
+    def _request(self, method: str, path: str, body: str = "") -> int:
+        t0 = time.monotonic()
+        status, out = self.rest.dispatch(method, path, {}, body)
+        self._record(status, out, time.monotonic() - t0)
+        return status
+
+    def _writer(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                self._seq += 1
+                doc_id = f"{self.tag}-{self._seq}"
+            status = self._request(
+                "PUT",
+                f"/{INDEX}/_doc/{doc_id}",
+                json.dumps(
+                    {"body": f"payload {doc_id}", "tag": self.tag}
+                ),
+            )
+            if status in (200, 201):
+                with self._lock:
+                    self.acked.append(doc_id)
+            time.sleep(0.02)
+
+    def _reader(self) -> None:
+        rng = random.Random(7)
+        while not self._stop.is_set():
+            with self._lock:
+                doc_id = (
+                    rng.choice(self.acked) if self.acked else None
+                )
+            if doc_id is not None:
+                self._request("GET", f"/{INDEX}/_doc/{doc_id}")
+            self._request(
+                "GET",
+                f"/{INDEX}/_search",
+                json.dumps(
+                    {"query": {"match": {"body": "payload"}}, "size": 10}
+                ),
+            )
+            time.sleep(0.02)
+
+    def __enter__(self):
+        self._threads = [
+            threading.Thread(target=self._writer, daemon=True),
+            threading.Thread(target=self._reader, daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2 * GATEWAY_TIMEOUT_S)
+        return False
+
+    def assert_clean(self) -> None:
+        assert not self.unexpected, (
+            f"traffic saw non-(2xx/404/409/503) responses: "
+            f"{self.unexpected[:5]}"
+        )
+        assert self.latencies and max(self.latencies) < (
+            2 * GATEWAY_TIMEOUT_S
+        ), "a request outlived twice the gateway budget (hang?)"
+
+
+def _timed_health_report(rest: RestServer) -> tuple[dict, float]:
+    t0 = time.monotonic()
+    status, report = rest.dispatch("GET", "/_health_report", {}, "")
+    elapsed = time.monotonic() - t0
+    assert status == 200
+    assert elapsed < FAN_BUDGET_S, (
+        f"health report took {elapsed:.2f}s — the fan must answer "
+        f"within the per-send deadline ({SEND_TIMEOUT_S}s + slack)"
+    )
+    return report, elapsed
+
+
+def _until(predicate, timeout_s: float, what: str):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        out = predicate()
+        if out:
+            return out
+        assert time.monotonic() < deadline, f"timed out waiting for {what}"
+        time.sleep(0.1)
+
+
+def _assert_all_acked_readable(rest: RestServer, acked: list[str]) -> None:
+    status, _ = rest.dispatch("POST", f"/{INDEX}/_refresh", {}, "")
+    assert status == 200
+    missing = []
+    for doc_id in acked:
+        status, out = rest.dispatch("GET", f"/{INDEX}/_doc/{doc_id}", {}, "")
+        if status != 200 or not out.get("found"):
+            missing.append(doc_id)
+    assert not missing, (
+        f"{len(missing)}/{len(acked)} ACKED writes lost: {missing[:10]}"
+    )
+
+
+class TestRollingRestart:
+    def test_rolling_restart_zero_acked_write_loss(self, topo):
+        rest, procs = topo
+        with Traffic(rest, "roll") as traffic:
+            for node_id in procs.workers:
+                procs.sigterm(node_id)
+                # The survivor (+ tiebreaker) keeps serving while the
+                # process is down; restart rejoins + re-replicates.
+                procs.restart(node_id)
+                procs.wait_for_status("green", timeout_s=60.0)
+            time.sleep(0.5)
+        traffic.assert_clean()
+        assert traffic.acked, "no write was ever acked during the roll"
+        # THE rolling-restart claim: every write acked across two full
+        # process generations is still readable afterwards.
+        _assert_all_acked_readable(rest, traffic.acked)
+        report, _ = _timed_health_report(rest)
+        assert report["status"] == "green"
+
+
+class TestBrownout:
+    def test_slow_peer_named_and_routed_around(self, topo):
+        rest, procs = topo
+        master = procs._local_node.state.master
+        assert master in procs.workers
+        slow = next(n for n in procs.workers if n != master)
+        with Traffic(rest, "brown") as traffic:
+            # Brown out ONE peer: every send toward it crawls past the
+            # per-send deadline; healthy paths untouched.
+            procs.set_delay(2 * SEND_TIMEOUT_S, to_id=slow)
+            try:
+                # The master's failure detection drops the unresponsive
+                # member and fails its copies out of in-sync — the
+                # membership view of "routed around".
+                _until(
+                    lambda: slow
+                    not in procs._local_node.state.nodes,
+                    timeout_s=30.0,
+                    what=f"master dropping browned-out [{slow}]",
+                )
+
+                # The report names the peer, two ways: the per-peer
+                # send-timeout attribution and the membership view.
+                def _named():
+                    report, _ = _timed_health_report(rest)
+                    transport = report["indicators"]["transport"]
+                    if transport["status"] == "green":
+                        return None
+                    causes = " ".join(
+                        d["cause"] for d in transport["diagnosis"]
+                    )
+                    return report if f"[{slow}]" in causes else None
+
+                report = _until(
+                    _named,
+                    timeout_s=30.0,
+                    what="a transport diagnosis naming the slow peer",
+                )
+                assert report["status"] != "green"
+                details = report["indicators"]["transport"]["details"]
+                assert slow in details.get("unreachable_members", ())
+
+                # Healthy-path latency budget: p99 of searches AFTER the
+                # route-around stays below the per-send deadline — no
+                # measured request waited on the browned-out peer.
+                lat = []
+                for _ in range(30):
+                    t0 = time.monotonic()
+                    status, _out = rest.dispatch(
+                        "GET",
+                        f"/{INDEX}/_search",
+                        {},
+                        json.dumps(
+                            {"query": {"match_all": {}}, "size": 10}
+                        ),
+                    )
+                    lat.append(time.monotonic() - t0)
+                    assert status == 200
+                lat.sort()
+                p99 = lat[int(0.99 * (len(lat) - 1))]
+                assert p99 < HEALTHY_P99_BUDGET_S, (
+                    f"healthy-path search p99 {p99:.3f}s blew the "
+                    f"{HEALTHY_P99_BUDGET_S}s brownout budget"
+                )
+            finally:
+                procs.set_delay(0.0)
+        traffic.assert_clean()
+        # Healed: the cleared delay lets the master re-admit the peer
+        # and re-replicate; green is the arc's exit condition.
+        procs.wait_for_status("green", timeout_s=60.0)
+        _assert_all_acked_readable(rest, traffic.acked)
+
+
+class TestPartition:
+    def test_minority_refuses_majority_serves_heal_to_green(self, topo):
+        rest, procs = topo
+        from elasticsearch_tpu.cluster import RemoteActionError
+
+        minority = procs._local_node.state.master
+        assert minority in procs.workers
+        majority_worker = next(
+            n for n in procs.workers if n != minority
+        )
+        with Traffic(rest, "part") as traffic:
+            # Asymmetric counts: 1 node alone vs worker + tiebreaker.
+            procs.partition(
+                {minority}, {majority_worker, "tiebreaker"}
+            )
+            try:
+                # Majority side elects and keeps serving (the gateway's
+                # coordinator is the tiebreaker — majority side).
+                _until(
+                    lambda: procs._local_node.state.master
+                    == majority_worker,
+                    timeout_s=30.0,
+                    what="majority-side election",
+                )
+
+                # Minority refusal: the old master stepped down on
+                # losing publish quorum, and its client-serving wire
+                # entries refuse possibly-stale serving. The probe rides
+                # the never-intercepted `_ctl` path, so the request
+                # REACHES the minority node — the refusal is the node's
+                # own lease check over its partitioned transport.
+                def _refused():
+                    try:
+                        procs._ctl.send(
+                            "_ctl",
+                            minority,
+                            "client_search",
+                            {
+                                "index": INDEX,
+                                "body": {
+                                    "query": {"match_all": {}},
+                                    "size": 1,
+                                },
+                            },
+                        )
+                        return None
+                    except RemoteActionError as e:
+                        return e if (
+                            e.remote_type == "NotMasterError"
+                        ) else None
+
+                refusal = _until(
+                    _refused,
+                    timeout_s=30.0,
+                    what="minority-side stale-serve refusal",
+                )
+                assert refusal.remote_type == "NotMasterError"
+
+                # Non-green report NAMES the unreachable member, within
+                # the fan deadline.
+                def _named():
+                    report, _ = _timed_health_report(rest)
+                    if report["status"] == "green":
+                        return None
+                    transport = report["indicators"]["transport"]
+                    missing = transport["details"].get(
+                        "unreachable_members", ()
+                    )
+                    return report if minority in missing else None
+
+                _until(
+                    _named,
+                    timeout_s=30.0,
+                    what="a report naming the partitioned member",
+                )
+                # Writes keep acking on the majority side mid-partition.
+                count_before = len(traffic.acked)
+                _until(
+                    lambda: len(traffic.acked) > count_before,
+                    timeout_s=2 * GATEWAY_TIMEOUT_S,
+                    what="an acked write on the majority side",
+                )
+            finally:
+                # THE only heal: drop the partition rules, then green.
+                procs.heal_partition()
+            procs.wait_for_status("green", timeout_s=60.0)
+        traffic.assert_clean()
+        _assert_all_acked_readable(rest, traffic.acked)
+        # Post-heal report: membership and shard math are green again
+        # and no member is named unreachable. (The transport indicator
+        # may honestly stay yellow until the partition's send timeouts
+        # age out of the trailing 60s window.)
+        report, _ = _timed_health_report(rest)
+        assert report["indicators"]["shards_availability"]["status"] == (
+            "green"
+        )
+        assert report["indicators"]["master_stability"]["status"] == (
+            "green"
+        )
+        transport = report["indicators"]["transport"]
+        assert minority not in transport["details"].get(
+            "unreachable_members", ()
+        )
+
+
+class TestCtlUnderChaos:
+    def test_obs_fans_answer_within_deadline_with_named_failures(
+        self, topo
+    ):
+        rest, procs = topo
+        victim = procs.workers[0]
+        survivor = procs.workers[1]
+        procs.partition({victim}, {survivor, "tiebreaker"})
+        procs.kill_9(victim)
+        try:
+            # health report: bounded, with the dead worker as a NAMED
+            # per-indicator diagnosis entry.
+            def _dead_named():
+                report, _ = _timed_health_report(rest)
+                shards = report["indicators"]["shards_availability"]
+                causes = " ".join(
+                    d["cause"] for d in shards["diagnosis"]
+                )
+                return report if f"[{victim}]" in causes else None
+
+            _until(
+                _dead_named,
+                timeout_s=30.0,
+                what="a diagnosis naming the killed worker",
+            )
+
+            # nodes_stats: bounded, named failures[] in the header.
+            t0 = time.monotonic()
+            status, stats = rest.dispatch("GET", "/_nodes/stats", {}, "")
+            assert time.monotonic() - t0 < FAN_BUDGET_S
+            assert status == 200
+            header = stats["_nodes"]
+            assert header["failed"] >= 1
+            assert victim in [
+                f["node"] for f in header["failures"]
+            ]
+            assert survivor in stats["nodes"]
+            assert "front" in stats["nodes"]
+
+            # metrics federation: bounded, survivors still labeled.
+            t0 = time.monotonic()
+            status, metrics = rest.dispatch("GET", "/_metrics", {}, "")
+            assert time.monotonic() - t0 < FAN_BUDGET_S
+            assert status == 200
+            text = getattr(metrics, "text", None) or str(metrics)
+            assert f'node="{survivor}"' in text
+        finally:
+            procs.restart(victim)
+            procs.heal_partition()
+        procs.wait_for_status("green", timeout_s=60.0)
+
+    def test_close_reaps_children_and_ctl_listener(self, topo):
+        """Runs LAST: tears the module topology down itself and asserts
+        the supervisor leaks nothing — every worker reaped, the `_ctl`
+        listener socket closed (its port refuses new connections). The
+        module fixture's close() is an idempotent no-op afterwards."""
+        import socket as socketlib
+
+        rest, procs = topo
+        host, port = procs._ctl._server.getsockname()[:2]
+        children = [procs._procs[n] for n in procs.workers]
+        rest.close()
+        for proc in children:
+            assert not proc.is_alive()
+        assert procs._ctl._closed
+        with pytest.raises(OSError):
+            probe = socketlib.create_connection((host, port), timeout=1.0)
+            probe.close()

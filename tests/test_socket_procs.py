@@ -140,6 +140,68 @@ class TestTwoProcessCluster:
             assert "step_errors" in cluster.state_of(worker)
 
 
+class TestStaticAddressBook:
+    """Multi-host address-book mode (the `discovery.seed_hosts` analog):
+    every member's transport address is explicit configuration — no
+    shared-filesystem address directory, no inherited fds — the form a
+    REAL multi-host deployment (one process per TPU host over DCN) would
+    use. Workers bind their configured ports, discover each other from
+    the static map alone, and the serving path works end to end."""
+
+    def test_boot_discover_and_serve_with_explicit_seeds(self):
+        import socket as socketlib
+
+        # Pre-pick free ports by binding then releasing them; the gap to
+        # the worker's own bind is the standard best-effort race.
+        ports = []
+        holders = []
+        for _ in range(3):
+            s = socketlib.socket()
+            s.bind(("127.0.0.1", 0))
+            holders.append(s)
+            ports.append(s.getsockname()[1])
+        for s in holders:
+            s.close()
+        seed_addrs = {
+            "node-0": f"127.0.0.1:{ports[0]}",
+            "node-1": f"127.0.0.1:{ports[1]}",
+            "tiebreaker": f"127.0.0.1:{ports[2]}",
+        }
+        cluster = ProcCluster(
+            2,
+            data_path=tempfile.mkdtemp(prefix="estpu-static-book-"),
+            seed_addrs=seed_addrs,
+        )
+        try:
+            # Members really bound their CONFIGURED addresses.
+            for node_id in cluster.workers:
+                transport = cluster.state_of(node_id)
+                assert transport["node"] == node_id
+            for node_id, addr in seed_addrs.items():
+                host, port = addr.split(":")
+                looked_up = cluster._book.lookup(node_id)
+                assert looked_up == (host, int(port))
+            # Discovery: an elected master whose membership names every
+            # seed — from the static map alone.
+            cluster.wait_for_status("green", timeout_s=60.0)
+            assert set(cluster._local_node.state.nodes) >= set(
+                cluster.workers
+            )
+            # Serving path over the configured addresses.
+            cluster.create_index(
+                "s", n_shards=1, n_replicas=1, mappings=MAPPINGS
+            )
+            for i in range(5):
+                cluster.write("s", f"d{i}", {"body": f"payload {i}"})
+            out = cluster.search(
+                "s", {"query": {"match": {"body": "payload"}}, "size": 10}
+            )
+            assert out["hits"]["total"]["value"] == 5
+            assert cluster.read("s", "d0") is not None
+        finally:
+            cluster.close()
+
+
 @pytest.mark.slow
 class TestProcessChurn:
     def test_repeated_kill9_restart_cycles(self):

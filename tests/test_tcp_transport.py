@@ -498,3 +498,126 @@ class TestTcpClusterSmoke:
             assert replica in routing.in_sync
         finally:
             cluster.close()
+
+
+class TestHandshakeAuth:
+    """Shared-key HMAC wire authn (satellite of the socketed-topology
+    PR): a peer without the cluster's transport key cannot complete a
+    handshake, and the refusal feeds the SAME observables (reject
+    counter + windowed event) the `transport` health indicator reads."""
+
+    def _pair(self, key_a, key_b):
+        book = InMemoryAddressBook()
+        a = TcpTransport("a", book, cluster_name="t", auth_key=key_a)
+        b = TcpTransport("b", book, cluster_name="t", auth_key=key_b)
+        a.register("a", _echo)
+        b.register("b", _echo)
+        return a, b
+
+    def test_matching_keys_serve(self):
+        a, b = self._pair("sesame", "sesame")
+        try:
+            out = a.send("a", "b", "ping", {"n": 1})
+            assert out["echo"] == "ping"
+            assert b.stats()["handshake_rejects"] == 0
+        finally:
+            a.close()
+            b.close()
+
+    def test_mismatched_key_rejected_and_counted(self):
+        a, b = self._pair("wrong", "sesame")
+        try:
+            with pytest.raises(ConnectTransportError) as err:
+                a.send("a", "b", "ping", {}, timeout_s=3.0)
+            text = str(err.value)
+            assert "auth" in text
+            assert "sesame" not in text  # never echo key material
+            assert "wrong" not in text
+            assert b.stats()["handshake_rejects"] >= 1
+            assert b.recent_events().get("handshake_reject", 0) >= 1
+        finally:
+            a.close()
+            b.close()
+
+    def test_missing_key_rejected(self):
+        # Dialer has no key at all (env empty -> authn disabled on its
+        # side); the keyed server still refuses it.
+        a, b = self._pair("", "sesame")
+        try:
+            with pytest.raises(ConnectTransportError):
+                a.send("a", "b", "ping", {}, timeout_s=3.0)
+            assert b.stats()["handshake_rejects"] >= 1
+        finally:
+            a.close()
+            b.close()
+
+    def test_env_key_picked_up(self, monkeypatch):
+        from elasticsearch_tpu.cluster.tcp_transport import (
+            TRANSPORT_KEY_ENV,
+        )
+
+        monkeypatch.setenv(TRANSPORT_KEY_ENV, "from-env")
+        book = InMemoryAddressBook()
+        a = TcpTransport("a", book, cluster_name="t")
+        b = TcpTransport("b", book, cluster_name="t")
+        a.register("a", _echo)
+        b.register("b", _echo)
+        try:
+            assert a.auth_key == "from-env"
+            out = a.send("a", "b", "ping", {})
+            assert out["echo"] == "ping"
+        finally:
+            a.close()
+            b.close()
+
+
+class TestDrainBarrier:
+    """Graceful-shutdown drain (satellite of the SIGTERM-drain arc): a
+    worker about to exit waits out its in-flight requests — they answer
+    instead of dying as connection resets."""
+
+    def test_drain_waits_for_inflight_request(self):
+        book = InMemoryAddressBook()
+        a = TcpTransport("a", book, cluster_name="t")
+        b = TcpTransport("b", book, cluster_name="t")
+        entered = threading.Event()
+        release = threading.Event()
+
+        def slow(from_id, action, payload):
+            entered.set()
+            release.wait(timeout=10.0)
+            return {"done": True}
+
+        a.register("a", _echo)
+        b.register("b", slow)
+        result: dict = {}
+
+        def send():
+            result["out"] = a.send("a", "b", "work", {}, timeout_s=10.0)
+
+        sender = threading.Thread(target=send, daemon=True)
+        try:
+            sender.start()
+            assert entered.wait(timeout=5.0)
+            # In-flight: a bounded drain reports stragglers honestly.
+            assert b.drain(timeout_s=0.2) is False
+            release.set()
+            assert b.drain(timeout_s=5.0) is True
+            sender.join(timeout=5.0)
+            assert result["out"] == {"done": True}
+            assert b.stats()["drains"] >= 2
+        finally:
+            release.set()
+            a.close()
+            b.close()
+
+    def test_drain_idle_is_immediate(self):
+        book = InMemoryAddressBook()
+        b = TcpTransport("b", book, cluster_name="t")
+        b.register("b", _echo)
+        try:
+            t0 = time.monotonic()
+            assert b.drain(timeout_s=5.0) is True
+            assert time.monotonic() - t0 < 1.0
+        finally:
+            b.close()
