@@ -2712,6 +2712,202 @@ def bench_cfg14_socket(n_docs=None, n_q=24, duration_s=3.0):
     }
 
 
+def bench_cfg15_qos(n_docs=None, n_q=16, n_light=100, n_flood_threads=8):
+    """ISSUE 17 config: async search parity + per-tenant QoS fairness.
+
+    Two gates on one corpus:
+
+    1. `mismatches`: every query in a cfg7-style mix (filtered matches,
+       field sorts, terms/metric aggregations) is served twice — the
+       synchronous `_search` and the stored progressive `_async_search`
+       (completion awaited) — and the completed async response must be
+       bit-identical to the synchronous one (`took` excluded: it
+       measures a different execution). Zero tolerated.
+    2. `fairness_ok`: one tenant floods heavy aggregations from
+       `n_flood_threads` threads through a deliberately small admission
+       budget while `n_light` distinct light tenants each run a cheap
+       search; every light lane's windowed admission-wait p99 (the
+       per-lane `estpu_qos_queue_wait_recent_ms` rolling window) must
+       stay under `light_budget_ms`. The hog MAY be shed (reported),
+       the lights may not be starved.
+    """
+    import os
+    import threading
+
+    from elasticsearch_tpu.node import Node
+
+    if n_docs is None:
+        n_docs = int(os.environ.get("ESTPU_BENCH_QOS_N", 3_000))
+    light_budget_ms = float(
+        os.environ.get("ESTPU_BENCH_QOS_LIGHT_BUDGET_MS", 1_500.0)
+    )
+    rng = np.random.default_rng(151)
+    vocab = [f"w{i:04d}" for i in range(1_500)]
+    probs = 1.0 / np.arange(1, len(vocab) + 1) ** 1.1
+    probs /= probs.sum()
+
+    # The progressive sharded tier is the host-coordinator scatter; an
+    # SPMD mesh view (captured at create_index time) would route these
+    # multi-shard searches to the solo fallback instead.
+    prev_mesh = os.environ.get("ESTPU_MESH_SERVING")
+    os.environ["ESTPU_MESH_SERVING"] = "0"
+    try:
+        node = Node(data_path=None)
+        node.create_index(
+            "qos",
+            {
+                "settings": {"index": {"number_of_shards": 3}},
+                "mappings": {
+                    "properties": {
+                        "body": {"type": "text"},
+                        "tag": {"type": "keyword"},
+                        "rank": {"type": "float"},
+                    }
+                },
+            },
+        )
+    finally:
+        if prev_mesh is None:
+            os.environ.pop("ESTPU_MESH_SERVING", None)
+        else:
+            os.environ["ESTPU_MESH_SERVING"] = prev_mesh
+    try:
+        t0 = time.monotonic()
+        ranks = rng.random(n_docs)
+        for i in range(n_docs):
+            terms = rng.choice(len(vocab), size=10, p=probs)
+            node.index_doc(
+                "qos",
+                {
+                    "body": " ".join(vocab[t] for t in terms),
+                    "tag": f"t{i % 12}",
+                    "rank": float(ranks[i]),
+                },
+                f"d{i}",
+            )
+        node.refresh("qos")
+        ingest_s = time.monotonic() - t0
+
+        bodies = []
+        for qi in range(n_q):
+            picked = rng.choice(250, size=2, replace=False)
+            body = {
+                "query": {"match": {"body": " ".join(vocab[t] for t in picked)}},
+                "size": K,
+            }
+            if qi % 3 == 1:
+                body["sort"] = [{"rank": "desc"}]
+            if qi % 3 == 2:
+                body["aggs"] = {
+                    "bytag": {
+                        "terms": {"field": "tag"},
+                        "aggs": {"mr": {"max": {"field": "rank"}}},
+                    }
+                }
+            bodies.append(body)
+
+        # ---- Gate 1: async-vs-sync zero-mismatch parity -----------------
+        t0 = time.monotonic()
+        mismatches = 0
+        async_waits_ms = []
+        for body in bodies:
+            sync = dict(node.search("qos", dict(body), request_cache=False))
+            t1 = time.monotonic()
+            out = node.async_search_submit(
+                "qos",
+                dict(body),
+                params={"wait_for_completion_timeout": "60s"},
+            )
+            async_waits_ms.append((time.monotonic() - t1) * 1e3)
+            got = dict(out.get("response") or {})
+            sync.pop("took", None)
+            got.pop("took", None)
+            if out.get("is_running") or got != sync:
+                mismatches += 1
+        parity_s = time.monotonic() - t0
+
+        # ---- Gate 2: the fairness arc -----------------------------------
+        heavy_body = {
+            "query": {"match": {"body": vocab[0]}},
+            "size": 3,
+            "aggs": {
+                "bytag": {
+                    "terms": {"field": "tag"},
+                    "aggs": {"mr": {"max": {"field": "rank"}}},
+                }
+            },
+        }
+        light_body = {"query": {"match_all": {}}, "size": 1}
+        node.qos.inflight_budget = 4  # force contention at bench scale
+        stop = threading.Event()
+        flood_count = [0]
+        flood_sheds = [0]
+
+        def flood():
+            while not stop.is_set():
+                try:
+                    node.search(
+                        "qos", dict(heavy_body),
+                        request_cache=False, tenant="hog",
+                    )
+                    flood_count[0] += 1
+                except Exception:  # staticcheck: ignore[broad-except] a shed flood request (429) is the mechanism under test, not a failure
+                    flood_sheds[0] += 1
+
+        t0 = time.monotonic()
+        floods = [
+            threading.Thread(target=flood, daemon=True)
+            for _ in range(n_flood_threads)
+        ]
+        for th in floods:
+            th.start()
+        time.sleep(0.3)
+        light_ok = 0
+        for i in range(n_light):
+            node.search(
+                "qos", dict(light_body),
+                request_cache=False, tenant=f"light-{i}",
+            )
+            light_ok += 1
+        stop.set()
+        for th in floods:
+            th.join(timeout=20)
+        fairness_s = time.monotonic() - t0
+
+        worst_light_p99 = 0.0
+        for i in range(n_light):
+            w = node.metrics.window(
+                "estpu_qos_queue_wait_recent_ms", lane=f"light-{i}"
+            )
+            if w is not None:
+                worst_light_p99 = max(worst_light_p99, w.snapshot()["p99"])
+        fairness_ok = worst_light_p99 < light_budget_ms
+        return {
+            "mismatches": mismatches,
+            "fairness_ok": fairness_ok,
+            "worst_light_lane_p99_ms": round(worst_light_p99, 3),
+            "light_budget_ms": light_budget_ms,
+            "light_searches_served": light_ok,
+            "flood_searches_served": flood_count[0],
+            "flood_searches_shed": flood_sheds[0],
+            "hog_window_cost_ms": round(node.qos.window_cost_ms("hog"), 1),
+            "async_submit_p50_ms": round(
+                float(np.median(async_waits_ms)), 3
+            ),
+            "n_docs": n_docs,
+            "n_queries": n_q,
+            "n_light_tenants": n_light,
+            "ingest_s": round(ingest_s, 2),
+            "parity_phase_s": round(parity_s, 2),
+            "fairness_phase_s": round(fairness_s, 2),
+            # Scope note: the fairness arc here is in-process; the
+            # socketed twin is gated in tests/test_chaos_arcs.py.
+            "path": "in-process",
+        }
+    finally:
+        node.close()
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -3029,6 +3225,7 @@ def main():
         ("cfg12_device_obs", bench_cfg12_device_obs),
         ("cfg13_health", bench_cfg13_health),
         ("cfg14_socket", bench_cfg14_socket),
+        ("cfg15_qos", bench_cfg15_qos),
     ):
         # Device-obs accounting per config (ISSUE 14): bracket every
         # config with a process census + HBM window so each emits its

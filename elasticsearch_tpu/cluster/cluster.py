@@ -942,11 +942,9 @@ class ClusterNode:
         meta = self.state.indices.get(index)
         if meta is None:
             raise NoShardAvailableError(f"no such index [{index}]")
-        from ..search.aggs import (
-            merge_wire_states,
-            render_wire_states,
-            wire_agg_ineligible_reason,
-        )
+        from ..exec.async_search import ProgressiveShardReduce
+        from ..index.mapping import Mappings
+        from ..search.aggs import wire_agg_ineligible_reason
         from ..search.service import SearchRequest, sort_merge_key
 
         # The coordinator's view of the request: merge keys (sort spec,
@@ -964,72 +962,55 @@ class ClusterNode:
         shard_body = dict(body)
         shard_body["from"] = 0
         shard_body["size"] = int(body.get("from", 0)) + size
-        merged: list[tuple] = []
-        total = 0
-        max_score = None
-        successful = 0
-        failures: list[dict] = []
-        agg_acc: list | None = None
-        from ..obs.tracing import TRACER
-
+        # The same progressive reducer async search drives shard-by-shard:
+        # the synchronous path is just "feed every shard, render once".
+        # Folding in ascending shard order keeps the merge (and its f64
+        # agg arithmetic) bit-identical whatever order parts arrive in.
+        reduce = ProgressiveShardReduce(
+            request,
+            from_=int(body.get("from", 0)),
+            size=size,
+            n_shards=len(meta.shards),
+            index_name=index,
+            mappings=lambda: Mappings.from_json(meta.mappings),
+        )
         # Target nodes that already recorded this REQUEST's filter-cache
         # sighting: the first shard request sent to a node records, later
         # shards of the same scatter pass record_filter_usage=False — one
         # sighting per user request per node cache.
         recorded_nodes: set[str] = set()
-        for shard_id, routing in sorted(meta.shards.items()):
-            copies = [
-                n
-                for n in ([routing.primary] if routing.primary else [])
-                + routing.replicas
-                if n is not None
-            ]
-            with TRACER.span(
-                "cluster.shard", shard=shard_id, index=index
-            ) as shard_span:
-                resp, failure = self._search_one_shard(
-                    index, shard_id, copies, shard_body,
-                    recorded_nodes=recorded_nodes,
-                )
-                if shard_span is not None and failure is not None:
-                    shard_span.status = "error"
-                    shard_span.tags["failed"] = True
-                    shard_span.tags["error_reason"] = failure["reason"][
-                        "reason"
-                    ][:200]
+        for shard_id in sorted(meta.shards):
+            resp, failure = self.search_shard(
+                index, shard_id, shard_body, recorded_nodes=recorded_nodes
+            )
             if resp is None:
-                failures.append(failure)
+                reduce.add_failure(shard_id, failure)
                 continue
-            successful += 1
-            total += resp["total"] or 0
-            if resp["max_score"] is not None:
-                max_score = (
-                    resp["max_score"]
-                    if max_score is None
-                    else max(max_score, resp["max_score"])
-                )
-            shard_aggs = resp.get("aggs")
-            if request.aggs is not None and shard_aggs is not None:
-                if agg_acc is None:
-                    agg_acc = [None] * len(request.aggs)
-                agg_acc = [
-                    merge_wire_states(node, acc, wire)
-                    for node, acc, wire in zip(
-                        request.aggs, agg_acc, shard_aggs
-                    )
-                ]
-            for rank, hit in enumerate(resp["hits"]):
+            keyed = [
                 # Merge contract identical to the single-process
                 # coordinator: (sort key per the request's sort spec with
                 # missing-value placement, shard index, per-shard rank).
-                sort_key = sort_merge_key(
-                    request, hit.get("_score"), hit.get("sort")
+                (
+                    sort_merge_key(
+                        request, hit.get("_score"), hit.get("sort")
+                    ),
+                    rank,
+                    hit,
                 )
-                merged.append((sort_key, shard_id, rank, hit))
+                for rank, hit in enumerate(resp["hits"])
+            ]
+            reduce.add_part(
+                shard_id,
+                resp["total"] or 0,
+                resp["max_score"],
+                keyed,
+                agg_wires=resp.get("aggs"),
+            )
+        failures = reduce.failures()
         failed = len(failures)
         if failed:
             self._count_search("shard_failures", failed)
-        if successful == 0 and failed > 0:
+        if reduce.successful_count() == 0 and failed > 0:
             raise NoShardAvailableError(
                 f"all shards of [{index}] failed: "
                 f"{failures[-1]['reason']['reason']}"
@@ -1042,55 +1023,59 @@ class ClusterNode:
             )
         if failed:
             self._count_search("partial_results")
-        merged.sort(key=lambda t: (t[0], t[1], t[2]))
-        if request.knn is not None:
-            # Global top-k reduce (the kNN coordinator contract): shards
-            # contribute up to k candidates each; the merge keeps k.
-            merged = merged[: request.knn.k]
-        frm = int(body.get("from", 0))
-        page = []
-        for _, _, _, h in merged[frm : frm + size]:
-            if h.get("sort") is None:
-                h = {k2: v for k2, v in h.items() if k2 != "sort"}
-            page.append(h)
-        shards_obj: dict[str, Any] = {
-            "total": len(meta.shards),
-            "successful": successful,
-            "skipped": 0,
-            "failed": failed,
-        }
-        if failures:
-            shards_obj["failures"] = failures
-        out: dict[str, Any] = {
-            "_shards": shards_obj,
-            "hits": {
-                "total": {"value": total, "relation": "eq"},
-                "max_score": max_score,
-                "hits": page,
-            },
-        }
-        if request.aggs is not None:
-            from ..index.mapping import Mappings
+        return reduce.render()
 
-            wires = agg_acc or [None] * len(request.aggs)
-            if any(w is None for w in wires):
-                # No successful shard contributed (all-failed raises
-                # earlier): render empty states.
-                from ..search.aggs import new_merge_state, state_to_wire
+    def search_meta(self, index: str) -> dict:
+        """Shard map + mappings for a coordinating async-search runner:
+        the list of shard ids to scatter over and the mappings JSON its
+        reducer renders aggs against."""
+        meta = self.state.indices.get(index)
+        if meta is None:
+            raise NoShardAvailableError(f"no such index [{index}]")
+        return {
+            "shards": sorted(meta.shards),
+            "mappings": meta.mappings,
+        }
 
-                wires = [
-                    w
-                    if w is not None
-                    else state_to_wire(n, new_merge_state(n), {})
-                    for n, w in zip(request.aggs, wires)
-                ]
-            out["aggregations"] = render_wire_states(
-                request.aggs,
-                wires,
-                Mappings.from_json(meta.mappings),
-                index,
+    def search_shard(
+        self, index: str, shard_id: int, shard_body: dict,
+        recorded_nodes: set | None = None,
+    ) -> tuple[dict | None, dict | None]:
+        """One shard's leg of the scatter: EWMA-ranked copies, bounded
+        retry, traced; returns (shard response, None) or (None, failure
+        entry). The async-search runner calls this per shard and folds
+        each part into its progressive reduce; the synchronous search()
+        above is the same calls in a tight loop."""
+        meta = self.state.indices.get(index)
+        if meta is None:
+            raise NoShardAvailableError(f"no such index [{index}]")
+        routing = meta.shards.get(shard_id)
+        if routing is None:
+            raise NoShardAvailableError(
+                f"[{index}][{shard_id}] no such shard"
             )
-        return out
+        from ..obs.tracing import TRACER
+
+        copies = [
+            n
+            for n in ([routing.primary] if routing.primary else [])
+            + routing.replicas
+            if n is not None
+        ]
+        with TRACER.span(
+            "cluster.shard", shard=shard_id, index=index
+        ) as shard_span:
+            resp, failure = self._search_one_shard(
+                index, shard_id, copies, shard_body,
+                recorded_nodes=recorded_nodes,
+            )
+            if shard_span is not None and failure is not None:
+                shard_span.status = "error"
+                shard_span.tags["failed"] = True
+                shard_span.tags["error_reason"] = failure["reason"][
+                    "reason"
+                ][:200]
+        return resp, failure
 
     def _search_one_shard(
         self, index: str, shard_id: int, copies: list[str],

@@ -298,6 +298,36 @@ class ReplicationGateway:
             timeout_s=timeout_s,
         )
 
+    def search_meta(self, index: str, timeout_s: float | None = None) -> dict:
+        """The coordinating node's scatter plan for `index`: sorted shard
+        ids + mappings JSON. The async-search runner uses it to size its
+        ProgressiveShardReduce before scattering `search_shard` calls."""
+        return self._run(
+            f"search_meta:{index}",
+            lambda node: node.search_meta(index),
+            timeout_s=timeout_s,
+        )
+
+    def search_shard(
+        self,
+        index: str,
+        shard_id: int,
+        shard_body: dict,
+        recorded_nodes=None,
+        timeout_s: float | None = None,
+    ) -> tuple:
+        """One shard's part of a scattered search: `(resp, failure)` with
+        exactly one side non-None — ClusterNode.search_shard's contract.
+        Safe under `_run`'s retry loop: the progressive reduce keys parts
+        by shard id, so a retried shard overwrites its own slot."""
+        return self._run(
+            f"search_shard:{index}",
+            lambda node: node.search_shard(
+                index, shard_id, shard_body, recorded_nodes=recorded_nodes
+            ),
+            timeout_s=timeout_s,
+        )
+
     def create_index(
         self,
         name: str,

@@ -20,7 +20,15 @@ live here as one subsystem:
 - packed.py   — the packed multi-tenant executor: many SMALL indices
                 share one device plane and one coalesced launch (the
                 batcher's cross-index group), with per-tenant result
-                parity and planner-routed packed-vs-oracle execution.
+                parity and planner-routed packed-vs-oracle execution;
+- qos.py      — per-tenant QoS: weighted admission lanes (keyed by
+                X-Opaque-Id) with windowed observed-cost accounting,
+                deficit-round-robin drain in the batcher, and weighted
+                shedding that 429s the over-quota lane first;
+- async_search.py — stored progressive searches (the _async_search API):
+                per-shard results fold through sort_merge_key /
+                merge_wire_states into partials that are each the exact
+                answer over the shards reduced so far.
 
 Every routing decision is observable: `profile: true` carries the chosen
 backend per shard, and `GET /_nodes/stats` exposes decision counters,
@@ -28,15 +36,21 @@ batch-occupancy histograms, queue-wait percentiles, packed-launch
 occupancy, and EWMA snapshots.
 """
 
+from .async_search import AsyncSearchService, ProgressiveShardReduce
 from .batcher import MicroBatcher
 from .cost import CostModel, PlanFeatures
 from .packed import PackedExecutor
 from .planner import ExecPlanner
+from .qos import DEFAULT_LANE, QosController
 
 __all__ = [
+    "AsyncSearchService",
     "CostModel",
+    "DEFAULT_LANE",
     "ExecPlanner",
     "MicroBatcher",
     "PackedExecutor",
     "PlanFeatures",
+    "ProgressiveShardReduce",
+    "QosController",
 ]

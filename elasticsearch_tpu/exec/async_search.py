@@ -1,0 +1,1003 @@
+"""Async search: stored tasks serving progressively-reduced partials.
+
+The x-pack async-search analog (AsyncSearchTask: a registered task whose
+per-shard results reduce incrementally, queryable by id while running).
+Two pieces:
+
+**ProgressiveShardReduce** — the one coordinator-reduce implementation
+shards fold into as they complete. Hits merge under the same
+`sort_merge_key` contract every serving form uses; agg merge-states fold
+through `merge_wire_states` (the PR-8 wire family IS the partial-reduce
+machinery); rendering always folds in ASCENDING shard order, so the
+result is invariant to completion order and bit-identical to the
+synchronous fold — every partial is the correct answer over exactly the
+shards reduced so far. `cluster/cluster.ClusterNode.search` now runs its
+synchronous scatter through this same reducer ("feed every shard, render
+once"), so async-vs-sync parity is structural, not aspirational.
+
+**AsyncSearchService** — the bounded store behind
+`POST /{index}/_async_search` (returns `{id, is_partial, is_running,
+response}` after `wait_for_completion_timeout`, default 1s),
+`GET /_async_search/{id}` (blocking poll + `keep_alive` extension) and
+`DELETE /_async_search/{id}` (cancel through the task registry — the
+existing `POST /_tasks/{id}/_cancel` works too, the runner checks
+`raise_if_cancelled` between shards). Entries expire `keep_alive`
+(default 5m) after their last touch; expired entries GC on access
+(running ones are cancelled), and a full store evicts oldest-completed
+first, 429ing only when every entry is still running.
+
+Three runner tiers, picked at submit:
+- **replicated** (ClusterNode / socketed ProcGateway): the coordinating
+  node scatters `search_shard` per shard through the gateway and folds
+  each part locally — the store lives on the coordinating node.
+- **sharded in-process** (ShardedSearchCoordinator, wire-eligible
+  shapes): per-shard hits passes + per-shard `Aggregator.run_states`
+  wires fold progressively. Honest residue: per-shard metric-agg states
+  keep running f64 sums per shard, so adversarial float sets can differ
+  from the sync single-Aggregator fold in the last ULP (the fuzz suite
+  uses dyadic-safe values; percentile/terms families are exact).
+  can_match-skipped shards still contribute their agg states, so bucket
+  and `global` agg math stays exact.
+- **solo fallback** (everything else — mesh-served, knn, highlight…):
+  one synchronous `node.search` producing a single final part. Trivially
+  bit-exact; no intermediate partials.
+
+Tiers 1-2 run inside `node.qos.admit(lane)` — an async flood obeys the
+same per-tenant admission quotas as synchronous traffic (the solo tier
+admits inside `node.search` itself). `fault_point("async.reduce")` fires
+per shard: an injected fault degrades that shard into an honest
+`_shards.failures[]` entry instead of poisoning the stored search.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+import uuid
+
+from ..common.tasks import TaskCancelledError
+from ..faults import fault_point
+from .qos import DEFAULT_LANE
+
+
+def _api_error(status: int, type_: str, reason: str):
+    from ..node import ApiError  # lazy: node imports this module lazily too
+
+    return ApiError(status, type_, reason)
+
+
+class ProgressiveShardReduce:
+    """Fold per-shard search parts into one response, incrementally.
+
+    Thread-safe; `render()` may run concurrently with `add_part` (each
+    render folds a consistent snapshot). Parts are idempotent per shard
+    (a retried shard overwrites its own slot), and rendering folds in
+    ascending shard order regardless of arrival order — the property
+    that makes every partial AND the final bit-identical to the
+    synchronous reduce.
+
+    `style` picks the envelope: "cluster" mirrors ClusterNode.search's
+    dict (caller wraps took/timed_out), "coordinator" mirrors
+    SearchResponse.to_json (clamped totals, took/timed_out inline).
+    """
+
+    def __init__(
+        self,
+        request,
+        from_: int,
+        size: int,
+        n_shards: int,
+        index_name: str,
+        mappings,
+        style: str = "cluster",
+    ):
+        self.request = request
+        self.from_ = max(0, int(from_))
+        self.size = max(0, int(size))
+        self.n_shards = n_shards
+        self.index_name = index_name
+        self._mappings = mappings  # Mappings object or zero-arg callable
+        self.style = style
+        self._lock = threading.Lock()
+        # shard_id -> (total, max_score, keyed_hits, agg_wires, timed_out)
+        self._parts: dict = {}
+        self._failures: dict = {}
+        # shard_id -> (total, agg_wires): hits-pass can_match skips that
+        # still carry their agg contribution (global/bucket math must see
+        # every shard even when the hits pass provably matches nothing).
+        self._skipped: dict = {}
+
+    # ------------------------------------------------------------ feeding
+
+    def add_part(
+        self,
+        shard_id,
+        total,
+        max_score,
+        keyed_hits,
+        agg_wires=None,
+        timed_out: bool = False,
+    ) -> None:
+        """One completed shard: `keyed_hits` = [(merge_key, rank, hit
+        JSON)] in the shard's own rank order; `agg_wires` = that shard's
+        state_to_wire payloads (one per top-level agg node)."""
+        with self._lock:
+            self._parts[shard_id] = (
+                total, max_score, list(keyed_hits), agg_wires, timed_out,
+            )
+            self._failures.pop(shard_id, None)
+            self._skipped.pop(shard_id, None)
+
+    def add_failure(self, shard_id, failure: dict) -> None:
+        with self._lock:
+            if shard_id not in self._parts:
+                self._failures[shard_id] = failure
+
+    def add_skipped(self, shard_id, total=0, agg_wires=None) -> None:
+        with self._lock:
+            self._skipped[shard_id] = (total, agg_wires)
+
+    # ----------------------------------------------------------- counters
+
+    def successful_count(self) -> int:
+        with self._lock:
+            return len(self._parts)
+
+    def skipped_count(self) -> int:
+        with self._lock:
+            return len(self._skipped)
+
+    def reduced_count(self) -> int:
+        """Shards accounted for so far (parts + failures + skips)."""
+        with self._lock:
+            return len(self._parts) + len(self._failures) + len(self._skipped)
+
+    def failures(self) -> list[dict]:
+        with self._lock:
+            return [self._failures[s] for s in sorted(self._failures)]
+
+    # ------------------------------------------------------------- render
+
+    def render(self, took_ms: int | None = None, timed_out: bool = False):
+        """The response over the shards reduced SO FAR. Pure fold over a
+        snapshot — never mutates reduce state, so partial renders and the
+        final render run the same code."""
+        request = self.request
+        with self._lock:
+            parts = sorted(self._parts.items())
+            skipped_items = sorted(self._skipped.items())
+            failures = [self._failures[s] for s in sorted(self._failures)]
+            successful = len(self._parts)
+            skipped = len(self._skipped)
+        total = 0
+        max_score = None
+        merged: list[tuple] = []
+        agg_acc: list | None = None
+        any_timed_out = False
+        # Agg fold walks parts AND skipped shards in one ascending-id
+        # sweep: fold order (and therefore any f64 arithmetic) never
+        # depends on which shard finished first.
+        agg_feed = sorted(
+            [(sid, p[3]) for sid, p in parts]
+            + [(sid, s[1]) for sid, s in skipped_items]
+        )
+        if request.aggs is not None:
+            from ..search.aggs import merge_wire_states
+
+            for _sid, wires in agg_feed:
+                if wires is None:
+                    continue
+                if agg_acc is None:
+                    agg_acc = [None] * len(request.aggs)
+                agg_acc = [
+                    merge_wire_states(node, acc, wire)
+                    for node, acc, wire in zip(request.aggs, agg_acc, wires)
+                ]
+        for shard_id, (p_total, p_max, keyed, _wires, p_to) in parts:
+            total += p_total or 0
+            any_timed_out = any_timed_out or p_to
+            if p_max is not None:
+                max_score = (
+                    p_max if max_score is None else max(max_score, p_max)
+                )
+            for key, rank, hit in keyed:
+                merged.append((key, shard_id, rank, hit))
+        for _sid, (s_total, _wires) in skipped_items:
+            total += s_total or 0
+        merged.sort(key=lambda t: (t[0], t[1], t[2]))
+        if request.knn is not None:
+            # Global top-k reduce (the kNN coordinator contract).
+            merged = merged[: request.knn.k]
+        page_rows = merged[self.from_ : self.from_ + self.size]
+        failed = len(failures)
+        shards_obj = {
+            "total": self.n_shards,
+            "successful": successful,
+            "skipped": skipped,
+            "failed": failed,
+        }
+        if failures:
+            shards_obj["failures"] = failures
+        if self.style == "coordinator":
+            from ..search.service import clamp_total
+
+            total_out, relation = clamp_total(
+                total, request.track_total_hits
+            )
+            hits_obj = {
+                "max_score": max_score,
+                # Hit JSON came through SearchHit.to_json (sort already
+                # omitted when None) — identical bytes to the sync page.
+                "hits": [h for _, _, _, h in page_rows],
+            }
+            if total_out is not None:
+                hits_obj = {
+                    "total": {"value": total_out, "relation": relation},
+                    **hits_obj,
+                }
+            out = {
+                "took": int(took_ms or 0),
+                "timed_out": bool(timed_out or any_timed_out),
+                "_shards": shards_obj,
+                "hits": hits_obj,
+            }
+        else:
+            page = []
+            for _, _, _, h in page_rows:
+                if h.get("sort") is None:
+                    h = {k2: v for k2, v in h.items() if k2 != "sort"}
+                page.append(h)
+            out = {
+                "_shards": shards_obj,
+                "hits": {
+                    "total": {"value": total, "relation": "eq"},
+                    "max_score": max_score,
+                    "hits": page,
+                },
+            }
+        if request.aggs is not None:
+            from ..search.aggs import new_merge_state, state_to_wire
+
+            wires = agg_acc or [None] * len(request.aggs)
+            if any(w is None for w in wires):
+                # No reduced shard contributed yet: render empty states.
+                wires = [
+                    w
+                    if w is not None
+                    else state_to_wire(n, new_merge_state(n), {})
+                    for n, w in zip(request.aggs, wires)
+                ]
+            from ..search.aggs import render_wire_states
+
+            mappings = (
+                self._mappings() if callable(self._mappings)
+                else self._mappings
+            )
+            out["aggregations"] = render_wire_states(
+                request.aggs, wires, mappings, self.index_name
+            )
+        return out
+
+
+class _AsyncEntry:
+    """One stored async search."""
+
+    def __init__(self, id_, index, lane, tier, body, keep_alive_s):
+        self.id = id_
+        self.index = index
+        self.lane = lane
+        self.tier = tier
+        self.body = body
+        self.task = None
+        self.thread = None
+        self.reduce: ProgressiveShardReduce | None = None
+        self.response = None
+        self.error = None
+        self.is_running = True
+        # staticcheck: ignore[wallclock-duration] user-facing epoch stamp (start_time_in_millis); nothing measures durations from it
+        self.start_ms = int(time.time() * 1000)
+        self.keep_alive_s = keep_alive_s
+        # staticcheck: ignore[wallclock-duration] expiration_time_in_millis is reported to clients as an epoch stamp, so the GC deadline must live on the same clock
+        self.expires_at = time.time() + keep_alive_s
+        self.completion_ms: int | None = None
+        self.done = threading.Event()
+        self.lock = threading.Lock()
+
+
+class AsyncSearchService:
+    """One node's async-search store + runners."""
+
+    def __init__(self, node):
+        self.node = node
+        self.max_stored = int(
+            os.environ.get("ESTPU_ASYNC_SEARCH_MAX", "") or 64
+        )
+        self._lock = threading.Lock()
+        self._store: dict[str, _AsyncEntry] = {}
+        self._ids = itertools.count(1)
+        m = node.metrics
+        self._searches_total = m.counter(
+            "estpu_async_searches_total", "Async searches submitted"
+        )
+        self._partials_served = m.counter(
+            "estpu_async_partials_served_total",
+            "GET /_async_search polls answered while still running",
+        )
+        self._expired_total = m.counter(
+            "estpu_async_expired_total",
+            "Stored async searches expired by keep_alive GC",
+        )
+        self._reduce_recent = m.windowed_histogram(
+            "estpu_async_reduce_recent_ms",
+            "Per-fold progressive reduce render time over the trailing "
+            "window, ms",
+        )
+
+        def _running() -> int:
+            with self._lock:
+                return sum(
+                    1 for e in self._store.values() if e.is_running
+                )
+
+        def _stored() -> int:
+            with self._lock:
+                return len(self._store)
+
+        m.gauge(
+            "estpu_async_running",
+            "Async searches currently executing",
+            fn=_running,
+        )
+        m.gauge(
+            "estpu_async_stored",
+            "Async searches currently stored",
+            fn=_stored,
+        )
+
+    # ------------------------------------------------------------- public
+
+    def submit(
+        self, index: str, body: dict | None, params: dict | None = None,
+        tenant: str | None = None,
+    ) -> dict:
+        from ..search.service import (
+            SearchRequest,
+            _parse_timeout,
+            parse_lenient_bool,
+        )
+
+        node = self.node
+        params = params or {}
+        body = dict(body or {})
+        wait_s = self._duration_param(
+            params, "wait_for_completion_timeout", 1.0, _parse_timeout
+        )
+        keep_alive_s = self._duration_param(
+            params, "keep_alive", 300.0, _parse_timeout
+        )
+        try:
+            keep_on_completion = parse_lenient_bool(
+                params.get("keep_on_completion", False),
+                "keep_on_completion",
+            )
+        except ValueError as e:
+            raise _api_error(
+                400, "illegal_argument_exception", str(e)
+            ) from None
+        if body.get("scroll") is not None or params.get("scroll"):
+            raise _api_error(
+                400,
+                "illegal_argument_exception",
+                "scroll is not supported with [_async_search]",
+            )
+        targets = node.resolve_search_targets(index)
+        if len(targets) != 1:
+            raise _api_error(
+                400,
+                "illegal_argument_exception",
+                f"[_async_search] requires exactly one concrete index, "
+                f"[{index}] resolved to {len(targets)}",
+            )
+        svc = node.get_index(targets[0])  # alias-resolving; 404s honestly
+        name = svc.name
+        # Request-shaped errors surface synchronously at submit, exactly
+        # like the synchronous _search (a 400 must never hide inside a
+        # stored task).
+        try:
+            request = SearchRequest.from_json(body)
+        except (ValueError, TypeError) as e:
+            raise _api_error(
+                400, "illegal_argument_exception", str(e)
+            ) from None
+        tier = self._pick_tier(svc, request, body)
+        if tier == "replicated":
+            if body.get("suggest"):
+                raise _api_error(
+                    400,
+                    "illegal_argument_exception",
+                    "scroll/suggest are not supported on replicated "
+                    "indices yet; disable replication for this workload",
+                )
+            if request.aggs is not None:
+                from ..search.aggs import wire_agg_ineligible_reason
+
+                reason = wire_agg_ineligible_reason(request.aggs)
+                if reason:
+                    raise _api_error(
+                        400,
+                        "search_phase_execution_exception",
+                        f"{reason} are not supported on replicated "
+                        f"indices yet",
+                    )
+        elif tier == "sharded":
+            try:
+                svc.search.services[0]._validate_sort(request)
+                svc.search.services[0]._validate_knn(request)
+            except ValueError as e:
+                raise _api_error(
+                    400, "illegal_argument_exception", str(e)
+                ) from None
+        entry = _AsyncEntry(
+            id_=f"{node.node_name}:as-{next(self._ids)}-"
+            f"{uuid.uuid4().hex[:8]}",
+            index=name,
+            lane=tenant or DEFAULT_LANE,
+            tier=tier,
+            body=body,
+            keep_alive_s=keep_alive_s,
+        )
+        with self._lock:
+            self._gc_locked()
+            if len(self._store) >= self.max_stored:
+                self._evict_completed_locked()
+            if len(self._store) >= self.max_stored:
+                err = _api_error(
+                    429,
+                    "es_rejected_execution_exception",
+                    f"rejected async search: store is full "
+                    f"[{len(self._store)}/{self.max_stored}] and every "
+                    f"entry is still running",
+                )
+                err.headers = {"Retry-After": "1"}
+                raise err
+            self._store[entry.id] = entry
+        entry.task = node.tasks.register(
+            "indices:data/read/search[async]",
+            description=f"async_search indices[{name}]",
+            timeout_s=request.timeout_s,
+        )
+        self._searches_total.inc()
+        entry.thread = threading.Thread(
+            target=self._run_entry,
+            args=(entry,),
+            name=f"async-search-{entry.id}",
+            daemon=True,
+        )
+        entry.thread.start()
+        entry.done.wait(timeout=max(0.0, wait_s))
+        if entry.done.is_set() and not keep_on_completion:
+            # Completed within the caller's wait and the caller did not
+            # ask to keep it: behave like a synchronous search (nothing
+            # left to GET, no id in the envelope).
+            with self._lock:
+                self._store.pop(entry.id, None)
+            return self._envelope(entry, include_id=False)
+        return self._envelope(entry, include_id=True)
+
+    def get(self, id_: str, params: dict | None = None) -> dict:
+        from ..search.service import _parse_timeout
+
+        params = params or {}
+        with self._lock:
+            self._gc_locked()
+            entry = self._store.get(id_)
+        if entry is None:
+            raise _api_error(
+                404, "resource_not_found_exception", f"[{id_}] not found"
+            )
+        if params.get("keep_alive") is not None:
+            ka = self._duration_param(
+                params, "keep_alive", entry.keep_alive_s, _parse_timeout
+            )
+            with entry.lock:
+                entry.keep_alive_s = ka
+                # staticcheck: ignore[wallclock-duration] keep_alive extension on the client-visible epoch clock (expiration_time_in_millis)
+                entry.expires_at = time.time() + ka
+        wait = params.get("wait_for_completion_timeout")
+        if wait is not None:
+            entry.done.wait(
+                timeout=max(
+                    0.0,
+                    self._duration_param(
+                        params, "wait_for_completion_timeout", 0.0,
+                        _parse_timeout,
+                    ),
+                )
+            )
+        if entry.is_running:
+            self._partials_served.inc()
+        return self._envelope(entry, include_id=True)
+
+    def delete(self, id_: str) -> dict:
+        with self._lock:
+            entry = self._store.pop(id_, None)
+        if entry is None:
+            raise _api_error(
+                404, "resource_not_found_exception", f"[{id_}] not found"
+            )
+        if entry.is_running and entry.task is not None:
+            self.node.tasks.cancel(
+                entry.task.id, reason="async search deleted"
+            )
+        return {"acknowledged": True}
+
+    def stats(self) -> dict:
+        with self._lock:
+            stored = len(self._store)
+            running = sum(1 for e in self._store.values() if e.is_running)
+        return {
+            "stored": stored,
+            "running": running,
+            "submitted": int(self._searches_total.value),
+            "partials_served": int(self._partials_served.value),
+            "expired": int(self._expired_total.value),
+            "max_stored": self.max_stored,
+        }
+
+    # ----------------------------------------------------------- internal
+
+    @staticmethod
+    def _duration_param(params, key, default_s, parse):
+        raw = params.get(key)
+        if raw is None or raw == "":
+            return default_s
+        try:
+            val = parse(raw)
+        except ValueError as e:
+            raise _api_error(
+                400, "illegal_argument_exception", str(e)
+            ) from None
+        return default_s if val is None else val
+
+    def _pick_tier(self, svc, request, body) -> str:
+        node = self.node
+        if node.replication is not None:
+            return "replicated"
+        from ..search.coordinator import ShardedSearchCoordinator
+
+        coord = svc.search
+        if not isinstance(coord, ShardedSearchCoordinator):
+            return "solo"
+        if coord.mesh_view is not None:
+            # Mesh-served shapes execute as ONE program over every shard
+            # — nothing per-shard to progressively reduce.
+            return "solo"
+        if (
+            request.knn is not None
+            or request.highlight is not None
+            or getattr(request, "docvalue_fields", None)
+            or getattr(request, "fields", None)
+            or getattr(request, "profile", False)
+            or getattr(request, "search_after", None) is not None
+            or getattr(request, "rescore", None)
+            or getattr(request, "collapse", None)
+            or body.get("suggest")
+        ):
+            return "solo"
+        if request.aggs is not None:
+            from ..search.aggs import wire_agg_ineligible_reason
+
+            if wire_agg_ineligible_reason(request.aggs):
+                return "solo"
+        return "sharded"
+
+    def _gc_locked(self) -> None:
+        # staticcheck: ignore[wallclock-duration] compared against expires_at, which is epoch by contract (client-visible expiration stamp)
+        now = time.time()
+        for id_, entry in list(self._store.items()):
+            if entry.expires_at <= now:
+                del self._store[id_]
+                self._expired_total.inc()
+                if entry.is_running and entry.task is not None:
+                    self.node.tasks.cancel(
+                        entry.task.id, reason="async search expired"
+                    )
+
+    def _evict_completed_locked(self) -> None:
+        oldest_id, oldest_ms = None, None
+        for id_, entry in self._store.items():
+            if entry.is_running:
+                continue
+            if oldest_ms is None or entry.start_ms < oldest_ms:
+                oldest_id, oldest_ms = id_, entry.start_ms
+        if oldest_id is not None:
+            del self._store[oldest_id]
+
+    def _envelope(self, entry: _AsyncEntry, include_id: bool) -> dict:
+        with entry.lock:
+            out: dict = {}
+            if include_id:
+                out["id"] = entry.id
+            out["is_partial"] = entry.is_running or entry.error is not None
+            out["is_running"] = entry.is_running
+            out["start_time_in_millis"] = entry.start_ms
+            out["expiration_time_in_millis"] = int(entry.expires_at * 1000)
+            if entry.completion_ms is not None:
+                out["completion_time_in_millis"] = entry.completion_ms
+            if entry.response is not None:
+                out["response"] = entry.response
+            if entry.error is not None:
+                out["error"] = entry.error
+            return out
+
+    def _publish(self, entry: _AsyncEntry, response: dict) -> None:
+        with entry.lock:
+            entry.response = response
+
+    def _finish(self, entry: _AsyncEntry, response=None, error=None) -> None:
+        with entry.lock:
+            if response is not None:
+                entry.response = response
+            if error is not None:
+                entry.error = error
+            entry.is_running = False
+            # staticcheck: ignore[wallclock-duration] user-facing epoch stamp (completion_time_in_millis); nothing measures durations from it
+            entry.completion_ms = int(time.time() * 1000)
+        entry.done.set()
+
+    def _error_json(self, e: Exception) -> dict:
+        from ..node import ApiError
+
+        if isinstance(e, ApiError):
+            return {
+                "type": e.err_type, "reason": e.reason, "status": e.status,
+            }
+        if isinstance(e, TaskCancelledError):
+            return {
+                "type": "task_cancelled_exception",
+                "reason": str(e),
+                "status": 400,
+            }
+        if isinstance(e, (ValueError, TypeError)):
+            return {
+                "type": "illegal_argument_exception",
+                "reason": str(e),
+                "status": 400,
+            }
+        return {
+            "type": "search_phase_execution_exception",
+            "reason": str(e),
+            "status": 503,
+        }
+
+    # ------------------------------------------------------------ runners
+
+    def _run_entry(self, entry: _AsyncEntry) -> None:
+        node = self.node
+        try:
+            if entry.tier == "replicated":
+                out = self._run_replicated(entry)
+            elif entry.tier == "sharded":
+                out = self._run_sharded(entry)
+            else:
+                # Solo fallback: full synchronous path (its own QoS
+                # admission, insights, caches) — one final part.
+                out = node.search(
+                    entry.index, dict(entry.body), tenant=entry.lane
+                )
+            self._finish(entry, response=out)
+        # staticcheck: ignore[broad-except] runner thread boundary: every failure must land in the stored envelope's error field, never kill the thread silently
+        except Exception as e:
+            self._finish(entry, error=self._error_json(e))
+        finally:
+            if entry.task is not None:
+                node.tasks.unregister(entry.task)
+
+    @staticmethod
+    def _part_delay_s() -> float:
+        # Test pacing hook: a deliberate gap between shard folds so the
+        # progressive-partial suites can observe intermediate renders.
+        try:
+            return float(
+                os.environ.get("ESTPU_ASYNC_PART_DELAY_MS", "") or 0
+            ) / 1e3
+        except ValueError:
+            return 0.0
+
+    def _render_and_publish(
+        self, entry: _AsyncEntry, wrap
+    ) -> None:
+        r_t0 = time.monotonic()
+        out = wrap()
+        self._reduce_recent.record((time.monotonic() - r_t0) * 1e3)
+        self._publish(entry, out)
+
+    def _run_replicated(self, entry: _AsyncEntry) -> dict:
+        from ..index.mapping import Mappings
+        from ..search.service import (
+            SearchRequest,
+            parse_lenient_bool,
+            sort_merge_key,
+        )
+
+        node = self.node
+        gw = node.replication
+        body = dict(entry.body)
+        try:
+            allow_partial = parse_lenient_bool(
+                body.pop("allow_partial_search_results", True),
+                "allow_partial_search_results",
+            )
+        except ValueError as e:
+            raise _api_error(
+                400, "illegal_argument_exception", str(e)
+            ) from None
+        meta = gw.search_meta(entry.index)
+        shard_ids = list(meta["shards"])
+        mappings_json = meta["mappings"]
+        request = SearchRequest.from_json(body)
+        size = int(body.get("size", 10))
+        shard_body = dict(body)
+        shard_body["from"] = 0
+        shard_body["size"] = int(body.get("from", 0)) + size
+        reduce = ProgressiveShardReduce(
+            request,
+            from_=int(body.get("from", 0)),
+            size=size,
+            n_shards=len(shard_ids),
+            index_name=entry.index,
+            mappings=lambda: Mappings.from_json(mappings_json),
+        )
+        entry.reduce = reduce
+        t0 = time.monotonic()
+        delay_s = self._part_delay_s()
+        recorded_nodes: set = set()
+
+        def wrap() -> dict:
+            out = reduce.render()
+            for hit in out["hits"]["hits"]:
+                hit.setdefault("_index", entry.index)
+            return {
+                "took": int((time.monotonic() - t0) * 1000),
+                "timed_out": False,
+                **out,
+            }
+
+        # Zero-shard partial: a running envelope always carries a
+        # response, even before the first fold lands.
+        self._render_and_publish(entry, wrap)
+        with node.qos.admit(entry.lane):
+            for i, shard_id in enumerate(shard_ids):
+                entry.task.raise_if_cancelled()
+                if delay_s and i:
+                    time.sleep(delay_s)
+                try:
+                    # Injectable per-fold fault (faults/registry.py
+                    # `async.reduce`): one poisoned shard degrades into a
+                    # failures[] entry, the stored search stays correct.
+                    fault_point(
+                        "async.reduce", index=entry.index, shard=shard_id
+                    )
+                    resp, failure = gw.search_shard(
+                        entry.index, shard_id, shard_body,
+                        recorded_nodes=recorded_nodes,
+                    )
+                except (ValueError, TypeError, TaskCancelledError):
+                    raise
+                except Exception as e:
+                    # Degraded-mode contract: any shard-level blowup
+                    # becomes an honest failures[] entry while other
+                    # shards keep reducing.
+                    resp, failure = None, {
+                        "shard": shard_id,
+                        "index": entry.index,
+                        "node": None,
+                        "reason": {
+                            "type": type(e).__name__,
+                            "reason": str(e),
+                        },
+                    }
+                if resp is None:
+                    reduce.add_failure(shard_id, failure)
+                else:
+                    keyed = [
+                        (
+                            sort_merge_key(
+                                request, hit.get("_score"),
+                                hit.get("sort"),
+                            ),
+                            rank,
+                            hit,
+                        )
+                        for rank, hit in enumerate(resp["hits"])
+                    ]
+                    reduce.add_part(
+                        shard_id,
+                        resp["total"] or 0,
+                        resp["max_score"],
+                        keyed,
+                        agg_wires=resp.get("aggs"),
+                    )
+                self._render_and_publish(entry, wrap)
+        failures = reduce.failures()
+        failed = len(failures)
+        if reduce.successful_count() == 0 and failed > 0:
+            raise _api_error(
+                503,
+                "search_phase_execution_exception",
+                f"all shards of [{entry.index}] failed: "
+                f"{failures[-1]['reason']['reason']}",
+            )
+        if failed and not allow_partial:
+            raise _api_error(
+                503,
+                "search_phase_execution_exception",
+                f"[{entry.index}] {failed} of {len(shard_ids)} shards "
+                f"failed and allow_partial_search_results is false",
+            )
+        return wrap()
+
+    def _run_sharded(self, entry: _AsyncEntry) -> dict:
+        from dataclasses import replace
+
+        from ..index.filter_cache import (
+            record_filter_usage,
+            record_knn_filter_usage,
+        )
+        from ..search.service import SearchRequest, sort_merge_key
+
+        node = self.node
+        svc = node.indices[entry.index]
+        coord = svc.search
+        request = SearchRequest.from_json(entry.body)
+        # One admission sighting per user request, exactly like the
+        # synchronous coordinator (per-shard passes record=False below).
+        fc_entries = record_filter_usage(
+            coord.filter_cache, request.query, record=True
+        )
+        record_knn_filter_usage(
+            coord.filter_cache, request.knn, record=True
+        )
+        snapshots = [list(e.segments) for e in coord.engines]
+        stats = coord.global_stats(snapshots)
+        k = max(0, request.from_) + max(0, request.size)
+        shard_request = replace(
+            request,
+            from_=0,
+            size=k,
+            aggs=None,
+            track_total_hits=True,
+            highlight=None,
+            docvalue_fields=None,
+            fields=None,
+        )
+        reduce = ProgressiveShardReduce(
+            request,
+            from_=request.from_,
+            size=request.size,
+            n_shards=len(coord.engines),
+            index_name=coord.index_name,
+            mappings=svc.mappings,
+            style="coordinator",
+        )
+        entry.reduce = reduce
+        t0 = time.monotonic()
+        delay_s = self._part_delay_s()
+
+        def wrap() -> dict:
+            return reduce.render(
+                took_ms=int((time.monotonic() - t0) * 1000),
+                timed_out=bool(entry.task.timed_out),
+            )
+
+        # Zero-shard partial: a running envelope always carries a
+        # response, even before the first fold lands.
+        self._render_and_publish(entry, wrap)
+        with node.qos.admit(entry.lane):
+            for shard_idx in range(len(coord.engines)):
+                entry.task.raise_if_cancelled()
+                if delay_s and shard_idx:
+                    time.sleep(delay_s)
+                agg_wires = None
+                agg_total_i = None
+                try:
+                    fault_point(
+                        "async.reduce",
+                        index=coord.index_name,
+                        shard=shard_idx,
+                    )
+                    if request.aggs is not None:
+                        from ..search.aggs import Aggregator, state_to_wire
+
+                        agg = Aggregator(
+                            coord.engines[0],
+                            request.aggs,
+                            handles=snapshots[shard_idx],
+                            index_name=coord.index_name,
+                        )
+                        agg_total_i, states = agg.run_states(
+                            request.query, stats=stats, task=entry.task
+                        )
+                        agg_wires = [
+                            state_to_wire(n, s, agg._plan)
+                            for n, s in zip(request.aggs, states)
+                        ]
+                    if k == 0 and agg_total_i is not None:
+                        # Agg-only request: the agg program already
+                        # counted this shard's total; no hits pass (the
+                        # synchronous coordinator skips the scatter too).
+                        reduce.add_part(
+                            shard_idx, agg_total_i, None, [],
+                            agg_wires=agg_wires,
+                        )
+                    elif not coord._shard_can_match(
+                        shard_request, shard_idx, snapshots
+                    ):
+                        # can_match pre-filter skips the hits pass only;
+                        # the agg contribution above still folds (bucket
+                        # and `global` agg math must see every shard).
+                        reduce.add_skipped(
+                            shard_idx,
+                            total=agg_total_i or 0,
+                            agg_wires=agg_wires,
+                        )
+                    else:
+                        resp = coord.services[shard_idx].search(
+                            shard_request,
+                            stats=stats,
+                            segments=snapshots[shard_idx],
+                            task=entry.task,
+                            record_filter_usage=False,
+                            fc_entries=fc_entries,
+                        )
+                        part_total = (
+                            agg_total_i
+                            if agg_total_i is not None
+                            else resp.total
+                        )
+                        keyed = [
+                            (
+                                sort_merge_key(request, h.score, h.sort),
+                                rank,
+                                h.to_json(coord.index_name),
+                            )
+                            for rank, h in enumerate(resp.hits)
+                        ]
+                        reduce.add_part(
+                            shard_idx,
+                            part_total,
+                            resp.max_score,
+                            keyed,
+                            agg_wires=agg_wires,
+                            timed_out=resp.timed_out,
+                        )
+                except (ValueError, TypeError, TaskCancelledError):
+                    raise
+                except Exception as e:
+                    # Degraded-mode contract: a failed shard becomes a
+                    # failures[] entry while the reduce continues.
+                    reduce.add_failure(
+                        shard_idx,
+                        coord._shard_failure_entry(shard_idx, e),
+                    )
+                self._render_and_publish(entry, wrap)
+        failures = reduce.failures()
+        if failures:
+            executed = len(coord.engines) - reduce.skipped_count()
+            if len(failures) >= executed:
+                raise _api_error(
+                    503,
+                    "search_phase_execution_exception",
+                    f"all shards failed for [{coord.index_name}]",
+                )
+            if not request.allow_partial_search_results:
+                raise _api_error(
+                    503,
+                    "search_phase_execution_exception",
+                    f"[{coord.index_name}] {len(failures)} of "
+                    f"{len(coord.engines)} shards failed and "
+                    f"allow_partial_search_results is false",
+                )
+        return wrap()

@@ -50,6 +50,7 @@ from ..common.tasks import TaskCancelledError
 from ..faults import fault_point
 from ..obs.metrics import OCCUPANCY_BUCKETS, QUEUE_WAIT_MS_BUCKETS
 from ..obs.tracing import TRACER
+from .qos import DEFAULT_LANE
 
 # Errors that must surface verbatim, never trigger an individual retry:
 # cancellations honor the cancel contract; ValueError/TypeError are
@@ -123,6 +124,9 @@ class _Pending:
     result: object = None
     error: Exception | None = None
     queue_wait_s: float = 0.0
+    # Tenant lane this rider is attributed to (QoS accounting, weighted
+    # shedding and DRR drain all key on it).
+    lane: str = DEFAULT_LANE
     # Failed while riding a coalesced launch: the CALLER thread runs one
     # individual retry on the per-request path (keeping the scheduler
     # thread free for other groups).
@@ -148,7 +152,15 @@ class MicroBatcher:
         max_batch: int = 64,
         queue_limit: int = 256,
         metrics=None,
+        qos=None,
     ):
+        # Optional per-tenant QoS controller (exec/qos.QosController).
+        # When present: ready groups drain by weighted deficit-round-
+        # robin instead of strict earliest-due, a full queue sheds the
+        # most over-quota lane's newest rider first, Retry-After comes
+        # from the shed lane's own windowed wait p50, and each rider's
+        # share of the observed launch wall is charged to its lane.
+        self.qos = qos
         if max_wait_s is None:
             max_wait_s = (
                 float(os.environ.get("ESTPU_EXEC_BATCH_WAIT_MS", 4.0)) / 1e3
@@ -277,13 +289,25 @@ class MicroBatcher:
 
     # ------------------------------------------------------------- public
 
-    def execute(self, searcher, request, task=None, group_key=()) -> object:
+    def execute(
+        self, searcher, request, task=None, group_key=(), tenant_key=None
+    ) -> object:
         """Run one search through the batching queue (blocking).
+
+        `tenant_key` attributes the request to a QoS lane (the REST
+        layer threads the `X-Opaque-Id` header here); riders without
+        one fall back to the request's own `lane_key` (packed wrappers
+        carry it) and then to the shared `_default` lane.
 
         Returns the SearchResponse; raises the search's own error
         (including TaskCancelledError for a queue-cancelled task and
         IndexingPressureRejected when load is shed)."""
         self._ensure_thread()
+        lane_key = (
+            tenant_key
+            or getattr(request, "lane_key", None)
+            or DEFAULT_LANE
+        )
         group = (id(searcher), group_key)
         now = time.monotonic()
         with self._cv:
@@ -303,18 +327,29 @@ class MicroBatcher:
                 self._quarantine_hits_c.inc()
         if quarantined:
             return searcher.search(request, task=task)
+        victim: _Pending | None = None
         with self._cv:
             depth = sum(len(q) for q in self._queues.values())
             if depth >= self.queue_limit:
-                self._shed.inc()
-                self._shed_recent.inc()
-                err = IndexingPressureRejected(
-                    f"rejected execution of search: exec batch queue is "
-                    f"full [queued={depth}, limit={self.queue_limit}]"
-                )
-                # Back-off hint for the REST layer's Retry-After header.
-                err.retry_after_s = self._retry_after_locked(depth)
-                raise err
+                # Weighted shedding: before 429ing the arrival, see if a
+                # strictly more over-quota lane has a queued rider — the
+                # flooding tenant absorbs its own backpressure first.
+                victim = self._pick_shed_victim_locked(lane_key)
+                if victim is None:
+                    self._shed.inc()
+                    self._shed_recent.inc()
+                    retry_after = self._retry_after_locked(depth, lane_key)
+                    message = (
+                        f"rejected execution of search: exec batch queue is "
+                        f"full [queued={depth}, limit={self.queue_limit}]"
+                    )
+                    if self.qos is not None:
+                        raise self.qos.shed(lane_key, message, retry_after)
+                    err = IndexingPressureRejected(message)
+                    # Back-off hint for the REST layer's Retry-After
+                    # header.
+                    err.retry_after_s = retry_after
+                    raise err
             queue = self._queues.setdefault(group, deque())
             # Idle groups launch immediately; a group with work in flight
             # (or already queued) opens the continuous-batching window so
@@ -332,12 +367,26 @@ class MicroBatcher:
                 group=group,
                 enqueued_at=now,
                 launch_at=launch_at,
+                lane=lane_key,
                 trace_ctx=TRACER.context(),
             )
             if task is not None:
                 task.span_name = "batcher.queue"
             queue.append(item)
             self._cv.notify_all()
+        if victim is not None:
+            # Wake the evicted rider outside the lock; its execute()
+            # raises the 429 built by _pick_shed_victim_locked.
+            TRACER.record(
+                victim.trace_ctx,
+                "batcher.queue",
+                victim.enqueued_at,
+                time.monotonic(),
+                status="error",
+                shed=True,
+                lane=victim.lane,
+            )
+            victim.event.set()
         if task is not None:
             task.add_cancel_listener(lambda: self._cancel_queued(item))
         self._await(item)
@@ -396,10 +445,17 @@ class MicroBatcher:
         while len(self._group_stats) > self._GROUP_STATS_MAX:
             self._group_stats.popitem(last=False)
 
-    def _retry_after_locked(self, depth: int) -> int:
+    def _retry_after_locked(
+        self, depth: int, lane_key: str | None = None
+    ) -> int:
         """Retry-After seconds for a shed request: the observed queue-wait
         p50 scaled by how many batches deep the queue is — an honest
-        drain-time estimate, clamped to [1, 30]s. Caller holds _cv."""
+        drain-time estimate, clamped to [1, 30]s. Caller holds _cv.
+
+        With QoS attached the p50 comes from the SHED LANE's own windowed
+        waits (global p50 only as the cold-lane fallback): a throttled
+        heavy tenant's long waits must not inflate the backoff advertised
+        to everyone else."""
         if self._wait_samples:
             p50_s = float(
                 np.percentile(
@@ -408,8 +464,66 @@ class MicroBatcher:
             )
         else:
             p50_s = self.max_wait_s
+        if self.qos is not None and lane_key is not None:
+            return self.qos.retry_after_s(
+                lane_key,
+                depth=depth,
+                max_batch=self.max_batch,
+                fallback_p50_s=p50_s,
+            )
         estimate = p50_s * (1.0 + depth / self.max_batch)
         return int(min(30, max(1, math.ceil(estimate))))
+
+    def _pick_shed_victim_locked(self, arriving_lane: str):
+        """Weighted shedding: when the queue is full, evict the NEWEST
+        queued rider of the most over-quota lane — but only a lane
+        STRICTLY more over-quota than the arrival's (otherwise the
+        arrival itself is the right victim and the caller sheds it).
+        Caller holds _cv; returns the claimed/errored victim (caller
+        fires its event outside the lock) or None."""
+        if self.qos is None:
+            return None
+        lanes = set()
+        for q in self._queues.values():
+            for it in q:
+                if not it.claimed:
+                    lanes.add(it.lane)
+        if not lanes:
+            return None
+        victim_lane = self.qos.pick_shed_lane(
+            sorted(lanes), arriving=arriving_lane
+        )
+        if victim_lane is None:
+            return None
+        victim = None
+        for q in self._queues.values():
+            for it in reversed(q):
+                if not it.claimed and it.lane == victim_lane:
+                    if victim is None or it.enqueued_at > victim.enqueued_at:
+                        victim = it
+                    break
+        if victim is None:
+            return None
+        victim.claimed = True
+        queue = self._queues.get(victim.group)
+        if queue is not None:
+            try:
+                queue.remove(victim)
+            except ValueError:
+                pass
+            if not queue:
+                self._queues.pop(victim.group, None)
+        depth = sum(len(q) for q in self._queues.values())
+        self._shed.inc()
+        self._shed_recent.inc()
+        victim.error = self.qos.shed(
+            victim_lane,
+            f"rejected execution of search: exec batch queue is full "
+            f"[queued={depth}, limit={self.queue_limit}] (weighted shed: "
+            f"lane [{victim_lane}] over quota)",
+            self._retry_after_locked(depth, victim_lane),
+        )
+        return victim
 
     def stats(self) -> dict:
         with self._cv:
@@ -528,14 +642,29 @@ class MicroBatcher:
                 if self._closed:
                     return
                 now = time.monotonic()
-                best_due = None
+                ready_groups: list[tuple] = []  # (group, due, lane)
                 for g, q in self._queues.items():
                     if not q:
                         continue
                     due = min(it.launch_at for it in q)
-                    ready = len(q) >= self.max_batch or due <= now
-                    if ready and (best_due is None or due < best_due):
-                        best_due, group = due, g
+                    if len(q) >= self.max_batch or due <= now:
+                        lane = next(
+                            (it.lane for it in q if not it.claimed), None
+                        )
+                        ready_groups.append((g, due, lane))
+                if len(ready_groups) == 1:
+                    group = ready_groups[0][0]
+                elif ready_groups:
+                    if self.qos is not None:
+                        # Weighted deficit-round-robin: the lane that
+                        # spent the most observed launch ms waits while
+                        # lighter lanes' groups drain first.
+                        group = self.qos.drr_pick(ready_groups)
+                    else:
+                        best_due = None
+                        for g, due, _lane in ready_groups:
+                            if best_due is None or due < best_due:
+                                best_due, group = due, g
                 if group is None:
                     soonest = min(
                         min(it.launch_at for it in q)
@@ -639,6 +768,14 @@ class MicroBatcher:
                 results = [e] * len(live)
             launch_t1 = time.monotonic()
             self._launch_exec_ms.observe((launch_t1 - launch_t0) * 1e3)
+            if self.qos is not None:
+                # Windowed cost accounting: each rider's lane pays an
+                # equal share of the OBSERVED launch wall (the same
+                # wall estpu_launch_ms{phase="execute"} records) — the
+                # signal DRR deficits and shed-victim choice run on.
+                share_ms = (launch_t1 - launch_t0) * 1e3 / max(1, len(live))
+                for it in live:
+                    self.qos.charge(it.lane, share_ms)
             for item, result in zip(live, results):
                 failed = isinstance(result, Exception)
                 # The coalesced-launch span, shared across batchmates: the
@@ -723,3 +860,7 @@ class MicroBatcher:
                 self._queue_wait_hist.observe(item.queue_wait_s * 1e3)
                 self._queue_wait_recent.record(item.queue_wait_s * 1e3)
                 self._launch_queue_ms.observe(item.queue_wait_s * 1e3)
+                if self.qos is not None:
+                    # Per-lane windowed wait — the fairness arc's gate
+                    # (and the lane's own Retry-After source).
+                    self.qos.note_queue_wait(item.lane, item.queue_wait_s)

@@ -101,15 +101,19 @@ def packed_query_eligible(query: Query, mappings) -> bool:
 class TenantSearch:
     """One rider of the shared packed group: (index service, request).
 
-    The micro-batcher treats requests opaquely; `tenant_key` is the one
-    attribute it reads (per-group coalesced-tenant telemetry)."""
+    The micro-batcher treats requests opaquely; `tenant_key` is read for
+    per-group coalesced-tenant telemetry (always the index name), and
+    `lane_key` carries the request's QoS lane (the caller's tenant
+    attribution — e.g. its `X-Opaque-Id`) through the packed wrapper so
+    fairness accounting survives the indirection."""
 
-    __slots__ = ("svc", "request", "tenant_key")
+    __slots__ = ("svc", "request", "tenant_key", "lane_key")
 
-    def __init__(self, svc, request):
+    def __init__(self, svc, request, lane_key=None):
         self.svc = svc
         self.request = request
         self.tenant_key = svc.name
+        self.lane_key = lane_key
 
 
 class _Unpackable(Exception):
@@ -218,8 +222,8 @@ class PackedExecutor:
             return False
         return packed_query_eligible(request.query, svc.mappings)
 
-    def wrap(self, svc, request) -> TenantSearch:
-        return TenantSearch(svc, request)
+    def wrap(self, svc, request, lane_key=None) -> TenantSearch:
+        return TenantSearch(svc, request, lane_key=lane_key)
 
     # ---------------------------------------------- searcher facade (batcher)
 

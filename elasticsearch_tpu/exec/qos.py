@@ -1,0 +1,539 @@
+"""Per-tenant QoS: weighted admission lanes for the search serving path.
+
+ISSUE 17's fairness half. Every search carries a tenant lane key (the
+REST layer threads `X-Opaque-Id` — or the `ESTPU_QOS_HEADER` override —
+into the serving path; requests without one share the `_default` lane),
+and the QoS controller turns the old single-FIFO/global-429 admission
+into weighted lanes:
+
+- **windowed cost accounting** — each lane accumulates OBSERVED execution
+  milliseconds (the micro-batcher charges its riders from the same
+  launch wall-clock `estpu_launch_ms{phase="execute"}` observes; the
+  non-batched paths charge their measured execution wall), pruned to a
+  rolling window. Cost is measured, never guessed from request shape.
+- **weighted deficit-round-robin drain** — the micro-batcher asks
+  `drr_pick` which ready group launches next: lanes earn credit in
+  proportion to their weight and pay observed launch cost, so a flood of
+  heavy requests on one lane cannot starve light lanes' point queries.
+- **lane-quota admission** — the non-batched execution paths (deep aggs,
+  replicated scatter — the requests the batcher never sees) pass through
+  `admit()`: a global inflight budget that binds ONLY under contention,
+  split per-lane in proportion to weight. An over-quota lane waits; a
+  wait past the admission deadline sheds THAT lane with a 429 whose
+  Retry-After comes from the lane's own windowed queue-wait p50.
+- **weighted shedding** — when the batch queue is full, the most
+  over-quota lane's newest rider is evicted first (`pick_shed_lane`),
+  so the flooding tenant absorbs its own backpressure while everyone
+  else stays green.
+
+Per-lane rolling windows land in the metrics registry
+(`estpu_qos_queue_wait_recent_ms{lane=}` / `estpu_qos_shed_recent{lane=}`
+/ `estpu_qos_lane_cost_recent_ms{lane=}`) — the fairness arc's assertion
+surface — and the lane table is LRU-bounded so tenant cardinality cannot
+grow the registry without bound.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from ..common.indexing_pressure import IndexingPressureRejected
+from ..faults import fault_point
+
+# Requests without a tenant attribution share one lane.
+DEFAULT_LANE = "_default"
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def parse_weights(spec: str | None) -> dict[str, float]:
+    """`"tenantA:4,tenantB:0.5"` -> {"tenantA": 4.0, "tenantB": 0.5}."""
+    out: dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        key, _, raw = part.rpartition(":")
+        key = key.strip()
+        try:
+            weight = float(raw)
+        except ValueError:
+            continue
+        if key and weight > 0:
+            out[key] = weight
+    return out
+
+
+class _Lane:
+    """One tenant's admission lane. All mutation under QosController._cv."""
+
+    __slots__ = (
+        "key",
+        "weight",
+        "deficit",
+        "inflight",
+        "waiting",
+        "cost_events",
+        "wait_events",
+        "shed_count",
+        "admitted",
+        "last_used",
+    )
+
+    def __init__(self, key: str, weight: float):
+        self.key = key
+        self.weight = weight
+        self.deficit = 0.0  # DRR credit, milliseconds
+        self.inflight = 0  # admit() slots currently held
+        self.waiting = 0  # admit() callers blocked on the budget
+        self.cost_events: deque[tuple[float, float]] = deque()  # (t, ms)
+        self.wait_events: deque[tuple[float, float]] = deque()  # (t, s)
+        self.shed_count = 0
+        self.admitted = 0
+        self.last_used = 0.0
+
+
+class _Admission:
+    """Context manager holding one admitted slot; exit charges the
+    lane with the measured execution wall (the observed cost)."""
+
+    def __init__(self, controller: "QosController", lane_key: str):
+        self._qos = controller
+        self._lane_key = lane_key
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Admission":
+        self._qos._acquire(self._lane_key)
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        elapsed_ms = (time.monotonic() - self._t0) * 1e3
+        self._qos._release(self._lane_key, elapsed_ms)
+
+
+class QosController:
+    """One node's per-tenant QoS state: lanes, quotas, DRR deficits."""
+
+    MAX_LANES = 256  # LRU bound on tracked lanes (metric cardinality)
+
+    def __init__(
+        self,
+        metrics=None,
+        inflight_budget: int | None = None,
+        admit_wait_s: float | None = None,
+        window_s: float = 60.0,
+        quantum_ms: float | None = None,
+    ):
+        if inflight_budget is None:
+            inflight_budget = int(_env_float("ESTPU_QOS_INFLIGHT", 16))
+        if admit_wait_s is None:
+            admit_wait_s = _env_float("ESTPU_QOS_ADMIT_WAIT_S", 10.0)
+        if quantum_ms is None:
+            quantum_ms = _env_float("ESTPU_QOS_QUANTUM_MS", 5.0)
+        self.inflight_budget = max(1, inflight_budget)
+        self.admit_wait_s = max(0.0, admit_wait_s)
+        self.window_s = window_s
+        self.quantum_ms = max(0.1, quantum_ms)
+        self.weights = parse_weights(os.environ.get("ESTPU_QOS_WEIGHTS"))
+        self._cv = threading.Condition()
+        self._lanes: "OrderedDict[str, _Lane]" = OrderedDict()
+        self._inflight_total = 0
+        self.metrics = metrics
+        self._shed_total = {}
+        self._shed_recent = {}
+        self._wait_recent = {}
+        self._cost_recent = {}
+        if metrics is not None:
+            metrics.gauge(
+                "estpu_qos_lanes",
+                "Tenant lanes currently tracked by the QoS controller",
+                fn=lambda: len(self._lanes),
+            )
+
+    # ------------------------------------------------------------- lanes
+
+    def set_weight(self, key: str, weight: float) -> None:
+        with self._cv:
+            self.weights[key] = max(1e-3, float(weight))
+            lane = self._lanes.get(key)
+            if lane is not None:
+                lane.weight = self.weights[key]
+
+    def _lane_locked(self, key: str) -> _Lane:
+        key = key or DEFAULT_LANE
+        lane = self._lanes.get(key)
+        if lane is None:
+            lane = _Lane(key, self.weights.get(key, 1.0))
+            self._lanes[key] = lane
+            # LRU-bound: never evict a lane holding live state.
+            while len(self._lanes) > self.MAX_LANES:
+                for old_key, old in self._lanes.items():
+                    if old.inflight == 0 and old.waiting == 0:
+                        del self._lanes[old_key]
+                        break
+                else:
+                    break
+        lane.last_used = time.monotonic()
+        self._lanes.move_to_end(key)
+        return lane
+
+    def _lane_instrument(self, cache: dict, key: str, kind: str, name: str, help_: str):
+        if self.metrics is None:
+            return None
+        inst = cache.get(key)
+        if inst is None:
+            inst = getattr(self.metrics, kind)(name, help_, lane=key)
+            cache[key] = inst
+        return inst
+
+    def _prune_locked(self, lane: _Lane, now: float) -> None:
+        horizon = now - self.window_s
+        while lane.cost_events and lane.cost_events[0][0] < horizon:
+            lane.cost_events.popleft()
+        while lane.wait_events and lane.wait_events[0][0] < horizon:
+            lane.wait_events.popleft()
+
+    # ------------------------------------------------------- accounting
+
+    def note_queue_wait(self, key: str, wait_s: float) -> None:
+        """Record one request's admission/queue wait on its lane — the
+        per-lane rolling window the fairness gate asserts on."""
+        now = time.monotonic()
+        with self._cv:
+            lane = self._lane_locked(key)
+            self._prune_locked(lane, now)
+            lane.wait_events.append((now, wait_s))
+        inst = self._lane_instrument(
+            self._wait_recent,
+            key or DEFAULT_LANE,
+            "windowed_histogram",
+            "estpu_qos_queue_wait_recent_ms",
+            "Per-lane admission + batch-queue wait over the trailing "
+            "window, ms",
+        )
+        if inst is not None:
+            inst.record(wait_s * 1e3)
+
+    def charge(self, key: str, cost_ms: float) -> None:
+        """Charge observed execution milliseconds to a lane: the windowed
+        cost that drives quotas, DRR deficits and shed-victim choice."""
+        if cost_ms < 0:
+            cost_ms = 0.0
+        now = time.monotonic()
+        with self._cv:
+            lane = self._lane_locked(key)
+            self._prune_locked(lane, now)
+            lane.cost_events.append((now, cost_ms))
+            lane.deficit -= cost_ms
+        inst = self._lane_instrument(
+            self._cost_recent,
+            key or DEFAULT_LANE,
+            "windowed_counter",
+            "estpu_qos_lane_cost_recent_ms",
+            "Per-lane observed execution cost (ms) over the trailing "
+            "window",
+        )
+        if inst is not None:
+            inst.inc(cost_ms)
+
+    def window_cost_ms(self, key: str) -> float:
+        now = time.monotonic()
+        with self._cv:
+            lane = self._lane_locked(key)
+            self._prune_locked(lane, now)
+            return float(sum(ms for _, ms in lane.cost_events))
+
+    def _over_quota_score_locked(self, lane: _Lane, now: float) -> float:
+        """Windowed cost per unit weight: the 'how much more than its
+        share has this lane consumed' ordering used by weighted shedding."""
+        self._prune_locked(lane, now)
+        cost = sum(ms for _, ms in lane.cost_events)
+        return cost / max(1e-3, lane.weight)
+
+    def lane_wait_p50_s(self, key: str) -> float | None:
+        now = time.monotonic()
+        with self._cv:
+            lane = self._lane_locked(key)
+            self._prune_locked(lane, now)
+            if not lane.wait_events:
+                return None
+            waits = np.asarray(
+                [w for _, w in lane.wait_events], dtype=np.float64
+            )
+        return float(np.percentile(waits, 50))
+
+    def retry_after_s(
+        self,
+        key: str,
+        depth: int = 0,
+        max_batch: int = 64,
+        fallback_p50_s: float = 0.004,
+    ) -> int:
+        """Retry-After seconds for a shed on THIS lane: the lane's own
+        windowed queue-wait p50 scaled by queue depth, clamped [1, 30]s.
+        A throttled heavy tenant's waits no longer inflate the backoff
+        advertised to everyone else (ISSUE 17 satellite)."""
+        p50_s = self.lane_wait_p50_s(key)
+        if p50_s is None:
+            p50_s = fallback_p50_s
+        estimate = p50_s * (1.0 + depth / max(1, max_batch))
+        return int(min(30, max(1, math.ceil(estimate))))
+
+    # ---------------------------------------------------------- shedding
+
+    def shed(
+        self, key: str, message: str, retry_after_s: int
+    ) -> IndexingPressureRejected:
+        """Account one weighted shed on a lane and build the 429 error
+        (the caller raises it, or sets it on an evicted rider)."""
+        key = key or DEFAULT_LANE
+        with self._cv:
+            lane = self._lane_locked(key)
+            lane.shed_count += 1
+        counter = self._lane_instrument(
+            self._shed_total,
+            key,
+            "counter",
+            "estpu_qos_shed_total",
+            "Requests shed with 429 by weighted per-lane shedding",
+        )
+        if counter is not None:
+            counter.inc()
+        recent = self._lane_instrument(
+            self._shed_recent,
+            key,
+            "windowed_counter",
+            "estpu_qos_shed_recent",
+            "Per-lane weighted sheds over the trailing window",
+        )
+        if recent is not None:
+            recent.inc()
+        # Injectable chaos hook (faults/registry.py `qos.shed`): arming it
+        # makes the shedding path itself misbehave (delay/error) — the
+        # "backpressure is broken" failure mode the chaos suite rehearses.
+        fault_point("qos.shed", lane=key)
+        err = IndexingPressureRejected(message)
+        err.retry_after_s = retry_after_s
+        err.lane = key
+        return err
+
+    def pick_shed_lane(
+        self, candidates, arriving: str | None = None
+    ) -> str | None:
+        """Among `candidates` (lane keys with queued work), the most
+        over-quota lane — but only if it is STRICTLY more over-quota than
+        the arriving lane (else the arrival itself is the right victim).
+        Returns None when no candidate should be evicted."""
+        now = time.monotonic()
+        with self._cv:
+            arriving_score = (
+                self._over_quota_score_locked(
+                    self._lane_locked(arriving), now
+                )
+                if arriving is not None
+                else float("inf")
+            )
+            best_key = None
+            best_score = arriving_score
+            for key in candidates:
+                lane = self._lane_locked(key)
+                score = self._over_quota_score_locked(lane, now)
+                if score > best_score:
+                    best_key, best_score = key, score
+            return best_key
+
+    # --------------------------------------------------------- admission
+
+    def admit(self, key: str | None) -> _Admission:
+        """Admission gate for the non-batched execution paths. Usage:
+        `with qos.admit(lane): run the search`. Binds only under
+        contention (global inflight below budget admits immediately);
+        raises IndexingPressureRejected past the admission deadline."""
+        return _Admission(self, key or DEFAULT_LANE)
+
+    def _quota_locked(self, lane: _Lane) -> int:
+        """This lane's slot quota under contention: its weight share of
+        the global budget over the lanes currently holding or awaiting
+        slots. Always at least 1 — contention can slow a lane down, never
+        lock it out entirely."""
+        total_weight = lane.weight
+        for other in self._lanes.values():
+            if other is lane:
+                continue
+            if other.inflight > 0 or other.waiting > 0:
+                total_weight += other.weight
+        share = self.inflight_budget * lane.weight / max(1e-3, total_weight)
+        return max(1, int(share))
+
+    def _acquire(self, key: str) -> None:
+        t0 = time.monotonic()
+        deadline = t0 + self.admit_wait_s
+        with self._cv:
+            lane = self._lane_locked(key)
+            while True:
+                # The global budget is a HARD ceiling; under it, the lane
+                # quota decides who gets the slot. Work-conserving: an
+                # over-quota lane may still take a free slot when no
+                # other lane wants it (weights bind under contention,
+                # never idle the device).
+                others_waiting = any(
+                    ln.waiting > 0
+                    for k2, ln in self._lanes.items()
+                    if k2 != key
+                )
+                if self._inflight_total < self.inflight_budget and (
+                    lane.inflight < self._quota_locked(lane)
+                    or not others_waiting
+                ):
+                    lane.inflight += 1
+                    lane.admitted += 1
+                    self._inflight_total += 1
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    depth = sum(
+                        ln.waiting for ln in self._lanes.values()
+                    )
+                    raise self._shed_locked_exit(key, depth)
+                lane.waiting += 1
+                try:
+                    self._cv.wait(timeout=min(remaining, 0.25))
+                finally:
+                    lane.waiting -= 1
+        self.note_queue_wait(key, time.monotonic() - t0)
+
+    def _shed_locked_exit(self, key: str, depth: int):
+        # Build the shed error outside the condition lock (shed() takes
+        # it again); the caller raises the return value.
+        self._cv.release()
+        try:
+            err = self.shed(
+                key,
+                f"rejected execution of search: lane [{key}] is over its "
+                f"admission quota [budget={self.inflight_budget}, "
+                f"waiting={depth}]",
+                self.retry_after_s(key, depth=depth),
+            )
+        finally:
+            self._cv.acquire()
+        return err
+
+    def _release(self, key: str, elapsed_ms: float) -> None:
+        with self._cv:
+            lane = self._lane_locked(key)
+            lane.inflight = max(0, lane.inflight - 1)
+            self._inflight_total = max(0, self._inflight_total - 1)
+            self._cv.notify_all()
+        self.charge(key, elapsed_ms)
+
+    # --------------------------------------------------------------- DRR
+
+    def drr_pick(self, candidates: list[tuple]) -> object:
+        """Weighted deficit-round-robin group selection for the
+        micro-batcher. `candidates`: [(group, due, lane_key)] for every
+        ready group. A group launches when its lane's deficit is
+        non-negative; lanes earn weight-proportional quanta until one
+        qualifies, so a lane that spent heavily (observed launch ms,
+        charged by the batcher) waits while light lanes drain first —
+        but never starves: credit always accrues."""
+        if len(candidates) == 1:
+            return candidates[0][0]
+
+        def _earliest(cands):
+            # Group keys are opaque (possibly non-comparable tuples):
+            # order on due alone, first-listed wins ties.
+            best_group, best_due = None, None
+            for group, due, _key in cands:
+                if best_due is None or due < best_due:
+                    best_group, best_due = group, due
+            return best_group
+
+        with self._cv:
+            lanes = {}
+            for _group, _due, key in candidates:
+                lanes[key or DEFAULT_LANE] = self._lane_locked(key)
+            cap = self.quantum_ms * 64.0
+            for _round in range(64):
+                eligible = [
+                    (group, due, key)
+                    for group, due, key in candidates
+                    if lanes[key or DEFAULT_LANE].deficit >= 0.0
+                ]
+                if eligible:
+                    return _earliest(eligible)
+                for lane in lanes.values():
+                    lane.deficit = min(
+                        cap, lane.deficit + self.quantum_ms * lane.weight
+                    )
+        # Pathological deficits (e.g. one huge launch charged to every
+        # lane): fall back to earliest-due rather than spin.
+        return _earliest(candidates)
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        now = time.monotonic()
+        with self._cv:
+            lanes = {}
+            for key, lane in self._lanes.items():
+                self._prune_locked(lane, now)
+                lanes[key] = {
+                    "weight": lane.weight,
+                    "inflight": lane.inflight,
+                    "admitted": lane.admitted,
+                    "shed": lane.shed_count,
+                    "window_cost_ms": round(
+                        sum(ms for _, ms in lane.cost_events), 3
+                    ),
+                    "window_requests": len(lane.wait_events),
+                }
+            return {
+                "inflight_budget": self.inflight_budget,
+                "inflight": self._inflight_total,
+                "lanes": lanes,
+            }
+
+    def health_inputs(self) -> dict:
+        """The exec_saturation indicator's per-tenant section: recent
+        shed counts and queue-wait p99 per lane (top offenders only, so
+        the wire section stays bounded)."""
+        now = time.monotonic()
+        shed_by_lane: dict[str, int] = {}
+        wait_p99: dict[str, float] = {}
+        with self._cv:
+            for key, lane in self._lanes.items():
+                self._prune_locked(lane, now)
+                if lane.wait_events:
+                    waits = np.asarray(
+                        [w for _, w in lane.wait_events], dtype=np.float64
+                    )
+                    wait_p99[key] = round(
+                        float(np.percentile(waits, 99)) * 1e3, 3
+                    )
+        if self.metrics is not None:
+            for key, inst in self._shed_recent.items():
+                n = int(inst.count())
+                if n:
+                    shed_by_lane[key] = n
+        top_shed = dict(
+            sorted(shed_by_lane.items(), key=lambda kv: -kv[1])[:5]
+        )
+        return {
+            "lanes": len(self._lanes),
+            "shed_recent_by_lane": top_shed,
+            "queue_wait_p99_ms_by_lane": dict(
+                sorted(wait_p99.items(), key=lambda kv: -kv[1])[:5]
+            ),
+        }

@@ -38,6 +38,13 @@ Sites currently threaded (fnmatch patterns match against these names):
                                 the drain begins — arm a delay to rehearse
                                 a slow drain racing the SIGTERM timeout
     breaker.reserve             HBM breaker reservation (common/breaker.py)
+    async.reduce                one shard's fold into an async search's
+                                progressive reduce (exec/async_search.py):
+                                arm it to degrade stored searches into
+                                honest partial failures mid-reduce
+    qos.shed                    a per-tenant QoS lane rejecting a request
+                                (exec/qos.py): arm a delay to rehearse
+                                slow-shed backpressure
 
 Configuration is per-site: error rate, error class (internal | transport |
 breaker), injected latency, a count budget, and a seed. Specs arm via the
@@ -82,6 +89,8 @@ SITES = (
     "transport.handshake",
     "transport.drain",
     "breaker.reserve",
+    "async.reduce",
+    "qos.shed",
 )
 
 

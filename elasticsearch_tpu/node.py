@@ -408,14 +408,22 @@ class Node:
         # searches into one padded device launch. ESTPU_EXEC_PLANNER=0 /
         # ESTPU_EXEC_BATCHER=0 opt out.
         from .exec import ExecPlanner, MicroBatcher, PackedExecutor
+        from .exec.qos import QosController
 
         self.exec_planner = (
             ExecPlanner(metrics=self.metrics)
             if os.environ.get("ESTPU_EXEC_PLANNER", "1") != "0"
             else None
         )
+        # Per-tenant QoS (exec/qos.py): weighted admission lanes keyed by
+        # X-Opaque-Id (ESTPU_QOS_HEADER). The batcher drains lanes by
+        # deficit-round-robin and sheds the over-quota lane first; the
+        # non-batched paths (replicated, direct) admit through the same
+        # controller, so one flooding tenant meets the same ceiling
+        # everywhere.
+        self.qos = QosController(metrics=self.metrics)
         self.exec_batcher = (
-            MicroBatcher(metrics=self.metrics)
+            MicroBatcher(metrics=self.metrics, qos=self.qos)
             if os.environ.get("ESTPU_EXEC_BATCHER", "1") != "0"
             else None
         )
@@ -435,6 +443,12 @@ class Node:
             and os.environ.get("ESTPU_EXEC_PACKED", "1") != "0"
             else None
         )
+        # Async search (exec/async_search.py): the bounded store behind
+        # POST /{index}/_async_search — registered tasks whose per-shard
+        # results reduce progressively into queryable partials.
+        from .exec.async_search import AsyncSearchService
+
+        self.async_search = AsyncSearchService(self)
         if self.replication is not None:
             # Re-home the gateway's counters onto this node's registry
             # (still zero at this point) so `GET /_metrics` exposes them.
@@ -1820,6 +1834,7 @@ class Node:
         request_cache: bool | None = None,
         timeout_s: float | None = None,
         allow_partial: bool | None = None,
+        tenant: str | None = None,
     ) -> dict:
         # Every search runs inside a span: a child of the REST root when
         # dispatched over HTTP, a fresh root trace when called directly —
@@ -1832,7 +1847,31 @@ class Node:
                 request_cache=request_cache,
                 timeout_s=timeout_s,
                 allow_partial=allow_partial,
+                tenant=tenant,
             )
+
+    def async_search_submit(
+        self,
+        index: str,
+        body: dict[str, Any] | None,
+        params: dict[str, Any] | None = None,
+        tenant: str | None = None,
+    ) -> dict:
+        """POST /{index}/_async_search: register a stored progressive
+        search, wait up to wait_for_completion_timeout, return the
+        {id?, is_partial, is_running, response} envelope."""
+        with TRACER.span("async_search", root=True, index=index):
+            return self.async_search.submit(
+                index, body, params=params, tenant=tenant
+            )
+
+    def async_search_get(
+        self, id_: str, params: dict[str, Any] | None = None
+    ) -> dict:
+        return self.async_search.get(id_, params=params)
+
+    def async_search_delete(self, id_: str) -> dict:
+        return self.async_search.delete(id_)
 
     def _search_inner(
         self,
@@ -1842,7 +1881,11 @@ class Node:
         request_cache: bool | None = None,
         timeout_s: float | None = None,
         allow_partial: bool | None = None,
+        tenant: str | None = None,
     ) -> dict:
+        from .exec.qos import DEFAULT_LANE
+
+        lane = tenant or DEFAULT_LANE
         search_t0 = time.monotonic()
         if allow_partial is not None:
             # ?allow_partial_search_results= on the URL wins over the body
@@ -1881,7 +1924,21 @@ class Node:
         if body:
             body = self.resolve_script_refs(body)
         if self.replication is not None:
-            out = self._replicated_search(svc, body, scroll)
+            # The replicated path never rides the micro-batcher, so its
+            # QoS admission happens here: a flooding tenant queues (then
+            # 429s) at the same per-lane quota the batched paths enforce.
+            try:
+                with self.qos.admit(lane):
+                    out = self._replicated_search(svc, body, scroll)
+            except IndexingPressureRejected as e:
+                headers = {}
+                retry_after = getattr(e, "retry_after_s", None)
+                if retry_after is not None:
+                    headers["Retry-After"] = str(int(retry_after))
+                raise ApiError(
+                    429, "es_rejected_execution_exception", str(e),
+                    headers=headers,
+                ) from None
             # Replicated searches slowlog too (no per-phase breakdown:
             # the cluster path reports one end-to-end took).
             self._log_slow_search(
@@ -1896,6 +1953,7 @@ class Node:
                 shards=out.get("_shards"),
                 trace_id=TRACER.current_trace_id(),
                 source=body,
+                tenant=lane,
             )
             return out
         if self._scrolls:
@@ -1974,6 +2032,7 @@ class Node:
                             "_knn", svc.name, knn.field, knn.k,
                             knn.num_candidates, knn.nprobe,
                         ),
+                        tenant_key=lane,
                     )
                 elif self._batchable(svc, request, body):
                     from .exec.planner import ast_signature
@@ -1988,12 +2047,13 @@ class Node:
                         # packed launch (per-tenant results unchanged).
                         response = self.exec_batcher.execute(
                             self.packed_exec,
-                            self.packed_exec.wrap(svc, request),
+                            self.packed_exec.wrap(svc, request, lane_key=lane),
                             task=task,
                             group_key=(
                                 "_packed",
                                 ast_signature(request.query),
                             ),
+                            tenant_key=lane,
                         )
                     else:
                         response = self.exec_batcher.execute(
@@ -2004,9 +2064,15 @@ class Node:
                                 svc.name,
                                 ast_signature(request.query),
                             ),
+                            tenant_key=lane,
                         )
                 else:
-                    response = svc.search.search(request, task=task)
+                    # Non-batchable local shapes (aggs, sorts, scripted
+                    # scoring...) admit through the QoS controller
+                    # directly — the shed raises IndexingPressureRejected
+                    # into the same 429 mapping below.
+                    with self.qos.admit(lane):
+                        response = svc.search.search(request, task=task)
             finally:
                 self.tasks.unregister(task)
         except TaskCancelledError as e:
@@ -2062,6 +2128,7 @@ class Node:
             trace_id=TRACER.current_trace_id(),
             phases=getattr(response, "phases", None),
             source=body,
+            tenant=lane,
         )
         if request.profile and "profile" in out:
             # The ES profile-API analog of a trace dump: `profile: true`
@@ -3925,6 +3992,9 @@ class Node:
                 if self.exec_batcher is not None
                 else {"enabled": False}
             ),
+            # Per-lane QoS windows: exec_saturation names the top shed
+            # tenants from these instead of a bare node-wide count.
+            "qos": self.qos.health_inputs(),
             "step_errors": 0,
         }
         out.update(self._recent_windows())
@@ -4526,6 +4596,12 @@ class Node:
                     if self.packed_exec is not None
                     else {"enabled": False}
                 ),
+                # Per-tenant QoS lanes: weights, inflight, windowed cost
+                # and shed counts per lane (estpu_qos_* views).
+                "qos": self.qos.stats(),
+                # Async-search store: stored/running entries, partials
+                # served, keep_alive expiries (estpu_async_* views).
+                "async_search": self.async_search.stats(),
             },
             # Fault-injection registry (POST /_fault) and degraded-mode
             # serving counters: partial responses, absorbed shard

@@ -677,23 +677,44 @@ def indicator_exec_saturation(ctx: HealthContext) -> dict[str, Any]:
             "quarantined_now": quarantined,
             "queued_now": batcher.get("queued", 0),
         }
+        # Per-tenant QoS attribution: when weighted shedding engages,
+        # NAME the over-quota lanes — "who is being turned away" is the
+        # question the operator actually asks.
+        qos = inputs.get("qos") or {}
+        shed_by_lane = qos.get("shed_recent_by_lane") or {}
+        if shed_by_lane:
+            node_detail["shed_recent_by_lane"] = shed_by_lane
+        lane_p99 = qos.get("queue_wait_p99_ms_by_lane") or {}
+        if lane_p99:
+            node_detail["queue_wait_p99_ms_by_lane"] = lane_p99
+        top_shed = ", ".join(
+            f"[{lane}]={int(n)}" for lane, n in list(shed_by_lane.items())[:3]
+        )
         details["nodes"][node_id] = node_detail
         if shed_recent >= SHED_RED:
             status = "red"
             symptoms.append(
                 f"[{node_id}] shed {shed_recent} searches with 429 in "
                 "the trailing window"
+                + (f" (top shed tenants: {top_shed})" if top_shed else "")
             )
             diagnosis.append(
                 {
                     "cause": (
                         f"the batch queue on [{node_id}] is full and "
                         "shedding load at a sustained rate"
+                        + (
+                            f"; weighted shedding is rejecting "
+                            f"over-quota tenants {top_shed}"
+                            if top_shed
+                            else ""
+                        )
                     ),
                     "action": (
                         "add serving capacity, raise the queue limit, "
-                        "or shed at the client with the Retry-After "
-                        "hints"
+                        "throttle the named tenants (ESTPU_QOS_WEIGHTS "
+                        "re-weights their lanes), or shed at the client "
+                        "with the Retry-After hints"
                     ),
                 }
             )
@@ -701,6 +722,7 @@ def indicator_exec_saturation(ctx: HealthContext) -> dict[str, Any]:
             status = worst([status, "yellow"])
             symptoms.append(
                 f"[{node_id}] shed {shed_recent} search(es) recently"
+                + (f" (top shed tenants: {top_shed})" if top_shed else "")
             )
             diagnosis.append(
                 {
@@ -708,10 +730,17 @@ def indicator_exec_saturation(ctx: HealthContext) -> dict[str, Any]:
                         f"the batch queue on [{node_id}] filled and "
                         f"shed {shed_recent} request(s) in the "
                         "trailing window"
+                        + (
+                            f"; over-quota tenants: {top_shed}"
+                            if top_shed
+                            else ""
+                        )
                     ),
                     "action": (
-                        "watch estpu_exec_batcher_shed_recent; if it "
-                        "sustains, add capacity or raise queue_limit"
+                        "watch estpu_exec_batcher_shed_recent and "
+                        "estpu_qos_shed_recent; if it sustains, add "
+                        "capacity, raise queue_limit, or re-weight the "
+                        "named lanes via ESTPU_QOS_WEIGHTS"
                     ),
                 }
             )

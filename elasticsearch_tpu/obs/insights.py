@@ -57,6 +57,7 @@ class QueryInsights:
         trace_id: str | None = None,
         phases: dict | None = None,
         source: dict | None = None,
+        tenant: str | None = None,
     ) -> None:
         if self._recorded is not None:
             self._recorded.inc()
@@ -74,6 +75,10 @@ class QueryInsights:
             }
             if trace_id:
                 entry["trace_id"] = trace_id
+            if tenant is not None:
+                # QoS lane attribution: exemplars answer "WHOSE slow
+                # query" without a second lookup.
+                entry["tenant"] = tenant
             if shards:
                 entry["shards"] = {
                     k: shards[k]

@@ -911,6 +911,28 @@ CATALOG = {
     # SearchResponse.phases hook.
     "estpu_insights_recorded_total": ("counter", "obs.insights"),
     "estpu_insights_entries": ("gauge", "obs.insights"),
+    # Per-tenant QoS lanes (exec/qos.py): windowed per-lane cost/wait
+    # accounting behind weighted deficit-round-robin drain and weighted
+    # shedding; the exec_saturation indicator names tenants from these.
+    "estpu_qos_lanes": ("gauge", "exec.qos"),
+    "estpu_qos_shed_total": ("counter", "exec.qos"),
+    "estpu_qos_shed_recent": ("windowed_counter", "exec.qos"),
+    "estpu_qos_queue_wait_recent_ms": (
+        "windowed_histogram",
+        "exec.qos",
+    ),
+    "estpu_qos_lane_cost_recent_ms": ("windowed_counter", "exec.qos"),
+    # Async search (exec/async_search.py): the stored progressive-search
+    # store and its per-fold reduce timing.
+    "estpu_async_searches_total": ("counter", "exec.async_search"),
+    "estpu_async_partials_served_total": ("counter", "exec.async_search"),
+    "estpu_async_expired_total": ("counter", "exec.async_search"),
+    "estpu_async_running": ("gauge", "exec.async_search"),
+    "estpu_async_stored": ("gauge", "exec.async_search"),
+    "estpu_async_reduce_recent_ms": (
+        "windowed_histogram",
+        "exec.async_search",
+    ),
 }
 
 # Pow-2-ish bounds for the padding-waste ratio and occupancy/wait shapes.

@@ -325,8 +325,15 @@ class RestServer:
         # Wire byte length of the current request's body, per handler
         # thread (the Content-Length the socket actually carried).
         self._tl = threading.local()
+        # Per-tenant QoS lane key: the request header (X-Opaque-Id by
+        # default, ESTPU_QOS_HEADER overrides) rides thread-locally from
+        # dispatch into the search handlers; absent → the _default lane.
+        self._qos_header = os.environ.get("ESTPU_QOS_HEADER") or "X-Opaque-Id"
         self.routes: list[tuple[str, re.Pattern, Handler]] = []
         self._register_routes()
+
+    def _tenant(self) -> str | None:
+        return getattr(self._tl, "tenant", None)
 
     def close(self) -> None:
         """Stop the replication cluster (if any) and local engines."""
@@ -528,6 +535,7 @@ class RestServer:
                 "_all", _json(b), scroll=q.get("scroll"),
                 timeout_s=_timeout_param(q),
                 allow_partial=_partial_param(q),
+                tenant=s._tenant(),
             ))
             r(method, "/_count", lambda s, p, q, b: n.count(
                 n.default_index(), _json(b)
@@ -558,7 +566,15 @@ class RestServer:
                 # exec micro-batcher's queue (deadline-aware launch).
                 timeout_s=_timeout_param(q),
                 allow_partial=_partial_param(q),
+                tenant=s._tenant(),
             ))
+            # Async search (the reference's RestSubmitAsyncSearchAction):
+            # registers a stored progressive search; wait_for_completion_
+            # timeout / keep_alive / keep_on_completion ride as params.
+            r(method, "/{index}/_async_search", lambda s, p, q, b:
+                n.async_search_submit(
+                    p["index"], _json(b), params=q, tenant=s._tenant()
+                ))
             r(method, "/{index}/_count", lambda s, p, q, b: n.count(
                 p["index"], _json(b)
             ))
@@ -578,6 +594,10 @@ class RestServer:
             r(method, "/{index}/_explain/{id}", lambda s, p, q, b: n.explain(
                 p["index"], p["id"], _json(b)
             ))
+        r("GET", "/_async_search/{id}", lambda s, p, q, b:
+            n.async_search_get(p["id"], params=q))
+        r("DELETE", "/_async_search/{id}", lambda s, p, q, b:
+            n.async_search_delete(p["id"]))
         r("DELETE", "/_search/scroll", lambda s, p, q, b: n.clear_scroll(
             _json(b)
         ))
@@ -737,6 +757,11 @@ class RestServer:
         slowlogs the same way). The trace id returns as `X-Trace-Id` +
         `traceparent` response headers."""
         headers = headers or {}
+        # QoS lane key for this request, whatever dispatch path follows.
+        self._tl.tenant = (
+            headers.get(self._qos_header)
+            or headers.get(self._qos_header.lower())
+        )
         if any(path == p or path.startswith(p + "/") for p in _UNTRACED_PATHS):
             # Untraced, but still timed: the rolling per-endpoint window
             # is a few counter words, not a trace-ring slot.

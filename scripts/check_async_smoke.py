@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""CI smoke gate for async search + per-tenant QoS (ISSUE 17).
+
+Runs the stored-progressive-search and weighted-admission suites on the
+CPU backend — no TPU needed: completed `_async_search` responses
+bit-identical to the synchronous `_search`, order-invariant progressive
+reduces across random shard-completion orders, store lifecycle
+(keep_alive GC, DELETE cancellation, bounded-store 429s), and the QoS
+fairness contracts (hard inflight ceiling, weighted shed-victim choice,
+per-lane Retry-After, the in-process flood arc). The same tests ride
+the tier-1 run via the fast (`not slow`) marker; this script is the
+standalone hook for pre-merge / cron checks:
+
+    python scripts/check_async_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "tests/test_async_search.py",
+        "tests/test_qos.py",
+        "-q",
+        "-m",
+        "not slow",
+        "-p",
+        "no:cacheprovider",
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,14 @@ def arm_faults():
     fault_point("other.bad")
 
 
+def arm_async_qos_faults():
+    # New-subsystem chaos hooks must register like any other: an
+    # async-search reduce fold or a QoS lane shed that never made it
+    # into SITES fails the gate.
+    fault_point("async.reduce")
+    fault_point("qos.shed")
+
+
 def make_instruments(m):
     m.counter("estpu_good_total", "cataloged: fine")
     m.counter("estpu_rogue_total", "not in CATALOG")
@@ -107,6 +115,20 @@ def make_health_instruments(m):
     # (and a cataloged one stays clean).
     m.windowed_counter("estpu_rogue_recent", "window not in CATALOG")
     m.windowed_histogram("estpu_good_recent_ms", "cataloged: fine")
+
+
+def make_async_qos_instruments(m):
+    # Async-search store and per-tenant QoS instruments are instruments
+    # too: uncataloged estpu_async_* / estpu_qos_* registrations fail the
+    # gate exactly like any other rogue estpu_* name.
+    m.counter(
+        "estpu_async_rogue_total",
+        "async-search instrument not in CATALOG",
+    )
+    m.counter(
+        "estpu_qos_rogue_total",
+        "QoS lane instrument not in CATALOG",
+    )
 
 
 def charge_breaker(breaker, n):

@@ -1,0 +1,588 @@
+"""Async search (ISSUE 17): stored progressive searches.
+
+Contracts under test:
+- envelope shape: POST /{index}/_async_search returns
+  `{id?, is_partial, is_running, response}` after
+  wait_for_completion_timeout; completed-within-wait without
+  keep_on_completion behaves like a synchronous search (no id left to
+  GET);
+- progressive partials: while running, `response` is the exact answer
+  over the shards reduced so far (honest `_shards.successful`), and the
+  COMPLETED response is bit-identical to the synchronous `_search`
+  (ids, order, scores, agg values, shard math — `took` excluded, it
+  measures a different execution);
+- store lifecycle: keep_alive expiry GC, DELETE cancellation, the
+  bounded store 429ing only when full of still-running entries;
+- order-invariance fuzz: ProgressiveShardReduce renders bit-identically
+  under every shard-completion order, at every prefix, across
+  metric/percentile/terms agg families and field-sorted hits;
+- chaos: an armed `async.reduce` fault degrades one shard into an
+  honest failures[] entry instead of poisoning the stored search.
+"""
+
+import json
+import os
+import random
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster import LocalCluster
+from elasticsearch_tpu.exec.async_search import ProgressiveShardReduce
+from elasticsearch_tpu.faults import REGISTRY, FaultSpec
+from elasticsearch_tpu.node import ApiError, Node
+from elasticsearch_tpu.search.service import SearchRequest
+
+N_DOCS = 48
+
+
+def _fill(node, index, n_shards):
+    node.create_index(
+        index,
+        {
+            "settings": {"index": {"number_of_shards": n_shards}},
+            "mappings": {
+                "properties": {
+                    "f": {"type": "keyword"},
+                    "v": {"type": "integer"},
+                    # Dyadic-safe floats: per-shard metric folds associate
+                    # exactly, so the fuzz parity below is bit-exact.
+                    "x": {"type": "float"},
+                    "body": {"type": "text"},
+                }
+            },
+        },
+    )
+    for i in range(N_DOCS):
+        node.index_doc(
+            index,
+            {
+                "f": f"k{i % 5}",
+                "v": i,
+                "x": i * 0.25,
+                "body": f"word{i % 7} common text",
+            },
+            str(i),
+        )
+    node.refresh(index)
+
+
+def _drain_async(n):
+    """Wait for any still-running async runner threads before close."""
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if not n.tasks.list("indices:data/read/search[async]"):
+            return
+        time.sleep(0.05)
+
+
+@pytest.fixture(scope="module")
+def node():
+    # The progressive sharded tier is the host-coordinator path; under
+    # the conftest 8-device mesh a multi-shard index would otherwise be
+    # mesh-served at create time and take the solo fallback.
+    prev = os.environ.get("ESTPU_MESH_SERVING")
+    os.environ["ESTPU_MESH_SERVING"] = "0"
+    try:
+        n = Node(data_path=None)
+        _fill(n, "sh", 3)
+        _fill(n, "solo", 1)
+    finally:
+        if prev is None:
+            os.environ.pop("ESTPU_MESH_SERVING", None)
+        else:
+            os.environ["ESTPU_MESH_SERVING"] = prev
+    yield n
+    _drain_async(n)
+    n.close()
+
+
+def strip_took(resp: dict) -> dict:
+    out = dict(resp)
+    out.pop("took", None)
+    return out
+
+
+BODIES = [
+    pytest.param(
+        {"query": {"match_all": {}}, "size": 10, "sort": [{"v": "desc"}]},
+        id="field-sorted",
+    ),
+    pytest.param(
+        {"query": {"match": {"body": "word3"}}, "size": 8},
+        id="relevance",
+    ),
+    pytest.param(
+        {
+            "size": 0,
+            "aggs": {
+                "byf": {"terms": {"field": "f"}},
+                "sx": {"sum": {"field": "x"}},
+                "mx": {"max": {"field": "v"}},
+                "pv": {"percentiles": {"field": "v"}},
+            },
+        },
+        id="agg-only",
+    ),
+    pytest.param(
+        {
+            "query": {"match": {"body": "common"}},
+            "size": 5,
+            "from": 3,
+            "sort": [{"v": "asc"}],
+            "aggs": {
+                "byf": {
+                    "terms": {"field": "f"},
+                    "aggs": {"ax": {"avg": {"field": "x"}}},
+                },
+            },
+        },
+        id="paged-sorted-nested-aggs",
+    ),
+]
+
+
+class TestEnvelope:
+    def test_completed_within_wait_is_sync_shaped(self, node):
+        body = {"query": {"match_all": {}}, "size": 5}
+        sync = node.search("sh", dict(body))
+        out = node.async_search_submit(
+            "sh", dict(body), params={"wait_for_completion_timeout": "10s"}
+        )
+        # Completed inside the wait without keep_on_completion: nothing
+        # stored, no id — the sync-search degenerate case.
+        assert "id" not in out
+        assert out["is_running"] is False
+        assert out["is_partial"] is False
+        assert out["start_time_in_millis"] <= out["completion_time_in_millis"]
+        assert strip_took(out["response"]) == strip_took(sync)
+
+    def test_running_envelope_and_blocking_get(self, node, monkeypatch):
+        monkeypatch.setenv("ESTPU_ASYNC_PART_DELAY_MS", "250")
+        body = {"query": {"match_all": {}}, "size": 6, "sort": [{"v": "asc"}]}
+        sync = node.search("sh", dict(body))
+        out = node.async_search_submit(
+            "sh", dict(body), params={"wait_for_completion_timeout": "1ms"}
+        )
+        assert out["is_running"] is True
+        assert out["is_partial"] is True
+        assert "id" in out and "expiration_time_in_millis" in out
+        # The blocking poll returns the completed search.
+        got = node.async_search_get(
+            out["id"], params={"wait_for_completion_timeout": "30s"}
+        )
+        assert got["is_running"] is False
+        assert got["is_partial"] is False
+        assert strip_took(got["response"]) == strip_took(sync)
+        node.async_search_delete(out["id"])
+
+    def test_partials_are_honest_prefixes(self, node, monkeypatch):
+        monkeypatch.setenv("ESTPU_ASYNC_PART_DELAY_MS", "400")
+        body = {"query": {"match_all": {}}, "size": 6}
+        out = node.async_search_submit(
+            "sh", dict(body), params={"wait_for_completion_timeout": "60ms"}
+        )
+        assert out["is_running"] is True
+        shards = out["response"]["_shards"]
+        # A partial names how many shards it actually covers.
+        assert shards["total"] == 3
+        assert 0 <= shards["successful"] < 3
+        got = node.async_search_get(
+            out["id"], params={"wait_for_completion_timeout": "30s"}
+        )
+        assert got["response"]["_shards"]["successful"] == 3
+        node.async_search_delete(out["id"])
+
+    def test_keep_on_completion_stores_the_result(self, node):
+        body = {"query": {"match_all": {}}, "size": 3}
+        out = node.async_search_submit(
+            "sh",
+            dict(body),
+            params={
+                "wait_for_completion_timeout": "10s",
+                "keep_on_completion": "true",
+            },
+        )
+        assert "id" in out and out["is_running"] is False
+        got = node.async_search_get(out["id"])
+        assert strip_took(got["response"]) == strip_took(out["response"])
+        assert node.async_search_delete(out["id"]) == {"acknowledged": True}
+        with pytest.raises(ApiError) as err:
+            node.async_search_get(out["id"])
+        assert err.value.status == 404
+
+    def test_submit_errors_are_synchronous_400s(self, node):
+        with pytest.raises(ApiError) as err:
+            node.async_search_submit("sh", {"bogus_key": 1})
+        assert err.value.status == 400
+        with pytest.raises(ApiError) as err:
+            node.async_search_submit("missing-index", {})
+        assert err.value.status == 404
+
+
+class TestParity:
+    @pytest.mark.parametrize("body", BODIES)
+    def test_sharded_completion_bit_identical_to_sync(self, node, body):
+        sync = node.search("sh", dict(body))
+        out = node.async_search_submit(
+            "sh", dict(body), params={"wait_for_completion_timeout": "30s"}
+        )
+        assert out["is_running"] is False
+        assert strip_took(out["response"]) == strip_took(sync)
+
+    def test_solo_fallback_parity(self, node):
+        # highlight is outside the progressive tier: the solo fallback
+        # still serves it, one final part, bit-identical.
+        body = {
+            "query": {"match": {"body": "word2"}},
+            "size": 5,
+            "highlight": {"fields": {"body": {}}},
+        }
+        sync = node.search("solo", dict(body))
+        out = node.async_search_submit(
+            "solo", dict(body), params={"wait_for_completion_timeout": "30s"}
+        )
+        assert strip_took(out["response"]) == strip_took(sync)
+
+
+class TestStoreLifecycle:
+    def test_keep_alive_expiry_gc(self, node):
+        body = {"query": {"match_all": {}}, "size": 1}
+        out = node.async_search_submit(
+            "sh",
+            dict(body),
+            params={
+                "wait_for_completion_timeout": "10s",
+                "keep_on_completion": "true",
+                "keep_alive": "150ms",
+            },
+        )
+        assert "id" in out
+        time.sleep(0.3)
+        with pytest.raises(ApiError) as err:
+            node.async_search_get(out["id"])
+        assert err.value.status == 404
+
+    def test_get_extends_keep_alive(self, node):
+        body = {"query": {"match_all": {}}, "size": 1}
+        out = node.async_search_submit(
+            "sh",
+            dict(body),
+            params={
+                "wait_for_completion_timeout": "10s",
+                "keep_on_completion": "true",
+                "keep_alive": "200ms",
+            },
+        )
+        got = node.async_search_get(out["id"], params={"keep_alive": "1h"})
+        assert (
+            got["expiration_time_in_millis"]
+            > out["expiration_time_in_millis"]
+        )
+        time.sleep(0.3)  # would have expired under the original keep_alive
+        assert node.async_search_get(out["id"])["is_running"] is False
+        node.async_search_delete(out["id"])
+
+    def test_delete_cancels_a_running_search(self, node, monkeypatch):
+        monkeypatch.setenv("ESTPU_ASYNC_PART_DELAY_MS", "400")
+        out = node.async_search_submit(
+            "sh",
+            {"query": {"match_all": {}}, "size": 1},
+            params={"wait_for_completion_timeout": "40ms"},
+        )
+        assert out["is_running"] is True
+        running = node.tasks.list("indices:data/read/search[async]")
+        assert running, "the async runner must be a registered task"
+        assert node.async_search_delete(out["id"]) == {"acknowledged": True}
+        # The cancelled runner unregisters its task promptly.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if not node.tasks.list("indices:data/read/search[async]"):
+                break
+            time.sleep(0.05)
+        assert not node.tasks.list("indices:data/read/search[async]")
+
+    def test_store_full_of_running_429s(self, node, monkeypatch):
+        monkeypatch.setenv("ESTPU_ASYNC_PART_DELAY_MS", "500")
+        svc = node.async_search
+        monkeypatch.setattr(svc, "max_stored", 2)
+        ids = []
+        try:
+            for _ in range(2):
+                out = node.async_search_submit(
+                    "sh",
+                    {"query": {"match_all": {}}, "size": 1},
+                    params={"wait_for_completion_timeout": "1ms"},
+                )
+                ids.append(out["id"])
+            with pytest.raises(ApiError) as err:
+                node.async_search_submit(
+                    "sh",
+                    {"query": {"match_all": {}}, "size": 1},
+                    params={"wait_for_completion_timeout": "1ms"},
+                )
+            assert err.value.status == 429
+            assert (err.value.headers or {}).get("Retry-After")
+        finally:
+            for id_ in ids:
+                try:
+                    node.async_search_delete(id_)
+                except ApiError:
+                    pass
+
+    def test_full_store_evicts_oldest_completed(self, node, monkeypatch):
+        svc = node.async_search
+        monkeypatch.setattr(svc, "max_stored", 2)
+        params = {
+            "wait_for_completion_timeout": "10s",
+            "keep_on_completion": "true",
+        }
+        body = {"query": {"match_all": {}}, "size": 1}
+        first = node.async_search_submit("sh", dict(body), params=params)
+        second = node.async_search_submit("sh", dict(body), params=params)
+        third = node.async_search_submit("sh", dict(body), params=params)
+        # The oldest COMPLETED entry made room; the newest two remain.
+        with pytest.raises(ApiError):
+            node.async_search_get(first["id"])
+        for out in (second, third):
+            assert node.async_search_get(out["id"])["is_running"] is False
+            node.async_search_delete(out["id"])
+
+
+class TestReduceFuzz:
+    """Order-invariance: ProgressiveShardReduce must render bit-exactly
+    under EVERY shard-completion order, at every prefix."""
+
+    def _captured_parts(self, node, body):
+        """Run the real async runner and steal its reducer's per-shard
+        parts — the same keyed hits + agg wires production the serving
+        path uses."""
+        out = node.async_search_submit(
+            "sh",
+            dict(body),
+            params={
+                "wait_for_completion_timeout": "30s",
+                "keep_on_completion": "true",
+            },
+        )
+        assert out["is_running"] is False
+        entry = node.async_search._store[out["id"]]
+        reduce = entry.reduce
+        assert reduce is not None
+        parts = dict(reduce._parts)
+        skipped = dict(reduce._skipped)
+        node.async_search_delete(out["id"])
+        return out["response"], parts, skipped
+
+    def _fresh_reduce(self, node, body):
+        svc = node.indices["sh"]
+        request = SearchRequest.from_json(dict(body))
+        return ProgressiveShardReduce(
+            request,
+            from_=request.from_,
+            size=request.size,
+            n_shards=3,
+            index_name="sh",
+            mappings=svc.mappings,
+            style="coordinator",
+        )
+
+    def _feed(self, reduce, parts, skipped, order):
+        for sid in order:
+            if sid in parts:
+                total, max_score, keyed, wires, timed_out = parts[sid]
+                reduce.add_part(
+                    sid, total, max_score, keyed,
+                    agg_wires=wires, timed_out=timed_out,
+                )
+            else:
+                s_total, s_wires = skipped[sid]
+                reduce.add_skipped(sid, total=s_total, agg_wires=s_wires)
+
+    @pytest.mark.parametrize("body", BODIES)
+    def test_random_orders_and_prefixes_converge(self, node, body):
+        sync = node.search("sh", dict(body))
+        final, parts, skipped = self._captured_parts(node, body)
+        assert strip_took(final) == strip_took(sync)
+        shard_ids = sorted(set(parts) | set(skipped))
+        rng = random.Random(17)
+        for _trial in range(6):
+            order = list(shard_ids)
+            rng.shuffle(order)
+            reduce = self._fresh_reduce(node, body)
+            for i, sid in enumerate(order):
+                self._feed(reduce, parts, skipped, [sid])
+                # Every prefix must render identically to an ascending-
+                # order fold over the same subset: completion order can
+                # never leak into the partial.
+                ref = self._fresh_reduce(node, body)
+                self._feed(ref, parts, skipped, sorted(order[: i + 1]))
+                assert strip_took(reduce.render()) == strip_took(
+                    ref.render()
+                ), f"prefix {i + 1} of order {order} diverged"
+            assert strip_took(reduce.render()) == strip_took(sync)
+
+    def test_retried_shard_overwrites_its_slot(self, node):
+        body = {"query": {"match_all": {}}, "size": 10}
+        sync = node.search("sh", dict(body))
+        _final, parts, skipped = self._captured_parts(node, body)
+        reduce = self._fresh_reduce(node, body)
+        order = sorted(set(parts) | set(skipped))
+        self._feed(reduce, parts, skipped, order)
+        # A gateway retry re-delivers shard 0: idempotent overwrite.
+        self._feed(reduce, parts, skipped, [order[0]])
+        assert strip_took(reduce.render()) == strip_took(sync)
+
+
+class TestFaultDegradation:
+    def test_armed_reduce_fault_degrades_one_shard(self, node):
+        REGISTRY.put(FaultSpec(site="async.reduce", error_rate=1.0, count=1))
+        try:
+            out = node.async_search_submit(
+                "sh",
+                {"query": {"match_all": {}}, "size": 5},
+                params={"wait_for_completion_timeout": "30s"},
+            )
+        finally:
+            REGISTRY.clear()
+        shards = out["response"]["_shards"]
+        assert shards["failed"] == 1
+        assert shards["successful"] == 2
+        assert shards["failures"][0]["reason"]["type"] == "InjectedFaultError"
+
+    def test_all_shards_failed_is_an_error_envelope(self, node):
+        REGISTRY.put(FaultSpec(site="async.reduce", error_rate=1.0))
+        try:
+            out = node.async_search_submit(
+                "sh",
+                {"query": {"match_all": {}}, "size": 5},
+                params={"wait_for_completion_timeout": "30s"},
+            )
+        finally:
+            REGISTRY.clear()
+        assert out["is_partial"] is True
+        assert out["is_running"] is False
+        assert out["error"]["status"] == 503
+        assert out["error"]["type"] == "search_phase_execution_exception"
+
+
+class TestReplicatedTier:
+    @pytest.fixture(scope="class")
+    def rnode(self):
+        n = Node(data_path=None, replication=LocalCluster(3))
+        n.create_index(
+            "rep",
+            {
+                "settings": {
+                    "index": {
+                        "number_of_shards": 3,
+                        "number_of_replicas": 1,
+                    }
+                },
+                "mappings": {
+                    "properties": {
+                        "f": {"type": "keyword"},
+                        "v": {"type": "integer"},
+                    }
+                },
+            },
+        )
+        for i in range(30):
+            n.index_doc("rep", {"f": f"k{i % 4}", "v": i}, str(i))
+        n.refresh("rep")
+        yield n
+        n.close()
+
+    def test_replicated_completion_parity(self, rnode):
+        body = {
+            "query": {"match_all": {}},
+            "size": 7,
+            "sort": [{"v": "asc"}],
+            "aggs": {
+                "byf": {"terms": {"field": "f"}},
+                "mv": {"max": {"field": "v"}},
+            },
+        }
+        sync = rnode.search("rep", dict(body))
+        out = rnode.async_search_submit(
+            "rep", dict(body), params={"wait_for_completion_timeout": "30s"}
+        )
+        assert out["is_running"] is False
+        assert strip_took(out["response"]) == strip_took(sync)
+
+    def test_replicated_progressive_partials(self, rnode, monkeypatch):
+        monkeypatch.setenv("ESTPU_ASYNC_PART_DELAY_MS", "300")
+        body = {"query": {"match_all": {}}, "size": 5}
+        sync = rnode.search("rep", dict(body))
+        out = rnode.async_search_submit(
+            "rep", dict(body), params={"wait_for_completion_timeout": "50ms"}
+        )
+        assert out["is_running"] is True
+        assert out["response"]["_shards"]["total"] == 3
+        assert out["response"]["_shards"]["successful"] < 3
+        got = rnode.async_search_get(
+            out["id"], params={"wait_for_completion_timeout": "30s"}
+        )
+        assert strip_took(got["response"]) == strip_took(sync)
+        rnode.async_search_delete(out["id"])
+
+
+class TestRestApi:
+    @pytest.fixture(scope="class")
+    def rest(self):
+        from elasticsearch_tpu.rest.server import RestServer
+
+        rest = RestServer()
+        status, _ = rest.dispatch(
+            "PUT",
+            "/ridx",
+            {},
+            json.dumps(
+                {
+                    "settings": {"index": {"number_of_shards": 2}},
+                    "mappings": {
+                        "properties": {"v": {"type": "integer"}}
+                    },
+                }
+            ),
+        )
+        assert status == 200
+        for i in range(12):
+            rest.dispatch(
+                "PUT", f"/ridx/_doc/{i}", {}, json.dumps({"v": i})
+            )
+        rest.dispatch("POST", "/ridx/_refresh", {}, "")
+        yield rest
+        rest.close()
+
+    def test_rest_round_trip(self, rest):
+        body = json.dumps(
+            {"query": {"match_all": {}}, "size": 4, "sort": [{"v": "desc"}]}
+        )
+        status, sync = rest.dispatch("POST", "/ridx/_search", {}, body)
+        assert status == 200
+        status, out = rest.dispatch(
+            "POST",
+            "/ridx/_async_search",
+            {
+                "wait_for_completion_timeout": "30s",
+                "keep_on_completion": "true",
+            },
+            body,
+        )
+        assert status == 200
+        assert out["is_running"] is False
+        assert strip_took(out["response"]) == strip_took(sync)
+        status, got = rest.dispatch(
+            "GET", f"/_async_search/{out['id']}", {}, ""
+        )
+        assert status == 200
+        assert strip_took(got["response"]) == strip_took(sync)
+        status, deleted = rest.dispatch(
+            "DELETE", f"/_async_search/{out['id']}", {}, ""
+        )
+        assert status == 200 and deleted == {"acknowledged": True}
+        status, _ = rest.dispatch(
+            "GET", f"/_async_search/{out['id']}", {}, ""
+        )
+        assert status == 404

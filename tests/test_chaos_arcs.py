@@ -419,6 +419,105 @@ class TestPartition:
         )
 
 
+class TestTenantFairness:
+    """The socketed half of the ISSUE 17 fairness arc: one tenant
+    floods heavy aggregation searches through the REST front (every
+    shard hop a real TCP connection) while 100 light tenants each run a
+    cheap search — and every light lane's windowed admission-wait p99
+    (the per-lane `estpu_qos_queue_wait_recent_ms` rolling window on
+    the coordinating front) stays in budget."""
+
+    LIGHT_BUDGET_MS = 1500.0
+
+    def test_heavy_tenant_cannot_starve_light_lanes(self, topo):
+        rest, _procs = topo
+        node = rest.node
+        # Seed enough docs that the heavy aggregation does real work.
+        for i in range(40):
+            status, _ = rest.dispatch(
+                "PUT",
+                f"/{INDEX}/_doc/fair-{i}",
+                {},
+                json.dumps(
+                    {"body": f"fair doc {i}", "tag": f"t{i % 6}"}
+                ),
+            )
+            assert status in (200, 201)
+        rest.dispatch("POST", f"/{INDEX}/_refresh", {}, "")
+        heavy = json.dumps(
+            {
+                # size > 0: sidesteps the size-0 request cache so every
+                # flood request really executes over the sockets.
+                "query": {"match": {"body": "fair"}},
+                "size": 3,
+                "aggs": {"bytag": {"terms": {"field": "tag"}}},
+            }
+        )
+        light = json.dumps({"query": {"match_all": {}}, "size": 1})
+        # Pin a small admission budget so the flood actually contends
+        # for slots (the default would never saturate at this scale).
+        prev_budget = node.qos.inflight_budget
+        node.qos.inflight_budget = 4
+        stop = threading.Event()
+
+        def flood():
+            while not stop.is_set():
+                # A flooding request MAY answer 429 — that is weighted
+                # shedding doing its job; it must never starve lights.
+                rest.dispatch(
+                    "POST",
+                    f"/{INDEX}/_search",
+                    {},
+                    heavy,
+                    headers={"X-Opaque-Id": "hog"},
+                )
+
+        floods = [
+            threading.Thread(target=flood, daemon=True) for _ in range(8)
+        ]
+        try:
+            for t in floods:
+                t.start()
+            time.sleep(0.3)  # the flood is established
+            for i in range(100):
+                status, _ = rest.dispatch(
+                    "POST",
+                    f"/{INDEX}/_search",
+                    {},
+                    light,
+                    headers={"X-Opaque-Id": f"light-{i}"},
+                )
+                assert status == 200, f"light-{i} was turned away"
+        finally:
+            stop.set()
+            for t in floods:
+                t.join(timeout=15)
+            node.qos.inflight_budget = prev_budget
+        worst = 0.0
+        gated = 0
+        for i in range(100):
+            w = node.metrics.window(
+                "estpu_qos_queue_wait_recent_ms", lane=f"light-{i}"
+            )
+            if w is None:
+                continue
+            gated += 1
+            worst = max(worst, w.snapshot()["p99"])
+        assert gated == 100, "every light lane must have a wait window"
+        assert worst < self.LIGHT_BUDGET_MS, (
+            f"light-lane p99 {worst:.1f}ms blew the "
+            f"{self.LIGHT_BUDGET_MS}ms fairness budget"
+        )
+        # The hog really contended: its lane carries the windowed cost,
+        # and the insights exemplars attribute the slow queries to it.
+        assert node.qos.window_cost_ms("hog") > 0.0
+        status, insights = rest.dispatch(
+            "GET", "/_insights/queries", {}, ""
+        )
+        assert status == 200
+        assert "hog" in {q.get("tenant") for q in insights["queries"]}
+
+
 class TestCtlUnderChaos:
     def test_obs_fans_answer_within_deadline_with_named_failures(
         self, topo

@@ -1,0 +1,514 @@
+"""Per-tenant QoS (ISSUE 17): weighted admission lanes.
+
+Contracts under test:
+- the QosController units: weight parsing, windowed cost accounting,
+  the strictly-more-over-quota shed-victim rule, weighted DRR overtake,
+  the hard inflight ceiling with work-conserving lane quotas, and the
+  per-lane Retry-After estimate;
+- the micro-batcher's weighted shedding: a full queue evicts the most
+  over-quota lane's queued rider before 429ing an innocent arrival, and
+  the arriving lane absorbs its own backpressure when it IS the worst;
+- tenant threading: `X-Opaque-Id` (or the `ESTPU_QOS_HEADER` override)
+  becomes the QoS lane from REST dispatch down to the insights
+  exemplars and the `exec_saturation` health indicator, which NAMES the
+  top shed tenants;
+- the in-process fairness arc: one tenant flooding heavy aggregations
+  cannot push 100 light tenants' windowed queue-wait p99 out of budget
+  (gated on the per-lane `estpu_qos_queue_wait_recent_ms` window).
+"""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.exec.batcher import (
+    IndexingPressureRejected,
+    MicroBatcher,
+)
+from elasticsearch_tpu.exec.qos import (
+    DEFAULT_LANE,
+    QosController,
+    parse_weights,
+)
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.obs.health import (
+    HealthContext,
+    indicator_exec_saturation,
+)
+
+
+class TestController:
+    def test_parse_weights(self):
+        assert parse_weights("a:4,b:0.5") == {"a": 4.0, "b": 0.5}
+        assert parse_weights(" bigco : 2 ") == {"bigco": 2.0}
+        # Malformed entries are dropped, not fatal; zero/negative weights
+        # cannot silence a lane entirely.
+        assert parse_weights("a,b:x,c:-1,:3,") == {}
+        assert parse_weights(None) == {}
+        # Tenant ids may themselves contain colons (trace-style ids).
+        assert parse_weights("org:team:2") == {"org:team": 2.0}
+
+    def test_windowed_cost_accounting(self):
+        qos = QosController(window_s=60.0)
+        qos.charge("a", 120.0)
+        qos.charge("a", 30.0)
+        assert qos.window_cost_ms("a") == pytest.approx(150.0)
+        assert qos.window_cost_ms("never-seen") == 0.0
+
+    def test_retry_after_uses_the_lanes_own_p50(self):
+        qos = QosController()
+        # Lane "slow" has been waiting ~8s; lane "fast" ~5ms. The 429
+        # advertised to "fast" must not inherit "slow"'s misery.
+        for _ in range(8):
+            qos.note_queue_wait("slow", 8.0)
+            qos.note_queue_wait("fast", 0.005)
+        assert qos.retry_after_s("slow") >= 8
+        assert qos.retry_after_s("fast") == 1
+        # Cold lane: the fallback estimate, clamped to the 1s floor.
+        assert qos.retry_after_s("cold") == 1
+
+    def test_pick_shed_lane_is_strict(self):
+        qos = QosController()
+        qos.charge("hog", 5000.0)
+        qos.charge("mid", 100.0)
+        # The hog is strictly more over-quota than the arriving light
+        # lane: it is the victim.
+        assert qos.pick_shed_lane(["hog", "mid"], arriving="light") == "hog"
+        # When the arrival IS the worst offender, nobody else pays:
+        # pick_shed_lane declines and the arrival absorbs its own 429.
+        assert qos.pick_shed_lane(["mid"], arriving="hog") is None
+
+    def test_weights_scale_the_over_quota_ordering(self):
+        qos = QosController()
+        qos.set_weight("paid", 10.0)
+        qos.charge("paid", 1000.0)
+        qos.charge("free", 500.0)
+        # Per unit weight the free lane (500/1) out-consumed the paid
+        # lane (1000/10): weighted shedding targets the free lane.
+        assert qos.pick_shed_lane(["paid", "free"], arriving="x") == "free"
+
+    def test_drr_overtake(self):
+        qos = QosController(quantum_ms=5.0)
+        qos.charge("spender", 400.0)  # deep negative deficit
+        # The spender's group is due EARLIER, but a fresh lane's group
+        # overtakes: deficit-round-robin drains light lanes first.
+        picked = qos.drr_pick(
+            [("g-spender", 1.0, "spender"), ("g-fresh", 2.0, "fresh")]
+        )
+        assert picked == "g-fresh"
+        # With only one candidate there is nothing to arbitrate.
+        assert qos.drr_pick([("only", 1.0, "spender")]) == "only"
+
+    def test_drr_never_starves(self):
+        qos = QosController(quantum_ms=5.0)
+        qos.charge("spender", 200.0)
+        # Credit accrues every round: the spender eventually drains even
+        # while alone in the candidate set with a deep deficit.
+        picked = qos.drr_pick(
+            [("g1", 1.0, "spender"), ("g2", 2.0, "spender")]
+        )
+        assert picked == "g1"
+
+    def test_admission_hard_ceiling_and_shed(self):
+        qos = QosController(inflight_budget=1, admit_wait_s=0.2)
+        adm = qos.admit("a")
+        adm.__enter__()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(IndexingPressureRejected) as err:
+                with qos.admit("b"):
+                    pass
+            assert time.monotonic() - t0 >= 0.2
+            assert err.value.lane == "b"
+            assert err.value.retry_after_s >= 1
+        finally:
+            adm.__exit__(None, None, None)
+        # The slot freed: the same lane admits instantly now.
+        with qos.admit("b"):
+            pass
+        stats = qos.stats()
+        assert stats["lanes"]["b"]["shed"] == 1
+        assert stats["lanes"]["b"]["admitted"] == 1
+        assert stats["inflight"] == 0
+
+    def test_admission_is_work_conserving(self):
+        # One lane may hold the WHOLE budget while nobody else wants it:
+        # weights bind under contention, never idle the device.
+        qos = QosController(inflight_budget=4, admit_wait_s=0.2)
+        admissions = [qos.admit("solo") for _ in range(4)]
+        for a in admissions:
+            a.__enter__()
+        assert qos.stats()["inflight"] == 4
+        for a in admissions:
+            a.__exit__(None, None, None)
+
+    def test_admission_quota_binds_under_contention(self):
+        # Budget 2, two lanes: while lane b is WAITING, lane a (already
+        # holding its half-share) cannot grab the freed slot first.
+        qos = QosController(inflight_budget=2, admit_wait_s=5.0)
+        first = qos.admit("a")
+        second = qos.admit("a")
+        first.__enter__()
+        second.__enter__()  # work-conserving: both slots to lane a
+        order = []
+
+        def want(lane):
+            with qos.admit(lane):
+                order.append(lane)
+                time.sleep(0.05)
+
+        tb = threading.Thread(target=want, args=("b",))
+        tb.start()
+        time.sleep(0.1)  # b is now waiting on the full budget
+        ta = threading.Thread(target=want, args=("a",))
+        ta.start()
+        time.sleep(0.05)
+        first.__exit__(None, None, None)  # one slot frees
+        tb.join(timeout=5)
+        second.__exit__(None, None, None)
+        ta.join(timeout=5)
+        assert order[0] == "b", "the waiting light lane wins the freed slot"
+
+    def test_lane_lru_bound(self):
+        qos = QosController()
+        for i in range(QosController.MAX_LANES + 40):
+            qos.charge(f"lane-{i}", 1.0)
+        assert len(qos.stats()["lanes"]) <= QosController.MAX_LANES
+
+    def test_health_inputs_shape(self):
+        qos = QosController()
+        qos.note_queue_wait("bigco", 0.9)
+        out = qos.health_inputs()
+        assert out["lanes"] >= 1
+        assert "bigco" in out["queue_wait_p99_ms_by_lane"]
+        assert out["queue_wait_p99_ms_by_lane"]["bigco"] == pytest.approx(
+            900.0, rel=0.01
+        )
+
+
+class _GatedSearcher:
+    """search_many blocks until released — keeps riders queued so the
+    shedding paths are reachable deterministically."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.calls = []
+
+    def search_many(self, requests, tasks=None):
+        self.gate.wait(timeout=10)
+        self.calls.append(list(requests))
+        return [f"r:{r}" for r in requests]
+
+    def search(self, request, task=None, **kwargs):
+        return f"solo:{request}"
+
+
+class TestBatcherWeightedShedding:
+    def _run(self, batcher, searcher, request, lane, results, errors):
+        try:
+            results.append(
+                (lane, batcher.execute(searcher, request, tenant_key=lane))
+            )
+        except IndexingPressureRejected as e:
+            errors.append((lane, e))
+
+    def test_full_queue_evicts_the_over_quota_lane_first(self):
+        qos = QosController(inflight_budget=64)
+        qos.charge("hog", 10_000.0)  # windowed history: the hog overspent
+        batcher = MicroBatcher(
+            max_wait_s=0.2, queue_limit=2, qos=qos
+        )
+        searcher = _GatedSearcher()
+        results: list = []
+        errors: list = []
+        try:
+            # First rider launches immediately and parks inside
+            # search_many; the next two fill the queue to its limit.
+            threads = [
+                threading.Thread(
+                    target=self._run,
+                    args=(batcher, searcher, f"q{i}", "hog", results, errors),
+                )
+                for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+                time.sleep(0.05)
+            # An innocent light arrival finds the queue full: weighted
+            # shedding evicts a queued hog rider instead of 429ing it.
+            tl = threading.Thread(
+                target=self._run,
+                args=(batcher, searcher, "light-q", "light", results, errors),
+            )
+            tl.start()
+            time.sleep(0.1)
+            searcher.gate.set()
+            for t in threads:
+                t.join(timeout=10)
+            tl.join(timeout=10)
+        finally:
+            searcher.gate.set()
+            batcher.close()
+        assert [lane for lane, _ in errors] == ["hog"]
+        err = errors[0][1]
+        assert err.lane == "hog"
+        assert err.retry_after_s >= 1
+        served = {lane for lane, _ in results}
+        assert "light" in served
+        assert qos.stats()["lanes"]["hog"]["shed"] == 1
+
+    def test_worst_offender_arrival_absorbs_its_own_429(self):
+        qos = QosController(inflight_budget=64)
+        qos.charge("hog", 10_000.0)
+        batcher = MicroBatcher(max_wait_s=0.2, queue_limit=2, qos=qos)
+        searcher = _GatedSearcher()
+        results: list = []
+        errors: list = []
+        try:
+            threads = [
+                threading.Thread(
+                    target=self._run,
+                    args=(
+                        batcher, searcher, f"q{i}", "light", results, errors,
+                    ),
+                )
+                for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+                time.sleep(0.05)
+            # The hog arrives at a queue full of LIGHT riders it already
+            # out-spent: nobody else pays — the hog itself is shed.
+            with pytest.raises(IndexingPressureRejected) as err:
+                batcher.execute(searcher, "hog-q", tenant_key="hog")
+            assert err.value.lane == "hog"
+            searcher.gate.set()
+            for t in threads:
+                t.join(timeout=10)
+        finally:
+            searcher.gate.set()
+            batcher.close()
+        assert not errors, "no queued light rider was evicted"
+        assert qos.stats()["lanes"]["hog"]["shed"] == 1
+
+
+class TestHealthIndicatorNamesTenants:
+    def _ctx(self, shed_recent):
+        return HealthContext(
+            node_inputs={
+                "node-0": {
+                    "batcher": {"enabled": True, "queued": 3},
+                    "queue_wait_recent": {"p99": 40.0, "count": 10},
+                    "shed_recent": shed_recent,
+                    "qos": {
+                        "lanes": 4,
+                        "shed_recent_by_lane": {"bigco": shed_recent},
+                        "queue_wait_p99_ms_by_lane": {"bigco": 900.0},
+                    },
+                }
+            }
+        )
+
+    def test_red_names_the_top_shed_tenants(self):
+        out = indicator_exec_saturation(self._ctx(120))
+        assert out["status"] == "red"
+        assert "[bigco]=120" in out["symptom"]
+        assert any(
+            "[bigco]=120" in d["cause"] for d in out["diagnosis"]
+        )
+        node = out["details"]["nodes"]["node-0"]
+        assert node["shed_recent_by_lane"] == {"bigco": 120}
+        assert node["queue_wait_p99_ms_by_lane"] == {"bigco": 900.0}
+
+    def test_yellow_names_them_too(self):
+        out = indicator_exec_saturation(self._ctx(3))
+        assert out["status"] == "yellow"
+        assert "[bigco]=3" in out["symptom"]
+
+
+class TestTenantThreading:
+    @pytest.fixture()
+    def rest(self):
+        import json
+
+        from elasticsearch_tpu.rest.server import RestServer
+
+        rest = RestServer()
+        status, _ = rest.dispatch(
+            "PUT",
+            "/tidx",
+            {},
+            json.dumps(
+                {"mappings": {"properties": {"v": {"type": "integer"}}}}
+            ),
+        )
+        assert status == 200
+        for i in range(8):
+            rest.dispatch(
+                "PUT", f"/tidx/_doc/{i}", {}, json.dumps({"v": i})
+            )
+        rest.dispatch("POST", "/tidx/_refresh", {}, "")
+        yield rest
+        rest.close()
+
+    def test_opaque_id_becomes_the_lane_and_insight_tenant(self, rest):
+        import json
+
+        body = json.dumps({"query": {"match_all": {}}, "size": 2})
+        status, _ = rest.dispatch(
+            "POST",
+            "/tidx/_search",
+            {},
+            body,
+            headers={"X-Opaque-Id": "tenant-zed"},
+        )
+        assert status == 200
+        assert "tenant-zed" in rest.node.qos.stats()["lanes"]
+        status, insights = rest.dispatch(
+            "GET", "/_insights/queries", {}, ""
+        )
+        assert status == 200
+        tenants = {q.get("tenant") for q in insights["queries"]}
+        assert "tenant-zed" in tenants
+
+    def test_absent_header_rides_the_default_lane(self, rest):
+        import json
+
+        body = json.dumps({"query": {"match_all": {}}, "size": 1})
+        status, _ = rest.dispatch("POST", "/tidx/_search", {}, body)
+        assert status == 200
+        assert DEFAULT_LANE in rest.node.qos.stats()["lanes"]
+
+    def test_qos_header_override(self, monkeypatch):
+        import json
+
+        from elasticsearch_tpu.rest.server import RestServer
+
+        monkeypatch.setenv("ESTPU_QOS_HEADER", "X-Team")
+        rest = RestServer()
+        try:
+            rest.dispatch(
+                "PUT",
+                "/oidx",
+                {},
+                json.dumps(
+                    {"mappings": {"properties": {"v": {"type": "integer"}}}}
+                ),
+            )
+            rest.dispatch("PUT", "/oidx/_doc/0", {}, json.dumps({"v": 1}))
+            rest.dispatch("POST", "/oidx/_refresh", {}, "")
+            status, _ = rest.dispatch(
+                "POST",
+                "/oidx/_search",
+                {},
+                json.dumps({"query": {"match_all": {}}}),
+                headers={"X-Team": "blue", "X-Opaque-Id": "ignored"},
+            )
+            assert status == 200
+            lanes = rest.node.qos.stats()["lanes"]
+            assert "blue" in lanes
+            assert "ignored" not in lanes
+        finally:
+            rest.close()
+
+
+class TestFairnessArcInProcess:
+    """One tenant floods heavy aggregations; 100 light tenants' windowed
+    queue-wait p99 stays in budget (the in-process half of the ISSUE 17
+    fairness acceptance arc — the socketed half lives in
+    test_chaos_arcs.py)."""
+
+    LIGHT_BUDGET_MS = 1500.0
+
+    def test_flood_does_not_starve_light_lanes(self):
+        n = Node(data_path=None)
+        try:
+            n.create_index(
+                "fair",
+                {
+                    "mappings": {
+                        "properties": {
+                            "f": {"type": "keyword"},
+                            "v": {"type": "integer"},
+                        }
+                    }
+                },
+            )
+            for i in range(64):
+                n.index_doc("fair", {"f": f"k{i % 8}", "v": i}, str(i))
+            n.refresh("fair")
+            heavy_body = {
+                "size": 0,
+                "aggs": {
+                    "byf": {
+                        "terms": {"field": "f"},
+                        "aggs": {"sv": {"sum": {"field": "v"}}},
+                    }
+                },
+            }
+            light_body = {
+                "size": 0,
+                "aggs": {"mv": {"max": {"field": "v"}}},
+            }
+            # Pin a small admission budget so the flood actually
+            # contends (the default 16 would never saturate here).
+            n.qos.inflight_budget = 4
+            stop = threading.Event()
+            flood_errors: list = []
+
+            def flood():
+                while not stop.is_set():
+                    try:
+                        n.search(
+                            "fair",
+                            dict(heavy_body),
+                            request_cache=False,
+                            tenant="hog",
+                        )
+                    # A flood MAY be shed — that is the mechanism working.
+                    except Exception as e:  # noqa: BLE001
+                        flood_errors.append(e)
+                        if not isinstance(e, Exception):
+                            raise
+
+            floods = [
+                threading.Thread(target=flood, daemon=True)
+                for _ in range(8)
+            ]
+            for t in floods:
+                t.start()
+            time.sleep(0.2)  # flood is established
+            try:
+                for i in range(100):
+                    n.search(
+                        "fair",
+                        dict(light_body),
+                        request_cache=False,
+                        tenant=f"light-{i}",
+                    )
+            finally:
+                stop.set()
+                for t in floods:
+                    t.join(timeout=10)
+            # Gate on the per-lane rolling windows: every light lane's
+            # p99 admission wait stays in budget while the hog floods.
+            worst = 0.0
+            gated = 0
+            for i in range(100):
+                w = n.metrics.window(
+                    "estpu_qos_queue_wait_recent_ms", lane=f"light-{i}"
+                )
+                if w is None:
+                    continue
+                gated += 1
+                worst = max(worst, w.snapshot()["p99"])
+            assert gated == 100, "every light lane must have a wait window"
+            assert worst < self.LIGHT_BUDGET_MS, (
+                f"light-lane p99 {worst:.1f}ms blew the "
+                f"{self.LIGHT_BUDGET_MS}ms fairness budget"
+            )
+            # The hog really was contending (its lane did the spending).
+            assert n.qos.window_cost_ms("hog") > 0.0
+        finally:
+            n.close()

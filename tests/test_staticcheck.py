@@ -141,7 +141,10 @@ class TestRegistryConsistency:
         assert any("[dead.site]" in m for m in msgs)
         # an unregistered socket-transport site fails like any other
         assert any("[transport.tcp.frame]" in m for m in msgs)
-        assert len(msgs) == 3
+        # ... and so do the async-search reduce fold and QoS shed sites
+        assert any("[async.reduce]" in m for m in msgs)
+        assert any("[qos.shed]" in m for m in msgs)
+        assert len(msgs) == 5
 
     def test_fault_site_suppressed_twin(self, report):
         assert rules_of(report.suppressed).get("registry-fault-site") == 1
@@ -175,7 +178,10 @@ class TestRegistryConsistency:
         # cataloged windowed twin (estpu_good_recent_ms) stays clean.
         assert any("[estpu_rogue_recent]" in m for m in msgs)
         assert not any("[estpu_good_recent_ms]" in m for m in msgs)
-        assert len(msgs) == 13
+        # ... and uncataloged async-search / QoS-lane instruments
+        assert any("[estpu_async_rogue_total]" in m for m in msgs)
+        assert any("[estpu_qos_rogue_total]" in m for m in msgs)
+        assert len(msgs) == 15
 
     def test_indicator_registry(self, report):
         msgs = [
