@@ -13,6 +13,7 @@ from .gateway import (
     ReplicationUnavailableError,
 )
 from .procs import ProcCluster
+from .remediation import ACTIONS, Action, RemediationService
 from .response_collector import ResponseCollectorService
 from .state import ClusterState, IndexMeta, ShardRouting
 from .tcp_transport import (
@@ -29,6 +30,8 @@ from .transport import (
 )
 
 __all__ = [
+    "ACTIONS",
+    "Action",
     "ClusterNode",
     "ClusterState",
     "ConnectTransportError",
@@ -38,6 +41,7 @@ __all__ = [
     "NotMasterError",
     "ProcCluster",
     "ProcGateway",
+    "RemediationService",
     "RemoteActionError",
     "ReplicationFailedError",
     "ReplicationGateway",

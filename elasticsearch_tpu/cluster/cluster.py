@@ -1655,6 +1655,54 @@ class ClusterNode:
             routing.in_sync.add(node)
             return {"acked": self._publish(new)}
 
+    def move_shard_replica(
+        self, index: str, shard_id: int, from_node: str, to_node: str
+    ) -> dict:
+        """Master action (remediation allocation loop): move one REPLICA
+        copy off a hot node. The replica leaves the routing table and the
+        destination enters `recovering`, so the move completes through
+        the ordinary peer-recovery machinery (`check_recoveries` +
+        shard_recovered). The primary is never touched — promotion
+        safety, and therefore every acked write, is untouched."""
+        with self.master_lock:
+            self._require_master()
+            new = self.state.copy()
+            meta = new.indices.get(index)
+            if meta is None:
+                raise ValueError(f"no such index [{index}]")
+            routing = meta.shards[shard_id]
+            if from_node == routing.primary:
+                raise ValueError(
+                    f"refusing to move primary {index}[{shard_id}] — "
+                    "only replicas relocate"
+                )
+            if from_node not in routing.replicas:
+                raise ValueError(
+                    f"[{from_node}] holds no replica of {index}[{shard_id}]"
+                )
+            if to_node in routing.assigned() or to_node in routing.recovering:
+                raise ValueError(
+                    f"[{to_node}] already holds a copy of {index}[{shard_id}]"
+                )
+            if to_node not in new.nodes or to_node in new.voting_only:
+                raise ValueError(
+                    f"[{to_node}] is not a data-eligible cluster member"
+                )
+            routing.replicas.remove(from_node)
+            routing.in_sync.discard(from_node)
+            routing.recovering.append(to_node)
+            return {"acked": self._publish(new)}
+
+    def note_remediation(self, record: dict) -> dict:
+        """Master action: ride one executed remediation action into the
+        published state, making it an observable, versioned cluster-state
+        transition every member sees."""
+        with self.master_lock:
+            self._require_master()
+            new = self.state.copy()
+            new.log_remediation(record)
+            return {"acked": self._publish(new)}
+
     def _on_create_index(self, from_id: str, payload: dict):
         with self.master_lock:
             return self._create_index_locked(payload)
@@ -1955,6 +2003,10 @@ class LocalCluster:
         )
         self._stepper: threading.Thread | None = None
         self._stop = threading.Event()
+        # The remediation tick (cluster/remediation.py) rides the same
+        # stepper as the master's health round: the owning node registers
+        # a zero-arg callable; it runs only while a master holds office.
+        self.remediation_hook = None
         self.step()  # bootstrap election
 
     # ------------------------------------------------------------ control
@@ -1969,6 +2021,9 @@ class LocalCluster:
         master = self.master()
         if master is not None:
             master.health_round()
+            hook = self.remediation_hook
+            if hook is not None:
+                hook()
         for node in list(self.nodes.values()):
             if not node.closed:
                 node.check_recoveries()

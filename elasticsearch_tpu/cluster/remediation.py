@@ -1,0 +1,880 @@
+"""Health-driven remediation: the self-driving half of the health report.
+
+PR 15 built the interpretation layer (obs/health.py indicators ->
+symptom/impacts/diagnosis); this module closes the loop from diagnosis
+to ACTION the way the reference's ILM + allocation deciders do
+(x-pack/plugin/ilm/, cluster/routing/allocation/AllocationService.java).
+A `RemediationService` runs on the elected master's stepper (LocalCluster
+registers the tick as a stepper hook; a standalone node drives it from
+its own paced stepper or on demand), reads the SAME `HealthContext` the
+indicators render, and drives three closed loops:
+
+- **lifecycle** — ILM-analog policies: rollover of an alias's write
+  index by doc count/age, background force-merge scheduled off the
+  windowed write rate (a quiet index with too many segments compacts;
+  a hot one is left alone), and cold-index demotion from HBM planes to
+  host arrays (placement driven by the PR-14 HBM ledger's per-(label,
+  index) bytes) with on-demand re-pack at the next search.
+- **allocation** — decider-style shard moves when one node's HBM trends
+  past the yellow fraction or its windowed queue-wait p99 diverges from
+  the cluster median: one REPLICA copy moves off the hot node through
+  the ordinary peer-recovery machinery (the primary is never touched,
+  so acked writes are structurally safe).
+- **budget** — the filter/ANN/packed cache budgets auto-tune against
+  each other from windowed eviction bursts and hit rates instead of
+  three static env vars; every retune is recorded on the affected
+  cache's own stats so operators can attribute hit-rate shifts.
+
+Robustness is the design center:
+
+- `ACTIONS` is the machine-checked registry (staticcheck's
+  registry-action rule, mirroring INDICATORS): every entry must have a
+  pure module-level `plan_<name>(ctx) -> list[Action]` implementation
+  here, and every implementation must be registered.
+- Every EXECUTED action is published as an observable cluster-state
+  transition (`ClusterState.remediations`, version-bumped through the
+  master's quorum publication) and named in the `_health_report`
+  diagnosis of the indicator it serves.
+- Global dry-run (`ESTPU_REMEDIATION_DRY_RUN` / POST /_remediation):
+  identical planning, zero actuation; `GET /_remediation` shows
+  planned-vs-executed side by side.
+- Per-action hysteresis/cooldown: an action and its INVERSE share one
+  damping key, so the loop can never flap (demote→promote→demote...)
+  inside `ESTPU_REMEDIATION_COOLDOWN_S`.
+- A cap on executed actions per cooldown window
+  (`ESTPU_REMEDIATION_MAX_ACTIONS`) so a pathological context cannot
+  stampede the cluster.
+- `remediate.<loop>` fault sites: an action failing mid-flight retries
+  with backoff, then the whole loop degrades to ADVISORY (diagnosis
+  only) for `ESTPU_REMEDIATION_ADVISORY_S` instead of thrashing, with
+  the failure counted in `estpu_remediation_failures_total`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..faults.registry import fault_point
+
+# Machine-checked action-planner registry: every entry has a pure
+# module-level `plan_<name>(ctx) -> list[Action]` below (staticcheck's
+# registry-action rule), dispatched by RemediationService.plan exactly
+# like HealthService dispatches INDICATORS.
+ACTIONS = ("lifecycle", "allocation", "budget")
+
+# Which health indicator each loop's actions are grafted onto: the
+# diagnosis that NAMES the action taken (obs/health.py reads this).
+ACTION_INDICATOR = {
+    "lifecycle": "device_memory",
+    "allocation": "device_memory",
+    "budget": "exec_saturation",
+}
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+@dataclass
+class Action:
+    """One planned remediation step. `inverse` names the action kind
+    that undoes this one — the two share a hysteresis key, so neither
+    may fire within one cooldown window of the other."""
+
+    loop: str  # the ACTIONS entry that planned it
+    kind: str  # force_merge | rollover | demote_index | ...
+    target: str  # index, alias, "index[shard]", or budget name
+    reason: str  # operator-readable narration (health diagnosis cause)
+    params: dict = field(default_factory=dict)
+    inverse: str | None = None
+
+    def damping_key(self) -> tuple:
+        """Hysteresis identity: the action and its inverse collapse to
+        one key per target, so demote/promote (or a move and its
+        return trip) can never both fire within the cooldown."""
+        kinds = frozenset(
+            k for k in (self.kind, self.inverse) if k is not None
+        )
+        return (kinds, self.target)
+
+    def to_json(self) -> dict:
+        return {
+            "loop": self.loop,
+            "kind": self.kind,
+            "target": self.target,
+            "reason": self.reason,
+            "params": dict(self.params),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Pure planners: HealthContext -> list[Action]. No clocks, no I/O, no
+# service state — everything they decide from is IN the context, so a
+# plan is replayable and the dry-run plans exactly what live would.
+# ---------------------------------------------------------------------------
+
+
+def _coord_inputs(ctx) -> dict:
+    return ctx.node_inputs.get(ctx.coordinator, {}) or {}
+
+
+def _hbm_fraction(inputs: dict) -> float:
+    breaker = inputs.get("breaker") or {}
+    limit = int(breaker.get("limit_size_in_bytes") or 0)
+    used = int(breaker.get("estimated_size_in_bytes") or 0)
+    return (used / limit) if limit > 0 else 0.0
+
+
+def _segment_bytes_by_index(inputs: dict) -> dict[str, int]:
+    """Per-index packed-segment HBM from the PR-14 ledger snapshot —
+    the lifecycle loop's placement input."""
+    hbm = inputs.get("hbm") or {}
+    out: dict[str, int] = {}
+    for row in hbm.get("by_label_index", []) or []:
+        if row.get("label") == "segment" and row.get("index") != "_node":
+            out[row["index"]] = out.get(row["index"], 0) + int(
+                row.get("bytes", 0)
+            )
+    return out
+
+
+def next_rollover_name(index: str) -> str:
+    """`logs-000001` -> `logs-000002`; an unsuffixed name grows one."""
+    base, _, tail = index.rpartition("-")
+    if base and tail.isdigit():
+        return f"{base}-{int(tail) + 1:0{len(tail)}d}"
+    return f"{index}-000002"
+
+
+def plan_lifecycle(ctx) -> list[Action]:
+    """ILM-analog policies: rollover by size/age, force-merge off the
+    windowed write rate, cold-index demotion under HBM pressure (and
+    eager promotion back once pressure clears)."""
+    acts: list[Action] = []
+    inputs = _coord_inputs(ctx)
+    rollover_docs = int(_env_f("ESTPU_REMEDIATION_ROLLOVER_DOCS", 2e6))
+    rollover_age = _env_f("ESTPU_REMEDIATION_ROLLOVER_AGE_S", 0.0)
+    seg_budget = int(_env_f("ESTPU_REMEDIATION_SEGMENTS", 8))
+    hbm_high = _env_f("ESTPU_REMEDIATION_HBM_FRACTION", 0.9)
+    hbm_low = hbm_high * 0.5
+    writes_recent = inputs.get("writes_recent") or {}
+    # Rollover: each alias with ONE write target whose docs/age crossed
+    # the policy threshold rolls to the next generation.
+    for alias, targets in sorted((ctx.aliases or {}).items()):
+        if len(targets) != 1:
+            continue  # ambiguous write target: never guess
+        name = targets[0]
+        svc = ctx.local_indices.get(name)
+        if svc is None:
+            continue
+        docs = int(getattr(svc, "num_docs", 0))
+        age_s = max(0.0, ctx.now - float(getattr(svc, "created_at", ctx.now)))
+        over_docs = docs >= rollover_docs > 0
+        over_age = rollover_age > 0 and age_s >= rollover_age
+        if not (over_docs or over_age):
+            continue
+        why = (
+            f"[{name}] behind alias [{alias}] has {docs} docs"
+            if over_docs
+            else f"[{name}] behind alias [{alias}] is {age_s:.0f}s old"
+        )
+        acts.append(
+            Action(
+                loop="lifecycle",
+                kind="rollover",
+                target=alias,
+                reason=f"{why} — past the rollover policy threshold",
+                params={
+                    "index": name,
+                    "new_index": next_rollover_name(name),
+                },
+            )
+        )
+    # Background force-merge: a QUIET index (zero writes in the trailing
+    # window) carrying more searchable segments than the budget compacts
+    # in the background; a hot one is left to the ordinary merge policy.
+    for name, svc in sorted(ctx.local_indices.items()):
+        engines = getattr(svc, "engines", None) or []
+        segs = sum(len(e.segments) for e in engines)
+        if segs < max(2, seg_budget):
+            continue
+        if int(writes_recent.get(name, 0)) > 0:
+            continue  # scheduled off the windowed write rate
+        acts.append(
+            Action(
+                loop="lifecycle",
+                kind="force_merge",
+                target=name,
+                reason=(
+                    f"[{name}] holds {segs} searchable segments with no "
+                    "writes in the trailing window — background "
+                    "force-merge is free tail latency"
+                ),
+            )
+        )
+    # Demotion/promotion: under HBM pressure the coldest index (largest
+    # ledger `segment` bytes, not searched in the window) drops its
+    # device planes to host arrays; once pressure clears a demoted index
+    # re-packs eagerly. On-demand re-pack at search time is always on —
+    # this only decides the background direction.
+    frac = _hbm_fraction(inputs)
+    seg_bytes = _segment_bytes_by_index(inputs)
+    recent_searches = set(ctx.recent_search_indices or ())
+    demoted = {
+        name
+        for name, svc in ctx.local_indices.items()
+        if any(getattr(e, "demoted", False) for e in
+               (getattr(svc, "engines", None) or []))
+    }
+    if frac >= hbm_high and ctx.scrolls_active == 0:
+        candidates = sorted(
+            (
+                (n, b)
+                for n, b in seg_bytes.items()
+                if n not in recent_searches
+                and n not in demoted
+                and n in ctx.local_indices
+            ),
+            key=lambda kv: (-kv[1], kv[0]),
+        )
+        if candidates:
+            name, nbytes = candidates[0]
+            acts.append(
+                Action(
+                    loop="lifecycle",
+                    kind="demote_index",
+                    target=name,
+                    inverse="promote_index",
+                    reason=(
+                        f"HBM at {frac:.0%} of the breaker budget; "
+                        f"[{name}] holds {nbytes} cold segment bytes "
+                        "with no searches in the trailing window"
+                    ),
+                    params={"bytes": nbytes},
+                )
+            )
+    elif 0.0 < frac <= hbm_low:
+        for name in sorted(demoted):
+            acts.append(
+                Action(
+                    loop="lifecycle",
+                    kind="promote_index",
+                    target=name,
+                    inverse="demote_index",
+                    reason=(
+                        f"HBM back at {frac:.0%} of the breaker budget "
+                        f"— re-pack demoted [{name}] ahead of demand"
+                    ),
+                )
+            )
+            break  # one promotion per tick: re-packs are real work
+    return acts
+
+
+def plan_allocation(ctx) -> list[Action]:
+    """Decider-style shard moves: when one node's HBM fraction or
+    windowed queue-wait p99 diverges from the rest, ONE replica copy
+    moves off it through ordinary peer recovery. Primaries never move
+    — promotion safety (and therefore acked writes) is untouched."""
+    state = ctx.state
+    if state is None or len(ctx.node_inputs) < 2:
+        return []
+    hbm_high = _env_f("ESTPU_REMEDIATION_HBM_FRACTION", 0.9)
+    divergence = _env_f("ESTPU_REMEDIATION_P99_DIVERGENCE", 4.0)
+    p99_floor_ms = _env_f("ESTPU_REMEDIATION_P99_FLOOR_MS", 50.0)
+    signals: dict[str, tuple[float, float]] = {}
+    for node_id, inputs in ctx.node_inputs.items():
+        queue = (inputs or {}).get("queue_wait_recent") or {}
+        p99 = float(queue.get("p99") or 0.0)
+        signals[node_id] = (_hbm_fraction(inputs or {}), p99)
+    candidates = {
+        n for n in state.nodes if n not in state.voting_only
+    } & set(signals)
+    if len(candidates) < 2:
+        return []
+    hot = None
+    why = ""
+    for node_id in sorted(candidates):
+        frac, p99 = signals[node_id]
+        others = [signals[n] for n in candidates if n != node_id]
+        other_fracs = [f for f, _ in others]
+        other_p99s = sorted(p for _, p in others)
+        median_p99 = other_p99s[len(other_p99s) // 2]
+        if frac >= hbm_high and max(other_fracs, default=0.0) < hbm_high:
+            hot = node_id
+            why = (
+                f"node [{node_id}] HBM at {frac:.0%} of its breaker "
+                "budget while the rest of the cluster is below the "
+                "yellow fraction"
+            )
+            break
+        if p99 >= p99_floor_ms and p99 >= divergence * max(
+            median_p99, 1e-9
+        ):
+            hot = node_id
+            why = (
+                f"node [{node_id}] windowed queue-wait p99 "
+                f"({p99:.1f}ms) diverges {divergence:.0f}x from the "
+                f"cluster median ({median_p99:.1f}ms)"
+            )
+            break
+    if hot is None:
+        return []
+    # Coldest destination: lowest (hbm fraction, p99) among the rest.
+    dests = sorted(
+        (n for n in candidates if n != hot),
+        key=lambda n: (signals[n][0], signals[n][1], n),
+    )
+    for index in sorted(state.indices):
+        meta = state.indices[index]
+        for shard_id in sorted(meta.shards):
+            routing = meta.shards[shard_id]
+            if hot not in routing.replicas:
+                continue  # only replicas move; primaries stay put
+            holders = set(routing.assigned()) | set(routing.recovering)
+            for dest in dests:
+                if dest in holders:
+                    continue
+                return [
+                    Action(
+                        loop="allocation",
+                        kind="move_shard",
+                        target=f"{index}[{shard_id}]",
+                        inverse="move_shard",
+                        reason=(
+                            f"{why} — moving replica {index}[{shard_id}]"
+                            f" to [{dest}]"
+                        ),
+                        params={"index": index, "shard": shard_id,
+                                "from": hot, "to": dest},
+                    )
+                ]
+    return []
+
+
+def plan_budget(ctx) -> list[Action]:
+    """Auto-tune the filter/ANN cache budgets against each other from
+    windowed eviction bursts + hit rates, and grow/shrink the packed
+    plane's doc budget off its occupancy — instead of three static
+    env vars."""
+    acts: list[Action] = []
+    inputs = _coord_inputs(ctx)
+    caches = inputs.get("caches") or {}
+    filt = caches.get("filter")
+    ann = caches.get("ann")
+    evictions = inputs.get("evictions_recent") or {}
+    burst = int(_env_f("ESTPU_REMEDIATION_EVICTION_BURST", 64))
+    floor = int(_env_f("ESTPU_REMEDIATION_BUDGET_FLOOR_BYTES", 16 << 20))
+
+    def _hit_rate(stats: dict) -> tuple[float, int]:
+        hits = int(stats.get("hit_count", 0))
+        misses = int(stats.get("miss_count", 0))
+        lookups = hits + misses
+        return (hits / lookups if lookups else 0.0), lookups
+
+    if filt is not None and ann is not None:
+        f_ev = int(evictions.get("filter", 0))
+        a_ev = int(evictions.get("ann", 0))
+        f_budget = int(filt.get("budget_bytes", 0))
+        a_budget = int(ann.get("budget_bytes", 0))
+        f_rate, f_lookups = _hit_rate(filt)
+        a_rate, a_lookups = _hit_rate(ann)
+        shift = max(1 << 20, a_budget // 10)
+        if (
+            f_ev >= burst
+            and f_ev >= 4 * max(1, a_ev)
+            and a_budget - shift >= floor
+            and (a_lookups < 32 or a_rate < 0.5)
+        ):
+            acts.append(
+                Action(
+                    loop="budget",
+                    kind="grow_filter_budget",
+                    target="cache_budgets",
+                    inverse="shrink_filter_budget",
+                    reason=(
+                        f"filter cache churned {f_ev} evictions in the "
+                        f"window (hit rate {f_rate:.0%}) while the ANN "
+                        f"cache is quiet — shifting {shift} bytes of "
+                        "ANN budget to the filter cache"
+                    ),
+                    params={
+                        "filter_bytes": f_budget + shift,
+                        "ann_bytes": a_budget - shift,
+                    },
+                )
+            )
+        else:
+            shift = max(1 << 20, f_budget // 10)
+            if (
+                a_ev >= burst
+                and a_ev >= 4 * max(1, f_ev)
+                and f_budget - shift >= floor
+                and (f_lookups < 32 or f_rate < 0.5)
+            ):
+                acts.append(
+                    Action(
+                        loop="budget",
+                        kind="shrink_filter_budget",
+                        target="cache_budgets",
+                        inverse="grow_filter_budget",
+                        reason=(
+                            f"ANN cache churned {a_ev} evictions in "
+                            f"the window (hit rate {a_rate:.0%}) while "
+                            "the filter cache is quiet — shifting "
+                            f"{shift} bytes of filter budget to the "
+                            "ANN cache"
+                        ),
+                        params={
+                            "filter_bytes": f_budget - shift,
+                            "ann_bytes": a_budget + shift,
+                        },
+                    )
+                )
+    packed = caches.get("packed")
+    if packed is not None:
+        plane_docs = int(packed.get("plane_docs", 0))
+        budget_docs = int(packed.get("max_plane_docs", 0))
+        default_docs = int(packed.get("default_plane_docs", budget_docs))
+        if budget_docs > 0 and plane_docs >= int(0.9 * budget_docs):
+            acts.append(
+                Action(
+                    loop="budget",
+                    kind="grow_packed_budget",
+                    target="packed_budget",
+                    inverse="shrink_packed_budget",
+                    reason=(
+                        f"packed plane at {plane_docs}/{budget_docs} "
+                        "docs — riders past the budget fall back solo"
+                    ),
+                    params={"max_plane_docs": int(budget_docs * 1.25)},
+                )
+            )
+        elif (
+            budget_docs > default_docs
+            and plane_docs <= int(0.25 * budget_docs)
+        ):
+            acts.append(
+                Action(
+                    loop="budget",
+                    kind="shrink_packed_budget",
+                    target="packed_budget",
+                    inverse="grow_packed_budget",
+                    reason=(
+                        f"packed plane at {plane_docs}/{budget_docs} "
+                        "docs — shrinking the grown budget back toward "
+                        "its default"
+                    ),
+                    params={
+                        "max_plane_docs": max(
+                            default_docs, int(budget_docs * 0.8)
+                        )
+                    },
+                )
+            )
+    return acts
+
+
+# ---------------------------------------------------------------------------
+# The service: plan (pure) -> damp (hysteresis/cooldown/cap/advisory) ->
+# actuate (retry with backoff through the remediate.<loop> fault sites)
+# -> publish (cluster-state transition + history + metrics).
+# ---------------------------------------------------------------------------
+
+
+class RemediationService:
+    """One node's remediation state machine. The node constructs it and
+    drives `tick()` from the master's stepper (clustered), its own paced
+    stepper (standalone), or on demand (POST /_remediation)."""
+
+    HISTORY = 64
+
+    def __init__(self, node, metrics=None):
+        self._node = node
+        self._lock = threading.Lock()
+        self.enabled = os.environ.get("ESTPU_REMEDIATION", "1") != "0"
+        self.dry_run = (
+            os.environ.get("ESTPU_REMEDIATION_DRY_RUN", "0") != "0"
+        )
+        self.interval_s = _env_f("ESTPU_REMEDIATION_INTERVAL_S", 1.0)
+        self.cooldown_s = _env_f("ESTPU_REMEDIATION_COOLDOWN_S", 30.0)
+        self.max_actions = int(_env_f("ESTPU_REMEDIATION_MAX_ACTIONS", 4))
+        self.retries = max(1, int(_env_f("ESTPU_REMEDIATION_RETRIES", 3)))
+        self.backoff_s = _env_f("ESTPU_REMEDIATION_BACKOFF_S", 0.05)
+        self.advisory_s = _env_f("ESTPU_REMEDIATION_ADVISORY_S", 60.0)
+        self._last_tick = 0.0
+        self._last_fired: dict[tuple, float] = {}  # damping key -> mono
+        self._executed_at: list[float] = []  # cap window bookkeeping
+        self._advisory_until: dict[str, float] = {}  # loop -> mono
+        self._advisory_why: dict[str, str] = {}
+        self._history: list[dict] = []  # newest last, bounded
+        self._seq = 0
+        self._stop = threading.Event()
+        self._stepper: threading.Thread | None = None
+        self._tick_thread: threading.Thread | None = None
+        if metrics is None:
+            from ..obs.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self._ticks = metrics.counter(
+            "estpu_remediation_ticks_total",
+            "Remediation rounds planned (stepper + on-demand)",
+        )
+        self._actions = metrics.counter(
+            "estpu_remediation_actions_total",
+            "Remediation actions executed, by loop and kind",
+        )
+        self._failures = metrics.counter(
+            "estpu_remediation_failures_total",
+            "Remediation action attempts that failed (each retry "
+            "counts; the final failure degrades the loop to advisory)",
+        )
+        self._suppressed = metrics.counter(
+            "estpu_remediation_suppressed_total",
+            "Planned actions suppressed by hysteresis/cooldown, the "
+            "per-window cap, or an advisory-degraded loop",
+        )
+        self._actions_recent = metrics.windowed_counter(
+            "estpu_remediation_actions_recent",
+            "Remediation actions executed over the trailing window",
+        )
+        self._tick_recent = metrics.windowed_histogram(
+            "estpu_remediation_tick_recent_ms",
+            "Wall-clock cost of one remediation round over the "
+            "trailing window, ms (the quiet-cluster overhead gate)",
+        )
+
+    # ----------------------------------------------------------- planning
+
+    def plan(self, ctx) -> list[Action]:
+        """Dispatch every registered planner over the context — pure,
+        no damping, no side effects (what dry-run and live both see)."""
+        out: list[Action] = []
+        for name in ACTIONS:
+            out.extend(globals()[f"plan_{name}"](ctx))
+        return out
+
+    # -------------------------------------------------------------- tick
+
+    def tick(self, ctx=None, force: bool = False) -> list[dict]:
+        """One remediation round: plan, damp, actuate, publish. Returns
+        the round's history records (planned AND suppressed entries
+        included — the planned-vs-executed surface)."""
+        if not self.enabled:
+            return []
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_tick < self.interval_s:
+                return []
+            self._last_tick = now
+        t0 = time.monotonic()
+        if ctx is None:
+            ctx = self._node._remediation_context()
+        planned = self.plan(ctx)
+        records: list[dict] = []
+        for action in planned:
+            records.append(self._consider(action, ctx))
+        self._ticks.inc()
+        self._tick_recent.record((time.monotonic() - t0) * 1e3)
+        return records
+
+    def tick_async(self) -> None:
+        """Stepper-hook form: NEVER blocks the caller. Building the
+        context fans health_inputs over the members, and during a
+        partition that fan waits out a per-send deadline — a wait that
+        belongs on this service's own thread, not the control-plane
+        step loop that elections, health rounds, and recoveries ride
+        on. Single-flight: a still-running tick skips the round."""
+        if not self.enabled:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if (
+                self._tick_thread is not None
+                and self._tick_thread.is_alive()
+            ):
+                return
+            if now - self._last_tick < self.interval_s:
+                return
+            thread = threading.Thread(
+                target=self._tick_swallowing,
+                daemon=True,
+                name="estpu-remediation-tick",
+            )
+            self._tick_thread = thread
+        thread.start()
+
+    def _tick_swallowing(self) -> None:
+        try:
+            self.tick()
+        # staticcheck: ignore[broad-except] detached stepper-hook tick: a planning error must not kill the round silently OR take anything down — it is COUNTED into estpu_remediation_failures_total (actuation failures inside tick are already counted there)
+        except Exception:
+            self._failures.inc()
+
+    def _consider(self, action: Action, ctx) -> dict:
+        """Damp one planned action, then actuate it (live mode only)."""
+        now = time.monotonic()
+        record = action.to_json()
+        with self._lock:
+            self._seq += 1
+            record["id"] = self._seq
+            # staticcheck: ignore[wallclock-duration] operator-facing timestamp; damping/cooldown math uses the monotonic clock
+            record["at_ms"] = int(time.time() * 1e3)
+            record["dry_run"] = self.dry_run
+            record["executed"] = False
+            key = action.damping_key()
+            last = self._last_fired.get(key)
+            until = self._advisory_until.get(action.loop, 0.0)
+            if until > now:
+                record["suppressed"] = "advisory"
+                record["advisory"] = True
+                record["advisory_reason"] = self._advisory_why.get(
+                    action.loop, ""
+                )
+            elif last is not None and now - last < self.cooldown_s:
+                record["suppressed"] = "cooldown"
+            else:
+                self._executed_at = [
+                    t
+                    for t in self._executed_at
+                    if now - t < self.cooldown_s
+                ]
+                if len(self._executed_at) >= self.max_actions:
+                    record["suppressed"] = "cap"
+                else:
+                    # Claim the damping + cap slots NOW (dry-run too, so
+                    # a dry-run plans the same cadence live would).
+                    self._last_fired[key] = now
+                    self._executed_at.append(now)
+        if "suppressed" in record:
+            self._suppressed.inc()
+            self._remember(record)
+            return record
+        if self.dry_run:
+            self._remember(record)
+            return record
+        err = self._actuate(action, record)
+        if err is None:
+            record["executed"] = True
+            self._actions.inc()
+            self._actions_recent.inc()
+            self._publish_transition(record)
+        else:
+            record["error"] = err
+            record["advisory"] = True
+            with self._lock:
+                self._advisory_until[action.loop] = (
+                    time.monotonic() + self.advisory_s
+                )
+                self._advisory_why[action.loop] = (
+                    f"[{action.kind}] on [{action.target}] failed after "
+                    f"{self.retries} attempts: {err}"
+                )
+        self._remember(record)
+        return record
+
+    def _actuate(self, action: Action, record: dict) -> str | None:
+        """Execute with retry + exponential backoff through the
+        `remediate.<loop>` fault site. Returns the final error string
+        (None on success)."""
+        last = ""
+        for attempt in range(self.retries):
+            try:
+                fault_point(
+                    f"remediate.{action.loop}",
+                    kind=action.kind,
+                    target=action.target,
+                )
+                self._apply(action)
+                record["attempts"] = attempt + 1
+                return None
+            # staticcheck: ignore[broad-except] actuation must never take the stepper down: every failure is COUNTED (estpu_remediation_failures_total) and the loop degrades to advisory
+            except Exception as exc:
+                self._failures.inc()
+                last = f"{type(exc).__name__}: {exc}"
+                if attempt + 1 < self.retries:
+                    time.sleep(self.backoff_s * (2**attempt))
+        record["attempts"] = self.retries
+        return last
+
+    def _apply(self, action: Action) -> None:
+        node = self._node
+        kind = action.kind
+        if kind == "force_merge":
+            node.force_merge(action.target)
+        elif kind == "rollover":
+            node.rollover_alias(
+                action.target,
+                action.params["index"],
+                action.params["new_index"],
+            )
+        elif kind == "demote_index":
+            node.demote_index(action.target)
+        elif kind == "promote_index":
+            node.promote_index(action.target)
+        elif kind == "move_shard":
+            node.move_shard_replica(
+                action.params["index"],
+                int(action.params["shard"]),
+                action.params["from"],
+                action.params["to"],
+            )
+        elif kind in ("grow_filter_budget", "shrink_filter_budget"):
+            node.retune_cache_budgets(
+                int(action.params["filter_bytes"]),
+                int(action.params["ann_bytes"]),
+                reason=action.reason,
+            )
+        elif kind in ("grow_packed_budget", "shrink_packed_budget"):
+            node.retune_packed_budget(
+                int(action.params["max_plane_docs"]),
+                reason=action.reason,
+            )
+        else:
+            raise ValueError(f"unknown remediation action [{kind}]")
+
+    def _publish_transition(self, record: dict) -> None:
+        """Ride the executed action into the published ClusterState (a
+        versioned, quorum-acked transition every member observes). A
+        standalone node has no cluster state — its GET /_remediation
+        history is the observable surface there."""
+        node = self._node
+        if getattr(node, "replication", None) is None:
+            return
+        try:
+            master = node.replication.cluster.master()
+            if master is not None:
+                master.note_remediation(
+                    {
+                        k: record[k]
+                        for k in (
+                            "id",
+                            "loop",
+                            "kind",
+                            "target",
+                            "reason",
+                            "at_ms",
+                        )
+                    }
+                )
+        # staticcheck: ignore[broad-except] publication is observability, not actuation: a masterless interval must not fail the action that already succeeded
+        except Exception:
+            pass
+
+    def _remember(self, record: dict) -> None:
+        with self._lock:
+            self._history.append(record)
+            if len(self._history) > self.HISTORY:
+                del self._history[: -self.HISTORY]
+
+    # ------------------------------------------------------------ surface
+
+    def note_on_demand_repack(self, index: str) -> None:
+        """A search re-packed a demoted index's planes on demand — the
+        lifecycle loop's lazy half, recorded so the narration is
+        complete."""
+        with self._lock:
+            self._seq += 1
+            record = {
+                "id": self._seq,
+                # staticcheck: ignore[wallclock-duration] operator-facing timestamp
+                "at_ms": int(time.time() * 1e3),
+                "loop": "lifecycle",
+                "kind": "on_demand_repack",
+                "target": index,
+                "reason": (
+                    f"search against demoted [{index}] re-packed its "
+                    "device planes on demand"
+                ),
+                "params": {},
+                "dry_run": False,
+                "executed": True,
+            }
+            self._history.append(record)
+            if len(self._history) > self.HISTORY:
+                del self._history[: -self.HISTORY]
+        self._actions_recent.inc()
+
+    def status(self) -> dict:
+        """GET /_remediation: config, advisory state, planned-vs-
+        executed history (newest first)."""
+        now = time.monotonic()
+        with self._lock:
+            history = list(reversed(self._history))
+            advisory = {
+                loop: {
+                    "until_s": round(until - now, 3),
+                    "reason": self._advisory_why.get(loop, ""),
+                }
+                for loop, until in self._advisory_until.items()
+                if until > now
+            }
+        executed = [r for r in history if r.get("executed")]
+        planned_only = [r for r in history if not r.get("executed")]
+        return {
+            "enabled": self.enabled,
+            "dry_run": self.dry_run,
+            "loops": list(ACTIONS),
+            "interval_s": self.interval_s,
+            "cooldown_s": self.cooldown_s,
+            "max_actions_per_window": self.max_actions,
+            "advisory": advisory,
+            "executed_total": int(self._actions.value),
+            "failures_total": int(self._failures.value),
+            "suppressed_total": int(self._suppressed.value),
+            "executed": executed,
+            "planned": planned_only,
+        }
+
+    def health_view(self) -> dict:
+        """The slice the health report grafts into its indicators: the
+        trailing window's records, advisory loops, dry-run flag."""
+        now = time.monotonic()
+        with self._lock:
+            recent = list(self._history[-16:])
+            advisory = {
+                loop: self._advisory_why.get(loop, "")
+                for loop, until in self._advisory_until.items()
+                if until > now
+            }
+        return {
+            "dry_run": self.dry_run,
+            "recent": recent,
+            "advisory": advisory,
+        }
+
+    # ------------------------------------------------------------ stepper
+
+    def start_stepper(self, interval_s: float | None = None) -> None:
+        """A paced standalone-node stepper (clustered nodes ride the
+        LocalCluster stepper hook instead)."""
+        if self._stepper is not None and self._stepper.is_alive():
+            return
+        pace = self.interval_s if interval_s is None else interval_s
+
+        def loop():
+            while not self._stop.wait(pace):
+                try:
+                    self.tick()
+                # staticcheck: ignore[broad-except] daemon remediation stepper: must survive any transient planning error and retry next tick — failures inside actuation are already counted by estpu_remediation_failures_total
+                except Exception:
+                    pass
+
+        self._stop.clear()
+        self._stepper = threading.Thread(
+            target=loop, daemon=True, name="estpu-remediation-stepper"
+        )
+        self._stepper.start()
+
+    def stop_stepper(self) -> None:
+        self._stop.set()
+        if self._stepper is not None:
+            self._stepper.join(timeout=2)
+            self._stepper = None

@@ -102,6 +102,20 @@ class ClusterState:
     # kill -9 of either data process. Static configuration, like
     # seed_nodes.
     voting_only: set[str] = field(default_factory=set)
+    # Bounded log of executed remediation actions (cluster/remediation.py):
+    # every self-driving action the master actuated rides the published
+    # state, so an action IS an observable, versioned cluster-state
+    # transition — any member (and GET /_remediation) can narrate what the
+    # control plane did and at which state version.
+    remediations: list[dict] = field(default_factory=list)
+
+    MAX_REMEDIATIONS = 32
+
+    def log_remediation(self, record: dict) -> None:
+        """Append one action record, keeping the log bounded."""
+        self.remediations.append(dict(record))
+        if len(self.remediations) > self.MAX_REMEDIATIONS:
+            del self.remediations[: -self.MAX_REMEDIATIONS]
 
     def newer_than(self, other: "ClusterState") -> bool:
         return (self.term, self.version) > (other.term, other.version)
@@ -122,6 +136,7 @@ class ClusterState:
             "indices": {k: v.to_json() for k, v in self.indices.items()},
             "node_sessions": dict(self.node_sessions),
             "voting_only": sorted(self.voting_only),
+            "remediations": [dict(r) for r in self.remediations],
         }
 
     @classmethod
@@ -137,4 +152,5 @@ class ClusterState:
             },
             node_sessions=dict(d.get("node_sessions", {})),
             voting_only=set(d.get("voting_only", [])),
+            remediations=[dict(r) for r in d.get("remediations", [])],
         )

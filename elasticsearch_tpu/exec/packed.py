@@ -149,6 +149,14 @@ class PackedExecutor:
         # (scope "_packed") — plane installs swap the registration.
         self.ledger = ledger
         self._plane_nbytes = 0
+        # Live plane-doc budget: starts at the class default; the
+        # remediation budget loop retunes it off occupancy (grow when
+        # riders fall back solo at the ceiling, shrink back toward the
+        # default when the plane sits mostly empty).
+        self.max_plane_docs = int(self.MAX_PLANE_DOCS)
+        # Retune events (bounded, newest last), riding stats() so
+        # occupancy shifts are attributable to a budget change.
+        self._retunes: list[dict] = []
         self._lock = threading.Lock()
         # Known packable tenants (weak: a deleted index must not be kept
         # alive, nor resurrect into the next plane).
@@ -587,11 +595,16 @@ class PackedExecutor:
                 if svc is None or len(svc.engines) != 1:
                     continue
                 engine = svc.engines[0]
+                if getattr(engine, "demoted", False):
+                    # Demoted tenant: its planes live on the host (device
+                    # is None); it re-packs on demand when searched and
+                    # can ride the next plane rebuild after that.
+                    continue
                 handles = [
                     h for h in engine.segments if h.segment.num_docs > 0
                 ]
                 docs = sum(h.device.num_docs for h in handles)
-                if total_docs + docs > self.MAX_PLANE_DOCS:
+                if total_docs + docs > self.max_plane_docs:
                     if uuid in current:
                         # Even with priority admission an active rider
                         # doesn't fit: packing is unavailable this batch.
@@ -643,6 +656,32 @@ class PackedExecutor:
 
     # -------------------------------------------------------------- stats
 
+    MAX_RETUNES = 8
+
+    def retune(self, max_plane_docs: int, reason: str = "") -> dict:
+        """Remediation budget-loop hook: move the plane-doc budget. A
+        shrink drops the cached plane so the next batch re-admits under
+        the new budget; a grow keeps the plane (the next rebuild admits
+        more). The event is recorded on stats()."""
+        import time
+
+        with self._lock:
+            old = self.max_plane_docs
+            self.max_plane_docs = max(1, int(max_plane_docs))
+            if self.max_plane_docs < old:
+                self._plane_key = None  # force re-admission next batch
+            event = {
+                # staticcheck: ignore[wallclock-duration] operator-facing timestamp, not a duration
+                "at_ms": int(time.time() * 1e3),
+                "from_docs": old,
+                "to_docs": self.max_plane_docs,
+                "reason": reason,
+            }
+            self._retunes.append(event)
+            if len(self._retunes) > self.MAX_RETUNES:
+                del self._retunes[: -self.MAX_RETUNES]
+            return event
+
     def stats(self) -> dict:
         """`GET /_nodes/stats` exec.packed payload."""
         with self._lock:
@@ -656,6 +695,9 @@ class PackedExecutor:
             "plane_rebuilds": int(self._rebuilds.value),
             "fallback_solo": int(self._fallbacks.value),
             "plane_docs": plane.num_docs if plane is not None else 0,
+            "max_plane_docs": int(self.max_plane_docs),
+            "default_plane_docs": int(self.MAX_PLANE_DOCS),
+            "retunes": [dict(r) for r in self._retunes],
             # Device bytes of the resident plane — the consistency-law
             # twin of the ledger's "packed_plane" registration.
             "plane_bytes": int(plane_nbytes),

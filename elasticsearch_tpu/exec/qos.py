@@ -48,6 +48,12 @@ from ..faults import fault_point
 
 # Requests without a tenant attribution share one lane.
 DEFAULT_LANE = "_default"
+# Once ESTPU_QOS_MAX_LANES distinct tenants have been given dedicated
+# lanes, every NEW tenant key folds into this shared lane permanently —
+# a client spamming unique `X-Opaque-Id` values gets collective (not
+# per-id) fairness and cannot grow per-lane state or metric label
+# cardinality without bound.
+OVERFLOW_LANE = "_overflow"
 
 
 def _env_float(name: str, default: float) -> float:
@@ -147,6 +153,12 @@ class QosController:
         self.window_s = window_s
         self.quantum_ms = max(0.1, quantum_ms)
         self.weights = parse_weights(os.environ.get("ESTPU_QOS_WEIGHTS"))
+        # Hard bound on DISTINCT tenant keys ever granted a dedicated
+        # lane; later keys fold into OVERFLOW_LANE (_resolve_locked).
+        self.max_lanes = max(
+            1, int(_env_float("ESTPU_QOS_MAX_LANES", float(self.MAX_LANES)))
+        )
+        self._known_keys: set[str] = set()
         self._cv = threading.Condition()
         self._lanes: "OrderedDict[str, _Lane]" = OrderedDict()
         self._inflight_total = 0
@@ -171,14 +183,32 @@ class QosController:
             if lane is not None:
                 lane.weight = self.weights[key]
 
-    def _lane_locked(self, key: str) -> _Lane:
+    def _resolve_locked(self, key: str) -> str:
+        """Fold past-the-bound tenant keys into the shared overflow
+        lane. Known keys (ever granted a dedicated lane), explicitly
+        weighted tenants, and the default lane always resolve to
+        themselves; once `max_lanes` distinct keys exist, every new one
+        resolves to OVERFLOW_LANE — permanently, so a returning folded
+        tenant stays folded (no instrument-series flapping)."""
         key = key or DEFAULT_LANE
+        if key in self._known_keys:
+            return key
+        if key in self.weights or key == DEFAULT_LANE:
+            self._known_keys.add(key)
+            return key
+        if len(self._known_keys) >= self.max_lanes:
+            return OVERFLOW_LANE
+        self._known_keys.add(key)
+        return key
+
+    def _lane_locked(self, key: str) -> _Lane:
+        key = self._resolve_locked(key)
         lane = self._lanes.get(key)
         if lane is None:
             lane = _Lane(key, self.weights.get(key, 1.0))
             self._lanes[key] = lane
             # LRU-bound: never evict a lane holding live state.
-            while len(self._lanes) > self.MAX_LANES:
+            while len(self._lanes) > self.max_lanes:
                 for old_key, old in self._lanes.items():
                     if old.inflight == 0 and old.waiting == 0:
                         del self._lanes[old_key]
@@ -186,7 +216,7 @@ class QosController:
                 else:
                     break
         lane.last_used = time.monotonic()
-        self._lanes.move_to_end(key)
+        self._lanes.move_to_end(lane.key)
         return lane
 
     def _lane_instrument(self, cache: dict, key: str, kind: str, name: str, help_: str):
@@ -215,9 +245,10 @@ class QosController:
             lane = self._lane_locked(key)
             self._prune_locked(lane, now)
             lane.wait_events.append((now, wait_s))
+            lane_key = lane.key  # RESOLVED: folded tenants share series
         inst = self._lane_instrument(
             self._wait_recent,
-            key or DEFAULT_LANE,
+            lane_key,
             "windowed_histogram",
             "estpu_qos_queue_wait_recent_ms",
             "Per-lane admission + batch-queue wait over the trailing "
@@ -237,9 +268,10 @@ class QosController:
             self._prune_locked(lane, now)
             lane.cost_events.append((now, cost_ms))
             lane.deficit -= cost_ms
+            lane_key = lane.key  # RESOLVED: folded tenants share series
         inst = self._lane_instrument(
             self._cost_recent,
-            key or DEFAULT_LANE,
+            lane_key,
             "windowed_counter",
             "estpu_qos_lane_cost_recent_ms",
             "Per-lane observed execution cost (ms) over the trailing "
@@ -302,6 +334,7 @@ class QosController:
         with self._cv:
             lane = self._lane_locked(key)
             lane.shed_count += 1
+            key = lane.key  # RESOLVED: folded tenants share one series
         counter = self._lane_instrument(
             self._shed_total,
             key,
@@ -382,6 +415,9 @@ class QosController:
         deadline = t0 + self.admit_wait_s
         with self._cv:
             lane = self._lane_locked(key)
+            # RESOLVED from here on: a folded tenant contends, sheds,
+            # and reports as the shared overflow lane, not its raw id.
+            key = lane.key
             while True:
                 # The global budget is a HARD ceiling; under it, the lane
                 # quota decides who gets the slot. Work-conserving: an

@@ -45,6 +45,14 @@ Sites currently threaded (fnmatch patterns match against these names):
     qos.shed                    a per-tenant QoS lane rejecting a request
                                 (exec/qos.py): arm a delay to rehearse
                                 slow-shed backpressure
+    remediate.*                 one remediation action's actuation
+                                (cluster/remediation.py, per loop:
+                                remediate.lifecycle / remediate.allocation
+                                / remediate.budget): evaluated at the top
+                                of each execute attempt — arm it to make
+                                the self-driving action itself fail
+                                mid-flight and watch the loop retry with
+                                backoff, then degrade to advisory
 
 Configuration is per-site: error rate, error class (internal | transport |
 breaker), injected latency, a count budget, and a seed. Specs arm via the
@@ -91,6 +99,7 @@ SITES = (
     "breaker.reserve",
     "async.reduce",
     "qos.shed",
+    "remediate.*",
 )
 
 

@@ -307,6 +307,10 @@ class AnnCache:
             "Distinct k-means centroids resident across cached planes",
             fn=lambda: self._centroids_resident,
         )
+        # Remediation budget-loop retunes (bounded, newest last): each
+        # event rides stats() so operators can attribute recall/latency
+        # shifts to a budget change instead of a workload change.
+        self._retunes: list[dict] = []
         self._searches: dict[str, Any] = {}
         self._probes = metrics.counter(
             "estpu_ann_probes_total",
@@ -487,12 +491,38 @@ class AnnCache:
                 self._drop_locked(k)
             return len(keys)
 
+    MAX_RETUNES = 8
+
+    def retune(self, max_bytes: int, reason: str = "") -> dict:
+        """Remediation budget-loop hook: move the byte budget and drop
+        LRU planes down to it immediately, recording the event on this
+        cache's own stats (the filter cache's retune twin)."""
+        with self._lock:
+            old = self.max_bytes
+            self.max_bytes = max(0, int(max_bytes))
+            while self._bytes > self.max_bytes and self._entries:
+                self._drop_locked(next(iter(self._entries)))
+            import time
+
+            event = {
+                # staticcheck: ignore[wallclock-duration] operator-facing timestamp, not a duration
+                "at_ms": int(time.time() * 1e3),
+                "from_bytes": old,
+                "to_bytes": self.max_bytes,
+                "reason": reason,
+            }
+            self._retunes.append(event)
+            if len(self._retunes) > self.MAX_RETUNES:
+                del self._retunes[: -self.MAX_RETUNES]
+            return event
+
     def stats(self) -> dict:
         with self._lock:
             entries = list(self._entries.values())
             bytes_resident = self._bytes
             searches = list(self._searches.items())
             recall_gate = list(self._recall_gate.items())
+            retunes = [dict(r) for r in self._retunes]
         return {
             "enabled": True,
             "planes": len(entries),
@@ -500,6 +530,7 @@ class AnnCache:
             "centroids": sum(p.n_clusters for p in entries),
             "vectors": sum(p.n_vectors for p in entries),
             "bytes_resident": bytes_resident,
+            "budget_bytes": self.max_bytes,
             "builds": int(self._builds.value),
             "evictions": int(self._evictions.value),
             "searches": {b: int(c.value) for b, c in sorted(searches)},
@@ -507,6 +538,7 @@ class AnnCache:
             "recall_gate": {
                 o: int(c.value) for o, c in sorted(recall_gate)
             },
+            "retunes": retunes,
         }
 
     @staticmethod
@@ -519,11 +551,13 @@ class AnnCache:
             "centroids": 0,
             "vectors": 0,
             "bytes_resident": 0,
+            "budget_bytes": 0,
             "builds": 0,
             "evictions": 0,
             "searches": {},
             "probes": 0,
             "recall_gate": {},
+            "retunes": [],
         }
 
 

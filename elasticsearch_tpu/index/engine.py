@@ -136,6 +136,11 @@ class SegmentHandle:
     def sync_live(self) -> None:
         """Re-upload the live mask if deletions happened since last sync."""
         if self.live_dirty:
+            if self.device is None:
+                # Demoted to host: the re-pack (Engine.ensure_device)
+                # re-derives the device mask from live_host and clears
+                # the dirty flag then.
+                return
             import jax
 
             self.device.live = jax.device_put(self.live_host.copy())
@@ -241,6 +246,10 @@ class Engine:
         self.translog: Translog | None = None
         self._next_seg_id = 1
         self._recovering = False
+        # Cold-tier demotion (cluster/remediation.py lifecycle loop):
+        # device planes dropped to free HBM, host segments stay — the
+        # next search (or an explicit promotion) re-packs on demand.
+        self._demoted = False
         if data_path is not None:
             os.makedirs(data_path, exist_ok=True)
             # Recovery must load durably-acked data regardless of the HBM
@@ -727,6 +736,76 @@ class Engine:
     def device_bytes(self) -> int:
         """HBM held by this engine's packed segments."""
         return sum(h.nbytes for h in self.segments)
+
+    # -------------------------------------------------- cold-tier demotion
+
+    @property
+    def demoted(self) -> bool:
+        """True while device planes are dropped (host segments remain)."""
+        return self._demoted
+
+    def demote_device(self) -> int:
+        """Drop every packed device plane to free HBM, keeping the host
+        segments (postings, doc values, live masks) intact — the cold
+        tier of the remediation lifecycle loop. Searches re-pack on
+        demand through `ensure_device`, bit-identically: the device
+        planes are a pure function of the host segments. Returns the
+        HBM bytes released from the breaker."""
+        with self.lock:
+            if self._demoted or not self.segments:
+                return 0
+            freed = 0
+            for handle in self.segments:
+                if handle.nbytes and self.breaker is not None:
+                    self.breaker.release(
+                        handle.nbytes, label="segment", scope=self.uid
+                    )
+                freed += handle.nbytes
+                handle.device = None
+                handle.nbytes = 0
+            self._demoted = True
+            return freed
+
+    def ensure_device(self) -> bool:
+        """Re-pack any dropped device planes (promotion / on-demand
+        re-pack at search time). Same `_pack_accounted` path as refresh,
+        so the HBM breaker + ledger account the return trip; handle uids
+        and the engine generation are unchanged — the planes hold the
+        SAME searchable content, so filter/ANN cache entries stay warm
+        and hits stay bit-identical through the demote/re-pack cycle.
+        Returns True when a re-pack happened."""
+        if not self._demoted:
+            return False
+        with self.lock:
+            if not self._demoted:
+                return False
+            for handle in self.segments:
+                if handle.device is not None:
+                    continue
+                device, nbytes = self._pack_accounted(handle.segment)
+                handle.device = device
+                handle.nbytes = nbytes
+                if handle.live_dirty:
+                    # Deletions landed while demoted: the device mask
+                    # must advance past the pack-time all-live default,
+                    # and the epoch must bump so mask caches re-key.
+                    import jax
+
+                    # staticcheck: ignore[lock-blocking-call] deliberate: the re-packed plane and its live mask must install atomically against concurrent refresh/delete; promotion is a rare background action, not a request path
+                    handle.device.live = jax.device_put(
+                        handle.live_host.copy()
+                    )
+                    handle.live_dirty = False
+                    handle.live_epoch += 1
+                elif not bool(handle.live_host.all()):
+                    import jax
+
+                    # staticcheck: ignore[lock-blocking-call] deliberate: same atomic plane+mask install as the dirty branch (epoch unchanged — the mask content equals what caches already keyed)
+                    handle.device.live = jax.device_put(
+                        handle.live_host.copy()
+                    )
+            self._demoted = False
+            return True
 
     # ------------------------------------------------------------- merging
 

@@ -46,6 +46,7 @@ move top-k ids, order, fp32 scores, or totals on any execution path.
 from __future__ import annotations
 
 import threading
+import time
 from collections import Counter, OrderedDict, deque
 from typing import Any, Callable
 
@@ -92,6 +93,10 @@ class FilterCache:
         # False), the policy's leaf-independent frequency count.
         self._history: deque = deque(maxlen=max(1, int(history)))
         self._freq: Counter = Counter()
+        # Remediation budget-loop retunes (bounded, newest last): each
+        # event rides stats() so operators can attribute hit-rate shifts
+        # to a budget change instead of a workload change.
+        self._retunes: list[dict] = []
         if metrics is None:
             from ..obs.metrics import MetricsRegistry
 
@@ -308,6 +313,30 @@ class FilterCache:
                 self._drop_locked(k)
             return len(stale)
 
+    MAX_RETUNES = 8
+
+    def retune(self, max_bytes: int, reason: str = "") -> dict:
+        """Remediation budget-loop hook: move the byte budget and evict
+        LRU planes down to it immediately. The retune is recorded on
+        this cache's own stats (bounded, newest last) so a hit-rate
+        shift is attributable to the budget change."""
+        with self._lock:
+            old = self.max_bytes
+            self.max_bytes = max(0, int(max_bytes))
+            while self._bytes > self.max_bytes and self._entries:
+                self._evict_lru_locked()
+            event = {
+                # staticcheck: ignore[wallclock-duration] operator-facing timestamp, not a duration
+                "at_ms": int(time.time() * 1e3),
+                "from_bytes": old,
+                "to_bytes": self.max_bytes,
+                "reason": reason,
+            }
+            self._retunes.append(event)
+            if len(self._retunes) > self.MAX_RETUNES:
+                del self._retunes[: -self.MAX_RETUNES]
+            return event
+
     def note_reuse(self, n: int) -> None:
         """Count `n` cached planes substituted into one launch."""
         if n > 0:
@@ -338,11 +367,13 @@ class FilterCache:
             "enabled": True,
             "entries": entries,
             "bytes_resident": bytes_resident,
+            "budget_bytes": self.max_bytes,
             "hit_count": int(self._hits.value),
             "miss_count": int(self._misses.value),
             "admissions": int(self._admissions.value),
             "evictions": int(self._evictions.value),
             "mask_reuse": int(self._mask_reuse.value),
+            "retunes": [dict(r) for r in self._retunes],
         }
 
     @staticmethod
@@ -353,11 +384,13 @@ class FilterCache:
             "enabled": False,
             "entries": 0,
             "bytes_resident": 0,
+            "budget_bytes": 0,
             "hit_count": 0,
             "miss_count": 0,
             "admissions": 0,
             "evictions": 0,
             "mask_reuse": 0,
+            "retunes": [],
         }
 
 

@@ -449,10 +449,32 @@ class Node:
         from .exec.async_search import AsyncSearchService
 
         self.async_search = AsyncSearchService(self)
+        # Trailing-window searched-index tracking (bounded dict): the
+        # remediation lifecycle loop must never demote an index that is
+        # being searched right now.
+        self._search_seen: dict[str, float] = {}
+        # Self-driving remediation (cluster/remediation.py): plans off
+        # the SAME HealthContext the indicators render and actuates
+        # through this node's own surfaces (force-merge, demotion,
+        # shard moves, cache retunes). ESTPU_REMEDIATION=0 disarms it;
+        # ESTPU_REMEDIATION_DRY_RUN=1 plans without actuating.
+        from .cluster.remediation import RemediationService
+
+        self.remediation = RemediationService(self, metrics=self.metrics)
         if self.replication is not None:
             # Re-home the gateway's counters onto this node's registry
             # (still zero at this point) so `GET /_metrics` exposes them.
             self.replication.bind_metrics(self.metrics)
+            cluster = self.replication.cluster
+            if hasattr(cluster, "remediation_hook"):
+                # In-process LocalCluster: the remediation tick rides
+                # the master's stepper (self-rate-limited by its own
+                # interval). The async form keeps the context fan's
+                # per-send deadline off the control-plane step loop —
+                # a partitioned member must never stall elections or
+                # recoveries. The proc-clustered form has no in-process
+                # master to ride — POST /_remediation drives it there.
+                cluster.remediation_hook = self.remediation.tick_async
         # Extension system (plugins.py): analyzers / ingest processors /
         # query types contributed by ESTPU_PLUGINS or the plugins param.
         from .plugins import load_plugins
@@ -1482,6 +1504,7 @@ class Node:
     ) -> dict:
         write_t0 = time.monotonic()
         svc = self.get_index(index, auto_create=True)
+        self._note_index_write(svc.name)
         source = self._apply_pipeline(svc, source, pipeline)
         if source is None:  # dropped by an ingest drop processor
             return {
@@ -1566,6 +1589,7 @@ class Node:
         timeout_s: float | None = None,
     ) -> dict:
         svc = self.get_index(index)
+        self._note_index_write(svc.name)
         if self.replication is not None:
             out = self._replicated_write(
                 svc, doc_id, None, op="delete", refresh=refresh,
@@ -1921,6 +1945,7 @@ class Node:
             return self._multi_index_search(targets, body, scroll)
         index = targets[0]
         svc = self.get_index(index)
+        self._note_index_searched(svc)
         if body:
             body = self.resolve_script_refs(body)
         if self.replication is not None:
@@ -3997,6 +4022,35 @@ class Node:
             "qos": self.qos.health_inputs(),
             "step_errors": 0,
         }
+        # Cache budget/occupancy snapshots: the remediation budget loop
+        # tunes filter/ANN/packed budgets against each other from these
+        # (plus evictions_recent below).
+        from .index.ann import AnnCache
+        from .index.filter_cache import FilterCache
+
+        caches: dict[str, Any] = {
+            "filter": (
+                self.filter_cache.stats()
+                if self.filter_cache is not None
+                else FilterCache.disabled_stats()
+            ),
+            "ann": (
+                self.ann_cache.stats()
+                if self.ann_cache is not None
+                else AnnCache.disabled_stats()
+            ),
+        }
+        if self.packed_exec is not None:
+            caches["packed"] = self.packed_exec.stats()
+        out["caches"] = caches
+        writes: dict[str, int] = {}
+        for labels, window in self.metrics.windows(
+            "estpu_index_writes_recent"
+        ):
+            name = labels.get("index")
+            if name:
+                writes[name] = writes.get(name, 0) + int(window.count())
+        out["writes_recent"] = writes
         out.update(self._recent_windows())
         mesh: dict[str, str] = {}
         for name, svc in sorted(self.indices.items()):
@@ -4081,11 +4135,241 @@ class Node:
             fan_failures=failures,
             fanned=fanned,
             local_indices=self.indices,
+            **self._remediation_ctx_fields(),
         )
         report = self.health.report(
             ctx, verbose=verbose, indicator=indicator
         )
         return report
+
+    # ---------------------------------------------------------- remediation
+
+    def _note_index_write(self, index: str) -> None:
+        """Chokepoint for the per-index write-rate window: index_doc and
+        delete_doc both land here (bulk routes through them), so the
+        remediation lifecycle loop sees every mutation path."""
+        self.metrics.windowed_counter(
+            "estpu_index_writes_recent",
+            "Document writes by index over the trailing window",
+            index=index,
+        ).inc()
+
+    def _note_index_searched(self, svc) -> None:
+        """Record that an index is actively searched (the lifecycle loop
+        never demotes such an index) and transparently re-pack it if a
+        prior demotion moved its planes off-device."""
+        now = time.monotonic()
+        seen = self._search_seen
+        seen[svc.name] = now
+        if len(seen) > 512:
+            # Bounded: drop the stalest entry (staleness past the 60s
+            # recency horizon makes the victim's identity irrelevant).
+            seen.pop(min(seen, key=seen.get), None)
+        promoted = False
+        for engine in svc.engines:
+            if getattr(engine, "demoted", False) and engine.ensure_device():
+                promoted = True
+        if promoted:
+            self.remediation.note_on_demand_repack(svc.name)
+
+    def _remediation_ctx_fields(self) -> dict[str, Any]:
+        """HealthContext fields only the remediation loops consume —
+        spliced into health_report's context too so `GET /_health_report`
+        and the planner read the SAME view."""
+        now = time.monotonic()
+        recent = tuple(
+            sorted(
+                name
+                for name, at in self._search_seen.items()
+                if now - at <= 60.0
+            )
+        )
+        return {
+            "aliases": {
+                a: tuple(sorted(t)) for a, t in self.aliases.items()
+            },
+            "recent_search_indices": recent,
+            "scrolls_active": len(self._scrolls),
+            "remediation": self.remediation.health_view(),
+            # Wall clock feeds the rollover max-age policy only — never
+            # differenced against monotonic stamps.
+            "now": time.time(),  # staticcheck: ignore[wallclock-duration] policy clock, not a duration
+        }
+
+    def _remediation_context(self) -> HealthContext:
+        """The planner's view: the same context shape health_report
+        renders, built on the remediation stepper's cadence. Fans
+        health_inputs over in-process cluster members so the allocation
+        loop can compare nodes; the proc-clustered topology has no
+        in-process stepper, so no fan is needed here."""
+        node_inputs = {self.node_name: self._health_inputs_local()}
+        failures: list[dict] = []
+        expected: tuple[str, ...] = ()
+        fanned = False
+        if self.replication is not None and self._procs is None:
+            fanned = True
+            expected = tuple(sorted(self.replication.cluster.nodes))
+            results, failures = self._cluster_fan("health_inputs", {})
+            for node_id, section in results.items():
+                if node_id == self.node_name:
+                    merged = dict(section)
+                    merged.update(node_inputs[node_id])
+                    node_inputs[node_id] = merged
+                else:
+                    node_inputs[node_id] = section
+        return HealthContext(
+            cluster_name=self.cluster_name,
+            coordinator=self.node_name,
+            standalone=self.replication is None,
+            state=self._coordinator_state(),
+            expected_nodes=expected,
+            node_inputs=node_inputs,
+            fan_failures=failures,
+            fanned=fanned,
+            local_indices=self.indices,
+            **self._remediation_ctx_fields(),
+        )
+
+    def rollover_alias(
+        self, alias: str, old_index: str, new_index: str
+    ) -> dict:
+        """Actuate a lifecycle rollover: create the successor with the
+        old index's mappings/settings and atomically repoint the alias.
+        The old index stays searchable (and demotable once it goes
+        cold)."""
+        if new_index in self.indices:
+            raise ApiError(
+                400,
+                "resource_already_exists_exception",
+                f"index [{new_index}] already exists",
+            )
+        old = self.get_index(old_index)
+        self.create_index(
+            new_index,
+            {
+                "mappings": old.mappings.to_json(),
+                "settings": {
+                    "index": {"number_of_shards": old.n_shards}
+                },
+            },
+        )
+        self.aliases[alias] = {new_index}
+        self._save_aliases()
+        return {"acknowledged": True, "old_index": old_index,
+                "new_index": new_index}
+
+    def demote_index(self, index: str) -> dict:
+        """Move an index's segment planes off-device (HBM -> host).
+        Searches transparently re-pack on demand (_note_index_searched);
+        hits stay bit-identical because device planes are a pure
+        function of the host segments."""
+        svc = self.get_index(index)
+        freed = 0
+        for engine in svc.engines:
+            freed += engine.demote_device()
+        self._prune_dead_cache_planes(svc)
+        return {"acknowledged": True, "freed_bytes": int(freed)}
+
+    def promote_index(self, index: str) -> dict:
+        """Re-pack a demoted index's planes back onto the device."""
+        svc = self.get_index(index)
+        promoted = False
+        for engine in svc.engines:
+            if getattr(engine, "demoted", False) and engine.ensure_device():
+                promoted = True
+        return {"acknowledged": True, "promoted": promoted}
+
+    def move_shard_replica(
+        self, index: str, shard_id: int, from_node: str, to_node: str
+    ) -> dict:
+        """Actuate an allocation move via the elected master (replicas
+        only — the master action rejects primary moves, so acked writes
+        are never at risk)."""
+        if self.replication is None:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                "shard moves require a cluster",
+            )
+        master = self.replication.cluster.master()
+        if master is None:
+            raise ApiError(
+                503, "master_not_discovered_exception", "no elected master"
+            )
+        out = master.move_shard_replica(index, shard_id, from_node, to_node)
+        if not out.get("acked"):
+            raise ApiError(
+                503,
+                "cluster_block_exception",
+                f"shard move [{index}][{shard_id}] not acked",
+            )
+        return out
+
+    def retune_cache_budgets(
+        self, filter_bytes: int, ann_bytes: int, reason: str = ""
+    ) -> dict:
+        """Actuate a budget-loop shift between the filter and ANN cache
+        budgets; each cache records the retune as an event on its
+        stats."""
+        out: dict[str, Any] = {"acknowledged": True}
+        if self.filter_cache is not None:
+            out["filter"] = self.filter_cache.retune(
+                int(filter_bytes), reason=reason
+            )
+        if self.ann_cache is not None:
+            out["ann"] = self.ann_cache.retune(int(ann_bytes), reason=reason)
+        return out
+
+    def retune_packed_budget(
+        self, max_plane_docs: int, reason: str = ""
+    ) -> dict:
+        """Actuate a packed-plane budget retune."""
+        if self.packed_exec is None:
+            return {"acknowledged": False}
+        return {
+            "acknowledged": True,
+            "packed": self.packed_exec.retune(
+                int(max_plane_docs), reason=reason
+            ),
+        }
+
+    def get_remediation(self) -> dict:
+        """GET /_remediation — planned-vs-executed history, per-loop
+        advisory state, damping windows, and (when clustered) the
+        remediation transitions published into cluster state."""
+        out = self.remediation.status()
+        if self.replication is not None:
+            state = self._coordinator_state()
+            published = getattr(state, "remediations", None)
+            if published is not None:
+                out["published"] = [dict(r) for r in published]
+        return out
+
+    def post_remediation(self, body: dict | None) -> dict:
+        """POST /_remediation — toggle dry_run/enabled at runtime and/or
+        force a planning tick (`{"tick": true}`), which is also how the
+        proc-clustered topology (no in-process stepper) drives the
+        loops."""
+        body = body or {}
+        svc = self.remediation
+        for key in ("dry_run", "enabled"):
+            if key in body:
+                if not isinstance(body[key], bool):
+                    raise ApiError(
+                        400,
+                        "illegal_argument_exception",
+                        f"[{key}] must be a boolean",
+                    )
+                setattr(svc, key, body[key])
+        out: dict[str, Any] = {
+            "acknowledged": True,
+            "enabled": svc.enabled,
+            "dry_run": svc.dry_run,
+        }
+        if body.get("tick"):
+            records = svc.tick(force=True)
+            out["records"] = [dict(r) for r in records or []]
+        return out
 
     # ---------------------------------------------------------------- admin
 

@@ -152,6 +152,19 @@ class HealthContext:
     # step-error deltas) — filled by HealthService.report.
     recent_terms: int = 0
     recent_step_errors: int = 0
+    # Remediation inputs (cluster/remediation.py reads the SAME context
+    # the indicators render): alias -> sorted target names, the trailing
+    # window's searched index names (demotion must never pick a hot
+    # index), live scroll cursors (their frozen handles pin device
+    # planes), and the remediation service's own recent-action view
+    # (RemediationService.health_view()) for diagnosis grafting.
+    aliases: dict[str, tuple] = field(default_factory=dict)
+    recent_search_indices: tuple = ()
+    scrolls_active: int = 0
+    remediation: dict | None = None
+    # Report wall-clock (plan_lifecycle's rollover-age input): filled by
+    # the node when it builds the context, so planners stay clock-free.
+    now: float = 0.0
 
 
 def _result(
@@ -168,6 +181,79 @@ def _result(
         "impacts": impacts or [],
         "diagnosis": diagnosis or [],
     }
+
+
+def _graft_remediation(
+    indicators: dict[str, Any], ctx: HealthContext
+) -> None:
+    """Name the remediation loops' recent work in the indicators they
+    serve (ACTION_INDICATOR maps loop -> indicator): every executed
+    action, every dry-run plan, and every advisory-degraded loop lands
+    in that indicator's details + diagnosis — the report narrates what
+    the self-driving control plane did, not just what it saw."""
+    view = ctx.remediation
+    if not view:
+        return
+    from ..cluster.remediation import ACTION_INDICATOR
+
+    for record in view.get("recent", []):
+        name = ACTION_INDICATOR.get(record.get("loop"))
+        if name is None or name not in indicators:
+            continue
+        entry = indicators[name]
+        entry.setdefault("details", {}).setdefault(
+            "remediation", []
+        ).append(
+            {
+                "kind": record.get("kind"),
+                "target": record.get("target"),
+                "executed": bool(record.get("executed")),
+                "dry_run": bool(record.get("dry_run")),
+                "suppressed": record.get("suppressed"),
+            }
+        )
+        diagnosis = entry.setdefault("diagnosis", [])
+        if record.get("executed"):
+            diagnosis.append(
+                {
+                    "cause": record.get("reason", ""),
+                    "action": (
+                        f"remediation executed [{record.get('kind')}] "
+                        f"on [{record.get('target')}] — no operator "
+                        "action needed"
+                    ),
+                }
+            )
+        elif record.get("dry_run") and not record.get("suppressed"):
+            diagnosis.append(
+                {
+                    "cause": record.get("reason", ""),
+                    "action": (
+                        f"remediation planned [{record.get('kind')}] on "
+                        f"[{record.get('target')}] but dry-run mode is "
+                        "on: unset ESTPU_REMEDIATION_DRY_RUN (or POST "
+                        "/_remediation {\"dry_run\": false}) to actuate"
+                    ),
+                }
+            )
+    for loop, why in (view.get("advisory") or {}).items():
+        name = ACTION_INDICATOR.get(loop)
+        if name is None or name not in indicators:
+            continue
+        indicators[name].setdefault("diagnosis", []).append(
+            {
+                "cause": (
+                    f"remediation loop [{loop}] degraded to advisory: "
+                    f"{why}"
+                ),
+                "action": (
+                    "actuation is paused after repeated failures; "
+                    "investigate the failing action (GET /_remediation) "
+                    "— the loop resumes automatically after the "
+                    "advisory window"
+                ),
+            }
+        )
 
 
 def _fan_failure_diagnosis(ctx: HealthContext) -> list[dict]:
@@ -1098,6 +1184,8 @@ class HealthService:
                     "symptom": result["symptom"],
                 }
             indicators[name] = result
+        if verbose:
+            _graft_remediation(indicators, ctx)
         status = worst(r["status"] for r in indicators.values())
         with self._lock:
             self._reports += 1

@@ -933,6 +933,24 @@ CATALOG = {
         "windowed_histogram",
         "exec.async_search",
     ),
+    # Self-driving remediation (cluster/remediation.py): rounds planned,
+    # actions executed, per-attempt failures (the chaos arc's counter),
+    # suppressions (hysteresis/cooldown/cap/advisory), plus the trailing
+    # window's action count and per-round wall cost (the quiet-cluster
+    # overhead gate in bench cfg16_remediation).
+    "estpu_remediation_ticks_total": ("counter", "remediation"),
+    "estpu_remediation_actions_total": ("counter", "remediation"),
+    "estpu_remediation_failures_total": ("counter", "remediation"),
+    "estpu_remediation_suppressed_total": ("counter", "remediation"),
+    "estpu_remediation_actions_recent": ("windowed_counter", "remediation"),
+    "estpu_remediation_tick_recent_ms": (
+        "windowed_histogram",
+        "remediation",
+    ),
+    # Per-index write rate over the trailing window (node.py write
+    # chokepoint): the lifecycle loop schedules background force-merges
+    # only when an index went quiet.
+    "estpu_index_writes_recent": ("windowed_counter", "indices"),
 }
 
 # Pow-2-ish bounds for the padding-waste ratio and occupancy/wait shapes.

@@ -452,6 +452,13 @@ class RestServer:
         r("DELETE", "/_fault/{site}", lambda s, p, q, b: n.clear_faults(
             p["site"]
         ))
+        # Self-driving remediation (cluster/remediation.py): planned-vs-
+        # executed history + runtime dry_run/enabled toggles and forced
+        # planning ticks.
+        r("GET", "/_remediation", lambda s, p, q, b: n.get_remediation())
+        r("POST", "/_remediation", lambda s, p, q, b: n.post_remediation(
+            _json(b)
+        ))
         # Observability: trace ring + Prometheus exposition.
         r("GET", "/_traces", lambda s, p, q, b: n.get_traces(
             limit=int(q.get("limit", 50))

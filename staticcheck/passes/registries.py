@@ -58,6 +58,11 @@ RULES = {
         "implementation in obs/health.py (or an implementation absent "
         "from INDICATORS)"
     ),
+    "registry-action": (
+        "remediation ACTIONS entry without a plan_<name> implementation "
+        "in cluster/remediation.py (or an implementation absent from "
+        "ACTIONS)"
+    ),
 }
 
 _PLANNER = "elasticsearch_tpu/exec/planner.py"
@@ -67,6 +72,7 @@ _METRICS = "elasticsearch_tpu/obs/metrics.py"
 _COMPILE = "elasticsearch_tpu/query/compile.py"
 _DEVICE_OBS = "elasticsearch_tpu/obs/device.py"
 _HEALTH = "elasticsearch_tpu/obs/health.py"
+_REMEDIATION = "elasticsearch_tpu/cluster/remediation.py"
 
 # Files handling raw bool-spec tuples (construction restricted to
 # make_bool_spec in compile.py; index bounds checked everywhere below).
@@ -117,6 +123,7 @@ def run(project: Project) -> list[Finding]:
     findings += _check_bool_spec(project)
     findings += _check_breaker_labels(project)
     findings += _check_indicators(project)
+    findings += _check_actions(project)
     return findings
 
 
@@ -526,6 +533,67 @@ def _check_indicators(project: Project) -> list[Finding]:
                         f"indicator_[{name}] is implemented but absent "
                         "from INDICATORS — it never renders in the "
                         "health report"
+                    ),
+                )
+            )
+    return out
+
+
+# ------------------------------------------------------------- actions
+
+def _check_actions(project: Project) -> list[Finding]:
+    """The remediation-planner registry (cluster/remediation.py
+    ACTIONS): every registered loop must have a pure module-level
+    `plan_<name>` implementation, and every implementation must be
+    registered — `RemediationService.plan` dispatches by name exactly
+    like the health report dispatches INDICATORS, so an unregistered
+    planner silently never runs and a registered ghost KeyErrors every
+    tick."""
+    remediation = project.get(_REMEDIATION)
+    if remediation is None:
+        return []
+    names, line = _assigned_tuple(remediation.tree, "ACTIONS")
+    if not names:
+        return [
+            Finding(
+                rule="registry-action",
+                path=_REMEDIATION,
+                line=1,
+                message="ACTIONS tuple not found",
+            )
+        ]
+    implemented: dict[str, int] = {}
+    for node in remediation.tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name.startswith(
+            "plan_"
+        ):
+            implemented[node.name[len("plan_"):]] = node.lineno
+    out = []
+    for name in names:
+        if name not in implemented:
+            out.append(
+                Finding(
+                    rule="registry-action",
+                    path=_REMEDIATION,
+                    line=line,
+                    message=(
+                        f"remediation loop [{name}] is registered in "
+                        "ACTIONS but has no plan_<name> implementation "
+                        "— every tick would KeyError planning it"
+                    ),
+                )
+            )
+    for name, impl_line in sorted(implemented.items()):
+        if name not in names:
+            out.append(
+                Finding(
+                    rule="registry-action",
+                    path=_REMEDIATION,
+                    line=impl_line,
+                    message=(
+                        f"plan_[{name}] is implemented but absent from "
+                        "ACTIONS — the remediation service never "
+                        "dispatches it"
                     ),
                 )
             )

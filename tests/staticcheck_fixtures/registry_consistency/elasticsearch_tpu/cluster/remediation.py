@@ -1,0 +1,19 @@
+"""Fixture remediation-planner registry (registry-action).
+
+[steady] is registered AND implemented (clean); [phantom] is registered
+with no planner; plan_rogue is implemented but never registered — both
+directions must fail the gate.
+"""
+
+ACTIONS = (
+    "steady",
+    "phantom",
+)
+
+
+def plan_steady(ctx):
+    return []
+
+
+def plan_rogue(ctx):
+    return []

@@ -819,6 +819,8 @@ class TestRestAndObs:
                 "admissions": 0,
                 "evictions": 0,
                 "mask_reuse": 0,
+                "budget_bytes": 0,
+                "retunes": [],
             }
             # Clear-cache API still answers (zero filter planes).
             out = n.clear_cache("idx")

@@ -415,10 +415,12 @@ class TestExecutorRouting:
             out = ex.search_many(all_wrapped)  # registers all 4 tenants
             assert all(not isinstance(r, Exception) for r in out)
             assert len(ex._member_rows) == 4
-            # Shrink the budget so only the two ACTIVE riders fit.
+            # Shrink the LIVE budget (the remediation retune surface)
+            # so only the two ACTIVE riders fit.
             active = [all_wrapped[2], all_wrapped[3]]
-            ex.MAX_PLANE_DOCS = sum(
-                w.svc.num_docs for w in active
+            ex.retune(
+                sum(w.svc.num_docs for w in active),
+                reason="test shrink",
             )
             out = ex.search_many(active)
             assert all(not isinstance(r, Exception) for r in out)

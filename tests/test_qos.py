@@ -28,6 +28,7 @@ from elasticsearch_tpu.exec.batcher import (
 )
 from elasticsearch_tpu.exec.qos import (
     DEFAULT_LANE,
+    OVERFLOW_LANE,
     QosController,
     parse_weights,
 )
@@ -175,6 +176,48 @@ class TestController:
         for i in range(QosController.MAX_LANES + 40):
             qos.charge(f"lane-{i}", 1.0)
         assert len(qos.stats()["lanes"]) <= QosController.MAX_LANES
+
+    def test_lane_exhaustion_folds_into_overflow(self, monkeypatch):
+        # A tenant-id cardinality attack (random X-Opaque-Id per request)
+        # must not mint unbounded lanes/instrument series: past the
+        # ESTPU_QOS_MAX_LANES bound, NEW keys share one overflow lane.
+        monkeypatch.setenv("ESTPU_QOS_MAX_LANES", "8")
+        qos = QosController()
+        for i in range(100):
+            qos.charge(f"attacker-{i}", 1.0)
+        lanes = qos.stats()["lanes"]
+        assert len(lanes) <= 8
+        assert OVERFLOW_LANE in lanes
+        # Early tenants stay KNOWN: an idle dedicated lane may be
+        # LRU-evicted, but the key re-mints its own lane on return.
+        # A folded tenant STAYS folded (no instrument-series flapping).
+        qos.charge("attacker-0", 1.0)
+        assert "attacker-0" in qos.stats()["lanes"]
+        qos.charge("attacker-99", 1.0)
+        assert "attacker-99" not in qos.stats()["lanes"]
+        # The default lane and explicitly weighted tenants always get
+        # dedicated lanes, even after exhaustion.
+        monkeypatch.setenv("ESTPU_QOS_WEIGHTS", "bigco:4")
+        qos2 = QosController()
+        for i in range(50):
+            qos2.charge(f"noise-{i}", 1.0)
+        qos2.charge("bigco", 1.0)
+        qos2.note_queue_wait(DEFAULT_LANE, 0.001)
+        lanes2 = qos2.stats()["lanes"]
+        assert "bigco" in lanes2 and DEFAULT_LANE in lanes2
+
+    def test_overflow_shed_names_the_overflow_lane(self, monkeypatch):
+        # err.lane (and the 429 body built from it) must carry the
+        # RESOLVED lane, so operators see [_overflow], not a random id.
+        monkeypatch.setenv("ESTPU_QOS_MAX_LANES", "2")
+        qos = QosController(inflight_budget=1, admit_wait_s=0.01)
+        for i in range(4):
+            qos.charge(f"t-{i}", 1.0)
+        with qos.admit("t-0"):
+            with pytest.raises(IndexingPressureRejected) as exc:
+                with qos.admit("t-brand-new"):
+                    pass
+        assert f"[{OVERFLOW_LANE}]" in str(exc.value)
 
     def test_health_inputs_shape(self):
         qos = QosController()

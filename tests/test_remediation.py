@@ -1,0 +1,691 @@
+"""The self-driving cluster (health-driven remediation loops).
+
+Four layers of proof:
+
+- **planners are pure** — plan_lifecycle/plan_allocation/plan_budget map
+  a HealthContext to actions with no service state, so a dry-run plans
+  exactly what live would;
+- **flap damping** — an oscillating context (pressure on, pressure off,
+  pressure on ...) executes at most ONE action per cooldown window and
+  NEVER an action and its inverse within one window; the per-window cap
+  bounds a pathological plan;
+- **chaos** — an armed `remediate.<loop>` fault site makes actuation
+  fail mid-flight: the loop retries with backoff, every attempt lands in
+  `estpu_remediation_failures_total`, the loop degrades to ADVISORY
+  instead of thrashing, and no acked write is lost;
+- **the acceptance arc** — induced HBM pressure on a replicated node
+  demotes the coldest unsearched index with zero operator actions, the
+  executed action rides the published cluster state AND the health
+  report's diagnosis, hits stay bit-identical through the demote /
+  on-demand re-pack cycle, and the same arc under dry-run plans the
+  identical action while executing none.
+"""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster import LocalCluster
+from elasticsearch_tpu.cluster.remediation import (
+    ACTIONS,
+    Action,
+    RemediationService,
+    next_rollover_name,
+    plan_allocation,
+    plan_budget,
+    plan_lifecycle,
+)
+from elasticsearch_tpu.faults.registry import REGISTRY, FaultSpec
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.obs.health import HealthContext
+from elasticsearch_tpu.obs.metrics import MetricsRegistry
+from elasticsearch_tpu.rest.server import RestServer
+
+
+class StubEngine:
+    def __init__(self, demoted=False, n_segments=1):
+        self.demoted = demoted
+        self.segments = [None] * n_segments
+
+
+class StubIndex:
+    def __init__(self, num_docs=0, engines=None, created_at=0.0):
+        self.num_docs = num_docs
+        self.engines = engines if engines is not None else [StubEngine()]
+        self.created_at = created_at
+
+
+class StubNode:
+    """Records every actuation; no device, no cluster."""
+
+    def __init__(self):
+        self.calls = []
+        self.replication = None
+
+    def force_merge(self, index):
+        self.calls.append(("force_merge", index))
+
+    def rollover_alias(self, alias, old, new):
+        self.calls.append(("rollover", alias, old, new))
+
+    def demote_index(self, index):
+        self.calls.append(("demote_index", index))
+
+    def promote_index(self, index):
+        self.calls.append(("promote_index", index))
+
+    def move_shard_replica(self, index, shard, src, dst):
+        self.calls.append(("move_shard", index, shard, src, dst))
+
+    def retune_cache_budgets(self, filter_bytes, ann_bytes, reason=""):
+        self.calls.append(("retune_caches", filter_bytes, ann_bytes))
+
+    def retune_packed_budget(self, max_plane_docs, reason=""):
+        self.calls.append(("retune_packed", max_plane_docs))
+
+
+def _pressure_ctx(
+    used=95,
+    limit=100,
+    cold_demoted=False,
+    searched=(),
+    scrolls=0,
+):
+    """One coordinating front, one cold index, HBM fraction used/limit."""
+    inputs = {
+        "breaker": {
+            "limit_size_in_bytes": limit,
+            "estimated_size_in_bytes": used,
+        },
+        "hbm": {
+            "by_label_index": [
+                {"label": "segment", "index": "cold", "bytes": 1000}
+            ]
+        },
+        "writes_recent": {},
+    }
+    return HealthContext(
+        coordinator="n0",
+        node_inputs={"n0": inputs},
+        local_indices={
+            "cold": StubIndex(
+                engines=[StubEngine(demoted=cold_demoted)]
+            )
+        },
+        recent_search_indices=searched,
+        scrolls_active=scrolls,
+        now=1000.0,
+    )
+
+
+def _svc(node=None, **cfg):
+    svc = RemediationService(node or StubNode(), metrics=MetricsRegistry())
+    for key, value in cfg.items():
+        setattr(svc, key, value)
+    return svc
+
+
+# ------------------------------------------------------------- planners
+
+
+class TestPlanners:
+    def test_registry_names_match_planners(self):
+        import elasticsearch_tpu.cluster.remediation as mod
+
+        for name in ACTIONS:
+            assert callable(getattr(mod, f"plan_{name}"))
+
+    def test_next_rollover_name(self):
+        assert next_rollover_name("logs-000001") == "logs-000002"
+        assert next_rollover_name("logs-000009") == "logs-000010"
+        assert next_rollover_name("logs") == "logs-000002"
+
+    def test_pressure_plans_demote_of_coldest(self):
+        acts = plan_lifecycle(_pressure_ctx())
+        assert [(a.kind, a.target) for a in acts] == [
+            ("demote_index", "cold")
+        ]
+        assert acts[0].inverse == "promote_index"
+
+    def test_recently_searched_index_never_demoted(self):
+        assert plan_lifecycle(_pressure_ctx(searched=("cold",))) == []
+
+    def test_live_scrolls_block_demotion(self):
+        # Scroll cursors pin frozen device planes; demotion under them
+        # would invalidate what the cursor is paging through.
+        assert plan_lifecycle(_pressure_ctx(scrolls=1)) == []
+
+    def test_pressure_cleared_plans_promotion(self):
+        acts = plan_lifecycle(_pressure_ctx(used=10, cold_demoted=True))
+        assert [(a.kind, a.target) for a in acts] == [
+            ("promote_index", "cold")
+        ]
+
+    def test_quiet_index_with_many_segments_force_merges(self):
+        ctx = HealthContext(
+            coordinator="n0",
+            node_inputs={"n0": {"writes_recent": {"busy": 9}}},
+            local_indices={
+                "quiet": StubIndex(engines=[StubEngine(n_segments=10)]),
+                "busy": StubIndex(engines=[StubEngine(n_segments=10)]),
+            },
+            now=1000.0,
+        )
+        acts = plan_lifecycle(ctx)
+        # The hot index (writes in the trailing window) is left to the
+        # ordinary merge policy; the quiet one compacts.
+        assert [(a.kind, a.target) for a in acts] == [
+            ("force_merge", "quiet")
+        ]
+
+    def test_rollover_past_doc_policy(self, monkeypatch):
+        monkeypatch.setenv("ESTPU_REMEDIATION_ROLLOVER_DOCS", "100")
+        ctx = HealthContext(
+            coordinator="n0",
+            node_inputs={"n0": {}},
+            aliases={"logs": ("logs-000001",)},
+            local_indices={"logs-000001": StubIndex(num_docs=150)},
+            now=1000.0,
+        )
+        acts = plan_lifecycle(ctx)
+        assert [(a.kind, a.target) for a in acts] == [("rollover", "logs")]
+        assert acts[0].params["new_index"] == "logs-000002"
+
+    def test_budget_shifts_toward_churning_filter_cache(self):
+        ctx = HealthContext(
+            coordinator="n0",
+            node_inputs={
+                "n0": {
+                    "caches": {
+                        "filter": {
+                            "budget_bytes": 64 << 20,
+                            "hit_count": 10,
+                            "miss_count": 90,
+                        },
+                        "ann": {
+                            "budget_bytes": 64 << 20,
+                            "hit_count": 0,
+                            "miss_count": 0,
+                        },
+                    },
+                    "evictions_recent": {"filter": 200, "ann": 0},
+                }
+            },
+        )
+        acts = plan_budget(ctx)
+        assert [a.kind for a in acts] == ["grow_filter_budget"]
+        shift = acts[0].params["filter_bytes"] - (64 << 20)
+        assert shift > 0
+        assert acts[0].params["ann_bytes"] == (64 << 20) - shift
+
+    def test_packed_budget_grows_at_occupancy(self):
+        ctx = HealthContext(
+            coordinator="n0",
+            node_inputs={
+                "n0": {
+                    "caches": {
+                        "packed": {
+                            "plane_docs": 95,
+                            "max_plane_docs": 100,
+                            "default_plane_docs": 100,
+                        }
+                    }
+                }
+            },
+        )
+        acts = plan_budget(ctx)
+        assert [a.kind for a in acts] == ["grow_packed_budget"]
+        assert acts[0].params["max_plane_docs"] == 125
+
+    def test_allocation_moves_replica_off_divergent_node(self):
+        class Routing:
+            primary = "n0"
+            replicas = ["n1"]
+            recovering = []
+
+            def assigned(self):
+                return ["n0", "n1"]
+
+        class Meta:
+            shards = {0: Routing()}
+
+        class State:
+            nodes = {"n0": None, "n1": None, "n2": None}
+            voting_only = set()
+            indices = {"idx": Meta()}
+
+        ctx = HealthContext(
+            coordinator="n0",
+            state=State(),
+            node_inputs={
+                "n0": {"queue_wait_recent": {"p99": 2.0}},
+                "n1": {"queue_wait_recent": {"p99": 900.0}},
+                "n2": {"queue_wait_recent": {"p99": 2.0}},
+            },
+        )
+        acts = plan_allocation(ctx)
+        assert len(acts) == 1
+        assert acts[0].kind == "move_shard"
+        assert acts[0].params == {
+            "index": "idx",
+            "shard": 0,
+            "from": "n1",
+            "to": "n2",
+        }
+
+    def test_allocation_never_moves_primaries(self):
+        class Routing:
+            primary = "n1"  # the divergent node holds only the PRIMARY
+            replicas = []
+            recovering = []
+
+            def assigned(self):
+                return ["n1"]
+
+        class Meta:
+            shards = {0: Routing()}
+
+        class State:
+            nodes = {"n0": None, "n1": None, "n2": None}
+            voting_only = set()
+            indices = {"idx": Meta()}
+
+        ctx = HealthContext(
+            coordinator="n0",
+            state=State(),
+            node_inputs={
+                "n0": {"queue_wait_recent": {"p99": 2.0}},
+                "n1": {"queue_wait_recent": {"p99": 900.0}},
+                "n2": {"queue_wait_recent": {"p99": 2.0}},
+            },
+        )
+        assert plan_allocation(ctx) == []
+
+
+# ---------------------------------------------------- damping & dry-run
+
+
+class TestFlapDamping:
+    def test_action_and_inverse_share_a_damping_key(self):
+        demote = Action("lifecycle", "demote_index", "cold", "",
+                        inverse="promote_index")
+        promote = Action("lifecycle", "promote_index", "cold", "",
+                         inverse="demote_index")
+        assert demote.damping_key() == promote.damping_key()
+
+    def test_oscillating_context_executes_once_per_window(self):
+        node = StubNode()
+        svc = _svc(node, cooldown_s=30.0)
+        executed = []
+        for round_no in range(6):
+            ctx = (
+                _pressure_ctx()
+                if round_no % 2 == 0
+                else _pressure_ctx(used=10, cold_demoted=True)
+            )
+            for record in svc.tick(ctx=ctx, force=True):
+                if record["executed"]:
+                    executed.append(record["kind"])
+        # One cooldown window covers the whole loop: exactly one action
+        # fired, and its inverse never did.
+        assert executed == ["demote_index"]
+        assert node.calls == [("demote_index", "cold")]
+        suppressed = [
+            r["suppressed"]
+            for r in svc.status()["planned"]
+            if "suppressed" in r
+        ]
+        assert suppressed and set(suppressed) == {"cooldown"}
+
+    def test_window_cap_bounds_a_pathological_plan(self):
+        node = StubNode()
+        svc = _svc(node, max_actions=2)
+        ctx = HealthContext(
+            coordinator="n0",
+            node_inputs={"n0": {}},
+            local_indices={
+                f"q{i}": StubIndex(engines=[StubEngine(n_segments=10)])
+                for i in range(5)
+            },
+            now=1000.0,
+        )
+        records = svc.tick(ctx=ctx, force=True)
+        assert len(records) == 5
+        assert sum(r["executed"] for r in records) == 2
+        assert [r["suppressed"] for r in records[2:]] == ["cap"] * 3
+        assert len(node.calls) == 2
+
+    def test_dry_run_plans_identically_and_executes_nothing(self):
+        live_node, dry_node = StubNode(), StubNode()
+        live = _svc(live_node)
+        dry = _svc(dry_node, dry_run=True)
+        ctx = _pressure_ctx()
+        live_records = live.tick(ctx=ctx, force=True)
+        dry_records = dry.tick(ctx=ctx, force=True)
+        assert [(r["kind"], r["target"], r["reason"])
+                for r in dry_records] == [
+            (r["kind"], r["target"], r["reason"]) for r in live_records
+        ]
+        assert all(r["dry_run"] and not r["executed"]
+                   for r in dry_records)
+        assert dry_node.calls == []
+        assert live_node.calls == [("demote_index", "cold")]
+        # Dry-run claims the SAME damping slots, so toggling live after
+        # a dry round cannot double-fire inside the window.
+        repeat = dry.tick(ctx=ctx, force=True)
+        assert [r["suppressed"] for r in repeat] == ["cooldown"]
+
+    def test_disabled_service_plans_nothing(self):
+        svc = _svc(enabled=False)
+        assert svc.tick(ctx=_pressure_ctx(), force=True) == []
+
+
+# --------------------------------------------------------------- chaos
+
+
+class TestChaosAdvisory:
+    def test_failed_actuation_retries_then_degrades_to_advisory(self):
+        node = StubNode()
+        svc = _svc(node, backoff_s=0.001)
+        REGISTRY.put(
+            FaultSpec(site="remediate.lifecycle", error_rate=1.0, seed=3)
+        )
+        try:
+            records = svc.tick(ctx=_pressure_ctx(), force=True)
+        finally:
+            REGISTRY.clear()
+        assert len(records) == 1
+        record = records[0]
+        assert record["executed"] is False
+        assert record["attempts"] == svc.retries
+        assert "injected fault" in record["error"]
+        assert record["advisory"] is True
+        # Every failed attempt is COUNTED.
+        assert svc._failures.value == svc.retries
+        assert node.calls == []
+        # The loop is advisory now: the same plan is suppressed, not
+        # retried into a thrash loop.
+        repeat = svc.tick(ctx=_pressure_ctx(), force=True)
+        assert [r["suppressed"] for r in repeat] == ["advisory"]
+        assert "failed after" in repeat[0]["advisory_reason"]
+        advisory = svc.status()["advisory"]
+        assert "lifecycle" in advisory
+
+    def test_cluster_chaos_arc_no_acked_write_loss(self):
+        """Armed remediate.allocation faults + a planned replica move:
+        retries, advisory degradation, counted failures — and every
+        acked write still answers. After the fault clears, the same
+        move executes through ordinary peer recovery."""
+        n = Node(data_path=None, replication=LocalCluster(3))
+        try:
+            n.create_index(
+                "chaos",
+                {
+                    "settings": {
+                        "index": {
+                            "number_of_shards": 2,
+                            "number_of_replicas": 1,
+                        }
+                    },
+                    "mappings": {"properties": {"b": {"type": "text"}}},
+                },
+            )
+            for i in range(20):
+                n.index_doc("chaos", {"b": f"payload {i}"}, str(i))
+            n.refresh("chaos")
+            svc = n.remediation
+            svc.backoff_s = 0.001
+            svc.cooldown_s = 0.05
+            svc.advisory_s = 0.05
+            state = n._coordinator_state()
+            routing = state.indices["chaos"].shards[0]
+            hot = routing.replicas[0]
+            inputs = {
+                nid: {"queue_wait_recent": {"p99": 1.0}}
+                for nid in state.nodes
+            }
+            inputs[hot] = {"queue_wait_recent": {"p99": 900.0}}
+            ctx = HealthContext(
+                coordinator=n.node_name,
+                standalone=False,
+                state=state,
+                node_inputs=inputs,
+            )
+            failures_before = svc._failures.value
+            REGISTRY.put(
+                FaultSpec(
+                    site="remediate.allocation", error_rate=1.0, seed=5
+                )
+            )
+            try:
+                records = svc.tick(ctx=ctx, force=True)
+            finally:
+                REGISTRY.clear()
+            assert len(records) == 1
+            assert records[0]["executed"] is False
+            assert records[0]["attempts"] == svc.retries
+            assert svc._failures.value - failures_before == svc.retries
+            # Zero acked-write loss through the chaos.
+            out = n.search("chaos", {"query": {"match_all": {}},
+                                     "size": 0})
+            assert out["hits"]["total"]["value"] == 20
+            # The instrument is live on the node registry (catalog ref).
+            assert "estpu_remediation_failures_total" in n.metrics_text()
+            # Fault cleared + advisory/cooldown expired: the SAME move
+            # now executes as an observable cluster-state transition.
+            time.sleep(0.1)
+            records = svc.tick(ctx=ctx, force=True)
+            assert [r["executed"] for r in records] == [True]
+            new_state = n._coordinator_state()
+            new_routing = new_state.indices["chaos"].shards[0]
+            assert hot not in new_routing.replicas
+            assert any(
+                r["kind"] == "move_shard" for r in new_state.remediations
+            )
+            # The move completes through ordinary peer recovery.
+            cluster = n.replication.cluster
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                routing = n._coordinator_state().indices["chaos"].shards[0]
+                if not routing.recovering:
+                    break
+                cluster.step()
+                time.sleep(0.02)
+            assert not routing.recovering
+            out = n.search("chaos", {"query": {"match_all": {}},
+                                     "size": 0})
+            assert out["hits"]["total"]["value"] == 20
+        finally:
+            n.close()
+
+
+# ----------------------------------------------------- the acceptance arc
+
+
+class TestAcceptanceArc:
+    """Standalone topology: the front's LOCAL engines hold the segment
+    HBM the lifecycle loop manages (in the replicated topology shard
+    data lives on cluster members — the allocation chaos arc above
+    covers the cluster-state publication surface there)."""
+
+    @pytest.fixture()
+    def rnode(self):
+        n = Node()
+        # Park the paced tick so the test's forced ticks are the only
+        # rounds that plan.
+        n.remediation.interval_s = 1e9
+        n.remediation._last_tick = time.monotonic()
+        n.create_index(
+            "hot", {"mappings": {"properties": {"t": {"type": "text"}}}}
+        )
+        n.create_index(
+            "cold", {"mappings": {"properties": {"t": {"type": "text"}}}}
+        )
+        for i in range(8):
+            n.index_doc("hot", {"t": f"alpha {i}"}, str(i))
+            n.index_doc("cold", {"t": f"omega term {i}"}, str(i))
+        n.refresh("hot")
+        n.refresh("cold")
+        yield n
+        n.close()
+
+    def test_hot_spot_remediates_to_green_hands_off(
+        self, rnode, monkeypatch
+    ):
+        n = rnode
+        # Baseline hits BEFORE the arc (white-box: forget the baseline
+        # search so "cold" still counts as unsearched for the planner).
+        baseline = n.search("cold", {"query": {"match": {"t": "omega"}}})
+        n._search_seen.clear()
+        n.search("hot", {"query": {"match": {"t": "alpha"}}})
+        # Induce the hot spot: ANY resident segment byte now counts as
+        # pressure past the demotion fraction.
+        monkeypatch.setenv("ESTPU_REMEDIATION_HBM_FRACTION", "1e-9")
+        used_before = n.breaker.stats()["estimated_size_in_bytes"]
+        assert used_before > 0
+        records = n.remediation.tick(force=True)
+        executed = [r for r in records if r["executed"]]
+        assert [(r["kind"], r["target"]) for r in executed] == [
+            ("demote_index", "cold")
+        ]
+        # ZERO operator actions: the hot spot cleared by itself.
+        assert n.breaker.stats()["estimated_size_in_bytes"] < used_before
+        assert all(e.demoted for e in n.indices["cold"].engines)
+        # The action surfaces in GET /_remediation (the standalone
+        # observable surface; clustered executions additionally ride
+        # ClusterState.remediations — see the chaos arc above) ...
+        status = n.get_remediation()
+        assert any(
+            r["kind"] == "demote_index" for r in status["executed"]
+        )
+        # ... and the health report's diagnosis NAMES it.
+        monkeypatch.setenv("ESTPU_REMEDIATION_HBM_FRACTION", "0.9")
+        report = n.health_report(verbose=True)
+        assert report["status"] == "green"
+        diagnosis = " ".join(
+            d.get("cause", "") + " " + d.get("action", "")
+            for d in report["indicators"]["device_memory"]["diagnosis"]
+        )
+        assert "remediation executed [demote_index] on [cold]" in diagnosis
+        assert "no operator action needed" in diagnosis
+        # Bit-identical hits through demotion + on-demand re-pack.
+        after = n.search("cold", {"query": {"match": {"t": "omega"}}})
+        assert [
+            (h["_id"], h["_score"]) for h in after["hits"]["hits"]
+        ] == [
+            (h["_id"], h["_score"]) for h in baseline["hits"]["hits"]
+        ]
+        assert not n.indices["cold"].engines[0].demoted
+        assert any(
+            r["kind"] == "on_demand_repack"
+            for r in n.get_remediation()["executed"]
+        )
+
+    def test_same_arc_under_dry_run_plans_identically(
+        self, rnode, monkeypatch
+    ):
+        n = rnode
+        n._search_seen.clear()
+        n.search("hot", {"query": {"match": {"t": "alpha"}}})
+        monkeypatch.setenv("ESTPU_REMEDIATION_HBM_FRACTION", "1e-9")
+        used_before = n.breaker.stats()["estimated_size_in_bytes"]
+        # A fresh service over the SAME node (no damping state shared
+        # with other tests), in dry-run mode.
+        dry = RemediationService(n, metrics=MetricsRegistry())
+        dry.dry_run = True
+        records = dry.tick(force=True)
+        planned = [r for r in records if "suppressed" not in r]
+        assert [(r["kind"], r["target"]) for r in planned] == [
+            ("demote_index", "cold")
+        ]
+        # Identical plan, zero actuation: nothing demoted, no bytes
+        # freed, the hot spot STAYS (non-green) until dry-run is lifted.
+        assert all(not r["executed"] for r in records)
+        assert not any(e.demoted for e in n.indices["cold"].engines)
+        assert n.breaker.stats()["estimated_size_in_bytes"] == used_before
+        # The dry-run plan narrates how to actuate it.
+        view = dry.health_view()
+        assert view["dry_run"] is True
+        ctx = n._remediation_context()
+        ctx = HealthContext(
+            **{**ctx.__dict__, "remediation": dry.health_view()}
+        )
+        from elasticsearch_tpu.obs.health import _graft_remediation
+
+        indicators = {
+            "device_memory": {"diagnosis": [], "details": {}},
+            "exec_saturation": {"diagnosis": [], "details": {}},
+        }
+        _graft_remediation(indicators, ctx)
+        causes = " ".join(
+            d.get("cause", "") + " " + d.get("action", "")
+            for d in indicators["device_memory"]["diagnosis"]
+        )
+        assert "dry-run mode is on" in causes
+
+
+# ------------------------------------------------- budgets & REST surface
+
+
+class TestBudgetRetunes:
+    def test_retune_recorded_on_cache_stats_and_health_inputs(self):
+        n = Node()
+        if n.filter_cache is None or n.ann_cache is None:
+            pytest.skip("caches disabled in this environment")
+        before_f = n.filter_cache.max_bytes
+        before_a = n.ann_cache.max_bytes
+        n.retune_cache_budgets(
+            before_f + (1 << 20),
+            before_a - (1 << 20),
+            reason="test shift",
+        )
+        stats = n._health_inputs_local()["caches"]
+        assert stats["filter"]["budget_bytes"] == before_f + (1 << 20)
+        assert stats["ann"]["budget_bytes"] == before_a - (1 << 20)
+        for side in ("filter", "ann"):
+            events = stats[side]["retunes"]
+            assert len(events) == 1
+            assert events[0]["reason"] == "test shift"
+            assert events[0]["from_bytes"] != events[0]["to_bytes"]
+
+    def test_packed_retune_event_and_shrink_forces_readmission(self):
+        n = Node()
+        if n.packed_exec is None:
+            pytest.skip("packed execution disabled")
+        default = n.packed_exec.max_plane_docs
+        n.retune_packed_budget(default * 2, reason="grow")
+        n.retune_packed_budget(default, reason="shrink back")
+        stats = n.packed_exec.stats()
+        assert stats["max_plane_docs"] == default
+        assert stats["default_plane_docs"] == default
+        assert [e["reason"] for e in stats["retunes"]] == [
+            "grow",
+            "shrink back",
+        ]
+
+
+class TestRestSurface:
+    def test_get_and_post_remediation(self):
+        server = RestServer()
+        try:
+            status, out = server.dispatch("GET", "/_remediation", {}, "")
+            assert status == 200
+            assert out["loops"] == list(ACTIONS)
+            assert {"enabled", "dry_run", "executed", "planned"} <= set(
+                out
+            )
+            status, out = server.dispatch(
+                "POST", "/_remediation", {}, '{"dry_run": true}'
+            )
+            assert status == 200
+            assert out["dry_run"] is True
+            status, out = server.dispatch(
+                "POST", "/_remediation", {}, '{"dry_run": false}'
+            )
+            assert out["dry_run"] is False
+            status, out = server.dispatch(
+                "POST", "/_remediation", {}, '{"dry_run": "yes"}'
+            )
+            assert status == 400
+        finally:
+            server.close()

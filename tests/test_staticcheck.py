@@ -196,6 +196,19 @@ class TestRegistryConsistency:
         assert any("[ghost]" in m for m in msgs)
         assert not any("[good]" in m for m in msgs)
 
+    def test_action_registry(self, report):
+        msgs = [
+            f.message
+            for f in report.findings
+            if f.rule == "registry-action"
+        ]
+        # [phantom] is registered with no planner; [rogue] is planned
+        # but unregistered; [steady] is clean.
+        assert len(msgs) == 2
+        assert any("[phantom]" in m for m in msgs)
+        assert any("[rogue]" in m for m in msgs)
+        assert not any("[steady]" in m for m in msgs)
+
     def test_breaker_labels(self, report):
         msgs = [
             f.message
