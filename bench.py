@@ -3135,6 +3135,183 @@ def bench_cfg16_remediation(
     }
 
 
+def bench_cfg17_incidents(
+    n_docs=None, n_q=24, phase_s=3.0, poll_interval_s=1.0
+):
+    """ISSUE 19 config: the always-on flight recorder + a paced
+    incident poll stay off the serving hot path.
+
+    The cfg3-style filtered mix serves on a Node while a background
+    thread runs the FULL incident cadence once per second: a VERBOSE
+    `GET /_health_report` (whose transition hook records a recorder
+    frame and screens for triggers every round) followed by a
+    `GET /_incidents` scrape of the capsule ring — the paced loop a real
+    orchestrator would run against this surface. Gates: the loaded p50
+    stays within 1.05x of the quiet p50 (plus a 0.5 ms CPU-jitter
+    floor), and the loaded phase's hits are bit-identical to the quiet
+    phase's. Quiet is measured BEFORE and AFTER the loaded phase
+    (best-of, the cfg11 drift-damping methodology). The recorder must
+    actually have recorded (one frame per poll) — a zero-cost gate over
+    an idle recorder would gate nothing."""
+    import os
+    import threading
+
+    from elasticsearch_tpu.rest.server import RestServer
+    from elasticsearch_tpu.utils.corpus import (
+        build_zipf_segment,
+        pick_query_terms,
+    )
+
+    if n_docs is None:
+        n_docs = int(os.environ.get("ESTPU_BENCH_INCIDENTS_N", 100_000))
+    rng = np.random.default_rng(93)
+    t0 = time.monotonic()
+    _, base_seg = build_zipf_segment(
+        n_docs, vocab_size=20_000, seed=53, with_sources=True
+    )
+    base_seg.doc_values["rank"] = rng.random(n_docs).astype(np.float64)
+    server = RestServer()
+    node = server.node
+    node.create_index(
+        "incidents",
+        {
+            "mappings": {
+                "properties": {
+                    "body": {"type": "text"},
+                    "rank": {"type": "float"},
+                }
+            }
+        },
+    )
+    engine = node.indices["incidents"].engines[0]
+    engine.restore_segments([(base_seg, np.ones(n_docs, dtype=bool))])
+    node.refresh("incidents")
+    build_s = time.monotonic() - t0
+
+    term_sets = pick_query_terms(base_seg, rng, n_q)
+    bodies = []
+    for terms in term_sets:
+        lo = float(rng.random() * 0.4)
+        bodies.append(
+            {
+                "query": {
+                    "bool": {
+                        "must": [{"match": {"body": " ".join(terms[:2])}}],
+                        "filter": [
+                            {"range": {"rank": {"gte": lo, "lte": lo + 0.5}}}
+                        ],
+                    }
+                },
+                "size": K,
+            }
+        )
+    for body in bodies:  # warm: compiles + cache admissions
+        node.search("incidents", body)
+        node.search("incidents", body)
+
+    def measure(duration_s):
+        times = []
+        hits = []
+        deadline = time.monotonic() + duration_s
+        qi = 0
+        while time.monotonic() < deadline:
+            body = bodies[qi % n_q]
+            t1 = time.monotonic()
+            resp = node.search("incidents", body)
+            times.append(time.monotonic() - t1)
+            if qi < n_q:
+                hits.append(
+                    [
+                        (h["_id"], h["_score"])
+                        for h in resp["hits"]["hits"]
+                    ]
+                )
+            qi += 1
+        return float(np.median(times)) * 1e3, len(times), hits
+
+    quiet_a_p50, quiet_a_n, quiet_hits = measure(phase_s)
+
+    stop = threading.Event()
+    polls = [0]
+    poll_errors: list[str] = []
+    frames_before = node.incidents.recorder.stats()["recorded_total"]
+
+    def poll_loop():
+        # First poll fires immediately, then paced 1/s: each round is a
+        # verbose report (recorder frame + trigger screen through the
+        # transition hook) plus an incident-ring scrape.
+        while True:
+            try:
+                status, _rep = server.dispatch(
+                    "GET", "/_health_report", {}, ""
+                )
+                status2, _out = server.dispatch(
+                    "GET", "/_incidents", {"verbose": "false"}, ""
+                )
+                if status != 200 or status2 != 200:
+                    poll_errors.append(f"http {status}/{status2}")
+                polls[0] += 1
+            except Exception as e:  # staticcheck: ignore[broad-except] a dying poll thread must be REPORTED (poll_errors in the result), not silently end the load this config measures
+                poll_errors.append(f"{type(e).__name__}: {e}")
+                if len(poll_errors) >= 5:
+                    return
+            if stop.wait(poll_interval_s):
+                return
+
+    thread = threading.Thread(target=poll_loop, daemon=True)
+    t_loaded = time.monotonic()
+    thread.start()
+    try:
+        loaded_p50, loaded_n, loaded_hits = measure(phase_s)
+    finally:
+        stop.set()
+        thread.join(timeout=10)
+    loaded_s = time.monotonic() - t_loaded
+    frames_recorded = (
+        node.incidents.recorder.stats()["recorded_total"] - frames_before
+    )
+    incidents_open = node.incidents.stats()["open"]
+    quiet_b_p50, quiet_b_n, _ = measure(phase_s)
+    server.close()
+
+    mismatches = sum(
+        1 for got, want in zip(loaded_hits, quiet_hits) if got != want
+    )
+    quiet_p50 = min(quiet_a_p50, quiet_b_p50)
+    # Gate: the always-on recorder + a paced 1/s incident poll cost
+    # nothing the serving path can feel — 5% + a 0.5ms CPU-jitter floor.
+    impact_ok = loaded_p50 <= quiet_p50 * 1.05 + 0.5
+    return {
+        "mismatches": mismatches,
+        "quiet_p50_ms": round(quiet_p50, 3),
+        "quiet_p50_before_ms": round(quiet_a_p50, 3),
+        "quiet_p50_after_ms": round(quiet_b_p50, 3),
+        "loaded_p50_ms": round(loaded_p50, 3),
+        "p50_ratio_loaded_over_quiet": (
+            round(loaded_p50 / quiet_p50, 3) if quiet_p50 else 0.0
+        ),
+        "incident_poll_impact_ok": impact_ok,
+        "incident_polls": polls[0],
+        "polls_per_s": round(polls[0] / loaded_s, 2),
+        "recorder_frames_recorded": frames_recorded,
+        "recorder_active": frames_recorded >= polls[0] > 0,
+        "incidents_open_after": incidents_open,
+        "poll_errors": len(poll_errors),
+        "poll_error_samples": poll_errors[:3],
+        "queries_quiet": quiet_a_n + quiet_b_n,
+        "queries_loaded": loaded_n,
+        "n_docs": n_docs,
+        "n_queries": n_q,
+        "corpus_build_s": round(build_s, 1),
+        # Scope note: standalone front (no cluster fan under the poll) —
+        # the capsule fan over both cluster forms, the chaos-arc capture
+        # law, and resolution records are gated in tests/
+        # test_incidents.py and the brownout arc; this config measures
+        # the steady-state recorder + poll tax the serving path feels.
+        "path": "standalone",
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -3454,6 +3631,7 @@ def main():
         ("cfg14_socket", bench_cfg14_socket),
         ("cfg15_qos", bench_cfg15_qos),
         ("cfg16_remediation", bench_cfg16_remediation),
+        ("cfg17_incidents", bench_cfg17_incidents),
     ):
         # Device-obs accounting per config (ISSUE 14): bracket every
         # config with a process census + HBM window so each emits its

@@ -184,6 +184,12 @@ class ClusterNode:
             "Control-plane step errors swallowed by the background stepper",
             node=node_id,
         )
+        # Member-side flight recorder (obs/recorder.py): lazily armed on
+        # the first health_inputs ship — each frame is the inputs dict
+        # already being assembled for the coordinator, so the member-side
+        # ring costs nothing the health fan wasn't paying already. Serves
+        # the `incidents` wire action (GET /_incidents cluster fan).
+        self._recorder = None
         self._recover_persisted_state()
         hub.register(node_id, self._handle)
 
@@ -1518,10 +1524,39 @@ class ClusterNode:
                     out["transport_events_recent"] = {
                         k: int(v) for k, v in recent.items()
                     }
+        if os.environ.get("ESTPU_INCIDENTS", "1") != "0":
+            if self._recorder is None:
+                from ..obs.recorder import FlightRecorder
+
+                self._recorder = FlightRecorder(metrics=self.metrics)
+            self._recorder.record(
+                extras={
+                    "node": self.node_id,
+                    "step_errors": out["step_errors"],
+                    "evictions_recent": out.get("evictions_recent"),
+                    "transport_events_recent": out.get(
+                        "transport_events_recent"
+                    ),
+                }
+            )
         return out
 
     def _on_health_inputs(self, from_id: str, payload: dict):
         return self.health_inputs_local()
+
+    def _on_incidents(self, from_id: str, payload: dict):
+        """Incident ship side (GET /_incidents cluster fan): this
+        member's flight-recorder summary plus its newest frames, so a
+        coordinator capsule reader sees per-member evidence without a
+        second bespoke wire action."""
+        if self._recorder is None:
+            return {"node": self.node_id, "recorder": None}
+        limit = int(payload.get("frames", 3))
+        return {
+            "node": self.node_id,
+            "recorder": self._recorder.stats(),
+            "frames": self._recorder.frames(limit=max(0, limit)),
+        }
 
     def _on_metrics_wire(self, from_id: str, payload: dict):
         """Federated `/_metrics` ship side: this node's registry as a

@@ -232,6 +232,10 @@ class ProcCluster:
         # Lazily-built health report service (obs/health.py): holds the
         # re-election/step-error history between report rounds.
         self._health = None
+        # Transition hook (obs/incidents.py) handed down by the fronting
+        # Node so the incident capture law holds in the proc topology
+        # too — assigned onto the lazy HealthService at first report.
+        self.health_transition_hook = None
         self._closed = False
         if self.seed_addrs:
             missing = [n for n in self.seeds if n not in self.seed_addrs]
@@ -734,6 +738,7 @@ class ProcCluster:
 
         if self._health is None:
             self._health = HealthService(metrics=self._ctl.metrics)
+        self._health.transition_hook = self.health_transition_hook
         node_inputs: dict[str, dict] = {}
         failures: list[dict] = []
         state = None

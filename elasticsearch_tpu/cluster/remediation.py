@@ -519,6 +519,10 @@ class RemediationService:
         self._stop = threading.Event()
         self._stepper: threading.Thread | None = None
         self._tick_thread: threading.Thread | None = None
+        # Action hook (obs/incidents.py): every remembered record lands
+        # on the open incident capsules live. Best-effort — a hook error
+        # must never fail the action that already executed.
+        self.action_hook = None
         if metrics is None:
             from ..obs.metrics import MetricsRegistry
 
@@ -773,6 +777,16 @@ class RemediationService:
             self._history.append(record)
             if len(self._history) > self.HISTORY:
                 del self._history[: -self.HISTORY]
+        self._link_incident(record)
+
+    def _link_incident(self, record: dict) -> None:
+        if self.action_hook is None:
+            return
+        try:
+            self.action_hook(dict(record))
+        # staticcheck: ignore[broad-except] incident linkage is observability, not actuation: a capsule-side error must not fail the action that already executed
+        except Exception:
+            pass
 
     # ------------------------------------------------------------ surface
 
@@ -801,6 +815,7 @@ class RemediationService:
             if len(self._history) > self.HISTORY:
                 del self._history[: -self.HISTORY]
         self._actions_recent.inc()
+        self._link_incident(record)
 
     def status(self) -> dict:
         """GET /_remediation: config, advisory state, planned-vs-

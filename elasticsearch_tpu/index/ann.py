@@ -292,6 +292,25 @@ class AnnCache:
             "estpu_ann_evictions_recent",
             "IVF planes dropped over the trailing window",
         )
+        # Real hit/miss accounting at the lookup sites: the remediation
+        # budget loop and incident capsules read a true hit rate instead
+        # of leaning on the eviction window (PR-18 residue).
+        self._hits = metrics.counter(
+            "estpu_ann_cache_hits_total",
+            "IVF plane lookups served from the cache",
+        )
+        self._misses = metrics.counter(
+            "estpu_ann_cache_misses_total",
+            "IVF plane lookups that fell through to a build",
+        )
+        self._events_recent = {
+            event: metrics.windowed_counter(
+                "estpu_ann_cache_events_recent",
+                "ANN cache lookup outcomes over the trailing window",
+                event=event,
+            )
+            for event in ("hit", "miss")
+        }
         metrics.gauge(
             "estpu_ann_bytes_resident",
             "HBM bytes held by IVF partition planes",
@@ -376,6 +395,7 @@ class AnnCache:
             entry = self._entries.get(key)
             if entry is not None and entry.metric == metric:
                 self._entries.move_to_end(key)
+                self._note_lookup("hit")
                 return entry
             gate = self._building.setdefault(key, threading.Lock())
         with gate:
@@ -383,7 +403,11 @@ class AnnCache:
                 entry = self._entries.get(key)
                 if entry is not None and entry.metric == metric:
                     self._entries.move_to_end(key)
+                    # A build raced us and won: the planes are warm, the
+                    # lookup never paid the k-means pass — a hit.
+                    self._note_lookup("hit")
                     return entry
+            self._note_lookup("miss")
             # Build OUTSIDE self._lock (only the per-key gate held): a
             # k-means pass must not stall readers of other keys.
             parts = build_partitions(
@@ -401,6 +425,10 @@ class AnnCache:
         with self._lock:
             self._building.pop(key, None)
         return parts
+
+    def _note_lookup(self, event: str) -> None:
+        (self._hits if event == "hit" else self._misses).inc()
+        self._events_recent[event].inc()
 
     def _store(self, key, parts: AnnPartitions, live_uids) -> bool:
         if parts.nbytes > self.max_bytes:
@@ -533,6 +561,18 @@ class AnnCache:
             "budget_bytes": self.max_bytes,
             "builds": int(self._builds.value),
             "evictions": int(self._evictions.value),
+            # Keys the remediation budget loop's `_hit_rate` reads.
+            "hit_count": int(self._hits.value),
+            "miss_count": int(self._misses.value),
+            "hit_rate": (
+                round(
+                    int(self._hits.value)
+                    / (int(self._hits.value) + int(self._misses.value)),
+                    4,
+                )
+                if int(self._hits.value) + int(self._misses.value)
+                else 0.0
+            ),
             "searches": {b: int(c.value) for b, c in sorted(searches)},
             "probes": int(self._probes.value),
             "recall_gate": {
@@ -554,6 +594,9 @@ class AnnCache:
             "budget_bytes": 0,
             "builds": 0,
             "evictions": 0,
+            "hit_count": 0,
+            "miss_count": 0,
+            "hit_rate": 0.0,
             "searches": {},
             "probes": 0,
             "recall_gate": {},

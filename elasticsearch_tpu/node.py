@@ -71,6 +71,7 @@ from .search.service import (
     SearchPhaseFailedError,
     SearchRequest,
     SearchService,
+    _iso_millis,
 )
 
 
@@ -475,6 +476,23 @@ class Node:
                 # recoveries. The proc-clustered form has no in-process
                 # master to ride — POST /_remediation drives it there.
                 cluster.remediation_hook = self.remediation.tick_async
+        # Flight recorder + incident autopsy (obs/incidents.py): rides
+        # the health poll as the HealthService transition hook — every
+        # report records a recorder frame and screens for non-green
+        # transitions to freeze evidence capsules. The remediation
+        # action hook links in-window actions onto open capsules live.
+        # ESTPU_INCIDENTS=0 disarms (present-but-inert).
+        from .obs.incidents import IncidentService
+
+        self.incidents = IncidentService(self, metrics=self.metrics)
+        self.health.transition_hook = self.incidents.on_report
+        self.remediation.action_hook = self.incidents.on_remediation_record
+        if self._procs is not None:
+            # Proc topology: health reports run through the gateway's
+            # own HealthService (procs.health_report), not self.health —
+            # hand it the same hook so the recorder cadence and capture
+            # law hold there too.
+            self._procs.health_transition_hook = self.incidents.on_report
         # Extension system (plugins.py): analyzers / ingest processors /
         # query types contributed by ESTPU_PLUGINS or the plugins param.
         from .plugins import load_plugins
@@ -4142,6 +4160,106 @@ class Node:
         )
         return report
 
+    # ------------------------------------------------------------ incidents
+
+    def get_incidents(self, verbose: bool = True) -> dict:
+        """GET /_incidents — the bounded incident ring (obs/incidents.py)
+        plus, when verbose, a cluster fan of per-member flight-recorder
+        summaries over BOTH cluster forms (the PR-13 scatter for the
+        in-process cluster, the never-intercepted `_ctl` path for the
+        proc cluster). ``verbose=False`` returns statuses/trigger lines
+        only and skips capsule bodies AND the fan."""
+        out: dict[str, Any] = {
+            "enabled": self.incidents.enabled,
+            "incidents": self.incidents.incidents(verbose=verbose),
+            "recorder": self.incidents.recorder.stats(),
+        }
+        if (
+            not verbose
+            or not self.incidents.enabled
+            or self.replication is None
+        ):
+            return out
+        if self._procs is not None:
+            expected = list(self._procs.workers)
+            results, failures = self._procs._fan("incidents")
+        else:
+            expected = sorted(self.replication.cluster.nodes)
+            results, failures = self._cluster_fan("incidents", {})
+        nodes: dict[str, Any] = {
+            self.node_name: {
+                "node": self.node_name,
+                "recorder": self.incidents.recorder.stats(),
+                "open": self.incidents.stats()["open"],
+            }
+        }
+        for node_id in expected:
+            if node_id in results:
+                nodes.setdefault(node_id, results[node_id])
+        header: dict[str, Any] = {
+            "total": 1 + len(expected),
+            "successful": 1
+            + len([n for n in expected if n in results]),
+            "failed": len(failures),
+        }
+        if failures:
+            header["failures"] = list(failures)
+        out["_nodes"] = header
+        out["nodes"] = nodes
+        return out
+
+    def get_incident(self, incident_id: str) -> dict:
+        """GET /_incidents/{id} — one full capsule, or 404."""
+        incident = self.incidents.get(incident_id)
+        if incident is None:
+            raise ApiError(
+                404,
+                "resource_not_found_exception",
+                f"no incident [{incident_id}] in the ring (bounded; "
+                "resolved incidents age out first)",
+            )
+        return incident
+
+    def capture_incident(self, body: dict | None = None) -> dict:
+        """POST /_incidents/_capture — manual evidence grab."""
+        body = body or {}
+        indicator = body.get("indicator")
+        if indicator is not None and indicator not in INDICATORS:
+            raise ApiError(
+                400,
+                "illegal_argument_exception",
+                f"unknown health indicator [{indicator}]; expected one "
+                f"of {list(INDICATORS)}",
+            )
+        return self.incidents.capture(
+            indicator=indicator,
+            reason=str(body.get("reason", "manual")),
+        )
+
+    def cat_incidents(self) -> list[dict]:
+        """GET /_cat/incidents — one row per ring entry, newest first."""
+        rows: list[dict] = []
+        for summary in self.incidents.incidents(verbose=False):
+            trigger = summary["trigger"]
+            ttg = summary.get("time_to_green_ms")
+            rows.append(
+                {
+                    "id": summary["id"],
+                    "trigger": trigger.get("indicator")
+                    or trigger.get("loop")
+                    or trigger.get("burst")
+                    or trigger["kind"],
+                    "kind": trigger["kind"],
+                    "status": summary["status"],
+                    "start": _iso_millis(summary["started_at_ms"]),
+                    "time_to_green_ms": (
+                        "-" if ttg is None else str(int(ttg))
+                    ),
+                    "actions": str(summary.get("actions", 0)),
+                }
+            )
+        return rows
+
     # ---------------------------------------------------------- remediation
 
     def _note_index_write(self, index: str) -> None:
@@ -4921,6 +5039,9 @@ class Node:
             # Health-report rounds + last-computed indicator statuses
             # (obs/health.py; estpu_health_* views).
             "health": self.health.stats(),
+            # Flight recorder + incident ring (obs/incidents.py):
+            # present-but-inert under ESTPU_INCIDENTS=0.
+            "incidents": self.incidents.stats(),
         }
         if self.replication is not None:
             node_stats["replication"] = self.replication.stats()

@@ -1124,6 +1124,11 @@ class HealthService:
         self._last_step_errors: dict[str, int] = {}
         self._last: dict[str, str] = {}
         self._reports = 0
+        # Transition hook (obs/incidents.py): called after every report
+        # with the round's status changes — the incident auto-capture
+        # and flight-recorder cadence. Invoked outside the lock; a hook
+        # error must never break a health report.
+        self.transition_hook = None
         if metrics is not None:
             self._reports_c = metrics.counter(
                 "estpu_health_reports_total",
@@ -1187,10 +1192,17 @@ class HealthService:
         if verbose:
             _graft_remediation(indicators, ctx)
         status = worst(r["status"] for r in indicators.values())
+        transitions: list[dict[str, Any]] = []
         with self._lock:
             self._reports += 1
             for name, result in indicators.items():
-                self._last[name] = result["status"]
+                old = self._last.get(name)
+                new = result["status"]
+                if old != new:
+                    transitions.append(
+                        {"indicator": name, "from": old, "to": new}
+                    )
+                self._last[name] = new
         if self._reports_c is not None:
             self._reports_c.inc()
         if self.metrics is not None:
@@ -1201,6 +1213,12 @@ class HealthService:
                     "yellow / 2 red)",
                     indicator=name,
                 ).set(_STATUS_RANK.get(result["status"], 1))
+        if self.transition_hook is not None:
+            try:
+                self.transition_hook(transitions, indicators, verbose)
+            # staticcheck: ignore[broad-except] the hook is evidence capture — it must never break the health report it observes
+            except Exception:
+                pass
         out: dict[str, Any] = {
             "cluster_name": ctx.cluster_name,
             "status": status,

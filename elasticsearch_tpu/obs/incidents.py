@@ -1,0 +1,652 @@
+"""Incident autopsy: auto-captured, time-correlated evidence capsules
+for every non-green transition (ISSUE 19).
+
+The health report can *diagnose* live and the remediation loops can
+*act*, but every signal is ephemeral: windowed metrics age out in 60s
+and the trace ring churns. The IncidentService watches health-indicator
+transitions (via the HealthService transition hook), remediation-loop
+advisory degradation, and windowed shed/eviction bursts, and freezes an
+*incident capsule* — the reference's support-diagnostics bundle analog:
+
+- the triggering indicator's full symptom/details/impacts/diagnosis,
+- flight-recorder frames spanning the pre/post windows (obs/recorder.py
+  — the guarantee that evidence from *before* the trigger survives),
+- cluster-wide spliced trace trees of the window's slowest exemplars
+  (the PR-13 `collect_fragments` scatter / ProcCluster `_ctl` path),
+- a hot-threads sample taken at capture time (local, quick),
+- transport recent-events with peer names,
+- every remediation action inside the window (history + the published
+  `ClusterState.remediations`),
+
+then appends a resolution record (time-to-green) when the triggering
+condition recovers.
+
+Capture is two-phase so a health poll's latency budget survives chaos:
+the *freeze* (trigger, diagnosis, frames, remediation window, transport
+events — pure dict assembly) happens synchronously inside the
+triggering report, and the *enrichment* (trace splice fan, hot-threads
+sample — the parts that cost wall clock or a wire round) fills in on a
+bounded background thread. A browned-out peer can therefore never push
+the triggering health poll past its fan deadline.
+
+`ESTPU_INCIDENTS=0` disarms the service (present-but-inert stats shape,
+no frames, no captures). `ESTPU_INCIDENTS_DIR` exports each capsule as
+a JSON bundle on freeze and again on resolve.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+from .recorder import DEFAULT_CAPACITY, FlightRecorder
+
+DEFAULT_RING = 32
+DEFAULT_COOLDOWN_S = 60.0
+# Windowed burst floors: a trailing-window shed/eviction count past
+# these freezes a capsule even when every indicator still reads green
+# (the burst may be absorbed before the next report interprets it).
+DEFAULT_SHED_BURST = 256
+DEFAULT_EVICTION_BURST = 512
+# Evidence bounds: capsules are bounded artifacts, never unbounded dumps.
+MAX_FRAMES_PER_CAPSULE = 60
+MAX_EXEMPLAR_TRACES = 3
+MAX_SPANS_PER_TRACE = 200
+MAX_ACTIONS_PER_CAPSULE = 32
+
+
+def _env_f(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return float(default)
+    try:
+        return float(raw)
+    except ValueError:
+        return float(default)
+
+
+class IncidentService:
+    """Bounded incident ring + the flight recorder feeding it.
+
+    Wired as the HealthService transition hook on both cluster forms:
+    every health report records one recorder frame and is screened for
+    triggers/resolutions; the report's own verbose indicator blocks are
+    reused as the captured diagnosis, so the capture adds no second fan
+    to the triggering poll."""
+
+    def __init__(self, node, metrics=None):
+        self.node = node
+        self.enabled = os.environ.get("ESTPU_INCIDENTS", "1") != "0"
+        self.capacity = int(
+            _env_f("ESTPU_INCIDENTS_CAPACITY", DEFAULT_RING)
+        )
+        self.cooldown_s = _env_f(
+            "ESTPU_INCIDENTS_COOLDOWN_S", DEFAULT_COOLDOWN_S
+        )
+        self.shed_burst = int(
+            _env_f("ESTPU_INCIDENTS_SHED_BURST", DEFAULT_SHED_BURST)
+        )
+        self.eviction_burst = int(
+            _env_f(
+                "ESTPU_INCIDENTS_EVICTION_BURST", DEFAULT_EVICTION_BURST
+            )
+        )
+        self.export_dir = os.environ.get("ESTPU_INCIDENTS_DIR") or None
+        self.recorder = FlightRecorder(
+            capacity=int(
+                _env_f("ESTPU_RECORDER_CAPACITY", DEFAULT_CAPACITY)
+            ),
+            metrics=metrics if self.enabled else None,
+        )
+        self._lock = threading.Lock()
+        self._ring: list[dict] = []  # newest last, bounded
+        self._open: dict[str, dict] = {}  # trigger key -> incident
+        self._last_capture: dict[str, float] = {}  # key -> monotonic
+        self._seq = 0
+        # Re-entrancy guard: a capture's own verbose health recompute
+        # must not nest another frame/capture round.
+        self._tl = threading.local()
+        self.metrics = metrics
+        if metrics is not None:
+            self._captures_c = metrics.counter(
+                "estpu_incident_captures_total",
+                "Incident capsules frozen (auto triggers + manual grabs)",
+            )
+            self._resolved_c = metrics.counter(
+                "estpu_incident_resolved_total",
+                "Incidents resolved (triggering condition back to green)",
+            )
+            metrics.gauge(
+                "estpu_incident_open",
+                "Incidents currently open (trigger not yet recovered)",
+                fn=lambda: len(self._open),
+            )
+        else:
+            self._captures_c = None
+            self._resolved_c = None
+
+    # ------------------------------------------------------- frame extras
+
+    def _frame_extras(self) -> dict[str, Any]:
+        """The windowed/ledger slice of one recorder frame: every number
+        here is already computed by an existing instrument — assembling
+        the frame is dict work, never a fan or a device call."""
+        node = self.node
+        extras: dict[str, Any] = {}
+        rest: dict[str, Any] = {}
+        for labels, window in node.metrics.windows(
+            "estpu_rest_latency_recent_ms"
+        ):
+            snap = window.snapshot()
+            if snap["count"]:
+                rest[labels.get("endpoint", "_all")] = {
+                    "p50_ms": round(snap["p50"], 3),
+                    "p99_ms": round(snap["p99"], 3),
+                    "rate_per_s": snap["rate_per_s"],
+                }
+        if rest:
+            extras["rest_latency_recent"] = rest
+        shed = 0
+        window = node.metrics.window("estpu_exec_batcher_shed_recent")
+        if window is not None:
+            shed += int(window.count())
+        for _labels, lane_w in node.metrics.windows(
+            "estpu_qos_shed_recent"
+        ):
+            shed += int(lane_w.count())
+        extras["shed_recent"] = shed
+        evictions = 0
+        for name in (
+            "estpu_filter_cache_evictions_recent",
+            "estpu_ann_evictions_recent",
+        ):
+            window = node.metrics.window(name)
+            if window is not None:
+                evictions += int(window.count())
+        extras["evictions_recent"] = evictions
+        breaker = node.breaker.stats()
+        extras["breaker"] = {
+            k: breaker[k] for k in breaker if not isinstance(breaker[k], dict)
+        }
+        hbm = node.hbm_ledger.snapshot()
+        extras["hbm_total_bytes"] = int(hbm.get("total_bytes", 0))
+        extras["qos"] = node.qos.health_inputs()
+        exemplars = [
+            q["trace_id"]
+            for q in node.insights.queries(size=5)
+            if q.get("trace_id")
+        ]
+        if exemplars:
+            extras["exemplar_trace_ids"] = exemplars[:MAX_EXEMPLAR_TRACES]
+        return extras
+
+    # ---------------------------------------------------------- evidence
+
+    def _transport_evidence(self) -> dict[str, Any]:
+        """Transport recent-events with peer names, from the cluster
+        hub's registry (whichever transport backs this topology)."""
+        node = self.node
+        out: dict[str, Any] = {}
+        if node.replication is None:
+            return out
+        hub = node.replication.cluster.hub
+        hub_metrics = getattr(hub, "metrics", None)
+        if hub_metrics is not None:
+            events = hub_metrics.window_counts(
+                "estpu_transport_events_recent", "event"
+            )
+            if events:
+                out["events_recent"] = {
+                    k: int(v) for k, v in sorted(events.items())
+                }
+            peers: dict[str, dict[str, int]] = {}
+            for labels, window in hub_metrics.windows(
+                "estpu_transport_peer_events_recent"
+            ):
+                peer = labels.get("peer")
+                if not peer:
+                    continue
+                event = labels.get("event", "event")
+                entry = peers.setdefault(peer, {})
+                entry[event] = entry.get(event, 0) + int(window.count())
+            if peers:
+                out["peer_events_recent"] = {
+                    p: peers[p] for p in sorted(peers)
+                }
+        hub_stats = getattr(hub, "stats", None)
+        if hub_stats is not None:
+            try:
+                out["stats"] = hub_stats()
+            # staticcheck: ignore[broad-except] capsule evidence is best-effort: a transport mid-teardown must degrade the bundle, never fail the capture
+            except Exception:
+                pass
+        return out
+
+    def _remediation_window(self, since_ms: int) -> dict[str, Any]:
+        """Remediation actions inside the incident window: the service's
+        own recent history plus the transitions published into cluster
+        state (cluster/remediation.py `_publish_transition`)."""
+        node = self.node
+        view = node.remediation.health_view()
+        recent = [
+            dict(r)
+            for r in view.get("recent", ())
+            if int(r.get("at_ms", 0)) >= since_ms
+        ]
+        published = []
+        state = node._coordinator_state()
+        for record in getattr(state, "remediations", None) or ():
+            if int(record.get("at_ms", 0)) >= since_ms:
+                published.append(dict(record))
+        return {
+            "actions": recent[-MAX_ACTIONS_PER_CAPSULE:],
+            "published": published[-MAX_ACTIONS_PER_CAPSULE:],
+            "advisory": dict(view.get("advisory", {})),
+            "dry_run": bool(view.get("dry_run", False)),
+        }
+
+    def _exemplar_traces(self, since_ms: int) -> list[dict]:
+        """Cluster-wide spliced span trees of the window's slowest
+        exemplars: the insights ring names the trace ids, the PR-13
+        scatter (or the ProcCluster `_ctl` path) splices each tree."""
+        from ..node import ApiError
+
+        node = self.node
+        picked: list[dict] = []
+        for entry in node.insights.queries(size=10):
+            trace_id = entry.get("trace_id")
+            if not trace_id:
+                continue
+            at_ms = int(entry.get("timestamp_ms", 0) or 0)
+            if at_ms and at_ms < since_ms:
+                continue
+            picked.append(entry)
+            if len(picked) >= MAX_EXEMPLAR_TRACES:
+                break
+        out: list[dict] = []
+        for entry in picked:
+            trace_id = entry["trace_id"]
+            summary: dict[str, Any] = {
+                "trace_id": trace_id,
+                "took_ms": entry.get("took_ms"),
+                "index": entry.get("index"),
+            }
+            try:
+                tree = node.get_trace(trace_id)
+                spans = tree.get("spans", [])
+                summary["spans"] = spans[:MAX_SPANS_PER_TRACE]
+                summary["span_count"] = len(spans)
+                summary["nodes"] = sorted(
+                    {
+                        s.get("node")
+                        for s in spans
+                        if isinstance(s, dict) and s.get("node")
+                    }
+                )
+                if "_nodes" in tree:
+                    summary["_nodes"] = tree["_nodes"]
+            except ApiError:
+                summary["missing"] = "trace aged out of the ring"
+            # staticcheck: ignore[broad-except] capsule evidence is best-effort: a mid-chaos trace fan failure must degrade the bundle, never fail the capture
+            except Exception as e:
+                summary["error"] = f"{type(e).__name__}: {e}"
+            out.append(summary)
+        return out
+
+    def _hot_threads_sample(self) -> str:
+        """A quick LOCAL sample (never the cluster fan: capture must not
+        spend a second per-send deadline under the very chaos that
+        triggered it)."""
+        from .hot_threads import hot_threads_text
+
+        return hot_threads_text(
+            node_name=self.node.node_name,
+            threads=3,
+            interval_s=0.05,
+            snapshots=2,
+            metrics=self.node.metrics,
+        )
+
+    # ----------------------------------------------------------- the hook
+
+    def on_report(
+        self,
+        transitions: list[dict],
+        indicators: dict[str, dict],
+        verbose: bool,
+    ) -> None:
+        """HealthService transition hook: record one recorder frame,
+        screen for new triggers, resolve recovered incidents. Runs on
+        every report round (the health poll IS the recorder cadence)."""
+        if not self.enabled or getattr(self._tl, "capturing", False):
+            return
+        statuses = {
+            name: result.get("status", "unknown")
+            for name, result in indicators.items()
+        }
+        extras = self._frame_extras()
+        self.recorder.record(statuses, extras)
+        # --- new triggers -------------------------------------------
+        for t in transitions:
+            if t["to"] == "green":
+                continue
+            detail = indicators.get(t["indicator"]) if verbose else None
+            self._maybe_capture(
+                key=f"indicator:{t['indicator']}",
+                trigger={
+                    "kind": "indicator",
+                    "indicator": t["indicator"],
+                    "from": t["from"],
+                    "to": t["to"],
+                    "reason": (
+                        f"health indicator [{t['indicator']}] went "
+                        f"{t['from'] or 'unknown'} -> {t['to']}"
+                    ),
+                },
+                detail=detail,
+            )
+        advisory = self.node.remediation.health_view().get("advisory", {})
+        for loop, why in advisory.items():
+            self._maybe_capture(
+                key=f"remediation_advisory:{loop}",
+                trigger={
+                    "kind": "remediation_advisory",
+                    "loop": loop,
+                    "reason": (
+                        f"remediation loop [{loop}] degraded to "
+                        f"advisory: {why}"
+                    ),
+                },
+                detail=None,
+            )
+        for burst, count, floor in (
+            ("shed", extras.get("shed_recent", 0), self.shed_burst),
+            (
+                "evictions",
+                extras.get("evictions_recent", 0),
+                self.eviction_burst,
+            ),
+        ):
+            if count >= floor:
+                self._maybe_capture(
+                    key=f"burst:{burst}",
+                    trigger={
+                        "kind": "burst",
+                        "burst": burst,
+                        "count": int(count),
+                        "threshold": int(floor),
+                        "reason": (
+                            f"windowed {burst} burst: {int(count)} over "
+                            f"the trailing window (floor {int(floor)})"
+                        ),
+                    },
+                    detail=None,
+                )
+        # --- resolutions --------------------------------------------
+        with self._lock:
+            open_now = list(self._open.items())
+        for key, incident in open_now:
+            trigger = incident["trigger"]
+            recovered = False
+            if trigger["kind"] == "indicator":
+                status = statuses.get(trigger["indicator"])
+                recovered = status == "green"
+            elif trigger["kind"] == "remediation_advisory":
+                recovered = trigger["loop"] not in advisory
+            elif trigger["kind"] == "burst":
+                count = extras.get(f"{trigger['burst']}_recent", 0)
+                recovered = count < trigger["threshold"] / 2
+            if recovered:
+                self._resolve(key, incident)
+
+    # ----------------------------------------------------------- capture
+
+    def _maybe_capture(
+        self, key: str, trigger: dict, detail: dict | None
+    ) -> dict | None:
+        now = time.monotonic()
+        with self._lock:
+            open_incident = self._open.get(key)
+            if open_incident is not None:
+                # Escalation while open (yellow -> red): note it on the
+                # open capsule instead of double-capturing.
+                if trigger.get("to") and trigger.get("to") != (
+                    open_incident["trigger"].get("to")
+                ):
+                    open_incident.setdefault("escalations", []).append(
+                        dict(trigger)
+                    )
+                return None
+            last = self._last_capture.get(key)
+            if last is not None and now - last < self.cooldown_s:
+                return None
+            self._last_capture[key] = now
+        return self._capture(key, trigger, detail)
+
+    def _capture(
+        self,
+        key: str | None,
+        trigger: dict,
+        detail: dict | None,
+        enrich_async: bool = True,
+    ) -> dict:
+        """Freeze the capsule. The synchronous half is dict assembly
+        only; trace splice + hot threads enrich on a background thread
+        (see the module docstring's latency-budget rationale)."""
+        t0 = time.monotonic()
+        # staticcheck: ignore[wallclock-duration] operator-facing timestamp, not a duration
+        started_ms = int(time.time() * 1e3)
+        since_ms = started_ms - 60_000  # one trailing-window span back
+        if detail is None and trigger.get("indicator"):
+            detail = self._indicator_detail(trigger["indicator"])
+        capsule: dict[str, Any] = {
+            "indicator": detail,
+            "frames": self.recorder.frames(limit=MAX_FRAMES_PER_CAPSULE),
+            "transport": self._transport_evidence(),
+            "remediation": self._remediation_window(since_ms),
+            "enrichment": "pending",
+        }
+        with self._lock:
+            self._seq += 1
+            incident: dict[str, Any] = {
+                "id": f"inc-{self._seq:04d}",
+                "status": "open" if key is not None else "resolved",
+                "trigger": dict(trigger),
+                "started_at_ms": started_ms,
+                "time_to_green_ms": None,
+                "capsule": capsule,
+            }
+            if key is not None:
+                self._open[key] = incident
+            self._ring.append(incident)
+            self._evict_locked()
+        if self._captures_c is not None:
+            self._captures_c.inc()
+        capsule["freeze_cost_ms"] = round(
+            (time.monotonic() - t0) * 1e3, 3
+        )
+        if enrich_async:
+            threading.Thread(
+                target=self._enrich,
+                args=(incident, since_ms),
+                daemon=True,
+                name=f"estpu-incident-{incident['id']}",
+            ).start()
+        else:
+            self._enrich(incident, since_ms)
+        return incident
+
+    def _enrich(self, incident: dict, since_ms: int) -> None:
+        capsule = incident["capsule"]
+        self._tl.capturing = True
+        try:
+            capsule["traces"] = self._exemplar_traces(since_ms)
+            capsule["hot_threads"] = self._hot_threads_sample()
+            capsule["enrichment"] = "complete"
+        # staticcheck: ignore[broad-except] enrichment is best-effort evidence: a mid-chaos fan error degrades the bundle (recorded on it), never crashes the capture thread silently
+        except Exception as e:
+            capsule["enrichment"] = f"failed: {type(e).__name__}: {e}"
+        finally:
+            self._tl.capturing = False
+        self._export(incident)
+
+    def _indicator_detail(self, indicator: str) -> dict | None:
+        """The triggering report was terse: recompute ONE indicator
+        verbosely, with the hook guard held so the recompute can never
+        nest another frame/capture round."""
+        self._tl.capturing = True
+        try:
+            report = self.node.health_report(
+                verbose=True, indicator=indicator
+            )
+            return report["indicators"].get(indicator)
+        # staticcheck: ignore[broad-except] capsule evidence is best-effort: a failed recompute degrades the bundle to the terse symptom, never fails the capture
+        except Exception:
+            return None
+        finally:
+            self._tl.capturing = False
+
+    def _resolve(self, key: str, incident: dict) -> None:
+        # staticcheck: ignore[wallclock-duration] operator-facing timestamp; the delta below is ms-vs-ms of the same clock
+        resolved_ms = int(time.time() * 1e3)
+        with self._lock:
+            if self._open.get(key) is not incident:
+                return
+            del self._open[key]
+            incident["status"] = "resolved"
+            incident["resolved_at_ms"] = resolved_ms
+            incident["time_to_green_ms"] = max(
+                0, resolved_ms - incident["started_at_ms"]
+            )
+        # Post-window evidence: frames since the trigger and any
+        # remediation actions the window picked up while open.
+        capsule = incident["capsule"]
+        capsule["post_frames"] = self.recorder.frames(
+            since_ms=incident["started_at_ms"],
+            limit=MAX_FRAMES_PER_CAPSULE,
+        )
+        capsule["remediation"] = self._remediation_window(
+            incident["started_at_ms"] - 60_000
+        )
+        if self._resolved_c is not None:
+            self._resolved_c.inc()
+        self._export(incident)
+
+    def _evict_locked(self) -> None:
+        """Bound the ring: resolved incidents age out first; an open
+        incident is only dropped when resolved ones cannot make room."""
+        while len(self._ring) > self.capacity:
+            victim = None
+            for candidate in self._ring:
+                if candidate["status"] != "open":
+                    victim = candidate
+                    break
+            if victim is None:
+                victim = self._ring[0]
+                for k, v in list(self._open.items()):
+                    if v is victim:
+                        del self._open[k]
+            self._ring.remove(victim)
+
+    def _export(self, incident: dict) -> None:
+        if self.export_dir is None:
+            return
+        try:
+            os.makedirs(self.export_dir, exist_ok=True)
+            path = os.path.join(
+                self.export_dir, f"incident-{incident['id']}.json"
+            )
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(incident, f, default=str)
+            os.replace(tmp, path)
+        except OSError as e:
+            incident["export_error"] = f"{type(e).__name__}: {e}"
+
+    # ---------------------------------------------------------- remediation
+
+    def on_remediation_record(self, record: dict) -> None:
+        """RemediationService action hook: an executed/planned action
+        lands on every open capsule live (the resolve pass re-derives
+        the full window anyway; this keeps mid-incident GETs honest)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            for incident in self._open.values():
+                actions = incident["capsule"]["remediation"].setdefault(
+                    "actions", []
+                )
+                actions.append(dict(record))
+                del actions[:-MAX_ACTIONS_PER_CAPSULE]
+
+    # ------------------------------------------------------------ surface
+
+    def capture(
+        self, indicator: str | None = None, reason: str = "manual"
+    ) -> dict:
+        """POST /_incidents/_capture — an operator grab: freezes a
+        capsule right now (resolved immediately: there is no trigger to
+        watch). Enrichment runs synchronously — the operator asked."""
+        if not self.enabled:
+            return {"enabled": False, "captured": False}
+        trigger: dict[str, Any] = {"kind": "manual", "reason": reason}
+        if indicator is not None:
+            trigger["indicator"] = indicator
+        incident = self._capture(
+            None, trigger, detail=None, enrich_async=False
+        )
+        return incident
+
+    def incidents(self, verbose: bool = True) -> list[dict]:
+        """The ring, newest first: full capsules when verbose, else
+        status/trigger lines only."""
+        with self._lock:
+            ring = list(reversed(self._ring))
+        if verbose:
+            return ring
+        return [self._summary(i) for i in ring]
+
+    @staticmethod
+    def _summary(incident: dict) -> dict:
+        capsule = incident.get("capsule", {})
+        remediation = capsule.get("remediation", {})
+        return {
+            "id": incident["id"],
+            "status": incident["status"],
+            "trigger": dict(incident["trigger"]),
+            "started_at_ms": incident["started_at_ms"],
+            "resolved_at_ms": incident.get("resolved_at_ms"),
+            "time_to_green_ms": incident.get("time_to_green_ms"),
+            "actions": len(remediation.get("actions", ())),
+            "enrichment": capsule.get("enrichment"),
+        }
+
+    def get(self, incident_id: str) -> dict | None:
+        with self._lock:
+            for incident in self._ring:
+                if incident["id"] == incident_id:
+                    return incident
+        return None
+
+    def stats(self) -> dict:
+        """The `_nodes/stats → incidents` section (present-but-inert
+        under ESTPU_INCIDENTS=0, like every other gated subsystem)."""
+        with self._lock:
+            open_count = len(self._open)
+            total = self._seq
+            resolved = sum(
+                1 for i in self._ring if i["status"] == "resolved"
+            )
+        return {
+            "enabled": self.enabled,
+            "open": open_count,
+            "captured_total": total,
+            "resolved_in_ring": resolved,
+            "capacity": self.capacity,
+            "cooldown_s": self.cooldown_s,
+            "export_dir": self.export_dir,
+            "recorder": self.recorder.stats(),
+        }

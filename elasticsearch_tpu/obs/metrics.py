@@ -951,6 +951,22 @@ CATALOG = {
     # chokepoint): the lifecycle loop schedules background force-merges
     # only when an index went quiet.
     "estpu_index_writes_recent": ("windowed_counter", "indices"),
+    # ANN cache lookup outcomes at the get_or_build sites (index/ann.py):
+    # the remediation budget loop and incident capsules read a TRUE hit
+    # rate instead of leaning on the eviction window (PR-18 residue).
+    "estpu_ann_cache_hits_total": ("counter", "search.ann"),
+    "estpu_ann_cache_misses_total": ("counter", "search.ann"),
+    "estpu_ann_cache_events_recent": ("windowed_counter", "search.ann"),
+    # Flight recorder + incident autopsy (obs/recorder.py +
+    # obs/incidents.py, GET /_incidents): frames recorded on the health
+    # poll cadence, frames resident in the bounded ring, capsules frozen
+    # (auto triggers + manual grabs), incidents resolved back to green,
+    # and the open-incident count.
+    "estpu_recorder_frames_total": ("counter", "incidents"),
+    "estpu_recorder_frames": ("gauge", "incidents"),
+    "estpu_incident_captures_total": ("counter", "incidents"),
+    "estpu_incident_resolved_total": ("counter", "incidents"),
+    "estpu_incident_open": ("gauge", "incidents"),
 }
 
 # Pow-2-ish bounds for the padding-waste ratio and occupancy/wait shapes.

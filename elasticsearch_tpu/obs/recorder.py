@@ -1,0 +1,109 @@
+"""Flight recorder: an always-on bounded ring of periodic lightweight
+cluster snapshots (ISSUE 19).
+
+Every windowed instrument ages out in 60s and the trace ring churns, so
+by the time anyone looks at an incident the evidence is gone. The
+recorder keeps the last N *frames* — per-indicator health statuses,
+rolling-window deltas (`estpu_*_recent` p50/p99/rates), breaker/HBM
+ledger totals, QoS lane summaries, top insights exemplar trace_ids —
+recorded on the health poll's cadence, so an incident capsule
+(obs/incidents.py) can always splice in what the cluster looked like
+*before* the trigger, not just after.
+
+A frame is a plain dict snapshot of already-computed numbers: recording
+one costs dict assembly, never a fan, never a device call — the ring is
+safe to feed at 1/s forever (the bench cfg17 gate).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+DEFAULT_CAPACITY = 240  # 4 minutes of 1/s polls
+
+
+class FlightRecorder:
+    """Bounded ring of timestamped frames, newest last.
+
+    `record` stamps and appends; `frames` filters by wall-clock window
+    (the incident capsule's pre/post splice); both are lock-cheap.
+    """
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        metrics=None,
+    ):
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._frames: list[dict] = []  # newest last, bounded
+        self._seq = 0
+        self.metrics = metrics
+        if metrics is not None:
+            self._frames_c = metrics.counter(
+                "estpu_recorder_frames_total",
+                "Flight-recorder frames recorded (health-poll cadence)",
+            )
+            metrics.gauge(
+                "estpu_recorder_frames",
+                "Flight-recorder frames resident in the bounded ring",
+                fn=lambda: len(self._frames),
+            )
+        else:
+            self._frames_c = None
+
+    def record(
+        self,
+        statuses: dict[str, str] | None = None,
+        extras: dict[str, Any] | None = None,
+    ) -> dict:
+        """Append one frame: indicator statuses plus whatever windowed/
+        ledger extras the caller snapshotted. Returns the frame."""
+        frame: dict[str, Any] = {
+            # staticcheck: ignore[wallclock-duration] operator-facing timestamp, not a duration
+            "at_ms": int(time.time() * 1e3),
+            "statuses": dict(statuses or {}),
+        }
+        if extras:
+            frame.update(extras)
+        with self._lock:
+            self._seq += 1
+            frame["seq"] = self._seq
+            self._frames.append(frame)
+            if len(self._frames) > self.capacity:
+                del self._frames[: -self.capacity]
+        if self._frames_c is not None:
+            self._frames_c.inc()
+        return frame
+
+    def frames(
+        self,
+        since_ms: int | None = None,
+        until_ms: int | None = None,
+        limit: int | None = None,
+    ) -> list[dict]:
+        """Frames inside [since_ms, until_ms] (wall clock), oldest
+        first; `limit` keeps the newest N of the selection."""
+        with self._lock:
+            out = list(self._frames)
+        if since_ms is not None:
+            out = [f for f in out if f["at_ms"] >= since_ms]
+        if until_ms is not None:
+            out = [f for f in out if f["at_ms"] <= until_ms]
+        if limit is not None and len(out) > limit:
+            out = out[-limit:]
+        return out
+
+    def last(self) -> dict | None:
+        with self._lock:
+            return self._frames[-1] if self._frames else None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "frames": len(self._frames),
+                "capacity": self.capacity,
+                "recorded_total": self._seq,
+            }

@@ -73,8 +73,15 @@ class PlainText:
 # Endpoints that observe the observer: tracing them would fill the ring
 # buffer with scrapes instead of searches. `/_health_report` belongs
 # here so a paced health poll (a 1/s liveness probe is normal ops)
-# doesn't churn the trace ring.
-_UNTRACED_PATHS = ("/_traces", "/_metrics", "/_health_report")
+# doesn't churn the trace ring; `/_incidents` for the same reason — a
+# paced incident poll must not evict the very exemplar traces its
+# capsules splice in.
+_UNTRACED_PATHS = (
+    "/_traces",
+    "/_metrics",
+    "/_health_report",
+    "/_incidents",
+)
 
 # Cluster-topology failures that may escape the Node's own retry mapping
 # (e.g. raised from a code path that predates replication): the router
@@ -459,6 +466,20 @@ class RestServer:
         r("POST", "/_remediation", lambda s, p, q, b: n.post_remediation(
             _json(b)
         ))
+        # Flight recorder + incident autopsy (obs/incidents.py): the
+        # bounded capsule ring. ?verbose=false returns statuses/trigger
+        # lines only (no capsule bodies, no cluster fan); untraced (see
+        # _UNTRACED_PATHS). `_capture` registers before `{id}` — route
+        # registration order is match order.
+        r("GET", "/_incidents", lambda s, p, q, b: n.get_incidents(
+            verbose=_verbose_param(q)
+        ))
+        r("POST", "/_incidents/_capture", lambda s, p, q, b:
+          n.capture_incident(_json(b)))
+        r("GET", "/_incidents/{id}", lambda s, p, q, b: n.get_incident(
+            p["id"]
+        ))
+        r("GET", "/_cat/incidents", lambda s, p, q, b: n.cat_incidents())
         # Observability: trace ring + Prometheus exposition.
         r("GET", "/_traces", lambda s, p, q, b: n.get_traces(
             limit=int(q.get("limit", 50))

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""CI smoke gate for the flight recorder + incident autopsy (ISSUE 19).
+
+Runs, on the CPU backend with no TPU in the loop:
+
+- the bounded flight-recorder ring (record/filter/limit semantics, the
+  cataloged estpu_recorder_* instruments),
+- the auto-capture law on a standalone node and over a LocalCluster REST
+  front: any health indicator leaving green freezes an incident capsule
+  within one poll, with the named diagnosis, >= 1 recorder frame from
+  BEFORE the trigger, spliced exemplar traces, a hot-threads sample, and
+  in-window remediation actions; green resolves with a time-to-green,
+- manual grabs (`POST /_incidents/_capture`), the ring bound (resolved
+  incidents age out first, open ones survive), JSON bundle export, the
+  `/_cat/incidents` row surface, `?verbose=false` skipping capsule
+  bodies and the cluster fan, the untraced-path law, and the
+  `ESTPU_INCIDENTS=0` present-but-inert mode,
+- the ProcCluster capsule fan over the never-intercepted `_ctl` path
+  (per-member recorder summaries).
+
+The same tests ride the tier-1 run via the fast (`not slow`) marker;
+this script is the standalone hook for pre-merge / cron checks,
+mirroring scripts/check_health_smoke.py:
+
+    python scripts/check_incidents_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "tests/test_incidents.py",
+        "-q",
+        "-m",
+        "not slow",
+        "-p",
+        "no:cacheprovider",
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    main_rc = main()
+    sys.exit(main_rc)

@@ -131,6 +131,20 @@ def make_async_qos_instruments(m):
     )
 
 
+def make_incident_instruments(m):
+    # Flight-recorder and incident-autopsy instruments are instruments
+    # too: uncataloged estpu_recorder_* / estpu_incident_* registrations
+    # fail the gate exactly like any other rogue estpu_* name.
+    m.counter(
+        "estpu_recorder_rogue_total",
+        "flight-recorder instrument not in CATALOG",
+    )
+    m.counter(
+        "estpu_incident_rogue_total",
+        "incident instrument not in CATALOG",
+    )
+
+
 def charge_breaker(breaker, n):
     breaker.add(n, label="segment")  # registered ledger label: fine
     # f-string labels match by static prefix, like fault-site patterns.

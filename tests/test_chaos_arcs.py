@@ -283,6 +283,50 @@ class TestBrownout:
                 details = report["indicators"]["transport"]["details"]
                 assert slow in details.get("unreachable_members", ())
 
+                # ISSUE 19 auto-capture law: the SAME poll that first
+                # reported transport non-green froze an incident capsule
+                # (the capture rides the report's own transition hook —
+                # "within one health poll" is structural, not a race).
+                status, out = rest.dispatch(
+                    "GET", "/_incidents", {"verbose": "false"}, ""
+                )
+                assert status == 200
+                opened = [
+                    s
+                    for s in out["incidents"]
+                    if s["trigger"].get("indicator") == "transport"
+                ]
+                assert opened, f"no transport capsule frozen: {out}"
+                incident_id = opened[0]["id"]
+                # An in-window remediation action links onto the open
+                # capsule live through the action hook.
+                rest.node.remediation.note_on_demand_repack(INDEX)
+
+                def _enriched():
+                    inc = rest.node.incidents.get(incident_id)
+                    if inc["capsule"]["enrichment"] == "pending":
+                        return None
+                    return inc
+
+                incident = _until(
+                    _enriched,
+                    timeout_s=5 * FAN_BUDGET_S,
+                    what="capsule enrichment under brownout",
+                )
+                capsule = incident["capsule"]
+                # The captured diagnosis NAMES the slow peer.
+                assert f"[{slow}]" in json.dumps(capsule["indicator"])
+                # >= 1 recorder frame from BEFORE the trigger (the green
+                # polls above fed the ring).
+                assert any(
+                    f["at_ms"] < incident["started_at_ms"]
+                    for f in capsule["frames"]
+                ), "no pre-trigger recorder frame survived"
+                assert any(
+                    a["kind"] == "on_demand_repack"
+                    for a in capsule["remediation"]["actions"]
+                )
+
                 # Healthy-path latency budget: p99 of searches AFTER the
                 # route-around stays below the per-send deadline — no
                 # measured request waited on the browned-out peer.
@@ -312,6 +356,27 @@ class TestBrownout:
         # and re-replicate; green is the arc's exit condition.
         procs.wait_for_status("green", timeout_s=60.0)
         _assert_all_acked_readable(rest, traffic.acked)
+
+        # The incident resolves with a time-to-green once a report sees
+        # transport green again. HONEST lag: the indicator stays yellow
+        # until the browned-out window's send timeouts age out (~60s),
+        # so the resolution poll is generous but bounded. Resolution
+        # needs a report round — GET /_incidents alone never re-judges.
+        def _resolved():
+            s, _ = rest.dispatch(
+                "GET", "/_health_report", {"verbose": "false"}, ""
+            )
+            assert s == 200
+            inc = rest.node.incidents.get(incident_id)
+            return inc if inc["status"] == "resolved" else None
+
+        incident = _until(
+            _resolved,
+            timeout_s=90.0,
+            what="incident resolution (transport back to green)",
+        )
+        assert incident["time_to_green_ms"] is not None
+        assert incident["time_to_green_ms"] > 0
 
 
 class TestPartition:

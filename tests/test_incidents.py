@@ -1,0 +1,479 @@
+"""Flight recorder + incident autopsy (ISSUE 19): the bounded frame
+ring, the auto-capture law (any indicator leaving green freezes a
+time-correlated evidence capsule within one health poll), manual grabs,
+resolution records with time-to-green, the `GET /_incidents` /
+`/_cat/incidents` surfaces over both cluster forms, and the
+`ESTPU_INCIDENTS=0` present-but-inert mode.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+import pytest
+
+from elasticsearch_tpu.node import ApiError, Node
+from elasticsearch_tpu.obs.incidents import IncidentService
+from elasticsearch_tpu.obs.metrics import MetricsRegistry
+from elasticsearch_tpu.obs.recorder import FlightRecorder
+from elasticsearch_tpu.rest.server import RestServer
+
+REPLICATED_INDEX = json.dumps(
+    {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 1},
+        "mappings": {"properties": {"b": {"type": "text"}}},
+    }
+)
+
+
+def _until(predicate, timeout_s: float, what: str):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        result = predicate()
+        if result:
+            return result
+        if time.monotonic() >= deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        time.sleep(0.1)
+
+
+def _wait_enriched(service, incident_id: str, timeout_s: float = 10.0):
+    """Enrichment (trace splice + hot threads) runs on a background
+    thread; wait for it before asserting capsule anatomy."""
+
+    def done():
+        incident = service.get(incident_id)
+        state = incident["capsule"]["enrichment"]
+        return incident if state != "pending" else None
+
+    return _until(done, timeout_s, f"enrichment of {incident_id}")
+
+
+# --------------------------------------------------------- the frame ring
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_ordered(self):
+        rec = FlightRecorder(capacity=5)
+        for i in range(12):
+            rec.record(statuses={"transport": "green"}, extras={"i": i})
+        frames = rec.frames()
+        assert len(frames) == 5
+        assert [f["i"] for f in frames] == [7, 8, 9, 10, 11]
+        assert frames[-1] is rec.last()
+        stats = rec.stats()
+        assert stats == {
+            "frames": 5,
+            "capacity": 5,
+            "recorded_total": 12,
+        }
+
+    def test_window_filter_and_limit(self):
+        rec = FlightRecorder(capacity=10)
+        first = rec.record(extras={"i": 0})
+        rec.record(extras={"i": 1})
+        assert rec.frames(since_ms=first["at_ms"])[0]["i"] == 0
+        assert [f["i"] for f in rec.frames(limit=1)] == [1]
+        assert rec.frames(until_ms=first["at_ms"] - 1) == []
+
+    def test_registers_cataloged_instruments(self):
+        registry = MetricsRegistry()
+        rec = FlightRecorder(capacity=3, metrics=registry)
+        rec.record(statuses={"transport": "green"})
+        assert registry.value("estpu_recorder_frames_total") == 1
+
+
+# ------------------------------------------------------------- standalone
+
+
+@pytest.fixture(scope="module")
+def node():
+    n = Node(node_name="inc-node")
+    n.create_index(
+        "inc", {"mappings": {"properties": {"b": {"type": "text"}}}}
+    )
+    n.index_doc("inc", {"b": "alpha evidence"}, "1")
+    n.refresh("inc")
+    n.search("inc", {"query": {"match": {"b": "alpha"}}})
+    yield n
+    n.close()
+
+
+class TestStandaloneIncidents:
+    def test_health_report_records_a_frame(self, node):
+        before = node.incidents.recorder.stats()["recorded_total"]
+        node.health_report(verbose=True)
+        after = node.incidents.recorder.stats()
+        assert after["recorded_total"] == before + 1
+        frame = node.incidents.recorder.last()
+        assert frame["statuses"]  # per-indicator statuses
+        assert "shed_recent" in frame and "evictions_recent" in frame
+        assert "breaker" in frame and "hbm_total_bytes" in frame
+
+    def test_manual_capture_capsule_anatomy(self, node):
+        node.health_report(verbose=True)
+        incident = node.incidents.capture(reason="unit grab")
+        assert incident["status"] == "resolved"  # nothing to watch
+        assert incident["trigger"] == {
+            "kind": "manual",
+            "reason": "unit grab",
+        }
+        capsule = incident["capsule"]
+        assert capsule["enrichment"] == "complete"  # sync for manual
+        assert capsule["frames"], "ring frames spliced in"
+        assert all(
+            f["at_ms"] <= incident["started_at_ms"]
+            for f in capsule["frames"]
+        )
+        assert "hot_threads" in capsule and node.node_name in (
+            capsule["hot_threads"]
+        )
+        # The window's slowest exemplar, spliced via the trace ring.
+        traces = capsule["traces"]
+        assert traces and traces[0]["trace_id"]
+        assert "remediation" in capsule
+        assert incident["time_to_green_ms"] is None  # manual: no arc
+
+    def test_transition_opens_then_green_resolves(self, node):
+        service = node.incidents
+        service.on_report(
+            [{"indicator": "transport", "from": "green", "to": "yellow"}],
+            {
+                "transport": {
+                    "status": "yellow",
+                    "symptom": "slow peer [node-9]",
+                }
+            },
+            False,
+        )
+        summaries = service.incidents(verbose=False)
+        mine = [
+            s
+            for s in summaries
+            if s["trigger"].get("indicator") == "transport"
+        ]
+        assert mine and mine[0]["status"] == "open"
+        incident_id = mine[0]["id"]
+        _wait_enriched(service, incident_id)
+        # A repeat transition while open must NOT double-capture; an
+        # escalation (yellow -> red) is noted on the open capsule.
+        service.on_report(
+            [{"indicator": "transport", "from": "yellow", "to": "red"}],
+            {"transport": {"status": "red", "symptom": "worse"}},
+            False,
+        )
+        still = [
+            s
+            for s in service.incidents(verbose=False)
+            if s["trigger"].get("indicator") == "transport"
+        ]
+        assert len(still) == 1 and still[0]["id"] == incident_id
+        assert service.get(incident_id).get("escalations")
+        # Remediation linkage: an executed action lands on the open
+        # capsule live through the action hook.
+        node.remediation.note_on_demand_repack("inc")
+        actions = service.get(incident_id)["capsule"]["remediation"][
+            "actions"
+        ]
+        assert any(a["kind"] == "on_demand_repack" for a in actions)
+        # Green resolves with a time-to-green.
+        service.on_report(
+            [],
+            {"transport": {"status": "green", "symptom": "ok"}},
+            False,
+        )
+        resolved = service.get(incident_id)
+        assert resolved["status"] == "resolved"
+        assert resolved["time_to_green_ms"] is not None
+        assert resolved["time_to_green_ms"] >= 0
+        assert resolved["capsule"]["post_frames"] is not None
+
+    def test_cat_rows_and_404(self, node):
+        rows = node.cat_incidents()
+        assert rows, "prior tests captured incidents"
+        for row in rows:
+            assert set(row) == {
+                "id",
+                "trigger",
+                "kind",
+                "status",
+                "start",
+                "time_to_green_ms",
+                "actions",
+            }
+            assert all(isinstance(v, str) for v in row.values())
+            assert row["status"] in ("open", "resolved")
+        with pytest.raises(ApiError) as err:
+            node.get_incident("inc-9999")
+        assert err.value.status == 404
+
+    def test_bundle_export_writes_json(self, node, monkeypatch):
+        with tempfile.TemporaryDirectory(prefix="estpu-inc-") as d:
+            monkeypatch.setattr(node.incidents, "export_dir", d)
+            incident = node.incidents.capture(reason="export grab")
+            path = os.path.join(d, f"incident-{incident['id']}.json")
+            assert os.path.exists(path)
+            with open(path) as f:
+                bundle = json.load(f)
+            assert bundle["id"] == incident["id"]
+            assert bundle["capsule"]["frames"]
+
+
+class TestRingBound:
+    def test_resolved_incidents_age_out_open_survive(self, monkeypatch):
+        monkeypatch.setenv("ESTPU_INCIDENTS_CAPACITY", "3")
+        n = Node(node_name="ring-node")
+        try:
+            service = n.incidents
+            assert service.capacity == 3
+            # One OPEN incident, then a flood of manual (resolved) grabs:
+            # the open one must survive the eviction sweep.
+            service.on_report(
+                [
+                    {
+                        "indicator": "transport",
+                        "from": "green",
+                        "to": "yellow",
+                    }
+                ],
+                {"transport": {"status": "yellow", "symptom": "s"}},
+                False,
+            )
+            open_id = service.incidents(verbose=False)[0]["id"]
+            _wait_enriched(service, open_id)
+            for i in range(5):
+                service.capture(reason=f"flood-{i}")
+            summaries = service.incidents(verbose=False)
+            assert len(summaries) == 3
+            assert any(s["id"] == open_id for s in summaries)
+            assert service.stats()["open"] == 1
+        finally:
+            n.close()
+
+
+class TestDisabledMode:
+    def test_present_but_inert(self, monkeypatch):
+        monkeypatch.setenv("ESTPU_INCIDENTS", "0")
+        n = Node(node_name="off-node")
+        try:
+            assert n.incidents.enabled is False
+            n.health_report(verbose=True)
+            assert n.incidents.recorder.stats()["frames"] == 0
+            grabbed = n.incidents.capture(reason="ignored")
+            assert grabbed == {"enabled": False, "captured": False}
+            out = n.get_incidents(verbose=True)
+            assert out["enabled"] is False
+            assert out["incidents"] == []
+            # The stats section keeps its full shape (the AnnCache
+            # disabled_stats law).
+            stats = n.incidents.stats()
+            assert stats["enabled"] is False
+            assert stats["open"] == 0 and stats["captured_total"] == 0
+            assert "recorder" in stats
+            assert n._local_node_stats()["incidents"]["enabled"] is False
+        finally:
+            n.close()
+
+    def test_hook_is_a_noop_when_disabled(self, monkeypatch):
+        monkeypatch.setenv("ESTPU_INCIDENTS", "0")
+        registry = MetricsRegistry()
+        service = IncidentService.__new__(IncidentService)
+        # Construct via __init__ against a bare sentinel node: disabled
+        # mode must never touch it.
+        IncidentService.__init__(service, node=None, metrics=registry)
+        service.on_report(
+            [{"indicator": "transport", "from": "green", "to": "red"}],
+            {"transport": {"status": "red", "symptom": "s"}},
+            True,
+        )
+        assert service.incidents() == []
+        service.on_remediation_record({"kind": "retune"})
+
+
+# ------------------------------------------------- LocalCluster auto-capture
+
+
+class TestLocalClusterIncidentArc:
+    @pytest.fixture(scope="class")
+    def rest(self):
+        mesh = os.environ.get("ESTPU_MESH_SERVING")
+        os.environ["ESTPU_MESH_SERVING"] = "0"
+        server = RestServer(replication_nodes=3)
+        server.dispatch("PUT", "/iarc", {}, REPLICATED_INDEX)
+        server.dispatch(
+            "PUT", "/iarc/_doc/1", {}, json.dumps({"b": "alpha"})
+        )
+        yield server
+        server.close()
+        if mesh is None:
+            os.environ.pop("ESTPU_MESH_SERVING", None)
+        else:
+            os.environ["ESTPU_MESH_SERVING"] = mesh
+
+    def _wait_green(self, rest, timeout_s=30.0):
+        def green():
+            status, rep = rest.dispatch("GET", "/_health_report", {}, "")
+            assert status == 200
+            return rep if rep["status"] == "green" else None
+
+        return _until(green, timeout_s, "green report")
+
+    def test_kill_freezes_capsule_with_pre_trigger_frame(self, rest):
+        self._wait_green(rest)
+        node = rest.node
+        frames_before = node.incidents.recorder.stats()["recorded_total"]
+        assert frames_before >= 1  # the green polls fed the ring
+        rest.cluster.kill("node-2")
+        try:
+            # ONE health poll both diagnoses and freezes: the capture
+            # rides the report's own transition hook.
+            status, rep = rest.dispatch("GET", "/_health_report", {}, "")
+            assert status == 200 and rep["status"] != "green"
+            status, out = rest.dispatch(
+                "GET", "/_incidents", {"verbose": "false"}, ""
+            )
+            assert status == 200
+            opened = [
+                s for s in out["incidents"] if s["status"] == "open"
+            ]
+            assert opened, f"no incident frozen: {out}"
+            sa = [
+                s
+                for s in opened
+                if s["trigger"].get("indicator") == "shards_availability"
+            ]
+            assert sa, f"no shards_availability trigger: {opened}"
+            incident = _wait_enriched(node.incidents, sa[0]["id"])
+            capsule = incident["capsule"]
+            # The named diagnosis, straight from the triggering report.
+            detail = capsule["indicator"]
+            assert detail is not None and detail["status"] != "green"
+            assert any(
+                "node-2" in d["cause"] for d in detail["diagnosis"]
+            )
+            # >= 1 recorder frame from BEFORE the trigger.
+            assert any(
+                f["at_ms"] < incident["started_at_ms"]
+                and f["statuses"]
+                for f in capsule["frames"]
+            )
+            assert "traces" in capsule and "hot_threads" in capsule
+        finally:
+            rest.cluster.restart("node-2")
+        self._wait_green(rest)
+
+        def resolved():
+            status, out = rest.dispatch(
+                "GET", "/_incidents", {"verbose": "false"}, ""
+            )
+            assert status == 200
+            done = [
+                s
+                for s in out["incidents"]
+                if s["trigger"].get("indicator") == "shards_availability"
+                and s["status"] == "resolved"
+            ]
+            return done[0] if done else None
+
+        record = _until(resolved, 30.0, "incident resolution")
+        assert record["time_to_green_ms"] is not None
+        assert record["time_to_green_ms"] > 0
+
+    def test_verbose_false_skips_capsules_and_fan(self, rest):
+        status, out = rest.dispatch(
+            "GET", "/_incidents", {"verbose": "false"}, ""
+        )
+        assert status == 200
+        assert "_nodes" not in out and "nodes" not in out
+        for summary in out["incidents"]:
+            assert "capsule" not in summary
+        status, full = rest.dispatch("GET", "/_incidents", {}, "")
+        assert status == 200
+        assert full["_nodes"]["failed"] == 0
+        assert set(full["nodes"]) >= {"node-0", "node-1", "node-2"}
+
+    def test_incidents_polling_stays_untraced(self, rest):
+        """Trace-identity law: a paced /_incidents poll must not churn
+        the trace ring — the newest trace ids are unchanged by the
+        scrapes (same law as /_health_report)."""
+
+        def newest_ids():
+            return [
+                t["trace_id"]
+                for t in rest.node.get_traces(limit=5)["traces"]
+            ]
+
+        before = newest_ids()
+        for _ in range(5):
+            status, _out = rest.dispatch(
+                "GET", "/_incidents", {"verbose": "false"}, ""
+            )
+            assert status == 200
+            rest.dispatch("GET", "/_incidents/inc-0001", {}, "")
+        assert newest_ids() == before  # polls buffered NO traces
+        # ... while an ordinary request DOES trace.
+        rest.dispatch(
+            "POST",
+            "/iarc/_search",
+            {},
+            json.dumps({"query": {"match": {"b": "alpha"}}}),
+        )
+        after = newest_ids()
+        assert after != before
+        assert after[0] not in before
+
+    def test_cat_incidents_format_json(self, rest):
+        status, rows = rest.dispatch(
+            "GET", "/_cat/incidents", {"format": "json"}, ""
+        )
+        assert status == 200
+        assert isinstance(rows, list) and rows
+        assert rows[0]["id"].startswith("inc-")
+
+
+# -------------------------------------------------- ProcCluster capsule fan
+
+
+@pytest.fixture(scope="module")
+def procs():
+    from elasticsearch_tpu.cluster.procs import ProcCluster
+
+    cluster = ProcCluster(
+        2, data_path=tempfile.mkdtemp(prefix="estpu-inc-procs-")
+    )
+    yield cluster
+    cluster.close()
+
+
+class TestProcClusterIncidentFan:
+    def test_fan_collects_member_recorders(self, procs):
+        from elasticsearch_tpu.cluster.gateway import ProcGateway
+
+        procs.wait_for_status("green", 60)
+        node = Node(
+            node_name="front",
+            replication=ProcGateway(procs, timeout_s=8.0),
+        )
+        try:
+            node.health_report(verbose=True)
+            # The front's recorder rode the procs HealthService hook;
+            # every worker armed its member-side ring from the
+            # health_inputs ship.
+            assert node.incidents.recorder.stats()["frames"] >= 1
+            out = node.get_incidents(verbose=True)
+            assert out["_nodes"]["failed"] == 0
+            assert set(out["nodes"]) >= {"front", "node-0", "node-1"}
+            for worker in procs.workers:
+                section = out["nodes"][worker]
+                assert section["recorder"]["frames"] >= 1
+                assert section["frames"], "newest member frames ride along"
+            # Manual grab works over the proc topology too, including
+            # the spliced-trace path through the `_ctl` scatter.
+            incident = node.incidents.capture(reason="proc grab")
+            assert incident["capsule"]["enrichment"] == "complete"
+            assert incident["capsule"]["frames"]
+        finally:
+            node.close()

@@ -181,7 +181,10 @@ class TestRegistryConsistency:
         # ... and uncataloged async-search / QoS-lane instruments
         assert any("[estpu_async_rogue_total]" in m for m in msgs)
         assert any("[estpu_qos_rogue_total]" in m for m in msgs)
-        assert len(msgs) == 15
+        # ... and uncataloged flight-recorder / incident instruments
+        assert any("[estpu_recorder_rogue_total]" in m for m in msgs)
+        assert any("[estpu_incident_rogue_total]" in m for m in msgs)
+        assert len(msgs) == 17
 
     def test_indicator_registry(self, report):
         msgs = [
